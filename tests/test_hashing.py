@@ -1,0 +1,80 @@
+"""Hashing contract tests: native vs pure-Python cross-check + known vectors
+(the chained block hash is the cross-tier invariant — SURVEY.md §7)."""
+
+import pytest
+
+from xllm_service_tpu.common import hashing
+
+
+# Published MurmurHash3 x64_128 vectors. The output is the canonical C
+# byte stream (memcpy of h1 then h2 on a little-endian host); sources that
+# print the (h1, h2) uint64 pair in hex are the per-word byte reverse.
+def _from_u64_pair(h1_hex: str, h2_hex: str) -> str:
+    return (bytes.fromhex(h1_hex)[::-1] + bytes.fromhex(h2_hex)[::-1]).hex()
+
+
+KNOWN_VECTORS = [
+    (b"", 0, "00000000000000000000000000000000"),
+    # (h1, h2) = (0xcbd8a7b341bd9b02, 0x5b1e906a48ae1d19) — widely cited.
+    (b"hello", 0, _from_u64_pair("cbd8a7b341bd9b02", "5b1e906a48ae1d19")),
+    (b"hello, world", 0, _from_u64_pair("342fac623a5ebc8e", "4cdcbc079642414d")),
+    # This one already in byte-stream form.
+    (b"The quick brown fox jumps over the lazy dog", 0,
+     "6c1b07bc7bbc4be347939ac4a93c437a"),
+]
+
+
+@pytest.mark.parametrize("data,seed,expect", KNOWN_VECTORS)
+def test_known_vectors_py(data, seed, expect):
+    assert hashing.murmur3_x64_128_py(data, seed).hex() == expect
+
+
+@pytest.mark.parametrize("data,seed,expect", KNOWN_VECTORS)
+def test_known_vectors_native(data, seed, expect):
+    if hashing._load_native() is None:
+        pytest.skip("native lib unavailable")
+    assert hashing.murmur3_x64_128(data, seed).hex() == expect
+
+
+def test_native_matches_python_fuzz():
+    import random
+
+    rng = random.Random(7)
+    if hashing._load_native() is None:
+        pytest.skip("native lib unavailable")
+    for _ in range(200):
+        n = rng.randrange(0, 300)
+        data = bytes(rng.randrange(256) for _ in range(n))
+        seed = rng.randrange(2**32)
+        assert hashing.murmur3_x64_128(data, seed) == hashing.murmur3_x64_128_py(
+            data, seed
+        )
+
+
+def test_block_hash_chaining():
+    tokens = list(range(256))
+    h = hashing.prefix_block_hashes(tokens, block_size=128)
+    assert len(h) == 2
+    # First block: unchained hash of tokens[0:128].
+    h0 = hashing.block_hash(None, tokens[:128])
+    assert h[0] == h0
+    # Second block chains on the first.
+    assert h[1] == hashing.block_hash(h0, tokens[128:256])
+    # Chaining means a different prefix changes downstream hashes.
+    tokens2 = [1] + tokens[1:]
+    h2 = hashing.prefix_block_hashes(tokens2, block_size=128)
+    assert h2[0] != h[0] and h2[1] != h[1]
+    # But an identical prefix gives identical hashes (partial block ignored).
+    h3 = hashing.prefix_block_hashes(tokens + [999], block_size=128)
+    assert h3 == h
+
+
+def test_incomplete_block_not_hashed():
+    assert hashing.prefix_block_hashes(list(range(127)), block_size=128) == []
+
+
+def test_seed_sensitivity():
+    tokens = list(range(128))
+    a = hashing.prefix_block_hashes(tokens, seed=1024)
+    b = hashing.prefix_block_hashes(tokens, seed=1025)
+    assert a != b

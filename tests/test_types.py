@@ -1,0 +1,80 @@
+"""JSON serde round-trips for control-plane types (wire parity with
+reference types.h serialize_to_json/parse_from_json)."""
+
+from xllm_service_tpu.common.types import (
+    CacheLocations,
+    InstanceMetaInfo,
+    InstanceType,
+    KvCacheEvent,
+    LatencyMetrics,
+    LoadMetrics,
+    Routing,
+)
+
+
+def test_routing_roundtrip():
+    r = Routing(prefill_name="p0", decode_name="d0")
+    j = r.to_json()
+    assert j == {"prefill_name": "p0", "decode_name": "d0"}
+    assert Routing.from_json(j) == r
+
+
+def test_load_metrics_roundtrip():
+    m = LoadMetrics(waiting_requests_num=7, gpu_cache_usage_perc=0.42)
+    assert LoadMetrics.from_json(m.to_json()) == m
+    # Reference wire field names preserved.
+    assert set(m.to_json()) == {"waiting_requests_num", "gpu_cache_usage_perc"}
+
+
+def test_instance_meta_roundtrip():
+    info = InstanceMetaInfo(
+        name="inst-0",
+        rpc_address="10.0.0.1:9889",
+        http_address="10.0.0.1:9888",
+        type=InstanceType.PREFILL,
+        cluster_ids=[0, 1],
+        addrs=["10.0.0.1:7000"],
+        k_cache_ids=[11, 12],
+        v_cache_ids=[21, 22],
+        dp_size=2,
+        tp_size=4,
+        ttft_profiling_data=[(128, 30.0), (1024, 180.0)],
+        tpot_profiling_data=[(1, 128, 8.0), (8, 4096, 12.0)],
+    )
+    back = InstanceMetaInfo.deserialize(info.serialize())
+    assert back.name == info.name
+    assert back.type == InstanceType.PREFILL
+    assert back.ttft_profiling_data == info.ttft_profiling_data
+    assert back.tpot_profiling_data == info.tpot_profiling_data
+    assert back.k_cache_ids == [11, 12]
+
+
+def test_cache_locations():
+    loc = CacheLocations(hbm_instance_set={"a"}, dram_instance_set={"b"})
+    back = CacheLocations.from_json(loc.to_json())
+    assert back == loc
+    assert not loc.empty()
+    assert CacheLocations().empty()
+
+
+def test_kvcache_event_roundtrip():
+    ev = KvCacheEvent(
+        stored_cache={b"\x01" * 16},
+        removed_cache={b"\x02" * 16},
+        offload_cache={b"\x03" * 16: "dram"},
+    )
+    back = KvCacheEvent.from_json(ev.to_json())
+    assert back == ev
+    assert not ev.empty()
+    assert KvCacheEvent().empty()
+
+
+def test_instance_type_parse():
+    assert InstanceType.parse("prefill") == InstanceType.PREFILL
+    assert InstanceType.parse(2) == InstanceType.DECODE
+    assert InstanceType.parse(InstanceType.MIX) == InstanceType.MIX
+
+
+def test_latency_metrics():
+    lm = LatencyMetrics(recent_max_ttft=120, recent_max_tbt=15)
+    assert LatencyMetrics.from_json(lm.to_json()) == lm

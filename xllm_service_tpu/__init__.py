@@ -1,0 +1,19 @@
+"""xllm_service_tpu — a TPU-native clustered LLM serving framework.
+
+A ground-up rebuild of the capabilities of xllm-service (jd-opensource's
+cluster service layer, see /root/reference) plus the engine tier it delegates
+to, designed TPU-first:
+
+- Engine tier: JAX/XLA/Pallas continuous-batching inference runtime with a
+  paged KV cache, pjit/shard_map parallelism over `jax.sharding.Mesh`, and
+  Pallas kernels for the hot ops (paged attention).
+- Service tier: OpenAI-compatible HTTP front end, etcd-style coordination
+  (with an in-memory backend for tests), instance registry with dynamic
+  prefill/decode role flipping, global prefix-cache index keyed by chained
+  murmur3 block hashes, and round-robin / cache-aware / SLO-aware routing.
+
+Layering follows SURVEY.md; reference file:line citations appear in each
+module's docstring.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,115 @@
+"""Framework configuration.
+
+Replaces the reference's gflags + builder Options pair
+(reference: common/global_gflags.cpp — ~23 flags; common/options.h:24-77)
+with one frozen dataclass parsed from CLI/env. Defaults mirror the
+reference's flag defaults (BASELINE.md anchors).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class ServiceConfig:
+    """Service-tier (control plane) options."""
+
+    # Server endpoints (reference: global_gflags.cpp ports).
+    host: str = "0.0.0.0"
+    http_port: int = 9888
+    rpc_port: int = 9889
+
+    # Concurrency (reference defaults 32 threads / 128 concurrency).
+    num_threads: int = 32
+    max_concurrency: int = 128
+    num_ordered_output_streams: int = 128  # reference: scheduler.h:112
+
+    # Coordination backend. "memory://" selects the in-process store;
+    # "etcd://host:port" an external etcd (reference: --etcd_addr).
+    etcd_addr: str = "memory://"
+
+    # Routing policy: RR | CAR | SLO_AWARE (reference: --load_balance_policy).
+    load_balance_policy: str = "RR"
+
+    # KV block contract (reference: --block_size default 128,
+    # --murmur_hash3_seed default 1024).
+    block_size: int = 128
+    murmur_hash3_seed: int = 1024
+
+    # SLO targets, ms (reference: global_gflags.cpp:102-112).
+    target_ttft_ms: float = 1000.0
+    target_tpot_ms: float = 50.0
+
+    # Liveness (reference: 3 s heartbeat / lease TTL; the 15 s
+    # detect_disconnected_instance_interval flag is dead code there — here it
+    # is real and prunes instances whose heartbeat stopped).
+    heartbeat_interval_s: float = 3.0
+    master_lease_ttl_s: float = 3.0
+    detect_disconnected_instance_interval_s: float = 15.0
+
+    # Tokenizer / template (reference: --tokenizer_path).
+    tokenizer_path: str = ""
+
+    # Tracing (reference: --enable_request_trace).
+    enable_request_trace: bool = False
+    trace_dir: str = "trace"
+
+    # Decode→service direct response path (reference:
+    # ENABLE_DECODE_RESPONSE_TO_SERVICE env, rpc_service/service.h:61-71).
+    enable_decode_response_to_service: bool = True
+
+    @classmethod
+    def from_args(cls, argv: Optional[List[str]] = None) -> "ServiceConfig":
+        parser = argparse.ArgumentParser("xllm-service-tpu master")
+        for f in dataclasses.fields(cls):
+            flag = "--" + f.name.replace("_", "-")
+            if f.type == "bool" or isinstance(f.default, bool):
+                parser.add_argument(
+                    flag, type=lambda s: s.lower() in ("1", "true", "yes"),
+                    default=f.default,
+                )
+            else:
+                parser.add_argument(flag, type=type(f.default), default=f.default)
+        ns = parser.parse_args(argv)
+        return cls(**vars(ns))
+
+
+@dataclass
+class EngineConfig:
+    """Engine-tier (TPU runtime) options for one instance."""
+
+    model: str = "llama3-tiny"  # key into models/configs.py registry
+    checkpoint_path: str = ""  # empty = random-init (tests/bench)
+    dtype: str = "bfloat16"
+
+    # Paged KV cache.
+    block_size: int = 128  # tokens per KV block — must match service tier
+    num_blocks: int = 0  # 0 = size from hbm_utilization
+    hbm_utilization: float = 0.9
+
+    # Continuous batching.
+    max_running_requests: int = 64
+    max_prefill_tokens: int = 8192  # per-step prefill token budget
+    max_seq_len: int = 8192
+    prefill_buckets: List[int] = field(
+        default_factory=lambda: [128, 256, 512, 1024, 2048, 4096, 8192]
+    )
+
+    # Parallelism over the instance's mesh.
+    dp_size: int = 1
+    tp_size: int = 1
+
+    # Sampling defaults.
+    max_new_tokens_default: int = 512
+
+    # Host offload (DRAM tier) blocks; 0 disables.
+    num_host_blocks: int = 0
+
+    # Instance identity/role.
+    instance_name: str = ""
+    instance_type: str = "MIX"  # DEFAULT | PREFILL | DECODE | MIX | ENCODE

@@ -1,0 +1,211 @@
+"""Chained murmur3 KV-block hashing — THE cross-tier invariant.
+
+The engine's cache events, the service's global cache index, and cache-aware
+routing must all derive identical 16-byte keys for the same token prefix
+(reference: xllm_service/common/hash_util.{h,cpp}; chaining walk in
+global_kvcache_mgr.cpp:85-95). Contract:
+
+    hash_0 = murmur3_x64_128(int32_le(tokens[0:B]), seed)
+    hash_i = murmur3_x64_128(hash_{i-1} || int32_le(tokens[i*B:(i+1)*B]), seed)
+
+with B = block_size (default 128) and seed default 1024
+(reference: common/global_gflags.cpp:50-55, 94-96). Only *complete* blocks
+are hashed.
+
+Backed by the C++ cdylib in native/ (built on demand); a pure-Python
+implementation serves as fallback and as an independent cross-check in tests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+MURMUR3_VALUE_LEN = 16
+DEFAULT_SEED = 1024  # reference: global_gflags.cpp:55
+DEFAULT_BLOCK_SIZE = 128  # reference: global_gflags.cpp:94-96
+
+_MASK64 = (1 << 64) - 1
+_C1 = 0x87C37B91114253D5
+_C2 = 0x4CF5AD432745937F
+
+
+def _rotl64(x: int, r: int) -> int:
+    return ((x << r) | (x >> (64 - r))) & _MASK64
+
+
+def _fmix64(k: int) -> int:
+    k ^= k >> 33
+    k = (k * 0xFF51AFD7ED558CCD) & _MASK64
+    k ^= k >> 33
+    k = (k * 0xC4CEB9FE1A85EC53) & _MASK64
+    k ^= k >> 33
+    return k
+
+
+def murmur3_x64_128_py(data: bytes, seed: int = DEFAULT_SEED) -> bytes:
+    """Pure-Python MurmurHash3 x64_128 (little-endian output h1||h2)."""
+    length = len(data)
+    nblocks = length // 16
+    h1 = seed & _MASK64
+    h2 = seed & _MASK64
+
+    for i in range(nblocks):
+        k1, k2 = struct.unpack_from("<QQ", data, i * 16)
+        k1 = (k1 * _C1) & _MASK64
+        k1 = _rotl64(k1, 31)
+        k1 = (k1 * _C2) & _MASK64
+        h1 ^= k1
+        h1 = _rotl64(h1, 27)
+        h1 = (h1 + h2) & _MASK64
+        h1 = (h1 * 5 + 0x52DCE729) & _MASK64
+        k2 = (k2 * _C2) & _MASK64
+        k2 = _rotl64(k2, 33)
+        k2 = (k2 * _C1) & _MASK64
+        h2 ^= k2
+        h2 = _rotl64(h2, 31)
+        h2 = (h2 + h1) & _MASK64
+        h2 = (h2 * 5 + 0x38495AB5) & _MASK64
+
+    tail = data[nblocks * 16 :]
+    k1 = 0
+    k2 = 0
+    tl = len(tail)
+    if tl > 8:
+        for i in range(tl - 1, 7, -1):
+            k2 = (k2 << 8) | tail[i]
+        k2 = (k2 * _C2) & _MASK64
+        k2 = _rotl64(k2, 33)
+        k2 = (k2 * _C1) & _MASK64
+        h2 ^= k2
+    if tl > 0:
+        for i in range(min(tl, 8) - 1, -1, -1):
+            k1 = (k1 << 8) | tail[i]
+        k1 = (k1 * _C1) & _MASK64
+        k1 = _rotl64(k1, 31)
+        k1 = (k1 * _C2) & _MASK64
+        h1 ^= k1
+
+    h1 ^= length
+    h2 ^= length
+    h1 = (h1 + h2) & _MASK64
+    h2 = (h2 + h1) & _MASK64
+    h1 = _fmix64(h1)
+    h2 = _fmix64(h2)
+    h1 = (h1 + h2) & _MASK64
+    h2 = (h2 + h1) & _MASK64
+    return struct.pack("<QQ", h1, h2)
+
+
+# ---------------------------------------------------------------------------
+# Native library loading (lazy, build-on-demand, thread-safe)
+# ---------------------------------------------------------------------------
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libxllm_native.so"))
+_SRC_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "murmur3.cpp"))
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_lock = threading.Lock()
+_lib_failed = False
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_LIB_PATH) or os.path.getmtime(
+                _SRC_PATH
+            ) > os.path.getmtime(_LIB_PATH):
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB_PATH, _SRC_PATH],
+                    check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.xllm_murmur3_x64_128.argtypes = [
+                ctypes.c_void_p,
+                ctypes.c_int,
+                ctypes.c_uint32,
+                ctypes.c_void_p,
+            ]
+            lib.xllm_block_hash.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int,
+                ctypes.c_uint32,
+                ctypes.c_char_p,
+            ]
+            lib.xllm_prefix_block_hashes.argtypes = [
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int,
+                ctypes.c_int,
+                ctypes.c_uint32,
+                ctypes.c_char_p,
+            ]
+            lib.xllm_prefix_block_hashes.restype = ctypes.c_int
+            _lib = lib
+        except Exception:
+            _lib_failed = True
+    return _lib
+
+
+def murmur3_x64_128(data: bytes, seed: int = DEFAULT_SEED) -> bytes:
+    lib = _load_native()
+    if lib is None:
+        return murmur3_x64_128_py(data, seed)
+    out = ctypes.create_string_buffer(MURMUR3_VALUE_LEN)
+    lib.xllm_murmur3_x64_128(data, len(data), seed, out)
+    return out.raw
+
+
+def block_hash(
+    prev_hash: Optional[bytes],
+    token_ids: Sequence[int],
+    seed: int = DEFAULT_SEED,
+) -> bytes:
+    """One chained step (reference: hash_util.cpp:18-44)."""
+    payload = struct.pack(f"<{len(token_ids)}i", *token_ids)
+    if prev_hash is not None:
+        if len(prev_hash) != MURMUR3_VALUE_LEN:
+            raise ValueError("prev_hash must be 16 bytes")
+        payload = prev_hash + payload
+    return murmur3_x64_128(payload, seed)
+
+
+def prefix_block_hashes(
+    token_ids: Sequence[int],
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    seed: int = DEFAULT_SEED,
+) -> List[bytes]:
+    """Chained hashes of every complete block of the prefix
+    (reference walk: global_kvcache_mgr.cpp:85-95)."""
+    n = len(token_ids)
+    num_blocks = n // block_size
+    if num_blocks == 0:
+        return []
+    lib = _load_native()
+    if lib is not None:
+        arr = (ctypes.c_int32 * n)(*token_ids)
+        out = ctypes.create_string_buffer(num_blocks * MURMUR3_VALUE_LEN)
+        lib.xllm_prefix_block_hashes(arr, n, block_size, seed, out)
+        raw = out.raw
+        return [
+            raw[i * MURMUR3_VALUE_LEN : (i + 1) * MURMUR3_VALUE_LEN]
+            for i in range(num_blocks)
+        ]
+    hashes: List[bytes] = []
+    prev: Optional[bytes] = None
+    for b in range(num_blocks):
+        h = block_hash(prev, token_ids[b * block_size : (b + 1) * block_size], seed)
+        hashes.append(h)
+        prev = h
+    return hashes

@@ -1,0 +1,20 @@
+"""Short request-id generation (reference: common/xllm/uuid.{h,cpp} — 22-char
+base62 UUID via absl::BitGen). Same shape: 22 chars from [0-9A-Za-z]."""
+
+from __future__ import annotations
+
+import secrets
+import string
+import threading
+
+_ALPHABET = string.digits + string.ascii_uppercase + string.ascii_lowercase
+_LEN = 22
+
+
+def generate_uuid(length: int = _LEN) -> str:
+    return "".join(secrets.choice(_ALPHABET) for _ in range(length))
+
+
+def generate_service_request_id(method: str) -> str:
+    """'{method}-{thread_id}-{uuid22}' (reference: http_service/service.cpp:41-48)."""
+    return f"{method}-{threading.get_ident() & 0xFFFF}-{generate_uuid()}"

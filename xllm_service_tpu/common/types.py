@@ -1,0 +1,420 @@
+"""Control-plane data structures with JSON serde.
+
+TPU-native re-design of the reference's core types
+(reference: xllm_service/common/types.h:39-411). JSON field names are kept
+wire-compatible with the reference's `serialize_to_json()` output so that a
+coordination store written by either implementation parses in the other.
+Divergences (deliberate, per SURVEY.md §7 "quirks"):
+  * float scoring everywhere (the reference's integer-division cost terms
+    truncate to 0 — cache_aware_routing.cpp:73-78);
+  * `CacheLocations` tier attribution is correct for DRAM/SSD (the reference
+    reads `hbm_instance_set.begin()` in those branches —
+    global_kvcache_mgr.cpp:108-125);
+  * an ENCODE instance type exists for the EPD multimodal three-stage path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+
+class ErrorCode(enum.IntEnum):
+    # reference: common/types.h:53-58
+    OK = 0
+    INTERNAL_ERROR = 1
+    INSTANCE_EXISTED = 2
+    INSTANCE_NOT_EXISTED = 3
+
+
+class InstanceType(enum.IntEnum):
+    """Engine-instance role (reference: common/types.h:71-79).
+
+    ENCODE (=4) is new: the multimodal encoder stage of EPD three-stage
+    disaggregation (the reference carries only vestiges of this —
+    chat_template MMContent, jinja_chat_template.h:30-47).
+    """
+
+    DEFAULT = 0
+    PREFILL = 1
+    DECODE = 2
+    MIX = 3
+    ENCODE = 4
+
+    @classmethod
+    def parse(cls, v: "InstanceType | int | str") -> "InstanceType":
+        if isinstance(v, InstanceType):
+            return v
+        if isinstance(v, int):
+            return cls(v)
+        return cls[v.upper()]
+
+
+@dataclass
+class Routing:
+    """PD(+E) instance assignment for one request (reference: types.h:39-51)."""
+
+    prefill_name: str = ""
+    decode_name: str = ""
+    # EPD extension: encoder-stage instance (empty = no encode stage).
+    encode_name: str = ""
+
+    def to_json(self) -> Dict[str, Any]:
+        j: Dict[str, Any] = {
+            "prefill_name": self.prefill_name,
+            "decode_name": self.decode_name,
+        }
+        if self.encode_name:
+            j["encode_name"] = self.encode_name
+        return j
+
+    @classmethod
+    def from_json(cls, j: Dict[str, Any]) -> "Routing":
+        return cls(
+            prefill_name=j.get("prefill_name", ""),
+            decode_name=j.get("decode_name", ""),
+            encode_name=j.get("encode_name", ""),
+        )
+
+    def debug_string(self) -> str:
+        return json.dumps(self.to_json(), indent=2)
+
+
+@dataclass
+class LoadMetrics:
+    """Instance load snapshot (reference: types.h:81-115).
+
+    `gpu_cache_usage_perc` keeps the reference wire name; on TPU it reports
+    HBM KV-cache pool usage in [0, 1].
+    """
+
+    waiting_requests_num: int = 0
+    gpu_cache_usage_perc: float = 0.0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "waiting_requests_num": self.waiting_requests_num,
+            "gpu_cache_usage_perc": self.gpu_cache_usage_perc,
+        }
+
+    @classmethod
+    def from_json(cls, j: Dict[str, Any]) -> "LoadMetrics":
+        return cls(
+            waiting_requests_num=int(j["waiting_requests_num"]),
+            gpu_cache_usage_perc=float(j["gpu_cache_usage_perc"]),
+        )
+
+
+@dataclass
+class LatencyMetrics:
+    """Recent-window latency maxima, milliseconds (reference: types.h:117-127)."""
+
+    recent_max_ttft: int = 0
+    recent_max_tbt: int = 0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "recent_max_ttft": self.recent_max_ttft,
+            "recent_max_tbt": self.recent_max_tbt,
+        }
+
+    @classmethod
+    def from_json(cls, j: Dict[str, Any]) -> "LatencyMetrics":
+        return cls(
+            recent_max_ttft=int(j["recent_max_ttft"]),
+            recent_max_tbt=int(j["recent_max_tbt"]),
+        )
+
+
+class RequestAction(enum.IntEnum):
+    # reference: types.h:129-135
+    SCHEDULE = 0
+    FINISH_PREFILL = 1
+    GENERATE = 2
+    FINISH_DECODE = 3
+    CANCEL = 4
+
+
+@dataclass
+class RequestMetrics:
+    """Per-instance request bookkeeping driven by the 5-action state machine
+    (reference: types.h:137-155; transitions in instance_mgr.cpp:582-654)."""
+
+    prefill_request_num: int = 0
+    prefill_token_num: int = 0
+    decode_request_num: int = 0
+    decode_token_num: int = 0
+    # Estimated execution time for all queued prefill work, milliseconds.
+    estimated_prefill_time: float = 0.0
+
+
+@dataclass
+class InstanceMetaInfo:
+    """Instance registration record (reference: types.h:157-270).
+
+    TPU mapping of the KV-transfer handles: `cluster_ids` become global slice
+    ids, `addrs` the per-host transfer-server addresses, and
+    `k_cache_ids`/`v_cache_ids` opaque per-layer buffer handles the peer uses
+    to pull KV blocks over ICI/DCN (the reference relays the RDMA analogs of
+    these without interpreting them — types.h:174-177).
+    """
+
+    name: str = ""
+    rpc_address: str = ""
+    http_address: str = ""
+    type: InstanceType = InstanceType.DEFAULT
+    cluster_ids: List[int] = field(default_factory=list)
+    addrs: List[str] = field(default_factory=list)
+    k_cache_ids: List[int] = field(default_factory=list)
+    v_cache_ids: List[int] = field(default_factory=list)
+    dp_size: int = 1
+    tp_size: int = 1
+    # [(prompt_len, ttft_ms)]
+    ttft_profiling_data: List[Tuple[int, float]] = field(default_factory=list)
+    # [(batch_size, total_tokens, tpot_ms)]
+    tpot_profiling_data: List[Tuple[int, int, float]] = field(default_factory=list)
+    latest_timestamp: int = field(default_factory=lambda: int(time.time() * 1000))
+    instance_index: int = -1
+    # Current role of a MIX instance (SLO-aware PD flipping; types.h:192-194).
+    current_type: InstanceType = InstanceType.PREFILL
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "rpc_address": self.rpc_address,
+            "http_address": self.http_address,
+            "type": int(self.type),
+            "addrs": self.addrs,
+            "cluster_ids": self.cluster_ids,
+            "k_cache_ids": self.k_cache_ids,
+            "v_cache_ids": self.v_cache_ids,
+            "dp_size": self.dp_size,
+            "tp_size": self.tp_size,
+            "ttft_profiling_data": [list(p) for p in self.ttft_profiling_data],
+            "tpot_profiling_data": [list(p) for p in self.tpot_profiling_data],
+            "latest_timestamp": self.latest_timestamp,
+            "current_type": int(self.current_type),
+        }
+
+    @classmethod
+    def from_json(cls, j: Dict[str, Any]) -> "InstanceMetaInfo":
+        return cls(
+            name=j.get("name", ""),
+            rpc_address=j.get("rpc_address", ""),
+            http_address=j.get("http_address", ""),
+            type=InstanceType(int(j.get("type", 0))),
+            cluster_ids=[int(x) for x in j.get("cluster_ids", [])],
+            addrs=list(j.get("addrs", [])),
+            k_cache_ids=[int(x) for x in j.get("k_cache_ids", [])],
+            v_cache_ids=[int(x) for x in j.get("v_cache_ids", [])],
+            dp_size=int(j.get("dp_size", 1)),
+            tp_size=int(j.get("tp_size", 1)),
+            ttft_profiling_data=[
+                (int(p[0]), float(p[1])) for p in j.get("ttft_profiling_data", [])
+            ],
+            tpot_profiling_data=[
+                (int(p[0]), int(p[1]), float(p[2]))
+                for p in j.get("tpot_profiling_data", [])
+            ],
+            latest_timestamp=int(j.get("latest_timestamp", 0)),
+            current_type=InstanceType(int(j.get("current_type", 1))),
+        )
+
+    def serialize(self) -> str:
+        return json.dumps(self.to_json())
+
+    @classmethod
+    def deserialize(cls, s: str) -> "InstanceMetaInfo":
+        return cls.from_json(json.loads(s))
+
+
+@dataclass
+class CacheLocations:
+    """Which instances hold a KV block, per memory tier
+    (reference: types.h:272-317). On TPU the tiers are HBM (device),
+    DRAM (host offload), SSD (local NVMe)."""
+
+    hbm_instance_set: Set[str] = field(default_factory=set)
+    dram_instance_set: Set[str] = field(default_factory=set)
+    ssd_instance_set: Set[str] = field(default_factory=set)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "hbm_instance_set": sorted(self.hbm_instance_set),
+            "dram_instance_set": sorted(self.dram_instance_set),
+            "ssd_instance_set": sorted(self.ssd_instance_set),
+        }
+
+    @classmethod
+    def from_json(cls, j: Dict[str, Any]) -> "CacheLocations":
+        return cls(
+            hbm_instance_set=set(j.get("hbm_instance_set", [])),
+            dram_instance_set=set(j.get("dram_instance_set", [])),
+            ssd_instance_set=set(j.get("ssd_instance_set", [])),
+        )
+
+    def empty(self) -> bool:
+        return not (
+            self.hbm_instance_set or self.dram_instance_set or self.ssd_instance_set
+        )
+
+
+@dataclass
+class KvCacheEvent:
+    """Heartbeat-carried KV-cache delta from an engine instance
+    (reference: proto/xllm_rpc_service.proto:44-48). Hash values are the
+    16-byte chained murmur3 block keys (common/hashing.py)."""
+
+    stored_cache: Set[bytes] = field(default_factory=set)
+    removed_cache: Set[bytes] = field(default_factory=set)
+    # Blocks moved to a colder tier: hash -> tier name ("dram" | "ssd").
+    offload_cache: Dict[bytes, str] = field(default_factory=dict)
+
+    def empty(self) -> bool:
+        return not (self.stored_cache or self.removed_cache or self.offload_cache)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "stored_cache": [h.hex() for h in sorted(self.stored_cache)],
+            "removed_cache": [h.hex() for h in sorted(self.removed_cache)],
+            "offload_cache": {h.hex(): t for h, t in self.offload_cache.items()},
+        }
+
+    @classmethod
+    def from_json(cls, j: Dict[str, Any]) -> "KvCacheEvent":
+        return cls(
+            stored_cache={bytes.fromhex(h) for h in j.get("stored_cache", [])},
+            removed_cache={bytes.fromhex(h) for h in j.get("removed_cache", [])},
+            offload_cache={
+                bytes.fromhex(h): t for h, t in j.get("offload_cache", {}).items()
+            },
+        )
+
+
+@dataclass
+class OverlapScores:
+    """Prefix-cache match result per candidate instance
+    (reference: types.h:319-355): instance name -> matched block count,
+    per tier."""
+
+    hbm_scores: Dict[str, int] = field(default_factory=dict)
+    dram_scores: Dict[str, int] = field(default_factory=dict)
+    ssd_scores: Dict[str, int] = field(default_factory=dict)
+    total_blocks: int = 0
+
+    def best(self) -> Tuple[str, int]:
+        """Highest-scoring instance across tiers, HBM-weighted first."""
+        best_name, best_score = "", -1
+        for scores, weight in (
+            (self.hbm_scores, 1.0),
+            (self.dram_scores, 0.5),
+            (self.ssd_scores, 0.25),
+        ):
+            for name, cnt in scores.items():
+                s = cnt * weight
+                if s > best_score:
+                    best_name, best_score = name, s
+        return best_name, best_score
+
+
+@dataclass
+class LoadBalanceInfos:
+    """Inputs the cache-aware policy scores per candidate
+    (reference: types.h:357-389)."""
+
+    overlap_scores: OverlapScores = field(default_factory=OverlapScores)
+    load_metrics: Dict[str, LoadMetrics] = field(default_factory=dict)
+    max_waiting_requests_num: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Engine result types (reference: common/xllm/output.h, status.h)
+# ---------------------------------------------------------------------------
+
+
+class StatusCode(enum.IntEnum):
+    # reference: common/xllm/status.h:26-45
+    OK = 0
+    CANCELLED = 1
+    UNKNOWN = 2
+    INVALID_ARGUMENT = 3
+    DEADLINE_EXCEEDED = 4
+    RESOURCE_EXHAUSTED = 8
+    UNAVAILABLE = 14
+
+
+@dataclass
+class Status:
+    code: StatusCode = StatusCode.OK
+    message: str = ""
+
+    def ok(self) -> bool:
+        return self.code == StatusCode.OK
+
+
+class FinishReason(enum.Enum):
+    # reference: common/xllm/output.h:31-37
+    NONE = None
+    STOP = "stop"
+    LENGTH = "length"
+    FUNCTION_CALL = "function_call"
+
+    def to_string(self) -> Optional[str]:
+        return self.value
+
+
+@dataclass
+class Usage:
+    # reference: common/xllm/output.h:39-48
+    num_prompt_tokens: int = 0
+    num_generated_tokens: int = 0
+
+    @property
+    def num_total_tokens(self) -> int:
+        return self.num_prompt_tokens + self.num_generated_tokens
+
+
+@dataclass
+class LogProbData:
+    # reference: common/xllm/output.h:50-56
+    token: str = ""
+    token_id: int = 0
+    logprob: float = 0.0
+
+
+@dataclass
+class LogProb:
+    # reference: common/xllm/output.h:58-63
+    data: LogProbData = field(default_factory=LogProbData)
+    top_logprobs: List[LogProbData] = field(default_factory=list)
+
+
+@dataclass
+class SequenceOutput:
+    # reference: common/xllm/output.h:66-81
+    index: int = 0
+    text: str = ""
+    token_ids: List[int] = field(default_factory=list)
+    finish_reason: FinishReason = FinishReason.NONE
+    logprobs: List[LogProb] = field(default_factory=list)
+
+
+@dataclass
+class RequestOutput:
+    # reference: common/xllm/output.h:83-108
+    request_id: str = ""
+    service_request_id: str = ""
+    status: Status = field(default_factory=Status)
+    outputs: List[SequenceOutput] = field(default_factory=list)
+    usage: Optional[Usage] = None
+    finished: bool = False
+    cancelled: bool = False
+
+
+# Callback invoked per generation step; returns False to cancel the stream
+# (reference: common/xllm/output.h:131).
+OutputCallback = Callable[[RequestOutput], bool]

@@ -1,0 +1,138 @@
+// MurmurHash3 x64_128 + chained KV-block hashing, exposed with a C ABI for
+// ctypes. Implemented fresh from Austin Appleby's public-domain algorithm
+// description; behaviorally equivalent to the reference's smhasher dependency
+// (reference: xllm_service/common/hash_util.cpp:18-44 for the chaining
+// contract: hash_i = murmur3_x64_128(prev_hash_16B || int32_le_tokens, seed)).
+//
+// Build: g++ -O2 -shared -fPIC -o libxllm_native.so murmur3.cpp
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline uint64_t rotl64(uint64_t x, int8_t r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+inline uint64_t fmix64(uint64_t k) {
+  k ^= k >> 33;
+  k *= 0xff51afd7ed558ccdULL;
+  k ^= k >> 33;
+  k *= 0xc4ceb9fe1a85ec53ULL;
+  k ^= k >> 33;
+  return k;
+}
+
+inline uint64_t load64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, sizeof(v));  // little-endian hosts only (x86/arm)
+  return v;
+}
+
+void murmur3_x64_128(const void* key, int len, uint32_t seed, void* out) {
+  const uint8_t* data = static_cast<const uint8_t*>(key);
+  const int nblocks = len / 16;
+
+  uint64_t h1 = seed;
+  uint64_t h2 = seed;
+
+  const uint64_t c1 = 0x87c37b91114253d5ULL;
+  const uint64_t c2 = 0x4cf5ad432745937fULL;
+
+  for (int i = 0; i < nblocks; i++) {
+    uint64_t k1 = load64(data + i * 16);
+    uint64_t k2 = load64(data + i * 16 + 8);
+
+    k1 *= c1; k1 = rotl64(k1, 31); k1 *= c2; h1 ^= k1;
+    h1 = rotl64(h1, 27); h1 += h2; h1 = h1 * 5 + 0x52dce729;
+    k2 *= c2; k2 = rotl64(k2, 33); k2 *= c1; h2 ^= k2;
+    h2 = rotl64(h2, 31); h2 += h1; h2 = h2 * 5 + 0x38495ab5;
+  }
+
+  const uint8_t* tail = data + nblocks * 16;
+  uint64_t k1 = 0;
+  uint64_t k2 = 0;
+
+  switch (len & 15) {
+    case 15: k2 ^= static_cast<uint64_t>(tail[14]) << 48; [[fallthrough]];
+    case 14: k2 ^= static_cast<uint64_t>(tail[13]) << 40; [[fallthrough]];
+    case 13: k2 ^= static_cast<uint64_t>(tail[12]) << 32; [[fallthrough]];
+    case 12: k2 ^= static_cast<uint64_t>(tail[11]) << 24; [[fallthrough]];
+    case 11: k2 ^= static_cast<uint64_t>(tail[10]) << 16; [[fallthrough]];
+    case 10: k2 ^= static_cast<uint64_t>(tail[9]) << 8; [[fallthrough]];
+    case 9:
+      k2 ^= static_cast<uint64_t>(tail[8]);
+      k2 *= c2; k2 = rotl64(k2, 33); k2 *= c1; h2 ^= k2;
+      [[fallthrough]];
+    case 8: k1 ^= static_cast<uint64_t>(tail[7]) << 56; [[fallthrough]];
+    case 7: k1 ^= static_cast<uint64_t>(tail[6]) << 48; [[fallthrough]];
+    case 6: k1 ^= static_cast<uint64_t>(tail[5]) << 40; [[fallthrough]];
+    case 5: k1 ^= static_cast<uint64_t>(tail[4]) << 32; [[fallthrough]];
+    case 4: k1 ^= static_cast<uint64_t>(tail[3]) << 24; [[fallthrough]];
+    case 3: k1 ^= static_cast<uint64_t>(tail[2]) << 16; [[fallthrough]];
+    case 2: k1 ^= static_cast<uint64_t>(tail[1]) << 8; [[fallthrough]];
+    case 1:
+      k1 ^= static_cast<uint64_t>(tail[0]);
+      k1 *= c1; k1 = rotl64(k1, 31); k1 *= c2; h1 ^= k1;
+  }
+
+  h1 ^= static_cast<uint64_t>(len);
+  h2 ^= static_cast<uint64_t>(len);
+  h1 += h2;
+  h2 += h1;
+  h1 = fmix64(h1);
+  h2 = fmix64(h2);
+  h1 += h2;
+  h2 += h1;
+
+  std::memcpy(static_cast<uint8_t*>(out), &h1, 8);
+  std::memcpy(static_cast<uint8_t*>(out) + 8, &h2, 8);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Hash one buffer.
+void xllm_murmur3_x64_128(const void* key, int len, uint32_t seed, void* out) {
+  murmur3_x64_128(key, len, seed, out);
+}
+
+// Chained block hash: out = murmur3(prev_hash(16B, may be null) ||
+// int32_le(token_ids), seed). Mirrors hash_util.cpp:18-44.
+void xllm_block_hash(const uint8_t* prev_hash,
+                     const int32_t* token_ids,
+                     int num_tokens,
+                     uint32_t seed,
+                     uint8_t* out) {
+  if (prev_hash == nullptr) {
+    murmur3_x64_128(token_ids, num_tokens * 4, seed, out);
+    return;
+  }
+  // 16-byte prev hash + up to 8K tokens per block comfortably on stack.
+  uint8_t buf[16 + 8192 * 4];
+  int ntok = num_tokens > 8192 ? 8192 : num_tokens;
+  std::memcpy(buf, prev_hash, 16);
+  std::memcpy(buf + 16, token_ids, ntok * 4);
+  murmur3_x64_128(buf, 16 + ntok * 4, seed, out);
+}
+
+// Full prefix walk: hash every complete block of `block_size` tokens,
+// chaining. Writes num_blocks*16 bytes into out; returns num_blocks.
+int xllm_prefix_block_hashes(const int32_t* token_ids,
+                             int num_tokens,
+                             int block_size,
+                             uint32_t seed,
+                             uint8_t* out) {
+  int num_blocks = num_tokens / block_size;
+  const uint8_t* prev = nullptr;
+  for (int b = 0; b < num_blocks; ++b) {
+    xllm_block_hash(prev, token_ids + b * block_size, block_size, seed,
+                    out + b * 16);
+    prev = out + b * 16;
+  }
+  return num_blocks;
+}
+
+}  // extern "C"
