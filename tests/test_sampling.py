@@ -1,0 +1,70 @@
+"""Sampling op tests: filtering semantics + determinism."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from xllm_service_tpu.ops import sampling
+
+
+def _sample(logits, temp, top_k, top_p, seeds, step=0):
+    R = logits.shape[0]
+    keys = sampling.make_step_keys(jnp.asarray(seeds, jnp.uint32), jnp.int32(step))
+    return sampling.sample_tokens(
+        jnp.asarray(logits, jnp.float32),
+        jnp.asarray(temp, jnp.float32),
+        jnp.asarray(top_k, jnp.int32),
+        jnp.asarray(top_p, jnp.float32),
+        keys,
+    )
+
+
+def test_greedy_picks_argmax():
+    rng = np.random.RandomState(0)
+    logits = rng.randn(4, 64).astype(np.float32)
+    ids, lp, full = _sample(logits, [0.0] * 4, [0] * 4, [1.0] * 4, [1, 2, 3, 4])
+    np.testing.assert_array_equal(np.asarray(ids), logits.argmax(-1))
+    # Chosen logprob == log_softmax at chosen index.
+    np.testing.assert_allclose(
+        np.asarray(lp),
+        np.take_along_axis(np.asarray(full), logits.argmax(-1)[:, None], 1)[:, 0],
+        rtol=1e-6,
+    )
+
+
+def test_top_k_1_equals_greedy_even_with_temperature():
+    rng = np.random.RandomState(1)
+    logits = rng.randn(3, 100).astype(np.float32)
+    ids, _, _ = _sample(logits, [5.0] * 3, [1] * 3, [1.0] * 3, [7, 8, 9])
+    np.testing.assert_array_equal(np.asarray(ids), logits.argmax(-1))
+
+
+def test_tiny_top_p_equals_greedy():
+    rng = np.random.RandomState(2)
+    logits = rng.randn(3, 100).astype(np.float32)
+    ids, _, _ = _sample(logits, [1.0] * 3, [0] * 3, [1e-6] * 3, [7, 8, 9])
+    np.testing.assert_array_equal(np.asarray(ids), logits.argmax(-1))
+
+
+def test_sampling_stays_in_top_k():
+    rng = np.random.RandomState(3)
+    logits = rng.randn(8, 50).astype(np.float32)
+    topk = 5
+    allowed = np.argsort(logits, -1)[:, ::-1][:, :topk]
+    for step in range(10):
+        ids, _, _ = _sample(
+            logits, [2.0] * 8, [topk] * 8, [1.0] * 8, list(range(8)), step=step
+        )
+        for r in range(8):
+            assert int(ids[r]) in allowed[r]
+
+
+def test_same_seed_same_step_deterministic():
+    rng = np.random.RandomState(4)
+    logits = rng.randn(2, 40).astype(np.float32)
+    a = _sample(logits, [1.0, 1.0], [0, 0], [0.9, 0.9], [42, 42], step=3)[0]
+    b = _sample(logits, [1.0, 1.0], [0, 0], [0.9, 0.9], [42, 42], step=3)[0]
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    c = _sample(logits, [1.0, 1.0], [0, 0], [0.9, 0.9], [42, 42], step=4)[0]
+    # Different step folds a different key (overwhelmingly likely to differ
+    # somewhere over repeated draws; don't assert inequality per-row).
+    assert a.shape == c.shape

@@ -125,11 +125,15 @@ def _load_native() -> Optional[ctypes.CDLL]:
             if not os.path.exists(_LIB_PATH) or os.path.getmtime(
                 _SRC_PATH
             ) > os.path.getmtime(_LIB_PATH):
+                # Build to a per-pid temp then atomically rename so concurrent
+                # processes never dlopen a half-written library.
+                tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
                 subprocess.run(
-                    ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB_PATH, _SRC_PATH],
+                    ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC_PATH],
                     check=True,
                     capture_output=True,
                 )
+                os.replace(tmp, _LIB_PATH)
             lib = ctypes.CDLL(_LIB_PATH)
             lib.xllm_murmur3_x64_128.argtypes = [
                 ctypes.c_void_p,

@@ -1,0 +1,266 @@
+"""Llama-family transformer, TPU-first.
+
+Engine-tier model (reference delegates to the absent xLLM submodule;
+SURVEY.md §2.3). Design choices:
+
+  * Parameters are a plain pytree with per-layer tensors STACKED on a leading
+    layer axis and the block applied with `lax.scan` — one compiled layer
+    body regardless of depth (fast compiles, XLA-friendly).
+  * Decode processes a fixed batch of R sequences against the paged KV cache
+    (ops/attention.py); prefill processes one bucketed-length chunk for one
+    sequence. Both scatter new K/V into the cache first, then attend over
+    gathered context, which makes fresh prefill, chunked prefill, and
+    prefix-cache-hit prefill the same code path.
+  * GQA throughout; SwiGLU MLP; optional MoE block (Mixtral-style top-k
+    router). MoE here computes all experts and combines by router weight —
+    exact and fine at test scale; the expert-parallel ragged-dispatch path
+    lives in parallel/ (later rounds route through it).
+  * Everything is shape-static: R, bucketed prefill lengths, max_blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from xllm_service_tpu.models.configs import ModelConfig
+from xllm_service_tpu.ops.attention import (
+    paged_attention,
+    prefill_attention_gather,
+)
+from xllm_service_tpu.ops.norms import rms_norm
+from xllm_service_tpu.ops.rope import apply_rope
+
+Params = Dict[str, Any]
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random-init parameters (tests/bench; checkpoint loading replaces these
+    values with the same pytree structure — runtime/weights.py)."""
+    E, L = cfg.hidden_size, cfg.num_layers
+    Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    F = cfg.intermediate_size
+    keys = jax.random.split(key, 16)
+
+    def norm_init(shape):
+        return jnp.ones(shape, dtype=jnp.float32)
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(
+            dtype
+        )
+
+    layers: Dict[str, jnp.ndarray] = {
+        "attn_norm": norm_init((L, E)),
+        "wq": w(keys[0], (L, E, Hq * D), E),
+        "wk": w(keys[1], (L, E, Hkv * D), E),
+        "wv": w(keys[2], (L, E, Hkv * D), E),
+        "wo": w(keys[3], (L, Hq * D, E), Hq * D),
+        "mlp_norm": norm_init((L, E)),
+    }
+    if cfg.is_moe:
+        X, Fm = cfg.num_experts, cfg.moe_intermediate_size
+        layers.update(
+            {
+                "router": w(keys[4], (L, E, X), E),
+                "w_gate": w(keys[5], (L, X, E, Fm), E),
+                "w_up": w(keys[6], (L, X, E, Fm), E),
+                "w_down": w(keys[7], (L, X, Fm, E), Fm),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w_gate": w(keys[5], (L, E, F), E),
+                "w_up": w(keys[6], (L, E, F), E),
+                "w_down": w(keys[7], (L, F, E), F),
+            }
+        )
+
+    params: Params = {
+        "embed": w(keys[8], (cfg.vocab_size, E), E),
+        "layers": layers,
+        "final_norm": norm_init((E,)),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(keys[9], (E, cfg.vocab_size), E)
+    return params
+
+
+def _unembed(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    h = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if cfg.tie_word_embeddings:
+        return jnp.einsum("...e,ve->...v", h.astype(jnp.float32),
+                          params["embed"].astype(jnp.float32))
+    return jnp.einsum("...e,ev->...v", h.astype(jnp.float32),
+                      params["lm_head"].astype(jnp.float32))
+
+
+def _mlp(lp: Dict[str, jnp.ndarray], cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    """SwiGLU (dense) or top-k MoE block. x: [T, E]."""
+    if not cfg.is_moe:
+        gate = jnp.einsum("te,ef->tf", x, lp["w_gate"])
+        up = jnp.einsum("te,ef->tf", x, lp["w_up"])
+        return jnp.einsum("tf,fe->te", jax.nn.silu(gate) * up, lp["w_down"])
+    # MoE: router scores -> top-k weights; compute all experts, combine.
+    scores = jnp.einsum("te,ex->tx", x.astype(jnp.float32), lp["router"].astype(jnp.float32))
+    topw, topi = jax.lax.top_k(scores, cfg.num_experts_per_tok)
+    weights = jax.nn.softmax(topw, axis=-1)  # [T, k]
+    gate = jnp.einsum("te,xef->txf", x, lp["w_gate"])
+    up = jnp.einsum("te,xef->txf", x, lp["w_up"])
+    expert_out = jnp.einsum("txf,xfe->txe", jax.nn.silu(gate) * up, lp["w_down"])
+    # [T, k, E] pick + combine
+    picked = jnp.take_along_axis(expert_out, topi[:, :, None], axis=1)
+    return jnp.sum(picked * weights[:, :, None].astype(picked.dtype), axis=1)
+
+
+def _qkv(lp, cfg: ModelConfig, x: jnp.ndarray, positions: jnp.ndarray):
+    """x: [T, E] -> q [T, Hq, D], k/v [T, Hkv, D] with RoPE applied."""
+    T = x.shape[0]
+    q = jnp.einsum("te,eh->th", x, lp["wq"]).reshape(T, cfg.num_heads, cfg.head_dim)
+    k = jnp.einsum("te,eh->th", x, lp["wk"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    v = jnp.einsum("te,eh->th", x, lp["wv"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _scatter_kv(k_cache, v_cache, slots, k, v):
+    """Write per-token K/V rows into flat cache slots.
+
+    k_cache: [num_blocks, bs, Hkv, D]; slots: [T] flat row indices
+    (block_id*bs + offset); inactive/invalid tokens carry slot pointing into
+    the reserved garbage block 0."""
+    NB, BS, H, D = k_cache.shape
+    kf = k_cache.reshape(NB * BS, H, D).at[slots].set(k).reshape(NB, BS, H, D)
+    vf = v_cache.reshape(NB * BS, H, D).at[slots].set(v).reshape(NB, BS, H, D)
+    return kf, vf
+
+
+def decode_step(
+    params: Params,
+    cfg: ModelConfig,
+    k_caches: jnp.ndarray,  # [L, num_blocks, bs, Hkv, D]
+    v_caches: jnp.ndarray,
+    token_ids: jnp.ndarray,  # [R] int32
+    positions: jnp.ndarray,  # [R] int32 (0-based position of this token)
+    block_tables: jnp.ndarray,  # [R, max_blocks] int32
+    active: jnp.ndarray,  # [R] bool
+    use_kernel: bool | None = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One generation step for R sequences. Returns (logits [R, V],
+    k_caches', v_caches')."""
+    bs = k_caches.shape[2]
+    scale = cfg.head_dim**-0.5
+    x = params["embed"][token_ids].astype(params["layers"]["wq"].dtype)  # [R, E]
+
+    block_idx = positions // bs
+    offset = positions % bs
+    blk = jnp.take_along_axis(block_tables, block_idx[:, None], axis=1)[:, 0]
+    slots = jnp.where(active, blk * bs + offset, 0)
+    seq_lens = jnp.where(active, positions + 1, 0)
+
+    def layer_fn(x, scanned):
+        lp, k_l, v_l = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(lp, cfg, h, positions)
+        k_l, v_l = _scatter_kv(k_l, v_l, slots, k, v)
+        attn = paged_attention(
+            q, k_l, v_l, block_tables, seq_lens, scale, use_kernel=use_kernel
+        )
+        x = x + jnp.einsum("rh,he->re", attn.reshape(attn.shape[0], -1),
+                           lp["wo"].reshape(-1, cfg.hidden_size))
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(lp, cfg, h)
+        return x, (k_l, v_l)
+
+    x, (k_caches, v_caches) = jax.lax.scan(
+        layer_fn, x, (params["layers"], k_caches, v_caches)
+    )
+    logits = _unembed(params, cfg, x)  # [R, V]
+    return logits, k_caches, v_caches
+
+
+def prefill_step(
+    params: Params,
+    cfg: ModelConfig,
+    k_caches: jnp.ndarray,
+    v_caches: jnp.ndarray,
+    token_ids: jnp.ndarray,  # [Lpad] int32 — one sequence's chunk, padded
+    start_pos: jnp.ndarray,  # scalar int32: cached tokens before this chunk
+    true_len: jnp.ndarray,  # scalar int32: valid tokens in chunk
+    block_table: jnp.ndarray,  # [max_blocks] int32
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Process one prefill chunk. Returns (last-token logits [V], k', v')."""
+    bs = k_caches.shape[2]
+    scale = cfg.head_dim**-0.5
+    Lpad = token_ids.shape[0]
+    x = params["embed"][token_ids].astype(params["layers"]["wq"].dtype)  # [Lpad, E]
+
+    offsets = jnp.arange(Lpad, dtype=jnp.int32)
+    positions = start_pos + offsets
+    valid = offsets < true_len
+    block_idx = positions // bs
+    blk = block_table[block_idx]
+    slots = jnp.where(valid, blk * bs + positions % bs, 0)
+
+    def layer_fn(x, scanned):
+        lp, k_l, v_l = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(lp, cfg, h, positions)
+        k_l, v_l = _scatter_kv(k_l, v_l, slots, k, v)
+        attn = prefill_attention_gather(
+            q, k_l, v_l, block_table, start_pos, true_len, scale
+        )
+        x = x + jnp.einsum("lh,he->le", attn.reshape(Lpad, -1),
+                           lp["wo"].reshape(-1, cfg.hidden_size))
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(lp, cfg, h)
+        return x, (k_l, v_l)
+
+    x, (k_caches, v_caches) = jax.lax.scan(
+        layer_fn, x, (params["layers"], k_caches, v_caches)
+    )
+    last = x[jnp.maximum(true_len - 1, 0)]
+    logits = _unembed(params, cfg, last)
+    return logits, k_caches, v_caches
+
+
+def forward_dense(
+    params: Params,
+    cfg: ModelConfig,
+    token_ids: jnp.ndarray,  # [B, L] int32
+) -> jnp.ndarray:
+    """Plain causal forward without KV cache — the correctness oracle for
+    prefill/decode and the body of the training step (__graft_entry__)."""
+    B, L = token_ids.shape
+    scale = cfg.head_dim**-0.5
+    x = params["embed"][token_ids].astype(params["layers"]["wq"].dtype)
+    positions = jnp.arange(L, dtype=jnp.int32)
+    causal = jnp.tril(jnp.ones((L, L), dtype=bool))
+
+    def layer_fn(x, lp):
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+
+        def one_seq(hx):
+            q, k, v = _qkv(lp, cfg, hx, positions)
+            Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+            g = Hq // Hkv
+            qf = q.astype(jnp.float32).reshape(L, Hkv, g, D)
+            scores = jnp.einsum("qhgd,khd->hgqk", qf, k.astype(jnp.float32)) * scale
+            scores = jnp.where(causal[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum("hgqk,khd->qhgd", probs, v.astype(jnp.float32))
+            return out.reshape(L, Hq * D).astype(hx.dtype)
+
+        attn = jax.vmap(one_seq)(h)  # [B, L, Hq*D]
+        x = x + jnp.einsum("blh,he->ble", attn, lp["wo"].reshape(-1, cfg.hidden_size))
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        mlp_out = jax.vmap(lambda t: _mlp(lp, cfg, t))(h)
+        x = x + mlp_out
+        return x, None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    return _unembed(params, cfg, x)  # [B, L, V]

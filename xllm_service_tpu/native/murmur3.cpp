@@ -110,12 +110,14 @@ void xllm_block_hash(const uint8_t* prev_hash,
     murmur3_x64_128(token_ids, num_tokens * 4, seed, out);
     return;
   }
-  // 16-byte prev hash + up to 8K tokens per block comfortably on stack.
-  uint8_t buf[16 + 8192 * 4];
-  int ntok = num_tokens > 8192 ? 8192 : num_tokens;
+  // 16-byte prev hash + tokens; stack for typical block sizes, heap beyond.
+  uint8_t stack_buf[16 + 8192 * 4];
+  const size_t need = 16 + static_cast<size_t>(num_tokens) * 4;
+  uint8_t* buf = need <= sizeof(stack_buf) ? stack_buf : new uint8_t[need];
   std::memcpy(buf, prev_hash, 16);
-  std::memcpy(buf + 16, token_ids, ntok * 4);
-  murmur3_x64_128(buf, 16 + ntok * 4, seed, out);
+  std::memcpy(buf + 16, token_ids, static_cast<size_t>(num_tokens) * 4);
+  murmur3_x64_128(buf, static_cast<int>(need), seed, out);
+  if (buf != stack_buf) delete[] buf;
 }
 
 // Full prefix walk: hash every complete block of `block_size` tokens,

@@ -1,0 +1,131 @@
+"""Paged attention over a block-structured KV cache.
+
+Engine-tier hot op (the reference's paged-attention CUDA kernel lives in the
+absent submodule; the service-visible contract is only the 128-token block
+size + chained hashing — SURVEY.md §2.3). Two implementations:
+
+  * `paged_attention_gather` — pure-jnp reference: gathers each sequence's
+    blocks via its block table and runs masked SDPA. Exact; used on CPU
+    (tests) and as the correctness oracle for the Pallas kernel.
+  * `ops/pallas/paged_attention.py` — TPU Pallas kernel that streams KV
+    blocks HBM→VMEM per (sequence, kv-head) program with the block table in
+    scalar memory. Selected on TPU via `ops.attention.paged_attention`.
+
+Cache layout (one layer): k_cache, v_cache `[num_blocks, block_size,
+num_kv_heads, head_dim]`, KV-head axis shardable over the `tp` mesh axis.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def gather_context(
+    k_cache: jnp.ndarray,  # [num_blocks, block_size, Hkv, D]
+    v_cache: jnp.ndarray,
+    block_table: jnp.ndarray,  # [R, max_blocks] int32
+):
+    """Gather each sequence's context as [R, max_blocks*block_size, Hkv, D]."""
+    k_ctx = k_cache[block_table]  # [R, max_blocks, bs, Hkv, D]
+    v_ctx = v_cache[block_table]
+    R, MB, BS, H, D = k_ctx.shape
+    return k_ctx.reshape(R, MB * BS, H, D), v_ctx.reshape(R, MB * BS, H, D)
+
+
+def _sdpa(
+    q: jnp.ndarray,  # [R, Lq, Hq, D]
+    k: jnp.ndarray,  # [R, Lk, Hkv, D]
+    v: jnp.ndarray,  # [R, Lk, Hkv, D]
+    mask: jnp.ndarray,  # [R, Lq, Lk] bool (True = attend)
+    scale: float,
+) -> jnp.ndarray:
+    R, Lq, Hq, D = q.shape
+    Hkv = k.shape[2]
+    groups = Hq // Hkv
+    qf = q.astype(jnp.float32).reshape(R, Lq, Hkv, groups, D)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # [R, Hkv, groups, Lq, Lk]
+    scores = jnp.einsum("rqhgd,rkhd->rhgqk", qf, kf) * scale
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("rhgqk,rkhd->rqhgd", probs, vf)
+    return out.reshape(R, Lq, Hq, D).astype(q.dtype)
+
+
+def paged_attention_gather(
+    q: jnp.ndarray,  # [R, Hq, D] — one query token per sequence (decode)
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    block_table: jnp.ndarray,  # [R, max_blocks]
+    seq_lens: jnp.ndarray,  # [R] context length INCLUDING current token
+    scale: float,
+) -> jnp.ndarray:
+    """Decode-step attention: each query attends to its first seq_lens cache
+    rows. Returns [R, Hq, D]."""
+    k_ctx, v_ctx = gather_context(k_cache, v_cache, block_table)
+    Lk = k_ctx.shape[1]
+    cols = jnp.arange(Lk, dtype=jnp.int32)[None, :]  # [1, Lk]
+    mask = cols < seq_lens[:, None]  # [R, Lk]
+    out = _sdpa(q[:, None], k_ctx, v_ctx, mask[:, None, :], scale)
+    return out[:, 0]
+
+
+def prefill_attention_gather(
+    q: jnp.ndarray,  # [L, Hq, D] — chunk of new tokens for ONE sequence
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    block_table: jnp.ndarray,  # [max_blocks]
+    start_pos: jnp.ndarray,  # scalar int32: tokens already in cache (prefix hit)
+    true_len: jnp.ndarray,  # scalar int32: valid tokens in this chunk
+    scale: float,
+) -> jnp.ndarray:
+    """Chunked-prefill attention for one sequence: rows are chunk positions
+    start_pos..start_pos+L, columns the sequence's cache rows (which already
+    contain this chunk's K/V — caller scatters before attending). Causal.
+    Returns [L, Hq, D]."""
+    k_ctx, v_ctx = gather_context(
+        k_cache[:, :, :, :], v_cache[:, :, :, :], block_table[None]
+    )
+    L = q.shape[0]
+    Lk = k_ctx.shape[1]
+    rows = start_pos + jnp.arange(L, dtype=jnp.int32)  # absolute positions
+    cols = jnp.arange(Lk, dtype=jnp.int32)
+    causal = cols[None, :] <= rows[:, None]
+    valid_row = jnp.arange(L, dtype=jnp.int32) < true_len
+    mask = causal & valid_row[:, None]
+    out = _sdpa(q[None], k_ctx, v_ctx, mask[None], scale)
+    return out[0]
+
+
+@functools.lru_cache(maxsize=1)
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def paged_attention(
+    q, k_cache, v_cache, block_table, seq_lens, scale, use_kernel: bool | None = None
+):
+    """Decode paged attention; Pallas kernel on TPU, gather fallback elsewhere."""
+    if use_kernel is None:
+        use_kernel = _on_tpu()
+    if use_kernel:
+        try:
+            from xllm_service_tpu.ops.pallas.paged_attention import (
+                paged_attention_kernel,
+            )
+        except ImportError:
+            use_kernel = False
+        else:
+            return paged_attention_kernel(
+                q, k_cache, v_cache, block_table, seq_lens, scale
+            )
+    return paged_attention_gather(q, k_cache, v_cache, block_table, seq_lens, scale)

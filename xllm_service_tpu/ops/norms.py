@@ -1,0 +1,13 @@
+"""RMSNorm (engine-tier op; SURVEY.md §2.3). Computed in float32 for
+stability, cast back to input dtype; XLA fuses this into adjacent ops."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf / jnp.sqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)

@@ -1,0 +1,40 @@
+"""Rotary position embeddings.
+
+Engine-tier op (the reference's RoPE lives in the absent CUDA engine —
+SURVEY.md §2.3). Pure jnp: XLA fuses the sin/cos + elementwise rotation into
+surrounding matmuls on TPU, so no Pallas kernel is warranted here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float) -> jnp.ndarray:
+    """Inverse frequencies [head_dim/2], float32."""
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    return 1.0 / (theta**exponent)
+
+
+def apply_rope(
+    x: jnp.ndarray,  # [..., num_heads, head_dim]
+    positions: jnp.ndarray,  # [...] int32, broadcastable to x's batch dims
+    theta: float,
+) -> jnp.ndarray:
+    """Rotate pairs (x[2i], x[2i+1]) by positions * inv_freq[i].
+
+    Uses the interleaved-pair convention expressed as split-half rotation on
+    a de-interleaved view — matches HF Llama when weights are loaded with the
+    standard permutation; for random-init + self-consistent decode any
+    consistent convention is exact.
+    """
+    half = x.shape[-1] // 2
+    inv_freq = rope_frequencies(x.shape[-1], theta)  # [half]
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., half]
+    cos = jnp.cos(angles)[..., None, :]  # [..., 1, half]
+    sin = jnp.sin(angles)[..., None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)

@@ -1,0 +1,103 @@
+"""Batched token sampling with per-request parameters.
+
+Engine-tier op (reference delegates sampling to the absent CUDA engine;
+logprob wire shape constrained by proto/xllm_rpc_service.proto:85-113).
+
+All functions are jit-safe over a fixed batch R: every request carries its
+own (temperature, top_k, top_p, greedy-flag, seed) so one compiled step
+serves any mixture — no recompilation on batch composition changes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+@dataclass
+class SamplingParams:
+    """Host-side per-request sampling spec (OpenAI-compatible surface)."""
+
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    seed: int = 0
+    logprobs: bool = False
+    top_logprobs: int = 0
+    max_new_tokens: int = 512
+    stop_token_ids: tuple = ()
+    ignore_eos: bool = False
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+
+def apply_top_k_top_p(
+    logits: jnp.ndarray, top_k: jnp.ndarray, top_p: jnp.ndarray
+) -> jnp.ndarray:
+    """Combined per-row top-k + nucleus filtering with ONE descending argsort
+    (the sort over V dominates sampling cost at vocab ~128K). top_k<=0 and
+    top_p>=1 disable their respective filters; the argmax is always kept."""
+    R, vocab = logits.shape
+    order = jnp.argsort(logits, axis=-1)[:, ::-1]  # descending
+    sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+    ranks = jnp.arange(vocab, dtype=jnp.int32)[None, :]
+
+    k = jnp.where(top_k <= 0, vocab, jnp.minimum(top_k, vocab))
+    keep_k = ranks < k[:, None]
+
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Token i is kept if the cumulative mass *before* it is < top_p.
+    keep_p = (cum - probs) < top_p[:, None]
+
+    keep_sorted = (keep_k & keep_p).at[:, 0].set(True)
+    keep = jnp.zeros_like(keep_sorted).at[jnp.arange(R)[:, None], order].set(
+        keep_sorted
+    )
+    return jnp.where(keep, logits, NEG_INF)
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [R, V] float32
+    temperature: jnp.ndarray,  # [R] float32; <=0 means greedy
+    top_k: jnp.ndarray,  # [R] int32; 0 disables
+    top_p: jnp.ndarray,  # [R] float32; 1.0 disables
+    step_keys: jnp.ndarray,  # [R, 2] uint32 PRNG keys (pre-folded per step)
+):
+    """Returns (token_ids [R], logprob_of_chosen [R], logprobs [R, V])."""
+    logits = logits.astype(jnp.float32)
+    logprobs_full = jax.nn.log_softmax(logits, axis=-1)
+
+    greedy_ids = jnp.argmax(logits, axis=-1)
+
+    safe_temp = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_temp[:, None]
+    scaled = apply_top_k_top_p(scaled, top_k, top_p)
+
+    def sample_one(key, row):
+        return jax.random.categorical(jax.random.wrap_key_data(key), row)
+
+    sampled_ids = jax.vmap(sample_one)(step_keys, scaled)
+
+    token_ids = jnp.where(temperature > 0, sampled_ids, greedy_ids).astype(jnp.int32)
+    chosen_logprob = jnp.take_along_axis(
+        logprobs_full, token_ids[:, None], axis=-1
+    )[:, 0]
+    return token_ids, chosen_logprob, logprobs_full
+
+
+def make_step_keys(base_seeds: jnp.ndarray, step: jnp.ndarray) -> jnp.ndarray:
+    """Per-request keys folded with the decode step index. [R] -> [R, 2]."""
+
+    def one(seed):
+        k = jax.random.key(seed)
+        k = jax.random.fold_in(k, step)
+        return jax.random.key_data(k)
+
+    return jax.vmap(one)(base_seeds)
