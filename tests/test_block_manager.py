@@ -1,0 +1,84 @@
+"""Block manager: allocation, ref counting, prefix cache, eviction, events."""
+
+import pytest
+
+from xllm_service_tpu.common.hashing import prefix_block_hashes
+from xllm_service_tpu.runtime.block_manager import BlockManager, OutOfBlocksError
+
+BS = 16
+
+
+def test_alloc_free_cycle():
+    m = BlockManager(num_blocks=8, block_size=BS)
+    assert m.num_free_blocks == 7
+    blocks = m.allocate(3)
+    assert len(set(blocks)) == 3 and 0 not in blocks
+    assert m.num_free_blocks == 4
+    m.free(blocks)
+    assert m.num_free_blocks == 7
+
+
+def test_out_of_blocks():
+    m = BlockManager(num_blocks=4, block_size=BS)
+    m.allocate(3)
+    with pytest.raises(OutOfBlocksError):
+        m.allocate(1)
+    assert not m.can_allocate(1)
+
+
+def test_prefix_match_and_reuse():
+    m = BlockManager(num_blocks=10, block_size=BS)
+    tokens = list(range(BS * 3))
+    hashes = prefix_block_hashes(tokens, BS)
+    blocks = m.allocate(3)
+    for b, h in zip(blocks, hashes):
+        m.commit_block(b, h)
+    # Same prefix matches all 3 blocks.
+    n, matched = m.match_prefix(tokens)
+    assert n == BS * 3 and matched == blocks
+    m.free(matched)
+    # Divergent second block matches only the first.
+    tokens2 = tokens[:BS] + [999] + tokens[BS + 1 :]
+    n2, matched2 = m.match_prefix(tokens2)
+    assert n2 == BS and matched2 == blocks[:1]
+    m.free(matched2)
+    m.free(blocks)
+
+
+def test_eviction_lru_and_events():
+    m = BlockManager(num_blocks=4, block_size=BS)  # 3 usable
+    tokens = list(range(BS * 3))
+    hashes = prefix_block_hashes(tokens, BS)
+    blocks = m.allocate(3)
+    for b, h in zip(blocks, hashes):
+        m.commit_block(b, h)
+    ev = m.take_cache_event()
+    assert ev.stored_cache == set(hashes)
+    m.free(blocks)  # now evictable but still cached
+    assert m.num_free_blocks == 3
+    n, matched = m.match_prefix(tokens)
+    assert n == BS * 3
+    m.free(matched)
+    # Allocating 2 evicts the 2 least-recently-used cached blocks.
+    newb = m.allocate(2)
+    assert len(newb) == 2
+    ev2 = m.take_cache_event()
+    assert len(ev2.removed_cache) == 2
+    assert ev2.removed_cache < set(hashes)
+    # The evicted hashes no longer match.
+    n3, matched3 = m.match_prefix(tokens)
+    assert n3 < BS * 3
+    m.free(matched3)
+
+
+def test_referenced_blocks_not_evicted():
+    m = BlockManager(num_blocks=4, block_size=BS)
+    tokens = list(range(BS))
+    (h,) = prefix_block_hashes(tokens, BS)
+    (b,) = m.allocate(1)
+    m.commit_block(b, h)
+    # Still referenced: not evictable, so only 2 blocks free.
+    assert m.num_free_blocks == 2
+    m.allocate(2)
+    with pytest.raises(OutOfBlocksError):
+        m.allocate(1)

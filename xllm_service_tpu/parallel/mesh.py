@@ -1,0 +1,41 @@
+"""Device-mesh construction for an engine instance.
+
+TPU-native replacement for the reference engine's NCCL/MPI process groups
+(SURVEY.md §2.2): parallelism is expressed as a `jax.sharding.Mesh` with
+named axes and sharding annotations; XLA inserts the ICI/DCN collectives.
+
+Axes:
+  dp — data parallel (decode batch rows, independent replicas)
+  tp — tensor parallel (attention heads / FFN hidden)
+  (later rounds add: ep — expert parallel; sp — sequence/context parallel)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def build_mesh(
+    dp: int = 1,
+    tp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    need = dp * tp
+    if need > len(devices):
+        raise ValueError(f"mesh dp*tp={need} exceeds {len(devices)} devices")
+    arr = np.asarray(devices[:need]).reshape(dp, tp)
+    return Mesh(arr, ("dp", "tp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))

@@ -1,0 +1,78 @@
+"""Megatron-style tensor-parallel sharding specs for the Llama param pytree.
+
+The per-layer tensors carry a leading stacked-layer axis (models/llama.py),
+so specs shift right by one. Contract:
+
+  wq/wk/wv  [L, E, H*D]   → shard output heads over tp
+  wo        [L, H*D, E]   → shard contracting dim over tp (psum after)
+  w_gate/up [L, E, F]     → shard F; w_down [L, F, E] → shard F
+  MoE       experts axis X over tp for now (true `ep` axis in later rounds)
+  embed     [V, E]        → shard V (all-gather on embed lookup is tiny)
+  lm_head   [E, V]        → shard V
+  KV caches [L, B, bs, Hkv, D] → shard Hkv over tp
+
+XLA derives the matching collectives (psum for row-parallel contractions)
+from these annotations under jit — no hand-written comms.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from xllm_service_tpu.models.configs import ModelConfig
+
+
+def param_shardings(cfg: ModelConfig, mesh: Mesh) -> Dict[str, Any]:
+    def ns(*spec):
+        return NamedSharding(mesh, P(*spec))
+
+    layers: Dict[str, Any] = {
+        "attn_norm": ns(None, None),
+        "wq": ns(None, None, "tp"),
+        "wk": ns(None, None, "tp"),
+        "wv": ns(None, None, "tp"),
+        "wo": ns(None, "tp", None),
+        "mlp_norm": ns(None, None),
+    }
+    if cfg.is_moe:
+        layers.update(
+            {
+                "router": ns(None, None, None),
+                "w_gate": ns(None, "tp", None, None),
+                "w_up": ns(None, "tp", None, None),
+                "w_down": ns(None, "tp", None, None),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w_gate": ns(None, None, "tp"),
+                "w_up": ns(None, None, "tp"),
+                "w_down": ns(None, "tp", None),
+            }
+        )
+    out: Dict[str, Any] = {
+        "embed": ns("tp", None),
+        "layers": layers,
+        "final_norm": ns(None),
+    }
+    if not cfg.tie_word_embeddings:
+        out["lm_head"] = ns(None, "tp")
+    return out
+
+
+def kv_cache_sharding(mesh: Mesh) -> NamedSharding:
+    # [L, num_blocks, bs, Hkv, D]: KV heads over tp.
+    return NamedSharding(mesh, P(None, None, None, "tp", None))
+
+
+def check_tp_divisibility(cfg: ModelConfig, tp: int) -> None:
+    if cfg.num_kv_heads % tp or cfg.num_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide num_heads={cfg.num_heads} and "
+            f"num_kv_heads={cfg.num_kv_heads}"
+        )
+    if cfg.intermediate_size % tp:
+        raise ValueError(f"tp={tp} must divide intermediate={cfg.intermediate_size}")

@@ -1,0 +1,157 @@
+"""Paged KV-cache block manager with content-addressed prefix caching.
+
+Engine-tier counterpart of the service's global cache index: allocates
+fixed-size token blocks, commits full blocks under their chained murmur3
+hash (common/hashing.py — the cross-tier invariant), serves intra-instance
+prefix-cache hits, evicts LRU, and accumulates the stored/removed deltas
+that the heartbeat reports as a KvCacheEvent
+(reference contract: proto/xllm_rpc_service.proto:44-48;
+global_kvcache_mgr.cpp:177-225 consumes these on the service side).
+
+Block 0 is reserved as the garbage slot for masked scatter writes
+(models/llama.py) and is never allocated.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from xllm_service_tpu.common.hashing import prefix_block_hashes
+from xllm_service_tpu.common.types import KvCacheEvent
+
+
+class OutOfBlocksError(RuntimeError):
+    pass
+
+
+@dataclass
+class _BlockInfo:
+    ref_count: int = 0
+    hash: Optional[bytes] = None  # set once the block is full + committed
+
+
+class BlockManager:
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        seed: int = 1024,
+    ):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is reserved)")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.seed = seed
+        self._blocks: Dict[int, _BlockInfo] = {
+            i: _BlockInfo() for i in range(1, num_blocks)
+        }
+        self._free: List[int] = list(range(1, num_blocks))
+        # hash -> block_id for committed blocks (both live and evictable).
+        self._hash_to_block: Dict[bytes, int] = {}
+        # Evictable committed blocks in LRU order: block_id -> None.
+        self._evictable: OrderedDict[int, None] = OrderedDict()
+        # Heartbeat deltas.
+        self._stored: Set[bytes] = set()
+        self._removed: Set[bytes] = set()
+
+    # ------------------------------------------------------------------ util
+
+    @property
+    def num_free_blocks(self) -> int:
+        return len(self._free) + len(self._evictable)
+
+    @property
+    def usage(self) -> float:
+        total = self.num_blocks - 1
+        return (total - self.num_free_blocks) / max(total, 1)
+
+    def can_allocate(self, n: int) -> bool:
+        return self.num_free_blocks >= n
+
+    # ------------------------------------------------------------- allocate
+
+    def _pop_free_block(self) -> int:
+        if self._free:
+            return self._free.pop()
+        if self._evictable:
+            victim, _ = self._evictable.popitem(last=False)  # LRU
+            info = self._blocks[victim]
+            if info.hash is not None:
+                del self._hash_to_block[info.hash]
+                self._removed.add(info.hash)
+                self._stored.discard(info.hash)
+                info.hash = None
+            return victim
+        raise OutOfBlocksError("KV cache exhausted")
+
+    def allocate(self, n: int) -> List[int]:
+        if not self.can_allocate(n):
+            raise OutOfBlocksError(
+                f"need {n} blocks, only {self.num_free_blocks} free"
+            )
+        out = []
+        for _ in range(n):
+            b = self._pop_free_block()
+            self._blocks[b].ref_count = 1
+            out.append(b)
+        return out
+
+    def acquire_cached(self, block_id: int) -> None:
+        """Take a reference on a committed block found via match_prefix."""
+        info = self._blocks[block_id]
+        if info.ref_count == 0:
+            self._evictable.pop(block_id, None)
+        info.ref_count += 1
+
+    def free(self, block_ids: Sequence[int]) -> None:
+        for b in block_ids:
+            info = self._blocks[b]
+            info.ref_count -= 1
+            assert info.ref_count >= 0, f"double free of block {b}"
+            if info.ref_count == 0:
+                if info.hash is not None:
+                    self._evictable[b] = None  # keep cached, evictable
+                else:
+                    self._free.append(b)
+
+    # --------------------------------------------------------- prefix cache
+
+    def commit_block(self, block_id: int, block_hash: bytes) -> None:
+        """Register a now-full block under its chained hash. If the hash is
+        already cached by another block, the new block stays uncommitted
+        (duplicate content; dedup happens on the next match)."""
+        if block_hash in self._hash_to_block:
+            return
+        info = self._blocks[block_id]
+        if info.hash is not None:
+            return
+        info.hash = block_hash
+        self._hash_to_block[block_hash] = block_id
+        self._stored.add(block_hash)
+        self._removed.discard(block_hash)
+
+    def match_prefix(self, token_ids: Sequence[int]) -> Tuple[int, List[int]]:
+        """Longest cached prefix: returns (num_cached_tokens, block_ids) and
+        takes a reference on each matched block (same walk as the service's
+        GlobalKVCacheMgr.match — global_kvcache_mgr.cpp:73-131)."""
+        hashes = prefix_block_hashes(token_ids, self.block_size, self.seed)
+        matched: List[int] = []
+        for h in hashes:
+            b = self._hash_to_block.get(h)
+            if b is None:
+                break
+            matched.append(b)
+        for b in matched:
+            self.acquire_cached(b)
+        return len(matched) * self.block_size, matched
+
+    # ------------------------------------------------------------ heartbeat
+
+    def take_cache_event(self) -> KvCacheEvent:
+        """Drain accumulated deltas for the next heartbeat."""
+        ev = KvCacheEvent(stored_cache=self._stored, removed_cache=self._removed)
+        self._stored = set()
+        self._removed = set()
+        return ev

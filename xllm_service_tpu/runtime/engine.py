@@ -1,0 +1,515 @@
+"""Continuous-batching inference engine.
+
+Engine-tier core (the reference's analog lives in the absent xLLM submodule;
+this implements the runtime its service layer assumes — SURVEY.md §2.3):
+admission with prefix-cache reuse, one fixed-shape decode step per iteration
+over R slots, incremental block allocation with recompute-preemption, block
+commits under chained hashes, and heartbeat-ready load/latency metrics +
+KV cache events (proto contract: xllm_rpc_service.proto:44-58).
+
+Pure host-side orchestration: all device work goes through ModelExecutor's
+two jitted step functions, so nothing here ever triggers a recompile.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from xllm_service_tpu.common.config import EngineConfig
+from xllm_service_tpu.common.hashing import prefix_block_hashes
+from xllm_service_tpu.common.types import (
+    FinishReason,
+    KvCacheEvent,
+    LatencyMetrics,
+    LoadMetrics,
+    LogProb,
+    LogProbData,
+    RequestOutput,
+    SequenceOutput,
+    Status,
+    StatusCode,
+    Usage,
+)
+from xllm_service_tpu.ops.sampling import SamplingParams
+from xllm_service_tpu.runtime.block_manager import BlockManager, OutOfBlocksError
+from xllm_service_tpu.runtime.executor import ModelExecutor, SamplingBatch
+
+
+@dataclass
+class EngineRequest:
+    request_id: str
+    prompt_token_ids: List[int]
+    sampling: SamplingParams
+    # Called from the engine thread once per generated token (and once on
+    # finish); return False to cancel (reference OutputCallback contract,
+    # common/xllm/output.h:131).
+    callback: Callable[[RequestOutput], bool]
+    arrival_time: float = field(default_factory=time.monotonic)
+
+
+class _Seq:
+    __slots__ = (
+        "req", "slot", "tokens", "block_ids", "num_cached", "generated",
+        "last_committed_block", "prefill_done_time", "last_token_time",
+    )
+
+    def __init__(self, req: EngineRequest, slot: int):
+        self.req = req
+        self.slot = slot
+        self.tokens: List[int] = list(req.prompt_token_ids)
+        self.block_ids: List[int] = []
+        self.num_cached = 0
+        self.generated: List[Tuple[int, float]] = []  # (token, logprob)
+        self.last_committed_block = -1  # index into block_ids
+        self.prefill_done_time = 0.0
+        self.last_token_time = 0.0
+
+
+# The waiting queue holds fresh EngineRequests and preempted _Seqs (which
+# resume with their full token history + generation accounting intact).
+_QueueItem = "EngineRequest | _Seq"
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        engine_cfg: EngineConfig,
+        executor: Optional[ModelExecutor] = None,
+        eos_token_ids: Tuple[int, ...] = (),
+    ):
+        self.cfg = engine_cfg
+        self.executor = executor or ModelExecutor(engine_cfg)
+        self.eos_token_ids = set(eos_token_ids)
+        self.block_size = self.executor.block_size
+        self.R = self.executor.R
+        self.max_blocks = self.executor.max_blocks_per_seq
+        self.block_mgr = BlockManager(
+            self.executor.num_blocks, self.block_size,
+            seed=engine_cfg.murmur_hash3_seed,
+        )
+
+        self._waiting: Deque[EngineRequest] = collections.deque()
+        self._running: Dict[int, _Seq] = {}  # slot -> seq
+        self._free_slots = list(range(self.R - 1, -1, -1))
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self._cancelled: set = set()
+
+        # Static decode-batch arrays (filled per step).
+        self._block_tables = np.zeros((self.R, self.max_blocks), np.int32)
+        # Latency windows (ms) for LatencyMetrics.
+        self._ttft_window: Deque[Tuple[float, float]] = collections.deque()
+        self._tbt_window: Deque[Tuple[float, float]] = collections.deque()
+        self._profile_ttft: List[Tuple[int, float]] = []
+        self._profile_tpot: List[Tuple[int, int, float]] = []
+
+    # -------------------------------------------------------------- public
+
+    def add_request(self, req: EngineRequest) -> None:
+        with self._lock:
+            self._waiting.append(req)
+        self._work.set()
+
+    def cancel(self, request_id: str) -> None:
+        with self._lock:
+            self._cancelled.add(request_id)
+        self._work.set()
+
+    def has_work(self) -> bool:
+        return bool(self._waiting or self._running)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop = True
+        self._work.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    # ------------------------------------------------------------- metrics
+
+    def get_load_metrics(self) -> LoadMetrics:
+        return LoadMetrics(
+            waiting_requests_num=len(self._waiting),
+            gpu_cache_usage_perc=self.block_mgr.usage,
+        )
+
+    def get_latency_metrics(self, window_s: float = 30.0) -> LatencyMetrics:
+        now = time.monotonic()
+        for dq in (self._ttft_window, self._tbt_window):
+            while dq and now - dq[0][0] > window_s:
+                dq.popleft()
+        return LatencyMetrics(
+            recent_max_ttft=int(max((v for _, v in self._ttft_window), default=0)),
+            recent_max_tbt=int(max((v for _, v in self._tbt_window), default=0)),
+        )
+
+    def take_cache_event(self) -> KvCacheEvent:
+        return self.block_mgr.take_cache_event()
+
+    def profiling_data(self):
+        return list(self._profile_ttft), list(self._profile_tpot)
+
+    # ---------------------------------------------------------------- loop
+
+    def _loop(self) -> None:
+        while not self._stop:
+            if not self.has_work():
+                self._work.wait(timeout=0.05)
+                self._work.clear()
+                continue
+            try:
+                produced = self.step()
+                if produced == 0:
+                    # Waiting work that cannot run yet (e.g. blocked on KV
+                    # capacity) — back off instead of hot-spinning.
+                    time.sleep(0.005)
+            except Exception:  # pragma: no cover — keep the loop alive
+                import traceback
+
+                traceback.print_exc()
+                time.sleep(0.1)
+
+    # ---------------------------------------------------------------- step
+
+    def step(self) -> int:
+        """One engine iteration: admit + prefill new requests, then one
+        decode step. Returns number of tokens produced."""
+        self._drain_cancelled()
+        admitted = self._admit()
+        return admitted + self._decode_once()
+
+    # ------------------------------------------------------------ admission
+
+    @staticmethod
+    def _item_req(item) -> EngineRequest:
+        return item.req if isinstance(item, _Seq) else item
+
+    def _drain_cancelled(self) -> None:
+        dropped = []
+        with self._lock:
+            cancelled = self._cancelled
+            self._cancelled = set()
+            if not cancelled:
+                return
+            kept: Deque = collections.deque()
+            for item in self._waiting:
+                if self._item_req(item).request_id in cancelled:
+                    dropped.append(item)
+                else:
+                    kept.append(item)
+            self._waiting = kept
+        for item in dropped:
+            self._notify_cancelled(self._item_req(item))
+        for slot, seq in list(self._running.items()):
+            if seq.req.request_id in cancelled:
+                self._finish(seq, FinishReason.NONE, cancelled=True)
+
+    def _admit(self) -> int:
+        budget = self.cfg.max_prefill_tokens
+        pool_capacity = self.block_mgr.num_blocks - 1
+        rejects: List[Tuple[EngineRequest, StatusCode, str]] = []
+        admitted = 0
+        while budget > 0:
+            with self._lock:
+                if not self._waiting or not self._free_slots:
+                    break
+                item = self._waiting[0]
+                tokens = item.tokens if isinstance(item, _Seq) else item.prompt_token_ids
+                n_tok = len(tokens)
+                if n_tok >= self.cfg.max_seq_len:
+                    self._waiting.popleft()
+                    rejects.append(
+                        (self._item_req(item), StatusCode.INVALID_ARGUMENT,
+                         "prompt exceeds max_seq_len")
+                    )
+                    continue
+                # Need blocks for all current tokens + the next one.
+                need_total = math.ceil((n_tok + 1) / self.block_size)
+                if need_total > pool_capacity:
+                    # Can NEVER fit — reject instead of stalling the queue
+                    # head forever.
+                    self._waiting.popleft()
+                    rejects.append(
+                        (self._item_req(item), StatusCode.RESOURCE_EXHAUSTED,
+                         "request needs more KV blocks than the pool holds")
+                    )
+                    continue
+                if not self.block_mgr.can_allocate(need_total):
+                    break
+                self._waiting.popleft()
+
+            if isinstance(item, _Seq):  # resuming a preempted sequence
+                seq = item
+                seq.slot = self._free_slots.pop()
+            else:
+                seq = _Seq(item, self._free_slots.pop())
+            # Prefix-cache match — never the entire context (at least one
+            # token must run to produce logits).
+            num_cached, cached_blocks = self.block_mgr.match_prefix(
+                seq.tokens[: n_tok - 1]
+            )
+            seq.num_cached = num_cached
+            seq.block_ids = list(cached_blocks)
+            seq.last_committed_block = len(cached_blocks) - 1
+            new_blocks = need_total - len(cached_blocks)
+            try:
+                seq.block_ids += self.block_mgr.allocate(new_blocks)
+            except OutOfBlocksError:
+                self.block_mgr.free(seq.block_ids)
+                seq.block_ids = []
+                self._free_slots.append(seq.slot)
+                with self._lock:
+                    self._waiting.appendleft(item)
+                break
+
+            table = np.zeros((self.max_blocks,), np.int32)
+            table[: len(seq.block_ids)] = seq.block_ids
+            suffix = seq.tokens[num_cached:]
+            budget -= len(suffix)
+
+            t0 = time.monotonic()
+            s = seq.req.sampling
+            tok, lp = self.executor.prefill(
+                np.asarray(suffix, np.int32),
+                num_cached,
+                table,
+                temperature=s.temperature,
+                top_k=s.top_k,
+                top_p=s.top_p,
+                seed=s.seed,
+                step=len(seq.generated),
+            )
+            ttft_ms = (time.monotonic() - t0) * 1000
+            self._ttft_window.append((time.monotonic(), ttft_ms))
+            self._profile_ttft.append((len(suffix), ttft_ms))
+            seq.prefill_done_time = seq.last_token_time = time.monotonic()
+
+            self._commit_full_blocks(seq)
+            seq.generated.append((tok, lp))
+            seq.tokens.append(tok)
+            self._running[seq.slot] = seq
+            self._emit(seq, finished=self._check_stop(seq))
+            admitted += 1
+        for req, code, msg in rejects:
+            self._reject(req, code, msg)
+        return admitted
+
+    def _reject(self, req: EngineRequest, code: StatusCode, msg: str) -> None:
+        out = RequestOutput(
+            request_id=req.request_id,
+            status=Status(code, msg),
+            finished=True,
+        )
+        try:
+            req.callback(out)
+        except Exception:
+            pass
+
+    def _notify_cancelled(self, req: EngineRequest) -> None:
+        out = RequestOutput(
+            request_id=req.request_id,
+            finished=True,
+            cancelled=True,
+            status=Status(StatusCode.CANCELLED, "cancelled"),
+        )
+        try:
+            req.callback(out)
+        except Exception:
+            pass
+
+    # -------------------------------------------------------------- decode
+
+    def _decode_once(self) -> int:
+        if not self._running:
+            return 0
+        # Ensure block capacity for the token each seq is about to write.
+        for slot, seq in sorted(self._running.items()):
+            if slot not in self._running:  # preempted earlier this pass
+                continue
+            pos = len(seq.tokens) - 1  # position of the input token
+            need = pos // self.block_size + 1
+            while len(seq.block_ids) < need:
+                try:
+                    seq.block_ids += self.block_mgr.allocate(1)
+                except OutOfBlocksError:
+                    victim = self._pick_preemption_victim(exclude=slot)
+                    if victim is None:
+                        # Nothing to preempt: preempt this seq itself.
+                        self._preempt(seq)
+                        break
+                    self._preempt(victim)
+            else:
+                continue
+        if not self._running:
+            return 0
+
+        token_ids = np.zeros((self.R,), np.int32)
+        positions = np.zeros((self.R,), np.int32)
+        active = np.zeros((self.R,), bool)
+        temps = np.zeros((self.R,), np.float32)
+        top_ks = np.zeros((self.R,), np.int32)
+        top_ps = np.ones((self.R,), np.float32)
+        seeds = np.zeros((self.R,), np.uint32)
+        steps = np.zeros((self.R,), np.int32)
+        self._block_tables[:] = 0
+
+        for slot, seq in self._running.items():
+            token_ids[slot] = seq.tokens[-1]
+            positions[slot] = len(seq.tokens) - 1
+            active[slot] = True
+            n = len(seq.block_ids)
+            self._block_tables[slot, :n] = seq.block_ids
+            s = seq.req.sampling
+            temps[slot] = s.temperature
+            top_ks[slot] = s.top_k
+            top_ps[slot] = s.top_p
+            seeds[slot] = s.seed & 0xFFFFFFFF
+            steps[slot] = len(seq.generated)
+
+        t0 = time.monotonic()
+        tokens, logprobs = self.executor.decode(
+            token_ids,
+            positions,
+            self._block_tables,
+            active,
+            SamplingBatch(temps, top_ks, top_ps, seeds, steps),
+        )
+        step_ms = (time.monotonic() - t0) * 1000
+        nactive = int(active.sum())
+        total_ctx = int(positions[active].sum()) + nactive
+        self._profile_tpot.append((nactive, total_ctx, step_ms))
+
+        produced = 0
+        now = time.monotonic()
+        for slot in list(self._running.keys()):
+            seq = self._running[slot]
+            tok, lp = int(tokens[slot]), float(logprobs[slot])
+            self._tbt_window.append((now, (now - seq.last_token_time) * 1000))
+            seq.last_token_time = now
+            seq.generated.append((tok, lp))
+            seq.tokens.append(tok)
+            self._commit_full_blocks(seq)
+            produced += 1
+            self._emit(seq, finished=self._check_stop(seq))
+        return produced
+
+    # ---------------------------------------------------------- preemption
+
+    def _pick_preemption_victim(self, exclude: int) -> Optional[_Seq]:
+        candidates = [s for sl, s in self._running.items() if sl != exclude]
+        if not candidates:
+            return None
+        # Youngest first (least work lost on recompute).
+        return max(candidates, key=lambda s: s.req.arrival_time)
+
+    def _preempt(self, seq: _Seq) -> None:
+        """Recompute-style preemption: release blocks and requeue the _Seq
+        itself, preserving token history and generation accounting (KV is
+        recomputed on re-admission; prefix-cache blocks soften the cost)."""
+        self.block_mgr.free(seq.block_ids)
+        seq.block_ids = []
+        seq.last_committed_block = -1
+        del self._running[seq.slot]
+        self._free_slots.append(seq.slot)
+        with self._lock:
+            self._waiting.appendleft(seq)
+
+    # ------------------------------------------------------------- commits
+
+    def _commit_full_blocks(self, seq: _Seq) -> None:
+        """Commit newly filled blocks under their chained hashes."""
+        full = len(seq.tokens) // self.block_size
+        committed = seq.last_committed_block + 1
+        if full <= committed:
+            return
+        hashes = prefix_block_hashes(
+            seq.tokens[: full * self.block_size], self.block_size,
+            seed=self.block_mgr.seed,
+        )
+        for i in range(committed, full):
+            self.block_mgr.commit_block(seq.block_ids[i], hashes[i])
+        seq.last_committed_block = full - 1
+
+    # ---------------------------------------------------------------- stop
+
+    def _check_stop(self, seq: _Seq) -> Optional[FinishReason]:
+        s = seq.req.sampling
+        tok = seq.tokens[-1]
+        if not s.ignore_eos and tok in self.eos_token_ids:
+            return FinishReason.STOP
+        if tok in s.stop_token_ids:
+            return FinishReason.STOP
+        if len(seq.generated) >= s.max_new_tokens:
+            return FinishReason.LENGTH
+        if len(seq.tokens) >= self.cfg.max_seq_len:
+            return FinishReason.LENGTH
+        return None
+
+    # ---------------------------------------------------------------- emit
+
+    def _emit(self, seq: _Seq, finished: Optional[FinishReason]) -> bool:
+        tok, lp = seq.generated[-1]
+        s = seq.req.sampling
+        seq_out = SequenceOutput(
+            index=0,
+            token_ids=[tok],
+            finish_reason=finished or FinishReason.NONE,
+        )
+        if s.logprobs:
+            seq_out.logprobs = [LogProb(data=LogProbData(token_id=tok, logprob=lp))]
+        out = RequestOutput(
+            request_id=seq.req.request_id,
+            outputs=[seq_out],
+            usage=Usage(
+                num_prompt_tokens=len(seq.req.prompt_token_ids),
+                num_generated_tokens=len(seq.generated),
+            ),
+            finished=finished is not None,
+        )
+        keep_going = True
+        try:
+            keep_going = seq.req.callback(out)
+        except Exception:  # callback errors must not kill the engine loop
+            import traceback
+
+            traceback.print_exc()
+            keep_going = False
+        if finished is not None:
+            self._finish(seq, finished)
+            return False
+        if keep_going is False:
+            self._finish(seq, FinishReason.NONE, cancelled=True)
+            return False
+        return True
+
+    def _finish(
+        self, seq: _Seq, reason: FinishReason, cancelled: bool = False
+    ) -> None:
+        if seq.slot in self._running:
+            del self._running[seq.slot]
+            self._free_slots.append(seq.slot)
+        self.block_mgr.free(seq.block_ids)
+        seq.block_ids = []
+        if cancelled:
+            out = RequestOutput(
+                request_id=seq.req.request_id,
+                finished=True,
+                cancelled=True,
+                status=Status(StatusCode.CANCELLED, "cancelled"),
+            )
+            try:
+                seq.req.callback(out)
+            except Exception:
+                pass

@@ -1,0 +1,300 @@
+"""Model executor: owns params + paged KV cache on a device mesh and exposes
+jitted prefill/decode steps with fused sampling.
+
+Engine-tier component (the reference's analog is inside the absent xLLM
+submodule; the service-visible contracts it must honor are the 128-token
+block size and the KV-handle metadata relayed in InstanceMetaInfo —
+SURVEY.md §2.3).
+
+TPU design points:
+  * one compiled decode step for a FIXED batch of R slots — batch
+    composition changes never recompile (SURVEY.md §7 hard part 3);
+  * prefill lengths are bucketed; each bucket compiles once;
+  * KV caches are donated through every step (in-place update, no HBM copy);
+  * sampling runs on-device inside the same jit — only R int32 tokens +
+    R float32 logprobs cross back to the host per step;
+  * params/caches carry NamedShardings from parallel/sharding.py; under
+    multi-device meshes XLA emits the TP collectives.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from xllm_service_tpu.common.config import EngineConfig
+from xllm_service_tpu.models import llama
+from xllm_service_tpu.models.configs import ModelConfig, get_model_config
+from xllm_service_tpu.ops import sampling as sampling_ops
+from xllm_service_tpu.parallel.mesh import build_mesh
+from xllm_service_tpu.parallel.sharding import (
+    check_tp_divisibility,
+    kv_cache_sharding,
+    param_shardings,
+)
+
+
+@dataclass
+class SamplingBatch:
+    """Device-ready per-slot sampling params for the fixed decode batch."""
+
+    temperature: np.ndarray  # [R] float32
+    top_k: np.ndarray  # [R] int32
+    top_p: np.ndarray  # [R] float32
+    seeds: np.ndarray  # [R] uint32
+    steps: np.ndarray  # [R] int32 (per-request generated-token count)
+
+
+class ModelExecutor:
+    def __init__(
+        self,
+        engine_cfg: EngineConfig,
+        model_cfg: Optional[ModelConfig] = None,
+        mesh: Optional[Mesh] = None,
+        init_seed: int = 0,
+    ):
+        self.engine_cfg = engine_cfg
+        self.cfg = model_cfg or get_model_config(engine_cfg.model)
+        self.mesh = mesh or build_mesh(engine_cfg.dp_size, engine_cfg.tp_size)
+        tp = self.mesh.shape.get("tp", 1)
+        if tp > 1:
+            check_tp_divisibility(self.cfg, tp)
+
+        self.dtype = jnp.bfloat16 if engine_cfg.dtype == "bfloat16" else jnp.float32
+        self.R = engine_cfg.max_running_requests
+        self.block_size = engine_cfg.block_size
+        self.num_blocks = self._decide_num_blocks()
+        self.max_blocks_per_seq = math.ceil(
+            engine_cfg.max_seq_len / self.block_size
+        )
+
+        p_shardings = param_shardings(self.cfg, self.mesh)
+        kv_sharding = kv_cache_sharding(self.mesh)
+
+        with self.mesh:
+            if engine_cfg.checkpoint_path:
+                from xllm_service_tpu.runtime.weights import load_checkpoint
+
+                self.params = load_checkpoint(
+                    engine_cfg.checkpoint_path, self.cfg, self.dtype, p_shardings
+                )
+            else:
+                init_fn = jax.jit(
+                    lambda key: llama.init_params(self.cfg, key, self.dtype),
+                    out_shardings=p_shardings,
+                )
+                self.params = init_fn(jax.random.key(init_seed))
+
+            cache_shape = (
+                self.cfg.num_layers,
+                self.num_blocks,
+                self.block_size,
+                self.cfg.num_kv_heads,
+                self.cfg.head_dim,
+            )
+            alloc = jax.jit(
+                lambda: (
+                    jnp.zeros(cache_shape, self.dtype),
+                    jnp.zeros(cache_shape, self.dtype),
+                ),
+                out_shardings=(kv_sharding, kv_sharding),
+            )
+            self.k_cache, self.v_cache = alloc()
+
+        self._decode_jit = jax.jit(
+            self._decode_impl, donate_argnums=(0, 1), static_argnames=("use_kernel",)
+        )
+        self._prefill_jit = jax.jit(
+            self._prefill_impl, donate_argnums=(0, 1)
+        )
+        self.prefill_buckets = sorted(
+            b for b in engine_cfg.prefill_buckets if b <= engine_cfg.max_seq_len
+        )
+        # Buckets must cover max_seq_len so any admissible suffix fits.
+        if not self.prefill_buckets or self.prefill_buckets[-1] < engine_cfg.max_seq_len:
+            self.prefill_buckets.append(engine_cfg.max_seq_len)
+
+    # ----------------------------------------------------------- sizing
+
+    def _decide_num_blocks(self) -> int:
+        if self.engine_cfg.num_blocks > 0:
+            return self.engine_cfg.num_blocks
+        # Size the KV pool from free HBM after params (bench/real use).
+        cfg = self.cfg
+        bytes_per_param = 2 if self.engine_cfg.dtype == "bfloat16" else 4
+        E, L = cfg.hidden_size, cfg.num_layers
+        F = cfg.moe_intermediate_size * cfg.num_experts if cfg.is_moe else cfg.intermediate_size
+        n_params = (
+            cfg.vocab_size * E * (1 if cfg.tie_word_embeddings else 2)
+            + L * E * (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim
+            + L * cfg.num_heads * cfg.head_dim * E
+            + 3 * L * E * F
+        )
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            total_hbm = stats.get("bytes_limit", 16 * 2**30)
+        except Exception:
+            total_hbm = 16 * 2**30
+        tp = self.mesh.shape.get("tp", 1)
+        budget = total_hbm * self.engine_cfg.hbm_utilization - n_params * bytes_per_param / tp
+        block_bytes = (
+            2
+            * self.cfg.num_layers
+            * self.block_size
+            * (self.cfg.num_kv_heads // tp if self.cfg.num_kv_heads >= tp else self.cfg.num_kv_heads)
+            * self.cfg.head_dim
+            * bytes_per_param
+        )
+        n = int(budget // block_bytes)
+        return max(n, 16)
+
+    # ------------------------------------------------------------ step fns
+
+    def _decode_impl(
+        self,
+        k_cache,
+        v_cache,
+        params,
+        token_ids,
+        positions,
+        block_tables,
+        active,
+        temperature,
+        top_k,
+        top_p,
+        step_keys,
+        use_kernel=None,
+    ):
+        logits, k_cache, v_cache = llama.decode_step(
+            params,
+            self.cfg,
+            k_cache,
+            v_cache,
+            token_ids,
+            positions,
+            block_tables,
+            active,
+            use_kernel=use_kernel,
+        )
+        tokens, logprob, _ = sampling_ops.sample_tokens(
+            logits, temperature, top_k, top_p, step_keys
+        )
+        return k_cache, v_cache, tokens, logprob
+
+    def _prefill_impl(
+        self,
+        k_cache,
+        v_cache,
+        params,
+        token_ids,
+        start_pos,
+        true_len,
+        block_table,
+        temperature,
+        top_k,
+        top_p,
+        step_key,
+    ):
+        logits, k_cache, v_cache = llama.prefill_step(
+            params, self.cfg, k_cache, v_cache, token_ids, start_pos, true_len,
+            block_table,
+        )
+        tokens, logprob, _ = sampling_ops.sample_tokens(
+            logits[None],
+            temperature[None],
+            top_k[None],
+            top_p[None],
+            step_key[None],
+        )
+        return k_cache, v_cache, tokens[0], logprob[0]
+
+    # ---------------------------------------------------------- public API
+
+    def bucket_len(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return self.prefill_buckets[-1]
+
+    def prefill(
+        self,
+        token_ids: np.ndarray,  # [n] int32 — uncached suffix of the prompt
+        start_pos: int,
+        block_table: np.ndarray,  # [max_blocks_per_seq] int32
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+        step: int = 0,
+    ) -> Tuple[int, float]:
+        n = len(token_ids)
+        pad = self.bucket_len(n)
+        padded = np.zeros((pad,), np.int32)
+        padded[:n] = token_ids
+        key = sampling_ops.make_step_keys(
+            jnp.asarray([seed], jnp.uint32), jnp.int32(step)
+        )[0]
+        self.k_cache, self.v_cache, tok, lp = self._prefill_jit(
+            self.k_cache,
+            self.v_cache,
+            self.params,
+            jnp.asarray(padded),
+            jnp.int32(start_pos),
+            jnp.int32(n),
+            jnp.asarray(block_table, jnp.int32),
+            jnp.float32(temperature),
+            jnp.int32(top_k),
+            jnp.float32(top_p),
+            key,
+        )
+        return int(tok), float(lp)
+
+    def decode(
+        self,
+        token_ids: np.ndarray,  # [R]
+        positions: np.ndarray,  # [R]
+        block_tables: np.ndarray,  # [R, max_blocks_per_seq]
+        active: np.ndarray,  # [R] bool
+        batch: SamplingBatch,
+        use_kernel: Optional[bool] = None,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        keys = jax.vmap(
+            lambda s, st: jax.random.key_data(
+                jax.random.fold_in(jax.random.key(s), st)
+            )
+        )(jnp.asarray(batch.seeds, jnp.uint32), jnp.asarray(batch.steps, jnp.int32))
+        self.k_cache, self.v_cache, tokens, logprobs = self._decode_jit(
+            self.k_cache,
+            self.v_cache,
+            self.params,
+            jnp.asarray(token_ids, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(active),
+            jnp.asarray(batch.temperature, jnp.float32),
+            jnp.asarray(batch.top_k, jnp.int32),
+            jnp.asarray(batch.top_p, jnp.float32),
+            keys,
+            use_kernel=use_kernel,
+        )
+        return np.asarray(tokens), np.asarray(logprobs)
+
+    # ------------------------------------------------- KV block migration
+
+    def export_blocks(self, block_ids: np.ndarray) -> jax.Array:
+        """Gather KV blocks for migration to a peer instance (PD disagg).
+        Returns [2, L, n, bs, Hkv, D] on device; the transfer layer moves it
+        over ICI/DCN (jax.device_put to the peer mesh) or via host RPC."""
+        ids = jnp.asarray(block_ids, jnp.int32)
+        return jnp.stack([self.k_cache[:, ids], self.v_cache[:, ids]])
+
+    def import_blocks(self, blocks: jax.Array, block_ids: np.ndarray) -> None:
+        ids = jnp.asarray(block_ids, jnp.int32)
+        self.k_cache = self.k_cache.at[:, ids].set(blocks[0].astype(self.dtype))
+        self.v_cache = self.v_cache.at[:, ids].set(blocks[1].astype(self.dtype))
