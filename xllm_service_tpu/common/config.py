@@ -91,7 +91,7 @@ class EngineConfig:
     block_size: int = 128  # tokens per KV block — must match service tier
     murmur_hash3_seed: int = 1024  # block-hash seed — must match service tier
     num_blocks: int = 0  # 0 = size from hbm_utilization
-    hbm_utilization: float = 0.9
+    hbm_utilization: float = 0.9  # fraction of HBM for params + KV pool
 
     # Continuous batching.
     max_running_requests: int = 64

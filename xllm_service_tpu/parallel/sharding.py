@@ -5,8 +5,10 @@ so specs shift right by one. Contract:
 
   wq/wk/wv  [L, E, H*D]   → shard output heads over tp
   wo        [L, H*D, E]   → shard contracting dim over tp (psum after)
-  w_gate/up [L, E, F]     → shard F; w_down [L, F, E] → shard F
-  MoE       experts axis X over tp for now (true `ep` axis in later rounds)
+  dense MLP w_gate/up [L, E, F] → shard F; w_down [L, F, E] → shard F
+  MoE       w_gate/up [L, X, E, Fm], w_down [L, X, Fm, E] → experts X over
+            ep (when an ep mesh axis is given) and Fm over tp; without an
+            ep axis, X rides tp (pure-TP MoE for small expert counts)
   embed     [V, E]        → shard V (all-gather on embed lookup is tiny)
   lm_head   [E, V]        → shard V
   KV caches [L, B, bs, Hkv, D] → shard Hkv over tp
@@ -24,42 +26,57 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from xllm_service_tpu.models.configs import ModelConfig
 
 
-def param_shardings(cfg: ModelConfig, mesh: Mesh) -> Dict[str, Any]:
+def param_shardings(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    tp_axis: str = "tp",
+    ep_axis: str | None = None,
+) -> Dict[str, Any]:
+    """Sharding pytree matching the Llama param pytree.
+
+    `ep_axis` (when set and present in the mesh) shards the MoE expert axis
+    over its own mesh axis while `tp_axis` shards each expert's hidden dim —
+    true EP×TP. With ep_axis=None, experts ride the tp axis (pure-TP MoE,
+    right for small expert counts on one slice)."""
+
     def ns(*spec):
         return NamedSharding(mesh, P(*spec))
 
+    tp = tp_axis if tp_axis in mesh.shape else None
     layers: Dict[str, Any] = {
         "attn_norm": ns(None, None),
-        "wq": ns(None, None, "tp"),
-        "wk": ns(None, None, "tp"),
-        "wv": ns(None, None, "tp"),
-        "wo": ns(None, "tp", None),
+        "wq": ns(None, None, tp),
+        "wk": ns(None, None, tp),
+        "wv": ns(None, None, tp),
+        "wo": ns(None, tp, None),
         "mlp_norm": ns(None, None),
     }
     if cfg.is_moe:
+        ep = ep_axis if ep_axis is not None and ep_axis in mesh.shape else None
+        e, t = (ep, tp) if ep is not None else (tp, None)
         layers.update(
             {
                 "router": ns(None, None, None),
-                "w_gate": ns(None, "tp", None, None),
-                "w_up": ns(None, "tp", None, None),
-                "w_down": ns(None, "tp", None, None),
+                "w_gate": ns(None, e, None, t),
+                "w_up": ns(None, e, None, t),
+                "w_down": ns(None, e, t, None),
             }
         )
     else:
         layers.update(
             {
-                "w_gate": ns(None, None, "tp"),
-                "w_up": ns(None, None, "tp"),
-                "w_down": ns(None, "tp", None),
+                "w_gate": ns(None, None, tp),
+                "w_up": ns(None, None, tp),
+                "w_down": ns(None, tp, None),
             }
         )
     out: Dict[str, Any] = {
-        "embed": ns("tp", None),
+        "embed": ns(tp, None),
         "layers": layers,
         "final_norm": ns(None),
     }
     if not cfg.tie_word_embeddings:
-        out["lm_head"] = ns(None, "tp")
+        out["lm_head"] = ns(None, tp)
     return out
 
 
@@ -68,11 +85,28 @@ def kv_cache_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(None, None, None, "tp", None))
 
 
-def check_tp_divisibility(cfg: ModelConfig, tp: int) -> None:
+def check_tp_divisibility(cfg: ModelConfig, tp: int, ep: int = 1) -> None:
     if cfg.num_kv_heads % tp or cfg.num_heads % tp:
         raise ValueError(
             f"tp={tp} must divide num_heads={cfg.num_heads} and "
             f"num_kv_heads={cfg.num_kv_heads}"
         )
-    if cfg.intermediate_size % tp:
+    if cfg.is_moe:
+        # EP×TP: experts over ep, per-expert hidden over tp; pure-TP MoE
+        # (ep=1) shards the expert axis over tp instead.
+        if ep > 1:
+            if cfg.num_experts % ep:
+                raise ValueError(
+                    f"ep={ep} must divide num_experts={cfg.num_experts}"
+                )
+            if cfg.moe_intermediate_size % tp:
+                raise ValueError(
+                    f"tp={tp} must divide "
+                    f"moe_intermediate={cfg.moe_intermediate_size}"
+                )
+        elif cfg.num_experts % tp:
+            raise ValueError(
+                f"tp={tp} must divide num_experts={cfg.num_experts}"
+            )
+    elif cfg.intermediate_size % tp:
         raise ValueError(f"tp={tp} must divide intermediate={cfg.intermediate_size}")

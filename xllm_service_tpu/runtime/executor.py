@@ -142,7 +142,13 @@ class ModelExecutor:
         except Exception:
             total_hbm = 16 * 2**30
         tp = self.mesh.shape.get("tp", 1)
-        budget = total_hbm * self.engine_cfg.hbm_utilization - n_params * bytes_per_param / tp
+        # XLA's AOT peak-memory estimate counts donated KV caches on both
+        # sides of the step, so budget for 2x the pool (params are not
+        # donated and count once).
+        budget = (
+            total_hbm * self.engine_cfg.hbm_utilization
+            - n_params * bytes_per_param / tp
+        ) / 2
         block_bytes = (
             2
             * self.cfg.num_layers
@@ -152,6 +158,15 @@ class ModelExecutor:
             * bytes_per_param
         )
         n = int(budget // block_bytes)
+        if n < 16:
+            import warnings
+
+            warnings.warn(
+                f"KV pool auto-sizing collapsed to the 16-block floor "
+                f"(budget {budget/2**30:.2f} GiB, block {block_bytes/2**20:.2f} "
+                f"MiB): params leave almost no HBM headroom; expect thrashing",
+                stacklevel=2,
+            )
         return max(n, 16)
 
     # ------------------------------------------------------------ step fns
