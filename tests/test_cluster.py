@@ -1,0 +1,433 @@
+"""Cluster-manager + policy tests: registry lifecycle, role indices, PD
+flips, request-metrics state machine, prefix-cache index, CAR/SLO scoring.
+
+Mirrors the reference's (untested) manager semantics
+(instance_mgr.cpp, global_kvcache_mgr.cpp, cache_aware_routing.cpp) per the
+SURVEY.md §4 test-pyramid plan: pure-logic units over a MemoryStore, no I/O.
+"""
+
+import json
+import time
+
+import pytest
+
+from xllm_service_tpu.cluster import (
+    CACHE_PREFIX,
+    GlobalKVCacheMgr,
+    InstanceMgr,
+    LOADMETRICS_PREFIX,
+    TimePredictor,
+    instance_key,
+)
+from xllm_service_tpu.cluster.policies import make_policy
+from xllm_service_tpu.common.hashing import prefix_block_hashes
+from xllm_service_tpu.common.types import (
+    InstanceMetaInfo,
+    InstanceType,
+    KvCacheEvent,
+    LoadMetrics,
+    RequestAction,
+    Routing,
+)
+from xllm_service_tpu.coordination import MemoryStore
+
+
+def wait_until(pred, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def meta(name, itype=InstanceType.MIX, **kw):
+    return InstanceMetaInfo(
+        name=name,
+        rpc_address=f"{name}:9000",
+        http_address=f"{name}:8000",
+        type=itype,
+        **kw,
+    )
+
+
+@pytest.fixture
+def store():
+    st = MemoryStore()
+    yield st
+    st.close()
+
+
+@pytest.fixture
+def mgr(store):
+    m = InstanceMgr(store, is_master=lambda: True)
+    yield m
+    m.close()
+
+
+def register(store, m):
+    store.set(instance_key(m), m.serialize())
+
+
+class TestInstanceMgr:
+    def test_watch_driven_register_and_mix_assignment(self, store, mgr):
+        register(store, meta("i0"))
+        register(store, meta("i1"))
+        register(store, meta("i2"))
+        assert wait_until(lambda: len(mgr.list_instances()) == 3)
+        # First MIX -> decode, rest -> prefill (reference :110-127).
+        assert mgr.decode_instances() == ["i0"]
+        assert sorted(mgr.prefill_instances()) == ["i1", "i2"]
+
+    def test_explicit_roles(self, store, mgr):
+        register(store, meta("p0", InstanceType.PREFILL))
+        register(store, meta("d0", InstanceType.DECODE))
+        register(store, meta("e0", InstanceType.ENCODE))
+        assert wait_until(lambda: mgr.counts() == (1, 1, 1))
+        assert mgr.prefill_instances() == ["p0"]
+        assert mgr.decode_instances() == ["d0"]
+        assert mgr.encode_instances() == ["e0"]
+
+    def test_lease_expiry_removes_instance(self, store, mgr):
+        lease = store.grant_lease(0.2)
+        m = meta("dying", InstanceType.PREFILL)
+        store.set(instance_key(m), m.serialize(), lease_id=lease)
+        assert wait_until(lambda: mgr.prefill_instances() == ["dying"])
+        # lease expires -> DELETE -> swap-pop removal (reference §3.5)
+        assert wait_until(lambda: mgr.prefill_instances() == [])
+        assert mgr.get_instance("dying") is None
+
+    def test_swap_pop_keeps_index_dense(self, store, mgr):
+        for i in range(4):
+            register(store, meta(f"p{i}", InstanceType.PREFILL))
+        assert wait_until(lambda: mgr.counts()[0] == 4)
+        store.remove(instance_key(meta("p1", InstanceType.PREFILL)))
+        assert wait_until(lambda: mgr.counts()[0] == 3)
+        assert sorted(mgr.prefill_instances()) == ["p0", "p2", "p3"]
+        # RR still cycles over the dense index.
+        seen = {mgr.get_next_instance_pair().prefill_name for _ in range(6)}
+        assert seen == {"p0", "p2", "p3"}
+
+    def test_round_robin_pairing(self, store, mgr):
+        register(store, meta("p0", InstanceType.PREFILL))
+        register(store, meta("p1", InstanceType.PREFILL))
+        register(store, meta("d0", InstanceType.DECODE))
+        assert wait_until(lambda: mgr.counts() == (2, 1, 0))
+        pairs = [mgr.get_next_instance_pair() for _ in range(4)]
+        assert [p.prefill_name for p in pairs] == ["p0", "p1", "p0", "p1"]
+        assert all(p.decode_name == "d0" for p in pairs)
+
+    def test_colocated_fallback_without_decode(self, store, mgr):
+        register(store, meta("p0", InstanceType.PREFILL))
+        assert wait_until(lambda: mgr.counts()[0] == 1)
+        r = mgr.get_next_instance_pair()
+        assert r.prefill_name == "p0" and r.decode_name == "p0"
+
+    def test_request_metrics_state_machine(self, store, mgr):
+        register(store, meta("p0", InstanceType.PREFILL))
+        register(store, meta("d0", InstanceType.DECODE))
+        assert wait_until(lambda: mgr.counts() == (1, 1, 0))
+        r = Routing(prefill_name="p0", decode_name="d0")
+        mgr.update_request_metrics(r, RequestAction.SCHEDULE, num_tokens=256)
+        pm = mgr.get_request_metrics("p0")
+        assert pm.prefill_request_num == 1 and pm.prefill_token_num == 256
+        mgr.update_request_metrics(r, RequestAction.FINISH_PREFILL, 256)
+        pm = mgr.get_request_metrics("p0")
+        dm = mgr.get_request_metrics("d0")
+        assert pm.prefill_request_num == 0 and dm.decode_request_num == 1
+        mgr.update_request_metrics(r, RequestAction.GENERATE)
+        mgr.update_request_metrics(r, RequestAction.GENERATE)
+        assert mgr.get_request_metrics("d0").decode_token_num == 2
+        mgr.update_request_metrics(r, RequestAction.FINISH_DECODE)
+        assert mgr.get_request_metrics("d0").decode_request_num == 0
+
+    def test_cancel_unwinds(self, store, mgr):
+        register(store, meta("p0", InstanceType.PREFILL))
+        register(store, meta("d0", InstanceType.DECODE))
+        assert wait_until(lambda: mgr.counts() == (1, 1, 0))
+        r = Routing(prefill_name="p0", decode_name="d0")
+        mgr.update_request_metrics(r, RequestAction.SCHEDULE, 100)
+        mgr.update_request_metrics(r, RequestAction.CANCEL, 100)
+        pm = mgr.get_request_metrics("p0")
+        assert pm.prefill_request_num == 0 and pm.prefill_token_num == 0
+
+    def test_pd_flips(self, store, mgr):
+        for i in range(3):
+            register(store, meta(f"m{i}"))  # MIX: m0->decode, m1,m2->prefill
+        assert wait_until(lambda: mgr.counts() == (2, 1, 0))
+        flipped = mgr.flip_prefill_to_decode()
+        assert flipped in ("m1", "m2")
+        assert mgr.counts() == (1, 2, 0)
+        # Never empties a side.
+        assert mgr.flip_prefill_to_decode() == ""
+        back = mgr.flip_decode_to_prefill()
+        assert back != ""
+        assert mgr.counts() == (2, 1, 0)
+
+    def test_flip_skips_non_mix(self, store, mgr):
+        register(store, meta("p0", InstanceType.PREFILL))
+        register(store, meta("p1", InstanceType.PREFILL))
+        register(store, meta("d0", InstanceType.DECODE))
+        assert wait_until(lambda: mgr.counts() == (2, 1, 0))
+        assert mgr.flip_prefill_to_decode() == ""  # dedicated roles never flip
+
+    def test_load_metrics_upload_and_replication(self, store, mgr):
+        register(store, meta("p0", InstanceType.PREFILL))
+        assert wait_until(lambda: mgr.counts()[0] == 1)
+        mgr.record_load_metrics_update("p0", LoadMetrics(7, 0.5))
+        assert mgr.upload_load_metrics() == 1
+        raw = store.get(LOADMETRICS_PREFIX + "p0")
+        assert json.loads(raw)["waiting_requests_num"] == 7
+        # Non-master replica learns through the watch.
+        replica = InstanceMgr(store, is_master=lambda: False)
+        try:
+            assert wait_until(
+                lambda: replica.get_load_metrics()
+                .get("p0", LoadMetrics())
+                .waiting_requests_num
+                == 7
+            )
+        finally:
+            replica.close()
+
+    def test_prune_disconnected(self, store):
+        mgr = InstanceMgr(
+            store, is_master=lambda: True, detect_disconnected_interval_s=0.2
+        )
+        try:
+            register(store, meta("p0", InstanceType.PREFILL))
+            assert wait_until(lambda: mgr.counts()[0] == 1)
+            time.sleep(0.3)
+            assert mgr.prune_disconnected() == ["p0"]
+            assert mgr.counts()[0] == 0
+            # master also removed the store record
+            assert store.get_prefix("XLLM:PREFILL:") == {}
+        finally:
+            mgr.close()
+
+
+class TestTimePredictor:
+    def test_ttft_quadratic_fit(self):
+        # y = 10 + 0.5x + 0.001x^2
+        data = [(x, 10 + 0.5 * x + 0.001 * x * x) for x in (64, 128, 512, 1024, 4096)]
+        p = TimePredictor(ttft_profiling_data=data)
+        assert p.has_ttft_model
+        assert abs(p.predict_ttft(2048) - (10 + 0.5 * 2048 + 0.001 * 2048**2)) < 1.0
+
+    def test_tpot_linear_fit(self):
+        data = [
+            (b, t, 5.0 + 0.2 * b + 0.001 * t)
+            for b in (1, 8, 32)
+            for t in (1024, 8192)
+        ]
+        p = TimePredictor(tpot_profiling_data=data)
+        assert p.has_tpot_model
+        assert abs(p.predict_tpot(16, 4096) - (5.0 + 0.2 * 16 + 0.001 * 4096)) < 0.5
+
+    def test_no_data_predicts_inf(self):
+        p = TimePredictor()
+        assert p.predict_ttft(100) == float("inf")
+        assert p.predict_tpot(1, 100) == float("inf")
+
+
+class TestGlobalKVCacheMgr:
+    BS = 16
+
+    def make(self, store, master=True):
+        return GlobalKVCacheMgr(
+            store, is_master=lambda: master, block_size=self.BS
+        )
+
+    def test_match_walk_stops_at_gap(self, store):
+        kv = self.make(store)
+        try:
+            tokens = list(range(self.BS * 4))
+            hashes = prefix_block_hashes(tokens, self.BS)
+            # instance A holds blocks 0,1; block 2 missing; block 3 held.
+            kv.record_updated_kvcaches(
+                "A", KvCacheEvent(stored_cache={hashes[0], hashes[1], hashes[3]})
+            )
+            scores = kv.match(tokens)
+            assert scores.total_blocks == 4
+            assert scores.hbm_scores == {"A": 2}  # walk stops at gap
+        finally:
+            kv.close()
+
+    def test_tier_transitions(self, store):
+        kv = self.make(store)
+        try:
+            tokens = list(range(self.BS))
+            h = prefix_block_hashes(tokens, self.BS)[0]
+            kv.record_updated_kvcaches("A", KvCacheEvent(stored_cache={h}))
+            assert kv.lookup(h).hbm_instance_set == {"A"}
+            kv.record_updated_kvcaches(
+                "A", KvCacheEvent(offload_cache={h: "dram"})
+            )
+            loc = kv.lookup(h)
+            assert loc.hbm_instance_set == set()
+            assert loc.dram_instance_set == {"A"}
+            kv.record_updated_kvcaches("A", KvCacheEvent(offload_cache={h: "ssd"}))
+            assert kv.lookup(h).ssd_instance_set == {"A"}
+            kv.record_updated_kvcaches("A", KvCacheEvent(removed_cache={h}))
+            assert kv.lookup(h).empty()
+            assert len(kv) == 0
+        finally:
+            kv.close()
+
+    def test_dram_match_attributed_to_holder(self, store):
+        # The reference would read hbm_instance_set.begin() here (UB).
+        kv = self.make(store)
+        try:
+            tokens = list(range(self.BS))
+            h = prefix_block_hashes(tokens, self.BS)[0]
+            kv.record_updated_kvcaches("B", KvCacheEvent(stored_cache={h}))
+            kv.record_updated_kvcaches("B", KvCacheEvent(offload_cache={h: "dram"}))
+            scores = kv.match(tokens)
+            assert scores.hbm_scores == {}
+            assert scores.dram_scores == {"B": 1}
+        finally:
+            kv.close()
+
+    def test_master_upload_and_replica_sync(self, store):
+        kv = self.make(store, master=True)
+        replica_store_view = store  # same store; replica is non-master
+        replica = self.make(replica_store_view, master=False)
+        try:
+            tokens = list(range(self.BS * 2))
+            hashes = prefix_block_hashes(tokens, self.BS)
+            kv.record_updated_kvcaches(
+                "A", KvCacheEvent(stored_cache=set(hashes))
+            )
+            assert kv.upload_kvcache() == 2
+            assert wait_until(lambda: len(replica) == 2)
+            scores = replica.match(tokens)
+            assert scores.hbm_scores == {"A": 2}
+            # removal propagates as store DELETE
+            kv.record_updated_kvcaches(
+                "A", KvCacheEvent(removed_cache=set(hashes))
+            )
+            assert kv.upload_kvcache() == 2
+            assert wait_until(lambda: len(replica) == 0)
+        finally:
+            kv.close()
+            replica.close()
+
+    def test_remove_instance_clears_locations(self, store):
+        kv = self.make(store)
+        try:
+            tokens = list(range(self.BS))
+            h = prefix_block_hashes(tokens, self.BS)[0]
+            kv.record_updated_kvcaches("A", KvCacheEvent(stored_cache={h}))
+            kv.record_updated_kvcaches("B", KvCacheEvent(stored_cache={h}))
+            kv.remove_instance("A")
+            assert kv.lookup(h).hbm_instance_set == {"B"}
+            kv.remove_instance("B")
+            assert len(kv) == 0
+        finally:
+            kv.close()
+
+
+class TestPolicies:
+    BS = 16
+
+    def setup_cluster(self, store):
+        mgr = InstanceMgr(store, is_master=lambda: True)
+        kv = GlobalKVCacheMgr(store, is_master=lambda: True, block_size=self.BS)
+        register(store, meta("p0", InstanceType.PREFILL))
+        register(store, meta("p1", InstanceType.PREFILL))
+        register(store, meta("d0", InstanceType.DECODE))
+        assert wait_until(lambda: mgr.counts() == (2, 1, 0))
+        return mgr, kv
+
+    def test_rr_policy(self, store):
+        mgr, kv = self.setup_cluster(store)
+        try:
+            pol = make_policy("RR", mgr)
+            names = [pol.select_instances_pair([1, 2]).prefill_name for _ in range(4)]
+            assert names == ["p0", "p1", "p0", "p1"]
+        finally:
+            mgr.close(); kv.close()
+
+    def test_car_prefers_cache_affinity(self, store):
+        mgr, kv = self.setup_cluster(store)
+        try:
+            pol = make_policy("CAR", mgr, kv)
+            tokens = list(range(self.BS * 3))
+            hashes = prefix_block_hashes(tokens, self.BS)
+            kv.record_updated_kvcaches("p1", KvCacheEvent(stored_cache=set(hashes)))
+            r = pol.select_instances_pair(tokens)
+            assert r.prefill_name == "p1"
+            assert r.decode_name == "d0"
+        finally:
+            mgr.close(); kv.close()
+
+    def test_car_penalizes_load(self, store):
+        mgr, kv = self.setup_cluster(store)
+        try:
+            pol = make_policy("CAR", mgr, kv)
+            # p1 has full cache affinity but is saturated.
+            tokens = list(range(self.BS * 2))
+            hashes = prefix_block_hashes(tokens, self.BS)
+            kv.record_updated_kvcaches("p1", KvCacheEvent(stored_cache=set(hashes)))
+            mgr.record_load_metrics_update("p1", LoadMetrics(10, 0.99))
+            mgr.record_load_metrics_update("p0", LoadMetrics(0, 0.0))
+            r = pol.select_instances_pair(tokens)
+            # affinity(1.0) - usage(0.99) - waiting(1.0) < 0 => p0 wins
+            assert r.prefill_name == "p0"
+        finally:
+            mgr.close(); kv.close()
+
+    def test_slo_policy_prefers_fast_instance(self, store):
+        mgr = InstanceMgr(store, is_master=lambda: True)
+        kv = None
+        try:
+            fast = [(x, 0.1 * x) for x in (64, 256, 1024, 4096)]
+            slow = [(x, 10.0 * x) for x in (64, 256, 1024, 4096)]
+            tpot = [(b, t, 5.0) for b in (1, 4, 16) for t in (128, 4096)]
+            register(
+                store,
+                meta("slowp", InstanceType.PREFILL,
+                     ttft_profiling_data=slow, tpot_profiling_data=tpot),
+            )
+            register(
+                store,
+                meta("fastp", InstanceType.PREFILL,
+                     ttft_profiling_data=fast, tpot_profiling_data=tpot),
+            )
+            register(
+                store,
+                meta("d0", InstanceType.DECODE,
+                     ttft_profiling_data=fast, tpot_profiling_data=tpot),
+            )
+            assert wait_until(lambda: mgr.counts() == (2, 1, 0))
+            pol = make_policy("SLO_AWARE", mgr, target_ttft_ms=1000.0,
+                              target_tpot_ms=50.0)
+            # 512-token prompt: slowp predicts 5120ms > target, fastp 51ms.
+            r = pol.select_instances_pair(list(range(512)))
+            assert r.prefill_name == "fastp"
+            assert r.decode_name == "d0"
+        finally:
+            mgr.close()
+
+    def test_slo_decode_pressure_flips_mix_prefill(self, store):
+        mgr = InstanceMgr(store, is_master=lambda: True)
+        try:
+            ttft = [(x, 0.1 * x) for x in (64, 256, 1024, 4096)]
+            # decode tpot model far above target -> pressure
+            bad_tpot = [(b, t, 500.0) for b in (1, 4, 16) for t in (128, 4096)]
+            register(store, meta("m0", InstanceType.MIX,
+                                 ttft_profiling_data=ttft,
+                                 tpot_profiling_data=bad_tpot))
+            register(store, meta("m1", InstanceType.MIX,
+                                 ttft_profiling_data=ttft,
+                                 tpot_profiling_data=bad_tpot))
+            register(store, meta("m2", InstanceType.MIX,
+                                 ttft_profiling_data=ttft,
+                                 tpot_profiling_data=bad_tpot))
+            assert wait_until(lambda: mgr.counts() == (2, 1, 0))
+            pol = make_policy("SLO_AWARE", mgr, target_tpot_ms=50.0)
+            pol.select_instances_pair(list(range(128)))
+            # one MIX prefill flipped to decode to absorb pressure
+            assert mgr.counts() == (1, 2, 0)
+        finally:
+            mgr.close()

@@ -1,0 +1,20 @@
+"""Cluster state managers (reference: xllm_service/scheduler/managers/)."""
+
+from xllm_service_tpu.cluster.global_kvcache_mgr import CACHE_PREFIX, GlobalKVCacheMgr
+from xllm_service_tpu.cluster.instance_mgr import (
+    INSTANCE_PREFIXES,
+    LOADMETRICS_PREFIX,
+    InstanceMgr,
+    instance_key,
+)
+from xllm_service_tpu.cluster.time_predictor import TimePredictor
+
+__all__ = [
+    "CACHE_PREFIX",
+    "GlobalKVCacheMgr",
+    "INSTANCE_PREFIXES",
+    "LOADMETRICS_PREFIX",
+    "InstanceMgr",
+    "instance_key",
+    "TimePredictor",
+]

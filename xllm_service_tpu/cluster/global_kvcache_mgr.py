@@ -1,0 +1,216 @@
+"""Global prefix-cache location index ("KV Cache Pool").
+
+TPU-native redesign of the reference's GlobalKVCacheMgr
+(reference: xllm_service/scheduler/managers/global_kvcache_mgr.{h,cpp}):
+maps chained murmur3 block hashes -> per-tier instance sets, fed by
+heartbeat KvCacheEvents (:177-225), queried by cache-aware routing via a
+block-aligned prefix walk (:73-131), replicated master->store under
+`XLLM:CACHE:` (:227-247) and synced on non-masters via watches (:133-175).
+
+On TPU the tiers are HBM (device pool), DRAM (host offload), SSD (local
+NVMe). Deliberate fix vs the reference: DRAM/SSD matches attribute the score
+to the instance actually holding the block (the reference dereferences
+`hbm_instance_set.begin()` in those branches — UB when the HBM set is
+empty, global_kvcache_mgr.cpp:108-125).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from typing import Callable, Dict, List, Sequence, Set
+
+from xllm_service_tpu.common.hashing import prefix_block_hashes
+from xllm_service_tpu.common.types import (
+    CacheLocations,
+    KvCacheEvent,
+    OverlapScores,
+)
+from xllm_service_tpu.coordination.store import (
+    CoordinationStore,
+    EventType,
+    WatchEvent,
+)
+
+logger = logging.getLogger(__name__)
+
+CACHE_PREFIX = "XLLM:CACHE:"
+
+
+class GlobalKVCacheMgr:
+    def __init__(
+        self,
+        store: CoordinationStore,
+        is_master: Callable[[], bool],
+        block_size: int = 128,
+        murmur_hash3_seed: int = 1024,
+    ) -> None:
+        self._store = store
+        self._is_master = is_master
+        self._block_size = block_size
+        self._seed = murmur_hash3_seed
+        self._mu = threading.RLock()
+        self._index: Dict[bytes, CacheLocations] = {}
+        self._dirty: Set[bytes] = set()    # changed since last upload
+        self._deleted: Set[bytes] = set()  # emptied since last upload
+        self._watch_id = self._store.add_watch(CACHE_PREFIX, self._on_watch)
+        self._init_from_store()
+
+    def close(self) -> None:
+        self._store.remove_watch(self._watch_id)
+
+    @property
+    def block_size(self) -> int:
+        return self._block_size
+
+    def _init_from_store(self) -> None:
+        for key, raw in self._store.get_prefix(CACHE_PREFIX).items():
+            h = bytes.fromhex(key[len(CACHE_PREFIX):])
+            try:
+                self._index[h] = CacheLocations.from_json(json.loads(raw))
+            except Exception:
+                logger.warning("bad cache record at %s", key)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._index)
+
+    def lookup(self, block_hash: bytes) -> CacheLocations:
+        with self._mu:
+            loc = self._index.get(block_hash)
+            return (
+                CacheLocations(
+                    set(loc.hbm_instance_set),
+                    set(loc.dram_instance_set),
+                    set(loc.ssd_instance_set),
+                )
+                if loc is not None
+                else CacheLocations()
+            )
+
+    # ------------------------------------------------------------------ #
+    # match: the routing-side prefix walk
+    # ------------------------------------------------------------------ #
+
+    def match(self, token_ids: Sequence[int]) -> OverlapScores:
+        """Per-instance matched-block counts over the longest cached prefix
+        (reference: global_kvcache_mgr.cpp:73-131). Hashes every complete
+        block of the prompt with the chained scheme — identical bytes to
+        what engines commit — then walks until a block no instance holds."""
+        hashes = prefix_block_hashes(token_ids, self._block_size, self._seed)
+        scores = OverlapScores(total_blocks=len(hashes))
+        with self._mu:
+            for h in hashes:
+                loc = self._index.get(h)
+                if loc is None or loc.empty():
+                    break
+                for name in loc.hbm_instance_set:
+                    scores.hbm_scores[name] = scores.hbm_scores.get(name, 0) + 1
+                for name in loc.dram_instance_set:
+                    scores.dram_scores[name] = scores.dram_scores.get(name, 0) + 1
+                for name in loc.ssd_instance_set:
+                    scores.ssd_scores[name] = scores.ssd_scores.get(name, 0) + 1
+        return scores
+
+    # ------------------------------------------------------------------ #
+    # heartbeat ingestion
+    # ------------------------------------------------------------------ #
+
+    def record_updated_kvcaches(self, instance: str, event: KvCacheEvent) -> None:
+        """Apply one instance's cache delta
+        (reference: global_kvcache_mgr.cpp:177-225). `stored` puts the block
+        in the instance's HBM set; `offload` moves it HBM->DRAM/SSD;
+        `removed` clears the instance from every tier."""
+        if event.empty():
+            return
+        with self._mu:
+            for h in event.stored_cache:
+                loc = self._index.setdefault(h, CacheLocations())
+                loc.hbm_instance_set.add(instance)
+                loc.dram_instance_set.discard(instance)
+                loc.ssd_instance_set.discard(instance)
+                self._dirty.add(h)
+            for h, tier in event.offload_cache.items():
+                loc = self._index.setdefault(h, CacheLocations())
+                loc.hbm_instance_set.discard(instance)
+                if tier == "ssd":
+                    loc.dram_instance_set.discard(instance)
+                    loc.ssd_instance_set.add(instance)
+                else:
+                    loc.dram_instance_set.add(instance)
+                    loc.ssd_instance_set.discard(instance)
+                self._dirty.add(h)
+            for h in event.removed_cache:
+                loc = self._index.get(h)
+                if loc is None:
+                    continue
+                loc.hbm_instance_set.discard(instance)
+                loc.dram_instance_set.discard(instance)
+                loc.ssd_instance_set.discard(instance)
+                if loc.empty():
+                    del self._index[h]
+                    self._deleted.add(h)
+                    self._dirty.discard(h)
+                else:
+                    self._dirty.add(h)
+
+    def remove_instance(self, instance: str) -> None:
+        """Drop a departed instance from every location set."""
+        with self._mu:
+            for h in list(self._index):
+                loc = self._index[h]
+                before = (
+                    instance in loc.hbm_instance_set
+                    or instance in loc.dram_instance_set
+                    or instance in loc.ssd_instance_set
+                )
+                if not before:
+                    continue
+                loc.hbm_instance_set.discard(instance)
+                loc.dram_instance_set.discard(instance)
+                loc.ssd_instance_set.discard(instance)
+                if loc.empty():
+                    del self._index[h]
+                    self._deleted.add(h)
+                    self._dirty.discard(h)
+                else:
+                    self._dirty.add(h)
+
+    # ------------------------------------------------------------------ #
+    # master <-> store replication
+    # ------------------------------------------------------------------ #
+
+    def upload_kvcache(self) -> int:
+        """Master-only batch flush of dirty records
+        (reference: global_kvcache_mgr.cpp:227-247). Returns writes+deletes."""
+        if not self._is_master():
+            return 0
+        with self._mu:
+            dirty = {h: self._index[h].to_json() for h in self._dirty
+                     if h in self._index}
+            deleted = set(self._deleted)
+            self._dirty.clear()
+            self._deleted.clear()
+        for h, j in dirty.items():
+            self._store.set(CACHE_PREFIX + h.hex(), json.dumps(j))
+        for h in deleted:
+            self._store.remove(CACHE_PREFIX + h.hex())
+        return len(dirty) + len(deleted)
+
+    def _on_watch(self, events: List[WatchEvent]) -> None:
+        """Non-master sync (reference: global_kvcache_mgr.cpp:133-175)."""
+        if self._is_master():
+            return
+        with self._mu:
+            for ev in events:
+                h = bytes.fromhex(ev.key[len(CACHE_PREFIX):])
+                if ev.type == EventType.PUT:
+                    try:
+                        self._index[h] = CacheLocations.from_json(
+                            json.loads(ev.value)
+                        )
+                    except Exception:
+                        pass
+                else:
+                    self._index.pop(h, None)

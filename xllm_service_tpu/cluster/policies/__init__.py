@@ -1,0 +1,14 @@
+"""Load-balance policies (reference: xllm_service/scheduler/loadbalance_policy/)."""
+
+from xllm_service_tpu.cluster.policies.base import LoadBalancePolicy, make_policy
+from xllm_service_tpu.cluster.policies.cache_aware import CacheAwareRouting
+from xllm_service_tpu.cluster.policies.round_robin import RoundRobinPolicy
+from xllm_service_tpu.cluster.policies.slo_aware import SloAwarePolicy
+
+__all__ = [
+    "LoadBalancePolicy",
+    "make_policy",
+    "CacheAwareRouting",
+    "RoundRobinPolicy",
+    "SloAwarePolicy",
+]

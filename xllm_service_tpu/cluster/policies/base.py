@@ -1,0 +1,42 @@
+"""Policy interface + factory.
+
+Reference: loadbalance_policy.h:25-36 (`select_instances_pair(request)`)
+and the flag-driven construction in the scheduler ctor
+(scheduler.cpp:50-57, --load_balance_policy = RR | CAR | SLO_AWARE).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from xllm_service_tpu.common.types import Routing
+
+
+class LoadBalancePolicy:
+    def select_instances_pair(self, token_ids: Sequence[int]) -> Routing:
+        """Choose the (prefill, decode) pair for one request given its
+        pre-tokenized prompt."""
+        raise NotImplementedError
+
+
+def make_policy(
+    name: str,
+    instance_mgr,
+    kvcache_mgr=None,
+    target_ttft_ms: float = 1000.0,
+    target_tpot_ms: float = 50.0,
+) -> LoadBalancePolicy:
+    from xllm_service_tpu.cluster.policies.cache_aware import CacheAwareRouting
+    from xllm_service_tpu.cluster.policies.round_robin import RoundRobinPolicy
+    from xllm_service_tpu.cluster.policies.slo_aware import SloAwarePolicy
+
+    key = name.upper()
+    if key in ("RR", "ROUND_ROBIN"):
+        return RoundRobinPolicy(instance_mgr)
+    if key in ("CAR", "CACHE_AWARE"):
+        if kvcache_mgr is None:
+            raise ValueError("CAR policy requires a GlobalKVCacheMgr")
+        return CacheAwareRouting(instance_mgr, kvcache_mgr)
+    if key == "SLO_AWARE":
+        return SloAwarePolicy(instance_mgr, target_ttft_ms, target_tpot_ms)
+    raise ValueError(f"unknown load_balance_policy {name!r}")

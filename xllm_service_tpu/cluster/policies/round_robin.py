@@ -1,0 +1,18 @@
+"""Round-robin pair selection (reference: loadbalance_policy/round_robin.cpp:20-22,
+delegating to InstanceMgr::get_next_instance_pair)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from xllm_service_tpu.cluster.instance_mgr import InstanceMgr
+from xllm_service_tpu.cluster.policies.base import LoadBalancePolicy
+from xllm_service_tpu.common.types import Routing
+
+
+class RoundRobinPolicy(LoadBalancePolicy):
+    def __init__(self, instance_mgr: InstanceMgr) -> None:
+        self._instance_mgr = instance_mgr
+
+    def select_instances_pair(self, token_ids: Sequence[int]) -> Routing:
+        return self._instance_mgr.get_next_instance_pair()

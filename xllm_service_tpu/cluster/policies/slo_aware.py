@@ -1,0 +1,35 @@
+"""SLO-aware routing with dynamic PD-ratio flipping.
+
+Reference: loadbalance_policy/slo_aware_policy.cpp:26-39 delegating to
+InstanceMgr::select_instance_pair_on_slo (instance_mgr.cpp:656-757);
+targets from --target_ttft / --target_tpot (global_gflags.cpp:102-112).
+The policy predicts TTFT/TPOT per candidate from each instance's fitted
+profiling curves, dispatches to the first instance meeting targets, spills
+prefill work onto idle decode instances, and flips MIX instance roles to
+rebalance the prefill:decode ratio under sustained pressure.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from xllm_service_tpu.cluster.instance_mgr import InstanceMgr
+from xllm_service_tpu.cluster.policies.base import LoadBalancePolicy
+from xllm_service_tpu.common.types import Routing
+
+
+class SloAwarePolicy(LoadBalancePolicy):
+    def __init__(
+        self,
+        instance_mgr: InstanceMgr,
+        target_ttft_ms: float = 1000.0,
+        target_tpot_ms: float = 50.0,
+    ) -> None:
+        self._instance_mgr = instance_mgr
+        self.target_ttft_ms = target_ttft_ms
+        self.target_tpot_ms = target_tpot_ms
+
+    def select_instances_pair(self, token_ids: Sequence[int]) -> Routing:
+        return self._instance_mgr.select_instance_pair_on_slo(
+            len(token_ids), self.target_ttft_ms, self.target_tpot_ms
+        )

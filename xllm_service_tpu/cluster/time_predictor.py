@@ -1,0 +1,60 @@
+"""Latency predictors fitted from instance-published profiling curves.
+
+The reference fits a degree-2 polynomial TTFT(prompt_len) and a linear model
+TPOT(batch_size, total_tokens) per instance with Eigen's
+colPivHouseholderQr (reference: common/time_predictor.{h,cpp}:25-93); engines
+profile themselves and publish the sample curves in their registration
+metadata (types.h:179-182). Here the fit is a numpy least-squares solve.
+Deliberate divergence: the reference's `else` branch zeroes the *ttft*
+coefficients when tpot data is missing (time_predictor.cpp:72-74, a bug);
+we zero the right ones.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class TimePredictor:
+    def __init__(
+        self,
+        ttft_profiling_data: Sequence[Tuple[int, float]] = (),
+        tpot_profiling_data: Sequence[Tuple[int, int, float]] = (),
+    ) -> None:
+        self._ttft_coef: Optional[np.ndarray] = None  # [c0, c1, c2]
+        self._tpot_coef: Optional[np.ndarray] = None  # [c0, c_batch, c_tokens]
+        if len(ttft_profiling_data) >= 3:
+            x = np.array([p[0] for p in ttft_profiling_data], dtype=np.float64)
+            y = np.array([p[1] for p in ttft_profiling_data], dtype=np.float64)
+            A = np.stack([np.ones_like(x), x, x * x], axis=1)
+            self._ttft_coef, *_ = np.linalg.lstsq(A, y, rcond=None)
+        if len(tpot_profiling_data) >= 3:
+            b = np.array([p[0] for p in tpot_profiling_data], dtype=np.float64)
+            t = np.array([p[1] for p in tpot_profiling_data], dtype=np.float64)
+            y = np.array([p[2] for p in tpot_profiling_data], dtype=np.float64)
+            A = np.stack([np.ones_like(b), b, t], axis=1)
+            self._tpot_coef, *_ = np.linalg.lstsq(A, y, rcond=None)
+
+    @property
+    def has_ttft_model(self) -> bool:
+        return self._ttft_coef is not None
+
+    @property
+    def has_tpot_model(self) -> bool:
+        return self._tpot_coef is not None
+
+    def predict_ttft(self, prompt_len: int) -> float:
+        """Milliseconds; +inf when no model (so SLO routing skips the
+        instance rather than treating it as instantaneous)."""
+        if self._ttft_coef is None:
+            return float("inf")
+        c = self._ttft_coef
+        return float(c[0] + c[1] * prompt_len + c[2] * prompt_len * prompt_len)
+
+    def predict_tpot(self, batch_size: int, total_tokens: int) -> float:
+        if self._tpot_coef is None:
+            return float("inf")
+        c = self._tpot_coef
+        return float(c[0] + c[1] * batch_size + c[2] * total_tokens)

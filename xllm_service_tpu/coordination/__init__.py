@@ -1,0 +1,24 @@
+"""Coordination: store backends (memory / etcd gateway) and master election."""
+
+from xllm_service_tpu.coordination.election import MASTER_KEY, MasterElection
+from xllm_service_tpu.coordination.store import (
+    CoordinationStore,
+    EtcdGatewayStore,
+    EventType,
+    MemoryStore,
+    WatchEvent,
+    connect,
+    reset_memory_namespace,
+)
+
+__all__ = [
+    "MASTER_KEY",
+    "MasterElection",
+    "CoordinationStore",
+    "EtcdGatewayStore",
+    "EventType",
+    "MemoryStore",
+    "WatchEvent",
+    "connect",
+    "reset_memory_namespace",
+]

@@ -1,0 +1,155 @@
+"""Master election over the coordination store.
+
+The reference inlines this in the scheduler: a compare-create transaction on
+`XLLM:SERVICE:MASTER` with a 3 s TTL lease, a keepalive/heartbeat loop while
+master, and a watch-triggered takeover when the key vanishes
+(reference: scheduler.cpp:27,38-42,113-121,132-149; etcd_client.cpp:47-62).
+Here it is a reusable component with explicit elected/lost callbacks, and the
+keepalive loop *detects* lease loss (store unreachable / lease expired) and
+demotes itself — the reference silently keeps believing it is master.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from xllm_service_tpu.coordination.store import (
+    CoordinationStore,
+    EventType,
+    WatchEvent,
+)
+
+MASTER_KEY = "XLLM:SERVICE:MASTER"
+
+
+class MasterElection:
+    def __init__(
+        self,
+        store: CoordinationStore,
+        identity: str,
+        lease_ttl_s: float = 3.0,
+        on_elected: Optional[Callable[[], None]] = None,
+        on_lost: Optional[Callable[[], None]] = None,
+        master_key: str = MASTER_KEY,
+    ) -> None:
+        self._store = store
+        self._identity = identity
+        self._ttl = lease_ttl_s
+        self._on_elected = on_elected
+        self._on_lost = on_lost
+        self._key = master_key
+        self._mu = threading.Lock()
+        self._is_master = False
+        self._lease_id = 0
+        self._stop = threading.Event()
+        self._keepalive_thread: Optional[threading.Thread] = None
+        self._watch_id: Optional[int] = None
+
+    # -- public ------------------------------------------------------------
+    @property
+    def is_master(self) -> bool:
+        with self._mu:
+            return self._is_master
+
+    @property
+    def identity(self) -> str:
+        return self._identity
+
+    def current_master(self) -> Optional[str]:
+        return self._store.get(self._key)
+
+    def start(self) -> None:
+        """Campaign once, then watch for vacancies (reference startup order:
+        try election first, fall back to watching, scheduler.cpp:38-68)."""
+        if not self._campaign():
+            self._watch_id = self._store.add_watch(self._key, self._on_watch)
+            # Re-check after installing the watch: the master may have died
+            # between our failed campaign and the watch registration.
+            if self._store.get(self._key) is None:
+                self._campaign()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watch_id is not None:
+            self._store.remove_watch(self._watch_id)
+            self._watch_id = None
+        with self._mu:
+            was_master, lease = self._is_master, self._lease_id
+            self._is_master = False
+        if was_master and lease:
+            try:
+                self._store.revoke_lease(lease)
+            except Exception:
+                pass
+        if self._keepalive_thread is not None:
+            self._keepalive_thread.join(timeout=2.0)
+            self._keepalive_thread = None
+
+    # -- internals ---------------------------------------------------------
+    def _campaign(self) -> bool:
+        lease = self._store.grant_lease(self._ttl)
+        if self._store.compare_create(self._key, self._identity, lease):
+            with self._mu:
+                self._is_master = True
+                self._lease_id = lease
+            self._keepalive_thread = threading.Thread(
+                target=self._keepalive_loop, name="master-keepalive", daemon=True
+            )
+            self._keepalive_thread.start()
+            if self._on_elected:
+                self._on_elected()
+            return True
+        self._store.revoke_lease(lease)
+        return False
+
+    def _keepalive_loop(self) -> None:
+        period = max(0.05, self._ttl / 3.0)
+        while not self._stop.wait(period):
+            with self._mu:
+                lease = self._lease_id if self._is_master else 0
+            if not lease:
+                return
+            ok = False
+            try:
+                ok = self._store.keepalive(lease)
+            except Exception:
+                ok = False
+            if not ok:
+                self._demote()
+                return
+
+    def _demote(self) -> None:
+        with self._mu:
+            if not self._is_master:
+                return
+            self._is_master = False
+            self._lease_id = 0
+        if self._on_lost:
+            self._on_lost()
+        # Go back to watching for the next vacancy. The DELETE may already
+        # have fired before the watch existed (our own lease expiry), so
+        # re-check the key and campaign immediately if it is vacant — same
+        # race start() closes after its failed first campaign.
+        if self._watch_id is None and not self._stop.is_set():
+            self._watch_id = self._store.add_watch(self._key, self._on_watch)
+            try:
+                vacant = self._store.get(self._key) is None
+            except Exception:
+                vacant = False
+            if vacant and self._campaign() and self._watch_id is not None:
+                self._store.remove_watch(self._watch_id)
+                self._watch_id = None
+
+    def _on_watch(self, events: List[WatchEvent]) -> None:
+        if self._stop.is_set():
+            return
+        for ev in events:
+            if ev.key == self._key and ev.type == EventType.DELETE:
+                # Vacancy: attempt takeover (reference:
+                # handle_master_service_watch, scheduler.cpp:132-149).
+                if not self.is_master and self._campaign():
+                    if self._watch_id is not None:
+                        self._store.remove_watch(self._watch_id)
+                        self._watch_id = None
+                return
