@@ -1,0 +1,275 @@
+"""End-to-end API tests over real sockets: master (HTTP+RPC) + fake-engine
+instances registering/heartbeating/pushing generations — the full
+curl -> service -> instance -> tokens path of SURVEY.md §3.2/§3.3, minus JAX
+(the FakeEngine echoes prompt tokens; the real-engine path is covered by
+tests/test_instance_real.py).
+"""
+
+import http.client
+import json
+import time
+
+import pytest
+
+from xllm_service_tpu.api import FakeEngine, Master
+from xllm_service_tpu.api.instance import InstanceServer
+from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+from xllm_service_tpu.coordination import MemoryStore
+from xllm_service_tpu.tokenizer import ByteTokenizer
+
+
+def wait_until(pred, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def http_post(addr, path, body, timeout=30.0):
+    host, _, port = addr.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    conn.request(
+        "POST", path, body=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, json.loads(data) if data else {}
+
+def http_get(addr, path, timeout=10.0):
+    host, _, port = addr.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = resp.read().decode()
+    conn.close()
+    try:
+        return resp.status, json.loads(data)
+    except json.JSONDecodeError:
+        return resp.status, data
+
+
+def sse_post(addr, path, body, timeout=30.0):
+    """POST and parse an SSE stream into a list of data payloads."""
+    host, _, port = addr.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    conn.request(
+        "POST", path, body=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    assert resp.status == 200, resp.read()
+    events = []
+    for raw in resp:
+        line = raw.decode().strip()
+        if not line.startswith("data: "):
+            continue
+        payload = line[len("data: "):]
+        if payload == "[DONE]":
+            events.append("[DONE]")
+            break
+        events.append(json.loads(payload))
+    conn.close()
+    return events
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    store = MemoryStore()
+    cfg = ServiceConfig(
+        host="127.0.0.1",
+        http_port=0,
+        rpc_port=0,
+        heartbeat_interval_s=0.2,
+        master_lease_ttl_s=1.0,
+        load_balance_policy="CAR",
+        num_ordered_output_streams=8,
+        block_size=16,
+    )
+    master = Master(cfg, store=store)
+    master.start()
+
+    def make_instance(name, itype, **engine_kw):
+        ecfg = EngineConfig(
+            model="fake-echo", instance_name=name, instance_type=itype,
+            block_size=16,
+        )
+        srv = InstanceServer(
+            ecfg,
+            master_rpc_addr=master.rpc_address,
+            heartbeat_interval_s=0.2,
+            engine=FakeEngine(**engine_kw),
+        )
+        srv.start()
+        return srv
+
+    p0 = make_instance("p0", "PREFILL")
+    d0 = make_instance("d0", "DECODE")
+    assert wait_until(
+        lambda: master.scheduler.instance_mgr.counts() == (1, 1, 0)
+    )
+    yield master, p0, d0, store
+    p0.stop()
+    d0.stop()
+    master.stop()
+    store.close()
+
+
+TOK = ByteTokenizer()
+
+
+class TestHttpSurface:
+    def test_hello(self, cluster):
+        master = cluster[0]
+        code, body = http_get(master.http_address, "/hello")
+        assert code == 200 and "hello" in body["message"]
+
+    def test_models_lists_registered_model(self, cluster):
+        master = cluster[0]
+        code, body = http_get(master.http_address, "/v1/models")
+        assert code == 200
+        assert [m["id"] for m in body["data"]] == ["fake-echo"]
+
+    def test_metrics_aggregated(self, cluster):
+        master = cluster[0]
+        assert wait_until(
+            lambda: "p0" in master.scheduler.instance_mgr.get_load_metrics()
+        )
+        code, body = http_get(master.http_address, "/metrics")
+        assert code == 200
+        assert 'xllm_instance_waiting_requests{instance="p0"}' in body
+
+    def test_metrics_passthrough(self, cluster):
+        master = cluster[0]
+        code, body = http_get(master.http_address, "/metrics?instance=p0")
+        assert code == 200
+
+    def test_404(self, cluster):
+        master = cluster[0]
+        code, body = http_get(master.http_address, "/nope")
+        assert code == 404
+
+
+class TestCompletionE2E:
+    def test_nonstream_completion_echoes(self, cluster):
+        master = cluster[0]
+        prompt = "abc"
+        code, body = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "fake-echo", "prompt": prompt, "max_tokens": 16},
+        )
+        assert code == 200, body
+        assert body["object"] == "text_completion"
+        # FakeEngine echoes reversed prompt tokens
+        assert body["choices"][0]["text"] == prompt[::-1]
+        assert body["choices"][0]["finish_reason"] == "stop"
+        assert body["usage"]["prompt_tokens"] == len(prompt)
+        assert body["usage"]["completion_tokens"] == len(prompt)
+
+    def test_stream_completion(self, cluster):
+        master = cluster[0]
+        events = sse_post(
+            master.http_address, "/v1/completions",
+            {"model": "fake-echo", "prompt": "hi", "max_tokens": 8,
+             "stream": True,
+             "stream_options": {"include_usage": True}},
+        )
+        assert events[-1] == "[DONE]"
+        text = "".join(
+            e["choices"][0]["text"] for e in events[:-1] if e.get("choices")
+        )
+        assert text == "ih"
+        usage_events = [e for e in events[:-1] if e != "[DONE]" and e.get("usage")]
+        assert usage_events and usage_events[-1]["usage"]["completion_tokens"] == 2
+
+    def test_nonstream_chat(self, cluster):
+        master = cluster[0]
+        code, body = http_post(
+            master.http_address, "/v1/chat/completions",
+            {"model": "fake-echo",
+             "messages": [{"role": "user", "content": "yo"}],
+             "max_tokens": 4},
+        )
+        assert code == 200, body
+        assert body["object"] == "chat.completion"
+        msg = body["choices"][0]["message"]
+        assert msg["role"] == "assistant" and len(msg["content"]) == 4
+
+    def test_stream_chat_role_delta(self, cluster):
+        master = cluster[0]
+        events = sse_post(
+            master.http_address, "/v1/chat/completions",
+            {"model": "fake-echo",
+             "messages": [{"role": "user", "content": "x"}],
+             "max_tokens": 4, "stream": True},
+        )
+        assert events[-1] == "[DONE]"
+        first = events[0]
+        assert first["object"] == "chat.completion.chunk"
+        assert first["choices"][0]["delta"].get("role") == "assistant"
+
+    def test_missing_prompt_400(self, cluster):
+        master = cluster[0]
+        code, body = http_post(
+            master.http_address, "/v1/completions", {"model": "fake-echo"}
+        )
+        assert code == 400
+
+    def test_embeddings_501(self, cluster):
+        master = cluster[0]
+        code, _ = http_post(
+            master.http_address, "/v1/embeddings",
+            {"model": "fake-echo", "input": "x"},
+        )
+        assert code == 501
+
+
+class TestClusterBehavior:
+    def test_routing_injected_and_prefill_received(self, cluster):
+        master, p0, d0, _ = cluster
+        before = len(p0.engine.requests_seen)
+        http_post(
+            master.http_address, "/v1/completions",
+            {"model": "fake-echo", "prompt": "route-me", "max_tokens": 4},
+        )
+        assert len(p0.engine.requests_seen) == before + 1
+        # pre-tokenized ids were used, not re-encoded
+        req = p0.engine.requests_seen[-1]
+        assert req.prompt_token_ids == TOK.encode("route-me")
+
+    def test_heartbeat_replicates_load_to_store(self, cluster):
+        master, _, _, store = cluster
+        assert wait_until(
+            lambda: store.get_prefix("XLLM:LOADMETRICS:") != {}
+        )
+
+    def test_instance_death_removes_from_registry(self, cluster):
+        master = cluster[0]
+        ecfg = EngineConfig(model="fake-echo", instance_name="dying",
+                            instance_type="PREFILL", block_size=16)
+        srv = InstanceServer(
+            ecfg, master_rpc_addr=master.rpc_address,
+            heartbeat_interval_s=0.2, engine=FakeEngine(),
+        )
+        srv.start()
+        assert wait_until(
+            lambda: master.scheduler.instance_mgr.counts()[0] == 2
+        )
+        srv.stop()  # heartbeats stop -> lease (3x interval) expires
+        assert wait_until(
+            lambda: master.scheduler.instance_mgr.counts()[0] == 1, timeout=15.0
+        )
+
+    def test_generations_for_unknown_request_reports_stop(self, cluster):
+        master = cluster[0]
+        from xllm_service_tpu.api import MasterClient, output_to_json
+        from xllm_service_tpu.common.types import RequestOutput
+
+        client = MasterClient(master.rpc_address)
+        cont = client.push_generations(
+            [RequestOutput(service_request_id="ghost-1")]
+        )
+        assert cont == {"ghost-1": False}

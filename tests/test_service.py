@@ -1,0 +1,348 @@
+"""Service-tier tests: tokenizer, chat template, response JSON shapes,
+scheduler request lifecycle (stream + non-stream + cancel + offline parking).
+
+The scheduler runs against a MemoryStore and fake instances (the
+rpc_client_test pattern from the reference grown into an in-process fixture,
+SURVEY.md §4).
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from xllm_service_tpu.cluster import instance_key
+from xllm_service_tpu.common.config import ServiceConfig
+from xllm_service_tpu.common.types import (
+    FinishReason,
+    InstanceMetaInfo,
+    InstanceType,
+    LoadMetrics,
+    LogProb,
+    LogProbData,
+    RequestOutput,
+    SequenceOutput,
+    Status,
+    StatusCode,
+    Usage,
+)
+from xllm_service_tpu.coordination import MemoryStore
+from xllm_service_tpu.service import (
+    ClientStream,
+    ResponseHandler,
+    Scheduler,
+    ServiceRequest,
+    make_service_request_id,
+)
+from xllm_service_tpu.tokenizer import (
+    ByteTokenizer,
+    ChatTemplate,
+    Message,
+    MMContentPart,
+    create_tokenizer,
+    parse_messages,
+)
+
+
+def wait_until(pred, timeout=5.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+class CaptureStream(ClientStream):
+    def __init__(self, fail_after=None):
+        self.chunks = []
+        self.done = False
+        self.final = None
+        self.error = None
+        self.fail_after = fail_after
+
+    def write(self, payload):
+        if self.fail_after is not None and len(self.chunks) >= self.fail_after:
+            return False
+        self.chunks.append(payload)
+        return True
+
+    def write_done(self):
+        self.done = True
+        return True
+
+    def finish(self, payload):
+        self.final = payload
+        return True
+
+    def finish_with_error(self, code, message):
+        self.error = (code, message)
+        return True
+
+
+class TestTokenizer:
+    def test_byte_roundtrip(self):
+        tok = ByteTokenizer()
+        ids = tok.encode("hello ✓")
+        assert tok.decode(ids) == "hello ✓"
+        assert tok.vocab_size == 259
+        assert tok.eos_token_id == 2
+
+    def test_factory_default(self):
+        assert isinstance(create_tokenizer(""), ByteTokenizer)
+
+
+class TestChatTemplate:
+    def test_fallback_template_shape(self):
+        tpl = ChatTemplate(None)
+        msgs = [Message("system", "be brief"), Message("user", "hi")]
+        out = tpl.apply(msgs)
+        assert out == (
+            "<|im_start|>system\nbe brief<|im_end|>\n"
+            "<|im_start|>user\nhi<|im_end|>\n"
+            "<|im_start|>assistant\n"
+        )
+
+    def test_multimodal_placeholders(self):
+        msgs = parse_messages(
+            [
+                {
+                    "role": "user",
+                    "content": [
+                        {"type": "text", "text": "what is this? "},
+                        {"type": "image_url", "image_url": {"url": "http://x/i.png"}},
+                    ],
+                }
+            ]
+        )
+        assert isinstance(msgs[0].content[1], MMContentPart)
+        assert msgs[0].content[1].url == "http://x/i.png"
+        out = ChatTemplate(None).apply(msgs)
+        assert "what is this? <|image|>" in out
+
+    def test_tools_serialized(self):
+        tools = [{"type": "function", "function": {"name": "f"}}]
+        out = ChatTemplate(None).apply([Message("user", "q")], tools)
+        assert '"name": "f"' in out
+
+
+class TestResponseHandler:
+    def req(self, **kw):
+        return ServiceRequest(
+            service_request_id="chatcmpl-1", model="m", **kw
+        )
+
+    def test_stream_chat_chunks(self):
+        h, s = ResponseHandler(), CaptureStream()
+        req = self.req(stream=True, messages=[Message("user", "hi")],
+                       include_usage=True)
+        out1 = RequestOutput(
+            service_request_id="chatcmpl-1",
+            outputs=[SequenceOutput(index=0, text="Hel", token_ids=[1])],
+        )
+        assert h.send_delta_to_client(s, req, out1, first_chunk_sent=False)
+        out2 = RequestOutput(
+            service_request_id="chatcmpl-1",
+            outputs=[SequenceOutput(index=0, text="lo", token_ids=[2],
+                                    finish_reason=FinishReason.STOP)],
+            usage=Usage(3, 2), finished=True,
+        )
+        assert h.send_delta_to_client(s, req, out2, first_chunk_sent=True)
+        assert s.chunks[0]["object"] == "chat.completion.chunk"
+        assert s.chunks[0]["choices"][0]["delta"] == {
+            "role": "assistant", "content": "Hel"
+        }
+        assert s.chunks[1]["choices"][0]["delta"] == {"content": "lo"}
+        assert s.chunks[1]["choices"][0]["finish_reason"] == "stop"
+        assert s.chunks[2]["usage"]["total_tokens"] == 5
+        assert s.done
+
+    def test_nonstream_completion_with_logprobs(self):
+        h, s = ResponseHandler(), CaptureStream()
+        req = self.req(prompt="p")
+        lp = LogProb(
+            data=LogProbData("he", 5, -0.1),
+            top_logprobs=[LogProbData("he", 5, -0.1), LogProbData("a", 6, -2.0)],
+        )
+        out = RequestOutput(
+            service_request_id="chatcmpl-1",
+            outputs=[SequenceOutput(index=0, text="hey", token_ids=[5],
+                                    finish_reason=FinishReason.LENGTH,
+                                    logprobs=[lp])],
+            usage=Usage(1, 1), finished=True,
+        )
+        assert h.send_result_to_client(s, req, out)
+        assert s.final["object"] == "text_completion"
+        c = s.final["choices"][0]
+        assert c["text"] == "hey" and c["finish_reason"] == "length"
+        assert c["logprobs"]["tokens"] == ["he"]
+        assert c["logprobs"]["top_logprobs"][0] == {"he": -0.1, "a": -2.0}
+        assert s.final["usage"]["prompt_tokens"] == 1
+
+    def test_error_path(self):
+        h, s = ResponseHandler(), CaptureStream()
+        out = RequestOutput(
+            service_request_id="chatcmpl-1",
+            status=Status(StatusCode.RESOURCE_EXHAUSTED, "full"),
+        )
+        h.send_result_to_client(s, self.req(prompt="p"), out)
+        assert s.error == (StatusCode.RESOURCE_EXHAUSTED, "full")
+
+
+@pytest.fixture
+def sched_env():
+    store = MemoryStore()
+    cfg = ServiceConfig(
+        etcd_addr="memory://unused",
+        heartbeat_interval_s=0.1,
+        master_lease_ttl_s=0.5,
+        num_ordered_output_streams=4,
+        load_balance_policy="RR",
+    )
+    sched = Scheduler(cfg, store=store)
+    # register one prefill + one decode instance
+    for name, t in (("p0", InstanceType.PREFILL), ("d0", InstanceType.DECODE)):
+        m = InstanceMetaInfo(name=name, type=t, rpc_address=f"{name}:9",
+                             http_address=f"{name}:8")
+        store.set(instance_key(m), m.serialize())
+    assert wait_until(lambda: sched.instance_mgr.counts() == (1, 1, 0))
+    yield sched, store
+    sched.stop(drain_timeout_s=0.5)
+    store.close()
+
+
+def step(srid, text, toks, finished=False, reason=FinishReason.NONE, usage=None):
+    return RequestOutput(
+        service_request_id=srid,
+        outputs=[SequenceOutput(index=0, text=text, token_ids=toks,
+                                finish_reason=reason)],
+        usage=usage,
+        finished=finished,
+    )
+
+
+class TestScheduler:
+    def test_schedule_fills_tokens_and_routing(self, sched_env):
+        sched, _ = sched_env
+        req = ServiceRequest(service_request_id="r1", prompt="hello world")
+        st = sched.schedule(req)
+        assert st.ok()
+        assert req.token_ids == ByteTokenizer().encode("hello world")
+        assert req.routing.prefill_name == "p0"
+        assert req.routing.decode_name == "d0"
+        pm = sched.instance_mgr.get_request_metrics("p0")
+        assert pm.prefill_request_num == 1
+
+    def test_chat_template_applied(self, sched_env):
+        sched, _ = sched_env
+        req = ServiceRequest(
+            service_request_id="r1", messages=[Message("user", "hi")]
+        )
+        assert sched.schedule(req).ok()
+        assert "<|im_start|>user" in req.prompt
+        assert req.token_ids
+
+    def test_empty_prompt_rejected(self, sched_env):
+        sched, _ = sched_env
+        st = sched.schedule(ServiceRequest(service_request_id="r1"))
+        assert st.code == StatusCode.INVALID_ARGUMENT
+
+    def test_stream_lifecycle(self, sched_env):
+        sched, _ = sched_env
+        req = ServiceRequest(service_request_id="r1", prompt="abc", stream=True)
+        assert sched.schedule(req).ok()
+        s = CaptureStream()
+        sched.record_new_request(req, s)
+        assert sched.handle_generation(step("r1", "to", [10]))
+        assert sched.handle_generation(
+            step("r1", "k", [11], finished=True, reason=FinishReason.STOP,
+                 usage=Usage(3, 2))
+        )
+        assert wait_until(lambda: s.done)
+        assert [c["choices"][0].get("text") for c in s.chunks[:2]] == ["to", "k"]
+        assert wait_until(lambda: sched.num_inflight == 0)
+        # unknown request now
+        assert not sched.handle_generation(step("r1", "x", [1]))
+        dm = sched.instance_mgr.get_request_metrics("d0")
+        assert dm.decode_request_num == 0 and dm.decode_token_num == 2
+
+    def test_nonstream_accumulates(self, sched_env):
+        sched, _ = sched_env
+        req = ServiceRequest(service_request_id="r2", prompt="abc")
+        assert sched.schedule(req).ok()
+        s = CaptureStream()
+        sched.record_new_request(req, s)
+        sched.handle_generation(step("r2", "foo", [1, 2]))
+        sched.handle_generation(
+            step("r2", "bar", [3], finished=True, reason=FinishReason.STOP,
+                 usage=Usage(3, 3))
+        )
+        assert wait_until(lambda: s.final is not None)
+        assert s.final["choices"][0]["text"] == "foobar"
+        assert s.final["usage"]["completion_tokens"] == 3
+
+    def test_client_disconnect_cancels(self, sched_env):
+        sched, _ = sched_env
+        req = ServiceRequest(service_request_id="r3", prompt="abc", stream=True)
+        assert sched.schedule(req).ok()
+        cancelled = threading.Event()
+        s = CaptureStream(fail_after=1)
+        sched.record_new_request(req, s, cancel_callback=cancelled.set)
+        sched.handle_generation(step("r3", "a", [1]))
+        sched.handle_generation(step("r3", "b", [2]))
+        assert cancelled.wait(5.0)
+        assert wait_until(lambda: sched.num_inflight == 0)
+
+    def test_fail_request_reports_error(self, sched_env):
+        sched, _ = sched_env
+        req = ServiceRequest(service_request_id="r4", prompt="abc")
+        assert sched.schedule(req).ok()
+        s = CaptureStream()
+        sched.record_new_request(req, s)
+        sched.fail_request("r4", StatusCode.UNAVAILABLE, "prefill down")
+        assert wait_until(lambda: s.error is not None)
+        assert s.error[0] == StatusCode.UNAVAILABLE
+
+    def test_offline_parked_under_pressure_and_pumped(self, sched_env):
+        sched, _ = sched_env
+        # saturate the only prefill instance
+        sched.instance_mgr.record_load_metrics_update("p0", LoadMetrics(10, 0.9))
+        req = ServiceRequest(service_request_id="r5", prompt="abc", offline=True)
+        assert sched.schedule(req).ok()
+        assert sched.should_defer_offline(req)
+        dispatched = threading.Event()
+        sched.park_offline(req, dispatched.set)
+        time.sleep(0.25)
+        assert not dispatched.is_set()
+        # pressure clears -> master loop pumps the parked request
+        sched.instance_mgr.record_load_metrics_update("p0", LoadMetrics(0, 0.1))
+        assert dispatched.wait(5.0)
+
+    def test_online_never_deferred(self, sched_env):
+        sched, _ = sched_env
+        sched.instance_mgr.record_load_metrics_update("p0", LoadMetrics(10, 0.9))
+        req = ServiceRequest(service_request_id="r6", prompt="abc", offline=False)
+        assert not sched.should_defer_offline(req)
+
+    def test_heartbeat_plumbs_to_managers(self, sched_env):
+        sched, _ = sched_env
+        from xllm_service_tpu.common.hashing import prefix_block_hashes
+        from xllm_service_tpu.common.types import KvCacheEvent, LatencyMetrics
+
+        toks = list(range(sched.kvcache_mgr.block_size))
+        h = prefix_block_hashes(toks, sched.kvcache_mgr.block_size)[0]
+        sched.handle_instance_heartbeat(
+            "p0",
+            load_metrics=LoadMetrics(2, 0.3),
+            latency_metrics=LatencyMetrics(120, 40),
+            cache_event=KvCacheEvent(stored_cache={h}),
+        )
+        assert sched.kvcache_mgr.lookup(h).hbm_instance_set == {"p0"}
+        assert sched.instance_mgr.get_load_metrics()["p0"].waiting_requests_num == 2
+        assert sched.instance_mgr.get_latency_metrics("p0").recent_max_ttft == 120
+
+    def test_service_request_id_format(self):
+        rid = make_service_request_id("chatcmpl")
+        assert rid.startswith("chatcmpl-")
+        assert len(rid.split("-")) == 3

@@ -1,0 +1,20 @@
+"""API tier: master (HTTP+RPC), instance server, control-plane client, wire protocol."""
+
+from xllm_service_tpu.api.client import HeartbeatLoop, MasterClient
+from xllm_service_tpu.api.fake_engine import FakeEngine
+from xllm_service_tpu.api.master import Master
+from xllm_service_tpu.api.protocol import (
+    augment_forwarded_request,
+    output_from_json,
+    output_to_json,
+)
+
+__all__ = [
+    "HeartbeatLoop",
+    "MasterClient",
+    "FakeEngine",
+    "Master",
+    "augment_forwarded_request",
+    "output_from_json",
+    "output_to_json",
+]

@@ -1,0 +1,144 @@
+"""Instance-side control-plane client.
+
+The embeddable client an engine instance uses to join the cluster —
+register, 3 s heartbeat loop, decode->service token push — mirroring the
+reference's rpc client library (reference: rpc_service/client.{h,cpp}:
+heartbeat loop :59-77, register_instance :85-115) over the JSON protocol in
+api/protocol.py. Heartbeats carry load/latency metrics + KV cache events;
+a `reregister` response (lease lost) triggers automatic re-registration.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional
+
+from xllm_service_tpu.api.http_utils import get_json, post_json
+from xllm_service_tpu.api.protocol import output_to_json
+from xllm_service_tpu.common.types import (
+    InstanceMetaInfo,
+    KvCacheEvent,
+    LatencyMetrics,
+    LoadMetrics,
+    RequestOutput,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class MasterClient:
+    def __init__(self, master_rpc_addr: str):
+        self._addr = master_rpc_addr
+
+    def hello(self, name: str) -> bool:
+        code, resp = post_json(self._addr, "/rpc/hello", {"name": name})
+        return code == 200 and resp.get("ok", False)
+
+    def register(self, meta: InstanceMetaInfo) -> Dict:
+        code, resp = post_json(
+            self._addr, "/rpc/register", {"meta": meta.to_json()}
+        )
+        if code != 200 or not resp.get("ok"):
+            raise RuntimeError(f"register failed: {code} {resp}")
+        return resp
+
+    def heartbeat(
+        self,
+        name: str,
+        load_metrics: Optional[LoadMetrics] = None,
+        latency_metrics: Optional[LatencyMetrics] = None,
+        cache_event: Optional[KvCacheEvent] = None,
+    ) -> Dict:
+        body: Dict = {"name": name}
+        if load_metrics is not None:
+            body["load_metrics"] = load_metrics.to_json()
+        if latency_metrics is not None:
+            body["latency_metrics"] = latency_metrics.to_json()
+        if cache_event is not None and not cache_event.empty():
+            body["cache_event"] = cache_event.to_json()
+        code, resp = post_json(self._addr, "/rpc/heartbeat", body, timeout=10.0)
+        return resp if code == 200 else {"ok": False}
+
+    def push_generations(self, outputs: List[RequestOutput]) -> Dict[str, bool]:
+        """Batched decode->service stream (proto analog:
+        DisaggStreamGenerations, Generations RPC). Returns the per-request
+        continue map; False means the service dropped the request."""
+        if not outputs:
+            return {}
+        code, resp = post_json(
+            self._addr,
+            "/rpc/generations",
+            {"gens": [output_to_json(o) for o in outputs]},
+            timeout=30.0,
+        )
+        return resp.get("cont", {}) if code == 200 else {}
+
+    def instance_info(self, name: str) -> Optional[InstanceMetaInfo]:
+        code, resp = get_json(self._addr, f"/rpc/instance_info?name={name}")
+        return InstanceMetaInfo.from_json(resp) if code == 200 else None
+
+
+class HeartbeatLoop:
+    """Background register+heartbeat driver (reference: client.cpp:59-77).
+
+    Collect callbacks sample the engine's current load/latency/cache-delta
+    at each beat; re-registers when the master reports a lost lease."""
+
+    def __init__(
+        self,
+        client: MasterClient,
+        meta: InstanceMetaInfo,
+        interval_s: float = 3.0,
+        collect_load: Optional[Callable[[], LoadMetrics]] = None,
+        collect_latency: Optional[Callable[[], LatencyMetrics]] = None,
+        collect_cache_event: Optional[Callable[[], KvCacheEvent]] = None,
+    ):
+        self._client = client
+        self._meta = meta
+        self._interval = interval_s
+        self._collect_load = collect_load
+        self._collect_latency = collect_latency
+        self._collect_cache_event = collect_cache_event
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"heartbeat-{meta.name}", daemon=True
+        )
+
+    def start(self) -> None:
+        resp = self._client.register(self._meta)
+        self._interval = float(resp.get("heartbeat_interval_s", self._interval))
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+    def beat_now(self) -> Dict:
+        """One synchronous beat (tests / forced flush)."""
+        return self._beat()
+
+    def _beat(self) -> Dict:
+        resp = self._client.heartbeat(
+            self._meta.name,
+            load_metrics=self._collect_load() if self._collect_load else None,
+            latency_metrics=(
+                self._collect_latency() if self._collect_latency else None
+            ),
+            cache_event=(
+                self._collect_cache_event() if self._collect_cache_event else None
+            ),
+        )
+        if resp.get("reregister"):
+            try:
+                self._client.register(self._meta)
+            except Exception:
+                logger.warning("re-registration failed for %s", self._meta.name)
+        return resp
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self._beat()
+            except Exception:
+                logger.exception("heartbeat failed for %s", self._meta.name)

@@ -1,0 +1,146 @@
+"""Scriptable fake engine for cluster/service integration tests.
+
+The reference's closest analog is examples/rpc_client_test.cpp:44-58 — a
+fake instance that registers and heartbeats forever. This grows that idea
+into a full engine stand-in (SURVEY.md §4 test plan): same interface as
+runtime.engine.InferenceEngine (add_request/cancel/start/stop/metrics/
+cache-event/profiling), but generation is a thread that echoes the prompt
+(or a scripted list) token by token, so service-tier e2e tests exercise the
+real HTTP/RPC/scheduler stack without JAX in the process.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from xllm_service_tpu.common.types import (
+    FinishReason,
+    KvCacheEvent,
+    LatencyMetrics,
+    LoadMetrics,
+    RequestOutput,
+    SequenceOutput,
+    Status,
+    StatusCode,
+    Usage,
+)
+
+
+class FakeEngine:
+    def __init__(
+        self,
+        token_delay_s: float = 0.005,
+        script: Optional[Sequence[int]] = None,
+        ttft_ms: float = 20.0,
+        fail_admission: bool = False,
+    ):
+        self.token_delay_s = token_delay_s
+        self.script = list(script) if script is not None else None
+        self.ttft_ms = ttft_ms
+        self.fail_admission = fail_admission
+        self._cancelled: Dict[str, bool] = {}
+        self._mu = threading.Lock()
+        self._active = 0
+        self._cache_event = KvCacheEvent()
+        self.requests_seen: List = []
+
+    # -- engine interface ---------------------------------------------- #
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    def add_request(self, req) -> None:
+        self.requests_seen.append(req)
+        if self.fail_admission:
+            req.callback(
+                RequestOutput(
+                    request_id=req.request_id,
+                    status=Status(StatusCode.RESOURCE_EXHAUSTED, "no blocks"),
+                    finished=True,
+                )
+            )
+            return
+        t = threading.Thread(target=self._run, args=(req,), daemon=True)
+        with self._mu:
+            self._active += 1
+        t.start()
+
+    def cancel(self, request_id: str) -> None:
+        with self._mu:
+            self._cancelled[request_id] = True
+
+    def get_load_metrics(self) -> LoadMetrics:
+        with self._mu:
+            return LoadMetrics(self._active, min(1.0, 0.1 * self._active))
+
+    def get_latency_metrics(self, window_s: float = 30.0) -> LatencyMetrics:
+        return LatencyMetrics(int(self.ttft_ms), int(self.token_delay_s * 1000))
+
+    def take_cache_event(self) -> KvCacheEvent:
+        with self._mu:
+            ev, self._cache_event = self._cache_event, KvCacheEvent()
+            return ev
+
+    def seed_cache_event(self, ev: KvCacheEvent) -> None:
+        with self._mu:
+            self._cache_event = ev
+
+    def profiling_data(self) -> Tuple[List, List]:
+        ttft = [(n, self.ttft_ms + 0.01 * n) for n in (64, 256, 1024, 4096)]
+        tpot = [
+            (b, t, self.token_delay_s * 1000 + 0.1 * b)
+            for b in (1, 8, 32)
+            for t in (256, 4096)
+        ]
+        return ttft, tpot
+
+    # -- generation ------------------------------------------------------ #
+    def _run(self, req) -> None:
+        try:
+            tokens = (
+                self.script
+                if self.script is not None
+                else list(reversed(req.prompt_token_ids))
+            )
+            n = min(len(tokens), req.sampling.max_new_tokens) or 1
+            tokens = (tokens or [0])[:n]
+            time.sleep(self.ttft_ms / 1000.0)
+            for i, tok in enumerate(tokens):
+                with self._mu:
+                    if self._cancelled.pop(req.request_id, False):
+                        req.callback(
+                            RequestOutput(
+                                request_id=req.request_id,
+                                status=Status(StatusCode.CANCELLED, "cancelled"),
+                                finished=True,
+                                cancelled=True,
+                            )
+                        )
+                        return
+                last = i == len(tokens) - 1
+                out = RequestOutput(
+                    request_id=req.request_id,
+                    outputs=[
+                        SequenceOutput(
+                            index=0,
+                            token_ids=[tok],
+                            finish_reason=(
+                                FinishReason.STOP if last else FinishReason.NONE
+                            ),
+                        )
+                    ],
+                    usage=Usage(len(req.prompt_token_ids), i + 1),
+                    finished=last,
+                )
+                keep = req.callback(out)
+                if keep is False:
+                    return
+                if not last:
+                    time.sleep(self.token_delay_s)
+        finally:
+            with self._mu:
+                self._active -= 1

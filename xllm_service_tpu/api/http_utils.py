@@ -1,0 +1,201 @@
+"""HTTP plumbing shared by the master and instance servers.
+
+Replaces the reference's brpc server/ProgressiveAttachment machinery
+(call_data.h:83-201) with stdlib ThreadingHTTPServer + chunked SSE writes.
+Keep-alive JSON POSTs between tiers reuse an http.client connection per
+(thread, host) — the analog of the reference's cached brpc channels
+(instance_mgr.cpp:334-353).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+
+class QuietHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+    # -- helpers -----------------------------------------------------------
+    def read_json(self) -> Optional[Dict[str, Any]]:
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(n) if n else b"{}"
+            return json.loads(raw.decode("utf-8"))
+        except Exception:
+            return None
+
+    def send_json(self, obj: Any, status: int = 200) -> None:
+        data = json.dumps(obj).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def send_error_json(self, status: int, message: str, etype: str = "invalid_request_error") -> None:
+        self.send_json({"error": {"message": message, "type": etype}}, status)
+
+    def query(self) -> Dict[str, str]:
+        q = parse_qs(urlparse(self.path).query)
+        return {k: v[0] for k, v in q.items()}
+
+    @property
+    def route(self) -> str:
+        return urlparse(self.path).path
+
+
+class SseWriter:
+    """Server-sent-events writer over a chunked HTTP/1.1 response
+    (the ProgressiveAttachment analog, call_data.h:150-193). Thread-safe:
+    scheduler lanes write from their own threads."""
+
+    def __init__(self, handler: BaseHTTPRequestHandler):
+        self._h = handler
+        self._mu = threading.Lock()
+        self.closed = False
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-cache")
+        handler.send_header("Connection", "keep-alive")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.end_headers()
+
+    def _chunk(self, data: bytes) -> bool:
+        with self._mu:
+            if self.closed:
+                return False
+            try:
+                self._h.wfile.write(f"{len(data):X}\r\n".encode() + data + b"\r\n")
+                self._h.wfile.flush()
+                return True
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                self.closed = True
+                return False
+
+    def send(self, payload: Dict[str, Any]) -> bool:
+        return self._chunk(
+            b"data: " + json.dumps(payload).encode("utf-8") + b"\n\n"
+        )
+
+    def send_done(self) -> bool:
+        ok = self._chunk(b"data: [DONE]\n\n")
+        self.close()
+        return ok
+
+    def close(self) -> None:
+        with self._mu:
+            if self.closed:
+                return
+            self.closed = True
+            try:
+                self._h.wfile.write(b"0\r\n\r\n")
+                self._h.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass
+
+
+class HttpServerThread:
+    """One threaded HTTP server on its own accept thread (the reference runs
+    each brpc server on a dedicated thread, master.cpp:38-58)."""
+
+    def __init__(self, host: str, port: int, handler_cls):
+        class _Srv(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+            request_queue_size = 128
+
+        self.server = _Srv((host, port), handler_cls)
+        self.host, self.port = self.server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, name=f"http-{self.port}", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        self._thread.join(timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# outbound JSON client with per-thread connection reuse
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+def _conn_for(addr: str, timeout: float) -> http.client.HTTPConnection:
+    cache: Dict[str, http.client.HTTPConnection] = getattr(_tls, "conns", None) or {}
+    _tls.conns = cache
+    conn = cache.get(addr)
+    if conn is None:
+        host, _, port = addr.partition(":")
+        conn = http.client.HTTPConnection(host, int(port or 80), timeout=timeout)
+        cache[addr] = conn
+    else:
+        conn.timeout = timeout
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
+    return conn
+
+
+def post_json(
+    addr: str, path: str, body: Dict[str, Any], timeout: float = 30.0
+) -> Tuple[int, Dict[str, Any]]:
+    """POST with one retry, but ONLY on send-time failures (stale kept-alive
+    connection). Once the request has been written, a failure is raised, not
+    retried — POSTs here are not idempotent (a re-send would dispatch the
+    same generation twice)."""
+    payload = json.dumps(body).encode("utf-8")
+    for attempt in (0, 1):
+        conn = _conn_for(addr, timeout)
+        try:
+            conn.request(
+                "POST", path, body=payload,
+                headers={"Content-Type": "application/json"},
+            )
+        except Exception:
+            conn.close()
+            getattr(_tls, "conns", {}).pop(addr, None)
+            if attempt:
+                raise
+            continue
+        try:
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, (json.loads(data) if data else {})
+        except Exception:
+            conn.close()
+            getattr(_tls, "conns", {}).pop(addr, None)
+            raise
+    raise RuntimeError("unreachable")
+
+
+def get_json(addr: str, path: str, timeout: float = 30.0) -> Tuple[int, Any]:
+    for attempt in (0, 1):
+        conn = _conn_for(addr, timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            data = resp.read()
+            try:
+                return resp.status, json.loads(data) if data else {}
+            except json.JSONDecodeError:
+                return resp.status, data.decode("utf-8", "replace")
+        except Exception:
+            conn.close()
+            getattr(_tls, "conns", {}).pop(addr, None)
+            if attempt:
+                raise
+    raise RuntimeError("unreachable")

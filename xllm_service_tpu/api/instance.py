@@ -1,0 +1,501 @@
+"""Engine instance server: the TPU engine behind the cluster protocol.
+
+The reference's engine tier is the absent xLLM submodule; this is its
+TPU-native replacement's front door (SURVEY.md §2.3 lists the service-side
+touchpoints that constrain it): per-instance OpenAI HTTP endpoints (the
+service forwards raw JSON to `instance/v1/...`, service.cpp:163-190),
+registration + heartbeats with load/latency/cache events, and the
+decode->service `Generations` push. Detokenization happens here — the
+engine speaks token ids only.
+
+Serves two modes on the same endpoints:
+  * forwarded service traffic (body carries service_request_id+token_ids):
+    ack immediately, stream tokens back via /rpc/generations;
+  * direct client traffic: run locally, return/stream OpenAI JSON itself.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from xllm_service_tpu.api.client import HeartbeatLoop, MasterClient
+from xllm_service_tpu.api.http_utils import (
+    HttpServerThread,
+    QuietHandler,
+    SseWriter,
+)
+from xllm_service_tpu.common.config import EngineConfig
+from xllm_service_tpu.common.shortuuid import generate_uuid
+from xllm_service_tpu.common.types import (
+    InstanceMetaInfo,
+    InstanceType,
+    RequestOutput,
+    StatusCode,
+)
+from xllm_service_tpu.api.protocol import parse_prompt_field
+from xllm_service_tpu.ops.sampling import SamplingParams
+from xllm_service_tpu.service.response_handler import (
+    ResponseHandler,
+    accumulate_sequences,
+)
+from xllm_service_tpu.service.request import ServiceRequest
+from xllm_service_tpu.tokenizer import ChatTemplate, create_tokenizer, parse_messages
+
+logger = logging.getLogger(__name__)
+
+
+def sampling_from_body(body: Dict[str, Any], cfg: EngineConfig) -> SamplingParams:
+    max_tokens = int(
+        body.get("max_tokens") or body.get("max_completion_tokens") or 0
+    )
+    lp = body.get("logprobs")
+    top_lp = int(body.get("top_logprobs", 0) or 0)
+    return SamplingParams(
+        temperature=float(body.get("temperature", 1.0)),
+        top_p=float(body.get("top_p", 1.0)),
+        top_k=int(body.get("top_k", 0) or 0),
+        seed=int(body.get("seed", 0) or 0),
+        logprobs=bool(lp),
+        top_logprobs=top_lp if top_lp else (int(lp) if isinstance(lp, int) else 0),
+        max_new_tokens=max_tokens or cfg.max_new_tokens_default,
+        ignore_eos=bool(body.get("ignore_eos", False)),
+    )
+
+
+class InstanceServer:
+    def __init__(
+        self,
+        engine_cfg: EngineConfig,
+        master_rpc_addr: str = "",
+        host: str = "127.0.0.1",
+        port: int = 0,
+        tokenizer_path: str = "",
+        heartbeat_interval_s: float = 3.0,
+        engine=None,
+    ):
+        # Deferred imports keep jax out of service-only processes.
+        if engine is None:
+            from xllm_service_tpu.runtime.engine import InferenceEngine
+            from xllm_service_tpu.runtime.executor import ModelExecutor
+
+            engine = InferenceEngine(engine_cfg, executor=ModelExecutor(engine_cfg))
+        self.engine = engine
+        self.cfg = engine_cfg
+        self.tokenizer = create_tokenizer(tokenizer_path)
+        self.chat_template = ChatTemplate(self.tokenizer)
+        self._responses = ResponseHandler()
+
+        instance_self = self
+
+        class Handler(QuietHandler):
+            def do_GET(self):
+                instance_self.handle_get(self)
+
+            def do_POST(self):
+                instance_self.handle_post(self)
+
+        self.http = HttpServerThread(host, port, Handler)
+        self.name = engine_cfg.instance_name or f"{host}:{self.http.port}"
+        self.meta = InstanceMetaInfo(
+            name=self.name,
+            rpc_address=f"{host}:{self.http.port}",
+            http_address=f"{host}:{self.http.port}",
+            model_name=engine_cfg.model,
+            type=InstanceType.parse(engine_cfg.instance_type),
+            dp_size=engine_cfg.dp_size,
+            tp_size=engine_cfg.tp_size,
+        )
+        ttft, tpot = self.engine.profiling_data()
+        self.meta.ttft_profiling_data = ttft
+        self.meta.tpot_profiling_data = tpot
+
+        self._master: Optional[MasterClient] = (
+            MasterClient(master_rpc_addr) if master_rpc_addr else None
+        )
+        self._heartbeat: Optional[HeartbeatLoop] = (
+            HeartbeatLoop(
+                self._master,
+                self.meta,
+                interval_s=heartbeat_interval_s,
+                collect_load=self.engine.get_load_metrics,
+                collect_latency=self.engine.get_latency_metrics,
+                collect_cache_event=self.engine.take_cache_event,
+            )
+            if self._master
+            else None
+        )
+        # decode->service push pipeline
+        self._push_q: "queue.Queue[Optional[RequestOutput]]" = queue.Queue()
+        self._push_thread = threading.Thread(
+            target=self._push_loop, name=f"gen-push-{self.name}", daemon=True
+        )
+        # service_request_id -> engine request_id (for /cancel)
+        self._srid_map: Dict[str, str] = {}
+        self._srid_mu = threading.Lock()
+
+    # ------------------------------------------------------------------ #
+    def start(self) -> None:
+        self.engine.start()
+        self.http.start()
+        self._push_thread.start()
+        if self._heartbeat is not None:
+            self._heartbeat.start()
+        logger.info("instance %s serving on :%d", self.name, self.http.port)
+
+    def stop(self) -> None:
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+        self._push_q.put(None)
+        self._push_thread.join(timeout=5.0)
+        self.http.stop()
+        self.engine.stop()
+
+    @property
+    def address(self) -> str:
+        return f"{self.http.host}:{self.http.port}"
+
+    # ------------------------------------------------------------------ #
+    # decode -> service push (proto analog: Generations RPC)
+    # ------------------------------------------------------------------ #
+
+    def _push_loop(self) -> None:
+        while True:
+            out = self._push_q.get()
+            if out is None:
+                return
+            batch = [out]
+            # micro-batch whatever else is queued (DisaggStreamGenerations
+            # carries a list for the same reason)
+            while True:
+                try:
+                    nxt = self._push_q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._push_q.put(None)
+                    break
+                batch.append(nxt)
+            cont = None
+            for backoff in (0.2, 0.5, 1.0, 2.0, 5.0, 10.0):
+                try:
+                    cont = self._master.push_generations(batch)
+                    break
+                except Exception:
+                    # Master briefly unreachable: the batch may hold a
+                    # request's only finished=True marker — retry, don't
+                    # drop (a drop strands the client until its timeout).
+                    time.sleep(backoff)
+            if cont is None:
+                logger.error(
+                    "generations push failed permanently; dropping %d outputs",
+                    len(batch),
+                )
+                continue
+            for srid, keep in cont.items():
+                if not keep:
+                    with self._srid_mu:
+                        rid = self._srid_map.pop(srid, None)
+                    if rid is not None:
+                        self.engine.cancel(rid)
+
+    # ------------------------------------------------------------------ #
+    # HTTP surface
+    # ------------------------------------------------------------------ #
+
+    def handle_get(self, h: QuietHandler) -> None:
+        route = h.route
+        if route == "/hello":
+            h.send_json({"message": f"hello from instance {self.name}"})
+        elif route == "/metrics":
+            lm = self.engine.get_load_metrics()
+            lat = self.engine.get_latency_metrics()
+            body = (
+                "# TYPE xllm_engine_waiting_requests gauge\n"
+                f"xllm_engine_waiting_requests {lm.waiting_requests_num}\n"
+                "# TYPE xllm_engine_kv_cache_usage gauge\n"
+                f"xllm_engine_kv_cache_usage {lm.gpu_cache_usage_perc:.4f}\n"
+                "# TYPE xllm_engine_recent_max_ttft_ms gauge\n"
+                f"xllm_engine_recent_max_ttft_ms {lat.recent_max_ttft}\n"
+                "# TYPE xllm_engine_recent_max_tbt_ms gauge\n"
+                f"xllm_engine_recent_max_tbt_ms {lat.recent_max_tbt}\n"
+            ).encode()
+            h.send_response(200)
+            h.send_header("Content-Type", "text/plain; version=0.0.4")
+            h.send_header("Content-Length", str(len(body)))
+            h.end_headers()
+            h.wfile.write(body)
+        elif route == "/v1/models":
+            h.send_json(
+                {
+                    "object": "list",
+                    "data": [{"id": self.cfg.model, "object": "model"}],
+                }
+            )
+        else:
+            h.send_error_json(404, f"no route {route}")
+
+    def handle_post(self, h: QuietHandler) -> None:
+        route = h.route
+        body = h.read_json()
+        if body is None:
+            h.send_error_json(400, "invalid JSON body")
+            return
+        if route == "/v1/completions":
+            self._serve(h, body, chat=False)
+        elif route == "/v1/chat/completions":
+            self._serve(h, body, chat=True)
+        elif route == "/cancel":
+            srid = body.get("service_request_id", "")
+            with self._srid_mu:
+                rid = self._srid_map.pop(srid, None)
+            if rid is not None:
+                self.engine.cancel(rid)
+            h.send_json({"ok": True, "cancelled": rid is not None})
+        else:
+            h.send_error_json(404, f"no route {route}")
+
+    # ------------------------------------------------------------------ #
+    def _prompt_tokens(self, body: Dict[str, Any], chat: bool) -> List[int]:
+        # Forwarded traffic arrives pre-tokenized (the injection contract,
+        # service.cpp:334-341) — never re-tokenize.
+        if body.get("token_ids"):
+            return [int(t) for t in body["token_ids"]]
+        if chat:
+            prompt = self.chat_template.apply(
+                parse_messages(body.get("messages", [])), body.get("tools")
+            )
+        else:
+            prompt, token_ids, err = parse_prompt_field(body.get("prompt", ""))
+            if err:
+                raise ValueError(err)
+            if token_ids:
+                return token_ids
+        return self.tokenizer.encode(prompt)
+
+    def _serve(self, h: QuietHandler, body: Dict[str, Any], chat: bool) -> None:
+        from xllm_service_tpu.runtime.engine import EngineRequest
+
+        srid = body.get("service_request_id", "")
+        try:
+            token_ids = self._prompt_tokens(body, chat)
+        except (ValueError, TypeError) as e:
+            h.send_error_json(400, str(e))
+            return
+        if not token_ids:
+            h.send_error_json(400, "empty prompt")
+            return
+        sampling = sampling_from_body(body, self.cfg)
+        rid = generate_uuid(16)
+
+        if srid and self._master is not None:
+            # Forwarded mode: ack now, stream back over /rpc/generations.
+            with self._srid_mu:
+                self._srid_map[srid] = rid
+
+            def callback(out: RequestOutput) -> bool:
+                out.service_request_id = srid
+                self._detokenize(out)
+                if out.finished:
+                    with self._srid_mu:
+                        self._srid_map.pop(srid, None)
+                self._push_q.put(out)
+                return True
+
+            self.engine.add_request(
+                EngineRequest(
+                    request_id=rid,
+                    prompt_token_ids=token_ids,
+                    sampling=sampling,
+                    callback=callback,
+                )
+            )
+            h.send_json({"ok": True, "service_request_id": srid, "request_id": rid})
+            return
+
+        # Direct mode: this instance is the whole stack for one request.
+        self._serve_direct(h, body, chat, token_ids, sampling, rid)
+
+    def _serve_direct(
+        self,
+        h: QuietHandler,
+        body: Dict[str, Any],
+        chat: bool,
+        token_ids: List[int],
+        sampling: SamplingParams,
+        rid: str,
+    ) -> None:
+        from xllm_service_tpu.runtime.engine import EngineRequest
+
+        req = ServiceRequest(
+            service_request_id=("chatcmpl-" if chat else "cmpl-") + rid,
+            model=body.get("model", self.cfg.model),
+            stream=bool(body.get("stream", False)),
+            include_usage=bool(
+                (body.get("stream_options") or {}).get("include_usage", False)
+            ),
+            token_ids=token_ids,
+        )
+        if chat:
+            req.messages = parse_messages(body.get("messages", []))
+        else:
+            p = body.get("prompt", "")
+            req.prompt = p if isinstance(p, str) else "".join(p)
+
+        done = threading.Event()
+        acc: List[RequestOutput] = []
+        sse: Optional[SseWriter] = None
+        first_sent = [False]
+
+        if req.stream:
+            sse = SseWriter(h)
+
+            class _Stream:
+                def write(_, payload):
+                    return sse.send(payload)
+
+                def write_done(_):
+                    ok = sse.send_done()
+                    done.set()
+                    return ok
+
+            stream = _Stream()
+
+            def callback(out: RequestOutput) -> bool:
+                self._detokenize(out)
+                ok = self._responses.send_delta_to_client(
+                    stream, req, out, first_sent[0]
+                )
+                first_sent[0] = True
+                if out.finished or not ok:
+                    # Finished, or the client disconnected mid-stream —
+                    # either way the exchange is over; release the handler.
+                    done.set()
+                return ok
+        else:
+
+            def callback(out: RequestOutput) -> bool:
+                self._detokenize(out)
+                acc.append(out)
+                if out.finished:
+                    done.set()
+                return True
+
+        self.engine.add_request(
+            EngineRequest(
+                request_id=rid,
+                prompt_token_ids=token_ids,
+                sampling=sampling,
+                callback=callback,
+            )
+        )
+        if not done.wait(600.0):
+            self.engine.cancel(rid)
+            if sse is None:
+                # Only a never-started exchange can still carry an error
+                # response; an open SSE stream must not get a second head.
+                h.send_error_json(504, "generation timeout")
+            else:
+                sse.close()
+                h.close_connection = True
+            return
+        if not req.stream:
+            self._respond_accumulated(h, req, acc)
+
+    def _respond_accumulated(
+        self, h: QuietHandler, req: ServiceRequest, acc: List[RequestOutput]
+    ) -> None:
+        if acc and not acc[-1].status.ok():
+            code = acc[-1].status.code
+            h.send_error_json(
+                429 if code == StatusCode.RESOURCE_EXHAUSTED else 500,
+                acc[-1].status.message,
+            )
+            return
+        merged: Dict[int, Any] = {}
+        usage = None
+        for out in acc:
+            accumulate_sequences(merged, out)
+            if out.usage:
+                usage = out.usage
+        final = RequestOutput(
+            request_id=req.service_request_id,
+            service_request_id=req.service_request_id,
+            outputs=sorted(merged.values(), key=lambda s: s.index),
+            usage=usage,
+            finished=True,
+        )
+
+        class _Once:
+            def finish(_, payload):
+                h.send_json(payload)
+                return True
+
+            def finish_with_error(_, code, msg):
+                h.send_error_json(500, msg)
+                return True
+
+        self._responses.send_result_to_client(_Once(), req, final)
+
+    def _detokenize(self, out: RequestOutput) -> None:
+        for s in out.outputs:
+            if s.token_ids and not s.text:
+                s.text = self.tokenizer.decode(s.token_ids)
+            for lp in s.logprobs:
+                if not lp.data.token:
+                    lp.data.token = self.tokenizer.id_to_token(lp.data.token_id)
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser("xllm-service-tpu instance")
+    parser.add_argument("--model", default="llama3-tiny")
+    parser.add_argument("--master-rpc-addr", default="")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--tokenizer-path", default="")
+    parser.add_argument("--instance-type", default="MIX")
+    parser.add_argument("--checkpoint-path", default="")
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument("--block-size", type=int, default=128)
+    parser.add_argument("--num-blocks", type=int, default=0)
+    parser.add_argument("--max-running-requests", type=int, default=16)
+    parser.add_argument("--max-seq-len", type=int, default=2048)
+    parser.add_argument(
+        "--prefill-buckets", default="128,256,512,1024,2048",
+        help="comma-separated prefill padding buckets",
+    )
+    args = parser.parse_args(argv)
+    cfg = EngineConfig(
+        model=args.model,
+        checkpoint_path=args.checkpoint_path,
+        instance_type=args.instance_type,
+        dtype=args.dtype,
+        block_size=args.block_size,
+        num_blocks=args.num_blocks,
+        max_running_requests=args.max_running_requests,
+        max_seq_len=args.max_seq_len,
+        prefill_buckets=[int(b) for b in args.prefill_buckets.split(",")],
+    )
+    srv = InstanceServer(
+        cfg,
+        master_rpc_addr=args.master_rpc_addr,
+        host=args.host,
+        port=args.port,
+        tokenizer_path=args.tokenizer_path,
+    )
+    srv.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

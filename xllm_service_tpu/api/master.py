@@ -1,0 +1,508 @@
+"""Master process: OpenAI-compatible HTTP front end + instance-facing RPC.
+
+Composes one Scheduler with two threaded HTTP servers on separate ports —
+the same process shape as the reference master (reference: master.cpp:26-34
+wires Scheduler->RPC->HTTP; :60-102 HTTP server; :104-139 RPC server; two
+server threads at :38-58). The client plane parses OpenAI JSON, schedules,
+injects service fields, and forwards to the prefill instance
+(http_service/service.cpp:286-424, :147-191); the instance plane carries
+registration, heartbeats, and the decode->service token stream
+(rpc_service/service.cpp:107-206).
+
+Divergences by design: registration is a real RPC that writes a leased
+store key (the reference declares RegisterInstance but never overrides it —
+instances write etcd directly; both paths work here), and /metrics serves
+aggregated cluster metrics instead of a bare passthrough
+(service.cpp:452-457), with ?instance= for the passthrough behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from xllm_service_tpu.api.http_utils import (
+    HttpServerThread,
+    QuietHandler,
+    SseWriter,
+    get_json,
+    post_json,
+)
+from xllm_service_tpu.api.protocol import (
+    augment_forwarded_request,
+    output_from_json,
+    parse_prompt_field,
+)
+from xllm_service_tpu.cluster.instance_mgr import instance_key
+from xllm_service_tpu.common.config import ServiceConfig
+from xllm_service_tpu.common.types import (
+    InstanceMetaInfo,
+    KvCacheEvent,
+    LatencyMetrics,
+    LoadMetrics,
+    StatusCode,
+)
+from xllm_service_tpu.coordination.store import CoordinationStore
+from xllm_service_tpu.service import (
+    ClientStream,
+    Scheduler,
+    ServiceRequest,
+    make_service_request_id,
+)
+from xllm_service_tpu.tokenizer import parse_messages
+
+logger = logging.getLogger(__name__)
+
+_HTTP_STATUS = {
+    StatusCode.OK: 200,
+    StatusCode.INVALID_ARGUMENT: 400,
+    StatusCode.DEADLINE_EXCEEDED: 504,
+    StatusCode.RESOURCE_EXHAUSTED: 429,
+    StatusCode.UNAVAILABLE: 503,
+    StatusCode.CANCELLED: 499,
+}
+
+
+class HttpClientStream(ClientStream):
+    """Bridges scheduler lanes to one live HTTP exchange; the handler thread
+    blocks on `done` while lane threads write (reference: StreamCallData +
+    the early done->Run SSE trick, call_data.h:83-92)."""
+
+    def __init__(self, handler: QuietHandler, streaming: bool):
+        self._handler = handler
+        self._streaming = streaming
+        self._sse: Optional[SseWriter] = None
+        self.done = threading.Event()
+        # Set when the handler thread gives up on the exchange (timeout):
+        # any later lane write must be dropped, never land on the socket —
+        # the connection may be serving another request by then.
+        self._abandoned = threading.Event()
+
+    def abandon(self) -> None:
+        self._abandoned.set()
+        self.done.set()
+
+    def _ensure_sse(self) -> SseWriter:
+        if self._sse is None:
+            self._sse = SseWriter(self._handler)
+        return self._sse
+
+    def write(self, payload: Dict[str, Any]) -> bool:
+        if self._abandoned.is_set():
+            return False
+        if not self._streaming:
+            return True  # non-stream accumulates in the scheduler
+        return self._ensure_sse().send(payload)
+
+    def write_done(self) -> bool:
+        ok = True
+        if self._streaming and not self._abandoned.is_set():
+            ok = self._ensure_sse().send_done()
+        self.done.set()
+        return ok
+
+    def finish(self, payload: Dict[str, Any]) -> bool:
+        if self._abandoned.is_set():
+            return False
+        try:
+            self._handler.send_json(payload)
+            return True
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            return False
+        finally:
+            self.done.set()
+
+    def finish_with_error(self, code: StatusCode, message: str) -> bool:
+        if self._abandoned.is_set():
+            return False
+        try:
+            if self._streaming and self._sse is not None:
+                ok = self._sse.send(
+                    {"error": {"message": message, "code": int(code)}}
+                )
+                self._sse.close()
+                return ok
+            self._handler.send_error_json(
+                _HTTP_STATUS.get(code, 500), message, "service_error"
+            )
+            return True
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            return False
+        finally:
+            self.done.set()
+
+
+class Master:
+    def __init__(
+        self,
+        config: ServiceConfig,
+        store: Optional[CoordinationStore] = None,
+        tokenizer=None,
+    ):
+        self.config = config
+        self.scheduler = Scheduler(config, store=store, tokenizer=tokenizer)
+        self._store = self.scheduler._store
+        # instance name -> lease id held on its registration key
+        self._leases: Dict[str, int] = {}
+        self._leases_mu = threading.Lock()
+        self._request_timeout_s = 600.0
+
+        master = self
+
+        class ClientHandler(QuietHandler):
+            def do_GET(self):
+                master.handle_client_get(self)
+
+            def do_POST(self):
+                master.handle_client_post(self)
+
+        class RpcHandler(QuietHandler):
+            def do_GET(self):
+                master.handle_rpc_get(self)
+
+            def do_POST(self):
+                master.handle_rpc_post(self)
+
+        self.http = HttpServerThread(config.host, config.http_port, ClientHandler)
+        self.rpc = HttpServerThread(config.host, config.rpc_port, RpcHandler)
+
+    # ------------------------------------------------------------------ #
+    def start(self) -> None:
+        self.http.start()
+        self.rpc.start()
+        logger.info(
+            "master serving http=:%d rpc=:%d", self.http.port, self.rpc.port
+        )
+
+    def stop(self) -> None:
+        self.http.stop()
+        self.rpc.stop()
+        self.scheduler.stop()
+
+    @property
+    def http_address(self) -> str:
+        return f"{self.http.host}:{self.http.port}"
+
+    @property
+    def rpc_address(self) -> str:
+        return f"{self.rpc.host}:{self.rpc.port}"
+
+    # ------------------------------------------------------------------ #
+    # client plane
+    # ------------------------------------------------------------------ #
+
+    def handle_client_get(self, h: QuietHandler) -> None:
+        route = h.route
+        if route == "/hello":
+            h.send_json({"message": "hello from xllm-service-tpu master"})
+        elif route == "/v1/models":
+            models = sorted(
+                {
+                    m.model_name
+                    for m in self.scheduler.instance_mgr.list_instances()
+                    if m.model_name
+                }
+            )
+            h.send_json(
+                {
+                    "object": "list",
+                    "data": [
+                        {"id": m, "object": "model", "owned_by": "xllm-service-tpu"}
+                        for m in models
+                    ],
+                }
+            )
+        elif route == "/metrics":
+            self._handle_metrics(h)
+        else:
+            h.send_error_json(404, f"no route {route}")
+
+    def _handle_metrics(self, h: QuietHandler) -> None:
+        inst = h.query().get("instance")
+        if inst:
+            # Passthrough to one instance (reference behavior,
+            # service.cpp:452-457).
+            meta = self.scheduler.instance_mgr.get_instance(inst)
+            if meta is None:
+                h.send_error_json(404, f"unknown instance {inst}")
+                return
+            try:
+                status, body = get_json(meta.http_address, "/metrics")
+                h.send_json(body if isinstance(body, dict) else {"raw": body}, status)
+            except Exception as e:
+                h.send_error_json(502, f"instance unreachable: {e}")
+            return
+        mgr = self.scheduler.instance_mgr
+        load = mgr.get_load_metrics()
+        lines = [
+            "# TYPE xllm_service_inflight_requests gauge",
+            f"xllm_service_inflight_requests {self.scheduler.num_inflight}",
+            "# TYPE xllm_service_is_master gauge",
+            f"xllm_service_is_master {int(self.scheduler.is_master)}",
+            "# TYPE xllm_instance_waiting_requests gauge",
+        ]
+        for name, m in sorted(load.items()):
+            lines.append(
+                f'xllm_instance_waiting_requests{{instance="{name}"}} '
+                f"{m.waiting_requests_num}"
+            )
+        lines.append("# TYPE xllm_instance_kv_cache_usage gauge")
+        for name, m in sorted(load.items()):
+            lines.append(
+                f'xllm_instance_kv_cache_usage{{instance="{name}"}} '
+                f"{m.gpu_cache_usage_perc:.4f}"
+            )
+        body = ("\n".join(lines) + "\n").encode()
+        h.send_response(200)
+        h.send_header("Content-Type", "text/plain; version=0.0.4")
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)
+
+    def handle_client_post(self, h: QuietHandler) -> None:
+        route = h.route
+        if route == "/v1/completions":
+            self._serve_generation(h, chat=False)
+        elif route == "/v1/chat/completions":
+            self._serve_generation(h, chat=True)
+        elif route == "/v1/embeddings":
+            # The reference rejects embeddings outright (service.cpp:441-442).
+            h.send_error_json(501, "embeddings not supported yet")
+        else:
+            h.send_error_json(404, f"no route {route}")
+
+    def _parse_request(
+        self, body: Dict[str, Any], chat: bool
+    ) -> ServiceRequest:
+        req = ServiceRequest(
+            service_request_id=make_service_request_id(
+                "chatcmpl" if chat else "cmpl"
+            ),
+            model=body.get("model", ""),
+            stream=bool(body.get("stream", False)),
+            include_usage=bool(
+                (body.get("stream_options") or {}).get("include_usage", False)
+            ),
+            echo=bool(body.get("echo", False)),
+            offline=bool(body.get("offline", False)),
+            n=int(body.get("n", 1)),
+            max_tokens=int(
+                body.get("max_tokens")
+                or body.get("max_completion_tokens")
+                or 0
+            ),
+            temperature=float(body.get("temperature", 1.0)),
+            top_p=float(body.get("top_p", 1.0)),
+        )
+        if chat:
+            req.messages = parse_messages(body.get("messages", []))
+            req.tools = body.get("tools")
+            req.top_logprobs = int(body.get("top_logprobs", 0) or 0)
+            if body.get("logprobs"):
+                req.logprobs = max(1, req.top_logprobs)
+        else:
+            text, token_ids, err = parse_prompt_field(body.get("prompt", ""))
+            if err:
+                raise ValueError(err)
+            req.prompt = text
+            req.token_ids = token_ids
+            lp = body.get("logprobs")
+            req.logprobs = int(lp) if lp is not None else None
+        return req
+
+    def _serve_generation(self, h: QuietHandler, chat: bool) -> None:
+        body = h.read_json()
+        if body is None:
+            h.send_error_json(400, "invalid JSON body")
+            return
+        if chat and not body.get("messages"):
+            h.send_error_json(400, "messages is required")
+            return
+        if not chat and not body.get("prompt"):
+            h.send_error_json(400, "prompt is required")
+            return
+        try:
+            req = self._parse_request(body, chat)
+        except (ValueError, TypeError) as e:
+            h.send_error_json(400, str(e))
+            return
+        status = self.scheduler.schedule(req)
+        if not status.ok():
+            h.send_error_json(
+                _HTTP_STATUS.get(status.code, 500), status.message
+            )
+            return
+
+        meta = self.scheduler.instance_mgr.get_instance(req.routing.prefill_name)
+        if meta is None:
+            h.send_error_json(503, "prefill instance vanished")
+            return
+        stream = HttpClientStream(h, req.stream)
+        self.scheduler.record_new_request(
+            req, stream, cancel_callback=lambda: self._cancel_on_instance(req)
+        )
+
+        path = "/v1/chat/completions" if chat else "/v1/completions"
+        fwd = augment_forwarded_request(
+            body, req.service_request_id, req.token_ids, req.routing
+        )
+
+        def dispatch() -> None:
+            # Forward to the prefill instance (reference: service.cpp:147-191,
+            # ack-mode: tokens return via /rpc/generations).
+            try:
+                code, resp = post_json(meta.http_address, path, fwd, timeout=30.0)
+                if code != 200:
+                    self.scheduler.fail_request(
+                        req.service_request_id,
+                        StatusCode.UNAVAILABLE,
+                        f"prefill rejected: {resp}",
+                    )
+            except Exception as e:
+                self.scheduler.fail_request(
+                    req.service_request_id,
+                    StatusCode.UNAVAILABLE,
+                    f"prefill unreachable: {e}",
+                )
+
+        if self.scheduler.should_defer_offline(req):
+            self.scheduler.park_offline(req, dispatch)
+        else:
+            dispatch()
+        # Hold the exchange open until the scheduler finishes it.
+        if not stream.done.wait(self._request_timeout_s):
+            self.scheduler.fail_request(
+                req.service_request_id, StatusCode.DEADLINE_EXCEEDED, "timeout"
+            )
+            if not stream.done.wait(5.0):
+                # The lane never ran: drop the exchange without a response
+                # and make sure no late write can reach a reused socket.
+                stream.abandon()
+                h.close_connection = True
+
+    def _cancel_on_instance(self, req: ServiceRequest) -> None:
+        for name in {req.routing.prefill_name, req.routing.decode_name}:
+            meta = self.scheduler.instance_mgr.get_instance(name)
+            if meta is None:
+                continue
+            try:
+                post_json(
+                    meta.http_address,
+                    "/cancel",
+                    {"service_request_id": req.service_request_id},
+                    timeout=5.0,
+                )
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------ #
+    # instance plane
+    # ------------------------------------------------------------------ #
+
+    def handle_rpc_get(self, h: QuietHandler) -> None:
+        route = h.route
+        mgr = self.scheduler.instance_mgr
+        if route == "/rpc/instance_info":
+            name = h.query().get("name", "")
+            meta = mgr.get_instance(name)
+            if meta is None:
+                h.send_error_json(404, f"unknown instance {name}")
+            else:
+                h.send_json(meta.to_json())
+        elif route == "/rpc/static_prefill_list":
+            h.send_json({"instances": mgr.prefill_instances()})
+        elif route == "/rpc/static_decode_list":
+            h.send_json({"instances": mgr.decode_instances()})
+        else:
+            h.send_error_json(404, f"no route {route}")
+
+    def handle_rpc_post(self, h: QuietHandler) -> None:
+        route = h.route
+        body = h.read_json()
+        if body is None:
+            h.send_error_json(400, "invalid JSON body")
+            return
+        if route == "/rpc/hello":
+            h.send_json({"ok": True, "name": body.get("name", "")})
+        elif route == "/rpc/register":
+            self._handle_register(h, body)
+        elif route == "/rpc/heartbeat":
+            self._handle_heartbeat(h, body)
+        elif route == "/rpc/generations":
+            self._handle_generations(h, body)
+        else:
+            h.send_error_json(404, f"no route {route}")
+
+    def _handle_register(self, h: QuietHandler, body: Dict[str, Any]) -> None:
+        try:
+            meta = InstanceMetaInfo.from_json(body.get("meta", body))
+        except Exception as e:
+            h.send_error_json(400, f"bad meta: {e}")
+            return
+        if not meta.name:
+            h.send_error_json(400, "meta.name required")
+            return
+        ttl = 3.0 * self.config.heartbeat_interval_s
+        lease = self._store.grant_lease(ttl)
+        self._store.set(instance_key(meta), meta.serialize(), lease_id=lease)
+        with self._leases_mu:
+            # A stale prior lease is left to expire on its own; revoking it
+            # here would delete the key the new lease now owns.
+            self._leases[meta.name] = lease
+        h.send_json(
+            {
+                "ok": True,
+                "lease_ttl_s": ttl,
+                "heartbeat_interval_s": self.config.heartbeat_interval_s,
+            }
+        )
+
+    def _handle_heartbeat(self, h: QuietHandler, body: Dict[str, Any]) -> None:
+        name = body.get("name", "")
+        with self._leases_mu:
+            lease = self._leases.get(name)
+        alive = lease is not None and self._store.keepalive(lease)
+        if not alive or self.scheduler.instance_mgr.get_instance(name) is None:
+            # Lease lost (or this replica never saw the registration):
+            # tell the engine to re-register (the etcd-expiry analog).
+            h.send_json({"ok": False, "reregister": True})
+            return
+        load = body.get("load_metrics")
+        lat = body.get("latency_metrics")
+        cache = body.get("cache_event")
+        self.scheduler.handle_instance_heartbeat(
+            name,
+            load_metrics=LoadMetrics.from_json(load) if load else None,
+            latency_metrics=LatencyMetrics.from_json(lat) if lat else None,
+            cache_event=KvCacheEvent.from_json(cache) if cache else None,
+        )
+        h.send_json({"ok": True})
+
+    def _handle_generations(self, h: QuietHandler, body: Dict[str, Any]) -> None:
+        cont: Dict[str, bool] = {}
+        for j in body.get("gens", []):
+            try:
+                out = output_from_json(j)
+            except Exception:
+                continue
+            cont[out.service_request_id] = self.scheduler.handle_generation(out)
+        h.send_json({"cont": cont})
+
+
+def main(argv=None) -> None:
+    logging.basicConfig(level=logging.INFO)
+    config = ServiceConfig.from_args(argv)
+    master = Master(config)
+    master.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        master.stop()
+
+
+if __name__ == "__main__":
+    main()

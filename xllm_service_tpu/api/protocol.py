@@ -1,0 +1,158 @@
+"""Wire protocol for the service <-> instance control plane.
+
+The reference speaks protobuf over brpc (proto/xllm_rpc_service.proto:
+HeartbeatRequest :60-65, DisaggStreamGeneration(s) :120-136, service
+:138-149) plus OpenAI JSON over HTTP with three injected fields
+(service_request_id, token_ids, routing — http_service/service.cpp:334-341,
+:405-412). This stack keeps the exact message shapes but carries them as
+JSON over HTTP: one serialization across client, control, and coordination
+planes, zero codegen, and the payloads are the same dicts the store
+replicates.
+
+Endpoints (instance-facing, on the master's rpc_port — mirrors the proto
+service methods):
+  POST /rpc/hello          {name}                          -> {ok}
+  POST /rpc/register       {meta}                          -> {ok, lease_ttl_s}
+  POST /rpc/heartbeat      {name, load_metrics?, latency_metrics?,
+                            cache_event?}                  -> {ok, reregister?}
+  POST /rpc/generations    {gens: [RequestOutput...]}      -> {cont: {srid: bool}}
+  GET  /rpc/instance_info?name=                            -> meta
+  GET  /rpc/static_prefill_list                            -> {instances: [...]}
+  GET  /rpc/static_decode_list                             -> {instances: [...]}
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from xllm_service_tpu.common.types import (
+    FinishReason,
+    LogProb,
+    LogProbData,
+    RequestOutput,
+    SequenceOutput,
+    Status,
+    StatusCode,
+    Usage,
+)
+
+# ---------------------------------------------------------------------------
+# RequestOutput serde (proto analog: DisaggStreamGeneration, proto:120-136)
+# ---------------------------------------------------------------------------
+
+
+def _logprob_to_json(lp: LogProb) -> Dict[str, Any]:
+    return {
+        "token": lp.data.token,
+        "token_id": lp.data.token_id,
+        "logprob": lp.data.logprob,
+        "top_logprobs": [
+            {"token": t.token, "token_id": t.token_id, "logprob": t.logprob}
+            for t in lp.top_logprobs
+        ],
+    }
+
+
+def _logprob_from_json(j: Dict[str, Any]) -> LogProb:
+    return LogProb(
+        data=LogProbData(j.get("token", ""), int(j.get("token_id", 0)),
+                         float(j.get("logprob", 0.0))),
+        top_logprobs=[
+            LogProbData(t.get("token", ""), int(t.get("token_id", 0)),
+                        float(t.get("logprob", 0.0)))
+            for t in j.get("top_logprobs", [])
+        ],
+    )
+
+
+def output_to_json(out: RequestOutput) -> Dict[str, Any]:
+    j: Dict[str, Any] = {
+        "request_id": out.request_id,
+        "service_request_id": out.service_request_id,
+        "status_code": int(out.status.code),
+        "status_message": out.status.message,
+        "finished": out.finished,
+        "cancelled": out.cancelled,
+        "outputs": [
+            {
+                "index": s.index,
+                "text": s.text,
+                "token_ids": list(s.token_ids),
+                "finish_reason": s.finish_reason.to_string(),
+                "logprobs": [_logprob_to_json(lp) for lp in s.logprobs],
+            }
+            for s in out.outputs
+        ],
+    }
+    if out.usage is not None:
+        j["usage"] = {
+            "num_prompt_tokens": out.usage.num_prompt_tokens,
+            "num_generated_tokens": out.usage.num_generated_tokens,
+        }
+    return j
+
+
+def output_from_json(j: Dict[str, Any]) -> RequestOutput:
+    usage = None
+    if "usage" in j and j["usage"] is not None:
+        usage = Usage(
+            num_prompt_tokens=int(j["usage"].get("num_prompt_tokens", 0)),
+            num_generated_tokens=int(j["usage"].get("num_generated_tokens", 0)),
+        )
+    outputs = []
+    for s in j.get("outputs", []):
+        fr = s.get("finish_reason")
+        outputs.append(
+            SequenceOutput(
+                index=int(s.get("index", 0)),
+                text=s.get("text", ""),
+                token_ids=[int(t) for t in s.get("token_ids", [])],
+                finish_reason=FinishReason(fr) if fr else FinishReason.NONE,
+                logprobs=[_logprob_from_json(lp) for lp in s.get("logprobs", [])],
+            )
+        )
+    return RequestOutput(
+        request_id=j.get("request_id", ""),
+        service_request_id=j.get("service_request_id", ""),
+        status=Status(StatusCode(int(j.get("status_code", 0))),
+                      j.get("status_message", "")),
+        outputs=outputs,
+        usage=usage,
+        finished=bool(j.get("finished", False)),
+        cancelled=bool(j.get("cancelled", False)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Forwarded-request augmentation (reference: service.cpp:334-341, 405-412)
+# ---------------------------------------------------------------------------
+
+
+def parse_prompt_field(prompt: Any) -> "tuple[str, List[int], str]":
+    """OpenAI `prompt` accepts a string or an array of token ids.
+    Returns (text, token_ids, error); exactly one of text/token_ids is
+    filled on success. Batched string arrays are rejected explicitly."""
+    if isinstance(prompt, str):
+        return prompt, [], ""
+    if isinstance(prompt, list):
+        if not prompt:
+            return "", [], "prompt is empty"
+        if all(isinstance(t, int) for t in prompt):
+            return "", [int(t) for t in prompt], ""
+        return "", [], "batched string prompts are not supported; send one string"
+    return "", [], "prompt must be a string or an array of token ids"
+
+
+def augment_forwarded_request(
+    body: Dict[str, Any],
+    service_request_id: str,
+    token_ids: List[int],
+    routing,
+) -> Dict[str, Any]:
+    """Inject the service-side fields so the engine skips re-tokenization
+    and knows its PD pair."""
+    fwd = dict(body)
+    fwd["service_request_id"] = service_request_id
+    fwd["token_ids"] = list(token_ids)
+    fwd["routing"] = routing.to_json()
+    return fwd

@@ -166,6 +166,9 @@ class InstanceMetaInfo:
     name: str = ""
     rpc_address: str = ""
     http_address: str = ""
+    # Served model id, surfaced through /v1/models (engine-side metadata the
+    # reference never carries because its engines are absent).
+    model_name: str = ""
     type: InstanceType = InstanceType.DEFAULT
     cluster_ids: List[int] = field(default_factory=list)
     addrs: List[str] = field(default_factory=list)
@@ -187,6 +190,7 @@ class InstanceMetaInfo:
             "name": self.name,
             "rpc_address": self.rpc_address,
             "http_address": self.http_address,
+            "model": self.model_name,
             "type": int(self.type),
             "addrs": self.addrs,
             "cluster_ids": self.cluster_ids,
@@ -206,6 +210,7 @@ class InstanceMetaInfo:
             name=j.get("name", ""),
             rpc_address=j.get("rpc_address", ""),
             http_address=j.get("http_address", ""),
+            model_name=j.get("model", ""),
             type=InstanceType(int(j.get("type", 0))),
             cluster_ids=[int(x) for x in j.get("cluster_ids", [])],
             addrs=list(j.get("addrs", [])),

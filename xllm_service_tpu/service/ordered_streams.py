@@ -1,0 +1,64 @@
+"""Per-request ordered output executors.
+
+The reference guarantees per-request token order by hashing each request to
+one of 128 single-thread pools (reference: scheduler.h:112-117, dispatch at
+scheduler.cpp:312-333). Same design: N worker threads, each owning a FIFO;
+a request is pinned to one lane for its lifetime, so its callbacks are
+serialized while different requests fan out across lanes.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional
+
+
+class OrderedStreams:
+    def __init__(self, num_streams: int = 128, queue_capacity: int = 4096):
+        self._num = max(1, num_streams)
+        self._queues: List["queue.Queue[Optional[Callable[[], None]]]"] = [
+            queue.Queue(maxsize=queue_capacity) for _ in range(self._num)
+        ]
+        self._threads = [
+            threading.Thread(
+                target=self._run, args=(q,), name=f"ordered-out-{i}", daemon=True
+            )
+            for i, q in enumerate(self._queues)
+        ]
+        self._next = 0
+        self._mu = threading.Lock()
+        for t in self._threads:
+            t.start()
+
+    @property
+    def num_streams(self) -> int:
+        return self._num
+
+    def assign(self) -> int:
+        """Pick a lane for a new request (round-robin,
+        reference: scheduler.cpp:209-214)."""
+        with self._mu:
+            idx = self._next % self._num
+            self._next += 1
+            return idx
+
+    def submit(self, lane: int, fn: Callable[[], None]) -> None:
+        self._queues[lane % self._num].put(fn)
+
+    @staticmethod
+    def _run(q: "queue.Queue[Optional[Callable[[], None]]]") -> None:
+        while True:
+            fn = q.get()
+            if fn is None:
+                return
+            try:
+                fn()
+            except Exception:
+                pass  # a client callback failure must not kill the lane
+
+    def shutdown(self) -> None:
+        for q in self._queues:
+            q.put(None)
+        for t in self._threads:
+            t.join(timeout=2.0)

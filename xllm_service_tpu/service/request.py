@@ -1,0 +1,107 @@
+"""Service-side request record + tracer.
+
+Reference: xllm_service/request/request.h:25-63 (the record) and
+http_service/request_tracer.{h,cpp} (JSONL per-request I/O tracing gated by
+--enable_request_trace, hooked into every stream write).
+The `offline` flag here is consumed by hybrid online/offline admission in
+the scheduler — in the reference it exists but nothing reads it
+(request.h:38; README.md:40 roadmap).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from xllm_service_tpu.common.shortuuid import generate_service_request_id
+from xllm_service_tpu.common.types import Routing
+from xllm_service_tpu.tokenizer.chat_template import Message
+
+# 'method-threadid-uuid22' (reference: service.cpp:41-48).
+make_service_request_id = generate_service_request_id
+
+
+@dataclass
+class ServiceRequest:
+    service_request_id: str = ""
+    model: str = ""
+    stream: bool = False
+    include_usage: bool = False
+    echo: bool = False
+    # Hybrid scheduling priority class; offline work yields to online.
+    offline: bool = False
+    n: int = 1
+    best_of: int = 1
+    logprobs: Optional[int] = None  # completions API: top-k count
+    top_logprobs: int = 0  # chat API
+    max_tokens: int = 0
+    temperature: float = 1.0
+    top_p: float = 1.0
+    prompt: str = ""
+    messages: List[Message] = field(default_factory=list)
+    tools: Optional[List[Dict[str, Any]]] = None
+    token_ids: List[int] = field(default_factory=list)
+    routing: Routing = field(default_factory=Routing)
+    created_time: float = field(default_factory=time.time)
+    # Filled by the scheduler:
+    num_generated_tokens: int = 0
+    estimated_ttft_ms: float = 0.0
+    # Tracing hook (reference: Request::trace_callback, service.cpp:212-218).
+    trace_callback: Optional[Callable[[str, Any], None]] = None
+
+    @property
+    def is_chat(self) -> bool:
+        return bool(self.messages)
+
+    def trace(self, direction: str, payload: Any) -> None:
+        if self.trace_callback is not None:
+            self.trace_callback(direction, payload)
+
+
+class RequestTracer:
+    """Mutex-guarded JSONL appender (reference: request_tracer.cpp:38-62
+    opens trace/trace.json and appends {timestamp, service_request_id,
+    payload} per streamed write)."""
+
+    def __init__(self, trace_dir: str = "trace", enabled: bool = False):
+        self._enabled = enabled
+        self._mu = threading.Lock()
+        self._fh = None
+        if enabled:
+            os.makedirs(trace_dir, exist_ok=True)
+            self._fh = open(
+                os.path.join(trace_dir, "trace.jsonl"), "a", encoding="utf-8"
+            )
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def record(self, service_request_id: str, direction: str, payload: Any) -> None:
+        if not self._enabled or self._fh is None:
+            return
+        entry = {
+            "timestamp_ms": int(time.time() * 1000),
+            "service_request_id": service_request_id,
+            "direction": direction,
+            "payload": payload,
+        }
+        line = json.dumps(entry, ensure_ascii=False, default=str)
+        with self._mu:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def bind(self, service_request_id: str) -> Callable[[str, Any], None]:
+        return lambda direction, payload: self.record(
+            service_request_id, direction, payload
+        )
+
+    def close(self) -> None:
+        with self._mu:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None

@@ -1,0 +1,410 @@
+"""Service-tier orchestration core.
+
+TPU-native redesign of the reference Scheduler
+(reference: xllm_service/scheduler/scheduler.{h,cpp}): owns the tokenizer +
+chat template, the coordination store + master election, the cluster
+managers and routing policy, the request registry, and the ordered output
+lanes. `schedule()` is the request hot path (template -> tokenize -> policy
+-> metrics, scheduler.cpp:73-106); `handle_generation()` the token hot path
+(per-request serialized dispatch, :293-336); the master loop replicates
+cluster state every heartbeat period (:113-121).
+
+Additions over the reference, per SURVEY.md §5/§7:
+  * hybrid online/offline admission — `offline` requests are parked under
+    cluster pressure and re-dispatched when load drops (the reference only
+    declares the flag, request.h:38);
+  * real disconnected-instance pruning on the master loop;
+  * graceful stop that drains instead of the reference's exit(1) handler.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional
+
+from xllm_service_tpu.cluster.global_kvcache_mgr import GlobalKVCacheMgr
+from xllm_service_tpu.cluster.instance_mgr import InstanceMgr
+from xllm_service_tpu.cluster.policies import LoadBalancePolicy, make_policy
+from xllm_service_tpu.common.config import ServiceConfig
+from xllm_service_tpu.common.types import (
+    FinishReason,
+    KvCacheEvent,
+    LatencyMetrics,
+    LoadMetrics,
+    RequestAction,
+    RequestOutput,
+    SequenceOutput,
+    Status,
+    StatusCode,
+    Usage,
+)
+from xllm_service_tpu.coordination.election import MasterElection
+from xllm_service_tpu.coordination.store import CoordinationStore, connect
+from xllm_service_tpu.service.ordered_streams import OrderedStreams
+from xllm_service_tpu.service.request import RequestTracer, ServiceRequest
+from xllm_service_tpu.service.response_handler import (
+    ClientStream,
+    ResponseHandler,
+    accumulate_sequences,
+)
+from xllm_service_tpu.tokenizer import ChatTemplate, Tokenizer, create_tokenizer
+
+logger = logging.getLogger(__name__)
+
+# Park offline work when every prefill candidate has this many waiters.
+OFFLINE_PRESSURE_WAITING = 4
+
+
+@dataclass
+class _RequestState:
+    request: ServiceRequest
+    stream: ClientStream
+    lane: int
+    # api-tier hook to propagate cancellation to the engine instance
+    cancel_callback: Optional[Callable[[], None]] = None
+    first_chunk_sent: bool = False
+    prefill_finished: bool = False
+    # accumulated per-sequence state for non-stream responses
+    acc: Dict[int, SequenceOutput] = field(default_factory=dict)
+    usage: Optional[Usage] = None
+    done: bool = False
+
+
+class Scheduler:
+    def __init__(
+        self,
+        config: ServiceConfig,
+        store: Optional[CoordinationStore] = None,
+        tokenizer: Optional[Tokenizer] = None,
+    ) -> None:
+        self._config = config
+        self._store = store if store is not None else connect(config.etcd_addr)
+        self._tokenizer = tokenizer or create_tokenizer(config.tokenizer_path)
+        self._chat_template = ChatTemplate(self._tokenizer)
+        self._tracer = RequestTracer(config.trace_dir, config.enable_request_trace)
+
+        self._election = MasterElection(
+            self._store,
+            identity=f"{config.host}:{config.http_port}",
+            lease_ttl_s=config.master_lease_ttl_s,
+        )
+        self._election.start()
+        self._instance_mgr = InstanceMgr(
+            self._store,
+            is_master=lambda: self._election.is_master,
+            detect_disconnected_interval_s=(
+                config.detect_disconnected_instance_interval_s
+            ),
+        )
+        self._kvcache_mgr = GlobalKVCacheMgr(
+            self._store,
+            is_master=lambda: self._election.is_master,
+            block_size=config.block_size,
+            murmur_hash3_seed=config.murmur_hash3_seed,
+        )
+        self._policy: LoadBalancePolicy = make_policy(
+            config.load_balance_policy,
+            self._instance_mgr,
+            self._kvcache_mgr,
+            target_ttft_ms=config.target_ttft_ms,
+            target_tpot_ms=config.target_tpot_ms,
+        )
+        self._response_handler = ResponseHandler()
+        self._streams = OrderedStreams(config.num_ordered_output_streams)
+
+        self._mu = threading.Lock()
+        self._requests: Dict[str, _RequestState] = {}
+        # parked offline work: (request, dispatch_callback)
+        self._offline_parked: Deque = deque()
+
+        self._stop = threading.Event()
+        self._master_thread = threading.Thread(
+            target=self._master_loop, name="scheduler-master", daemon=True
+        )
+        self._master_thread.start()
+
+    # ------------------------------------------------------------------ #
+    # lifecycle
+    # ------------------------------------------------------------------ #
+
+    @property
+    def is_master(self) -> bool:
+        return self._election.is_master
+
+    @property
+    def instance_mgr(self) -> InstanceMgr:
+        return self._instance_mgr
+
+    @property
+    def kvcache_mgr(self) -> GlobalKVCacheMgr:
+        return self._kvcache_mgr
+
+    @property
+    def tokenizer(self) -> Tokenizer:
+        return self._tokenizer
+
+    @property
+    def tracer(self) -> RequestTracer:
+        return self._tracer
+
+    @property
+    def num_inflight(self) -> int:
+        with self._mu:
+            return len(self._requests)
+
+    def stop(self, drain_timeout_s: float = 10.0) -> None:
+        """Graceful drain (the reference's SIGINT handler calls exit(1),
+        master.cpp:143-147 — its stop path is dead code)."""
+        deadline = time.monotonic() + drain_timeout_s
+        while self.num_inflight and time.monotonic() < deadline:
+            time.sleep(0.05)
+        self._stop.set()
+        self._master_thread.join(timeout=2.0)
+        self._streams.shutdown()
+        self._instance_mgr.close()
+        self._kvcache_mgr.close()
+        self._election.stop()
+        self._tracer.close()
+
+    def _master_loop(self) -> None:
+        """Heartbeat-period state replication + liveness backstop
+        (reference: update_master_service_heartbeat, scheduler.cpp:113-121)."""
+        period = self._config.heartbeat_interval_s
+        while not self._stop.wait(period):
+            self._pump_offline()
+            if not self._election.is_master:
+                continue
+            try:
+                self._kvcache_mgr.upload_kvcache()
+                self._instance_mgr.upload_load_metrics()
+                for name in self._instance_mgr.prune_disconnected():
+                    self._kvcache_mgr.remove_instance(name)
+            except Exception:
+                logger.exception("master loop iteration failed")
+
+    # ------------------------------------------------------------------ #
+    # request hot path
+    # ------------------------------------------------------------------ #
+
+    def schedule(self, request: ServiceRequest) -> Status:
+        """Template -> tokenize -> route (reference: scheduler.cpp:73-106).
+        Fills request.token_ids, request.routing, request.estimated_ttft_ms."""
+        if request.is_chat and not request.prompt:
+            try:
+                request.prompt = self._chat_template.apply(
+                    request.messages, request.tools
+                )
+            except Exception as e:
+                return Status(StatusCode.INVALID_ARGUMENT, f"chat template: {e}")
+        if not request.token_ids:
+            if not request.prompt:
+                return Status(StatusCode.INVALID_ARGUMENT, "empty prompt")
+            request.token_ids = self._tokenizer.encode(request.prompt)
+        if not request.token_ids:
+            return Status(StatusCode.INVALID_ARGUMENT, "prompt tokenized to nothing")
+
+        request.routing = self._policy.select_instances_pair(request.token_ids)
+        if not request.routing.prefill_name and not request.routing.decode_name:
+            return Status(StatusCode.UNAVAILABLE, "no instances registered")
+        pred = self._instance_mgr.get_time_predictor(request.routing.prefill_name)
+        if pred is not None and pred.has_ttft_model:
+            request.estimated_ttft_ms = pred.predict_ttft(len(request.token_ids))
+        self._instance_mgr.update_request_metrics(
+            request.routing, RequestAction.SCHEDULE, len(request.token_ids)
+        )
+        return Status(StatusCode.OK)
+
+    def should_defer_offline(self, request: ServiceRequest) -> bool:
+        """Hybrid scheduling: park offline work while online traffic keeps
+        every prefill candidate busy."""
+        if not request.offline:
+            return False
+        load = self._instance_mgr.get_load_metrics()
+        candidates = self._instance_mgr.prefill_instances() or list(load)
+        if not candidates:
+            return False
+        return all(
+            load.get(n, LoadMetrics()).waiting_requests_num
+            >= OFFLINE_PRESSURE_WAITING
+            for n in candidates
+        )
+
+    def park_offline(
+        self, request: ServiceRequest, dispatch: Callable[[], None]
+    ) -> None:
+        with self._mu:
+            self._offline_parked.append((request, dispatch))
+
+    def _pump_offline(self) -> None:
+        while True:
+            with self._mu:
+                if not self._offline_parked:
+                    return
+                request, dispatch = self._offline_parked[0]
+            if self.should_defer_offline(request):
+                return
+            with self._mu:
+                self._offline_parked.popleft()
+            try:
+                dispatch()
+            except Exception:
+                logger.exception("offline dispatch failed")
+
+    def record_new_request(
+        self,
+        request: ServiceRequest,
+        stream: ClientStream,
+        cancel_callback: Optional[Callable[[], None]] = None,
+    ) -> None:
+        """Register the response route for a scheduled request
+        (reference: scheduler.cpp:171-266)."""
+        if self._tracer.enabled:
+            request.trace_callback = self._tracer.bind(request.service_request_id)
+            request.trace(
+                "in",
+                {
+                    "model": request.model,
+                    "stream": request.stream,
+                    "prompt_tokens": len(request.token_ids),
+                    "routing": request.routing.to_json(),
+                },
+            )
+        state = _RequestState(
+            request=request,
+            stream=stream,
+            lane=self._streams.assign(),
+            cancel_callback=cancel_callback,
+        )
+        with self._mu:
+            self._requests[request.service_request_id] = state
+
+    # ------------------------------------------------------------------ #
+    # token hot path
+    # ------------------------------------------------------------------ #
+
+    def handle_generation(self, output: RequestOutput) -> bool:
+        """One engine step for one request; serialized per request via its
+        lane (reference: scheduler.cpp:293-336). Returns False when the
+        request is unknown (finished/cancelled) so the caller can stop the
+        upstream stream."""
+        with self._mu:
+            state = self._requests.get(output.service_request_id)
+        if state is None or state.done:
+            return False
+        self._streams.submit(state.lane, lambda: self._deliver(state, output))
+        return True
+
+    def _deliver(self, state: _RequestState, output: RequestOutput) -> None:
+        if state.done:
+            # finish_request/fail_request won the race while this step sat
+            # queued in the lane — never write after the exchange ended.
+            return
+        request = state.request
+        new_tokens = sum(len(seq.token_ids) for seq in output.outputs)
+        if new_tokens:
+            request.num_generated_tokens += new_tokens
+            if not state.prefill_finished:
+                state.prefill_finished = True
+                self._instance_mgr.update_request_metrics(
+                    request.routing,
+                    RequestAction.FINISH_PREFILL,
+                    len(request.token_ids),
+                )
+            self._instance_mgr.update_request_metrics(
+                request.routing, RequestAction.GENERATE, new_tokens
+            )
+
+        if request.stream:
+            ok = self._response_handler.send_delta_to_client(
+                state.stream, request, output, state.first_chunk_sent
+            )
+            state.first_chunk_sent = True
+            if not ok and not output.finished:
+                self._cancel(state)
+                return
+        else:
+            self._accumulate(state, output)
+            if output.finished or not output.status.ok():
+                final = RequestOutput(
+                    request_id=output.request_id,
+                    service_request_id=output.service_request_id,
+                    status=output.status,
+                    outputs=sorted(state.acc.values(), key=lambda s: s.index),
+                    usage=state.usage,
+                    finished=True,
+                )
+                self._response_handler.send_result_to_client(
+                    state.stream, request, final
+                )
+        if output.finished or not output.status.ok():
+            self.finish_request(
+                request.service_request_id,
+                cancelled=not output.status.ok()
+                and output.status.code == StatusCode.CANCELLED,
+            )
+
+    def _accumulate(self, state: _RequestState, output: RequestOutput) -> None:
+        accumulate_sequences(state.acc, output)
+        if output.usage is not None:
+            state.usage = output.usage
+
+    def _cancel(self, state: _RequestState) -> None:
+        """Client went away mid-stream: unwind metrics + tell the engine
+        (reference cancels via the OutputCallback returning false)."""
+        if state.cancel_callback is not None:
+            try:
+                state.cancel_callback()
+            except Exception:
+                pass
+        self.finish_request(state.request.service_request_id, cancelled=True)
+
+    def finish_request(self, service_request_id: str, cancelled: bool = False) -> None:
+        """Terminal bookkeeping (reference: scheduler.cpp:268-291)."""
+        with self._mu:
+            state = self._requests.pop(service_request_id, None)
+        if state is None or state.done:
+            return
+        state.done = True
+        request = state.request
+        action = RequestAction.CANCEL if cancelled else RequestAction.FINISH_DECODE
+        self._instance_mgr.update_request_metrics(
+            request.routing, action, len(request.token_ids)
+        )
+
+    def fail_request(self, service_request_id: str, code: StatusCode, msg: str) -> None:
+        """Error-finish from the API tier (e.g. prefill POST failed —
+        reference: handle_first_response cntl->Failed, service.cpp:101-106)."""
+        with self._mu:
+            state = self._requests.get(service_request_id)
+        if state is None:
+            return
+        self._streams.submit(
+            state.lane,
+            lambda: (
+                state.stream.finish_with_error(code, msg),
+                self.finish_request(service_request_id, cancelled=True),
+            ),
+        )
+
+    # ------------------------------------------------------------------ #
+    # instance-facing plane
+    # ------------------------------------------------------------------ #
+
+    def handle_instance_heartbeat(
+        self,
+        name: str,
+        load_metrics: Optional[LoadMetrics] = None,
+        latency_metrics: Optional[LatencyMetrics] = None,
+        cache_event: Optional[KvCacheEvent] = None,
+    ) -> None:
+        """(reference: scheduler.cpp:123-130)"""
+        if cache_event is not None and not cache_event.empty():
+            self._kvcache_mgr.record_updated_kvcaches(name, cache_event)
+        if load_metrics is not None:
+            self._instance_mgr.record_load_metrics_update(name, load_metrics)
+        if latency_metrics is not None:
+            self._instance_mgr.update_latency_metrics(name, latency_metrics)

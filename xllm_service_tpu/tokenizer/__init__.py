@@ -1,0 +1,25 @@
+"""Tokenization + chat templating (reference: xllm_service/tokenizer/, chat_template/)."""
+
+from xllm_service_tpu.tokenizer.chat_template import (
+    ChatTemplate,
+    Message,
+    MMContentPart,
+    parse_messages,
+)
+from xllm_service_tpu.tokenizer.tokenizer import (
+    ByteTokenizer,
+    HFTokenizer,
+    Tokenizer,
+    create_tokenizer,
+)
+
+__all__ = [
+    "ChatTemplate",
+    "Message",
+    "MMContentPart",
+    "parse_messages",
+    "ByteTokenizer",
+    "HFTokenizer",
+    "Tokenizer",
+    "create_tokenizer",
+]

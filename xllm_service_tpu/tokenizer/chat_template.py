@@ -1,0 +1,125 @@
+"""Chat templating with tool-call and multimodal content support.
+
+The reference renders HF Jinja chat templates through the minja C++ engine
+with a multimodal message model and tool/function JSON
+(reference: xllm_service/chat_template/jinja_chat_template.{h,cpp}:
+Message/MMContent h:30-61, apply() cpp:53-99, mm placeholder serialization
+cpp:101-120). Here the real Jinja path is the tokenizer's own
+`apply_chat_template` (same template source: the model dir's
+tokenizer_config.json / chat_template.jinja), with a deterministic fallback
+template for tokenizer-less runs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Union
+
+from xllm_service_tpu.tokenizer.tokenizer import HFTokenizer, Tokenizer
+
+
+@dataclass
+class MMContentPart:
+    """One multimodal content part (reference: MMContent,
+    jinja_chat_template.h:30-47): type in
+    {text, image_url, video_url, audio_url}."""
+
+    type: str = "text"
+    text: str = ""
+    url: str = ""
+
+    @classmethod
+    def from_json(cls, j: Dict[str, Any]) -> "MMContentPart":
+        t = j.get("type", "text")
+        if t == "text":
+            return cls(type="text", text=j.get("text", ""))
+        payload = j.get(t) or {}
+        return cls(type=t, url=payload.get("url", "") if isinstance(payload, dict) else str(payload))
+
+    def to_json(self) -> Dict[str, Any]:
+        if self.type == "text":
+            return {"type": "text", "text": self.text}
+        return {"type": self.type, self.type: {"url": self.url}}
+
+
+@dataclass
+class Message:
+    """Chat message; content is either a plain string or multimodal parts
+    (reference: Message, jinja_chat_template.h:49-61)."""
+
+    role: str = "user"
+    content: Union[str, List[MMContentPart]] = ""
+
+    @classmethod
+    def from_json(cls, j: Dict[str, Any]) -> "Message":
+        content = j.get("content", "")
+        if isinstance(content, list):
+            return cls(
+                role=j.get("role", "user"),
+                content=[MMContentPart.from_json(p) for p in content],
+            )
+        return cls(role=j.get("role", "user"), content=content or "")
+
+    def flat_text(self) -> str:
+        """Serialize multimodal parts to text with media placeholders
+        (reference: mm placeholder serialization, cpp:101-120)."""
+        if isinstance(self.content, str):
+            return self.content
+        parts = []
+        for p in self.content:
+            if p.type == "text":
+                parts.append(p.text)
+            else:
+                # <|image|> / <|video|> / <|audio|> markers the encoder
+                # stage later resolves against the request's media inputs.
+                marker = p.type.split("_")[0]
+                parts.append(f"<|{marker}|>")
+        return "".join(parts)
+
+    def to_hf(self) -> Dict[str, Any]:
+        return {"role": self.role, "content": self.flat_text()}
+
+
+class ChatTemplate:
+    """apply(messages, tools) -> prompt string
+    (reference: JinjaChatTemplate::apply, jinja_chat_template.cpp:53-99)."""
+
+    def __init__(self, tokenizer: Optional[Tokenizer] = None):
+        self._hf = tokenizer.hf if isinstance(tokenizer, HFTokenizer) else None
+
+    def apply(
+        self,
+        messages: List[Message],
+        tools: Optional[List[Dict[str, Any]]] = None,
+    ) -> str:
+        if self._hf is not None and getattr(self._hf, "chat_template", None):
+            return self._hf.apply_chat_template(
+                [m.to_hf() for m in messages],
+                tools=tools,
+                tokenize=False,
+                add_generation_prompt=True,
+            )
+        return self._fallback(messages, tools)
+
+    @staticmethod
+    def _fallback(
+        messages: List[Message], tools: Optional[List[Dict[str, Any]]]
+    ) -> str:
+        """ChatML-shaped deterministic template for tokenizer-less runs."""
+        import json as _json
+
+        out = []
+        if tools:
+            out.append(
+                "<|im_start|>system\n# Tools\n"
+                + _json.dumps(tools, sort_keys=True)
+                + "<|im_end|>\n"
+            )
+        for m in messages:
+            out.append(f"<|im_start|>{m.role}\n{m.flat_text()}<|im_end|>\n")
+        out.append("<|im_start|>assistant\n")
+        return "".join(out)
+
+
+def parse_messages(raw: List[Dict[str, Any]]) -> List[Message]:
+    return [Message.from_json(j) for j in raw]

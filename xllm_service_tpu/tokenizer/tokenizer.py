@@ -1,0 +1,150 @@
+"""Tokenization tier.
+
+The reference ships three native tokenizer families behind one interface —
+a Rust HF-tokenizers FFI crate, sentencepiece, and a tiktoken BPE
+(reference: xllm_service/tokenizer/tokenizer.h:28-46,
+tokenizer_factory.cpp:9-33, fast_tokenizer.cpp, sentencepiece_tokenizer.cpp,
+tiktoken_tokenizer.cpp). On this stack all three arrive through HF
+`transformers.AutoTokenizer` (whose fast path is the same Rust `tokenizers`
+wheel the reference binds by hand), so the factory dispatch by model-dir
+contents collapses into one adapter; a deterministic byte-level tokenizer
+covers tests and benches with no model files on disk.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+
+class Tokenizer:
+    """Interface (reference: tokenizer.h:28-46)."""
+
+    def encode(self, text: str) -> List[int]:
+        raise NotImplementedError
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        raise NotImplementedError
+
+    def id_to_token(self, token_id: int) -> str:
+        raise NotImplementedError
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        raise NotImplementedError
+
+    @property
+    def vocab_size(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def eos_token_id(self) -> Optional[int]:
+        return None
+
+    @property
+    def bos_token_id(self) -> Optional[int]:
+        return None
+
+
+class ByteTokenizer(Tokenizer):
+    """UTF-8 byte-level tokenizer: id = byte + 3 (0=pad, 1=bos, 2=eos).
+
+    Deterministic, file-free; the test/bench stand-in for a real model
+    tokenizer (SURVEY.md §4: the reference has no such seam and cannot unit
+    test its tokenize path without model dirs on disk)."""
+
+    PAD, BOS, EOS = 0, 1, 2
+    _OFFSET = 3
+
+    def encode(self, text: str) -> List[int]:
+        return [b + self._OFFSET for b in text.encode("utf-8")]
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        # Total over arbitrary ids: a model whose vocab exceeds 259 (e.g. the
+        # random-init test models) may emit any id — fold it onto a byte.
+        data = bytes(
+            (i - self._OFFSET) % 256 for i in ids if i >= self._OFFSET
+        )
+        return data.decode("utf-8", errors="replace")
+
+    def id_to_token(self, token_id: int) -> str:
+        if 0 <= token_id < self._OFFSET:
+            return ["<pad>", "<bos>", "<eos>"][token_id]
+        return chr((token_id - self._OFFSET) % 256)
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        specials = {"<pad>": 0, "<bos>": 1, "<eos>": 2}
+        if token in specials:
+            return specials[token]
+        b = token.encode("utf-8")
+        return b[0] + self._OFFSET if len(b) == 1 else None
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + self._OFFSET
+
+    @property
+    def eos_token_id(self) -> Optional[int]:
+        return self.EOS
+
+    @property
+    def bos_token_id(self) -> Optional[int]:
+        return self.BOS
+
+
+class HFTokenizer(Tokenizer):
+    """Adapter over transformers.AutoTokenizer — the union of the
+    reference's Fast (tokenizer.json), SentencePiece, and Tiktoken families.
+    Encode/decode on HF fast tokenizers is thread-safe; the slow (Python)
+    path is guarded by a lock, replacing the reference's thread-local clones
+    (scheduler.cpp:166-169)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, trust_remote_code=False)
+        self._lock = threading.Lock() if not self._tok.is_fast else None
+
+    def _guarded(self, fn):
+        if self._lock is None:
+            return fn()
+        with self._lock:
+            return fn()
+
+    def encode(self, text: str) -> List[int]:
+        return self._guarded(lambda: self._tok.encode(text, add_special_tokens=False))
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        return self._guarded(
+            lambda: self._tok.decode(list(ids), skip_special_tokens=skip_special_tokens)
+        )
+
+    def id_to_token(self, token_id: int) -> str:
+        return self._guarded(lambda: self._tok.convert_ids_to_tokens(token_id)) or ""
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        tid = self._guarded(lambda: self._tok.convert_tokens_to_ids(token))
+        return None if tid == self._tok.unk_token_id and token != self._tok.unk_token else tid
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._tok)
+
+    @property
+    def eos_token_id(self) -> Optional[int]:
+        return self._tok.eos_token_id
+
+    @property
+    def bos_token_id(self) -> Optional[int]:
+        return self._tok.bos_token_id
+
+    @property
+    def hf(self):
+        return self._tok
+
+
+def create_tokenizer(path: str = "") -> Tokenizer:
+    """Factory (reference: tokenizer_factory.cpp:9-33). Empty path selects
+    the byte tokenizer (tests/bench); a model dir or hub id selects HF."""
+    if not path or path == "byte":
+        return ByteTokenizer()
+    return HFTokenizer(path)
