@@ -22,7 +22,7 @@ def tiny():
 
 
 def _empty_caches(cfg, dtype=jnp.float32):
-    shape = (cfg.num_layers, NUM_BLOCKS, BS, cfg.num_kv_heads, cfg.head_dim)
+    shape = (cfg.num_layers, NUM_BLOCKS, cfg.num_kv_heads, BS, cfg.head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
 
 

@@ -1,0 +1,74 @@
+"""Pallas kernel correctness vs the jnp oracles, run in interpreter mode on
+CPU (the same kernel compiles natively on TPU; bench.py exercises that)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from xllm_service_tpu.ops.attention import paged_attention_gather
+from xllm_service_tpu.ops.pallas.paged_attention import paged_attention_kernel
+
+
+def make_case(
+    rng, R=4, Hq=8, Hkv=4, D=64, BS=16, MB=8, num_blocks=64, dtype=jnp.float32
+):
+    q = jnp.asarray(rng.standard_normal((R, Hq, D)), dtype)
+    k = jnp.asarray(rng.standard_normal((num_blocks, Hkv, BS, D)), dtype)
+    v = jnp.asarray(rng.standard_normal((num_blocks, Hkv, BS, D)), dtype)
+    # distinct random block ids per sequence
+    bt = jnp.asarray(
+        rng.choice(num_blocks, size=(R, MB), replace=False).astype(np.int32)
+    )
+    seq_lens = jnp.asarray(
+        rng.integers(1, MB * BS + 1, size=(R,)).astype(np.int32)
+    )
+    return q, k, v, bt, seq_lens
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("gqa", [1, 4])
+def test_decode_kernel_matches_gather(seed, gqa):
+    rng = np.random.default_rng(seed)
+    Hkv = 4
+    q, k, v, bt, seq_lens = make_case(rng, Hq=Hkv * gqa, Hkv=Hkv)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    ref = paged_attention_gather(q, k, v, bt, seq_lens, scale)
+    out = paged_attention_kernel(q, k, v, bt, seq_lens, scale, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_decode_kernel_edge_lengths():
+    """seq_len = 1 (single token), exactly one block, exactly full table."""
+    rng = np.random.default_rng(2)
+    q, k, v, bt, _ = make_case(rng, R=3, MB=4, BS=16)
+    seq_lens = jnp.asarray([1, 16, 64], jnp.int32)
+    scale = 0.125
+    ref = paged_attention_gather(q, k, v, bt, seq_lens, scale)
+    out = paged_attention_kernel(q, k, v, bt, seq_lens, scale, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_decode_kernel_inactive_slots_zero():
+    """seq_len = 0 rows (inactive decode slots) emit zeros, no DMAs."""
+    rng = np.random.default_rng(4)
+    q, k, v, bt, _ = make_case(rng, R=4, MB=4, BS=16)
+    seq_lens = jnp.asarray([0, 5, 0, 64], jnp.int32)
+    out = paged_attention_kernel(q, k, v, bt, seq_lens, 0.125, interpret=True)
+    out = np.asarray(out)
+    assert np.all(out[0] == 0) and np.all(out[2] == 0)
+    ref = paged_attention_gather(q, k, v, bt, seq_lens, 0.125)
+    np.testing.assert_allclose(out[1], np.asarray(ref)[1], atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(out[3], np.asarray(ref)[3], atol=2e-5, rtol=2e-5)
+
+
+def test_decode_kernel_bf16():
+    rng = np.random.default_rng(3)
+    q, k, v, bt, seq_lens = make_case(rng, dtype=jnp.bfloat16)
+    scale = 0.125
+    ref = paged_attention_gather(q, k, v, bt, seq_lens, scale)
+    out = paged_attention_kernel(q, k, v, bt, seq_lens, scale, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=3e-2, rtol=3e-2,
+    )

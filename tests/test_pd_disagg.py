@@ -1,0 +1,184 @@
+"""PD disaggregation at the engine level: prefill on engine A, KV handoff,
+decode continuation on engine B. Greedy output across the handoff must be
+identical to a single colocated engine (the correctness bar for the
+reference's prefill->decode split, SURVEY.md §2.2)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from xllm_service_tpu.common.config import EngineConfig
+from xllm_service_tpu.ops.sampling import SamplingParams
+from xllm_service_tpu.runtime.engine import EngineRequest, InferenceEngine
+from xllm_service_tpu.runtime.executor import ModelExecutor
+
+BS = 16
+
+
+def make_engine(seed=0, num_blocks=64):
+    cfg = EngineConfig(
+        model="llama3-tiny",
+        dtype="float32",
+        block_size=BS,
+        num_blocks=num_blocks,
+        max_running_requests=4,
+        max_seq_len=256,
+        prefill_buckets=[32, 64, 128, 256],
+    )
+    return InferenceEngine(cfg, executor=ModelExecutor(cfg, init_seed=seed))
+
+
+class Collector:
+    def __init__(self):
+        self.tokens = []
+        self.outputs = []
+        self.finished = threading.Event()
+
+    def __call__(self, out):
+        self.outputs.append(out)
+        for so in out.outputs:
+            self.tokens.extend(so.token_ids)
+        if out.finished:
+            self.finished.set()
+        return True
+
+
+def run(eng, max_steps=100):
+    for _ in range(max_steps):
+        if not eng.has_work():
+            break
+        eng.step()
+
+
+@pytest.fixture(scope="module")
+def engines():
+    # identical init_seed => identical weights on both sides
+    return make_engine(seed=0), make_engine(seed=0)
+
+
+@pytest.mark.parametrize("prompt_len", [23, 40, 7])
+def test_handoff_matches_colocated(engines, prompt_len):
+    a, b = engines
+    rng = np.random.RandomState(prompt_len)
+    prompt = [int(x) for x in rng.randint(0, 500, size=prompt_len)]
+    n_new = 8
+
+    # oracle: colocated run on a fresh engine with the same weights
+    oracle_eng = make_engine(seed=0)
+    c0 = Collector()
+    oracle_eng.add_request(
+        EngineRequest("oracle", list(prompt),
+                      SamplingParams(temperature=0.0, max_new_tokens=n_new), c0)
+    )
+    run(oracle_eng)
+    assert c0.finished.is_set()
+
+    # disaggregated: prefill on A with handoff, decode on B
+    handoffs = []
+    ca, cb = Collector(), Collector()
+    a.add_request(
+        EngineRequest(
+            "req-a", list(prompt),
+            SamplingParams(temperature=0.0, max_new_tokens=n_new), ca,
+            prefill_only=True, handoff=handoffs.append,
+        )
+    )
+    run(a)
+    assert len(handoffs) == 1
+    h = handoffs[0]
+    assert ca.tokens == [h.first_token]
+    assert h.token_ids == prompt + [h.first_token]
+    assert h.num_full_blocks == prompt_len // BS
+    # A's slot + block refs released
+    assert not a._running and a.block_mgr.usage < 1.0
+
+    b.import_sequence(
+        EngineRequest(
+            "req-b", list(prompt),
+            SamplingParams(temperature=0.0, max_new_tokens=n_new), cb,
+        ),
+        h,
+    )
+    run(b)
+    assert cb.finished.is_set()
+    combined = ca.tokens + cb.tokens
+    assert combined == c0.tokens, (combined, c0.tokens)
+    # usage accounting survives the handoff
+    final = cb.outputs[-1]
+    assert final.usage.num_prompt_tokens == prompt_len
+    assert final.usage.num_generated_tokens == n_new
+
+
+def test_import_dedups_against_local_cache(engines):
+    a, b = engines
+    rng = np.random.RandomState(99)
+    prompt = [int(x) for x in rng.randint(0, 500, size=3 * BS + 5)]
+
+    handoffs = []
+    ca = Collector()
+    a.add_request(
+        EngineRequest("h1", list(prompt),
+                      SamplingParams(temperature=0.0, max_new_tokens=4), ca,
+                      prefill_only=True, handoff=handoffs.append)
+    )
+    run(a)
+    h = handoffs[0]
+    cb = Collector()
+    b.import_sequence(
+        EngineRequest("d1", list(prompt),
+                      SamplingParams(temperature=0.0, max_new_tokens=4), cb), h
+    )
+    run(b)
+    assert cb.finished.is_set()
+    # same prefix handed off again: B already caches those hashes
+    before = [b.block_mgr.lookup_hash(x) for x in h.block_hashes]
+    assert all(x is not None for x in before)
+    handoffs2 = []
+    ca2 = Collector()
+    a.add_request(
+        EngineRequest("h2", list(prompt),
+                      SamplingParams(temperature=0.0, max_new_tokens=4), ca2,
+                      prefill_only=True, handoff=handoffs2.append)
+    )
+    run(a)
+    cb2 = Collector()
+    b.import_sequence(
+        EngineRequest("d2", list(prompt),
+                      SamplingParams(temperature=0.0, max_new_tokens=4), cb2),
+        handoffs2[0],
+    )
+    run(b)
+    assert cb2.finished.is_set()
+    after = [b.block_mgr.lookup_hash(x) for x in h.block_hashes]
+    assert after == before  # dedup: no re-import under new block ids
+
+
+def test_short_prompt_pure_recompute(engines):
+    """Prompt shorter than one block: no KV migrates, decode side recomputes."""
+    a, b = engines
+    prompt = [5, 6, 7]
+    handoffs = []
+    ca, cb = Collector(), Collector()
+    a.add_request(
+        EngineRequest("s1", list(prompt),
+                      SamplingParams(temperature=0.0, max_new_tokens=5), ca,
+                      prefill_only=True, handoff=handoffs.append)
+    )
+    run(a)
+    h = handoffs[0]
+    assert h.num_full_blocks == 0 and h.kv is None
+    b.import_sequence(
+        EngineRequest("s1d", list(prompt),
+                      SamplingParams(temperature=0.0, max_new_tokens=5), cb), h
+    )
+    run(b)
+    assert cb.finished.is_set()
+    oracle_eng = make_engine(seed=0)
+    c0 = Collector()
+    oracle_eng.add_request(
+        EngineRequest("o", list(prompt),
+                      SamplingParams(temperature=0.0, max_new_tokens=5), c0)
+    )
+    run(oracle_eng)
+    assert ca.tokens + cb.tokens == c0.tokens

@@ -1,0 +1,141 @@
+"""Full-stack PD disaggregation over real sockets: client -> master ->
+prefill instance (real JAX engine) -> KV handoff over HTTP -> decode
+instance -> generations push -> client. Greedy output must match a
+colocated single-instance run (SURVEY.md §3.2/§3.3 with the §2.2 PD split).
+"""
+
+import pytest
+
+from xllm_service_tpu.api import Master
+from xllm_service_tpu.api.instance import InstanceServer
+from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+from xllm_service_tpu.coordination import MemoryStore
+
+from tests.test_api_e2e import http_post, sse_post, wait_until
+
+BLOCK = 16
+
+
+def engine_cfg(name, itype):
+    return EngineConfig(
+        model="llama3-tiny", dtype="float32", block_size=BLOCK,
+        num_blocks=64, max_running_requests=4, max_seq_len=256,
+        prefill_buckets=[32, 64, 128],
+        instance_name=name, instance_type=itype,
+    )
+
+
+@pytest.fixture(scope="module")
+def pd_stack():
+    store = MemoryStore()
+    cfg = ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0,
+        load_balance_policy="RR", block_size=BLOCK,
+    )
+    master = Master(cfg, store=store)
+    master.start()
+    prefill = InstanceServer(
+        engine_cfg("pre0", "PREFILL"), master_rpc_addr=master.rpc_address,
+        heartbeat_interval_s=0.2,
+    )
+    decode = InstanceServer(
+        engine_cfg("dec0", "DECODE"), master_rpc_addr=master.rpc_address,
+        heartbeat_interval_s=0.2,
+    )
+    prefill.start()
+    decode.start()
+    assert wait_until(
+        lambda: master.scheduler.instance_mgr.counts() == (1, 1, 0)
+    )
+    yield master, prefill, decode, store
+    prefill.stop()
+    decode.stop()
+    master.stop()
+    store.close()
+
+
+@pytest.fixture(scope="module")
+def colocated():
+    """Oracle: one MIX instance with identical weights, own master."""
+    store = MemoryStore()
+    cfg = ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0,
+        load_balance_policy="RR", block_size=BLOCK,
+    )
+    master = Master(cfg, store=store)
+    master.start()
+    inst = InstanceServer(
+        engine_cfg("mix0", "MIX"), master_rpc_addr=master.rpc_address,
+        heartbeat_interval_s=0.2,
+    )
+    inst.start()
+    assert wait_until(
+        lambda: sum(master.scheduler.instance_mgr.counts()) == 1
+    )
+    yield master
+    inst.stop()
+    master.stop()
+    store.close()
+
+
+def completion(master, prompt, n=8):
+    code, body = http_post(
+        master.http_address, "/v1/completions",
+        {"model": "llama3-tiny", "prompt": prompt, "max_tokens": n,
+         "temperature": 0.0},
+        timeout=300.0,
+    )
+    assert code == 200, body
+    return body
+
+
+def test_disagg_matches_colocated_long_prompt(pd_stack, colocated):
+    master = pd_stack[0]
+    prompt = "x" * (BLOCK * 3 + 5)  # 3 full blocks migrate, tail recomputes
+    got = completion(master, prompt)
+    want = completion(colocated, prompt)
+    assert got["choices"][0]["text"] == want["choices"][0]["text"]
+    assert got["usage"] == want["usage"]
+
+
+def test_disagg_matches_colocated_short_prompt(pd_stack, colocated):
+    master = pd_stack[0]
+    prompt = "hi"  # no full blocks: pure recompute on the decode side
+    got = completion(master, prompt)
+    want = completion(colocated, prompt)
+    assert got["choices"][0]["text"] == want["choices"][0]["text"]
+
+
+def test_disagg_streaming(pd_stack):
+    master, prefill, decode, _ = pd_stack
+    events = sse_post(
+        master.http_address, "/v1/completions",
+        {"model": "llama3-tiny", "prompt": "y" * 40, "max_tokens": 6,
+         "temperature": 0.0, "stream": True},
+        timeout=300.0,
+    )
+    assert events[-1] == "[DONE]"
+    texts = [e["choices"][0]["text"] for e in events[:-1] if e.get("choices")]
+    assert len(texts) == 6  # first token from prefill + 5 from decode
+
+    # both engines actually participated
+    assert prefill.engine.block_mgr is not decode.engine.block_mgr
+
+
+def test_decode_side_has_imported_blocks(pd_stack):
+    master, prefill, decode, _ = pd_stack
+    prompt = "z" * (BLOCK * 2)
+    completion(master, prompt)
+    ids = master.scheduler.tokenizer.encode(prompt)
+    from xllm_service_tpu.common.hashing import prefix_block_hashes
+
+    hashes = prefix_block_hashes(ids, BLOCK)
+    # the migrated full blocks are committed in the DECODE instance's cache
+    assert wait_until(
+        lambda: all(
+            decode.engine.block_mgr.lookup_hash(h) is not None
+            for h in hashes[:2]
+        )
+    )

@@ -346,3 +346,26 @@ class TestScheduler:
         rid = make_service_request_id("chatcmpl")
         assert rid.startswith("chatcmpl-")
         assert len(rid.split("-")) == 3
+
+
+class TestIncrementalDetokenizer:
+    def test_multibyte_char_across_tokens(self):
+        from xllm_service_tpu.tokenizer.tokenizer import IncrementalDetokenizer
+
+        tok = ByteTokenizer()
+        ids = tok.encode("héllo ✓")  # multi-byte chars
+        d = IncrementalDetokenizer(tok)
+        out = "".join(d.push([i]) for i in ids) + d.flush()
+        assert out == "héllo ✓"
+
+    def test_held_back_bytes_do_not_duplicate(self):
+        from xllm_service_tpu.tokenizer.tokenizer import IncrementalDetokenizer
+
+        tok = ByteTokenizer()
+        ids = tok.encode("✓✓")
+        d = IncrementalDetokenizer(tok)
+        pieces = [d.push([i]) for i in ids]
+        pieces.append(d.flush())
+        assert "".join(pieces) == "✓✓"
+        # no replacement chars leaked
+        assert all("�" not in p for p in pieces)

@@ -64,10 +64,58 @@ class FakeEngine:
                 )
             )
             return
-        t = threading.Thread(target=self._run, args=(req,), daemon=True)
+        if getattr(req, "prefill_only", False) and req.handoff is not None:
+            t = threading.Thread(target=self._run_prefill_only, args=(req,),
+                                 daemon=True)
+        else:
+            t = threading.Thread(target=self._run, args=(req,), daemon=True)
         with self._mu:
             self._active += 1
         t.start()
+
+    def import_sequence(self, req, handoff) -> None:
+        """Continue a handed-off sequence: emit tokens AFTER the first one
+        (mirrors InferenceEngine.import_sequence)."""
+        with self._mu:
+            self._active += 1
+        threading.Thread(
+            target=self._run, args=(req,), kwargs={"skip_first": True},
+            daemon=True,
+        ).start()
+
+    def _run_prefill_only(self, req) -> None:
+        from xllm_service_tpu.runtime.engine import KVHandoff
+
+        try:
+            tokens = (
+                self.script if self.script is not None
+                else list(reversed(req.prompt_token_ids))
+            ) or [0]
+            time.sleep(self.ttft_ms / 1000.0)
+            first = tokens[0]
+            req.callback(
+                RequestOutput(
+                    request_id=req.request_id,
+                    outputs=[SequenceOutput(index=0, token_ids=[first])],
+                    usage=Usage(len(req.prompt_token_ids), 1),
+                    finished=False,
+                )
+            )
+            req.handoff(
+                KVHandoff(
+                    request_id=req.request_id,
+                    token_ids=list(req.prompt_token_ids) + [first],
+                    first_token=first,
+                    first_logprob=0.0,
+                    num_full_blocks=0,
+                    block_hashes=[],
+                    kv=None,
+                    usage_prompt_tokens=len(req.prompt_token_ids),
+                )
+            )
+        finally:
+            with self._mu:
+                self._active -= 1
 
     def cancel(self, request_id: str) -> None:
         with self._mu:
@@ -99,7 +147,7 @@ class FakeEngine:
         return ttft, tpot
 
     # -- generation ------------------------------------------------------ #
-    def _run(self, req) -> None:
+    def _run(self, req, skip_first: bool = False) -> None:
         try:
             tokens = (
                 self.script
@@ -108,6 +156,10 @@ class FakeEngine:
             )
             n = min(len(tokens), req.sampling.max_new_tokens) or 1
             tokens = (tokens or [0])[:n]
+            gen_offset = 0
+            if skip_first:
+                tokens = tokens[1:] or [0]
+                gen_offset = 1
             time.sleep(self.ttft_ms / 1000.0)
             for i, tok in enumerate(tokens):
                 with self._mu:
@@ -133,7 +185,7 @@ class FakeEngine:
                             ),
                         )
                     ],
-                    usage=Usage(len(req.prompt_token_ids), i + 1),
+                    usage=Usage(len(req.prompt_token_ids), gen_offset + i + 1),
                     finished=last,
                 )
                 keep = req.callback(out)

@@ -182,6 +182,58 @@ def post_json(
     raise RuntimeError("unreachable")
 
 
+def post_bytes(
+    addr: str, path: str, data: bytes, timeout: float = 60.0
+) -> Tuple[int, Dict[str, Any]]:
+    """Binary POST (KV handoff payloads). Same send-time-only retry rule as
+    post_json."""
+    for attempt in (0, 1):
+        conn = _conn_for(addr, timeout)
+        try:
+            conn.request(
+                "POST", path, body=data,
+                headers={"Content-Type": "application/octet-stream"},
+            )
+        except Exception:
+            conn.close()
+            getattr(_tls, "conns", {}).pop(addr, None)
+            if attempt:
+                raise
+            continue
+        try:
+            resp = conn.getresponse()
+            body = resp.read()
+            return resp.status, (json.loads(body) if body else {})
+        except Exception:
+            conn.close()
+            getattr(_tls, "conns", {}).pop(addr, None)
+            raise
+    raise RuntimeError("unreachable")
+
+
+def get_raw(
+    addr: str, path: str, timeout: float = 30.0
+) -> Tuple[int, bytes, str]:
+    """GET returning (status, body bytes, content type) — for verbatim
+    passthrough."""
+    for attempt in (0, 1):
+        conn = _conn_for(addr, timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return (
+                resp.status,
+                resp.read(),
+                resp.getheader("Content-Type", "application/octet-stream"),
+            )
+        except Exception:
+            conn.close()
+            getattr(_tls, "conns", {}).pop(addr, None)
+            if attempt:
+                raise
+    raise RuntimeError("unreachable")
+
+
 def get_json(addr: str, path: str, timeout: float = 30.0) -> Tuple[int, Any]:
     for attempt in (0, 1):
         conn = _conn_for(addr, timeout)

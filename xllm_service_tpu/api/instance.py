@@ -27,7 +27,9 @@ from xllm_service_tpu.api.http_utils import (
     HttpServerThread,
     QuietHandler,
     SseWriter,
+    post_bytes,
 )
+from xllm_service_tpu.api.protocol import handoff_from_bytes, handoff_to_bytes
 from xllm_service_tpu.common.config import EngineConfig
 from xllm_service_tpu.common.shortuuid import generate_uuid
 from xllm_service_tpu.common.types import (
@@ -44,6 +46,7 @@ from xllm_service_tpu.service.response_handler import (
 )
 from xllm_service_tpu.service.request import ServiceRequest
 from xllm_service_tpu.tokenizer import ChatTemplate, create_tokenizer, parse_messages
+from xllm_service_tpu.tokenizer.tokenizer import IncrementalDetokenizer
 
 logger = logging.getLogger(__name__)
 
@@ -136,6 +139,13 @@ class InstanceServer:
         # service_request_id -> engine request_id (for /cancel)
         self._srid_map: Dict[str, str] = {}
         self._srid_mu = threading.Lock()
+        # decode-peer address cache (PD disagg handoff target)
+        self._peer_addrs: Dict[str, str] = {}
+        # srid -> set once a generations push carrying it was acked by the
+        # master; the handoff sender waits on this so the decode peer's
+        # tokens can never reach the master before the first token
+        self._push_acked: Dict[str, threading.Event] = {}
+        self._push_acked_mu = threading.Lock()
 
     # ------------------------------------------------------------------ #
     def start(self) -> None:
@@ -196,6 +206,10 @@ class InstanceServer:
                 )
                 continue
             for srid, keep in cont.items():
+                with self._push_acked_mu:
+                    ev = self._push_acked.get(srid)
+                if ev is not None:
+                    ev.set()
                 if not keep:
                     with self._srid_mu:
                         rid = self._srid_map.pop(srid, None)
@@ -240,6 +254,9 @@ class InstanceServer:
 
     def handle_post(self, h: QuietHandler) -> None:
         route = h.route
+        if route == "/kv/import":  # binary body, not JSON
+            self._handle_kv_import(h)
+            return
         body = h.read_json()
         if body is None:
             h.send_error_json(400, "invalid JSON body")
@@ -257,6 +274,145 @@ class InstanceServer:
             h.send_json({"ok": True, "cancelled": rid is not None})
         else:
             h.send_error_json(404, f"no route {route}")
+
+    # ------------------------------------------------------------------ #
+    # PD disaggregation
+    # ------------------------------------------------------------------ #
+
+    def _make_push_callback(
+        self,
+        srid: str,
+        detoks: Optional[Dict[int, IncrementalDetokenizer]] = None,
+    ):
+        if detoks is None:
+            detoks = {}
+
+        def callback(out: RequestOutput) -> bool:
+            out.service_request_id = srid
+            self._detokenize(out, detoks)
+            if out.finished:
+                with self._srid_mu:
+                    self._srid_map.pop(srid, None)
+            self._push_q.put(out)
+            return True
+
+        return callback
+
+    def _resolve_instance_addr(self, name: str) -> str:
+        addr = self._peer_addrs.get(name)
+        if addr:
+            return addr
+        meta = self._master.instance_info(name) if self._master else None
+        if meta is None:
+            return ""
+        self._peer_addrs[name] = meta.http_address
+        return meta.http_address
+
+    def _make_handoff_sender(
+        self,
+        srid: str,
+        decode_name: str,
+        body: Dict,
+        detoks: Optional[Dict[int, IncrementalDetokenizer]] = None,
+    ):
+        from xllm_service_tpu.common.types import Status, StatusCode
+
+        sampling_fields = {
+            k: body[k]
+            for k in (
+                "max_tokens", "max_completion_tokens", "temperature",
+                "top_p", "top_k", "seed", "logprobs", "top_logprobs",
+                "ignore_eos",
+            )
+            if k in body
+        }
+
+        def send(handoff) -> None:
+            # Runs on the engine thread; the POST is cheap relative to a
+            # prefill and backpressures the prefill side naturally.
+            with self._push_acked_mu:
+                acked = self._push_acked.get(srid)
+            err = ""
+            # Cross-instance ordering: the first token must be acked by the
+            # master before the decode peer can start pushing, or a client
+            # could see token 2 before token 1. The event stays in the dict
+            # until AFTER the wait — popping first would race the ack.
+            if acked is not None and not acked.wait(60.0):
+                err = "first-token push never acked by master"
+            with self._push_acked_mu:
+                self._push_acked.pop(srid, None)
+            addr = self._resolve_instance_addr(decode_name) if not err else ""
+            if not err and not addr:
+                err = f"decode instance {decode_name} unknown"
+            if not err:
+                try:
+                    extra = {
+                        "service_request_id": srid,
+                        "sampling": sampling_fields,
+                    }
+                    # Detokenizer carry-over: the decode peer continues from
+                    # this side's exact byte/char position.
+                    d0 = (detoks or {}).get(0)
+                    if d0 is not None:
+                        ids, emitted = d0.export_state()
+                        extra["detok_ids"] = ids
+                        extra["detok_emitted"] = emitted
+                    payload = handoff_to_bytes(handoff, extra)
+                    code, resp = post_bytes(addr, "/kv/import", payload)
+                    if code != 200:
+                        err = f"decode peer rejected handoff: {resp}"
+                except Exception as e:
+                    err = f"decode peer unreachable: {e}"
+            if not err:
+                # Handoff complete: this instance is done with the request
+                # (the decode peer owns cancellation from here).
+                with self._srid_mu:
+                    self._srid_map.pop(srid, None)
+            if err:
+                logger.error("handoff for %s failed: %s", srid, err)
+                out = RequestOutput(
+                    request_id=handoff.request_id,
+                    service_request_id=srid,
+                    status=Status(StatusCode.UNAVAILABLE, err),
+                    finished=True,
+                )
+                with self._srid_mu:
+                    self._srid_map.pop(srid, None)
+                self._push_q.put(out)
+
+        return send
+
+    def _handle_kv_import(self, h: QuietHandler) -> None:
+        from xllm_service_tpu.runtime.engine import EngineRequest
+
+        try:
+            n = int(h.headers.get("Content-Length", 0))
+            data = h.rfile.read(n)
+            handoff, header = handoff_from_bytes(data)
+        except Exception as e:
+            h.send_error_json(400, f"bad handoff payload: {e}")
+            return
+        srid = header.get("service_request_id", "")
+        sampling = sampling_from_body(header.get("sampling", {}), self.cfg)
+        rid = generate_uuid(16)
+        with self._srid_mu:
+            self._srid_map[srid] = rid
+        detoks: Dict[int, IncrementalDetokenizer] = {}
+        if "detok_ids" in header:
+            detoks[0] = IncrementalDetokenizer.from_state(
+                self.tokenizer, header["detok_ids"],
+                header.get("detok_emitted", 0),
+            )
+        self.engine.import_sequence(
+            EngineRequest(
+                request_id=rid,
+                prompt_token_ids=handoff.token_ids[:-1],
+                sampling=sampling,
+                callback=self._make_push_callback(srid, detoks),
+            ),
+            handoff,
+        )
+        h.send_json({"ok": True, "request_id": rid})
 
     # ------------------------------------------------------------------ #
     def _prompt_tokens(self, body: Dict[str, Any], chat: bool) -> List[int]:
@@ -295,24 +451,37 @@ class InstanceServer:
             # Forwarded mode: ack now, stream back over /rpc/generations.
             with self._srid_mu:
                 self._srid_map[srid] = rid
-
-            def callback(out: RequestOutput) -> bool:
-                out.service_request_id = srid
-                self._detokenize(out)
-                if out.finished:
-                    with self._srid_mu:
-                        self._srid_map.pop(srid, None)
-                self._push_q.put(out)
-                return True
-
-            self.engine.add_request(
-                EngineRequest(
-                    request_id=rid,
-                    prompt_token_ids=token_ids,
-                    sampling=sampling,
-                    callback=callback,
+            detoks: Dict[int, IncrementalDetokenizer] = {}
+            callback = self._make_push_callback(srid, detoks)
+            routing = body.get("routing") or {}
+            decode_name = routing.get("decode_name", "")
+            if decode_name and decode_name != self.name:
+                # PD disaggregation: this instance is the prefill side —
+                # emit the first token, then migrate KV to the decode peer
+                # (reference topology: rpc_service/service.h:61-71).
+                with self._push_acked_mu:
+                    self._push_acked[srid] = threading.Event()
+                self.engine.add_request(
+                    EngineRequest(
+                        request_id=rid,
+                        prompt_token_ids=token_ids,
+                        sampling=sampling,
+                        callback=callback,
+                        prefill_only=True,
+                        handoff=self._make_handoff_sender(
+                            srid, decode_name, body, detoks
+                        ),
+                    )
                 )
-            )
+            else:
+                self.engine.add_request(
+                    EngineRequest(
+                        request_id=rid,
+                        prompt_token_ids=token_ids,
+                        sampling=sampling,
+                        callback=callback,
+                    )
+                )
             h.send_json({"ok": True, "service_request_id": srid, "request_id": rid})
             return
 
@@ -350,6 +519,7 @@ class InstanceServer:
         sse: Optional[SseWriter] = None
         first_sent = [False]
 
+        detoks: Dict[int, IncrementalDetokenizer] = {}
         if req.stream:
             sse = SseWriter(h)
 
@@ -365,7 +535,17 @@ class InstanceServer:
             stream = _Stream()
 
             def callback(out: RequestOutput) -> bool:
-                self._detokenize(out)
+                if not out.status.ok() and not out.cancelled:
+                    # Engine-side failure: surface it, don't end as a clean
+                    # empty stream.
+                    sse.send(
+                        {"error": {"message": out.status.message,
+                                   "code": int(out.status.code)}}
+                    )
+                    sse.close()
+                    done.set()
+                    return False
+                self._detokenize(out, detoks)
                 ok = self._responses.send_delta_to_client(
                     stream, req, out, first_sent[0]
                 )
@@ -378,7 +558,7 @@ class InstanceServer:
         else:
 
             def callback(out: RequestOutput) -> bool:
-                self._detokenize(out)
+                self._detokenize(out, detoks)
                 acc.append(out)
                 if out.finished:
                     done.set()
@@ -440,10 +620,20 @@ class InstanceServer:
 
         self._responses.send_result_to_client(_Once(), req, final)
 
-    def _detokenize(self, out: RequestOutput) -> None:
+    def _detokenize(
+        self, out: RequestOutput, detoks: Dict[int, IncrementalDetokenizer]
+    ) -> None:
+        """Per-request incremental detokenization: characters spanning token
+        boundaries are held back until complete (detoks carries one state
+        per sequence index for the request's lifetime)."""
         for s in out.outputs:
             if s.token_ids and not s.text:
-                s.text = self.tokenizer.decode(s.token_ids)
+                d = detoks.get(s.index)
+                if d is None:
+                    d = detoks[s.index] = IncrementalDetokenizer(self.tokenizer)
+                s.text = d.push(s.token_ids)
+                if out.finished:
+                    s.text += d.flush()
             for lp in s.logprobs:
                 if not lp.data.token:
                     lp.data.token = self.tokenizer.id_to_token(lp.data.token_id)

@@ -29,6 +29,7 @@ from xllm_service_tpu.api.http_utils import (
     QuietHandler,
     SseWriter,
     get_json,
+    get_raw,
     post_json,
 )
 from xllm_service_tpu.api.protocol import (
@@ -43,6 +44,7 @@ from xllm_service_tpu.common.types import (
     KvCacheEvent,
     LatencyMetrics,
     LoadMetrics,
+    RequestAction,
     StatusCode,
 )
 from xllm_service_tpu.coordination.store import CoordinationStore
@@ -224,14 +226,19 @@ class Master:
         inst = h.query().get("instance")
         if inst:
             # Passthrough to one instance (reference behavior,
-            # service.cpp:452-457).
+            # service.cpp:452-457): forward body + content type verbatim so
+            # the Prometheus exposition format survives.
             meta = self.scheduler.instance_mgr.get_instance(inst)
             if meta is None:
                 h.send_error_json(404, f"unknown instance {inst}")
                 return
             try:
-                status, body = get_json(meta.http_address, "/metrics")
-                h.send_json(body if isinstance(body, dict) else {"raw": body}, status)
+                status, body, ctype = get_raw(meta.http_address, "/metrics")
+                h.send_response(status)
+                h.send_header("Content-Type", ctype)
+                h.send_header("Content-Length", str(len(body)))
+                h.end_headers()
+                h.wfile.write(body)
             except Exception as e:
                 h.send_error_json(502, f"instance unreachable: {e}")
             return
@@ -338,6 +345,11 @@ class Master:
 
         meta = self.scheduler.instance_mgr.get_instance(req.routing.prefill_name)
         if meta is None:
+            # Unwind the SCHEDULE bookkeeping recorded by schedule() — the
+            # request never dispatches.
+            self.scheduler.instance_mgr.update_request_metrics(
+                req.routing, RequestAction.CANCEL, len(req.token_ids)
+            )
             h.send_error_json(503, "prefill instance vanished")
             return
         stream = HttpClientStream(h, req.stream)

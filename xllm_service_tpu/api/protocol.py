@@ -128,6 +128,73 @@ def output_from_json(j: Dict[str, Any]) -> RequestOutput:
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# KV handoff framing (PD disaggregation data plane)
+# ---------------------------------------------------------------------------
+#
+# The DCN transport for KVHandoff payloads: one JSON header line, a NUL, then
+# the raw KV bytes (C-order). The reference's analog is an engine-side RDMA
+# pull keyed by the relayed cluster_ids/k_cache_ids handles (types.h:174-177);
+# here the prefill side pushes over HTTP and the ids are chained block hashes.
+
+import json as _json
+
+
+def handoff_to_bytes(h, extra: Dict[str, Any]) -> bytes:
+    import numpy as np
+
+    header: Dict[str, Any] = {
+        "request_id": h.request_id,
+        "token_ids": list(h.token_ids),
+        "first_token": int(h.first_token),
+        "first_logprob": float(h.first_logprob),
+        "num_full_blocks": int(h.num_full_blocks),
+        "block_hashes": [b.hex() for b in h.block_hashes],
+        "usage_prompt_tokens": int(h.usage_prompt_tokens),
+        **extra,
+    }
+    if h.kv is not None:
+        kv = np.asarray(h.kv)
+        header["kv_dtype"] = str(kv.dtype)
+        header["kv_shape"] = list(kv.shape)
+        body = kv.tobytes()
+    else:
+        body = b""
+    return _json.dumps(header).encode("utf-8") + b"\x00" + body
+
+
+def handoff_from_bytes(data: bytes):
+    """Returns (KVHandoff, header_dict)."""
+    import numpy as np
+
+    from xllm_service_tpu.runtime.engine import KVHandoff
+
+    sep = data.index(b"\x00")
+    header = _json.loads(data[:sep].decode("utf-8"))
+    kv = None
+    if "kv_shape" in header:
+        # bfloat16 needs ml_dtypes (jax ships it); np.dtype falls back for
+        # standard dtypes.
+        try:
+            dt = np.dtype(header["kv_dtype"])
+        except TypeError:
+            import ml_dtypes
+
+            dt = np.dtype(getattr(ml_dtypes, header["kv_dtype"]))
+        kv = np.frombuffer(data[sep + 1:], dtype=dt).reshape(header["kv_shape"])
+    h = KVHandoff(
+        request_id=header["request_id"],
+        token_ids=[int(t) for t in header["token_ids"]],
+        first_token=int(header["first_token"]),
+        first_logprob=float(header["first_logprob"]),
+        num_full_blocks=int(header["num_full_blocks"]),
+        block_hashes=[bytes.fromhex(x) for x in header["block_hashes"]],
+        kv=kv,
+        usage_prompt_tokens=int(header.get("usage_prompt_tokens", 0)),
+    )
+    return h, header
+
+
 def parse_prompt_field(prompt: Any) -> "tuple[str, List[int], str]":
     """OpenAI `prompt` accepts a string or an array of token ids.
     Returns (text, token_ids, error); exactly one of text/token_ids is

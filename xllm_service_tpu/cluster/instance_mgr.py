@@ -438,7 +438,10 @@ class InstanceMgr:
         FINISH_PREFILL  -> prefill done, decode slot opens on decode instance;
         GENERATE        -> one decode token on the decode instance;
         FINISH_DECODE   -> decode slot closes;
-        CANCEL          -> unwind whatever stage the request was in.
+        CANCEL          -> unwind a request cancelled BEFORE FINISH_PREFILL
+                           (prefill counters only — its decode slot never
+                           opened; post-prefill cancellation must use
+                           FINISH_DECODE).
         """
         with self._mu:
             pm = self._request_metrics.get(routing.prefill_name)
@@ -472,8 +475,13 @@ class InstanceMgr:
                 if pm is not None and pm.prefill_request_num > 0:
                     pm.prefill_request_num -= 1
                     pm.prefill_token_num = max(0, pm.prefill_token_num - num_tokens)
-                if dm is not None and dm.decode_request_num > 0:
-                    dm.decode_request_num -= 1
+                    pred = self._predictors.get(routing.prefill_name)
+                    if pred is not None and pred.has_ttft_model:
+                        pm.estimated_prefill_time = max(
+                            0.0,
+                            pm.estimated_prefill_time
+                            - pred.predict_ttft(num_tokens),
+                        )
 
     # ------------------------------------------------------------------ #
     # SLO-aware selection + dynamic PD ratio

@@ -127,22 +127,21 @@ def _qkv(lp, cfg: ModelConfig, x: jnp.ndarray, positions: jnp.ndarray):
     return q, k, v
 
 
-def _scatter_kv(k_cache, v_cache, slots, k, v):
-    """Write per-token K/V rows into flat cache slots.
+def _scatter_kv(k_cache, v_cache, blk, offset, k, v):
+    """Write per-token K/V rows into cache slots.
 
-    k_cache: [num_blocks, bs, Hkv, D]; slots: [T] flat row indices
-    (block_id*bs + offset); inactive/invalid tokens carry slot pointing into
-    the reserved garbage block 0."""
-    NB, BS, H, D = k_cache.shape
-    kf = k_cache.reshape(NB * BS, H, D).at[slots].set(k).reshape(NB, BS, H, D)
-    vf = v_cache.reshape(NB * BS, H, D).at[slots].set(v).reshape(NB, BS, H, D)
+    k_cache: [num_blocks, Hkv, bs, D]; blk/offset: [T] block ids and
+    in-block offsets per token; inactive/invalid tokens carry (0, 0),
+    pointing into the reserved garbage block 0."""
+    kf = k_cache.at[blk, :, offset, :].set(k)
+    vf = v_cache.at[blk, :, offset, :].set(v)
     return kf, vf
 
 
 def decode_step(
     params: Params,
     cfg: ModelConfig,
-    k_caches: jnp.ndarray,  # [L, num_blocks, bs, Hkv, D]
+    k_caches: jnp.ndarray,  # [L, num_blocks, Hkv, bs, D]
     v_caches: jnp.ndarray,
     token_ids: jnp.ndarray,  # [R] int32
     positions: jnp.ndarray,  # [R] int32 (0-based position of this token)
@@ -152,21 +151,21 @@ def decode_step(
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One generation step for R sequences. Returns (logits [R, V],
     k_caches', v_caches')."""
-    bs = k_caches.shape[2]
+    bs = k_caches.shape[3]
     scale = cfg.head_dim**-0.5
     x = params["embed"][token_ids].astype(params["layers"]["wq"].dtype)  # [R, E]
 
     block_idx = positions // bs
-    offset = positions % bs
+    offset = jnp.where(active, positions % bs, 0)
     blk = jnp.take_along_axis(block_tables, block_idx[:, None], axis=1)[:, 0]
-    slots = jnp.where(active, blk * bs + offset, 0)
+    blk = jnp.where(active, blk, 0)
     seq_lens = jnp.where(active, positions + 1, 0)
 
     def layer_fn(x, scanned):
         lp, k_l, v_l = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h, positions)
-        k_l, v_l = _scatter_kv(k_l, v_l, slots, k, v)
+        k_l, v_l = _scatter_kv(k_l, v_l, blk, offset, k, v)
         attn = paged_attention(
             q, k_l, v_l, block_tables, seq_lens, scale, use_kernel=use_kernel
         )
@@ -194,7 +193,7 @@ def prefill_step(
     block_table: jnp.ndarray,  # [max_blocks] int32
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Process one prefill chunk. Returns (last-token logits [V], k', v')."""
-    bs = k_caches.shape[2]
+    bs = k_caches.shape[3]
     scale = cfg.head_dim**-0.5
     Lpad = token_ids.shape[0]
     x = params["embed"][token_ids].astype(params["layers"]["wq"].dtype)  # [Lpad, E]
@@ -203,14 +202,14 @@ def prefill_step(
     positions = start_pos + offsets
     valid = offsets < true_len
     block_idx = positions // bs
-    blk = block_table[block_idx]
-    slots = jnp.where(valid, blk * bs + positions % bs, 0)
+    blk = jnp.where(valid, block_table[block_idx], 0)
+    in_block = jnp.where(valid, positions % bs, 0)
 
     def layer_fn(x, scanned):
         lp, k_l, v_l = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(lp, cfg, h, positions)
-        k_l, v_l = _scatter_kv(k_l, v_l, slots, k, v)
+        k_l, v_l = _scatter_kv(k_l, v_l, blk, in_block, k, v)
         attn = prefill_attention_gather(
             q, k_l, v_l, block_table, start_pos, true_len, scale
         )

@@ -11,8 +11,10 @@ size + chained hashing — SURVEY.md §2.3). Two implementations:
     blocks HBM→VMEM per (sequence, kv-head) program with the block table in
     scalar memory. Selected on TPU via `ops.attention.paged_attention`.
 
-Cache layout (one layer): k_cache, v_cache `[num_blocks, block_size,
-num_kv_heads, head_dim]`, KV-head axis shardable over the `tp` mesh axis.
+Cache layout (one layer): k_cache, v_cache `[num_blocks, num_kv_heads,
+block_size, head_dim]` — KV-head-major within a block so the Pallas kernel
+DMAs a [block_size, head_dim] tile per (block, head) with TPU-legal tiling;
+the KV-head axis shards over the `tp` mesh axis.
 """
 
 from __future__ import annotations
@@ -26,13 +28,13 @@ NEG_INF = -1e30
 
 
 def gather_context(
-    k_cache: jnp.ndarray,  # [num_blocks, block_size, Hkv, D]
+    k_cache: jnp.ndarray,  # [num_blocks, Hkv, block_size, D]
     v_cache: jnp.ndarray,
     block_table: jnp.ndarray,  # [R, max_blocks] int32
 ):
     """Gather each sequence's context as [R, max_blocks*block_size, Hkv, D]."""
-    k_ctx = k_cache[block_table]  # [R, max_blocks, bs, Hkv, D]
-    v_ctx = v_cache[block_table]
+    k_ctx = jnp.swapaxes(k_cache[block_table], 2, 3)  # [R, MB, bs, Hkv, D]
+    v_ctx = jnp.swapaxes(v_cache[block_table], 2, 3)
     R, MB, BS, H, D = k_ctx.shape
     return k_ctx.reshape(R, MB * BS, H, D), v_ctx.reshape(R, MB * BS, H, D)
 
@@ -114,9 +116,17 @@ def _on_tpu() -> bool:
 def paged_attention(
     q, k_cache, v_cache, block_table, seq_lens, scale, use_kernel: bool | None = None
 ):
-    """Decode paged attention; Pallas kernel on TPU, gather fallback elsewhere."""
+    """Decode paged attention; Pallas kernel on TPU, gather fallback elsewhere.
+
+    The kernel is opt-in via XLLM_PAGED_ATTENTION_KERNEL=1 while its chunked
+    v4 shape awaits validation on real hardware (the v2 shape passed
+    correctness on-chip; the serving tunnel went down mid-benchmark of v4)."""
     if use_kernel is None:
-        use_kernel = _on_tpu()
+        import os
+
+        use_kernel = (
+            _on_tpu() and os.environ.get("XLLM_PAGED_ATTENTION_KERNEL") == "1"
+        )
     if use_kernel:
         try:
             from xllm_service_tpu.ops.pallas.paged_attention import (

@@ -1,0 +1,220 @@
+"""Pallas TPU paged-attention decode kernel.
+
+The engine's hottest op (SURVEY.md §7 hard part #1; the reference's CUDA
+analog lives in the absent engine submodule). One query token per running
+sequence attends to that sequence's paged KV context.
+
+Design (flash-decode, manual double-buffered DMA, chunked blocks):
+  * grid = (R, Hkv): one program per (sequence, KV head). The K/V caches
+    stay in HBM (`pl.ANY`); the kernel streams this sequence's blocks
+    through a 2-slot VMEM buffer with `make_async_copy`, overlapping the
+    next chunk's DMA with the current chunk's compute.
+  * each inner iteration processes a CHUNK of `C` consecutive block-table
+    entries as one [C*BS, D] tile -> a single [Gp, C*BS] score matmul.
+    Shape search on real hardware: one-block-per-grid-step (4096 programs)
+    and one-block-per-iteration (16 iters of ~10 ns MXU work) are both
+    loop-latency-bound (~300 ns/step floor), and an 8x head-unrolled body
+    stalls the Mosaic compiler; C=4 chunking cuts iteration count 4x with
+    no code-size growth.
+  * the block table and sequence lengths ride in scalar-prefetch SMEM; the
+    inner `fori_loop` bound is the sequence's true chunk count, so no
+    bandwidth is spent on other sequences' blocks. Padding entries within
+    a live chunk DMA the reserved garbage block and are masked out of the
+    softmax by column index.
+  * GQA: the G = Hq//Hkv query heads of one KV head are processed together,
+    zero-padded to Gp = roundup(G, 8) sublanes to satisfy TPU tiling;
+    scores are bf16-in/f32-accum on the MXU (the fast path).
+
+Cache layout matches ops/attention.py: k/v `[num_blocks, Hkv, BS, D]`;
+q `[R, Hq, D]`; block_table `[R, MB]` int32; seq_lens `[R]` int32 (context
+length INCLUDING the current token). Returns `[R, Hq, D]`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    block_table_ref,  # [R, MBp] SMEM (padded to a multiple of C with 0s)
+    seq_lens_ref,     # [R]      SMEM
+    # inputs
+    q_ref,            # [1, 1, Gp, D] VMEM
+    k_hbm,            # [N, Hkv, BS, D] HBM (pl.ANY)
+    v_hbm,            # [N, Hkv, BS, D] HBM (pl.ANY)
+    # output
+    o_ref,            # [1, 1, Gp, D] VMEM
+    # scratch
+    k_buf,            # [2, C*BS, D] VMEM
+    v_buf,            # [2, C*BS, D] VMEM
+    sems,             # [2, 2, C] DMA semaphores
+    *,
+    block_size: int,
+    chunk: int,
+    scale: float,
+):
+    r = pl.program_id(0)
+    h = pl.program_id(1)
+    seq_len = seq_lens_ref[r]
+    span = chunk * block_size
+    nc = pl.cdiv(seq_len, span)  # chunks to process
+
+    def dma_pair(slot, c_idx, blk):
+        off = c_idx * block_size
+        return (
+            pltpu.make_async_copy(
+                k_hbm.at[blk, h],
+                k_buf.at[slot, pl.ds(off, block_size)],
+                sems.at[slot, 0, c_idx],
+            ),
+            pltpu.make_async_copy(
+                v_hbm.at[blk, h],
+                v_buf.at[slot, pl.ds(off, block_size)],
+                sems.at[slot, 1, c_idx],
+            ),
+        )
+
+    def start_chunk(slot, c):
+        for c_idx in range(chunk):  # static, small
+            blk = block_table_ref[r, c * chunk + c_idx]
+            kd, vd = dma_pair(slot, c_idx, blk)
+            kd.start()
+            vd.start()
+
+    def wait_chunk(slot, c):
+        for c_idx in range(chunk):
+            blk = block_table_ref[r, c * chunk + c_idx]
+            kd, vd = dma_pair(slot, c_idx, blk)
+            kd.wait()
+            vd.wait()
+
+    # Inactive decode slots carry seq_len = 0: issue no DMAs (their
+    # semaphores would never be awaited and could satisfy a later grid
+    # step's wait early) and emit zeros.
+    @pl.when(nc > 0)
+    def _first():
+        start_chunk(0, 0)
+
+    q = q_ref[0, 0]  # [Gp, D], model dtype (bf16 on TPU)
+
+    def body(c, carry):
+        m_prev, l_prev, acc = carry
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < nc)
+        def _prefetch():
+            start_chunk(jax.lax.rem(c + 1, 2), c + 1)
+
+        wait_chunk(slot, c)
+        scores = (
+            jax.lax.dot_general(
+                q, k_buf[slot],
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [Gp, C*BS] f32
+        col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        scores = jnp.where(c * span + col < seq_len, scores, NEG_INF)
+
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.dot(
+            p.astype(k_buf.dtype), v_buf[slot],
+            preferred_element_type=jnp.float32,
+        )  # [Gp, D] f32
+        return m_new, l_new, acc * alpha + pv
+
+    Gp, D = q_ref.shape[2], q_ref.shape[3]
+    m0 = jnp.full((Gp, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Gp, 1), jnp.float32)
+    a0 = jnp.zeros((Gp, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nc, body, (m0, l0, a0))
+    # an active slot always has seq_len >= 1 (l > 0); inactive slots get 0
+    o_ref[0, 0] = jnp.where(
+        nc > 0, acc / jnp.maximum(l, 1e-30), 0.0
+    ).astype(o_ref.dtype)
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "interpret", "chunk")
+)
+def paged_attention_kernel(
+    q: jnp.ndarray,            # [R, Hq, D]
+    k_cache: jnp.ndarray,      # [N, Hkv, BS, D]
+    v_cache: jnp.ndarray,
+    block_table: jnp.ndarray,  # [R, MB] int32
+    seq_lens: jnp.ndarray,     # [R] int32
+    scale: float,
+    interpret: bool = False,
+    chunk: int = 4,
+) -> jnp.ndarray:
+    R, Hq, D = q.shape
+    _, Hkv, BS, _ = k_cache.shape
+    MB = block_table.shape[1]
+    G = Hq // Hkv
+    Gp = _round_up(G, 8)
+    C = max(1, min(chunk, MB))
+
+    qr = q.reshape(R, Hkv, G, D)
+    if Gp != G:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, Gp - G), (0, 0)))
+    MBp = _round_up(MB, C)
+    bt = block_table.astype(jnp.int32)
+    if MBp != MB:
+        # Chunk-tail entries point at the reserved garbage block 0; their
+        # columns are masked out by seq_len anyway.
+        bt = jnp.pad(bt, ((0, 0), (0, MBp - MB)))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(R, Hkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, Gp, D), lambda r, h, bt, sl: (r, h, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, Gp, D), lambda r, h, bt, sl: (r, h, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, C * BS, D), k_cache.dtype),
+            pltpu.VMEM((2, C * BS, D), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2, C)),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, block_size=BS, chunk=C, scale=scale
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, Hkv, Gp, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * R * Hkv * Gp * D * MB * BS,  # qk + pv
+            bytes_accessed=(
+                R * Hq * D * 4 + 2 * R * MB * BS * Hkv * D * 2
+            ),
+            transcendentals=R * Hkv * Gp * MB * BS,
+        ),
+        interpret=interpret,
+    )(bt, seq_lens.astype(jnp.int32), qr, k_cache, v_cache)
+    return out[:, :, :G, :].reshape(R, Hq, D)

@@ -81,8 +81,8 @@ def param_shardings(
 
 
 def kv_cache_sharding(mesh: Mesh) -> NamedSharding:
-    # [L, num_blocks, bs, Hkv, D]: KV heads over tp.
-    return NamedSharding(mesh, P(None, None, None, "tp", None))
+    # [L, num_blocks, Hkv, bs, D]: KV heads over tp.
+    return NamedSharding(mesh, P(None, None, "tp", None, None))
 
 
 def check_tp_divisibility(cfg: ModelConfig, tp: int, ep: int = 1) -> None:

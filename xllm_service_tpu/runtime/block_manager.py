@@ -132,6 +132,10 @@ class BlockManager:
         self._stored.add(block_hash)
         self._removed.discard(block_hash)
 
+    def lookup_hash(self, block_hash: bytes) -> Optional[int]:
+        """Block id currently committed under this hash, if any."""
+        return self._hash_to_block.get(block_hash)
+
     def match_prefix(self, token_ids: Sequence[int]) -> Tuple[int, List[int]]:
         """Longest cached prefix: returns (num_cached_tokens, block_ids) and
         takes a reference on each matched block (same walk as the service's

@@ -52,6 +52,39 @@ class EngineRequest:
     # common/xllm/output.h:131).
     callback: Callable[[RequestOutput], bool]
     arrival_time: float = field(default_factory=time.monotonic)
+    # PD disaggregation (prefill side): emit the first token, then hand the
+    # sequence off instead of decoding (reference flow: prefill instance
+    # returns the first chunk, decode instance continues —
+    # rpc_service/service.h:61-71). `handoff` receives a KVHandoff.
+    prefill_only: bool = False
+    handoff: Optional[Callable[["KVHandoff"], None]] = None
+
+
+@dataclass
+class KVHandoff:
+    """Everything a decode peer needs to continue a prefilled sequence.
+
+    Only FULL committed blocks migrate; the sub-block tail (< block_size
+    tokens plus the first generated token) is recomputed by the importer's
+    prefill path, which keeps the chained-hash prefix-cache semantics exact
+    on both sides. The TPU analog of the reference's RDMA KV pull whose
+    handles the service relays (types.h:174-177): in-process peers receive
+    `kv` as a device array (ICI path: jax.device_put to the peer mesh);
+    cross-host peers receive it serialized over the data plane (DCN path).
+    """
+
+    request_id: str
+    # prompt + the first generated token
+    token_ids: List[int]
+    first_token: int
+    first_logprob: float
+    num_full_blocks: int
+    # chained hashes of the migrated full blocks, in order
+    block_hashes: List[bytes]
+    # [2, L, num_full_blocks, Hkv, BS, D] (k, v stacked); None when no full
+    # blocks exist (short prompt -> pure recompute on the decode side)
+    kv: Optional[object]
+    usage_prompt_tokens: int = 0
 
 
 class _Seq:
@@ -96,6 +129,11 @@ class InferenceEngine:
         )
 
         self._waiting: Deque[EngineRequest] = collections.deque()
+        # KV imports from prefill peers, landed on the engine thread
+        # (BlockManager is engine-thread-only).
+        self._pending_imports: Deque[Tuple[EngineRequest, KVHandoff]] = (
+            collections.deque()
+        )
         self._running: Dict[int, _Seq] = {}  # slot -> seq
         self._free_slots = list(range(self.R - 1, -1, -1))
         self._lock = threading.Lock()
@@ -125,7 +163,7 @@ class InferenceEngine:
         self._work.set()
 
     def has_work(self) -> bool:
-        return bool(self._waiting or self._running)
+        return bool(self._waiting or self._running or self._pending_imports)
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -184,8 +222,9 @@ class InferenceEngine:
     # ---------------------------------------------------------------- step
 
     def step(self) -> int:
-        """One engine iteration: admit + prefill new requests, then one
-        decode step. Returns number of tokens produced."""
+        """One engine iteration: land migrated KV, admit + prefill new
+        requests, then one decode step. Returns number of tokens produced."""
+        self._drain_imports()
         self._drain_cancelled()
         admitted = self._admit()
         return admitted + self._decode_once()
@@ -300,11 +339,109 @@ class InferenceEngine:
             seq.generated.append((tok, lp))
             seq.tokens.append(tok)
             self._running[seq.slot] = seq
-            self._emit(seq, finished=self._check_stop(seq))
+            alive = self._emit(seq, finished=self._check_stop(seq))
+            if alive and seq.req.prefill_only:
+                self._handoff(seq)
             admitted += 1
         for req, code, msg in rejects:
             self._reject(req, code, msg)
         return admitted
+
+    # ------------------------------------------------- PD disaggregation
+
+    def _handoff(self, seq: _Seq) -> None:
+        """Prefill side: export this sequence's full committed blocks and
+        hand them to the peer transport, then release the local sequence.
+        The committed blocks stay in the local prefix cache (evictable), so
+        cache-aware routing keeps its affinity signal."""
+        full = seq.last_committed_block + 1
+        hashes = (
+            prefix_block_hashes(
+                seq.tokens[: full * self.block_size],
+                self.block_size,
+                self.block_mgr.seed,
+            )
+            if full > 0
+            else []
+        )
+        kv = None
+        if full > 0:
+            kv = np.asarray(self.executor.export_blocks(seq.block_ids[:full]))
+        payload = KVHandoff(
+            request_id=seq.req.request_id,
+            token_ids=list(seq.tokens),
+            first_token=seq.generated[0][0],
+            first_logprob=seq.generated[0][1],
+            num_full_blocks=full,
+            block_hashes=list(hashes),
+            kv=kv,
+            usage_prompt_tokens=len(seq.req.prompt_token_ids),
+        )
+        try:
+            seq.req.handoff(payload)
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+        # release slot + block refs; committed blocks become evictable-cached
+        if seq.slot in self._running:
+            del self._running[seq.slot]
+            self._free_slots.append(seq.slot)
+        self.block_mgr.free(seq.block_ids)
+        seq.block_ids = []
+
+    def import_sequence(
+        self, req: EngineRequest, handoff: KVHandoff
+    ) -> None:
+        """Decode side: continue a sequence prefilled by a peer. Thread-safe
+        entry; the KV landing happens on the engine thread."""
+        with self._lock:
+            self._pending_imports.append((req, handoff))
+        self._work.set()
+
+    def _drain_imports(self) -> None:
+        while True:
+            with self._lock:
+                if not self._pending_imports:
+                    return
+                req, h = self._pending_imports.popleft()
+            self._do_import(req, h)
+
+    def _do_import(self, req: EngineRequest, h: KVHandoff) -> None:
+        # Land migrated full blocks into the local cache under their chained
+        # hashes; blocks whose hash is already cached locally are skipped
+        # (dedup). On any capacity problem fall back to pure recompute —
+        # admission will prefill the whole prompt locally.
+        if h.num_full_blocks > 0 and h.kv is not None:
+            fresh = [
+                i
+                for i, hb in enumerate(h.block_hashes)
+                if self.block_mgr.lookup_hash(hb) is None
+            ]
+            if fresh:
+                try:
+                    ids = self.block_mgr.allocate(len(fresh))
+                except OutOfBlocksError:
+                    ids = []
+                if ids:
+                    kv = np.asarray(h.kv)
+                    self.executor.import_blocks(kv[:, :, fresh], np.asarray(ids))
+                    for bid, i in zip(ids, fresh):
+                        self.block_mgr.commit_block(bid, h.block_hashes[i])
+                    # drop our temporary ref; blocks stay evictable-cached
+                    # until admission re-acquires them via match_prefix
+                    self.block_mgr.free(ids)
+        # Seed a resume-sequence: prompt + first generated token; admission
+        # treats it like a preempted sequence — prefix match picks up the
+        # imported blocks, only the sub-block tail is recomputed, and the
+        # next emitted token is the SECOND one (the prefill peer already
+        # streamed the first).
+        seq = _Seq(req, slot=-1)
+        seq.tokens = list(h.token_ids)
+        seq.generated = [(h.first_token, h.first_logprob)]
+        with self._lock:
+            self._waiting.append(seq)
+        self._work.set()
 
     def _reject(self, req: EngineRequest, code: StatusCode, msg: str) -> None:
         out = RequestOutput(

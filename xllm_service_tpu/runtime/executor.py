@@ -91,11 +91,14 @@ class ModelExecutor:
                 )
                 self.params = init_fn(jax.random.key(init_seed))
 
+            # [L, N, Hkv, BS, D]: KV-head-major within a block so the Pallas
+            # decode kernel can DMA one (block, head) tile of shape [BS, D]
+            # with TPU-legal last-two-dims tiling.
             cache_shape = (
                 self.cfg.num_layers,
                 self.num_blocks,
-                self.block_size,
                 self.cfg.num_kv_heads,
+                self.block_size,
                 self.cfg.head_dim,
             )
             alloc = jax.jit(

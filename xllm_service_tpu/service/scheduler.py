@@ -319,6 +319,14 @@ class Scheduler:
             )
 
         if request.stream:
+            if not output.status.ok() and not output.status.code == StatusCode.CANCELLED:
+                # Engine-side failure mid-stream (or at admission): surface
+                # it instead of closing as a clean empty stream.
+                state.stream.finish_with_error(
+                    output.status.code, output.status.message
+                )
+                self.finish_request(request.service_request_id, cancelled=True)
+                return
             ok = self._response_handler.send_delta_to_client(
                 state.stream, request, output, state.first_chunk_sent
             )
@@ -363,14 +371,25 @@ class Scheduler:
         self.finish_request(state.request.service_request_id, cancelled=True)
 
     def finish_request(self, service_request_id: str, cancelled: bool = False) -> None:
-        """Terminal bookkeeping (reference: scheduler.cpp:268-291)."""
+        """Terminal bookkeeping (reference: scheduler.cpp:268-291).
+
+        A request cancelled BEFORE its first token unwinds the queued
+        prefill work (CANCEL); once FINISH_PREFILL has fired, the prefill
+        counters were already decremented and only the decode slot is open,
+        so any termination — clean or cancelled — closes it with
+        FINISH_DECODE (a CANCEL here would double-decrement prefill and
+        corrupt other requests' counts)."""
         with self._mu:
             state = self._requests.pop(service_request_id, None)
         if state is None or state.done:
             return
         state.done = True
         request = state.request
-        action = RequestAction.CANCEL if cancelled else RequestAction.FINISH_DECODE
+        action = (
+            RequestAction.CANCEL
+            if cancelled and not state.prefill_finished
+            else RequestAction.FINISH_DECODE
+        )
         self._instance_mgr.update_request_metrics(
             request.routing, action, len(request.token_ids)
         )

@@ -142,6 +142,54 @@ class HFTokenizer(Tokenizer):
         return self._tok
 
 
+class IncrementalDetokenizer:
+    """Streaming-safe detokenization for one sequence.
+
+    Decoding each step's token ids independently corrupts characters whose
+    bytes span token boundaries (routine for byte-level and BPE
+    byte-fallback vocabularies). This keeps the full id history, re-decodes,
+    and emits only the newly *stable* text — a trailing run of U+FFFD
+    replacement chars is held back until later tokens complete the
+    sequence (vLLM-style prefix-diff detokenization)."""
+
+    def __init__(self, tokenizer: Tokenizer):
+        self._tok = tokenizer
+        self._ids: List[int] = []
+        self._emitted = 0
+
+    def push(self, ids: Sequence[int]) -> str:
+        self._ids.extend(int(i) for i in ids)
+        text = self._tok.decode(self._ids)
+        stable_end = len(text)
+        while stable_end > self._emitted and text[stable_end - 1] == "�":
+            stable_end -= 1
+        delta = text[self._emitted:stable_end]
+        self._emitted = stable_end
+        return delta
+
+    def flush(self) -> str:
+        """Emit whatever is still held back (end of stream)."""
+        text = self._tok.decode(self._ids)
+        delta = text[self._emitted:]
+        self._emitted = len(text)
+        return delta
+
+    # State carry-over across a PD handoff: the decode peer must continue
+    # the prefill peer's byte/char position or the streamed text diverges
+    # from a colocated run.
+    def export_state(self) -> "tuple[List[int], int]":
+        return list(self._ids), self._emitted
+
+    @classmethod
+    def from_state(
+        cls, tokenizer: Tokenizer, ids: Sequence[int], emitted: int
+    ) -> "IncrementalDetokenizer":
+        d = cls(tokenizer)
+        d._ids = [int(i) for i in ids]
+        d._emitted = int(emitted)
+        return d
+
+
 def create_tokenizer(path: str = "") -> Tokenizer:
     """Factory (reference: tokenizer_factory.cpp:9-33). Empty path selects
     the byte tokenizer (tests/bench); a model dir or hub id selects HF."""
