@@ -343,8 +343,7 @@ class Master:
             )
             return
 
-        meta = self.scheduler.instance_mgr.get_instance(req.routing.prefill_name)
-        if meta is None:
+        if self.scheduler.instance_mgr.get_instance(req.routing.prefill_name) is None:
             # Unwind the SCHEDULE bookkeeping recorded by schedule() — the
             # request never dispatches.
             self.scheduler.instance_mgr.update_request_metrics(
@@ -353,18 +352,27 @@ class Master:
             h.send_error_json(503, "prefill instance vanished")
             return
         stream = HttpClientStream(h, req.stream)
-        self.scheduler.record_new_request(
-            req, stream, cancel_callback=lambda: self._cancel_on_instance(req)
-        )
 
         path = "/v1/chat/completions" if chat else "/v1/completions"
-        fwd = augment_forwarded_request(
-            body, req.service_request_id, req.token_ids, req.routing
-        )
 
         def dispatch() -> None:
-            # Forward to the prefill instance (reference: service.cpp:147-191,
-            # ack-mode: tokens return via /rpc/generations).
+            # Forward to the CURRENT routed prefill instance (re-resolved
+            # per call: re-dispatch after instance death changes routing;
+            # reference: service.cpp:147-191, ack-mode — tokens return via
+            # /rpc/generations).
+            meta = self.scheduler.instance_mgr.get_instance(
+                req.routing.prefill_name
+            )
+            if meta is None:
+                self.scheduler.fail_request(
+                    req.service_request_id,
+                    StatusCode.UNAVAILABLE,
+                    "prefill instance vanished",
+                )
+                return
+            fwd = augment_forwarded_request(
+                body, req.service_request_id, req.token_ids, req.routing
+            )
             try:
                 code, resp = post_json(meta.http_address, path, fwd, timeout=30.0)
                 if code != 200:
@@ -374,11 +382,23 @@ class Master:
                         f"prefill rejected: {resp}",
                     )
             except Exception as e:
-                self.scheduler.fail_request(
-                    req.service_request_id,
-                    StatusCode.UNAVAILABLE,
-                    f"prefill unreachable: {e}",
-                )
+                # Fast failure (connection refused / timeout): try another
+                # instance before giving up — lease expiry would take
+                # seconds to notice.
+                if not self.scheduler.redispatch_request(
+                    req.service_request_id, exclude=meta.name
+                ):
+                    self.scheduler.fail_request(
+                        req.service_request_id,
+                        StatusCode.UNAVAILABLE,
+                        f"prefill unreachable: {e}",
+                    )
+
+        self.scheduler.record_new_request(
+            req, stream,
+            cancel_callback=lambda: self._cancel_on_instance(req),
+            dispatch=dispatch,
+        )
 
         if self.scheduler.should_defer_offline(req):
             self.scheduler.park_offline(req, dispatch)

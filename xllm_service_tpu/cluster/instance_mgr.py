@@ -89,6 +89,10 @@ class InstanceMgr:
         self._rr_decode = 0
         self._rr_encode = 0
 
+        # Removal listeners (scheduler re-dispatch, cache-index cleanup).
+        # Called OUTSIDE the registry lock with the instance name.
+        self._removal_listeners: List[Callable[[str], None]] = []
+
         self._watch_ids: List[int] = []
         for prefix in INSTANCE_PREFIXES.values():
             self._watch_ids.append(
@@ -199,6 +203,9 @@ class InstanceMgr:
             idx[pos] = last
             self._index_pos[last] = pos
 
+    def add_removal_listener(self, fn: Callable[[str], None]) -> None:
+        self._removal_listeners.append(fn)
+
     def _remove(self, name: str) -> None:
         with self._mu:
             meta = self._instances.pop(name, None)
@@ -212,6 +219,11 @@ class InstanceMgr:
             self._heartbeat_ts.pop(name, None)
             self._dirty_load.discard(name)
             logger.info("instance %s removed", name)
+        for fn in self._removal_listeners:
+            try:
+                fn(name)
+            except Exception:
+                logger.exception("removal listener failed for %s", name)
         if self._is_master():
             # Clean the replicated load-metrics record for departed
             # instances (reference marks names for LOADMETRICS cleanup).

@@ -66,6 +66,10 @@ class _RequestState:
     lane: int
     # api-tier hook to propagate cancellation to the engine instance
     cancel_callback: Optional[Callable[[], None]] = None
+    # api-tier hook to (re-)forward the request to its routed prefill
+    # instance; enables automatic re-dispatch after instance death
+    dispatch: Optional[Callable[[], None]] = None
+    redispatch_count: int = 0
     first_chunk_sent: bool = False
     prefill_finished: bool = False
     # accumulated per-sequence state for non-stream responses
@@ -115,6 +119,14 @@ class Scheduler:
         )
         self._response_handler = ResponseHandler()
         self._streams = OrderedStreams(config.num_ordered_output_streams)
+        # Re-dispatch interrupted requests when their instance dies (the
+        # reference only promises this — README.md:46; its failure surface
+        # is an error-finish, SURVEY.md §3.5 note).
+        self._instance_mgr.add_removal_listener(self._on_instance_removed)
+        self._instance_mgr.add_removal_listener(
+            self._kvcache_mgr.remove_instance
+        )
+        self.max_redispatch = 2
 
         self._mu = threading.Lock()
         self._requests: Dict[str, _RequestState] = {}
@@ -181,8 +193,9 @@ class Scheduler:
             try:
                 self._kvcache_mgr.upload_kvcache()
                 self._instance_mgr.upload_load_metrics()
-                for name in self._instance_mgr.prune_disconnected():
-                    self._kvcache_mgr.remove_instance(name)
+                # pruning fires the removal listeners (re-dispatch + cache
+                # index cleanup)
+                self._instance_mgr.prune_disconnected()
             except Exception:
                 logger.exception("master loop iteration failed")
 
@@ -259,6 +272,7 @@ class Scheduler:
         request: ServiceRequest,
         stream: ClientStream,
         cancel_callback: Optional[Callable[[], None]] = None,
+        dispatch: Optional[Callable[[], None]] = None,
     ) -> None:
         """Register the response route for a scheduled request
         (reference: scheduler.cpp:171-266)."""
@@ -278,6 +292,7 @@ class Scheduler:
             stream=stream,
             lane=self._streams.assign(),
             cancel_callback=cancel_callback,
+            dispatch=dispatch,
         )
         with self._mu:
             self._requests[request.service_request_id] = state
@@ -408,6 +423,85 @@ class Scheduler:
                 self.finish_request(service_request_id, cancelled=True),
             ),
         )
+
+    # ------------------------------------------------------------------ #
+    # fault handling: interrupted-request re-dispatch
+    # ------------------------------------------------------------------ #
+
+    def _on_instance_removed(self, name: str) -> None:
+        """An instance left the registry (lease expiry / prune). Requests
+        routed to it that have produced NO tokens yet are re-routed and
+        re-forwarded transparently; requests already mid-stream cannot be
+        replayed without duplicating output, so they error-finish."""
+        with self._mu:
+            affected = [
+                s
+                for s in self._requests.values()
+                if not s.done
+                and name
+                in (s.request.routing.prefill_name, s.request.routing.decode_name)
+            ]
+        for state in affected:
+            if not self.redispatch_request(
+                state.request.service_request_id, exclude=name
+            ):
+                self.fail_request(
+                    state.request.service_request_id,
+                    StatusCode.UNAVAILABLE,
+                    f"instance {name} died mid-generation",
+                )
+
+    def redispatch_request(
+        self, service_request_id: str, exclude: str = ""
+    ) -> bool:
+        """Re-route + re-forward a request whose instance failed. Only safe
+        before any token reached the client; bounded by max_redispatch.
+        Returns False when the request cannot be replayed (caller decides
+        how to fail it)."""
+        with self._mu:
+            state = self._requests.get(service_request_id)
+        if state is None or state.done:
+            return False
+        request = state.request
+        if (
+            request.num_generated_tokens > 0
+            or state.dispatch is None
+            or state.redispatch_count >= self.max_redispatch
+        ):
+            return False
+        state.redispatch_count += 1
+        routing = self._policy.select_instances_pair(request.token_ids)
+        if exclude and routing.prefill_name == exclude:
+            # Registry may still list the failed instance (fast-fail before
+            # lease expiry) — route around it over every live candidate.
+            candidates = [
+                n
+                for n in (
+                    self._instance_mgr.prefill_instances()
+                    + self._instance_mgr.decode_instances()
+                )
+                if n != exclude
+            ]
+            if not candidates:
+                return False
+            routing.prefill_name = self._instance_mgr.least_loaded(candidates)
+        if exclude and routing.decode_name == exclude:
+            routing.decode_name = routing.prefill_name
+        if not routing.prefill_name and not routing.decode_name:
+            return False
+        request.routing = routing
+        self._instance_mgr.update_request_metrics(
+            routing, RequestAction.SCHEDULE, len(request.token_ids)
+        )
+        logger.info(
+            "re-dispatching %s (excluding %s) -> %s",
+            service_request_id, exclude or "-", routing.to_json(),
+        )
+        try:
+            state.dispatch()
+        except Exception:
+            return False
+        return True
 
     # ------------------------------------------------------------------ #
     # instance-facing plane
