@@ -4,21 +4,14 @@ collective / sharding logic is exercised without TPU hardware (SURVEY.md §4).
 Must run before jax is imported anywhere."""
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from __graft_entry__ import _force_cpu_platform  # noqa: E402
 
-# The axon sitecustomize calls jax.config.update("jax_platforms", "axon,cpu")
-# at interpreter start, which overrides the env var — force CPU back before
-# any backend initializes.
-jax.config.update("jax_platforms", "cpu")
+_force_cpu_platform(8)
 
 import pytest  # noqa: E402
 
