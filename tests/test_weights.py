@@ -1,0 +1,186 @@
+"""Checkpoint loading round-trip + executor e2e.
+
+Covers VERDICT round-1 missing item 1: runtime/weights.py — HF safetensors
+→ stacked pytree, all three registered families (Llama, Qwen2-style bias,
+Mixtral-style MoE), sharded multi-file checkpoints, and an executor that
+serves from a checkpoint dir producing tokens identical to one holding the
+same params in memory.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from xllm_service_tpu.common.config import EngineConfig
+from xllm_service_tpu.models import llama
+from xllm_service_tpu.models.configs import ModelConfig, get_model_config
+from xllm_service_tpu.runtime import weights
+from xllm_service_tpu.runtime.executor import ModelExecutor, SamplingBatch
+
+QWEN_TINY = ModelConfig(
+    name="qwen-tiny",
+    vocab_size=512,
+    hidden_size=128,
+    intermediate_size=256,
+    num_layers=2,
+    num_heads=4,
+    num_kv_heads=2,
+    head_dim=32,
+    attn_bias=True,
+    max_position_embeddings=1024,
+)
+
+
+def _tree_equal(a, b):
+    la = jax.tree.leaves(a)
+    lb = jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.parametrize(
+    "cfg",
+    [
+        get_model_config("llama3-tiny"),
+        QWEN_TINY,
+        get_model_config("moe-tiny"),
+    ],
+    ids=["llama", "qwen-bias", "moe"],
+)
+def test_save_load_roundtrip(cfg, tmp_path):
+    params = llama.init_params(cfg, jax.random.key(7), jnp.bfloat16)
+    # Give biases nonzero values so the mapping is actually exercised.
+    if cfg.attn_bias:
+        lp = params["layers"]
+        for k in ("bq", "bk", "bv"):
+            lp[k] = jax.random.normal(jax.random.key(hash(k) % 2**31),
+                                      lp[k].shape, jnp.bfloat16)
+    ckpt = str(tmp_path / "ckpt")
+    weights.save_hf_checkpoint(params, cfg, ckpt)
+
+    loaded_cfg = weights.config_from_hf(ckpt)
+    for f in ("vocab_size", "hidden_size", "num_layers", "num_heads",
+              "num_kv_heads", "head_dim", "rope_theta", "rms_norm_eps",
+              "tie_word_embeddings", "num_experts", "num_experts_per_tok",
+              "attn_bias"):
+        assert getattr(loaded_cfg, f) == getattr(cfg, f), f
+
+    loaded = weights.load_checkpoint(ckpt, cfg, jnp.bfloat16)
+    _tree_equal(params, loaded)
+
+    # Same logits through the oracle forward.
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16), np.int32)
+    )
+    out_a = llama.forward_dense(params, cfg, toks)
+    out_b = llama.forward_dense(loaded, cfg, toks)
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
+
+
+def test_tied_embeddings_roundtrip(tmp_path):
+    cfg = ModelConfig(
+        name="tied-tiny", vocab_size=128, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=2, num_kv_heads=2,
+        head_dim=32, tie_word_embeddings=True,
+    )
+    params = llama.init_params(cfg, jax.random.key(0), jnp.bfloat16)
+    ckpt = str(tmp_path / "ckpt")
+    weights.save_hf_checkpoint(params, cfg, ckpt)
+    assert weights.config_from_hf(ckpt).tie_word_embeddings
+    loaded = weights.load_checkpoint(ckpt, cfg, jnp.bfloat16)
+    assert "lm_head" not in loaded
+    _tree_equal(params, loaded)
+
+
+def test_multi_shard_with_index(tmp_path):
+    """Checkpoints split across files + model.safetensors.index.json."""
+    cfg = get_model_config("llama3-tiny")
+    params = llama.init_params(cfg, jax.random.key(3), jnp.bfloat16)
+    ckpt = tmp_path / "ckpt"
+    weights.save_hf_checkpoint(params, cfg, str(ckpt))
+
+    # Re-split the single file into two shards + index.
+    tensors = dict(weights.read_safetensors(str(ckpt / "model.safetensors")))
+    tensors = {k: v.copy() for k, v in tensors.items()}
+    names = sorted(tensors)
+    half = len(names) // 2
+    shard_of = {}
+    for i, part in enumerate((names[:half], names[half:])):
+        fname = f"model-0000{i + 1}-of-00002.safetensors"
+        weights.write_safetensors(
+            str(ckpt / fname), {n: tensors[n] for n in part}
+        )
+        for n in part:
+            shard_of[n] = fname
+    os.remove(ckpt / "model.safetensors")
+    with open(ckpt / "model.safetensors.index.json", "w") as f:
+        json.dump({"weight_map": shard_of}, f)
+
+    loaded = weights.load_checkpoint(str(ckpt), cfg, jnp.bfloat16)
+    _tree_equal(params, loaded)
+
+
+def test_missing_tensor_raises(tmp_path):
+    cfg = get_model_config("llama3-tiny")
+    params = llama.init_params(cfg, jax.random.key(0), jnp.bfloat16)
+    ckpt = tmp_path / "ckpt"
+    weights.save_hf_checkpoint(params, cfg, str(ckpt))
+    tensors = dict(weights.read_safetensors(str(ckpt / "model.safetensors")))
+    tensors = {k: v.copy() for k, v in tensors.items()}
+    del tensors["model.layers.1.self_attn.q_proj.weight"]
+    weights.write_safetensors(str(ckpt / "model.safetensors"), tensors)
+    with pytest.raises(ValueError, match="missing"):
+        weights.load_checkpoint(str(ckpt), cfg, jnp.bfloat16)
+
+
+def test_executor_serves_from_checkpoint(tmp_path):
+    """An executor given checkpoint_path produces the exact tokens of one
+    holding the same params in memory (greedy decode, real prefill)."""
+    ecfg = EngineConfig(model="llama3-tiny", num_blocks=32,
+                       max_running_requests=4, max_seq_len=256,
+                       prefill_buckets=[32, 64])
+    ref = ModelExecutor(ecfg, init_seed=11)
+    ckpt = str(tmp_path / "ckpt")
+    weights.save_hf_checkpoint(ref.params, ref.cfg, ckpt)
+
+    ecfg2 = EngineConfig(model="llama3-tiny", checkpoint_path=ckpt,
+                        num_blocks=32, max_running_requests=4,
+                        max_seq_len=256, prefill_buckets=[32, 64])
+    exe = ModelExecutor(ecfg2, init_seed=0)  # seed irrelevant: weights loaded
+    _tree_equal(ref.params, exe.params)
+
+    prompt = np.arange(10, dtype=np.int32) % ref.cfg.vocab_size
+    table = np.zeros((ref.max_blocks_per_seq,), np.int32)
+    table[0] = 3
+    outs = []
+    for e in (ref, exe):
+        tok, _ = e.prefill(prompt, 0, table)
+        toks = [tok]
+        R = ecfg.max_running_requests
+        batch = SamplingBatch(
+            temperature=np.zeros(R, np.float32),
+            top_k=np.zeros(R, np.int32),
+            top_p=np.ones(R, np.float32),
+            seeds=np.zeros(R, np.uint32),
+            steps=np.zeros(R, np.int32),
+        )
+        ids = np.zeros(R, np.int32)
+        pos = np.zeros(R, np.int32)
+        tables = np.zeros((R, ref.max_blocks_per_seq), np.int32)
+        tables[0] = table
+        active = np.zeros(R, bool)
+        active[0] = True
+        cur, p = tok, len(prompt)
+        for _ in range(5):
+            ids[0], pos[0] = cur, p
+            t, _ = e.decode(ids, pos, tables, active, batch)
+            cur = int(t[0])
+            toks.append(cur)
+            p += 1
+        outs.append(toks)
+    assert outs[0] == outs[1]

@@ -31,6 +31,8 @@ class ModelConfig:
     moe_intermediate_size: int = 0
     # Sliding-window attention (0 = full).
     sliding_window: int = 0
+    # QKV projection bias (Qwen2-style).
+    attn_bias: bool = False
 
     @property
     def is_moe(self) -> bool:
@@ -142,6 +144,7 @@ register(
         head_dim=128,
         rope_theta=1000000.0,
         rms_norm_eps=1e-6,
+        attn_bias=True,
     )
 )
 

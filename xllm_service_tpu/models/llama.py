@@ -60,6 +60,10 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
         "wo": w(keys[3], (L, Hq * D, E), Hq * D),
         "mlp_norm": norm_init((L, E)),
     }
+    if cfg.attn_bias:
+        layers["bq"] = jnp.zeros((L, Hq * D), dtype)
+        layers["bk"] = jnp.zeros((L, Hkv * D), dtype)
+        layers["bv"] = jnp.zeros((L, Hkv * D), dtype)
     if cfg.is_moe:
         X, Fm = cfg.num_experts, cfg.moe_intermediate_size
         layers.update(
@@ -119,9 +123,14 @@ def _mlp(lp: Dict[str, jnp.ndarray], cfg: ModelConfig, x: jnp.ndarray) -> jnp.nd
 def _qkv(lp, cfg: ModelConfig, x: jnp.ndarray, positions: jnp.ndarray):
     """x: [T, E] -> q [T, Hq, D], k/v [T, Hkv, D] with RoPE applied."""
     T = x.shape[0]
-    q = jnp.einsum("te,eh->th", x, lp["wq"]).reshape(T, cfg.num_heads, cfg.head_dim)
-    k = jnp.einsum("te,eh->th", x, lp["wk"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
-    v = jnp.einsum("te,eh->th", x, lp["wv"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    q = jnp.einsum("te,eh->th", x, lp["wq"])
+    k = jnp.einsum("te,eh->th", x, lp["wk"])
+    v = jnp.einsum("te,eh->th", x, lp["wv"])
+    if cfg.attn_bias:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(T, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(T, cfg.num_kv_heads, cfg.head_dim)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     return q, k, v

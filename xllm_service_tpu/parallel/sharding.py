@@ -51,6 +51,10 @@ def param_shardings(
         "wo": ns(None, tp, None),
         "mlp_norm": ns(None, None),
     }
+    if cfg.attn_bias:
+        layers.update(
+            {"bq": ns(None, tp), "bk": ns(None, tp), "bv": ns(None, tp)}
+        )
     if cfg.is_moe:
         ep = ep_axis if ep_axis is not None and ep_axis in mesh.shape else None
         e, t = (ep, tp) if ep is not None else (tp, None)

@@ -1,0 +1,425 @@
+"""Checkpoint loading: HuggingFace safetensors → the stacked-layer pytree.
+
+Engine-tier component. The reference's engine (the absent xLLM submodule —
+SURVEY.md §2.3) loads real HF checkpoints and relays `model_name` in
+InstanceMetaInfo (reference xllm_service/common/types.h:169-171 analog);
+here the executor (runtime/executor.py) calls `load_checkpoint` when
+`EngineConfig.checkpoint_path` is set.
+
+Design:
+  * Self-contained safetensors parser (the format: u64 header length +
+    JSON header + raw little-endian tensor data). mmap'd reads — no copy
+    until the dtype cast — and bfloat16 via ml_dtypes, which the
+    `safetensors` pip package's numpy API can't always represent.
+  * HF Llama/Qwen2/Mixtral name mapping → per-layer tensors STACKED on a
+    leading layer axis (models/llama.py contract). torch `nn.Linear`
+    stores [out, in]; our einsum contracts [in, out], so every projection
+    transposes on load.
+  * RoPE: ops/rope.py applies split-half rotation — the same convention HF
+    checkpoints are stored in — so q/k weights load with NO head
+    permutation (only the transpose).
+  * Each stacked leaf is `jax.device_put` with its NamedSharding from
+    parallel/sharding.py, so a tp>1 mesh receives only its shard per
+    device; host RAM briefly holds the full stacked array per leaf.
+  * `save_hf_checkpoint` writes the inverse mapping (HF names, HF layouts)
+    — round-trip tested in tests/test_weights.py and usable for exporting.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import struct
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+from xllm_service_tpu.models.configs import ModelConfig
+
+Params = Dict[str, Any]
+
+_ST_DTYPES = {
+    "F64": np.float64,
+    "F32": np.float32,
+    "F16": np.float16,
+    "BF16": ml_dtypes.bfloat16,
+    "I64": np.int64,
+    "I32": np.int32,
+    "I16": np.int16,
+    "I8": np.int8,
+    "U8": np.uint8,
+    "BOOL": np.bool_,
+}
+_ST_NAMES = {np.dtype(v): k for k, v in _ST_DTYPES.items()}
+
+
+# ------------------------------------------------------------- safetensors IO
+
+
+def read_safetensors(path: str) -> Iterator[Tuple[str, np.ndarray]]:
+    """Yield (name, array) from one .safetensors file, zero-copy via mmap.
+
+    Arrays are views into the mapping — cast or copy before the file goes
+    away (load_checkpoint always casts into the staging buffer).
+    """
+    with open(path, "rb") as f:
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen))
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        base = 8 + hlen
+        for name, meta in header.items():
+            if name == "__metadata__":
+                continue
+            dtype = _ST_DTYPES[meta["dtype"]]
+            begin, end = meta["data_offsets"]
+            arr = np.frombuffer(
+                mm, dtype=dtype, count=int(np.prod(meta["shape"], dtype=np.int64)),
+                offset=base + begin,
+            ).reshape(meta["shape"])
+            assert arr.nbytes == end - begin, f"{name}: size mismatch"
+            yield name, arr
+
+
+def write_safetensors(path: str, tensors: Dict[str, np.ndarray]) -> None:
+    header: Dict[str, Any] = {}
+    offset = 0
+    arrays = {}
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        arrays[name] = arr
+        header[name] = {
+            "dtype": _ST_NAMES[arr.dtype],
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + arr.nbytes],
+        }
+        offset += arr.nbytes
+    blob = json.dumps(header).encode()
+    # Pad header to 8-byte alignment (spec allows trailing spaces).
+    blob += b" " * (-len(blob) % 8)
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(blob)))
+        f.write(blob)
+        for arr in arrays.values():
+            f.write(arr.tobytes())
+
+
+def _shard_files(path: str) -> list:
+    """All .safetensors files of a checkpoint dir, index-aware."""
+    index = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(index):
+        with open(index) as f:
+            weight_map = json.load(f)["weight_map"]
+        return sorted({os.path.join(path, v) for v in weight_map.values()})
+    files = sorted(
+        os.path.join(path, f)
+        for f in os.listdir(path)
+        if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+    return files
+
+
+# ----------------------------------------------------------------- HF config
+
+
+def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
+    """Build a ModelConfig from an HF checkpoint dir's config.json.
+
+    Covers the registered families: Llama (LlamaForCausalLM), Qwen2
+    (Qwen2ForCausalLM: adds QKV bias), Mixtral (MixtralForCausalLM: MoE).
+    """
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    archs = hf.get("architectures") or ["LlamaForCausalLM"]
+    arch = archs[0]
+    num_heads = hf["num_attention_heads"]
+    head_dim = hf.get("head_dim") or hf["hidden_size"] // num_heads
+    common = dict(
+        name=name or hf.get("model_type", "hf-model"),
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=num_heads,
+        num_kv_heads=hf.get("num_key_value_heads", num_heads),
+        head_dim=head_dim,
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        max_position_embeddings=hf.get("max_position_embeddings", 8192),
+        tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        sliding_window=int(hf.get("sliding_window") or 0),
+    )
+    if arch == "Qwen2ForCausalLM":
+        common["attn_bias"] = True
+    elif arch == "MixtralForCausalLM":
+        common.update(
+            num_experts=hf["num_local_experts"],
+            num_experts_per_tok=hf["num_experts_per_tok"],
+            moe_intermediate_size=hf["intermediate_size"],
+        )
+    elif arch != "LlamaForCausalLM":
+        raise ValueError(f"unsupported architecture {arch!r}")
+    return ModelConfig(**common)
+
+
+# ------------------------------------------------------------- name mapping
+
+# Leaf spec: (pytree path, transpose). Layer leaves live under "layers" and
+# get a layer index from the HF name; expert leaves also get an expert index.
+
+
+def _hf_leaf(cfg: ModelConfig, hf_name: str):
+    """Map one HF tensor name → (leaf_key, layer, expert, transpose) or None.
+
+    leaf_key is a top-level key ("embed", "final_norm", "lm_head") or a
+    "layers.<name>" key; transpose flips torch's [out, in] Linear layout to
+    our [in, out] einsum layout.
+    """
+    if hf_name == "model.embed_tokens.weight":
+        return ("embed", None, None, False)
+    if hf_name == "model.norm.weight":
+        return ("final_norm", None, None, False)
+    if hf_name == "lm_head.weight":
+        if cfg.tie_word_embeddings:
+            return None  # tied: unembed reads params["embed"]
+        return ("lm_head", None, None, True)
+    if not hf_name.startswith("model.layers."):
+        return None
+    rest = hf_name[len("model.layers."):]
+    layer_s, _, tail = rest.partition(".")
+    layer = int(layer_s)
+    simple = {
+        "input_layernorm.weight": ("layers.attn_norm", False),
+        "self_attn.q_proj.weight": ("layers.wq", True),
+        "self_attn.k_proj.weight": ("layers.wk", True),
+        "self_attn.v_proj.weight": ("layers.wv", True),
+        "self_attn.q_proj.bias": ("layers.bq", False),
+        "self_attn.k_proj.bias": ("layers.bk", False),
+        "self_attn.v_proj.bias": ("layers.bv", False),
+        "self_attn.o_proj.weight": ("layers.wo", True),
+        "post_attention_layernorm.weight": ("layers.mlp_norm", False),
+        "mlp.gate_proj.weight": ("layers.w_gate", True),
+        "mlp.up_proj.weight": ("layers.w_up", True),
+        "mlp.down_proj.weight": ("layers.w_down", True),
+        "block_sparse_moe.gate.weight": ("layers.router", True),
+    }
+    if tail in simple:
+        key, transpose = simple[tail]
+        return (key, layer, None, transpose)
+    if tail.startswith("block_sparse_moe.experts."):
+        sub = tail[len("block_sparse_moe.experts."):]
+        expert_s, _, w = sub.partition(".")
+        expert = int(expert_s)
+        moe = {
+            "w1.weight": "layers.w_gate",  # gate_proj
+            "w3.weight": "layers.w_up",  # up_proj
+            "w2.weight": "layers.w_down",  # down_proj
+        }
+        if w in moe:
+            return (moe[w], layer, expert, True)
+    return None
+
+
+def _leaf_shapes(cfg: ModelConfig) -> Dict[str, Tuple[int, ...]]:
+    """Target (host staging) shape per leaf key — mirrors llama.init_params."""
+    E, L = cfg.hidden_size, cfg.num_layers
+    Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    shapes: Dict[str, Tuple[int, ...]] = {
+        "embed": (cfg.vocab_size, E),
+        "final_norm": (E,),
+        "layers.attn_norm": (L, E),
+        "layers.wq": (L, E, Hq * D),
+        "layers.wk": (L, E, Hkv * D),
+        "layers.wv": (L, E, Hkv * D),
+        "layers.wo": (L, Hq * D, E),
+        "layers.mlp_norm": (L, E),
+    }
+    if cfg.attn_bias:
+        shapes.update(
+            {
+                "layers.bq": (L, Hq * D),
+                "layers.bk": (L, Hkv * D),
+                "layers.bv": (L, Hkv * D),
+            }
+        )
+    if cfg.is_moe:
+        X, Fm = cfg.num_experts, cfg.moe_intermediate_size
+        shapes.update(
+            {
+                "layers.router": (L, E, X),
+                "layers.w_gate": (L, X, E, Fm),
+                "layers.w_up": (L, X, E, Fm),
+                "layers.w_down": (L, X, Fm, E),
+            }
+        )
+    else:
+        F = cfg.intermediate_size
+        shapes.update(
+            {
+                "layers.w_gate": (L, E, F),
+                "layers.w_up": (L, E, F),
+                "layers.w_down": (L, F, E),
+            }
+        )
+    if not cfg.tie_word_embeddings:
+        shapes["lm_head"] = (E, cfg.vocab_size)
+    return shapes
+
+
+_NORM_LEAVES = {"final_norm", "layers.attn_norm", "layers.mlp_norm"}
+
+
+def load_checkpoint(
+    path: str,
+    cfg: ModelConfig,
+    dtype=jnp.bfloat16,
+    shardings: Optional[Dict[str, Any]] = None,
+) -> Params:
+    """Load an HF safetensors checkpoint dir into the stacked param pytree.
+
+    Norm weights stage as float32 (matching init_params — rms_norm computes
+    in f32); everything else as `dtype`. When `shardings` (the pytree from
+    parallel/sharding.param_shardings) is given, each finished leaf is
+    device_put with its NamedSharding so devices receive only their shard.
+    """
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"checkpoint dir {path!r} does not exist")
+    np_dtype = ml_dtypes.bfloat16 if dtype == jnp.bfloat16 else np.dtype(dtype)
+    shapes = _leaf_shapes(cfg)
+    staging: Dict[str, np.ndarray] = {}
+    filled: Dict[str, np.ndarray] = {
+        k: np.zeros(s[0] if len(s) > 1 else 1, dtype=bool)
+        if k.startswith("layers.")
+        else np.zeros(1, dtype=bool)
+        for k, s in shapes.items()
+    }
+
+    def stage(key: str) -> np.ndarray:
+        if key not in staging:
+            want = np.float32 if key in _NORM_LEAVES else np_dtype
+            staging[key] = np.empty(shapes[key], dtype=want)
+        return staging[key]
+
+    for file in _shard_files(path):
+        for name, arr in read_safetensors(file):
+            spec = _hf_leaf(cfg, name)
+            if spec is None:
+                continue
+            key, layer, expert, transpose = spec
+            if key not in shapes:
+                raise ValueError(
+                    f"{name} maps to {key!r} which this config lacks "
+                    f"(attn_bias={cfg.attn_bias}, is_moe={cfg.is_moe})"
+                )
+            buf = stage(key)
+            src = arr.T if transpose else arr
+            if layer is None:
+                np.copyto(buf, src, casting="unsafe")
+                filled[key][0] = True
+            elif expert is None:
+                np.copyto(buf[layer], src, casting="unsafe")
+                filled[key][layer] = True
+            else:
+                np.copyto(buf[layer, expert], src, casting="unsafe")
+                # expert leaves complete when the last expert lands
+                if expert == cfg.num_experts - 1:
+                    filled[key][layer] = True
+
+    missing = [k for k, f in filled.items() if not f.all()]
+    if missing:
+        raise ValueError(f"checkpoint {path} is missing tensors for {missing}")
+
+    params: Params = {"layers": {}}
+    for key, buf in staging.items():
+        leaf = jnp.asarray(buf)
+        if shardings is not None:
+            if key.startswith("layers."):
+                sh = shardings["layers"][key.split(".", 1)[1]]
+            else:
+                sh = shardings[key]
+            leaf = jax.device_put(leaf, sh)
+        if key.startswith("layers."):
+            params["layers"][key.split(".", 1)[1]] = leaf
+        else:
+            params[key] = leaf
+    return params
+
+
+# ---------------------------------------------------------------- HF export
+
+
+def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
+    """Write params back out as an HF-layout checkpoint dir (config.json +
+    model.safetensors) — the inverse of load_checkpoint. Used by the
+    round-trip test and for exporting synthetic checkpoints."""
+    os.makedirs(path, exist_ok=True)
+    arch = (
+        "MixtralForCausalLM"
+        if cfg.is_moe
+        else ("Qwen2ForCausalLM" if cfg.attn_bias else "LlamaForCausalLM")
+    )
+    hf_cfg = {
+        "architectures": [arch],
+        "model_type": arch[: -len("ForCausalLM")].lower(),
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": (
+            cfg.moe_intermediate_size if cfg.is_moe else cfg.intermediate_size
+        ),
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_kv_heads,
+        "head_dim": cfg.head_dim,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "max_position_embeddings": cfg.max_position_embeddings,
+        "tie_word_embeddings": cfg.tie_word_embeddings,
+    }
+    if cfg.is_moe:
+        hf_cfg["num_local_experts"] = cfg.num_experts
+        hf_cfg["num_experts_per_tok"] = cfg.num_experts_per_tok
+    if cfg.sliding_window:
+        hf_cfg["sliding_window"] = cfg.sliding_window
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(hf_cfg, f, indent=2)
+
+    def host(x) -> np.ndarray:
+        a = np.asarray(x)
+        return a.astype(ml_dtypes.bfloat16) if a.dtype == ml_dtypes.bfloat16 else a
+
+    lp = params["layers"]
+    tensors: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": host(params["embed"]),
+        "model.norm.weight": host(params["final_norm"]),
+    }
+    if not cfg.tie_word_embeddings:
+        tensors["lm_head.weight"] = host(params["lm_head"]).T
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        tensors[pre + "input_layernorm.weight"] = host(lp["attn_norm"])[i]
+        tensors[pre + "post_attention_layernorm.weight"] = host(lp["mlp_norm"])[i]
+        tensors[pre + "self_attn.q_proj.weight"] = host(lp["wq"])[i].T
+        tensors[pre + "self_attn.k_proj.weight"] = host(lp["wk"])[i].T
+        tensors[pre + "self_attn.v_proj.weight"] = host(lp["wv"])[i].T
+        tensors[pre + "self_attn.o_proj.weight"] = host(lp["wo"])[i].T
+        if cfg.attn_bias:
+            tensors[pre + "self_attn.q_proj.bias"] = host(lp["bq"])[i]
+            tensors[pre + "self_attn.k_proj.bias"] = host(lp["bk"])[i]
+            tensors[pre + "self_attn.v_proj.bias"] = host(lp["bv"])[i]
+        if cfg.is_moe:
+            tensors[pre + "block_sparse_moe.gate.weight"] = host(lp["router"])[i].T
+            for j in range(cfg.num_experts):
+                ep = pre + f"block_sparse_moe.experts.{j}."
+                tensors[ep + "w1.weight"] = host(lp["w_gate"])[i, j].T
+                tensors[ep + "w3.weight"] = host(lp["w_up"])[i, j].T
+                tensors[ep + "w2.weight"] = host(lp["w_down"])[i, j].T
+        else:
+            tensors[pre + "mlp.gate_proj.weight"] = host(lp["w_gate"])[i].T
+            tensors[pre + "mlp.up_proj.weight"] = host(lp["w_up"])[i].T
+            tensors[pre + "mlp.down_proj.weight"] = host(lp["w_down"])[i].T
+    write_safetensors(os.path.join(path, "model.safetensors"), tensors)
