@@ -1,0 +1,158 @@
+"""Batched/overlapped prefill (round-1 weak item 4).
+
+Admission prefills concurrent waiting requests in shared compiled steps:
+same-bucket prompts ride ONE device call, so TTFT under a burst stacks
+sub-linearly instead of one-jit-call-per-request. Parity with the
+sequential path must be exact (greedy).
+"""
+
+import threading
+
+import numpy as np
+
+from xllm_service_tpu.common.config import EngineConfig
+from xllm_service_tpu.ops.sampling import SamplingParams
+from xllm_service_tpu.runtime.engine import EngineRequest, InferenceEngine
+from xllm_service_tpu.runtime.executor import ModelExecutor, PrefillItem
+
+
+def _cfg(**kw):
+    base = dict(
+        model="llama3-tiny",
+        num_blocks=96,
+        max_running_requests=16,
+        max_seq_len=256,
+        prefill_buckets=[32, 64],
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def test_prefill_batch_matches_sequential():
+    """prefill_batch over mixed-length items == one-at-a-time prefill."""
+    exe_a = ModelExecutor(_cfg(), init_seed=3)
+    exe_b = ModelExecutor(_cfg(), init_seed=3)
+
+    rng = np.random.default_rng(0)
+    items = []
+    base_block = 1
+    for i, n in enumerate([5, 17, 33, 9]):
+        table = np.zeros((exe_a.max_blocks_per_seq,), np.int32)
+        nb = (n + 1 + exe_a.block_size - 1) // exe_a.block_size
+        table[:nb] = np.arange(base_block, base_block + nb)
+        base_block += nb
+        items.append(
+            PrefillItem(
+                token_ids=rng.integers(0, 512, n).astype(np.int32),
+                start_pos=0,
+                block_table=table,
+            )
+        )
+
+    seq_results = [
+        exe_a.prefill(it.token_ids, it.start_pos, it.block_table)
+        for it in items
+    ]
+    batch_results = exe_b.prefill_batch(items)
+    # Tokens must match exactly; logprobs only to float tolerance (the P=1
+    # and P=4 programs reduce in different orders).
+    assert [t for t, _ in seq_results] == [t for t, _ in batch_results]
+    np.testing.assert_allclose(
+        [l for _, l in seq_results], [l for _, l in batch_results], atol=1e-4
+    )
+    # Caches identical outside garbage block 0 (masked/padded rows collide
+    # there with nondeterministic winners — by design).
+    np.testing.assert_array_equal(
+        np.asarray(exe_a.k_cache)[:, 1:], np.asarray(exe_b.k_cache)[:, 1:]
+    )
+
+
+def test_burst_shares_compiled_steps():
+    """8 concurrent same-bucket prompts are admitted in at most 2 batched
+    prefill calls (not 8 sequential ones)."""
+    exe = ModelExecutor(_cfg(), init_seed=1)
+    calls = []
+    orig = exe._prefill_group
+
+    def counting(group):
+        calls.append(len(group))
+        return orig(group)
+
+    exe._prefill_group = counting
+
+    eng = InferenceEngine(_cfg(), executor=exe)
+    done = []
+    rng = np.random.default_rng(7)
+    # Enqueue BEFORE starting the engine so one _admit sees the full burst.
+    for i in range(8):
+        ev = threading.Event()
+        done.append(ev)
+
+        def cb(out, ev=ev):
+            if out.finished:
+                ev.set()
+            return True
+
+        eng.add_request(
+            EngineRequest(
+                request_id=f"b{i}",
+                prompt_token_ids=[int(t) for t in rng.integers(0, 512, 20 + i)],
+                sampling=SamplingParams(temperature=0.0, max_new_tokens=4),
+                callback=cb,
+            )
+        )
+    eng.start()
+    try:
+        for ev in done:
+            assert ev.wait(120.0)
+    finally:
+        eng.stop()
+    assert sum(calls) == 8  # every request prefilled exactly once
+    assert len(calls) <= 2, f"burst used {len(calls)} prefill steps: {calls}"
+    assert max(calls) == 8
+
+
+def test_engine_batched_greedy_parity():
+    """Concurrent requests through the batching engine produce the same
+    greedy streams as the same requests run one at a time."""
+    prompts = [
+        [int(t) for t in np.random.default_rng(i).integers(0, 512, 8 + 3 * i)]
+        for i in range(5)
+    ]
+
+    def run(concurrent: bool):
+        eng = InferenceEngine(_cfg(), executor=ModelExecutor(_cfg(), init_seed=4))
+        eng.start()
+        results = {}
+        try:
+            events = []
+            for i, p in enumerate(prompts):
+                toks = []
+                results[i] = toks
+                ev = threading.Event()
+                events.append(ev)
+
+                def cb(out, toks=toks, ev=ev):
+                    for s in out.outputs:
+                        toks.extend(s.token_ids)
+                    if out.finished:
+                        ev.set()
+                    return True
+
+                eng.add_request(
+                    EngineRequest(
+                        request_id=f"r{i}",
+                        prompt_token_ids=p,
+                        sampling=SamplingParams(temperature=0.0, max_new_tokens=6),
+                        callback=cb,
+                    )
+                )
+                if not concurrent:
+                    assert ev.wait(120.0)
+            for ev in events:
+                assert ev.wait(120.0)
+        finally:
+            eng.stop()
+        return results
+
+    assert run(False) == run(True)

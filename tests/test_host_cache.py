@@ -1,0 +1,165 @@
+"""Engine-side host (DRAM) cache tier (round-1 missing item 4).
+
+Committed HBM blocks evicted under pressure are copied to the host pool
+(heartbeat delta: offload_cache['dram']), then re-imported on a later
+prefix match (delta: stored — re-promotion), and the service index follows
+the tier transitions (reference global_kvcache_mgr.cpp:177-225 contract).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from xllm_service_tpu.common.config import EngineConfig
+from xllm_service_tpu.common.hashing import prefix_block_hashes
+from xllm_service_tpu.common.types import KvCacheEvent
+from xllm_service_tpu.ops.sampling import SamplingParams
+from xllm_service_tpu.runtime.engine import EngineRequest, InferenceEngine
+from xllm_service_tpu.runtime.executor import ModelExecutor
+from xllm_service_tpu.runtime.host_cache import HostKVPool
+
+
+def test_host_pool_lru():
+    pool = HostKVPool(2)
+    a = np.zeros((2, 1, 2, 4, 8), np.float32)
+    assert pool.put(b"h1", a) == []
+    assert pool.put(b"h2", a) == []
+    assert pool.get(b"h1") is not None  # h1 now MRU
+    assert pool.put(b"h3", a) == [b"h2"]  # h2 was LRU
+    assert pool.get(b"h2") is None
+    assert b"h1" in pool and b"h3" in pool
+
+
+class _EngineHarness:
+    def __init__(self, num_host_blocks: int):
+        self.cfg = EngineConfig(
+            model="llama3-tiny",
+            num_blocks=4,  # 3 usable: tight enough to force eviction
+            block_size=16,
+            max_running_requests=2,
+            max_seq_len=64,
+            prefill_buckets=[48],
+            num_host_blocks=num_host_blocks,
+        )
+        self.exe = ModelExecutor(self.cfg, init_seed=2)
+        self.prefill_items = []
+        orig = self.exe.prefill_batch
+
+        def spy(items):
+            self.prefill_items.extend(items)
+            return orig(items)
+
+        self.exe.prefill_batch = spy
+        self.engine = InferenceEngine(self.cfg, executor=self.exe)
+        self.engine.start()
+
+    def run(self, prompt, max_new=2):
+        ev = threading.Event()
+
+        def cb(out):
+            if out.finished:
+                ev.set()
+            return True
+
+        self.engine.add_request(
+            EngineRequest(
+                request_id=f"req{id(prompt) % 1000}-{len(self.prefill_items)}",
+                prompt_token_ids=list(prompt),
+                sampling=SamplingParams(temperature=0.0, max_new_tokens=max_new),
+                callback=cb,
+            )
+        )
+        assert ev.wait(120.0)
+
+    def stop(self):
+        self.engine.stop()
+
+
+def test_offload_reimport_cycle():
+    h = _EngineHarness(num_host_blocks=8)
+    try:
+        bs = h.cfg.block_size
+        prompt_a = [(i * 11 + 1) % 512 for i in range(40)]  # 2 full blocks
+        prompt_b = [(i * 7 + 3) % 512 for i in range(40)]
+
+        h.run(prompt_a)
+        ev_a = h.engine.take_cache_event()
+        hashes_a = prefix_block_hashes(prompt_a, bs, h.engine.block_mgr.seed)
+        assert set(hashes_a[:2]) <= ev_a.stored_cache
+        assert not ev_a.offload_cache
+
+        # B forces eviction of A's committed blocks -> host offload.
+        h.run(prompt_b)
+        ev_b = h.engine.take_cache_event()
+        offloaded = {hh for hh in hashes_a[:2] if hh in ev_b.offload_cache}
+        assert offloaded, f"no offload events: {ev_b.to_json()}"
+        for hh in offloaded:
+            assert ev_b.offload_cache[hh] == "dram"
+            assert hh in h.engine.host_pool
+
+        # A again: host blocks re-import, prefill starts past them.
+        n_items_before = len(h.prefill_items)
+        h.run(prompt_a)
+        item = h.prefill_items[n_items_before]
+        assert item.start_pos >= bs, (
+            f"host re-import missed: start_pos={item.start_pos}"
+        )
+        ev_a2 = h.engine.take_cache_event()
+        # re-promotion: at least the re-imported hashes are stored again
+        assert offloaded & ev_a2.stored_cache
+    finally:
+        h.stop()
+
+
+def test_service_index_follows_tiers():
+    """The engine's real event stream drives the service index through
+    hbm -> dram -> hbm for the same instance."""
+    from xllm_service_tpu.cluster.global_kvcache_mgr import GlobalKVCacheMgr
+    from xllm_service_tpu.coordination.store import MemoryStore
+
+    h = _EngineHarness(num_host_blocks=8)
+    try:
+        bs = h.cfg.block_size
+        prompt_a = [(i * 11 + 1) % 512 for i in range(40)]
+        prompt_b = [(i * 7 + 3) % 512 for i in range(40)]
+        mgr = GlobalKVCacheMgr(
+            MemoryStore(), is_master=lambda: True, block_size=bs,
+            murmur_hash3_seed=h.engine.block_mgr.seed,
+        )
+        inst = "engine-0"
+
+        h.run(prompt_a)
+        mgr.record_updated_kvcaches(inst, h.engine.take_cache_event())
+        hashes_a = prefix_block_hashes(prompt_a, bs, h.engine.block_mgr.seed)
+        loc = mgr.lookup(hashes_a[0])
+        assert inst in loc.hbm_instance_set
+
+        h.run(prompt_b)
+        mgr.record_updated_kvcaches(inst, h.engine.take_cache_event())
+        loc = mgr.lookup(hashes_a[0])
+        assert inst in loc.dram_instance_set
+        assert inst not in loc.hbm_instance_set
+
+        h.run(prompt_a)
+        mgr.record_updated_kvcaches(inst, h.engine.take_cache_event())
+        loc = mgr.lookup(hashes_a[0])
+        assert inst in loc.hbm_instance_set
+        assert inst not in loc.dram_instance_set
+    finally:
+        h.stop()
+
+
+def test_no_host_pool_means_removed_events():
+    h = _EngineHarness(num_host_blocks=0)
+    try:
+        assert h.engine.host_pool is None
+        prompt_a = [(i * 11 + 1) % 512 for i in range(40)]
+        prompt_b = [(i * 7 + 3) % 512 for i in range(40)]
+        h.run(prompt_a)
+        h.engine.take_cache_event()
+        h.run(prompt_b)
+        ev = h.engine.take_cache_event()
+        assert ev.removed_cache and not ev.offload_cache
+    finally:
+        h.stop()

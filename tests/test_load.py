@@ -1,0 +1,125 @@
+"""Service-tier load test (round-1 weak item 6): 128 concurrent streaming
+requests through master + 2 fake-engine instances over real sockets —
+the reference's concurrency defaults (32 server threads / 128 concurrency,
+global_gflags.cpp:33-47; 128 ordered output lanes, scheduler.h:112).
+
+Asserts correctness under load (every stream completes, in order, with all
+its tokens) and prints one JSON line with throughput/latency percentiles
+that BASELINE.md records.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from xllm_service_tpu.api import FakeEngine, Master
+from xllm_service_tpu.api.instance import InstanceServer
+from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+from xllm_service_tpu.coordination import MemoryStore
+
+from tests.test_api_e2e import sse_post, wait_until
+
+CONCURRENCY = 128
+TOKENS_PER_REQ = 16
+
+
+@pytest.fixture(scope="module")
+def load_cluster():
+    store = MemoryStore()
+    cfg = ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.5, master_lease_ttl_s=2.0,
+        load_balance_policy="RR", block_size=16,
+    )
+    master = Master(cfg, store=store)
+    master.start()
+    instances = []
+    for i in range(2):
+        ecfg = EngineConfig(
+            model="fake-echo", instance_name=f"mix{i}", instance_type="MIX",
+            block_size=16,
+        )
+        srv = InstanceServer(
+            ecfg, master_rpc_addr=master.rpc_address,
+            heartbeat_interval_s=0.5,
+            engine=FakeEngine(token_delay_s=0.002, ttft_ms=5.0),
+        )
+        srv.start()
+        instances.append(srv)
+    assert wait_until(
+        lambda: sum(master.scheduler.instance_mgr.counts()) == 2
+    )
+    yield master, instances, store
+    for srv in instances:
+        srv.stop()
+    master.stop()
+    store.close()
+
+
+def test_128_concurrent_streams(load_cluster):
+    master, instances, _ = load_cluster
+    results = [None] * CONCURRENCY
+    latencies = [0.0] * CONCURRENCY
+    errors = []
+
+    def drive(i):
+        t0 = time.monotonic()
+        try:
+            events = sse_post(
+                master.http_address, "/v1/completions",
+                {
+                    "model": "fake-echo",
+                    # FakeEngine echoes prompt tokens: keep the prompt at
+                    # least TOKENS_PER_REQ bytes long.
+                    "prompt": f"load-{i:04d}-" + "x" * TOKENS_PER_REQ,
+                    "max_tokens": TOKENS_PER_REQ,
+                    "temperature": 0.0,
+                    "stream": True,
+                },
+                timeout=120.0,
+            )
+            results[i] = events
+        except Exception as e:  # noqa: BLE001 — collected and asserted
+            errors.append((i, repr(e)))
+        latencies[i] = time.monotonic() - t0
+
+    t_start = time.monotonic()
+    threads = [
+        threading.Thread(target=drive, args=(i,)) for i in range(CONCURRENCY)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180.0)
+    wall = time.monotonic() - t_start
+
+    assert not errors, f"{len(errors)} requests failed: {errors[:5]}"
+    total_tokens = 0
+    for i, events in enumerate(results):
+        assert events is not None, f"request {i} never completed"
+        assert events[-1] == "[DONE]"
+        texts = [
+            e["choices"][0]["text"] for e in events[:-1] if e.get("choices")
+        ]
+        assert len(texts) == TOKENS_PER_REQ, (
+            f"request {i}: {len(texts)} tokens"
+        )
+        total_tokens += len(texts)
+
+    lat = sorted(latencies)
+    summary = {
+        "metric": "service_tier_load",
+        "concurrency": CONCURRENCY,
+        "total_tokens": total_tokens,
+        "wall_s": round(wall, 3),
+        "throughput_tok_s": round(total_tokens / wall, 1),
+        "req_p50_s": round(lat[len(lat) // 2], 3),
+        "req_p99_s": round(lat[int(len(lat) * 0.99)], 3),
+    }
+    print("\nLOAD " + json.dumps(summary))
+    # Generous sanity ceiling — catches pathological serialization (e.g.
+    # the whole batch taking CONCURRENCY * per-request time).
+    ideal = TOKENS_PER_REQ * 0.002
+    assert lat[int(len(lat) * 0.99)] < 60 * ideal, summary

@@ -1,0 +1,147 @@
+"""Multi-device SERVING parity on the virtual 8-device CPU mesh.
+
+Covers VERDICT round-1 weak item 3: production decode/prefill through
+ModelExecutor + InferenceEngine actually executing with tp>1 / dp>1,
+exercising kv_cache_sharding — not just the training dryrun. Token streams
+must match the tp=1 oracle exactly (greedy) on the same weights.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from xllm_service_tpu.common.config import EngineConfig
+from xllm_service_tpu.ops.sampling import SamplingParams
+from xllm_service_tpu.runtime.engine import EngineRequest, InferenceEngine
+from xllm_service_tpu.runtime.executor import ModelExecutor, SamplingBatch
+
+
+def _engine_cfg(**kw) -> EngineConfig:
+    base = dict(
+        model="llama3-tiny",
+        num_blocks=64,
+        max_running_requests=4,
+        max_seq_len=256,
+        prefill_buckets=[32, 64],
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _greedy_tokens(exe: ModelExecutor, prompt: np.ndarray, steps: int):
+    """Prefill one sequence then greedy-decode `steps` tokens."""
+    table = np.zeros((exe.max_blocks_per_seq,), np.int32)
+    table[0] = 2
+    table[1] = 3
+    tok, lp = exe.prefill(prompt, 0, table)
+    toks, lps = [tok], [lp]
+    R = exe.R
+    ids = np.zeros(R, np.int32)
+    pos = np.zeros(R, np.int32)
+    tables = np.zeros((R, exe.max_blocks_per_seq), np.int32)
+    tables[0] = table
+    active = np.zeros(R, bool)
+    active[0] = True
+    batch = SamplingBatch(
+        temperature=np.zeros(R, np.float32),
+        top_k=np.zeros(R, np.int32),
+        top_p=np.ones(R, np.float32),
+        seeds=np.zeros(R, np.uint32),
+        steps=np.zeros(R, np.int32),
+    )
+    cur, p = tok, len(prompt)
+    for _ in range(steps):
+        ids[0], pos[0] = cur, p
+        t, l = exe.decode(ids, pos, tables, active, batch)
+        cur = int(t[0])
+        toks.append(cur)
+        lps.append(float(l[0]))
+        p += 1
+    return toks, lps
+
+
+@pytest.mark.parametrize("dp,tp", [(1, 2), (2, 1), (2, 2)], ids=["tp2", "dp2", "dp2tp2"])
+def test_executor_sharded_decode_parity(cpu_devices, dp, tp):
+    """tp/dp-sharded executor produces the tp=1 oracle's exact greedy
+    tokens (same init seed -> identical weights regardless of sharding)."""
+    prompt = (np.arange(11, dtype=np.int32) * 7 + 3) % 512
+    ref_exe = ModelExecutor(_engine_cfg(), init_seed=5)
+    ref_toks, ref_lps = _greedy_tokens(ref_exe, prompt, 6)
+
+    exe = ModelExecutor(_engine_cfg(dp_size=dp, tp_size=tp), init_seed=5)
+    assert exe.mesh.shape == {"dp": dp, "tp": tp}
+    toks, lps = _greedy_tokens(exe, prompt, 6)
+    assert toks == ref_toks
+    # bf16 activations + tp-parallel psum reduce in different orders:
+    # tokens must be identical, logprobs only close.
+    np.testing.assert_allclose(lps, ref_lps, atol=0.05)
+
+
+def _run_engine(exe: ModelExecutor, prompts, steps: int):
+    eng = InferenceEngine(exe.engine_cfg, executor=exe)
+    eng.start()
+    results = {}
+    events = []
+    try:
+        for i, prompt in enumerate(prompts):
+            done = threading.Event()
+            events.append(done)
+            toks = []
+            results[i] = toks
+
+            def cb(out, toks=toks, done=done):
+                for s in out.outputs:
+                    toks.extend(s.token_ids)
+                if out.finished:
+                    done.set()
+                return True
+
+            eng.add_request(
+                EngineRequest(
+                    request_id=f"r{i}",
+                    prompt_token_ids=list(prompt),
+                    sampling=SamplingParams(
+                        temperature=0.0, max_new_tokens=steps
+                    ),
+                    callback=cb,
+                )
+            )
+        for done in events:
+            assert done.wait(60.0), "engine request timed out"
+    finally:
+        eng.stop()
+    return results
+
+
+@pytest.mark.parametrize("dp,tp,ep", [(1, 1, 2), (1, 2, 2), (2, 1, 2)],
+                         ids=["ep2", "tp2ep2", "dp2ep2"])
+def test_moe_ep_decode_parity(cpu_devices, dp, tp, ep):
+    """MoE decode with experts sharded over an ep axis (EP serving path —
+    the combine contraction makes XLA emit the psum) matches the
+    single-device dense-all-experts oracle token for token."""
+    prompt = (np.arange(13, dtype=np.int32) * 5 + 2) % 512
+    ref_exe = ModelExecutor(_engine_cfg(model="moe-tiny"), init_seed=7)
+    ref_toks, ref_lps = _greedy_tokens(ref_exe, prompt, 6)
+
+    exe = ModelExecutor(
+        _engine_cfg(model="moe-tiny", dp_size=dp, tp_size=tp, ep_size=ep),
+        init_seed=7,
+    )
+    assert exe.mesh.shape == {"dp": dp, "tp": tp, "ep": ep}
+    toks, lps = _greedy_tokens(exe, prompt, 6)
+    assert toks == ref_toks
+    np.testing.assert_allclose(lps, ref_lps, atol=0.05)
+
+
+def test_engine_tp2_parity(cpu_devices):
+    """Full continuous-batching engine over a tp=2 mesh: token streams for
+    concurrent greedy requests match the tp=1 engine's."""
+    prompts = [
+        [(i * 13 + j * 5 + 1) % 512 for j in range(9 + i)] for i in range(3)
+    ]
+    ref = _run_engine(ModelExecutor(_engine_cfg(), init_seed=9), prompts, 8)
+    tp2 = _run_engine(
+        ModelExecutor(_engine_cfg(tp_size=2), init_seed=9), prompts, 8
+    )
+    assert ref == tp2

@@ -124,6 +124,77 @@ def test_disagg_streaming(pd_stack):
     assert prefill.engine.block_mgr is not decode.engine.block_mgr
 
 
+@pytest.fixture(scope="module")
+def relay_stack():
+    """PD stack running the ALTERNATE response topology
+    (enable_decode_response_to_service=False — reference service.h:61-71):
+    decode relays generations back through the prefill instance."""
+    store = MemoryStore()
+    cfg = ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0,
+        load_balance_policy="RR", block_size=BLOCK,
+        enable_decode_response_to_service=False,
+    )
+    master = Master(cfg, store=store)
+    master.start()
+    prefill = InstanceServer(
+        engine_cfg("pre1", "PREFILL"), master_rpc_addr=master.rpc_address,
+        heartbeat_interval_s=0.2,
+    )
+    decode = InstanceServer(
+        engine_cfg("dec1", "DECODE"), master_rpc_addr=master.rpc_address,
+        heartbeat_interval_s=0.2,
+    )
+    prefill.start()
+    decode.start()
+    assert wait_until(
+        lambda: master.scheduler.instance_mgr.counts() == (1, 1, 0)
+    )
+    yield master, prefill, decode, store
+    prefill.stop()
+    decode.stop()
+    master.stop()
+    store.close()
+
+
+def test_relay_topology_matches_colocated(relay_stack, colocated):
+    master, prefill, decode, _ = relay_stack
+    relayed = []
+    orig = decode._relay_generations
+
+    def spy(addr, outs):
+        relayed.append(addr)
+        return orig(addr, outs)
+
+    decode._relay_generations = spy
+    try:
+        prompt = "w" * (BLOCK * 3 + 5)
+        got = completion(master, prompt)
+        want = completion(colocated, prompt)
+        assert got["choices"][0]["text"] == want["choices"][0]["text"]
+        assert got["usage"] == want["usage"]
+        # tokens actually flowed through the prefill instance
+        assert relayed and all(a == prefill.address for a in relayed)
+    finally:
+        decode._relay_generations = orig
+
+
+def test_relay_topology_streaming(relay_stack):
+    master, prefill, decode, _ = relay_stack
+    events = sse_post(
+        master.http_address, "/v1/completions",
+        {"model": "llama3-tiny", "prompt": "v" * 40, "max_tokens": 6,
+         "temperature": 0.0, "stream": True},
+        timeout=300.0,
+    )
+    assert events[-1] == "[DONE]"
+    texts = [e["choices"][0]["text"] for e in events[:-1] if e.get("choices")]
+    assert len(texts) == 6
+    # relay bookkeeping fully reaped after finish
+    assert wait_until(lambda: not decode._relay_addrs)
+
+
 def test_decode_side_has_imported_blocks(pd_stack):
     master, prefill, decode, _ = pd_stack
     prompt = "z" * (BLOCK * 2)

@@ -138,6 +138,36 @@ def test_missing_tensor_raises(tmp_path):
         weights.load_checkpoint(str(ckpt), cfg, jnp.bfloat16)
 
 
+def test_missing_expert_raises(tmp_path):
+    """A MoE checkpoint missing ONE expert's tensor must raise, not serve
+    uninitialized garbage for that expert."""
+    cfg = get_model_config("moe-tiny")
+    params = llama.init_params(cfg, jax.random.key(1), jnp.bfloat16)
+    ckpt = tmp_path / "ckpt"
+    weights.save_hf_checkpoint(params, cfg, str(ckpt))
+    tensors = dict(weights.read_safetensors(str(ckpt / "model.safetensors")))
+    tensors = {k: v.copy() for k, v in tensors.items()}
+    del tensors["model.layers.0.block_sparse_moe.experts.2.w1.weight"]
+    weights.write_safetensors(str(ckpt / "model.safetensors"), tensors)
+    with pytest.raises(ValueError, match="missing"):
+        weights.load_checkpoint(str(ckpt), cfg, jnp.bfloat16)
+
+
+def test_executor_uses_checkpoint_config(tmp_path):
+    """checkpoint_path with a config.json NOT in the registry: the executor
+    derives the architecture from the checkpoint (config_from_hf), so real
+    HF dirs serve without a pre-registered config."""
+    params = llama.init_params(QWEN_TINY, jax.random.key(5), jnp.bfloat16)
+    ckpt = str(tmp_path / "ckpt")
+    weights.save_hf_checkpoint(params, QWEN_TINY, ckpt)
+    ecfg = EngineConfig(model="not-in-registry", checkpoint_path=ckpt,
+                       num_blocks=16, max_running_requests=2,
+                       max_seq_len=128, prefill_buckets=[32])
+    exe = ModelExecutor(ecfg)
+    assert exe.cfg.attn_bias and exe.cfg.hidden_size == QWEN_TINY.hidden_size
+    _tree_equal(params, exe.params)
+
+
 def test_executor_serves_from_checkpoint(tmp_path):
     """An executor given checkpoint_path produces the exact tokens of one
     holding the same params in memory (greedy decode, real prefill)."""

@@ -101,6 +101,10 @@ class HeartbeatLoop:
         self._collect_latency = collect_latency
         self._collect_cache_event = collect_cache_event
         self._stop = threading.Event()
+        # Cache delta drained from the engine but not yet delivered: merged
+        # into the next beat so a failed POST never loses transitions (the
+        # global KV index would silently diverge otherwise).
+        self._pending_event: Optional[KvCacheEvent] = None
         self._thread = threading.Thread(
             target=self._loop, name=f"heartbeat-{meta.name}", daemon=True
         )
@@ -119,16 +123,29 @@ class HeartbeatLoop:
         return self._beat()
 
     def _beat(self) -> Dict:
-        resp = self._client.heartbeat(
-            self._meta.name,
-            load_metrics=self._collect_load() if self._collect_load else None,
-            latency_metrics=(
-                self._collect_latency() if self._collect_latency else None
-            ),
-            cache_event=(
-                self._collect_cache_event() if self._collect_cache_event else None
-            ),
-        )
+        event = self._collect_cache_event() if self._collect_cache_event else None
+        if self._pending_event is not None:
+            event = (
+                self._pending_event.merge(event)
+                if event is not None
+                else self._pending_event
+            )
+            self._pending_event = None
+        try:
+            resp = self._client.heartbeat(
+                self._meta.name,
+                load_metrics=self._collect_load() if self._collect_load else None,
+                latency_metrics=(
+                    self._collect_latency() if self._collect_latency else None
+                ),
+                cache_event=event,
+            )
+        except Exception:
+            self._pending_event = event
+            raise
+        if not resp.get("ok", False) and event is not None and not event.empty():
+            # Master rejected/unreachable: keep the delta for the next beat.
+            self._pending_event = event
         if resp.get("reregister"):
             try:
                 self._client.register(self._meta)

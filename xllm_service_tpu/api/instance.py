@@ -17,10 +17,11 @@ Serves two modes on the same endpoints:
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from xllm_service_tpu.api.client import HeartbeatLoop, MasterClient
 from xllm_service_tpu.api.http_utils import (
@@ -57,11 +58,20 @@ def sampling_from_body(body: Dict[str, Any], cfg: EngineConfig) -> SamplingParam
     )
     lp = body.get("logprobs")
     top_lp = int(body.get("top_logprobs", 0) or 0)
+    raw_seed = body.get("seed")
+    # OpenAI semantics: unseeded sampling varies per call. Only an explicit
+    # client seed (any value, 0 included) gives the deterministic stream;
+    # otherwise draw a fresh per-request seed.
+    seed = (
+        int(raw_seed)
+        if raw_seed is not None
+        else int.from_bytes(os.urandom(4), "little")
+    )
     return SamplingParams(
         temperature=float(body.get("temperature", 1.0)),
         top_p=float(body.get("top_p", 1.0)),
         top_k=int(body.get("top_k", 0) or 0),
-        seed=int(body.get("seed", 0) or 0),
+        seed=seed,
         logprobs=bool(lp),
         top_logprobs=top_lp if top_lp else (int(lp) if isinstance(lp, int) else 0),
         max_new_tokens=max_tokens or cfg.max_new_tokens_default,
@@ -141,17 +151,42 @@ class InstanceServer:
         self._srid_mu = threading.Lock()
         # decode-peer address cache (PD disagg handoff target)
         self._peer_addrs: Dict[str, str] = {}
+        # Alternate PD response topology (service.h:61-71 analog): srid ->
+        # prefill-instance address to relay generations through instead of
+        # pushing to the master directly.
+        self._relay_addrs: Dict[str, str] = {}
         # srid -> set once a generations push carrying it was acked by the
         # master; the handoff sender waits on this so the decode peer's
         # tokens can never reach the master before the first token
         self._push_acked: Dict[str, threading.Event] = {}
         self._push_acked_mu = threading.Lock()
+        # PD handoff transfer pipeline: the engine thread only enqueues
+        # (the KV payload is already a host copy and the slot/blocks are
+        # released before send); the master-ack wait + KV POST run here so
+        # a slow master or decode peer never stalls admission/decode. A
+        # small worker POOL bounds head-of-line blocking: one stuck peer
+        # (60s ack wait + HTTP timeout) delays only its own lane. The queue
+        # is BOUNDED so a stuck master/peer backpressures the engine thread
+        # (blocking put) instead of accumulating unbounded host KV copies.
+        self._transfer_q: "queue.Queue[Optional[Callable[[], None]]]" = (
+            queue.Queue(maxsize=8)
+        )
+        self._transfer_threads = [
+            threading.Thread(
+                target=self._transfer_loop,
+                name=f"kv-xfer-{self.name}-{i}",
+                daemon=True,
+            )
+            for i in range(4)
+        ]
 
     # ------------------------------------------------------------------ #
     def start(self) -> None:
         self.engine.start()
         self.http.start()
         self._push_thread.start()
+        for t in self._transfer_threads:
+            t.start()
         if self._heartbeat is not None:
             self._heartbeat.start()
         logger.info("instance %s serving on :%d", self.name, self.http.port)
@@ -161,8 +196,22 @@ class InstanceServer:
             self._heartbeat.stop()
         self._push_q.put(None)
         self._push_thread.join(timeout=5.0)
+        for _ in self._transfer_threads:
+            self._transfer_q.put(None)
+        for t in self._transfer_threads:
+            t.join(timeout=5.0)
         self.http.stop()
         self.engine.stop()
+
+    def _transfer_loop(self) -> None:
+        while True:
+            job = self._transfer_q.get()
+            if job is None:
+                return
+            try:
+                job()
+            except Exception:
+                logger.exception("KV transfer job failed")
 
     @property
     def address(self) -> str:
@@ -189,32 +238,93 @@ class InstanceServer:
                     self._push_q.put(None)
                     break
                 batch.append(nxt)
-            cont = None
-            for backoff in (0.2, 0.5, 1.0, 2.0, 5.0, 10.0):
-                try:
-                    cont = self._master.push_generations(batch)
-                    break
-                except Exception:
-                    # Master briefly unreachable: the batch may hold a
-                    # request's only finished=True marker — retry, don't
-                    # drop (a drop strands the client until its timeout).
-                    time.sleep(backoff)
-            if cont is None:
-                logger.error(
-                    "generations push failed permanently; dropping %d outputs",
-                    len(batch),
+            # Partition by destination: master push (default topology) vs
+            # relay through the request's prefill instance (alternate
+            # topology — service.h:61-71). The master group goes FIRST and
+            # relay retries are short with a direct-to-master fallback, so
+            # a dead relay peer can't head-of-line-block direct streams.
+            groups: Dict[str, List[RequestOutput]] = {}
+            for out in batch:
+                dest = self._relay_addrs.get(out.service_request_id, "")
+                groups.setdefault(dest, []).append(out)
+            cont: Dict[str, bool] = {}
+            for dest in sorted(groups, key=bool):  # "" (master) first
+                group = groups[dest]
+                got = None
+                backoffs = (0.2, 0.5, 1.0) if dest else (
+                    0.2, 0.5, 1.0, 2.0, 5.0, 10.0
                 )
-                continue
+                for backoff in backoffs:
+                    try:
+                        if dest:
+                            got = self._relay_generations(dest, group)
+                        else:
+                            got = self._master.push_generations(group)
+                        break
+                    except Exception:
+                        # Destination briefly unreachable: the batch may
+                        # hold a request's only finished=True marker —
+                        # retry, don't drop (a drop strands the client
+                        # until its timeout).
+                        time.sleep(backoff)
+                if got is None and dest:
+                    # Relay peer is gone: downgrade to the direct topology
+                    # rather than stranding the client.
+                    logger.warning(
+                        "relay peer %s unreachable; pushing %d outputs "
+                        "directly to master", dest, len(group),
+                    )
+                    for out in group:
+                        self._relay_addrs.pop(out.service_request_id, None)
+                    try:
+                        got = self._master.push_generations(group)
+                    except Exception:
+                        got = None
+                if got is None:
+                    logger.error(
+                        "generations push to %s failed permanently; "
+                        "dropping %d outputs", dest or "master", len(group),
+                    )
+                    for out in group:
+                        if out.finished:
+                            self._relay_addrs.pop(
+                                out.service_request_id, None
+                            )
+                    continue
+                cont.update(got)
+                for out in group:
+                    if out.finished:
+                        self._relay_addrs.pop(out.service_request_id, None)
             for srid, keep in cont.items():
                 with self._push_acked_mu:
                     ev = self._push_acked.get(srid)
                 if ev is not None:
                     ev.set()
                 if not keep:
+                    self._relay_addrs.pop(srid, None)
                     with self._srid_mu:
                         rid = self._srid_map.pop(srid, None)
                     if rid is not None:
                         self.engine.cancel(rid)
+
+    def _relay_generations(
+        self, addr: str, outputs: List[RequestOutput]
+    ) -> Dict[str, bool]:
+        """Decode side of the alternate topology: hand the token batch to
+        the prefill instance, which forwards it to the master and returns
+        the master's continue map."""
+        from xllm_service_tpu.api.http_utils import post_json
+        from xllm_service_tpu.api.protocol import output_to_json
+
+        code, resp = post_json(
+            addr,
+            "/rpc/relay_generations",
+            {"gens": [output_to_json(o) for o in outputs]},
+            timeout=5.0,
+        )
+        if code != 200:
+            raise RuntimeError(f"relay peer {addr} returned {code}")
+        return resp.get("cont", {})
 
     # ------------------------------------------------------------------ #
     # HTTP surface
@@ -265,6 +375,27 @@ class InstanceServer:
             self._serve(h, body, chat=False)
         elif route == "/v1/chat/completions":
             self._serve(h, body, chat=True)
+        elif route == "/rpc/relay_generations":
+            # Prefill side of the alternate PD response topology: forward
+            # the decode peer's token batch to the master synchronously so
+            # the continue map (cancellation feedback) flows back through
+            # the same exchange.
+            from xllm_service_tpu.api.protocol import output_from_json
+
+            if self._master is None:
+                h.send_error_json(503, "no master connection to relay to")
+                return
+            try:
+                outs = [output_from_json(j) for j in body.get("gens", [])]
+            except Exception as e:
+                h.send_error_json(400, f"bad generations payload: {e}")
+                return
+            try:
+                cont = self._master.push_generations(outs)
+            except Exception as e:
+                h.send_error_json(502, f"master push failed: {e}")
+                return
+            h.send_json({"ok": True, "cont": cont})
         elif route == "/cancel":
             srid = body.get("service_request_id", "")
             with self._srid_mu:
@@ -293,6 +424,11 @@ class InstanceServer:
             if out.finished:
                 with self._srid_mu:
                     self._srid_map.pop(srid, None)
+                # A prefill_only request that finishes on its first token
+                # (EOS / max_tokens=1 / reject / cancel) never runs its
+                # handoff — reap the ack event here or it leaks forever.
+                with self._push_acked_mu:
+                    self._push_acked.pop(srid, None)
             self._push_q.put(out)
             return True
 
@@ -314,6 +450,8 @@ class InstanceServer:
         decode_name: str,
         body: Dict,
         detoks: Optional[Dict[int, IncrementalDetokenizer]] = None,
+        seed: Optional[int] = None,
+        respond_via_self: bool = False,
     ):
         from xllm_service_tpu.common.types import Status, StatusCode
 
@@ -326,10 +464,18 @@ class InstanceServer:
             )
             if k in body
         }
+        if seed is not None:
+            # Forward the RESOLVED seed (possibly drawn at random for an
+            # unseeded request) so the decode peer continues the same
+            # RNG stream instead of drawing its own.
+            sampling_fields["seed"] = seed
 
-        def send(handoff) -> None:
-            # Runs on the engine thread; the POST is cheap relative to a
-            # prefill and backpressures the prefill side naturally.
+        def transfer(handoff) -> None:
+            # Runs on the transfer thread (never the engine thread): waits
+            # for the master to ack the first-token push, then POSTs the KV
+            # payload to the decode peer. The engine already released the
+            # sequence's slot and blocks before enqueueing this job, so a
+            # slow master/peer delays only this handoff, not the engine.
             with self._push_acked_mu:
                 acked = self._push_acked.get(srid)
             err = ""
@@ -350,6 +496,10 @@ class InstanceServer:
                         "service_request_id": srid,
                         "sampling": sampling_fields,
                     }
+                    if respond_via_self:
+                        # Alternate topology: decode relays its generations
+                        # back through this (prefill) instance.
+                        extra["respond_addr"] = self.address
                     # Detokenizer carry-over: the decode peer continues from
                     # this side's exact byte/char position.
                     d0 = (detoks or {}).get(0)
@@ -380,6 +530,10 @@ class InstanceServer:
                     self._srid_map.pop(srid, None)
                 self._push_q.put(out)
 
+        def send(handoff) -> None:
+            # Engine-thread side: just enqueue (cheap, non-blocking).
+            self._transfer_q.put(lambda: transfer(handoff))
+
         return send
 
     def _handle_kv_import(self, h: QuietHandler) -> None:
@@ -397,6 +551,9 @@ class InstanceServer:
         rid = generate_uuid(16)
         with self._srid_mu:
             self._srid_map[srid] = rid
+        relay_addr = header.get("respond_addr", "")
+        if relay_addr:
+            self._relay_addrs[srid] = relay_addr
         detoks: Dict[int, IncrementalDetokenizer] = {}
         if "detok_ids" in header:
             detoks[0] = IncrementalDetokenizer.from_state(
@@ -469,7 +626,12 @@ class InstanceServer:
                         callback=callback,
                         prefill_only=True,
                         handoff=self._make_handoff_sender(
-                            srid, decode_name, body, detoks
+                            srid, decode_name, body, detoks,
+                            seed=sampling.seed,
+                            respond_via_self=(
+                                routing.get("decode_response_to_service", True)
+                                is False
+                            ),
                         ),
                     )
                 )

@@ -371,7 +371,10 @@ class Master:
                 )
                 return
             fwd = augment_forwarded_request(
-                body, req.service_request_id, req.token_ids, req.routing
+                body, req.service_request_id, req.token_ids, req.routing,
+                decode_response_to_service=(
+                    self.config.enable_decode_response_to_service
+                ),
             )
             try:
                 code, resp = post_json(meta.http_address, path, fwd, timeout=30.0)

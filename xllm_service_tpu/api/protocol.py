@@ -215,11 +215,17 @@ def augment_forwarded_request(
     service_request_id: str,
     token_ids: List[int],
     routing,
+    decode_response_to_service: bool = True,
 ) -> Dict[str, Any]:
     """Inject the service-side fields so the engine skips re-tokenization
-    and knows its PD pair."""
+    and knows its PD pair. `decode_response_to_service=False` selects the
+    alternate PD response topology (reference: service.h:61-71 env switch):
+    the decode peer streams tokens back THROUGH the prefill instance
+    instead of pushing to the master directly."""
     fwd = dict(body)
     fwd["service_request_id"] = service_request_id
     fwd["token_ids"] = list(token_ids)
     fwd["routing"] = routing.to_json()
+    if not decode_response_to_service:
+        fwd["routing"]["decode_response_to_service"] = False
     return fwd

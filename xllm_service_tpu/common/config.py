@@ -104,6 +104,7 @@ class EngineConfig:
     # Parallelism over the instance's mesh.
     dp_size: int = 1
     tp_size: int = 1
+    ep_size: int = 1  # MoE expert parallelism (experts over an ep axis)
 
     # Sampling defaults.
     max_new_tokens_default: int = 512

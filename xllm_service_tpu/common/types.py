@@ -282,6 +282,26 @@ class KvCacheEvent:
     def empty(self) -> bool:
         return not (self.stored_cache or self.removed_cache or self.offload_cache)
 
+    def merge(self, newer: "KvCacheEvent") -> "KvCacheEvent":
+        """Fold a NEWER delta onto this one (self happened first). Used to
+        re-merge an undelivered heartbeat delta with the next beat's so a
+        failed POST never loses stored/removed transitions."""
+        stored = (self.stored_cache - newer.removed_cache) | newer.stored_cache
+        # A hash the newer delta stores OR offloads is alive again — an old
+        # removal must not survive the merge (the master applies removed
+        # last and would delete the live location).
+        removed = (
+            self.removed_cache
+            - newer.stored_cache
+            - set(newer.offload_cache)
+        ) | newer.removed_cache
+        offload = {**self.offload_cache, **newer.offload_cache}
+        for h in newer.stored_cache | newer.removed_cache:
+            offload.pop(h, None)
+        return KvCacheEvent(
+            stored_cache=stored, removed_cache=removed, offload_cache=offload
+        )
+
     def to_json(self) -> Dict[str, Any]:
         return {
             "stored_cache": [h.hex() for h in sorted(self.stored_cache)],

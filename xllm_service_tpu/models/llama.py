@@ -108,16 +108,25 @@ def _mlp(lp: Dict[str, jnp.ndarray], cfg: ModelConfig, x: jnp.ndarray) -> jnp.nd
         gate = jnp.einsum("te,ef->tf", x, lp["w_gate"])
         up = jnp.einsum("te,ef->tf", x, lp["w_up"])
         return jnp.einsum("tf,fe->te", jax.nn.silu(gate) * up, lp["w_down"])
-    # MoE: router scores -> top-k weights; compute all experts, combine.
+    # MoE: router scores -> top-k weights; every expert's FFN runs on its
+    # own shard and the top-k combine is a CONTRACTION over the expert
+    # axis. With w_gate/w_up/w_down sharded on X over an `ep` mesh axis
+    # (parallel/sharding.py), the XLA SPMD partitioner keeps each device's
+    # expert compute local and inserts one psum for the combine — the EP
+    # serving path, with no gather that would force an all-gather of
+    # [T, X, E] activations.
     scores = jnp.einsum("te,ex->tx", x.astype(jnp.float32), lp["router"].astype(jnp.float32))
     topw, topi = jax.lax.top_k(scores, cfg.num_experts_per_tok)
     weights = jax.nn.softmax(topw, axis=-1)  # [T, k]
+    T, X = scores.shape
+    combine = jnp.zeros((T, X), jnp.float32)
+    combine = combine.at[
+        jnp.arange(T, dtype=jnp.int32)[:, None], topi
+    ].set(weights)  # [T, X]: top-k softmax weight or 0
     gate = jnp.einsum("te,xef->txf", x, lp["w_gate"])
     up = jnp.einsum("te,xef->txf", x, lp["w_up"])
     expert_out = jnp.einsum("txf,xfe->txe", jax.nn.silu(gate) * up, lp["w_down"])
-    # [T, k, E] pick + combine
-    picked = jnp.take_along_axis(expert_out, topi[:, :, None], axis=1)
-    return jnp.sum(picked * weights[:, :, None].astype(picked.dtype), axis=1)
+    return jnp.einsum("txe,tx->te", expert_out, combine.astype(expert_out.dtype))
 
 
 def _qkv(lp, cfg: ModelConfig, x: jnp.ndarray, positions: jnp.ndarray):
@@ -191,6 +200,73 @@ def decode_step(
     return logits, k_caches, v_caches
 
 
+def prefill_batch_step(
+    params: Params,
+    cfg: ModelConfig,
+    k_caches: jnp.ndarray,
+    v_caches: jnp.ndarray,
+    token_ids: jnp.ndarray,  # [P, Lpad] int32 — per-seq chunks, padded
+    start_pos: jnp.ndarray,  # [P] int32: cached tokens before each chunk
+    true_len: jnp.ndarray,  # [P] int32: valid tokens per chunk
+    block_tables: jnp.ndarray,  # [P, CB] int32 — SLICED to the group's
+    # context-block bound, capping the per-layer gather (round-1 weak
+    # item 4: gathering max_blocks*BS rows per chunk was O(L^2) with a
+    # full-context materialization)
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Prefill P sequences' chunks in ONE compiled step (batched admission).
+
+    K/V rows for all P*Lpad tokens scatter into the paged cache in a single
+    flattened write (invalid rows land in garbage block 0); attention is
+    vmapped per sequence over its own sliced block table. Returns
+    (last-token logits [P, V], k', v')."""
+    bs = k_caches.shape[3]
+    scale = cfg.head_dim**-0.5
+    P, Lpad = token_ids.shape
+    x = params["embed"][token_ids].astype(params["layers"]["wq"].dtype)
+
+    offsets = jnp.arange(Lpad, dtype=jnp.int32)[None, :]  # [1, Lpad]
+    positions = start_pos[:, None] + offsets  # [P, Lpad]
+    valid = offsets < true_len[:, None]
+    block_idx = positions // bs
+    blk = jnp.where(
+        valid, jnp.take_along_axis(block_tables, block_idx, axis=1), 0
+    )
+    in_block = jnp.where(valid, positions % bs, 0)
+    flat_blk = blk.reshape(P * Lpad)
+    flat_off = in_block.reshape(P * Lpad)
+
+    def layer_fn(x, scanned):
+        lp, k_l, v_l = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = jax.vmap(lambda hx, pos: _qkv(lp, cfg, hx, pos))(
+            h, positions
+        )  # q [P, Lpad, Hq, D]
+        k_l, v_l = _scatter_kv(
+            k_l, v_l, flat_blk, flat_off,
+            k.reshape(P * Lpad, *k.shape[2:]),
+            v.reshape(P * Lpad, *v.shape[2:]),
+        )
+        attn = jax.vmap(
+            lambda qi, ti, sp, tl: prefill_attention_gather(
+                qi, k_l, v_l, ti, sp, tl, scale
+            )
+        )(q, block_tables, start_pos, true_len)  # [P, Lpad, Hq, D]
+        x = x + jnp.einsum("plh,he->ple", attn.reshape(P, Lpad, -1),
+                           lp["wo"].reshape(-1, cfg.hidden_size))
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + jax.vmap(lambda t: _mlp(lp, cfg, t))(h)
+        return x, (k_l, v_l)
+
+    x, (k_caches, v_caches) = jax.lax.scan(
+        layer_fn, x, (params["layers"], k_caches, v_caches)
+    )
+    last = jnp.take_along_axis(
+        x, jnp.maximum(true_len - 1, 0)[:, None, None], axis=1
+    )[:, 0]  # [P, E]
+    logits = _unembed(params, cfg, last)  # [P, V]
+    return logits, k_caches, v_caches
+
+
 def prefill_step(
     params: Params,
     cfg: ModelConfig,
@@ -201,39 +277,16 @@ def prefill_step(
     true_len: jnp.ndarray,  # scalar int32: valid tokens in chunk
     block_table: jnp.ndarray,  # [max_blocks] int32
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Process one prefill chunk. Returns (last-token logits [V], k', v')."""
-    bs = k_caches.shape[3]
-    scale = cfg.head_dim**-0.5
-    Lpad = token_ids.shape[0]
-    x = params["embed"][token_ids].astype(params["layers"]["wq"].dtype)  # [Lpad, E]
-
-    offsets = jnp.arange(Lpad, dtype=jnp.int32)
-    positions = start_pos + offsets
-    valid = offsets < true_len
-    block_idx = positions // bs
-    blk = jnp.where(valid, block_table[block_idx], 0)
-    in_block = jnp.where(valid, positions % bs, 0)
-
-    def layer_fn(x, scanned):
-        lp, k_l, v_l = scanned
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(lp, cfg, h, positions)
-        k_l, v_l = _scatter_kv(k_l, v_l, blk, in_block, k, v)
-        attn = prefill_attention_gather(
-            q, k_l, v_l, block_table, start_pos, true_len, scale
-        )
-        x = x + jnp.einsum("lh,he->le", attn.reshape(Lpad, -1),
-                           lp["wo"].reshape(-1, cfg.hidden_size))
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, cfg, h)
-        return x, (k_l, v_l)
-
-    x, (k_caches, v_caches) = jax.lax.scan(
-        layer_fn, x, (params["layers"], k_caches, v_caches)
+    """Process one prefill chunk (P=1 wrapper over prefill_batch_step).
+    Returns (last-token logits [V], k', v')."""
+    logits, k_caches, v_caches = prefill_batch_step(
+        params, cfg, k_caches, v_caches,
+        token_ids[None],
+        jnp.asarray(start_pos, jnp.int32)[None],
+        jnp.asarray(true_len, jnp.int32)[None],
+        block_table[None],
     )
-    last = x[jnp.maximum(true_len - 1, 0)]
-    logits = _unembed(params, cfg, last)
-    return logits, k_caches, v_caches
+    return logits[0], k_caches, v_caches
 
 
 def forward_dense(

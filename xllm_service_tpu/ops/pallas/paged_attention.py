@@ -186,8 +186,12 @@ def paged_attention_kernel(
         grid=(R, Hkv),
         in_specs=[
             pl.BlockSpec((1, 1, Gp, D), lambda r, h, bt, sl: (r, h, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            # Pin the caches to HBM explicitly: under pl.ANY the compiler
+            # may place a small cache in VMEM, where the [BS, D] per-block
+            # slice is illegal for D < 128 (lane-padded tiling); HBM DMA
+            # slices are contiguous and shape-free.
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, Gp, D), lambda r, h, bt, sl: (r, h, 0, 0)

@@ -78,7 +78,19 @@ def sample_tokens(
 
     safe_temp = jnp.where(temperature > 0, temperature, 1.0)
     scaled = logits / safe_temp[:, None]
-    scaled = apply_top_k_top_p(scaled, top_k, top_p)
+    # The argsort over V (~128K) dominates sampling cost; skip it at
+    # runtime (lax.cond — real control flow on TPU) when NO live row has a
+    # filter enabled: greedy rows and filters-off rows don't need it.
+    vocab = logits.shape[-1]
+    needs_filter = (temperature > 0) & (
+        ((top_k > 0) & (top_k < vocab)) | (top_p < 1.0)
+    )
+    scaled = jax.lax.cond(
+        jnp.any(needs_filter),
+        lambda x: apply_top_k_top_p(x, top_k, top_p),
+        lambda x: x,
+        scaled,
+    )
 
     def sample_one(key, row):
         return jax.random.categorical(jax.random.wrap_key_data(key), row)
@@ -92,12 +104,19 @@ def sample_tokens(
     return token_ids, chosen_logprob, logprobs_full
 
 
-def make_step_keys(base_seeds: jnp.ndarray, step: jnp.ndarray) -> jnp.ndarray:
-    """Per-request keys folded with the decode step index. [R] -> [R, 2]."""
+def make_step_keys(base_seeds: jnp.ndarray, steps: jnp.ndarray) -> jnp.ndarray:
+    """Per-request keys folded with the generation step index: [R] -> [R, 2].
 
-    def one(seed):
+    `steps` may be a scalar (all rows at the same step) or a [R] array
+    (continuous-batching: every slot at its own step). This is the ONLY
+    seed-folding definition — executor prefill and decode both call it, so
+    prefill and decode RNG streams can never diverge (PD-disagg resume
+    depends on that)."""
+
+    def one(seed, st):
         k = jax.random.key(seed)
-        k = jax.random.fold_in(k, step)
+        k = jax.random.fold_in(k, st)
         return jax.random.key_data(k)
 
-    return jax.vmap(one)(base_seeds)
+    steps = jnp.broadcast_to(jnp.asarray(steps, jnp.int32), base_seeds.shape)
+    return jax.vmap(one)(base_seeds, steps)

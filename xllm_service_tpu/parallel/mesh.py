@@ -7,7 +7,9 @@ named axes and sharding annotations; XLA inserts the ICI/DCN collectives.
 Axes:
   dp — data parallel (decode batch rows, independent replicas)
   tp — tensor parallel (attention heads / FFN hidden)
-  (later rounds add: ep — expert parallel; sp — sequence/context parallel)
+  ep — expert parallel (MoE expert shards; models/llama.py's combine
+       contraction makes XLA emit the psum)
+  sp — sequence/context parallel (ring attention, ops/ring_attention.py)
 """
 
 from __future__ import annotations
@@ -22,13 +24,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 def build_mesh(
     dp: int = 1,
     tp: int = 1,
+    ep: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     if devices is None:
         devices = jax.devices()
-    need = dp * tp
+    need = dp * tp * ep
     if need > len(devices):
-        raise ValueError(f"mesh dp*tp={need} exceeds {len(devices)} devices")
+        raise ValueError(
+            f"mesh dp*tp*ep={need} exceeds {len(devices)} devices"
+        )
+    if ep > 1:
+        # tp innermost: per-layer TP psums (the most latency-sensitive
+        # collectives) run over CONTIGUOUS ICI neighbors; ep collectives
+        # are once-per-MLP and tolerate the larger stride.
+        arr = np.asarray(devices[:need]).reshape(dp, ep, tp)
+        return Mesh(arr, ("dp", "ep", "tp"))
     arr = np.asarray(devices[:need]).reshape(dp, tp)
     return Mesh(arr, ("dp", "tp"))
 

@@ -14,6 +14,7 @@ Block 0 is reserved as the garbage slot for masked scatter writes
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set, Tuple
@@ -52,9 +53,19 @@ class BlockManager:
         self._hash_to_block: Dict[bytes, int] = {}
         # Evictable committed blocks in LRU order: block_id -> None.
         self._evictable: OrderedDict[int, None] = OrderedDict()
-        # Heartbeat deltas.
+        # Heartbeat deltas. Guarded by _ev_mu: the heartbeat thread drains
+        # them (take_cache_event) while the engine thread mutates.
+        self._ev_mu = threading.Lock()
         self._stored: Set[bytes] = set()
         self._removed: Set[bytes] = set()
+        self._offloaded: Dict[bytes, str] = {}
+        # Optional host-offload hook: called as on_evict([(block_id, hash),
+        # ...]) with ALL of an allocation's committed victims BEFORE their
+        # device blocks are reused (ONE batched device->host copy, not one
+        # sync per block); returns the iterable of hashes actually saved —
+        # those become offload_cache['dram'] deltas instead of
+        # removed_cache (reference proto:47).
+        self.on_evict = None
 
     # ------------------------------------------------------------------ util
 
@@ -72,19 +83,34 @@ class BlockManager:
 
     # ------------------------------------------------------------- allocate
 
-    def _pop_free_block(self) -> int:
-        if self._free:
-            return self._free.pop()
-        if self._evictable:
-            victim, _ = self._evictable.popitem(last=False)  # LRU
-            info = self._blocks[victim]
-            if info.hash is not None:
-                del self._hash_to_block[info.hash]
-                self._removed.add(info.hash)
-                self._stored.discard(info.hash)
-                info.hash = None
-            return victim
-        raise OutOfBlocksError("KV cache exhausted")
+    def _evict_batch(self, victims: List[int]) -> None:
+        """Un-commit a batch of LRU victims, offering their content to the
+        host tier in ONE hook call (one bulk device->host copy)."""
+        hashed = [
+            (b, self._blocks[b].hash)
+            for b in victims
+            if self._blocks[b].hash is not None
+        ]
+        for _, h in hashed:
+            del self._hash_to_block[h]
+        saved: Set[bytes] = set()
+        if self.on_evict is not None and hashed:
+            try:
+                saved = set(self.on_evict(hashed))
+            except Exception:
+                saved = set()
+        with self._ev_mu:
+            for b, h in hashed:
+                if h in saved:
+                    self._offloaded[h] = "dram"
+                    # A transient removal recorded earlier in this batch
+                    # (host-pool LRU churn) must not ride the same beat as
+                    # the offload — the master applies removed last.
+                    self._removed.discard(h)
+                else:
+                    self._removed.add(h)
+                self._stored.discard(h)
+                self._blocks[b].hash = None
 
     def allocate(self, n: int) -> List[int]:
         if not self.can_allocate(n):
@@ -92,10 +118,17 @@ class BlockManager:
                 f"need {n} blocks, only {self.num_free_blocks} free"
             )
         out = []
-        for _ in range(n):
-            b = self._pop_free_block()
+        while len(out) < n and self._free:
+            out.append(self._free.pop())
+        victims = []
+        while len(out) + len(victims) < n:
+            victim, _ = self._evictable.popitem(last=False)  # LRU
+            victims.append(victim)
+        if victims:
+            self._evict_batch(victims)
+            out.extend(victims)
+        for b in out:
             self._blocks[b].ref_count = 1
-            out.append(b)
         return out
 
     def acquire_cached(self, block_id: int) -> None:
@@ -129,18 +162,30 @@ class BlockManager:
             return
         info.hash = block_hash
         self._hash_to_block[block_hash] = block_id
-        self._stored.add(block_hash)
-        self._removed.discard(block_hash)
+        with self._ev_mu:
+            self._stored.add(block_hash)
+            self._removed.discard(block_hash)
+            # Re-promotion: an offloaded block recommitted to HBM (host
+            # re-import or recompute) moves the index entry back to the hot
+            # tier.
+            self._offloaded.pop(block_hash, None)
 
     def lookup_hash(self, block_hash: bytes) -> Optional[int]:
         """Block id currently committed under this hash, if any."""
         return self._hash_to_block.get(block_hash)
 
-    def match_prefix(self, token_ids: Sequence[int]) -> Tuple[int, List[int]]:
+    def match_prefix(
+        self,
+        token_ids: Sequence[int],
+        hashes: Optional[List[bytes]] = None,
+    ) -> Tuple[int, List[int]]:
         """Longest cached prefix: returns (num_cached_tokens, block_ids) and
         takes a reference on each matched block (same walk as the service's
-        GlobalKVCacheMgr.match — global_kvcache_mgr.cpp:73-131)."""
-        hashes = prefix_block_hashes(token_ids, self.block_size, self.seed)
+        GlobalKVCacheMgr.match — global_kvcache_mgr.cpp:73-131). Pass
+        `hashes` when the caller already computed the chain (the engine's
+        host-tier continuation reuses it)."""
+        if hashes is None:
+            hashes = prefix_block_hashes(token_ids, self.block_size, self.seed)
         matched: List[int] = []
         for h in hashes:
             b = self._hash_to_block.get(h)
@@ -153,9 +198,25 @@ class BlockManager:
 
     # ------------------------------------------------------------ heartbeat
 
+    def record_host_removed(self, block_hash: bytes) -> None:
+        """The host tier dropped this hash. Only emit a removal if NO tier
+        still holds it (an HBM re-promotion must not be un-indexed)."""
+        with self._ev_mu:
+            self._offloaded.pop(block_hash, None)
+            if block_hash not in self._hash_to_block:
+                self._removed.add(block_hash)
+                self._stored.discard(block_hash)
+
     def take_cache_event(self) -> KvCacheEvent:
-        """Drain accumulated deltas for the next heartbeat."""
-        ev = KvCacheEvent(stored_cache=self._stored, removed_cache=self._removed)
-        self._stored = set()
-        self._removed = set()
+        """Drain accumulated deltas for the next heartbeat (called from the
+        heartbeat thread — atomic swap under the event lock)."""
+        with self._ev_mu:
+            ev = KvCacheEvent(
+                stored_cache=self._stored,
+                removed_cache=self._removed,
+                offload_cache=self._offloaded,
+            )
+            self._stored = set()
+            self._removed = set()
+            self._offloaded = {}
         return ev

@@ -127,6 +127,15 @@ class InferenceEngine:
             self.executor.num_blocks, self.block_size,
             seed=engine_cfg.murmur_hash3_seed,
         )
+        # Host (DRAM) cache tier: committed blocks evicted from HBM are
+        # copied to host memory and re-imported on a later prefix match
+        # (num_host_blocks=0 disables — reference tier contract proto:47).
+        self.host_pool = None
+        if engine_cfg.num_host_blocks > 0:
+            from xllm_service_tpu.runtime.host_cache import HostKVPool
+
+            self.host_pool = HostKVPool(engine_cfg.num_host_blocks)
+            self.block_mgr.on_evict = self._offload_to_host
 
         self._waiting: Deque[EngineRequest] = collections.deque()
         # KV imports from prefill peers, landed on the engine thread
@@ -256,10 +265,21 @@ class InferenceEngine:
                 self._finish(seq, FinishReason.NONE, cancelled=True)
 
     def _admit(self) -> int:
+        """Admit waiting requests up to max_prefill_tokens and prefill them
+        in BATCHED compiled steps (executor.prefill_batch groups by length
+        bucket) — one slow prefill no longer serializes the whole queue and
+        concurrent short prompts share a single device step (round-1 weak
+        item 4)."""
         budget = self.cfg.max_prefill_tokens
         pool_capacity = self.block_mgr.num_blocks - 1
         rejects: List[Tuple[EngineRequest, StatusCode, str]] = []
-        admitted = 0
+        batch: List[_Seq] = []
+        # Full-block hashes the CURRENT batch will commit. A waiting request
+        # sharing a prefix with an in-batch member (chained hashes: any
+        # overlap implies block-0 overlap) is deferred one step so it
+        # prefix-matches the committed blocks instead of redundantly
+        # prefilling the shared prefix in the same batched step.
+        pending_hashes: set = set()
         while budget > 0:
             with self._lock:
                 if not self._waiting or not self._free_slots:
@@ -289,16 +309,36 @@ class InferenceEngine:
                     break
                 self._waiting.popleft()
 
+            # Hash OUTSIDE the lock (long prompts hash thousands of blocks;
+            # add_request/cancel must not stall behind it). Safe: this
+            # thread is the only one that pops/appendlefts _waiting.
+            head_hashes = prefix_block_hashes(
+                tokens[: n_tok - 1], self.block_size, self.block_mgr.seed
+            )
+            if head_hashes and head_hashes[0] in pending_hashes:
+                # Defer: shares a prefix with this batch — next step's
+                # prefix match will reuse the blocks this batch commits.
+                with self._lock:
+                    self._waiting.appendleft(item)
+                break
+
             if isinstance(item, _Seq):  # resuming a preempted sequence
                 seq = item
                 seq.slot = self._free_slots.pop()
             else:
                 seq = _Seq(item, self._free_slots.pop())
             # Prefix-cache match — never the entire context (at least one
-            # token must run to produce logits).
+            # token must run to produce logits). The hash chain (already
+            # computed for the dedup check) is shared with the host-tier
+            # continuation.
+            hashes = head_hashes
             num_cached, cached_blocks = self.block_mgr.match_prefix(
-                seq.tokens[: n_tok - 1]
+                seq.tokens[: n_tok - 1], hashes=hashes
             )
+            if self.host_pool is not None:
+                num_cached, cached_blocks = self._extend_match_from_host(
+                    hashes, num_cached, list(cached_blocks)
+                )
             seq.num_cached = num_cached
             seq.block_ids = list(cached_blocks)
             seq.last_committed_block = len(cached_blocks) - 1
@@ -313,28 +353,49 @@ class InferenceEngine:
                     self._waiting.appendleft(item)
                 break
 
+            budget -= len(seq.tokens) - seq.num_cached
+            pending_hashes.update(hashes)
+            batch.append(seq)
+
+        admitted = self._prefill_admitted(batch) if batch else 0
+        for req, code, msg in rejects:
+            self._reject(req, code, msg)
+        return admitted
+
+    def _prefill_admitted(self, batch: List[_Seq]) -> int:
+        from xllm_service_tpu.runtime.executor import PrefillItem
+
+        items = []
+        for seq in batch:
             table = np.zeros((self.max_blocks,), np.int32)
             table[: len(seq.block_ids)] = seq.block_ids
-            suffix = seq.tokens[num_cached:]
-            budget -= len(suffix)
-
-            t0 = time.monotonic()
             s = seq.req.sampling
-            tok, lp = self.executor.prefill(
-                np.asarray(suffix, np.int32),
-                num_cached,
-                table,
-                temperature=s.temperature,
-                top_k=s.top_k,
-                top_p=s.top_p,
-                seed=s.seed,
-                step=len(seq.generated),
+            items.append(
+                PrefillItem(
+                    token_ids=np.asarray(seq.tokens[seq.num_cached:], np.int32),
+                    start_pos=seq.num_cached,
+                    block_table=table,
+                    temperature=s.temperature,
+                    top_k=s.top_k,
+                    top_p=s.top_p,
+                    seed=s.seed,
+                    step=len(seq.generated),
+                )
             )
-            ttft_ms = (time.monotonic() - t0) * 1000
-            self._ttft_window.append((time.monotonic(), ttft_ms))
-            self._profile_ttft.append((len(suffix), ttft_ms))
-            seq.prefill_done_time = seq.last_token_time = time.monotonic()
-
+        t0 = time.monotonic()
+        outs = self.executor.prefill_batch(items)
+        now = time.monotonic()
+        # Client-perceived TTFT is the whole batched step for every member;
+        # the profiling curve gets (suffix_len, batch_ms) pairs — slightly
+        # pessimistic per-seq, conservative for the TimePredictor fit.
+        batch_ms = (now - t0) * 1000
+        admitted = 0
+        for seq, (tok, lp) in zip(batch, outs):
+            self._ttft_window.append((now, batch_ms))
+            self._profile_ttft.append(
+                (len(seq.tokens) - seq.num_cached, batch_ms)
+            )
+            seq.prefill_done_time = seq.last_token_time = now
             self._commit_full_blocks(seq)
             seq.generated.append((tok, lp))
             seq.tokens.append(tok)
@@ -343,9 +404,50 @@ class InferenceEngine:
             if alive and seq.req.prefill_only:
                 self._handoff(seq)
             admitted += 1
-        for req, code, msg in rejects:
-            self._reject(req, code, msg)
         return admitted
+
+    # ------------------------------------------------- host (DRAM) tier
+
+    def _offload_to_host(self, items: List[Tuple[int, bytes]]) -> List[bytes]:
+        """BlockManager eviction hook: copy ALL victims' KV to the host pool
+        in one bulk device->host transfer BEFORE the device blocks are
+        reused. Returns the hashes saved, which become offload('dram')
+        heartbeat deltas instead of removed."""
+        kv = np.asarray(
+            self.executor.export_blocks([b for b, _ in items])
+        )  # [2, L, n, Hkv, BS, D] — one device sync for the batch
+        for i, (_, block_hash) in enumerate(items):
+            for evicted in self.host_pool.put(block_hash, kv[:, :, i]):
+                self.block_mgr.record_host_removed(evicted)
+        # Only report hashes that SURVIVED the whole batch: a later put()
+        # may have LRU-evicted an earlier one — claiming it saved would
+        # leave a dangling DRAM entry in the master's index.
+        return [h for _, h in items if h in self.host_pool]
+
+    def _extend_match_from_host(
+        self, hashes: List[bytes], num_cached: int, cached_blocks: List[int]
+    ) -> Tuple[int, List[int]]:
+        """Continue a prefix match into the host tier: consecutive host-held
+        blocks after the HBM hit are re-imported (one bulk host->device copy)
+        and recommitted, re-promoting their index entries to HBM."""
+        start = len(cached_blocks)
+        run: List[Tuple[bytes, np.ndarray]] = []
+        for h in hashes[start:]:
+            kv = self.host_pool.get(h)
+            if kv is None:
+                break
+            run.append((h, kv))
+        if not run or not self.block_mgr.can_allocate(len(run)):
+            return num_cached, cached_blocks
+        try:
+            ids = self.block_mgr.allocate(len(run))
+        except OutOfBlocksError:
+            return num_cached, cached_blocks
+        stacked = np.stack([kv for _, kv in run], axis=2)  # [2, L, n, ...]
+        self.executor.import_blocks(stacked, np.asarray(ids))
+        for bid, (h, _) in zip(ids, run):
+            self.block_mgr.commit_block(bid, h)
+        return num_cached + len(run) * self.block_size, cached_blocks + ids
 
     # ------------------------------------------------- PD disaggregation
 
@@ -410,27 +512,57 @@ class InferenceEngine:
     def _do_import(self, req: EngineRequest, h: KVHandoff) -> None:
         # Land migrated full blocks into the local cache under their chained
         # hashes; blocks whose hash is already cached locally are skipped
-        # (dedup). On any capacity problem fall back to pure recompute —
-        # admission will prefill the whole prompt locally.
+        # (dedup). On ANY problem — capacity, a PD pair whose engine configs
+        # diverge (block_size/layers/heads/dtype), a corrupt payload — fall
+        # back to pure recompute: the resume _Seq below is seeded regardless,
+        # so admission prefills the whole prompt locally and the request
+        # never vanishes.
         if h.num_full_blocks > 0 and h.kv is not None:
-            fresh = [
-                i
-                for i, hb in enumerate(h.block_hashes)
-                if self.block_mgr.lookup_hash(hb) is None
-            ]
-            if fresh:
-                try:
-                    ids = self.block_mgr.allocate(len(fresh))
-                except OutOfBlocksError:
-                    ids = []
+            try:
+                kv = np.asarray(h.kv)
+                c = self.executor.cfg
+                expect = (
+                    2, c.num_layers, h.num_full_blocks, c.num_kv_heads,
+                    self.block_size, c.head_dim,
+                )
+                if kv.shape != expect:
+                    raise ValueError(
+                        f"handoff KV shape {kv.shape} != local cache layout "
+                        f"{expect} — PD pair config mismatch; recomputing"
+                    )
+                if len(h.block_hashes) != h.num_full_blocks:
+                    raise ValueError(
+                        f"{len(h.block_hashes)} block hashes for "
+                        f"{h.num_full_blocks} blocks; recomputing"
+                    )
+                fresh = [
+                    i
+                    for i, hb in enumerate(h.block_hashes)
+                    if self.block_mgr.lookup_hash(hb) is None
+                ]
+                ids = []
+                if fresh:
+                    try:
+                        ids = self.block_mgr.allocate(len(fresh))
+                    except OutOfBlocksError:
+                        ids = []
                 if ids:
-                    kv = np.asarray(h.kv)
-                    self.executor.import_blocks(kv[:, :, fresh], np.asarray(ids))
+                    try:
+                        self.executor.import_blocks(
+                            kv[:, :, fresh], np.asarray(ids)
+                        )
+                    except Exception:
+                        self.block_mgr.free(ids)
+                        raise
                     for bid, i in zip(ids, fresh):
                         self.block_mgr.commit_block(bid, h.block_hashes[i])
                     # drop our temporary ref; blocks stay evictable-cached
                     # until admission re-acquires them via match_prefix
                     self.block_mgr.free(ids)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
         # Seed a resume-sequence: prompt + first generated token; admission
         # treats it like a preempted sequence — prefix match picks up the
         # imported blocks, only the sub-block tail is recomputed, and the

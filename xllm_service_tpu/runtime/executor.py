@@ -20,8 +20,9 @@ TPU design points:
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -51,6 +52,20 @@ class SamplingBatch:
     steps: np.ndarray  # [R] int32 (per-request generated-token count)
 
 
+@dataclass
+class PrefillItem:
+    """One sequence's uncached prompt suffix for a batched prefill step."""
+
+    token_ids: np.ndarray  # [n] int32
+    start_pos: int  # cached tokens before this chunk (prefix-cache hit)
+    block_table: np.ndarray  # [>=ceil((start_pos+n)/bs)] int32
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
+    step: int = 0
+
+
 class ModelExecutor:
     def __init__(
         self,
@@ -60,11 +75,27 @@ class ModelExecutor:
         init_seed: int = 0,
     ):
         self.engine_cfg = engine_cfg
-        self.cfg = model_cfg or get_model_config(engine_cfg.model)
-        self.mesh = mesh or build_mesh(engine_cfg.dp_size, engine_cfg.tp_size)
+        if model_cfg is not None:
+            self.cfg = model_cfg
+        elif engine_cfg.checkpoint_path and os.path.exists(
+            os.path.join(engine_cfg.checkpoint_path, "config.json")
+        ):
+            # Real HF checkpoint dirs carry their own architecture — the
+            # registry is for test/bench configs (runtime/weights.py).
+            from xllm_service_tpu.runtime.weights import config_from_hf
+
+            self.cfg = config_from_hf(
+                engine_cfg.checkpoint_path, name=engine_cfg.model
+            )
+        else:
+            self.cfg = get_model_config(engine_cfg.model)
+        self.mesh = mesh or build_mesh(
+            engine_cfg.dp_size, engine_cfg.tp_size, engine_cfg.ep_size
+        )
         tp = self.mesh.shape.get("tp", 1)
-        if tp > 1:
-            check_tp_divisibility(self.cfg, tp)
+        ep = self.mesh.shape.get("ep", 1)
+        if tp > 1 or ep > 1:
+            check_tp_divisibility(self.cfg, tp, ep)
 
         self.dtype = jnp.bfloat16 if engine_cfg.dtype == "bfloat16" else jnp.float32
         self.R = engine_cfg.max_running_requests
@@ -74,7 +105,9 @@ class ModelExecutor:
             engine_cfg.max_seq_len / self.block_size
         )
 
-        p_shardings = param_shardings(self.cfg, self.mesh)
+        p_shardings = param_shardings(
+            self.cfg, self.mesh, ep_axis="ep" if ep > 1 else None
+        )
         kv_sharding = kv_cache_sharding(self.mesh)
 
         with self.mesh:
@@ -115,6 +148,13 @@ class ModelExecutor:
         )
         self._prefill_jit = jax.jit(
             self._prefill_impl, donate_argnums=(0, 1)
+        )
+        self._import_jit = jax.jit(
+            lambda k, v, blocks, ids: (
+                k.at[:, ids].set(blocks[0].astype(k.dtype)),
+                v.at[:, ids].set(blocks[1].astype(v.dtype)),
+            ),
+            donate_argnums=(0, 1),
         )
         self.prefill_buckets = sorted(
             b for b in engine_cfg.prefill_buckets if b <= engine_cfg.max_seq_len
@@ -210,27 +250,23 @@ class ModelExecutor:
         k_cache,
         v_cache,
         params,
-        token_ids,
-        start_pos,
-        true_len,
-        block_table,
-        temperature,
-        top_k,
-        top_p,
-        step_key,
+        token_ids,  # [P, Lpad]
+        start_pos,  # [P]
+        true_len,  # [P]
+        block_tables,  # [P, CB] — sliced to the group's context bound
+        temperature,  # [P]
+        top_k,  # [P]
+        top_p,  # [P]
+        step_keys,  # [P]
     ):
-        logits, k_cache, v_cache = llama.prefill_step(
-            params, self.cfg, k_cache, v_cache, token_ids, start_pos, true_len,
-            block_table,
+        logits, k_cache, v_cache = llama.prefill_batch_step(
+            params, self.cfg, k_cache, v_cache, token_ids, start_pos,
+            true_len, block_tables,
         )
         tokens, logprob, _ = sampling_ops.sample_tokens(
-            logits[None],
-            temperature[None],
-            top_k[None],
-            top_p[None],
-            step_key[None],
+            logits, temperature, top_k, top_p, step_keys
         )
-        return k_cache, v_cache, tokens[0], logprob[0]
+        return k_cache, v_cache, tokens, logprob
 
     # ---------------------------------------------------------- public API
 
@@ -239,6 +275,96 @@ class ModelExecutor:
             if n <= b:
                 return b
         return self.prefill_buckets[-1]
+
+    # Prefill group-size buckets: bounded compile count, P=8 amortizes the
+    # per-step overhead for bursts of short concurrent prompts.
+    PREFILL_GROUP_MAX = 8
+
+    @staticmethod
+    def _pow2_bucket(n: int, cap: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return min(b, cap)
+
+    def prefill_batch(self, items: List["PrefillItem"]) -> List[Tuple[int, float]]:
+        """Prefill several sequences' chunks in as few compiled steps as
+        possible. Items are grouped by padded-length bucket (so a short
+        prompt never pads to a long one's bucket) into chunks of
+        <= PREFILL_GROUP_MAX with bucketed (P, Lpad, CB) shapes; each chunk
+        is ONE jitted call (batched admission — round-1 weak item 4).
+        Returns per-item (first_token, logprob) in input order."""
+        order = sorted(
+            range(len(items)),
+            key=lambda i: self.bucket_len(len(items[i].token_ids)),
+        )
+        results: List[Optional[Tuple[int, float]]] = [None] * len(items)
+        i = 0
+        while i < len(order):
+            bucket = self.bucket_len(len(items[order[i]].token_ids))
+            group_idx = []
+            while (
+                i < len(order)
+                and len(group_idx) < self.PREFILL_GROUP_MAX
+                and self.bucket_len(len(items[order[i]].token_ids)) == bucket
+            ):
+                group_idx.append(order[i])
+                i += 1
+            outs = self._prefill_group([items[g] for g in group_idx])
+            for g, o in zip(group_idx, outs):
+                results[g] = o
+        return results  # type: ignore[return-value]
+
+    def _prefill_group(self, group: List["PrefillItem"]) -> List[Tuple[int, float]]:
+        n_real = len(group)
+        P = self._pow2_bucket(n_real, self.PREFILL_GROUP_MAX)
+        Lpad = self.bucket_len(max(len(it.token_ids) for it in group))
+        bs = self.block_size
+        need_blocks = max(
+            (it.start_pos + len(it.token_ids) + bs - 1) // bs for it in group
+        )
+        CB = self._pow2_bucket(max(need_blocks, 1), self.max_blocks_per_seq)
+
+        token_ids = np.zeros((P, Lpad), np.int32)
+        start_pos = np.zeros((P,), np.int32)
+        true_len = np.zeros((P,), np.int32)
+        tables = np.zeros((P, CB), np.int32)
+        temps = np.zeros((P,), np.float32)
+        top_ks = np.zeros((P,), np.int32)
+        top_ps = np.ones((P,), np.float32)
+        seeds = np.zeros((P,), np.uint32)
+        steps = np.zeros((P,), np.int32)
+        for i, it in enumerate(group):
+            n = len(it.token_ids)
+            token_ids[i, :n] = it.token_ids
+            start_pos[i] = it.start_pos
+            true_len[i] = n
+            m = min(CB, len(it.block_table))
+            tables[i, :m] = np.asarray(it.block_table[:m], np.int32)
+            temps[i] = it.temperature
+            top_ks[i] = it.top_k
+            top_ps[i] = it.top_p
+            seeds[i] = it.seed & 0xFFFFFFFF
+            steps[i] = it.step
+        keys = sampling_ops.make_step_keys(
+            jnp.asarray(seeds), jnp.asarray(steps, jnp.int32)
+        )
+        self.k_cache, self.v_cache, toks, lps = self._prefill_jit(
+            self.k_cache,
+            self.v_cache,
+            self.params,
+            jnp.asarray(token_ids),
+            jnp.asarray(start_pos),
+            jnp.asarray(true_len),
+            jnp.asarray(tables),
+            jnp.asarray(temps),
+            jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
+            keys,
+        )
+        toks = np.asarray(toks)
+        lps = np.asarray(lps)
+        return [(int(toks[i]), float(lps[i])) for i in range(n_real)]
 
     def prefill(
         self,
@@ -251,27 +377,20 @@ class ModelExecutor:
         seed: int = 0,
         step: int = 0,
     ) -> Tuple[int, float]:
-        n = len(token_ids)
-        pad = self.bucket_len(n)
-        padded = np.zeros((pad,), np.int32)
-        padded[:n] = token_ids
-        key = sampling_ops.make_step_keys(
-            jnp.asarray([seed], jnp.uint32), jnp.int32(step)
+        return self.prefill_batch(
+            [
+                PrefillItem(
+                    token_ids=np.asarray(token_ids, np.int32),
+                    start_pos=start_pos,
+                    block_table=np.asarray(block_table, np.int32),
+                    temperature=temperature,
+                    top_k=top_k,
+                    top_p=top_p,
+                    seed=seed,
+                    step=step,
+                )
+            ]
         )[0]
-        self.k_cache, self.v_cache, tok, lp = self._prefill_jit(
-            self.k_cache,
-            self.v_cache,
-            self.params,
-            jnp.asarray(padded),
-            jnp.int32(start_pos),
-            jnp.int32(n),
-            jnp.asarray(block_table, jnp.int32),
-            jnp.float32(temperature),
-            jnp.int32(top_k),
-            jnp.float32(top_p),
-            key,
-        )
-        return int(tok), float(lp)
 
     def decode(
         self,
@@ -282,11 +401,10 @@ class ModelExecutor:
         batch: SamplingBatch,
         use_kernel: Optional[bool] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
-        keys = jax.vmap(
-            lambda s, st: jax.random.key_data(
-                jax.random.fold_in(jax.random.key(s), st)
-            )
-        )(jnp.asarray(batch.seeds, jnp.uint32), jnp.asarray(batch.steps, jnp.int32))
+        keys = sampling_ops.make_step_keys(
+            jnp.asarray(batch.seeds, jnp.uint32),
+            jnp.asarray(batch.steps, jnp.int32),
+        )
         self.k_cache, self.v_cache, tokens, logprobs = self._decode_jit(
             self.k_cache,
             self.v_cache,
@@ -313,6 +431,22 @@ class ModelExecutor:
         return jnp.stack([self.k_cache[:, ids], self.v_cache[:, ids]])
 
     def import_blocks(self, blocks: jax.Array, block_ids: np.ndarray) -> None:
-        ids = jnp.asarray(block_ids, jnp.int32)
-        self.k_cache = self.k_cache.at[:, ids].set(blocks[0].astype(self.dtype))
-        self.v_cache = self.v_cache.at[:, ids].set(blocks[1].astype(self.dtype))
+        """Scatter migrated/offloaded blocks into the caches IN PLACE (the
+        jitted step donates both caches — without donation each import
+        would copy the whole multi-GiB pool). Block count is padded to a
+        power of two (duplicate trailing id, same data: benign re-write) so
+        compile count stays logarithmic."""
+        n = len(block_ids)
+        P = 1
+        while P < n:
+            P *= 2
+        ids = np.empty((P,), np.int32)
+        ids[:n] = block_ids
+        ids[n:] = block_ids[n - 1] if n else 0
+        arr = np.asarray(blocks)
+        if P != n:
+            pad = np.repeat(arr[:, :, -1:], P - n, axis=2)
+            arr = np.concatenate([arr, pad], axis=2)
+        self.k_cache, self.v_cache = self._import_jit(
+            self.k_cache, self.v_cache, jnp.asarray(arr), jnp.asarray(ids)
+        )

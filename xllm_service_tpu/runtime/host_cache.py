@@ -1,0 +1,59 @@
+"""Host (DRAM) KV-cache tier for evicted prefix blocks.
+
+Engine-side analog of the reference's multi-tier cache: its engine emits
+offload events whose tier transitions the service index tracks
+(reference xllm_service/scheduler/managers/global_kvcache_mgr.cpp:177-225,
+proto:47 `offload_cache`). Here, committed blocks evicted from the HBM pool
+are copied into pinned host memory instead of dropped; a later prefix match
+re-imports them (HBM re-promotion) for the cost of a host->device copy
+instead of a recompute.
+
+TPU design note: transfers ride the same host<->HBM DMA path jax uses for
+np.asarray / device_put; blocks are [2, L, Hkv, BS, D] contiguous arrays so
+each offload/restore is one bulk copy, not a per-token scatter.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import List, Optional
+
+import numpy as np
+
+
+class HostKVPool:
+    """LRU pool of content-addressed KV blocks in host DRAM.
+
+    Keys are the chained murmur3 block hashes (the cross-tier contract);
+    values are [2, L, Hkv, BS, D] host arrays (k, v stacked).
+    """
+
+    def __init__(self, capacity_blocks: int):
+        if capacity_blocks <= 0:
+            raise ValueError("HostKVPool needs capacity > 0")
+        self.capacity = capacity_blocks
+        self._data: "OrderedDict[bytes, np.ndarray]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def __contains__(self, block_hash: bytes) -> bool:
+        return block_hash in self._data
+
+    def get(self, block_hash: bytes) -> Optional[np.ndarray]:
+        kv = self._data.get(block_hash)
+        if kv is not None:
+            self._data.move_to_end(block_hash)
+        return kv
+
+    def put(self, block_hash: bytes, kv: np.ndarray) -> List[bytes]:
+        """Store a block; returns the hashes LRU-evicted to make room."""
+        evicted: List[bytes] = []
+        if block_hash in self._data:
+            self._data.move_to_end(block_hash)
+            return evicted
+        while len(self._data) >= self.capacity:
+            h, _ = self._data.popitem(last=False)
+            evicted.append(h)
+        self._data[block_hash] = np.ascontiguousarray(kv)
+        return evicted

@@ -291,13 +291,21 @@ def load_checkpoint(
         raise FileNotFoundError(f"checkpoint dir {path!r} does not exist")
     np_dtype = ml_dtypes.bfloat16 if dtype == jnp.bfloat16 else np.dtype(dtype)
     shapes = _leaf_shapes(cfg)
+    expert_leaves = (
+        {"layers.w_gate", "layers.w_up", "layers.w_down"} if cfg.is_moe else set()
+    )
     staging: Dict[str, np.ndarray] = {}
-    filled: Dict[str, np.ndarray] = {
-        k: np.zeros(s[0] if len(s) > 1 else 1, dtype=bool)
-        if k.startswith("layers.")
-        else np.zeros(1, dtype=bool)
-        for k, s in shapes.items()
-    }
+    # Completeness tracking: [L] per layer leaf, [L, X] per expert leaf
+    # (every expert must land — a missing expert must raise, not serve
+    # np.empty garbage), [1] per top-level leaf.
+    filled: Dict[str, np.ndarray] = {}
+    for k, s in shapes.items():
+        if k in expert_leaves:
+            filled[k] = np.zeros((cfg.num_layers, cfg.num_experts), bool)
+        elif k.startswith("layers."):
+            filled[k] = np.zeros(cfg.num_layers, bool)
+        else:
+            filled[k] = np.zeros(1, bool)
 
     def stage(key: str) -> np.ndarray:
         if key not in staging:
@@ -326,9 +334,7 @@ def load_checkpoint(
                 filled[key][layer] = True
             else:
                 np.copyto(buf[layer, expert], src, casting="unsafe")
-                # expert leaves complete when the last expert lands
-                if expert == cfg.num_experts - 1:
-                    filled[key][layer] = True
+                filled[key][layer, expert] = True
 
     missing = [k for k, f in filled.items() if not f.all()]
     if missing:
