@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Smoke client for a running xllm-service-tpu master (or a direct
+instance): completion, chat completion, and streaming over the OpenAI
+surface. The runnable analog of the reference's manual smoke client
+(reference xllm_service/examples/http_client_test.cpp:71-145).
+
+    python -m xllm_service_tpu.api.master &          # service tier
+    python -m xllm_service_tpu.api.instance \
+        --master-rpc-addr 127.0.0.1:9996 &           # engine tier
+    python examples/http_client.py --addr 127.0.0.1:9999
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+
+
+def _connect(addr: str, path: str, body: dict):
+    host, _, port = addr.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=300.0)
+    conn.request(
+        "POST", path, body=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    return conn, conn.getresponse()
+
+
+def post(addr: str, path: str, body: dict):
+    conn, resp = _connect(addr, path, body)
+    data = resp.read()
+    conn.close()
+    return resp.status, json.loads(data) if data else {}
+
+
+def post_stream(addr: str, path: str, body: dict):
+    conn, resp = _connect(addr, path, body)
+    assert resp.status == 200, resp.read()
+    for raw in resp:
+        line = raw.decode().strip()
+        if line.startswith("data: "):
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                break
+            yield json.loads(payload)
+    conn.close()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("xllm-service-tpu smoke client")
+    p.add_argument("--addr", default="127.0.0.1:9999")
+    p.add_argument("--model", default="llama3-tiny")
+    p.add_argument("--prompt", default="hello, tpu serving")
+    p.add_argument("--max-tokens", type=int, default=16)
+    args = p.parse_args()
+
+    print("== /v1/completions ==")
+    code, body = post(
+        args.addr, "/v1/completions",
+        {"model": args.model, "prompt": args.prompt,
+         "max_tokens": args.max_tokens, "temperature": 0.0},
+    )
+    print(code, json.dumps(body, indent=2)[:400])
+
+    print("== /v1/chat/completions ==")
+    code, body = post(
+        args.addr, "/v1/chat/completions",
+        {"model": args.model,
+         "messages": [{"role": "user", "content": args.prompt}],
+         "max_tokens": args.max_tokens, "temperature": 0.0},
+    )
+    print(code, json.dumps(body, indent=2)[:400])
+
+    print("== streaming ==")
+    text = []
+    for event in post_stream(
+        args.addr, "/v1/completions",
+        {"model": args.model, "prompt": args.prompt,
+         "max_tokens": args.max_tokens, "temperature": 0.0, "stream": True},
+    ):
+        for c in event.get("choices", []):
+            text.append(c.get("text", ""))
+    print("streamed:", "".join(text))
+
+
+if __name__ == "__main__":
+    main()

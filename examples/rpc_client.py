@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Control-plane smoke client: exercises the master's RPC surface the way
+an engine instance does — hello, register, heartbeat, instance listing
+(reference xllm_service/examples/rpc_client_test.cpp:44-58).
+
+    python -m xllm_service_tpu.api.master &
+    python examples/rpc_client.py --rpc-addr 127.0.0.1:9996
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from xllm_service_tpu.api.client import MasterClient  # noqa: E402
+from xllm_service_tpu.api.http_utils import get_json
+from xllm_service_tpu.common.types import (
+    InstanceMetaInfo,
+    InstanceType,
+    LoadMetrics,
+)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("xllm-service-tpu rpc smoke client")
+    p.add_argument("--rpc-addr", default="127.0.0.1:9996")
+    args = p.parse_args()
+
+    client = MasterClient(args.rpc_addr)
+    print("hello:", client.hello("smoke-client"))
+
+    meta = InstanceMetaInfo(
+        name="smoke-instance",
+        rpc_address="127.0.0.1:0",
+        http_address="127.0.0.1:0",
+        model_name="llama3-tiny",
+        type=InstanceType.MIX,
+    )
+    print("register:", client.register(meta))
+    print(
+        "heartbeat:",
+        client.heartbeat(
+            meta.name,
+            load_metrics=LoadMetrics(waiting_requests_num=0,
+                                     gpu_cache_usage_perc=0.0),
+        ),
+    )
+    code, info = get_json(
+        args.rpc_addr, f"/rpc/instance_info?name={meta.name}"
+    )
+    print("instance_info:", code, json.dumps(info)[:400])
+    code, prefills = get_json(args.rpc_addr, "/rpc/static_prefill_list")
+    print("static_prefill_list:", code, json.dumps(prefills)[:200])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,159 @@
+"""n>1 / best_of sequence fan-out (round-1 missing item 8): multiple
+choices per request through the real engine, direct and forwarded modes.
+Children run as independent engine requests sharing prompt KV via the
+prefix cache; best_of selects the top-n by mean logprob.
+"""
+
+import pytest
+
+from xllm_service_tpu.api import Master
+from xllm_service_tpu.api.instance import InstanceServer
+from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+from xllm_service_tpu.coordination import MemoryStore
+
+from tests.test_api_e2e import http_post, sse_post, wait_until
+
+BLOCK = 16
+
+
+@pytest.fixture(scope="module")
+def direct_instance():
+    srv = InstanceServer(
+        EngineConfig(
+            model="llama3-tiny", dtype="float32", block_size=BLOCK,
+            num_blocks=96, max_running_requests=8, max_seq_len=256,
+            prefill_buckets=[32, 64],
+        )
+    )
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def forwarded_stack():
+    store = MemoryStore()
+    master = Master(
+        ServiceConfig(
+            host="127.0.0.1", http_port=0, rpc_port=0,
+            heartbeat_interval_s=0.2, master_lease_ttl_s=1.0,
+            load_balance_policy="RR", block_size=BLOCK,
+        ),
+        store=store,
+    )
+    master.start()
+    inst = InstanceServer(
+        EngineConfig(
+            model="llama3-tiny", dtype="float32", block_size=BLOCK,
+            num_blocks=96, max_running_requests=8, max_seq_len=256,
+            prefill_buckets=[32, 64], instance_name="mix-n",
+            instance_type="MIX",
+        ),
+        master_rpc_addr=master.rpc_address,
+        heartbeat_interval_s=0.2,
+    )
+    inst.start()
+    assert wait_until(lambda: sum(master.scheduler.instance_mgr.counts()) == 1)
+    yield master
+    inst.stop()
+    master.stop()
+    store.close()
+
+
+def test_direct_n3_completions(direct_instance):
+    code, body = http_post(
+        direct_instance.address, "/v1/completions",
+        {"model": "llama3-tiny", "prompt": "multi-choice test", "n": 3,
+         "max_tokens": 6, "temperature": 0.8, "seed": 42},
+        timeout=120.0,
+    )
+    assert code == 200, body
+    choices = body["choices"]
+    assert [c["index"] for c in choices] == [0, 1, 2]
+    assert all(c["text"] for c in choices)
+    # distinct per-child RNG streams: at least two distinct texts
+    assert len({c["text"] for c in choices}) >= 2
+    assert body["usage"]["completion_tokens"] == 18
+
+
+def test_direct_n2_chat_stream(direct_instance):
+    events = sse_post(
+        direct_instance.address, "/v1/chat/completions",
+        {"model": "llama3-tiny",
+         "messages": [{"role": "user", "content": "hello"}],
+         "n": 2, "max_tokens": 5, "temperature": 0.9, "seed": 7,
+         "stream": True},
+        timeout=120.0,
+    )
+    assert events[-1] == "[DONE]"
+    assert events.count("[DONE]") == 1
+    seen = {c["index"] for e in events[:-1] for c in e.get("choices", [])}
+    assert seen == {0, 1}
+    finishes = [
+        c for e in events[:-1] for c in e.get("choices", [])
+        if c.get("finish_reason")
+    ]
+    assert len(finishes) == 2  # one finish_reason chunk per choice
+
+
+def test_direct_best_of(direct_instance):
+    code, body = http_post(
+        direct_instance.address, "/v1/completions",
+        {"model": "llama3-tiny", "prompt": "pick the best", "n": 2,
+         "best_of": 4, "max_tokens": 5, "temperature": 1.0, "seed": 3},
+        timeout=120.0,
+    )
+    assert code == 200, body
+    choices = body["choices"]
+    assert [c["index"] for c in choices] == [0, 1]
+    assert "logprobs" not in body["choices"][0] or not body["choices"][0]["logprobs"]
+    assert body["usage"]["completion_tokens"] == 20  # all 4 children counted
+
+
+def test_best_of_rejects_stream(direct_instance):
+    code, body = http_post(
+        direct_instance.address, "/v1/completions",
+        {"model": "llama3-tiny", "prompt": "x", "best_of": 2,
+         "max_tokens": 2, "stream": True},
+        timeout=60.0,
+    )
+    assert code == 400
+
+
+def test_best_of_lt_n_rejected(direct_instance):
+    code, _ = http_post(
+        direct_instance.address, "/v1/completions",
+        {"model": "llama3-tiny", "prompt": "x", "n": 3, "best_of": 2,
+         "max_tokens": 2},
+        timeout=60.0,
+    )
+    assert code == 400
+
+
+def test_forwarded_n2(forwarded_stack):
+    master = forwarded_stack
+    code, body = http_post(
+        master.http_address, "/v1/completions",
+        {"model": "llama3-tiny", "prompt": "forwarded multi", "n": 2,
+         "max_tokens": 6, "temperature": 0.7, "seed": 11},
+        timeout=120.0,
+    )
+    assert code == 200, body
+    choices = body["choices"]
+    assert [c["index"] for c in choices] == [0, 1]
+    assert all(c["text"] for c in choices)
+    assert body["usage"]["completion_tokens"] == 12
+
+
+def test_forwarded_n2_stream(forwarded_stack):
+    master = forwarded_stack
+    events = sse_post(
+        master.http_address, "/v1/completions",
+        {"model": "llama3-tiny", "prompt": "forwarded stream multi",
+         "n": 2, "max_tokens": 4, "temperature": 0.7, "seed": 13,
+         "stream": True},
+        timeout=120.0,
+    )
+    assert events[-1] == "[DONE]"
+    seen = {c["index"] for e in events[:-1] for c in e.get("choices", [])}
+    assert seen == {0, 1}

@@ -1,0 +1,146 @@
+"""Real-tokenizer path (round-1 missing item 5 / next-round item 10): a
+genuine on-disk HF tokenizer dir — tokenizer.json (Rust fast tokenizer, the
+same wheel the reference binds via FFI) + tokenizer_config.json with a real
+Jinja chat template — exercised through HFTokenizer, ChatTemplate, and the
+incremental detokenizer. No network: the fixture BUILDS the tokenizer
+locally with the `tokenizers` library.
+"""
+
+import json
+
+import pytest
+
+from xllm_service_tpu.tokenizer import ChatTemplate, create_tokenizer, parse_messages
+from xllm_service_tpu.tokenizer.tokenizer import HFTokenizer, IncrementalDetokenizer
+
+CHATML_TEMPLATE = (
+    "{% for message in messages %}"
+    "{{ '<|im_start|>' + message['role'] + '\n' + message['content'] "
+    "+ '<|im_end|>' + '\n' }}"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}{{ '<|im_start|>assistant\n' }}{% endif %}"
+)
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "hello world, hello tokenizer",
+    "streaming detokenization holds back incomplete characters",
+    "héllo wörld — ünïcode résumé naïve",
+    "<|im_start|>user<|im_end|><|im_start|>assistant",
+    "numbers 0123456789 and punctuation!?.,;:",
+]
+
+
+@pytest.fixture(scope="module")
+def tok_dir(tmp_path_factory):
+    from tokenizers import Tokenizer as RustTokenizer
+    from tokenizers import decoders, models, pre_tokenizers, trainers
+
+    d = tmp_path_factory.mktemp("hf-tok")
+    rt = RustTokenizer(models.BPE())
+    rt.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    rt.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=384,
+        special_tokens=["<|endoftext|>", "<|im_start|>", "<|im_end|>"],
+        show_progress=False,
+    )
+    rt.train_from_iterator(CORPUS, trainer)
+    rt.save(str(d / "tokenizer.json"))
+    with open(d / "tokenizer_config.json", "w") as f:
+        json.dump(
+            {
+                "tokenizer_class": "PreTrainedTokenizerFast",
+                "eos_token": "<|endoftext|>",
+                "chat_template": CHATML_TEMPLATE,
+                "model_max_length": 2048,
+            },
+            f,
+        )
+    return str(d)
+
+
+def test_factory_selects_hf(tok_dir):
+    tok = create_tokenizer(tok_dir)
+    assert isinstance(tok, HFTokenizer)
+    assert tok.eos_token_id == tok.token_to_id("<|endoftext|>")
+    assert tok.vocab_size > 100  # tiny corpus trains ~200 merges
+
+
+def test_encode_decode_roundtrip(tok_dir):
+    tok = create_tokenizer(tok_dir)
+    for text in ("hello world", "the lazy dog", "résumé naïve — ünïcode"):
+        ids = tok.encode(text)
+        assert ids and all(isinstance(i, int) for i in ids)
+        assert tok.decode(ids) == text
+
+
+def test_chat_template_real_jinja(tok_dir):
+    """The model dir's OWN Jinja template renders (not the fallback)."""
+    tok = create_tokenizer(tok_dir)
+    ct = ChatTemplate(tok)
+    msgs = parse_messages(
+        [
+            {"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hello world"},
+        ]
+    )
+    prompt = ct.apply(msgs)
+    assert prompt == (
+        "<|im_start|>system\nbe brief<|im_end|>\n"
+        "<|im_start|>user\nhello world<|im_end|>\n"
+        "<|im_start|>assistant\n"
+    )
+    # and the rendered prompt tokenizes with the special tokens intact
+    ids = tok.encode(prompt)
+    assert tok.token_to_id("<|im_start|>") in ids
+
+
+def test_chat_template_multimodal_parts(tok_dir):
+    tok = create_tokenizer(tok_dir)
+    ct = ChatTemplate(tok)
+    msgs = parse_messages(
+        [
+            {
+                "role": "user",
+                "content": [
+                    {"type": "text", "text": "describe "},
+                    {"type": "image_url",
+                     "image_url": {"url": "http://x/img.png"}},
+                ],
+            }
+        ]
+    )
+    prompt = ct.apply(msgs)
+    assert "describe <|image|>" in prompt
+
+
+def test_incremental_detok_multibyte(tok_dir):
+    """Characters whose bytes span BPE token boundaries are held back until
+    complete — pushing one token id at a time must emit exactly the full
+    text, never a replacement char."""
+    tok = create_tokenizer(tok_dir)
+    text = "héllo wörld — résumé"
+    ids = tok.encode(text)
+    detok = IncrementalDetokenizer(tok)
+    out = ""
+    for i in ids:
+        piece = detok.push([i])
+        assert "�" not in piece
+        out += piece
+    out += detok.flush()
+    assert out == text
+
+
+def test_detok_state_carryover(tok_dir):
+    """PD handoff: the decode peer resumes mid-stream at the exact
+    byte/char position (export_state/from_state)."""
+    tok = create_tokenizer(tok_dir)
+    ids = tok.encode("the quick brown fox — ünïcode tail")
+    cut = len(ids) // 2
+    d1 = IncrementalDetokenizer(tok)
+    first = d1.push(ids[:cut])
+    state_ids, emitted = d1.export_state()
+    d2 = IncrementalDetokenizer.from_state(tok, state_ids, emitted)
+    rest = d2.push(ids[cut:]) + d2.flush()
+    assert first + rest == tok.decode(ids)

@@ -21,7 +21,7 @@ import os
 import queue
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from xllm_service_tpu.api.client import HeartbeatLoop, MasterClient
 from xllm_service_tpu.api.http_utils import (
@@ -146,8 +146,10 @@ class InstanceServer:
         self._push_thread = threading.Thread(
             target=self._push_loop, name=f"gen-push-{self.name}", daemon=True
         )
-        # service_request_id -> engine request_id (for /cancel)
-        self._srid_map: Dict[str, str] = {}
+        # service_request_id -> engine request_ids (n>1 fans out to one
+        # engine request per sequence; /cancel and dropped-stream feedback
+        # cancel them all)
+        self._srid_map: Dict[str, List[str]] = {}
         self._srid_mu = threading.Lock()
         # decode-peer address cache (PD disagg handoff target)
         self._peer_addrs: Dict[str, str] = {}
@@ -303,8 +305,8 @@ class InstanceServer:
                 if not keep:
                     self._relay_addrs.pop(srid, None)
                     with self._srid_mu:
-                        rid = self._srid_map.pop(srid, None)
-                    if rid is not None:
+                        rids = self._srid_map.pop(srid, None) or []
+                    for rid in rids:
                         self.engine.cancel(rid)
 
     def _relay_generations(
@@ -399,10 +401,10 @@ class InstanceServer:
         elif route == "/cancel":
             srid = body.get("service_request_id", "")
             with self._srid_mu:
-                rid = self._srid_map.pop(srid, None)
-            if rid is not None:
+                rids = self._srid_map.pop(srid, None) or []
+            for rid in rids:
                 self.engine.cancel(rid)
-            h.send_json({"ok": True, "cancelled": rid is not None})
+            h.send_json({"ok": True, "cancelled": bool(rids)})
         else:
             h.send_error_json(404, f"no route {route}")
 
@@ -550,7 +552,7 @@ class InstanceServer:
         sampling = sampling_from_body(header.get("sampling", {}), self.cfg)
         rid = generate_uuid(16)
         with self._srid_mu:
-            self._srid_map[srid] = rid
+            self._srid_map.setdefault(srid, []).append(rid)
         relay_addr = header.get("respond_addr", "")
         if relay_addr:
             self._relay_addrs[srid] = relay_addr
@@ -572,6 +574,154 @@ class InstanceServer:
         h.send_json({"ok": True, "request_id": rid})
 
     # ------------------------------------------------------------------ #
+    # n>1 / best_of fan-out
+    # ------------------------------------------------------------------ #
+
+    def _serve_fanout_forwarded(
+        self,
+        srid: str,
+        token_ids: List[int],
+        sampling: SamplingParams,
+        n: int,
+        best_of: int,
+    ) -> None:
+        """Run n (or best_of) sequences as independent engine requests and
+        push INDEXED deltas under one service_request_id. The prompt's KV
+        blocks are shared through the prefix cache. best_of buffers all
+        children and pushes only the top-n (by mean logprob) at the end."""
+        from xllm_service_tpu.common.types import Usage
+        from xllm_service_tpu.runtime.engine import EngineRequest
+
+        total = best_of or n
+        detoks: Dict[int, IncrementalDetokenizer] = {}
+        agg_mu = threading.Lock()
+        state = {
+            "remaining": total,
+            "generated": [0] * total,
+            "logprob_sum": [0.0] * total,
+            "buffered": {} if best_of else None,  # index -> merged SequenceOutput
+            "aborted": False,
+        }
+        want_logprobs = sampling.logprobs
+
+        def make_cb(i: int):
+            def cb(out: RequestOutput) -> bool:
+                out.service_request_id = srid
+                for s in out.outputs:
+                    s.index = i
+                    for lp in s.logprobs:
+                        state["logprob_sum"][i] += lp.data.logprob
+                with agg_mu:
+                    if state["aborted"]:
+                        return False
+                    if out.usage:
+                        state["generated"][i] = out.usage.num_generated_tokens
+                    last = False
+                    if out.finished:
+                        state["remaining"] -= 1
+                        last = state["remaining"] == 0
+                if not out.status.ok() and not out.cancelled:
+                    # Child error (reject/engine failure): surface it ONCE,
+                    # cancel the siblings, drop the request.
+                    with agg_mu:
+                        state["aborted"] = True
+                    with self._srid_mu:
+                        others = self._srid_map.pop(srid, None) or []
+                    for other in others:
+                        self.engine.cancel(other)
+                    out.finished = True
+                    self._push_q.put(out)
+                    return False
+                if state["buffered"] is not None:
+                    # best_of: hold everything until all children finish.
+                    with agg_mu:
+                        accumulate_sequences(state["buffered"], out)
+                    if last:
+                        self._finish_best_of(
+                            srid, state, token_ids, n, want_logprobs, detoks
+                        )
+                    return True
+                # n>1 streaming/accumulating path: push indexed deltas; only
+                # the LAST child's finish carries finished + merged usage
+                # (per-seq finish_reason still reaches the client).
+                self._detokenize(out, detoks)
+                if out.finished and not last:
+                    out.finished = False
+                    out.usage = None
+                elif out.finished and last:
+                    out.usage = Usage(
+                        num_prompt_tokens=len(token_ids),
+                        num_generated_tokens=sum(state["generated"]),
+                    )
+                    with self._srid_mu:
+                        self._srid_map.pop(srid, None)
+                self._push_q.put(out)
+                return True
+
+            return cb
+
+        # Register the rids BEFORE submitting: a fast-finishing child pops
+        # the srid entry, and a late registration would resurrect it (leak)
+        # or let a /cancel in the window find nothing to cancel.
+        rids = [generate_uuid(16) for _ in range(total)]
+        with self._srid_mu:
+            self._srid_map.setdefault(srid, []).extend(rids)
+        for i, rid in enumerate(rids):
+            self.engine.add_request(
+                EngineRequest(
+                    request_id=rid,
+                    prompt_token_ids=list(token_ids),
+                    sampling=self._child_sampling(
+                        sampling, i, need_logprobs=bool(best_of)
+                    ),
+                    callback=make_cb(i),
+                )
+            )
+
+    def _finish_best_of(
+        self,
+        srid: str,
+        state: Dict[str, Any],
+        token_ids: List[int],
+        n: int,
+        want_logprobs: bool,
+        detoks: Dict[int, IncrementalDetokenizer],
+    ) -> None:
+        """All best_of children done: rank by mean logprob, re-index the
+        top n as choices 0..n-1, push ONE final output."""
+        from xllm_service_tpu.common.types import Usage
+
+        merged = state["buffered"]
+        order = sorted(
+            merged,
+            key=lambda i: (
+                state["logprob_sum"][i] / max(len(merged[i].token_ids), 1)
+            ),
+            reverse=True,
+        )
+        winners = []
+        for new_idx, old_idx in enumerate(order[:n]):
+            s = merged[old_idx]
+            s.index = new_idx
+            if not want_logprobs:
+                s.logprobs = []
+            winners.append(s)
+        final = RequestOutput(
+            request_id=srid,
+            service_request_id=srid,
+            outputs=winners,
+            usage=Usage(
+                num_prompt_tokens=len(token_ids),
+                num_generated_tokens=sum(state["generated"]),
+            ),
+            finished=True,
+        )
+        self._detokenize(final, detoks)
+        with self._srid_mu:
+            self._srid_map.pop(srid, None)
+        self._push_q.put(final)
+
+    # ------------------------------------------------------------------ #
     def _prompt_tokens(self, body: Dict[str, Any], chat: bool) -> List[int]:
         # Forwarded traffic arrives pre-tokenized (the injection contract,
         # service.cpp:334-341) — never re-tokenize.
@@ -589,6 +739,40 @@ class InstanceServer:
                 return token_ids
         return self.tokenizer.encode(prompt)
 
+    @staticmethod
+    def _n_sequences(body: Dict[str, Any], chat: bool) -> Tuple[int, int, str]:
+        """Parse (n, best_of, error). best_of is the completions-only
+        over-generation count (>= n, select top-n by logprob); chat has no
+        best_of. Errors mirror OpenAI validation."""
+        try:
+            n = max(int(body.get("n") or 1), 1)
+        except (TypeError, ValueError):
+            return 1, 0, "invalid n"
+        best_of = 0
+        if not chat and body.get("best_of") is not None:
+            try:
+                best_of = int(body["best_of"])
+            except (TypeError, ValueError):
+                return n, 0, "invalid best_of"
+            if best_of < n:
+                return n, best_of, "best_of must be >= n"
+            if body.get("stream"):
+                return n, best_of, "best_of is not supported with streaming"
+        return n, best_of, ""
+
+    @staticmethod
+    def _child_sampling(sampling: SamplingParams, i: int, need_logprobs: bool):
+        """Per-sequence sampling params: distinct RNG stream per choice
+        (i=0 keeps the request seed so n=1 behavior is unchanged)."""
+        import dataclasses
+
+        seed = (sampling.seed + 0x9E3779B9 * i) & 0xFFFFFFFF
+        return dataclasses.replace(
+            sampling,
+            seed=seed,
+            logprobs=sampling.logprobs or need_logprobs,
+        )
+
     def _serve(self, h: QuietHandler, body: Dict[str, Any], chat: bool) -> None:
         from xllm_service_tpu.runtime.engine import EngineRequest
 
@@ -601,13 +785,25 @@ class InstanceServer:
         if not token_ids:
             h.send_error_json(400, "empty prompt")
             return
+        n, best_of, n_err = self._n_sequences(body, chat)
+        if n_err:
+            h.send_error_json(400, n_err)
+            return
         sampling = sampling_from_body(body, self.cfg)
+
+        if srid and self._master is not None and (n > 1 or best_of > 1):
+            # Fan-out mode: PD split is skipped for multi-sequence requests
+            # (a per-child handoff would need sub-request ids on the wire);
+            # this instance serves all sequences and pushes indexed deltas.
+            self._serve_fanout_forwarded(srid, token_ids, sampling, n, best_of)
+            h.send_json({"ok": True, "service_request_id": srid})
+            return
         rid = generate_uuid(16)
 
         if srid and self._master is not None:
             # Forwarded mode: ack now, stream back over /rpc/generations.
             with self._srid_mu:
-                self._srid_map[srid] = rid
+                self._srid_map.setdefault(srid, []).append(rid)
             detoks: Dict[int, IncrementalDetokenizer] = {}
             callback = self._make_push_callback(srid, detoks)
             routing = body.get("routing") or {}
@@ -648,7 +844,7 @@ class InstanceServer:
             return
 
         # Direct mode: this instance is the whole stack for one request.
-        self._serve_direct(h, body, chat, token_ids, sampling, rid)
+        self._serve_direct(h, body, chat, token_ids, sampling, rid, n, best_of)
 
     def _serve_direct(
         self,
@@ -658,8 +854,12 @@ class InstanceServer:
         token_ids: List[int],
         sampling: SamplingParams,
         rid: str,
+        n: int = 1,
+        best_of: int = 0,
     ) -> None:
         from xllm_service_tpu.runtime.engine import EngineRequest
+
+        total = best_of or n
 
         req = ServiceRequest(
             service_request_id=("chatcmpl-" if chat else "cmpl-") + rid,
@@ -679,7 +879,14 @@ class InstanceServer:
         done = threading.Event()
         acc: List[RequestOutput] = []
         sse: Optional[SseWriter] = None
-        first_sent = [False]
+        # Per-choice: each choice's first chat chunk must carry the
+        # assistant role (OpenAI stream semantics), not just the globally
+        # first chunk.
+        first_sent: Dict[int, bool] = {}
+        agg_mu = threading.Lock()
+        remaining = [total]
+        lp_sums = [0.0] * total
+        gen_counts = [0] * total
 
         detoks: Dict[int, IncrementalDetokenizer] = {}
         if req.stream:
@@ -696,46 +903,87 @@ class InstanceServer:
 
             stream = _Stream()
 
-            def callback(out: RequestOutput) -> bool:
-                if not out.status.ok() and not out.cancelled:
-                    # Engine-side failure: surface it, don't end as a clean
-                    # empty stream.
-                    sse.send(
-                        {"error": {"message": out.status.message,
-                                   "code": int(out.status.code)}}
+            def make_callback(i: int):
+                def callback(out: RequestOutput) -> bool:
+                    if not out.status.ok() and not out.cancelled:
+                        # Engine-side failure: surface it, don't end as a
+                        # clean empty stream.
+                        sse.send(
+                            {"error": {"message": out.status.message,
+                                       "code": int(out.status.code)}}
+                        )
+                        sse.close()
+                        done.set()
+                        return False
+                    for s in out.outputs:
+                        s.index = i
+                        gen_counts[i] += len(s.token_ids)
+                    with agg_mu:
+                        last = True
+                        if out.finished:
+                            remaining[0] -= 1
+                            last = remaining[0] == 0
+                        if out.finished and not last:
+                            # Suppress the per-child [DONE]; keep the
+                            # choice's finish_reason chunk.
+                            out.finished = False
+                            out.usage = None
+                        elif out.finished and out.usage and total > 1:
+                            from xllm_service_tpu.common.types import Usage
+
+                            out.usage = Usage(
+                                num_prompt_tokens=len(token_ids),
+                                num_generated_tokens=sum(gen_counts),
+                            )
+                    self._detokenize(out, detoks)
+                    ok = self._responses.send_delta_to_client(
+                        stream, req, out, first_sent.get(i, False)
                     )
-                    sse.close()
-                    done.set()
-                    return False
-                self._detokenize(out, detoks)
-                ok = self._responses.send_delta_to_client(
-                    stream, req, out, first_sent[0]
-                )
-                first_sent[0] = True
-                if out.finished or not ok:
-                    # Finished, or the client disconnected mid-stream —
-                    # either way the exchange is over; release the handler.
-                    done.set()
-                return ok
+                    first_sent[i] = True
+                    if out.finished or not ok:
+                        # All sequences finished, or the client
+                        # disconnected — the exchange is over.
+                        done.set()
+                    return ok
+
+                return callback
         else:
 
-            def callback(out: RequestOutput) -> bool:
-                self._detokenize(out, detoks)
-                acc.append(out)
-                if out.finished:
-                    done.set()
-                return True
+            def make_callback(i: int):
+                def callback(out: RequestOutput) -> bool:
+                    for s in out.outputs:
+                        s.index = i
+                        for lp in s.logprobs:
+                            lp_sums[i] += lp.data.logprob
+                    if not best_of:
+                        self._detokenize(out, detoks)
+                    with agg_mu:
+                        acc.append(out)
+                        if out.finished:
+                            remaining[0] -= 1
+                            if remaining[0] == 0:
+                                done.set()
+                    return True
 
-        self.engine.add_request(
-            EngineRequest(
-                request_id=rid,
-                prompt_token_ids=token_ids,
-                sampling=sampling,
-                callback=callback,
+                return callback
+
+        rids = []
+        for i in range(total):
+            child_rid = rid if i == 0 else generate_uuid(16)
+            rids.append(child_rid)
+            self.engine.add_request(
+                EngineRequest(
+                    request_id=child_rid,
+                    prompt_token_ids=list(token_ids),
+                    sampling=self._child_sampling(
+                        sampling, i, need_logprobs=bool(best_of)
+                    ),
+                    callback=make_callback(i),
+                )
             )
-        )
         if not done.wait(600.0):
-            self.engine.cancel(rid)
+            for child_rid in rids:
+                self.engine.cancel(child_rid)
             if sse is None:
                 # Only a never-started exchange can still carry an error
                 # response; an open SSE stream must not get a second head.
@@ -745,16 +993,81 @@ class InstanceServer:
                 h.close_connection = True
             return
         if not req.stream:
-            self._respond_accumulated(h, req, acc)
+            if best_of:
+                self._respond_best_of(
+                    h, req, acc, lp_sums, n, sampling.logprobs, detoks
+                )
+            else:
+                self._respond_accumulated(h, req, acc)
+
+    def _respond_best_of(
+        self,
+        h: QuietHandler,
+        req: ServiceRequest,
+        acc: List[RequestOutput],
+        lp_sums: List[float],
+        n: int,
+        want_logprobs: bool,
+        detoks: Dict[int, IncrementalDetokenizer],
+    ) -> None:
+        """Rank best_of children by mean logprob, return the top n as
+        choices 0..n-1 (completions API best_of semantics)."""
+        from xllm_service_tpu.common.types import Usage
+
+        if any(not o.status.ok() and not o.cancelled for o in acc):
+            self._respond_accumulated(h, req, acc)  # error path
+            return
+        merged: Dict[int, Any] = {}
+        for out in acc:
+            accumulate_sequences(merged, out)
+        order = sorted(
+            merged,
+            key=lambda i: lp_sums[i] / max(len(merged[i].token_ids), 1),
+            reverse=True,
+        )
+        winners = []
+        total_generated = sum(len(s.token_ids) for s in merged.values())
+        for new_idx, old_idx in enumerate(order[:n]):
+            s = merged[old_idx]
+            s.index = new_idx
+            if not want_logprobs:
+                s.logprobs = []
+            winners.append(s)
+        final = RequestOutput(
+            request_id=req.service_request_id,
+            service_request_id=req.service_request_id,
+            outputs=winners,
+            usage=Usage(
+                num_prompt_tokens=len(req.token_ids),
+                num_generated_tokens=total_generated,
+            ),
+            finished=True,
+        )
+        self._detokenize(final, detoks)
+
+        class _Once:
+            def finish(_, payload):
+                h.send_json(payload)
+                return True
+
+            def finish_with_error(_, code, msg):
+                h.send_error_json(500, msg)
+                return True
+
+        self._responses.send_result_to_client(_Once(), req, final)
 
     def _respond_accumulated(
         self, h: QuietHandler, req: ServiceRequest, acc: List[RequestOutput]
     ) -> None:
-        if acc and not acc[-1].status.ok():
-            code = acc[-1].status.code
+        # With n>1 children interleaving, an errored child's output can sit
+        # anywhere in acc — scan, don't just check the tail.
+        err = next(
+            (o for o in acc if not o.status.ok() and not o.cancelled), None
+        )
+        if err is not None:
             h.send_error_json(
-                429 if code == StatusCode.RESOURCE_EXHAUSTED else 500,
-                acc[-1].status.message,
+                429 if err.status.code == StatusCode.RESOURCE_EXHAUSTED else 500,
+                err.status.message,
             )
             return
         merged: Dict[int, Any] = {}
@@ -763,6 +1076,17 @@ class InstanceServer:
             accumulate_sequences(merged, out)
             if out.usage:
                 usage = out.usage
+        if usage is not None and len(merged) > 1:
+            # n>1: per-child usage only counts its own tokens — report the
+            # request-level total.
+            from xllm_service_tpu.common.types import Usage
+
+            usage = Usage(
+                num_prompt_tokens=usage.num_prompt_tokens,
+                num_generated_tokens=sum(
+                    len(s.token_ids) for s in merged.values()
+                ),
+            )
         final = RequestOutput(
             request_id=req.service_request_id,
             service_request_id=req.service_request_id,
@@ -823,6 +1147,14 @@ def main(argv=None) -> None:
         help="comma-separated prefill padding buckets",
     )
     args = parser.parse_args(argv)
+    # Restore standard JAX env semantics: some environments force a
+    # platform at interpreter start (sitecustomize), overriding
+    # JAX_PLATFORMS; an explicit env var wins here.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     cfg = EngineConfig(
         model=args.model,
         checkpoint_path=args.checkpoint_path,
