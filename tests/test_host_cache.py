@@ -26,9 +26,86 @@ def test_host_pool_lru():
     assert pool.put(b"h1", a) == []
     assert pool.put(b"h2", a) == []
     assert pool.get(b"h1") is not None  # h1 now MRU
-    assert pool.put(b"h3", a) == [b"h2"]  # h2 was LRU
+    evicted = pool.put(b"h3", a)  # h2 was LRU
+    assert [h for h, _ in evicted] == [b"h2"]
+    np.testing.assert_array_equal(evicted[0][1], a)
     assert pool.get(b"h2") is None
     assert b"h1" in pool and b"h3" in pool
+
+
+def test_ssd_pool_roundtrip(tmp_path):
+    from xllm_service_tpu.runtime.host_cache import SsdKVPool
+
+    pool = SsdKVPool(str(tmp_path / "ssd"), 2)
+    a = np.arange(24, dtype=np.float32).reshape(2, 1, 2, 2, 3)
+    assert pool.put(b"s1", a) == []
+    assert pool.put(b"s2", a * 2) == []
+    np.testing.assert_array_equal(pool.get(b"s1"), a)  # s1 now MRU
+    assert pool.put(b"s3", a * 3) == [b"s2"]
+    assert pool.get(b"s2") is None
+    np.testing.assert_array_equal(pool.get(b"s3"), a * 3)
+
+
+def test_dram_to_ssd_demotion_and_reimport(tmp_path):
+    """HBM -> DRAM -> SSD -> HBM: a block squeezed through all three tiers
+    re-imports from disk on a later prefix match, with the right events."""
+    cfg = EngineConfig(
+        model="llama3-tiny", num_blocks=4, block_size=16,
+        max_running_requests=2, max_seq_len=64, prefill_buckets=[48],
+        num_host_blocks=1, num_ssd_blocks=8,
+        ssd_cache_dir=str(tmp_path / "ssd"),
+    )
+    exe = ModelExecutor(cfg, init_seed=2)
+    items = []
+    orig = exe.prefill_batch
+
+    def spy(batch):
+        items.extend(batch)
+        return orig(batch)
+
+    exe.prefill_batch = spy
+    engine = InferenceEngine(cfg, executor=exe)
+    engine.start()
+    try:
+        bs = cfg.block_size
+        prompt_a = [(i * 11 + 1) % 512 for i in range(40)]  # 2 full blocks
+        prompt_b = [(i * 7 + 3) % 512 for i in range(40)]
+        hashes_a = prefix_block_hashes(prompt_a, bs, engine.block_mgr.seed)
+
+        def run(prompt):
+            ev = threading.Event()
+            engine.add_request(
+                EngineRequest(
+                    request_id=f"t{len(items)}",
+                    prompt_token_ids=list(prompt),
+                    sampling=SamplingParams(temperature=0.0, max_new_tokens=2),
+                    callback=lambda out, ev=ev: (
+                        ev.set() if out.finished else None
+                    ) or True,
+                )
+            )
+            assert ev.wait(120.0)
+
+        run(prompt_a)
+        engine.take_cache_event()
+        # B evicts A's 2 committed blocks: host pool holds 1, so one of
+        # them demotes straight through to SSD.
+        run(prompt_b)
+        ev = engine.take_cache_event()
+        tiers = {ev.offload_cache.get(hh) for hh in hashes_a[:2]}
+        assert "ssd" in tiers and "dram" in tiers, ev.to_json()
+        assert engine.ssd_pool is not None and len(engine.ssd_pool) >= 1
+
+        # A again: both blocks come back (one from DRAM, one from disk).
+        n_before = len(items)
+        run(prompt_a)
+        assert items[n_before].start_pos >= 2 * bs, (
+            f"tiered re-import missed: start_pos={items[n_before].start_pos}"
+        )
+        ev2 = engine.take_cache_event()
+        assert set(hashes_a[:2]) <= ev2.stored_cache  # re-promoted
+    finally:
+        engine.stop()
 
 
 class _EngineHarness:

@@ -1,0 +1,266 @@
+"""EPD multimodal: vision encoder + media-embedding injection.
+
+Oracle for injection: overriding placeholder rows with the embedding rows
+of OTHER tokens must produce exactly the logits/tokens of a prompt that
+contains those tokens directly (same positions, same RoPE). Media requests
+must bypass the prefix cache (placeholder ids cannot key content).
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xllm_service_tpu.common.config import EngineConfig
+from xllm_service_tpu.models import vision
+from xllm_service_tpu.ops.sampling import SamplingParams
+from xllm_service_tpu.runtime.engine import EngineRequest, InferenceEngine
+from xllm_service_tpu.runtime.executor import ModelExecutor, PrefillItem
+
+
+def _cfg(**kw):
+    base = dict(
+        model="llama3-tiny",
+        num_blocks=64,
+        max_running_requests=4,
+        max_seq_len=256,
+        prefill_buckets=[32, 64],
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def test_vision_encoder_output():
+    cfg = vision.get_vision_config("vit-tiny")
+    params = vision.init_vision_params(cfg, jax.random.key(0), jnp.float32)
+    imgs = jnp.asarray(
+        np.random.default_rng(0).random((3, cfg.image_size, cfg.image_size, 3)),
+        jnp.float32,
+    )
+    out = vision.encode_images(params, cfg, imgs)
+    assert out.shape == (3, cfg.out_tokens, cfg.out_dim)
+    assert np.isfinite(np.asarray(out)).all()
+    # deterministic
+    out2 = vision.encode_images(params, cfg, imgs)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    # different images -> different tokens
+    out3 = vision.encode_images(params, cfg, imgs[::-1])
+    assert not np.array_equal(np.asarray(out), np.asarray(out3))
+
+
+def test_injection_matches_direct_tokens():
+    """Injecting embed[t] at placeholder positions == prompting t directly."""
+    exe_a = ModelExecutor(_cfg(), init_seed=6)
+    exe_b = ModelExecutor(_cfg(), init_seed=6)
+    rng = np.random.default_rng(1)
+    n = 20
+    base = rng.integers(3, 500, n).astype(np.int32)
+    positions = np.asarray([4, 5, 11], np.int64)
+    targets = np.asarray([101, 202, 303], np.int32)
+
+    with_tokens = base.copy()
+    with_tokens[positions] = targets
+    with_placeholders = base.copy()
+    with_placeholders[positions] = 0  # pad id
+
+    embeds = np.asarray(exe_a.params["embed"])[targets].astype(np.float32)
+
+    table = np.zeros((exe_a.max_blocks_per_seq,), np.int32)
+    table[0], table[1] = 2, 3
+
+    tok_direct, lp_direct = exe_a.prefill(with_tokens, 0, table)
+    tok_inj, lp_inj = exe_b.prefill_batch(
+        [
+            PrefillItem(
+                token_ids=with_placeholders,
+                start_pos=0,
+                block_table=table,
+                mm_embeds=embeds,
+                mm_positions=positions,
+            )
+        ]
+    )[0]
+    assert tok_inj == tok_direct
+    np.testing.assert_allclose(lp_inj, lp_direct, atol=1e-4)
+    # KV caches identical outside the garbage block
+    np.testing.assert_array_equal(
+        np.asarray(exe_a.k_cache)[:, 1:], np.asarray(exe_b.k_cache)[:, 1:]
+    )
+
+
+def _run(engine, prompt, mm_embeds=None, mm_positions=None, max_new=4):
+    done = threading.Event()
+    toks = []
+
+    def cb(out):
+        for s in out.outputs:
+            toks.extend(s.token_ids)
+        if out.finished:
+            done.set()
+        return True
+
+    engine.add_request(
+        EngineRequest(
+            request_id=f"mm-{id(prompt) % 9999}-{len(toks)}",
+            prompt_token_ids=list(prompt),
+            sampling=SamplingParams(temperature=0.0, max_new_tokens=max_new),
+            callback=cb,
+            mm_embeds=mm_embeds,
+            mm_positions=mm_positions,
+        )
+    )
+    assert done.wait(120.0)
+    return toks
+
+
+def _raw_data_url(img: np.ndarray) -> str:
+    import base64
+
+    s = img.shape
+    payload = base64.b64encode(
+        np.ascontiguousarray(img, np.float32).tobytes()
+    ).decode()
+    return (
+        f"data:application/x-raw-f32;shape={s[0]}x{s[1]}x{s[2]};base64,"
+        + payload
+    )
+
+
+def test_epd_three_stage_e2e():
+    """Full EPD: client -> master -> ENCODE instance (vision encoder) ->
+    embeddings pushed to the serving instance -> prefill with injection ->
+    tokens. Different images must produce different outputs."""
+    import pytest
+
+    from xllm_service_tpu.api import Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+
+    from tests.test_api_e2e import http_post, wait_until
+
+    store = MemoryStore()
+    master = Master(
+        ServiceConfig(
+            host="127.0.0.1", http_port=0, rpc_port=0,
+            heartbeat_interval_s=0.2, master_lease_ttl_s=1.0,
+            load_balance_policy="RR", block_size=16,
+            mm_tokens_per_media=4,  # == vit-tiny out_tokens
+        ),
+        store=store,
+    )
+    master.start()
+    lm = InstanceServer(
+        EngineConfig(
+            model="llama3-tiny", dtype="float32", block_size=16,
+            num_blocks=64, max_running_requests=4, max_seq_len=256,
+            prefill_buckets=[64, 128], instance_name="mm-mix",
+            instance_type="MIX",
+        ),
+        master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.2,
+    )
+    enc = InstanceServer(
+        EngineConfig(
+            model="vit-tiny", instance_name="mm-enc",
+            instance_type="ENCODE",
+        ),
+        master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.2,
+    )
+    lm.start()
+    enc.start()
+    try:
+        assert wait_until(
+            lambda: master.scheduler.instance_mgr.counts()[2] == 1
+            and sum(master.scheduler.instance_mgr.counts()) == 2
+        )
+        rng = np.random.default_rng(5)
+        img_a = rng.random((32, 32, 3)).astype(np.float32)
+        img_b = (1.0 - img_a).astype(np.float32)
+
+        def ask(img):
+            code, body = http_post(
+                master.http_address, "/v1/chat/completions",
+                {
+                    "model": "llama3-tiny",
+                    "messages": [
+                        {
+                            "role": "user",
+                            "content": [
+                                {"type": "text", "text": "describe "},
+                                {"type": "image_url",
+                                 "image_url": {"url": _raw_data_url(img)}},
+                            ],
+                        }
+                    ],
+                    "max_tokens": 6,
+                    "temperature": 0.0,
+                },
+                timeout=180.0,
+            )
+            assert code == 200, body
+            return body["choices"][0]["message"]["content"]
+
+        out_a = ask(img_a)
+        out_b = ask(img_b)
+        out_a2 = ask(img_a)
+        assert out_a == out_a2  # deterministic per image
+        assert out_a != out_b  # the image actually reaches the LM
+
+        # media request without an encoder -> clean 4xx/5xx, not a hang
+        enc.stop()
+        assert wait_until(
+            lambda: master.scheduler.instance_mgr.counts()[2] == 0,
+            timeout=15.0,
+        )
+        code, body = http_post(
+            master.http_address, "/v1/chat/completions",
+            {
+                "model": "llama3-tiny",
+                "messages": [
+                    {
+                        "role": "user",
+                        "content": [
+                            {"type": "image_url",
+                             "image_url": {"url": _raw_data_url(img_a)}},
+                        ],
+                    }
+                ],
+                "max_tokens": 4,
+            },
+            timeout=60.0,
+        )
+        assert code in (400, 503), body
+    finally:
+        try:
+            enc.stop()
+        except Exception:
+            pass
+        lm.stop()
+        master.stop()
+        store.close()
+
+
+def test_media_requests_bypass_prefix_cache():
+    """Same placeholder token ids + different embeddings must produce
+    independent generations — nothing cached, nothing committed."""
+    eng = InferenceEngine(_cfg(), executor=ModelExecutor(_cfg(), init_seed=8))
+    eng.start()
+    try:
+        rng = np.random.default_rng(2)
+        prompt = [int(t) for t in rng.integers(3, 500, 40)]
+        pos = [2, 3]
+        e1 = rng.standard_normal((2, 128)).astype(np.float32)
+        e2 = rng.standard_normal((2, 128)).astype(np.float32) * 3.0
+
+        out1 = _run(eng, prompt, e1, pos)
+        ev = eng.take_cache_event()
+        assert not ev.stored_cache  # media blocks never committed
+
+        out2 = _run(eng, prompt, e2, pos)
+        assert out1 != out2  # different media -> different continuation
+
+        out1b = _run(eng, prompt, e1, pos)
+        assert out1b == out1  # deterministic given the same media
+    finally:
+        eng.stop()

@@ -29,6 +29,7 @@ from xllm_service_tpu.api.http_utils import (
     QuietHandler,
     SseWriter,
     post_bytes,
+    post_json,
 )
 from xllm_service_tpu.api.protocol import handoff_from_bytes, handoff_to_bytes
 from xllm_service_tpu.common.config import EngineConfig
@@ -92,10 +93,22 @@ class InstanceServer:
     ):
         # Deferred imports keep jax out of service-only processes.
         if engine is None:
-            from xllm_service_tpu.runtime.engine import InferenceEngine
-            from xllm_service_tpu.runtime.executor import ModelExecutor
+            if engine_cfg.instance_type == "ENCODE":
+                # EPD stage E: this instance hosts the vision encoder
+                # instead of an LM engine (engine_cfg.model names a
+                # VisionConfig, e.g. vit-tiny).
+                from xllm_service_tpu.runtime.vision_executor import (
+                    EncoderEngine,
+                )
 
-            engine = InferenceEngine(engine_cfg, executor=ModelExecutor(engine_cfg))
+                engine = EncoderEngine(model=engine_cfg.model)
+            else:
+                from xllm_service_tpu.runtime.engine import InferenceEngine
+                from xllm_service_tpu.runtime.executor import ModelExecutor
+
+                engine = InferenceEngine(
+                    engine_cfg, executor=ModelExecutor(engine_cfg)
+                )
         self.engine = engine
         self.cfg = engine_cfg
         self.tokenizer = create_tokenizer(tokenizer_path)
@@ -157,6 +170,12 @@ class InstanceServer:
         # prefill-instance address to relay generations through instead of
         # pushing to the master directly.
         self._relay_addrs: Dict[str, str] = {}
+        # EPD: media embeddings landed by the encoder stage, keyed by srid;
+        # the forwarded request waits on its event before admission.
+        # Values: (embeds, positions, arrival_ts) — TTL-reaped.
+        self._mm_imports: Dict[str, Tuple[Any, List[int], float]] = {}
+        self._mm_events: Dict[str, threading.Event] = {}
+        self._mm_mu = threading.Lock()
         # srid -> set once a generations push carrying it was acked by the
         # master; the handoff sender waits on this so the decode peer's
         # tokens can never reach the master before the first token
@@ -377,6 +396,10 @@ class InstanceServer:
             self._serve(h, body, chat=False)
         elif route == "/v1/chat/completions":
             self._serve(h, body, chat=True)
+        elif route == "/encode":
+            self._handle_encode(h, body)
+        elif route == "/mm/import":
+            self._handle_mm_import(h, body)
         elif route == "/rpc/relay_generations":
             # Prefill side of the alternate PD response topology: forward
             # the decode peer's token batch to the master synchronously so
@@ -572,6 +595,129 @@ class InstanceServer:
             handoff,
         )
         h.send_json({"ok": True, "request_id": rid})
+
+    # ------------------------------------------------------------------ #
+    # EPD multimodal (encoder stage + embedding import)
+    # ------------------------------------------------------------------ #
+
+    def _handle_encode(self, h: QuietHandler, body: Dict[str, Any]) -> None:
+        """ENCODE-instance entry: media parts in, embeddings pushed to the
+        prefill peer's /mm/import, ack out (three-stage EPD routing)."""
+        import base64
+
+        import numpy as np
+
+        if not hasattr(self.engine, "encode"):
+            h.send_error_json(501, "this instance has no encoder engine")
+            return
+        srid = body.get("service_request_id", "")
+        parts = body.get("parts") or []
+        positions = body.get("positions") or []
+        target = body.get("target", "")
+        if not parts or not target:
+            h.send_error_json(400, "parts and target are required")
+            return
+        vcfg = self.engine.executor.cfg
+        images = []
+        for p in parts:
+            shape = p.get("shape") or []
+            if (
+                len(shape) != 3
+                or shape[0] != vcfg.image_size
+                or shape[1] != vcfg.image_size
+                or shape[2] != 3
+            ):
+                h.send_error_json(
+                    400,
+                    f"media shape {shape} != encoder input "
+                    f"[{vcfg.image_size}, {vcfg.image_size}, 3]",
+                )
+                return
+            try:
+                arr = np.frombuffer(
+                    base64.b64decode(p["data"]), np.float32
+                ).reshape(shape)
+            except Exception as e:
+                h.send_error_json(400, f"bad media payload: {e}")
+                return
+            images.append(arr)
+        embeds = self.engine.encode(np.stack(images))  # [B, T, D]
+        flat = np.ascontiguousarray(embeds.reshape(-1, embeds.shape[-1]))
+        if positions and len(positions) != flat.shape[0]:
+            h.send_error_json(
+                400,
+                f"{len(positions)} placeholder positions but the encoder "
+                f"produced {flat.shape[0]} media tokens "
+                f"({embeds.shape[1]} per part — set mm_tokens_per_media)",
+            )
+            return
+        try:
+            code, resp = post_json(
+                target,
+                "/mm/import",
+                {
+                    "service_request_id": srid,
+                    "embeds": base64.b64encode(flat.tobytes()).decode(),
+                    "count": int(flat.shape[0]),
+                    "dim": int(flat.shape[1]),
+                    "positions": list(positions),
+                },
+                timeout=30.0,
+            )
+        except Exception as e:
+            h.send_error_json(502, f"prefill peer unreachable: {e}")
+            return
+        if code != 200:
+            h.send_error_json(502, f"prefill peer rejected embeddings: {resp}")
+            return
+        h.send_json({"ok": True, "media_tokens": int(flat.shape[0])})
+
+    _MM_IMPORT_TTL_S = 120.0
+
+    def _handle_mm_import(self, h: QuietHandler, body: Dict[str, Any]) -> None:
+        import base64
+
+        import numpy as np
+
+        srid = body.get("service_request_id", "")
+        try:
+            count = int(body["count"])
+            dim = int(body["dim"])
+            embeds = np.frombuffer(
+                base64.b64decode(body["embeds"]), np.float32
+            ).reshape(count, dim)
+            positions = [int(p) for p in body.get("positions", [])]
+        except Exception as e:
+            h.send_error_json(400, f"bad embeddings payload: {e}")
+            return
+        now = time.monotonic()
+        with self._mm_mu:
+            # Reap orphans (a push landing after its waiter timed out, or a
+            # master that died between /encode and the forward): without a
+            # TTL every such request pins its embedding array forever.
+            stale = [
+                s for s, (_, _, ts) in self._mm_imports.items()
+                if now - ts > self._MM_IMPORT_TTL_S
+            ]
+            for s in stale:
+                self._mm_imports.pop(s, None)
+                self._mm_events.pop(s, None)
+            self._mm_imports[srid] = (embeds, positions, now)
+            ev = self._mm_events.setdefault(srid, threading.Event())
+        ev.set()
+        h.send_json({"ok": True})
+
+    def _pop_mm_import(self, srid: str, timeout: float):
+        with self._mm_mu:
+            ev = self._mm_events.setdefault(srid, threading.Event())
+        if not ev.wait(timeout):
+            with self._mm_mu:
+                self._mm_events.pop(srid, None)
+            return None
+        with self._mm_mu:
+            self._mm_events.pop(srid, None)
+            entry = self._mm_imports.pop(srid, None)
+            return entry[:2] if entry is not None else None
 
     # ------------------------------------------------------------------ #
     # n>1 / best_of fan-out
@@ -802,12 +948,37 @@ class InstanceServer:
 
         if srid and self._master is not None:
             # Forwarded mode: ack now, stream back over /rpc/generations.
+            mm_embeds = mm_positions = None
+            if body.get("mm_positions"):
+                # EPD: the encoder stage pushed this request's media
+                # embeddings to /mm/import (usually already landed — the
+                # master dispatches the encoder first).
+                mm = self._pop_mm_import(srid, timeout=30.0)
+                if mm is None:
+                    h.send_error_json(503, "media embeddings never arrived")
+                    return
+                mm_embeds, mm_positions = mm
+                if len(mm_positions) != len(body["mm_positions"]):
+                    # Encoder and service disagree on media-token count —
+                    # reject rather than pair mismatched arrays (an
+                    # embeds/positions desync would crash the engine step).
+                    h.send_error_json(
+                        502,
+                        f"encoder produced {len(mm_positions)} media tokens "
+                        f"but the request has "
+                        f"{len(body['mm_positions'])} placeholders",
+                    )
+                    return
             with self._srid_mu:
                 self._srid_map.setdefault(srid, []).append(rid)
             detoks: Dict[int, IncrementalDetokenizer] = {}
             callback = self._make_push_callback(srid, detoks)
             routing = body.get("routing") or {}
             decode_name = routing.get("decode_name", "")
+            if mm_embeds is not None:
+                # Media requests serve colocated: the recomputed tail on a
+                # decode peer would need the embeddings too.
+                decode_name = ""
             if decode_name and decode_name != self.name:
                 # PD disaggregation: this instance is the prefill side —
                 # emit the first token, then migrate KV to the decode peer
@@ -838,6 +1009,8 @@ class InstanceServer:
                         prompt_token_ids=token_ids,
                         sampling=sampling,
                         callback=callback,
+                        mm_embeds=mm_embeds,
+                        mm_positions=mm_positions,
                     )
                 )
             h.send_json({"ok": True, "service_request_id": srid, "request_id": rid})

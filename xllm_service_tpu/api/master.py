@@ -370,12 +370,49 @@ class Master:
                     "prefill instance vanished",
                 )
                 return
+            if req.media_parts:
+                # EPD stage E: the encoder computes media embeddings and
+                # pushes them to the prefill peer's /mm/import BEFORE the
+                # text request arrives there.
+                enc = self.scheduler.instance_mgr.get_instance(
+                    req.routing.encode_name
+                )
+                if enc is None:
+                    self.scheduler.fail_request(
+                        req.service_request_id,
+                        StatusCode.UNAVAILABLE,
+                        "encode instance vanished",
+                    )
+                    return
+                try:
+                    code, resp = post_json(
+                        enc.http_address,
+                        "/encode",
+                        {
+                            "service_request_id": req.service_request_id,
+                            "parts": req.media_parts,
+                            "positions": req.mm_positions,
+                            "target": meta.http_address,
+                        },
+                        timeout=60.0,
+                    )
+                except Exception as e:
+                    code, resp = 0, str(e)
+                if code != 200:
+                    self.scheduler.fail_request(
+                        req.service_request_id,
+                        StatusCode.UNAVAILABLE,
+                        f"encoder failed: {resp}",
+                    )
+                    return
             fwd = augment_forwarded_request(
                 body, req.service_request_id, req.token_ids, req.routing,
                 decode_response_to_service=(
                     self.config.enable_decode_response_to_service
                 ),
             )
+            if req.mm_positions:
+                fwd["mm_positions"] = list(req.mm_positions)
             try:
                 code, resp = post_json(meta.http_address, path, fwd, timeout=30.0)
                 if code != 200:

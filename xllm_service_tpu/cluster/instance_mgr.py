@@ -23,6 +23,7 @@ import json
 import logging
 import threading
 import time
+import uuid
 from typing import Callable, Dict, List, Optional, Tuple
 
 from xllm_service_tpu.cluster.time_predictor import TimePredictor
@@ -83,6 +84,14 @@ class InstanceMgr:
         self._latency_metrics: Dict[str, LatencyMetrics] = {}
         self._load_metrics: Dict[str, LoadMetrics] = {}
         self._heartbeat_ts: Dict[str, float] = {}
+        # Last master-flush (epoch, counter) seen per instance: replicas
+        # only refresh liveness on PUTs whose stamp advances. The epoch is
+        # per-master-process randomness, NOT wall time — cross-host clock
+        # comparison would let a skewed old master disable refreshes after
+        # failover.
+        self._load_flush_seq: Dict[str, Tuple[str, int]] = {}
+        self._flush_epoch = uuid.uuid4().hex[:12]
+        self._flush_counter = 0
         self._dirty_load: set = set()  # names needing master->store upload
 
         self._rr_prefill = 0
@@ -131,7 +140,12 @@ class InstanceMgr:
         for key, raw in self._store.get_prefix(LOADMETRICS_PREFIX).items():
             name = key[len(LOADMETRICS_PREFIX):]
             try:
-                self._load_metrics[name] = LoadMetrics.from_json(json.loads(raw))
+                j = json.loads(raw)
+                seq = j.pop("_flush_seq", None)
+                j.pop("_flushed_at", None)
+                if seq is not None:
+                    self._load_flush_seq[name] = (str(seq[0]), int(seq[1]))
+                self._load_metrics[name] = LoadMetrics.from_json(j)
             except Exception:
                 pass
 
@@ -266,19 +280,33 @@ class InstanceMgr:
                 name = ev.key[len(LOADMETRICS_PREFIX):]
                 if ev.type == EventType.PUT:
                     try:
-                        self._load_metrics[name] = LoadMetrics.from_json(
-                            json.loads(ev.value)
-                        )
+                        j = json.loads(ev.value)
+                        seq = j.pop("_flush_seq", None)
+                        j.pop("_flushed_at", None)  # legacy stamp
+                        self._load_metrics[name] = LoadMetrics.from_json(j)
                         # A replicated metrics PUT proves the instance was
                         # alive at the master's flush — refresh liveness so a
                         # newly-promoted master does not mass-evict on its
-                        # first prune_disconnected pass.
-                        if name in self._instances:
+                        # first prune_disconnected pass. Only a PUT whose
+                        # flush sequence ADVANCES counts (same-epoch replays
+                        # of stale data must not extend a dead instance's
+                        # life); a new epoch — master failover — always
+                        # counts, and unstamped records (older writers)
+                        # refresh unconditionally.
+                        prev = self._load_flush_seq.get(name)
+                        fresh = True
+                        if seq is not None:
+                            epoch, counter = str(seq[0]), int(seq[1])
+                            if prev is not None and prev[0] == epoch:
+                                fresh = counter > prev[1]
+                            self._load_flush_seq[name] = (epoch, counter)
+                        if name in self._instances and fresh:
                             self._heartbeat_ts[name] = time.monotonic()
                     except Exception:
                         pass
                 else:
                     self._load_metrics.pop(name, None)
+                    self._load_flush_seq.pop(name, None)
 
     # ------------------------------------------------------------------ #
     # accessors
@@ -410,7 +438,12 @@ class InstanceMgr:
                 if n in self._load_metrics
             }
             self._dirty_load.clear()
+        self._flush_counter += 1
         for name, j in dirty.items():
+            # The flush sequence rides the record so replicas only refresh
+            # liveness on PUTs carrying NEW data — a slow master re-flushing
+            # stale metrics must not keep a dead instance alive.
+            j["_flush_seq"] = [self._flush_epoch, self._flush_counter]
             self._store.set(LOADMETRICS_PREFIX + name, json.dumps(j))
         return len(dirty)
 

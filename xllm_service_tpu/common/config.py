@@ -63,6 +63,10 @@ class ServiceConfig:
     # ENABLE_DECODE_RESPONSE_TO_SERVICE env, rpc_service/service.h:61-71).
     enable_decode_response_to_service: bool = True
 
+    # EPD multimodal: placeholder tokens inserted per media part — must
+    # match the encoder's VisionConfig.out_tokens.
+    mm_tokens_per_media: int = 4
+
     @classmethod
     def from_args(cls, argv: Optional[List[str]] = None) -> "ServiceConfig":
         parser = argparse.ArgumentParser("xllm-service-tpu master")
@@ -111,6 +115,9 @@ class EngineConfig:
 
     # Host offload (DRAM tier) blocks; 0 disables.
     num_host_blocks: int = 0
+    # SSD tier: blocks spilled from the host pool to local disk; 0 disables.
+    num_ssd_blocks: int = 0
+    ssd_cache_dir: str = ""  # empty = <tempdir>/xllm-ssd-cache-<pid>
 
     # Instance identity/role.
     instance_name: str = ""

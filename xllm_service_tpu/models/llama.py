@@ -212,17 +212,30 @@ def prefill_batch_step(
     # context-block bound, capping the per-layer gather (round-1 weak
     # item 4: gathering max_blocks*BS rows per chunk was O(L^2) with a
     # full-context materialization)
+    embed_overrides: jnp.ndarray | None = None,  # [P, M, E] media tokens
+    override_positions: jnp.ndarray | None = None,  # [P, M] chunk-relative;
+    # padding entries point at Lpad (a dummy row, sliced off)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Prefill P sequences' chunks in ONE compiled step (batched admission).
 
     K/V rows for all P*Lpad tokens scatter into the paged cache in a single
     flattened write (invalid rows land in garbage block 0); attention is
-    vmapped per sequence over its own sliced block table. Returns
-    (last-token logits [P, V], k', v')."""
+    vmapped per sequence over its own sliced block table. Media embeddings
+    (EPD encoder outputs) overwrite placeholder-token rows before the first
+    layer. Returns (last-token logits [P, V], k', v')."""
     bs = k_caches.shape[3]
     scale = cfg.head_dim**-0.5
     P, Lpad = token_ids.shape
     x = params["embed"][token_ids].astype(params["layers"]["wq"].dtype)
+    if embed_overrides is not None and embed_overrides.shape[1] > 0:
+        # Scatter into an extended buffer whose last row is a discard slot
+        # so padded positions (== Lpad) never corrupt real rows.
+        E = x.shape[-1]
+        ext = jnp.concatenate([x, jnp.zeros((P, 1, E), x.dtype)], axis=1)
+        ext = ext.at[
+            jnp.arange(P, dtype=jnp.int32)[:, None], override_positions
+        ].set(embed_overrides.astype(x.dtype))
+        x = ext[:, :Lpad]
 
     offsets = jnp.arange(Lpad, dtype=jnp.int32)[None, :]  # [1, Lpad]
     positions = start_pos[:, None] + offsets  # [P, Lpad]

@@ -1,0 +1,169 @@
+"""Vision encoder for the EPD multimodal stage.
+
+The reference's only vestige of multimodal serving is the chat-template
+MMContent message model (reference jinja_chat_template.h:30-47) and the
+EPD architecture notes — the encoder itself lives in the absent engine.
+Here it is first-class: a compact ViT whose output tokens are injected
+into the language model's prompt at media-marker positions
+(models/llama.py prefill embed overrides).
+
+TPU design points:
+  * patchify is a reshape + one [P*P*3, E] matmul — no conv lowering
+    needed, lands straight on the MXU;
+  * layers are scan-stacked like the LM (one compiled body);
+  * pooling to a FIXED number of output tokens (cfg.out_tokens) keeps the
+    LM-side injection shape static — the placeholder expansion in the
+    service tier uses the same constant.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from xllm_service_tpu.ops.norms import rms_norm
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    name: str
+    image_size: int  # square inputs [S, S, 3]
+    patch_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    out_tokens: int  # media tokens emitted per image (LM placeholders)
+    out_dim: int  # LM hidden size to project into
+    rms_norm_eps: float = 1e-5
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+_REGISTRY: Dict[str, VisionConfig] = {}
+
+
+def register_vision(cfg: VisionConfig) -> VisionConfig:
+    _REGISTRY[cfg.name] = cfg
+    return cfg
+
+
+def get_vision_config(name: str) -> VisionConfig:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown vision config '{name}'; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+register_vision(
+    VisionConfig(
+        name="vit-tiny",
+        image_size=32,
+        patch_size=8,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        out_tokens=4,
+        out_dim=128,  # matches llama3-tiny hidden_size
+    )
+)
+
+register_vision(
+    VisionConfig(
+        name="vit-base-patch14",
+        image_size=336,
+        patch_size=14,
+        hidden_size=1024,
+        intermediate_size=4096,
+        num_layers=24,
+        num_heads=16,
+        out_tokens=64,
+        out_dim=4096,  # llama3-8b hidden
+    )
+)
+
+
+def init_vision_params(cfg: VisionConfig, key, dtype=jnp.bfloat16) -> Params:
+    keys = jax.random.split(key, 10)
+    E, L = cfg.hidden_size, cfg.num_layers
+    D = E // cfg.num_heads
+    F = cfg.intermediate_size
+    patch_dim = cfg.patch_size * cfg.patch_size * 3
+
+    def w(k, shape, fan_in):
+        return (
+            jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)
+        ).astype(dtype)
+
+    return {
+        "patch_embed": w(keys[0], (patch_dim, E), patch_dim),
+        "pos_embed": w(keys[1], (cfg.num_patches, E), E),
+        "layers": {
+            "attn_norm": jnp.ones((L, E), jnp.float32),
+            "wqkv": w(keys[2], (L, E, 3 * E), E),
+            "wo": w(keys[3], (L, E, E), E),
+            "mlp_norm": jnp.ones((L, E), jnp.float32),
+            "w_up": w(keys[4], (L, E, F), E),
+            "w_down": w(keys[5], (L, F, E), F),
+        },
+        "final_norm": jnp.ones((E,), jnp.float32),
+        # pooled media tokens -> LM hidden (LLaVA-style connector)
+        "proj": w(keys[6], (E, cfg.out_dim), E),
+    }
+
+
+def _patchify(images: jnp.ndarray, patch: int) -> jnp.ndarray:
+    """[B, S, S, 3] -> [B, N, patch*patch*3] (pure reshape/transpose)."""
+    B, S, _, C = images.shape
+    n = S // patch
+    x = images.reshape(B, n, patch, n, patch, C)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(B, n * n, patch * patch * C)
+
+
+def encode_images(
+    params: Params, cfg: VisionConfig, images: jnp.ndarray
+) -> jnp.ndarray:
+    """[B, S, S, 3] float in [0, 1] -> media tokens [B, out_tokens, out_dim]."""
+    B = images.shape[0]
+    H, D = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    x = _patchify(images.astype(params["patch_embed"].dtype), cfg.patch_size)
+    x = jnp.einsum("bnp,pe->bne", x, params["patch_embed"])
+    x = x + params["pos_embed"][None]
+
+    def layer_fn(x, lp):
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        qkv = jnp.einsum("bne,ef->bnf", h, lp["wqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        N = q.shape[1]
+        q = q.reshape(B, N, H, D)
+        k = k.reshape(B, N, H, D)
+        v = v.reshape(B, N, H, D)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) * (D**-0.5)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+        attn = attn.reshape(B, N, -1).astype(x.dtype)
+        x = x + jnp.einsum("bne,ef->bnf", attn, lp["wo"])
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        h = jnp.einsum("bne,ef->bnf", h, lp["w_up"])
+        x = x + jnp.einsum("bnf,fe->bne", jax.nn.silu(h), lp["w_down"])
+        return x, None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    # Pool N patches into out_tokens groups (mean), then project to LM dim.
+    N = x.shape[1]
+    G = max(N // cfg.out_tokens, 1)
+    pooled = x[:, : G * cfg.out_tokens].reshape(
+        B, cfg.out_tokens, G, cfg.hidden_size
+    ).mean(axis=2)
+    return jnp.einsum("bte,ed->btd", pooled, params["proj"])

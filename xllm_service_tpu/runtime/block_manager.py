@@ -198,6 +198,16 @@ class BlockManager:
 
     # ------------------------------------------------------------ heartbeat
 
+    def record_tier_offload(self, block_hash: bytes, tier: str) -> None:
+        """A colder tier (dram->ssd demotion) now holds this hash. No-op if
+        HBM still holds it — the hot location stays authoritative."""
+        with self._ev_mu:
+            if block_hash in self._hash_to_block:
+                return
+            self._offloaded[block_hash] = tier
+            self._removed.discard(block_hash)
+            self._stored.discard(block_hash)
+
     def record_host_removed(self, block_hash: bytes) -> None:
         """The host tier dropped this hash. Only emit a removal if NO tier
         still holds it (an HBM re-promotion must not be un-indexed)."""

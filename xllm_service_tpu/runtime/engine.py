@@ -58,6 +58,16 @@ class EngineRequest:
     # rpc_service/service.h:61-71). `handoff` receives a KVHandoff.
     prefill_only: bool = False
     handoff: Optional[Callable[["KVHandoff"], None]] = None
+    # EPD multimodal: encoder-produced media embeddings [m, E] injected at
+    # these absolute prompt positions (placeholder tokens). Requests with
+    # media bypass the prefix cache — placeholder ids alone cannot key
+    # content-addressed blocks across different images.
+    mm_embeds: Optional[object] = None
+    mm_positions: Optional[object] = None
+
+    @property
+    def has_media(self) -> bool:
+        return self.mm_embeds is not None and len(self.mm_positions or ()) > 0
 
 
 @dataclass
@@ -130,12 +140,24 @@ class InferenceEngine:
         # Host (DRAM) cache tier: committed blocks evicted from HBM are
         # copied to host memory and re-imported on a later prefix match
         # (num_host_blocks=0 disables — reference tier contract proto:47).
+        # The SSD tier catches DRAM's own evictions on local disk.
         self.host_pool = None
+        self.ssd_pool = None
         if engine_cfg.num_host_blocks > 0:
-            from xllm_service_tpu.runtime.host_cache import HostKVPool
+            from xllm_service_tpu.runtime.host_cache import HostKVPool, SsdKVPool
 
             self.host_pool = HostKVPool(engine_cfg.num_host_blocks)
             self.block_mgr.on_evict = self._offload_to_host
+            if engine_cfg.num_ssd_blocks > 0:
+                import os
+                import tempfile
+
+                directory = engine_cfg.ssd_cache_dir or os.path.join(
+                    tempfile.gettempdir(), f"xllm-ssd-cache-{os.getpid()}"
+                )
+                self.ssd_pool = SsdKVPool(
+                    directory, engine_cfg.num_ssd_blocks
+                )
 
         self._waiting: Deque[EngineRequest] = collections.deque()
         # KV imports from prefill peers, landed on the engine thread
@@ -183,6 +205,8 @@ class InferenceEngine:
         self._work.set()
         if self._thread:
             self._thread.join(timeout=10)
+        if self.ssd_pool is not None:
+            self.ssd_pool.close()
 
     # ------------------------------------------------------------- metrics
 
@@ -312,8 +336,13 @@ class InferenceEngine:
             # Hash OUTSIDE the lock (long prompts hash thousands of blocks;
             # add_request/cancel must not stall behind it). Safe: this
             # thread is the only one that pops/appendlefts _waiting.
-            head_hashes = prefix_block_hashes(
-                tokens[: n_tok - 1], self.block_size, self.block_mgr.seed
+            has_media = self._item_req(item).has_media
+            head_hashes = (
+                []
+                if has_media
+                else prefix_block_hashes(
+                    tokens[: n_tok - 1], self.block_size, self.block_mgr.seed
+                )
             )
             if head_hashes and head_hashes[0] in pending_hashes:
                 # Defer: shares a prefix with this batch — next step's
@@ -330,12 +359,13 @@ class InferenceEngine:
             # Prefix-cache match — never the entire context (at least one
             # token must run to produce logits). The hash chain (already
             # computed for the dedup check) is shared with the host-tier
-            # continuation.
+            # continuation. Media requests bypass the cache entirely
+            # (head_hashes is empty for them).
             hashes = head_hashes
             num_cached, cached_blocks = self.block_mgr.match_prefix(
                 seq.tokens[: n_tok - 1], hashes=hashes
             )
-            if self.host_pool is not None:
+            if self.host_pool is not None and not has_media:
                 num_cached, cached_blocks = self._extend_match_from_host(
                     hashes, num_cached, list(cached_blocks)
                 )
@@ -380,6 +410,16 @@ class InferenceEngine:
                     top_p=s.top_p,
                     seed=s.seed,
                     step=len(seq.generated),
+                    mm_embeds=(
+                        np.asarray(seq.req.mm_embeds, np.float32)
+                        if seq.req.has_media
+                        else None
+                    ),
+                    mm_positions=(
+                        np.asarray(seq.req.mm_positions, np.int64)
+                        if seq.req.has_media
+                        else None
+                    ),
                 )
             )
         t0 = time.monotonic()
@@ -417,12 +457,34 @@ class InferenceEngine:
             self.executor.export_blocks([b for b, _ in items])
         )  # [2, L, n, Hkv, BS, D] — one device sync for the batch
         for i, (_, block_hash) in enumerate(items):
-            for evicted in self.host_pool.put(block_hash, kv[:, :, i]):
-                self.block_mgr.record_host_removed(evicted)
+            for ev_hash, ev_kv in self.host_pool.put(block_hash, kv[:, :, i]):
+                self._demote_to_ssd(ev_hash, ev_kv)
         # Only report hashes that SURVIVED the whole batch: a later put()
         # may have LRU-evicted an earlier one — claiming it saved would
         # leave a dangling DRAM entry in the master's index.
         return [h for _, h in items if h in self.host_pool]
+
+    def _demote_to_ssd(self, block_hash: bytes, kv: np.ndarray) -> None:
+        """DRAM eviction lands on disk when the SSD tier is enabled
+        (dram->ssd transition, reference proto:47); otherwise the hash is
+        gone from this instance."""
+        if self.ssd_pool is None:
+            self.block_mgr.record_host_removed(block_hash)
+            return
+        for dropped in self.ssd_pool.put(block_hash, kv):
+            self._record_cold_removed(dropped)
+        self.block_mgr.record_tier_offload(block_hash, "ssd")
+
+    def _record_cold_removed(self, block_hash: bytes) -> None:
+        """A cold tier dropped this hash — but another tier may still hold
+        it (DRAM re-population after an SSD spill); only report the tier
+        the instance still serves from, never a false removal."""
+        if self.host_pool is not None and block_hash in self.host_pool:
+            self.block_mgr.record_tier_offload(block_hash, "dram")
+        elif self.ssd_pool is not None and block_hash in self.ssd_pool:
+            self.block_mgr.record_tier_offload(block_hash, "ssd")
+        else:
+            self.block_mgr.record_host_removed(block_hash)
 
     def _extend_match_from_host(
         self, hashes: List[bytes], num_cached: int, cached_blocks: List[int]
@@ -434,6 +496,8 @@ class InferenceEngine:
         run: List[Tuple[bytes, np.ndarray]] = []
         for h in hashes[start:]:
             kv = self.host_pool.get(h)
+            if kv is None and self.ssd_pool is not None:
+                kv = self.ssd_pool.get(h)
             if kv is None:
                 break
             run.append((h, kv))
@@ -698,7 +762,11 @@ class InferenceEngine:
     # ------------------------------------------------------------- commits
 
     def _commit_full_blocks(self, seq: _Seq) -> None:
-        """Commit newly filled blocks under their chained hashes."""
+        """Commit newly filled blocks under their chained hashes. Media
+        requests never commit: their KV depends on encoder embeddings the
+        token-id hash cannot see."""
+        if seq.req.has_media:
+            return
         full = len(seq.tokens) // self.block_size
         committed = seq.last_committed_block + 1
         if full <= committed:

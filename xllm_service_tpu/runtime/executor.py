@@ -64,6 +64,10 @@ class PrefillItem:
     top_p: float = 1.0
     seed: int = 0
     step: int = 0
+    # Media-token injection (EPD): embeddings [m, E] overwrite the prompt's
+    # placeholder rows at these ABSOLUTE prompt positions.
+    mm_embeds: Optional[np.ndarray] = None
+    mm_positions: Optional[np.ndarray] = None
 
 
 class ModelExecutor:
@@ -258,10 +262,13 @@ class ModelExecutor:
         top_k,  # [P]
         top_p,  # [P]
         step_keys,  # [P]
+        mm_embeds=None,  # [P, M, E] or None
+        mm_positions=None,  # [P, M] chunk-relative (pad = Lpad)
     ):
         logits, k_cache, v_cache = llama.prefill_batch_step(
             params, self.cfg, k_cache, v_cache, token_ids, start_pos,
             true_len, block_tables,
+            embed_overrides=mm_embeds, override_positions=mm_positions,
         )
         tokens, logprob, _ = sampling_ops.sample_tokens(
             logits, temperature, top_k, top_p, step_keys
@@ -349,6 +356,31 @@ class ModelExecutor:
         keys = sampling_ops.make_step_keys(
             jnp.asarray(seeds), jnp.asarray(steps, jnp.int32)
         )
+        # Media-token injection: bucket the per-seq override count to a
+        # power of two; padded entries point at Lpad (the model's discard
+        # row). Positions are chunk-relative; overrides outside this chunk
+        # (already prefix-cached) are dropped.
+        mm_counts = []
+        for it in group:
+            cnt = 0
+            if it.mm_embeds is not None and it.mm_positions is not None:
+                rel = np.asarray(it.mm_positions, np.int64) - it.start_pos
+                cnt = int(((rel >= 0) & (rel < len(it.token_ids))).sum())
+            mm_counts.append(cnt)
+        M = self._pow2_bucket(max(mm_counts), 2**14) if any(mm_counts) else 0
+        mm_args = ()
+        if M:
+            E = self.cfg.hidden_size
+            embeds = np.zeros((P, M, E), np.float32)
+            positions = np.full((P, M), Lpad, np.int32)  # default: discard
+            for i, it in enumerate(group):
+                if not mm_counts[i]:
+                    continue
+                rel = np.asarray(it.mm_positions, np.int64) - it.start_pos
+                keep = (rel >= 0) & (rel < len(it.token_ids))
+                positions[i, : mm_counts[i]] = rel[keep]
+                embeds[i, : mm_counts[i]] = np.asarray(it.mm_embeds)[keep]
+            mm_args = (jnp.asarray(embeds), jnp.asarray(positions))
         self.k_cache, self.v_cache, toks, lps = self._prefill_jit(
             self.k_cache,
             self.v_cache,
@@ -361,6 +393,7 @@ class ModelExecutor:
             jnp.asarray(top_ks),
             jnp.asarray(top_ps),
             keys,
+            *mm_args,
         )
         toks = np.asarray(toks)
         lps = np.asarray(lps)

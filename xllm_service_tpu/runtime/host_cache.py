@@ -15,8 +15,9 @@ each offload/restore is one bulk copy, not a per-token scatter.
 
 from __future__ import annotations
 
+import os
 from collections import OrderedDict
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -46,14 +47,92 @@ class HostKVPool:
             self._data.move_to_end(block_hash)
         return kv
 
-    def put(self, block_hash: bytes, kv: np.ndarray) -> List[bytes]:
-        """Store a block; returns the hashes LRU-evicted to make room."""
-        evicted: List[bytes] = []
+    def put(
+        self, block_hash: bytes, kv: np.ndarray
+    ) -> List[Tuple[bytes, np.ndarray]]:
+        """Store a block; returns the (hash, kv) pairs LRU-evicted to make
+        room — the caller may demote them to a colder tier (SSD)."""
+        evicted: List[Tuple[bytes, np.ndarray]] = []
         if block_hash in self._data:
             self._data.move_to_end(block_hash)
             return evicted
         while len(self._data) >= self.capacity:
-            h, _ = self._data.popitem(last=False)
-            evicted.append(h)
+            h, arr = self._data.popitem(last=False)
+            evicted.append((h, arr))
         self._data[block_hash] = np.ascontiguousarray(kv)
         return evicted
+
+
+class SsdKVPool:
+    """Coldest tier: content-addressed KV blocks on local disk (the
+    reference's SSD tier — global_kvcache_mgr.cpp tier transitions,
+    proto:47). One .npy file per block, LRU by insertion/touch order."""
+
+    def __init__(self, directory: str, capacity_blocks: int):
+        if capacity_blocks <= 0:
+            raise ValueError("SsdKVPool needs capacity > 0")
+        os.makedirs(directory, exist_ok=True)
+        self.dir = directory
+        self.capacity = capacity_blocks
+        self._index: "OrderedDict[bytes, tuple]" = OrderedDict()
+        # Purge stale spill files from prior runs: the in-memory index
+        # starts empty, so anything on disk is unreachable garbage.
+        for f in os.listdir(directory):
+            if f.endswith(".kv"):
+                try:
+                    os.remove(os.path.join(directory, f))
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        """Delete this pool's spill files (engine shutdown)."""
+        for _, (path, _, _) in self._index.items():
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        self._index.clear()
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def __contains__(self, block_hash: bytes) -> bool:
+        return block_hash in self._index
+
+    def _path(self, block_hash: bytes) -> str:
+        return os.path.join(self.dir, block_hash.hex() + ".kv")
+
+    def get(self, block_hash: bytes) -> Optional[np.ndarray]:
+        entry = self._index.get(block_hash)
+        if entry is None:
+            return None
+        self._index.move_to_end(block_hash)
+        path, dtype, shape = entry
+        try:
+            with open(path, "rb") as f:
+                return np.frombuffer(f.read(), dtype=dtype).reshape(shape)
+        except Exception:
+            self._index.pop(block_hash, None)
+            return None
+
+    def put(self, block_hash: bytes, kv: np.ndarray) -> List[bytes]:
+        """Spill a block to disk; returns hashes dropped entirely. Raw
+        bytes + in-index (dtype, shape) metadata — np.save cannot
+        round-trip ml_dtypes bfloat16."""
+        dropped: List[bytes] = []
+        if block_hash in self._index:
+            self._index.move_to_end(block_hash)
+            return dropped
+        while len(self._index) >= self.capacity:
+            h, (path, _, _) = self._index.popitem(last=False)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            dropped.append(h)
+        kv = np.ascontiguousarray(kv)
+        path = self._path(block_hash)
+        with open(path, "wb") as f:
+            f.write(kv.tobytes())
+        self._index[block_hash] = (path, kv.dtype, kv.shape)
+        return dropped

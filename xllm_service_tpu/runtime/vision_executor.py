@@ -1,0 +1,113 @@
+"""Encoder-stage executor + engine shim (EPD stage E).
+
+Runs the vision encoder (models/vision.py) behind the same instance
+lifecycle the LM engines use: the ENCODE instance registers with the
+master, heartbeats load metrics, and serves `/encode` — media parts in,
+LM-ready embedding tokens out, pushed to the prefill peer's `/mm/import`.
+
+TPU design: image batches are bucketed to powers of two and encoded in one
+jitted call; weights stay resident.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xllm_service_tpu.common.types import (
+    KvCacheEvent,
+    LatencyMetrics,
+    LoadMetrics,
+)
+from xllm_service_tpu.models import vision
+
+
+class VisionExecutor:
+    def __init__(self, model: str = "vit-tiny", dtype: str = "float32",
+                 init_seed: int = 0):
+        self.cfg = vision.get_vision_config(model)
+        self.dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+        self.params = vision.init_vision_params(
+            self.cfg, jax.random.key(init_seed), self.dtype
+        )
+        self._jit = jax.jit(
+            lambda p, imgs: vision.encode_images(p, self.cfg, imgs)
+        )
+
+    @staticmethod
+    def _pow2(n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def encode(self, images: np.ndarray) -> np.ndarray:
+        """[B, S, S, 3] float32 in [0,1] -> [B, out_tokens, out_dim]."""
+        B = images.shape[0]
+        P = self._pow2(max(B, 1))
+        if P != B:
+            images = np.concatenate(
+                [images, np.zeros((P - B, *images.shape[1:]), images.dtype)]
+            )
+        out = self._jit(self.params, jnp.asarray(images, jnp.float32))
+        return np.asarray(out[:B], np.float32)
+
+
+class EncoderEngine:
+    """Engine-interface adapter so InstanceServer can host an ENCODE role:
+    start/stop, heartbeat metric sources, and the encode entry point."""
+
+    def __init__(self, executor: Optional[VisionExecutor] = None,
+                 model: str = "vit-tiny"):
+        self.executor = executor or VisionExecutor(model)
+        self._active = 0
+        self._mu = threading.Lock()
+        self._latency_window: List[Tuple[float, float]] = []
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    # -- heartbeat sources ---------------------------------------------
+    def get_load_metrics(self) -> LoadMetrics:
+        with self._mu:
+            return LoadMetrics(
+                waiting_requests_num=self._active, gpu_cache_usage_perc=0.0
+            )
+
+    def get_latency_metrics(self, window_s: float = 30.0) -> LatencyMetrics:
+        now = time.monotonic()
+        with self._mu:
+            self._latency_window = [
+                (t, ms) for t, ms in self._latency_window
+                if now - t <= window_s
+            ]
+            mx = max((ms for _, ms in self._latency_window), default=0)
+        return LatencyMetrics(recent_max_ttft=int(mx), recent_max_tbt=0)
+
+    def take_cache_event(self) -> KvCacheEvent:
+        return KvCacheEvent()
+
+    def profiling_data(self):
+        return [], []
+
+    # -- work -----------------------------------------------------------
+    def encode(self, images: np.ndarray) -> np.ndarray:
+        with self._mu:
+            self._active += 1
+        t0 = time.monotonic()
+        try:
+            return self.executor.encode(images)
+        finally:
+            ms = (time.monotonic() - t0) * 1000
+            with self._mu:
+                self._active -= 1
+                self._latency_window.append((time.monotonic(), ms))

@@ -47,6 +47,11 @@ class ServiceRequest:
     token_ids: List[int] = field(default_factory=list)
     routing: Routing = field(default_factory=Routing)
     created_time: float = field(default_factory=time.time)
+    # EPD multimodal (filled by the scheduler's media expansion): raw media
+    # payloads for the encoder stage + the placeholder-token positions in
+    # token_ids where its embeddings land.
+    media_parts: List[Dict[str, Any]] = field(default_factory=list)
+    mm_positions: List[int] = field(default_factory=list)
     # Filled by the scheduler:
     num_generated_tokens: int = 0
     estimated_ttft_ms: float = 0.0

@@ -20,6 +20,7 @@ Additions over the reference, per SURVEY.md §5/§7:
 from __future__ import annotations
 
 import logging
+import re
 import threading
 import time
 from collections import deque
@@ -213,6 +214,9 @@ class Scheduler:
                 )
             except Exception as e:
                 return Status(StatusCode.INVALID_ARGUMENT, f"chat template: {e}")
+        media_status = self._expand_media(request)
+        if media_status is not None:
+            return media_status
         if not request.token_ids:
             if not request.prompt:
                 return Status(StatusCode.INVALID_ARGUMENT, "empty prompt")
@@ -223,6 +227,14 @@ class Scheduler:
         request.routing = self._policy.select_instances_pair(request.token_ids)
         if not request.routing.prefill_name and not request.routing.decode_name:
             return Status(StatusCode.UNAVAILABLE, "no instances registered")
+        if request.media_parts:
+            # Three-stage EPD routing: the encoder runs before prefill.
+            request.routing.encode_name = self._instance_mgr.next_encode_instance()
+            if not request.routing.encode_name:
+                return Status(
+                    StatusCode.UNAVAILABLE,
+                    "media request but no ENCODE instance registered",
+                )
         pred = self._instance_mgr.get_time_predictor(request.routing.prefill_name)
         if pred is not None and pred.has_ttft_model:
             request.estimated_ttft_ms = pred.predict_ttft(len(request.token_ids))
@@ -230,6 +242,72 @@ class Scheduler:
             request.routing, RequestAction.SCHEDULE, len(request.token_ids)
         )
         return Status(StatusCode.OK)
+
+    _MM_MARKERS = ("<|image|>", "<|video|>", "<|audio|>")
+    _MM_DATA_RE = re.compile(
+        r"data:application/x-raw-f32;shape=(\d+)x(\d+)x(\d+);base64,(.*)",
+        re.S,
+    )
+
+    def _expand_media(self, request: ServiceRequest) -> Optional[Status]:
+        """EPD stage-E preparation (SURVEY.md §7 stage 7): media parts in
+        chat messages become runs of placeholder tokens in token_ids; the
+        raw payloads + placeholder positions ride the request so the master
+        can dispatch the encoder before prefill. Returns a Status only on
+        error; None means proceed (with or without media)."""
+        parts = [
+            p
+            for m in request.messages
+            if isinstance(m.content, list)
+            for p in m.content
+            if p.type != "text"
+        ]
+        if not parts:
+            return None
+        media_parts = []
+        for p in parts:
+            m = self._MM_DATA_RE.match(p.url or "")
+            if not m:
+                return Status(
+                    StatusCode.INVALID_ARGUMENT,
+                    f"unsupported media URL for {p.type}: expected a "
+                    "data:application/x-raw-f32;shape=HxWxC;base64 payload",
+                )
+            media_parts.append(
+                {
+                    "type": p.type,
+                    "shape": [int(m.group(1)), int(m.group(2)), int(m.group(3))],
+                    "data": m.group(4),
+                }
+            )
+        k = self._config.mm_tokens_per_media
+        marker_re = re.compile(
+            "(" + "|".join(re.escape(s) for s in self._MM_MARKERS) + ")"
+        )
+        segments = marker_re.split(request.prompt)
+        n_markers = sum(1 for s in segments if s in self._MM_MARKERS)
+        if n_markers != len(media_parts):
+            # STRICT equality: a literal marker string typed inside a text
+            # part would otherwise steal a real image's placeholder slot
+            # and bind its embeddings to an attacker-chosen position.
+            return Status(
+                StatusCode.INVALID_ARGUMENT,
+                f"{len(media_parts)} media parts but {n_markers} media "
+                "markers in the templated prompt (literal marker text in a "
+                "message is not allowed)",
+            )
+        token_ids: List[int] = []
+        positions: List[int] = []
+        for seg in segments:
+            if seg in self._MM_MARKERS:
+                positions.extend(range(len(token_ids), len(token_ids) + k))
+                token_ids.extend([0] * k)  # placeholder (pad) tokens
+            elif seg:
+                token_ids.extend(self._tokenizer.encode(seg))
+        request.token_ids = token_ids
+        request.mm_positions = positions
+        request.media_parts = media_parts
+        return None
 
     def should_defer_offline(self, request: ServiceRequest) -> bool:
         """Hybrid scheduling: park offline work while online traffic keeps
