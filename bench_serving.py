@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Burst-trace serving benchmark (SURVEY.md §7 stage 8).
+
+Replays a synthetic ShareGPT-shaped trace — Poisson arrivals, lognormal
+prompt/output lengths — against an in-process cluster (master + N
+instances over real sockets) and reports TTFT/TPOT/throughput percentiles
+as ONE JSON line. Default backend is the fake engine (isolates the
+service tier); --real-engine serves the actual JAX engine (llama3-tiny on
+CPU, llama3-1b on TPU).
+
+    python bench_serving.py --requests 64 --rate 32
+    python bench_serving.py --real-engine --requests 16 --rate 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("xllm-service-tpu burst bench")
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--rate", type=float, default=32.0, help="mean arrivals/s")
+    p.add_argument("--instances", type=int, default=2)
+    p.add_argument("--real-engine", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--policy", default="RR", choices=["RR", "CAR", "SLO_AWARE"])
+    args = p.parse_args()
+
+    import os
+
+    if not args.real_engine:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+    import numpy as np
+
+    from xllm_service_tpu.api import FakeEngine, Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+
+    rng = np.random.default_rng(args.seed)
+    store = MemoryStore()
+    cfg = ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=1.0, master_lease_ttl_s=3.0,
+        load_balance_policy=args.policy, block_size=16,
+    )
+    master = Master(cfg, store=store)
+    master.start()
+
+    on_tpu = False
+    if args.real_engine:
+        import jax
+
+        on_tpu = jax.default_backend() == "tpu"
+    model = "llama3-1b" if on_tpu else "llama3-tiny"
+
+    instances = []
+    for i in range(args.instances):
+        if args.real_engine:
+            ecfg = EngineConfig(
+                model=model, block_size=128 if on_tpu else 16,
+                num_blocks=512 if on_tpu else 128,
+                max_running_requests=32 if on_tpu else 8,
+                max_seq_len=2048 if on_tpu else 256,
+                prefill_buckets=(
+                    [256, 512, 1024, 2048] if on_tpu else [64, 128, 256]
+                ),
+                instance_name=f"bench{i}", instance_type="MIX",
+            )
+            srv = InstanceServer(
+                ecfg, master_rpc_addr=master.rpc_address,
+                heartbeat_interval_s=1.0,
+            )
+        else:
+            ecfg = EngineConfig(
+                model="fake-echo", instance_name=f"bench{i}",
+                instance_type="MIX", block_size=16,
+            )
+            srv = InstanceServer(
+                ecfg, master_rpc_addr=master.rpc_address,
+                heartbeat_interval_s=1.0,
+                engine=FakeEngine(token_delay_s=0.002, ttft_ms=10.0),
+            )
+        srv.start()
+        instances.append(srv)
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if sum(master.scheduler.instance_mgr.counts()) == args.instances:
+            break
+        time.sleep(0.05)
+
+    # Trace: lognormal prompt chars / output tokens, Poisson arrivals.
+    prompt_lens = np.clip(
+        rng.lognormal(mean=4.0, sigma=0.6, size=args.requests), 16, 180
+    ).astype(int)
+    out_lens = np.clip(
+        rng.lognormal(mean=2.6, sigma=0.5, size=args.requests), 4, 48
+    ).astype(int)
+    gaps = rng.exponential(1.0 / args.rate, size=args.requests)
+
+    ttfts, tpots, lats, errors = [], [], [], []
+    first_tokens = [0]
+    mu = threading.Lock()
+
+    def drive(i: int):
+        t0 = time.monotonic()
+        try:
+            host, _, port = master.http_address.partition(":")
+            import http.client
+
+            conn = http.client.HTTPConnection(host, int(port), timeout=300.0)
+            conn.request(
+                "POST", "/v1/completions",
+                body=json.dumps(
+                    {
+                        "model": model if args.real_engine else "fake-echo",
+                        "prompt": "w" * int(prompt_lens[i]),
+                        "max_tokens": int(out_lens[i]),
+                        "temperature": 0.0,
+                        "stream": True,
+                    }
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            assert resp.status == 200, resp.read()
+            n_tok = 0
+            t_first = t_last = None
+            deltas = []
+            for raw in resp:
+                line = raw.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    break
+                now = time.monotonic()
+                if t_first is None:
+                    t_first = now
+                elif t_last is not None:
+                    deltas.append(now - t_last)
+                t_last = now
+                n_tok += 1
+            conn.close()
+            with mu:
+                if t_first is not None:
+                    ttfts.append(t_first - t0)
+                tpots.extend(deltas)
+                lats.append(time.monotonic() - t0)
+                first_tokens[0] += n_tok
+        except Exception as e:  # noqa: BLE001
+            with mu:
+                errors.append(repr(e))
+
+    threads = []
+    t_start = time.monotonic()
+    for i in range(args.requests):
+        time.sleep(float(gaps[i]))
+        t = threading.Thread(target=drive, args=(i,))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=600.0)
+    wall = time.monotonic() - t_start
+
+    for srv in instances:
+        srv.stop()
+    master.stop()
+    store.close()
+
+    def pct(xs, q):
+        return round(float(np.percentile(xs, q)), 4) if xs else None
+
+    print(
+        json.dumps(
+            {
+                "metric": "serving_burst",
+                "backend": (
+                    ("tpu" if on_tpu else "cpu-real")
+                    if args.real_engine
+                    else "fake"
+                ),
+                "policy": args.policy,
+                "requests": args.requests,
+                "errors": len(errors),
+                "rate_req_s": args.rate,
+                "wall_s": round(wall, 3),
+                "total_tokens": first_tokens[0],
+                "throughput_tok_s": round(first_tokens[0] / wall, 1),
+                "ttft_p50_s": pct(ttfts, 50),
+                "ttft_p99_s": pct(ttfts, 99),
+                "tpot_p50_ms": (
+                    round(1000 * float(np.percentile(tpots, 50)), 2)
+                    if tpots else None
+                ),
+                "tpot_p99_ms": (
+                    round(1000 * float(np.percentile(tpots, 99)), 2)
+                    if tpots else None
+                ),
+                "req_p99_s": pct(lats, 99),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

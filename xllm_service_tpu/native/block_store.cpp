@@ -1,0 +1,293 @@
+// Native paged-KV block store: the engine's hot bookkeeping path.
+//
+// C++ core for runtime/block_manager.py semantics (the reference's
+// allocator/scheduler tier is likewise native): free-list + refcounted
+// blocks, content-addressed committed-block index keyed by 16-byte chained
+// murmur3 hashes (common/hashing.py contract), LRU eviction of unreferenced
+// committed blocks, and the stored/removed/offloaded event deltas the
+// heartbeat drains. Thread-safe (the heartbeat thread drains events while
+// the engine thread mutates).
+//
+// Exposed as a C ABI consumed via ctypes (runtime/native_blocks.py); the
+// Python BlockManager remains as fallback and as the parity oracle in
+// tests/test_native_blocks.py.
+
+#include <cstdint>
+#include <cstring>
+#include <list>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kHashLen = 16;
+
+struct BlockInfo {
+  int ref = 0;
+  bool has_hash = false;
+  std::string hash;  // 16 bytes when has_hash
+  bool in_evictable = false;
+  std::list<int>::iterator lru_it;
+};
+
+struct Store {
+  std::mutex mu;
+  int num_blocks = 0;
+  int block_size = 0;
+  std::vector<BlockInfo> blocks;
+  std::vector<int> free_list;                       // LIFO like the Python pop()
+  std::unordered_map<std::string, int> hash_index;  // committed hash -> block
+  std::list<int> evictable;                         // front = LRU victim
+
+  // Heartbeat event deltas (guarded by mu, like BlockManager._ev_mu).
+  std::set<std::string> stored;
+  std::set<std::string> removed;
+  std::map<std::string, int> offloaded;  // hash -> tier (0=dram, 1=ssd)
+
+  int free_count_locked() const {
+    return static_cast<int>(free_list.size() + evictable.size());
+  }
+};
+
+std::string key_of(const char* h) { return std::string(h, kHashLen); }
+
+void detach_evictable(Store* s, int id) {
+  BlockInfo& b = s->blocks[id];
+  if (b.in_evictable) {
+    s->evictable.erase(b.lru_it);
+    b.in_evictable = false;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* xbs_new(int num_blocks, int block_size) {
+  if (num_blocks < 2) return nullptr;
+  auto* s = new Store();
+  s->num_blocks = num_blocks;
+  s->block_size = block_size;
+  s->blocks.resize(num_blocks);
+  s->free_list.reserve(num_blocks - 1);
+  // Block 0 is the reserved garbage slot — never allocated.
+  for (int i = 1; i < num_blocks; ++i) s->free_list.push_back(i);
+  return s;
+}
+
+void xbs_free_store(void* p) { delete static_cast<Store*>(p); }
+
+int xbs_num_free(void* p) {
+  auto* s = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(s->mu);
+  return s->free_count_locked();
+}
+
+// Allocate n blocks (ref=1 each). Committed LRU victims are UN-indexed and
+// reported via out_evicted_{ids,hashes} so the caller can offer their
+// content to a colder tier, then record the matching event. Returns 0 on
+// success, -1 if capacity is insufficient (nothing changes).
+int xbs_allocate(void* p, int n, int32_t* out_ids, int32_t* out_evicted_ids,
+                 char* out_evicted_hashes, int* n_evicted) {
+  auto* s = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(s->mu);
+  *n_evicted = 0;
+  if (n > s->free_count_locked()) return -1;
+  int got = 0;
+  while (got < n && !s->free_list.empty()) {
+    int id = s->free_list.back();
+    s->free_list.pop_back();
+    s->blocks[id].ref = 1;
+    out_ids[got++] = id;
+  }
+  while (got < n) {
+    int victim = s->evictable.front();
+    s->evictable.pop_front();
+    BlockInfo& b = s->blocks[victim];
+    b.in_evictable = false;
+    if (b.has_hash) {
+      s->hash_index.erase(b.hash);
+      out_evicted_ids[*n_evicted] = victim;
+      std::memcpy(out_evicted_hashes + *n_evicted * kHashLen, b.hash.data(),
+                  kHashLen);
+      ++(*n_evicted);
+      b.has_hash = false;
+      b.hash.clear();
+    }
+    b.ref = 1;
+    out_ids[got++] = victim;
+  }
+  return 0;
+}
+
+void xbs_acquire(void* p, int id) {
+  auto* s = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (id < 1 || id >= s->num_blocks) return;  // bounds: no UB on bad ids
+  BlockInfo& b = s->blocks[id];
+  if (b.ref == 0) detach_evictable(s, id);
+  b.ref += 1;
+}
+
+// Releases every VALID id; returns 0 when all were valid live references,
+// -1 if any id was out of range or double-freed (the rest still release —
+// a partial abort would leak the tail of the list).
+int xbs_release(void* p, const int32_t* ids, int n) {
+  auto* s = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(s->mu);
+  int rc = 0;
+  for (int i = 0; i < n; ++i) {
+    if (ids[i] < 1 || ids[i] >= s->num_blocks) {
+      rc = -1;
+      continue;
+    }
+    BlockInfo& b = s->blocks[ids[i]];
+    if (b.ref <= 0) {
+      rc = -1;
+      continue;
+    }
+    b.ref -= 1;
+    if (b.ref == 0) {
+      if (b.has_hash) {
+        s->evictable.push_back(ids[i]);
+        b.lru_it = std::prev(s->evictable.end());
+        b.in_evictable = true;
+      } else {
+        s->free_list.push_back(ids[i]);
+      }
+    }
+  }
+  return rc;
+}
+
+// Returns 1 if the block was committed under the hash, 0 if the hash is
+// already indexed elsewhere or the block already carries a hash.
+int xbs_commit(void* p, int id, const char* hash) {
+  auto* s = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (id < 1 || id >= s->num_blocks) return 0;
+  std::string k = key_of(hash);
+  if (s->hash_index.count(k)) return 0;
+  BlockInfo& b = s->blocks[id];
+  if (b.has_hash) return 0;
+  b.has_hash = true;
+  b.hash = k;
+  s->hash_index[k] = id;
+  s->stored.insert(k);
+  s->removed.erase(k);
+  s->offloaded.erase(k);
+  return 1;
+}
+
+int xbs_lookup(void* p, const char* hash) {
+  auto* s = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->hash_index.find(key_of(hash));
+  return it == s->hash_index.end() ? -1 : it->second;
+}
+
+// Longest-prefix walk over n chained hashes; matched blocks are acquired
+// (ref+1, detached from the LRU). Returns the match count.
+int xbs_match_prefix(void* p, const char* hashes, int n, int32_t* out_ids) {
+  auto* s = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(s->mu);
+  int matched = 0;
+  for (int i = 0; i < n; ++i) {
+    auto it = s->hash_index.find(key_of(hashes + i * kHashLen));
+    if (it == s->hash_index.end()) break;
+    out_ids[matched++] = it->second;
+  }
+  for (int i = 0; i < matched; ++i) {
+    BlockInfo& b = s->blocks[out_ids[i]];
+    if (b.ref == 0) detach_evictable(s, out_ids[i]);
+    b.ref += 1;
+  }
+  return matched;
+}
+
+// Event recording — guards mirror block_manager.py exactly.
+void xbs_record_removed_unless_hot(void* p, const char* hash) {
+  auto* s = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string k = key_of(hash);
+  s->offloaded.erase(k);
+  if (!s->hash_index.count(k)) {
+    s->removed.insert(k);
+    s->stored.erase(k);
+  }
+}
+
+void xbs_record_offload(void* p, const char* hash, int tier) {
+  auto* s = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string k = key_of(hash);
+  if (s->hash_index.count(k)) return;  // hot tier stays authoritative
+  s->offloaded[k] = tier;
+  s->removed.erase(k);
+  s->stored.erase(k);
+}
+
+// Post-eviction accounting for xbs_allocate's victims: saved ones become
+// offload events, the rest removals.
+void xbs_record_evicted(void* p, const char* hash, int saved_tier /*-1=no*/) {
+  auto* s = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string k = key_of(hash);
+  if (saved_tier >= 0) {
+    s->offloaded[k] = saved_tier;
+    s->removed.erase(k);
+  } else {
+    s->removed.insert(k);
+  }
+  s->stored.erase(k);
+}
+
+void xbs_event_counts(void* p, int* n_stored, int* n_removed, int* n_offload) {
+  auto* s = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(s->mu);
+  *n_stored = static_cast<int>(s->stored.size());
+  *n_removed = static_cast<int>(s->removed.size());
+  *n_offload = static_cast<int>(s->offloaded.size());
+}
+
+// Drain events. Buffers hold `cap_*` 16-byte hashes (+ tiers). Returns 0 and
+// drains when everything fits, else -1 and drains NOTHING (retry bigger).
+int xbs_take_events(void* p, char* stored_buf, int cap_stored, int* n_stored,
+                    char* removed_buf, int cap_removed, int* n_removed,
+                    char* offload_buf, int32_t* offload_tiers, int cap_offload,
+                    int* n_offload) {
+  auto* s = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (static_cast<int>(s->stored.size()) > cap_stored ||
+      static_cast<int>(s->removed.size()) > cap_removed ||
+      static_cast<int>(s->offloaded.size()) > cap_offload) {
+    *n_stored = static_cast<int>(s->stored.size());
+    *n_removed = static_cast<int>(s->removed.size());
+    *n_offload = static_cast<int>(s->offloaded.size());
+    return -1;
+  }
+  int i = 0;
+  for (const auto& k : s->stored)
+    std::memcpy(stored_buf + (i++) * kHashLen, k.data(), kHashLen);
+  *n_stored = i;
+  i = 0;
+  for (const auto& k : s->removed)
+    std::memcpy(removed_buf + (i++) * kHashLen, k.data(), kHashLen);
+  *n_removed = i;
+  i = 0;
+  for (const auto& kv : s->offloaded) {
+    std::memcpy(offload_buf + i * kHashLen, kv.first.data(), kHashLen);
+    offload_tiers[i++] = kv.second;
+  }
+  *n_offload = i;
+  s->stored.clear();
+  s->removed.clear();
+  s->offloaded.clear();
+  return 0;
+}
+
+}  // extern "C"

@@ -133,7 +133,9 @@ class InferenceEngine:
         self.block_size = self.executor.block_size
         self.R = self.executor.R
         self.max_blocks = self.executor.max_blocks_per_seq
-        self.block_mgr = BlockManager(
+        from xllm_service_tpu.runtime.native_blocks import create_block_manager
+
+        self.block_mgr = create_block_manager(
             self.executor.num_blocks, self.block_size,
             seed=engine_cfg.murmur_hash3_seed,
         )
@@ -598,6 +600,13 @@ class InferenceEngine:
                     raise ValueError(
                         f"{len(h.block_hashes)} block hashes for "
                         f"{h.num_full_blocks} blocks; recomputing"
+                    )
+                if any(
+                    not isinstance(hb, bytes) or len(hb) != 16
+                    for hb in h.block_hashes
+                ):
+                    raise ValueError(
+                        "malformed block hash in handoff; recomputing"
                     )
                 fresh = [
                     i

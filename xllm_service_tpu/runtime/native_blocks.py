@@ -1,0 +1,288 @@
+"""ctypes binding for the C++ block store (native/block_store.cpp).
+
+`NativeBlockManager` is interface-identical to runtime/block_manager.py's
+BlockManager — the engine picks whichever `create_block_manager` returns.
+The native core owns the hot bookkeeping (free lists, refcounts, hash
+index, LRU, event deltas); the chained murmur3 hashing already lives in
+native/murmur3.cpp. Set XLLM_NATIVE_BLOCKS=0 to force the Python store.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from xllm_service_tpu.common.hashing import prefix_block_hashes
+from xllm_service_tpu.common.types import KvCacheEvent
+from xllm_service_tpu.runtime.block_manager import BlockManager, OutOfBlocksError
+
+_NATIVE_DIR = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "native")
+)
+_SRC = os.path.join(_NATIVE_DIR, "block_store.cpp")
+_LIB = os.path.join(_NATIVE_DIR, "libxllm_blockstore.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_lib_failed = False
+_lib_error = ""
+
+_TIERS = ("dram", "ssd")
+
+logger = __import__("logging").getLogger(__name__)
+
+
+def _check_hash(block_hash: bytes) -> bytes:
+    """The C side reads exactly 16 bytes — network-origin hashes (PD
+    handoffs) MUST be length-checked before they cross the ABI."""
+    if not isinstance(block_hash, bytes) or len(block_hash) != 16:
+        raise ValueError(
+            f"block hash must be 16 bytes, got "
+            f"{len(block_hash) if isinstance(block_hash, bytes) else type(block_hash)}"
+        )
+    return block_hash
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_LIB) or os.path.getmtime(
+                _SRC
+            ) > os.path.getmtime(_LIB):
+                tmp = f"{_LIB}.{os.getpid()}.tmp"
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                     "-o", tmp, _SRC],
+                    check=True,
+                    capture_output=True,
+                )
+                os.replace(tmp, _LIB)
+            lib = ctypes.CDLL(_LIB)
+            P, I, C = ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p
+            IP = ctypes.POINTER(ctypes.c_int32)
+            lib.xbs_new.restype = P
+            lib.xbs_new.argtypes = [I, I]
+            lib.xbs_free_store.argtypes = [P]
+            lib.xbs_num_free.argtypes = [P]
+            lib.xbs_num_free.restype = I
+            lib.xbs_allocate.argtypes = [P, I, IP, IP, C, ctypes.POINTER(I)]
+            lib.xbs_allocate.restype = I
+            lib.xbs_acquire.argtypes = [P, I]
+            lib.xbs_release.argtypes = [P, IP, I]
+            lib.xbs_release.restype = I
+            lib.xbs_commit.argtypes = [P, I, C]
+            lib.xbs_commit.restype = I
+            lib.xbs_lookup.argtypes = [P, C]
+            lib.xbs_lookup.restype = I
+            lib.xbs_match_prefix.argtypes = [P, C, I, IP]
+            lib.xbs_match_prefix.restype = I
+            lib.xbs_record_removed_unless_hot.argtypes = [P, C]
+            lib.xbs_record_offload.argtypes = [P, C, I]
+            lib.xbs_record_evicted.argtypes = [P, C, I]
+            lib.xbs_event_counts.argtypes = [P] + [ctypes.POINTER(I)] * 3
+            lib.xbs_take_events.argtypes = [
+                P, C, I, ctypes.POINTER(I),
+                C, I, ctypes.POINTER(I),
+                C, IP, I, ctypes.POINTER(I),
+            ]
+            lib.xbs_take_events.restype = I
+            _lib = lib
+        except Exception as e:
+            global _lib_error
+            _lib_failed = True
+            detail = ""
+            if isinstance(e, subprocess.CalledProcessError):
+                detail = (e.stderr or b"").decode(errors="replace")[-2000:]
+            _lib_error = f"{e!r} {detail}".strip()
+            logger.warning(
+                "native block store unavailable, falling back to the "
+                "Python store: %s", _lib_error,
+            )
+    return _lib
+
+
+class NativeBlockManager:
+    """Drop-in replacement for BlockManager backed by the C++ store."""
+
+    def __init__(self, num_blocks: int, block_size: int, seed: int = 1024):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is reserved)")
+        lib = _load()
+        assert lib is not None, "native block store unavailable"
+        self._lib = lib
+        self._store = lib.xbs_new(num_blocks, block_size)
+        assert self._store, "xbs_new failed"
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.seed = seed
+        self.on_evict: Optional[
+            Callable[[List[Tuple[int, bytes]]], Sequence[bytes]]
+        ] = None
+
+    def __del__(self):
+        store, self._store = getattr(self, "_store", None), None
+        if store:
+            self._lib.xbs_free_store(store)
+
+    # ------------------------------------------------------------------ util
+
+    @property
+    def num_free_blocks(self) -> int:
+        return self._lib.xbs_num_free(self._store)
+
+    @property
+    def usage(self) -> float:
+        total = self.num_blocks - 1
+        return (total - self.num_free_blocks) / max(total, 1)
+
+    def can_allocate(self, n: int) -> bool:
+        return self.num_free_blocks >= n
+
+    # ------------------------------------------------------------- allocate
+
+    def allocate(self, n: int) -> List[int]:
+        out = (ctypes.c_int32 * max(n, 1))()
+        ev_ids = (ctypes.c_int32 * max(n, 1))()
+        ev_hashes = ctypes.create_string_buffer(16 * max(n, 1))
+        n_ev = ctypes.c_int(0)
+        rc = self._lib.xbs_allocate(
+            self._store, n, out, ev_ids, ev_hashes, ctypes.byref(n_ev)
+        )
+        if rc != 0:
+            raise OutOfBlocksError(
+                f"need {n} blocks, only {self.num_free_blocks} free"
+            )
+        if n_ev.value:
+            hashed = [
+                (int(ev_ids[i]), ev_hashes.raw[i * 16:(i + 1) * 16])
+                for i in range(n_ev.value)
+            ]
+            saved: Sequence[bytes] = ()
+            if self.on_evict is not None:
+                try:
+                    saved = set(self.on_evict(hashed))
+                except Exception:
+                    saved = ()
+            for _, h in hashed:
+                self._lib.xbs_record_evicted(
+                    self._store, h, 0 if h in saved else -1
+                )
+        return [int(out[i]) for i in range(n)]
+
+    def acquire_cached(self, block_id: int) -> None:
+        self._lib.xbs_acquire(self._store, block_id)
+
+    def free(self, block_ids: Sequence[int]) -> None:
+        n = len(block_ids)
+        if not n:
+            return
+        arr = (ctypes.c_int32 * n)(*block_ids)
+        rc = self._lib.xbs_release(self._store, arr, n)
+        if rc != 0:
+            # The C side released every valid id (no leaked tail); fail
+            # loudly for the invalid one like BlockManager's assert.
+            raise RuntimeError(f"double/invalid free in {list(block_ids)}")
+
+    # --------------------------------------------------------- prefix cache
+
+    def commit_block(self, block_id: int, block_hash: bytes) -> None:
+        self._lib.xbs_commit(self._store, block_id, _check_hash(block_hash))
+
+    def lookup_hash(self, block_hash: bytes) -> Optional[int]:
+        if not isinstance(block_hash, bytes) or len(block_hash) != 16:
+            return None  # malformed (network-origin) hash: a clean miss
+        got = self._lib.xbs_lookup(self._store, block_hash)
+        return None if got < 0 else int(got)
+
+    def match_prefix(
+        self,
+        token_ids: Sequence[int],
+        hashes: Optional[List[bytes]] = None,
+    ) -> Tuple[int, List[int]]:
+        if hashes is None:
+            hashes = prefix_block_hashes(token_ids, self.block_size, self.seed)
+        if not hashes:
+            return 0, []
+        for h in hashes:
+            _check_hash(h)
+        blob = b"".join(hashes)
+        out = (ctypes.c_int32 * len(hashes))()
+        n = self._lib.xbs_match_prefix(self._store, blob, len(hashes), out)
+        return n * self.block_size, [int(out[i]) for i in range(n)]
+
+    # ------------------------------------------------------------ heartbeat
+
+    def record_host_removed(self, block_hash: bytes) -> None:
+        self._lib.xbs_record_removed_unless_hot(
+            self._store, _check_hash(block_hash)
+        )
+
+    def record_tier_offload(self, block_hash: bytes, tier: str) -> None:
+        self._lib.xbs_record_offload(
+            self._store, _check_hash(block_hash), _TIERS.index(tier)
+        )
+
+    def take_cache_event(self) -> KvCacheEvent:
+        n_s, n_r, n_o = ctypes.c_int(), ctypes.c_int(), ctypes.c_int()
+        while True:
+            self._lib.xbs_event_counts(
+                self._store, ctypes.byref(n_s), ctypes.byref(n_r),
+                ctypes.byref(n_o),
+            )
+            cap_s, cap_r, cap_o = (
+                max(n_s.value, 1) + 64,
+                max(n_r.value, 1) + 64,
+                max(n_o.value, 1) + 64,
+            )
+            sb = ctypes.create_string_buffer(16 * cap_s)
+            rb = ctypes.create_string_buffer(16 * cap_r)
+            ob = ctypes.create_string_buffer(16 * cap_o)
+            tiers = (ctypes.c_int32 * cap_o)()
+            rc = self._lib.xbs_take_events(
+                self._store,
+                sb, cap_s, ctypes.byref(n_s),
+                rb, cap_r, ctypes.byref(n_r),
+                ob, tiers, cap_o, ctypes.byref(n_o),
+            )
+            if rc == 0:
+                break
+        return KvCacheEvent(
+            stored_cache={
+                sb.raw[i * 16:(i + 1) * 16] for i in range(n_s.value)
+            },
+            removed_cache={
+                rb.raw[i * 16:(i + 1) * 16] for i in range(n_r.value)
+            },
+            offload_cache={
+                ob.raw[i * 16:(i + 1) * 16]: _TIERS[tiers[i]]
+                for i in range(n_o.value)
+            },
+        )
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def create_block_manager(num_blocks: int, block_size: int, seed: int = 1024):
+    """Factory: the C++ store when buildable (default), else the Python
+    one. XLLM_NATIVE_BLOCKS=0 forces Python; =1 requires native."""
+    pref = os.environ.get("XLLM_NATIVE_BLOCKS", "")
+    if pref == "0":
+        return BlockManager(num_blocks, block_size, seed=seed)
+    if native_available():
+        return NativeBlockManager(num_blocks, block_size, seed=seed)
+    if pref == "1":
+        raise RuntimeError(
+            f"XLLM_NATIVE_BLOCKS=1 but the native store failed to build: "
+            f"{_lib_error}"
+        )
+    return BlockManager(num_blocks, block_size, seed=seed)
