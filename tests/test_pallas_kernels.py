@@ -72,3 +72,33 @@ def test_decode_kernel_bf16():
         np.asarray(out, np.float32), np.asarray(ref, np.float32),
         atol=3e-2, rtol=3e-2,
     )
+
+
+@pytest.mark.parametrize("start_pos,true_len", [(0, 24), (16, 13), (0, 1)])
+def test_blockwise_prefill_matches_gather(start_pos, true_len):
+    """Flash-style blockwise prefill (the serving path) == dense gather
+    oracle, incl. prefix-cache offsets and padded tails."""
+    from xllm_service_tpu.ops.attention import (
+        prefill_attention_blockwise,
+        prefill_attention_gather,
+    )
+
+    rng = np.random.default_rng(4)
+    L, Hq, Hkv, D, BS, NB, CB = 24, 4, 2, 16, 8, 12, 6
+    q = jnp.asarray(rng.standard_normal((L, Hq, D)), jnp.float32)
+    k_cache = jnp.asarray(rng.standard_normal((NB, Hkv, BS, D)), jnp.float32)
+    v_cache = jnp.asarray(rng.standard_normal((NB, Hkv, BS, D)), jnp.float32)
+    table = jnp.asarray(rng.permutation(NB)[:CB], jnp.int32)
+    scale = D**-0.5
+    want = prefill_attention_gather(
+        q, k_cache, v_cache, table, jnp.int32(start_pos),
+        jnp.int32(true_len), scale,
+    )
+    got = prefill_attention_blockwise(
+        q, k_cache, v_cache, table, jnp.int32(start_pos),
+        jnp.int32(true_len), scale,
+    )
+    valid = np.arange(L) < true_len
+    np.testing.assert_allclose(
+        np.asarray(got)[valid], np.asarray(want)[valid], atol=2e-5, rtol=2e-5
+    )

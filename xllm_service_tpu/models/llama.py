@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from xllm_service_tpu.models.configs import ModelConfig
 from xllm_service_tpu.ops.attention import (
     paged_attention,
-    prefill_attention_gather,
+    prefill_attention_blockwise,
 )
 from xllm_service_tpu.ops.norms import rms_norm
 from xllm_service_tpu.ops.rope import apply_rope
@@ -260,7 +260,7 @@ def prefill_batch_step(
             v.reshape(P * Lpad, *v.shape[2:]),
         )
         attn = jax.vmap(
-            lambda qi, ti, sp, tl: prefill_attention_gather(
+            lambda qi, ti, sp, tl: prefill_attention_blockwise(
                 qi, k_l, v_l, ti, sp, tl, scale
             )
         )(q, block_tables, start_pos, true_len)  # [P, Lpad, Hq, D]

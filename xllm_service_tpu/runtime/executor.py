@@ -438,13 +438,24 @@ class ModelExecutor:
             jnp.asarray(batch.seeds, jnp.uint32),
             jnp.asarray(batch.steps, jnp.int32),
         )
+        # Slice the block table to the batch's true context bound (pow2
+        # bucket: <= log2(max_blocks) compiles). The gather fallback
+        # otherwise materializes [R, max_blocks*BS] context per layer even
+        # when every sequence is short.
+        need = 1
+        if active.any():
+            need = int(
+                (np.asarray(positions)[np.asarray(active)].max() // self.block_size)
+                + 1
+            )
+        CB = self._pow2_bucket(need, self.max_blocks_per_seq)
         self.k_cache, self.v_cache, tokens, logprobs = self._decode_jit(
             self.k_cache,
             self.v_cache,
             self.params,
             jnp.asarray(token_ids, jnp.int32),
             jnp.asarray(positions, jnp.int32),
-            jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(block_tables[:, :CB], jnp.int32),
             jnp.asarray(active),
             jnp.asarray(batch.temperature, jnp.float32),
             jnp.asarray(batch.top_k, jnp.int32),
