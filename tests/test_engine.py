@@ -203,3 +203,50 @@ def test_engine_thread_loop():
         assert len(c.tokens) == 5
     finally:
         eng.stop()
+
+
+def test_warmup_compiles_before_start():
+    """warmup_on_start pre-compiles every prefill bucket + the decode step
+    against the garbage block; serving afterwards is unchanged."""
+    import threading
+
+    from xllm_service_tpu.common.config import EngineConfig
+    from xllm_service_tpu.ops.sampling import SamplingParams
+    from xllm_service_tpu.runtime.engine import EngineRequest, InferenceEngine
+    from xllm_service_tpu.runtime.executor import ModelExecutor
+
+    cfg = EngineConfig(
+        model="llama3-tiny", num_blocks=32, block_size=16,
+        max_running_requests=4, max_seq_len=128, prefill_buckets=[32, 64],
+        warmup_on_start=True,
+    )
+    exe = ModelExecutor(cfg, init_seed=2)
+    groups = []
+    orig = exe._prefill_group
+    exe._prefill_group = lambda g: groups.append(len(g)) or orig(g)
+    eng = InferenceEngine(cfg, executor=exe)
+    eng.start()  # warmup runs here
+    try:
+        assert len(groups) == len(exe.prefill_buckets)  # one per bucket
+        ev = threading.Event()
+        toks = []
+
+        def cb(out):
+            for s in out.outputs:
+                toks.extend(s.token_ids)
+            if out.finished:
+                ev.set()
+            return True
+
+        eng.add_request(
+            EngineRequest(
+                request_id="w0",
+                prompt_token_ids=[(i * 5 + 1) % 512 for i in range(20)],
+                sampling=SamplingParams(temperature=0.0, max_new_tokens=4),
+                callback=cb,
+            )
+        )
+        assert ev.wait(120.0)
+        assert len(toks) == 4
+    finally:
+        eng.stop()

@@ -16,12 +16,17 @@ from tests.test_api_e2e import http_post, sse_post, wait_until
 BLOCK = 16
 
 
-def engine_cfg(name, itype):
+def engine_cfg(name, itype, **kw):
+    # These stacks run in ONE process: default to the HTTP data plane so
+    # the wire path stays covered (the direct in-process path has its own
+    # test below).
+    kw.setdefault("enable_local_kv_transfer", False)
     return EngineConfig(
         model="llama3-tiny", dtype="float32", block_size=BLOCK,
         num_blocks=64, max_running_requests=4, max_seq_len=256,
         prefill_buckets=[32, 64, 128],
         instance_name=name, instance_type=itype,
+        **kw,
     )
 
 
@@ -193,6 +198,71 @@ def test_relay_topology_streaming(relay_stack):
     assert len(texts) == 6
     # relay bookkeeping fully reaped after finish
     assert wait_until(lambda: not decode._relay_addrs)
+
+
+@pytest.fixture(scope="module")
+def local_transfer_stack():
+    """PD pair in one process with the DIRECT (no-serialization) KV
+    handoff path enabled — the single-host analog of ICI transfer."""
+    store = MemoryStore()
+    cfg = ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0,
+        load_balance_policy="RR", block_size=BLOCK,
+    )
+    master = Master(cfg, store=store)
+    master.start()
+    prefill = InstanceServer(
+        engine_cfg("pre-local", "PREFILL", enable_local_kv_transfer=True),
+        master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.2,
+    )
+    decode = InstanceServer(
+        engine_cfg("dec-local", "DECODE", enable_local_kv_transfer=True),
+        master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.2,
+    )
+    prefill.start()
+    decode.start()
+    assert wait_until(
+        lambda: master.scheduler.instance_mgr.counts() == (1, 1, 0)
+    )
+    yield master, prefill, decode, store
+    prefill.stop()
+    decode.stop()
+    master.stop()
+    store.close()
+
+
+def test_local_transfer_matches_colocated(local_transfer_stack, colocated):
+    master, prefill, decode, _ = local_transfer_stack
+    direct_calls = []
+    orig = decode._admit_import
+
+    def spy(handoff, header):
+        direct_calls.append(header.get("service_request_id"))
+        return orig(handoff, header)
+
+    decode._admit_import = spy
+    http_posts = []
+    import xllm_service_tpu.api.instance as inst_mod
+
+    orig_post = inst_mod.post_bytes
+
+    def post_spy(addr, path, payload):
+        if path == "/kv/import":
+            http_posts.append(addr)
+        return orig_post(addr, path, payload)
+
+    inst_mod.post_bytes = post_spy
+    try:
+        prompt = "q" * (BLOCK * 3 + 5)
+        got = completion(master, prompt)
+        want = completion(colocated, prompt)
+        assert got["choices"][0]["text"] == want["choices"][0]["text"]
+        assert direct_calls, "direct in-process handoff never used"
+        assert not http_posts, "HTTP data plane used despite local peer"
+    finally:
+        decode._admit_import = orig
+        inst_mod.post_bytes = orig_post
 
 
 def test_decode_side_has_imported_blocks(pd_stack):

@@ -80,6 +80,13 @@ def sampling_from_body(body: Dict[str, Any], cfg: EngineConfig) -> SamplingParam
     )
 
 
+# Process-local instance registry: colocated PD peers hand KV off through
+# direct calls (device arrays stay device-resident — the single-host analog
+# of the ICI device_put path) instead of numpy-over-HTTP serialization.
+_LOCAL_INSTANCES: Dict[str, "InstanceServer"] = {}
+_LOCAL_MU = threading.Lock()
+
+
 class InstanceServer:
     def __init__(
         self,
@@ -203,6 +210,8 @@ class InstanceServer:
 
     # ------------------------------------------------------------------ #
     def start(self) -> None:
+        with _LOCAL_MU:
+            _LOCAL_INSTANCES[self.name] = self
         self.engine.start()
         self.http.start()
         self._push_thread.start()
@@ -213,6 +222,9 @@ class InstanceServer:
         logger.info("instance %s serving on :%d", self.name, self.http.port)
 
     def stop(self) -> None:
+        with _LOCAL_MU:
+            if _LOCAL_INSTANCES.get(self.name) is self:
+                del _LOCAL_INSTANCES[self.name]
         if self._heartbeat is not None:
             self._heartbeat.stop()
         self._push_q.put(None)
@@ -512,32 +524,54 @@ class InstanceServer:
                 err = "first-token push never acked by master"
             with self._push_acked_mu:
                 self._push_acked.pop(srid, None)
-            addr = self._resolve_instance_addr(decode_name) if not err else ""
-            if not err and not addr:
-                err = f"decode instance {decode_name} unknown"
             if not err:
-                try:
-                    extra = {
-                        "service_request_id": srid,
-                        "sampling": sampling_fields,
-                    }
-                    if respond_via_self:
-                        # Alternate topology: decode relays its generations
-                        # back through this (prefill) instance.
-                        extra["respond_addr"] = self.address
-                    # Detokenizer carry-over: the decode peer continues from
-                    # this side's exact byte/char position.
-                    d0 = (detoks or {}).get(0)
-                    if d0 is not None:
-                        ids, emitted = d0.export_state()
-                        extra["detok_ids"] = ids
-                        extra["detok_emitted"] = emitted
-                    payload = handoff_to_bytes(handoff, extra)
-                    code, resp = post_bytes(addr, "/kv/import", payload)
-                    if code != 200:
-                        err = f"decode peer rejected handoff: {resp}"
-                except Exception as e:
-                    err = f"decode peer unreachable: {e}"
+                extra = {
+                    "service_request_id": srid,
+                    "sampling": sampling_fields,
+                }
+                if respond_via_self:
+                    # Alternate topology: decode relays its generations
+                    # back through this (prefill) instance.
+                    extra["respond_addr"] = self.address
+                # Detokenizer carry-over: the decode peer continues from
+                # this side's exact byte/char position.
+                d0 = (detoks or {}).get(0)
+                if d0 is not None:
+                    ids, emitted = d0.export_state()
+                    extra["detok_ids"] = ids
+                    extra["detok_emitted"] = emitted
+                peer = None
+                if self.cfg.enable_local_kv_transfer:
+                    with _LOCAL_MU:
+                        peer = _LOCAL_INSTANCES.get(decode_name)
+                    if peer is not None and (
+                        # BOTH sides must opt in, and both must belong to
+                        # the same master (name collisions across stacks in
+                        # one process must not cross-deliver KV).
+                        not peer.cfg.enable_local_kv_transfer
+                        or getattr(peer._master, "_addr", None)
+                        != getattr(self._master, "_addr", "")
+                    ):
+                        peer = None
+                if peer is not None and peer is not self:
+                    # Colocated peer: direct in-process import, no
+                    # serialization (ICI-path analog).
+                    try:
+                        peer._admit_import(handoff, extra)
+                    except Exception as e:
+                        err = f"local decode peer import failed: {e}"
+                else:
+                    addr = self._resolve_instance_addr(decode_name)
+                    if not addr:
+                        err = f"decode instance {decode_name} unknown"
+                    else:
+                        try:
+                            payload = handoff_to_bytes(handoff, extra)
+                            code, resp = post_bytes(addr, "/kv/import", payload)
+                            if code != 200:
+                                err = f"decode peer rejected handoff: {resp}"
+                        except Exception as e:
+                            err = f"decode peer unreachable: {e}"
             if not err:
                 # Handoff complete: this instance is done with the request
                 # (the decode peer owns cancellation from here).
@@ -562,8 +596,6 @@ class InstanceServer:
         return send
 
     def _handle_kv_import(self, h: QuietHandler) -> None:
-        from xllm_service_tpu.runtime.engine import EngineRequest
-
         try:
             n = int(h.headers.get("Content-Length", 0))
             data = h.rfile.read(n)
@@ -571,6 +603,16 @@ class InstanceServer:
         except Exception as e:
             h.send_error_json(400, f"bad handoff payload: {e}")
             return
+        rid = self._admit_import(handoff, header)
+        h.send_json({"ok": True, "request_id": rid})
+
+    def _admit_import(self, handoff, header: Dict[str, Any]) -> str:
+        """Decode-side admission of a handed-off sequence — shared by the
+        HTTP /kv/import route and the in-process direct path (colocated
+        peers skip serialization entirely; the single-host analog of the
+        ICI device-to-device KV transfer)."""
+        from xllm_service_tpu.runtime.engine import EngineRequest
+
         srid = header.get("service_request_id", "")
         sampling = sampling_from_body(header.get("sampling", {}), self.cfg)
         rid = generate_uuid(16)
@@ -594,7 +636,7 @@ class InstanceServer:
             ),
             handoff,
         )
-        h.send_json({"ok": True, "request_id": rid})
+        return rid
 
     # ------------------------------------------------------------------ #
     # EPD multimodal (encoder stage + embedding import)
@@ -953,7 +995,7 @@ class InstanceServer:
                 # EPD: the encoder stage pushed this request's media
                 # embeddings to /mm/import (usually already landed — the
                 # master dispatches the encoder first).
-                mm = self._pop_mm_import(srid, timeout=30.0)
+                mm = self._pop_mm_import(srid, timeout=60.0)
                 if mm is None:
                     h.send_error_json(503, "media embeddings never arrived")
                     return

@@ -394,7 +394,9 @@ class Master:
                             "positions": req.mm_positions,
                             "target": meta.http_address,
                         },
-                        timeout=60.0,
+                        # Generous: the encoder's FIRST request pays its
+                        # XLA compile inside this call.
+                        timeout=180.0,
                     )
                 except Exception as e:
                     code, resp = 0, str(e)

@@ -109,6 +109,10 @@ class EngineConfig:
     dp_size: int = 1
     tp_size: int = 1
     ep_size: int = 1  # MoE expert parallelism (experts over an ep axis)
+    sp_size: int = 1  # sequence/context parallelism (ring-attention prefill)
+    # Prompts with at least this many uncached tokens prefill via the
+    # sequence-parallel ring path (0 = never). Requires sp_size > 1.
+    sp_prefill_threshold: int = 0
 
     # Sampling defaults.
     max_new_tokens_default: int = 512
@@ -118,6 +122,16 @@ class EngineConfig:
     # SSD tier: blocks spilled from the host pool to local disk; 0 disables.
     num_ssd_blocks: int = 0
     ssd_cache_dir: str = ""  # empty = <tempdir>/xllm-ssd-cache-<pid>
+
+    # PD KV handoff to a decode peer in the SAME process goes through a
+    # direct call (no serialization — single-host ICI-path analog) when
+    # enabled; disable to force the HTTP data plane.
+    enable_local_kv_transfer: bool = True
+
+    # Compile the serving step functions (per-bucket prefill + decode)
+    # BEFORE the instance registers, so the first real request never pays
+    # a compile in its TTFT.
+    warmup_on_start: bool = False
 
     # Instance identity/role.
     instance_name: str = ""

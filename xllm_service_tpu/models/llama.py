@@ -302,6 +302,53 @@ def prefill_step(
     return logits[0], k_caches, v_caches
 
 
+def prefill_sp_step(
+    params: Params,
+    cfg: ModelConfig,
+    token_ids: jnp.ndarray,  # [Lsp] int32 — padded to a multiple of sp
+    true_len: jnp.ndarray,  # scalar int32
+    mesh,
+    sp_axis: str = "sp",
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sequence-parallel long-context prefill: the prompt's sequence axis is
+    sharded over the `sp` mesh ring and every layer's attention is EXACT
+    ring attention (ops/ring_attention.py — K/V shards rotate via ppermute,
+    queries stay resident), so max prompt length scales linearly with the
+    ring size instead of one device's HBM.
+
+    Returns (last-token logits [V], k_all [layers, Lsp, Hkv, D],
+    v_all [...]) — the caller scatters K/V into the paged cache
+    (runtime/executor.py prefill_long) and decode proceeds normally.
+    """
+    from xllm_service_tpu.ops.ring_attention import ring_attention
+
+    Lsp = token_ids.shape[0]
+    positions = jnp.arange(Lsp, dtype=jnp.int32)
+    x = params["embed"][token_ids].astype(params["layers"]["wq"].dtype)
+    x = x[None]  # [1, Lsp, E] — ring_attention is batched
+
+    def layer_fn(x, lp):
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(lp, cfg, h[0], positions)
+        attn = ring_attention(
+            q[None], k[None], v[None], mesh, sp_axis=sp_axis,
+            scale=cfg.head_dim**-0.5, causal=True,
+        )
+        x = x + jnp.einsum(
+            "blh,he->ble",
+            attn.reshape(1, Lsp, -1),
+            lp["wo"].reshape(-1, cfg.hidden_size),
+        )
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(lp, cfg, h[0])[None]
+        return x, (k, v)
+
+    x, (k_all, v_all) = jax.lax.scan(layer_fn, x, params["layers"])
+    last = x[0, jnp.maximum(true_len - 1, 0)]
+    logits = _unembed(params, cfg, last)
+    return logits, k_all, v_all
+
+
 def forward_dense(
     params: Params,
     cfg: ModelConfig,

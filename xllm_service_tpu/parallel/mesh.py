@@ -25,23 +25,26 @@ def build_mesh(
     dp: int = 1,
     tp: int = 1,
     ep: int = 1,
+    sp: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
+    """Axes appear only when sized > 1 (existing shardings reference axes
+    by name and tolerate absence); tp stays innermost: per-layer TP psums
+    (the most latency-sensitive collectives) ride CONTIGUOUS ICI
+    neighbors, while sp's ring ppermute and ep's per-MLP psum tolerate the
+    larger stride."""
     if devices is None:
         devices = jax.devices()
-    need = dp * tp * ep
+    need = dp * tp * ep * sp
     if need > len(devices):
         raise ValueError(
-            f"mesh dp*tp*ep={need} exceeds {len(devices)} devices"
+            f"mesh dp*tp*ep*sp={need} exceeds {len(devices)} devices"
         )
-    if ep > 1:
-        # tp innermost: per-layer TP psums (the most latency-sensitive
-        # collectives) run over CONTIGUOUS ICI neighbors; ep collectives
-        # are once-per-MLP and tolerate the larger stride.
-        arr = np.asarray(devices[:need]).reshape(dp, ep, tp)
-        return Mesh(arr, ("dp", "ep", "tp"))
-    arr = np.asarray(devices[:need]).reshape(dp, tp)
-    return Mesh(arr, ("dp", "tp"))
+    sizes = [("dp", dp), ("sp", sp), ("ep", ep), ("tp", tp)]
+    names = tuple(n for n, s in sizes if s > 1 or n in ("dp", "tp"))
+    dims = tuple(s for n, s in sizes if s > 1 or n in ("dp", "tp"))
+    arr = np.asarray(devices[:need]).reshape(dims)
+    return Mesh(arr, names)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -199,6 +199,8 @@ class InferenceEngine:
         return bool(self._waiting or self._running or self._pending_imports)
 
     def start(self) -> None:
+        if self.cfg.warmup_on_start and hasattr(self.executor, "warmup"):
+            self.executor.warmup()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -397,6 +399,22 @@ class InferenceEngine:
     def _prefill_admitted(self, batch: List[_Seq]) -> int:
         from xllm_service_tpu.runtime.executor import PrefillItem
 
+        # Long-context path: prompts past the SP threshold prefill over the
+        # mesh's sequence-parallel ring (ring attention) one at a time;
+        # they skip prefix reuse (ring attends from position 0) and media
+        # requests stay on the batched path (embedding injection).
+        sp_thresh = self.cfg.sp_prefill_threshold
+        if sp_thresh > 0 and getattr(self.executor, "supports_sp", False):
+            sp_batch = [
+                s
+                for s in batch
+                if not s.req.has_media
+                and len(s.tokens) - s.num_cached >= sp_thresh
+            ]
+            if sp_batch:
+                batch = [s for s in batch if s not in sp_batch]
+                done = self._prefill_sp(sp_batch)
+                return done + (self._prefill_admitted(batch) if batch else 0)
         items = []
         for seq in batch:
             table = np.zeros((self.max_blocks,), np.int32)
@@ -437,6 +455,57 @@ class InferenceEngine:
             self._profile_ttft.append(
                 (len(seq.tokens) - seq.num_cached, batch_ms)
             )
+            seq.prefill_done_time = seq.last_token_time = now
+            self._commit_full_blocks(seq)
+            seq.generated.append((tok, lp))
+            seq.tokens.append(tok)
+            self._running[seq.slot] = seq
+            alive = self._emit(seq, finished=self._check_stop(seq))
+            if alive and seq.req.prefill_only:
+                self._handoff(seq)
+            admitted += 1
+        return admitted
+
+    def _prefill_sp(self, batch: List[_Seq]) -> int:
+        """Ring-attention prefill for long prompts (one jitted call per
+        sequence; the sp mesh ring IS the batch dimension here). The ring
+        attends from position 0, so a prefix-cache match is traded for
+        FRESH blocks — overwriting shared cached blocks with a recompute
+        would mutate other sequences' context mid-flight."""
+        admitted = 0
+        for seq in batch:
+            if seq.num_cached:
+                self.block_mgr.free(seq.block_ids)
+                need_total = math.ceil(
+                    (len(seq.tokens) + 1) / self.block_size
+                )
+                try:
+                    seq.block_ids = self.block_mgr.allocate(need_total)
+                except OutOfBlocksError:
+                    seq.block_ids = []
+                    self._free_slots.append(seq.slot)
+                    with self._lock:
+                        self._waiting.appendleft(seq)
+                    continue
+                seq.num_cached = 0
+                seq.last_committed_block = -1
+            table = np.zeros((self.max_blocks,), np.int32)
+            table[: len(seq.block_ids)] = seq.block_ids
+            s = seq.req.sampling
+            t0 = time.monotonic()
+            tok, lp = self.executor.prefill_long(
+                np.asarray(seq.tokens, np.int32),
+                table,
+                temperature=s.temperature,
+                top_k=s.top_k,
+                top_p=s.top_p,
+                seed=s.seed,
+                step=len(seq.generated),
+            )
+            now = time.monotonic()
+            ms = (now - t0) * 1000
+            self._ttft_window.append((now, ms))
+            self._profile_ttft.append((len(seq.tokens), ms))
             seq.prefill_done_time = seq.last_token_time = now
             self._commit_full_blocks(seq)
             seq.generated.append((tok, lp))

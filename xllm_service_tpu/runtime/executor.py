@@ -94,7 +94,8 @@ class ModelExecutor:
         else:
             self.cfg = get_model_config(engine_cfg.model)
         self.mesh = mesh or build_mesh(
-            engine_cfg.dp_size, engine_cfg.tp_size, engine_cfg.ep_size
+            engine_cfg.dp_size, engine_cfg.tp_size, engine_cfg.ep_size,
+            engine_cfg.sp_size,
         )
         tp = self.mesh.shape.get("tp", 1)
         ep = self.mesh.shape.get("ep", 1)
@@ -398,6 +399,131 @@ class ModelExecutor:
         toks = np.asarray(toks)
         lps = np.asarray(lps)
         return [(int(toks[i]), float(lps[i])) for i in range(n_real)]
+
+    def warmup(self) -> None:
+        """Compile the common serving shapes (P=1 prefill per length
+        bucket + one decode step) against the garbage block, so the first
+        real request's TTFT carries no compile (SURVEY §7 hard part 3 —
+        shape-bucketed continuous batching without runtime recompiles)."""
+        table = np.zeros((self.max_blocks_per_seq,), np.int32)
+        for b in self.prefill_buckets:
+            n = min(b, self.engine_cfg.max_seq_len - 1)
+            self.prefill_batch(
+                [
+                    PrefillItem(
+                        token_ids=np.zeros((n,), np.int32),
+                        start_pos=0,
+                        block_table=table,
+                    )
+                ]
+            )
+        R = self.R
+        active = np.zeros((R,), bool)
+        active[0] = True
+        batch = SamplingBatch(
+            temperature=np.zeros(R, np.float32),
+            top_k=np.zeros(R, np.int32),
+            top_p=np.ones(R, np.float32),
+            seeds=np.zeros(R, np.uint32),
+            steps=np.zeros(R, np.int32),
+        )
+        # Every pow2 context-width bucket decode can hit (decode() slices
+        # the table to the batch's true block bound, one compile per
+        # bucket) — positions drive the bucket; writes land in block 0.
+        CB = 1
+        while True:
+            positions = np.zeros((R,), np.int32)
+            positions[0] = CB * self.block_size - 1
+            self.decode(
+                np.zeros((R,), np.int32),
+                positions,
+                np.zeros((R, self.max_blocks_per_seq), np.int32),
+                active,
+                batch,
+            )
+            if CB >= self.max_blocks_per_seq:
+                break
+            CB = min(CB * 2, self.max_blocks_per_seq)
+
+    # ------------------------------------------------ SP (ring) prefill
+
+    @property
+    def supports_sp(self) -> bool:
+        return self.mesh.shape.get("sp", 1) > 1
+
+    def _sp_impl(self, k_cache, v_cache, params, token_ids, true_len,
+                 blk, off, temperature, top_k, top_p, step_key):
+        from xllm_service_tpu.models.llama import prefill_sp_step
+
+        logits, k_all, v_all = prefill_sp_step(
+            params, self.cfg, token_ids, true_len, self.mesh
+        )
+        # Scatter every token's per-layer K/V into the paged cache
+        # (invalid/padded rows land in garbage block 0). Advanced indices
+        # separated by slices put the token axis FIRST in the update shape:
+        # [Lsp, layers, Hkv, D].
+        k_cache = k_cache.at[:, blk, :, off, :].set(
+            jnp.swapaxes(k_all.astype(self.dtype), 0, 1)
+        )
+        v_cache = v_cache.at[:, blk, :, off, :].set(
+            jnp.swapaxes(v_all.astype(self.dtype), 0, 1)
+        )
+        tokens, logprob, _ = sampling_ops.sample_tokens(
+            logits[None], temperature[None], top_k[None], top_p[None],
+            step_key[None],
+        )
+        return k_cache, v_cache, tokens[0], logprob[0]
+
+    def prefill_long(
+        self,
+        token_ids: np.ndarray,  # [n] int32 — FULL prompt (no prefix reuse)
+        block_table: np.ndarray,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+        step: int = 0,
+    ) -> Tuple[int, float]:
+        """Sequence-parallel prefill over the mesh's sp ring (long-context
+        path). The prompt attends from position 0 (prefix-cache reuse is
+        skipped for this path); K/V land in the paged cache and decode
+        proceeds exactly as for a normal prefill."""
+        assert self.supports_sp, "mesh has no sp axis"
+        sp = self.mesh.shape["sp"]
+        n = len(token_ids)
+        pad = self.bucket_len(n)
+        if pad % sp:
+            pad += sp - pad % sp
+        padded = np.zeros((pad,), np.int32)
+        padded[:n] = token_ids
+        offsets = np.arange(pad, dtype=np.int32)
+        valid = offsets < n
+        # Clamp the table index BEFORE the lookup: sp-rounding can push pad
+        # past max_blocks * block_size, and numpy indexes eagerly inside
+        # np.where (clamped rows are invalid and masked to block 0 anyway).
+        idx = np.minimum(offsets // self.block_size, len(block_table) - 1)
+        blk = np.where(valid, block_table[idx], 0)
+        off = np.where(valid, offsets % self.block_size, 0)
+        key = sampling_ops.make_step_keys(
+            jnp.asarray([seed], jnp.uint32), jnp.int32(step)
+        )[0]
+        if not hasattr(self, "_sp_jit"):
+            self._sp_jit = jax.jit(self._sp_impl, donate_argnums=(0, 1))
+        with self.mesh:
+            self.k_cache, self.v_cache, tok, lp = self._sp_jit(
+                self.k_cache,
+                self.v_cache,
+                self.params,
+                jnp.asarray(padded),
+                jnp.int32(n),
+                jnp.asarray(blk, jnp.int32),
+                jnp.asarray(off, jnp.int32),
+                jnp.float32(temperature),
+                jnp.int32(top_k),
+                jnp.float32(top_p),
+                key,
+            )
+        return int(tok), float(lp)
 
     def prefill(
         self,
