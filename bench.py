@@ -149,11 +149,15 @@ def main() -> None:
         jnp.asarray(batch.temperature), jnp.asarray(batch.top_k),
         jnp.asarray(batch.top_p), jnp.asarray(batch.seeds),
     )
+    # Force a host fetch of the result, not just block_until_ready: through
+    # the axon dev tunnel block_until_ready can return before execution
+    # completes (observed: impossible >5 PFLOP/s "timings" on v5e), and only
+    # a device->host transfer reliably drains the queue.
     ex.k_cache, ex.v_cache, out = run(ex.k_cache, ex.v_cache, ex.params, *args)
-    jax.block_until_ready(out)  # warmup/compile
+    int(jnp.sum(out))  # warmup/compile + drain
     t0 = time.perf_counter()
     ex.k_cache, ex.v_cache, out = run(ex.k_cache, ex.v_cache, ex.params, *args)
-    jax.block_until_ready(out)
+    int(jnp.sum(out))
     dt = time.perf_counter() - t0
 
     tok_per_s = R * decode_steps / dt

@@ -1,0 +1,99 @@
+"""On-hardware validation + microbenchmark for the Pallas paged-attention
+decode kernel (ops/pallas/paged_attention.py) against the gather oracle.
+
+Run on a real TPU:  python scripts/validate_kernel_tpu.py
+
+Prints one line per shape: max-abs-err vs oracle, kernel vs gather time,
+and achieved HBM bandwidth (the op is bandwidth-bound: 2*R*ctx*Hkv*D*2 bytes
+of KV traffic dominates).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xllm_service_tpu.ops.attention import paged_attention_gather
+from xllm_service_tpu.ops.pallas.paged_attention import paged_attention_kernel
+
+
+def bench(fn, iters=20):
+    """Per-call execution time. block_until_ready is unreliable through the
+    axon tunnel (returns before execution); force a host fetch to drain the
+    queue and difference two iteration counts to cancel the fetch/dispatch
+    fixed cost."""
+    fn()  # compile
+
+    def timed(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn()
+        float(out.sum())
+        return time.perf_counter() - t0
+
+    short = timed(max(1, iters // 4))
+    full = timed(iters + max(1, iters // 4))
+    return (full - short) / iters
+
+
+def run_case(R, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16, chunk=4):
+    rng = np.random.default_rng(0)
+    N = R * MB + 1  # block 0 reserved garbage
+    q = jnp.asarray(rng.standard_normal((R, Hq, D)), dtype)
+    k = jnp.asarray(rng.standard_normal((N, Hkv, BS, D)), dtype)
+    v = jnp.asarray(rng.standard_normal((N, Hkv, BS, D)), dtype)
+    bt = jnp.asarray(
+        1 + np.arange(R * MB).reshape(R, MB) % (N - 1), jnp.int32
+    )
+    lens = jnp.asarray(
+        np.clip(rng.integers(ctx // 2, ctx + 1, R), 1, MB * BS), jnp.int32
+    )
+    scale = 1.0 / D**0.5
+
+    ker = lambda: paged_attention_kernel(q, k, v, bt, lens, scale, chunk=chunk)
+    gat = lambda: paged_attention_gather(q, k, v, bt, lens, scale)
+
+    out_k = np.asarray(ker().astype(jnp.float32))
+    out_g = np.asarray(gat().astype(jnp.float32))
+    err = float(np.max(np.abs(out_k - out_g)))
+
+    tk = bench(ker)
+    tg = bench(gat)
+    # KV bytes actually needed (true lens), bf16
+    kv_bytes = 2 * float(np.sum(np.asarray(lens))) * Hkv * D * dtype.dtype.itemsize
+    bw = kv_bytes / tk / 1e9
+    print(
+        f"R={R:3d} Hq={Hq} Hkv={Hkv} D={D} BS={BS} MB={MB} ctx~{ctx} "
+        f"err={err:.4f} kernel={tk*1e6:8.1f}us gather={tg*1e6:8.1f}us "
+        f"speedup={tg/tk:5.2f}x bw={bw:6.1f}GB/s"
+    )
+    return err
+
+
+def main():
+    print(f"backend={jax.default_backend()} device={jax.devices()[0]}")
+    assert jax.default_backend() == "tpu"
+    errs = []
+    # llama-8B-class: Hq=32 Hkv=8 D=128; llama-70B-class: Hq=64 Hkv=8 D=128
+    for case in [
+        dict(R=8, Hq=32, Hkv=8, D=128, BS=16, MB=64, ctx=1024),
+        dict(R=32, Hq=32, Hkv=8, D=128, BS=16, MB=64, ctx=1024),
+        dict(R=64, Hq=32, Hkv=8, D=128, BS=16, MB=128, ctx=2048),
+        dict(R=32, Hq=64, Hkv=8, D=128, BS=16, MB=64, ctx=1024),
+        dict(R=16, Hq=32, Hkv=8, D=128, BS=16, MB=256, ctx=4096),
+        # production block size (reference contract: 128 tokens/block)
+        dict(R=64, Hq=32, Hkv=8, D=128, BS=128, MB=16, ctx=2048),
+        # NOTE: D=64 is NOT included — Mosaic rejects the lane-padded HBM
+        # block slice below one 128-lane tile (tpu.memref_slice verify
+        # failure on-chip); ops/attention.py falls back to gather there.
+    ]:
+        errs.append(run_case(**case))
+    assert max(errs) < 0.05, f"parity FAIL: {errs}"
+    print("PARITY OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -227,7 +227,18 @@ def test_warmup_compiles_before_start():
     eng = InferenceEngine(cfg, executor=exe)
     eng.start()  # warmup runs here
     try:
-        assert len(groups) == len(exe.prefill_buckets)  # one per bucket
+        # Every bucket is warmed, including the prefix-hit CB variants up
+        # to the full context width (round-2 review: a first request with
+        # fewer context blocks than its length bucket must not compile).
+        assert len(groups) >= len(exe.prefill_buckets)
+        per_bucket_cbs: dict = {}
+        for lpad, cb in exe.warmup():  # idempotent: shapes already built
+            per_bucket_cbs.setdefault(lpad, set()).add(cb)
+        assert set(per_bucket_cbs) == set(exe.prefill_buckets)
+        assert all(
+            max(cbs) == exe.max_blocks_per_seq
+            for cbs in per_bucket_cbs.values()
+        )
         ev = threading.Event()
         toks = []
 

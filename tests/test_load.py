@@ -119,9 +119,11 @@ def test_128_concurrent_streams(load_cluster):
         "req_p99_s": round(lat[int(len(lat) * 0.99)], 3),
     }
     print("\nLOAD " + json.dumps(summary))
-    # Sanity ceiling — catches pathological serialization (fully
-    # serialized, the tail request would wait ~CONCURRENCY * 37 ms ≈ 4.7 s
-    # MINIMUM, typically far more). Generous enough to tolerate a loaded
-    # CI machine; correctness assertions above stay strict.
-    ideal = TOKENS_PER_REQ * 0.002
-    assert lat[int(len(lat) * 0.99)] < 200 * ideal, summary
+    # Sanity ceiling — catches pathological serialization. Fully
+    # serialized, the tail request waits ~CONCURRENCY * 37 ms ≈ 4.7 s
+    # MINIMUM (37 ms = 5 ms TTFT + 16 tok * 2 ms pacing), so the bound
+    # must sit BELOW that to have teeth; 60% of it is ~2x the measured
+    # p99 (1.39 s, BASELINE.md) — headroom for a loaded CI machine
+    # without letting full serialization pass.
+    serialized_min = CONCURRENCY * (0.005 + TOKENS_PER_REQ * 0.002)
+    assert lat[int(len(lat) * 0.99)] < 0.6 * serialized_min, summary

@@ -81,8 +81,10 @@ def sampling_from_body(body: Dict[str, Any], cfg: EngineConfig) -> SamplingParam
 
 
 # Process-local instance registry: colocated PD peers hand KV off through
-# direct calls (device arrays stay device-resident — the single-host analog
-# of the ICI device_put path) instead of numpy-over-HTTP serialization.
+# direct calls, skipping the bytes (de)serialization + HTTP hop of the DCN
+# path. The KV payload is already a host numpy copy by this point
+# (engine._handoff exports blocks device->host either way); keeping the
+# export device-resident end-to-end is the ICI device_put path, still open.
 _LOCAL_INSTANCES: Dict[str, "InstanceServer"] = {}
 _LOCAL_MU = threading.Lock()
 

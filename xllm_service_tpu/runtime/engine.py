@@ -405,11 +405,18 @@ class InferenceEngine:
         # requests stay on the batched path (embedding injection).
         sp_thresh = self.cfg.sp_prefill_threshold
         if sp_thresh > 0 and getattr(self.executor, "supports_sp", False):
+            # The ring recomputes from position 0 (no prefix reuse), so SP
+            # is only a win when the prompt is long AND mostly uncached:
+            # a heavily prefix-cached prompt would trade a short batched
+            # suffix prefill for a full-prompt recompute and give up its
+            # cache hit. Require the uncached suffix to dominate (>= 8x)
+            # the cached prefix.
             sp_batch = [
                 s
                 for s in batch
                 if not s.req.has_media
                 and len(s.tokens) - s.num_cached >= sp_thresh
+                and len(s.tokens) - s.num_cached >= 8 * s.num_cached
             ]
             if sp_batch:
                 batch = [s for s in batch if s not in sp_batch]
@@ -451,20 +458,35 @@ class InferenceEngine:
         batch_ms = (now - t0) * 1000
         admitted = 0
         for seq, (tok, lp) in zip(batch, outs):
-            self._ttft_window.append((now, batch_ms))
-            self._profile_ttft.append(
-                (len(seq.tokens) - seq.num_cached, batch_ms)
+            self._finish_prefill(
+                seq, tok, lp, now, batch_ms,
+                len(seq.tokens) - seq.num_cached,
             )
-            seq.prefill_done_time = seq.last_token_time = now
-            self._commit_full_blocks(seq)
-            seq.generated.append((tok, lp))
-            seq.tokens.append(tok)
-            self._running[seq.slot] = seq
-            alive = self._emit(seq, finished=self._check_stop(seq))
-            if alive and seq.req.prefill_only:
-                self._handoff(seq)
             admitted += 1
         return admitted
+
+    def _finish_prefill(
+        self,
+        seq: "_Seq",
+        tok: int,
+        lp: float,
+        now: float,
+        ms: float,
+        profiled_len: int,
+    ) -> None:
+        """Shared post-prefill bookkeeping for the batched and SP paths:
+        TTFT windows + profiling curve, block commit, first token, running
+        insert, emit, and the prefill-only handoff."""
+        self._ttft_window.append((now, ms))
+        self._profile_ttft.append((profiled_len, ms))
+        seq.prefill_done_time = seq.last_token_time = now
+        self._commit_full_blocks(seq)
+        seq.generated.append((tok, lp))
+        seq.tokens.append(tok)
+        self._running[seq.slot] = seq
+        alive = self._emit(seq, finished=self._check_stop(seq))
+        if alive and seq.req.prefill_only:
+            self._handoff(seq)
 
     def _prefill_sp(self, batch: List[_Seq]) -> int:
         """Ring-attention prefill for long prompts (one jitted call per
@@ -504,16 +526,7 @@ class InferenceEngine:
             )
             now = time.monotonic()
             ms = (now - t0) * 1000
-            self._ttft_window.append((now, ms))
-            self._profile_ttft.append((len(seq.tokens), ms))
-            seq.prefill_done_time = seq.last_token_time = now
-            self._commit_full_blocks(seq)
-            seq.generated.append((tok, lp))
-            seq.tokens.append(tok)
-            self._running[seq.slot] = seq
-            alive = self._emit(seq, finished=self._check_stop(seq))
-            if alive and seq.req.prefill_only:
-                self._handoff(seq)
+            self._finish_prefill(seq, tok, lp, now, ms, len(seq.tokens))
             admitted += 1
         return admitted
 

@@ -400,23 +400,63 @@ class ModelExecutor:
         lps = np.asarray(lps)
         return [(int(toks[i]), float(lps[i])) for i in range(n_real)]
 
-    def warmup(self) -> None:
-        """Compile the common serving shapes (P=1 prefill per length
-        bucket + one decode step) against the garbage block, so the first
-        real request's TTFT carries no compile (SURVEY §7 hard part 3 —
-        shape-bucketed continuous batching without runtime recompiles)."""
-        table = np.zeros((self.max_blocks_per_seq,), np.int32)
-        for b in self.prefill_buckets:
-            n = min(b, self.engine_cfg.max_seq_len - 1)
-            self.prefill_batch(
-                [
-                    PrefillItem(
-                        token_ids=np.zeros((n,), np.int32),
-                        start_pos=0,
-                        block_table=table,
-                    )
-                ]
+    def warmup(self) -> List[Tuple[int, int]]:
+        """Compile the common serving shapes against the garbage block, so
+        the first real request's TTFT carries no compile (SURVEY §7 hard
+        part 3 — shape-bucketed continuous batching without recompiles).
+
+        Prefill shapes are (P, Lpad, CB); this warms EVERY reachable
+        (Lpad, CB) pair at P=1 — CB is decoupled from Lpad because a
+        prefix-cache hit raises start_pos, so a short suffix can carry any
+        context width up to max_blocks_per_seq. Group shapes P>1 are left
+        to first contact (at most log2(PREFILL_GROUP_MAX) extra compiles
+        per bucket over the process lifetime, hit only under concurrent
+        admission bursts). Returns the (Lpad, CB) pairs warmed."""
+        bs = self.block_size
+        max_len = self.engine_cfg.max_seq_len
+        warmed: List[Tuple[int, int]] = []
+        for bi, b in enumerate(self.prefill_buckets):
+            n_full = min(b, max_len - 1)
+            # Shortest suffix still padding to THIS bucket (for large-CB
+            # prefix-hit shapes where the full-bucket suffix wouldn't fit,
+            # and for the small-CB shapes short in-bucket prompts hit).
+            n_min = (self.prefill_buckets[bi - 1] + 1) if bi else 1
+            # CB floor matches _prefill_group's need_blocks for the
+            # SHORTEST prompt in this bucket (ceil(n/bs), no +1 — the
+            # next-token block is allocated by the engine, not attended).
+            CB = self._pow2_bucket(
+                max(1, (n_min + bs - 1) // bs), self.max_blocks_per_seq
             )
+            while True:
+                if CB * bs <= n_full:
+                    # Natural shape: a prompt of exactly CB blocks, no
+                    # prefix hit (n_min <= CB*bs <= n_full keeps the
+                    # length in this bucket).
+                    n, sp = CB * bs, 0
+                else:
+                    # Prefix-hit shape: block-aligned start_pos so
+                    # need_blocks lands exactly on this CB bucket.
+                    n = n_full
+                    sp = (CB - (n + bs - 1) // bs) * bs
+                    if sp + n >= max_len:
+                        n = n_min
+                        sp = (CB - (n + bs - 1) // bs) * bs
+                if sp + n < max_len:
+                    table = np.zeros((self.max_blocks_per_seq,), np.int32)
+                    self.prefill_batch(
+                        [
+                            PrefillItem(
+                                token_ids=np.zeros((n,), np.int32),
+                                start_pos=sp,
+                                block_table=table,
+                            )
+                        ]
+                    )
+                    warmed.append((b, CB))
+                if CB >= self.max_blocks_per_seq:
+                    break
+                CB = min(CB * 2, self.max_blocks_per_seq)
+
         R = self.R
         active = np.zeros((R,), bool)
         active[0] = True
@@ -444,6 +484,7 @@ class ModelExecutor:
             if CB >= self.max_blocks_per_seq:
                 break
             CB = min(CB * 2, self.max_blocks_per_seq)
+        return warmed
 
     # ------------------------------------------------ SP (ring) prefill
 
