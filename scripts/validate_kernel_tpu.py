@@ -39,12 +39,20 @@ def bench(fn, iters=20):
     return (full - short) / iters
 
 
-def run_case(R, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16, chunk=4):
+def run_case(R, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16, chunk=4,
+             int8=False):
     rng = np.random.default_rng(0)
     N = R * MB + 1  # block 0 reserved garbage
     q = jnp.asarray(rng.standard_normal((R, Hq, D)), dtype)
     k = jnp.asarray(rng.standard_normal((N, Hkv, BS, D)), dtype)
     v = jnp.asarray(rng.standard_normal((N, Hkv, BS, D)), dtype)
+    if int8:
+        from xllm_service_tpu.ops import kv_cache as kvc
+
+        kq, ks = kvc.quantize_rows(k)
+        vq, vs = kvc.quantize_rows(v)
+        k = kvc.PagedKV(kq, ks)
+        v = kvc.PagedKV(vq, vs)
     bt = jnp.asarray(
         1 + np.arange(R * MB).reshape(R, MB) % (N - 1), jnp.int32
     )
@@ -62,11 +70,13 @@ def run_case(R, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16, chunk=4):
 
     tk = bench(ker)
     tg = bench(gat)
-    # KV bytes actually needed (true lens), bf16
-    kv_bytes = 2 * float(np.sum(np.asarray(lens))) * Hkv * D * dtype.dtype.itemsize
+    # KV bytes actually needed (true lens): element bytes + f32 scale/row
+    row_bytes = D * (1 if int8 else dtype.dtype.itemsize) + (4 if int8 else 0)
+    kv_bytes = 2 * float(np.sum(np.asarray(lens))) * Hkv * row_bytes
     bw = kv_bytes / tk / 1e9
     print(
         f"R={R:3d} Hq={Hq} Hkv={Hkv} D={D} BS={BS} MB={MB} ctx~{ctx} "
+        f"{'int8' if int8 else 'bf16'} "
         f"err={err:.4f} kernel={tk*1e6:8.1f}us gather={tg*1e6:8.1f}us "
         f"speedup={tg/tk:5.2f}x bw={bw:6.1f}GB/s"
     )
@@ -86,6 +96,9 @@ def main():
         dict(R=16, Hq=32, Hkv=8, D=128, BS=16, MB=256, ctx=4096),
         # production block size (reference contract: 128 tokens/block)
         dict(R=64, Hq=32, Hkv=8, D=128, BS=128, MB=16, ctx=2048),
+        # int8 KV cache (scale DMA + column folding) at production shapes
+        dict(R=64, Hq=32, Hkv=8, D=128, BS=128, MB=16, ctx=2048, int8=True),
+        dict(R=64, Hq=24, Hkv=8, D=128, BS=128, MB=16, ctx=2048, int8=True),
         # NOTE: D=64 is NOT included — Mosaic rejects the lane-padded HBM
         # block slice below one 128-lane tile (tpu.memref_slice verify
         # failure on-chip); ops/attention.py falls back to gather there.

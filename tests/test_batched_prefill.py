@@ -63,7 +63,7 @@ def test_prefill_batch_matches_sequential():
     # Caches identical outside garbage block 0 (masked/padded rows collide
     # there with nondeterministic winners — by design).
     np.testing.assert_array_equal(
-        np.asarray(exe_a.k_cache)[:, 1:], np.asarray(exe_b.k_cache)[:, 1:]
+        np.asarray(exe_a.k_cache.data)[:, 1:], np.asarray(exe_b.k_cache.data)[:, 1:]
     )
 
 

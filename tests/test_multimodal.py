@@ -85,7 +85,7 @@ def test_injection_matches_direct_tokens():
     np.testing.assert_allclose(lp_inj, lp_direct, atol=1e-4)
     # KV caches identical outside the garbage block
     np.testing.assert_array_equal(
-        np.asarray(exe_a.k_cache)[:, 1:], np.asarray(exe_b.k_cache)[:, 1:]
+        np.asarray(exe_a.k_cache.data)[:, 1:], np.asarray(exe_b.k_cache.data)[:, 1:]
     )
 
 

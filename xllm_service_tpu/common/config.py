@@ -96,6 +96,12 @@ class EngineConfig:
     murmur_hash3_seed: int = 1024  # block-hash seed — must match service tier
     num_blocks: int = 0  # 0 = size from hbm_utilization
     hbm_utilization: float = 0.9  # fraction of HBM for params + KV pool
+    # "auto" stores KV in model dtype; "int8" quantizes per (token, kv-head)
+    # row — halves decode's HBM traffic and doubles pool capacity. The
+    # block-hash contract is unaffected (hashes cover token ids, not bytes);
+    # migration/host-tier payloads stay in model dtype (requantized on
+    # import).
+    kv_cache_dtype: str = "auto"
 
     # Continuous batching.
     max_running_requests: int = 64

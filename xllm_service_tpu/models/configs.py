@@ -108,6 +108,21 @@ register(
 
 register(
     ModelConfig(
+        name="llama3-3b",
+        vocab_size=128256,
+        hidden_size=3072,
+        intermediate_size=8192,
+        num_layers=28,
+        num_heads=24,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=500000.0,
+        tie_word_embeddings=True,
+    )
+)
+
+register(
+    ModelConfig(
         name="llama3-8b",
         vocab_size=128256,
         hidden_size=4096,

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from xllm_service_tpu.models.configs import ModelConfig
+from xllm_service_tpu.ops import kv_cache as kv_cache_ops
 from xllm_service_tpu.ops.attention import (
     paged_attention,
     prefill_attention_blockwise,
@@ -148,11 +149,12 @@ def _qkv(lp, cfg: ModelConfig, x: jnp.ndarray, positions: jnp.ndarray):
 def _scatter_kv(k_cache, v_cache, blk, offset, k, v):
     """Write per-token K/V rows into cache slots.
 
-    k_cache: [num_blocks, Hkv, bs, D]; blk/offset: [T] block ids and
-    in-block offsets per token; inactive/invalid tokens carry (0, 0),
-    pointing into the reserved garbage block 0."""
-    kf = k_cache.at[blk, :, offset, :].set(k)
-    vf = v_cache.at[blk, :, offset, :].set(v)
+    k_cache: [num_blocks, Hkv, bs, D] plain array or PagedKV (int8 caches
+    quantize the rows on write); blk/offset: [T] block ids and in-block
+    offsets per token; inactive/invalid tokens carry (0, 0), pointing into
+    the reserved garbage block 0."""
+    kf = kv_cache_ops.scatter_rows(k_cache, blk, offset, k)
+    vf = kv_cache_ops.scatter_rows(v_cache, blk, offset, v)
     return kf, vf
 
 

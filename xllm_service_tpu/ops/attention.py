@@ -24,17 +24,21 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from xllm_service_tpu.ops import kv_cache as kvc
+
 NEG_INF = -1e30
 
 
 def gather_context(
-    k_cache: jnp.ndarray,  # [num_blocks, Hkv, block_size, D]
-    v_cache: jnp.ndarray,
+    k_cache,  # [num_blocks, Hkv, block_size, D] (plain or PagedKV)
+    v_cache,
     block_table: jnp.ndarray,  # [R, max_blocks] int32
 ):
-    """Gather each sequence's context as [R, max_blocks*block_size, Hkv, D]."""
-    k_ctx = jnp.swapaxes(k_cache[block_table], 2, 3)  # [R, MB, bs, Hkv, D]
-    v_ctx = jnp.swapaxes(v_cache[block_table], 2, 3)
+    """Gather each sequence's context as [R, max_blocks*block_size, Hkv, D].
+    Quantized (int8) caches are dequantized after the gather — only the
+    sequence's own blocks pay the dequant, not the whole pool."""
+    k_ctx = jnp.swapaxes(kvc.gather_blocks(k_cache, block_table), 2, 3)
+    v_ctx = jnp.swapaxes(kvc.gather_blocks(v_cache, block_table), 2, 3)
     R, MB, BS, H, D = k_ctx.shape
     return k_ctx.reshape(R, MB * BS, H, D), v_ctx.reshape(R, MB * BS, H, D)
 
@@ -92,9 +96,7 @@ def prefill_attention_gather(
     contain this chunk's K/V — caller scatters before attending). Causal.
     Reference oracle — materializes the full [L, Lk] score matrix; the
     serving path uses prefill_attention_blockwise. Returns [L, Hq, D]."""
-    k_ctx, v_ctx = gather_context(
-        k_cache[:, :, :, :], v_cache[:, :, :, :], block_table[None]
-    )
+    k_ctx, v_ctx = gather_context(k_cache, v_cache, block_table[None])
     L = q.shape[0]
     Lk = k_ctx.shape[1]
     rows = start_pos + jnp.arange(L, dtype=jnp.int32)  # absolute positions
@@ -136,8 +138,8 @@ def prefill_attention_blockwise(
     def body(carry, inputs):
         m_prev, l_prev, acc = carry
         blk_idx, blk_id = inputs
-        k_blk = k_cache[blk_id].astype(jnp.float32)  # [Hkv, BS, D]
-        v_blk = v_cache[blk_id].astype(jnp.float32)
+        k_blk = kvc.gather_block(k_cache, blk_id, jnp.float32)  # [Hkv, BS, D]
+        v_blk = kvc.gather_block(v_cache, blk_id, jnp.float32)
         cols = blk_idx * BS + jnp.arange(BS, dtype=jnp.int32)
         scores = (
             jnp.einsum("qhgd,hkd->qhgk", qf, k_blk) * scale
@@ -192,7 +194,11 @@ def paged_attention(
     env = os.environ.get("XLLM_PAGED_ATTENTION_KERNEL")
     if use_kernel is None:
         D = q.shape[-1]
-        kernel_ok = _on_tpu() and D % 128 == 0
+        BS = kvc.raw(k_cache).shape[-2]
+        kq = isinstance(k_cache, kvc.PagedKV) and k_cache.quantized
+        # int8 additionally needs BS lanes to form full 128-wide scale rows
+        # (the scale DMA slices [blk, h*BS : (h+1)*BS]).
+        kernel_ok = _on_tpu() and D % 128 == 0 and (not kq or BS % 128 == 0)
         use_kernel = (env != "0") if kernel_ok else (env == "1")
     if use_kernel:
         try:

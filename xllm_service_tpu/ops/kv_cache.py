@@ -1,0 +1,143 @@
+"""Paged KV cache representation, including the int8-quantized variant.
+
+Decode attention is HBM-bandwidth-bound: every step streams the whole live
+context's K/V through the chip (SURVEY.md §7 hard part 1). Storing the
+cache as int8 with one scale per (token-row, kv-head) halves that traffic
+— the decisive lever on v5e where HBM BW (~819 GB/s), not MXU FLOPs, caps
+decode throughput. The reference's engine-side analog is its KV-cache
+quantization config (engine tier, absent submodule; service-visible
+contract is only the block/hash layout, which is unchanged here: the
+block-size and chained-hash contract hashes TOKEN IDS, not cache bytes).
+
+Representation: a `PagedKV` NamedTuple so the cache flows through
+`jax.lax.scan`/`jit`/donation as a pytree wherever a plain array did.
+
+  * bf16 mode:  PagedKV(data=[..., N, Hkv, BS, D] bf16, scale=None)
+  * int8 mode:  PagedKV(data=[..., N, Hkv, BS, D] int8,
+                        scale=[..., N, Hkv, BS] f32)
+
+Quantization is symmetric per row (one token's one head, D lanes):
+scale = max|row| / 127, data = round(row / scale). Dequantized compute
+stays bf16/f32; only storage and HBM transfer shrink.
+
+Plain jnp.ndarray caches remain accepted everywhere (`as_paged`), so the
+bf16 path and all existing callers/tests are untouched.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+
+class PagedKV(NamedTuple):
+    data: jnp.ndarray
+    scale: Optional[jnp.ndarray] = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.scale is not None
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+
+CacheLike = Union[jnp.ndarray, PagedKV]
+
+
+def as_paged(cache: CacheLike) -> PagedKV:
+    return cache if isinstance(cache, PagedKV) else PagedKV(cache, None)
+
+
+def raw(cache: CacheLike) -> jnp.ndarray:
+    """The storage array (for shape/dtype introspection)."""
+    return cache.data if isinstance(cache, PagedKV) else cache
+
+
+def quantize_rows(rows: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """rows [..., D] -> (int8 [..., D], scale [...]) symmetric per-row."""
+    amax = jnp.max(jnp.abs(rows.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(rows.astype(jnp.float32) / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize(data: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.bfloat16):
+    """data int8 [..., D], scale [...] -> [..., D] in `dtype`."""
+    return (data.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def set_rows(cache: CacheLike, data_index, scale_index, rows: jnp.ndarray):
+    """Generic quantize-or-cast cache write: `rows` [..., D] land at
+    `cache.data[data_index]` (and, when quantized, their per-row scales at
+    `cache.scale[scale_index]`). The single place the write-side
+    quantization branch lives — scatter_rows / PD import / SP scatter all
+    route through here."""
+    if isinstance(cache, PagedKV) and cache.quantized:
+        q, s = quantize_rows(rows)
+        return PagedKV(
+            cache.data.at[data_index].set(q),
+            cache.scale.at[scale_index].set(s),
+        )
+    if isinstance(cache, PagedKV):
+        return PagedKV(
+            cache.data.at[data_index].set(rows.astype(cache.data.dtype)),
+            None,
+        )
+    return cache.at[data_index].set(rows.astype(cache.dtype))
+
+
+def scatter_rows(
+    cache: CacheLike,
+    blk: jnp.ndarray,  # [T] int32 block ids (0 = garbage block)
+    offset: jnp.ndarray,  # [T] int32 in-block offsets
+    rows: jnp.ndarray,  # [T, Hkv, D] model-dtype K or V rows
+) -> CacheLike:
+    """Write per-token rows into cache slots [N, Hkv, BS, D] (one layer's
+    cache — the layer axis is already sliced off by the caller's scan)."""
+    return set_rows(
+        cache,
+        (blk, slice(None), offset, slice(None)),
+        (blk, slice(None), offset),
+        rows,
+    )
+
+
+def gather_block(cache: CacheLike, block_id, dtype=jnp.bfloat16):
+    """One block [Hkv, BS, D] dequantized to `dtype` (blockwise prefill)."""
+    if isinstance(cache, PagedKV) and cache.quantized:
+        return dequantize(cache.data[block_id], cache.scale[block_id], dtype)
+    return raw(cache)[block_id].astype(dtype)
+
+
+def gather_blocks(cache: CacheLike, block_table: jnp.ndarray, dtype=None):
+    """Gather + dequantize blocks via a block table of any shape [...B];
+    returns [...B, Hkv, BS, D]."""
+    if isinstance(cache, PagedKV) and cache.quantized:
+        return dequantize(
+            cache.data[block_table], cache.scale[block_table],
+            dtype or jnp.bfloat16,
+        )
+    out = raw(cache)[block_table]
+    return out if dtype is None else out.astype(dtype)
+
+
+def alloc_cache(
+    shape: Tuple[int, ...],  # [..., N, Hkv, BS, D]
+    dtype,
+    quantized: bool,
+) -> PagedKV:
+    if quantized:
+        return PagedKV(
+            jnp.zeros(shape, jnp.int8), jnp.zeros(shape[:-1], jnp.float32)
+        )
+    return PagedKV(jnp.zeros(shape, dtype), None)

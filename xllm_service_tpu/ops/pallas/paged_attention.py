@@ -48,28 +48,34 @@ def _decode_kernel(
     seq_lens_ref,     # [R]      SMEM
     # inputs
     q_ref,            # [1, 1, Gp, D] VMEM
-    k_hbm,            # [N, Hkv, BS, D] HBM (pl.ANY)
+    k_hbm,            # [N, Hkv, BS, D] HBM (pl.ANY) — bf16 or int8
     v_hbm,            # [N, Hkv, BS, D] HBM (pl.ANY)
+    *rest,            # quantized: ks_hbm, vs_hbm [N, Hkv*BS] f32, then
     # output
-    o_ref,            # [1, 1, Gp, D] VMEM
+    #   o_ref         # [1, 1, Gp, D] VMEM
     # scratch
-    k_buf,            # [2, C*BS, D] VMEM
-    v_buf,            # [2, C*BS, D] VMEM
-    sems,             # [2, 2, C] DMA semaphores
-    *,
+    #   k_buf, v_buf  # [2, C*BS, D] VMEM (cache dtype)
+    #   sems          # [2, 2, C] DMA semaphores
+    #   (quantized)   ks_buf, vs_buf [2, C, BS] f32 + ssems [2, 2, C]
     block_size: int,
     chunk: int,
     scale: float,
+    quantized: bool,
 ):
+    if quantized:
+        ks_hbm, vs_hbm, o_ref, k_buf, v_buf, sems, ks_buf, vs_buf, ssems = rest
+    else:
+        o_ref, k_buf, v_buf, sems = rest
+        ks_hbm = vs_hbm = ks_buf = vs_buf = ssems = None
     r = pl.program_id(0)
     h = pl.program_id(1)
     seq_len = seq_lens_ref[r]
     span = chunk * block_size
     nc = pl.cdiv(seq_len, span)  # chunks to process
 
-    def dma_pair(slot, c_idx, blk):
+    def dmas(slot, c_idx, blk):
         off = c_idx * block_size
-        return (
+        out = [
             pltpu.make_async_copy(
                 k_hbm.at[blk, h],
                 k_buf.at[slot, pl.ds(off, block_size)],
@@ -80,21 +86,35 @@ def _decode_kernel(
                 v_buf.at[slot, pl.ds(off, block_size)],
                 sems.at[slot, 1, c_idx],
             ),
-        )
+        ]
+        if quantized:
+            out.append(
+                pltpu.make_async_copy(
+                    ks_hbm.at[blk, pl.ds(h * block_size, block_size)],
+                    ks_buf.at[slot, c_idx],
+                    ssems.at[slot, 0, c_idx],
+                )
+            )
+            out.append(
+                pltpu.make_async_copy(
+                    vs_hbm.at[blk, pl.ds(h * block_size, block_size)],
+                    vs_buf.at[slot, c_idx],
+                    ssems.at[slot, 1, c_idx],
+                )
+            )
+        return out
 
     def start_chunk(slot, c):
         for c_idx in range(chunk):  # static, small
             blk = block_table_ref[r, c * chunk + c_idx]
-            kd, vd = dma_pair(slot, c_idx, blk)
-            kd.start()
-            vd.start()
+            for d in dmas(slot, c_idx, blk):
+                d.start()
 
     def wait_chunk(slot, c):
         for c_idx in range(chunk):
             blk = block_table_ref[r, c * chunk + c_idx]
-            kd, vd = dma_pair(slot, c_idx, blk)
-            kd.wait()
-            vd.wait()
+            for d in dmas(slot, c_idx, blk):
+                d.wait()
 
     # Inactive decode slots carry seq_len = 0: issue no DMAs (their
     # semaphores would never be awaited and could satisfy a later grid
@@ -114,14 +134,21 @@ def _decode_kernel(
             start_chunk(jax.lax.rem(c + 1, 2), c + 1)
 
         wait_chunk(slot, c)
+        k_tile = k_buf[slot]
+        if quantized:
+            k_tile = k_tile.astype(jnp.bfloat16)
         scores = (
             jax.lax.dot_general(
-                q, k_buf[slot],
+                q, k_tile,
                 dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             * scale
         )  # [Gp, C*BS] f32
+        if quantized:
+            # True K row j = int8 row * ks[j]: fold the per-row scale into
+            # the score columns (cheaper than dequantizing the K tile).
+            scores = scores * ks_buf[slot].reshape(1, chunk * block_size)
         col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
         scores = jnp.where(c * span + col < seq_len, scores, NEG_INF)
 
@@ -130,10 +157,18 @@ def _decode_kernel(
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(scores - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jnp.dot(
-            p.astype(k_buf.dtype), v_buf[slot],
-            preferred_element_type=jnp.float32,
-        )  # [Gp, D] f32
+        if quantized:
+            # True V row j = int8 row * vs[j]: fold into p's columns.
+            p = p * vs_buf[slot].reshape(1, chunk * block_size)
+            pv = jnp.dot(
+                p.astype(jnp.bfloat16), v_buf[slot].astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )  # [Gp, D] f32
+        else:
+            pv = jnp.dot(
+                p.astype(k_buf.dtype), v_buf[slot],
+                preferred_element_type=jnp.float32,
+            )
         return m_new, l_new, acc * alpha + pv
 
     Gp, D = q_ref.shape[2], q_ref.shape[3]
@@ -156,16 +191,23 @@ def _round_up(x: int, m: int) -> int:
 )
 def paged_attention_kernel(
     q: jnp.ndarray,            # [R, Hq, D]
-    k_cache: jnp.ndarray,      # [N, Hkv, BS, D]
-    v_cache: jnp.ndarray,
+    k_cache,                   # [N, Hkv, BS, D] plain array or PagedKV
+    v_cache,
     block_table: jnp.ndarray,  # [R, MB] int32
     seq_lens: jnp.ndarray,     # [R] int32
     scale: float,
     interpret: bool = False,
     chunk: int = 4,
 ) -> jnp.ndarray:
+    from xllm_service_tpu.ops import kv_cache as kvc
+
+    k_cache = kvc.as_paged(k_cache)
+    v_cache = kvc.as_paged(v_cache)
+    quantized = k_cache.quantized
+    k_data, v_data = k_cache.data, v_cache.data
+
     R, Hq, D = q.shape
-    _, Hkv, BS, _ = k_cache.shape
+    N, Hkv, BS, _ = k_data.shape
     MB = block_table.shape[1]
     G = Hq // Hkv
     Gp = _round_up(G, 8)
@@ -181,29 +223,49 @@ def paged_attention_kernel(
         # columns are masked out by seq_len anyway.
         bt = jnp.pad(bt, ((0, 0), (0, MBp - MB)))
 
+    # Pin the caches to HBM explicitly: under pl.ANY the compiler may place
+    # a small cache in VMEM, where the [BS, D] per-block slice is illegal
+    # for D < 128 (lane-padded tiling); HBM DMA slices are contiguous.
+    hbm = pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM)
+    in_specs = [
+        pl.BlockSpec((1, 1, Gp, D), lambda r, h, bt, sl: (r, h, 0, 0)),
+        hbm,
+        hbm,
+    ]
+    inputs = [bt, seq_lens.astype(jnp.int32), qr, k_data, v_data]
+    scratch = [
+        pltpu.VMEM((2, C * BS, D), k_data.dtype),
+        pltpu.VMEM((2, C * BS, D), v_data.dtype),
+        pltpu.SemaphoreType.DMA((2, 2, C)),
+    ]
+    kv_bytes_per_row = D * k_data.dtype.itemsize
+    if quantized:
+        # Scales ride as [N, Hkv*BS] f32 so the per-(block, head) slice is
+        # a contiguous [BS]-lane row (BS = 128 in production).
+        in_specs += [hbm, hbm]
+        inputs += [
+            k_cache.scale.reshape(N, Hkv * BS).astype(jnp.float32),
+            v_cache.scale.reshape(N, Hkv * BS).astype(jnp.float32),
+        ]
+        scratch += [
+            pltpu.VMEM((2, C, BS), jnp.float32),
+            pltpu.VMEM((2, C, BS), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2, C)),
+        ]
+        kv_bytes_per_row += 4
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(R, Hkv),
-        in_specs=[
-            pl.BlockSpec((1, 1, Gp, D), lambda r, h, bt, sl: (r, h, 0, 0)),
-            # Pin the caches to HBM explicitly: under pl.ANY the compiler
-            # may place a small cache in VMEM, where the [BS, D] per-block
-            # slice is illegal for D < 128 (lane-padded tiling); HBM DMA
-            # slices are contiguous and shape-free.
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, Gp, D), lambda r, h, bt, sl: (r, h, 0, 0)
         ),
-        scratch_shapes=[
-            pltpu.VMEM((2, C * BS, D), k_cache.dtype),
-            pltpu.VMEM((2, C * BS, D), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2, C)),
-        ],
+        scratch_shapes=scratch,
     )
     kernel = functools.partial(
-        _decode_kernel, block_size=BS, chunk=C, scale=scale
+        _decode_kernel, block_size=BS, chunk=C, scale=scale,
+        quantized=quantized,
     )
     out = pl.pallas_call(
         kernel,
@@ -215,10 +277,10 @@ def paged_attention_kernel(
         cost_estimate=pl.CostEstimate(
             flops=4 * R * Hkv * Gp * D * MB * BS,  # qk + pv
             bytes_accessed=(
-                R * Hq * D * 4 + 2 * R * MB * BS * Hkv * D * 2
+                R * Hq * D * 4 + 2 * R * MB * BS * Hkv * kv_bytes_per_row
             ),
             transcendentals=R * Hkv * Gp * MB * BS,
         ),
         interpret=interpret,
-    )(bt, seq_lens.astype(jnp.int32), qr, k_cache, v_cache)
+    )(*inputs)
     return out[:, :, :G, :].reshape(R, Hq, D)

@@ -89,6 +89,12 @@ def kv_cache_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(None, None, "tp", None, None))
 
 
+def kv_scale_sharding(mesh: Mesh) -> NamedSharding:
+    # int8 cache scales [L, num_blocks, Hkv, bs]: KV heads over tp, same
+    # placement as the data they scale.
+    return NamedSharding(mesh, P(None, None, "tp", None))
+
+
 def check_tp_divisibility(cfg: ModelConfig, tp: int, ep: int = 1) -> None:
     if cfg.num_kv_heads % tp or cfg.num_heads % tp:
         raise ValueError(

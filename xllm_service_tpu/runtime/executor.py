@@ -34,9 +34,11 @@ from xllm_service_tpu.models import llama
 from xllm_service_tpu.models.configs import ModelConfig, get_model_config
 from xllm_service_tpu.ops import sampling as sampling_ops
 from xllm_service_tpu.parallel.mesh import build_mesh
+from xllm_service_tpu.ops import kv_cache as kvc
 from xllm_service_tpu.parallel.sharding import (
     check_tp_divisibility,
     kv_cache_sharding,
+    kv_scale_sharding,
     param_shardings,
 )
 
@@ -103,6 +105,14 @@ class ModelExecutor:
             check_tp_divisibility(self.cfg, tp, ep)
 
         self.dtype = jnp.bfloat16 if engine_cfg.dtype == "bfloat16" else jnp.float32
+        # int8 KV cache: halves decode's HBM traffic (the bound resource);
+        # params/activations stay in model dtype.
+        if engine_cfg.kv_cache_dtype not in ("auto", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype={engine_cfg.kv_cache_dtype!r}: expected "
+                f"'auto' (model dtype) or 'int8'"
+            )
+        self.kv_quantized = engine_cfg.kv_cache_dtype == "int8"
         self.R = engine_cfg.max_running_requests
         self.block_size = engine_cfg.block_size
         self.num_blocks = self._decide_num_blocks()
@@ -139,12 +149,16 @@ class ModelExecutor:
                 self.block_size,
                 self.cfg.head_dim,
             )
+            cache_sharding = kvc.PagedKV(
+                kv_sharding,
+                kv_scale_sharding(self.mesh) if self.kv_quantized else None,
+            )
             alloc = jax.jit(
                 lambda: (
-                    jnp.zeros(cache_shape, self.dtype),
-                    jnp.zeros(cache_shape, self.dtype),
+                    kvc.alloc_cache(cache_shape, self.dtype, self.kv_quantized),
+                    kvc.alloc_cache(cache_shape, self.dtype, self.kv_quantized),
                 ),
-                out_shardings=(kv_sharding, kv_sharding),
+                out_shardings=(cache_sharding, cache_sharding),
             )
             self.k_cache, self.v_cache = alloc()
 
@@ -154,13 +168,16 @@ class ModelExecutor:
         self._prefill_jit = jax.jit(
             self._prefill_impl, donate_argnums=(0, 1)
         )
-        self._import_jit = jax.jit(
-            lambda k, v, blocks, ids: (
-                k.at[:, ids].set(blocks[0].astype(k.dtype)),
-                v.at[:, ids].set(blocks[1].astype(v.dtype)),
-            ),
-            donate_argnums=(0, 1),
-        )
+        def _import_impl(k, v, blocks, ids):
+            # blocks [2, L, P, Hkv, BS, D] in model dtype (migration payloads
+            # stay bf16 on the wire/host tiers; int8 caches requantize here).
+            idx = (slice(None), ids)
+            return (
+                kvc.set_rows(k, idx, idx, blocks[0]),
+                kvc.set_rows(v, idx, idx, blocks[1]),
+            )
+
+        self._import_jit = jax.jit(_import_impl, donate_argnums=(0, 1))
         self.prefill_buckets = sorted(
             b for b in engine_cfg.prefill_buckets if b <= engine_cfg.max_seq_len
         )
@@ -197,13 +214,19 @@ class ModelExecutor:
             total_hbm * self.engine_cfg.hbm_utilization
             - n_params * bytes_per_param / tp
         ) / 2
+        # int8 cache: 1 byte/element + 4-byte f32 scale per D-row.
+        kv_elem_bytes = (
+            1 + 4.0 / self.cfg.head_dim
+            if self.kv_quantized
+            else bytes_per_param
+        )
         block_bytes = (
             2
             * self.cfg.num_layers
             * self.block_size
             * (self.cfg.num_kv_heads // tp if self.cfg.num_kv_heads >= tp else self.cfg.num_kv_heads)
             * self.cfg.head_dim
-            * bytes_per_param
+            * kv_elem_bytes
         )
         n = int(budget // block_bytes)
         if n < 16:
@@ -503,12 +526,12 @@ class ModelExecutor:
         # (invalid/padded rows land in garbage block 0). Advanced indices
         # separated by slices put the token axis FIRST in the update shape:
         # [Lsp, layers, Hkv, D].
-        k_cache = k_cache.at[:, blk, :, off, :].set(
-            jnp.swapaxes(k_all.astype(self.dtype), 0, 1)
-        )
-        v_cache = v_cache.at[:, blk, :, off, :].set(
-            jnp.swapaxes(v_all.astype(self.dtype), 0, 1)
-        )
+        # rows [L, Lsp, Hkv, D] -> token axis first to match the advanced-
+        # index update shape [Lsp, layers, Hkv(, D)].
+        di = (slice(None), blk, slice(None), off, slice(None))
+        si = (slice(None), blk, slice(None), off)
+        k_cache = kvc.set_rows(k_cache, di, si, jnp.swapaxes(k_all, 0, 1))
+        v_cache = kvc.set_rows(v_cache, di, si, jnp.swapaxes(v_all, 0, 1))
         tokens, logprob, _ = sampling_ops.sample_tokens(
             logits[None], temperature[None], top_k[None], top_p[None],
             step_key[None],
@@ -636,10 +659,20 @@ class ModelExecutor:
 
     def export_blocks(self, block_ids: np.ndarray) -> jax.Array:
         """Gather KV blocks for migration to a peer instance (PD disagg).
-        Returns [2, L, n, bs, Hkv, D] on device; the transfer layer moves it
-        over ICI/DCN (jax.device_put to the peer mesh) or via host RPC."""
+        Returns [2, L, n, Hkv, bs, D] on device in MODEL dtype (int8 caches
+        dequantize on export so the migration payload / host-tier format is
+        dtype-stable); the transfer layer moves it over ICI/DCN
+        (jax.device_put to the peer mesh) or via host RPC."""
         ids = jnp.asarray(block_ids, jnp.int32)
-        return jnp.stack([self.k_cache[:, ids], self.v_cache[:, ids]])
+
+        def grab(cache):
+            if cache.quantized:
+                return kvc.dequantize(
+                    cache.data[:, ids], cache.scale[:, ids], self.dtype
+                )
+            return cache.data[:, ids]
+
+        return jnp.stack([grab(self.k_cache), grab(self.v_cache)])
 
     def import_blocks(self, blocks: jax.Array, block_ids: np.ndarray) -> None:
         """Scatter migrated/offloaded blocks into the caches IN PLACE (the
