@@ -239,6 +239,12 @@ def test_local_transfer_matches_colocated(local_transfer_stack, colocated):
 
     def spy(handoff, header):
         direct_calls.append(header.get("service_request_id"))
+        # ICI-analog contract: the in-process path must deliver the KV as a
+        # DEVICE array (no host copy anywhere between export and import).
+        if handoff.kv is not None:
+            import jax
+
+            assert isinstance(handoff.kv, jax.Array), type(handoff.kv)
         return orig(handoff, header)
 
     decode._admit_import = spy

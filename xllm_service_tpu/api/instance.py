@@ -16,11 +16,14 @@ Serves two modes on the same endpoints:
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import os
 import queue
 import threading
 import time
+
+import numpy as np
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from xllm_service_tpu.api.client import HeartbeatLoop, MasterClient
@@ -81,10 +84,10 @@ def sampling_from_body(body: Dict[str, Any], cfg: EngineConfig) -> SamplingParam
 
 
 # Process-local instance registry: colocated PD peers hand KV off through
-# direct calls, skipping the bytes (de)serialization + HTTP hop of the DCN
-# path. The KV payload is already a host numpy copy by this point
-# (engine._handoff exports blocks device->host either way); keeping the
-# export device-resident end-to-end is the ICI device_put path, still open.
+# direct calls. The KV payload stays a DEVICE array end-to-end on this path
+# (engine._handoff exports to a device buffer; the peer's import pads and
+# scatters device-side) — the single-host analog of the ICI device_put
+# path. Only the HTTP/DCN route copies to host, at serialization time.
 _LOCAL_INSTANCES: Dict[str, "InstanceServer"] = {}
 _LOCAL_MU = threading.Lock()
 
@@ -542,20 +545,8 @@ class InstanceServer:
                     ids, emitted = d0.export_state()
                     extra["detok_ids"] = ids
                     extra["detok_emitted"] = emitted
-                peer = None
-                if self.cfg.enable_local_kv_transfer:
-                    with _LOCAL_MU:
-                        peer = _LOCAL_INSTANCES.get(decode_name)
-                    if peer is not None and (
-                        # BOTH sides must opt in, and both must belong to
-                        # the same master (name collisions across stacks in
-                        # one process must not cross-deliver KV).
-                        not peer.cfg.enable_local_kv_transfer
-                        or getattr(peer._master, "_addr", None)
-                        != getattr(self._master, "_addr", "")
-                    ):
-                        peer = None
-                if peer is not None and peer is not self:
+                peer = self._local_peer(decode_name)
+                if peer is not None:
                     # Colocated peer: direct in-process import, no
                     # serialization (ICI-path analog).
                     try:
@@ -592,10 +583,39 @@ class InstanceServer:
                 self._push_q.put(out)
 
         def send(handoff) -> None:
-            # Engine-thread side: just enqueue (cheap, non-blocking).
+            # Engine-thread side. The KV export arrives as a DEVICE array;
+            # it may only stay device-resident if a colocated peer will
+            # take it directly — on the HTTP/DCN path it would otherwise
+            # sit pinned in HBM through the queue + up-to-60s ack wait
+            # while the engine has already freed and re-budgeted those
+            # blocks (round-2 review finding). Copy to host here (what the
+            # engine itself did before the transfer pipeline existed); a
+            # peer that (de)registers between enqueue and transfer still
+            # works — both import paths accept either array kind.
+            if handoff.kv is not None and self._local_peer(decode_name) is None:
+                handoff = dataclasses.replace(
+                    handoff, kv=np.asarray(handoff.kv)
+                )
             self._transfer_q.put(lambda: transfer(handoff))
 
         return send
+
+    def _local_peer(self, decode_name: str) -> Optional["InstanceServer"]:
+        """The colocated in-process peer eligible for direct (device-
+        resident) KV handoff, or None. BOTH sides must opt in, and both
+        must belong to the same master (name collisions across stacks in
+        one process must not cross-deliver KV)."""
+        if not self.cfg.enable_local_kv_transfer:
+            return None
+        with _LOCAL_MU:
+            peer = _LOCAL_INSTANCES.get(decode_name)
+        if peer is None or peer is self:
+            return None
+        if not peer.cfg.enable_local_kv_transfer or getattr(
+            peer._master, "_addr", None
+        ) != getattr(self._master, "_addr", ""):
+            return None
+        return peer
 
     def _handle_kv_import(self, h: QuietHandler) -> None:
         try:

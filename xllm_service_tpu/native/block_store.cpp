@@ -87,6 +87,18 @@ int xbs_num_free(void* p) {
   return s->free_count_locked();
 }
 
+// Blocks currently holding live references (ref > 0). Diagnostic /
+// invariant hook: after the engine drains, this must be 0 — anything else
+// is a leaked reference (the stress harness asserts on it).
+int xbs_num_referenced(void* p) {
+  auto* s = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(s->mu);
+  int n = 0;
+  for (int i = 1; i < s->num_blocks; ++i)
+    if (s->blocks[i].ref > 0) ++n;
+  return n;
+}
+
 // Allocate n blocks (ref=1 each). Committed LRU victims are UN-indexed and
 // reported via out_evicted_{ids,hashes} so the caller can offer their
 // content to a colder tier, then record the matching event. Returns 0 on

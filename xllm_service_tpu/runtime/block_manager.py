@@ -74,6 +74,13 @@ class BlockManager:
         return len(self._free) + len(self._evictable)
 
     @property
+    def num_referenced_blocks(self) -> int:
+        """Blocks with live references — 0 when the engine is drained
+        (stress-harness invariant; mirrors NativeBlockManager). Like the
+        rest of this class, call from the engine thread or after stop()."""
+        return sum(1 for b in self._blocks.values() if b.ref_count > 0)
+
+    @property
     def usage(self) -> float:
         total = self.num_blocks - 1
         return (total - self.num_free_blocks) / max(total, 1)

@@ -616,7 +616,13 @@ class InferenceEngine:
         )
         kv = None
         if full > 0:
-            kv = np.asarray(self.executor.export_blocks(seq.block_ids[:full]))
+            # Stays a DEVICE array: the in-process (colocated-PD / ICI
+            # analog) path imports it without ever touching the host; the
+            # HTTP/DCN path converts at serialization (handoff_to_bytes).
+            # Safe vs. the block free below: export_blocks gathers into a
+            # fresh buffer on the device stream before any later step can
+            # rewrite the freed blocks.
+            kv = self.executor.export_blocks(seq.block_ids[:full])
         payload = KVHandoff(
             request_id=seq.req.request_id,
             token_ids=list(seq.tokens),
@@ -667,7 +673,10 @@ class InferenceEngine:
         # never vanishes.
         if h.num_full_blocks > 0 and h.kv is not None:
             try:
-                kv = np.asarray(h.kv)
+                # numpy from the HTTP/DCN path; a device jax.Array from the
+                # in-process local path (no host round-trip — the slice and
+                # import below run device-side).
+                kv = h.kv
                 c = self.executor.cfg
                 expect = (
                     2, c.num_layers, h.num_full_blocks, c.num_kv_heads,
@@ -704,7 +713,8 @@ class InferenceEngine:
                 if ids:
                     try:
                         self.executor.import_blocks(
-                            kv[:, :, fresh], np.asarray(ids)
+                            kv[:, :, np.asarray(fresh, np.int32)],
+                            np.asarray(ids),
                         )
                     except Exception:
                         self.block_mgr.free(ids)

@@ -687,10 +687,14 @@ class ModelExecutor:
         ids = np.empty((P,), np.int32)
         ids[:n] = block_ids
         ids[n:] = block_ids[n - 1] if n else 0
-        arr = np.asarray(blocks)
+        # One device-side pad for both payload kinds: host (HTTP/DCN, tier
+        # re-import) payloads transfer UNPADDED and pad on device; the
+        # in-process PD fast path is already device-resident (no host
+        # round-trip anywhere in the import).
+        arr = jnp.asarray(blocks)
         if P != n:
-            pad = np.repeat(arr[:, :, -1:], P - n, axis=2)
-            arr = np.concatenate([arr, pad], axis=2)
+            pad = jnp.repeat(arr[:, :, -1:], P - n, axis=2)
+            arr = jnp.concatenate([arr, pad], axis=2)
         self.k_cache, self.v_cache = self._import_jit(
-            self.k_cache, self.v_cache, jnp.asarray(arr), jnp.asarray(ids)
+            self.k_cache, self.v_cache, arr, jnp.asarray(ids)
         )

@@ -73,6 +73,8 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.xbs_free_store.argtypes = [P]
             lib.xbs_num_free.argtypes = [P]
             lib.xbs_num_free.restype = I
+            lib.xbs_num_referenced.argtypes = [P]
+            lib.xbs_num_referenced.restype = I
             lib.xbs_allocate.argtypes = [P, I, IP, IP, C, ctypes.POINTER(I)]
             lib.xbs_allocate.restype = I
             lib.xbs_acquire.argtypes = [P, I]
@@ -137,6 +139,11 @@ class NativeBlockManager:
     @property
     def num_free_blocks(self) -> int:
         return self._lib.xbs_num_free(self._store)
+
+    @property
+    def num_referenced_blocks(self) -> int:
+        """Blocks with live references — 0 when the engine is drained."""
+        return self._lib.xbs_num_referenced(self._store)
 
     @property
     def usage(self) -> float:
