@@ -1,0 +1,179 @@
+"""DeepSeek-family MLA (models/deepseek.py): the ABSORBED paged decode and
+blockwise prefill must reproduce the NAIVE (non-absorbed, materialized
+per-head K/V) dense oracle exactly — this pins the latent-space absorption
+math (q_nope @ W_UK, W_UV-after-attention) to the paper formulation.
+
+Also covers: the engine running deepseek-tiny end-to-end (latent cache in
+the k slot, dummy v), the MoE + shared-experts variant, int8 latent cache,
+and PD migration shapes for a 1-cache family.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from xllm_service_tpu.common.config import EngineConfig
+from xllm_service_tpu.models import deepseek
+from xllm_service_tpu.models.configs import get_model_config
+from xllm_service_tpu.ops.sampling import SamplingParams
+from xllm_service_tpu.runtime.engine import EngineRequest, InferenceEngine
+from xllm_service_tpu.runtime.executor import (
+    ModelExecutor,
+    PrefillItem,
+    SamplingBatch,
+)
+
+
+def _executor(model="deepseek-tiny", **kw):
+    cfg = EngineConfig(
+        model=model,
+        dtype="float32",
+        block_size=16,
+        num_blocks=64,
+        max_running_requests=4,
+        max_seq_len=256,
+        prefill_buckets=[32, 64, 128, 256],
+        **kw,
+    )
+    return ModelExecutor(cfg, init_seed=11)
+
+
+def _oracle_tokens(ex, prompt, n):
+    mcfg = ex.cfg
+    seq = list(prompt)
+    for _ in range(n):
+        logits = deepseek.forward_dense(
+            ex.params, mcfg, jnp.asarray(seq, jnp.int32)[None]
+        )
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    return seq[len(prompt):]
+
+
+@pytest.mark.parametrize("model", ["deepseek-tiny", "deepseek-moe-tiny"])
+def test_paged_matches_dense_oracle(model):
+    """Prefill (blockwise over latent blocks) + absorbed paged decode equal
+    the naive dense forward, greedy, token-for-token."""
+    ex = _executor(model)
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(1, 500, (37,)).astype(np.int32)
+    table = np.zeros((ex.max_blocks_per_seq,), np.int32)
+    table[:5] = [1, 2, 3, 4, 5]
+
+    tok, _ = ex.prefill(prompt, 0, table)
+    want = _oracle_tokens(ex, list(prompt), 6)
+    assert tok == want[0], (tok, want)
+
+    got = [tok]
+    pos = np.zeros(4, np.int32)
+    pos[0] = len(prompt)
+    active = np.zeros(4, bool)
+    active[0] = True
+    tables = np.zeros((4, ex.max_blocks_per_seq), np.int32)
+    tables[0] = table
+    cur = np.zeros(4, np.int32)
+    cur[0] = tok
+    batch = SamplingBatch(
+        np.zeros(4, np.float32), np.zeros(4, np.int32),
+        np.ones(4, np.float32), np.zeros(4, np.uint32), np.zeros(4, np.int32),
+    )
+    for _ in range(5):
+        t, _ = ex.decode(cur, pos, tables, active, batch)
+        cur[0] = t[0]
+        pos[0] += 1
+        got.append(int(t[0]))
+    assert got == want, (got, want)
+
+
+def test_prefill_chunked_matches_single_shot():
+    """Chunked prefill (prefix continuation with start_pos > 0) writes the
+    same latent cache as one-shot prefill: the continuation token stream
+    must match."""
+    ex = _executor()
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(1, 500, (48,)).astype(np.int32)
+    table = np.zeros((ex.max_blocks_per_seq,), np.int32)
+    table[:4] = [1, 2, 3, 4]
+    tok_a, _ = ex.prefill(prompt, 0, table)
+
+    ex2 = _executor()
+    table2 = np.zeros((ex2.max_blocks_per_seq,), np.int32)
+    table2[:4] = [1, 2, 3, 4]
+    ex2.prefill(prompt[:32], 0, table2)  # fills blocks 1..2
+    tok_b, _ = ex2.prefill(prompt[32:], 32, table2)
+    assert tok_a == tok_b
+
+
+def test_int8_latent_cache_close():
+    ex_fp = _executor()
+    ex_q = _executor(kv_cache_dtype="int8")
+    assert ex_q.k_cache.quantized
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(1, 500, (30,)).astype(np.int32)
+    table = np.zeros((ex_fp.max_blocks_per_seq,), np.int32)
+    table[:3] = [1, 2, 3]
+    t1, _ = ex_fp.prefill(prompt, 0, table)
+    t2, _ = ex_q.prefill(prompt, 0, table)
+    assert t1 == t2  # tiny model, greedy: int8 rounding shouldn't flip it
+
+
+def test_migration_shape_single_cache():
+    ex = _executor()
+    assert ex.num_caches == 1
+    mcfg = get_model_config("deepseek-tiny")
+    assert ex.migration_shape(3) == (
+        1, mcfg.num_layers, 3, 1, 16, mcfg.kv_lora_rank + mcfg.qk_rope_head_dim,
+    )
+    table = np.zeros((ex.max_blocks_per_seq,), np.int32)
+    table[:3] = [1, 2, 3]
+    ex.prefill(np.arange(1, 40, dtype=np.int32), 0, table)
+    out = ex.export_blocks(np.asarray([1, 2, 3], np.int32))
+    assert tuple(out.shape) == ex.migration_shape(3)
+    # Round-trip through import (requantize path exercised elsewhere).
+    ex.import_blocks(out, np.asarray([7, 8, 9], np.int32))
+    again = ex.export_blocks(np.asarray([7, 8, 9], np.int32))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(again))
+
+
+def test_engine_e2e_deepseek():
+    """Full continuous-batching engine over the MLA family: greedy engine
+    output equals the dense oracle continuation."""
+    cfg = EngineConfig(
+        model="deepseek-tiny",
+        dtype="float32",
+        block_size=16,
+        num_blocks=64,
+        max_running_requests=4,
+        max_seq_len=256,
+        prefill_buckets=[32, 64, 128, 256],
+    )
+    ex = ModelExecutor(cfg, init_seed=11)
+    eng = InferenceEngine(cfg, executor=ex)
+    eng.start()
+    try:
+        rng = np.random.default_rng(8)
+        prompt = rng.integers(1, 500, (21,)).tolist()
+        toks = []
+        done = threading.Event()
+
+        def cb(out):
+            for so in out.outputs:
+                toks.extend(so.token_ids)
+            if out.finished:
+                done.set()
+            return True
+
+        eng.add_request(
+            EngineRequest(
+                request_id="ds-0",
+                prompt_token_ids=prompt,
+                sampling=SamplingParams(temperature=0.0, max_new_tokens=6),
+                callback=cb,
+            )
+        )
+        assert done.wait(120)
+        assert toks == _oracle_tokens(ex, prompt, 6)
+    finally:
+        eng.stop()

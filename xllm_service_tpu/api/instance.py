@@ -518,6 +518,19 @@ class InstanceServer:
             # payload to the decode peer. The engine already released the
             # sequence's slot and blocks before enqueueing this job, so a
             # slow master/peer delays only this handoff, not the engine.
+            #
+            # TOCTOU guard: send() kept the KV device-resident because a
+            # local peer existed at enqueue time; if that peer deregistered
+            # since, copy to host NOW — before the ack wait below — so a
+            # device export never sits pinned in HBM through it.
+            if (
+                handoff.kv is not None
+                and not isinstance(handoff.kv, np.ndarray)
+                and self._local_peer(decode_name) is None
+            ):
+                handoff = dataclasses.replace(
+                    handoff, kv=np.asarray(handoff.kv)
+                )
             with self._push_acked_mu:
                 acked = self._push_acked.get(srid)
             err = ""

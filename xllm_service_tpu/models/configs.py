@@ -33,10 +33,32 @@ class ModelConfig:
     sliding_window: int = 0
     # QKV projection bias (Qwen2-style).
     attn_bias: bool = False
+    # Multi-head Latent Attention (DeepSeek-V2/V3). kv_lora_rank > 0 turns
+    # MLA on: the paged cache stores ONE compressed latent row per token
+    # (kv_lora_rank + qk_rope_head_dim floats) instead of per-head K/V —
+    # e.g. 576 vs 2048 floats/token for a 70B-class GQA layout, a ~3.5x
+    # HBM/bandwidth win for long contexts.
+    kv_lora_rank: int = 0
+    q_lora_rank: int = 0  # 0 = direct q projection (V2-Lite style)
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    v_head_dim: int = 0
+    # MoE shared experts (DeepSeek style): dense FFN of
+    # n_shared_experts * moe_intermediate_size always active.
+    n_shared_experts: int = 0
 
     @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
+
+    @property
+    def is_mla(self) -> bool:
+        return self.kv_lora_rank > 0
+
+    @property
+    def mla_cache_dim(self) -> int:
+        """Latent cache floats per token: c_kv + shared RoPE key."""
+        return self.kv_lora_rank + self.qk_rope_head_dim
 
 
 _REGISTRY: Dict[str, ModelConfig] = {}
@@ -165,6 +187,51 @@ register(
 
 register(
     ModelConfig(
+        name="deepseek-tiny",
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=4,  # MLA is effectively MHA over latents
+        head_dim=32,  # unused by MLA paths (qk dims below rule)
+        # Pairwise-DISTINCT dims (kvr != dn != dv) so any transposed or
+        # double-applied projection fails shape checks instead of silently
+        # computing garbage.
+        kv_lora_rank=40,
+        q_lora_rank=48,
+        qk_nope_head_dim=32,
+        qk_rope_head_dim=16,
+        v_head_dim=24,
+        max_position_embeddings=1024,
+    )
+)
+
+register(
+    ModelConfig(
+        name="deepseek-moe-tiny",
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=4,
+        head_dim=32,
+        kv_lora_rank=40,
+        q_lora_rank=0,  # V2-Lite-style direct q projection
+        qk_nope_head_dim=32,
+        qk_rope_head_dim=16,
+        v_head_dim=24,
+        num_experts=8,
+        num_experts_per_tok=2,
+        moe_intermediate_size=64,
+        n_shared_experts=2,
+        max_position_embeddings=1024,
+    )
+)
+
+register(
+    ModelConfig(
         name="mixtral-8x7b",
         vocab_size=32000,
         hidden_size=4096,
@@ -177,5 +244,31 @@ register(
         num_experts=8,
         num_experts_per_tok=2,
         moe_intermediate_size=14336,
+    )
+)
+
+register(
+    ModelConfig(
+        name="deepseek-v3",
+        # arxiv 2412.19437 table 1 / HF config.json of DeepSeek-V3:
+        # 671B total, 37B active, MLA + 256-expert MoE with 1 shared expert.
+        vocab_size=129280,
+        hidden_size=7168,
+        intermediate_size=18432,
+        num_layers=61,
+        num_heads=128,
+        num_kv_heads=128,
+        head_dim=128,
+        kv_lora_rank=512,
+        q_lora_rank=1536,
+        qk_nope_head_dim=128,
+        qk_rope_head_dim=64,
+        v_head_dim=128,
+        rope_theta=10000.0,
+        num_experts=256,
+        num_experts_per_tok=8,
+        moe_intermediate_size=2048,
+        n_shared_experts=1,
+        rms_norm_eps=1e-6,
     )
 )

@@ -36,6 +36,13 @@ from xllm_service_tpu.ops.rope import apply_rope
 
 Params = Dict[str, Any]
 
+NUM_CACHES = 2  # separate paged K and V caches
+
+
+def cache_row_dims(cfg: ModelConfig) -> Tuple[int, int]:
+    """(heads, row_dim) of one paged-cache row: per-KV-head K/V vectors."""
+    return cfg.num_kv_heads, cfg.head_dim
+
 
 def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     """Random-init parameters (tests/bench; checkpoint loading replaces these
@@ -75,6 +82,17 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
                 "w_down": w(keys[7], (L, X, Fm, E), Fm),
             }
         )
+        if cfg.n_shared_experts > 0:
+            # Shared experts are family-agnostic (_mlp reads these for any
+            # MoE config with n_shared_experts > 0).
+            Fs = cfg.n_shared_experts * Fm
+            layers.update(
+                {
+                    "w_sh_gate": w(keys[10], (L, E, Fs), E),
+                    "w_sh_up": w(keys[11], (L, E, Fs), E),
+                    "w_sh_down": w(keys[12], (L, Fs, E), Fs),
+                }
+            )
     else:
         layers.update(
             {
@@ -127,7 +145,16 @@ def _mlp(lp: Dict[str, jnp.ndarray], cfg: ModelConfig, x: jnp.ndarray) -> jnp.nd
     gate = jnp.einsum("te,xef->txf", x, lp["w_gate"])
     up = jnp.einsum("te,xef->txf", x, lp["w_up"])
     expert_out = jnp.einsum("txf,xfe->txe", jax.nn.silu(gate) * up, lp["w_down"])
-    return jnp.einsum("txe,tx->te", expert_out, combine.astype(expert_out.dtype))
+    out = jnp.einsum("txe,tx->te", expert_out, combine.astype(expert_out.dtype))
+    if cfg.n_shared_experts > 0:
+        # DeepSeek-style always-active shared expert(s): a dense SwiGLU of
+        # n_shared * moe_intermediate width alongside the routed experts.
+        sg = jnp.einsum("te,ef->tf", x, lp["w_sh_gate"])
+        su = jnp.einsum("te,ef->tf", x, lp["w_sh_up"])
+        out = out + jnp.einsum(
+            "tf,fe->te", jax.nn.silu(sg) * su, lp["w_sh_down"]
+        )
+    return out
 
 
 def _qkv(lp, cfg: ModelConfig, x: jnp.ndarray, positions: jnp.ndarray):

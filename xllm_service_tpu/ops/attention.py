@@ -167,6 +167,90 @@ def prefill_attention_blockwise(
     return out.reshape(L, Hq, D).astype(q.dtype)
 
 
+# ----------------------------------------------------------------- MLA
+# Multi-head Latent Attention (DeepSeek-V2/V3): the paged cache stores ONE
+# compressed row per token — concat(c_kv [kv_rank], k_pe [rope_dim]) — and
+# decode runs in ABSORBED form: queries are projected into the latent space
+# (q_nope @ W_UK per head) so scores and the attention-weighted context are
+# computed directly against cache rows, with the per-head V up-projection
+# applied once to the [kv_rank] context vector. This is what makes the
+# ~3.5x-smaller cache also a bandwidth win: no per-head K/V is ever
+# materialized for cached tokens.
+
+
+def mla_paged_attention_gather(
+    q_lat: jnp.ndarray,  # [R, Hq, C] — concat(absorbed q_nope, roped q_pe)
+    c_cache,  # [N, 1, BS, C] plain or PagedKV (C = kv_rank + rope_dim)
+    block_table: jnp.ndarray,  # [R, MB] int32
+    seq_lens: jnp.ndarray,  # [R] int32 (INCLUDING current token)
+    scale: float,
+    kv_rank: int,
+) -> jnp.ndarray:
+    """Decode-step MLA attention. Returns the attention-weighted LATENT
+    context [R, Hq, kv_rank] (caller applies W_UV per head)."""
+    ctx = kvc.gather_blocks(c_cache, block_table, jnp.float32)
+    R, MB, _, BS, C = ctx.shape
+    ctx = ctx.reshape(R, MB * BS, C)
+    scores = (
+        jnp.einsum("rhc,rtc->rht", q_lat.astype(jnp.float32), ctx) * scale
+    )
+    cols = jnp.arange(MB * BS, dtype=jnp.int32)[None, None, :]
+    scores = jnp.where(cols < seq_lens[:, None, None], scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("rht,rtk->rhk", p, ctx[:, :, :kv_rank])
+    return out.astype(q_lat.dtype)
+
+
+def mla_prefill_blockwise(
+    q_lat: jnp.ndarray,  # [Lq, Hq, C] for ONE sequence's chunk
+    c_cache,  # [N, 1, BS, C]
+    block_table: jnp.ndarray,  # [CB] — sliced to the context bound
+    start_pos: jnp.ndarray,  # scalar int32
+    true_len: jnp.ndarray,  # scalar int32
+    scale: float,
+    kv_rank: int,
+) -> jnp.ndarray:
+    """Flash-style causal MLA prefill over latent blocks (online softmax,
+    O(Lq * BS) peak score memory). Returns [Lq, Hq, kv_rank]."""
+    Lq, Hq, C = q_lat.shape
+    BS = kvc.raw(c_cache).shape[2]
+    qf = q_lat.astype(jnp.float32)
+    rows = start_pos + jnp.arange(Lq, dtype=jnp.int32)
+    valid_row = jnp.arange(Lq, dtype=jnp.int32) < true_len
+
+    m0 = jnp.full((Lq, Hq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Lq, Hq, 1), jnp.float32)
+    a0 = jnp.zeros((Lq, Hq, kv_rank), jnp.float32)
+
+    def body(carry, inputs):
+        m_prev, l_prev, acc = carry
+        blk_idx, blk_id = inputs
+        blk = kvc.gather_block(c_cache, blk_id, jnp.float32)[0]  # [BS, C]
+        cols = blk_idx * BS + jnp.arange(BS, dtype=jnp.int32)
+        scores = jnp.einsum("qhc,kc->qhk", qf, blk) * scale  # [Lq, Hq, BS]
+        mask = (cols[None, :] <= rows[:, None]) & valid_row[:, None]
+        scores = jnp.where(mask[:, None, :], scores, NEG_INF)
+
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        alpha = jnp.where(m_prev <= NEG_INF / 2, 0.0, alpha)
+        p = jnp.exp(scores - m_new)
+        p = jnp.where(m_new <= NEG_INF / 2, 0.0, p)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("qhk,kc->qhc", p, blk[:, :kv_rank])
+        return (m_new, l_new, acc), None
+
+    CB = block_table.shape[0]
+    (m, l, acc), _ = jax.lax.scan(
+        body,
+        (m0, l0, a0),
+        (jnp.arange(CB, dtype=jnp.int32), block_table.astype(jnp.int32)),
+    )
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(q_lat.dtype)
+
+
 @functools.lru_cache(maxsize=1)
 def _on_tpu() -> bool:
     try:

@@ -45,16 +45,44 @@ def param_shardings(
     tp = tp_axis if tp_axis in mesh.shape else None
     layers: Dict[str, Any] = {
         "attn_norm": ns(None, None),
-        "wq": ns(None, None, tp),
-        "wk": ns(None, None, tp),
-        "wv": ns(None, None, tp),
-        "wo": ns(None, tp, None),
         "mlp_norm": ns(None, None),
     }
-    if cfg.attn_bias:
+    if cfg.is_mla:
+        # MLA (deepseek.py): the shared latent path (w_dq/w_dkv and norms)
+        # replicates — it is tiny and feeds every head; the per-head
+        # up-projections and wo shard over heads (Megatron column/row).
         layers.update(
-            {"bq": ns(None, tp), "bk": ns(None, tp), "bv": ns(None, tp)}
+            {
+                "w_dkv": ns(None, None, None),
+                "kv_norm": ns(None, None),
+                "w_uk": ns(None, tp, None, None),
+                "w_uv": ns(None, tp, None, None),
+                "wo": ns(None, tp, None),
+            }
         )
+        if cfg.q_lora_rank > 0:
+            layers.update(
+                {
+                    "w_dq": ns(None, None, None),
+                    "q_norm": ns(None, None),
+                    "w_uq": ns(None, None, tp),
+                }
+            )
+        else:
+            layers["w_q"] = ns(None, None, tp)
+    else:
+        layers.update(
+            {
+                "wq": ns(None, None, tp),
+                "wk": ns(None, None, tp),
+                "wv": ns(None, None, tp),
+                "wo": ns(None, tp, None),
+            }
+        )
+        if cfg.attn_bias:
+            layers.update(
+                {"bq": ns(None, tp), "bk": ns(None, tp), "bv": ns(None, tp)}
+            )
     if cfg.is_moe:
         ep = ep_axis if ep_axis is not None and ep_axis in mesh.shape else None
         e, t = (ep, tp) if ep is not None else (tp, None)
@@ -66,6 +94,15 @@ def param_shardings(
                 "w_down": ns(None, e, t, None),
             }
         )
+        if cfg.n_shared_experts > 0:
+            # DeepSeek shared experts: dense SwiGLU, ordinary column/row TP.
+            layers.update(
+                {
+                    "w_sh_gate": ns(None, None, tp),
+                    "w_sh_up": ns(None, None, tp),
+                    "w_sh_down": ns(None, tp, None),
+                }
+            )
     else:
         layers.update(
             {
@@ -96,7 +133,13 @@ def kv_scale_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def check_tp_divisibility(cfg: ModelConfig, tp: int, ep: int = 1) -> None:
-    if cfg.num_kv_heads % tp or cfg.num_heads % tp:
+    if cfg.is_mla:
+        # MLA: only query heads shard (the latent cache is shared/replicated).
+        if cfg.num_heads % tp:
+            raise ValueError(
+                f"tp={tp} must divide num_heads={cfg.num_heads}"
+            )
+    elif cfg.num_kv_heads % tp or cfg.num_heads % tp:
         raise ValueError(
             f"tp={tp} must divide num_heads={cfg.num_heads} and "
             f"num_kv_heads={cfg.num_kv_heads}"

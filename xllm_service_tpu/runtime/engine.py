@@ -677,11 +677,7 @@ class InferenceEngine:
                 # in-process local path (no host round-trip — the slice and
                 # import below run device-side).
                 kv = h.kv
-                c = self.executor.cfg
-                expect = (
-                    2, c.num_layers, h.num_full_blocks, c.num_kv_heads,
-                    self.block_size, c.head_dim,
-                )
+                expect = self.executor.migration_shape(h.num_full_blocks)
                 if kv.shape != expect:
                     raise ValueError(
                         f"handoff KV shape {kv.shape} != local cache layout "

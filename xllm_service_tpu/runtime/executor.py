@@ -30,7 +30,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from xllm_service_tpu.common.config import EngineConfig
-from xllm_service_tpu.models import llama
+from xllm_service_tpu import models
 from xllm_service_tpu.models.configs import ModelConfig, get_model_config
 from xllm_service_tpu.ops import sampling as sampling_ops
 from xllm_service_tpu.parallel.mesh import build_mesh
@@ -95,6 +95,10 @@ class ModelExecutor:
             )
         else:
             self.cfg = get_model_config(engine_cfg.model)
+        # Model-family dispatch (llama-style GQA vs deepseek-style MLA) —
+        # every family exports the same step-function surface.
+        self.model_mod = models.get_module(self.cfg)
+        self.num_caches = models.num_caches(self.cfg)
         self.mesh = mesh or build_mesh(
             engine_cfg.dp_size, engine_cfg.tp_size, engine_cfg.ep_size,
             engine_cfg.sp_size,
@@ -123,7 +127,15 @@ class ModelExecutor:
         p_shardings = param_shardings(
             self.cfg, self.mesh, ep_axis="ep" if ep > 1 else None
         )
-        kv_sharding = kv_cache_sharding(self.mesh)
+        # MLA's latent cache has no KV-head axis to shard — it is shared by
+        # all heads and replicated across tp (each device's head shard
+        # reads the whole latent context; ~3.5x smaller than sharded GQA
+        # K/V anyway).
+        kv_sharding = (
+            NamedSharding(self.mesh, P())
+            if self.cfg.is_mla
+            else kv_cache_sharding(self.mesh)
+        )
 
         with self.mesh:
             if engine_cfg.checkpoint_path:
@@ -134,33 +146,63 @@ class ModelExecutor:
                 )
             else:
                 init_fn = jax.jit(
-                    lambda key: llama.init_params(self.cfg, key, self.dtype),
+                    lambda key: self.model_mod.init_params(
+                        self.cfg, key, self.dtype
+                    ),
                     out_shardings=p_shardings,
                 )
                 self.params = init_fn(jax.random.key(init_seed))
 
             # [L, N, Hkv, BS, D]: KV-head-major within a block so the Pallas
             # decode kernel can DMA one (block, head) tile of shape [BS, D]
-            # with TPU-legal last-two-dims tiling.
+            # with TPU-legal last-two-dims tiling. MLA families cache one
+            # latent row per token instead: [L, N, 1, BS, C].
+            cache_heads, cache_dim = models.cache_row_dims(self.cfg)
             cache_shape = (
                 self.cfg.num_layers,
                 self.num_blocks,
-                self.cfg.num_kv_heads,
+                cache_heads,
                 self.block_size,
-                self.cfg.head_dim,
+                cache_dim,
+            )
+            scale_sharding = (
+                NamedSharding(self.mesh, P())
+                if self.cfg.is_mla
+                else kv_scale_sharding(self.mesh)
             )
             cache_sharding = kvc.PagedKV(
                 kv_sharding,
-                kv_scale_sharding(self.mesh) if self.kv_quantized else None,
+                scale_sharding if self.kv_quantized else None,
             )
-            alloc = jax.jit(
-                lambda: (
-                    kvc.alloc_cache(cache_shape, self.dtype, self.kv_quantized),
-                    kvc.alloc_cache(cache_shape, self.dtype, self.kv_quantized),
-                ),
-                out_shardings=(cache_sharding, cache_sharding),
-            )
-            self.k_cache, self.v_cache = alloc()
+            if self.num_caches == 2:
+                alloc = jax.jit(
+                    lambda: (
+                        kvc.alloc_cache(
+                            cache_shape, self.dtype, self.kv_quantized
+                        ),
+                        kvc.alloc_cache(
+                            cache_shape, self.dtype, self.kv_quantized
+                        ),
+                    ),
+                    out_shardings=(cache_sharding, cache_sharding),
+                )
+                self.k_cache, self.v_cache = alloc()
+            else:
+                # Latent cache rides the k slot; v is a 1-element dummy
+                # threaded through the step scans untouched.
+                alloc = jax.jit(
+                    lambda: kvc.alloc_cache(
+                        cache_shape, self.dtype, self.kv_quantized
+                    ),
+                    out_shardings=cache_sharding,
+                )
+                self.k_cache = alloc()
+                self.v_cache = kvc.PagedKV(
+                    jnp.zeros(
+                        (self.cfg.num_layers, 1, 1, 1, 1), self.dtype
+                    ),
+                    None,
+                )
 
         self._decode_jit = jax.jit(
             self._decode_impl, donate_argnums=(0, 1), static_argnames=("use_kernel",)
@@ -172,10 +214,10 @@ class ModelExecutor:
             # blocks [2, L, P, Hkv, BS, D] in model dtype (migration payloads
             # stay bf16 on the wire/host tiers; int8 caches requantize here).
             idx = (slice(None), ids)
-            return (
-                kvc.set_rows(k, idx, idx, blocks[0]),
-                kvc.set_rows(v, idx, idx, blocks[1]),
-            )
+            k = kvc.set_rows(k, idx, idx, blocks[0])
+            if self.num_caches == 2:
+                v = kvc.set_rows(v, idx, idx, blocks[1])
+            return k, v
 
         self._import_jit = jax.jit(_import_impl, donate_argnums=(0, 1))
         self.prefill_buckets = sorted(
@@ -214,18 +256,23 @@ class ModelExecutor:
             total_hbm * self.engine_cfg.hbm_utilization
             - n_params * bytes_per_param / tp
         ) / 2
-        # int8 cache: 1 byte/element + 4-byte f32 scale per D-row.
+        cache_heads, cache_dim = models.cache_row_dims(self.cfg)
+        # int8 cache: 1 byte/element + 4-byte f32 scale per row.
         kv_elem_bytes = (
-            1 + 4.0 / self.cfg.head_dim
-            if self.kv_quantized
-            else bytes_per_param
+            1 + 4.0 / cache_dim if self.kv_quantized else bytes_per_param
+        )
+        # MLA's latent cache is replicated (no KV-head axis to shard).
+        heads_per_dev = (
+            cache_heads
+            if self.cfg.is_mla or cache_heads < tp
+            else cache_heads // tp
         )
         block_bytes = (
-            2
+            models.num_caches(self.cfg)
             * self.cfg.num_layers
             * self.block_size
-            * (self.cfg.num_kv_heads // tp if self.cfg.num_kv_heads >= tp else self.cfg.num_kv_heads)
-            * self.cfg.head_dim
+            * heads_per_dev
+            * cache_dim
             * kv_elem_bytes
         )
         n = int(budget // block_bytes)
@@ -257,7 +304,7 @@ class ModelExecutor:
         step_keys,
         use_kernel=None,
     ):
-        logits, k_cache, v_cache = llama.decode_step(
+        logits, k_cache, v_cache = self.model_mod.decode_step(
             params,
             self.cfg,
             k_cache,
@@ -289,7 +336,7 @@ class ModelExecutor:
         mm_embeds=None,  # [P, M, E] or None
         mm_positions=None,  # [P, M] chunk-relative (pad = Lpad)
     ):
-        logits, k_cache, v_cache = llama.prefill_batch_step(
+        logits, k_cache, v_cache = self.model_mod.prefill_batch_step(
             params, self.cfg, k_cache, v_cache, token_ids, start_pos,
             true_len, block_tables,
             embed_overrides=mm_embeds, override_positions=mm_positions,
@@ -513,13 +560,15 @@ class ModelExecutor:
 
     @property
     def supports_sp(self) -> bool:
-        return self.mesh.shape.get("sp", 1) > 1
+        return self.mesh.shape.get("sp", 1) > 1 and hasattr(
+            self.model_mod, "prefill_sp_step"
+        )
 
     def _sp_impl(self, k_cache, v_cache, params, token_ids, true_len,
                  blk, off, temperature, top_k, top_p, step_key):
-        from xllm_service_tpu.models.llama import prefill_sp_step
-
-        logits, k_all, v_all = prefill_sp_step(
+        # Per-family dispatch — supports_sp already gated on the module
+        # actually providing prefill_sp_step.
+        logits, k_all, v_all = self.model_mod.prefill_sp_step(
             params, self.cfg, token_ids, true_len, self.mesh
         )
         # Scatter every token's per-layer K/V into the paged cache
@@ -657,6 +706,20 @@ class ModelExecutor:
 
     # ------------------------------------------------- KV block migration
 
+    def migration_shape(self, n_blocks: int) -> Tuple[int, ...]:
+        """Expected KV-handoff payload shape for n_blocks blocks — the PD
+        pair compatibility contract (engine validates incoming handoffs
+        against it): [num_caches, L, n, cache_heads, BS, row_dim]."""
+        ch, cd = models.cache_row_dims(self.cfg)
+        return (
+            self.num_caches,
+            self.cfg.num_layers,
+            n_blocks,
+            ch,
+            self.block_size,
+            cd,
+        )
+
     def export_blocks(self, block_ids: np.ndarray) -> jax.Array:
         """Gather KV blocks for migration to a peer instance (PD disagg).
         Returns [2, L, n, Hkv, bs, D] on device in MODEL dtype (int8 caches
@@ -672,7 +735,8 @@ class ModelExecutor:
                 )
             return cache.data[:, ids]
 
-        return jnp.stack([grab(self.k_cache), grab(self.v_cache)])
+        caches = [self.k_cache, self.v_cache][: self.num_caches]
+        return jnp.stack([grab(c) for c in caches])
 
     def import_blocks(self, blocks: jax.Array, block_ids: np.ndarray) -> None:
         """Scatter migrated/offloaded blocks into the caches IN PLACE (the
