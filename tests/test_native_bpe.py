@@ -1,0 +1,156 @@
+"""Native C++ byte-level BPE tokenizer (native/bpe_tokenizer.cpp +
+tokenizer/native_bpe.py) — exact-parity tests against the HF fast
+tokenizer on a genuine on-disk tokenizer dir (the reference implements its
+tokenizer families natively: Rust FFI / sentencepiece / tiktoken; this is
+the rebuild's native family).
+"""
+
+import json
+
+import pytest
+
+from xllm_service_tpu.tokenizer import ChatTemplate, create_tokenizer, parse_messages
+from xllm_service_tpu.tokenizer.native_bpe import NativeBPETokenizer, try_load
+from xllm_service_tpu.tokenizer.tokenizer import HFTokenizer, IncrementalDetokenizer
+
+CHATML = (
+    "{% for message in messages %}"
+    "{{ '<|im_start|>' + message['role'] + '\n' + message['content'] "
+    "+ '<|im_end|>' + '\n' }}"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}{{ '<|im_start|>assistant\n' }}{% endif %}"
+)
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "hello world, hello tokenizer 1234",
+    "don't stop believin' — it's can't won't",
+    "héllo wörld ünïcode résumé naïve",
+    "numbers 0123456789 and punctuation!?.,;:",
+    "    indented   runs\tof\nwhitespace  ",
+]
+
+SAMPLES = [
+    "hello world",
+    "the quick brown fox",
+    "don't can't won't it's",
+    "résumé naïve ünïcode — héllo",
+    "a  b   c\t\td\n\ne",
+    "punctuation!?.,;: 42 tokens 007",
+    "<|im_start|>user\nhello<|im_end|>",
+    "mixed <|endoftext|> in the middle",
+    "",
+    "🙂 emoji and ascii",
+]
+
+
+@pytest.fixture(scope="module")
+def tok_dir(tmp_path_factory):
+    from tokenizers import Tokenizer as RustTokenizer
+    from tokenizers import decoders, models, pre_tokenizers, trainers
+
+    d = tmp_path_factory.mktemp("native-bpe")
+    rt = RustTokenizer(models.BPE())
+    rt.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    rt.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=600,
+        special_tokens=["<|endoftext|>", "<|im_start|>", "<|im_end|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    rt.train_from_iterator(CORPUS, trainer)
+    rt.save(str(d / "tokenizer.json"))
+    with open(d / "tokenizer_config.json", "w") as f:
+        json.dump(
+            {
+                "tokenizer_class": "PreTrainedTokenizerFast",
+                "eos_token": "<|endoftext|>",
+                "chat_template": CHATML,
+            },
+            f,
+        )
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def pair(tok_dir):
+    native = try_load(tok_dir)
+    assert native is not None, "native BPE failed to load the fixture dir"
+    return native, HFTokenizer(tok_dir)
+
+
+def test_encode_parity(pair):
+    native, hf = pair
+    for text in SAMPLES:
+        assert native.encode(text) == hf.encode(text), text
+
+
+def test_decode_parity(pair):
+    native, hf = pair
+    for text in SAMPLES:
+        ids = hf.encode(text)
+        assert native.decode(ids, skip_special_tokens=False) == hf.decode(
+            ids, skip_special_tokens=False
+        ), text
+
+
+def test_special_token_handling(pair):
+    native, hf = pair
+    text = "<|im_start|>user\nhi<|im_end|>"
+    ids = native.encode(text)
+    assert native.token_to_id("<|im_start|>") in ids
+    # skip_special_tokens strips them on decode
+    assert "<|im_start|>" not in native.decode(ids)
+    assert "<|im_start|>" in native.decode(ids, skip_special_tokens=False)
+
+
+def test_vocab_surface(pair):
+    native, hf = pair
+    assert native.vocab_size == hf.vocab_size
+    assert native.eos_token_id == hf.token_to_id("<|endoftext|>")
+    for tok in ("<|im_end|>", "hello"):
+        if hf.token_to_id(tok) is not None:
+            assert native.token_to_id(tok) == hf.token_to_id(tok)
+
+
+def test_incremental_detok_with_native(pair):
+    native, _ = pair
+    text = "héllo wörld résumé — streaming"
+    ids = native.encode(text)
+    detok = IncrementalDetokenizer(native)
+    got = "".join(detok.push([i]) for i in ids) + detok.flush()
+    assert got == text
+
+
+def test_chat_template_renders_via_native(tok_dir):
+    tok = create_tokenizer(tok_dir)
+    assert isinstance(tok, NativeBPETokenizer)
+    ct = ChatTemplate(tok)
+    msgs = parse_messages(
+        [
+            {"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hello"},
+        ]
+    )
+    assert ct.apply(msgs) == (
+        "<|im_start|>system\nbe brief<|im_end|>\n"
+        "<|im_start|>user\nhello<|im_end|>\n"
+        "<|im_start|>assistant\n"
+    )
+
+
+def test_unsupported_model_falls_back(tmp_path):
+    """A Unigram (SentencePiece-style) tokenizer.json is outside the native
+    family — try_load returns None and the factory serves HF instead."""
+    d = tmp_path / "uni"
+    d.mkdir()
+    (d / "tokenizer.json").write_text(
+        json.dumps(
+            {
+                "model": {"type": "Unigram", "vocab": []},
+                "pre_tokenizer": None,
+            }
+        )
+    )
+    assert try_load(str(d)) is None

@@ -60,10 +60,18 @@ def tok_dir(tmp_path_factory):
     return str(d)
 
 
-def test_factory_selects_hf(tok_dir):
+def test_factory_selects_native_then_hf(tok_dir, monkeypatch):
+    """The factory prefers the native BPE family for a byte-level BPE dir
+    (reference ships native tokenizers; tokenizer_factory.cpp:9-33) and
+    falls back to transformers when forced or unsupported."""
+    from xllm_service_tpu.tokenizer.native_bpe import NativeBPETokenizer
+
     tok = create_tokenizer(tok_dir)
-    assert isinstance(tok, HFTokenizer)
-    assert tok.eos_token_id == tok.token_to_id("<|endoftext|>")
+    assert isinstance(tok, (NativeBPETokenizer, HFTokenizer))
+    monkeypatch.setenv("XLLM_NATIVE_TOKENIZER", "0")
+    tok_hf = create_tokenizer(tok_dir)
+    assert isinstance(tok_hf, HFTokenizer)
+    assert tok.eos_token_id == tok_hf.token_to_id("<|endoftext|>")
     assert tok.vocab_size > 100  # tiny corpus trains ~200 merges
 
 

@@ -1,0 +1,167 @@
+// Native byte-level BPE tokenizer core (C ABI, ctypes-bound).
+//
+// The reference ships three NATIVE tokenizer implementations (a Rust
+// HF-tokenizers FFI crate, sentencepiece_tokenizer.cpp, and
+// tiktoken_tokenizer.cpp — reference xllm_service/tokenizer/); this is the
+// TPU rebuild's native equivalent: the BPE merge loop and vocab tables —
+// the per-request hot path the service tier runs on every schedule() —
+// live here, while JSON model parsing and unicode regex pre-tokenization
+// stay in the Python wrapper (tokenizer/native_bpe.py), mirroring how the
+// reference's Rust crate delegates model parsing to the hf-tokenizers
+// library.
+//
+// Algorithm: classic lowest-rank-first pair merging over byte-level
+// initial symbols, with an unordered word cache (HF tokenizers does the
+// same) guarded by a mutex for concurrent service threads.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 bpe_tokenizer.cpp -o libxllm_bpe.so
+// (tokenizer/native_bpe.py rebuilds on demand when the .cpp is newer).
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct PairHash {
+  size_t operator()(const std::pair<int32_t, int32_t>& p) const {
+    return std::hash<uint64_t>()(
+        (static_cast<uint64_t>(static_cast<uint32_t>(p.first)) << 32) |
+        static_cast<uint32_t>(p.second));
+  }
+};
+
+struct Bpe {
+  // id -> token bytes (decode table).
+  std::vector<std::string> id_to_bytes;
+  // raw byte value -> initial symbol id.
+  int32_t byte_to_id[256];
+  // (left_id, right_id) -> {rank, merged_id}; lower rank merges first.
+  std::unordered_map<std::pair<int32_t, int32_t>,
+                     std::pair<int32_t, int32_t>, PairHash>
+      merges;
+
+  std::mutex cache_mu;
+  std::unordered_map<std::string, std::vector<int32_t>> word_cache;
+  size_t cache_cap = 1 << 16;
+
+  void encode_word(const char* data, int n, std::vector<int32_t>* out) {
+    out->clear();
+    if (n <= 0) return;
+    std::string key(data, n);
+    {
+      std::lock_guard<std::mutex> g(cache_mu);
+      auto it = word_cache.find(key);
+      if (it != word_cache.end()) {
+        *out = it->second;
+        return;
+      }
+    }
+    std::vector<int32_t>& ids = *out;
+    ids.reserve(n);
+    for (int i = 0; i < n; ++i) {
+      int32_t id = byte_to_id[static_cast<uint8_t>(data[i])];
+      if (id < 0) continue;  // byte with no token (malformed vocab): drop
+      ids.push_back(id);
+    }
+    // Lowest-rank-first merge loop. Each pass scans for the best pair and
+    // merges ALL its occurrences; word lengths are pre-tokenized short
+    // (a handful of symbols), so the quadratic bound is irrelevant.
+    while (ids.size() >= 2) {
+      int32_t best_rank = INT32_MAX, best_pos = -1, best_new = -1;
+      for (size_t i = 0; i + 1 < ids.size(); ++i) {
+        auto it = merges.find({ids[i], ids[i + 1]});
+        if (it != merges.end() && it->second.first < best_rank) {
+          best_rank = it->second.first;
+          best_pos = static_cast<int32_t>(i);
+          best_new = it->second.second;
+        }
+      }
+      if (best_pos < 0) break;
+      // Merge every non-overlapping occurrence of this exact pair (same
+      // semantics as HF: the chosen merge applies across the word).
+      int32_t l = ids[best_pos], r = ids[best_pos + 1];
+      std::vector<int32_t> next;
+      next.reserve(ids.size());
+      for (size_t i = 0; i < ids.size();) {
+        if (i + 1 < ids.size() && ids[i] == l && ids[i + 1] == r) {
+          next.push_back(best_new);
+          i += 2;
+        } else {
+          next.push_back(ids[i]);
+          i += 1;
+        }
+      }
+      ids.swap(next);
+    }
+    std::lock_guard<std::mutex> g(cache_mu);
+    if (word_cache.size() >= cache_cap) word_cache.clear();
+    word_cache.emplace(std::move(key), ids);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* xbpe_new(int32_t vocab_size) {
+  auto* b = new Bpe();
+  b->id_to_bytes.resize(vocab_size);
+  std::memset(b->byte_to_id, 0xff, sizeof(b->byte_to_id));
+  return b;
+}
+
+void xbpe_free(void* p) { delete static_cast<Bpe*>(p); }
+
+// Register a token's raw bytes under its id (decode table).
+int xbpe_set_token(void* p, int32_t id, const char* bytes, int32_t n) {
+  auto* b = static_cast<Bpe*>(p);
+  if (id < 0 || id >= static_cast<int32_t>(b->id_to_bytes.size())) return -1;
+  b->id_to_bytes[id].assign(bytes, n);
+  return 0;
+}
+
+void xbpe_set_byte_token(void* p, int32_t byte, int32_t id) {
+  auto* b = static_cast<Bpe*>(p);
+  if (byte >= 0 && byte < 256) b->byte_to_id[byte] = id;
+}
+
+void xbpe_add_merge(void* p, int32_t left, int32_t right, int32_t merged,
+                    int32_t rank) {
+  auto* b = static_cast<Bpe*>(p);
+  b->merges[{left, right}] = {rank, merged};
+}
+
+// Encode one pre-tokenized word's raw bytes. Returns the id count (may
+// exceed max_out — caller retries with a bigger buffer).
+int32_t xbpe_encode_word(void* p, const char* data, int32_t n,
+                         int32_t* out_ids, int32_t max_out) {
+  auto* b = static_cast<Bpe*>(p);
+  std::vector<int32_t> ids;
+  b->encode_word(data, n, &ids);
+  int32_t count = static_cast<int32_t>(ids.size());
+  for (int32_t i = 0; i < count && i < max_out; ++i) out_ids[i] = ids[i];
+  return count;
+}
+
+// Concatenate token bytes. Returns byte count (may exceed cap — caller
+// retries with a bigger buffer).
+int32_t xbpe_decode(void* p, const int32_t* ids, int32_t n, char* out,
+                    int32_t cap) {
+  auto* b = static_cast<Bpe*>(p);
+  int32_t total = 0;
+  for (int32_t i = 0; i < n; ++i) {
+    if (ids[i] < 0 || ids[i] >= static_cast<int32_t>(b->id_to_bytes.size()))
+      continue;
+    const std::string& s = b->id_to_bytes[ids[i]];
+    if (total + static_cast<int32_t>(s.size()) <= cap)
+      std::memcpy(out + total, s.data(), s.size());
+    total += static_cast<int32_t>(s.size());
+  }
+  return total;
+}
+
+}  // extern "C"

@@ -237,11 +237,28 @@ class ModelExecutor:
         bytes_per_param = 2 if self.engine_cfg.dtype == "bfloat16" else 4
         E, L = cfg.hidden_size, cfg.num_layers
         F = cfg.moe_intermediate_size * cfg.num_experts if cfg.is_moe else cfg.intermediate_size
+        if cfg.is_mla:
+            # MLA attention params/layer (models/deepseek.py init_params):
+            # w_dkv + w_uk/w_uv + wo + q path (LoRA'd or direct).
+            dn, dr, dv = (
+                cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+            )
+            kvr, qr, Hq = cfg.kv_lora_rank, cfg.q_lora_rank, cfg.num_heads
+            attn = (
+                E * (kvr + dr)
+                + Hq * kvr * (dn + dv)
+                + Hq * dv * E
+                + (E * qr + qr * Hq * (dn + dr) if qr else E * Hq * (dn + dr))
+            )
+        else:
+            attn = (
+                E * (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim
+                + cfg.num_heads * cfg.head_dim * E
+            )
+        mlp = 3 * E * F + 3 * E * cfg.n_shared_experts * cfg.moe_intermediate_size
         n_params = (
             cfg.vocab_size * E * (1 if cfg.tie_word_embeddings else 2)
-            + L * E * (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim
-            + L * cfg.num_heads * cfg.head_dim * E
-            + 3 * L * E * F
+            + L * (attn + mlp)
         )
         try:
             stats = jax.devices()[0].memory_stats() or {}

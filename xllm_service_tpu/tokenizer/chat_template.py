@@ -86,6 +86,30 @@ class ChatTemplate:
 
     def __init__(self, tokenizer: Optional[Tokenizer] = None):
         self._hf = tokenizer.hf if isinstance(tokenizer, HFTokenizer) else None
+        # Native tokenizers carry the model dir's raw Jinja template string
+        # (tokenizer_config.json chat_template) — compiled ONCE here (the
+        # apply() below runs on the request hot path) and rendered with the
+        # same context HF's apply_chat_template provides: special-token
+        # strings and raise_exception (stock templates use both).
+        self._compiled = None
+        self._special_ctx: Dict[str, Any] = {}
+        template = getattr(tokenizer, "chat_template", None)
+        if template and self._hf is None:
+            import jinja2
+
+            def raise_exception(message):
+                raise jinja2.exceptions.TemplateError(message)
+
+            env = jinja2.Environment(
+                trim_blocks=True, lstrip_blocks=True,
+                extensions=["jinja2.ext.loopcontrols"],
+            )
+            env.globals["raise_exception"] = raise_exception
+            self._compiled = env.from_string(template)
+            self._special_ctx = {
+                "bos_token": getattr(tokenizer, "bos_token", None) or "",
+                "eos_token": getattr(tokenizer, "eos_token", None) or "",
+            }
 
     def apply(
         self,
@@ -98,6 +122,13 @@ class ChatTemplate:
                 tools=tools,
                 tokenize=False,
                 add_generation_prompt=True,
+            )
+        if self._compiled is not None:
+            return self._compiled.render(
+                messages=[m.to_hf() for m in messages],
+                tools=tools,
+                add_generation_prompt=True,
+                **self._special_ctx,
             )
         return self._fallback(messages, tools)
 

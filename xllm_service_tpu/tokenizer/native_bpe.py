@@ -1,0 +1,342 @@
+"""Native byte-level BPE tokenizer: C++ merge core + Python model parsing.
+
+The reference implements its tokenizer families natively (Rust
+HF-tokenizers FFI, sentencepiece_tokenizer.cpp, tiktoken_tokenizer.cpp —
+reference xllm_service/tokenizer/). This is the rebuild's native family:
+`native/bpe_tokenizer.cpp` owns the hot path (BPE merge loop, vocab
+tables, word cache) behind a ctypes C ABI; this wrapper parses the HF
+`tokenizer.json` model, runs the unicode regex pre-tokenization (the
+`regex` module speaks \\p{L} classes; std::regex does not), and handles
+added/special tokens.
+
+Coverage: BPE models with ByteLevel pre-tokenization (GPT-2/Llama-3/Qwen
+style — the dominant modern family). `try_load` returns None for anything
+else (SentencePiece-Unigram models, normalizers beyond NFC/NFKC,
+add_prefix_space) and the factory falls back to transformers — correctness
+over coverage, parity-tested against HF on a real tokenizer dir.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import json
+import os
+import subprocess
+import threading
+import unicodedata
+from typing import Dict, List, Optional, Sequence
+
+import regex as _regex
+
+from xllm_service_tpu.tokenizer.tokenizer import Tokenizer
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+)
+_SRC = os.path.join(_NATIVE_DIR, "bpe_tokenizer.cpp")
+_LIB = os.path.join(_NATIVE_DIR, "libxllm_bpe.so")
+
+# GPT-2 ByteLevel pre-tokenization pattern (the default HF ByteLevel
+# regex); Llama-3-style tokenizers override it via a Split pre-tokenizer
+# whose pattern we read from tokenizer.json.
+_GPT2_PAT = (
+    r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+"
+    r"|\s+(?!\S)|\s+"
+)
+
+_build_lock = threading.Lock()
+
+
+@functools.lru_cache(maxsize=1)
+def _load_lib() -> Optional[ctypes.CDLL]:
+    with _build_lock:
+        try:
+            if not os.path.exists(_LIB) or os.path.getmtime(
+                _SRC
+            ) > os.path.getmtime(_LIB):
+                subprocess.run(
+                    [
+                        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                        _SRC, "-o", _LIB,
+                    ],
+                    check=True, capture_output=True,
+                )
+            lib = ctypes.CDLL(_LIB)
+        except Exception:
+            return None
+    P, I, C = ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p
+    IP = ctypes.POINTER(ctypes.c_int32)
+    lib.xbpe_new.restype = P
+    lib.xbpe_new.argtypes = [I]
+    lib.xbpe_free.argtypes = [P]
+    lib.xbpe_set_token.argtypes = [P, I, C, I]
+    lib.xbpe_set_token.restype = I
+    lib.xbpe_set_byte_token.argtypes = [P, I, I]
+    lib.xbpe_add_merge.argtypes = [P, I, I, I, I]
+    lib.xbpe_encode_word.argtypes = [P, C, I, IP, I]
+    lib.xbpe_encode_word.restype = I
+    lib.xbpe_decode.argtypes = [P, IP, I, C, I]
+    lib.xbpe_decode.restype = I
+    return lib
+
+
+@functools.lru_cache(maxsize=1)
+def _byte_to_unicode() -> Dict[int, str]:
+    """GPT-2 byte<->unicode alphabet (printable stand-ins for raw bytes)."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, (chr(c) for c in cs)))
+
+
+def _token_str_to_bytes(s: str) -> Optional[bytes]:
+    u2b = {c: b for b, c in _byte_to_unicode().items()}
+    out = bytearray()
+    for ch in s:
+        b = u2b.get(ch)
+        if b is None:
+            return None  # not a byte-level token
+        out.append(b)
+    return bytes(out)
+
+
+class NativeBPETokenizer(Tokenizer):
+    """HF-compatible byte-level BPE over the native C++ core."""
+
+    def __init__(self, path: str, model: dict):
+        lib = _load_lib()
+        assert lib is not None
+        self._lib = lib
+        vocab: Dict[str, int] = model["model"]["vocab"]
+        merges = model["model"]["merges"]
+        added = model.get("added_tokens") or []
+
+        self._token_to_id: Dict[str, int] = dict(vocab)
+        n_ids = max(
+            [max(vocab.values(), default=-1)]
+            + [t["id"] for t in added]
+        ) + 1
+        self._id_to_token: List[str] = [""] * n_ids
+        for tok, tid in vocab.items():
+            self._id_to_token[tid] = tok
+
+        self._bpe = lib.xbpe_new(n_ids)
+        b2u = _byte_to_unicode()
+        for tok, tid in vocab.items():
+            raw = _token_str_to_bytes(tok)
+            if raw is None:
+                raw = tok.encode("utf-8")  # non-byte-level (added) entry
+            lib.xbpe_set_token(self._bpe, tid, raw, len(raw))
+        for byte, ch in b2u.items():
+            tid = vocab.get(ch)
+            if tid is not None:
+                lib.xbpe_set_byte_token(self._bpe, byte, tid)
+        for rank, m in enumerate(merges):
+            left, right = m if isinstance(m, (list, tuple)) else m.split(" ", 1)
+            li, ri, mi = (
+                vocab.get(left), vocab.get(right), vocab.get(left + right)
+            )
+            if li is not None and ri is not None and mi is not None:
+                lib.xbpe_add_merge(self._bpe, li, ri, mi, rank)
+
+        # Added/special tokens: matched verbatim before BPE.
+        self._special_ids = set()
+        self._added: List[str] = []
+        for t in added:
+            self._token_to_id[t["content"]] = t["id"]
+            if t["id"] < n_ids:
+                self._id_to_token[t["id"]] = t["content"]
+                raw = t["content"].encode("utf-8")
+                lib.xbpe_set_token(self._bpe, t["id"], raw, len(raw))
+            self._added.append(t["content"])
+            if t.get("special"):
+                self._special_ids.add(t["id"])
+        self._added.sort(key=len, reverse=True)
+        self._added_re = (
+            _regex.compile(
+                "(" + "|".join(_regex.escape(t) for t in self._added) + ")"
+            )
+            if self._added
+            else None
+        )
+
+        self._pat = _regex.compile(self._split_pattern(model))
+        self._normalizer = self._normalizer_form(model)
+
+        # bos/eos + chat template from tokenizer_config.json. The token
+        # STRINGS are kept too — chat templates reference {{ bos_token }} /
+        # {{ eos_token }} directly.
+        self._eos_id: Optional[int] = None
+        self._bos_id: Optional[int] = None
+        self.eos_token: Optional[str] = None
+        self.bos_token: Optional[str] = None
+        cfg_path = os.path.join(path, "tokenizer_config.json")
+        self.chat_template: Optional[str] = None
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                cfg = json.load(f)
+            self.eos_token = self._named_token_str(cfg.get("eos_token"))
+            self.bos_token = self._named_token_str(cfg.get("bos_token"))
+            self._eos_id = self._named_token_id(cfg.get("eos_token"))
+            self._bos_id = self._named_token_id(cfg.get("bos_token"))
+            ct = cfg.get("chat_template")
+            if isinstance(ct, str):
+                self.chat_template = ct
+
+    def __del__(self):
+        bpe, self._bpe = getattr(self, "_bpe", None), None
+        if bpe and getattr(self, "_lib", None):
+            self._lib.xbpe_free(bpe)
+
+    # ------------------------------------------------------------- parsing
+
+    @staticmethod
+    def supported(model: dict) -> bool:
+        m = model.get("model") or {}
+        if m.get("type") != "BPE":
+            return False
+        if NativeBPETokenizer._normalizer_form(model) is False:
+            return False
+        return NativeBPETokenizer._split_pattern(model) is not None
+
+    @staticmethod
+    def _normalizer_form(model: dict):
+        """None (no-op), an NFC/NFKC form name, or False (unsupported)."""
+        nz = model.get("normalizer")
+        if nz is None:
+            return None
+        if nz.get("type") in ("NFC", "NFKC"):
+            return nz["type"]
+        return False
+
+    @staticmethod
+    def _split_pattern(model: dict) -> Optional[str]:
+        """The pre-tokenization regex, or None when unsupported."""
+        pt = model.get("pre_tokenizer")
+        if pt is None:
+            return None
+
+        def from_one(p) -> Optional[str]:
+            if p.get("type") == "ByteLevel":
+                if p.get("add_prefix_space"):
+                    return None  # changes text; fall back to HF
+                return _GPT2_PAT if p.get("use_regex", True) else ""
+            if p.get("type") == "Split":
+                pat = p.get("pattern") or {}
+                if "Regex" in pat and p.get("behavior") == "Isolated":
+                    return pat["Regex"]
+                return None
+            return None
+
+        if pt.get("type") == "Sequence":
+            pats = [from_one(p) for p in pt.get("pretokenizers", [])]
+            if any(p is None for p in pats):
+                return None
+            real = [p for p in pats if p]
+            return real[0] if len(real) == 1 else (None if real else "")
+        return from_one(pt)
+
+    @staticmethod
+    def _named_token_str(tok) -> Optional[str]:
+        if isinstance(tok, dict):
+            tok = tok.get("content")
+        return tok if isinstance(tok, str) else None
+
+    def _named_token_id(self, tok) -> Optional[int]:
+        tok = self._named_token_str(tok)
+        return self._token_to_id.get(tok) if tok is not None else None
+
+    # ------------------------------------------------------------ interface
+
+    def encode(self, text: str) -> List[int]:
+        if self._normalizer:
+            text = unicodedata.normalize(self._normalizer, text)
+        out: List[int] = []
+        segments = (
+            self._added_re.split(text) if self._added_re else [text]
+        )
+        buf = (ctypes.c_int32 * 512)()
+        for i, seg in enumerate(segments):
+            if not seg:
+                continue
+            if i % 2 == 1:  # added-token capture group
+                out.append(self._token_to_id[seg])
+                continue
+            words = (
+                self._pat.findall(seg) if self._pat.pattern else [seg]
+            )
+            for word in words:
+                raw = word.encode("utf-8")
+                n = self._lib.xbpe_encode_word(
+                    self._bpe, raw, len(raw), buf, len(buf)
+                )
+                if n > len(buf):
+                    big = (ctypes.c_int32 * n)()
+                    self._lib.xbpe_encode_word(
+                        self._bpe, raw, len(raw), big, n
+                    )
+                    out.extend(big[:n])
+                else:
+                    out.extend(buf[:n])
+        return out
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        ids = [
+            i
+            for i in ids
+            if not (skip_special_tokens and i in self._special_ids)
+        ]
+        arr = (ctypes.c_int32 * max(len(ids), 1))(*ids)
+        cap = 16 + 8 * len(ids)
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.xbpe_decode(self._bpe, arr, len(ids), buf, cap)
+        if n > cap:
+            buf = ctypes.create_string_buffer(n)
+            self._lib.xbpe_decode(self._bpe, arr, len(ids), buf, n)
+        return buf.raw[:n].decode("utf-8", errors="replace")
+
+    def id_to_token(self, token_id: int) -> str:
+        if 0 <= token_id < len(self._id_to_token):
+            return self._id_to_token[token_id]
+        return ""
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        return self._token_to_id.get(token)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._id_to_token)
+
+    @property
+    def eos_token_id(self) -> Optional[int]:
+        return self._eos_id
+
+    @property
+    def bos_token_id(self) -> Optional[int]:
+        return self._bos_id
+
+
+def try_load(path: str) -> Optional[NativeBPETokenizer]:
+    """A NativeBPETokenizer for this model dir, or None when the model is
+    outside the supported family / the native lib can't build."""
+    tj = os.path.join(path, "tokenizer.json")
+    if not os.path.isfile(tj) or _load_lib() is None:
+        return None
+    try:
+        with open(tj, encoding="utf-8") as f:
+            model = json.load(f)
+        if not NativeBPETokenizer.supported(model):
+            return None
+        return NativeBPETokenizer(path, model)
+    except Exception:
+        return None

@@ -192,7 +192,19 @@ class IncrementalDetokenizer:
 
 def create_tokenizer(path: str = "") -> Tokenizer:
     """Factory (reference: tokenizer_factory.cpp:9-33). Empty path selects
-    the byte tokenizer (tests/bench); a model dir or hub id selects HF."""
+    the byte tokenizer (tests/bench). A model dir first tries the NATIVE
+    byte-level BPE family (C++ core, tokenizer/native_bpe.py — the
+    reference's native-tokenizer analog); models outside that family
+    (SentencePiece, exotic normalizers) and hub ids fall back to
+    transformers. XLLM_NATIVE_TOKENIZER=0 forces the HF path."""
+    import os
+
     if not path or path == "byte":
         return ByteTokenizer()
+    if os.path.isdir(path) and os.environ.get("XLLM_NATIVE_TOKENIZER") != "0":
+        from xllm_service_tpu.tokenizer import native_bpe
+
+        tok = native_bpe.try_load(path)
+        if tok is not None:
+            return tok
     return HFTokenizer(path)
