@@ -73,6 +73,9 @@ def main() -> None:
         # int8 KV: halves the decode attention HBM traffic (validated
         # kernel + e2e parity in tests/test_kv_quant.py).
         kv_cache_dtype="int8" if on_tpu else "auto",
+        # Persistent jit cache: re-runs (and later rounds) skip the
+        # 20-40s-per-shape TPU compiles.
+        compilation_cache_dir="/tmp/xllm-jit-cache" if on_tpu else "",
     )
     ex = ModelExecutor(cfg)
     bs = ex.block_size

@@ -154,3 +154,71 @@ def test_unsupported_model_falls_back(tmp_path):
         )
     )
     assert try_load(str(d)) is None
+
+
+def test_ignore_merges_whole_word(tok_dir, tmp_path):
+    """Llama-3-style `ignore_merges`: a whole pre-tokenized word present in
+    the vocab must encode as that single token, bypassing the merge loop —
+    exactly what HF does (the converted merge list cannot rebuild every
+    whole-word vocab entry)."""
+    import shutil
+
+    d = tmp_path / "im"
+    shutil.copytree(tok_dir, d)
+    tj = d / "tokenizer.json"
+    model = json.loads(tj.read_text())
+    # A whole-word vocab entry (with ByteLevel space marker) that merges
+    # cannot reconstruct.
+    word = "Ġsupercalifragilistic"  # " supercalifragilistic"
+    new_id = max(model["model"]["vocab"].values()) + 1
+    model["model"]["vocab"][word] = new_id
+    model["model"]["ignore_merges"] = True
+    tj.write_text(json.dumps(model))
+
+    native = try_load(str(d))
+    assert native is not None and native._ignore_merges
+    hf = HFTokenizer(str(d))
+    text = "hello supercalifragilistic world"
+    assert native.encode(text) == hf.encode(text)
+    assert new_id in native.encode(text)
+
+
+def test_split_isolated_keeps_gaps(tmp_path):
+    """A Split/Isolated pre-tokenizer whose regex does NOT cover all input
+    must keep the uncovered spans (HF semantics); findall-style dropping
+    would lose characters."""
+    from tokenizers import Tokenizer as RustTokenizer
+    from tokenizers import decoders, models, pre_tokenizers, trainers
+
+    d = tmp_path / "split"
+    d.mkdir()
+    rt = RustTokenizer(models.BPE())
+    # Split on digit runs only; letters land in the gaps.
+    rt.pre_tokenizer = pre_tokenizers.Sequence(
+        [
+            pre_tokenizers.Split(
+                pattern=__import__("tokenizers").Regex(r"\d+"),
+                behavior="isolated",
+            ),
+            pre_tokenizers.ByteLevel(
+                add_prefix_space=False, use_regex=False
+            ),
+        ]
+    )
+    rt.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=400,
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    rt.train_from_iterator(["abc 123 def 4567 xy"] * 4, trainer)
+    rt.save(str(d / "tokenizer.json"))
+    (d / "tokenizer_config.json").write_text(
+        json.dumps({"tokenizer_class": "PreTrainedTokenizerFast"})
+    )
+    native = try_load(str(d))
+    assert native is not None
+    hf = HFTokenizer(str(d))
+    for text in ("abc 123 def", "99 monkeys 42", "no digits at all"):
+        assert native.encode(text) == hf.encode(text), text
+        assert native.decode(native.encode(text)) == text

@@ -49,11 +49,16 @@ def _tree_equal(a, b):
         get_model_config("llama3-tiny"),
         QWEN_TINY,
         get_model_config("moe-tiny"),
+        get_model_config("deepseek-tiny"),
+        get_model_config("deepseek-moe-tiny"),
     ],
-    ids=["llama", "qwen-bias", "moe"],
+    ids=["llama", "qwen-bias", "moe", "mla", "mla-moe-shared"],
 )
 def test_save_load_roundtrip(cfg, tmp_path):
-    params = llama.init_params(cfg, jax.random.key(7), jnp.bfloat16)
+    from xllm_service_tpu import models
+
+    family = models.get_module(cfg)
+    params = family.init_params(cfg, jax.random.key(7), jnp.bfloat16)
     # Give biases nonzero values so the mapping is actually exercised.
     if cfg.attn_bias:
         lp = params["layers"]
@@ -65,10 +70,14 @@ def test_save_load_roundtrip(cfg, tmp_path):
 
     loaded_cfg = weights.config_from_hf(ckpt)
     for f in ("vocab_size", "hidden_size", "num_layers", "num_heads",
-              "num_kv_heads", "head_dim", "rope_theta", "rms_norm_eps",
+              "num_kv_heads", "rope_theta", "rms_norm_eps",
               "tie_word_embeddings", "num_experts", "num_experts_per_tok",
-              "attn_bias"):
+              "attn_bias", "kv_lora_rank", "q_lora_rank",
+              "qk_nope_head_dim", "qk_rope_head_dim", "v_head_dim",
+              "n_shared_experts"):
         assert getattr(loaded_cfg, f) == getattr(cfg, f), f
+    if not cfg.is_mla:  # MLA ignores head_dim; HF derives it differently
+        assert loaded_cfg.head_dim == cfg.head_dim
 
     loaded = weights.load_checkpoint(ckpt, cfg, jnp.bfloat16)
     _tree_equal(params, loaded)
@@ -77,8 +86,8 @@ def test_save_load_roundtrip(cfg, tmp_path):
     toks = jnp.asarray(
         np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16), np.int32)
     )
-    out_a = llama.forward_dense(params, cfg, toks)
-    out_b = llama.forward_dense(loaded, cfg, toks)
+    out_a = family.forward_dense(params, cfg, toks)
+    out_b = family.forward_dense(loaded, cfg, toks)
     np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
 
 

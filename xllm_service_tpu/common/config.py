@@ -123,6 +123,13 @@ class EngineConfig:
     # Sampling defaults.
     max_new_tokens_default: int = 512
 
+    # Persistent XLA compilation cache dir ("" disables). First boot of a
+    # shape-bucketed engine compiles tens of programs at 20-40 s each on
+    # TPU; with the cache, every later boot (restart, PD role flip to an
+    # already-seen traffic shape, elastic scale-out on shared storage)
+    # loads them in milliseconds — SURVEY.md §7 hard part 4.
+    compilation_cache_dir: str = ""
+
     # Host offload (DRAM tier) blocks; 0 disables.
     num_host_blocks: int = 0
     # SSD tier: blocks spilled from the host pool to local disk; 0 disables.

@@ -72,6 +72,33 @@ class PrefillItem:
     mm_positions: Optional[np.ndarray] = None
 
 
+_COMPILATION_CACHE_DIR: Optional[str] = None
+
+
+def _setup_compilation_cache(cache_dir: str) -> None:
+    """Set the process-global persistent jit cache ONCE (restarts / PD
+    role flips / elastic scale-outs then skip the 20-40 s/shape TPU
+    compiles). The jax config is process-global, so first non-empty dir
+    wins; a co-resident engine asking for a DIFFERENT dir gets a warning
+    and shares the first (an engine with "" simply doesn't call this —
+    it cannot unset what another engine enabled)."""
+    global _COMPILATION_CACHE_DIR
+    if _COMPILATION_CACHE_DIR is not None:
+        if _COMPILATION_CACHE_DIR != cache_dir:
+            import warnings
+
+            warnings.warn(
+                f"compilation_cache_dir={cache_dir!r} ignored: process "
+                f"already caches to {_COMPILATION_CACHE_DIR!r} (jax "
+                f"config is process-global)",
+                stacklevel=3,
+            )
+        return
+    _COMPILATION_CACHE_DIR = cache_dir
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
 class ModelExecutor:
     def __init__(
         self,
@@ -108,6 +135,8 @@ class ModelExecutor:
         if tp > 1 or ep > 1:
             check_tp_divisibility(self.cfg, tp, ep)
 
+        if engine_cfg.compilation_cache_dir:
+            _setup_compilation_cache(engine_cfg.compilation_cache_dir)
         self.dtype = jnp.bfloat16 if engine_cfg.dtype == "bfloat16" else jnp.float32
         # int8 KV cache: halves decode's HBM traffic (the bound resource);
         # params/activations stay in model dtype.

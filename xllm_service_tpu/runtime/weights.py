@@ -162,6 +162,33 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
             num_experts_per_tok=hf["num_experts_per_tok"],
             moe_intermediate_size=hf["intermediate_size"],
         )
+    elif arch in ("DeepseekV2ForCausalLM", "DeepseekV3ForCausalLM"):
+        # MLA family. Heterogeneous layer stacks (first_k_dense_replace /
+        # moe_layer_freq) are out of scope for the scan-stacked layout —
+        # fail loudly rather than mis-mapping.
+        if (
+            int(hf.get("first_k_dense_replace") or 0) > 0
+            or int(hf.get("moe_layer_freq") or 1) != 1
+        ):
+            raise NotImplementedError(
+                "DeepSeek checkpoints with first_k_dense_replace > 0 or "
+                "moe_layer_freq != 1 mix dense and MoE layers; the "
+                "stacked-layer pytree requires a homogeneous stack"
+            )
+        common.update(
+            kv_lora_rank=hf["kv_lora_rank"],
+            q_lora_rank=int(hf.get("q_lora_rank") or 0),
+            qk_nope_head_dim=hf["qk_nope_head_dim"],
+            qk_rope_head_dim=hf["qk_rope_head_dim"],
+            v_head_dim=hf["v_head_dim"],
+        )
+        if int(hf.get("n_routed_experts") or 0) > 0:
+            common.update(
+                num_experts=hf["n_routed_experts"],
+                num_experts_per_tok=hf["num_experts_per_tok"],
+                moe_intermediate_size=hf["moe_intermediate_size"],
+                n_shared_experts=int(hf.get("n_shared_experts") or 0),
+            )
     elif arch != "LlamaForCausalLM":
         raise ValueError(f"unsupported architecture {arch!r}")
     return ModelConfig(**common)
@@ -208,45 +235,98 @@ def _hf_leaf(cfg: ModelConfig, hf_name: str):
         "mlp.down_proj.weight": ("layers.w_down", True),
         "block_sparse_moe.gate.weight": ("layers.router", True),
     }
+    if cfg.is_mla:
+        # DeepSeek-V2/V3 MLA projections. q_proj is the direct-q (V2-Lite)
+        # form and maps to w_q; kv_b_proj carries the per-head k_nope AND v
+        # up-projections interleaved per head — staged whole under a pseudo
+        # leaf and split into w_uk/w_uv after all shards land.
+        simple.update(
+            {
+                "self_attn.q_proj.weight": ("layers.w_q", True),
+                "self_attn.q_a_proj.weight": ("layers.w_dq", True),
+                "self_attn.q_a_layernorm.weight": ("layers.q_norm", False),
+                "self_attn.q_b_proj.weight": ("layers.w_uq", True),
+                "self_attn.kv_a_proj_with_mqa.weight": ("layers.w_dkv", True),
+                "self_attn.kv_a_layernorm.weight": ("layers.kv_norm", False),
+                "self_attn.kv_b_proj.weight": ("layers._w_ukv", True),
+                "mlp.gate.weight": ("layers.router", True),
+                "mlp.shared_experts.gate_proj.weight": ("layers.w_sh_gate", True),
+                "mlp.shared_experts.up_proj.weight": ("layers.w_sh_up", True),
+                "mlp.shared_experts.down_proj.weight": ("layers.w_sh_down", True),
+            }
+        )
     if tail in simple:
         key, transpose = simple[tail]
         return (key, layer, None, transpose)
-    if tail.startswith("block_sparse_moe.experts."):
-        sub = tail[len("block_sparse_moe.experts."):]
-        expert_s, _, w = sub.partition(".")
-        expert = int(expert_s)
-        moe = {
-            "w1.weight": "layers.w_gate",  # gate_proj
-            "w3.weight": "layers.w_up",  # up_proj
-            "w2.weight": "layers.w_down",  # down_proj
-        }
-        if w in moe:
-            return (moe[w], layer, expert, True)
+    for prefix in ("block_sparse_moe.experts.", "mlp.experts."):
+        if tail.startswith(prefix):
+            sub = tail[len(prefix):]
+            expert_s, _, w = sub.partition(".")
+            expert = int(expert_s)
+            moe = {
+                "w1.weight": "layers.w_gate",  # gate_proj (mixtral names)
+                "w3.weight": "layers.w_up",  # up_proj
+                "w2.weight": "layers.w_down",  # down_proj
+                "gate_proj.weight": "layers.w_gate",  # deepseek names
+                "up_proj.weight": "layers.w_up",
+                "down_proj.weight": "layers.w_down",
+            }
+            if w in moe:
+                return (moe[w], layer, expert, True)
     return None
 
 
 def _leaf_shapes(cfg: ModelConfig) -> Dict[str, Tuple[int, ...]]:
-    """Target (host staging) shape per leaf key — mirrors llama.init_params."""
+    """Target (host staging) shape per leaf key — mirrors the family
+    module's init_params. For MLA, the kv_b up-projection stages under the
+    pseudo leaf `layers._w_ukv` (HF interleaves k_nope and v per head in
+    one tensor); load_checkpoint splits it into w_uk/w_uv afterwards."""
     E, L = cfg.hidden_size, cfg.num_layers
     Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     shapes: Dict[str, Tuple[int, ...]] = {
         "embed": (cfg.vocab_size, E),
         "final_norm": (E,),
         "layers.attn_norm": (L, E),
-        "layers.wq": (L, E, Hq * D),
-        "layers.wk": (L, E, Hkv * D),
-        "layers.wv": (L, E, Hkv * D),
-        "layers.wo": (L, Hq * D, E),
         "layers.mlp_norm": (L, E),
     }
-    if cfg.attn_bias:
+    if cfg.is_mla:
+        dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+        kvr, qr = cfg.kv_lora_rank, cfg.q_lora_rank
         shapes.update(
             {
-                "layers.bq": (L, Hq * D),
-                "layers.bk": (L, Hkv * D),
-                "layers.bv": (L, Hkv * D),
+                "layers.w_dkv": (L, E, kvr + dr),
+                "layers.kv_norm": (L, kvr),
+                "layers._w_ukv": (L, kvr, Hq * (dn + dv)),
+                "layers.wo": (L, Hq * dv, E),
             }
         )
+        if qr > 0:
+            shapes.update(
+                {
+                    "layers.w_dq": (L, E, qr),
+                    "layers.q_norm": (L, qr),
+                    "layers.w_uq": (L, qr, Hq * (dn + dr)),
+                }
+            )
+        else:
+            shapes["layers.w_q"] = (L, E, Hq * (dn + dr))
+    else:
+        shapes.update(
+            {
+                "layers.wq": (L, E, Hq * D),
+                "layers.wk": (L, E, Hkv * D),
+                "layers.wv": (L, E, Hkv * D),
+                "layers.wo": (L, Hq * D, E),
+            }
+        )
+        if cfg.attn_bias:
+            shapes.update(
+                {
+                    "layers.bq": (L, Hq * D),
+                    "layers.bk": (L, Hkv * D),
+                    "layers.bv": (L, Hkv * D),
+                }
+            )
     if cfg.is_moe:
         X, Fm = cfg.num_experts, cfg.moe_intermediate_size
         shapes.update(
@@ -257,6 +337,15 @@ def _leaf_shapes(cfg: ModelConfig) -> Dict[str, Tuple[int, ...]]:
                 "layers.w_down": (L, X, Fm, E),
             }
         )
+        if cfg.n_shared_experts > 0:
+            Fs = cfg.n_shared_experts * Fm
+            shapes.update(
+                {
+                    "layers.w_sh_gate": (L, E, Fs),
+                    "layers.w_sh_up": (L, E, Fs),
+                    "layers.w_sh_down": (L, Fs, E),
+                }
+            )
     else:
         F = cfg.intermediate_size
         shapes.update(
@@ -271,7 +360,13 @@ def _leaf_shapes(cfg: ModelConfig) -> Dict[str, Tuple[int, ...]]:
     return shapes
 
 
-_NORM_LEAVES = {"final_norm", "layers.attn_norm", "layers.mlp_norm"}
+_NORM_LEAVES = {
+    "final_norm",
+    "layers.attn_norm",
+    "layers.mlp_norm",
+    "layers.kv_norm",
+    "layers.q_norm",
+}
 
 
 def load_checkpoint(
@@ -340,6 +435,21 @@ def load_checkpoint(
     if missing:
         raise ValueError(f"checkpoint {path} is missing tensors for {missing}")
 
+    if cfg.is_mla:
+        # Split HF's interleaved kv_b up-projection into the absorbed-form
+        # tensors the model consumes: [L, kvr, Hq*(dn+dv)] ->
+        # w_uk [L, Hq, kvr, dn] + w_uv [L, Hq, kvr, dv].
+        dn, dv = cfg.qk_nope_head_dim, cfg.v_head_dim
+        raw = staging.pop("layers._w_ukv").reshape(
+            cfg.num_layers, cfg.kv_lora_rank, cfg.num_heads, dn + dv
+        )
+        staging["layers.w_uk"] = np.ascontiguousarray(
+            np.transpose(raw[..., :dn], (0, 2, 1, 3))
+        )
+        staging["layers.w_uv"] = np.ascontiguousarray(
+            np.transpose(raw[..., dn:], (0, 2, 1, 3))
+        )
+
     params: Params = {"layers": {}}
     for key, buf in staging.items():
         leaf = jnp.asarray(buf)
@@ -364,18 +474,23 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
     model.safetensors) — the inverse of load_checkpoint. Used by the
     round-trip test and for exporting synthetic checkpoints."""
     os.makedirs(path, exist_ok=True)
-    arch = (
-        "MixtralForCausalLM"
-        if cfg.is_moe
-        else ("Qwen2ForCausalLM" if cfg.attn_bias else "LlamaForCausalLM")
-    )
+    if cfg.is_mla:
+        arch = "DeepseekV2ForCausalLM"
+    elif cfg.is_moe:
+        arch = "MixtralForCausalLM"
+    elif cfg.attn_bias:
+        arch = "Qwen2ForCausalLM"
+    else:
+        arch = "LlamaForCausalLM"
     hf_cfg = {
         "architectures": [arch],
         "model_type": arch[: -len("ForCausalLM")].lower(),
         "vocab_size": cfg.vocab_size,
         "hidden_size": cfg.hidden_size,
         "intermediate_size": (
-            cfg.moe_intermediate_size if cfg.is_moe else cfg.intermediate_size
+            cfg.moe_intermediate_size
+            if (cfg.is_moe and not cfg.is_mla)
+            else cfg.intermediate_size
         ),
         "num_hidden_layers": cfg.num_layers,
         "num_attention_heads": cfg.num_heads,
@@ -386,7 +501,23 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
         "max_position_embeddings": cfg.max_position_embeddings,
         "tie_word_embeddings": cfg.tie_word_embeddings,
     }
-    if cfg.is_moe:
+    if cfg.is_mla:
+        hf_cfg.update(
+            kv_lora_rank=cfg.kv_lora_rank,
+            q_lora_rank=cfg.q_lora_rank or None,
+            qk_nope_head_dim=cfg.qk_nope_head_dim,
+            qk_rope_head_dim=cfg.qk_rope_head_dim,
+            v_head_dim=cfg.v_head_dim,
+            first_k_dense_replace=0,
+        )
+        if cfg.is_moe:
+            hf_cfg.update(
+                n_routed_experts=cfg.num_experts,
+                num_experts_per_tok=cfg.num_experts_per_tok,
+                moe_intermediate_size=cfg.moe_intermediate_size,
+                n_shared_experts=cfg.n_shared_experts,
+            )
+    elif cfg.is_moe:
         hf_cfg["num_local_experts"] = cfg.num_experts
         hf_cfg["num_experts_per_tok"] = cfg.num_experts_per_tok
     if cfg.sliding_window:
@@ -409,21 +540,65 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
         pre = f"model.layers.{i}."
         tensors[pre + "input_layernorm.weight"] = host(lp["attn_norm"])[i]
         tensors[pre + "post_attention_layernorm.weight"] = host(lp["mlp_norm"])[i]
-        tensors[pre + "self_attn.q_proj.weight"] = host(lp["wq"])[i].T
-        tensors[pre + "self_attn.k_proj.weight"] = host(lp["wk"])[i].T
-        tensors[pre + "self_attn.v_proj.weight"] = host(lp["wv"])[i].T
-        tensors[pre + "self_attn.o_proj.weight"] = host(lp["wo"])[i].T
-        if cfg.attn_bias:
-            tensors[pre + "self_attn.q_proj.bias"] = host(lp["bq"])[i]
-            tensors[pre + "self_attn.k_proj.bias"] = host(lp["bk"])[i]
-            tensors[pre + "self_attn.v_proj.bias"] = host(lp["bv"])[i]
+        if cfg.is_mla:
+            dn, dv = cfg.qk_nope_head_dim, cfg.v_head_dim
+            kvr, Hq = cfg.kv_lora_rank, cfg.num_heads
+            tensors[pre + "self_attn.kv_a_proj_with_mqa.weight"] = host(
+                lp["w_dkv"]
+            )[i].T
+            tensors[pre + "self_attn.kv_a_layernorm.weight"] = host(
+                lp["kv_norm"]
+            )[i]
+            # Re-interleave w_uk/w_uv per head into HF's kv_b_proj layout
+            # [Hq*(dn+dv), kvr] (the inverse of load_checkpoint's split).
+            uk = np.transpose(host(lp["w_uk"])[i], (1, 0, 2))  # [kvr,Hq,dn]
+            uv = np.transpose(host(lp["w_uv"])[i], (1, 0, 2))  # [kvr,Hq,dv]
+            kv_b = np.concatenate([uk, uv], axis=-1).reshape(
+                kvr, Hq * (dn + dv)
+            )
+            tensors[pre + "self_attn.kv_b_proj.weight"] = kv_b.T
+            if cfg.q_lora_rank > 0:
+                tensors[pre + "self_attn.q_a_proj.weight"] = host(lp["w_dq"])[i].T
+                tensors[pre + "self_attn.q_a_layernorm.weight"] = host(
+                    lp["q_norm"]
+                )[i]
+                tensors[pre + "self_attn.q_b_proj.weight"] = host(lp["w_uq"])[i].T
+            else:
+                tensors[pre + "self_attn.q_proj.weight"] = host(lp["w_q"])[i].T
+            tensors[pre + "self_attn.o_proj.weight"] = host(lp["wo"])[i].T
+        else:
+            tensors[pre + "self_attn.q_proj.weight"] = host(lp["wq"])[i].T
+            tensors[pre + "self_attn.k_proj.weight"] = host(lp["wk"])[i].T
+            tensors[pre + "self_attn.v_proj.weight"] = host(lp["wv"])[i].T
+            tensors[pre + "self_attn.o_proj.weight"] = host(lp["wo"])[i].T
+            if cfg.attn_bias:
+                tensors[pre + "self_attn.q_proj.bias"] = host(lp["bq"])[i]
+                tensors[pre + "self_attn.k_proj.bias"] = host(lp["bk"])[i]
+                tensors[pre + "self_attn.v_proj.bias"] = host(lp["bv"])[i]
         if cfg.is_moe:
-            tensors[pre + "block_sparse_moe.gate.weight"] = host(lp["router"])[i].T
+            gate_name, exp_pre, w_names = (
+                ("mlp.gate.weight", "mlp.experts.",
+                 ("gate_proj.weight", "up_proj.weight", "down_proj.weight"))
+                if cfg.is_mla
+                else ("block_sparse_moe.gate.weight", "block_sparse_moe.experts.",
+                      ("w1.weight", "w3.weight", "w2.weight"))
+            )
+            tensors[pre + gate_name] = host(lp["router"])[i].T
             for j in range(cfg.num_experts):
-                ep = pre + f"block_sparse_moe.experts.{j}."
-                tensors[ep + "w1.weight"] = host(lp["w_gate"])[i, j].T
-                tensors[ep + "w3.weight"] = host(lp["w_up"])[i, j].T
-                tensors[ep + "w2.weight"] = host(lp["w_down"])[i, j].T
+                ep = pre + exp_pre + f"{j}."
+                tensors[ep + w_names[0]] = host(lp["w_gate"])[i, j].T
+                tensors[ep + w_names[1]] = host(lp["w_up"])[i, j].T
+                tensors[ep + w_names[2]] = host(lp["w_down"])[i, j].T
+            if cfg.n_shared_experts > 0:
+                tensors[pre + "mlp.shared_experts.gate_proj.weight"] = host(
+                    lp["w_sh_gate"]
+                )[i].T
+                tensors[pre + "mlp.shared_experts.up_proj.weight"] = host(
+                    lp["w_sh_up"]
+                )[i].T
+                tensors[pre + "mlp.shared_experts.down_proj.weight"] = host(
+                    lp["w_sh_down"]
+                )[i].T
         else:
             tensors[pre + "mlp.gate_proj.weight"] = host(lp["w_gate"])[i].T
             tensors[pre + "mlp.up_proj.weight"] = host(lp["w_up"])[i].T

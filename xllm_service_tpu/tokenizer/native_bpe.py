@@ -82,6 +82,11 @@ def _load_lib() -> Optional[ctypes.CDLL]:
 
 
 @functools.lru_cache(maxsize=1)
+def _unicode_to_byte() -> Dict[str, int]:
+    return {c: b for b, c in _byte_to_unicode().items()}
+
+
+@functools.lru_cache(maxsize=1)
 def _byte_to_unicode() -> Dict[int, str]:
     """GPT-2 byte<->unicode alphabet (printable stand-ins for raw bytes)."""
     bs = (
@@ -100,7 +105,7 @@ def _byte_to_unicode() -> Dict[int, str]:
 
 
 def _token_str_to_bytes(s: str) -> Optional[bytes]:
-    u2b = {c: b for b, c in _byte_to_unicode().items()}
+    u2b = _unicode_to_byte()  # cached — called once per vocab entry
     out = bytearray()
     for ch in s:
         b = u2b.get(ch)
@@ -108,6 +113,11 @@ def _token_str_to_bytes(s: str) -> Optional[bytes]:
             return None  # not a byte-level token
         out.append(b)
     return bytes(out)
+
+
+def _bytes_to_token_str(raw: bytes) -> str:
+    b2u = _byte_to_unicode()
+    return "".join(b2u[b] for b in raw)
 
 
 class NativeBPETokenizer(Tokenizer):
@@ -172,6 +182,10 @@ class NativeBPETokenizer(Tokenizer):
 
         self._pat = _regex.compile(self._split_pattern(model))
         self._normalizer = self._normalizer_form(model)
+        # Llama-3-style BPE: whole pre-tokenized words that exist in the
+        # vocab bypass the merge loop (the converted merge list cannot
+        # reconstruct every whole-word entry).
+        self._ignore_merges = bool(model["model"].get("ignore_merges"))
 
         # bos/eos + chat template from tokenizer_config.json. The token
         # STRINGS are kept too — chat templates reference {{ bos_token }} /
@@ -258,6 +272,25 @@ class NativeBPETokenizer(Tokenizer):
 
     # ------------------------------------------------------------ interface
 
+    def _pretokenize(self, seg: str) -> List[str]:
+        """Isolated-split semantics: matched spans AND the gaps between
+        them (a Split regex need not cover every character — HF keeps
+        unmatched spans as their own segments; findall would drop them,
+        and would return groups for patterns with capture groups)."""
+        if not self._pat.pattern:
+            return [seg]
+        words: List[str] = []
+        pos = 0
+        for m in self._pat.finditer(seg):
+            if m.start() > pos:
+                words.append(seg[pos:m.start()])
+            if m.group(0):
+                words.append(m.group(0))
+            pos = m.end()
+        if pos < len(seg):
+            words.append(seg[pos:])
+        return words
+
     def encode(self, text: str) -> List[int]:
         if self._normalizer:
             text = unicodedata.normalize(self._normalizer, text)
@@ -272,11 +305,13 @@ class NativeBPETokenizer(Tokenizer):
             if i % 2 == 1:  # added-token capture group
                 out.append(self._token_to_id[seg])
                 continue
-            words = (
-                self._pat.findall(seg) if self._pat.pattern else [seg]
-            )
-            for word in words:
+            for word in self._pretokenize(seg):
                 raw = word.encode("utf-8")
+                if self._ignore_merges:
+                    whole = self._token_to_id.get(_bytes_to_token_str(raw))
+                    if whole is not None:
+                        out.append(whole)
+                        continue
                 n = self._lib.xbpe_encode_word(
                     self._bpe, raw, len(raw), buf, len(buf)
                 )
