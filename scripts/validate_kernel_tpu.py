@@ -83,6 +83,37 @@ def run_case(R, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16, chunk=4,
     return err
 
 
+def run_mla_case(R, Hq, kvr, dr, BS, MB, ctx, dtype=jnp.bfloat16):
+    """MLA decode kernel vs the MLA gather oracle on hardware."""
+    from xllm_service_tpu.ops.attention import mla_paged_attention_gather
+    from xllm_service_tpu.ops.pallas.mla_attention import mla_attention_kernel
+
+    rng = np.random.default_rng(0)
+    C = kvr + dr
+    N = R * MB + 1
+    q = jnp.asarray(rng.standard_normal((R, Hq, C)), dtype)
+    cache = jnp.asarray(rng.standard_normal((N, 1, BS, C)), dtype)
+    bt = jnp.asarray(1 + np.arange(R * MB).reshape(R, MB) % (N - 1), jnp.int32)
+    lens = jnp.asarray(
+        np.clip(rng.integers(ctx // 2, ctx + 1, R), 1, MB * BS), jnp.int32
+    )
+    scale = C**-0.5
+    ker = lambda: mla_attention_kernel(q, cache, bt, lens, scale, kvr)
+    gat = lambda: mla_paged_attention_gather(q, cache, bt, lens, scale, kvr)
+    err = float(
+        np.max(np.abs(np.asarray(ker().astype(jnp.float32))
+                      - np.asarray(gat().astype(jnp.float32))))
+    )
+    tk, tg = bench(ker), bench(gat)
+    bw = float(np.sum(np.asarray(lens))) * C * dtype.dtype.itemsize / tk / 1e9
+    print(
+        f"MLA R={R:3d} Hq={Hq} kvr={kvr} dr={dr} BS={BS} MB={MB} ctx~{ctx} "
+        f"err={err:.4f} kernel={tk*1e6:8.1f}us gather={tg*1e6:8.1f}us "
+        f"speedup={tg/tk:5.2f}x bw={bw:6.1f}GB/s"
+    )
+    return err
+
+
 def main():
     print(f"backend={jax.default_backend()} device={jax.devices()[0]}")
     assert jax.default_backend() == "tpu"
@@ -104,6 +135,11 @@ def main():
         # failure on-chip); ops/attention.py falls back to gather there.
     ]:
         errs.append(run_case(**case))
+    # MLA decode kernel (DeepSeek-V3 geometry: kvr=512, dr=64, Hq=128).
+    errs.append(run_mla_case(R=32, Hq=128, kvr=512, dr=64, BS=128, MB=16,
+                             ctx=2048))
+    errs.append(run_mla_case(R=8, Hq=16, kvr=160, dr=32, BS=128, MB=32,
+                             ctx=4096))
     assert max(errs) < 0.05, f"parity FAIL: {errs}"
     print("PARITY OK")
 

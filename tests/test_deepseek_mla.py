@@ -177,3 +177,45 @@ def test_engine_e2e_deepseek():
         assert toks == _oracle_tokens(ex, prompt, 6)
     finally:
         eng.stop()
+
+
+def test_mla_pallas_kernel_interpret_parity():
+    """The MLA Pallas decode kernel (one program per sequence, latent
+    streaming, online softmax) vs the gather oracle, interpret mode —
+    V3-like shapes scaled down (C=192 exercises the non-128-multiple lane
+    dim; Hq=16 exercises head padding is a no-op at multiples of 8)."""
+    from xllm_service_tpu.ops.attention import mla_paged_attention_gather
+    from xllm_service_tpu.ops.pallas.mla_attention import mla_attention_kernel
+
+    rng = np.random.default_rng(6)
+    R, Hq, BS, MB, kvr, dr = 3, 16, 16, 4, 160, 32
+    C = kvr + dr
+    N = R * MB + 1
+    q = jnp.asarray(rng.standard_normal((R, Hq, C)), jnp.float32)
+    cache = jnp.asarray(rng.standard_normal((N, 1, BS, C)), jnp.float32)
+    bt = jnp.asarray(1 + np.arange(R * MB).reshape(R, MB), jnp.int32)
+    lens = jnp.asarray([37, 64, 9], jnp.int32)
+    scale = C**-0.5
+    out_k = mla_attention_kernel(
+        q, cache, bt, lens, scale, kvr, interpret=True
+    )
+    out_g = mla_paged_attention_gather(q, cache, bt, lens, scale, kvr)
+    np.testing.assert_allclose(
+        np.asarray(out_k), np.asarray(out_g), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_mla_dispatcher_kernel_flag():
+    """use_kernel=True routes decode through the Pallas path end-to-end
+    (interpret on CPU is exercised above; here we only pin the dispatcher
+    contract: explicit False forces gather and matches default)."""
+    from xllm_service_tpu.ops.attention import mla_paged_attention
+
+    rng = np.random.default_rng(7)
+    q = jnp.asarray(rng.standard_normal((2, 4, 48)), jnp.float32)
+    cache = jnp.asarray(rng.standard_normal((5, 1, 16, 48)), jnp.float32)
+    bt = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    lens = jnp.asarray([20, 32], jnp.int32)
+    a = mla_paged_attention(q, cache, bt, lens, 0.2, 40, use_kernel=False)
+    b = mla_paged_attention(q, cache, bt, lens, 0.2, 40)  # default: gather
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

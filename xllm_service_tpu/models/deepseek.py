@@ -34,7 +34,7 @@ from xllm_service_tpu.models.configs import ModelConfig
 from xllm_service_tpu.models.llama import _mlp, _unembed
 from xllm_service_tpu.ops import kv_cache as kv_cache_ops
 from xllm_service_tpu.ops.attention import (
-    mla_paged_attention_gather,
+    mla_paged_attention,
     mla_prefill_blockwise,
 )
 from xllm_service_tpu.ops.norms import rms_norm
@@ -192,8 +192,9 @@ def decode_step(
         rows = _latent_rows(lp, cfg, h, positions)
         c_l = kv_cache_ops.scatter_rows(c_l, blk, offset, rows[:, None, :])
         q_lat = _absorb_q(lp, q_nope, q_pe)
-        ctx = mla_paged_attention_gather(
-            q_lat, c_l, block_tables, seq_lens, scale, kvr
+        ctx = mla_paged_attention(
+            q_lat, c_l, block_tables, seq_lens, scale, kvr,
+            use_kernel=use_kernel,
         )
         x = x + _attn_out(lp, cfg, ctx)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)

@@ -201,6 +201,33 @@ def mla_paged_attention_gather(
     return out.astype(q_lat.dtype)
 
 
+def mla_paged_attention(
+    q_lat, c_cache, block_table, seq_lens, scale, kv_rank,
+    use_kernel: bool | None = None,
+):
+    """Decode MLA attention; Pallas kernel on TPU (opt-in via
+    XLLM_MLA_ATTENTION_KERNEL=1 until validated on hardware — the GQA
+    kernel went through the same gate in round 1), gather elsewhere.
+    Quantized latent caches use the gather path (no int8 MLA kernel yet)."""
+    import os
+
+    env = os.environ.get("XLLM_MLA_ATTENTION_KERNEL")
+    if use_kernel is None:
+        kq = isinstance(c_cache, kvc.PagedKV) and c_cache.quantized
+        use_kernel = env == "1" and _on_tpu() and not kq
+    if use_kernel:
+        from xllm_service_tpu.ops.pallas.mla_attention import (
+            mla_attention_kernel,
+        )
+
+        return mla_attention_kernel(
+            q_lat, kvc.raw(c_cache), block_table, seq_lens, scale, kv_rank
+        )
+    return mla_paged_attention_gather(
+        q_lat, c_cache, block_table, seq_lens, scale, kv_rank
+    )
+
+
 def mla_prefill_blockwise(
     q_lat: jnp.ndarray,  # [Lq, Hq, C] for ONE sequence's chunk
     c_cache,  # [N, 1, BS, C]

@@ -1,0 +1,188 @@
+"""Pallas TPU decode kernel for Multi-head Latent Attention (DeepSeek).
+
+The MLA decode op (ops/attention.mla_paged_attention_gather) is, like GQA
+decode, HBM-bandwidth-bound — but its traffic is the compressed latent
+cache (kv_rank + rope_dim floats/token, shared by ALL heads), so the
+gather fallback's weakness is different: XLA materializes the gathered
+context [R, MB*BS, C] per layer in HBM before the einsum. This kernel
+streams the sequence's latent blocks HBM→VMEM once and fuses scores +
+online softmax + latent-context accumulation, never materializing the
+gathered context.
+
+Design (one program per SEQUENCE — no head axis in the grid):
+  * the latents are shared across heads, so all Hq heads' scores for a
+    chunk come from ONE [Hqp, C] x [C, T] matmul — MXU-shaped (Hq is 128
+    for DeepSeek-V3); the grid is just (R,).
+  * double-buffered chunk DMA with scalar-prefetched block tables, same
+    scheme as the GQA kernel (ops/pallas/paged_attention.py).
+  * pv accumulates in LATENT space ([Hqp, kv_rank]) — W_UV is applied by
+    the caller once per token, outside the kernel, exactly like the
+    absorbed gather path.
+
+Cache layout: c_cache [N, 1, BS, C] (ops/attention.py MLA contract);
+q_lat [R, Hq, C]; block_table [R, MB]; seq_lens [R]. Returns
+[R, Hq, kv_rank]. C (576 for V3) need not be a multiple of 128 — Mosaic
+lane-pads the VMEM tiles.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _mla_kernel(
+    # scalar prefetch
+    block_table_ref,  # [R, MBp] SMEM
+    seq_lens_ref,     # [R] SMEM
+    # inputs
+    q_ref,            # [1, Hqp, C] VMEM
+    c_hbm,            # [N, 1, BS, C] HBM
+    # output
+    o_ref,            # [1, Hqp, KVR] VMEM
+    # scratch
+    c_buf,            # [2, CH*BS, C] VMEM
+    sems,             # [2, CH] DMA semaphores
+    *,
+    block_size: int,
+    chunk: int,
+    scale: float,
+    kv_rank: int,
+):
+    r = pl.program_id(0)
+    seq_len = seq_lens_ref[r]
+    span = chunk * block_size
+    nc = pl.cdiv(seq_len, span)
+
+    def dma(slot, c_idx, blk):
+        return pltpu.make_async_copy(
+            c_hbm.at[blk, 0],
+            c_buf.at[slot, pl.ds(c_idx * block_size, block_size)],
+            sems.at[slot, c_idx],
+        )
+
+    def start_chunk(slot, c):
+        for c_idx in range(chunk):
+            dma(slot, c_idx, block_table_ref[r, c * chunk + c_idx]).start()
+
+    def wait_chunk(slot, c):
+        for c_idx in range(chunk):
+            dma(slot, c_idx, block_table_ref[r, c * chunk + c_idx]).wait()
+
+    @pl.when(nc > 0)
+    def _first():
+        start_chunk(0, 0)
+
+    q = q_ref[0]  # [Hqp, C]
+
+    def body(c, carry):
+        m_prev, l_prev, acc = carry
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < nc)
+        def _prefetch():
+            start_chunk(jax.lax.rem(c + 1, 2), c + 1)
+
+        wait_chunk(slot, c)
+        tile = c_buf[slot]  # [CH*BS, C]
+        scores = (
+            jax.lax.dot_general(
+                q, tile,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [Hqp, CH*BS]
+        col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        scores = jnp.where(c * span + col < seq_len, scores, NEG_INF)
+
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(tile.dtype), tile[:, :kv_rank],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Hqp, KVR]
+        return m_new, l_new, acc * alpha + pv
+
+    Hqp = q_ref.shape[1]
+    m0 = jnp.full((Hqp, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Hqp, 1), jnp.float32)
+    a0 = jnp.zeros((Hqp, kv_rank), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nc, body, (m0, l0, a0))
+    o_ref[0] = jnp.where(
+        nc > 0, acc / jnp.maximum(l, 1e-30), 0.0
+    ).astype(o_ref.dtype)
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "kv_rank", "interpret", "chunk")
+)
+def mla_attention_kernel(
+    q_lat: jnp.ndarray,        # [R, Hq, C]
+    c_cache: jnp.ndarray,      # [N, 1, BS, C] (plain array; int8 not yet)
+    block_table: jnp.ndarray,  # [R, MB] int32
+    seq_lens: jnp.ndarray,     # [R] int32
+    scale: float,
+    kv_rank: int,
+    interpret: bool = False,
+    chunk: int = 4,
+) -> jnp.ndarray:
+    R, Hq, C = q_lat.shape
+    N, _, BS, _ = c_cache.shape
+    MB = block_table.shape[1]
+    Hqp = _round_up(Hq, 8)
+    CH = max(1, min(chunk, MB))
+
+    qr = q_lat
+    if Hqp != Hq:
+        qr = jnp.pad(qr, ((0, 0), (0, Hqp - Hq), (0, 0)))
+    MBp = _round_up(MB, CH)
+    bt = block_table.astype(jnp.int32)
+    if MBp != MB:
+        bt = jnp.pad(bt, ((0, 0), (0, MBp - MB)))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(R,),
+        in_specs=[
+            pl.BlockSpec((1, Hqp, C), lambda r, bt, sl: (r, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        ],
+        out_specs=pl.BlockSpec((1, Hqp, kv_rank), lambda r, bt, sl: (r, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, CH * BS, C), c_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, CH)),
+        ],
+    )
+    kernel = functools.partial(
+        _mla_kernel, block_size=BS, chunk=CH, scale=scale, kv_rank=kv_rank
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, Hqp, kv_rank), q_lat.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * R * Hqp * C * MB * BS + 2 * R * Hqp * kv_rank * MB * BS,
+            bytes_accessed=R * MB * BS * C * c_cache.dtype.itemsize,
+            transcendentals=R * Hqp * MB * BS,
+        ),
+        interpret=interpret,
+    )(bt, seq_lens.astype(jnp.int32), qr, c_cache)
+    return out[:, :Hq, :]
