@@ -206,10 +206,15 @@ def test_mla_pallas_kernel_interpret_parity():
 
 
 def test_mla_dispatcher_kernel_flag():
-    """use_kernel=True routes decode through the Pallas path end-to-end
-    (interpret on CPU is exercised above; here we only pin the dispatcher
-    contract: explicit False forces gather and matches default)."""
-    from xllm_service_tpu.ops.attention import mla_paged_attention
+    """Dispatcher contract: the kernel branch (kvc.raw unwrap + argument
+    order) is driven via interpret mode and must match gather; a QUANTIZED
+    cache must take the gather path even with use_kernel=True (no int8 MLA
+    kernel — raw int8 data must never be matmul'd as values)."""
+    from xllm_service_tpu.ops import kv_cache as kvc
+    from xllm_service_tpu.ops.attention import (
+        mla_paged_attention,
+        mla_paged_attention_gather,
+    )
 
     rng = np.random.default_rng(7)
     q = jnp.asarray(rng.standard_normal((2, 4, 48)), jnp.float32)
@@ -219,3 +224,17 @@ def test_mla_dispatcher_kernel_flag():
     a = mla_paged_attention(q, cache, bt, lens, 0.2, 40, use_kernel=False)
     b = mla_paged_attention(q, cache, bt, lens, 0.2, 40)  # default: gather
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # Kernel branch through the DISPATCHER (interpret mode on CPU).
+    c = mla_paged_attention(
+        q, cache, bt, lens, 0.2, 40, use_kernel=True, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=2e-5)
+    # Quantized cache + explicit use_kernel=True -> exact gather result
+    # (kernel would produce garbage from raw int8).
+    qd, qs = kvc.quantize_rows(cache)
+    qcache = kvc.PagedKV(qd, qs)
+    d = mla_paged_attention(
+        q, qcache, bt, lens, 0.2, 40, use_kernel=True, interpret=True
+    )
+    e = mla_paged_attention_gather(q, qcache, bt, lens, 0.2, 40)
+    np.testing.assert_array_equal(np.asarray(d), np.asarray(e))

@@ -203,25 +203,28 @@ def mla_paged_attention_gather(
 
 def mla_paged_attention(
     q_lat, c_cache, block_table, seq_lens, scale, kv_rank,
-    use_kernel: bool | None = None,
+    use_kernel: bool | None = None, interpret: bool = False,
 ):
     """Decode MLA attention; Pallas kernel on TPU (opt-in via
     XLLM_MLA_ATTENTION_KERNEL=1 until validated on hardware — the GQA
     kernel went through the same gate in round 1), gather elsewhere.
-    Quantized latent caches use the gather path (no int8 MLA kernel yet)."""
+    Quantized latent caches ALWAYS use the gather path (there is no int8
+    MLA kernel yet — an explicit use_kernel=True must not matmul raw int8
+    data as values); `interpret` lets CI drive the kernel branch on CPU."""
     import os
 
-    env = os.environ.get("XLLM_MLA_ATTENTION_KERNEL")
+    quantized = isinstance(c_cache, kvc.PagedKV) and c_cache.quantized
     if use_kernel is None:
-        kq = isinstance(c_cache, kvc.PagedKV) and c_cache.quantized
-        use_kernel = env == "1" and _on_tpu() and not kq
-    if use_kernel:
+        env = os.environ.get("XLLM_MLA_ATTENTION_KERNEL")
+        use_kernel = env == "1" and (_on_tpu() or interpret)
+    if use_kernel and not quantized:
         from xllm_service_tpu.ops.pallas.mla_attention import (
             mla_attention_kernel,
         )
 
         return mla_attention_kernel(
-            q_lat, kvc.raw(c_cache), block_table, seq_lens, scale, kv_rank
+            q_lat, kvc.raw(c_cache), block_table, seq_lens, scale, kv_rank,
+            interpret=interpret,
         )
     return mla_paged_attention_gather(
         q_lat, c_cache, block_table, seq_lens, scale, kv_rank
