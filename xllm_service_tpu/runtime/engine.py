@@ -101,6 +101,7 @@ class _Seq:
     __slots__ = (
         "req", "slot", "tokens", "block_ids", "num_cached", "generated",
         "last_committed_block", "prefill_done_time", "last_token_time",
+        "prefilled", "chunk_len", "prefill_start_time", "head_hash",
     )
 
     def __init__(self, req: EngineRequest, slot: int):
@@ -113,6 +114,15 @@ class _Seq:
         self.last_committed_block = -1  # index into block_ids
         self.prefill_done_time = 0.0
         self.last_token_time = 0.0
+        # Chunked-prefill state: `prefilled` = prompt tokens whose KV is
+        # already in this seq's cache blocks (>= num_cached once the first
+        # partial chunk lands); `chunk_len` = this step's budgeted chunk.
+        # A mid-prefill seq waits in the queue HOLDING its slot and blocks
+        # (continued FIRST each step); decode steps run between chunks.
+        self.prefilled = 0
+        self.chunk_len = 0
+        self.prefill_start_time = 0.0  # first chunk's t0 (true TTFT base)
+        self.head_hash: Optional[bytes] = None  # block-0 chained hash
 
 
 # The waiting queue holds fresh EngineRequests and preempted _Seqs (which
@@ -287,6 +297,12 @@ class InferenceEngine:
                     kept.append(item)
             self._waiting = kept
         for item in dropped:
+            # A mid-chunk seq waits HOLDING its slot and blocks — release
+            # both (ordinary waiting items hold neither).
+            if isinstance(item, _Seq) and item.block_ids:
+                self.block_mgr.free(item.block_ids)
+                item.block_ids = []
+                self._free_slots.append(item.slot)
             self._notify_cancelled(self._item_req(item))
         for slot, seq in list(self._running.items()):
             if seq.req.request_id in cancelled:
@@ -308,11 +324,35 @@ class InferenceEngine:
         # prefix-matches the committed blocks instead of redundantly
         # prefilling the shared prefix in the same batched step.
         pending_hashes: set = set()
+
+        # Mid-chunk seqs continue FIRST, wherever they sit in the queue: a
+        # preempted/blocked item appendleft'd in front of one must not
+        # starve it — it HOLDS slot + blocks that only further chunks can
+        # turn into output (it is not in _running, so it is neither
+        # preemptible nor evictable; skipping it could deadlock the pool).
+        with self._lock:
+            midchunk = [
+                x
+                for x in self._waiting
+                if isinstance(x, _Seq) and x.block_ids
+            ]
+            for x in midchunk:
+                self._waiting.remove(x)
+        for seq in midchunk:
+            chunk = min(len(seq.tokens) - seq.prefilled, max(budget, 1))
+            budget -= chunk
+            seq.chunk_len = chunk
+            if seq.head_hash is not None:
+                pending_hashes.add(seq.head_hash)
+            batch.append(seq)
+
         while budget > 0:
             with self._lock:
-                if not self._waiting or not self._free_slots:
+                if not self._waiting:
                     break
                 item = self._waiting[0]
+                if not self._free_slots:
+                    break
                 tokens = item.tokens if isinstance(item, _Seq) else item.prompt_token_ids
                 n_tok = len(tokens)
                 if n_tok >= self.cfg.max_seq_len:
@@ -387,7 +427,15 @@ class InferenceEngine:
                     self._waiting.appendleft(item)
                 break
 
-            budget -= len(seq.tokens) - seq.num_cached
+            # Chunked prefill: the step budget is STRICT — a long uncached
+            # suffix prefills across steps (decode runs between chunks, so
+            # one long prompt no longer spikes every running request's
+            # TBT). The sequence keeps its slot and blocks while waiting
+            # for its next chunk.
+            seq.prefilled = seq.num_cached
+            seq.chunk_len = min(len(seq.tokens) - seq.prefilled, budget)
+            seq.head_hash = hashes[0] if hashes else None
+            budget -= seq.chunk_len
             pending_hashes.update(hashes)
             batch.append(seq)
 
@@ -398,7 +446,6 @@ class InferenceEngine:
 
     def _prefill_admitted(self, batch: List[_Seq]) -> int:
         from xllm_service_tpu.runtime.executor import PrefillItem
-
         # Long-context path: prompts past the SP threshold prefill over the
         # mesh's sequence-parallel ring (ring attention) one at a time;
         # they skip prefix reuse (ring attends from position 0) and media
@@ -410,27 +457,36 @@ class InferenceEngine:
             # a heavily prefix-cached prompt would trade a short batched
             # suffix prefill for a full-prompt recompute and give up its
             # cache hit. Require the uncached suffix to dominate (>= 8x)
-            # the cached prefix.
+            # the cached prefix. Mid-chunk seqs (prefilled > num_cached)
+            # stay on the batched path — the ring would discard the chunks
+            # already landed.
             sp_batch = [
                 s
                 for s in batch
                 if not s.req.has_media
+                and s.prefilled <= s.num_cached
                 and len(s.tokens) - s.num_cached >= sp_thresh
                 and len(s.tokens) - s.num_cached >= 8 * s.num_cached
             ]
             if sp_batch:
                 batch = [s for s in batch if s not in sp_batch]
                 done = self._prefill_sp(sp_batch)
-                return done + (self._prefill_admitted(batch) if batch else 0)
+                return done + (
+                    self._prefill_admitted(batch) if batch else 0
+                )
         items = []
         for seq in batch:
             table = np.zeros((self.max_blocks,), np.int32)
             table[: len(seq.block_ids)] = seq.block_ids
             s = seq.req.sampling
+            start = seq.prefilled
+            n = seq.chunk_len or (len(seq.tokens) - start)
             items.append(
                 PrefillItem(
-                    token_ids=np.asarray(seq.tokens[seq.num_cached:], np.int32),
-                    start_pos=seq.num_cached,
+                    token_ids=np.asarray(
+                        seq.tokens[start:start + n], np.int32
+                    ),
+                    start_pos=start,
                     block_table=table,
                     temperature=s.temperature,
                     top_k=s.top_k,
@@ -450,16 +506,33 @@ class InferenceEngine:
                 )
             )
         t0 = time.monotonic()
+        for seq in batch:
+            if seq.prefilled <= seq.num_cached:
+                seq.prefill_start_time = t0  # first chunk: TTFT base
         outs = self.executor.prefill_batch(items)
         now = time.monotonic()
-        # Client-perceived TTFT is the whole batched step for every member;
-        # the profiling curve gets (suffix_len, batch_ms) pairs — slightly
-        # pessimistic per-seq, conservative for the TimePredictor fit.
-        batch_ms = (now - t0) * 1000
         admitted = 0
-        for seq, (tok, lp) in zip(batch, outs):
+        for seq, item, (tok, lp) in zip(batch, items, outs):
+            end = seq.prefilled + len(item.token_ids)
+            if end < len(seq.tokens):
+                # Partial chunk: KV landed; the chunk-tail "token" sampled
+                # from a mid-prompt position is discarded. The seq returns
+                # to the queue (holding slot + blocks) for its next chunk;
+                # decode steps run in between. Counts as progress (the
+                # loop must not back off between chunks).
+                seq.prefilled = end
+                with self._lock:
+                    self._waiting.appendleft(seq)
+                admitted += 1
+                continue
+            seq.prefilled = end
+            # Client-perceived TTFT spans ALL chunks (+ interleaved decode
+            # steps) from the first chunk's start — for single-chunk seqs
+            # this is the whole batched step: slightly pessimistic per seq,
+            # conservative for the TimePredictor fit.
+            ms = (now - seq.prefill_start_time) * 1000
             self._finish_prefill(
-                seq, tok, lp, now, batch_ms,
+                seq, tok, lp, now, ms,
                 len(seq.tokens) - seq.num_cached,
             )
             admitted += 1
