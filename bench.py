@@ -50,11 +50,40 @@ def main() -> None:
         _force_cpu_platform(1)
     import jax
 
+    on_tpu = jax.default_backend() == "tpu"
+    # Fastest config first; fall back if a path that never ran on real
+    # hardware this round (the int8 kernel's scale DMA) fails to compile —
+    # the bench must ALWAYS print a number (round-1 lesson).
+    attempts = (
+        [
+            {"kv_cache_dtype": "int8"},
+            {"kv_cache_dtype": "auto"},
+            {"kv_cache_dtype": "auto", "use_kernel": False},
+        ]
+        if on_tpu
+        else [{"kv_cache_dtype": "auto"}]
+    )
+    last_err = None
+    for attempt in attempts:
+        try:
+            _run(on_tpu, **attempt)
+            return
+        except Exception as e:  # noqa: BLE001 — fall through to next config
+            last_err = e
+            import traceback
+
+            traceback.print_exc()
+    raise SystemExit(f"all bench configs failed: {last_err}")
+
+
+def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
+         use_kernel: bool | None = None) -> None:
+    import jax
+
     from xllm_service_tpu.common.config import EngineConfig
     from xllm_service_tpu.ops.sampling import SamplingParams
     from xllm_service_tpu.runtime.executor import ModelExecutor, SamplingBatch
 
-    on_tpu = jax.default_backend() == "tpu"
     # llama3-3b: largest llama member fitting v5e HBM (6.4 GB bf16 params);
     # head_dim 128 engages the Pallas decode kernel (1b's 64 cannot).
     model = "llama3-3b" if on_tpu else "llama3-tiny"
@@ -72,7 +101,7 @@ def main() -> None:
         block_size=128 if on_tpu else 16,
         # int8 KV: halves the decode attention HBM traffic (validated
         # kernel + e2e parity in tests/test_kv_quant.py).
-        kv_cache_dtype="int8" if on_tpu else "auto",
+        kv_cache_dtype=kv_cache_dtype,
         # Persistent jit cache: re-runs (and later rounds) skip the
         # 20-40s-per-shape TPU compiles.
         compilation_cache_dir="/tmp/xllm-jit-cache" if on_tpu else "",
@@ -139,7 +168,8 @@ def main() -> None:
         def body(carry, step):
             k_cache, v_cache, toks, pos = carry
             logits, k_cache, v_cache = llama.decode_step(
-                params, mcfg, k_cache, v_cache, toks, pos, tables, active)
+                params, mcfg, k_cache, v_cache, toks, pos, tables, active,
+                use_kernel=use_kernel)
             keys = sampling_ops.make_step_keys(seeds, step)
             toks, _, _ = sampling_ops.sample_tokens(
                 logits, temps, top_ks, top_ps, keys)
@@ -188,8 +218,10 @@ def main() -> None:
         "tpot_ms": round(1000.0 * dt / decode_steps, 3),
         "mfu": round(achieved_flops / peak, 4) if peak else None,
         "prefill_tok_s": round(prefill_tok_s, 1),
-        "attention_kernel": os.environ.get(
-            "XLLM_PAGED_ATTENTION_KERNEL", "default"),
+        "attention_kernel": (
+            "forced-off" if use_kernel is False else os.environ.get(
+                "XLLM_PAGED_ATTENTION_KERNEL", "default")
+        ),
         "kv_cache_dtype": cfg.kv_cache_dtype,
     }))
 
