@@ -69,10 +69,14 @@ def main() -> None:
             _run(on_tpu, **attempt)
             return
         except Exception as e:  # noqa: BLE001 — fall through to next config
-            last_err = e
             import traceback
 
             traceback.print_exc()
+            # Keep only the repr: the exception's traceback pins _run's
+            # frame locals (multi-GB params/caches) and would OOM the next
+            # attempt.
+            last_err = repr(e)
+            del e
     raise SystemExit(f"all bench configs failed: {last_err}")
 
 

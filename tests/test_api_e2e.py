@@ -218,13 +218,18 @@ class TestCompletionE2E:
         )
         assert code == 400
 
-    def test_embeddings_501(self, cluster):
+    def test_embeddings(self, cluster):
+        """Round 1 mirrored the reference's 501 (service.cpp:441-442);
+        round 2 serves embeddings for real — master tokenizes and routes,
+        the instance pools (fake engine: deterministic unit vectors)."""
         master = cluster[0]
-        code, _ = http_post(
+        code, body = http_post(
             master.http_address, "/v1/embeddings",
-            {"model": "fake-echo", "input": "x"},
+            {"model": "fake-echo", "input": ["x", "y"]},
         )
-        assert code == 501
+        assert code == 200, body
+        assert len(body["data"]) == 2
+        assert body["data"][0]["embedding"] != body["data"][1]["embedding"]
 
 
 class TestClusterBehavior:

@@ -110,3 +110,44 @@ def test_chat_stream(stack):
     )
     assert events[-1] == "[DONE]"
     assert events[0]["choices"][0]["delta"].get("role") == "assistant"
+
+
+def test_embeddings_endpoint(stack):
+    """/v1/embeddings end-to-end (the reference 501s this endpoint —
+    serving it exceeds parity): master tokenizes, instance pools
+    normalized hidden states; deterministic, unit-norm, input-sensitive."""
+    import numpy as np
+
+    master, inst, _ = stack
+    code, body = http_post(
+        master.http_address, "/v1/embeddings",
+        {"model": "llama3-tiny",
+         "input": ["hello world", "a very different sentence"]},
+        timeout=300.0,
+    )
+    assert code == 200, body
+    assert body["object"] == "list" and len(body["data"]) == 2
+    v0 = np.asarray(body["data"][0]["embedding"], np.float32)
+    v1 = np.asarray(body["data"][1]["embedding"], np.float32)
+    assert v0.shape == (128,)  # llama3-tiny hidden_size
+    np.testing.assert_allclose(np.linalg.norm(v0), 1.0, atol=1e-3)
+    np.testing.assert_allclose(np.linalg.norm(v1), 1.0, atol=1e-3)
+    assert abs(float(v0 @ v1)) < 0.999  # different inputs, different vectors
+    assert body["usage"]["prompt_tokens"] > 0
+
+    # Determinism + single-string form.
+    code2, body2 = http_post(
+        master.http_address, "/v1/embeddings",
+        {"model": "llama3-tiny", "input": "hello world"},
+        timeout=60.0,
+    )
+    assert code2 == 200
+    np.testing.assert_allclose(
+        np.asarray(body2["data"][0]["embedding"], np.float32), v0, atol=1e-5
+    )
+
+    # Validation errors.
+    code3, body3 = http_post(
+        master.http_address, "/v1/embeddings", {"input": []}, timeout=30.0
+    )
+    assert code3 == 400

@@ -28,6 +28,20 @@ from xllm_service_tpu.common.types import (
 )
 
 
+class _FakeEmbedExecutor:
+    hidden_size = 32
+
+    def embed_tokens(self, inputs):
+        import numpy as np
+
+        out = np.zeros((len(inputs), self.hidden_size), np.float32)
+        for i, ids in enumerate(inputs):
+            rng = np.random.default_rng(abs(hash(tuple(ids))) % 2**32)
+            v = rng.standard_normal(self.hidden_size).astype(np.float32)
+            out[i] = v / np.linalg.norm(v)
+        return out
+
+
 class FakeEngine:
     def __init__(
         self,
@@ -45,6 +59,10 @@ class FakeEngine:
         self._active = 0
         self._cache_event = KvCacheEvent()
         self.requests_seen: List = []
+        # /v1/embeddings surface: deterministic unit vectors derived from
+        # the token ids (the instance HTTP layer calls
+        # engine.executor.embed_tokens like the real engine's).
+        self.executor = _FakeEmbedExecutor()
 
     # -- engine interface ---------------------------------------------- #
     def start(self) -> None:

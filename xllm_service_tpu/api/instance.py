@@ -413,6 +413,8 @@ class InstanceServer:
             self._serve(h, body, chat=False)
         elif route == "/v1/chat/completions":
             self._serve(h, body, chat=True)
+        elif route == "/v1/embeddings":
+            self._handle_embeddings(h, body)
         elif route == "/encode":
             self._handle_encode(h, body)
         elif route == "/mm/import":
@@ -629,6 +631,52 @@ class InstanceServer:
         ) != getattr(self._master, "_addr", ""):
             return None
         return peer
+
+    def _handle_embeddings(self, h: QuietHandler, body: Dict[str, Any]) -> None:
+        """Engine-side /v1/embeddings: token id lists in (the service
+        tokenizes, same injection contract as generation forwarding),
+        mean-pooled normalized hidden-state vectors out. The reference
+        rejects this endpoint (service.cpp:441-442) — implementing it
+        exceeds parity."""
+        token_lists = body.get("token_ids")
+        if not isinstance(token_lists, list) or not token_lists or not all(
+            isinstance(t, list) and t for t in token_lists
+        ):
+            h.send_error_json(
+                400,
+                "token_ids (non-empty list of non-empty id lists) required "
+                "— raw text inputs are tokenized by the master service",
+            )
+            return
+        limit = self.cfg.max_seq_len
+        too_long = max(len(t) for t in token_lists)
+        if too_long > limit:
+            h.send_error_json(
+                400,
+                f"input of {too_long} tokens exceeds max_seq_len {limit}",
+            )
+            return
+        try:
+            vecs = self.engine.executor.embed_tokens(token_lists)
+        except Exception as e:
+            h.send_error_json(500, f"embedding failed: {e}")
+            return
+        n_tok = sum(len(t) for t in token_lists)
+        h.send_json(
+            {
+                "object": "list",
+                "model": body.get("model") or self.cfg.model,
+                "data": [
+                    {
+                        "object": "embedding",
+                        "index": i,
+                        "embedding": [float(x) for x in vecs[i]],
+                    }
+                    for i in range(len(token_lists))
+                ],
+                "usage": {"prompt_tokens": n_tok, "total_tokens": n_tok},
+            }
+        )
 
     def _handle_kv_import(self, h: QuietHandler) -> None:
         try:

@@ -276,10 +276,66 @@ class Master:
         elif route == "/v1/chat/completions":
             self._serve_generation(h, chat=True)
         elif route == "/v1/embeddings":
-            # The reference rejects embeddings outright (service.cpp:441-442).
-            h.send_error_json(501, "embeddings not supported yet")
+            # The reference rejects embeddings outright (service.cpp:441-442);
+            # serving them here EXCEEDS parity: the service tokenizes (same
+            # injection contract as generation), an instance pools hidden
+            # states.
+            self._serve_embeddings(h)
         else:
             h.send_error_json(404, f"no route {route}")
+
+    def _serve_embeddings(self, h: QuietHandler) -> None:
+        body = h.read_json()
+        if body is None:
+            h.send_error_json(400, "invalid JSON body")
+            return
+        raw = body.get("input")
+        if isinstance(raw, str):
+            raw = [raw]
+        if isinstance(raw, list) and raw and all(
+            isinstance(x, int) for x in raw
+        ):
+            raw = [raw]  # single pre-tokenized input
+        if not isinstance(raw, list) or not raw:
+            h.send_error_json(400, "input (string or array) is required")
+            return
+        token_lists: List[List[int]] = []
+        for x in raw:
+            if isinstance(x, str):
+                ids = self.scheduler.tokenizer.encode(x)
+            elif isinstance(x, list) and all(isinstance(i, int) for i in x):
+                ids = list(x)
+            else:
+                h.send_error_json(400, "input items must be strings or id lists")
+                return
+            if not ids:
+                h.send_error_json(400, "input item tokenized to nothing")
+                return
+            token_lists.append(ids)
+        # Route like a prefill: the policy's pair choice keeps load skew
+        # visible to it; embeddings are synchronous one-shot calls.
+        routing = self.scheduler.route_only(token_lists[0])
+        if routing is None:
+            h.send_error_json(503, "no instances registered")
+            return
+        meta = self.scheduler.instance_mgr.get_instance(routing.prefill_name)
+        if meta is None:
+            h.send_error_json(503, "routed instance vanished")
+            return
+        try:
+            code, resp = post_json(
+                meta.http_address,
+                "/v1/embeddings",
+                {"model": body.get("model") or "", "token_ids": token_lists},
+                timeout=120.0,
+            )
+        except Exception as e:
+            h.send_error_json(502, f"instance unreachable: {e}")
+            return
+        if code != 200:
+            h.send_error_json(502, f"instance rejected embeddings: {resp}")
+            return
+        h.send_json(resp)
 
     def _parse_request(
         self, body: Dict[str, Any], chat: bool

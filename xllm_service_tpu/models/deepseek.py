@@ -289,6 +289,17 @@ def forward_dense(
     """NAIVE (non-absorbed) causal forward — the correctness oracle for the
     absorbed paged paths: materializes per-head K = concat(c_kv @ W_UK,
     broadcast k_pe) and V = c_kv @ W_UV, then standard MHA."""
+    from xllm_service_tpu.models.llama import _project
+
+    return _project(params, cfg, hidden_dense(params, cfg, token_ids))
+
+
+def hidden_dense(
+    params: Params,
+    cfg: ModelConfig,
+    token_ids: jnp.ndarray,  # [B, L]
+) -> jnp.ndarray:
+    """Final-norm hidden states [B, L, E] (the /v1/embeddings path)."""
     B, L = token_ids.shape
     dn, dr = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
     kvr = cfg.kv_lora_rank
@@ -329,4 +340,4 @@ def forward_dense(
         return jax.vmap(one_seq)(x), None
 
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])
-    return _unembed(params, cfg, x)
+    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)

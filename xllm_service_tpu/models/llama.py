@@ -113,7 +113,13 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
 
 
 def _unembed(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
-    h = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return _project(
+        params, cfg, rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    )
+
+
+def _project(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
+    """Vocab projection of ALREADY-final-normed hidden states."""
     if cfg.tie_word_embeddings:
         return jnp.einsum("...e,ve->...v", h.astype(jnp.float32),
                           params["embed"].astype(jnp.float32))
@@ -385,6 +391,17 @@ def forward_dense(
 ) -> jnp.ndarray:
     """Plain causal forward without KV cache — the correctness oracle for
     prefill/decode and the body of the training step (__graft_entry__)."""
+    return _project(params, cfg, hidden_dense(params, cfg, token_ids))
+
+
+def hidden_dense(
+    params: Params,
+    cfg: ModelConfig,
+    token_ids: jnp.ndarray,  # [B, L] int32
+) -> jnp.ndarray:
+    """Final-norm hidden states [B, L, E] of a plain causal forward —
+    the /v1/embeddings path (pooling happens executor-side) and the body
+    forward_dense unembeds."""
     B, L = token_ids.shape
     scale = cfg.head_dim**-0.5
     x = params["embed"][token_ids].astype(params["layers"]["wq"].dtype)
@@ -413,4 +430,4 @@ def forward_dense(
         return x, None
 
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])
-    return _unembed(params, cfg, x)  # [B, L, V]
+    return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)  # [B, L, E]

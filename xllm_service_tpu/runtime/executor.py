@@ -73,6 +73,11 @@ class PrefillItem:
 
 
 _COMPILATION_CACHE_DIR: Optional[str] = None
+# Guards lazy _embed_jit creation: /v1/embeddings arrives on concurrent
+# HTTP handler threads; double-tracing a 20-40s TPU compile must not race.
+import threading as _threading
+
+_EMBED_INIT_LOCK = _threading.Lock()
 
 
 def _setup_compilation_cache(cache_dir: str) -> None:
@@ -751,6 +756,50 @@ class ModelExecutor:
         return np.asarray(tokens), np.asarray(logprobs)
 
     # ------------------------------------------------- KV block migration
+
+    # ------------------------------------------------------------ embeddings
+
+    def embed_tokens(self, inputs: List[List[int]]) -> np.ndarray:
+        """/v1/embeddings path (the reference rejects the endpoint outright
+        — service.cpp:441-442; implementing it EXCEEDS parity): mean-pooled,
+        L2-normalized final-norm hidden states of a causal forward. Inputs
+        bucket to the prefill length buckets (bounded compiles); batch of
+        one per call keeps it simple — embeddings traffic is sparse
+        relative to generation."""
+        with _EMBED_INIT_LOCK:
+            init_needed = not hasattr(self, "_embed_jit")
+        if init_needed:
+            def _impl(params, token_ids, true_len):
+                h = self.model_mod.hidden_dense(
+                    params, self.cfg, token_ids
+                )  # [1, L, E]
+                mask = (
+                    jnp.arange(h.shape[1])[None, :, None] < true_len
+                ).astype(jnp.float32)
+                hf = h.astype(jnp.float32) * mask
+                pooled = hf.sum(axis=1) / jnp.maximum(
+                    mask.sum(axis=1), 1.0
+                )  # [1, E]
+                return pooled / jnp.maximum(
+                    jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9
+                )
+
+            with _EMBED_INIT_LOCK:
+                if not hasattr(self, "_embed_jit"):
+                    self._embed_jit = jax.jit(_impl)
+        out = np.empty((len(inputs), self.cfg.hidden_size), np.float32)
+        with self.mesh:
+            for i, ids in enumerate(inputs):
+                n = max(1, min(len(ids), self.engine_cfg.max_seq_len))
+                pad = self.bucket_len(n)
+                padded = np.zeros((1, pad), np.int32)
+                padded[0, :n] = ids[:n]
+                out[i] = np.asarray(
+                    self._embed_jit(
+                        self.params, jnp.asarray(padded), jnp.int32(n)
+                    )
+                )[0]
+        return out
 
     def migration_shape(self, n_blocks: int) -> Tuple[int, ...]:
         """Expected KV-handoff payload shape for n_blocks blocks — the PD

@@ -204,6 +204,17 @@ class Scheduler:
     # request hot path
     # ------------------------------------------------------------------ #
 
+    def route_only(self, token_ids=()):
+        """Pick an instance pair without registering a generation request —
+        one-shot synchronous calls (/v1/embeddings) that still want the
+        policy's load/affinity view. None when no instances exist."""
+        routing = self._policy.select_instances_pair(list(token_ids))
+        if not routing.prefill_name and not routing.decode_name:
+            return None
+        if not routing.prefill_name:
+            routing.prefill_name = routing.decode_name
+        return routing
+
     def schedule(self, request: ServiceRequest) -> Status:
         """Template -> tokenize -> route (reference: scheduler.cpp:73-106).
         Fills request.token_ids, request.routing, request.estimated_ttft_ms."""
