@@ -278,3 +278,59 @@ class TestClusterBehavior:
             [RequestOutput(service_request_id="ghost-1")]
         )
         assert cont == {"ghost-1": False}
+
+
+class TestRoleFlipNotification:
+    def test_flipped_instance_learns_its_role(self, cluster):
+        """Round-1 weak item 8: a dynamic PD-ratio flip mutated only the
+        master's registry; now the master notifies the instance (/flip)
+        so the engine knows its serving role — the reference never
+        notifies at all (instance_mgr.cpp:759-807)."""
+        master = cluster[0]
+        from xllm_service_tpu.api.fake_engine import FakeEngine
+        from xllm_service_tpu.api.instance import InstanceServer
+        from xllm_service_tpu.common.config import EngineConfig
+        from xllm_service_tpu.common.types import InstanceType
+
+        mgr = master.scheduler.instance_mgr
+        # With the fixture's p0 (PREFILL) and d0 (DECODE) present, BOTH
+        # MIX instances land on the prefill side (_initial_role: a decode
+        # instance already exists), so a prefill->decode flip is legal
+        # (never empties a side; only MIX is flippable).
+        mixes = []
+        for name in ("mixa", "mixb"):
+            srv = InstanceServer(
+                EngineConfig(
+                    model="fake-echo", instance_name=name,
+                    instance_type="MIX", block_size=16,
+                ),
+                master_rpc_addr=master.rpc_address,
+                heartbeat_interval_s=0.2,
+                engine=FakeEngine(),
+            )
+            srv.start()
+            mixes.append(srv)
+        try:
+            assert wait_until(
+                lambda: all(
+                    mgr.get_instance(s.name) is not None for s in mixes
+                )
+            )
+            flipped = mgr.flip_prefill_to_decode() or mgr.flip_decode_to_prefill()
+            assert flipped in ("mixa", "mixb")
+            target = next(s for s in mixes if s.name == flipped)
+            want = mgr.get_instance(flipped).current_type
+            assert wait_until(
+                lambda: target.meta.current_type == want
+                and getattr(target.engine, "serving_role", "") == want.name,
+                timeout=5.0,
+            ), (target.meta.current_type, want)
+            # The DECLARED type must survive the flip (a lease-blip
+            # re-register under the serving role would permanently strip
+            # flip eligibility).
+            from xllm_service_tpu.common.types import InstanceType
+
+            assert target.meta.type == InstanceType.MIX
+        finally:
+            for s in mixes:
+                s.stop()

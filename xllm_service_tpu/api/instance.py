@@ -440,6 +440,26 @@ class InstanceServer:
                 h.send_error_json(502, f"master push failed: {e}")
                 return
             h.send_json({"ok": True, "cont": cont})
+        elif route == "/flip":
+            # Dynamic PD-ratio role flip (SURVEY §7 hard part 4): the
+            # master's registry changed this instance's serving role; now
+            # the ENGINE learns it too (round-1 weak item 8 — reference
+            # never notifies, instance_mgr.cpp:759-807). MIX engines serve
+            # both roles with identical compiled shapes (bucketed prefill +
+            # fixed decode batch + persistent jit cache), so no
+            # recompilation is needed — the role re-points heartbeat
+            # metadata and is observable on /metrics.
+            role = str(body.get("role", ""))
+            if role not in ("PREFILL", "DECODE"):
+                h.send_error_json(400, f"bad role {role!r}")
+                return
+            # current_type is the SERVING role; meta.type stays the
+            # DECLARED type (MIX) — clobbering it would make a lease-blip
+            # re-register permanently strip flip eligibility.
+            self.meta.current_type = InstanceType.parse(role)
+            setattr(self.engine, "serving_role", role)
+            logger.info("instance %s now serving role %s", self.name, role)
+            h.send_json({"ok": True, "role": role})
         elif route == "/cancel":
             srid = body.get("service_request_id", "")
             with self._srid_mu:
@@ -1444,6 +1464,27 @@ def main(argv=None) -> None:
         "--prefill-buckets", default="128,256,512,1024,2048",
         help="comma-separated prefill padding buckets",
     )
+    parser.add_argument(
+        "--kv-cache-dtype", default="auto", choices=["auto", "int8"],
+        help="int8 halves decode HBM traffic and doubles pool capacity",
+    )
+    parser.add_argument("--dp-size", type=int, default=1)
+    parser.add_argument("--tp-size", type=int, default=1)
+    parser.add_argument("--ep-size", type=int, default=1)
+    parser.add_argument("--sp-size", type=int, default=1)
+    parser.add_argument(
+        "--sp-prefill-threshold", type=int, default=0,
+        help="uncached-suffix length that routes prefill to the sp ring",
+    )
+    parser.add_argument(
+        "--max-prefill-tokens", type=int, default=8192,
+        help="strict per-step prefill budget (long prompts chunk across "
+        "steps with decode interleaved)",
+    )
+    parser.add_argument(
+        "--compilation-cache-dir", default="",
+        help="persistent XLA jit cache (restarts skip the per-shape compiles)",
+    )
     args = parser.parse_args(argv)
     # Restore standard JAX env semantics: some environments force a
     # platform at interpreter start (sitecustomize), overriding
@@ -1463,6 +1504,14 @@ def main(argv=None) -> None:
         max_running_requests=args.max_running_requests,
         max_seq_len=args.max_seq_len,
         prefill_buckets=[int(b) for b in args.prefill_buckets.split(",")],
+        kv_cache_dtype=args.kv_cache_dtype,
+        dp_size=args.dp_size,
+        tp_size=args.tp_size,
+        ep_size=args.ep_size,
+        sp_size=args.sp_size,
+        sp_prefill_threshold=args.sp_prefill_threshold,
+        max_prefill_tokens=args.max_prefill_tokens,
+        compilation_cache_dir=args.compilation_cache_dir,
     )
     srv = InstanceServer(
         cfg,

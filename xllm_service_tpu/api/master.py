@@ -171,6 +171,36 @@ class Master:
         self.http = HttpServerThread(config.host, config.http_port, ClientHandler)
         self.rpc = HttpServerThread(config.host, config.rpc_port, RpcHandler)
 
+        def notify_flip(name: str, attempt: int) -> None:
+            # Role resolved at SEND time from the registry (not frozen at
+            # event time): a delayed delivery racing a flip-back would
+            # otherwise park the engine on a stale role.
+            meta = self.scheduler.instance_mgr.get_instance(name)
+            if meta is None:
+                return  # deregistered since the flip: nothing to notify
+            role = meta.current_type.name
+            err = ""
+            try:
+                code, resp = post_json(
+                    meta.http_address, "/flip", {"role": role}, timeout=5.0
+                )
+                if code != 200:
+                    err = f"HTTP {code}: {resp}"
+            except Exception as e:  # instance may be mid-restart
+                err = str(e)
+            if err:
+                logger.warning(
+                    "flip notify %s -> %s failed (attempt %d): %s",
+                    name, role, attempt, err,
+                )
+                # Bounded retry on the next master-loop tick; a dead
+                # instance leaves the registry and stops the retries
+                # naturally, the bound stops a live-but-broken one.
+                if attempt < 5:
+                    self.scheduler.instance_mgr.requeue_flip(name, attempt + 1)
+
+        self.scheduler.on_role_flip = notify_flip
+
     # ------------------------------------------------------------------ #
     def start(self) -> None:
         self.http.start()

@@ -70,6 +70,8 @@ class InstanceMgr:
         self._is_master = is_master
         self._stale_after_s = detect_disconnected_interval_s
         self._mu = threading.RLock()
+        # Pending (name, attempt) role flips awaiting instance notification.
+        self._flip_events: List[Tuple[str, int]] = []
 
         self._instances: Dict[str, InstanceMetaInfo] = {}
         # Role indices: name lists with swap-pop removal (reference keeps
@@ -647,6 +649,7 @@ class InstanceMgr:
                 self._pop_index(name, InstanceType.PREFILL)
                 self._push_index(name, InstanceType.DECODE)
                 self._instances[name].current_type = InstanceType.DECODE
+                self._flip_events.append((name, 1))
                 logger.info("flipped %s prefill->decode", name)
                 return name
             return ""
@@ -665,6 +668,26 @@ class InstanceMgr:
                 self._pop_index(name, InstanceType.DECODE)
                 self._push_index(name, InstanceType.PREFILL)
                 self._instances[name].current_type = InstanceType.PREFILL
+                self._flip_events.append((name, 1))
                 logger.info("flipped %s decode->prefill", name)
                 return name
             return ""
+
+    def take_flip_events(self):
+        """Drain pending (instance, attempt) flip notifications — the
+        master tells each flipped instance so the ENGINE learns its new
+        role (round-1 weak item 8: the registry mutated but the instance
+        never knew; the reference never notifies at all,
+        instance_mgr.cpp:759-807). The role itself is NOT carried: the
+        notifier reads the registry's current_type at send time, so
+        delayed deliveries can't park an engine on a stale role."""
+        with self._mu:
+            out = list(self._flip_events)
+            self._flip_events.clear()
+            return out
+
+    def requeue_flip(self, name: str, attempt: int) -> None:
+        """Re-queue a failed flip notification for the next master tick."""
+        with self._mu:
+            if not any(n == name for n, _ in self._flip_events):
+                self._flip_events.append((name, attempt))

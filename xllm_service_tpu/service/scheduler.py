@@ -91,6 +91,9 @@ class Scheduler:
         self._tokenizer = tokenizer or create_tokenizer(config.tokenizer_path)
         self._chat_template = ChatTemplate(self._tokenizer)
         self._tracer = RequestTracer(config.trace_dir, config.enable_request_trace)
+        # Installed by the Master: transport for role-flip notifications
+        # ((instance_name, new_role) -> POST instance /flip).
+        self.on_role_flip = None
 
         self._election = MasterElection(
             self._store,
@@ -189,6 +192,7 @@ class Scheduler:
         period = self._config.heartbeat_interval_s
         while not self._stop.wait(period):
             self._pump_offline()
+            self._notify_flips()
             if not self._election.is_master:
                 continue
             try:
@@ -199,6 +203,22 @@ class Scheduler:
                 self._instance_mgr.prune_disconnected()
             except Exception:
                 logger.exception("master loop iteration failed")
+
+    def _notify_flips(self) -> None:
+        """Tell flipped instances their new role (round-1 weak item 8:
+        the registry mutated but the engine never learned it flipped).
+        The transport callback is installed by the Master (HTTP POST to
+        the instance's /flip); flips are rare, so one daemon thread per
+        event keeps the loop unblocked."""
+        if self.on_role_flip is None:
+            return
+        for name, attempt in self._instance_mgr.take_flip_events():
+            threading.Thread(
+                target=self.on_role_flip,
+                args=(name, attempt),
+                name=f"flip-notify-{name}",
+                daemon=True,
+            ).start()
 
     # ------------------------------------------------------------------ #
     # request hot path
