@@ -331,6 +331,74 @@ class TestRoleFlipNotification:
             from xllm_service_tpu.common.types import InstanceType
 
             assert target.meta.type == InstanceType.MIX
+
+            # Reconciliation: if the instance LOSES the role (restart /
+            # dropped notification), the next heartbeat's serving_role
+            # mismatch makes the master re-send /flip.
+            target.meta.current_type = InstanceType.MIX
+            target.engine.serving_role = ""
+            assert wait_until(
+                lambda: target.meta.current_type == want
+                and target.engine.serving_role == want.name,
+                timeout=5.0,
+            ), (target.meta.current_type, target.engine.serving_role)
         finally:
             for s in mixes:
                 s.stop()
+
+
+class TestStopSequences:
+    def test_nonstream_stop_truncates(self, cluster):
+        """OpenAI `stop`: output ends BEFORE the first stop match
+        (fake engine echoes the reversed prompt: 'abcdef' -> 'fedcba')."""
+        master = cluster[0]
+        code, body = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "fake-echo", "prompt": "abcdef", "max_tokens": 16,
+             "stop": "dc"},
+        )
+        assert code == 200, body
+        assert body["choices"][0]["text"] == "fe"
+        assert body["choices"][0]["finish_reason"] == "stop"
+
+    def test_stream_stop_never_emits_partial(self, cluster):
+        master = cluster[0]
+        events = sse_post(
+            master.http_address, "/v1/completions",
+            {"model": "fake-echo", "prompt": "abcdef", "max_tokens": 16,
+             "stream": True, "stop": ["dc", "zz"]},
+        )
+        assert events[-1] == "[DONE]"
+        text = "".join(
+            e["choices"][0]["text"] for e in events[:-1] if e.get("choices")
+        )
+        assert text == "fe"
+        # no chunk ever contained any part of the stop string beyond "fe"
+        for e in events[:-1]:
+            if e.get("choices"):
+                assert "d" not in e["choices"][0]["text"]
+
+    def test_stop_no_match_releases_holdback(self, cluster):
+        """A stop whose PREFIX appears at end of stream must still be
+        emitted once generation finishes naturally."""
+        master = cluster[0]
+        code, body = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "fake-echo", "prompt": "abcdef", "max_tokens": 16,
+             "stop": ["aZZZ"]},  # 'a' (the last token) is a proper prefix
+        )
+        assert code == 200, body
+        assert body["choices"][0]["text"] == "fedcba"
+
+    def test_stop_validation(self, cluster):
+        master = cluster[0]
+        code, _ = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "fake-echo", "prompt": "x", "stop": ["a"] * 5},
+        )
+        assert code == 400
+        code, _ = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "fake-echo", "prompt": "x", "stop": 7},
+        )
+        assert code == 400

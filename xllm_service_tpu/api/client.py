@@ -49,8 +49,11 @@ class MasterClient:
         load_metrics: Optional[LoadMetrics] = None,
         latency_metrics: Optional[LatencyMetrics] = None,
         cache_event: Optional[KvCacheEvent] = None,
+        serving_role: str = "",
     ) -> Dict:
         body: Dict = {"name": name}
+        if serving_role:
+            body["serving_role"] = serving_role
         if load_metrics is not None:
             body["load_metrics"] = load_metrics.to_json()
         if latency_metrics is not None:
@@ -139,6 +142,10 @@ class HeartbeatLoop:
                     self._collect_latency() if self._collect_latency else None
                 ),
                 cache_event=event,
+                # Role reconciliation: the master compares against its
+                # registry and re-sends /flip on mismatch (a dropped or
+                # restart-lost notification self-heals within one beat).
+                serving_role=self._meta.current_type.name,
             )
         except Exception:
             self._pending_event = event

@@ -390,6 +390,17 @@ class Master:
             temperature=float(body.get("temperature", 1.0)),
             top_p=float(body.get("top_p", 1.0)),
         )
+        raw_stop = body.get("stop")
+        if raw_stop is not None:
+            if isinstance(raw_stop, str):
+                raw_stop = [raw_stop]
+            if not isinstance(raw_stop, list) or not all(
+                isinstance(s, str) for s in raw_stop
+            ):
+                raise ValueError("stop must be a string or array of strings")
+            if len(raw_stop) > 4:
+                raise ValueError("stop supports at most 4 sequences")
+            req.stop = [s for s in raw_stop if s]
         if chat:
             req.messages = parse_messages(body.get("messages", []))
             req.tools = body.get("tools")
@@ -639,6 +650,18 @@ class Master:
             latency_metrics=LatencyMetrics.from_json(lat) if lat else None,
             cache_event=KvCacheEvent.from_json(cache) if cache else None,
         )
+        # Role reconciliation (flip notifications are best-effort + bounded
+        # retry; a restart or a dropped event would otherwise desync the
+        # engine's serving role from the registry forever): on mismatch,
+        # queue a fresh notification.
+        reported = body.get("serving_role", "")
+        meta = self.scheduler.instance_mgr.get_instance(name)
+        if (
+            reported
+            and meta is not None
+            and reported != meta.current_type.name
+        ):
+            self.scheduler.instance_mgr.requeue_flip(name, 1)
         h.send_json({"ok": True})
 
     def _handle_generations(self, h: QuietHandler, body: Dict[str, Any]) -> None:

@@ -45,6 +45,10 @@ class ServiceRequest:
     messages: List[Message] = field(default_factory=list)
     tools: Optional[List[Dict[str, Any]]] = None
     token_ids: List[int] = field(default_factory=list)
+    # OpenAI `stop`: up to 4 strings; generation halts BEFORE any of them
+    # appears. Enforced service-side on detokenized text (the engine speaks
+    # token ids; stop strings can span token boundaries).
+    stop: List[str] = field(default_factory=list)
     routing: Routing = field(default_factory=Routing)
     created_time: float = field(default_factory=time.time)
     # EPD multimodal (filled by the scheduler's media expansion): raw media
@@ -110,3 +114,48 @@ class RequestTracer:
             if self._fh is not None:
                 self._fh.close()
                 self._fh = None
+
+
+class StopStringMonitor:
+    """Streaming stop-sequence matcher with partial-match hold-back.
+
+    Text flows through `push`; anything that could still be the start of a
+    stop string is held until disambiguated, so a stop spanning chunk (or
+    token) boundaries is caught and NEVER partially emitted. OpenAI
+    semantics: output ends BEFORE the matched stop string.
+    """
+
+    def __init__(self, stops: List[str]):
+        self.stops = [s for s in stops if s]
+        self.stopped = False
+        self._buf = ""
+
+    def push(self, text: str) -> "tuple[str, bool]":
+        """Returns (emittable_text, hit)."""
+        if not self.stops or self.stopped:
+            return ("", True) if self.stopped else (text, False)
+        self._buf += text
+        first = -1
+        for s in self.stops:
+            j = self._buf.find(s)
+            if j != -1 and (first == -1 or j < first):
+                first = j
+        if first != -1:
+            out, self._buf = self._buf[:first], ""
+            self.stopped = True
+            return out, True
+        # Hold back the longest suffix that is a proper prefix of any stop.
+        hold = 0
+        for s in self.stops:
+            for k in range(min(len(s) - 1, len(self._buf)), hold, -1):
+                if self._buf.endswith(s[:k]):
+                    hold = k
+                    break
+        cut = len(self._buf) - hold
+        out, self._buf = self._buf[:cut], self._buf[cut:]
+        return out, False
+
+    def flush(self) -> str:
+        """Natural end of generation: release any held-back partial."""
+        out, self._buf = self._buf, ""
+        return out

@@ -46,7 +46,11 @@ from xllm_service_tpu.common.types import (
 from xllm_service_tpu.coordination.election import MasterElection
 from xllm_service_tpu.coordination.store import CoordinationStore, connect
 from xllm_service_tpu.service.ordered_streams import OrderedStreams
-from xllm_service_tpu.service.request import RequestTracer, ServiceRequest
+from xllm_service_tpu.service.request import (
+    RequestTracer,
+    ServiceRequest,
+    StopStringMonitor,
+)
 from xllm_service_tpu.service.response_handler import (
     ClientStream,
     ResponseHandler,
@@ -73,6 +77,8 @@ class _RequestState:
     redispatch_count: int = 0
     first_chunk_sent: bool = False
     prefill_finished: bool = False
+    # Per-sequence stop-string matchers (OpenAI `stop`), lazily created.
+    stop_monitors: Dict[int, "StopStringMonitor"] = field(default_factory=dict)
     # accumulated per-sequence state for non-stream responses
     acc: Dict[int, SequenceOutput] = field(default_factory=dict)
     usage: Optional[Usage] = None
@@ -428,6 +434,8 @@ class Scheduler:
             # queued in the lane — never write after the exchange ended.
             return
         request = state.request
+        if request.stop:
+            self._apply_stop_strings(state, output)
         new_tokens = sum(len(seq.token_ids) for seq in output.outputs)
         if new_tokens:
             request.num_generated_tokens += new_tokens
@@ -478,6 +486,69 @@ class Scheduler:
                 cancelled=not output.status.ok()
                 and output.status.code == StatusCode.CANCELLED,
             )
+
+    def _apply_stop_strings(
+        self, state: _RequestState, output: RequestOutput
+    ) -> None:
+        """OpenAI `stop` sequences, enforced on the service tier where the
+        detokenized text stream lives (stops can span token boundaries —
+        each sequence's matcher holds back partial matches). When every
+        sequence has stopped, the output is force-finished and the engine
+        side is cancelled (it would otherwise keep generating discarded
+        tokens)."""
+        request = state.request
+        for seq in output.outputs:
+            mon = state.stop_monitors.get(seq.index)
+            if mon is None:
+                mon = state.stop_monitors[seq.index] = StopStringMonitor(
+                    request.stop
+                )
+            if mon.stopped:
+                # Post-stop tail from the engine: drop entirely.
+                seq.text = ""
+                seq.token_ids = []
+                seq.logprobs = []
+                continue
+            pushed = seq.text or ""
+            emit, hit = mon.push(pushed)
+            if hit:
+                seq.finish_reason = FinishReason.STOP
+                # Align token-level fields with the truncated text: exact
+                # per-token boundaries aren't visible at this tier (the
+                # instance detokenized), so keep a character-proportional
+                # share of this chunk's tokens — post-stop tokens must not
+                # leak into logprobs/usage/GENERATE metrics.
+                if pushed and seq.token_ids:
+                    import math as _math
+
+                    keep = min(
+                        len(seq.token_ids),
+                        _math.ceil(
+                            len(emit) / len(pushed) * len(seq.token_ids)
+                        ),
+                    )
+                    seq.token_ids = seq.token_ids[:keep]
+                    seq.logprobs = seq.logprobs[:keep]
+            elif output.finished or seq.finish_reason != FinishReason.NONE:
+                # THIS sequence ended naturally (n>1: a child can finish
+                # before the request-level finished flag) — release any
+                # held-back stop-prefix text.
+                emit += mon.flush()
+            seq.text = emit
+        n = max(request.n, 1)
+        if (
+            not output.finished
+            and len(state.stop_monitors) >= n
+            and all(m.stopped for m in state.stop_monitors.values())
+        ):
+            output.finished = True
+            # Stop the engine's generation; the finish below is CLEAN
+            # (finish_reason stop), not a client cancel.
+            if state.cancel_callback is not None:
+                try:
+                    state.cancel_callback()
+                except Exception:
+                    pass
 
     def _accumulate(self, state: _RequestState, output: RequestOutput) -> None:
         accumulate_sequences(state.acc, output)
