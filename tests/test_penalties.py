@@ -1,0 +1,119 @@
+"""Presence/frequency penalties (OpenAI sampling surface): on-device
+generated-token histograms fused into the sampling step.
+
+Semantics (vLLM-style, documented in SamplingParams): penalties cover
+GENERATED tokens only; presence subtracts a flat amount per seen token,
+frequency per occurrence. The histogram is donated through every decode
+step and (re)seeded from the sequence's generation history on admission —
+so preemption-resume and PD import keep penalty state exact.
+"""
+
+import threading
+
+import numpy as np
+import jax.numpy as jnp
+
+from xllm_service_tpu.common.config import EngineConfig
+from xllm_service_tpu.ops import sampling as sampling_ops
+from xllm_service_tpu.ops.sampling import SamplingParams
+from xllm_service_tpu.runtime.engine import EngineRequest, InferenceEngine
+from xllm_service_tpu.runtime.executor import ModelExecutor
+
+
+def test_apply_penalties_math():
+    R, V = 2, 16
+    logits = jnp.zeros((R, V))
+    counts = (
+        jnp.zeros((R, V), jnp.int32).at[0, 3].set(2).at[1, 5].set(1)
+    )
+    out = np.asarray(
+        sampling_ops.apply_penalties(
+            logits, counts,
+            jnp.asarray([0.5, 0.0]), jnp.asarray([0.25, 1.0]),
+        )
+    )
+    assert np.isclose(out[0, 3], -0.5 - 0.25 * 2)
+    assert np.isclose(out[1, 5], -1.0)
+    assert np.isclose(out[0, 0], 0.0)  # unseen tokens untouched
+    # zero penalties = exact no-op (the runtime-skip branch)
+    same = np.asarray(
+        sampling_ops.apply_penalties(
+            logits, counts, jnp.zeros(R), jnp.zeros(R)
+        )
+    )
+    np.testing.assert_array_equal(same, np.asarray(logits))
+
+
+def _engine():
+    cfg = EngineConfig(
+        model="llama3-tiny", dtype="float32", block_size=16,
+        num_blocks=64, max_running_requests=4, max_seq_len=256,
+        prefill_buckets=[32, 64, 128],
+    )
+    ex = ModelExecutor(cfg, init_seed=5)
+    return InferenceEngine(cfg, executor=ex)
+
+
+def _run(eng, rid, pp, fp, n=24, prompt=(5, 9, 13)):
+    toks, done = [], threading.Event()
+
+    def cb(out):
+        for s in out.outputs:
+            toks.extend(s.token_ids)
+        if out.finished:
+            done.set()
+        return True
+
+    eng.add_request(
+        EngineRequest(
+            request_id=rid, prompt_token_ids=list(prompt),
+            sampling=SamplingParams(
+                temperature=0.0, max_new_tokens=n,
+                presence_penalty=pp, frequency_penalty=fp,
+            ),
+            callback=cb,
+        )
+    )
+    assert done.wait(120)
+    return toks
+
+
+def test_engine_frequency_penalty_kills_repeats():
+    eng = _engine()
+    eng.start()
+    try:
+        base = _run(eng, "base", 0.0, 0.0)
+        pen = _run(eng, "pen", 0.0, 50.0)
+    finally:
+        eng.stop()
+    # A huge frequency penalty makes greedy argmax unable to repeat ANY
+    # generated token; the unpenalized tiny model repeats.
+    assert len(set(pen)) == len(pen), pen
+    assert len(set(base)) < len(base)
+
+
+def test_zero_penalty_is_bit_identical():
+    """Adding the penalty machinery must not perturb the no-penalty path."""
+    eng = _engine()
+    eng.start()
+    try:
+        a = _run(eng, "a", 0.0, 0.0, n=12)
+        b = _run(eng, "b", 0.0, 0.0, n=12)
+    finally:
+        eng.stop()
+    assert a == b
+
+
+def test_counts_reseed_on_slot_reuse():
+    """A new request reusing a slot must not inherit the previous
+    occupant's histogram (seed_slot_counts clears the row)."""
+    eng = _engine()
+    eng.start()
+    try:
+        first = _run(eng, "one", 0.0, 50.0, n=10)
+        second = _run(eng, "two", 0.0, 50.0, n=10)
+    finally:
+        eng.stop()
+    # Same prompt + params: identical streams — any count leakage from
+    # the first run would shift the second.
+    assert first == second

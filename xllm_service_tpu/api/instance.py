@@ -80,6 +80,8 @@ def sampling_from_body(body: Dict[str, Any], cfg: EngineConfig) -> SamplingParam
         top_logprobs=top_lp if top_lp else (int(lp) if isinstance(lp, int) else 0),
         max_new_tokens=max_tokens or cfg.max_new_tokens_default,
         ignore_eos=bool(body.get("ignore_eos", False)),
+        presence_penalty=float(body.get("presence_penalty", 0.0) or 0.0),
+        frequency_penalty=float(body.get("frequency_penalty", 0.0) or 0.0),
     )
 
 
@@ -524,7 +526,7 @@ class InstanceServer:
             for k in (
                 "max_tokens", "max_completion_tokens", "temperature",
                 "top_p", "top_k", "seed", "logprobs", "top_logprobs",
-                "ignore_eos",
+                "ignore_eos", "presence_penalty", "frequency_penalty",
             )
             if k in body
         }

@@ -31,6 +31,11 @@ class SamplingParams:
     max_new_tokens: int = 512
     stop_token_ids: tuple = ()
     ignore_eos: bool = False
+    # OpenAI penalties over GENERATED tokens (vLLM semantics — the prompt
+    # is not penalized): presence subtracts a flat amount from every
+    # already-sampled token's logit; frequency subtracts per occurrence.
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
 
     @property
     def greedy(self) -> bool:
@@ -63,15 +68,41 @@ def apply_top_k_top_p(
     return jnp.where(keep, logits, NEG_INF)
 
 
+def apply_penalties(
+    logits: jnp.ndarray,  # [R, V] float32
+    counts: jnp.ndarray,  # [R, V] int32 — generated-token occurrence counts
+    presence: jnp.ndarray,  # [R] float32
+    frequency: jnp.ndarray,  # [R] float32
+) -> jnp.ndarray:
+    """OpenAI presence/frequency penalties over generated tokens. The
+    count update (scatter-add of the sampled token) lives with the caller
+    so the counts array can be donated through the decode step. Skipped at
+    runtime (lax.cond) when no live row has a penalty — the [R, V]
+    elementwise pass is real HBM traffic at V~128K."""
+    active = (presence != 0.0) | (frequency != 0.0)
+
+    def apply(x):
+        cf = counts.astype(jnp.float32)
+        seen = (counts > 0).astype(jnp.float32)
+        return x - presence[:, None] * seen - frequency[:, None] * cf
+
+    return jax.lax.cond(jnp.any(active), apply, lambda x: x, logits)
+
+
 def sample_tokens(
     logits: jnp.ndarray,  # [R, V] float32
     temperature: jnp.ndarray,  # [R] float32; <=0 means greedy
     top_k: jnp.ndarray,  # [R] int32; 0 disables
     top_p: jnp.ndarray,  # [R] float32; 1.0 disables
     step_keys: jnp.ndarray,  # [R, 2] uint32 PRNG keys (pre-folded per step)
+    counts: jnp.ndarray | None = None,  # [R, V] int32 generated-token counts
+    presence: jnp.ndarray | None = None,  # [R] float32
+    frequency: jnp.ndarray | None = None,  # [R] float32
 ):
     """Returns (token_ids [R], logprob_of_chosen [R], logprobs [R, V])."""
     logits = logits.astype(jnp.float32)
+    if counts is not None and presence is not None and frequency is not None:
+        logits = apply_penalties(logits, counts, presence, frequency)
     logprobs_full = jax.nn.log_softmax(logits, axis=-1)
 
     greedy_ids = jnp.argmax(logits, axis=-1)

@@ -556,6 +556,20 @@ class InferenceEngine:
         self._commit_full_blocks(seq)
         seq.generated.append((tok, lp))
         seq.tokens.append(tok)
+        # Penalty state: (re)build this slot's generated-token histogram —
+        # fresh admission carries one token, preemption/PD resume the full
+        # history. Skipped for penalty-free requests (the common case):
+        # their counts are never READ, and any later penalized occupant of
+        # the slot re-seeds on its own admission — so the prefill hot path
+        # avoids a scatter over the donated [R, V] histogram.
+        s = seq.req.sampling
+        if (
+            getattr(s, "presence_penalty", 0.0)
+            or getattr(s, "frequency_penalty", 0.0)
+        ) and hasattr(self.executor, "seed_slot_counts"):
+            self.executor.seed_slot_counts(
+                seq.slot, [t for t, _ in seq.generated]
+            )
         self._running[seq.slot] = seq
         alive = self._emit(seq, finished=self._check_stop(seq))
         if alive and seq.req.prefill_only:
@@ -866,6 +880,8 @@ class InferenceEngine:
         top_ps = np.ones((self.R,), np.float32)
         seeds = np.zeros((self.R,), np.uint32)
         steps = np.zeros((self.R,), np.int32)
+        presence = np.zeros((self.R,), np.float32)
+        frequency = np.zeros((self.R,), np.float32)
         self._block_tables[:] = 0
 
         for slot, seq in self._running.items():
@@ -880,6 +896,8 @@ class InferenceEngine:
             top_ps[slot] = s.top_p
             seeds[slot] = s.seed & 0xFFFFFFFF
             steps[slot] = len(seq.generated)
+            presence[slot] = getattr(s, "presence_penalty", 0.0)
+            frequency[slot] = getattr(s, "frequency_penalty", 0.0)
 
         t0 = time.monotonic()
         tokens, logprobs = self.executor.decode(
@@ -887,7 +905,9 @@ class InferenceEngine:
             positions,
             self._block_tables,
             active,
-            SamplingBatch(temps, top_ks, top_ps, seeds, steps),
+            SamplingBatch(
+                temps, top_ks, top_ps, seeds, steps, presence, frequency
+            ),
         )
         step_ms = (time.monotonic() - t0) * 1000
         nactive = int(active.sum())

@@ -52,6 +52,9 @@ class SamplingBatch:
     top_p: np.ndarray  # [R] float32
     seeds: np.ndarray  # [R] uint32
     steps: np.ndarray  # [R] int32 (per-request generated-token count)
+    # OpenAI penalties over generated tokens; None = all zeros (no penalty).
+    presence: Optional[np.ndarray] = None  # [R] float32
+    frequency: Optional[np.ndarray] = None  # [R] float32
 
 
 @dataclass
@@ -238,8 +241,15 @@ class ModelExecutor:
                     None,
                 )
 
+        # Generated-token histogram per slot (presence/frequency penalties).
+        # int32 [R, V] — 32 MB at V=128K, R=64; donated through every step.
+        with self.mesh:
+            self.token_counts = jax.jit(
+                lambda: jnp.zeros((self.R, self.cfg.vocab_size), jnp.int32)
+            )()
         self._decode_jit = jax.jit(
-            self._decode_impl, donate_argnums=(0, 1), static_argnames=("use_kernel",)
+            self._decode_impl, donate_argnums=(0, 1, 2),
+            static_argnames=("use_kernel",)
         )
         self._prefill_jit = jax.jit(
             self._prefill_impl, donate_argnums=(0, 1)
@@ -344,6 +354,7 @@ class ModelExecutor:
         self,
         k_cache,
         v_cache,
+        counts,  # [R, V] int32 generated-token histogram (donated)
         params,
         token_ids,
         positions,
@@ -353,6 +364,8 @@ class ModelExecutor:
         top_k,
         top_p,
         step_keys,
+        presence,
+        frequency,
         use_kernel=None,
     ):
         logits, k_cache, v_cache = self.model_mod.decode_step(
@@ -367,9 +380,13 @@ class ModelExecutor:
             use_kernel=use_kernel,
         )
         tokens, logprob, _ = sampling_ops.sample_tokens(
-            logits, temperature, top_k, top_p, step_keys
+            logits, temperature, top_k, top_p, step_keys,
+            counts=counts, presence=presence, frequency=frequency,
         )
-        return k_cache, v_cache, tokens, logprob
+        counts = counts.at[
+            jnp.arange(tokens.shape[0]), tokens
+        ].add(active.astype(jnp.int32))
+        return k_cache, v_cache, counts, tokens, logprob
 
     def _prefill_impl(
         self,
@@ -392,6 +409,11 @@ class ModelExecutor:
             true_len, block_tables,
             embed_overrides=mm_embeds, override_positions=mm_positions,
         )
+        # Known limitation: presence/frequency penalties are not applied to
+        # THIS token (the one sampled at (re)admission) — counts live in
+        # the decode state and seed after the prefill lands. One token per
+        # preemption/PD-resume may repeat where a penalty would have
+        # suppressed it; every decode-step token is penalized exactly.
         tokens, logprob, _ = sampling_ops.sample_tokens(
             logits, temperature, top_k, top_p, step_keys
         )
@@ -739,9 +761,16 @@ class ModelExecutor:
                 + 1
             )
         CB = self._pow2_bucket(need, self.max_blocks_per_seq)
-        self.k_cache, self.v_cache, tokens, logprobs = self._decode_jit(
+        R = self.R
+        zeros = np.zeros((R,), np.float32)
+        presence = batch.presence if batch.presence is not None else zeros
+        frequency = batch.frequency if batch.frequency is not None else zeros
+        (
+            self.k_cache, self.v_cache, self.token_counts, tokens, logprobs,
+        ) = self._decode_jit(
             self.k_cache,
             self.v_cache,
+            self.token_counts,
             self.params,
             jnp.asarray(token_ids, jnp.int32),
             jnp.asarray(positions, jnp.int32),
@@ -751,9 +780,33 @@ class ModelExecutor:
             jnp.asarray(batch.top_k, jnp.int32),
             jnp.asarray(batch.top_p, jnp.float32),
             keys,
+            jnp.asarray(presence, jnp.float32),
+            jnp.asarray(frequency, jnp.float32),
             use_kernel=use_kernel,
         )
         return np.asarray(tokens), np.asarray(logprobs)
+
+    def seed_slot_counts(self, slot: int, generated: "List[int]") -> None:
+        """(Re)build one slot's generated-token histogram — on admission
+        (fresh: the prefill's first token) and on resume (preemption / PD
+        import carry full generation history). Penalties depend on it."""
+        if not hasattr(self, "_seed_counts_jit"):
+            def _impl(counts, slot_, toks, n):
+                counts = counts.at[slot_].set(0)
+                ids = jnp.where(
+                    jnp.arange(toks.shape[0]) < n, toks, 0
+                )
+                add = (jnp.arange(toks.shape[0]) < n).astype(jnp.int32)
+                return counts.at[slot_, ids].add(add)
+
+            self._seed_counts_jit = jax.jit(_impl, donate_argnums=(0,))
+        P = self._pow2_bucket(max(len(generated), 1), 1 << 30)
+        toks = np.zeros((P,), np.int32)
+        toks[: len(generated)] = generated
+        self.token_counts = self._seed_counts_jit(
+            self.token_counts, jnp.int32(slot), jnp.asarray(toks),
+            jnp.int32(len(generated)),
+        )
 
     # ------------------------------------------------- KV block migration
 

@@ -79,6 +79,8 @@ class _RequestState:
     prefill_finished: bool = False
     # Per-sequence stop-string matchers (OpenAI `stop`), lazily created.
     stop_monitors: Dict[int, "StopStringMonitor"] = field(default_factory=dict)
+    # Generated tokens dropped by stop truncation (subtracted from usage).
+    stop_dropped: int = 0
     # accumulated per-sequence state for non-stream responses
     acc: Dict[int, SequenceOutput] = field(default_factory=dict)
     usage: Optional[Usage] = None
@@ -436,6 +438,12 @@ class Scheduler:
         request = state.request
         if request.stop:
             self._apply_stop_strings(state, output)
+            if output.usage is not None and state.stop_dropped:
+                # The engine's cumulative usage counts tokens the stop
+                # truncation dropped — report what the client received.
+                output.usage.num_generated_tokens = max(
+                    0, output.usage.num_generated_tokens - state.stop_dropped
+                )
         new_tokens = sum(len(seq.token_ids) for seq in output.outputs)
         if new_tokens:
             request.num_generated_tokens += new_tokens
@@ -504,10 +512,16 @@ class Scheduler:
                     request.stop
                 )
             if mon.stopped:
-                # Post-stop tail from the engine: drop entirely.
+                # Post-stop tail from the engine: drop entirely, and keep
+                # asserting the STOP reason — the engine's later natural
+                # finish (length/eos) must not overwrite it in accumulation
+                # or emit a contradictory finish_reason delta (n>1: the
+                # engine keeps generating this child until all stop).
+                state.stop_dropped += len(seq.token_ids)
                 seq.text = ""
                 seq.token_ids = []
                 seq.logprobs = []
+                seq.finish_reason = FinishReason.STOP
                 continue
             pushed = seq.text or ""
             emit, hit = mon.push(pushed)
@@ -527,6 +541,7 @@ class Scheduler:
                             len(emit) / len(pushed) * len(seq.token_ids)
                         ),
                     )
+                    state.stop_dropped += len(seq.token_ids) - keep
                     seq.token_ids = seq.token_ids[:keep]
                     seq.logprobs = seq.logprobs[:keep]
             elif output.finished or seq.finish_reason != FinishReason.NONE:
