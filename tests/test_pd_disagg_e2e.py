@@ -35,7 +35,7 @@ def pd_stack():
     store = MemoryStore()
     cfg = ServiceConfig(
         host="127.0.0.1", http_port=0, rpc_port=0,
-        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=5.0,
         load_balance_policy="RR", block_size=BLOCK,
     )
     master = Master(cfg, store=store)
@@ -66,7 +66,7 @@ def colocated():
     store = MemoryStore()
     cfg = ServiceConfig(
         host="127.0.0.1", http_port=0, rpc_port=0,
-        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=5.0,
         load_balance_policy="RR", block_size=BLOCK,
     )
     master = Master(cfg, store=store)
@@ -137,7 +137,7 @@ def relay_stack():
     store = MemoryStore()
     cfg = ServiceConfig(
         host="127.0.0.1", http_port=0, rpc_port=0,
-        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=5.0,
         load_balance_policy="RR", block_size=BLOCK,
         enable_decode_response_to_service=False,
     )
@@ -207,7 +207,7 @@ def local_transfer_stack():
     store = MemoryStore()
     cfg = ServiceConfig(
         host="127.0.0.1", http_port=0, rpc_port=0,
-        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=5.0,
         load_balance_policy="RR", block_size=BLOCK,
     )
     master = Master(cfg, store=store)
