@@ -27,6 +27,7 @@ def make_master(store, **kw):
     cfg = ServiceConfig(
         host="127.0.0.1", http_port=0, rpc_port=0,
         heartbeat_interval_s=0.2, master_lease_ttl_s=1.0,
+        instance_lease_min_ttl_s=0.0,
         load_balance_policy="RR", block_size=16,
         detect_disconnected_instance_interval_s=1.0, **kw,
     )

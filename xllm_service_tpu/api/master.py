@@ -616,7 +616,10 @@ class Master:
         if not meta.name:
             h.send_error_json(400, "meta.name required")
             return
-        ttl = 3.0 * self.config.heartbeat_interval_s
+        ttl = max(
+            3.0 * self.config.heartbeat_interval_s,
+            self.config.instance_lease_min_ttl_s,
+        )
         lease = self._store.grant_lease(ttl)
         self._store.set(instance_key(meta), meta.serialize(), lease_id=lease)
         with self._leases_mu:

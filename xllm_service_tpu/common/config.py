@@ -51,6 +51,12 @@ class ServiceConfig:
     heartbeat_interval_s: float = 3.0
     master_lease_ttl_s: float = 3.0
     detect_disconnected_instance_interval_s: float = 15.0
+    # Floor on the instance-registration lease TTL (the TTL is otherwise
+    # 3x the heartbeat interval). An engine whose heartbeat thread stalls
+    # behind a long GIL-holding XLA trace/compile must not be pruned as
+    # dead mid-generation; fault-injection tests that WANT fast expiry
+    # lower this explicitly.
+    instance_lease_min_ttl_s: float = 10.0
 
     # Tokenizer / template (reference: --tokenizer_path).
     tokenizer_path: str = ""
