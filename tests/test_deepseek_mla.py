@@ -52,7 +52,10 @@ def _oracle_tokens(ex, prompt, n):
     return seq[len(prompt):]
 
 
-@pytest.mark.parametrize("model", ["deepseek-tiny", "deepseek-moe-tiny"])
+@pytest.mark.parametrize(
+    "model",
+    ["deepseek-tiny", "deepseek-moe-tiny", "deepseek-hetero-tiny"],
+)
 def test_paged_matches_dense_oracle(model):
     """Prefill (blockwise over latent blocks) + absorbed paged decode equal
     the naive dense forward, greedy, token-for-token."""

@@ -51,8 +51,10 @@ def _tree_equal(a, b):
         get_model_config("moe-tiny"),
         get_model_config("deepseek-tiny"),
         get_model_config("deepseek-moe-tiny"),
+        get_model_config("deepseek-hetero-tiny"),
     ],
-    ids=["llama", "qwen-bias", "moe", "mla", "mla-moe-shared"],
+    ids=["llama", "qwen-bias", "moe", "mla", "mla-moe-shared",
+         "mla-hetero"],
 )
 def test_save_load_roundtrip(cfg, tmp_path):
     from xllm_service_tpu import models
@@ -74,7 +76,7 @@ def test_save_load_roundtrip(cfg, tmp_path):
               "tie_word_embeddings", "num_experts", "num_experts_per_tok",
               "attn_bias", "kv_lora_rank", "q_lora_rank",
               "qk_nope_head_dim", "qk_rope_head_dim", "v_head_dim",
-              "n_shared_experts"):
+              "n_shared_experts", "first_k_dense_replace"):
         assert getattr(loaded_cfg, f) == getattr(cfg, f), f
     if not cfg.is_mla:  # MLA ignores head_dim; HF derives it differently
         assert loaded_cfg.head_dim == cfg.head_dim
@@ -223,3 +225,33 @@ def test_executor_serves_from_checkpoint(tmp_path):
             p += 1
         outs.append(toks)
     assert outs[0] == outs[1]
+
+
+def test_executor_serves_hetero_checkpoint(tmp_path):
+    """A heterogeneous DeepSeek checkpoint (dense prefix + MoE suffix,
+    first_k_dense_replace=1) loads through the executor's sharded path and
+    serves: loaded params match, greedy prefill tokens agree."""
+    ecfg = EngineConfig(model="deepseek-hetero-tiny", dtype="float32",
+                       num_blocks=32, max_running_requests=2,
+                       max_seq_len=128, prefill_buckets=[32])
+    ref = ModelExecutor(ecfg, init_seed=3)
+    assert "dense_layers" in ref.params
+    ckpt = str(tmp_path / "ckpt")
+    weights.save_hf_checkpoint(ref.params, ref.cfg, ckpt)
+
+    loaded_cfg = weights.config_from_hf(ckpt)
+    assert loaded_cfg.first_k_dense_replace == 1
+
+    ecfg2 = EngineConfig(model="deepseek-hetero-tiny", dtype="float32",
+                        checkpoint_path=ckpt, num_blocks=32,
+                        max_running_requests=2, max_seq_len=128,
+                        prefill_buckets=[32])
+    exe = ModelExecutor(ecfg2, init_seed=0)
+    _tree_equal(ref.params, exe.params)
+
+    prompt = (np.arange(12, dtype=np.int32) * 7 + 1) % ref.cfg.vocab_size
+    table = np.zeros((ref.max_blocks_per_seq,), np.int32)
+    table[0] = 2
+    t_ref, _ = ref.prefill(prompt, 0, table)
+    t_exe, _ = exe.prefill(prompt, 0, table)
+    assert t_ref == t_exe

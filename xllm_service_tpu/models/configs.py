@@ -46,6 +46,12 @@ class ModelConfig:
     # MoE shared experts (DeepSeek style): dense FFN of
     # n_shared_experts * moe_intermediate_size always active.
     n_shared_experts: int = 0
+    # DeepSeek-V2/V3 heterogeneous stack: the first k layers use a dense
+    # SwiGLU of `intermediate_size` instead of the MoE block (HF config
+    # first_k_dense_replace). The param pytree splits into a `dense_layers`
+    # prefix stack and the MoE `layers` suffix stack; each runs its own
+    # lax.scan (models/deepseek.py _scan_stack).
+    first_k_dense_replace: int = 0
 
     @property
     def is_moe(self) -> bool:
@@ -232,6 +238,32 @@ register(
 
 register(
     ModelConfig(
+        # Real-V2/V3 shape: dense first layer + MoE suffix (HF
+        # first_k_dense_replace) — drives the split-stack pytree paths.
+        name="deepseek-hetero-tiny",
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=3,
+        num_heads=4,
+        num_kv_heads=4,
+        head_dim=32,
+        kv_lora_rank=40,
+        q_lora_rank=48,
+        qk_nope_head_dim=32,
+        qk_rope_head_dim=16,
+        v_head_dim=24,
+        num_experts=8,
+        num_experts_per_tok=2,
+        moe_intermediate_size=64,
+        n_shared_experts=2,
+        first_k_dense_replace=1,
+        max_position_embeddings=1024,
+    )
+)
+
+register(
+    ModelConfig(
         name="mixtral-8x7b",
         vocab_size=32000,
         hidden_size=4096,
@@ -269,6 +301,7 @@ register(
         num_experts_per_tok=8,
         moe_intermediate_size=2048,
         n_shared_experts=1,
+        first_k_dense_replace=3,  # V3: first 3 layers dense
         rms_norm_eps=1e-6,
     )
 )

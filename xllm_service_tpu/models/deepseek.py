@@ -51,13 +51,16 @@ def cache_row_dims(cfg: ModelConfig) -> Tuple[int, int]:
     return 1, cfg.mla_cache_dim
 
 
-def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
-    E, L = cfg.hidden_size, cfg.num_layers
-    Hq = cfg.num_heads
+def _layer_stack(
+    cfg: ModelConfig, key: jax.Array, dtype, n: int, moe: bool
+) -> Dict[str, jnp.ndarray]:
+    """One stacked-layer leaf dict of `n` layers: MLA attention plus either
+    the MoE block (`moe=True`, dims from moe_intermediate_size) or a dense
+    SwiGLU (`moe=False`, dims from intermediate_size)."""
+    E, Hq = cfg.hidden_size, cfg.num_heads
     dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
     kvr, qr = cfg.kv_lora_rank, cfg.q_lora_rank
-    F = cfg.intermediate_size
-    keys = jax.random.split(key, 20)
+    keys = jax.random.split(key, 14)
 
     def norm_init(shape):
         return jnp.ones(shape, dtype=jnp.float32)
@@ -68,58 +71,121 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
         ).astype(dtype)
 
     layers: Dict[str, jnp.ndarray] = {
-        "attn_norm": norm_init((L, E)),
-        "mlp_norm": norm_init((L, E)),
+        "attn_norm": norm_init((n, E)),
+        "mlp_norm": norm_init((n, E)),
         # KV down-projection to the shared latent + rope key.
-        "w_dkv": w(keys[0], (L, E, kvr + dr), E),
-        "kv_norm": norm_init((L, kvr)),
+        "w_dkv": w(keys[0], (n, E, kvr + dr), E),
+        "kv_norm": norm_init((n, kvr)),
         # Per-head up-projections OUT of the latent space.
-        "w_uk": w(keys[1], (L, Hq, kvr, dn), kvr),
-        "w_uv": w(keys[2], (L, Hq, kvr, dv), kvr),
-        "wo": w(keys[3], (L, Hq * dv, E), Hq * dv),
+        "w_uk": w(keys[1], (n, Hq, kvr, dn), kvr),
+        "w_uv": w(keys[2], (n, Hq, kvr, dv), kvr),
+        "wo": w(keys[3], (n, Hq * dv, E), Hq * dv),
     }
     if qr > 0:
-        layers["w_dq"] = w(keys[4], (L, E, qr), E)
-        layers["q_norm"] = norm_init((L, qr))
-        layers["w_uq"] = w(keys[5], (L, qr, Hq * (dn + dr)), qr)
+        layers["w_dq"] = w(keys[4], (n, E, qr), E)
+        layers["q_norm"] = norm_init((n, qr))
+        layers["w_uq"] = w(keys[5], (n, qr, Hq * (dn + dr)), qr)
     else:
-        layers["w_q"] = w(keys[5], (L, E, Hq * (dn + dr)), E)
-    if cfg.is_moe:
+        layers["w_q"] = w(keys[5], (n, E, Hq * (dn + dr)), E)
+    if moe:
         X, Fm = cfg.num_experts, cfg.moe_intermediate_size
         layers.update(
             {
-                "router": w(keys[6], (L, E, X), E),
-                "w_gate": w(keys[7], (L, X, E, Fm), E),
-                "w_up": w(keys[8], (L, X, E, Fm), E),
-                "w_down": w(keys[9], (L, X, Fm, E), Fm),
+                "router": w(keys[6], (n, E, X), E),
+                "w_gate": w(keys[7], (n, X, E, Fm), E),
+                "w_up": w(keys[8], (n, X, E, Fm), E),
+                "w_down": w(keys[9], (n, X, Fm, E), Fm),
             }
         )
         if cfg.n_shared_experts > 0:
             Fs = cfg.n_shared_experts * Fm
             layers.update(
                 {
-                    "w_sh_gate": w(keys[10], (L, E, Fs), E),
-                    "w_sh_up": w(keys[11], (L, E, Fs), E),
-                    "w_sh_down": w(keys[12], (L, Fs, E), Fs),
+                    "w_sh_gate": w(keys[10], (n, E, Fs), E),
+                    "w_sh_up": w(keys[11], (n, E, Fs), E),
+                    "w_sh_down": w(keys[12], (n, Fs, E), Fs),
                 }
             )
     else:
+        F = cfg.intermediate_size
         layers.update(
             {
-                "w_gate": w(keys[7], (L, E, F), E),
-                "w_up": w(keys[8], (L, E, F), E),
-                "w_down": w(keys[9], (L, F, E), F),
+                "w_gate": w(keys[7], (n, E, F), E),
+                "w_up": w(keys[8], (n, E, F), E),
+                "w_down": w(keys[9], (n, F, E), F),
             }
         )
+    return layers
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Param pytree. With first_k_dense_replace > 0 (real DeepSeek-V2/V3:
+    HF config first_k_dense_replace, the first layers dense) the stack
+    splits: `dense_layers` holds the k-layer dense prefix, `layers` the
+    (L - k)-layer MoE suffix — each runs its own lax.scan."""
+    E, L = cfg.hidden_size, cfg.num_layers
+    kd = cfg.first_k_dense_replace
+    k_embed, k_lm, k_stack, k_dense = jax.random.split(key, 4)
+
+    def w(key, shape, fan_in):
+        return (
+            jax.random.normal(key, shape, jnp.float32) / jnp.sqrt(fan_in)
+        ).astype(dtype)
 
     params: Params = {
-        "embed": w(keys[13], (cfg.vocab_size, E), E),
-        "layers": layers,
-        "final_norm": norm_init((E,)),
+        "embed": w(k_embed, (cfg.vocab_size, E), E),
+        "layers": _layer_stack(cfg, k_stack, dtype, L - kd, cfg.is_moe),
+        "final_norm": jnp.ones((E,), jnp.float32),
     }
+    if kd > 0:
+        params["dense_layers"] = _layer_stack(cfg, k_dense, dtype, kd, False)
     if not cfg.tie_word_embeddings:
-        params["lm_head"] = w(keys[14], (E, cfg.vocab_size), E)
+        params["lm_head"] = w(k_lm, (E, cfg.vocab_size), E)
     return params
+
+
+def _dense_cfg(cfg: ModelConfig) -> ModelConfig:
+    """cfg with MoE off — routes llama._mlp to its dense-SwiGLU branch for
+    the dense-prefix stack (trace-time only)."""
+    import dataclasses
+
+    return dataclasses.replace(cfg, num_experts=0)
+
+
+def _split_stack(tree, k: int):
+    return (
+        jax.tree_util.tree_map(lambda a: a[:k], tree),
+        jax.tree_util.tree_map(lambda a: a[k:], tree),
+    )
+
+
+def _concat_stack(a, b):
+    return jax.tree_util.tree_map(
+        lambda x, y: jnp.concatenate([x, y], axis=0), a, b
+    )
+
+
+def _scan_stack(params, cfg: ModelConfig, make_layer_fn, x, k_caches, v_caches):
+    """Apply the layer stack: one scan for a homogeneous model, or a
+    dense-prefix scan over cache[:k] followed by the MoE-suffix scan over
+    cache[k:] (first_k_dense_replace). The two cache outputs concatenate
+    back to the [L, ...] layout the executor owns; under donation XLA
+    writes the scan outputs directly into slices of the output buffer."""
+    kd = cfg.first_k_dense_replace if "dense_layers" in params else 0
+    if kd == 0:
+        x, (kc, vc) = jax.lax.scan(
+            make_layer_fn(cfg.is_moe), x, (params["layers"], k_caches, v_caches)
+        )
+        return x, kc, vc
+    kc_pre, kc_suf = _split_stack(k_caches, kd)
+    vc_pre, vc_suf = _split_stack(v_caches, kd)
+    x, (kc1, vc1) = jax.lax.scan(
+        make_layer_fn(False), x, (params["dense_layers"], kc_pre, vc_pre)
+    )
+    x, (kc2, vc2) = jax.lax.scan(
+        make_layer_fn(cfg.is_moe), x, (params["layers"], kc_suf, vc_suf)
+    )
+    return x, _concat_stack(kc1, kc2), _concat_stack(vc1, vc2)
 
 
 def _q_heads(lp, cfg: ModelConfig, h: jnp.ndarray, positions: jnp.ndarray):
@@ -185,24 +251,29 @@ def decode_step(
     blk = jnp.where(active, blk, 0)
     seq_lens = jnp.where(active, positions + 1, 0)
 
-    def layer_fn(x, scanned):
-        lp, c_l, v_l = scanned
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q_nope, q_pe = _q_heads(lp, cfg, h, positions)
-        rows = _latent_rows(lp, cfg, h, positions)
-        c_l = kv_cache_ops.scatter_rows(c_l, blk, offset, rows[:, None, :])
-        q_lat = _absorb_q(lp, q_nope, q_pe)
-        ctx = mla_paged_attention(
-            q_lat, c_l, block_tables, seq_lens, scale, kvr,
-            use_kernel=use_kernel,
-        )
-        x = x + _attn_out(lp, cfg, ctx)
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, cfg, h)
-        return x, (c_l, v_l)
+    def make_layer_fn(moe: bool):
+        mcfg = cfg if moe else _dense_cfg(cfg)
 
-    x, (k_caches, v_caches) = jax.lax.scan(
-        layer_fn, x, (params["layers"], k_caches, v_caches)
+        def layer_fn(x, scanned):
+            lp, c_l, v_l = scanned
+            h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            q_nope, q_pe = _q_heads(lp, cfg, h, positions)
+            rows = _latent_rows(lp, cfg, h, positions)
+            c_l = kv_cache_ops.scatter_rows(c_l, blk, offset, rows[:, None, :])
+            q_lat = _absorb_q(lp, q_nope, q_pe)
+            ctx = mla_paged_attention(
+                q_lat, c_l, block_tables, seq_lens, scale, kvr,
+                use_kernel=use_kernel,
+            )
+            x = x + _attn_out(lp, cfg, ctx)
+            h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+            x = x + _mlp(lp, mcfg, h)
+            return x, (c_l, v_l)
+
+        return layer_fn
+
+    x, k_caches, v_caches = _scan_stack(
+        params, cfg, make_layer_fn, x, k_caches, v_caches
     )
     logits = _unembed(params, cfg, x)
     return logits, k_caches, v_caches
@@ -247,32 +318,37 @@ def prefill_batch_step(
     flat_blk = blk.reshape(P * Lpad)
     flat_off = in_block.reshape(P * Lpad)
 
-    def layer_fn(x, scanned):
-        lp, c_l, v_l = scanned
-        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q_nope, q_pe = jax.vmap(
-            lambda hx, pos: _q_heads(lp, cfg, hx, pos)
-        )(h, positions)  # [P, Lpad, Hq, *]
-        rows = jax.vmap(lambda hx, pos: _latent_rows(lp, cfg, hx, pos))(
-            h, positions
-        )  # [P, Lpad, C]
-        c_l = kv_cache_ops.scatter_rows(
-            c_l, flat_blk, flat_off,
-            rows.reshape(P * Lpad, 1, rows.shape[-1]),
-        )
-        q_lat = _absorb_q(lp, q_nope, q_pe)  # [P, Lpad, Hq, C]
-        ctx = jax.vmap(
-            lambda qi, ti, sp, tl: mla_prefill_blockwise(
-                qi, c_l, ti, sp, tl, scale, kvr
-            )
-        )(q_lat, block_tables, start_pos, true_len)  # [P, Lpad, Hq, kvr]
-        x = x + _attn_out(lp, cfg, ctx)
-        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + jax.vmap(lambda t: _mlp(lp, cfg, t))(h)
-        return x, (c_l, v_l)
+    def make_layer_fn(moe: bool):
+        mcfg = cfg if moe else _dense_cfg(cfg)
 
-    x, (k_caches, v_caches) = jax.lax.scan(
-        layer_fn, x, (params["layers"], k_caches, v_caches)
+        def layer_fn(x, scanned):
+            lp, c_l, v_l = scanned
+            h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            q_nope, q_pe = jax.vmap(
+                lambda hx, pos: _q_heads(lp, cfg, hx, pos)
+            )(h, positions)  # [P, Lpad, Hq, *]
+            rows = jax.vmap(lambda hx, pos: _latent_rows(lp, cfg, hx, pos))(
+                h, positions
+            )  # [P, Lpad, C]
+            c_l = kv_cache_ops.scatter_rows(
+                c_l, flat_blk, flat_off,
+                rows.reshape(P * Lpad, 1, rows.shape[-1]),
+            )
+            q_lat = _absorb_q(lp, q_nope, q_pe)  # [P, Lpad, Hq, C]
+            ctx = jax.vmap(
+                lambda qi, ti, sp, tl: mla_prefill_blockwise(
+                    qi, c_l, ti, sp, tl, scale, kvr
+                )
+            )(q_lat, block_tables, start_pos, true_len)  # [P, Lpad, Hq, kvr]
+            x = x + _attn_out(lp, cfg, ctx)
+            h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+            x = x + jax.vmap(lambda t: _mlp(lp, mcfg, t))(h)
+            return x, (c_l, v_l)
+
+        return layer_fn
+
+    x, k_caches, v_caches = _scan_stack(
+        params, cfg, make_layer_fn, x, k_caches, v_caches
     )
     last = jnp.take_along_axis(
         x, jnp.maximum(true_len - 1, 0)[:, None, None], axis=1
@@ -310,34 +386,41 @@ def hidden_dense(
         jnp.arange(L)[None, :] <= jnp.arange(L)[:, None]
     )  # [L, L] True = attend
 
-    def layer_fn(x, lp):
-        def one_seq(hx):
-            h = rms_norm(hx, lp["attn_norm"], cfg.rms_norm_eps)
-            q_nope, q_pe = _q_heads(lp, cfg, h, positions)
-            rows = _latent_rows(lp, cfg, h, positions)  # [L, C]
-            c, k_pe = rows[..., :kvr], rows[..., kvr:]
-            k_nope = jnp.einsum("tk,hkd->thd", c, lp["w_uk"])  # [L,Hq,dn]
-            v = jnp.einsum("tk,hkv->thv", c, lp["w_uv"])  # [L,Hq,dv]
-            k_pe_b = jnp.broadcast_to(
-                k_pe[:, None, :], (L, cfg.num_heads, dr)
-            )
-            q = jnp.concatenate([q_nope, q_pe], axis=-1).astype(jnp.float32)
-            k = jnp.concatenate([k_nope, k_pe_b], axis=-1).astype(jnp.float32)
-            scores = jnp.einsum("qhd,khd->hqk", q, k) * scale
-            scores = jnp.where(causal[None], scores, -1e30)
-            p = jax.nn.softmax(scores, axis=-1)
-            # v is ALREADY up-projected per head — apply only wo here
-            # (_attn_out would apply W_UV a second time; caught by the
-            # paged-vs-dense parity test once tiny dims were made
-            # pairwise distinct).
-            o = jnp.einsum("hqk,khv->qhv", p, v.astype(jnp.float32))
-            flat = o.reshape(L, cfg.num_heads * cfg.v_head_dim)
-            attn = jnp.einsum("qf,fe->qe", flat.astype(hx.dtype), lp["wo"])
-            hx = hx + attn
-            h2 = rms_norm(hx, lp["mlp_norm"], cfg.rms_norm_eps)
-            return hx + _mlp(lp, cfg, h2)
+    def make_layer_fn(moe: bool):
+        mcfg = cfg if moe else _dense_cfg(cfg)
 
-        return jax.vmap(one_seq)(x), None
+        def layer_fn(x, lp):
+            def one_seq(hx):
+                h = rms_norm(hx, lp["attn_norm"], cfg.rms_norm_eps)
+                q_nope, q_pe = _q_heads(lp, cfg, h, positions)
+                rows = _latent_rows(lp, cfg, h, positions)  # [L, C]
+                c, k_pe = rows[..., :kvr], rows[..., kvr:]
+                k_nope = jnp.einsum("tk,hkd->thd", c, lp["w_uk"])  # [L,Hq,dn]
+                v = jnp.einsum("tk,hkv->thv", c, lp["w_uv"])  # [L,Hq,dv]
+                k_pe_b = jnp.broadcast_to(
+                    k_pe[:, None, :], (L, cfg.num_heads, dr)
+                )
+                q = jnp.concatenate([q_nope, q_pe], axis=-1).astype(jnp.float32)
+                k = jnp.concatenate([k_nope, k_pe_b], axis=-1).astype(jnp.float32)
+                scores = jnp.einsum("qhd,khd->hqk", q, k) * scale
+                scores = jnp.where(causal[None], scores, -1e30)
+                p = jax.nn.softmax(scores, axis=-1)
+                # v is ALREADY up-projected per head — apply only wo here
+                # (_attn_out would apply W_UV a second time; caught by the
+                # paged-vs-dense parity test once tiny dims were made
+                # pairwise distinct).
+                o = jnp.einsum("hqk,khv->qhv", p, v.astype(jnp.float32))
+                flat = o.reshape(L, cfg.num_heads * cfg.v_head_dim)
+                attn = jnp.einsum("qf,fe->qe", flat.astype(hx.dtype), lp["wo"])
+                hx = hx + attn
+                h2 = rms_norm(hx, lp["mlp_norm"], cfg.rms_norm_eps)
+                return hx + _mlp(lp, mcfg, h2)
 
-    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+            return jax.vmap(one_seq)(x), None
+
+        return layer_fn
+
+    if cfg.first_k_dense_replace > 0 and "dense_layers" in params:
+        x, _ = jax.lax.scan(make_layer_fn(False), x, params["dense_layers"])
+    x, _ = jax.lax.scan(make_layer_fn(cfg.is_moe), x, params["layers"])
     return rms_norm(x, params["final_norm"], cfg.rms_norm_eps)

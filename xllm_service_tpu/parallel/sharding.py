@@ -116,6 +116,27 @@ def param_shardings(
         "layers": layers,
         "final_norm": ns(None),
     }
+    if cfg.first_k_dense_replace > 0:
+        # Heterogeneous DeepSeek stack: the dense prefix carries the same
+        # MLA attention specs plus dense-SwiGLU MLP specs (models/deepseek
+        # _layer_stack(moe=False)).
+        dense = {
+            k: v
+            for k, v in layers.items()
+            if k
+            not in (
+                "router", "w_gate", "w_up", "w_down",
+                "w_sh_gate", "w_sh_up", "w_sh_down",
+            )
+        }
+        dense.update(
+            {
+                "w_gate": ns(None, None, tp),
+                "w_up": ns(None, None, tp),
+                "w_down": ns(None, tp, None),
+            }
+        )
+        out["dense_layers"] = dense
     if not cfg.tie_word_embeddings:
         out["lm_head"] = ns(None, tp)
     return out
@@ -160,6 +181,12 @@ def check_tp_divisibility(cfg: ModelConfig, tp: int, ep: int = 1) -> None:
         elif cfg.num_experts % tp:
             raise ValueError(
                 f"tp={tp} must divide num_experts={cfg.num_experts}"
+            )
+        # Heterogeneous stack: the dense prefix shards intermediate_size.
+        if cfg.first_k_dense_replace > 0 and cfg.intermediate_size % tp:
+            raise ValueError(
+                f"tp={tp} must divide dense-prefix intermediate="
+                f"{cfg.intermediate_size}"
             )
     elif cfg.intermediate_size % tp:
         raise ValueError(f"tp={tp} must divide intermediate={cfg.intermediate_size}")

@@ -163,18 +163,19 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
             moe_intermediate_size=hf["intermediate_size"],
         )
     elif arch in ("DeepseekV2ForCausalLM", "DeepseekV3ForCausalLM"):
-        # MLA family. Heterogeneous layer stacks (first_k_dense_replace /
-        # moe_layer_freq) are out of scope for the scan-stacked layout —
-        # fail loudly rather than mis-mapping.
-        if (
-            int(hf.get("first_k_dense_replace") or 0) > 0
-            or int(hf.get("moe_layer_freq") or 1) != 1
-        ):
+        # MLA family. first_k_dense_replace (real V2/V3: first layers
+        # dense) maps to the split dense-prefix/MoE-suffix stack; a
+        # non-unit moe_layer_freq (interleaved dense layers mid-stack)
+        # remains out of scope for the two-scan layout.
+        if int(hf.get("moe_layer_freq") or 1) != 1:
             raise NotImplementedError(
-                "DeepSeek checkpoints with first_k_dense_replace > 0 or "
-                "moe_layer_freq != 1 mix dense and MoE layers; the "
-                "stacked-layer pytree requires a homogeneous stack"
+                "DeepSeek checkpoints with moe_layer_freq != 1 interleave "
+                "dense and MoE layers mid-stack; only a dense PREFIX "
+                "(first_k_dense_replace) is supported"
             )
+        common["first_k_dense_replace"] = int(
+            hf.get("first_k_dense_replace") or 0
+        )
         common.update(
             kv_lora_rank=hf["kv_lora_rank"],
             q_lora_rank=int(hf.get("q_lora_rank") or 0),
@@ -257,6 +258,7 @@ def _hf_leaf(cfg: ModelConfig, hf_name: str):
         )
     if tail in simple:
         key, transpose = simple[tail]
+        key, layer = _route_stack(cfg, key, layer)
         return (key, layer, None, transpose)
     for prefix in ("block_sparse_moe.experts.", "mlp.experts."):
         if tail.startswith(prefix):
@@ -272,101 +274,136 @@ def _hf_leaf(cfg: ModelConfig, hf_name: str):
                 "down_proj.weight": "layers.w_down",
             }
             if w in moe:
-                return (moe[w], layer, expert, True)
+                key, layer = _route_stack(cfg, moe[w], layer)
+                return (key, layer, expert, True)
     return None
 
 
-def _leaf_shapes(cfg: ModelConfig) -> Dict[str, Tuple[int, ...]]:
-    """Target (host staging) shape per leaf key — mirrors the family
-    module's init_params. For MLA, the kv_b up-projection stages under the
-    pseudo leaf `layers._w_ukv` (HF interleaves k_nope and v per head in
-    one tensor); load_checkpoint splits it into w_uk/w_uv afterwards."""
-    E, L = cfg.hidden_size, cfg.num_layers
+def _route_stack(cfg: ModelConfig, key: str, layer: int) -> Tuple[str, int]:
+    """Heterogeneous DeepSeek stacks: HF layer i < first_k_dense_replace
+    lands in the `dense_layers` prefix stack (same leaf names, dense MLP
+    dims); later layers land in `layers` re-indexed from 0."""
+    kd = cfg.first_k_dense_replace
+    if kd == 0 or not key.startswith("layers."):
+        return key, layer
+    if layer < kd:
+        return "dense_layers." + key[len("layers."):], layer
+    return key, layer - kd
+
+
+def _stack_shapes(
+    cfg: ModelConfig, pre: str, L: int, moe: bool
+) -> Dict[str, Tuple[int, ...]]:
+    """Shapes of one stacked-layer leaf set (`pre` is "layers." or
+    "dense_layers."), mirroring the family module's _layer_stack/init."""
+    E = cfg.hidden_size
     Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     shapes: Dict[str, Tuple[int, ...]] = {
-        "embed": (cfg.vocab_size, E),
-        "final_norm": (E,),
-        "layers.attn_norm": (L, E),
-        "layers.mlp_norm": (L, E),
+        pre + "attn_norm": (L, E),
+        pre + "mlp_norm": (L, E),
     }
     if cfg.is_mla:
         dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
         kvr, qr = cfg.kv_lora_rank, cfg.q_lora_rank
         shapes.update(
             {
-                "layers.w_dkv": (L, E, kvr + dr),
-                "layers.kv_norm": (L, kvr),
-                "layers._w_ukv": (L, kvr, Hq * (dn + dv)),
-                "layers.wo": (L, Hq * dv, E),
+                pre + "w_dkv": (L, E, kvr + dr),
+                pre + "kv_norm": (L, kvr),
+                pre + "_w_ukv": (L, kvr, Hq * (dn + dv)),
+                pre + "wo": (L, Hq * dv, E),
             }
         )
         if qr > 0:
             shapes.update(
                 {
-                    "layers.w_dq": (L, E, qr),
-                    "layers.q_norm": (L, qr),
-                    "layers.w_uq": (L, qr, Hq * (dn + dr)),
+                    pre + "w_dq": (L, E, qr),
+                    pre + "q_norm": (L, qr),
+                    pre + "w_uq": (L, qr, Hq * (dn + dr)),
                 }
             )
         else:
-            shapes["layers.w_q"] = (L, E, Hq * (dn + dr))
+            shapes[pre + "w_q"] = (L, E, Hq * (dn + dr))
     else:
         shapes.update(
             {
-                "layers.wq": (L, E, Hq * D),
-                "layers.wk": (L, E, Hkv * D),
-                "layers.wv": (L, E, Hkv * D),
-                "layers.wo": (L, Hq * D, E),
+                pre + "wq": (L, E, Hq * D),
+                pre + "wk": (L, E, Hkv * D),
+                pre + "wv": (L, E, Hkv * D),
+                pre + "wo": (L, Hq * D, E),
             }
         )
         if cfg.attn_bias:
             shapes.update(
                 {
-                    "layers.bq": (L, Hq * D),
-                    "layers.bk": (L, Hkv * D),
-                    "layers.bv": (L, Hkv * D),
+                    pre + "bq": (L, Hq * D),
+                    pre + "bk": (L, Hkv * D),
+                    pre + "bv": (L, Hkv * D),
                 }
             )
-    if cfg.is_moe:
+    if moe:
         X, Fm = cfg.num_experts, cfg.moe_intermediate_size
         shapes.update(
             {
-                "layers.router": (L, E, X),
-                "layers.w_gate": (L, X, E, Fm),
-                "layers.w_up": (L, X, E, Fm),
-                "layers.w_down": (L, X, Fm, E),
+                pre + "router": (L, E, X),
+                pre + "w_gate": (L, X, E, Fm),
+                pre + "w_up": (L, X, E, Fm),
+                pre + "w_down": (L, X, Fm, E),
             }
         )
         if cfg.n_shared_experts > 0:
             Fs = cfg.n_shared_experts * Fm
             shapes.update(
                 {
-                    "layers.w_sh_gate": (L, E, Fs),
-                    "layers.w_sh_up": (L, E, Fs),
-                    "layers.w_sh_down": (L, Fs, E),
+                    pre + "w_sh_gate": (L, E, Fs),
+                    pre + "w_sh_up": (L, E, Fs),
+                    pre + "w_sh_down": (L, Fs, E),
                 }
             )
     else:
         F = cfg.intermediate_size
         shapes.update(
             {
-                "layers.w_gate": (L, E, F),
-                "layers.w_up": (L, E, F),
-                "layers.w_down": (L, F, E),
+                pre + "w_gate": (L, E, F),
+                pre + "w_up": (L, E, F),
+                pre + "w_down": (L, F, E),
             }
         )
+    return shapes
+
+
+def _leaf_shapes(cfg: ModelConfig) -> Dict[str, Tuple[int, ...]]:
+    """Target (host staging) shape per leaf key — mirrors the family
+    module's init_params. For MLA, the kv_b up-projection stages under the
+    pseudo leaf `layers._w_ukv` (HF interleaves k_nope and v per head in
+    one tensor); load_checkpoint splits it into w_uk/w_uv afterwards.
+    Heterogeneous DeepSeek stacks add a `dense_layers.` prefix set."""
+    E = cfg.hidden_size
+    kd = cfg.first_k_dense_replace
+    shapes: Dict[str, Tuple[int, ...]] = {
+        "embed": (cfg.vocab_size, E),
+        "final_norm": (E,),
+    }
+    shapes.update(
+        _stack_shapes(cfg, "layers.", cfg.num_layers - kd, cfg.is_moe)
+    )
+    if kd > 0:
+        shapes.update(_stack_shapes(cfg, "dense_layers.", kd, False))
     if not cfg.tie_word_embeddings:
         shapes["lm_head"] = (E, cfg.vocab_size)
     return shapes
 
 
-_NORM_LEAVES = {
+_NORM_SUFFIXES = (
     "final_norm",
-    "layers.attn_norm",
-    "layers.mlp_norm",
-    "layers.kv_norm",
-    "layers.q_norm",
-}
+    "attn_norm",
+    "mlp_norm",
+    "kv_norm",
+    "q_norm",
+)
+
+
+def _is_norm_leaf(key: str) -> bool:
+    return key.rsplit(".", 1)[-1] in _NORM_SUFFIXES
 
 
 def load_checkpoint(
@@ -390,21 +427,22 @@ def load_checkpoint(
         {"layers.w_gate", "layers.w_up", "layers.w_down"} if cfg.is_moe else set()
     )
     staging: Dict[str, np.ndarray] = {}
-    # Completeness tracking: [L] per layer leaf, [L, X] per expert leaf
-    # (every expert must land — a missing expert must raise, not serve
-    # np.empty garbage), [1] per top-level leaf.
+    # Completeness tracking: [stack_len] per layer leaf (leading dim of the
+    # leaf's shape — the stacks differ in length for heterogeneous models),
+    # [stack_len, X] per expert leaf (every expert must land — a missing
+    # expert must raise, not serve np.empty garbage), [1] per top-level.
     filled: Dict[str, np.ndarray] = {}
     for k, s in shapes.items():
         if k in expert_leaves:
-            filled[k] = np.zeros((cfg.num_layers, cfg.num_experts), bool)
-        elif k.startswith("layers."):
-            filled[k] = np.zeros(cfg.num_layers, bool)
+            filled[k] = np.zeros((s[0], cfg.num_experts), bool)
+        elif "." in k:
+            filled[k] = np.zeros(s[0], bool)
         else:
             filled[k] = np.zeros(1, bool)
 
     def stage(key: str) -> np.ndarray:
         if key not in staging:
-            want = np.float32 if key in _NORM_LEAVES else np_dtype
+            want = np.float32 if _is_norm_leaf(key) else np_dtype
             staging[key] = np.empty(shapes[key], dtype=want)
         return staging[key]
 
@@ -437,30 +475,34 @@ def load_checkpoint(
 
     if cfg.is_mla:
         # Split HF's interleaved kv_b up-projection into the absorbed-form
-        # tensors the model consumes: [L, kvr, Hq*(dn+dv)] ->
-        # w_uk [L, Hq, kvr, dn] + w_uv [L, Hq, kvr, dv].
+        # tensors the model consumes: [n, kvr, Hq*(dn+dv)] ->
+        # w_uk [n, Hq, kvr, dn] + w_uv [n, Hq, kvr, dv] — per stack.
         dn, dv = cfg.qk_nope_head_dim, cfg.v_head_dim
-        raw = staging.pop("layers._w_ukv").reshape(
-            cfg.num_layers, cfg.kv_lora_rank, cfg.num_heads, dn + dv
-        )
-        staging["layers.w_uk"] = np.ascontiguousarray(
-            np.transpose(raw[..., :dn], (0, 2, 1, 3))
-        )
-        staging["layers.w_uv"] = np.ascontiguousarray(
-            np.transpose(raw[..., dn:], (0, 2, 1, 3))
-        )
+        for pre in ("layers.", "dense_layers."):
+            if pre + "_w_ukv" not in staging:
+                continue
+            raw = staging.pop(pre + "_w_ukv")
+            raw = raw.reshape(
+                raw.shape[0], cfg.kv_lora_rank, cfg.num_heads, dn + dv
+            )
+            staging[pre + "w_uk"] = np.ascontiguousarray(
+                np.transpose(raw[..., :dn], (0, 2, 1, 3))
+            )
+            staging[pre + "w_uv"] = np.ascontiguousarray(
+                np.transpose(raw[..., dn:], (0, 2, 1, 3))
+            )
 
     params: Params = {"layers": {}}
+    if cfg.first_k_dense_replace > 0:
+        params["dense_layers"] = {}
     for key, buf in staging.items():
         leaf = jnp.asarray(buf)
+        stack, _, sub = key.partition(".")
         if shardings is not None:
-            if key.startswith("layers."):
-                sh = shardings["layers"][key.split(".", 1)[1]]
-            else:
-                sh = shardings[key]
+            sh = shardings[stack][sub] if sub else shardings[key]
             leaf = jax.device_put(leaf, sh)
-        if key.startswith("layers."):
-            params["layers"][key.split(".", 1)[1]] = leaf
+        if sub:
+            params[stack][sub] = leaf
         else:
             params[key] = leaf
     return params
@@ -508,7 +550,7 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
             qk_nope_head_dim=cfg.qk_nope_head_dim,
             qk_rope_head_dim=cfg.qk_rope_head_dim,
             v_head_dim=cfg.v_head_dim,
-            first_k_dense_replace=0,
+            first_k_dense_replace=cfg.first_k_dense_replace,
         )
         if cfg.is_moe:
             hf_cfg.update(
@@ -529,15 +571,21 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
         a = np.asarray(x)
         return a.astype(ml_dtypes.bfloat16) if a.dtype == ml_dtypes.bfloat16 else a
 
-    lp = params["layers"]
     tensors: Dict[str, np.ndarray] = {
         "model.embed_tokens.weight": host(params["embed"]),
         "model.norm.weight": host(params["final_norm"]),
     }
     if not cfg.tie_word_embeddings:
         tensors["lm_head.weight"] = host(params["lm_head"]).T
-    for i in range(cfg.num_layers):
-        pre = f"model.layers.{i}."
+    kd = cfg.first_k_dense_replace
+    for hf_i in range(cfg.num_layers):
+        # Heterogeneous stacks: HF layer hf_i < kd reads the dense-prefix
+        # stack (dense MLP names); later layers read the main stack.
+        if kd and hf_i < kd:
+            lp, i, layer_moe = params["dense_layers"], hf_i, False
+        else:
+            lp, i, layer_moe = params["layers"], hf_i - kd, cfg.is_moe
+        pre = f"model.layers.{hf_i}."
         tensors[pre + "input_layernorm.weight"] = host(lp["attn_norm"])[i]
         tensors[pre + "post_attention_layernorm.weight"] = host(lp["mlp_norm"])[i]
         if cfg.is_mla:
@@ -575,7 +623,7 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
                 tensors[pre + "self_attn.q_proj.bias"] = host(lp["bq"])[i]
                 tensors[pre + "self_attn.k_proj.bias"] = host(lp["bk"])[i]
                 tensors[pre + "self_attn.v_proj.bias"] = host(lp["bv"])[i]
-        if cfg.is_moe:
+        if layer_moe:
             gate_name, exp_pre, w_names = (
                 ("mlp.gate.weight", "mlp.experts.",
                  ("gate_proj.weight", "up_proj.weight", "down_proj.weight"))
