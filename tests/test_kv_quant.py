@@ -189,3 +189,47 @@ def test_export_import_roundtrip_int8():
     ex.import_blocks(jnp.asarray(out1), np.asarray([5, 6], np.int32))
     out2 = np.asarray(ex.export_blocks(np.asarray([5, 6], np.int32)))
     np.testing.assert_array_equal(out1, out2)
+
+
+def test_grouped_quantize_separates_segments():
+    """Sub-channel (grouped) scales: a row whose first segment is 100x the
+    second must not wash out the small segment's precision (the MLA
+    concat(c_kv, k_pe) case — ADVICE r2)."""
+    rng = np.random.default_rng(7)
+    D, G = 128, 2  # two 64-lane segments
+    big = rng.standard_normal((16, D // 2)) * 100.0
+    small = rng.standard_normal((16, D // 2)) * 0.5
+    rows = jnp.asarray(np.concatenate([big, small], axis=-1), jnp.float32)
+
+    q1, s1 = kvc.quantize_rows(rows)  # one scale per row
+    qg, sg = kvc.quantize_rows(rows, groups=G)
+    assert sg.shape == (16, G)
+    back1 = np.asarray(kvc.dequantize(q1, s1, jnp.float32))
+    backg = np.asarray(kvc.dequantize(qg, sg, jnp.float32))
+    err1 = np.abs(back1[:, D // 2:] - np.asarray(rows)[:, D // 2:]).max()
+    errg = np.abs(backg[:, D // 2:] - np.asarray(rows)[:, D // 2:]).max()
+    # Grouped error on the small segment is bounded by ITS OWN amax/254.
+    assert errg <= np.abs(small).max() / 254 + 1e-6
+    assert errg < err1 / 10  # single-scale error is dominated by `big`
+
+
+def test_set_rows_infers_groups_from_cache():
+    """A cache allocated with scale_groups quantizes writes per group and
+    gathers back with matching dequantization."""
+    rng = np.random.default_rng(8)
+    N, Hkv, BS, D, G = 4, 1, 8, 96, 3
+    cache = kvc.alloc_cache((N, Hkv, BS, D), jnp.float32, True, scale_groups=G)
+    assert cache.scale.shape == (N, Hkv, BS, G)
+    rows = jnp.asarray(rng.standard_normal((5, Hkv, D)), jnp.float32)
+    rows = rows * jnp.asarray([100.0] * 32 + [1.0] * 32 + [0.01] * 32)
+    blk = jnp.asarray([0, 1, 2, 3, 1], jnp.int32)
+    off = jnp.asarray([0, 1, 2, 3, 4], jnp.int32)
+    cache = kvc.scatter_rows(cache, blk, off, rows)
+    got = np.asarray(kvc.gather_blocks(cache, jnp.arange(N), jnp.float32))
+    for i, (b, o) in enumerate(zip([0, 1, 2, 3, 1], [0, 1, 2, 3, 4])):
+        seg = np.asarray(rows)[i, 0]
+        back = got[b, 0, o]
+        for g in range(G):
+            sl = slice(g * 32, (g + 1) * 32)
+            bound = np.abs(seg[sl]).max() / 254 + 1e-7
+            assert np.abs(back[sl] - seg[sl]).max() <= bound

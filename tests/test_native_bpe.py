@@ -222,3 +222,55 @@ def test_split_isolated_keeps_gaps(tmp_path):
     for text in ("abc 123 def", "99 monkeys 42", "no digits at all"):
         assert native.encode(text) == hf.encode(text), text
         assert native.decode(native.encode(text)) == text
+
+
+def test_chat_template_strftime_now(tok_dir):
+    """Stock Llama-3.1/3.2 templates call strftime_now for date_string —
+    the native Jinja env must provide it (ADVICE r2 medium)."""
+    import types
+
+    tok = create_tokenizer(tok_dir)
+    tok2 = types.SimpleNamespace(
+        chat_template=(
+            "{{ strftime_now('%Y') }}:"
+            "{% for m in messages %}{{ m['content'] }}{% endfor %}"
+        ),
+        bos_token=None, eos_token=None,
+    )
+    ct = ChatTemplate(tok2)
+    out = ct.apply(parse_messages([{"role": "user", "content": "hi"}]))
+    year, _, rest = out.partition(":")
+    assert year.isdigit() and len(year) == 4 and rest == "hi"
+
+
+def test_chat_template_render_failure_falls_back(tok_dir):
+    """A template referencing an unknown global degrades to the ChatML
+    fallback instead of failing the request (ADVICE r2 medium)."""
+    import types
+
+    tok2 = types.SimpleNamespace(
+        chat_template="{{ not_a_real_global() }}",
+        bos_token=None, eos_token=None,
+    )
+    ct = ChatTemplate(tok2)
+    out = ct.apply(parse_messages([{"role": "user", "content": "hi"}]))
+    assert out == "<|im_start|>user\nhi<|im_end|>\n<|im_start|>assistant\n"
+
+
+def test_chat_template_raise_exception_propagates(tok_dir):
+    """raise_exception() is the template REJECTING the conversation (role
+    alternation etc.) — a client error that must surface, not silently
+    degrade to the fallback prompt."""
+    import types
+
+    import pytest as _pytest
+
+    from xllm_service_tpu.tokenizer.chat_template import TemplateReject
+
+    tok2 = types.SimpleNamespace(
+        chat_template="{{ raise_exception('roles must alternate') }}",
+        bos_token=None, eos_token=None,
+    )
+    ct = ChatTemplate(tok2)
+    with _pytest.raises(TemplateReject, match="roles must alternate"):
+        ct.apply(parse_messages([{"role": "user", "content": "hi"}]))

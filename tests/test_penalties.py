@@ -117,3 +117,33 @@ def test_counts_reseed_on_slot_reuse():
     # Same prompt + params: identical streams — any count leakage from
     # the first run would shift the second.
     assert first == second
+
+
+def test_prefill_sampling_applies_penalties():
+    """The token sampled at (re)admission sees presence/frequency penalties
+    from prior generated tokens (ADVICE r2: previously the first token per
+    preemption/PD-resume escaped penalties)."""
+    from xllm_service_tpu.runtime.executor import PrefillItem
+
+    cfg = EngineConfig(
+        model="llama3-tiny", dtype="float32", block_size=16,
+        num_blocks=32, max_running_requests=4, max_seq_len=128,
+        prefill_buckets=[32],
+    )
+    ex = ModelExecutor(cfg, init_seed=11)
+    table = np.zeros((8,), np.int32)
+    table[0] = 1
+    base = PrefillItem(
+        token_ids=np.asarray([5, 9, 13], np.int32),
+        start_pos=0, block_table=table, temperature=0.0,
+    )
+    [(tok0, _)] = ex.prefill_batch([base])
+
+    penalized = PrefillItem(
+        token_ids=np.asarray([5, 9, 13], np.int32),
+        start_pos=0, block_table=table, temperature=0.0,
+        presence=50.0, frequency=50.0,
+        prior_tokens=np.asarray([tok0], np.int32),
+    )
+    [(tok1, _)] = ex.prefill_batch([penalized])
+    assert tok1 != tok0

@@ -52,6 +52,15 @@ class PagedKV(NamedTuple):
 CacheLike = Union[jnp.ndarray, PagedKV]
 
 
+def mla_scale_groups(kv_lora_rank: int, rope_dim: int) -> int:
+    """Scale-group count for an int8 MLA latent cache row of
+    kv_lora_rank + rope_dim lanes: group size gcd(kvr, rope) puts the
+    latent/RoPE boundary on a group boundary (see quantize_rows)."""
+    import math
+
+    return (kv_lora_rank + rope_dim) // math.gcd(kv_lora_rank, rope_dim)
+
+
 def as_paged(cache: CacheLike) -> PagedKV:
     return cache if isinstance(cache, PagedKV) else PagedKV(cache, None)
 
@@ -61,18 +70,41 @@ def raw(cache: CacheLike) -> jnp.ndarray:
     return cache.data if isinstance(cache, PagedKV) else cache
 
 
-def quantize_rows(rows: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """rows [..., D] -> (int8 [..., D], scale [...]) symmetric per-row."""
-    amax = jnp.max(jnp.abs(rows.astype(jnp.float32)), axis=-1)
+def quantize_rows(
+    rows: jnp.ndarray, groups: int = 1
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """rows [..., D] -> (int8 [..., D], scale) symmetric per-row.
+
+    groups=1: one scale per row (scale [...]).
+    groups=S: sub-channel quantization — the D lanes split into S equal
+    segments, each with its own scale (scale [..., S]). Used for MLA latent
+    caches, where one scale across concat(c_kv, k_pe) lets whichever
+    segment has the smaller magnitude lose precision to the other; a group
+    size dividing kv_lora_rank puts the latent/RoPE boundary on a group
+    boundary so the segments quantize independently (ADVICE r2)."""
+    f = rows.astype(jnp.float32)
+    if groups > 1:
+        g = f.reshape(*f.shape[:-1], groups, f.shape[-1] // groups)
+        scale = jnp.maximum(jnp.max(jnp.abs(g), axis=-1), 1e-8) / 127.0
+        q = jnp.clip(jnp.round(g / scale[..., None]), -127, 127)
+        return q.reshape(rows.shape).astype(jnp.int8), scale
+    amax = jnp.max(jnp.abs(f), axis=-1)
     scale = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(
-        jnp.round(rows.astype(jnp.float32) / scale[..., None]), -127, 127
-    ).astype(jnp.int8)
+    q = jnp.clip(jnp.round(f / scale[..., None]), -127, 127).astype(jnp.int8)
     return q, scale
 
 
 def dequantize(data: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.bfloat16):
-    """data int8 [..., D], scale [...] -> [..., D] in `dtype`."""
+    """data int8 [..., D], scale [...] or [..., S] (grouped) -> [..., D].
+
+    Grouping is inferred from rank: scale.ndim == data.ndim means the last
+    scale axis is the per-row group count."""
+    if scale.ndim == data.ndim:
+        S = scale.shape[-1]
+        g = data.astype(jnp.float32).reshape(
+            *data.shape[:-1], S, data.shape[-1] // S
+        )
+        return (g * scale[..., None]).reshape(data.shape).astype(dtype)
     return (data.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
@@ -83,7 +115,12 @@ def set_rows(cache: CacheLike, data_index, scale_index, rows: jnp.ndarray):
     quantization branch lives — scatter_rows / PD import / SP scatter all
     route through here."""
     if isinstance(cache, PagedKV) and cache.quantized:
-        q, s = quantize_rows(rows)
+        groups = (
+            cache.scale.shape[-1]
+            if cache.scale.ndim == cache.data.ndim
+            else 1
+        )
+        q, s = quantize_rows(rows, groups)
         return PagedKV(
             cache.data.at[data_index].set(q),
             cache.scale.at[scale_index].set(s),
@@ -135,9 +172,13 @@ def alloc_cache(
     shape: Tuple[int, ...],  # [..., N, Hkv, BS, D]
     dtype,
     quantized: bool,
+    scale_groups: int = 1,
 ) -> PagedKV:
     if quantized:
+        scale_shape = (
+            shape[:-1] + (scale_groups,) if scale_groups > 1 else shape[:-1]
+        )
         return PagedKV(
-            jnp.zeros(shape, jnp.int8), jnp.zeros(shape[:-1], jnp.float32)
+            jnp.zeros(shape, jnp.int8), jnp.zeros(scale_shape, jnp.float32)
         )
     return PagedKV(jnp.zeros(shape, dtype), None)

@@ -460,10 +460,22 @@ class InferenceEngine:
             # the cached prefix. Mid-chunk seqs (prefilled > num_cached)
             # stay on the batched path — the ring would discard the chunks
             # already landed.
+            # Penalized RESUMES (generated history + presence/frequency
+            # set) also stay batched: prefill_long samples without the
+            # penalty histogram, so routing one through SP would let the
+            # resumed token escape its penalties.
+            def _penalized_resume(s):
+                sp = s.req.sampling
+                return s.generated and (
+                    getattr(sp, "presence_penalty", 0.0)
+                    or getattr(sp, "frequency_penalty", 0.0)
+                )
+
             sp_batch = [
                 s
                 for s in batch
                 if not s.req.has_media
+                and not _penalized_resume(s)
                 and s.prefilled <= s.num_cached
                 and len(s.tokens) - s.num_cached >= sp_thresh
                 and len(s.tokens) - s.num_cached >= 8 * s.num_cached
@@ -501,6 +513,23 @@ class InferenceEngine:
                     mm_positions=(
                         np.asarray(seq.req.mm_positions, np.int64)
                         if seq.req.has_media
+                        else None
+                    ),
+                    presence=getattr(s, "presence_penalty", 0.0),
+                    frequency=getattr(s, "frequency_penalty", 0.0),
+                    # Only the FINAL chunk's sampled token survives, so
+                    # intermediate chunks skip the [P, V] histogram (and
+                    # the penalized compiled variant) entirely.
+                    prior_tokens=(
+                        np.asarray(
+                            [t for t, _ in seq.generated], np.int32
+                        )
+                        if seq.generated
+                        and start + n >= len(seq.tokens)
+                        and (
+                            getattr(s, "presence_penalty", 0.0)
+                            or getattr(s, "frequency_penalty", 0.0)
+                        )
                         else None
                     ),
                 )

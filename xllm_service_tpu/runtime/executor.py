@@ -73,6 +73,11 @@ class PrefillItem:
     # placeholder rows at these ABSOLUTE prompt positions.
     mm_embeds: Optional[np.ndarray] = None
     mm_positions: Optional[np.ndarray] = None
+    # Penalty state for the token sampled at (re)admission: prior generated
+    # tokens (non-empty on preemption/PD resume) and the penalty strengths.
+    presence: float = 0.0
+    frequency: float = 0.0
+    prior_tokens: Optional[np.ndarray] = None
 
 
 _COMPILATION_CACHE_DIR: Optional[str] = None
@@ -226,10 +231,18 @@ class ModelExecutor:
                 self.k_cache, self.v_cache = alloc()
             else:
                 # Latent cache rides the k slot; v is a 1-element dummy
-                # threaded through the step scans untouched.
+                # threaded through the step scans untouched. Int8 uses
+                # sub-channel scales with the group boundary on
+                # kv_lora_rank, so the latent and RoPE segments of each
+                # concat(c_kv, k_pe) row quantize independently.
+                groups = 1
+                if self.kv_quantized:
+                    groups = kvc.mla_scale_groups(
+                        self.cfg.kv_lora_rank, self.cfg.qk_rope_head_dim
+                    )
                 alloc = jax.jit(
                     lambda: kvc.alloc_cache(
-                        cache_shape, self.dtype, self.kv_quantized
+                        cache_shape, self.dtype, self.kv_quantized, groups
                     ),
                     out_shardings=cache_sharding,
                 )
@@ -300,9 +313,14 @@ class ModelExecutor:
                 + cfg.num_heads * cfg.head_dim * E
             )
         mlp = 3 * E * F + 3 * E * cfg.n_shared_experts * cfg.moe_intermediate_size
+        # Heterogeneous DeepSeek stacks: the dense prefix uses the (much
+        # smaller) dense SwiGLU instead of the MoE block.
+        kd = cfg.first_k_dense_replace
+        mlp_total = (L - kd) * mlp + kd * 3 * E * cfg.intermediate_size
         n_params = (
             cfg.vocab_size * E * (1 if cfg.tie_word_embeddings else 2)
-            + L * (attn + mlp)
+            + L * attn
+            + mlp_total
         )
         try:
             stats = jax.devices()[0].memory_stats() or {}
@@ -318,9 +336,18 @@ class ModelExecutor:
             - n_params * bytes_per_param / tp
         ) / 2
         cache_heads, cache_dim = models.cache_row_dims(self.cfg)
-        # int8 cache: 1 byte/element + 4-byte f32 scale per row.
+        # int8 cache: 1 byte/element + 4-byte f32 scale per scale group
+        # (1 group/row for GQA; MLA rows carry cache_dim/gcd groups — must
+        # match the alloc path's grouping or the pool oversizes).
+        scale_groups = 1
+        if self.kv_quantized and self.cfg.is_mla:
+            scale_groups = kvc.mla_scale_groups(
+                self.cfg.kv_lora_rank, self.cfg.qk_rope_head_dim
+            )
         kv_elem_bytes = (
-            1 + 4.0 / cache_dim if self.kv_quantized else bytes_per_param
+            1 + 4.0 * scale_groups / cache_dim
+            if self.kv_quantized
+            else bytes_per_param
         )
         # MLA's latent cache is replicated (no KV-head axis to shard).
         heads_per_dev = (
@@ -403,19 +430,23 @@ class ModelExecutor:
         step_keys,  # [P]
         mm_embeds=None,  # [P, M, E] or None
         mm_positions=None,  # [P, M] chunk-relative (pad = Lpad)
+        counts=None,  # [P, V] prior-token histogram (penalized items only)
+        presence=None,  # [P]
+        frequency=None,  # [P]
     ):
         logits, k_cache, v_cache = self.model_mod.prefill_batch_step(
             params, self.cfg, k_cache, v_cache, token_ids, start_pos,
             true_len, block_tables,
             embed_overrides=mm_embeds, override_positions=mm_positions,
         )
-        # Known limitation: presence/frequency penalties are not applied to
-        # THIS token (the one sampled at (re)admission) — counts live in
-        # the decode state and seed after the prefill lands. One token per
-        # preemption/PD-resume may repeat where a penalty would have
-        # suppressed it; every decode-step token is penalized exactly.
+        # Penalties at (re)admission: when any item in the group carries
+        # presence/frequency penalties, the caller passes its prior-token
+        # histogram so the token sampled HERE is penalized exactly like
+        # every decode-step token (ADVICE r2). Penalty-free groups (the
+        # common case) skip the [P, V] transfer entirely.
         tokens, logprob, _ = sampling_ops.sample_tokens(
-            logits, temperature, top_k, top_p, step_keys
+            logits, temperature, top_k, top_p, step_keys,
+            counts=counts, presence=presence, frequency=frequency,
         )
         return k_cache, v_cache, tokens, logprob
 
@@ -525,6 +556,32 @@ class ModelExecutor:
                 positions[i, : mm_counts[i]] = rel[keep]
                 embeds[i, : mm_counts[i]] = np.asarray(it.mm_embeds)[keep]
             mm_args = (jnp.asarray(embeds), jnp.asarray(positions))
+        # Penalized (re)admissions: ship each item's prior-token histogram
+        # so the prefill-sampled token sees the same penalties a decode
+        # step would. Gated on PRIOR TOKENS actually existing — a fresh
+        # penalized prompt has an all-zero histogram (exact no-op), and
+        # shipping it would cost a [P, V] transfer + an unwarmed compile
+        # per shape.
+        pen_kwargs = {}
+        if any(
+            it.prior_tokens is not None and len(it.prior_tokens)
+            for it in group
+        ):
+            cnts = np.zeros((P, self.cfg.vocab_size), np.int32)
+            pres = np.zeros((P,), np.float32)
+            freq = np.zeros((P,), np.float32)
+            for i, it in enumerate(group):
+                pres[i] = it.presence
+                freq[i] = it.frequency
+                if it.prior_tokens is not None and len(it.prior_tokens):
+                    np.add.at(
+                        cnts[i], np.asarray(it.prior_tokens, np.int64), 1
+                    )
+            pen_kwargs = dict(
+                counts=jnp.asarray(cnts),
+                presence=jnp.asarray(pres),
+                frequency=jnp.asarray(freq),
+            )
         self.k_cache, self.v_cache, toks, lps = self._prefill_jit(
             self.k_cache,
             self.v_cache,
@@ -538,6 +595,7 @@ class ModelExecutor:
             jnp.asarray(top_ps),
             keys,
             *mm_args,
+            **pen_kwargs,
         )
         toks = np.asarray(toks)
         lps = np.asarray(lps)

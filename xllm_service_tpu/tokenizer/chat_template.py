@@ -18,6 +18,12 @@ from typing import Any, Dict, List, Optional, Union
 from xllm_service_tpu.tokenizer.tokenizer import HFTokenizer, Tokenizer
 
 
+class TemplateReject(ValueError):
+    """Raised when a chat template's own raise_exception() rejects the
+    conversation (e.g. role-alternation checks) — a client error, never
+    swallowed by the render-failure fallback."""
+
+
 @dataclass
 class MMContentPart:
     """One multimodal content part (reference: MMContent,
@@ -92,19 +98,30 @@ class ChatTemplate:
         # same context HF's apply_chat_template provides: special-token
         # strings and raise_exception (stock templates use both).
         self._compiled = None
+        self._render_warned = False
         self._special_ctx: Dict[str, Any] = {}
         template = getattr(tokenizer, "chat_template", None)
         if template and self._hf is None:
             import jinja2
 
             def raise_exception(message):
-                raise jinja2.exceptions.TemplateError(message)
+                raise TemplateReject(message)
 
             env = jinja2.Environment(
                 trim_blocks=True, lstrip_blocks=True,
                 extensions=["jinja2.ext.loopcontrols"],
             )
             env.globals["raise_exception"] = raise_exception
+
+            def strftime_now(fmt):
+                # Stock Llama-3.1/3.2-Instruct templates call
+                # strftime_now("%d %b %Y") for date_string; HF injects the
+                # same callable into apply_chat_template's environment.
+                import datetime
+
+                return datetime.datetime.now().strftime(fmt)
+
+            env.globals["strftime_now"] = strftime_now
             self._compiled = env.from_string(template)
             self._special_ctx = {
                 "bos_token": getattr(tokenizer, "bos_token", None) or "",
@@ -124,12 +141,32 @@ class ChatTemplate:
                 add_generation_prompt=True,
             )
         if self._compiled is not None:
-            return self._compiled.render(
-                messages=[m.to_hf() for m in messages],
-                tools=tools,
-                add_generation_prompt=True,
-                **self._special_ctx,
-            )
+            try:
+                return self._compiled.render(
+                    messages=[m.to_hf() for m in messages],
+                    tools=tools,
+                    add_generation_prompt=True,
+                    **self._special_ctx,
+                )
+            except TemplateReject:
+                # The template itself rejected the conversation via
+                # raise_exception (e.g. role-alternation checks) — a real
+                # client error that must fail the request, same as the HF
+                # path would.
+                raise
+            except Exception as e:
+                # A template referencing a global we don't provide must not
+                # fail the request — degrade to the deterministic template,
+                # loudly (once) so silent format corruption is diagnosable.
+                if not self._render_warned:
+                    self._render_warned = True
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "chat template render failed (%s: %s); falling back "
+                        "to the ChatML template for this tokenizer",
+                        type(e).__name__, e,
+                    )
         return self._fallback(messages, tools)
 
     @staticmethod
