@@ -1,0 +1,46 @@
+"""Subprocess body for the cross-process PD e2e test: run ONE decode
+instance (own JAX runtime, own process) registered to the parent
+process's master, with the pull-plane KV transfer server enabled.
+
+Argv: master_rpc_addr block_size. Runs until killed by the parent.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=1"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig
+
+    master_rpc, block = sys.argv[1], int(sys.argv[2])
+    inst = InstanceServer(
+        EngineConfig(
+            model="llama3-tiny", dtype="float32", block_size=block,
+            num_blocks=64, max_running_requests=4, max_seq_len=256,
+            prefill_buckets=[32, 64, 128],
+            instance_name="dec-proc", instance_type="DECODE",
+            enable_local_kv_transfer=False,
+            enable_kv_transfer_server=True,
+        ),
+        master_rpc_addr=master_rpc,
+        heartbeat_interval_s=0.2,
+    )
+    inst.start()
+    print("DECODE_READY", flush=True)
+    import time
+
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

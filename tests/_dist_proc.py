@@ -1,0 +1,48 @@
+"""Subprocess body for the multi-host bootstrap test: join a 2-process
+jax.distributed group on the CPU backend (4 virtual devices per process),
+build a GLOBAL 8-device mesh, and run one psum to prove cross-process
+collectives work.
+
+Argv: coordinator_addr process_id num_processes.
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    coordinator, pid, n = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    from xllm_service_tpu.parallel import distributed
+
+    assert distributed.bootstrap(coordinator, n, pid)
+    assert jax.process_count() == n, jax.process_count()
+    assert len(jax.devices()) == 4 * n, len(jax.devices())
+    assert len(jax.local_devices()) == 4
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(jax.devices(), ("dp",))
+    # Each process contributes its local shard; the jitted global sum runs
+    # a cross-process psum under the hood.
+    x = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")),
+        np.full((4, 8), pid + 1.0, np.float32),  # this process's row shard
+    )
+    total = jax.jit(
+        lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P())
+    )(x)
+    want = sum(8 * 4 * (i + 1.0) for i in range(n))
+    assert float(total) == want, (float(total), want)
+    print(f"DIST_OK {pid} {float(total)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -214,6 +214,23 @@ class InstanceServer:
             )
             for i in range(4)
         ]
+        # Cross-process device-to-device KV plane (runtime/transfer.py):
+        # offers ride this process's TransferServer; the /kv/import control
+        # message carries only {addr, uuid, shape, dtype} and the decode
+        # peer pulls straight into its device memory. ENCODE instances and
+        # disabled configs keep the bytes-in-body plane.
+        self._kv_transfer = None
+        # Peers that rejected a kv_pull header (no transfer server): the
+        # bytes plane is used for them without another failing round trip.
+        self._peer_no_pull: set = set()
+        if engine_cfg.enable_kv_transfer_server and (
+            engine_cfg.instance_type != "ENCODE"
+        ):
+            from xllm_service_tpu.runtime.transfer import get_transfer_server
+
+            self._kv_transfer = get_transfer_server(
+                engine_cfg.kv_transfer_listen
+            )
 
     # ------------------------------------------------------------------ #
     def start(self) -> None:
@@ -546,11 +563,15 @@ class InstanceServer:
             # TOCTOU guard: send() kept the KV device-resident because a
             # local peer existed at enqueue time; if that peer deregistered
             # since, copy to host NOW — before the ack wait below — so a
-            # device export never sits pinned in HBM through it.
+            # device export never sits pinned in HBM through it. With the
+            # pull plane enabled, device-residency through the ack wait is
+            # the point (the peer pulls from device memory), so the copy
+            # is skipped.
             if (
                 handoff.kv is not None
                 and not isinstance(handoff.kv, np.ndarray)
                 and self._local_peer(decode_name) is None
+                and self._kv_transfer is None
             ):
                 handoff = dataclasses.replace(
                     handoff, kv=np.asarray(handoff.kv)
@@ -595,13 +616,7 @@ class InstanceServer:
                     if not addr:
                         err = f"decode instance {decode_name} unknown"
                     else:
-                        try:
-                            payload = handoff_to_bytes(handoff, extra)
-                            code, resp = post_bytes(addr, "/kv/import", payload)
-                            if code != 200:
-                                err = f"decode peer rejected handoff: {resp}"
-                        except Exception as e:
-                            err = f"decode peer unreachable: {e}"
+                        err = self._post_handoff(addr, handoff, extra)
             if not err:
                 # Handoff complete: this instance is done with the request
                 # (the decode peer owns cancellation from here).
@@ -622,20 +637,85 @@ class InstanceServer:
         def send(handoff) -> None:
             # Engine-thread side. The KV export arrives as a DEVICE array;
             # it may only stay device-resident if a colocated peer will
-            # take it directly — on the HTTP/DCN path it would otherwise
-            # sit pinned in HBM through the queue + up-to-60s ack wait
-            # while the engine has already freed and re-budgeted those
-            # blocks (round-2 review finding). Copy to host here (what the
-            # engine itself did before the transfer pipeline existed); a
-            # peer that (de)registers between enqueue and transfer still
-            # works — both import paths accept either array kind.
-            if handoff.kv is not None and self._local_peer(decode_name) is None:
+            # take it directly (in-process import) or the pull plane will
+            # serve it (the decode peer pulls from device memory) — on the
+            # bytes path it would otherwise sit pinned in HBM through the
+            # queue + up-to-60s ack wait while the engine has already
+            # freed and re-budgeted those blocks (round-2 review finding).
+            # Copy to host here for the bytes path; a peer that
+            # (de)registers between enqueue and transfer still works —
+            # both import paths accept either array kind.
+            if (
+                handoff.kv is not None
+                and self._local_peer(decode_name) is None
+                and self._kv_transfer is None
+            ):
                 handoff = dataclasses.replace(
                     handoff, kv=np.asarray(handoff.kv)
                 )
             self._transfer_q.put(lambda: transfer(handoff))
 
         return send
+
+    def _post_handoff(self, addr: str, handoff, extra: Dict[str, Any]) -> str:
+        """POST one handoff to a cross-process decode peer; returns "" on
+        success, an error string otherwise.
+
+        With the pull plane up and a device-resident payload, the KV is
+        OFFERED on this process's transfer server and the POST carries
+        only {addr, uuid, shape, dtype}; the peer pulls device-to-device
+        before acking (runtime/transfer.py). A peer that rejects the pull
+        header (no transfer server / pull failure) gets ONE retry on the
+        bytes plane. Host (np) payloads always ride the bytes plane."""
+        use_pull = (
+            self._kv_transfer is not None
+            and handoff.kv is not None
+            and not isinstance(handoff.kv, np.ndarray)
+            and addr not in self._peer_no_pull
+        )
+        if use_pull:
+            kv_dev = handoff.kv
+            uuid = self._kv_transfer.offer([kv_dev])
+            header = dict(extra)
+            header["kv_pull"] = {
+                "addr": self._kv_transfer.address,
+                "uuid": uuid,
+                "shape": [int(s) for s in kv_dev.shape],
+                "dtype": str(kv_dev.dtype),
+            }
+            try:
+                payload = handoff_to_bytes(
+                    dataclasses.replace(handoff, kv=None), header
+                )
+                code, resp = post_bytes(addr, "/kv/import", payload)
+            except Exception as e:
+                # The peer may STILL be pulling (e.g. our request timed
+                # out while its pull was in flight) — an immediate
+                # retract could free the buffer under it.
+                self._kv_transfer.retract_later(uuid)
+                return f"decode peer unreachable: {e}"
+            # A response means the peer finished (or never started) its
+            # pull — the offer's keepalive can drop now.
+            self._kv_transfer.retract(uuid)
+            if code == 200:
+                return ""
+            logger.warning(
+                "pull-plane handoff rejected by %s (%s); using the bytes "
+                "plane for this peer from now on", addr, resp,
+            )
+            # Capability cache: a peer without a transfer server rejects
+            # EVERY pull header — don't pay the failing round trip per
+            # handoff forever.
+            self._peer_no_pull.add(addr)
+            handoff = dataclasses.replace(handoff, kv=np.asarray(kv_dev))
+        try:
+            payload = handoff_to_bytes(handoff, extra)
+            code, resp = post_bytes(addr, "/kv/import", payload)
+            if code != 200:
+                return f"decode peer rejected handoff: {resp}"
+        except Exception as e:
+            return f"decode peer unreachable: {e}"
+        return ""
 
     def _local_peer(self, decode_name: str) -> Optional["InstanceServer"]:
         """The colocated in-process peer eligible for direct (device-
@@ -708,6 +788,32 @@ class InstanceServer:
         except Exception as e:
             h.send_error_json(400, f"bad handoff payload: {e}")
             return
+        if "kv_pull" in header:
+            # Pull plane: the body carried no KV bytes — pull the payload
+            # straight from the prefill peer's device memory into ours,
+            # BEFORE acking (so the sender's offer lifetime is bounded by
+            # this round-trip and pull failures surface in its response).
+            if self._kv_transfer is None:
+                h.send_error_json(
+                    400, "kv_pull offered but this instance has no "
+                    "transfer server (enable_kv_transfer_server)",
+                )
+                return
+            p = header["kv_pull"]
+            try:
+                try:
+                    dt = np.dtype(p["dtype"])
+                except TypeError:
+                    import ml_dtypes
+
+                    dt = np.dtype(getattr(ml_dtypes, p["dtype"]))
+                kv = self._kv_transfer.pull_single(
+                    p["addr"], int(p["uuid"]), p["shape"], dt
+                )
+            except Exception as e:
+                h.send_error_json(400, f"kv pull failed: {e}")
+                return
+            handoff = dataclasses.replace(handoff, kv=kv)
         rid = self._admit_import(handoff, header)
         h.send_json({"ok": True, "request_id": rid})
 

@@ -147,6 +147,25 @@ class EngineConfig:
     # enabled; disable to force the HTTP data plane.
     enable_local_kv_transfer: bool = True
 
+    # Cross-PROCESS device-to-device KV data plane
+    # (jax.experimental.transfer). When enabled, PD handoffs to a peer in
+    # another process are OFFERED on this process's transfer server and
+    # pulled by the peer straight into its device memory — the payload
+    # never stages through host RAM on either side (the reference's
+    # engine-to-engine RDMA pull, types.h:174-177). Disabled: payload
+    # bytes ride the /kv/import POST body.
+    enable_kv_transfer_server: bool = False
+    kv_transfer_listen: str = "127.0.0.1:0"
+
+    # Multi-host process group (jax.distributed). Non-empty
+    # coordinator_address bootstraps the group before the mesh is built;
+    # jax.devices() then spans ALL hosts and dp/tp/ep/sp shardings ride
+    # ICI within a slice and DCN across hosts. num_processes/process_id
+    # may stay 0/-1 on real TPU pods (auto-discovered from metadata).
+    coordinator_address: str = ""
+    num_processes: int = 0
+    process_id: int = -1
+
     # Compile the serving step functions (per-bucket prefill + decode)
     # BEFORE the instance registers, so the first real request never pays
     # a compile in its TTFT.

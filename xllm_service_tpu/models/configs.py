@@ -67,6 +67,42 @@ class ModelConfig:
         return self.kv_lora_rank + self.qk_rope_head_dim
 
 
+def approx_param_count(cfg: ModelConfig) -> int:
+    """Analytic parameter count (norm weights omitted — noise at scale).
+    Single source for HBM budgeting: runtime/executor._decide_num_blocks
+    sizes the KV pool with it and __graft_entry__'s dress rehearsal
+    checks serving layouts against it."""
+    E, L = cfg.hidden_size, cfg.num_layers
+    if cfg.is_mla:
+        dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+        kvr, qr, Hq = cfg.kv_lora_rank, cfg.q_lora_rank, cfg.num_heads
+        attn = (
+            E * (kvr + dr)
+            + Hq * kvr * (dn + dv)
+            + Hq * dv * E
+            + (E * qr + qr * Hq * (dn + dr) if qr else E * Hq * (dn + dr))
+        )
+    else:
+        attn = (
+            E * (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim
+            + cfg.num_heads * cfg.head_dim * E
+        )
+    if cfg.is_moe:
+        moe_mlp = 3 * E * (
+            cfg.moe_intermediate_size * cfg.num_experts
+            + cfg.n_shared_experts * cfg.moe_intermediate_size
+        ) + E * cfg.num_experts  # router
+    else:
+        moe_mlp = 3 * E * cfg.intermediate_size
+    kd = cfg.first_k_dense_replace
+    mlp_total = (L - kd) * moe_mlp + kd * 3 * E * cfg.intermediate_size
+    return (
+        cfg.vocab_size * E * (1 if cfg.tie_word_embeddings else 2)
+        + L * attn
+        + mlp_total
+    )
+
+
 _REGISTRY: Dict[str, ModelConfig] = {}
 
 

@@ -1,0 +1,67 @@
+"""Multi-host process-group bootstrap.
+
+The reference scales across hosts through its NCCL/MPI-backed engine
+backend (absent submodule; the service relays per-node addrs for it —
+SURVEY.md §2.2 comm backends). The TPU-native equivalent is
+`jax.distributed`: every host process calls initialize() against one
+coordinator, after which `jax.devices()` is the GLOBAL device list and a
+`jax.sharding.Mesh` over it spans the pod — XLA's SPMD partitioner then
+rides ICI within a slice and DCN across hosts with no hand-written
+communication. A v5e-64 (16 hosts x 4 chips) mesh exists only after this
+bootstrap.
+
+Config: EngineConfig.coordinator_address / num_processes / process_id
+(process_id < 0 means single-process; on real TPU pods num_processes and
+process_id may be omitted and are discovered from the TPU metadata).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+logger = logging.getLogger(__name__)
+
+_BOOT_MU = threading.Lock()
+_BOOTED = False
+
+
+def bootstrap(
+    coordinator_address: str,
+    num_processes: int = 0,
+    process_id: int = -1,
+) -> bool:
+    """Idempotently initialize jax.distributed. Returns True when this
+    call (or a previous one) initialized the process group; False when
+    coordinator_address is empty (single-process mode).
+
+    MUST run before the first JAX backend touch in the process — the
+    executor calls it before building its mesh.
+    """
+    global _BOOTED
+    if not coordinator_address:
+        return False
+    with _BOOT_MU:
+        if _BOOTED:
+            return True
+        import jax
+
+        kwargs = {}
+        if num_processes > 0:
+            kwargs["num_processes"] = num_processes
+        if process_id >= 0:
+            kwargs["process_id"] = process_id
+        jax.distributed.initialize(coordinator_address, **kwargs)
+        _BOOTED = True
+        logger.info(
+            "jax.distributed up: coordinator=%s process=%s/%s global_devices=%d",
+            coordinator_address,
+            jax.process_index(),
+            jax.process_count(),
+            len(jax.devices()),
+        )
+        return True
+
+
+def is_bootstrapped() -> bool:
+    return _BOOTED

@@ -31,7 +31,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from xllm_service_tpu.common.config import EngineConfig
 from xllm_service_tpu import models
-from xllm_service_tpu.models.configs import ModelConfig, get_model_config
+from xllm_service_tpu.models.configs import (
+    ModelConfig,
+    approx_param_count,
+    get_model_config,
+)
 from xllm_service_tpu.ops import sampling as sampling_ops
 from xllm_service_tpu.parallel.mesh import build_mesh
 from xllm_service_tpu.ops import kv_cache as kvc
@@ -121,6 +125,17 @@ class ModelExecutor:
         init_seed: int = 0,
     ):
         self.engine_cfg = engine_cfg
+        # Multi-host: join the process group BEFORE the first backend
+        # touch, so build_mesh below sees the GLOBAL device list
+        # (parallel/distributed.py; no-op when coordinator_address is "").
+        if engine_cfg.coordinator_address:
+            from xllm_service_tpu.parallel import distributed
+
+            distributed.bootstrap(
+                engine_cfg.coordinator_address,
+                engine_cfg.num_processes,
+                engine_cfg.process_id,
+            )
         if model_cfg is not None:
             self.cfg = model_cfg
         elif engine_cfg.checkpoint_path and os.path.exists(
@@ -292,36 +307,7 @@ class ModelExecutor:
         # Size the KV pool from free HBM after params (bench/real use).
         cfg = self.cfg
         bytes_per_param = 2 if self.engine_cfg.dtype == "bfloat16" else 4
-        E, L = cfg.hidden_size, cfg.num_layers
-        F = cfg.moe_intermediate_size * cfg.num_experts if cfg.is_moe else cfg.intermediate_size
-        if cfg.is_mla:
-            # MLA attention params/layer (models/deepseek.py init_params):
-            # w_dkv + w_uk/w_uv + wo + q path (LoRA'd or direct).
-            dn, dr, dv = (
-                cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
-            )
-            kvr, qr, Hq = cfg.kv_lora_rank, cfg.q_lora_rank, cfg.num_heads
-            attn = (
-                E * (kvr + dr)
-                + Hq * kvr * (dn + dv)
-                + Hq * dv * E
-                + (E * qr + qr * Hq * (dn + dr) if qr else E * Hq * (dn + dr))
-            )
-        else:
-            attn = (
-                E * (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim
-                + cfg.num_heads * cfg.head_dim * E
-            )
-        mlp = 3 * E * F + 3 * E * cfg.n_shared_experts * cfg.moe_intermediate_size
-        # Heterogeneous DeepSeek stacks: the dense prefix uses the (much
-        # smaller) dense SwiGLU instead of the MoE block.
-        kd = cfg.first_k_dense_replace
-        mlp_total = (L - kd) * mlp + kd * 3 * E * cfg.intermediate_size
-        n_params = (
-            cfg.vocab_size * E * (1 if cfg.tie_word_embeddings else 2)
-            + L * attn
-            + mlp_total
-        )
+        n_params = approx_param_count(cfg)
         try:
             stats = jax.devices()[0].memory_stats() or {}
             total_hbm = stats.get("bytes_limit", 16 * 2**30)

@@ -1,0 +1,125 @@
+"""Cross-process device-to-device KV data plane.
+
+The reference relays per-instance RDMA handles (cluster_ids / addrs /
+k_cache_ids / v_cache_ids — xllm_service/common/types.h:174-177, proto
+fields 37-40, served by GetInstanceInfo in rpc_service/service.cpp:74-105)
+so a decode engine can pull prefilled KV straight out of the prefill
+engine's device memory. The TPU-native analog is
+`jax.experimental.transfer`: each instance runs one TransferServer bound to
+its JAX client; the prefill side OFFERS a device array under a uuid, the
+decode side PULLS it directly into its own device memory over the
+transfer transport (DCN/ICI on real pods, TCP on CPU tests) — the payload
+never stages through host memory on either side.
+
+Wire protocol: the existing /kv/import control message carries a
+`kv_pull` header ({addr, uuid, shape, dtype}) INSTEAD of body bytes; the
+receiving handler pulls synchronously before acking, so the offer's
+lifetime is bounded by the control round-trip and errors surface in the
+HTTP response exactly like the bytes path.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+class KVTransferServer:
+    """One per process: offers outgoing KV arrays and pulls incoming ones.
+
+    Thread-safe; connections to peer servers are cached per address.
+    """
+
+    def __init__(self, listen: str = "127.0.0.1:0"):
+        import jax
+        from jax.experimental import transfer
+
+        # local_devices, not devices: under jax.distributed the global
+        # list starts with process 0's devices — a server/pull target on
+        # any other host must address its OWN chips.
+        self._client = jax.local_devices()[0].client
+        # An explicit socket transport address is REQUIRED: with the
+        # default (none), jaxlib routes same-host peers through its
+        # "local bulk transport" registry, which only knows transports
+        # created in THIS process — a pull from another process on the
+        # same host then dies on a CHECK in streaming.cc
+        # (LocalBulkTransportFactory::RecvBulkTransport).
+        host = listen.rsplit(":", 1)[0] or "127.0.0.1"
+        self._srv = transfer.start_transfer_server(
+            self._client, listen, [f"{host}:0"]
+        )
+        self._mu = threading.Lock()
+        self._conns: Dict[str, Any] = {}
+        self._uuid = itertools.count(1)
+        # Keep offered arrays (and their pull futures) alive until the
+        # peer's pull completes — retract() drops the reference.
+        self._pending: Dict[int, Any] = {}
+
+    @property
+    def address(self) -> str:
+        return self._srv.address()
+
+    def offer(self, arrays: Sequence[Any]) -> int:
+        """Register device arrays for a one-shot pull; returns the uuid
+        the peer pulls under."""
+        with self._mu:
+            uuid = next(self._uuid)
+            self._pending[uuid] = (self._srv.await_pull(uuid, list(arrays)), arrays)
+        return uuid
+
+    def retract(self, uuid: int) -> None:
+        """Drop an offer's keepalive (after the peer acked its pull, or on
+        control-message failure)."""
+        with self._mu:
+            self._pending.pop(uuid, None)
+
+    def pull(self, addr: str, uuid: int, avals: Sequence[Any]) -> List[Any]:
+        """Pull arrays offered under `uuid` from the server at `addr` into
+        this process's devices. `avals` are jax.ShapeDtypeStruct with
+        shardings on local devices."""
+        with self._mu:
+            conn = self._conns.get(addr)
+            if conn is None:
+                conn = self._srv.connect(addr)
+                self._conns[addr] = conn
+        return conn.pull(uuid, list(avals))
+
+    def pull_single(self, addr: str, uuid: int, shape, dtype) -> Any:
+        """Pull one array onto this process's first LOCAL device (the
+        common single-chip PD-pair case; a sharded consumer reshards
+        under its own jit)."""
+        import jax
+        from jax.sharding import SingleDeviceSharding
+
+        aval = jax.ShapeDtypeStruct(
+            tuple(shape), dtype,
+            sharding=SingleDeviceSharding(jax.local_devices()[0]),
+        )
+        return self.pull(addr, uuid, [aval])[0]
+
+    def retract_later(self, uuid: int, delay_s: float = 120.0) -> None:
+        """Drop an offer's keepalive AFTER the peer's possible pull window
+        (used when a control POST errored mid-flight: the peer may still
+        be pulling, so an immediate retract could free the buffer under
+        it)."""
+        t = threading.Timer(delay_s, self.retract, args=(uuid,))
+        t.daemon = True
+        t.start()
+
+
+_PROCESS_SERVER: Optional[KVTransferServer] = None
+_PROCESS_MU = threading.Lock()
+
+
+def get_transfer_server(listen: str = "127.0.0.1:0") -> KVTransferServer:
+    """Process-wide singleton (a TransferServer binds per-client transport
+    resources; instances in one process share it)."""
+    global _PROCESS_SERVER
+    with _PROCESS_MU:
+        if _PROCESS_SERVER is None:
+            _PROCESS_SERVER = KVTransferServer(listen)
+        return _PROCESS_SERVER
