@@ -110,124 +110,162 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
         # 20-40s-per-shape TPU compiles.
         compilation_cache_dir="/tmp/xllm-jit-cache" if on_tpu else "",
     )
-    ex = ModelExecutor(cfg)
-    bs = ex.block_size
-    rng = np.random.default_rng(0)
+    prev_prefill_env = os.environ.get("XLLM_PREFILL_ATTENTION_KERNEL")
+    if use_kernel is False:
+        # Conservative fallback config: force BOTH Pallas paths off so a
+        # kernel-compile regression can never take the bench down.
+        # Restored in the finally at the end — a later attempt in this
+        # process must not inherit the override.
+        os.environ["XLLM_PREFILL_ATTENTION_KERNEL"] = "0"
+    try:
+        ex = ModelExecutor(cfg)
+        bs = ex.block_size
+        rng = np.random.default_rng(0)
 
-    # Fill every slot with a prefilled context of prompt_len tokens via the
-    # BATCHED prefill path (the serving admission path) — timed, so the
-    # bench also reports prefill throughput.
-    from xllm_service_tpu.runtime.executor import PrefillItem
+        # Fill every slot with a prefilled context of prompt_len tokens via the
+        # BATCHED prefill path (the serving admission path) — timed, so the
+        # bench also reports prefill throughput.
+        from xllm_service_tpu.runtime.executor import PrefillItem
 
-    blocks_per_seq = (prompt_len + 1 + bs - 1) // bs
-    assert ex.num_blocks > R * blocks_per_seq, "KV pool too small for bench"
-    tables = np.zeros((R, ex.max_blocks_per_seq), np.int32)
-    next_block = 1
-    items = []
-    for r in range(R):
-        ids = list(range(next_block, next_block + blocks_per_seq))
-        next_block += blocks_per_seq
-        tables[r, : len(ids)] = ids
-        items.append(
-            PrefillItem(
-                token_ids=rng.integers(
-                    0, ex.cfg.vocab_size, (prompt_len,), np.int32
-                ),
-                start_pos=0,
-                block_table=tables[r],
+        blocks_per_seq = (prompt_len + 1 + bs - 1) // bs
+        assert ex.num_blocks > R * blocks_per_seq, "KV pool too small for bench"
+        tables = np.zeros((R, ex.max_blocks_per_seq), np.int32)
+        next_block = 1
+        items = []
+        for r in range(R):
+            ids = list(range(next_block, next_block + blocks_per_seq))
+            next_block += blocks_per_seq
+            tables[r, : len(ids)] = ids
+            items.append(
+                PrefillItem(
+                    token_ids=rng.integers(
+                        0, ex.cfg.vocab_size, (prompt_len,), np.int32
+                    ),
+                    start_pos=0,
+                    block_table=tables[r],
+                )
             )
+        ex.prefill_batch(items)  # warmup/compile (idempotent: same blocks)
+        t0 = time.perf_counter()
+        ex.prefill_batch(items)
+        prefill_dt = time.perf_counter() - t0
+        prefill_tok_s = R * prompt_len / prefill_dt
+
+        token_ids = rng.integers(0, ex.cfg.vocab_size, (R,)).astype(np.int32)
+        positions = np.full((R,), prompt_len, np.int32)
+        active = np.ones((R,), bool)
+        s = SamplingParams(temperature=0.7)
+        batch = SamplingBatch(
+            np.full((R,), s.temperature, np.float32),
+            np.zeros((R,), np.int32),
+            np.ones((R,), np.float32),
+            rng.integers(0, 2**32, (R,)).astype(np.uint32),
+            np.zeros((R,), np.int32),
         )
-    ex.prefill_batch(items)  # warmup/compile (idempotent: same blocks)
-    t0 = time.perf_counter()
-    ex.prefill_batch(items)
-    prefill_dt = time.perf_counter() - t0
-    prefill_tok_s = R * prompt_len / prefill_dt
 
-    token_ids = rng.integers(0, ex.cfg.vocab_size, (R,)).astype(np.int32)
-    positions = np.full((R,), prompt_len, np.int32)
-    active = np.ones((R,), bool)
-    s = SamplingParams(temperature=0.7)
-    batch = SamplingBatch(
-        np.full((R,), s.temperature, np.float32),
-        np.zeros((R,), np.int32),
-        np.ones((R,), np.float32),
-        rng.integers(0, 2**32, (R,)).astype(np.uint32),
-        np.zeros((R,), np.int32),
-    )
+        # Timed loop runs ON DEVICE via lax.scan (autoregressive feedback, fused
+        # sampling each step) so the number measures TPU decode throughput, not
+        # the dev-tunnel's per-dispatch latency. Production hosts dispatch in µs;
+        # this harness round-trips through an HTTP tunnel per call.
+        import jax
+        import jax.numpy as jnp
 
-    # Timed loop runs ON DEVICE via lax.scan (autoregressive feedback, fused
-    # sampling each step) so the number measures TPU decode throughput, not
-    # the dev-tunnel's per-dispatch latency. Production hosts dispatch in µs;
-    # this harness round-trips through an HTTP tunnel per call.
-    import jax
-    import jax.numpy as jnp
+        from xllm_service_tpu.models import llama
+        from xllm_service_tpu.ops import sampling as sampling_ops
 
-    from xllm_service_tpu.models import llama
-    from xllm_service_tpu.ops import sampling as sampling_ops
+        mcfg = ex.cfg
 
-    mcfg = ex.cfg
+        def run_steps(k_cache, v_cache, params, tokens0, pos0, tables, active,
+                      temps, top_ks, top_ps, seeds):
+            def body(carry, step):
+                k_cache, v_cache, toks, pos = carry
+                logits, k_cache, v_cache = llama.decode_step(
+                    params, mcfg, k_cache, v_cache, toks, pos, tables, active,
+                    use_kernel=use_kernel)
+                keys = sampling_ops.make_step_keys(seeds, step)
+                toks, _, _ = sampling_ops.sample_tokens(
+                    logits, temps, top_ks, top_ps, keys)
+                return (k_cache, v_cache, toks, pos + 1), toks
 
-    def run_steps(k_cache, v_cache, params, tokens0, pos0, tables, active,
-                  temps, top_ks, top_ps, seeds):
-        def body(carry, step):
-            k_cache, v_cache, toks, pos = carry
-            logits, k_cache, v_cache = llama.decode_step(
-                params, mcfg, k_cache, v_cache, toks, pos, tables, active,
-                use_kernel=use_kernel)
-            keys = sampling_ops.make_step_keys(seeds, step)
-            toks, _, _ = sampling_ops.sample_tokens(
-                logits, temps, top_ks, top_ps, keys)
-            return (k_cache, v_cache, toks, pos + 1), toks
+            (k_cache, v_cache, toks, _), out = jax.lax.scan(
+                body, (k_cache, v_cache, tokens0, pos0),
+                jnp.arange(decode_steps, dtype=jnp.int32))
+            return k_cache, v_cache, out
 
-        (k_cache, v_cache, toks, _), out = jax.lax.scan(
-            body, (k_cache, v_cache, tokens0, pos0),
-            jnp.arange(decode_steps, dtype=jnp.int32))
-        return k_cache, v_cache, out
+        run = jax.jit(run_steps, donate_argnums=(0, 1))
+        args = (
+            jnp.asarray(token_ids), jnp.asarray(positions), jnp.asarray(tables),
+            jnp.asarray(active),
+            jnp.asarray(batch.temperature), jnp.asarray(batch.top_k),
+            jnp.asarray(batch.top_p), jnp.asarray(batch.seeds),
+        )
+        # Force a host fetch of the result, not just block_until_ready: through
+        # the axon dev tunnel block_until_ready can return before execution
+        # completes (observed: impossible >5 PFLOP/s "timings" on v5e), and only
+        # a device->host transfer reliably drains the queue.
+        ex.k_cache, ex.v_cache, out = run(ex.k_cache, ex.v_cache, ex.params, *args)
+        int(jnp.sum(out))  # warmup/compile + drain
+        t0 = time.perf_counter()
+        ex.k_cache, ex.v_cache, out = run(ex.k_cache, ex.v_cache, ex.params, *args)
+        int(jnp.sum(out))
+        dt = time.perf_counter() - t0
 
-    run = jax.jit(run_steps, donate_argnums=(0, 1))
-    args = (
-        jnp.asarray(token_ids), jnp.asarray(positions), jnp.asarray(tables),
-        jnp.asarray(active),
-        jnp.asarray(batch.temperature), jnp.asarray(batch.top_k),
-        jnp.asarray(batch.top_p), jnp.asarray(batch.seeds),
-    )
-    # Force a host fetch of the result, not just block_until_ready: through
-    # the axon dev tunnel block_until_ready can return before execution
-    # completes (observed: impossible >5 PFLOP/s "timings" on v5e), and only
-    # a device->host transfer reliably drains the queue.
-    ex.k_cache, ex.v_cache, out = run(ex.k_cache, ex.v_cache, ex.params, *args)
-    int(jnp.sum(out))  # warmup/compile + drain
-    t0 = time.perf_counter()
-    ex.k_cache, ex.v_cache, out = run(ex.k_cache, ex.v_cache, ex.params, *args)
-    int(jnp.sum(out))
-    dt = time.perf_counter() - t0
+        tok_per_s = R * decode_steps / dt
+        baseline = R * (1000.0 / 50.0)  # reference SLO: 50 ms TPOT per request
 
-    tok_per_s = R * decode_steps / dt
-    baseline = R * (1000.0 / 50.0)  # reference SLO: 50 ms TPOT per request
-
-    # Roofline context: decode FLOPs/token ≈ 2·params (matmuls) plus
-    # attention score/value FLOPs over the live context.
-    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(ex.params))
-    ctx = prompt_len + decode_steps // 2
-    attn_flops = 4 * mcfg.num_layers * mcfg.num_heads * mcfg.head_dim * ctx
-    flops_per_tok = 2 * n_params + attn_flops
-    achieved_flops = flops_per_tok * tok_per_s
-    peak = _peak_flops(jax.devices()[0])
-    print(json.dumps({
-        "metric": f"decode_throughput_{model}_bs{R}",
-        "value": round(tok_per_s, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(tok_per_s / baseline, 3),
-        "backend": jax.default_backend(),
-        "tpot_ms": round(1000.0 * dt / decode_steps, 3),
-        "mfu": round(achieved_flops / peak, 4) if peak else None,
-        "prefill_tok_s": round(prefill_tok_s, 1),
-        "attention_kernel": (
-            "forced-off" if use_kernel is False else os.environ.get(
-                "XLLM_PAGED_ATTENTION_KERNEL", "default")
-        ),
-        "kv_cache_dtype": cfg.kv_cache_dtype,
-    }))
+        # Roofline context: decode FLOPs/token ≈ 2·params (matmuls) plus
+        # attention score/value FLOPs over the live context.
+        n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(ex.params))
+        ctx = prompt_len + decode_steps // 2
+        attn_flops = 4 * mcfg.num_layers * mcfg.num_heads * mcfg.head_dim * ctx
+        flops_per_tok = 2 * n_params + attn_flops
+        achieved_flops = flops_per_tok * tok_per_s
+        peak = _peak_flops(jax.devices()[0])
+        # Prefill MFU: matmul FLOPs + causal attention (~L^2/2 per sequence).
+        # Unembed runs ONCE per sequence (last token only) and the embedding
+        # is a gather, so the per-token cost excludes lm_head — unlike decode,
+        # which unembeds every token.
+        lm_head_params = (
+            0 if mcfg.tie_word_embeddings else mcfg.hidden_size * mcfg.vocab_size
+        )
+        body_params = n_params - lm_head_params - mcfg.vocab_size * mcfg.hidden_size
+        prefill_flops = R * (
+            prompt_len * 2 * body_params
+            + 2 * mcfg.hidden_size * mcfg.vocab_size  # one unembed per seq
+            + 4 * mcfg.num_layers * mcfg.num_heads * mcfg.head_dim
+            * prompt_len * prompt_len // 2
+        )
+        prefill_mfu = (
+            round(prefill_flops / prefill_dt / peak, 4) if peak else None
+        )
+        print(json.dumps({
+            "metric": f"decode_throughput_{model}_bs{R}",
+            "value": round(tok_per_s, 1),
+            "unit": "tokens/s",
+            "vs_baseline": round(tok_per_s / baseline, 3),
+            "backend": jax.default_backend(),
+            "tpot_ms": round(1000.0 * dt / decode_steps, 3),
+            "mfu": round(achieved_flops / peak, 4) if peak else None,
+            "prefill_tok_s": round(prefill_tok_s, 1),
+            "prefill_mfu": prefill_mfu,
+            "attention_kernel": (
+                "forced-off" if use_kernel is False else os.environ.get(
+                    "XLLM_PAGED_ATTENTION_KERNEL", "default")
+            ),
+            "prefill_kernel": (
+                "forced-off" if use_kernel is False else os.environ.get(
+                    "XLLM_PREFILL_ATTENTION_KERNEL", "default")
+            ),
+            "kv_cache_dtype": cfg.kv_cache_dtype,
+        }))
+    finally:
+        if use_kernel is False:
+            if prev_prefill_env is None:
+                os.environ.pop("XLLM_PREFILL_ATTENTION_KERNEL", None)
+            else:
+                os.environ["XLLM_PREFILL_ATTENTION_KERNEL"] = (
+                    prev_prefill_env
+                )
 
 
 def _peak_flops(device) -> float | None:

@@ -29,7 +29,7 @@ from xllm_service_tpu.models.configs import ModelConfig
 from xllm_service_tpu.ops import kv_cache as kv_cache_ops
 from xllm_service_tpu.ops.attention import (
     paged_attention,
-    prefill_attention_blockwise,
+    prefill_attention,
 )
 from xllm_service_tpu.ops.norms import rms_norm
 from xllm_service_tpu.ops.rope import apply_rope
@@ -294,11 +294,9 @@ def prefill_batch_step(
             k.reshape(P * Lpad, *k.shape[2:]),
             v.reshape(P * Lpad, *v.shape[2:]),
         )
-        attn = jax.vmap(
-            lambda qi, ti, sp, tl: prefill_attention_blockwise(
-                qi, k_l, v_l, ti, sp, tl, scale
-            )
-        )(q, block_tables, start_pos, true_len)  # [P, Lpad, Hq, D]
+        attn = prefill_attention(
+            q, k_l, v_l, block_tables, start_pos, true_len, scale
+        )  # [P, Lpad, Hq, D] — flash kernel on TPU, blockwise elsewhere
         x = x + jnp.einsum("plh,he->ple", attn.reshape(P, Lpad, -1),
                            lp["wo"].reshape(-1, cfg.hidden_size))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)

@@ -167,6 +167,52 @@ def prefill_attention_blockwise(
     return out.reshape(L, Hq, D).astype(q.dtype)
 
 
+def prefill_attention(
+    q: jnp.ndarray,  # [P, Lpad, Hq, D] — the batched chunk's queries
+    k_cache,
+    v_cache,
+    block_tables: jnp.ndarray,  # [P, CB]
+    start_pos: jnp.ndarray,  # [P]
+    true_len: jnp.ndarray,  # [P]
+    scale: float,
+    use_kernel: bool | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Batched chunked-prefill attention over the paged cache; Pallas
+    flash kernel (ops/pallas/flash_prefill.py) on TPU, vmapped blockwise
+    scan elsewhere. Same eligibility rules as the decode kernel (D a
+    lane multiple; int8 additionally needs BS scale rows 128-wide); env
+    override XLLM_PREFILL_ATTENTION_KERNEL=0/1 forces the path, and
+    `interpret` lets CI drive the kernel branch on CPU."""
+    import os
+
+    env = os.environ.get("XLLM_PREFILL_ATTENTION_KERNEL")
+    if use_kernel is None:
+        D = q.shape[-1]
+        BS = kvc.raw(k_cache).shape[-2]
+        kq = isinstance(k_cache, kvc.PagedKV) and k_cache.quantized
+        kernel_ok = (
+            (_on_tpu() or interpret)
+            and D % 128 == 0
+            and (not kq or BS % 128 == 0)
+        )
+        use_kernel = (env != "0") if kernel_ok else (env == "1")
+    if use_kernel:
+        from xllm_service_tpu.ops.pallas.flash_prefill import (
+            flash_prefill_kernel,
+        )
+
+        return flash_prefill_kernel(
+            q, k_cache, v_cache, block_tables, start_pos, true_len, scale,
+            interpret=interpret,
+        )
+    return jax.vmap(
+        lambda qi, ti, sp, tl: prefill_attention_blockwise(
+            qi, k_cache, v_cache, ti, sp, tl, scale
+        )
+    )(q, block_tables, start_pos, true_len)
+
+
 # ----------------------------------------------------------------- MLA
 # Multi-head Latent Attention (DeepSeek-V2/V3): the paged cache stores ONE
 # compressed row per token — concat(c_kv [kv_rank], k_pe [rope_dim]) — and

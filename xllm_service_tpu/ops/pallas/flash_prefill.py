@@ -1,0 +1,314 @@
+"""Pallas TPU flash-attention kernel for chunked paged prefill.
+
+The prefill half of SURVEY.md §7 hard part #1 (the decode half is
+ops/pallas/paged_attention.py; the reference's CUDA analogs live in its
+absent engine submodule). The executor scatters a prefill chunk's K/V
+rows into the paged pool FIRST (models/llama.py prefill_batch_step), so
+attention here reads everything — prefix AND chunk — from the cache:
+query at absolute position p attends to cache positions 0..p.
+
+Design (flash, manual double-buffered DMA, chunked blocks — the decode
+kernel's loop structure with a query-tile axis):
+  * grid = (P, Hkv, NT): one program per (sequence, KV head, query tile).
+    A tile is TQ consecutive chunk positions; its G = Hq//Hkv query heads
+    ride along as TQ*G sublane rows, so scores are ONE
+    [TQ*G, C*BS] MXU matmul per inner step.
+  * the inner fori_loop streams cache blocks HBM→VMEM through a 2-slot
+    buffer (C block-table entries per iteration, next chunk's DMA
+    overlapped with compute). Its bound is the tile's OWN context
+    length — ceil((start_pos + min((t+1)*TQ, true_len)) / (C*BS)) — so
+    early tiles don't pay for late context and padded tiles run nothing.
+  * causal + ragged masking by absolute position: row r (query position
+    start_pos + t*TQ + r//G) keeps column c*span + j iff that cache
+    position <= its own, and rows past true_len are dead (l=0 → zeros).
+  * int8 caches: per-row scales ride as [N, Hkv*BS] f32 rows and fold
+    into score columns (K) and probability columns (V) — same scheme the
+    decode kernel chip-validated.
+
+Layouts: q [P, Lpad, Hq, D] (chunk-relative), caches [N, Hkv, BS, D],
+block_table [P, MB] int32, start_pos/true_len [P] int32. Returns
+[P, Lpad, Hq, D]. Parity oracle: ops/attention.prefill_attention_blockwise
+(tests/test_pallas_kernels.py drives interpret mode on CPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _prefill_kernel(
+    # scalar prefetch
+    block_table_ref,  # [P, MBp] SMEM
+    start_pos_ref,    # [P] SMEM
+    true_len_ref,     # [P] SMEM
+    # inputs
+    q_ref,            # [1, 1, 1, Rp, D] VMEM (one tile's TQ*G rows)
+    k_hbm,            # [N, Hkv, BS, D] HBM
+    v_hbm,            # [N, Hkv, BS, D] HBM
+    *rest,            # quantized: ks_hbm, vs_hbm; then o_ref + scratch
+    block_size: int,
+    chunk: int,
+    tile_q: int,
+    groups: int,
+    scale: float,
+    quantized: bool,
+):
+    if quantized:
+        ks_hbm, vs_hbm, o_ref, k_buf, v_buf, sems, ks_buf, vs_buf, ssems = rest
+    else:
+        o_ref, k_buf, v_buf, sems = rest
+        ks_hbm = vs_hbm = ks_buf = vs_buf = ssems = None
+    p = pl.program_id(0)
+    h = pl.program_id(1)
+    t = pl.program_id(2)
+    start = start_pos_ref[p]
+    n_valid = true_len_ref[p]
+    span = chunk * block_size
+
+    # This tile's context: positions 0 .. start + min((t+1)*TQ, true_len).
+    tile_lo = t * tile_q  # first chunk-relative position of the tile
+    ctx = start + jnp.minimum(tile_lo + tile_q, n_valid)
+    nc = jnp.where(tile_lo < n_valid, pl.cdiv(ctx, span), 0)
+
+    def dmas(slot, c_idx, blk):
+        off = c_idx * block_size
+        out = [
+            pltpu.make_async_copy(
+                k_hbm.at[blk, h],
+                k_buf.at[slot, pl.ds(off, block_size)],
+                sems.at[slot, 0, c_idx],
+            ),
+            pltpu.make_async_copy(
+                v_hbm.at[blk, h],
+                v_buf.at[slot, pl.ds(off, block_size)],
+                sems.at[slot, 1, c_idx],
+            ),
+        ]
+        if quantized:
+            out.append(
+                pltpu.make_async_copy(
+                    ks_hbm.at[blk, pl.ds(h * block_size, block_size)],
+                    ks_buf.at[slot, c_idx],
+                    ssems.at[slot, 0, c_idx],
+                )
+            )
+            out.append(
+                pltpu.make_async_copy(
+                    vs_hbm.at[blk, pl.ds(h * block_size, block_size)],
+                    vs_buf.at[slot, c_idx],
+                    ssems.at[slot, 1, c_idx],
+                )
+            )
+        return out
+
+    def start_chunk(slot, c):
+        for c_idx in range(chunk):
+            blk = block_table_ref[p, c * chunk + c_idx]
+            for d in dmas(slot, c_idx, blk):
+                d.start()
+
+    def wait_chunk(slot, c):
+        for c_idx in range(chunk):
+            blk = block_table_ref[p, c * chunk + c_idx]
+            for d in dmas(slot, c_idx, blk):
+                d.wait()
+
+    @pl.when(nc > 0)
+    def _first():
+        start_chunk(0, 0)
+
+    q = q_ref[0, 0, 0]  # [Rp, D]
+    Rp, D = q.shape
+    # Absolute position of each query row: start + tile_lo + row // G.
+    row_pos = start + tile_lo + (
+        jax.lax.broadcasted_iota(jnp.int32, (Rp, 1), 0) // groups
+    )
+    row_valid = tile_lo + (
+        jax.lax.broadcasted_iota(jnp.int32, (Rp, 1), 0) // groups
+    ) < n_valid
+
+    def body(c, carry):
+        m_prev, l_prev, acc = carry
+        slot = jax.lax.rem(c, 2)
+
+        @pl.when(c + 1 < nc)
+        def _prefetch():
+            start_chunk(jax.lax.rem(c + 1, 2), c + 1)
+
+        wait_chunk(slot, c)
+        k_tile = k_buf[slot]
+        if quantized:
+            k_tile = k_tile.astype(jnp.bfloat16)
+        scores = (
+            jax.lax.dot_general(
+                q, k_tile,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # [Rp, C*BS] f32
+        if quantized:
+            scores = scores * ks_buf[slot].reshape(1, chunk * block_size)
+        col_pos = c * span + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1
+        )
+        keep = (col_pos <= row_pos) & row_valid
+        scores = jnp.where(keep, scores, NEG_INF)
+
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # Fully-masked-so-far rows: keep alpha/p at 0 so acc stays 0.
+        alpha = jnp.where(
+            m_prev <= NEG_INF / 2, 0.0, jnp.exp(m_prev - m_new)
+        )
+        pmat = jnp.where(
+            m_new <= NEG_INF / 2, 0.0, jnp.exp(scores - m_new)
+        )
+        l_new = alpha * l_prev + jnp.sum(pmat, axis=-1, keepdims=True)
+        if quantized:
+            pmat = pmat * vs_buf[slot].reshape(1, chunk * block_size)
+            pv = jnp.dot(
+                pmat.astype(jnp.bfloat16), v_buf[slot].astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+        else:
+            pv = jnp.dot(
+                pmat.astype(k_buf.dtype), v_buf[slot],
+                preferred_element_type=jnp.float32,
+            )
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((Rp, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Rp, 1), jnp.float32)
+    a0 = jnp.zeros((Rp, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nc, body, (m0, l0, a0))
+    o_ref[0, 0, 0] = jnp.where(
+        l > 0, acc / jnp.maximum(l, 1e-30), 0.0
+    ).astype(o_ref.dtype)
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "interpret", "chunk", "tile_q")
+)
+def flash_prefill_kernel(
+    q: jnp.ndarray,            # [P, Lpad, Hq, D]
+    k_cache,                   # [N, Hkv, BS, D] plain array or PagedKV
+    v_cache,
+    block_table: jnp.ndarray,  # [P, MB] int32
+    start_pos: jnp.ndarray,    # [P] int32
+    true_len: jnp.ndarray,     # [P] int32
+    scale: float,
+    interpret: bool = False,
+    chunk: int = 4,
+    tile_q: int = 128,
+) -> jnp.ndarray:
+    from xllm_service_tpu.ops import kv_cache as kvc
+
+    k_cache = kvc.as_paged(k_cache)
+    v_cache = kvc.as_paged(v_cache)
+    quantized = k_cache.quantized
+    k_data, v_data = k_cache.data, v_cache.data
+
+    P, Lpad, Hq, D = q.shape
+    N, Hkv, BS, _ = k_data.shape
+    MB = block_table.shape[1]
+    G = Hq // Hkv
+    TQ = min(tile_q, _round_up(Lpad, 8))
+    # Rows per tile must satisfy 8-sublane tiling: TQ*G padded via TQ.
+    while (TQ * G) % 8:
+        TQ += 1
+    Lp = _round_up(Lpad, TQ)
+    NT = Lp // TQ
+    Rp = TQ * G
+    C = max(1, min(chunk, MB))
+
+    qt = q
+    if Lp != Lpad:
+        qt = jnp.pad(qt, ((0, 0), (0, Lp - Lpad), (0, 0), (0, 0)))
+    # [P, Lp, Hq, D] -> [P, Hkv, NT, TQ*G, D], rows position-major so
+    # row // G is the chunk-relative query offset within the tile.
+    qt = qt.reshape(P, NT, TQ, Hkv, G, D)
+    qt = qt.transpose(0, 3, 1, 2, 4, 5).reshape(P, Hkv, NT, Rp, D)
+
+    MBp = _round_up(MB, C)
+    bt = block_table.astype(jnp.int32)
+    if MBp != MB:
+        bt = jnp.pad(bt, ((0, 0), (0, MBp - MB)))
+
+    hbm = pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM)
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1, 1, Rp, D), lambda p, h, t, bt, sp, tl: (p, h, t, 0, 0)
+        ),
+        hbm,
+        hbm,
+    ]
+    inputs = [
+        bt, start_pos.astype(jnp.int32), true_len.astype(jnp.int32),
+        qt, k_data, v_data,
+    ]
+    scratch = [
+        pltpu.VMEM((2, C * BS, D), k_data.dtype),
+        pltpu.VMEM((2, C * BS, D), v_data.dtype),
+        pltpu.SemaphoreType.DMA((2, 2, C)),
+    ]
+    kv_bytes_per_row = D * k_data.dtype.itemsize
+    if quantized:
+        in_specs += [hbm, hbm]
+        inputs += [
+            k_cache.scale.reshape(N, Hkv * BS).astype(jnp.float32),
+            v_cache.scale.reshape(N, Hkv * BS).astype(jnp.float32),
+        ]
+        scratch += [
+            pltpu.VMEM((2, C, BS), jnp.float32),
+            pltpu.VMEM((2, C, BS), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2, C)),
+        ]
+        kv_bytes_per_row += 4
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(P, Hkv, NT),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, 1, Rp, D), lambda p, h, t, bt, sp, tl: (p, h, t, 0, 0)
+        ),
+        scratch_shapes=scratch,
+    )
+    kernel = functools.partial(
+        _prefill_kernel, block_size=BS, chunk=C, tile_q=TQ, groups=G,
+        scale=scale, quantized=quantized,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((P, Hkv, NT, Rp, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            # ~L^2/2 causal flops per (seq, head-group); bytes dominated by
+            # re-streaming the context per query tile.
+            flops=2 * P * Hq * D * Lp * (Lp + 2 * MB * BS) // 2,
+            bytes_accessed=(
+                P * Lp * Hq * D * 4
+                + P * NT * MB * BS * Hkv * kv_bytes_per_row
+            ),
+            transcendentals=P * Hq * Lp * MB * BS // max(NT, 1),
+        ),
+        interpret=interpret,
+    )(*inputs)
+    # [P, Hkv, NT, TQ*G, D] -> [P, Lp, Hq, D] -> slice chunk rows.
+    out = out.reshape(P, Hkv, NT, TQ, G, D).transpose(0, 2, 3, 1, 4, 5)
+    return out.reshape(P, Lp, Hq, D)[:, :Lpad]
