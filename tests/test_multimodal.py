@@ -8,6 +8,8 @@ must bypass the prefix cache (placeholder ids cannot key content).
 
 import threading
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -264,3 +266,149 @@ def test_media_requests_bypass_prefix_cache():
         assert out1b == out1  # deterministic given the same media
     finally:
         eng.stop()
+
+
+# --------------------------------------------- real VLM checkpoint towers
+
+
+def test_siglip_tower_roundtrip(tmp_path):
+    """SigLIP-arch tower saves to the HF SiglipVisionModel layout and
+    loads back bit-identical, producing the same media tokens."""
+    from xllm_service_tpu.runtime.weights import (
+        load_vision_checkpoint,
+        save_vision_checkpoint,
+    )
+
+    cfg = vision.get_vision_config("siglip-tiny")
+    params = vision.init_vision_params(cfg, jax.random.key(5), jnp.float32)
+    ckpt = str(tmp_path / "tower")
+    save_vision_checkpoint(params, cfg, ckpt)
+
+    loaded_cfg, loaded = load_vision_checkpoint(
+        ckpt, dtype=jnp.float32, out_dim=cfg.out_dim
+    )
+    assert loaded_cfg.arch == "siglip"
+    assert loaded_cfg.hidden_size == cfg.hidden_size
+    assert loaded_cfg.num_layers == cfg.num_layers
+
+    imgs = jnp.asarray(
+        np.random.default_rng(0).random((2, cfg.image_size, cfg.image_size, 3)),
+        jnp.float32,
+    )
+    want = vision.encode_images(params, cfg, imgs)
+    # out_tokens/out_dim come from the registry cfg (the checkpoint has no
+    # projector metadata) — encode under the ORIGINAL cfg with loaded
+    # weights for an apples-to-apples comparison.
+    got = vision.encode_images(loaded, cfg, imgs)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_encoder_engine_serves_checkpoint(tmp_path):
+    """The EPD ENCODE stage runs a checkpoint-LOADED tower (not random
+    init): VisionExecutor(checkpoint_path=...) output matches direct
+    encode_images with the saved weights."""
+    from xllm_service_tpu.runtime.vision_executor import VisionExecutor
+    from xllm_service_tpu.runtime.weights import save_vision_checkpoint
+
+    cfg = vision.get_vision_config("siglip-tiny")
+    params = vision.init_vision_params(cfg, jax.random.key(9), jnp.float32)
+    ckpt = str(tmp_path / "tower")
+    save_vision_checkpoint(params, cfg, ckpt)
+
+    ex = VisionExecutor(checkpoint_path=ckpt)
+    assert ex.cfg.arch == "siglip"
+    imgs = np.random.default_rng(1).random(
+        (3, cfg.image_size, cfg.image_size, 3)
+    ).astype(np.float32)
+    got = ex.encode(imgs)
+    want = np.asarray(
+        vision.encode_images(
+            ex.params, ex.cfg, jnp.asarray(imgs, jnp.float32)
+        ),
+        np.float32,
+    )
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-5)
+    assert got.shape == (3, ex.cfg.out_tokens, ex.cfg.out_dim)
+
+
+def test_siglip_matches_hf_reference(tmp_path):
+    """Numerical parity with the HF transformers SiglipVisionModel on the
+    same weights (the tower computation, pre-pooling) — proves the arch
+    mapping is the real SigLIP computation, not merely self-consistent."""
+    torch = pytest.importorskip("torch")
+    try:
+        from transformers import SiglipVisionConfig, SiglipVisionModel
+    except Exception:
+        pytest.skip("transformers lacks SiglipVisionModel")
+
+    cfg = vision.get_vision_config("siglip-tiny")
+    hf_cfg = SiglipVisionConfig(
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        image_size=cfg.image_size,
+        patch_size=cfg.patch_size,
+        layer_norm_eps=cfg.rms_norm_eps,
+        hidden_act="gelu_pytorch_tanh",
+    )
+    with torch.no_grad():
+        hf = SiglipVisionModel(hf_cfg).eval()
+        # Export HF weights into our layout via the checkpoint dir.
+        tensors = {
+            ("vision_model." + n if not n.startswith("vision_model.") else n): (
+                p.detach().numpy()
+            )
+            for n, p in hf.named_parameters()
+        }
+    # SiglipVisionModel includes a pooling head our tower doesn't use;
+    # drop it and write the rest in HF layout.
+    from xllm_service_tpu.runtime import weights as W
+
+    tensors = {
+        n: t for n, t in tensors.items() if ".head." not in n
+        and "pooler" not in n
+    }
+    ckpt = str(tmp_path / "hf-tower")
+    import os as _os
+
+    _os.makedirs(ckpt, exist_ok=True)
+    import json as _json
+
+    with open(_os.path.join(ckpt, "config.json"), "w") as f:
+        _json.dump({"vision_config": {
+            "image_size": cfg.image_size, "patch_size": cfg.patch_size,
+            "hidden_size": cfg.hidden_size,
+            "intermediate_size": cfg.intermediate_size,
+            "num_hidden_layers": cfg.num_layers,
+            "num_attention_heads": cfg.num_heads,
+            "layer_norm_eps": cfg.rms_norm_eps,
+        }}, f)
+    W.write_safetensors(_os.path.join(ckpt, "model.safetensors"), tensors)
+
+    loaded_cfg, params = W.load_vision_checkpoint(ckpt, dtype=jnp.float32)
+
+    rng = np.random.default_rng(3)
+    imgs = rng.random((2, cfg.image_size, cfg.image_size, 3)).astype(np.float32)
+    with torch.no_grad():
+        # HF expects NCHW
+        hf_out = hf(
+            torch.from_numpy(np.transpose(imgs, (0, 3, 1, 2)))
+        ).last_hidden_state.numpy()
+
+    # Our tower pre-pooling output: encode with out_tokens=num_patches and
+    # identity-ish projector — compare the post-layernorm hidden states by
+    # setting proj to identity.
+    E = loaded_cfg.hidden_size
+    params["proj"] = jnp.eye(E, dtype=jnp.float32)
+    import dataclasses as _dc
+
+    cfg_id = _dc.replace(
+        loaded_cfg, out_dim=E, out_tokens=loaded_cfg.num_patches
+    )
+    ours = np.asarray(
+        vision.encode_images(params, cfg_id, jnp.asarray(imgs)), np.float32
+    )
+    np.testing.assert_allclose(ours, hf_out, atol=2e-4, rtol=2e-4)

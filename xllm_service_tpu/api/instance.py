@@ -115,7 +115,11 @@ class InstanceServer:
                     EncoderEngine,
                 )
 
-                engine = EncoderEngine(model=engine_cfg.model)
+                engine = EncoderEngine(
+                    model=engine_cfg.model,
+                    checkpoint_path=engine_cfg.checkpoint_path,
+                    dtype=engine_cfg.dtype,
+                )
             else:
                 from xllm_service_tpu.runtime.engine import InferenceEngine
                 from xllm_service_tpu.runtime.executor import ModelExecutor

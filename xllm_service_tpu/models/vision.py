@@ -41,6 +41,14 @@ class VisionConfig:
     out_tokens: int  # media tokens emitted per image (LM placeholders)
     out_dim: int  # LM hidden size to project into
     rms_norm_eps: float = 1e-5
+    # Tower architecture: "rms" is the compact in-house ViT (RMSNorm,
+    # SiLU, bias-free); "siglip" matches the HF SiglipVisionModel tower
+    # (pre-LayerNorm with biases, tanh-GELU MLP, biased projections, no
+    # class token) so SigLIP-layout VLM checkpoints load weight-for-weight
+    # (runtime/weights.load_vision_checkpoint; HF-parity-tested). CLIP
+    # towers (class token, pre_layrnorm, quick_gelu) are NOT supported —
+    # the loader rejects their position-embedding shape.
+    arch: str = "rms"
 
     @property
     def num_patches(self) -> int:
@@ -90,8 +98,52 @@ register_vision(
 )
 
 
+def layer_norm(x: jnp.ndarray, weight, bias, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(axis=-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(axis=-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps) * weight + bias
+    return out.astype(x.dtype)
+
+
+register_vision(
+    VisionConfig(
+        # Test-scale SigLIP-arch tower (CI drives the checkpoint loader
+        # and the LayerNorm/GELU/bias path on it).
+        name="siglip-tiny",
+        image_size=32,
+        patch_size=8,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        out_tokens=16,  # = num_patches: no pooling, LLaVA-style
+        out_dim=128,
+        rms_norm_eps=1e-6,
+        arch="siglip",
+    )
+)
+
+register_vision(
+    VisionConfig(
+        # HF google/siglip-base-patch16-384 vision tower dims.
+        name="siglip-base-patch16-384",
+        image_size=384,
+        patch_size=16,
+        hidden_size=768,
+        intermediate_size=3072,
+        num_layers=12,
+        num_heads=12,
+        out_tokens=576,
+        out_dim=4096,
+        rms_norm_eps=1e-6,
+        arch="siglip",
+    )
+)
+
+
 def init_vision_params(cfg: VisionConfig, key, dtype=jnp.bfloat16) -> Params:
-    keys = jax.random.split(key, 10)
+    keys = jax.random.split(key, 12)
     E, L = cfg.hidden_size, cfg.num_layers
     D = E // cfg.num_heads
     F = cfg.intermediate_size
@@ -102,6 +154,34 @@ def init_vision_params(cfg: VisionConfig, key, dtype=jnp.bfloat16) -> Params:
             jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)
         ).astype(dtype)
 
+    if cfg.arch == "siglip":
+        return {
+            "patch_embed": w(keys[0], (patch_dim, E), patch_dim),
+            "patch_bias": jnp.zeros((E,), dtype),
+            "pos_embed": w(keys[1], (cfg.num_patches, E), E),
+            "layers": {
+                "ln1_w": jnp.ones((L, E), jnp.float32),
+                "ln1_b": jnp.zeros((L, E), jnp.float32),
+                "wq": w(keys[2], (L, E, E), E),
+                "bq": jnp.zeros((L, E), dtype),
+                "wk": w(keys[3], (L, E, E), E),
+                "bk": jnp.zeros((L, E), dtype),
+                "wv": w(keys[4], (L, E, E), E),
+                "bv": jnp.zeros((L, E), dtype),
+                "wo": w(keys[5], (L, E, E), E),
+                "bo": jnp.zeros((L, E), dtype),
+                "ln2_w": jnp.ones((L, E), jnp.float32),
+                "ln2_b": jnp.zeros((L, E), jnp.float32),
+                "w_up": w(keys[6], (L, E, F), E),
+                "b_up": jnp.zeros((L, F), dtype),
+                "w_down": w(keys[7], (L, F, E), F),
+                "b_down": jnp.zeros((L, E), dtype),
+            },
+            "final_norm_w": jnp.ones((E,), jnp.float32),
+            "final_norm_b": jnp.zeros((E,), jnp.float32),
+            "proj": w(keys[8], (E, cfg.out_dim), E),
+            "proj_bias": jnp.zeros((cfg.out_dim,), dtype),
+        }
     return {
         "patch_embed": w(keys[0], (patch_dim, E), patch_dim),
         "pos_embed": w(keys[1], (cfg.num_patches, E), E),
@@ -128,10 +208,56 @@ def _patchify(images: jnp.ndarray, patch: int) -> jnp.ndarray:
     return x.reshape(B, n * n, patch * patch * C)
 
 
+def _encode_siglip(
+    params: Params, cfg: VisionConfig, images: jnp.ndarray
+) -> jnp.ndarray:
+    """SigLIP/CLIP-style tower: pre-LayerNorm blocks with biases,
+    tanh-GELU MLP — the HF SiglipVisionModel computation, weight-loaded
+    by runtime/weights.load_vision_checkpoint."""
+    B = images.shape[0]
+    H, D = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    eps = cfg.rms_norm_eps
+    x = _patchify(images.astype(params["patch_embed"].dtype), cfg.patch_size)
+    x = jnp.einsum("bnp,pe->bne", x, params["patch_embed"]) + params["patch_bias"]
+    x = x + params["pos_embed"][None]
+
+    def layer_fn(x, lp):
+        h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], eps)
+        N = h.shape[1]
+        q = (jnp.einsum("bne,ef->bnf", h, lp["wq"]) + lp["bq"]).reshape(B, N, H, D)
+        k = (jnp.einsum("bne,ef->bnf", h, lp["wk"]) + lp["bk"]).reshape(B, N, H, D)
+        v = (jnp.einsum("bne,ef->bnf", h, lp["wv"]) + lp["bv"]).reshape(B, N, H, D)
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) * (D**-0.5)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+        attn = attn.reshape(B, N, -1).astype(x.dtype)
+        x = x + jnp.einsum("bne,ef->bnf", attn, lp["wo"]) + lp["bo"]
+        h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], eps)
+        h = jnp.einsum("bne,ef->bnf", h, lp["w_up"]) + lp["b_up"]
+        h = jax.nn.gelu(h, approximate=True)
+        x = x + jnp.einsum("bnf,fe->bne", h, lp["w_down"]) + lp["b_down"]
+        return x, None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    x = layer_norm(x, params["final_norm_w"], params["final_norm_b"], eps)
+    N = x.shape[1]
+    G = max(N // cfg.out_tokens, 1)
+    pooled = x[:, : G * cfg.out_tokens].reshape(
+        B, cfg.out_tokens, G, cfg.hidden_size
+    ).mean(axis=2)
+    return (
+        jnp.einsum("bte,ed->btd", pooled, params["proj"]) + params["proj_bias"]
+    )
+
+
 def encode_images(
     params: Params, cfg: VisionConfig, images: jnp.ndarray
 ) -> jnp.ndarray:
     """[B, S, S, 3] float in [0, 1] -> media tokens [B, out_tokens, out_dim]."""
+    if cfg.arch == "siglip":
+        return _encode_siglip(params, cfg, images)
     B = images.shape[0]
     H, D = cfg.num_heads, cfg.hidden_size // cfg.num_heads
     x = _patchify(images.astype(params["patch_embed"].dtype), cfg.patch_size)

@@ -29,12 +29,30 @@ from xllm_service_tpu.models import vision
 
 class VisionExecutor:
     def __init__(self, model: str = "vit-tiny", dtype: str = "float32",
-                 init_seed: int = 0):
-        self.cfg = vision.get_vision_config(model)
+                 init_seed: int = 0, checkpoint_path: str = ""):
+        import os
+
         self.dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
-        self.params = vision.init_vision_params(
-            self.cfg, jax.random.key(init_seed), self.dtype
-        )
+        if checkpoint_path:
+            # Real HF vision tower (SigLIP layout) — weights and
+            # architecture come from the checkpoint dir. A set-but-broken
+            # path fails LOUDLY (same contract as the LM executor), never
+            # silently serving random-init embeddings.
+            if not os.path.exists(os.path.join(checkpoint_path, "config.json")):
+                raise FileNotFoundError(
+                    f"vision checkpoint dir {checkpoint_path!r} has no "
+                    f"config.json"
+                )
+            from xllm_service_tpu.runtime.weights import load_vision_checkpoint
+
+            self.cfg, self.params = load_vision_checkpoint(
+                checkpoint_path, dtype=self.dtype
+            )
+        else:
+            self.cfg = vision.get_vision_config(model)
+            self.params = vision.init_vision_params(
+                self.cfg, jax.random.key(init_seed), self.dtype
+            )
         self._jit = jax.jit(
             lambda p, imgs: vision.encode_images(p, self.cfg, imgs)
         )
@@ -63,8 +81,11 @@ class EncoderEngine:
     start/stop, heartbeat metric sources, and the encode entry point."""
 
     def __init__(self, executor: Optional[VisionExecutor] = None,
-                 model: str = "vit-tiny"):
-        self.executor = executor or VisionExecutor(model)
+                 model: str = "vit-tiny", checkpoint_path: str = "",
+                 dtype: str = "float32"):
+        self.executor = executor or VisionExecutor(
+            model, dtype=dtype, checkpoint_path=checkpoint_path
+        )
         self._active = 0
         self._mu = threading.Lock()
         self._latency_window: List[Tuple[float, float]] = []

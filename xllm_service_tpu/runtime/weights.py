@@ -508,6 +508,223 @@ def load_checkpoint(
     return params
 
 
+# ------------------------------------------------------------ vision towers
+
+
+def vision_config_from_hf(path: str, out_dim: int = 0):
+    """VisionConfig from an HF checkpoint dir carrying a SigLIP-layout
+    vision tower (config.json `vision_config`, or a bare vision-model
+    config). `out_dim` overrides the projector target (defaults to the
+    tower hidden size when the checkpoint has no projector). CLIP-style
+    class-token towers are rejected at load (see load_vision_checkpoint)."""
+    from xllm_service_tpu.models.vision import VisionConfig
+
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    vc = hf.get("vision_config", hf)
+    image_size = int(vc["image_size"])
+    patch = int(vc["patch_size"])
+    if image_size % patch:
+        raise ValueError(
+            f"image_size {image_size} not divisible by patch_size {patch} "
+            f"(conv-with-remainder towers are not supported)"
+        )
+    n_patches = (image_size // patch) ** 2
+    return VisionConfig(
+        name=hf.get("model_type", "siglip") + "-vision",
+        image_size=image_size,
+        patch_size=patch,
+        hidden_size=int(vc["hidden_size"]),
+        intermediate_size=int(vc["intermediate_size"]),
+        num_layers=int(vc["num_hidden_layers"]),
+        num_heads=int(vc["num_attention_heads"]),
+        out_tokens=n_patches,  # no pooling: LLaVA-style full patch grid
+        out_dim=out_dim or int(vc["hidden_size"]),
+        rms_norm_eps=float(vc.get("layer_norm_eps", 1e-6)),
+        arch="siglip",
+    )
+
+
+# HF SiglipVisionModel tensor name -> (leaf key, transpose). Layer leaves
+# carry "layers." and a layer index parsed from the name.
+_VISION_SIMPLE = {
+    "vision_model.embeddings.position_embedding.weight": ("pos_embed", False),
+    "vision_model.post_layernorm.weight": ("final_norm_w", False),
+    "vision_model.post_layernorm.bias": ("final_norm_b", False),
+}
+_VISION_LAYER = {
+    "layer_norm1.weight": ("ln1_w", False),
+    "layer_norm1.bias": ("ln1_b", False),
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.q_proj.bias": ("bq", False),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.k_proj.bias": ("bk", False),
+    "self_attn.v_proj.weight": ("wv", True),
+    "self_attn.v_proj.bias": ("bv", False),
+    "self_attn.out_proj.weight": ("wo", True),
+    "self_attn.out_proj.bias": ("bo", False),
+    "layer_norm2.weight": ("ln2_w", False),
+    "layer_norm2.bias": ("ln2_b", False),
+    "mlp.fc1.weight": ("w_up", True),
+    "mlp.fc1.bias": ("b_up", False),
+    "mlp.fc2.weight": ("w_down", True),
+    "mlp.fc2.bias": ("b_down", False),
+}
+
+
+def load_vision_checkpoint(
+    path: str, cfg=None, dtype=jnp.bfloat16, out_dim: int = 0
+):
+    """Load an HF SiglipVisionModel-layout checkpoint dir into the
+    models/vision.py `siglip` param pytree. Returns (VisionConfig, params).
+
+    The conv patch embedding [E, 3, P, P] flattens to the patchify
+    matmul's [P*P*3, E] layout ((py, px, c) lane order — models/vision.py
+    _patchify). A `multi_modal_projector.linear.weight` (or `proj.weight`)
+    maps to the LM-dim projector when present; otherwise the projector
+    initializes to identity-like random and `out_dim` falls back to the
+    tower width (caller projects downstream)."""
+    from xllm_service_tpu.models.vision import init_vision_params
+
+    cfg = cfg or vision_config_from_hf(path, out_dim=out_dim)
+    np_dtype = ml_dtypes.bfloat16 if dtype == jnp.bfloat16 else np.dtype(dtype)
+    E, L, P = cfg.hidden_size, cfg.num_layers, cfg.patch_size
+
+    # Stage over random init so an absent projector keeps a usable leaf;
+    # every TOWER leaf must land (tracked below). np.array: a WRITABLE
+    # host copy (np.asarray of a jax array is read-only).
+    params = jax.tree.map(
+        lambda x: np.array(x), init_vision_params(cfg, jax.random.key(0), dtype)
+    )
+    needed = {"patch_embed", "patch_bias", "pos_embed",
+              "final_norm_w", "final_norm_b"}
+    needed |= {f"layers.{k}" for k, _ in _VISION_LAYER.values()}
+    landed = set()
+    layer_seen: Dict[str, np.ndarray] = {
+        f"layers.{k}": np.zeros(L, bool) for k, _ in _VISION_LAYER.values()
+    }
+
+    for file in _shard_files(path):
+        for name, arr in read_safetensors(file):
+            # VLM checkpoints prefix the tower (e.g. "vision_tower.");
+            # strip anything before "vision_model.".
+            if "vision_model." in name:
+                name = name[name.index("vision_model."):]
+            if name == "vision_model.embeddings.patch_embedding.weight":
+                # conv [E, 3, P, P] -> [(py, px, c), E]
+                w = np.transpose(arr, (2, 3, 1, 0)).reshape(P * P * 3, E)
+                params["patch_embed"] = w.astype(np_dtype)
+                landed.add("patch_embed")
+            elif name == "vision_model.embeddings.patch_embedding.bias":
+                params["patch_bias"] = np.asarray(arr, np_dtype)
+                landed.add("patch_bias")
+            elif name in _VISION_SIMPLE:
+                key, _t = _VISION_SIMPLE[name]
+                if key == "pos_embed" and arr.shape[0] != cfg.num_patches:
+                    # CLIP-style towers carry a class token (num_patches+1
+                    # rows) and a different computation (pre_layrnorm,
+                    # quick_gelu) — reject loudly instead of broadcasting
+                    # garbage inside the jitted encode.
+                    raise ValueError(
+                        f"position embedding has {arr.shape[0]} rows, "
+                        f"expected {cfg.num_patches}: class-token (CLIP) "
+                        f"towers are not supported; use a SigLIP-layout "
+                        f"tower"
+                    )
+                want = (
+                    np.float32 if key.startswith(("final_norm",)) else np_dtype
+                )
+                params[key] = np.asarray(arr, want)
+                landed.add(key)
+            elif name.startswith("vision_model.encoder.layers."):
+                rest = name[len("vision_model.encoder.layers."):]
+                layer_s, _, tail = rest.partition(".")
+                if tail in _VISION_LAYER:
+                    key, transpose = _VISION_LAYER[tail]
+                    src = arr.T if transpose else arr
+                    buf = params["layers"][key]
+                    np.copyto(buf[int(layer_s)], src, casting="unsafe")
+                    layer_seen[f"layers.{key}"][int(layer_s)] = True
+            elif name in (
+                "multi_modal_projector.linear.weight", "proj.weight"
+            ):
+                params["proj"] = np.asarray(arr.T, np_dtype)
+                landed.add("proj")
+            elif name in (
+                "multi_modal_projector.linear.bias", "proj.bias"
+            ):
+                params["proj_bias"] = np.asarray(arr, np_dtype)
+                landed.add("proj_bias")
+
+    for k, seen in layer_seen.items():
+        if seen.all():
+            landed.add(k)
+    missing = sorted(needed - landed)
+    if missing:
+        raise ValueError(f"vision checkpoint {path} missing tensors: {missing}")
+    if "proj" in landed:
+        # The checkpoint's own projector decides the output dim (without
+        # one, the random-init projector already staged at cfg.out_dim
+        # stands). A weight without a bias keeps bias = 0 at the RIGHT
+        # width.
+        proj_dim = int(params["proj"].shape[1])
+        if proj_dim != cfg.out_dim:
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, out_dim=proj_dim)
+        if "proj_bias" not in landed:
+            params["proj_bias"] = np.zeros((proj_dim,), np_dtype)
+    return cfg, jax.tree.map(jnp.asarray, params)
+
+
+def save_vision_checkpoint(params, cfg, path: str) -> None:
+    """Inverse of load_vision_checkpoint (HF SiglipVisionModel layout) —
+    round-trip tested; usable for exporting synthetic towers."""
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(
+            {
+                "model_type": "siglip_vision_model",
+                "vision_config": {
+                    "image_size": cfg.image_size,
+                    "patch_size": cfg.patch_size,
+                    "hidden_size": cfg.hidden_size,
+                    "intermediate_size": cfg.intermediate_size,
+                    "num_hidden_layers": cfg.num_layers,
+                    "num_attention_heads": cfg.num_heads,
+                    "layer_norm_eps": cfg.rms_norm_eps,
+                },
+            },
+            f, indent=2,
+        )
+
+    def host(x) -> np.ndarray:
+        a = np.asarray(x)
+        return a.astype(ml_dtypes.bfloat16) if a.dtype == ml_dtypes.bfloat16 else a
+
+    E, P = cfg.hidden_size, cfg.patch_size
+    lp = params["layers"]
+    tensors: Dict[str, np.ndarray] = {
+        "vision_model.embeddings.patch_embedding.weight": np.ascontiguousarray(
+            np.transpose(
+                host(params["patch_embed"]).reshape(P, P, 3, E), (3, 2, 0, 1)
+            )
+        ),
+        "vision_model.embeddings.patch_embedding.bias": host(params["patch_bias"]),
+        "vision_model.embeddings.position_embedding.weight": host(params["pos_embed"]),
+        "vision_model.post_layernorm.weight": host(params["final_norm_w"]),
+        "vision_model.post_layernorm.bias": host(params["final_norm_b"]),
+        "proj.weight": np.ascontiguousarray(host(params["proj"]).T),
+        "proj.bias": host(params["proj_bias"]),
+    }
+    for i in range(cfg.num_layers):
+        pre = f"vision_model.encoder.layers.{i}."
+        for tail, (key, transpose) in _VISION_LAYER.items():
+            t = host(lp[key])[i]
+            tensors[pre + tail] = np.ascontiguousarray(t.T if transpose else t)
+    write_safetensors(os.path.join(path, "model.safetensors"), tensors)
+
+
 # ---------------------------------------------------------------- HF export
 
 
