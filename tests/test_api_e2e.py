@@ -78,7 +78,7 @@ def sse_post(addr, path, body, timeout=30.0):
 
 @pytest.fixture(scope="module")
 def cluster():
-    store = MemoryStore()
+    store = MemoryStore(clock=lambda: 0.0)  # frozen: leases never lapse under GIL stalls
     cfg = ServiceConfig(
         host="127.0.0.1",
         http_port=0,

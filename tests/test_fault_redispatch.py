@@ -50,7 +50,7 @@ def make_instance(master, name, itype="MIX", **engine_kw):
 
 
 def test_slow_instance_death_redispatches_queued_request():
-    store = MemoryStore()
+    store = MemoryStore(clock=lambda: 0.0)  # frozen: leases never lapse under GIL stalls
     master = make_master(store)
     # i0: accepts the forward but never generates (hung engine);
     # i1: healthy echo engine.
@@ -80,10 +80,16 @@ def test_slow_instance_death_redispatches_queued_request():
                 break
         t = threading.Thread(target=client, daemon=True)
         t.start()
-        # wait until the request is in flight, then kill i0 (stop heartbeats
-        # + let its lease lapse)
+        # wait until the request is in flight, then kill i0 UNGRACEFULLY
+        # (heartbeats stop, no deregister — a crashed engine). The store
+        # clock is frozen (leases can't lapse under GIL stalls), so the
+        # death signal is raised EXPLICITLY: expire i0's registration
+        # lease, exactly what the sweeper does when a real TTL passes.
         assert wait_until(lambda: master.scheduler.num_inflight == 1)
-        hung.stop()
+        with master._leases_mu:
+            lid = master._leases["i0"]
+        hung._heartbeat.stop()
+        store.expire_lease_now(lid)
         t.join(timeout=60.0)
         code, body = result["resp"]
         if body["choices"][0]["text"] == "dcba":
@@ -91,11 +97,12 @@ def test_slow_instance_death_redispatches_queued_request():
         else:
             pytest.fail(f"unexpected response: {body}")
     finally:
-        healthy.stop(); decode.stop(); master.stop(); store.close()
+        hung.stop(); healthy.stop(); decode.stop(); master.stop()
+        store.close()
 
 
 def test_fast_connection_failure_redispatches_immediately():
-    store = MemoryStore()
+    store = MemoryStore(clock=lambda: 0.0)  # frozen: leases never lapse under GIL stalls
     master = make_master(store)
     healthy = make_instance(master, "good", "MIX")
     try:
@@ -127,7 +134,7 @@ def test_fast_connection_failure_redispatches_immediately():
 
 
 def test_midstream_death_errors_cleanly():
-    store = MemoryStore()
+    store = MemoryStore(clock=lambda: 0.0)  # frozen: leases never lapse under GIL stalls
     master = make_master(store)
     # slow token emitter so we can kill it mid-stream
     slow = make_instance(master, "slow", "MIX", token_delay_s=0.3)

@@ -201,7 +201,8 @@ def test_service_index_follows_tiers():
         prompt_a = [(i * 11 + 1) % 512 for i in range(40)]
         prompt_b = [(i * 7 + 3) % 512 for i in range(40)]
         mgr = GlobalKVCacheMgr(
-            MemoryStore(), is_master=lambda: True, block_size=bs,
+            MemoryStore(clock=lambda: 0.0),  # frozen clock
+            is_master=lambda: True, block_size=bs,
             murmur_hash3_seed=h.engine.block_mgr.seed,
         )
         inst = "engine-0"

@@ -76,7 +76,7 @@ def test_offer_pull_roundtrip():
 
 
 def _mk_master():
-    store = MemoryStore()
+    store = MemoryStore(clock=lambda: 0.0)  # frozen: leases never lapse under GIL stalls
     cfg = ServiceConfig(
         host="127.0.0.1", http_port=0, rpc_port=0,
         heartbeat_interval_s=0.2, master_lease_ttl_s=5.0,

@@ -142,7 +142,7 @@ def test_epd_three_stage_e2e():
 
     from tests.test_api_e2e import http_post, wait_until
 
-    store = MemoryStore()
+    store = MemoryStore(clock=lambda: 0.0)  # frozen: leases never lapse under GIL stalls
     master = Master(
         ServiceConfig(
             host="127.0.0.1", http_port=0, rpc_port=0,

@@ -32,7 +32,7 @@ def direct_instance():
 
 @pytest.fixture(scope="module")
 def forwarded_stack():
-    store = MemoryStore()
+    store = MemoryStore(clock=lambda: 0.0)  # frozen: leases never lapse under GIL stalls
     master = Master(
         ServiceConfig(
             host="127.0.0.1", http_port=0, rpc_port=0,

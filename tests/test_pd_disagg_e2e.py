@@ -32,7 +32,7 @@ def engine_cfg(name, itype, **kw):
 
 @pytest.fixture(scope="module")
 def pd_stack():
-    store = MemoryStore()
+    store = MemoryStore(clock=lambda: 0.0)  # frozen: leases never lapse under GIL stalls
     cfg = ServiceConfig(
         host="127.0.0.1", http_port=0, rpc_port=0,
         heartbeat_interval_s=0.2, master_lease_ttl_s=5.0,
@@ -63,7 +63,7 @@ def pd_stack():
 @pytest.fixture(scope="module")
 def colocated():
     """Oracle: one MIX instance with identical weights, own master."""
-    store = MemoryStore()
+    store = MemoryStore(clock=lambda: 0.0)  # frozen: leases never lapse under GIL stalls
     cfg = ServiceConfig(
         host="127.0.0.1", http_port=0, rpc_port=0,
         heartbeat_interval_s=0.2, master_lease_ttl_s=5.0,
@@ -134,7 +134,7 @@ def relay_stack():
     """PD stack running the ALTERNATE response topology
     (enable_decode_response_to_service=False — reference service.h:61-71):
     decode relays generations back through the prefill instance."""
-    store = MemoryStore()
+    store = MemoryStore(clock=lambda: 0.0)  # frozen: leases never lapse under GIL stalls
     cfg = ServiceConfig(
         host="127.0.0.1", http_port=0, rpc_port=0,
         heartbeat_interval_s=0.2, master_lease_ttl_s=5.0,
@@ -204,7 +204,7 @@ def test_relay_topology_streaming(relay_stack):
 def local_transfer_stack():
     """PD pair in one process with the DIRECT (no-serialization) KV
     handoff path enabled — the single-host analog of ICI transfer."""
-    store = MemoryStore()
+    store = MemoryStore(clock=lambda: 0.0)  # frozen: leases never lapse under GIL stalls
     cfg = ServiceConfig(
         host="127.0.0.1", http_port=0, rpc_port=0,
         heartbeat_interval_s=0.2, master_lease_ttl_s=5.0,

@@ -192,7 +192,7 @@ class TestResponseHandler:
 
 @pytest.fixture
 def sched_env():
-    store = MemoryStore()
+    store = MemoryStore(clock=lambda: 0.0)  # frozen: leases never lapse under GIL stalls
     cfg = ServiceConfig(
         etcd_addr="memory://unused",
         heartbeat_interval_s=0.1,

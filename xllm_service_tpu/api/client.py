@@ -43,6 +43,17 @@ class MasterClient:
             raise RuntimeError(f"register failed: {code} {resp}")
         return resp
 
+    def deregister(self, name: str) -> bool:
+        """Graceful-shutdown removal from the registry (lease revoked
+        immediately; ungraceful death still falls to TTL expiry)."""
+        try:
+            code, resp = post_json(
+                self._addr, "/rpc/deregister", {"name": name}, timeout=5.0
+            )
+        except Exception:
+            return False
+        return code == 200 and resp.get("ok", False)
+
     def heartbeat(
         self,
         name: str,
@@ -121,6 +132,10 @@ class HeartbeatLoop:
         self._stop.set()
         self._thread.join(timeout=2.0)
 
+    @property
+    def stopped(self) -> bool:
+        return self._stop.is_set()
+
     def beat_now(self) -> Dict:
         """One synchronous beat (tests / forced flush)."""
         return self._beat()
@@ -153,7 +168,11 @@ class HeartbeatLoop:
         if not resp.get("ok", False) and event is not None and not event.empty():
             # Master rejected/unreachable: keep the delta for the next beat.
             self._pending_event = event
-        if resp.get("reregister"):
+        if resp.get("reregister") and not self._stop.is_set():
+            # The stop guard matters: a slow in-flight beat straddling
+            # shutdown would otherwise re-insert the instance AFTER the
+            # graceful deregister revoked its lease — routing requests to
+            # a closed endpoint until the fresh TTL lapsed.
             try:
                 self._client.register(self._meta)
             except Exception:

@@ -255,6 +255,14 @@ class InstanceServer:
                 del _LOCAL_INSTANCES[self.name]
         if self._heartbeat is not None:
             self._heartbeat.stop()
+        if self._master is not None:
+            # Graceful shutdown: leave the registry NOW (best-effort) so
+            # the master stops routing here immediately — crash death
+            # still falls to lease-TTL expiry.
+            try:
+                self._master.deregister(self.name)
+            except Exception:
+                pass
         self._push_q.put(None)
         self._push_thread.join(timeout=5.0)
         for _ in self._transfer_threads:

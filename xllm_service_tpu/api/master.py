@@ -602,6 +602,8 @@ class Master:
             self._handle_register(h, body)
         elif route == "/rpc/heartbeat":
             self._handle_heartbeat(h, body)
+        elif route == "/rpc/deregister":
+            self._handle_deregister(h, body)
         elif route == "/rpc/generations":
             self._handle_generations(h, body)
         else:
@@ -633,6 +635,21 @@ class Master:
                 "heartbeat_interval_s": self.config.heartbeat_interval_s,
             }
         )
+
+    def _handle_deregister(self, h: QuietHandler, body: Dict[str, Any]) -> None:
+        """Graceful shutdown: revoke the instance's registration lease NOW
+        (DELETE event -> registry drop -> routing stops immediately),
+        instead of leaving a dead endpoint routable until the TTL lapses.
+        Ungraceful death keeps the lease-expiry path (sweeper)."""
+        name = body.get("name", "")
+        if not name:
+            h.send_error_json(400, "name required")
+            return
+        with self._leases_mu:
+            lease = self._leases.pop(name, None)
+        if lease is not None:
+            self._store.revoke_lease(lease)
+        h.send_json({"ok": True, "removed": lease is not None})
 
     def _handle_heartbeat(self, h: QuietHandler, body: Dict[str, Any]) -> None:
         name = body.get("name", "")

@@ -122,10 +122,10 @@ class CoordinationStore:
 class _Lease:
     __slots__ = ("lease_id", "ttl_s", "expires_at", "keys")
 
-    def __init__(self, lease_id: int, ttl_s: float):
+    def __init__(self, lease_id: int, ttl_s: float, now: float):
         self.lease_id = lease_id
         self.ttl_s = ttl_s
-        self.expires_at = time.monotonic() + ttl_s
+        self.expires_at = now + ttl_s
         self.keys: set = set()
 
 
@@ -138,7 +138,15 @@ class MemoryStore(CoordinationStore):
     produces DELETE events exactly like an etcd lease timeout.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, clock=None) -> None:
+        # `clock`: monotonic-seconds callable driving LEASE TIME only
+        # (watch/notify stay real-threaded). Tests that don't exercise
+        # liveness inject a frozen clock so leases can never expire
+        # underneath them — an XLA compile hogging the GIL past a
+        # wall-clock TTL was the suite's recurring flake (rounds 1-2);
+        # failure-detection tests advance a manual clock instead of
+        # sleeping.
+        self._clock = clock or time.monotonic
         self._mu = threading.RLock()
         self._kv: Dict[str, str] = {}
         self._key_lease: Dict[str, int] = {}
@@ -188,7 +196,7 @@ class MemoryStore(CoordinationStore):
             with self._mu:
                 if self._closed:
                     return
-                now = time.monotonic()
+                now = self._clock()
                 expired = [l for l in self._leases.values() if l.expires_at <= now]
                 events: List[WatchEvent] = []
                 for lease in expired:
@@ -255,7 +263,7 @@ class MemoryStore(CoordinationStore):
         with self._mu:
             lid = self._next_lease_id
             self._next_lease_id += 1
-            self._leases[lid] = _Lease(lid, ttl_s)
+            self._leases[lid] = _Lease(lid, ttl_s, self._clock())
             return lid
 
     def keepalive(self, lease_id: int) -> bool:
@@ -263,7 +271,7 @@ class MemoryStore(CoordinationStore):
             lease = self._leases.get(lease_id)
             if lease is None:
                 return False
-            lease.expires_at = time.monotonic() + lease.ttl_s
+            lease.expires_at = self._clock() + lease.ttl_s
             return True
 
     def revoke_lease(self, lease_id: int) -> None:
