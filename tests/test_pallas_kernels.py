@@ -241,3 +241,78 @@ def test_prefill_dispatcher_kernel_branch():
             np.asarray(out)[p, :tl], np.asarray(ref)[p, :tl],
             atol=3e-5, rtol=3e-5,
         )
+
+
+# --------------------------------------------------- MLA flash prefill
+
+from xllm_service_tpu.ops.attention import mla_prefill_blockwise
+from xllm_service_tpu.ops.pallas.mla_prefill import mla_flash_prefill_kernel
+
+
+def make_mla_prefill_case(
+    rng, P=2, Lpad=32, Hq=8, C=56, BS=16, MB=8, num_blocks=64
+):
+    q = jnp.asarray(rng.standard_normal((P, Lpad, Hq, C)), jnp.float32)
+    cache = jnp.asarray(
+        rng.standard_normal((num_blocks, 1, BS, C)), jnp.float32
+    )
+    bt = jnp.asarray(
+        np.stack([
+            rng.choice(np.arange(1, num_blocks), size=MB, replace=False)
+            for _ in range(P)
+        ]).astype(np.int32)
+    )
+    return q, cache, bt
+
+
+def _mla_blockwise_ref(q, cache, bt, start_pos, true_len, scale, kvr):
+    return jax.vmap(
+        lambda qi, ti, sp, tl: mla_prefill_blockwise(
+            qi, cache, ti, sp, tl, scale, kvr
+        )
+    )(q, bt, start_pos, true_len)
+
+
+@pytest.mark.parametrize("tile_q", [8, 16])
+def test_mla_flash_prefill_matches_blockwise(tile_q):
+    """Latent-space flash prefill vs the blockwise oracle: ragged lens,
+    prefix hits, absorbed-form output ([.., kv_rank], W_UV applied by the
+    caller)."""
+    rng = np.random.default_rng(0)
+    kvr = 40  # latent rank; C = kvr + rope(16)
+    q, cache, bt = make_mla_prefill_case(rng, C=56)
+    start_pos = jnp.asarray([0, 24], jnp.int32)
+    true_len = jnp.asarray([32, 17], jnp.int32)
+    scale = 0.125
+    ref = _mla_blockwise_ref(q, cache, bt, start_pos, true_len, scale, kvr)
+    out = mla_flash_prefill_kernel(
+        q, cache, bt, start_pos, true_len, scale, kvr, interpret=True,
+        tile_q=tile_q,
+    )
+    for p, tl in enumerate([32, 17]):
+        np.testing.assert_allclose(
+            np.asarray(out)[p, :tl], np.asarray(ref)[p, :tl],
+            atol=3e-5, rtol=3e-5,
+        )
+
+
+def test_mla_prefill_dispatcher_kernel_branch():
+    from xllm_service_tpu.ops.attention import mla_prefill_attention
+
+    rng = np.random.default_rng(1)
+    kvr = 40
+    q, cache, bt = make_mla_prefill_case(rng, C=56)
+    start_pos = jnp.asarray([0, 8], jnp.int32)
+    true_len = jnp.asarray([20, 32], jnp.int32)
+    ref = mla_prefill_attention(
+        q, cache, bt, start_pos, true_len, 0.125, kvr, use_kernel=False
+    )
+    out = mla_prefill_attention(
+        q, cache, bt, start_pos, true_len, 0.125, kvr, use_kernel=True,
+        interpret=True,
+    )
+    for p, tl in enumerate([20, 32]):
+        np.testing.assert_allclose(
+            np.asarray(out)[p, :tl], np.asarray(ref)[p, :tl],
+            atol=3e-5, rtol=3e-5,
+        )

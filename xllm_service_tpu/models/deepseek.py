@@ -35,7 +35,7 @@ from xllm_service_tpu.models.llama import _mlp, _unembed
 from xllm_service_tpu.ops import kv_cache as kv_cache_ops
 from xllm_service_tpu.ops.attention import (
     mla_paged_attention,
-    mla_prefill_blockwise,
+    mla_prefill_attention,
 )
 from xllm_service_tpu.ops.norms import rms_norm
 from xllm_service_tpu.ops.rope import apply_rope
@@ -335,11 +335,9 @@ def prefill_batch_step(
                 rows.reshape(P * Lpad, 1, rows.shape[-1]),
             )
             q_lat = _absorb_q(lp, q_nope, q_pe)  # [P, Lpad, Hq, C]
-            ctx = jax.vmap(
-                lambda qi, ti, sp, tl: mla_prefill_blockwise(
-                    qi, c_l, ti, sp, tl, scale, kvr
-                )
-            )(q_lat, block_tables, start_pos, true_len)  # [P, Lpad, Hq, kvr]
+            ctx = mla_prefill_attention(
+                q_lat, c_l, block_tables, start_pos, true_len, scale, kvr
+            )  # [P, Lpad, Hq, kvr] — flash kernel on TPU
             x = x + _attn_out(lp, cfg, ctx)
             h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
             x = x + jax.vmap(lambda t: _mlp(lp, mcfg, t))(h)

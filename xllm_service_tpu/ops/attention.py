@@ -277,6 +277,46 @@ def mla_paged_attention(
     )
 
 
+def mla_prefill_attention(
+    q_lat: jnp.ndarray,  # [P, Lpad, Hq, C] — the batched chunk's queries
+    c_cache,
+    block_tables: jnp.ndarray,  # [P, CB]
+    start_pos: jnp.ndarray,  # [P]
+    true_len: jnp.ndarray,  # [P]
+    scale: float,
+    kv_rank: int,
+    use_kernel: bool | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Batched MLA chunked-prefill attention; Pallas flash kernel
+    (ops/pallas/mla_prefill.py) on TPU, vmapped blockwise scan elsewhere.
+    Quantized latent caches ALWAYS use the blockwise path (no int8 MLA
+    kernel — same policy as mla_paged_attention);
+    XLLM_MLA_PREFILL_KERNEL=0/1 forces the path, `interpret` drives the
+    kernel branch in CI."""
+    import os
+
+    quantized = isinstance(c_cache, kvc.PagedKV) and c_cache.quantized
+    if use_kernel is None:
+        env = os.environ.get("XLLM_MLA_PREFILL_KERNEL")
+        kernel_ok = (_on_tpu() or interpret) and not quantized
+        use_kernel = (env != "0") if kernel_ok else (env == "1")
+    if use_kernel and not quantized:
+        from xllm_service_tpu.ops.pallas.mla_prefill import (
+            mla_flash_prefill_kernel,
+        )
+
+        return mla_flash_prefill_kernel(
+            q_lat, kvc.raw(c_cache), block_tables, start_pos, true_len,
+            scale, kv_rank, interpret=interpret,
+        )
+    return jax.vmap(
+        lambda qi, ti, sp, tl: mla_prefill_blockwise(
+            qi, c_cache, ti, sp, tl, scale, kv_rank
+        )
+    )(q_lat, block_tables, start_pos, true_len)
+
+
 def mla_prefill_blockwise(
     q_lat: jnp.ndarray,  # [Lq, Hq, C] for ONE sequence's chunk
     c_cache,  # [N, 1, BS, C]
