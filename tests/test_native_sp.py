@@ -1,0 +1,184 @@
+"""Native SentencePiece-Unigram family (native/sp_tokenizer.cpp +
+tokenizer/native_sp.py) — the reference's sentencepiece_tokenizer.cpp
+analog. The .model fixtures are hand-built protobufs (the sentencepiece
+pip package is not in this image), and Viterbi optimality is pinned to a
+pure-Python dynamic-programming oracle over the same pieces.
+"""
+
+import json
+import os
+import struct
+
+import pytest
+
+from xllm_service_tpu.tokenizer import create_tokenizer
+from xllm_service_tpu.tokenizer.native_sp import NativeSPTokenizer, try_load
+
+
+def _varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _piece(p: str, score: float, t: int = 1) -> bytes:
+    body = b"\x0a" + _varint(len(p.encode())) + p.encode()
+    body += b"\x15" + struct.pack("<f", score)
+    body += b"\x18" + _varint(t)
+    return b"\x0a" + _varint(len(body)) + body
+
+
+def _write_model(dirpath, pieces, add_dummy_prefix=True):
+    blob = b"".join(_piece(*p) for p in pieces)
+    norm = (
+        (b"\x18\x01" if add_dummy_prefix else b"\x18\x00")
+        + b"\x20\x01"  # remove_extra_whitespaces
+        + b"\x28\x01"  # escape_whitespaces
+    )
+    blob += b"\x1a" + _varint(len(norm)) + norm
+    with open(os.path.join(dirpath, "tokenizer.model"), "wb") as f:
+        f.write(blob)
+
+
+BASE_PIECES = [
+    ("<unk>", 0.0, 2), ("<s>", 0.0, 3), ("</s>", 0.0, 3),
+    ("▁hello", -1.0, 1), ("▁world", -1.2, 1), ("▁", -2.0, 1),
+    ("hello", -3.0, 1), ("he", -4.0, 1), ("llo", -4.5, 1),
+    ("wor", -5.0, 1), ("ld", -5.0, 1), ("lo", -6.0, 1),
+] + [(c, -8.0, 1) for c in "abcdefghijklmnopqrstuvwxyz"]
+
+
+@pytest.fixture()
+def sp_dir(tmp_path):
+    _write_model(str(tmp_path), BASE_PIECES)
+    return str(tmp_path)
+
+
+def _oracle(pieces, text, add_dummy_prefix=True):
+    """Reference Viterbi (max sum of piece scores; UNK penalty
+    min_score - 10 per unknown char), over the escaped text."""
+    table = {
+        p: (i, s) for i, (p, s, t) in enumerate(pieces) if t in (1, 4)
+    }
+    unk = next(i for i, (_, _, t) in enumerate(pieces) if t == 2)
+    min_score = min(s for _, s, _ in pieces)
+    s = text.replace(" ", "▁")
+    if add_dummy_prefix and s:
+        s = "▁" + s
+    n = len(s)
+    best = [-1e30] * (n + 1)
+    back = [None] * (n + 1)
+    best[0] = 0.0
+    for i in range(n):
+        if best[i] <= -1e29:
+            continue
+        for j in range(i + 1, n + 1):
+            sub = s[i:j]
+            if sub in table:
+                pid, sc = table[sub]
+                if best[i] + sc > best[j]:
+                    best[j] = best[i] + sc
+                    back[j] = (i, pid)
+        j = i + 1
+        cand = best[i] + min_score - 10.0
+        if cand > best[j]:
+            best[j] = cand
+            back[j] = (i, unk)
+    ids = []
+    pos = n
+    while pos > 0:
+        i, pid = back[pos]
+        ids.append(pid)
+        pos = i
+    return ids[::-1]
+
+
+def test_viterbi_matches_oracle(sp_dir):
+    tok = try_load(sp_dir)
+    assert isinstance(tok, NativeSPTokenizer)
+    for text in [
+        "hello world", "held", "low", "hello", "woldhello",
+        "a b c", "world world world", "",
+    ]:
+        assert tok.encode(text) == _oracle(BASE_PIECES, text), text
+
+
+def test_roundtrip_and_specials(sp_dir):
+    with open(os.path.join(sp_dir, "tokenizer_config.json"), "w") as f:
+        json.dump({"bos_token": "<s>", "eos_token": "</s>"}, f)
+    tok = try_load(sp_dir)
+    assert tok.decode(tok.encode("hello world")) == "hello world"
+    assert tok.bos_token_id == 1 and tok.eos_token_id == 2
+    assert tok.id_to_token(3) == "▁hello"
+    assert tok.token_to_id("▁world") == 4
+    assert tok.vocab_size == len(BASE_PIECES)
+
+
+def test_unknown_char_falls_to_unk(sp_dir):
+    tok = try_load(sp_dir)
+    ids = tok.encode("Q")
+    assert tok._unk in ids
+
+
+def test_byte_fallback_model(tmp_path):
+    """A model with the full <0xXX> byte alphabet encodes unknown chars
+    as byte pieces, and decode restores the exact text."""
+    pieces = [("<unk>", 0.0, 2), ("▁", -2.0, 1)]
+    pieces += [(c, -6.0, 1) for c in "xyz"]
+    byte_base = len(pieces)
+    pieces += [(f"<0x{b:02X}>", -9.0, 6) for b in range(256)]
+    _write_model(str(tmp_path), pieces)
+    tok = try_load(str(tmp_path))
+    assert tok is not None
+    ids = tok.encode("xQz")  # Q and é have no pieces -> bytes
+    toks = [tok.id_to_token(i) for i in ids]
+    assert "<0x51>" in toks, toks  # 'Q'
+    assert tok.decode(ids) == "xQz"
+    ids2 = tok.encode("é")
+    assert tok.decode(ids2) == "é"  # two UTF-8 bytes restored
+
+
+def test_factory_selects_native_sp(sp_dir):
+    tok = create_tokenizer(sp_dir)
+    assert isinstance(tok, NativeSPTokenizer)
+
+
+def test_charsmap_models_decline(tmp_path):
+    """A model whose normalizer carries a precompiled charsmap (NFKC) is
+    OUT of the native family's scope — try_load must decline so the
+    factory falls back to transformers."""
+    blob = b"".join(_piece(*p) for p in BASE_PIECES)
+    norm = b"\x12" + _varint(4) + b"\x01\x02\x03\x04" + b"\x18\x01"
+    blob += b"\x1a" + _varint(len(norm)) + norm
+    with open(os.path.join(tmp_path, "tokenizer.model"), "wb") as f:
+        f.write(blob)
+    assert try_load(str(tmp_path)) is None
+
+
+def test_special_tokens_split_from_text(sp_dir):
+    """Chat templates inject special tokens as TEXT ('<s>...'); encode
+    must emit their control ids, never Viterbi-segment the surface form
+    (real sentencepiece excludes CONTROL pieces from matching too)."""
+    tok = try_load(sp_dir)
+    ids = tok.encode("<s>hello world</s>")
+    assert ids[0] == 1 and ids[-1] == 2, ids
+    inner = ids[1:-1]
+    assert inner == tok.encode("hello world")
+
+
+def test_embedded_nul_byte(tmp_path):
+    """Explicit-length ABI: a NUL byte mid-text must not truncate (byte
+    fallback encodes it like real sentencepiece)."""
+    pieces = [("<unk>", 0.0, 2), ("▁", -2.0, 1)]
+    pieces += [(c, -6.0, 1) for c in "ab"]
+    pieces += [(f"<0x{b:02X}>", -9.0, 6) for b in range(256)]
+    _write_model(str(tmp_path), pieces)
+    tok = try_load(str(tmp_path))
+    ids = tok.encode("a\x00b")
+    toks = [tok.id_to_token(i) for i in ids]
+    assert "<0x00>" in toks, toks
+    assert tok.decode(ids) == "a\x00b"

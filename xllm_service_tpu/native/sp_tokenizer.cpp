@@ -1,0 +1,435 @@
+// Native SentencePiece-Unigram tokenizer core.
+//
+// The reference ships a native sentencepiece family
+// (xllm_service/tokenizer/sentencepiece_tokenizer.{h,cpp} wrapping the
+// vendored sentencepiece C++ library). This is the rebuild's equivalent,
+// self-contained: a hand-rolled ModelProto wire parser (the .model file
+// is an ordinary protobuf) + Viterbi Unigram segmentation + byte
+// fallback, behind a ctypes C ABI (tokenizer/native_sp.py wraps it).
+//
+// Scope: Unigram models with the standard normalizer options
+// (add_dummy_prefix / escape_whitespaces / remove_extra_whitespaces).
+// Precompiled charsmap normalization (NFKC) is NOT applied — the Python
+// wrapper rejects models whose charsmap is non-empty unless the caller
+// opts in, and the factory falls back to the transformers adapter.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 sp_tokenizer.cpp -o libxllm_sp.so
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- protobuf
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+      uint8_t b = *p++;
+      v |= uint64_t(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+
+  // Returns (field_number, wire_type); field 0 on EOF.
+  std::pair<uint32_t, uint32_t> tag() {
+    if (p >= end) return {0, 0};
+    uint64_t t = varint();
+    return {uint32_t(t >> 3), uint32_t(t & 7)};
+  }
+
+  std::string_view bytes() {
+    uint64_t n = varint();
+    // Compare against the REMAINING size: `p + n` could wrap on a corrupt
+    // near-2^64 varint length and slip past a pointer-sum check.
+    if (!ok || n > uint64_t(end - p)) {
+      ok = false;
+      return {};
+    }
+    std::string_view out(reinterpret_cast<const char*>(p), size_t(n));
+    p += n;
+    return out;
+  }
+
+  float fixed32() {
+    if (p + 4 > end) {
+      ok = false;
+      return 0.f;
+    }
+    float f;
+    std::memcpy(&f, p, 4);
+    p += 4;
+    return f;
+  }
+
+  void skip(uint32_t wire) {
+    switch (wire) {
+      case 0: varint(); break;
+      case 1: p += 8; break;
+      case 2: bytes(); break;
+      case 5: p += 4; break;
+      default: ok = false;
+    }
+    if (p > end) ok = false;
+  }
+};
+
+// SentencePiece piece types (sentencepiece.proto).
+enum PieceType : int {
+  kNormal = 1,
+  kUnknown = 2,
+  kControl = 3,
+  kUserDefined = 4,
+  kUnused = 5,
+  kByte = 6,
+};
+
+constexpr const char kSpace[] = "\xe2\x96\x81";  // U+2581 LOWER ONE EIGHTH BLOCK
+
+struct Model {
+  std::vector<std::string> pieces;
+  std::vector<float> scores;
+  std::vector<int> types;
+  std::unordered_map<std::string, int> piece_to_id;
+  int unk_id = 0;
+  int byte_ids[256];
+  bool has_bytes = false;
+  bool add_dummy_prefix = true;
+  bool remove_extra_ws = true;
+  bool escape_ws = true;
+  bool has_charsmap = false;
+  size_t max_piece_len = 1;
+  float min_score = 0.f;
+};
+
+bool parse_normalizer(std::string_view buf, Model* m) {
+  Reader r{reinterpret_cast<const uint8_t*>(buf.data()),
+           reinterpret_cast<const uint8_t*>(buf.data()) + buf.size()};
+  while (true) {
+    auto [field, wire] = r.tag();
+    if (!field) break;
+    if (field == 2 && wire == 2) {
+      m->has_charsmap = !r.bytes().empty();
+    } else if (field == 3 && wire == 0) {
+      m->add_dummy_prefix = r.varint() != 0;
+    } else if (field == 4 && wire == 0) {
+      m->remove_extra_ws = r.varint() != 0;
+    } else if (field == 5 && wire == 0) {
+      m->escape_ws = r.varint() != 0;
+    } else {
+      r.skip(wire);
+    }
+    if (!r.ok) return false;
+  }
+  return true;
+}
+
+bool parse_piece(std::string_view buf, Model* m) {
+  Reader r{reinterpret_cast<const uint8_t*>(buf.data()),
+           reinterpret_cast<const uint8_t*>(buf.data()) + buf.size()};
+  std::string piece;
+  float score = 0.f;
+  int type = kNormal;
+  while (true) {
+    auto [field, wire] = r.tag();
+    if (!field) break;
+    if (field == 1 && wire == 2) {
+      piece = std::string(r.bytes());
+    } else if (field == 2 && wire == 5) {
+      score = r.fixed32();
+    } else if (field == 3 && wire == 0) {
+      type = int(r.varint());
+    } else {
+      r.skip(wire);
+    }
+    if (!r.ok) return false;
+  }
+  int id = int(m->pieces.size());
+  m->pieces.push_back(piece);
+  m->scores.push_back(score);
+  m->types.push_back(type);
+  if (type == kUnknown) m->unk_id = id;
+  if (type == kByte && piece.size() == 6 && piece[0] == '<' &&
+      piece[1] == '0' && piece[2] == 'x' && piece[5] == '>') {
+    auto hex = [](char c) -> int {
+      if (c >= '0' && c <= '9') return c - '0';
+      if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+      if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+      return -1;
+    };
+    int hi = hex(piece[3]), lo = hex(piece[4]);
+    if (hi >= 0 && lo >= 0) m->byte_ids[hi * 16 + lo] = id;
+  }
+  // Matchable surface forms only (CONTROL/UNUSED never match from text).
+  if (type == kNormal || type == kUserDefined || type == kUnknown) {
+    m->piece_to_id.emplace(piece, id);
+    m->max_piece_len = std::max(m->max_piece_len, piece.size());
+  }
+  return true;
+}
+
+Model* parse_model(const uint8_t* buf, int64_t len) {
+  auto* m = new Model();
+  for (int i = 0; i < 256; i++) m->byte_ids[i] = -1;
+  Reader r{buf, buf + len};
+  while (true) {
+    auto [field, wire] = r.tag();
+    if (!field) break;
+    if (field == 1 && wire == 2) {  // repeated SentencePiece pieces
+      if (!parse_piece(r.bytes(), m)) {
+        delete m;
+        return nullptr;
+      }
+    } else if (field == 3 && wire == 2) {  // NormalizerSpec
+      if (!parse_normalizer(r.bytes(), m)) {
+        delete m;
+        return nullptr;
+      }
+    } else {
+      r.skip(wire);
+    }
+    if (!r.ok || r.p > r.end) {
+      delete m;
+      return nullptr;
+    }
+  }
+  if (m->pieces.empty()) {
+    delete m;
+    return nullptr;
+  }
+  m->has_bytes = true;
+  for (int i = 0; i < 256 && m->has_bytes; i++)
+    if (m->byte_ids[i] < 0) m->has_bytes = false;
+  m->min_score = m->scores[0];
+  for (float s : m->scores) m->min_score = std::min(m->min_score, s);
+  return m;
+}
+
+// ------------------------------------------------------------- normalize
+
+int utf8_len(uint8_t b) {
+  if (b < 0x80) return 1;
+  if ((b & 0xe0) == 0xc0) return 2;
+  if ((b & 0xf0) == 0xe0) return 3;
+  if ((b & 0xf8) == 0xf0) return 4;
+  return 1;  // invalid byte: treat as single
+}
+
+std::string normalize(const Model& m, const char* text, size_t n) {
+  std::string out;
+  out.reserve(n + 8);
+  bool prev_space = true;  // collapses leading spaces when remove_extra_ws
+  if (m.add_dummy_prefix && n) out += m.escape_ws ? kSpace : " ";
+  for (size_t i = 0; i < n; i++) {
+    char c = text[i];
+    if (c == ' ') {
+      if (m.remove_extra_ws && prev_space) continue;
+      out += m.escape_ws ? kSpace : " ";
+      prev_space = true;
+    } else {
+      out += c;
+      prev_space = false;
+    }
+  }
+  if (m.remove_extra_ws) {
+    // strip trailing escaped/plain spaces
+    const std::string sp = m.escape_ws ? kSpace : " ";
+    while (out.size() >= sp.size() &&
+           out.compare(out.size() - sp.size(), sp.size(), sp) == 0)
+      out.resize(out.size() - sp.size());
+  }
+  return out;
+}
+
+// --------------------------------------------------------------- viterbi
+
+constexpr float kUnkPenalty = 10.0f;
+constexpr float kNegInf = -1e30f;
+
+int viterbi(const Model& m, const std::string& s, int32_t* out, int max_out) {
+  const size_t n = s.size();
+  if (!n) return 0;
+  // char-boundary flags
+  std::vector<uint8_t> boundary(n + 1, 0);
+  boundary[0] = 1;
+  for (size_t i = 0; i < n;) {
+    i += utf8_len(uint8_t(s[i]));
+    if (i <= n) boundary[i] = 1;
+  }
+  boundary[n] = 1;
+
+  std::vector<float> best(n + 1, kNegInf);
+  std::vector<int32_t> back_id(n + 1, -1);
+  std::vector<int32_t> back_pos(n + 1, -1);
+  best[0] = 0.f;
+  const float unk_score = m.min_score - kUnkPenalty;
+
+  std::string key;
+  for (size_t i = 0; i < n; i++) {
+    if (!boundary[i] || best[i] <= kNegInf / 2) continue;
+    size_t maxj = std::min(n, i + m.max_piece_len);
+    for (size_t j = i + 1; j <= maxj; j++) {
+      if (!boundary[j]) continue;
+      key.assign(s, i, j - i);
+      auto it = m.piece_to_id.find(key);
+      if (it != m.piece_to_id.end() && m.types[it->second] != kUnknown) {
+        float cand = best[i] + m.scores[it->second];
+        if (cand > best[j]) {
+          best[j] = cand;
+          back_id[j] = it->second;
+          back_pos[j] = int32_t(i);
+        }
+      }
+    }
+    // Unknown single-char fallback (always available so segmentation
+    // never dead-ends): one UNK per char, or byte pieces when the model
+    // has the full byte alphabet.
+    size_t j = i + utf8_len(uint8_t(s[i]));
+    if (j > n) j = n;
+    float cand = best[i] + unk_score;
+    if (cand > best[j]) {
+      best[j] = cand;
+      back_id[j] = -2;  // sentinel: unk/byte expansion of s[i..j)
+      back_pos[j] = int32_t(i);
+    }
+  }
+  if (best[n] <= kNegInf / 2) return -1;
+
+  // Walk back, then reverse.
+  std::vector<int32_t> rev;
+  rev.reserve(n / 2 + 4);
+  for (size_t pos = n; pos > 0;) {
+    int32_t id = back_id[pos];
+    int32_t prev = back_pos[pos];
+    if (id == -2) {
+      if (m.has_bytes) {
+        for (size_t b = pos; b > size_t(prev); b--)
+          rev.push_back(m.byte_ids[uint8_t(s[b - 1])]);
+      } else {
+        rev.push_back(m.unk_id);
+      }
+    } else {
+      rev.push_back(id);
+    }
+    pos = size_t(prev);
+  }
+  int count = int(rev.size());
+  if (count > max_out) return -count;
+  for (int i = 0; i < count; i++) out[i] = rev[count - 1 - i];
+  return count;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* sp_create(const uint8_t* buf, int64_t len) {
+  return parse_model(buf, len);
+}
+
+void sp_destroy(void* h) { delete static_cast<Model*>(h); }
+
+int sp_vocab_size(void* h) {
+  return int(static_cast<Model*>(h)->pieces.size());
+}
+
+int sp_has_charsmap(void* h) {
+  return static_cast<Model*>(h)->has_charsmap ? 1 : 0;
+}
+
+int sp_unk_id(void* h) { return static_cast<Model*>(h)->unk_id; }
+
+// ids written to out; returns count, or -needed when max_out too small,
+// or INT32_MIN on failure. `len` is the explicit byte length (embedded
+// NUL bytes tokenize via byte fallback, same as real sentencepiece).
+int sp_encode(void* h, const char* text, int64_t len, int32_t* out,
+              int max_out) {
+  auto& m = *static_cast<Model*>(h);
+  std::string norm = normalize(m, text, size_t(len));
+  int r = viterbi(m, norm, out, max_out);
+  return r == -1 ? INT32_MIN : r;
+}
+
+// Decoded text written to out (NUL-terminated); returns byte length, or
+// -needed when max_out too small.
+int sp_decode(void* h, const int32_t* ids, int n, char* out, int max_out) {
+  auto& m = *static_cast<Model*>(h);
+  std::string s;
+  for (int i = 0; i < n; i++) {
+    int id = ids[i];
+    if (id < 0 || size_t(id) >= m.pieces.size()) continue;
+    if (m.types[id] == kControl) continue;
+    if (m.types[id] == kByte) {
+      const std::string& p = m.pieces[id];
+      if (p.size() == 6) {
+        auto hex = [](char c) -> int {
+          if (c >= '0' && c <= '9') return c - '0';
+          if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+          if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+          return 0;
+        };
+        s += char(hex(p[3]) * 16 + hex(p[4]));
+      }
+      continue;
+    }
+    s += m.pieces[id];
+  }
+  // un-escape ▁ -> space
+  std::string t;
+  t.reserve(s.size());
+  for (size_t i = 0; i < s.size();) {
+    if (i + 3 <= s.size() && s.compare(i, 3, kSpace) == 0) {
+      t += ' ';
+      i += 3;
+    } else {
+      t += s[i++];
+    }
+  }
+  // drop the dummy-prefix space
+  size_t start = (m.add_dummy_prefix && !t.empty() && t[0] == ' ') ? 1 : 0;
+  int len = int(t.size() - start);
+  if (len + 1 > max_out) return -(len + 1);
+  std::memcpy(out, t.data() + start, size_t(len));
+  out[len] = 0;
+  return len;
+}
+
+int sp_piece_to_id(void* h, const char* piece) {
+  auto& m = *static_cast<Model*>(h);
+  // CONTROL pieces (bos/eos) are looked up here too — scan all.
+  auto it = m.piece_to_id.find(piece);
+  if (it != m.piece_to_id.end()) return it->second;
+  for (size_t i = 0; i < m.pieces.size(); i++)
+    if (m.pieces[i] == piece) return int(i);
+  return -1;
+}
+
+int sp_id_to_piece(void* h, int id, char* out, int max_out) {
+  auto& m = *static_cast<Model*>(h);
+  if (id < 0 || size_t(id) >= m.pieces.size()) return -1;
+  const std::string& p = m.pieces[id];
+  if (int(p.size()) + 1 > max_out) return -(int(p.size()) + 1);
+  std::memcpy(out, p.data(), p.size());
+  out[p.size()] = 0;
+  return int(p.size());
+}
+
+int sp_piece_type(void* h, int id) {
+  auto& m = *static_cast<Model*>(h);
+  if (id < 0 || size_t(id) >= m.pieces.size()) return -1;
+  return m.types[id];
+}
+
+}  // extern "C"

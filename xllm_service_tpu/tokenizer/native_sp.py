@@ -1,0 +1,262 @@
+"""Native SentencePiece-Unigram tokenizer family.
+
+The reference implements a sentencepiece tokenizer natively
+(xllm_service/tokenizer/sentencepiece_tokenizer.{h,cpp} over the vendored
+sentencepiece C++ library, selected by tokenizer_factory.cpp when the
+model dir carries a .model file). This is the rebuild's native family for
+that path: `native/sp_tokenizer.cpp` parses the .model protobuf itself
+(ModelProto wire format) and runs Viterbi Unigram segmentation with byte
+fallback behind a ctypes C ABI; this wrapper handles file discovery,
+special-token config, and the Tokenizer interface.
+
+Scope: Unigram models with the standard normalizer flags. Models whose
+normalizer carries a precompiled charsmap (NFKC etc.) are declined —
+`try_load` returns None and the factory falls back to the transformers
+adapter (correctness over coverage, same policy as native_bpe).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import json
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+from xllm_service_tpu.tokenizer.tokenizer import Tokenizer
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+)
+_SRC = os.path.join(_NATIVE_DIR, "sp_tokenizer.cpp")
+_LIB = os.path.join(_NATIVE_DIR, "libxllm_sp.so")
+
+_build_lock = threading.Lock()
+
+
+@functools.lru_cache(maxsize=1)
+def _load_lib() -> Optional[ctypes.CDLL]:
+    with _build_lock:
+        try:
+            if not os.path.exists(_LIB) or os.path.getmtime(
+                _SRC
+            ) > os.path.getmtime(_LIB):
+                subprocess.run(
+                    [
+                        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                        _SRC, "-o", _LIB,
+                    ],
+                    check=True, capture_output=True,
+                )
+            lib = ctypes.CDLL(_LIB)
+        except Exception:
+            return None
+    lib.sp_create.restype = ctypes.c_void_p
+    lib.sp_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.sp_destroy.argtypes = [ctypes.c_void_p]
+    lib.sp_vocab_size.argtypes = [ctypes.c_void_p]
+    lib.sp_vocab_size.restype = ctypes.c_int
+    lib.sp_has_charsmap.argtypes = [ctypes.c_void_p]
+    lib.sp_has_charsmap.restype = ctypes.c_int
+    lib.sp_unk_id.argtypes = [ctypes.c_void_p]
+    lib.sp_unk_id.restype = ctypes.c_int
+    lib.sp_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+    ]
+    lib.sp_encode.restype = ctypes.c_int
+    lib.sp_decode.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.sp_decode.restype = ctypes.c_int
+    lib.sp_piece_to_id.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.sp_piece_to_id.restype = ctypes.c_int
+    lib.sp_id_to_piece.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int
+    ]
+    lib.sp_id_to_piece.restype = ctypes.c_int
+    lib.sp_piece_type.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.sp_piece_type.restype = ctypes.c_int
+    return lib
+
+
+_MODEL_NAMES = ("tokenizer.model", "spiece.model", "spm.model")
+
+
+class NativeSPTokenizer(Tokenizer):
+    """SentencePiece Unigram over the native core (one instance per model
+    dir; the C handle is owned here and freed on GC)."""
+
+    def __init__(self, path: str, model_file: str):
+        lib = _load_lib()
+        assert lib is not None
+        self._lib = lib
+        with open(model_file, "rb") as f:
+            blob = f.read()
+        self._h = lib.sp_create(blob, len(blob))
+        if not self._h:
+            raise ValueError(f"{model_file}: not a sentencepiece model")
+        self._vocab = lib.sp_vocab_size(self._h)
+        self._unk = lib.sp_unk_id(self._h)
+
+        # Special-token strings + chat template from tokenizer_config.json
+        # (same contract native_bpe reads; CONTROL pieces <s>/</s> are the
+        # usual fallback names).
+        self.bos_token: Optional[str] = None
+        self.eos_token: Optional[str] = None
+        self.chat_template: Optional[str] = None
+        cfg_path = os.path.join(path, "tokenizer_config.json")
+        if os.path.isfile(cfg_path):
+            with open(cfg_path, encoding="utf-8") as f:
+                cfg = json.load(f)
+            self.bos_token = _token_str(cfg.get("bos_token"))
+            self.eos_token = _token_str(cfg.get("eos_token"))
+            ct = cfg.get("chat_template")
+            if isinstance(ct, str):
+                self.chat_template = ct
+        if self.bos_token is None and self.token_to_id("<s>") is not None:
+            self.bos_token = "<s>"
+        if self.eos_token is None and self.token_to_id("</s>") is not None:
+            self.eos_token = "</s>"
+
+        # Special-token surface forms never match inside Viterbi (CONTROL
+        # pieces are excluded from segmentation, exactly like real
+        # sentencepiece) — chat templates INJECT them as text ("<s>",
+        # "<|eot_id|>" ...), so encode() splits on them first and emits
+        # their ids directly (native_bpe's added-token splitting, the HF
+        # added_tokens contract). Sources: every CONTROL/unused piece in
+        # the model + added_tokens_decoder entries in tokenizer_config.
+        specials: dict = {}
+        buf = ctypes.create_string_buffer(512)
+        for i in range(self._vocab):
+            t = lib.sp_piece_type(self._h, i)
+            if t in (3, 5):  # CONTROL / UNUSED
+                n = lib.sp_id_to_piece(self._h, i, buf, 512)
+                if n > 0:
+                    specials[buf.raw[:n].decode("utf-8", "replace")] = i
+        if os.path.isfile(cfg_path):
+            for spec in (cfg.get("added_tokens_decoder") or {}).values():
+                s = _token_str(spec)
+                sid = (
+                    self.token_to_id(s) if isinstance(s, str) else None
+                )
+                if s and sid is not None:
+                    specials[s] = sid
+        self._specials = specials
+        self._special_re = None
+        if specials:
+            import re
+
+            self._special_re = re.compile(
+                "|".join(
+                    re.escape(s)
+                    for s in sorted(specials, key=len, reverse=True)
+                )
+            )
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.sp_destroy(h)
+            self._h = None
+
+    # ------------------------------------------------------------- encode
+    def _encode_plain(self, text: str) -> List[int]:
+        data = text.encode("utf-8")
+        cap = max(16, len(data) * 2)
+        while True:
+            buf = (ctypes.c_int32 * cap)()
+            n = self._lib.sp_encode(self._h, data, len(data), buf, cap)
+            if n == -(2**31):
+                raise ValueError("sentencepiece encode failed")
+            if n < 0:
+                cap = -n
+                continue
+            return list(buf[:n])
+
+    def encode(self, text: str) -> List[int]:
+        if self._special_re is None:
+            return self._encode_plain(text)
+        # Split on special-token surface forms; each plain segment goes
+        # through the native core independently (the dummy prefix applies
+        # per segment — HF's sentencepiece added-token behavior).
+        out: List[int] = []
+        pos = 0
+        for m in self._special_re.finditer(text):
+            if m.start() > pos:
+                out.extend(self._encode_plain(text[pos:m.start()]))
+            out.append(self._specials[m.group(0)])
+            pos = m.end()
+        if pos < len(text):
+            out.extend(self._encode_plain(text[pos:]))
+        return out
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        arr = (ctypes.c_int32 * len(ids))(*[int(i) for i in ids])
+        cap = max(16, len(ids) * 8)
+        while True:
+            out = ctypes.create_string_buffer(cap)
+            n = self._lib.sp_decode(self._h, arr, len(ids), out, cap)
+            if n < 0:
+                cap = -n
+                continue
+            return out.raw[:n].decode("utf-8", errors="replace")
+
+    def id_to_token(self, token_id: int) -> str:
+        out = ctypes.create_string_buffer(256)
+        n = self._lib.sp_id_to_piece(self._h, int(token_id), out, 256)
+        return out.raw[:n].decode("utf-8", errors="replace") if n >= 0 else ""
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        i = self._lib.sp_piece_to_id(self._h, token.encode("utf-8"))
+        return None if i < 0 else i
+
+    @property
+    def vocab_size(self) -> int:
+        return self._vocab
+
+    @property
+    def bos_token_id(self) -> Optional[int]:
+        return self.token_to_id(self.bos_token) if self.bos_token else None
+
+    @property
+    def eos_token_id(self) -> Optional[int]:
+        return self.token_to_id(self.eos_token) if self.eos_token else None
+
+
+def _token_str(v) -> Optional[str]:
+    if isinstance(v, str):
+        return v
+    if isinstance(v, dict):
+        return v.get("content")
+    return None
+
+
+def try_load(path: str) -> Optional[NativeSPTokenizer]:
+    """A NativeSPTokenizer for this model dir, or None when there is no
+    .model file, the native lib can't build, or the model needs charsmap
+    normalization (NFKC) we don't implement — the factory then falls back
+    to the transformers adapter."""
+    lib = _load_lib()
+    if lib is None:
+        return None
+    model_file = next(
+        (
+            os.path.join(path, n)
+            for n in _MODEL_NAMES
+            if os.path.isfile(os.path.join(path, n))
+        ),
+        None,
+    )
+    if model_file is None:
+        return None
+    try:
+        tok = NativeSPTokenizer(path, model_file)
+    except Exception:
+        return None
+    if lib.sp_has_charsmap(tok._h):
+        return None
+    return tok

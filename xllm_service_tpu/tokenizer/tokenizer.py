@@ -4,11 +4,14 @@ The reference ships three native tokenizer families behind one interface —
 a Rust HF-tokenizers FFI crate, sentencepiece, and a tiktoken BPE
 (reference: xllm_service/tokenizer/tokenizer.h:28-46,
 tokenizer_factory.cpp:9-33, fast_tokenizer.cpp, sentencepiece_tokenizer.cpp,
-tiktoken_tokenizer.cpp). On this stack all three arrive through HF
-`transformers.AutoTokenizer` (whose fast path is the same Rust `tokenizers`
-wheel the reference binds by hand), so the factory dispatch by model-dir
-contents collapses into one adapter; a deterministic byte-level tokenizer
-covers tests and benches with no model files on disk.
+tiktoken_tokenizer.cpp). On this stack TWO native families cover the
+dominant formats — the C++ byte-level BPE core (tokenizer/native_bpe.py,
+GPT-2/Llama-3/Qwen style) and the C++ SentencePiece-Unigram core
+(tokenizer/native_sp.py, .model protobuf + Viterbi + byte fallback) —
+with `transformers.AutoTokenizer` (the same Rust `tokenizers` wheel the
+reference binds by hand) as the fallback adapter for everything else; a
+deterministic byte-level tokenizer covers tests and benches with no model
+files on disk.
 """
 
 from __future__ import annotations
@@ -202,9 +205,14 @@ def create_tokenizer(path: str = "") -> Tokenizer:
     if not path or path == "byte":
         return ByteTokenizer()
     if os.path.isdir(path) and os.environ.get("XLLM_NATIVE_TOKENIZER") != "0":
-        from xllm_service_tpu.tokenizer import native_bpe
+        from xllm_service_tpu.tokenizer import native_bpe, native_sp
 
         tok = native_bpe.try_load(path)
         if tok is not None:
             return tok
+        # SentencePiece family (.model protobuf, Unigram + byte fallback)
+        # — the reference's sentencepiece_tokenizer.cpp analog.
+        sp = native_sp.try_load(path)
+        if sp is not None:
+            return sp
     return HFTokenizer(path)
