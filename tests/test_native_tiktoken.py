@@ -1,0 +1,143 @@
+"""Native tiktoken family (native/tiktoken_core.cpp +
+tokenizer/native_tiktoken.py) — the reference's tiktoken_tokenizer.cpp
+analog. Vocab fixtures are hand-built base64 rank files; merge behavior
+is pinned to a pure-Python greedy rank-merge oracle (tiktoken's
+byte_pair_merge semantics: a pair merges iff the concatenation is in the
+vocab, lowest resulting rank first).
+"""
+
+import base64
+import json
+import os
+
+import pytest
+import regex as _regex
+
+from xllm_service_tpu.tokenizer import create_tokenizer
+from xllm_service_tpu.tokenizer.native_tiktoken import (
+    _CL100K_PAT,
+    NativeTiktokenTokenizer,
+    try_load,
+)
+
+
+def _write_vocab(dirpath, entries):
+    with open(os.path.join(dirpath, "test.tiktoken"), "wb") as f:
+        for tok, rank in entries:
+            f.write(base64.b64encode(tok) + b" " + str(rank).encode() + b"\n")
+
+
+def _base_entries():
+    # All 256 bytes first (ranks 0-255), then merged pieces.
+    entries = [(bytes([b]), b) for b in range(256)]
+    merged = [b"he", b"ll", b"llo", b"hello", b" he", b" hello", b"lo",
+              b" w", b" wo", b" wor", b" world", b"or", b"ld"]
+    entries += [(m, 256 + i) for i, m in enumerate(merged)]
+    return entries
+
+
+@pytest.fixture()
+def tk_dir(tmp_path):
+    _write_vocab(str(tmp_path), _base_entries())
+    return str(tmp_path)
+
+
+def _oracle_word(vocab, data: bytes):
+    """tiktoken byte_pair_merge: repeatedly merge the adjacent pair whose
+    concatenation has the LOWEST rank in the vocab."""
+    if data in vocab:
+        return [vocab[data]]
+    parts = [data[i:i + 1] for i in range(len(data))]
+    while len(parts) > 1:
+        best, best_i = None, None
+        for i in range(len(parts) - 1):
+            cand = parts[i] + parts[i + 1]
+            r = vocab.get(cand)
+            if r is not None and (best is None or r < best):
+                best, best_i = r, i
+        if best is None:
+            break
+        parts[best_i:best_i + 2] = [parts[best_i] + parts[best_i + 1]]
+    return [vocab[p] for p in parts]
+
+
+def _oracle(entries, text: str):
+    vocab = dict(entries)
+    pat = _regex.compile(_CL100K_PAT)
+    out = []
+    for m in pat.finditer(text):
+        out.extend(_oracle_word(vocab, m.group(0).encode("utf-8")))
+    return out
+
+
+def test_merge_matches_oracle(tk_dir):
+    tok = try_load(tk_dir)
+    assert isinstance(tok, NativeTiktokenTokenizer)
+    for text in [
+        "hello world", "hello", " hello world", "heo", "worldly",
+        "hell", "o world", "abc 123", "héllo",
+    ]:
+        assert tok.encode(text) == _oracle(_base_entries(), text), text
+
+
+def test_roundtrip_utf8(tk_dir):
+    tok = try_load(tk_dir)
+    for text in ["hello world", "héllo wörld", "🙂 emoji", "a\nb\tc"]:
+        assert tok.decode(tok.encode(text)) == text
+
+
+def test_special_tokens(tk_dir):
+    with open(os.path.join(tk_dir, "tokenizer_config.json"), "w") as f:
+        json.dump(
+            {
+                "added_tokens_decoder": {
+                    "300": {"content": "<|im_start|>"},
+                    "301": {"content": "<|im_end|>"},
+                },
+                "eos_token": "<|im_end|>",
+            },
+            f,
+        )
+    tok = try_load(tk_dir)
+    ids = tok.encode("<|im_start|>hello<|im_end|>")
+    assert ids[0] == 300 and ids[-1] == 301
+    assert ids[1:-1] == tok.encode("hello")
+    assert tok.eos_token_id == 301
+    assert tok.decode(ids) == "hello"  # specials skipped by default
+    assert (
+        tok.decode(ids, skip_special_tokens=False)
+        == "<|im_start|>hello<|im_end|>"
+    )
+    assert tok.vocab_size == 302
+
+
+def test_factory_selects_native_tiktoken(tk_dir):
+    tok = create_tokenizer(tk_dir)
+    assert isinstance(tok, NativeTiktokenTokenizer)
+
+
+def test_id_token_maps(tk_dir):
+    tok = try_load(tk_dir)
+    assert tok.token_to_id("hello") == 256 + 3
+    assert tok.id_to_token(256) == "he"
+    assert tok.token_to_id("zzz-not-here") is None
+
+
+def test_non_special_added_token_survives_decode(tk_dir):
+    """added_tokens_decoder entries with special=false are user-visible
+    text: encode maps them atomically, decode KEEPS them (only
+    special=true strips under skip_special_tokens)."""
+    with open(os.path.join(tk_dir, "tokenizer_config.json"), "w") as f:
+        json.dump(
+            {
+                "added_tokens_decoder": {
+                    "300": {"content": "<tool_call>", "special": False},
+                    "301": {"content": "<|im_end|>", "special": True},
+                },
+            },
+            f,
+        )
+    tok = try_load(tk_dir)
+    ids = tok.encode("<tool_call>hello<|im_end|>")
+    assert ids[0] == 300 and ids[-1] == 301
+    assert tok.decode(ids) == "<tool_call>hello"

@@ -1,0 +1,169 @@
+// Native tiktoken-style BPE merge core.
+//
+// The reference ships a native tiktoken tokenizer
+// (xllm_service/tokenizer/tiktoken_tokenizer.{h,cpp}: base64 vocab file,
+// re2 pre-tokenization, rank-ordered byte-pair merging). This is the
+// rebuild's equivalent core: the merge loop over one pre-tokenized word,
+// where a pair is mergeable iff the concatenated byte string exists in
+// the vocab and pairs merge in ascending RANK order (tiktoken semantics —
+// no merges list; the vocab ranks ARE the merge priorities). The Python
+// wrapper (tokenizer/native_tiktoken.py) parses the base64 vocab file,
+// runs the unicode regex split (the `regex` module speaks \p{L}; the
+// same division of labor as native_bpe), and handles special tokens.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 tiktoken_core.cpp -o libxllm_tk.so
+
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Vocab {
+  std::unordered_map<std::string, int32_t> rank;  // bytes -> id (== rank)
+  std::vector<std::string> pieces;                // id -> bytes
+  size_t max_piece_len = 1;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tk_create() { return new Vocab(); }
+
+void tk_destroy(void* h) { delete static_cast<Vocab*>(h); }
+
+namespace {
+
+int64_t lookup_rank(const Vocab& v, const uint8_t* bytes, size_t a, size_t b) {
+  if (b - a > v.max_piece_len) return std::numeric_limits<int64_t>::max();
+  std::string s(reinterpret_cast<const char*>(bytes) + a, b - a);
+  auto it = v.rank.find(s);
+  return it == v.rank.end() ? std::numeric_limits<int64_t>::max()
+                            : int64_t(it->second);
+}
+
+}  // namespace
+
+// Register one vocab entry (raw bytes + its id/rank). Entries may arrive
+// in any order; ids need not be dense.
+void tk_add(void* h, const uint8_t* bytes, int64_t len, int32_t id) {
+  auto& v = *static_cast<Vocab*>(h);
+  std::string s(reinterpret_cast<const char*>(bytes), size_t(len));
+  if (size_t(id) >= v.pieces.size()) v.pieces.resize(size_t(id) + 1);
+  v.pieces[size_t(id)] = s;
+  v.rank.emplace(std::move(s), id);
+  v.max_piece_len = std::max(v.max_piece_len, size_t(len));
+}
+
+// Encode ONE pre-tokenized word (raw bytes). Returns token count, or
+// -needed if out too small, or INT32_MIN when a single byte is missing
+// from the vocab (malformed vocab — tiktoken vocabs carry all 256).
+int tk_encode_word(void* h, const uint8_t* bytes, int64_t len, int32_t* out,
+                   int max_out) {
+  auto& v = *static_cast<Vocab*>(h);
+  if (len <= 0) return 0;
+  // Whole-word fast path (common for frequent words and special-cased
+  // single-byte words).
+  {
+    std::string whole(reinterpret_cast<const char*>(bytes), size_t(len));
+    auto it = v.rank.find(whole);
+    if (it != v.rank.end()) {
+      if (max_out < 1) return -1;
+      out[0] = it->second;
+      return 1;
+    }
+  }
+  constexpr int64_t kMax = std::numeric_limits<int64_t>::max();
+  // parts[i] = (start offset of symbol i, rank of merging symbols i and
+  // i+1). Only the pairs ADJACENT to a merge change rank, so each merge
+  // recomputes two entries instead of rescanning the word (tiktoken's
+  // byte_pair_merge shape — a 10k-char punctuation run stays O(n^2)
+  // worst in the erase, not O(n^2) hash lookups).
+  struct Part { int32_t start; int64_t rank; };
+  std::vector<Part> parts(size_t(len) + 1);
+  for (int64_t i = 0; i <= len; i++) parts[size_t(i)] = {int32_t(i), kMax};
+  for (size_t i = 0; i + 2 < parts.size(); i++)
+    parts[i].rank = lookup_rank(v, bytes, size_t(parts[i].start),
+                                size_t(parts[i + 2].start));
+
+  while (parts.size() > 2) {
+    int64_t best = kMax;
+    size_t best_i = 0;
+    for (size_t i = 0; i + 1 < parts.size(); i++) {
+      if (parts[i].rank < best) {
+        best = parts[i].rank;
+        best_i = i;
+      }
+    }
+    if (best == kMax) break;
+    parts.erase(parts.begin() + long(best_i) + 1);
+    parts[best_i].rank =
+        best_i + 2 < parts.size()
+            ? lookup_rank(v, bytes, size_t(parts[best_i].start),
+                          size_t(parts[best_i + 2].start))
+            : kMax;
+    if (best_i > 0)
+      parts[best_i - 1].rank =
+          best_i + 1 < parts.size()
+              ? lookup_rank(v, bytes, size_t(parts[best_i - 1].start),
+                            size_t(parts[best_i + 1].start))
+              : kMax;
+  }
+
+  int count = int(parts.size()) - 1;
+  if (count > max_out) return -count;
+  for (int i = 0; i < count; i++) {
+    size_t a = size_t(parts[size_t(i)].start);
+    size_t b = size_t(parts[size_t(i) + 1].start);
+    std::string s(reinterpret_cast<const char*>(bytes) + a, b - a);
+    auto it = v.rank.find(s);
+    if (it == v.rank.end()) return std::numeric_limits<int32_t>::min();
+    out[i] = it->second;
+  }
+  return count;
+}
+
+// Decode ids into the out buffer; returns byte length or -needed.
+int tk_decode(void* h, const int32_t* ids, int n, uint8_t* out, int max_out) {
+  auto& v = *static_cast<Vocab*>(h);
+  size_t total = 0;
+  for (int i = 0; i < n; i++) {
+    int32_t id = ids[i];
+    if (id < 0 || size_t(id) >= v.pieces.size()) continue;
+    total += v.pieces[size_t(id)].size();
+  }
+  if (total > size_t(max_out)) return -int(total);
+  size_t off = 0;
+  for (int i = 0; i < n; i++) {
+    int32_t id = ids[i];
+    if (id < 0 || size_t(id) >= v.pieces.size()) continue;
+    const std::string& p = v.pieces[size_t(id)];
+    std::memcpy(out + off, p.data(), p.size());
+    off += p.size();
+  }
+  return int(off);
+}
+
+// id of an exact byte string, or -1.
+int tk_token_to_id(void* h, const uint8_t* bytes, int64_t len) {
+  auto& v = *static_cast<Vocab*>(h);
+  std::string s(reinterpret_cast<const char*>(bytes), size_t(len));
+  auto it = v.rank.find(s);
+  return it == v.rank.end() ? -1 : it->second;
+}
+
+// bytes of an id; returns length or -needed or -1 for unknown id.
+int tk_id_to_token(void* h, int32_t id, uint8_t* out, int max_out) {
+  auto& v = *static_cast<Vocab*>(h);
+  if (id < 0 || size_t(id) >= v.pieces.size()) return -1;
+  const std::string& p = v.pieces[size_t(id)];
+  if (int(p.size()) > max_out) return -int(p.size());
+  std::memcpy(out, p.data(), p.size());
+  return int(p.size());
+}
+
+}  // extern "C"

@@ -1,0 +1,46 @@
+"""Shared build-and-load for the native tokenizer cores.
+
+All three families (native_bpe / native_sp / native_tiktoken) self-compile
+their C++ core on first use with a staleness check; the pipeline lives
+here ONCE so compiler flags and the stale-.so handling can't drift."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_build_lock = threading.Lock()
+
+
+def build_and_load(src: str, lib_path: str) -> Optional[ctypes.CDLL]:
+    """Compile `src` to `lib_path` when missing/stale and dlopen it;
+    None when the toolchain or load fails (callers fall back to the
+    transformers adapter)."""
+    with _build_lock:
+        try:
+            if not os.path.exists(lib_path) or os.path.getmtime(
+                src
+            ) > os.path.getmtime(lib_path):
+                subprocess.run(
+                    [
+                        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                        src, "-o", lib_path,
+                    ],
+                    check=True, capture_output=True,
+                )
+            return ctypes.CDLL(lib_path)
+        except Exception:
+            return None
+
+
+def named_token_str(v) -> Optional[str]:
+    """tokenizer_config.json token specs are either plain strings or
+    {"content": ...} dicts."""
+    if isinstance(v, str):
+        return v
+    if isinstance(v, dict):
+        return v.get("content")
+    return None

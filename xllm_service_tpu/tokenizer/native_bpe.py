@@ -22,8 +22,6 @@ import ctypes
 import functools
 import json
 import os
-import subprocess
-import threading
 import unicodedata
 from typing import Dict, List, Optional, Sequence
 
@@ -45,26 +43,13 @@ _GPT2_PAT = (
     r"|\s+(?!\S)|\s+"
 )
 
-_build_lock = threading.Lock()
-
-
 @functools.lru_cache(maxsize=1)
 def _load_lib() -> Optional[ctypes.CDLL]:
-    with _build_lock:
-        try:
-            if not os.path.exists(_LIB) or os.path.getmtime(
-                _SRC
-            ) > os.path.getmtime(_LIB):
-                subprocess.run(
-                    [
-                        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                        _SRC, "-o", _LIB,
-                    ],
-                    check=True, capture_output=True,
-                )
-            lib = ctypes.CDLL(_LIB)
-        except Exception:
-            return None
+    from xllm_service_tpu.tokenizer._native_build import build_and_load
+
+    lib = build_and_load(_SRC, _LIB)
+    if lib is None:
+        return None
     P, I, C = ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p
     IP = ctypes.POINTER(ctypes.c_int32)
     lib.xbpe_new.restype = P

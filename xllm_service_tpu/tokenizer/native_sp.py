@@ -21,10 +21,12 @@ import ctypes
 import functools
 import json
 import os
-import subprocess
-import threading
 from typing import List, Optional, Sequence
 
+from xllm_service_tpu.tokenizer._native_build import (
+    build_and_load,
+    named_token_str,
+)
 from xllm_service_tpu.tokenizer.tokenizer import Tokenizer
 
 _NATIVE_DIR = os.path.join(
@@ -33,26 +35,11 @@ _NATIVE_DIR = os.path.join(
 _SRC = os.path.join(_NATIVE_DIR, "sp_tokenizer.cpp")
 _LIB = os.path.join(_NATIVE_DIR, "libxllm_sp.so")
 
-_build_lock = threading.Lock()
-
-
 @functools.lru_cache(maxsize=1)
 def _load_lib() -> Optional[ctypes.CDLL]:
-    with _build_lock:
-        try:
-            if not os.path.exists(_LIB) or os.path.getmtime(
-                _SRC
-            ) > os.path.getmtime(_LIB):
-                subprocess.run(
-                    [
-                        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                        _SRC, "-o", _LIB,
-                    ],
-                    check=True, capture_output=True,
-                )
-            lib = ctypes.CDLL(_LIB)
-        except Exception:
-            return None
+    lib = build_and_load(_SRC, _LIB)
+    if lib is None:
+        return None
     lib.sp_create.restype = ctypes.c_void_p
     lib.sp_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
     lib.sp_destroy.argtypes = [ctypes.c_void_p]
@@ -112,8 +99,8 @@ class NativeSPTokenizer(Tokenizer):
         if os.path.isfile(cfg_path):
             with open(cfg_path, encoding="utf-8") as f:
                 cfg = json.load(f)
-            self.bos_token = _token_str(cfg.get("bos_token"))
-            self.eos_token = _token_str(cfg.get("eos_token"))
+            self.bos_token = named_token_str(cfg.get("bos_token"))
+            self.eos_token = named_token_str(cfg.get("eos_token"))
             ct = cfg.get("chat_template")
             if isinstance(ct, str):
                 self.chat_template = ct
@@ -139,7 +126,7 @@ class NativeSPTokenizer(Tokenizer):
                     specials[buf.raw[:n].decode("utf-8", "replace")] = i
         if os.path.isfile(cfg_path):
             for spec in (cfg.get("added_tokens_decoder") or {}).values():
-                s = _token_str(spec)
+                s = named_token_str(spec)
                 sid = (
                     self.token_to_id(s) if isinstance(s, str) else None
                 )
@@ -225,14 +212,6 @@ class NativeSPTokenizer(Tokenizer):
     @property
     def eos_token_id(self) -> Optional[int]:
         return self.token_to_id(self.eos_token) if self.eos_token else None
-
-
-def _token_str(v) -> Optional[str]:
-    if isinstance(v, str):
-        return v
-    if isinstance(v, dict):
-        return v.get("content")
-    return None
 
 
 def try_load(path: str) -> Optional[NativeSPTokenizer]:

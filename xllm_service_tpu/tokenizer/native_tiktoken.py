@@ -1,0 +1,254 @@
+"""Native tiktoken tokenizer family.
+
+The reference implements a tiktoken tokenizer natively
+(xllm_service/tokenizer/tiktoken_tokenizer.{h,cpp}: base64 "token rank"
+vocab file, re2 pre-tokenization regex, special-token regex, rank-ordered
+byte-pair merging). This is the rebuild's native family for that path:
+`native/tiktoken_core.cpp` owns the merge loop and vocab tables behind a
+ctypes C ABI; this wrapper parses the base64 vocab file, runs the unicode
+regex split (the `regex` module speaks \\p{L} classes), and splits
+special tokens out of the text before merging — same division of labor
+as tokenizer/native_bpe.py.
+
+Model-dir detection: a `*.tiktoken` vocab file (Qwen-style dirs ship
+`qwen.tiktoken`). Special tokens come from tokenizer_config.json's
+added_tokens_decoder / special-token fields; the split pattern defaults
+to the cl100k/Qwen pattern (the dirs don't carry it — same assumption
+the reference's TokenizerArgs encode).
+"""
+
+from __future__ import annotations
+
+import base64
+import ctypes
+import functools
+import glob
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import regex as _regex
+
+from xllm_service_tpu.tokenizer._native_build import (
+    build_and_load,
+    named_token_str,
+)
+from xllm_service_tpu.tokenizer.tokenizer import Tokenizer
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+)
+_SRC = os.path.join(_NATIVE_DIR, "tiktoken_core.cpp")
+_LIB = os.path.join(_NATIVE_DIR, "libxllm_tk.so")
+
+# cl100k_base / Qwen split pattern.
+_CL100K_PAT = (
+    r"(?i:'s|'t|'re|'ve|'m|'ll|'d)|[^\r\n\p{L}\p{N}]?\p{L}+|\p{N}{1,3}"
+    r"| ?[^\s\p{L}\p{N}]+[\r\n]*|\s*[\r\n]+|\s+(?!\S)|\s+"
+)
+
+
+@functools.lru_cache(maxsize=1)
+def _load_lib() -> Optional[ctypes.CDLL]:
+    lib = build_and_load(_SRC, _LIB)
+    if lib is None:
+        return None
+    lib.tk_create.restype = ctypes.c_void_p
+    lib.tk_destroy.argtypes = [ctypes.c_void_p]
+    lib.tk_add.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32
+    ]
+    lib.tk_encode_word.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+    ]
+    lib.tk_encode_word.restype = ctypes.c_int
+    lib.tk_decode.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.tk_decode.restype = ctypes.c_int
+    lib.tk_token_to_id.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64
+    ]
+    lib.tk_token_to_id.restype = ctypes.c_int
+    lib.tk_id_to_token.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_char_p, ctypes.c_int
+    ]
+    lib.tk_id_to_token.restype = ctypes.c_int
+    return lib
+
+
+class NativeTiktokenTokenizer(Tokenizer):
+    def __init__(self, path: str, vocab_file: str):
+        lib = _load_lib()
+        assert lib is not None
+        self._lib = lib
+        self._h = lib.tk_create()
+        max_id = -1
+        with open(vocab_file, "rb") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                tok_b64, _, rank_s = line.partition(b" ")
+                tok = base64.b64decode(tok_b64)
+                rank = int(rank_s)
+                lib.tk_add(self._h, tok, len(tok), rank)
+                max_id = max(max_id, rank)
+
+        self._pat = _regex.compile(_CL100K_PAT)
+        self.bos_token: Optional[str] = None
+        self.eos_token: Optional[str] = None
+        self.chat_template: Optional[str] = None
+        self._specials: Dict[str, int] = {}
+        self._strip_ids: set = set()
+        cfg_path = os.path.join(path, "tokenizer_config.json")
+        if os.path.isfile(cfg_path):
+            with open(cfg_path, encoding="utf-8") as f:
+                cfg = json.load(f)
+            # Special tokens append after the base vocab unless the config
+            # carries explicit ids (added_tokens_decoder keys ARE the ids).
+            for sid, spec in sorted(
+                (cfg.get("added_tokens_decoder") or {}).items(),
+                key=lambda kv: int(kv[0]),
+            ):
+                s = spec.get("content") if isinstance(spec, dict) else spec
+                if isinstance(s, str):
+                    self._specials[s] = int(sid)
+                    max_id = max(max_id, int(sid))
+                    # Only special=True tokens are STRIPPED on decode;
+                    # non-special added tokens (tool markers etc.) are
+                    # user-visible text (native_bpe gates the same way).
+                    if not isinstance(spec, dict) or spec.get(
+                        "special", True
+                    ):
+                        self._strip_ids.add(int(sid))
+            self.bos_token = named_token_str(cfg.get("bos_token"))
+            self.eos_token = named_token_str(cfg.get("eos_token"))
+            ct = cfg.get("chat_template")
+            if isinstance(ct, str):
+                self.chat_template = ct
+        self._vocab = max_id + 1
+        self._special_ids = {v: k for k, v in self._specials.items()}
+        self._special_re = (
+            _regex.compile(
+                "|".join(
+                    _regex.escape(s)
+                    for s in sorted(self._specials, key=len, reverse=True)
+                )
+            )
+            if self._specials
+            else None
+        )
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.tk_destroy(h)
+            self._h = None
+
+    # ------------------------------------------------------------- encode
+    def _encode_word(self, data: bytes) -> List[int]:
+        cap = max(8, len(data))
+        while True:
+            buf = (ctypes.c_int32 * cap)()
+            n = self._lib.tk_encode_word(self._h, data, len(data), buf, cap)
+            if n == -(2**31):
+                raise ValueError("tiktoken vocab is missing a byte entry")
+            if n < 0:
+                cap = -n
+                continue
+            return list(buf[:n])
+
+    def _encode_plain(self, text: str) -> List[int]:
+        out: List[int] = []
+        for m in self._pat.finditer(text):
+            out.extend(self._encode_word(m.group(0).encode("utf-8")))
+        return out
+
+    def encode(self, text: str) -> List[int]:
+        if self._special_re is None:
+            return self._encode_plain(text)
+        out: List[int] = []
+        pos = 0
+        for m in self._special_re.finditer(text):
+            if m.start() > pos:
+                out.extend(self._encode_plain(text[pos:m.start()]))
+            out.append(self._specials[m.group(0)])
+            pos = m.end()
+        if pos < len(text):
+            out.extend(self._encode_plain(text[pos:]))
+        return out
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        # Specials live OUTSIDE the byte vocab: stitch segments.
+        parts: List[bytes] = []
+        seg: List[int] = []
+
+        def flush():
+            if not seg:
+                return
+            arr = (ctypes.c_int32 * len(seg))(*seg)
+            cap = max(16, len(seg) * 8)
+            while True:
+                out = ctypes.create_string_buffer(cap)
+                n = self._lib.tk_decode(self._h, arr, len(seg), out, cap)
+                if n < 0:
+                    cap = -n
+                    continue
+                parts.append(out.raw[:n])
+                break
+            seg.clear()
+
+        for i in ids:
+            s = self._special_ids.get(int(i))
+            if s is not None:
+                flush()
+                if not skip_special_tokens or int(i) not in self._strip_ids:
+                    parts.append(s.encode("utf-8"))
+            else:
+                seg.append(int(i))
+        flush()
+        return b"".join(parts).decode("utf-8", errors="replace")
+
+    def id_to_token(self, token_id: int) -> str:
+        s = self._special_ids.get(int(token_id))
+        if s is not None:
+            return s
+        buf = ctypes.create_string_buffer(512)
+        n = self._lib.tk_id_to_token(self._h, int(token_id), buf, 512)
+        return buf.raw[:n].decode("utf-8", errors="replace") if n >= 0 else ""
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        if token in self._specials:
+            return self._specials[token]
+        data = token.encode("utf-8")
+        i = self._lib.tk_token_to_id(self._h, data, len(data))
+        return None if i < 0 else i
+
+    @property
+    def vocab_size(self) -> int:
+        return self._vocab
+
+    @property
+    def bos_token_id(self) -> Optional[int]:
+        return self.token_to_id(self.bos_token) if self.bos_token else None
+
+    @property
+    def eos_token_id(self) -> Optional[int]:
+        return self.token_to_id(self.eos_token) if self.eos_token else None
+
+
+def try_load(path: str) -> Optional[NativeTiktokenTokenizer]:
+    """A NativeTiktokenTokenizer for this model dir, or None when there is
+    no .tiktoken vocab file or the native lib can't build."""
+    if _load_lib() is None:
+        return None
+    files = sorted(glob.glob(os.path.join(path, "*.tiktoken")))
+    if not files:
+        return None
+    try:
+        return NativeTiktokenTokenizer(path, files[0])
+    except Exception:
+        return None

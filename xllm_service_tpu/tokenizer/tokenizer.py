@@ -205,7 +205,11 @@ def create_tokenizer(path: str = "") -> Tokenizer:
     if not path or path == "byte":
         return ByteTokenizer()
     if os.path.isdir(path) and os.environ.get("XLLM_NATIVE_TOKENIZER") != "0":
-        from xllm_service_tpu.tokenizer import native_bpe, native_sp
+        from xllm_service_tpu.tokenizer import (
+            native_bpe,
+            native_sp,
+            native_tiktoken,
+        )
 
         tok = native_bpe.try_load(path)
         if tok is not None:
@@ -215,4 +219,9 @@ def create_tokenizer(path: str = "") -> Tokenizer:
         sp = native_sp.try_load(path)
         if sp is not None:
             return sp
+        # Tiktoken family (*.tiktoken base64 vocab, rank merges) — the
+        # reference's tiktoken_tokenizer.cpp analog.
+        tk = native_tiktoken.try_load(path)
+        if tk is not None:
+            return tk
     return HFTokenizer(path)
