@@ -249,7 +249,9 @@ def test_local_transfer_matches_colocated(local_transfer_stack, colocated):
 
     decode._admit_import = spy
     http_posts = []
-    import xllm_service_tpu.api.instance as inst_mod
+    # The HTTP data-plane POST lives in the KV-handoff mixin module
+    # since the round-3 instance split.
+    import xllm_service_tpu.api.instance_kv as inst_mod
 
     orig_post = inst_mod.post_bytes
 

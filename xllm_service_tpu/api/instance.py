@@ -16,85 +16,42 @@ Serves two modes on the same endpoints:
 
 from __future__ import annotations
 
-import dataclasses
 import logging
 import os
 import queue
 import threading
 import time
 
-import numpy as np
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional
 
 from xllm_service_tpu.api.client import HeartbeatLoop, MasterClient
-from xllm_service_tpu.api.http_utils import (
-    HttpServerThread,
-    QuietHandler,
-    SseWriter,
-    post_bytes,
-    post_json,
-)
-from xllm_service_tpu.api.protocol import handoff_from_bytes, handoff_to_bytes
+from xllm_service_tpu.api.http_utils import HttpServerThread, QuietHandler
+from xllm_service_tpu.api.protocol import sampling_from_body  # noqa: F401 — re-export
 from xllm_service_tpu.common.config import EngineConfig
-from xllm_service_tpu.common.shortuuid import generate_uuid
 from xllm_service_tpu.common.types import (
     InstanceMetaInfo,
     InstanceType,
     RequestOutput,
-    StatusCode,
 )
-from xllm_service_tpu.api.protocol import parse_prompt_field
-from xllm_service_tpu.ops.sampling import SamplingParams
-from xllm_service_tpu.service.response_handler import (
-    ResponseHandler,
-    accumulate_sequences,
-)
-from xllm_service_tpu.service.request import ServiceRequest
-from xllm_service_tpu.tokenizer import ChatTemplate, create_tokenizer, parse_messages
+from xllm_service_tpu.service.response_handler import ResponseHandler
+from xllm_service_tpu.tokenizer import ChatTemplate, create_tokenizer
 from xllm_service_tpu.tokenizer.tokenizer import IncrementalDetokenizer
 
 logger = logging.getLogger(__name__)
 
 
-def sampling_from_body(body: Dict[str, Any], cfg: EngineConfig) -> SamplingParams:
-    max_tokens = int(
-        body.get("max_tokens") or body.get("max_completion_tokens") or 0
-    )
-    lp = body.get("logprobs")
-    top_lp = int(body.get("top_logprobs", 0) or 0)
-    raw_seed = body.get("seed")
-    # OpenAI semantics: unseeded sampling varies per call. Only an explicit
-    # client seed (any value, 0 included) gives the deterministic stream;
-    # otherwise draw a fresh per-request seed.
-    seed = (
-        int(raw_seed)
-        if raw_seed is not None
-        else int.from_bytes(os.urandom(4), "little")
-    )
-    return SamplingParams(
-        temperature=float(body.get("temperature", 1.0)),
-        top_p=float(body.get("top_p", 1.0)),
-        top_k=int(body.get("top_k", 0) or 0),
-        seed=seed,
-        logprobs=bool(lp),
-        top_logprobs=top_lp if top_lp else (int(lp) if isinstance(lp, int) else 0),
-        max_new_tokens=max_tokens or cfg.max_new_tokens_default,
-        ignore_eos=bool(body.get("ignore_eos", False)),
-        presence_penalty=float(body.get("presence_penalty", 0.0) or 0.0),
-        frequency_penalty=float(body.get("frequency_penalty", 0.0) or 0.0),
-    )
+# Process-local instance registry (api/instance_registry.py): colocated PD
+# peers hand KV off through direct calls; re-exported here for tests.
+from xllm_service_tpu.api.instance_registry import (  # noqa: E402
+    _LOCAL_INSTANCES,
+    _LOCAL_MU,
+)
+from xllm_service_tpu.api.instance_kv import KVHandoffMixin  # noqa: E402
+from xllm_service_tpu.api.instance_mm import MultimodalMixin  # noqa: E402
+from xllm_service_tpu.api.instance_serving import ServingMixin  # noqa: E402
 
 
-# Process-local instance registry: colocated PD peers hand KV off through
-# direct calls. The KV payload stays a DEVICE array end-to-end on this path
-# (engine._handoff exports to a device buffer; the peer's import pads and
-# scatters device-side) — the single-host analog of the ICI device_put
-# path. Only the HTTP/DCN route copies to host, at serialization time.
-_LOCAL_INSTANCES: Dict[str, "InstanceServer"] = {}
-_LOCAL_MU = threading.Lock()
-
-
-class InstanceServer:
+class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
     def __init__(
         self,
         engine_cfg: EngineConfig,
@@ -271,16 +228,6 @@ class InstanceServer:
             t.join(timeout=5.0)
         self.http.stop()
         self.engine.stop()
-
-    def _transfer_loop(self) -> None:
-        while True:
-            job = self._transfer_q.get()
-            if job is None:
-                return
-            try:
-                job()
-            except Exception:
-                logger.exception("KV transfer job failed")
 
     @property
     def address(self) -> str:
@@ -500,1049 +447,6 @@ class InstanceServer:
             h.send_json({"ok": True, "cancelled": bool(rids)})
         else:
             h.send_error_json(404, f"no route {route}")
-
-    # ------------------------------------------------------------------ #
-    # PD disaggregation
-    # ------------------------------------------------------------------ #
-
-    def _make_push_callback(
-        self,
-        srid: str,
-        detoks: Optional[Dict[int, IncrementalDetokenizer]] = None,
-    ):
-        if detoks is None:
-            detoks = {}
-
-        def callback(out: RequestOutput) -> bool:
-            out.service_request_id = srid
-            self._detokenize(out, detoks)
-            if out.finished:
-                with self._srid_mu:
-                    self._srid_map.pop(srid, None)
-                # A prefill_only request that finishes on its first token
-                # (EOS / max_tokens=1 / reject / cancel) never runs its
-                # handoff — reap the ack event here or it leaks forever.
-                with self._push_acked_mu:
-                    self._push_acked.pop(srid, None)
-            self._push_q.put(out)
-            return True
-
-        return callback
-
-    def _resolve_instance_addr(self, name: str) -> str:
-        addr = self._peer_addrs.get(name)
-        if addr:
-            return addr
-        meta = self._master.instance_info(name) if self._master else None
-        if meta is None:
-            return ""
-        self._peer_addrs[name] = meta.http_address
-        return meta.http_address
-
-    def _make_handoff_sender(
-        self,
-        srid: str,
-        decode_name: str,
-        body: Dict,
-        detoks: Optional[Dict[int, IncrementalDetokenizer]] = None,
-        seed: Optional[int] = None,
-        respond_via_self: bool = False,
-    ):
-        from xllm_service_tpu.common.types import Status, StatusCode
-
-        sampling_fields = {
-            k: body[k]
-            for k in (
-                "max_tokens", "max_completion_tokens", "temperature",
-                "top_p", "top_k", "seed", "logprobs", "top_logprobs",
-                "ignore_eos", "presence_penalty", "frequency_penalty",
-            )
-            if k in body
-        }
-        if seed is not None:
-            # Forward the RESOLVED seed (possibly drawn at random for an
-            # unseeded request) so the decode peer continues the same
-            # RNG stream instead of drawing its own.
-            sampling_fields["seed"] = seed
-
-        def transfer(handoff) -> None:
-            # Runs on the transfer thread (never the engine thread): waits
-            # for the master to ack the first-token push, then POSTs the KV
-            # payload to the decode peer. The engine already released the
-            # sequence's slot and blocks before enqueueing this job, so a
-            # slow master/peer delays only this handoff, not the engine.
-            #
-            # TOCTOU guard: send() kept the KV device-resident because a
-            # local peer existed at enqueue time; if that peer deregistered
-            # since, copy to host NOW — before the ack wait below — so a
-            # device export never sits pinned in HBM through it. With the
-            # pull plane enabled, device-residency through the ack wait is
-            # the point (the peer pulls from device memory), so the copy
-            # is skipped.
-            if (
-                handoff.kv is not None
-                and not isinstance(handoff.kv, np.ndarray)
-                and self._local_peer(decode_name) is None
-                and self._kv_transfer is None
-            ):
-                handoff = dataclasses.replace(
-                    handoff, kv=np.asarray(handoff.kv)
-                )
-            with self._push_acked_mu:
-                acked = self._push_acked.get(srid)
-            err = ""
-            # Cross-instance ordering: the first token must be acked by the
-            # master before the decode peer can start pushing, or a client
-            # could see token 2 before token 1. The event stays in the dict
-            # until AFTER the wait — popping first would race the ack.
-            if acked is not None and not acked.wait(60.0):
-                err = "first-token push never acked by master"
-            with self._push_acked_mu:
-                self._push_acked.pop(srid, None)
-            if not err:
-                extra = {
-                    "service_request_id": srid,
-                    "sampling": sampling_fields,
-                }
-                if respond_via_self:
-                    # Alternate topology: decode relays its generations
-                    # back through this (prefill) instance.
-                    extra["respond_addr"] = self.address
-                # Detokenizer carry-over: the decode peer continues from
-                # this side's exact byte/char position.
-                d0 = (detoks or {}).get(0)
-                if d0 is not None:
-                    ids, emitted = d0.export_state()
-                    extra["detok_ids"] = ids
-                    extra["detok_emitted"] = emitted
-                peer = self._local_peer(decode_name)
-                if peer is not None:
-                    # Colocated peer: direct in-process import, no
-                    # serialization (ICI-path analog).
-                    try:
-                        peer._admit_import(handoff, extra)
-                    except Exception as e:
-                        err = f"local decode peer import failed: {e}"
-                else:
-                    addr = self._resolve_instance_addr(decode_name)
-                    if not addr:
-                        err = f"decode instance {decode_name} unknown"
-                    else:
-                        err = self._post_handoff(addr, handoff, extra)
-            if not err:
-                # Handoff complete: this instance is done with the request
-                # (the decode peer owns cancellation from here).
-                with self._srid_mu:
-                    self._srid_map.pop(srid, None)
-            if err:
-                logger.error("handoff for %s failed: %s", srid, err)
-                out = RequestOutput(
-                    request_id=handoff.request_id,
-                    service_request_id=srid,
-                    status=Status(StatusCode.UNAVAILABLE, err),
-                    finished=True,
-                )
-                with self._srid_mu:
-                    self._srid_map.pop(srid, None)
-                self._push_q.put(out)
-
-        def send(handoff) -> None:
-            # Engine-thread side. The KV export arrives as a DEVICE array;
-            # it may only stay device-resident if a colocated peer will
-            # take it directly (in-process import) or the pull plane will
-            # serve it (the decode peer pulls from device memory) — on the
-            # bytes path it would otherwise sit pinned in HBM through the
-            # queue + up-to-60s ack wait while the engine has already
-            # freed and re-budgeted those blocks (round-2 review finding).
-            # Copy to host here for the bytes path; a peer that
-            # (de)registers between enqueue and transfer still works —
-            # both import paths accept either array kind.
-            if (
-                handoff.kv is not None
-                and self._local_peer(decode_name) is None
-                and self._kv_transfer is None
-            ):
-                handoff = dataclasses.replace(
-                    handoff, kv=np.asarray(handoff.kv)
-                )
-            self._transfer_q.put(lambda: transfer(handoff))
-
-        return send
-
-    def _post_handoff(self, addr: str, handoff, extra: Dict[str, Any]) -> str:
-        """POST one handoff to a cross-process decode peer; returns "" on
-        success, an error string otherwise.
-
-        With the pull plane up and a device-resident payload, the KV is
-        OFFERED on this process's transfer server and the POST carries
-        only {addr, uuid, shape, dtype}; the peer pulls device-to-device
-        before acking (runtime/transfer.py). A peer that rejects the pull
-        header (no transfer server / pull failure) gets ONE retry on the
-        bytes plane. Host (np) payloads always ride the bytes plane."""
-        use_pull = (
-            self._kv_transfer is not None
-            and handoff.kv is not None
-            and not isinstance(handoff.kv, np.ndarray)
-            and addr not in self._peer_no_pull
-        )
-        if use_pull:
-            kv_dev = handoff.kv
-            uuid = self._kv_transfer.offer([kv_dev])
-            header = dict(extra)
-            header["kv_pull"] = {
-                "addr": self._kv_transfer.address,
-                "uuid": uuid,
-                "shape": [int(s) for s in kv_dev.shape],
-                "dtype": str(kv_dev.dtype),
-            }
-            try:
-                payload = handoff_to_bytes(
-                    dataclasses.replace(handoff, kv=None), header
-                )
-                code, resp = post_bytes(addr, "/kv/import", payload)
-            except Exception as e:
-                # The peer may STILL be pulling (e.g. our request timed
-                # out while its pull was in flight) — an immediate
-                # retract could free the buffer under it.
-                self._kv_transfer.retract_later(uuid)
-                return f"decode peer unreachable: {e}"
-            # A response means the peer finished (or never started) its
-            # pull — the offer's keepalive can drop now.
-            self._kv_transfer.retract(uuid)
-            if code == 200:
-                return ""
-            logger.warning(
-                "pull-plane handoff rejected by %s (%s); using the bytes "
-                "plane for this peer from now on", addr, resp,
-            )
-            # Capability cache: a peer without a transfer server rejects
-            # EVERY pull header — don't pay the failing round trip per
-            # handoff forever.
-            self._peer_no_pull.add(addr)
-            handoff = dataclasses.replace(handoff, kv=np.asarray(kv_dev))
-        try:
-            payload = handoff_to_bytes(handoff, extra)
-            code, resp = post_bytes(addr, "/kv/import", payload)
-            if code != 200:
-                return f"decode peer rejected handoff: {resp}"
-        except Exception as e:
-            return f"decode peer unreachable: {e}"
-        return ""
-
-    def _local_peer(self, decode_name: str) -> Optional["InstanceServer"]:
-        """The colocated in-process peer eligible for direct (device-
-        resident) KV handoff, or None. BOTH sides must opt in, and both
-        must belong to the same master (name collisions across stacks in
-        one process must not cross-deliver KV)."""
-        if not self.cfg.enable_local_kv_transfer:
-            return None
-        with _LOCAL_MU:
-            peer = _LOCAL_INSTANCES.get(decode_name)
-        if peer is None or peer is self:
-            return None
-        if not peer.cfg.enable_local_kv_transfer or getattr(
-            peer._master, "_addr", None
-        ) != getattr(self._master, "_addr", ""):
-            return None
-        return peer
-
-    def _handle_embeddings(self, h: QuietHandler, body: Dict[str, Any]) -> None:
-        """Engine-side /v1/embeddings: token id lists in (the service
-        tokenizes, same injection contract as generation forwarding),
-        mean-pooled normalized hidden-state vectors out. The reference
-        rejects this endpoint (service.cpp:441-442) — implementing it
-        exceeds parity."""
-        token_lists = body.get("token_ids")
-        if not isinstance(token_lists, list) or not token_lists or not all(
-            isinstance(t, list) and t for t in token_lists
-        ):
-            h.send_error_json(
-                400,
-                "token_ids (non-empty list of non-empty id lists) required "
-                "— raw text inputs are tokenized by the master service",
-            )
-            return
-        limit = self.cfg.max_seq_len
-        too_long = max(len(t) for t in token_lists)
-        if too_long > limit:
-            h.send_error_json(
-                400,
-                f"input of {too_long} tokens exceeds max_seq_len {limit}",
-            )
-            return
-        try:
-            vecs = self.engine.executor.embed_tokens(token_lists)
-        except Exception as e:
-            h.send_error_json(500, f"embedding failed: {e}")
-            return
-        n_tok = sum(len(t) for t in token_lists)
-        h.send_json(
-            {
-                "object": "list",
-                "model": body.get("model") or self.cfg.model,
-                "data": [
-                    {
-                        "object": "embedding",
-                        "index": i,
-                        "embedding": [float(x) for x in vecs[i]],
-                    }
-                    for i in range(len(token_lists))
-                ],
-                "usage": {"prompt_tokens": n_tok, "total_tokens": n_tok},
-            }
-        )
-
-    def _handle_kv_import(self, h: QuietHandler) -> None:
-        try:
-            n = int(h.headers.get("Content-Length", 0))
-            data = h.rfile.read(n)
-            handoff, header = handoff_from_bytes(data)
-        except Exception as e:
-            h.send_error_json(400, f"bad handoff payload: {e}")
-            return
-        if "kv_pull" in header:
-            # Pull plane: the body carried no KV bytes — pull the payload
-            # straight from the prefill peer's device memory into ours,
-            # BEFORE acking (so the sender's offer lifetime is bounded by
-            # this round-trip and pull failures surface in its response).
-            if self._kv_transfer is None:
-                h.send_error_json(
-                    400, "kv_pull offered but this instance has no "
-                    "transfer server (enable_kv_transfer_server)",
-                )
-                return
-            p = header["kv_pull"]
-            try:
-                try:
-                    dt = np.dtype(p["dtype"])
-                except TypeError:
-                    import ml_dtypes
-
-                    dt = np.dtype(getattr(ml_dtypes, p["dtype"]))
-                kv = self._kv_transfer.pull_single(
-                    p["addr"], int(p["uuid"]), p["shape"], dt
-                )
-            except Exception as e:
-                h.send_error_json(400, f"kv pull failed: {e}")
-                return
-            handoff = dataclasses.replace(handoff, kv=kv)
-        rid = self._admit_import(handoff, header)
-        h.send_json({"ok": True, "request_id": rid})
-
-    def _admit_import(self, handoff, header: Dict[str, Any]) -> str:
-        """Decode-side admission of a handed-off sequence — shared by the
-        HTTP /kv/import route and the in-process direct path (colocated
-        peers skip serialization entirely; the single-host analog of the
-        ICI device-to-device KV transfer)."""
-        from xllm_service_tpu.runtime.engine import EngineRequest
-
-        srid = header.get("service_request_id", "")
-        sampling = sampling_from_body(header.get("sampling", {}), self.cfg)
-        rid = generate_uuid(16)
-        with self._srid_mu:
-            self._srid_map.setdefault(srid, []).append(rid)
-        relay_addr = header.get("respond_addr", "")
-        if relay_addr:
-            self._relay_addrs[srid] = relay_addr
-        detoks: Dict[int, IncrementalDetokenizer] = {}
-        if "detok_ids" in header:
-            detoks[0] = IncrementalDetokenizer.from_state(
-                self.tokenizer, header["detok_ids"],
-                header.get("detok_emitted", 0),
-            )
-        self.engine.import_sequence(
-            EngineRequest(
-                request_id=rid,
-                prompt_token_ids=handoff.token_ids[:-1],
-                sampling=sampling,
-                callback=self._make_push_callback(srid, detoks),
-            ),
-            handoff,
-        )
-        return rid
-
-    # ------------------------------------------------------------------ #
-    # EPD multimodal (encoder stage + embedding import)
-    # ------------------------------------------------------------------ #
-
-    def _handle_encode(self, h: QuietHandler, body: Dict[str, Any]) -> None:
-        """ENCODE-instance entry: media parts in, embeddings pushed to the
-        prefill peer's /mm/import, ack out (three-stage EPD routing)."""
-        import base64
-
-        import numpy as np
-
-        if not hasattr(self.engine, "encode"):
-            h.send_error_json(501, "this instance has no encoder engine")
-            return
-        srid = body.get("service_request_id", "")
-        parts = body.get("parts") or []
-        positions = body.get("positions") or []
-        target = body.get("target", "")
-        if not parts or not target:
-            h.send_error_json(400, "parts and target are required")
-            return
-        vcfg = self.engine.executor.cfg
-        images = []
-        for p in parts:
-            shape = p.get("shape") or []
-            if (
-                len(shape) != 3
-                or shape[0] != vcfg.image_size
-                or shape[1] != vcfg.image_size
-                or shape[2] != 3
-            ):
-                h.send_error_json(
-                    400,
-                    f"media shape {shape} != encoder input "
-                    f"[{vcfg.image_size}, {vcfg.image_size}, 3]",
-                )
-                return
-            try:
-                arr = np.frombuffer(
-                    base64.b64decode(p["data"]), np.float32
-                ).reshape(shape)
-            except Exception as e:
-                h.send_error_json(400, f"bad media payload: {e}")
-                return
-            images.append(arr)
-        embeds = self.engine.encode(np.stack(images))  # [B, T, D]
-        flat = np.ascontiguousarray(embeds.reshape(-1, embeds.shape[-1]))
-        if positions and len(positions) != flat.shape[0]:
-            h.send_error_json(
-                400,
-                f"{len(positions)} placeholder positions but the encoder "
-                f"produced {flat.shape[0]} media tokens "
-                f"({embeds.shape[1]} per part — set mm_tokens_per_media)",
-            )
-            return
-        try:
-            code, resp = post_json(
-                target,
-                "/mm/import",
-                {
-                    "service_request_id": srid,
-                    "embeds": base64.b64encode(flat.tobytes()).decode(),
-                    "count": int(flat.shape[0]),
-                    "dim": int(flat.shape[1]),
-                    "positions": list(positions),
-                },
-                timeout=30.0,
-            )
-        except Exception as e:
-            h.send_error_json(502, f"prefill peer unreachable: {e}")
-            return
-        if code != 200:
-            h.send_error_json(502, f"prefill peer rejected embeddings: {resp}")
-            return
-        h.send_json({"ok": True, "media_tokens": int(flat.shape[0])})
-
-    _MM_IMPORT_TTL_S = 120.0
-
-    def _handle_mm_import(self, h: QuietHandler, body: Dict[str, Any]) -> None:
-        import base64
-
-        import numpy as np
-
-        srid = body.get("service_request_id", "")
-        try:
-            count = int(body["count"])
-            dim = int(body["dim"])
-            embeds = np.frombuffer(
-                base64.b64decode(body["embeds"]), np.float32
-            ).reshape(count, dim)
-            positions = [int(p) for p in body.get("positions", [])]
-        except Exception as e:
-            h.send_error_json(400, f"bad embeddings payload: {e}")
-            return
-        now = time.monotonic()
-        with self._mm_mu:
-            # Reap orphans (a push landing after its waiter timed out, or a
-            # master that died between /encode and the forward): without a
-            # TTL every such request pins its embedding array forever.
-            stale = [
-                s for s, (_, _, ts) in self._mm_imports.items()
-                if now - ts > self._MM_IMPORT_TTL_S
-            ]
-            for s in stale:
-                self._mm_imports.pop(s, None)
-                self._mm_events.pop(s, None)
-            self._mm_imports[srid] = (embeds, positions, now)
-            ev = self._mm_events.setdefault(srid, threading.Event())
-        ev.set()
-        h.send_json({"ok": True})
-
-    def _pop_mm_import(self, srid: str, timeout: float):
-        with self._mm_mu:
-            ev = self._mm_events.setdefault(srid, threading.Event())
-        if not ev.wait(timeout):
-            with self._mm_mu:
-                self._mm_events.pop(srid, None)
-            return None
-        with self._mm_mu:
-            self._mm_events.pop(srid, None)
-            entry = self._mm_imports.pop(srid, None)
-            return entry[:2] if entry is not None else None
-
-    # ------------------------------------------------------------------ #
-    # n>1 / best_of fan-out
-    # ------------------------------------------------------------------ #
-
-    def _serve_fanout_forwarded(
-        self,
-        srid: str,
-        token_ids: List[int],
-        sampling: SamplingParams,
-        n: int,
-        best_of: int,
-    ) -> None:
-        """Run n (or best_of) sequences as independent engine requests and
-        push INDEXED deltas under one service_request_id. The prompt's KV
-        blocks are shared through the prefix cache. best_of buffers all
-        children and pushes only the top-n (by mean logprob) at the end."""
-        from xllm_service_tpu.common.types import Usage
-        from xllm_service_tpu.runtime.engine import EngineRequest
-
-        total = best_of or n
-        detoks: Dict[int, IncrementalDetokenizer] = {}
-        agg_mu = threading.Lock()
-        state = {
-            "remaining": total,
-            "generated": [0] * total,
-            "logprob_sum": [0.0] * total,
-            "buffered": {} if best_of else None,  # index -> merged SequenceOutput
-            "aborted": False,
-        }
-        want_logprobs = sampling.logprobs
-
-        def make_cb(i: int):
-            def cb(out: RequestOutput) -> bool:
-                out.service_request_id = srid
-                for s in out.outputs:
-                    s.index = i
-                    for lp in s.logprobs:
-                        state["logprob_sum"][i] += lp.data.logprob
-                with agg_mu:
-                    if state["aborted"]:
-                        return False
-                    if out.usage:
-                        state["generated"][i] = out.usage.num_generated_tokens
-                    last = False
-                    if out.finished:
-                        state["remaining"] -= 1
-                        last = state["remaining"] == 0
-                if not out.status.ok() and not out.cancelled:
-                    # Child error (reject/engine failure): surface it ONCE,
-                    # cancel the siblings, drop the request.
-                    with agg_mu:
-                        state["aborted"] = True
-                    with self._srid_mu:
-                        others = self._srid_map.pop(srid, None) or []
-                    for other in others:
-                        self.engine.cancel(other)
-                    out.finished = True
-                    self._push_q.put(out)
-                    return False
-                if state["buffered"] is not None:
-                    # best_of: hold everything until all children finish.
-                    with agg_mu:
-                        accumulate_sequences(state["buffered"], out)
-                    if last:
-                        self._finish_best_of(
-                            srid, state, token_ids, n, want_logprobs, detoks
-                        )
-                    return True
-                # n>1 streaming/accumulating path: push indexed deltas; only
-                # the LAST child's finish carries finished + merged usage
-                # (per-seq finish_reason still reaches the client).
-                self._detokenize(out, detoks)
-                if out.finished and not last:
-                    out.finished = False
-                    out.usage = None
-                elif out.finished and last:
-                    out.usage = Usage(
-                        num_prompt_tokens=len(token_ids),
-                        num_generated_tokens=sum(state["generated"]),
-                    )
-                    with self._srid_mu:
-                        self._srid_map.pop(srid, None)
-                self._push_q.put(out)
-                return True
-
-            return cb
-
-        # Register the rids BEFORE submitting: a fast-finishing child pops
-        # the srid entry, and a late registration would resurrect it (leak)
-        # or let a /cancel in the window find nothing to cancel.
-        rids = [generate_uuid(16) for _ in range(total)]
-        with self._srid_mu:
-            self._srid_map.setdefault(srid, []).extend(rids)
-        for i, rid in enumerate(rids):
-            self.engine.add_request(
-                EngineRequest(
-                    request_id=rid,
-                    prompt_token_ids=list(token_ids),
-                    sampling=self._child_sampling(
-                        sampling, i, need_logprobs=bool(best_of)
-                    ),
-                    callback=make_cb(i),
-                )
-            )
-
-    def _finish_best_of(
-        self,
-        srid: str,
-        state: Dict[str, Any],
-        token_ids: List[int],
-        n: int,
-        want_logprobs: bool,
-        detoks: Dict[int, IncrementalDetokenizer],
-    ) -> None:
-        """All best_of children done: rank by mean logprob, re-index the
-        top n as choices 0..n-1, push ONE final output."""
-        from xllm_service_tpu.common.types import Usage
-
-        merged = state["buffered"]
-        order = sorted(
-            merged,
-            key=lambda i: (
-                state["logprob_sum"][i] / max(len(merged[i].token_ids), 1)
-            ),
-            reverse=True,
-        )
-        winners = []
-        for new_idx, old_idx in enumerate(order[:n]):
-            s = merged[old_idx]
-            s.index = new_idx
-            if not want_logprobs:
-                s.logprobs = []
-            winners.append(s)
-        final = RequestOutput(
-            request_id=srid,
-            service_request_id=srid,
-            outputs=winners,
-            usage=Usage(
-                num_prompt_tokens=len(token_ids),
-                num_generated_tokens=sum(state["generated"]),
-            ),
-            finished=True,
-        )
-        self._detokenize(final, detoks)
-        with self._srid_mu:
-            self._srid_map.pop(srid, None)
-        self._push_q.put(final)
-
-    # ------------------------------------------------------------------ #
-    def _prompt_tokens(self, body: Dict[str, Any], chat: bool) -> List[int]:
-        # Forwarded traffic arrives pre-tokenized (the injection contract,
-        # service.cpp:334-341) — never re-tokenize.
-        if body.get("token_ids"):
-            return [int(t) for t in body["token_ids"]]
-        if chat:
-            prompt = self.chat_template.apply(
-                parse_messages(body.get("messages", [])), body.get("tools")
-            )
-        else:
-            prompt, token_ids, err = parse_prompt_field(body.get("prompt", ""))
-            if err:
-                raise ValueError(err)
-            if token_ids:
-                return token_ids
-        return self.tokenizer.encode(prompt)
-
-    @staticmethod
-    def _n_sequences(body: Dict[str, Any], chat: bool) -> Tuple[int, int, str]:
-        """Parse (n, best_of, error). best_of is the completions-only
-        over-generation count (>= n, select top-n by logprob); chat has no
-        best_of. Errors mirror OpenAI validation."""
-        try:
-            n = max(int(body.get("n") or 1), 1)
-        except (TypeError, ValueError):
-            return 1, 0, "invalid n"
-        best_of = 0
-        if not chat and body.get("best_of") is not None:
-            try:
-                best_of = int(body["best_of"])
-            except (TypeError, ValueError):
-                return n, 0, "invalid best_of"
-            if best_of < n:
-                return n, best_of, "best_of must be >= n"
-            if body.get("stream"):
-                return n, best_of, "best_of is not supported with streaming"
-        return n, best_of, ""
-
-    @staticmethod
-    def _child_sampling(sampling: SamplingParams, i: int, need_logprobs: bool):
-        """Per-sequence sampling params: distinct RNG stream per choice
-        (i=0 keeps the request seed so n=1 behavior is unchanged)."""
-        import dataclasses
-
-        seed = (sampling.seed + 0x9E3779B9 * i) & 0xFFFFFFFF
-        return dataclasses.replace(
-            sampling,
-            seed=seed,
-            logprobs=sampling.logprobs or need_logprobs,
-        )
-
-    def _serve(self, h: QuietHandler, body: Dict[str, Any], chat: bool) -> None:
-        from xllm_service_tpu.runtime.engine import EngineRequest
-
-        srid = body.get("service_request_id", "")
-        try:
-            token_ids = self._prompt_tokens(body, chat)
-        except (ValueError, TypeError) as e:
-            h.send_error_json(400, str(e))
-            return
-        if not token_ids:
-            h.send_error_json(400, "empty prompt")
-            return
-        n, best_of, n_err = self._n_sequences(body, chat)
-        if n_err:
-            h.send_error_json(400, n_err)
-            return
-        sampling = sampling_from_body(body, self.cfg)
-
-        if srid and self._master is not None and (n > 1 or best_of > 1):
-            # Fan-out mode: PD split is skipped for multi-sequence requests
-            # (a per-child handoff would need sub-request ids on the wire);
-            # this instance serves all sequences and pushes indexed deltas.
-            self._serve_fanout_forwarded(srid, token_ids, sampling, n, best_of)
-            h.send_json({"ok": True, "service_request_id": srid})
-            return
-        rid = generate_uuid(16)
-
-        if srid and self._master is not None:
-            # Forwarded mode: ack now, stream back over /rpc/generations.
-            mm_embeds = mm_positions = None
-            if body.get("mm_positions"):
-                # EPD: the encoder stage pushed this request's media
-                # embeddings to /mm/import (usually already landed — the
-                # master dispatches the encoder first).
-                mm = self._pop_mm_import(srid, timeout=60.0)
-                if mm is None:
-                    h.send_error_json(503, "media embeddings never arrived")
-                    return
-                mm_embeds, mm_positions = mm
-                if len(mm_positions) != len(body["mm_positions"]):
-                    # Encoder and service disagree on media-token count —
-                    # reject rather than pair mismatched arrays (an
-                    # embeds/positions desync would crash the engine step).
-                    h.send_error_json(
-                        502,
-                        f"encoder produced {len(mm_positions)} media tokens "
-                        f"but the request has "
-                        f"{len(body['mm_positions'])} placeholders",
-                    )
-                    return
-            with self._srid_mu:
-                self._srid_map.setdefault(srid, []).append(rid)
-            detoks: Dict[int, IncrementalDetokenizer] = {}
-            callback = self._make_push_callback(srid, detoks)
-            routing = body.get("routing") or {}
-            decode_name = routing.get("decode_name", "")
-            if mm_embeds is not None:
-                # Media requests serve colocated: the recomputed tail on a
-                # decode peer would need the embeddings too.
-                decode_name = ""
-            if decode_name and decode_name != self.name:
-                # PD disaggregation: this instance is the prefill side —
-                # emit the first token, then migrate KV to the decode peer
-                # (reference topology: rpc_service/service.h:61-71).
-                with self._push_acked_mu:
-                    self._push_acked[srid] = threading.Event()
-                self.engine.add_request(
-                    EngineRequest(
-                        request_id=rid,
-                        prompt_token_ids=token_ids,
-                        sampling=sampling,
-                        callback=callback,
-                        prefill_only=True,
-                        handoff=self._make_handoff_sender(
-                            srid, decode_name, body, detoks,
-                            seed=sampling.seed,
-                            respond_via_self=(
-                                routing.get("decode_response_to_service", True)
-                                is False
-                            ),
-                        ),
-                    )
-                )
-            else:
-                self.engine.add_request(
-                    EngineRequest(
-                        request_id=rid,
-                        prompt_token_ids=token_ids,
-                        sampling=sampling,
-                        callback=callback,
-                        mm_embeds=mm_embeds,
-                        mm_positions=mm_positions,
-                    )
-                )
-            h.send_json({"ok": True, "service_request_id": srid, "request_id": rid})
-            return
-
-        # Direct mode: this instance is the whole stack for one request.
-        self._serve_direct(h, body, chat, token_ids, sampling, rid, n, best_of)
-
-    def _serve_direct(
-        self,
-        h: QuietHandler,
-        body: Dict[str, Any],
-        chat: bool,
-        token_ids: List[int],
-        sampling: SamplingParams,
-        rid: str,
-        n: int = 1,
-        best_of: int = 0,
-    ) -> None:
-        from xllm_service_tpu.runtime.engine import EngineRequest
-
-        total = best_of or n
-
-        req = ServiceRequest(
-            service_request_id=("chatcmpl-" if chat else "cmpl-") + rid,
-            model=body.get("model", self.cfg.model),
-            stream=bool(body.get("stream", False)),
-            include_usage=bool(
-                (body.get("stream_options") or {}).get("include_usage", False)
-            ),
-            token_ids=token_ids,
-        )
-        if chat:
-            req.messages = parse_messages(body.get("messages", []))
-        else:
-            p = body.get("prompt", "")
-            req.prompt = p if isinstance(p, str) else "".join(p)
-
-        done = threading.Event()
-        acc: List[RequestOutput] = []
-        sse: Optional[SseWriter] = None
-        # Per-choice: each choice's first chat chunk must carry the
-        # assistant role (OpenAI stream semantics), not just the globally
-        # first chunk.
-        first_sent: Dict[int, bool] = {}
-        agg_mu = threading.Lock()
-        remaining = [total]
-        lp_sums = [0.0] * total
-        gen_counts = [0] * total
-
-        detoks: Dict[int, IncrementalDetokenizer] = {}
-        if req.stream:
-            sse = SseWriter(h)
-
-            class _Stream:
-                def write(_, payload):
-                    return sse.send(payload)
-
-                def write_done(_):
-                    ok = sse.send_done()
-                    done.set()
-                    return ok
-
-            stream = _Stream()
-
-            def make_callback(i: int):
-                def callback(out: RequestOutput) -> bool:
-                    if not out.status.ok() and not out.cancelled:
-                        # Engine-side failure: surface it, don't end as a
-                        # clean empty stream.
-                        sse.send(
-                            {"error": {"message": out.status.message,
-                                       "code": int(out.status.code)}}
-                        )
-                        sse.close()
-                        done.set()
-                        return False
-                    for s in out.outputs:
-                        s.index = i
-                        gen_counts[i] += len(s.token_ids)
-                    with agg_mu:
-                        last = True
-                        if out.finished:
-                            remaining[0] -= 1
-                            last = remaining[0] == 0
-                        if out.finished and not last:
-                            # Suppress the per-child [DONE]; keep the
-                            # choice's finish_reason chunk.
-                            out.finished = False
-                            out.usage = None
-                        elif out.finished and out.usage and total > 1:
-                            from xllm_service_tpu.common.types import Usage
-
-                            out.usage = Usage(
-                                num_prompt_tokens=len(token_ids),
-                                num_generated_tokens=sum(gen_counts),
-                            )
-                    self._detokenize(out, detoks)
-                    ok = self._responses.send_delta_to_client(
-                        stream, req, out, first_sent.get(i, False)
-                    )
-                    first_sent[i] = True
-                    if out.finished or not ok:
-                        # All sequences finished, or the client
-                        # disconnected — the exchange is over.
-                        done.set()
-                    return ok
-
-                return callback
-        else:
-
-            def make_callback(i: int):
-                def callback(out: RequestOutput) -> bool:
-                    for s in out.outputs:
-                        s.index = i
-                        for lp in s.logprobs:
-                            lp_sums[i] += lp.data.logprob
-                    if not best_of:
-                        self._detokenize(out, detoks)
-                    with agg_mu:
-                        acc.append(out)
-                        if out.finished:
-                            remaining[0] -= 1
-                            if remaining[0] == 0:
-                                done.set()
-                    return True
-
-                return callback
-
-        rids = []
-        for i in range(total):
-            child_rid = rid if i == 0 else generate_uuid(16)
-            rids.append(child_rid)
-            self.engine.add_request(
-                EngineRequest(
-                    request_id=child_rid,
-                    prompt_token_ids=list(token_ids),
-                    sampling=self._child_sampling(
-                        sampling, i, need_logprobs=bool(best_of)
-                    ),
-                    callback=make_callback(i),
-                )
-            )
-        if not done.wait(600.0):
-            for child_rid in rids:
-                self.engine.cancel(child_rid)
-            if sse is None:
-                # Only a never-started exchange can still carry an error
-                # response; an open SSE stream must not get a second head.
-                h.send_error_json(504, "generation timeout")
-            else:
-                sse.close()
-                h.close_connection = True
-            return
-        if not req.stream:
-            if best_of:
-                self._respond_best_of(
-                    h, req, acc, lp_sums, n, sampling.logprobs, detoks
-                )
-            else:
-                self._respond_accumulated(h, req, acc)
-
-    def _respond_best_of(
-        self,
-        h: QuietHandler,
-        req: ServiceRequest,
-        acc: List[RequestOutput],
-        lp_sums: List[float],
-        n: int,
-        want_logprobs: bool,
-        detoks: Dict[int, IncrementalDetokenizer],
-    ) -> None:
-        """Rank best_of children by mean logprob, return the top n as
-        choices 0..n-1 (completions API best_of semantics)."""
-        from xllm_service_tpu.common.types import Usage
-
-        if any(not o.status.ok() and not o.cancelled for o in acc):
-            self._respond_accumulated(h, req, acc)  # error path
-            return
-        merged: Dict[int, Any] = {}
-        for out in acc:
-            accumulate_sequences(merged, out)
-        order = sorted(
-            merged,
-            key=lambda i: lp_sums[i] / max(len(merged[i].token_ids), 1),
-            reverse=True,
-        )
-        winners = []
-        total_generated = sum(len(s.token_ids) for s in merged.values())
-        for new_idx, old_idx in enumerate(order[:n]):
-            s = merged[old_idx]
-            s.index = new_idx
-            if not want_logprobs:
-                s.logprobs = []
-            winners.append(s)
-        final = RequestOutput(
-            request_id=req.service_request_id,
-            service_request_id=req.service_request_id,
-            outputs=winners,
-            usage=Usage(
-                num_prompt_tokens=len(req.token_ids),
-                num_generated_tokens=total_generated,
-            ),
-            finished=True,
-        )
-        self._detokenize(final, detoks)
-
-        class _Once:
-            def finish(_, payload):
-                h.send_json(payload)
-                return True
-
-            def finish_with_error(_, code, msg):
-                h.send_error_json(500, msg)
-                return True
-
-        self._responses.send_result_to_client(_Once(), req, final)
-
-    def _respond_accumulated(
-        self, h: QuietHandler, req: ServiceRequest, acc: List[RequestOutput]
-    ) -> None:
-        # With n>1 children interleaving, an errored child's output can sit
-        # anywhere in acc — scan, don't just check the tail.
-        err = next(
-            (o for o in acc if not o.status.ok() and not o.cancelled), None
-        )
-        if err is not None:
-            h.send_error_json(
-                429 if err.status.code == StatusCode.RESOURCE_EXHAUSTED else 500,
-                err.status.message,
-            )
-            return
-        merged: Dict[int, Any] = {}
-        usage = None
-        for out in acc:
-            accumulate_sequences(merged, out)
-            if out.usage:
-                usage = out.usage
-        if usage is not None and len(merged) > 1:
-            # n>1: per-child usage only counts its own tokens — report the
-            # request-level total.
-            from xllm_service_tpu.common.types import Usage
-
-            usage = Usage(
-                num_prompt_tokens=usage.num_prompt_tokens,
-                num_generated_tokens=sum(
-                    len(s.token_ids) for s in merged.values()
-                ),
-            )
-        final = RequestOutput(
-            request_id=req.service_request_id,
-            service_request_id=req.service_request_id,
-            outputs=sorted(merged.values(), key=lambda s: s.index),
-            usage=usage,
-            finished=True,
-        )
-
-        class _Once:
-            def finish(_, payload):
-                h.send_json(payload)
-                return True
-
-            def finish_with_error(_, code, msg):
-                h.send_error_json(500, msg)
-                return True
-
-        self._responses.send_result_to_client(_Once(), req, final)
 
     def _detokenize(
         self, out: RequestOutput, detoks: Dict[int, IncrementalDetokenizer]

@@ -1,0 +1,326 @@
+"""KV-handoff plane of the instance server (PD disaggregation).
+
+Split from api/instance.py (round-3 de-monolith): everything that moves
+prefilled KV to a decode peer — the transfer worker loop, the handoff
+sender (ack-ordered send with local-peer direct import, pull-plane offer,
+bytes-plane fallback), the /kv/import receiver, and decode-side
+admission. Mixed into InstanceServer (api/instance.py); `self` is the
+server.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from xllm_service_tpu.api.http_utils import QuietHandler, post_bytes
+from xllm_service_tpu.api.instance_registry import _LOCAL_INSTANCES, _LOCAL_MU
+from xllm_service_tpu.api.protocol import (
+    handoff_from_bytes,
+    handoff_to_bytes,
+    sampling_from_body,
+)
+from xllm_service_tpu.common.shortuuid import generate_uuid
+from xllm_service_tpu.common.types import RequestOutput, Status, StatusCode
+from xllm_service_tpu.tokenizer.tokenizer import IncrementalDetokenizer
+
+logger = logging.getLogger("xllm_service_tpu.api.instance")
+
+
+class KVHandoffMixin:
+    def _transfer_loop(self) -> None:
+        while True:
+            job = self._transfer_q.get()
+            if job is None:
+                return
+            try:
+                job()
+            except Exception:
+                logger.exception("KV transfer job failed")
+
+    def _resolve_instance_addr(self, name: str) -> str:
+        addr = self._peer_addrs.get(name)
+        if addr:
+            return addr
+        meta = self._master.instance_info(name) if self._master else None
+        if meta is None:
+            return ""
+        self._peer_addrs[name] = meta.http_address
+        return meta.http_address
+
+    def _make_handoff_sender(
+        self,
+        srid: str,
+        decode_name: str,
+        body: Dict,
+        detoks: Optional[Dict[int, IncrementalDetokenizer]] = None,
+        seed: Optional[int] = None,
+        respond_via_self: bool = False,
+    ):
+        sampling_fields = {
+            k: body[k]
+            for k in (
+                "max_tokens", "max_completion_tokens", "temperature",
+                "top_p", "top_k", "seed", "logprobs", "top_logprobs",
+                "ignore_eos", "presence_penalty", "frequency_penalty",
+            )
+            if k in body
+        }
+        if seed is not None:
+            # Forward the RESOLVED seed (possibly drawn at random for an
+            # unseeded request) so the decode peer continues the same
+            # RNG stream instead of drawing its own.
+            sampling_fields["seed"] = seed
+
+        def transfer(handoff) -> None:
+            # Runs on the transfer thread (never the engine thread): waits
+            # for the master to ack the first-token push, then POSTs the KV
+            # payload to the decode peer. The engine already released the
+            # sequence's slot and blocks before enqueueing this job, so a
+            # slow master/peer delays only this handoff, not the engine.
+            #
+            # TOCTOU guard: send() kept the KV device-resident because a
+            # local peer existed at enqueue time; if that peer deregistered
+            # since, copy to host NOW — before the ack wait below — so a
+            # device export never sits pinned in HBM through it. With the
+            # pull plane enabled, device-residency through the ack wait is
+            # the point (the peer pulls from device memory), so the copy
+            # is skipped.
+            if (
+                handoff.kv is not None
+                and not isinstance(handoff.kv, np.ndarray)
+                and self._local_peer(decode_name) is None
+                and self._kv_transfer is None
+            ):
+                handoff = dataclasses.replace(
+                    handoff, kv=np.asarray(handoff.kv)
+                )
+            with self._push_acked_mu:
+                acked = self._push_acked.get(srid)
+            err = ""
+            # Cross-instance ordering: the first token must be acked by the
+            # master before the decode peer can start pushing, or a client
+            # could see token 2 before token 1. The event stays in the dict
+            # until AFTER the wait — popping first would race the ack.
+            if acked is not None and not acked.wait(60.0):
+                err = "first-token push never acked by master"
+            with self._push_acked_mu:
+                self._push_acked.pop(srid, None)
+            if not err:
+                extra = {
+                    "service_request_id": srid,
+                    "sampling": sampling_fields,
+                }
+                if respond_via_self:
+                    # Alternate topology: decode relays its generations
+                    # back through this (prefill) instance.
+                    extra["respond_addr"] = self.address
+                # Detokenizer carry-over: the decode peer continues from
+                # this side's exact byte/char position.
+                d0 = (detoks or {}).get(0)
+                if d0 is not None:
+                    ids, emitted = d0.export_state()
+                    extra["detok_ids"] = ids
+                    extra["detok_emitted"] = emitted
+                peer = self._local_peer(decode_name)
+                if peer is not None:
+                    # Colocated peer: direct in-process import, no
+                    # serialization (ICI-path analog).
+                    try:
+                        peer._admit_import(handoff, extra)
+                    except Exception as e:
+                        err = f"local decode peer import failed: {e}"
+                else:
+                    addr = self._resolve_instance_addr(decode_name)
+                    if not addr:
+                        err = f"decode instance {decode_name} unknown"
+                    else:
+                        err = self._post_handoff(addr, handoff, extra)
+            if not err:
+                # Handoff complete: this instance is done with the request
+                # (the decode peer owns cancellation from here).
+                with self._srid_mu:
+                    self._srid_map.pop(srid, None)
+            if err:
+                logger.error("handoff for %s failed: %s", srid, err)
+                out = RequestOutput(
+                    request_id=handoff.request_id,
+                    service_request_id=srid,
+                    status=Status(StatusCode.UNAVAILABLE, err),
+                    finished=True,
+                )
+                with self._srid_mu:
+                    self._srid_map.pop(srid, None)
+                self._push_q.put(out)
+
+        def send(handoff) -> None:
+            # Engine-thread side. The KV export arrives as a DEVICE array;
+            # it may only stay device-resident if a colocated peer will
+            # take it directly (in-process import) or the pull plane will
+            # serve it (the decode peer pulls from device memory) — on the
+            # bytes path it would otherwise sit pinned in HBM through the
+            # queue + up-to-60s ack wait while the engine has already
+            # freed and re-budgeted those blocks (round-2 review finding).
+            # Copy to host here for the bytes path; a peer that
+            # (de)registers between enqueue and transfer still works —
+            # both import paths accept either array kind.
+            if (
+                handoff.kv is not None
+                and self._local_peer(decode_name) is None
+                and self._kv_transfer is None
+            ):
+                handoff = dataclasses.replace(
+                    handoff, kv=np.asarray(handoff.kv)
+                )
+            self._transfer_q.put(lambda: transfer(handoff))
+
+        return send
+
+    def _post_handoff(self, addr: str, handoff, extra: Dict[str, Any]) -> str:
+        """POST one handoff to a cross-process decode peer; returns "" on
+        success, an error string otherwise.
+
+        With the pull plane up and a device-resident payload, the KV is
+        OFFERED on this process's transfer server and the POST carries
+        only {addr, uuid, shape, dtype}; the peer pulls device-to-device
+        before acking (runtime/transfer.py). A peer that rejects the pull
+        header (no transfer server / pull failure) gets ONE retry on the
+        bytes plane. Host (np) payloads always ride the bytes plane."""
+        use_pull = (
+            self._kv_transfer is not None
+            and handoff.kv is not None
+            and not isinstance(handoff.kv, np.ndarray)
+            and addr not in self._peer_no_pull
+        )
+        if use_pull:
+            kv_dev = handoff.kv
+            uuid = self._kv_transfer.offer([kv_dev])
+            header = dict(extra)
+            header["kv_pull"] = {
+                "addr": self._kv_transfer.address,
+                "uuid": uuid,
+                "shape": [int(s) for s in kv_dev.shape],
+                "dtype": str(kv_dev.dtype),
+            }
+            try:
+                payload = handoff_to_bytes(
+                    dataclasses.replace(handoff, kv=None), header
+                )
+                code, resp = post_bytes(addr, "/kv/import", payload)
+            except Exception as e:
+                # The peer may STILL be pulling (e.g. our request timed
+                # out while its pull was in flight) — an immediate
+                # retract could free the buffer under it.
+                self._kv_transfer.retract_later(uuid)
+                return f"decode peer unreachable: {e}"
+            # A response means the peer finished (or never started) its
+            # pull — the offer's keepalive can drop now.
+            self._kv_transfer.retract(uuid)
+            if code == 200:
+                return ""
+            logger.warning(
+                "pull-plane handoff rejected by %s (%s); using the bytes "
+                "plane for this peer from now on", addr, resp,
+            )
+            # Capability cache: a peer without a transfer server rejects
+            # EVERY pull header — don't pay the failing round trip per
+            # handoff forever.
+            self._peer_no_pull.add(addr)
+            handoff = dataclasses.replace(handoff, kv=np.asarray(kv_dev))
+        try:
+            payload = handoff_to_bytes(handoff, extra)
+            code, resp = post_bytes(addr, "/kv/import", payload)
+            if code != 200:
+                return f"decode peer rejected handoff: {resp}"
+        except Exception as e:
+            return f"decode peer unreachable: {e}"
+        return ""
+
+    def _local_peer(self, decode_name: str) -> Optional["InstanceServer"]:
+        """The colocated in-process peer eligible for direct (device-
+        resident) KV handoff, or None. BOTH sides must opt in, and both
+        must belong to the same master (name collisions across stacks in
+        one process must not cross-deliver KV)."""
+        if not self.cfg.enable_local_kv_transfer:
+            return None
+        with _LOCAL_MU:
+            peer = _LOCAL_INSTANCES.get(decode_name)
+        if peer is None or peer is self:
+            return None
+        if not peer.cfg.enable_local_kv_transfer or getattr(
+            peer._master, "_addr", None
+        ) != getattr(self._master, "_addr", ""):
+            return None
+        return peer
+
+    def _handle_kv_import(self, h: QuietHandler) -> None:
+        try:
+            n = int(h.headers.get("Content-Length", 0))
+            data = h.rfile.read(n)
+            handoff, header = handoff_from_bytes(data)
+        except Exception as e:
+            h.send_error_json(400, f"bad handoff payload: {e}")
+            return
+        if "kv_pull" in header:
+            # Pull plane: the body carried no KV bytes — pull the payload
+            # straight from the prefill peer's device memory into ours,
+            # BEFORE acking (so the sender's offer lifetime is bounded by
+            # this round-trip and pull failures surface in its response).
+            if self._kv_transfer is None:
+                h.send_error_json(
+                    400, "kv_pull offered but this instance has no "
+                    "transfer server (enable_kv_transfer_server)",
+                )
+                return
+            p = header["kv_pull"]
+            try:
+                try:
+                    dt = np.dtype(p["dtype"])
+                except TypeError:
+                    import ml_dtypes
+
+                    dt = np.dtype(getattr(ml_dtypes, p["dtype"]))
+                kv = self._kv_transfer.pull_single(
+                    p["addr"], int(p["uuid"]), p["shape"], dt
+                )
+            except Exception as e:
+                h.send_error_json(400, f"kv pull failed: {e}")
+                return
+            handoff = dataclasses.replace(handoff, kv=kv)
+        rid = self._admit_import(handoff, header)
+        h.send_json({"ok": True, "request_id": rid})
+
+    def _admit_import(self, handoff, header: Dict[str, Any]) -> str:
+        """Decode-side admission of a handed-off sequence — shared by the
+        HTTP /kv/import route and the in-process direct path (colocated
+        peers skip serialization entirely; the single-host analog of the
+        ICI device-to-device KV transfer)."""
+        from xllm_service_tpu.runtime.engine import EngineRequest
+
+        srid = header.get("service_request_id", "")
+        sampling = sampling_from_body(header.get("sampling", {}), self.cfg)
+        rid = generate_uuid(16)
+        with self._srid_mu:
+            self._srid_map.setdefault(srid, []).append(rid)
+        relay_addr = header.get("respond_addr", "")
+        if relay_addr:
+            self._relay_addrs[srid] = relay_addr
+        detoks: Dict[int, IncrementalDetokenizer] = {}
+        if "detok_ids" in header:
+            detoks[0] = IncrementalDetokenizer.from_state(
+                self.tokenizer, header["detok_ids"],
+                header.get("detok_emitted", 0),
+            )
+        self.engine.import_sequence(
+            EngineRequest(
+                request_id=rid,
+                prompt_token_ids=handoff.token_ids[:-1],
+                sampling=sampling,
+                callback=self._make_push_callback(srid, detoks),
+            ),
+            handoff,
+        )
+        return rid

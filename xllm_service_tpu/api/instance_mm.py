@@ -1,0 +1,179 @@
+"""Multimodal (EPD) and embeddings endpoints of the instance server.
+
+Split from api/instance.py (round-3 de-monolith): the ENCODE-stage
+/encode entry, the prefill-side /mm/import landing + wait, and the
+/v1/embeddings handler. Mixed into InstanceServer; `self` is the server.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+from xllm_service_tpu.api.http_utils import QuietHandler, post_json
+
+class MultimodalMixin:
+    # Landed-but-unclaimed media embeddings are reaped after this TTL.
+    _MM_IMPORT_TTL_S = 120.0
+
+    def _handle_embeddings(self, h: QuietHandler, body: Dict[str, Any]) -> None:
+        """Engine-side /v1/embeddings: token id lists in (the service
+        tokenizes, same injection contract as generation forwarding),
+        mean-pooled normalized hidden-state vectors out. The reference
+        rejects this endpoint (service.cpp:441-442) — implementing it
+        exceeds parity."""
+        token_lists = body.get("token_ids")
+        if not isinstance(token_lists, list) or not token_lists or not all(
+            isinstance(t, list) and t for t in token_lists
+        ):
+            h.send_error_json(
+                400,
+                "token_ids (non-empty list of non-empty id lists) required "
+                "— raw text inputs are tokenized by the master service",
+            )
+            return
+        limit = self.cfg.max_seq_len
+        too_long = max(len(t) for t in token_lists)
+        if too_long > limit:
+            h.send_error_json(
+                400,
+                f"input of {too_long} tokens exceeds max_seq_len {limit}",
+            )
+            return
+        try:
+            vecs = self.engine.executor.embed_tokens(token_lists)
+        except Exception as e:
+            h.send_error_json(500, f"embedding failed: {e}")
+            return
+        n_tok = sum(len(t) for t in token_lists)
+        h.send_json(
+            {
+                "object": "list",
+                "model": body.get("model") or self.cfg.model,
+                "data": [
+                    {
+                        "object": "embedding",
+                        "index": i,
+                        "embedding": [float(x) for x in vecs[i]],
+                    }
+                    for i in range(len(token_lists))
+                ],
+                "usage": {"prompt_tokens": n_tok, "total_tokens": n_tok},
+            }
+        )
+
+    def _handle_encode(self, h: QuietHandler, body: Dict[str, Any]) -> None:
+        """ENCODE-instance entry: media parts in, embeddings pushed to the
+        prefill peer's /mm/import, ack out (three-stage EPD routing)."""
+        import base64
+
+        if not hasattr(self.engine, "encode"):
+            h.send_error_json(501, "this instance has no encoder engine")
+            return
+        srid = body.get("service_request_id", "")
+        parts = body.get("parts") or []
+        positions = body.get("positions") or []
+        target = body.get("target", "")
+        if not parts or not target:
+            h.send_error_json(400, "parts and target are required")
+            return
+        vcfg = self.engine.executor.cfg
+        images = []
+        for p in parts:
+            shape = p.get("shape") or []
+            if (
+                len(shape) != 3
+                or shape[0] != vcfg.image_size
+                or shape[1] != vcfg.image_size
+                or shape[2] != 3
+            ):
+                h.send_error_json(
+                    400,
+                    f"media shape {shape} != encoder input "
+                    f"[{vcfg.image_size}, {vcfg.image_size}, 3]",
+                )
+                return
+            try:
+                arr = np.frombuffer(
+                    base64.b64decode(p["data"]), np.float32
+                ).reshape(shape)
+            except Exception as e:
+                h.send_error_json(400, f"bad media payload: {e}")
+                return
+            images.append(arr)
+        embeds = self.engine.encode(np.stack(images))  # [B, T, D]
+        flat = np.ascontiguousarray(embeds.reshape(-1, embeds.shape[-1]))
+        if positions and len(positions) != flat.shape[0]:
+            h.send_error_json(
+                400,
+                f"{len(positions)} placeholder positions but the encoder "
+                f"produced {flat.shape[0]} media tokens "
+                f"({embeds.shape[1]} per part — set mm_tokens_per_media)",
+            )
+            return
+        try:
+            code, resp = post_json(
+                target,
+                "/mm/import",
+                {
+                    "service_request_id": srid,
+                    "embeds": base64.b64encode(flat.tobytes()).decode(),
+                    "count": int(flat.shape[0]),
+                    "dim": int(flat.shape[1]),
+                    "positions": list(positions),
+                },
+                timeout=30.0,
+            )
+        except Exception as e:
+            h.send_error_json(502, f"prefill peer unreachable: {e}")
+            return
+        if code != 200:
+            h.send_error_json(502, f"prefill peer rejected embeddings: {resp}")
+            return
+        h.send_json({"ok": True, "media_tokens": int(flat.shape[0])})
+
+    def _handle_mm_import(self, h: QuietHandler, body: Dict[str, Any]) -> None:
+        import base64
+
+        srid = body.get("service_request_id", "")
+        try:
+            count = int(body["count"])
+            dim = int(body["dim"])
+            embeds = np.frombuffer(
+                base64.b64decode(body["embeds"]), np.float32
+            ).reshape(count, dim)
+            positions = [int(p) for p in body.get("positions", [])]
+        except Exception as e:
+            h.send_error_json(400, f"bad embeddings payload: {e}")
+            return
+        now = time.monotonic()
+        with self._mm_mu:
+            # Reap orphans (a push landing after its waiter timed out, or a
+            # master that died between /encode and the forward): without a
+            # TTL every such request pins its embedding array forever.
+            stale = [
+                s for s, (_, _, ts) in self._mm_imports.items()
+                if now - ts > self._MM_IMPORT_TTL_S
+            ]
+            for s in stale:
+                self._mm_imports.pop(s, None)
+                self._mm_events.pop(s, None)
+            self._mm_imports[srid] = (embeds, positions, now)
+            ev = self._mm_events.setdefault(srid, threading.Event())
+        ev.set()
+        h.send_json({"ok": True})
+
+    def _pop_mm_import(self, srid: str, timeout: float):
+        with self._mm_mu:
+            ev = self._mm_events.setdefault(srid, threading.Event())
+        if not ev.wait(timeout):
+            with self._mm_mu:
+                self._mm_events.pop(srid, None)
+            return None
+        with self._mm_mu:
+            self._mm_events.pop(srid, None)
+            entry = self._mm_imports.pop(srid, None)
+            return entry[:2] if entry is not None else None

@@ -1,0 +1,17 @@
+"""Process-local instance registry.
+
+Colocated PD peers hand KV off through direct calls; the KV payload stays
+a DEVICE array end-to-end on this path (engine._handoff exports to a
+device buffer; the peer's import pads and scatters device-side) — the
+single-host analog of the ICI device_put path. Only the HTTP/DCN route
+copies to host, at serialization time. Lives in its own module so
+api/instance.py and the KV-handoff mixin share it without a cycle.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_LOCAL_INSTANCES: Dict[str, "object"] = {}
+_LOCAL_MU = threading.Lock()

@@ -229,3 +229,37 @@ def augment_forwarded_request(
     if not decode_response_to_service:
         fwd["routing"]["decode_response_to_service"] = False
     return fwd
+
+
+def sampling_from_body(body, cfg):
+    """OpenAI request body -> SamplingParams (forwarded and direct
+    traffic share it; cfg supplies the max-new-tokens default). Unseeded
+    sampling draws a fresh per-request seed — only an explicit client
+    seed (0 included) gives the deterministic stream."""
+    import os
+
+    from xllm_service_tpu.ops.sampling import SamplingParams
+
+    max_tokens = int(
+        body.get("max_tokens") or body.get("max_completion_tokens") or 0
+    )
+    lp = body.get("logprobs")
+    top_lp = int(body.get("top_logprobs", 0) or 0)
+    raw_seed = body.get("seed")
+    seed = (
+        int(raw_seed)
+        if raw_seed is not None
+        else int.from_bytes(os.urandom(4), "little")
+    )
+    return SamplingParams(
+        temperature=float(body.get("temperature", 1.0)),
+        top_p=float(body.get("top_p", 1.0)),
+        top_k=int(body.get("top_k", 0) or 0),
+        seed=seed,
+        logprobs=bool(lp),
+        top_logprobs=top_lp if top_lp else (int(lp) if isinstance(lp, int) else 0),
+        max_new_tokens=max_tokens or cfg.max_new_tokens_default,
+        ignore_eos=bool(body.get("ignore_eos", False)),
+        presence_penalty=float(body.get("presence_penalty", 0.0) or 0.0),
+        frequency_penalty=float(body.get("frequency_penalty", 0.0) or 0.0),
+    )
