@@ -114,21 +114,30 @@ def _run_engine(exe: ModelExecutor, prompts, steps: int):
     return results
 
 
+@pytest.mark.parametrize(
+    "model", ["moe-tiny", "deepseek-hetero-tiny"],
+    ids=["moe", "mla-hetero"],
+)
 @pytest.mark.parametrize("dp,tp,ep", [(1, 1, 2), (1, 2, 2), (2, 1, 2)],
                          ids=["ep2", "tp2ep2", "dp2ep2"])
-def test_moe_ep_decode_parity(cpu_devices, dp, tp, ep):
+def test_moe_ep_decode_parity(cpu_devices, dp, tp, ep, model):
     """MoE decode with experts sharded over an ep axis (EP serving path —
     the combine contraction makes XLA emit the psum) matches the
-    single-device dense-all-experts oracle token for token."""
+    single-device dense-all-experts oracle token for token. Covers the
+    Mixtral-style GQA MoE and the heterogeneous DeepSeek stack (dense
+    prefix + MoE suffix: the split-stack two-scan path with per-stack
+    sharding specs)."""
     prompt = (np.arange(13, dtype=np.int32) * 5 + 2) % 512
-    ref_exe = ModelExecutor(_engine_cfg(model="moe-tiny"), init_seed=7)
+    ref_exe = ModelExecutor(_engine_cfg(model=model), init_seed=7)
     ref_toks, ref_lps = _greedy_tokens(ref_exe, prompt, 6)
 
     exe = ModelExecutor(
-        _engine_cfg(model="moe-tiny", dp_size=dp, tp_size=tp, ep_size=ep),
+        _engine_cfg(model=model, dp_size=dp, tp_size=tp, ep_size=ep),
         init_seed=7,
     )
     assert exe.mesh.shape == {"dp": dp, "tp": tp, "ep": ep}
+    if model == "deepseek-hetero-tiny":
+        assert "dense_layers" in exe.params
     toks, lps = _greedy_tokens(exe, prompt, 6)
     assert toks == ref_toks
     np.testing.assert_allclose(lps, ref_lps, atol=0.05)
