@@ -492,6 +492,11 @@ def main(argv=None) -> None:
         "--kv-cache-dtype", default="auto", choices=["auto", "int8"],
         help="int8 halves decode HBM traffic and doubles pool capacity",
     )
+    parser.add_argument(
+        "--weight-dtype", default="auto", choices=["auto", "int8"],
+        help="int8 weights: per-out-channel W8 halves weight HBM traffic "
+        "and per-device param residency",
+    )
     parser.add_argument("--dp-size", type=int, default=1)
     parser.add_argument("--tp-size", type=int, default=1)
     parser.add_argument("--ep-size", type=int, default=1)
@@ -529,6 +534,7 @@ def main(argv=None) -> None:
         max_seq_len=args.max_seq_len,
         prefill_buckets=[int(b) for b in args.prefill_buckets.split(",")],
         kv_cache_dtype=args.kv_cache_dtype,
+        weight_dtype=args.weight_dtype,
         dp_size=args.dp_size,
         tp_size=args.tp_size,
         ep_size=args.ep_size,

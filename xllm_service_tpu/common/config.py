@@ -108,6 +108,11 @@ class EngineConfig:
     # migration/host-tier payloads stay in model dtype (requantized on
     # import).
     kv_cache_dtype: str = "auto"
+    # "auto" keeps matmul weights in model dtype; "int8" quantizes them
+    # per output channel (ops/quant.py) — halves decode's weight HBM
+    # traffic and per-device param residency (the 70B-on-v5e lever the
+    # dress rehearsal budgets flag). Llama/Qwen/Mixtral family.
+    weight_dtype: str = "auto"
 
     # Continuous batching.
     max_running_requests: int = 64

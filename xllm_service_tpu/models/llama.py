@@ -32,11 +32,21 @@ from xllm_service_tpu.ops.attention import (
     prefill_attention,
 )
 from xllm_service_tpu.ops.norms import rms_norm
+from xllm_service_tpu.ops.quant import wdtype, wt
 from xllm_service_tpu.ops.rope import apply_rope
 
 Params = Dict[str, Any]
 
 NUM_CACHES = 2  # separate paged K and V caches
+
+# Stacked matmul leaves eligible for int8 weight quantization (all are
+# [L, in, out] / [L, X, in, out] with the contraction on axis -2 —
+# ops/quant.py). Norms/biases/router stay high precision; embed/lm_head
+# are gathers (dequant-at-use would materialize the full table).
+QUANTIZABLE_WEIGHT_LEAVES = (
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+    "w_sh_gate", "w_sh_up", "w_sh_down",
+)
 
 
 def cache_row_dims(cfg: ModelConfig) -> Tuple[int, int]:
@@ -130,9 +140,9 @@ def _project(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
 def _mlp(lp: Dict[str, jnp.ndarray], cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     """SwiGLU (dense) or top-k MoE block. x: [T, E]."""
     if not cfg.is_moe:
-        gate = jnp.einsum("te,ef->tf", x, lp["w_gate"])
-        up = jnp.einsum("te,ef->tf", x, lp["w_up"])
-        return jnp.einsum("tf,fe->te", jax.nn.silu(gate) * up, lp["w_down"])
+        gate = jnp.einsum("te,ef->tf", x, wt(lp["w_gate"]))
+        up = jnp.einsum("te,ef->tf", x, wt(lp["w_up"]))
+        return jnp.einsum("tf,fe->te", jax.nn.silu(gate) * up, wt(lp["w_down"]))
     # MoE: router scores -> top-k weights; every expert's FFN runs on its
     # own shard and the top-k combine is a CONTRACTION over the expert
     # axis. With w_gate/w_up/w_down sharded on X over an `ep` mesh axis
@@ -148,17 +158,19 @@ def _mlp(lp: Dict[str, jnp.ndarray], cfg: ModelConfig, x: jnp.ndarray) -> jnp.nd
     combine = combine.at[
         jnp.arange(T, dtype=jnp.int32)[:, None], topi
     ].set(weights)  # [T, X]: top-k softmax weight or 0
-    gate = jnp.einsum("te,xef->txf", x, lp["w_gate"])
-    up = jnp.einsum("te,xef->txf", x, lp["w_up"])
-    expert_out = jnp.einsum("txf,xfe->txe", jax.nn.silu(gate) * up, lp["w_down"])
+    gate = jnp.einsum("te,xef->txf", x, wt(lp["w_gate"]))
+    up = jnp.einsum("te,xef->txf", x, wt(lp["w_up"]))
+    expert_out = jnp.einsum(
+        "txf,xfe->txe", jax.nn.silu(gate) * up, wt(lp["w_down"])
+    )
     out = jnp.einsum("txe,tx->te", expert_out, combine.astype(expert_out.dtype))
     if cfg.n_shared_experts > 0:
         # DeepSeek-style always-active shared expert(s): a dense SwiGLU of
         # n_shared * moe_intermediate width alongside the routed experts.
-        sg = jnp.einsum("te,ef->tf", x, lp["w_sh_gate"])
-        su = jnp.einsum("te,ef->tf", x, lp["w_sh_up"])
+        sg = jnp.einsum("te,ef->tf", x, wt(lp["w_sh_gate"]))
+        su = jnp.einsum("te,ef->tf", x, wt(lp["w_sh_up"]))
         out = out + jnp.einsum(
-            "tf,fe->te", jax.nn.silu(sg) * su, lp["w_sh_down"]
+            "tf,fe->te", jax.nn.silu(sg) * su, wt(lp["w_sh_down"])
         )
     return out
 
@@ -166,9 +178,9 @@ def _mlp(lp: Dict[str, jnp.ndarray], cfg: ModelConfig, x: jnp.ndarray) -> jnp.nd
 def _qkv(lp, cfg: ModelConfig, x: jnp.ndarray, positions: jnp.ndarray):
     """x: [T, E] -> q [T, Hq, D], k/v [T, Hkv, D] with RoPE applied."""
     T = x.shape[0]
-    q = jnp.einsum("te,eh->th", x, lp["wq"])
-    k = jnp.einsum("te,eh->th", x, lp["wk"])
-    v = jnp.einsum("te,eh->th", x, lp["wv"])
+    q = jnp.einsum("te,eh->th", x, wt(lp["wq"]))
+    k = jnp.einsum("te,eh->th", x, wt(lp["wk"]))
+    v = jnp.einsum("te,eh->th", x, wt(lp["wv"]))
     if cfg.attn_bias:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = q.reshape(T, cfg.num_heads, cfg.head_dim)
@@ -206,7 +218,7 @@ def decode_step(
     k_caches', v_caches')."""
     bs = k_caches.shape[3]
     scale = cfg.head_dim**-0.5
-    x = params["embed"][token_ids].astype(params["layers"]["wq"].dtype)  # [R, E]
+    x = params["embed"][token_ids].astype(wdtype(params["layers"]["wq"]))  # [R, E]
 
     block_idx = positions // bs
     offset = jnp.where(active, positions % bs, 0)
@@ -223,7 +235,7 @@ def decode_step(
             q, k_l, v_l, block_tables, seq_lens, scale, use_kernel=use_kernel
         )
         x = x + jnp.einsum("rh,he->re", attn.reshape(attn.shape[0], -1),
-                           lp["wo"].reshape(-1, cfg.hidden_size))
+                           wt(lp["wo"]).reshape(-1, cfg.hidden_size))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(lp, cfg, h)
         return x, (k_l, v_l)
@@ -261,7 +273,7 @@ def prefill_batch_step(
     bs = k_caches.shape[3]
     scale = cfg.head_dim**-0.5
     P, Lpad = token_ids.shape
-    x = params["embed"][token_ids].astype(params["layers"]["wq"].dtype)
+    x = params["embed"][token_ids].astype(wdtype(params["layers"]["wq"]))
     if embed_overrides is not None and embed_overrides.shape[1] > 0:
         # Scatter into an extended buffer whose last row is a discard slot
         # so padded positions (== Lpad) never corrupt real rows.
@@ -298,7 +310,7 @@ def prefill_batch_step(
             q, k_l, v_l, block_tables, start_pos, true_len, scale
         )  # [P, Lpad, Hq, D] — flash kernel on TPU, blockwise elsewhere
         x = x + jnp.einsum("plh,he->ple", attn.reshape(P, Lpad, -1),
-                           lp["wo"].reshape(-1, cfg.hidden_size))
+                           wt(lp["wo"]).reshape(-1, cfg.hidden_size))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + jax.vmap(lambda t: _mlp(lp, cfg, t))(h)
         return x, (k_l, v_l)
@@ -357,7 +369,7 @@ def prefill_sp_step(
 
     Lsp = token_ids.shape[0]
     positions = jnp.arange(Lsp, dtype=jnp.int32)
-    x = params["embed"][token_ids].astype(params["layers"]["wq"].dtype)
+    x = params["embed"][token_ids].astype(wdtype(params["layers"]["wq"]))
     x = x[None]  # [1, Lsp, E] — ring_attention is batched
 
     def layer_fn(x, lp):
@@ -370,7 +382,7 @@ def prefill_sp_step(
         x = x + jnp.einsum(
             "blh,he->ble",
             attn.reshape(1, Lsp, -1),
-            lp["wo"].reshape(-1, cfg.hidden_size),
+            wt(lp["wo"]).reshape(-1, cfg.hidden_size),
         )
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(lp, cfg, h[0])[None]
@@ -402,7 +414,7 @@ def hidden_dense(
     forward_dense unembeds."""
     B, L = token_ids.shape
     scale = cfg.head_dim**-0.5
-    x = params["embed"][token_ids].astype(params["layers"]["wq"].dtype)
+    x = params["embed"][token_ids].astype(wdtype(params["layers"]["wq"]))
     positions = jnp.arange(L, dtype=jnp.int32)
     causal = jnp.tril(jnp.ones((L, L), dtype=bool))
 
@@ -421,7 +433,7 @@ def hidden_dense(
             return out.reshape(L, Hq * D).astype(hx.dtype)
 
         attn = jax.vmap(one_seq)(h)  # [B, L, Hq*D]
-        x = x + jnp.einsum("blh,he->ble", attn, lp["wo"].reshape(-1, cfg.hidden_size))
+        x = x + jnp.einsum("blh,he->ble", attn, wt(lp["wo"]).reshape(-1, cfg.hidden_size))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         mlp_out = jax.vmap(lambda t: _mlp(lp, cfg, t))(h)
         x = x + mlp_out

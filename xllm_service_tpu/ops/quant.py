@@ -1,0 +1,59 @@
+"""Int8 weight quantization (W8): per-output-channel scales.
+
+Decode is HBM-bandwidth-bound and the weights dominate its traffic
+(every step streams all params once). Storing matmul weights as int8
+with one scale per output channel halves that traffic and halves
+per-device param residency — the lever that fits llama3-70b-class
+models on v5e chips (__graft_entry__ dress-rehearsal budget:
+bf16 params alone exceed one chip at the largest buildable tp).
+
+Representation: a weight leaf becomes {"q": int8[..., in, out],
+"s": model_dtype[..., out]} — a plain dict, so it flows through
+lax.scan / jit / shardings as a pytree wherever the array did.
+Quantization is symmetric per output channel over the CONTRACTING
+axis (-2 for every stacked matmul leaf in models/llama.py:
+[L, in, out], [L, X, in, out]).
+
+Compute: `wt()` dequantizes at the use site — q.astype * s — which XLA
+fuses into the consuming matmul's operand read on TPU, so HBM still
+moves int8 bytes. The gather paths (embed/lm_head) are NOT quantized
+(dequant-at-use would materialize the full table per step; their share
+of 70B-class params is ~1.5%).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Union
+
+import jax.numpy as jnp
+
+QuantLeaf = Dict[str, jnp.ndarray]
+WeightLike = Union[jnp.ndarray, QuantLeaf]
+
+
+def is_quant(leaf: Any) -> bool:
+    return isinstance(leaf, dict) and "q" in leaf and "s" in leaf
+
+
+def quantize_weight(w: jnp.ndarray, dtype=None) -> QuantLeaf:
+    """w [..., in, out] -> {"q": int8 same shape, "s": [..., out]}.
+    Symmetric per-output-channel over the contracting (-2) axis; `dtype`
+    sets the scale dtype (defaults to w's)."""
+    f = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f), axis=-2)
+    s = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(f / s[..., None, :]), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": s.astype(dtype or w.dtype)}
+
+
+def wt(leaf: WeightLike) -> jnp.ndarray:
+    """Weight at a use site: dequantize an int8 leaf (fused into the
+    consuming matmul by XLA), pass plain arrays through."""
+    if is_quant(leaf):
+        return leaf["q"].astype(leaf["s"].dtype) * leaf["s"][..., None, :]
+    return leaf
+
+
+def wdtype(leaf: WeightLike):
+    """Compute dtype of a weight leaf (dict or array)."""
+    return leaf["s"].dtype if is_quant(leaf) else leaf.dtype

@@ -173,6 +173,11 @@ class ModelExecutor:
                 f"kv_cache_dtype={engine_cfg.kv_cache_dtype!r}: expected "
                 f"'auto' (model dtype) or 'int8'"
             )
+        if engine_cfg.weight_dtype not in ("auto", "int8"):
+            raise ValueError(
+                f"weight_dtype={engine_cfg.weight_dtype!r}: expected "
+                f"'auto' (model dtype) or 'int8'"
+            )
         self.kv_quantized = engine_cfg.kv_cache_dtype == "int8"
         self.R = engine_cfg.max_running_requests
         self.block_size = engine_cfg.block_size
@@ -209,6 +214,8 @@ class ModelExecutor:
                     out_shardings=p_shardings,
                 )
                 self.params = init_fn(jax.random.key(init_seed))
+            if engine_cfg.weight_dtype == "int8":
+                self._quantize_weights(p_shardings)
 
             # [L, N, Hkv, BS, D]: KV-head-major within a block so the Pallas
             # decode kernel can DMA one (block, head) tile of shape [BS, D]
@@ -301,12 +308,56 @@ class ModelExecutor:
 
     # ----------------------------------------------------------- sizing
 
+    def _quantize_weights(self, p_shardings) -> None:
+        """In-place W8 pass over the stacked matmul leaves (ops/quant.py):
+        each eligible leaf becomes {"q": int8, "s": per-out-channel
+        scale}, sharded like the original (the scale drops the contracted
+        -2 axis from the spec). Leaf-by-leaf with donation so peak HBM
+        never holds two full copies."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        from xllm_service_tpu.ops import quant
+
+        names = getattr(self.model_mod, "QUANTIZABLE_WEIGHT_LEAVES", ())
+        if not names:
+            raise ValueError(
+                f"weight_dtype=int8: model family "
+                f"{self.model_mod.__name__} has no quantizable-leaf map"
+            )
+        for stack in ("layers", "dense_layers"):
+            if stack not in self.params:
+                continue
+            for name in names:
+                leaf = self.params[stack].get(name)
+                if leaf is None:
+                    continue
+                sh = p_shardings[stack][name]
+                spec = list(sh.spec) + [None] * (
+                    leaf.ndim - len(sh.spec)
+                )
+                s_sh = NamedSharding(
+                    sh.mesh, PartitionSpec(*(spec[:-2] + spec[-1:]))
+                )
+                qfn = jax.jit(
+                    lambda w: quant.quantize_weight(w, self.dtype),
+                    out_shardings={"q": sh, "s": s_sh},
+                    donate_argnums=(0,),
+                )
+                self.params[stack][name] = qfn(leaf)
+
     def _decide_num_blocks(self) -> int:
         if self.engine_cfg.num_blocks > 0:
             return self.engine_cfg.num_blocks
         # Size the KV pool from free HBM after params (bench/real use).
         cfg = self.cfg
-        bytes_per_param = 2 if self.engine_cfg.dtype == "bfloat16" else 4
+        dtype_bytes = 2 if self.engine_cfg.dtype == "bfloat16" else 4
+        # Param residency and KV element size are SEPARATE quantities:
+        # int8 weights shrink only the former (matmul leaves become
+        # 1 byte + per-out-channel scales; embed/lm_head/norms stay full
+        # precision — ~1.15 bytes/param blended), while the KV element
+        # size tracks kv_cache_dtype below.
+        param_bytes = (
+            1.15 if self.engine_cfg.weight_dtype == "int8" else dtype_bytes
+        )
         n_params = approx_param_count(cfg)
         try:
             stats = jax.devices()[0].memory_stats() or {}
@@ -319,7 +370,7 @@ class ModelExecutor:
         # donated and count once).
         budget = (
             total_hbm * self.engine_cfg.hbm_utilization
-            - n_params * bytes_per_param / tp
+            - n_params * param_bytes / tp
         ) / 2
         cache_heads, cache_dim = models.cache_row_dims(self.cfg)
         # int8 cache: 1 byte/element + 4-byte f32 scale per scale group
@@ -333,7 +384,7 @@ class ModelExecutor:
         kv_elem_bytes = (
             1 + 4.0 * scale_groups / cache_dim
             if self.kv_quantized
-            else bytes_per_param
+            else dtype_bytes
         )
         # MLA's latent cache is replicated (no KV-head axis to shard).
         heads_per_dev = (
