@@ -38,11 +38,24 @@ from xllm_service_tpu.ops.attention import (
     mla_prefill_attention,
 )
 from xllm_service_tpu.ops.norms import rms_norm
+from xllm_service_tpu.ops.quant import wdtype, wt
 from xllm_service_tpu.ops.rope import apply_rope
 
 Params = Dict[str, Any]
 
 NUM_CACHES = 1  # latent cache only — no separate V cache
+
+# Stacked matmul leaves eligible for int8 weight quantization. Scales are
+# per-axis(-1)-channel over axis -2 (ops/quant.py); for most leaves that
+# is per-OUTPUT-channel over the contraction. Exception: w_uk's absorbed
+# use (_absorb_q) contracts its LAST axis (dn), so its scales are
+# per-contracting-channel there — numerically fine because leaves
+# dequantize before the matmul, but don't assume the per-output invariant
+# when adding leaves or changing the quantization axis.
+QUANTIZABLE_WEIGHT_LEAVES = (
+    "w_dkv", "w_uk", "w_uv", "wo", "w_dq", "w_uq", "w_q",
+    "w_gate", "w_up", "w_down", "w_sh_gate", "w_sh_up", "w_sh_down",
+)
 
 
 def cache_row_dims(cfg: ModelConfig) -> Tuple[int, int]:
@@ -193,11 +206,11 @@ def _q_heads(lp, cfg: ModelConfig, h: jnp.ndarray, positions: jnp.ndarray):
     T = h.shape[0]
     dn, dr = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
     if cfg.q_lora_rank > 0:
-        cq = jnp.einsum("te,eq->tq", h, lp["w_dq"])
+        cq = jnp.einsum("te,eq->tq", h, wt(lp["w_dq"]))
         cq = rms_norm(cq, lp["q_norm"], cfg.rms_norm_eps)
-        q = jnp.einsum("tq,qh->th", cq, lp["w_uq"])
+        q = jnp.einsum("tq,qh->th", cq, wt(lp["w_uq"]))
     else:
-        q = jnp.einsum("te,eh->th", h, lp["w_q"])
+        q = jnp.einsum("te,eh->th", h, wt(lp["w_q"]))
     q = q.reshape(T, cfg.num_heads, dn + dr)
     q_nope, q_pe = q[..., :dn], q[..., dn:]
     q_pe = apply_rope(q_pe, positions, cfg.rope_theta)
@@ -207,7 +220,7 @@ def _q_heads(lp, cfg: ModelConfig, h: jnp.ndarray, positions: jnp.ndarray):
 def _latent_rows(lp, cfg: ModelConfig, h: jnp.ndarray, positions: jnp.ndarray):
     """h [T, E] -> cache rows [T, C]: concat(normed c_kv, roped k_pe)."""
     kvr, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
-    ckv = jnp.einsum("te,ec->tc", h, lp["w_dkv"])  # [T, kvr + dr]
+    ckv = jnp.einsum("te,ec->tc", h, wt(lp["w_dkv"]))  # [T, kvr + dr]
     c, k_pe = ckv[..., :kvr], ckv[..., kvr:]
     c = rms_norm(c, lp["kv_norm"], cfg.rms_norm_eps)
     # Single shared rope key per token (head axis of 1 for apply_rope).
@@ -217,15 +230,15 @@ def _latent_rows(lp, cfg: ModelConfig, h: jnp.ndarray, positions: jnp.ndarray):
 
 def _absorb_q(lp, q_nope: jnp.ndarray, q_pe: jnp.ndarray) -> jnp.ndarray:
     """Project q_nope into the latent space and append q_pe: [.., Hq, C]."""
-    q_lat = jnp.einsum("...hd,hkd->...hk", q_nope, lp["w_uk"])
+    q_lat = jnp.einsum("...hd,hkd->...hk", q_nope, wt(lp["w_uk"]))
     return jnp.concatenate([q_lat, q_pe], axis=-1)
 
 
 def _attn_out(lp, cfg: ModelConfig, ctx_lat: jnp.ndarray) -> jnp.ndarray:
     """ctx_lat [..., Hq, kvr] -> hidden [..., E] via W_UV then W_O."""
-    o = jnp.einsum("...hk,hkv->...hv", ctx_lat, lp["w_uv"])
+    o = jnp.einsum("...hk,hkv->...hv", ctx_lat, wt(lp["w_uv"]))
     flat = o.reshape(*o.shape[:-2], cfg.num_heads * cfg.v_head_dim)
-    return jnp.einsum("...h,he->...e", flat, lp["wo"])
+    return jnp.einsum("...h,he->...e", flat, wt(lp["wo"]))
 
 
 def decode_step(
@@ -243,7 +256,7 @@ def decode_step(
     bs = k_caches.shape[3]
     scale = (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
     kvr = cfg.kv_lora_rank
-    x = params["embed"][token_ids].astype(params["layers"]["w_dkv"].dtype)
+    x = params["embed"][token_ids].astype(wdtype(params["layers"]["w_dkv"]))
 
     block_idx = positions // bs
     offset = jnp.where(active, positions % bs, 0)
@@ -298,7 +311,7 @@ def prefill_batch_step(
     scale = (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
     kvr = cfg.kv_lora_rank
     P, Lpad = token_ids.shape
-    x = params["embed"][token_ids].astype(params["layers"]["w_dkv"].dtype)
+    x = params["embed"][token_ids].astype(wdtype(params["layers"]["w_dkv"]))
     if embed_overrides is not None and embed_overrides.shape[1] > 0:
         E = x.shape[-1]
         ext = jnp.concatenate([x, jnp.zeros((P, 1, E), x.dtype)], axis=1)
@@ -379,7 +392,7 @@ def hidden_dense(
     kvr = cfg.kv_lora_rank
     scale = (dn + dr) ** -0.5
     positions = jnp.arange(L, dtype=jnp.int32)
-    x = params["embed"][token_ids].astype(params["layers"]["w_dkv"].dtype)
+    x = params["embed"][token_ids].astype(wdtype(params["layers"]["w_dkv"]))
     causal = (
         jnp.arange(L)[None, :] <= jnp.arange(L)[:, None]
     )  # [L, L] True = attend
@@ -393,8 +406,10 @@ def hidden_dense(
                 q_nope, q_pe = _q_heads(lp, cfg, h, positions)
                 rows = _latent_rows(lp, cfg, h, positions)  # [L, C]
                 c, k_pe = rows[..., :kvr], rows[..., kvr:]
-                k_nope = jnp.einsum("tk,hkd->thd", c, lp["w_uk"])  # [L,Hq,dn]
-                v = jnp.einsum("tk,hkv->thv", c, lp["w_uv"])  # [L,Hq,dv]
+                k_nope = jnp.einsum(
+                    "tk,hkd->thd", c, wt(lp["w_uk"])
+                )  # [L,Hq,dn]
+                v = jnp.einsum("tk,hkv->thv", c, wt(lp["w_uv"]))  # [L,Hq,dv]
                 k_pe_b = jnp.broadcast_to(
                     k_pe[:, None, :], (L, cfg.num_heads, dr)
                 )
@@ -409,7 +424,9 @@ def hidden_dense(
                 # pairwise distinct).
                 o = jnp.einsum("hqk,khv->qhv", p, v.astype(jnp.float32))
                 flat = o.reshape(L, cfg.num_heads * cfg.v_head_dim)
-                attn = jnp.einsum("qf,fe->qe", flat.astype(hx.dtype), lp["wo"])
+                attn = jnp.einsum(
+                    "qf,fe->qe", flat.astype(hx.dtype), wt(lp["wo"])
+                )
                 hx = hx + attn
                 h2 = rms_norm(hx, lp["mlp_norm"], cfg.rms_norm_eps)
                 return hx + _mlp(lp, mcfg, h2)
