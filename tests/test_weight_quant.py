@@ -150,3 +150,26 @@ def test_w8_deepseek_hidden_dense():
     )
     out = deepseek.hidden_dense(ex8.params, ex8.cfg, toks)
     assert np.isfinite(np.asarray(out)).all()
+
+
+def test_w8_from_checkpoint_matches_init(tmp_path):
+    """weight_dtype=int8 composes with checkpoint loading: quantization
+    runs after load, so a checkpointed W8 executor equals a W8 executor
+    holding the same weights from init."""
+    from xllm_service_tpu.runtime import weights
+
+    ref = ModelExecutor(_engine_cfg("llama3-tiny"), init_seed=6)
+    ckpt = str(tmp_path / "ckpt")
+    weights.save_hf_checkpoint(ref.params, ref.cfg, ckpt)
+
+    ex_init = ModelExecutor(
+        _engine_cfg("llama3-tiny", weight_dtype="int8"), init_seed=6
+    )
+    ex_ckpt = ModelExecutor(
+        _engine_cfg(
+            "llama3-tiny", weight_dtype="int8", checkpoint_path=ckpt
+        ),
+        init_seed=0,  # irrelevant: weights loaded
+    )
+    prompt = (np.arange(15, dtype=np.int32) * 11 + 2) % 512
+    assert _greedy(ex_ckpt, prompt, 6) == _greedy(ex_init, prompt, 6)
