@@ -67,8 +67,10 @@ class TerminalTracker:
         return True
 
 
-@pytest.mark.parametrize("seed", [0, 1, 2])
-def test_engine_concurrency_fuzz(seed):
+@pytest.mark.parametrize("seed,weight_dtype", [
+    (0, "auto"), (1, "auto"), (2, "auto"), (3, "int8"),
+], ids=["s0", "s1", "s2", "s3-w8"])
+def test_engine_concurrency_fuzz(seed, weight_dtype):
     cfg = EngineConfig(
         model="llama3-tiny",
         dtype="float32",
@@ -77,6 +79,7 @@ def test_engine_concurrency_fuzz(seed):
         max_running_requests=4,
         max_seq_len=128,
         prefill_buckets=[32, 64, 128],
+        weight_dtype=weight_dtype,  # one seed soaks the W8 path
     )
     ex = ModelExecutor(cfg, init_seed=7)
     eng = InferenceEngine(cfg, executor=ex)
