@@ -27,17 +27,22 @@ def wait_until(pred, timeout=10.0, interval=0.02):
     return pred()
 
 
-def http_post(addr, path, body, timeout=30.0):
+def http_post(addr, path, body, timeout=30.0, headers=None,
+              return_headers=False):
     host, _, port = addr.partition(":")
     conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
     conn.request(
         "POST", path, body=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json"},
+        headers={"Content-Type": "application/json", **(headers or {})},
     )
     resp = conn.getresponse()
     data = resp.read()
+    resp_headers = dict(resp.getheaders())
     conn.close()
-    return resp.status, json.loads(data) if data else {}
+    parsed = json.loads(data) if data else {}
+    if return_headers:
+        return resp.status, parsed, resp_headers
+    return resp.status, parsed
 
 def http_get(addr, path, timeout=10.0):
     host, _, port = addr.partition(":")
@@ -402,3 +407,47 @@ class TestStopSequences:
             {"model": "fake-echo", "prompt": "x", "stop": 7},
         )
         assert code == 400
+
+
+class TestXRequestId:
+    def test_nonstream_echoes_header(self, cluster):
+        """x-request-id (reference CallData header pair) round-trips to
+        the response."""
+        master = cluster[0]
+        code, _, rh = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "fake-echo", "prompt": "ab", "max_tokens": 2},
+            headers={"x-request-id": "corr-123"}, return_headers=True,
+            timeout=60.0,
+        )
+        assert code == 200
+        assert rh.get("x-request-id") == "corr-123"
+
+    def test_stream_echoes_header(self, cluster):
+        master = cluster[0]
+        host, _, port = master.http_address.partition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=60.0)
+        conn.request(
+            "POST", "/v1/completions",
+            body=json.dumps({"model": "fake-echo", "prompt": "ab",
+                             "max_tokens": 2, "stream": True}).encode(),
+            headers={"Content-Type": "application/json",
+                     "x-ms-client-request-id": "corr-456"},  # fallback
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("x-request-id") == "corr-456"
+        resp.read()
+        conn.close()
+
+    def test_error_echoes_header(self, cluster):
+        """Correlation survives failures — the error paths echo too."""
+        master = cluster[0]
+        code, _, rh = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "fake-echo", "max_tokens": 2},  # no prompt -> 400
+            headers={"x-request-id": "corr-err"}, return_headers=True,
+            timeout=60.0,
+        )
+        assert code == 400
+        assert rh.get("x-request-id") == "corr-err"

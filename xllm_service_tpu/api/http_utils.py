@@ -33,16 +33,37 @@ class QuietHandler(BaseHTTPRequestHandler):
         except Exception:
             return None
 
-    def send_json(self, obj: Any, status: int = 200) -> None:
+    def send_json(
+        self, obj: Any, status: int = 200,
+        extra_headers: Optional[Dict[str, str]] = None,
+    ) -> None:
         data = json.dumps(obj).encode("utf-8")
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
-    def send_error_json(self, status: int, message: str, etype: str = "invalid_request_error") -> None:
-        self.send_json({"error": {"message": message, "type": etype}}, status)
+    def x_request_id(self) -> str:
+        """Client correlation id (reference: call_data.h:41-47 reads
+        x-request-id, falling back to x-ms-client-request-id)."""
+        return (
+            self.headers.get("x-request-id")
+            or self.headers.get("x-ms-client-request-id")
+            or ""
+        )
+
+    def send_error_json(
+        self, status: int, message: str,
+        etype: str = "invalid_request_error",
+        extra_headers: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.send_json(
+            {"error": {"message": message, "type": etype}}, status,
+            extra_headers=extra_headers,
+        )
 
     def query(self) -> Dict[str, str]:
         q = parse_qs(urlparse(self.path).query)
@@ -58,7 +79,11 @@ class SseWriter:
     (the ProgressiveAttachment analog, call_data.h:150-193). Thread-safe:
     scheduler lanes write from their own threads."""
 
-    def __init__(self, handler: BaseHTTPRequestHandler):
+    def __init__(
+        self,
+        handler: BaseHTTPRequestHandler,
+        extra_headers: Optional[Dict[str, str]] = None,
+    ):
         self._h = handler
         self._mu = threading.Lock()
         self.closed = False
@@ -67,6 +92,8 @@ class SseWriter:
         handler.send_header("Cache-Control", "no-cache")
         handler.send_header("Connection", "keep-alive")
         handler.send_header("Transfer-Encoding", "chunked")
+        for k, v in (extra_headers or {}).items():
+            handler.send_header(k, v)
         handler.end_headers()
 
     def _chunk(self, data: bytes) -> bool:

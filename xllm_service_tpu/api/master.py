@@ -73,9 +73,17 @@ class HttpClientStream(ClientStream):
     blocks on `done` while lane threads write (reference: StreamCallData +
     the early done->Run SSE trick, call_data.h:83-92)."""
 
-    def __init__(self, handler: QuietHandler, streaming: bool):
+    def __init__(
+        self, handler: QuietHandler, streaming: bool, x_request_id: str = ""
+    ):
         self._handler = handler
         self._streaming = streaming
+        # Echoed on every response — success AND error (reference
+        # CallData captures the same header pair; here it round-trips to
+        # the client and lands in the request trace for correlation).
+        self._extra_headers = (
+            {"x-request-id": x_request_id} if x_request_id else None
+        )
         self._sse: Optional[SseWriter] = None
         self.done = threading.Event()
         # Set when the handler thread gives up on the exchange (timeout):
@@ -89,7 +97,7 @@ class HttpClientStream(ClientStream):
 
     def _ensure_sse(self) -> SseWriter:
         if self._sse is None:
-            self._sse = SseWriter(self._handler)
+            self._sse = SseWriter(self._handler, self._extra_headers)
         return self._sse
 
     def write(self, payload: Dict[str, Any]) -> bool:
@@ -110,7 +118,9 @@ class HttpClientStream(ClientStream):
         if self._abandoned.is_set():
             return False
         try:
-            self._handler.send_json(payload)
+            self._handler.send_json(
+                payload, extra_headers=self._extra_headers
+            )
             return True
         except (BrokenPipeError, ConnectionResetError, OSError):
             return False
@@ -128,7 +138,8 @@ class HttpClientStream(ClientStream):
                 self._sse.close()
                 return ok
             self._handler.send_error_json(
-                _HTTP_STATUS.get(code, 500), message, "service_error"
+                _HTTP_STATUS.get(code, 500), message, "service_error",
+                extra_headers=self._extra_headers,
             )
             return True
         except (BrokenPipeError, ConnectionResetError, OSError):
@@ -418,25 +429,28 @@ class Master:
         return req
 
     def _serve_generation(self, h: QuietHandler, chat: bool) -> None:
+        xrid = h.x_request_id()
+        xh = {"x-request-id": xrid} if xrid else None
         body = h.read_json()
         if body is None:
-            h.send_error_json(400, "invalid JSON body")
+            h.send_error_json(400, "invalid JSON body", extra_headers=xh)
             return
         if chat and not body.get("messages"):
-            h.send_error_json(400, "messages is required")
+            h.send_error_json(400, "messages is required", extra_headers=xh)
             return
         if not chat and not body.get("prompt"):
-            h.send_error_json(400, "prompt is required")
+            h.send_error_json(400, "prompt is required", extra_headers=xh)
             return
         try:
             req = self._parse_request(body, chat)
         except (ValueError, TypeError) as e:
-            h.send_error_json(400, str(e))
+            h.send_error_json(400, str(e), extra_headers=xh)
             return
         status = self.scheduler.schedule(req)
         if not status.ok():
             h.send_error_json(
-                _HTTP_STATUS.get(status.code, 500), status.message
+                _HTTP_STATUS.get(status.code, 500), status.message,
+                extra_headers=xh,
             )
             return
 
@@ -446,9 +460,15 @@ class Master:
             self.scheduler.instance_mgr.update_request_metrics(
                 req.routing, RequestAction.CANCEL, len(req.token_ids)
             )
-            h.send_error_json(503, "prefill instance vanished")
+            h.send_error_json(
+                503, "prefill instance vanished", extra_headers=xh
+            )
             return
-        stream = HttpClientStream(h, req.stream)
+        if xrid and self.scheduler.tracer.enabled:
+            self.scheduler.tracer.record(
+                req.service_request_id, "x_request_id", xrid
+            )
+        stream = HttpClientStream(h, req.stream, x_request_id=xrid)
 
         path = "/v1/chat/completions" if chat else "/v1/completions"
 
