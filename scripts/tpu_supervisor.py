@@ -1,0 +1,177 @@
+"""Tunnel-resilient TPU session supervisor.
+
+The axon tunnel wedges unpredictably (observed rounds 2 and 3: a client
+blocks forever in recv mid-compile). This supervisor makes on-chip results
+land anyway:
+
+  * probe the tunnel with a tiny matmul in a SUBPROCESS (timeout-guarded);
+  * while healthy, run each pending validate_kernel_tpu.py case in its own
+    subprocess with a hard timeout — a wedge kills that case's process,
+    not the session;
+  * retry wedged cases (up to MAX_TRIES) after the tunnel answers again;
+  * when every case is done (or exhausted), run bench.py on the chip and
+    store its JSON line;
+  * append everything to OUTDIR so a later shell can harvest results.
+
+Run:  nohup python scripts/tpu_supervisor.py > /tmp/tpu_supervisor.log 2>&1 &
+State lives in .tpu_session/ (untracked): done_<i>.txt per finished case,
+bench.json for the bench line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUTDIR = os.path.join(REPO, ".tpu_session")
+PROBE_TIMEOUT = 180
+CASE_TIMEOUT = int(os.environ.get("XLLM_TPU_CASE_TIMEOUT", 1500))
+BENCH_TIMEOUT = int(os.environ.get("XLLM_TPU_BENCH_TIMEOUT", 3600))
+MAX_TRIES = 3
+PROBE_SLEEP = 150
+
+ENV = dict(os.environ, PYTHONUNBUFFERED="1")
+ENV.pop("XLLM_BENCH_FORCE_CPU", None)
+ENV["PYTHONPATH"] = REPO + ":" + ENV.get("PYTHONPATH", "")
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def probe() -> bool:
+    code = ("import jax, jax.numpy as jnp;"
+            "y=(jnp.ones((256,256),jnp.bfloat16)@jnp.ones((256,256),"
+            "jnp.bfloat16)).sum();print('PROBE_OK',float(y),"
+            "jax.default_backend())")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], env=ENV,
+                           capture_output=True, text=True,
+                           timeout=PROBE_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        return False
+    return r.returncode == 0 and "PROBE_OK" in r.stdout and \
+        r.stdout.strip().endswith("tpu")
+
+
+def case_list() -> list[tuple[int, str, bool]]:
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts/validate_kernel_tpu.py"),
+         "--list"],
+        env=dict(ENV, JAX_PLATFORMS="cpu"), capture_output=True, text=True,
+        timeout=PROBE_TIMEOUT)
+    out = []
+    for line in r.stdout.splitlines():
+        parts = line.split()
+        if len(parts) == 3 and parts[0].isdigit():
+            out.append((int(parts[0]), parts[1], parts[2] == "1"))
+    if r.returncode != 0 or not out:
+        raise RuntimeError(
+            f"--list failed rc={r.returncode}: {r.stderr[-1000:]}")
+    return out
+
+
+def run_case(i: int, name: str) -> bool:
+    log(f"case {i} {name}: start")
+    try:
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts/validate_kernel_tpu.py"),
+             "--case", str(i)],
+            env=ENV, capture_output=True, text=True, timeout=CASE_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        # TimeoutExpired.stdout is None on POSIX; partial output is lost
+        log(f"case {i} {name}: TIMEOUT after {CASE_TIMEOUT}s")
+        with open(os.path.join(OUTDIR, "attempts.log"), "a") as f:
+            f.write(f"case {i} {name} TIMEOUT\n")
+        return False
+    ok = r.returncode == 0 and "PARITY OK" in r.stdout
+    with open(os.path.join(OUTDIR, "attempts.log"), "a") as f:
+        f.write(f"case {i} {name} rc={r.returncode}\n{r.stdout}\n"
+                f"{r.stderr[-2000:] if not ok else ''}\n")
+    if ok:
+        with open(os.path.join(OUTDIR, f"done_{name}.txt"), "w") as f:
+            f.write(r.stdout)
+        log(f"case {i} {name}: OK")
+    else:
+        log(f"case {i} {name}: FAIL rc={r.returncode} "
+            f"(tail: {r.stdout.strip().splitlines()[-1:] or r.stderr.strip().splitlines()[-1:]})")
+    return ok
+
+
+def run_bench() -> bool:
+    log("bench.py: start")
+    try:
+        r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                           env=ENV, capture_output=True, text=True,
+                           timeout=BENCH_TIMEOUT, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        log("bench.py: TIMEOUT")
+        return False
+    line = ""
+    for ln in r.stdout.splitlines():
+        if ln.startswith("{"):
+            line = ln
+    with open(os.path.join(OUTDIR, "bench_raw.log"), "a") as f:
+        f.write(r.stdout + "\n--- stderr ---\n" + r.stderr[-4000:] + "\n")
+    if not line:
+        log(f"bench.py: no JSON line (rc={r.returncode})")
+        return False
+    try:
+        rec = json.loads(line)
+    except ValueError:
+        log(f"bench.py: unparseable JSON line: {line[:200]}")
+        return False
+    ok = rec.get("backend") == "tpu"
+    # Only a real on-chip number marks the bench done; a CPU-fallback line
+    # is recorded separately and the TPU bench is retried.
+    dest = "bench.json" if ok else "bench_cpu_fallback.json"
+    with open(os.path.join(OUTDIR, dest), "w") as f:
+        f.write(line + "\n")
+    log(f"bench.py: {'OK' if ok else 'landed but not tpu'} {line}")
+    return ok
+
+
+def main() -> None:
+    os.makedirs(OUTDIR, exist_ok=True)
+    cases = case_list()
+    log(f"{len(cases)} validation cases queued")
+    tries = {i: 0 for i, _, _ in cases}
+    bench_tries = 0
+    healthy = True  # probe only after a failure — cases carry own timeouts
+    while True:
+        pending = [(i, n, p) for i, n, p in cases
+                   if not os.path.exists(
+                       os.path.join(OUTDIR, f"done_{n}.txt"))
+                   and tries[i] < MAX_TRIES]
+        bench_done = os.path.exists(os.path.join(OUTDIR, "bench.json"))
+        if not pending and (bench_done or bench_tries >= MAX_TRIES * 2):
+            log("all work done (or exhausted); exiting")
+            return
+        if not healthy:
+            if not probe():
+                log("tunnel down; sleeping")
+                time.sleep(PROBE_SLEEP)
+                continue
+            log("tunnel healthy again")
+            healthy = True
+        # Bench first once the high-priority cases (the never-validated
+        # kernels) are done — the flagship number outranks tail re-validation.
+        prio_pending = [c for c in pending if c[2]]
+        if not prio_pending and not bench_done and bench_tries < MAX_TRIES * 2:
+            bench_tries += 1
+            healthy = run_bench()
+            continue
+        if not pending:
+            continue
+        i, name, _ = (prio_pending or pending)[0]
+        tries[i] += 1
+        healthy = run_case(i, name)
+
+
+if __name__ == "__main__":
+    main()
