@@ -134,6 +134,24 @@ class EngineConfig:
     # Sampling defaults.
     max_new_tokens_default: int = 512
 
+    # Speculative decoding (prompt-lookup / n-gram drafting; 0 disables).
+    # Each decode step drafts this many tokens per sequence by matching the
+    # newest suffix n-gram against the sequence's own history, verifies all
+    # of them in ONE forward pass (static [R, k+1] shapes — no recompiles),
+    # and emits 1..k+1 tokens. EXACT: point-mass drafts + the sequential
+    # per-step key schedule make the emitted stream bit-identical to
+    # non-speculative decoding under the same seeds (ops/sampling.py
+    # speculative_sample). Decode is HBM-bound, so verifying k+1 positions
+    # reuses the same weight/KV traffic one token would — accepted drafts
+    # are nearly free throughput.
+    speculative_tokens: int = 0
+    speculative_ngram_max: int = 3  # longest suffix n-gram to match
+    # Drafting scans at most this many trailing history tokens (numpy
+    # sliding-window match, host-side, every decode step) — bounds the
+    # proposer's host cost on long contexts; matches beyond the window are
+    # rare and only cost un-accepted drafts, never correctness.
+    speculative_lookback: int = 4096
+
     # Persistent XLA compilation cache dir ("" disables). First boot of a
     # shape-bucketed engine compiles tens of programs at 20-40 s each on
     # TPU; with the cache, every later boot (restart, PD role flip to an

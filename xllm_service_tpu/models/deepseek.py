@@ -303,6 +303,7 @@ def prefill_batch_step(
     block_tables: jnp.ndarray,  # [P, CB]
     embed_overrides: jnp.ndarray | None = None,
     override_positions: jnp.ndarray | None = None,
+    all_logits: bool = False,  # speculative verify: unembed EVERY position
 ):
     """Batched chunked prefill; mirrors llama.prefill_batch_step (media
     embedding injection included — the EPD encoder stage is model-family
@@ -361,6 +362,8 @@ def prefill_batch_step(
     x, k_caches, v_caches = _scan_stack(
         params, cfg, make_layer_fn, x, k_caches, v_caches
     )
+    if all_logits:
+        return _unembed(params, cfg, x), k_caches, v_caches  # [P, Lpad, V]
     last = jnp.take_along_axis(
         x, jnp.maximum(true_len - 1, 0)[:, None, None], axis=1
     )[:, 0]

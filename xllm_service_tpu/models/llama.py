@@ -262,6 +262,7 @@ def prefill_batch_step(
     embed_overrides: jnp.ndarray | None = None,  # [P, M, E] media tokens
     override_positions: jnp.ndarray | None = None,  # [P, M] chunk-relative;
     # padding entries point at Lpad (a dummy row, sliced off)
+    all_logits: bool = False,  # speculative verify: unembed EVERY position
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Prefill P sequences' chunks in ONE compiled step (batched admission).
 
@@ -269,7 +270,8 @@ def prefill_batch_step(
     flattened write (invalid rows land in garbage block 0); attention is
     vmapped per sequence over its own sliced block table. Media embeddings
     (EPD encoder outputs) overwrite placeholder-token rows before the first
-    layer. Returns (last-token logits [P, V], k', v')."""
+    layer. Returns (last-token logits [P, V] — or [P, Lpad, V] when
+    `all_logits`, the speculative-decoding verify pass — k', v')."""
     bs = k_caches.shape[3]
     scale = cfg.head_dim**-0.5
     P, Lpad = token_ids.shape
@@ -318,6 +320,8 @@ def prefill_batch_step(
     x, (k_caches, v_caches) = jax.lax.scan(
         layer_fn, x, (params["layers"], k_caches, v_caches)
     )
+    if all_logits:
+        return _unembed(params, cfg, x), k_caches, v_caches  # [P, Lpad, V]
     last = jnp.take_along_axis(
         x, jnp.maximum(true_len - 1, 0)[:, None, None], axis=1
     )[:, 0]  # [P, E]

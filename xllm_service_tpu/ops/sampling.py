@@ -135,6 +135,79 @@ def sample_tokens(
     return token_ids, chosen_logprob, logprobs_full
 
 
+def speculative_sample(
+    logits: jnp.ndarray,  # [R, S, V] — verify-pass logits, position-major
+    drafts: jnp.ndarray,  # [R, S-1] int32 — proposed tokens d_1..d_k
+    temperature: jnp.ndarray,  # [R]
+    top_k: jnp.ndarray,  # [R]
+    top_p: jnp.ndarray,  # [R]
+    step_keys: jnp.ndarray,  # [R, S, 2] — per-position keys (step_base + j)
+    limits: jnp.ndarray,  # [R] int32 — max tokens this row may emit (<= S)
+    active: jnp.ndarray,  # [R] bool
+    counts: jnp.ndarray | None = None,  # [R, V] int32 (donated by caller)
+    presence: jnp.ndarray | None = None,  # [R]
+    frequency: jnp.ndarray | None = None,  # [R]
+):
+    """Speculative acceptance for point-mass (n-gram / prompt-lookup) drafts.
+
+    Position j's logits condition on [x_0, d_1..d_j] (the verify pass fed
+    the last accepted token then the drafts). Sample t_j ~ p_j with the SAME
+    per-step key schedule the sequential decode path would use at step
+    base+j, and keep emitting while t_j equals the draft. This is *exactly*
+    sequential sampling, not an approximation: accepting d_j with
+    probability p_j(d_j) and otherwise emitting a sample from
+    p_j(x | x != d_j) is the same joint law as emitting t_j ~ p_j outright —
+    the standard speculative rejection rule collapses to equality-coupling
+    when the draft distribution is a point mass. Consequently the
+    speculative engine reproduces the non-speculative token stream
+    bit-for-bit under identical seeds (tests/test_speculative.py asserts
+    this), while emitting up to S tokens per verify step.
+
+    Penalty exactness: the scan threads `counts` through the positions, so
+    each emitted token penalizes later positions inside the same verify
+    step just as it would across sequential decode steps.
+
+    Returns (tokens [R, S], logprobs [R, S], n_emit [R], counts').
+    Rows emit their first n_emit tokens; the rest is garbage.
+    """
+    R, S, V = logits.shape
+    logits = logits.astype(jnp.float32)
+    # pad drafts with an impossible token so position S-1 never "accepts"
+    drafts_p = jnp.concatenate(
+        [drafts.astype(jnp.int32), jnp.full((R, 1), -1, jnp.int32)], axis=1
+    )
+    have_counts = counts is not None
+    if not have_counts:
+        counts = jnp.zeros((R, 1), jnp.int32)  # dummy carry
+
+    def body(carry, xs):
+        cnts, going = carry
+        lg, keys_j, d_j, j = xs
+        tok, lp, _ = sample_tokens(
+            lg, temperature, top_k, top_p, keys_j,
+            counts=cnts if have_counts else None,
+            presence=presence, frequency=frequency,
+        )
+        emit = going & (j < limits)
+        if have_counts:
+            cnts = cnts.at[jnp.arange(R), tok].add(emit.astype(jnp.int32))
+        going = emit & (tok == d_j)
+        return (cnts, going), (tok, lp, emit)
+
+    (counts, _), (toks, lps, emits) = jax.lax.scan(
+        body,
+        (counts, active),
+        (
+            jnp.swapaxes(logits, 0, 1),  # [S, R, V]
+            jnp.swapaxes(step_keys, 0, 1),  # [S, R, 2]
+            drafts_p.T,  # [S, R]
+            jnp.arange(S, dtype=jnp.int32),
+        ),
+    )
+    n_emit = jnp.sum(emits.astype(jnp.int32), axis=0)  # [R]
+    return toks.T, lps.T, n_emit, counts
+
+
 def make_step_keys(base_seeds: jnp.ndarray, steps: jnp.ndarray) -> jnp.ndarray:
     """Per-request keys folded with the generation step index: [R] -> [R, 2].
 

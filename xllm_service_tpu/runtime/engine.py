@@ -877,15 +877,18 @@ class InferenceEngine:
 
     # -------------------------------------------------------------- decode
 
-    def _decode_once(self) -> int:
-        if not self._running:
-            return 0
-        # Ensure block capacity for the token each seq is about to write.
+    def _ensure_decode_capacity(self, width: int) -> None:
+        """Ensure block capacity for every position the coming decode step
+        may write: `width` tokens starting at each seq's input position
+        (1 for plain decode, k+1 for speculative), capped at max_seq_len.
+        Preempts (victim-first, then self) on pool exhaustion."""
+        max_len = self.cfg.max_seq_len
         for slot, seq in sorted(self._running.items()):
             if slot not in self._running:  # preempted earlier this pass
                 continue
-            pos = len(seq.tokens) - 1  # position of the input token
-            need = pos // self.block_size + 1
+            pos = len(seq.tokens) - 1  # position of the first input token
+            tl = max(1, min(width, max_len - pos))
+            need = (pos + tl - 1) // self.block_size + 1
             while len(seq.block_ids) < need:
                 try:
                     seq.block_ids += self.block_mgr.allocate(1)
@@ -898,12 +901,10 @@ class InferenceEngine:
                     self._preempt(victim)
             else:
                 continue
-        if not self._running:
-            return 0
 
-        token_ids = np.zeros((self.R,), np.int32)
-        positions = np.zeros((self.R,), np.int32)
-        active = np.zeros((self.R,), bool)
+    def _gather_sampling_batch(self) -> SamplingBatch:
+        """Per-slot sampling params + block tables for the running set
+        (shared by the plain and speculative decode paths)."""
         temps = np.zeros((self.R,), np.float32)
         top_ks = np.zeros((self.R,), np.int32)
         top_ps = np.ones((self.R,), np.float32)
@@ -912,11 +913,7 @@ class InferenceEngine:
         presence = np.zeros((self.R,), np.float32)
         frequency = np.zeros((self.R,), np.float32)
         self._block_tables[:] = 0
-
         for slot, seq in self._running.items():
-            token_ids[slot] = seq.tokens[-1]
-            positions[slot] = len(seq.tokens) - 1
-            active[slot] = True
             n = len(seq.block_ids)
             self._block_tables[slot, :n] = seq.block_ids
             s = seq.req.sampling
@@ -927,6 +924,27 @@ class InferenceEngine:
             steps[slot] = len(seq.generated)
             presence[slot] = getattr(s, "presence_penalty", 0.0)
             frequency[slot] = getattr(s, "frequency_penalty", 0.0)
+        return SamplingBatch(
+            temps, top_ks, top_ps, seeds, steps, presence, frequency
+        )
+
+    def _decode_once(self) -> int:
+        if self.cfg.speculative_tokens > 0:
+            return self._decode_spec_once()
+        if not self._running:
+            return 0
+        self._ensure_decode_capacity(1)
+        if not self._running:
+            return 0
+
+        token_ids = np.zeros((self.R,), np.int32)
+        positions = np.zeros((self.R,), np.int32)
+        active = np.zeros((self.R,), bool)
+        batch = self._gather_sampling_batch()
+        for slot, seq in self._running.items():
+            token_ids[slot] = seq.tokens[-1]
+            positions[slot] = len(seq.tokens) - 1
+            active[slot] = True
 
         t0 = time.monotonic()
         tokens, logprobs = self.executor.decode(
@@ -934,9 +952,7 @@ class InferenceEngine:
             positions,
             self._block_tables,
             active,
-            SamplingBatch(
-                temps, top_ks, top_ps, seeds, steps, presence, frequency
-            ),
+            batch,
         )
         step_ms = (time.monotonic() - t0) * 1000
         nactive = int(active.sum())
@@ -955,6 +971,93 @@ class InferenceEngine:
             self._commit_full_blocks(seq)
             produced += 1
             self._emit(seq, finished=self._check_stop(seq))
+        return produced
+
+    # ------------------------------------------------- speculative decode
+
+    def _propose_drafts(self, seq: _Seq, k: int) -> np.ndarray:
+        """Prompt-lookup drafting: match the newest suffix n-gram (longest
+        first, down to 1) against the sequence's own prompt+generation
+        history and propose the k tokens that followed the most recent
+        earlier occurrence. No draft model, no extra device work — repetitive
+        text (code, quotes, structured output) accepts several tokens per
+        step; random text degrades to plain decoding (the verify step
+        always emits >= 1 token). History beyond `speculative_lookback`
+        trailing tokens is not scanned (bounds host cost per step)."""
+        a = np.asarray(
+            seq.tokens[-self.cfg.speculative_lookback:], np.int32
+        )
+        n_max = min(self.cfg.speculative_ngram_max, len(a) - 1)
+        for n in range(n_max, 0, -1):
+            g = a[-n:]
+            w = np.lib.stride_tricks.sliding_window_view(a, n)
+            starts = np.nonzero((w == g).all(axis=1))[0]
+            starts = starts[starts < len(a) - n]  # exclude the suffix itself
+            if starts.size:
+                i = int(starts[-1])  # most recent prior occurrence
+                follow = a[i + n: i + n + k]
+                if follow.size:
+                    out = np.empty((k,), np.int32)
+                    out[: follow.size] = follow
+                    out[follow.size:] = follow[-1]
+                    return out
+        return np.full((k,), a[-1], np.int32)
+
+    def _decode_spec_once(self) -> int:
+        """Speculative variant of _decode_once: feed [last_token, k drafts]
+        per sequence, verify in one pass, emit the accepted prefix + one
+        corrected/bonus token. Identical output stream to the plain path
+        (see EngineConfig.speculative_tokens), 1..k+1 tokens per step."""
+        if not self._running:
+            return 0
+        k = self.cfg.speculative_tokens
+        S = k + 1
+        max_len = self.cfg.max_seq_len
+        self._ensure_decode_capacity(S)
+        if not self._running:
+            return 0
+
+        token_ids = np.zeros((self.R, S), np.int32)
+        positions = np.zeros((self.R,), np.int32)
+        true_len = np.zeros((self.R,), np.int32)
+        active = np.zeros((self.R,), bool)
+        batch = self._gather_sampling_batch()
+        for slot, seq in self._running.items():
+            pos = len(seq.tokens) - 1
+            token_ids[slot, 0] = seq.tokens[-1]
+            token_ids[slot, 1:] = self._propose_drafts(seq, k)
+            positions[slot] = pos
+            true_len[slot] = max(1, min(S, max_len - pos))
+            active[slot] = True
+
+        t0 = time.monotonic()
+        tokens, logprobs, n_emit = self.executor.verify(
+            token_ids,
+            positions,
+            true_len,
+            self._block_tables,
+            active,
+            batch,
+        )
+        step_ms = (time.monotonic() - t0) * 1000
+        nactive = int(active.sum())
+        total_ctx = int(positions[active].sum()) + nactive
+        self._profile_tpot.append((nactive, total_ctx, step_ms))
+
+        produced = 0
+        now = time.monotonic()
+        for slot in list(self._running.keys()):
+            seq = self._running[slot]
+            self._tbt_window.append((now, (now - seq.last_token_time) * 1000))
+            seq.last_token_time = now
+            for i in range(int(n_emit[slot])):
+                tok, lp = int(tokens[slot, i]), float(logprobs[slot, i])
+                seq.generated.append((tok, lp))
+                seq.tokens.append(tok)
+                self._commit_full_blocks(seq)
+                produced += 1
+                if not self._emit(seq, finished=self._check_stop(seq)):
+                    break  # finished or cancelled: drop remaining tokens
         return produced
 
     # ---------------------------------------------------------- preemption

@@ -487,7 +487,106 @@ class ModelExecutor:
         )
         return k_cache, v_cache, tokens, logprob
 
+    def _verify_impl(
+        self,
+        k_cache,
+        v_cache,
+        counts,  # [R, V] int32 (donated)
+        params,
+        token_ids,  # [R, S] — last accepted token then S-1 draft tokens
+        start_pos,  # [R] — position of the first fed token
+        true_len,  # [R] — fed tokens this row may write/emit (0 = inactive)
+        block_tables,  # [R, CB]
+        temperature,
+        top_k,
+        top_p,
+        step_keys,  # [R, S, 2]
+        active,  # [R] bool
+        presence,
+        frequency,
+    ):
+        """Speculative-decoding verify step: one forward pass over S
+        positions per sequence (the prefill machinery with `all_logits`),
+        then point-mass speculative acceptance (ops/sampling.py). KV rows
+        for ALL S positions are written; rows past the accepted prefix are
+        stale garbage that attention can never read (masked by seq_lens)
+        and the next step overwrites."""
+        logits, k_cache, v_cache = self.model_mod.prefill_batch_step(
+            params, self.cfg, k_cache, v_cache, token_ids, start_pos,
+            true_len, block_tables, all_logits=True,
+        )  # [R, S, V]
+        drafts = token_ids[:, 1:]
+        tokens, logprobs, n_emit, counts = sampling_ops.speculative_sample(
+            logits, drafts, temperature, top_k, top_p, step_keys,
+            limits=true_len, active=active,
+            counts=counts, presence=presence, frequency=frequency,
+        )
+        return k_cache, v_cache, counts, tokens, logprobs, n_emit
+
     # ---------------------------------------------------------- public API
+
+    def verify(
+        self,
+        token_ids: np.ndarray,  # [R, S]
+        positions: np.ndarray,  # [R] — position of the first fed token
+        true_len: np.ndarray,  # [R] — <= S; 0 for inactive rows
+        block_tables: np.ndarray,  # [R, max_blocks_per_seq]
+        active: np.ndarray,  # [R] bool
+        batch: SamplingBatch,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Speculative decode step. Returns (tokens [R, S], logprobs [R, S],
+        n_emit [R]): each active row emits its first n_emit tokens (>= 1 —
+        a verify step subsumes a plain decode step)."""
+        if not hasattr(self, "_verify_jit"):
+            self._verify_jit = jax.jit(
+                self._verify_impl, donate_argnums=(0, 1, 2)
+            )
+        S = token_ids.shape[1]
+        # Per-position keys on the sequential schedule: position j uses
+        # step base+j, so the emitted stream is bit-identical to the
+        # non-speculative path under the same seeds.
+        seeds = jnp.asarray(batch.seeds, jnp.uint32)
+        keys = jnp.stack(
+            [
+                sampling_ops.make_step_keys(
+                    seeds, jnp.asarray(batch.steps, jnp.int32) + j
+                )
+                for j in range(S)
+            ],
+            axis=1,
+        )  # [R, S, 2]
+        need = 1
+        if active.any():
+            last_pos = np.asarray(positions) + np.asarray(true_len) - 1
+            need = int(
+                (last_pos[np.asarray(active)].max() // self.block_size) + 1
+            )
+        CB = self._pow2_bucket(need, self.max_blocks_per_seq)
+        R = self.R
+        zeros = np.zeros((R,), np.float32)
+        presence = batch.presence if batch.presence is not None else zeros
+        frequency = batch.frequency if batch.frequency is not None else zeros
+        (
+            self.k_cache, self.v_cache, self.token_counts,
+            tokens, logprobs, n_emit,
+        ) = self._verify_jit(
+            self.k_cache,
+            self.v_cache,
+            self.token_counts,
+            self.params,
+            jnp.asarray(token_ids, jnp.int32),
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(true_len, jnp.int32),
+            jnp.asarray(block_tables[:, :CB], jnp.int32),
+            jnp.asarray(batch.temperature, jnp.float32),
+            jnp.asarray(batch.top_k, jnp.int32),
+            jnp.asarray(batch.top_p, jnp.float32),
+            keys,
+            jnp.asarray(active),
+            jnp.asarray(presence, jnp.float32),
+            jnp.asarray(frequency, jnp.float32),
+        )
+        return np.asarray(tokens), np.asarray(logprobs), np.asarray(n_emit)
 
     def bucket_len(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -722,6 +821,29 @@ class ModelExecutor:
             if CB >= self.max_blocks_per_seq:
                 break
             CB = min(CB * 2, self.max_blocks_per_seq)
+
+        # Speculative verify shapes ([R, S] over the same pow2 CB buckets)
+        # when the engine runs speculative decoding.
+        spec = self.engine_cfg.speculative_tokens
+        if spec > 0:
+            S = spec + 1
+            CB = 1
+            while True:
+                positions = np.zeros((R,), np.int32)
+                positions[0] = max(CB * self.block_size - S, 0)
+                true_len = np.zeros((R,), np.int32)
+                true_len[0] = S
+                self.verify(
+                    np.zeros((R, S), np.int32),
+                    positions,
+                    true_len,
+                    np.zeros((R, self.max_blocks_per_seq), np.int32),
+                    active,
+                    batch,
+                )
+                if CB >= self.max_blocks_per_seq:
+                    break
+                CB = min(CB * 2, self.max_blocks_per_seq)
         return warmed
 
     # ------------------------------------------------ SP (ring) prefill
