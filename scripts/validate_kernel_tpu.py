@@ -99,6 +99,56 @@ def run_case(R, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16, chunk=4,
     return err
 
 
+def run_mq_case(R, S, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16,
+                int8=False):
+    """Multi-query decode (speculative verify) kernel vs the blockwise
+    prefill oracle on hardware."""
+    from xllm_service_tpu.ops.attention import prefill_attention
+    from xllm_service_tpu.ops.pallas.paged_attention import (
+        multiquery_paged_attention_kernel,
+    )
+
+    rng = np.random.default_rng(0)
+    N = R * MB + 1
+    q = jnp.asarray(rng.standard_normal((R, S, Hq, D)), dtype)
+    k = jnp.asarray(rng.standard_normal((N, Hkv, BS, D)), dtype)
+    v = jnp.asarray(rng.standard_normal((N, Hkv, BS, D)), dtype)
+    if int8:
+        from xllm_service_tpu.ops import kv_cache as kvc
+
+        k = kvc.PagedKV(*kvc.quantize_rows(k))
+        v = kvc.PagedKV(*kvc.quantize_rows(v))
+    bt = jnp.asarray(1 + np.arange(R * MB).reshape(R, MB) % (N - 1), jnp.int32)
+    lens = jnp.asarray(
+        np.clip(rng.integers(ctx // 2, ctx + 1, R), 1, MB * BS - S), jnp.int32
+    )
+    scale = 1.0 / D**0.5
+    start_pos = jnp.maximum(lens - 1, 0)
+    true_len = jnp.full((R,), S, jnp.int32)
+
+    ker = lambda: multiquery_paged_attention_kernel(
+        q, k, v, bt, lens, scale
+    )
+    orc = lambda: prefill_attention(
+        q, k, v, bt, start_pos, true_len, scale, use_kernel=False
+    )
+    err = float(
+        np.max(np.abs(np.asarray(ker().astype(jnp.float32))
+                      - np.asarray(orc().astype(jnp.float32))))
+    )
+    tk, tg = bench(ker), bench(orc)
+    row_bytes = D * (1 if int8 else dtype.dtype.itemsize) + (4 if int8 else 0)
+    kv_bytes = 2 * float(np.sum(np.asarray(lens))) * Hkv * row_bytes
+    bw = kv_bytes / tk / 1e9
+    print(
+        f"MQ R={R:3d} S={S} Hq={Hq} Hkv={Hkv} D={D} BS={BS} MB={MB} "
+        f"ctx~{ctx} {'int8' if int8 else 'bf16'} err={err:.4f} "
+        f"kernel={tk*1e6:8.1f}us blockwise={tg*1e6:8.1f}us "
+        f"speedup={tg/tk:5.2f}x bw={bw:6.1f}GB/s"
+    )
+    return err
+
+
 def run_mla_case(R, Hq, kvr, dr, BS, MB, ctx, dtype=jnp.bfloat16):
     """MLA decode kernel vs the MLA gather oracle on hardware."""
     from xllm_service_tpu.ops.attention import mla_paged_attention_gather
@@ -258,6 +308,12 @@ CASES = [
      dict(P=4, Lpad=512, Hq=32, Hkv=8, D=128, BS=128, MB=8, int8=True)),
     ("mla-prefill", run_mla_prefill_case,
      dict(P=2, Lpad=512, Hq=128, kvr=512, dr=64, BS=128, MB=8)),
+    # Multi-query decode (speculative verify) at production shapes
+    ("mq-bf16", run_mq_case,
+     dict(R=64, S=4, Hq=32, Hkv=8, D=128, BS=128, MB=16, ctx=2048)),
+    ("mq-int8", run_mq_case,
+     dict(R=64, S=4, Hq=32, Hkv=8, D=128, BS=128, MB=16, ctx=2048,
+          int8=True)),
     # bf16 decode (re-validated round 2; re-run last)
     ("dec-bf16-prod", run_case,
      dict(R=64, Hq=32, Hkv=8, D=128, BS=128, MB=16, ctx=2048)),

@@ -316,3 +316,162 @@ def test_mla_prefill_dispatcher_kernel_branch():
             np.asarray(out)[p, :tl], np.asarray(ref)[p, :tl],
             atol=3e-5, rtol=3e-5,
         )
+
+
+# ------------------------- multi-query decode (speculative verify) kernel
+
+
+def _mq_oracle(q, k, v, bt, seq_lens, S, scale):
+    """Blockwise prefill as the oracle: query row s of seq r attends to
+    seq_lens[r] + s context rows (prefill semantics with start_pos =
+    seq_lens - 1, true_len = S for active rows)."""
+    from xllm_service_tpu.ops.attention import prefill_attention
+
+    start_pos = jnp.maximum(seq_lens - 1, 0)
+    true_len = jnp.where(seq_lens > 0, S, 0)
+    return prefill_attention(
+        q, k, v, bt, start_pos, true_len, scale, use_kernel=False
+    )
+
+
+@pytest.mark.parametrize("gqa", [1, 4])
+@pytest.mark.parametrize("S", [2, 4])
+def test_mq_decode_kernel_matches_blockwise(gqa, S):
+    from xllm_service_tpu.ops.pallas.paged_attention import (
+        multiquery_paged_attention_kernel,
+    )
+
+    rng = np.random.default_rng(0)
+    Hkv = 4
+    _, k, v, bt, seq_lens = make_case(rng, Hq=Hkv * gqa, Hkv=Hkv)
+    R, MB = bt.shape
+    BS = k.shape[2]
+    q = jnp.asarray(
+        rng.standard_normal((R, S, Hkv * gqa, k.shape[-1])), jnp.float32
+    )
+    # leave S rows of headroom inside the table for the extra positions
+    seq_lens = jnp.minimum(seq_lens, MB * BS - S)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    ref = _mq_oracle(q, k, v, bt, seq_lens, S, scale)
+    out = multiquery_paged_attention_kernel(
+        q, k, v, bt, seq_lens, scale, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_mq_decode_kernel_inactive_and_edge():
+    """Inactive slots (seq_len = 0) emit zeros; seq_len = 1 and a
+    block-boundary-straddling step are exact."""
+    from xllm_service_tpu.ops.pallas.paged_attention import (
+        multiquery_paged_attention_kernel,
+    )
+
+    rng = np.random.default_rng(3)
+    S = 4
+    _, k, v, bt, _ = make_case(rng, R=4, MB=4, BS=16)
+    q = jnp.asarray(rng.standard_normal((4, S, 8, 64)), jnp.float32)
+    # 14 + 4 > 16 straddles the first block boundary
+    seq_lens = jnp.asarray([0, 1, 14, 60], jnp.int32)
+    out = multiquery_paged_attention_kernel(
+        q, k, v, bt, seq_lens, 0.125, interpret=True
+    )
+    ref = _mq_oracle(q, k, v, bt, seq_lens, S, 0.125)
+    out, ref = np.asarray(out), np.asarray(ref)
+    assert np.all(out[0] == 0)
+    np.testing.assert_allclose(out[1:], ref[1:], atol=2e-5, rtol=2e-5)
+
+
+def test_mq_decode_kernel_int8():
+    from xllm_service_tpu.ops import kv_cache as kvc
+    from xllm_service_tpu.ops.pallas.paged_attention import (
+        multiquery_paged_attention_kernel,
+    )
+
+    rng = np.random.default_rng(5)
+    S = 3
+    _, k, v, bt, seq_lens = make_case(rng, R=4, Hq=8, Hkv=4, D=128, BS=128,
+                                      MB=4, num_blocks=32)
+    q = jnp.asarray(rng.standard_normal((4, S, 8, 128)), jnp.float32)
+    seq_lens = jnp.minimum(seq_lens, 4 * 128 - S)
+    kq = kvc.PagedKV(*kvc.quantize_rows(k))
+    vq = kvc.PagedKV(*kvc.quantize_rows(v))
+    scale = 1.0 / np.sqrt(128)
+    ref = _mq_oracle(q, kq, vq, bt, seq_lens, S, scale)
+    out = multiquery_paged_attention_kernel(
+        q, kq, vq, bt, seq_lens, scale, interpret=True
+    )
+    # int8 path: the kernel folds scales into scores and runs the pv
+    # matmul in bf16; the oracle dequantizes rows in f32 first.
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-2, rtol=2e-2
+    )
+
+
+def test_mq_dispatcher_env_gate(monkeypatch):
+    """prefill_attention routes small-S shapes through the mq kernel only
+    under XLLM_MQ_ATTENTION_KERNEL=1, and the result matches blockwise.
+    D must satisfy the D % 128 == 0 gate or the branch is never taken."""
+    from xllm_service_tpu.ops.attention import prefill_attention
+
+    rng = np.random.default_rng(7)
+    _, k, v, bt, seq_lens = make_case(rng, D=128)
+    R, MB = bt.shape
+    q = jnp.asarray(rng.standard_normal((R, 4, 8, 128)), jnp.float32)
+    seq_lens = jnp.minimum(seq_lens, MB * 16 - 4)
+    start_pos = jnp.maximum(seq_lens - 1, 0)
+    true_len = jnp.where(seq_lens > 0, 4, 0)
+    scale = 1.0 / np.sqrt(128)
+    ref = prefill_attention(
+        q, k, v, bt, start_pos, true_len, scale, use_kernel=False
+    )
+    # Prove the mq branch actually runs: count entries into the kernel
+    # (the dispatcher imports it at call time, so the spy is seen).
+    calls = []
+    from xllm_service_tpu.ops.pallas import paged_attention as pa_mod
+
+    orig = pa_mod.multiquery_paged_attention_kernel
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(
+        pa_mod, "multiquery_paged_attention_kernel", spy
+    )
+    monkeypatch.setenv("XLLM_MQ_ATTENTION_KERNEL", "1")
+    out = prefill_attention(
+        q, k, v, bt, start_pos, true_len, scale, interpret=True
+    )
+    assert calls, "mq kernel branch was not taken"
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_mq_decode_kernel_table_edge_clamp():
+    """true_len < S at the end of a sequence: the chunk walk must clamp to
+    the table width (no out-of-bounds block-table reads), and rows below
+    true_len stay exact — rows past it are garbage the sampler never emits."""
+    from xllm_service_tpu.ops.pallas.paged_attention import (
+        multiquery_paged_attention_kernel,
+    )
+
+    rng = np.random.default_rng(11)
+    S = 4
+    _, k, v, bt, _ = make_case(rng, R=2, MB=4, BS=16)
+    q = jnp.asarray(rng.standard_normal((2, S, 8, 64)), jnp.float32)
+    # seq 0 sits at the last table row: context for row 0 is the full
+    # table; rows 1..3 would walk past it without the clamp.
+    seq_lens = jnp.asarray([4 * 16, 30], jnp.int32)
+    out = np.asarray(
+        multiquery_paged_attention_kernel(
+            q, k, v, bt, seq_lens, 0.125, interpret=True
+        )
+    )
+    ref = np.asarray(_mq_oracle(q, k, v, bt, seq_lens, S, 0.125))
+    # seq 0: only row 0 is a real query (true_len = 1 at max_seq_len).
+    np.testing.assert_allclose(out[0, :1], ref[0, :1], atol=2e-5, rtol=2e-5)
+    # seq 1 is far from the edge: all rows exact.
+    np.testing.assert_allclose(out[1], ref[1], atol=2e-5, rtol=2e-5)

@@ -346,6 +346,24 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
     # HTTP surface
     # ------------------------------------------------------------------ #
 
+    def _spec_metrics(self) -> str:
+        """Speculative-decoding gauges (empty when the engine never ran a
+        verify step — FakeEngine and spec-off instances emit nothing)."""
+        steps = getattr(self.engine, "spec_steps", 0)
+        if not steps:
+            return ""
+        slot_steps = self.engine.spec_slot_steps
+        emitted = self.engine.spec_tokens_emitted
+        rate = emitted / max(slot_steps, 1)
+        return (
+            "# TYPE xllm_engine_spec_verify_steps counter\n"
+            f"xllm_engine_spec_verify_steps {steps}\n"
+            "# TYPE xllm_engine_spec_tokens_emitted counter\n"
+            f"xllm_engine_spec_tokens_emitted {emitted}\n"
+            "# TYPE xllm_engine_spec_tokens_per_slot_step gauge\n"
+            f"xllm_engine_spec_tokens_per_slot_step {rate:.4f}\n"
+        )
+
     def handle_get(self, h: QuietHandler) -> None:
         route = h.route
         if route == "/hello":
@@ -362,6 +380,7 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
                 f"xllm_engine_recent_max_ttft_ms {lat.recent_max_ttft}\n"
                 "# TYPE xllm_engine_recent_max_tbt_ms gauge\n"
                 f"xllm_engine_recent_max_tbt_ms {lat.recent_max_tbt}\n"
+                + self._spec_metrics()
             ).encode()
             h.send_response(200)
             h.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -514,6 +533,15 @@ def main(argv=None) -> None:
         "--compilation-cache-dir", default="",
         help="persistent XLA jit cache (restarts skip the per-shape compiles)",
     )
+    parser.add_argument(
+        "--speculative-tokens", type=int, default=0,
+        help="prompt-lookup speculative decoding: draft k tokens/step and "
+        "verify in one pass (exact; 0 disables)",
+    )
+    parser.add_argument(
+        "--speculative-ngram-max", type=int, default=3,
+        help="longest suffix n-gram the drafter matches",
+    )
     args = parser.parse_args(argv)
     # Restore standard JAX env semantics: some environments force a
     # platform at interpreter start (sitecustomize), overriding
@@ -542,6 +570,8 @@ def main(argv=None) -> None:
         sp_prefill_threshold=args.sp_prefill_threshold,
         max_prefill_tokens=args.max_prefill_tokens,
         compilation_cache_dir=args.compilation_cache_dir,
+        speculative_tokens=args.speculative_tokens,
+        speculative_ngram_max=args.speculative_ngram_max,
     )
     srv = InstanceServer(
         cfg,

@@ -186,6 +186,33 @@ def prefill_attention(
     `interpret` lets CI drive the kernel branch on CPU."""
     import os
 
+    # Speculative-verify shapes (a handful of query rows per sequence):
+    # the multi-query decode kernel streams each KV row ONCE like a decode
+    # step — the flash-prefill kernel would pad S~4 rows to a 128-row
+    # query tile. Opt-in via XLLM_MQ_ATTENTION_KERNEL=1 until validated on
+    # hardware (the same gate the MLA kernels went through;
+    # scripts/validate_kernel_tpu.py carries the mq cases).
+    S = q.shape[1]
+    if use_kernel is None and S <= 8:
+        D = q.shape[-1]
+        BS = kvc.raw(k_cache).shape[-2]
+        kq = isinstance(k_cache, kvc.PagedKV) and k_cache.quantized
+        mq_ok = (
+            (_on_tpu() or interpret)
+            and D % 128 == 0
+            and (not kq or BS % 128 == 0)
+        )
+        if mq_ok and os.environ.get("XLLM_MQ_ATTENTION_KERNEL") == "1":
+            from xllm_service_tpu.ops.pallas.paged_attention import (
+                multiquery_paged_attention_kernel,
+            )
+
+            seq_lens = jnp.where(true_len > 0, start_pos + 1, 0)
+            return multiquery_paged_attention_kernel(
+                q, k_cache, v_cache, block_tables, seq_lens, scale,
+                interpret=interpret,
+            )
+
     env = os.environ.get("XLLM_PREFILL_ATTENTION_KERNEL")
     if use_kernel is None:
         D = q.shape[-1]

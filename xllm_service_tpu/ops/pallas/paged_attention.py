@@ -61,6 +61,8 @@ def _decode_kernel(
     chunk: int,
     scale: float,
     quantized: bool,
+    s_rows: int = 1,
+    gp: int = 0,
 ):
     if quantized:
         ks_hbm, vs_hbm, o_ref, k_buf, v_buf, sems, ks_buf, vs_buf, ssems = rest
@@ -71,7 +73,20 @@ def _decode_kernel(
     h = pl.program_id(1)
     seq_len = seq_lens_ref[r]
     span = chunk * block_size
-    nc = pl.cdiv(seq_len, span)  # chunks to process
+    if s_rows == 1:
+        nc = pl.cdiv(seq_len, span)  # chunks to process
+    else:
+        # Multi-query (speculative verify): query row s attends to context
+        # seq_len + s, so the chunk walk must cover the LAST row's context;
+        # inactive slots (seq_len = 0) still process no chunks. Clamp to
+        # the table width: near max_seq_len the caller may have sized the
+        # table for fewer than S extra rows (true_len < S) — rows past
+        # that bound are garbage the sampler never emits, and walking
+        # beyond the table would read out-of-bounds SMEM block ids.
+        nc = jnp.minimum(
+            jnp.where(seq_len == 0, 0, pl.cdiv(seq_len + s_rows - 1, span)),
+            block_table_ref.shape[1] // chunk,
+        )
 
     def dmas(slot, c_idx, blk):
         off = c_idx * block_size
@@ -150,7 +165,14 @@ def _decode_kernel(
             # the score columns (cheaper than dequantizing the K tile).
             scores = scores * ks_buf[slot].reshape(1, chunk * block_size)
         col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-        scores = jnp.where(c * span + col < seq_len, scores, NEG_INF)
+        if s_rows == 1:
+            valid = c * span + col < seq_len
+        else:
+            # q tile rows are [S, Gp] flattened: row // gp is the query's
+            # offset from the first fed position (causal within the step).
+            row = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+            valid = c * span + col < seq_len + row // gp
+        scores = jnp.where(valid, scores, NEG_INF)
 
         m_cur = jnp.max(scores, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -284,3 +306,106 @@ def paged_attention_kernel(
         interpret=interpret,
     )(*inputs)
     return out[:, :, :G, :].reshape(R, Hq, D)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "interpret", "chunk")
+)
+def multiquery_paged_attention_kernel(
+    q: jnp.ndarray,            # [R, S, Hq, D] — S consecutive query tokens
+    k_cache,                   # [N, Hkv, BS, D] plain array or PagedKV
+    v_cache,
+    block_table: jnp.ndarray,  # [R, MB] int32
+    seq_lens: jnp.ndarray,     # [R] int32 — context INCLUDING the FIRST
+    # query token; row s of a sequence attends to seq_lens + s rows
+    scale: float,
+    interpret: bool = False,
+    chunk: int = 4,
+) -> jnp.ndarray:
+    """Speculative-verify attention: the decode kernel with S query rows
+    per sequence. Same HBM traffic as one decode step (each KV row streams
+    once), S times the MXU work — the shape speculative decoding wants.
+    The S*G query heads of one KV head ride one [S*Gp, D] tile; causal
+    masking within the step is by tile-row // Gp. Returns [R, S, Hq, D]."""
+    from xllm_service_tpu.ops import kv_cache as kvc
+
+    k_cache = kvc.as_paged(k_cache)
+    v_cache = kvc.as_paged(v_cache)
+    quantized = k_cache.quantized
+    k_data, v_data = k_cache.data, v_cache.data
+
+    R, S, Hq, D = q.shape
+    N, Hkv, BS, _ = k_data.shape
+    MB = block_table.shape[1]
+    G = Hq // Hkv
+    Gp = _round_up(G, 8)
+    C = max(1, min(chunk, MB))
+
+    # [R, S, Hkv, G, D] -> [R, Hkv, S, Gp, D] -> [R, Hkv, S*Gp, D]
+    qr = jnp.swapaxes(q.reshape(R, S, Hkv, G, D), 1, 2)
+    if Gp != G:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, 0), (0, Gp - G), (0, 0)))
+    qr = qr.reshape(R, Hkv, S * Gp, D)
+    MBp = _round_up(MB, C)
+    bt = block_table.astype(jnp.int32)
+    if MBp != MB:
+        bt = jnp.pad(bt, ((0, 0), (0, MBp - MB)))
+
+    hbm = pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM)
+    in_specs = [
+        pl.BlockSpec((1, 1, S * Gp, D), lambda r, h, bt, sl: (r, h, 0, 0)),
+        hbm,
+        hbm,
+    ]
+    inputs = [bt, seq_lens.astype(jnp.int32), qr, k_data, v_data]
+    scratch = [
+        pltpu.VMEM((2, C * BS, D), k_data.dtype),
+        pltpu.VMEM((2, C * BS, D), v_data.dtype),
+        pltpu.SemaphoreType.DMA((2, 2, C)),
+    ]
+    kv_bytes_per_row = D * k_data.dtype.itemsize
+    if quantized:
+        in_specs += [hbm, hbm]
+        inputs += [
+            k_cache.scale.reshape(N, Hkv * BS).astype(jnp.float32),
+            v_cache.scale.reshape(N, Hkv * BS).astype(jnp.float32),
+        ]
+        scratch += [
+            pltpu.VMEM((2, C, BS), jnp.float32),
+            pltpu.VMEM((2, C, BS), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2, C)),
+        ]
+        kv_bytes_per_row += 4
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(R, Hkv),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, S * Gp, D), lambda r, h, bt, sl: (r, h, 0, 0)
+        ),
+        scratch_shapes=scratch,
+    )
+    kernel = functools.partial(
+        _decode_kernel, block_size=BS, chunk=C, scale=scale,
+        quantized=quantized, s_rows=S, gp=Gp,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, Hkv, S * Gp, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * R * Hkv * S * Gp * D * MB * BS,
+            bytes_accessed=(
+                R * S * Hq * D * 4 + 2 * R * MB * BS * Hkv * kv_bytes_per_row
+            ),
+            transcendentals=R * Hkv * S * Gp * MB * BS,
+        ),
+        interpret=interpret,
+    )(*inputs)
+    # [R, Hkv, S*Gp, D] -> [R, Hkv, S, Gp, D] -> [R, S, Hq, D]
+    out = out.reshape(R, Hkv, S, Gp, D)[:, :, :, :G, :]
+    return jnp.swapaxes(out, 1, 2).reshape(R, S, Hq, D)

@@ -192,6 +192,12 @@ class InferenceEngine:
         self._tbt_window: Deque[Tuple[float, float]] = collections.deque()
         self._profile_ttft: List[Tuple[int, float]] = []
         self._profile_tpot: List[Tuple[int, int, float]] = []
+        # Speculative-decoding accounting: verify steps run, slot-steps
+        # (active sequences summed over steps), and tokens emitted — the
+        # mean tokens/slot-step is the realized speedup over plain decode.
+        self.spec_steps = 0
+        self.spec_slot_steps = 0
+        self.spec_tokens_emitted = 0
 
     # -------------------------------------------------------------- public
 
@@ -1043,6 +1049,9 @@ class InferenceEngine:
         nactive = int(active.sum())
         total_ctx = int(positions[active].sum()) + nactive
         self._profile_tpot.append((nactive, total_ctx, step_ms))
+        self.spec_steps += 1
+        self.spec_slot_steps += nactive
+        self.spec_tokens_emitted += int(n_emit[active].sum())
 
         produced = 0
         now = time.monotonic()
