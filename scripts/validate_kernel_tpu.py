@@ -149,6 +149,46 @@ def run_mq_case(R, S, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16,
     return err
 
 
+def run_mla_mq_case(R, S, Hq, kvr, dr, BS, MB, ctx, dtype=jnp.bfloat16):
+    """MLA multi-query (speculative verify) kernel vs the blockwise oracle
+    on hardware."""
+    from xllm_service_tpu.ops.attention import mla_prefill_attention
+    from xllm_service_tpu.ops.pallas.mla_attention import (
+        mla_multiquery_attention_kernel,
+    )
+
+    rng = np.random.default_rng(0)
+    C = kvr + dr
+    N = R * MB + 1
+    q = jnp.asarray(rng.standard_normal((R, S, Hq, C)), dtype)
+    cache = jnp.asarray(rng.standard_normal((N, 1, BS, C)), dtype)
+    bt = jnp.asarray(1 + np.arange(R * MB).reshape(R, MB) % (N - 1), jnp.int32)
+    lens = jnp.asarray(
+        np.clip(rng.integers(ctx // 2, ctx + 1, R), 1, MB * BS - S), jnp.int32
+    )
+    scale = C**-0.5
+    start_pos = jnp.maximum(lens - 1, 0)
+    true_len = jnp.full((R,), S, jnp.int32)
+    ker = lambda: mla_multiquery_attention_kernel(
+        q, cache, bt, lens, scale, kvr
+    )
+    orc = lambda: mla_prefill_attention(
+        q, cache, bt, start_pos, true_len, scale, kvr, use_kernel=False
+    )
+    err = float(
+        np.max(np.abs(np.asarray(ker().astype(jnp.float32))
+                      - np.asarray(orc().astype(jnp.float32))))
+    )
+    tk, tg = bench(ker), bench(orc)
+    bw = float(np.sum(np.asarray(lens))) * C * dtype.dtype.itemsize / tk / 1e9
+    print(
+        f"MLA-MQ R={R:3d} S={S} Hq={Hq} kvr={kvr} dr={dr} BS={BS} MB={MB} "
+        f"ctx~{ctx} err={err:.4f} kernel={tk*1e6:8.1f}us "
+        f"blockwise={tg*1e6:8.1f}us speedup={tg/tk:5.2f}x bw={bw:6.1f}GB/s"
+    )
+    return err
+
+
 def run_mla_case(R, Hq, kvr, dr, BS, MB, ctx, dtype=jnp.bfloat16):
     """MLA decode kernel vs the MLA gather oracle on hardware."""
     from xllm_service_tpu.ops.attention import mla_paged_attention_gather
@@ -314,6 +354,8 @@ CASES = [
     ("mq-int8", run_mq_case,
      dict(R=64, S=4, Hq=32, Hkv=8, D=128, BS=128, MB=16, ctx=2048,
           int8=True)),
+    ("mq-mla", run_mla_mq_case,
+     dict(R=32, S=4, Hq=128, kvr=512, dr=64, BS=128, MB=16, ctx=2048)),
     # bf16 decode (re-validated round 2; re-run last)
     ("dec-bf16-prod", run_case,
      dict(R=64, Hq=32, Hkv=8, D=128, BS=128, MB=16, ctx=2048)),

@@ -475,3 +475,89 @@ def test_mq_decode_kernel_table_edge_clamp():
     np.testing.assert_allclose(out[0, :1], ref[0, :1], atol=2e-5, rtol=2e-5)
     # seq 1 is far from the edge: all rows exact.
     np.testing.assert_allclose(out[1], ref[1], atol=2e-5, rtol=2e-5)
+
+
+def _mla_mq_oracle(q, cache, bt, seq_lens, S, scale, kvr):
+    from xllm_service_tpu.ops.attention import mla_prefill_attention
+
+    start_pos = jnp.maximum(seq_lens - 1, 0)
+    true_len = jnp.where(seq_lens > 0, S, 0)
+    return mla_prefill_attention(
+        q, cache, bt, start_pos, true_len, scale, kvr, use_kernel=False
+    )
+
+
+@pytest.mark.parametrize("S", [2, 4])
+def test_mla_mq_kernel_matches_blockwise(S):
+    from xllm_service_tpu.ops.pallas.mla_attention import (
+        mla_multiquery_attention_kernel,
+    )
+
+    rng = np.random.default_rng(0)
+    kvr = 40
+    q4, cache, bt = make_mla_prefill_case(rng, P=3, Lpad=S, C=56, MB=8)
+    R, MB = bt.shape
+    BS = cache.shape[2]
+    seq_lens = jnp.asarray([1, 60, MB * BS - S], jnp.int32)
+    scale = 0.125
+    ref = _mla_mq_oracle(q4, cache, bt, seq_lens, S, scale, kvr)
+    out = mla_multiquery_attention_kernel(
+        q4, cache, bt, seq_lens, scale, kvr, interpret=True
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=3e-5, rtol=3e-5
+    )
+
+
+def test_mla_mq_kernel_inactive_and_clamp():
+    from xllm_service_tpu.ops.pallas.mla_attention import (
+        mla_multiquery_attention_kernel,
+    )
+
+    rng = np.random.default_rng(2)
+    S, kvr = 4, 40
+    q4, cache, bt = make_mla_prefill_case(rng, P=3, Lpad=S, C=56, MB=4)
+    BS = cache.shape[2]
+    # slot 0 inactive; slot 2 at the very end of its table (clamp path)
+    seq_lens = jnp.asarray([0, 17, 4 * BS], jnp.int32)
+    out = np.asarray(
+        mla_multiquery_attention_kernel(
+            q4, cache, bt, seq_lens, 0.125, kvr, interpret=True
+        )
+    )
+    ref = np.asarray(_mla_mq_oracle(q4, cache, bt, seq_lens, S, 0.125, kvr))
+    assert np.all(out[0] == 0)
+    np.testing.assert_allclose(out[1], ref[1], atol=3e-5, rtol=3e-5)
+    # seq 2: only row 0 is real past the table end
+    np.testing.assert_allclose(out[2, :1], ref[2, :1], atol=3e-5, rtol=3e-5)
+
+
+def test_mla_mq_dispatcher_env_gate(monkeypatch):
+    from xllm_service_tpu.ops.attention import mla_prefill_attention
+    from xllm_service_tpu.ops.pallas import mla_attention as mla_mod
+
+    rng = np.random.default_rng(5)
+    S, kvr = 4, 40
+    q4, cache, bt = make_mla_prefill_case(rng, P=2, Lpad=S, C=56, MB=8)
+    seq_lens = jnp.asarray([30, 90], jnp.int32)
+    start_pos = jnp.maximum(seq_lens - 1, 0)
+    true_len = jnp.full((2,), S, jnp.int32)
+    ref = mla_prefill_attention(
+        q4, cache, bt, start_pos, true_len, 0.125, kvr, use_kernel=False
+    )
+    calls = []
+    orig = mla_mod.mla_multiquery_attention_kernel
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(mla_mod, "mla_multiquery_attention_kernel", spy)
+    monkeypatch.setenv("XLLM_MQ_ATTENTION_KERNEL", "1")
+    out = mla_prefill_attention(
+        q4, cache, bt, start_pos, true_len, 0.125, kvr, interpret=True
+    )
+    assert calls, "mla mq kernel branch was not taken"
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=3e-5, rtol=3e-5
+    )

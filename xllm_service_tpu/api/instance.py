@@ -356,10 +356,10 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
         emitted = self.engine.spec_tokens_emitted
         rate = emitted / max(slot_steps, 1)
         return (
-            "# TYPE xllm_engine_spec_verify_steps counter\n"
-            f"xllm_engine_spec_verify_steps {steps}\n"
-            "# TYPE xllm_engine_spec_tokens_emitted counter\n"
-            f"xllm_engine_spec_tokens_emitted {emitted}\n"
+            "# TYPE xllm_engine_spec_verify_steps_total counter\n"
+            f"xllm_engine_spec_verify_steps_total {steps}\n"
+            "# TYPE xllm_engine_spec_tokens_emitted_total counter\n"
+            f"xllm_engine_spec_tokens_emitted_total {emitted}\n"
             "# TYPE xllm_engine_spec_tokens_per_slot_step gauge\n"
             f"xllm_engine_spec_tokens_per_slot_step {rate:.4f}\n"
         )

@@ -186,6 +186,18 @@ def prefill_attention(
     `interpret` lets CI drive the kernel branch on CPU."""
     import os
 
+    # One eligibility predicate for BOTH Pallas paths (flash prefill and
+    # the multi-query verify kernel): D a lane multiple; int8 additionally
+    # needs BS scale rows 128-wide.
+    D = q.shape[-1]
+    BS = kvc.raw(k_cache).shape[-2]
+    kq = isinstance(k_cache, kvc.PagedKV) and k_cache.quantized
+    kernel_ok = (
+        (_on_tpu() or interpret)
+        and D % 128 == 0
+        and (not kq or BS % 128 == 0)
+    )
+
     # Speculative-verify shapes (a handful of query rows per sequence):
     # the multi-query decode kernel streams each KV row ONCE like a decode
     # step — the flash-prefill kernel would pad S~4 rows to a 128-row
@@ -193,36 +205,24 @@ def prefill_attention(
     # hardware (the same gate the MLA kernels went through;
     # scripts/validate_kernel_tpu.py carries the mq cases).
     S = q.shape[1]
-    if use_kernel is None and S <= 8:
-        D = q.shape[-1]
-        BS = kvc.raw(k_cache).shape[-2]
-        kq = isinstance(k_cache, kvc.PagedKV) and k_cache.quantized
-        mq_ok = (
-            (_on_tpu() or interpret)
-            and D % 128 == 0
-            and (not kq or BS % 128 == 0)
+    if (
+        use_kernel is None
+        and S <= 8
+        and kernel_ok
+        and os.environ.get("XLLM_MQ_ATTENTION_KERNEL") == "1"
+    ):
+        from xllm_service_tpu.ops.pallas.paged_attention import (
+            multiquery_paged_attention_kernel,
         )
-        if mq_ok and os.environ.get("XLLM_MQ_ATTENTION_KERNEL") == "1":
-            from xllm_service_tpu.ops.pallas.paged_attention import (
-                multiquery_paged_attention_kernel,
-            )
 
-            seq_lens = jnp.where(true_len > 0, start_pos + 1, 0)
-            return multiquery_paged_attention_kernel(
-                q, k_cache, v_cache, block_tables, seq_lens, scale,
-                interpret=interpret,
-            )
+        seq_lens = jnp.where(true_len > 0, start_pos + 1, 0)
+        return multiquery_paged_attention_kernel(
+            q, k_cache, v_cache, block_tables, seq_lens, scale,
+            interpret=interpret,
+        )
 
     env = os.environ.get("XLLM_PREFILL_ATTENTION_KERNEL")
     if use_kernel is None:
-        D = q.shape[-1]
-        BS = kvc.raw(k_cache).shape[-2]
-        kq = isinstance(k_cache, kvc.PagedKV) and k_cache.quantized
-        kernel_ok = (
-            (_on_tpu() or interpret)
-            and D % 128 == 0
-            and (not kq or BS % 128 == 0)
-        )
         use_kernel = (env != "0") if kernel_ok else (env == "1")
     if use_kernel:
         from xllm_service_tpu.ops.pallas.flash_prefill import (
@@ -324,6 +324,26 @@ def mla_prefill_attention(
     import os
 
     quantized = isinstance(c_cache, kvc.PagedKV) and c_cache.quantized
+    # Speculative-verify shapes: the multi-query MLA decode kernel streams
+    # each latent row once (see the GQA analog in prefill_attention).
+    # Opt-in via XLLM_MQ_ATTENTION_KERNEL=1 until chip-validated.
+    S = q_lat.shape[1]
+    if (
+        use_kernel is None
+        and S <= 8
+        and not quantized
+        and (_on_tpu() or interpret)
+        and os.environ.get("XLLM_MQ_ATTENTION_KERNEL") == "1"
+    ):
+        from xllm_service_tpu.ops.pallas.mla_attention import (
+            mla_multiquery_attention_kernel,
+        )
+
+        seq_lens = jnp.where(true_len > 0, start_pos + 1, 0)
+        return mla_multiquery_attention_kernel(
+            q_lat, kvc.raw(c_cache), block_tables, seq_lens, scale,
+            kv_rank, interpret=interpret,
+        )
     if use_kernel is None:
         env = os.environ.get("XLLM_MLA_PREFILL_KERNEL")
         kernel_ok = (_on_tpu() or interpret) and not quantized

@@ -54,11 +54,22 @@ def _mla_kernel(
     chunk: int,
     scale: float,
     kv_rank: int,
+    s_rows: int = 1,
+    hqp: int = 0,
 ):
     r = pl.program_id(0)
     seq_len = seq_lens_ref[r]
     span = chunk * block_size
-    nc = pl.cdiv(seq_len, span)
+    if s_rows == 1:
+        nc = pl.cdiv(seq_len, span)
+    else:
+        # Multi-query (speculative verify): row s attends to seq_len + s
+        # context rows; clamp to the table width (true_len < S near
+        # max_seq_len) and keep inactive slots at zero chunks.
+        nc = jnp.minimum(
+            jnp.where(seq_len == 0, 0, pl.cdiv(seq_len + s_rows - 1, span)),
+            block_table_ref.shape[1] // chunk,
+        )
 
     def dma(slot, c_idx, blk):
         return pltpu.make_async_copy(
@@ -100,7 +111,12 @@ def _mla_kernel(
             * scale
         )  # [Hqp, CH*BS]
         col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-        scores = jnp.where(c * span + col < seq_len, scores, NEG_INF)
+        if s_rows == 1:
+            valid = c * span + col < seq_len
+        else:
+            row = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+            valid = c * span + col < seq_len + row // hqp
+        scores = jnp.where(valid, scores, NEG_INF)
 
         m_cur = jnp.max(scores, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -186,3 +202,72 @@ def mla_attention_kernel(
         interpret=interpret,
     )(bt, seq_lens.astype(jnp.int32), qr, c_cache)
     return out[:, :Hq, :]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "kv_rank", "interpret", "chunk")
+)
+def mla_multiquery_attention_kernel(
+    q_lat: jnp.ndarray,        # [R, S, Hq, C] — S consecutive query tokens
+    c_cache: jnp.ndarray,      # [N, 1, BS, C] (plain array; int8 not yet)
+    block_table: jnp.ndarray,  # [R, MB] int32
+    seq_lens: jnp.ndarray,     # [R] int32 — context INCLUDING the FIRST
+    # query token; row s attends to seq_lens + s rows
+    scale: float,
+    kv_rank: int,
+    interpret: bool = False,
+    chunk: int = 4,
+) -> jnp.ndarray:
+    """Speculative-verify MLA attention: the decode kernel with S query
+    rows per sequence riding one [S*Hqp, C] tile — same latent-cache HBM
+    traffic as one decode step, S times the MXU work. Causal masking
+    within the step is by tile-row // Hqp. Returns [R, S, Hq, kv_rank]."""
+    R, S, Hq, C = q_lat.shape
+    N, _, BS, _ = c_cache.shape
+    MB = block_table.shape[1]
+    Hqp = _round_up(Hq, 8)
+    CH = max(1, min(chunk, MB))
+
+    qr = q_lat
+    if Hqp != Hq:
+        qr = jnp.pad(qr, ((0, 0), (0, 0), (0, Hqp - Hq), (0, 0)))
+    qr = qr.reshape(R, S * Hqp, C)
+    MBp = _round_up(MB, CH)
+    bt = block_table.astype(jnp.int32)
+    if MBp != MB:
+        bt = jnp.pad(bt, ((0, 0), (0, MBp - MB)))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(R,),
+        in_specs=[
+            pl.BlockSpec((1, S * Hqp, C), lambda r, bt, sl: (r, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, S * Hqp, kv_rank), lambda r, bt, sl: (r, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, CH * BS, C), c_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, CH)),
+        ],
+    )
+    kernel = functools.partial(
+        _mla_kernel, block_size=BS, chunk=CH, scale=scale, kv_rank=kv_rank,
+        s_rows=S, hqp=Hqp,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((R, S * Hqp, kv_rank), q_lat.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * R * S * Hqp * (C + kv_rank) * MB * BS,
+            bytes_accessed=R * MB * BS * C * c_cache.dtype.itemsize,
+            transcendentals=R * S * Hqp * MB * BS,
+        ),
+        interpret=interpret,
+    )(bt, seq_lens.astype(jnp.int32), qr, c_cache)
+    return out.reshape(R, S, Hqp, kv_rank)[:, :, :Hq, :]
