@@ -512,9 +512,9 @@ def main(argv=None) -> None:
         help="int8 halves decode HBM traffic and doubles pool capacity",
     )
     parser.add_argument(
-        "--weight-dtype", default="auto", choices=["auto", "int8"],
-        help="int8 weights: per-out-channel W8 halves weight HBM traffic "
-        "and per-device param residency",
+        "--weight-dtype", default="auto", choices=["auto", "int8", "int4"],
+        help="int8: per-out-channel W8 halves weight HBM traffic and "
+        "per-device param residency; int4: group-wise W4 quarters them",
     )
     parser.add_argument("--dp-size", type=int, default=1)
     parser.add_argument("--tp-size", type=int, default=1)

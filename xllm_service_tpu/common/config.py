@@ -111,7 +111,10 @@ class EngineConfig:
     # "auto" keeps matmul weights in model dtype; "int8" quantizes them
     # per output channel (ops/quant.py) — halves decode's weight HBM
     # traffic and per-device param residency (the 70B-on-v5e lever the
-    # dress rehearsal budgets flag). Llama/Qwen/Mixtral family.
+    # dress rehearsal budgets flag); "int4" packs two weights per byte
+    # with group-wise scales (group 128 along the contracting axis) —
+    # quarter-size weights, the DeepSeek-V3-scale-on-a-pod lever. All
+    # model families.
     weight_dtype: str = "auto"
 
     # Continuous batching.

@@ -1,4 +1,4 @@
-"""Int8 weight quantization (W8): per-output-channel scales.
+"""Int8/int4 weight quantization (W8/W4).
 
 Decode is HBM-bandwidth-bound and the weights dominate its traffic
 (every step streams all params once). Storing matmul weights as int8
@@ -19,6 +19,15 @@ fuses into the consuming matmul's operand read on TPU, so HBM still
 moves int8 bytes. The gather paths (embed/lm_head) are NOT quantized
 (dequant-at-use would materialize the full table per step; their share
 of 70B-class params is ~1.5%).
+
+W4 (`bits=4`): native jnp.int4 leaves (XLA packs two per byte on TPU —
+quarter-size weights) with GROUP-WISE scales along the contracting axis
+(`group` values per scale, default 128) — per-channel symmetric int4
+would be too coarse on real checkpoints. The scale tensor keeps the
+leaf's rank ([..., in/group, out]), so its sharding spec is the weight's
+own spec (a tp-sharded contracting axis shards the group axis
+identically). Falls back to one group (per-channel) when the contracting
+axis is not divisible by `group`.
 """
 
 from __future__ import annotations
@@ -35,22 +44,51 @@ def is_quant(leaf: Any) -> bool:
     return isinstance(leaf, dict) and "q" in leaf and "s" in leaf
 
 
-def quantize_weight(w: jnp.ndarray, dtype=None) -> QuantLeaf:
-    """w [..., in, out] -> {"q": int8 same shape, "s": [..., out]}.
-    Symmetric per-output-channel over the contracting (-2) axis; `dtype`
-    sets the scale dtype (defaults to w's)."""
+def quantize_weight(
+    w: jnp.ndarray, dtype=None, bits: int = 8, group: int = 128
+) -> QuantLeaf:
+    """w [..., in, out] -> {"q": int8|int4 same shape, "s": scales}.
+
+    bits=8: symmetric per-output-channel over the contracting (-2) axis;
+    s is [..., out]. bits=4: symmetric per (group, output-channel) with
+    `group` contracting values per scale; s is [..., in/group, out]
+    (one group when `in` is not divisible). `dtype` sets the scale dtype
+    (defaults to w's)."""
     f = w.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(f), axis=-2)
-    s = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(f / s[..., None, :]), -127, 127).astype(jnp.int8)
-    return {"q": q, "s": s.astype(dtype or w.dtype)}
+    if bits == 8:
+        amax = jnp.max(jnp.abs(f), axis=-2)
+        s = jnp.maximum(amax, 1e-8) / 127.0
+        q = jnp.clip(jnp.round(f / s[..., None, :]), -127, 127).astype(
+            jnp.int8
+        )
+        return {"q": q, "s": s.astype(dtype or w.dtype)}
+    if bits != 4:
+        raise ValueError(f"bits={bits}: expected 8 or 4")
+    In, Out = f.shape[-2], f.shape[-1]
+    g = group if In % group == 0 else In
+    fg = f.reshape(*f.shape[:-2], In // g, g, Out)
+    amax = jnp.max(jnp.abs(fg), axis=-2)  # [..., in/g, out]
+    s = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(fg / s[..., None, :]), -7, 7).astype(jnp.int4)
+    return {
+        "q": q.reshape(f.shape),
+        "s": s.astype(dtype or w.dtype),
+    }
 
 
 def wt(leaf: WeightLike) -> jnp.ndarray:
-    """Weight at a use site: dequantize an int8 leaf (fused into the
+    """Weight at a use site: dequantize an int8/int4 leaf (fused into the
     consuming matmul by XLA), pass plain arrays through."""
     if is_quant(leaf):
-        return leaf["q"].astype(leaf["s"].dtype) * leaf["s"][..., None, :]
+        q, s = leaf["q"], leaf["s"]
+        if q.dtype == jnp.int4:
+            In, Out = q.shape[-2], q.shape[-1]
+            g = In // s.shape[-2]
+            qf = q.astype(s.dtype).reshape(
+                *q.shape[:-2], In // g, g, Out
+            )
+            return (qf * s[..., :, None, :]).reshape(q.shape)
+        return q.astype(s.dtype) * s[..., None, :]
     return leaf
 
 

@@ -173,10 +173,10 @@ class ModelExecutor:
                 f"kv_cache_dtype={engine_cfg.kv_cache_dtype!r}: expected "
                 f"'auto' (model dtype) or 'int8'"
             )
-        if engine_cfg.weight_dtype not in ("auto", "int8"):
+        if engine_cfg.weight_dtype not in ("auto", "int8", "int4"):
             raise ValueError(
                 f"weight_dtype={engine_cfg.weight_dtype!r}: expected "
-                f"'auto' (model dtype) or 'int8'"
+                f"'auto' (model dtype), 'int8', or 'int4'"
             )
         self.kv_quantized = engine_cfg.kv_cache_dtype == "int8"
         self.R = engine_cfg.max_running_requests
@@ -214,8 +214,11 @@ class ModelExecutor:
                     out_shardings=p_shardings,
                 )
                 self.params = init_fn(jax.random.key(init_seed))
-            if engine_cfg.weight_dtype == "int8":
-                self._quantize_weights(p_shardings)
+            if engine_cfg.weight_dtype in ("int8", "int4"):
+                self._quantize_weights(
+                    p_shardings,
+                    bits=4 if engine_cfg.weight_dtype == "int4" else 8,
+                )
 
             # [L, N, Hkv, BS, D]: KV-head-major within a block so the Pallas
             # decode kernel can DMA one (block, head) tile of shape [BS, D]
@@ -308,11 +311,13 @@ class ModelExecutor:
 
     # ----------------------------------------------------------- sizing
 
-    def _quantize_weights(self, p_shardings) -> None:
-        """In-place W8 pass over the stacked matmul leaves (ops/quant.py):
-        each eligible leaf becomes {"q": int8, "s": per-out-channel
-        scale}, sharded like the original (the scale drops the contracted
-        -2 axis from the spec). Leaf-by-leaf with donation so peak HBM
+    def _quantize_weights(self, p_shardings, bits: int = 8) -> None:
+        """In-place W8/W4 pass over the stacked matmul leaves
+        (ops/quant.py): each eligible leaf becomes {"q": int8|int4, "s":
+        scales}, sharded like the original. W8 scales drop the contracted
+        -2 axis from the spec; W4 group scales keep the leaf's rank (the
+        group axis aligns with the contracting axis), so they reuse the
+        weight's own sharding. Leaf-by-leaf with donation so peak HBM
         never holds two full copies."""
         from jax.sharding import NamedSharding, PartitionSpec
         from xllm_service_tpu.ops import quant
@@ -320,7 +325,7 @@ class ModelExecutor:
         names = getattr(self.model_mod, "QUANTIZABLE_WEIGHT_LEAVES", ())
         if not names:
             raise ValueError(
-                f"weight_dtype=int8: model family "
+                f"weight_dtype=int{bits}: model family "
                 f"{self.model_mod.__name__} has no quantizable-leaf map"
             )
         for stack in ("layers", "dense_layers"):
@@ -334,11 +339,31 @@ class ModelExecutor:
                 spec = list(sh.spec) + [None] * (
                     leaf.ndim - len(sh.spec)
                 )
-                s_sh = NamedSharding(
-                    sh.mesh, PartitionSpec(*(spec[:-2] + spec[-1:]))
-                )
+                group = 128
+                if bits == 4:
+                    # W4 group scales keep the leaf's rank, so they reuse
+                    # the weight's own sharding — but a tp-sharded
+                    # contracting axis must split into whole scale groups
+                    # on every shard: use the largest divisor <= 128 of
+                    # the per-shard dim (never one giant group, which
+                    # would silently coarsen quantization).
+                    s_sh = sh
+                    tp_ax = spec[-2]
+                    shards = (
+                        self.mesh.shape.get(tp_ax, 1) if tp_ax else 1
+                    )
+                    per_shard = leaf.shape[-2] // shards
+                    group = min(per_shard, 128)
+                    while per_shard % group:
+                        group -= 1
+                else:
+                    s_sh = NamedSharding(
+                        sh.mesh, PartitionSpec(*(spec[:-2] + spec[-1:]))
+                    )
                 qfn = jax.jit(
-                    lambda w: quant.quantize_weight(w, self.dtype),
+                    lambda w, g=group: quant.quantize_weight(
+                        w, self.dtype, bits=bits, group=g
+                    ),
                     out_shardings={"q": sh, "s": s_sh},
                     donate_argnums=(0,),
                 )
@@ -355,9 +380,12 @@ class ModelExecutor:
         # 1 byte + per-out-channel scales; embed/lm_head/norms stay full
         # precision — ~1.15 bytes/param blended), while the KV element
         # size tracks kv_cache_dtype below.
-        param_bytes = (
-            1.15 if self.engine_cfg.weight_dtype == "int8" else dtype_bytes
-        )
+        param_bytes = {
+            "int8": 1.15,
+            # int4 packs two weights per byte; scales (1/group) + the
+            # unquantized embed/lm_head/norm share blend to ~0.65.
+            "int4": 0.65,
+        }.get(self.engine_cfg.weight_dtype, dtype_bytes)
         n_params = approx_param_count(cfg)
         try:
             stats = jax.devices()[0].memory_stats() or {}
