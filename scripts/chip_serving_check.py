@@ -1,0 +1,154 @@
+"""On-chip serving-layout check (VERDICT r2 item 8): prove the executor's
+HBM auto-sizing (`_decide_num_blocks`, hbm_utilization-driven) on real
+full-size weights, then measure serving decode throughput through the
+ENGINE path (continuous batching, not the bench's raw on-device scan).
+
+Run on a real TPU:  python scripts/chip_serving_check.py [--model llama3-3b]
+
+Prints one JSON line:
+  {"model": ..., "weight_dtype": ..., "num_blocks": N, "pool_gib": ...,
+   "params_gib": ..., "hbm_limit_gib": ..., "decode_tok_s": ...,
+   "spec_tok_s": ...}
+
+The weights are random-init at the REAL model size (no checkpoints ship
+with this environment), which is what the sizing math cares about —
+param residency and pool headroom are shape-, not value-, dependent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="llama3-3b")
+    ap.add_argument("--weight-dtype", default="int8")
+    ap.add_argument("--kv-cache-dtype", default="int8")
+    ap.add_argument("--steps", type=int, default=64)
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--prompt-len", type=int, default=256)
+    ap.add_argument("--allow-cpu", action="store_true",
+                    help="smoke-test the harness on the CPU backend")
+    ap.add_argument("--num-blocks", type=int, default=0,
+                    help="override pool size (CPU smoke: the auto-sizer "
+                    "reads host RAM as HBM and allocates a huge pool)")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.allow_cpu:
+        # must happen BEFORE any backend touch — probing a wedged tunnel
+        # backend hangs the process
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        assert jax.default_backend() == "tpu", "run this on the chip"
+
+    from xllm_service_tpu.common.config import EngineConfig
+    from xllm_service_tpu.ops.sampling import SamplingParams
+    from xllm_service_tpu.runtime.engine import (
+        EngineRequest, InferenceEngine,
+    )
+    from xllm_service_tpu.runtime.executor import ModelExecutor
+
+    cfg = EngineConfig(
+        model=args.model,
+        max_running_requests=args.requests,
+        max_seq_len=2048,
+        num_blocks=args.num_blocks,  # 0 = auto-size from real HBM headroom
+        hbm_utilization=0.85,
+        block_size=128,
+        kv_cache_dtype=args.kv_cache_dtype,
+        weight_dtype=args.weight_dtype,
+        compilation_cache_dir="/tmp/xllm-jit-cache",
+    )
+    t0 = time.time()
+    ex = ModelExecutor(cfg)
+    stats = jax.devices()[0].memory_stats() or {}
+    limit = stats.get("bytes_limit", 0)
+    in_use = stats.get("bytes_in_use", 0)
+    from xllm_service_tpu.ops import kv_cache as kvc
+
+    def nbytes(x):
+        return sum(
+            getattr(leaf, "nbytes", 0) for leaf in jax.tree.leaves(x)
+        )
+
+    pool_bytes = nbytes(ex.k_cache) + nbytes(ex.v_cache)
+    params_bytes = nbytes(ex.params)
+    print(
+        f"# built in {time.time()-t0:.0f}s: num_blocks={ex.num_blocks} "
+        f"params={params_bytes/2**30:.2f}GiB pool={pool_bytes/2**30:.2f}GiB "
+        f"in_use={in_use/2**30:.2f}GiB limit={limit/2**30:.2f}GiB",
+        flush=True,
+    )
+    if not args.num_blocks:
+        assert ex.num_blocks > 16, "auto-sizing collapsed to the floor"
+    if limit:
+        assert in_use <= limit, "over HBM limit"
+
+    def serve(spec: int) -> float:
+        """Engine-path decode throughput: fill all slots, run the engine
+        loop, count generated tokens / wall time (excludes prefill)."""
+        scfg = EngineConfig(**{**cfg.__dict__, "speculative_tokens": spec})
+        eng = InferenceEngine(scfg, executor=ex)
+        done = []
+        rng = np.random.default_rng(0)
+        # Repetitive prompts so the speculative pass has accept fodder.
+        base = rng.integers(0, ex.cfg.vocab_size, (32,)).astype(int)
+        prompt = list(base) * (args.prompt_len // 32)
+        for i in range(args.requests):
+            eng.add_request(EngineRequest(
+                f"r{i}", list(prompt),
+                SamplingParams(temperature=0.0,
+                               max_new_tokens=args.steps,
+                               ignore_eos=True),
+                lambda out, i=i: (done.append(i) if out.finished else None)
+                or True,
+            ))
+        # admit + prefill
+        while len(eng._running) < args.requests:
+            eng.step()
+        eng.step()  # compile the decode/verify shape outside the timing
+        t0 = time.perf_counter()
+        produced = 0
+        while eng.has_work() and produced < args.requests * args.steps:
+            produced += eng.step()
+        dt = time.perf_counter() - t0
+        tok_s = produced / dt
+        if spec:
+            print(
+                f"# spec accept: {eng.spec_tokens_emitted} tokens / "
+                f"{eng.spec_slot_steps} slot-steps",
+                flush=True,
+            )
+        return tok_s
+
+    decode_tok_s = serve(0)
+    spec_tok_s = serve(3)
+
+    # NOTE: through the axon dev tunnel every engine.step() pays ~100s of
+    # ms of dispatch latency, so absolute tok/s here is tunnel-bound; the
+    # spec/plain RATIO still reflects tokens-per-step amortization, and
+    # the sizing numbers are exact. Production hosts dispatch in us.
+    print(json.dumps({
+        "model": args.model,
+        "dispatch": "tunnel" if jax.default_backend() == "tpu" else "cpu",
+        "weight_dtype": args.weight_dtype,
+        "kv_cache_dtype": args.kv_cache_dtype,
+        "num_blocks": ex.num_blocks,
+        "params_gib": round(params_bytes / 2**30, 2),
+        "pool_gib": round(pool_bytes / 2**30, 2),
+        "hbm_limit_gib": round(limit / 2**30, 2),
+        "decode_tok_s": round(decode_tok_s, 1),
+        "spec_tok_s": round(spec_tok_s, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

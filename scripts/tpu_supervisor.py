@@ -11,6 +11,8 @@ land anyway:
   * retry wedged cases (up to MAX_TRIES) after the tunnel answers again;
   * when every case is done (or exhausted), run bench.py on the chip and
     store its JSON line;
+  * after the bench, run scripts/chip_serving_check.py (HBM auto-sizing
+    on real-size weights + engine-path serving) and store its JSON line;
   * append everything to OUTDIR so a later shell can harvest results.
 
 Run:  nohup python scripts/tpu_supervisor.py > /tmp/tpu_supervisor.log 2>&1 &
@@ -136,12 +138,39 @@ def run_bench() -> bool:
     return ok
 
 
+def run_serving_check() -> bool:
+    log("serving check: start")
+    try:
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts/chip_serving_check.py")],
+            env=ENV, capture_output=True, text=True,
+            timeout=BENCH_TIMEOUT, cwd=REPO)
+    except subprocess.TimeoutExpired:
+        log("serving check: TIMEOUT")
+        return False
+    line = ""
+    for ln in r.stdout.splitlines():
+        if ln.startswith("{"):
+            line = ln
+    with open(os.path.join(OUTDIR, "serving_raw.log"), "a") as f:
+        f.write(r.stdout + "\n--- stderr ---\n" + r.stderr[-4000:] + "\n")
+    if r.returncode != 0 or not line:
+        log(f"serving check: FAIL rc={r.returncode}")
+        return False
+    with open(os.path.join(OUTDIR, "serving.json"), "w") as f:
+        f.write(line + "\n")
+    log(f"serving check: OK {line}")
+    return True
+
+
 def main() -> None:
     os.makedirs(OUTDIR, exist_ok=True)
     cases = case_list()
     log(f"{len(cases)} validation cases queued")
     tries = {i: 0 for i, _, _ in cases}
     bench_tries = 0
+    serving_tries = 0
     healthy = True  # probe only after a failure — cases carry own timeouts
     while True:
         pending = [(i, n, p) for i, n, p in cases
@@ -149,7 +178,12 @@ def main() -> None:
                        os.path.join(OUTDIR, f"done_{n}.txt"))
                    and tries[i] < MAX_TRIES]
         bench_done = os.path.exists(os.path.join(OUTDIR, "bench.json"))
+        serving_done = os.path.exists(os.path.join(OUTDIR, "serving.json"))
         if not pending and (bench_done or bench_tries >= MAX_TRIES * 2):
+            if not serving_done and serving_tries < MAX_TRIES:
+                serving_tries += 1
+                healthy = run_serving_check()
+                continue
             log("all work done (or exhausted); exiting")
             return
         if not healthy:
