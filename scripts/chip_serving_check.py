@@ -72,7 +72,6 @@ def main() -> None:
     stats = jax.devices()[0].memory_stats() or {}
     limit = stats.get("bytes_limit", 0)
     in_use = stats.get("bytes_in_use", 0)
-    from xllm_service_tpu.ops import kv_cache as kvc
 
     def nbytes(x):
         return sum(
@@ -95,7 +94,9 @@ def main() -> None:
     def serve(spec: int) -> float:
         """Engine-path decode throughput: fill all slots, run the engine
         loop, count generated tokens / wall time (excludes prefill)."""
-        scfg = EngineConfig(**{**cfg.__dict__, "speculative_tokens": spec})
+        import dataclasses
+
+        scfg = dataclasses.replace(cfg, speculative_tokens=spec)
         eng = InferenceEngine(scfg, executor=ex)
         done = []
         rng = np.random.default_rng(0)
@@ -111,9 +112,12 @@ def main() -> None:
                 lambda out, i=i: (done.append(i) if out.finished else None)
                 or True,
             ))
-        # admit + prefill
-        while len(eng._running) < args.requests:
+        # admit + prefill; stop early if the pool can't hold every
+        # request concurrently (rejected/preempted requests must not
+        # spin this loop forever)
+        while eng.has_work() and len(eng._running) < args.requests:
             eng.step()
+        assert eng._running, "no requests admitted"
         eng.step()  # compile the decode/verify shape outside the timing
         t0 = time.perf_counter()
         produced = 0

@@ -105,62 +105,61 @@ def run_case(i: int, name: str) -> bool:
     return ok
 
 
-def run_bench() -> bool:
-    log("bench.py: start")
+def _run_json_step(label, argv, raw_log, require_tpu):
+    """Run a JSON-line-emitting step in a timeout-guarded subprocess.
+    Returns the parsed record (None on any failure)."""
+    log(f"{label}: start")
     try:
-        r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
-                           env=ENV, capture_output=True, text=True,
+        r = subprocess.run(argv, env=ENV, capture_output=True, text=True,
                            timeout=BENCH_TIMEOUT, cwd=REPO)
     except subprocess.TimeoutExpired:
-        log("bench.py: TIMEOUT")
-        return False
+        log(f"{label}: TIMEOUT")
+        return None
     line = ""
     for ln in r.stdout.splitlines():
         if ln.startswith("{"):
             line = ln
-    with open(os.path.join(OUTDIR, "bench_raw.log"), "a") as f:
+    with open(os.path.join(OUTDIR, raw_log), "a") as f:
         f.write(r.stdout + "\n--- stderr ---\n" + r.stderr[-4000:] + "\n")
     if not line:
-        log(f"bench.py: no JSON line (rc={r.returncode})")
-        return False
+        log(f"{label}: no JSON line (rc={r.returncode})")
+        return None
     try:
         rec = json.loads(line)
     except ValueError:
-        log(f"bench.py: unparseable JSON line: {line[:200]}")
+        log(f"{label}: unparseable JSON line: {line[:200]}")
+        return None
+    if require_tpu and rec.get("backend") != "tpu":
+        # Record the CPU-fallback line separately; the step is retried.
+        with open(os.path.join(OUTDIR, "bench_cpu_fallback.json"), "w") as f:
+            f.write(line + "\n")
+        log(f"{label}: landed but not tpu {line}")
+        return None
+    rec["_line"] = line
+    log(f"{label}: OK {line}")
+    return rec
+
+
+def run_bench() -> bool:
+    rec = _run_json_step(
+        "bench.py", [sys.executable, os.path.join(REPO, "bench.py")],
+        "bench_raw.log", require_tpu=True)
+    if rec is None:
         return False
-    ok = rec.get("backend") == "tpu"
-    # Only a real on-chip number marks the bench done; a CPU-fallback line
-    # is recorded separately and the TPU bench is retried.
-    dest = "bench.json" if ok else "bench_cpu_fallback.json"
-    with open(os.path.join(OUTDIR, dest), "w") as f:
-        f.write(line + "\n")
-    log(f"bench.py: {'OK' if ok else 'landed but not tpu'} {line}")
-    return ok
+    with open(os.path.join(OUTDIR, "bench.json"), "w") as f:
+        f.write(rec["_line"] + "\n")
+    return True
 
 
 def run_serving_check() -> bool:
-    log("serving check: start")
-    try:
-        r = subprocess.run(
-            [sys.executable,
-             os.path.join(REPO, "scripts/chip_serving_check.py")],
-            env=ENV, capture_output=True, text=True,
-            timeout=BENCH_TIMEOUT, cwd=REPO)
-    except subprocess.TimeoutExpired:
-        log("serving check: TIMEOUT")
-        return False
-    line = ""
-    for ln in r.stdout.splitlines():
-        if ln.startswith("{"):
-            line = ln
-    with open(os.path.join(OUTDIR, "serving_raw.log"), "a") as f:
-        f.write(r.stdout + "\n--- stderr ---\n" + r.stderr[-4000:] + "\n")
-    if r.returncode != 0 or not line:
-        log(f"serving check: FAIL rc={r.returncode}")
+    rec = _run_json_step(
+        "serving check",
+        [sys.executable, os.path.join(REPO, "scripts/chip_serving_check.py")],
+        "serving_raw.log", require_tpu=False)
+    if rec is None:
         return False
     with open(os.path.join(OUTDIR, "serving.json"), "w") as f:
-        f.write(line + "\n")
-    log(f"serving check: OK {line}")
+        f.write(rec["_line"] + "\n")
     return True
 
 
@@ -179,11 +178,9 @@ def main() -> None:
                    and tries[i] < MAX_TRIES]
         bench_done = os.path.exists(os.path.join(OUTDIR, "bench.json"))
         serving_done = os.path.exists(os.path.join(OUTDIR, "serving.json"))
-        if not pending and (bench_done or bench_tries >= MAX_TRIES * 2):
-            if not serving_done and serving_tries < MAX_TRIES:
-                serving_tries += 1
-                healthy = run_serving_check()
-                continue
+        bench_settled = bench_done or bench_tries >= MAX_TRIES * 2
+        serving_settled = serving_done or serving_tries >= MAX_TRIES
+        if not pending and bench_settled and serving_settled:
             log("all work done (or exhausted); exiting")
             return
         if not healthy:
@@ -193,6 +190,10 @@ def main() -> None:
                 continue
             log("tunnel healthy again")
             healthy = True
+        if not pending and bench_settled and not serving_settled:
+            serving_tries += 1
+            healthy = run_serving_check()
+            continue
         # Bench first once the high-priority cases (the never-validated
         # kernels) are done — the flagship number outranks tail re-validation.
         prio_pending = [c for c in pending if c[2]]
