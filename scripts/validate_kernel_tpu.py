@@ -149,7 +149,8 @@ def run_mq_case(R, S, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16,
     return err
 
 
-def run_mla_mq_case(R, S, Hq, kvr, dr, BS, MB, ctx, dtype=jnp.bfloat16):
+def run_mla_mq_case(R, S, Hq, kvr, dr, BS, MB, ctx, dtype=jnp.bfloat16,
+                    int8=False):
     """MLA multi-query (speculative verify) kernel vs the blockwise oracle
     on hardware."""
     from xllm_service_tpu.ops.attention import mla_prefill_attention
@@ -162,6 +163,12 @@ def run_mla_mq_case(R, S, Hq, kvr, dr, BS, MB, ctx, dtype=jnp.bfloat16):
     N = R * MB + 1
     q = jnp.asarray(rng.standard_normal((R, S, Hq, C)), dtype)
     cache = jnp.asarray(rng.standard_normal((N, 1, BS, C)), dtype)
+    G = 1
+    if int8:
+        from xllm_service_tpu.ops import kv_cache as kvc
+
+        G = kvc.mla_scale_groups(kvr, dr)
+        cache = kvc.PagedKV(*kvc.quantize_rows(cache, G))
     bt = jnp.asarray(1 + np.arange(R * MB).reshape(R, MB) % (N - 1), jnp.int32)
     lens = jnp.asarray(
         np.clip(rng.integers(ctx // 2, ctx + 1, R), 1, MB * BS - S), jnp.int32
@@ -180,16 +187,19 @@ def run_mla_mq_case(R, S, Hq, kvr, dr, BS, MB, ctx, dtype=jnp.bfloat16):
                       - np.asarray(orc().astype(jnp.float32))))
     )
     tk, tg = bench(ker), bench(orc)
-    bw = float(np.sum(np.asarray(lens))) * C * dtype.dtype.itemsize / tk / 1e9
+    row_bytes = C + 4 * G if int8 else C * dtype.dtype.itemsize
+    bw = float(np.sum(np.asarray(lens))) * row_bytes / tk / 1e9
     print(
         f"MLA-MQ R={R:3d} S={S} Hq={Hq} kvr={kvr} dr={dr} BS={BS} MB={MB} "
-        f"ctx~{ctx} err={err:.4f} kernel={tk*1e6:8.1f}us "
+        f"ctx~{ctx} {'int8' if int8 else 'bf16'} err={err:.4f} "
+        f"kernel={tk*1e6:8.1f}us "
         f"blockwise={tg*1e6:8.1f}us speedup={tg/tk:5.2f}x bw={bw:6.1f}GB/s"
     )
     return err
 
 
-def run_mla_case(R, Hq, kvr, dr, BS, MB, ctx, dtype=jnp.bfloat16):
+def run_mla_case(R, Hq, kvr, dr, BS, MB, ctx, dtype=jnp.bfloat16,
+                 int8=False):
     """MLA decode kernel vs the MLA gather oracle on hardware."""
     from xllm_service_tpu.ops.attention import mla_paged_attention_gather
     from xllm_service_tpu.ops.pallas.mla_attention import mla_attention_kernel
@@ -199,6 +209,12 @@ def run_mla_case(R, Hq, kvr, dr, BS, MB, ctx, dtype=jnp.bfloat16):
     N = R * MB + 1
     q = jnp.asarray(rng.standard_normal((R, Hq, C)), dtype)
     cache = jnp.asarray(rng.standard_normal((N, 1, BS, C)), dtype)
+    G = 1
+    if int8:
+        from xllm_service_tpu.ops import kv_cache as kvc
+
+        G = kvc.mla_scale_groups(kvr, dr)
+        cache = kvc.PagedKV(*kvc.quantize_rows(cache, G))
     bt = jnp.asarray(1 + np.arange(R * MB).reshape(R, MB) % (N - 1), jnp.int32)
     lens = jnp.asarray(
         np.clip(rng.integers(ctx // 2, ctx + 1, R), 1, MB * BS), jnp.int32
@@ -211,9 +227,11 @@ def run_mla_case(R, Hq, kvr, dr, BS, MB, ctx, dtype=jnp.bfloat16):
                       - np.asarray(gat().astype(jnp.float32))))
     )
     tk, tg = bench(ker), bench(gat)
-    bw = float(np.sum(np.asarray(lens))) * C * dtype.dtype.itemsize / tk / 1e9
+    row_bytes = C + 4 * G if int8 else C * dtype.dtype.itemsize
+    bw = float(np.sum(np.asarray(lens))) * row_bytes / tk / 1e9
     print(
         f"MLA R={R:3d} Hq={Hq} kvr={kvr} dr={dr} BS={BS} MB={MB} ctx~{ctx} "
+        f"{'int8' if int8 else 'bf16'} "
         f"err={err:.4f} kernel={tk*1e6:8.1f}us gather={tg*1e6:8.1f}us "
         f"speedup={tg/tk:5.2f}x bw={bw:6.1f}GB/s"
     )
@@ -356,6 +374,14 @@ CASES = [
           int8=True)),
     ("mq-mla", run_mla_mq_case,
      dict(R=32, S=4, Hq=128, kvr=512, dr=64, BS=128, MB=16, ctx=2048)),
+    # int8 latent caches through the MLA kernels (VMEM dequant via the
+    # scale-expansion matmul)
+    ("mla-dec-int8", run_mla_case,
+     dict(R=32, Hq=128, kvr=512, dr=64, BS=128, MB=16, ctx=2048,
+          int8=True)),
+    ("mq-mla-int8", run_mla_mq_case,
+     dict(R=32, S=4, Hq=128, kvr=512, dr=64, BS=128, MB=16, ctx=2048,
+          int8=True)),
     # bf16 decode (re-validated round 2; re-run last)
     ("dec-bf16-prod", run_case,
      dict(R=64, Hq=32, Hkv=8, D=128, BS=128, MB=16, ctx=2048)),

@@ -281,22 +281,21 @@ def mla_paged_attention(
     """Decode MLA attention; Pallas kernel on TPU (opt-in via
     XLLM_MLA_ATTENTION_KERNEL=1 until validated on hardware — the GQA
     kernel went through the same gate in round 1), gather elsewhere.
-    Quantized latent caches ALWAYS use the gather path (there is no int8
-    MLA kernel yet — an explicit use_kernel=True must not matmul raw int8
-    data as values); `interpret` lets CI drive the kernel branch on CPU."""
+    Int8 latent caches ride the kernel too (sub-channel scales stream in
+    a separate plane and dequantize in VMEM); `interpret` lets CI drive
+    the kernel branch on CPU."""
     import os
 
-    quantized = isinstance(c_cache, kvc.PagedKV) and c_cache.quantized
     if use_kernel is None:
         env = os.environ.get("XLLM_MLA_ATTENTION_KERNEL")
         use_kernel = env == "1" and (_on_tpu() or interpret)
-    if use_kernel and not quantized:
+    if use_kernel:
         from xllm_service_tpu.ops.pallas.mla_attention import (
             mla_attention_kernel,
         )
 
         return mla_attention_kernel(
-            q_lat, kvc.raw(c_cache), block_table, seq_lens, scale, kv_rank,
+            q_lat, c_cache, block_table, seq_lens, scale, kv_rank,
             interpret=interpret,
         )
     return mla_paged_attention_gather(
@@ -317,21 +316,22 @@ def mla_prefill_attention(
 ) -> jnp.ndarray:
     """Batched MLA chunked-prefill attention; Pallas flash kernel
     (ops/pallas/mla_prefill.py) on TPU, vmapped blockwise scan elsewhere.
-    Quantized latent caches ALWAYS use the blockwise path (no int8 MLA
-    kernel — same policy as mla_paged_attention);
-    XLLM_MLA_PREFILL_KERNEL=0/1 forces the path, `interpret` drives the
-    kernel branch in CI."""
+    Quantized latent caches take the blockwise path for the FLASH kernel
+    (mla_flash_prefill_kernel has no int8 plane yet) but DO ride the
+    multi-query verify kernel below, which dequantizes in VMEM;
+    XLLM_MLA_PREFILL_KERNEL=0/1 forces the flash path, `interpret` drives
+    the kernel branches in CI."""
     import os
 
     quantized = isinstance(c_cache, kvc.PagedKV) and c_cache.quantized
     # Speculative-verify shapes: the multi-query MLA decode kernel streams
-    # each latent row once (see the GQA analog in prefill_attention).
+    # each latent row once (see the GQA analog in prefill_attention);
+    # int8 latent caches dequantize in VMEM inside the kernel.
     # Opt-in via XLLM_MQ_ATTENTION_KERNEL=1 until chip-validated.
     S = q_lat.shape[1]
     if (
         use_kernel is None
         and S <= 8
-        and not quantized
         and (_on_tpu() or interpret)
         and os.environ.get("XLLM_MQ_ATTENTION_KERNEL") == "1"
     ):
@@ -341,7 +341,7 @@ def mla_prefill_attention(
 
         seq_lens = jnp.where(true_len > 0, start_pos + 1, 0)
         return mla_multiquery_attention_kernel(
-            q_lat, kvc.raw(c_cache), block_tables, seq_lens, scale,
+            q_lat, c_cache, block_tables, seq_lens, scale,
             kv_rank, interpret=interpret,
         )
     if use_kernel is None:

@@ -43,20 +43,28 @@ def _mla_kernel(
     seq_lens_ref,     # [R] SMEM
     # inputs
     q_ref,            # [1, Hqp, C] VMEM
-    c_hbm,            # [N, 1, BS, C] HBM
+    c_hbm,            # [N, 1, BS, C] HBM — bf16 or int8
+    *rest,            # quantized: cs_hbm [N, BS*G] f32, then
     # output
-    o_ref,            # [1, Hqp, KVR] VMEM
+    #   o_ref         # [1, Hqp, KVR] VMEM
     # scratch
-    c_buf,            # [2, CH*BS, C] VMEM
-    sems,             # [2, CH] DMA semaphores
-    *,
+    #   c_buf         # [2, CH*BS, C] VMEM (cache dtype)
+    #   sems          # [2, CH] DMA semaphores
+    #   (quantized)   s_buf [2, CH, BS*G] f32 + ssems [2, CH]
     block_size: int,
     chunk: int,
     scale: float,
     kv_rank: int,
     s_rows: int = 1,
     hqp: int = 0,
+    quantized: bool = False,
+    scale_groups: int = 1,
 ):
+    if quantized:
+        cs_hbm, o_ref, c_buf, sems, s_buf, ssems = rest
+    else:
+        o_ref, c_buf, sems = rest
+        cs_hbm = s_buf = ssems = None
     r = pl.program_id(0)
     seq_len = seq_lens_ref[r]
     span = chunk * block_size
@@ -71,20 +79,33 @@ def _mla_kernel(
             block_table_ref.shape[1] // chunk,
         )
 
-    def dma(slot, c_idx, blk):
-        return pltpu.make_async_copy(
-            c_hbm.at[blk, 0],
-            c_buf.at[slot, pl.ds(c_idx * block_size, block_size)],
-            sems.at[slot, c_idx],
-        )
+    def dmas(slot, c_idx, blk):
+        out = [
+            pltpu.make_async_copy(
+                c_hbm.at[blk, 0],
+                c_buf.at[slot, pl.ds(c_idx * block_size, block_size)],
+                sems.at[slot, c_idx],
+            )
+        ]
+        if quantized:
+            out.append(
+                pltpu.make_async_copy(
+                    cs_hbm.at[blk],
+                    s_buf.at[slot, c_idx],
+                    ssems.at[slot, c_idx],
+                )
+            )
+        return out
 
     def start_chunk(slot, c):
         for c_idx in range(chunk):
-            dma(slot, c_idx, block_table_ref[r, c * chunk + c_idx]).start()
+            for d in dmas(slot, c_idx, block_table_ref[r, c * chunk + c_idx]):
+                d.start()
 
     def wait_chunk(slot, c):
         for c_idx in range(chunk):
-            dma(slot, c_idx, block_table_ref[r, c * chunk + c_idx]).wait()
+            for d in dmas(slot, c_idx, block_table_ref[r, c * chunk + c_idx]):
+                d.wait()
 
     @pl.when(nc > 0)
     def _first():
@@ -102,6 +123,28 @@ def _mla_kernel(
 
         wait_chunk(slot, c)
         tile = c_buf[slot]  # [CH*BS, C]
+        if quantized:
+            # Dequantize in VMEM: per-(row, group) scales expand to the C
+            # lanes via a constant 0/1 matmul (E[g, c] = 1 iff c's group
+            # is g) — no lane reshapes, which Mosaic dislikes. HBM still
+            # moved int8 bytes; this is VPU/MXU work on resident data.
+            C = tile.shape[-1]
+            gsz = C // scale_groups
+            E = (
+                jax.lax.broadcasted_iota(
+                    jnp.int32, (scale_groups, C), 1
+                ) // gsz
+                == jax.lax.broadcasted_iota(
+                    jnp.int32, (scale_groups, C), 0
+                )
+            ).astype(jnp.float32)
+            sc = s_buf[slot].reshape(chunk * block_size, scale_groups)
+            s_exp = jax.lax.dot_general(
+                sc, E,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [CH*BS, C]
+            tile = (tile.astype(jnp.float32) * s_exp).astype(jnp.bfloat16)
         scores = (
             jax.lax.dot_general(
                 q, tile,
@@ -144,12 +187,29 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _mla_common(c_cache):
+    """Split a plain-or-PagedKV latent cache into (data, flat scales,
+    groups); scales flatten to [N, BS*G] so each block's DMA slice is a
+    contiguous lane row (the same trick as the GQA kernel's scale plane)."""
+    from xllm_service_tpu.ops import kv_cache as kvc
+
+    c_cache = kvc.as_paged(c_cache)
+    data = c_cache.data
+    if not c_cache.quantized:
+        return data, None, 1
+    N, _, BS, C = data.shape
+    sc = c_cache.scale  # [N, 1, BS, G]
+    G = sc.shape[-1] if sc.ndim == data.ndim else 1
+    flat = sc.reshape(N, BS * G).astype(jnp.float32)
+    return data, flat, G
+
+
 @functools.partial(
     jax.jit, static_argnames=("scale", "kv_rank", "interpret", "chunk")
 )
 def mla_attention_kernel(
     q_lat: jnp.ndarray,        # [R, Hq, C]
-    c_cache: jnp.ndarray,      # [N, 1, BS, C] (plain array; int8 not yet)
+    c_cache,                   # [N, 1, BS, C] plain array or PagedKV
     block_table: jnp.ndarray,  # [R, MB] int32
     seq_lens: jnp.ndarray,     # [R] int32
     scale: float,
@@ -157,8 +217,10 @@ def mla_attention_kernel(
     interpret: bool = False,
     chunk: int = 4,
 ) -> jnp.ndarray:
+    data, scales, G = _mla_common(c_cache)
+    quantized = scales is not None
     R, Hq, C = q_lat.shape
-    N, _, BS, _ = c_cache.shape
+    N, _, BS, _ = data.shape
     MB = block_table.shape[1]
     Hqp = _round_up(Hq, 8)
     CH = max(1, min(chunk, MB))
@@ -171,21 +233,35 @@ def mla_attention_kernel(
     if MBp != MB:
         bt = jnp.pad(bt, ((0, 0), (0, MBp - MB)))
 
+    hbm = pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM)
+    in_specs = [
+        pl.BlockSpec((1, Hqp, C), lambda r, bt, sl: (r, 0, 0)),
+        hbm,
+    ]
+    inputs = [bt, seq_lens.astype(jnp.int32), qr, data]
+    scratch = [
+        pltpu.VMEM((2, CH * BS, C), data.dtype),
+        pltpu.SemaphoreType.DMA((2, CH)),
+    ]
+    row_bytes = C * data.dtype.itemsize
+    if quantized:
+        in_specs.append(hbm)
+        inputs.append(scales)
+        scratch += [
+            pltpu.VMEM((2, CH, BS * G), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, CH)),
+        ]
+        row_bytes += 4 * G
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(R,),
-        in_specs=[
-            pl.BlockSpec((1, Hqp, C), lambda r, bt, sl: (r, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, Hqp, kv_rank), lambda r, bt, sl: (r, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, CH * BS, C), c_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, CH)),
-        ],
+        scratch_shapes=scratch,
     )
     kernel = functools.partial(
-        _mla_kernel, block_size=BS, chunk=CH, scale=scale, kv_rank=kv_rank
+        _mla_kernel, block_size=BS, chunk=CH, scale=scale, kv_rank=kv_rank,
+        quantized=quantized, scale_groups=G,
     )
     out = pl.pallas_call(
         kernel,
@@ -196,11 +272,11 @@ def mla_attention_kernel(
         ),
         cost_estimate=pl.CostEstimate(
             flops=2 * R * Hqp * C * MB * BS + 2 * R * Hqp * kv_rank * MB * BS,
-            bytes_accessed=R * MB * BS * C * c_cache.dtype.itemsize,
+            bytes_accessed=R * MB * BS * row_bytes,
             transcendentals=R * Hqp * MB * BS,
         ),
         interpret=interpret,
-    )(bt, seq_lens.astype(jnp.int32), qr, c_cache)
+    )(*inputs)
     return out[:, :Hq, :]
 
 
@@ -209,7 +285,7 @@ def mla_attention_kernel(
 )
 def mla_multiquery_attention_kernel(
     q_lat: jnp.ndarray,        # [R, S, Hq, C] — S consecutive query tokens
-    c_cache: jnp.ndarray,      # [N, 1, BS, C] (plain array; int8 not yet)
+    c_cache,                   # [N, 1, BS, C] plain array or PagedKV
     block_table: jnp.ndarray,  # [R, MB] int32
     seq_lens: jnp.ndarray,     # [R] int32 — context INCLUDING the FIRST
     # query token; row s attends to seq_lens + s rows
@@ -222,8 +298,10 @@ def mla_multiquery_attention_kernel(
     rows per sequence riding one [S*Hqp, C] tile — same latent-cache HBM
     traffic as one decode step, S times the MXU work. Causal masking
     within the step is by tile-row // Hqp. Returns [R, S, Hq, kv_rank]."""
+    data, scales, G = _mla_common(c_cache)
+    quantized = scales is not None
     R, S, Hq, C = q_lat.shape
-    N, _, BS, _ = c_cache.shape
+    N, _, BS, _ = data.shape
     MB = block_table.shape[1]
     Hqp = _round_up(Hq, 8)
     CH = max(1, min(chunk, MB))
@@ -237,24 +315,37 @@ def mla_multiquery_attention_kernel(
     if MBp != MB:
         bt = jnp.pad(bt, ((0, 0), (0, MBp - MB)))
 
+    hbm = pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM)
+    in_specs = [
+        pl.BlockSpec((1, S * Hqp, C), lambda r, bt, sl: (r, 0, 0)),
+        hbm,
+    ]
+    inputs = [bt, seq_lens.astype(jnp.int32), qr, data]
+    scratch = [
+        pltpu.VMEM((2, CH * BS, C), data.dtype),
+        pltpu.SemaphoreType.DMA((2, CH)),
+    ]
+    row_bytes = C * data.dtype.itemsize
+    if quantized:
+        in_specs.append(hbm)
+        inputs.append(scales)
+        scratch += [
+            pltpu.VMEM((2, CH, BS * G), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, CH)),
+        ]
+        row_bytes += 4 * G
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(R,),
-        in_specs=[
-            pl.BlockSpec((1, S * Hqp, C), lambda r, bt, sl: (r, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, S * Hqp, kv_rank), lambda r, bt, sl: (r, 0, 0)
         ),
-        scratch_shapes=[
-            pltpu.VMEM((2, CH * BS, C), c_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, CH)),
-        ],
+        scratch_shapes=scratch,
     )
     kernel = functools.partial(
         _mla_kernel, block_size=BS, chunk=CH, scale=scale, kv_rank=kv_rank,
-        s_rows=S, hqp=Hqp,
+        s_rows=S, hqp=Hqp, quantized=quantized, scale_groups=G,
     )
     out = pl.pallas_call(
         kernel,
@@ -265,9 +356,9 @@ def mla_multiquery_attention_kernel(
         ),
         cost_estimate=pl.CostEstimate(
             flops=2 * R * S * Hqp * (C + kv_rank) * MB * BS,
-            bytes_accessed=R * MB * BS * C * c_cache.dtype.itemsize,
+            bytes_accessed=R * MB * BS * row_bytes,
             transcendentals=R * S * Hqp * MB * BS,
         ),
         interpret=interpret,
-    )(bt, seq_lens.astype(jnp.int32), qr, c_cache)
+    )(*inputs)
     return out.reshape(R, S, Hqp, kv_rank)[:, :, :Hq, :]
