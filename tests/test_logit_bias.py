@@ -1,0 +1,172 @@
+"""OpenAI logit_bias end to end: sparse per-request biases applied to the
+logits before filtering/sampling on every path — prefill-sampled token,
+plain decode, and the speculative verify scan."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from xllm_service_tpu.common.config import EngineConfig
+from xllm_service_tpu.ops import sampling as sampling_ops
+from xllm_service_tpu.ops.sampling import SamplingParams
+from xllm_service_tpu.runtime.engine import EngineRequest, InferenceEngine
+from xllm_service_tpu.runtime.executor import ModelExecutor
+
+from tests.test_speculative import Collector, _cfg, _run, REPEAT_PROMPT
+
+
+def test_sample_tokens_bias_bans_and_forces():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.standard_normal((2, 32)), jnp.float32)
+    argmax0 = int(jnp.argmax(logits[0]))
+    target1 = 7
+    K = 2
+    bias_ids = np.zeros((2, K), np.int32)
+    bias_vals = np.zeros((2, K), np.float32)
+    bias_ids[0, 0] = argmax0
+    bias_vals[0, 0] = -100.0  # ban row 0's natural argmax
+    bias_ids[1, 0] = target1
+    bias_vals[1, 0] = 100.0  # force token 7 on row 1
+    keys = sampling_ops.make_step_keys(
+        jnp.zeros((2,), jnp.uint32), jnp.zeros((2,), jnp.int32)
+    )
+    toks, lps, _ = sampling_ops.sample_tokens(
+        logits,
+        jnp.zeros((2,), jnp.float32),  # greedy
+        jnp.zeros((2,), jnp.int32),
+        jnp.ones((2,), jnp.float32),
+        keys,
+        bias_ids=jnp.asarray(bias_ids),
+        bias_vals=jnp.asarray(bias_vals),
+    )
+    assert int(toks[0]) != argmax0
+    assert int(toks[1]) == target1
+    # reported logprob reflects the BIASED distribution
+    assert float(lps[1]) > -1e-2
+
+
+@pytest.mark.parametrize("spec", [0, 3], ids=["plain", "speculative"])
+def test_engine_bias_forces_token(spec):
+    """+100 bias on one token makes greedy decode emit only that token,
+    through both the plain and the speculative engine paths (including
+    the prefill-sampled first token)."""
+    forced = 123
+    cfg = _cfg(spec)
+    eng = InferenceEngine(cfg, executor=ModelExecutor(cfg))
+    c = Collector()
+    eng.add_request(
+        EngineRequest(
+            "r", list(REPEAT_PROMPT),
+            SamplingParams(
+                temperature=0.0, max_new_tokens=6,
+                logit_bias=((forced, 100.0),),
+            ),
+            c,
+        )
+    )
+    for _ in range(30):
+        if not eng.has_work():
+            break
+        eng.step()
+    assert c.done
+    assert c.tokens == [forced] * 6
+
+
+def test_engine_bias_ban_and_spec_parity():
+    """-100 ban on the natural continuation: banned token never appears,
+    and the speculative engine matches the plain engine token for token."""
+    base = _run(
+        InferenceEngine(_cfg(0), executor=ModelExecutor(_cfg(0))),
+        [("r", REPEAT_PROMPT,
+          SamplingParams(temperature=0.0, max_new_tokens=8))],
+    )
+    banned = base[0].tokens[0]
+    sp = SamplingParams(
+        temperature=0.7, seed=11, max_new_tokens=10,
+        logit_bias=((banned, -100.0),),
+    )
+    plain = _run(
+        InferenceEngine(_cfg(0), executor=ModelExecutor(_cfg(0))),
+        [("r", REPEAT_PROMPT, sp)],
+    )
+    fast = _run(
+        InferenceEngine(_cfg(3), executor=ModelExecutor(_cfg(3))),
+        [("r", REPEAT_PROMPT, sp)],
+    )
+    assert banned not in plain[0].tokens
+    assert fast[0].tokens == plain[0].tokens
+
+
+def test_api_parse_and_service_e2e():
+    """/v1/completions with logit_bias: parse validation + the bias
+    actually steering the served tokens."""
+    from xllm_service_tpu.api.protocol import sampling_from_body
+
+    cfg = EngineConfig()
+    sp = sampling_from_body(
+        {"logit_bias": {"5": 50, "9": -101.5}, "temperature": 0.0}, cfg
+    )
+    assert sp.logit_bias == ((5, 50.0), (9, -100.0))
+    with pytest.raises(ValueError):
+        sampling_from_body({"logit_bias": {"-3": 1}}, cfg)
+    with pytest.raises(ValueError):
+        sampling_from_body({"logit_bias": [5, 1]}, cfg)
+    with pytest.raises(ValueError):
+        sampling_from_body(
+            {"logit_bias": {str(i): 1 for i in range(301)}}, cfg
+        )
+
+
+def test_service_stack_bias_and_error_relay():
+    """Through the real HTTP stack: logit_bias steers the served text, and
+    an invalid bias comes back as a 400 (the master relays the instance's
+    4xx instead of masking it as a 503 service failure)."""
+    from xllm_service_tpu.api import Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+    from tests.test_api_e2e import http_post, wait_until
+
+    store = MemoryStore(clock=lambda: 0.0)
+    scfg = ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0, block_size=16,
+    )
+    master = Master(scfg, store=store)
+    master.start()
+    ecfg = EngineConfig(
+        model="llama3-tiny", dtype="float32", block_size=16, num_blocks=64,
+        max_running_requests=4, max_seq_len=256,
+        prefill_buckets=[32, 64, 128],
+        instance_name="lb0", instance_type="MIX",
+    )
+    inst = InstanceServer(
+        ecfg, master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.2
+    )
+    inst.start()
+    try:
+        assert wait_until(
+            lambda: sum(master.scheduler.instance_mgr.counts()) == 1
+        )
+        code, body = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "llama3-tiny", "prompt": "steer me", "max_tokens": 4,
+             "temperature": 0.0, "logit_bias": {"90": 100}},
+            timeout=300.0,
+        )
+        assert code == 200, body
+        text = body["choices"][0]["text"]
+        assert len(set(text)) == 1, text  # the forced token, repeated
+
+        code, body = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "llama3-tiny", "prompt": "x", "max_tokens": 2,
+             "logit_bias": {"-1": 5}},
+            timeout=60.0,
+        )
+        assert code == 400, (code, body)
+        assert "non-negative" in body["error"]["message"]
+    finally:
+        inst.stop()
+        master.stop()
+        store.close()

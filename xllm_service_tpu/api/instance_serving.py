@@ -230,6 +230,10 @@ class ServingMixin:
                 return n, best_of, "best_of is not supported with streaming"
         return n, best_of, ""
 
+    def _vocab_size(self):
+        ex = getattr(self.engine, "executor", None)
+        return getattr(getattr(ex, "cfg", None), "vocab_size", None)
+
     @staticmethod
     def _child_sampling(sampling: SamplingParams, i: int, need_logprobs: bool):
         """Per-sequence sampling params: distinct RNG stream per choice
@@ -257,7 +261,13 @@ class ServingMixin:
         if n_err:
             h.send_error_json(400, n_err)
             return
-        sampling = sampling_from_body(body, self.cfg)
+        try:
+            sampling = sampling_from_body(
+                body, self.cfg, vocab_size=self._vocab_size()
+            )
+        except ValueError as e:
+            h.send_error_json(400, str(e))
+            return
 
         if srid and self._master is not None and (n > 1 or best_of > 1):
             # Fan-out mode: PD split is skipped for multi-sequence requests

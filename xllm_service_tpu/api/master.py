@@ -535,10 +535,20 @@ class Master:
             try:
                 code, resp = post_json(meta.http_address, path, fwd, timeout=30.0)
                 if code != 200:
+                    # A 4xx from the instance is the CLIENT's error
+                    # (e.g. invalid logit_bias) — relay it as such
+                    # instead of masking it as a service failure.
+                    msg = resp
+                    if isinstance(resp, dict):
+                        msg = (resp.get("error") or {}).get(
+                            "message", resp
+                        )
                     self.scheduler.fail_request(
                         req.service_request_id,
-                        StatusCode.UNAVAILABLE,
-                        f"prefill rejected: {resp}",
+                        StatusCode.INVALID_ARGUMENT
+                        if 400 <= code < 500
+                        else StatusCode.UNAVAILABLE,
+                        f"prefill rejected: {msg}",
                     )
             except Exception as e:
                 # Fast failure (connection refused / timeout): try another

@@ -231,9 +231,10 @@ def augment_forwarded_request(
     return fwd
 
 
-def sampling_from_body(body, cfg):
+def sampling_from_body(body, cfg, vocab_size=None):
     """OpenAI request body -> SamplingParams (forwarded and direct
-    traffic share it; cfg supplies the max-new-tokens default). Unseeded
+    traffic share it; cfg supplies the max-new-tokens default; pass
+    vocab_size to reject out-of-vocabulary logit_bias ids). Unseeded
     sampling draws a fresh per-request seed — only an explicit client
     seed (0 included) gives the deterministic stream."""
     import os
@@ -251,6 +252,27 @@ def sampling_from_body(body, cfg):
         if raw_seed is not None
         else int.from_bytes(os.urandom(4), "little")
     )
+    raw_bias = body.get("logit_bias")
+    if raw_bias is None:
+        raw_bias = {}
+    if not isinstance(raw_bias, dict):
+        raise ValueError("logit_bias must be an object of token_id: bias")
+    if len(raw_bias) > 300:
+        raise ValueError("logit_bias supports at most 300 entries")
+    try:
+        # OpenAI clamps biases to [-100, 100]
+        logit_bias = tuple(
+            (int(k), max(-100.0, min(100.0, float(v))))
+            for k, v in raw_bias.items()
+        )
+    except (TypeError, ValueError):
+        raise ValueError("logit_bias must map token ids to numbers")
+    if any(t < 0 for t, _ in logit_bias):
+        raise ValueError("logit_bias token ids must be non-negative")
+    if vocab_size and any(t >= vocab_size for t, _ in logit_bias):
+        raise ValueError(
+            f"logit_bias token ids must be < vocab size {vocab_size}"
+        )
     return SamplingParams(
         temperature=float(body.get("temperature", 1.0)),
         top_p=float(body.get("top_p", 1.0)),
@@ -262,4 +284,5 @@ def sampling_from_body(body, cfg):
         ignore_eos=bool(body.get("ignore_eos", False)),
         presence_penalty=float(body.get("presence_penalty", 0.0) or 0.0),
         frequency_penalty=float(body.get("frequency_penalty", 0.0) or 0.0),
+        logit_bias=logit_bias,
     )

@@ -36,6 +36,10 @@ class SamplingParams:
     # already-sampled token's logit; frequency subtracts per occurrence.
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
+    # OpenAI logit_bias: ((token_id, bias), ...) pairs added to the
+    # token's logit before filtering/sampling; bias in [-100, 100]
+    # (-100 effectively bans, +100 effectively forces).
+    logit_bias: tuple = ()
 
     @property
     def greedy(self) -> bool:
@@ -98,9 +102,20 @@ def sample_tokens(
     counts: jnp.ndarray | None = None,  # [R, V] int32 generated-token counts
     presence: jnp.ndarray | None = None,  # [R] float32
     frequency: jnp.ndarray | None = None,  # [R] float32
+    bias_ids: jnp.ndarray | None = None,  # [R, K] int32 (pad: id 0, bias 0)
+    bias_vals: jnp.ndarray | None = None,  # [R, K] float32
 ):
     """Returns (token_ids [R], logprob_of_chosen [R], logprobs [R, V])."""
     logits = logits.astype(jnp.float32)
+    if bias_ids is not None and bias_vals is not None:
+        # OpenAI logit_bias: sparse per-request add BEFORE penalties /
+        # filtering / softmax, so greedy, sampling, and reported logprobs
+        # all see the biased distribution. Padding rows carry (0, 0.0) —
+        # adding zero to token 0 is a no-op.
+        R = logits.shape[0]
+        logits = logits.at[
+            jnp.arange(R, dtype=jnp.int32)[:, None], bias_ids
+        ].add(bias_vals)
     if counts is not None and presence is not None and frequency is not None:
         logits = apply_penalties(logits, counts, presence, frequency)
     logprobs_full = jax.nn.log_softmax(logits, axis=-1)
@@ -147,6 +162,8 @@ def speculative_sample(
     counts: jnp.ndarray | None = None,  # [R, V] int32 (donated by caller)
     presence: jnp.ndarray | None = None,  # [R]
     frequency: jnp.ndarray | None = None,  # [R]
+    bias_ids: jnp.ndarray | None = None,  # [R, K]
+    bias_vals: jnp.ndarray | None = None,  # [R, K]
 ):
     """Speculative acceptance for point-mass (n-gram / prompt-lookup) drafts.
 
@@ -187,6 +204,7 @@ def speculative_sample(
             lg, temperature, top_k, top_p, keys_j,
             counts=cnts if have_counts else None,
             presence=presence, frequency=frequency,
+            bias_ids=bias_ids, bias_vals=bias_vals,
         )
         emit = going & (j < limits)
         if have_counts:
@@ -206,6 +224,27 @@ def speculative_sample(
     )
     n_emit = jnp.sum(emits.astype(jnp.int32), axis=0)  # [R]
     return toks.T, lps.T, n_emit, counts
+
+
+def pack_logit_bias(rows, n_rows: int):
+    """Pack per-row ((token_id, bias), ...) tuples into the sparse
+    [n_rows, K] (ids, vals) arrays sample_tokens takes; K is pow2-bucketed
+    to bound compile count, padding entries are (0, 0.0) — adding zero to
+    token 0 is a no-op. Returns (None, None) when no row has bias."""
+    import numpy as np
+
+    if not any(rows):
+        return None, None
+    K = 1
+    while K < max(len(r) for r in rows if r):
+        K *= 2
+    ids = np.zeros((n_rows, K), np.int32)
+    vals = np.zeros((n_rows, K), np.float32)
+    for i, r in enumerate(rows):
+        for j, (tid, bv) in enumerate(r[:K] if r else ()):
+            ids[i, j] = tid
+            vals[i, j] = bv
+    return ids, vals
 
 
 def make_step_keys(base_seeds: jnp.ndarray, steps: jnp.ndarray) -> jnp.ndarray:

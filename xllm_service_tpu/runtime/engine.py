@@ -524,6 +524,14 @@ class InferenceEngine:
                     presence=getattr(s, "presence_penalty", 0.0),
                     frequency=getattr(s, "frequency_penalty", 0.0),
                     # Only the FINAL chunk's sampled token survives, so
+                    # intermediate chunks skip the bias (and its compiled
+                    # variant), like prior_tokens below.
+                    logit_bias=(
+                        tuple(getattr(s, "logit_bias", ()) or ())
+                        if start + n >= len(seq.tokens)
+                        else ()
+                    ),
+                    # Only the FINAL chunk's sampled token survives, so
                     # intermediate chunks skip the [P, V] histogram (and
                     # the penalized compiled variant) entirely.
                     prior_tokens=(
@@ -919,6 +927,7 @@ class InferenceEngine:
         presence = np.zeros((self.R,), np.float32)
         frequency = np.zeros((self.R,), np.float32)
         self._block_tables[:] = 0
+        bias_rows = [()] * self.R
         for slot, seq in self._running.items():
             n = len(seq.block_ids)
             self._block_tables[slot, :n] = seq.block_ids
@@ -930,8 +939,13 @@ class InferenceEngine:
             steps[slot] = len(seq.generated)
             presence[slot] = getattr(s, "presence_penalty", 0.0)
             frequency[slot] = getattr(s, "frequency_penalty", 0.0)
+            bias_rows[slot] = tuple(getattr(s, "logit_bias", ()) or ())
+        from xllm_service_tpu.ops.sampling import pack_logit_bias
+
+        bias_ids, bias_vals = pack_logit_bias(bias_rows, self.R)
         return SamplingBatch(
-            temps, top_ks, top_ps, seeds, steps, presence, frequency
+            temps, top_ks, top_ps, seeds, steps, presence, frequency,
+            bias_ids, bias_vals,
         )
 
     def _decode_once(self) -> int:

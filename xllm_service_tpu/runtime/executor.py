@@ -59,6 +59,10 @@ class SamplingBatch:
     # OpenAI penalties over generated tokens; None = all zeros (no penalty).
     presence: Optional[np.ndarray] = None  # [R] float32
     frequency: Optional[np.ndarray] = None  # [R] float32
+    # OpenAI logit_bias, sparse: ids [R, K] int32 + vals [R, K] float32
+    # (padding entries (0, 0.0)); None = no bias anywhere in the batch.
+    bias_ids: Optional[np.ndarray] = None
+    bias_vals: Optional[np.ndarray] = None
 
 
 @dataclass
@@ -82,6 +86,9 @@ class PrefillItem:
     presence: float = 0.0
     frequency: float = 0.0
     prior_tokens: Optional[np.ndarray] = None
+    # OpenAI logit_bias pairs ((token_id, bias), ...) for the token
+    # sampled at (re)admission.
+    logit_bias: tuple = ()
 
 
 _COMPILATION_CACHE_DIR: Optional[str] = None
@@ -458,6 +465,8 @@ class ModelExecutor:
         step_keys,
         presence,
         frequency,
+        bias_ids=None,
+        bias_vals=None,
         use_kernel=None,
     ):
         logits, k_cache, v_cache = self.model_mod.decode_step(
@@ -474,6 +483,7 @@ class ModelExecutor:
         tokens, logprob, _ = sampling_ops.sample_tokens(
             logits, temperature, top_k, top_p, step_keys,
             counts=counts, presence=presence, frequency=frequency,
+            bias_ids=bias_ids, bias_vals=bias_vals,
         )
         counts = counts.at[
             jnp.arange(tokens.shape[0]), tokens
@@ -498,6 +508,8 @@ class ModelExecutor:
         counts=None,  # [P, V] prior-token histogram (penalized items only)
         presence=None,  # [P]
         frequency=None,  # [P]
+        bias_ids=None,  # [P, K]
+        bias_vals=None,  # [P, K]
     ):
         logits, k_cache, v_cache = self.model_mod.prefill_batch_step(
             params, self.cfg, k_cache, v_cache, token_ids, start_pos,
@@ -512,6 +524,7 @@ class ModelExecutor:
         tokens, logprob, _ = sampling_ops.sample_tokens(
             logits, temperature, top_k, top_p, step_keys,
             counts=counts, presence=presence, frequency=frequency,
+            bias_ids=bias_ids, bias_vals=bias_vals,
         )
         return k_cache, v_cache, tokens, logprob
 
@@ -532,6 +545,8 @@ class ModelExecutor:
         active,  # [R] bool
         presence,
         frequency,
+        bias_ids=None,
+        bias_vals=None,
     ):
         """Speculative-decoding verify step: one forward pass over S
         positions per sequence (the prefill machinery with `all_logits`),
@@ -548,6 +563,7 @@ class ModelExecutor:
             logits, drafts, temperature, top_k, top_p, step_keys,
             limits=true_len, active=active,
             counts=counts, presence=presence, frequency=frequency,
+            bias_ids=bias_ids, bias_vals=bias_vals,
         )
         return k_cache, v_cache, counts, tokens, logprobs, n_emit
 
@@ -594,6 +610,12 @@ class ModelExecutor:
         zeros = np.zeros((R,), np.float32)
         presence = batch.presence if batch.presence is not None else zeros
         frequency = batch.frequency if batch.frequency is not None else zeros
+        bias_kwargs = {}
+        if batch.bias_ids is not None:
+            bias_kwargs = dict(
+                bias_ids=jnp.asarray(batch.bias_ids, jnp.int32),
+                bias_vals=jnp.asarray(batch.bias_vals, jnp.float32),
+            )
         (
             self.k_cache, self.v_cache, self.token_counts,
             tokens, logprobs, n_emit,
@@ -613,6 +635,7 @@ class ModelExecutor:
             jnp.asarray(active),
             jnp.asarray(presence, jnp.float32),
             jnp.asarray(frequency, jnp.float32),
+            **bias_kwargs,
         )
         return np.asarray(tokens), np.asarray(logprobs), np.asarray(n_emit)
 
@@ -727,6 +750,13 @@ class ModelExecutor:
         # shipping it would cost a [P, V] transfer + an unwarmed compile
         # per shape.
         pen_kwargs = {}
+        b_ids, b_vals = sampling_ops.pack_logit_bias(
+            [it.logit_bias for it in group], P
+        )
+        if b_ids is not None:
+            pen_kwargs.update(
+                bias_ids=jnp.asarray(b_ids), bias_vals=jnp.asarray(b_vals)
+            )
         if any(
             it.prior_tokens is not None and len(it.prior_tokens)
             for it in group
@@ -741,7 +771,7 @@ class ModelExecutor:
                     np.add.at(
                         cnts[i], np.asarray(it.prior_tokens, np.int64), 1
                     )
-            pen_kwargs = dict(
+            pen_kwargs.update(
                 counts=jnp.asarray(cnts),
                 presence=jnp.asarray(pres),
                 frequency=jnp.asarray(freq),
@@ -1010,6 +1040,12 @@ class ModelExecutor:
         zeros = np.zeros((R,), np.float32)
         presence = batch.presence if batch.presence is not None else zeros
         frequency = batch.frequency if batch.frequency is not None else zeros
+        bias_kwargs = {}
+        if batch.bias_ids is not None:
+            bias_kwargs = dict(
+                bias_ids=jnp.asarray(batch.bias_ids, jnp.int32),
+                bias_vals=jnp.asarray(batch.bias_vals, jnp.float32),
+            )
         (
             self.k_cache, self.v_cache, self.token_counts, tokens, logprobs,
         ) = self._decode_jit(
@@ -1028,6 +1064,7 @@ class ModelExecutor:
             jnp.asarray(presence, jnp.float32),
             jnp.asarray(frequency, jnp.float32),
             use_kernel=use_kernel,
+            **bias_kwargs,
         )
         return np.asarray(tokens), np.asarray(logprobs)
 
