@@ -1,0 +1,410 @@
+"""Guided decoding: the byte-level JSON automaton and its abstract
+token-mask table."""
+
+import json
+
+import numpy as np
+import pytest
+
+from xllm_service_tpu.guided import json_fsm as J
+
+
+def accepts(text: str, top_object: bool = True) -> bool:
+    st = J.advance_bytes(
+        J.initial_state(), text.encode(), top_object=top_object
+    )
+    return J.is_complete(st)
+
+
+VALID_OBJECTS = [
+    '{}',
+    '{"a": 1}',
+    '{"a": -0.5e+3, "b": [1, 2, {"c": null}], "d": "x\\n\\"y\\u00e9"}',
+    '{"nested": {"deep": [[], {}, [true, false]]}}',
+    ' { "ws" : [ 1 , 2 ] } ',
+    '{"empty": [], "eo": {}}',
+    '{"num": 0, "n2": 0.5, "n3": 10e2, "n4": -0}',
+]
+
+INVALID = [
+    '',
+    '  {"a": 1}',    # ws runs cap at ONE byte (budget-exhaustion guard)
+    '{"a":  1}',
+    '[1, 2]',        # top level must be an object in json_object mode
+    '"str"',
+    '{',
+    '{"a"}',
+    '{"a": }',
+    '{"a": 1,}',     # trailing comma
+    '{"a": 1 "b": 2}',
+    '{"a": 01}',     # leading zero
+    '{"a": +1}',
+    '{"a": 1.}',
+    '{"a": .5}',
+    '{"a": tru}',
+    '{"a": truee}',
+    '{"a": "\\x"}',  # bad escape
+    '{"a": [1,]}',
+    '{"a": 1}}',
+    '{"a": "unterminated',
+    "{'a': 1}",      # single quotes
+    '{"a": nan}',
+]
+
+
+@pytest.mark.parametrize("text", VALID_OBJECTS)
+def test_accepts_valid(text):
+    json.loads(text)  # sanity: Python agrees it's valid
+    assert accepts(text)
+
+
+@pytest.mark.parametrize("text", INVALID)
+def test_rejects_invalid(text):
+    assert not accepts(text)
+
+
+def test_top_object_false_accepts_bare_values():
+    for text in ['[1, 2]', '"s"', '42', 'true', 'null', '-1.5e-3']:
+        json.loads(text)
+        assert accepts(text, top_object=False), text
+    assert not accepts('1 2', top_object=False)
+
+
+def test_random_generated_json_roundtrip():
+    """Randomly built JSON objects all pass; random mutations that break
+    json.loads are (almost always) rejected — and every FSM-accepted
+    string MUST parse."""
+    rng = np.random.default_rng(0)
+
+    def rand_value(depth):
+        kind = rng.integers(0, 6 if depth < 3 else 4)
+        if kind == 0:
+            return rng.integers(-1000, 1000) * (0.5 ** int(rng.integers(0, 3)))
+        if kind == 1:
+            return rng.choice([True, False, None])
+        if kind == 2:
+            chars = 'abc XYZ0"\\\n\té'
+            n = int(rng.integers(0, 8))
+            return ''.join(rng.choice(list(chars)) for _ in range(n))
+        if kind == 3:
+            return int(rng.integers(-10, 10))
+        if kind == 4:
+            return [rand_value(depth + 1) for _ in range(rng.integers(0, 4))]
+        return {
+            f"k{i}": rand_value(depth + 1)
+            for i in range(rng.integers(0, 4))
+        }
+
+    for _ in range(60):
+        obj = {f"k{i}": rand_value(0) for i in range(rng.integers(0, 5))}
+        text = json.dumps(obj)
+        assert accepts(text), text
+
+    # FSM-accepted => json.loads parses (soundness, the property that
+    # actually matters for the product)
+    for _ in range(200):
+        obj = {"k": rand_value(0)}
+        text = json.dumps(obj)
+        cut = int(rng.integers(1, len(text) + 1))
+        st = J.advance_bytes(J.initial_state(), text[:cut].encode())
+        if J.is_complete(st):
+            json.loads(text[:cut])
+
+
+def test_incremental_prefix_states_never_reject_valid():
+    text = '{"a": [1, {"b": "c\\u00e9"}, null], "d": -2.5e-1}'
+    st = J.initial_state()
+    for b in text.encode():
+        st = J.advance_byte(st, b)
+        assert st is not None
+    assert J.is_complete(st)
+
+
+# --------------------------------------------------------- mask table
+
+
+def _byte_vocab():
+    """The test vocab: token id i = byte i (ByteTokenizer layout), plus a
+    few multi-byte tokens at the top."""
+    toks = [bytes([i]) for i in range(256)]
+    toks += [b'{"', b'":', b'",', b'"}', b'true', b'null', b'1}',
+             b'": ', b', "', b']}', b'}}', b'"a"', b'[]']
+    return toks
+
+
+def test_mask_table_soundness_greedy_walk():
+    """From the initial state, repeatedly pick any allowed token and
+    advance: every reachable emission stays parseable-or-extendable, and
+    EOS is allowed exactly when the object is complete."""
+    toks = _byte_vocab()
+    eos = [3]  # arbitrary byte token reserved as EOS
+    toks[3] = b""  # specials carry no bytes
+    table = J.token_mask_table(toks, eos)
+    assert table.shape == (J.NUM_MASK_STATES, len(toks))
+
+    rng = np.random.default_rng(1)
+    for trial in range(40):
+        st = J.initial_state()
+        out = b""
+        for _ in range(60):
+            row = table[J.abstract_index(st)]
+            allowed = np.nonzero(row)[0]
+            assert allowed.size, f"empty mask at {st!r} after {out!r}"
+            t = int(rng.choice(allowed))
+            if t == 3:  # EOS
+                assert J.is_complete(st), out
+                # string content may contain non-UTF8 bytes (the mask
+                # constrains JSON structure, not text encoding)
+                json.loads(out.decode("utf-8", errors="replace"))
+                break
+            nst = J.advance_bytes(st, toks[t])
+            assert nst is not None, (out, toks[t], st)
+            st = nst
+            out += toks[t]
+
+    # EOS allowed ONLY in DONE rows
+    st = J.advance_bytes(J.initial_state(), b'{"a": 1')
+    assert not table[J.abstract_index(st), 3]
+    st = J.advance_bytes(J.initial_state(), b'{"a": 1}')
+    assert J.is_complete(st)
+    assert table[J.abstract_index(st), 3]
+
+
+def test_mask_conservative_multi_close():
+    """A token closing more than the visible top is mask-rejected even
+    when the true stack could absorb it; single closers stay allowed."""
+    toks = _byte_vocab()
+    table = J.token_mask_table(toks, eos_ids=[])
+    st = J.advance_bytes(J.initial_state(), b'{"a": {"b": 1')
+    row = table[J.abstract_index(st)]
+    assert row[ord("}")]  # close inner object
+    idx_close2 = toks.index(b"}}")
+    assert not row[idx_close2]  # would need to see below the top
+    # after closing the inner object the host state knows the real stack
+    st2 = J.advance_bytes(st, b"}")
+    assert table[J.abstract_index(st2), ord("}")]
+
+
+def test_deep_nesting_abstract_vs_exact_agreement():
+    """For single-byte tokens the abstract mask must agree EXACTLY with
+    the real automaton at any depth (conservatism only affects
+    multi-close tokens)."""
+    toks = [bytes([i]) for i in range(128)]
+    table = J.token_mask_table(toks, eos_ids=[])
+    prefixes = [
+        b'{"a": [',
+        b'{"a": [[',
+        b'{"a": [{"b": [1, ',
+        b'{"a": {"b": {"c": ',
+        b'{"a": [1, 2.5, ',
+        b'{"a": "str',
+        b'{"a": tr',
+    ]
+    for p in prefixes:
+        st = J.advance_bytes(J.initial_state(), p)
+        assert st is not None, p
+        row = table[J.abstract_index(st)]
+        for b in range(128):
+            real = J.advance_byte(st, b) is not None
+            assert bool(row[b]) == real, (p, chr(b), bool(row[b]), real)
+
+
+# ------------------------------------------------- engine + service e2e
+
+
+def _engine_guided(spec=0):
+    from xllm_service_tpu.common.config import EngineConfig
+    from xllm_service_tpu.runtime.engine import InferenceEngine
+    from xllm_service_tpu.runtime.executor import ModelExecutor
+    from xllm_service_tpu.tokenizer import ByteTokenizer
+
+    cfg = EngineConfig(
+        model="llama3-tiny", dtype="float32", block_size=16, num_blocks=64,
+        max_running_requests=4, max_seq_len=256,
+        prefill_buckets=[32, 64, 128], speculative_tokens=spec,
+    )
+    eng = InferenceEngine(cfg, executor=ModelExecutor(cfg), eos_token_ids=(2,))
+    tok = ByteTokenizer()
+    tb = tok.token_bytes_table(eng.executor.cfg.vocab_size)
+    table = J.token_mask_table(tb, eos_ids=[2])
+    eng.set_guided_context(table, tb)
+    return eng, tb
+
+
+def _run_guided(eng, sampling, prompt=None, max_steps=300):
+    from xllm_service_tpu.runtime.engine import EngineRequest
+
+    out = {"tokens": [], "finish": None}
+
+    def cb(o):
+        for s in o.outputs:
+            out["tokens"].extend(s.token_ids)
+            if o.finished:
+                out["finish"] = s.finish_reason
+        return True
+
+    eng.add_request(EngineRequest(
+        "g", list(prompt or [10, 20, 30]), sampling, cb, guided="json",
+    ))
+    for _ in range(max_steps):
+        if not eng.has_work():
+            break
+        eng.step()
+    return out
+
+
+@pytest.mark.parametrize("temp", [0.0, 1.0], ids=["greedy", "sampled"])
+def test_engine_guided_output_is_valid_json_prefix(temp):
+    """A random-weight model under the JSON mask emits a byte stream that
+    the automaton never rejects; if it finished via EOS the output parses."""
+    from xllm_service_tpu.ops.sampling import SamplingParams
+    from xllm_service_tpu.common.types import FinishReason
+
+    eng, tb = _engine_guided()
+    out = _run_guided(
+        eng, SamplingParams(temperature=temp, seed=5, max_new_tokens=60)
+    )
+    assert out["tokens"], "nothing generated"
+    data = b"".join(tb[t] for t in out["tokens"] if t != 2)
+    st = J.advance_bytes(J.initial_state(), data)
+    assert st is not None, data
+    assert data.lstrip()[:1] == b"{", data
+    if out["finish"] == FinishReason.STOP:  # EOS: must be complete JSON
+        assert J.is_complete(st), data
+        json.loads(data.decode("utf-8", errors="replace"))
+
+
+def test_engine_guided_spec_matches_plain():
+    """Guided + speculative decoding == guided plain decoding, token for
+    token (the verify scan applies the same per-position masks)."""
+    from xllm_service_tpu.ops.sampling import SamplingParams
+
+    sp = SamplingParams(temperature=0.8, seed=9, max_new_tokens=24)
+    eng0, _ = _engine_guided(spec=0)
+    eng3, _ = _engine_guided(spec=3)
+    a = _run_guided(eng0, sp)
+    b = _run_guided(eng3, sp)
+    assert a["tokens"] == b["tokens"]
+
+
+def test_service_response_format_e2e():
+    """response_format={"type": "json_object"} through the real HTTP
+    stack: output is a valid JSON prefix; unsupported types 400."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from xllm_service_tpu.api import Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+    from tests.test_api_e2e import http_post, wait_until
+
+    store = MemoryStore(clock=lambda: 0.0)
+    scfg = ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0, block_size=16,
+    )
+    master = Master(scfg, store=store)
+    master.start()
+    ecfg = EngineConfig(
+        model="llama3-tiny", dtype="float32", block_size=16, num_blocks=64,
+        max_running_requests=4, max_seq_len=256,
+        prefill_buckets=[32, 64, 128],
+        instance_name="g0", instance_type="MIX",
+    )
+    inst = InstanceServer(
+        ecfg, master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.2
+    )
+    inst.start()
+    try:
+        assert wait_until(
+            lambda: sum(master.scheduler.instance_mgr.counts()) == 1
+        )
+        code, body = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "llama3-tiny", "prompt": "give me json",
+             "max_tokens": 40, "temperature": 0.0,
+             "response_format": {"type": "json_object"}},
+            timeout=300.0,
+        )
+        assert code == 200, body
+        text = body["choices"][0]["text"]
+        st = J.advance_bytes(
+            J.initial_state(), text.encode("utf-8", errors="replace")
+        )
+        assert st is not None, text
+        assert text.lstrip()[:1] == "{", text
+
+        code, body = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "llama3-tiny", "prompt": "x", "max_tokens": 2,
+             "response_format": {"type": "json_schema"}},
+            timeout=60.0,
+        )
+        assert code == 400, (code, body)
+        assert "not supported" in body["error"]["message"]
+    finally:
+        inst.stop()
+        master.stop()
+        store.close()
+
+
+def test_guided_survives_pd_handoff():
+    """response_format through a PREFILL -> DECODE pair: the decode peer
+    continues the mask mid-stream (state rebuilt from the handed-off
+    first token)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from xllm_service_tpu.api import Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+    from tests.test_api_e2e import http_post, wait_until
+
+    store = MemoryStore(clock=lambda: 0.0)
+    scfg = ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0, block_size=16,
+    )
+    master = Master(scfg, store=store)
+    master.start()
+
+    def mk(name, itype):
+        ecfg = EngineConfig(
+            model="llama3-tiny", dtype="float32", block_size=16,
+            num_blocks=64, max_running_requests=4, max_seq_len=256,
+            prefill_buckets=[32, 64, 128],
+            instance_name=name, instance_type=itype,
+        )
+        srv = InstanceServer(
+            ecfg, master_rpc_addr=master.rpc_address,
+            heartbeat_interval_s=0.2,
+        )
+        srv.start()
+        return srv
+
+    p0, d0 = mk("p0", "PREFILL"), mk("d0", "DECODE")
+    try:
+        assert wait_until(
+            lambda: master.scheduler.instance_mgr.counts() == (1, 1, 0)
+        )
+        code, body = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "llama3-tiny", "prompt": "json please",
+             "max_tokens": 30, "temperature": 0.0,
+             "response_format": {"type": "json_object"}},
+            timeout=300.0,
+        )
+        assert code == 200, body
+        text = body["choices"][0]["text"]
+        st = J.advance_bytes(
+            J.initial_state(), text.encode("utf-8", errors="replace")
+        )
+        assert st is not None, text
+        assert text.lstrip()[:1] == "{", text
+    finally:
+        p0.stop()
+        d0.stop()
+        master.stop()
+        store.close()

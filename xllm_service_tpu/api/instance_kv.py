@@ -70,6 +70,12 @@ class KVHandoffMixin:
             )
             if k in body
         }
+        guided_mode = (
+            "json"
+            if isinstance(body.get("response_format"), dict)
+            and body["response_format"].get("type") == "json_object"
+            else None
+        )
         if seed is not None:
             # Forward the RESOLVED seed (possibly drawn at random for an
             # unseeded request) so the decode peer continues the same
@@ -114,6 +120,7 @@ class KVHandoffMixin:
                 extra = {
                     "service_request_id": srid,
                     "sampling": sampling_fields,
+                    "guided": guided_mode,
                 }
                 if respond_via_self:
                     # Alternate topology: decode relays its generations
@@ -303,6 +310,11 @@ class KVHandoffMixin:
 
         srid = header.get("service_request_id", "")
         sampling = sampling_from_body(header.get("sampling", {}), self.cfg)
+        guided = header.get("guided")
+        if guided and self._ensure_guided_context():
+            # decode peer cannot express the mask (tokenizer mismatch):
+            # degrade to unconstrained rather than drop the request
+            guided = None
         rid = generate_uuid(16)
         with self._srid_mu:
             self._srid_map.setdefault(srid, []).append(rid)
@@ -321,6 +333,7 @@ class KVHandoffMixin:
                 prompt_token_ids=handoff.token_ids[:-1],
                 sampling=sampling,
                 callback=self._make_push_callback(srid, detoks),
+                guided=guided,
             ),
             handoff,
         )

@@ -55,6 +55,7 @@ class ServingMixin:
         sampling: SamplingParams,
         n: int,
         best_of: int,
+        guided: Optional[str] = None,
     ) -> None:
         """Run n (or best_of) sequences as independent engine requests and
         push INDEXED deltas under one service_request_id. The prompt's KV
@@ -146,6 +147,7 @@ class ServingMixin:
                         sampling, i, need_logprobs=bool(best_of)
                     ),
                     callback=make_cb(i),
+                    guided=guided,
                 )
             )
 
@@ -234,6 +236,114 @@ class ServingMixin:
         ex = getattr(self.engine, "executor", None)
         return getattr(getattr(ex, "cfg", None), "vocab_size", None)
 
+    def _parse_guided(self, body: Dict[str, Any]) -> Tuple[Optional[str], str]:
+        """OpenAI response_format -> (guided mode, error). Only
+        {"type": "json_object"} constrains; "text"/absent pass through."""
+        rf = body.get("response_format")
+        if not rf:
+            return None, ""
+        if not isinstance(rf, dict) or "type" not in rf:
+            return None, "response_format must be an object with a type"
+        if rf["type"] in ("text", None):
+            return None, ""
+        if rf["type"] != "json_object":
+            return None, (
+                f"response_format type {rf['type']!r} is not supported "
+                f"(json_object or text)"
+            )
+        err = self._ensure_guided_context()
+        return ("json", "") if not err else (None, err)
+
+    def _ensure_guided_context(self) -> str:
+        """Build + install the JSON-mode mask table once (persistent-
+        cached next to the XLA jit cache when configured — the first
+        build walks every vocab token through the automaton from every
+        abstract state, ~a minute for 128K vocabs)."""
+        if getattr(self, "_guided_ready", False):
+            return ""
+        if not hasattr(self, "_guided_build_lock"):
+            self._guided_build_lock = threading.Lock()
+        with self._guided_build_lock:
+            if getattr(self, "_guided_ready", False):
+                return ""
+            return self._build_guided_context()
+
+    def _build_guided_context(self) -> str:
+        if not hasattr(self.engine, "set_guided_context"):
+            return "guided decoding requires a real engine"
+        vocab = self._vocab_size()
+        if not vocab:
+            return "guided decoding requires a real engine"
+        tb = self.tokenizer.token_bytes_table(vocab)
+        if tb is None:
+            return "guided json is not supported for this tokenizer"
+        from xllm_service_tpu.guided import json_fsm
+
+        eos = sorted(
+            set(self.engine.eos_token_ids)
+            | ({self.tokenizer.eos_token_id}
+               if self.tokenizer.eos_token_id is not None else set())
+        )
+        table = self._load_guided_cache(tb, eos)
+        if table is None:
+            table = json_fsm.token_mask_table(tb, eos)
+            self._store_guided_cache(tb, eos, table)
+        self.engine.set_guided_context(table, tb)
+        self._guided_ready = True
+        return ""
+
+    def _guided_cache_path(self, tb, eos):
+        import hashlib
+        import os
+        import tempfile
+
+        from xllm_service_tpu.guided import json_fsm
+
+        h = hashlib.sha256()
+        for t in tb:
+            h.update(t + b"\x00")
+        h.update(repr(eos).encode())
+        h.update(
+            f"v{json_fsm.FSM_VERSION}:{json_fsm.NUM_MASK_STATES}".encode()
+        )
+        base = self.cfg.compilation_cache_dir or tempfile.gettempdir()
+        return os.path.join(base, f"xllm-json-mask-{h.hexdigest()[:16]}.npy")
+
+    def _load_guided_cache(self, tb, eos):
+        import os
+
+        import numpy as np
+
+        from xllm_service_tpu.guided import json_fsm
+
+        path = self._guided_cache_path(tb, eos)
+        if os.path.exists(path):
+            try:
+                table = np.load(path)
+            except Exception:
+                return None
+            if table.shape == (json_fsm.NUM_MASK_STATES, len(tb)):
+                return table
+        return None
+
+    def _store_guided_cache(self, tb, eos, table) -> None:
+        import os
+        import tempfile
+
+        import numpy as np
+
+        path = self._guided_cache_path(tb, eos)
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            fd, tmp = tempfile.mkstemp(
+                dir=os.path.dirname(path), suffix=".npy"
+            )
+            os.close(fd)
+            np.save(tmp, table)  # np.save keeps the .npy name as-is
+            os.replace(tmp, path)
+        except Exception:
+            pass  # cache is best-effort
+
     @staticmethod
     def _child_sampling(sampling: SamplingParams, i: int, need_logprobs: bool):
         """Per-sequence sampling params: distinct RNG stream per choice
@@ -268,12 +378,18 @@ class ServingMixin:
         except ValueError as e:
             h.send_error_json(400, str(e))
             return
+        guided, gerr = self._parse_guided(body)
+        if gerr:
+            h.send_error_json(400, gerr)
+            return
 
         if srid and self._master is not None and (n > 1 or best_of > 1):
             # Fan-out mode: PD split is skipped for multi-sequence requests
             # (a per-child handoff would need sub-request ids on the wire);
             # this instance serves all sequences and pushes indexed deltas.
-            self._serve_fanout_forwarded(srid, token_ids, sampling, n, best_of)
+            self._serve_fanout_forwarded(
+                srid, token_ids, sampling, n, best_of, guided=guided
+            )
             h.send_json({"ok": True, "service_request_id": srid})
             return
         rid = generate_uuid(16)
@@ -323,6 +439,7 @@ class ServingMixin:
                         prompt_token_ids=token_ids,
                         sampling=sampling,
                         callback=callback,
+                        guided=guided,
                         prefill_only=True,
                         handoff=self._make_handoff_sender(
                             srid, decode_name, body, detoks,
@@ -341,6 +458,7 @@ class ServingMixin:
                         prompt_token_ids=token_ids,
                         sampling=sampling,
                         callback=callback,
+                        guided=guided,
                         mm_embeds=mm_embeds,
                         mm_positions=mm_positions,
                     )
@@ -349,7 +467,10 @@ class ServingMixin:
             return
 
         # Direct mode: this instance is the whole stack for one request.
-        self._serve_direct(h, body, chat, token_ids, sampling, rid, n, best_of)
+        self._serve_direct(
+            h, body, chat, token_ids, sampling, rid, n, best_of,
+            guided=guided,
+        )
 
     def _serve_direct(
         self,
@@ -361,6 +482,7 @@ class ServingMixin:
         rid: str,
         n: int = 1,
         best_of: int = 0,
+        guided: Optional[str] = None,
     ) -> None:
         from xllm_service_tpu.runtime.engine import EngineRequest
 
@@ -484,6 +606,7 @@ class ServingMixin:
                         sampling, i, need_logprobs=bool(best_of)
                     ),
                     callback=make_callback(i),
+                    guided=guided,
                 )
             )
         if not done.wait(600.0):

@@ -1,0 +1,349 @@
+"""Byte-level JSON automaton for guided decoding (OpenAI
+`response_format: {"type": "json_object"}`).
+
+Two layers:
+
+  * EXACT host tracking: `JsonState` carries (surface, bracket stack,
+    pending-literal suffix); `advance_bytes` walks emitted tokens byte
+    by byte — O(len) per emitted token, one state per request.
+  * ABSTRACT mask states for the on-device token mask: the allowed-token
+    set from a position depends only on (surface, literal suffix,
+    top-of-stack, depth==1?). The stack below the top is unknown to the
+    mask, so a token may close AT MOST the visible top bracket; a token
+    with content past that close is conservatively rejected (the model
+    emits single closers instead — still fully expressive, never
+    invalid; the host recomputes the exact state after every emission).
+    `token_mask_table` simulates every distinct vocab byte string from
+    every abstract state into one bool table [NUM_MASK_STATES, V],
+    built once per tokenizer and cached on device.
+
+The automaton accepts exactly the JSON value grammar (RFC 8259, with the
+\\uXXXX escape simplified to \\u + 4 ordinary string bytes — hex digits
+are legal content bytes, so acceptance is unchanged) plus inter-token
+whitespace CAPPED AT ONE CONSECUTIVE BYTE — unbounded whitespace runs
+would let a masked model spend its whole token budget on legal
+emptiness (observed: greedy decode under the mask emitting only tabs).
+json.dumps-style output (", " separators) is unaffected. Restricted to
+one top-level object when `top_object=True` (what json_object mode
+promises). No trailing commas ('[' and ',' expect different states).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+(
+    S_VALUE,        # expecting a value (top level / after ':' / after ',')
+    S_ARR_FIRST,    # right after '[': a value or ']'
+    S_OBJ_FIRST,    # after '{': key string or '}'
+    S_OBJ_KEY,      # after ',' in object: key string
+    S_OBJ_COLON,    # after key string: ':'
+    S_OBJ_NEXT,     # after a member value: ',' or '}'
+    S_ARR_NEXT,     # after an element value: ',' or ']'
+    S_STR,          # inside a value string
+    S_STR_ESC,      # after backslash in a value string
+    S_KEYSTR,       # inside a key string
+    S_KEYSTR_ESC,   # after backslash in a key string
+    S_NUM_SIGN,     # after '-' needing first digit
+    S_NUM_INT,      # integer digits — value may end here
+    S_NUM_Z,        # leading zero — only '.', 'e', or end may follow
+    S_NUM_DOT,      # after '.' needing a digit
+    S_NUM_FRAC,     # fraction digits — value may end here
+    S_NUM_E,        # after 'e'/'E' needing sign or digit
+    S_NUM_ESIGN,    # after exponent sign needing digit
+    S_NUM_EXP,      # exponent digits — value may end here
+    S_LIT,          # inside true/false/null (suffix tracked)
+    S_DONE,         # complete top-level value; only whitespace (+EOS)
+    NUM_SURFACES,
+) = range(22)
+
+WS = frozenset(b" \t\n\r")
+DIGITS = frozenset(b"0123456789")
+_NUM_END_OK = {S_NUM_INT, S_NUM_Z, S_NUM_FRAC, S_NUM_EXP}
+_LITERALS = (b"true", b"false", b"null")
+# every literal suffix a token boundary can land on
+_LIT_SUFFIXES = sorted(
+    {w[i:] for w in _LITERALS for i in range(1, len(w))}
+)
+
+
+class JsonState:
+    """Exact configuration: surface + bracket stack + literal suffix +
+    just-saw-whitespace flag (ws runs cap at one byte)."""
+
+    __slots__ = ("surface", "stack", "lit", "ws")
+
+    def __init__(self, surface: int, stack: Tuple[str, ...] = (),
+                 lit: bytes = b"", ws: bool = False):
+        self.surface = surface
+        self.stack = stack
+        self.lit = lit
+        self.ws = ws
+
+    def key(self):
+        return (self.surface, self.stack, self.lit, self.ws)
+
+    def __eq__(self, other):
+        return isinstance(other, JsonState) and self.key() == other.key()
+
+    def __hash__(self):
+        return hash(self.key())
+
+    def __repr__(self):
+        return (
+            f"JsonState({self.surface}, {self.stack}, {self.lit!r}, "
+            f"ws={self.ws})"
+        )
+
+
+def initial_state(top_object: bool = True) -> JsonState:
+    return JsonState(S_VALUE)
+
+
+def _close(stack: Tuple[str, ...]) -> Tuple[int, Tuple[str, ...]]:
+    """Surface after a value completes under the given (new) stack."""
+    if not stack:
+        return S_DONE, stack
+    return (S_OBJ_NEXT if stack[-1] == "o" else S_ARR_NEXT), stack
+
+
+def advance_byte(
+    st: JsonState, b: int, top_object: bool = True
+) -> Optional[JsonState]:
+    """One byte through the EXACT automaton; None rejects."""
+    s, stack, lit = st.surface, st.stack, st.lit
+    c = bytes([b])
+
+    if s == S_LIT:
+        if lit and b == lit[0]:
+            rest = lit[1:]
+            if rest:
+                return JsonState(S_LIT, stack, rest)
+            ns, stack = _close(stack)
+            return JsonState(ns, stack)
+        return None
+
+    if s in (S_STR, S_KEYSTR):
+        if b == 0x22:
+            if s == S_KEYSTR:
+                return JsonState(S_OBJ_COLON, stack)
+            ns, stack = _close(stack)
+            return JsonState(ns, stack)
+        if b == 0x5C:
+            return JsonState(
+                S_STR_ESC if s == S_STR else S_KEYSTR_ESC, stack
+            )
+        if b >= 0x20:
+            return JsonState(s, stack)
+        return None
+    if s in (S_STR_ESC, S_KEYSTR_ESC):
+        if c in b'"\\/bfnrtu':
+            return JsonState(S_STR if s == S_STR_ESC else S_KEYSTR, stack)
+        return None
+
+    if s in (S_NUM_SIGN, S_NUM_DOT, S_NUM_E, S_NUM_ESIGN):
+        if s == S_NUM_E and b in b"+-":
+            return JsonState(S_NUM_ESIGN, stack)
+        if b in DIGITS:
+            if s == S_NUM_SIGN:
+                return JsonState(S_NUM_Z if b == 0x30 else S_NUM_INT, stack)
+            if s == S_NUM_DOT:
+                return JsonState(S_NUM_FRAC, stack)
+            return JsonState(S_NUM_EXP, stack)
+        return None
+    if s in _NUM_END_OK:
+        if b in DIGITS:
+            if s == S_NUM_Z:
+                return None  # no leading zeros
+            return JsonState(s, stack)
+        if b == 0x2E and s in (S_NUM_INT, S_NUM_Z):
+            return JsonState(S_NUM_DOT, stack)
+        if b in b"eE" and s in (S_NUM_INT, S_NUM_Z, S_NUM_FRAC):
+            return JsonState(S_NUM_E, stack)
+        # number ends lazily: close it, re-dispatch this byte
+        ns, nstack = _close(stack)
+        return advance_byte(JsonState(ns, nstack), b, top_object)
+
+    if b in WS:
+        if not st.ws and s in (
+            S_VALUE, S_ARR_FIRST, S_OBJ_FIRST, S_OBJ_KEY, S_OBJ_COLON,
+            S_OBJ_NEXT, S_ARR_NEXT, S_DONE,
+        ):
+            return JsonState(s, stack, ws=True)
+        return None
+
+    if s in (S_VALUE, S_ARR_FIRST):
+        if s == S_ARR_FIRST and b == 0x5D:  # empty array
+            ns, nstack = _close(stack[:-1])
+            return JsonState(ns, nstack)
+        if top_object and not stack and b != 0x7B:
+            return None  # json_object: top level must be an object
+        if b == 0x7B:
+            return JsonState(S_OBJ_FIRST, stack + ("o",))
+        if b == 0x5B:
+            return JsonState(S_ARR_FIRST, stack + ("a",))
+        if b == 0x22:
+            return JsonState(S_STR, stack)
+        if b == 0x2D:
+            return JsonState(S_NUM_SIGN, stack)
+        if b == 0x30:
+            return JsonState(S_NUM_Z, stack)
+        if b in DIGITS:
+            return JsonState(S_NUM_INT, stack)
+        for word in _LITERALS:
+            if b == word[0]:
+                return JsonState(S_LIT, stack, word[1:])
+        return None
+    if s == S_OBJ_FIRST:
+        if b == 0x22:
+            return JsonState(S_KEYSTR, stack)
+        if b == 0x7D:
+            ns, nstack = _close(stack[:-1])
+            return JsonState(ns, nstack)
+        return None
+    if s == S_OBJ_KEY:
+        if b == 0x22:
+            return JsonState(S_KEYSTR, stack)
+        return None
+    if s == S_OBJ_COLON:
+        if b == 0x3A:
+            return JsonState(S_VALUE, stack)
+        return None
+    if s == S_OBJ_NEXT:
+        if b == 0x2C:
+            return JsonState(S_OBJ_KEY, stack)
+        if b == 0x7D:
+            ns, nstack = _close(stack[:-1])
+            return JsonState(ns, nstack)
+        return None
+    if s == S_ARR_NEXT:
+        if b == 0x2C:
+            return JsonState(S_VALUE, stack)
+        if b == 0x5D:
+            ns, nstack = _close(stack[:-1])
+            return JsonState(ns, nstack)
+        return None
+    return None  # S_DONE with a non-ws byte
+
+
+def advance_bytes(
+    st: Optional[JsonState], data: bytes, top_object: bool = True
+) -> Optional[JsonState]:
+    for b in data:
+        if st is None:
+            return None
+        st = advance_byte(st, b, top_object)
+    return st
+
+
+def is_complete(st: Optional[JsonState]) -> bool:
+    """A complete top-level value: DONE, or a top-level number that may
+    end here (numbers terminate lazily — no byte closes them)."""
+    if st is None or st.stack:
+        return False
+    return st.surface == S_DONE or st.surface in _NUM_END_OK
+
+
+# ------------------------------------------------------- abstract mask rows
+
+_TOPS = ("", "o", "a")
+
+
+_WS_SURFACES = {
+    S_VALUE, S_ARR_FIRST, S_OBJ_FIRST, S_OBJ_KEY, S_OBJ_COLON,
+    S_OBJ_NEXT, S_ARR_NEXT, S_DONE,
+}
+
+
+def _abstract_states():
+    out = []
+    for s in range(NUM_SURFACES):
+        lits = _LIT_SUFFIXES if s == S_LIT else [b""]
+        ws_opts = (False, True) if s in _WS_SURFACES else (False,)
+        for lit in lits:
+            for ws in ws_opts:
+                for top in _TOPS:
+                    for depth1 in (True, False):
+                        if top == "" and not depth1:
+                            continue
+                        out.append((s, lit, ws, top, depth1))
+    return out
+
+
+# bump when the automaton or abstract-state layout changes — persistent
+# mask-table caches key on this (a stale table would silently mis-mask)
+FSM_VERSION = 2
+
+_ABSTRACT = _abstract_states()
+_ABSTRACT_INDEX = {a: i for i, a in enumerate(_ABSTRACT)}
+NUM_MASK_STATES = len(_ABSTRACT)
+_SENTINEL = "?"  # unknown stack below the visible top
+
+
+def abstract_index(st: JsonState) -> int:
+    top = st.stack[-1] if st.stack else ""
+    depth1 = len(st.stack) <= 1
+    lit = st.lit if st.surface == S_LIT else b""
+    ws = st.ws if st.surface in _WS_SURFACES else False
+    return _ABSTRACT_INDEX[(st.surface, lit, ws, top, depth1)]
+
+
+def _seed_state(abstract) -> JsonState:
+    s, lit, ws, top, depth1 = abstract
+    if top == "":
+        stack: Tuple[str, ...] = ()
+    elif depth1:
+        stack = (top,)
+    else:
+        stack = (_SENTINEL, top)
+    return JsonState(s, stack, lit, ws)
+
+
+def token_allowed_from(abstract, token: bytes, top_object: bool) -> bool:
+    """Simulate one token from the seeded abstract state. A token may
+    close at most the VISIBLE top bracket: once only the sentinel
+    remains, any further byte rejects (the context below the top is
+    unknown to the mask)."""
+    st: Optional[JsonState] = _seed_state(abstract)
+    for b in token:
+        if st is None:
+            return False
+        if st.stack == (_SENTINEL,):
+            return False  # content past the visible top's close
+        st = advance_byte(st, b, top_object)
+    if st is None:
+        return False
+    # Landing exactly on the sentinel is fine — the host recomputes the
+    # true state — unless the simulation had to INTERPRET the sentinel
+    # (it never does: _close reads the symbol only to pick obj/arr, and
+    # we stopped before any byte was consumed under it).
+    return True
+
+
+def token_mask_table(
+    token_bytes: List[bytes], eos_ids: List[int], top_object: bool = True
+) -> np.ndarray:
+    """[NUM_MASK_STATES, V] bool allowed-token table. EOS ids are allowed
+    exactly in DONE rows; empty-byte tokens (specials) are disallowed
+    everywhere."""
+    V = len(token_bytes)
+    table = np.zeros((NUM_MASK_STATES, V), dtype=bool)
+    uniq = {}
+    for tid, tb in enumerate(token_bytes):
+        uniq.setdefault(bytes(tb), []).append(tid)
+    uniq.pop(b"", None)
+    for ai, abstract in enumerate(_ABSTRACT):
+        for tb, ids in uniq.items():
+            if token_allowed_from(abstract, tb, top_object):
+                table[ai, ids] = True
+    for top in _TOPS:
+        for d1 in (True, False):
+            for ws in (False, True):
+                key = (S_DONE, b"", ws, top, d1)
+                if key in _ABSTRACT_INDEX:
+                    for e in eos_ids:
+                        if 0 <= e < V:
+                            table[_ABSTRACT_INDEX[key], e] = True
+    return table

@@ -104,6 +104,7 @@ def sample_tokens(
     frequency: jnp.ndarray | None = None,  # [R] float32
     bias_ids: jnp.ndarray | None = None,  # [R, K] int32 (pad: id 0, bias 0)
     bias_vals: jnp.ndarray | None = None,  # [R, K] float32
+    allowed: jnp.ndarray | None = None,  # [R, V] bool (guided decoding)
 ):
     """Returns (token_ids [R], logprob_of_chosen [R], logprobs [R, V])."""
     logits = logits.astype(jnp.float32)
@@ -118,6 +119,11 @@ def sample_tokens(
         ].add(bias_vals)
     if counts is not None and presence is not None and frequency is not None:
         logits = apply_penalties(logits, counts, presence, frequency)
+    if allowed is not None:
+        # Guided decoding (JSON mode): hard-mask disallowed tokens LAST so
+        # no bias or penalty can resurrect them; reported logprobs are
+        # over the allowed set.
+        logits = jnp.where(allowed, logits, NEG_INF)
     logprobs_full = jax.nn.log_softmax(logits, axis=-1)
 
     greedy_ids = jnp.argmax(logits, axis=-1)
@@ -164,6 +170,7 @@ def speculative_sample(
     frequency: jnp.ndarray | None = None,  # [R]
     bias_ids: jnp.ndarray | None = None,  # [R, K]
     bias_vals: jnp.ndarray | None = None,  # [R, K]
+    allowed: jnp.ndarray | None = None,  # [R, S, V] bool per-position masks
 ):
     """Speculative acceptance for point-mass (n-gram / prompt-lookup) drafts.
 
@@ -197,14 +204,19 @@ def speculative_sample(
     if not have_counts:
         counts = jnp.zeros((R, 1), jnp.int32)  # dummy carry
 
+    have_mask = allowed is not None
+    if not have_mask:
+        allowed = jnp.zeros((R, S, 1), bool)  # dummy scan input
+
     def body(carry, xs):
         cnts, going = carry
-        lg, keys_j, d_j, j = xs
+        lg, keys_j, d_j, j, allow_j = xs
         tok, lp, _ = sample_tokens(
             lg, temperature, top_k, top_p, keys_j,
             counts=cnts if have_counts else None,
             presence=presence, frequency=frequency,
             bias_ids=bias_ids, bias_vals=bias_vals,
+            allowed=allow_j if have_mask else None,
         )
         emit = going & (j < limits)
         if have_counts:
@@ -220,6 +232,7 @@ def speculative_sample(
             jnp.swapaxes(step_keys, 0, 1),  # [S, R, 2]
             drafts_p.T,  # [S, R]
             jnp.arange(S, dtype=jnp.int32),
+            jnp.swapaxes(allowed, 0, 1),  # [S, R, V] (or dummy)
         ),
     )
     n_emit = jnp.sum(emits.astype(jnp.int32), axis=0)  # [R]

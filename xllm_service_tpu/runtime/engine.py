@@ -64,6 +64,9 @@ class EngineRequest:
     # content-addressed blocks across different images.
     mm_embeds: Optional[object] = None
     mm_positions: Optional[object] = None
+    # Guided decoding: "json" constrains the output to a JSON object via
+    # the engine's mask table (set_guided_context must have been called).
+    guided: Optional[str] = None
 
     @property
     def has_media(self) -> bool:
@@ -102,6 +105,7 @@ class _Seq:
         "req", "slot", "tokens", "block_ids", "num_cached", "generated",
         "last_committed_block", "prefill_done_time", "last_token_time",
         "prefilled", "chunk_len", "prefill_start_time", "head_hash",
+        "json_state", "json_upto",
     )
 
     def __init__(self, req: EngineRequest, slot: int):
@@ -123,6 +127,13 @@ class _Seq:
         self.chunk_len = 0
         self.prefill_start_time = 0.0  # first chunk's t0 (true TTFT base)
         self.head_hash: Optional[bytes] = None  # block-0 chained hash
+        # Guided decoding: exact JSON automaton state consumed up to
+        # generated[json_upto]; lazily advanced by _guided_row (survives
+        # preemption with the _Seq; rebuilt on PD import since the state
+        # walks `generated`). None after an automaton reject = permissive
+        # from then on (never expected under the mask; belt+braces).
+        self.json_state = "INIT"
+        self.json_upto = 0
 
 
 # The waiting queue holds fresh EngineRequests and preempted _Seqs (which
@@ -192,6 +203,11 @@ class InferenceEngine:
         self._tbt_window: Deque[Tuple[float, float]] = collections.deque()
         self._profile_ttft: List[Tuple[int, float]] = []
         self._profile_tpot: List[Tuple[int, int, float]] = []
+        # Guided decoding context (set_guided_context): device mask table
+        # lives on the executor; the engine keeps token bytes + row
+        # liveness for exact host tracking.
+        self._guided_tokens: Optional[List[bytes]] = None
+        self._guided_row_any: Optional[np.ndarray] = None
         # Speculative-decoding accounting: verify steps run, slot-steps
         # (active sequences summed over steps), and tokens emitted — the
         # mean tokens/slot-step is the realized speedup over plain decode.
@@ -530,6 +546,13 @@ class InferenceEngine:
                         tuple(getattr(s, "logit_bias", ()) or ())
                         if start + n >= len(seq.tokens)
                         else ()
+                    ),
+                    mask_row=(
+                        self._guided_row(seq)
+                        if seq.req.guided
+                        and self._guided_tokens is not None
+                        and start + n >= len(seq.tokens)
+                        else -1
                     ),
                     # Only the FINAL chunk's sampled token survives, so
                     # intermediate chunks skip the [P, V] histogram (and
@@ -965,6 +988,13 @@ class InferenceEngine:
             token_ids[slot] = seq.tokens[-1]
             positions[slot] = len(seq.tokens) - 1
             active[slot] = True
+        if self._guided_tokens is not None and any(
+            s.req.guided for s in self._running.values()
+        ):
+            rows = np.full((self.R,), self.executor.permissive_row, np.int32)
+            for slot, seq in self._running.items():
+                rows[slot] = self._guided_row(seq)
+            batch.mask_rows = rows
 
         t0 = time.monotonic()
         tokens, logprobs = self.executor.decode(
@@ -992,6 +1022,73 @@ class InferenceEngine:
             produced += 1
             self._emit(seq, finished=self._check_stop(seq))
         return produced
+
+    # --------------------------------------------------- guided decoding
+
+    def set_guided_context(
+        self, table: np.ndarray, token_bytes: List[bytes]
+    ) -> None:
+        """Install the JSON-mode mask table ([M, V] bool, one row per
+        abstract automaton state — guided/json_fsm.token_mask_table) and
+        the per-id byte surfaces the host tracker walks."""
+        self.executor.set_guided_table(table)
+        self._guided_tokens = token_bytes
+        self._guided_row_any = table.any(axis=1)
+
+    def _guided_row(self, seq: _Seq) -> int:
+        """Mask-table row for the seq's NEXT sampled token, advancing the
+        exact automaton through any not-yet-consumed emitted tokens.
+        Returns the permissive row for unguided seqs, on automaton reject
+        (cannot happen under the mask), or for an all-false row (vocab
+        cannot express the needed byte — degrade open rather than hang)."""
+        from xllm_service_tpu.guided import json_fsm
+
+        perm = self.executor.permissive_row
+        if seq.req.guided != "json" or self._guided_tokens is None:
+            return perm
+        if seq.json_state == "INIT":
+            seq.json_state = json_fsm.initial_state()
+            seq.json_upto = 0
+        st = seq.json_state
+        toks = self._guided_tokens
+        while st is not None and seq.json_upto < len(seq.generated):
+            tok = seq.generated[seq.json_upto][0]
+            tb = toks[tok] if 0 <= tok < len(toks) else b""
+            st = json_fsm.advance_bytes(st, tb)
+            seq.json_upto += 1
+        seq.json_state = st
+        if st is None:
+            return perm
+        row = json_fsm.abstract_index(st)
+        if self._guided_row_any is not None and not self._guided_row_any[row]:
+            return perm
+        return row
+
+    def _guided_rows_spec(self, seq: _Seq, drafts: np.ndarray, S: int):
+        """Per-position mask rows for a verify step: position 0 uses the
+        current state; position j continues through drafts 0..j-1 (the
+        accepted tokens ARE the drafts). An illegal draft leaves later
+        positions permissive — sampling rejects at the illegal position
+        anyway."""
+        from xllm_service_tpu.guided import json_fsm
+
+        perm = self.executor.permissive_row
+        rows = np.full((S,), perm, np.int32)
+        r0 = self._guided_row(seq)
+        rows[0] = r0
+        if r0 == perm:
+            return rows
+        st = seq.json_state
+        toks = self._guided_tokens
+        for j in range(1, S):
+            d = int(drafts[j - 1])
+            tb = toks[d] if 0 <= d < len(toks) else b""
+            st = json_fsm.advance_bytes(st, tb)
+            if st is None:
+                break
+            row = json_fsm.abstract_index(st)
+            rows[j] = row if self._guided_row_any[row] else perm
+        return rows
 
     # ------------------------------------------------- speculative decode
 
@@ -1049,6 +1146,17 @@ class InferenceEngine:
             positions[slot] = pos
             true_len[slot] = max(1, min(S, max_len - pos))
             active[slot] = True
+        if self._guided_tokens is not None and any(
+            s.req.guided for s in self._running.values()
+        ):
+            rows = np.full(
+                (self.R, S), self.executor.permissive_row, np.int32
+            )
+            for slot, seq in self._running.items():
+                rows[slot] = self._guided_rows_spec(
+                    seq, token_ids[slot, 1:], S
+                )
+            batch.mask_rows = rows
 
         t0 = time.monotonic()
         tokens, logprobs, n_emit = self.executor.verify(

@@ -63,6 +63,10 @@ class SamplingBatch:
     # (padding entries (0, 0.0)); None = no bias anywhere in the batch.
     bias_ids: Optional[np.ndarray] = None
     bias_vals: Optional[np.ndarray] = None
+    # Guided decoding: per-slot rows into the executor's mask table
+    # (set_guided_table); unguided slots carry the permissive row. None =
+    # nothing guided in the batch. Decode: [R]; verify: [R, S].
+    mask_rows: Optional[np.ndarray] = None
 
 
 @dataclass
@@ -89,6 +93,8 @@ class PrefillItem:
     # OpenAI logit_bias pairs ((token_id, bias), ...) for the token
     # sampled at (re)admission.
     logit_bias: tuple = ()
+    # Guided decoding mask row for the admission-sampled token (-1 = none).
+    mask_row: int = -1
 
 
 _COMPILATION_CACHE_DIR: Optional[str] = None
@@ -124,6 +130,10 @@ def _setup_compilation_cache(cache_dir: str) -> None:
 
 
 class ModelExecutor:
+    # guided decoding: index of the appended all-True row once
+    # set_guided_table runs; a safe default for unguided paths
+    permissive_row = 0
+
     def __init__(
         self,
         engine_cfg: EngineConfig,
@@ -316,6 +326,23 @@ class ModelExecutor:
         if not self.prefill_buckets or self.prefill_buckets[-1] < engine_cfg.max_seq_len:
             self.prefill_buckets.append(engine_cfg.max_seq_len)
 
+    # -------------------------------------------------- guided decoding
+
+    def set_guided_table(self, table: np.ndarray) -> None:
+        """Install the guided-decoding token-mask table [M, V] bool (one
+        row per abstract automaton state). A permissive all-True row is
+        appended at index M — unguided slots point there, so one compiled
+        step serves mixed guided/unguided batches."""
+        M, V = table.shape
+        full = np.ones((M + 1, V), dtype=bool)
+        full[:M] = table
+        self._guided_table = jnp.asarray(full)
+        self.permissive_row = M
+
+    @property
+    def guided_table(self):
+        return getattr(self, "_guided_table", None)
+
     # ----------------------------------------------------------- sizing
 
     def _quantize_weights(self, p_shardings, bits: int = 8) -> None:
@@ -467,6 +494,8 @@ class ModelExecutor:
         frequency,
         bias_ids=None,
         bias_vals=None,
+        mask_rows=None,  # [R] rows into guided_table
+        guided_table=None,  # [M+1, V] bool
         use_kernel=None,
     ):
         logits, k_cache, v_cache = self.model_mod.decode_step(
@@ -484,6 +513,9 @@ class ModelExecutor:
             logits, temperature, top_k, top_p, step_keys,
             counts=counts, presence=presence, frequency=frequency,
             bias_ids=bias_ids, bias_vals=bias_vals,
+            allowed=(
+                guided_table[mask_rows] if mask_rows is not None else None
+            ),
         )
         counts = counts.at[
             jnp.arange(tokens.shape[0]), tokens
@@ -510,6 +542,8 @@ class ModelExecutor:
         frequency=None,  # [P]
         bias_ids=None,  # [P, K]
         bias_vals=None,  # [P, K]
+        mask_rows=None,  # [P] rows into guided_table
+        guided_table=None,
     ):
         logits, k_cache, v_cache = self.model_mod.prefill_batch_step(
             params, self.cfg, k_cache, v_cache, token_ids, start_pos,
@@ -525,6 +559,9 @@ class ModelExecutor:
             logits, temperature, top_k, top_p, step_keys,
             counts=counts, presence=presence, frequency=frequency,
             bias_ids=bias_ids, bias_vals=bias_vals,
+            allowed=(
+                guided_table[mask_rows] if mask_rows is not None else None
+            ),
         )
         return k_cache, v_cache, tokens, logprob
 
@@ -547,6 +584,8 @@ class ModelExecutor:
         frequency,
         bias_ids=None,
         bias_vals=None,
+        mask_rows=None,  # [R, S] rows into guided_table
+        guided_table=None,
     ):
         """Speculative-decoding verify step: one forward pass over S
         positions per sequence (the prefill machinery with `all_logits`),
@@ -564,6 +603,9 @@ class ModelExecutor:
             limits=true_len, active=active,
             counts=counts, presence=presence, frequency=frequency,
             bias_ids=bias_ids, bias_vals=bias_vals,
+            allowed=(
+                guided_table[mask_rows] if mask_rows is not None else None
+            ),
         )
         return k_cache, v_cache, counts, tokens, logprobs, n_emit
 
@@ -615,6 +657,11 @@ class ModelExecutor:
             bias_kwargs = dict(
                 bias_ids=jnp.asarray(batch.bias_ids, jnp.int32),
                 bias_vals=jnp.asarray(batch.bias_vals, jnp.float32),
+            )
+        if batch.mask_rows is not None:
+            bias_kwargs.update(
+                mask_rows=jnp.asarray(batch.mask_rows, jnp.int32),
+                guided_table=self._guided_table,
             )
         (
             self.k_cache, self.v_cache, self.token_counts,
@@ -756,6 +803,15 @@ class ModelExecutor:
         if b_ids is not None:
             pen_kwargs.update(
                 bias_ids=jnp.asarray(b_ids), bias_vals=jnp.asarray(b_vals)
+            )
+        if any(it.mask_row >= 0 for it in group):
+            rows = np.full((P,), self.permissive_row, np.int32)
+            for i, it in enumerate(group):
+                if it.mask_row >= 0:
+                    rows[i] = it.mask_row
+            pen_kwargs.update(
+                mask_rows=jnp.asarray(rows),
+                guided_table=self._guided_table,
             )
         if any(
             it.prior_tokens is not None and len(it.prior_tokens)
@@ -1045,6 +1101,11 @@ class ModelExecutor:
             bias_kwargs = dict(
                 bias_ids=jnp.asarray(batch.bias_ids, jnp.int32),
                 bias_vals=jnp.asarray(batch.bias_vals, jnp.float32),
+            )
+        if batch.mask_rows is not None:
+            bias_kwargs.update(
+                mask_rows=jnp.asarray(batch.mask_rows, jnp.int32),
+                guided_table=self._guided_table,
             )
         (
             self.k_cache, self.v_cache, self.token_counts, tokens, logprobs,

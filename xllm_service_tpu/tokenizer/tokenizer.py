@@ -47,6 +47,13 @@ class Tokenizer:
     def bos_token_id(self) -> Optional[int]:
         return None
 
+    def token_bytes_table(self, vocab_size: int) -> "Optional[List[bytes]]":
+        """Per-id raw bytes for guided decoding (JSON mode). None =
+        unsupported for this tokenizer family (guided requests are then
+        rejected with a clear error). Ids with no byte surface (specials,
+        out-of-table) map to b""."""
+        return None
+
 
 class ByteTokenizer(Tokenizer):
     """UTF-8 byte-level tokenizer: id = byte + 3 (0=pad, 1=bos, 2=eos).
@@ -92,6 +99,14 @@ class ByteTokenizer(Tokenizer):
     @property
     def bos_token_id(self) -> Optional[int]:
         return self.BOS
+
+    def token_bytes_table(self, vocab_size: int) -> "List[bytes]":
+        # model vocabs may exceed 259 (random-init test configs): decode
+        # folds id onto (id - 3) % 256, so the byte table does too
+        out = [b"" for _ in range(vocab_size)]
+        for i in range(self._OFFSET, vocab_size):
+            out[i] = bytes([(i - self._OFFSET) % 256])
+        return out
 
 
 class HFTokenizer(Tokenizer):
@@ -143,6 +158,49 @@ class HFTokenizer(Tokenizer):
     @property
     def hf(self):
         return self._tok
+
+    def token_bytes_table(self, vocab_size: int) -> "Optional[List[bytes]]":
+        """Byte surfaces via the tokenizer's own convention: GPT-2-style
+        byte-level vocabs map through the bytes_to_unicode table;
+        SentencePiece pieces map '\u2581' to space and '<0xNN>' byte
+        tokens to their byte; specials map to b""."""
+        # GPT-2 byte-level unicode->byte inverse table
+        bs = (
+            list(range(0x21, 0x7F)) + list(range(0xA1, 0xAD))
+            + list(range(0xAE, 0x100))
+        )
+        cs = bs[:]
+        n = 0
+        for b in range(256):
+            if b not in bs:
+                bs.append(b)
+                cs.append(256 + n)
+                n += 1
+        uni2byte = {chr(c): b for b, c in zip(bs, cs)}
+
+        special = set(self._tok.all_special_ids or [])
+        toks = self._guarded(
+            lambda: self._tok.convert_ids_to_tokens(
+                list(range(min(vocab_size, len(self._tok))))
+            )
+        )
+        out: List[bytes] = []
+        for tid, t in enumerate(toks):
+            if t is None or tid in special:
+                out.append(b"")
+                continue
+            if t.startswith("<0x") and t.endswith(">") and len(t) == 6:
+                try:
+                    out.append(bytes([int(t[3:5], 16)]))
+                    continue
+                except ValueError:
+                    pass
+            if all(ch in uni2byte for ch in t):
+                out.append(bytes(uni2byte[ch] for ch in t))
+            else:
+                out.append(t.replace("▁", " ").encode("utf-8"))
+        out += [b""] * (vocab_size - len(out))
+        return out
 
 
 class IncrementalDetokenizer:
