@@ -42,18 +42,57 @@ def _probe_backend() -> str:
     return "cpu"
 
 
+# Per-attempt wall-clock cap: a config whose kernel COMPILES but then
+# wedges the device/tunnel (observed failure mode of the axon tunnel:
+# a client blocks in recv forever) must not take the whole bench down —
+# exceptions already fall through; hangs need a subprocess boundary.
+# Default keeps 4 attempts + probe under the supervisor's 3600 s outer
+# budget (scripts/tpu_supervisor.py BENCH_TIMEOUT).
+_ATTEMPT_TIMEOUT_S = float(os.environ.get("XLLM_BENCH_ATTEMPT_TIMEOUT", 780))
+
+
+def _run_attempt_subprocess(child_cfg: dict) -> "tuple[int, str, str]":
+    """One attempt in its own PROCESS GROUP: a wedged child (or any
+    helper process it forked holding the pipe FDs) is killed as a group,
+    so the parent's pipe reads always terminate. Returns (rc, out, err);
+    rc < 0 means timeout-killed."""
+    import signal
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--attempt-json", json.dumps(child_cfg)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=_ATTEMPT_TIMEOUT_S)
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        out, err = proc.communicate()
+        return -1, out or "", err or ""
+
+
 def main() -> None:
+    if "--attempt-json" in sys.argv:
+        # child mode: run exactly one config in THIS process
+        cfg = json.loads(sys.argv[sys.argv.index("--attempt-json") + 1])
+        on_tpu = cfg.pop("_on_tpu")
+        if not on_tpu:
+            from __graft_entry__ import _force_cpu_platform
+
+            _force_cpu_platform(1)
+        _run(on_tpu, **cfg)
+        return
+
     backend = _probe_backend()
-    if backend != "tpu":
-        from __graft_entry__ import _force_cpu_platform
-
-        _force_cpu_platform(1)
-    import jax
-
-    on_tpu = jax.default_backend() == "tpu"
+    on_tpu = backend == "tpu"
     # Fastest config first; fall back if a path that never ran on real
-    # hardware this round (the int8 kernel's scale DMA) fails to compile —
-    # the bench must ALWAYS print a number (round-1 lesson).
+    # hardware this round fails to compile OR hangs — the bench must
+    # ALWAYS print a number (round-1 lesson; hang isolation round 3).
     attempts = (
         [
             # Fastest first: int8 weights (halves weight HBM traffic —
@@ -68,18 +107,21 @@ def main() -> None:
     )
     last_err = None
     for attempt in attempts:
-        try:
-            _run(on_tpu, **attempt)
+        rc, out, err = _run_attempt_subprocess(dict(attempt, _on_tpu=on_tpu))
+        line = ""
+        for ln in out.splitlines():
+            if ln.startswith("{"):
+                line = ln
+        if rc == 0 and line:
+            print(line)
             return
-        except Exception as e:  # noqa: BLE001 — fall through to next config
-            import traceback
-
-            traceback.print_exc()
-            # Keep only the repr: the exception's traceback pins _run's
-            # frame locals (multi-GB params/caches) and would OOM the next
-            # attempt.
-            last_err = repr(e)
-            del e
+        sys.stderr.write(err[-4000:])
+        last_err = (
+            f"attempt {attempt} timed out after {_ATTEMPT_TIMEOUT_S:.0f}s"
+            if rc < 0
+            else f"attempt {attempt} rc={rc}"
+        )
+        print(f"# {last_err}", file=sys.stderr)
     raise SystemExit(f"all bench configs failed: {last_err}")
 
 
