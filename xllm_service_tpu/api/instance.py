@@ -61,6 +61,7 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
         tokenizer_path: str = "",
         heartbeat_interval_s: float = 3.0,
         engine=None,
+        lora_adapters=None,  # {name: peft-dir path OR adapter dict}
     ):
         # Deferred imports keep jax out of service-only processes.
         if engine is None:
@@ -86,6 +87,26 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
                 )
         self.engine = engine
         self.cfg = engine_cfg
+        # Multi-LoRA registry: adapter name -> row in the executor's
+        # stacks; OpenAI `model` fields naming an adapter route to it.
+        self.lora_names: Dict[str, int] = {}
+        if lora_adapters:
+            if not hasattr(engine, "set_lora_adapters"):
+                raise ValueError(
+                    "lora_adapters requires a real inference engine"
+                )
+            loaded = {}
+            for name, spec in lora_adapters.items():
+                if isinstance(spec, str):
+                    from xllm_service_tpu.runtime.weights import (
+                        load_lora_checkpoint,
+                    )
+
+                    spec = load_lora_checkpoint(
+                        spec, self.engine.executor.cfg
+                    )
+                loaded[name] = spec
+            self.lora_names = self.engine.set_lora_adapters(loaded)
         self.tokenizer = create_tokenizer(tokenizer_path)
         self.chat_template = ChatTemplate(self.tokenizer)
         self._responses = ResponseHandler()
@@ -391,7 +412,12 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
             h.send_json(
                 {
                     "object": "list",
-                    "data": [{"id": self.cfg.model, "object": "model"}],
+                    "data": [{"id": self.cfg.model, "object": "model"}]
+                    + [
+                        {"id": n, "object": "model",
+                         "parent": self.cfg.model}
+                        for n in sorted(self.lora_names)
+                    ],
                 }
             )
         else:
@@ -542,6 +568,11 @@ def main(argv=None) -> None:
         "--speculative-ngram-max", type=int, default=3,
         help="longest suffix n-gram the drafter matches",
     )
+    parser.add_argument(
+        "--lora", action="append", default=[], metavar="NAME=PATH",
+        help="register a peft-layout LoRA adapter served under model "
+        "NAME (repeatable)",
+    )
     args = parser.parse_args(argv)
     # Restore standard JAX env semantics: some environments force a
     # platform at interpreter start (sitecustomize), overriding
@@ -573,12 +604,19 @@ def main(argv=None) -> None:
         speculative_tokens=args.speculative_tokens,
         speculative_ngram_max=args.speculative_ngram_max,
     )
+    lora = {}
+    for spec in args.lora:
+        name, _, path = spec.partition("=")
+        if not name or not path:
+            parser.error(f"--lora expects NAME=PATH, got {spec!r}")
+        lora[name] = path
     srv = InstanceServer(
         cfg,
         master_rpc_addr=args.master_rpc_addr,
         host=args.host,
         port=args.port,
         tokenizer_path=args.tokenizer_path,
+        lora_adapters=lora or None,
     )
     srv.start()
     try:

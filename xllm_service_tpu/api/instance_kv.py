@@ -76,6 +76,12 @@ class KVHandoffMixin:
             and body["response_format"].get("type") == "json_object"
             else None
         )
+        # adapter travels by NAME: rows are executor-local
+        lora_name = (
+            body.get("model")
+            if body.get("model") in getattr(self, "lora_names", {})
+            else ""
+        )
         if seed is not None:
             # Forward the RESOLVED seed (possibly drawn at random for an
             # unseeded request) so the decode peer continues the same
@@ -121,6 +127,7 @@ class KVHandoffMixin:
                     "service_request_id": srid,
                     "sampling": sampling_fields,
                     "guided": guided_mode,
+                    "lora": lora_name,
                 }
                 if respond_via_self:
                     # Alternate topology: decode relays its generations
@@ -315,6 +322,26 @@ class KVHandoffMixin:
             # decode peer cannot express the mask (tokenizer mismatch):
             # degrade to unconstrained rather than drop the request
             guided = None
+        lora_name = header.get("lora") or ""
+        adapter_idx = getattr(self, "lora_names", {}).get(lora_name, 0)
+        if lora_name and not adapter_idx:
+            # Continuing on the base model would splice two different
+            # models into one response — reject instead (the prefill side
+            # also colocates LoRA requests, so this is belt and braces).
+            logger.error(
+                "handoff names adapter %r this instance does not serve; "
+                "rejecting", lora_name,
+            )
+            self._push_q.put(RequestOutput(
+                request_id=header.get("service_request_id", ""),
+                service_request_id=srid,
+                status=Status(
+                    StatusCode.INVALID_ARGUMENT,
+                    f"decode instance does not serve adapter {lora_name!r}",
+                ),
+                finished=True,
+            ))
+            return ""
         rid = generate_uuid(16)
         with self._srid_mu:
             self._srid_map.setdefault(srid, []).append(rid)
@@ -334,6 +361,7 @@ class KVHandoffMixin:
                 sampling=sampling,
                 callback=self._make_push_callback(srid, detoks),
                 guided=guided,
+                adapter_idx=adapter_idx,
             ),
             handoff,
         )

@@ -56,6 +56,7 @@ class ServingMixin:
         n: int,
         best_of: int,
         guided: Optional[str] = None,
+        adapter_idx: int = 0,
     ) -> None:
         """Run n (or best_of) sequences as independent engine requests and
         push INDEXED deltas under one service_request_id. The prompt's KV
@@ -148,6 +149,7 @@ class ServingMixin:
                     ),
                     callback=make_cb(i),
                     guided=guided,
+                    adapter_idx=adapter_idx,
                 )
             )
 
@@ -382,13 +384,19 @@ class ServingMixin:
         if gerr:
             h.send_error_json(400, gerr)
             return
+        # Multi-LoRA: an OpenAI `model` naming a registered adapter routes
+        # to its row; anything else runs the base model.
+        adapter_idx = getattr(self, "lora_names", {}).get(
+            body.get("model"), 0
+        )
 
         if srid and self._master is not None and (n > 1 or best_of > 1):
             # Fan-out mode: PD split is skipped for multi-sequence requests
             # (a per-child handoff would need sub-request ids on the wire);
             # this instance serves all sequences and pushes indexed deltas.
             self._serve_fanout_forwarded(
-                srid, token_ids, sampling, n, best_of, guided=guided
+                srid, token_ids, sampling, n, best_of, guided=guided,
+                adapter_idx=adapter_idx,
             )
             h.send_json({"ok": True, "service_request_id": srid})
             return
@@ -427,6 +435,12 @@ class ServingMixin:
                 # Media requests serve colocated: the recomputed tail on a
                 # decode peer would need the embeddings too.
                 decode_name = ""
+            if adapter_idx:
+                # LoRA requests serve colocated too: adapter KV never
+                # commits (adapter-blind hashes), so a PD split would ship
+                # a zero-block handoff and the decode peer would silently
+                # recompute the whole prompt.
+                decode_name = ""
             if decode_name and decode_name != self.name:
                 # PD disaggregation: this instance is the prefill side —
                 # emit the first token, then migrate KV to the decode peer
@@ -440,6 +454,7 @@ class ServingMixin:
                         sampling=sampling,
                         callback=callback,
                         guided=guided,
+                        adapter_idx=adapter_idx,
                         prefill_only=True,
                         handoff=self._make_handoff_sender(
                             srid, decode_name, body, detoks,
@@ -459,6 +474,7 @@ class ServingMixin:
                         sampling=sampling,
                         callback=callback,
                         guided=guided,
+                        adapter_idx=adapter_idx,
                         mm_embeds=mm_embeds,
                         mm_positions=mm_positions,
                     )
@@ -469,7 +485,7 @@ class ServingMixin:
         # Direct mode: this instance is the whole stack for one request.
         self._serve_direct(
             h, body, chat, token_ids, sampling, rid, n, best_of,
-            guided=guided,
+            guided=guided, adapter_idx=adapter_idx,
         )
 
     def _serve_direct(
@@ -483,6 +499,7 @@ class ServingMixin:
         n: int = 1,
         best_of: int = 0,
         guided: Optional[str] = None,
+        adapter_idx: int = 0,
     ) -> None:
         from xllm_service_tpu.runtime.engine import EngineRequest
 
@@ -607,6 +624,7 @@ class ServingMixin:
                     ),
                     callback=make_callback(i),
                     guided=guided,
+                    adapter_idx=adapter_idx,
                 )
             )
         if not done.wait(600.0):

@@ -32,6 +32,7 @@ from xllm_service_tpu.ops.attention import (
     prefill_attention,
 )
 from xllm_service_tpu.ops.norms import rms_norm
+from xllm_service_tpu.ops import lora as lora_ops
 from xllm_service_tpu.ops.quant import wdtype, wt
 from xllm_service_tpu.ops.rope import apply_rope
 
@@ -137,12 +138,22 @@ def _project(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
                       params["lm_head"].astype(jnp.float32))
 
 
-def _mlp(lp: Dict[str, jnp.ndarray], cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+def _mlp(
+    lp: Dict[str, jnp.ndarray], cfg: ModelConfig, x: jnp.ndarray,
+    lora_idx=None,
+) -> jnp.ndarray:
     """SwiGLU (dense) or top-k MoE block. x: [T, E]."""
     if not cfg.is_moe:
         gate = jnp.einsum("te,ef->tf", x, wt(lp["w_gate"]))
         up = jnp.einsum("te,ef->tf", x, wt(lp["w_up"]))
-        return jnp.einsum("tf,fe->te", jax.nn.silu(gate) * up, wt(lp["w_down"]))
+        d = lora_ops.maybe_apply(lp, "w_gate", x, lora_idx, 1.0)
+        gate = gate + d if d is not None else gate
+        d = lora_ops.maybe_apply(lp, "w_up", x, lora_idx, 1.0)
+        up = up + d if d is not None else up
+        h = jax.nn.silu(gate) * up
+        out = jnp.einsum("tf,fe->te", h, wt(lp["w_down"]))
+        d = lora_ops.maybe_apply(lp, "w_down", h, lora_idx, 1.0)
+        return out + d if d is not None else out
     # MoE: router scores -> top-k weights; every expert's FFN runs on its
     # own shard and the top-k combine is a CONTRACTION over the expert
     # axis. With w_gate/w_up/w_down sharded on X over an `ep` mesh axis
@@ -175,12 +186,19 @@ def _mlp(lp: Dict[str, jnp.ndarray], cfg: ModelConfig, x: jnp.ndarray) -> jnp.nd
     return out
 
 
-def _qkv(lp, cfg: ModelConfig, x: jnp.ndarray, positions: jnp.ndarray):
+def _qkv(lp, cfg: ModelConfig, x: jnp.ndarray, positions: jnp.ndarray,
+         lora_idx=None):
     """x: [T, E] -> q [T, Hq, D], k/v [T, Hkv, D] with RoPE applied."""
     T = x.shape[0]
     q = jnp.einsum("te,eh->th", x, wt(lp["wq"]))
     k = jnp.einsum("te,eh->th", x, wt(lp["wk"]))
     v = jnp.einsum("te,eh->th", x, wt(lp["wv"]))
+    d = lora_ops.maybe_apply(lp, "wq", x, lora_idx, 1.0)
+    q = q + d if d is not None else q
+    d = lora_ops.maybe_apply(lp, "wk", x, lora_idx, 1.0)
+    k = k + d if d is not None else k
+    d = lora_ops.maybe_apply(lp, "wv", x, lora_idx, 1.0)
+    v = v + d if d is not None else v
     if cfg.attn_bias:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = q.reshape(T, cfg.num_heads, cfg.head_dim)
@@ -213,6 +231,7 @@ def decode_step(
     block_tables: jnp.ndarray,  # [R, max_blocks] int32
     active: jnp.ndarray,  # [R] bool
     use_kernel: bool | None = None,
+    lora_idx: jnp.ndarray | None = None,  # [R] per-slot adapter rows
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One generation step for R sequences. Returns (logits [R, V],
     k_caches', v_caches')."""
@@ -229,15 +248,18 @@ def decode_step(
     def layer_fn(x, scanned):
         lp, k_l, v_l = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(lp, cfg, h, positions)
+        q, k, v = _qkv(lp, cfg, h, positions, lora_idx)
         k_l, v_l = _scatter_kv(k_l, v_l, blk, offset, k, v)
         attn = paged_attention(
             q, k_l, v_l, block_tables, seq_lens, scale, use_kernel=use_kernel
         )
-        x = x + jnp.einsum("rh,he->re", attn.reshape(attn.shape[0], -1),
-                           wt(lp["wo"]).reshape(-1, cfg.hidden_size))
+        attn_flat = attn.reshape(attn.shape[0], -1)
+        o = jnp.einsum("rh,he->re", attn_flat,
+                       wt(lp["wo"]).reshape(-1, cfg.hidden_size))
+        d = lora_ops.maybe_apply(lp, "wo", attn_flat, lora_idx, 1.0)
+        x = x + (o + d if d is not None else o)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, cfg, h)
+        x = x + _mlp(lp, cfg, h, lora_idx)
         return x, (k_l, v_l)
 
     x, (k_caches, v_caches) = jax.lax.scan(
@@ -263,6 +285,7 @@ def prefill_batch_step(
     override_positions: jnp.ndarray | None = None,  # [P, M] chunk-relative;
     # padding entries point at Lpad (a dummy row, sliced off)
     all_logits: bool = False,  # speculative verify: unembed EVERY position
+    lora_idx: jnp.ndarray | None = None,  # [P] per-sequence adapter rows
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Prefill P sequences' chunks in ONE compiled step (batched admission).
 
@@ -297,12 +320,16 @@ def prefill_batch_step(
     flat_blk = blk.reshape(P * Lpad)
     flat_off = in_block.reshape(P * Lpad)
 
+    li = lora_idx if lora_idx is not None else jnp.zeros((P,), jnp.int32)
+
     def layer_fn(x, scanned):
         lp, k_l, v_l = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = jax.vmap(lambda hx, pos: _qkv(lp, cfg, hx, pos))(
-            h, positions
-        )  # q [P, Lpad, Hq, D]
+        q, k, v = jax.vmap(
+            lambda hx, pos, ai: _qkv(
+                lp, cfg, hx, pos, ai if lora_idx is not None else None
+            )
+        )(h, positions, li)  # q [P, Lpad, Hq, D]
         k_l, v_l = _scatter_kv(
             k_l, v_l, flat_blk, flat_off,
             k.reshape(P * Lpad, *k.shape[2:]),
@@ -311,10 +338,22 @@ def prefill_batch_step(
         attn = prefill_attention(
             q, k_l, v_l, block_tables, start_pos, true_len, scale
         )  # [P, Lpad, Hq, D] — flash kernel on TPU, blockwise elsewhere
-        x = x + jnp.einsum("plh,he->ple", attn.reshape(P, Lpad, -1),
-                           wt(lp["wo"]).reshape(-1, cfg.hidden_size))
+        attn_flat = attn.reshape(P, Lpad, -1)
+        o = jnp.einsum("plh,he->ple", attn_flat,
+                       wt(lp["wo"]).reshape(-1, cfg.hidden_size))
+        if lora_idx is not None and lp.get("lora_wo_a") is not None:
+            o = o + jax.vmap(
+                lambda af, ai: lora_ops.apply(
+                    af, lp["lora_wo_a"], lp["lora_wo_b"], ai
+                )
+            )(attn_flat, li)
+        x = x + o
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + jax.vmap(lambda t: _mlp(lp, cfg, t))(h)
+        x = x + jax.vmap(
+            lambda t, ai: _mlp(
+                lp, cfg, t, ai if lora_idx is not None else None
+            )
+        )(h, li)
         return x, (k_l, v_l)
 
     x, (k_caches, v_caches) = jax.lax.scan(

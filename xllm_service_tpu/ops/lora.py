@@ -1,0 +1,71 @@
+"""Multi-LoRA application for batched serving.
+
+Per-request low-rank adapters over one set of base weights (the
+vLLM/punica-class serving feature; no reference analog — the engine tier
+is an absent submodule there). TPU-first formulation: instead of
+gathering each slot's adapter matrices (a [R, E, r] HBM gather per
+projection per layer — hundreds of MB/step), compute the low-rank path
+against ALL adapters and select per slot:
+
+    xa    = einsum('...e, aer -> ...ar', x, A)     # [..., n_a, r]
+    delta = einsum('...ar, aro -> ...ao', xa, B)   # [..., n_a, out]
+    out  += take_along_axis(delta, idx)[..., 0, :] * scaling
+
+Extra FLOPs scale with n_a * r — for n_a <= 16, r <= 32 this is < 1% of
+the base matmul; HBM reads the stacked A/B once per layer (a few percent
+of base weight traffic). XLA fuses the chain; no dynamic shapes, no
+scatter/gather of weight matrices.
+
+Adapter index 0 is the reserved BASE row (all zeros): base-model
+requests ride the same compiled step with a guaranteed-zero delta.
+
+Adapter leaves live INSIDE params["layers"] under "lora_<name>_a" /
+"lora_<name>_b" keys with layer-major stacking [L, n_a, E, r] /
+[L, n_a, r, out], so the existing scan/jit/sharding plumbing carries
+them with zero signature changes; model code applies them when the keys
+are present (static pytree structure — presence is a trace-time branch).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+
+def apply(
+    x: jnp.ndarray,          # [..., E]
+    a: jnp.ndarray,          # [n_a, E, r]   (one layer's slice)
+    b: jnp.ndarray,          # [n_a, r, out]
+    idx: jnp.ndarray,        # [...] int32 — broadcastable to x's batch dims
+    scaling: float | jnp.ndarray = 1.0,
+) -> jnp.ndarray:
+    """The LoRA delta for every row's own adapter. Returns [..., out]."""
+    xa = jnp.einsum(
+        "...e,aer->...ar", x.astype(a.dtype), a
+    )  # [..., n_a, r]
+    delta = jnp.einsum("...ar,aro->...ao", xa, b)  # [..., n_a, out]
+    # idx may be a scalar (vmapped per-sequence paths) or per-row
+    idx_b = jnp.broadcast_to(
+        jnp.asarray(idx, jnp.int32), x.shape[:-1]
+    )
+    sel = jnp.take_along_axis(
+        delta, idx_b[..., None, None], axis=-2
+    )[..., 0, :]
+    return (sel * scaling).astype(x.dtype)
+
+
+def maybe_apply(
+    lp: Dict[str, jnp.ndarray],
+    name: str,
+    x: jnp.ndarray,
+    idx: Optional[jnp.ndarray],
+    scaling,
+) -> Optional[jnp.ndarray]:
+    """The delta for projection `name` if this layer carries adapters for
+    it (and a batch index was provided); None otherwise. Presence of the
+    lora_* keys is static, so the no-adapter path traces to nothing."""
+    a = lp.get(f"lora_{name}_a")
+    if a is None or idx is None:
+        return None
+    return apply(x, a, lp[f"lora_{name}_b"], idx, scaling)

@@ -67,6 +67,8 @@ class EngineRequest:
     # Guided decoding: "json" constrains the output to a JSON object via
     # the engine's mask table (set_guided_context must have been called).
     guided: Optional[str] = None
+    # Multi-LoRA adapter row in the executor's stacks (0 = base model).
+    adapter_idx: int = 0
 
     @property
     def has_media(self) -> bool:
@@ -402,7 +404,13 @@ class InferenceEngine:
             # Hash OUTSIDE the lock (long prompts hash thousands of blocks;
             # add_request/cancel must not stall behind it). Safe: this
             # thread is the only one that pops/appendlefts _waiting.
-            has_media = self._item_req(item).has_media
+            # Media requests bypass the cache (their KV depends on encoder
+            # embeddings the token-id hash cannot see); so do LoRA-adapter
+            # requests — their KV depends on the adapter, and the chained
+            # token-id hashes are adapter-blind (a base/other-adapter hit
+            # would serve the WRONG cached KV).
+            req0 = self._item_req(item)
+            has_media = req0.has_media or bool(req0.adapter_idx)
             head_hashes = (
                 []
                 if has_media
@@ -497,6 +505,9 @@ class InferenceEngine:
                 s
                 for s in batch
                 if not s.req.has_media
+                # LoRA requests stay on the batched path: the SP ring
+                # prefill has no adapter application
+                and not s.req.adapter_idx
                 and not _penalized_resume(s)
                 and s.prefilled <= s.num_cached
                 and len(s.tokens) - s.num_cached >= sp_thresh
@@ -554,6 +565,7 @@ class InferenceEngine:
                         and start + n >= len(seq.tokens)
                         else -1
                     ),
+                    adapter_idx=seq.req.adapter_idx,
                     # Only the FINAL chunk's sampled token survives, so
                     # intermediate chunks skip the [P, V] histogram (and
                     # the penalized compiled variant) entirely.
@@ -966,9 +978,14 @@ class InferenceEngine:
         from xllm_service_tpu.ops.sampling import pack_logit_bias
 
         bias_ids, bias_vals = pack_logit_bias(bias_rows, self.R)
+        adapter_idx = None
+        if any(sq.req.adapter_idx for sq in self._running.values()):
+            adapter_idx = np.zeros((self.R,), np.int32)
+            for slot, sq in self._running.items():
+                adapter_idx[slot] = sq.req.adapter_idx
         return SamplingBatch(
             temps, top_ks, top_ps, seeds, steps, presence, frequency,
-            bias_ids, bias_vals,
+            bias_ids, bias_vals, adapter_idx=adapter_idx,
         )
 
     def _decode_once(self) -> int:
@@ -1024,6 +1041,12 @@ class InferenceEngine:
         return produced
 
     # --------------------------------------------------- guided decoding
+
+    def set_lora_adapters(self, adapters) -> "Dict[str, int]":
+        """Install LoRA adapters on the executor (see
+        ModelExecutor.set_lora_adapters); returns {name: row}."""
+        self.lora_names = self.executor.set_lora_adapters(adapters)
+        return self.lora_names
 
     def set_guided_context(
         self, table: np.ndarray, token_bytes: List[bytes]
@@ -1216,9 +1239,10 @@ class InferenceEngine:
 
     def _commit_full_blocks(self, seq: _Seq) -> None:
         """Commit newly filled blocks under their chained hashes. Media
-        requests never commit: their KV depends on encoder embeddings the
-        token-id hash cannot see."""
-        if seq.req.has_media:
+        requests never commit (their KV depends on encoder embeddings the
+        token-id hash cannot see) and neither do LoRA-adapter requests
+        (adapter-dependent KV under adapter-blind hashes)."""
+        if seq.req.has_media or seq.req.adapter_idx:
             return
         full = len(seq.tokens) // self.block_size
         committed = seq.last_committed_block + 1

@@ -67,6 +67,9 @@ class SamplingBatch:
     # (set_guided_table); unguided slots carry the permissive row. None =
     # nothing guided in the batch. Decode: [R]; verify: [R, S].
     mask_rows: Optional[np.ndarray] = None
+    # Multi-LoRA: per-slot adapter rows (0 = base). None = whole batch on
+    # the base model (the LoRA einsums trace away entirely).
+    adapter_idx: Optional[np.ndarray] = None
 
 
 @dataclass
@@ -95,6 +98,8 @@ class PrefillItem:
     logit_bias: tuple = ()
     # Guided decoding mask row for the admission-sampled token (-1 = none).
     mask_row: int = -1
+    # Multi-LoRA adapter row (0 = base).
+    adapter_idx: int = 0
 
 
 _COMPILATION_CACHE_DIR: Optional[str] = None
@@ -326,6 +331,61 @@ class ModelExecutor:
         if not self.prefill_buckets or self.prefill_buckets[-1] < engine_cfg.max_seq_len:
             self.prefill_buckets.append(engine_cfg.max_seq_len)
 
+    # ------------------------------------------------------- multi-LoRA
+
+    def set_lora_adapters(self, adapters) -> Dict[str, int]:
+        """Install per-request LoRA adapters over the base weights.
+
+        `adapters`: {name: {proj: (A [L, E_in, r], B [L, r, out])}} with
+        proj in the family's QUANTIZABLE_WEIGHT_LEAVES names (wq, wk, wv,
+        wo, w_gate, w_up, w_down); scaling (alpha/r) must already be
+        folded into B (runtime/weights.load_lora_checkpoint does). The
+        stacks install into params["layers"] as lora_<proj>_{a,b} leaves
+        [L, n_a+1, ...] with the all-zero BASE row at index 0, so the
+        existing scan/jit plumbing carries them and requests with
+        adapter_idx 0 get exact base outputs. Returns {name: row}."""
+        if self.cfg.is_mla:
+            raise ValueError(
+                "LoRA serving is supported for the llama family only"
+            )
+        if not adapters:
+            return {}
+        names = list(adapters)
+        projs = sorted({p for a in adapters.values() for p in a})
+        if self.cfg.is_moe and any(
+            p in ("w_gate", "w_up", "w_down") for p in projs
+        ):
+            raise ValueError(
+                "LoRA on MoE expert MLPs is not supported (attention "
+                "projections only for MoE models)"
+            )
+        L = self.cfg.num_layers
+        with self.mesh:
+            rep = NamedSharding(self.mesh, P())
+            for proj in projs:
+                shapes = [
+                    adapters[n][proj] for n in names if proj in adapters[n]
+                ]
+                r = max(a.shape[-1] for a, _ in shapes)
+                e_in = shapes[0][0].shape[1]
+                out = shapes[0][1].shape[2]
+                A = np.zeros((L, len(names) + 1, e_in, r), np.float32)
+                B = np.zeros((L, len(names) + 1, r, out), np.float32)
+                for i, n in enumerate(names):
+                    if proj not in adapters[n]:
+                        continue
+                    a_n, b_n = adapters[n][proj]
+                    A[:, i + 1, :, : a_n.shape[-1]] = a_n
+                    B[:, i + 1, : b_n.shape[1], :] = b_n
+                self.params["layers"][f"lora_{proj}_a"] = jax.device_put(
+                    jnp.asarray(A, self.dtype), rep
+                )
+                self.params["layers"][f"lora_{proj}_b"] = jax.device_put(
+                    jnp.asarray(B, self.dtype), rep
+                )
+        self.lora_names = {n: i + 1 for i, n in enumerate(names)}
+        return self.lora_names
+
     # -------------------------------------------------- guided decoding
 
     def set_guided_table(self, table: np.ndarray) -> None:
@@ -496,8 +556,12 @@ class ModelExecutor:
         bias_vals=None,
         mask_rows=None,  # [R] rows into guided_table
         guided_table=None,  # [M+1, V] bool
+        lora_idx=None,  # [R] adapter rows (0 = base)
         use_kernel=None,
     ):
+        step_kwargs = (
+            {"lora_idx": lora_idx} if lora_idx is not None else {}
+        )
         logits, k_cache, v_cache = self.model_mod.decode_step(
             params,
             self.cfg,
@@ -508,6 +572,7 @@ class ModelExecutor:
             block_tables,
             active,
             use_kernel=use_kernel,
+            **step_kwargs,
         )
         tokens, logprob, _ = sampling_ops.sample_tokens(
             logits, temperature, top_k, top_p, step_keys,
@@ -544,11 +609,16 @@ class ModelExecutor:
         bias_vals=None,  # [P, K]
         mask_rows=None,  # [P] rows into guided_table
         guided_table=None,
+        lora_idx=None,  # [P] adapter rows (0 = base)
     ):
+        step_kwargs = (
+            {"lora_idx": lora_idx} if lora_idx is not None else {}
+        )
         logits, k_cache, v_cache = self.model_mod.prefill_batch_step(
             params, self.cfg, k_cache, v_cache, token_ids, start_pos,
             true_len, block_tables,
             embed_overrides=mm_embeds, override_positions=mm_positions,
+            **step_kwargs,
         )
         # Penalties at (re)admission: when any item in the group carries
         # presence/frequency penalties, the caller passes its prior-token
@@ -586,6 +656,7 @@ class ModelExecutor:
         bias_vals=None,
         mask_rows=None,  # [R, S] rows into guided_table
         guided_table=None,
+        lora_idx=None,  # [R] adapter rows (0 = base)
     ):
         """Speculative-decoding verify step: one forward pass over S
         positions per sequence (the prefill machinery with `all_logits`),
@@ -593,9 +664,12 @@ class ModelExecutor:
         for ALL S positions are written; rows past the accepted prefix are
         stale garbage that attention can never read (masked by seq_lens)
         and the next step overwrites."""
+        step_kwargs = (
+            {"lora_idx": lora_idx} if lora_idx is not None else {}
+        )
         logits, k_cache, v_cache = self.model_mod.prefill_batch_step(
             params, self.cfg, k_cache, v_cache, token_ids, start_pos,
-            true_len, block_tables, all_logits=True,
+            true_len, block_tables, all_logits=True, **step_kwargs,
         )  # [R, S, V]
         drafts = token_ids[:, 1:]
         tokens, logprobs, n_emit, counts = sampling_ops.speculative_sample(
@@ -662,6 +736,10 @@ class ModelExecutor:
             bias_kwargs.update(
                 mask_rows=jnp.asarray(batch.mask_rows, jnp.int32),
                 guided_table=self._guided_table,
+            )
+        if batch.adapter_idx is not None:
+            bias_kwargs.update(
+                lora_idx=jnp.asarray(batch.adapter_idx, jnp.int32)
             )
         (
             self.k_cache, self.v_cache, self.token_counts,
@@ -812,6 +890,14 @@ class ModelExecutor:
             pen_kwargs.update(
                 mask_rows=jnp.asarray(rows),
                 guided_table=self._guided_table,
+            )
+        if any(it.adapter_idx for it in group):
+            pen_kwargs.update(
+                lora_idx=jnp.asarray(
+                    [it.adapter_idx for it in group]
+                    + [0] * (P - n_real),
+                    jnp.int32,
+                )
             )
         if any(
             it.prior_tokens is not None and len(it.prior_tokens)
@@ -1106,6 +1192,10 @@ class ModelExecutor:
             bias_kwargs.update(
                 mask_rows=jnp.asarray(batch.mask_rows, jnp.int32),
                 guided_table=self._guided_table,
+            )
+        if batch.adapter_idx is not None:
+            bias_kwargs.update(
+                lora_idx=jnp.asarray(batch.adapter_idx, jnp.int32)
             )
         (
             self.k_cache, self.v_cache, self.token_counts, tokens, logprobs,

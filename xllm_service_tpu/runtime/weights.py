@@ -31,6 +31,7 @@ import json
 import mmap
 import os
 import struct
+import re
 from typing import Any, Dict, Iterator, Optional, Tuple
 
 import jax
@@ -869,3 +870,111 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
             tensors[pre + "mlp.up_proj.weight"] = host(lp["w_up"])[i].T
             tensors[pre + "mlp.down_proj.weight"] = host(lp["w_down"])[i].T
     write_safetensors(os.path.join(path, "model.safetensors"), tensors)
+
+
+# ------------------------------------------------------------- LoRA (peft)
+
+# peft target-module names -> stacked-leaf projection names (llama family)
+_LORA_PROJ_MAP = {
+    "q_proj": "wq",
+    "k_proj": "wk",
+    "v_proj": "wv",
+    "o_proj": "wo",
+    "gate_proj": "w_gate",
+    "up_proj": "w_up",
+    "down_proj": "w_down",
+}
+
+
+def load_lora_checkpoint(path: str, cfg: ModelConfig):
+    """Load one peft-layout LoRA adapter dir into the executor's format:
+    {proj: (A [L, in, r], B [L, r, out])} with the peft scaling
+    (lora_alpha / r) folded into B. Layers the adapter does not cover
+    stay zero. Expects `adapter_model.safetensors` with
+    `...layers.{i}.(self_attn|mlp).<target>.lora_{A,B}.weight` keys
+    (peft stores A as [r, in] and B as [out, r]) and an optional
+    `adapter_config.json` carrying r / lora_alpha."""
+    import json as _json
+
+    cfg_path = os.path.join(path, "adapter_config.json")
+    alpha = r_cfg = None
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            acfg = _json.load(f)
+        alpha = acfg.get("lora_alpha")
+        r_cfg = acfg.get("r")
+    st_path = os.path.join(path, "adapter_model.safetensors")
+    if not os.path.exists(st_path):
+        raise FileNotFoundError(f"no adapter_model.safetensors in {path}")
+
+    per_proj: Dict[str, Dict[int, Dict[str, np.ndarray]]] = {}
+    layer_re = re.compile(r"\.layers\.(\d+)\.")
+    for key, arr in read_safetensors(st_path):
+        m = layer_re.search(key)
+        if m is None:
+            continue
+        layer = int(m.group(1))
+        tail = key[m.end():]  # e.g. self_attn.q_proj.lora_A.weight
+        parts = tail.split(".")
+        if len(parts) < 3 or parts[-1] != "weight":
+            continue
+        which = parts[-2]  # lora_A | lora_B
+        target = parts[-3]
+        proj = _LORA_PROJ_MAP.get(target)
+        if proj is None or which not in ("lora_A", "lora_B"):
+            continue
+        per_proj.setdefault(proj, {}).setdefault(layer, {})[which] = arr
+    if not per_proj:
+        raise ValueError(f"{st_path}: no recognizable lora_A/lora_B keys")
+
+    out = {}
+    for proj, layers in per_proj.items():
+        any_layer = next(iter(layers.values()))
+        if "lora_A" not in any_layer or "lora_B" not in any_layer:
+            raise ValueError(f"{proj}: incomplete lora_A/lora_B pair")
+        r = any_layer["lora_A"].shape[0]
+        e_in = any_layer["lora_A"].shape[1]
+        e_out = any_layer["lora_B"].shape[0]
+        scaling = (alpha / (r_cfg or r)) if alpha else 1.0
+        A = np.zeros((cfg.num_layers, e_in, r), np.float32)
+        B = np.zeros((cfg.num_layers, r, e_out), np.float32)
+        for layer, pair in layers.items():
+            if layer >= cfg.num_layers:
+                raise ValueError(
+                    f"{proj}: adapter layer {layer} out of range"
+                )
+            A[layer] = pair["lora_A"].astype(np.float32).T  # [in, r]
+            B[layer] = pair["lora_B"].astype(np.float32).T * scaling
+        out[proj] = (A, B)
+    return out
+
+
+def save_lora_checkpoint(adapter, path: str, alpha=None, r=None) -> None:
+    """Write {proj: (A [L, in, r], B [L, r, out])} as a peft-layout dir
+    (testing/roundtrip; B is UNSCALED here — pass alpha/r to record the
+    scaling load_lora_checkpoint will fold in)."""
+    import json as _json
+
+    os.makedirs(path, exist_ok=True)
+    inv = {v: k for k, v in _LORA_PROJ_MAP.items()}
+    tensors: Dict[str, np.ndarray] = {}
+    for proj, (A, B) in adapter.items():
+        target = inv[proj]
+        grp = "self_attn" if proj.startswith("w") and proj[1] in "qkvo" \
+            else "mlp"
+        for layer in range(A.shape[0]):
+            base = (
+                f"base_model.model.model.layers.{layer}.{grp}.{target}"
+            )
+            tensors[f"{base}.lora_A.weight"] = np.ascontiguousarray(
+                A[layer].T.astype(np.float32)
+            )
+            tensors[f"{base}.lora_B.weight"] = np.ascontiguousarray(
+                B[layer].T.astype(np.float32)
+            )
+    write_safetensors(
+        os.path.join(path, "adapter_model.safetensors"), tensors
+    )
+    if alpha is not None:
+        with open(os.path.join(path, "adapter_config.json"), "w") as f:
+            _json.dump({"lora_alpha": alpha, "r": r}, f)
