@@ -68,3 +68,46 @@ def test_same_seed_same_step_deterministic():
     # Different step folds a different key (overwhelmingly likely to differ
     # somewhere over repeated draws; don't assert inequality per-row).
     assert a.shape == c.shape
+
+
+def test_min_p_filters_low_probability_tokens():
+    """min_p (vLLM semantics): tokens with prob < min_p * max_prob never
+    sample; min_p=0 leaves the distribution untouched."""
+    import numpy as np
+
+    from xllm_service_tpu.ops import sampling as ops
+
+    # Row: one dominant token (0), one mid (1), many tiny tails
+    logits = np.full((1, 16), -10.0, np.float32)
+    logits[0, 0] = 5.0
+    logits[0, 1] = 4.0
+    lg = jnp.asarray(logits)
+    temps = jnp.ones((1,), jnp.float32)
+    none_k = jnp.zeros((1,), jnp.int32)
+    none_p = jnp.ones((1,), jnp.float32)
+    seen = set()
+    for step in range(64):
+        keys = ops.make_step_keys(jnp.asarray([7], jnp.uint32), step)
+        tok, _, _ = ops.sample_tokens(
+            lg, temps, none_k, none_p, keys,
+            min_p=jnp.asarray([0.2], jnp.float32),
+        )
+        seen.add(int(tok[0]))
+    # only tokens 0 and 1 survive the 0.2 * max-prob floor
+    assert seen <= {0, 1} and 0 in seen
+
+    # min_p=0 disables: tail tokens remain reachable in principle — the
+    # filtered-vs-unfiltered logits must be identical
+    filt = ops.apply_top_k_top_p(
+        lg, none_k, none_p, jnp.zeros((1,), jnp.float32)
+    )
+    np.testing.assert_array_equal(np.asarray(filt), logits)
+
+
+def test_min_p_parses_from_body():
+    from xllm_service_tpu.api.protocol import sampling_from_body
+    from xllm_service_tpu.common.config import EngineConfig
+
+    sp = sampling_from_body({"min_p": 0.25}, EngineConfig())
+    assert sp.min_p == 0.25
+    assert sampling_from_body({}, EngineConfig()).min_p == 0.0

@@ -66,7 +66,7 @@ class KVHandoffMixin:
                 "max_tokens", "max_completion_tokens", "temperature",
                 "top_p", "top_k", "seed", "logprobs", "top_logprobs",
                 "ignore_eos", "presence_penalty", "frequency_penalty",
-                "logit_bias",
+                "logit_bias", "min_p",
             )
             if k in body
         }

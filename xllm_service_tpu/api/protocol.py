@@ -285,4 +285,5 @@ def sampling_from_body(body, cfg, vocab_size=None):
         presence_penalty=float(body.get("presence_penalty", 0.0) or 0.0),
         frequency_penalty=float(body.get("frequency_penalty", 0.0) or 0.0),
         logit_bias=logit_bias,
+        min_p=float(body.get("min_p", 0.0) or 0.0),
     )

@@ -25,6 +25,9 @@ class SamplingParams:
     temperature: float = 1.0
     top_p: float = 1.0
     top_k: int = 0  # 0 = disabled
+    # min_p (vLLM semantics): drop tokens whose probability is below
+    # min_p * max-probability. 0 disables. Applied with top-k/top-p.
+    min_p: float = 0.0
     seed: int = 0
     logprobs: bool = False
     top_logprobs: int = 0
@@ -47,11 +50,13 @@ class SamplingParams:
 
 
 def apply_top_k_top_p(
-    logits: jnp.ndarray, top_k: jnp.ndarray, top_p: jnp.ndarray
+    logits: jnp.ndarray, top_k: jnp.ndarray, top_p: jnp.ndarray,
+    min_p: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
-    """Combined per-row top-k + nucleus filtering with ONE descending argsort
-    (the sort over V dominates sampling cost at vocab ~128K). top_k<=0 and
-    top_p>=1 disable their respective filters; the argmax is always kept."""
+    """Combined per-row top-k + nucleus + min-p filtering with ONE
+    descending argsort (the sort over V dominates sampling cost at vocab
+    ~128K). top_k<=0, top_p>=1, and min_p<=0 disable their respective
+    filters; the argmax is always kept."""
     R, vocab = logits.shape
     order = jnp.argsort(logits, axis=-1)[:, ::-1]  # descending
     sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
@@ -65,7 +70,13 @@ def apply_top_k_top_p(
     # Token i is kept if the cumulative mass *before* it is < top_p.
     keep_p = (cum - probs) < top_p[:, None]
 
-    keep_sorted = (keep_k & keep_p).at[:, 0].set(True)
+    keep_sorted = keep_k & keep_p
+    if min_p is not None:
+        # vLLM semantics: prob >= min_p * max-prob (column 0 after the
+        # descending sort holds the max).
+        floor = jnp.where(min_p > 0, min_p, 0.0)[:, None] * probs[:, :1]
+        keep_sorted = keep_sorted & (probs >= floor)
+    keep_sorted = keep_sorted.at[:, 0].set(True)
     keep = jnp.zeros_like(keep_sorted).at[jnp.arange(R)[:, None], order].set(
         keep_sorted
     )
@@ -105,6 +116,7 @@ def sample_tokens(
     bias_ids: jnp.ndarray | None = None,  # [R, K] int32 (pad: id 0, bias 0)
     bias_vals: jnp.ndarray | None = None,  # [R, K] float32
     allowed: jnp.ndarray | None = None,  # [R, V] bool (guided decoding)
+    min_p: jnp.ndarray | None = None,  # [R] float32; 0 disables
 ):
     """Returns (token_ids [R], logprob_of_chosen [R], logprobs [R, V])."""
     logits = logits.astype(jnp.float32)
@@ -135,11 +147,13 @@ def sample_tokens(
     # filter enabled: greedy rows and filters-off rows don't need it.
     vocab = logits.shape[-1]
     needs_filter = (temperature > 0) & (
-        ((top_k > 0) & (top_k < vocab)) | (top_p < 1.0)
+        ((top_k > 0) & (top_k < vocab))
+        | (top_p < 1.0)
+        | ((min_p > 0) if min_p is not None else False)
     )
     scaled = jax.lax.cond(
         jnp.any(needs_filter),
-        lambda x: apply_top_k_top_p(x, top_k, top_p),
+        lambda x: apply_top_k_top_p(x, top_k, top_p, min_p),
         lambda x: x,
         scaled,
     )
@@ -171,6 +185,7 @@ def speculative_sample(
     bias_ids: jnp.ndarray | None = None,  # [R, K]
     bias_vals: jnp.ndarray | None = None,  # [R, K]
     allowed: jnp.ndarray | None = None,  # [R, S, V] bool per-position masks
+    min_p: jnp.ndarray | None = None,  # [R]
 ):
     """Speculative acceptance for point-mass (n-gram / prompt-lookup) drafts.
 
@@ -217,6 +232,7 @@ def speculative_sample(
             presence=presence, frequency=frequency,
             bias_ids=bias_ids, bias_vals=bias_vals,
             allowed=allow_j if have_mask else None,
+            min_p=min_p,
         )
         emit = going & (j < limits)
         if have_counts:

@@ -506,8 +506,13 @@ class InferenceEngine:
                 for s in batch
                 if not s.req.has_media
                 # LoRA requests stay on the batched path: the SP ring
-                # prefill has no adapter application
+                # prefill has no adapter application; likewise requests
+                # whose FIRST sampled token needs min_p / logit_bias /
+                # a guided mask — prefill_long samples without them
                 and not s.req.adapter_idx
+                and not getattr(s.req.sampling, "min_p", 0.0)
+                and not getattr(s.req.sampling, "logit_bias", ())
+                and not s.req.guided
                 and not _penalized_resume(s)
                 and s.prefilled <= s.num_cached
                 and len(s.tokens) - s.num_cached >= sp_thresh
@@ -566,6 +571,13 @@ class InferenceEngine:
                         else -1
                     ),
                     adapter_idx=seq.req.adapter_idx,
+                    # final chunk only, like logit_bias/mask_row: the
+                    # intermediate chunks' sampled tokens are discarded
+                    min_p=(
+                        getattr(s, "min_p", 0.0)
+                        if start + n >= len(seq.tokens)
+                        else 0.0
+                    ),
                     # Only the FINAL chunk's sampled token survives, so
                     # intermediate chunks skip the [P, V] histogram (and
                     # the penalized compiled variant) entirely.
@@ -983,9 +995,17 @@ class InferenceEngine:
             adapter_idx = np.zeros((self.R,), np.int32)
             for slot, sq in self._running.items():
                 adapter_idx[slot] = sq.req.adapter_idx
+        min_p = None
+        if any(
+            getattr(sq.req.sampling, "min_p", 0.0)
+            for sq in self._running.values()
+        ):
+            min_p = np.zeros((self.R,), np.float32)
+            for slot, sq in self._running.items():
+                min_p[slot] = getattr(sq.req.sampling, "min_p", 0.0)
         return SamplingBatch(
             temps, top_ks, top_ps, seeds, steps, presence, frequency,
-            bias_ids, bias_vals, adapter_idx=adapter_idx,
+            bias_ids, bias_vals, adapter_idx=adapter_idx, min_p=min_p,
         )
 
     def _decode_once(self) -> int:

@@ -70,6 +70,8 @@ class SamplingBatch:
     # Multi-LoRA: per-slot adapter rows (0 = base). None = whole batch on
     # the base model (the LoRA einsums trace away entirely).
     adapter_idx: Optional[np.ndarray] = None
+    # min_p filtering; None = disabled for the whole batch.
+    min_p: Optional[np.ndarray] = None
 
 
 @dataclass
@@ -100,6 +102,7 @@ class PrefillItem:
     mask_row: int = -1
     # Multi-LoRA adapter row (0 = base).
     adapter_idx: int = 0
+    min_p: float = 0.0
 
 
 _COMPILATION_CACHE_DIR: Optional[str] = None
@@ -557,6 +560,7 @@ class ModelExecutor:
         mask_rows=None,  # [R] rows into guided_table
         guided_table=None,  # [M+1, V] bool
         lora_idx=None,  # [R] adapter rows (0 = base)
+        min_p=None,  # [R]
         use_kernel=None,
     ):
         step_kwargs = (
@@ -581,6 +585,7 @@ class ModelExecutor:
             allowed=(
                 guided_table[mask_rows] if mask_rows is not None else None
             ),
+            min_p=min_p,
         )
         counts = counts.at[
             jnp.arange(tokens.shape[0]), tokens
@@ -610,6 +615,7 @@ class ModelExecutor:
         mask_rows=None,  # [P] rows into guided_table
         guided_table=None,
         lora_idx=None,  # [P] adapter rows (0 = base)
+        min_p=None,  # [P]
     ):
         step_kwargs = (
             {"lora_idx": lora_idx} if lora_idx is not None else {}
@@ -632,6 +638,7 @@ class ModelExecutor:
             allowed=(
                 guided_table[mask_rows] if mask_rows is not None else None
             ),
+            min_p=min_p,
         )
         return k_cache, v_cache, tokens, logprob
 
@@ -657,6 +664,7 @@ class ModelExecutor:
         mask_rows=None,  # [R, S] rows into guided_table
         guided_table=None,
         lora_idx=None,  # [R] adapter rows (0 = base)
+        min_p=None,  # [R]
     ):
         """Speculative-decoding verify step: one forward pass over S
         positions per sequence (the prefill machinery with `all_logits`),
@@ -680,6 +688,7 @@ class ModelExecutor:
             allowed=(
                 guided_table[mask_rows] if mask_rows is not None else None
             ),
+            min_p=min_p,
         )
         return k_cache, v_cache, counts, tokens, logprobs, n_emit
 
@@ -741,6 +750,8 @@ class ModelExecutor:
             bias_kwargs.update(
                 lora_idx=jnp.asarray(batch.adapter_idx, jnp.int32)
             )
+        if batch.min_p is not None:
+            bias_kwargs.update(min_p=jnp.asarray(batch.min_p, jnp.float32))
         (
             self.k_cache, self.v_cache, self.token_counts,
             tokens, logprobs, n_emit,
@@ -897,6 +908,13 @@ class ModelExecutor:
                     [it.adapter_idx for it in group]
                     + [0] * (P - n_real),
                     jnp.int32,
+                )
+            )
+        if any(it.min_p for it in group):
+            pen_kwargs.update(
+                min_p=jnp.asarray(
+                    [it.min_p for it in group] + [0.0] * (P - n_real),
+                    jnp.float32,
                 )
             )
         if any(
@@ -1197,6 +1215,8 @@ class ModelExecutor:
             bias_kwargs.update(
                 lora_idx=jnp.asarray(batch.adapter_idx, jnp.int32)
             )
+        if batch.min_p is not None:
+            bias_kwargs.update(min_p=jnp.asarray(batch.min_p, jnp.float32))
         (
             self.k_cache, self.v_cache, self.token_counts, tokens, logprobs,
         ) = self._decode_jit(
