@@ -150,3 +150,102 @@ def test_engine_concurrency_fuzz(seed, weight_dtype):
     assert not eng._running
     assert len(eng._free_slots) == cfg.max_running_requests
     assert not eng._waiting
+
+
+@pytest.mark.parametrize("seed", [11, 12], ids=["s11", "s12"])
+def test_engine_concurrency_fuzz_round3_features(seed):
+    """The same invariant fuzz with the round-3 feature surface mixed in:
+    speculative decoding engine-wide, and per-request random combinations
+    of LoRA adapters, logit_bias, min_p, and guided JSON — racing
+    add/cancel/reject against preemption on a tight pool."""
+    from tests.test_lora import _rand_adapter
+    from xllm_service_tpu.guided import json_fsm
+    from xllm_service_tpu.tokenizer import ByteTokenizer
+
+    cfg = EngineConfig(
+        model="llama3-tiny",
+        dtype="float32",
+        block_size=16,
+        num_blocks=48,
+        max_running_requests=4,
+        max_seq_len=128,
+        prefill_buckets=[32, 64, 128],
+        speculative_tokens=2,
+    )
+    ex = ModelExecutor(cfg, init_seed=7)
+    np_rng = np.random.default_rng(seed)
+    ex.set_lora_adapters(
+        {"fuzz-a": _rand_adapter(ex.cfg, np_rng, r=4, projs=("wq", "wv"))}
+    )
+    eng = InferenceEngine(cfg, executor=ex, eos_token_ids=(2,))
+    tok = ByteTokenizer()
+    tb = tok.token_bytes_table(ex.cfg.vocab_size)
+    eng.set_guided_context(json_fsm.token_mask_table(tb, [2]), tb)
+    eng.start()
+    rng = random.Random(seed)
+    N = 18
+    trackers = []
+    try:
+        def client(base):
+            for i in range(N // 3):
+                rid = f"r3s{seed}-c{base}-{i}"
+                kind = rng.random()
+                cancel_after = 2 if kind < 0.2 else None
+                t = TerminalTracker(rid, cancel_after, eng)
+                trackers.append(t)
+                prompt = np_rng.integers(
+                    1, 500, (int(np_rng.integers(3, 80)),)
+                ).tolist()
+                feat = rng.random()
+                sp = SamplingParams(
+                    temperature=rng.choice([0.0, 0.8]),
+                    seed=rng.randrange(2**31),
+                    max_new_tokens=int(np_rng.integers(1, 8)),
+                    logit_bias=(
+                        ((int(np_rng.integers(0, 500)), 25.0),)
+                        if feat > 0.7 else ()
+                    ),
+                    min_p=0.1 if 0.5 < feat <= 0.7 else 0.0,
+                )
+                eng.add_request(
+                    EngineRequest(
+                        request_id=rid,
+                        prompt_token_ids=prompt,
+                        sampling=sp,
+                        callback=t,
+                        adapter_idx=1 if feat < 0.3 else 0,
+                        guided="json" if 0.3 <= feat <= 0.5 else None,
+                    )
+                )
+                if kind > 0.85:
+                    time.sleep(rng.random() * 0.02)
+                    eng.cancel(rid)
+                time.sleep(rng.random() * 0.01)
+
+        threads = [
+            threading.Thread(target=client, args=(b,)) for b in range(3)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        deadline = time.monotonic() + 180
+        for t in trackers:
+            assert t.done.wait(max(0.1, deadline - time.monotonic())), (
+                f"request {t.rid} never reached a terminal state "
+                f"(tokens={t.n_tokens})"
+            )
+    finally:
+        eng.stop()
+
+    for t in trackers:
+        assert t.post_terminal == 0, (
+            f"{t.rid}: {t.post_terminal} outputs after terminal emission"
+        )
+        assert t.terminal in ("finished", "error"), t.terminal
+    bm = eng.block_mgr
+    assert bm.num_referenced_blocks == 0
+    assert bm.num_free_blocks == bm.num_blocks - 1
+    assert not eng._running
+    assert len(eng._free_slots) == cfg.max_running_requests
+    assert not eng._waiting
