@@ -294,7 +294,8 @@ def run_prefill_case(P, Lpad, Hq, Hkv, D, BS, MB, dtype=jnp.bfloat16,
     return err
 
 
-def run_mla_prefill_case(P, Lpad, Hq, kvr, dr, BS, MB, dtype=jnp.bfloat16):
+def run_mla_prefill_case(P, Lpad, Hq, kvr, dr, BS, MB, dtype=jnp.bfloat16,
+                         int8=False):
     """MLA flash prefill kernel vs the blockwise oracle on hardware."""
     from xllm_service_tpu.ops.attention import mla_prefill_blockwise
     from xllm_service_tpu.ops.pallas.mla_prefill import (
@@ -306,6 +307,11 @@ def run_mla_prefill_case(P, Lpad, Hq, kvr, dr, BS, MB, dtype=jnp.bfloat16):
     N = P * MB + 1
     q = jnp.asarray(rng.standard_normal((P, Lpad, Hq, C)), dtype)
     cache = jnp.asarray(rng.standard_normal((N, 1, BS, C)), dtype)
+    if int8:
+        from xllm_service_tpu.ops import kv_cache as kvc
+
+        G = kvc.mla_scale_groups(kvr, dr)
+        cache = kvc.PagedKV(*kvc.quantize_rows(cache, G))
     bt = jnp.asarray(1 + np.arange(P * MB).reshape(P, MB) % (N - 1), jnp.int32)
     sp = jnp.asarray(rng.integers(0, BS, P), jnp.int32)
     tl = jnp.asarray(
@@ -381,6 +387,9 @@ CASES = [
           int8=True)),
     ("mq-mla-int8", run_mla_mq_case,
      dict(R=32, S=4, Hq=128, kvr=512, dr=64, BS=128, MB=16, ctx=2048,
+          int8=True)),
+    ("mla-prefill-int8", run_mla_prefill_case,
+     dict(P=2, Lpad=512, Hq=128, kvr=512, dr=64, BS=128, MB=8,
           int8=True)),
     # bf16 decode (re-validated round 2; re-run last)
     ("dec-bf16-prod", run_case,

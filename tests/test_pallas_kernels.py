@@ -645,3 +645,32 @@ def test_mla_dispatcher_int8_kernel_branch(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), atol=2e-2, rtol=2e-2
     )
+
+
+def test_mla_flash_prefill_int8_matches_blockwise():
+    """Int8 latent cache through the MLA flash-prefill kernel (scale
+    plane + VMEM dequant) vs the blockwise oracle on the SAME quantized
+    cache."""
+    from xllm_service_tpu.ops.attention import mla_prefill_attention
+    from xllm_service_tpu.ops.pallas.mla_prefill import (
+        mla_flash_prefill_kernel,
+    )
+
+    rng = np.random.default_rng(13)
+    kvr, dr = 40, 16
+    q, cache, bt = make_mla_prefill_case(rng, P=2, Lpad=32, C=56, MB=8)
+    qc = _quantize_mla_cache(cache, kvr, dr)
+    start_pos = jnp.asarray([0, 8], jnp.int32)
+    true_len = jnp.asarray([32, 17], jnp.int32)
+    ref = mla_prefill_attention(
+        q, qc, bt, start_pos, true_len, 0.125, kvr, use_kernel=False
+    )
+    out = mla_flash_prefill_kernel(
+        q, qc, bt, start_pos, true_len, 0.125, kvr, interpret=True,
+        tile_q=16,
+    )
+    for p, tl in enumerate([32, 17]):
+        np.testing.assert_allclose(
+            np.asarray(out)[p, :tl], np.asarray(ref)[p, :tl],
+            atol=2e-2, rtol=2e-2,
+        )

@@ -316,11 +316,10 @@ def mla_prefill_attention(
 ) -> jnp.ndarray:
     """Batched MLA chunked-prefill attention; Pallas flash kernel
     (ops/pallas/mla_prefill.py) on TPU, vmapped blockwise scan elsewhere.
-    Quantized latent caches take the blockwise path for the FLASH kernel
-    (mla_flash_prefill_kernel has no int8 plane yet) but DO ride the
-    multi-query verify kernel below, which dequantizes in VMEM;
-    XLLM_MLA_PREFILL_KERNEL=0/1 forces the flash path, `interpret` drives
-    the kernel branches in CI."""
+    Int8 latent caches ride both kernel branches (sub-channel scales
+    stream in their own plane, VMEM dequant); XLLM_MLA_PREFILL_KERNEL=0/1
+    forces the flash path, `interpret` drives the kernel branches in
+    CI."""
     import os
 
     quantized = isinstance(c_cache, kvc.PagedKV) and c_cache.quantized
@@ -346,15 +345,18 @@ def mla_prefill_attention(
         )
     if use_kernel is None:
         env = os.environ.get("XLLM_MLA_PREFILL_KERNEL")
+        # int8 stays OPT-IN (env == "1") until the mla-prefill-int8 chip
+        # case validates — the convention for every unvalidated kernel
+        # path; bf16 keeps its existing default.
         kernel_ok = (_on_tpu() or interpret) and not quantized
         use_kernel = (env != "0") if kernel_ok else (env == "1")
-    if use_kernel and not quantized:
+    if use_kernel:
         from xllm_service_tpu.ops.pallas.mla_prefill import (
             mla_flash_prefill_kernel,
         )
 
         return mla_flash_prefill_kernel(
-            q_lat, kvc.raw(c_cache), block_tables, start_pos, true_len,
+            q_lat, c_cache, block_tables, start_pos, true_len,
             scale, kv_rank, interpret=interpret,
         )
     return jax.vmap(

@@ -37,6 +37,28 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _dequant_tile(tile, s_rows_buf, chunk, block_size, scale_groups):
+    """VMEM dequant of an int8 latent tile [CH*BS, C] with per-(row,
+    group) scales [CH, BS*G]: expand the scales to the C lanes via a
+    constant 0/1 matmul (E[g, c] = 1 iff c's group is g) — no lane
+    reshapes, which Mosaic dislikes. HBM already moved int8 bytes; this
+    is VPU/MXU work on resident data. Shared by the MLA decode,
+    multi-query, and flash-prefill kernels."""
+    C = tile.shape[-1]
+    gsz = C // scale_groups
+    E = (
+        jax.lax.broadcasted_iota(jnp.int32, (scale_groups, C), 1) // gsz
+        == jax.lax.broadcasted_iota(jnp.int32, (scale_groups, C), 0)
+    ).astype(jnp.float32)
+    sc = s_rows_buf.reshape(chunk * block_size, scale_groups)
+    s_exp = jax.lax.dot_general(
+        sc, E,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [CH*BS, C]
+    return (tile.astype(jnp.float32) * s_exp).astype(jnp.bfloat16)
+
+
 def _mla_kernel(
     # scalar prefetch
     block_table_ref,  # [R, MBp] SMEM
@@ -124,27 +146,9 @@ def _mla_kernel(
         wait_chunk(slot, c)
         tile = c_buf[slot]  # [CH*BS, C]
         if quantized:
-            # Dequantize in VMEM: per-(row, group) scales expand to the C
-            # lanes via a constant 0/1 matmul (E[g, c] = 1 iff c's group
-            # is g) — no lane reshapes, which Mosaic dislikes. HBM still
-            # moved int8 bytes; this is VPU/MXU work on resident data.
-            C = tile.shape[-1]
-            gsz = C // scale_groups
-            E = (
-                jax.lax.broadcasted_iota(
-                    jnp.int32, (scale_groups, C), 1
-                ) // gsz
-                == jax.lax.broadcasted_iota(
-                    jnp.int32, (scale_groups, C), 0
-                )
-            ).astype(jnp.float32)
-            sc = s_buf[slot].reshape(chunk * block_size, scale_groups)
-            s_exp = jax.lax.dot_general(
-                sc, E,
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # [CH*BS, C]
-            tile = (tile.astype(jnp.float32) * s_exp).astype(jnp.bfloat16)
+            tile = _dequant_tile(
+                tile, s_buf[slot], chunk, block_size, scale_groups
+            )
         scores = (
             jax.lax.dot_general(
                 q, tile,

@@ -37,20 +37,28 @@ def _mla_prefill_kernel(
     true_len_ref,     # [P] SMEM
     # inputs
     q_ref,            # [1, 1, Rp, C] VMEM (one tile's TQ*Hq rows)
-    c_hbm,            # [N, 1, BS, C] HBM
+    c_hbm,            # [N, 1, BS, C] HBM — bf16 or int8
+    *rest,            # quantized: cs_hbm [N, BS*G] f32, then
     # output
-    o_ref,            # [1, 1, Rp, KVR] VMEM
+    #   o_ref         # [1, 1, Rp, KVR] VMEM
     # scratch
-    c_buf,            # [2, CH*BS, C] VMEM
-    sems,             # [2, CH] DMA semaphores
-    *,
+    #   c_buf         # [2, CH*BS, C] VMEM (cache dtype)
+    #   sems          # [2, CH]
+    #   (quantized)   s_buf [2, CH, BS*G] f32 + ssems [2, CH]
     block_size: int,
     chunk: int,
     tile_q: int,
     heads: int,
     scale: float,
     kv_rank: int,
+    quantized: bool = False,
+    scale_groups: int = 1,
 ):
+    if quantized:
+        cs_hbm, o_ref, c_buf, sems, s_buf, ssems = rest
+    else:
+        o_ref, c_buf, sems = rest
+        cs_hbm = s_buf = ssems = None
     p = pl.program_id(0)
     t = pl.program_id(1)
     start = start_pos_ref[p]
@@ -61,20 +69,33 @@ def _mla_prefill_kernel(
     ctx = start + jnp.minimum(tile_lo + tile_q, n_valid)
     nc = jnp.where(tile_lo < n_valid, pl.cdiv(ctx, span), 0)
 
-    def dma(slot, c_idx, blk):
-        return pltpu.make_async_copy(
-            c_hbm.at[blk, 0],
-            c_buf.at[slot, pl.ds(c_idx * block_size, block_size)],
-            sems.at[slot, c_idx],
-        )
+    def dmas(slot, c_idx, blk):
+        out = [
+            pltpu.make_async_copy(
+                c_hbm.at[blk, 0],
+                c_buf.at[slot, pl.ds(c_idx * block_size, block_size)],
+                sems.at[slot, c_idx],
+            )
+        ]
+        if quantized:
+            out.append(
+                pltpu.make_async_copy(
+                    cs_hbm.at[blk],
+                    s_buf.at[slot, c_idx],
+                    ssems.at[slot, c_idx],
+                )
+            )
+        return out
 
     def start_chunk(slot, c):
         for c_idx in range(chunk):
-            dma(slot, c_idx, block_table_ref[p, c * chunk + c_idx]).start()
+            for d in dmas(slot, c_idx, block_table_ref[p, c * chunk + c_idx]):
+                d.start()
 
     def wait_chunk(slot, c):
         for c_idx in range(chunk):
-            dma(slot, c_idx, block_table_ref[p, c * chunk + c_idx]).wait()
+            for d in dmas(slot, c_idx, block_table_ref[p, c * chunk + c_idx]):
+                d.wait()
 
     @pl.when(nc > 0)
     def _first():
@@ -96,6 +117,14 @@ def _mla_prefill_kernel(
 
         wait_chunk(slot, c)
         tile = c_buf[slot]  # [CH*BS, C]
+        if quantized:
+            from xllm_service_tpu.ops.pallas.mla_attention import (
+                _dequant_tile,
+            )
+
+            tile = _dequant_tile(
+                tile, s_buf[slot], chunk, block_size, scale_groups
+            )
         scores = (
             jax.lax.dot_general(
                 q, tile,
@@ -144,7 +173,7 @@ def _round_up(x: int, m: int) -> int:
 )
 def mla_flash_prefill_kernel(
     q_lat: jnp.ndarray,        # [P, Lpad, Hq, C]
-    c_cache: jnp.ndarray,      # [N, 1, BS, C] plain array (int8 uses gather)
+    c_cache,                   # [N, 1, BS, C] plain array or PagedKV
     block_table: jnp.ndarray,  # [P, MB] int32
     start_pos: jnp.ndarray,    # [P] int32
     true_len: jnp.ndarray,     # [P] int32
@@ -154,6 +183,11 @@ def mla_flash_prefill_kernel(
     chunk: int = 4,
     tile_q: int = 128,
 ) -> jnp.ndarray:
+    from xllm_service_tpu.ops.pallas.mla_attention import _mla_common
+
+    c_data, scales, G = _mla_common(c_cache)
+    quantized = scales is not None
+    c_cache = c_data
     P, Lpad, Hq, C = q_lat.shape
     N, _, BS, _ = c_cache.shape
     MB = block_table.shape[1]
@@ -177,24 +211,40 @@ def mla_flash_prefill_kernel(
     if MBp != MB:
         bt = jnp.pad(bt, ((0, 0), (0, MBp - MB)))
 
+    hbm = pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM)
+    in_specs = [
+        pl.BlockSpec((1, 1, Rp, C), lambda p, t, bt, sp, tl: (p, t, 0, 0)),
+        hbm,
+    ]
+    inputs = [
+        bt, start_pos.astype(jnp.int32), true_len.astype(jnp.int32),
+        qt, c_cache,
+    ]
+    scratch = [
+        pltpu.VMEM((2, CH * BS, C), c_cache.dtype),
+        pltpu.SemaphoreType.DMA((2, CH)),
+    ]
+    row_bytes = C * c_cache.dtype.itemsize
+    if quantized:
+        in_specs.append(hbm)
+        inputs.append(scales)
+        scratch += [
+            pltpu.VMEM((2, CH, BS * G), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, CH)),
+        ]
+        row_bytes += 4 * G
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(P, NT),
-        in_specs=[
-            pl.BlockSpec((1, 1, Rp, C), lambda p, t, bt, sp, tl: (p, t, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, Rp, kv_rank), lambda p, t, bt, sp, tl: (p, t, 0, 0)
         ),
-        scratch_shapes=[
-            pltpu.VMEM((2, CH * BS, C), c_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, CH)),
-        ],
+        scratch_shapes=scratch,
     )
     kernel = functools.partial(
         _mla_prefill_kernel, block_size=BS, chunk=CH, tile_q=TQ, heads=Hq,
-        scale=scale, kv_rank=kv_rank,
+        scale=scale, kv_rank=kv_rank, quantized=quantized, scale_groups=G,
     )
     out = pl.pallas_call(
         kernel,
@@ -207,10 +257,10 @@ def mla_flash_prefill_kernel(
             flops=2 * P * Hq * (C + kv_rank) * Lp * MB * BS // max(NT, 1),
             bytes_accessed=(
                 P * Lp * Hq * C * 4
-                + P * NT * MB * BS * C * c_cache.dtype.itemsize
+                + P * NT * MB * BS * row_bytes
             ),
             transcendentals=P * Hq * Lp * MB * BS // max(NT, 1),
         ),
         interpret=interpret,
-    )(bt, start_pos.astype(jnp.int32), true_len.astype(jnp.int32), qt, c_cache)
+    )(*inputs)
     return out.reshape(P, Lp, Hq, kv_rank)[:, :Lpad]
