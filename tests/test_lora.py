@@ -296,3 +296,51 @@ def test_api_adapter_routing_e2e(tmp_path):
         inst.stop()
         master.stop()
         store.close()
+
+
+def test_master_models_lists_adapters(tmp_path):
+    """Registration metadata carries adapter names; the master's
+    /v1/models merges them cluster-wide."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from xllm_service_tpu.api import Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+    from xllm_service_tpu.models.configs import get_model_config
+    from xllm_service_tpu.runtime.weights import save_lora_checkpoint
+    from tests.test_api_e2e import http_get, wait_until
+
+    cfg = get_model_config("llama3-tiny")
+    rng = np.random.default_rng(6)
+    save_lora_checkpoint(
+        _rand_adapter(cfg, rng, r=4, projs=("wq",)), str(tmp_path)
+    )
+    store = MemoryStore(clock=lambda: 0.0)
+    master = Master(
+        ServiceConfig(host="127.0.0.1", http_port=0, rpc_port=0,
+                      heartbeat_interval_s=0.2, master_lease_ttl_s=1.0,
+                      block_size=16),
+        store=store,
+    )
+    master.start()
+    inst = InstanceServer(
+        _cfg(instance_name="ml0", instance_type="MIX"),
+        master_rpc_addr=master.rpc_address,
+        heartbeat_interval_s=0.2,
+        lora_adapters={"cluster-ft": str(tmp_path)},
+    )
+    inst.start()
+    try:
+        assert wait_until(
+            lambda: sum(master.scheduler.instance_mgr.counts()) == 1
+        )
+        code, models = http_get(master.http_address, "/v1/models")
+        assert code == 200
+        ids = [m["id"] for m in models["data"]]
+        assert "cluster-ft" in ids and "llama3-tiny" in ids
+    finally:
+        inst.stop()
+        master.stop()
+        store.close()

@@ -130,6 +130,7 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
             type=InstanceType.parse(engine_cfg.instance_type),
             dp_size=engine_cfg.dp_size,
             tp_size=engine_cfg.tp_size,
+            lora_adapters=sorted(self.lora_names),
         )
         ttft, tpot = self.engine.profiling_data()
         self.meta.ttft_profiling_data = ttft

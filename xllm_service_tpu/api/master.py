@@ -248,6 +248,11 @@ class Master:
                     for m in self.scheduler.instance_mgr.list_instances()
                     if m.model_name
                 }
+                | {
+                    a
+                    for m in self.scheduler.instance_mgr.list_instances()
+                    for a in getattr(m, "lora_adapters", [])
+                }
             )
             h.send_json(
                 {

@@ -184,6 +184,9 @@ class InstanceMetaInfo:
     instance_index: int = -1
     # Current role of a MIX instance (SLO-aware PD flipping; types.h:192-194).
     current_type: InstanceType = InstanceType.PREFILL
+    # LoRA adapter names this instance serves (requests with model=<name>
+    # route to the adapter; surfaced cluster-wide via /v1/models).
+    lora_adapters: List[str] = field(default_factory=list)
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -202,6 +205,7 @@ class InstanceMetaInfo:
             "tpot_profiling_data": [list(p) for p in self.tpot_profiling_data],
             "latest_timestamp": self.latest_timestamp,
             "current_type": int(self.current_type),
+            "lora_adapters": list(self.lora_adapters),
         }
 
     @classmethod
@@ -227,6 +231,7 @@ class InstanceMetaInfo:
             ],
             latest_timestamp=int(j.get("latest_timestamp", 0)),
             current_type=InstanceType(int(j.get("current_type", 1))),
+            lora_adapters=[str(x) for x in j.get("lora_adapters", [])],
         )
 
     def serialize(self) -> str:
