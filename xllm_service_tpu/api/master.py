@@ -242,18 +242,12 @@ class Master:
         if route == "/hello":
             h.send_json({"message": "hello from xllm-service-tpu master"})
         elif route == "/v1/models":
-            models = sorted(
-                {
-                    m.model_name
-                    for m in self.scheduler.instance_mgr.list_instances()
-                    if m.model_name
-                }
-                | {
-                    a
-                    for m in self.scheduler.instance_mgr.list_instances()
-                    for a in getattr(m, "lora_adapters", [])
-                }
-            )
+            names = set()
+            for m in self.scheduler.instance_mgr.list_instances():
+                if m.model_name:
+                    names.add(m.model_name)
+                names.update(m.lora_adapters)
+            models = sorted(names)
             h.send_json(
                 {
                     "object": "list",

@@ -21,9 +21,10 @@ kernel's loop structure with a query-tile axis):
   * causal + ragged masking by absolute position: row r (query position
     start_pos + t*TQ + r//G) keeps column c*span + j iff that cache
     position <= its own, and rows past true_len are dead (l=0 → zeros).
-  * int8 caches: per-row scales ride as [N, Hkv*BS] f32 rows and fold
-    into score columns (K) and probability columns (V) — same scheme the
-    decode kernel chip-validated.
+  * int8 caches: per-row scales ride pool-native as [N, Hkv, BS] f32 —
+    one full-extent [Hkv, BS] tile DMA per block — and fold into score
+    columns (K) and probability columns (V), same scheme as the decode
+    kernel.
 
 Layouts: q [P, Lpad, Hq, D] (chunk-relative), caches [N, Hkv, BS, D],
 block_table [P, MB] int32, start_pos/true_len [P] int32. Returns
@@ -40,6 +41,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from xllm_service_tpu.ops.pallas.paged_attention import _head_scale_row
+
 NEG_INF = -1e30
 
 
@@ -52,7 +55,8 @@ def _prefill_kernel(
     q_ref,            # [1, 1, 1, Rp, D] VMEM (one tile's TQ*G rows)
     k_hbm,            # [N, Hkv, BS, D] HBM
     v_hbm,            # [N, Hkv, BS, D] HBM
-    *rest,            # quantized: ks_hbm, vs_hbm; then o_ref + scratch
+    *rest,            # quantized: ks_hbm, vs_hbm [N, Hkv, BS] f32; then
+    # o_ref + scratch (quantized scale bufs are [2, C, Hkv, BS] f32)
     block_size: int,
     chunk: int,
     tile_q: int,
@@ -92,16 +96,19 @@ def _prefill_kernel(
             ),
         ]
         if quantized:
+            # Full-extent [Hkv, BS] scale tile per block (blk on the
+            # untiled dim); compute selects head h — see
+            # paged_attention._head_scale_row for why.
             out.append(
                 pltpu.make_async_copy(
-                    ks_hbm.at[blk, pl.ds(h * block_size, block_size)],
+                    ks_hbm.at[blk],
                     ks_buf.at[slot, c_idx],
                     ssems.at[slot, 0, c_idx],
                 )
             )
             out.append(
                 pltpu.make_async_copy(
-                    vs_hbm.at[blk, pl.ds(h * block_size, block_size)],
+                    vs_hbm.at[blk],
                     vs_buf.at[slot, c_idx],
                     ssems.at[slot, 1, c_idx],
                 )
@@ -155,7 +162,7 @@ def _prefill_kernel(
             * scale
         )  # [Rp, C*BS] f32
         if quantized:
-            scores = scores * ks_buf[slot].reshape(1, chunk * block_size)
+            scores = scores * _head_scale_row(ks_buf[slot], h)
         col_pos = c * span + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 1
         )
@@ -173,7 +180,7 @@ def _prefill_kernel(
         )
         l_new = alpha * l_prev + jnp.sum(pmat, axis=-1, keepdims=True)
         if quantized:
-            pmat = pmat * vs_buf[slot].reshape(1, chunk * block_size)
+            pmat = pmat * _head_scale_row(vs_buf[slot], h)
             pv = jnp.dot(
                 pmat.astype(jnp.bfloat16), v_buf[slot].astype(jnp.bfloat16),
                 preferred_element_type=jnp.float32,
@@ -266,16 +273,18 @@ def flash_prefill_kernel(
     kv_bytes_per_row = D * k_data.dtype.itemsize
     if quantized:
         in_specs += [hbm, hbm]
+        # Pool-native [N, Hkv, BS] layout (see paged_attention.py).
         inputs += [
-            k_cache.scale.reshape(N, Hkv * BS).astype(jnp.float32),
-            v_cache.scale.reshape(N, Hkv * BS).astype(jnp.float32),
+            k_cache.scale.astype(jnp.float32),
+            v_cache.scale.astype(jnp.float32),
         ]
         scratch += [
-            pltpu.VMEM((2, C, BS), jnp.float32),
-            pltpu.VMEM((2, C, BS), jnp.float32),
+            pltpu.VMEM((2, C, Hkv, BS), jnp.float32),
+            pltpu.VMEM((2, C, Hkv, BS), jnp.float32),
             pltpu.SemaphoreType.DMA((2, 2, C)),
         ]
-        kv_bytes_per_row += 4
+        # Full [Hkv, BS] scale tile per block per head-program.
+        kv_bytes_per_row += 4 * Hkv
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,

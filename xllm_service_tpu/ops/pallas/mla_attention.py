@@ -39,7 +39,7 @@ NEG_INF = -1e30
 
 def _dequant_tile(tile, s_rows_buf, chunk, block_size, scale_groups):
     """VMEM dequant of an int8 latent tile [CH*BS, C] with per-(row,
-    group) scales [CH, BS*G]: expand the scales to the C lanes via a
+    group) scales [CH, BS, G]: expand the scales to the C lanes via a
     constant 0/1 matmul (E[g, c] = 1 iff c's group is g) — no lane
     reshapes, which Mosaic dislikes. HBM already moved int8 bytes; this
     is VPU/MXU work on resident data. Shared by the MLA decode,
@@ -66,13 +66,13 @@ def _mla_kernel(
     # inputs
     q_ref,            # [1, Hqp, C] VMEM
     c_hbm,            # [N, 1, BS, C] HBM — bf16 or int8
-    *rest,            # quantized: cs_hbm [N, BS*G] f32, then
+    *rest,            # quantized: cs_hbm [N, 1, BS, G] f32, then
     # output
     #   o_ref         # [1, Hqp, KVR] VMEM
     # scratch
     #   c_buf         # [2, CH*BS, C] VMEM (cache dtype)
     #   sems          # [2, CH] DMA semaphores
-    #   (quantized)   s_buf [2, CH, BS*G] f32 + ssems [2, CH]
+    #   (quantized)   s_buf [2, CH, BS, G] f32 + ssems [2, CH]
     block_size: int,
     chunk: int,
     scale: float,
@@ -110,9 +110,10 @@ def _mla_kernel(
             )
         ]
         if quantized:
+            # Full-extent [BS, G] scale tile (blk on the untiled dim).
             out.append(
                 pltpu.make_async_copy(
-                    cs_hbm.at[blk],
+                    cs_hbm.at[blk, 0],
                     s_buf.at[slot, c_idx],
                     ssems.at[slot, c_idx],
                 )
@@ -192,20 +193,24 @@ def _round_up(x: int, m: int) -> int:
 
 
 def _mla_common(c_cache):
-    """Split a plain-or-PagedKV latent cache into (data, flat scales,
-    groups); scales flatten to [N, BS*G] so each block's DMA slice is a
-    contiguous lane row (the same trick as the GQA kernel's scale plane)."""
+    """Split a plain-or-PagedKV latent cache into (data, scales, groups).
+
+    Scales stay in their pool-native [N, 1, BS, G] layout: each block's
+    DMA is then a full-extent [BS, G] tile with the dynamic block id on
+    the untiled leading dim — the only slice shape Mosaic accepts on
+    real hardware (the previous flat [N, BS*G] plane needed a 1-sublane
+    row slice, which fails (8,128) tiling alignment). Ungrouped legacy
+    scales ([N, 1, BS]) are expanded to G=1."""
     from xllm_service_tpu.ops import kv_cache as kvc
 
     c_cache = kvc.as_paged(c_cache)
     data = c_cache.data
     if not c_cache.quantized:
         return data, None, 1
-    N, _, BS, C = data.shape
-    sc = c_cache.scale  # [N, 1, BS, G]
-    G = sc.shape[-1] if sc.ndim == data.ndim else 1
-    flat = sc.reshape(N, BS * G).astype(jnp.float32)
-    return data, flat, G
+    sc = c_cache.scale  # [N, 1, BS, G] (grouped) or [N, 1, BS]
+    if sc.ndim == data.ndim:
+        return data, sc.astype(jnp.float32), sc.shape[-1]
+    return data, sc[..., None].astype(jnp.float32), 1
 
 
 @functools.partial(
@@ -252,7 +257,7 @@ def mla_attention_kernel(
         in_specs.append(hbm)
         inputs.append(scales)
         scratch += [
-            pltpu.VMEM((2, CH, BS * G), jnp.float32),
+            pltpu.VMEM((2, CH, BS, G), jnp.float32),
             pltpu.SemaphoreType.DMA((2, CH)),
         ]
         row_bytes += 4 * G
@@ -334,7 +339,7 @@ def mla_multiquery_attention_kernel(
         in_specs.append(hbm)
         inputs.append(scales)
         scratch += [
-            pltpu.VMEM((2, CH, BS * G), jnp.float32),
+            pltpu.VMEM((2, CH, BS, G), jnp.float32),
             pltpu.SemaphoreType.DMA((2, CH)),
         ]
         row_bytes += 4 * G

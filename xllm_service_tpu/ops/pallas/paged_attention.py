@@ -42,6 +42,22 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _head_scale_row(buf, h):
+    """Select KV head h's scale rows from a VMEM tile [C, Hkv, BS] and
+    flatten to [1, C*BS] score columns.
+
+    Why a mask-reduce instead of `buf[:, h]`: h is a grid index, and a
+    dynamic slice on the sublane (second-minor) dimension is illegal for
+    Mosaic; the iota compare keeps everything full-tile vector ops. The
+    scale plane rides in its pool-native [N, Hkv, BS] layout so the
+    per-block DMA is a full-extent [Hkv, BS] tile with the dynamic block
+    id on the untiled leading dim — the same pattern as the K/V data DMA
+    (a [1, Hkv*BS]-row slice of a 2D plane, the previous scheme, fails
+    Mosaic's (8,128) tiling alignment on real hardware)."""
+    mask = jax.lax.broadcasted_iota(jnp.int32, buf.shape, 1) == h
+    return jnp.sum(jnp.where(mask, buf, 0.0), axis=1).reshape(1, -1)
+
+
 def _decode_kernel(
     # scalar prefetch
     block_table_ref,  # [R, MBp] SMEM (padded to a multiple of C with 0s)
@@ -50,13 +66,13 @@ def _decode_kernel(
     q_ref,            # [1, 1, Gp, D] VMEM
     k_hbm,            # [N, Hkv, BS, D] HBM (pl.ANY) — bf16 or int8
     v_hbm,            # [N, Hkv, BS, D] HBM (pl.ANY)
-    *rest,            # quantized: ks_hbm, vs_hbm [N, Hkv*BS] f32, then
+    *rest,            # quantized: ks_hbm, vs_hbm [N, Hkv, BS] f32, then
     # output
     #   o_ref         # [1, 1, Gp, D] VMEM
     # scratch
     #   k_buf, v_buf  # [2, C*BS, D] VMEM (cache dtype)
     #   sems          # [2, 2, C] DMA semaphores
-    #   (quantized)   ks_buf, vs_buf [2, C, BS] f32 + ssems [2, 2, C]
+    #   (quantized)   ks_buf, vs_buf [2, C, Hkv, BS] f32 + ssems [2, 2, C]
     block_size: int,
     chunk: int,
     scale: float,
@@ -103,16 +119,18 @@ def _decode_kernel(
             ),
         ]
         if quantized:
+            # All heads' scales move as one full-extent [Hkv, BS] tile
+            # (blk on the untiled dim); compute selects head h.
             out.append(
                 pltpu.make_async_copy(
-                    ks_hbm.at[blk, pl.ds(h * block_size, block_size)],
+                    ks_hbm.at[blk],
                     ks_buf.at[slot, c_idx],
                     ssems.at[slot, 0, c_idx],
                 )
             )
             out.append(
                 pltpu.make_async_copy(
-                    vs_hbm.at[blk, pl.ds(h * block_size, block_size)],
+                    vs_hbm.at[blk],
                     vs_buf.at[slot, c_idx],
                     ssems.at[slot, 1, c_idx],
                 )
@@ -163,7 +181,7 @@ def _decode_kernel(
         if quantized:
             # True K row j = int8 row * ks[j]: fold the per-row scale into
             # the score columns (cheaper than dequantizing the K tile).
-            scores = scores * ks_buf[slot].reshape(1, chunk * block_size)
+            scores = scores * _head_scale_row(ks_buf[slot], h)
         col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
         if s_rows == 1:
             valid = c * span + col < seq_len
@@ -181,7 +199,7 @@ def _decode_kernel(
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         if quantized:
             # True V row j = int8 row * vs[j]: fold into p's columns.
-            p = p * vs_buf[slot].reshape(1, chunk * block_size)
+            p = p * _head_scale_row(vs_buf[slot], h)
             pv = jnp.dot(
                 p.astype(jnp.bfloat16), v_buf[slot].astype(jnp.bfloat16),
                 preferred_element_type=jnp.float32,
@@ -262,19 +280,22 @@ def paged_attention_kernel(
     ]
     kv_bytes_per_row = D * k_data.dtype.itemsize
     if quantized:
-        # Scales ride as [N, Hkv*BS] f32 so the per-(block, head) slice is
-        # a contiguous [BS]-lane row (BS = 128 in production).
         in_specs += [hbm, hbm]
+        # Pool-native [N, Hkv, BS] layout — no reshape (the old flat
+        # [N, Hkv*BS] plane was a physical relayout copy per call AND its
+        # per-block row DMA violated Mosaic's sublane tiling on chip).
         inputs += [
-            k_cache.scale.reshape(N, Hkv * BS).astype(jnp.float32),
-            v_cache.scale.reshape(N, Hkv * BS).astype(jnp.float32),
+            k_cache.scale.astype(jnp.float32),
+            v_cache.scale.astype(jnp.float32),
         ]
         scratch += [
-            pltpu.VMEM((2, C, BS), jnp.float32),
-            pltpu.VMEM((2, C, BS), jnp.float32),
+            pltpu.VMEM((2, C, Hkv, BS), jnp.float32),
+            pltpu.VMEM((2, C, Hkv, BS), jnp.float32),
             pltpu.SemaphoreType.DMA((2, 2, C)),
         ]
-        kv_bytes_per_row += 4
+        # Each head-program DMAs the full [Hkv, BS] scale tile per block
+        # (tile-alignment forces it), so scale traffic scales with Hkv.
+        kv_bytes_per_row += 4 * Hkv
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -366,16 +387,21 @@ def multiquery_paged_attention_kernel(
     kv_bytes_per_row = D * k_data.dtype.itemsize
     if quantized:
         in_specs += [hbm, hbm]
+        # Pool-native [N, Hkv, BS] layout — no reshape (the old flat
+        # [N, Hkv*BS] plane was a physical relayout copy per call AND its
+        # per-block row DMA violated Mosaic's sublane tiling on chip).
         inputs += [
-            k_cache.scale.reshape(N, Hkv * BS).astype(jnp.float32),
-            v_cache.scale.reshape(N, Hkv * BS).astype(jnp.float32),
+            k_cache.scale.astype(jnp.float32),
+            v_cache.scale.astype(jnp.float32),
         ]
         scratch += [
-            pltpu.VMEM((2, C, BS), jnp.float32),
-            pltpu.VMEM((2, C, BS), jnp.float32),
+            pltpu.VMEM((2, C, Hkv, BS), jnp.float32),
+            pltpu.VMEM((2, C, Hkv, BS), jnp.float32),
             pltpu.SemaphoreType.DMA((2, 2, C)),
         ]
-        kv_bytes_per_row += 4
+        # Each head-program DMAs the full [Hkv, BS] scale tile per block
+        # (tile-alignment forces it), so scale traffic scales with Hkv.
+        kv_bytes_per_row += 4 * Hkv
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
