@@ -65,10 +65,8 @@ def run_case(R, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16, chunk=4,
     if int8:
         from xllm_service_tpu.ops import kv_cache as kvc
 
-        kq, ks = kvc.quantize_rows(k)
-        vq, vs = kvc.quantize_rows(v)
-        k = kvc.PagedKV(kq, ks)
-        v = kvc.PagedKV(vq, vs)
+        k = kvc.quantize_pool(k)
+        v = kvc.quantize_pool(v)
     bt = jnp.asarray(
         1 + np.arange(R * MB).reshape(R, MB) % (N - 1), jnp.int32
     )
@@ -86,8 +84,9 @@ def run_case(R, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16, chunk=4,
 
     tk = bench(ker)
     tg = bench(gat)
-    # KV bytes actually needed (true lens): element bytes + f32 scale/row
-    row_bytes = D * (1 if int8 else dtype.dtype.itemsize) + (4 if int8 else 0)
+    # KV bytes actually needed (true lens): element bytes + f32 group
+    # scales (G=8 sub-channel groups per GQA row, kv_cache.py).
+    row_bytes = D * (1 if int8 else dtype.dtype.itemsize) + (32 if int8 else 0)
     kv_bytes = 2 * float(np.sum(np.asarray(lens))) * Hkv * row_bytes
     bw = kv_bytes / tk / 1e9
     print(
@@ -116,8 +115,8 @@ def run_mq_case(R, S, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16,
     if int8:
         from xllm_service_tpu.ops import kv_cache as kvc
 
-        k = kvc.PagedKV(*kvc.quantize_rows(k))
-        v = kvc.PagedKV(*kvc.quantize_rows(v))
+        k = kvc.quantize_pool(k)
+        v = kvc.quantize_pool(v)
     bt = jnp.asarray(1 + np.arange(R * MB).reshape(R, MB) % (N - 1), jnp.int32)
     lens = jnp.asarray(
         np.clip(rng.integers(ctx // 2, ctx + 1, R), 1, MB * BS - S), jnp.int32
@@ -137,7 +136,7 @@ def run_mq_case(R, S, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16,
                       - np.asarray(orc().astype(jnp.float32))))
     )
     tk, tg = bench(ker), bench(orc)
-    row_bytes = D * (1 if int8 else dtype.dtype.itemsize) + (4 if int8 else 0)
+    row_bytes = D * (1 if int8 else dtype.dtype.itemsize) + (32 if int8 else 0)
     kv_bytes = 2 * float(np.sum(np.asarray(lens))) * Hkv * row_bytes
     bw = kv_bytes / tk / 1e9
     print(
@@ -159,16 +158,18 @@ def run_mla_mq_case(R, S, Hq, kvr, dr, BS, MB, ctx, dtype=jnp.bfloat16,
     )
 
     rng = np.random.default_rng(0)
-    C = kvr + dr
+    C = (kvr + dr + 127) // 128 * 128  # lane-padded like the real pool
     N = R * MB + 1
-    q = jnp.asarray(rng.standard_normal((R, S, Hq, C)), dtype)
-    cache = jnp.asarray(rng.standard_normal((N, 1, BS, C)), dtype)
+    q = jnp.asarray(rng.standard_normal((R, S, Hq, kvr + dr)), dtype)
+    q = jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, C - kvr - dr)))
+    cache = jnp.asarray(rng.standard_normal((N, 1, BS, kvr + dr)), dtype)
+    cache = jnp.pad(cache, ((0, 0), (0, 0), (0, 0), (0, C - kvr - dr)))
     G = 1
     if int8:
         from xllm_service_tpu.ops import kv_cache as kvc
 
-        G = kvc.mla_scale_groups(kvr, dr)
-        cache = kvc.PagedKV(*kvc.quantize_rows(cache, G))
+        G = kvc.mla_scale_groups(kvr, dr, C)
+        cache = kvc.quantize_pool(cache, G)
     bt = jnp.asarray(1 + np.arange(R * MB).reshape(R, MB) % (N - 1), jnp.int32)
     lens = jnp.asarray(
         np.clip(rng.integers(ctx // 2, ctx + 1, R), 1, MB * BS - S), jnp.int32
@@ -205,16 +206,18 @@ def run_mla_case(R, Hq, kvr, dr, BS, MB, ctx, dtype=jnp.bfloat16,
     from xllm_service_tpu.ops.pallas.mla_attention import mla_attention_kernel
 
     rng = np.random.default_rng(0)
-    C = kvr + dr
+    C = (kvr + dr + 127) // 128 * 128  # lane-padded like the real pool
     N = R * MB + 1
-    q = jnp.asarray(rng.standard_normal((R, Hq, C)), dtype)
-    cache = jnp.asarray(rng.standard_normal((N, 1, BS, C)), dtype)
+    q = jnp.asarray(rng.standard_normal((R, Hq, kvr + dr)), dtype)
+    q = jnp.pad(q, ((0, 0), (0, 0), (0, C - kvr - dr)))
+    cache = jnp.asarray(rng.standard_normal((N, 1, BS, kvr + dr)), dtype)
+    cache = jnp.pad(cache, ((0, 0), (0, 0), (0, 0), (0, C - kvr - dr)))
     G = 1
     if int8:
         from xllm_service_tpu.ops import kv_cache as kvc
 
-        G = kvc.mla_scale_groups(kvr, dr)
-        cache = kvc.PagedKV(*kvc.quantize_rows(cache, G))
+        G = kvc.mla_scale_groups(kvr, dr, C)
+        cache = kvc.quantize_pool(cache, G)
     bt = jnp.asarray(1 + np.arange(R * MB).reshape(R, MB) % (N - 1), jnp.int32)
     lens = jnp.asarray(
         np.clip(rng.integers(ctx // 2, ctx + 1, R), 1, MB * BS), jnp.int32
@@ -252,8 +255,8 @@ def run_prefill_case(P, Lpad, Hq, Hkv, D, BS, MB, dtype=jnp.bfloat16,
     if int8:
         from xllm_service_tpu.ops import kv_cache as kvc
 
-        k = kvc.PagedKV(*kvc.quantize_rows(k))
-        v = kvc.PagedKV(*kvc.quantize_rows(v))
+        k = kvc.quantize_pool(k)
+        v = kvc.quantize_pool(v)
     bt = jnp.asarray(1 + np.arange(P * MB).reshape(P, MB) % (N - 1), jnp.int32)
     sp = jnp.asarray(rng.integers(0, BS, P), jnp.int32)
     tl = jnp.asarray(
@@ -303,15 +306,17 @@ def run_mla_prefill_case(P, Lpad, Hq, kvr, dr, BS, MB, dtype=jnp.bfloat16,
     )
 
     rng = np.random.default_rng(0)
-    C = kvr + dr
+    C = (kvr + dr + 127) // 128 * 128  # lane-padded like the real pool
     N = P * MB + 1
-    q = jnp.asarray(rng.standard_normal((P, Lpad, Hq, C)), dtype)
-    cache = jnp.asarray(rng.standard_normal((N, 1, BS, C)), dtype)
+    q = jnp.asarray(rng.standard_normal((P, Lpad, Hq, kvr + dr)), dtype)
+    q = jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, C - kvr - dr)))
+    cache = jnp.asarray(rng.standard_normal((N, 1, BS, kvr + dr)), dtype)
+    cache = jnp.pad(cache, ((0, 0), (0, 0), (0, 0), (0, C - kvr - dr)))
     if int8:
         from xllm_service_tpu.ops import kv_cache as kvc
 
-        G = kvc.mla_scale_groups(kvr, dr)
-        cache = kvc.PagedKV(*kvc.quantize_rows(cache, G))
+        G = kvc.mla_scale_groups(kvr, dr, C)
+        cache = kvc.quantize_pool(cache, G)
     bt = jnp.asarray(1 + np.arange(P * MB).reshape(P, MB) % (N - 1), jnp.int32)
     sp = jnp.asarray(rng.integers(0, BS, P), jnp.int32)
     tl = jnp.asarray(

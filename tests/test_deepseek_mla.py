@@ -127,7 +127,7 @@ def test_migration_shape_single_cache():
     assert ex.num_caches == 1
     mcfg = get_model_config("deepseek-tiny")
     assert ex.migration_shape(3) == (
-        1, mcfg.num_layers, 3, 1, 16, mcfg.kv_lora_rank + mcfg.qk_rope_head_dim,
+        1, mcfg.num_layers, 3, 1, 16, mcfg.mla_cache_dim,
     )
     table = np.zeros((ex.max_blocks_per_seq,), np.int32)
     table[:3] = [1, 2, 3]
@@ -235,8 +235,7 @@ def test_mla_dispatcher_kernel_flag():
     np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=2e-5)
     # Quantized cache + use_kernel=True rides the kernel too and must
     # match the gather on the SAME quantized cache.
-    qd, qs = kvc.quantize_rows(cache, groups=kvc.mla_scale_groups(40, 8))
-    qcache = kvc.PagedKV(qd, qs)
+    qcache = kvc.quantize_pool(cache, kvc.mla_scale_groups(40, 8, 48))
     d = mla_paged_attention(
         q, qcache, bt, lens, 0.2, 40, use_kernel=True, interpret=True
     )

@@ -50,9 +50,7 @@ def _toy_cache(rng, N=10, Hkv=2, BS=16, D=64, quantized=False):
     v = jnp.asarray(rng.standard_normal((N, Hkv, BS, D)), jnp.float32)
     if not quantized:
         return k, v
-    kq, ks = kvc.quantize_rows(k)
-    vq, vs = kvc.quantize_rows(v)
-    return kvc.PagedKV(kq, ks), kvc.PagedKV(vq, vs)
+    return kvc.quantize_pool(k), kvc.quantize_pool(v)
 
 
 def test_decode_gather_int8_close_to_fp():
@@ -88,9 +86,8 @@ def test_blockwise_prefill_int8_close_to_fp():
 
 
 def test_pallas_kernel_int8_interpret_parity():
-    """The int8 kernel (scale DMA + column folding) vs the int8 gather
-    oracle, interpret mode. BS=128 satisfies the kernel's full-lane scale
-    rows exactly as production does."""
+    """The int8 kernel ([G, BS] scale-tile DMA + VMEM grouped dequant)
+    vs the int8 gather oracle, interpret mode. BS=128 as production."""
     from xllm_service_tpu.ops.pallas.paged_attention import (
         paged_attention_kernel,
     )
@@ -217,19 +214,20 @@ def test_set_rows_infers_groups_from_cache():
     """A cache allocated with scale_groups quantizes writes per group and
     gathers back with matching dequantization."""
     rng = np.random.default_rng(8)
-    N, Hkv, BS, D, G = 4, 1, 8, 96, 3
+    N, Hkv, BS, D, G = 4, 1, 8, 96, 8
     cache = kvc.alloc_cache((N, Hkv, BS, D), jnp.float32, True, scale_groups=G)
-    assert cache.scale.shape == (N, Hkv, BS, G)
+    assert cache.scale.shape == (N, Hkv, G, BS)  # pool layout: groups-major
     rows = jnp.asarray(rng.standard_normal((5, Hkv, D)), jnp.float32)
     rows = rows * jnp.asarray([100.0] * 32 + [1.0] * 32 + [0.01] * 32)
     blk = jnp.asarray([0, 1, 2, 3, 1], jnp.int32)
     off = jnp.asarray([0, 1, 2, 3, 4], jnp.int32)
     cache = kvc.scatter_rows(cache, blk, off, rows)
     got = np.asarray(kvc.gather_blocks(cache, jnp.arange(N), jnp.float32))
+    gsz = D // G
     for i, (b, o) in enumerate(zip([0, 1, 2, 3, 1], [0, 1, 2, 3, 4])):
         seg = np.asarray(rows)[i, 0]
         back = got[b, 0, o]
         for g in range(G):
-            sl = slice(g * 32, (g + 1) * 32)
+            sl = slice(g * gsz, (g + 1) * gsz)
             bound = np.abs(seg[sl]).max() / 254 + 1e-7
             assert np.abs(back[sl] - seg[sl]).max() <= bound

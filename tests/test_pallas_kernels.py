@@ -180,14 +180,18 @@ def test_flash_prefill_prefix_hit():
 
 
 def test_flash_prefill_int8():
-    """int8 cache: folded per-row scales match the dequantizing oracle
-    within quantization tolerance."""
+    """int8 cache: the kernel's VMEM grouped dequant matches the
+    dequantizing oracle within quantization tolerance. Tolerance budget:
+    dequant_tile rounds the scaled tile to bf16 before the score matmul
+    (the oracle dequantizes to bf16 too, but multiplies under f32
+    promotion), so ~0.4% relative per product accumulates over D=64
+    lanes — 5e-3 was borderline, 2e-2 is the honest bound."""
     from xllm_service_tpu.ops import kv_cache as kvc
 
     rng = np.random.default_rng(2)
     q, k, v, bt = make_prefill_case(rng, P=2, Lpad=32)
-    kq = kvc.PagedKV(*kvc.quantize_rows(k))
-    vq = kvc.PagedKV(*kvc.quantize_rows(v))
+    kq = kvc.quantize_pool(k)
+    vq = kvc.quantize_pool(v)
     start_pos = jnp.asarray([0, 16], jnp.int32)
     true_len = jnp.asarray([32, 30], jnp.int32)
     scale = 0.125
@@ -198,7 +202,7 @@ def test_flash_prefill_int8():
     for p, tl in enumerate([32, 30]):
         np.testing.assert_allclose(
             np.asarray(out)[p, :tl], np.asarray(ref)[p, :tl],
-            atol=5e-3, rtol=5e-3,
+            atol=2e-2, rtol=2e-2,
         )
 
 
@@ -395,8 +399,8 @@ def test_mq_decode_kernel_int8():
                                       MB=4, num_blocks=32)
     q = jnp.asarray(rng.standard_normal((4, S, 8, 128)), jnp.float32)
     seq_lens = jnp.minimum(seq_lens, 4 * 128 - S)
-    kq = kvc.PagedKV(*kvc.quantize_rows(k))
-    vq = kvc.PagedKV(*kvc.quantize_rows(v))
+    kq = kvc.quantize_pool(k)
+    vq = kvc.quantize_pool(v)
     scale = 1.0 / np.sqrt(128)
     ref = _mq_oracle(q, kq, vq, bt, seq_lens, S, scale)
     out = multiquery_paged_attention_kernel(
@@ -538,7 +542,9 @@ def test_mla_mq_dispatcher_env_gate(monkeypatch):
 
     rng = np.random.default_rng(5)
     S, kvr = 4, 40
-    q4, cache, bt = make_mla_prefill_case(rng, P=2, Lpad=S, C=56, MB=8)
+    # C=128: the dispatcher's tile-legality gate (attention._mla_kernel_ok)
+    # requires a 128-multiple latent lane dim, as the production pool pads.
+    q4, cache, bt = make_mla_prefill_case(rng, P=2, Lpad=S, C=128, MB=8)
     seq_lens = jnp.asarray([30, 90], jnp.int32)
     start_pos = jnp.maximum(seq_lens - 1, 0)
     true_len = jnp.full((2,), S, jnp.int32)
@@ -566,9 +572,8 @@ def test_mla_mq_dispatcher_env_gate(monkeypatch):
 def _quantize_mla_cache(cache, kvr, dr):
     from xllm_service_tpu.ops import kv_cache as kvc
 
-    G = kvc.mla_scale_groups(kvr, dr)
-    q, s = kvc.quantize_rows(cache, G)
-    return kvc.PagedKV(q, s)
+    G = kvc.mla_scale_groups(kvr, dr, cache.shape[-1])
+    return kvc.quantize_pool(cache, G)
 
 
 def test_mla_kernel_int8_matches_gather():

@@ -62,9 +62,18 @@ class ModelConfig:
         return self.kv_lora_rank > 0
 
     @property
-    def mla_cache_dim(self) -> int:
-        """Latent cache floats per token: c_kv + shared RoPE key."""
+    def mla_row_dim(self) -> int:
+        """True latent floats per token: c_kv + shared RoPE key."""
         return self.kv_lora_rank + self.qk_rope_head_dim
+
+    @property
+    def mla_cache_dim(self) -> int:
+        """Latent cache lanes per token: mla_row_dim padded to a multiple
+        of 128. Mosaic DMA slices need 128-aligned lane extents on real
+        hardware (chip finding, round 3), so the pool stores zero-padded
+        rows; q_lat pads with zeros too, making the extra lanes inert in
+        every score/context contraction."""
+        return (self.mla_row_dim + 127) // 128 * 128
 
 
 def approx_param_count(cfg: ModelConfig) -> int:

@@ -217,21 +217,37 @@ def _q_heads(lp, cfg: ModelConfig, h: jnp.ndarray, positions: jnp.ndarray):
     return q_nope, q_pe
 
 
+def _pad_lanes(x: jnp.ndarray, width: int) -> jnp.ndarray:
+    """Zero-pad the last dim to `width` (the cache's 128-aligned lane
+    count, cfg.mla_cache_dim). Zeros on both q and cache rows keep the
+    padded lanes out of every q·k score and tile[:, :kvr] context read."""
+    if x.shape[-1] == width:
+        return x
+    pad = [(0, 0)] * (x.ndim - 1) + [(0, width - x.shape[-1])]
+    return jnp.pad(x, pad)
+
+
 def _latent_rows(lp, cfg: ModelConfig, h: jnp.ndarray, positions: jnp.ndarray):
-    """h [T, E] -> cache rows [T, C]: concat(normed c_kv, roped k_pe)."""
+    """h [T, E] -> cache rows [T, C]: concat(normed c_kv, roped k_pe),
+    lane-padded to cfg.mla_cache_dim."""
     kvr, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
     ckv = jnp.einsum("te,ec->tc", h, wt(lp["w_dkv"]))  # [T, kvr + dr]
     c, k_pe = ckv[..., :kvr], ckv[..., kvr:]
     c = rms_norm(c, lp["kv_norm"], cfg.rms_norm_eps)
     # Single shared rope key per token (head axis of 1 for apply_rope).
     k_pe = apply_rope(k_pe[:, None, :], positions, cfg.rope_theta)[:, 0]
-    return jnp.concatenate([c, k_pe], axis=-1)
+    return _pad_lanes(
+        jnp.concatenate([c, k_pe], axis=-1), cfg.mla_cache_dim
+    )
 
 
-def _absorb_q(lp, q_nope: jnp.ndarray, q_pe: jnp.ndarray) -> jnp.ndarray:
-    """Project q_nope into the latent space and append q_pe: [.., Hq, C]."""
+def _absorb_q(lp, cfg: ModelConfig, q_nope, q_pe) -> jnp.ndarray:
+    """Project q_nope into the latent space and append q_pe: [.., Hq, C]
+    (lane-padded to match the cache rows)."""
     q_lat = jnp.einsum("...hd,hkd->...hk", q_nope, wt(lp["w_uk"]))
-    return jnp.concatenate([q_lat, q_pe], axis=-1)
+    return _pad_lanes(
+        jnp.concatenate([q_lat, q_pe], axis=-1), cfg.mla_cache_dim
+    )
 
 
 def _attn_out(lp, cfg: ModelConfig, ctx_lat: jnp.ndarray) -> jnp.ndarray:
@@ -273,7 +289,7 @@ def decode_step(
             q_nope, q_pe = _q_heads(lp, cfg, h, positions)
             rows = _latent_rows(lp, cfg, h, positions)
             c_l = kv_cache_ops.scatter_rows(c_l, blk, offset, rows[:, None, :])
-            q_lat = _absorb_q(lp, q_nope, q_pe)
+            q_lat = _absorb_q(lp, cfg, q_nope, q_pe)
             ctx = mla_paged_attention(
                 q_lat, c_l, block_tables, seq_lens, scale, kvr,
                 use_kernel=use_kernel,
@@ -348,7 +364,7 @@ def prefill_batch_step(
                 c_l, flat_blk, flat_off,
                 rows.reshape(P * Lpad, 1, rows.shape[-1]),
             )
-            q_lat = _absorb_q(lp, q_nope, q_pe)  # [P, Lpad, Hq, C]
+            q_lat = _absorb_q(lp, cfg, q_nope, q_pe)  # [P, Lpad, Hq, C]
             ctx = mla_prefill_attention(
                 q_lat, c_l, block_tables, start_pos, true_len, scale, kvr
             )  # [P, Lpad, Hq, kvr] — flash kernel on TPU
@@ -408,7 +424,8 @@ def hidden_dense(
                 h = rms_norm(hx, lp["attn_norm"], cfg.rms_norm_eps)
                 q_nope, q_pe = _q_heads(lp, cfg, h, positions)
                 rows = _latent_rows(lp, cfg, h, positions)  # [L, C]
-                c, k_pe = rows[..., :kvr], rows[..., kvr:]
+                # rows are lane-padded past kvr + dr; slice the true spans.
+                c, k_pe = rows[..., :kvr], rows[..., kvr:kvr + dr]
                 k_nope = jnp.einsum(
                     "tk,hkd->thd", c, wt(lp["w_uk"])
                 )  # [L,Hq,dn]

@@ -167,6 +167,33 @@ def prefill_attention_blockwise(
     return out.reshape(L, Hq, D).astype(q.dtype)
 
 
+
+def _kernel_tile_ok(cache, lane_dim: int, on: bool) -> bool:
+    """Mosaic tile-legality gate for every Pallas kernel path (chip
+    findings, round 3): DMA slice dims must be tile MULTIPLES on the
+    last two dims. `lane_dim` is the per-row lane width (head_dim D for
+    GQA, the lane-padded latent dim C for MLA) and must be a 128
+    multiple; BS sits on sublanes of the [BS, lane_dim] data slice (16
+    bf16; int8's stricter bound is subsumed below); int8 additionally
+    streams [G, BS] scale tiles with BS on LANES, so quantized caches
+    need BS % 128."""
+    BS = kvc.raw(cache).shape[-2]
+    cq = isinstance(cache, kvc.PagedKV) and cache.quantized
+    return (
+        on
+        and lane_dim % 128 == 0
+        and (BS % 128 == 0 if cq else BS % 16 == 0)
+    )
+
+
+def _gqa_kernel_ok(k_cache, D: int, on: bool) -> bool:
+    return _kernel_tile_ok(k_cache, D, on)
+
+
+def _mla_kernel_ok(c_cache, on: bool) -> bool:
+    return _kernel_tile_ok(c_cache, kvc.raw(c_cache).shape[-1], on)
+
+
 def prefill_attention(
     q: jnp.ndarray,  # [P, Lpad, Hq, D] — the batched chunk's queries
     k_cache,
@@ -187,16 +214,8 @@ def prefill_attention(
     import os
 
     # One eligibility predicate for BOTH Pallas paths (flash prefill and
-    # the multi-query verify kernel): D a lane multiple; int8 additionally
-    # needs BS scale rows 128-wide.
-    D = q.shape[-1]
-    BS = kvc.raw(k_cache).shape[-2]
-    kq = isinstance(k_cache, kvc.PagedKV) and k_cache.quantized
-    kernel_ok = (
-        (_on_tpu() or interpret)
-        and D % 128 == 0
-        and (not kq or BS % 128 == 0)
-    )
+    # the multi-query verify kernel).
+    kernel_ok = _gqa_kernel_ok(k_cache, q.shape[-1], _on_tpu() or interpret)
 
     # Speculative-verify shapes (a handful of query rows per sequence):
     # the multi-query decode kernel streams each KV row ONCE like a decode
@@ -288,7 +307,10 @@ def mla_paged_attention(
 
     if use_kernel is None:
         env = os.environ.get("XLLM_MLA_ATTENTION_KERNEL")
-        use_kernel = env == "1" and (_on_tpu() or interpret)
+        use_kernel = (
+            env == "1"
+            and _mla_kernel_ok(c_cache, _on_tpu() or interpret)
+        )
     if use_kernel:
         from xllm_service_tpu.ops.pallas.mla_attention import (
             mla_attention_kernel,
@@ -331,7 +353,7 @@ def mla_prefill_attention(
     if (
         use_kernel is None
         and S <= 8
-        and (_on_tpu() or interpret)
+        and _mla_kernel_ok(c_cache, _on_tpu() or interpret)
         and os.environ.get("XLLM_MQ_ATTENTION_KERNEL") == "1"
     ):
         from xllm_service_tpu.ops.pallas.mla_attention import (
@@ -348,7 +370,10 @@ def mla_prefill_attention(
         # int8 stays OPT-IN (env == "1") until the mla-prefill-int8 chip
         # case validates — the convention for every unvalidated kernel
         # path; bf16 keeps its existing default.
-        kernel_ok = (_on_tpu() or interpret) and not quantized
+        kernel_ok = (
+            _mla_kernel_ok(c_cache, _on_tpu() or interpret)
+            and not quantized
+        )
         use_kernel = (env != "0") if kernel_ok else (env == "1")
     if use_kernel:
         from xllm_service_tpu.ops.pallas.mla_prefill import (
@@ -442,12 +467,7 @@ def paged_attention(
 
     env = os.environ.get("XLLM_PAGED_ATTENTION_KERNEL")
     if use_kernel is None:
-        D = q.shape[-1]
-        BS = kvc.raw(k_cache).shape[-2]
-        kq = isinstance(k_cache, kvc.PagedKV) and k_cache.quantized
-        # int8 additionally needs BS lanes to form full 128-wide scale rows
-        # (the scale DMA slices [blk, h*BS : (h+1)*BS]).
-        kernel_ok = _on_tpu() and D % 128 == 0 and (not kq or BS % 128 == 0)
+        kernel_ok = _gqa_kernel_ok(k_cache, q.shape[-1], _on_tpu())
         use_kernel = (env != "0") if kernel_ok else (env == "1")
     if use_kernel:
         try:

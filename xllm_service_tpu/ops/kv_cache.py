@@ -12,13 +12,29 @@ block-size and chained-hash contract hashes TOKEN IDS, not cache bytes).
 Representation: a `PagedKV` NamedTuple so the cache flows through
 `jax.lax.scan`/`jit`/donation as a pytree wherever a plain array did.
 
-  * bf16 mode:  PagedKV(data=[..., N, Hkv, BS, D] bf16, scale=None)
-  * int8 mode:  PagedKV(data=[..., N, Hkv, BS, D] int8,
-                        scale=[..., N, Hkv, BS] f32)
+  * bf16 mode:  PagedKV(data=[..., N, H, BS, D] bf16, scale=None)
+  * int8 mode:  PagedKV(data=[..., N, H, BS, D] int8,
+                        scale=[..., N, H, G, BS] f32), G % 8 == 0
 
-Quantization is symmetric per row (one token's one head, D lanes):
-scale = max|row| / 127, data = round(row / scale). Dequantized compute
-stays bf16/f32; only storage and HBM transfer shrink.
+ONE scale layout for both families: sub-channel grouped, G groups per
+row on the SUBLANE axis with BS on lanes (GQA: H = Hkv kv-heads, G = 8
+groups of D/8 lanes; MLA: H = 1, D = the lane-padded latent dim, G from
+mla_scale_groups). The layout is dictated by real-hardware Mosaic DMA
+rules (learned on chip, round 3): a DMA slice's shape must be a multiple
+of the (8, 128) tile on the last two dims — even at full extent — and
+dynamic offsets may ride only on untiled leading dims. [G, BS] per
+(block, head) with G % 8 == 0 satisfies that on EVERY tp shard (a
+per-head or head-padded plane would go sub-tile once tp slices Hkv below
+8, which is exactly the llama tp=8 production layout); heads stay a
+leading dim so the scale plane shards identically to the data
+(parallel/sharding.kv_scale_sharding). The MLA latent dim C is itself
+lane-padded to 128 by `ModelConfig.mla_cache_dim` for the same reason.
+
+Quantization is symmetric per (row, group): scale = max|group| / 127,
+data = round(group / scale). Sub-channel grouping also quantizes a
+high-magnitude segment independently of its neighbors (ADVICE r2 for the
+MLA concat(c_kv, k_pe) row; for GQA it just buys precision). Dequantized
+compute stays bf16/f32; only storage and HBM transfer shrink.
 
 Plain jnp.ndarray caches remain accepted everywhere (`as_paged`), so the
 bf16 path and all existing callers/tests are untouched.
@@ -26,6 +42,7 @@ bf16 path and all existing callers/tests are untouched.
 
 from __future__ import annotations
 
+import math
 from typing import NamedTuple, Optional, Tuple, Union
 
 import jax
@@ -52,13 +69,35 @@ class PagedKV(NamedTuple):
 CacheLike = Union[jnp.ndarray, PagedKV]
 
 
-def mla_scale_groups(kv_lora_rank: int, rope_dim: int) -> int:
-    """Scale-group count for an int8 MLA latent cache row of
-    kv_lora_rank + rope_dim lanes: group size gcd(kvr, rope) puts the
-    latent/RoPE boundary on a group boundary (see quantize_rows)."""
-    import math
+def _ceil8(x: int) -> int:
+    return (x + 7) // 8 * 8
 
-    return (kv_lora_rank + rope_dim) // math.gcd(kv_lora_rank, rope_dim)
+
+# Sub-channel groups per GQA cache row (head dims are 8-multiples, so 8
+# groups of D/8 lanes always divide evenly and the [G, BS] scale tile is
+# Mosaic-legal).
+GQA_SCALE_GROUPS = 8
+
+
+def mla_scale_groups(
+    kv_lora_rank: int, rope_dim: int, cache_dim: Optional[int] = None
+) -> int:
+    """Scale-group count for an int8 MLA latent cache row.
+
+    Constraints: the group size must (a) divide kv_lora_rank so the
+    latent/RoPE boundary falls on a group boundary (the two segments
+    quantize independently — ADVICE r2), (b) divide the (lane-padded)
+    cache_dim exactly, and (c) yield a group COUNT that is a multiple of
+    8, because the groups live on the sublane axis of the pool's
+    [..., G, BS] scale plane and Mosaic DMA requires 8-aligned sublane
+    extents. Start from gcd(kvr, rope, 128) — a power of two — and halve
+    until the count is 8-aligned (always terminates: cache_dim is a
+    multiple of 128 when padded, and gsz=1 gives a 128-multiple count)."""
+    dim = cache_dim if cache_dim is not None else kv_lora_rank + rope_dim
+    gsz = math.gcd(math.gcd(kv_lora_rank, rope_dim), 128)
+    while gsz > 1 and (dim % gsz or (dim // gsz) % 8):
+        gsz //= 2
+    return dim // gsz
 
 
 def as_paged(cache: CacheLike) -> PagedKV:
@@ -70,6 +109,11 @@ def raw(cache: CacheLike) -> jnp.ndarray:
     return cache.data if isinstance(cache, PagedKV) else cache
 
 
+def scale_groups_of(cache: PagedKV) -> int:
+    """Sub-channel group count of a quantized pool cache."""
+    return cache.scale.shape[-2] if cache.scale is not None else 1
+
+
 def quantize_rows(
     rows: jnp.ndarray, groups: int = 1
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -77,11 +121,8 @@ def quantize_rows(
 
     groups=1: one scale per row (scale [...]).
     groups=S: sub-channel quantization — the D lanes split into S equal
-    segments, each with its own scale (scale [..., S]). Used for MLA latent
-    caches, where one scale across concat(c_kv, k_pe) lets whichever
-    segment has the smaller magnitude lose precision to the other; a group
-    size dividing kv_lora_rank puts the latent/RoPE boundary on a group
-    boundary so the segments quantize independently (ADVICE r2)."""
+    segments, each with its own scale (scale [..., S], groups LAST; pool
+    planes store them with BS last — the write paths below relayout)."""
     f = rows.astype(jnp.float32)
     if groups > 1:
         g = f.reshape(*f.shape[:-1], groups, f.shape[-1] // groups)
@@ -95,10 +136,10 @@ def quantize_rows(
 
 
 def dequantize(data: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.bfloat16):
-    """data int8 [..., D], scale [...] or [..., S] (grouped) -> [..., D].
-
-    Grouping is inferred from rank: scale.ndim == data.ndim means the last
-    scale axis is the per-row group count."""
+    """Row-layout inverse of quantize_rows: data int8 [..., D], scale
+    [...] or [..., S] (grouped, groups LAST) -> [..., D]. Grouping is
+    inferred from rank: scale.ndim == data.ndim means the last scale axis
+    is the per-row group count."""
     if scale.ndim == data.ndim:
         S = scale.shape[-1]
         g = data.astype(jnp.float32).reshape(
@@ -108,19 +149,38 @@ def dequantize(data: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.bfloat16):
     return (data.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
-def set_rows(cache: CacheLike, data_index, scale_index, rows: jnp.ndarray):
+def dequantize_pool(data: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.bfloat16):
+    """Pool-LAYOUT dequant: data int8 [..., H, BS, D] with grouped scale
+    [..., H, G, BS] — transpose to row-major groups-last and delegate."""
+    return dequantize(data, jnp.swapaxes(scale, -1, -2), dtype)
+
+
+def set_rows(
+    cache: CacheLike,
+    data_index,
+    scale_index,
+    rows: jnp.ndarray,
+    mode: str = "token",
+):
     """Generic quantize-or-cast cache write: `rows` [..., D] land at
     `cache.data[data_index]` (and, when quantized, their per-row scales at
     `cache.scale[scale_index]`). The single place the write-side
-    quantization branch lives — scatter_rows / PD import / SP scatter all
-    route through here."""
+    quantization branch lives — scatter_rows / block import / SP scatter
+    all route through here.
+
+    `mode` tells set_rows how the scale slot is laid out so the quantized
+    scale values (groups LAST, from quantize_rows) can be relayouted into
+    the pool's tile-aligned [..., H, G, BS] planes:
+      * "token": scale_index consumed the in-block (BS) position — the
+        slot already trails with the group axis; values land as-is.
+      * "block": scale_index addresses whole blocks — the slot keeps the
+        pool's trailing [G, BS] dims, so the quantized [..., BS, G]
+        values transpose.
+    """
     if isinstance(cache, PagedKV) and cache.quantized:
-        groups = (
-            cache.scale.shape[-1]
-            if cache.scale.ndim == cache.data.ndim
-            else 1
-        )
-        q, s = quantize_rows(rows, groups)
+        q, s = quantize_rows(rows, cache.scale.shape[-2])
+        if mode == "block":
+            s = jnp.swapaxes(s, -1, -2)  # [..., G, BS]
         return PagedKV(
             cache.data.at[data_index].set(q),
             cache.scale.at[scale_index].set(s),
@@ -144,15 +204,43 @@ def scatter_rows(
     return set_rows(
         cache,
         (blk, slice(None), offset, slice(None)),
-        (blk, slice(None), offset),
+        # Pool scales are [N, H, G, BS]: offset picks the BS lane, the
+        # slices keep heads and groups -> slot [T, H, G], matching the
+        # groups-last quantized values exactly.
+        (blk, slice(None), slice(None), offset),
         rows,
+        mode="token",
     )
+
+
+def set_blocks(cache: CacheLike, ids: jnp.ndarray, blocks: jnp.ndarray):
+    """Write whole blocks [..., P, heads, BS, D] at block ids along the N
+    axis of a pooled cache [..., N, heads, BS, D] (leading layer dims
+    untouched). Used by the PD/tier migration import path."""
+    idx = (slice(None), ids)
+    return set_rows(cache, idx, idx, blocks, mode="block")
+
+
+def quantize_pool(cache: jnp.ndarray, groups: int = GQA_SCALE_GROUPS) -> PagedKV:
+    """Quantize a whole dense cache array [..., N, H, BS, D] into a
+    pool-LAYOUT PagedKV ([..., N, H, G, BS] scales). Test/bench helper —
+    production pools allocate zeroed via alloc_cache and quantize
+    incrementally through set_rows."""
+    if groups % 8 or cache.shape[-1] % groups:
+        raise ValueError(
+            f"quantize_pool: groups={groups} must be a multiple of 8 "
+            f"dividing the row dim {cache.shape[-1]} (see alloc_cache)"
+        )
+    q, s = quantize_rows(cache, groups)
+    return PagedKV(q, jnp.swapaxes(s, -1, -2))
 
 
 def gather_block(cache: CacheLike, block_id, dtype=jnp.bfloat16):
     """One block [Hkv, BS, D] dequantized to `dtype` (blockwise prefill)."""
     if isinstance(cache, PagedKV) and cache.quantized:
-        return dequantize(cache.data[block_id], cache.scale[block_id], dtype)
+        return dequantize_pool(
+            cache.data[block_id], cache.scale[block_id], dtype
+        )
     return raw(cache)[block_id].astype(dtype)
 
 
@@ -160,7 +248,7 @@ def gather_blocks(cache: CacheLike, block_table: jnp.ndarray, dtype=None):
     """Gather + dequantize blocks via a block table of any shape [...B];
     returns [...B, Hkv, BS, D]."""
     if isinstance(cache, PagedKV) and cache.quantized:
-        return dequantize(
+        return dequantize_pool(
             cache.data[block_table], cache.scale[block_table],
             dtype or jnp.bfloat16,
         )
@@ -169,15 +257,20 @@ def gather_blocks(cache: CacheLike, block_table: jnp.ndarray, dtype=None):
 
 
 def alloc_cache(
-    shape: Tuple[int, ...],  # [..., N, Hkv, BS, D]
+    shape: Tuple[int, ...],  # [..., N, H, BS, D]
     dtype,
     quantized: bool,
-    scale_groups: int = 1,
+    scale_groups: int = GQA_SCALE_GROUPS,
 ) -> PagedKV:
     if quantized:
-        scale_shape = (
-            shape[:-1] + (scale_groups,) if scale_groups > 1 else shape[:-1]
-        )
+        if scale_groups % 8 or shape[-1] % scale_groups:
+            raise ValueError(
+                f"scale_groups={scale_groups} must be a multiple of 8 "
+                f"dividing the row dim {shape[-1]} (Mosaic sublane tiling"
+                f" of the [..., G, BS] scale plane)"
+            )
+        # [..., N, H, G, BS] — groups on sublanes, BS on lanes.
+        scale_shape = shape[:-2] + (scale_groups, shape[-2])
         return PagedKV(
             jnp.zeros(shape, jnp.int8), jnp.zeros(scale_shape, jnp.float32)
         )

@@ -21,10 +21,10 @@ kernel's loop structure with a query-tile axis):
   * causal + ragged masking by absolute position: row r (query position
     start_pos + t*TQ + r//G) keeps column c*span + j iff that cache
     position <= its own, and rows past true_len are dead (l=0 → zeros).
-  * int8 caches: per-row scales ride pool-native as [N, Hkv, BS] f32 —
-    one full-extent [Hkv, BS] tile DMA per block — and fold into score
-    columns (K) and probability columns (V), same scheme as the decode
-    kernel.
+  * int8 caches: sub-channel scales ride pool-native as [N, Hkv, G, BS]
+    f32 — one [G, BS] tile DMA per (block, head) — and tiles dequantize
+    in VMEM via the shared expansion matmul (paged_attention.dequant_tile
+    explains why column folding is off the table).
 
 Layouts: q [P, Lpad, Hq, D] (chunk-relative), caches [N, Hkv, BS, D],
 block_table [P, MB] int32, start_pos/true_len [P] int32. Returns
@@ -41,7 +41,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from xllm_service_tpu.ops.pallas.paged_attention import _head_scale_row
+from xllm_service_tpu.ops.pallas.paged_attention import dequant_tile
 
 NEG_INF = -1e30
 
@@ -55,14 +55,15 @@ def _prefill_kernel(
     q_ref,            # [1, 1, 1, Rp, D] VMEM (one tile's TQ*G rows)
     k_hbm,            # [N, Hkv, BS, D] HBM
     v_hbm,            # [N, Hkv, BS, D] HBM
-    *rest,            # quantized: ks_hbm, vs_hbm [N, Hkv, BS] f32; then
-    # o_ref + scratch (quantized scale bufs are [2, C, Hkv, BS] f32)
+    *rest,            # quantized: ks_hbm, vs_hbm [N, Hkv, G, BS] f32; then
+    # o_ref + scratch (quantized scale bufs are [2, C, G, BS] f32)
     block_size: int,
     chunk: int,
     tile_q: int,
     groups: int,
     scale: float,
     quantized: bool,
+    scale_groups: int = 8,
 ):
     if quantized:
         ks_hbm, vs_hbm, o_ref, k_buf, v_buf, sems, ks_buf, vs_buf, ssems = rest
@@ -96,19 +97,17 @@ def _prefill_kernel(
             ),
         ]
         if quantized:
-            # Full-extent [Hkv, BS] scale tile per block (blk on the
-            # untiled dim); compute selects head h — see
-            # paged_attention._head_scale_row for why.
+            # Head h's [G, BS] scale tile (blk, h on untiled dims).
             out.append(
                 pltpu.make_async_copy(
-                    ks_hbm.at[blk],
+                    ks_hbm.at[blk, h],
                     ks_buf.at[slot, c_idx],
                     ssems.at[slot, 0, c_idx],
                 )
             )
             out.append(
                 pltpu.make_async_copy(
-                    vs_hbm.at[blk],
+                    vs_hbm.at[blk, h],
                     vs_buf.at[slot, c_idx],
                     ssems.at[slot, 1, c_idx],
                 )
@@ -152,7 +151,9 @@ def _prefill_kernel(
         wait_chunk(slot, c)
         k_tile = k_buf[slot]
         if quantized:
-            k_tile = k_tile.astype(jnp.bfloat16)
+            k_tile = dequant_tile(
+                k_tile, ks_buf[slot], chunk, block_size, scale_groups
+            )
         scores = (
             jax.lax.dot_general(
                 q, k_tile,
@@ -161,8 +162,6 @@ def _prefill_kernel(
             )
             * scale
         )  # [Rp, C*BS] f32
-        if quantized:
-            scores = scores * _head_scale_row(ks_buf[slot], h)
         col_pos = c * span + jax.lax.broadcasted_iota(
             jnp.int32, scores.shape, 1
         )
@@ -180,9 +179,11 @@ def _prefill_kernel(
         )
         l_new = alpha * l_prev + jnp.sum(pmat, axis=-1, keepdims=True)
         if quantized:
-            pmat = pmat * _head_scale_row(vs_buf[slot], h)
+            v_tile = dequant_tile(
+                v_buf[slot], vs_buf[slot], chunk, block_size, scale_groups
+            )
             pv = jnp.dot(
-                pmat.astype(jnp.bfloat16), v_buf[slot].astype(jnp.bfloat16),
+                pmat.astype(jnp.bfloat16), v_tile,
                 preferred_element_type=jnp.float32,
             )
         else:
@@ -270,21 +271,22 @@ def flash_prefill_kernel(
         pltpu.VMEM((2, C * BS, D), v_data.dtype),
         pltpu.SemaphoreType.DMA((2, 2, C)),
     ]
+    SG = k_cache.scale.shape[-2] if quantized else 8  # sub-channel groups
     kv_bytes_per_row = D * k_data.dtype.itemsize
     if quantized:
         in_specs += [hbm, hbm]
-        # Pool-native [N, Hkv, BS] layout (see paged_attention.py).
+        # Pool-native [N, Hkv, G, BS] grouped plane (see kv_cache.py).
         inputs += [
             k_cache.scale.astype(jnp.float32),
             v_cache.scale.astype(jnp.float32),
         ]
         scratch += [
-            pltpu.VMEM((2, C, Hkv, BS), jnp.float32),
-            pltpu.VMEM((2, C, Hkv, BS), jnp.float32),
+            pltpu.VMEM((2, C, SG, BS), jnp.float32),
+            pltpu.VMEM((2, C, SG, BS), jnp.float32),
             pltpu.SemaphoreType.DMA((2, 2, C)),
         ]
-        # Full [Hkv, BS] scale tile per block per head-program.
-        kv_bytes_per_row += 4 * Hkv
+        # Per-block scale tile is [G, BS] f32: 4*G bytes per row.
+        kv_bytes_per_row += 4 * SG
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -298,6 +300,7 @@ def flash_prefill_kernel(
     kernel = functools.partial(
         _prefill_kernel, block_size=BS, chunk=C, tile_q=TQ, groups=G,
         scale=scale, quantized=quantized,
+        scale_groups=SG,
     )
     out = pl.pallas_call(
         kernel,

@@ -37,26 +37,9 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _dequant_tile(tile, s_rows_buf, chunk, block_size, scale_groups):
-    """VMEM dequant of an int8 latent tile [CH*BS, C] with per-(row,
-    group) scales [CH, BS, G]: expand the scales to the C lanes via a
-    constant 0/1 matmul (E[g, c] = 1 iff c's group is g) — no lane
-    reshapes, which Mosaic dislikes. HBM already moved int8 bytes; this
-    is VPU/MXU work on resident data. Shared by the MLA decode,
-    multi-query, and flash-prefill kernels."""
-    C = tile.shape[-1]
-    gsz = C // scale_groups
-    E = (
-        jax.lax.broadcasted_iota(jnp.int32, (scale_groups, C), 1) // gsz
-        == jax.lax.broadcasted_iota(jnp.int32, (scale_groups, C), 0)
-    ).astype(jnp.float32)
-    sc = s_rows_buf.reshape(chunk * block_size, scale_groups)
-    s_exp = jax.lax.dot_general(
-        sc, E,
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [CH*BS, C]
-    return (tile.astype(jnp.float32) * s_exp).astype(jnp.bfloat16)
+from xllm_service_tpu.ops.pallas.paged_attention import dequant_tile
+
+_dequant_tile = dequant_tile  # shared with mla_prefill (historical name)
 
 
 def _mla_kernel(
@@ -66,13 +49,13 @@ def _mla_kernel(
     # inputs
     q_ref,            # [1, Hqp, C] VMEM
     c_hbm,            # [N, 1, BS, C] HBM — bf16 or int8
-    *rest,            # quantized: cs_hbm [N, 1, BS, G] f32, then
+    *rest,            # quantized: cs_hbm [N, 1, G, BS] f32, then
     # output
     #   o_ref         # [1, Hqp, KVR] VMEM
     # scratch
     #   c_buf         # [2, CH*BS, C] VMEM (cache dtype)
     #   sems          # [2, CH] DMA semaphores
-    #   (quantized)   s_buf [2, CH, BS, G] f32 + ssems [2, CH]
+    #   (quantized)   s_buf [2, CH, G, BS] f32 + ssems [2, CH]
     block_size: int,
     chunk: int,
     scale: float,
@@ -110,7 +93,7 @@ def _mla_kernel(
             )
         ]
         if quantized:
-            # Full-extent [BS, G] scale tile (blk on the untiled dim).
+            # Full-extent [G, BS] scale tile (blk on the untiled dim).
             out.append(
                 pltpu.make_async_copy(
                     cs_hbm.at[blk, 0],
@@ -195,22 +178,28 @@ def _round_up(x: int, m: int) -> int:
 def _mla_common(c_cache):
     """Split a plain-or-PagedKV latent cache into (data, scales, groups).
 
-    Scales stay in their pool-native [N, 1, BS, G] layout: each block's
-    DMA is then a full-extent [BS, G] tile with the dynamic block id on
-    the untiled leading dim — the only slice shape Mosaic accepts on
-    real hardware (the previous flat [N, BS*G] plane needed a 1-sublane
-    row slice, which fails (8,128) tiling alignment). Ungrouped legacy
-    scales ([N, 1, BS]) are expanded to G=1."""
+    Scales stay in their pool-native [N, 1, G, BS] layout (G groups on
+    sublanes, BS on lanes, G a multiple of 8 — kv_cache.mla_scale_groups
+    guarantees it): each block's DMA is then a full-extent [G, BS] tile
+    with the dynamic block id on the untiled leading dim. Mosaic accepts
+    only full (8,128)-tile-aligned extents on the last two dims of a DMA
+    slice (chip finding, round 3) — both the old flat [N, BS*G] plane
+    (1-sublane row slices) and a [.., BS, G] layout (G non-128 lanes)
+    fail to compile on real hardware."""
     from xllm_service_tpu.ops import kv_cache as kvc
 
     c_cache = kvc.as_paged(c_cache)
     data = c_cache.data
     if not c_cache.quantized:
         return data, None, 1
-    sc = c_cache.scale  # [N, 1, BS, G] (grouped) or [N, 1, BS]
-    if sc.ndim == data.ndim:
-        return data, sc.astype(jnp.float32), sc.shape[-1]
-    return data, sc[..., None].astype(jnp.float32), 1
+    sc = c_cache.scale
+    if sc.ndim != data.ndim or sc.shape[-2] % 8:
+        raise ValueError(
+            f"int8 MLA caches need grouped [N, 1, G, BS] scales with "
+            f"G % 8 == 0 (got scale shape {sc.shape}); allocate via "
+            f"kv_cache.alloc_cache with kv_cache.mla_scale_groups"
+        )
+    return data, sc.astype(jnp.float32), sc.shape[-2]
 
 
 @functools.partial(
@@ -257,7 +246,7 @@ def mla_attention_kernel(
         in_specs.append(hbm)
         inputs.append(scales)
         scratch += [
-            pltpu.VMEM((2, CH, BS, G), jnp.float32),
+            pltpu.VMEM((2, CH, G, BS), jnp.float32),
             pltpu.SemaphoreType.DMA((2, CH)),
         ]
         row_bytes += 4 * G
@@ -339,7 +328,7 @@ def mla_multiquery_attention_kernel(
         in_specs.append(hbm)
         inputs.append(scales)
         scratch += [
-            pltpu.VMEM((2, CH, BS, G), jnp.float32),
+            pltpu.VMEM((2, CH, G, BS), jnp.float32),
             pltpu.SemaphoreType.DMA((2, CH)),
         ]
         row_bytes += 4 * G

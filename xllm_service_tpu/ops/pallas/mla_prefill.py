@@ -38,13 +38,13 @@ def _mla_prefill_kernel(
     # inputs
     q_ref,            # [1, 1, Rp, C] VMEM (one tile's TQ*Hq rows)
     c_hbm,            # [N, 1, BS, C] HBM — bf16 or int8
-    *rest,            # quantized: cs_hbm [N, 1, BS, G] f32, then
+    *rest,            # quantized: cs_hbm [N, 1, G, BS] f32, then
     # output
     #   o_ref         # [1, 1, Rp, KVR] VMEM
     # scratch
     #   c_buf         # [2, CH*BS, C] VMEM (cache dtype)
     #   sems          # [2, CH]
-    #   (quantized)   s_buf [2, CH, BS, G] f32 + ssems [2, CH]
+    #   (quantized)   s_buf [2, CH, G, BS] f32 + ssems [2, CH]
     block_size: int,
     chunk: int,
     tile_q: int,
@@ -78,7 +78,7 @@ def _mla_prefill_kernel(
             )
         ]
         if quantized:
-            # Full-extent [BS, G] scale tile (blk on the untiled dim);
+            # Full-extent [G, BS] scale tile (blk on the untiled dim);
             # see mla_attention._mla_common for why.
             out.append(
                 pltpu.make_async_copy(
@@ -231,7 +231,7 @@ def mla_flash_prefill_kernel(
         in_specs.append(hbm)
         inputs.append(scales)
         scratch += [
-            pltpu.VMEM((2, CH, BS, G), jnp.float32),
+            pltpu.VMEM((2, CH, G, BS), jnp.float32),
             pltpu.SemaphoreType.DMA((2, CH)),
         ]
         row_bytes += 4 * G

@@ -42,20 +42,35 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _head_scale_row(buf, h):
-    """Select KV head h's scale rows from a VMEM tile [C, Hkv, BS] and
-    flatten to [1, C*BS] score columns.
+def dequant_tile(tile, s_buf, chunk, block_size, scale_groups):
+    """VMEM dequant of an int8 cache tile [CH*BS, D] with sub-channel
+    scales [CH, G, BS] (the pool's [.., H, G, BS] plane, one head's [G,
+    BS] tile DMA'd per block): expand the scales to the D lanes via a
+    constant 0/1 matmul (E[g, d] = 1 iff lane d's group is g) contracting
+    the G axis — no lane reshapes or sublane-dynamic slices, which Mosaic
+    rejects. HBM already moved int8 bytes; this is VPU/MXU work on
+    resident data. Shared by every int8 kernel path (GQA + MLA, decode +
+    prefill + multi-query).
 
-    Why a mask-reduce instead of `buf[:, h]`: h is a grid index, and a
-    dynamic slice on the sublane (second-minor) dimension is illegal for
-    Mosaic; the iota compare keeps everything full-tile vector ops. The
-    scale plane rides in its pool-native [N, Hkv, BS] layout so the
-    per-block DMA is a full-extent [Hkv, BS] tile with the dynamic block
-    id on the untiled leading dim — the same pattern as the K/V data DMA
-    (a [1, Hkv*BS]-row slice of a 2D plane, the previous scheme, fails
-    Mosaic's (8,128) tiling alignment on real hardware)."""
-    mask = jax.lax.broadcasted_iota(jnp.int32, buf.shape, 1) == h
-    return jnp.sum(jnp.where(mask, buf, 0.0), axis=1).reshape(1, -1)
+    Why scales aren't folded into score/probability columns anymore (the
+    round-2 scheme): column folding needs ONE scale per cache row, but a
+    per-row scale plane cannot be tiled legally on every tp shard —
+    Mosaic DMA slices must be (8, 128)-tile multiples and tp slices Hkv
+    to 1 on production llama shards. Grouped [G % 8 == 0, BS] tiles are
+    shard-invariant, and sub-channel grouping buys precision."""
+    D = tile.shape[-1]
+    gsz = D // scale_groups
+    E = (
+        jax.lax.broadcasted_iota(jnp.int32, (scale_groups, D), 1) // gsz
+        == jax.lax.broadcasted_iota(jnp.int32, (scale_groups, D), 0)
+    ).astype(jnp.float32)
+    s_exp = jax.lax.dot_general(
+        s_buf, E,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [CH, BS, D]
+    s_exp = s_exp.reshape(chunk * block_size, D)
+    return (tile.astype(jnp.float32) * s_exp).astype(jnp.bfloat16)
 
 
 def _decode_kernel(
@@ -66,19 +81,20 @@ def _decode_kernel(
     q_ref,            # [1, 1, Gp, D] VMEM
     k_hbm,            # [N, Hkv, BS, D] HBM (pl.ANY) — bf16 or int8
     v_hbm,            # [N, Hkv, BS, D] HBM (pl.ANY)
-    *rest,            # quantized: ks_hbm, vs_hbm [N, Hkv, BS] f32, then
+    *rest,            # quantized: ks_hbm, vs_hbm [N, Hkv, G, BS] f32, then
     # output
     #   o_ref         # [1, 1, Gp, D] VMEM
     # scratch
     #   k_buf, v_buf  # [2, C*BS, D] VMEM (cache dtype)
     #   sems          # [2, 2, C] DMA semaphores
-    #   (quantized)   ks_buf, vs_buf [2, C, Hkv, BS] f32 + ssems [2, 2, C]
+    #   (quantized)   ks_buf, vs_buf [2, C, G, BS] f32 + ssems [2, 2, C]
     block_size: int,
     chunk: int,
     scale: float,
     quantized: bool,
     s_rows: int = 1,
     gp: int = 0,
+    scale_groups: int = 8,
 ):
     if quantized:
         ks_hbm, vs_hbm, o_ref, k_buf, v_buf, sems, ks_buf, vs_buf, ssems = rest
@@ -119,18 +135,17 @@ def _decode_kernel(
             ),
         ]
         if quantized:
-            # All heads' scales move as one full-extent [Hkv, BS] tile
-            # (blk on the untiled dim); compute selects head h.
+            # Head h's [G, BS] scale tile (blk, h on untiled dims).
             out.append(
                 pltpu.make_async_copy(
-                    ks_hbm.at[blk],
+                    ks_hbm.at[blk, h],
                     ks_buf.at[slot, c_idx],
                     ssems.at[slot, 0, c_idx],
                 )
             )
             out.append(
                 pltpu.make_async_copy(
-                    vs_hbm.at[blk],
+                    vs_hbm.at[blk, h],
                     vs_buf.at[slot, c_idx],
                     ssems.at[slot, 1, c_idx],
                 )
@@ -169,7 +184,9 @@ def _decode_kernel(
         wait_chunk(slot, c)
         k_tile = k_buf[slot]
         if quantized:
-            k_tile = k_tile.astype(jnp.bfloat16)
+            k_tile = dequant_tile(
+                k_tile, ks_buf[slot], chunk, block_size, scale_groups
+            )
         scores = (
             jax.lax.dot_general(
                 q, k_tile,
@@ -178,10 +195,6 @@ def _decode_kernel(
             )
             * scale
         )  # [Gp, C*BS] f32
-        if quantized:
-            # True K row j = int8 row * ks[j]: fold the per-row scale into
-            # the score columns (cheaper than dequantizing the K tile).
-            scores = scores * _head_scale_row(ks_buf[slot], h)
         col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
         if s_rows == 1:
             valid = c * span + col < seq_len
@@ -198,10 +211,11 @@ def _decode_kernel(
         p = jnp.exp(scores - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         if quantized:
-            # True V row j = int8 row * vs[j]: fold into p's columns.
-            p = p * _head_scale_row(vs_buf[slot], h)
+            v_tile = dequant_tile(
+                v_buf[slot], vs_buf[slot], chunk, block_size, scale_groups
+            )
             pv = jnp.dot(
-                p.astype(jnp.bfloat16), v_buf[slot].astype(jnp.bfloat16),
+                p.astype(jnp.bfloat16), v_tile,
                 preferred_element_type=jnp.float32,
             )  # [Gp, D] f32
         else:
@@ -278,24 +292,23 @@ def paged_attention_kernel(
         pltpu.VMEM((2, C * BS, D), v_data.dtype),
         pltpu.SemaphoreType.DMA((2, 2, C)),
     ]
+    SG = k_cache.scale.shape[-2] if quantized else 8  # sub-channel groups
     kv_bytes_per_row = D * k_data.dtype.itemsize
     if quantized:
         in_specs += [hbm, hbm]
-        # Pool-native [N, Hkv, BS] layout — no reshape (the old flat
-        # [N, Hkv*BS] plane was a physical relayout copy per call AND its
-        # per-block row DMA violated Mosaic's sublane tiling on chip).
+        # Pool-native [N, Hkv, G, BS] grouped plane (kv_cache.py) — no
+        # per-call relayout, tile-legal on every tp shard.
         inputs += [
             k_cache.scale.astype(jnp.float32),
             v_cache.scale.astype(jnp.float32),
         ]
         scratch += [
-            pltpu.VMEM((2, C, Hkv, BS), jnp.float32),
-            pltpu.VMEM((2, C, Hkv, BS), jnp.float32),
+            pltpu.VMEM((2, C, SG, BS), jnp.float32),
+            pltpu.VMEM((2, C, SG, BS), jnp.float32),
             pltpu.SemaphoreType.DMA((2, 2, C)),
         ]
-        # Each head-program DMAs the full [Hkv, BS] scale tile per block
-        # (tile-alignment forces it), so scale traffic scales with Hkv.
-        kv_bytes_per_row += 4 * Hkv
+        # Per-block scale tile is [G, BS] f32: 4*G bytes per row.
+        kv_bytes_per_row += 4 * SG
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -309,6 +322,7 @@ def paged_attention_kernel(
     kernel = functools.partial(
         _decode_kernel, block_size=BS, chunk=C, scale=scale,
         quantized=quantized,
+        scale_groups=SG,
     )
     out = pl.pallas_call(
         kernel,
@@ -384,24 +398,23 @@ def multiquery_paged_attention_kernel(
         pltpu.VMEM((2, C * BS, D), v_data.dtype),
         pltpu.SemaphoreType.DMA((2, 2, C)),
     ]
+    SG = k_cache.scale.shape[-2] if quantized else 8  # sub-channel groups
     kv_bytes_per_row = D * k_data.dtype.itemsize
     if quantized:
         in_specs += [hbm, hbm]
-        # Pool-native [N, Hkv, BS] layout — no reshape (the old flat
-        # [N, Hkv*BS] plane was a physical relayout copy per call AND its
-        # per-block row DMA violated Mosaic's sublane tiling on chip).
+        # Pool-native [N, Hkv, G, BS] grouped plane (kv_cache.py) — no
+        # per-call relayout, tile-legal on every tp shard.
         inputs += [
             k_cache.scale.astype(jnp.float32),
             v_cache.scale.astype(jnp.float32),
         ]
         scratch += [
-            pltpu.VMEM((2, C, Hkv, BS), jnp.float32),
-            pltpu.VMEM((2, C, Hkv, BS), jnp.float32),
+            pltpu.VMEM((2, C, SG, BS), jnp.float32),
+            pltpu.VMEM((2, C, SG, BS), jnp.float32),
             pltpu.SemaphoreType.DMA((2, 2, C)),
         ]
-        # Each head-program DMAs the full [Hkv, BS] scale tile per block
-        # (tile-alignment forces it), so scale traffic scales with Hkv.
-        kv_bytes_per_row += 4 * Hkv
+        # Per-block scale tile is [G, BS] f32: 4*G bytes per row.
+        kv_bytes_per_row += 4 * SG
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -415,6 +428,7 @@ def multiquery_paged_attention_kernel(
     kernel = functools.partial(
         _decode_kernel, block_size=BS, chunk=C, scale=scale,
         quantized=quantized, s_rows=S, gp=Gp,
+        scale_groups=SG,
     )
     out = pl.pallas_call(
         kernel,

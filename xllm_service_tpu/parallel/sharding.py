@@ -148,9 +148,11 @@ def kv_cache_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def kv_scale_sharding(mesh: Mesh) -> NamedSharding:
-    # int8 cache scales [L, num_blocks, Hkv, bs]: KV heads over tp, same
-    # placement as the data they scale.
-    return NamedSharding(mesh, P(None, None, "tp", None))
+    # int8 cache scales [L, num_blocks, Hkv, G, bs]: KV heads over tp,
+    # same placement as the data rows they scale (G = sub-channel groups,
+    # a multiple of 8 so the per-block [G, bs] DMA tile is Mosaic-legal
+    # on every tp shard — see ops/kv_cache.py).
+    return NamedSharding(mesh, P(None, None, "tp", None, None))
 
 
 def check_tp_divisibility(cfg: ModelConfig, tp: int, ep: int = 1) -> None:

@@ -288,7 +288,9 @@ class ModelExecutor:
                 groups = 1
                 if self.kv_quantized:
                     groups = kvc.mla_scale_groups(
-                        self.cfg.kv_lora_rank, self.cfg.qk_rope_head_dim
+                        self.cfg.kv_lora_rank,
+                        self.cfg.qk_rope_head_dim,
+                        self.cfg.mla_cache_dim,
                     )
                 alloc = jax.jit(
                     lambda: kvc.alloc_cache(
@@ -320,10 +322,9 @@ class ModelExecutor:
         def _import_impl(k, v, blocks, ids):
             # blocks [2, L, P, Hkv, BS, D] in model dtype (migration payloads
             # stay bf16 on the wire/host tiers; int8 caches requantize here).
-            idx = (slice(None), ids)
-            k = kvc.set_rows(k, idx, idx, blocks[0])
+            k = kvc.set_blocks(k, ids, blocks[0])
             if self.num_caches == 2:
-                v = kvc.set_rows(v, idx, idx, blocks[1])
+                v = kvc.set_blocks(v, ids, blocks[1])
             return k, v
 
         self._import_jit = jax.jit(_import_impl, donate_argnums=(0, 1))
@@ -498,13 +499,15 @@ class ModelExecutor:
             - n_params * param_bytes / tp
         ) / 2
         cache_heads, cache_dim = models.cache_row_dims(self.cfg)
-        # int8 cache: 1 byte/element + 4-byte f32 scale per scale group
-        # (1 group/row for GQA; MLA rows carry cache_dim/gcd groups — must
-        # match the alloc path's grouping or the pool oversizes).
-        scale_groups = 1
+        # int8 cache: 1 byte/element + 4-byte f32 scale per sub-channel
+        # group (G=8 for GQA rows, mla_scale_groups for MLA — must match
+        # the alloc path's grouping or the pool over/undersizes).
+        scale_groups = kvc.GQA_SCALE_GROUPS
         if self.kv_quantized and self.cfg.is_mla:
             scale_groups = kvc.mla_scale_groups(
-                self.cfg.kv_lora_rank, self.cfg.qk_rope_head_dim
+                self.cfg.kv_lora_rank,
+                self.cfg.qk_rope_head_dim,
+                self.cfg.mla_cache_dim,
             )
         kv_elem_bytes = (
             1 + 4.0 * scale_groups / cache_dim
@@ -1086,7 +1089,8 @@ class ModelExecutor:
         # rows [L, Lsp, Hkv, D] -> token axis first to match the advanced-
         # index update shape [Lsp, layers, Hkv(, D)].
         di = (slice(None), blk, slice(None), off, slice(None))
-        si = (slice(None), blk, slice(None), off)
+        # Scale pool is [L, N, Hkv, G, BS]: off picks the BS lane.
+        si = (slice(None), blk, slice(None), slice(None), off)
         k_cache = kvc.set_rows(k_cache, di, si, jnp.swapaxes(k_all, 0, 1))
         v_cache = kvc.set_rows(v_cache, di, si, jnp.swapaxes(v_all, 0, 1))
         tokens, logprob, _ = sampling_ops.sample_tokens(
@@ -1331,7 +1335,7 @@ class ModelExecutor:
 
         def grab(cache):
             if cache.quantized:
-                return kvc.dequantize(
+                return kvc.dequantize_pool(
                     cache.data[:, ids], cache.scale[:, ids], self.dtype
                 )
             return cache.data[:, ids]
