@@ -115,6 +115,38 @@ def test_pallas_kernel_int8_interpret_parity():
     )
 
 
+def test_pallas_kernel_int8_tp_local_shard_shape():
+    """The int8 kernel at the LOCAL shard shape a llama tp=8 slice
+    produces: Hkv=1 kv head, [N, 1, G, BS] scale plane. This is the
+    configuration that killed the per-row/head-padded scale layouts
+    (sub-8 sublane tiles once tp slices Hkv) and motivated the grouped
+    contract — single-chip validation can't reach it, so interpret mode
+    pins the per-shard shapes the sharded kernel will see."""
+    from xllm_service_tpu.ops.pallas.paged_attention import (
+        paged_attention_kernel,
+    )
+
+    rng = np.random.default_rng(9)
+    R, Hq, Hkv, BS, D, MB = 2, 4, 1, 128, 128, 3
+    N = R * MB + 1
+    k8, v8 = _toy_cache(rng, N=N, Hkv=Hkv, BS=BS, D=D, quantized=True)
+    assert k8.scale.shape == (N, Hkv, kvc.GQA_SCALE_GROUPS, BS)
+    q = jnp.asarray(rng.standard_normal((R, Hq, D)), jnp.float32).astype(
+        jnp.bfloat16
+    )
+    bt = jnp.asarray(1 + np.arange(R * MB).reshape(R, MB), jnp.int32)
+    lens = jnp.asarray([290, 47], jnp.int32)
+    out_k = paged_attention_kernel(
+        q, k8, v8, bt, lens, D**-0.5, interpret=True
+    )
+    out_g = paged_attention_gather(q, k8, v8, bt, lens, D**-0.5)
+    np.testing.assert_allclose(
+        np.asarray(out_k.astype(jnp.float32)),
+        np.asarray(out_g.astype(jnp.float32)),
+        atol=0.03, rtol=0.03,
+    )
+
+
 def test_executor_int8_decode_matches_bf16_greedy():
     """End-to-end executor parity: same prompts, greedy decode, int8 cache
     tracks the bf16 cache token-for-token on the tiny model."""

@@ -78,6 +78,29 @@ def test_executor_sharded_decode_parity(cpu_devices, dp, tp):
     np.testing.assert_allclose(lps, ref_lps, atol=0.05)
 
 
+@pytest.mark.parametrize("model", ["llama3-tiny", "deepseek-tiny"],
+                         ids=["gqa", "mla"])
+def test_executor_sharded_int8_decode_parity(cpu_devices, model):
+    """tp=2 + int8 KV: the grouped [.., H, G, BS] scale plane shards
+    along heads with the data (kv_scale_sharding, 5-dim spec) — GQA —
+    or replicates — MLA — and greedy tokens still match the tp=1 int8
+    oracle. Pins the sharded alloc + scatter + gather paths the
+    single-chip validator can't."""
+    prompt = (np.arange(13, dtype=np.int32) * 5 + 2) % 512
+    ref_exe = ModelExecutor(
+        _engine_cfg(model=model, kv_cache_dtype="int8"), init_seed=5
+    )
+    ref_toks, _ = _greedy_tokens(ref_exe, prompt, 5)
+
+    exe = ModelExecutor(
+        _engine_cfg(model=model, kv_cache_dtype="int8", tp_size=2),
+        init_seed=5,
+    )
+    assert exe.k_cache.quantized
+    toks, _ = _greedy_tokens(exe, prompt, 5)
+    assert toks == ref_toks
+
+
 def _run_engine(exe: ModelExecutor, prompts, steps: int):
     eng = InferenceEngine(exe.engine_cfg, executor=exe)
     eng.start()
