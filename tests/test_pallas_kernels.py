@@ -414,8 +414,9 @@ def test_mq_decode_kernel_int8():
 
 
 def test_mq_dispatcher_env_gate(monkeypatch):
-    """prefill_attention routes small-S shapes through the mq kernel only
-    under XLLM_MQ_ATTENTION_KERNEL=1, and the result matches blockwise.
+    """prefill_attention routes small-S bf16 shapes through the mq
+    kernel (default ON since the round-3 chip validation; int8 stays
+    behind XLLM_MQ_ATTENTION_KERNEL=1), and the result matches blockwise.
     D must satisfy the D % 128 == 0 gate or the branch is never taken."""
     from xllm_service_tpu.ops.attention import prefill_attention
 
@@ -452,6 +453,46 @@ def test_mq_dispatcher_env_gate(monkeypatch):
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5
     )
+
+    # bf16-default semantics: env UNSET still takes the mq branch...
+    monkeypatch.delenv("XLLM_MQ_ATTENTION_KERNEL", raising=False)
+    calls.clear()
+    prefill_attention(q, k, v, bt, start_pos, true_len, scale, interpret=True)
+    assert calls, "bf16 mq default-on regressed"
+    # ...=0 disables it...
+    monkeypatch.setenv("XLLM_MQ_ATTENTION_KERNEL", "0")
+    calls.clear()
+    prefill_attention(q, k, v, bt, start_pos, true_len, scale, interpret=True)
+    assert not calls, "XLLM_MQ_ATTENTION_KERNEL=0 must disable the branch"
+    # ...the function-wide kill switch covers the mq path too...
+    monkeypatch.delenv("XLLM_MQ_ATTENTION_KERNEL", raising=False)
+    monkeypatch.setenv("XLLM_PREFILL_ATTENTION_KERNEL", "0")
+    calls.clear()
+    prefill_attention(q, k, v, bt, start_pos, true_len, scale, interpret=True)
+    assert not calls, "PREFILL=0 kill switch must cover the mq branch"
+    monkeypatch.delenv("XLLM_PREFILL_ATTENTION_KERNEL", raising=False)
+    # ...and int8 caches stay opt-in until mq-int8 chip-validates —
+    # with a BS=128 cache so the tile gate itself is satisfied and the
+    # decline is genuinely the int8 opt-in.
+    from xllm_service_tpu.ops import kv_cache as kvc
+
+    kb = jnp.asarray(rng.standard_normal((5, 2, 128, 128)), jnp.float32)
+    vb = jnp.asarray(rng.standard_normal((5, 2, 128, 128)), jnp.float32)
+    q8 = jnp.asarray(rng.standard_normal((2, 4, 4, 128)), jnp.float32)
+    bt8 = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    sp8 = jnp.asarray([40, 90], jnp.int32)
+    tl8 = jnp.asarray([4, 4], jnp.int32)
+    calls.clear()
+    prefill_attention(
+        q8, kb, vb, bt8, sp8, tl8, scale, interpret=True
+    )
+    assert calls, "bf16 BS=128 control case should take the mq branch"
+    calls.clear()
+    prefill_attention(
+        q8, kvc.quantize_pool(kb), kvc.quantize_pool(vb), bt8, sp8, tl8,
+        scale, interpret=True,
+    )
+    assert not calls, "int8 mq must stay opt-in until chip-validated"
 
 
 def test_mq_decode_kernel_table_edge_clamp():

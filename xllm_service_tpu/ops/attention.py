@@ -220,15 +220,21 @@ def prefill_attention(
     # Speculative-verify shapes (a handful of query rows per sequence):
     # the multi-query decode kernel streams each KV row ONCE like a decode
     # step — the flash-prefill kernel would pad S~4 rows to a 128-row
-    # query tile. Opt-in via XLLM_MQ_ATTENTION_KERNEL=1 until validated on
-    # hardware (the same gate the MLA kernels went through;
-    # scripts/validate_kernel_tpu.py carries the mq cases).
+    # query tile. Default ON for bf16 since the mq-bf16 case validated on
+    # a real v5e chip (round 3, scripts/validate_kernel_tpu.py); int8
+    # stays opt-in (XLLM_MQ_ATTENTION_KERNEL=1) until mq-int8 validates
+    # on the grouped scale layout. =0 disables outright.
     S = q.shape[1]
+    mq_env = os.environ.get("XLLM_MQ_ATTENTION_KERNEL")
+    kq_mq = isinstance(k_cache, kvc.PagedKV) and k_cache.quantized
     if (
         use_kernel is None
         and S <= 8
         and kernel_ok
-        and os.environ.get("XLLM_MQ_ATTENTION_KERNEL") == "1"
+        and (mq_env == "1" if kq_mq else mq_env != "0")
+        # The function-wide kill switch keeps covering EVERY kernel path
+        # here: =0 forces the blockwise reference even for mq shapes.
+        and os.environ.get("XLLM_PREFILL_ATTENTION_KERNEL") != "0"
     ):
         from xllm_service_tpu.ops.pallas.paged_attention import (
             multiquery_paged_attention_kernel,
