@@ -78,6 +78,25 @@ def test_executor_sharded_decode_parity(cpu_devices, dp, tp):
     np.testing.assert_allclose(lps, ref_lps, atol=0.05)
 
 
+def test_executor_sharded_qwen3_parity(cpu_devices):
+    """tp=2 over the Qwen3 family: the replicated q/k head-norm leaves
+    (parallel/sharding.py qk_norm specs) compose with head-sharded
+    attention; greedy tokens match the tp=1 oracle."""
+    # float32: this seed lands a near-tie on the first token, and bf16
+    # psum ordering across tp legitimately flips it.
+    prompt = (np.arange(12, dtype=np.int32) * 11 + 5) % 500
+    ref = ModelExecutor(
+        _engine_cfg(model="qwen3-tiny", dtype="float32"), init_seed=9
+    )
+    ref_toks, _ = _greedy_tokens(ref, prompt, 5)
+    exe = ModelExecutor(
+        _engine_cfg(model="qwen3-tiny", dtype="float32", tp_size=2),
+        init_seed=9,
+    )
+    toks, _ = _greedy_tokens(exe, prompt, 5)
+    assert toks == ref_toks
+
+
 @pytest.mark.parametrize("model", ["llama3-tiny", "deepseek-tiny"],
                          ids=["gqa", "mla"])
 def test_executor_sharded_int8_decode_parity(cpu_devices, model):

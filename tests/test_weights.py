@@ -48,13 +48,15 @@ def _tree_equal(a, b):
     [
         get_model_config("llama3-tiny"),
         QWEN_TINY,
+        get_model_config("qwen3-tiny"),
+        get_model_config("qwen3-moe-tiny"),
         get_model_config("moe-tiny"),
         get_model_config("deepseek-tiny"),
         get_model_config("deepseek-moe-tiny"),
         get_model_config("deepseek-hetero-tiny"),
     ],
-    ids=["llama", "qwen-bias", "moe", "mla", "mla-moe-shared",
-         "mla-hetero"],
+    ids=["llama", "qwen-bias", "qwen3-qknorm", "qwen3-moe", "moe", "mla",
+         "mla-moe-shared", "mla-hetero"],
 )
 def test_save_load_roundtrip(cfg, tmp_path):
     from xllm_service_tpu import models

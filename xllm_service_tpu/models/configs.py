@@ -33,6 +33,8 @@ class ModelConfig:
     sliding_window: int = 0
     # QKV projection bias (Qwen2-style).
     attn_bias: bool = False
+    # Per-head RMSNorm on q and k before RoPE (Qwen3-style QK-norm).
+    qk_norm: bool = False
     # Multi-head Latent Attention (DeepSeek-V2/V3). kv_lora_rank > 0 turns
     # MLA on: the paged cache stores ONE compressed latent row per token
     # (kv_lora_rank + qk_rope_head_dim floats) instead of per-head K/V —
@@ -233,6 +235,77 @@ register(
         rope_theta=1000000.0,
         rms_norm_eps=1e-6,
         attn_bias=True,
+    )
+)
+
+register(
+    ModelConfig(
+        name="qwen3-8b",
+        vocab_size=151936,
+        hidden_size=4096,
+        intermediate_size=12288,
+        num_layers=36,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        rope_theta=1000000.0,
+        rms_norm_eps=1e-6,
+        qk_norm=True,
+    )
+)
+
+register(
+    # Qwen3-30B-A3B: 128-expert top-8 MoE, no shared experts; router
+    # weighting is softmax over the selected experts' logits, which the
+    # shared _mlp already computes (identical to renormalized-top-k).
+    ModelConfig(
+        name="qwen3-30b-a3b",
+        vocab_size=151936,
+        hidden_size=2048,
+        intermediate_size=6144,
+        num_layers=48,
+        num_heads=32,
+        num_kv_heads=4,
+        head_dim=128,
+        rope_theta=1000000.0,
+        rms_norm_eps=1e-6,
+        qk_norm=True,
+        num_experts=128,
+        num_experts_per_tok=8,
+        moe_intermediate_size=768,
+    )
+)
+
+register(
+    ModelConfig(
+        name="qwen3-tiny",
+        vocab_size=512,
+        hidden_size=96,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=24,
+        rope_theta=10000.0,
+        qk_norm=True,
+    )
+)
+
+register(
+    ModelConfig(
+        name="qwen3-moe-tiny",
+        vocab_size=512,
+        hidden_size=96,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=24,
+        rope_theta=10000.0,
+        qk_norm=True,
+        num_experts=4,
+        num_experts_per_tok=2,
+        moe_intermediate_size=64,
     )
 )
 

@@ -83,6 +83,11 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
         layers["bq"] = jnp.zeros((L, Hq * D), dtype)
         layers["bk"] = jnp.zeros((L, Hkv * D), dtype)
         layers["bv"] = jnp.zeros((L, Hkv * D), dtype)
+    if cfg.qk_norm:
+        # Qwen3 QK-norm: one RMSNorm weight over head_dim, shared by all
+        # q heads (q_head_norm) / kv heads (k_head_norm) of a layer.
+        layers["q_head_norm"] = norm_init((L, D))
+        layers["k_head_norm"] = norm_init((L, D))
     if cfg.is_moe:
         X, Fm = cfg.num_experts, cfg.moe_intermediate_size
         layers.update(
@@ -204,6 +209,11 @@ def _qkv(lp, cfg: ModelConfig, x: jnp.ndarray, positions: jnp.ndarray,
     q = q.reshape(T, cfg.num_heads, cfg.head_dim)
     k = k.reshape(T, cfg.num_kv_heads, cfg.head_dim)
     v = v.reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        # Qwen3: per-head RMSNorm over head_dim BEFORE RoPE (HF
+        # Qwen3Attention ordering).
+        q = rms_norm(q, lp["q_head_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_head_norm"], cfg.rms_norm_eps)
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     return q, k, v

@@ -83,6 +83,14 @@ def param_shardings(
             layers.update(
                 {"bq": ns(None, tp), "bk": ns(None, tp), "bv": ns(None, tp)}
             )
+        if cfg.qk_norm:
+            # Head-dim norms are tiny and head-agnostic: replicate.
+            layers.update(
+                {
+                    "q_head_norm": ns(None, None),
+                    "k_head_norm": ns(None, None),
+                }
+            )
     if cfg.is_moe:
         ep = ep_axis if ep_axis is not None and ep_axis in mesh.shape else None
         e, t = (ep, tp) if ep is not None else (tp, None)

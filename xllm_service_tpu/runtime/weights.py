@@ -157,6 +157,28 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
     )
     if arch == "Qwen2ForCausalLM":
         common["attn_bias"] = True
+    elif arch == "Qwen3ForCausalLM":
+        common["qk_norm"] = True
+    elif arch == "Qwen3MoeForCausalLM":
+        # Non-uniform sparsity (dense layers interleaved mid-stack) has no
+        # stacked-leaf layout here — same scope rule as DeepSeek's
+        # moe_layer_freq guard below.
+        if int(hf.get("decoder_sparse_step") or 1) != 1 or hf.get(
+            "mlp_only_layers"
+        ):
+            raise NotImplementedError(
+                "Qwen3-MoE checkpoints with decoder_sparse_step != 1 or "
+                "mlp_only_layers interleave dense layers mid-stack; only "
+                "uniformly-sparse stacks are supported"
+            )
+        common.update(
+            qk_norm=True,
+            num_experts=hf["num_local_experts"]
+            if "num_local_experts" in hf
+            else hf["num_experts"],
+            num_experts_per_tok=hf["num_experts_per_tok"],
+            moe_intermediate_size=hf["moe_intermediate_size"],
+        )
     elif arch == "MixtralForCausalLM":
         common.update(
             num_experts=hf["num_local_experts"],
@@ -231,11 +253,15 @@ def _hf_leaf(cfg: ModelConfig, hf_name: str):
         "self_attn.k_proj.bias": ("layers.bk", False),
         "self_attn.v_proj.bias": ("layers.bv", False),
         "self_attn.o_proj.weight": ("layers.wo", True),
+        # Qwen3 QK-norm (per-head RMSNorm weights over head_dim).
+        "self_attn.q_norm.weight": ("layers.q_head_norm", False),
+        "self_attn.k_norm.weight": ("layers.k_head_norm", False),
         "post_attention_layernorm.weight": ("layers.mlp_norm", False),
         "mlp.gate_proj.weight": ("layers.w_gate", True),
         "mlp.up_proj.weight": ("layers.w_up", True),
         "mlp.down_proj.weight": ("layers.w_down", True),
         "block_sparse_moe.gate.weight": ("layers.router", True),
+        "mlp.gate.weight": ("layers.router", True),
     }
     if cfg.is_mla:
         # DeepSeek-V2/V3 MLA projections. q_proj is the direct-q (V2-Lite)
@@ -251,7 +277,6 @@ def _hf_leaf(cfg: ModelConfig, hf_name: str):
                 "self_attn.kv_a_proj_with_mqa.weight": ("layers.w_dkv", True),
                 "self_attn.kv_a_layernorm.weight": ("layers.kv_norm", False),
                 "self_attn.kv_b_proj.weight": ("layers._w_ukv", True),
-                "mlp.gate.weight": ("layers.router", True),
                 "mlp.shared_experts.gate_proj.weight": ("layers.w_sh_gate", True),
                 "mlp.shared_experts.up_proj.weight": ("layers.w_sh_up", True),
                 "mlp.shared_experts.down_proj.weight": ("layers.w_sh_down", True),
@@ -339,6 +364,13 @@ def _stack_shapes(
                     pre + "bq": (L, Hq * D),
                     pre + "bk": (L, Hkv * D),
                     pre + "bv": (L, Hkv * D),
+                }
+            )
+        if cfg.qk_norm:
+            shapes.update(
+                {
+                    pre + "q_head_norm": (L, D),
+                    pre + "k_head_norm": (L, D),
                 }
             )
     if moe:
@@ -736,8 +768,12 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
     os.makedirs(path, exist_ok=True)
     if cfg.is_mla:
         arch = "DeepseekV2ForCausalLM"
+    elif cfg.is_moe and cfg.qk_norm:
+        arch = "Qwen3MoeForCausalLM"
     elif cfg.is_moe:
         arch = "MixtralForCausalLM"
+    elif cfg.qk_norm:
+        arch = "Qwen3ForCausalLM"
     elif cfg.attn_bias:
         arch = "Qwen2ForCausalLM"
     else:
@@ -749,7 +785,7 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
         "hidden_size": cfg.hidden_size,
         "intermediate_size": (
             cfg.moe_intermediate_size
-            if (cfg.is_moe and not cfg.is_mla)
+            if (cfg.is_moe and not cfg.is_mla and not cfg.qk_norm)
             else cfg.intermediate_size
         ),
         "num_hidden_layers": cfg.num_layers,
@@ -761,6 +797,12 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
         "max_position_embeddings": cfg.max_position_embeddings,
         "tie_word_embeddings": cfg.tie_word_embeddings,
     }
+    if cfg.is_moe and cfg.qk_norm:
+        hf_cfg.update(
+            num_experts=cfg.num_experts,
+            num_experts_per_tok=cfg.num_experts_per_tok,
+            moe_intermediate_size=cfg.moe_intermediate_size,
+        )
     if cfg.is_mla:
         hf_cfg.update(
             kv_lora_rank=cfg.kv_lora_rank,
@@ -841,11 +883,18 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
                 tensors[pre + "self_attn.q_proj.bias"] = host(lp["bq"])[i]
                 tensors[pre + "self_attn.k_proj.bias"] = host(lp["bk"])[i]
                 tensors[pre + "self_attn.v_proj.bias"] = host(lp["bv"])[i]
+            if cfg.qk_norm:
+                tensors[pre + "self_attn.q_norm.weight"] = host(
+                    lp["q_head_norm"]
+                )[i]
+                tensors[pre + "self_attn.k_norm.weight"] = host(
+                    lp["k_head_norm"]
+                )[i]
         if layer_moe:
             gate_name, exp_pre, w_names = (
                 ("mlp.gate.weight", "mlp.experts.",
                  ("gate_proj.weight", "up_proj.weight", "down_proj.weight"))
-                if cfg.is_mla
+                if cfg.is_mla or cfg.qk_norm  # deepseek + qwen3-moe naming
                 else ("block_sparse_moe.gate.weight", "block_sparse_moe.experts.",
                       ("w1.weight", "w3.weight", "w2.weight"))
             )
