@@ -98,6 +98,51 @@ def run_case(R, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16, chunk=4,
     return err
 
 
+def run_packed_case(R, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16,
+                    int8=False):
+    """Packed-pair decode (head_dim < 128, llama3-1b class): cache rows
+    carry P = 128/D heads; queries embed block-diagonally. Kernel vs the
+    unpacking gather oracle."""
+    from xllm_service_tpu.ops import kv_cache as kvc
+    from xllm_service_tpu.ops.attention import kernel_io_for, unpack_outputs
+
+    rng = np.random.default_rng(0)
+    P = 128 // D
+    hc, dc = Hkv // P, D * P
+    N = R * MB + 1
+    q = jnp.asarray(rng.standard_normal((R, Hq, D)), dtype)
+    k = jnp.asarray(rng.standard_normal((N, hc, BS, dc)), dtype)
+    v = jnp.asarray(rng.standard_normal((N, hc, BS, dc)), dtype)
+    if int8:
+        k, v = kvc.quantize_pool(k), kvc.quantize_pool(v)
+    bt = jnp.asarray(1 + np.arange(R * MB).reshape(R, MB) % (N - 1), jnp.int32)
+    lens = jnp.asarray(
+        np.clip(rng.integers(ctx // 2, ctx + 1, R), 1, MB * BS), jnp.int32
+    )
+    scale = 1.0 / D**0.5
+    pk, kvh, qp = kernel_io_for(k, q)
+
+    ker = lambda: unpack_outputs(
+        paged_attention_kernel(qp, k, v, bt, lens, scale), pk, kvh
+    )
+    gat = lambda: paged_attention_gather(q, k, v, bt, lens, scale)
+    err = float(
+        np.max(np.abs(np.asarray(ker().astype(jnp.float32))
+                      - np.asarray(gat().astype(jnp.float32))))
+    )
+    tk, tg = bench(ker), bench(gat)
+    row_bytes = dc * (1 if int8 else dtype.dtype.itemsize) + (32 if int8 else 0)
+    kv_bytes = 2 * float(np.sum(np.asarray(lens))) * hc * row_bytes
+    bw = kv_bytes / tk / 1e9
+    print(
+        f"PACKED R={R:3d} Hq={Hq} Hkv={Hkv} D={D} (P={pk}) BS={BS} MB={MB} "
+        f"ctx~{ctx} {'int8' if int8 else 'bf16'} err={err:.4f} "
+        f"kernel={tk*1e6:8.1f}us gather={tg*1e6:8.1f}us "
+        f"speedup={tg/tk:5.2f}x bw={bw:6.1f}GB/s"
+    )
+    return err
+
+
 def run_mq_case(R, S, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16,
                 int8=False):
     """Multi-query decode (speculative verify) kernel vs the blockwise
@@ -396,6 +441,11 @@ CASES = [
     ("mla-prefill-int8", run_mla_prefill_case,
      dict(P=2, Lpad=512, Hq=128, kvr=512, dr=64, BS=128, MB=8,
           int8=True)),
+    # Packed-pair head_dim-64 decode (llama3-1b geometry: Hq=32 Hkv=8)
+    ("dec-packed-bf16", run_packed_case,
+     dict(R=64, Hq=32, Hkv=8, D=64, BS=128, MB=16, ctx=2048)),
+    ("dec-packed-int8", run_packed_case,
+     dict(R=64, Hq=32, Hkv=8, D=64, BS=128, MB=16, ctx=2048, int8=True)),
     # bf16 decode (re-validated round 2; re-run last)
     ("dec-bf16-prod", run_case,
      dict(R=64, Hq=32, Hkv=8, D=128, BS=128, MB=16, ctx=2048)),

@@ -292,6 +292,22 @@ register(
 )
 
 register(
+    # head_dim 64 with 2 kv heads: exercises the packed-pair KV layout
+    # (kv_cache.kv_pack_factor P=2 -> one 128-lane cache row per pair).
+    ModelConfig(
+        name="llama3-packed-tiny",
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=64,
+        max_position_embeddings=1024,
+    )
+)
+
+register(
     ModelConfig(
         name="qwen3-moe-tiny",
         vocab_size=512,

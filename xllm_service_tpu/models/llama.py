@@ -51,8 +51,11 @@ QUANTIZABLE_WEIGHT_LEAVES = (
 
 
 def cache_row_dims(cfg: ModelConfig) -> Tuple[int, int]:
-    """(heads, row_dim) of one paged-cache row: per-KV-head K/V vectors."""
-    return cfg.num_kv_heads, cfg.head_dim
+    """(heads, row_dim) of one paged-cache row. head_dim < 128 models
+    pack P = 128/head_dim consecutive KV heads per row so the Pallas
+    kernels' 128-lane DMA tiling holds (kv_cache.kv_pack_factor)."""
+    P = kv_cache_ops.kv_pack_factor(cfg.num_kv_heads, cfg.head_dim)
+    return cfg.num_kv_heads // P, cfg.head_dim * P
 
 
 def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
@@ -222,12 +225,18 @@ def _qkv(lp, cfg: ModelConfig, x: jnp.ndarray, positions: jnp.ndarray,
 def _scatter_kv(k_cache, v_cache, blk, offset, k, v):
     """Write per-token K/V rows into cache slots.
 
-    k_cache: [num_blocks, Hkv, bs, D] plain array or PagedKV (int8 caches
+    k_cache: [num_blocks, Hc, bs, Dc] plain array or PagedKV (int8 caches
     quantize the rows on write); blk/offset: [T] block ids and in-block
     offsets per token; inactive/invalid tokens carry (0, 0), pointing into
-    the reserved garbage block 0."""
-    kf = kv_cache_ops.scatter_rows(k_cache, blk, offset, k)
-    vf = kv_cache_ops.scatter_rows(v_cache, blk, offset, v)
+    the reserved garbage block 0. Packed caches (Hc < Hkv — head_dim < 128
+    models, see cache_row_dims) take the rows reshaped to the packed
+    layout: consecutive heads concatenate on lanes."""
+    kf = kv_cache_ops.scatter_rows(
+        k_cache, blk, offset, kv_cache_ops.pack_rows(k, k_cache)
+    )
+    vf = kv_cache_ops.scatter_rows(
+        v_cache, blk, offset, kv_cache_ops.pack_rows(v, v_cache)
+    )
     return kf, vf
 
 

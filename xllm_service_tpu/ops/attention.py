@@ -29,16 +29,76 @@ from xllm_service_tpu.ops import kv_cache as kvc
 NEG_INF = -1e30
 
 
+def _pack_ratio(cache, q_head_dim: int) -> int:
+    """Heads packed per cache row (kv_cache.kv_pack_factor layouts):
+    1 for ordinary caches, cache_row_dim / head_dim for packed ones."""
+    return kvc.raw(cache).shape[-1] // q_head_dim
+
+
+def _pack_lanes(heads: int, pack: int, groups: int) -> jnp.ndarray:
+    """[Hq, pack] one-hot of which packed lane-block each query head's
+    kv head occupies (query head h -> kv head h // groups)."""
+    i = (jnp.arange(heads, dtype=jnp.int32) // groups) % pack
+    return jax.nn.one_hot(i, pack, dtype=jnp.float32)
+
+
+def kernel_io_for(cache, q: jnp.ndarray):
+    """(pack, kv_heads, packed_q) for a kernel call against `cache` —
+    the one place the pack/derive trio lives (review r3)."""
+    pack = _pack_ratio(cache, q.shape[-1])
+    kv_heads = kvc.raw(cache).shape[-3] * pack
+    return pack, kv_heads, pack_queries(q, pack, kv_heads)
+
+
+def _packed_kernel_allowed(pack: int) -> bool:
+    """Packed-pair shapes are a NEW on-chip shape class validated only in
+    interpret mode so far; per the repo's opt-in-until-chip-validated
+    convention they ride the kernels only under XLLM_PACKED_KV_KERNEL=1
+    (scripts/validate_kernel_tpu.py carries the packed cases; flip the
+    default once they report PARITY OK on silicon)."""
+    import os
+
+    return pack == 1 or os.environ.get("XLLM_PACKED_KV_KERNEL") == "1"
+
+
+def pack_queries(q: jnp.ndarray, pack: int, kv_heads: int) -> jnp.ndarray:
+    """Embed queries block-diagonally for a packed cache: [..., Hq, D] ->
+    [..., Hq, pack*D] with head h's vector in its kv head's lane block and
+    zeros elsewhere — zeros keep q·k scores exact against packed K rows,
+    and the pv garbage lanes are discarded by unpack_outputs."""
+    if pack == 1:
+        return q
+    *lead, hq, d = q.shape
+    oh = _pack_lanes(hq, pack, hq // kv_heads).astype(q.dtype)
+    return jnp.einsum("...hd,hp->...hpd", q, oh).reshape(*lead, hq, pack * d)
+
+
+def unpack_outputs(o: jnp.ndarray, pack: int, kv_heads: int) -> jnp.ndarray:
+    """Select each query head's own lane block from packed attention
+    output: [..., Hq, pack*D] -> [..., Hq, D]."""
+    if pack == 1:
+        return o
+    *lead, hq, dp = o.shape
+    oh = _pack_lanes(hq, pack, hq // kv_heads).astype(o.dtype)
+    o = o.reshape(*lead, hq, pack, dp // pack)
+    return jnp.einsum("...hpd,hp->...hd", o, oh)
+
+
 def gather_context(
     k_cache,  # [num_blocks, Hkv, block_size, D] (plain or PagedKV)
     v_cache,
     block_table: jnp.ndarray,  # [R, max_blocks] int32
+    unpack: int = 1,
 ):
     """Gather each sequence's context as [R, max_blocks*block_size, Hkv, D].
     Quantized (int8) caches are dequantized after the gather — only the
-    sequence's own blocks pay the dequant, not the whole pool."""
-    k_ctx = jnp.swapaxes(kvc.gather_blocks(k_cache, block_table), 2, 3)
-    v_ctx = jnp.swapaxes(kvc.gather_blocks(v_cache, block_table), 2, 3)
+    sequence's own blocks pay the dequant, not the whole pool. `unpack`
+    undoes packed-pair rows (head_dim < 128 layouts) on the gathered
+    slice only."""
+    k_ctx = kvc.unpack_rows(kvc.gather_blocks(k_cache, block_table), unpack)
+    v_ctx = kvc.unpack_rows(kvc.gather_blocks(v_cache, block_table), unpack)
+    k_ctx = jnp.swapaxes(k_ctx, 2, 3)
+    v_ctx = jnp.swapaxes(v_ctx, 2, 3)
     R, MB, BS, H, D = k_ctx.shape
     return k_ctx.reshape(R, MB * BS, H, D), v_ctx.reshape(R, MB * BS, H, D)
 
@@ -74,7 +134,10 @@ def paged_attention_gather(
 ) -> jnp.ndarray:
     """Decode-step attention: each query attends to its first seq_lens cache
     rows. Returns [R, Hq, D]."""
-    k_ctx, v_ctx = gather_context(k_cache, v_cache, block_table)
+    k_ctx, v_ctx = gather_context(
+        k_cache, v_cache, block_table,
+        unpack=_pack_ratio(k_cache, q.shape[-1]),
+    )
     Lk = k_ctx.shape[1]
     cols = jnp.arange(Lk, dtype=jnp.int32)[None, :]  # [1, Lk]
     mask = cols < seq_lens[:, None]  # [R, Lk]
@@ -96,7 +159,10 @@ def prefill_attention_gather(
     contain this chunk's K/V — caller scatters before attending). Causal.
     Reference oracle — materializes the full [L, Lk] score matrix; the
     serving path uses prefill_attention_blockwise. Returns [L, Hq, D]."""
-    k_ctx, v_ctx = gather_context(k_cache, v_cache, block_table[None])
+    k_ctx, v_ctx = gather_context(
+        k_cache, v_cache, block_table[None],
+        unpack=_pack_ratio(k_cache, q.shape[-1]),
+    )
     L = q.shape[0]
     Lk = k_ctx.shape[1]
     rows = start_pos + jnp.arange(L, dtype=jnp.int32)  # absolute positions
@@ -123,8 +189,9 @@ def prefill_attention_blockwise(
     (~8.5 GB for 32 heads) would not fit v5e HBM. Exact (log-sum-exp
     merge), parity-tested against prefill_attention_gather."""
     L, Hq, D = q.shape
-    Hkv = k_cache.shape[1]
-    BS = k_cache.shape[2]
+    pack = _pack_ratio(k_cache, D)
+    Hkv = kvc.raw(k_cache).shape[-3] * pack
+    BS = kvc.raw(k_cache).shape[-2]
     G = Hq // Hkv
     qf = q.astype(jnp.float32).reshape(L, Hkv, G, D)
     rows = start_pos + jnp.arange(L, dtype=jnp.int32)  # absolute positions
@@ -138,8 +205,12 @@ def prefill_attention_blockwise(
     def body(carry, inputs):
         m_prev, l_prev, acc = carry
         blk_idx, blk_id = inputs
-        k_blk = kvc.gather_block(k_cache, blk_id, jnp.float32)  # [Hkv, BS, D]
-        v_blk = kvc.gather_block(v_cache, blk_id, jnp.float32)
+        k_blk = kvc.unpack_rows(
+            kvc.gather_block(k_cache, blk_id, jnp.float32), pack
+        )  # [Hkv, BS, D]
+        v_blk = kvc.unpack_rows(
+            kvc.gather_block(v_cache, blk_id, jnp.float32), pack
+        )
         cols = blk_idx * BS + jnp.arange(BS, dtype=jnp.int32)
         scores = (
             jnp.einsum("qhgd,hkd->qhgk", qf, k_blk) * scale
@@ -186,8 +257,10 @@ def _kernel_tile_ok(cache, lane_dim: int, on: bool) -> bool:
     )
 
 
-def _gqa_kernel_ok(k_cache, D: int, on: bool) -> bool:
-    return _kernel_tile_ok(k_cache, D, on)
+def _gqa_kernel_ok(k_cache, on: bool) -> bool:
+    # Gate on the CACHE row width: packed head_dim<128 layouts carry
+    # 128-lane rows and are kernel-eligible; unpacked narrow rows are not.
+    return _kernel_tile_ok(k_cache, kvc.raw(k_cache).shape[-1], on)
 
 
 def _mla_kernel_ok(c_cache, on: bool) -> bool:
@@ -215,7 +288,12 @@ def prefill_attention(
 
     # One eligibility predicate for BOTH Pallas paths (flash prefill and
     # the multi-query verify kernel).
-    kernel_ok = _gqa_kernel_ok(k_cache, q.shape[-1], _on_tpu() or interpret)
+    # Packed-pair caches (head_dim < 128): queries embed block-diagonally
+    # into the 128-lane rows; outputs slice back (pack_queries docstring).
+    pack, kv_heads, q_packed = kernel_io_for(k_cache, q)
+    kernel_ok = _gqa_kernel_ok(
+        k_cache, _on_tpu() or interpret
+    ) and _packed_kernel_allowed(pack)
 
     # Speculative-verify shapes (a handful of query rows per sequence):
     # the multi-query decode kernel streams each KV row ONCE like a decode
@@ -241,9 +319,12 @@ def prefill_attention(
         )
 
         seq_lens = jnp.where(true_len > 0, start_pos + 1, 0)
-        return multiquery_paged_attention_kernel(
-            q, k_cache, v_cache, block_tables, seq_lens, scale,
-            interpret=interpret,
+        return unpack_outputs(
+            multiquery_paged_attention_kernel(
+                q_packed, k_cache, v_cache,
+                block_tables, seq_lens, scale, interpret=interpret,
+            ),
+            pack, kv_heads,
         )
 
     env = os.environ.get("XLLM_PREFILL_ATTENTION_KERNEL")
@@ -254,9 +335,13 @@ def prefill_attention(
             flash_prefill_kernel,
         )
 
-        return flash_prefill_kernel(
-            q, k_cache, v_cache, block_tables, start_pos, true_len, scale,
-            interpret=interpret,
+        return unpack_outputs(
+            flash_prefill_kernel(
+                q_packed, k_cache, v_cache,
+                block_tables, start_pos, true_len, scale,
+                interpret=interpret,
+            ),
+            pack, kv_heads,
         )
     return jax.vmap(
         lambda qi, ti, sp, tl: prefill_attention_blockwise(
@@ -466,14 +551,18 @@ def paged_attention(
     Set XLLM_PAGED_ATTENTION_KERNEL=0 to force the gather path, =1 to force
     the kernel even where the default heuristics decline it.
 
-    The head_dim < 128 case falls back to gather: the per-block HBM slice is
-    lane-padded below one 128-lane tile and Mosaic refuses the memref slice
-    (observed on-chip: D=64 -> tpu.memref_slice verification failure)."""
+    head_dim < 128 models ride the kernel through the packed-pair cache
+    layout (kv_cache.kv_pack_factor: a bare [BS, 64] block slice is below
+    one 128-lane Mosaic tile — observed on-chip as a tpu.memref_slice
+    verification failure — so P heads pack per 128-lane row and queries
+    embed block-diagonally, see pack_queries)."""
     import os
 
     env = os.environ.get("XLLM_PAGED_ATTENTION_KERNEL")
     if use_kernel is None:
-        kernel_ok = _gqa_kernel_ok(k_cache, q.shape[-1], _on_tpu())
+        kernel_ok = _gqa_kernel_ok(
+            k_cache, _on_tpu()
+        ) and _packed_kernel_allowed(_pack_ratio(k_cache, q.shape[-1]))
         use_kernel = (env != "0") if kernel_ok else (env == "1")
     if use_kernel:
         try:
@@ -483,7 +572,11 @@ def paged_attention(
         except ImportError:
             use_kernel = False
         else:
-            return paged_attention_kernel(
-                q, k_cache, v_cache, block_table, seq_lens, scale
+            pack, kv_heads, q_packed = kernel_io_for(k_cache, q)
+            return unpack_outputs(
+                paged_attention_kernel(
+                    q_packed, k_cache, v_cache, block_table, seq_lens, scale,
+                ),
+                pack, kv_heads,
             )
     return paged_attention_gather(q, k_cache, v_cache, block_table, seq_lens, scale)

@@ -79,6 +79,23 @@ def _ceil8(x: int) -> int:
 GQA_SCALE_GROUPS = 8
 
 
+def kv_pack_factor(num_kv_heads: int, head_dim: int) -> int:
+    """KV heads PACKED per cache row for head_dim < 128 models.
+
+    Mosaic DMA slices need 128-multiple lane extents (chip finding,
+    round 3), so a [BS, 64] per-(block, head) tile can never ride the
+    Pallas kernels. Packing P = 128 // head_dim consecutive heads into
+    one 128-lane row ([N, Hkv/P, BS, P*D]) makes every model with a
+    dividing head_dim kernel-eligible: kernels see an ordinary D'=128
+    cache; wrappers embed queries block-diagonally (zeros in the other
+    heads' lanes keep scores exact) and slice outputs back. Returns 1
+    (no packing) when head_dim >= 128, doesn't divide 128, or doesn't
+    divide the head count."""
+    if head_dim >= 128 or 128 % head_dim or num_kv_heads % (128 // head_dim):
+        return 1
+    return 128 // head_dim
+
+
 def mla_scale_groups(
     kv_lora_rank: int, rope_dim: int, cache_dim: Optional[int] = None
 ) -> int:
@@ -219,6 +236,29 @@ def set_blocks(cache: CacheLike, ids: jnp.ndarray, blocks: jnp.ndarray):
     untouched). Used by the PD/tier migration import path."""
     idx = (slice(None), ids)
     return set_rows(cache, idx, idx, blocks, mode="block")
+
+
+def pack_rows(rows: jnp.ndarray, cache: "CacheLike") -> jnp.ndarray:
+    """Relayout per-token rows [..., Hkv, D] to a cache's packed row shape
+    [..., Hc, Dc] (consecutive heads concatenate on lanes — the inverse of
+    unpack_rows). No-op for unpacked caches. The ONE place the write-side
+    packing reshape lives."""
+    hc = raw(cache).shape[-3]
+    if hc == rows.shape[-2]:
+        return rows
+    return rows.reshape(*rows.shape[:-2], hc, -1)
+
+
+def unpack_rows(x: jnp.ndarray, pack: int) -> jnp.ndarray:
+    """Undo kv_pack_factor packing on a gathered cache slice
+    [..., Hc, BS, Dc] -> [..., Hc*pack, BS, Dc/pack] (consecutive heads
+    were concatenated on lanes, so head order is preserved)."""
+    if pack == 1:
+        return x
+    *lead, hc, bs, dc = x.shape
+    x = x.reshape(*lead, hc, bs, pack, dc // pack)
+    x = jnp.moveaxis(x, -2, -3)
+    return x.reshape(*lead, hc * pack, bs, dc // pack)
 
 
 def quantize_pool(cache: jnp.ndarray, groups: int = GQA_SCALE_GROUPS) -> PagedKV:

@@ -175,6 +175,20 @@ def check_tp_divisibility(cfg: ModelConfig, tp: int, ep: int = 1) -> None:
             f"tp={tp} must divide num_heads={cfg.num_heads} and "
             f"num_kv_heads={cfg.num_kv_heads}"
         )
+    else:
+        from xllm_service_tpu.ops.kv_cache import kv_pack_factor
+
+        packed = cfg.num_kv_heads // kv_pack_factor(
+            cfg.num_kv_heads, cfg.head_dim
+        )
+        if packed % tp:
+            raise ValueError(
+                f"tp={tp} must divide the PACKED KV-head count {packed}: "
+                f"head_dim={cfg.head_dim} < 128 models pack "
+                f"{cfg.num_kv_heads // packed} heads per 128-lane cache "
+                f"row for Mosaic kernel tiling (kv_cache.kv_pack_factor), "
+                f"and the packed rows are the shardable axis"
+            )
     if cfg.is_moe:
         # EP×TP: experts over ep, per-expert hidden over tp; pure-TP MoE
         # (ep=1) shards the expert axis over tp instead.

@@ -514,11 +514,11 @@ class ModelExecutor:
             if self.kv_quantized
             else dtype_bytes
         )
-        # MLA's latent cache is replicated (no KV-head axis to shard).
+        # MLA's latent cache is replicated (no KV-head axis to shard);
+        # for GQA, check_tp_divisibility guarantees tp divides the packed
+        # cache-head count.
         heads_per_dev = (
-            cache_heads
-            if self.cfg.is_mla or cache_heads < tp
-            else cache_heads // tp
+            cache_heads if self.cfg.is_mla else cache_heads // tp
         )
         block_bytes = (
             models.num_caches(self.cfg)
@@ -1091,8 +1091,10 @@ class ModelExecutor:
         di = (slice(None), blk, slice(None), off, slice(None))
         # Scale pool is [L, N, Hkv, G, BS]: off picks the BS lane.
         si = (slice(None), blk, slice(None), slice(None), off)
-        k_cache = kvc.set_rows(k_cache, di, si, jnp.swapaxes(k_all, 0, 1))
-        v_cache = kvc.set_rows(v_cache, di, si, jnp.swapaxes(v_all, 0, 1))
+        rows_k = kvc.pack_rows(jnp.swapaxes(k_all, 0, 1), k_cache)
+        rows_v = kvc.pack_rows(jnp.swapaxes(v_all, 0, 1), v_cache)
+        k_cache = kvc.set_rows(k_cache, di, si, rows_k)
+        v_cache = kvc.set_rows(v_cache, di, si, rows_v)
         tokens, logprob, _ = sampling_ops.sample_tokens(
             logits[None], temperature[None], top_k[None], top_p[None],
             step_key[None],
