@@ -131,9 +131,12 @@ def paged_attention_gather(
     block_table: jnp.ndarray,  # [R, max_blocks]
     seq_lens: jnp.ndarray,  # [R] context length INCLUDING current token
     scale: float,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Decode-step attention: each query attends to its first seq_lens cache
-    rows. Returns [R, Hq, D]."""
+    rows — the LAST `window` of them when sliding-window attention is on
+    (window > 0, HF semantics: positions [pos-window+1, pos]). Returns
+    [R, Hq, D]."""
     k_ctx, v_ctx = gather_context(
         k_cache, v_cache, block_table,
         unpack=_pack_ratio(k_cache, q.shape[-1]),
@@ -141,6 +144,8 @@ def paged_attention_gather(
     Lk = k_ctx.shape[1]
     cols = jnp.arange(Lk, dtype=jnp.int32)[None, :]  # [1, Lk]
     mask = cols < seq_lens[:, None]  # [R, Lk]
+    if window > 0:
+        mask = mask & (cols >= seq_lens[:, None] - window)
     out = _sdpa(q[:, None], k_ctx, v_ctx, mask[:, None, :], scale)
     return out[:, 0]
 
@@ -153,10 +158,12 @@ def prefill_attention_gather(
     start_pos: jnp.ndarray,  # scalar int32: tokens already in cache (prefix hit)
     true_len: jnp.ndarray,  # scalar int32: valid tokens in this chunk
     scale: float,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Chunked-prefill attention for one sequence: rows are chunk positions
     start_pos..start_pos+L, columns the sequence's cache rows (which already
-    contain this chunk's K/V — caller scatters before attending). Causal.
+    contain this chunk's K/V — caller scatters before attending). Causal;
+    window > 0 restricts each row to its last `window` positions.
     Reference oracle — materializes the full [L, Lk] score matrix; the
     serving path uses prefill_attention_blockwise. Returns [L, Hq, D]."""
     k_ctx, v_ctx = gather_context(
@@ -168,6 +175,8 @@ def prefill_attention_gather(
     rows = start_pos + jnp.arange(L, dtype=jnp.int32)  # absolute positions
     cols = jnp.arange(Lk, dtype=jnp.int32)
     causal = cols[None, :] <= rows[:, None]
+    if window > 0:
+        causal = causal & (cols[None, :] > rows[:, None] - window)
     valid_row = jnp.arange(L, dtype=jnp.int32) < true_len
     mask = causal & valid_row[:, None]
     out = _sdpa(q[None], k_ctx, v_ctx, mask[None], scale)
@@ -182,6 +191,7 @@ def prefill_attention_blockwise(
     start_pos: jnp.ndarray,  # scalar int32
     true_len: jnp.ndarray,  # scalar int32
     scale: float,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Flash-style prefill: lax.scan over KV blocks with online-softmax
     accumulation. Peak memory is O(L * BS) per step instead of the dense
@@ -216,6 +226,8 @@ def prefill_attention_blockwise(
             jnp.einsum("qhgd,hkd->qhgk", qf, k_blk) * scale
         )  # [L, Hkv, G, BS]
         mask = (cols[None, :] <= rows[:, None]) & valid_row[:, None]
+        if window > 0:
+            mask = mask & (cols[None, :] > rows[:, None] - window)
         scores = jnp.where(mask[:, None, None], scores, NEG_INF)
 
         m_cur = jnp.max(scores, axis=-1, keepdims=True)
@@ -277,10 +289,13 @@ def prefill_attention(
     scale: float,
     use_kernel: bool | None = None,
     interpret: bool = False,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Batched chunked-prefill attention over the paged cache; Pallas
     flash kernel (ops/pallas/flash_prefill.py) on TPU, vmapped blockwise
-    scan elsewhere. Same eligibility rules as the decode kernel (D a
+    scan elsewhere. window > 0 = sliding-window attention (each position
+    attends its last `window` positions; kernels also skip blocks wholly
+    below the window). Same eligibility rules as the decode kernel (D a
     lane multiple; int8 additionally needs BS scale rows 128-wide); env
     override XLLM_PREFILL_ATTENTION_KERNEL=0/1 forces the path, and
     `interpret` lets CI drive the kernel branch on CPU."""
@@ -323,6 +338,7 @@ def prefill_attention(
             multiquery_paged_attention_kernel(
                 q_packed, k_cache, v_cache,
                 block_tables, seq_lens, scale, interpret=interpret,
+                window=window,
             ),
             pack, kv_heads,
         )
@@ -339,13 +355,13 @@ def prefill_attention(
             flash_prefill_kernel(
                 q_packed, k_cache, v_cache,
                 block_tables, start_pos, true_len, scale,
-                interpret=interpret,
+                interpret=interpret, window=window,
             ),
             pack, kv_heads,
         )
     return jax.vmap(
         lambda qi, ti, sp, tl: prefill_attention_blockwise(
-            qi, k_cache, v_cache, ti, sp, tl, scale
+            qi, k_cache, v_cache, ti, sp, tl, scale, window=window
         )
     )(q, block_tables, start_pos, true_len)
 
@@ -541,7 +557,8 @@ def _on_tpu() -> bool:
 
 
 def paged_attention(
-    q, k_cache, v_cache, block_table, seq_lens, scale, use_kernel: bool | None = None
+    q, k_cache, v_cache, block_table, seq_lens, scale,
+    use_kernel: bool | None = None, window: int = 0,
 ):
     """Decode paged attention; Pallas kernel on TPU, gather fallback elsewhere.
 
@@ -576,7 +593,10 @@ def paged_attention(
             return unpack_outputs(
                 paged_attention_kernel(
                     q_packed, k_cache, v_cache, block_table, seq_lens, scale,
+                    window=window,
                 ),
                 pack, kv_heads,
             )
-    return paged_attention_gather(q, k_cache, v_cache, block_table, seq_lens, scale)
+    return paged_attention_gather(
+        q, k_cache, v_cache, block_table, seq_lens, scale, window=window
+    )

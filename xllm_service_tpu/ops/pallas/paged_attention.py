@@ -95,6 +95,7 @@ def _decode_kernel(
     s_rows: int = 1,
     gp: int = 0,
     scale_groups: int = 8,
+    window: int = 0,
 ):
     if quantized:
         ks_hbm, vs_hbm, o_ref, k_buf, v_buf, sems, ks_buf, vs_buf, ssems = rest
@@ -105,6 +106,13 @@ def _decode_kernel(
     h = pl.program_id(1)
     seq_len = seq_lens_ref[r]
     span = chunk * block_size
+    # Sliding-window attention: the chunk walk starts at the first chunk
+    # holding any in-window position (earliest window start across the
+    # s_rows queries is seq_len - window) — blocks wholly below it never
+    # stream, so SWA decode bandwidth is O(window), not O(context).
+    c_lo = (
+        jnp.maximum(seq_len - window, 0) // span if window > 0 else 0
+    )
     if s_rows == 1:
         nc = pl.cdiv(seq_len, span)  # chunks to process
     else:
@@ -167,9 +175,9 @@ def _decode_kernel(
     # Inactive decode slots carry seq_len = 0: issue no DMAs (their
     # semaphores would never be awaited and could satisfy a later grid
     # step's wait early) and emit zeros.
-    @pl.when(nc > 0)
+    @pl.when(nc > c_lo)
     def _first():
-        start_chunk(0, 0)
+        start_chunk(jax.lax.rem(c_lo, 2), c_lo)
 
     q = q_ref[0, 0]  # [Gp, D], model dtype (bf16 on TPU)
 
@@ -198,11 +206,15 @@ def _decode_kernel(
         col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
         if s_rows == 1:
             valid = c * span + col < seq_len
+            if window > 0:
+                valid &= c * span + col >= seq_len - window
         else:
             # q tile rows are [S, Gp] flattened: row // gp is the query's
             # offset from the first fed position (causal within the step).
             row = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
             valid = c * span + col < seq_len + row // gp
+            if window > 0:
+                valid &= c * span + col >= seq_len + row // gp - window
         scores = jnp.where(valid, scores, NEG_INF)
 
         m_cur = jnp.max(scores, axis=-1, keepdims=True)
@@ -229,10 +241,10 @@ def _decode_kernel(
     m0 = jnp.full((Gp, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((Gp, 1), jnp.float32)
     a0 = jnp.zeros((Gp, D), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nc, body, (m0, l0, a0))
+    m, l, acc = jax.lax.fori_loop(c_lo, nc, body, (m0, l0, a0))
     # an active slot always has seq_len >= 1 (l > 0); inactive slots get 0
     o_ref[0, 0] = jnp.where(
-        nc > 0, acc / jnp.maximum(l, 1e-30), 0.0
+        nc > c_lo, acc / jnp.maximum(l, 1e-30), 0.0
     ).astype(o_ref.dtype)
 
 
@@ -241,7 +253,7 @@ def _round_up(x: int, m: int) -> int:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "interpret", "chunk")
+    jax.jit, static_argnames=("scale", "interpret", "chunk", "window")
 )
 def paged_attention_kernel(
     q: jnp.ndarray,            # [R, Hq, D]
@@ -252,6 +264,7 @@ def paged_attention_kernel(
     scale: float,
     interpret: bool = False,
     chunk: int = 4,
+    window: int = 0,
 ) -> jnp.ndarray:
     from xllm_service_tpu.ops import kv_cache as kvc
 
@@ -322,7 +335,7 @@ def paged_attention_kernel(
     kernel = functools.partial(
         _decode_kernel, block_size=BS, chunk=C, scale=scale,
         quantized=quantized,
-        scale_groups=SG,
+        scale_groups=SG, window=window,
     )
     out = pl.pallas_call(
         kernel,
@@ -344,7 +357,7 @@ def paged_attention_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "interpret", "chunk")
+    jax.jit, static_argnames=("scale", "interpret", "chunk", "window")
 )
 def multiquery_paged_attention_kernel(
     q: jnp.ndarray,            # [R, S, Hq, D] — S consecutive query tokens
@@ -356,6 +369,7 @@ def multiquery_paged_attention_kernel(
     scale: float,
     interpret: bool = False,
     chunk: int = 4,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Speculative-verify attention: the decode kernel with S query rows
     per sequence. Same HBM traffic as one decode step (each KV row streams
@@ -428,7 +442,7 @@ def multiquery_paged_attention_kernel(
     kernel = functools.partial(
         _decode_kernel, block_size=BS, chunk=C, scale=scale,
         quantized=quantized, s_rows=S, gp=Gp,
-        scale_groups=SG,
+        scale_groups=SG, window=window,
     )
     out = pl.pallas_call(
         kernel,
