@@ -185,14 +185,16 @@ def test_engine_e2e_deepseek():
 def test_mla_pallas_kernel_interpret_parity():
     """The MLA Pallas decode kernel (one program per sequence, latent
     streaming, online softmax) vs the gather oracle, interpret mode —
-    V3-like shapes scaled down (C=192 exercises the non-128-multiple lane
-    dim; Hq=16 exercises head padding is a no-op at multiples of 8)."""
+    V3-like shapes scaled down, at the lane-padded cache width the
+    production pool allocates (Hq=16 exercises head padding being a
+    no-op at multiples of 8)."""
     from xllm_service_tpu.ops.attention import mla_paged_attention_gather
     from xllm_service_tpu.ops.pallas.mla_attention import mla_attention_kernel
 
     rng = np.random.default_rng(6)
     R, Hq, BS, MB, kvr, dr = 3, 16, 16, 4, 160, 32
-    C = kvr + dr
+    C = 256  # kvr + dr = 192, lane-padded to the next 128 multiple —
+    # the production pool layout (kv_cache.mla_cache_dim; chip rule)
     N = R * MB + 1
     q = jnp.asarray(rng.standard_normal((R, Hq, C)), jnp.float32)
     cache = jnp.asarray(rng.standard_normal((N, 1, BS, C)), jnp.float32)
@@ -221,8 +223,10 @@ def test_mla_dispatcher_kernel_flag():
     )
 
     rng = np.random.default_rng(7)
-    q = jnp.asarray(rng.standard_normal((2, 4, 48)), jnp.float32)
-    cache = jnp.asarray(rng.standard_normal((5, 1, 16, 48)), jnp.float32)
+    # Lane-padded cache width (128) as the production pool allocates;
+    # int8 needs BS=128 so the [G, BS] scale tile is chip-legal.
+    q = jnp.asarray(rng.standard_normal((2, 4, 128)), jnp.float32)
+    cache = jnp.asarray(rng.standard_normal((5, 1, 128, 128)), jnp.float32)
     bt = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
     lens = jnp.asarray([20, 32], jnp.int32)
     a = mla_paged_attention(q, cache, bt, lens, 0.2, 40, use_kernel=False)
@@ -235,7 +239,7 @@ def test_mla_dispatcher_kernel_flag():
     np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=2e-5)
     # Quantized cache + use_kernel=True rides the kernel too and must
     # match the gather on the SAME quantized cache.
-    qcache = kvc.quantize_pool(cache, kvc.mla_scale_groups(40, 8, 48))
+    qcache = kvc.quantize_pool(cache, kvc.mla_scale_groups(40, 8, 128))
     d = mla_paged_attention(
         q, qcache, bt, lens, 0.2, 40, use_kernel=True, interpret=True
     )

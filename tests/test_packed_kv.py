@@ -123,6 +123,34 @@ def test_packed_decode_kernel_interpret_parity(int8):
     )
 
 
+def test_packing_falls_back_when_tp_exceeds_packed_heads():
+    """ADVICE r3: Hkv=8, D=64 packs to 4 cache rows — tp=8 used to raise
+    at startup even though the UNPACKED layout shards fine. Now
+    resolve_kv_packing disables packing and the gather path serves it."""
+    from xllm_service_tpu.models import cache_row_dims
+    from xllm_service_tpu.parallel.sharding import (
+        check_tp_divisibility, resolve_kv_packing,
+    )
+
+    cfg = get_model_config("llama3-tiny")  # Hkv=8? use real fields below
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        cfg, num_heads=8, num_kv_heads=8, head_dim=64, hidden_size=512,
+        intermediate_size=1024,
+    )
+    assert kvc.kv_pack_factor(8, 64) == 2  # packs to 4 rows
+    # tp=4 divides the packed count: packing stays on.
+    check_tp_divisibility(cfg, 4)
+    assert resolve_kv_packing(cfg, 4) is cfg
+    assert cache_row_dims(cfg) == (4, 128)
+    # tp=8 doesn't: must NOT raise, falls back to the unpacked layout.
+    check_tp_divisibility(cfg, 8)
+    cfg8 = resolve_kv_packing(cfg, 8)
+    assert cfg8.kv_pack_disable
+    assert cache_row_dims(cfg8) == (8, 64)
+
+
 def test_packed_executor_e2e_matches_dense():
     """llama3-packed-tiny through the executor (gather path on CPU):
     greedy continuation equals the dense oracle — the packed scatter,

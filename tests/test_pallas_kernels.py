@@ -11,7 +11,7 @@ from xllm_service_tpu.ops.pallas.paged_attention import paged_attention_kernel
 
 
 def make_case(
-    rng, R=4, Hq=8, Hkv=4, D=64, BS=16, MB=8, num_blocks=64, dtype=jnp.float32
+    rng, R=4, Hq=8, Hkv=4, D=128, BS=16, MB=8, num_blocks=64, dtype=jnp.float32
 ):
     q = jnp.asarray(rng.standard_normal((R, Hq, D)), dtype)
     k = jnp.asarray(rng.standard_normal((num_blocks, Hkv, BS, D)), dtype)
@@ -111,7 +111,7 @@ from xllm_service_tpu.ops.pallas.flash_prefill import flash_prefill_kernel
 
 
 def make_prefill_case(
-    rng, P=3, Lpad=48, Hq=8, Hkv=4, D=64, BS=16, MB=8, num_blocks=64,
+    rng, P=3, Lpad=48, Hq=8, Hkv=4, D=128, BS=16, MB=8, num_blocks=64,
     dtype=jnp.float32,
 ):
     q = jnp.asarray(rng.standard_normal((P, Lpad, Hq, D)), dtype)
@@ -179,6 +179,56 @@ def test_flash_prefill_prefix_hit():
         )
 
 
+@pytest.mark.parametrize("window", [12, 40])
+def test_flash_prefill_window(window):
+    """Sliding-window prefill (ADVICE r3 high): kernel masking AND its
+    below-window chunk skip (start_pos deep enough that c0 > 0) match the
+    blockwise oracle's HF semantics (position p attends [p-window+1, p])."""
+    rng = np.random.default_rng(7)
+    q, k, v, bt = make_prefill_case(rng, P=2, Lpad=32)
+    start_pos = jnp.asarray([16, 96], jnp.int32)
+    true_len = jnp.asarray([32, 23], jnp.int32)
+    scale = 0.125
+    ref = jax.vmap(
+        lambda qi, ti, sp, tl: prefill_attention_blockwise(
+            qi, k, v, ti, sp, tl, scale, window=window
+        )
+    )(q, bt, start_pos, true_len)
+    out = flash_prefill_kernel(
+        q, k, v, bt, start_pos, true_len, scale, interpret=True, tile_q=16,
+        window=window,
+    )
+    for p, tl in enumerate([32, 23]):
+        np.testing.assert_allclose(
+            np.asarray(out)[p, :tl], np.asarray(ref)[p, :tl],
+            atol=3e-5, rtol=3e-5,
+        )
+
+
+def test_flash_prefill_window_dispatcher():
+    """prefill_attention(window>0) down the forced-kernel branch agrees
+    with the blockwise path (this dispatch used to raise TypeError)."""
+    from xllm_service_tpu.ops.attention import prefill_attention
+
+    rng = np.random.default_rng(8)
+    q, k, v, bt = make_prefill_case(rng, P=2, Lpad=32, Hq=8, Hkv=4)
+    start_pos = jnp.asarray([0, 48], jnp.int32)
+    true_len = jnp.asarray([32, 20], jnp.int32)
+    scale = 0.125
+    ref = prefill_attention(
+        q, k, v, bt, start_pos, true_len, scale, use_kernel=False, window=24
+    )
+    out = prefill_attention(
+        q, k, v, bt, start_pos, true_len, scale, use_kernel=True,
+        interpret=True, window=24,
+    )
+    for p, tl in enumerate([32, 20]):
+        np.testing.assert_allclose(
+            np.asarray(out)[p, :tl], np.asarray(ref)[p, :tl],
+            atol=3e-5, rtol=3e-5,
+        )
+
+
 def test_flash_prefill_int8():
     """int8 cache: the kernel's VMEM grouped dequant matches the
     dequantizing oracle within quantization tolerance. Tolerance budget:
@@ -189,7 +239,8 @@ def test_flash_prefill_int8():
     from xllm_service_tpu.ops import kv_cache as kvc
 
     rng = np.random.default_rng(2)
-    q, k, v, bt = make_prefill_case(rng, P=2, Lpad=32)
+    # BS=128: the int8 [G, BS] scale tile carries BS on lanes (chip rule).
+    q, k, v, bt = make_prefill_case(rng, P=2, Lpad=32, BS=128, MB=2, num_blocks=16)
     kq = kvc.quantize_pool(k)
     vq = kvc.quantize_pool(v)
     start_pos = jnp.asarray([0, 16], jnp.int32)
@@ -254,7 +305,7 @@ from xllm_service_tpu.ops.pallas.mla_prefill import mla_flash_prefill_kernel
 
 
 def make_mla_prefill_case(
-    rng, P=2, Lpad=32, Hq=8, C=56, BS=16, MB=8, num_blocks=64
+    rng, P=2, Lpad=32, Hq=8, C=128, BS=16, MB=8, num_blocks=64
 ):
     q = jnp.asarray(rng.standard_normal((P, Lpad, Hq, C)), jnp.float32)
     cache = jnp.asarray(
@@ -284,7 +335,7 @@ def test_mla_flash_prefill_matches_blockwise(tile_q):
     caller)."""
     rng = np.random.default_rng(0)
     kvr = 40  # latent rank; C = kvr + rope(16)
-    q, cache, bt = make_mla_prefill_case(rng, C=56)
+    q, cache, bt = make_mla_prefill_case(rng, C=128)
     start_pos = jnp.asarray([0, 24], jnp.int32)
     true_len = jnp.asarray([32, 17], jnp.int32)
     scale = 0.125
@@ -305,7 +356,7 @@ def test_mla_prefill_dispatcher_kernel_branch():
 
     rng = np.random.default_rng(1)
     kvr = 40
-    q, cache, bt = make_mla_prefill_case(rng, C=56)
+    q, cache, bt = make_mla_prefill_case(rng, C=128)
     start_pos = jnp.asarray([0, 8], jnp.int32)
     true_len = jnp.asarray([20, 32], jnp.int32)
     ref = mla_prefill_attention(
@@ -375,7 +426,7 @@ def test_mq_decode_kernel_inactive_and_edge():
     rng = np.random.default_rng(3)
     S = 4
     _, k, v, bt, _ = make_case(rng, R=4, MB=4, BS=16)
-    q = jnp.asarray(rng.standard_normal((4, S, 8, 64)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((4, S, 8, 128)), jnp.float32)
     # 14 + 4 > 16 straddles the first block boundary
     seq_lens = jnp.asarray([0, 1, 14, 60], jnp.int32)
     out = multiquery_paged_attention_kernel(
@@ -506,7 +557,7 @@ def test_mq_decode_kernel_table_edge_clamp():
     rng = np.random.default_rng(11)
     S = 4
     _, k, v, bt, _ = make_case(rng, R=2, MB=4, BS=16)
-    q = jnp.asarray(rng.standard_normal((2, S, 8, 64)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((2, S, 8, 128)), jnp.float32)
     # seq 0 sits at the last table row: context for row 0 is the full
     # table; rows 1..3 would walk past it without the clamp.
     seq_lens = jnp.asarray([4 * 16, 30], jnp.int32)
@@ -540,7 +591,7 @@ def test_mla_mq_kernel_matches_blockwise(S):
 
     rng = np.random.default_rng(0)
     kvr = 40
-    q4, cache, bt = make_mla_prefill_case(rng, P=3, Lpad=S, C=56, MB=8)
+    q4, cache, bt = make_mla_prefill_case(rng, P=3, Lpad=S, C=128, MB=8)
     R, MB = bt.shape
     BS = cache.shape[2]
     seq_lens = jnp.asarray([1, 60, MB * BS - S], jnp.int32)
@@ -561,7 +612,7 @@ def test_mla_mq_kernel_inactive_and_clamp():
 
     rng = np.random.default_rng(2)
     S, kvr = 4, 40
-    q4, cache, bt = make_mla_prefill_case(rng, P=3, Lpad=S, C=56, MB=4)
+    q4, cache, bt = make_mla_prefill_case(rng, P=3, Lpad=S, C=128, MB=4)
     BS = cache.shape[2]
     # slot 0 inactive; slot 2 at the very end of its table (clamp path)
     seq_lens = jnp.asarray([0, 17, 4 * BS], jnp.int32)
@@ -627,8 +678,8 @@ def test_mla_kernel_int8_matches_gather():
     )
 
     rng = np.random.default_rng(9)
-    kvr, dr = 40, 16  # C = 56, gcd 8 -> 7 scale groups
-    q, cache, bt = make_mla_prefill_case(rng, P=3, Lpad=1, C=56, MB=8)
+    kvr, dr = 40, 16  # C = 128 lane-padded, 16 scale groups
+    q, cache, bt = make_mla_prefill_case(rng, P=3, Lpad=1, C=128, BS=128, MB=2, num_blocks=16)
     q = q[:, 0]  # [R, Hq, C]
     qc = _quantize_mla_cache(cache, kvr, dr)
     seq_lens = jnp.asarray([1, 60, 128], jnp.int32)
@@ -648,10 +699,10 @@ def test_mla_mq_kernel_int8_matches_blockwise():
 
     rng = np.random.default_rng(10)
     S, kvr, dr = 3, 40, 16
-    q4, cache, bt = make_mla_prefill_case(rng, P=3, Lpad=S, C=56, MB=8)
+    q4, cache, bt = make_mla_prefill_case(rng, P=3, Lpad=S, C=128, BS=128, MB=2, num_blocks=16)
     qc = _quantize_mla_cache(cache, kvr, dr)
     BS = cache.shape[2]
-    seq_lens = jnp.asarray([1, 60, 8 * BS - S], jnp.int32)
+    seq_lens = jnp.asarray([1, 60, 2 * BS - S], jnp.int32)  # MB=2 table
     ref = _mla_mq_oracle(q4, qc, bt, seq_lens, S, 0.125, kvr)
     out = mla_multiquery_attention_kernel(
         q4, qc, bt, seq_lens, 0.125, kvr, interpret=True
@@ -669,7 +720,7 @@ def test_mla_dispatcher_int8_kernel_branch(monkeypatch):
 
     rng = np.random.default_rng(11)
     kvr, dr = 40, 16
-    q, cache, bt = make_mla_prefill_case(rng, P=2, Lpad=1, C=56, MB=4)
+    q, cache, bt = make_mla_prefill_case(rng, P=2, Lpad=1, C=128, BS=128, MB=2, num_blocks=16)
     q = q[:, 0]
     qc = _quantize_mla_cache(cache, kvr, dr)
     seq_lens = jnp.asarray([20, 50], jnp.int32)
@@ -704,7 +755,9 @@ def test_mla_flash_prefill_int8_matches_blockwise():
 
     rng = np.random.default_rng(13)
     kvr, dr = 40, 16
-    q, cache, bt = make_mla_prefill_case(rng, P=2, Lpad=32, C=56, MB=8)
+    q, cache, bt = make_mla_prefill_case(
+        rng, P=2, Lpad=32, C=128, BS=128, MB=2, num_blocks=16
+    )
     qc = _quantize_mla_cache(cache, kvr, dr)
     start_pos = jnp.asarray([0, 8], jnp.int32)
     true_len = jnp.asarray([32, 17], jnp.int32)
@@ -720,3 +773,53 @@ def test_mla_flash_prefill_int8_matches_blockwise():
             np.asarray(out)[p, :tl], np.asarray(ref)[p, :tl],
             atol=2e-2, rtol=2e-2,
         )
+
+
+# ------------------------------------------------ Mosaic layout rules
+
+
+def test_mosaic_rules_reject_known_bad_layouts():
+    """The trace-time layout validator (ops/pallas/mosaic_rules) rejects
+    every layout class that passed interpret mode and failed on silicon
+    (round 2/3 chip findings); kernels route all DMAs through it, so the
+    interpret suites above double as layout-legality checks."""
+    import pytest as _pytest
+
+    from xllm_service_tpu.ops.pallas import mosaic_rules as mosaic
+
+    # Round-2 flat scale plane: [1, BS*G] slice = 1 sublane row.
+    with _pytest.raises(mosaic.MosaicLayoutError, match="sublane"):
+        mosaic.check_copy_shape((1, 16 * 8), jnp.float32, "flat scale row")
+    # Round-2 alternative [.., BS, G]: G=8 lanes.
+    with _pytest.raises(mosaic.MosaicLayoutError, match="lane"):
+        mosaic.check_copy_shape((128, 8), jnp.float32, "scale tile")
+    # Round-3 unpadded MLA latent row: 576 lanes.
+    with _pytest.raises(mosaic.MosaicLayoutError, match="lane"):
+        mosaic.check_copy_shape((1, 1, 128, 576), jnp.bfloat16, "latent")
+    # Current layouts pass: packed GQA row, grouped scale tile, padded
+    # MLA latent.
+    mosaic.check_copy_shape((128, 128), jnp.bfloat16)
+    mosaic.check_copy_shape((8, 128), jnp.float32)
+    mosaic.check_copy_shape((1, 128, 640), jnp.bfloat16)
+
+
+def test_mosaic_rules_dynamic_offset_placement():
+    """Rule 2: dynamic offsets only on untiled leading dims."""
+    import pytest as _pytest
+
+    from jax.experimental import pallas as _pl
+    from xllm_service_tpu.ops.pallas import mosaic_rules as mosaic
+
+    class FakeTracer:  # anything that isn't a python int is dynamic
+        pass
+
+    blk = FakeTracer()
+    # [N, H, BS, D] cache: block id + head on leading dims — legal.
+    mosaic.check_slice_indices(4, (blk, 1))
+    # Static pl.ds on a tiled dim — legal.
+    mosaic.check_slice_indices(3, (blk, _pl.ds(0, 128)))
+    # Dynamic offset on the sublane dim — the round-2 failure mode.
+    with _pytest.raises(mosaic.MosaicLayoutError, match="dynamic"):
+        mosaic.check_slice_indices(2, (blk,))
+    with _pytest.raises(mosaic.MosaicLayoutError, match="dynamic"):
+        mosaic.check_slice_indices(4, (0, 1, blk))

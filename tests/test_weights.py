@@ -257,3 +257,32 @@ def test_executor_serves_hetero_checkpoint(tmp_path):
     t_ref, _ = ref.prefill(prompt, 0, table)
     t_exe, _ = exe.prefill(prompt, 0, table)
     assert t_ref == t_exe
+
+
+def test_hf_sliding_window_gates():
+    """HF SWA gates (ADVICE r4 review): Qwen2-style use_sliding_window=
+    false and partial max_window_layers must NOT enable the window;
+    Mistral-style bare sliding_window must."""
+    from xllm_service_tpu.runtime.weights import _hf_sliding_window
+
+    assert _hf_sliding_window({"sliding_window": 4096}) == 4096
+    assert _hf_sliding_window({"sliding_window": None}) == 0
+    assert _hf_sliding_window(
+        {"sliding_window": 32768, "use_sliding_window": False}
+    ) == 0
+    # HF Qwen2 semantics: layer i slides iff i >= max_window_layers.
+    # mwl=28/64 -> mixed stack (unrepresentable): full attention.
+    assert _hf_sliding_window(
+        {"sliding_window": 32768, "use_sliding_window": True,
+         "max_window_layers": 28, "num_hidden_layers": 64}
+    ) == 0
+    # mwl=64/64 -> ZERO sliding layers: full attention.
+    assert _hf_sliding_window(
+        {"sliding_window": 32768, "use_sliding_window": True,
+         "max_window_layers": 64, "num_hidden_layers": 64}
+    ) == 0
+    # mwl=0 -> every layer slides: the uniform window applies.
+    assert _hf_sliding_window(
+        {"sliding_window": 32768, "use_sliding_window": True,
+         "max_window_layers": 0, "num_hidden_layers": 64}
+    ) == 32768

@@ -31,6 +31,11 @@ class ModelConfig:
     moe_intermediate_size: int = 0
     # Sliding-window attention (0 = full).
     sliding_window: int = 0
+    # Disable head_dim<128 packed cache rows (kv_cache.kv_pack_factor).
+    # Set by the executor (sharding.resolve_kv_packing) when tp doesn't
+    # divide the packed head count — the unpacked layout keeps every
+    # tp that divides num_kv_heads functional via the gather path.
+    kv_pack_disable: bool = False
     # QKV projection bias (Qwen2-style).
     attn_bias: bool = False
     # Per-head RMSNorm on q and k before RoPE (Qwen3-style QK-norm).

@@ -54,7 +54,10 @@ def cache_row_dims(cfg: ModelConfig) -> Tuple[int, int]:
     """(heads, row_dim) of one paged-cache row. head_dim < 128 models
     pack P = 128/head_dim consecutive KV heads per row so the Pallas
     kernels' 128-lane DMA tiling holds (kv_cache.kv_pack_factor)."""
-    P = kv_cache_ops.kv_pack_factor(cfg.num_kv_heads, cfg.head_dim)
+    P = (
+        1 if cfg.kv_pack_disable
+        else kv_cache_ops.kv_pack_factor(cfg.num_kv_heads, cfg.head_dim)
+    )
     return cfg.num_kv_heads // P, cfg.head_dim * P
 
 
@@ -270,7 +273,8 @@ def decode_step(
         q, k, v = _qkv(lp, cfg, h, positions, lora_idx)
         k_l, v_l = _scatter_kv(k_l, v_l, blk, offset, k, v)
         attn = paged_attention(
-            q, k_l, v_l, block_tables, seq_lens, scale, use_kernel=use_kernel
+            q, k_l, v_l, block_tables, seq_lens, scale,
+            use_kernel=use_kernel, window=cfg.sliding_window,
         )
         attn_flat = attn.reshape(attn.shape[0], -1)
         o = jnp.einsum("rh,he->re", attn_flat,
@@ -355,7 +359,8 @@ def prefill_batch_step(
             v.reshape(P * Lpad, *v.shape[2:]),
         )
         attn = prefill_attention(
-            q, k_l, v_l, block_tables, start_pos, true_len, scale
+            q, k_l, v_l, block_tables, start_pos, true_len, scale,
+            window=cfg.sliding_window,
         )  # [P, Lpad, Hq, D] — flash kernel on TPU, blockwise elsewhere
         attn_flat = attn.reshape(P, Lpad, -1)
         o = jnp.einsum("plh,he->ple", attn_flat,
@@ -479,6 +484,11 @@ def hidden_dense(
     x = params["embed"][token_ids].astype(wdtype(params["layers"]["wq"]))
     positions = jnp.arange(L, dtype=jnp.int32)
     causal = jnp.tril(jnp.ones((L, L), dtype=bool))
+    if cfg.sliding_window:
+        # HF SWA semantics: position p attends [p-window+1, p].
+        causal &= (
+            positions[None, :] > positions[:, None] - cfg.sliding_window
+        )
 
     def layer_fn(x, lp):
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)

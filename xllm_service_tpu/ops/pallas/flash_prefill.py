@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from xllm_service_tpu.ops.pallas import mosaic_rules as mosaic
 from xllm_service_tpu.ops.pallas.paged_attention import dequant_tile
 
 NEG_INF = -1e30
@@ -64,6 +65,7 @@ def _prefill_kernel(
     scale: float,
     quantized: bool,
     scale_groups: int = 8,
+    window: int = 0,
 ):
     if quantized:
         ks_hbm, vs_hbm, o_ref, k_buf, v_buf, sems, ks_buf, vs_buf, ssems = rest
@@ -81,34 +83,42 @@ def _prefill_kernel(
     tile_lo = t * tile_q  # first chunk-relative position of the tile
     ctx = start + jnp.minimum(tile_lo + tile_q, n_valid)
     nc = jnp.where(tile_lo < n_valid, pl.cdiv(ctx, span), 0)
+    # Sliding window: the chunk walk starts at the first chunk holding any
+    # in-window column (earliest window start across the tile's rows is
+    # start + tile_lo - window + 1); earlier blocks never stream, so SWA
+    # prefill bandwidth is O(L * window), not O(L * context).
+    c0 = (
+        jnp.maximum(start + tile_lo - window + 1, 0) // span
+        if window > 0 else 0
+    )
 
     def dmas(slot, c_idx, blk):
         off = c_idx * block_size
         out = [
-            pltpu.make_async_copy(
-                k_hbm.at[blk, h],
-                k_buf.at[slot, pl.ds(off, block_size)],
+            mosaic.async_copy(
+                mosaic.checked_at(k_hbm, blk, h),
+                mosaic.checked_at(k_buf, slot, pl.ds(off, block_size)),
                 sems.at[slot, 0, c_idx],
             ),
-            pltpu.make_async_copy(
-                v_hbm.at[blk, h],
-                v_buf.at[slot, pl.ds(off, block_size)],
+            mosaic.async_copy(
+                mosaic.checked_at(v_hbm, blk, h),
+                mosaic.checked_at(v_buf, slot, pl.ds(off, block_size)),
                 sems.at[slot, 1, c_idx],
             ),
         ]
         if quantized:
             # Head h's [G, BS] scale tile (blk, h on untiled dims).
             out.append(
-                pltpu.make_async_copy(
-                    ks_hbm.at[blk, h],
-                    ks_buf.at[slot, c_idx],
+                mosaic.async_copy(
+                    mosaic.checked_at(ks_hbm, blk, h),
+                    mosaic.checked_at(ks_buf, slot, c_idx),
                     ssems.at[slot, 0, c_idx],
                 )
             )
             out.append(
-                pltpu.make_async_copy(
-                    vs_hbm.at[blk, h],
-                    vs_buf.at[slot, c_idx],
+                mosaic.async_copy(
+                    mosaic.checked_at(vs_hbm, blk, h),
+                    mosaic.checked_at(vs_buf, slot, c_idx),
                     ssems.at[slot, 1, c_idx],
                 )
             )
@@ -128,7 +138,7 @@ def _prefill_kernel(
 
     @pl.when(nc > 0)
     def _first():
-        start_chunk(0, 0)
+        start_chunk(jax.lax.rem(c0, 2) if window > 0 else 0, c0)
 
     q = q_ref[0, 0, 0]  # [Rp, D]
     Rp, D = q.shape
@@ -166,6 +176,9 @@ def _prefill_kernel(
             jnp.int32, scores.shape, 1
         )
         keep = (col_pos <= row_pos) & row_valid
+        if window > 0:
+            # HF SWA semantics: position p attends [p-window+1, p].
+            keep &= col_pos > row_pos - window
         scores = jnp.where(keep, scores, NEG_INF)
 
         m_cur = jnp.max(scores, axis=-1, keepdims=True)
@@ -196,7 +209,7 @@ def _prefill_kernel(
     m0 = jnp.full((Rp, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((Rp, 1), jnp.float32)
     a0 = jnp.zeros((Rp, D), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nc, body, (m0, l0, a0))
+    m, l, acc = jax.lax.fori_loop(c0, nc, body, (m0, l0, a0))
     o_ref[0, 0, 0] = jnp.where(
         l > 0, acc / jnp.maximum(l, 1e-30), 0.0
     ).astype(o_ref.dtype)
@@ -207,7 +220,8 @@ def _round_up(x: int, m: int) -> int:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("scale", "interpret", "chunk", "tile_q")
+    jax.jit,
+    static_argnames=("scale", "interpret", "chunk", "tile_q", "window"),
 )
 def flash_prefill_kernel(
     q: jnp.ndarray,            # [P, Lpad, Hq, D]
@@ -220,6 +234,7 @@ def flash_prefill_kernel(
     interpret: bool = False,
     chunk: int = 4,
     tile_q: int = 128,
+    window: int = 0,
 ) -> jnp.ndarray:
     from xllm_service_tpu.ops import kv_cache as kvc
 
@@ -300,7 +315,7 @@ def flash_prefill_kernel(
     kernel = functools.partial(
         _prefill_kernel, block_size=BS, chunk=C, tile_q=TQ, groups=G,
         scale=scale, quantized=quantized,
-        scale_groups=SG,
+        scale_groups=SG, window=window,
     )
     out = pl.pallas_call(
         kernel,

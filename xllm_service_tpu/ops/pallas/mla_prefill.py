@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from xllm_service_tpu.ops.pallas import mosaic_rules as mosaic
+
 NEG_INF = -1e30
 
 
@@ -71,19 +73,19 @@ def _mla_prefill_kernel(
 
     def dmas(slot, c_idx, blk):
         out = [
-            pltpu.make_async_copy(
-                c_hbm.at[blk, 0],
-                c_buf.at[slot, pl.ds(c_idx * block_size, block_size)],
-                sems.at[slot, c_idx],
-            )
+            mosaic.async_copy(
+                    mosaic.checked_at(c_hbm, blk, 0),
+                    mosaic.checked_at(c_buf, slot, pl.ds(c_idx * block_size, block_size)),
+                    sems.at[slot, c_idx],
+                )
         ]
         if quantized:
             # Full-extent [G, BS] scale tile (blk on the untiled dim);
             # see mla_attention._mla_common for why.
             out.append(
-                pltpu.make_async_copy(
-                    cs_hbm.at[blk, 0],
-                    s_buf.at[slot, c_idx],
+                mosaic.async_copy(
+                    mosaic.checked_at(cs_hbm, blk, 0),
+                    mosaic.checked_at(s_buf, slot, c_idx),
                     ssems.at[slot, c_idx],
                 )
             )

@@ -39,6 +39,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from xllm_service_tpu.ops.pallas import mosaic_rules as mosaic
+
 NEG_INF = -1e30
 
 
@@ -131,30 +133,30 @@ def _decode_kernel(
     def dmas(slot, c_idx, blk):
         off = c_idx * block_size
         out = [
-            pltpu.make_async_copy(
-                k_hbm.at[blk, h],
-                k_buf.at[slot, pl.ds(off, block_size)],
-                sems.at[slot, 0, c_idx],
-            ),
-            pltpu.make_async_copy(
-                v_hbm.at[blk, h],
-                v_buf.at[slot, pl.ds(off, block_size)],
-                sems.at[slot, 1, c_idx],
-            ),
+            mosaic.async_copy(
+                    mosaic.checked_at(k_hbm, blk, h),
+                    mosaic.checked_at(k_buf, slot, pl.ds(off, block_size)),
+                    sems.at[slot, 0, c_idx],
+                ),
+            mosaic.async_copy(
+                    mosaic.checked_at(v_hbm, blk, h),
+                    mosaic.checked_at(v_buf, slot, pl.ds(off, block_size)),
+                    sems.at[slot, 1, c_idx],
+                ),
         ]
         if quantized:
             # Head h's [G, BS] scale tile (blk, h on untiled dims).
             out.append(
-                pltpu.make_async_copy(
-                    ks_hbm.at[blk, h],
-                    ks_buf.at[slot, c_idx],
+                mosaic.async_copy(
+                    mosaic.checked_at(ks_hbm, blk, h),
+                    mosaic.checked_at(ks_buf, slot, c_idx),
                     ssems.at[slot, 0, c_idx],
                 )
             )
             out.append(
-                pltpu.make_async_copy(
-                    vs_hbm.at[blk, h],
-                    vs_buf.at[slot, c_idx],
+                mosaic.async_copy(
+                    mosaic.checked_at(vs_hbm, blk, h),
+                    mosaic.checked_at(vs_buf, slot, c_idx),
                     ssems.at[slot, 1, c_idx],
                 )
             )

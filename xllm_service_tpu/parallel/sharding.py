@@ -19,6 +19,7 @@ from these annotations under jit — no hand-written comms.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Dict
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -175,42 +176,54 @@ def check_tp_divisibility(cfg: ModelConfig, tp: int, ep: int = 1) -> None:
             f"tp={tp} must divide num_heads={cfg.num_heads} and "
             f"num_kv_heads={cfg.num_kv_heads}"
         )
-    else:
-        from xllm_service_tpu.ops.kv_cache import kv_pack_factor
-
-        packed = cfg.num_kv_heads // kv_pack_factor(
-            cfg.num_kv_heads, cfg.head_dim
-        )
-        if packed % tp:
-            raise ValueError(
-                f"tp={tp} must divide the PACKED KV-head count {packed}: "
-                f"head_dim={cfg.head_dim} < 128 models pack "
-                f"{cfg.num_kv_heads // packed} heads per 128-lane cache "
-                f"row for Mosaic kernel tiling (kv_cache.kv_pack_factor), "
-                f"and the packed rows are the shardable axis"
-            )
+    # head_dim<128 packed rows cap the shardable cache-head axis at the
+    # packed count; when tp doesn't divide it the executor falls back to
+    # the unpacked layout via resolve_kv_packing (ADVICE r3) instead of
+    # rejecting the config here.
     if cfg.is_moe:
-        # EP×TP: experts over ep, per-expert hidden over tp; pure-TP MoE
-        # (ep=1) shards the expert axis over tp instead.
-        if ep > 1:
-            if cfg.num_experts % ep:
-                raise ValueError(
-                    f"ep={ep} must divide num_experts={cfg.num_experts}"
-                )
-            if cfg.moe_intermediate_size % tp:
-                raise ValueError(
-                    f"tp={tp} must divide "
-                    f"moe_intermediate={cfg.moe_intermediate_size}"
-                )
-        elif cfg.num_experts % tp:
-            raise ValueError(
-                f"tp={tp} must divide num_experts={cfg.num_experts}"
-            )
-        # Heterogeneous stack: the dense prefix shards intermediate_size.
-        if cfg.first_k_dense_replace > 0 and cfg.intermediate_size % tp:
-            raise ValueError(
-                f"tp={tp} must divide dense-prefix intermediate="
-                f"{cfg.intermediate_size}"
-            )
+        _check_moe_divisibility(cfg, tp, ep)
     elif cfg.intermediate_size % tp:
-        raise ValueError(f"tp={tp} must divide intermediate={cfg.intermediate_size}")
+        raise ValueError(
+            f"tp={tp} must divide intermediate={cfg.intermediate_size}"
+        )
+
+
+def resolve_kv_packing(cfg: ModelConfig, tp: int) -> ModelConfig:
+    """Disable head_dim<128 row packing when tp doesn't divide the packed
+    head count (e.g. llama-1B-class: Hkv=8, D=64 packs to 4 rows, so tp=8
+    only works unpacked). The unpacked cache keeps the gather attention
+    path functional; packing (and kernel eligibility) is purely a layout
+    optimization, never a correctness requirement."""
+    from xllm_service_tpu.ops.kv_cache import kv_pack_factor
+
+    if cfg.is_mla or cfg.kv_pack_disable:
+        return cfg
+    pf = kv_pack_factor(cfg.num_kv_heads, cfg.head_dim)
+    if pf > 1 and (cfg.num_kv_heads // pf) % tp:
+        return dataclasses.replace(cfg, kv_pack_disable=True)
+    return cfg
+
+
+def _check_moe_divisibility(cfg: ModelConfig, tp: int, ep: int) -> None:
+    # EP×TP: experts over ep, per-expert hidden over tp; pure-TP MoE
+    # (ep=1) shards the expert axis over tp instead.
+    if ep > 1:
+        if cfg.num_experts % ep:
+            raise ValueError(
+                f"ep={ep} must divide num_experts={cfg.num_experts}"
+            )
+        if cfg.moe_intermediate_size % tp:
+            raise ValueError(
+                f"tp={tp} must divide "
+                f"moe_intermediate={cfg.moe_intermediate_size}"
+            )
+    elif cfg.num_experts % tp:
+        raise ValueError(
+            f"tp={tp} must divide num_experts={cfg.num_experts}"
+        )
+    # Heterogeneous stack: the dense prefix shards intermediate_size.
+    if cfg.first_k_dense_replace > 0 and cfg.intermediate_size % tp:
+        raise ValueError(
+            f"tp={tp} must divide dense-prefix intermediate="
+            f"{cfg.intermediate_size}"
+        )

@@ -19,6 +19,7 @@ TPU design points:
 
 from __future__ import annotations
 
+import logging
 import math
 import os
 from dataclasses import dataclass
@@ -44,6 +45,7 @@ from xllm_service_tpu.parallel.sharding import (
     kv_cache_sharding,
     kv_scale_sharding,
     param_shardings,
+    resolve_kv_packing,
 )
 
 
@@ -187,6 +189,23 @@ class ModelExecutor:
         ep = self.mesh.shape.get("ep", 1)
         if tp > 1 or ep > 1:
             check_tp_divisibility(self.cfg, tp, ep)
+            # Packed head_dim<128 rows shard only when tp divides the
+            # packed count; otherwise serve unpacked via the gather path.
+            resolved = resolve_kv_packing(self.cfg, tp)
+            if resolved is not self.cfg:
+                logging.getLogger(__name__).warning(
+                    "tp=%d doesn't divide the packed KV-head count of %s "
+                    "(Hkv=%d, D=%d): serving the UNPACKED cache layout — "
+                    "decode uses the gather path, not the Pallas kernel; "
+                    "tp=%d would restore packing",
+                    tp, self.cfg.name, self.cfg.num_kv_heads,
+                    self.cfg.head_dim,
+                    self.cfg.num_kv_heads
+                    // kvc.kv_pack_factor(
+                        self.cfg.num_kv_heads, self.cfg.head_dim
+                    ),
+                )
+            self.cfg = resolved
 
         if engine_cfg.compilation_cache_dir:
             _setup_compilation_cache(engine_cfg.compilation_cache_dir)
@@ -515,8 +534,10 @@ class ModelExecutor:
             else dtype_bytes
         )
         # MLA's latent cache is replicated (no KV-head axis to shard);
-        # for GQA, check_tp_divisibility guarantees tp divides the packed
-        # cache-head count.
+        # for GQA, check_tp_divisibility guarantees tp divides
+        # num_kv_heads and resolve_kv_packing has already unpacked the
+        # layout if tp didn't divide the packed count — so cache_heads
+        # (post-resolve cache_row_dims) is always tp-divisible here.
         heads_per_dev = (
             cache_heads if self.cfg.is_mla else cache_heads // tp
         )
@@ -1071,8 +1092,13 @@ class ModelExecutor:
 
     @property
     def supports_sp(self) -> bool:
-        return self.mesh.shape.get("sp", 1) > 1 and hasattr(
-            self.model_mod, "prefill_sp_step"
+        # Ring attention is exact FULL attention; a sliding-window model
+        # must stay on the chunked path (whose kernels mask + skip blocks
+        # below the window) or SP-prefilled logits would diverge.
+        return (
+            self.mesh.shape.get("sp", 1) > 1
+            and hasattr(self.model_mod, "prefill_sp_step")
+            and not getattr(self.cfg, "sliding_window", 0)
         )
 
     def _sp_impl(self, k_cache, v_cache, params, token_ids, true_len,

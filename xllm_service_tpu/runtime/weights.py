@@ -128,6 +128,27 @@ def _shard_files(path: str) -> list:
 # ----------------------------------------------------------------- HF config
 
 
+def _hf_sliding_window(hf: dict) -> int:
+    """SWA window from an HF config, honoring the gates HF applies.
+
+    Qwen2/Qwen3 configs carry a sliding_window VALUE but disable it via
+    use_sliding_window=false. HF's max_window_layers semantics (Qwen2
+    modeling: layer i slides iff i >= max_window_layers, i.e. the FIRST
+    mwl layers use full attention): mwl == 0 means every layer slides —
+    exactly our uniform-window stack; any other value means zero SWA
+    layers (mwl >= num_layers) or a mixed stack our scanned layers can't
+    represent, and both serve correctly/safest as full attention."""
+    window = int(hf.get("sliding_window") or 0)
+    if not window:
+        return 0
+    if not hf.get("use_sliding_window", True):
+        return 0
+    mwl = hf.get("max_window_layers")
+    if mwl is not None and int(mwl) != 0:
+        return 0
+    return window
+
+
 def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
     """Build a ModelConfig from an HF checkpoint dir's config.json.
 
@@ -153,7 +174,7 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
         rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
         max_position_embeddings=hf.get("max_position_embeddings", 8192),
         tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
-        sliding_window=int(hf.get("sliding_window") or 0),
+        sliding_window=_hf_sliding_window(hf),
     )
     if arch == "Qwen2ForCausalLM":
         common["attn_bias"] = True
