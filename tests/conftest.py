@@ -13,6 +13,23 @@ from __graft_entry__ import _force_cpu_platform  # noqa: E402
 
 _force_cpu_platform(8)
 
+# Persistent XLA compilation cache: CPU test compiles dominate suite wall
+# time (VERDICT r3 weak #3); warm runs skip them entirely. The cache key
+# includes backend/flags, so the virtual-8-device CPU entries never leak
+# into TPU runs.
+import jax  # noqa: E402
+
+_CACHE_DIR = os.environ.get(
+    "XLLM_TEST_JIT_CACHE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                 ".test-jit-cache"),
+)
+if _CACHE_DIR != "0":
+    jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+
 import pytest  # noqa: E402
 
 
