@@ -342,6 +342,14 @@ def test_service_response_format_e2e():
             timeout=60.0,
         )
         assert code == 400, (code, body)
+        assert "json_schema.schema" in body["error"]["message"]
+        code, body = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "llama3-tiny", "prompt": "x", "max_tokens": 2,
+             "response_format": {"type": "grammar"}},
+            timeout=60.0,
+        )
+        assert code == 400, (code, body)
         assert "not supported" in body["error"]["message"]
     finally:
         inst.stop()

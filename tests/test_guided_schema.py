@@ -1,0 +1,293 @@
+"""json_schema structured outputs end to end: engine-level conformance
+under the dynamic-row masks, speculative parity, and the HTTP surface
+(response_format json_schema), including the PD handoff relay."""
+
+import json
+
+import jax
+import pytest
+
+from xllm_service_tpu.guided import schema_fsm as sf
+
+SCHEMA = {
+    "type": "object",
+    "additionalProperties": False,
+    "properties": {
+        "name": {"type": "string"},
+        "kind": {"enum": ["cat", "dog"]},
+        "count": {"type": "integer"},
+    },
+    "required": ["name", "kind", "count"],
+}
+
+
+def _engine(spec=0):
+    from xllm_service_tpu.common.config import EngineConfig
+    from xllm_service_tpu.guided import json_fsm as J
+    from xllm_service_tpu.runtime.engine import InferenceEngine
+    from xllm_service_tpu.runtime.executor import ModelExecutor
+    from xllm_service_tpu.tokenizer import ByteTokenizer
+
+    cfg = EngineConfig(
+        model="llama3-tiny", dtype="float32", block_size=16, num_blocks=64,
+        max_running_requests=4, max_seq_len=256,
+        prefill_buckets=[32, 64, 128], speculative_tokens=spec,
+    )
+    eng = InferenceEngine(cfg, executor=ModelExecutor(cfg), eos_token_ids=(2,))
+    tok = ByteTokenizer()
+    tb = tok.token_bytes_table(eng.executor.cfg.vocab_size)
+    table = J.token_mask_table(tb, eos_ids=[2])
+    eng.set_guided_context(table, tb)
+    return eng, tb
+
+
+def _run(eng, sampling, schema=SCHEMA, max_steps=400):
+    from xllm_service_tpu.runtime.engine import EngineRequest
+
+    out = {"tokens": [], "finish": None}
+
+    def cb(o):
+        for s in o.outputs:
+            out["tokens"].extend(s.token_ids)
+            if o.finished:
+                out["finish"] = s.finish_reason
+        return True
+
+    eng.add_request(EngineRequest(
+        "s", [10, 20, 30], sampling, cb,
+        guided="json_schema", schema=schema,
+    ))
+    for _ in range(max_steps):
+        if not eng.has_work():
+            break
+        eng.step()
+    return out
+
+
+@pytest.mark.parametrize("temp", [0.0, 1.0], ids=["greedy", "sampled"])
+def test_engine_schema_output_conforms(temp):
+    """A random-weight model under the schema mask emits a stream the
+    schema automaton never rejects; on EOS the document parses AND has
+    exactly the required keys with the right types."""
+    from xllm_service_tpu.common.types import FinishReason
+    from xllm_service_tpu.ops.sampling import SamplingParams
+
+    eng, tb = _engine()
+    out = _run(
+        eng, SamplingParams(temperature=temp, seed=7, max_new_tokens=80)
+    )
+    assert out["tokens"], "nothing generated"
+    data = b"".join(tb[t] for t in out["tokens"] if t != 2)
+    spec = sf.compile_schema(SCHEMA)
+    st = sf.advance_bytes(spec, sf.initial_state(spec), data)
+    assert st is not None, data
+    if out["finish"] == FinishReason.STOP:
+        assert sf.is_complete(st), data
+        doc = json.loads(data.decode("utf-8", errors="replace"))
+        assert set(doc) == {"name", "kind", "count"}
+        assert isinstance(doc["name"], str)
+        assert doc["kind"] in ("cat", "dog")
+        assert isinstance(doc["count"], int)
+
+
+def test_engine_schema_spec_matches_plain():
+    """Schema-guided + speculative decoding == schema-guided plain
+    decoding, token for token."""
+    from xllm_service_tpu.ops.sampling import SamplingParams
+
+    sp = SamplingParams(temperature=0.8, seed=11, max_new_tokens=24)
+    a = _run(_engine(spec=0)[0], sp)
+    b = _run(_engine(spec=3)[0], sp)
+    assert a["tokens"] == b["tokens"]
+
+
+def test_engine_schema_row_memoization():
+    """Distinct visited states stay bounded (structural states repeat;
+    free-content states are constant): the dynamic-row region never
+    exhausts on this schema."""
+    from xllm_service_tpu.ops.sampling import SamplingParams
+
+    eng, _ = _engine()
+    _run(eng, SamplingParams(temperature=1.0, seed=3, max_new_tokens=60))
+    used = eng._schema_row_next
+    assert 0 < used <= eng.executor.num_dynamic_rows, used
+
+
+def test_service_json_schema_e2e():
+    """response_format json_schema through the real HTTP stack: the
+    completion conforms; an unsupported schema 400s."""
+    jax.config.update("jax_platforms", "cpu")
+    from xllm_service_tpu.api import Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+    from tests.test_api_e2e import http_post, wait_until
+
+    store = MemoryStore(clock=lambda: 0.0)
+    scfg = ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0, block_size=16,
+    )
+    master = Master(scfg, store=store)
+    master.start()
+    ecfg = EngineConfig(
+        model="llama3-tiny", dtype="float32", block_size=16, num_blocks=64,
+        max_running_requests=4, max_seq_len=256,
+        prefill_buckets=[32, 64, 128],
+        instance_name="s0", instance_type="MIX",
+    )
+    inst = InstanceServer(
+        ecfg, master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.2
+    )
+    inst.start()
+    try:
+        assert wait_until(
+            lambda: sum(master.scheduler.instance_mgr.counts()) == 1
+        )
+        rf = {"type": "json_schema",
+              "json_schema": {"name": "pet", "schema": SCHEMA}}
+        code, body = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "llama3-tiny", "prompt": "emit a pet",
+             "max_tokens": 60, "temperature": 0.0,
+             "response_format": rf},
+            timeout=300.0,
+        )
+        assert code == 200, body
+        text = body["choices"][0]["text"]
+        spec = sf.compile_schema(SCHEMA)
+        st = sf.advance_bytes(
+            spec, sf.initial_state(spec),
+            text.encode("utf-8", errors="replace"),
+        )
+        assert st is not None, text
+        if body["choices"][0]["finish_reason"] == "stop":
+            doc = json.loads(text)
+            assert set(doc) == {"name", "kind", "count"}
+
+        code, body = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "llama3-tiny", "prompt": "x", "max_tokens": 2,
+             "response_format": {
+                 "type": "json_schema",
+                 "json_schema": {"schema": {"anyOf": []}},
+             }},
+            timeout=60.0,
+        )
+        assert code == 400, (code, body)
+        assert "unsupported json_schema" in body["error"]["message"]
+    finally:
+        inst.stop()
+        master.stop()
+        store.close()
+
+
+def test_schema_survives_pd_handoff():
+    """json_schema through a PREFILL -> DECODE pair: the schema relays in
+    the handoff header and the decode peer keeps masking mid-document."""
+    jax.config.update("jax_platforms", "cpu")
+    from xllm_service_tpu.api import Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+    from tests.test_api_e2e import http_post, wait_until
+
+    store = MemoryStore(clock=lambda: 0.0)
+    scfg = ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0, block_size=16,
+    )
+    master = Master(scfg, store=store)
+    master.start()
+
+    def mk(name, itype):
+        ecfg = EngineConfig(
+            model="llama3-tiny", dtype="float32", block_size=16,
+            num_blocks=64, max_running_requests=4, max_seq_len=256,
+            prefill_buckets=[32, 64, 128],
+            instance_name=name, instance_type=itype,
+        )
+        srv = InstanceServer(
+            ecfg, master_rpc_addr=master.rpc_address,
+            heartbeat_interval_s=0.2,
+        )
+        srv.start()
+        return srv
+
+    p0, d0 = mk("sp0", "PREFILL"), mk("sd0", "DECODE")
+    try:
+        assert wait_until(
+            lambda: master.scheduler.instance_mgr.counts() == (1, 1, 0)
+        )
+        rf = {"type": "json_schema",
+              "json_schema": {"name": "pet", "schema": SCHEMA}}
+        code, body = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "llama3-tiny", "prompt": "pet json",
+             "max_tokens": 40, "temperature": 0.0,
+             "response_format": rf},
+            timeout=300.0,
+        )
+        assert code == 200, body
+        text = body["choices"][0]["text"]
+        spec = sf.compile_schema(SCHEMA)
+        st = sf.advance_bytes(
+            spec, sf.initial_state(spec),
+            text.encode("utf-8", errors="replace"),
+        )
+        assert st is not None, text
+        assert text.lstrip()[:1] == "{", text
+    finally:
+        p0.stop()
+        d0.stop()
+        master.stop()
+        store.close()
+
+def test_schema_eos_comes_from_guided_context():
+    """Service deployments construct the engine with an EMPTY engine-side
+    eos set; the schema bitmaps must use the eos the mask TABLE was
+    built with (set_guided_context eos_ids) or completed documents could
+    never emit EOS (review finding, round 4)."""
+    from xllm_service_tpu.common.config import EngineConfig
+    from xllm_service_tpu.guided import json_fsm as J
+    from xllm_service_tpu.runtime.engine import InferenceEngine
+    from xllm_service_tpu.runtime.executor import ModelExecutor
+    from xllm_service_tpu.tokenizer import ByteTokenizer
+
+    cfg = EngineConfig(
+        model="llama3-tiny", dtype="float32", block_size=16, num_blocks=64,
+        max_running_requests=4, max_seq_len=256,
+        prefill_buckets=[32, 64, 128],
+    )
+    eng = InferenceEngine(cfg, executor=ModelExecutor(cfg))  # no engine eos
+    tok = ByteTokenizer()
+    tb = tok.token_bytes_table(eng.executor.cfg.vocab_size)
+    eng.set_guided_context(J.token_mask_table(tb, eos_ids=[2]), tb,
+                           eos_ids=[2])
+    spec = sf.compile_schema({"const": "x"})
+    st = sf.advance_bytes(spec, sf.initial_state(spec), b'"x"')
+    assert sf.is_complete(st)
+    row = eng._schema_state_row(spec, st)
+    table = np.asarray(eng.executor.guided_table)
+    assert row != eng.executor.permissive_row
+    assert table[row, 2], "EOS must be allowed at document completion"
+
+
+def test_schema_row_flush_recycles_region():
+    """Exhausting the dynamic region degrades open for one step, then
+    the between-steps flush recycles it (review finding, round 4)."""
+    eng, _ = _engine()
+    ex = eng.executor
+    # burn the region
+    eng._schema_row_next = ex.num_dynamic_rows
+    spec = sf.compile_schema({"const": "y"})
+    st = sf.initial_state(spec)
+    assert eng._schema_state_row(spec, st) == ex.permissive_row
+    assert eng._schema_flush_pending
+    eng._maybe_flush_schema_rows()
+    assert eng._schema_row_next == 0
+    row = eng._schema_state_row(spec, st)
+    assert row == ex.dynamic_row_base
+
+
+import numpy as np  # noqa: E402  (used by the eos regression test)

@@ -1,0 +1,256 @@
+"""JSON-Schema byte automaton (guided/schema_fsm): acceptance/rejection
+of documents against the compiled schema, lazy number/enum termination,
+key ordering + optional skipping, and the token-bitmap layer."""
+
+import json
+
+import numpy as np
+import pytest
+
+from xllm_service_tpu.guided import schema_fsm as sf
+
+
+def accepts(schema, text: str) -> bool:
+    spec = sf.compile_schema(schema)
+    st = sf.advance_bytes(spec, sf.initial_state(spec), text.encode())
+    return sf.is_complete(st)
+
+
+def prefix_ok(schema, text: str) -> bool:
+    spec = sf.compile_schema(schema)
+    st = sf.advance_bytes(spec, sf.initial_state(spec), text.encode())
+    return st is not None
+
+
+PERSON = {
+    "type": "object",
+    "additionalProperties": False,
+    "properties": {
+        "name": {"type": "string"},
+        "age": {"type": "integer"},
+        "tags": {
+            "type": "array", "items": {"type": "string"}, "minItems": 1,
+        },
+    },
+    "required": ["name", "age", "tags"],
+}
+
+
+def test_object_accepts_exact_document():
+    doc = '{"name": "ada", "age": 36, "tags": ["x", "y"]}'
+    assert accepts(PERSON, doc)
+    assert json.loads(doc)  # sanity: the doc is real JSON
+
+
+def test_object_rejects_wrong_order_missing_and_extra_keys():
+    # declaration order is enforced
+    assert not prefix_ok(PERSON, '{"age"')
+    # unknown key
+    assert not prefix_ok(PERSON, '{"nope"')
+    # missing required key: '}' after age is rejected
+    assert not prefix_ok(PERSON, '{"name": "a", "age": 1}')
+    # wrong value type
+    assert not prefix_ok(PERSON, '{"name": 3')
+    # integer rejects fractions
+    assert not prefix_ok(PERSON, '{"name": "a", "age": 1.')
+
+
+def test_optional_keys_skip_in_order():
+    schema = {
+        "type": "object",
+        "additionalProperties": False,
+        "properties": {
+            "a": {"type": "integer"},
+            "b": {"type": "integer"},
+            "c": {"type": "integer"},
+        },
+        "required": ["c"],
+    }
+    assert accepts(schema, '{"a": 1, "b": 2, "c": 3}')
+    assert accepts(schema, '{"b": 2, "c": 3}')
+    assert accepts(schema, '{"c": 3}')
+    # skipping backwards is not allowed
+    assert not prefix_ok(schema, '{"b": 2, "a"')
+    # required key cannot be skipped
+    assert not prefix_ok(schema, '{"a": 1}')
+
+
+def test_all_optional_object_can_be_empty():
+    schema = {
+        "type": "object",
+        "additionalProperties": False,
+        "properties": {"a": {"type": "integer"}},
+    }
+    assert accepts(schema, "{}")
+    assert accepts(schema, '{"a": 5}')
+
+
+def test_enum_and_const():
+    schema = {"enum": ["red", "green", 42, 421, True, None]}
+    for doc in ['"red"', '"green"', "42", "421", "true", "null"]:
+        assert accepts(schema, doc), doc
+    assert not prefix_ok(schema, '"blue"')
+    assert not prefix_ok(schema, "false")
+    # 42 may end (lazy) while 421 continues
+    spec = sf.compile_schema(schema)
+    st = sf.advance_bytes(spec, sf.initial_state(spec), b"42")
+    assert sf.is_complete(st)
+    st2 = sf.advance_bytes(spec, st, b"1")
+    assert sf.is_complete(st2)
+    assert accepts({"const": "only"}, '"only"')
+    assert not prefix_ok({"const": "only"}, '"two"')
+
+
+def test_enum_with_escaped_string():
+    schema = {"enum": ['say "hi"']}
+    assert accepts(schema, json.dumps('say "hi"'))
+
+
+def test_arrays_min_max():
+    schema = {
+        "type": "array", "items": {"type": "integer"},
+        "minItems": 1, "maxItems": 2,
+    }
+    assert not accepts(schema, "[]")
+    assert accepts(schema, "[1]")
+    assert accepts(schema, "[1, 2]")
+    assert not prefix_ok(schema, "[1, 2,")
+    empty_ok = {"type": "array", "items": {"type": "integer"}}
+    assert accepts(empty_ok, "[]")
+
+
+def test_nested_structures_and_numbers():
+    schema = {
+        "type": "object",
+        "additionalProperties": False,
+        "properties": {
+            "pt": {
+                "type": "object",
+                "additionalProperties": False,
+                "properties": {
+                    "x": {"type": "number"}, "y": {"type": "number"},
+                },
+                "required": ["x", "y"],
+            },
+        },
+        "required": ["pt"],
+    }
+    assert accepts(schema, '{"pt": {"x": -1.5e3, "y": 0.25}}')
+    assert not prefix_ok(schema, '{"pt": {"x": 01')
+
+
+def test_free_string_escapes():
+    schema = {"type": "string"}
+    assert accepts(schema, json.dumps('line\n "quoted" \\ done'))
+
+
+def test_whitespace_capped_at_one_byte():
+    assert accepts(PERSON, '{ "name": "a", "age": 1, "tags": ["t"] }')
+    assert not prefix_ok(PERSON, '{  "name"')
+
+
+def test_unsupported_schemas_rejected():
+    for bad in [
+        {"anyOf": [{"type": "string"}]},
+        {"type": "object", "properties": {}},  # no additionalProperties
+        {"type": "string", "pattern": "a+"},
+        {"type": "integer", "minimum": 3},
+        {"type": ["string", "null"]},
+        {"type": "array"},  # no items
+        {},  # no type
+    ]:
+        with pytest.raises(sf.SchemaError):
+            sf.compile_schema(bad)
+
+
+def test_token_bitmap_soundness():
+    """Every token the bitmap allows keeps the automaton alive; every
+    token it rejects kills it (exactness, not just soundness)."""
+    spec = sf.compile_schema(PERSON)
+    vocab = [
+        b"", b"{", b"}", b'{"', b'{"name', b'{"name":', b'"', b'":',
+        b" ", b"  ", b'{"age', b"ada", b'a"', b"12", b"1.5", b",", b"]",
+        b'", "age": 3', b":", b"[",
+    ]
+    fbi = sf.build_first_byte_index(vocab)
+    st = sf.initial_state(spec)
+    bits = sf.token_bitmap(spec, st, fbi, len(vocab), eos_ids=[0])
+    for tid, tb in enumerate(vocab):
+        if not tb:
+            continue
+        alive = sf.advance_bytes(spec, st, tb) is not None
+        assert bits[tid] == alive, (tid, tb)
+    # EOS disallowed mid-document, allowed at completion
+    assert not bits[0]
+    done = sf.advance_bytes(
+        spec, st, b'{"name": "a", "age": 1, "tags": ["t"]}'
+    )
+    assert sf.is_complete(done)
+    bits_done = sf.token_bitmap(spec, done, fbi, len(vocab), eos_ids=[0])
+    assert bits_done[0]
+
+
+def test_greedy_walk_under_bitmap_terminates_validly():
+    """Drive a random-but-masked walk: at every step pick any allowed
+    token; the document must stay valid and reach completion (the mask
+    never paints the model into a corner on this vocab)."""
+    spec = sf.compile_schema(PERSON)
+    vocab = [
+        bytes([b]) for b in range(32, 127)
+    ] + [b'{"', b'": ', b'", "', b'"]', b"]}", b'"name', b'"age', b'"tags']
+    fbi = sf.build_first_byte_index(vocab)
+    rng = np.random.default_rng(0)
+    st = sf.initial_state(spec)
+    out = b""
+    for _ in range(300):
+        bits = sf.token_bitmap(spec, st, fbi, len(vocab), eos_ids=[])
+        if sf.is_complete(st):
+            break
+        choices = np.flatnonzero(bits)
+        assert choices.size, out
+        tok = int(rng.choice(choices))
+        out += vocab[tok]
+        st = sf.advance_bytes(spec, st, vocab[tok])
+        assert st is not None
+    assert sf.is_complete(st), out
+    json.loads(out.decode())
+
+def test_key_with_whitespace_matches():
+    """Property names containing spaces are content bytes inside the key
+    string — the inter-token whitespace cap must not swallow them
+    (review finding, round 4)."""
+    schema = {
+        "type": "object",
+        "additionalProperties": False,
+        "properties": {"full name": {"type": "string"}},
+        "required": ["full name"],
+    }
+    assert accepts(schema, '{"full name": "ada"}')
+    # and the bitmap layer agrees byte-for-byte
+    spec = sf.compile_schema(schema)
+    st = sf.advance_bytes(spec, sf.initial_state(spec), b'{"full')
+    assert st is not None
+    nxt = sf.advance_byte_top(spec, st, 0x20)
+    assert nxt is not None  # the space advances the key suffix
+
+
+def test_property_order_distinguishes_specs():
+    """Two schemas differing only in property declaration order compile
+    to different automata AND different memo keys (review finding: a
+    sort_keys canonical key collapsed them)."""
+    a = {
+        "type": "object", "additionalProperties": False,
+        "properties": {"a": {"type": "integer"}, "b": {"type": "integer"}},
+        "required": ["a", "b"],
+    }
+    b = {
+        "type": "object", "additionalProperties": False,
+        "properties": {"b": {"type": "integer"}, "a": {"type": "integer"}},
+        "required": ["a", "b"],
+    }
+    sa, sb = sf.compile_schema(a), sf.compile_schema(b)
+    assert sa.source_key != sb.source_key
+    assert accepts(a, '{"a": 1, "b": 2}')
+    assert not prefix_ok(a, '{"b"')
+    assert accepts(b, '{"b": 2, "a": 1}')
+    assert not prefix_ok(b, '{"a"')

@@ -70,12 +70,17 @@ class KVHandoffMixin:
             )
             if k in body
         }
-        guided_mode = (
-            "json"
-            if isinstance(body.get("response_format"), dict)
-            and body["response_format"].get("type") == "json_object"
-            else None
-        )
+        rf = body.get("response_format")
+        rf = rf if isinstance(rf, dict) else {}
+        guided_mode = {
+            "json_object": "json", "json_schema": "json_schema"
+        }.get(rf.get("type"))
+        guided_schema = None
+        if guided_mode == "json_schema":
+            js = rf.get("json_schema")
+            guided_schema = (
+                js.get("schema") if isinstance(js, dict) else None
+            )
         # adapter travels by NAME: rows are executor-local
         lora_name = (
             body.get("model")
@@ -127,6 +132,7 @@ class KVHandoffMixin:
                     "service_request_id": srid,
                     "sampling": sampling_fields,
                     "guided": guided_mode,
+                    "guided_schema": guided_schema,
                     "lora": lora_name,
                 }
                 if respond_via_self:
@@ -318,10 +324,13 @@ class KVHandoffMixin:
         srid = header.get("service_request_id", "")
         sampling = sampling_from_body(header.get("sampling", {}), self.cfg)
         guided = header.get("guided")
+        schema = header.get("guided_schema")
         if guided and self._ensure_guided_context():
             # decode peer cannot express the mask (tokenizer mismatch):
             # degrade to unconstrained rather than drop the request
-            guided = None
+            guided = schema = None
+        if guided == "json_schema" and not isinstance(schema, dict):
+            guided = schema = None
         lora_name = header.get("lora") or ""
         adapter_idx = getattr(self, "lora_names", {}).get(lora_name, 0)
         if lora_name and not adapter_idx:
@@ -361,6 +370,7 @@ class KVHandoffMixin:
                 sampling=sampling,
                 callback=self._make_push_callback(srid, detoks),
                 guided=guided,
+                schema=schema,
                 adapter_idx=adapter_idx,
             ),
             handoff,

@@ -56,6 +56,7 @@ class ServingMixin:
         n: int,
         best_of: int,
         guided: Optional[str] = None,
+        schema: Optional[dict] = None,
         adapter_idx: int = 0,
     ) -> None:
         """Run n (or best_of) sequences as independent engine requests and
@@ -149,6 +150,7 @@ class ServingMixin:
                     ),
                     callback=make_cb(i),
                     guided=guided,
+                    schema=schema,
                     adapter_idx=adapter_idx,
                 )
             )
@@ -238,23 +240,45 @@ class ServingMixin:
         ex = getattr(self.engine, "executor", None)
         return getattr(getattr(ex, "cfg", None), "vocab_size", None)
 
-    def _parse_guided(self, body: Dict[str, Any]) -> Tuple[Optional[str], str]:
-        """OpenAI response_format -> (guided mode, error). Only
-        {"type": "json_object"} constrains; "text"/absent pass through."""
+    def _parse_guided(
+        self, body: Dict[str, Any]
+    ) -> Tuple[Optional[str], Optional[dict], str]:
+        """OpenAI response_format -> (guided mode, schema, error).
+        {"type": "json_object"} constrains to any JSON object;
+        {"type": "json_schema", "json_schema": {"schema": ...}} to the
+        given schema (strict subset — guided/schema_fsm); "text"/absent
+        pass through."""
         rf = body.get("response_format")
         if not rf:
-            return None, ""
+            return None, None, ""
         if not isinstance(rf, dict) or "type" not in rf:
-            return None, "response_format must be an object with a type"
+            return None, None, "response_format must be an object with a type"
         if rf["type"] in ("text", None):
-            return None, ""
+            return None, None, ""
+        if rf["type"] == "json_schema":
+            js = rf.get("json_schema")
+            schema = js.get("schema") if isinstance(js, dict) else None
+            if not isinstance(schema, dict):
+                return None, None, (
+                    "response_format json_schema requires "
+                    "json_schema.schema (an object)"
+                )
+            from xllm_service_tpu.guided import schema_fsm
+
+            try:
+                schema_fsm.compile_schema(schema)
+            except schema_fsm.SchemaError as e:
+                return None, None, f"unsupported json_schema: {e}"
+            err = self._ensure_guided_context()
+            return (("json_schema", schema, "") if not err
+                    else (None, None, err))
         if rf["type"] != "json_object":
-            return None, (
+            return None, None, (
                 f"response_format type {rf['type']!r} is not supported "
-                f"(json_object or text)"
+                f"(json_schema, json_object or text)"
             )
         err = self._ensure_guided_context()
-        return ("json", "") if not err else (None, err)
+        return ("json", None, "") if not err else (None, None, err)
 
     def _ensure_guided_context(self) -> str:
         """Build + install the JSON-mode mask table once (persistent-
@@ -290,7 +314,10 @@ class ServingMixin:
         if table is None:
             table = json_fsm.token_mask_table(tb, eos)
             self._store_guided_cache(tb, eos, table)
-        self.engine.set_guided_context(table, tb)
+        # eos travels with the table: schema bitmaps must allow the SAME
+        # eos set the json_object table was built with (the engine's own
+        # set is empty in service deployments).
+        self.engine.set_guided_context(table, tb, eos_ids=eos)
         self._guided_ready = True
         return ""
 
@@ -380,7 +407,7 @@ class ServingMixin:
         except ValueError as e:
             h.send_error_json(400, str(e))
             return
-        guided, gerr = self._parse_guided(body)
+        guided, guided_schema, gerr = self._parse_guided(body)
         if gerr:
             h.send_error_json(400, gerr)
             return
@@ -396,7 +423,7 @@ class ServingMixin:
             # this instance serves all sequences and pushes indexed deltas.
             self._serve_fanout_forwarded(
                 srid, token_ids, sampling, n, best_of, guided=guided,
-                adapter_idx=adapter_idx,
+                schema=guided_schema, adapter_idx=adapter_idx,
             )
             h.send_json({"ok": True, "service_request_id": srid})
             return
@@ -454,6 +481,7 @@ class ServingMixin:
                         sampling=sampling,
                         callback=callback,
                         guided=guided,
+                        schema=guided_schema,
                         adapter_idx=adapter_idx,
                         prefill_only=True,
                         handoff=self._make_handoff_sender(
@@ -474,6 +502,7 @@ class ServingMixin:
                         sampling=sampling,
                         callback=callback,
                         guided=guided,
+                        schema=guided_schema,
                         adapter_idx=adapter_idx,
                         mm_embeds=mm_embeds,
                         mm_positions=mm_positions,
@@ -485,7 +514,7 @@ class ServingMixin:
         # Direct mode: this instance is the whole stack for one request.
         self._serve_direct(
             h, body, chat, token_ids, sampling, rid, n, best_of,
-            guided=guided, adapter_idx=adapter_idx,
+            guided=guided, schema=guided_schema, adapter_idx=adapter_idx,
         )
 
     def _serve_direct(
@@ -499,6 +528,7 @@ class ServingMixin:
         n: int = 1,
         best_of: int = 0,
         guided: Optional[str] = None,
+        schema: Optional[dict] = None,
         adapter_idx: int = 0,
     ) -> None:
         from xllm_service_tpu.runtime.engine import EngineRequest
@@ -624,6 +654,7 @@ class ServingMixin:
                     ),
                     callback=make_callback(i),
                     guided=guided,
+                    schema=schema,
                     adapter_idx=adapter_idx,
                 )
             )

@@ -1,0 +1,498 @@
+"""JSON-Schema-constrained byte automaton for structured outputs
+(OpenAI `response_format: {"type": "json_schema", ...}`).
+
+Generalizes guided/json_fsm.py from "any JSON object" to "a JSON
+document matching this schema". Same two-layer architecture:
+
+  * EXACT host tracking: `advance_byte` walks a hashable state tuple
+    (surface, aux, frame stack, ws flag) byte by byte. Unlike the
+    generic automaton, the FULL stack is part of the state — schema
+    masks are per-request anyway, so there is no abstract/visible-top
+    approximation and no sentinel conservatism.
+  * LAZY device mask rows: the mask for a state is computed on first
+    visit by simulating every vocab byte-string whose first byte the
+    state accepts (`token_bitmap`), memoized by state key, and written
+    into the executor table's dynamic-row region
+    (ModelExecutor.update_guided_row). States inside free-form regions
+    (string content, numbers) are CONSTANT across content bytes, so a
+    generation visits O(schema size) distinct states, not O(output
+    length).
+
+Supported subset (the OpenAI structured-outputs strict profile):
+  object (ordered properties, required subset, additionalProperties
+  must be false), array (items + minItems/maxItems), string, enum /
+  const over strings/numbers/bools/null, integer, number, boolean,
+  null. Properties are emitted in DECLARATION ORDER (optional ones may
+  be skipped) — the order OpenAI's implementation produces; it keeps
+  the automaton finite and small. anyOf / $ref / pattern / numeric
+  ranges are rejected at compile time (HTTP 400), not silently
+  ignored.
+
+Whitespace: one byte between tokens, as in json_fsm (unbounded legal
+whitespace lets a masked model burn its budget on emptiness).
+
+Reference vestige for guided decoding overall: the reference exposes
+no structured outputs (its OpenAI surface stops at plain completions);
+this tracks the OpenAI API the reference's HTTP tier mirrors
+(xllm_service/http_service/service.cpp:286-424).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# Surfaces (schema automaton's own, smaller than json_fsm's: object/key
+# bookkeeping lives in the frame stack, literal sets in aux).
+(
+    V_START,    # expecting the first byte of a value (aux = node id)
+    LIT,        # inside a literal-alternative set (aux = alt suffixes)
+    STR,        # free string content (aux = ())
+    STR_ESC,    # after backslash in a free string
+    NUM_SIGN,   # after '-' (aux = ("int"|"num",))
+    NUM_INT,    # integer digits — may end here
+    NUM_Z,      # leading zero — '.', 'e' (number only) or end
+    NUM_DOT,    # after '.' needing a digit
+    NUM_FRAC,   # fraction digits — may end here
+    NUM_E,      # after e/E needing sign/digit
+    NUM_ESIGN,  # after exponent sign needing digit
+    NUM_EXP,    # exponent digits — may end here
+    KEY,        # inside an object key (aux = ((prop_idx, suffix), ...))
+    COLON,      # expecting ':' (aux = (prop_idx,))
+    POST,       # after a complete value: ',' / '}' / ']' per top frame
+    DONE,       # complete document: whitespace + EOS only
+) = range(16)
+
+WS = frozenset(b" \t\n\r")
+DIGITS = frozenset(b"0123456789")
+_NUM_MAY_END = {NUM_INT, NUM_Z, NUM_FRAC, NUM_EXP}
+_WS_OK = {V_START, KEY, COLON, POST, DONE}
+
+
+class SchemaError(ValueError):
+    """Schema outside the supported strict subset (surface as HTTP 400)."""
+
+
+# ------------------------------------------------------------- compilation
+
+
+class SchemaSpec:
+    """Compiled schema: a flat node list (id 0 = root). Hashable by the
+    canonical JSON of the source schema (mask-row caches key on it)."""
+
+    def __init__(self, nodes: List[dict], source_key: str):
+        self.nodes = nodes
+        self.source_key = source_key
+
+
+def _enc_str(s: str) -> bytes:
+    """JSON-encoded string WITHOUT the surrounding quotes (escapes kept:
+    candidate matching runs over encoded bytes, so values needing
+    escapes match exactly)."""
+    return json.dumps(s, ensure_ascii=False)[1:-1].encode("utf-8")
+
+
+def _enc_value(v) -> bytes:
+    """Full JSON encoding of a scalar enum/const alternative."""
+    if isinstance(v, (dict, list)):
+        raise SchemaError("enum/const values must be scalars")
+    return json.dumps(v, ensure_ascii=False).encode("utf-8")
+
+
+_UNSUPPORTED = (
+    "anyOf", "oneOf", "allOf", "not", "$ref", "if", "then", "else",
+    "patternProperties", "pattern", "format", "minimum", "maximum",
+    "exclusiveMinimum", "exclusiveMaximum", "multipleOf", "minLength",
+    "maxLength", "uniqueItems", "prefixItems",
+)
+
+
+def compile_schema(schema: dict) -> SchemaSpec:
+    """Validate + flatten a schema dict. Raises SchemaError outside the
+    supported subset."""
+    if not isinstance(schema, dict):
+        raise SchemaError("schema must be an object")
+    nodes: List[dict] = []
+
+    def build(node: dict) -> int:
+        if not isinstance(node, dict):
+            raise SchemaError("schema node must be an object")
+        for k in _UNSUPPORTED:
+            if k in node:
+                raise SchemaError(f"unsupported schema keyword: {k}")
+        nid = len(nodes)
+        nodes.append({})  # reserve slot (children reference by id)
+        if "const" in node:
+            nodes[nid] = {
+                "kind": "enum", "alts": (_enc_value(node["const"]),)
+            }
+            return nid
+        if "enum" in node:
+            vals = node["enum"]
+            if not isinstance(vals, list) or not vals:
+                raise SchemaError("enum must be a non-empty array")
+            nodes[nid] = {
+                "kind": "enum",
+                "alts": tuple(sorted({_enc_value(v) for v in vals})),
+            }
+            return nid
+        t = node.get("type")
+        if isinstance(t, list):
+            raise SchemaError("type unions are not supported")
+        if t == "object":
+            props = node.get("properties") or {}
+            if not isinstance(props, dict):
+                raise SchemaError("properties must be an object")
+            if node.get("additionalProperties", None) is not False:
+                raise SchemaError(
+                    "objects require additionalProperties: false "
+                    "(strict structured outputs)"
+                )
+            required = node.get("required") or []
+            unknown = set(required) - set(props)
+            if unknown:
+                raise SchemaError(f"required lists unknown keys: {unknown}")
+            plist = []
+            for name, sub in props.items():
+                plist.append(
+                    (_enc_str(name), build(sub), name in set(required))
+                )
+            nodes[nid] = {"kind": "object", "props": tuple(plist)}
+            return nid
+        if t == "array":
+            if "items" not in node:
+                raise SchemaError("arrays require an items schema")
+            mn = int(node.get("minItems", 0))
+            mx = node.get("maxItems")
+            mx = int(mx) if mx is not None else None
+            if mx is not None and mx < mn:
+                raise SchemaError("maxItems < minItems")
+            nodes[nid] = {
+                "kind": "array", "items": build(node["items"]),
+                "min": mn, "max": mx,
+            }
+            return nid
+        if t == "string":
+            nodes[nid] = {"kind": "string"}
+            return nid
+        if t in ("integer", "number"):
+            nodes[nid] = {"kind": t}
+            return nid
+        if t == "boolean":
+            nodes[nid] = {"kind": "enum", "alts": (b"true", b"false")}
+            return nid
+        if t == "null":
+            nodes[nid] = {"kind": "enum", "alts": (b"null",)}
+            return nid
+        raise SchemaError(
+            f"unsupported or missing type: {t!r} (every node needs an "
+            f"explicit type, enum, or const)"
+        )
+
+    build(schema)
+    # NO sort_keys: property DECLARATION ORDER is part of the contract
+    # (two schemas differing only in order compile to different
+    # automata and must not share a memo entry).
+    key = json.dumps(schema, separators=(",", ":"))
+    return SchemaSpec(nodes, key)
+
+
+# ------------------------------------------------------------- the automaton
+#
+# State: (surface, aux, stack, ws)
+#   stack frames: ("o", node_id, next_prop_idx) | ("a", node_id, count)
+#   aux by surface: V_START -> (node_id,); LIT -> alt suffix tuple;
+#   NUM_* -> ("int"|"num",); KEY -> ((prop_idx, suffix), ...);
+#   COLON -> (prop_idx,); else ().
+
+State = Tuple[int, tuple, tuple, bool]
+
+
+def initial_state(spec: SchemaSpec) -> State:
+    return (V_START, (0,), (), False)
+
+
+def is_complete(st: Optional[State]) -> bool:
+    if st is None:
+        return False
+    s, aux, stack, _ = st
+    if stack:
+        return False
+    if s == DONE:
+        return True
+    # lazy number end at top level
+    if s in _NUM_MAY_END:
+        return True
+    # a completable literal alternative (empty suffix present)
+    return s == LIT and b"" in aux
+
+
+def _key_candidates(spec: SchemaSpec, node_id: int, idx: int):
+    """Keys emittable at property position idx: every optional property
+    until (and including) the first required one."""
+    props = spec.nodes[node_id]["props"]
+    out = []
+    for j in range(idx, len(props)):
+        name, _, req = props[j]
+        out.append((j, name))
+        if req:
+            break
+    return out
+
+
+def _may_close(spec: SchemaSpec, node_id: int, idx: int) -> bool:
+    """'}' legal at property position idx iff no required property
+    remains at/after idx."""
+    props = spec.nodes[node_id]["props"]
+    return all(not req for _, _, req in props[idx:])
+
+
+def _pop_value(spec: SchemaSpec, stack: tuple) -> State:
+    """A value just completed under `stack` — surface for what follows."""
+    if not stack:
+        return (DONE, (), (), False)
+    return (POST, (), stack, False)
+
+
+def _start_value(spec: SchemaSpec, node_id: int, stack: tuple,
+                 b: int) -> Optional[State]:
+    """Dispatch byte b as the first byte of a value of node `node_id`."""
+    node = spec.nodes[node_id]
+    kind = node["kind"]
+    if kind == "enum":
+        alive = tuple(a[1:] for a in node["alts"] if a and a[0] == b)
+        if not alive:
+            return None
+        if b"" in alive and len(alive) == 1:
+            return _pop_value(spec, stack)
+        return (LIT, alive, stack, False)
+    if kind == "object":
+        if b != 0x7B:  # '{'
+            return None
+        # KEY with aux=() is the "at a key boundary" position: '"' opens
+        # a candidate key, '}' closes if no required property remains.
+        return (KEY, (), stack + (("o", node_id, 0),), False)
+    if kind == "array":
+        if b != 0x5B:  # '['
+            return None
+        return (V_START, (node["items"],), stack + (("a", node_id, 0),),
+                False)
+    if kind == "string":
+        if b != 0x22:
+            return None
+        return (STR, (), stack, False)
+    if kind in ("integer", "number"):
+        k = "int" if kind == "integer" else "num"
+        if b == 0x2D:  # '-'
+            return (NUM_SIGN, (k,), stack, False)
+        if b == 0x30:
+            return (NUM_Z, (k,), stack, False)
+        if b in DIGITS:
+            return (NUM_INT, (k,), stack, False)
+        return None
+    raise AssertionError(kind)
+
+
+def advance_byte(spec: SchemaSpec, st: State, b: int) -> Optional[State]:
+    s, aux, stack, ws = st
+
+    # ---- literal alternative set
+    if s == LIT:
+        alive = tuple(a[1:] for a in aux if a and a[0] == b)
+        if alive:
+            if alive == (b"",):
+                return _pop_value(spec, stack)
+            return (LIT, alive, stack, False)
+        if b"" in aux:
+            # a completable (number) alternative ends lazily here
+            nxt = _pop_value(spec, stack)
+            return advance_byte(spec, nxt, b)
+        return None
+
+    # ---- free string value
+    if s == STR:
+        if b == 0x22:
+            return _pop_value(spec, stack)
+        if b == 0x5C:
+            return (STR_ESC, (), stack, False)
+        if b >= 0x20:
+            return (STR, (), stack, False)
+        return None
+    if s == STR_ESC:
+        if bytes([b]) in b'"\\/bfnrtu':
+            return (STR, (), stack, False)
+        return None
+
+    # ---- numbers (aux = ("int"|"num",))
+    if s in (NUM_SIGN, NUM_DOT, NUM_E, NUM_ESIGN):
+        if s == NUM_E and b in b"+-":
+            return (NUM_ESIGN, aux, stack, False)
+        if b in DIGITS:
+            if s == NUM_SIGN:
+                return (NUM_Z if b == 0x30 else NUM_INT, aux, stack, False)
+            if s == NUM_DOT:
+                return (NUM_FRAC, aux, stack, False)
+            return (NUM_EXP, aux, stack, False)
+        return None
+    if s in _NUM_MAY_END:
+        num = aux[0] == "num"
+        if b in DIGITS:
+            if s == NUM_Z:
+                return None
+            return (s, aux, stack, False)
+        if num and b == 0x2E and s in (NUM_INT, NUM_Z):
+            return (NUM_DOT, aux, stack, False)
+        if num and b in b"eE" and s in (NUM_INT, NUM_Z, NUM_FRAC):
+            return (NUM_E, aux, stack, False)
+        nxt = _pop_value(spec, stack)
+        return advance_byte(spec, nxt, b)
+
+    # ---- whitespace (one byte max between tokens; NOT inside a key
+    # string — KEY with non-empty aux is mid-string, where a space is a
+    # content byte the candidate suffixes must match)
+    if b in WS and not (s == KEY and aux):
+        if not ws and s in _WS_OK:
+            return (s, aux, stack, True)
+        return None
+
+    # ---- value start
+    if s == V_START:
+        return _start_value(spec, aux[0], stack, b)
+
+    # ---- object key position (top frame is ("o", node, idx))
+    if s == KEY:
+        frame = stack[-1]
+        _, node_id, idx = frame
+        if not aux:
+            # at the '{' / ',' boundary: '"' opens a key, '}' may close
+            if b == 0x7D and _may_close(spec, node_id, idx):
+                return _pop_value(spec, stack[:-1])
+            if b == 0x22:
+                cands = _key_candidates(spec, node_id, idx)
+                if not cands:
+                    return None
+                return (KEY, tuple((j, n) for j, n in cands), stack, False)
+            return None
+        # inside the key string: match candidate suffixes
+        alive = tuple(
+            (j, n[1:]) for j, n in aux if n and n[0] == b
+        )
+        done = [j for j, n in aux if n == b""]
+        if b == 0x22 and done:
+            # key complete: bind property `done[0]` (suffix-free match is
+            # unique — JSON-encoded names are distinct)
+            j = done[0]
+            return (COLON, (j,), stack, False)
+        if alive:
+            return (KEY, alive, stack, False)
+        return None
+
+    if s == COLON:
+        if b == 0x3A:
+            j = aux[0]
+            _, node_id, _ = stack[-1]
+            props = spec.nodes[node_id]["props"]
+            nstack = stack[:-1] + (("o", node_id, j + 1),)
+            return (V_START, (props[j][1],), nstack, False)
+        return None
+
+    # ---- after a complete value
+    if s == POST:
+        frame = stack[-1]
+        if frame[0] == "o":
+            _, node_id, idx = frame
+            if b == 0x2C and _key_candidates(spec, node_id, idx):
+                return (KEY, (), stack, False)
+            if b == 0x7D and _may_close(spec, node_id, idx):
+                return _pop_value(spec, stack[:-1])
+            return None
+        _, node_id, count = frame
+        node = spec.nodes[node_id]
+        count += 1
+        if b == 0x2C and (node["max"] is None or count < node["max"]):
+            nstack = stack[:-1] + (("a", node_id, count),)
+            return (V_START, (node["items"],), nstack, False)
+        if b == 0x5D and count >= node["min"]:
+            return _pop_value(spec, stack[:-1])
+        return None
+
+    return None  # DONE + non-ws
+
+
+# Array-first-element special case: '[' pushes ("a", node, 0) and V_START;
+# ']' immediately after '[' (empty array) must be legal when min == 0.
+# V_START handles only value bytes, so patch: _start_value of the items
+# node returning None for b == ']' falls here via a wrapper.
+
+
+def advance_byte_top(spec: SchemaSpec, st: State, b: int) -> Optional[State]:
+    """advance_byte + the empty-array special case (']' at an array's
+    first V_START position)."""
+    s, aux, stack, ws = st
+    if (
+        s == V_START and b == 0x5D and stack and stack[-1][0] == "a"
+        and stack[-1][2] == 0
+    ):
+        node = spec.nodes[stack[-1][1]]
+        if node["min"] == 0:
+            return _pop_value(spec, stack[:-1])
+    return advance_byte(spec, st, b)
+
+
+def advance_bytes(
+    spec: SchemaSpec, st: Optional[State], data: bytes
+) -> Optional[State]:
+    for b in data:
+        if st is None:
+            return None
+        st = advance_byte_top(spec, st, b)
+    return st
+
+
+# ------------------------------------------------------------- mask bitmaps
+
+
+def build_first_byte_index(token_bytes: List[bytes]):
+    """byte -> [(token_bytes, [ids])] over unique non-empty surfaces."""
+    uniq: Dict[bytes, List[int]] = {}
+    for tid, tb in enumerate(token_bytes):
+        if tb:
+            uniq.setdefault(bytes(tb), []).append(tid)
+    index: Dict[int, List[Tuple[bytes, List[int]]]] = {}
+    for tb, ids in uniq.items():
+        index.setdefault(tb[0], []).append((tb, ids))
+    return index
+
+
+def token_bitmap(
+    spec: SchemaSpec,
+    st: State,
+    first_byte_index,
+    vocab_size: int,
+    eos_ids: List[int],
+) -> np.ndarray:
+    """[V] bool allowed-token bitmap for one exact state: a token is
+    allowed iff every byte advances the automaton. EOS is allowed iff
+    the document is complete at this state. Cost is bounded by the
+    tokens whose FIRST byte the state accepts; free-content states are
+    constant across content, so each distinct state is computed once
+    per schema (the engine memoizes by state key)."""
+    bits = np.zeros(vocab_size, dtype=bool)
+    for b in range(256):
+        if advance_byte_top(spec, st, b) is None:
+            continue
+        for tb, ids in first_byte_index.get(b, ()):
+            cur: Optional[State] = st
+            for byte in tb:
+                cur = advance_byte_top(spec, cur, byte)
+                if cur is None:
+                    break
+            if cur is not None:
+                bits[ids] = True
+    if is_complete(st):
+        for e in eos_ids:
+            if 0 <= e < vocab_size:
+                bits[e] = True
+    return bits

@@ -14,6 +14,8 @@ two jitted step functions, so nothing here ever triggers a recompile.
 from __future__ import annotations
 
 import collections
+import json
+import logging
 import math
 import threading
 import time
@@ -65,8 +67,11 @@ class EngineRequest:
     mm_embeds: Optional[object] = None
     mm_positions: Optional[object] = None
     # Guided decoding: "json" constrains the output to a JSON object via
-    # the engine's mask table (set_guided_context must have been called).
+    # the engine's mask table (set_guided_context must have been called);
+    # "json_schema" additionally constrains it to `schema` (a JSON-Schema
+    # dict in the supported strict subset — guided/schema_fsm).
     guided: Optional[str] = None
+    schema: Optional[dict] = None
     # Multi-LoRA adapter row in the executor's stacks (0 = base model).
     adapter_idx: int = 0
 
@@ -107,7 +112,7 @@ class _Seq:
         "req", "slot", "tokens", "block_ids", "num_cached", "generated",
         "last_committed_block", "prefill_done_time", "last_token_time",
         "prefilled", "chunk_len", "prefill_start_time", "head_hash",
-        "json_state", "json_upto",
+        "json_state", "json_upto", "schema_spec",
     )
 
     def __init__(self, req: EngineRequest, slot: int):
@@ -136,6 +141,7 @@ class _Seq:
         # from then on (never expected under the mask; belt+braces).
         self.json_state = "INIT"
         self.json_upto = 0
+        self.schema_spec = None  # compiled SchemaSpec, cached at first use
 
 
 # The waiting queue holds fresh EngineRequests and preempted _Seqs (which
@@ -210,6 +216,16 @@ class InferenceEngine:
         # liveness for exact host tracking.
         self._guided_tokens: Optional[List[bytes]] = None
         self._guided_row_any: Optional[np.ndarray] = None
+        # json_schema mode: compiled specs by canonical schema key, the
+        # (schema, exact-state) -> dynamic-row memo, the next free row in
+        # the executor table's dynamic region, and the lazily built
+        # first-byte token index the bitmap builder prefilters with.
+        self._schema_specs: Dict[str, object] = {}
+        self._schema_row_cache: Dict[tuple, int] = {}
+        self._schema_row_next = 0
+        self._schema_fbi = None
+        self._schema_flush_pending = False
+        self._guided_eos: Optional[List[int]] = None
         # Speculative-decoding accounting: verify steps run, slot-steps
         # (active sequences summed over steps), and tokens emitted — the
         # mean tokens/slot-step is the realized speedup over plain decode.
@@ -297,6 +313,7 @@ class InferenceEngine:
         requests, then one decode step. Returns number of tokens produced."""
         self._drain_imports()
         self._drain_cancelled()
+        self._maybe_flush_schema_rows()
         admitted = self._admit()
         return admitted + self._decode_once()
 
@@ -1069,14 +1086,23 @@ class InferenceEngine:
         return self.lora_names
 
     def set_guided_context(
-        self, table: np.ndarray, token_bytes: List[bytes]
+        self, table: np.ndarray, token_bytes: List[bytes],
+        eos_ids: Optional[List[int]] = None,
     ) -> None:
         """Install the JSON-mode mask table ([M, V] bool, one row per
         abstract automaton state — guided/json_fsm.token_mask_table) and
-        the per-id byte surfaces the host tracker walks."""
+        the per-id byte surfaces the host tracker walks. `eos_ids` is the
+        EOS set the TABLE was built with (engine EOS unioned with the
+        tokenizer's — instance_serving._build_guided_context); schema
+        bitmaps must use the same set or completed documents could never
+        emit EOS in deployments where the engine's own set is empty."""
         self.executor.set_guided_table(table)
         self._guided_tokens = token_bytes
         self._guided_row_any = table.any(axis=1)
+        self._guided_eos = (
+            sorted(set(eos_ids)) if eos_ids is not None
+            else sorted(self.eos_token_ids)
+        )
 
     def _guided_row(self, seq: _Seq) -> int:
         """Mask-table row for the seq's NEXT sampled token, advancing the
@@ -1087,19 +1113,22 @@ class InferenceEngine:
         from xllm_service_tpu.guided import json_fsm
 
         perm = self.executor.permissive_row
-        if seq.req.guided != "json" or self._guided_tokens is None:
+        if self._guided_tokens is None:
             return perm
-        if seq.json_state == "INIT":
-            seq.json_state = json_fsm.initial_state()
-            seq.json_upto = 0
-        st = seq.json_state
-        toks = self._guided_tokens
-        while st is not None and seq.json_upto < len(seq.generated):
-            tok = seq.generated[seq.json_upto][0]
-            tb = toks[tok] if 0 <= tok < len(toks) else b""
-            st = json_fsm.advance_bytes(st, tb)
-            seq.json_upto += 1
-        seq.json_state = st
+        if seq.req.guided == "json_schema":
+            spec = seq.schema_spec
+            if spec is None:  # first touch (False = compile failed, sticky)
+                spec = self._schema_spec_for(seq.req)
+                seq.schema_spec = spec if spec is not None else False
+            if not spec:
+                return perm
+            st = self._advance_exact(seq, spec)
+            if st is None:
+                return perm
+            return self._schema_state_row(spec, st)
+        if seq.req.guided != "json":
+            return perm
+        st = self._advance_exact(seq, None)
         if st is None:
             return perm
         row = json_fsm.abstract_index(st)
@@ -1107,13 +1136,116 @@ class InferenceEngine:
             return perm
         return row
 
+    def _advance_exact(self, seq: _Seq, spec):
+        """Advance the seq's exact automaton (generic JSON when spec is
+        None, schema otherwise) through unconsumed emitted tokens."""
+        from xllm_service_tpu.guided import json_fsm, schema_fsm
+
+        if seq.json_state == "INIT":
+            seq.json_state = (
+                schema_fsm.initial_state(spec) if spec is not None
+                else json_fsm.initial_state()
+            )
+            seq.json_upto = 0
+        st = seq.json_state
+        toks = self._guided_tokens
+        while st is not None and seq.json_upto < len(seq.generated):
+            tok = seq.generated[seq.json_upto][0]
+            tb = toks[tok] if 0 <= tok < len(toks) else b""
+            st = (
+                schema_fsm.advance_bytes(spec, st, tb) if spec is not None
+                else json_fsm.advance_bytes(st, tb)
+            )
+            seq.json_upto += 1
+        seq.json_state = st
+        return st
+
+    def _schema_spec_for(self, req: EngineRequest):
+        """Compiled SchemaSpec for the request's schema (memoized by
+        canonical schema JSON; compile errors were already rejected at
+        the API layer — degrade open if one slips through)."""
+        from xllm_service_tpu.guided import schema_fsm
+
+        if req.schema is None:
+            return None
+        # NO sort_keys: declaration order IS the emission contract.
+        key = json.dumps(req.schema, separators=(",", ":"))
+        spec = self._schema_specs.get(key)
+        if spec is None:
+            try:
+                spec = schema_fsm.compile_schema(req.schema)
+            except schema_fsm.SchemaError:
+                logging.getLogger(__name__).warning(
+                    "json_schema compile failed post-admission; serving "
+                    "unconstrained"
+                )
+                return None
+            self._schema_specs[key] = spec
+        return spec
+
+    def _schema_state_row(self, spec, st) -> int:
+        """Dynamic-row index for an exact schema state: memoized (incl.
+        permissive-degrade outcomes — recomputing a full-vocab bitmap per
+        step would stall the batch); first visit computes the token
+        bitmap and writes it into the executor table's dynamic region.
+        On exhaustion the region is flushed BETWEEN steps (a mid-step
+        flush could overwrite a row another slot was just assigned) and
+        this state degrades open for one step."""
+        from xllm_service_tpu.guided import schema_fsm
+
+        ex = self.executor
+        perm = ex.permissive_row
+        base = getattr(ex, "dynamic_row_base", None)
+        if base is None:
+            return perm
+        key = (spec.source_key, st)
+        row = self._schema_row_cache.get(key)
+        if row is not None:
+            return row
+        if self._schema_row_next >= getattr(ex, "num_dynamic_rows", 0):
+            # Flush at the next step boundary; this step degrades open.
+            if not self._schema_flush_pending:
+                self._schema_flush_pending = True
+                logging.getLogger(__name__).warning(
+                    "guided json_schema: dynamic mask rows exhausted; "
+                    "flushing the region at the next step"
+                )
+            return perm
+        if self._schema_fbi is None:
+            self._schema_fbi = schema_fsm.build_first_byte_index(
+                self._guided_tokens
+            )
+        eos = getattr(self, "_guided_eos", None)
+        bits = schema_fsm.token_bitmap(
+            spec, st, self._schema_fbi, len(self._guided_tokens),
+            eos if eos is not None else sorted(self.eos_token_ids),
+        )
+        if not bits.any():
+            self._schema_row_cache[key] = perm  # memoize the degrade
+            return perm
+        row = base + self._schema_row_next
+        self._schema_row_next += 1
+        ex.update_guided_row(row, bits)
+        self._schema_row_cache[key] = row
+        return row
+
+    def _maybe_flush_schema_rows(self) -> None:
+        """Between-steps recycle of the dynamic mask-row region: drop the
+        memo and restart allocation. Live sequences re-derive their rows
+        from their current exact state on the next assembly, so no row
+        index can be stale."""
+        if self._schema_flush_pending:
+            self._schema_flush_pending = False
+            self._schema_row_cache.clear()
+            self._schema_row_next = 0
+
     def _guided_rows_spec(self, seq: _Seq, drafts: np.ndarray, S: int):
         """Per-position mask rows for a verify step: position 0 uses the
         current state; position j continues through drafts 0..j-1 (the
         accepted tokens ARE the drafts). An illegal draft leaves later
         positions permissive — sampling rejects at the illegal position
         anyway."""
-        from xllm_service_tpu.guided import json_fsm
+        from xllm_service_tpu.guided import json_fsm, schema_fsm
 
         perm = self.executor.permissive_row
         rows = np.full((S,), perm, np.int32)
@@ -1121,16 +1253,25 @@ class InferenceEngine:
         rows[0] = r0
         if r0 == perm:
             return rows
+        schema = seq.req.guided == "json_schema"
+        # _guided_row above already resolved + cached the spec on the seq.
+        spec = seq.schema_spec or None if schema else None
         st = seq.json_state
         toks = self._guided_tokens
         for j in range(1, S):
             d = int(drafts[j - 1])
             tb = toks[d] if 0 <= d < len(toks) else b""
-            st = json_fsm.advance_bytes(st, tb)
+            st = (
+                schema_fsm.advance_bytes(spec, st, tb) if schema
+                else json_fsm.advance_bytes(st, tb)
+            )
             if st is None:
                 break
-            row = json_fsm.abstract_index(st)
-            rows[j] = row if self._guided_row_any[row] else perm
+            if schema:
+                rows[j] = self._schema_state_row(spec, st)
+            else:
+                row = json_fsm.abstract_index(st)
+                rows[j] = row if self._guided_row_any[row] else perm
         return rows
 
     # ------------------------------------------------- speculative decode

@@ -411,16 +411,33 @@ class ModelExecutor:
 
     # -------------------------------------------------- guided decoding
 
-    def set_guided_table(self, table: np.ndarray) -> None:
+    def set_guided_table(
+        self, table: np.ndarray, dynamic_rows: int = 256
+    ) -> None:
         """Install the guided-decoding token-mask table [M, V] bool (one
         row per abstract automaton state). A permissive all-True row is
         appended at index M — unguided slots point there, so one compiled
-        step serves mixed guided/unguided batches."""
+        step serves mixed guided/unguided batches. `dynamic_rows` extra
+        rows follow for per-request schema masks (json_schema mode):
+        written lazily via update_guided_row as the schema automaton
+        visits states, all-False until then (the engine never points a
+        slot at an unwritten row)."""
         M, V = table.shape
-        full = np.ones((M + 1, V), dtype=bool)
+        full = np.ones((M + 1 + dynamic_rows, V), dtype=bool)
         full[:M] = table
+        full[M + 1:] = False
         self._guided_table = jnp.asarray(full)
         self.permissive_row = M
+        self.dynamic_row_base = M + 1
+        self.num_dynamic_rows = dynamic_rows
+
+    def update_guided_row(self, row: int, bits: np.ndarray) -> None:
+        """Write one dynamic mask row (device-side functional update; the
+        table is a plain jit argument, never donated, so the new array
+        simply rides the next step)."""
+        self._guided_table = self._guided_table.at[row].set(
+            jnp.asarray(bits, dtype=bool)
+        )
 
     @property
     def guided_table(self):
