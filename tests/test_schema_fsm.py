@@ -254,3 +254,24 @@ def test_property_order_distinguishes_specs():
     assert not prefix_ok(a, '{"b"')
     assert accepts(b, '{"b": 2, "a": 1}')
     assert not prefix_ok(b, '{"a"')
+
+
+def test_no_trailing_comma_with_optional_tail():
+    """'{\"a\": 1,}' must be rejected even when remaining properties are
+    all optional — ',' commits to another key (review finding, round 4)."""
+    schema = {
+        "type": "object", "additionalProperties": False,
+        "properties": {"a": {"type": "integer"}, "b": {"type": "integer"}},
+        "required": ["a"],
+    }
+    assert accepts(schema, '{"a": 1}')
+    assert accepts(schema, '{"a": 1, "b": 2}')
+    assert not prefix_ok(schema, '{"a": 1,}')
+    # whitespace after the comma still works
+    assert accepts(schema, '{"a": 1, "b": 2}')
+    spec = sf.compile_schema(schema)
+    st = sf.advance_bytes(spec, sf.initial_state(spec), b'{"a": 1,')
+    # bitmap agrees: '}' disallowed, ' ' and '"' allowed
+    fbi = sf.build_first_byte_index([b"}", b" ", b'"'])
+    bits = sf.token_bitmap(spec, st, fbi, 3, eos_ids=[])
+    assert not bits[0] and bits[1] and bits[2]

@@ -134,6 +134,7 @@ class KVHandoffMixin:
                     "guided": guided_mode,
                     "guided_schema": guided_schema,
                     "lora": lora_name,
+                    "offline": bool(body.get("offline", False)),
                 }
                 if respond_via_self:
                     # Alternate topology: decode relays its generations
@@ -372,6 +373,7 @@ class KVHandoffMixin:
                 guided=guided,
                 schema=schema,
                 adapter_idx=adapter_idx,
+                offline=bool(header.get("offline", False)),
             ),
             handoff,
         )

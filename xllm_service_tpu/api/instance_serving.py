@@ -58,6 +58,7 @@ class ServingMixin:
         guided: Optional[str] = None,
         schema: Optional[dict] = None,
         adapter_idx: int = 0,
+        offline: bool = False,
     ) -> None:
         """Run n (or best_of) sequences as independent engine requests and
         push INDEXED deltas under one service_request_id. The prompt's KV
@@ -151,6 +152,7 @@ class ServingMixin:
                     callback=make_cb(i),
                     guided=guided,
                     schema=schema,
+                    offline=offline,
                     adapter_idx=adapter_idx,
                 )
             )
@@ -416,6 +418,10 @@ class ServingMixin:
         adapter_idx = getattr(self, "lora_names", {}).get(
             body.get("model"), 0
         )
+        # Hybrid scheduling: offline work admits behind online work and
+        # its running decodes preempt under online bursts (engine-level;
+        # the master additionally parks offline admissions).
+        offline = bool(body.get("offline", False))
 
         if srid and self._master is not None and (n > 1 or best_of > 1):
             # Fan-out mode: PD split is skipped for multi-sequence requests
@@ -424,6 +430,7 @@ class ServingMixin:
             self._serve_fanout_forwarded(
                 srid, token_ids, sampling, n, best_of, guided=guided,
                 schema=guided_schema, adapter_idx=adapter_idx,
+                offline=offline,
             )
             h.send_json({"ok": True, "service_request_id": srid})
             return
@@ -482,6 +489,7 @@ class ServingMixin:
                         callback=callback,
                         guided=guided,
                         schema=guided_schema,
+                        offline=offline,
                         adapter_idx=adapter_idx,
                         prefill_only=True,
                         handoff=self._make_handoff_sender(
@@ -503,6 +511,7 @@ class ServingMixin:
                         callback=callback,
                         guided=guided,
                         schema=guided_schema,
+                        offline=offline,
                         adapter_idx=adapter_idx,
                         mm_embeds=mm_embeds,
                         mm_positions=mm_positions,
@@ -515,6 +524,7 @@ class ServingMixin:
         self._serve_direct(
             h, body, chat, token_ids, sampling, rid, n, best_of,
             guided=guided, schema=guided_schema, adapter_idx=adapter_idx,
+            offline=offline,
         )
 
     def _serve_direct(
@@ -530,6 +540,7 @@ class ServingMixin:
         guided: Optional[str] = None,
         schema: Optional[dict] = None,
         adapter_idx: int = 0,
+        offline: bool = False,
     ) -> None:
         from xllm_service_tpu.runtime.engine import EngineRequest
 
@@ -655,6 +666,7 @@ class ServingMixin:
                     callback=make_callback(i),
                     guided=guided,
                     schema=schema,
+                    offline=offline,
                     adapter_idx=adapter_idx,
                 )
             )

@@ -69,6 +69,9 @@ WS = frozenset(b" \t\n\r")
 DIGITS = frozenset(b"0123456789")
 _NUM_MAY_END = {NUM_INT, NUM_Z, NUM_FRAC, NUM_EXP}
 _WS_OK = {V_START, KEY, COLON, POST, DONE}
+# KEY-surface aux marker: at a post-',' boundary a key is MANDATORY ('}'
+# would make a trailing comma); post-'{' boundaries use aux=().
+_KEY_REQUIRED = ("!",)
 
 
 class SchemaError(ValueError):
@@ -350,9 +353,10 @@ def advance_byte(spec: SchemaSpec, st: State, b: int) -> Optional[State]:
         return advance_byte(spec, nxt, b)
 
     # ---- whitespace (one byte max between tokens; NOT inside a key
-    # string — KEY with non-empty aux is mid-string, where a space is a
-    # content byte the candidate suffixes must match)
-    if b in WS and not (s == KEY and aux):
+    # string — KEY with candidate-suffix aux is mid-string, where a space
+    # is a content byte the suffixes must match; the _KEY_REQUIRED
+    # boundary marker still takes inter-token whitespace)
+    if b in WS and not (s == KEY and aux and aux != _KEY_REQUIRED):
         if not ws and s in _WS_OK:
             return (s, aux, stack, True)
         return None
@@ -365,9 +369,14 @@ def advance_byte(spec: SchemaSpec, st: State, b: int) -> Optional[State]:
     if s == KEY:
         frame = stack[-1]
         _, node_id, idx = frame
-        if not aux:
-            # at the '{' / ',' boundary: '"' opens a key, '}' may close
-            if b == 0x7D and _may_close(spec, node_id, idx):
+        if not aux or aux == _KEY_REQUIRED:
+            # At a key boundary: '"' opens a key. '}' may close ONLY at
+            # the post-'{' boundary (aux=()); after a ',' a key is
+            # mandatory — '{"a": 1,}' is not JSON (review finding, r4).
+            if (
+                b == 0x7D and not aux
+                and _may_close(spec, node_id, idx)
+            ):
                 return _pop_value(spec, stack[:-1])
             if b == 0x22:
                 cands = _key_candidates(spec, node_id, idx)
@@ -404,7 +413,7 @@ def advance_byte(spec: SchemaSpec, st: State, b: int) -> Optional[State]:
         if frame[0] == "o":
             _, node_id, idx = frame
             if b == 0x2C and _key_candidates(spec, node_id, idx):
-                return (KEY, (), stack, False)
+                return (KEY, _KEY_REQUIRED, stack, False)
             if b == 0x7D and _may_close(spec, node_id, idx):
                 return _pop_value(spec, stack[:-1])
             return None

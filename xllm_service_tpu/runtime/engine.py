@@ -74,6 +74,11 @@ class EngineRequest:
     schema: Optional[dict] = None
     # Multi-LoRA adapter row in the executor's stacks (0 = base model).
     adapter_idx: int = 0
+    # Hybrid online/offline (north-star config 5; reference vestige
+    # request.h:38, unconsumed there): offline work admits only behind
+    # online work and its RUNNING decodes are preempted (recompute-style)
+    # when online requests are waiting for slots or blocks.
+    offline: bool = False
 
     @property
     def has_media(self) -> bool:
@@ -387,13 +392,61 @@ class InferenceEngine:
                 pending_hashes.add(seq.head_hash)
             batch.append(seq)
 
+        # Priority admission (hybrid online/offline): stable-partition the
+        # queue so every online item precedes every offline one. Relative
+        # order within each class is preserved; mid-chunk seqs were
+        # already extracted above, so nothing here holds blocks.
+        with self._lock:
+            if any(self._item_req(x).offline for x in self._waiting) and any(
+                not self._item_req(x).offline for x in self._waiting
+            ):
+                ordered = sorted(
+                    self._waiting, key=lambda x: self._item_req(x).offline
+                )  # sort is stable: online (False) first
+                self._waiting.clear()
+                self._waiting.extend(ordered)
+
         while budget > 0:
             with self._lock:
                 if not self._waiting:
                     break
-                item = self._waiting[0]
-                if not self._free_slots:
+                head_item = self._waiting[0]
+                head = self._item_req(head_item)
+                # Sanity-reject BEFORE any preemption decision: evicting
+                # offline work for a head that is then rejected would
+                # sacrifice its KV for nothing (review finding, r4).
+                htoks = (
+                    head_item.tokens if isinstance(head_item, _Seq)
+                    else head_item.prompt_token_ids
+                )
+                if len(htoks) >= self.cfg.max_seq_len:
+                    self._waiting.popleft()
+                    rejects.append(
+                        (head, StatusCode.INVALID_ARGUMENT,
+                         "prompt exceeds max_seq_len")
+                    )
+                    continue
+                if math.ceil(
+                    (len(htoks) + 1) / self.block_size
+                ) > pool_capacity:
+                    self._waiting.popleft()
+                    rejects.append(
+                        (head, StatusCode.RESOURCE_EXHAUSTED,
+                         "request needs more KV blocks than the pool holds")
+                    )
+                    continue
+                no_slot = not self._free_slots
+            if no_slot:
+                # Online head + every slot busy: preempt a running OFFLINE
+                # decode (recompute-style) instead of stalling the burst.
+                if not self._preempt_offline_for(head):
                     break
+                continue
+            with self._lock:
+                if not self._waiting or not self._free_slots:
+                    # only this thread pops the head, but re-check anyway
+                    break
+                item = self._waiting[0]
                 tokens = item.tokens if isinstance(item, _Seq) else item.prompt_token_ids
                 n_tok = len(tokens)
                 if n_tok >= self.cfg.max_seq_len:
@@ -415,8 +468,16 @@ class InferenceEngine:
                     )
                     continue
                 if not self.block_mgr.can_allocate(need_total):
+                    blocked_on_pool = True
+                else:
+                    blocked_on_pool = False
+                    self._waiting.popleft()
+            if blocked_on_pool:
+                # Online head + pool pressure: free blocks by preempting a
+                # running OFFLINE decode, then retry this head.
+                if not self._preempt_offline_for(self._item_req(item)):
                     break
-                self._waiting.popleft()
+                continue
 
             # Hash OUTSIDE the lock (long prompts hash thousands of blocks;
             # add_request/cancel must not stall behind it). Safe: this
@@ -1180,6 +1241,19 @@ class InferenceEngine:
                     "unconstrained"
                 )
                 return None
+            # Bounded memo: distinct schemas can be unbounded on a
+            # long-lived server (per-request enum values etc.) — evict
+            # oldest-inserted past the cap; live seqs keep their spec via
+            # seq.schema_spec, so eviction only costs a recompile. The
+            # row cache is swept of perm-degrade entries likewise (row
+            # entries are already bounded by the dynamic region + flush).
+            if len(self._schema_specs) >= 128:
+                self._schema_specs.pop(next(iter(self._schema_specs)))
+            if len(self._schema_row_cache) >= 8192:
+                # perm-degrade entries accumulate without consuming rows;
+                # recycle at the next step boundary (mid-step clears could
+                # overwrite a row another slot was just assigned).
+                self._schema_flush_pending = True
             self._schema_specs[key] = spec
         return spec
 
@@ -1381,20 +1455,44 @@ class InferenceEngine:
         candidates = [s for sl, s in self._running.items() if sl != exclude]
         if not candidates:
             return None
-        # Youngest first (least work lost on recompute).
-        return max(candidates, key=lambda s: s.req.arrival_time)
+        # Offline work is always sacrificed before online work; within a
+        # class, youngest first (least work lost on recompute).
+        offline = [s for s in candidates if s.req.offline]
+        pool = offline or candidates
+        return max(pool, key=lambda s: s.req.arrival_time)
 
-    def _preempt(self, seq: _Seq) -> None:
+    def _preempt_offline_for(self, head: EngineRequest) -> bool:
+        """Hybrid-scheduling preemption: an ONLINE head waiting on slots
+        or blocks evicts one RUNNING offline decode (recompute-style; the
+        victim requeues BEHIND online work and resumes when pressure
+        clears). Returns False when the head is itself offline or no
+        offline victim is running. Called WITHOUT self._lock held."""
+        if head.offline:
+            return False
+        victims = [s for s in self._running.values() if s.req.offline]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda s: s.req.arrival_time)
+        self._preempt(victim, requeue_front=False)
+        return True
+
+    def _preempt(self, seq: _Seq, requeue_front: bool = True) -> None:
         """Recompute-style preemption: release blocks and requeue the _Seq
         itself, preserving token history and generation accounting (KV is
-        recomputed on re-admission; prefix-cache blocks soften the cost)."""
+        recomputed on re-admission; prefix-cache blocks soften the cost).
+        Offline victims of online pressure requeue at the BACK
+        (requeue_front=False) so the admission partition keeps online
+        work ahead of them."""
         self.block_mgr.free(seq.block_ids)
         seq.block_ids = []
         seq.last_committed_block = -1
         del self._running[seq.slot]
         self._free_slots.append(seq.slot)
         with self._lock:
-            self._waiting.appendleft(seq)
+            if requeue_front:
+                self._waiting.appendleft(seq)
+            else:
+                self._waiting.append(seq)
 
     # ------------------------------------------------------------- commits
 

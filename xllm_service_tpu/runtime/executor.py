@@ -427,21 +427,39 @@ class ModelExecutor:
         full[:M] = table
         full[M + 1:] = False
         self._guided_table = jnp.asarray(full)
+        self._pending_guided_rows.clear()
         self.permissive_row = M
         self.dynamic_row_base = M + 1
         self.num_dynamic_rows = dynamic_rows
 
     def update_guided_row(self, row: int, bits: np.ndarray) -> None:
-        """Write one dynamic mask row (device-side functional update; the
-        table is a plain jit argument, never donated, so the new array
-        simply rides the next step)."""
-        self._guided_table = self._guided_table.at[row].set(
-            jnp.asarray(bits, dtype=bool)
-        )
+        """Stage one dynamic mask-row write. Writes are BUFFERED and
+        applied as a single batched .at[rows].set the next time the table
+        is consumed (guided_table property) — a per-row functional update
+        would copy the whole [M+1+D, V] device array once per newly
+        visited schema state (review finding, r4)."""
+        self._pending_guided_rows.append((row, np.asarray(bits, dtype=bool)))
+
+    @property
+    def _pending_guided_rows(self) -> list:
+        if not hasattr(self, "_pending_rows_buf"):
+            self._pending_rows_buf = []
+        return self._pending_rows_buf
+
+    def _flushed_guided_table(self):
+        pend = self._pending_guided_rows
+        if pend:
+            rows = jnp.asarray([r for r, _ in pend], jnp.int32)
+            bits = jnp.asarray(np.stack([b for _, b in pend]))
+            self._guided_table = self._guided_table.at[rows].set(bits)
+            pend.clear()
+        return self._guided_table
 
     @property
     def guided_table(self):
-        return getattr(self, "_guided_table", None)
+        if getattr(self, "_guided_table", None) is None:
+            return None
+        return self._flushed_guided_table()
 
     # ----------------------------------------------------------- sizing
 
@@ -785,7 +803,7 @@ class ModelExecutor:
         if batch.mask_rows is not None:
             bias_kwargs.update(
                 mask_rows=jnp.asarray(batch.mask_rows, jnp.int32),
-                guided_table=self._guided_table,
+                guided_table=self._flushed_guided_table(),
             )
         if batch.adapter_idx is not None:
             bias_kwargs.update(
@@ -941,7 +959,7 @@ class ModelExecutor:
                     rows[i] = it.mask_row
             pen_kwargs.update(
                 mask_rows=jnp.asarray(rows),
-                guided_table=self._guided_table,
+                guided_table=self._flushed_guided_table(),
             )
         if any(it.adapter_idx for it in group):
             pen_kwargs.update(
@@ -1258,7 +1276,7 @@ class ModelExecutor:
         if batch.mask_rows is not None:
             bias_kwargs.update(
                 mask_rows=jnp.asarray(batch.mask_rows, jnp.int32),
-                guided_table=self._guided_table,
+                guided_table=self._flushed_guided_table(),
             )
         if batch.adapter_idx is not None:
             bias_kwargs.update(
