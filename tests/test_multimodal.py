@@ -412,3 +412,263 @@ def test_siglip_matches_hf_reference(tmp_path):
         vision.encode_images(params, cfg_id, jnp.asarray(imgs)), np.float32
     )
     np.testing.assert_allclose(ours, hf_out, atol=2e-4, rtol=2e-4)
+
+
+# ----------------------------------------------- Qwen2-VL tower (r4)
+
+
+def test_qwen2vl_tower_roundtrip(tmp_path):
+    """qwen2vl-arch tower saves to the HF Qwen2-VL `visual.*` layout and
+    loads back bit-identically (config + every leaf)."""
+    from xllm_service_tpu.runtime import weights as W
+
+    cfg = vision.get_vision_config("qwen2vl-tiny")
+    params = vision.init_vision_params(cfg, jax.random.key(4), jnp.float32)
+    ckpt = str(tmp_path / "q2vl")
+    W.save_qwen2vl_visual(params, cfg, ckpt)
+    cfg2, params2 = W.load_vision_checkpoint(ckpt, dtype=jnp.float32)
+    assert cfg2.arch == "qwen2vl"
+    assert cfg2.hidden_size == cfg.hidden_size
+    assert cfg2.out_tokens == cfg.out_tokens
+    assert cfg2.out_dim == cfg.out_dim
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(params2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    rng = np.random.default_rng(0)
+    imgs = rng.random((1, cfg.image_size, cfg.image_size, 3)).astype(
+        np.float32
+    )
+    out = vision.encode_images(params2, cfg2, jnp.asarray(imgs))
+    assert out.shape == (1, cfg.out_tokens, cfg.out_dim)
+
+
+def test_qwen2vl_matches_hf_reference(tmp_path):
+    """Numerical parity with the HF transformers Qwen2VisionTransformer
+    on the same weights — tower, 2D rotary, AND the PatchMerger
+    projector (the full ViT+projector path of north-star config 4)."""
+    torch = pytest.importorskip("torch")
+    try:
+        from transformers.models.qwen2_vl.configuration_qwen2_vl import (
+            Qwen2VLVisionConfig,
+        )
+        from transformers.models.qwen2_vl.modeling_qwen2_vl import (
+            Qwen2VisionTransformerPretrainedModel,
+        )
+    except Exception:
+        pytest.skip("transformers lacks Qwen2-VL")
+
+    cfg = vision.get_vision_config("qwen2vl-tiny")
+    hf_cfg = Qwen2VLVisionConfig(
+        depth=cfg.num_layers,
+        embed_dim=cfg.hidden_size,
+        hidden_size=cfg.out_dim,
+        mlp_ratio=cfg.intermediate_size // cfg.hidden_size,
+        num_heads=cfg.num_heads,
+        patch_size=cfg.patch_size,
+        spatial_merge_size=cfg.spatial_merge_size,
+        temporal_patch_size=cfg.temporal_patch_size,
+        attn_implementation="eager",
+    )
+    with torch.no_grad():
+        hf = Qwen2VisionTransformerPretrainedModel(hf_cfg).eval().float()
+        tensors = {
+            "visual." + n: p.detach().numpy()
+            for n, p in hf.named_parameters()
+        }
+    from xllm_service_tpu.runtime import weights as W
+
+    ckpt = str(tmp_path / "hf-q2vl")
+    import json as _json
+    import os as _os
+
+    _os.makedirs(ckpt, exist_ok=True)
+    with open(_os.path.join(ckpt, "config.json"), "w") as f:
+        _json.dump({"model_type": "qwen2_vl", "vision_config": {
+            "model_type": "qwen2_vl",
+            "embed_dim": cfg.hidden_size,
+            "hidden_size": cfg.out_dim,
+            "depth": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "patch_size": cfg.patch_size,
+            "image_size": cfg.image_size,
+            "mlp_ratio": cfg.intermediate_size // cfg.hidden_size,
+            "spatial_merge_size": cfg.spatial_merge_size,
+            "temporal_patch_size": cfg.temporal_patch_size,
+        }}, f)
+    W.write_safetensors(_os.path.join(ckpt, "model.safetensors"), tensors)
+    loaded_cfg, params = W.load_vision_checkpoint(ckpt, dtype=jnp.float32)
+
+    rng = np.random.default_rng(9)
+    imgs = rng.random((1, cfg.image_size, cfg.image_size, 3)).astype(
+        np.float32
+    )
+    # Feed HF the SAME patch rows our arrangement builds (the HF
+    # processor's (h//m, w//m, mh, mw) order — Qwen2VisionTransformer's
+    # rot_pos_emb assumes it, so an arrangement mismatch would show up
+    # as a parity failure here).
+    from xllm_service_tpu.models.vision import _qwen2vl_patch_rows
+
+    rows, _, _ = _qwen2vl_patch_rows(jnp.asarray(imgs), cfg)
+    g = cfg.image_size // cfg.patch_size
+    with torch.no_grad():
+        hf_out = hf(
+            torch.from_numpy(np.asarray(rows[0], np.float32)),
+            grid_thw=torch.tensor([[1, g, g]]),
+        ).numpy()
+
+    ours = np.asarray(
+        vision.encode_images(params, loaded_cfg, jnp.asarray(imgs))[0],
+        np.float32,
+    )
+    np.testing.assert_allclose(ours, hf_out, atol=3e-4, rtol=3e-4)
+
+
+def test_qwen2vl_epd_e2e_with_real_tower(tmp_path):
+    """North-star config 4 with the REAL VLM family: a Qwen2-VL-arch
+    tower (HF visual.* checkpoint) as the ENCODE stage feeding media
+    embeddings into the LM through the full three-stage EPD HTTP path."""
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", "cpu")
+    from xllm_service_tpu.api import Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+    from xllm_service_tpu.runtime import weights as W
+    from tests.test_api_e2e import http_post, wait_until
+
+    cfg = vision.get_vision_config("qwen2vl-tiny")
+    params = vision.init_vision_params(cfg, jax.random.key(6), jnp.float32)
+    ckpt = str(tmp_path / "q2vl-tower")
+    W.save_qwen2vl_visual(params, cfg, ckpt)
+
+    store = MemoryStore(clock=lambda: 0.0)
+    scfg = ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0, block_size=16,
+        mm_tokens_per_media=cfg.out_tokens,  # qwen2vl-tiny: 4
+    )
+    master = Master(scfg, store=store)
+    master.start()
+
+    def mk(name, itype, model, ckpt_path=""):
+        ecfg = EngineConfig(
+            model=model, dtype="float32", block_size=16, num_blocks=64,
+            max_running_requests=4, max_seq_len=256,
+            prefill_buckets=[32, 64, 128], instance_name=name,
+            instance_type=itype, checkpoint_path=ckpt_path,
+        )
+        srv = InstanceServer(
+            ecfg, master_rpc_addr=master.rpc_address,
+            heartbeat_interval_s=0.2,
+        )
+        srv.start()
+        return srv
+
+    enc = mk("q2vl-e", "ENCODE", "qwen2vl-tiny", ckpt)
+    mix = mk("q2vl-m", "MIX", "llama3-tiny")
+    try:
+        assert wait_until(
+            lambda: master.scheduler.instance_mgr.counts()[2] == 1
+            and sum(master.scheduler.instance_mgr.counts()) == 2
+        )
+        # Strongly contrasting images: the tiny random tower maps mildly
+        # different photos to embeddings close enough that 6 greedy LM
+        # tokens can coincide; all-dark vs all-bright cannot.
+        img_a = np.full((cfg.image_size, cfg.image_size, 3), 0.95,
+                        np.float32)
+        img_b = np.zeros((cfg.image_size, cfg.image_size, 3), np.float32)
+
+        def ask(img):
+            code, body = http_post(
+                master.http_address, "/v1/chat/completions",
+                {"model": "llama3-tiny", "max_tokens": 8,
+                 "temperature": 0.0,
+                 "messages": [{"role": "user", "content": [
+                     {"type": "text", "text": "describe "},
+                     {"type": "image_url",
+                      "image_url": {"url": _raw_data_url(img)}},
+                 ]}]},
+                timeout=300.0,
+            )
+            assert code == 200, body
+            return body["choices"][0]["message"]["content"]
+
+        out_a, out_b = ask(img_a), ask(img_b)
+        assert out_a == ask(img_a)  # deterministic per image
+        assert out_a != out_b      # the Qwen2-VL embeddings reach the LM
+    finally:
+        enc.stop()
+        mix.stop()
+        master.stop()
+        store.close()
+
+
+def test_qwen2vl_combined_checkpoint_serves_both_sides(tmp_path):
+    """ONE Qwen2-VL checkpoint dir (architectures
+    Qwen2VLForConditionalGeneration, visual.* + model.* tensors): the LM
+    executor loads the text stack (Qwen2 layout, visual tensors skipped)
+    and the vision loader the tower — the reference deployment shape for
+    north-star config 4."""
+    import dataclasses
+    import json as _json
+    import os as _os
+
+    from xllm_service_tpu.common.config import EngineConfig
+    from xllm_service_tpu.models import llama
+    from xllm_service_tpu.models.configs import get_model_config
+    from xllm_service_tpu.runtime import weights as W
+    from xllm_service_tpu.runtime.executor import ModelExecutor
+
+    # Text side: qwen2-style (attn_bias) tiny stack.
+    lcfg = dataclasses.replace(
+        get_model_config("llama3-tiny"), name="q2vl-text", attn_bias=True
+    )
+    lparams = llama.init_params(lcfg, jax.random.key(1), dtype=jnp.float32)
+    ckpt = str(tmp_path / "q2vl-full")
+    W.save_hf_checkpoint(lparams, lcfg, ckpt)
+    # Vision side: qwen2vl tower tensors alongside (extra shard file).
+    vcfg = vision.get_vision_config("qwen2vl-tiny")
+    vparams = vision.init_vision_params(vcfg, jax.random.key(2), jnp.float32)
+    vtmp = str(tmp_path / "vis-only")
+    W.save_qwen2vl_visual(vparams, vcfg, vtmp)
+    import shutil
+
+    shutil.copy(
+        _os.path.join(vtmp, "model.safetensors"),
+        _os.path.join(ckpt, "model-visual.safetensors"),
+    )
+    # Combined config.json: VL architecture + vision_config.
+    with open(_os.path.join(ckpt, "config.json")) as f:
+        combined = _json.load(f)
+    with open(_os.path.join(vtmp, "config.json")) as f:
+        vis_cfg = _json.load(f)["vision_config"]
+    combined["architectures"] = ["Qwen2VLForConditionalGeneration"]
+    combined["model_type"] = "qwen2_vl"
+    combined["vision_config"] = vis_cfg
+    with open(_os.path.join(ckpt, "config.json"), "w") as f:
+        _json.dump(combined, f)
+
+    # Text side loads + serves.
+    cfg2 = W.config_from_hf(ckpt)
+    assert cfg2.attn_bias and cfg2.num_layers == lcfg.num_layers
+    ecfg = EngineConfig(
+        model="q2vl", dtype="float32", checkpoint_path=ckpt, block_size=16,
+        num_blocks=32, max_running_requests=2, max_seq_len=128,
+        prefill_buckets=[32],
+    )
+    ex = ModelExecutor(ecfg)
+    prompt = np.arange(1, 9, dtype=np.int32)
+    table = np.zeros((ex.max_blocks_per_seq,), np.int32)
+    table[0] = 1
+    tok, _ = ex.prefill(prompt, 0, table)
+    assert isinstance(tok, int)
+
+    # Vision side loads from the SAME dir with HF-exact weights.
+    vcfg2, vparams2 = W.load_vision_checkpoint(ckpt, dtype=jnp.float32)
+    assert vcfg2.arch == "qwen2vl"
+    img = np.full((vcfg2.image_size, vcfg2.image_size, 3), 0.5, np.float32)
+    out = vision.encode_images(vparams2, vcfg2, jnp.asarray(img[None]))
+    want = vision.encode_images(vparams, vcfg, jnp.asarray(img[None]))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(want), atol=1e-5, rtol=1e-5
+    )

@@ -47,8 +47,14 @@ class VisionConfig:
     # class token) so SigLIP-layout VLM checkpoints load weight-for-weight
     # (runtime/weights.load_vision_checkpoint; HF-parity-tested). CLIP
     # towers (class token, pre_layrnorm, quick_gelu) are NOT supported —
-    # the loader rejects their position-embedding shape.
+    # the loader rejects their position-embedding shape. "qwen2vl"
+    # matches the HF Qwen2VisionTransformer (2D rotary, fused biased QKV,
+    # QuickGELU, LayerNorm eps 1e-6, PatchMerger 2x2 -> LLM dim) —
+    # north-star config 4's named family, HF-parity-tested.
     arch: str = "rms"
+    # qwen2vl-only geometry (HF Qwen2VLVisionConfig names).
+    spatial_merge_size: int = 2
+    temporal_patch_size: int = 2
 
     @property
     def num_patches(self) -> int:
@@ -142,6 +148,44 @@ register_vision(
 )
 
 
+register_vision(
+    VisionConfig(
+        # Test-scale Qwen2-VL-arch tower (CI drives the HF-parity path;
+        # dims follow Qwen2VLVisionConfig ratios at toy size).
+        name="qwen2vl-tiny",
+        image_size=32,
+        patch_size=8,
+        hidden_size=64,          # embed_dim
+        intermediate_size=256,   # embed_dim * mlp_ratio(4)
+        num_layers=2,
+        num_heads=4,
+        out_tokens=4,            # (32/8)^2 / merge^2
+        out_dim=128,             # LM hidden (llama3-tiny / qwen2-tiny)
+        rms_norm_eps=1e-6,
+        arch="qwen2vl",
+    )
+)
+
+register_vision(
+    VisionConfig(
+        # HF Qwen/Qwen2-VL-7B-Instruct visual tower dims (fixed 448x448
+        # inputs here; the HF processor's native dynamic resolution maps
+        # to per-request grids — this config serves the square default).
+        name="qwen2-vl-7b-visual",
+        image_size=448,
+        patch_size=14,
+        hidden_size=1280,
+        intermediate_size=5120,
+        num_layers=32,
+        num_heads=16,
+        out_tokens=256,          # (448/14)^2 / 4
+        out_dim=3584,
+        rms_norm_eps=1e-6,
+        arch="qwen2vl",
+    )
+)
+
+
 def init_vision_params(cfg: VisionConfig, key, dtype=jnp.bfloat16) -> Params:
     keys = jax.random.split(key, 12)
     E, L = cfg.hidden_size, cfg.num_layers
@@ -154,6 +198,33 @@ def init_vision_params(cfg: VisionConfig, key, dtype=jnp.bfloat16) -> Params:
             jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)
         ).astype(dtype)
 
+    if cfg.arch == "qwen2vl":
+        F = cfg.intermediate_size
+        M = E * cfg.spatial_merge_size**2
+        qdim = patch_dim * cfg.temporal_patch_size
+        return {
+            "patch_embed": w(keys[0], (qdim, E), qdim),
+            "layers": {
+                "ln1_w": jnp.ones((L, E), jnp.float32),
+                "ln1_b": jnp.zeros((L, E), jnp.float32),
+                "wqkv": w(keys[2], (L, E, 3 * E), E),
+                "bqkv": jnp.zeros((L, 3 * E), dtype),
+                "wo": w(keys[3], (L, E, E), E),
+                "bo": jnp.zeros((L, E), dtype),
+                "ln2_w": jnp.ones((L, E), jnp.float32),
+                "ln2_b": jnp.zeros((L, E), jnp.float32),
+                "fc1": w(keys[4], (L, E, F), E),
+                "b1": jnp.zeros((L, F), dtype),
+                "fc2": w(keys[5], (L, F, E), F),
+                "b2": jnp.zeros((L, E), dtype),
+            },
+            "merger_ln_w": jnp.ones((E,), jnp.float32),
+            "merger_ln_b": jnp.zeros((E,), jnp.float32),
+            "merger_fc1": w(keys[6], (M, M), M),
+            "merger_b1": jnp.zeros((M,), dtype),
+            "merger_fc2": w(keys[7], (M, cfg.out_dim), M),
+            "merger_b2": jnp.zeros((cfg.out_dim,), dtype),
+        }
     if cfg.arch == "siglip":
         return {
             "patch_embed": w(keys[0], (patch_dim, E), patch_dim),
@@ -252,12 +323,114 @@ def _encode_siglip(
     )
 
 
+def _qwen2vl_patch_rows(images: jnp.ndarray, cfg: VisionConfig):
+    """HF Qwen2VLImageProcessor patch arrangement for a square still
+    image: rows ordered (h_group, w_group, merge_h, merge_w) so the
+    PatchMerger takes 4 CONSECUTIVE rows per output token; each row is
+    the [C, T, Ph, Pw] flattened patch with the single frame repeated to
+    temporal_patch_size. Returns (rows [B, N, C*T*P*P], h_ids, w_ids)."""
+    B, S, _, C = images.shape
+    P, m, T = cfg.patch_size, cfg.spatial_merge_size, cfg.temporal_patch_size
+    g = S // P
+    gg = g // m
+    x = images.reshape(B, gg, m, P, gg, m, P, C)
+    # -> [B, hg, wg, mh, mw, C, Ph, Pw]
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 7, 3, 6))
+    rows = x.reshape(B, g * g, C, 1, P, P)
+    rows = jnp.broadcast_to(
+        rows[:, :, :, None, 0], (B, g * g, C, T, P, P)
+    ).reshape(B, g * g, C * T * P * P)
+    import numpy as _np
+
+    hg, wg, mh, mw = _np.meshgrid(
+        _np.arange(gg), _np.arange(gg), _np.arange(m), _np.arange(m),
+        indexing="ij",
+    )
+    # match the row order (hg, wg, mh, mw)
+    h_ids = (hg * m + mh).reshape(-1)
+    w_ids = (wg * m + mw).reshape(-1)
+    return rows, h_ids, w_ids
+
+
+def _encode_qwen2vl(
+    params: Params, cfg: VisionConfig, images: jnp.ndarray
+) -> jnp.ndarray:
+    """HF Qwen2VisionTransformer: bias-free Conv3d patch embed (a matmul
+    over the flattened [C, T, P, P] patch), 2D rotary position embedding
+    over (h, w) patch ids, pre-LayerNorm blocks with fused biased QKV +
+    QuickGELU MLP, full (non-causal) attention over the image's patches,
+    then PatchMerger (ln_q -> 2x2 concat -> GELU MLP -> LLM dim).
+    Reference: transformers modeling_qwen2_vl.py."""
+    import numpy as _np
+
+    B = images.shape[0]
+    H, D = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    m2 = cfg.spatial_merge_size**2
+    rows, h_ids, w_ids = _qwen2vl_patch_rows(
+        images.astype(params["patch_embed"].dtype), cfg
+    )
+    x = jnp.einsum("bnp,pe->bne", rows, params["patch_embed"])  # [B, N, E]
+
+    # 2D rotary: VisionRotaryEmbedding(head_dim // 2) -> inv_freq of
+    # length head_dim//4 per axis; emb = cat(h_freqs, w_freqs) doubled.
+    hd4 = D // 4
+    inv = 1.0 / (
+        10000.0 ** (_np.arange(0, hd4, dtype=_np.float64) / hd4)
+    )
+    half = _np.concatenate(
+        [h_ids[:, None] * inv[None], w_ids[:, None] * inv[None]], axis=1
+    )  # [N, D/2]
+    emb = _np.concatenate([half, half], axis=1)  # [N, D]
+    cos = jnp.asarray(_np.cos(emb), jnp.float32)[None, :, None, :]
+    sin = jnp.asarray(_np.sin(emb), jnp.float32)[None, :, None, :]
+
+    def rot_half(t):
+        a, b = jnp.split(t, 2, axis=-1)
+        return jnp.concatenate([-b, a], axis=-1)
+
+    def layer_fn(x, lp):
+        h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], cfg.rms_norm_eps)
+        N = h.shape[1]
+        qkv = jnp.einsum("bne,ef->bnf", h, lp["wqkv"]) + lp["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, N, H, D).astype(jnp.float32)
+        k = k.reshape(B, N, H, D).astype(jnp.float32)
+        v = v.reshape(B, N, H, D).astype(jnp.float32)
+        q = q * cos + rot_half(q) * sin
+        k = k * cos + rot_half(k) * sin
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D**-0.5)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        attn = attn.reshape(B, N, -1).astype(x.dtype)
+        x = x + jnp.einsum("bne,ef->bnf", attn, lp["wo"]) + lp["bo"]
+        h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], cfg.rms_norm_eps)
+        h = jnp.einsum("bne,ef->bnf", h, lp["fc1"]) + lp["b1"]
+        h = h * jax.nn.sigmoid(1.702 * h)  # QuickGELU
+        x = x + jnp.einsum("bnf,fe->bne", h, lp["fc2"]) + lp["b2"]
+        return x, None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    x = layer_norm(
+        x, params["merger_ln_w"], params["merger_ln_b"], cfg.rms_norm_eps
+    )
+    N = x.shape[1]
+    x = x.reshape(B, N // m2, m2 * cfg.hidden_size)
+    h = jnp.einsum("bnm,mf->bnf", x, params["merger_fc1"]) + params["merger_b1"]
+    h = jax.nn.gelu(h, approximate=False)  # nn.GELU default: exact erf
+    return (
+        jnp.einsum("bnf,fd->bnd", h, params["merger_fc2"])
+        + params["merger_b2"]
+    )
+
+
 def encode_images(
     params: Params, cfg: VisionConfig, images: jnp.ndarray
 ) -> jnp.ndarray:
     """[B, S, S, 3] float in [0, 1] -> media tokens [B, out_tokens, out_dim]."""
     if cfg.arch == "siglip":
         return _encode_siglip(params, cfg, images)
+    if cfg.arch == "qwen2vl":
+        return _encode_qwen2vl(params, cfg, images)
     B = images.shape[0]
     H, D = cfg.num_heads, cfg.hidden_size // cfg.num_heads
     x = _patchify(images.astype(params["patch_embed"].dtype), cfg.patch_size)

@@ -159,6 +159,16 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
         hf = json.load(f)
     archs = hf.get("architectures") or ["LlamaForCausalLM"]
     arch = archs[0]
+    if arch == "Qwen2VLForConditionalGeneration":
+        # Qwen2-VL: the text tower is a plain Qwen2 stack (the `visual.*`
+        # tensors load separately via load_vision_checkpoint); newer HF
+        # configs nest the text fields under text_config. Image spans are
+        # served with sequential (LLaVA-style) positions — HF's grid
+        # M-RoPE collapses to standard RoPE whenever the three position
+        # components are equal, which holds for all text tokens and every
+        # decode step, so text requests are HF-exact (docs/ARCHITECTURE).
+        hf = {**hf, **(hf.get("text_config") or {})}
+        arch = "Qwen2ForCausalLM"
     num_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hf["hidden_size"] // num_heads
     common = dict(
@@ -576,6 +586,8 @@ def vision_config_from_hf(path: str, out_dim: int = 0):
     with open(os.path.join(path, "config.json")) as f:
         hf = json.load(f)
     vc = hf.get("vision_config", hf)
+    if vc.get("model_type") == "qwen2_vl" or "embed_dim" in vc:
+        return _qwen2vl_vision_config(hf, vc, out_dim)
     image_size = int(vc["image_size"])
     patch = int(vc["patch_size"])
     if image_size % patch:
@@ -597,6 +609,170 @@ def vision_config_from_hf(path: str, out_dim: int = 0):
         rms_norm_eps=float(vc.get("layer_norm_eps", 1e-6)),
         arch="siglip",
     )
+
+
+def _qwen2vl_vision_config(hf: dict, vc: dict, out_dim: int = 0):
+    """VisionConfig for an HF Qwen2VLVisionConfig dict (embed_dim is the
+    tower width; vision_config.hidden_size is the LLM dim the PatchMerger
+    projects into). The HF processor's dynamic resolution maps to
+    per-request grids; this serving path fixes a square input size
+    (image_size keyword in vision_config, else 448 — 32x32 patches)."""
+    from xllm_service_tpu.models.vision import VisionConfig
+
+    E = int(vc["embed_dim"])
+    merge = int(vc.get("spatial_merge_size", 2))
+    image_size = int(vc.get("image_size", 448))
+    patch = int(vc["patch_size"])
+    if image_size % patch:
+        raise ValueError(
+            f"image_size {image_size} not divisible by patch_size {patch}"
+        )
+    grid = image_size // patch
+    if grid % merge:
+        raise ValueError(
+            f"image_size {image_size} / patch {patch} not divisible by "
+            f"spatial_merge_size {merge}"
+        )
+    return VisionConfig(
+        name="qwen2_vl-visual",
+        image_size=image_size,
+        patch_size=patch,
+        hidden_size=E,
+        intermediate_size=int(E * float(vc.get("mlp_ratio", 4))),
+        num_layers=int(vc["depth"]),
+        num_heads=int(vc["num_heads"]),
+        out_tokens=grid * grid // (merge * merge),
+        out_dim=out_dim or int(vc.get("hidden_size") or E),
+        rms_norm_eps=1e-6,  # HF hardcodes LayerNorm(eps=1e-6)
+        arch="qwen2vl",
+        spatial_merge_size=merge,
+        temporal_patch_size=int(vc.get("temporal_patch_size", 2)),
+    )
+
+
+# HF Qwen2VisionTransformer layer tensor name -> (leaf key, transpose).
+_QWEN2VL_LAYER = {
+    "norm1.weight": ("ln1_w", False),
+    "norm1.bias": ("ln1_b", False),
+    "attn.qkv.weight": ("wqkv", True),
+    "attn.qkv.bias": ("bqkv", False),
+    "attn.proj.weight": ("wo", True),
+    "attn.proj.bias": ("bo", False),
+    "norm2.weight": ("ln2_w", False),
+    "norm2.bias": ("ln2_b", False),
+    "mlp.fc1.weight": ("fc1", True),
+    "mlp.fc1.bias": ("b1", False),
+    "mlp.fc2.weight": ("fc2", True),
+    "mlp.fc2.bias": ("b2", False),
+}
+_QWEN2VL_SIMPLE = {
+    "visual.merger.ln_q.weight": ("merger_ln_w", False, np.float32),
+    "visual.merger.ln_q.bias": ("merger_ln_b", False, np.float32),
+    "visual.merger.mlp.0.weight": ("merger_fc1", True, None),
+    "visual.merger.mlp.0.bias": ("merger_b1", False, None),
+    "visual.merger.mlp.2.weight": ("merger_fc2", True, None),
+    "visual.merger.mlp.2.bias": ("merger_b2", False, None),
+}
+
+
+def _load_qwen2vl_visual(path: str, cfg, dtype, np_dtype):
+    """Qwen2-VL `visual.*` tower -> the models/vision.py qwen2vl pytree.
+    Conv3d patch embed [E, C, T, P, P] flattens to the [(C, T, Ph, Pw), E]
+    matmul layout (_qwen2vl_patch_rows builds rows in exactly that
+    order)."""
+    from xllm_service_tpu.models.vision import init_vision_params
+
+    E, L, P = cfg.hidden_size, cfg.num_layers, cfg.patch_size
+    T = cfg.temporal_patch_size
+    # Stage over EMPTY buffers shaped by init (no random generation —
+    # unlike the SigLIP path, every tensor must land or this raises, so
+    # values are always overwritten; a 675M-param tower shouldn't pay a
+    # full random init to be discarded).
+    params = jax.tree.map(
+        lambda x: np.empty(x.shape, x.dtype),
+        jax.eval_shape(
+            lambda: init_vision_params(cfg, jax.random.key(0), dtype)
+        ),
+    )
+    needed = {"patch_embed"} | {k for k, _, _ in _QWEN2VL_SIMPLE.values()}
+    needed |= {f"layers.{k}" for k, _ in _QWEN2VL_LAYER.values()}
+    landed = set()
+    layer_seen = {
+        f"layers.{k}": np.zeros(L, bool) for k, _ in _QWEN2VL_LAYER.values()
+    }
+    for file in _shard_files(path):
+        for name, arr in read_safetensors(file):
+            if not name.startswith("visual."):
+                continue
+            if name == "visual.patch_embed.proj.weight":
+                w = np.asarray(arr).reshape(E, 3 * T * P * P).T
+                params["patch_embed"] = w.astype(np_dtype)
+                landed.add("patch_embed")
+            elif name in _QWEN2VL_SIMPLE:
+                key, transpose, want = _QWEN2VL_SIMPLE[name]
+                src = np.asarray(arr).T if transpose else np.asarray(arr)
+                params[key] = src.astype(want or np_dtype)
+                landed.add(key)
+            elif name.startswith("visual.blocks."):
+                rest = name[len("visual.blocks."):]
+                layer_s, _, tail = rest.partition(".")
+                if tail in _QWEN2VL_LAYER:
+                    key, transpose = _QWEN2VL_LAYER[tail]
+                    src = arr.T if transpose else arr
+                    buf = params["layers"][key]
+                    np.copyto(buf[int(layer_s)], src, casting="unsafe")
+                    layer_seen[f"layers.{key}"][int(layer_s)] = True
+    for k, seen in layer_seen.items():
+        if seen.all():
+            landed.add(k)
+    missing = sorted(needed - landed)
+    if missing:
+        raise ValueError(
+            f"qwen2vl visual checkpoint {path} missing tensors: {missing}"
+        )
+    return cfg, jax.tree.map(jnp.asarray, params)
+
+
+def save_qwen2vl_visual(params, cfg, path: str) -> None:
+    """Inverse of the qwen2vl branch of load_vision_checkpoint (HF
+    Qwen2-VL `visual.*` layout) — round-trip tested; exports synthetic
+    towers for CI."""
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(
+            {
+                "model_type": "qwen2_vl",
+                "vision_config": {
+                    "model_type": "qwen2_vl",
+                    "embed_dim": cfg.hidden_size,
+                    "hidden_size": cfg.out_dim,
+                    "depth": cfg.num_layers,
+                    "num_heads": cfg.num_heads,
+                    "patch_size": cfg.patch_size,
+                    "image_size": cfg.image_size,
+                    "mlp_ratio": cfg.intermediate_size / cfg.hidden_size,
+                    "spatial_merge_size": cfg.spatial_merge_size,
+                    "temporal_patch_size": cfg.temporal_patch_size,
+                },
+            },
+            f, indent=2,
+        )
+
+    E, P, T = cfg.hidden_size, cfg.patch_size, cfg.temporal_patch_size
+    lp = params["layers"]
+    arrays = {
+        "visual.patch_embed.proj.weight": np.asarray(
+            params["patch_embed"]
+        ).T.reshape(E, 3, T, P, P),
+    }
+    for name, (key, transpose, _w) in _QWEN2VL_SIMPLE.items():
+        a = np.asarray(params[key])
+        arrays[name] = a.T if transpose else a
+    for i in range(cfg.num_layers):
+        for tail, (key, transpose) in _QWEN2VL_LAYER.items():
+            a = np.asarray(lp[key][i])
+            arrays[f"visual.blocks.{i}.{tail}"] = a.T if transpose else a
+    write_safetensors(os.path.join(path, "model.safetensors"), arrays)
 
 
 # HF SiglipVisionModel tensor name -> (leaf key, transpose). Layer leaves
@@ -642,6 +818,8 @@ def load_vision_checkpoint(
 
     cfg = cfg or vision_config_from_hf(path, out_dim=out_dim)
     np_dtype = ml_dtypes.bfloat16 if dtype == jnp.bfloat16 else np.dtype(dtype)
+    if cfg.arch == "qwen2vl":
+        return _load_qwen2vl_visual(path, cfg, dtype, np_dtype)
     E, L, P = cfg.hidden_size, cfg.num_layers, cfg.patch_size
 
     # Stage over random init so an absent projector keeps a usable leaf;
