@@ -1,14 +1,34 @@
 #!/usr/bin/env python
 """Burst-trace serving benchmark (SURVEY.md §7 stage 8).
 
-Replays a synthetic ShareGPT-shaped trace — Poisson arrivals, lognormal
-prompt/output lengths — against an in-process cluster (master + N
-instances over real sockets) and reports TTFT/TPOT/throughput percentiles
-as ONE JSON line. Default backend is the fake engine (isolates the
-service tier); --real-engine serves the actual JAX engine (llama3-tiny on
-CPU, llama3-1b on TPU).
+Replays a ShareGPT-class trace against an in-process cluster (master +
+N instances over real sockets) and reports TTFT/TPOT/throughput
+percentiles as ONE JSON line. Three trace sources:
 
-    python bench_serving.py --requests 64 --rate 32
+  * --trace PATH: a REAL ShareGPT-format JSON (list of {"conversations":
+    [{"from": "human", "value": ...}, {"from": "gpt", ...}, ...]});
+    prompt text comes from the first human turn, the output budget from
+    the first gpt reply's length.
+  * default synthetic: lognormal token lengths FITTED to the published
+    ShareGPT distribution (prompt median ~100 tokens / heavy tail,
+    output median ~120 — the vLLM-paper trace shape), Poisson arrivals.
+    Lengths clamp to the backend's max_seq_len.
+  * --offline-frac F marks a fraction of requests `offline: true`,
+    exercising hybrid scheduling (master parking + engine preemption)
+    under the same burst.
+
+Fault injection: --kill-at F crashes one instance (heartbeats + HTTP
+drop, NO deregistration — api/instance.crash) after F of the requests
+have been dispatched; the report then carries the master's re-dispatch
+count and per-class error totals. The reference only PROMISES automatic
+rescheduling (README.md:46); here it is measured.
+
+Default backend is the fake engine (isolates the service tier);
+--real-engine serves the actual JAX engine (llama3-tiny on CPU,
+llama3-1b on TPU).
+
+    python bench_serving.py --requests 512 --rate 64
+    python bench_serving.py --requests 512 --rate 64 --kill-at 0.4
     python bench_serving.py --real-engine --requests 16 --rate 4
 """
 
@@ -20,6 +40,47 @@ import threading
 import time
 
 
+def load_sharegpt(path: str, n: int, rng):
+    """(prompt_text, out_tokens) pairs from a ShareGPT-format JSON."""
+    with open(path) as f:
+        data = json.load(f)
+    pairs = []
+    for conv in data:
+        turns = conv.get("conversations") or []
+        human = next((t["value"] for t in turns if t.get("from") == "human"), None)
+        reply = next((t["value"] for t in turns if t.get("from") == "gpt"), None)
+        if human and reply:
+            pairs.append((human, max(len(reply) // 4, 4)))
+    if not pairs:
+        raise SystemExit(f"{path}: no usable conversations")
+    idx = rng.integers(0, len(pairs), size=n)
+    return [pairs[i] for i in idx]
+
+
+def synthetic_sharegpt(n: int, rng, max_prompt: int, max_out: int,
+                       word_mode: bool = False):
+    """Lognormal fits to the public ShareGPT token statistics (heavy
+    upper tail on both sides). word_mode (real tokenizers) emits n
+    DISTINCT words — ~1+ BPE token each — instead of a repeated-char
+    string a BPE tokenizer would collapse to a fraction of the intended
+    length; the fake engine's byte tokenizer sees chars == tokens."""
+    p_tok = rng.lognormal(mean=4.6, sigma=1.0, size=n)
+    o_tok = rng.lognormal(mean=4.8, sigma=0.9, size=n)
+    prompts = []
+    for p in p_tok:
+        ln = int(min(max(p, 4), max_prompt))
+        if word_mode:
+            # short numeric words tokenize to ~2 BPE tokens each; halve
+            # the word count so the prompt lands near `ln` tokens
+            prompts.append(
+                " ".join(str(i % 997) for i in range(max(ln // 2, 2)))
+            )
+        else:
+            prompts.append("w" * ln)
+    outs = [int(min(max(o, 4), max_out)) for o in o_tok]
+    return list(zip(prompts, outs))
+
+
 def main() -> None:
     p = argparse.ArgumentParser("xllm-service-tpu burst bench")
     p.add_argument("--requests", type=int, default=64)
@@ -28,6 +89,12 @@ def main() -> None:
     p.add_argument("--real-engine", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--policy", default="RR", choices=["RR", "CAR", "SLO_AWARE"])
+    p.add_argument("--trace", default="", help="ShareGPT-format JSON path")
+    p.add_argument("--offline-frac", type=float, default=0.0)
+    p.add_argument(
+        "--kill-at", type=float, default=0.0,
+        help="crash one instance after this fraction of requests dispatched",
+    )
     args = p.parse_args()
 
     import os
@@ -53,6 +120,7 @@ def main() -> None:
         host="127.0.0.1", http_port=0, rpc_port=0,
         heartbeat_interval_s=1.0, master_lease_ttl_s=3.0,
         load_balance_policy=args.policy, block_size=16,
+        detect_disconnected_instance_interval_s=2.0,
     )
     master = Master(cfg, store=store)
     master.start()
@@ -76,6 +144,8 @@ def main() -> None:
                     [256, 512, 1024, 2048] if on_tpu else [64, 128, 256]
                 ),
                 instance_name=f"bench{i}", instance_type="MIX",
+                # persistent jit cache: repeat runs skip the compiles
+                compilation_cache_dir="/tmp/xllm-jit-cache",
             )
             srv = InstanceServer(
                 ecfg, master_rpc_addr=master.rpc_address,
@@ -100,16 +170,31 @@ def main() -> None:
             break
         time.sleep(0.05)
 
-    # Trace: lognormal prompt chars / output tokens, Poisson arrivals.
-    prompt_lens = np.clip(
-        rng.lognormal(mean=4.0, sigma=0.6, size=args.requests), 16, 180
-    ).astype(int)
-    out_lens = np.clip(
-        rng.lognormal(mean=2.6, sigma=0.5, size=args.requests), 4, 48
-    ).astype(int)
+    # Trace.
+    if args.real_engine and not on_tpu:
+        max_prompt, max_out = 180, 48  # tiny-model max_seq_len budget
+    elif args.real_engine:
+        max_prompt, max_out = 1500, 256
+    else:
+        max_prompt, max_out = 1024, 512
+    if args.trace:
+        pairs = load_sharegpt(args.trace, args.requests, rng)
+        pairs = [
+            (t[:max_prompt], min(o, max_out)) for t, o in pairs
+        ]
+    else:
+        pairs = synthetic_sharegpt(
+            args.requests, rng, max_prompt, max_out,
+            word_mode=args.real_engine,
+        )
+    offline_mask = rng.random(args.requests) < args.offline_frac
     gaps = rng.exponential(1.0 / args.rate, size=args.requests)
+    kill_idx = (
+        int(args.kill_at * args.requests) if args.kill_at > 0 else -1
+    )
 
     ttfts, tpots, lats, errors = [], [], [], []
+    off_ttfts, on_ttfts = [], []
     first_tokens = [0]
     mu = threading.Lock()
 
@@ -120,17 +205,17 @@ def main() -> None:
             import http.client
 
             conn = http.client.HTTPConnection(host, int(port), timeout=300.0)
+            body = {
+                "model": model if args.real_engine else "fake-echo",
+                "prompt": pairs[i][0],
+                "max_tokens": int(pairs[i][1]),
+                "temperature": 0.0,
+                "stream": True,
+            }
+            if offline_mask[i]:
+                body["offline"] = True
             conn.request(
-                "POST", "/v1/completions",
-                body=json.dumps(
-                    {
-                        "model": model if args.real_engine else "fake-echo",
-                        "prompt": "w" * int(prompt_lens[i]),
-                        "max_tokens": int(out_lens[i]),
-                        "temperature": 0.0,
-                        "stream": True,
-                    }
-                ).encode(),
+                "POST", "/v1/completions", body=json.dumps(body).encode(),
                 headers={"Content-Type": "application/json"},
             )
             resp = conn.getresponse()
@@ -138,12 +223,18 @@ def main() -> None:
             n_tok = 0
             t_first = t_last = None
             deltas = []
+            stream_err = ""
             for raw in resp:
                 line = raw.decode().strip()
                 if not line.startswith("data: "):
                     continue
                 payload = line[len("data: "):]
                 if payload == "[DONE]":
+                    break
+                if '"error"' in payload:
+                    # mid-stream error event (e.g. instance died after
+                    # tokens reached us — not replayable): fault-visible
+                    stream_err = payload[:200]
                     break
                 now = time.monotonic()
                 if t_first is None:
@@ -156,26 +247,39 @@ def main() -> None:
             with mu:
                 if t_first is not None:
                     ttfts.append(t_first - t0)
+                    (off_ttfts if offline_mask[i] else on_ttfts).append(
+                        t_first - t0
+                    )
                 tpots.extend(deltas)
                 lats.append(time.monotonic() - t0)
                 first_tokens[0] += n_tok
+                if stream_err:
+                    errors.append(stream_err)
         except Exception as e:  # noqa: BLE001
             with mu:
                 errors.append(repr(e))
 
     threads = []
     t_start = time.monotonic()
+    killed_at_s = None
     for i in range(args.requests):
         time.sleep(float(gaps[i]))
+        if i == kill_idx and len(instances) > 1:
+            instances[-1].crash()
+            killed_at_s = round(time.monotonic() - t_start, 3)
         t = threading.Thread(target=drive, args=(i,))
         t.start()
         threads.append(t)
     for t in threads:
         t.join(timeout=600.0)
     wall = time.monotonic() - t_start
+    redispatches = master.scheduler.total_redispatches
 
     for srv in instances:
-        srv.stop()
+        try:
+            srv.stop()
+        except Exception:
+            pass
     master.stop()
     store.close()
 
@@ -192,7 +296,9 @@ def main() -> None:
                     else "fake"
                 ),
                 "policy": args.policy,
+                "trace": args.trace or "synthetic-sharegpt",
                 "requests": args.requests,
+                "offline_frac": args.offline_frac,
                 "errors": len(errors),
                 "rate_req_s": args.rate,
                 "wall_s": round(wall, 3),
@@ -200,6 +306,8 @@ def main() -> None:
                 "throughput_tok_s": round(first_tokens[0] / wall, 1),
                 "ttft_p50_s": pct(ttfts, 50),
                 "ttft_p99_s": pct(ttfts, 99),
+                "online_ttft_p99_s": pct(on_ttfts, 99),
+                "offline_ttft_p99_s": pct(off_ttfts, 99),
                 "tpot_p50_ms": (
                     round(1000 * float(np.percentile(tpots, 50)), 2)
                     if tpots else None
@@ -209,6 +317,8 @@ def main() -> None:
                     if tpots else None
                 ),
                 "req_p99_s": pct(lats, 99),
+                "killed_instance_at_s": killed_at_s,
+                "redispatches": redispatches,
             }
         )
     )

@@ -1,0 +1,178 @@
+"""EtcdGatewayStore against a REAL etcd binary (VERDICT r3 #7).
+
+The fake-gateway suite (tests/test_etcd_gateway.py) pins the wire
+protocol; this suite validates the semantics only real etcd enforces —
+server-side lease TTL expiry, the v3 watch stream, compare-create txns
+under contention, and master failover driven by a real lease lapsing.
+
+The build image ships no etcd and installs are off, so the suite
+auto-skips unless an `etcd` binary is on PATH or named by
+XLLM_ETCD_BIN. Run it wherever etcd exists:
+
+    XLLM_ETCD_BIN=/usr/local/bin/etcd python -m pytest tests/test_etcd_real.py
+
+Reference semantics being matched: etcd_client.cpp:47-62 (TTL-lease
+compare-create election), :90-99 (guarded txn deletes), :156-193
+(watch streams).
+"""
+
+import os
+import shutil
+import socket
+import subprocess
+import time
+
+import pytest
+
+from xllm_service_tpu.coordination.store import EtcdGatewayStore, EventType
+
+ETCD = os.environ.get("XLLM_ETCD_BIN") or shutil.which("etcd")
+
+pytestmark = pytest.mark.skipif(
+    ETCD is None,
+    reason="no etcd binary (set XLLM_ETCD_BIN or put etcd on PATH)",
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture()
+def etcd_addr(tmp_path):
+    client = _free_port()
+    peer = _free_port()
+    proc = subprocess.Popen(
+        [
+            ETCD,
+            "--data-dir", str(tmp_path / "etcd-data"),
+            "--listen-client-urls", f"http://127.0.0.1:{client}",
+            "--advertise-client-urls", f"http://127.0.0.1:{client}",
+            "--listen-peer-urls", f"http://127.0.0.1:{peer}",
+            "--initial-advertise-peer-urls", f"http://127.0.0.1:{peer}",
+            "--initial-cluster", f"default=http://127.0.0.1:{peer}",
+        ],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    addr = f"127.0.0.1:{client}"
+    try:
+        deadline = time.monotonic() + 20
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                EtcdGatewayStore(addr)  # ctor pings
+                break
+            except Exception as e:  # noqa: BLE001
+                last = e
+                time.sleep(0.2)
+        else:
+            raise RuntimeError(f"etcd never came up: {last}")
+        yield addr
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def _wait(pred, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def test_kv_txn_and_prefix_real(etcd_addr):
+    st = EtcdGatewayStore(etcd_addr)
+    assert st.get("missing") is None
+    st.set("XLLM:PREFILL:a", "1")
+    st.set("XLLM:PREFILL:b", '{"x": "ünïcode"}')
+    assert st.get("XLLM:PREFILL:b") == '{"x": "ünïcode"}'
+    assert st.get_prefix("XLLM:PREFILL:") == {
+        "XLLM:PREFILL:a": "1",
+        "XLLM:PREFILL:b": '{"x": "ünïcode"}',
+    }
+    # compare-create under contention: exactly one winner
+    assert st.compare_create("XLLM:SERVICE:MASTER", "m1")
+    assert not st.compare_create("XLLM:SERVICE:MASTER", "m2")
+    # guarded removes re-check the guard (etcd_client.cpp:90-99)
+    st.set("guard", "me")
+    st.set("a", "1")
+    assert not st.guarded_remove(["a"], "guard", "not-me")
+    assert st.get("a") == "1"
+    assert st.guarded_remove(["a"], "guard", "me")
+    assert st.get("a") is None
+
+
+def test_real_lease_ttl_expires_key(etcd_addr):
+    """Real server-side TTL: a key under an un-kept lease vanishes after
+    the TTL (the liveness mechanism instance registration rides)."""
+    st = EtcdGatewayStore(etcd_addr)
+    lid = st.grant_lease(1.0)  # etcd clamps to >= 1s
+    st.set("XLLM:MIX:inst0", "meta", lease_id=lid)
+    assert st.get("XLLM:MIX:inst0") == "meta"
+    assert st.keepalive(lid)
+    assert _wait(lambda: st.get("XLLM:MIX:inst0") is None, timeout=20.0)
+    assert not st.keepalive(lid)
+
+
+def test_real_watch_stream(etcd_addr):
+    st = EtcdGatewayStore(etcd_addr)
+    got = []
+    wid = st.add_watch("XLLM:WATCHME:", lambda evs: got.extend(evs))
+    time.sleep(0.5)
+    st.set("XLLM:WATCHME:a", "v1")
+    st.set("XLLM:OTHER:z", "ignored")
+    st.remove("XLLM:WATCHME:a")
+    assert _wait(lambda: len(got) >= 2)
+    assert got[0].type == EventType.PUT and got[0].value == "v1"
+    assert got[1].type == EventType.DELETE
+    assert all(not e.key.startswith("XLLM:OTHER") for e in got)
+    st.remove_watch(wid)
+
+
+def test_real_lease_expiry_fires_watch_delete(etcd_addr):
+    """The full failure-detection chain on real etcd: lease lapses ->
+    etcd deletes the key -> the watch stream delivers DELETE (what
+    drives instance removal + request re-dispatch)."""
+    st = EtcdGatewayStore(etcd_addr)
+    got = []
+    st.add_watch("XLLM:MIX:", lambda evs: got.extend(evs))
+    time.sleep(0.5)
+    lid = st.grant_lease(1.0)
+    st.set("XLLM:MIX:dying", "meta", lease_id=lid)
+    assert _wait(
+        lambda: any(
+            e.type == EventType.DELETE and e.key == "XLLM:MIX:dying"
+            for e in got
+        ),
+        timeout=20.0,
+    )
+
+
+def test_real_master_failover(etcd_addr):
+    """Two MasterElection replicas on real etcd: one wins; when it stops
+    keeping its lease alive, the real TTL lapses and the other takes
+    over via its watch."""
+    from xllm_service_tpu.coordination import MasterElection
+
+    e1 = MasterElection(
+        EtcdGatewayStore(etcd_addr), "replica-1", lease_ttl_s=1.0
+    )
+    e2 = MasterElection(
+        EtcdGatewayStore(etcd_addr), "replica-2", lease_ttl_s=1.0
+    )
+    e1.start()
+    assert _wait(lambda: e1.is_master)
+    e2.start()
+    time.sleep(0.5)
+    assert not e2.is_master
+    # CRASH, not graceful stop (stop() revokes the lease): cease
+    # keepalives and let the REAL server-side TTL lapse.
+    e1._stop.set()
+    assert _wait(lambda: e2.is_master, timeout=30.0)
+    e1.stop()
+    e2.stop()

@@ -168,3 +168,85 @@ def test_midstream_death_errors_cleanly():
         assert "died mid-generation" in body["error"]["message"]
     finally:
         master.stop(); store.close()
+
+
+def test_crash_kills_midstream_with_error_event():
+    """InstanceServer.crash() (bench fault injection) is a REAL crash:
+    mid-stream requests stop receiving tokens and get an explicit
+    UNAVAILABLE error event after removal — never a fabricated [DONE]
+    (review finding, r4: the push channel must die with the instance)."""
+    import http.client
+    import json as _json
+
+    from xllm_service_tpu.api import FakeEngine, Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+
+    store = MemoryStore()
+    master = Master(
+        ServiceConfig(
+            host="127.0.0.1", http_port=0, rpc_port=0,
+            heartbeat_interval_s=0.5, master_lease_ttl_s=1.5,
+            block_size=16, detect_disconnected_instance_interval_s=0.5,
+        ),
+        store=store,
+    )
+    master.start()
+    srv = InstanceServer(
+        EngineConfig(model="fake-echo", instance_name="cr0",
+                     instance_type="MIX", block_size=16),
+        master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.5,
+        engine=FakeEngine(token_delay_s=0.2, ttft_ms=10.0),
+    )
+    srv.start()
+    try:
+        assert wait_until(
+            lambda: sum(master.scheduler.instance_mgr.counts()) == 1
+        )
+        result = {}
+
+        def client():
+            host, _, port = master.http_address.partition(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=60)
+            conn.request(
+                "POST", "/v1/completions",
+                body=_json.dumps({
+                    "model": "fake-echo", "prompt": "x" * 40,
+                    "max_tokens": 40, "stream": True,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            toks, err, done = 0, "", False
+            for raw in resp:
+                s = raw.decode().strip()
+                if not s.startswith("data: "):
+                    continue
+                p = s[6:]
+                if p == "[DONE]":
+                    done = True
+                    break
+                if '"error"' in p:
+                    err = p
+                    break
+                toks += 1
+            result.update(toks=toks, err=err, done=done)
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        # wait until some tokens streamed (0.2 s/token x 40 = 8 s total)
+        assert wait_until(
+            lambda: master.scheduler.num_inflight == 1, timeout=20.0
+        )
+        time.sleep(1.0)
+        srv.crash()
+        t.join(timeout=30.0)
+        assert not t.is_alive()
+        assert result["err"], result  # explicit mid-stream error event
+        assert not result["done"]     # and never a fabricated [DONE]
+        assert 0 < result["toks"] < 40
+    finally:
+        srv.stop()
+        master.stop()
+        store.close()

@@ -132,6 +132,16 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
             tp_size=engine_cfg.tp_size,
             lora_adapters=sorted(self.lora_names),
         )
+        # Fixed-role instances SERVE their declared role from beat one —
+        # the field otherwise defaults to PREFILL and an ENCODE instance
+        # would heartbeat a role mismatch the master can never reconcile
+        # (/flip only swaps PREFILL<->DECODE), looping flip notifications
+        # forever. MIX keeps the default: the master assigns its first
+        # serving role and the reconciliation beat self-heals.
+        if self.meta.type in (
+            InstanceType.PREFILL, InstanceType.DECODE, InstanceType.ENCODE
+        ):
+            self.meta.current_type = self.meta.type
         ttft, tpot = self.engine.profiling_data()
         self.meta.ttft_profiling_data = ttft
         self.meta.tpot_profiling_data = tpot
@@ -228,6 +238,26 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
             self._heartbeat.start()
         logger.info("instance %s serving on :%d", self.name, self.http.port)
 
+    def crash(self) -> None:
+        """UNGRACEFUL death for fault-injection tests/benches: heartbeats
+        stop, the HTTP server drops (in-flight requests included), the
+        engine halts, and the generations push channel goes silent — all
+        with NO deregistration. The master learns via lease expiry /
+        disconnected pruning exactly as for a crashed engine process;
+        mid-stream requests die (error-finish after removal) instead of
+        quietly completing through a still-alive push loop. A later
+        stop() still runs the remaining thread teardown."""
+        self._crashed = True  # push loop drops everything from here on
+        with _LOCAL_MU:
+            if _LOCAL_INSTANCES.get(self.name) is self:
+                del _LOCAL_INSTANCES[self.name]
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+        if not getattr(self, "_http_stopped", False):
+            self._http_stopped = True
+            self.http.stop()
+        self.engine.stop()
+
     def stop(self) -> None:
         with _LOCAL_MU:
             if _LOCAL_INSTANCES.get(self.name) is self:
@@ -248,7 +278,9 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
             self._transfer_q.put(None)
         for t in self._transfer_threads:
             t.join(timeout=5.0)
-        self.http.stop()
+        if not getattr(self, "_http_stopped", False):
+            self._http_stopped = True
+            self.http.stop()
         self.engine.stop()
 
     @property
@@ -264,6 +296,8 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
             out = self._push_q.get()
             if out is None:
                 return
+            if getattr(self, "_crashed", False):
+                continue  # crashed instances push nothing (fault injection)
             batch = [out]
             # micro-batch whatever else is queued (DisaggStreamGenerations
             # carries a list for the same reason)

@@ -709,6 +709,9 @@ class Master:
             reported
             and meta is not None
             and reported != meta.current_type.name
+            # Only PD roles are flip-notifiable; an ENCODE instance can
+            # never accept /flip, so a mismatch there must not loop.
+            and meta.current_type.name in ("PREFILL", "DECODE")
         ):
             self.scheduler.instance_mgr.requeue_flip(name, 1)
         h.send_json({"ok": True})

@@ -139,6 +139,9 @@ class Scheduler:
             self._kvcache_mgr.remove_instance
         )
         self.max_redispatch = 2
+        # Cluster-lifetime fault accounting (aggregated /metrics +
+        # bench_serving's fault-injection report).
+        self.total_redispatches = 0
 
         self._mu = threading.Lock()
         self._requests: Dict[str, _RequestState] = {}
@@ -665,6 +668,8 @@ class Scheduler:
         ):
             return False
         state.redispatch_count += 1
+        with self._mu:  # removal watch + prune loop race this counter
+            self.total_redispatches += 1
         routing = self._policy.select_instances_pair(request.token_ids)
         if exclude and routing.prefill_name == exclude:
             # Registry may still list the failed instance (fast-fail before
