@@ -71,9 +71,15 @@ def synthetic_sharegpt(n: int, rng, max_prompt: int, max_out: int,
         ln = int(min(max(p, 4), max_prompt))
         if word_mode:
             # short numeric words tokenize to ~2 BPE tokens each; halve
-            # the word count so the prompt lands near `ln` tokens
+            # the word count so the prompt lands near `ln` tokens. Salt
+            # per request: identical prefixes would hand CAR routing a
+            # near-100% shared-prefix artifact.
+            salt = int(rng.integers(0, 100000))
             prompts.append(
-                " ".join(str(i % 997) for i in range(max(ln // 2, 2)))
+                " ".join(
+                    str((salt + i) % 9973)
+                    for i in range(max(ln // 2, 2))
+                )
             )
         else:
             prompts.append("w" * ln)
@@ -189,9 +195,13 @@ def main() -> None:
         )
     offline_mask = rng.random(args.requests) < args.offline_frac
     gaps = rng.exponential(1.0 / args.rate, size=args.requests)
-    kill_idx = (
-        int(args.kill_at * args.requests) if args.kill_at > 0 else -1
-    )
+    kill_idx = -1
+    if args.kill_at > 0:
+        if len(instances) < 2:
+            raise SystemExit(
+                "--kill-at needs --instances >= 2 (someone must survive)"
+            )
+        kill_idx = min(int(args.kill_at * args.requests), args.requests - 1)
 
     ttfts, tpots, lats, errors = [], [], [], []
     off_ttfts, on_ttfts = [], []
@@ -231,7 +241,11 @@ def main() -> None:
                 payload = line[len("data: "):]
                 if payload == "[DONE]":
                     break
-                if '"error"' in payload:
+                try:
+                    ev = json.loads(payload)
+                except ValueError:
+                    ev = {}
+                if isinstance(ev, dict) and "error" in ev:
                     # mid-stream error event (e.g. instance died after
                     # tokens reached us — not replayable): fault-visible
                     stream_err = payload[:200]

@@ -249,3 +249,110 @@ def test_engine_concurrency_fuzz_round3_features(seed):
     assert not eng._running
     assert len(eng._free_slots) == cfg.max_running_requests
     assert not eng._waiting
+
+
+@pytest.mark.parametrize("seed", [31, 32])
+def test_engine_concurrency_fuzz_round4_features(seed):
+    """Round-4 surface under the same invariants: offline requests racing
+    online bursts (priority admission + running-decode preemption),
+    json_schema guidance (dynamic mask rows allocated/flushed on the
+    engine thread), and cancels landing on preempted-offline sequences."""
+    from xllm_service_tpu.guided import json_fsm
+    from xllm_service_tpu.tokenizer import ByteTokenizer
+
+    SCHEMA = {
+        "type": "object",
+        "additionalProperties": False,
+        "properties": {
+            "k": {"enum": ["a", "b"]},
+            "n": {"type": "integer"},
+        },
+        "required": ["k", "n"],
+    }
+    cfg = EngineConfig(
+        model="llama3-tiny",
+        dtype="float32",
+        block_size=16,
+        num_blocks=40,  # tight: forces pool-pressure preemption too
+        max_running_requests=3,
+        max_seq_len=128,
+        prefill_buckets=[32, 64, 128],
+    )
+    ex = ModelExecutor(cfg, init_seed=9)
+    eng = InferenceEngine(cfg, executor=ex, eos_token_ids=(2,))
+    tok = ByteTokenizer()
+    tb = tok.token_bytes_table(ex.cfg.vocab_size)
+    eng.set_guided_context(json_fsm.token_mask_table(tb, [2]), tb,
+                           eos_ids=[2])
+    eng.start()
+    rng = random.Random(seed)
+    np_rng = np.random.default_rng(seed)
+    N = 18
+    trackers = []
+    try:
+        def client(base):
+            for i in range(N // 3):
+                rid = f"r4s{seed}-c{base}-{i}"
+                kind = rng.random()
+                cancel_after = 1 if kind < 0.15 else None
+                t = TerminalTracker(rid, cancel_after, eng)
+                trackers.append(t)
+                prompt = np_rng.integers(
+                    1, 500, (int(np_rng.integers(3, 70)),)
+                ).tolist()
+                feat = rng.random()
+                # offline long decodes become preemption victims for the
+                # online burst that follows them
+                offline = feat < 0.4
+                guided = "json_schema" if 0.4 <= feat < 0.6 else (
+                    "json" if 0.6 <= feat < 0.7 else None
+                )
+                eng.add_request(
+                    EngineRequest(
+                        request_id=rid,
+                        prompt_token_ids=prompt,
+                        sampling=SamplingParams(
+                            temperature=rng.choice([0.0, 0.9]),
+                            seed=rng.randrange(2**31),
+                            max_new_tokens=int(
+                                np_rng.integers(8, 24)
+                            ) if offline else int(np_rng.integers(1, 6)),
+                        ),
+                        callback=t,
+                        offline=offline,
+                        guided=guided,
+                        schema=SCHEMA if guided == "json_schema" else None,
+                    )
+                )
+                if kind > 0.85:
+                    time.sleep(rng.random() * 0.02)
+                    eng.cancel(rid)
+                time.sleep(rng.random() * 0.01)
+
+        threads = [
+            threading.Thread(target=client, args=(b,)) for b in range(3)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        deadline = time.monotonic() + 240
+        for t in trackers:
+            assert t.done.wait(max(0.1, deadline - time.monotonic())), (
+                f"request {t.rid} never reached a terminal state "
+                f"(tokens={t.n_tokens})"
+            )
+    finally:
+        eng.stop()
+
+    for t in trackers:
+        assert t.post_terminal == 0, (
+            f"{t.rid}: {t.post_terminal} outputs after terminal emission"
+        )
+        assert t.terminal in ("finished", "error"), t.terminal
+    bm = eng.block_mgr
+    assert bm.num_referenced_blocks == 0
+    assert bm.num_free_blocks == bm.num_blocks - 1
+    assert not eng._running
+    assert len(eng._free_slots) == cfg.max_running_requests
+    assert not eng._waiting

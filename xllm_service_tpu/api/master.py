@@ -289,6 +289,11 @@ class Master:
             f"xllm_service_inflight_requests {self.scheduler.num_inflight}",
             "# TYPE xllm_service_is_master gauge",
             f"xllm_service_is_master {int(self.scheduler.is_master)}",
+            # fault handling: lifetime count of transparently replayed
+            # requests (instance death before first token)
+            "# TYPE xllm_service_redispatches_total counter",
+            f"xllm_service_redispatches_total "
+            f"{self.scheduler.total_redispatches}",
             "# TYPE xllm_instance_waiting_requests gauge",
         ]
         for name, m in sorted(load.items()):

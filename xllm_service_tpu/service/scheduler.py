@@ -668,8 +668,6 @@ class Scheduler:
         ):
             return False
         state.redispatch_count += 1
-        with self._mu:  # removal watch + prune loop race this counter
-            self.total_redispatches += 1
         routing = self._policy.select_instances_pair(request.token_ids)
         if exclude and routing.prefill_name == exclude:
             # Registry may still list the failed instance (fast-fail before
@@ -701,6 +699,11 @@ class Scheduler:
             state.dispatch()
         except Exception:
             return False
+        # Count only SUCCESSFUL replays (the /metrics counter claims
+        # "transparently replayed", not "attempted"); under self._mu —
+        # the removal watch and the prune loop race here.
+        with self._mu:
+            self.total_redispatches += 1
         return True
 
     # ------------------------------------------------------------------ #
