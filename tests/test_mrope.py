@@ -1,0 +1,277 @@
+"""Qwen2-VL M-RoPE: the (t, h, w) position streams through the LLM.
+
+Three layers of proof:
+  * ops-level: equal streams make apply_mrope identical to apply_rope
+    (why text tokens and decode steps need no special handling);
+  * the engine's host-side position algorithm matches HF
+    Qwen2VLModel.get_rope_index on image-bearing prompts;
+  * full-model parity: a tiny HF Qwen2VLForConditionalGeneration and
+    our engine (combined checkpoint, HF tower embeds injected) produce
+    the SAME greedy continuation for an image prompt — rope streams,
+    the post-image position compression (rope_delta), and decode all
+    line up.
+"""
+
+import json as _json
+import os as _os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from xllm_service_tpu.ops import rope as rope_ops
+
+SECTION = (4, 6, 6)  # head_dim 32 -> half 16
+
+
+def test_equal_streams_reduce_to_standard_rope():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((5, 4, 32)), jnp.float32)
+    pos = jnp.asarray([3, 9, 0, 17, 2], jnp.int32)
+    std = rope_ops.apply_rope(x, pos, 10000.0)
+    tri = rope_ops.apply_mrope(
+        x, jnp.stack([pos, pos, pos]), 10000.0, SECTION
+    )
+    np.testing.assert_allclose(np.asarray(tri), np.asarray(std), atol=1e-6)
+    # and diverging streams actually change the rotation
+    tri2 = rope_ops.apply_mrope(
+        x, jnp.stack([pos, pos + 1, pos]), 10000.0, SECTION
+    )
+    assert not np.allclose(np.asarray(tri2), np.asarray(std))
+
+
+def _tiny_hf():
+    torch = pytest.importorskip("torch")
+    from transformers import Qwen2VLConfig, Qwen2VLForConditionalGeneration
+
+    cfg = Qwen2VLConfig(
+        vision_config=dict(
+            depth=2, embed_dim=64, num_heads=4, patch_size=8,
+            spatial_merge_size=2, temporal_patch_size=2, mlp_ratio=4,
+            hidden_size=128, image_size=32,
+        ),
+        hidden_size=128, intermediate_size=256, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=512,
+        max_position_embeddings=512, rope_theta=10000.0,
+        rope_scaling={"type": "mrope", "mrope_section": list(SECTION)},
+        image_token_id=7, vision_start_token_id=8, vision_end_token_id=9,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    with torch.no_grad():
+        return Qwen2VLForConditionalGeneration(cfg).eval().float(), cfg
+
+
+# prompt: text, text, <vision_start>, 4x<image>, <vision_end>, text
+PROMPT = [10, 20, 8, 7, 7, 7, 7, 9, 30]
+MM_POS = [3, 4, 5, 6]
+
+
+def test_engine_positions_match_hf_get_rope_index():
+    torch = pytest.importorskip("torch")
+
+    hf, cfg = _tiny_hf()
+    ids = torch.tensor([PROMPT])
+    grid = torch.tensor([[1, 4, 4]])
+    hf_pos, hf_delta = hf.model.get_rope_index(
+        ids, image_grid_thw=grid, attention_mask=torch.ones_like(ids)
+    )
+    # ours
+    from xllm_service_tpu.common.config import EngineConfig
+    from xllm_service_tpu.ops.sampling import SamplingParams
+    from xllm_service_tpu.runtime.engine import (
+        EngineRequest, InferenceEngine, _Seq,
+    )
+    from xllm_service_tpu.runtime.executor import ModelExecutor
+    import dataclasses
+
+    from xllm_service_tpu.models.configs import get_model_config
+
+    mcfg = dataclasses.replace(
+        get_model_config("llama3-tiny"), mrope_section=SECTION
+    )
+    ecfg = EngineConfig(
+        model="llama3-tiny", dtype="float32", block_size=16, num_blocks=32,
+        max_running_requests=2, max_seq_len=128, prefill_buckets=[16, 32],
+    )
+    eng = InferenceEngine(
+        ecfg, executor=ModelExecutor(ecfg, model_cfg=mcfg)
+    )
+    seq = _Seq(
+        EngineRequest(
+            "m", PROMPT, SamplingParams(), lambda o: True,
+            mm_embeds=np.zeros((4, 128), np.float32), mm_positions=MM_POS,
+        ),
+        0,
+    )
+    ours = eng._mrope_positions(seq)
+    np.testing.assert_array_equal(ours, hf_pos[:, 0].numpy())
+    assert seq.rope_delta == int(hf_delta[0])
+
+
+def test_full_model_greedy_parity_with_hf(tmp_path):
+    """Tiny HF Qwen2-VL vs our engine on the SAME weights and image:
+    identical greedy continuations. The tower embeds are taken from HF's
+    visual (tower parity is pinned separately in test_multimodal), so
+    this isolates the LLM's M-RoPE streams + rope_delta decode path."""
+    torch = pytest.importorskip("torch")
+
+    hf, cfg = _tiny_hf()
+    # ---- export the text stack in Qwen2 layout + combined config
+    from xllm_service_tpu.runtime import weights as W
+
+    ckpt = str(tmp_path / "q2vl")
+    _os.makedirs(ckpt, exist_ok=True)
+    tensors = {}
+    for n, p in hf.named_parameters():
+        if n.startswith("model.language_model."):
+            n = "model." + n[len("model.language_model."):]
+        elif n.startswith("model.visual."):
+            n = n[len("model."):]
+        tensors[n] = p.detach().numpy()
+    if "lm_head.weight" not in tensors:  # tied embeddings
+        tensors["lm_head.weight"] = tensors["model.embed_tokens.weight"]
+    W.write_safetensors(_os.path.join(ckpt, "model.safetensors"), tensors)
+    with open(_os.path.join(ckpt, "config.json"), "w") as f:
+        _json.dump({
+            "architectures": ["Qwen2VLForConditionalGeneration"],
+            "model_type": "qwen2_vl",
+            "vocab_size": 512, "hidden_size": 128,
+            "intermediate_size": 256, "num_hidden_layers": 2,
+            "num_attention_heads": 4, "num_key_value_heads": 2,
+            "rope_theta": 10000.0, "rms_norm_eps": 1e-6,
+            "max_position_embeddings": 512,
+            "tie_word_embeddings": bool(cfg.tie_word_embeddings),
+            "rope_scaling": {"type": "mrope",
+                             "mrope_section": list(SECTION)},
+            "vision_config": {
+                "model_type": "qwen2_vl", "embed_dim": 64, "depth": 2,
+                "num_heads": 4, "patch_size": 8, "image_size": 32,
+                "mlp_ratio": 4, "spatial_merge_size": 2,
+                "temporal_patch_size": 2, "hidden_size": 128,
+            },
+        }, f)
+
+    # ---- the image: identical pixel patches on both sides
+    from xllm_service_tpu.models import vision as V
+
+    vcfg = V.get_vision_config("qwen2vl-tiny")
+    rng = np.random.default_rng(3)
+    img = rng.random((1, 32, 32, 3)).astype(np.float32)
+    rows, _, _ = V._qwen2vl_patch_rows(jnp.asarray(img), vcfg)
+    with torch.no_grad():
+        embeds = hf.model.visual(
+            torch.from_numpy(np.asarray(rows[0], np.float32)),
+            grid_thw=torch.tensor([[1, 4, 4]]),
+        ).numpy()  # [4, 128]
+
+    # ---- HF greedy continuation
+    ids = torch.tensor([PROMPT])
+    with torch.no_grad():
+        hf_out = hf.generate(
+            input_ids=ids,
+            pixel_values=torch.from_numpy(np.asarray(rows[0], np.float32)),
+            image_grid_thw=torch.tensor([[1, 4, 4]]),
+            attention_mask=torch.ones_like(ids),
+            max_new_tokens=6, do_sample=False,
+        )
+    want = hf_out[0, len(PROMPT):].tolist()
+
+    # ---- ours: engine over the combined checkpoint, HF embeds injected
+    from xllm_service_tpu.common.config import EngineConfig
+    from xllm_service_tpu.ops.sampling import SamplingParams
+    from xllm_service_tpu.runtime.engine import EngineRequest, InferenceEngine
+    from xllm_service_tpu.runtime.executor import ModelExecutor
+
+    ecfg = EngineConfig(
+        model="q2vl", dtype="float32", checkpoint_path=ckpt, block_size=16,
+        num_blocks=32, max_running_requests=2, max_seq_len=128,
+        prefill_buckets=[16, 32],
+    )
+    ex = ModelExecutor(ecfg)
+    assert ex.cfg.mrope_section == SECTION
+    eng = InferenceEngine(ecfg, executor=ex)
+    got = []
+
+    def cb(o):
+        for s in o.outputs:
+            got.extend(s.token_ids)
+        return True
+
+    eng.add_request(EngineRequest(
+        "p", PROMPT,
+        SamplingParams(temperature=0.0, max_new_tokens=6), cb,
+        mm_embeds=embeds, mm_positions=MM_POS,
+    ))
+    for _ in range(60):
+        if not eng.has_work():
+            break
+        eng.step()
+    assert got == want, (got, want)
+
+def test_media_seq_survives_preemption_with_exact_positions():
+    """A preempted media sequence re-prefills prompt + generated tokens;
+    the M-RoPE streams must extend over the generated history with the
+    compressed continuation (review finding, r4) — the resumed greedy
+    continuation equals an undisturbed run."""
+    import dataclasses
+
+    from xllm_service_tpu.common.config import EngineConfig
+    from xllm_service_tpu.models.configs import get_model_config
+    from xllm_service_tpu.ops.sampling import SamplingParams
+    from xllm_service_tpu.runtime.engine import EngineRequest, InferenceEngine
+    from xllm_service_tpu.runtime.executor import ModelExecutor
+
+    mcfg = dataclasses.replace(
+        get_model_config("llama3-tiny"), mrope_section=SECTION
+    )
+    embeds = np.random.default_rng(2).standard_normal(
+        (4, 128)
+    ).astype(np.float32)
+
+    def run(disturb: bool):
+        ecfg = EngineConfig(
+            model="llama3-tiny", dtype="float32", block_size=16,
+            num_blocks=48, max_running_requests=3, max_seq_len=128,
+            prefill_buckets=[16, 32, 64],
+        )
+        eng = InferenceEngine(
+            ecfg, executor=ModelExecutor(ecfg, model_cfg=mcfg, init_seed=4)
+        )
+        got = {}
+
+        def cb(tag):
+            def f(o):
+                for s in o.outputs:
+                    got.setdefault(tag, []).extend(s.token_ids)
+                return True
+            return f
+
+        eng.add_request(EngineRequest(
+            "victim", PROMPT,
+            SamplingParams(temperature=0.0, max_new_tokens=24),
+            cb("victim"), mm_embeds=embeds, mm_positions=MM_POS,
+            offline=True,
+        ))
+        for _ in range(6):
+            eng.step()
+        if disturb:
+            # online burst preempts the running offline media decode
+            for i in range(3):
+                eng.add_request(EngineRequest(
+                    f"on{i}", [11, 12, 13],
+                    SamplingParams(temperature=0.0, max_new_tokens=4),
+                    cb(f"on{i}"),
+                ))
+        for _ in range(400):
+            if not eng.has_work():
+                break
+            eng.step()
+        return got["victim"]
+
+    undisturbed = run(False)
+    resumed = run(True)
+    assert len(undisturbed) == 24
+    assert resumed == undisturbed

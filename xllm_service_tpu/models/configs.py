@@ -31,6 +31,11 @@ class ModelConfig:
     moe_intermediate_size: int = 0
     # Sliding-window attention (0 = full).
     sliding_window: int = 0
+    # Qwen2-VL M-RoPE half-dim sections ((t, h, w) streams; empty =
+    # standard 1D RoPE). Equal streams reduce M-RoPE to standard RoPE,
+    # so text tokens and decode steps need no special handling; image
+    # spans inside a prompt carry [3, L] positions (models/llama.py).
+    mrope_section: tuple = ()
     # Disable head_dim<128 packed cache rows (kv_cache.kv_pack_factor).
     # Set by the executor (sharding.resolve_kv_packing) when tp doesn't
     # divide the packed head count — the unpacked layout keeps every

@@ -34,6 +34,7 @@ from xllm_service_tpu.ops.attention import (
 from xllm_service_tpu.ops.norms import rms_norm
 from xllm_service_tpu.ops import lora as lora_ops
 from xllm_service_tpu.ops.quant import wdtype, wt
+from xllm_service_tpu.ops import rope as rope_ops
 from xllm_service_tpu.ops.rope import apply_rope
 
 Params = Dict[str, Any]
@@ -220,6 +221,17 @@ def _qkv(lp, cfg: ModelConfig, x: jnp.ndarray, positions: jnp.ndarray,
         # Qwen3Attention ordering).
         q = rms_norm(q, lp["q_head_norm"], cfg.rms_norm_eps)
         k = rms_norm(k, lp["k_head_norm"], cfg.rms_norm_eps)
+    if cfg.mrope_section and positions.ndim == 2:
+        # Qwen2-VL M-RoPE: [3, T] (t, h, w) streams diverge inside image
+        # spans. 1D positions (text-only prompts, every decode step) take
+        # the standard path below — equal streams make them identical.
+        q = rope_ops.apply_mrope(
+            q, positions, cfg.rope_theta, cfg.mrope_section
+        )
+        k = rope_ops.apply_mrope(
+            k, positions, cfg.rope_theta, cfg.mrope_section
+        )
+        return q, k, v
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     return q, k, v
@@ -254,6 +266,7 @@ def decode_step(
     active: jnp.ndarray,  # [R] bool
     use_kernel: bool | None = None,
     lora_idx: jnp.ndarray | None = None,  # [R] per-slot adapter rows
+    rope_delta: jnp.ndarray | None = None,  # [R] int32 (M-RoPE, <= 0)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One generation step for R sequences. Returns (logits [R, V],
     k_caches', v_caches')."""
@@ -261,6 +274,10 @@ def decode_step(
     scale = cfg.head_dim**-0.5
     x = params["embed"][token_ids].astype(wdtype(params["layers"]["wq"]))  # [R, E]
 
+    # Rope positions may lag cache positions (Qwen2-VL M-RoPE compresses
+    # image spans): rope_delta <= 0 shifts the ROTATION only — cache
+    # slots, block lookup, and attention lengths stay token-count-based.
+    rope_pos = positions + rope_delta if rope_delta is not None else positions
     block_idx = positions // bs
     offset = jnp.where(active, positions % bs, 0)
     blk = jnp.take_along_axis(block_tables, block_idx[:, None], axis=1)[:, 0]
@@ -270,7 +287,7 @@ def decode_step(
     def layer_fn(x, scanned):
         lp, k_l, v_l = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(lp, cfg, h, positions, lora_idx)
+        q, k, v = _qkv(lp, cfg, h, rope_pos, lora_idx)
         k_l, v_l = _scatter_kv(k_l, v_l, blk, offset, k, v)
         attn = paged_attention(
             q, k_l, v_l, block_tables, seq_lens, scale,
@@ -309,6 +326,7 @@ def prefill_batch_step(
     # padding entries point at Lpad (a dummy row, sliced off)
     all_logits: bool = False,  # speculative verify: unembed EVERY position
     lora_idx: jnp.ndarray | None = None,  # [P] per-sequence adapter rows
+    rope_positions: jnp.ndarray | None = None,  # [P, 3, Lpad] M-RoPE streams
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Prefill P sequences' chunks in ONE compiled step (batched admission).
 
@@ -344,6 +362,9 @@ def prefill_batch_step(
     flat_off = in_block.reshape(P * Lpad)
 
     li = lora_idx if lora_idx is not None else jnp.zeros((P,), jnp.int32)
+    # Cache slots/attention stay token-count positional; only the q/k
+    # ROTATION takes the (t, h, w) streams when M-RoPE positions ride in.
+    rp = rope_positions if rope_positions is not None else positions
 
     def layer_fn(x, scanned):
         lp, k_l, v_l = scanned
@@ -352,7 +373,7 @@ def prefill_batch_step(
             lambda hx, pos, ai: _qkv(
                 lp, cfg, hx, pos, ai if lora_idx is not None else None
             )
-        )(h, positions, li)  # q [P, Lpad, Hq, D]
+        )(h, rp, li)  # q [P, Lpad, Hq, D]
         k_l, v_l = _scatter_kv(
             k_l, v_l, flat_blk, flat_off,
             k.reshape(P * Lpad, *k.shape[2:]),

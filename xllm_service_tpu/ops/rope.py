@@ -16,6 +16,45 @@ def rope_frequencies(head_dim: int, theta: float) -> jnp.ndarray:
     return 1.0 / (theta**exponent)
 
 
+def _rotate(x: jnp.ndarray, angles: jnp.ndarray) -> jnp.ndarray:
+    """Split-half rotation by per-(token, frequency) `angles` [..., half]
+    — the single rotation convention both rope variants share (a future
+    convention change must hit both or equal-streams M-RoPE would
+    silently diverge from the standard path decode relies on)."""
+    half = x.shape[-1] // 2
+    cos = jnp.cos(angles)[..., None, :]  # [..., 1, half]
+    sin = jnp.sin(angles)[..., None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+
+
+def apply_mrope(
+    x: jnp.ndarray,  # [T, num_heads, head_dim]
+    positions3: jnp.ndarray,  # [3, T] int32 — (t, h, w) position streams
+    theta: float,
+    section,  # static tuple of half-dim section sizes, e.g. (16, 24, 24)
+) -> jnp.ndarray:
+    """Multimodal rotary embedding (Qwen2-VL M-RoPE, HF
+    apply_multimodal_rotary_pos_emb): frequency band i takes its ANGLE
+    from position stream section_of(i) — the first `section[0]` inverse
+    frequencies from the temporal stream, the next `section[1]` from the
+    height stream, the rest from width. When the three streams are equal
+    (every text token, every decode step) this IS apply_rope; image
+    spans inside a prompt are where the streams diverge."""
+    import numpy as np
+
+    half = x.shape[-1] // 2
+    assert sum(section) == half, (section, half)
+    inv_freq = rope_frequencies(x.shape[-1], theta)  # [half]
+    sel = np.repeat(np.arange(len(section)), section)  # [half] -> stream id
+    pos_sel = positions3[jnp.asarray(sel)]  # [half, T]
+    angles = pos_sel.T.astype(jnp.float32) * inv_freq  # [T, half]
+    return _rotate(x, angles)
+
+
 def apply_rope(
     x: jnp.ndarray,  # [..., num_heads, head_dim]
     positions: jnp.ndarray,  # [...] int32, broadcastable to x's batch dims
@@ -28,13 +67,6 @@ def apply_rope(
     standard permutation; for random-init + self-consistent decode any
     consistent convention is exact.
     """
-    half = x.shape[-1] // 2
     inv_freq = rope_frequencies(x.shape[-1], theta)  # [half]
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., half]
-    cos = jnp.cos(angles)[..., None, :]  # [..., 1, half]
-    sin = jnp.sin(angles)[..., None, :]
-    x1 = x[..., :half].astype(jnp.float32)
-    x2 = x[..., half:].astype(jnp.float32)
-    out1 = x1 * cos - x2 * sin
-    out2 = x2 * cos + x1 * sin
-    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+    return _rotate(x, angles)

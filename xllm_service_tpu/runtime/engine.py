@@ -118,6 +118,7 @@ class _Seq:
         "last_committed_block", "prefill_done_time", "last_token_time",
         "prefilled", "chunk_len", "prefill_start_time", "head_hash",
         "json_state", "json_upto", "schema_spec",
+        "rope_pos3", "rope_delta",
     )
 
     def __init__(self, req: EngineRequest, slot: int):
@@ -147,6 +148,11 @@ class _Seq:
         self.json_state = "INIT"
         self.json_upto = 0
         self.schema_spec = None  # compiled SchemaSpec, cached at first use
+        # Qwen2-VL M-RoPE: [3, prompt_len] position streams + the (<= 0)
+        # lag of generation rope positions behind token counts; None/0
+        # for everything but media prompts on an mrope model.
+        self.rope_pos3 = None
+        self.rope_delta = 0
 
 
 # The waiting queue holds fresh EngineRequests and preempted _Seqs (which
@@ -631,6 +637,11 @@ class InferenceEngine:
                         if seq.req.has_media
                         else None
                     ),
+                    rope_positions=(
+                        self._mrope_positions(seq)[:, start:start + n]
+                        if self._mrope_active(seq)
+                        else None
+                    ),
                     presence=getattr(s, "presence_penalty", 0.0),
                     frequency=getattr(s, "frequency_penalty", 0.0),
                     # Only the FINAL chunk's sampled token survives, so
@@ -1081,9 +1092,17 @@ class InferenceEngine:
             min_p = np.zeros((self.R,), np.float32)
             for slot, sq in self._running.items():
                 min_p[slot] = getattr(sq.req.sampling, "min_p", 0.0)
+        rope_delta = None
+        if any(
+            getattr(sq, "rope_delta", 0) for sq in self._running.values()
+        ):
+            rope_delta = np.zeros((self.R,), np.int32)
+            for slot, sq in self._running.items():
+                rope_delta[slot] = sq.rope_delta
         return SamplingBatch(
             temps, top_ks, top_ps, seeds, steps, presence, frequency,
             bias_ids, bias_vals, adapter_idx=adapter_idx, min_p=min_p,
+            rope_delta=rope_delta,
         )
 
     def _decode_once(self) -> int:
@@ -1137,6 +1156,84 @@ class InferenceEngine:
             produced += 1
             self._emit(seq, finished=self._check_stop(seq))
         return produced
+
+    # ------------------------------------------------------------ M-RoPE
+
+    def _mrope_active(self, seq: _Seq) -> bool:
+        return bool(
+            getattr(self.executor.cfg, "mrope_section", ())
+            and seq.req.has_media
+        )
+
+    def _mrope_positions(self, seq: _Seq) -> np.ndarray:
+        """[3, len(seq.tokens)] (t, h, w) rope streams for a media
+        sequence — the HF Qwen2-VL get_rope_index algorithm for square
+        still-image grids: text advances all three streams together; an
+        image span of m = g*g merged tokens pins t at the span start,
+        lays h/w on the g x g grid, and resumes text at start + g. Also
+        fixes the sequence's rope_delta (generation positions continue
+        from the compressed maximum, not the token count).
+
+        Covers GENERATED tokens too — preemption/PD resume re-prefills
+        prompt + generated, so the streams extend on demand with the
+        compressed continuation (token i: i + rope_delta, all equal)."""
+        need = len(seq.tokens)
+        if seq.rope_pos3 is not None and seq.rope_pos3.shape[1] >= need:
+            return seq.rope_pos3
+        if seq.rope_pos3 is not None:
+            base = seq.rope_pos3
+            have = base.shape[1]
+            ext = (
+                np.arange(have, need, dtype=np.int32) + seq.rope_delta
+            )[None, :].repeat(3, axis=0)
+            seq.rope_pos3 = np.concatenate([base, ext], axis=1)
+            return seq.rope_pos3
+        L = len(seq.req.prompt_token_ids)
+        pos = np.zeros((3, L), np.int32)
+        spans = []  # (start, length) contiguous placeholder runs
+        mm = sorted(int(p) for p in seq.req.mm_positions)
+        run_start = None
+        prev = None
+        for p in mm:
+            if run_start is None:
+                run_start = prev = p
+                continue
+            if p == prev + 1:
+                prev = p
+                continue
+            spans.append((run_start, prev - run_start + 1))
+            run_start = prev = p
+        if run_start is not None:
+            spans.append((run_start, prev - run_start + 1))
+        cur = 0  # next rope position value
+        idx = 0  # next prompt index to fill
+        for s0, m in spans:
+            while idx < s0:  # text before the span
+                pos[:, idx] = cur
+                cur += 1
+                idx += 1
+            g = int(round(math.sqrt(m)))
+            if g * g != m:
+                # non-square span (unknown grid): degrade to sequential
+                for j in range(m):
+                    pos[:, idx + j] = cur + j
+                cur += m
+            else:
+                for j in range(m):
+                    pos[0, idx + j] = cur
+                    pos[1, idx + j] = cur + j // g
+                    pos[2, idx + j] = cur + j % g
+                cur += g
+            idx += m
+        while idx < L:
+            pos[:, idx] = cur
+            cur += 1
+            idx += 1
+        seq.rope_pos3 = pos
+        seq.rope_delta = cur - L  # <= 0: image spans compress positions
+        if need > L:  # resumed with generated history: extend now
+            return self._mrope_positions(seq)
+        return pos
 
     # --------------------------------------------------- guided decoding
 

@@ -74,6 +74,9 @@ class SamplingBatch:
     adapter_idx: Optional[np.ndarray] = None
     # min_p filtering; None = disabled for the whole batch.
     min_p: Optional[np.ndarray] = None
+    # Qwen2-VL M-RoPE: per-slot rope-position lag (<= 0; image spans
+    # compress positions). None = no VLM sequences in the batch.
+    rope_delta: Optional[np.ndarray] = None
 
 
 @dataclass
@@ -105,6 +108,10 @@ class PrefillItem:
     # Multi-LoRA adapter row (0 = base).
     adapter_idx: int = 0
     min_p: float = 0.0
+    # Qwen2-VL M-RoPE: (t, h, w) position streams for THIS CHUNK's
+    # tokens, [3, n] (None = standard 1D positions). Cache slots stay
+    # token-count-based; only the q/k rotation reads these.
+    rope_positions: Optional[np.ndarray] = None
 
 
 _COMPILATION_CACHE_DIR: Optional[str] = None
@@ -621,10 +628,13 @@ class ModelExecutor:
         lora_idx=None,  # [R] adapter rows (0 = base)
         min_p=None,  # [R]
         use_kernel=None,
+        rope_delta=None,  # [R] M-RoPE position lag (Qwen2-VL image spans)
     ):
         step_kwargs = (
             {"lora_idx": lora_idx} if lora_idx is not None else {}
         )
+        if rope_delta is not None:
+            step_kwargs["rope_delta"] = rope_delta
         logits, k_cache, v_cache = self.model_mod.decode_step(
             params,
             self.cfg,
@@ -675,10 +685,13 @@ class ModelExecutor:
         guided_table=None,
         lora_idx=None,  # [P] adapter rows (0 = base)
         min_p=None,  # [P]
+        rope_positions=None,  # [P, 3, Lpad] M-RoPE streams (image spans)
     ):
         step_kwargs = (
             {"lora_idx": lora_idx} if lora_idx is not None else {}
         )
+        if rope_positions is not None:
+            step_kwargs["rope_positions"] = rope_positions
         logits, k_cache, v_cache = self.model_mod.prefill_batch_step(
             params, self.cfg, k_cache, v_cache, token_ids, start_pos,
             true_len, block_tables,
@@ -724,6 +737,7 @@ class ModelExecutor:
         guided_table=None,
         lora_idx=None,  # [R] adapter rows (0 = base)
         min_p=None,  # [R]
+        rope_delta=None,  # [R] M-RoPE position lag (<= 0)
     ):
         """Speculative-decoding verify step: one forward pass over S
         positions per sequence (the prefill machinery with `all_logits`),
@@ -734,6 +748,16 @@ class ModelExecutor:
         step_kwargs = (
             {"lora_idx": lora_idx} if lora_idx is not None else {}
         )
+        if rope_delta is not None:
+            # generation positions have equal (t, h, w) streams; only the
+            # lag vs cache positions matters
+            S_ = token_ids.shape[1]
+            base = (start_pos + rope_delta)[:, None] + jnp.arange(
+                S_, dtype=jnp.int32
+            )[None]
+            step_kwargs["rope_positions"] = jnp.broadcast_to(
+                base[:, None, :], (base.shape[0], 3, S_)
+            )
         logits, k_cache, v_cache = self.model_mod.prefill_batch_step(
             params, self.cfg, k_cache, v_cache, token_ids, start_pos,
             true_len, block_tables, all_logits=True, **step_kwargs,
@@ -811,6 +835,10 @@ class ModelExecutor:
             )
         if batch.min_p is not None:
             bias_kwargs.update(min_p=jnp.asarray(batch.min_p, jnp.float32))
+        if batch.rope_delta is not None:
+            bias_kwargs.update(
+                rope_delta=jnp.asarray(batch.rope_delta, jnp.int32)
+            )
         (
             self.k_cache, self.v_cache, self.token_counts,
             tokens, logprobs, n_emit,
@@ -976,6 +1004,19 @@ class ModelExecutor:
                     jnp.float32,
                 )
             )
+        if any(it.rope_positions is not None for it in group):
+            # M-RoPE streams; items without them get the standard
+            # sequential positions (equal streams == standard RoPE).
+            rp = np.zeros((P, 3, Lpad), np.int32)
+            for i in range(P):
+                it = group[i] if i < n_real else None
+                if it is not None and it.rope_positions is not None:
+                    n = len(it.token_ids)
+                    rp[i, :, :n] = np.asarray(it.rope_positions, np.int32)
+                elif it is not None:
+                    seq = it.start_pos + np.arange(Lpad, dtype=np.int32)
+                    rp[i] = seq[None, :]
+            pen_kwargs.update(rope_positions=jnp.asarray(rp))
         if any(
             it.prior_tokens is not None and len(it.prior_tokens)
             for it in group
@@ -1284,6 +1325,10 @@ class ModelExecutor:
             )
         if batch.min_p is not None:
             bias_kwargs.update(min_p=jnp.asarray(batch.min_p, jnp.float32))
+        if batch.rope_delta is not None:
+            bias_kwargs.update(
+                rope_delta=jnp.asarray(batch.rope_delta, jnp.int32)
+            )
         (
             self.k_cache, self.v_cache, self.token_counts, tokens, logprobs,
         ) = self._decode_jit(

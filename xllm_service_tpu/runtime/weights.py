@@ -169,6 +169,9 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
         # decode step, so text requests are HF-exact (docs/ARCHITECTURE).
         hf = {**hf, **(hf.get("text_config") or {})}
         arch = "Qwen2ForCausalLM"
+        rs = hf.get("rope_scaling") or {}
+        if rs.get("mrope_section"):
+            hf["_mrope_section"] = tuple(int(v) for v in rs["mrope_section"])
     num_heads = hf["num_attention_heads"]
     head_dim = hf.get("head_dim") or hf["hidden_size"] // num_heads
     common = dict(
@@ -185,6 +188,7 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
         max_position_embeddings=hf.get("max_position_embeddings", 8192),
         tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
         sliding_window=_hf_sliding_window(hf),
+        mrope_section=tuple(hf.get("_mrope_section") or ()),
     )
     if arch == "Qwen2ForCausalLM":
         common["attn_bias"] = True
