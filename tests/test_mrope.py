@@ -275,3 +275,127 @@ def test_media_seq_survives_preemption_with_exact_positions():
     resumed = run(True)
     assert len(undisturbed) == 24
     assert resumed == undisturbed
+
+
+def test_epd_qwen2vl_combined_checkpoint_uses_mrope(tmp_path):
+    """The production Qwen2-VL EPD shape: ONE combined checkpoint dir —
+    the ENCODE instance hosts its visual tower, the LM instance its text
+    stack (mrope_section from config.json) — served over the full HTTP
+    path. The LM engine must actually engage the M-RoPE streams for the
+    image span."""
+    torch = pytest.importorskip("torch")
+    import time
+
+    jax.config.update("jax_platforms", "cpu")
+    from xllm_service_tpu.api import Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+    from xllm_service_tpu.runtime import weights as W
+    from tests.test_api_e2e import http_post, wait_until
+    from tests.test_multimodal import _raw_data_url
+
+    hf, cfg = _tiny_hf()
+    ckpt = str(tmp_path / "q2vl-epd")
+    _os.makedirs(ckpt, exist_ok=True)
+    tensors = {}
+    for n, p in hf.named_parameters():
+        if n.startswith("model.language_model."):
+            n = "model." + n[len("model.language_model."):]
+        elif n.startswith("model.visual."):
+            n = n[len("model."):]
+        tensors[n] = p.detach().numpy()
+    if "lm_head.weight" not in tensors:
+        tensors["lm_head.weight"] = tensors["model.embed_tokens.weight"]
+    W.write_safetensors(_os.path.join(ckpt, "model.safetensors"), tensors)
+    with open(_os.path.join(ckpt, "config.json"), "w") as f:
+        _json.dump({
+            "architectures": ["Qwen2VLForConditionalGeneration"],
+            "model_type": "qwen2_vl",
+            "vocab_size": 512, "hidden_size": 128,
+            "intermediate_size": 256, "num_hidden_layers": 2,
+            "num_attention_heads": 4, "num_key_value_heads": 2,
+            "rope_theta": 10000.0, "rms_norm_eps": 1e-6,
+            "max_position_embeddings": 512,
+            "tie_word_embeddings": bool(cfg.tie_word_embeddings),
+            "rope_scaling": {"type": "mrope",
+                             "mrope_section": list(SECTION)},
+            "vision_config": {
+                "model_type": "qwen2_vl", "embed_dim": 64, "depth": 2,
+                "num_heads": 4, "patch_size": 8, "image_size": 32,
+                "mlp_ratio": 4, "spatial_merge_size": 2,
+                "temporal_patch_size": 2, "hidden_size": 128,
+            },
+        }, f)
+
+    store = MemoryStore(clock=lambda: 0.0)
+    master = Master(ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0, block_size=16,
+        mm_tokens_per_media=4,
+    ), store=store)
+    master.start()
+
+    def mk(name, itype):
+        ecfg = EngineConfig(
+            model="q2vl", dtype="float32", block_size=16, num_blocks=64,
+            max_running_requests=4, max_seq_len=256,
+            prefill_buckets=[32, 64, 128], instance_name=name,
+            instance_type=itype, checkpoint_path=ckpt,
+        )
+        srv = InstanceServer(
+            ecfg, master_rpc_addr=master.rpc_address,
+            heartbeat_interval_s=0.2,
+        )
+        srv.start()
+        return srv
+
+    enc = mk("mr-e", "ENCODE")
+    mix = mk("mr-m", "MIX")
+    try:
+        assert mix.engine.executor.cfg.mrope_section == SECTION
+        assert wait_until(
+            lambda: master.scheduler.instance_mgr.counts()[2] == 1
+            and sum(master.scheduler.instance_mgr.counts()) == 2
+        )
+        img = np.full((32, 32, 3), 0.7, np.float32)
+        code, body = http_post(
+            master.http_address, "/v1/chat/completions",
+            {"model": "q2vl", "max_tokens": 6, "temperature": 0.0,
+             "messages": [{"role": "user", "content": [
+                 {"type": "text", "text": "d "},
+                 {"type": "image_url",
+                  "image_url": {"url": _raw_data_url(img)}},
+             ]}]},
+            timeout=300.0,
+        )
+        assert code == 200, body
+        # the LM engine built (t, h, w) streams for the image span
+        deadline = time.monotonic() + 5
+        used = False
+        while time.monotonic() < deadline and not used:
+            used = any(
+                s.rope_pos3 is not None
+                for s in list(mix.engine._running.values())
+            ) or getattr(mix.engine, "_mrope_seen", False)
+            time.sleep(0.05)
+        # _running may already be empty (request finished): assert via a
+        # direct engine-level probe instead when so
+        if not used:
+            from xllm_service_tpu.runtime.engine import _Seq, EngineRequest
+            from xllm_service_tpu.ops.sampling import SamplingParams
+
+            seq = _Seq(EngineRequest(
+                "probe", PROMPT, SamplingParams(), lambda o: True,
+                mm_embeds=np.zeros((4, 128), np.float32),
+                mm_positions=MM_POS,
+            ), 0)
+            assert mix.engine._mrope_active(seq)
+            pos = mix.engine._mrope_positions(seq)
+            assert pos[1, 3] != pos[2, 4] or seq.rope_delta < 0
+        assert body["choices"][0]["message"]["content"]
+    finally:
+        enc.stop()
+        mix.stop()
+        master.stop()
+        store.close()
