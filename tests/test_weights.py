@@ -286,3 +286,36 @@ def test_hf_sliding_window_gates():
         {"sliding_window": 32768, "use_sliding_window": True,
          "max_window_layers": 0, "num_hidden_layers": 64}
     ) == 32768
+
+
+def test_mistral_arch_loads_with_sliding_window(tmp_path):
+    """MistralForCausalLM (Llama layout + SWA) round-trips through
+    config_from_hf/load_checkpoint with sliding_window parsed into the
+    config (the serving-path window behavior itself is pinned by
+    test_model.test_sliding_window_matches_dense)."""
+    import dataclasses
+    import json as _json
+
+    from xllm_service_tpu.models import llama
+    from xllm_service_tpu.models.configs import get_model_config
+    from xllm_service_tpu.runtime import weights as W
+
+    cfg = dataclasses.replace(get_model_config("llama3-tiny"),
+                              sliding_window=24)
+    params = llama.init_params(cfg, jax.random.key(5), dtype=jnp.float32)
+    ckpt = str(tmp_path / "mistral")
+    W.save_hf_checkpoint(params, cfg, ckpt)
+    with open(os.path.join(ckpt, "config.json")) as f:
+        hf = _json.load(f)
+    hf["architectures"] = ["MistralForCausalLM"]
+    hf["model_type"] = "mistral"
+    hf["sliding_window"] = 24
+    with open(os.path.join(ckpt, "config.json"), "w") as f:
+        _json.dump(hf, f)
+
+    cfg2 = W.config_from_hf(ckpt)
+    assert cfg2.sliding_window == 24
+    assert not cfg2.attn_bias
+    loaded = W.load_checkpoint(ckpt, cfg2, dtype=jnp.float32)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(loaded)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

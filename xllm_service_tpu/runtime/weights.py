@@ -248,7 +248,10 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
                 moe_intermediate_size=hf["moe_intermediate_size"],
                 n_shared_experts=int(hf.get("n_shared_experts") or 0),
             )
-    elif arch != "LlamaForCausalLM":
+    elif arch not in ("LlamaForCausalLM", "MistralForCausalLM"):
+        # Mistral is architecturally Llama (same tensor names, bias-free
+        # QKV) + sliding-window attention, which _hf_sliding_window
+        # already picked up from the config.
         raise ValueError(f"unsupported architecture {arch!r}")
     return ModelConfig(**common)
 
