@@ -275,3 +275,86 @@ def test_no_trailing_comma_with_optional_tail():
     fbi = sf.build_first_byte_index([b"}", b" ", b'"'])
     bits = sf.token_bitmap(spec, st, fbi, 3, eos_ids=[])
     assert not bits[0] and bits[1] and bits[2]
+
+
+def test_internal_refs_resolve_pydantic_shape():
+    """$defs/$ref (the shape pydantic model_json_schema emits) resolves
+    inline; recursion and unknown refs are rejected."""
+    schema = {
+        "$defs": {
+            "Pet": {
+                "type": "object", "additionalProperties": False,
+                "properties": {
+                    "name": {"type": "string"},
+                    "kind": {"$ref": "#/$defs/Kind"},
+                },
+                "required": ["name", "kind"],
+            },
+            "Kind": {"enum": ["cat", "dog"]},
+        },
+        "type": "object", "additionalProperties": False,
+        "properties": {
+            "pet": {"$ref": "#/$defs/Pet"},
+            "count": {"type": "integer"},
+        },
+        "required": ["pet", "count"],
+    }
+    doc = '{"pet": {"name": "mo", "kind": "cat"}, "count": 2}'
+    assert accepts(schema, doc)
+    assert not prefix_ok(schema, '{"pet": {"name": "mo", "kind": "ox')
+    # legacy "definitions" key too
+    legacy = {
+        "definitions": {"N": {"type": "integer"}},
+        "type": "object", "additionalProperties": False,
+        "properties": {"n": {"$ref": "#/definitions/N"}},
+        "required": ["n"],
+    }
+    assert accepts(legacy, '{"n": 7}')
+    # recursion rejected (unbounded documents)
+    rec = {
+        "$defs": {"T": {
+            "type": "object", "additionalProperties": False,
+            "properties": {"next": {"$ref": "#/$defs/T"}},
+        }},
+        "$ref": "#/$defs/T",
+    }
+    with pytest.raises(sf.SchemaError, match="recursive"):
+        sf.compile_schema(rec)
+    with pytest.raises(sf.SchemaError, match="unresolvable"):
+        sf.compile_schema({"$ref": "#/$defs/Nope"})
+
+
+def test_ref_blowup_and_sibling_constraints_rejected():
+    """Review findings (r4): a doubling-DAG of refs must compile in
+    O(defs) via memoization (not 2^N nodes), and $ref nodes carrying
+    unsupported constraint siblings are rejected, not silently
+    stripped."""
+    import time
+
+    N = 24
+    defs = {f"D{N}": {"type": "integer"}}
+    for i in range(N - 1, -1, -1):
+        defs[f"D{i}"] = {
+            "type": "object", "additionalProperties": False,
+            "properties": {
+                "a": {"$ref": f"#/$defs/D{i + 1}"},
+                "b": {"$ref": f"#/$defs/D{i + 1}"},
+            },
+            "required": ["a", "b"],
+        }
+    schema = {"$defs": defs, "$ref": "#/$defs/D0"}
+    t0 = time.monotonic()
+    spec = sf.compile_schema(schema)
+    assert time.monotonic() - t0 < 2.0
+    assert len(spec.nodes) <= 3 * N + 4  # linear, not exponential
+
+    with pytest.raises(sf.SchemaError, match="unsupported"):
+        sf.compile_schema({
+            "$defs": {"T": {"type": "string"}},
+            "$ref": "#/$defs/T", "pattern": "^x",
+        })
+    with pytest.raises(sf.SchemaError, match="siblings"):
+        sf.compile_schema({
+            "$defs": {"T": {"type": "string"}},
+            "$ref": "#/$defs/T", "enum": ["a"],
+        })

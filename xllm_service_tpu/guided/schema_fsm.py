@@ -22,11 +22,12 @@ Supported subset (the OpenAI structured-outputs strict profile):
   object (ordered properties, required subset, additionalProperties
   must be false), array (items + minItems/maxItems), string, enum /
   const over strings/numbers/bools/null, integer, number, boolean,
-  null. Properties are emitted in DECLARATION ORDER (optional ones may
-  be skipped) — the order OpenAI's implementation produces; it keeps
-  the automaton finite and small. anyOf / $ref / pattern / numeric
-  ranges are rejected at compile time (HTTP 400), not silently
-  ignored.
+  null, and internal NON-recursive $ref into $defs/definitions (the
+  shape pydantic's model_json_schema emits). Properties are emitted in
+  DECLARATION ORDER (optional ones may be skipped) — the order
+  OpenAI's implementation produces; it keeps the automaton finite and
+  small. anyOf / recursive $ref / pattern / numeric ranges are
+  rejected at compile time (HTTP 400), not silently ignored.
 
 Whitespace: one byte between tokens, as in json_fsm (unbounded legal
 whitespace lets a masked model burn its budget on emptiness).
@@ -105,7 +106,7 @@ def _enc_value(v) -> bytes:
 
 
 _UNSUPPORTED = (
-    "anyOf", "oneOf", "allOf", "not", "$ref", "if", "then", "else",
+    "anyOf", "oneOf", "allOf", "not", "if", "then", "else",
     "patternProperties", "pattern", "format", "minimum", "maximum",
     "exclusiveMinimum", "exclusiveMaximum", "multipleOf", "minLength",
     "maxLength", "uniqueItems", "prefixItems",
@@ -114,17 +115,63 @@ _UNSUPPORTED = (
 
 def compile_schema(schema: dict) -> SchemaSpec:
     """Validate + flatten a schema dict. Raises SchemaError outside the
-    supported subset."""
+    supported subset. Internal, NON-recursive `$ref` into `$defs` /
+    `definitions` resolves inline (pydantic's model_json_schema always
+    emits nested models this way); recursive schemas describe unbounded
+    documents and are rejected."""
     if not isinstance(schema, dict):
         raise SchemaError("schema must be an object")
+    defs = {}
+    for key in ("$defs", "definitions"):
+        d = schema.get(key)
+        if isinstance(d, dict):
+            for name, sub in d.items():
+                defs[f"#/{key}/{name}"] = sub
     nodes: List[dict] = []
+    ref_stack: List[str] = []  # cycle detection across $ref chains
+    ref_memo: Dict[str, int] = {}  # each def compiles ONCE (expansion is
+    # pure, subtrees are immutable) — without this, a DAG of doubling
+    # refs compiles to 2^N nodes from a KB-sized request body
 
     def build(node: dict) -> int:
         if not isinstance(node, dict):
             raise SchemaError("schema node must be an object")
+        # Reject unsupported keywords FIRST — including as $ref siblings
+        # (draft 2020-12 allows them; silently dropping a constraint
+        # would violate the "rejected, not ignored" contract).
         for k in _UNSUPPORTED:
             if k in node:
                 raise SchemaError(f"unsupported schema keyword: {k}")
+        ref = node.get("$ref")
+        if ref is not None:
+            extra = set(node) - {"$ref", "$defs", "definitions",
+                                 "title", "description", "default"}
+            if extra:
+                raise SchemaError(
+                    f"$ref with constraint siblings is not supported: "
+                    f"{sorted(extra)}"
+                )
+            if ref not in defs:
+                raise SchemaError(
+                    f"unresolvable $ref {ref!r} (only internal "
+                    f"#/$defs/... and #/definitions/... are supported)"
+                )
+            if ref in ref_stack:
+                raise SchemaError(
+                    f"recursive $ref {ref!r}: recursive schemas describe "
+                    f"unbounded documents and are not supported"
+                )
+            if ref in ref_memo:
+                return ref_memo[ref]
+            ref_stack.append(ref)
+            try:
+                nid = build(defs[ref])
+            finally:
+                ref_stack.pop()
+            ref_memo[ref] = nid
+            return nid
+        if len(nodes) > 4096:
+            raise SchemaError("schema too large (> 4096 nodes)")
         nid = len(nodes)
         nodes.append({})  # reserve slot (children reference by id)
         if "const" in node:
