@@ -56,7 +56,7 @@ def bench(fn, iters=32):
 
 
 def run_case(R, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16, chunk=4,
-             int8=False):
+             int8=False, window=0):
     rng = np.random.default_rng(0)
     N = R * MB + 1  # block 0 reserved garbage
     q = jnp.asarray(rng.standard_normal((R, Hq, D)), dtype)
@@ -75,8 +75,12 @@ def run_case(R, Hq, Hkv, D, BS, MB, ctx, dtype=jnp.bfloat16, chunk=4,
     )
     scale = 1.0 / D**0.5
 
-    ker = lambda: paged_attention_kernel(q, k, v, bt, lens, scale, chunk=chunk)
-    gat = lambda: paged_attention_gather(q, k, v, bt, lens, scale)
+    ker = lambda: paged_attention_kernel(
+        q, k, v, bt, lens, scale, chunk=chunk, window=window
+    )
+    gat = lambda: paged_attention_gather(
+        q, k, v, bt, lens, scale, window=window
+    )
 
     out_k = np.asarray(ker().astype(jnp.float32))
     out_g = np.asarray(gat().astype(jnp.float32))
@@ -287,7 +291,7 @@ def run_mla_case(R, Hq, kvr, dr, BS, MB, ctx, dtype=jnp.bfloat16,
 
 
 def run_prefill_case(P, Lpad, Hq, Hkv, D, BS, MB, dtype=jnp.bfloat16,
-                     int8=False, tile_q=128):
+                     int8=False, tile_q=128, window=0):
     """GQA flash prefill kernel vs the blockwise oracle on hardware."""
     from xllm_service_tpu.ops.attention import prefill_attention_blockwise
     from xllm_service_tpu.ops.pallas.flash_prefill import flash_prefill_kernel
@@ -310,14 +314,14 @@ def run_prefill_case(P, Lpad, Hq, Hkv, D, BS, MB, dtype=jnp.bfloat16,
     scale = 1.0 / D**0.5
 
     ker = lambda: flash_prefill_kernel(
-        q, k, v, bt, sp, tl, scale, tile_q=tile_q
+        q, k, v, bt, sp, tl, scale, tile_q=tile_q, window=window
     )
     # Jit ONCE (the pjit cache keys on callable identity — a fresh lambda
     # per call would recompile the oracle every timing iteration).
     jorc = jax.jit(
         lambda q_, bt_, sp_, tl_: jax.vmap(
             lambda qi, ti, s_, t_: prefill_attention_blockwise(
-                qi, k, v, ti, s_, t_, scale
+                qi, k, v, ti, s_, t_, scale, window=window
             )
         )(q_, bt_, sp_, tl_)
     )
@@ -441,6 +445,13 @@ CASES = [
     ("mla-prefill-int8", run_mla_prefill_case,
      dict(P=2, Lpad=512, Hq=128, kvr=512, dr=64, BS=128, MB=8,
           int8=True)),
+    # Sliding-window attention (round-4 flash-prefill window + the
+    # decode kernel's window path — masking AND the below-window block
+    # skip have never run on silicon)
+    ("prefill-swa", run_prefill_case,
+     dict(P=4, Lpad=512, Hq=32, Hkv=8, D=128, BS=128, MB=8, window=256)),
+    ("dec-swa", run_case,
+     dict(R=64, Hq=32, Hkv=8, D=128, BS=128, MB=16, ctx=2048, window=512)),
     # Packed-pair head_dim-64 decode (llama3-1b geometry: Hq=32 Hkv=8)
     ("dec-packed-bf16", run_packed_case,
      dict(R=64, Hq=32, Hkv=8, D=64, BS=128, MB=16, ctx=2048)),

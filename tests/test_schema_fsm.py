@@ -358,3 +358,16 @@ def test_ref_blowup_and_sibling_constraints_rejected():
             "$defs": {"T": {"type": "string"}},
             "$ref": "#/$defs/T", "enum": ["a"],
         })
+
+
+def test_ref_chain_depth_and_bad_ref_types_are_schema_errors():
+    """Pathological $ref inputs fail as SchemaError (HTTP 400), never
+    RecursionError/TypeError escaping as 500 (review findings, r4)."""
+    chain = {f"D{i}": {"$ref": f"#/$defs/D{i + 1}"} for i in range(2000)}
+    chain["D2000"] = {"type": "integer"}
+    with pytest.raises(sf.SchemaError, match="too deep"):
+        sf.compile_schema({"$defs": chain, "$ref": "#/$defs/D0"})
+    with pytest.raises(sf.SchemaError, match="must be a string"):
+        sf.compile_schema({"$ref": [1]})
+    with pytest.raises(sf.SchemaError, match="must be a string"):
+        sf.compile_schema({"$ref": {}})

@@ -144,6 +144,8 @@ def compile_schema(schema: dict) -> SchemaSpec:
                 raise SchemaError(f"unsupported schema keyword: {k}")
         ref = node.get("$ref")
         if ref is not None:
+            if not isinstance(ref, str):
+                raise SchemaError("$ref must be a string")
             extra = set(node) - {"$ref", "$defs", "definitions",
                                  "title", "description", "default"}
             if extra:
@@ -151,6 +153,10 @@ def compile_schema(schema: dict) -> SchemaSpec:
                     f"$ref with constraint siblings is not supported: "
                     f"{sorted(extra)}"
                 )
+            if len(ref_stack) >= 64:
+                # pure-ref chains never touch the node cap; bound the
+                # build() recursion (RecursionError would 500, not 400)
+                raise SchemaError("$ref chain too deep (> 64)")
             if ref not in defs:
                 raise SchemaError(
                     f"unresolvable $ref {ref!r} (only internal "
