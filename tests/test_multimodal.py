@@ -672,3 +672,90 @@ def test_qwen2vl_combined_checkpoint_serves_both_sides(tmp_path):
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(want), atol=1e-5, rtol=1e-5
     )
+
+
+def test_qwen25vl_matches_hf_reference(tmp_path):
+    """Numerical parity with the HF transformers
+    Qwen2_5_VisionTransformer on the same weights — RMSNorm blocks,
+    gated-SiLU MLP, WINDOW attention (2x2 windows at this geometry) with
+    a full-attention layer, and the RMSNorm PatchMerger."""
+    torch = pytest.importorskip("torch")
+    try:
+        from transformers.models.qwen2_5_vl.configuration_qwen2_5_vl import (
+            Qwen2_5_VLVisionConfig,
+        )
+        from transformers.models.qwen2_5_vl.modeling_qwen2_5_vl import (
+            Qwen2_5_VisionTransformerPretrainedModel,
+        )
+    except Exception:
+        pytest.skip("transformers lacks Qwen2.5-VL")
+
+    cfg = vision.get_vision_config("qwen25vl-tiny")
+    hf_cfg = Qwen2_5_VLVisionConfig(
+        depth=cfg.num_layers,
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        out_hidden_size=cfg.out_dim,
+        num_heads=cfg.num_heads,
+        patch_size=cfg.patch_size,
+        spatial_merge_size=cfg.spatial_merge_size,
+        temporal_patch_size=cfg.temporal_patch_size,
+        window_size=cfg.window_size,
+        fullatt_block_indexes=list(cfg.fullatt_block_indexes),
+        hidden_act="silu",
+        attn_implementation="eager",
+    )
+    with torch.no_grad():
+        hf = (
+            Qwen2_5_VisionTransformerPretrainedModel(hf_cfg).eval().float()
+        )
+        tensors = {
+            "visual." + n: p.detach().numpy()
+            for n, p in hf.named_parameters()
+        }
+    from xllm_service_tpu.runtime import weights as W
+
+    import json as _json
+    import os as _os
+
+    ckpt = str(tmp_path / "hf-q25vl")
+    _os.makedirs(ckpt, exist_ok=True)
+    with open(_os.path.join(ckpt, "config.json"), "w") as f:
+        _json.dump({"model_type": "qwen2_5_vl", "vision_config": {
+            "model_type": "qwen2_5_vl",
+            "hidden_size": cfg.hidden_size,
+            "intermediate_size": cfg.intermediate_size,
+            "out_hidden_size": cfg.out_dim,
+            "depth": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "patch_size": cfg.patch_size,
+            "image_size": cfg.image_size,
+            "spatial_merge_size": cfg.spatial_merge_size,
+            "temporal_patch_size": cfg.temporal_patch_size,
+            "window_size": cfg.window_size,
+            "fullatt_block_indexes": list(cfg.fullatt_block_indexes),
+        }}, f)
+    W.write_safetensors(_os.path.join(ckpt, "model.safetensors"), tensors)
+    loaded_cfg, params = W.load_vision_checkpoint(ckpt, dtype=jnp.float32)
+    assert loaded_cfg.arch == "qwen25vl"
+    assert loaded_cfg.fullatt_block_indexes == cfg.fullatt_block_indexes
+
+    rng = np.random.default_rng(13)
+    imgs = rng.random((1, cfg.image_size, cfg.image_size, 3)).astype(
+        np.float32
+    )
+    from xllm_service_tpu.models.vision import _qwen2vl_patch_rows
+
+    rows, _, _ = _qwen2vl_patch_rows(jnp.asarray(imgs), cfg)
+    g = cfg.image_size // cfg.patch_size
+    with torch.no_grad():
+        hf_out = hf(
+            torch.from_numpy(np.array(rows[0], np.float32)),
+            grid_thw=torch.tensor([[1, g, g]]),
+        ).numpy()
+
+    ours = np.asarray(
+        vision.encode_images(params, loaded_cfg, jnp.asarray(imgs))[0],
+        np.float32,
+    )
+    np.testing.assert_allclose(ours, hf_out, atol=3e-4, rtol=3e-4)

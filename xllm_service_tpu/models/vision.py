@@ -51,10 +51,16 @@ class VisionConfig:
     # matches the HF Qwen2VisionTransformer (2D rotary, fused biased QKV,
     # QuickGELU, LayerNorm eps 1e-6, PatchMerger 2x2 -> LLM dim) —
     # north-star config 4's named family, HF-parity-tested.
+    # "qwen25vl" is the Qwen2.5-VL tower (HF
+    # Qwen2_5_VisionTransformerPretrainedModel): RMSNorm blocks, gated
+    # SiLU MLP with biases, WINDOW attention (window_size pixels; full
+    # attention on fullatt_block_indexes layers), RMSNorm PatchMerger.
     arch: str = "rms"
-    # qwen2vl-only geometry (HF Qwen2VLVisionConfig names).
+    # qwen2vl/qwen25vl geometry (HF vision-config names).
     spatial_merge_size: int = 2
     temporal_patch_size: int = 2
+    window_size: int = 112  # qwen25vl: attention window in PIXELS
+    fullatt_block_indexes: tuple = ()  # qwen25vl: full-attention layers
 
     @property
     def num_patches(self) -> int:
@@ -186,6 +192,48 @@ register_vision(
 )
 
 
+register_vision(
+    VisionConfig(
+        # Test-scale Qwen2.5-VL-arch tower: 8x8 patch grid -> 4x4 merge
+        # units -> 2x2 windows of 2x2 units (window_size 32px), full
+        # attention on the last block — the real family's layer mix.
+        name="qwen25vl-tiny",
+        image_size=64,
+        patch_size=8,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=4,
+        num_heads=4,
+        out_tokens=16,
+        out_dim=128,
+        rms_norm_eps=1e-6,
+        arch="qwen25vl",
+        window_size=32,
+        fullatt_block_indexes=(3,),
+    )
+)
+
+register_vision(
+    VisionConfig(
+        # HF Qwen/Qwen2.5-VL-7B-Instruct visual tower dims (square 448
+        # serving default; window 112px -> 4x4 merge-unit windows).
+        name="qwen2.5-vl-7b-visual",
+        image_size=448,
+        patch_size=14,
+        hidden_size=1280,
+        intermediate_size=3420,
+        num_layers=32,
+        num_heads=16,
+        out_tokens=256,
+        out_dim=3584,
+        rms_norm_eps=1e-6,
+        arch="qwen25vl",
+        window_size=112,
+        fullatt_block_indexes=(7, 15, 23, 31),
+    )
+)
+
+
 def init_vision_params(cfg: VisionConfig, key, dtype=jnp.bfloat16) -> Params:
     keys = jax.random.split(key, 12)
     E, L = cfg.hidden_size, cfg.num_layers
@@ -198,6 +246,32 @@ def init_vision_params(cfg: VisionConfig, key, dtype=jnp.bfloat16) -> Params:
             jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)
         ).astype(dtype)
 
+    if cfg.arch == "qwen25vl":
+        F = cfg.intermediate_size
+        M = E * cfg.spatial_merge_size**2
+        qdim = patch_dim * cfg.temporal_patch_size
+        return {
+            "patch_embed": w(keys[0], (qdim, E), qdim),
+            "layers": {
+                "ln1_w": jnp.ones((L, E), jnp.float32),
+                "wqkv": w(keys[2], (L, E, 3 * E), E),
+                "bqkv": jnp.zeros((L, 3 * E), dtype),
+                "wo": w(keys[3], (L, E, E), E),
+                "bo": jnp.zeros((L, E), dtype),
+                "ln2_w": jnp.ones((L, E), jnp.float32),
+                "w_gate": w(keys[4], (L, E, F), E),
+                "b_gate": jnp.zeros((L, F), dtype),
+                "w_up": w(keys[5], (L, E, F), E),
+                "b_up": jnp.zeros((L, F), dtype),
+                "w_down": w(keys[6], (L, F, E), F),
+                "b_down": jnp.zeros((L, E), dtype),
+            },
+            "merger_ln_w": jnp.ones((E,), jnp.float32),
+            "merger_fc1": w(keys[7], (M, M), M),
+            "merger_b1": jnp.zeros((M,), dtype),
+            "merger_fc2": w(keys[8], (M, cfg.out_dim), M),
+            "merger_b2": jnp.zeros((cfg.out_dim,), dtype),
+        }
     if cfg.arch == "qwen2vl":
         F = cfg.intermediate_size
         M = E * cfg.spatial_merge_size**2
@@ -352,6 +426,46 @@ def _qwen2vl_patch_rows(images: jnp.ndarray, cfg: VisionConfig):
     return rows, h_ids, w_ids
 
 
+def _rot_half(t):
+    a, b = jnp.split(t, 2, axis=-1)
+    return jnp.concatenate([-b, a], axis=-1)
+
+
+def _qwen2vl_rope_tables(h_ids, w_ids, D: int):
+    """2D vision rotary tables, shared by both Qwen-VL generations:
+    VisionRotaryEmbedding(head_dim // 2) -> inv_freq of length
+    head_dim//4 per axis; emb = cat(h_freqs, w_freqs) doubled. Returns
+    (cos, sin) as [N, D] float32."""
+    import numpy as _np
+
+    hd4 = D // 4
+    inv = 1.0 / (
+        10000.0 ** (_np.arange(0, hd4, dtype=_np.float64) / hd4)
+    )
+    half = _np.concatenate(
+        [h_ids[:, None] * inv[None], w_ids[:, None] * inv[None]], axis=1
+    )  # [N, D/2]
+    emb = _np.concatenate([half, half], axis=1)  # [N, D]
+    return (
+        jnp.asarray(_np.cos(emb), jnp.float32),
+        jnp.asarray(_np.sin(emb), jnp.float32),
+    )
+
+
+def _merger_mlp(params: Params, cfg: VisionConfig, x: jnp.ndarray):
+    """PatchMerger tail shared by both generations: group m^2 consecutive
+    rows, fc1 -> exact-erf GELU -> fc2 (nn.GELU default is erf)."""
+    B, N = x.shape[0], x.shape[1]
+    m2 = cfg.spatial_merge_size**2
+    x = x.reshape(B, N // m2, m2 * cfg.hidden_size)
+    h = jnp.einsum("bnm,mf->bnf", x, params["merger_fc1"]) + params["merger_b1"]
+    h = jax.nn.gelu(h, approximate=False)
+    return (
+        jnp.einsum("bnf,fd->bnd", h, params["merger_fc2"])
+        + params["merger_b2"]
+    )
+
+
 def _encode_qwen2vl(
     params: Params, cfg: VisionConfig, images: jnp.ndarray
 ) -> jnp.ndarray:
@@ -361,8 +475,6 @@ def _encode_qwen2vl(
     QuickGELU MLP, full (non-causal) attention over the image's patches,
     then PatchMerger (ln_q -> 2x2 concat -> GELU MLP -> LLM dim).
     Reference: transformers modeling_qwen2_vl.py."""
-    import numpy as _np
-
     B = images.shape[0]
     H, D = cfg.num_heads, cfg.hidden_size // cfg.num_heads
     m2 = cfg.spatial_merge_size**2
@@ -371,22 +483,10 @@ def _encode_qwen2vl(
     )
     x = jnp.einsum("bnp,pe->bne", rows, params["patch_embed"])  # [B, N, E]
 
-    # 2D rotary: VisionRotaryEmbedding(head_dim // 2) -> inv_freq of
-    # length head_dim//4 per axis; emb = cat(h_freqs, w_freqs) doubled.
-    hd4 = D // 4
-    inv = 1.0 / (
-        10000.0 ** (_np.arange(0, hd4, dtype=_np.float64) / hd4)
-    )
-    half = _np.concatenate(
-        [h_ids[:, None] * inv[None], w_ids[:, None] * inv[None]], axis=1
-    )  # [N, D/2]
-    emb = _np.concatenate([half, half], axis=1)  # [N, D]
-    cos = jnp.asarray(_np.cos(emb), jnp.float32)[None, :, None, :]
-    sin = jnp.asarray(_np.sin(emb), jnp.float32)[None, :, None, :]
-
-    def rot_half(t):
-        a, b = jnp.split(t, 2, axis=-1)
-        return jnp.concatenate([-b, a], axis=-1)
+    cos_t, sin_t = _qwen2vl_rope_tables(h_ids, w_ids, D)
+    cos = cos_t[None, :, None, :]
+    sin = sin_t[None, :, None, :]
+    rot_half = _rot_half
 
     def layer_fn(x, lp):
         h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], cfg.rms_norm_eps)
@@ -413,14 +513,105 @@ def _encode_qwen2vl(
     x = layer_norm(
         x, params["merger_ln_w"], params["merger_ln_b"], cfg.rms_norm_eps
     )
-    N = x.shape[1]
-    x = x.reshape(B, N // m2, m2 * cfg.hidden_size)
-    h = jnp.einsum("bnm,mf->bnf", x, params["merger_fc1"]) + params["merger_b1"]
-    h = jax.nn.gelu(h, approximate=False)  # nn.GELU default: exact erf
-    return (
-        jnp.einsum("bnf,fd->bnd", h, params["merger_fc2"])
-        + params["merger_b2"]
+    return _merger_mlp(params, cfg, x)
+
+
+def _qwen25_window_perm(cfg: VisionConfig):
+    """Merge-UNIT permutation into window order (HF get_window_index for
+    a square grid with no padding): units (hg, wg) row-major -> windows
+    (win_h, win_w) of win x win units each, units row-major inside.
+    Returns (unit_perm [U], inverse [U], win_units) as numpy."""
+    import numpy as _np
+
+    gg = cfg.image_size // cfg.patch_size // cfg.spatial_merge_size
+    wu = cfg.window_size // cfg.spatial_merge_size // cfg.patch_size
+    if wu <= 0 or gg % wu:
+        raise ValueError(
+            f"window_size {cfg.window_size} must cover a whole number of "
+            f"merge units dividing the {gg}-unit grid"
+        )
+    idx = _np.arange(gg * gg).reshape(gg // wu, wu, gg // wu, wu)
+    perm = idx.transpose(0, 2, 1, 3).reshape(-1)
+    return perm, _np.argsort(perm), wu
+
+
+def _encode_qwen25vl(
+    params: Params, cfg: VisionConfig, images: jnp.ndarray
+) -> jnp.ndarray:
+    """HF Qwen2_5_VisionTransformer: the qwen2vl patch pipeline with
+    RMSNorm blocks, gated-SiLU MLP (biased), and WINDOW attention —
+    hidden states permute into window order at merge-unit granularity,
+    windowed layers attend within each (equal-size) window, the layers
+    in fullatt_block_indexes attend globally, and the merger output
+    permutes back. One scanned block body (lax.cond picks the attention
+    scope per layer — a 32-deep python unroll would inflate the traced
+    HLO 32x). Reference: transformers modeling_qwen2_5_vl.py."""
+    import numpy as _np
+
+    B = images.shape[0]
+    H, D = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    m2 = cfg.spatial_merge_size**2
+    rows, h_ids, w_ids = _qwen2vl_patch_rows(
+        images.astype(params["patch_embed"].dtype), cfg
     )
+    x = jnp.einsum("bnp,pe->bne", rows, params["patch_embed"])  # [B, N, E]
+    N = x.shape[1]
+
+    unit_perm, unit_inv, wu = _qwen25_window_perm(cfg)
+    row_perm = (
+        unit_perm[:, None] * m2 + _np.arange(m2)[None, :]
+    ).reshape(-1)
+    x = x[:, jnp.asarray(row_perm)]
+    W = wu * wu * m2  # rows per window (all equal: no padding)
+    nW = N // W
+
+    cos_t, sin_t = _qwen2vl_rope_tables(h_ids[row_perm], w_ids[row_perm], D)
+    cos = cos_t[None, :, None, :]
+    sin = sin_t[None, :, None, :]
+
+    def attend(q, k, v):
+        # q/k/v [..., T, H, D] f32 within one attention scope
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D**-0.5)
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    fullatt = jnp.asarray(
+        [li in cfg.fullatt_block_indexes for li in range(cfg.num_layers)]
+    )
+
+    def layer_fn(x, scanned):
+        lp, full = scanned
+        h = rms_norm(x, lp["ln1_w"], cfg.rms_norm_eps)
+        qkv = jnp.einsum("bne,ef->bnf", h, lp["wqkv"]) + lp["bqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, N, H, D).astype(jnp.float32)
+        k = k.reshape(B, N, H, D).astype(jnp.float32)
+        v = v.reshape(B, N, H, D).astype(jnp.float32)
+        q = q * cos + _rot_half(q) * sin
+        k = k * cos + _rot_half(k) * sin
+        attn = jax.lax.cond(
+            full,
+            lambda args: attend(*args),
+            lambda args: attend(
+                *(t.reshape(B * nW, W, H, D) for t in args)
+            ).reshape(B, N, H, D),
+            (q, k, v),
+        )
+        attn = attn.reshape(B, N, -1).astype(x.dtype)
+        x = x + jnp.einsum("bne,ef->bnf", attn, lp["wo"]) + lp["bo"]
+        h = rms_norm(x, lp["ln2_w"], cfg.rms_norm_eps)
+        gate = jnp.einsum("bne,ef->bnf", h, lp["w_gate"]) + lp["b_gate"]
+        up = jnp.einsum("bne,ef->bnf", h, lp["w_up"]) + lp["b_up"]
+        x = x + (
+            jnp.einsum("bnf,fe->bne", jax.nn.silu(gate) * up, lp["w_down"])
+            + lp["b_down"]
+        )
+        return x, None
+
+    x, _ = jax.lax.scan(layer_fn, x, (params["layers"], fullatt))
+    x = rms_norm(x, params["merger_ln_w"], cfg.rms_norm_eps)
+    out = _merger_mlp(params, cfg, x)
+    return out[:, jnp.asarray(unit_inv)]
 
 
 def encode_images(
@@ -431,6 +622,8 @@ def encode_images(
         return _encode_siglip(params, cfg, images)
     if cfg.arch == "qwen2vl":
         return _encode_qwen2vl(params, cfg, images)
+    if cfg.arch == "qwen25vl":
+        return _encode_qwen25vl(params, cfg, images)
     B = images.shape[0]
     H, D = cfg.num_heads, cfg.hidden_size // cfg.num_heads
     x = _patchify(images.astype(params["patch_embed"].dtype), cfg.patch_size)

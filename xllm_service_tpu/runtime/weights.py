@@ -159,14 +159,15 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
         hf = json.load(f)
     archs = hf.get("architectures") or ["LlamaForCausalLM"]
     arch = archs[0]
-    if arch == "Qwen2VLForConditionalGeneration":
-        # Qwen2-VL: the text tower is a plain Qwen2 stack (the `visual.*`
-        # tensors load separately via load_vision_checkpoint); newer HF
-        # configs nest the text fields under text_config. Image spans are
-        # served with sequential (LLaVA-style) positions — HF's grid
-        # M-RoPE collapses to standard RoPE whenever the three position
-        # components are equal, which holds for all text tokens and every
-        # decode step, so text requests are HF-exact (docs/ARCHITECTURE).
+    if arch in (
+        "Qwen2VLForConditionalGeneration",
+        "Qwen2_5_VLForConditionalGeneration",
+    ):
+        # Qwen2-VL / Qwen2.5-VL: the text tower is a plain Qwen2 stack
+        # (the `visual.*` tensors load separately via
+        # load_vision_checkpoint); newer HF configs nest the text fields
+        # under text_config. mrope_section feeds the full M-RoPE path
+        # (ops/rope.apply_mrope + engine position streams).
         hf = {**hf, **(hf.get("text_config") or {})}
         arch = "Qwen2ForCausalLM"
         rs = hf.get("rope_scaling") or {}
@@ -593,6 +594,11 @@ def vision_config_from_hf(path: str, out_dim: int = 0):
     with open(os.path.join(path, "config.json")) as f:
         hf = json.load(f)
     vc = hf.get("vision_config", hf)
+    if (
+        vc.get("model_type") == "qwen2_5_vl"
+        or "fullatt_block_indexes" in vc
+    ):
+        return _qwen25vl_vision_config(hf, vc, out_dim)
     if vc.get("model_type") == "qwen2_vl" or "embed_dim" in vc:
         return _qwen2vl_vision_config(hf, vc, out_dim)
     image_size = int(vc["image_size"])
@@ -657,6 +663,71 @@ def _qwen2vl_vision_config(hf: dict, vc: dict, out_dim: int = 0):
     )
 
 
+def _qwen25vl_vision_config(hf: dict, vc: dict, out_dim: int = 0):
+    """VisionConfig for an HF Qwen2_5_VLVisionConfig dict (hidden_size is
+    the TOWER width here, out_hidden_size the LLM dim — the names moved
+    between the two generations)."""
+    from xllm_service_tpu.models.vision import VisionConfig
+
+    E = int(vc["hidden_size"])
+    merge = int(vc.get("spatial_merge_size", 2))
+    image_size = int(vc.get("image_size", 448))
+    patch = int(vc["patch_size"])
+    if image_size % patch:
+        raise ValueError(
+            f"image_size {image_size} not divisible by patch_size {patch}"
+        )
+    grid = image_size // patch
+    if grid % merge:
+        raise ValueError(
+            f"image_size {image_size} / patch {patch} not divisible by "
+            f"spatial_merge_size {merge}"
+        )
+    return VisionConfig(
+        name="qwen2_5_vl-visual",
+        image_size=image_size,
+        patch_size=patch,
+        hidden_size=E,
+        intermediate_size=int(vc["intermediate_size"]),
+        num_layers=int(vc["depth"]),
+        num_heads=int(vc["num_heads"]),
+        out_tokens=grid * grid // (merge * merge),
+        out_dim=out_dim or int(vc.get("out_hidden_size") or E),
+        rms_norm_eps=1e-6,
+        arch="qwen25vl",
+        spatial_merge_size=merge,
+        temporal_patch_size=int(vc.get("temporal_patch_size", 2)),
+        window_size=int(vc.get("window_size", 112)),
+        fullatt_block_indexes=tuple(
+            int(i) for i in (vc.get("fullatt_block_indexes") or ())
+        ),
+    )
+
+
+# HF Qwen2_5_VisionTransformer layer tensor name -> (leaf key, transpose).
+_QWEN25VL_LAYER = {
+    "norm1.weight": ("ln1_w", False),
+    "attn.qkv.weight": ("wqkv", True),
+    "attn.qkv.bias": ("bqkv", False),
+    "attn.proj.weight": ("wo", True),
+    "attn.proj.bias": ("bo", False),
+    "norm2.weight": ("ln2_w", False),
+    "mlp.gate_proj.weight": ("w_gate", True),
+    "mlp.gate_proj.bias": ("b_gate", False),
+    "mlp.up_proj.weight": ("w_up", True),
+    "mlp.up_proj.bias": ("b_up", False),
+    "mlp.down_proj.weight": ("w_down", True),
+    "mlp.down_proj.bias": ("b_down", False),
+}
+_QWEN25VL_SIMPLE = {
+    "visual.merger.ln_q.weight": ("merger_ln_w", False, np.float32),
+    "visual.merger.mlp.0.weight": ("merger_fc1", True, None),
+    "visual.merger.mlp.0.bias": ("merger_b1", False, None),
+    "visual.merger.mlp.2.weight": ("merger_fc2", True, None),
+    "visual.merger.mlp.2.bias": ("merger_b2", False, None),
+}
+
+
 # HF Qwen2VisionTransformer layer tensor name -> (leaf key, transpose).
 _QWEN2VL_LAYER = {
     "norm1.weight": ("ln1_w", False),
@@ -691,6 +762,12 @@ def _load_qwen2vl_visual(path: str, cfg, dtype, np_dtype):
 
     E, L, P = cfg.hidden_size, cfg.num_layers, cfg.patch_size
     T = cfg.temporal_patch_size
+    layer_map = (
+        _QWEN25VL_LAYER if cfg.arch == "qwen25vl" else _QWEN2VL_LAYER
+    )
+    simple_map = (
+        _QWEN25VL_SIMPLE if cfg.arch == "qwen25vl" else _QWEN2VL_SIMPLE
+    )
     # Stage over EMPTY buffers shaped by init (no random generation —
     # unlike the SigLIP path, every tensor must land or this raises, so
     # values are always overwritten; a 675M-param tower shouldn't pay a
@@ -701,11 +778,11 @@ def _load_qwen2vl_visual(path: str, cfg, dtype, np_dtype):
             lambda: init_vision_params(cfg, jax.random.key(0), dtype)
         ),
     )
-    needed = {"patch_embed"} | {k for k, _, _ in _QWEN2VL_SIMPLE.values()}
-    needed |= {f"layers.{k}" for k, _ in _QWEN2VL_LAYER.values()}
+    needed = {"patch_embed"} | {k for k, _, _ in simple_map.values()}
+    needed |= {f"layers.{k}" for k, _ in layer_map.values()}
     landed = set()
     layer_seen = {
-        f"layers.{k}": np.zeros(L, bool) for k, _ in _QWEN2VL_LAYER.values()
+        f"layers.{k}": np.zeros(L, bool) for k, _ in layer_map.values()
     }
     for file in _shard_files(path):
         for name, arr in read_safetensors(file):
@@ -715,16 +792,16 @@ def _load_qwen2vl_visual(path: str, cfg, dtype, np_dtype):
                 w = np.asarray(arr).reshape(E, 3 * T * P * P).T
                 params["patch_embed"] = w.astype(np_dtype)
                 landed.add("patch_embed")
-            elif name in _QWEN2VL_SIMPLE:
-                key, transpose, want = _QWEN2VL_SIMPLE[name]
+            elif name in simple_map:
+                key, transpose, want = simple_map[name]
                 src = np.asarray(arr).T if transpose else np.asarray(arr)
                 params[key] = src.astype(want or np_dtype)
                 landed.add(key)
             elif name.startswith("visual.blocks."):
                 rest = name[len("visual.blocks."):]
                 layer_s, _, tail = rest.partition(".")
-                if tail in _QWEN2VL_LAYER:
-                    key, transpose = _QWEN2VL_LAYER[tail]
+                if tail in layer_map:
+                    key, transpose = layer_map[tail]
                     src = arr.T if transpose else arr
                     buf = params["layers"][key]
                     np.copyto(buf[int(layer_s)], src, casting="unsafe")
@@ -825,7 +902,7 @@ def load_vision_checkpoint(
 
     cfg = cfg or vision_config_from_hf(path, out_dim=out_dim)
     np_dtype = ml_dtypes.bfloat16 if dtype == jnp.bfloat16 else np.dtype(dtype)
-    if cfg.arch == "qwen2vl":
+    if cfg.arch in ("qwen2vl", "qwen25vl"):
         return _load_qwen2vl_visual(path, cfg, dtype, np_dtype)
     E, L, P = cfg.hidden_size, cfg.num_layers, cfg.patch_size
 
