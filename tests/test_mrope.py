@@ -399,3 +399,143 @@ def test_epd_qwen2vl_combined_checkpoint_uses_mrope(tmp_path):
         mix.stop()
         master.stop()
         store.close()
+
+
+def test_epd_qwen25vl_combined_checkpoint(tmp_path):
+    """Qwen2.5-VL production EPD shape: one combined checkpoint dir
+    (visual.* window-attention tower + Qwen2 text stack with
+    mrope_section) served over the full HTTP path."""
+    torch = pytest.importorskip("torch")
+    try:
+        from transformers.models.qwen2_5_vl.configuration_qwen2_5_vl import (
+            Qwen2_5_VLVisionConfig,
+        )
+        from transformers.models.qwen2_5_vl.modeling_qwen2_5_vl import (
+            Qwen2_5_VisionTransformerPretrainedModel,
+        )
+    except Exception:
+        pytest.skip("transformers lacks Qwen2.5-VL")
+
+    jax.config.update("jax_platforms", "cpu")
+    from xllm_service_tpu.api import Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+    from xllm_service_tpu.models import vision as V
+    from xllm_service_tpu.runtime import weights as W
+    from tests.test_api_e2e import http_post, wait_until
+    from tests.test_multimodal import _raw_data_url
+
+    vcfg = V.get_vision_config("qwen25vl-tiny")
+    hf_vis_cfg = Qwen2_5_VLVisionConfig(
+        depth=vcfg.num_layers, hidden_size=vcfg.hidden_size,
+        intermediate_size=vcfg.intermediate_size,
+        out_hidden_size=128, num_heads=vcfg.num_heads,
+        patch_size=vcfg.patch_size,
+        spatial_merge_size=vcfg.spatial_merge_size,
+        temporal_patch_size=vcfg.temporal_patch_size,
+        window_size=vcfg.window_size,
+        fullatt_block_indexes=list(vcfg.fullatt_block_indexes),
+        hidden_act="silu", attn_implementation="eager",
+    )
+    torch.manual_seed(1)
+    with torch.no_grad():
+        tower = (
+            Qwen2_5_VisionTransformerPretrainedModel(hf_vis_cfg)
+            .eval().float()
+        )
+    # text side: tiny llama-layout stack exported in Qwen2 layout
+    import dataclasses
+
+    from xllm_service_tpu.models import llama
+    from xllm_service_tpu.models.configs import get_model_config
+
+    lcfg = dataclasses.replace(
+        get_model_config("llama3-tiny"), name="q25vl-text", attn_bias=True
+    )
+    lparams = llama.init_params(lcfg, jax.random.key(8), dtype=jnp.float32)
+    ckpt = str(tmp_path / "q25vl-full")
+    W.save_hf_checkpoint(lparams, lcfg, ckpt)
+    tensors = dict(
+        W.read_safetensors(_os.path.join(ckpt, "model.safetensors"))
+    )
+    tensors = {k: np.array(v) for k, v in tensors.items()}
+    for n, p in tower.named_parameters():
+        tensors["visual." + n] = p.detach().numpy()
+    W.write_safetensors(_os.path.join(ckpt, "model.safetensors"), tensors)
+    with open(_os.path.join(ckpt, "config.json")) as f:
+        combined = _json.load(f)
+    combined["architectures"] = ["Qwen2_5_VLForConditionalGeneration"]
+    combined["model_type"] = "qwen2_5_vl"
+    combined["rope_scaling"] = {
+        "type": "mrope", "mrope_section": list(SECTION)
+    }
+    combined["vision_config"] = {
+        "model_type": "qwen2_5_vl",
+        "hidden_size": vcfg.hidden_size,
+        "intermediate_size": vcfg.intermediate_size,
+        "out_hidden_size": 128,
+        "depth": vcfg.num_layers, "num_heads": vcfg.num_heads,
+        "patch_size": vcfg.patch_size, "image_size": vcfg.image_size,
+        "spatial_merge_size": vcfg.spatial_merge_size,
+        "temporal_patch_size": vcfg.temporal_patch_size,
+        "window_size": vcfg.window_size,
+        "fullatt_block_indexes": list(vcfg.fullatt_block_indexes),
+    }
+    with open(_os.path.join(ckpt, "config.json"), "w") as f:
+        _json.dump(combined, f)
+
+    store = MemoryStore(clock=lambda: 0.0)
+    master = Master(ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0, block_size=16,
+        mm_tokens_per_media=vcfg.out_tokens,  # 16
+    ), store=store)
+    master.start()
+
+    def mk(name, itype):
+        ecfg = EngineConfig(
+            model="q25vl", dtype="float32", block_size=16, num_blocks=64,
+            max_running_requests=4, max_seq_len=256,
+            prefill_buckets=[32, 64, 128], instance_name=name,
+            instance_type=itype, checkpoint_path=ckpt,
+        )
+        srv = InstanceServer(
+            ecfg, master_rpc_addr=master.rpc_address,
+            heartbeat_interval_s=0.2,
+        )
+        srv.start()
+        return srv
+
+    enc = mk("q25-e", "ENCODE")
+    mix = mk("q25-m", "MIX")
+    try:
+        assert mix.engine.executor.cfg.mrope_section == SECTION
+        assert enc.engine.executor.cfg.arch == "qwen25vl"
+        assert wait_until(
+            lambda: master.scheduler.instance_mgr.counts()[2] == 1
+            and sum(master.scheduler.instance_mgr.counts()) == 2
+        )
+        img_a = np.full((64, 64, 3), 0.9, np.float32)
+        img_b = np.zeros((64, 64, 3), np.float32)
+
+        def ask(img):
+            code, body = http_post(
+                master.http_address, "/v1/chat/completions",
+                {"model": "q25vl", "max_tokens": 6, "temperature": 0.0,
+                 "messages": [{"role": "user", "content": [
+                     {"type": "text", "text": "d "},
+                     {"type": "image_url",
+                      "image_url": {"url": _raw_data_url(img)}},
+                 ]}]},
+                timeout=300.0,
+            )
+            assert code == 200, body
+            return body["choices"][0]["message"]["content"]
+
+        assert ask(img_a) != ask(img_b)
+    finally:
+        enc.stop()
+        mix.stop()
+        master.stop()
+        store.close()
