@@ -31,6 +31,12 @@ class ModelConfig:
     moe_intermediate_size: int = 0
     # Sliding-window attention (0 = full).
     sliding_window: int = 0
+    # Gemma-family deltas: GELU-tanh gated MLP (vs SwiGLU), embeddings
+    # scaled by sqrt(hidden_size), and zero-centered RMSNorm weights in
+    # the CHECKPOINT (the loader adds 1 so rms_norm stays uniform).
+    mlp_act: str = "silu"
+    embed_scale: bool = False
+    norm_zero_centered: bool = False
     # Qwen2-VL M-RoPE half-dim sections ((t, h, w) streams; empty =
     # standard 1D RoPE). Equal streams reduce M-RoPE to standard RoPE,
     # so text tokens and decode steps need no special handling; image
@@ -171,6 +177,26 @@ register(
         num_experts=8,
         num_experts_per_tok=2,
         moe_intermediate_size=128,
+        max_position_embeddings=1024,
+    )
+)
+
+register(
+    ModelConfig(
+        name="gemma-tiny",
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=32,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+        mlp_act="gelu_tanh",
+        embed_scale=True,
+        norm_zero_centered=True,
         max_position_embeddings=1024,
     )
 )

@@ -150,6 +150,22 @@ def _project(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
                       params["lm_head"].astype(jnp.float32))
 
 
+def _act(cfg: ModelConfig):
+    """Gated-MLP activation: SwiGLU (default) or Gemma's GELU-tanh."""
+    if cfg.mlp_act == "gelu_tanh":
+        return lambda t: jax.nn.gelu(t, approximate=True)
+    return jax.nn.silu
+
+
+def _embed(params: Params, cfg: ModelConfig, token_ids, wd) -> jnp.ndarray:
+    """Token embeddings in weight dtype; Gemma scales by sqrt(E) (HF
+    computes the normalizer in model dtype)."""
+    x = params["embed"][token_ids].astype(wd)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
+    return x
+
+
 def _mlp(
     lp: Dict[str, jnp.ndarray], cfg: ModelConfig, x: jnp.ndarray,
     lora_idx=None,
@@ -162,7 +178,7 @@ def _mlp(
         gate = gate + d if d is not None else gate
         d = lora_ops.maybe_apply(lp, "w_up", x, lora_idx, 1.0)
         up = up + d if d is not None else up
-        h = jax.nn.silu(gate) * up
+        h = _act(cfg)(gate) * up
         out = jnp.einsum("tf,fe->te", h, wt(lp["w_down"]))
         d = lora_ops.maybe_apply(lp, "w_down", h, lora_idx, 1.0)
         return out + d if d is not None else out
@@ -184,7 +200,7 @@ def _mlp(
     gate = jnp.einsum("te,xef->txf", x, wt(lp["w_gate"]))
     up = jnp.einsum("te,xef->txf", x, wt(lp["w_up"]))
     expert_out = jnp.einsum(
-        "txf,xfe->txe", jax.nn.silu(gate) * up, wt(lp["w_down"])
+        "txf,xfe->txe", _act(cfg)(gate) * up, wt(lp["w_down"])
     )
     out = jnp.einsum("txe,tx->te", expert_out, combine.astype(expert_out.dtype))
     if cfg.n_shared_experts > 0:
@@ -272,7 +288,7 @@ def decode_step(
     k_caches', v_caches')."""
     bs = k_caches.shape[3]
     scale = cfg.head_dim**-0.5
-    x = params["embed"][token_ids].astype(wdtype(params["layers"]["wq"]))  # [R, E]
+    x = _embed(params, cfg, token_ids, wdtype(params["layers"]["wq"]))  # [R, E]
 
     # Rope positions may lag cache positions (Qwen2-VL M-RoPE compresses
     # image spans): rope_delta <= 0 shifts the ROTATION only — cache
@@ -339,7 +355,7 @@ def prefill_batch_step(
     bs = k_caches.shape[3]
     scale = cfg.head_dim**-0.5
     P, Lpad = token_ids.shape
-    x = params["embed"][token_ids].astype(wdtype(params["layers"]["wq"]))
+    x = _embed(params, cfg, token_ids, wdtype(params["layers"]["wq"]))
     if embed_overrides is not None and embed_overrides.shape[1] > 0:
         # Scatter into an extended buffer whose last row is a discard slot
         # so padded positions (== Lpad) never corrupt real rows.
@@ -457,7 +473,7 @@ def prefill_sp_step(
 
     Lsp = token_ids.shape[0]
     positions = jnp.arange(Lsp, dtype=jnp.int32)
-    x = params["embed"][token_ids].astype(wdtype(params["layers"]["wq"]))
+    x = _embed(params, cfg, token_ids, wdtype(params["layers"]["wq"]))
     x = x[None]  # [1, Lsp, E] — ring_attention is batched
 
     def layer_fn(x, lp):
@@ -502,7 +518,7 @@ def hidden_dense(
     forward_dense unembeds."""
     B, L = token_ids.shape
     scale = cfg.head_dim**-0.5
-    x = params["embed"][token_ids].astype(wdtype(params["layers"]["wq"]))
+    x = _embed(params, cfg, token_ids, wdtype(params["layers"]["wq"]))
     positions = jnp.arange(L, dtype=jnp.int32)
     causal = jnp.tril(jnp.ones((L, L), dtype=bool))
     if cfg.sliding_window:

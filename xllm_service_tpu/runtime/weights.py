@@ -191,6 +191,22 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
         sliding_window=_hf_sliding_window(hf),
         mrope_section=tuple(hf.get("_mrope_section") or ()),
     )
+    if arch == "GemmaForCausalLM":
+        # Gemma: Llama tensor layout + GELU-tanh gated MLP, sqrt(E)
+        # embedding scale, zero-centered RMSNorm weights (the loader
+        # adds 1 below so ops/norms.rms_norm stays uniform). Real Gemma
+        # config.json files OMIT tie_word_embeddings (HF's GemmaConfig
+        # defaults it True and drops default-valued keys), so the
+        # absent-key default flips to True here — False would demand an
+        # lm_head tensor no Gemma checkpoint ships.
+        common.update(
+            mlp_act="gelu_tanh", embed_scale=True,
+            norm_zero_centered=True,
+            tie_word_embeddings=bool(
+                hf.get("tie_word_embeddings", True)
+            ),
+        )
+        arch = "LlamaForCausalLM"
     if arch == "Qwen2ForCausalLM":
         common["attn_bias"] = True
     elif arch == "Qwen3ForCausalLM":
@@ -564,6 +580,11 @@ def load_checkpoint(
                 np.transpose(raw[..., dn:], (0, 2, 1, 3))
             )
 
+    if cfg.norm_zero_centered:
+        # Gemma convention: checkpoint stores w, computation uses (1+w).
+        for key, buf in staging.items():
+            if _is_norm_leaf(key):
+                buf += 1.0
     params: Params = {"layers": {}}
     if cfg.first_k_dense_replace > 0:
         params["dense_layers"] = {}
@@ -1049,7 +1070,9 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
     model.safetensors) — the inverse of load_checkpoint. Used by the
     round-trip test and for exporting synthetic checkpoints."""
     os.makedirs(path, exist_ok=True)
-    if cfg.is_mla:
+    if cfg.norm_zero_centered:
+        arch = "GemmaForCausalLM"
+    elif cfg.is_mla:
         arch = "DeepseekV2ForCausalLM"
     elif cfg.is_moe and cfg.qk_norm:
         arch = "Qwen3MoeForCausalLM"
@@ -1114,9 +1137,13 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
         a = np.asarray(x)
         return a.astype(ml_dtypes.bfloat16) if a.dtype == ml_dtypes.bfloat16 else a
 
+    def norm_out(x) -> np.ndarray:
+        # Gemma checkpoints store zero-centered norm weights (load adds 1)
+        return host(x) - 1.0 if cfg.norm_zero_centered else host(x)
+
     tensors: Dict[str, np.ndarray] = {
         "model.embed_tokens.weight": host(params["embed"]),
-        "model.norm.weight": host(params["final_norm"]),
+        "model.norm.weight": norm_out(params["final_norm"]),
     }
     if not cfg.tie_word_embeddings:
         tensors["lm_head.weight"] = host(params["lm_head"]).T
@@ -1129,8 +1156,8 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
         else:
             lp, i, layer_moe = params["layers"], hf_i - kd, cfg.is_moe
         pre = f"model.layers.{hf_i}."
-        tensors[pre + "input_layernorm.weight"] = host(lp["attn_norm"])[i]
-        tensors[pre + "post_attention_layernorm.weight"] = host(lp["mlp_norm"])[i]
+        tensors[pre + "input_layernorm.weight"] = norm_out(lp["attn_norm"])[i]
+        tensors[pre + "post_attention_layernorm.weight"] = norm_out(lp["mlp_norm"])[i]
         if cfg.is_mla:
             dn, dv = cfg.qk_nope_head_dim, cfg.v_head_dim
             kvr, Hq = cfg.kv_lora_rank, cfg.num_heads
