@@ -265,10 +265,22 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
                 moe_intermediate_size=hf["moe_intermediate_size"],
                 n_shared_experts=int(hf.get("n_shared_experts") or 0),
             )
+    elif arch == "Phi3ForCausalLM":
+        # Phi-3's fused tensors split on load. longrope-scaled variants
+        # (128k) interpolate per-band factors our plain-theta rope
+        # doesn't implement — fail LOUDLY rather than serve silently
+        # diverging logits.
+        if hf.get("rope_scaling"):
+            raise NotImplementedError(
+                "Phi-3 rope_scaling (longrope) is not supported; "
+                "4k-class checkpoints without rope_scaling load fine"
+            )
     elif arch not in ("LlamaForCausalLM", "MistralForCausalLM"):
         # Mistral is architecturally Llama (same tensor names, bias-free
         # QKV) + sliding-window attention, which _hf_sliding_window
-        # already picked up from the config.
+        # already picked up from the config. Phi-3 is Llama with FUSED
+        # qkv_proj / gate_up_proj tensors, split on load by the config's
+        # head/intermediate geometry (load_checkpoint).
         raise ValueError(f"unsupported architecture {arch!r}")
     return ModelConfig(**common)
 
@@ -536,6 +548,47 @@ def load_checkpoint(
 
     for file in _shard_files(path):
         for name, arr in read_safetensors(file):
+            # Phi-3 fuses QKV and gate/up into single tensors; split by
+            # the config's head/intermediate geometry (row order q,k,v /
+            # gate,up — HF Phi3Attention/Phi3MLP slicing).
+            mfused = re.match(
+                r"model\.layers\.(\d+)\.self_attn\.qkv_proj\.weight$", name
+            )
+            if mfused:
+                li = int(mfused.group(1))
+                qd = cfg.num_heads * cfg.head_dim
+                kd = cfg.num_kv_heads * cfg.head_dim
+                if arr.shape[0] != qd + 2 * kd:
+                    raise ValueError(
+                        f"{name}: fused qkv has {arr.shape[0]} rows, "
+                        f"config geometry needs {qd + 2 * kd}"
+                    )
+                for key, chunk in (
+                    ("layers.wq", arr[:qd]),
+                    ("layers.wk", arr[qd:qd + kd]),
+                    ("layers.wv", arr[qd + kd:qd + 2 * kd]),
+                ):
+                    np.copyto(stage(key)[li], chunk.T, casting="unsafe")
+                    filled[key][li] = True
+                continue
+            mfused = re.match(
+                r"model\.layers\.(\d+)\.mlp\.gate_up_proj\.weight$", name
+            )
+            if mfused:
+                li = int(mfused.group(1))
+                F = cfg.intermediate_size
+                if arr.shape[0] != 2 * F:
+                    raise ValueError(
+                        f"{name}: fused gate_up has {arr.shape[0]} rows, "
+                        f"config geometry needs {2 * F}"
+                    )
+                for key, chunk in (
+                    ("layers.w_gate", arr[:F]),
+                    ("layers.w_up", arr[F:2 * F]),
+                ):
+                    np.copyto(stage(key)[li], chunk.T, casting="unsafe")
+                    filled[key][li] = True
+                continue
             spec = _hf_leaf(cfg, name)
             if spec is None:
                 continue
