@@ -247,3 +247,199 @@ def test_mla_dispatcher_kernel_flag():
     np.testing.assert_allclose(
         np.asarray(d), np.asarray(e), atol=2e-2, rtol=2e-2
     )
+
+
+def test_deepseek_v3_router_matches_hf(tmp_path):
+    """DeepSeek-V3 routing semantics (sigmoid scoring, noaux_tc grouped
+    selection with the e_score_correction_bias, renormalized weights,
+    routed_scaling_factor) — greedy continuations match transformers'
+    DeepseekV3ForCausalLM on the same exported weights. Round-3's router
+    was Mixtral-equivalent only; real V2/V3 checkpoints would have
+    mis-routed (round-4 audit)."""
+    import json as _json
+    import os as _os
+
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    try:
+        from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
+    except Exception:
+        pytest.skip("transformers lacks DeepseekV3")
+
+    from xllm_service_tpu.runtime import weights as W
+
+    hf_cfg = DeepseekV3Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4,
+        n_routed_experts=8, num_experts_per_tok=2, n_shared_experts=1,
+        n_group=2, topk_group=1, norm_topk_prob=True,
+        routed_scaling_factor=2.5, scoring_func="sigmoid",
+        topk_method="noaux_tc", first_k_dense_replace=1,
+        kv_lora_rank=32, q_lora_rank=24, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, rope_theta=10000.0,
+        rms_norm_eps=1e-6, max_position_embeddings=1024,
+        attn_implementation="eager", pad_token_id=0,
+    )
+    torch.manual_seed(5)
+    with torch.no_grad():
+        hf = DeepseekV3ForCausalLM(hf_cfg).eval().float()
+        # give the correction bias nonzero values so the selection path
+        # is actually exercised (checkpoint ships it as a buffer)
+        for layer in hf.model.layers[1:]:
+            layer.mlp.gate.e_score_correction_bias.uniform_(-0.5, 0.5)
+    ckpt = str(tmp_path / "dsv3")
+    _os.makedirs(ckpt, exist_ok=True)
+    tensors = {n: p.detach().numpy() for n, p in hf.named_parameters()}
+    for n, b in hf.named_buffers():
+        if "e_score_correction_bias" in n:
+            tensors[n] = b.detach().numpy()
+    W.write_safetensors(_os.path.join(ckpt, "model.safetensors"), tensors)
+    with open(_os.path.join(ckpt, "config.json"), "w") as f:
+        _json.dump({
+            "architectures": ["DeepseekV3ForCausalLM"],
+            "model_type": "deepseek_v3",
+            "vocab_size": 512, "hidden_size": 64,
+            "intermediate_size": 128, "moe_intermediate_size": 32,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 4,
+            "n_routed_experts": 8, "num_experts_per_tok": 2,
+            "n_shared_experts": 1, "n_group": 2, "topk_group": 1,
+            "norm_topk_prob": True, "routed_scaling_factor": 2.5,
+            "scoring_func": "sigmoid", "topk_method": "noaux_tc",
+            "first_k_dense_replace": 1,
+            "kv_lora_rank": 32, "q_lora_rank": 24,
+            "qk_nope_head_dim": 16, "qk_rope_head_dim": 8,
+            "v_head_dim": 16, "rope_theta": 10000.0,
+            "rms_norm_eps": 1e-6, "max_position_embeddings": 1024,
+        }, f)
+
+    from xllm_service_tpu.common.config import EngineConfig
+    from xllm_service_tpu.ops.sampling import SamplingParams
+    from xllm_service_tpu.runtime.engine import EngineRequest, InferenceEngine
+    from xllm_service_tpu.runtime.executor import ModelExecutor
+
+    cfg2 = W.config_from_hf(ckpt)
+    assert cfg2.scoring_func == "sigmoid"
+    assert cfg2.topk_method == "noaux_tc"
+    assert cfg2.routed_scaling_factor == 2.5
+
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(1, 500, (10,)).tolist()
+    with torch.no_grad():
+        hf_out = hf.generate(
+            input_ids=torch.tensor([prompt]), max_new_tokens=6,
+            do_sample=False,
+        )
+    want = hf_out[0, len(prompt):].tolist()
+
+    ecfg = EngineConfig(
+        model="dsv3-hf", dtype="float32", checkpoint_path=ckpt,
+        block_size=16, num_blocks=32, max_running_requests=2,
+        max_seq_len=128, prefill_buckets=[16, 32],
+    )
+    eng = InferenceEngine(ecfg, executor=ModelExecutor(ecfg))
+    got = []
+
+    def cb(o):
+        for s in o.outputs:
+            got.extend(s.token_ids)
+        return True
+
+    eng.add_request(EngineRequest(
+        "v3", prompt, SamplingParams(temperature=0.0, max_new_tokens=6), cb,
+    ))
+    for _ in range(60):
+        if not eng.has_work():
+            break
+        eng.step()
+    assert got == want, (got, want)
+
+
+def test_deepseek_v2_group_limited_router_matches_hf(tmp_path):
+    """DeepSeek-V2 routing (softmax scores, group_limited_greedy group-max
+    selection, NO top-k renorm, routed_scaling_factor) — greedy parity vs
+    transformers' DeepseekV2ForCausalLM (the V2 branches of every new
+    router conditional, complementing the V3 noaux_tc test)."""
+    import json as _json
+    import os as _os
+
+    import pytest
+
+    torch = pytest.importorskip("torch")
+    try:
+        from transformers import DeepseekV2Config, DeepseekV2ForCausalLM
+    except Exception:
+        pytest.skip("transformers lacks DeepseekV2")
+
+    from xllm_service_tpu.runtime import weights as W
+
+    kw = dict(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4,
+        n_routed_experts=8, num_experts_per_tok=2, n_shared_experts=1,
+        n_group=2, topk_group=1, norm_topk_prob=False,
+        routed_scaling_factor=16.0, scoring_func="softmax",
+        topk_method="group_limited_greedy", first_k_dense_replace=1,
+        kv_lora_rank=32, q_lora_rank=24, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, rope_theta=10000.0,
+        rms_norm_eps=1e-6, max_position_embeddings=1024,
+    )
+    hf_cfg = DeepseekV2Config(
+        **kw, attn_implementation="eager", pad_token_id=0,
+    )
+    torch.manual_seed(6)
+    with torch.no_grad():
+        hf = DeepseekV2ForCausalLM(hf_cfg).eval().float()
+    ckpt = str(tmp_path / "dsv2")
+    _os.makedirs(ckpt, exist_ok=True)
+    tensors = {n: p.detach().numpy() for n, p in hf.named_parameters()}
+    W.write_safetensors(_os.path.join(ckpt, "model.safetensors"), tensors)
+    with open(_os.path.join(ckpt, "config.json"), "w") as f:
+        _json.dump(
+            {"architectures": ["DeepseekV2ForCausalLM"],
+             "model_type": "deepseek_v2", **kw}, f,
+        )
+
+    from xllm_service_tpu.common.config import EngineConfig
+    from xllm_service_tpu.ops.sampling import SamplingParams
+    from xllm_service_tpu.runtime.engine import EngineRequest, InferenceEngine
+    from xllm_service_tpu.runtime.executor import ModelExecutor
+
+    cfg2 = W.config_from_hf(ckpt)
+    assert cfg2.topk_method == "group_limited_greedy"
+    assert not cfg2.norm_topk_prob
+    assert cfg2.routed_scaling_factor == 16.0
+
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(1, 500, (10,)).tolist()
+    with torch.no_grad():
+        hf_out = hf.generate(
+            input_ids=torch.tensor([prompt]), max_new_tokens=6,
+            do_sample=False,
+        )
+    want = hf_out[0, len(prompt):].tolist()
+
+    ecfg = EngineConfig(
+        model="dsv2-hf", dtype="float32", checkpoint_path=ckpt,
+        block_size=16, num_blocks=32, max_running_requests=2,
+        max_seq_len=128, prefill_buckets=[16, 32],
+    )
+    eng = InferenceEngine(ecfg, executor=ModelExecutor(ecfg))
+    got = []
+
+    def cb(o):
+        for s in o.outputs:
+            got.extend(s.token_ids)
+        return True
+
+    eng.add_request(EngineRequest(
+        "v2", prompt, SamplingParams(temperature=0.0, max_new_tokens=6), cb,
+    ))
+    for _ in range(60):
+        if not eng.has_work():
+            break
+        eng.step()
+    assert got == want, (got, want)

@@ -29,6 +29,19 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 0
     moe_intermediate_size: int = 0
+    # Router semantics. "softmax" scoring + norm_topk_prob covers
+    # Mixtral/Qwen3 (top-k renormalized full-softmax probs — identical
+    # to softmaxing the top-k logits); DeepSeek adds "sigmoid" scoring
+    # (V3), group-limited selection (n_group/topk_group; "noaux_tc"
+    # scores groups by top-2 sums with a selection-only correction bias,
+    # "group_limited_greedy" by group max), optional non-normalized
+    # weights, and routed_scaling_factor.
+    scoring_func: str = "softmax"
+    topk_method: str = "plain"
+    n_group: int = 0
+    topk_group: int = 0
+    norm_topk_prob: bool = True
+    routed_scaling_factor: float = 1.0
     # Sliding-window attention (0 = full).
     sliding_window: int = 0
     # Gemma-family deltas: GELU-tanh gated MLP (vs SwiGLU), embeddings

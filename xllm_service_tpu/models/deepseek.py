@@ -110,6 +110,8 @@ def _layer_stack(
                 "w_down": w(keys[9], (n, X, Fm, E), Fm),
             }
         )
+        if cfg.topk_method == "noaux_tc":
+            layers["router_bias"] = jnp.zeros((n, X), jnp.float32)
         if cfg.n_shared_experts > 0:
             Fs = cfg.n_shared_experts * Fm
             layers.update(

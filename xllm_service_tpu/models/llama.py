@@ -105,6 +105,8 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
                 "w_down": w(keys[7], (L, X, Fm, E), Fm),
             }
         )
+        if cfg.topk_method == "noaux_tc":
+            layers["router_bias"] = jnp.zeros((L, X), jnp.float32)
         if cfg.n_shared_experts > 0:
             # Shared experts are family-agnostic (_mlp reads these for any
             # MoE config with n_shared_experts > 0).
@@ -189,14 +191,46 @@ def _mlp(
     # expert compute local and inserts one psum for the combine — the EP
     # serving path, with no gather that would force an all-gather of
     # [T, X, E] activations.
-    scores = jnp.einsum("te,ex->tx", x.astype(jnp.float32), lp["router"].astype(jnp.float32))
-    topw, topi = jax.lax.top_k(scores, cfg.num_experts_per_tok)
-    weights = jax.nn.softmax(topw, axis=-1)  # [T, k]
+    logits = jnp.einsum(
+        "te,ex->tx", x.astype(jnp.float32), lp["router"].astype(jnp.float32)
+    )
+    if cfg.scoring_func == "sigmoid":  # DeepSeek-V3
+        scores = jax.nn.sigmoid(logits)
+    else:
+        scores = jax.nn.softmax(logits, axis=-1)
     T, X = scores.shape
+    # Selection scores may differ from COMBINE weights: V3's noaux_tc
+    # adds a correction bias for selection only (HF DeepseekV3TopkRouter).
+    sel = scores
+    if lp.get("router_bias") is not None:
+        sel = sel + lp["router_bias"].astype(jnp.float32)
+    if cfg.n_group > 1 and cfg.topk_group > 0:
+        # Group-limited routing: keep topk_group groups (scored by their
+        # top-2 sum for noaux_tc, group max for group_limited_greedy),
+        # zero the rest (scores are non-negative post-softmax/sigmoid).
+        gs = sel.reshape(T, cfg.n_group, X // cfg.n_group)
+        if cfg.topk_method == "noaux_tc":
+            group_scores = jax.lax.top_k(gs, 2)[0].sum(-1)
+        else:
+            group_scores = gs.max(-1)
+        _, gidx = jax.lax.top_k(group_scores, cfg.topk_group)
+        gmask = jnp.zeros((T, cfg.n_group), jnp.float32)
+        gmask = gmask.at[
+            jnp.arange(T, dtype=jnp.int32)[:, None], gidx
+        ].set(1.0)
+        sel = (gs * gmask[..., None]).reshape(T, X)
+    _, topi = jax.lax.top_k(sel, cfg.num_experts_per_tok)
+    weights = jnp.take_along_axis(scores, topi, axis=-1)  # [T, k]
+    if cfg.norm_topk_prob:
+        weights = weights / (
+            jnp.sum(weights, axis=-1, keepdims=True) + 1e-20
+        )
+    if cfg.routed_scaling_factor != 1.0:
+        weights = weights * cfg.routed_scaling_factor
     combine = jnp.zeros((T, X), jnp.float32)
     combine = combine.at[
         jnp.arange(T, dtype=jnp.int32)[:, None], topi
-    ].set(weights)  # [T, X]: top-k softmax weight or 0
+    ].set(weights)  # [T, X]: top-k combine weight or 0
     gate = jnp.einsum("te,xef->txf", x, wt(lp["w_gate"]))
     up = jnp.einsum("te,xef->txf", x, wt(lp["w_up"]))
     expert_out = jnp.einsum(

@@ -103,6 +103,8 @@ def param_shardings(
                 "w_down": ns(None, e, t, None),
             }
         )
+        if cfg.topk_method == "noaux_tc":
+            layers["router_bias"] = ns(None, None)  # replicated like router
         if cfg.n_shared_experts > 0:
             # DeepSeek shared experts: dense SwiGLU, ordinary column/row TP.
             layers.update(
@@ -134,7 +136,7 @@ def param_shardings(
             for k, v in layers.items()
             if k
             not in (
-                "router", "w_gate", "w_up", "w_down",
+                "router", "router_bias", "w_gate", "w_up", "w_down",
                 "w_sh_gate", "w_sh_up", "w_sh_down",
             )
         }

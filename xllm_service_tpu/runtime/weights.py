@@ -230,6 +230,9 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
             else hf["num_experts"],
             num_experts_per_tok=hf["num_experts_per_tok"],
             moe_intermediate_size=hf["moe_intermediate_size"],
+            # HF Qwen3MoeSparseMoeBlock honors this key (skips the
+            # top-k renorm when false)
+            norm_topk_prob=bool(hf.get("norm_topk_prob", True)),
         )
     elif arch == "MixtralForCausalLM":
         common.update(
@@ -264,6 +267,18 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
                 num_experts_per_tok=hf["num_experts_per_tok"],
                 moe_intermediate_size=hf["moe_intermediate_size"],
                 n_shared_experts=int(hf.get("n_shared_experts") or 0),
+                # DeepSeek routing semantics (V2: softmax +
+                # group_limited_greedy, no renorm, scaling 16; V3:
+                # sigmoid + noaux_tc with correction bias, renorm,
+                # scaling 2.5) — models/llama._mlp implements them all.
+                scoring_func=str(hf.get("scoring_func") or "softmax"),
+                topk_method=str(hf.get("topk_method") or "plain"),
+                n_group=int(hf.get("n_group") or 0),
+                topk_group=int(hf.get("topk_group") or 0),
+                norm_topk_prob=bool(hf.get("norm_topk_prob", True)),
+                routed_scaling_factor=float(
+                    hf.get("routed_scaling_factor") or 1.0
+                ),
             )
     elif arch == "Phi3ForCausalLM":
         # Phi-3's fused tensors split on load. longrope-scaled variants
@@ -329,6 +344,7 @@ def _hf_leaf(cfg: ModelConfig, hf_name: str):
         "mlp.down_proj.weight": ("layers.w_down", True),
         "block_sparse_moe.gate.weight": ("layers.router", True),
         "mlp.gate.weight": ("layers.router", True),
+        "mlp.gate.e_score_correction_bias": ("layers.router_bias", False),
     }
     if cfg.is_mla:
         # DeepSeek-V2/V3 MLA projections. q_proj is the direct-q (V2-Lite)
@@ -450,6 +466,8 @@ def _stack_shapes(
                 pre + "w_down": (L, X, Fm, E),
             }
         )
+        if cfg.topk_method == "noaux_tc":
+            shapes[pre + "router_bias"] = (L, X)
         if cfg.n_shared_experts > 0:
             Fs = cfg.n_shared_experts * Fm
             shapes.update(
@@ -499,6 +517,7 @@ _NORM_SUFFIXES = (
     "mlp_norm",
     "kv_norm",
     "q_norm",
+    "router_bias",  # V3 selection bias: f32 like HF's buffer
 )
 
 
@@ -1125,6 +1144,12 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
     os.makedirs(path, exist_ok=True)
     if cfg.norm_zero_centered:
         arch = "GemmaForCausalLM"
+    elif cfg.is_mla and (
+        cfg.topk_method == "noaux_tc" or cfg.scoring_func == "sigmoid"
+    ):
+        # V3 routing can't run under the V2 gate (transformers'
+        # DeepseekV2MoEGate has no noaux_tc/sigmoid branch).
+        arch = "DeepseekV3ForCausalLM"
     elif cfg.is_mla:
         arch = "DeepseekV2ForCausalLM"
     elif cfg.is_moe and cfg.qk_norm:
@@ -1177,6 +1202,17 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
                 num_experts_per_tok=cfg.num_experts_per_tok,
                 moe_intermediate_size=cfg.moe_intermediate_size,
                 n_shared_experts=cfg.n_shared_experts,
+                scoring_func=cfg.scoring_func,
+                # transformers' V2 gate knows only greedy /
+                # group_limited_greedy; our internal "plain" maps back
+                topk_method=(
+                    "greedy" if cfg.topk_method == "plain"
+                    else cfg.topk_method
+                ),
+                n_group=cfg.n_group or None,
+                topk_group=cfg.topk_group or None,
+                norm_topk_prob=cfg.norm_topk_prob,
+                routed_scaling_factor=cfg.routed_scaling_factor,
             )
     elif cfg.is_moe:
         hf_cfg["num_local_experts"] = cfg.num_experts
@@ -1262,6 +1298,10 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
                       ("w1.weight", "w3.weight", "w2.weight"))
             )
             tensors[pre + gate_name] = host(lp["router"])[i].T
+            if lp.get("router_bias") is not None:
+                tensors[pre + "mlp.gate.e_score_correction_bias"] = host(
+                    lp["router_bias"]
+                )[i]
             for j in range(cfg.num_experts):
                 ep = pre + exp_pre + f"{j}."
                 tensors[ep + w_names[0]] = host(lp["w_gate"])[i, j].T
