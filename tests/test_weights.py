@@ -271,11 +271,14 @@ def test_hf_sliding_window_gates():
         {"sliding_window": 32768, "use_sliding_window": False}
     ) == 0
     # HF Qwen2 semantics: layer i slides iff i >= max_window_layers.
-    # mwl=28/64 -> mixed stack (unrepresentable): full attention.
-    assert _hf_sliding_window(
-        {"sliding_window": 32768, "use_sliding_window": True,
-         "max_window_layers": 28, "num_hidden_layers": 64}
-    ) == 0
+    # mwl=28/64 -> mixed stack (unrepresentable): LOUD reject — serving
+    # it as full attention would silently diverge from HF beyond the
+    # window (advisor finding, round 4).
+    with pytest.raises(NotImplementedError, match="max_window_layers"):
+        _hf_sliding_window(
+            {"sliding_window": 32768, "use_sliding_window": True,
+             "max_window_layers": 28, "num_hidden_layers": 64}
+        )
     # mwl=64/64 -> ZERO sliding layers: full attention.
     assert _hf_sliding_window(
         {"sliding_window": 32768, "use_sliding_window": True,
@@ -499,19 +502,39 @@ def test_phi3_matches_hf_reference(tmp_path):
     assert got == want, (got, want)
 
 
-def test_phi3_longrope_rejected(tmp_path):
-    """128k longrope Phi-3 variants fail LOUDLY (review finding: plain
-    theta would silently diverge from HF)."""
-    ckpt = str(tmp_path / "phi3-long")
+def test_unknown_rope_scaling_rejected(tmp_path):
+    """Unimplemented rope_scaling types (yarn here) fail LOUDLY — the one
+    failure mode the loader refuses is a checkpoint that loads cleanly
+    and serves silently diverging logits. (longrope/llama3/linear/dynamic
+    are implemented — tests/test_rope_scaling.py.)"""
+    ckpt = str(tmp_path / "llama-yarn")
     os.makedirs(ckpt, exist_ok=True)
     with open(os.path.join(ckpt, "config.json"), "w") as f:
         json.dump({
-            "architectures": ["Phi3ForCausalLM"], "vocab_size": 512,
+            "architectures": ["LlamaForCausalLM"], "vocab_size": 512,
             "hidden_size": 64, "intermediate_size": 128,
             "num_hidden_layers": 2, "num_attention_heads": 4,
             "num_key_value_heads": 2,
-            "rope_scaling": {"type": "longrope",
-                             "short_factor": [1.0], "long_factor": [1.0]},
+            "rope_scaling": {"rope_type": "yarn", "factor": 4.0},
         }, f)
-    with pytest.raises(NotImplementedError, match="longrope"):
+    with pytest.raises(NotImplementedError, match="yarn"):
+        weights.config_from_hf(ckpt)
+
+
+def test_mixed_sliding_window_stack_rejected(tmp_path):
+    """A genuinely mixed SWA stack (0 < max_window_layers < num_layers
+    with use_sliding_window=true) is not representable by the uniform
+    scanned layers — it must raise, not silently serve full attention
+    (advisor finding, round 4)."""
+    ckpt = str(tmp_path / "qwen2-mixed-swa")
+    os.makedirs(ckpt, exist_ok=True)
+    with open(os.path.join(ckpt, "config.json"), "w") as f:
+        json.dump({
+            "architectures": ["Qwen2ForCausalLM"], "vocab_size": 512,
+            "hidden_size": 64, "intermediate_size": 128,
+            "num_hidden_layers": 4, "num_attention_heads": 4,
+            "num_key_value_heads": 2, "sliding_window": 32,
+            "use_sliding_window": True, "max_window_layers": 2,
+        }, f)
+    with pytest.raises(NotImplementedError, match="max_window_layers"):
         weights.config_from_hf(ckpt)

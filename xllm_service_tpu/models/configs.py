@@ -22,6 +22,18 @@ class ModelConfig:
     num_kv_heads: int
     head_dim: int
     rope_theta: float = 500000.0
+    # HF `rope_scaling` (ops/rope.rope_parameters implements the math;
+    # runtime/weights.config_from_hf parses it and LOUDLY rejects types
+    # not listed there). "" = plain theta. Tuples keep the frozen config
+    # hashable for jit static args.
+    rope_scaling_type: str = ""  # "linear" | "dynamic" | "llama3" | "longrope"
+    rope_scaling_factor: float = 1.0
+    rope_original_max_position: int = 0  # 0 = max_position_embeddings
+    rope_low_freq_factor: float = 1.0  # llama3
+    rope_high_freq_factor: float = 4.0  # llama3
+    rope_short_factor: tuple = ()  # longrope per-band tables [head_dim/2]
+    rope_long_factor: tuple = ()
+    rope_attention_factor: float = 0.0  # longrope; 0 = HF sqrt-log formula
     rms_norm_eps: float = 1e-5
     max_position_embeddings: int = 8192
     tie_word_embeddings: bool = False

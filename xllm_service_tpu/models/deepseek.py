@@ -37,9 +37,9 @@ from xllm_service_tpu.ops.attention import (
     mla_paged_attention,
     mla_prefill_attention,
 )
+from xllm_service_tpu.ops import rope as rope_ops
 from xllm_service_tpu.ops.norms import rms_norm
 from xllm_service_tpu.ops.quant import wdtype, wt
-from xllm_service_tpu.ops.rope import apply_rope
 
 Params = Dict[str, Any]
 
@@ -215,7 +215,7 @@ def _q_heads(lp, cfg: ModelConfig, h: jnp.ndarray, positions: jnp.ndarray):
         q = jnp.einsum("te,eh->th", h, wt(lp["w_q"]))
     q = q.reshape(T, cfg.num_heads, dn + dr)
     q_nope, q_pe = q[..., :dn], q[..., dn:]
-    q_pe = apply_rope(q_pe, positions, cfg.rope_theta)
+    q_pe = rope_ops.apply_rope_scaled(q_pe, positions, cfg)
     return q_nope, q_pe
 
 
@@ -237,7 +237,7 @@ def _latent_rows(lp, cfg: ModelConfig, h: jnp.ndarray, positions: jnp.ndarray):
     c, k_pe = ckv[..., :kvr], ckv[..., kvr:]
     c = rms_norm(c, lp["kv_norm"], cfg.rms_norm_eps)
     # Single shared rope key per token (head axis of 1 for apply_rope).
-    k_pe = apply_rope(k_pe[:, None, :], positions, cfg.rope_theta)[:, 0]
+    k_pe = rope_ops.apply_rope_scaled(k_pe[:, None, :], positions, cfg)[:, 0]
     return _pad_lanes(
         jnp.concatenate([c, k_pe], axis=-1), cfg.mla_cache_dim
     )

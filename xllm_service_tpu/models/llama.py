@@ -35,7 +35,6 @@ from xllm_service_tpu.ops.norms import rms_norm
 from xllm_service_tpu.ops import lora as lora_ops
 from xllm_service_tpu.ops.quant import wdtype, wt
 from xllm_service_tpu.ops import rope as rope_ops
-from xllm_service_tpu.ops.rope import apply_rope
 
 Params = Dict[str, Any]
 
@@ -221,11 +220,19 @@ def _mlp(
         sel = (gs * gmask[..., None]).reshape(T, X)
     _, topi = jax.lax.top_k(sel, cfg.num_experts_per_tok)
     weights = jnp.take_along_axis(scores, topi, axis=-1)  # [T, k]
+    # Scaling placement differs between the HF gates: V2's MoEGate
+    # applies routed_scaling_factor ONLY in its no-renorm branch, while
+    # V3's TopkRouter (sigmoid / noaux_tc configs) renormalizes AND
+    # scales. Mixtral/Qwen3 renorm unconditionally and never scale.
+    # (Advisor finding, round 4.)
+    v3_style = cfg.topk_method == "noaux_tc" or cfg.scoring_func == "sigmoid"
     if cfg.norm_topk_prob:
         weights = weights / (
             jnp.sum(weights, axis=-1, keepdims=True) + 1e-20
         )
-    if cfg.routed_scaling_factor != 1.0:
+    if cfg.routed_scaling_factor != 1.0 and (
+        v3_style or not cfg.norm_topk_prob
+    ):
         weights = weights * cfg.routed_scaling_factor
     combine = jnp.zeros((T, X), jnp.float32)
     combine = combine.at[
@@ -282,8 +289,8 @@ def _qkv(lp, cfg: ModelConfig, x: jnp.ndarray, positions: jnp.ndarray,
             k, positions, cfg.rope_theta, cfg.mrope_section
         )
         return q, k, v
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    q = rope_ops.apply_rope_scaled(q, positions, cfg)
+    k = rope_ops.apply_rope_scaled(k, positions, cfg)
     return q, k, v
 
 
@@ -492,6 +499,9 @@ def prefill_sp_step(
     true_len: jnp.ndarray,  # scalar int32
     mesh,
     sp_axis: str = "sp",
+    tp_axis=None,  # compose with tensor parallelism on the same mesh:
+    # params keep their Megatron tp sharding and the ring shards heads
+    # over tp_axis too (ops/ring_attention.ring_attention)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Sequence-parallel long-context prefill: the prompt's sequence axis is
     sharded over the `sp` mesh ring and every layer's attention is EXACT
@@ -515,7 +525,7 @@ def prefill_sp_step(
         q, k, v = _qkv(lp, cfg, h[0], positions)
         attn = ring_attention(
             q[None], k[None], v[None], mesh, sp_axis=sp_axis,
-            scale=cfg.head_dim**-0.5, causal=True,
+            scale=cfg.head_dim**-0.5, causal=True, tp_axis=tp_axis,
         )
         x = x + jnp.einsum(
             "blh,he->ble",

@@ -135,18 +135,97 @@ def _hf_sliding_window(hf: dict) -> int:
     use_sliding_window=false. HF's max_window_layers semantics (Qwen2
     modeling: layer i slides iff i >= max_window_layers, i.e. the FIRST
     mwl layers use full attention): mwl == 0 means every layer slides —
-    exactly our uniform-window stack; any other value means zero SWA
-    layers (mwl >= num_layers) or a mixed stack our scanned layers can't
-    represent, and both serve correctly/safest as full attention."""
+    exactly our uniform-window stack; mwl >= num_layers means zero SWA
+    layers — full attention, exactly HF. A genuinely MIXED stack
+    (0 < mwl < num_layers with use_sliding_window=true) can't be
+    represented by the scanned uniform layers and serving it as full
+    attention would diverge from HF beyond the window — fail LOUDLY
+    instead (same principle as the unsupported-rope_scaling reject)."""
     window = int(hf.get("sliding_window") or 0)
     if not window:
         return 0
     if not hf.get("use_sliding_window", True):
         return 0
     mwl = hf.get("max_window_layers")
-    if mwl is not None and int(mwl) != 0:
+    if mwl is None or int(mwl) == 0:
+        return window
+    if int(mwl) >= int(hf["num_hidden_layers"]):
         return 0
-    return window
+    raise NotImplementedError(
+        f"mixed sliding-window stack (max_window_layers={mwl} of "
+        f"{hf['num_hidden_layers']} layers, use_sliding_window=true) is "
+        "not representable by the uniform scanned stack; refusing to "
+        "serve it as full attention"
+    )
+
+
+def _hf_rope_scaling(hf: dict) -> dict:
+    """ModelConfig rope_scaling_* fields from an HF config dict.
+
+    Implemented types (ops/rope.rope_parameters does the math): linear,
+    dynamic NTK, llama3 (Llama-3.1/3.2), longrope (Phi-3, incl. the older
+    "su" spelling). "default"/mrope-only entries are no-ops. ANY other
+    type raises — the one silent failure mode this loader refuses is a
+    checkpoint that loads cleanly and serves diverging logits (yarn
+    checkpoints, e.g. real DeepSeek-V2, land here until implemented)."""
+    rs = hf.get("rope_scaling")
+    if not rs or rs.get("mrope_section"):
+        # mrope_section-only configs (Qwen2-VL) declare type "default"/
+        # "mrope" — M-RoPE is handled by the _mrope_section path.
+        return {}
+    rtype = str(rs.get("rope_type") or rs.get("type") or "default")
+    if rtype == "default":
+        return {}
+    if rtype == "linear":
+        return dict(
+            rope_scaling_type="linear",
+            rope_scaling_factor=float(rs["factor"]),
+        )
+    if rtype == "dynamic":
+        return dict(
+            rope_scaling_type="dynamic",
+            rope_scaling_factor=float(rs["factor"]),
+            rope_original_max_position=int(
+                rs.get("original_max_position_embeddings") or 0
+            ),
+        )
+    if rtype == "llama3":
+        return dict(
+            rope_scaling_type="llama3",
+            rope_scaling_factor=float(rs["factor"]),
+            rope_low_freq_factor=float(rs["low_freq_factor"]),
+            rope_high_freq_factor=float(rs["high_freq_factor"]),
+            rope_original_max_position=int(
+                rs["original_max_position_embeddings"]
+            ),
+        )
+    if rtype in ("longrope", "su"):
+        # Phi-3 keeps original_max_position_embeddings at the TOP level
+        # of config.json; newer HF layouts put it inside rope_scaling.
+        orig = int(
+            rs.get("original_max_position_embeddings")
+            or hf.get("original_max_position_embeddings")
+            or 0
+        )
+        if not orig:
+            raise ValueError(
+                "longrope rope_scaling needs original_max_position_"
+                "embeddings (in rope_scaling or at the config top level)"
+            )
+        return dict(
+            rope_scaling_type="longrope",
+            rope_short_factor=tuple(
+                float(v) for v in rs["short_factor"]
+            ),
+            rope_long_factor=tuple(float(v) for v in rs["long_factor"]),
+            rope_original_max_position=orig,
+            rope_attention_factor=float(rs.get("attention_factor") or 0.0),
+        )
+    raise NotImplementedError(
+        f"rope_scaling type {rtype!r} is not supported (implemented: "
+        "linear, dynamic, llama3, longrope); refusing to load a "
+        "checkpoint that would serve silently diverging logits"
+    )
 
 
 def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
@@ -191,6 +270,7 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
         sliding_window=_hf_sliding_window(hf),
         mrope_section=tuple(hf.get("_mrope_section") or ()),
     )
+    common.update(_hf_rope_scaling(hf))
     if arch == "GemmaForCausalLM":
         # Gemma: Llama tensor layout + GELU-tanh gated MLP, sqrt(E)
         # embedding scale, zero-centered RMSNorm weights (the loader
@@ -282,14 +362,9 @@ def config_from_hf(path: str, name: Optional[str] = None) -> ModelConfig:
             )
     elif arch == "Phi3ForCausalLM":
         # Phi-3's fused tensors split on load. longrope-scaled variants
-        # (128k) interpolate per-band factors our plain-theta rope
-        # doesn't implement — fail LOUDLY rather than serve silently
-        # diverging logits.
-        if hf.get("rope_scaling"):
-            raise NotImplementedError(
-                "Phi-3 rope_scaling (longrope) is not supported; "
-                "4k-class checkpoints without rope_scaling load fine"
-            )
+        # (128k) are handled by _hf_rope_scaling above (per-band
+        # short/long factor tables + HF attention factor).
+        pass
     elif arch not in ("LlamaForCausalLM", "MistralForCausalLM"):
         # Mistral is architecturally Llama (same tensor names, bias-free
         # QKV) + sliding-window attention, which _hf_sliding_window
@@ -914,6 +989,14 @@ def save_qwen2vl_visual(params, cfg, path: str) -> None:
     """Inverse of the qwen2vl branch of load_vision_checkpoint (HF
     Qwen2-VL `visual.*` layout) — round-trip tested; exports synthetic
     towers for CI."""
+    if cfg.arch != "qwen2vl":
+        # Fail BEFORE config.json is written: a qwen25vl tower uses
+        # different layer maps and would KeyError mid-write, leaving a
+        # half-written checkpoint dir (advisor finding, round 4).
+        raise ValueError(
+            f"save_qwen2vl_visual handles arch 'qwen2vl' only, got "
+            f"{cfg.arch!r}"
+        )
     os.makedirs(path, exist_ok=True)
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump(
@@ -1219,6 +1302,28 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
         hf_cfg["num_experts_per_tok"] = cfg.num_experts_per_tok
     if cfg.sliding_window:
         hf_cfg["sliding_window"] = cfg.sliding_window
+    if cfg.rope_scaling_type:
+        # Inverse of _hf_rope_scaling — lets the HF-parity tests load the
+        # same rope-scaled geometry through transformers.
+        rs: Dict[str, Any] = {"rope_type": cfg.rope_scaling_type}
+        if cfg.rope_scaling_type in ("linear", "dynamic", "llama3"):
+            rs["factor"] = cfg.rope_scaling_factor
+        if cfg.rope_scaling_type == "llama3":
+            rs["low_freq_factor"] = cfg.rope_low_freq_factor
+            rs["high_freq_factor"] = cfg.rope_high_freq_factor
+            rs["original_max_position_embeddings"] = (
+                cfg.rope_original_max_position
+            )
+        if cfg.rope_scaling_type == "longrope":
+            rs["short_factor"] = list(cfg.rope_short_factor)
+            rs["long_factor"] = list(cfg.rope_long_factor)
+            if cfg.rope_attention_factor:
+                rs["attention_factor"] = cfg.rope_attention_factor
+            # Phi-3 keeps the original context at the config top level.
+            hf_cfg["original_max_position_embeddings"] = (
+                cfg.rope_original_max_position
+            )
+        hf_cfg["rope_scaling"] = rs
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump(hf_cfg, f, indent=2)
 
