@@ -72,6 +72,15 @@ class ServiceConfig:
     # EPD multimodal: placeholder tokens inserted per media part — must
     # match the encoder's VisionConfig.out_tokens.
     mm_tokens_per_media: int = 4
+    # Real-image front door (service/image_processor.py): which HF
+    # processor semantics to apply to data:image/... payloads before the
+    # encode stage. "" rejects real images (raw-f32 tensor backdoor
+    # only); "siglip" = resize+0.5-normalize; "qwen2vl" = smart-resize
+    # pixel math pinned to the tower's square, CLIP normalize.
+    mm_image_processor: str = ""
+    # Square the ENCODE tower compiled for (VisionConfig.image_size);
+    # required when mm_image_processor is set.
+    mm_image_size: int = 0
 
     @classmethod
     def from_args(cls, argv: Optional[List[str]] = None) -> "ServiceConfig":

@@ -1,0 +1,181 @@
+"""Real-image front door: decode + HF-processor-parity preprocessing.
+
+The reference's message model carries `image_url` parts verbatim to the
+engine (jinja_chat_template.h:30-47); any OpenAI-compatible server must
+therefore accept `data:image/png;base64,...` payloads, not just
+pre-encoded tensors. This module turns those payloads into the
+fixed-geometry float32 tensors the EPD encode stage already transports
+(service/scheduler._expand_media -> api/instance_mm._handle_encode ->
+models/vision towers):
+
+  * decode: PNG/JPEG/WebP/GIF via PIL -> uint8 RGB;
+  * SigLIP family: bicubic resize to (S, S), rescale 1/255, normalize
+    with mean/std 0.5 — exactly HF SiglipImageProcessor;
+  * Qwen2-VL family: `smart_resize` to patch*merge multiples bounded by
+    min/max pixels (the exact HF function), bicubic resize, rescale,
+    normalize with the OPENAI CLIP mean/std — exactly HF
+    Qwen2VLImageProcessor (shared by Qwen2.5-VL);
+  * hf_qwen2vl_patches replicates the HF processor's patch flattening
+    (temporal tiling + (h//m, m, w//m, m) interleave) so tests can
+    assert OUR tensor equals HF `pixel_values` bit-for-bit; the serving
+    tower does its own patchify from the [H, W, 3] image.
+
+Resizes go through PIL on uint8 data — the same path transformers takes
+(image_transforms.resize converts to PIL) — so parity is exact, not
+approximate. Everything here is host-side numpy; nothing is jitted.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import math
+import re
+from typing import Optional, Tuple
+
+import numpy as np
+
+# HF constants (transformers.image_utils).
+OPENAI_CLIP_MEAN = (0.48145466, 0.4578275, 0.40821073)
+OPENAI_CLIP_STD = (0.26862954, 0.26130258, 0.27577711)
+IMAGENET_STANDARD_MEAN = (0.5, 0.5, 0.5)  # SigLIP
+IMAGENET_STANDARD_STD = (0.5, 0.5, 0.5)
+
+_IMAGE_DATA_RE = re.compile(
+    r"data:image/(png|jpeg|jpg|webp|gif|bmp);base64,(.*)", re.S
+)
+
+
+def decode_image_url(url: str) -> Optional[np.ndarray]:
+    """`data:image/...;base64` URL -> uint8 RGB [H, W, 3], or None if the
+    URL is not an image data URL (the raw-f32 tensor backdoor and error
+    reporting stay with the caller). Raises ValueError on a payload that
+    claims to be an image but does not decode."""
+    m = _IMAGE_DATA_RE.match(url or "")
+    if not m:
+        return None
+    try:
+        raw = base64.b64decode(m.group(2))
+    except Exception as e:
+        raise ValueError(f"bad base64 image payload: {e}") from e
+    return decode_image_bytes(raw)
+
+
+def decode_image_bytes(raw: bytes) -> np.ndarray:
+    """Compressed image bytes -> uint8 RGB [H, W, 3] via PIL."""
+    try:
+        from PIL import Image
+    except Exception as e:  # pragma: no cover - PIL is in the image
+        raise RuntimeError("PIL is required for image decoding") from e
+    try:
+        with Image.open(io.BytesIO(raw)) as im:
+            return np.asarray(im.convert("RGB"))
+    except Exception as e:
+        raise ValueError(f"undecodable image payload: {e}") from e
+
+
+def _resize_bicubic(img: np.ndarray, height: int, width: int) -> np.ndarray:
+    """uint8 [H, W, 3] -> uint8 [height, width, 3], PIL bicubic — the
+    exact resample path transformers uses for both families."""
+    from PIL import Image
+
+    if img.shape[0] == height and img.shape[1] == width:
+        return img
+    pil = Image.fromarray(img).resize(
+        (width, height), resample=Image.Resampling.BICUBIC
+    )
+    return np.asarray(pil)
+
+
+def _normalize(img_u8: np.ndarray, mean, std) -> np.ndarray:
+    x = img_u8.astype(np.float32) * (1.0 / 255.0)
+    return (
+        (x - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
+    ).astype(np.float32)
+
+
+def preprocess_siglip(img: np.ndarray, image_size: int) -> np.ndarray:
+    """uint8 RGB -> normalized float32 [S, S, 3] (HF SiglipImageProcessor:
+    bicubic resize, rescale 1/255, mean/std 0.5)."""
+    return _normalize(
+        _resize_bicubic(img, image_size, image_size),
+        IMAGENET_STANDARD_MEAN, IMAGENET_STANDARD_STD,
+    )
+
+
+def smart_resize(
+    height: int, width: int, factor: int = 28,
+    min_pixels: int = 56 * 56, max_pixels: int = 14 * 14 * 4 * 1280,
+) -> Tuple[int, int]:
+    """HF Qwen2-VL smart_resize, verbatim semantics
+    (transformers qwen2_vl/image_processing_qwen2_vl.py): round both
+    sides to `factor` multiples, keeping the pixel count within
+    [min_pixels, max_pixels] and the aspect ratio (within 200:1)."""
+    if max(height, width) / min(height, width) > 200:
+        raise ValueError(
+            "absolute aspect ratio must be smaller than 200, got "
+            f"{max(height, width) / min(height, width)}"
+        )
+    h_bar = round(height / factor) * factor
+    w_bar = round(width / factor) * factor
+    if h_bar * w_bar > max_pixels:
+        beta = math.sqrt((height * width) / max_pixels)
+        h_bar = math.floor(height / beta / factor) * factor
+        w_bar = math.floor(width / beta / factor) * factor
+    elif h_bar * w_bar < min_pixels:
+        beta = math.sqrt(min_pixels / (height * width))
+        h_bar = math.ceil(height * beta / factor) * factor
+        w_bar = math.ceil(width * beta / factor) * factor
+    return max(h_bar, factor), max(w_bar, factor)
+
+
+def preprocess_qwen2vl(
+    img: np.ndarray,
+    patch_size: int = 14,
+    merge_size: int = 2,
+    min_pixels: int = 56 * 56,
+    max_pixels: int = 14 * 14 * 4 * 1280,
+    pinned_size: int = 0,
+) -> np.ndarray:
+    """uint8 RGB -> normalized float32 [H', W', 3] with H', W' multiples
+    of patch_size*merge_size (HF Qwen2VLImageProcessor: smart_resize,
+    bicubic, rescale 1/255, CLIP mean/std). `pinned_size` overrides
+    smart_resize with a fixed square — the serving towers compile for
+    one static grid (models/vision.VisionConfig.image_size), so the
+    service pins the geometry while keeping the exact HF pixel math."""
+    if pinned_size:
+        h_bar = w_bar = pinned_size
+    else:
+        h_bar, w_bar = smart_resize(
+            img.shape[0], img.shape[1],
+            factor=patch_size * merge_size,
+            min_pixels=min_pixels, max_pixels=max_pixels,
+        )
+    return _normalize(
+        _resize_bicubic(img, h_bar, w_bar),
+        OPENAI_CLIP_MEAN, OPENAI_CLIP_STD,
+    )
+
+
+def hf_qwen2vl_patches(
+    norm_img: np.ndarray,
+    patch_size: int = 14,
+    merge_size: int = 2,
+    temporal_patch_size: int = 2,
+) -> Tuple[np.ndarray, Tuple[int, int, int]]:
+    """Replicate the HF processor's flattened patch layout from a
+    normalized [H, W, 3] image: tile temporally (a single image repeats
+    t_patch times), then emit rows in (h//m, w//m, m, m) merge order —
+    [grid_t*grid_h*grid_w, 3*tps*p*p] `pixel_values` plus grid_thw.
+    Used by parity tests to compare against transformers bit-for-bit
+    (the serving tower patchifies on device instead)."""
+    H, W, C = norm_img.shape
+    p, m, tps = patch_size, merge_size, temporal_patch_size
+    gh, gw = H // p, W // p
+    x = np.repeat(norm_img.transpose(2, 0, 1)[None], tps, axis=0)  # [t,C,H,W]
+    x = x.reshape(1, tps, C, gh // m, m, p, gw // m, m, p)
+    x = x.transpose(0, 3, 6, 4, 7, 2, 1, 5, 8)
+    return (
+        np.ascontiguousarray(x).reshape(gh * gw, C * tps * p * p),
+        (1, gh, gw),
+    )

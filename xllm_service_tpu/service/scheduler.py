@@ -27,6 +27,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional
 
+import numpy as np
+
 from xllm_service_tpu.cluster.global_kvcache_mgr import GlobalKVCacheMgr
 from xllm_service_tpu.cluster.instance_mgr import InstanceMgr
 from xllm_service_tpu.cluster.policies import LoadBalancePolicy, make_policy
@@ -291,6 +293,62 @@ class Scheduler:
         re.S,
     )
 
+    def _decode_media_part(self, p):
+        """One MMContentPart -> ({type, shape, data}, None) or (None,
+        error Status). Real images (data:image/...;base64) decode via PIL
+        and preprocess with the configured family's HF pixel math
+        (service/image_processor.py); the raw-f32 tensor URI remains as
+        the pre-encoded backdoor (tests, non-image media)."""
+        import base64 as _b64
+
+        from xllm_service_tpu.service import image_processor as _ip
+
+        url = p.url or ""
+        if p.type in ("image", "image_url"):
+            try:
+                img = _ip.decode_image_url(url)
+            except ValueError as e:
+                return None, Status(StatusCode.INVALID_ARGUMENT, str(e))
+            if img is not None:
+                proc = self._config.mm_image_processor
+                size = self._config.mm_image_size
+                if not proc or not size:
+                    return None, Status(
+                        StatusCode.INVALID_ARGUMENT,
+                        "real-image ingestion is not enabled on this "
+                        "deployment (set mm_image_processor and "
+                        "mm_image_size to match the ENCODE tower)",
+                    )
+                if proc == "siglip":
+                    arr = _ip.preprocess_siglip(img, size)
+                elif proc == "qwen2vl":
+                    arr = _ip.preprocess_qwen2vl(img, pinned_size=size)
+                else:
+                    return None, Status(
+                        StatusCode.INVALID_ARGUMENT,
+                        f"unknown mm_image_processor {proc!r}",
+                    )
+                return {
+                    "type": p.type,
+                    "shape": list(arr.shape),
+                    "data": _b64.b64encode(
+                        np.ascontiguousarray(arr).tobytes()
+                    ).decode(),
+                }, None
+        m = self._MM_DATA_RE.match(url)
+        if not m:
+            return None, Status(
+                StatusCode.INVALID_ARGUMENT,
+                f"unsupported media URL for {p.type}: expected a "
+                "data:image/...;base64 image or a "
+                "data:application/x-raw-f32;shape=HxWxC;base64 payload",
+            )
+        return {
+            "type": p.type,
+            "shape": [int(m.group(1)), int(m.group(2)), int(m.group(3))],
+            "data": m.group(4),
+        }, None
+
     def _expand_media(self, request: ServiceRequest) -> Optional[Status]:
         """EPD stage-E preparation (SURVEY.md §7 stage 7): media parts in
         chat messages become runs of placeholder tokens in token_ids; the
@@ -308,20 +366,10 @@ class Scheduler:
             return None
         media_parts = []
         for p in parts:
-            m = self._MM_DATA_RE.match(p.url or "")
-            if not m:
-                return Status(
-                    StatusCode.INVALID_ARGUMENT,
-                    f"unsupported media URL for {p.type}: expected a "
-                    "data:application/x-raw-f32;shape=HxWxC;base64 payload",
-                )
-            media_parts.append(
-                {
-                    "type": p.type,
-                    "shape": [int(m.group(1)), int(m.group(2)), int(m.group(3))],
-                    "data": m.group(4),
-                }
-            )
+            part, err = self._decode_media_part(p)
+            if err is not None:
+                return err
+            media_parts.append(part)
         k = self._config.mm_tokens_per_media
         marker_re = re.compile(
             "(" + "|".join(re.escape(s) for s in self._MM_MARKERS) + ")"
