@@ -151,16 +151,144 @@ def test_whitespace_capped_at_one_byte():
 
 def test_unsupported_schemas_rejected():
     for bad in [
-        {"anyOf": [{"type": "string"}]},
+        {"oneOf": [{"type": "string"}]},
+        {"anyOf": []},  # empty union
+        {"anyOf": [{"type": "string"}], "type": "string"},  # siblings
         {"type": "object", "properties": {}},  # no additionalProperties
         {"type": "string", "pattern": "a+"},
         {"type": "integer", "minimum": 3},
-        {"type": ["string", "null"]},
+        {"type": []},  # empty type list
         {"type": "array"},  # no items
         {},  # no type
     ]:
         with pytest.raises(sf.SchemaError):
             sf.compile_schema(bad)
+
+
+# ------------------------------------------------------------------ anyOf
+
+
+def test_anyof_accepts_any_branch():
+    schema = {"anyOf": [
+        {"type": "string"},
+        {"type": "integer"},
+        {"type": "null"},
+    ]}
+    assert accepts(schema, '"hello"')
+    assert accepts(schema, "42")
+    assert accepts(schema, "null")
+    assert not prefix_ok(schema, "true")
+    assert not prefix_ok(schema, "[")
+
+
+def test_anyof_shared_prefix_stays_ambiguous():
+    """integer vs number share digit prefixes: '1' is complete under
+    both; '1.' forces the number branch; '1.5e2' completes it."""
+    schema = {"anyOf": [{"type": "integer"}, {"type": "number"}]}
+    assert accepts(schema, "1")
+    assert prefix_ok(schema, "1.")
+    assert not accepts(schema, "1.")
+    assert accepts(schema, "1.5")
+    assert accepts(schema, "1.5e2")
+    # enum branches with shared byte prefixes
+    schema2 = {"anyOf": [
+        {"enum": ["cat", "car"]}, {"enum": ["care"]},
+    ]}
+    assert accepts(schema2, '"cat"')
+    assert accepts(schema2, '"car"')
+    assert accepts(schema2, '"care"')
+    assert not prefix_ok(schema2, '"cab')
+
+
+def test_anyof_object_branches_with_distinct_keys():
+    schema = {"anyOf": [
+        {
+            "type": "object", "additionalProperties": False,
+            "properties": {"cat": {"type": "string"}},
+            "required": ["cat"],
+        },
+        {
+            "type": "object", "additionalProperties": False,
+            "properties": {"car": {"type": "integer"}},
+            "required": ["car"],
+        },
+    ]}
+    assert accepts(schema, '{"cat": "meow"}')
+    assert accepts(schema, '{"car": 3}')
+    # the shared '"ca' prefix keeps both branches alive...
+    assert prefix_ok(schema, '{"ca')
+    # ...then the value type binds to the branch that owns the key
+    assert not prefix_ok(schema, '{"cat": 3')
+    assert not prefix_ok(schema, '{"car": "x"')
+
+
+def test_anyof_optional_shape_inside_object():
+    """The pydantic Optional[str] shape: anyOf [string, null] as a
+    property value."""
+    schema = {
+        "type": "object", "additionalProperties": False,
+        "properties": {
+            "name": {"anyOf": [{"type": "string"}, {"type": "null"}]},
+        },
+        "required": ["name"],
+    }
+    assert accepts(schema, '{"name": "ada"}')
+    assert accepts(schema, '{"name": null}')
+    assert not prefix_ok(schema, '{"name": 3')
+
+
+def test_type_list_union_compiles_as_anyof():
+    schema = {"type": ["string", "null"]}
+    assert accepts(schema, '"x"')
+    assert accepts(schema, "null")
+    assert not prefix_ok(schema, "3")
+
+
+def test_nested_anyof_flattens():
+    schema = {"anyOf": [
+        {"anyOf": [{"type": "integer"}, {"type": "boolean"}]},
+        {"type": "null"},
+    ]}
+    assert accepts(schema, "7")
+    assert accepts(schema, "true")
+    assert accepts(schema, "null")
+    assert not prefix_ok(schema, '"')
+
+
+def test_anyof_array_items():
+    schema = {
+        "type": "array",
+        "items": {"anyOf": [{"type": "integer"}, {"type": "string"}]},
+        "minItems": 1,
+    }
+    assert accepts(schema, '[1, "a", 2]')
+    assert not prefix_ok(schema, "[true")
+
+
+def test_anyof_token_bitmap_soundness():
+    """Bitmap exactness holds through MULTI states: allowed tokens keep
+    the NFA alive, rejected tokens kill it."""
+    schema = {"anyOf": [
+        {"type": "integer"},
+        {"type": "object", "additionalProperties": False,
+         "properties": {"a": {"type": "string"}}, "required": ["a"]},
+    ]}
+    spec = sf.compile_schema(schema)
+    vocab = [
+        b"", b"1", b"12", b"1.5", b"{", b'{"a', b'{"a": "', b'"', b"}",
+        b"true", b"[", b'{"b', b" ", b"-3",
+    ]
+    fbi = sf.build_first_byte_index(vocab)
+    # walk a few states: initial, post-'1' (ambiguous-free here), post-'{'
+    for prefix in (b"", b"1", b"{", b'{"a": "x'):
+        st = sf.advance_bytes(spec, sf.initial_state(spec), prefix)
+        assert st is not None, prefix
+        bits = sf.token_bitmap(spec, st, fbi, len(vocab), eos_ids=[0])
+        for tid, tb in enumerate(vocab):
+            if not tb:
+                continue
+            alive = sf.advance_bytes(spec, st, tb) is not None
+            assert bits[tid] == alive, (prefix, tb)
 
 
 def test_token_bitmap_soundness():

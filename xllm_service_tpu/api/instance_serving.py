@@ -272,6 +272,15 @@ class ServingMixin:
             except schema_fsm.SchemaError as e:
                 return None, None, f"unsupported json_schema: {e}"
             err = self._ensure_guided_context()
+            if not err:
+                # HTTP-thread prewarm: compute the canonical-path token
+                # bitmaps NOW so the engine step loop (all running
+                # decodes) never stalls behind the first-visit vocab
+                # byte walk (advisor finding, round 4).
+                try:
+                    self.engine.prewarm_schema(schema)
+                except Exception:
+                    pass  # prewarm is an optimization, never a gate
             return (("json_schema", schema, "") if not err
                     else (None, None, err))
         if rf["type"] != "json_object":

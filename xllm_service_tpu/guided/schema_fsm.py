@@ -22,12 +22,19 @@ Supported subset (the OpenAI structured-outputs strict profile):
   object (ordered properties, required subset, additionalProperties
   must be false), array (items + minItems/maxItems), string, enum /
   const over strings/numbers/bools/null, integer, number, boolean,
-  null, and internal NON-recursive $ref into $defs/definitions (the
-  shape pydantic's model_json_schema emits). Properties are emitted in
-  DECLARATION ORDER (optional ones may be skipped) — the order
-  OpenAI's implementation produces; it keeps the automaton finite and
-  small. anyOf / recursive $ref / pattern / numeric ranges are
-  rejected at compile time (HTTP 400), not silently ignored.
+  null, `anyOf` over any of these (incl. type-list unions like
+  ["string", "null"], which compile to the same alternative sets), and
+  internal NON-recursive $ref into $defs/definitions (the shape
+  pydantic's model_json_schema emits — Optional[X] arrives as anyOf).
+  Properties are emitted in DECLARATION ORDER (optional ones may be
+  skipped) — the order OpenAI's implementation produces; it keeps the
+  automaton finite and small. anyOf runs as an NFA: the MULTI surface
+  carries the set of parallel branch states, advancing all of them per
+  byte, dropping dead ones and collapsing when they converge — byte
+  prefixes shared between branches (e.g. integer vs number) stay
+  ambiguous exactly as long as the input does. oneOf / allOf /
+  recursive $ref / pattern / numeric ranges are rejected at compile
+  time (HTTP 400), not silently ignored.
 
 Whitespace: one byte between tokens, as in json_fsm (unbounded legal
 whitespace lets a masked model burn its budget on emptiness).
@@ -64,7 +71,8 @@ import numpy as np
     COLON,      # expecting ':' (aux = (prop_idx,))
     POST,       # after a complete value: ',' / '}' / ']' per top frame
     DONE,       # complete document: whitespace + EOS only
-) = range(16)
+    MULTI,      # anyOf NFA: aux = tuple of parallel sub-States
+) = range(17)
 
 WS = frozenset(b" \t\n\r")
 DIGITS = frozenset(b"0123456789")
@@ -106,7 +114,7 @@ def _enc_value(v) -> bytes:
 
 
 _UNSUPPORTED = (
-    "anyOf", "oneOf", "allOf", "not", "if", "then", "else",
+    "oneOf", "allOf", "not", "if", "then", "else",
     "patternProperties", "pattern", "format", "minimum", "maximum",
     "exclusiveMinimum", "exclusiveMaximum", "multipleOf", "minLength",
     "maxLength", "uniqueItems", "prefixItems",
@@ -180,6 +188,22 @@ def compile_schema(schema: dict) -> SchemaSpec:
             raise SchemaError("schema too large (> 4096 nodes)")
         nid = len(nodes)
         nodes.append({})  # reserve slot (children reference by id)
+        if "anyOf" in node:
+            alts = node["anyOf"]
+            if not isinstance(alts, list) or not alts:
+                raise SchemaError("anyOf must be a non-empty array")
+            extra = set(node) - {"anyOf", "$defs", "definitions",
+                                 "title", "description", "default"}
+            if extra:
+                raise SchemaError(
+                    f"anyOf with constraint siblings is not supported: "
+                    f"{sorted(extra)}"
+                )
+            nodes[nid] = {
+                "kind": "anyOf",
+                "branches": tuple(build(sub) for sub in alts),
+            }
+            return nid
         if "const" in node:
             nodes[nid] = {
                 "kind": "enum", "alts": (_enc_value(node["const"]),)
@@ -196,7 +220,17 @@ def compile_schema(schema: dict) -> SchemaSpec:
             return nid
         t = node.get("type")
         if isinstance(t, list):
-            raise SchemaError("type unions are not supported")
+            # Type-list unions (["string", "null"]) compile as anyOf over
+            # single-type copies of the node.
+            if not t:
+                raise SchemaError("type list must be non-empty")
+            nodes[nid] = {
+                "kind": "anyOf",
+                "branches": tuple(
+                    build({**node, "type": tt}) for tt in t
+                ),
+            }
+            return nid
         if t == "object":
             props = node.get("properties") or {}
             if not isinstance(props, dict):
@@ -274,6 +308,9 @@ def is_complete(st: Optional[State]) -> bool:
     if st is None:
         return False
     s, aux, stack, _ = st
+    if s == MULTI:
+        # an anyOf document is complete iff ANY live branch is
+        return any(is_complete(sub) for sub in aux)
     if stack:
         return False
     if s == DONE:
@@ -283,6 +320,26 @@ def is_complete(st: Optional[State]) -> bool:
         return True
     # a completable literal alternative (empty suffix present)
     return s == LIT and b"" in aux
+
+
+def _merge_states(results) -> Optional[State]:
+    """Collapse a list of parallel branch states: dedupe (order-
+    preserving, so equal inputs yield equal MULTI states), flatten
+    nested MULTIs, collapse singletons. None when no branch survives."""
+    flat = []
+    for r in results:
+        if r is None:
+            continue
+        if r[0] == MULTI:
+            flat.extend(r[1])
+        else:
+            flat.append(r)
+    out = tuple(dict.fromkeys(flat))
+    if not out:
+        return None
+    if len(out) == 1:
+        return out[0]
+    return (MULTI, out, (), False)
 
 
 def _key_candidates(spec: SchemaSpec, node_id: int, idx: int):
@@ -317,6 +374,13 @@ def _start_value(spec: SchemaSpec, node_id: int, stack: tuple,
     """Dispatch byte b as the first byte of a value of node `node_id`."""
     node = spec.nodes[node_id]
     kind = node["kind"]
+    if kind == "anyOf":
+        # NFA start: byte b may open any branch; live alternatives run
+        # in parallel under MULTI until the input disambiguates.
+        return _merge_states(
+            _start_value(spec, branch, stack, b)
+            for branch in node["branches"]
+        )
     if kind == "enum":
         alive = tuple(a[1:] for a in node["alts"] if a and a[0] == b)
         if not alive:
@@ -353,6 +417,12 @@ def _start_value(spec: SchemaSpec, node_id: int, stack: tuple,
 
 def advance_byte(spec: SchemaSpec, st: State, b: int) -> Optional[State]:
     s, aux, stack, ws = st
+
+    # ---- anyOf NFA: advance every live branch, drop the dead
+    if s == MULTI:
+        return _merge_states(
+            advance_byte_top(spec, sub, b) for sub in aux
+        )
 
     # ---- literal alternative set
     if s == LIT:

@@ -236,6 +236,14 @@ class InferenceEngine:
         self._schema_row_next = 0
         self._schema_fbi = None
         self._schema_flush_pending = False
+        # (schema, exact-state) -> [V] bool bitmap, shared between the
+        # engine step loop and prewarm_schema (HTTP admission threads):
+        # the vocab-wide Python byte walk is the expensive part of a
+        # first state visit, and precomputing it at admission keeps the
+        # step loop from stalling every running decode (advisor finding,
+        # round 4). Plain dict ops are GIL-atomic; values are immutable.
+        self._schema_bitmap_cache: Dict[tuple, np.ndarray] = {}
+        self._prewarmed_schema_keys: set = set()
         self._guided_eos: Optional[List[int]] = None
         # Speculative-decoding accounting: verify steps run, slot-steps
         # (active sequences summed over steps), and tokens emitted — the
@@ -1382,15 +1390,10 @@ class InferenceEngine:
                     "flushing the region at the next step"
                 )
             return perm
-        if self._schema_fbi is None:
-            self._schema_fbi = schema_fsm.build_first_byte_index(
-                self._guided_tokens
-            )
-        eos = getattr(self, "_guided_eos", None)
-        bits = schema_fsm.token_bitmap(
-            spec, st, self._schema_fbi, len(self._guided_tokens),
-            eos if eos is not None else sorted(self.eos_token_ids),
-        )
+        bits = self._schema_bitmap_cache.get(key)
+        if bits is None:
+            bits = self._compute_schema_bitmap(spec, st)
+            self._schema_bitmap_put(key, bits)
         if not bits.any():
             self._schema_row_cache[key] = perm  # memoize the degrade
             return perm
@@ -1400,6 +1403,98 @@ class InferenceEngine:
         self._schema_row_cache[key] = row
         return row
 
+    def _compute_schema_bitmap(self, spec, st) -> np.ndarray:
+        """token_bitmap for one exact state (callable from ANY thread —
+        everything it reads is immutable or benignly-racy)."""
+        from xllm_service_tpu.guided import schema_fsm
+
+        if self._schema_fbi is None:
+            # Benign race: two threads may both build; either result is
+            # correct and the GIL makes the attribute swap atomic.
+            self._schema_fbi = schema_fsm.build_first_byte_index(
+                self._guided_tokens
+            )
+        eos = getattr(self, "_guided_eos", None)
+        return schema_fsm.token_bitmap(
+            spec, st, self._schema_fbi, len(self._guided_tokens),
+            eos if eos is not None else sorted(self.eos_token_ids),
+        )
+
+    def _schema_bitmap_put(self, key, bits: np.ndarray) -> None:
+        cache = self._schema_bitmap_cache
+        if len(cache) >= 4096:  # ~vocab/8 bytes per entry; bound memory
+            try:
+                cache.pop(next(iter(cache)))
+            except (StopIteration, KeyError, RuntimeError):
+                pass
+        cache[key] = bits
+
+    # Canonical-walk byte preferences: quote first (opens a string value
+    # / closes string content), then brace-open, then terminators (end a
+    # number / container, move to the next key), digits last so numbers
+    # stay one digit — the walk emits one minimal document, visiting
+    # every skeleton state and each value node's free-content entry
+    # state once.
+    _PREWARM_BYTES = (0x22, 0x7B, 0x7D, 0x5D, 0x2C, 0x3A, 0x31)
+
+    def prewarm_schema(self, schema) -> None:
+        """Called from the API layer at ADMISSION (HTTP thread) after the
+        schema compiles: walk one canonical document through the
+        automaton, computing and caching the token bitmap of every state
+        visited — object skeleton, key strings, and each value's
+        free-content state (the expensive ones: a free string accepts
+        most of the vocab, ~vocab Python byte walks). By the time the
+        engine step loop first assembles this request, the bitmaps it
+        needs are cache hits, so running decodes never stall behind the
+        byte walk (advisor finding, round 4). States off the canonical
+        path (deep inside free content) still compute lazily on the
+        loop, but those are the cheap self-loop variants."""
+        from xllm_service_tpu.guided import schema_fsm
+
+        if self._guided_tokens is None or schema is None:
+            return
+        try:
+            spec = schema_fsm.compile_schema(schema)
+        except schema_fsm.SchemaError:
+            return
+        # Once per distinct schema: repeat admissions of a warmed schema
+        # skip the canonical walk entirely (review finding, r5). Set ops
+        # are GIL-atomic; a racing double-walk is benign (same results).
+        if spec.source_key in self._prewarmed_schema_keys:
+            return
+        if len(self._prewarmed_schema_keys) >= 512:
+            self._prewarmed_schema_keys.clear()
+        self._prewarmed_schema_keys.add(spec.source_key)
+        st = schema_fsm.initial_state(spec)
+        seen = set()
+        for _ in range(512):  # walk bound (counters make states unique)
+            if st is None or st in seen:
+                return
+            seen.add(st)
+            key = (spec.source_key, st)
+            if key not in self._schema_bitmap_cache:
+                self._schema_bitmap_put(
+                    key, self._compute_schema_bitmap(spec, st)
+                )
+            if schema_fsm.is_complete(st):
+                return
+            # Prefer a successor not yet visited (a whitespace or digit
+            # self-loop must not end the walk while unvisited skeleton
+            # remains); an all-seen frontier terminates via the cycle
+            # check above.
+            nxt = None
+            fallback = None
+            for b in (*self._PREWARM_BYTES, *range(256)):
+                cand = schema_fsm.advance_byte_top(spec, st, b)
+                if cand is None:
+                    continue
+                if cand not in seen:
+                    nxt = cand
+                    break
+                if fallback is None:
+                    fallback = cand
+            st = nxt if nxt is not None else fallback
+
     def _maybe_flush_schema_rows(self) -> None:
         """Between-steps recycle of the dynamic mask-row region: drop the
         memo and restart allocation. Live sequences re-derive their rows
@@ -1407,6 +1502,15 @@ class InferenceEngine:
         index can be stale."""
         if self._schema_flush_pending:
             self._schema_flush_pending = False
+            # Discard writes still buffered for pre-flush rows: the memo
+            # clear makes every live row re-derive and re-stage, and a
+            # stale buffered write must not share one batched
+            # .at[rows].set with a fresh write to the same recycled index
+            # (duplicate-index winner is unspecified in JAX — advisor
+            # finding, round 4).
+            pend = getattr(self.executor, "_pending_guided_rows", None)
+            if pend is not None:
+                pend.clear()
             self._schema_row_cache.clear()
             self._schema_row_next = 0
 
