@@ -299,6 +299,62 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
         prefill_mfu = (
             round(prefill_flops / prefill_dt / peak, 4) if peak else None
         )
+
+        # Analytic roofline (VERDICT r4 #2): expected MFU / HBM-GB/s per
+        # config, computable on ANY backend — on CPU the expectation is
+        # referenced against the bench's TPU target (v5e) so a
+        # tunnel-down round still records where perf SHOULD land.
+        # Weight bytes come from the LIVE param leaves (so W8/W4
+        # quantized residency is counted as served); KV bytes from the
+        # cache dtype. XLA's compiled-module cost_analysis is recorded
+        # alongside for reference but NOT used for the expectation: it
+        # counts lax.scan bodies once (verified: 17 GFLOP reported vs
+        # 282 analytic on the 80-layer 70B decode), so it under-counts
+        # scanned stacks ~num_layers-fold.
+        peak_bw = _peak_hbm_bw(jax.devices()[0])
+        roofline_ref = None
+        peak_ref, bw_ref = peak, peak_bw
+        if peak_ref is None or bw_ref is None:
+            peak_ref, bw_ref, roofline_ref = 197e12, 819e9, "v5e"
+        weight_bytes = sum(
+            int(p.nbytes) for p in jax.tree.leaves(ex.params)
+        )
+        if cfg.kv_cache_dtype == "int8":
+            kv_elem_bytes = 1
+        else:
+            kv_elem_bytes = 4 if cfg.dtype == "float32" else 2
+        kv_row = mcfg.num_layers * mcfg.num_kv_heads * mcfg.head_dim
+        # Decode step: whole weight set streams once per step (R
+        # amortizes it), each slot reads its live context's K/V rows.
+        dec_flops = R * flops_per_tok
+        dec_bytes = weight_bytes + R * ctx * kv_row * 2 * kv_elem_bytes
+        decode_rl = _roofline(dec_flops, dec_bytes, peak_ref, bw_ref)
+        decode_rl["expected_tok_s"] = round(
+            R / decode_rl["expected_step_s"], 1
+        )
+        # Prefill: same weight stream + K/V writes for R*prompt_len rows;
+        # FLOPs from the causal-attention-aware count above.
+        pre_bytes = (
+            weight_bytes + R * prompt_len * kv_row * 2 * kv_elem_bytes
+        )
+        prefill_rl = _roofline(prefill_flops, pre_bytes, peak_ref, bw_ref)
+        prefill_rl["expected_tok_s"] = round(
+            R * prompt_len / prefill_rl["expected_step_s"], 1
+        )
+        # Opt-in: lowering again is a SECOND full XLA compile of the
+        # decode scan (the jit dispatch cache is separate from the AOT
+        # path) — not worth default bench time for a reference-only
+        # field.
+        xla_cost = None
+        if os.environ.get("XLLM_BENCH_XLA_COST"):
+            try:
+                xla_cost = _cost_analysis(
+                    run.lower(
+                        ex.k_cache, ex.v_cache, ex.params, *args
+                    ).compile()
+                )
+            except Exception:
+                xla_cost = None
         print(json.dumps({
             "metric": f"decode_throughput_{model}_bs{R}",
             "value": round(tok_per_s, 1),
@@ -319,6 +375,20 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
             ),
             "kv_cache_dtype": cfg.kv_cache_dtype,
             "weight_dtype": cfg.weight_dtype,
+            # Analytic roofline expectations ("roofline_ref" names the
+            # referenced chip when the run itself is not on TPU). Decode
+            # must be HBM-bound: weights + KV stream once per step.
+            "expected_mfu": decode_rl["expected_mfu"],
+            "expected_hbm_gbps": decode_rl["expected_hbm_gbps"],
+            "decode_roofline": decode_rl,
+            "prefill_roofline": prefill_rl,
+            "roofline_ref": roofline_ref,
+            # Raw XLA compiled-module numbers, for reference only (scan
+            # bodies are counted once — see comment above).
+            "xla_cost_analysis": (
+                {"flops": xla_cost[0], "bytes": xla_cost[1]}
+                if xla_cost else None
+            ),
             # Methodology markers: median of N repeats, the per-repeat
             # spread, and the host's 1-min load average around the run —
             # a hot host shows up here instead of masquerading as a
@@ -349,6 +419,58 @@ def _peak_flops(device) -> float | None:
         if key in kind:
             return peak
     return None
+
+
+def _peak_hbm_bw(device) -> float | None:
+    """Peak HBM bandwidth (bytes/s) by device kind; None on CPU."""
+    kind = getattr(device, "device_kind", "").lower()
+    table = {
+        "v6": 1640e9, "v5p": 2765e9, "v5e": 819e9, "v5 lite": 819e9,
+        "v5": 2765e9, "v4": 1228e9,
+    }
+    for key, bw in table.items():
+        if key in kind:
+            return bw
+    return None
+
+
+def _cost_analysis(compiled) -> "tuple[float, float] | None":
+    """(flops, bytes_accessed) from a compiled executable's XLA cost
+    analysis, or None when the backend doesn't report it."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if not cost:
+        return None
+    flops = float(cost.get("flops", 0.0))
+    bts = float(cost.get("bytes accessed", 0.0))
+    if flops <= 0 or bts <= 0:
+        return None
+    return flops, bts
+
+
+def _roofline(flops: float, bts: float, peak_flops: float,
+              peak_bw: float) -> dict:
+    """Analytic roofline for one compiled step: expected step time is
+    max(compute time, HBM time); expected_mfu / expected_hbm_gbps are
+    what the step achieves AT that bound (VERDICT r4 #2 — a perf
+    expectation that exists even when no chip is reachable)."""
+    t_compute = flops / peak_flops
+    t_hbm = bts / peak_bw
+    t = max(t_compute, t_hbm)
+    return {
+        "flops": flops,
+        "bytes": bts,
+        "expected_step_s": t,
+        "expected_mfu": round(flops / (t * peak_flops), 4),
+        "expected_hbm_gbps": round(bts / t / 1e9, 1),
+        "bound": "hbm" if t_hbm >= t_compute else "compute",
+        "arithmetic_intensity": round(flops / bts, 2),
+        "ridge_intensity": round(peak_flops / peak_bw, 2),
+    }
 
 
 if __name__ == "__main__":
