@@ -101,15 +101,23 @@ def ring_attention(
     sp_axis: str = "sp",
     scale: Optional[float] = None,
     causal: bool = True,
+    tp_axis: Optional[str] = None,
 ) -> jnp.ndarray:
     """Sequence-parallel exact attention over `mesh`'s `sp_axis`.
 
     Call under `jit` with the mesh installed; inputs carry (or are given)
     shardings with L split over `sp_axis`. Returns [B, L, Hq, D] with the
-    same sequence sharding."""
+    same sequence sharding.
+
+    `tp_axis` COMPOSES sequence and tensor parallelism: the head axis
+    additionally shards over that mesh axis (Hq and Hkv both divisible
+    by its size — GQA grouping is per-shard). The ring's ppermute runs
+    over sp only; heads need no cross-device communication, so the tp
+    dimension is purely spatial here and the surrounding projections
+    keep their Megatron sharding on the SAME mesh (VERDICT r4 #6)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    spec = P(None, sp_axis, None, None)
+    spec = P(None, sp_axis, tp_axis, None)
     fn = jax.shard_map(
         functools.partial(
             _ring_attention_local,
