@@ -101,6 +101,40 @@ def main() -> None:
         "--kill-at", type=float, default=0.0,
         help="crash one instance after this fraction of requests dispatched",
     )
+    p.add_argument(
+        "--shared-prefix", type=int, default=0,
+        help="prefix-heavy trace: every prompt starts with a shared "
+        "~N-token system prompt (the CAR-vs-RR workload, VERDICT r4 #5); "
+        "real-engine runs report the fleet prefix-cache hit rate",
+    )
+    p.add_argument(
+        "--prefix-sessions", type=int, default=1,
+        help="number of DISTINCT shared prefixes (request i uses prefix "
+        "i %% N). One session converges to all-hits under any policy "
+        "(every instance caches the single prefix after one miss); many "
+        "sessions discriminate: RR re-prefills each prefix once PER "
+        "INSTANCE, cache-aware routing follows the blocks",
+    )
+    p.add_argument(
+        "--token-delay-ms", type=float, default=2.0,
+        help="fake-engine per-token delay; above target_tpot_ms (50) it "
+        "drives SLO_AWARE decode-pressure flips",
+    )
+    p.add_argument(
+        "--heartbeat-s", type=float, default=1.0,
+        help="instance heartbeat interval: load metrics AND the global "
+        "KV index are exactly this stale at the master — cache-aware "
+        "routing follows blocks it can only see after a heartbeat",
+    )
+    p.add_argument(
+        "--instance-type", default="MIX",
+        choices=["MIX", "DEFAULT", "PREFILL", "DECODE"],
+        help="MIX fleets split one decode + rest prefill (the reference "
+        "placement rule, instance_mgr.cpp:110-127), leaving a SINGLE "
+        "prefill candidate at --instances 2 — every policy then routes "
+        "identically. Use DEFAULT (colocated, all prefill candidates) "
+        "for RR-vs-CAR comparisons",
+    )
     args = p.parse_args()
 
     import os
@@ -124,7 +158,7 @@ def main() -> None:
     store = MemoryStore()
     cfg = ServiceConfig(
         host="127.0.0.1", http_port=0, rpc_port=0,
-        heartbeat_interval_s=1.0, master_lease_ttl_s=3.0,
+        heartbeat_interval_s=args.heartbeat_s, master_lease_ttl_s=3.0,
         load_balance_policy=args.policy, block_size=16,
         detect_disconnected_instance_interval_s=2.0,
     )
@@ -149,23 +183,27 @@ def main() -> None:
                 prefill_buckets=(
                     [256, 512, 1024, 2048] if on_tpu else [64, 128, 256]
                 ),
-                instance_name=f"bench{i}", instance_type="MIX",
+                instance_name=f"bench{i}",
+                instance_type=args.instance_type,
                 # persistent jit cache: repeat runs skip the compiles
                 compilation_cache_dir="/tmp/xllm-jit-cache",
             )
             srv = InstanceServer(
                 ecfg, master_rpc_addr=master.rpc_address,
-                heartbeat_interval_s=1.0,
+                heartbeat_interval_s=args.heartbeat_s,
             )
         else:
             ecfg = EngineConfig(
                 model="fake-echo", instance_name=f"bench{i}",
-                instance_type="MIX", block_size=16,
+                instance_type=args.instance_type, block_size=16,
             )
             srv = InstanceServer(
                 ecfg, master_rpc_addr=master.rpc_address,
-                heartbeat_interval_s=1.0,
-                engine=FakeEngine(token_delay_s=0.002, ttft_ms=10.0),
+                heartbeat_interval_s=args.heartbeat_s,
+                engine=FakeEngine(
+                    token_delay_s=args.token_delay_ms / 1000.0,
+                    ttft_ms=10.0,
+                ),
             )
         srv.start()
         instances.append(srv)
@@ -193,6 +231,35 @@ def main() -> None:
             args.requests, rng, max_prompt, max_out,
             word_mode=args.real_engine,
         )
+    if args.shared_prefix > 0:
+        # Prefix-heavy rewrite: each request draws one of N session
+        # system prompts (~N tokens of numeric words) + a short distinct
+        # tail. CacheAwareRouting should route a session's repeats onto
+        # the instance already holding its prefix blocks; RR alternates
+        # and re-prefills every prefix on every instance.
+        n_sess = max(args.prefix_sessions, 1)
+        sys_prompts = [
+            " ".join(
+                str(7000 + 101 * s + i)
+                for i in range(max(args.shared_prefix // 2, 2))
+            )
+            for s in range(n_sess)
+        ]
+        tail_budget = max(max_prompt - args.shared_prefix, 16)
+        # Random session draw — a deterministic i % N assignment would
+        # CORRELATE with round-robin dispatch (session i%N always lands
+        # on instance i%2), silently pinning sessions under RR too.
+        sess_of = rng.integers(0, n_sess, size=len(pairs))
+        pairs = [
+            (
+                sys_prompts[int(sess_of[i])] + " " + " ".join(
+                    str((911 * i + j) % 9973)
+                    for j in range(max(min(tail_budget, 32) // 2, 2))
+                ),
+                o,
+            )
+            for i, (_, o) in enumerate(pairs)
+        ]
     offline_mask = rng.random(args.requests) < args.offline_frac
     gaps = rng.exponential(1.0 / args.rate, size=args.requests)
     kill_idx = -1
@@ -288,6 +355,21 @@ def main() -> None:
         t.join(timeout=600.0)
     wall = time.monotonic() - t_start
     redispatches = master.scheduler.total_redispatches
+    pd_flips = master.scheduler.instance_mgr.total_flips
+    cached = sum(
+        getattr(srv.engine, "prefix_cached_tokens", 0) for srv in instances
+    )
+    prompted = sum(
+        getattr(srv.engine, "prefix_prompt_tokens", 0) for srv in instances
+    )
+    prefix_hit_rate = round(cached / prompted, 4) if prompted else None
+    prefix_by_instance = {
+        srv.name: [
+            int(getattr(srv.engine, "prefix_cached_tokens", 0)),
+            int(getattr(srv.engine, "prefix_prompt_tokens", 0)),
+        ]
+        for srv in instances
+    }
 
     for srv in instances:
         try:
@@ -333,6 +415,13 @@ def main() -> None:
                 "req_p99_s": pct(lats, 99),
                 "killed_instance_at_s": killed_at_s,
                 "redispatches": redispatches,
+                "error_sample": errors[0][:200] if errors else None,
+                "shared_prefix_tokens": args.shared_prefix or None,
+                "prefix_cache_hit_rate": prefix_hit_rate,
+                "prefix_by_instance": (
+                    prefix_by_instance if args.shared_prefix else None
+                ),
+                "pd_flips": pd_flips,
             }
         )
     )

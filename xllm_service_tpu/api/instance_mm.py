@@ -81,19 +81,35 @@ class MultimodalMixin:
             h.send_error_json(400, "parts and target are required")
             return
         vcfg = self.engine.executor.cfg
-        images = []
+        S = vcfg.image_size
+        decoded = []  # (is_video, arr) in part order
         for p in parts:
             shape = p.get("shape") or []
+            is_video = len(shape) == 4
+            spatial = shape[1:] if is_video else shape
             if (
-                len(shape) != 3
-                or shape[0] != vcfg.image_size
-                or shape[1] != vcfg.image_size
-                or shape[2] != 3
+                len(shape) not in (3, 4)
+                or spatial != [S, S, 3]
+                or (is_video and (shape[0] < 2 or shape[0] % 2))
             ):
                 h.send_error_json(
                     400,
                     f"media shape {shape} != encoder input "
-                    f"[{vcfg.image_size}, {vcfg.image_size}, 3]",
+                    f"[{S}, {S}, 3] (or [T even, {S}, {S}, 3] for video)",
+                )
+                return
+            if is_video and (
+                not hasattr(self.engine, "encode_video")
+                or getattr(vcfg, "arch", "") != "qwen2vl"
+            ):
+                # Checked HERE, not at jit-trace time inside the encode
+                # call — a raise escaping the handler tears down the
+                # connection instead of sending this 501 (review
+                # finding, r5).
+                h.send_error_json(
+                    501,
+                    f"this encoder ({getattr(vcfg, 'arch', '?')}) has no "
+                    "video path (qwen2vl towers only)",
                 )
                 return
             try:
@@ -103,15 +119,40 @@ class MultimodalMixin:
             except Exception as e:
                 h.send_error_json(400, f"bad media payload: {e}")
                 return
-            images.append(arr)
-        embeds = self.engine.encode(np.stack(images))  # [B, T, D]
-        flat = np.ascontiguousarray(embeds.reshape(-1, embeds.shape[-1]))
+            decoded.append((is_video, arr))
+        # Contiguous still images batch through one encode call; videos
+        # encode per part (their token count varies with frame count).
+        chunks = []
+        img_batch = []
+
+        def flush_images():
+            if img_batch:
+                out = self.engine.encode(np.stack(img_batch))  # [B, T, D]
+                chunks.extend(out[i] for i in range(out.shape[0]))
+                img_batch.clear()
+
+        for is_video, arr in decoded:
+            if is_video:
+                flush_images()
+                chunks.append(self.engine.encode_video(arr))  # [N, D]
+            else:
+                img_batch.append(arr)
+        flush_images()
+        flat = np.ascontiguousarray(
+            np.concatenate([np.asarray(c).reshape(-1, c.shape[-1])
+                            for c in chunks])
+        )
         if positions and len(positions) != flat.shape[0]:
+            per_part = [
+                int(np.asarray(c).reshape(-1, flat.shape[-1]).shape[0])
+                for c in chunks
+            ]
             h.send_error_json(
                 400,
                 f"{len(positions)} placeholder positions but the encoder "
                 f"produced {flat.shape[0]} media tokens "
-                f"({embeds.shape[1]} per part — set mm_tokens_per_media)",
+                f"(per part: {per_part} — check mm_tokens_per_media and "
+                "the video frame counts)",
             )
             return
         try:

@@ -524,6 +524,7 @@ class ServingMixin:
                         adapter_idx=adapter_idx,
                         mm_embeds=mm_embeds,
                         mm_positions=mm_positions,
+                        mm_grids=body.get("mm_grids"),
                     )
                 )
             h.send_json({"ok": True, "service_request_id": srid, "request_id": rid})

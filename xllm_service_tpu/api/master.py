@@ -536,6 +536,8 @@ class Master:
             )
             if req.mm_positions:
                 fwd["mm_positions"] = list(req.mm_positions)
+                if req.mm_grids:
+                    fwd["mm_grids"] = [list(g) for g in req.mm_grids]
             try:
                 code, resp = post_json(meta.http_address, path, fwd, timeout=30.0)
                 if code != 200:

@@ -81,6 +81,10 @@ class ServiceConfig:
     # Square the ENCODE tower compiled for (VisionConfig.image_size);
     # required when mm_image_processor is set.
     mm_image_size: int = 0
+    # Frames per temporal slice of the ENCODE tower
+    # (VisionConfig.temporal_patch_size) — sizes video placeholder
+    # spans: a T-frame video takes T/tps * mm_tokens_per_media tokens.
+    mm_temporal_patch_size: int = 2
 
     @classmethod
     def from_args(cls, argv: Optional[List[str]] = None) -> "ServiceConfig":

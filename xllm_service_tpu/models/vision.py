@@ -466,6 +466,58 @@ def _merger_mlp(params: Params, cfg: VisionConfig, x: jnp.ndarray):
     )
 
 
+def _qwen2vl_video_rows(frames: jnp.ndarray, cfg: VisionConfig):
+    """HF Qwen2VLImageProcessor patch arrangement for VIDEO frames
+    [T, S, S, C] (T a multiple of temporal_patch_size): each temporal
+    group of tps REAL frames becomes one grid of [C, tps, Ph, Pw]
+    flattened patches in the same (hg, wg, mh, mw) spatial order as the
+    still-image path. Returns (rows [G, g*g, C*tps*P*P], h_ids, w_ids)
+    with G = T // tps temporal groups on the leading axis."""
+    T, S, _, C = frames.shape
+    P, m, tps = cfg.patch_size, cfg.spatial_merge_size, cfg.temporal_patch_size
+    assert T % tps == 0, (T, tps)
+    G = T // tps
+    g = S // P
+    gg = g // m
+    x = frames.reshape(G, tps, gg, m, P, gg, m, P, C)
+    # -> [G, hg, wg, mh, mw, C, tps, Ph, Pw]
+    x = jnp.transpose(x, (0, 2, 5, 3, 6, 8, 1, 4, 7))
+    rows = x.reshape(G, g * g, C * tps * P * P)
+    import numpy as _np
+
+    hg, wg, mh, mw = _np.meshgrid(
+        _np.arange(gg), _np.arange(gg), _np.arange(m), _np.arange(m),
+        indexing="ij",
+    )
+    h_ids = (hg * m + mh).reshape(-1)
+    w_ids = (wg * m + mw).reshape(-1)
+    return rows, h_ids, w_ids
+
+
+def encode_video(
+    params: Params, cfg: VisionConfig, frames: jnp.ndarray
+) -> jnp.ndarray:
+    """[T, S, S, 3] video frames -> media tokens [G * tokens_per_slice,
+    out_dim], G = T // temporal_patch_size.
+
+    HF Qwen2VisionTransformer attends PER temporal slice (cu_seqlens
+    repeats grid_h*grid_w per grid_t), so the group axis rides the
+    shared encoder body's batch dimension — each slice is an independent
+    attention span with the same (h, w) rotary tables, exactly the HF
+    semantics. Qwen2.5-VL's windowed tower is not wired for video yet
+    and rejects loudly."""
+    if cfg.arch != "qwen2vl":
+        raise NotImplementedError(
+            f"video encoding is implemented for the qwen2vl tower only "
+            f"(got arch {cfg.arch!r})"
+        )
+    rows, h_ids, w_ids = _qwen2vl_video_rows(
+        frames.astype(params["patch_embed"].dtype), cfg
+    )
+    out = _qwen2vl_body(params, cfg, rows, h_ids, w_ids)  # [G, n, D]
+    return out.reshape(-1, out.shape[-1])
+
+
 def _encode_qwen2vl(
     params: Params, cfg: VisionConfig, images: jnp.ndarray
 ) -> jnp.ndarray:
@@ -475,12 +527,20 @@ def _encode_qwen2vl(
     QuickGELU MLP, full (non-causal) attention over the image's patches,
     then PatchMerger (ln_q -> 2x2 concat -> GELU MLP -> LLM dim).
     Reference: transformers modeling_qwen2_vl.py."""
-    B = images.shape[0]
-    H, D = cfg.num_heads, cfg.hidden_size // cfg.num_heads
-    m2 = cfg.spatial_merge_size**2
     rows, h_ids, w_ids = _qwen2vl_patch_rows(
         images.astype(params["patch_embed"].dtype), cfg
     )
+    return _qwen2vl_body(params, cfg, rows, h_ids, w_ids)
+
+
+def _qwen2vl_body(
+    params: Params, cfg: VisionConfig, rows: jnp.ndarray, h_ids, w_ids
+) -> jnp.ndarray:
+    """Shared Qwen2-VL encoder body over pre-arranged patch rows
+    [B, N, C*tps*P*P]: still images put images on the batch axis; videos
+    put temporal groups there (per-slice attention)."""
+    B = rows.shape[0]
+    H, D = cfg.num_heads, cfg.hidden_size // cfg.num_heads
     x = jnp.einsum("bnp,pe->bne", rows, params["patch_embed"])  # [B, N, E]
 
     cos_t, sin_t = _qwen2vl_rope_tables(h_ids, w_ids, D)

@@ -66,6 +66,11 @@ class EngineRequest:
     # content-addressed blocks across different images.
     mm_embeds: Optional[object] = None
     mm_positions: Optional[object] = None
+    # Per-media merged-token grids [(t, gh, gw), ...] in document order
+    # (t > 1 = video): _mrope_positions lays the (t, h, w) streams from
+    # these instead of inferring a square still-image grid from the span
+    # length. Absent/short lists fall back to the inference.
+    mm_grids: Optional[object] = None
     # Guided decoding: "json" constrains the output to a JSON object via
     # the engine's mask table (set_guided_context must have been called);
     # "json_schema" additionally constrains it to `schema` (a JSON-Schema
@@ -251,6 +256,9 @@ class InferenceEngine:
         self.spec_steps = 0
         self.spec_slot_steps = 0
         self.spec_tokens_emitted = 0
+        # Prefix-cache effectiveness over fresh admissions (bench/metrics).
+        self.prefix_cached_tokens = 0
+        self.prefix_prompt_tokens = 0
 
     # -------------------------------------------------------------- public
 
@@ -548,6 +556,15 @@ class InferenceEngine:
                 with self._lock:
                     self._waiting.appendleft(item)
                 break
+            if not isinstance(item, _Seq):
+                # Prefix-cache effectiveness counters, AFTER allocation
+                # succeeds — an OutOfBlocksError requeue retries the same
+                # raw item and would double-count (review finding, r5);
+                # preemption resumes (_Seq items) re-match their own
+                # blocks and are not cache "hits". bench_serving reports
+                # the fleet hit rate from these.
+                self.prefix_cached_tokens += num_cached
+                self.prefix_prompt_tokens += max(n_tok - 1, 0)
 
             # Chunked prefill: the step budget is STRICT — a long uncached
             # suffix prefills across steps (decode runs between chunks, so
@@ -1213,6 +1230,8 @@ class InferenceEngine:
             run_start = prev = p
         if run_start is not None:
             spans.append((run_start, prev - run_start + 1))
+        grids = [tuple(int(v) for v in g) for g in (seq.req.mm_grids or ())]
+        gi = 0  # next undeclared-grid index (document order, like spans)
         cur = 0  # next rope position value
         idx = 0  # next prompt index to fill
         for s0, m in spans:
@@ -1220,6 +1239,30 @@ class InferenceEngine:
                 pos[:, idx] = cur
                 cur += 1
                 idx += 1
+            # Declared grids (HF get_rope_index, video-capable): consume
+            # greedily — ADJACENT media parts share one contiguous
+            # placeholder run, so a span may cover several grids. Each
+            # grid's t stream advances per temporal slice of gh*gw
+            # tokens, h/w lay the slice; text (or the next medium)
+            # resumes at cur + max(t, gh, gw).
+            rem = m
+            while rem > 0 and gi < len(grids):
+                t, gh, gw = grids[gi]
+                n_g = t * gh * gw
+                if n_g > rem:
+                    break
+                sl = gh * gw
+                for j in range(n_g):
+                    pos[0, idx + j] = cur + j // sl
+                    pos[1, idx + j] = cur + (j % sl) // gw
+                    pos[2, idx + j] = cur + j % gw
+                cur += max(t, gh, gw)
+                idx += n_g
+                rem -= n_g
+                gi += 1
+            if rem == 0:
+                continue
+            m = rem
             g = int(round(math.sqrt(m)))
             if g * g != m:
                 # non-square span (unknown grid): degrade to sequential

@@ -56,6 +56,9 @@ class VisionExecutor:
         self._jit = jax.jit(
             lambda p, imgs: vision.encode_images(p, self.cfg, imgs)
         )
+        self._video_jit = jax.jit(
+            lambda p, frames: vision.encode_video(p, self.cfg, frames)
+        )
 
     @staticmethod
     def _pow2(n: int) -> int:
@@ -74,6 +77,24 @@ class VisionExecutor:
             )
         out = self._jit(self.params, jnp.asarray(images, jnp.float32))
         return np.asarray(out[:B], np.float32)
+
+    def encode_video(self, frames: np.ndarray) -> np.ndarray:
+        """[T, S, S, 3] float32 video frames -> flat media tokens
+        [T//tps * tokens_per_slice, out_dim] (qwen2vl tower; per-slice
+        attention — models/vision.encode_video). Frame counts bucket to
+        the next multiple of 2*tps by repeating the LAST frame (the HF
+        processor's own pad-to-temporal-patch convention), keeping the
+        jit shape set small; padded slices' tokens are sliced off."""
+        tps = getattr(self.cfg, "temporal_patch_size", 2)
+        T = frames.shape[0]
+        want_slices = max((T + tps - 1) // tps, 1)
+        bucket = self._pow2(want_slices) * tps
+        if bucket != T:
+            pad = np.repeat(frames[-1:], bucket - T, axis=0)
+            frames = np.concatenate([frames, pad])
+        out = self._video_jit(self.params, jnp.asarray(frames, jnp.float32))
+        per_slice = out.shape[0] // (bucket // tps)
+        return np.asarray(out[: want_slices * per_slice], np.float32)
 
 
 class EncoderEngine:
@@ -121,14 +142,22 @@ class EncoderEngine:
         return [], []
 
     # -- work -----------------------------------------------------------
-    def encode(self, images: np.ndarray) -> np.ndarray:
+    def _timed(self, fn, arg: np.ndarray) -> np.ndarray:
+        """Shared active-count + latency-window accounting for both
+        encode paths (one place to change — review finding, r5)."""
         with self._mu:
             self._active += 1
         t0 = time.monotonic()
         try:
-            return self.executor.encode(images)
+            return fn(arg)
         finally:
             ms = (time.monotonic() - t0) * 1000
             with self._mu:
                 self._active -= 1
                 self._latency_window.append((time.monotonic(), ms))
+
+    def encode(self, images: np.ndarray) -> np.ndarray:
+        return self._timed(self.executor.encode, images)
+
+    def encode_video(self, frames: np.ndarray) -> np.ndarray:
+        return self._timed(self.executor.encode_video, frames)

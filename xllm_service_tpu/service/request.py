@@ -56,6 +56,9 @@ class ServiceRequest:
     # token_ids where its embeddings land.
     media_parts: List[Dict[str, Any]] = field(default_factory=list)
     mm_positions: List[int] = field(default_factory=list)
+    # Per-part merged (t, gh, gw) grids for the engine's M-RoPE streams
+    # (t > 1 = video); empty when the geometry isn't square.
+    mm_grids: List[List[int]] = field(default_factory=list)
     # Filled by the scheduler:
     num_generated_tokens: int = 0
     estimated_ttft_ms: float = 0.0

@@ -20,6 +20,7 @@ Additions over the reference, per SURVEY.md §5/§7:
 from __future__ import annotations
 
 import logging
+import math
 import re
 import threading
 import time
@@ -292,6 +293,13 @@ class Scheduler:
         r"data:application/x-raw-f32;shape=(\d+)x(\d+)x(\d+);base64,(.*)",
         re.S,
     )
+    # Video tensor backdoor: T x H x W x C frames (T even — the qwen2vl
+    # temporal_patch_size pairs frames).
+    _MM_DATA4_RE = re.compile(
+        r"data:application/x-raw-f32;shape=(\d+)x(\d+)x(\d+)x(\d+);"
+        r"base64,(.*)",
+        re.S,
+    )
 
     def _decode_media_part(self, p):
         """One MMContentPart -> ({type, shape, data}, None) or (None,
@@ -335,13 +343,30 @@ class Scheduler:
                         np.ascontiguousarray(arr).tobytes()
                     ).decode(),
                 }, None
+        if p.type in ("video", "video_url"):
+            m4 = self._MM_DATA4_RE.match(url)
+            if m4:
+                T = int(m4.group(1))
+                tps = max(self._config.mm_temporal_patch_size, 1)
+                if T < tps or T % tps:
+                    return None, Status(
+                        StatusCode.INVALID_ARGUMENT,
+                        f"video needs a frame count that is a positive "
+                        f"multiple of temporal_patch_size {tps}, got {T}",
+                    )
+                return {
+                    "type": p.type,
+                    "shape": [T] + [int(m4.group(i)) for i in (2, 3, 4)],
+                    "data": m4.group(5),
+                }, None
         m = self._MM_DATA_RE.match(url)
         if not m:
             return None, Status(
                 StatusCode.INVALID_ARGUMENT,
                 f"unsupported media URL for {p.type}: expected a "
-                "data:image/...;base64 image or a "
-                "data:application/x-raw-f32;shape=HxWxC;base64 payload",
+                "data:image/...;base64 image, a "
+                "data:application/x-raw-f32;shape=HxWxC;base64 tensor, or "
+                "(video) a ...shape=TxHxWxC tensor",
             )
         return {
             "type": p.type,
@@ -386,17 +411,39 @@ class Scheduler:
                 "markers in the templated prompt (literal marker text in a "
                 "message is not allowed)",
             )
+        # Per-part placeholder counts: an image part takes k tokens (the
+        # encoder's tokens-per-slice); a video of T frames spans
+        # T // tps temporal slices of k tokens each (tps = the tower's
+        # temporal_patch_size, config mm_temporal_patch_size). mm_grids
+        # carries each part's merged (t, gh, gw) grid for the engine's
+        # M-RoPE streams — only when k is a perfect square (the
+        # square-tower geometry); otherwise the engine's span inference
+        # applies.
+        tps = max(self._config.mm_temporal_patch_size, 1)
+        s = math.isqrt(k)
+        emit_grids = s * s == k
+        counts, grids = [], []
+        for part in media_parts:
+            slices = (
+                part["shape"][0] // tps if len(part["shape"]) == 4 else 1
+            )
+            counts.append(k * slices)
+            grids.append([slices, s, s])
         token_ids: List[int] = []
         positions: List[int] = []
+        pi = 0
         for seg in segments:
             if seg in self._MM_MARKERS:
-                positions.extend(range(len(token_ids), len(token_ids) + k))
-                token_ids.extend([0] * k)  # placeholder (pad) tokens
+                n = counts[pi]
+                pi += 1
+                positions.extend(range(len(token_ids), len(token_ids) + n))
+                token_ids.extend([0] * n)  # placeholder (pad) tokens
             elif seg:
                 token_ids.extend(self._tokenizer.encode(seg))
         request.token_ids = token_ids
         request.mm_positions = positions
         request.media_parts = media_parts
+        request.mm_grids = grids if emit_grids else []
         return None
 
     def should_defer_offline(self, request: ServiceRequest) -> bool:
