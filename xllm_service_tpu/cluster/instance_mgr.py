@@ -72,6 +72,8 @@ class InstanceMgr:
         self._mu = threading.RLock()
         # Pending (name, attempt) role flips awaiting instance notification.
         self._flip_events: List[Tuple[str, int]] = []
+        # Lifetime flip count (events drain; benches/metrics need totals).
+        self.total_flips = 0
 
         self._instances: Dict[str, InstanceMetaInfo] = {}
         # Role indices: name lists with swap-pop removal (reference keeps
@@ -650,6 +652,7 @@ class InstanceMgr:
                 self._push_index(name, InstanceType.DECODE)
                 self._instances[name].current_type = InstanceType.DECODE
                 self._flip_events.append((name, 1))
+                self.total_flips += 1
                 logger.info("flipped %s prefill->decode", name)
                 return name
             return ""
@@ -669,6 +672,7 @@ class InstanceMgr:
                 self._push_index(name, InstanceType.PREFILL)
                 self._instances[name].current_type = InstanceType.PREFILL
                 self._flip_events.append((name, 1))
+                self.total_flips += 1
                 logger.info("flipped %s decode->prefill", name)
                 return name
             return ""
