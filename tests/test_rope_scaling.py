@@ -406,6 +406,126 @@ def test_linear_rope_engine_matches_transformers_greedy(tmp_path):
     assert got == want, (got, want)
 
 
+def test_yarn_frequencies_match_hf():
+    """yarn table + attention factor vs transformers' own yarn init —
+    both the plain-factor form and the DeepSeek mscale/mscale_all_dim
+    ratio form."""
+    pytest.importorskip("torch")
+    cfg = _base_cfg(
+        rope_scaling_type="yarn", rope_scaling_factor=4.0,
+        rope_original_max_position=32, max_position_embeddings=128,
+    )
+    hf_cfg = _hf_llama_config(cfg, {
+        "rope_type": "yarn", "factor": 4.0,
+        "original_max_position_embeddings": 32,
+    })
+    want, want_scale = _hf_inv_freq("yarn", hf_cfg)
+    got, got_scale = rope_parameters(cfg.head_dim, cfg)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    np.testing.assert_allclose(got_scale, want_scale, rtol=1e-6)
+    # DeepSeek form: attention factor is the mscale RATIO.
+    cfg2 = _base_cfg(
+        rope_scaling_type="yarn", rope_scaling_factor=40.0,
+        rope_original_max_position=32, max_position_embeddings=1280,
+        rope_mscale=0.707, rope_mscale_all_dim=0.707,
+    )
+    hf_cfg2 = _hf_llama_config(cfg2, {
+        "rope_type": "yarn", "factor": 40.0,
+        "original_max_position_embeddings": 32,
+        "mscale": 0.707, "mscale_all_dim": 0.707,
+    })
+    want2, want_scale2 = _hf_inv_freq("yarn", hf_cfg2)
+    got2, got_scale2 = rope_parameters(cfg2.head_dim, cfg2)
+    np.testing.assert_allclose(got2, want2, rtol=1e-6)
+    np.testing.assert_allclose(got_scale2, want_scale2, rtol=1e-6)
+
+
+def test_deepseek_v3_yarn_engine_matches_transformers_greedy(tmp_path):
+    """Real-DeepSeek-shaped yarn (factor + mscale/mscale_all_dim, which
+    also scales the ATTENTION SOFTMAX temperature) through the real MLA
+    engine: greedy continuations equal transformers'
+    DeepseekV3ForCausalLM. Prompt runs BEYOND the original context so
+    the interpolated frequency band actually engages."""
+    torch = pytest.importorskip("torch")
+    try:
+        from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
+    except Exception:
+        pytest.skip("transformers lacks DeepseekV3")
+
+    rope_scaling = {
+        "rope_type": "yarn", "factor": 4.0,
+        "original_max_position_embeddings": 16,
+        "beta_fast": 32, "beta_slow": 1,
+        "mscale": 1.0, "mscale_all_dim": 1.0,
+    }
+    hf_cfg = DeepseekV3Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4,
+        n_routed_experts=8, num_experts_per_tok=2, n_shared_experts=1,
+        n_group=2, topk_group=1, norm_topk_prob=True,
+        routed_scaling_factor=2.5, scoring_func="sigmoid",
+        topk_method="noaux_tc", first_k_dense_replace=1,
+        kv_lora_rank=32, q_lora_rank=24, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, rope_theta=10000.0,
+        rms_norm_eps=1e-6, max_position_embeddings=64,
+        rope_scaling=rope_scaling,
+        attn_implementation="eager", pad_token_id=0,
+    )
+    torch.manual_seed(5)
+    with torch.no_grad():
+        hf = DeepseekV3ForCausalLM(hf_cfg).eval().float()
+        for layer in hf.model.layers[1:]:
+            layer.mlp.gate.e_score_correction_bias.uniform_(-0.5, 0.5)
+    ckpt = str(tmp_path / "dsv3-yarn")
+    os.makedirs(ckpt, exist_ok=True)
+    tensors = {n: p.detach().numpy() for n, p in hf.named_parameters()}
+    for n, b in hf.named_buffers():
+        if "e_score_correction_bias" in n:
+            tensors[n] = b.detach().numpy()
+    weights.write_safetensors(
+        os.path.join(ckpt, "model.safetensors"), tensors
+    )
+    with open(os.path.join(ckpt, "config.json"), "w") as f:
+        json.dump({
+            "architectures": ["DeepseekV3ForCausalLM"],
+            "model_type": "deepseek_v3",
+            "vocab_size": 512, "hidden_size": 64,
+            "intermediate_size": 128, "moe_intermediate_size": 32,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 4,
+            "n_routed_experts": 8, "num_experts_per_tok": 2,
+            "n_shared_experts": 1, "n_group": 2, "topk_group": 1,
+            "norm_topk_prob": True, "routed_scaling_factor": 2.5,
+            "scoring_func": "sigmoid", "topk_method": "noaux_tc",
+            "first_k_dense_replace": 1,
+            "kv_lora_rank": 32, "q_lora_rank": 24,
+            "qk_nope_head_dim": 16, "qk_rope_head_dim": 8,
+            "v_head_dim": 16, "rope_theta": 10000.0,
+            "rms_norm_eps": 1e-6, "max_position_embeddings": 64,
+            "rope_scaling": rope_scaling,
+        }, f)
+
+    mcfg = weights.config_from_hf(ckpt)
+    assert mcfg.rope_scaling_type == "yarn"
+    assert mcfg.rope_mscale_all_dim == 1.0
+    from xllm_service_tpu.models.deepseek import mla_softmax_scale
+
+    base = (mcfg.qk_nope_head_dim + mcfg.qk_rope_head_dim) ** -0.5
+    assert mla_softmax_scale(mcfg) > base  # temperature correction on
+
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(1, 500, (24,)).tolist()  # > original 16
+    with torch.no_grad():
+        out = hf.generate(
+            input_ids=torch.tensor([prompt]), max_new_tokens=6,
+            do_sample=False,
+        )
+    want = out[0, len(prompt):].tolist()
+    got = _engine_greedy(ckpt, prompt, 6, max_seq_len=64, buckets=(32,))
+    assert got == want, (got, want)
+
+
 def test_saved_checkpoint_roundtrips_rope_scaling(tmp_path):
     """save_hf_checkpoint emits rope_scaling; config_from_hf re-reads the
     identical fields (the inverse-pair invariant the parity tests use)."""

@@ -26,14 +26,20 @@ class ModelConfig:
     # runtime/weights.config_from_hf parses it and LOUDLY rejects types
     # not listed there). "" = plain theta. Tuples keep the frozen config
     # hashable for jit static args.
-    rope_scaling_type: str = ""  # "linear" | "dynamic" | "llama3" | "longrope"
+    # "linear" | "dynamic" | "llama3" | "longrope" | "yarn"
+    rope_scaling_type: str = ""
     rope_scaling_factor: float = 1.0
     rope_original_max_position: int = 0  # 0 = max_position_embeddings
     rope_low_freq_factor: float = 1.0  # llama3
     rope_high_freq_factor: float = 4.0  # llama3
     rope_short_factor: tuple = ()  # longrope per-band tables [head_dim/2]
     rope_long_factor: tuple = ()
-    rope_attention_factor: float = 0.0  # longrope; 0 = HF sqrt-log formula
+    rope_attention_factor: float = 0.0  # longrope/yarn; 0 = HF formula
+    rope_beta_fast: float = 32.0  # yarn correction-range bounds
+    rope_beta_slow: float = 1.0
+    rope_mscale: float = 0.0  # yarn (DeepSeek): attention-factor numerator
+    rope_mscale_all_dim: float = 0.0  # ...and denominator / softmax scale
+    rope_scaling_truncate: bool = True  # yarn: floor/ceil the range
     rms_norm_eps: float = 1e-5
     max_position_embeddings: int = 8192
     tie_word_embeddings: bool = False
@@ -498,5 +504,16 @@ register(
         n_shared_experts=1,
         first_k_dense_replace=3,  # V3: first 3 layers dense
         rms_norm_eps=1e-6,
+        # Real V3 ships yarn (config.json rope_scaling): 4k pretraining
+        # context extended 40x; mscale_all_dim also scales the MLA
+        # softmax temperature (models/deepseek.mla_softmax_scale).
+        max_position_embeddings=163840,
+        rope_scaling_type="yarn",
+        rope_scaling_factor=40.0,
+        rope_original_max_position=4096,
+        rope_beta_fast=32.0,
+        rope_beta_slow=1.0,
+        rope_mscale=1.0,
+        rope_mscale_all_dim=1.0,
     )
 )

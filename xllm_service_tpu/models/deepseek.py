@@ -64,6 +64,21 @@ def cache_row_dims(cfg: ModelConfig) -> Tuple[int, int]:
     return 1, cfg.mla_cache_dim
 
 
+def mla_softmax_scale(cfg: ModelConfig) -> float:
+    """Score scale for MLA attention: (dn + dr)^-0.5, times the yarn
+    temperature correction real DeepSeek-V2/V3 checkpoints apply — HF
+    DeepseekV2/V3Attention multiplies its softmax scale by
+    yarn_get_mscale(factor, mscale_all_dim)^2 when rope_scaling carries
+    mscale_all_dim."""
+    scale = (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
+    if cfg.rope_scaling_type == "yarn" and cfg.rope_mscale_all_dim:
+        m = rope_ops.yarn_mscale(
+            cfg.rope_scaling_factor, cfg.rope_mscale_all_dim
+        )
+        scale *= m * m
+    return scale
+
+
 def _layer_stack(
     cfg: ModelConfig, key: jax.Array, dtype, n: int, moe: bool
 ) -> Dict[str, jnp.ndarray]:
@@ -272,7 +287,7 @@ def decode_step(
 ):
     """One generation step for R sequences; mirrors llama.decode_step."""
     bs = k_caches.shape[3]
-    scale = (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
+    scale = mla_softmax_scale(cfg)
     kvr = cfg.kv_lora_rank
     x = params["embed"][token_ids].astype(wdtype(params["layers"]["w_dkv"]))
 
@@ -327,7 +342,7 @@ def prefill_batch_step(
     embedding injection included — the EPD encoder stage is model-family
     agnostic)."""
     bs = k_caches.shape[3]
-    scale = (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
+    scale = mla_softmax_scale(cfg)
     kvr = cfg.kv_lora_rank
     P, Lpad = token_ids.shape
     x = params["embed"][token_ids].astype(wdtype(params["layers"]["w_dkv"]))
@@ -411,7 +426,7 @@ def hidden_dense(
     B, L = token_ids.shape
     dn, dr = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
     kvr = cfg.kv_lora_rank
-    scale = (dn + dr) ** -0.5
+    scale = mla_softmax_scale(cfg)
     positions = jnp.arange(L, dtype=jnp.int32)
     x = params["embed"][token_ids].astype(wdtype(params["layers"]["w_dkv"]))
     causal = (

@@ -83,7 +83,57 @@ def rope_parameters(head_dim: int, cfg) -> tuple:
     if typ == "longrope":
         short, _, mscale = _longrope_tables(head_dim, cfg, inv, orig)
         return short, mscale
+    if typ == "yarn":
+        # HF _compute_yarn_parameters (arxiv 2309.00071): blend the
+        # interpolated (inv/factor) and extrapolated (inv) tables with a
+        # linear ramp between the beta_fast/beta_slow correction dims;
+        # the attention factor follows the paper's 0.1*ln(s)+1 mscale —
+        # DeepSeek configs supply mscale/mscale_all_dim and get the
+        # RATIO (their checkpoints also scale the softmax temperature,
+        # which the MLA attention applies — models/deepseek.py).
+        bf = float(getattr(cfg, "rope_beta_fast", 32.0)) or 32.0
+        bs = float(getattr(cfg, "rope_beta_slow", 1.0)) or 1.0
+        msc = float(getattr(cfg, "rope_mscale", 0.0))
+        msc_all = float(getattr(cfg, "rope_mscale_all_dim", 0.0))
+        att = float(getattr(cfg, "rope_attention_factor", 0.0))
+        if not att:
+            if msc and msc_all:
+                att = yarn_mscale(factor, msc) / yarn_mscale(
+                    factor, msc_all
+                )
+            else:
+                att = yarn_mscale(factor)
+
+        def corr_dim(rot: float) -> float:
+            return (
+                head_dim * math.log(orig / (rot * 2.0 * math.pi))
+            ) / (2.0 * math.log(theta))
+
+        low, high = corr_dim(bf), corr_dim(bs)
+        if getattr(cfg, "rope_scaling_truncate", True):
+            low, high = math.floor(low), math.ceil(high)
+        low, high = max(low, 0), min(high, head_dim - 1)
+        if low == high:
+            high += 0.001  # HF's singularity guard
+        ramp = np.clip(
+            (np.arange(head_dim // 2, dtype=np.float32) - low)
+            / (high - low),
+            0.0, 1.0,
+        )
+        extrap = 1.0 - ramp
+        return (
+            (inv / factor) * (1.0 - extrap) + inv * extrap
+        ).astype(np.float32), float(att)
     raise NotImplementedError(f"rope_scaling type {typ!r}")
+
+
+def yarn_mscale(scale: float, mscale: float = 1.0) -> float:
+    """The yarn paper's attention-temperature term (HF get_mscale);
+    DeepSeek's attention ALSO multiplies its softmax scale by
+    yarn_mscale(factor, mscale_all_dim)^2 — models/deepseek.py."""
+    if scale <= 1.0:
+        return 1.0
+    return 0.1 * mscale * math.log(scale) + 1.0
 
 
 def _plain_inv_freq(head_dim: int, theta: float) -> np.ndarray:

@@ -164,10 +164,11 @@ def _hf_rope_scaling(hf: dict) -> dict:
 
     Implemented types (ops/rope.rope_parameters does the math): linear,
     dynamic NTK, llama3 (Llama-3.1/3.2), longrope (Phi-3, incl. the older
-    "su" spelling). "default"/mrope-only entries are no-ops. ANY other
-    type raises — the one silent failure mode this loader refuses is a
-    checkpoint that loads cleanly and serves diverging logits (yarn
-    checkpoints, e.g. real DeepSeek-V2, land here until implemented)."""
+    "su" spelling), and yarn (real DeepSeek-V2/V3, incl. their
+    mscale/mscale_all_dim attention scaling). "default"/mrope-only
+    entries are no-ops. ANY other type raises — the one silent failure
+    mode this loader refuses is a checkpoint that loads cleanly and
+    serves diverging logits."""
     rs = hf.get("rope_scaling")
     if not rs or rs.get("mrope_section"):
         # mrope_section-only configs (Qwen2-VL) declare type "default"/
@@ -199,6 +200,20 @@ def _hf_rope_scaling(hf: dict) -> dict:
                 rs["original_max_position_embeddings"]
             ),
         )
+    if rtype == "yarn":
+        return dict(
+            rope_scaling_type="yarn",
+            rope_scaling_factor=float(rs["factor"]),
+            rope_original_max_position=int(
+                rs.get("original_max_position_embeddings") or 0
+            ),
+            rope_beta_fast=float(rs.get("beta_fast") or 32.0),
+            rope_beta_slow=float(rs.get("beta_slow") or 1.0),
+            rope_mscale=float(rs.get("mscale") or 0.0),
+            rope_mscale_all_dim=float(rs.get("mscale_all_dim") or 0.0),
+            rope_attention_factor=float(rs.get("attention_factor") or 0.0),
+            rope_scaling_truncate=bool(rs.get("truncate", True)),
+        )
     if rtype in ("longrope", "su"):
         # Phi-3 keeps original_max_position_embeddings at the TOP level
         # of config.json; newer HF layouts put it inside rope_scaling.
@@ -223,7 +238,7 @@ def _hf_rope_scaling(hf: dict) -> dict:
         )
     raise NotImplementedError(
         f"rope_scaling type {rtype!r} is not supported (implemented: "
-        "linear, dynamic, llama3, longrope); refusing to load a "
+        "linear, dynamic, llama3, longrope, yarn); refusing to load a "
         "checkpoint that would serve silently diverging logits"
     )
 
