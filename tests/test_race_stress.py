@@ -356,3 +356,134 @@ def test_engine_concurrency_fuzz_round4_features(seed):
     assert not eng._running
     assert len(eng._free_slots) == cfg.max_running_requests
     assert not eng._waiting
+
+
+@pytest.mark.parametrize("seed", [3, 29])
+def test_engine_concurrency_fuzz_round5_features(seed):
+    """Round-5 surface under the same invariants: anyOf schemas (MULTI
+    NFA states through the dynamic mask rows), media requests with
+    M-RoPE video grids (mm_grids position streams, media preemption
+    resume), and prewarm_schema racing from client threads (the HTTP
+    admission hook sharing the bitmap cache with the step loop)."""
+    import dataclasses
+
+    from xllm_service_tpu.guided import json_fsm
+    from xllm_service_tpu.models.configs import get_model_config
+    from xllm_service_tpu.tokenizer import ByteTokenizer
+
+    ANYOF = {
+        "type": "object",
+        "additionalProperties": False,
+        "properties": {
+            "v": {"anyOf": [
+                {"type": "integer"}, {"type": "string"},
+                {"type": "null"},
+            ]},
+            "t": {"type": ["string", "null"]},
+        },
+        "required": ["v", "t"],
+    }
+    cfg = EngineConfig(
+        model="llama3-tiny",
+        dtype="float32",
+        block_size=16,
+        num_blocks=40,  # tight: pool-pressure preemption
+        max_running_requests=3,
+        max_seq_len=128,
+        prefill_buckets=[32, 64, 128],
+    )
+    mcfg = dataclasses.replace(
+        get_model_config("llama3-tiny"), mrope_section=(4, 6, 6)
+    )
+    ex = ModelExecutor(cfg, init_seed=9, model_cfg=mcfg)
+    eng = InferenceEngine(cfg, executor=ex, eos_token_ids=(2,))
+    tok = ByteTokenizer()
+    tb = tok.token_bytes_table(ex.cfg.vocab_size)
+    eng.set_guided_context(json_fsm.token_mask_table(tb, [2]), tb,
+                           eos_ids=[2])
+    eng.start()
+    rng = random.Random(seed)
+    np_rng = np.random.default_rng(seed)
+    N = 18
+    trackers = []
+    try:
+        def client(base):
+            for i in range(N // 3):
+                rid = f"r5s{seed}-c{base}-{i}"
+                kind = rng.random()
+                cancel_after = 1 if kind < 0.15 else None
+                t = TerminalTracker(rid, cancel_after, eng)
+                trackers.append(t)
+                feat = rng.random()
+                mm_kwargs = {}
+                if feat < 0.35:
+                    # video-shaped media: 8 placeholders = 2 slices of a
+                    # 2x2 merged grid, embeds injected, grids declared
+                    prompt = (
+                        [10, 20, 8] + [0] * 8
+                        + np_rng.integers(1, 500, (5,)).tolist()
+                    )
+                    mm_kwargs = dict(
+                        mm_embeds=np_rng.standard_normal(
+                            (8, 128)
+                        ).astype(np.float32),
+                        mm_positions=list(range(3, 11)),
+                        mm_grids=[[2, 2, 2]],
+                    )
+                    guided = None
+                else:
+                    prompt = np_rng.integers(
+                        1, 500, (int(np_rng.integers(3, 70)),)
+                    ).tolist()
+                    guided = "json_schema" if feat < 0.6 else None
+                    if guided and rng.random() < 0.5:
+                        # racing HTTP-thread prewarm against the loop
+                        eng.prewarm_schema(ANYOF)
+                eng.add_request(
+                    EngineRequest(
+                        request_id=rid,
+                        prompt_token_ids=prompt,
+                        sampling=SamplingParams(
+                            temperature=rng.choice([0.0, 0.9]),
+                            seed=rng.randrange(2**31),
+                            max_new_tokens=int(np_rng.integers(2, 12)),
+                        ),
+                        callback=t,
+                        offline=feat > 0.85,
+                        guided=guided,
+                        schema=ANYOF if guided else None,
+                        **mm_kwargs,
+                    )
+                )
+                if kind > 0.85:
+                    time.sleep(rng.random() * 0.02)
+                    eng.cancel(rid)
+                time.sleep(rng.random() * 0.01)
+
+        threads = [
+            threading.Thread(target=client, args=(b,)) for b in range(3)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        deadline = time.monotonic() + 240
+        for t in trackers:
+            assert t.done.wait(max(0.1, deadline - time.monotonic())), (
+                f"request {t.rid} never reached a terminal state "
+                f"(tokens={t.n_tokens})"
+            )
+    finally:
+        eng.stop()
+
+    for t in trackers:
+        assert t.post_terminal == 0, (
+            f"{t.rid}: {t.post_terminal} outputs after terminal emission"
+        )
+        assert t.terminal in ("finished", "error"), t.terminal
+    bm = eng.block_mgr
+    assert bm.num_referenced_blocks == 0
+    assert bm.num_free_blocks == bm.num_blocks - 1
+    assert not eng._running
+    assert len(eng._free_slots) == cfg.max_running_requests
+    assert not eng._waiting

@@ -85,6 +85,9 @@ class ServiceConfig:
     # (VisionConfig.temporal_patch_size) — sizes video placeholder
     # spans: a T-frame video takes T/tps * mm_tokens_per_media tokens.
     mm_temporal_patch_size: int = 2
+    # Uniform-sampling cap for real compressed videos (data:video/...):
+    # longer clips sample down to this many frames before encoding.
+    mm_video_max_frames: int = 16
 
     @classmethod
     def from_args(cls, argv: Optional[List[str]] = None) -> "ServiceConfig":

@@ -1180,9 +1180,15 @@ class ModelExecutor:
     def _sp_impl(self, k_cache, v_cache, params, token_ids, true_len,
                  blk, off, temperature, top_k, top_p, step_key):
         # Per-family dispatch — supports_sp already gated on the module
-        # actually providing prefill_sp_step.
+        # actually providing prefill_sp_step. When the serving mesh also
+        # carries a tensor axis, the ring COMPOSES with it: params keep
+        # their Megatron tp sharding and ring attention shards heads
+        # over tp too (parity-proven on the composed mesh in
+        # __graft_entry__._composed_sp_tp_prefill).
+        tp_axis = "tp" if self.mesh.shape.get("tp", 1) > 1 else None
         logits, k_all, v_all = self.model_mod.prefill_sp_step(
-            params, self.cfg, token_ids, true_len, self.mesh
+            params, self.cfg, token_ids, true_len, self.mesh,
+            tp_axis=tp_axis,
         )
         # Scatter every token's per-layer K/V into the paged cache
         # (invalid/padded rows land in garbage block 0). Advanced indices

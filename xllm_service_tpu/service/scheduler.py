@@ -344,6 +344,42 @@ class Scheduler:
                     ).decode(),
                 }, None
         if p.type in ("video", "video_url"):
+            tps_cfg = max(self._config.mm_temporal_patch_size, 1)
+            is_real_video = _ip.is_video_data_url(url)
+            proc = self._config.mm_image_processor
+            size = self._config.mm_image_size
+            if is_real_video and (proc != "qwen2vl" or not size):
+                # Config check BEFORE the cv2 decode — a misconfigured
+                # deployment must reject for free, not after buffering a
+                # whole clip (review finding, r5).
+                return None, Status(
+                    StatusCode.INVALID_ARGUMENT,
+                    "real-video ingestion needs mm_image_processor="
+                    "'qwen2vl' and mm_image_size (the video-capable "
+                    "tower family)",
+                )
+            try:
+                frames = _ip.decode_video_url(
+                    url, max_frames=self._config.mm_video_max_frames,
+                    temporal_patch=tps_cfg,
+                )
+            except ValueError as e:
+                return None, Status(StatusCode.INVALID_ARGUMENT, str(e))
+            if frames is not None:
+                # Real compressed video: per-frame HF pixel math (the
+                # qwen2vl family's CLIP normalize, pinned to the tower's
+                # square) -> the 4D f32 tensor the encode stage carries.
+                arr = np.stack([
+                    _ip.preprocess_qwen2vl(f, pinned_size=size)
+                    for f in frames
+                ])
+                return {
+                    "type": p.type,
+                    "shape": list(arr.shape),
+                    "data": _b64.b64encode(
+                        np.ascontiguousarray(arr).tobytes()
+                    ).decode(),
+                }, None
             m4 = self._MM_DATA4_RE.match(url)
             if m4:
                 T = int(m4.group(1))
