@@ -1,0 +1,277 @@
+"""Audio through the EPD pipeline (Qwen2-Audio tower): mel-feature
+parity with WhisperFeatureExtractor, tower parity with HF
+Qwen2AudioEncoder (through the checkpoint loader), WAV decode, and the
+full HTTP front door. The reference's message model carries audio_url
+parts (jinja_chat_template.h:30-47) but has no encoder anywhere — this
+completes the media triad beyond parity."""
+
+from __future__ import annotations
+
+import io
+import json as _json
+import os as _os
+import wave as _wave
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+jax.config.update("jax_platforms", "cpu")
+
+from xllm_service_tpu.models import audio as A  # noqa: E402
+from xllm_service_tpu.service import audio_processor as ap  # noqa: E402
+
+
+def _wav_bytes(x: np.ndarray, rate: int = 16000) -> bytes:
+    buf = io.BytesIO()
+    with _wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(rate)
+        w.writeframes(
+            (np.clip(x, -1, 1) * 32767).astype(np.int16).tobytes()
+        )
+    return buf.getvalue()
+
+
+def test_log_mel_matches_whisper_feature_extractor():
+    pytest.importorskip("torch")
+    from transformers import WhisperFeatureExtractor
+
+    fe = WhisperFeatureExtractor(feature_size=128)
+    rng = np.random.default_rng(3)
+    wav = (rng.standard_normal(16000 * 3) * 0.1).astype(np.float32)
+    want = fe(
+        wav, sampling_rate=16000, return_tensors="np",
+        padding="max_length",
+    )["input_features"][0]
+    got = ap.log_mel(wav, 128, 3000)
+    assert got.shape == want.shape == (128, 3000)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_wav_decode_roundtrip_and_resample():
+    rng = np.random.default_rng(5)
+    x = (rng.standard_normal(8000) * 0.2).astype(np.float32)
+    import base64
+
+    url = "data:audio/wav;base64," + base64.b64encode(
+        _wav_bytes(x)
+    ).decode()
+    out = ap.decode_audio_url(url)
+    np.testing.assert_allclose(out, x, atol=1e-4)  # int16 quantization
+    # 8 kHz input resamples to 16 kHz
+    url8 = "data:audio/wav;base64," + base64.b64encode(
+        _wav_bytes(x, rate=8000)
+    ).decode()
+    out8 = ap.decode_audio_url(url8)
+    assert abs(len(out8) - 16000) <= 2
+    # non-audio URLs pass through
+    assert ap.decode_audio_url("data:image/png;base64,xx") is None
+    with pytest.raises(ValueError, match="undecodable"):
+        ap.decode_audio_url(
+            "data:audio/wav;base64," + base64.b64encode(b"junk").decode()
+        )
+
+
+def _export_hf_audio(tmp_path, cfg):
+    """Build an HF Qwen2AudioEncoder + projector on cfg's geometry and
+    export in the combined-checkpoint layout."""
+    torch = pytest.importorskip("torch")
+    from transformers.models.qwen2_audio.configuration_qwen2_audio import (
+        Qwen2AudioEncoderConfig,
+    )
+    from transformers.models.qwen2_audio.modeling_qwen2_audio import (
+        Qwen2AudioEncoder,
+    )
+
+    from xllm_service_tpu.runtime import weights as W
+
+    hf_cfg = Qwen2AudioEncoderConfig(
+        num_mel_bins=cfg.num_mel_bins, d_model=cfg.hidden_size,
+        encoder_layers=cfg.num_layers,
+        encoder_attention_heads=cfg.num_heads,
+        encoder_ffn_dim=cfg.intermediate_size,
+        max_source_positions=cfg.conv_frames,
+        scale_embedding=False, attn_implementation="eager",
+    )
+    torch.manual_seed(3)
+    with torch.no_grad():
+        hf = Qwen2AudioEncoder(hf_cfg).eval().float()
+        proj_w = torch.randn(cfg.out_dim, cfg.hidden_size) * 0.05
+        proj_b = torch.randn(cfg.out_dim) * 0.01
+    ckpt = str(tmp_path / "q2audio")
+    _os.makedirs(ckpt, exist_ok=True)
+    tensors = {
+        "audio_tower." + n: p.detach().numpy()
+        for n, p in hf.named_parameters()
+    }
+    tensors["multi_modal_projector.linear.weight"] = proj_w.numpy()
+    tensors["multi_modal_projector.linear.bias"] = proj_b.numpy()
+    W.write_safetensors(_os.path.join(ckpt, "model.safetensors"), tensors)
+    with open(_os.path.join(ckpt, "config.json"), "w") as f:
+        _json.dump({
+            "model_type": "qwen2_audio",
+            "audio_config": {
+                "model_type": "qwen2_audio_encoder",
+                "num_mel_bins": cfg.num_mel_bins,
+                "d_model": cfg.hidden_size,
+                "encoder_layers": cfg.num_layers,
+                "encoder_attention_heads": cfg.num_heads,
+                "encoder_ffn_dim": cfg.intermediate_size,
+                "max_source_positions": cfg.conv_frames,
+            },
+            "text_config": {"hidden_size": cfg.out_dim},
+        }, f)
+    return hf, (proj_w, proj_b), ckpt
+
+
+def test_audio_tower_matches_hf_through_loader(tmp_path):
+    """HF Qwen2AudioEncoder + projector exported in the combined layout,
+    ingested by load_audio_checkpoint, encode_audio output equals HF
+    tower -> linear — conv unfold, bias-free k, avg-pool and all."""
+    torch = pytest.importorskip("torch")
+    from xllm_service_tpu.runtime import weights as W
+
+    cfg = A.get_audio_config("audio-tiny")
+    hf, (proj_w, proj_b), ckpt = _export_hf_audio(tmp_path, cfg)
+    lcfg, params = W.load_audio_checkpoint(ckpt, dtype=jnp.float32)
+    assert lcfg.out_tokens == cfg.out_tokens == 10
+
+    rng = np.random.default_rng(1)
+    mel = rng.standard_normal(
+        (2, cfg.num_mel_bins, cfg.mel_frames)
+    ).astype(np.float32)
+    with torch.no_grad():
+        h = hf(torch.from_numpy(mel)).last_hidden_state
+        want = (h @ proj_w.T + proj_b).numpy()
+    got = np.asarray(A.encode_audio(params, lcfg, jnp.asarray(mel)))
+    assert got.shape == want.shape == (2, 10, cfg.out_dim)
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_audio_checkpoint_save_load_roundtrip(tmp_path):
+    from xllm_service_tpu.runtime import weights as W
+
+    cfg = A.get_audio_config("audio-tiny")
+    params = A.init_audio_params(cfg, jax.random.PRNGKey(4), jnp.float32)
+    path = str(tmp_path / "rt")
+    W.save_qwen2audio_tower(params, cfg, path)
+    cfg2, loaded = W.load_audio_checkpoint(path, dtype=jnp.float32)
+    assert cfg2.num_mel_bins == cfg.num_mel_bins
+    assert cfg2.mel_frames == cfg.mel_frames
+    mel = jnp.asarray(
+        np.random.default_rng(2).standard_normal(
+            (1, cfg.num_mel_bins, cfg.mel_frames)
+        ).astype(np.float32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(A.encode_audio(params, cfg, mel)),
+        np.asarray(A.encode_audio(loaded, cfg2, mel)),
+        atol=1e-6,
+    )
+
+
+def test_wav_through_full_epd_http_path(tmp_path):
+    """An ACTUAL WAV clip through /v1/chat/completions -> scheduler
+    (log-mel + per-clip placeholder count) -> audio ENCODE instance ->
+    embedding injection -> prefill -> tokens. Different clips must
+    produce different outputs."""
+    import base64
+
+    from xllm_service_tpu.api import Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+
+    from tests.test_api_e2e import http_post, wait_until
+
+    acfg = A.get_audio_config("audio-tiny")
+    store = MemoryStore(clock=lambda: 0.0)
+    master = Master(ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0, block_size=16,
+        mm_audio_mel_bins=acfg.num_mel_bins,
+        mm_audio_mel_frames=acfg.mel_frames,
+    ), store=store)
+    master.start()
+    lm = InstanceServer(
+        EngineConfig(
+            model="llama3-tiny", dtype="float32", block_size=16,
+            num_blocks=64, max_running_requests=4, max_seq_len=256,
+            prefill_buckets=[32, 64, 128], instance_name="au-mix",
+            instance_type="MIX",
+        ),
+        master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.2,
+    )
+    enc = InstanceServer(
+        EngineConfig(
+            model="audio-tiny", instance_name="au-enc",
+            instance_type="ENCODE",
+        ),
+        master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.2,
+    )
+    # A VISION encoder in the same fleet: modality routing must send
+    # every audio request to au-enc, never round-robin onto this one
+    # (review finding, r5 — encoders host one tower each).
+    venc = InstanceServer(
+        EngineConfig(
+            model="vit-tiny", instance_name="au-venc",
+            instance_type="ENCODE",
+        ),
+        master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.2,
+    )
+    lm.start()
+    enc.start()
+    venc.start()
+    try:
+        from xllm_service_tpu.runtime.vision_executor import AudioExecutor
+
+        assert isinstance(enc.engine.audio_executor, AudioExecutor)
+        assert enc.meta.modalities == ["audio"]
+        assert venc.meta.modalities == ["image"]
+        assert wait_until(
+            lambda: master.scheduler.instance_mgr.counts()[2] == 2
+            and sum(master.scheduler.instance_mgr.counts()) == 3
+        )
+        rng = np.random.default_rng(17)
+        # 0.4 s at 16 kHz == the tiny tower's 40 mel frames
+        clip_a = (np.sin(np.linspace(0, 880 * np.pi, 6400))
+                  * 0.3).astype(np.float32)
+        clip_b = (rng.standard_normal(6400) * 0.2).astype(np.float32)
+
+        def ask(clip):
+            url = "data:audio/wav;base64," + base64.b64encode(
+                _wav_bytes(clip)
+            ).decode()
+            code, body = http_post(
+                master.http_address, "/v1/chat/completions",
+                {"model": "llama3-tiny", "max_tokens": 6,
+                 "temperature": 0.0,
+                 "messages": [{"role": "user", "content": [
+                     {"type": "text", "text": "hear "},
+                     {"type": "audio_url",
+                      "audio_url": {"url": url}},
+                 ]}]},
+                timeout=180.0,
+            )
+            assert code == 200, body
+            return body["choices"][0]["message"]["content"]
+
+        out_a = ask(clip_a)
+        out_b = ask(clip_b)
+        out_a2 = ask(clip_a)
+        assert out_a == out_a2  # deterministic per clip
+        assert out_a != out_b  # the waveform actually reaches the LM
+
+        # Repeats stay deterministic BECAUSE modality routing pins audio
+        # to au-enc — a blind round-robin would 501 on au-venc.
+        for _ in range(2):
+            assert ask(clip_a) == out_a
+    finally:
+        enc.stop()
+        venc.stop()
+        lm.stop()
+        master.stop()
+        store.close()

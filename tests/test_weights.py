@@ -503,11 +503,11 @@ def test_phi3_matches_hf_reference(tmp_path):
 
 
 def test_unknown_rope_scaling_rejected(tmp_path):
-    """Unimplemented rope_scaling types (yarn here) fail LOUDLY — the one
-    failure mode the loader refuses is a checkpoint that loads cleanly
-    and serves silently diverging logits. (longrope/llama3/linear/dynamic
-    are implemented — tests/test_rope_scaling.py.)"""
-    ckpt = str(tmp_path / "llama-yarn")
+    """Unimplemented rope_scaling types fail LOUDLY — the one failure
+    mode the loader refuses is a checkpoint that loads cleanly and
+    serves silently diverging logits. (llama3/linear/dynamic/longrope/
+    yarn are all implemented — tests/test_rope_scaling.py.)"""
+    ckpt = str(tmp_path / "llama-mystery-rope")
     os.makedirs(ckpt, exist_ok=True)
     with open(os.path.join(ckpt, "config.json"), "w") as f:
         json.dump({
@@ -515,9 +515,9 @@ def test_unknown_rope_scaling_rejected(tmp_path):
             "hidden_size": 64, "intermediate_size": 128,
             "num_hidden_layers": 2, "num_attention_heads": 4,
             "num_key_value_heads": 2,
-            "rope_scaling": {"rope_type": "yarn", "factor": 4.0},
+            "rope_scaling": {"rope_type": "ntk-mystery", "factor": 4.0},
         }, f)
-    with pytest.raises(NotImplementedError, match="yarn"):
+    with pytest.raises(NotImplementedError, match="ntk-mystery"):
         weights.config_from_hf(ckpt)
 
 

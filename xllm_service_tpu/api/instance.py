@@ -142,6 +142,22 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
             InstanceType.PREFILL, InstanceType.DECODE, InstanceType.ENCODE
         ):
             self.meta.current_type = self.meta.type
+        if self.meta.type == InstanceType.ENCODE:
+            # Advertise the hosted modality: encoders serve ONE tower
+            # (vision_executor.EncoderEngine), and the scheduler must
+            # route each media request to an encoder covering every
+            # requested modality (review finding, r5).
+            mods = []
+            vis = getattr(self.engine, "executor", None)
+            if vis is not None:
+                mods.append("image")
+                if getattr(getattr(vis, "cfg", None), "arch", "") == (
+                    "qwen2vl"
+                ):
+                    mods.append("video")
+            if getattr(self.engine, "audio_executor", None) is not None:
+                mods.append("audio")
+            self.meta.modalities = mods
         ttft, tpot = self.engine.profiling_data()
         self.meta.ttft_profiling_data = ttft
         self.meta.tpot_profiling_data = tpot

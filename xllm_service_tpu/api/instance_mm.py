@@ -80,37 +80,64 @@ class MultimodalMixin:
         if not parts or not target:
             h.send_error_json(400, "parts and target are required")
             return
-        vcfg = self.engine.executor.cfg
-        S = vcfg.image_size
-        decoded = []  # (is_video, arr) in part order
+        vcfg = getattr(self.engine.executor, "cfg", None)  # vision
+        acfg = getattr(
+            getattr(self.engine, "audio_executor", None), "cfg", None
+        )
+        decoded = []  # (kind, arr) in part order; kind: img|video|audio
         for p in parts:
             shape = p.get("shape") or []
-            is_video = len(shape) == 4
-            spatial = shape[1:] if is_video else shape
-            if (
-                len(shape) not in (3, 4)
-                or spatial != [S, S, 3]
-                or (is_video and (shape[0] < 2 or shape[0] % 2))
-            ):
-                h.send_error_json(
-                    400,
-                    f"media shape {shape} != encoder input "
-                    f"[{S}, {S}, 3] (or [T even, {S}, {S}, 3] for video)",
-                )
-                return
-            if is_video and (
-                not hasattr(self.engine, "encode_video")
-                or getattr(vcfg, "arch", "") != "qwen2vl"
-            ):
-                # Checked HERE, not at jit-trace time inside the encode
-                # call — a raise escaping the handler tears down the
-                # connection instead of sending this 501 (review
-                # finding, r5).
-                h.send_error_json(
-                    501,
-                    f"this encoder ({getattr(vcfg, 'arch', '?')}) has no "
-                    "video path (qwen2vl towers only)",
-                )
+            if len(shape) == 2:
+                # Audio: [num_mel_bins, mel_frames] log-mel features.
+                if acfg is None:
+                    h.send_error_json(
+                        501, "this encoder instance hosts no audio tower"
+                    )
+                    return
+                if shape != [acfg.num_mel_bins, acfg.mel_frames]:
+                    h.send_error_json(
+                        400,
+                        f"audio shape {shape} != encoder input "
+                        f"[{acfg.num_mel_bins}, {acfg.mel_frames}]",
+                    )
+                    return
+                kind = "audio"
+            elif len(shape) in (3, 4):
+                if vcfg is None:
+                    h.send_error_json(
+                        501, "this encoder instance hosts no vision tower"
+                    )
+                    return
+                S = vcfg.image_size
+                is_video = len(shape) == 4
+                spatial = shape[1:] if is_video else shape
+                if spatial != [S, S, 3] or (
+                    is_video and (shape[0] < 2 or shape[0] % 2)
+                ):
+                    h.send_error_json(
+                        400,
+                        f"media shape {shape} != encoder input "
+                        f"[{S}, {S}, 3] (or [T even, {S}, {S}, 3] for "
+                        "video)",
+                    )
+                    return
+                if is_video and (
+                    not hasattr(self.engine, "encode_video")
+                    or getattr(vcfg, "arch", "") != "qwen2vl"
+                ):
+                    # Checked HERE, not at jit-trace time inside the
+                    # encode call — a raise escaping the handler tears
+                    # down the connection instead of sending this 501
+                    # (review finding, r5).
+                    h.send_error_json(
+                        501,
+                        f"this encoder ({getattr(vcfg, 'arch', '?')}) "
+                        "has no video path (qwen2vl towers only)",
+                    )
+                    return
+                kind = "video" if is_video else "img"
+            else:
+                h.send_error_json(400, f"bad media shape {shape}")
                 return
             try:
                 arr = np.frombuffer(
@@ -119,25 +146,35 @@ class MultimodalMixin:
             except Exception as e:
                 h.send_error_json(400, f"bad media payload: {e}")
                 return
-            decoded.append((is_video, arr))
-        # Contiguous still images batch through one encode call; videos
-        # encode per part (their token count varies with frame count).
+            decoded.append((kind, arr))
+        # Contiguous same-kind stills/audio batch through one encode
+        # call; videos encode per part (token count varies with frames).
         chunks = []
-        img_batch = []
+        batch: list = []
+        batch_kind = ""
 
-        def flush_images():
-            if img_batch:
-                out = self.engine.encode(np.stack(img_batch))  # [B, T, D]
+        def flush():
+            nonlocal batch_kind
+            if batch:
+                fn = (
+                    self.engine.encode_audio if batch_kind == "audio"
+                    else self.engine.encode
+                )
+                out = fn(np.stack(batch))  # [B, tokens, D]
                 chunks.extend(out[i] for i in range(out.shape[0]))
-                img_batch.clear()
+                batch.clear()
+            batch_kind = ""
 
-        for is_video, arr in decoded:
-            if is_video:
-                flush_images()
+        for kind, arr in decoded:
+            if kind == "video":
+                flush()
                 chunks.append(self.engine.encode_video(arr))  # [N, D]
             else:
-                img_batch.append(arr)
-        flush_images()
+                if batch_kind not in ("", kind):
+                    flush()
+                batch_kind = kind
+                batch.append(arr)
+        flush()
         flat = np.ascontiguousarray(
             np.concatenate([np.asarray(c).reshape(-1, c.shape[-1])
                             for c in chunks])

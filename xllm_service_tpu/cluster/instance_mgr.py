@@ -385,11 +385,23 @@ class InstanceMgr:
                 routing.decode_name = routing.prefill_name
             return routing
 
-    def next_encode_instance(self) -> str:
+    def next_encode_instance(self, required=frozenset()) -> str:
+        """Round-robin over ENCODE instances whose advertised modalities
+        cover `required` (e.g. {"image"} or {"audio"}). Encoders host
+        ONE tower, so modality-blind rotation would 501 half the
+        requests on mixed fleets (review finding, r5). Instances that
+        advertise nothing are legacy wildcards."""
+        required = set(required)
         with self._mu:
-            if not self._encode_index:
+            candidates = [
+                n for n in self._encode_index
+                if not required
+                or not (m := self._instances.get(n)) or not m.modalities
+                or required <= set(m.modalities)
+            ]
+            if not candidates:
                 return ""
-            name = self._encode_index[self._rr_encode % len(self._encode_index)]
+            name = candidates[self._rr_encode % len(candidates)]
             self._rr_encode += 1
             return name
 

@@ -88,6 +88,11 @@ class ServiceConfig:
     # Uniform-sampling cap for real compressed videos (data:video/...):
     # longer clips sample down to this many frames before encoding.
     mm_video_max_frames: int = 16
+    # Audio front door (service/audio_processor.py): the ENCODE audio
+    # tower's log-mel geometry (AudioConfig.num_mel_bins / mel_frames).
+    # 0 frames disables real-audio ingestion (raw-f32 backdoor only).
+    mm_audio_mel_bins: int = 128
+    mm_audio_mel_frames: int = 0
 
     @classmethod
     def from_args(cls, argv: Optional[List[str]] = None) -> "ServiceConfig":

@@ -187,6 +187,10 @@ class InstanceMetaInfo:
     # LoRA adapter names this instance serves (requests with model=<name>
     # route to the adapter; surfaced cluster-wide via /v1/models).
     lora_adapters: List[str] = field(default_factory=list)
+    # ENCODE instances: media modalities this encoder serves ("image",
+    # "video", "audio") — the scheduler routes media requests only to an
+    # encoder covering every requested modality. Empty = legacy wildcard.
+    modalities: List[str] = field(default_factory=list)
 
     def to_json(self) -> Dict[str, Any]:
         return {
@@ -206,6 +210,7 @@ class InstanceMetaInfo:
             "latest_timestamp": self.latest_timestamp,
             "current_type": int(self.current_type),
             "lora_adapters": list(self.lora_adapters),
+            "modalities": list(self.modalities),
         }
 
     @classmethod
@@ -232,6 +237,7 @@ class InstanceMetaInfo:
             latest_timestamp=int(j.get("latest_timestamp", 0)),
             current_type=InstanceType(int(j.get("current_type", 1))),
             lora_adapters=[str(x) for x in j.get("lora_adapters", [])],
+            modalities=[str(x) for x in j.get("modalities", [])],
         )
 
     def serialize(self) -> str:

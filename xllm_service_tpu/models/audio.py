@@ -1,0 +1,225 @@
+"""Audio encoder (EPD stage E): the Qwen2-Audio tower.
+
+Completes the media triad the reference's message model carries
+(jinja_chat_template.h:30-47 parses `audio_url` parts verbatim; no
+encoder exists anywhere in the reference — this is capability beyond
+parity, mirroring the vision towers' design).
+
+Architecture = HF Qwen2AudioEncoder (a WhisperEncoder clone,
+modeling_qwen2_audio.py) + the Qwen2AudioMultiModalProjector:
+
+    log-mel [B, M, T]
+      -> conv1 (M -> D, k3 p1) + GELU
+      -> conv2 (D -> D, k3 s2 p1) + GELU       T -> ceil(T/2)
+      -> + learned positions [max_source_positions, D]
+      -> pre-LN transformer (biased q/v/out, BIAS-FREE k — the Whisper
+         convention; GELU MLP; full bidirectional attention)
+      -> avg_pool1d(2, 2)                      -> floor(ceil(T/2)/2)
+      -> LayerNorm -> linear projector to the LM hidden size
+
+TPU-first: the convs are einsums over unfolded frames (static shapes,
+MXU-friendly), the layer stack is one lax.scan over stacked leaves —
+same compile-once shape discipline as the vision towers. Output tokens
+per clip are a pure function of the padded mel length
+(`audio_out_tokens`), which the service tier uses to size placeholder
+spans.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from xllm_service_tpu.models.vision import layer_norm
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class AudioConfig:
+    name: str
+    num_mel_bins: int  # M — mel features per frame
+    mel_frames: int  # T — padded mel length the tower compiles for
+    hidden_size: int  # D (HF d_model)
+    intermediate_size: int  # HF encoder_ffn_dim
+    num_layers: int  # HF encoder_layers
+    num_heads: int  # HF encoder_attention_heads
+    out_dim: int  # LM hidden size (projector output)
+    ln_eps: float = 1e-5
+
+    @property
+    def conv_frames(self) -> int:
+        """Positions after conv2 (stride 2, k3, p1) == HF
+        max_source_positions for the compiled mel length."""
+        return (self.mel_frames + 1) // 2
+
+    @property
+    def out_tokens(self) -> int:
+        """Media tokens per clip: conv2 then avg_pool(2, 2)."""
+        return self.conv_frames // 2
+
+
+_REGISTRY: Dict[str, AudioConfig] = {}
+
+
+def register_audio(cfg: AudioConfig) -> AudioConfig:
+    _REGISTRY[cfg.name] = cfg
+    return cfg
+
+
+def get_audio_config(name: str) -> AudioConfig:
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown audio config '{name}'; have {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[name]
+
+
+register_audio(
+    AudioConfig(
+        # Test-scale tower (CPU CI) paired with llama3-tiny's hidden 128.
+        name="audio-tiny",
+        num_mel_bins=16,
+        mel_frames=40,  # -> 20 conv positions -> 10 media tokens
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        out_dim=128,
+    )
+)
+
+register_audio(
+    AudioConfig(
+        # Real Qwen2-Audio-7B geometry (HF Qwen2AudioEncoderConfig
+        # defaults): 30 s of 16 kHz audio -> 3000 mel frames -> 1500
+        # positions -> 750 media tokens into a 4096-wide LM.
+        name="qwen2audio-encoder",
+        num_mel_bins=128,
+        mel_frames=3000,
+        hidden_size=1280,
+        intermediate_size=5120,
+        num_layers=32,
+        num_heads=20,
+        out_dim=4096,
+    )
+)
+
+
+def audio_out_tokens(mel_frames: int) -> int:
+    """Tokens the tower emits for a padded mel length (the service tier
+    sizes placeholder spans with this — keep in lockstep with
+    AudioConfig.out_tokens)."""
+    return ((mel_frames + 1) // 2) // 2
+
+
+def init_audio_params(
+    cfg: AudioConfig, key: jax.Array, dtype=jnp.float32
+) -> Params:
+    D, M, F = cfg.hidden_size, cfg.num_mel_bins, cfg.intermediate_size
+    L = cfg.num_layers
+    keys = jax.random.split(key, 12)
+
+    def w(k, shape, fan_in):
+        return (
+            jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan_in)
+        ).astype(dtype)
+
+    def zeros(shape):
+        return jnp.zeros(shape, dtype)
+
+    layers = {
+        "ln1_w": jnp.ones((L, D), jnp.float32),
+        "ln1_b": jnp.zeros((L, D), jnp.float32),
+        "wq": w(keys[0], (L, D, D), D), "bq": zeros((L, D)),
+        "wk": w(keys[1], (L, D, D), D),  # Whisper: k_proj has NO bias
+        "wv": w(keys[2], (L, D, D), D), "bv": zeros((L, D)),
+        "wo": w(keys[3], (L, D, D), D), "bo": zeros((L, D)),
+        "ln2_w": jnp.ones((L, D), jnp.float32),
+        "ln2_b": jnp.zeros((L, D), jnp.float32),
+        "fc1": w(keys[4], (L, D, F), D), "b1": zeros((L, F)),
+        "fc2": w(keys[5], (L, F, D), F), "b2": zeros((L, D)),
+    }
+    return {
+        # Conv kernels stored [k, in, out] for the unfolded einsum.
+        "conv1_w": w(keys[6], (3, M, D), 3 * M),
+        "conv1_b": zeros((D,)),
+        "conv2_w": w(keys[7], (3, D, D), 3 * D),
+        "conv2_b": zeros((D,)),
+        "pos_embed": w(keys[8], (cfg.conv_frames, D), D),
+        "layers": layers,
+        "ln_post_w": jnp.ones((D,), jnp.float32),
+        "ln_post_b": jnp.zeros((D,), jnp.float32),
+        "proj": w(keys[9], (D, cfg.out_dim), D),
+        "proj_b": zeros((cfg.out_dim,)),
+    }
+
+
+def _conv1d(x: jnp.ndarray, w: jnp.ndarray, b: jnp.ndarray,
+            stride: int) -> jnp.ndarray:
+    """[B, T, C_in] x [k=3, C_in, C_out] -> [B, T_out, C_out], padding 1
+    — an unfold + einsum so XLA sees one MXU matmul per output frame
+    block instead of a scalar conv loop."""
+    B, T, Ci = x.shape
+    xp = jnp.pad(x, ((0, 0), (1, 1), (0, 0)))
+    starts = jnp.arange(0, T, stride)
+    # windows [B, T_out, 3, Ci]
+    win = jnp.stack([xp[:, s: s + T: 1][:, starts] for s in range(3)],
+                    axis=2)
+    return jnp.einsum("btkc,kcd->btd", win, w) + b
+
+
+def encode_audio(
+    params: Params, cfg: AudioConfig, mel: jnp.ndarray
+) -> jnp.ndarray:
+    """[B, M, T] log-mel (T == cfg.mel_frames) -> [B, out_tokens,
+    out_dim] LM-ready media tokens."""
+    B = mel.shape[0]
+    assert mel.shape[1:] == (cfg.num_mel_bins, cfg.mel_frames), mel.shape
+    H, D = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+    x = mel.astype(params["conv1_w"].dtype).transpose(0, 2, 1)  # [B,T,M]
+    x = jax.nn.gelu(
+        _conv1d(x, params["conv1_w"], params["conv1_b"], 1),
+        approximate=False,
+    )
+    x = jax.nn.gelu(
+        _conv1d(x, params["conv2_w"], params["conv2_b"], 2),
+        approximate=False,
+    )
+    x = x + params["pos_embed"][None]
+    N = x.shape[1]
+
+    def layer_fn(x, lp):
+        h = layer_norm(x, lp["ln1_w"], lp["ln1_b"], cfg.ln_eps)
+        q = (jnp.einsum("bne,ef->bnf", h, lp["wq"]) + lp["bq"]) * (
+            D**-0.5
+        )
+        k = jnp.einsum("bne,ef->bnf", h, lp["wk"])  # bias-free (Whisper)
+        v = jnp.einsum("bne,ef->bnf", h, lp["wv"]) + lp["bv"]
+        q = q.reshape(B, N, H, D).astype(jnp.float32)
+        k = k.reshape(B, N, H, D).astype(jnp.float32)
+        v = v.reshape(B, N, H, D).astype(jnp.float32)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        attn = attn.reshape(B, N, -1).astype(x.dtype)
+        x = x + jnp.einsum("bne,ef->bnf", attn, lp["wo"]) + lp["bo"]
+        h = layer_norm(x, lp["ln2_w"], lp["ln2_b"], cfg.ln_eps)
+        h = jax.nn.gelu(
+            jnp.einsum("bne,ef->bnf", h, lp["fc1"]) + lp["b1"],
+            approximate=False,
+        )
+        x = x + jnp.einsum("bnf,fe->bne", h, lp["fc2"]) + lp["b2"]
+        return x, None
+
+    x, _ = jax.lax.scan(layer_fn, x, params["layers"])
+    # avg_pool1d(2, stride 2) over the position axis, then final LN.
+    x = x[:, : (N // 2) * 2].reshape(B, N // 2, 2, -1).mean(axis=2)
+    x = layer_norm(x, params["ln_post_w"], params["ln_post_b"], cfg.ln_eps)
+    return (
+        jnp.einsum("bne,ed->bnd", x, params["proj"]) + params["proj_b"]
+    )

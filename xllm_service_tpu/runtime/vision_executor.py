@@ -27,32 +27,41 @@ from xllm_service_tpu.common.types import (
 from xllm_service_tpu.models import vision
 
 
+def _load_or_init_tower(kind: str, model: str, dtype: str,
+                        init_seed: int, checkpoint_path: str,
+                        loader, get_config, init_params):
+    """Shared load-or-init for encoder towers: a set-but-broken
+    checkpoint path fails LOUDLY (same contract as the LM executor),
+    never silently serving random-init embeddings. Returns
+    (jnp_dtype, cfg, params)."""
+    import os
+
+    jdtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    if checkpoint_path:
+        if not os.path.exists(
+            os.path.join(checkpoint_path, "config.json")
+        ):
+            raise FileNotFoundError(
+                f"{kind} checkpoint dir {checkpoint_path!r} has no "
+                f"config.json"
+            )
+        cfg, params = loader(checkpoint_path, dtype=jdtype)
+    else:
+        cfg = get_config(model)
+        params = init_params(cfg, jax.random.key(init_seed), jdtype)
+    return jdtype, cfg, params
+
+
 class VisionExecutor:
     def __init__(self, model: str = "vit-tiny", dtype: str = "float32",
                  init_seed: int = 0, checkpoint_path: str = ""):
-        import os
+        from xllm_service_tpu.runtime.weights import load_vision_checkpoint
 
-        self.dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
-        if checkpoint_path:
-            # Real HF vision tower (SigLIP layout) — weights and
-            # architecture come from the checkpoint dir. A set-but-broken
-            # path fails LOUDLY (same contract as the LM executor), never
-            # silently serving random-init embeddings.
-            if not os.path.exists(os.path.join(checkpoint_path, "config.json")):
-                raise FileNotFoundError(
-                    f"vision checkpoint dir {checkpoint_path!r} has no "
-                    f"config.json"
-                )
-            from xllm_service_tpu.runtime.weights import load_vision_checkpoint
-
-            self.cfg, self.params = load_vision_checkpoint(
-                checkpoint_path, dtype=self.dtype
-            )
-        else:
-            self.cfg = vision.get_vision_config(model)
-            self.params = vision.init_vision_params(
-                self.cfg, jax.random.key(init_seed), self.dtype
-            )
+        self.dtype, self.cfg, self.params = _load_or_init_tower(
+            "vision", model, dtype, init_seed, checkpoint_path,
+            load_vision_checkpoint, vision.get_vision_config,
+            vision.init_vision_params,
+        )
         self._jit = jax.jit(
             lambda p, imgs: vision.encode_images(p, self.cfg, imgs)
         )
@@ -97,16 +106,79 @@ class VisionExecutor:
         return np.asarray(out[: want_slices * per_slice], np.float32)
 
 
+class AudioExecutor:
+    """EPD stage E, audio modality: the Qwen2-Audio tower
+    (models/audio.py) behind the same jit-once discipline as the vision
+    towers. Input is the service tier's log-mel features
+    (service/audio_processor.py); output is LM-ready media tokens."""
+
+    def __init__(self, model: str = "audio-tiny", dtype: str = "float32",
+                 init_seed: int = 0, checkpoint_path: str = ""):
+        from xllm_service_tpu.models import audio as audio_mod
+        from xllm_service_tpu.runtime.weights import load_audio_checkpoint
+
+        self.dtype, self.cfg, self.params = _load_or_init_tower(
+            "audio", model, dtype, init_seed, checkpoint_path,
+            load_audio_checkpoint, audio_mod.get_audio_config,
+            audio_mod.init_audio_params,
+        )
+        self._jit = jax.jit(
+            lambda p, mel: audio_mod.encode_audio(p, self.cfg, mel)
+        )
+
+    def encode_audio(self, mel: np.ndarray) -> np.ndarray:
+        """[B, M, T] log-mel -> [B, out_tokens, out_dim]."""
+        B = mel.shape[0]
+        P = VisionExecutor._pow2(max(B, 1))
+        if P != B:
+            mel = np.concatenate(
+                [mel, np.zeros((P - B, *mel.shape[1:]), mel.dtype)]
+            )
+        out = self._jit(self.params, jnp.asarray(mel, jnp.float32))
+        return np.asarray(out[:B], np.float32)
+
+
+def _is_audio_model(model: str, checkpoint_path: str) -> bool:
+    """An ENCODE instance hosts ONE modality: audio iff the model names
+    a registered AudioConfig or the checkpoint carries audio_config."""
+    import json
+    import os
+
+    from xllm_service_tpu.models import audio as audio_mod
+
+    if checkpoint_path:
+        cfg_path = os.path.join(checkpoint_path, "config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                return "audio_config" in json.load(f)
+    try:
+        audio_mod.get_audio_config(model)
+        return True
+    except KeyError:
+        return False
+
+
 class EncoderEngine:
     """Engine-interface adapter so InstanceServer can host an ENCODE role:
-    start/stop, heartbeat metric sources, and the encode entry point."""
+    start/stop, heartbeat metric sources, and the encode entry points.
+    Hosts ONE modality executor — vision (image + qwen2vl video) or
+    audio — chosen by the model name / checkpoint config."""
 
     def __init__(self, executor: Optional[VisionExecutor] = None,
                  model: str = "vit-tiny", checkpoint_path: str = "",
-                 dtype: str = "float32"):
-        self.executor = executor or VisionExecutor(
-            model, dtype=dtype, checkpoint_path=checkpoint_path
-        )
+                 dtype: str = "float32",
+                 audio_executor: Optional[AudioExecutor] = None):
+        if executor is None and audio_executor is None:
+            if _is_audio_model(model, checkpoint_path):
+                audio_executor = AudioExecutor(
+                    model, dtype=dtype, checkpoint_path=checkpoint_path
+                )
+            else:
+                executor = VisionExecutor(
+                    model, dtype=dtype, checkpoint_path=checkpoint_path
+                )
+        self.executor = executor  # vision; None on audio-only instances
+        self.audio_executor = audio_executor
         self._active = 0
         self._mu = threading.Lock()
         self._latency_window: List[Tuple[float, float]] = []
@@ -161,3 +233,6 @@ class EncoderEngine:
 
     def encode_video(self, frames: np.ndarray) -> np.ndarray:
         return self._timed(self.executor.encode_video, frames)
+
+    def encode_audio(self, mel: np.ndarray) -> np.ndarray:
+        return self._timed(self.audio_executor.encode_audio, mel)

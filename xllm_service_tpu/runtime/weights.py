@@ -1000,6 +1000,168 @@ def _load_qwen2vl_visual(path: str, cfg, dtype, np_dtype):
     return cfg, jax.tree.map(jnp.asarray, params)
 
 
+# Per-layer tensor map for the Qwen2-Audio (Whisper-layout) tower:
+# HF tail -> (stacked leaf, transpose). k_proj is bias-free (Whisper).
+_AUDIO_LAYER = {
+    "self_attn_layer_norm.weight": ("ln1_w", False),
+    "self_attn_layer_norm.bias": ("ln1_b", False),
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.q_proj.bias": ("bq", False),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.v_proj.weight": ("wv", True),
+    "self_attn.v_proj.bias": ("bv", False),
+    "self_attn.out_proj.weight": ("wo", True),
+    "self_attn.out_proj.bias": ("bo", False),
+    "final_layer_norm.weight": ("ln2_w", False),
+    "final_layer_norm.bias": ("ln2_b", False),
+    "fc1.weight": ("fc1", True),
+    "fc1.bias": ("b1", False),
+    "fc2.weight": ("fc2", True),
+    "fc2.bias": ("b2", False),
+}
+
+_AUDIO_SIMPLE = {
+    # HF name -> (leaf, transpose_spec). Conv kernels [D, C, 3] map to
+    # the unfolded-einsum layout [3, C, D].
+    "audio_tower.conv1.weight": ("conv1_w", (2, 1, 0)),
+    "audio_tower.conv1.bias": ("conv1_b", None),
+    "audio_tower.conv2.weight": ("conv2_w", (2, 1, 0)),
+    "audio_tower.conv2.bias": ("conv2_b", None),
+    "audio_tower.embed_positions.weight": ("pos_embed", None),
+    "audio_tower.layer_norm.weight": ("ln_post_w", None),
+    "audio_tower.layer_norm.bias": ("ln_post_b", None),
+    "multi_modal_projector.linear.weight": ("proj", (1, 0)),
+    "multi_modal_projector.linear.bias": ("proj_b", None),
+}
+
+
+def audio_config_from_hf(path: str, out_dim: int = 0):
+    """AudioConfig from an HF Qwen2AudioForConditionalGeneration (or
+    bare encoder) checkpoint dir: config.json `audio_config` carries the
+    Whisper geometry; the projector target comes from text_config (or
+    `out_dim`)."""
+    from xllm_service_tpu.models.audio import AudioConfig
+
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    ac = hf.get("audio_config", hf)
+    text = hf.get("text_config") or {}
+    return AudioConfig(
+        name=hf.get("model_type", "qwen2_audio") + "-audio",
+        num_mel_bins=int(ac["num_mel_bins"]),
+        mel_frames=2 * int(ac["max_source_positions"]),
+        hidden_size=int(ac["d_model"]),
+        intermediate_size=int(ac["encoder_ffn_dim"]),
+        num_layers=int(ac["encoder_layers"]),
+        num_heads=int(ac["encoder_attention_heads"]),
+        out_dim=int(
+            out_dim or text.get("hidden_size")
+            or hf.get("hidden_size") or ac["d_model"]
+        ),
+    )
+
+
+def load_audio_checkpoint(path: str, cfg=None, dtype=jnp.float32):
+    """Load the Qwen2-Audio tower + projector (`audio_tower.*`,
+    `multi_modal_projector.linear.*` — HF modeling_qwen2_audio layout)
+    into the models/audio.py pytree. Returns (AudioConfig, params);
+    missing tensors raise (no silent random-init serving)."""
+    from xllm_service_tpu.models.audio import init_audio_params
+
+    cfg = cfg or audio_config_from_hf(path)
+    np_dtype = (
+        ml_dtypes.bfloat16 if dtype == jnp.bfloat16 else np.dtype(dtype)
+    )
+    L = cfg.num_layers
+    params = jax.tree.map(
+        lambda x: np.zeros(x.shape, np_dtype),
+        jax.eval_shape(
+            lambda: init_audio_params(cfg, jax.random.key(0), dtype)
+        ),
+    )
+    needed = {k for k, _ in _AUDIO_SIMPLE.values()}
+    needed |= {f"layers.{k}" for k, _ in _AUDIO_LAYER.values()}
+    landed = set()
+    layer_seen = {
+        f"layers.{k}": np.zeros(L, bool) for k, _ in _AUDIO_LAYER.values()
+    }
+    for file in _shard_files(path):
+        for name, arr in read_safetensors(file):
+            if name in _AUDIO_SIMPLE:
+                key, perm = _AUDIO_SIMPLE[name]
+                src = np.asarray(arr)
+                if perm is not None:
+                    src = src.transpose(perm)
+                params[key] = np.ascontiguousarray(src).astype(np_dtype)
+                landed.add(key)
+            elif name.startswith("audio_tower.layers."):
+                rest = name[len("audio_tower.layers."):]
+                layer_s, _, tail = rest.partition(".")
+                if tail in _AUDIO_LAYER:
+                    key, transpose = _AUDIO_LAYER[tail]
+                    src = arr.T if transpose else arr
+                    np.copyto(
+                        params["layers"][key][int(layer_s)], src,
+                        casting="unsafe",
+                    )
+                    layer_seen[f"layers.{key}"][int(layer_s)] = True
+    for k, seen in layer_seen.items():
+        if seen.all():
+            landed.add(k)
+    missing = sorted(needed - landed)
+    if missing:
+        raise ValueError(
+            f"qwen2-audio checkpoint {path} missing tensors: {missing}"
+        )
+    return cfg, jax.tree.map(jnp.asarray, params)
+
+
+def save_qwen2audio_tower(params, cfg, path: str) -> None:
+    """Inverse of load_audio_checkpoint (HF Qwen2-Audio layout) — CI
+    round-trips and synthetic-tower export."""
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(
+            {
+                "model_type": "qwen2_audio",
+                "audio_config": {
+                    "model_type": "qwen2_audio_encoder",
+                    "num_mel_bins": cfg.num_mel_bins,
+                    "d_model": cfg.hidden_size,
+                    "encoder_layers": cfg.num_layers,
+                    "encoder_attention_heads": cfg.num_heads,
+                    "encoder_ffn_dim": cfg.intermediate_size,
+                    "max_source_positions": cfg.conv_frames,
+                },
+                "text_config": {"hidden_size": cfg.out_dim},
+            },
+            f,
+        )
+
+    def host(x) -> np.ndarray:
+        a = np.asarray(x)
+        return (
+            a.astype(ml_dtypes.bfloat16)
+            if a.dtype == ml_dtypes.bfloat16 else a
+        )
+
+    tensors: Dict[str, np.ndarray] = {}
+    for name, (key, perm) in _AUDIO_SIMPLE.items():
+        src = host(params[key])
+        if perm is not None:
+            inv = np.argsort(perm)
+            src = np.ascontiguousarray(src.transpose(tuple(inv)))
+        tensors[name] = src
+    lp = params["layers"]
+    for i in range(cfg.num_layers):
+        for tail, (key, transpose) in _AUDIO_LAYER.items():
+            t = host(lp[key])[i]
+            tensors[f"audio_tower.layers.{i}.{tail}"] = (
+                np.ascontiguousarray(t.T if transpose else t)
+            )
+    write_safetensors(os.path.join(path, "model.safetensors"), tensors)
+
+
 def save_qwen2vl_visual(params, cfg, path: str) -> None:
     """Inverse of the qwen2vl branch of load_vision_checkpoint (HF
     Qwen2-VL `visual.*` layout) — round-trip tested; exports synthetic

@@ -1,0 +1,157 @@
+"""Audio front door: WAV decode + Whisper-parity log-mel features.
+
+The reference's message model carries `audio_url` parts verbatim
+(jinja_chat_template.h:30-47); this turns them into the fixed-geometry
+[num_mel_bins, mel_frames] float32 features the Qwen2-Audio tower
+(models/audio.py) compiles for.
+
+The mel pipeline replicates HF's WhisperFeatureExtractor numpy path
+exactly (parity-tested): periodic Hann window 400, hop 160, centered
+STFT with reflect padding, power spectrum, slaney-scale/slaney-norm mel
+filterbank over 0..8 kHz at 16 kHz, log10 clamped at 1e-10, dynamic
+floor at (max - 8), then (x + 4) / 4. Everything is stdlib + numpy —
+`wave` for PCM decode, `np.fft.rfft` for the STFT.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import re
+import wave
+from typing import Optional, Tuple
+
+import numpy as np
+
+SAMPLE_RATE = 16000
+N_FFT = 400
+HOP = 160
+
+_AUDIO_DATA_RE = re.compile(
+    r"data:audio/(wav|x-wav|wave);base64,(.*)", re.S
+)
+
+
+def is_audio_data_url(url: str) -> bool:
+    return bool(_AUDIO_DATA_RE.match(url or ""))
+
+
+def decode_audio_url(url: str) -> Optional[np.ndarray]:
+    """`data:audio/wav;base64` -> mono float32 waveform at 16 kHz, or
+    None when the URL is not an audio data URL."""
+    m = _AUDIO_DATA_RE.match(url or "")
+    if not m:
+        return None
+    try:
+        raw = base64.b64decode(m.group(2))
+    except Exception as e:
+        raise ValueError(f"bad base64 audio payload: {e}") from e
+    wav, rate = decode_wav_bytes(raw)
+    return resample_linear(wav, rate, SAMPLE_RATE)
+
+
+def decode_wav_bytes(raw: bytes) -> Tuple[np.ndarray, int]:
+    """PCM WAV bytes -> (mono float32 in [-1, 1], sample_rate)."""
+    try:
+        with wave.open(io.BytesIO(raw)) as w:
+            rate = w.getframerate()
+            n_ch = w.getnchannels()
+            width = w.getsampwidth()
+            data = w.readframes(w.getnframes())
+    except Exception as e:
+        raise ValueError(f"undecodable WAV payload: {e}") from e
+    if width == 2:
+        x = np.frombuffer(data, np.int16).astype(np.float32) / 32768.0
+    elif width == 4:
+        x = np.frombuffer(data, np.int32).astype(np.float32) / 2147483648.0
+    elif width == 1:  # unsigned 8-bit PCM
+        x = (np.frombuffer(data, np.uint8).astype(np.float32) - 128.0) / 128.0
+    else:
+        raise ValueError(f"unsupported WAV sample width {width}")
+    if n_ch > 1:
+        x = x.reshape(-1, n_ch).mean(axis=1)
+    return x, rate
+
+
+def resample_linear(x: np.ndarray, src: int, dst: int) -> np.ndarray:
+    """Linear-interpolation resample (front-door tolerance for non-16k
+    uploads; 16 kHz input passes through untouched)."""
+    if src == dst:
+        return np.asarray(x, np.float32)
+    n_out = int(round(len(x) * dst / src))
+    pos = np.linspace(0.0, len(x) - 1.0, n_out)
+    return np.interp(pos, np.arange(len(x)), x).astype(np.float32)
+
+
+def _hz_to_mel_slaney(hz):
+    hz = np.asarray(hz, np.float64)
+    mel = 3.0 * hz / 200.0
+    log_region = hz >= 1000.0
+    logstep = np.log(6.4) / 27.0
+    mel = np.where(
+        log_region, 15.0 + np.log(np.maximum(hz, 1e-10) / 1000.0) / logstep,
+        mel,
+    )
+    return mel
+
+
+def _mel_to_hz_slaney(mel):
+    mel = np.asarray(mel, np.float64)
+    hz = 200.0 * mel / 3.0
+    logstep = np.log(6.4) / 27.0
+    return np.where(
+        mel >= 15.0, 1000.0 * np.exp(logstep * (mel - 15.0)), hz
+    )
+
+
+def mel_filter_bank(
+    num_mel: int, n_fft: int = N_FFT, rate: int = SAMPLE_RATE,
+    fmin: float = 0.0, fmax: float = 8000.0,
+) -> np.ndarray:
+    """[n_fft//2 + 1, num_mel] slaney-scale, slaney-normalized
+    triangular filters (HF audio_utils.mel_filter_bank semantics)."""
+    n_bins = n_fft // 2 + 1
+    fft_freqs = np.linspace(0.0, rate / 2.0, n_bins)
+    mel_pts = np.linspace(
+        _hz_to_mel_slaney(fmin), _hz_to_mel_slaney(fmax), num_mel + 2
+    )
+    hz_pts = _mel_to_hz_slaney(mel_pts)
+    fdiff = np.diff(hz_pts)
+    slopes = hz_pts[None, :] - fft_freqs[:, None]  # [bins, mel+2]
+    down = -slopes[:, :-2] / fdiff[:-1]
+    up = slopes[:, 2:] / fdiff[1:]
+    fb = np.maximum(0.0, np.minimum(down, up))
+    # slaney norm: constant energy per filter
+    fb *= (2.0 / (hz_pts[2:] - hz_pts[:-2]))[None, :]
+    return fb.astype(np.float64)
+
+
+def log_mel(
+    waveform: np.ndarray, num_mel_bins: int, mel_frames: int
+) -> np.ndarray:
+    """Mono 16 kHz float32 -> [num_mel_bins, mel_frames] float32 —
+    HF WhisperFeatureExtractor numpy semantics: the waveform pads with
+    zeros (or truncates) to mel_frames * hop samples, centered STFT with
+    reflect padding, and the final frame is dropped (the extractor's
+    `log_spec[:, :-1]`)."""
+    n_samples = mel_frames * HOP
+    x = np.zeros(n_samples, np.float64)
+    x[: min(len(waveform), n_samples)] = waveform[:n_samples]
+    pad = N_FFT // 2
+    x = np.pad(x, (pad, pad), mode="reflect")
+    # periodic Hann (HF window_function(400, "hann"))
+    window = 0.5 * (
+        1.0 - np.cos(2.0 * np.pi * np.arange(N_FFT) / N_FFT)
+    )
+    n_frames = 1 + (len(x) - N_FFT) // HOP
+    idx = (
+        np.arange(N_FFT)[None, :]
+        + HOP * np.arange(n_frames)[:, None]
+    )
+    frames = x[idx] * window[None, :]
+    power = np.abs(np.fft.rfft(frames, N_FFT, axis=1)) ** 2  # [F, bins]
+    mel = power @ mel_filter_bank(num_mel_bins)  # [F, M]
+    log_spec = np.log10(np.maximum(mel, 1e-10)).T  # [M, F]
+    log_spec = log_spec[:, :-1][:, :mel_frames]
+    log_spec = np.maximum(log_spec, log_spec.max() - 8.0)
+    return ((log_spec + 4.0) / 4.0).astype(np.float32)

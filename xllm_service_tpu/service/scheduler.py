@@ -274,11 +274,19 @@ class Scheduler:
             return Status(StatusCode.UNAVAILABLE, "no instances registered")
         if request.media_parts:
             # Three-stage EPD routing: the encoder runs before prefill.
-            request.routing.encode_name = self._instance_mgr.next_encode_instance()
+            # Route by MODALITY — encoders host one tower each.
+            required = {
+                {2: "audio", 4: "video"}.get(len(p["shape"]), "image")
+                for p in request.media_parts
+            }
+            request.routing.encode_name = (
+                self._instance_mgr.next_encode_instance(required)
+            )
             if not request.routing.encode_name:
                 return Status(
                     StatusCode.UNAVAILABLE,
-                    "media request but no ENCODE instance registered",
+                    f"media request needs an ENCODE instance serving "
+                    f"{sorted(required)}; none registered covers it",
                 )
         pred = self._instance_mgr.get_time_predictor(request.routing.prefill_name)
         if pred is not None and pred.has_ttft_model:
@@ -298,6 +306,11 @@ class Scheduler:
     _MM_DATA4_RE = re.compile(
         r"data:application/x-raw-f32;shape=(\d+)x(\d+)x(\d+)x(\d+);"
         r"base64,(.*)",
+        re.S,
+    )
+    # Audio tensor backdoor: num_mel_bins x mel_frames log-mel features.
+    _MM_DATA2_RE = re.compile(
+        r"data:application/x-raw-f32;shape=(\d+)x(\d+);base64,(.*)",
         re.S,
     )
 
@@ -395,14 +408,60 @@ class Scheduler:
                     "shape": [T] + [int(m4.group(i)) for i in (2, 3, 4)],
                     "data": m4.group(5),
                 }, None
+        if p.type in ("audio", "audio_url"):
+            from xllm_service_tpu.service import audio_processor as _ap
+
+            frames_cfg = self._config.mm_audio_mel_frames
+            if _ap.is_audio_data_url(url):
+                if not frames_cfg:
+                    return None, Status(
+                        StatusCode.INVALID_ARGUMENT,
+                        "real-audio ingestion is not enabled (set "
+                        "mm_audio_mel_frames/mm_audio_mel_bins to the "
+                        "ENCODE audio tower's geometry)",
+                    )
+                try:
+                    wav = _ap.decode_audio_url(url)
+                except ValueError as e:
+                    return None, Status(
+                        StatusCode.INVALID_ARGUMENT, str(e)
+                    )
+                mel = _ap.log_mel(
+                    wav, self._config.mm_audio_mel_bins, frames_cfg
+                )
+                return {
+                    "type": p.type,
+                    "shape": list(mel.shape),
+                    "data": _b64.b64encode(
+                        np.ascontiguousarray(mel).tobytes()
+                    ).decode(),
+                }, None
+            m2 = self._MM_DATA2_RE.match(url)
+            if m2:
+                return {
+                    "type": p.type,
+                    "shape": [int(m2.group(1)), int(m2.group(2))],
+                    "data": m2.group(3),
+                }, None
+            # NO fallthrough to the image/video tensor regexes: an
+            # audio-typed part with a 3D tensor would otherwise be
+            # silently ingested as an image, binding wrong embeddings to
+            # the audio marker (review finding, r5).
+            return None, Status(
+                StatusCode.INVALID_ARGUMENT,
+                f"unsupported media URL for {p.type}: expected "
+                "data:audio/wav;base64 or a "
+                "data:application/x-raw-f32;shape=MxT;base64 log-mel "
+                "tensor",
+            )
         m = self._MM_DATA_RE.match(url)
         if not m:
             return None, Status(
                 StatusCode.INVALID_ARGUMENT,
                 f"unsupported media URL for {p.type}: expected a "
-                "data:image/...;base64 image, a "
-                "data:application/x-raw-f32;shape=HxWxC;base64 tensor, or "
-                "(video) a ...shape=TxHxWxC tensor",
+                "data:image/...;base64 image, data:audio/wav;base64, a "
+                "data:application/x-raw-f32;shape=HxWxC;base64 tensor, "
+                "(video) ...shape=TxHxWxC, or (audio) ...shape=MxT",
             )
         return {
             "type": p.type,
@@ -460,9 +519,18 @@ class Scheduler:
         emit_grids = s * s == k
         counts, grids = [], []
         for part in media_parts:
-            slices = (
-                part["shape"][0] // tps if len(part["shape"]) == 4 else 1
-            )
+            shape = part["shape"]
+            if len(shape) == 2:
+                # Audio: tokens are the Whisper conv+pool geometry of
+                # the mel length (models/audio.audio_out_tokens); the
+                # M-RoPE grid is sequential (t=1, h=1, w=n).
+                from xllm_service_tpu.models.audio import audio_out_tokens
+
+                n = audio_out_tokens(shape[1])
+                counts.append(n)
+                grids.append([1, 1, n])
+                continue
+            slices = shape[0] // tps if len(shape) == 4 else 1
             counts.append(k * slices)
             grids.append([slices, s, s])
         token_ids: List[int] = []
