@@ -173,6 +173,134 @@ def test_audio_checkpoint_save_load_roundtrip(tmp_path):
     )
 
 
+def test_audio_full_model_greedy_parity_with_hf(tmp_path):
+    """Tiny HF Qwen2AudioForConditionalGeneration vs our engine on the
+    SAME weights and waveform: our mel features + our tower's embeddings
+    injected at the audio placeholders, greedy continuations equal HF
+    token-for-token through the paged decode path."""
+    torch = pytest.importorskip("torch")
+    try:
+        from transformers import (
+            Qwen2AudioConfig,
+            Qwen2AudioForConditionalGeneration,
+        )
+    except Exception:
+        pytest.skip("transformers lacks Qwen2Audio")
+
+    from xllm_service_tpu.common.config import EngineConfig
+    from xllm_service_tpu.ops.sampling import SamplingParams
+    from xllm_service_tpu.runtime import weights as W
+    from xllm_service_tpu.runtime.engine import (
+        EngineRequest, InferenceEngine,
+    )
+    from xllm_service_tpu.runtime.executor import ModelExecutor
+
+    cfg = Qwen2AudioConfig(
+        audio_config=dict(
+            num_mel_bins=16, d_model=64, encoder_layers=2,
+            encoder_attention_heads=4, encoder_ffn_dim=128,
+            max_source_positions=20,
+        ),
+        text_config=dict(
+            model_type="qwen2", vocab_size=512, hidden_size=128,
+            intermediate_size=256, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=512, rope_theta=10000.0,
+            rms_norm_eps=1e-6,
+        ),
+        audio_token_index=7,
+    )
+    torch.manual_seed(11)
+    with torch.no_grad():
+        hf = Qwen2AudioForConditionalGeneration(cfg).eval().float()
+
+    # Audio tower + projector in their own checkpoint dir.
+    adir = str(tmp_path / "audio")
+    _os.makedirs(adir, exist_ok=True)
+    W.write_safetensors(
+        _os.path.join(adir, "model.safetensors"),
+        {n: p.detach().numpy() for n, p in hf.named_parameters()
+         if n.startswith(("audio_tower.", "multi_modal_projector."))},
+    )
+    with open(_os.path.join(adir, "config.json"), "w") as f:
+        _json.dump({
+            "model_type": "qwen2_audio",
+            "audio_config": {
+                "num_mel_bins": 16, "d_model": 64, "encoder_layers": 2,
+                "encoder_attention_heads": 4, "encoder_ffn_dim": 128,
+                "max_source_positions": 20,
+            },
+            "text_config": {"hidden_size": 128},
+        }, f)
+    lacfg, aparams = W.load_audio_checkpoint(adir, dtype=jnp.float32)
+
+    # Text stack renamed to the plain Qwen2 layout.
+    ldir = str(tmp_path / "lm")
+    _os.makedirs(ldir, exist_ok=True)
+    lt = {}
+    for n, p in hf.named_parameters():
+        if n.startswith("language_model.model."):
+            lt["model." + n[len("language_model.model."):]] = (
+                p.detach().numpy()
+            )
+        elif n == "language_model.lm_head.weight":
+            lt["lm_head.weight"] = p.detach().numpy()
+    W.write_safetensors(_os.path.join(ldir, "model.safetensors"), lt)
+    with open(_os.path.join(ldir, "config.json"), "w") as f:
+        _json.dump({
+            "architectures": ["Qwen2ForCausalLM"], "model_type": "qwen2",
+            "vocab_size": 512, "hidden_size": 128,
+            "intermediate_size": 256, "num_hidden_layers": 2,
+            "num_attention_heads": 4, "num_key_value_heads": 2,
+            "max_position_embeddings": 512, "rope_theta": 10000.0,
+            "rms_norm_eps": 1e-6, "tie_word_embeddings": False,
+        }, f)
+
+    wav = (np.sin(np.linspace(0, 440 * np.pi, 6400)) * 0.3).astype(
+        np.float32
+    )
+    mel = ap.log_mel(wav, 16, 40)
+    embeds = np.asarray(
+        A.encode_audio(aparams, lacfg, jnp.asarray(mel[None]))
+    )[0]  # [10, 128]
+
+    prompt = [10, 20] + [7] * 10 + [30]
+    ids = torch.tensor([prompt])
+    with torch.no_grad():
+        out = hf.generate(
+            input_ids=ids,
+            input_features=torch.from_numpy(mel[None]),
+            feature_attention_mask=torch.ones(1, 40, dtype=torch.long),
+            attention_mask=torch.ones_like(ids),
+            max_new_tokens=6, do_sample=False,
+        )
+    want = out[0, len(prompt):].tolist()
+
+    ecfg = EngineConfig(
+        model="q2a-lm", dtype="float32", checkpoint_path=ldir,
+        block_size=16, num_blocks=32, max_running_requests=2,
+        max_seq_len=128, prefill_buckets=[16, 32],
+    )
+    eng = InferenceEngine(ecfg, executor=ModelExecutor(ecfg))
+    got = []
+
+    def cb(o):
+        for s in o.outputs:
+            got.extend(s.token_ids)
+        return True
+
+    eng.add_request(EngineRequest(
+        "qa", prompt,
+        SamplingParams(temperature=0.0, max_new_tokens=6), cb,
+        mm_embeds=embeds, mm_positions=list(range(2, 12)),
+    ))
+    for _ in range(60):
+        if not eng.has_work():
+            break
+        eng.step()
+    assert got == want, (got, want)
+
+
 def test_wav_through_full_epd_http_path(tmp_path):
     """An ACTUAL WAV clip through /v1/chat/completions -> scheduler
     (log-mel + per-clip placeholder count) -> audio ENCODE instance ->

@@ -128,6 +128,88 @@ def test_video_tower_matches_hf(tmp_path):
     np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
 
 
+def test_qwen25vl_video_tower_matches_hf(tmp_path):
+    """encode_video on the Qwen2.5-VL tower (per-slice WINDOW attention
+    + full-attention layers) vs HF Qwen2_5_VisionTransformer with video
+    grid_thw — HF computes window indices and cu_seqlens per temporal
+    slice, which is exactly the per-slice batch axis here."""
+    torch = pytest.importorskip("torch")
+    try:
+        from transformers.models.qwen2_5_vl.configuration_qwen2_5_vl import (
+            Qwen2_5_VLVisionConfig,
+        )
+        from transformers.models.qwen2_5_vl.modeling_qwen2_5_vl import (
+            Qwen2_5_VisionTransformerPretrainedModel,
+        )
+    except Exception:
+        pytest.skip("transformers lacks Qwen2.5-VL")
+
+    from xllm_service_tpu.models import vision
+    from xllm_service_tpu.runtime import weights as W
+
+    cfg = vision.get_vision_config("qwen25vl-tiny")
+    hf_cfg = Qwen2_5_VLVisionConfig(
+        depth=cfg.num_layers, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_heads=cfg.num_heads, patch_size=cfg.patch_size,
+        spatial_merge_size=cfg.spatial_merge_size,
+        temporal_patch_size=cfg.temporal_patch_size,
+        window_size=cfg.window_size,
+        fullatt_block_indexes=list(cfg.fullatt_block_indexes),
+        out_hidden_size=cfg.out_dim,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(7)
+    with torch.no_grad():
+        hf = (
+            Qwen2_5_VisionTransformerPretrainedModel(hf_cfg)
+            .eval().float()
+        )
+    ckpt = str(tmp_path / "q25v")
+    _os.makedirs(ckpt, exist_ok=True)
+    W.write_safetensors(
+        _os.path.join(ckpt, "model.safetensors"),
+        {"visual." + n: p.detach().numpy()
+         for n, p in hf.named_parameters()},
+    )
+    with open(_os.path.join(ckpt, "config.json"), "w") as f:
+        _json.dump({"model_type": "qwen2_5_vl", "vision_config": {
+            "model_type": "qwen2_5_vl",
+            "hidden_size": cfg.hidden_size,
+            "intermediate_size": cfg.intermediate_size,
+            "out_hidden_size": cfg.out_dim,
+            "depth": cfg.num_layers, "num_heads": cfg.num_heads,
+            "patch_size": cfg.patch_size, "image_size": cfg.image_size,
+            "spatial_merge_size": cfg.spatial_merge_size,
+            "temporal_patch_size": cfg.temporal_patch_size,
+            "window_size": cfg.window_size,
+            "fullatt_block_indexes": list(cfg.fullatt_block_indexes),
+        }}, f)
+    lcfg, params = W.load_vision_checkpoint(ckpt, dtype=jnp.float32)
+    assert lcfg.arch == "qwen25vl"
+
+    T = 4  # 2 temporal slices
+    rng = np.random.default_rng(13)
+    frames = rng.random(
+        (T, cfg.image_size, cfg.image_size, 3)
+    ).astype(np.float32)
+    rows, _, _ = vision._qwen2vl_video_rows(jnp.asarray(frames), lcfg)
+    G, g = T // 2, cfg.image_size // cfg.patch_size
+    flat = np.ascontiguousarray(
+        np.asarray(rows, np.float32).reshape(G * g * g, -1)
+    )
+    with torch.no_grad():
+        want = hf(
+            torch.from_numpy(flat), grid_thw=torch.tensor([[G, g, g]])
+        ).numpy()
+    got = np.asarray(
+        vision.encode_video(params, lcfg, jnp.asarray(frames)),
+        np.float32,
+    )
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, atol=3e-5, rtol=3e-5)
+
+
 def test_video_positions_match_hf_get_rope_index():
     """Engine M-RoPE streams for a VIDEO span (mm_grids declared) equal
     HF get_rope_index with video_grid_thw, rope_delta included."""

@@ -151,8 +151,8 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
             vis = getattr(self.engine, "executor", None)
             if vis is not None:
                 mods.append("image")
-                if getattr(getattr(vis, "cfg", None), "arch", "") == (
-                    "qwen2vl"
+                if getattr(getattr(vis, "cfg", None), "arch", "") in (
+                    "qwen2vl", "qwen25vl"
                 ):
                     mods.append("video")
             if getattr(self.engine, "audio_executor", None) is not None:

@@ -123,7 +123,8 @@ class MultimodalMixin:
                     return
                 if is_video and (
                     not hasattr(self.engine, "encode_video")
-                    or getattr(vcfg, "arch", "") != "qwen2vl"
+                    or getattr(vcfg, "arch", "")
+                    not in ("qwen2vl", "qwen25vl")
                 ):
                     # Checked HERE, not at jit-trace time inside the
                     # encode call — a raise escaping the handler tears
@@ -132,7 +133,8 @@ class MultimodalMixin:
                     h.send_error_json(
                         501,
                         f"this encoder ({getattr(vcfg, 'arch', '?')}) "
-                        "has no video path (qwen2vl towers only)",
+                        "has no video path (qwen2vl/qwen25vl towers "
+                        "only)",
                     )
                     return
                 kind = "video" if is_video else "img"

@@ -500,21 +500,22 @@ def encode_video(
     """[T, S, S, 3] video frames -> media tokens [G * tokens_per_slice,
     out_dim], G = T // temporal_patch_size.
 
-    HF Qwen2VisionTransformer attends PER temporal slice (cu_seqlens
-    repeats grid_h*grid_w per grid_t), so the group axis rides the
-    shared encoder body's batch dimension — each slice is an independent
+    Both Qwen-VL towers attend PER temporal slice (HF cu_seqlens
+    repeats grid_h*grid_w per grid_t; Qwen2.5-VL additionally computes
+    its window indices per slice), so the group axis rides the shared
+    encoder body's batch dimension — each slice is an independent
     attention span with the same (h, w) rotary tables, exactly the HF
-    semantics. Qwen2.5-VL's windowed tower is not wired for video yet
-    and rejects loudly."""
-    if cfg.arch != "qwen2vl":
+    semantics."""
+    if cfg.arch not in ("qwen2vl", "qwen25vl"):
         raise NotImplementedError(
-            f"video encoding is implemented for the qwen2vl tower only "
-            f"(got arch {cfg.arch!r})"
+            f"video encoding is implemented for the qwen2vl/qwen25vl "
+            f"towers only (got arch {cfg.arch!r})"
         )
     rows, h_ids, w_ids = _qwen2vl_video_rows(
         frames.astype(params["patch_embed"].dtype), cfg
     )
-    out = _qwen2vl_body(params, cfg, rows, h_ids, w_ids)  # [G, n, D]
+    body = _qwen25vl_body if cfg.arch == "qwen25vl" else _qwen2vl_body
+    out = body(params, cfg, rows, h_ids, w_ids)  # [G, n, D]
     return out.reshape(-1, out.shape[-1])
 
 
@@ -598,6 +599,15 @@ def _qwen25_window_perm(cfg: VisionConfig):
 def _encode_qwen25vl(
     params: Params, cfg: VisionConfig, images: jnp.ndarray
 ) -> jnp.ndarray:
+    rows, h_ids, w_ids = _qwen2vl_patch_rows(
+        images.astype(params["patch_embed"].dtype), cfg
+    )
+    return _qwen25vl_body(params, cfg, rows, h_ids, w_ids)
+
+
+def _qwen25vl_body(
+    params: Params, cfg: VisionConfig, rows: jnp.ndarray, h_ids, w_ids
+) -> jnp.ndarray:
     """HF Qwen2_5_VisionTransformer: the qwen2vl patch pipeline with
     RMSNorm blocks, gated-SiLU MLP (biased), and WINDOW attention —
     hidden states permute into window order at merge-unit granularity,
@@ -605,15 +615,15 @@ def _encode_qwen25vl(
     in fullatt_block_indexes attend globally, and the merger output
     permutes back. One scanned block body (lax.cond picks the attention
     scope per layer — a 32-deep python unroll would inflate the traced
-    HLO 32x). Reference: transformers modeling_qwen2_5_vl.py."""
+    HLO 32x). Still images ride the batch axis; VIDEO temporal slices
+    do too (HF computes window indices AND full-attention cu_seqlens
+    per slice, so per-slice batching is exactly its semantics).
+    Reference: transformers modeling_qwen2_5_vl.py."""
     import numpy as _np
 
-    B = images.shape[0]
+    B = rows.shape[0]
     H, D = cfg.num_heads, cfg.hidden_size // cfg.num_heads
     m2 = cfg.spatial_merge_size**2
-    rows, h_ids, w_ids = _qwen2vl_patch_rows(
-        images.astype(params["patch_embed"].dtype), cfg
-    )
     x = jnp.einsum("bnp,pe->bne", rows, params["patch_embed"])  # [B, N, E]
     N = x.shape[1]
 
