@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Multimodal smoke client: send a real PNG, an mp4 clip, or a WAV clip
+through `/v1/chat/completions` on a running cluster with ENCODE
+instances (the EPD path: encoder -> embedding injection -> prefill).
+
+    # vision cluster (Qwen2-VL combined checkpoint on both roles)
+    python -m xllm_service_tpu.api.master \
+        --mm-image-processor qwen2vl --mm-image-size 448 &
+    python -m xllm_service_tpu.api.instance --master-rpc-addr 127.0.0.1:9996 \
+        --model q2vl --checkpoint-path /ckpt --instance-type MIX &
+    python -m xllm_service_tpu.api.instance --master-rpc-addr 127.0.0.1:9996 \
+        --model q2vl --checkpoint-path /ckpt --instance-type ENCODE &
+
+    python examples/multimodal_client.py --addr 127.0.0.1:9999 \
+        --model q2vl --image cat.png
+    python examples/multimodal_client.py --addr 127.0.0.1:9999 \
+        --model q2vl --video clip.mp4
+    # audio cluster: an ENCODE instance with --model qwen2audio-encoder
+    # (or an audio checkpoint) + master --mm-audio-mel-frames 3000
+    python examples/multimodal_client.py --addr 127.0.0.1:9999 \
+        --model qwen2-audio --audio speech.wav
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import http.client
+import json
+import mimetypes
+import sys
+
+
+def data_url(path: str) -> tuple:
+    """(part_key, data URL) for an image/video/audio file."""
+    mime = mimetypes.guess_type(path)[0] or ""
+    kind = mime.split("/")[0]
+    if kind not in ("image", "video", "audio"):
+        sys.exit(f"{path}: unsupported media type {mime!r}")
+    with open(path, "rb") as f:
+        payload = base64.b64encode(f.read()).decode()
+    return f"{kind}_url", f"data:{mime};base64,{payload}"
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("multimodal smoke client")
+    p.add_argument("--addr", default="127.0.0.1:9999")
+    p.add_argument("--model", required=True)
+    p.add_argument("--prompt", default="Describe this.")
+    p.add_argument("--max-tokens", type=int, default=64)
+    g = p.add_mutually_exclusive_group(required=True)
+    g.add_argument("--image")
+    g.add_argument("--video")
+    g.add_argument("--audio")
+    args = p.parse_args()
+
+    media_path = args.image or args.video or args.audio
+    part_key, url = data_url(media_path)
+    body = {
+        "model": args.model,
+        "max_tokens": args.max_tokens,
+        "temperature": 0.0,
+        "messages": [{
+            "role": "user",
+            "content": [
+                {"type": "text", "text": args.prompt + " "},
+                {"type": part_key, part_key: {"url": url}},
+            ],
+        }],
+    }
+    host, _, port = args.addr.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=600.0)
+    conn.request(
+        "POST", "/v1/chat/completions", body=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    out = json.loads(resp.read())
+    if resp.status != 200:
+        sys.exit(f"HTTP {resp.status}: {json.dumps(out, indent=2)}")
+    print(out["choices"][0]["message"]["content"])
+
+
+if __name__ == "__main__":
+    main()

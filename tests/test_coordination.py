@@ -143,8 +143,12 @@ class TestMemoryStore:
 
 class TestMasterElection:
     def test_first_wins_second_watches(self, store):
-        e1 = MasterElection(store, "svc1", lease_ttl_s=0.3)
-        e2 = MasterElection(store, "svc2", lease_ttl_s=0.3)
+        # Generous TTL: a 0.3 s lease on the REAL clock flaked once under
+        # full-suite load (keepalive beat starved past the TTL, svc2 took
+        # over mid-assert). Nothing here waits on expiry, so the longer
+        # lease costs nothing.
+        e1 = MasterElection(store, "svc1", lease_ttl_s=3.0)
+        e2 = MasterElection(store, "svc2", lease_ttl_s=3.0)
         e1.start()
         e2.start()
         assert e1.is_master and not e2.is_master

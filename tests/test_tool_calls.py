@@ -1,0 +1,158 @@
+"""OpenAI tool-call extraction (service/tool_calls.py): Hermes/Qwen
+<tool_call> spans -> message.tool_calls with finish_reason
+"tool_calls" on non-streaming chat completions. The reference
+serializes `tools` INTO the prompt and never parses the answer back
+(jinja_chat_template.cpp:53-99) — this closes the loop."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from xllm_service_tpu.service.tool_calls import parse_tool_calls
+
+TOOLS = [{
+    "type": "function",
+    "function": {
+        "name": "get_weather",
+        "parameters": {
+            "type": "object",
+            "properties": {"city": {"type": "string"}},
+        },
+    },
+}]
+
+
+def test_parse_single_call_with_surrounding_text():
+    text = (
+        "Let me check.\n<tool_call>\n"
+        '{"name": "get_weather", "arguments": {"city": "Paris"}}\n'
+        "</tool_call>"
+    )
+    content, calls = parse_tool_calls(text, "r1")
+    assert content == "Let me check."
+    assert len(calls) == 1
+    c = calls[0]
+    assert c["type"] == "function"
+    assert c["function"]["name"] == "get_weather"
+    assert json.loads(c["function"]["arguments"]) == {"city": "Paris"}
+    assert c["id"] == "call_r1_0_0"
+
+
+def test_parse_multiple_calls_content_none():
+    text = (
+        '<tool_call>{"name": "a", "arguments": {}}</tool_call>\n'
+        '<tool_call>{"name": "b", "arguments": {"x": 1}}</tool_call>'
+    )
+    content, calls = parse_tool_calls(text, "r2")
+    assert content is None
+    assert [c["function"]["name"] for c in calls] == ["a", "b"]
+    assert [c["id"] for c in calls] == ["call_r2_0_0", "call_r2_0_1"]
+    # distinct choices get distinct ids (n>1 fan-out)
+    _, calls_c1 = parse_tool_calls(text, "r2", choice_index=1)
+    assert calls_c1[0]["id"] == "call_r2_1_0"
+
+
+def test_malformed_span_stays_in_content():
+    text = "<tool_call>not json</tool_call> after"
+    content, calls = parse_tool_calls(text, "r3")
+    assert calls == []
+    assert content == text  # untouched: never drop model output
+    # mixed: the good one parses, the bad one stays
+    text2 = (
+        '<tool_call>{"name": "ok", "arguments": {}}</tool_call>'
+        "<tool_call>{broken}</tool_call>"
+    )
+    content2, calls2 = parse_tool_calls(text2, "r4")
+    assert len(calls2) == 1 and calls2[0]["function"]["name"] == "ok"
+    assert "broken" in content2
+
+
+def test_string_arguments_pass_through():
+    text = '<tool_call>{"name": "f", "arguments": "{\\"y\\": 2}"}</tool_call>'
+    _, calls = parse_tool_calls(text, "r5")
+    assert json.loads(calls[0]["function"]["arguments"]) == {"y": 2}
+
+
+def test_plain_text_untouched():
+    content, calls = parse_tool_calls("just an answer", "r6")
+    assert content == "just an answer" and calls == []
+
+
+def test_tool_calls_through_service_e2e():
+    """Scripted fake engine emits a tool-call block: the chat completion
+    carries message.tool_calls + finish_reason tool_calls WHEN the
+    request declared tools, and plain content when it did not."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from xllm_service_tpu.api import FakeEngine, Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+
+    from tests.test_api_e2e import http_post, wait_until
+
+    from xllm_service_tpu.tokenizer import ByteTokenizer
+
+    block = (
+        "<tool_call>\n"
+        '{"name": "get_weather", "arguments": {"city": "Paris"}}\n'
+        "</tool_call>"
+    )
+    # The service detokenizes with its own (byte-level) tokenizer —
+    # script ids must come from the SAME mapping.
+    script = ByteTokenizer().encode(block)
+
+    store = MemoryStore(clock=lambda: 0.0)
+    master = Master(ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=1.0, block_size=16,
+    ), store=store)
+    master.start()
+    inst = InstanceServer(
+        EngineConfig(
+            model="fake-echo", instance_name="tc0", instance_type="MIX",
+            block_size=16,
+        ),
+        master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.2,
+        engine=FakeEngine(token_delay_s=0.0, script=script),
+    )
+    inst.start()
+    try:
+        assert wait_until(
+            lambda: sum(master.scheduler.instance_mgr.counts()) == 1
+        )
+        body_common = {
+            "model": "fake-echo",
+            "messages": [{"role": "user", "content": "weather?"}],
+            "max_tokens": len(script),
+        }
+        code, body = http_post(
+            master.http_address, "/v1/chat/completions",
+            dict(body_common, tools=TOOLS),
+        )
+        assert code == 200, body
+        choice = body["choices"][0]
+        assert choice["finish_reason"] == "tool_calls"
+        msg = choice["message"]
+        assert msg["content"] is None
+        assert msg["tool_calls"][0]["function"]["name"] == "get_weather"
+        assert json.loads(
+            msg["tool_calls"][0]["function"]["arguments"]
+        ) == {"city": "Paris"}
+
+        # Without tools: the raw text comes back untouched.
+        code, body = http_post(
+            master.http_address, "/v1/chat/completions", body_common
+        )
+        assert code == 200, body
+        choice = body["choices"][0]
+        assert choice["finish_reason"] == "stop"
+        assert "tool_calls" not in choice["message"]
+        assert choice["message"]["content"] == block
+    finally:
+        inst.stop()
+        master.stop()
+        store.close()

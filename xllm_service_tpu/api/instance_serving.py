@@ -567,6 +567,7 @@ class ServingMixin:
         )
         if chat:
             req.messages = parse_messages(body.get("messages", []))
+            req.tools = body.get("tools")  # tool-call extraction
         else:
             p = body.get("prompt", "")
             req.prompt = p if isinstance(p, str) else "".join(p)

@@ -211,15 +211,34 @@ class ResponseHandler:
             return stream.finish_with_error(output.status.code, output.status.message)
         created = int(request.created_time)
         if request.is_chat:
-            choices = [
-                {
-                    "index": seq.index,
-                    "message": {"role": "assistant", "content": seq.text},
-                    "logprobs": _chat_logprobs(seq.logprobs),
-                    "finish_reason": _finish_reason(seq) or "stop",
+            choices = []
+            for seq in output.outputs:
+                message: Dict[str, Any] = {
+                    "role": "assistant", "content": seq.text,
                 }
-                for seq in output.outputs
-            ]
+                finish = _finish_reason(seq) or "stop"
+                if request.tools:
+                    # service/tool_calls.py: Hermes/Qwen <tool_call>
+                    # spans -> OpenAI message.tool_calls (non-streaming
+                    # only; streaming emits the spans as content).
+                    from xllm_service_tpu.service.tool_calls import (
+                        parse_tool_calls,
+                    )
+
+                    content, calls = parse_tool_calls(
+                        seq.text, request.service_request_id, seq.index
+                    )
+                    if calls:
+                        message["content"] = content
+                        message["tool_calls"] = calls
+                        if finish == "stop":
+                            finish = "tool_calls"
+                choices.append({
+                    "index": seq.index,
+                    "message": message,
+                    "logprobs": _chat_logprobs(seq.logprobs),
+                    "finish_reason": finish,
+                })
             body = {
                 "id": request.service_request_id,
                 "object": "chat.completion",

@@ -1,0 +1,68 @@
+"""OpenAI tool-call extraction from generated text.
+
+The chat template serializes `tools` into the prompt (the reference
+does the same through minja and stops there — it never parses the
+model's answer back, jinja_chat_template.cpp:53-99). Models trained on
+that format (Qwen2/2.5, Hermes) emit calls as
+
+    <tool_call>
+    {"name": "get_weather", "arguments": {"city": "Paris"}}
+    </tool_call>
+
+Non-streaming chat completions parse these into the OpenAI
+`message.tool_calls` array with `finish_reason: "tool_calls"`;
+STREAMING responses deliberately emit the spans verbatim as content
+(clients parse the well-known format themselves — structured streamed
+tool deltas would require holding back every partial `<tool_call`
+prefix across chunks, trading interactivity for a convenience the
+OpenAI SDK reconstructs anyway). Malformed JSON inside a span stays in
+the content untouched — never drop model output on a parse failure.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+_TOOL_CALL_RE = re.compile(r"<tool_call>\s*(.*?)\s*</tool_call>", re.S)
+
+
+def parse_tool_calls(
+    text: str, request_id: str, choice_index: int = 0
+) -> Tuple[Optional[str], List[Dict[str, Any]]]:
+    """(remaining_content, tool_calls) from one choice's full text.
+
+    tool_calls follow the OpenAI wire shape (`function.arguments` is a
+    JSON STRING). Content becomes None when nothing but whitespace
+    remains outside the parsed spans. `choice_index` keeps ids unique
+    across an n>1 fan-out (OpenAI call ids are response-unique)."""
+    calls: List[Dict[str, Any]] = []
+
+    def replace(m: re.Match) -> str:
+        try:
+            obj = json.loads(m.group(1))
+            name = obj["name"]
+            args = obj.get("arguments", {})
+        except (ValueError, TypeError, KeyError):
+            return m.group(0)  # malformed: keep the span as content
+        if not isinstance(name, str):
+            return m.group(0)
+        calls.append({
+            "id": f"call_{request_id}_{choice_index}_{len(calls)}",
+            "type": "function",
+            "function": {
+                "name": name,
+                "arguments": (
+                    args if isinstance(args, str)
+                    else json.dumps(args, ensure_ascii=False)
+                ),
+            },
+        })
+        return ""
+
+    content = _TOOL_CALL_RE.sub(replace, text)
+    if not calls:
+        return text, []
+    content = content.strip()
+    return (content or None), calls
