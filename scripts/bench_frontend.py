@@ -1,0 +1,111 @@
+"""Front-end concurrency bench: event vs threaded HTTP backend.
+
+Spins one master + fake-echo MIX instances in-process and drives N
+concurrent SSE completion streams with the single-threaded event client
+(api/evserve/loadgen.py), printing one JSON line per run. This measures
+the CONTROL PLANE only — no JAX, no TPU; tokens come from FakeEngine.
+
+    python scripts/bench_frontend.py --streams 1024 --tokens 4
+    python scripts/bench_frontend.py --backend threaded --streams 256
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# Runnable as `python scripts/bench_frontend.py` from anywhere.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(backend: str, streams: int, tokens: int, instances: int,
+        token_delay_ms: float, ttft_ms: float) -> dict:
+    from xllm_service_tpu.api import FakeEngine, Master
+    from xllm_service_tpu.api.evserve.loadgen import run_sse_load
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+
+    store = MemoryStore(clock=lambda: 0.0)
+    master = Master(
+        ServiceConfig(
+            host="127.0.0.1", http_port=0, rpc_port=0,
+            heartbeat_interval_s=0.5, http_backend=backend, block_size=16,
+            http_max_connections=max(4096, streams + 64),
+        ),
+        store=store,
+    )
+    master.start()
+    servers = []
+    for i in range(instances):
+        srv = InstanceServer(
+            EngineConfig(model="fake-echo", instance_name=f"bench{i}",
+                         instance_type="MIX", block_size=16),
+            master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.5,
+            engine=FakeEngine(token_delay_s=token_delay_ms / 1000.0,
+                              ttft_ms=ttft_ms),
+        )
+        srv.start()
+        servers.append(srv)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if sum(master.scheduler.instance_mgr.counts()) == instances:
+            break
+        time.sleep(0.05)
+
+    bodies = [
+        {"model": "fake-echo", "prompt": f"b{i:05d}" + "x" * tokens,
+         "max_tokens": tokens, "stream": True}
+        for i in range(streams)
+    ]
+    t0 = time.monotonic()
+    results = run_sse_load(master.http_address, "/v1/completions", bodies,
+                           timeout_s=600.0)
+    wall = time.monotonic() - t0
+    ok = [r for r in results if r.ok]
+    ttfts = sorted(r.ttft_s for r in ok) or [0.0]
+    total_tokens = sum(
+        sum(1 for e in r.events[:-1] if '"choices"' in e) for r in ok
+    )
+    summary = {
+        "metric": "frontend_bench",
+        "backend": backend,
+        "streams": streams,
+        "ok": len(ok),
+        "failed": streams - len(ok),
+        "total_tokens": total_tokens,
+        "wall_s": round(wall, 3),
+        "throughput_tok_s": round(total_tokens / wall, 1) if wall else 0.0,
+        "ttft_p50_ms": round(ttfts[len(ttfts) // 2] * 1000, 1),
+        "ttft_p99_ms": round(ttfts[int(len(ttfts) * 0.99)] * 1000, 1),
+        "frontend": master.http.stats(),
+    }
+    for srv in servers:
+        srv.stop()
+    master.stop()
+    store.close()
+    return summary
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(__doc__)
+    ap.add_argument("--backend", default="event",
+                    choices=["event", "threaded"])
+    ap.add_argument("--streams", type=int, default=1024)
+    ap.add_argument("--tokens", type=int, default=4)
+    ap.add_argument("--instances", type=int, default=2)
+    ap.add_argument("--token-delay-ms", type=float, default=1.0)
+    ap.add_argument("--ttft-ms", type=float, default=2.0)
+    args = ap.parse_args(argv)
+    summary = run(args.backend, args.streams, args.tokens, args.instances,
+                  args.token_delay_ms, args.ttft_ms)
+    print(json.dumps(summary))
+    if summary["failed"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

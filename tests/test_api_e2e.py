@@ -81,8 +81,11 @@ def sse_post(addr, path, body, timeout=30.0):
     return events
 
 
-@pytest.fixture(scope="module")
-def cluster():
+@pytest.fixture(scope="module", params=["event", "threaded"])
+def cluster(request):
+    """The whole e2e surface runs twice — once per HTTP front-end backend
+    (evserve event loop and stdlib threaded) — so a route regression on
+    either backend fails CI, not just on the default."""
     store = MemoryStore(clock=lambda: 0.0)  # frozen: leases never lapse under GIL stalls
     cfg = ServiceConfig(
         host="127.0.0.1",
@@ -93,6 +96,7 @@ def cluster():
         load_balance_policy="CAR",
         num_ordered_output_streams=8,
         block_size=16,
+        http_backend=request.param,
     )
     master = Master(cfg, store=store)
     master.start()

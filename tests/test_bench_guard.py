@@ -1,0 +1,74 @@
+"""bench.py clean-load CPU decode regression guard (VERDICT r5 #2).
+
+Pure-logic tests over _cpu_regression_guard — no model, no timing. The
+guard must (a) fail loudly on a clean-load >5% CPU regression, (b) abstain
+on hot hosts (the r3 precedent) and on hosts smaller than the anchor's
+class, and (c) never touch TPU results or unparseable lines.
+"""
+
+import json
+
+import pytest
+
+import bench
+
+
+@pytest.fixture(autouse=True)
+def _anchor(monkeypatch):
+    # Pin the knobs so the assertions don't depend on env or host size.
+    monkeypatch.setattr(bench, "_BEST_CPU_DECODE_TOK_S", 4262.9)
+    monkeypatch.setattr(bench, "_GUARD_LOADAVG_CEILING", 1.0)
+    monkeypatch.setattr(bench, "_GUARD_MIN_CPUS", 1)
+
+
+def _line(**kw):
+    d = {"backend": "cpu", "value": 4262.9,
+         "loadavg_1m": 0.2, "loadavg_1m_start": 0.2}
+    d.update(kw)
+    return json.dumps(d)
+
+
+def test_clean_load_regression_fails():
+    out, rc = bench._cpu_regression_guard(_line(value=3901.8))  # the r5 drop
+    assert rc == 3
+    assert json.loads(out)["cpu_regression_guard"].startswith("FAIL")
+
+
+def test_within_five_percent_passes():
+    out, rc = bench._cpu_regression_guard(_line(value=4060.0))  # -4.8%
+    assert rc == 0
+    assert json.loads(out)["cpu_regression_guard"] == "ok"
+
+
+def test_hot_host_abstains():
+    out, rc = bench._cpu_regression_guard(
+        _line(value=100.0, loadavg_1m=3.0)
+    )
+    assert rc == 0
+    assert "loadavg" in json.loads(out)["cpu_regression_guard"]
+
+
+def test_small_host_abstains(monkeypatch):
+    monkeypatch.setattr(bench, "_GUARD_MIN_CPUS", 10_000)
+    out, rc = bench._cpu_regression_guard(_line(value=100.0))
+    assert rc == 0
+    assert "host below" in json.loads(out)["cpu_regression_guard"]
+
+
+def test_tpu_result_untouched():
+    line = json.dumps({"backend": "tpu", "value": 1.0})
+    out, rc = bench._cpu_regression_guard(line)
+    assert rc == 0
+    assert "cpu_regression_guard" not in json.loads(out)
+
+
+def test_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("XLLM_BENCH_NO_REGRESSION_GUARD", "1")
+    out, rc = bench._cpu_regression_guard(_line(value=10.0))
+    assert rc == 0
+    assert out == _line(value=10.0)
+
+
+def test_non_json_line_passes_through():
+    out, rc = bench._cpu_regression_guard("not json")
+    assert (out, rc) == ("not json", 0)

@@ -62,6 +62,38 @@ def test_pipeline_matches_dense_oracle(stages, microbatches):
     )
 
 
+def test_pipeline_quantized_weights():
+    """int8 weight leaves ({"q","s"} dicts) flow through the pipeline
+    layer body — lp["wo"] used to be applied with a raw .astype, which
+    crashes on quantized checkpoints — and match the equally-quantized
+    dense oracle exactly (both dequantize at the use site via wt())."""
+    from xllm_service_tpu.ops import quant
+
+    cfg = _cfg(layers=4)
+    mesh = _mesh(2)
+    params = llama.init_params(cfg, jax.random.key(11), jnp.float32)
+    lp = params["layers"]
+    for k in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+        lp[k] = quant.quantize_weight(lp[k])
+    # The sharding tree is a pytree prefix: each QuantLeaf's q and s both
+    # take the stacked-layer sharding.
+    placed = jax.device_put(
+        params, pipeline_param_shardings(cfg, mesh, "pp")
+    )
+    toks = jnp.asarray(
+        np.random.default_rng(9).integers(0, cfg.vocab_size, (2, 16)),
+        jnp.int32,
+    )
+    with mesh:
+        got = jax.jit(
+            lambda p, t: pipeline_forward_dense(p, cfg, t, mesh, "pp", 2)
+        )(placed, toks)
+    want = llama.forward_dense(params, cfg, toks)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
 def test_pipeline_tied_embeddings():
     cfg = _cfg(layers=4, tied=True)
     mesh = _mesh(4)

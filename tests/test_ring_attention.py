@@ -11,6 +11,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from xllm_service_tpu.ops.ring_attention import ring_attention
 
+# jax < 0.6 has no jax.set_mesh; `with mesh:` is the equivalent there.
+_mesh_ctx = jax.set_mesh if hasattr(jax, "set_mesh") else (lambda m: m)
+
 
 def _dense_reference(q, k, v, scale, causal):
     B, L, Hq, D = q.shape
@@ -41,7 +44,7 @@ def test_ring_matches_dense(cpu_devices, sp, causal):
 
     spec = NamedSharding(mesh, P(None, "sp", None, None))
     qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
-    with jax.set_mesh(mesh):
+    with _mesh_ctx(mesh):
         got = jax.jit(
             lambda a, b, c: ring_attention(
                 a, b, c, mesh, scale=scale, causal=causal
@@ -63,7 +66,7 @@ def test_ring_mha_no_gqa(cpu_devices):
     v = jnp.asarray(rng.standard_normal((B, L, H, D)), jnp.float32)
     want = _dense_reference(q, k, v, scale, True)
     spec = NamedSharding(mesh, P(None, "sp", None, None))
-    with jax.set_mesh(mesh):
+    with _mesh_ctx(mesh):
         got = jax.jit(
             lambda a, b, c: ring_attention(a, b, c, mesh, scale=scale)
         )(*(jax.device_put(x, spec) for x in (q, k, v)))

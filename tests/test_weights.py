@@ -110,6 +110,31 @@ def test_tied_embeddings_roundtrip(tmp_path):
     _tree_equal(params, loaded)
 
 
+def test_yarn_rope_scaling_roundtrip(tmp_path):
+    """save_hf_checkpoint must serialize yarn rope_scaling symmetrically
+    with _hf_rope_scaling — a saved DeepSeek-V3-style yarn config used to
+    come back as {"rope_type": "yarn"} alone, which config_from_hf rejects
+    (KeyError: 'factor') and transformers can't load."""
+    cfg = ModelConfig(
+        name="yarn-tiny", vocab_size=128, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=2, num_kv_heads=2,
+        head_dim=32,
+        rope_scaling_type="yarn", rope_scaling_factor=40.0,
+        rope_original_max_position=4096,
+        rope_beta_fast=32.0, rope_beta_slow=1.0,
+        rope_mscale=1.0, rope_mscale_all_dim=1.0,
+    )
+    params = llama.init_params(cfg, jax.random.key(0), jnp.bfloat16)
+    ckpt = str(tmp_path / "ckpt")
+    weights.save_hf_checkpoint(params, cfg, ckpt)
+    loaded_cfg = weights.config_from_hf(ckpt)
+    for f in ("rope_scaling_type", "rope_scaling_factor",
+              "rope_original_max_position", "rope_beta_fast",
+              "rope_beta_slow", "rope_mscale", "rope_mscale_all_dim",
+              "rope_scaling_truncate"):
+        assert getattr(loaded_cfg, f) == getattr(cfg, f), f
+
+
 def test_multi_shard_with_index(tmp_path):
     """Checkpoints split across files + model.safetensors.index.json."""
     cfg = get_model_config("llama3-tiny")

@@ -1,0 +1,24 @@
+"""Event-driven (selectors/epoll) HTTP+SSE front end for the control plane.
+
+Selected via ServiceConfig.http_backend = "event" (the default); the
+threaded stdlib backend remains available as "threaded". See
+docs/FRONTEND.md for the design.
+"""
+
+from xllm_service_tpu.api.evserve.handler import EvHandler
+from xllm_service_tpu.api.evserve.parser import (
+    Headers,
+    HttpRequest,
+    ParseError,
+    RequestParser,
+)
+from xllm_service_tpu.api.evserve.server import EventLoopHttpServer
+
+__all__ = [
+    "EvHandler",
+    "EventLoopHttpServer",
+    "Headers",
+    "HttpRequest",
+    "ParseError",
+    "RequestParser",
+]

@@ -1,0 +1,218 @@
+"""Event-driven SSE load client.
+
+Drives N concurrent streaming completions against a front end from ONE
+thread (selectors on the client side too) — the only honest way to prove
+the server holds >1k concurrent streams, since a thread-per-stream client
+would hit the same wall the threaded server does. Used by
+tests/test_evserve.py and scripts/bench_frontend.py.
+"""
+
+from __future__ import annotations
+
+import json
+import selectors
+import socket
+import time
+from typing import Any, Dict, List, Optional
+
+_RECV = 65536
+
+
+class StreamResult:
+    __slots__ = ("ok", "error", "events", "ttft_s", "total_s", "status")
+
+    def __init__(self):
+        self.ok = False
+        self.error: Optional[str] = None
+        self.events: List[str] = []  # raw SSE data payloads, "[DONE]" last
+        self.ttft_s: Optional[float] = None
+        self.total_s: Optional[float] = None
+        self.status: Optional[int] = None
+
+
+class _Stream:
+    def __init__(self, sock: socket.socket, payload: bytes):
+        self.sock = sock
+        self.to_send = memoryview(payload)
+        self.result = StreamResult()
+        self.t0 = time.monotonic()
+        self.raw = bytearray()  # undecoded wire bytes
+        self.head_done = False
+        self.chunked = False
+        self.chunk_need = -1  # -1: awaiting size line; >=0: data bytes left
+        self.body = bytearray()  # decoded SSE text stream
+        self.done = False
+
+    def finish(self, ok: bool, error: Optional[str] = None) -> None:
+        self.done = True
+        self.result.ok = ok
+        self.result.error = error
+        self.result.total_s = time.monotonic() - self.t0
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def _build_request(path: str, host: str, body: Dict[str, Any]) -> bytes:
+    data = json.dumps(body).encode()
+    return (
+        f"POST {path} HTTP/1.1\r\nHost: {host}\r\n"
+        f"Content-Type: application/json\r\nContent-Length: {len(data)}\r\n"
+        f"Connection: close\r\n\r\n"
+    ).encode() + data
+
+
+def run_sse_load(
+    addr: str,
+    path: str,
+    bodies: List[Dict[str, Any]],
+    timeout_s: float = 120.0,
+) -> List[StreamResult]:
+    """Open one connection per body, stream all of them concurrently, and
+    return per-stream results in input order."""
+    host, _, port = addr.partition(":")
+    target = (host, int(port))
+    sel = selectors.DefaultSelector()
+    streams: List[_Stream] = []
+    for body in bodies:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setblocking(False)
+        try:
+            sock.connect(target)
+        except BlockingIOError:
+            pass
+        except OSError as e:
+            st = _Stream(sock, b"")
+            st.finish(False, f"connect: {e}")
+            streams.append(st)
+            continue
+        st = _Stream(sock, _build_request(path, addr, body))
+        streams.append(st)
+        sel.register(sock, selectors.EVENT_WRITE, st)
+
+    live = sum(1 for s in streams if not s.done)
+    deadline = time.monotonic() + timeout_s
+    while live and time.monotonic() < deadline:
+        for key, mask in sel.select(timeout=0.5):
+            st: _Stream = key.data
+            if st.done:
+                continue
+            if mask & selectors.EVENT_WRITE:
+                err = st.sock.getsockopt(socket.SOL_SOCKET, socket.SO_ERROR)
+                if err:
+                    sel.unregister(st.sock)
+                    st.finish(False, f"connect: errno {err}")
+                    live -= 1
+                    continue
+                try:
+                    n = st.sock.send(st.to_send)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError as e:
+                    sel.unregister(st.sock)
+                    st.finish(False, f"send: {e}")
+                    live -= 1
+                    continue
+                st.to_send = st.to_send[n:]
+                if not len(st.to_send):
+                    sel.modify(st.sock, selectors.EVENT_READ, st)
+                continue
+            try:
+                data = st.sock.recv(_RECV)
+            except (BlockingIOError, InterruptedError):
+                continue
+            except OSError as e:
+                sel.unregister(st.sock)
+                st.finish(False, f"recv: {e}")
+                live -= 1
+                continue
+            if not data:
+                sel.unregister(st.sock)
+                st.finish(False, "connection closed mid-stream")
+                live -= 1
+                continue
+            st.raw += data
+            fin = _consume(st)
+            if fin is not None:
+                sel.unregister(st.sock)
+                st.finish(*fin)
+                live -= 1
+    for st in streams:
+        if not st.done:
+            try:
+                sel.unregister(st.sock)
+            except (KeyError, ValueError):
+                pass
+            st.finish(False, "timeout")
+    sel.close()
+    return [s.result for s in streams]
+
+
+def _consume(st: _Stream):
+    """Advance one stream's parser; returns (ok, error) when finished,
+    None while still streaming."""
+    if not st.head_done:
+        end = st.raw.find(b"\r\n\r\n")
+        if end < 0:
+            return None
+        head = bytes(st.raw[:end]).decode("iso-8859-1")
+        del st.raw[: end + 4]
+        line = head.split("\r\n")[0].split()
+        st.result.status = int(line[1]) if len(line) > 1 else 0
+        st.chunked = "transfer-encoding: chunked" in head.lower()
+        st.head_done = True
+        if st.result.status != 200:
+            return False, f"HTTP {st.result.status}"
+        if not st.chunked:
+            return False, "response not chunked"
+    # chunked transfer decoding
+    while True:
+        if st.chunk_need < 0:
+            nl = st.raw.find(b"\r\n")
+            if nl < 0:
+                break
+            try:
+                size = int(bytes(st.raw[:nl]).split(b";")[0], 16)
+            except ValueError:
+                return False, "bad chunk size"
+            del st.raw[: nl + 2]
+            if size == 0:
+                return _finish_events(st)
+            st.chunk_need = size
+        else:
+            if len(st.raw) < st.chunk_need + 2:
+                break
+            st.body += st.raw[: st.chunk_need]
+            del st.raw[: st.chunk_need + 2]  # data + CRLF
+            st.chunk_need = -1
+            ret = _drain_events(st)
+            if ret is not None:
+                return ret
+    return None
+
+
+def _drain_events(st: _Stream):
+    while True:
+        sep = st.body.find(b"\n\n")
+        if sep < 0:
+            return None
+        event = bytes(st.body[:sep]).decode("utf-8", "replace")
+        del st.body[: sep + 2]
+        for line in event.split("\n"):
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if st.result.ttft_s is None:
+                st.result.ttft_s = time.monotonic() - st.t0
+            st.result.events.append(payload)
+            if payload == "[DONE]":
+                return True, None
+    return None
+
+
+def _finish_events(st: _Stream):
+    _drain_events(st)
+    if st.result.events and st.result.events[-1] == "[DONE]":
+        return True, None
+    return False, "stream ended without [DONE]"

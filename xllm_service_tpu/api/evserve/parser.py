@@ -1,0 +1,141 @@
+"""Incremental HTTP/1.1 request parser for the event-loop front end.
+
+Zero-copy-ish push parser: the event loop feeds whatever bytes epoll
+delivered, the parser emits complete requests (possibly several — clients
+may pipeline). No request body streaming: the control plane's bodies are
+small JSON documents (the KV data plane rides the instance tier's servers,
+not this one), so bodies buffer fully before dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+MAX_HEAD_BYTES = 64 * 1024
+MAX_BODY_BYTES = 64 * 1024 * 1024
+
+
+class ParseError(Exception):
+    """Malformed/oversized request. `status` is the HTTP status the
+    connection should answer with before closing."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class Headers:
+    """Case-insensitive header map (the email.message.Message.get subset
+    the handlers use)."""
+
+    def __init__(self):
+        self._d = {}
+
+    def add(self, key: str, value: str) -> None:
+        k = key.lower()
+        if k in self._d:
+            # Repeated headers join per RFC 9110 §5.2 (none of ours repeat,
+            # but a client's duplicated Connection: must not be dropped).
+            self._d[k] = self._d[k] + ", " + value
+        else:
+            self._d[k] = value
+
+    def get(self, key: str, default=None):
+        return self._d.get(key.lower(), default)
+
+    def __contains__(self, key: str) -> bool:
+        return key.lower() in self._d
+
+    def items(self):
+        return self._d.items()
+
+
+class HttpRequest:
+    __slots__ = ("method", "target", "version", "headers", "body", "keep_alive")
+
+    def __init__(self, method: str, target: str, version: str,
+                 headers: Headers, body: bytes):
+        self.method = method
+        self.target = target
+        self.version = version
+        self.headers = headers
+        self.body = body
+        conn_tokens = (headers.get("connection", "") or "").lower()
+        if version == "HTTP/1.0":
+            self.keep_alive = "keep-alive" in conn_tokens
+        else:
+            self.keep_alive = "close" not in conn_tokens
+
+
+class RequestParser:
+    """feed(data) -> list of complete HttpRequests; raises ParseError once
+    the stream is unrecoverable (caller answers + closes)."""
+
+    def __init__(self, max_head_bytes: int = MAX_HEAD_BYTES,
+                 max_body_bytes: int = MAX_BODY_BYTES):
+        self._buf = bytearray()
+        self._head: Optional[Tuple[str, str, str, Headers]] = None
+        self._body_len = 0
+        self._max_head = max_head_bytes
+        self._max_body = max_body_bytes
+
+    def feed(self, data: bytes) -> List[HttpRequest]:
+        self._buf += data
+        out: List[HttpRequest] = []
+        while True:
+            req = self._try_parse_one()
+            if req is None:
+                return out
+            out.append(req)
+
+    def _try_parse_one(self) -> Optional[HttpRequest]:
+        if self._head is None:
+            end = self._buf.find(b"\r\n\r\n")
+            if end < 0:
+                if len(self._buf) > self._max_head:
+                    raise ParseError(431, "request head too large")
+                return None
+            head = bytes(self._buf[:end])
+            del self._buf[: end + 4]
+            self._head = self._parse_head(head)
+            headers = self._head[3]
+            if "chunked" in (headers.get("transfer-encoding", "") or "").lower():
+                raise ParseError(501, "chunked request bodies unsupported")
+            try:
+                self._body_len = int(headers.get("content-length", 0) or 0)
+            except ValueError:
+                raise ParseError(400, "bad Content-Length") from None
+            if self._body_len < 0:
+                raise ParseError(400, "bad Content-Length")
+            if self._body_len > self._max_body:
+                raise ParseError(413, "request body too large")
+        if len(self._buf) < self._body_len:
+            return None
+        body = bytes(self._buf[: self._body_len])
+        del self._buf[: self._body_len]
+        method, target, version, headers = self._head
+        self._head = None
+        self._body_len = 0
+        return HttpRequest(method, target, version, headers, body)
+
+    @staticmethod
+    def _parse_head(head: bytes) -> Tuple[str, str, str, Headers]:
+        try:
+            text = head.decode("iso-8859-1")
+        except Exception:  # pragma: no cover — iso-8859-1 decodes anything
+            raise ParseError(400, "undecodable request head") from None
+        lines = text.split("\r\n")
+        parts = lines[0].split()
+        if len(parts) != 3 or not parts[2].startswith("HTTP/1."):
+            raise ParseError(400, f"malformed request line: {lines[0]!r}")
+        method, target, version = parts
+        headers = Headers()
+        for line in lines[1:]:
+            if not line:
+                continue
+            name, sep, value = line.partition(":")
+            if not sep or not name or name != name.strip():
+                raise ParseError(400, f"malformed header line: {line!r}")
+            headers.add(name, value.strip())
+        return method, target, version, headers

@@ -1,7 +1,9 @@
 """HTTP plumbing shared by the master and instance servers.
 
 Replaces the reference's brpc server/ProgressiveAttachment machinery
-(call_data.h:83-201) with stdlib ThreadingHTTPServer + chunked SSE writes.
+(call_data.h:83-201) with chunked SSE writes over one of two backends
+(make_http_server): the stdlib ThreadingHTTPServer, or the evserve
+selectors/epoll event loop that detaches streams from threads.
 Keep-alive JSON POSTs between tiers reuse an http.client connection per
 (thread, host) — the analog of the reference's cached brpc channels
 (instance_mgr.cpp:334-353).
@@ -11,25 +13,22 @@ from __future__ import annotations
 
 import http.client
 import json
-import socketserver
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 
-class QuietHandler(BaseHTTPRequestHandler):
-    protocol_version = "HTTP/1.1"
+class HttpJsonApi:
+    """JSON/routing helpers shared by BOTH server backends: QuietHandler
+    (threaded, BaseHTTPRequestHandler) and evserve's EvHandler (event
+    loop). Requires the host class to provide `headers`, `path`,
+    `send_response/send_header/end_headers`, `wfile`, and `_read_body()`."""
 
-    def log_message(self, fmt, *args):  # silence per-request stderr spam
-        pass
-
-    # -- helpers -----------------------------------------------------------
     def read_json(self) -> Optional[Dict[str, Any]]:
         try:
-            n = int(self.headers.get("Content-Length", 0))
-            raw = self.rfile.read(n) if n else b"{}"
-            return json.loads(raw.decode("utf-8"))
+            raw = self._read_body()
+            return json.loads(raw.decode("utf-8")) if raw else {}
         except Exception:
             return None
 
@@ -73,6 +72,31 @@ class QuietHandler(BaseHTTPRequestHandler):
     def route(self) -> str:
         return urlparse(self.path).path
 
+
+class QuietHandler(HttpJsonApi, BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+    def _read_body(self) -> bytes:
+        n = int(self.headers.get("Content-Length", 0))
+        return self.rfile.read(n) if n else b"{}"
+
+    def hold(self, stream, timeout_s: float, fail) -> None:
+        """Block this handler thread until the scheduler finishes the
+        exchange (thread-per-stream semantics). On deadline, `fail()` asks
+        the scheduler to fail the request; if its lane still hasn't run
+        after a 5 s grace, the exchange is abandoned with no response and
+        the connection dropped so no late write can reach a reused socket.
+        The event backend's EvHandler.hold has the same contract without
+        the blocked thread."""
+        if stream.done.wait(timeout_s):
+            return
+        fail()
+        if not stream.done.wait(5.0):
+            stream.abandon()
+            self.close_connection = True
 
 class SseWriter:
     """Server-sent-events writer over a chunked HTTP/1.1 response
@@ -128,6 +152,11 @@ class SseWriter:
                 self._h.wfile.flush()
             except (BrokenPipeError, ConnectionResetError, OSError):
                 pass
+        # Event backend: tells the EvHandler its chunked response is fully
+        # framed so the exchange (and keep-alive slot) can complete.
+        hook = getattr(self._h, "on_sse_closed", None)
+        if hook is not None:
+            hook()
 
 
 class HttpServerThread:
@@ -153,6 +182,70 @@ class HttpServerThread:
         self.server.shutdown()
         self.server.server_close()
         self._thread.join(timeout=2.0)
+
+    def stats(self) -> Dict[str, Any]:
+        return {"backend": "threaded"}
+
+
+def make_http_server(
+    backend: str,
+    host: str,
+    port: int,
+    *,
+    do_get=None,
+    do_post=None,
+    name: str = "http",
+    workers: int = 32,
+    max_connections: int = 4096,
+    idle_timeout_s: float = 120.0,
+    max_stream_buffer: int = 512 * 1024,
+    drain_timeout_s: float = 5.0,
+    max_body_bytes: int = 256 * 1024 * 1024,
+):
+    """Build one control-plane HTTP server on the selected backend.
+
+    "threaded": stdlib ThreadingHTTPServer — a thread per connection plus a
+    blocked thread per in-flight stream. "event": evserve's selectors/epoll
+    loop — streams hold sockets, not threads, which is what carries the
+    front end past ~1k concurrent SSE streams. Both return the same
+    surface: start/stop/host/port/stats, and hand handlers the same
+    HttpJsonApi + hold() API.
+    """
+    if backend == "threaded":
+
+        class _Handler(QuietHandler):
+            def do_GET(self):
+                if do_get is None:
+                    self.send_error_json(405, "method not allowed")
+                else:
+                    do_get(self)
+
+            def do_POST(self):
+                if do_post is None:
+                    self.send_error_json(405, "method not allowed")
+                else:
+                    do_post(self)
+
+        return HttpServerThread(host, port, _Handler)
+    if backend != "event":
+        raise ValueError(f"unknown http backend {backend!r}")
+
+    from xllm_service_tpu.api.evserve import EventLoopHttpServer
+
+    def app(h) -> None:
+        if h.command == "GET" and do_get is not None:
+            do_get(h)
+        elif h.command == "POST" and do_post is not None:
+            do_post(h)
+        else:
+            h.send_error_json(405, f"method {h.command} not allowed")
+
+    return EventLoopHttpServer(
+        host, port, app,
+        name=name, workers=workers, max_connections=max_connections,
+        idle_timeout_s=idle_timeout_s, max_stream_buffer=max_stream_buffer,
+        drain_timeout_s=drain_timeout_s, max_body_bytes=max_body_bytes,
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -25,7 +25,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from xllm_service_tpu.api.client import HeartbeatLoop, MasterClient
-from xllm_service_tpu.api.http_utils import HttpServerThread, QuietHandler
+from xllm_service_tpu.api.http_utils import HttpJsonApi, make_http_server
 from xllm_service_tpu.api.protocol import sampling_from_body  # noqa: F401 — re-export
 from xllm_service_tpu.common.config import EngineConfig
 from xllm_service_tpu.common.types import (
@@ -111,16 +111,13 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
         self.chat_template = ChatTemplate(self.tokenizer)
         self._responses = ResponseHandler()
 
-        instance_self = self
-
-        class Handler(QuietHandler):
-            def do_GET(self):
-                instance_self.handle_get(self)
-
-            def do_POST(self):
-                instance_self.handle_post(self)
-
-        self.http = HttpServerThread(host, port, Handler)
+        # Front door on the configured backend (EngineConfig.http_backend;
+        # "threaded" default — see the config comment there).
+        self.http = make_http_server(
+            getattr(engine_cfg, "http_backend", "threaded"), host, port,
+            do_get=self.handle_get, do_post=self.handle_post,
+            name=f"inst-{engine_cfg.instance_name or port}",
+        )
         self.name = engine_cfg.instance_name or f"{host}:{self.http.port}"
         self.meta = InstanceMetaInfo(
             name=self.name,
@@ -436,7 +433,7 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
             f"xllm_engine_spec_tokens_per_slot_step {rate:.4f}\n"
         )
 
-    def handle_get(self, h: QuietHandler) -> None:
+    def handle_get(self, h: HttpJsonApi) -> None:
         route = h.route
         if route == "/hello":
             h.send_json({"message": f"hello from instance {self.name}"})
@@ -474,7 +471,7 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
         else:
             h.send_error_json(404, f"no route {route}")
 
-    def handle_post(self, h: QuietHandler) -> None:
+    def handle_post(self, h: HttpJsonApi) -> None:
         route = h.route
         if route == "/kv/import":  # binary body, not JSON
             self._handle_kv_import(h)

@@ -16,7 +16,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from xllm_service_tpu.api.http_utils import QuietHandler, post_bytes
+from xllm_service_tpu.api.http_utils import HttpJsonApi, post_bytes
 from xllm_service_tpu.api.instance_registry import _LOCAL_INSTANCES, _LOCAL_MU
 from xllm_service_tpu.api.protocol import (
     handoff_from_bytes,
@@ -278,7 +278,7 @@ class KVHandoffMixin:
             return None
         return peer
 
-    def _handle_kv_import(self, h: QuietHandler) -> None:
+    def _handle_kv_import(self, h: HttpJsonApi) -> None:
         try:
             n = int(h.headers.get("Content-Length", 0))
             data = h.rfile.read(n)

@@ -13,13 +13,13 @@ from typing import Any, Dict
 
 import numpy as np
 
-from xllm_service_tpu.api.http_utils import QuietHandler, post_json
+from xllm_service_tpu.api.http_utils import HttpJsonApi, post_json
 
 class MultimodalMixin:
     # Landed-but-unclaimed media embeddings are reaped after this TTL.
     _MM_IMPORT_TTL_S = 120.0
 
-    def _handle_embeddings(self, h: QuietHandler, body: Dict[str, Any]) -> None:
+    def _handle_embeddings(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
         """Engine-side /v1/embeddings: token id lists in (the service
         tokenizes, same injection contract as generation forwarding),
         mean-pooled normalized hidden-state vectors out. The reference
@@ -65,7 +65,7 @@ class MultimodalMixin:
             }
         )
 
-    def _handle_encode(self, h: QuietHandler, body: Dict[str, Any]) -> None:
+    def _handle_encode(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
         """ENCODE-instance entry: media parts in, embeddings pushed to the
         prefill peer's /mm/import, ack out (three-stage EPD routing)."""
         import base64
@@ -215,7 +215,7 @@ class MultimodalMixin:
             return
         h.send_json({"ok": True, "media_tokens": int(flat.shape[0])})
 
-    def _handle_mm_import(self, h: QuietHandler, body: Dict[str, Any]) -> None:
+    def _handle_mm_import(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
         import base64
 
         srid = body.get("service_request_id", "")

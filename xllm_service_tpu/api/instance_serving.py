@@ -13,7 +13,7 @@ import dataclasses
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
-from xllm_service_tpu.api.http_utils import QuietHandler, SseWriter
+from xllm_service_tpu.api.http_utils import HttpJsonApi, SseWriter
 from xllm_service_tpu.api.protocol import parse_prompt_field, sampling_from_body
 from xllm_service_tpu.common.shortuuid import generate_uuid
 from xllm_service_tpu.common.types import RequestOutput, StatusCode
@@ -395,7 +395,7 @@ class ServingMixin:
             logprobs=sampling.logprobs or need_logprobs,
         )
 
-    def _serve(self, h: QuietHandler, body: Dict[str, Any], chat: bool) -> None:
+    def _serve(self, h: HttpJsonApi, body: Dict[str, Any], chat: bool) -> None:
         from xllm_service_tpu.runtime.engine import EngineRequest
 
         srid = body.get("service_request_id", "")
@@ -539,7 +539,7 @@ class ServingMixin:
 
     def _serve_direct(
         self,
-        h: QuietHandler,
+        h: HttpJsonApi,
         body: Dict[str, Any],
         chat: bool,
         token_ids: List[int],
@@ -702,7 +702,7 @@ class ServingMixin:
 
     def _respond_best_of(
         self,
-        h: QuietHandler,
+        h: HttpJsonApi,
         req: ServiceRequest,
         acc: List[RequestOutput],
         lp_sums: List[float],
@@ -757,7 +757,7 @@ class ServingMixin:
         self._responses.send_result_to_client(_Once(), req, final)
 
     def _respond_accumulated(
-        self, h: QuietHandler, req: ServiceRequest, acc: List[RequestOutput]
+        self, h: HttpJsonApi, req: ServiceRequest, acc: List[RequestOutput]
     ) -> None:
         # With n>1 children interleaving, an errored child's output can sit
         # anywhere in acc — scan, don't just check the tail.

@@ -1,7 +1,9 @@
 """Master process: OpenAI-compatible HTTP front end + instance-facing RPC.
 
-Composes one Scheduler with two threaded HTTP servers on separate ports —
-the same process shape as the reference master (reference: master.cpp:26-34
+Composes one Scheduler with two HTTP servers on separate ports (the
+evserve event loop by default, config.http_backend="threaded" for the
+stdlib thread-per-connection backend) — the same process shape as the
+reference master (reference: master.cpp:26-34
 wires Scheduler->RPC->HTTP; :60-102 HTTP server; :104-139 RPC server; two
 server threads at :38-58). The client plane parses OpenAI JSON, schedules,
 injects service fields, and forwards to the prefill instance
@@ -25,11 +27,11 @@ import time
 from typing import Any, Dict, Optional
 
 from xllm_service_tpu.api.http_utils import (
-    HttpServerThread,
-    QuietHandler,
+    HttpJsonApi,
     SseWriter,
     get_json,
     get_raw,
+    make_http_server,
     post_json,
 )
 from xllm_service_tpu.api.protocol import (
@@ -74,7 +76,7 @@ class HttpClientStream(ClientStream):
     the early done->Run SSE trick, call_data.h:83-92)."""
 
     def __init__(
-        self, handler: QuietHandler, streaming: bool, x_request_id: str = ""
+        self, handler: HttpJsonApi, streaming: bool, x_request_id: str = ""
     ):
         self._handler = handler
         self._streaming = streaming
@@ -163,24 +165,26 @@ class Master:
         self._leases_mu = threading.Lock()
         self._request_timeout_s = 600.0
 
-        master = self
-
-        class ClientHandler(QuietHandler):
-            def do_GET(self):
-                master.handle_client_get(self)
-
-            def do_POST(self):
-                master.handle_client_post(self)
-
-        class RpcHandler(QuietHandler):
-            def do_GET(self):
-                master.handle_rpc_get(self)
-
-            def do_POST(self):
-                master.handle_rpc_post(self)
-
-        self.http = HttpServerThread(config.host, config.http_port, ClientHandler)
-        self.rpc = HttpServerThread(config.host, config.rpc_port, RpcHandler)
+        # Both control-plane servers ride the configured backend ("event"
+        # = evserve selectors loop, "threaded" = stdlib thread-per-conn).
+        server_opts = dict(
+            workers=config.http_workers,
+            max_connections=config.http_max_connections,
+            idle_timeout_s=config.http_idle_timeout_s,
+            max_stream_buffer=config.sse_max_buffered_kb * 1024,
+            drain_timeout_s=config.http_drain_timeout_s,
+            max_body_bytes=config.http_max_body_mb * 1024 * 1024,
+        )
+        self.http = make_http_server(
+            config.http_backend, config.host, config.http_port,
+            do_get=self.handle_client_get, do_post=self.handle_client_post,
+            name="master-http", **server_opts,
+        )
+        self.rpc = make_http_server(
+            config.http_backend, config.host, config.rpc_port,
+            do_get=self.handle_rpc_get, do_post=self.handle_rpc_post,
+            name="master-rpc", **server_opts,
+        )
 
         def notify_flip(name: str, attempt: int) -> None:
             # Role resolved at SEND time from the registry (not frozen at
@@ -237,7 +241,7 @@ class Master:
     # client plane
     # ------------------------------------------------------------------ #
 
-    def handle_client_get(self, h: QuietHandler) -> None:
+    def handle_client_get(self, h: HttpJsonApi) -> None:
         route = h.route
         if route == "/hello":
             h.send_json({"message": "hello from xllm-service-tpu master"})
@@ -262,7 +266,7 @@ class Master:
         else:
             h.send_error_json(404, f"no route {route}")
 
-    def _handle_metrics(self, h: QuietHandler) -> None:
+    def _handle_metrics(self, h: HttpJsonApi) -> None:
         inst = h.query().get("instance")
         if inst:
             # Passthrough to one instance (reference behavior,
@@ -294,8 +298,35 @@ class Master:
             "# TYPE xllm_service_redispatches_total counter",
             f"xllm_service_redispatches_total "
             f"{self.scheduler.total_redispatches}",
-            "# TYPE xllm_instance_waiting_requests gauge",
         ]
+        # Front-end gauges (event backend only: the threaded backend has no
+        # loop to report — stats() returns just its backend tag). One TYPE
+        # line per metric with both planes' samples grouped under it — the
+        # Prometheus text parser rejects duplicate TYPE lines / ungrouped
+        # series, which would fail the whole scrape.
+        plane_stats = [
+            (plane, srv.stats())
+            for plane, srv in (("http", self.http), ("rpc", self.rpc))
+        ]
+        plane_stats = [
+            (p, st) for p, st in plane_stats if st.get("backend") == "event"
+        ]
+        for key, kind in (
+            ("open_connections", "gauge"),
+            ("active_streams", "gauge"),
+            ("buffered_bytes", "gauge"),
+            ("accepted_total", "counter"),
+            ("requests_total", "counter"),
+            ("slow_client_closes", "counter"),
+            ("rejected_connections", "counter"),
+        ):
+            if plane_stats:
+                lines.append(f"# TYPE xllm_http_{key} {kind}")
+            for plane, st in plane_stats:
+                lines.append(
+                    f'xllm_http_{key}{{plane="{plane}"}} {st[key]}'
+                )
+        lines.append("# TYPE xllm_instance_waiting_requests gauge")
         for name, m in sorted(load.items()):
             lines.append(
                 f'xllm_instance_waiting_requests{{instance="{name}"}} '
@@ -314,7 +345,7 @@ class Master:
         h.end_headers()
         h.wfile.write(body)
 
-    def handle_client_post(self, h: QuietHandler) -> None:
+    def handle_client_post(self, h: HttpJsonApi) -> None:
         route = h.route
         if route == "/v1/completions":
             self._serve_generation(h, chat=False)
@@ -329,7 +360,7 @@ class Master:
         else:
             h.send_error_json(404, f"no route {route}")
 
-    def _serve_embeddings(self, h: QuietHandler) -> None:
+    def _serve_embeddings(self, h: HttpJsonApi) -> None:
         body = h.read_json()
         if body is None:
             h.send_error_json(400, "invalid JSON body")
@@ -432,7 +463,7 @@ class Master:
             req.logprobs = int(lp) if lp is not None else None
         return req
 
-    def _serve_generation(self, h: QuietHandler, chat: bool) -> None:
+    def _serve_generation(self, h: HttpJsonApi, chat: bool) -> None:
         xrid = h.x_request_id()
         xh = {"x-request-id": xrid} if xrid else None
         body = h.read_json()
@@ -579,16 +610,18 @@ class Master:
             self.scheduler.park_offline(req, dispatch)
         else:
             dispatch()
-        # Hold the exchange open until the scheduler finishes it.
-        if not stream.done.wait(self._request_timeout_s):
+
+        # Hold the exchange open until the scheduler finishes it. The
+        # threaded backend blocks this handler thread; the event backend
+        # parks the exchange on the connection and returns, enforcing the
+        # deadline with a loop timer — a stream holds a socket, not a
+        # thread.
+        def fail_deadline() -> None:
             self.scheduler.fail_request(
                 req.service_request_id, StatusCode.DEADLINE_EXCEEDED, "timeout"
             )
-            if not stream.done.wait(5.0):
-                # The lane never ran: drop the exchange without a response
-                # and make sure no late write can reach a reused socket.
-                stream.abandon()
-                h.close_connection = True
+
+        h.hold(stream, self._request_timeout_s, fail_deadline)
 
     def _cancel_on_instance(self, req: ServiceRequest) -> None:
         for name in {req.routing.prefill_name, req.routing.decode_name}:
@@ -609,7 +642,7 @@ class Master:
     # instance plane
     # ------------------------------------------------------------------ #
 
-    def handle_rpc_get(self, h: QuietHandler) -> None:
+    def handle_rpc_get(self, h: HttpJsonApi) -> None:
         route = h.route
         mgr = self.scheduler.instance_mgr
         if route == "/rpc/instance_info":
@@ -626,7 +659,7 @@ class Master:
         else:
             h.send_error_json(404, f"no route {route}")
 
-    def handle_rpc_post(self, h: QuietHandler) -> None:
+    def handle_rpc_post(self, h: HttpJsonApi) -> None:
         route = h.route
         body = h.read_json()
         if body is None:
@@ -645,7 +678,7 @@ class Master:
         else:
             h.send_error_json(404, f"no route {route}")
 
-    def _handle_register(self, h: QuietHandler, body: Dict[str, Any]) -> None:
+    def _handle_register(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
         try:
             meta = InstanceMetaInfo.from_json(body.get("meta", body))
         except Exception as e:
@@ -672,7 +705,7 @@ class Master:
             }
         )
 
-    def _handle_deregister(self, h: QuietHandler, body: Dict[str, Any]) -> None:
+    def _handle_deregister(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
         """Graceful shutdown: revoke the instance's registration lease NOW
         (DELETE event -> registry drop -> routing stops immediately),
         instead of leaving a dead endpoint routable until the TTL lapses.
@@ -687,7 +720,7 @@ class Master:
             self._store.revoke_lease(lease)
         h.send_json({"ok": True, "removed": lease is not None})
 
-    def _handle_heartbeat(self, h: QuietHandler, body: Dict[str, Any]) -> None:
+    def _handle_heartbeat(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
         name = body.get("name", "")
         with self._leases_mu:
             lease = self._leases.get(name)
@@ -723,7 +756,7 @@ class Master:
             self.scheduler.instance_mgr.requeue_flip(name, 1)
         h.send_json({"ok": True})
 
-    def _handle_generations(self, h: QuietHandler, body: Dict[str, Any]) -> None:
+    def _handle_generations(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
         cont: Dict[str, bool] = {}
         for j in body.get("gens", []):
             try:

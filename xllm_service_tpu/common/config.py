@@ -29,6 +29,22 @@ class ServiceConfig:
     max_concurrency: int = 128
     num_ordered_output_streams: int = 128  # reference: scheduler.h:112
 
+    # HTTP front-end backend: "event" = evserve selectors/epoll loop (SSE
+    # streams hold sockets, not threads — the >1k-concurrent-streams path);
+    # "threaded" = stdlib ThreadingHTTPServer (thread per connection).
+    http_backend: str = "event"
+    http_workers: int = 32  # event backend: route-handler pool size
+    http_max_connections: int = 4096  # accept cap; extras are refused
+    http_idle_timeout_s: float = 120.0  # keep-alive idle reap (<=0 disables)
+    http_drain_timeout_s: float = 5.0  # stop(): grace for in-flight streams
+    # Slow-client guard: per-connection SSE outbox cap. A client that falls
+    # a full buffer behind its generation is dropped and the request
+    # cancelled upstream, instead of buffering without bound.
+    sse_max_buffered_kb: int = 512
+    # Event backend request-body cap (413 past it). Must clear the largest
+    # legitimate body — base64 multimodal parts run to ~100 MB of video.
+    http_max_body_mb: int = 256
+
     # Coordination backend. "memory://" selects the in-process store;
     # "etcd://host:port" an external etcd (reference: --etcd_addr).
     etcd_addr: str = "memory://"
@@ -221,3 +237,9 @@ class EngineConfig:
     # Instance identity/role.
     instance_name: str = ""
     instance_type: str = "MIX"  # DEFAULT | PREFILL | DECODE | MIX | ENCODE
+
+    # Instance HTTP front door backend ("threaded" | "event"); the service
+    # tier's equivalent knob is ServiceConfig.http_backend. Threaded stays
+    # the default here: direct-mode streaming handlers block their worker,
+    # so the event loop's pool would cap direct-mode concurrency.
+    http_backend: str = "threaded"

@@ -49,7 +49,11 @@ def _ring_attention_local(
     B, Lc, Hq, D = q.shape
     Hkv = k.shape[2]
     G = Hq // Hkv
-    n = jax.lax.axis_size(axis_name)
+    n = (
+        jax.lax.axis_size(axis_name)
+        if hasattr(jax.lax, "axis_size")
+        else jax.lax.psum(1, axis_name)  # jax < 0.5 spelling
+    )
     me = jax.lax.axis_index(axis_name)
 
     qf = q.astype(jnp.float32).reshape(B, Lc, Hkv, G, D)
@@ -118,16 +122,22 @@ def ring_attention(
     if scale is None:
         scale = q.shape[-1] ** -0.5
     spec = P(None, sp_axis, tp_axis, None)
-    fn = jax.shard_map(
-        functools.partial(
-            _ring_attention_local,
-            axis_name=sp_axis,
-            scale=scale,
-            causal=causal,
-        ),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        check_vma=False,
+    local = functools.partial(
+        _ring_attention_local,
+        axis_name=sp_axis,
+        scale=scale,
+        causal=causal,
     )
+    if hasattr(jax, "shard_map"):
+        fn = jax.shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
+        )
+    else:  # jax < 0.6: the API (and the check_vma knob, née check_rep)
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        fn = _shard_map(
+            local, mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_rep=False,
+        )
     return fn(q, k, v)

@@ -61,6 +61,7 @@ def _apply_local_layers(lp_local, cfg: ModelConfig, x: jnp.ndarray,
     same dense layer body as llama.hidden_dense."""
     from xllm_service_tpu.models.llama import _mlp, _qkv
     from xllm_service_tpu.ops.norms import rms_norm
+    from xllm_service_tpu.ops.quant import wt
 
     scale = cfg.head_dim**-0.5
     Lq = x.shape[1]
@@ -84,10 +85,12 @@ def _apply_local_layers(lp_local, cfg: ModelConfig, x: jnp.ndarray,
             return attn.reshape(Lq, Hq * D).astype(x.dtype)
 
         attn = jax.vmap(one_seq)(h)
+        # wt() dequantizes int8/int4 leaves at the use site (and is the
+        # identity on plain arrays) — same contract as llama.hidden_dense.
+        wo = wt(lp["wo"])
         x = x + jnp.einsum(
             "ble,ef->blf", attn,
-            lp["wo"].astype(attn.dtype)
-            if lp["wo"].dtype != attn.dtype else lp["wo"],
+            wo.astype(attn.dtype) if wo.dtype != attn.dtype else wo,
         )
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + jax.vmap(lambda hx: _mlp(lp, cfg, hx))(h)
@@ -173,16 +176,21 @@ def pipeline_forward_dense(
         params["embed"] if cfg.tie_word_embeddings else params["lm_head"]
     )
     rep = P()
-    fn = jax.shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(
-            jax.tree.map(lambda _: P(pp_axis), params["layers"]),
-            rep, rep, rep, rep,
-        ),
-        out_specs=rep,
-        check_vma=False,
+    in_specs = (
+        jax.tree.map(lambda _: P(pp_axis), params["layers"]),
+        rep, rep, rep, rep,
     )
+    if hasattr(jax, "shard_map"):
+        fn = jax.shard_map(
+            local, mesh=mesh, in_specs=in_specs, out_specs=rep,
+            check_vma=False,
+        )
+    else:  # jax < 0.6: the API (and the check_vma knob, née check_rep)
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        fn = _shard_map(
+            local, mesh, in_specs=in_specs, out_specs=rep, check_rep=False
+        )
     return fn(
         params["layers"], params["embed"], params["final_norm"], head,
         token_ids,

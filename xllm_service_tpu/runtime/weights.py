@@ -1483,8 +1483,22 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, path: str) -> None:
         # Inverse of _hf_rope_scaling — lets the HF-parity tests load the
         # same rope-scaled geometry through transformers.
         rs: Dict[str, Any] = {"rope_type": cfg.rope_scaling_type}
-        if cfg.rope_scaling_type in ("linear", "dynamic", "llama3"):
+        if cfg.rope_scaling_type in ("linear", "dynamic", "llama3", "yarn"):
             rs["factor"] = cfg.rope_scaling_factor
+        if cfg.rope_scaling_type == "yarn":
+            rs["beta_fast"] = cfg.rope_beta_fast
+            rs["beta_slow"] = cfg.rope_beta_slow
+            rs["truncate"] = cfg.rope_scaling_truncate
+            if cfg.rope_mscale:
+                rs["mscale"] = cfg.rope_mscale
+            if cfg.rope_mscale_all_dim:
+                rs["mscale_all_dim"] = cfg.rope_mscale_all_dim
+            if cfg.rope_attention_factor:
+                rs["attention_factor"] = cfg.rope_attention_factor
+            if cfg.rope_original_max_position:
+                rs["original_max_position_embeddings"] = (
+                    cfg.rope_original_max_position
+                )
         if cfg.rope_scaling_type == "llama3":
             rs["low_freq_factor"] = cfg.rope_low_freq_factor
             rs["high_freq_factor"] = cfg.rope_high_freq_factor
