@@ -356,6 +356,28 @@ def main() -> None:
     wall = time.monotonic() - t_start
     redispatches = master.scheduler.total_redispatches
     pd_flips = master.scheduler.instance_mgr.total_flips
+
+    # Service-tier latency distributions from the obs histograms (the
+    # same series the master's /metrics exports): bucket-interpolated
+    # percentiles, cross-checkable against the client-side measurements
+    # above.
+    def hist_pcts(name):
+        h = master.scheduler.metrics.get(name)
+        if h is None:
+            return None
+        return {
+            f"p{q}": (
+                round(v, 3) if (v := h.percentile(q)) is not None else None
+            )
+            for q in (50, 90, 99)
+        }
+
+    service_hists = {
+        "ttft_ms": hist_pcts("xllm_service_ttft_ms"),
+        "tpot_ms": hist_pcts("xllm_service_tpot_ms"),
+        "e2e_ms": hist_pcts("xllm_service_e2e_ms"),
+        "queue_delay_ms": hist_pcts("xllm_service_queue_delay_ms"),
+    }
     cached = sum(
         getattr(srv.engine, "prefix_cached_tokens", 0) for srv in instances
     )
@@ -415,6 +437,7 @@ def main() -> None:
                 "req_p99_s": pct(lats, 99),
                 "killed_instance_at_s": killed_at_s,
                 "redispatches": redispatches,
+                "service_histograms": service_hists,
                 "error_sample": errors[0][:200] if errors else None,
                 "shared_prefix_tokens": args.shared_prefix or None,
                 "prefix_cache_hit_rate": prefix_hit_rate,

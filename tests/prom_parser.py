@@ -1,0 +1,183 @@
+"""Strict Prometheus text-format parser for validating /metrics surfaces.
+
+Deliberately independent of xllm_service_tpu.obs (the code under test):
+this is the SCRAPER'S view of the exposition. It enforces what a strict
+scraper enforces and the repo has been bitten by before (master.py's
+grouped-TYPE hazard):
+
+  * at most one `# TYPE` line per metric family;
+  * every family's samples contiguous under its TYPE line (no ungrouped
+    series — a family's sample after another family started is an error);
+  * sample lines syntactically valid, values parseable as floats;
+  * histogram families expose _bucket (with le labels, cumulative,
+    ending at +Inf) plus _sum and _count per label set.
+
+Raises PromFormatError with a line-numbered message on violation.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from collections import OrderedDict
+from typing import Dict, List, Tuple
+
+
+class PromFormatError(AssertionError):
+    pass
+
+
+SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(-?(?:\d+\.?\d*(?:[eE][+-]?\d+)?|NaN|[+-]?Inf))\s*$"
+)
+LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+class Family:
+    def __init__(self, name: str, kind: str):
+        self.name = name
+        self.kind = kind
+        # [(sample_name, labels_dict, float_value)]
+        self.samples: List[Tuple[str, Dict[str, str], float]] = []
+
+    def values(self, **label_filter) -> List[float]:
+        out = []
+        for _, labels, v in self.samples:
+            if all(labels.get(k) == str(w) for k, w in label_filter.items()):
+                out.append(v)
+        return out
+
+
+def _family_for_sample(name: str, families: Dict[str, Family]) -> str:
+    for suffix in _HIST_SUFFIXES:
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            fam = families.get(base)
+            if fam is not None and fam.kind == "histogram":
+                return base
+    return name
+
+
+def parse_metrics(text: str) -> "OrderedDict[str, Family]":
+    families: "OrderedDict[str, Family]" = OrderedDict()
+    current: str = ""
+    closed: set = set()  # families whose sample run has ended
+    for lineno, raw in enumerate(text.splitlines(), start=1):
+        line = raw.rstrip("\n")
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4:
+                raise PromFormatError(f"line {lineno}: malformed TYPE line")
+            _, _, name, kind = parts
+            if name in families:
+                raise PromFormatError(
+                    f"line {lineno}: duplicate # TYPE for {name}"
+                )
+            if kind not in ("counter", "gauge", "histogram", "summary",
+                            "untyped"):
+                raise PromFormatError(
+                    f"line {lineno}: unknown kind {kind!r}"
+                )
+            if current and current != name:
+                closed.add(current)
+            families[name] = Family(name, kind)
+            current = name
+            continue
+        if line.startswith("#"):
+            continue  # HELP / comments
+        m = SAMPLE_RE.match(line)
+        if not m:
+            raise PromFormatError(f"line {lineno}: unparseable sample {line!r}")
+        sample_name, labels_raw, value = m.groups()
+        fam_name = _family_for_sample(sample_name, families)
+        fam = families.get(fam_name)
+        if fam is None:
+            # untyped stray series: tolerated by Prometheus, but every
+            # xllm surface declares its families — treat as a violation.
+            raise PromFormatError(
+                f"line {lineno}: sample {sample_name} has no TYPE line"
+            )
+        if fam_name in closed:
+            raise PromFormatError(
+                f"line {lineno}: ungrouped series — {sample_name} appears "
+                f"after family {fam_name} was closed by a later TYPE line"
+            )
+        if current != fam_name:
+            closed.add(current)
+            current = fam_name
+        labels = dict(LABEL_RE.findall(labels_raw or ""))
+        fam.samples.append((sample_name, labels, float(value)))
+    _validate(families)
+    return families
+
+
+def _labels_key(labels: Dict[str, str]) -> Tuple:
+    return tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+
+
+def _validate(families: "OrderedDict[str, Family]") -> None:
+    for fam in families.values():
+        if fam.kind == "counter":
+            if not fam.name.endswith("_total"):
+                raise PromFormatError(
+                    f"counter {fam.name} does not end in _total"
+                )
+            for sample_name, _, v in fam.samples:
+                if v < 0:
+                    raise PromFormatError(
+                        f"counter {fam.name} has negative sample {v}"
+                    )
+        if fam.kind == "histogram":
+            buckets: Dict[Tuple, List[Tuple[float, float]]] = {}
+            sums: set = set()
+            counts: Dict[Tuple, float] = {}
+            for sample_name, labels, v in fam.samples:
+                key = _labels_key(labels)
+                if sample_name == fam.name + "_bucket":
+                    le = labels.get("le")
+                    if le is None:
+                        raise PromFormatError(
+                            f"{fam.name}_bucket sample without le label"
+                        )
+                    bound = math.inf if le == "+Inf" else float(le)
+                    buckets.setdefault(key, []).append((bound, v))
+                elif sample_name == fam.name + "_sum":
+                    sums.add(key)
+                elif sample_name == fam.name + "_count":
+                    counts[key] = v
+                else:
+                    raise PromFormatError(
+                        f"histogram {fam.name} has stray sample "
+                        f"{sample_name}"
+                    )
+            if not buckets:
+                raise PromFormatError(
+                    f"histogram {fam.name} has no _bucket samples"
+                )
+            for key, bs in buckets.items():
+                if key not in sums or key not in counts:
+                    raise PromFormatError(
+                        f"histogram {fam.name}{dict(key)} missing "
+                        "_sum/_count"
+                    )
+                ordered = sorted(bs)
+                if not math.isinf(ordered[-1][0]):
+                    raise PromFormatError(
+                        f"histogram {fam.name}{dict(key)} missing +Inf "
+                        "bucket"
+                    )
+                cum = [v for _, v in ordered]
+                if any(b > a for a, b in zip(cum[1:], cum)):
+                    raise PromFormatError(
+                        f"histogram {fam.name}{dict(key)} buckets not "
+                        "cumulative"
+                    )
+                if cum[-1] != counts[key]:
+                    raise PromFormatError(
+                        f"histogram {fam.name}{dict(key)} +Inf bucket "
+                        f"{cum[-1]} != _count {counts[key]}"
+                    )

@@ -30,10 +30,18 @@ from typing import Callable, Deque, Dict, List, Optional, Set
 from xllm_service_tpu.api.evserve.connection import Connection
 from xllm_service_tpu.api.evserve.handler import EvHandler
 from xllm_service_tpu.api.evserve.parser import HttpRequest
+from xllm_service_tpu.obs import MetricsRegistry
 
 logger = logging.getLogger(__name__)
 
 _IDLE_SWEEP_S = 1.0
+
+# Loop-lag buckets (ms): the event loop's per-wakeup busy time is usually
+# sub-millisecond — a fatter tail here means handlers or flushes are
+# stalling every stream the loop carries.
+_LOOP_LAG_BUCKETS_MS = (
+    0.05, 0.1, 0.2, 0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500, 1000,
+)
 
 
 class TimerHandle:
@@ -118,6 +126,17 @@ class EventLoopHttpServer:
         self._requests_total = 0
         self._slow_client_closes = 0
         self._active_streams = 0
+
+        # Per-plane registry (the master merges it under a plane label):
+        # the loop-lag histogram is the event backend's health signal —
+        # one loop thread carries every stream, so its busy time per
+        # wakeup bounds how stale every connection's IO can get.
+        self.metrics = MetricsRegistry()
+        self._m_loop_lag = self.metrics.histogram(
+            "xllm_http_loop_lag_ms",
+            "Event-loop busy time per wakeup (non-select work)",
+            buckets=_LOOP_LAG_BUCKETS_MS,
+        )
 
     # ------------------------------------------------------------------ #
     # lifecycle
@@ -338,6 +357,7 @@ class EventLoopHttpServer:
                 events = self._sel.select(self._next_timeout(now))
             except OSError:
                 events = []
+            busy_t0 = time.monotonic()
             for key, mask in events:
                 tag = key.data
                 if tag == "listen":
@@ -358,6 +378,7 @@ class EventLoopHttpServer:
             self._flush_dirty()
             self._fire_timers()
             now = time.monotonic()
+            self._m_loop_lag.observe((now - busy_t0) * 1000.0)
             if now - last_sweep >= _IDLE_SWEEP_S:
                 last_sweep = now
                 self._sweep_idle(now)

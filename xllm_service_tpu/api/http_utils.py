@@ -161,14 +161,34 @@ class SseWriter:
 
 class HttpServerThread:
     """One threaded HTTP server on its own accept thread (the reference runs
-    each brpc server on a dedicated thread, master.cpp:38-58)."""
+    each brpc server on a dedicated thread, master.cpp:38-58).
+
+    stats() reports the request/accept counters the event backend also
+    exposes, so the master's aggregated /metrics covers threaded planes
+    too instead of silently omitting them."""
 
     def __init__(self, host: str, port: int, handler_cls):
+        stats_mu = threading.Lock()
+
         class _Srv(ThreadingHTTPServer):
             daemon_threads = True
             allow_reuse_address = True
             request_queue_size = 128
+            accepted_total = 0
+            requests_total = 0
 
+            def get_request(inner):
+                req = super(_Srv, inner).get_request()
+                with stats_mu:
+                    _Srv.accepted_total += 1
+                return req
+
+            @staticmethod
+            def count_request() -> None:
+                with stats_mu:
+                    _Srv.requests_total += 1
+
+        self._srv_cls = _Srv
         self.server = _Srv((host, port), handler_cls)
         self.host, self.port = self.server.server_address[:2]
         self._thread = threading.Thread(
@@ -184,7 +204,11 @@ class HttpServerThread:
         self._thread.join(timeout=2.0)
 
     def stats(self) -> Dict[str, Any]:
-        return {"backend": "threaded"}
+        return {
+            "backend": "threaded",
+            "accepted_total": self._srv_cls.accepted_total,
+            "requests_total": self._srv_cls.requests_total,
+        }
 
 
 def make_http_server(
@@ -215,12 +239,14 @@ def make_http_server(
 
         class _Handler(QuietHandler):
             def do_GET(self):
+                self.server.count_request()
                 if do_get is None:
                     self.send_error_json(405, "method not allowed")
                 else:
                     do_get(self)
 
             def do_POST(self):
+                self.server.count_request()
                 if do_post is None:
                     self.send_error_json(405, "method not allowed")
                 else:

@@ -33,6 +33,11 @@ from xllm_service_tpu.common.types import (
     InstanceType,
     RequestOutput,
 )
+from xllm_service_tpu.obs import (
+    MetricsRegistry,
+    absorb_exposition,
+    render_families,
+)
 from xllm_service_tpu.service.response_handler import ResponseHandler
 from xllm_service_tpu.tokenizer import ChatTemplate, create_tokenizer
 from xllm_service_tpu.tokenizer.tokenizer import IncrementalDetokenizer
@@ -158,6 +163,56 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
         ttft, tpot = self.engine.profiling_data()
         self.meta.ttft_profiling_data = ttft
         self.meta.tpot_profiling_data = tpot
+
+        # Instance-front-door registry: heartbeat-visible load/latency as
+        # pull gauges (any engine, FakeEngine included) plus the
+        # speculative-decoding counters when the engine runs a verifier.
+        # /metrics renders this merged with the engine's OWN registry
+        # (runtime/engine.py step/preemption/prefix-cache series).
+        self.metrics = MetricsRegistry()
+        self.metrics.gauge(
+            "xllm_engine_waiting_requests", "Engine admission queue depth",
+        ).set_function(
+            lambda: self.engine.get_load_metrics().waiting_requests_num
+        )
+        self.metrics.gauge(
+            "xllm_engine_kv_cache_usage", "Fraction of KV blocks in use",
+        ).set_function(
+            lambda: self.engine.get_load_metrics().gpu_cache_usage_perc
+        )
+        self.metrics.gauge(
+            "xllm_engine_recent_max_ttft_ms",
+            "Max TTFT over the engine's recent window",
+        ).set_function(
+            lambda: self.engine.get_latency_metrics().recent_max_ttft
+        )
+        self.metrics.gauge(
+            "xllm_engine_recent_max_tbt_ms",
+            "Max time-between-tokens over the engine's recent window",
+        ).set_function(
+            lambda: self.engine.get_latency_metrics().recent_max_tbt
+        )
+        # Spec series only when this instance actually runs a verifier —
+        # a spec-off engine exporting a 0x "realized speedup" gauge would
+        # skew fleet dashboards (and FakeEngine has no spec at all).
+        if getattr(
+            getattr(self.engine, "cfg", None), "speculative_tokens", 0
+        ) > 0:
+            self.metrics.counter(
+                "xllm_engine_spec_verify_steps_total",
+                "Speculative verify steps run",
+            ).set_function(lambda: self.engine.spec_steps)
+            self.metrics.counter(
+                "xllm_engine_spec_tokens_emitted_total",
+                "Tokens emitted by speculative verify steps",
+            ).set_function(lambda: self.engine.spec_tokens_emitted)
+            self.metrics.gauge(
+                "xllm_engine_spec_tokens_per_slot_step",
+                "Realized speculative speedup over plain decode",
+            ).set_function(
+                lambda: self.engine.spec_tokens_emitted
+                / max(self.engine.spec_slot_steps, 1)
+            )
 
         self._master: Optional[MasterClient] = (
             MasterClient(master_rpc_addr) if master_rpc_addr else None
@@ -415,42 +470,25 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
     # HTTP surface
     # ------------------------------------------------------------------ #
 
-    def _spec_metrics(self) -> str:
-        """Speculative-decoding gauges (empty when the engine never ran a
-        verify step — FakeEngine and spec-off instances emit nothing)."""
-        steps = getattr(self.engine, "spec_steps", 0)
-        if not steps:
-            return ""
-        slot_steps = self.engine.spec_slot_steps
-        emitted = self.engine.spec_tokens_emitted
-        rate = emitted / max(slot_steps, 1)
-        return (
-            "# TYPE xllm_engine_spec_verify_steps_total counter\n"
-            f"xllm_engine_spec_verify_steps_total {steps}\n"
-            "# TYPE xllm_engine_spec_tokens_emitted_total counter\n"
-            f"xllm_engine_spec_tokens_emitted_total {emitted}\n"
-            "# TYPE xllm_engine_spec_tokens_per_slot_step gauge\n"
-            f"xllm_engine_spec_tokens_per_slot_step {rate:.4f}\n"
-        )
+    def _metrics_body(self) -> str:
+        """Instance exposition: the front-door registry merged with the
+        engine's own (runtime/engine.py registers its step/preemption/
+        prefix-cache/host-tier series there; FakeEngine has none)."""
+        from collections import OrderedDict
+
+        fams = OrderedDict()
+        absorb_exposition(fams, self.metrics.render())
+        engine_reg = getattr(self.engine, "metrics", None)
+        if engine_reg is not None and hasattr(engine_reg, "render"):
+            absorb_exposition(fams, engine_reg.render())
+        return render_families(fams)
 
     def handle_get(self, h: HttpJsonApi) -> None:
         route = h.route
         if route == "/hello":
             h.send_json({"message": f"hello from instance {self.name}"})
         elif route == "/metrics":
-            lm = self.engine.get_load_metrics()
-            lat = self.engine.get_latency_metrics()
-            body = (
-                "# TYPE xllm_engine_waiting_requests gauge\n"
-                f"xllm_engine_waiting_requests {lm.waiting_requests_num}\n"
-                "# TYPE xllm_engine_kv_cache_usage gauge\n"
-                f"xllm_engine_kv_cache_usage {lm.gpu_cache_usage_perc:.4f}\n"
-                "# TYPE xllm_engine_recent_max_ttft_ms gauge\n"
-                f"xllm_engine_recent_max_ttft_ms {lat.recent_max_ttft}\n"
-                "# TYPE xllm_engine_recent_max_tbt_ms gauge\n"
-                f"xllm_engine_recent_max_tbt_ms {lat.recent_max_tbt}\n"
-                + self._spec_metrics()
-            ).encode()
+            body = self._metrics_body().encode()
             h.send_response(200)
             h.send_header("Content-Type", "text/plain; version=0.0.4")
             h.send_header("Content-Length", str(len(body)))

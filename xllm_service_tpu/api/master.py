@@ -24,6 +24,7 @@ import json
 import logging
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, Optional
 
 from xllm_service_tpu.api.http_utils import (
@@ -50,6 +51,11 @@ from xllm_service_tpu.common.types import (
     StatusCode,
 )
 from xllm_service_tpu.coordination.store import CoordinationStore
+from xllm_service_tpu.obs import (
+    MetricsRegistry,
+    absorb_exposition,
+    render_families,
+)
 from xllm_service_tpu.service import (
     ClientStream,
     Scheduler,
@@ -186,6 +192,37 @@ class Master:
             name="master-rpc", **server_opts,
         )
 
+        # Cluster-level registry: fleet shape + fault accounting the
+        # aggregated /metrics adds on top of the scheduler's own series.
+        mgr = self.scheduler.instance_mgr
+        self.cluster_metrics = MetricsRegistry()
+        inst_gauge = self.cluster_metrics.gauge(
+            "xllm_cluster_instances",
+            "Registered instances by current serving role",
+            labelnames=("role",),
+        )
+        for i, role in enumerate(("prefill", "decode", "encode")):
+            inst_gauge.labels(role=role).set_function(
+                lambda i=i: mgr.counts()[i]
+            )
+        self.cluster_metrics.counter(
+            "xllm_cluster_pd_flips_total",
+            "Dynamic PREFILL<->DECODE role flips applied by the master",
+        ).set_function(lambda: mgr.total_flips)
+        self._m_scrape_failures = self.cluster_metrics.counter(
+            "xllm_cluster_scrape_failures_total",
+            "Instance /metrics scrapes that failed during aggregation",
+        )
+        # Long-lived scrape pool: its threads keep get_raw's thread-local
+        # keep-alive connections warm across scrape intervals (a per-call
+        # pool would pay thread start-up + a fresh TCP connect to every
+        # instance on every scrape).
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._scrape_pool = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="metrics-scrape"
+        )
+
         def notify_flip(name: str, attempt: int) -> None:
             # Role resolved at SEND time from the registry (not frozen at
             # event time): a delayed delivery racing a flip-back would
@@ -228,6 +265,7 @@ class Master:
         self.http.stop()
         self.rpc.stop()
         self.scheduler.stop()
+        self._scrape_pool.shutdown(wait=False)
 
     @property
     def http_address(self) -> str:
@@ -286,64 +324,95 @@ class Master:
             except Exception as e:
                 h.send_error_json(502, f"instance unreachable: {e}")
             return
-        mgr = self.scheduler.instance_mgr
-        load = mgr.get_load_metrics()
-        lines = [
-            "# TYPE xllm_service_inflight_requests gauge",
-            f"xllm_service_inflight_requests {self.scheduler.num_inflight}",
-            "# TYPE xllm_service_is_master gauge",
-            f"xllm_service_is_master {int(self.scheduler.is_master)}",
-            # fault handling: lifetime count of transparently replayed
-            # requests (instance death before first token)
-            "# TYPE xllm_service_redispatches_total counter",
-            f"xllm_service_redispatches_total "
-            f"{self.scheduler.total_redispatches}",
-        ]
-        # Front-end gauges (event backend only: the threaded backend has no
-        # loop to report — stats() returns just its backend tag). One TYPE
-        # line per metric with both planes' samples grouped under it — the
-        # Prometheus text parser rejects duplicate TYPE lines / ungrouped
-        # series, which would fail the whole scrape.
-        plane_stats = [
-            (plane, srv.stats())
-            for plane, srv in (("http", self.http), ("rpc", self.rpc))
-        ]
-        plane_stats = [
-            (p, st) for p, st in plane_stats if st.get("backend") == "event"
-        ]
-        for key, kind in (
-            ("open_connections", "gauge"),
-            ("active_streams", "gauge"),
-            ("buffered_bytes", "gauge"),
-            ("accepted_total", "counter"),
-            ("requests_total", "counter"),
-            ("slow_client_closes", "counter"),
-            ("rejected_connections", "counter"),
-        ):
-            if plane_stats:
-                lines.append(f"# TYPE xllm_http_{key} {kind}")
-            for plane, st in plane_stats:
-                lines.append(
-                    f'xllm_http_{key}{{plane="{plane}"}} {st[key]}'
-                )
-        lines.append("# TYPE xllm_instance_waiting_requests gauge")
-        for name, m in sorted(load.items()):
-            lines.append(
-                f'xllm_instance_waiting_requests{{instance="{name}"}} '
-                f"{m.waiting_requests_num}"
-            )
-        lines.append("# TYPE xllm_instance_kv_cache_usage gauge")
-        for name, m in sorted(load.items()):
-            lines.append(
-                f'xllm_instance_kv_cache_usage{{instance="{name}"}} '
-                f"{m.gpu_cache_usage_perc:.4f}"
-            )
-        body = ("\n".join(lines) + "\n").encode()
+        body = self._aggregate_metrics().encode()
         h.send_response(200)
         h.send_header("Content-Type", "text/plain; version=0.0.4")
         h.send_header("Content-Length", str(len(body)))
         h.end_headers()
         h.wfile.write(body)
+
+    def _aggregate_metrics(self) -> str:
+        """Cluster-wide exposition: master-local registries (scheduler +
+        cluster), per-plane HTTP front-end stats, per-instance load
+        gauges, and every registered instance's own /metrics scraped and
+        re-labelled under instance="...". One TYPE line per family with
+        every origin's samples grouped beneath it — the Prometheus text
+        parser rejects duplicate TYPE lines / ungrouped series, which
+        would fail the whole scrape."""
+        mgr = self.scheduler.instance_mgr
+        fams: "OrderedDict[str, Any]" = OrderedDict()
+        # Local registries go straight in as families — no render->parse
+        # round trip for data already in memory in the target shape.
+        fams.update(self.scheduler.metrics.families())
+        fams.update(self.cluster_metrics.families())
+        # Front-end planes: both backends report stats() now (the event
+        # loop's full set; the threaded backend's request/accept
+        # counters) — emit whichever keys each plane has.
+        plane_stats = [
+            (plane, srv.stats())
+            for plane, srv in (("http", self.http), ("rpc", self.rpc))
+        ]
+        for key, kind, metric in (
+            ("open_connections", "gauge", "xllm_http_open_connections"),
+            ("active_streams", "gauge", "xllm_http_active_streams"),
+            ("buffered_bytes", "gauge", "xllm_http_buffered_bytes"),
+            ("accepted_total", "counter", "xllm_http_accepted_total"),
+            ("requests_total", "counter", "xllm_http_requests_total"),
+            # stats() keys predate the naming convention; the exported
+            # counter names carry the mandatory _total suffix.
+            ("slow_client_closes", "counter",
+             "xllm_http_slow_client_closes_total"),
+            ("rejected_connections", "counter",
+             "xllm_http_rejected_connections_total"),
+        ):
+            samples = [
+                (f'{{plane="{plane}"}}', str(st[key]))
+                for plane, st in plane_stats
+                if key in st
+            ]
+            if samples:
+                fams[metric] = (kind, "", samples)
+        # Event-loop registries (loop-lag histogram), one per plane.
+        for plane, srv in (("http", self.http), ("rpc", self.rpc)):
+            reg = getattr(srv, "metrics", None)
+            if reg is not None:
+                absorb_exposition(
+                    fams, reg.render(), extra_labels={"plane": plane}
+                )
+        load = mgr.get_load_metrics()
+        fams["xllm_instance_waiting_requests"] = ("gauge", "", [
+            (f'{{instance="{name}"}}', str(m.waiting_requests_num))
+            for name, m in sorted(load.items())
+        ])
+        fams["xllm_instance_kv_cache_usage"] = ("gauge", "", [
+            (f'{{instance="{name}"}}', f"{m.gpu_cache_usage_perc:.4f}")
+            for name, m in sorted(load.items())
+        ])
+        # Scrape each instance's registry-rendered /metrics and merge its
+        # engine series under an instance label. Scrapes run CONCURRENTLY
+        # (a dead instance costs one 2 s timeout, not a serial stall that
+        # blows the scraper's own deadline on a large fleet); failures
+        # skip the instance (counted) — one dead engine must not fail the
+        # fleet scrape. The merge itself stays on this thread, in name
+        # order, so the exposition is deterministic.
+        instances = sorted(mgr.list_instances(), key=lambda m: m.name)
+
+        def scrape(meta):
+            status, raw, _ = get_raw(meta.http_address, "/metrics", timeout=2.0)
+            if status != 200:
+                raise RuntimeError(f"HTTP {status}")
+            return raw.decode("utf-8", "replace")
+
+        futures = [self._scrape_pool.submit(scrape, m) for m in instances]
+        for meta, fut in zip(instances, futures):
+            try:
+                absorb_exposition(
+                    fams, fut.result(timeout=10.0),
+                    extra_labels={"instance": meta.name},
+                )
+            except Exception:
+                self._m_scrape_failures.inc()
+        return render_families(fams)
 
     def handle_client_post(self, h: HttpJsonApi) -> None:
         route = h.route
@@ -600,7 +669,10 @@ class Master:
                         f"prefill unreachable: {e}",
                     )
 
-        self.scheduler.record_new_request(
+        # The scheduler wraps dispatch with span/queue-delay
+        # instrumentation; use its wrapper so re-dispatch and the first
+        # forward are timed identically.
+        dispatch = self.scheduler.record_new_request(
             req, stream,
             cancel_callback=lambda: self._cancel_on_instance(req),
             dispatch=dispatch,

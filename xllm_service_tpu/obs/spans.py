@@ -1,0 +1,161 @@
+"""Request-lifecycle spans: structured stage records -> timelines/traces.
+
+The tracer (service/request.py RequestTracer.stage) appends one JSONL
+record per stage transition:
+
+    {"type": "stage", "service_request_id": ..., "stage": ...,
+     "t_mono_ms": <monotonic ms>, "timestamp_ms": <wall ms>, ...fields}
+
+Stage vocabulary (SPAN_STAGES) follows the request path end to end:
+receive -> tokenize -> route -> dispatch -> first_token -> decode ticks ->
+finish (or cancel/error), with redispatch interleaved on fault replay.
+This module reconstructs per-request timelines from the JSONL and exports
+Chrome `trace_event` JSON (chrome://tracing / Perfetto "load trace"),
+giving the per-stage latency breakdown P/D-Serve (arXiv:2408.08147) argues
+disaggregated serving is tuned by.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import OrderedDict
+from typing import Any, Dict, Iterable, List, Tuple
+
+SPAN_STAGES = (
+    "receive",
+    "tokenize",
+    "route",
+    "dispatch",
+    "redispatch",
+    "first_token",
+    "decode",
+    "finish",
+    "cancel",
+    "error",
+)
+
+# Terminal stages close a request's timeline.
+TERMINAL_STAGES = frozenset(("finish", "cancel", "error"))
+
+
+def load_spans(path: str) -> List[Dict[str, Any]]:
+    """Stage records from a tracer JSONL file (non-stage records — the
+    raw in/out payload traces — are skipped)."""
+    records: List[Dict[str, Any]] = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("type") == "stage":
+                records.append(rec)
+    return records
+
+
+def build_timeline(
+    records: Iterable[Dict[str, Any]],
+) -> "OrderedDict[str, List[Dict[str, Any]]]":
+    """service_request_id -> stage records in RECORDED order.
+
+    Raises ValueError if any request's records go backwards in time — the
+    tracer stamps a single process monotonic clock and appends under one
+    lock, so a regression means a corrupted or hand-interleaved trace
+    file. The records are deliberately NOT re-sorted: sorting would mask
+    exactly the corruption this check exists to surface."""
+    by_req: "OrderedDict[str, List[Dict[str, Any]]]" = OrderedDict()
+    for rec in records:
+        srid = rec.get("service_request_id", "")
+        by_req.setdefault(srid, []).append(rec)
+    for srid, recs in by_req.items():
+        prev = None
+        for r in recs:
+            t = float(r.get("t_mono_ms", 0.0))
+            if prev is not None and t < prev:
+                raise ValueError(
+                    f"{srid}: non-monotonic stage timestamps "
+                    f"({t} after {prev})"
+                )
+            prev = t
+    return by_req
+
+
+def stage_durations_ms(
+    timeline: List[Dict[str, Any]],
+) -> List[Tuple[str, float]]:
+    """[(stage, ms-until-next-stage)] for one request's ordered records;
+    the terminal record gets duration 0."""
+    out: List[Tuple[str, float]] = []
+    for i, rec in enumerate(timeline):
+        t = float(rec.get("t_mono_ms", 0.0))
+        if i + 1 < len(timeline):
+            dur = float(timeline[i + 1].get("t_mono_ms", 0.0)) - t
+        else:
+            dur = 0.0
+        out.append((str(rec.get("stage", "")), dur))
+    return out
+
+
+_META_KEYS = ("type", "service_request_id", "stage", "t_mono_ms",
+              "timestamp_ms")
+
+
+def to_chrome_trace(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Chrome trace_event JSON: per request, each stage becomes a complete
+    ("X") slice lasting until the next stage; the terminal stage is an
+    instant ("i"). Requests map to tids so the trace viewer stacks them as
+    parallel tracks. Extra record fields ride in args."""
+    by_req = build_timeline(records)
+    events: List[Dict[str, Any]] = []
+    for tid, (srid, recs) in enumerate(by_req.items(), start=1):
+        events.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": 1,
+                "tid": tid,
+                "args": {"name": srid},
+            }
+        )
+        for i, rec in enumerate(recs):
+            ts_us = float(rec.get("t_mono_ms", 0.0)) * 1000.0
+            args = {k: v for k, v in rec.items() if k not in _META_KEYS}
+            stage = str(rec.get("stage", ""))
+            if i + 1 < len(recs):
+                dur_us = (
+                    float(recs[i + 1].get("t_mono_ms", 0.0)) * 1000.0 - ts_us
+                )
+                events.append(
+                    {
+                        "name": stage,
+                        "cat": "request",
+                        "ph": "X",
+                        "ts": ts_us,
+                        "dur": max(dur_us, 0.0),
+                        "pid": 1,
+                        "tid": tid,
+                        "args": args,
+                    }
+                )
+            else:
+                events.append(
+                    {
+                        "name": stage,
+                        "cat": "request",
+                        "ph": "i",
+                        "s": "t",
+                        "ts": ts_us,
+                        "pid": 1,
+                        "tid": tid,
+                        "args": args,
+                    }
+                )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(records: Iterable[Dict[str, Any]], path: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(to_chrome_trace(records), f)

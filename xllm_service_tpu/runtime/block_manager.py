@@ -66,6 +66,9 @@ class BlockManager:
         # those become offload_cache['dram'] deltas instead of
         # removed_cache (reference proto:47).
         self.on_evict = None
+        # Lifetime eviction count (engine-thread only, like the rest of
+        # the class) — exported as xllm_engine_block_evictions_total.
+        self.evictions_total = 0
 
     # ------------------------------------------------------------------ util
 
@@ -93,6 +96,7 @@ class BlockManager:
     def _evict_batch(self, victims: List[int]) -> None:
         """Un-commit a batch of LRU victims, offering their content to the
         host tier in ONE hook call (one bulk device->host copy)."""
+        self.evictions_total += len(victims)
         hashed = [
             (b, self._blocks[b].hash)
             for b in victims

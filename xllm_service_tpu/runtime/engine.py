@@ -39,6 +39,11 @@ from xllm_service_tpu.common.types import (
     StatusCode,
     Usage,
 )
+from xllm_service_tpu.obs import (
+    BATCH_BUCKETS,
+    LATENCY_BUCKETS_MS,
+    MetricsRegistry,
+)
 from xllm_service_tpu.ops.sampling import SamplingParams
 from xllm_service_tpu.runtime.block_manager import BlockManager, OutOfBlocksError
 from xllm_service_tpu.runtime.executor import ModelExecutor, SamplingBatch
@@ -259,6 +264,82 @@ class InferenceEngine:
         # Prefix-cache effectiveness over fresh admissions (bench/metrics).
         self.prefix_cached_tokens = 0
         self.prefix_prompt_tokens = 0
+        # Recompute-preemption accounting (any cause: pool pressure,
+        # hybrid-scheduling eviction).
+        self.preemptions = 0
+        self._build_metrics()
+
+    def _build_metrics(self) -> None:
+        """Engine registry (obs.metrics), rendered into the instance's
+        /metrics and scraped by the master under an instance label. Hot
+        paths observe histograms directly; everything already counted by
+        an attribute (preemptions, prefix-cache, block manager, host
+        tiers) exports via pull functions so the step loop pays nothing
+        extra."""
+        self.metrics = MetricsRegistry()
+        self._m_ttft = self.metrics.histogram(
+            "xllm_engine_ttft_ms", "Prefill time to first token",
+            buckets=LATENCY_BUCKETS_MS,
+        )
+        self._m_tbt = self.metrics.histogram(
+            "xllm_engine_tbt_ms", "Time between tokens per running "
+            "sequence", buckets=LATENCY_BUCKETS_MS,
+        )
+        self._m_batch = self.metrics.histogram(
+            "xllm_engine_decode_batch_size",
+            "Active sequences per decode step (batch occupancy)",
+            buckets=BATCH_BUCKETS,
+        )
+        self._m_steps = self.metrics.counter(
+            "xllm_engine_decode_steps_total", "Decode (or verify) steps "
+            "executed",
+        )
+        self.metrics.counter(
+            "xllm_engine_preemptions_total",
+            "Recompute-style preemptions (pool pressure + hybrid "
+            "eviction)",
+        ).set_function(lambda: self.preemptions)
+        self.metrics.counter(
+            "xllm_engine_prefix_cached_tokens_total",
+            "Prompt tokens served from the prefix cache at admission",
+        ).set_function(lambda: self.prefix_cached_tokens)
+        self.metrics.counter(
+            "xllm_engine_prefix_prompt_tokens_total",
+            "Prompt tokens eligible for prefix-cache matching",
+        ).set_function(lambda: self.prefix_prompt_tokens)
+        # NO waiting-depth / KV-usage gauges here: the instance front door
+        # already exports those via get_load_metrics (they would duplicate
+        # xllm_engine_waiting_requests / xllm_engine_kv_cache_usage in the
+        # same merged exposition).
+        self.metrics.gauge(
+            "xllm_engine_running_requests", "Sequences holding decode "
+            "slots",
+        ).set_function(lambda: len(self._running))
+        self.metrics.counter(
+            "xllm_engine_block_evictions_total",
+            "Committed blocks evicted from the device pool",
+        ).set_function(lambda: getattr(self.block_mgr, "evictions_total", 0))
+        self.metrics.counter(
+            "xllm_engine_host_cache_hits_total",
+            "Host (DRAM) tier prefix-block hits",
+        ).set_function(
+            lambda: getattr(self.host_pool, "hits", 0)
+            if self.host_pool is not None else 0
+        )
+        self.metrics.counter(
+            "xllm_engine_host_cache_misses_total",
+            "Host (DRAM) tier lookups that missed",
+        ).set_function(
+            lambda: getattr(self.host_pool, "misses", 0)
+            if self.host_pool is not None else 0
+        )
+        self.metrics.counter(
+            "xllm_engine_host_cache_evictions_total",
+            "Blocks LRU-evicted from the host (DRAM) tier",
+        ).set_function(
+            lambda: getattr(self.host_pool, "evictions", 0)
+            if self.host_pool is not None else 0
+        )
 
     # -------------------------------------------------------------- public
 
@@ -755,6 +836,7 @@ class InferenceEngine:
         TTFT windows + profiling curve, block commit, first token, running
         insert, emit, and the prefill-only handoff."""
         self._ttft_window.append((now, ms))
+        self._m_ttft.observe(ms)
         self._profile_ttft.append((profiled_len, ms))
         seq.prefill_done_time = seq.last_token_time = now
         self._commit_full_blocks(seq)
@@ -1167,13 +1249,17 @@ class InferenceEngine:
         nactive = int(active.sum())
         total_ctx = int(positions[active].sum()) + nactive
         self._profile_tpot.append((nactive, total_ctx, step_ms))
+        self._m_batch.observe(nactive)
+        self._m_steps.inc()
 
         produced = 0
         now = time.monotonic()
         for slot in list(self._running.keys()):
             seq = self._running[slot]
             tok, lp = int(tokens[slot]), float(logprobs[slot])
-            self._tbt_window.append((now, (now - seq.last_token_time) * 1000))
+            tbt_ms = (now - seq.last_token_time) * 1000
+            self._tbt_window.append((now, tbt_ms))
+            self._m_tbt.observe(tbt_ms)
             seq.last_token_time = now
             seq.generated.append((tok, lp))
             seq.tokens.append(tok)
@@ -1673,6 +1759,8 @@ class InferenceEngine:
         nactive = int(active.sum())
         total_ctx = int(positions[active].sum()) + nactive
         self._profile_tpot.append((nactive, total_ctx, step_ms))
+        self._m_batch.observe(nactive)
+        self._m_steps.inc()
         self.spec_steps += 1
         self.spec_slot_steps += nactive
         self.spec_tokens_emitted += int(n_emit[active].sum())
@@ -1681,7 +1769,9 @@ class InferenceEngine:
         now = time.monotonic()
         for slot in list(self._running.keys()):
             seq = self._running[slot]
-            self._tbt_window.append((now, (now - seq.last_token_time) * 1000))
+            tbt_ms = (now - seq.last_token_time) * 1000
+            self._tbt_window.append((now, tbt_ms))
+            self._m_tbt.observe(tbt_ms)
             seq.last_token_time = now
             for i in range(int(n_emit[slot])):
                 tok, lp = int(tokens[slot, i]), float(logprobs[slot, i])
@@ -1727,6 +1817,7 @@ class InferenceEngine:
         Offline victims of online pressure requeue at the BACK
         (requeue_front=False) so the admission partition keeps online
         work ahead of them."""
+        self.preemptions += 1
         self.block_mgr.free(seq.block_ids)
         seq.block_ids = []
         seq.last_committed_block = -1

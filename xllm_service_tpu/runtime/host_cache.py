@@ -34,6 +34,11 @@ class HostKVPool:
             raise ValueError("HostKVPool needs capacity > 0")
         self.capacity = capacity_blocks
         self._data: "OrderedDict[bytes, np.ndarray]" = OrderedDict()
+        # Observability counters (engine-thread only, like the pool):
+        # exported as xllm_engine_host_cache_{hits,misses,evictions}_total.
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
 
     def __len__(self) -> int:
         return len(self._data)
@@ -44,7 +49,10 @@ class HostKVPool:
     def get(self, block_hash: bytes) -> Optional[np.ndarray]:
         kv = self._data.get(block_hash)
         if kv is not None:
+            self.hits += 1
             self._data.move_to_end(block_hash)
+        else:
+            self.misses += 1
         return kv
 
     def put(
@@ -58,6 +66,7 @@ class HostKVPool:
             return evicted
         while len(self._data) >= self.capacity:
             h, arr = self._data.popitem(last=False)
+            self.evictions += 1
             evicted.append((h, arr))
         self._data[block_hash] = np.ascontiguousarray(kv)
         return evicted

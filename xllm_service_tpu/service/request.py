@@ -77,45 +77,132 @@ class ServiceRequest:
 class RequestTracer:
     """Mutex-guarded JSONL appender (reference: request_tracer.cpp:38-62
     opens trace/trace.json and appends {timestamp, service_request_id,
-    payload} per streamed write)."""
+    payload} per streamed write), extended with:
 
-    def __init__(self, trace_dir: str = "trace", enabled: bool = False):
+      * structured `stage` records — the request-lifecycle spans consumed
+        by obs.spans (receive -> tokenize -> route -> dispatch ->
+        first_token -> decode ticks -> finish/cancel/redispatch), each
+        stamped with one process monotonic clock so per-stage durations
+        subtract exactly;
+      * size-based rotation (trace.jsonl -> trace.jsonl.1) so a long-lived
+        service never grows the trace without bound;
+      * a drop counter instead of unbounded error growth: a failed disk
+        write increments `dropped` and the record is lost, never buffered.
+    """
+
+    def __init__(
+        self,
+        trace_dir: str = "trace",
+        enabled: bool = False,
+        max_bytes: int = 64 * 1024 * 1024,
+    ):
         self._enabled = enabled
         self._mu = threading.Lock()
         self._fh = None
+        self._path = os.path.join(trace_dir, "trace.jsonl")
+        self._max_bytes = max(int(max_bytes), 1)
+        self._size = 0
+        self.dropped = 0  # records lost to write failures / closed tracer
         if enabled:
             os.makedirs(trace_dir, exist_ok=True)
-            self._fh = open(
-                os.path.join(trace_dir, "trace.jsonl"), "a", encoding="utf-8"
-            )
+            self._fh = open(self._path, "a", encoding="utf-8")
+            try:
+                self._size = os.path.getsize(self._path)
+            except OSError:
+                self._size = 0
 
     @property
     def enabled(self) -> bool:
         return self._enabled
 
-    def record(self, service_request_id: str, direction: str, payload: Any) -> None:
-        if not self._enabled or self._fh is None:
-            return
-        entry = {
-            "timestamp_ms": int(time.time() * 1000),
-            "service_request_id": service_request_id,
-            "direction": direction,
-            "payload": payload,
-        }
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def _rotate_locked(self) -> None:
+        """One-deep rotation under self._mu: the previous generation is
+        overwritten — bounded disk, newest window always intact."""
+        try:
+            self._fh.close()
+            os.replace(self._path, self._path + ".1")
+            self._fh = open(self._path, "a", encoding="utf-8")
+            self._size = 0
+        except OSError:
+            # Rotation failed (e.g. the .1 target is unwritable): TRUNCATE
+            # the live file instead of appending on — the disk bound is
+            # the hard guarantee; the lost window is the trade. A doomed
+            # rotation must also not be re-attempted on every write.
+            self._size = 0
+            try:
+                self._fh = open(self._path, "w", encoding="utf-8")
+            except OSError:
+                self._fh = None
+
+    def _write_entry(self, entry: Dict[str, Any]) -> None:
         line = json.dumps(entry, ensure_ascii=False, default=str)
         with self._mu:
-            self._fh.write(line + "\n")
-            self._fh.flush()
+            if self._fh is None:
+                self.dropped += 1
+                return
+            try:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+            except (OSError, ValueError):
+                self.dropped += 1
+                return
+            # Bytes, not characters: ensure_ascii=False means multi-byte
+            # text would otherwise under-count 3-4x against max_bytes.
+            self._size += len(line.encode("utf-8")) + 1
+            if self._size >= self._max_bytes:
+                self._rotate_locked()
+
+    def record(self, service_request_id: str, direction: str, payload: Any) -> None:
+        if not self._enabled:
+            return
+        self._write_entry(
+            {
+                "timestamp_ms": int(time.time() * 1000),
+                "service_request_id": service_request_id,
+                "direction": direction,
+                "payload": payload,
+            }
+        )
+
+    def stage(self, service_request_id: str, stage: str, **fields: Any) -> None:
+        """One request-lifecycle span record (obs.spans schema)."""
+        if not self._enabled:
+            return
+        entry = {
+            "type": "stage",
+            "timestamp_ms": int(time.time() * 1000),
+            "t_mono_ms": time.monotonic() * 1000.0,
+            "service_request_id": service_request_id,
+            "stage": stage,
+        }
+        entry.update(fields)
+        self._write_entry(entry)
 
     def bind(self, service_request_id: str) -> Callable[[str, Any], None]:
         return lambda direction, payload: self.record(
             service_request_id, direction, payload
         )
 
+    def flush(self) -> None:
+        with self._mu:
+            if self._fh is not None:
+                try:
+                    self._fh.flush()
+                except (OSError, ValueError):
+                    self.dropped += 1
+
     def close(self) -> None:
         with self._mu:
             if self._fh is not None:
-                self._fh.close()
+                try:
+                    self._fh.flush()
+                    self._fh.close()
+                except (OSError, ValueError):
+                    pass
                 self._fh = None
 
 

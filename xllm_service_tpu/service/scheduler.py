@@ -48,6 +48,7 @@ from xllm_service_tpu.common.types import (
 )
 from xllm_service_tpu.coordination.election import MasterElection
 from xllm_service_tpu.coordination.store import CoordinationStore, connect
+from xllm_service_tpu.obs import LATENCY_BUCKETS_MS, MetricsRegistry
 from xllm_service_tpu.service.ordered_streams import OrderedStreams
 from xllm_service_tpu.service.request import (
     RequestTracer,
@@ -80,6 +81,15 @@ class _RequestState:
     redispatch_count: int = 0
     first_chunk_sent: bool = False
     prefill_finished: bool = False
+    # Observability timestamps (one monotonic clock): registration,
+    # first dispatch, first token, and the latest token delivery.
+    sched_mono: float = 0.0
+    dispatch_mono: float = 0.0
+    first_token_mono: float = 0.0
+    last_token_mono: float = 0.0
+    # Error-finish marker (fail_request): finish_request reports the
+    # outcome as "error" instead of "cancelled".
+    failed: bool = False
     # Per-sequence stop-string matchers (OpenAI `stop`), lazily created.
     stop_monitors: Dict[int, "StopStringMonitor"] = field(default_factory=dict)
     # Generated tokens dropped by stop truncation (subtracted from usage).
@@ -105,6 +115,58 @@ class Scheduler:
         # Installed by the Master: transport for role-flip notifications
         # ((instance_name, new_role) -> POST instance /flip).
         self.on_role_flip = None
+
+        # Service-tier metrics registry (obs.metrics): the master's
+        # /metrics renders this alongside the HTTP-plane registries and
+        # the scraped per-instance expositions.
+        self.metrics = MetricsRegistry()
+        self._m_requests = self.metrics.counter(
+            "xllm_service_requests_total",
+            "Requests accepted by schedule()", labelnames=("kind",),
+        )
+        self._m_finished = self.metrics.counter(
+            "xllm_service_finished_total",
+            "Requests finished by outcome", labelnames=("outcome",),
+        )
+        self.metrics.counter(
+            "xllm_service_redispatches_total",
+            "Requests transparently replayed after instance death",
+        ).set_function(lambda: self.total_redispatches)
+        self._m_ttft = self.metrics.histogram(
+            "xllm_service_ttft_ms",
+            "Client-perceived time to first token (schedule -> first "
+            "delivery)", buckets=LATENCY_BUCKETS_MS,
+        )
+        self._m_tpot = self.metrics.histogram(
+            "xllm_service_tpot_ms",
+            "Inter-delivery gap after the first token",
+            buckets=LATENCY_BUCKETS_MS,
+        )
+        self._m_queue_delay = self.metrics.histogram(
+            "xllm_service_queue_delay_ms",
+            "Schedule -> first dispatch to an instance (offline parking "
+            "included)", buckets=LATENCY_BUCKETS_MS,
+        )
+        self._m_e2e = self.metrics.histogram(
+            "xllm_service_e2e_ms",
+            "Schedule -> terminal bookkeeping", buckets=LATENCY_BUCKETS_MS,
+        )
+        self.metrics.gauge(
+            "xllm_service_inflight_requests", "Registered, unfinished "
+            "requests",
+        ).set_function(lambda: self.num_inflight)
+        self.metrics.gauge(
+            "xllm_service_is_master", "1 when this replica holds the "
+            "master lease",
+        ).set_function(lambda: int(self._election.is_master))
+        self.metrics.gauge(
+            "xllm_service_offline_parked_requests", "Offline requests "
+            "parked under cluster pressure",
+        ).set_function(lambda: len(self._offline_parked))
+        self.metrics.counter(
+            "xllm_service_trace_dropped_total", "Trace records lost to "
+            "disk-write failures",
+        ).set_function(lambda: self._tracer.dropped)
 
         self._election = MasterElection(
             self._store,
@@ -252,6 +314,12 @@ class Scheduler:
     def schedule(self, request: ServiceRequest) -> Status:
         """Template -> tokenize -> route (reference: scheduler.cpp:73-106).
         Fills request.token_ids, request.routing, request.estimated_ttft_ms."""
+        if self._tracer.enabled:
+            self._tracer.stage(
+                request.service_request_id, "receive",
+                kind="chat" if request.is_chat else "completion",
+                stream=request.stream, offline=request.offline,
+            )
         if request.is_chat and not request.prompt:
             try:
                 request.prompt = self._chat_template.apply(
@@ -268,6 +336,11 @@ class Scheduler:
             request.token_ids = self._tokenizer.encode(request.prompt)
         if not request.token_ids:
             return Status(StatusCode.INVALID_ARGUMENT, "prompt tokenized to nothing")
+        if self._tracer.enabled:
+            self._tracer.stage(
+                request.service_request_id, "tokenize",
+                prompt_tokens=len(request.token_ids),
+            )
 
         request.routing = self._policy.select_instances_pair(request.token_ids)
         if not request.routing.prefill_name and not request.routing.decode_name:
@@ -294,6 +367,15 @@ class Scheduler:
         self._instance_mgr.update_request_metrics(
             request.routing, RequestAction.SCHEDULE, len(request.token_ids)
         )
+        if self._tracer.enabled:
+            self._tracer.stage(
+                request.service_request_id, "route",
+                prefill=request.routing.prefill_name,
+                decode=request.routing.decode_name,
+            )
+        self._m_requests.labels(
+            kind="chat" if request.is_chat else "completion"
+        ).inc()
         return Status(StatusCode.OK)
 
     _MM_MARKERS = ("<|image|>", "<|video|>", "<|audio|>")
@@ -592,9 +674,12 @@ class Scheduler:
         stream: ClientStream,
         cancel_callback: Optional[Callable[[], None]] = None,
         dispatch: Optional[Callable[[], None]] = None,
-    ) -> None:
+    ) -> Optional[Callable[[], None]]:
         """Register the response route for a scheduled request
-        (reference: scheduler.cpp:171-266)."""
+        (reference: scheduler.cpp:171-266). Returns the dispatch callable
+        the caller should invoke: it wraps the one passed in with span +
+        queue-delay instrumentation, and re-dispatch reuses the same
+        wrapper so every forward attempt is timed."""
         if self._tracer.enabled:
             request.trace_callback = self._tracer.bind(request.service_request_id)
             request.trace(
@@ -611,10 +696,30 @@ class Scheduler:
             stream=stream,
             lane=self._streams.assign(),
             cancel_callback=cancel_callback,
-            dispatch=dispatch,
+            sched_mono=time.monotonic(),
         )
+
+        if dispatch is not None:
+            def dispatch_instrumented() -> None:
+                now = time.monotonic()
+                first = state.dispatch_mono == 0.0
+                if first:
+                    state.dispatch_mono = now
+                    self._m_queue_delay.observe(
+                        (now - state.sched_mono) * 1000.0
+                    )
+                if self._tracer.enabled:
+                    self._tracer.stage(
+                        request.service_request_id, "dispatch",
+                        prefill=request.routing.prefill_name,
+                        attempt=state.redispatch_count + 1,
+                    )
+                dispatch()
+
+            state.dispatch = dispatch_instrumented
         with self._mu:
             self._requests[request.service_request_id] = state
+        return state.dispatch
 
     # ------------------------------------------------------------------ #
     # token hot path
@@ -648,6 +753,28 @@ class Scheduler:
                 )
         new_tokens = sum(len(seq.token_ids) for seq in output.outputs)
         if new_tokens:
+            now = time.monotonic()
+            if state.first_token_mono == 0.0:
+                state.first_token_mono = now
+                self._m_ttft.observe((now - state.sched_mono) * 1000.0)
+                if self._tracer.enabled:
+                    self._tracer.stage(
+                        request.service_request_id, "first_token",
+                        ttft_ms=round((now - state.sched_mono) * 1000.0, 3),
+                    )
+            else:
+                # Per-TOKEN time: a delivery may carry several tokens
+                # (speculative decode, RPC-batched chunks) — observing the
+                # raw gap would read k x the client-perceived TPOT.
+                self._m_tpot.observe(
+                    (now - state.last_token_mono) * 1000.0 / new_tokens
+                )
+                if self._tracer.enabled:
+                    self._tracer.stage(
+                        request.service_request_id, "decode",
+                        n_tokens=new_tokens,
+                    )
+            state.last_token_mono = now
             request.num_generated_tokens += new_tokens
             if not state.prefill_finished:
                 state.prefill_finished = True
@@ -805,6 +932,24 @@ class Scheduler:
         self._instance_mgr.update_request_metrics(
             request.routing, action, len(request.token_ids)
         )
+        now = time.monotonic()
+        if state.sched_mono:
+            self._m_e2e.observe((now - state.sched_mono) * 1000.0)
+        outcome = (
+            "error" if state.failed
+            else "cancelled" if cancelled
+            else "ok"
+        )
+        self._m_finished.labels(outcome=outcome).inc()
+        if self._tracer.enabled:
+            terminal = {"ok": "finish", "error": "error"}.get(
+                outcome, "cancel"
+            )
+            self._tracer.stage(
+                service_request_id, terminal,
+                outcome=outcome,
+                generated_tokens=request.num_generated_tokens,
+            )
 
     def fail_request(self, service_request_id: str, code: StatusCode, msg: str) -> None:
         """Error-finish from the API tier (e.g. prefill POST failed —
@@ -813,6 +958,11 @@ class Scheduler:
             state = self._requests.get(service_request_id)
         if state is None:
             return
+        if self._tracer.enabled:
+            self._tracer.stage(
+                service_request_id, "error", code=int(code), message=msg
+            )
+        state.failed = True  # finish_request reports outcome="error"
         self._streams.submit(
             state.lane,
             lambda: (
@@ -903,6 +1053,11 @@ class Scheduler:
         # the removal watch and the prune loop race here.
         with self._mu:
             self.total_redispatches += 1
+        if self._tracer.enabled:
+            self._tracer.stage(
+                service_request_id, "redispatch",
+                excluded=exclude, prefill=routing.prefill_name,
+            )
         return True
 
     # ------------------------------------------------------------------ #
