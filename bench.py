@@ -93,9 +93,16 @@ _GUARD_LOADAVG_CEILING = float(os.environ.get("XLLM_BENCH_GUARD_LOAD", 1.0))
 _GUARD_MIN_CPUS = int(os.environ.get("XLLM_BENCH_GUARD_MIN_CPUS", 4))
 
 
+# Overlapped-engine A/B guard: the overlapped (default) engine must not
+# land below this fraction of the sync escape hatch's throughput in the
+# same run — the pipeline paying MORE than it hides is a regression.
+_OVERLAP_MIN_RATIO = float(os.environ.get("XLLM_BENCH_OVERLAP_MIN_RATIO", 0.92))
+
+
 def _cpu_regression_guard(line: str) -> "tuple[str, int]":
-    """Apply the >5% clean-load CPU decode regression guard to the result
-    line. Returns (annotated line, exit code) — nonzero means regression."""
+    """Apply the >5% clean-load CPU decode regression guard — and the
+    overlap-vs-sync engine A/B guard — to the result line. Returns
+    (annotated line, exit code); nonzero means regression."""
     if os.environ.get("XLLM_BENCH_NO_REGRESSION_GUARD"):
         return line, 0
     try:
@@ -119,15 +126,37 @@ def _cpu_regression_guard(line: str) -> "tuple[str, int]":
     if load > _GUARD_LOADAVG_CEILING:
         res["cpu_regression_guard"] = f"abstained: loadavg {load:.1f}"
         return json.dumps(res), 0
+    rc = 0
     if value >= 0.95 * _BEST_CPU_DECODE_TOK_S:
         res["cpu_regression_guard"] = "ok"
-        return json.dumps(res), 0
-    res["cpu_regression_guard"] = (
-        f"FAIL: {value:.1f} tok/s is "
-        f"{100.0 * (1.0 - value / _BEST_CPU_DECODE_TOK_S):.1f}% below the "
-        f"best recorded clean-load CPU figure {_BEST_CPU_DECODE_TOK_S:.1f}"
-    )
-    return json.dumps(res), 3
+    else:
+        res["cpu_regression_guard"] = (
+            f"FAIL: {value:.1f} tok/s is "
+            f"{100.0 * (1.0 - value / _BEST_CPU_DECODE_TOK_S):.1f}% below "
+            f"the best recorded clean-load CPU figure "
+            f"{_BEST_CPU_DECODE_TOK_S:.1f}"
+        )
+        rc = 3
+    # Engine-level A/B (runs against the overlapped DEFAULT mode): present
+    # only when the run measured both modes.
+    eb = res.get("engine_bench") or {}
+    if isinstance(eb, dict) and "sync" in eb and "overlap" in eb:
+        try:
+            s = float(eb["sync"]["tok_s"])
+            o = float(eb["overlap"]["tok_s"])
+        except (KeyError, TypeError, ValueError):
+            s = o = 0.0
+        if s <= 0:
+            pass
+        elif o >= _OVERLAP_MIN_RATIO * s:
+            res["engine_overlap_guard"] = "ok"
+        else:
+            res["engine_overlap_guard"] = (
+                f"FAIL: overlapped engine {o:.1f} tok/s is below "
+                f"{100 * _OVERLAP_MIN_RATIO:.0f}% of sync mode {s:.1f}"
+            )
+            rc = rc or 3
+    return json.dumps(res), rc
 
 
 def main() -> None:
@@ -141,6 +170,17 @@ def main() -> None:
             _force_cpu_platform(1)
         _run(on_tpu, **cfg)
         return
+
+    # --engine-mode {sync,overlap,both}: which InferenceEngine stepping
+    # mode(s) the engine-level A/B section measures (docs/ENGINE_PIPELINE.md).
+    # Default "both" reports the A/B pair and arms the overlap guard.
+    engine_mode = "both"
+    if "--engine-mode" in sys.argv:
+        engine_mode = sys.argv[sys.argv.index("--engine-mode") + 1]
+        if engine_mode not in ("sync", "overlap", "both"):
+            raise SystemExit(
+                f"--engine-mode must be sync|overlap|both, got {engine_mode!r}"
+            )
 
     backend = _probe_backend()
     on_tpu = backend == "tpu"
@@ -161,7 +201,9 @@ def main() -> None:
     )
     last_err = None
     for attempt in attempts:
-        rc, out, err = _run_attempt_subprocess(dict(attempt, _on_tpu=on_tpu))
+        rc, out, err = _run_attempt_subprocess(
+            dict(attempt, engine_mode=engine_mode, _on_tpu=on_tpu)
+        )
         line = ""
         for ln in out.splitlines():
             if ln.startswith("{"):
@@ -186,9 +228,91 @@ def main() -> None:
     raise SystemExit(f"all bench configs failed: {last_err}")
 
 
+def _engine_bench(sync: bool) -> dict:
+    """Full-InferenceEngine decode throughput (llama3-tiny, R=8) in one
+    stepping mode: R seeded requests driven to completion through the real
+    admission/decode/emit path. Reports tokens/s plus the pipeline
+    instruments — mean host_gap_ms (host bookkeeping between steps) and the
+    fraction of decode steps dispatched with another step in flight."""
+    import numpy as np
+
+    from xllm_service_tpu.common.config import EngineConfig
+    from xllm_service_tpu.ops.sampling import SamplingParams
+    from xllm_service_tpu.runtime.engine import EngineRequest, InferenceEngine
+    from xllm_service_tpu.runtime.executor import ModelExecutor
+
+    R, prompt_len, new_tokens = 8, 32, 48
+    cfg = EngineConfig(
+        model="llama3-tiny",
+        dtype="float32",
+        block_size=16,
+        num_blocks=64,
+        max_running_requests=R,
+        max_seq_len=256,
+        prefill_buckets=[32, 64, 128, 256],
+        sync_engine=sync,
+    )
+    eng = InferenceEngine(cfg, executor=ModelExecutor(cfg))
+    rng = np.random.default_rng(0)
+    prompts = [
+        rng.integers(0, eng.executor.cfg.vocab_size, (prompt_len,)).tolist()
+        for _ in range(R)
+    ]
+
+    def run_once(tag):
+        emitted = [0]
+
+        def cb(out):
+            for so in out.outputs:
+                emitted[0] += len(so.token_ids)
+            return True
+
+        t0 = time.perf_counter()
+        for i, p in enumerate(prompts):
+            eng.add_request(EngineRequest(
+                request_id=f"{tag}-{i}",
+                prompt_token_ids=list(p),
+                sampling=SamplingParams(
+                    temperature=0.7, seed=i + 1, max_new_tokens=new_tokens,
+                ),
+                callback=cb,
+            ))
+        while eng.has_work():
+            eng.step()
+        return emitted[0], time.perf_counter() - t0
+
+    run_once("warm")  # compile every shape outside the timing
+    repeats = int(os.environ.get("XLLM_BENCH_ENGINE_REPEATS", 3))
+    gap0, gsteps0 = eng.host_gap_ms_sum, eng.host_gap_steps
+    ov0, disp0 = eng.overlap_steps, eng.decode_dispatches
+    disc0 = eng.late_stop_discards
+    dts, toks = [], 0
+    for r in range(repeats):
+        n, dt = run_once(f"t{r}")
+        toks = n
+        dts.append(dt)
+    dt = float(np.median(dts))
+    gap_steps = max(eng.host_gap_steps - gsteps0, 1)
+    dispatches = max(eng.decode_dispatches - disp0, 1)
+    return {
+        "mode": "sync" if sync else "overlap",
+        "tok_s": round(toks / dt, 1),
+        "host_gap_ms_mean": round(
+            (eng.host_gap_ms_sum - gap0) / gap_steps, 3
+        ),
+        "overlap_step_frac": round(
+            (eng.overlap_steps - ov0) / dispatches, 3
+        ),
+        "late_stop_discards": eng.late_stop_discards - disc0,
+        "requests": R,
+        "new_tokens": new_tokens,
+    }
+
+
 def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
          use_kernel: bool | None = None,
-         weight_dtype: str = "auto") -> None:
+         weight_dtype: str = "auto",
+         engine_mode: str = "both") -> None:
     import jax
 
     from xllm_service_tpu.common.config import EngineConfig
@@ -406,6 +530,20 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
         # decode scan (the jit dispatch cache is separate from the AOT
         # path) — not worth default bench time for a reference-only
         # field.
+        # Engine-level A/B: the full InferenceEngine loop in sync vs
+        # overlapped stepping (CPU tiny-model only — through the TPU dev
+        # tunnel each engine.step pays ~100 ms of dispatch latency, which
+        # would measure the tunnel, not the pipeline).
+        engine_bench = None
+        if not on_tpu and not os.environ.get("XLLM_BENCH_SKIP_ENGINE_AB"):
+            engine_bench = {}
+            modes = (
+                ("sync", "overlap") if engine_mode == "both"
+                else (engine_mode,)
+            )
+            for m in modes:
+                engine_bench[m] = _engine_bench(sync=(m == "sync"))
+
         xla_cost = None
         if os.environ.get("XLLM_BENCH_XLA_COST"):
             try:
@@ -450,6 +588,12 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
                 {"flops": xla_cost[0], "bytes": xla_cost[1]}
                 if xla_cost else None
             ),
+            # Full-engine stepping-mode A/B (llama3-tiny, R=8): decode
+            # tokens/s, host_gap_ms, and overlap depth per mode — the
+            # overlapped (default) engine must not lose to the sync
+            # escape hatch (engine_overlap_guard enforces it).
+            "engine_bench": engine_bench,
+            "engine_mode": engine_mode,
             # Methodology markers: median of N repeats, the per-repeat
             # spread, and the host's 1-min load average around the run —
             # a hot host shows up here instead of masquerading as a

@@ -1,0 +1,340 @@
+"""Overlapped decode pipeline (docs/ENGINE_PIPELINE.md): seeded
+differential proof that the one-step-lookahead engine emits BYTE-IDENTICAL
+token streams to the sync_engine=True escape hatch across plain decode,
+guided decode, mid-stream cancel, and preemption — plus a race-stress
+invariant fuzz in the tests/test_race_stress.py style. Both engines build
+from the same init_seed, so any stream divergence is a pipeline bug, not
+weight noise."""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from xllm_service_tpu.common.config import EngineConfig
+from xllm_service_tpu.ops.sampling import SamplingParams
+from xllm_service_tpu.runtime.engine import EngineRequest, InferenceEngine
+from xllm_service_tpu.runtime.executor import ModelExecutor
+
+
+def _cfg(sync, **kw):
+    base = dict(
+        model="llama3-tiny",
+        dtype="float32",
+        block_size=16,
+        num_blocks=64,
+        max_running_requests=4,
+        max_seq_len=256,
+        prefill_buckets=[32, 64, 128, 256],
+        sync_engine=sync,
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _mk(sync, eos=(), **kw):
+    cfg = _cfg(sync, **kw)
+    return InferenceEngine(
+        cfg, executor=ModelExecutor(cfg, init_seed=0), eos_token_ids=eos
+    )
+
+
+class C:
+    """Stream collector; reject_after=N returns False from the callback
+    after N tokens (the deterministic mid-stream cancel path)."""
+
+    def __init__(self, reject_after=None):
+        self.tokens = []
+        self.done = False
+        self.cancelled = False
+        self.reject_after = reject_after
+
+    def __call__(self, out):
+        for so in out.outputs:
+            self.tokens.extend(so.token_ids)
+        if out.finished:
+            self.done = True
+            self.cancelled = bool(out.cancelled)
+            return True
+        if (
+            self.reject_after is not None
+            and len(self.tokens) >= self.reject_after
+        ):
+            return False
+        return True
+
+
+def _drive(eng, max_steps=3000):
+    for _ in range(max_steps):
+        if not eng.has_work():
+            break
+        eng.step()
+    assert not eng.has_work()
+    assert eng._inflight is None  # pipeline fully drained
+
+
+def _add_mixed(eng, tag=""):
+    """Deterministic mixed workload: greedy + seeded-sampled + penalties +
+    logit_bias + min_p, varying lengths, with a staggered second wave."""
+    rng = np.random.RandomState(42)
+    cols = {}
+    specs = [
+        ("greedy", SamplingParams(temperature=0.0, max_new_tokens=9), 23),
+        ("sampled", SamplingParams(
+            temperature=0.9, top_k=20, seed=7, max_new_tokens=12,
+        ), 37),
+        ("penalized", SamplingParams(
+            temperature=0.8, seed=11, max_new_tokens=10,
+            presence_penalty=0.5, frequency_penalty=0.3,
+        ), 17),
+        ("biased", SamplingParams(
+            temperature=0.0, max_new_tokens=7,
+            logit_bias=((5, 4.0), (9, -2.0)), min_p=0.05,
+        ), 29),
+    ]
+    for name, sp, plen in specs:
+        c = C()
+        cols[name] = c
+        eng.add_request(EngineRequest(
+            f"{tag}{name}", list(rng.randint(0, 500, size=plen)), sp, c,
+        ))
+    for _ in range(3):  # second wave lands mid-decode, deterministically
+        eng.step()
+    c = C()
+    cols["late"] = c
+    eng.add_request(EngineRequest(
+        f"{tag}late", list(rng.randint(0, 500, size=31)),
+        SamplingParams(temperature=0.7, seed=3, max_new_tokens=8), c,
+    ))
+    return cols
+
+
+def test_overlap_matches_sync_plain():
+    out = {}
+    for sync in (True, False):
+        eng = _mk(sync)
+        cols = _add_mixed(eng)
+        _drive(eng)
+        assert all(c.done for c in cols.values())
+        out[sync] = {k: c.tokens for k, c in cols.items()}
+        if not sync:
+            # the pipeline actually engaged: steps dispatched while the
+            # previous step was still in flight
+            assert eng.overlap_steps > 0
+            assert eng.host_gap_steps > 0
+    assert out[True] == out[False]
+
+
+def test_overlap_matches_sync_guided():
+    from xllm_service_tpu.guided import json_fsm
+    from xllm_service_tpu.tokenizer import ByteTokenizer
+
+    out = {}
+    for sync in (True, False):
+        eng = _mk(sync, eos=(2,))
+        tok = ByteTokenizer()
+        tb = tok.token_bytes_table(eng.executor.cfg.vocab_size)
+        eng.set_guided_context(json_fsm.token_mask_table(tb, [2]), tb,
+                               eos_ids=[2])
+        cols = {}
+        rng = np.random.RandomState(5)
+        for i, guided in enumerate([None, "json", "json", None]):
+            c = C()
+            cols[i] = c
+            eng.add_request(EngineRequest(
+                f"g{i}", list(rng.randint(1, 500, size=11 + 3 * i)),
+                SamplingParams(
+                    temperature=0.8 if i % 2 else 0.0, seed=i,
+                    max_new_tokens=10,
+                ),
+                c, guided=guided,
+            ))
+        _drive(eng)
+        assert all(c.done for c in cols.values())
+        out[sync] = {k: c.tokens for k, c in cols.items()}
+    assert out[True] == out[False]
+
+
+def test_overlap_matches_sync_cancel():
+    out = {}
+    for sync in (True, False):
+        eng = _mk(sync)
+        rng = np.random.RandomState(9)
+        keep, cancelled = C(), C(reject_after=3)
+        eng.add_request(EngineRequest(
+            "keep", list(rng.randint(0, 500, size=21)),
+            SamplingParams(temperature=0.0, max_new_tokens=10), keep,
+        ))
+        eng.add_request(EngineRequest(
+            "cxl", list(rng.randint(0, 500, size=19)),
+            SamplingParams(temperature=0.6, seed=4, max_new_tokens=40),
+            cancelled,
+        ))
+        _drive(eng)
+        assert keep.done and cancelled.done and cancelled.cancelled
+        out[sync] = (keep.tokens, cancelled.tokens)
+    assert out[True] == out[False]
+
+
+def test_overlap_matches_sync_preemption():
+    out = {}
+    for sync in (True, False):
+        # Tiny pool forces recompute-preemption mid-decode.
+        eng = _mk(sync, num_blocks=8, max_running_requests=2,
+                  max_seq_len=96)
+        rng = np.random.RandomState(4)
+        cols = [C(), C()]
+        for i, c in enumerate(cols):
+            eng.add_request(EngineRequest(
+                f"pr{i}", list(rng.randint(0, 500, size=20)),
+                SamplingParams(temperature=0.0, max_new_tokens=40), c,
+            ))
+        _drive(eng)
+        assert all(c.done for c in cols)
+        assert eng.preemptions > 0  # the path under test actually ran
+        out[sync] = [c.tokens for c in cols]
+        assert all(len(t) == 40 for t in out[sync])
+    assert out[True] == out[False]
+
+
+def test_one_step_late_stop_discards_exactly_the_extra_token():
+    """A token-dependent stop (stop_token_ids) is discovered one step late
+    in overlap mode: the stream still ends exactly at the stop token and
+    the single over-produced in-flight sample is counted as discarded."""
+    rng = np.random.RandomState(2)
+    prompt = list(rng.randint(0, 500, size=23))
+
+    eng = _mk(True)
+    probe = C()
+    eng.add_request(EngineRequest(
+        "probe", prompt, SamplingParams(temperature=0.0, max_new_tokens=8),
+        probe,
+    ))
+    _drive(eng)
+    stop_tok = probe.tokens[4]
+
+    out = {}
+    for sync in (True, False):
+        eng = _mk(sync)
+        c = C()
+        eng.add_request(EngineRequest(
+            "stopped", prompt,
+            SamplingParams(
+                temperature=0.0, max_new_tokens=50,
+                stop_token_ids=(stop_tok,),
+            ),
+            c,
+        ))
+        _drive(eng)
+        assert c.done
+        out[sync] = c.tokens
+        if not sync:
+            assert eng.late_stop_discards >= 1
+    assert out[True] == out[False]
+    assert out[False][-1] == stop_tok
+    assert len(out[False]) == 5
+
+
+def test_sync_escape_hatch_env(monkeypatch):
+    """XLLM_SYNC_ENGINE=1 forces sync stepping over a default config (and
+    =0 forces overlap over sync_engine=True)."""
+    monkeypatch.setenv("XLLM_SYNC_ENGINE", "1")
+    eng = _mk(False)
+    assert eng.sync_engine and eng._force_sync
+    monkeypatch.setenv("XLLM_SYNC_ENGINE", "0")
+    eng = _mk(True)
+    assert not eng.sync_engine and not eng._force_sync
+
+
+def test_async_engine_fuzz_invariants():
+    """tests/test_race_stress.py-style invariant fuzz against the
+    overlapped (default) engine: racing add/cancel/callback-rejection from
+    client threads, tight pool. After drain: every request terminal, all
+    block refcounts zero, all slots free, no in-flight step left."""
+    cfg = _cfg(False, num_blocks=48, max_running_requests=4,
+               max_seq_len=128, prefill_buckets=[32, 64, 128])
+    eng = InferenceEngine(cfg, executor=ModelExecutor(cfg, init_seed=7))
+    eng.start()
+    rng = random.Random(123)
+    np_rng = np.random.default_rng(123)
+    trackers = []
+
+    class T:
+        def __init__(self, rid, cancel_after=None):
+            self.rid = rid
+            self.lock = threading.Lock()
+            self.n = 0
+            self.terminal = None
+            self.post_terminal = 0
+            self.cancel_after = cancel_after
+            self.done = threading.Event()
+
+        def __call__(self, out):
+            with self.lock:
+                if self.terminal is not None:
+                    self.post_terminal += 1
+                    return False
+                for so in out.outputs:
+                    self.n += len(so.token_ids)
+                if out.finished:
+                    self.terminal = "done"
+                    self.done.set()
+                    return True
+                if self.cancel_after is not None and self.n >= self.cancel_after:
+                    eng.cancel(self.rid)
+            return True
+
+    try:
+        def client(base):
+            for i in range(8):
+                rid = f"af-c{base}-{i}"
+                kind = rng.random()
+                t = T(rid, 2 if kind < 0.25 else None)
+                trackers.append(t)
+                eng.add_request(EngineRequest(
+                    request_id=rid,
+                    prompt_token_ids=np_rng.integers(
+                        1, 500, (int(np_rng.integers(3, 90)),)
+                    ).tolist(),
+                    sampling=SamplingParams(
+                        temperature=rng.choice([0.0, 0.8]),
+                        seed=rng.randrange(2**31),
+                        max_new_tokens=int(np_rng.integers(1, 10)),
+                    ),
+                    callback=t,
+                ))
+                if kind > 0.85:
+                    time.sleep(rng.random() * 0.02)
+                    eng.cancel(rid)
+                time.sleep(rng.random() * 0.01)
+
+        threads = [
+            threading.Thread(target=client, args=(b,)) for b in range(3)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        deadline = time.monotonic() + 120
+        for t in trackers:
+            assert t.done.wait(max(0.1, deadline - time.monotonic())), (
+                f"request {t.rid} never reached a terminal state"
+            )
+        # Let the loop retire the trailing in-flight step.
+        deadline = time.monotonic() + 10
+        while eng.has_work() and time.monotonic() < deadline:
+            time.sleep(0.01)
+    finally:
+        eng.stop()
+
+    for t in trackers:
+        assert t.post_terminal == 0, t.rid
+    bm = eng.block_mgr
+    assert bm.num_referenced_blocks == 0
+    assert bm.num_free_blocks == bm.num_blocks - 1
+    assert not eng._running
+    assert len(eng._free_slots) == cfg.max_running_requests
+    assert not eng._waiting
+    assert eng._inflight is None

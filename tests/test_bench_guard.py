@@ -19,6 +19,7 @@ def _anchor(monkeypatch):
     monkeypatch.setattr(bench, "_BEST_CPU_DECODE_TOK_S", 4262.9)
     monkeypatch.setattr(bench, "_GUARD_LOADAVG_CEILING", 1.0)
     monkeypatch.setattr(bench, "_GUARD_MIN_CPUS", 1)
+    monkeypatch.setattr(bench, "_OVERLAP_MIN_RATIO", 0.92)
 
 
 def _line(**kw):
@@ -72,3 +73,47 @@ def test_env_kill_switch(monkeypatch):
 def test_non_json_line_passes_through():
     out, rc = bench._cpu_regression_guard("not json")
     assert (out, rc) == ("not json", 0)
+
+
+# ---- overlapped-engine A/B guard (runs against the overlapped default
+# mode; docs/ENGINE_PIPELINE.md) ----
+
+
+def _eb(sync_tok, overlap_tok):
+    return {
+        "sync": {"mode": "sync", "tok_s": sync_tok},
+        "overlap": {"mode": "overlap", "tok_s": overlap_tok},
+    }
+
+
+def test_overlap_at_parity_passes():
+    out, rc = bench._cpu_regression_guard(
+        _line(engine_bench=_eb(100.0, 99.0))
+    )
+    assert rc == 0
+    assert json.loads(out)["engine_overlap_guard"] == "ok"
+
+
+def test_overlap_regression_fails():
+    out, rc = bench._cpu_regression_guard(
+        _line(engine_bench=_eb(100.0, 80.0))
+    )
+    assert rc == 3
+    assert json.loads(out)["engine_overlap_guard"].startswith("FAIL")
+
+
+def test_overlap_guard_needs_both_modes():
+    # --engine-mode sync|overlap runs one mode: nothing to A/B.
+    out, rc = bench._cpu_regression_guard(
+        _line(engine_bench={"overlap": {"tok_s": 50.0}})
+    )
+    assert rc == 0
+    assert "engine_overlap_guard" not in json.loads(out)
+
+
+def test_overlap_guard_abstains_on_hot_host():
+    out, rc = bench._cpu_regression_guard(
+        _line(value=100.0, loadavg_1m=3.0, engine_bench=_eb(100.0, 10.0))
+    )
+    assert rc == 0
+    assert "engine_overlap_guard" not in json.loads(out)

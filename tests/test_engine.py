@@ -185,6 +185,58 @@ def test_oversized_request_rejected_not_stalled():
     assert small.outputs[-1].finished and len(small.tokens) == 3
 
 
+def test_sync_engine_config_escape_hatch(engine_and_oracle):
+    """sync_engine=True restores fully synchronous stepping (no in-flight
+    step ever) and emits the same greedy stream as the overlapped default
+    (which the rest of this module exercises)."""
+    _, oracle = engine_and_oracle
+    cfg = EngineConfig(
+        model="llama3-tiny",
+        dtype="float32",
+        block_size=16,
+        num_blocks=64,
+        max_running_requests=4,
+        max_seq_len=256,
+        prefill_buckets=[32, 64, 128, 256],
+        sync_engine=True,
+    )
+    eng = InferenceEngine(cfg, executor=ModelExecutor(cfg))
+    assert eng.sync_engine and eng._force_sync
+    rng = np.random.RandomState(0)
+    prompt = list(rng.randint(0, 500, size=23))
+    c = Collector()
+    eng.add_request(
+        EngineRequest(
+            "sync1", prompt,
+            SamplingParams(temperature=0.0, max_new_tokens=8), c,
+        )
+    )
+    run_to_completion(eng, [c])
+    assert c.tokens == oracle(prompt, 8)
+    assert eng.overlap_steps == 0 and eng._inflight is None
+
+
+def test_overlap_default_engages_pipeline(engine_and_oracle):
+    """The default engine runs the one-step-lookahead pipeline: decode
+    steps are dispatched while the previous step is still in flight."""
+    eng, oracle = engine_and_oracle
+    assert not eng.sync_engine
+    rng = np.random.RandomState(8)
+    prompt = list(rng.randint(0, 500, size=19))
+    c = Collector()
+    before = eng.overlap_steps
+    eng.add_request(
+        EngineRequest(
+            "ov1", prompt,
+            SamplingParams(temperature=0.0, max_new_tokens=8), c,
+        )
+    )
+    run_to_completion(eng, [c])
+    assert c.tokens == oracle(prompt, 8)
+    assert eng.overlap_steps > before
+    assert eng._inflight is None  # fully drained at idle
+
+
 def test_engine_thread_loop():
     eng, _ = make_engine()
     eng.start()

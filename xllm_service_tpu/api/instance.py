@@ -655,6 +655,11 @@ def main(argv=None) -> None:
         help="longest suffix n-gram the drafter matches",
     )
     parser.add_argument(
+        "--sync-engine", action="store_true",
+        help="disable the overlapped decode pipeline (fully synchronous "
+        "stepping; XLLM_SYNC_ENGINE=1|0 overrides either way)",
+    )
+    parser.add_argument(
         "--lora", action="append", default=[], metavar="NAME=PATH",
         help="register a peft-layout LoRA adapter served under model "
         "NAME (repeatable)",
@@ -689,6 +694,7 @@ def main(argv=None) -> None:
         compilation_cache_dir=args.compilation_cache_dir,
         speculative_tokens=args.speculative_tokens,
         speculative_ngram_max=args.speculative_ngram_max,
+        sync_engine=args.sync_engine,
     )
     lora = {}
     for spec in args.lora:

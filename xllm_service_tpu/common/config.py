@@ -174,6 +174,17 @@ class EngineConfig:
     # Sampling defaults.
     max_new_tokens_default: int = 512
 
+    # Engine stepping mode. False (default) = overlapped one-step-lookahead
+    # pipeline: decode step N+1 is dispatched while step N's sampled tokens
+    # are still in flight on the device (they feed step N+1's inputs
+    # device-side; the host drains results one step behind and discards the
+    # single late token a stopped sequence over-produces). True = fully
+    # synchronous stepping (every step fetched + booked before the next
+    # dispatch) — the differential-testing / debugging escape hatch. The
+    # env var XLLM_SYNC_ENGINE=1|0 overrides this field either way;
+    # speculative decoding always forces sync (docs/ENGINE_PIPELINE.md).
+    sync_engine: bool = False
+
     # Speculative decoding (prompt-lookup / n-gram drafting; 0 disables).
     # Each decode step drafts this many tokens per sequence by matching the
     # newest suffix n-gram against the sequence's own history, verifies all

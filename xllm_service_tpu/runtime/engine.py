@@ -128,7 +128,7 @@ class _Seq:
         "last_committed_block", "prefill_done_time", "last_token_time",
         "prefilled", "chunk_len", "prefill_start_time", "head_hash",
         "json_state", "json_upto", "schema_spec",
-        "rope_pos3", "rope_delta",
+        "rope_pos3", "rope_delta", "admit_gen",
     )
 
     def __init__(self, req: EngineRequest, slot: int):
@@ -163,6 +163,32 @@ class _Seq:
         # for everything but media prompts on an mrope model.
         self.rope_pos3 = None
         self.rope_delta = 0
+        # Bumped by _slot_admit: distinguishes a re-admission of the SAME
+        # sequence object from the occupancy an in-flight step sampled for
+        # (preempt + same-pass resume into the same slot must not let the
+        # stale in-flight token through the drain's identity check).
+        self.admit_gen = 0
+
+
+class _InFlight:
+    """One dispatched-but-undrained decode step (overlapped pipeline).
+
+    `tokens`/`logprobs` are DEVICE arrays still being computed; `slots`
+    snapshots slot -> (_Seq, admit_gen) at dispatch time so the drain can
+    tell whether a slot still belongs to the exact occupancy it sampled for
+    (a seq finished, cancelled, preempted — or preempted and re-admitted —
+    between dispatch and drain gets its late token discarded: the
+    one-step-late stop semantics, docs/ENGINE_PIPELINE.md)."""
+
+    __slots__ = ("tokens", "logprobs", "slots", "t0", "nactive", "total_ctx")
+
+    def __init__(self, tokens, logprobs, slots, t0, nactive, total_ctx):
+        self.tokens = tokens
+        self.logprobs = logprobs
+        self.slots = slots
+        self.t0 = t0
+        self.nactive = nactive
+        self.total_ctx = total_ctx
 
 
 # The waiting queue holds fresh EngineRequests and preempted _Seqs (which
@@ -225,8 +251,72 @@ class InferenceEngine:
         self._thread: Optional[threading.Thread] = None
         self._cancelled: set = set()
 
-        # Static decode-batch arrays (filled per step).
-        self._block_tables = np.zeros((self.R, self.max_blocks), np.int32)
+        # Stepping mode: overlapped one-step-lookahead pipeline by default;
+        # sync_engine=True (or XLLM_SYNC_ENGINE=1) forces fully synchronous
+        # stepping, and speculative decoding always does (the verify step's
+        # variable emission count cannot run one step blind). XLLM_SYNC_ENGINE=0
+        # force-enables overlap over a sync_engine=True config.
+        import os as _os
+
+        _env = _os.environ.get("XLLM_SYNC_ENGINE", "")
+        self.sync_engine = (
+            True if _env == "1"
+            else False if _env == "0"
+            else engine_cfg.sync_engine
+        )
+        self._force_sync = self.sync_engine or engine_cfg.speculative_tokens > 0
+
+        # Persistent decode-batch state: per-slot arrays mutated ONLY on
+        # admit/finish/cancel/preempt (plus vectorized per-step position and
+        # step-count advances) — the per-step O(R) SamplingBatch rebuild is
+        # gone from the hot loop. `_ps_gen` bumps on every slot mutation and
+        # keys the packed logit-bias cache.
+        R = self.R
+        self._block_tables = np.zeros((R, self.max_blocks), np.int32)
+        self._ps_gen = 0
+        self._ps_temps = np.zeros((R,), np.float32)
+        self._ps_top_k = np.zeros((R,), np.int32)
+        self._ps_top_p = np.ones((R,), np.float32)
+        self._ps_seeds = np.zeros((R,), np.uint32)
+        self._ps_steps = np.zeros((R,), np.int32)
+        self._ps_presence = np.zeros((R,), np.float32)
+        self._ps_frequency = np.zeros((R,), np.float32)
+        self._ps_min_p = np.zeros((R,), np.float32)
+        self._ps_adapter = np.zeros((R,), np.int32)
+        self._ps_rope_delta = np.zeros((R,), np.int32)
+        self._n_min_p = 0
+        self._n_adapter = 0
+        self._n_rope = 0
+        self._n_bias = 0
+        self._bias_rows: List[tuple] = [()] * R
+        self._bias_cache: Tuple[Optional[np.ndarray], Optional[np.ndarray]] = (
+            None, None,
+        )
+        self._bias_cache_gen = -1
+        self._guided_slots: set = set()
+        # Dispatch-side virtual state: positions/steps run one token AHEAD
+        # of seq.tokens while a step is in flight (_ps_pending = dispatched
+        # but not yet drained, 0 or 1 under one-step lookahead). `_fresh`
+        # marks slots whose next input token must come from the host
+        # (admission/resume/sync drain) instead of the in-flight device
+        # sample.
+        self._ps_active = np.zeros((R,), bool)
+        self._ps_last_tok = np.zeros((R,), np.int32)
+        self._ps_positions = np.zeros((R,), np.int32)
+        self._ps_pending = np.zeros((R,), np.int32)
+        self._ps_gen_count = np.zeros((R,), np.int32)
+        self._ps_tok_count = np.zeros((R,), np.int32)
+        self._ps_max_new = np.zeros((R,), np.int32)
+        self._fresh = np.zeros((R,), bool)
+        self._inflight: Optional[_InFlight] = None
+        # Overlap accounting (exported via metrics + bench --engine-mode).
+        self.decode_dispatches = 0
+        self.overlap_steps = 0
+        self.late_stop_discards = 0
+        self.loop_errors = 0
+        self.host_gap_ms_sum = 0.0
+        self.host_gap_steps = 0
+        self._t_host_free: Optional[float] = None
         # Latency windows (ms) for LatencyMetrics.
         self._ttft_window: Deque[Tuple[float, float]] = collections.deque()
         self._tbt_window: Deque[Tuple[float, float]] = collections.deque()
@@ -294,6 +384,35 @@ class InferenceEngine:
             "xllm_engine_decode_steps_total", "Decode (or verify) steps "
             "executed",
         )
+        # Overlapped-pipeline instruments (docs/ENGINE_PIPELINE.md): the
+        # host gap is the wall time between finishing one step's host
+        # bookkeeping and dispatching the next decode step — the window the
+        # device would idle through in sync mode; overlap hides it behind
+        # the in-flight step.
+        self._m_host_gap = self.metrics.histogram(
+            "xllm_engine_host_gap_ms",
+            "Host bookkeeping gap between one decode step's drain and the "
+            "next dispatch", buckets=LATENCY_BUCKETS_MS,
+        )
+        self.metrics.gauge(
+            "xllm_engine_overlap_depth",
+            "Decode steps currently in flight on the device (0 = idle or "
+            "sync mode, 1 = one-step lookahead active)",
+        ).set_function(lambda: 1 if self._inflight is not None else 0)
+        self.metrics.counter(
+            "xllm_engine_overlapped_steps_total",
+            "Decode steps dispatched while the prior step was still in "
+            "flight",
+        ).set_function(lambda: self.overlap_steps)
+        self.metrics.counter(
+            "xllm_engine_late_stop_discards_total",
+            "In-flight sampled tokens discarded because their sequence "
+            "stopped/cancelled/preempted one step earlier",
+        ).set_function(lambda: self.late_stop_discards)
+        self.metrics.counter(
+            "xllm_engine_loop_errors_total",
+            "Engine-loop iterations that raised (loop stays alive)",
+        ).set_function(lambda: self.loop_errors)
         self.metrics.counter(
             "xllm_engine_preemptions_total",
             "Recompute-style preemptions (pool pressure + hybrid "
@@ -354,7 +473,12 @@ class InferenceEngine:
         self._work.set()
 
     def has_work(self) -> bool:
-        return bool(self._waiting or self._running or self._pending_imports)
+        return bool(
+            self._waiting
+            or self._running
+            or self._pending_imports
+            or self._inflight is not None
+        )
 
     def start(self) -> None:
         if self.cfg.warmup_on_start and hasattr(self.executor, "warmup"):
@@ -397,6 +521,7 @@ class InferenceEngine:
     # ---------------------------------------------------------------- loop
 
     def _loop(self) -> None:
+        log = logging.getLogger(__name__)
         while not self._stop:
             if not self.has_work():
                 self._work.wait(timeout=0.05)
@@ -404,26 +529,57 @@ class InferenceEngine:
                 continue
             try:
                 produced = self.step()
-                if produced == 0:
+                if produced == 0 and self._inflight is None:
                     # Waiting work that cannot run yet (e.g. blocked on KV
-                    # capacity) — back off instead of hot-spinning.
-                    time.sleep(0.005)
+                    # capacity): sleep on the work event — set when KV
+                    # blocks are freed (_finish), imports/cancels land, or
+                    # new requests arrive — instead of a blind busy-backoff.
+                    self._work.wait(timeout=0.05)
+                    self._work.clear()
             except Exception:  # pragma: no cover — keep the loop alive
-                import traceback
-
-                traceback.print_exc()
+                self.loop_errors += 1
+                log.exception("engine loop iteration failed")
                 time.sleep(0.1)
 
     # ---------------------------------------------------------------- step
 
     def step(self) -> int:
         """One engine iteration: land migrated KV, admit + prefill new
-        requests, then one decode step. Returns number of tokens produced."""
+        requests, then one decode step. Returns number of tokens produced.
+
+        Overlapped mode (default): the decode dispatch for step N+1 happens
+        BEFORE step N's results are consumed, so host bookkeeping runs while
+        the device computes. Sync mode — the escape hatch, plus automatic
+        fallback while speculative decoding or any guided sequence is live
+        (their next dispatch depends on the previous step's tokens host-side)
+        — fetches and books each step before dispatching the next."""
+        if not self._running and self._inflight is None:
+            self._t_host_free = None  # idle time is not a host gap
         self._drain_imports()
         self._drain_cancelled()
         self._maybe_flush_schema_rows()
         admitted = self._admit()
-        return admitted + self._decode_once()
+        if self._force_sync or self._guided_slots:
+            produced = self._flush_inflight()
+            produced += self._decode_once()
+        else:
+            produced = self._step_overlap()
+        return admitted + produced
+
+    def _step_overlap(self) -> int:
+        """One pipeline iteration: dispatch decode step N+1 (fed from step
+        N's device-resident tokens), THEN drain/book step N while N+1 runs."""
+        nxt = self._dispatch_decode()
+        produced = self._drain_step(self._inflight, nxt)
+        self._inflight = nxt
+        return produced
+
+    def _flush_inflight(self) -> int:
+        """Drain any in-flight step without dispatching a successor (mode
+        transitions and shutdown): surviving slots return to host feeding."""
+        produced = self._drain_step(self._inflight, None)
+        self._inflight = None
+        return produced
 
     # ------------------------------------------------------------ admission
 
@@ -856,6 +1012,7 @@ class InferenceEngine:
             self.executor.seed_slot_counts(
                 seq.slot, [t for t, _ in seq.generated]
             )
+        self._slot_admit(seq)
         self._running[seq.slot] = seq
         alive = self._emit(seq, finished=self._check_stop(seq))
         if alive and seq.req.prefill_only:
@@ -1016,6 +1173,7 @@ class InferenceEngine:
         if seq.slot in self._running:
             del self._running[seq.slot]
             self._free_slots.append(seq.slot)
+            self._slot_clear(seq.slot)
         self.block_mgr.free(seq.block_ids)
         seq.block_ids = []
 
@@ -1134,21 +1292,29 @@ class InferenceEngine:
 
     # -------------------------------------------------------------- decode
 
-    def _ensure_decode_capacity(self, width: int) -> None:
+    def _ensure_decode_capacity(self, width: int, mask=None) -> None:
         """Ensure block capacity for every position the coming decode step
-        may write: `width` tokens starting at each seq's input position
-        (1 for plain decode, k+1 for speculative), capped at max_seq_len.
-        Preempts (victim-first, then self) on pool exhaustion."""
+        may write: `width` tokens starting at each slot's next input
+        position (the persistent dispatch position — one token ahead of
+        seq.tokens while a step is in flight), capped at max_seq_len.
+        Preempts (victim-first, then self) on pool exhaustion. `mask`
+        restricts the pass to dispatchable slots (overlap mode skips
+        length-stopped slots whose position already sits at the limit)."""
         max_len = self.cfg.max_seq_len
         for slot, seq in sorted(self._running.items()):
             if slot not in self._running:  # preempted earlier this pass
                 continue
-            pos = len(seq.tokens) - 1  # position of the first input token
+            if mask is not None and not mask[slot]:
+                continue
+            pos = int(self._ps_positions[slot])
             tl = max(1, min(width, max_len - pos))
             need = (pos + tl - 1) // self.block_size + 1
             while len(seq.block_ids) < need:
                 try:
                     seq.block_ids += self.block_mgr.allocate(1)
+                    self._block_tables[slot, len(seq.block_ids) - 1] = (
+                        seq.block_ids[-1]
+                    )
                 except OutOfBlocksError:
                     victim = self._pick_preemption_victim(exclude=slot)
                     if victim is None:
@@ -1159,58 +1325,136 @@ class InferenceEngine:
             else:
                 continue
 
-    def _gather_sampling_batch(self) -> SamplingBatch:
-        """Per-slot sampling params + block tables for the running set
-        (shared by the plain and speculative decode paths)."""
-        temps = np.zeros((self.R,), np.float32)
-        top_ks = np.zeros((self.R,), np.int32)
-        top_ps = np.ones((self.R,), np.float32)
-        seeds = np.zeros((self.R,), np.uint32)
-        steps = np.zeros((self.R,), np.int32)
-        presence = np.zeros((self.R,), np.float32)
-        frequency = np.zeros((self.R,), np.float32)
-        self._block_tables[:] = 0
-        bias_rows = [()] * self.R
-        for slot, seq in self._running.items():
-            n = len(seq.block_ids)
-            self._block_tables[slot, :n] = seq.block_ids
-            s = seq.req.sampling
-            temps[slot] = s.temperature
-            top_ks[slot] = s.top_k
-            top_ps[slot] = s.top_p
-            seeds[slot] = s.seed & 0xFFFFFFFF
-            steps[slot] = len(seq.generated)
-            presence[slot] = getattr(s, "presence_penalty", 0.0)
-            frequency[slot] = getattr(s, "frequency_penalty", 0.0)
-            bias_rows[slot] = tuple(getattr(s, "logit_bias", ()) or ())
-        from xllm_service_tpu.ops.sampling import pack_logit_bias
+    # ------------------------------------------- persistent batch state
 
-        bias_ids, bias_vals = pack_logit_bias(bias_rows, self.R)
-        adapter_idx = None
-        if any(sq.req.adapter_idx for sq in self._running.values()):
-            adapter_idx = np.zeros((self.R,), np.int32)
-            for slot, sq in self._running.items():
-                adapter_idx[slot] = sq.req.adapter_idx
-        min_p = None
-        if any(
-            getattr(sq.req.sampling, "min_p", 0.0)
-            for sq in self._running.values()
-        ):
-            min_p = np.zeros((self.R,), np.float32)
-            for slot, sq in self._running.items():
-                min_p[slot] = getattr(sq.req.sampling, "min_p", 0.0)
-        rope_delta = None
-        if any(
-            getattr(sq, "rope_delta", 0) for sq in self._running.values()
-        ):
-            rope_delta = np.zeros((self.R,), np.int32)
-            for slot, sq in self._running.items():
-                rope_delta[slot] = sq.rope_delta
-        return SamplingBatch(
-            temps, top_ks, top_ps, seeds, steps, presence, frequency,
-            bias_ids, bias_vals, adapter_idx=adapter_idx, min_p=min_p,
-            rope_delta=rope_delta,
+    def _set_opt(self, arr: np.ndarray, slot: int, val, count_attr: str):
+        """Write one optional-feature array entry, maintaining the count of
+        nonzero entries so _sampling_batch_view can pass None (and keep the
+        cheaper compiled variant) when the feature is unused batch-wide."""
+        old = arr[slot]
+        arr[slot] = val
+        setattr(
+            self, count_attr,
+            getattr(self, count_attr) + int(bool(val)) - int(bool(old)),
         )
+
+    def _slot_admit(self, seq: _Seq) -> None:
+        """Install a sequence's sampling params + dispatch state into the
+        persistent per-slot arrays (fresh admission, preemption resume, PD
+        import resume). Together with _slot_clear this is the ONLY write
+        path for sampling state — steady-state decode steps reuse the
+        arrays untouched instead of rebuilding a SamplingBatch."""
+        slot = seq.slot
+        s = seq.req.sampling
+        self._ps_temps[slot] = s.temperature
+        self._ps_top_k[slot] = s.top_k
+        self._ps_top_p[slot] = s.top_p
+        self._ps_seeds[slot] = s.seed & 0xFFFFFFFF
+        self._ps_steps[slot] = len(seq.generated)
+        self._ps_presence[slot] = getattr(s, "presence_penalty", 0.0)
+        self._ps_frequency[slot] = getattr(s, "frequency_penalty", 0.0)
+        self._set_opt(
+            self._ps_min_p, slot, getattr(s, "min_p", 0.0), "_n_min_p"
+        )
+        self._set_opt(
+            self._ps_adapter, slot, seq.req.adapter_idx, "_n_adapter"
+        )
+        self._set_opt(
+            self._ps_rope_delta, slot, getattr(seq, "rope_delta", 0) or 0,
+            "_n_rope",
+        )
+        bias = tuple(getattr(s, "logit_bias", ()) or ())
+        self._n_bias += int(bool(bias)) - int(bool(self._bias_rows[slot]))
+        self._bias_rows[slot] = bias
+        if seq.req.guided:
+            self._guided_slots.add(slot)
+        else:
+            self._guided_slots.discard(slot)
+        row = self._block_tables[slot]
+        row[:] = 0
+        row[: len(seq.block_ids)] = seq.block_ids
+        self._ps_active[slot] = True
+        self._ps_last_tok[slot] = seq.tokens[-1]
+        self._ps_positions[slot] = len(seq.tokens) - 1
+        self._ps_pending[slot] = 0
+        self._ps_gen_count[slot] = len(seq.generated)
+        self._ps_tok_count[slot] = len(seq.tokens)
+        self._ps_max_new[slot] = s.max_new_tokens
+        self._fresh[slot] = True
+        seq.admit_gen += 1
+        self._ps_gen += 1
+
+    def _slot_clear(self, slot: int) -> None:
+        """Reset one slot's persistent arrays (finish/cancel/preempt/
+        handoff) — inactive rows carry the same neutral values the old
+        per-step rebuild zero-filled them with."""
+        self._ps_active[slot] = False
+        self._ps_pending[slot] = 0
+        self._ps_temps[slot] = 0.0
+        self._ps_top_k[slot] = 0
+        self._ps_top_p[slot] = 1.0
+        self._ps_seeds[slot] = 0
+        self._ps_steps[slot] = 0
+        self._ps_presence[slot] = 0.0
+        self._ps_frequency[slot] = 0.0
+        self._set_opt(self._ps_min_p, slot, 0.0, "_n_min_p")
+        self._set_opt(self._ps_adapter, slot, 0, "_n_adapter")
+        self._set_opt(self._ps_rope_delta, slot, 0, "_n_rope")
+        self._n_bias -= int(bool(self._bias_rows[slot]))
+        self._bias_rows[slot] = ()
+        self._guided_slots.discard(slot)
+        self._block_tables[slot, :] = 0
+        self._ps_last_tok[slot] = 0
+        self._ps_positions[slot] = 0
+        self._ps_gen_count[slot] = 0
+        self._ps_tok_count[slot] = 0
+        self._ps_max_new[slot] = 0
+        self._fresh[slot] = False
+        self._ps_gen += 1
+
+    def _refresh_slot_arrays(self, slot: int, seq: _Seq) -> None:
+        """Re-derive a slot's dispatch state from host truth. The
+        speculative path emits a VARIABLE token count per step, so the
+        incremental +1 advances the plain paths use would drift."""
+        self._ps_steps[slot] = len(seq.generated)
+        self._ps_positions[slot] = len(seq.tokens) - 1
+        self._ps_last_tok[slot] = seq.tokens[-1]
+        self._ps_gen_count[slot] = len(seq.generated)
+        self._ps_tok_count[slot] = len(seq.tokens)
+
+    def _sampling_batch_view(self) -> SamplingBatch:
+        """SamplingBatch over the persistent arrays — zero per-step
+        allocation. The packed logit-bias arrays are cached keyed on the
+        running-set generation (_ps_gen), so the no-bias common case never
+        calls pack_logit_bias and steady-state biased batches pack once per
+        membership change, not once per step."""
+        if self._n_bias:
+            if self._bias_cache_gen != self._ps_gen:
+                from xllm_service_tpu.ops.sampling import pack_logit_bias
+
+                self._bias_cache = pack_logit_bias(self._bias_rows, self.R)
+                self._bias_cache_gen = self._ps_gen
+            bias_ids, bias_vals = self._bias_cache
+        else:
+            bias_ids = bias_vals = None
+        return SamplingBatch(
+            self._ps_temps, self._ps_top_k, self._ps_top_p, self._ps_seeds,
+            self._ps_steps, self._ps_presence, self._ps_frequency,
+            bias_ids, bias_vals,
+            adapter_idx=self._ps_adapter if self._n_adapter else None,
+            min_p=self._ps_min_p if self._n_min_p else None,
+            rope_delta=self._ps_rope_delta if self._n_rope else None,
+        )
+
+    def _observe_host_gap(self) -> None:
+        """Record the host-bookkeeping gap between the previous step's
+        drain and this dispatch — the window sync mode spends with the
+        device idle, and overlap mode hides behind the in-flight step."""
+        if self._t_host_free is not None:
+            gap = (time.monotonic() - self._t_host_free) * 1000
+            self._m_host_gap.observe(gap)
+            self.host_gap_ms_sum += gap
+            self.host_gap_steps += 1
 
     def _decode_once(self) -> int:
         if self.cfg.speculative_tokens > 0:
@@ -1221,36 +1465,32 @@ class InferenceEngine:
         if not self._running:
             return 0
 
-        token_ids = np.zeros((self.R,), np.int32)
-        positions = np.zeros((self.R,), np.int32)
-        active = np.zeros((self.R,), bool)
-        batch = self._gather_sampling_batch()
-        for slot, seq in self._running.items():
-            token_ids[slot] = seq.tokens[-1]
-            positions[slot] = len(seq.tokens) - 1
-            active[slot] = True
-        if self._guided_tokens is not None and any(
-            s.req.guided for s in self._running.values()
-        ):
+        active = self._ps_active.copy()
+        batch = self._sampling_batch_view()
+        if self._guided_tokens is not None and self._guided_slots:
             rows = np.full((self.R,), self.executor.permissive_row, np.int32)
             for slot, seq in self._running.items():
                 rows[slot] = self._guided_row(seq)
             batch.mask_rows = rows
 
+        self._observe_host_gap()
         t0 = time.monotonic()
         tokens, logprobs = self.executor.decode(
-            token_ids,
-            positions,
+            self._ps_last_tok,
+            self._ps_positions,
             self._block_tables,
             active,
             batch,
         )
         step_ms = (time.monotonic() - t0) * 1000
         nactive = int(active.sum())
-        total_ctx = int(positions[active].sum()) + nactive
+        total_ctx = int(self._ps_positions[active].sum()) + nactive
         self._profile_tpot.append((nactive, total_ctx, step_ms))
         self._m_batch.observe(nactive)
         self._m_steps.inc()
+        self.decode_dispatches += 1
+        self._ps_steps[active] += 1
+        self._ps_positions[active] += 1
 
         produced = 0
         now = time.monotonic()
@@ -1263,9 +1503,124 @@ class InferenceEngine:
             seq.last_token_time = now
             seq.generated.append((tok, lp))
             seq.tokens.append(tok)
+            self._ps_last_tok[slot] = tok
+            self._ps_gen_count[slot] += 1
+            self._ps_tok_count[slot] += 1
+            self._fresh[slot] = True
             self._commit_full_blocks(seq)
             produced += 1
             self._emit(seq, finished=self._check_stop(seq))
+        self._t_host_free = time.monotonic()
+        return produced
+
+    # ------------------------------------------------ overlapped pipeline
+
+    def _dispatch_decode(self) -> Optional[_InFlight]:
+        """Dispatch the next overlapped decode step, returning its in-flight
+        record (None when nothing is dispatchable). Continuing slots feed
+        from the PREVIOUS step's device-resident sampled tokens — the
+        autoregressive feedback never round-trips the host. Freshly
+        admitted/resumed slots feed from the host array. Length-predictable
+        stops (max_new_tokens / max_seq_len) are excluded up front; the
+        token-dependent ones (EOS / stop ids) surface at drain, one step
+        late, and cost exactly one discarded sample."""
+        if not self._running:
+            return None
+        can = (
+            self._ps_active
+            & (self._ps_gen_count + self._ps_pending < self._ps_max_new)
+            & (
+                self._ps_tok_count + self._ps_pending
+                < self.cfg.max_seq_len
+            )
+        )
+        if not can.any():
+            return None
+        self._ensure_decode_capacity(1, mask=can)
+        can &= self._ps_active  # the capacity pass may have preempted
+        if not can.any():
+            return None
+        batch = self._sampling_batch_view()
+        prev = self._inflight
+        # Non-dispatched rows read the (defined) host value; dispatched
+        # rows read the device feedback unless freshly (re)admitted.
+        fresh_mask = self._fresh | ~can
+        # Invariant: a non-fresh dispatched slot's feed lives in the
+        # in-flight step — with no in-flight step every slot is host-fed.
+        assert prev is not None or bool(fresh_mask[can].all())
+        self._observe_host_gap()
+        t0 = time.monotonic()
+        tokens, logprobs = self.executor.decode_start(
+            self._ps_last_tok,
+            fresh_mask,
+            prev.tokens if prev is not None else None,
+            self._ps_positions,
+            self._block_tables,
+            can,
+            batch,
+        )
+        nactive = int(can.sum())
+        total_ctx = int(self._ps_positions[can].sum()) + nactive
+        snapshot = {}
+        for slot in np.nonzero(can)[0]:
+            seq = self._running[int(slot)]
+            snapshot[int(slot)] = (seq, seq.admit_gen)
+        self._ps_pending[can] += 1
+        self._ps_positions[can] += 1
+        self._ps_steps[can] += 1
+        self._fresh[can] = False
+        self._m_batch.observe(nactive)
+        self._m_steps.inc()
+        self.decode_dispatches += 1
+        if prev is not None:
+            self.overlap_steps += 1
+        return _InFlight(tokens, logprobs, snapshot, t0, nactive, total_ctx)
+
+    def _drain_step(
+        self, flt: Optional[_InFlight], newer: Optional[_InFlight]
+    ) -> int:
+        """Consume one in-flight step's results (blocks until the device
+        finishes it — while `newer`, if any, already executes behind it).
+        Per-token emit, tracer windows, block commits, and stop checks all
+        live here, off the dispatch path. Late tokens for sequences no
+        longer running are discarded; surviving slots not covered by a
+        newer dispatch return to host feeding."""
+        if flt is None:
+            return 0
+        tokens = np.asarray(flt.tokens)
+        logprobs = np.asarray(flt.logprobs)
+        step_ms = (time.monotonic() - flt.t0) * 1000
+        self._profile_tpot.append((flt.nactive, flt.total_ctx, step_ms))
+        produced = 0
+        now = time.monotonic()
+        for slot, (seq, gen) in flt.slots.items():
+            if self._running.get(slot) is not seq or seq.admit_gen != gen:
+                # The seq stopped/cancelled/was preempted after dispatch
+                # (admit_gen also catches a preempt + re-admission of the
+                # SAME seq into the SAME slot): one-step-late stop —
+                # exactly one over-produced sample to drop (a preempted
+                # seq re-samples it deterministically on resume; same
+                # (seed, step) key, same context).
+                self.late_stop_discards += 1
+                continue
+            self._ps_pending[slot] -= 1
+            tok, lp = int(tokens[slot]), float(logprobs[slot])
+            tbt_ms = (now - seq.last_token_time) * 1000
+            self._tbt_window.append((now, tbt_ms))
+            self._m_tbt.observe(tbt_ms)
+            seq.last_token_time = now
+            seq.generated.append((tok, lp))
+            seq.tokens.append(tok)
+            self._ps_last_tok[slot] = tok
+            self._ps_gen_count[slot] += 1
+            self._ps_tok_count[slot] += 1
+            ent = newer.slots.get(slot) if newer is not None else None
+            if ent is None or ent[0] is not seq or ent[1] != gen:
+                self._fresh[slot] = True
+            self._commit_full_blocks(seq)
+            produced += 1
+            self._emit(seq, finished=self._check_stop(seq))
+        self._t_host_free = time.monotonic()
         return produced
 
     # ------------------------------------------------------------ M-RoPE
@@ -1718,6 +2073,10 @@ class InferenceEngine:
         k = self.cfg.speculative_tokens
         S = k + 1
         max_len = self.cfg.max_seq_len
+        # Variable emission counts: re-derive dispatch state from host
+        # truth before the capacity pass reads the position array.
+        for slot, seq in self._running.items():
+            self._refresh_slot_arrays(slot, seq)
         self._ensure_decode_capacity(S)
         if not self._running:
             return 0
@@ -1726,7 +2085,7 @@ class InferenceEngine:
         positions = np.zeros((self.R,), np.int32)
         true_len = np.zeros((self.R,), np.int32)
         active = np.zeros((self.R,), bool)
-        batch = self._gather_sampling_batch()
+        batch = self._sampling_batch_view()
         for slot, seq in self._running.items():
             pos = len(seq.tokens) - 1
             token_ids[slot, 0] = seq.tokens[-1]
@@ -1761,6 +2120,7 @@ class InferenceEngine:
         self._profile_tpot.append((nactive, total_ctx, step_ms))
         self._m_batch.observe(nactive)
         self._m_steps.inc()
+        self.decode_dispatches += 1
         self.spec_steps += 1
         self.spec_slot_steps += nactive
         self.spec_tokens_emitted += int(n_emit[active].sum())
@@ -1823,6 +2183,7 @@ class InferenceEngine:
         seq.last_committed_block = -1
         del self._running[seq.slot]
         self._free_slots.append(seq.slot)
+        self._slot_clear(seq.slot)
         with self._lock:
             if requeue_front:
                 self._waiting.appendleft(seq)
@@ -1908,8 +2269,13 @@ class InferenceEngine:
         if seq.slot in self._running:
             del self._running[seq.slot]
             self._free_slots.append(seq.slot)
+            self._slot_clear(seq.slot)
         self.block_mgr.free(seq.block_ids)
         seq.block_ids = []
+        # Slot + blocks freed: wake a loop that backed off with waiting
+        # work blocked on KV capacity (the event replaces the old blind
+        # sleep in _loop).
+        self._work.set()
         if cancelled:
             out = RequestOutput(
                 request_id=seq.req.request_id,

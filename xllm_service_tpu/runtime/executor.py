@@ -611,7 +611,11 @@ class ModelExecutor:
         v_cache,
         counts,  # [R, V] int32 generated-token histogram (donated)
         params,
-        token_ids,
+        fresh_tokens,  # [R] host-fed input ids (admissions / sync mode)
+        fresh_mask,  # [R] bool — True: input from fresh_tokens
+        prev_tokens,  # [R] DEVICE-resident sampled tokens from the prior
+        #              step (overlapped pipeline feeds them back without a
+        #              host round-trip); sync callers pass fresh_tokens
         positions,
         block_tables,
         active,
@@ -630,6 +634,7 @@ class ModelExecutor:
         use_kernel=None,
         rope_delta=None,  # [R] M-RoPE position lag (Qwen2-VL image spans)
     ):
+        token_ids = jnp.where(fresh_mask, fresh_tokens, prev_tokens)
         step_kwargs = (
             {"lora_idx": lora_idx} if lora_idx is not None else {}
         )
@@ -1295,6 +1300,31 @@ class ModelExecutor:
         batch: SamplingBatch,
         use_kernel: Optional[bool] = None,
     ) -> Tuple[np.ndarray, np.ndarray]:
+        """Synchronous decode step: dispatch + fetch (all inputs host-fed).
+        The overlapped engine uses decode_start directly and fetches one
+        step behind; both share the same compiled step function."""
+        tokens, logprobs = self.decode_start(
+            token_ids, None, None, positions, block_tables, active, batch,
+            use_kernel=use_kernel,
+        )
+        return np.asarray(tokens), np.asarray(logprobs)
+
+    def decode_start(
+        self,
+        fresh_tokens: np.ndarray,  # [R] host-fed input ids
+        fresh_mask: Optional[np.ndarray],  # [R] bool; None = all fresh
+        prev_tokens,  # device [R] int32 from the prior step, or None
+        positions: np.ndarray,  # [R]
+        block_tables: np.ndarray,  # [R, max_blocks_per_seq]
+        active: np.ndarray,  # [R] bool
+        batch: SamplingBatch,
+        use_kernel: Optional[bool] = None,
+    ):
+        """Dispatch one decode step WITHOUT fetching the results: returns
+        (tokens, logprobs) as DEVICE arrays still in flight. Slots where
+        fresh_mask is False take their input token from `prev_tokens` —
+        the previous step's device-resident sample — so the overlapped
+        pipeline's autoregressive feedback never round-trips the host."""
         keys = sampling_ops.make_step_keys(
             jnp.asarray(batch.seeds, jnp.uint32),
             jnp.asarray(batch.steps, jnp.int32),
@@ -1335,6 +1365,17 @@ class ModelExecutor:
             bias_kwargs.update(
                 rope_delta=jnp.asarray(batch.rope_delta, jnp.int32)
             )
+        fresh = jnp.asarray(fresh_tokens, jnp.int32)
+        if fresh_mask is None:
+            mask = jnp.ones((R,), bool)
+            prev = fresh
+        else:
+            mask = jnp.asarray(fresh_mask)
+            prev = (
+                jnp.asarray(prev_tokens, jnp.int32)
+                if prev_tokens is not None
+                else fresh
+            )
         (
             self.k_cache, self.v_cache, self.token_counts, tokens, logprobs,
         ) = self._decode_jit(
@@ -1342,7 +1383,9 @@ class ModelExecutor:
             self.v_cache,
             self.token_counts,
             self.params,
-            jnp.asarray(token_ids, jnp.int32),
+            fresh,
+            mask,
+            prev,
             jnp.asarray(positions, jnp.int32),
             jnp.asarray(block_tables[:, :CB], jnp.int32),
             jnp.asarray(active),
@@ -1355,7 +1398,7 @@ class ModelExecutor:
             use_kernel=use_kernel,
             **bias_kwargs,
         )
-        return np.asarray(tokens), np.asarray(logprobs)
+        return tokens, logprobs
 
     def seed_slot_counts(self, slot: int, generated: "List[int]") -> None:
         """(Re)build one slot's generated-token histogram — on admission
