@@ -17,11 +17,31 @@ percentiles as ONE JSON line. Three trace sources:
     exercising hybrid scheduling (master parking + engine preemption)
     under the same burst.
 
-Fault injection: --kill-at F crashes one instance (heartbeats + HTTP
-drop, NO deregistration — api/instance.crash) after F of the requests
-have been dispatched; the report then carries the master's re-dispatch
-count and per-class error totals. The reference only PROMISES automatic
-rescheduling (README.md:46); here it is measured.
+Fault injection: --chaos-spec takes a seeded schedule (inline JSON or
+@file) of events fired as the request stream passes index thresholds:
+
+    {"seed": 7, "events": [
+      {"at_frac": 0.3, "action": "kill", "instance": 1},
+      {"at_frac": 0.2, "action": "flap", "instance": 0, "duration_s": 2},
+      {"at_frac": 0.2, "action": "partition", "instance": 0,
+       "duration_s": 2},
+      {"at_frac": 0.1, "action": "slow", "instance": 0, "delay_ms": 50}]}
+
+  * kill      — InstanceServer.crash(): heartbeats + HTTP drop, NO
+                deregistration; live streams die mid-token and the
+                master must resume them on survivors (token replay);
+  * flap      — the instance's dispatch plane fails (common/faults.py
+                drop rule on its address) while heartbeats continue: the
+                health breaker must eject it without a retry storm;
+  * partition — flap + dropped heartbeats (both directions of the link)
+                for duration_s;
+  * slow      — stretch the fake engine's per-token delay.
+
+The report carries redispatch/resume counts, resume-latency p99,
+failed-after-retry, breaker ejections/probe recoveries, and the final
+health states. --kill-at F remains as sugar for a one-kill spec. The
+reference only PROMISES automatic rescheduling (README.md:46); here
+recovery is measured, reproducibly.
 
 Default backend is the fake engine (isolates the service tier);
 --real-engine serves the actual JAX engine (llama3-tiny on CPU,
@@ -99,7 +119,14 @@ def main() -> None:
     p.add_argument("--offline-frac", type=float, default=0.0)
     p.add_argument(
         "--kill-at", type=float, default=0.0,
-        help="crash one instance after this fraction of requests dispatched",
+        help="crash one instance after this fraction of requests "
+        "dispatched (sugar for a one-kill --chaos-spec)",
+    )
+    p.add_argument(
+        "--chaos-spec", default="",
+        help="seeded fault schedule, inline JSON or @file (see module "
+        "docstring): kill / flap / partition / slow events at request-"
+        "fraction thresholds",
     )
     p.add_argument(
         "--shared-prefix", type=int, default=0,
@@ -262,13 +289,93 @@ def main() -> None:
         ]
     offline_mask = rng.random(args.requests) < args.offline_frac
     gaps = rng.exponential(1.0 / args.rate, size=args.requests)
-    kill_idx = -1
+
+    # ---- chaos schedule (common/faults.py) ---------------------------- #
+    from xllm_service_tpu.common import faults
+
+    chaos = {"seed": args.seed, "events": []}
+    if args.chaos_spec:
+        raw = args.chaos_spec
+        if raw.startswith("@"):
+            with open(raw[1:]) as f:
+                chaos = json.load(f)
+        else:
+            chaos = json.loads(raw)
     if args.kill_at > 0:
-        if len(instances) < 2:
+        chaos.setdefault("events", []).append(
+            {"at_frac": args.kill_at, "action": "kill", "instance": -1}
+        )
+    chaos_events = list(chaos.get("events", []))
+    if chaos_events:
+        if any(e.get("action") == "kill" for e in chaos_events) and (
+            len(instances) < 2
+        ):
             raise SystemExit(
-                "--kill-at needs --instances >= 2 (someone must survive)"
+                "kill events need --instances >= 2 (someone must survive)"
             )
-        kill_idx = min(int(args.kill_at * args.requests), args.requests - 1)
+        plan = faults.install_plan(
+            faults.FaultPlan(seed=int(chaos.get("seed", args.seed)))
+        )
+    killed_at = []
+
+    def _expiring_rules(rules, duration_s):
+        for r in rules:
+            plan.add_rule(r)
+        if duration_s and duration_s > 0:
+            t = threading.Timer(
+                duration_s, lambda: [plan.remove_rule(r) for r in rules]
+            )
+            t.daemon = True
+            t.start()
+
+    def fire_chaos(ev, t_start):
+        idx = ev.get("instance", -1) % len(instances)
+        srv = instances[idx]
+        action = ev.get("action")
+        if action == "kill":
+            srv.crash()
+            killed_at.append(
+                {"instance": srv.name,
+                 "at_s": round(time.monotonic() - t_start, 3)}
+            )
+        elif action == "flap":
+            # dispatch plane dark, heartbeats alive: the breaker's job
+            _expiring_rules(
+                [faults.FaultRule(
+                    point="post_json.send", match=srv.address,
+                    action="drop",
+                )],
+                ev.get("duration_s"),
+            )
+        elif action == "partition":
+            # both directions of the master<->instance link
+            _expiring_rules(
+                [
+                    faults.FaultRule(
+                        point="post_json.send", match=srv.address,
+                        action="drop",
+                    ),
+                    faults.FaultRule(
+                        point="heartbeat.send", match=srv.name,
+                        action="partition",
+                    ),
+                ],
+                ev.get("duration_s"),
+            )
+        elif action == "slow":
+            if hasattr(srv.engine, "token_delay_s"):
+                srv.engine.token_delay_s = ev.get("delay_ms", 50) / 1000.0
+        else:
+            raise SystemExit(f"unknown chaos action {action!r}")
+
+    pending_events = sorted(
+        (
+            (min(int(float(e.get("at_frac", 0.0)) * args.requests),
+                 args.requests - 1), e)
+            for e in chaos_events
+        ),
+        key=lambda p: p[0],
+    )
 
     ttfts, tpots, lats, errors = [], [], [], []
     off_ttfts, on_ttfts = [], []
@@ -342,20 +449,34 @@ def main() -> None:
 
     threads = []
     t_start = time.monotonic()
-    killed_at_s = None
     for i in range(args.requests):
         time.sleep(float(gaps[i]))
-        if i == kill_idx and len(instances) > 1:
-            instances[-1].crash()
-            killed_at_s = round(time.monotonic() - t_start, 3)
+        while pending_events and pending_events[0][0] <= i:
+            _, ev = pending_events.pop(0)
+            fire_chaos(ev, t_start)
         t = threading.Thread(target=drive, args=(i,))
         t.start()
         threads.append(t)
     for t in threads:
         t.join(timeout=600.0)
     wall = time.monotonic() - t_start
-    redispatches = master.scheduler.total_redispatches
-    pd_flips = master.scheduler.instance_mgr.total_flips
+    sched = master.scheduler
+    redispatches = sched.total_redispatches
+    resumes = sched.total_resumes
+    redispatch_attempts = sched.total_redispatch_attempts
+    mgr = sched.instance_mgr
+    pd_flips = mgr.total_flips
+    failed_after_retry = int(
+        sched.metrics.get("xllm_service_finished_total")
+        .labels(outcome="error").get()
+    )
+    resume_hist = sched.metrics.get("xllm_service_resume_latency_ms")
+    resume_p99 = resume_hist.percentile(99) if resume_hist else None
+    health_states = dict(mgr.health_states())
+    ejections = mgr.total_ejections
+    probe_recoveries = mgr.total_probe_recoveries
+    budget_exhausted = master._retry_budget.exhausted_total
+    faults.clear()
 
     # Service-tier latency distributions from the obs histograms (the
     # same series the master's /metrics exports): bucket-interpolated
@@ -435,8 +556,19 @@ def main() -> None:
                     if tpots else None
                 ),
                 "req_p99_s": pct(lats, 99),
-                "killed_instance_at_s": killed_at_s,
+                "chaos_events": chaos_events or None,
+                "killed_instances": killed_at or None,
                 "redispatches": redispatches,
+                "redispatch_attempts": redispatch_attempts,
+                "recovered_streams": resumes,
+                "resume_latency_p99_ms": (
+                    round(resume_p99, 3) if resume_p99 is not None else None
+                ),
+                "failed_after_retry": failed_after_retry,
+                "breaker_ejections": ejections,
+                "breaker_probe_recoveries": probe_recoveries,
+                "retry_budget_exhausted": budget_exhausted,
+                "health_states": health_states or None,
                 "service_histograms": service_hists,
                 "error_sample": errors[0][:200] if errors else None,
                 "shared_prefix_tokens": args.shared_prefix or None,

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Fault-injection-point lint (run in tests via tests/test_faults.py,
+next to check_metric_names.py).
+
+Scans the package sources (plus bench_serving.py) for every literal
+`faults.point("...")` call site and enforces:
+
+  * names are lowercase dotted identifiers (`^[a-z0-9_]+(\\.[a-z0-9_]+)*$`);
+  * every name is UNIQUE — one injection point, one site (a duplicated
+    name makes a chaos spec fire in places its author never audited);
+  * every name is COVERED — referenced by at least one file under
+    tests/, so each recovery path the point gates is actually exercised.
+
+Exit status 0 = clean; 1 = violations (listed on stderr).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "xllm_service_tpu")
+TESTS = os.path.join(REPO, "tests")
+
+POINT_RE = re.compile(r"faults\.point\(\s*[\r\n ]*[\"']([^\"']+)[\"']")
+NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
+
+
+def _py_files(root):
+    for dirpath, dirs, files in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in files:
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def scan_points():
+    """[(path, name)] for every literal faults.point call site."""
+    found = []
+    sources = list(_py_files(PKG)) + [os.path.join(REPO, "bench_serving.py")]
+    for path in sources:
+        if not os.path.exists(path):
+            continue
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        for name in POINT_RE.findall(src):
+            found.append((os.path.relpath(path, REPO), name))
+    return found
+
+
+def main() -> int:
+    errors = []
+    points = scan_points()
+    if not points:
+        errors.append("no faults.point(...) call sites found at all")
+    by_name = {}
+    for path, name in points:
+        if not NAME_RE.match(name):
+            errors.append(f"{path}: bad point name {name!r}")
+        by_name.setdefault(name, []).append(path)
+    for name, paths in sorted(by_name.items()):
+        if len(paths) > 1:
+            errors.append(
+                f"point {name!r} defined at {len(paths)} sites: "
+                + ", ".join(paths)
+            )
+    test_blob = "\n".join(
+        open(p, encoding="utf-8").read() for p in _py_files(TESTS)
+    )
+    for name in sorted(by_name):
+        if name not in test_blob:
+            errors.append(
+                f"point {name!r} is not referenced by any test under tests/"
+            )
+    for e in errors:
+        print(f"check_fault_points: {e}", file=sys.stderr)
+    if not errors:
+        print(f"check_fault_points: {len(by_name)} points, all clean")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

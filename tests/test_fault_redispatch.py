@@ -3,11 +3,19 @@ requests. The reference promises this and never implements it
 (README.md:46, SURVEY.md §3.5 note); here it is behavior under test:
   * a request whose routed instance dies BEFORE any token is transparently
     re-routed and completes on a survivor;
-  * a request mid-stream errors out cleanly (no silent duplicate tokens);
+  * a request MID-STREAM resumes by token replay on a survivor — the
+    final client byte stream is identical to the unfaulted run (seeded
+    differential suite below, driven by common/faults.py);
+  * with no survivor, a mid-stream death errors out cleanly (no silent
+    duplicate tokens);
   * a dead-socket instance (fast connection failure) triggers immediate
-    re-dispatch without waiting for lease expiry.
+    re-dispatch without waiting for lease expiry;
+  * a seeded chaos fuzz (slow) asserts no stream ever sees duplicated,
+    missing, or reordered tokens under drops/delays/partitions.
 """
 
+import http.client
+import json
 import threading
 import time
 
@@ -16,11 +24,19 @@ import pytest
 from xllm_service_tpu.api import FakeEngine, Master
 from xllm_service_tpu.api.instance import InstanceServer
 from xllm_service_tpu.cluster import instance_key
+from xllm_service_tpu.common import faults
 from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
 from xllm_service_tpu.common.types import InstanceMetaInfo, InstanceType
 from xllm_service_tpu.coordination import MemoryStore
 
 from tests.test_api_e2e import http_post, wait_until
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    faults.clear()
+    yield
+    faults.clear()
 
 
 def make_master(store, **kw):
@@ -250,3 +266,354 @@ def test_crash_kills_midstream_with_error_event():
         srv.stop()
         master.stop()
         store.close()
+
+
+# ---------------------------------------------------------------------------
+# mid-stream failover: token-replay resume
+# ---------------------------------------------------------------------------
+
+
+def _stream_completion(addr, prompt, max_tokens, timeout=60.0):
+    """POST a streaming completion; returns (chunks, saw_done) where
+    chunks is the normalized [(text, finish_reason), ...] sequence (id /
+    created stripped — they legitimately differ across runs)."""
+    host, _, port = addr.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    conn.request(
+        "POST", "/v1/completions",
+        body=json.dumps({
+            "model": "fake-echo", "prompt": prompt,
+            "max_tokens": max_tokens, "stream": True,
+        }).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    assert resp.status == 200, resp.read()
+    chunks, saw_done = [], False
+    for raw in resp:
+        line = raw.decode().strip()
+        if not line.startswith("data: "):
+            continue
+        payload = line[len("data: "):]
+        if payload == "[DONE]":
+            saw_done = True
+            break
+        ev = json.loads(payload)
+        assert "error" not in ev, ev
+        c = ev["choices"][0]
+        chunks.append((c["text"], c["finish_reason"]))
+    conn.close()
+    return chunks, saw_done
+
+
+def _inflight_state(master):
+    with master.scheduler._mu:
+        for s in master.scheduler._requests.values():
+            return s
+    return None
+
+
+def test_midstream_kill_resume_differential():
+    """Seeded differential: kill the routed instance after K delivered
+    tokens; the final client SSE stream must be IDENTICAL to the
+    unfaulted run — no duplicated, missing, or reordered tokens — and
+    xllm_service_resumes_total must record the replay."""
+    store = MemoryStore(clock=lambda: 0.0)  # frozen: explicit lease expiry
+    master = make_master(store)
+    srvs = {
+        name: make_instance(master, name, "DEFAULT", token_delay_s=0.05)
+        for name in ("v0", "v1")
+    }
+    prompt, max_tokens = "abcdefghijkl", 12
+    try:
+        assert wait_until(
+            lambda: sum(master.scheduler.instance_mgr.counts()) == 2
+        )
+        # Unfaulted reference run.
+        want, want_done = _stream_completion(
+            master.http_address, prompt, max_tokens
+        )
+        assert "".join(t for t, _ in want) == prompt[::-1]
+
+        # Faulted run: seeded plan; the drop rule lands once the victim
+        # (whichever instance routing picked) is known.
+        plan = faults.install_plan(faults.FaultPlan(seed=42))
+        result = {}
+
+        def client():
+            result["got"] = _stream_completion(
+                master.http_address, prompt, max_tokens
+            )
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        assert wait_until(lambda: _inflight_state(master) is not None)
+        state = _inflight_state(master)
+        victim = state.request.routing.prefill_name
+        assert wait_until(
+            lambda: state.request.num_generated_tokens >= 3, timeout=20.0
+        )
+        # Hang the victim's engine step loop (fault injection), then raise
+        # the death signal the sweeper would raise on TTL expiry.
+        plan.add_rule(faults.FaultRule(
+            point="fake_engine.step", match=victim, action="drop",
+        ))
+        with master._leases_mu:
+            lid = master._leases[victim]
+        srvs[victim]._heartbeat.stop()
+        store.expire_lease_now(lid)
+        t.join(timeout=60.0)
+        assert not t.is_alive()
+
+        got, got_done = result["got"]
+        assert got == want  # byte-stream identical (normalized id/created)
+        assert got_done and want_done
+        assert master.scheduler.total_resumes >= 1
+        assert "xllm_service_resumes_total 1" in (
+            master.scheduler.metrics.render()
+        )
+    finally:
+        for srv in srvs.values():
+            srv.stop()
+        master.stop(); store.close()
+
+
+def test_midstream_resume_nonstream_usage():
+    """Non-stream mid-stream kill: the final body carries the complete
+    text and a usage block identical to the unfaulted run's (replayed
+    tokens count as completion tokens, not prompt)."""
+    store = MemoryStore(clock=lambda: 0.0)
+    master = make_master(store)
+    srvs = {
+        name: make_instance(master, name, "DEFAULT", token_delay_s=0.05)
+        for name in ("u0", "u1")
+    }
+    prompt = "abcdefgh"
+    try:
+        assert wait_until(
+            lambda: sum(master.scheduler.instance_mgr.counts()) == 2
+        )
+        plan = faults.install_plan(faults.FaultPlan(seed=7))
+        result = {}
+
+        def client():
+            result["resp"] = http_post(
+                master.http_address, "/v1/completions",
+                {"model": "fake-echo", "prompt": prompt, "max_tokens": 8},
+                timeout=60.0,
+            )
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        assert wait_until(lambda: _inflight_state(master) is not None)
+        state = _inflight_state(master)
+        victim = state.request.routing.prefill_name
+        assert wait_until(
+            lambda: state.request.num_generated_tokens >= 2, timeout=20.0
+        )
+        plan.add_rule(faults.FaultRule(
+            point="fake_engine.step", match=victim, action="drop",
+        ))
+        with master._leases_mu:
+            lid = master._leases[victim]
+        srvs[victim]._heartbeat.stop()
+        store.expire_lease_now(lid)
+        t.join(timeout=60.0)
+        code, body = result["resp"]
+        assert code == 200, body
+        assert body["choices"][0]["text"] == prompt[::-1]
+        assert body["usage"]["prompt_tokens"] == len(prompt)
+        assert body["usage"]["completion_tokens"] == len(prompt)
+        assert master.scheduler.total_resumes >= 1
+    finally:
+        for srv in srvs.values():
+            srv.stop()
+        master.stop(); store.close()
+
+
+def test_stale_wire_pushes_are_rejected():
+    """A replaced attempt's late generations push must be dropped, not
+    spliced into the live stream (the wire id carries the attempt)."""
+    from xllm_service_tpu.common.types import (
+        RequestOutput,
+        SequenceOutput,
+    )
+
+    from xllm_service_tpu.common.types import StatusCode
+
+    store = MemoryStore(clock=lambda: 0.0)
+    master = make_master(store)
+    srv = make_instance(master, "w0", "DEFAULT", token_delay_s=0.2)
+    try:
+        assert wait_until(
+            lambda: sum(master.scheduler.instance_mgr.counts()) == 1
+        )
+        result = {}
+
+        def client():
+            # tolerant reader: the exchange ends in an injected error
+            host, _, port = master.http_address.partition(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=30)
+            conn.request(
+                "POST", "/v1/completions",
+                body=json.dumps({
+                    "model": "fake-echo", "prompt": "abcd",
+                    "max_tokens": 4, "stream": True,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            text = ""
+            for raw in resp:
+                line = raw.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]" or '"error"' in payload:
+                    break
+                text += json.loads(payload)["choices"][0]["text"]
+            conn.close()
+            result["text"] = text
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        assert wait_until(lambda: _inflight_state(master) is not None)
+        state = _inflight_state(master)
+        srid = state.request.service_request_id
+        # Forge a push from a stale attempt: once the live attempt is
+        # bumped past it, the scheduler must reject wire id mismatches.
+        master.scheduler._bump_attempt(state)
+        stale = RequestOutput(
+            request_id="zz", service_request_id=srid,  # pre-bump wire id
+            outputs=[SequenceOutput(index=0, token_ids=[99], text="Z")],
+        )
+        assert master.scheduler.handle_generation(stale) is False
+        # the LIVE wire id is accepted
+        live = RequestOutput(
+            request_id="zz",
+            service_request_id=state.request.wire_srid,
+            outputs=[SequenceOutput(index=0, token_ids=[98], text="Y")],
+        )
+        assert master.scheduler.handle_generation(live) is True
+        # Close out the fenced exchange so the client returns promptly.
+        # Lane FIFO guarantees the live "Y" write lands before this error.
+        master.scheduler.fail_request(
+            srid, StatusCode.UNAVAILABLE, "test teardown"
+        )
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        # the stale "Z" never reached the client; the live "Y" did
+        assert "Z" not in result["text"]
+        assert "Y" in result["text"]
+    finally:
+        srv.stop(); master.stop(); store.close()
+
+
+@pytest.mark.slow
+def test_chaos_fuzz_no_duplicate_or_missing_tokens():
+    """Seeded chaos fuzz (common/faults.py): random dispatch drops,
+    indeterminate response losses, engine-step delays, and heartbeat
+    drops across a 3-instance fleet. Every stream that completes must
+    carry EXACTLY the expected token sequence; every stream that dies
+    must have received a clean prefix of it (no duplicates, no gaps, no
+    reordering) plus an explicit error."""
+    import random
+    import string
+
+    store = MemoryStore(clock=lambda: 0.0)
+    master = make_master(store)
+    srvs = [
+        make_instance(master, f"c{i}", "DEFAULT", token_delay_s=0.01)
+        for i in range(3)
+    ]
+    try:
+        assert wait_until(
+            lambda: sum(master.scheduler.instance_mgr.counts()) == 3
+        )
+        faults.install_spec({
+            "seed": 1234,
+            "rules": [
+                # master->instance dispatch vanishes before the wire
+                {"point": "post_json.send", "match": "/v1/completions",
+                 "action": "drop", "prob": 0.15},
+                # ...or the ack is lost after delivery (indeterminate)
+                {"point": "post_json.recv", "match": "/v1/completions",
+                 "action": "error", "prob": 0.1},
+                # engine hiccups stretch token gaps
+                {"point": "fake_engine.step", "action": "delay",
+                 "prob": 0.05, "delay_ms": 20},
+                # the instance->master side of a flaky link
+                {"point": "heartbeat.send", "action": "drop", "prob": 0.2},
+            ],
+        })
+        rng = random.Random(99)
+        n_req = 24
+        prompts = [
+            "".join(rng.sample(string.ascii_lowercase + string.digits, 10))
+            for _ in range(n_req)
+        ]
+        results = [None] * n_req
+
+        def drive(i):
+            host, _, port = master.http_address.partition(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=60)
+            conn.request(
+                "POST", "/v1/completions",
+                body=json.dumps({
+                    "model": "fake-echo", "prompt": prompts[i],
+                    "max_tokens": 10, "stream": True,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            text, err, done = "", None, False
+            if resp.status != 200:
+                results[i] = ("", "http", False)
+                return
+            for raw in resp:
+                line = raw.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    done = True
+                    break
+                ev = json.loads(payload)
+                if "error" in ev:
+                    err = ev["error"]
+                    break
+                text += ev["choices"][0]["text"]
+            conn.close()
+            results[i] = (text, err, done)
+
+        threads = [
+            threading.Thread(target=drive, args=(i,), daemon=True)
+            for i in range(n_req)
+        ]
+        for t in threads:
+            t.start()
+            time.sleep(0.02)
+        for t in threads:
+            t.join(timeout=120.0)
+            assert not t.is_alive()
+
+        completed = 0
+        for i, res in enumerate(results):
+            assert res is not None, f"request {i} never finished"
+            text, err, done = res
+            expect = prompts[i][::-1]
+            if done:
+                # completed: byte-exact (distinct chars per prompt, so
+                # equality == no dup/missing/reordered tokens)
+                assert text == expect, (i, text, expect)
+                completed += 1
+            else:
+                # faulted out: clean prefix + explicit error, never a
+                # corrupted or fabricated stream
+                assert expect.startswith(text), (i, text, expect)
+        # the fleet survived the chaos for most traffic
+        assert completed >= n_req // 2
+    finally:
+        for srv in srvs:
+            srv.stop()
+        master.stop(); store.close()

@@ -16,6 +16,7 @@ from typing import Callable, Dict, List, Optional
 
 from xllm_service_tpu.api.http_utils import get_json, post_json
 from xllm_service_tpu.api.protocol import output_to_json
+from xllm_service_tpu.common import faults
 from xllm_service_tpu.common.types import (
     InstanceMetaInfo,
     KvCacheEvent,
@@ -71,6 +72,9 @@ class MasterClient:
             body["latency_metrics"] = latency_metrics.to_json()
         if cache_event is not None and not cache_event.empty():
             body["cache_event"] = cache_event.to_json()
+        # Chaos hook: a dropped beat simulates the instance->master side of
+        # a partition (staleness suspicion / pruning paths).
+        faults.point("heartbeat.send", name=name, addr=self._addr)
         code, resp = post_json(self._addr, "/rpc/heartbeat", body, timeout=10.0)
         return resp if code == 200 else {"ok": False}
 

@@ -166,20 +166,73 @@ class FakeEngine:
 
     # -- generation ------------------------------------------------------ #
     def _run(self, req, skip_first: bool = False) -> None:
+        from xllm_service_tpu.common import faults
+
         try:
-            tokens = (
-                self.script
+            resume_from = int(getattr(req, "resume_from", 0) or 0)
+            prompt = list(req.prompt_token_ids)
+            if resume_from:
+                # Token-replay resume: the replayed suffix is generation
+                # output, not prompt — the echo script derives from the
+                # ORIGINAL prompt so the continuation is byte-identical to
+                # the unfaulted stream, and the replayed tokens are
+                # skipped instead of re-emitted.
+                prompt = prompt[:-resume_from]
+            full = (
+                list(self.script)
                 if self.script is not None
-                else list(reversed(req.prompt_token_ids))
-            )
-            n = min(len(tokens), req.sampling.max_new_tokens) or 1
-            tokens = (tokens or [0])[:n]
+                else list(reversed(prompt))
+            ) or [0]
+            # The serving layer already shrank max_new_tokens by the
+            # replayed count; the total budget fences the ORIGINAL script.
+            n = min(len(full), resume_from + req.sampling.max_new_tokens)
+            tokens = full[:max(n, 1)]
             gen_offset = 0
             if skip_first:
                 tokens = tokens[1:] or [0]
                 gen_offset = 1
+            if resume_from:
+                gen_offset = resume_from
+                tokens = tokens[resume_from:]
+                if not tokens:
+                    # Everything was already delivered before the kill —
+                    # close the stream cleanly with no fresh tokens.
+                    req.callback(
+                        RequestOutput(
+                            request_id=req.request_id,
+                            outputs=[SequenceOutput(
+                                index=0, token_ids=[],
+                                finish_reason=FinishReason.STOP,
+                            )],
+                            usage=Usage(len(req.prompt_token_ids), 0),
+                            finished=True,
+                        )
+                    )
+                    return
             time.sleep(self.ttft_ms / 1000.0)
             for i, tok in enumerate(tokens):
+                try:
+                    # Chaos hook: "drop" goes silent mid-stream (a hung or
+                    # dying engine), "error" surfaces an engine failure,
+                    # "delay" stretches the token gap.
+                    faults.point(
+                        "fake_engine.step",
+                        instance=getattr(self, "instance_name", ""),
+                        request_id=req.request_id,
+                        step=gen_offset + i,
+                    )
+                except faults.FaultInjected as fi:
+                    if fi.action == "error":
+                        req.callback(
+                            RequestOutput(
+                                request_id=req.request_id,
+                                status=Status(
+                                    StatusCode.UNAVAILABLE, str(fi)
+                                ),
+                                finished=True,
+                            )
+                        )
+                    return
                 with self._mu:
                     if self._cancelled.pop(req.request_id, False):
                         req.callback(
@@ -203,7 +256,14 @@ class FakeEngine:
                             ),
                         )
                     ],
-                    usage=Usage(len(req.prompt_token_ids), gen_offset + i + 1),
+                    # Resumed requests report FRESH generation only (the
+                    # service adds the replayed count back; the prompt it
+                    # subtracts) — skip_first (PD import) keeps reporting
+                    # the running total including the prefill token.
+                    usage=Usage(
+                        len(req.prompt_token_ids),
+                        (i + 1) if resume_from else (gen_offset + i + 1),
+                    ),
                     finished=last,
                 )
                 keep = req.callback(out)

@@ -13,10 +13,30 @@ from __future__ import annotations
 
 import http.client
 import json
+import random
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
+
+from xllm_service_tpu.common import faults
+
+
+class RequestNotSentError(ConnectionError):
+    """The request was never written to the socket — retrying it cannot
+    double-apply a non-idempotent operation. Any other failure out of
+    post_json/post_bytes is INDETERMINATE (the peer may have processed
+    the request) and must not be blindly retried."""
+
+
+def request_was_sent(exc: BaseException) -> bool:
+    """True when `exc` leaves the request outcome indeterminate."""
+    if isinstance(exc, RequestNotSentError):
+        return False
+    if isinstance(exc, faults.FaultInjected):
+        return exc.sent
+    return True
 
 
 class HttpJsonApi:
@@ -302,8 +322,14 @@ def post_json(
     """POST with one retry, but ONLY on send-time failures (stale kept-alive
     connection). Once the request has been written, a failure is raised, not
     retried — POSTs here are not idempotent (a re-send would dispatch the
-    same generation twice)."""
+    same generation twice). Send-time failures surface as
+    RequestNotSentError so callers (post_json_retrying) know a retry is
+    safe; anything later is indeterminate."""
     payload = json.dumps(body).encode("utf-8")
+    # Chaos hooks: "...send" simulates a request that never reaches the
+    # peer (partition/refused), "...recv" one that was delivered but whose
+    # response was lost (the indeterminate case).
+    faults.point("post_json.send", addr=addr, path=path)
     for attempt in (0, 1):
         conn = _conn_for(addr, timeout)
         try:
@@ -311,13 +337,16 @@ def post_json(
                 "POST", path, body=payload,
                 headers={"Content-Type": "application/json"},
             )
-        except Exception:
+        except Exception as e:
             conn.close()
             getattr(_tls, "conns", {}).pop(addr, None)
             if attempt:
-                raise
+                raise RequestNotSentError(
+                    f"POST {addr}{path} never sent: {e}"
+                ) from e
             continue
         try:
+            faults.point("post_json.recv", addr=addr, path=path)
             resp = conn.getresponse()
             data = resp.read()
             return resp.status, (json.loads(data) if data else {})
@@ -326,6 +355,81 @@ def post_json(
             getattr(_tls, "conns", {}).pop(addr, None)
             raise
     raise RuntimeError("unreachable")
+
+
+class RetryBudget:
+    """Global retry budget (token bucket): every first attempt deposits
+    `ratio` tokens, every retry withdraws one. Caps retry traffic at
+    ~ratio x the request rate fleet-wide, so one flapping instance can't
+    amplify into a retry storm. A `min_tokens` floor keeps sporadic
+    failures retryable at low request rates."""
+
+    def __init__(
+        self, ratio: float = 0.2, min_tokens: float = 10.0,
+        max_tokens: float = 100.0,
+    ):
+        self._ratio = float(ratio)
+        self._min = float(min_tokens)
+        self._max = float(max_tokens)
+        self._tokens = self._min
+        self._mu = threading.Lock()
+        self.exhausted_total = 0  # withdrawals refused
+
+    def deposit(self) -> None:
+        with self._mu:
+            self._tokens = min(self._tokens + self._ratio, self._max)
+
+    def withdraw(self) -> bool:
+        with self._mu:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            self.exhausted_total += 1
+            return False
+
+    @property
+    def tokens(self) -> float:
+        with self._mu:
+            return self._tokens
+
+
+def post_json_retrying(
+    addr: str,
+    path: str,
+    body: Dict[str, Any],
+    timeout: float = 30.0,
+    *,
+    attempts: int = 3,
+    budget: Optional[RetryBudget] = None,
+    idempotent: bool = False,
+    backoff_base_s: float = 0.05,
+    backoff_max_s: float = 2.0,
+) -> Tuple[int, Dict[str, Any]]:
+    """post_json under jittered exponential backoff.
+
+    Retries are gated three ways: the per-call `attempts` bound, the
+    shared `budget` (a refused withdrawal ends the retries immediately),
+    and the idempotency rule — non-idempotent calls retry ONLY failures
+    proven send-time (`request_was_sent` False); an indeterminate failure
+    re-raises at once so a generation can never be dispatched twice.
+    """
+    if budget is not None:
+        budget.deposit()
+    last: Optional[BaseException] = None
+    for i in range(max(attempts, 1)):
+        if i:
+            if budget is not None and not budget.withdraw():
+                break
+            delay = min(backoff_base_s * (2 ** (i - 1)), backoff_max_s)
+            time.sleep(delay * random.uniform(0.5, 1.5))
+        try:
+            return post_json(addr, path, body, timeout=timeout)
+        except Exception as e:  # noqa: BLE001 — classified below
+            last = e
+            if not idempotent and request_was_sent(e):
+                raise
+    assert last is not None
+    raise last
 
 
 def post_bytes(
@@ -340,11 +444,13 @@ def post_bytes(
                 "POST", path, body=data,
                 headers={"Content-Type": "application/octet-stream"},
             )
-        except Exception:
+        except Exception as e:
             conn.close()
             getattr(_tls, "conns", {}).pop(addr, None)
             if attempt:
-                raise
+                raise RequestNotSentError(
+                    f"POST {addr}{path} never sent: {e}"
+                ) from e
             continue
         try:
             resp = conn.getresponse()

@@ -124,6 +124,9 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
             name=f"inst-{engine_cfg.instance_name or port}",
         )
         self.name = engine_cfg.instance_name or f"{host}:{self.http.port}"
+        # Tag the engine so its fault-injection points (FakeEngine's step
+        # loop) can be matched per instance in a chaos spec.
+        setattr(self.engine, "instance_name", self.name)
         self.meta = InstanceMetaInfo(
             name=self.name,
             rpc_address=f"{host}:{self.http.port}",
@@ -487,6 +490,18 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
         route = h.route
         if route == "/hello":
             h.send_json({"message": f"hello from instance {self.name}"})
+        elif route == "/health":
+            # Breaker probe target: answering at all proves the HTTP plane
+            # is up; the payload lets the prober cross-check identity (a
+            # port reused by a different instance must not heal the old
+            # name's breaker).
+            h.send_json(
+                {
+                    "ok": True,
+                    "name": self.name,
+                    "role": self.meta.current_type.name,
+                }
+            )
         elif route == "/metrics":
             body = self._metrics_body().encode()
             h.send_response(200)
@@ -518,7 +533,17 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
         if body is None:
             h.send_error_json(400, "invalid JSON body")
             return
-        if route == "/v1/completions":
+        if route == "/health":
+            # POST twin of the GET probe: the master's breaker probes the
+            # dispatch (POST) plane, not just GET reachability.
+            h.send_json(
+                {
+                    "ok": True,
+                    "name": self.name,
+                    "role": self.meta.current_type.name,
+                }
+            )
+        elif route == "/v1/completions":
             self._serve(h, body, chat=False)
         elif route == "/v1/chat/completions":
             self._serve(h, body, chat=True)

@@ -444,6 +444,25 @@ class ServingMixin:
             h.send_json({"ok": True, "service_request_id": srid})
             return
         rid = generate_uuid(16)
+        # Mid-stream failover resume (docs/FAULT_TOLERANCE.md): the last
+        # `resume_from` token_ids are replayed output from a dead
+        # instance. The generation budget shrinks by the replayed count
+        # (the client already holds those tokens), and the engine-side
+        # marker keeps deterministic engines' continuations aligned.
+        resume_from = int(body.get("resume_from") or 0)
+        if resume_from:
+            if (
+                resume_from < 0  # would INFLATE the budget below
+                or resume_from >= len(token_ids)
+                or n > 1
+                or best_of > 1
+            ):
+                h.send_error_json(400, "invalid resume_from")
+                return
+            sampling = dataclasses.replace(
+                sampling,
+                max_new_tokens=max(sampling.max_new_tokens - resume_from, 1),
+            )
 
         if srid and self._master is not None:
             # Forwarded mode: ack now, stream back over /rpc/generations.
@@ -483,6 +502,11 @@ class ServingMixin:
                 # commits (adapter-blind hashes), so a PD split would ship
                 # a zero-block handoff and the decode peer would silently
                 # recompute the whole prompt.
+                decode_name = ""
+            if resume_from:
+                # Resumed requests serve colocated: the replay already
+                # paid one re-prefill; a PD handoff would bolt a second
+                # migration onto a recovery path that must stay simple.
                 decode_name = ""
             if decode_name and decode_name != self.name:
                 # PD disaggregation: this instance is the prefill side —
@@ -525,6 +549,7 @@ class ServingMixin:
                         mm_embeds=mm_embeds,
                         mm_positions=mm_positions,
                         mm_grids=body.get("mm_grids"),
+                        resume_from=resume_from,
                     )
                 )
             h.send_json({"ok": True, "service_request_id": srid, "request_id": rid})

@@ -29,18 +29,22 @@ from typing import Any, Dict, Optional
 
 from xllm_service_tpu.api.http_utils import (
     HttpJsonApi,
+    RetryBudget,
     SseWriter,
-    get_json,
     get_raw,
     make_http_server,
     post_json,
+    post_json_retrying,
 )
 from xllm_service_tpu.api.protocol import (
     augment_forwarded_request,
     output_from_json,
     parse_prompt_field,
 )
-from xllm_service_tpu.cluster.instance_mgr import instance_key
+from xllm_service_tpu.cluster.instance_mgr import (
+    HEALTH_STATE_VALUES,
+    instance_key,
+)
 from xllm_service_tpu.common.config import ServiceConfig
 from xllm_service_tpu.common.types import (
     InstanceMetaInfo,
@@ -209,6 +213,44 @@ class Master:
             "xllm_cluster_pd_flips_total",
             "Dynamic PREFILL<->DECODE role flips applied by the master",
         ).set_function(lambda: mgr.total_flips)
+        self.cluster_metrics.counter(
+            "xllm_cluster_breaker_ejections_total",
+            "Instances ejected by the health circuit breaker",
+        ).set_function(lambda: mgr.total_ejections)
+        self.cluster_metrics.counter(
+            "xllm_cluster_breaker_probe_recoveries_total",
+            "Ejected instances re-admitted to probation by a /health probe",
+        ).set_function(lambda: mgr.total_probe_recoveries)
+        # Global retry budget over control-plane POSTs (dispatch/cancel/
+        # encoder push): bounds fleet-wide retry amplification so one
+        # flapping instance can't start a retry storm.
+        self._retry_budget = RetryBudget(
+            ratio=getattr(config, "retry_budget_ratio", 0.2),
+            min_tokens=getattr(config, "retry_budget_min", 10.0),
+        )
+        self._retry_attempts = getattr(config, "dispatch_retry_attempts", 3)
+        self.cluster_metrics.counter(
+            "xllm_service_retry_budget_exhausted_total",
+            "Control-plane retries refused by the exhausted retry budget",
+        ).set_function(lambda: self._retry_budget.exhausted_total)
+
+        def health_probe(meta) -> bool:
+            # Breaker probe, deliberately POST-shaped: it exercises the
+            # SAME plane dispatch failures implicated (post_json), so a
+            # partition that kills dispatch also fails the probe instead
+            # of falsely healing the instance. Identity is cross-checked —
+            # a recycled port must not heal a dead instance's breaker.
+            code, resp = post_json(
+                meta.http_address, "/health", {}, timeout=2.0
+            )
+            return (
+                code == 200
+                and isinstance(resp, dict)
+                and bool(resp.get("ok"))
+                and resp.get("name") == meta.name
+            )
+
+        mgr.health_prober = health_probe
         self._m_scrape_failures = self.cluster_metrics.counter(
             "xllm_cluster_scrape_failures_total",
             "Instance /metrics scrapes that failed during aggregation",
@@ -387,6 +429,13 @@ class Master:
         fams["xllm_instance_kv_cache_usage"] = ("gauge", "", [
             (f'{{instance="{name}"}}', f"{m.gpu_cache_usage_perc:.4f}")
             for name, m in sorted(load.items())
+        ])
+        fams["xllm_instance_health_state"] = ("gauge", "", [
+            (
+                f'{{instance="{name}",state="{state}"}}',
+                str(HEALTH_STATE_VALUES.get(state, 0)),
+            )
+            for name, state in sorted(mgr.health_states().items())
         ])
         # Scrape each instance's registry-rendered /metrics and merge its
         # engine series under an instance label. Scrapes run CONCURRENTLY
@@ -572,18 +621,29 @@ class Master:
             self.scheduler.tracer.record(
                 req.service_request_id, "x_request_id", xrid
             )
+        # Mid-stream resume eligibility (docs/FAULT_TOLERANCE.md): token
+        # replay reconstructs exactly one sequence, guided FSM state does
+        # not survive a re-prefill of emitted tokens, and media embeddings
+        # would need a fresh encode pass — all of those fall back to the
+        # pre-token-only replay (then error-finish).
+        req.resumable = (
+            req.n <= 1
+            and int(body.get("best_of") or 1) <= 1
+            and not body.get("response_format")
+            and not req.media_parts
+        )
         stream = HttpClientStream(h, req.stream, x_request_id=xrid)
 
         path = "/v1/chat/completions" if chat else "/v1/completions"
+        mgr = self.scheduler.instance_mgr
 
         def dispatch() -> None:
             # Forward to the CURRENT routed prefill instance (re-resolved
             # per call: re-dispatch after instance death changes routing;
             # reference: service.cpp:147-191, ack-mode — tokens return via
-            # /rpc/generations).
-            meta = self.scheduler.instance_mgr.get_instance(
-                req.routing.prefill_name
-            )
+            # /rpc/generations). The wire id is attempt-versioned so a
+            # replaced attempt's late pushes can't reach the client.
+            meta = mgr.get_instance(req.routing.prefill_name)
             if meta is None:
                 self.scheduler.fail_request(
                     req.service_request_id,
@@ -591,13 +651,13 @@ class Master:
                     "prefill instance vanished",
                 )
                 return
+            wire = req.wire_srid or req.service_request_id
             if req.media_parts:
                 # EPD stage E: the encoder computes media embeddings and
                 # pushes them to the prefill peer's /mm/import BEFORE the
-                # text request arrives there.
-                enc = self.scheduler.instance_mgr.get_instance(
-                    req.routing.encode_name
-                )
+                # text request arrives there. Re-pushing embeddings is
+                # idempotent, so the retry wrapper may redeliver.
+                enc = mgr.get_instance(req.routing.encode_name)
                 if enc is None:
                     self.scheduler.fail_request(
                         req.service_request_id,
@@ -606,11 +666,11 @@ class Master:
                     )
                     return
                 try:
-                    code, resp = post_json(
+                    code, resp = post_json_retrying(
                         enc.http_address,
                         "/encode",
                         {
-                            "service_request_id": req.service_request_id,
+                            "service_request_id": wire,
                             "parts": req.media_parts,
                             "positions": req.mm_positions,
                             "target": meta.http_address,
@@ -618,28 +678,61 @@ class Master:
                         # Generous: the encoder's FIRST request pays its
                         # XLA compile inside this call.
                         timeout=180.0,
+                        attempts=self._retry_attempts,
+                        budget=self._retry_budget,
+                        idempotent=True,
                     )
                 except Exception as e:
                     code, resp = 0, str(e)
                 if code != 200:
+                    # Breaker signal only for transport failures and
+                    # instance-side (5xx) errors: a client's bad media
+                    # (4xx) must never eject a healthy encoder.
+                    if code == 0 or code >= 500:
+                        mgr.record_dispatch_failure(enc.name)
+                    else:
+                        mgr.record_dispatch_success(enc.name)
                     self.scheduler.fail_request(
                         req.service_request_id,
                         StatusCode.UNAVAILABLE,
                         f"encoder failed: {resp}",
                     )
                     return
+                mgr.record_dispatch_success(enc.name)
             fwd = augment_forwarded_request(
-                body, req.service_request_id, req.token_ids, req.routing,
+                body, wire, req.resume_token_ids or req.token_ids,
+                req.routing,
                 decode_response_to_service=(
                     self.config.enable_decode_response_to_service
                 ),
             )
+            if req.resume_base:
+                # Token-replay resume: the last resume_base token_ids are
+                # replayed output, not prompt — the instance fences its
+                # generation budget and (FakeEngine) its echo script on it.
+                fwd["resume_from"] = req.resume_base
             if req.mm_positions:
                 fwd["mm_positions"] = list(req.mm_positions)
                 if req.mm_grids:
                     fwd["mm_grids"] = [list(g) for g in req.mm_grids]
             try:
-                code, resp = post_json(meta.http_address, path, fwd, timeout=30.0)
+                # Dispatch is NOT idempotent: the wrapper only retries
+                # failures proven send-time (request never written); an
+                # indeterminate failure falls through to replay on another
+                # instance under a fresh wire id.
+                code, resp = post_json_retrying(
+                    meta.http_address, path, fwd, timeout=30.0,
+                    attempts=self._retry_attempts,
+                    budget=self._retry_budget,
+                )
+                # Breaker signal: a 5xx is an instance-side failure (a
+                # wedged engine behind a live HTTP plane must still trip
+                # the breaker); a 4xx is the CLIENT's error and proves the
+                # instance healthy.
+                if code >= 500:
+                    mgr.record_dispatch_failure(meta.name)
+                else:
+                    mgr.record_dispatch_success(meta.name)
                 if code != 200:
                     # A 4xx from the instance is the CLIENT's error
                     # (e.g. invalid logit_bias) — relay it as such
@@ -657,11 +750,19 @@ class Master:
                         f"prefill rejected: {msg}",
                     )
             except Exception as e:
-                # Fast failure (connection refused / timeout): try another
-                # instance before giving up — lease expiry would take
-                # seconds to notice.
-                if not self.scheduler.redispatch_request(
-                    req.service_request_id, exclude=meta.name
+                # Fast failure (connection refused / timeout): feed the
+                # breaker, then try another instance before giving up —
+                # lease expiry would take seconds to notice. Pre-token
+                # requests replay whole; mid-stream ones resume by token
+                # replay.
+                mgr.record_dispatch_failure(meta.name)
+                if not (
+                    self.scheduler.redispatch_request(
+                        req.service_request_id, exclude=meta.name
+                    )
+                    or self.scheduler.resume_request(
+                        req.service_request_id, exclude=meta.name
+                    )
                 ):
                     self.scheduler.fail_request(
                         req.service_request_id,
@@ -696,19 +797,36 @@ class Master:
         h.hold(stream, self._request_timeout_s, fail_deadline)
 
     def _cancel_on_instance(self, req: ServiceRequest) -> None:
+        """Propagate a client cancel to the routed instance(s). /cancel is
+        idempotent, so the retry wrapper may redeliver; failures feed the
+        breaker and the xllm_service_cancel_errors_total counter instead
+        of vanishing silently (a dead cancel path leaks engine work)."""
         for name in {req.routing.prefill_name, req.routing.decode_name}:
             meta = self.scheduler.instance_mgr.get_instance(name)
             if meta is None:
                 continue
             try:
-                post_json(
+                post_json_retrying(
                     meta.http_address,
                     "/cancel",
-                    {"service_request_id": req.service_request_id},
+                    {
+                        "service_request_id": (
+                            req.wire_srid or req.service_request_id
+                        ),
+                    },
                     timeout=5.0,
+                    attempts=self._retry_attempts,
+                    budget=self._retry_budget,
+                    idempotent=True,
                 )
-            except Exception:
-                pass
+                self.scheduler.instance_mgr.record_dispatch_success(name)
+            except Exception as e:
+                self.scheduler.m_cancel_errors.inc()
+                self.scheduler.instance_mgr.record_dispatch_failure(name)
+                logger.debug(
+                    "cancel of %s on %s failed: %s",
+                    req.service_request_id, name, e,
+                )
 
     # ------------------------------------------------------------------ #
     # instance plane

@@ -44,6 +44,45 @@ from xllm_service_tpu.coordination.store import (
 
 logger = logging.getLogger(__name__)
 
+
+class HealthState:
+    """Per-instance circuit-breaker states (string constants — they label
+    metrics and JSON surfaces).
+
+        healthy ──failures──▶ suspect ──more failures──▶ ejected
+           ▲                     │                          │
+           │◀──success/beat──────┘            /health probe ▼
+           └──────────first success────────────────── probation
+
+    healthy/probation route normally; suspect routes only when nothing
+    healthier exists; ejected never routes and is re-admitted only
+    through an active /health probe.
+    """
+
+    HEALTHY = "healthy"
+    SUSPECT = "suspect"
+    EJECTED = "ejected"
+    PROBATION = "probation"
+
+
+# Numeric encoding for the xllm_instance_health_state gauge.
+HEALTH_STATE_VALUES: Dict[str, int] = {
+    HealthState.HEALTHY: 0,
+    HealthState.SUSPECT: 1,
+    HealthState.EJECTED: 2,
+    HealthState.PROBATION: 3,
+}
+
+
+class _Health:
+    __slots__ = ("state", "consecutive_failures", "last_probe_mono")
+
+    def __init__(self) -> None:
+        self.state = HealthState.HEALTHY
+        self.consecutive_failures = 0
+        self.last_probe_mono = 0.0
+
+
 # Store key prefixes (reference: instance_mgr.cpp:31-39; ENCODE is new).
 INSTANCE_PREFIXES: Dict[InstanceType, str] = {
     InstanceType.DEFAULT: "XLLM:DEFAULT:",
@@ -65,10 +104,25 @@ class InstanceMgr:
         store: CoordinationStore,
         is_master: Callable[[], bool],
         detect_disconnected_interval_s: float = 15.0,
+        suspect_failures: int = 2,
+        eject_failures: int = 4,
+        probe_min_interval_s: float = 1.0,
     ) -> None:
         self._store = store
         self._is_master = is_master
         self._stale_after_s = detect_disconnected_interval_s
+        # Circuit breaker (docs/FAULT_TOLERANCE.md): consecutive
+        # dispatch/cancel failures drive healthy -> suspect -> ejected;
+        # heartbeat staleness past half the prune interval also suspects.
+        self._suspect_failures = max(int(suspect_failures), 1)
+        self._eject_failures = max(int(eject_failures), self._suspect_failures)
+        self._probe_min_interval_s = probe_min_interval_s
+        self._health: Dict[str, _Health] = {}
+        # Installed by the Master: meta -> bool active /health probe used
+        # to re-admit ejected instances (probation on success).
+        self.health_prober: Optional[Callable[[InstanceMetaInfo], bool]] = None
+        self.total_ejections = 0
+        self.total_probe_recoveries = 0
         self._mu = threading.RLock()
         # Pending (name, attempt) role flips awaiting instance notification.
         self._flip_events: List[Tuple[str, int]] = []
@@ -174,6 +228,9 @@ class InstanceMgr:
             self._latency_metrics[meta.name] = LatencyMetrics()
             self._load_metrics.setdefault(meta.name, LoadMetrics())
             self._heartbeat_ts[meta.name] = time.monotonic()
+            # A fresh registration starts with a clean breaker: the lease
+            # write proves the instance is up NOW.
+            self._health[meta.name] = _Health()
             role = self._initial_role(meta)
             meta.current_type = role
             self._push_index(meta.name, role)
@@ -236,6 +293,7 @@ class InstanceMgr:
             self._load_metrics.pop(name, None)
             self._heartbeat_ts.pop(name, None)
             self._dirty_load.discard(name)
+            self._health.pop(name, None)
             logger.info("instance %s removed", name)
         for fn in self._removal_listeners:
             try:
@@ -358,27 +416,203 @@ class InstanceMgr:
             return self._latency_metrics.get(name)
 
     # ------------------------------------------------------------------ #
+    # health circuit breaker
+    # ------------------------------------------------------------------ #
+
+    def health_state(self, name: str) -> str:
+        with self._mu:
+            h = self._health.get(name)
+            return h.state if h is not None else HealthState.HEALTHY
+
+    def health_states(self) -> Dict[str, str]:
+        with self._mu:
+            return {n: h.state for n, h in self._health.items()}
+
+    def record_dispatch_success(self, name: str) -> None:
+        """A control-plane call to the instance succeeded: close the
+        breaker (probation's first success graduates to healthy)."""
+        with self._mu:
+            h = self._health.get(name)
+            if h is None:
+                return
+            h.consecutive_failures = 0
+            if h.state != HealthState.HEALTHY:
+                logger.info(
+                    "instance %s breaker %s -> healthy", name, h.state
+                )
+                h.state = HealthState.HEALTHY
+
+    def record_dispatch_failure(self, name: str) -> str:
+        """One consecutive dispatch/cancel failure. Returns the resulting
+        state. A failure during probation re-ejects immediately (the probe
+        lied); otherwise the suspect/eject thresholds apply."""
+        with self._mu:
+            h = self._health.get(name)
+            if h is None:
+                return HealthState.HEALTHY
+            h.consecutive_failures += 1
+            prev = h.state
+            if prev == HealthState.PROBATION:
+                h.state = HealthState.EJECTED
+            elif h.consecutive_failures >= self._eject_failures:
+                h.state = HealthState.EJECTED
+            elif h.consecutive_failures >= self._suspect_failures:
+                if prev == HealthState.HEALTHY:
+                    h.state = HealthState.SUSPECT
+            if h.state != prev:
+                logger.warning(
+                    "instance %s breaker %s -> %s (%d consecutive failures)",
+                    name, prev, h.state, h.consecutive_failures,
+                )
+                if h.state == HealthState.EJECTED:
+                    self.total_ejections += 1
+                    h.last_probe_mono = 0.0  # probe as soon as possible
+            return h.state
+
+    def _beat_observed(self, name: str) -> None:
+        """A live heartbeat clears staleness-driven suspicion (failure-
+        driven suspicion clears only through dispatch success)."""
+        h = self._health.get(name)
+        if (
+            h is not None
+            and h.state == HealthState.SUSPECT
+            and h.consecutive_failures < self._suspect_failures
+        ):
+            h.state = HealthState.HEALTHY
+
+    def mark_stale_suspects(self) -> List[str]:
+        """Pre-prune staleness signal: an instance silent for half the
+        prune interval turns suspect (routing avoids it) well before the
+        prune backstop removes it."""
+        now = time.monotonic()
+        marked: List[str] = []
+        with self._mu:
+            for name, ts in self._heartbeat_ts.items():
+                h = self._health.get(name)
+                if (
+                    h is not None
+                    and h.state == HealthState.HEALTHY
+                    and now - ts > self._stale_after_s * 0.5
+                ):
+                    h.state = HealthState.SUSPECT
+                    marked.append(name)
+        for name in marked:
+            logger.warning("instance %s suspect: heartbeats stale", name)
+        return marked
+
+    def probe_unhealthy(self) -> int:
+        """Active breaker drive: fire a /health probe (the installed
+        health_prober) at each non-healthy instance at most once per
+        probe_min_interval_s. A routing-avoided suspect would otherwise
+        never see the traffic that could heal OR convict it — the probe
+        supplies that evidence: suspect + probe ok -> healthy, suspect +
+        probe failure -> one more consecutive failure (escalating to
+        ejected); ejected + probe ok -> probation. Probes run on daemon
+        threads so a dead endpoint's connect timeout never blocks the
+        master loop. Returns the number of probes launched."""
+        prober = self.health_prober
+        if prober is None:
+            return 0
+        now = time.monotonic()
+        due: List[InstanceMetaInfo] = []
+        with self._mu:
+            for name, h in self._health.items():
+                if h.state not in (HealthState.EJECTED, HealthState.SUSPECT):
+                    continue
+                if now - h.last_probe_mono < self._probe_min_interval_s:
+                    continue
+                meta = self._instances.get(name)
+                if meta is None:
+                    continue
+                h.last_probe_mono = now
+                due.append(meta)
+        for meta in due:
+            threading.Thread(
+                target=self._probe_one,
+                args=(prober, meta),
+                name=f"health-probe-{meta.name}",
+                daemon=True,
+            ).start()
+        return len(due)
+
+    def _probe_one(self, prober, meta: InstanceMetaInfo) -> None:
+        try:
+            ok = bool(prober(meta))
+        except Exception:
+            ok = False
+        escalate = False
+        with self._mu:
+            h = self._health.get(meta.name)
+            if h is None:
+                return
+            if h.state == HealthState.EJECTED and ok:
+                h.state = HealthState.PROBATION
+                h.consecutive_failures = 0
+                self.total_probe_recoveries += 1
+                logger.info(
+                    "instance %s /health probe ok: ejected -> probation",
+                    meta.name,
+                )
+            elif h.state == HealthState.SUSPECT:
+                if ok:
+                    h.state = HealthState.HEALTHY
+                    h.consecutive_failures = 0
+                    logger.info(
+                        "instance %s /health probe ok: suspect -> healthy",
+                        meta.name,
+                    )
+                else:
+                    escalate = True
+        if escalate:
+            self.record_dispatch_failure(meta.name)
+
+    def _routable(self, names: List[str]) -> List[str]:
+        """Health filter under self._mu: healthy/probation first; suspect
+        only as a last resort; ejected never."""
+        good, fallback = [], []
+        for n in names:
+            h = self._health.get(n)
+            state = h.state if h is not None else HealthState.HEALTHY
+            if state in (HealthState.HEALTHY, HealthState.PROBATION):
+                good.append(n)
+            elif state == HealthState.SUSPECT:
+                fallback.append(n)
+        return good or fallback
+
+    def routable_prefill_instances(self) -> List[str]:
+        with self._mu:
+            return self._routable(self._prefill_index)
+
+    def routable_decode_instances(self) -> List[str]:
+        with self._mu:
+            return self._routable(self._decode_index)
+
+    # ------------------------------------------------------------------ #
     # routing primitives
     # ------------------------------------------------------------------ #
 
     def get_next_instance_pair(self) -> Routing:
         """Round-robin prefill+decode pair
         (reference: instance_mgr.cpp:170-186). With no decode instances the
-        prefill instance serves both roles (colocated deployment)."""
+        prefill instance serves both roles (colocated deployment). The
+        health breaker filters the candidate lists: ejected instances are
+        never picked, suspect ones only when nothing healthier exists."""
         with self._mu:
             routing = Routing()
-            if self._prefill_index:
-                routing.prefill_name = self._prefill_index[
-                    self._rr_prefill % len(self._prefill_index)
+            prefill = self._routable(self._prefill_index)
+            decode = self._routable(self._decode_index)
+            if prefill:
+                routing.prefill_name = prefill[
+                    self._rr_prefill % len(prefill)
                 ]
                 self._rr_prefill += 1
-            elif self._decode_index:
-                routing.prefill_name = self._decode_index[
-                    self._rr_decode % len(self._decode_index)
+            elif decode:
+                routing.prefill_name = decode[
+                    self._rr_decode % len(decode)
                 ]
-            if self._decode_index:
-                routing.decode_name = self._decode_index[
-                    self._rr_decode % len(self._decode_index)
+            if decode:
+                routing.decode_name = decode[
+                    self._rr_decode % len(decode)
                 ]
                 self._rr_decode += 1
             else:
@@ -394,7 +628,7 @@ class InstanceMgr:
         required = set(required)
         with self._mu:
             candidates = [
-                n for n in self._encode_index
+                n for n in self._routable(self._encode_index)
                 if not required
                 or not (m := self._instances.get(n)) or not m.modalities
                 or required <= set(m.modalities)
@@ -415,8 +649,10 @@ class InstanceMgr:
 
     def least_loaded(self, candidates: List[str]) -> str:
         """Fallback selection by (waiting, cache usage) — the reference's
-        least-loaded path inside get_load_metrics."""
+        least-loaded path inside get_load_metrics. Candidates the breaker
+        has ejected are skipped."""
         with self._mu:
+            candidates = self._routable(list(candidates))
             best, best_key = "", None
             for name in candidates:
                 m = self._load_metrics.get(name, LoadMetrics())
@@ -436,6 +672,7 @@ class InstanceMgr:
             self._load_metrics[name] = metrics
             self._heartbeat_ts[name] = time.monotonic()
             self._dirty_load.add(name)
+            self._beat_observed(name)
 
     def update_latency_metrics(self, name: str, metrics: LatencyMetrics) -> None:
         with self._mu:
@@ -562,8 +799,8 @@ class InstanceMgr:
         Falls back to round-robin when predictors are absent.
         """
         with self._mu:
-            prefill_candidates = list(self._prefill_index)
-            decode_candidates = list(self._decode_index)
+            prefill_candidates = self._routable(self._prefill_index)
+            decode_candidates = self._routable(self._decode_index)
             have_models = any(
                 self._predictors.get(n) is not None
                 and self._predictors[n].has_ttft_model

@@ -72,11 +72,15 @@ class CacheAwareRouting(LoadBalancePolicy):
         max_waiting = max(
             (m.waiting_requests_num for m in load.values()), default=0
         )
+        # Health-filtered candidates: the breaker's ejected instances are
+        # excluded; suspect ones only surface when nothing healthier exists.
         prefill = self._pick(
-            self._instance_mgr.prefill_instances(), scores, load, max_waiting
+            self._instance_mgr.routable_prefill_instances(),
+            scores, load, max_waiting,
         )
         decode = self._pick(
-            self._instance_mgr.decode_instances(), scores, load, max_waiting
+            self._instance_mgr.routable_decode_instances(),
+            scores, load, max_waiting,
         )
         if not prefill and not decode:
             return self._instance_mgr.get_next_instance_pair()

@@ -74,6 +74,25 @@ class ServiceConfig:
     # lower this explicitly.
     instance_lease_min_ttl_s: float = 10.0
 
+    # Fault hardening (docs/FAULT_TOLERANCE.md). Control-plane POSTs
+    # (dispatch/cancel/encoder push) retry with jittered exponential
+    # backoff up to this many attempts...
+    dispatch_retry_attempts: int = 3
+    # ...gated by a GLOBAL retry budget: every first attempt deposits
+    # `ratio` tokens, every retry spends one (min_tokens floors the
+    # bucket), so one flapping instance can't trigger a retry storm.
+    retry_budget_ratio: float = 0.2
+    retry_budget_min: float = 10.0
+    # Circuit breaker: consecutive dispatch/cancel failures per instance
+    # before it turns suspect (deprioritized) / ejected (unroutable until
+    # an active /health probe passes).
+    breaker_suspect_failures: int = 2
+    breaker_eject_failures: int = 4
+    # Mid-stream failover: total transparent replay attempts per request
+    # (pre-first-token redispatch and token-replay resume share the
+    # bound).
+    max_redispatch: int = 2
+
     # Tokenizer / template (reference: --tokenizer_path).
     tokenizer_path: str = ""
 

@@ -1,0 +1,245 @@
+"""Deterministic fault injection for the service/control plane.
+
+P/D-Serve (arXiv:2408.08147) and the xLLM technical report
+(arXiv:2510.14686) both treat fast failure detection and transparent
+retry as first-class service duties — which means the recovery paths
+need to be *exercised reproducibly*, not just written. This module is
+the single switchboard: production code marks named injection points
+with `faults.point(<name>, **ctx)` (a no-op unless a plan is
+installed), and tests / `bench_serving.py --chaos-spec` install a
+seeded `FaultPlan` that decides — deterministically — which hits
+drop, delay, error, or partition.
+
+Point names are string literals at their call sites; uniqueness and
+test coverage are linted by `scripts/check_fault_points.py` (wired
+next to `check_metric_names.py`).
+
+Plan spec (JSON, via `install_spec`, `--chaos-spec`, or the
+`XLLM_CHAOS_SPEC` env var read at first use):
+
+    {"seed": 0,
+     "rules": [
+       {"point": "post_json.send",   # exact injection-point name
+        "match": "127.0.0.1:9999",   # substring over the ctx values
+        "action": "error",           # drop | delay | error | partition
+        "prob": 1.0,                 # seeded Bernoulli per hit
+        "after": 3,                  # skip the first N matching hits
+        "count": 2,                  # fire at most N times (0 = forever)
+        "delay_ms": 50}]}            # action=delay sleep
+
+Actions, as seen by the call site:
+  * drop      — raise FaultInjected (the operation never happens);
+  * error     — raise FaultInjected tagged `sent=True` (the operation
+                may or may not have happened: the indeterminate case);
+  * partition — alias of drop, conventionally matched on an address /
+                instance name so both directions of a link fail;
+  * delay     — time.sleep(delay_ms) then proceed normally.
+
+Determinism: each rule owns a `random.Random(seed ^ crc(point|idx))`
+stream and its own hit/fire counters, so a plan replayed against the
+same call sequence injects at exactly the same hits. Concurrency can
+reorder *which thread* sees a given hit; specs that need per-instance
+determinism should match on the instance/address in ctx.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import zlib
+from dataclasses import dataclass, field
+from random import Random
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "FaultInjected",
+    "FaultRule",
+    "FaultPlan",
+    "point",
+    "install_plan",
+    "install_spec",
+    "clear",
+    "get_plan",
+]
+
+
+class FaultInjected(ConnectionError):
+    """Raised at an injection point for drop/error/partition actions.
+
+    Subclasses ConnectionError so existing except-paths treat it like
+    the network failure it simulates. `sent` mirrors the http_utils
+    retry contract: False = the operation definitely never happened
+    (safe to retry), True = indeterminate.
+    """
+
+    def __init__(self, point_name: str, action: str, sent: bool = False):
+        super().__init__(f"injected {action} at {point_name}")
+        self.point_name = point_name
+        self.action = action
+        self.sent = sent
+
+
+_ACTIONS = ("drop", "delay", "error", "partition")
+
+
+@dataclass
+class FaultRule:
+    point: str
+    action: str = "drop"
+    match: str = ""
+    prob: float = 1.0
+    after: int = 0
+    count: int = 0  # 0 = unlimited
+    delay_ms: float = 0.0
+    # runtime state (not part of the spec)
+    hits: int = 0
+    fired: int = 0
+    _rng: Optional[Random] = field(default=None, repr=False)
+
+    def __post_init__(self) -> None:
+        if self.action not in _ACTIONS:
+            raise ValueError(
+                f"fault action {self.action!r} not in {_ACTIONS}"
+            )
+
+    def seed_rng(self, seed: int, idx: int) -> None:
+        tag = zlib.crc32(f"{self.point}|{idx}".encode())
+        self._rng = Random((seed ^ tag) & 0xFFFFFFFF)
+
+    def matches(self, name: str, ctx: Dict[str, Any]) -> bool:
+        if name != self.point:
+            return False
+        if not self.match:
+            return True
+        return any(self.match in str(v) for v in ctx.values())
+
+    def decide(self) -> bool:
+        """One matching hit: fire or not (mutates counters)."""
+        self.hits += 1
+        if self.hits <= self.after:
+            return False
+        if self.count and self.fired >= self.count:
+            return False
+        if self.prob < 1.0:
+            rng = self._rng or Random(0)
+            if rng.random() >= self.prob:
+                return False
+        self.fired += 1
+        return True
+
+
+class FaultPlan:
+    """A seeded set of rules; thread-safe; installable process-wide."""
+
+    def __init__(self, seed: int = 0, rules: Optional[List[FaultRule]] = None):
+        self.seed = int(seed)
+        self._mu = threading.Lock()
+        self._rules: List[FaultRule] = []
+        for r in rules or []:
+            self.add_rule(r)
+
+    @classmethod
+    def from_spec(cls, spec) -> "FaultPlan":
+        """Build from a dict, a JSON string, or an `@path` JSON file."""
+        if isinstance(spec, str):
+            if spec.startswith("@"):
+                with open(spec[1:]) as f:
+                    spec = json.load(f)
+            else:
+                spec = json.loads(spec)
+        if not isinstance(spec, dict):
+            raise ValueError("fault spec must be a JSON object")
+        plan = cls(seed=int(spec.get("seed", 0)))
+        for j in spec.get("rules", []):
+            plan.add_rule(FaultRule(**{
+                k: j[k]
+                for k in ("point", "action", "match", "prob", "after",
+                          "count", "delay_ms")
+                if k in j
+            }))
+        return plan
+
+    def add_rule(self, rule: FaultRule) -> FaultRule:
+        with self._mu:
+            rule.seed_rng(self.seed, len(self._rules))
+            self._rules.append(rule)
+        return rule
+
+    def remove_rule(self, rule: FaultRule) -> None:
+        with self._mu:
+            try:
+                self._rules.remove(rule)
+            except ValueError:
+                pass
+
+    def rules(self) -> List[FaultRule]:
+        with self._mu:
+            return list(self._rules)
+
+    def fire(self, name: str, ctx: Dict[str, Any]) -> None:
+        with self._mu:
+            todo = [
+                r for r in self._rules
+                if r.matches(name, ctx) and r.decide()
+            ]
+        for r in todo:
+            if r.action == "delay":
+                time.sleep(r.delay_ms / 1000.0)
+            elif r.action == "error":
+                raise FaultInjected(name, r.action, sent=True)
+            else:  # drop / partition
+                raise FaultInjected(name, r.action, sent=False)
+
+
+# ---------------------------------------------------------------------------
+# process-wide installation
+# ---------------------------------------------------------------------------
+
+_install_mu = threading.Lock()
+_plan: Optional[FaultPlan] = None
+_env_checked = False
+
+
+def install_plan(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install (or, with None, clear) the process-wide plan."""
+    global _plan, _env_checked
+    with _install_mu:
+        _plan = plan
+        _env_checked = True  # an explicit install overrides the env
+    return plan
+
+
+def install_spec(spec) -> FaultPlan:
+    return install_plan(FaultPlan.from_spec(spec))
+
+
+def clear() -> None:
+    install_plan(None)
+
+
+def get_plan() -> Optional[FaultPlan]:
+    global _env_checked, _plan
+    if not _env_checked:
+        with _install_mu:
+            if not _env_checked:
+                import os
+
+                raw = os.environ.get("XLLM_CHAOS_SPEC", "")
+                if raw:
+                    try:
+                        _plan = FaultPlan.from_spec(raw)
+                    except Exception:
+                        _plan = None
+                _env_checked = True
+    return _plan
+
+
+def point(name: str, /, **ctx: Any) -> None:
+    """Mark one named injection point. No-op (one global read + None
+    check) unless a plan is installed; may sleep or raise FaultInjected
+    when a rule fires."""
+    plan = get_plan()
+    if plan is None:
+        return
+    plan.fire(name, ctx)

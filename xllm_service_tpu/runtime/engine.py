@@ -84,6 +84,13 @@ class EngineRequest:
     schema: Optional[dict] = None
     # Multi-LoRA adapter row in the executor's stacks (0 = base model).
     adapter_idx: int = 0
+    # Mid-stream failover resume: the last `resume_from` entries of
+    # prompt_token_ids are REPLAYED generation output from a dead
+    # instance, not client prompt. The real engine needs no special
+    # handling (re-prefill + continue IS resume; prefix caching makes the
+    # replay cheap); deterministic stand-ins (FakeEngine) use it to keep
+    # the continuation byte-identical to the unfaulted stream.
+    resume_from: int = 0
     # Hybrid online/offline (north-star config 5; reference vestige
     # request.h:38, unconsumed there): offline work admits only behind
     # online work and its RUNNING decodes are preempted (recompute-style)

@@ -62,6 +62,17 @@ class ServiceRequest:
     # Filled by the scheduler:
     num_generated_tokens: int = 0
     estimated_ttft_ms: float = 0.0
+    # Mid-stream failover (docs/FAULT_TOLERANCE.md). `wire_srid` is the
+    # on-the-wire service_request_id for the CURRENT dispatch attempt —
+    # the bare id for attempt 0, `<id>#rN` after N replays; outputs
+    # carrying an older wire id are late pushes from a dead attempt and
+    # are dropped. `resumable` is computed at admission (n=1/best_of=1,
+    # non-guided, no media); `resume_token_ids` is prompt + every
+    # delivered token, `resume_base` the replayed-token count.
+    wire_srid: str = ""
+    resumable: bool = True
+    resume_token_ids: List[int] = field(default_factory=list)
+    resume_base: int = 0
     # Tracing hook (reference: Request::trace_callback, service.cpp:212-218).
     trace_callback: Optional[Callable[[str, Any], None]] = None
 

@@ -41,6 +41,7 @@ from xllm_service_tpu.common.types import (
     LoadMetrics,
     RequestAction,
     RequestOutput,
+    Routing,
     SequenceOutput,
     Status,
     StatusCode,
@@ -81,6 +82,18 @@ class _RequestState:
     redispatch_count: int = 0
     first_chunk_sent: bool = False
     prefill_finished: bool = False
+    # Dispatch-attempt epoch: bumped on every replay; outputs arriving
+    # under an older wire id (request.wire_srid) are late pushes from a
+    # dead attempt and must never reach the client stream.
+    attempt: int = 0
+    # Thread id of an in-flight replay (0 = none): two failure signals —
+    # e.g. the master's dispatch-exception handler and the removal
+    # listener — must not replay the same request concurrently (double
+    # dispatch); same-thread re-entry stays allowed for nested recovery.
+    replaying: int = 0
+    # Monotonic stamp of an in-flight resume (cleared by the first fresh
+    # delivery; feeds the resume-latency histogram).
+    resume_mono: float = 0.0
     # Observability timestamps (one monotonic clock): registration,
     # first dispatch, first token, and the latest token delivery.
     sched_mono: float = 0.0
@@ -132,6 +145,25 @@ class Scheduler:
             "xllm_service_redispatches_total",
             "Requests transparently replayed after instance death",
         ).set_function(lambda: self.total_redispatches)
+        self.metrics.counter(
+            "xllm_service_redispatch_attempts_total",
+            "Replay attempts (redispatch + resume), successful or not",
+        ).set_function(lambda: self.total_redispatch_attempts)
+        self.metrics.counter(
+            "xllm_service_resumes_total",
+            "Mid-stream token-replay resumes completed after instance "
+            "death",
+        ).set_function(lambda: self.total_resumes)
+        self.m_cancel_errors = self.metrics.counter(
+            "xllm_service_cancel_errors_total",
+            "Instance /cancel calls that failed (previously swallowed "
+            "silently)",
+        )
+        self._m_resume_latency = self.metrics.histogram(
+            "xllm_service_resume_latency_ms",
+            "Resume initiation -> first post-resume token delivery",
+            buckets=LATENCY_BUCKETS_MS,
+        )
         self._m_ttft = self.metrics.histogram(
             "xllm_service_ttft_ms",
             "Client-perceived time to first token (schedule -> first "
@@ -180,6 +212,8 @@ class Scheduler:
             detect_disconnected_interval_s=(
                 config.detect_disconnected_instance_interval_s
             ),
+            suspect_failures=getattr(config, "breaker_suspect_failures", 2),
+            eject_failures=getattr(config, "breaker_eject_failures", 4),
         )
         self._kvcache_mgr = GlobalKVCacheMgr(
             self._store,
@@ -203,10 +237,12 @@ class Scheduler:
         self._instance_mgr.add_removal_listener(
             self._kvcache_mgr.remove_instance
         )
-        self.max_redispatch = 2
+        self.max_redispatch = getattr(config, "max_redispatch", 2)
         # Cluster-lifetime fault accounting (aggregated /metrics +
         # bench_serving's fault-injection report).
         self.total_redispatches = 0
+        self.total_redispatch_attempts = 0
+        self.total_resumes = 0
 
         self._mu = threading.Lock()
         self._requests: Dict[str, _RequestState] = {}
@@ -274,6 +310,11 @@ class Scheduler:
             try:
                 self._kvcache_mgr.upload_kvcache()
                 self._instance_mgr.upload_load_metrics()
+                # Health breaker upkeep: silent instances turn suspect
+                # before the prune backstop removes them, and ejected ones
+                # get an active /health probe toward probation.
+                self._instance_mgr.mark_stale_suspects()
+                self._instance_mgr.probe_unhealthy()
                 # pruning fires the removal listeners (re-dispatch + cache
                 # index cleanup)
                 self._instance_mgr.prune_disconnected()
@@ -698,6 +739,7 @@ class Scheduler:
             cancel_callback=cancel_callback,
             sched_mono=time.monotonic(),
         )
+        request.wire_srid = request.service_request_id
 
         if dispatch is not None:
             def dispatch_instrumented() -> None:
@@ -728,12 +770,19 @@ class Scheduler:
     def handle_generation(self, output: RequestOutput) -> bool:
         """One engine step for one request; serialized per request via its
         lane (reference: scheduler.cpp:293-336). Returns False when the
-        request is unknown (finished/cancelled) so the caller can stop the
-        upstream stream."""
+        request is unknown (finished/cancelled) OR the output carries a
+        stale attempt's wire id — both tell the caller to stop the
+        upstream stream. Outputs arrive keyed by the attempt-versioned
+        wire id (`<srid>` or `<srid>#rN`, service/request.py); a replaced
+        attempt's late pushes must not interleave with the live one."""
+        wire = output.service_request_id
+        base, _, _ = wire.partition("#r")
         with self._mu:
-            state = self._requests.get(output.service_request_id)
+            state = self._requests.get(base)
         if state is None or state.done:
             return False
+        if wire != (state.request.wire_srid or base):
+            return False  # late push from a replaced dispatch attempt
         self._streams.submit(state.lane, lambda: self._deliver(state, output))
         return True
 
@@ -743,6 +792,20 @@ class Scheduler:
             # queued in the lane — never write after the exchange ended.
             return
         request = state.request
+        if output.service_request_id != (
+            request.wire_srid or request.service_request_id
+        ):
+            # A resume raced this already-queued delivery: the attempt it
+            # belongs to was replaced after handle_generation admitted it.
+            return
+        if request.resume_base and output.usage is not None:
+            # Normalize the resumed attempt's local view back to the
+            # client's: the replayed tokens ride as prompt on the wire
+            # (prompt + acc), but the client sees them as generated.
+            output.usage.num_prompt_tokens = max(
+                0, output.usage.num_prompt_tokens - request.resume_base
+            )
+            output.usage.num_generated_tokens += request.resume_base
         if request.stop:
             self._apply_stop_strings(state, output)
             if output.usage is not None and state.stop_dropped:
@@ -775,13 +838,20 @@ class Scheduler:
                         n_tokens=new_tokens,
                     )
             state.last_token_mono = now
+            if state.resume_mono:
+                self._m_resume_latency.observe(
+                    (now - state.resume_mono) * 1000.0
+                )
+                state.resume_mono = 0.0
             request.num_generated_tokens += new_tokens
             if not state.prefill_finished:
                 state.prefill_finished = True
                 self._instance_mgr.update_request_metrics(
                     request.routing,
                     RequestAction.FINISH_PREFILL,
-                    len(request.token_ids),
+                    # Must mirror the SCHEDULE charge exactly: a resumed
+                    # attempt was charged for prompt + replayed tokens.
+                    len(request.resume_token_ids or request.token_ids),
                 )
             self._instance_mgr.update_request_metrics(
                 request.routing, RequestAction.GENERATE, new_tokens
@@ -796,6 +866,11 @@ class Scheduler:
                 )
                 self.finish_request(request.service_request_id, cancelled=True)
                 return
+            if request.resumable:
+                # Streams keep the same delivered-token accumulator the
+                # non-stream path fills: it is the replay source a
+                # mid-stream resume rebuilds the request from.
+                self._accumulate(state, output)
             ok = self._response_handler.send_delta_to_client(
                 state.stream, request, output, state.first_chunk_sent
             )
@@ -930,7 +1005,10 @@ class Scheduler:
             else RequestAction.FINISH_DECODE
         )
         self._instance_mgr.update_request_metrics(
-            request.routing, action, len(request.token_ids)
+            request.routing, action,
+            # Mirror the live attempt's SCHEDULE charge (a resumed
+            # attempt was charged for prompt + replayed tokens).
+            len(request.resume_token_ids or request.token_ids),
         )
         now = time.monotonic()
         if state.sched_mono:
@@ -978,8 +1056,9 @@ class Scheduler:
     def _on_instance_removed(self, name: str) -> None:
         """An instance left the registry (lease expiry / prune). Requests
         routed to it that have produced NO tokens yet are re-routed and
-        re-forwarded transparently; requests already mid-stream cannot be
-        replayed without duplicating output, so they error-finish."""
+        re-forwarded transparently; requests already mid-stream resume by
+        token replay (prompt + every delivered token re-dispatched to a
+        survivor); only when neither works does the request error-finish."""
         with self._mu:
             affected = [
                 s
@@ -989,54 +1068,108 @@ class Scheduler:
                 in (s.request.routing.prefill_name, s.request.routing.decode_name)
             ]
         for state in affected:
-            if not self.redispatch_request(
-                state.request.service_request_id, exclude=name
+            srid = state.request.service_request_id
+            if not (
+                self.redispatch_request(srid, exclude=name)
+                or self.resume_request(srid, exclude=name)
             ):
                 self.fail_request(
-                    state.request.service_request_id,
+                    srid,
                     StatusCode.UNAVAILABLE,
                     f"instance {name} died mid-generation",
                 )
+
+    def _route_excluding(self, token_ids: List[int], exclude: str):
+        """Policy pair choice that never lands on `exclude` (the registry
+        may still list the failed instance — fast-fail beats lease
+        expiry). Returns None when no viable pair exists."""
+        routing = self._policy.select_instances_pair(token_ids)
+        if exclude and routing.prefill_name == exclude:
+            candidates = [
+                n
+                for n in (
+                    self._instance_mgr.routable_prefill_instances()
+                    + self._instance_mgr.routable_decode_instances()
+                )
+                if n != exclude
+            ]
+            if not candidates:
+                return None
+            routing.prefill_name = self._instance_mgr.least_loaded(candidates)
+        if exclude and routing.decode_name == exclude:
+            routing.decode_name = routing.prefill_name
+        if not routing.prefill_name and not routing.decode_name:
+            return None
+        return routing
+
+    def _bump_attempt(self, state: _RequestState) -> None:
+        """Advance the dispatch-attempt epoch: outputs pushed under the
+        previous wire id are rejected from here on (handle_generation and
+        the queued-delivery check in _deliver)."""
+        with self._mu:
+            state.attempt += 1
+            state.request.wire_srid = (
+                f"{state.request.service_request_id}#r{state.attempt}"
+            )
+
+    def _drain_lane(self, state: _RequestState) -> None:
+        """Barrier on the request's lane: any delivery admitted BEFORE the
+        attempt bump finishes writing (client + acc) before we snapshot
+        the delivered tokens. Never called from a lane thread."""
+        fence = threading.Event()
+        self._streams.submit(state.lane, fence.set)
+        fence.wait(timeout=5.0)
 
     def redispatch_request(
         self, service_request_id: str, exclude: str = ""
     ) -> bool:
         """Re-route + re-forward a request whose instance failed. Only safe
-        before any token reached the client; bounded by max_redispatch.
+        before any token reached the client (mid-stream requests go through
+        resume_request's token replay); bounded by max_redispatch.
         Returns False when the request cannot be replayed (caller decides
         how to fail it)."""
+        me = threading.get_ident()
         with self._mu:
             state = self._requests.get(service_request_id)
-        if state is None or state.done:
-            return False
-        request = state.request
-        if (
-            request.num_generated_tokens > 0
-            or state.dispatch is None
-            or state.redispatch_count >= self.max_redispatch
-        ):
-            return False
-        state.redispatch_count += 1
-        routing = self._policy.select_instances_pair(request.token_ids)
-        if exclude and routing.prefill_name == exclude:
-            # Registry may still list the failed instance (fast-fail before
-            # lease expiry) — route around it over every live candidate.
-            candidates = [
-                n
-                for n in (
-                    self._instance_mgr.prefill_instances()
-                    + self._instance_mgr.decode_instances()
-                )
-                if n != exclude
-            ]
-            if not candidates:
+            if state is None or state.done:
                 return False
-            routing.prefill_name = self._instance_mgr.least_loaded(candidates)
-        if exclude and routing.decode_name == exclude:
-            routing.decode_name = routing.prefill_name
-        if not routing.prefill_name and not routing.decode_name:
+            request = state.request
+            if (
+                request.num_generated_tokens > 0
+                or state.dispatch is None
+                or state.redispatch_count >= self.max_redispatch
+                # another thread is already replaying this request (the
+                # dispatch-failure handler racing the removal listener):
+                # a second concurrent replay would double-dispatch it
+                or state.replaying not in (0, me)
+            ):
+                return False
+            outermost = state.replaying == 0
+            state.replaying = me
+            state.redispatch_count += 1
+            self.total_redispatch_attempts += 1
+        try:
+            return self._redispatch_locked_out(
+                service_request_id, state, request, exclude
+            )
+        finally:
+            if outermost:
+                state.replaying = 0
+
+    def _redispatch_locked_out(
+        self, service_request_id, state, request, exclude
+    ) -> bool:
+        routing = self._route_excluding(request.token_ids, exclude)
+        if routing is None:
             return False
+        # Unwind the failed attempt's queued-prefill bookkeeping (a no-op
+        # when the instance already left the registry) before charging the
+        # new target.
+        self._instance_mgr.update_request_metrics(
+            request.routing, RequestAction.CANCEL, len(request.token_ids)
+        )
         request.routing = routing
+        self._bump_attempt(state)
         self._instance_mgr.update_request_metrics(
             routing, RequestAction.SCHEDULE, len(request.token_ids)
         )
@@ -1047,6 +1180,15 @@ class Scheduler:
         try:
             state.dispatch()
         except Exception:
+            # The SCHEDULE above must not leak when the forward itself
+            # failed — load accounting would drift on every failed replay
+            # (mirror of the "prefill instance vanished" unwind in
+            # api/master.py). Clearing the routing keeps the later
+            # finish_request/fail_request from unwinding a second time.
+            self._instance_mgr.update_request_metrics(
+                routing, RequestAction.CANCEL, len(request.token_ids)
+            )
+            request.routing = Routing()
             return False
         # Count only SUCCESSFUL replays (the /metrics counter claims
         # "transparently replayed", not "attempted"); under self._mu —
@@ -1057,6 +1199,114 @@ class Scheduler:
             self._tracer.stage(
                 service_request_id, "redispatch",
                 excluded=exclude, prefill=routing.prefill_name,
+            )
+        return True
+
+    def resume_request(
+        self, service_request_id: str, exclude: str = ""
+    ) -> bool:
+        """Mid-stream token-replay resume (docs/FAULT_TOLERANCE.md): the
+        request's instance died AFTER tokens reached the client. The
+        forwarded request is rebuilt as prompt + every delivered token
+        (state.acc), re-dispatched to a survivor with a `resume_from`
+        marker, and the continuation splices onto the client stream with
+        no duplicated or missing tokens (the attempt-versioned wire id
+        fences off the dead attempt's late pushes). Eligibility:
+        n=1/best_of=1, non-guided, no media (request.resumable); bounded
+        by max_redispatch together with pre-token redispatches."""
+        me = threading.get_ident()
+        with self._mu:
+            state = self._requests.get(service_request_id)
+            if state is None or state.done:
+                return False
+            request = state.request
+            if (
+                request.num_generated_tokens <= 0
+                or not request.resumable
+                or state.dispatch is None
+                or state.redispatch_count >= self.max_redispatch
+                # see redispatch_request: one replay at a time
+                or state.replaying not in (0, me)
+            ):
+                return False
+            outermost = state.replaying == 0
+            state.replaying = me
+            state.redispatch_count += 1
+            self.total_redispatch_attempts += 1
+        try:
+            return self._resume_locked_out(
+                service_request_id, state, request, exclude
+            )
+        finally:
+            if outermost:
+                state.replaying = 0
+
+    def _resume_locked_out(
+        self, service_request_id, state, request, exclude
+    ) -> bool:
+        # Fence the dead attempt FIRST, then drain the lane: deliveries
+        # already queued finish writing into acc, later ones are rejected
+        # — the snapshot below is exactly what the client has.
+        self._bump_attempt(state)
+        self._drain_lane(state)
+        with self._mu:
+            seq = state.acc.get(0)
+            emitted = list(seq.token_ids) if seq is not None else []
+        resume_ids = list(request.token_ids) + emitted
+        routing = self._route_excluding(resume_ids, exclude)
+        if routing is None:
+            return False
+        # Resumed requests serve colocated on the instance (no second PD
+        # handoff on a recovery path) — keep the load accounting aligned
+        # with where the work actually runs.
+        routing.decode_name = routing.prefill_name
+        # Close out the dead attempt's load accounting: its decode slot
+        # (or queued prefill, if the kill beat the first FINISH_PREFILL
+        # bookkeeping) — no-ops when the instance already left the
+        # registry. The unwind mirrors that attempt's own SCHEDULE charge
+        # (a second resume's predecessor was charged prompt + replay).
+        self._instance_mgr.update_request_metrics(
+            request.routing,
+            RequestAction.FINISH_DECODE
+            if state.prefill_finished
+            else RequestAction.CANCEL,
+            len(request.resume_token_ids or request.token_ids),
+        )
+        state.prefill_finished = False
+        request.routing = routing
+        request.resume_token_ids = resume_ids
+        request.resume_base = len(emitted)
+        # Stop bookkeeping restarts per attempt: drops already applied to
+        # acc are excluded from the replay, so carrying the old counter
+        # would double-subtract from the resumed attempt's usage.
+        state.stop_dropped = 0
+        state.resume_mono = time.monotonic()
+        self._instance_mgr.update_request_metrics(
+            routing, RequestAction.SCHEDULE, len(resume_ids)
+        )
+        logger.info(
+            "resuming %s mid-stream at token %d (excluding %s) -> %s",
+            service_request_id, len(emitted), exclude or "-",
+            routing.to_json(),
+        )
+        try:
+            state.dispatch()
+        except Exception:
+            # Same unwind rule as redispatch: a failed forward must not
+            # leave the SCHEDULE charge on the new target, and the cleared
+            # routing keeps the terminal bookkeeping from re-unwinding it.
+            self._instance_mgr.update_request_metrics(
+                routing, RequestAction.CANCEL, len(resume_ids)
+            )
+            request.routing = Routing()
+            return False
+        with self._mu:
+            self.total_resumes += 1
+        if self._tracer.enabled:
+            self._tracer.stage(
+                service_request_id, "resume",
+                excluded=exclude, prefill=routing.prefill_name,
+                replayed_tokens=len(emitted),
             )
         return True
 
