@@ -107,6 +107,240 @@ def synthetic_sharegpt(n: int, rng, max_prompt: int, max_out: int,
     return list(zip(prompts, outs))
 
 
+def run_pd_bench(args) -> None:
+    """PD handoff microbench (--pd): monolithic vs pipelined (streamed)
+    KV handoff on one prefill+decode pair of REAL engines.
+
+    Each phase replays the same multi-chunk prompt shape (distinct salts —
+    the prefix cache must not collapse later requests to one chunk) and
+    measures the handoff stall two ways:
+
+      * server side: the prefill instance's `xllm_kv_handoff_stall_ms`
+        samples (prefill-done -> decode-peer admission: master first-token
+        ack + residual KV delivery), split by mode;
+      * client side: the gap between the 1st streamed token (pushed at
+        prefill-done) and the 2nd (the decode peer's first step) — the
+        user-visible "prefill-done -> first decode step on the peer".
+
+    Exits 3 when the streamed stall p50 is not <= the monolithic p50
+    (the pipelined path must never lose to the one it replaces).
+    """
+    import http.client
+    import os
+    import sys
+
+    import jax
+
+    from xllm_service_tpu.api import Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+
+    import numpy as np
+
+    store = MemoryStore()
+    cfg = ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=1.0, master_lease_ttl_s=5.0,
+        load_balance_policy="RR", block_size=16,
+    )
+    master = Master(cfg, store=store)
+    master.start()
+
+    def engine_cfg(name, itype):
+        return EngineConfig(
+            model="llama3-tiny", dtype="float32", block_size=16,
+            num_blocks=256, max_running_requests=4, max_seq_len=1024,
+            max_prefill_tokens=args.pd_chunk_tokens,
+            prefill_buckets=[64, 128, 256, 512, 1024],
+            instance_name=name, instance_type=itype,
+            enable_local_kv_transfer=False,  # measure the wire path
+        )
+
+    prefill = InstanceServer(
+        engine_cfg("pd-pre", "PREFILL"), master_rpc_addr=master.rpc_address,
+        heartbeat_interval_s=1.0,
+    )
+    decode = InstanceServer(
+        engine_cfg("pd-dec", "DECODE"), master_rpc_addr=master.rpc_address,
+        heartbeat_interval_s=1.0,
+    )
+    prefill.start()
+    decode.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if sum(master.scheduler.instance_mgr.counts()) == 2:
+            break
+        time.sleep(0.05)
+
+    n_tok = max(args.pd_prompt_tokens, 64)
+    host, _, port = master.http_address.partition(":")
+
+    def one_request(salt: str):
+        """Stream one completion; returns (text, first->second token gap s)."""
+        prompt = salt + "x" * (n_tok - len(salt))
+        conn = http.client.HTTPConnection(host, int(port), timeout=300.0)
+        conn.request(
+            "POST", "/v1/completions",
+            body=json.dumps({
+                "model": "llama3-tiny", "prompt": prompt,
+                "max_tokens": args.pd_max_tokens, "temperature": 0.0,
+                "stream": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        stamps, text = [], []
+        for raw in resp:
+            line = raw.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                break
+            try:
+                ev = json.loads(payload)
+            except ValueError:
+                continue
+            if ev.get("choices"):
+                # One delta event per generations push — stamp them all
+                # (a delta's text can be EMPTY while the incremental
+                # detokenizer holds back a split multi-byte char).
+                stamps.append(time.monotonic())
+                for ch in ev["choices"]:
+                    text.append(ch.get("text") or "")
+        conn.close()
+        gap = stamps[1] - stamps[0] if len(stamps) >= 2 else None
+        return "".join(text), gap
+
+    # Warm the compile caches off-measurement, once per mode: the two
+    # modes exercise different import shapes on the decode peer (bulk
+    # monolithic landing vs per-chunk + tail landings).
+    os.environ["XLLM_PD_STREAMING"] = "1"
+    one_request("warm1 ")
+    os.environ["XLLM_PD_STREAMING"] = "0"
+    one_request("warm0 ")
+
+    # INTERLEAVE the modes request-by-request: a mono-then-streamed phase
+    # split measures the second phase against a decode peer whose block
+    # pool the first phase already filled (every chunk landing then pays
+    # LRU evictions the first phase never saw) plus whatever the machine
+    # drifted — alternation gives both modes the same cache pressure and
+    # the same noise.
+    stats = {
+        m: {"stalls": [], "gaps": [], "chunks": 0, "aborts": 0,
+            "degraded": 0, "streamed_blocks": 0, "total_blocks": 0}
+        for m in ("mono", "streamed")
+    }
+    # Per-request stall, indexed by request (None when the handoff failed
+    # and produced no sample) — the paired guard below must pair request
+    # 2k with 2k+1 exactly, never realign across a gap.
+    per_req_stall = []
+    for i in range(2 * args.pd_requests):
+        mode = "streamed" if i % 2 else "mono"
+        os.environ["XLLM_PD_STREAMING"] = "1" if mode == "streamed" else "0"
+        s = stats[mode]
+        streamed0 = prefill._kv_stream_blocks_streamed
+        total0 = prefill._kv_mig_blocks_total
+        chunks0 = prefill._m_kv_stream_chunks.get()
+        aborts0 = prefill._m_kv_stream_aborts.get()
+        prefill._kv_stall_samples.clear()
+        _, gap = one_request(f"{mode[0]}{i:05d} ")
+        if gap is not None:
+            s["gaps"].append(gap * 1000.0)
+        # EVERY handoff counts — an aborted streaming session degrades to
+        # a monolithic-tagged sample, and excluding it would hide exactly
+        # the regressions the exit-3 guard exists to catch.
+        samples = list(prefill._kv_stall_samples)
+        per_req_stall.append(samples[0][1] if samples else None)
+        s["stalls"].extend(ms for _, ms in samples)
+        s["degraded"] += sum(1 for m, _ in samples if m != mode)
+        s["chunks"] += int(prefill._m_kv_stream_chunks.get() - chunks0)
+        s["aborts"] += int(prefill._m_kv_stream_aborts.get() - aborts0)
+        s["streamed_blocks"] += (
+            prefill._kv_stream_blocks_streamed - streamed0
+        )
+        s["total_blocks"] += prefill._kv_mig_blocks_total - total0
+    os.environ.pop("XLLM_PD_STREAMING", None)
+
+    def pct(xs, q):
+        return round(float(np.percentile(xs, q)), 3) if xs else None
+
+    def report(mode):
+        s = stats[mode]
+        return {
+            "requests": args.pd_requests,
+            "handoff_stall_p50_ms": pct(s["stalls"], 50),
+            "handoff_stall_p99_ms": pct(s["stalls"], 99),
+            "client_first_decode_gap_p50_ms": pct(s["gaps"], 50),
+            "client_first_decode_gap_p99_ms": pct(s["gaps"], 99),
+            "chunks": s["chunks"],
+            "aborted_sessions": s["aborts"],
+            "degraded_handoffs": s["degraded"],
+            "overlap_frac": (
+                round(s["streamed_blocks"] / s["total_blocks"], 4)
+                if s["total_blocks"] else None
+            ),
+        }
+
+    mono, streamed = report("mono"), report("streamed")
+
+    # Guard: the pipelined path must not lose to the one it replaces, and
+    # a multi-chunk prompt must actually overlap most of its migration.
+    # The stall comparison is PAIRED — each alternated (mono, streamed)
+    # request pair ran back-to-back under the same machine conditions, so
+    # the median of per-pair differences cancels the load drift that
+    # dwarfs a tiny-model payload's absolute win. (Byte-identity across
+    # modes is pinned by tests/test_kv_stream.py; prompts here carry
+    # distinct salts, so texts differ by design.)
+    diffs = [
+        s - m
+        for m, s in zip(per_req_stall[0::2], per_req_stall[1::2])
+        if m is not None and s is not None
+    ]
+    stall_delta = (
+        round(float(np.percentile(diffs, 50)), 3) if diffs else None
+    )
+    guard_ok = True
+    reasons = []
+    if stall_delta is None or stall_delta > 0:
+        guard_ok = False
+        reasons.append(
+            "paired streamed-minus-monolithic handoff stall median above 0"
+        )
+    if streamed["overlap_frac"] is None or streamed["overlap_frac"] <= 0.5:
+        # None means streamed-mode handoffs recorded NO migration at all —
+        # the pipeline being inert is the worst regression, not a pass.
+        guard_ok = False
+        reasons.append(
+            "overlap fraction missing or <= 0.5 on a multi-chunk prompt"
+        )
+
+    for srv in (prefill, decode):
+        try:
+            srv.stop()
+        except Exception:
+            pass
+    master.stop()
+    store.close()
+
+    print(json.dumps({
+        "metric": "pd_handoff",
+        "backend": (
+            "tpu" if jax.default_backend() == "tpu" else "cpu-real"
+        ),
+        "prompt_tokens": n_tok,
+        "chunk_tokens": args.pd_chunk_tokens,
+        "monolithic": mono,
+        "streamed": streamed,
+        "paired_stall_delta_p50_ms": stall_delta,
+        "pd_stream_guard": "ok" if guard_ok else "; ".join(reasons),
+    }))
+    if not guard_ok:
+        sys.exit(3)
+
+
 def main() -> None:
     p = argparse.ArgumentParser("xllm-service-tpu burst bench")
     p.add_argument("--requests", type=int, default=64)
@@ -154,6 +388,31 @@ def main() -> None:
         "routing follows blocks it can only see after a heartbeat",
     )
     p.add_argument(
+        "--pd", action="store_true",
+        help="PD handoff microbench: monolithic vs pipelined (streamed) "
+        "KV handoff on a real-engine prefill+decode pair; reports "
+        "handoff-stall p50/p99 and overlap fraction per mode; exits 3 "
+        "when the streamed stall is not <= monolithic "
+        "(docs/PD_DISAGGREGATION.md)",
+    )
+    p.add_argument(
+        "--pd-requests", type=int, default=6,
+        help="--pd: measured requests per phase",
+    )
+    p.add_argument(
+        "--pd-prompt-tokens", type=int, default=960,
+        help="--pd: prompt length (tokens == chars on the test tokenizer)",
+    )
+    p.add_argument(
+        "--pd-chunk-tokens", type=int, default=64,
+        help="--pd: engine max_prefill_tokens (chunks per prompt = "
+        "prompt/chunk)",
+    )
+    p.add_argument(
+        "--pd-max-tokens", type=int, default=4,
+        help="--pd: generated tokens per request",
+    )
+    p.add_argument(
         "--instance-type", default="MIX",
         choices=["MIX", "DEFAULT", "PREFILL", "DECODE"],
         help="MIX fleets split one decode + rest prefill (the reference "
@@ -166,13 +425,17 @@ def main() -> None:
 
     import os
 
-    if not args.real_engine:
+    if not args.real_engine and not args.pd:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
     plat = os.environ.get("JAX_PLATFORMS")
     if plat:
         import jax
 
         jax.config.update("jax_platforms", plat)
+
+    if args.pd:
+        run_pd_bench(args)
+        return
 
     import numpy as np
 

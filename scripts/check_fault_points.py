@@ -27,6 +27,20 @@ TESTS = os.path.join(REPO, "tests")
 POINT_RE = re.compile(r"faults\.point\(\s*[\r\n ]*[\"']([^\"']+)[\"']")
 NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
 
+# Contractual points: chaos specs and docs reference these by name, so a
+# refactor that silently drops one must fail the lint even though the
+# generic scan would no longer see it.
+REQUIRED_POINTS = {
+    "post_json.send",
+    "post_json.recv",
+    "heartbeat.send",
+    "fake_engine.step",
+    # pipelined PD handoff (docs/PD_DISAGGREGATION.md): sender chunk
+    # emission and receiver chunk landing
+    "kv_stream.send",
+    "kv_stream.recv",
+}
+
 
 def _py_files(root):
     for dirpath, dirs, files in os.walk(root):
@@ -67,6 +81,10 @@ def main() -> int:
                 f"point {name!r} defined at {len(paths)} sites: "
                 + ", ".join(paths)
             )
+    for name in sorted(REQUIRED_POINTS - set(by_name)):
+        errors.append(
+            f"required point {name!r} has no faults.point call site"
+        )
     test_blob = "\n".join(
         open(p, encoding="utf-8").read() for p in _py_files(TESTS)
     )

@@ -1,0 +1,610 @@
+"""Pipelined chunk-wise KV streaming for the PD handoff
+(docs/PD_DISAGGREGATION.md).
+
+Engine level: the chunked-prefill loop must emit per-chunk KV exports
+covering exactly the prompt's full blocks, and the streamed handoff must
+be byte-identical to the monolithic handoff and to a non-disaggregated
+run — plain greedy, seeded sampling, abort fallback, lost chunks, and
+cancel-mid-session.
+
+Instance level (real sockets): the /kv/import session protocol
+(open / chunk / commit), the escape hatch, and peer-death-mid-session via
+the `kv_stream.send` / `kv_stream.recv` fault points — every failure mode
+must still produce the colocated oracle's exact stream.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from xllm_service_tpu.common import faults
+from xllm_service_tpu.common.config import EngineConfig
+from xllm_service_tpu.common.hashing import prefix_block_hashes
+from xllm_service_tpu.ops.sampling import SamplingParams
+from xllm_service_tpu.runtime.engine import EngineRequest, InferenceEngine
+from xllm_service_tpu.runtime.executor import ModelExecutor
+
+BS = 16
+CHUNK = 32  # max_prefill_tokens: 2 full blocks per prefill chunk
+
+
+def make_engine(seed=0, num_blocks=64):
+    cfg = EngineConfig(
+        model="llama3-tiny",
+        dtype="float32",
+        block_size=BS,
+        num_blocks=num_blocks,
+        max_running_requests=4,
+        max_seq_len=256,
+        max_prefill_tokens=CHUNK,
+        prefill_buckets=[32, 64, 128, 256],
+    )
+    return InferenceEngine(cfg, executor=ModelExecutor(cfg, init_seed=seed))
+
+
+class Collector:
+    def __init__(self):
+        self.tokens = []
+        self.outputs = []
+        self.finished = threading.Event()
+        self.cancelled = False
+
+    def __call__(self, out):
+        self.outputs.append(out)
+        for so in out.outputs:
+            self.tokens.extend(so.token_ids)
+        if out.cancelled:
+            self.cancelled = True
+        if out.finished:
+            self.finished.set()
+        return True
+
+
+class RecordingStream:
+    """Engine-side kv_stream stub: records chunks; `accept` can veto a
+    chunk (vetoing marks the session aborted, like the real session)."""
+
+    def __init__(self, accept=None):
+        self.chunks = []
+        self.aborted = False
+        self.disposed = False
+        self._accept = accept
+
+    def send_chunk(self, chunk):
+        if self.aborted:
+            return False
+        if self._accept is not None and not self._accept(chunk):
+            self.aborted = True
+            return False
+        self.chunks.append(chunk)
+        return True
+
+    def dispose(self):
+        # Mirrors _KVStreamSession.dispose: the engine calls this when the
+        # request ends without a handoff.
+        self.disposed = True
+        self.aborted = True
+
+
+def run(eng, max_steps=200):
+    for _ in range(max_steps):
+        if not eng.has_work():
+            break
+        eng.step()
+
+
+def prompt_tokens(n, seed=7):
+    rng = np.random.RandomState(seed)
+    return [int(x) for x in rng.randint(0, 500, size=n)]
+
+
+def land_chunks(engine, chunks):
+    for c in chunks:
+        engine.import_kv_blocks(list(c.block_hashes), np.asarray(c.kv))
+
+
+@pytest.fixture(scope="module")
+def engines():
+    # identical init_seed => identical weights on all sides
+    return make_engine(seed=0), make_engine(seed=0)
+
+
+@pytest.fixture(scope="module")
+def oracle_engine():
+    # One colocated oracle engine for the whole module: prefix-cache reuse
+    # across tests cannot change its outputs (the cache invariant), and a
+    # shared engine keeps the suite inside the tier-1 time budget.
+    return make_engine(seed=0)
+
+
+_oracle_seq = [0]
+
+
+def oracle_tokens(eng, prompt, sampling):
+    _oracle_seq[0] += 1
+    c = Collector()
+    eng.add_request(
+        EngineRequest(f"oracle-{_oracle_seq[0]}", list(prompt), sampling, c)
+    )
+    run(eng)
+    assert c.finished.is_set()
+    return c.tokens
+
+
+def test_chunk_stream_covers_all_full_blocks(engines):
+    a, _ = engines
+    prompt = prompt_tokens(6 * BS + 5)
+    stream = RecordingStream()
+    handoffs, ca = [], Collector()
+    a.add_request(
+        EngineRequest(
+            "st1", list(prompt),
+            SamplingParams(temperature=0.0, max_new_tokens=4), ca,
+            prefill_only=True, handoff=handoffs.append, kv_stream=stream,
+        )
+    )
+    run(a)
+    assert len(handoffs) == 1
+    h = handoffs[0]
+    # CHUNK=32 over a 101-token prompt: partial chunks end at 32/64/96,
+    # each completing 2 fresh full blocks; the 5-token tail rides the
+    # final (non-streaming) chunk.
+    assert [c.start_block for c in stream.chunks] == [0, 2, 4]
+    assert all(len(c.block_hashes) == 2 for c in stream.chunks)
+    want = prefix_block_hashes(prompt[: 6 * BS], BS, a.block_mgr.seed)
+    got = [hb for c in stream.chunks for hb in c.block_hashes]
+    assert got == want
+    for c in stream.chunks:
+        assert tuple(np.asarray(c.kv).shape) == a.executor.migration_shape(2)
+    # Every full block rode the stream: the commit payload is tail-free.
+    assert h.num_full_blocks == 6
+    assert h.kv_start_block == 6
+    assert h.kv is None
+    assert h.block_hashes == want
+
+
+@pytest.mark.parametrize(
+    "pseed, sampling",
+    [
+        (11, SamplingParams(temperature=0.0, max_new_tokens=8)),
+        (61, SamplingParams(
+            temperature=0.9, top_p=0.8, seed=1234, max_new_tokens=8,
+        )),
+    ],
+    ids=["greedy", "seeded"],
+)
+def test_streamed_equals_monolithic_and_colocated(
+    engines, oracle_engine, pseed, sampling
+):
+    # Distinct prompts per phase AND per parametrization (a module-scoped
+    # engine keeps its prefix cache, and a cached prompt's one-chunk
+    # suffix correctly skips streaming); each phase is pinned to ITS
+    # prompt's colocated oracle, so streamed ≡ monolithic ≡ colocated by
+    # transitivity.
+    a, b = engines
+
+    # Monolithic PD (no kv_stream).
+    prompt = prompt_tokens(5 * BS + 9, seed=pseed)
+    want = oracle_tokens(oracle_engine, prompt, sampling)
+    handoffs, ca = [], Collector()
+    a.add_request(
+        EngineRequest("mono-p", list(prompt), sampling, ca,
+                      prefill_only=True, handoff=handoffs.append)
+    )
+    run(a)
+    cb = Collector()
+    b.import_sequence(
+        EngineRequest("mono-d", list(prompt), sampling, cb), handoffs[0]
+    )
+    run(b)
+    assert cb.finished.is_set()
+    assert ca.tokens + cb.tokens == want
+
+    # Streamed PD: chunks land first, the commit carries only the tail.
+    prompt = prompt_tokens(5 * BS + 9, seed=pseed + 1)
+    want = oracle_tokens(oracle_engine, prompt, sampling)
+    stream = RecordingStream()
+    handoffs2, ca2 = [], Collector()
+    a.add_request(
+        EngineRequest("str-p", list(prompt), sampling, ca2,
+                      prefill_only=True, handoff=handoffs2.append,
+                      kv_stream=stream)
+    )
+    run(a)
+    h = handoffs2[0]
+    assert stream.chunks and h.kv_start_block == len(
+        [hb for c in stream.chunks for hb in c.block_hashes]
+    )
+    land_chunks(b, stream.chunks)
+    cb2 = Collector()
+    b.import_sequence(
+        EngineRequest("str-d", list(prompt), sampling, cb2), h
+    )
+    run(b)
+    assert cb2.finished.is_set()
+    assert ca2.tokens + cb2.tokens == want
+
+
+def test_aborted_stream_falls_back_to_monolithic(engines, oracle_engine):
+    a, b = engines
+    prompt = prompt_tokens(6 * BS + 3, seed=21)
+    sampling = SamplingParams(temperature=0.0, max_new_tokens=6)
+    want = oracle_tokens(oracle_engine, prompt, sampling)
+
+    # Veto the second chunk: the session aborts and the engine must ship
+    # the FULL payload in the commit (monolithic retry).
+    stream = RecordingStream(accept=lambda c: c.start_block == 0)
+    handoffs, ca = [], Collector()
+    a.add_request(
+        EngineRequest("ab-p", list(prompt), sampling, ca,
+                      prefill_only=True, handoff=handoffs.append,
+                      kv_stream=stream)
+    )
+    run(a)
+    h = handoffs[0]
+    assert stream.aborted
+    assert h.kv_start_block == 0
+    assert h.num_full_blocks == 6
+    assert tuple(np.asarray(h.kv).shape) == a.executor.migration_shape(6)
+    cb = Collector()
+    b.import_sequence(EngineRequest("ab-d", list(prompt), sampling, cb), h)
+    run(b)
+    assert cb.finished.is_set()
+    assert ca.tokens + cb.tokens == want
+
+
+def test_lost_chunk_only_costs_recompute(engines, oracle_engine):
+    a, b = engines
+    prompt = prompt_tokens(6 * BS + 7, seed=31)
+    sampling = SamplingParams(temperature=0.0, max_new_tokens=6)
+    want = oracle_tokens(oracle_engine, prompt, sampling)
+
+    stream = RecordingStream()
+    handoffs, ca = [], Collector()
+    a.add_request(
+        EngineRequest("lc-p", list(prompt), sampling, ca,
+                      prefill_only=True, handoff=handoffs.append,
+                      kv_stream=stream)
+    )
+    run(a)
+    assert len(stream.chunks) >= 2
+    # Chunk 0 dies on the wire (peer death mid-session): only the later
+    # chunks land. The decode side's prefix match stops at the hole, so
+    # the whole prompt recomputes — slower, but byte-identical.
+    land_chunks(b, stream.chunks[1:])
+    cb = Collector()
+    b.import_sequence(
+        EngineRequest("lc-d", list(prompt), sampling, cb), handoffs[0]
+    )
+    run(b)
+    assert cb.finished.is_set()
+    assert ca.tokens + cb.tokens == want
+
+
+def test_cancel_mid_session_releases_everything(engines):
+    a, _ = engines
+    prompt = prompt_tokens(12 * BS, seed=41)  # 6 chunks of prefill
+    stream = RecordingStream()
+    handoffs, ca = [], Collector()
+    a.add_request(
+        EngineRequest("cx-p", list(prompt),
+                      SamplingParams(temperature=0.0, max_new_tokens=4), ca,
+                      prefill_only=True, handoff=handoffs.append,
+                      kv_stream=stream)
+    )
+    a.step()  # first chunk lands, seq mid-prefill holding slot + blocks
+    assert stream.chunks  # the session started streaming
+    a.cancel("cx-p")
+    run(a)
+    assert not handoffs  # never handed off
+    assert ca.cancelled
+    assert not a._running and len(a._free_slots) == a.R
+    assert not a.has_work()
+    # The session was torn down (peer entry + offers), not leaked to TTL.
+    assert stream.disposed
+
+
+def test_import_kv_blocks_rejects_mismatched_shape(engines):
+    """A chunk whose payload disagrees with the local cache layout must be
+    dropped on the engine thread without corrupting the cache."""
+    _, b = engines
+    hashes = prefix_block_hashes(prompt_tokens(2 * BS, seed=51), BS,
+                                 b.block_mgr.seed)
+    bad = np.zeros((2, 1, 2, 1, BS, 4), np.float32)  # wrong layout
+    b.import_kv_blocks(hashes, bad)
+    run(b, max_steps=3)
+    assert all(b.block_mgr.lookup_hash(hb) is None for hb in hashes)
+
+
+# --------------------------------------------------------------------------
+# transfer.py resource hygiene (no transfer server needed: the offer/conn
+# bookkeeping is plain host state).
+# --------------------------------------------------------------------------
+
+
+def _bare_transfer_server():
+    from xllm_service_tpu.runtime import transfer
+
+    srv = object.__new__(transfer.KVTransferServer)
+    srv._mu = threading.Lock()
+    srv._conns = {}
+    srv._pending = {}
+    srv._retract_timers = {}
+    return srv
+
+
+def test_retract_cancels_pending_grace_timer():
+    """A clean ack after an errored control path must free the offer NOW,
+    not pin it through the whole retract_later grace window."""
+    srv = _bare_transfer_server()
+    srv._pending[1] = ("fut", "arrays")
+    srv.retract_later(1, delay_s=60.0)
+    t = srv._retract_timers[1]
+    srv.retract(1)
+    assert not srv._pending
+    assert not srv._retract_timers
+    assert t.finished.is_set()  # Timer.cancel() ran
+
+
+def test_pull_failure_evicts_cached_connection():
+    """A restarted peer must not keep receiving pulls over the dead cached
+    transport."""
+    srv = _bare_transfer_server()
+
+    class _DeadConn:
+        def pull(self, uuid, avals):
+            raise RuntimeError("dead transport")
+
+    srv._conns["peer:1"] = _DeadConn()
+    with pytest.raises(RuntimeError):
+        srv.pull("peer:1", 7, [])
+    assert "peer:1" not in srv._conns
+
+
+def test_extend_prefix_block_hashes_chain_parity():
+    """The incremental extension must be chain-identical to the bulk
+    walk — streamed chunks land under these hashes and the decode side
+    matches them with prefix_block_hashes."""
+    from xllm_service_tpu.common.hashing import (
+        extend_prefix_block_hashes,
+        prefix_block_hashes,
+    )
+
+    tokens = prompt_tokens(7 * BS + 3, seed=71)
+    want = prefix_block_hashes(tokens, BS, 1024)
+    got = []
+    for nblocks in (1, 3, 3, 7):  # grow in uneven steps, idempotent
+        extend_prefix_block_hashes(got, tokens, nblocks, BS, 1024)
+    assert got == want
+
+
+def test_offer_session_bulk_retract():
+    from xllm_service_tpu.runtime.transfer import KVOfferSession
+
+    class _StubSrv:
+        def __init__(self):
+            self.retracted = []
+            self.later = []
+            self._n = 0
+
+        def offer(self, arrays):
+            self._n += 1
+            return self._n
+
+        def retract(self, uuid):
+            self.retracted.append(uuid)
+
+        def retract_later(self, uuid, delay_s=120.0):
+            self.later.append(uuid)
+
+    stub = _StubSrv()
+    sess = KVOfferSession(stub)
+    u1, u2, u3 = sess.offer([1]), sess.offer([2]), sess.offer([3])
+    sess.retract(u2)  # one chunk's clean ack
+    assert stub.retracted == [u2]
+    sess.retract_all_later()  # abort: the rest get the grace window
+    assert sorted(stub.later) == [u1, u3]
+    sess.retract_all()  # idempotent once drained
+    assert stub.retracted == [u2]
+
+
+def test_session_deliver_toctou_host_copy(monkeypatch):
+    """Mid-session peer deregistration: a queued DEVICE chunk must fall
+    back to host bytes per-chunk (serialize + POST), not strand the
+    session or keep HBM pinned."""
+    import jax
+
+    import xllm_service_tpu.api.instance_kv as inst_mod
+    from xllm_service_tpu.api.protocol import kv_frame_split
+
+    class _StubOwner(inst_mod.KVHandoffMixin):
+        # Inherits _post_kv_frame (the shared delivery protocol) from the
+        # real mixin; everything else is stubbed.
+        name = "stub-pre"
+        cfg = EngineConfig(model="llama3-tiny")
+        _kv_transfer = None
+        _peer_no_pull = set()
+
+        def _local_peer(self, name):
+            return None  # the colocated peer is gone
+
+        def _resolve_instance_addr(self, name):
+            return "peer:9"
+
+    posted = []
+
+    def fake_post_bytes(addr, path, payload, timeout=60.0):
+        posted.append((addr, path, payload))
+        return 200, {"ok": True}
+
+    monkeypatch.setattr(inst_mod, "post_bytes", fake_post_bytes)
+    sess = inst_mod._KVStreamSession(_StubOwner(), "srid-1", "dead-peer")
+    kv = jax.numpy.ones((2, 2, 1, 2, BS, 32), jax.numpy.float32)
+    with sess._cv:
+        sess._pending += 1
+    sess._deliver(
+        {"idx": 0, "start_block": 0, "expected_blocks": 1,
+         "prompt_tokens": BS},
+        [b"\x00" * 16], kv,
+    )
+    assert not sess.aborted
+    assert sess.chunks_delivered == 1 and sess.blocks_delivered == 1
+    addr, path, payload = posted[0]
+    assert (addr, path) == ("peer:9", "/kv/import")
+    header, body = kv_frame_split(payload)
+    assert header["kv_stream"]["op"] == "open"
+    assert header["kv_shape"] == list(kv.shape)  # host-serialized bytes
+    assert len(body) == kv.size * 4
+
+
+# --------------------------------------------------------------------------
+# Instance level over real sockets: the /kv/import session wire protocol,
+# fault injection at kv_stream.send/recv (peer-death-mid-session), and the
+# escape hatch. Greedy output must always match the colocated oracle.
+# --------------------------------------------------------------------------
+
+from xllm_service_tpu.api import Master  # noqa: E402
+from xllm_service_tpu.api.instance import InstanceServer  # noqa: E402
+from xllm_service_tpu.common.config import ServiceConfig  # noqa: E402
+from xllm_service_tpu.coordination import MemoryStore  # noqa: E402
+
+from tests.test_api_e2e import http_post, wait_until  # noqa: E402
+
+
+def _engine_cfg(name, itype):
+    return EngineConfig(
+        model="llama3-tiny", dtype="float32", block_size=BS,
+        num_blocks=64, max_running_requests=4, max_seq_len=256,
+        max_prefill_tokens=CHUNK,  # multi-chunk prefill => streaming fires
+        prefill_buckets=[32, 64, 128],
+        instance_name=name, instance_type=itype,
+        enable_local_kv_transfer=False,  # exercise the wire protocol
+    )
+
+
+def _make_stack(prefix, itypes):
+    store = MemoryStore(clock=lambda: 0.0)  # frozen leases (GIL stalls)
+    cfg = ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.2, master_lease_ttl_s=5.0,
+        load_balance_policy="RR", block_size=BS,
+    )
+    master = Master(cfg, store=store)
+    master.start()
+    servers = []
+    for i, itype in enumerate(itypes):
+        srv = InstanceServer(
+            _engine_cfg(f"{prefix}{i}", itype),
+            master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.2,
+        )
+        srv.start()
+        servers.append(srv)
+    assert wait_until(
+        lambda: sum(master.scheduler.instance_mgr.counts()) == len(itypes)
+    )
+    return master, servers, store
+
+
+@pytest.fixture(scope="module")
+def stream_stack():
+    master, servers, store = _make_stack("kvs-", ["PREFILL", "DECODE"])
+    yield master, servers[0], servers[1]
+    for s in servers:
+        s.stop()
+    master.stop()
+    store.close()
+
+
+@pytest.fixture(scope="module")
+def stream_oracle():
+    """Colocated MIX oracle with the SAME chunked-prefill budget."""
+    master, servers, store = _make_stack("kvo-", ["MIX"])
+    yield master
+    servers[0].stop()
+    master.stop()
+    store.close()
+
+
+def _completion(master, prompt, n=6):
+    code, body = http_post(
+        master.http_address, "/v1/completions",
+        {"model": "llama3-tiny", "prompt": prompt, "max_tokens": n,
+         "temperature": 0.0},
+        timeout=300.0,
+    )
+    assert code == 200, body
+    return body
+
+
+@pytest.mark.slow
+def test_e2e_streamed_matches_colocated(stream_stack, stream_oracle):
+    master, prefill, decode = stream_stack
+    prompt = "s" * (6 * BS + 5)  # 4 prefill chunks, 6 full blocks
+    streamed0 = prefill._kv_stream_blocks_streamed
+    total0 = prefill._kv_mig_blocks_total
+    landed0 = prefill._m_kv_stream_landed.get() + (
+        decode._m_kv_stream_landed.get()
+    )
+    got = _completion(master, prompt)
+    want = _completion(stream_oracle, prompt)
+    assert got["choices"][0]["text"] == want["choices"][0]["text"]
+    assert got["usage"] == want["usage"]
+    d_streamed = prefill._kv_stream_blocks_streamed - streamed0
+    d_total = prefill._kv_mig_blocks_total - total0
+    assert d_total == 6
+    # The ISSUE bar: most of the payload left before prefill-done.
+    assert d_streamed / d_total > 0.5
+    assert prefill._m_kv_stream_chunks.get() >= 3
+    assert (
+        prefill._m_kv_stream_landed.get() + decode._m_kv_stream_landed.get()
+        > landed0
+    )
+    # Handoff stall was recorded for the streamed mode.
+    assert any(m == "streamed" for m, _ in prefill._kv_stall_samples)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("point", ["kv_stream.send", "kv_stream.recv"])
+def test_e2e_chunk_fault_falls_back_byte_identical(
+    stream_stack, stream_oracle, point
+):
+    """Peer death mid-session: a dropped/errored chunk aborts the session
+    and the commit retries monolithically — the client stream must be
+    byte-identical to the unfaulted colocated run."""
+    master, prefill, decode = stream_stack
+    prompt = ("u" if point.endswith("send") else "v") * (6 * BS + 5)
+    aborts0 = prefill._m_kv_stream_aborts.get()
+    faults.install_plan(faults.FaultPlan(seed=3, rules=[
+        faults.FaultRule(
+            point=point,
+            action="drop" if point.endswith("send") else "error",
+            count=1,
+        ),
+    ]))
+    try:
+        got = _completion(master, prompt)
+    finally:
+        faults.clear()
+    want = _completion(stream_oracle, prompt)
+    assert got["choices"][0]["text"] == want["choices"][0]["text"]
+    assert got["usage"] == want["usage"]
+    assert prefill._m_kv_stream_aborts.get() == aborts0 + 1
+
+
+@pytest.mark.slow
+def test_e2e_escape_hatch_disables_streaming(
+    stream_stack, stream_oracle, monkeypatch
+):
+    master, prefill, _ = stream_stack
+    monkeypatch.setenv("XLLM_PD_STREAMING", "0")
+    prompt = "w" * (6 * BS + 5)
+    chunks0 = prefill._m_kv_stream_chunks.get()
+    streamed0 = prefill._kv_stream_blocks_streamed
+    got = _completion(master, prompt)
+    want = _completion(stream_oracle, prompt)
+    assert got["choices"][0]["text"] == want["choices"][0]["text"]
+    assert prefill._m_kv_stream_chunks.get() == chunks0
+    assert prefill._kv_stream_blocks_streamed == streamed0
+    # The monolithic fallback still records its handoff stall.
+    assert any(m == "mono" for m, _ in prefill._kv_stall_samples)

@@ -217,6 +217,10 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
                 / max(self.engine.spec_slot_steps, 1)
             )
 
+        # Pipelined PD handoff state + metrics (instance_kv mixin):
+        # streaming-session tables and the handoff stall/overlap series.
+        self._init_kv_handoff()
+
         self._master: Optional[MasterClient] = (
             MasterClient(master_rpc_addr) if master_rpc_addr else None
         )
@@ -278,6 +282,24 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
             )
             for i in range(4)
         ]
+        # Pipelined-handoff chunk lane (docs/PD_DISAGGREGATION.md): chunk
+        # jobs get their OWN bounded queue + workers so one streaming
+        # session to a stuck decode peer can only saturate this lane —
+        # chunk sends then fail fast (put_nowait -> session abort ->
+        # monolithic fallback) and the monolithic plane's engine-thread
+        # backpressure never engages on a chunk's behalf.
+        self._stream_q: "queue.Queue[Optional[Callable[[], None]]]" = (
+            queue.Queue(maxsize=8)
+        )
+        self._stream_threads = [
+            threading.Thread(
+                target=self._transfer_loop,
+                args=(self._stream_q,),
+                name=f"kv-stream-{self.name}-{i}",
+                daemon=True,
+            )
+            for i in range(2)
+        ]
         # Cross-process device-to-device KV plane (runtime/transfer.py):
         # offers ride this process's TransferServer; the /kv/import control
         # message carries only {addr, uuid, shape, dtype} and the decode
@@ -304,6 +326,8 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
         self.http.start()
         self._push_thread.start()
         for t in self._transfer_threads:
+            t.start()
+        for t in self._stream_threads:
             t.start()
         if self._heartbeat is not None:
             self._heartbeat.start()
@@ -348,6 +372,17 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
         for _ in self._transfer_threads:
             self._transfer_q.put(None)
         for t in self._transfer_threads:
+            t.join(timeout=5.0)
+        for _ in self._stream_threads:
+            try:
+                # The lane is bounded and may be saturated by a stuck peer
+                # (the exact scenario it isolates) — never let shutdown
+                # block behind it; the workers are daemons and the join
+                # below is already time-bounded.
+                self._stream_q.put(None, timeout=1.0)
+            except queue.Full:
+                break
+        for t in self._stream_threads:
             t.join(timeout=5.0)
         if not getattr(self, "_http_stopped", False):
             self._http_stopped = True

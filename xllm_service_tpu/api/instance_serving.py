@@ -511,9 +511,14 @@ class ServingMixin:
             if decode_name and decode_name != self.name:
                 # PD disaggregation: this instance is the prefill side —
                 # emit the first token, then migrate KV to the decode peer
-                # (reference topology: rpc_service/service.h:61-71).
+                # (reference topology: rpc_service/service.h:61-71). The
+                # streaming session (pipelined per-chunk KV export,
+                # docs/PD_DISAGGREGATION.md) opens here, at ADMIT time:
+                # the master already routed the decode peer, so chunk 0
+                # can leave before prefill-done.
                 with self._push_acked_mu:
                     self._push_acked[srid] = threading.Event()
+                kv_stream = self._open_kv_stream(srid, decode_name)
                 self.engine.add_request(
                     EngineRequest(
                         request_id=rid,
@@ -525,6 +530,7 @@ class ServingMixin:
                         offline=offline,
                         adapter_idx=adapter_idx,
                         prefill_only=True,
+                        kv_stream=kv_stream,
                         handoff=self._make_handoff_sender(
                             srid, decode_name, body, detoks,
                             seed=sampling.seed,
@@ -532,6 +538,7 @@ class ServingMixin:
                                 routing.get("decode_response_to_service", True)
                                 is False
                             ),
+                            kv_stream=kv_stream,
                         ),
                     )
                 )

@@ -140,21 +140,16 @@ def output_from_json(j: Dict[str, Any]) -> RequestOutput:
 import json as _json
 
 
-def handoff_to_bytes(h, extra: Dict[str, Any]) -> bytes:
-    import numpy as np
+def kv_frame_to_bytes(header: Dict[str, Any], kv=None) -> bytes:
+    """Generic /kv/import frame: one JSON header, a NUL, then raw KV bytes
+    (C-order). The monolithic handoff and the pipelined session's chunk
+    messages share this layout; `kv_dtype`/`kv_shape` are injected when a
+    payload rides the body (the pull plane sends header-only frames)."""
+    if kv is not None:
+        import numpy as np
 
-    header: Dict[str, Any] = {
-        "request_id": h.request_id,
-        "token_ids": list(h.token_ids),
-        "first_token": int(h.first_token),
-        "first_logprob": float(h.first_logprob),
-        "num_full_blocks": int(h.num_full_blocks),
-        "block_hashes": [b.hex() for b in h.block_hashes],
-        "usage_prompt_tokens": int(h.usage_prompt_tokens),
-        **extra,
-    }
-    if h.kv is not None:
-        kv = np.asarray(h.kv)
+        kv = np.asarray(kv)
+        header = dict(header)
         header["kv_dtype"] = str(kv.dtype)
         header["kv_shape"] = list(kv.shape)
         body = kv.tobytes()
@@ -163,25 +158,62 @@ def handoff_to_bytes(h, extra: Dict[str, Any]) -> bytes:
     return _json.dumps(header).encode("utf-8") + b"\x00" + body
 
 
-def handoff_from_bytes(data: bytes):
-    """Returns (KVHandoff, header_dict)."""
+def kv_frame_split(data: bytes) -> "tuple[Dict[str, Any], bytes]":
+    """Split one /kv/import frame into (header_dict, body_bytes)."""
+    sep = data.index(b"\x00")
+    return _json.loads(data[:sep].decode("utf-8")), data[sep + 1:]
+
+
+def resolve_kv_dtype(name: str):
+    """Wire dtype name -> np.dtype. bfloat16 (and friends) need ml_dtypes
+    (jax ships it); np.dtype handles the standard names. Shared by the
+    bytes plane (kv_frame_array) and the pull plane so the two can never
+    diverge on a dtype-name fix."""
     import numpy as np
 
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def kv_frame_array(header: Dict[str, Any], body: bytes):
+    """Decode a frame's body into the array its header describes (None for
+    header-only frames)."""
+    import numpy as np
+
+    if "kv_shape" not in header:
+        return None
+    dt = resolve_kv_dtype(header["kv_dtype"])
+    return np.frombuffer(body, dtype=dt).reshape(header["kv_shape"])
+
+
+def handoff_header(h, extra: Dict[str, Any]) -> Dict[str, Any]:
+    """KV-free wire header for one handoff — the /kv/import frame header
+    minus the kv_dtype/kv_shape fields kv_frame_to_bytes injects when the
+    payload rides the body."""
+    return {
+        "request_id": h.request_id,
+        "token_ids": list(h.token_ids),
+        "first_token": int(h.first_token),
+        "first_logprob": float(h.first_logprob),
+        "num_full_blocks": int(h.num_full_blocks),
+        "block_hashes": [b.hex() for b in h.block_hashes],
+        "usage_prompt_tokens": int(h.usage_prompt_tokens),
+        "kv_start_block": int(getattr(h, "kv_start_block", 0) or 0),
+        **extra,
+    }
+
+
+def handoff_from_parts(header: Dict[str, Any], body: bytes):
+    """Build a KVHandoff from an already-split frame (callers that peeked
+    at the header — e.g. the /kv/import session dispatch — must not pay a
+    second JSON decode of a token_ids-sized header)."""
     from xllm_service_tpu.runtime.engine import KVHandoff
 
-    sep = data.index(b"\x00")
-    header = _json.loads(data[:sep].decode("utf-8"))
-    kv = None
-    if "kv_shape" in header:
-        # bfloat16 needs ml_dtypes (jax ships it); np.dtype falls back for
-        # standard dtypes.
-        try:
-            dt = np.dtype(header["kv_dtype"])
-        except TypeError:
-            import ml_dtypes
-
-            dt = np.dtype(getattr(ml_dtypes, header["kv_dtype"]))
-        kv = np.frombuffer(data[sep + 1:], dtype=dt).reshape(header["kv_shape"])
+    kv = kv_frame_array(header, body)
     h = KVHandoff(
         request_id=header["request_id"],
         token_ids=[int(t) for t in header["token_ids"]],
@@ -191,8 +223,9 @@ def handoff_from_bytes(data: bytes):
         block_hashes=[bytes.fromhex(x) for x in header["block_hashes"]],
         kv=kv,
         usage_prompt_tokens=int(header.get("usage_prompt_tokens", 0)),
+        kv_start_block=int(header.get("kv_start_block", 0) or 0),
     )
-    return h, header
+    return h
 
 
 def parse_prompt_field(prompt: Any) -> "tuple[str, List[int], str]":

@@ -240,6 +240,16 @@ class EngineConfig:
     # enabled; disable to force the HTTP data plane.
     enable_local_kv_transfer: bool = True
 
+    # Pipelined PD handoff (docs/PD_DISAGGREGATION.md): stream each
+    # prefill chunk's completed KV blocks to the decode peer WHILE the
+    # next chunk is still prefilling, so only the tail rides the
+    # post-prefill commit and the handoff stall shrinks to the tail +
+    # control round-trip. Single-chunk prompts always take the monolithic
+    # path; any session failure falls back to it too. The env var
+    # XLLM_PD_STREAMING=1|0 overrides this field either way (the escape
+    # hatch is read per request, so it can flip on a live instance).
+    enable_pd_streaming: bool = True
+
     # Cross-PROCESS device-to-device KV data plane
     # (jax.experimental.transfer). When enabled, PD handoffs to a peer in
     # another process are OFFERED on this process's transfer server and

@@ -213,3 +213,28 @@ def prefix_block_hashes(
         hashes.append(h)
         prev = h
     return hashes
+
+
+def extend_prefix_block_hashes(
+    hashes: List[bytes],
+    token_ids: Sequence[int],
+    nblocks: int,
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    seed: int = DEFAULT_SEED,
+) -> List[bytes]:
+    """Extend a chained-hash list IN PLACE to `nblocks` blocks of
+    `token_ids`, returning it. Chain-identical to prefix_block_hashes
+    (same block_hash steps) — the incremental form for callers that grow
+    a prefix block-by-block (the engine's chunked-prefill KV streaming)
+    and must never pay the O(blocks) rehash per extension. Lives here so
+    the chain semantics have exactly one home."""
+    while len(hashes) < nblocks:
+        b = len(hashes)
+        hashes.append(
+            block_hash(
+                hashes[b - 1] if b else None,
+                token_ids[b * block_size : (b + 1) * block_size],
+                seed,
+            )
+        )
+    return hashes

@@ -65,6 +65,16 @@ class EngineRequest:
     # rpc_service/service.h:61-71). `handoff` receives a KVHandoff.
     prefill_only: bool = False
     handoff: Optional[Callable[["KVHandoff"], None]] = None
+    # Pipelined PD handoff (docs/PD_DISAGGREGATION.md): when set on a
+    # prefill_only request, the chunked-prefill loop calls
+    # `kv_stream.send_chunk(KVStreamChunk)` on the engine thread after each
+    # PARTIAL chunk lands, exporting the newly completed full blocks while
+    # the next chunk is still prefilling. The hook returns True when the
+    # chunk was accepted for delivery (the blocks then ride the stream and
+    # the final handoff carries only the tail); False — or a later
+    # `kv_stream.aborted` — makes the final handoff monolithic again
+    # (kv_start_block=0, full export). Single-chunk prompts never call it.
+    kv_stream: Optional[object] = None
     # EPD multimodal: encoder-produced media embeddings [m, E] injected at
     # these absolute prompt positions (placeholder tokens). Requests with
     # media bypass the prefix cache — placeholder ids alone cannot key
@@ -123,10 +133,38 @@ class KVHandoff:
     num_full_blocks: int
     # chained hashes of the migrated full blocks, in order
     block_hashes: List[bytes]
-    # [2, L, num_full_blocks, Hkv, BS, D] (k, v stacked); None when no full
-    # blocks exist (short prompt -> pure recompute on the decode side)
+    # [2, L, num_full_blocks - kv_start_block, Hkv, BS, D] (k, v stacked);
+    # None when no full blocks remain to carry (short prompt -> pure
+    # recompute on the decode side, or every block already rode the
+    # streaming session)
     kv: Optional[object]
     usage_prompt_tokens: int = 0
+    # Pipelined handoff: blocks [0, kv_start_block) were already delivered
+    # through the per-chunk streaming session (they sit committed in the
+    # importer's prefix cache); `kv` covers [kv_start_block,
+    # num_full_blocks). 0 = monolithic payload, exactly the old contract.
+    kv_start_block: int = 0
+
+
+@dataclass
+class KVStreamChunk:
+    """One pipelined-handoff chunk: the full blocks completed by a partial
+    prefill chunk, exported while later chunks are still prefilling.
+
+    `block_hashes` are the chained hashes of blocks [start_block,
+    start_block + n); `kv` is the device export [2, L, n, Hkv, BS, D]. The
+    importer lands them straight into its prefix cache (content-addressed
+    commit), so delivery order across chunks does not matter and a lost
+    chunk only costs recompute of its span — never correctness."""
+
+    request_id: str
+    start_block: int
+    block_hashes: List[bytes]
+    kv: object
+    prompt_tokens: int
+    # Total full blocks the whole prompt will migrate (session sizing /
+    # receive-side reservation hint).
+    total_blocks_hint: int = 0
 
 
 class _Seq:
@@ -135,7 +173,8 @@ class _Seq:
         "last_committed_block", "prefill_done_time", "last_token_time",
         "prefilled", "chunk_len", "prefill_start_time", "head_hash",
         "json_state", "json_upto", "schema_spec",
-        "rope_pos3", "rope_delta", "admit_gen",
+        "rope_pos3", "rope_delta", "admit_gen", "streamed_blocks",
+        "stream_hashes",
     )
 
     def __init__(self, req: EngineRequest, slot: int):
@@ -170,6 +209,12 @@ class _Seq:
         # for everything but media prompts on an mrope model.
         self.rope_pos3 = None
         self.rope_delta = 0
+        # Pipelined PD handoff: full blocks already exported through the
+        # request's kv_stream hook (the final handoff carries only
+        # [streamed_blocks, num_full_blocks)); `stream_hashes` caches the
+        # chained block hashes, extended incrementally per chunk.
+        self.streamed_blocks = 0
+        self.stream_hashes: List[bytes] = []
         # Bumped by _slot_admit: distinguishes a re-admission of the SAME
         # sequence object from the occupancy an in-flight step sampled for
         # (preempt + same-pass resume into the same slot must not let the
@@ -250,6 +295,11 @@ class InferenceEngine:
         self._pending_imports: Deque[Tuple[EngineRequest, KVHandoff]] = (
             collections.deque()
         )
+        # Streamed-chunk blocks from a pipelined PD handoff, landed on the
+        # engine thread ahead of the session's commit.
+        self._pending_kv_chunks: Deque[Tuple[List[bytes], object]] = (
+            collections.deque()
+        )
         self._running: Dict[int, _Seq] = {}  # slot -> seq
         self._free_slots = list(range(self.R - 1, -1, -1))
         self._lock = threading.Lock()
@@ -321,6 +371,7 @@ class InferenceEngine:
         self.overlap_steps = 0
         self.late_stop_discards = 0
         self.loop_errors = 0
+        self.kv_chunk_land_errors = 0
         self.host_gap_ms_sum = 0.0
         self.host_gap_steps = 0
         self._t_host_free: Optional[float] = None
@@ -421,6 +472,11 @@ class InferenceEngine:
             "Engine-loop iterations that raised (loop stays alive)",
         ).set_function(lambda: self.loop_errors)
         self.metrics.counter(
+            "xllm_engine_kv_chunk_land_errors_total",
+            "Streamed PD chunks that failed to land into the prefix "
+            "cache after being acked (their span recomputes at commit)",
+        ).set_function(lambda: self.kv_chunk_land_errors)
+        self.metrics.counter(
             "xllm_engine_preemptions_total",
             "Recompute-style preemptions (pool pressure + hybrid "
             "eviction)",
@@ -484,6 +540,7 @@ class InferenceEngine:
             self._waiting
             or self._running
             or self._pending_imports
+            or self._pending_kv_chunks
             or self._inflight is not None
         )
 
@@ -969,6 +1026,7 @@ class InferenceEngine:
                 # decode steps run in between. Counts as progress (the
                 # loop must not back off between chunks).
                 seq.prefilled = end
+                self._stream_chunk_kv(seq)
                 with self._lock:
                     self._waiting.appendleft(seq)
                 admitted += 1
@@ -1136,30 +1194,102 @@ class InferenceEngine:
 
     # ------------------------------------------------- PD disaggregation
 
+    def _stream_chunk_kv(self, seq: _Seq) -> None:
+        """Pipelined handoff: after a PARTIAL prefill chunk lands, export
+        the newly completed full blocks to the request's kv_stream hook so
+        they migrate while the next chunk is still prefilling. Safe vs.
+        later prefill steps: export_blocks gathers into a fresh device
+        buffer, and prompt blocks below `prefilled` are never rewritten.
+        Media/LoRA prompts never stream (their KV never enters the
+        hash-addressed migration path) and neither do resumed sequences
+        (generated history makes the token/hash split ambiguous)."""
+        req = seq.req
+        stream = req.kv_stream
+        if (
+            stream is None
+            or not req.prefill_only
+            or getattr(stream, "aborted", False)
+            or req.has_media
+            or req.adapter_idx
+            or seq.generated
+        ):
+            return
+        avail = seq.prefilled // self.block_size
+        if avail <= seq.streamed_blocks:
+            return
+        prompt_len = len(seq.tokens)
+        hashes = self._stream_prefix_hashes(seq, avail)
+        chunk = KVStreamChunk(
+            request_id=req.request_id,
+            start_block=seq.streamed_blocks,
+            block_hashes=hashes[seq.streamed_blocks: avail],
+            kv=self.executor.export_blocks(
+                seq.block_ids[seq.streamed_blocks: avail]
+            ),
+            prompt_tokens=prompt_len,
+            total_blocks_hint=prompt_len // self.block_size,
+        )
+        try:
+            ok = stream.send_chunk(chunk)
+        except Exception:  # hook errors must not kill the engine loop
+            logging.getLogger(__name__).exception(
+                "kv_stream hook failed for %s; falling back to the "
+                "monolithic handoff", req.request_id,
+            )
+            ok = False
+        if ok:
+            seq.streamed_blocks = avail
+
+    def _stream_prefix_hashes(self, seq: _Seq, nblocks: int) -> List[bytes]:
+        """Chained hashes of seq.tokens' first `nblocks` full blocks,
+        extended INCREMENTALLY across chunks via the per-seq cache —
+        rehashing the whole prefix per chunk would be O(blocks x chunks)
+        on exactly the long prompts the pipeline targets."""
+        from xllm_service_tpu.common.hashing import extend_prefix_block_hashes
+
+        cache = extend_prefix_block_hashes(
+            seq.stream_hashes, seq.tokens, nblocks,
+            self.block_size, self.block_mgr.seed,
+        )
+        return cache[:nblocks]
+
     def _handoff(self, seq: _Seq) -> None:
         """Prefill side: export this sequence's full committed blocks and
         hand them to the peer transport, then release the local sequence.
         The committed blocks stay in the local prefix cache (evictable), so
         cache-aware routing keeps its affinity signal."""
         full = seq.last_committed_block + 1
-        hashes = (
-            prefix_block_hashes(
+        if full <= 0:
+            hashes = []
+        elif seq.req.kv_stream is not None:
+            # Streaming requests: extend the per-chunk hash cache instead
+            # of rehashing the whole prefix a second time.
+            hashes = self._stream_prefix_hashes(seq, full)
+        else:
+            hashes = prefix_block_hashes(
                 seq.tokens[: full * self.block_size],
                 self.block_size,
                 self.block_mgr.seed,
             )
-            if full > 0
-            else []
-        )
+        # Pipelined handoff: blocks already delivered through the stream
+        # session ride nothing twice — the commit payload carries only the
+        # tail. A session that aborted (peer rejection / send failure)
+        # falls back to the full monolithic export: the blocks are still
+        # held right here, so the retry is free.
+        streamed = seq.streamed_blocks
+        stream = seq.req.kv_stream
+        if stream is not None and getattr(stream, "aborted", False):
+            streamed = 0
+        streamed = max(0, min(streamed, full))
         kv = None
-        if full > 0:
+        if full > streamed:
             # Stays a DEVICE array: the in-process (colocated-PD / ICI
             # analog) path imports it without ever touching the host; the
-            # HTTP/DCN path converts at serialization (handoff_to_bytes).
+            # HTTP/DCN path converts at serialization (kv_frame_to_bytes).
             # Safe vs. the block free below: export_blocks gathers into a
             # fresh buffer on the device stream before any later step can
             # rewrite the freed blocks.
-            kv = self.executor.export_blocks(seq.block_ids[:full])
+            kv = self.executor.export_blocks(seq.block_ids[streamed:full])
         payload = KVHandoff(
             request_id=seq.req.request_id,
             token_ids=list(seq.tokens),
@@ -1169,6 +1299,7 @@ class InferenceEngine:
             block_hashes=list(hashes),
             kv=kv,
             usage_prompt_tokens=len(seq.req.prompt_token_ids),
+            kv_start_block=streamed,
         )
         try:
             seq.req.handoff(payload)
@@ -1176,6 +1307,8 @@ class InferenceEngine:
             import traceback
 
             traceback.print_exc()
+            # The commit will never be sent — don't leak the session.
+            self._dispose_stream(seq.req)
         # release slot + block refs; committed blocks become evictable-cached
         if seq.slot in self._running:
             del self._running[seq.slot]
@@ -1193,7 +1326,35 @@ class InferenceEngine:
             self._pending_imports.append((req, handoff))
         self._work.set()
 
+    def import_kv_blocks(self, block_hashes: List[bytes], kv) -> None:
+        """Pipelined-handoff receive side: land one streamed chunk's full
+        blocks into the local prefix cache (committed under their chained
+        hashes, immediately evictable). Thread-safe entry; the landing runs
+        on the engine thread. The later commit handoff's admission picks
+        the blocks up through the ordinary prefix match — a chunk that
+        never arrives only costs recompute of its span."""
+        with self._lock:
+            self._pending_kv_chunks.append((list(block_hashes), kv))
+        self._work.set()
+
     def _drain_imports(self) -> None:
+        while True:
+            with self._lock:
+                if not self._pending_kv_chunks:
+                    break
+                hashes, kv = self._pending_kv_chunks.popleft()
+            try:
+                self._land_migrated_blocks(hashes, kv)
+            except Exception:
+                # Counted (xllm_engine_kv_chunk_land_errors_total): the
+                # chunk was already acked to the sender, so a landing
+                # failure is otherwise invisible until the commit's
+                # prefix match silently recomputes.
+                self.kv_chunk_land_errors += 1
+                logging.getLogger(__name__).exception(
+                    "streamed KV chunk failed to land; the commit will "
+                    "recompute its span"
+                )
         while True:
             with self._lock:
                 if not self._pending_imports:
@@ -1201,63 +1362,69 @@ class InferenceEngine:
                 req, h = self._pending_imports.popleft()
             self._do_import(req, h)
 
+    def _land_migrated_blocks(self, hashes: List[bytes], kv) -> None:
+        """Land migrated full blocks into the local cache under their
+        chained hashes (hashes[i] names kv[:, :, i]); blocks whose hash is
+        already cached locally are skipped (dedup). Shared by the
+        monolithic handoff import and the streamed-chunk path. Raises on
+        malformed payloads — callers degrade to recompute."""
+        expect = self.executor.migration_shape(len(hashes))
+        if kv.shape != expect:
+            raise ValueError(
+                f"handoff KV shape {kv.shape} != local cache layout "
+                f"{expect} — PD pair config mismatch; recomputing"
+            )
+        if any(
+            not isinstance(hb, bytes) or len(hb) != 16 for hb in hashes
+        ):
+            raise ValueError("malformed block hash in handoff; recomputing")
+        fresh = [
+            i
+            for i, hb in enumerate(hashes)
+            if self.block_mgr.lookup_hash(hb) is None
+        ]
+        ids = []
+        if fresh:
+            try:
+                ids = self.block_mgr.allocate(len(fresh))
+            except OutOfBlocksError:
+                ids = []
+        if ids:
+            try:
+                self.executor.import_blocks(
+                    kv[:, :, np.asarray(fresh, np.int32)],
+                    np.asarray(ids),
+                )
+            except Exception:
+                self.block_mgr.free(ids)
+                raise
+            for bid, i in zip(ids, fresh):
+                self.block_mgr.commit_block(bid, hashes[i])
+            # drop our temporary ref; blocks stay evictable-cached
+            # until admission re-acquires them via match_prefix
+            self.block_mgr.free(ids)
+
     def _do_import(self, req: EngineRequest, h: KVHandoff) -> None:
         # Land migrated full blocks into the local cache under their chained
-        # hashes; blocks whose hash is already cached locally are skipped
-        # (dedup). On ANY problem — capacity, a PD pair whose engine configs
+        # hashes. On ANY problem — capacity, a PD pair whose engine configs
         # diverge (block_size/layers/heads/dtype), a corrupt payload — fall
         # back to pure recompute: the resume _Seq below is seeded regardless,
         # so admission prefills the whole prompt locally and the request
-        # never vanishes.
-        if h.num_full_blocks > 0 and h.kv is not None:
+        # never vanishes. A pipelined handoff's kv covers only blocks
+        # [kv_start_block, num_full_blocks) — the earlier ones arrived (or
+        # were lost, costing only recompute) through the streamed chunks.
+        start = max(int(getattr(h, "kv_start_block", 0) or 0), 0)
+        if h.num_full_blocks > start and h.kv is not None:
             try:
-                # numpy from the HTTP/DCN path; a device jax.Array from the
-                # in-process local path (no host round-trip — the slice and
-                # import below run device-side).
-                kv = h.kv
-                expect = self.executor.migration_shape(h.num_full_blocks)
-                if kv.shape != expect:
-                    raise ValueError(
-                        f"handoff KV shape {kv.shape} != local cache layout "
-                        f"{expect} — PD pair config mismatch; recomputing"
-                    )
                 if len(h.block_hashes) != h.num_full_blocks:
                     raise ValueError(
                         f"{len(h.block_hashes)} block hashes for "
                         f"{h.num_full_blocks} blocks; recomputing"
                     )
-                if any(
-                    not isinstance(hb, bytes) or len(hb) != 16
-                    for hb in h.block_hashes
-                ):
-                    raise ValueError(
-                        "malformed block hash in handoff; recomputing"
-                    )
-                fresh = [
-                    i
-                    for i, hb in enumerate(h.block_hashes)
-                    if self.block_mgr.lookup_hash(hb) is None
-                ]
-                ids = []
-                if fresh:
-                    try:
-                        ids = self.block_mgr.allocate(len(fresh))
-                    except OutOfBlocksError:
-                        ids = []
-                if ids:
-                    try:
-                        self.executor.import_blocks(
-                            kv[:, :, np.asarray(fresh, np.int32)],
-                            np.asarray(ids),
-                        )
-                    except Exception:
-                        self.block_mgr.free(ids)
-                        raise
-                    for bid, i in zip(ids, fresh):
-                        self.block_mgr.commit_block(bid, h.block_hashes[i])
-                    # drop our temporary ref; blocks stay evictable-cached
-                    # until admission re-acquires them via match_prefix
-                    self.block_mgr.free(ids)
+                # numpy from the HTTP/DCN path; a device jax.Array from the
+                # in-process local path (no host round-trip — the slice and
+                # import below run device-side).
+                self._land_migrated_blocks(h.block_hashes[start:], h.kv)
             except Exception:
                 import traceback
 
@@ -1274,7 +1441,23 @@ class InferenceEngine:
             self._waiting.append(seq)
         self._work.set()
 
+    @staticmethod
+    def _dispose_stream(req: EngineRequest) -> None:
+        """A request that will never hand off tears its streaming session
+        down (peer-side entry + offer keepalives) instead of leaking it
+        until the receiver's TTL reap."""
+        stream = req.kv_stream
+        if stream is None:
+            return
+        try:
+            fn = getattr(stream, "dispose", None)
+            if fn is not None:
+                fn()
+        except Exception:
+            pass
+
     def _reject(self, req: EngineRequest, code: StatusCode, msg: str) -> None:
+        self._dispose_stream(req)
         out = RequestOutput(
             request_id=req.request_id,
             status=Status(code, msg),
@@ -1286,6 +1469,7 @@ class InferenceEngine:
             pass
 
     def _notify_cancelled(self, req: EngineRequest) -> None:
+        self._dispose_stream(req)
         out = RequestOutput(
             request_id=req.request_id,
             finished=True,
@@ -2273,6 +2457,10 @@ class InferenceEngine:
     def _finish(
         self, seq: _Seq, reason: FinishReason, cancelled: bool = False
     ) -> None:
+        # A prefill_only request reaching _finish (cancel, or EOS/limit on
+        # its very first token) will never run its handoff — its streaming
+        # session must not leak on the decode peer.
+        self._dispose_stream(seq.req)
         if seq.slot in self._running:
             del self._running[seq.slot]
             self._free_slots.append(seq.slot)

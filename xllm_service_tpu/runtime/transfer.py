@@ -58,6 +58,11 @@ class KVTransferServer:
         # Keep offered arrays (and their pull futures) alive until the
         # peer's pull completes — retract() drops the reference.
         self._pending: Dict[int, Any] = {}
+        # retract_later timers by uuid: a clean ack after an errored
+        # control path must CANCEL the timer and drop the offer NOW —
+        # otherwise every such offer pins HBM for the full grace window
+        # even though the peer's pull already completed.
+        self._retract_timers: Dict[int, threading.Timer] = {}
 
     @property
     def address(self) -> str:
@@ -73,20 +78,32 @@ class KVTransferServer:
 
     def retract(self, uuid: int) -> None:
         """Drop an offer's keepalive (after the peer acked its pull, or on
-        control-message failure)."""
+        control-message failure). Cancels any retract_later timer still
+        pending for the uuid."""
         with self._mu:
             self._pending.pop(uuid, None)
+            timer = self._retract_timers.pop(uuid, None)
+        if timer is not None:
+            timer.cancel()
 
     def pull(self, addr: str, uuid: int, avals: Sequence[Any]) -> List[Any]:
         """Pull arrays offered under `uuid` from the server at `addr` into
         this process's devices. `avals` are jax.ShapeDtypeStruct with
-        shardings on local devices."""
+        shardings on local devices. A failed pull evicts the peer's cached
+        connection — a restarted peer must not keep receiving pulls over a
+        dead cached transport."""
         with self._mu:
             conn = self._conns.get(addr)
             if conn is None:
                 conn = self._srv.connect(addr)
                 self._conns[addr] = conn
-        return conn.pull(uuid, list(avals))
+        try:
+            return conn.pull(uuid, list(avals))
+        except Exception:
+            with self._mu:
+                if self._conns.get(addr) is conn:
+                    del self._conns[addr]
+            raise
 
     def pull_single(self, addr: str, uuid: int, shape, dtype) -> Any:
         """Pull one array onto this process's first LOCAL device (the
@@ -105,10 +122,70 @@ class KVTransferServer:
         """Drop an offer's keepalive AFTER the peer's possible pull window
         (used when a control POST errored mid-flight: the peer may still
         be pulling, so an immediate retract could free the buffer under
-        it)."""
+        it). A later retract() for the same uuid cancels the timer and
+        frees immediately."""
         t = threading.Timer(delay_s, self.retract, args=(uuid,))
         t.daemon = True
+        with self._mu:
+            old = self._retract_timers.pop(uuid, None)
+            self._retract_timers[uuid] = t
+        if old is not None:
+            old.cancel()
         t.start()
+
+    def open_offer_session(self) -> "KVOfferSession":
+        """Group several offers (a pipelined PD handoff's chunks) under one
+        session for bulk retraction on abort."""
+        return KVOfferSession(self)
+
+
+class KVOfferSession:
+    """Multi-offer bookkeeping for one streaming handoff session: each
+    chunk's arrays are offered independently (the peer pulls them as its
+    /kv/import control messages land, asynchronously w.r.t. later chunks),
+    and an abort retracts everything still pending in one sweep."""
+
+    def __init__(self, server: KVTransferServer):
+        self._server = server
+        self._mu = threading.Lock()
+        self._uuids: List[int] = []
+
+    def offer(self, arrays: Sequence[Any]) -> int:
+        uuid = self._server.offer(arrays)
+        with self._mu:
+            self._uuids.append(uuid)
+        return uuid
+
+    def retract(self, uuid: int) -> None:
+        """One chunk's pull completed (clean control ack): drop its offer
+        now, keep the rest of the session alive."""
+        self._server.retract(uuid)
+        self.forget(uuid)
+
+    def forget(self, uuid: int) -> None:
+        """Remove a uuid from the session WITHOUT touching its offer —
+        for offers whose lifetime was handed to a server-level grace
+        timer (errored control path): a later session-wide retract_all
+        must not cancel that timer and free the buffer mid-pull."""
+        with self._mu:
+            try:
+                self._uuids.remove(uuid)
+            except ValueError:
+                pass
+
+    def retract_all_later(self, delay_s: float = 120.0) -> None:
+        """Session abort with chunks possibly still being pulled: give
+        every outstanding offer the grace window, then free."""
+        with self._mu:
+            uuids, self._uuids = self._uuids, []
+        for uuid in uuids:
+            self._server.retract_later(uuid, delay_s)
+
+    def retract_all(self) -> None:
+        with self._mu:
+            uuids, self._uuids = self._uuids, []
+        for uuid in uuids:
+            self._server.retract(uuid)
 
 
 _PROCESS_SERVER: Optional[KVTransferServer] = None
