@@ -25,7 +25,9 @@ Fault injection: --chaos-spec takes a seeded schedule (inline JSON or
       {"at_frac": 0.2, "action": "flap", "instance": 0, "duration_s": 2},
       {"at_frac": 0.2, "action": "partition", "instance": 0,
        "duration_s": 2},
-      {"at_frac": 0.1, "action": "slow", "instance": 0, "delay_ms": 50}]}
+      {"at_frac": 0.1, "action": "slow", "instance": 0, "delay_ms": 50},
+      {"at_frac": 0.5, "action": "master_kill"},
+      {"at_frac": 0.5, "action": "master_partition", "duration_s": 3}]}
 
   * kill      — InstanceServer.crash(): heartbeats + HTTP drop, NO
                 deregistration; live streams die mid-token and the
@@ -36,6 +38,25 @@ Fault injection: --chaos-spec takes a seeded schedule (inline JSON or
   * partition — flap + dropped heartbeats (both directions of the link)
                 for duration_s;
   * slow      — stretch the fake engine's per-token delay.
+
+Control-plane chaos (docs/FAULT_TOLERANCE.md): any master_* event makes
+the bench run a TWO-master replica set against one shared store, and the
+driver resolves the current master from the store per attempt (retrying
+a failed request against whichever replica holds the lease — the
+client-retry contract the fenced front door redirects toward):
+
+  * master_kill      — Master.kill() on the active replica: both HTTP
+                       planes drop, the election keepalive stops WITHOUT
+                       revoking the lease; the standby takes over at TTL
+                       expiry, reconciles instance manifests, and serves;
+  * master_partition — drop the active master's election.keepalive for
+                       duration_s: it demotes + fences while alive (the
+                       split-brain case); the standby takes over.
+
+The report then carries takeover latency (lease-won -> reconciled, and
+-> first dispatch), reconciled vs orphaned manifests, orphan reaps,
+fenced-RPC rejections, and double_dispatches — completed streams whose
+token count deviates from the trace's expectation, which MUST be 0.
 
 The report carries redispatch/resume counts, resume-latency p99,
 failed-after-retry, breaker ejections/probe recoveries, and the final
@@ -445,15 +466,46 @@ def main() -> None:
     from xllm_service_tpu.coordination import MemoryStore
 
     rng = np.random.default_rng(args.seed)
+
+    # Chaos schedule (common/faults.py) — parsed ONCE, up front: the
+    # master topology below depends on whether control-plane events are
+    # scheduled.
+    chaos = {"seed": args.seed, "events": []}
+    if args.chaos_spec:
+        raw = args.chaos_spec
+        if raw.startswith("@"):
+            with open(raw[1:]) as f:
+                chaos = json.load(f)
+        else:
+            chaos = json.loads(raw)
+    if args.kill_at > 0:
+        chaos.setdefault("events", []).append(
+            {"at_frac": args.kill_at, "action": "kill", "instance": -1}
+        )
+    chaos_events = list(chaos.get("events", []))
+    master_chaos = any(
+        str(e.get("action", "")).startswith("master_")
+        for e in chaos_events
+    )
+
     store = MemoryStore()
     cfg = ServiceConfig(
         host="127.0.0.1", http_port=0, rpc_port=0,
         heartbeat_interval_s=args.heartbeat_s, master_lease_ttl_s=3.0,
         load_balance_policy=args.policy, block_size=16,
         detect_disconnected_instance_interval_s=2.0,
+        reconcile_orphan_ttl_s=5.0,
     )
     master = Master(cfg, store=store)
     master.start()
+    masters = [master]
+    if master_chaos:
+        # Control-plane chaos needs a standby to take over; spin it up
+        # front (same store, own ephemeral ports) so the takeover is a
+        # pure election + reconcile, not a process boot.
+        standby = Master(cfg, store=store)
+        standby.start()
+        masters.append(standby)
 
     on_tpu = False
     if args.real_engine:
@@ -553,22 +605,9 @@ def main() -> None:
     offline_mask = rng.random(args.requests) < args.offline_frac
     gaps = rng.exponential(1.0 / args.rate, size=args.requests)
 
-    # ---- chaos schedule (common/faults.py) ---------------------------- #
+    # ---- chaos plan installation (events parsed above) ---------------- #
     from xllm_service_tpu.common import faults
 
-    chaos = {"seed": args.seed, "events": []}
-    if args.chaos_spec:
-        raw = args.chaos_spec
-        if raw.startswith("@"):
-            with open(raw[1:]) as f:
-                chaos = json.load(f)
-        else:
-            chaos = json.loads(raw)
-    if args.kill_at > 0:
-        chaos.setdefault("events", []).append(
-            {"at_frac": args.kill_at, "action": "kill", "instance": -1}
-        )
-    chaos_events = list(chaos.get("events", []))
     if chaos_events:
         if any(e.get("action") == "kill" for e in chaos_events) and (
             len(instances) < 2
@@ -591,10 +630,51 @@ def main() -> None:
             t.daemon = True
             t.start()
 
+    def _active_master():
+        for m in masters:
+            if not m._killed and m.scheduler.is_master:
+                return m
+        for m in masters:
+            if not m._killed:
+                return m
+        return masters[0]
+
+    master_kills = []
+
     def fire_chaos(ev, t_start):
+        action = ev.get("action")
+        if action == "master_kill":
+            # Ungraceful: planes drop, keepalive stops, lease LINGERS
+            # until TTL — the standby takes over only when the store's
+            # liveness fires, then reconciles instance manifests.
+            m = _active_master()
+            m.kill()
+            master_kills.append(
+                {"master": m.http_address,
+                 "at_s": round(time.monotonic() - t_start, 3)}
+            )
+            return
+        if action == "master_partition":
+            # The split-brain case: the active master's keepalive HANGS
+            # (a partitioned etcd link times out, it doesn't fail fast),
+            # so its lease expires and the standby is elected WHILE this
+            # replica still believes it is master and keeps dispatching.
+            # Those stale-epoch dispatches are exactly what instances
+            # must fence (412) once the successor's reconcile raises
+            # their epoch — the run's fenced_rpcs counter proves it.
+            m = _active_master()
+            _expiring_rules(
+                [faults.FaultRule(
+                    point="election.keepalive",
+                    match=m.scheduler.election_identity,
+                    action="delay",
+                    delay_ms=float(ev.get("delay_ms", 6000.0)),
+                )],
+                ev.get("duration_s"),
+            )
+            return
         idx = ev.get("instance", -1) % len(instances)
         srv = instances[idx]
-        action = ev.get("action")
         if action == "kill":
             srv.crash()
             killed_at.append(
@@ -643,58 +723,100 @@ def main() -> None:
     ttfts, tpots, lats, errors = [], [], [], []
     off_ttfts, on_ttfts = [], []
     first_tokens = [0]
+    retried_to_new_master = [0]
+    double_dispatches = [0]
+    unrecovered = [0]
     mu = threading.Lock()
 
-    def drive(i: int):
-        t0 = time.monotonic()
-        try:
-            host, _, port = master.http_address.partition(":")
-            import http.client
+    from xllm_service_tpu.coordination import MASTER_KEY
 
-            conn = http.client.HTTPConnection(host, int(port), timeout=300.0)
-            body = {
-                "model": model if args.real_engine else "fake-echo",
-                "prompt": pairs[i][0],
-                "max_tokens": int(pairs[i][1]),
-                "temperature": 0.0,
-                "stream": True,
-            }
-            if offline_mask[i]:
-                body["offline"] = True
-            conn.request(
-                "POST", "/v1/completions", body=json.dumps(body).encode(),
-                headers={"Content-Type": "application/json"},
-            )
-            resp = conn.getresponse()
-            assert resp.status == 200, resp.read()
+    def _master_addr() -> str:
+        """The client-retry contract: resolve whichever replica holds the
+        master lease NOW (the election identity IS its client address);
+        the fenced front door 307s toward the same value."""
+        try:
+            cur = store.get(MASTER_KEY)
+        except Exception:
+            cur = None
+        return cur or _active_master().http_address
+
+    def drive(i: int):
+        import http.client
+
+        t0 = time.monotonic()
+        # Fake-echo expectation: one delta event per token, reversal
+        # capped by max_tokens — the double-dispatch detector below.
+        expect_tok = min(len(pairs[i][0]), int(pairs[i][1]))
+        # Retries must outlive the takeover window: lease TTL (3 s) +
+        # election + reconcile before the standby serves.
+        max_attempts = 6 if master_chaos else 1
+        for attempt in range(max_attempts):
+            if attempt:
+                with mu:
+                    retried_to_new_master[0] += 1
+                time.sleep(1.0)  # takeover window; addr re-resolves below
+            addr = _master_addr() if master_chaos else master.http_address
             n_tok = 0
             t_first = t_last = None
             deltas = []
             stream_err = ""
-            for raw in resp:
-                line = raw.decode().strip()
-                if not line.startswith("data: "):
-                    continue
-                payload = line[len("data: "):]
-                if payload == "[DONE]":
-                    break
-                try:
-                    ev = json.loads(payload)
-                except ValueError:
-                    ev = {}
-                if isinstance(ev, dict) and "error" in ev:
-                    # mid-stream error event (e.g. instance died after
-                    # tokens reached us — not replayable): fault-visible
-                    stream_err = payload[:200]
-                    break
-                now = time.monotonic()
-                if t_first is None:
-                    t_first = now
-                elif t_last is not None:
-                    deltas.append(now - t_last)
-                t_last = now
-                n_tok += 1
-            conn.close()
+            done = False
+            try:
+                host, _, port = addr.partition(":")
+                conn = http.client.HTTPConnection(
+                    host, int(port), timeout=300.0
+                )
+                body = {
+                    "model": model if args.real_engine else "fake-echo",
+                    "prompt": pairs[i][0],
+                    "max_tokens": int(pairs[i][1]),
+                    "temperature": 0.0,
+                    "stream": True,
+                }
+                if offline_mask[i]:
+                    body["offline"] = True
+                conn.request(
+                    "POST", "/v1/completions",
+                    body=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    # 307 = standby's redirect, 503 = no master yet —
+                    # both retry against the re-resolved address.
+                    raise RuntimeError(
+                        f"HTTP {resp.status}: {resp.read()[:120]!r}"
+                    )
+                for raw in resp:
+                    line = raw.decode().strip()
+                    if not line.startswith("data: "):
+                        continue
+                    payload = line[len("data: "):]
+                    if payload == "[DONE]":
+                        done = True
+                        break
+                    try:
+                        ev = json.loads(payload)
+                    except ValueError:
+                        ev = {}
+                    if isinstance(ev, dict) and "error" in ev:
+                        # mid-stream error event (e.g. instance died after
+                        # tokens reached us — not replayable, or the
+                        # master demoted mid-exchange): fault-visible
+                        stream_err = payload[:200]
+                        break
+                    now = time.monotonic()
+                    if t_first is None:
+                        t_first = now
+                    elif t_last is not None:
+                        deltas.append(now - t_last)
+                    t_last = now
+                    n_tok += 1
+                conn.close()
+            except Exception as e:  # noqa: BLE001
+                stream_err = stream_err or repr(e)
+            if not done and attempt + 1 < max_attempts:
+                continue  # retry-to-current-master
             with mu:
                 if t_first is not None:
                     ttfts.append(t_first - t0)
@@ -704,11 +826,28 @@ def main() -> None:
                 tpots.extend(deltas)
                 lats.append(time.monotonic() - t0)
                 first_tokens[0] += n_tok
-                if stream_err:
+                if (
+                    master_chaos
+                    and done
+                    and not args.real_engine
+                    and n_tok != expect_tok
+                ):
+                    # A COMPLETED stream whose token count deviates from
+                    # the trace expectation means duplicated (two masters
+                    # fed it) or lost tokens — the split-brain symptom
+                    # epoch fencing exists to make impossible.
+                    double_dispatches[0] += 1
+                if not done:
+                    if master_chaos:
+                        unrecovered[0] += 1
+                        errors.append(
+                            stream_err or "stream ended without [DONE]"
+                        )
+                    elif stream_err:
+                        errors.append(stream_err)
+                elif stream_err:
                     errors.append(stream_err)
-        except Exception as e:  # noqa: BLE001
-            with mu:
-                errors.append(repr(e))
+            return
 
     threads = []
     t_start = time.monotonic()
@@ -723,7 +862,10 @@ def main() -> None:
     for t in threads:
         t.join(timeout=600.0)
     wall = time.monotonic() - t_start
-    sched = master.scheduler
+    # Read terminal stats from the replica that ended the run as master —
+    # under master chaos the original one may be dead.
+    active = _active_master()
+    sched = active.scheduler
     redispatches = sched.total_redispatches
     resumes = sched.total_resumes
     redispatch_attempts = sched.total_redispatch_attempts
@@ -738,7 +880,45 @@ def main() -> None:
     health_states = dict(mgr.health_states())
     ejections = mgr.total_ejections
     probe_recoveries = mgr.total_probe_recoveries
-    budget_exhausted = master._retry_budget.exhausted_total
+    budget_exhausted = active._retry_budget.exhausted_total
+    master_report = None
+    if master_chaos:
+        # Give the instance-side orphan TTL a chance to fire so the reap
+        # counters below reflect the steady state, not a race.
+        time.sleep(cfg.reconcile_orphan_ttl_s + 1.0)
+
+        def _inst_counter(name):
+            total = 0
+            for srv in instances:
+                m = srv.metrics.get(name)
+                if m is not None:
+                    total += int(m.get())
+            return total
+
+        master_report = {
+            "master_kills": master_kills or None,
+            "final_master": sched.election_identity,
+            "final_epoch": sched.master_epoch,
+            "takeover_ms": (
+                round(sched.last_takeover_ms, 3)
+                if sched.last_takeover_ms is not None else None
+            ),
+            "takeover_to_first_dispatch_ms": (
+                round(sched.takeover_first_dispatch_ms, 3)
+                if sched.takeover_first_dispatch_ms is not None else None
+            ),
+            "reconciled_requests": sched.total_reconciled,
+            "orphaned_requests": sched.total_orphaned,
+            "orphans_reaped": _inst_counter(
+                "xllm_service_orphan_reaped_total"
+            ),
+            "fenced_rpcs": _inst_counter(
+                "xllm_instance_fenced_rpcs_total"
+            ),
+            "retried_to_new_master": retried_to_new_master[0],
+            "double_dispatches": double_dispatches[0],
+            "unrecovered_reconcilable_streams": unrecovered[0],
+        }
     faults.clear()
 
     # Service-tier latency distributions from the obs histograms (the
@@ -746,7 +926,7 @@ def main() -> None:
     # percentiles, cross-checkable against the client-side measurements
     # above.
     def hist_pcts(name):
-        h = master.scheduler.metrics.get(name)
+        h = sched.metrics.get(name)
         if h is None:
             return None
         return {
@@ -782,7 +962,11 @@ def main() -> None:
             srv.stop()
         except Exception:
             pass
-    master.stop()
+    for m in masters:
+        try:
+            m.stop()
+        except Exception:
+            pass
     store.close()
 
     def pct(xs, q):
@@ -840,6 +1024,7 @@ def main() -> None:
                     prefix_by_instance if args.shared_prefix else None
                 ),
                 "pd_flips": pd_flips,
+                "master_failover": master_report,
             }
         )
     )

@@ -39,6 +39,13 @@ REQUIRED_POINTS = {
     # emission and receiver chunk landing
     "kv_stream.send",
     "kv_stream.recv",
+    # control-plane failover (docs/FAULT_TOLERANCE.md): master lease
+    # keepalive (drop => demote + fence), store watch delivery, and both
+    # sides of the takeover-reconciliation RPC
+    "election.keepalive",
+    "store.watch",
+    "reconcile.send",
+    "reconcile.recv",
 }
 
 

@@ -299,6 +299,22 @@ def test_compare_create_election_txn(fake_etcd):
     assert st.get("XLLM:SERVICE:MASTER") == "m1"
 
 
+def test_compare_create_with_epoch_txn(fake_etcd):
+    """The fencing-epoch election txn (docs/FAULT_TOLERANCE.md): winner
+    commits master key + epoch bump atomically; losers get 0 and leave
+    the epoch untouched; a later term always commits a higher epoch."""
+    addr, _ = fake_etcd
+    st = EtcdGatewayStore(addr)
+    key, ek = "XLLM:SERVICE:MASTER", "XLLM:SERVICE:MASTER:EPOCH"
+    assert st.compare_create_with_epoch(key, "m1", ek) == 1
+    assert st.compare_create_with_epoch(key, "m2", ek) == 0  # key exists
+    assert st.get(ek) == "1"
+    assert st.get(key) == "m1"
+    st.remove(key)  # master died: key gone, epoch survives
+    assert st.compare_create_with_epoch(key, "m2", ek) == 2
+    assert st.get(ek) == "2" and st.get(key) == "m2"
+
+
 def test_guarded_remove(fake_etcd):
     addr, _ = fake_etcd
     st = EtcdGatewayStore(addr)

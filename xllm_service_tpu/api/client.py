@@ -78,19 +78,30 @@ class MasterClient:
         code, resp = post_json(self._addr, "/rpc/heartbeat", body, timeout=10.0)
         return resp if code == 200 else {"ok": False}
 
-    def push_generations(self, outputs: List[RequestOutput]) -> Dict[str, bool]:
+    def push_generations(
+        self, outputs: List[RequestOutput], epoch: int = 0
+    ) -> Dict[str, bool]:
         """Batched decode->service stream (proto analog:
         DisaggStreamGenerations, Generations RPC). Returns the per-request
-        continue map; False means the service dropped the request."""
+        continue map; False means the service dropped the request.
+
+        `epoch` is the instance's fence high-water: a master that sees a
+        HIGHER epoch than its own was deposed and just doesn't know yet —
+        it 503s instead of judging the batch (its cont=False would cancel
+        work the real master dispatched in the pre-demotion window). A
+        non-200 RAISES so the caller retries; by then the heartbeat has
+        re-pointed `_addr` at the successor."""
         if not outputs:
             return {}
+        body: Dict = {"gens": [output_to_json(o) for o in outputs]}
+        if epoch:
+            body["master_epoch"] = int(epoch)
         code, resp = post_json(
-            self._addr,
-            "/rpc/generations",
-            {"gens": [output_to_json(o) for o in outputs]},
-            timeout=30.0,
+            self._addr, "/rpc/generations", body, timeout=30.0
         )
-        return resp.get("cont", {}) if code == 200 else {}
+        if code != 200:
+            raise RuntimeError(f"generations push rejected: HTTP {code}")
+        return resp.get("cont", {})
 
     def instance_info(self, name: str) -> Optional[InstanceMetaInfo]:
         code, resp = get_json(self._addr, f"/rpc/instance_info?name={name}")
@@ -172,6 +183,16 @@ class HeartbeatLoop:
         if not resp.get("ok", False) and event is not None and not event.empty():
             # Master rejected/unreachable: keep the delta for the next beat.
             self._pending_event = event
+        new_rpc = resp.get("master_rpc") if isinstance(resp, dict) else ""
+        if new_rpc and new_rpc != self._client._addr:
+            # A deposed master answered with the successor's address
+            # (docs/FAULT_TOLERANCE.md): follow it — the next beat gets
+            # `reregister` from the new master and a fresh lease.
+            logger.info(
+                "heartbeat re-pointing %s -> %s (master takeover)",
+                self._client._addr, new_rpc,
+            )
+            self._client._addr = new_rpc
         if resp.get("reregister") and not self._stop.is_set():
             # The stop guard matters: a slow in-flight beat straddling
             # shutdown would otherwise re-insert the instance AFTER the

@@ -58,6 +58,7 @@ class FakeEngine:
         self._mu = threading.Lock()
         self._active = 0
         self._cache_event = KvCacheEvent()
+        self.cache_hashes: set = set()
         self.requests_seen: List = []
         # /v1/embeddings surface: deterministic unit vectors derived from
         # the token ids (the instance HTTP layer calls
@@ -154,6 +155,16 @@ class FakeEngine:
     def seed_cache_event(self, ev: KvCacheEvent) -> None:
         with self._mu:
             self._cache_event = ev
+            # Snapshot view (reconcile): stored hashes persist until a
+            # later event removes them.
+            self.cache_hashes |= set(ev.stored_cache)
+            self.cache_hashes -= set(ev.removed_cache)
+
+    def cache_snapshot(self):
+        """Full committed-block view for POST /reconcile (the real engine
+        reads its block manager; tests seed cache_hashes directly)."""
+        with self._mu:
+            return sorted(self.cache_hashes)
 
     def profiling_data(self) -> Tuple[List, List]:
         ttft = [(n, self.ttft_ms + 0.01 * n) for n in (64, 256, 1024, 4096)]

@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, List, Optional
 from xllm_service_tpu.api.client import HeartbeatLoop, MasterClient
 from xllm_service_tpu.api.http_utils import HttpJsonApi, make_http_server
 from xllm_service_tpu.api.protocol import sampling_from_body  # noqa: F401 — re-export
+from xllm_service_tpu.common import faults
 from xllm_service_tpu.common.config import EngineConfig
 from xllm_service_tpu.common.types import (
     InstanceMetaInfo,
@@ -246,6 +247,28 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
         # cancel them all)
         self._srid_map: Dict[str, List[str]] = {}
         self._srid_mu = threading.Lock()
+        # Per-srid reconcile manifest state (same lock): owning master
+        # epoch, prompt-token count, and delivered-token count — what a
+        # freshly elected master needs to rebuild its load charges from
+        # POST /reconcile (docs/FAULT_TOLERANCE.md, control plane).
+        self._srid_info: Dict[str, Dict[str, int]] = {}
+        # Epoch fence: highest master epoch this instance has seen on any
+        # control RPC. RPCs stamped with a LOWER epoch come from a
+        # deposed master and are rejected with 412 — split-brain dispatch
+        # is structurally impossible, not just unlikely.
+        self._fence_mu = threading.Lock()
+        self._fence_epoch = 0
+        self._m_fenced = self.metrics.counter(
+            "xllm_instance_fenced_rpcs_total",
+            "Master RPCs rejected for carrying a stale fencing epoch "
+            "(split-brain dispatch attempts)",
+        )
+        self._m_orphans = self.metrics.counter(
+            "xllm_service_orphan_reaped_total",
+            "In-flight requests reaped after a master takeover did not "
+            "reclaim them within the orphan TTL (engine work cancelled, "
+            "KV blocks freed)",
+        )
         # decode-peer address cache (PD disagg handoff target)
         self._peer_addrs: Dict[str, str] = {}
         # Alternate PD response topology (service.h:61-71 analog): srid ->
@@ -437,7 +460,13 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
                         if dest:
                             got = self._relay_generations(dest, group)
                         else:
-                            got = self._master.push_generations(group)
+                            # Stamped with the fence high-water: a master
+                            # whose term is older 503s instead of judging
+                            # (split-brain window), and the retry lands at
+                            # the successor once the heartbeat re-points.
+                            got = self._master.push_generations(
+                                group, epoch=self._fence_epoch
+                            )
                         break
                     except Exception:
                         # Destination briefly unreachable: the batch may
@@ -455,7 +484,9 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
                     for out in group:
                         self._relay_addrs.pop(out.service_request_id, None)
                     try:
-                        got = self._master.push_generations(group)
+                        got = self._master.push_generations(
+                            group, epoch=self._fence_epoch
+                        )
                     except Exception:
                         got = None
                 if got is None:
@@ -482,6 +513,7 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
                     self._relay_addrs.pop(srid, None)
                     with self._srid_mu:
                         rids = self._srid_map.pop(srid, None) or []
+                        self._srid_forget_locked(srid)
                     for rid in rids:
                         self.engine.cancel(rid)
 
@@ -559,6 +591,195 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
         else:
             h.send_error_json(404, f"no route {route}")
 
+    # ------------------------------------------------------------------ #
+    # epoch fencing + takeover reconciliation (docs/FAULT_TOLERANCE.md)
+    # ------------------------------------------------------------------ #
+
+    def _fence_epoch_check(self, epoch) -> int:
+        """Raise the high-water fencing epoch; returns 0 when `epoch` is
+        acceptable (absent / current / newer) or the current fence value
+        the caller is behind. Only stamped RPCs participate — direct
+        client traffic carries no epoch and always passes."""
+        try:
+            e = int(epoch)
+        except (TypeError, ValueError):
+            return 0
+        if e <= 0:
+            return 0
+        with self._fence_mu:
+            if e < self._fence_epoch:
+                return self._fence_epoch
+            self._fence_epoch = e
+        return 0
+
+    def _fence_reject(self, h: HttpJsonApi, body) -> bool:
+        """412-reject an RPC stamped with a stale master epoch (counted).
+        The DISTINCT status + `fenced` marker lets the deposed master
+        tell "you are not the master anymore" apart from a client error —
+        it must stop dispatching, not blame the request."""
+        stamped = (body or {}).get("master_epoch")
+        cur = self._fence_epoch_check(stamped)
+        if not cur:
+            return False
+        self._m_fenced.inc()
+        logger.warning(
+            "instance %s fenced an RPC from a deposed master "
+            "(epoch %s < %d)", self.name, stamped, cur,
+        )
+        h.send_json(
+            {
+                "error": {
+                    "message": (
+                        f"stale master epoch {stamped} < {cur}: this "
+                        "master was deposed"
+                    ),
+                    "type": "stale_epoch",
+                },
+                "fenced": True,
+                "epoch": cur,
+            },
+            status=412,
+        )
+        return True
+
+    def _srid_track(
+        self, srid: str, prompt_tokens: int, epoch, delivered: int = 0
+    ) -> None:
+        """Register one forwarded request's reconcile-manifest entry
+        (caller does NOT hold _srid_mu)."""
+        if not srid:
+            return
+        try:
+            e = int(epoch or 0)
+        except (TypeError, ValueError):
+            e = 0
+        with self._srid_mu:
+            self._srid_info[srid] = {
+                "prompt_tokens": int(prompt_tokens),
+                "delivered": int(delivered),
+                "epoch": e,
+            }
+
+    def _srid_note_delivered(self, srid: str, n: int) -> None:
+        if not srid or n <= 0:
+            return
+        with self._srid_mu:
+            info = self._srid_info.get(srid)
+            if info is not None:
+                info["delivered"] += n
+
+    def _srid_forget_locked(self, srid: str) -> None:
+        """Drop the manifest entry; caller holds _srid_mu."""
+        self._srid_info.pop(srid, None)
+
+    def _handle_reconcile(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
+        """Takeover reconciliation target (POST /reconcile): return this
+        instance's in-flight request manifest, current load, and the
+        committed prefix-cache block hashes so a freshly elected master
+        rebuilds its cluster view instead of starting amnesiac. In-flight
+        srids the new master does not claim (`known`) are ORPHANS: a TTL
+        timer reaps them — engine requests cancelled, blocks freed — so
+        a dead master's requests never leak KV. The epoch fence already
+        ran (handle_post), so a stale master can neither read manifests
+        nor steal the heartbeat target."""
+        try:
+            # Chaos hook: a dropped receive exercises the master's
+            # skip-and-continue takeover path.
+            faults.point(
+                "reconcile.recv",
+                instance=self.name, epoch=body.get("master_epoch", 0),
+            )
+        except faults.FaultInjected as fi:
+            h.send_error_json(503, str(fi))
+            return
+        known = set(body.get("known") or [])
+        try:
+            ttl = float(body.get("orphan_ttl_s") or 10.0)
+        except (TypeError, ValueError):
+            ttl = 10.0
+        new_rpc = str(body.get("master_rpc") or "")
+        if (
+            new_rpc
+            and self._master is not None
+            and self._master._addr != new_rpc
+        ):
+            # Follow the new master: heartbeats, re-registration, and the
+            # generations push all re-point here — the old master's
+            # in-process lease table died with it, so the next beat gets
+            # `reregister` and a fresh lease from the survivor.
+            logger.info(
+                "instance %s re-pointing control plane %s -> %s "
+                "(master takeover)", self.name, self._master._addr, new_rpc,
+            )
+            self._master._addr = new_rpc
+        with self._srid_mu:
+            inflight = list(self._srid_map.keys())
+            manifest = []
+            for srid in inflight:
+                info = self._srid_info.get(srid, {})
+                manifest.append({
+                    "service_request_id": srid,
+                    "request_ids": list(self._srid_map.get(srid) or []),
+                    "owning_epoch": int(info.get("epoch", 0)),
+                    "delivered_tokens": int(info.get("delivered", 0)),
+                    "prompt_tokens": int(info.get("prompt_tokens", 0)),
+                })
+            # Garbage entries (request finished between pops): drop.
+            for srid in list(self._srid_info):
+                if srid not in self._srid_map:
+                    self._srid_info.pop(srid, None)
+        orphans = [s for s in inflight if s not in known]
+        if orphans:
+            t = threading.Timer(
+                ttl, self._reap_orphans, args=(list(orphans),)
+            )
+            t.daemon = True
+            t.start()
+        snap = getattr(self.engine, "cache_snapshot", None)
+        hashes: List[str] = []
+        if callable(snap):
+            try:
+                hashes = [bytes(x).hex() for x in snap()]
+            except Exception:
+                hashes = []
+        h.send_json({
+            "ok": True,
+            "name": self.name,
+            "epoch": self._fence_epoch,
+            "manifest": manifest,
+            "orphans": orphans,
+            "load_metrics": self.engine.get_load_metrics().to_json(),
+            "cache_hashes": hashes,
+        })
+
+    def _reap_orphans(self, srids: List[str]) -> None:
+        """Orphan-TTL expiry: requests no reconciliation claimed are dead
+        weight — cancel their engine work (frees slots + KV blocks) and
+        drop every per-srid table entry. Requests that finished or were
+        re-claimed (srid gone from the map) are skipped."""
+        reaped = 0
+        for srid in srids:
+            with self._srid_mu:
+                rids = self._srid_map.pop(srid, None)
+                self._srid_info.pop(srid, None)
+            if rids is None:
+                continue
+            for rid in rids:
+                try:
+                    self.engine.cancel(rid)
+                except Exception:
+                    pass
+            self._relay_addrs.pop(srid, None)
+            with self._push_acked_mu:
+                self._push_acked.pop(srid, None)
+            reaped += 1
+        if reaped:
+            self._m_orphans.inc(reaped)
+            logger.warning(
+                "instance %s reaped %d orphaned request(s) unclaimed by "
+                "the takeover reconciliation", self.name, reaped,
+            )
+
     def handle_post(self, h: HttpJsonApi) -> None:
         route = h.route
         if route == "/kv/import":  # binary body, not JSON
@@ -568,7 +789,13 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
         if body is None:
             h.send_error_json(400, "invalid JSON body")
             return
-        if route == "/health":
+        # Epoch fence FIRST, on every control RPC: a deposed master's
+        # dispatch/cancel/flip/probe/reconcile must fail identically.
+        if self._fence_reject(h, body):
+            return
+        if route == "/reconcile":
+            self._handle_reconcile(h, body)
+        elif route == "/health":
             # POST twin of the GET probe: the master's breaker probes the
             # dispatch (POST) plane, not just GET reachability.
             h.send_json(
@@ -604,7 +831,9 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
                 h.send_error_json(400, f"bad generations payload: {e}")
                 return
             try:
-                cont = self._master.push_generations(outs)
+                cont = self._master.push_generations(
+                    outs, epoch=self._fence_epoch
+                )
             except Exception as e:
                 h.send_error_json(502, f"master push failed: {e}")
                 return
@@ -633,6 +862,7 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
             srid = body.get("service_request_id", "")
             with self._srid_mu:
                 rids = self._srid_map.pop(srid, None) or []
+                self._srid_forget_locked(srid)
             for rid in rids:
                 self.engine.cancel(rid)
             h.send_json({"ok": True, "cancelled": bool(rids)})

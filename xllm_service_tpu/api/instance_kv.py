@@ -75,10 +75,14 @@ class _KVStreamSession:
     deliver are merely unused cache entries on the peer.
     """
 
-    def __init__(self, owner, srid: str, decode_name: str):
+    def __init__(self, owner, srid: str, decode_name: str, epoch: int = 0):
         self.owner = owner
         self.srid = srid
         self.decode_name = decode_name
+        # Fencing epoch of the master that routed this PD pair: the
+        # session OPEN carries it so the decode peer's fence rejects KV
+        # control traffic descending from a deposed master's dispatch.
+        self.epoch = int(epoch or 0)
         self.session_id = generate_uuid(16)
         self.aborted = False
         self._mu = threading.Lock()
@@ -249,6 +253,11 @@ class _KVStreamSession:
             "service_request_id": self.srid,
             "block_hashes": [b.hex() for b in hashes],
         }
+        if meta["idx"] == 0 and self.epoch:
+            # Epoch fence on the /kv/import control plane: the session
+            # OPEN is the admission decision (reservation), so it is the
+            # message the receiver must be able to reject as stale.
+            header["master_epoch"] = self.epoch
         if self._offer_session is None and self.owner._kv_transfer is not None:
             self._offer_session = self.owner._kv_transfer.open_offer_session()
         return self.owner._post_kv_frame(
@@ -413,15 +422,21 @@ class KVHandoffMixin:
         )
 
     def _open_kv_stream(
-        self, srid: str, decode_name: str
+        self, srid: str, decode_name: str, epoch=None
     ) -> Optional[_KVStreamSession]:
         """Create the pipelined-handoff session for a PD-split request (or
         None when the escape hatch disables streaming). Costless for
         single-chunk prompts: the engine only streams on PARTIAL prefill
-        chunks, so an unused session never opens on the wire."""
+        chunks, so an unused session never opens on the wire. `epoch` is
+        the dispatching master's fencing epoch, carried on the session
+        OPEN so the decode peer can reject deposed-master control traffic."""
         if not _pd_streaming_enabled(self.cfg):
             return None
-        return _KVStreamSession(self, srid, decode_name)
+        try:
+            epoch = int(epoch or 0)
+        except (TypeError, ValueError):
+            epoch = 0
+        return _KVStreamSession(self, srid, decode_name, epoch=epoch)
 
     def _transfer_loop(self, q=None) -> None:
         q = q if q is not None else self._transfer_q
@@ -541,6 +556,11 @@ class KVHandoffMixin:
                     "lora": lora_name,
                     "offline": bool(body.get("offline", False)),
                 }
+                if body.get("master_epoch"):
+                    # Epoch fence rides the handoff control header too:
+                    # the decode peer must reject a commit descending
+                    # from a deposed master's dispatch.
+                    extra["master_epoch"] = body["master_epoch"]
                 if kv_stream is not None and kv_stream.chunks_sent:
                     # Streamed session: the commit trails its own chunks.
                     # Blocks land order-independently at the peer, but a
@@ -595,9 +615,11 @@ class KVHandoffMixin:
                         err = self._post_handoff(addr, handoff, extra)
             if not err:
                 # Handoff complete: this instance is done with the request
-                # (the decode peer owns cancellation from here).
+                # (the decode peer owns cancellation from here — including
+                # its reconcile-manifest entry).
                 with self._srid_mu:
                     self._srid_map.pop(srid, None)
+                    self._srid_forget_locked(srid)
                 # Stall + overlap observability: the stall spans prefill-
                 # done to decode-peer admission; the overlap counters feed
                 # the xllm_kv_stream_overlap_frac gauge. Only blocks the
@@ -629,6 +651,7 @@ class KVHandoffMixin:
                 )
                 with self._srid_mu:
                     self._srid_map.pop(srid, None)
+                    self._srid_forget_locked(srid)
                 self._push_q.put(out)
 
         def send(handoff) -> None:
@@ -802,6 +825,11 @@ class KVHandoffMixin:
             header, body = kv_frame_split(data)
         except Exception as e:
             h.send_error_json(400, f"bad handoff payload: {e}")
+            return
+        # Epoch fence on the /kv/import CONTROL plane: opens and commits
+        # descend from a master's routing decision, so a deposed master's
+        # pair choice must be rejectable here exactly like its dispatch.
+        if self._fence_reject(h, header):
             return
         ss = header.get("kv_stream") or {}
         if ss and ss.get("op") != "commit":
@@ -1005,6 +1033,15 @@ class KVHandoffMixin:
         rid = generate_uuid(16)
         with self._srid_mu:
             self._srid_map.setdefault(srid, []).append(rid)
+        # Fence high-water + reconcile-manifest entry for the adopted
+        # sequence (colocated imports bypass the HTTP fence; the epoch
+        # still raises the local high-water). The first token was already
+        # delivered by the prefill side: classify as an open decode slot.
+        self._fence_epoch_check(header.get("master_epoch"))
+        self._srid_track(
+            srid, max(len(handoff.token_ids) - 1, 0),
+            header.get("master_epoch"), delivered=1,
+        )
         relay_addr = header.get("respond_addr", "")
         if relay_addr:
             self._relay_addrs[srid] = relay_addr

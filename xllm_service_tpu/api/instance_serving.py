@@ -35,9 +35,13 @@ class ServingMixin:
         def callback(out: RequestOutput) -> bool:
             out.service_request_id = srid
             self._detokenize(out, detoks)
+            self._srid_note_delivered(
+                srid, sum(len(s.token_ids) for s in out.outputs)
+            )
             if out.finished:
                 with self._srid_mu:
                     self._srid_map.pop(srid, None)
+                    self._srid_forget_locked(srid)
                 # A prefill_only request that finishes on its first token
                 # (EOS / max_tokens=1 / reject / cancel) never runs its
                 # handoff — reap the ack event here or it leaks forever.
@@ -95,6 +99,9 @@ class ServingMixin:
                     if out.finished:
                         state["remaining"] -= 1
                         last = state["remaining"] == 0
+                self._srid_note_delivered(
+                    srid, sum(len(s.token_ids) for s in out.outputs)
+                )
                 if not out.status.ok() and not out.cancelled:
                     # Child error (reject/engine failure): surface it ONCE,
                     # cancel the siblings, drop the request.
@@ -102,6 +109,7 @@ class ServingMixin:
                         state["aborted"] = True
                     with self._srid_mu:
                         others = self._srid_map.pop(srid, None) or []
+                        self._srid_forget_locked(srid)
                     for other in others:
                         self.engine.cancel(other)
                     out.finished = True
@@ -130,6 +138,7 @@ class ServingMixin:
                     )
                     with self._srid_mu:
                         self._srid_map.pop(srid, None)
+                        self._srid_forget_locked(srid)
                 self._push_q.put(out)
                 return True
 
@@ -198,6 +207,7 @@ class ServingMixin:
         self._detokenize(final, detoks)
         with self._srid_mu:
             self._srid_map.pop(srid, None)
+            self._srid_forget_locked(srid)
         self._push_q.put(final)
 
     def _prompt_tokens(self, body: Dict[str, Any], chat: bool) -> List[int]:
@@ -433,6 +443,12 @@ class ServingMixin:
         offline = bool(body.get("offline", False))
 
         if srid and self._master is not None and (n > 1 or best_of > 1):
+            # Reconcile-manifest entry (docs/FAULT_TOLERANCE.md) — after
+            # every validation reject, so a refused request can't leak a
+            # tracking entry that only a takeover scan would collect.
+            self._srid_track(
+                srid, len(token_ids), body.get("master_epoch")
+            )
             # Fan-out mode: PD split is skipped for multi-sequence requests
             # (a per-child handoff would need sub-request ids on the wire);
             # this instance serves all sequences and pushes indexed deltas.
@@ -489,6 +505,11 @@ class ServingMixin:
                     return
             with self._srid_mu:
                 self._srid_map.setdefault(srid, []).append(rid)
+            # Manifest entry rides the same admission (after the mm/
+            # resume rejects above — see the fan-out branch's comment).
+            self._srid_track(
+                srid, len(token_ids), body.get("master_epoch")
+            )
             detoks: Dict[int, IncrementalDetokenizer] = {}
             callback = self._make_push_callback(srid, detoks)
             routing = body.get("routing") or {}
@@ -518,7 +539,9 @@ class ServingMixin:
                 # can leave before prefill-done.
                 with self._push_acked_mu:
                     self._push_acked[srid] = threading.Event()
-                kv_stream = self._open_kv_stream(srid, decode_name)
+                kv_stream = self._open_kv_stream(
+                    srid, decode_name, epoch=body.get("master_epoch"),
+                )
                 self.engine.add_request(
                     EngineRequest(
                         request_id=rid,

@@ -66,6 +66,7 @@ from xllm_service_tpu.service import (
     ServiceRequest,
     make_service_request_id,
 )
+from xllm_service_tpu.service.scheduler import NotMasterError
 from xllm_service_tpu.tokenizer import parse_messages
 
 logger = logging.getLogger(__name__)
@@ -168,15 +169,20 @@ class Master:
         tokenizer=None,
     ):
         self.config = config
-        self.scheduler = Scheduler(config, store=store, tokenizer=tokenizer)
-        self._store = self.scheduler._store
         # instance name -> lease id held on its registration key
         self._leases: Dict[str, int] = {}
         self._leases_mu = threading.Lock()
         self._request_timeout_s = 600.0
+        self._killed = False
 
         # Both control-plane servers ride the configured backend ("event"
         # = evserve selectors loop, "threaded" = stdlib thread-per-conn).
+        # They bind BEFORE the scheduler exists so the election identity
+        # is this replica's REAL client-plane address (ephemeral :0 ports
+        # resolve at bind) — the master key in the store then doubles as
+        # the redirect target a standby's front door hands to clients.
+        # Handlers only dereference self.scheduler at request time, after
+        # start().
         server_opts = dict(
             workers=config.http_workers,
             max_connections=config.http_max_connections,
@@ -195,6 +201,12 @@ class Master:
             do_get=self.handle_rpc_get, do_post=self.handle_rpc_post,
             name="master-rpc", **server_opts,
         )
+        self.scheduler = Scheduler(
+            config, store=store, tokenizer=tokenizer,
+            identity=f"{self.http.host}:{self.http.port}",
+        )
+        self._store = self.scheduler._store
+        self.scheduler.advertised_rpc = self.rpc_address
 
         # Cluster-level registry: fleet shape + fault accounting the
         # aggregated /metrics adds on top of the scheduler's own series.
@@ -240,8 +252,15 @@ class Master:
             # partition that kills dispatch also fails the probe instead
             # of falsely healing the instance. Identity is cross-checked —
             # a recycled port must not heal a dead instance's breaker.
+            # The probe carries the fencing epoch like every other
+            # master->instance RPC: a deposed master's probe gets a 412
+            # and must not keep healing breakers it no longer owns.
+            body: Dict[str, Any] = {}
+            ep = self.scheduler.master_epoch
+            if ep:
+                body["master_epoch"] = ep
             code, resp = post_json(
-                meta.http_address, "/health", {}, timeout=2.0
+                meta.http_address, "/health", body, timeout=2.0
             )
             return (
                 code == 200
@@ -251,6 +270,23 @@ class Master:
             )
 
         mgr.health_prober = health_probe
+
+        def reconcile_transport(meta, body: Dict[str, Any]) -> Dict[str, Any]:
+            # Takeover reconciliation RPC (docs/FAULT_TOLERANCE.md): the
+            # scheduler builds the claim set; this adds the rpc-plane
+            # address instances should re-point heartbeats/pushes to, and
+            # carries it over the wire. Idempotent — a retried reconcile
+            # returns the same manifest.
+            body = dict(body, master_rpc=self.rpc_address)
+            code, resp = post_json_retrying(
+                meta.http_address, "/reconcile", body, timeout=5.0,
+                attempts=2, budget=self._retry_budget, idempotent=True,
+            )
+            if code != 200:
+                raise RuntimeError(f"reconcile HTTP {code}: {resp}")
+            return resp
+
+        self.scheduler.on_reconcile = reconcile_transport
         self._m_scrape_failures = self.cluster_metrics.counter(
             "xllm_cluster_scrape_failures_total",
             "Instance /metrics scrapes that failed during aggregation",
@@ -274,9 +310,12 @@ class Master:
                 return  # deregistered since the flip: nothing to notify
             role = meta.current_type.name
             err = ""
+            flip_body: Dict[str, Any] = {"role": role}
+            if self.scheduler.master_epoch:
+                flip_body["master_epoch"] = self.scheduler.master_epoch
             try:
                 code, resp = post_json(
-                    meta.http_address, "/flip", {"role": role}, timeout=5.0
+                    meta.http_address, "/flip", flip_body, timeout=5.0
                 )
                 if code != 200:
                     err = f"HTTP {code}: {resp}"
@@ -299,14 +338,38 @@ class Master:
     def start(self) -> None:
         self.http.start()
         self.rpc.start()
+        # The initial election may have completed inside the scheduler's
+        # constructor, before advertised_rpc was installed — publish now.
+        self.scheduler.advertise_master_rpc()
         logger.info(
             "master serving http=:%d rpc=:%d", self.http.port, self.rpc.port
         )
 
     def stop(self) -> None:
-        self.http.stop()
-        self.rpc.stop()
-        self.scheduler.stop()
+        if not self._killed:
+            self.http.stop()
+            self.rpc.stop()
+        self.scheduler.stop(drain_timeout_s=0.0 if self._killed else 10.0)
+        self._scrape_pool.shutdown(wait=False)
+
+    def kill(self) -> None:
+        """UNGRACEFUL master death for chaos tests/benches: both HTTP
+        planes drop (in-flight exchanges included), the election
+        keepalive stops WITHOUT revoking the lease — the master key
+        lingers until TTL expiry, exactly like a crashed master process —
+        and the scheduler's loops halt. Standbys take over only once the
+        store's liveness mechanism fires; a later stop() still runs the
+        remaining teardown."""
+        self._killed = True
+        self.scheduler._stop.set()
+        self.scheduler._dispatch_gate.clear()
+        self.scheduler._election.kill()
+        for srv in (self.http, self.rpc):
+            try:
+                # ZERO drain: a crash does not finish in-flight streams.
+                srv.stop(drain_s=0.0)
+            except TypeError:  # threaded backend has no drain knob
+                srv.stop()
         self._scrape_pool.shutdown(wait=False)
 
     @property
@@ -463,6 +526,44 @@ class Master:
                 self._m_scrape_failures.inc()
         return render_families(fams)
 
+    def _redirect_if_standby(
+        self, h: HttpJsonApi, xh: Optional[Dict[str, str]] = None
+    ) -> bool:
+        """Fenced front door (docs/FAULT_TOLERANCE.md): a replica that
+        does not hold the master lease never accepts generation work — it
+        307-redirects to the current master (Location + a JSON body
+        naming it) or 503s when no master exists yet. A RECONCILING
+        master still holds the lease and accepts (the dispatch gate parks
+        the work until the takeover scan completes). Returns True when
+        the exchange was handled here."""
+        sched = self.scheduler
+        if sched.is_master:
+            return False
+        cur = sched.current_master_identity()
+        if cur and cur != sched.election_identity:
+            h.send_json(
+                {
+                    "error": {
+                        "message": (
+                            "this replica is not the master; retry "
+                            f"against {cur}"
+                        ),
+                        "type": "not_master",
+                    },
+                    "master": cur,
+                },
+                status=307,
+                extra_headers={
+                    **(xh or {}), "Location": f"http://{cur}{h.path}",
+                },
+            )
+        else:
+            h.send_error_json(
+                503, "no master elected yet; retry shortly",
+                etype="not_master", extra_headers=xh,
+            )
+        return True
+
     def handle_client_post(self, h: HttpJsonApi) -> None:
         route = h.route
         if route == "/v1/completions":
@@ -479,6 +580,8 @@ class Master:
             h.send_error_json(404, f"no route {route}")
 
     def _serve_embeddings(self, h: HttpJsonApi) -> None:
+        if self._redirect_if_standby(h):
+            return
         body = h.read_json()
         if body is None:
             h.send_error_json(400, "invalid JSON body")
@@ -584,6 +687,8 @@ class Master:
     def _serve_generation(self, h: HttpJsonApi, chat: bool) -> None:
         xrid = h.x_request_id()
         xh = {"x-request-id": xrid} if xrid else None
+        if self._redirect_if_standby(h, xh):
+            return
         body = h.read_json()
         if body is None:
             h.send_error_json(400, "invalid JSON body", extra_headers=xh)
@@ -652,6 +757,7 @@ class Master:
                 )
                 return
             wire = req.wire_srid or req.service_request_id
+            epoch = self.scheduler.master_epoch
             if req.media_parts:
                 # EPD stage E: the encoder computes media embeddings and
                 # pushes them to the prefill peer's /mm/import BEFORE the
@@ -674,6 +780,7 @@ class Master:
                             "parts": req.media_parts,
                             "positions": req.mm_positions,
                             "target": meta.http_address,
+                            "master_epoch": epoch,
                         },
                         # Generous: the encoder's FIRST request pays its
                         # XLA compile inside this call.
@@ -705,6 +812,7 @@ class Master:
                 decode_response_to_service=(
                     self.config.enable_decode_response_to_service
                 ),
+                master_epoch=epoch,
             )
             if req.resume_base:
                 # Token-replay resume: the last resume_base token_ids are
@@ -738,10 +846,27 @@ class Master:
                     # (e.g. invalid logit_bias) — relay it as such
                     # instead of masking it as a service failure.
                     msg = resp
+                    fenced = isinstance(resp, dict) and resp.get("fenced")
                     if isinstance(resp, dict):
                         msg = (resp.get("error") or {}).get(
                             "message", resp
                         )
+                    if fenced:
+                        # 412 stale-epoch: the FLEET is telling this
+                        # replica it was deposed — not a client error,
+                        # not an instance failure. The client retries
+                        # against the current master.
+                        self.scheduler.fail_request(
+                            req.service_request_id,
+                            StatusCode.UNAVAILABLE,
+                            "dispatch fenced (this master was deposed); "
+                            "retry against "
+                            + (
+                                self.scheduler.current_master_identity()
+                                or "the current master"
+                            ),
+                        )
+                        return
                     self.scheduler.fail_request(
                         req.service_request_id,
                         StatusCode.INVALID_ARGUMENT
@@ -782,7 +907,15 @@ class Master:
         if self.scheduler.should_defer_offline(req):
             self.scheduler.park_offline(req, dispatch)
         else:
-            dispatch()
+            try:
+                dispatch()
+            except NotMasterError as e:
+                # Demoted between the redirect check and the forward (or
+                # the reconcile park timed out): error the exchange toward
+                # the current master instead of leaving it to the deadline.
+                self.scheduler.fail_request(
+                    req.service_request_id, StatusCode.UNAVAILABLE, str(e)
+                )
 
         # Hold the exchange open until the scheduler finishes it. The
         # threaded backend blocks this handler thread; the event backend
@@ -813,6 +946,7 @@ class Master:
                         "service_request_id": (
                             req.wire_srid or req.service_request_id
                         ),
+                        "master_epoch": self.scheduler.master_epoch,
                     },
                     timeout=5.0,
                     attempts=self._retry_attempts,
@@ -912,6 +1046,18 @@ class Master:
 
     def _handle_heartbeat(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
         name = body.get("name", "")
+        if not self.scheduler.is_master:
+            # Deposed (or never-elected) replica: do NOT keepalive the
+            # instance's lease — this replica doesn't own the fleet — and
+            # hand back the ACTIVE master's advertised rpc address so the
+            # instance re-points even if a /reconcile never reached it.
+            h.send_json(
+                {
+                    "ok": False,
+                    "master_rpc": self.scheduler.current_master_rpc(),
+                }
+            )
+            return
         with self._leases_mu:
             lease = self._leases.get(name)
         alive = lease is not None and self._store.keepalive(lease)
@@ -947,6 +1093,28 @@ class Master:
         h.send_json({"ok": True})
 
     def _handle_generations(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
+        try:
+            pushed_epoch = int(body.get("master_epoch") or 0)
+        except (TypeError, ValueError):
+            pushed_epoch = 0
+        if not self.scheduler.is_master or (
+            pushed_epoch and pushed_epoch > self.scheduler.master_epoch
+        ):
+            # A deposed master must not answer the token stream: its
+            # `cont` map would authoritatively cancel work the CURRENT
+            # master dispatched. That covers both the replica that KNOWS
+            # it was demoted and the split-brain window where the fleet's
+            # fence epoch (stamped on the push) has already moved past
+            # this replica's term but its keepalive hasn't failed yet.
+            # 503 makes the instance's push loop retry; by the next
+            # attempt its heartbeat has re-pointed.
+            h.send_error_json(
+                503,
+                "not the master; retry against "
+                + (self.scheduler.current_master_rpc() or "current master"),
+                etype="not_master",
+            )
+            return
         cont: Dict[str, bool] = {}
         for j in body.get("gens", []):
             try:

@@ -19,6 +19,23 @@ service methods):
   GET  /rpc/instance_info?name=                            -> meta
   GET  /rpc/static_prefill_list                            -> {instances: [...]}
   GET  /rpc/static_decode_list                             -> {instances: [...]}
+
+Fenced failover additions (docs/FAULT_TOLERANCE.md, control plane):
+every master->instance RPC body carries `master_epoch` (the fencing
+epoch committed by the election transaction); instances persist the
+highest seen and reject lower with HTTP 412 + {"fenced": true}. A
+freshly elected master calls the instance-side
+
+  POST /reconcile  {master_epoch, master, master_rpc, known: [wire srids],
+                    orphan_ttl_s}
+    -> {ok, name, epoch, manifest: [{service_request_id, request_ids,
+        owning_epoch, delivered_tokens, prompt_tokens}], orphans,
+        load_metrics, cache_hashes}
+
+to rebuild its load/inflight/cache view; in-flight srids not in `known`
+are reaped instance-side after orphan_ttl_s (engine work cancelled,
+blocks freed), and the instance re-points heartbeats/pushes at
+`master_rpc`.
 """
 
 from __future__ import annotations
@@ -249,18 +266,24 @@ def augment_forwarded_request(
     token_ids: List[int],
     routing,
     decode_response_to_service: bool = True,
+    master_epoch: int = 0,
 ) -> Dict[str, Any]:
     """Inject the service-side fields so the engine skips re-tokenization
     and knows its PD pair. `decode_response_to_service=False` selects the
     alternate PD response topology (reference: service.h:61-71 env switch):
     the decode peer streams tokens back THROUGH the prefill instance
-    instead of pushing to the master directly."""
+    instead of pushing to the master directly. `master_epoch` is the
+    dispatching master's fencing epoch (docs/FAULT_TOLERANCE.md): the
+    instance persists the highest seen and 412-rejects anything lower, so
+    a deposed master cannot double-dispatch into the fleet."""
     fwd = dict(body)
     fwd["service_request_id"] = service_request_id
     fwd["token_ids"] = list(token_ids)
     fwd["routing"] = routing.to_json()
     if not decode_response_to_service:
         fwd["routing"]["decode_response_to_service"] = False
+    if master_epoch:
+        fwd["master_epoch"] = int(master_epoch)
     return fwd
 
 

@@ -155,6 +155,33 @@ class GlobalKVCacheMgr:
                 else:
                     self._dirty.add(h)
 
+    def absorb_instance_snapshot(
+        self, instance: str, hashes: Sequence[bytes]
+    ) -> None:
+        """Takeover reconciliation: fold one instance's full committed-
+        block snapshot (from its /reconcile manifest) into the index. The
+        snapshot is authoritative for the HBM tier — blocks the index
+        attributes to this instance that the instance no longer holds are
+        dropped, so a standby's stale watch-synced view cannot survive
+        the takeover (docs/FAULT_TOLERANCE.md, control plane)."""
+        want = set(hashes)
+        with self._mu:
+            for h in list(self._index):
+                loc = self._index[h]
+                if h in want or instance not in loc.hbm_instance_set:
+                    continue
+                loc.hbm_instance_set.discard(instance)
+                if loc.empty():
+                    del self._index[h]
+                    self._deleted.add(h)
+                    self._dirty.discard(h)
+                else:
+                    self._dirty.add(h)
+        if want:
+            self.record_updated_kvcaches(
+                instance, KvCacheEvent(stored_cache=want)
+            )
+
     def remove_instance(self, instance: str) -> None:
         """Drop a departed instance from every location set."""
         with self._mu:

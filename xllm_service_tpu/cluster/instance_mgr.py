@@ -700,6 +700,47 @@ class InstanceMgr:
             self._store.set(LOADMETRICS_PREFIX + name, json.dumps(j))
         return len(dirty)
 
+    def absorb_reconcile(
+        self,
+        name: str,
+        load: Optional[LoadMetrics],
+        manifest: List[Dict],
+    ) -> None:
+        """Takeover reconciliation (docs/FAULT_TOLERANCE.md): a freshly
+        elected master rebuilds this instance's request charges from its
+        /reconcile manifest instead of starting blind. Each in-flight
+        entry re-creates the charge its original SCHEDULE/FINISH_PREFILL
+        bookkeeping would have left: zero delivered tokens => queued
+        prefill work, delivered tokens => an open decode slot. The
+        heartbeat stamp refreshes too — the manifest IS a proof of life,
+        and the first post-takeover prune must not evict a healthy
+        instance whose beats went to the dead master."""
+        with self._mu:
+            if name not in self._instances:
+                return
+            if load is not None:
+                self._load_metrics[name] = load
+            self._heartbeat_ts[name] = time.monotonic()
+            rm = RequestMetrics()
+            pred = self._predictors.get(name)
+            for ent in manifest:
+                try:
+                    delivered = int(ent.get("delivered_tokens", 0))
+                    prompt_toks = int(ent.get("prompt_tokens", 0))
+                except (TypeError, ValueError):
+                    continue
+                if delivered > 0:
+                    rm.decode_request_num += 1
+                else:
+                    rm.prefill_request_num += 1
+                    rm.prefill_token_num += prompt_toks
+                    if pred is not None and pred.has_ttft_model:
+                        rm.estimated_prefill_time += pred.predict_ttft(
+                            prompt_toks
+                        )
+            self._request_metrics[name] = rm
+            self._beat_observed(name)
+
     def prune_disconnected(self) -> List[str]:
         """Drop instances whose heartbeats stopped, master-side backstop to
         store-lease liveness. The reference declares this interval flag but

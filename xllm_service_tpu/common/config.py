@@ -92,6 +92,11 @@ class ServiceConfig:
     # (pre-first-token redispatch and token-replay resume share the
     # bound).
     max_redispatch: int = 2
+    # Fenced master failover: instance-side TTL for in-flight manifests a
+    # takeover reconciliation did NOT reclaim — past it the instance
+    # reaps them (engine requests cancelled, blocks freed) so a dead
+    # master's requests can never leak KV (docs/FAULT_TOLERANCE.md).
+    reconcile_orphan_ttl_s: float = 10.0
 
     # Tokenizer / template (reference: --tokenizer_path).
     tokenizer_path: str = ""

@@ -1,6 +1,11 @@
 """Coordination: store backends (memory / etcd gateway) and master election."""
 
-from xllm_service_tpu.coordination.election import MASTER_KEY, MasterElection
+from xllm_service_tpu.coordination.election import (
+    MASTER_EPOCH_KEY,
+    MASTER_KEY,
+    MASTER_RPC_KEY,
+    MasterElection,
+)
 from xllm_service_tpu.coordination.store import (
     CoordinationStore,
     EtcdGatewayStore,
@@ -12,7 +17,9 @@ from xllm_service_tpu.coordination.store import (
 )
 
 __all__ = [
+    "MASTER_EPOCH_KEY",
     "MASTER_KEY",
+    "MASTER_RPC_KEY",
     "MasterElection",
     "CoordinationStore",
     "EtcdGatewayStore",

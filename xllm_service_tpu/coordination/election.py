@@ -14,6 +14,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, List, Optional
 
+from xllm_service_tpu.common import faults
 from xllm_service_tpu.coordination.store import (
     CoordinationStore,
     EventType,
@@ -21,6 +22,14 @@ from xllm_service_tpu.coordination.store import (
 )
 
 MASTER_KEY = "XLLM:SERVICE:MASTER"
+# Monotonic fencing epoch, bumped in the SAME store transaction that wins
+# the master key (compare_create_with_epoch). Unleased: the fence must
+# outlive every master so a successor always commits a higher value.
+MASTER_EPOCH_KEY = MASTER_KEY + ":EPOCH"
+# The active master's instance-plane (rpc) address, written under its
+# election lease: deposed masters hand it to heartbeating instances so
+# the fleet re-points even when a /reconcile never reached them.
+MASTER_RPC_KEY = MASTER_KEY + ":RPC"
 
 
 class MasterElection:
@@ -32,6 +41,7 @@ class MasterElection:
         on_elected: Optional[Callable[[], None]] = None,
         on_lost: Optional[Callable[[], None]] = None,
         master_key: str = MASTER_KEY,
+        epoch_key: str = "",
     ) -> None:
         self._store = store
         self._identity = identity
@@ -39,9 +49,11 @@ class MasterElection:
         self._on_elected = on_elected
         self._on_lost = on_lost
         self._key = master_key
+        self._epoch_key = epoch_key or master_key + ":EPOCH"
         self._mu = threading.Lock()
         self._is_master = False
         self._lease_id = 0
+        self._epoch = 0  # epoch of OUR last won term (sticky after demote)
         self._stop = threading.Event()
         self._keepalive_thread: Optional[threading.Thread] = None
         self._watch_id: Optional[int] = None
@@ -51,6 +63,15 @@ class MasterElection:
     def is_master(self) -> bool:
         with self._mu:
             return self._is_master
+
+    @property
+    def epoch(self) -> int:
+        """Fencing epoch of this replica's most recent won term (0 =
+        never elected). Deliberately sticky across demotion: a deposed
+        master keeps stamping its OLD epoch on any straggler RPC, which
+        is exactly what lets instances reject it."""
+        with self._mu:
+            return self._epoch
 
     @property
     def identity(self) -> str:
@@ -68,6 +89,21 @@ class MasterElection:
             # between our failed campaign and the watch registration.
             if self._store.get(self._key) is None:
                 self._campaign()
+
+    def kill(self) -> None:
+        """UNGRACEFUL death for fault injection: keepalives and watches
+        stop but the lease is NOT revoked — the master key lingers until
+        TTL expiry, exactly like a crashed master process. Standbys take
+        over only once the store's liveness mechanism notices."""
+        self._stop.set()
+        if self._watch_id is not None:
+            self._store.remove_watch(self._watch_id)
+            self._watch_id = None
+        with self._mu:
+            self._is_master = False
+        if self._keepalive_thread is not None:
+            self._keepalive_thread.join(timeout=2.0)
+            self._keepalive_thread = None
 
     def stop(self) -> None:
         self._stop.set()
@@ -88,15 +124,35 @@ class MasterElection:
 
     # -- internals ---------------------------------------------------------
     def _campaign(self) -> bool:
+        # Join the PREVIOUS term's keepalive thread before starting a new
+        # one: a demote->re-elect cycle used to overwrite the handle while
+        # the old loop could still be mid-iteration, leaking a live
+        # keepalive thread per cycle (and letting a stale loop touch the
+        # new term's lease bookkeeping). The old loop exits on its own —
+        # _is_master is already False — so the join is bounded.
+        prev = self._keepalive_thread
+        if prev is not None and prev is not threading.current_thread():
+            prev.join(timeout=2.0)
+            self._keepalive_thread = None
         lease = self._store.grant_lease(self._ttl)
-        if self._store.compare_create(self._key, self._identity, lease):
+        epoch = self._store.compare_create_with_epoch(
+            self._key, self._identity, self._epoch_key, lease
+        )
+        if epoch:
             with self._mu:
                 self._is_master = True
                 self._lease_id = lease
-            self._keepalive_thread = threading.Thread(
+                self._epoch = epoch
+            t = threading.Thread(
                 target=self._keepalive_loop, name="master-keepalive", daemon=True
             )
-            self._keepalive_thread.start()
+            # start() BEFORE publishing the handle: a concurrent stop()
+            # must never observe (and join) a created-but-unstarted
+            # thread. If stop() lands inside this window it joins the
+            # previous handle (or None); the fresh loop exits on its own
+            # at the first _stop check.
+            t.start()
+            self._keepalive_thread = t
             if self._on_elected:
                 self._on_elected()
             return True
@@ -112,6 +168,13 @@ class MasterElection:
                 return
             ok = False
             try:
+                # Chaos hook: a dropped keepalive simulates the master's
+                # store link partitioning — the lease lapses, a standby
+                # takes over, and THIS replica must demote + fence.
+                faults.point(
+                    "election.keepalive",
+                    identity=self._identity, lease=lease,
+                )
                 ok = self._store.keepalive(lease)
             except Exception:
                 ok = False

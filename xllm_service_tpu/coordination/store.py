@@ -28,11 +28,41 @@ from __future__ import annotations
 import base64
 import enum
 import json
+import random
 import threading
 import time
 import urllib.request
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from xllm_service_tpu.common import faults
+
+# Watch-stream reconnects across every EtcdGatewayStore in the process
+# (exported as xllm_coord_watch_reconnects_total by the scheduler's
+# registry — the store itself has no registry to avoid an obs dependency
+# in the coordination layer).
+_watch_reconnects_mu = threading.Lock()
+_watch_reconnects = 0
+
+
+def watch_reconnects_total() -> int:
+    with _watch_reconnects_mu:
+        return _watch_reconnects
+
+
+def _count_watch_reconnect() -> None:
+    global _watch_reconnects
+    with _watch_reconnects_mu:
+        _watch_reconnects += 1
+
+
+def _watch_backoff_s(attempt: int, base_s: float = 0.1, max_s: float = 5.0) -> float:
+    """Jittered exponential backoff for watch-stream reconnects: a blind
+    fixed sleep (the old 1.0 s) synchronizes every watcher in the fleet
+    into reconnect waves against a recovering etcd; jitter + growth spread
+    them out. `attempt` counts consecutive failures since the last healthy
+    stream (0-based)."""
+    return min(base_s * (2 ** min(attempt, 16)), max_s) * random.uniform(0.5, 1.5)
 
 
 class EventType(enum.Enum):
@@ -101,6 +131,29 @@ class CoordinationStore:
         """Delete `keys` iff guard_key still holds guard_value
         (reference: etcd_client.cpp:90-99 re-checks mastership)."""
         raise NotImplementedError
+
+    def compare_create_with_epoch(
+        self, key: str, value: str, epoch_key: str, lease_id: int = 0
+    ) -> int:
+        """Election txn WITH fencing: atomically create `key` iff absent
+        AND bump the monotonically increasing counter at `epoch_key`
+        (unleased — it must outlive every master) in the SAME transaction.
+        Returns the new epoch (>= 1) when this caller won, 0 otherwise.
+
+        The epoch is the split-brain fence: every master->instance RPC
+        carries it, instances persist the highest seen and reject lower —
+        a deposed-but-unaware master's dispatches are structurally
+        rejected (docs/FAULT_TOLERANCE.md, control plane).
+
+        Default implementation composes compare_create + set (atomic
+        enough for single-writer backends); MemoryStore and
+        EtcdGatewayStore override with genuinely transactional versions.
+        """
+        if not self.compare_create(key, value, lease_id):
+            return 0
+        epoch = int(self.get(epoch_key) or 0) + 1
+        self.set(epoch_key, str(epoch))
+        return epoch
 
     def close(self) -> None:
         pass
@@ -186,6 +239,13 @@ class MemoryStore(CoordinationStore):
                 sub = [e for e in batch if e.key.startswith(prefix)]
                 if sub:
                     try:
+                        # Chaos hook: a dropped delivery simulates a lost
+                        # etcd watch response (one watcher misses one
+                        # batch; liveness then rests on prefix re-scans /
+                        # lease expiry, exactly as with a real etcd blip).
+                        faults.point(
+                            "store.watch", prefix=prefix, key=sub[0].key
+                        )
                         cb(sub)
                     except Exception:  # watch callbacks must not kill the loop
                         pass
@@ -298,6 +358,25 @@ class MemoryStore(CoordinationStore):
             self._attach(key, lease_id)
             self._emit([WatchEvent(EventType.PUT, key, value)])
             return True
+
+    def compare_create_with_epoch(
+        self, key: str, value: str, epoch_key: str, lease_id: int = 0
+    ) -> int:
+        with self._mu:
+            if key in self._kv:
+                return 0
+            if lease_id and lease_id not in self._leases:
+                return 0
+            epoch = int(self._kv.get(epoch_key, "0")) + 1
+            self._kv[key] = value
+            self._attach(key, lease_id)
+            self._kv[epoch_key] = str(epoch)
+            self._attach(epoch_key, 0)  # the fence outlives the lease
+            self._emit([
+                WatchEvent(EventType.PUT, key, value),
+                WatchEvent(EventType.PUT, epoch_key, str(epoch)),
+            ])
+            return epoch
 
     def guarded_remove(self, keys: List[str], guard_key: str, guard_value: str) -> bool:
         with self._mu:
@@ -448,6 +527,53 @@ class EtcdGatewayStore(CoordinationStore):
         )
         return bool(r.get("succeeded", False))
 
+    def compare_create_with_epoch(
+        self, key: str, value: str, epoch_key: str, lease_id: int = 0
+    ) -> int:
+        """One etcd txn: [master absent AND epoch unchanged since read]
+        -> [put master (leased), put epoch+1 (unleased)]. The epoch
+        compare closes the read->txn window: two candidates racing the
+        same vacancy both read epoch N, but only the txn winner commits
+        N+1 — the loser's compare fails and it re-reads."""
+        put_master: Dict[str, Any] = {"key": _b64(key), "value": _b64(value)}
+        if lease_id:
+            put_master["lease"] = str(lease_id)
+        for _ in range(8):
+            cur = self.get(epoch_key)
+            nxt = int(cur or 0) + 1
+            compare: List[Dict[str, Any]] = [
+                {"key": _b64(key), "target": "CREATE", "create_revision": "0"}
+            ]
+            if cur is None:
+                compare.append(
+                    {"key": _b64(epoch_key), "target": "CREATE",
+                     "create_revision": "0"}
+                )
+            else:
+                compare.append(
+                    {"key": _b64(epoch_key), "target": "VALUE",
+                     "value": _b64(cur)}
+                )
+            r = self._post(
+                "/v3/kv/txn",
+                {
+                    "compare": compare,
+                    "success": [
+                        {"request_put": put_master},
+                        {"request_put": {
+                            "key": _b64(epoch_key), "value": _b64(str(nxt))
+                        }},
+                    ],
+                },
+            )
+            if r.get("succeeded", False):
+                return nxt
+            if self.get(key) is not None:
+                return 0  # someone else holds the master key: lost
+            # epoch moved under us (a master won and died inside the
+            # window) — re-read and retry the txn
+        return 0
+
     def guarded_remove(self, keys: List[str], guard_key: str, guard_value: str) -> bool:
         r = self._post(
             "/v3/kv/txn",
@@ -474,6 +600,7 @@ class EtcdGatewayStore(CoordinationStore):
                     }
                 }
             ).encode()
+            failures = 0
             while not stop.is_set():
                 try:
                     req = urllib.request.Request(
@@ -485,6 +612,9 @@ class EtcdGatewayStore(CoordinationStore):
                         for line in resp:
                             if stop.is_set():
                                 return
+                            # A delivered response proves the stream is
+                            # healthy again: reset the backoff ladder.
+                            failures = 0
                             msg = json.loads(line.decode())
                             events = []
                             for ev in msg.get("result", {}).get("events", []):
@@ -505,7 +635,13 @@ class EtcdGatewayStore(CoordinationStore):
                                 callback(events)
                 except Exception:
                     if not stop.is_set():
-                        time.sleep(1.0)  # reconnect backoff
+                        # Jittered exponential reconnect (counted): the
+                        # old blind 1.0 s sleep marched every watcher in
+                        # the fleet into synchronized reconnect storms
+                        # against a recovering etcd.
+                        _count_watch_reconnect()
+                        time.sleep(_watch_backoff_s(failures))
+                        failures += 1
 
         t = threading.Thread(target=reader, name=f"etcd-watch-{prefix}", daemon=True)
         t.start()

@@ -579,6 +579,23 @@ class InferenceEngine:
     def take_cache_event(self) -> KvCacheEvent:
         return self.block_mgr.take_cache_event()
 
+    def cache_snapshot(self) -> list:
+        """Every committed prefix-cache block hash, for the takeover
+        reconciliation manifest (POST /reconcile). Racy read by design:
+        the engine thread owns the block manager, and a hash that commits
+        or evicts mid-snapshot merely drifts the new master's index by
+        one heartbeat — the retry only guards the rare resize-during-
+        iteration RuntimeError."""
+        table = getattr(self.block_mgr, "_hash_to_block", None)
+        if table is None:
+            return []
+        for _ in range(3):
+            try:
+                return list(table)
+            except RuntimeError:
+                continue
+        return []
+
     def profiling_data(self):
         return list(self._profile_ttft), list(self._profile_tpot)
 

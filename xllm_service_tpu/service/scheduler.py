@@ -47,7 +47,12 @@ from xllm_service_tpu.common.types import (
     StatusCode,
     Usage,
 )
-from xllm_service_tpu.coordination.election import MasterElection
+from xllm_service_tpu.common import faults
+from xllm_service_tpu.coordination import store as coord_store
+from xllm_service_tpu.coordination.election import (
+    MASTER_RPC_KEY,
+    MasterElection,
+)
 from xllm_service_tpu.coordination.store import CoordinationStore, connect
 from xllm_service_tpu.obs import LATENCY_BUCKETS_MS, MetricsRegistry
 from xllm_service_tpu.service.ordered_streams import OrderedStreams
@@ -67,6 +72,29 @@ logger = logging.getLogger(__name__)
 
 # Park offline work when every prefill candidate has this many waiters.
 OFFLINE_PRESSURE_WAITING = 4
+
+# Control-plane mastership states (docs/FAULT_TOLERANCE.md):
+#   STANDBY     — not holding the lease; the front door redirects to the
+#                 current master and this replica never dispatches;
+#   RECONCILING — lease just won; new work is PARKED (not 500'd) while
+#                 the takeover scan rebuilds per-instance load, inflight
+#                 charges, and the KV index from instance /reconcile
+#                 manifests;
+#   ACTIVE      — reconciled; dispatch flows.
+MASTER_STANDBY = "standby"
+MASTER_RECONCILING = "reconciling"
+MASTER_ACTIVE = "active"
+
+# How long a dispatch parks behind an in-flight reconcile before giving
+# up (reconciles are one bounded RPC per instance — seconds, not minutes).
+RECONCILE_PARK_TIMEOUT_S = 15.0
+
+
+class NotMasterError(RuntimeError):
+    """Raised by the dispatch wrapper when this replica is not the ACTIVE
+    master: a demoted master must stop dispatching IMMEDIATELY (epoch
+    fencing makes the instance reject it anyway; this stops the attempt
+    at the source)."""
 
 
 @dataclass
@@ -119,6 +147,7 @@ class Scheduler:
         config: ServiceConfig,
         store: Optional[CoordinationStore] = None,
         tokenizer: Optional[Tokenizer] = None,
+        identity: str = "",
     ) -> None:
         self._config = config
         self._store = store if store is not None else connect(config.etcd_addr)
@@ -128,6 +157,26 @@ class Scheduler:
         # Installed by the Master: transport for role-flip notifications
         # ((instance_name, new_role) -> POST instance /flip).
         self.on_role_flip = None
+        # Installed by the Master: takeover-reconciliation transport
+        # ((meta, body) -> instance POST /reconcile response dict).
+        self.on_reconcile = None
+        # Installed by the Master: this replica's instance-plane address,
+        # advertised under the election lease so deposed masters can
+        # re-point heartbeating instances at the successor.
+        self.advertised_rpc = ""
+
+        # Mastership state machine (docs/FAULT_TOLERANCE.md): dispatch is
+        # gated on ACTIVE; RECONCILING parks it, STANDBY rejects it.
+        self._master_state = MASTER_STANDBY
+        self._dispatch_gate = threading.Event()
+        self._reconcile_thread: Optional[threading.Thread] = None
+        self._takeover_elected_mono = 0.0
+        # Bench/report surfaces (plain attrs; the histograms below carry
+        # the same numbers into /metrics).
+        self.last_takeover_ms: Optional[float] = None
+        self.takeover_first_dispatch_ms: Optional[float] = None
+        self.total_reconciled = 0
+        self.total_orphaned = 0
 
         # Service-tier metrics registry (obs.metrics): the master's
         # /metrics renders this alongside the HTTP-plane registries and
@@ -199,13 +248,34 @@ class Scheduler:
             "xllm_service_trace_dropped_total", "Trace records lost to "
             "disk-write failures",
         ).set_function(lambda: self._tracer.dropped)
+        self.metrics.gauge(
+            "xllm_master_epoch", "Fencing epoch of this replica's most "
+            "recent won master term (0 = never elected)",
+        ).set_function(lambda: self._election.epoch)
+        self._m_takeover = self.metrics.histogram(
+            "xllm_master_takeover_ms",
+            "Master takeover: lease won -> reconciliation complete "
+            "(dispatch unparked)", buckets=LATENCY_BUCKETS_MS,
+        )
+        self.metrics.counter(
+            "xllm_service_reconciled_requests_total",
+            "In-flight instance manifests reclaimed by a takeover "
+            "reconciliation (orphans are reaped instance-side and counted "
+            "in xllm_service_orphan_reaped_total there)",
+        ).set_function(lambda: self.total_reconciled)
+        self.metrics.counter(
+            "xllm_coord_watch_reconnects_total",
+            "Coordination-store watch streams reconnected after a "
+            "failure (jittered exponential backoff)",
+        ).set_function(coord_store.watch_reconnects_total)
 
         self._election = MasterElection(
             self._store,
-            identity=f"{config.host}:{config.http_port}",
+            identity=identity or f"{config.host}:{config.http_port}",
             lease_ttl_s=config.master_lease_ttl_s,
+            on_elected=self._on_elected,
+            on_lost=self._on_lost,
         )
-        self._election.start()
         self._instance_mgr = InstanceMgr(
             self._store,
             is_master=lambda: self._election.is_master,
@@ -254,6 +324,9 @@ class Scheduler:
             target=self._master_loop, name="scheduler-master", daemon=True
         )
         self._master_thread.start()
+        # Campaign LAST: a synchronous win fires _on_elected, whose
+        # reconcile thread touches every manager constructed above.
+        self._election.start()
 
     # ------------------------------------------------------------------ #
     # lifecycle
@@ -262,6 +335,275 @@ class Scheduler:
     @property
     def is_master(self) -> bool:
         return self._election.is_master
+
+    @property
+    def master_state(self) -> str:
+        return self._master_state
+
+    @property
+    def master_epoch(self) -> int:
+        """Fencing epoch stamped on every master->instance RPC."""
+        return self._election.epoch
+
+    @property
+    def election_identity(self) -> str:
+        return self._election.identity
+
+    def current_master_identity(self) -> str:
+        """The identity (host:http_port) holding the master lease NOW —
+        the redirect target for a standby's front door."""
+        try:
+            return self._election.current_master() or ""
+        except Exception:
+            return ""
+
+    # ------------------------------------------------------------------ #
+    # fenced master failover (docs/FAULT_TOLERANCE.md, control plane)
+    # ------------------------------------------------------------------ #
+
+    def _on_elected(self) -> None:
+        """Lease won (epoch committed in the same store txn). Enter
+        RECONCILING — new work parks, nothing dispatches — and rebuild
+        cluster state from instance manifests on a dedicated thread (this
+        callback may run on the store's watch-notifier thread, which must
+        never block on instance RPCs)."""
+        epoch = self._election.epoch
+        with self._mu:
+            self._master_state = MASTER_RECONCILING
+            self._takeover_elected_mono = time.monotonic()
+            self.takeover_first_dispatch_ms = None
+        logger.info(
+            "elected master (epoch %d): reconciling cluster state", epoch
+        )
+        t = threading.Thread(
+            target=self._reconcile_run, args=(epoch,),
+            name="master-reconcile", daemon=True,
+        )
+        self._reconcile_thread = t
+        t.start()
+
+    def _on_lost(self) -> None:
+        """Demoted (lease lost / store partition): stop dispatching NOW.
+        In-flight exchanges are error-finished so their clients retry
+        against the current master instead of hanging on a replica whose
+        RPCs the fleet now rejects; the front door (api tier) redirects
+        from here on."""
+        with self._mu:
+            self._master_state = MASTER_STANDBY
+            self._dispatch_gate.clear()
+            inflight = [
+                s.request.service_request_id
+                for s in self._requests.values()
+                if not s.done
+            ]
+        cur = self.current_master_identity()
+        logger.warning(
+            "demoted from master (epoch %d was fenced); failing %d "
+            "in-flight requests toward current master %s",
+            self._election.epoch, len(inflight), cur or "<none>",
+        )
+        for srid in inflight:
+            self.fail_request(
+                srid,
+                StatusCode.UNAVAILABLE,
+                "master demoted mid-request; retry against current "
+                f"master {cur or 'unknown'}",
+            )
+
+    def _reconcile_run(self, epoch: int) -> None:
+        """Takeover reconciliation: for every registered instance, pull
+        its in-flight manifest over POST /reconcile and rebuild the
+        per-instance request charges, load metrics, and the global KV
+        index. Manifest entries this master does not claim (`known`) are
+        reaped instance-side after the advertised TTL — no KV leaks from
+        a dead master's requests. Any instance failure is skipped: a dead
+        instance must not block the takeover (its state re-syncs through
+        heartbeats or pruning)."""
+        t0 = time.monotonic()
+        takeover = epoch > 1  # epoch 1 = cluster birth, nothing to reclaim
+        try:
+            instances = self._instance_mgr.list_instances()
+            if instances:
+                # The transport is installed by the api tier right after
+                # construction; tolerate that boot-order window.
+                deadline = t0 + 2.0
+                while (
+                    self.on_reconcile is None
+                    and time.monotonic() < deadline
+                    and not self._stop.is_set()
+                ):
+                    time.sleep(0.02)
+            with self._mu:
+                known_by_instance: Dict[str, set] = {}
+                for s in self._requests.values():
+                    if s.done:
+                        continue
+                    wire = (
+                        s.request.wire_srid
+                        or s.request.service_request_id
+                    )
+                    for name in {
+                        s.request.routing.prefill_name,
+                        s.request.routing.decode_name,
+                    }:
+                        if name:
+                            known_by_instance.setdefault(name, set()).add(
+                                wire
+                            )
+            if self.on_reconcile is not None:
+                for meta in instances:
+                    if self._stop.is_set():
+                        return
+                    # Epoch-keyed abandonment: a demote -> re-elect cycle
+                    # starts a NEW reconcile thread for the new term;
+                    # this one must stop even though the state reads
+                    # RECONCILING again (it belongs to the new epoch).
+                    if (
+                        self._master_state != MASTER_RECONCILING
+                        or self._election.epoch != epoch
+                    ):
+                        return
+                    self._reconcile_instance(
+                        meta, epoch,
+                        sorted(known_by_instance.get(meta.name, ())),
+                    )
+        finally:
+            # Only the thread whose term is STILL current completes the
+            # takeover: an abandoned term must neither unpark dispatch
+            # against a half-rebuilt view nor record a takeover sample.
+            flipped = False
+            with self._mu:
+                if (
+                    self._master_state == MASTER_RECONCILING
+                    and self._election.epoch == epoch
+                ):
+                    self._master_state = MASTER_ACTIVE
+                    self._dispatch_gate.set()
+                    flipped = True
+            if flipped:
+                self.advertise_master_rpc()
+                ms = (time.monotonic() - t0) * 1000.0
+                if takeover:
+                    self._m_takeover.observe(ms)
+                    self.last_takeover_ms = ms
+                logger.info(
+                    "reconciliation complete in %.1f ms (reclaimed=%d "
+                    "orphaned=%d)", ms, self.total_reconciled,
+                    self.total_orphaned,
+                )
+
+    def _reconcile_instance(self, meta, epoch: int, known: List[str]) -> None:
+        body = {
+            "master_epoch": epoch,
+            "master": self._election.identity,
+            "known": known,
+            "orphan_ttl_s": getattr(
+                self._config, "reconcile_orphan_ttl_s", 10.0
+            ),
+        }
+        try:
+            # Chaos hook: a dropped/errored reconcile exercises the
+            # skip-and-continue path (heartbeats re-sync the instance).
+            faults.point("reconcile.send", instance=meta.name, epoch=epoch)
+            resp = self.on_reconcile(meta, body)
+        except Exception as e:
+            logger.warning("reconcile of %s failed: %s", meta.name, e)
+            return
+        if self._election.epoch != epoch:
+            # Term changed while the RPC was in flight: the new term's
+            # thread owns absorption (a stale absorb would double-count
+            # and schedule a duplicate orphan unwind).
+            return
+        if not isinstance(resp, dict) or not resp.get("ok"):
+            logger.warning("reconcile of %s rejected: %s", meta.name, resp)
+            return
+        manifest = resp.get("manifest") or []
+        load = resp.get("load_metrics")
+        self._instance_mgr.absorb_reconcile(
+            meta.name,
+            LoadMetrics.from_json(load) if load else None,
+            manifest,
+        )
+        try:
+            hashes = [
+                bytes.fromhex(x) for x in resp.get("cache_hashes") or []
+            ]
+        except ValueError:
+            hashes = []
+        if hashes:
+            self._kvcache_mgr.absorb_instance_snapshot(meta.name, hashes)
+        known_set = set(known)
+        reclaimed = sum(
+            1 for ent in manifest
+            if ent.get("service_request_id") in known_set
+        )
+        orphans = [
+            ent for ent in manifest
+            if ent.get("service_request_id") not in known_set
+        ]
+        with self._mu:
+            self.total_reconciled += reclaimed
+            self.total_orphaned += len(orphans)
+        if orphans:
+            # The instance reaps unclaimed manifests at the orphan TTL
+            # (engine work cancelled, blocks freed); unwind the charges
+            # absorbed above on the same clock so the load accounting
+            # doesn't carry dead requests forever.
+            t = threading.Timer(
+                float(body["orphan_ttl_s"]) + 1.0,
+                self._unwind_orphan_charges, args=(meta.name, orphans),
+            )
+            t.daemon = True
+            t.start()
+
+    def _unwind_orphan_charges(self, name: str, entries: List[Dict]) -> None:
+        routing = Routing(prefill_name=name, decode_name=name)
+        for ent in entries:
+            try:
+                delivered = int(ent.get("delivered_tokens", 0))
+                prompt_toks = int(ent.get("prompt_tokens", 0))
+            except (TypeError, ValueError):
+                continue
+            self._instance_mgr.update_request_metrics(
+                routing,
+                RequestAction.FINISH_DECODE
+                if delivered > 0
+                else RequestAction.CANCEL,
+                prompt_toks,
+            )
+
+    def advertise_master_rpc(self) -> None:
+        """Publish this master's instance-plane address under its
+        election lease: the key dies with the master, and a deposed
+        replica hands its current value to heartbeating instances — the
+        re-point path that covers instances a /reconcile never reached."""
+        if not self.advertised_rpc or not self._election.is_master:
+            return
+        try:
+            self._store.set(
+                MASTER_RPC_KEY, self.advertised_rpc,
+                lease_id=self._election._lease_id,
+            )
+        except Exception:
+            logger.debug("master rpc advertisement failed", exc_info=True)
+
+    def current_master_rpc(self) -> str:
+        """The ACTIVE master's advertised instance-plane address ('' when
+        none) — what a deposed master hints to heartbeating instances."""
+        try:
+            return self._store.get(MASTER_RPC_KEY) or ""
+        except Exception:
+            return ""
+
+    def _dispatch_allowed(self) -> bool:
+        """Gate every master->instance forward on mastership: ACTIVE
+        dispatches, RECONCILING parks (bounded wait — reconciles are one
+        RPC per instance), STANDBY refuses."""
+        if self._dispatch_gate.is_set():
+            return True
+        if self._master_state == MASTER_RECONCILING:
+            self._dispatch_gate.wait(RECONCILE_PARK_TIMEOUT_S)
+        return self._dispatch_gate.is_set()
 
     @property
     def instance_mgr(self) -> InstanceMgr:
@@ -291,6 +633,11 @@ class Scheduler:
         while self.num_inflight and time.monotonic() < deadline:
             time.sleep(0.05)
         self._stop.set()
+        # Unblock any dispatch parked behind an in-flight reconcile.
+        self._dispatch_gate.set()
+        t = self._reconcile_thread
+        if t is not None:
+            t.join(timeout=2.0)
         self._master_thread.join(timeout=2.0)
         self._streams.shutdown()
         self._instance_mgr.close()
@@ -305,7 +652,10 @@ class Scheduler:
         while not self._stop.wait(period):
             self._pump_offline()
             self._notify_flips()
-            if not self._election.is_master:
+            # Master-only upkeep runs only once RECONCILED: pruning with a
+            # half-rebuilt heartbeat view would mass-evict live instances
+            # on the first post-takeover tick.
+            if self._master_state != MASTER_ACTIVE:
                 continue
             try:
                 self._kvcache_mgr.upload_kvcache()
@@ -706,6 +1056,13 @@ class Scheduler:
                 self._offline_parked.popleft()
             try:
                 dispatch()
+            except NotMasterError as e:
+                # Parked work outlived this replica's mastership: error
+                # it toward the current master instead of losing it.
+                self.fail_request(
+                    request.service_request_id,
+                    StatusCode.UNAVAILABLE, str(e),
+                )
             except Exception:
                 logger.exception("offline dispatch failed")
 
@@ -743,6 +1100,18 @@ class Scheduler:
 
         if dispatch is not None:
             def dispatch_instrumented() -> None:
+                # Mastership gate (docs/FAULT_TOLERANCE.md): a demoted
+                # replica must never forward — the fleet would reject its
+                # stale epoch anyway; refusing here keeps the failure on
+                # this side of the wire. A reconciling master PARKS the
+                # dispatch instead (bounded), so takeover never 500s work
+                # that arrived mid-transition.
+                if not self._dispatch_allowed():
+                    raise NotMasterError(
+                        "not the active master (state="
+                        f"{self._master_state}); current master is "
+                        f"{self.current_master_identity() or 'unknown'}"
+                    )
                 now = time.monotonic()
                 first = state.dispatch_mono == 0.0
                 if first:
@@ -750,6 +1119,16 @@ class Scheduler:
                     self._m_queue_delay.observe(
                         (now - state.sched_mono) * 1000.0
                     )
+                    if (
+                        self.takeover_first_dispatch_ms is None
+                        and self._takeover_elected_mono
+                        and self._election.epoch > 1
+                    ):
+                        # Takeover-to-first-dispatch: the acceptance
+                        # number the chaos bench reports.
+                        self.takeover_first_dispatch_ms = (
+                            (now - self._takeover_elected_mono) * 1000.0
+                        )
                 if self._tracer.enabled:
                     self._tracer.stage(
                         request.service_request_id, "dispatch",
