@@ -46,6 +46,12 @@ REQUIRED_POINTS = {
     "store.watch",
     "reconcile.send",
     "reconcile.recv",
+    # prefix KV fabric (docs/KV_CACHE.md): peer fetch send/receive —
+    # chaos here MUST degrade to recompute, never to an error — and the
+    # coordinated-eviction offer (chaos = the block dies locally)
+    "kv_fetch.send",
+    "kv_fetch.recv",
+    "fabric.evict_offer",
 }
 
 

@@ -377,6 +377,116 @@ class TestPolicies:
         finally:
             mgr.close(); kv.close()
 
+    def test_car_score_degenerate_inputs(self, store):
+        """Empty-tier OverlapScores, total_blocks == 0, max_waiting == 0:
+        every term must stay finite (no ZeroDivisionError) and the policy
+        must still pick deterministically."""
+        from xllm_service_tpu.cluster.policies import CacheAwareRouting
+        from xllm_service_tpu.common.types import OverlapScores
+
+        mgr, kv = self.setup_cluster(store)
+        try:
+            pol = CacheAwareRouting(mgr, kv)
+            empty = OverlapScores()  # no tiers, total_blocks=0
+            assert pol._score("p0", empty, {}, 0) == 0.0
+            # total_blocks == 0 with a nonzero waiting count but
+            # max_waiting == 0 (stale load map): waiting term drops out.
+            load = {"p0": LoadMetrics(5, 0.25)}
+            assert pol._score("p0", empty, load, 0) == -0.25
+            # max_waiting > 0 normalizes the waiting term.
+            assert pol._score("p0", empty, load, 10) == pytest.approx(
+                -0.25 - 0.5
+            )
+            # A prompt below one block hashes to nothing: the pair choice
+            # still resolves (affinity 0 everywhere -> load decides).
+            r = pol.select_instances_pair(list(range(self.BS - 1)))
+            assert r.prefill_name in ("p0", "p1") and r.decode_name == "d0"
+        finally:
+            mgr.close(); kv.close()
+
+    def test_car_tie_breaks_to_first_candidate(self, store):
+        """Strict > comparison: equal scores keep the FIRST candidate, so
+        a fully symmetric fleet routes deterministically."""
+        mgr, kv = self.setup_cluster(store)
+        try:
+            pol = make_policy("CAR", mgr, kv)
+            tokens = list(range(self.BS * 2))
+            hashes = prefix_block_hashes(tokens, self.BS)
+            for name in ("p0", "p1"):
+                kv.record_updated_kvcaches(
+                    name, KvCacheEvent(stored_cache=set(hashes))
+                )
+                mgr.record_load_metrics_update(name, LoadMetrics(1, 0.5))
+            r = pol.select_instances_pair(tokens)
+            assert r.prefill_name == "p0"
+        finally:
+            mgr.close(); kv.close()
+
+    def test_car_tier_weights_order_tiers(self, store):
+        """An HBM holder outranks a DRAM holder outranks an SSD holder at
+        equal load (the 1.0 / 0.5 / 0.25 tier weights)."""
+        from xllm_service_tpu.cluster.policies import CacheAwareRouting
+        from xllm_service_tpu.common.types import OverlapScores
+
+        mgr, kv = self.setup_cluster(store)
+        try:
+            pol = CacheAwareRouting(mgr, kv)
+            scores = OverlapScores(
+                hbm_scores={"h": 4}, dram_scores={"d": 4},
+                ssd_scores={"s": 4}, total_blocks=4,
+            )
+            sh = pol._score("h", scores, {}, 0)
+            sd = pol._score("d", scores, {}, 0)
+            ss = pol._score("s", scores, {}, 0)
+            assert sh > sd > ss > 0.0
+            assert sh == 1.0 and sd == 0.5 and ss == 0.25
+        finally:
+            mgr.close(); kv.close()
+
+    def test_car_fetch_adjusted_score(self, store):
+        """With the prefix fabric installed, a cold candidate scores the
+        holder's blocks at the fetch discount — so a lightly loaded
+        non-holder can beat a saturated holder, but never an idle one."""
+        from xllm_service_tpu.cluster.policies import CacheAwareRouting
+        from xllm_service_tpu.cluster.prefix_fabric import (
+            FETCH_DISCOUNT,
+            PrefixFabric,
+        )
+
+        mgr, kv = self.setup_cluster(store)
+        try:
+            fab = PrefixFabric(None, mgr, kv)
+            pol = CacheAwareRouting(mgr, kv, fabric=fab)
+            tokens = list(range(self.BS * 4))
+            hashes = prefix_block_hashes(tokens, self.BS)
+            kv.record_updated_kvcaches(
+                "p1", KvCacheEvent(stored_cache=set(hashes))
+            )
+            scores = kv.match(tokens)
+            # Cold p0 now carries the discounted fetchable value...
+            assert pol._score("p0", scores, {}, 0) == pytest.approx(
+                FETCH_DISCOUNT
+            )
+            # ...but the idle holder still wins on the margin.
+            r = pol.select_instances_pair(tokens)
+            assert r.prefill_name == "p1"
+            # A saturated holder loses to the cheap-fetch peer: affinity
+            # difference (1 - discount) < the load penalty.
+            mgr.record_load_metrics_update("p1", LoadMetrics(8, 0.9))
+            mgr.record_load_metrics_update("p0", LoadMetrics(0, 0.0))
+            r = pol.select_instances_pair(tokens)
+            assert r.prefill_name == "p0"
+            # Escape hatch: fabric off reverts to raw-overlap scoring.
+            import os
+
+            os.environ["XLLM_PREFIX_FABRIC"] = "0"
+            try:
+                assert pol._score("p0", scores, {}, 0) == 0.0
+            finally:
+                os.environ.pop("XLLM_PREFIX_FABRIC")
+        finally:
+            mgr.close(); kv.close()
+
     def test_slo_policy_prefers_fast_instance(self, store):
         mgr = InstanceMgr(store, is_master=lambda: True)
         kv = None

@@ -78,3 +78,50 @@ def test_seed_sensitivity():
     a = hashing.prefix_block_hashes(tokens, seed=1024)
     b = hashing.prefix_block_hashes(tokens, seed=1025)
     assert a != b
+
+
+def test_extend_prefix_block_hashes_fuzz_matches_full_recompute():
+    """Property fuzz: extending the chain incrementally over RANDOM chunk
+    splits — non-block-aligned tails included — must be byte-identical to
+    a full prefix_block_hashes recompute at every step, for random token
+    streams, block sizes, and seeds."""
+    import random
+
+    rng = random.Random(20260803)
+    for trial in range(60):
+        block_size = rng.choice([1, 2, 7, 16, 128])
+        seed = rng.randrange(2**32)
+        n = rng.randrange(0, 6 * block_size + rng.randrange(0, 5) + 1)
+        tokens = [rng.randrange(0, 1 << 31) for _ in range(n)]
+        want = hashing.prefix_block_hashes(tokens, block_size, seed)
+
+        chain = []
+        consumed = 0
+        while consumed < n:
+            # Arbitrary chunk sizes, deliberately not block multiples.
+            consumed = min(n, consumed + rng.randrange(1, 3 * block_size))
+            nblocks = consumed // block_size
+            got = hashing.extend_prefix_block_hashes(
+                chain, tokens, nblocks, block_size, seed
+            )
+            assert got is chain  # in-place contract
+            assert chain == want[:nblocks], (
+                f"trial {trial}: chunk split diverged at "
+                f"{consumed}/{n} tokens (bs={block_size})"
+            )
+        assert chain == want
+        # Over-asking never recomputes or extends past the token stream:
+        # nblocks already reached means the call is a no-op.
+        again = hashing.extend_prefix_block_hashes(
+            chain, tokens, len(want), block_size, seed
+        )
+        assert again == want
+
+
+def test_extend_prefix_block_hashes_empty_and_sub_block():
+    chain = []
+    assert hashing.extend_prefix_block_hashes(chain, [], 0, 16) == []
+    # A sub-block tail hashes nothing (nblocks=0), matching the full
+    # recompute's only-complete-blocks contract.
+    assert hashing.prefix_block_hashes([1, 2, 3], 16) == []
+    assert hashing.extend_prefix_block_hashes(chain, [1, 2, 3], 0, 16) == []

@@ -122,6 +122,7 @@ class HeartbeatLoop:
         collect_load: Optional[Callable[[], LoadMetrics]] = None,
         collect_latency: Optional[Callable[[], LatencyMetrics]] = None,
         collect_cache_event: Optional[Callable[[], KvCacheEvent]] = None,
+        collect_cache_snapshot: Optional[Callable[[], KvCacheEvent]] = None,
     ):
         self._client = client
         self._meta = meta
@@ -129,6 +130,13 @@ class HeartbeatLoop:
         self._collect_load = collect_load
         self._collect_latency = collect_latency
         self._collect_cache_event = collect_cache_event
+        # Full-tier snapshot provider (engine.cache_snapshot_event —
+        # stored = HBM commits, offload = host/SSD holdings): sent when
+        # the master asks (`resync_cache` on a heartbeat response — it
+        # pruned this instance's index locations on breaker ejection and
+        # deltas alone cannot rebuild them).
+        self._collect_cache_snapshot = collect_cache_snapshot
+        self._resync_cache = False
         self._stop = threading.Event()
         # Cache delta drained from the engine but not yet delivered: merged
         # into the next beat so a failed POST never loses transitions (the
@@ -164,6 +172,18 @@ class HeartbeatLoop:
                 else self._pending_event
             )
             self._pending_event = None
+        if self._resync_cache and self._collect_cache_snapshot is not None:
+            # Master-requested index rebuild: fold the FULL tier snapshot
+            # (stored = HBM, offload = host/SSD) under this beat's delta —
+            # merge() gives the newer delta precedence, and the index-side
+            # application is idempotent (set inserts / tier moves).
+            self._resync_cache = False
+            try:
+                snap = self._collect_cache_snapshot()
+            except Exception:
+                snap = None
+            if snap is not None and not snap.empty():
+                event = snap.merge(event) if event is not None else snap
         try:
             resp = self._client.heartbeat(
                 self._meta.name,
@@ -183,6 +203,11 @@ class HeartbeatLoop:
         if not resp.get("ok", False) and event is not None and not event.empty():
             # Master rejected/unreachable: keep the delta for the next beat.
             self._pending_event = event
+        if isinstance(resp, dict) and resp.get("resync_cache"):
+            # The master pruned this instance's KV-index locations (breaker
+            # ejection) and needs the full snapshot on the next beat —
+            # deltas alone cannot rebuild what was dropped.
+            self._resync_cache = True
         new_rpc = resp.get("master_rpc") if isinstance(resp, dict) else ""
         if new_rpc and new_rpc != self._client._addr:
             # A deposed master answered with the successor's address

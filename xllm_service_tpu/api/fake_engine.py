@@ -166,6 +166,12 @@ class FakeEngine:
         with self._mu:
             return sorted(self.cache_hashes)
 
+    def cache_snapshot_event(self) -> KvCacheEvent:
+        """Heartbeat cache-resync payload (post-ejection index rebuild);
+        the fake has one tier, so the snapshot is all stored."""
+        with self._mu:
+            return KvCacheEvent(stored_cache=set(self.cache_hashes))
+
     def profiling_data(self) -> Tuple[List, List]:
         ttft = [(n, self.ttft_ms + 0.01 * n) for n in (64, 256, 1024, 4096)]
         tpot = [

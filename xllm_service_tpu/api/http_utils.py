@@ -432,11 +432,11 @@ def post_json_retrying(
     raise last
 
 
-def post_bytes(
+def post_bytes_raw(
     addr: str, path: str, data: bytes, timeout: float = 60.0
-) -> Tuple[int, Dict[str, Any]]:
-    """Binary POST (KV handoff payloads). Same send-time-only retry rule as
-    post_json."""
+) -> Tuple[int, bytes]:
+    """Binary POST returning the RAW response body (the /kv/fetch reply is
+    a kv frame, not JSON). Same send-time-only retry rule as post_json."""
     for attempt in (0, 1):
         conn = _conn_for(addr, timeout)
         try:
@@ -454,13 +454,21 @@ def post_bytes(
             continue
         try:
             resp = conn.getresponse()
-            body = resp.read()
-            return resp.status, (json.loads(body) if body else {})
+            return resp.status, resp.read()
         except Exception:
             conn.close()
             getattr(_tls, "conns", {}).pop(addr, None)
             raise
     raise RuntimeError("unreachable")
+
+
+def post_bytes(
+    addr: str, path: str, data: bytes, timeout: float = 60.0
+) -> Tuple[int, Dict[str, Any]]:
+    """Binary POST with a JSON response (KV handoff payloads) — the raw
+    transport with the body parsed."""
+    status, body = post_bytes_raw(addr, path, data, timeout=timeout)
+    return status, (json.loads(body) if body else {})
 
 
 def get_raw(

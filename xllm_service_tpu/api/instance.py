@@ -52,12 +52,15 @@ from xllm_service_tpu.api.instance_registry import (  # noqa: E402
     _LOCAL_INSTANCES,
     _LOCAL_MU,
 )
+from xllm_service_tpu.api.instance_fabric import FabricMixin  # noqa: E402
 from xllm_service_tpu.api.instance_kv import KVHandoffMixin  # noqa: E402
 from xllm_service_tpu.api.instance_mm import MultimodalMixin  # noqa: E402
 from xllm_service_tpu.api.instance_serving import ServingMixin  # noqa: E402
 
 
-class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
+class InstanceServer(
+    KVHandoffMixin, FabricMixin, MultimodalMixin, ServingMixin
+):
     def __init__(
         self,
         engine_cfg: EngineConfig,
@@ -225,6 +228,11 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
         self._master: Optional[MasterClient] = (
             MasterClient(master_rpc_addr) if master_rpc_addr else None
         )
+        # Prefix-fabric state + metrics (instance_fabric mixin): peer
+        # fetch dedup tables, the evict-offer worker, and the
+        # xllm_fabric_* series. After self._master — the evictor side
+        # needs it to ask /rpc/fabric/evict_offer.
+        self._init_fabric()
         self._heartbeat: Optional[HeartbeatLoop] = (
             HeartbeatLoop(
                 self._master,
@@ -233,6 +241,9 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
                 collect_load=self.engine.get_load_metrics,
                 collect_latency=self.engine.get_latency_metrics,
                 collect_cache_event=self.engine.take_cache_event,
+                collect_cache_snapshot=getattr(
+                    self.engine, "cache_snapshot_event", None
+                ),
             )
             if self._master
             else None
@@ -392,6 +403,11 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
                 pass
         self._push_q.put(None)
         self._push_thread.join(timeout=5.0)
+        if self._fabric_evict_thread is not None:
+            try:
+                self._fabric_evict_q.put_nowait(None)
+            except queue.Full:
+                pass  # daemon thread; bounded queue must not block stop
         for _ in self._transfer_threads:
             self._transfer_q.put(None)
         for t in self._transfer_threads:
@@ -784,6 +800,9 @@ class InstanceServer(KVHandoffMixin, MultimodalMixin, ServingMixin):
         route = h.route
         if route == "/kv/import":  # binary body, not JSON
             self._handle_kv_import(h)
+            return
+        if route == "/kv/fetch":  # binary body, not JSON
+            self._handle_kv_fetch(h)
             return
         body = h.read_json()
         if body is None:

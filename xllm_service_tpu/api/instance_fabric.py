@@ -1,0 +1,418 @@
+"""Instance side of the fleet-wide prefix KV fabric (docs/KV_CACHE.md).
+
+Three duties, mixed into InstanceServer (`self` is the server):
+
+  * **Requester** — a forwarded request carrying the master's `kv_fabric`
+    hint starts `_fabric_prefetch`: compute the prompt's chained block
+    hashes, count what is already held locally on any tier, and pull the
+    missing matched range from the holding peer over `POST /kv/fetch`.
+    The fetch runs on a daemon thread WHILE the engine chunk-prefills the
+    uncovered tail; landed blocks are adopted at the next chunk boundary
+    (engine `_extend_midchunk_match`). Any failure — peer death, timeout,
+    shape mismatch, fault injection — only costs recompute, never an
+    error. Anti-stampede: concurrent requests missing the same first
+    block share ONE fetch (the rest count `dedup_waits` and proceed;
+    their chunk boundaries pick the blocks up when they land).
+  * **Holder** — `/kv/fetch` serves requested hashes from any local tier
+    via `engine.export_cached_blocks` (engine-thread export; a torn
+    off-thread read of an evicting block can never ship).
+  * **Evictor** — the engine's `on_cold_evict` hook lands here when a
+    block leaves the last local tier: the offer worker batches hashes to
+    the master's `/rpc/fabric/evict_offer`, and blocks the master marks
+    "send" are POSTed to the chosen peer's /kv/import (`fabric_blocks`
+    frames) so the last fleet replica of a hot prefix survives local
+    pressure. A dropped offer (chaos, full queue, master gone) just lets
+    the block die — the index retraction was already queued.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+from xllm_service_tpu.api.http_utils import (
+    HttpJsonApi,
+    post_bytes,
+    post_bytes_raw,
+    post_json,
+)
+from xllm_service_tpu.api.protocol import (
+    kv_frame_array,
+    kv_frame_split,
+    kv_frame_to_bytes,
+)
+from xllm_service_tpu.common import faults
+from xllm_service_tpu.common.hashing import prefix_block_hashes
+from xllm_service_tpu.cluster.prefix_fabric import fabric_enabled
+
+logger = logging.getLogger("xllm_service_tpu.api.instance")
+
+# Bounds: one fetch round-trip moves at most this many blocks (a huge
+# shared prefix fetches its head; the tail recomputes or refetches on the
+# next request), and the holder-side export waits at most this long for
+# the engine thread (a wedged engine must not pin HTTP workers).
+FETCH_MAX_BLOCKS = 2048
+FETCH_TIMEOUT_S = 30.0
+EXPORT_WAIT_S = 10.0
+# Evict-offer queue bound: under a host-tier eviction storm the fabric
+# sheds offers (blocks die locally, exactly as without the fabric) rather
+# than buffering unbounded host KV copies.
+EVICT_QUEUE_CAP = 64
+# Concurrent-fetch cap: each in-flight fetch is one daemon thread
+# buffering up to FETCH_MAX_BLOCKS of KV for up to FETCH_TIMEOUT_S —
+# the cap bounds both. A request arriving past the cap simply recomputes
+# (the universal fabric fallback).
+FETCH_MAX_CONCURRENT = 8
+
+
+class FabricMixin:
+    def _init_fabric(self) -> None:
+        """Fabric state + observability. Called from InstanceServer
+        .__init__ once self.metrics and self.engine exist."""
+        from xllm_service_tpu.obs import LATENCY_BUCKETS_MS
+
+        self._fabric_mu = threading.Lock()
+        # first-missing-hash -> in-flight marker (anti-stampede dedup).
+        self._fabric_inflight: Dict[bytes, bool] = {}
+        self._fabric_evict_q: "queue.Queue" = queue.Queue(
+            maxsize=EVICT_QUEUE_CAP
+        )
+        self._fabric_evict_thread = None
+        self._m_fabric_fetches = self.metrics.counter(
+            "xllm_fabric_fetches_total",
+            "Peer prefix fetches started (requester side)",
+        )
+        self._m_fabric_fetch_blocks = self.metrics.counter(
+            "xllm_fabric_fetch_blocks_total",
+            "KV blocks landed from peer prefix fetches",
+        )
+        self._m_fabric_fetch_aborts = self.metrics.counter(
+            "xllm_fabric_fetch_aborts_total",
+            "Peer prefix fetches that failed or timed out (the request "
+            "recomputes the gap — never an error)",
+        )
+        self._m_fabric_evict_offers = self.metrics.counter(
+            "xllm_fabric_evict_offers_total",
+            "Last-replica blocks re-homed onto a peer's cache by the "
+            "coordinated eviction tier",
+        )
+        self._m_fabric_dedup = self.metrics.counter(
+            "xllm_fabric_dedup_waits_total",
+            "Requests that piggybacked on an identical in-flight prefix "
+            "fetch instead of starting their own (anti-stampede)",
+        )
+        self._m_fabric_fetch_ms = self.metrics.histogram(
+            "xllm_fabric_fetch_ms",
+            "Peer prefix fetch: request start to blocks landed",
+            buckets=LATENCY_BUCKETS_MS,
+        )
+        # Coordinated eviction needs a real engine (host tier + block
+        # manager) and a master to ask; wire the hook only then.
+        if self._master is not None and hasattr(self.engine, "on_cold_evict"):
+            self.engine.on_cold_evict = self._fabric_on_cold_evict
+
+    def _fabric_enabled(self) -> bool:
+        return fabric_enabled(self.cfg)
+
+    # ------------------------------------------------------- requester side
+
+    def _fabric_prefetch(
+        self, token_ids: List[int], hint: Dict[str, Any]
+    ) -> None:
+        """Kick off the peer prefix fetch for one admitted request (HTTP
+        serving thread; the network work runs on a daemon thread so
+        admission is never delayed). Best-effort throughout — any early
+        exit just means recompute."""
+        if not hint or not self._fabric_enabled():
+            return
+        eng = self.engine
+        bm = getattr(eng, "block_mgr", None)
+        if bm is None or not hasattr(eng, "import_kv_blocks"):
+            return
+        holder = str(hint.get("holder") or "")
+        if not holder or holder == self.name:
+            return
+        want = min(int(hint.get("blocks") or 0), FETCH_MAX_BLOCKS)
+        if want <= 0:
+            return
+        hashes = prefix_block_hashes(
+            token_ids[: max(len(token_ids) - 1, 0)], bm.block_size, bm.seed
+        )
+        want = min(want, len(hashes))
+        host = getattr(eng, "host_pool", None)
+        ssd = getattr(eng, "ssd_pool", None)
+        local = 0
+        for h in hashes[:want]:
+            # Racy off-thread reads by design: an over- or under-count
+            # only shifts how many blocks ride the fetch; landing is
+            # content-addressed and dedups either way.
+            if (
+                bm.lookup_hash(h) is not None
+                or (host is not None and h in host)
+                or (ssd is not None and h in ssd)
+            ):
+                local += 1
+            else:
+                break
+        missing = hashes[local:want]
+        if not missing:
+            return
+        key = missing[0]
+        with self._fabric_mu:
+            if key in self._fabric_inflight:
+                # Anti-stampede: one fetch per distinct missing range; the
+                # piggybackers' chunk boundaries adopt the blocks when the
+                # winner lands them.
+                self._m_fabric_dedup.inc()
+                return
+            if len(self._fabric_inflight) >= FETCH_MAX_CONCURRENT:
+                return  # over the cap: recompute, don't pile up threads
+            self._fabric_inflight[key] = True
+        addr = str(hint.get("addr") or "")
+        threading.Thread(
+            target=self._fabric_fetch,
+            args=(holder, addr, missing, key),
+            name=f"kv-fetch-{self.name}",
+            daemon=True,
+        ).start()
+
+    def _fabric_fetch(
+        self, holder: str, addr: str, missing: List[bytes], key: bytes
+    ) -> None:
+        t0 = time.monotonic()
+        self._m_fabric_fetches.inc()
+        try:
+            if not addr:
+                addr = self._resolve_instance_addr(holder)
+            if not addr:
+                raise ConnectionError(f"holder {holder} unknown")
+            faults.point(
+                "kv_fetch.send",
+                instance=self.name, peer=holder, addr=addr,
+                blocks=len(missing),
+            )
+            code, raw = post_bytes_raw(
+                addr, "/kv/fetch",
+                kv_frame_to_bytes(
+                    {"block_hashes": [h.hex() for h in missing]}
+                ),
+                timeout=FETCH_TIMEOUT_S,
+            )
+            if code != 200:
+                raise ConnectionError(f"holder {holder} returned {code}")
+            header, body = kv_frame_split(raw)
+            served = [
+                bytes.fromhex(x) for x in header.get("block_hashes", [])
+            ]
+            kv = kv_frame_array(header, body)
+            if not served or kv is None:
+                raise ConnectionError(f"holder {holder} served no blocks")
+            # Shape gate, same rule as the PD stream receiver: a fleet
+            # whose engine configs diverge must fall back to recompute,
+            # not land garbage KV.
+            ex = getattr(self.engine, "executor", None)
+            if ex is not None and hasattr(ex, "migration_shape"):
+                expect = ex.migration_shape(len(served))
+                if tuple(kv.shape) != tuple(expect):
+                    raise ValueError(
+                        f"fetched KV shape {tuple(kv.shape)} != local "
+                        f"cache layout {tuple(expect)}"
+                    )
+            self.engine.import_kv_blocks(served, kv)
+            self._m_fabric_fetch_blocks.inc(len(served))
+            self._m_fabric_fetch_ms.observe((time.monotonic() - t0) * 1000)
+        except Exception as e:  # noqa: BLE001 — fetch must fail soft
+            self._m_fabric_fetch_aborts.inc()
+            logger.warning(
+                "prefix-fabric fetch of %d block(s) from %s aborted (%s); "
+                "recompute covers the gap", len(missing), holder, e,
+            )
+        finally:
+            with self._fabric_mu:
+                self._fabric_inflight.pop(key, None)
+
+    # --------------------------------------------------------- holder side
+
+    def _handle_kv_fetch(self, h: HttpJsonApi) -> None:
+        """Serve one peer's prefix fetch: kv-frame request ({block_hashes})
+        in, kv-frame response (served hashes + stacked KV bytes) out."""
+        try:
+            n = int(h.headers.get("Content-Length", 0))
+            header, _ = kv_frame_split(h.rfile.read(n))
+            hashes = [
+                bytes.fromhex(x) for x in header.get("block_hashes", [])
+            ]
+        except Exception as e:
+            h.send_error_json(400, f"bad fetch request: {e}")
+            return
+        try:
+            faults.point(
+                "kv_fetch.recv", instance=self.name, blocks=len(hashes)
+            )
+        except faults.FaultInjected as fi:
+            h.send_error_json(503, str(fi))
+            return
+        if not self._fabric_enabled() or not hasattr(
+            self.engine, "export_cached_blocks"
+        ):
+            h.send_error_json(
+                400, "this instance cannot serve prefix fetches"
+            )
+            return
+        if not hashes:
+            h.send_error_json(400, "fetch names no blocks")
+            return
+        served, kv = self.engine.export_cached_blocks(
+            hashes[:FETCH_MAX_BLOCKS], timeout=EXPORT_WAIT_S
+        )
+        body = kv_frame_to_bytes(
+            {"block_hashes": [b.hex() for b in served]},
+            kv if served else None,
+        )
+        h.send_response(200)
+        h.send_header("Content-Type", "application/octet-stream")
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)
+
+    def _handle_fabric_import(
+        self, h: HttpJsonApi, header: Dict[str, Any], body: bytes
+    ) -> None:
+        """Receive re-homed eviction blocks (a peer's coordinated-eviction
+        send): land them content-addressed into the local prefix cache.
+        The next heartbeat's stored delta re-indexes them fleet-wide."""
+        if not self._fabric_enabled():
+            # The escape hatch must disable the RECEIVE side too: a
+            # fabric-off instance refuses foreign KV (same gate as
+            # /kv/fetch) — in-flight offers from not-yet-flipped peers
+            # just drop their blocks, exactly like any refused offer.
+            h.send_error_json(400, "prefix fabric disabled")
+            return
+        if not hasattr(self.engine, "import_kv_blocks"):
+            h.send_error_json(400, "this instance cannot land KV blocks")
+            return
+        try:
+            hashes = [
+                bytes.fromhex(x) for x in header.get("block_hashes", [])
+            ]
+            kv = kv_frame_array(header, body)
+        except Exception as e:
+            h.send_error_json(400, f"bad fabric frame: {e}")
+            return
+        if not hashes or kv is None:
+            h.send_error_json(400, "fabric frame carries no blocks")
+            return
+        ex = getattr(self.engine, "executor", None)
+        if ex is not None and hasattr(ex, "migration_shape"):
+            expect = ex.migration_shape(len(hashes))
+            if tuple(kv.shape) != tuple(expect):
+                h.send_error_json(
+                    400,
+                    f"fabric KV shape {tuple(kv.shape)} != local cache "
+                    f"layout {tuple(expect)}",
+                )
+                return
+        self.engine.import_kv_blocks(hashes, kv)
+        h.send_json({"ok": True, "landed": len(hashes)})
+
+    # -------------------------------------------------------- evictor side
+
+    def _fabric_on_cold_evict(self, block_hash: bytes, kv) -> None:
+        """Engine-thread hook: a committed block is leaving the last local
+        tier. Enqueue the offer and return — NEVER block the engine; a
+        full queue sheds the offer (the block dies locally, exactly as
+        without the fabric)."""
+        if not self._fabric_enabled() or self._master is None:
+            return
+        try:
+            self._fabric_evict_q.put_nowait(
+                (bytes(block_hash), np.ascontiguousarray(kv))
+            )
+        except queue.Full:
+            return
+        self._fabric_evict_start()
+
+    def _fabric_evict_start(self) -> None:
+        t = self._fabric_evict_thread
+        if t is not None and t.is_alive():
+            return
+        with self._fabric_mu:
+            t = self._fabric_evict_thread
+            if t is not None and t.is_alive():
+                return
+            t = threading.Thread(
+                target=self._fabric_evict_loop,
+                name=f"fabric-evict-{self.name}",
+                daemon=True,
+            )
+            self._fabric_evict_thread = t
+        t.start()
+
+    def _fabric_evict_loop(self) -> None:
+        while True:
+            item = self._fabric_evict_q.get()
+            if item is None:
+                return
+            batch = [item]
+            while len(batch) < 16:
+                try:
+                    nxt = self._fabric_evict_q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._fabric_evict_q.put(None)
+                    break
+                batch.append(nxt)
+            try:
+                self._fabric_offer_batch(batch)
+            except Exception:  # noqa: BLE001 — offers are best-effort
+                logger.debug("fabric evict offer failed", exc_info=True)
+
+    def _fabric_offer_batch(self, batch) -> None:
+        """Ask the master where (whether) this batch of last-tier victims
+        should live, then ship the 'send' verdicts to their peers. Any
+        failure — chaos at the fault point, master unreachable, peer
+        rejection — drops the blocks exactly as an uncoordinated eviction
+        would: the index retraction is already queued on the heartbeat."""
+        hashes = [h for h, _ in batch]
+        faults.point(
+            "fabric.evict_offer", instance=self.name, blocks=len(hashes)
+        )
+        code, resp = post_json(
+            self._master._addr, "/rpc/fabric/evict_offer",
+            {
+                "name": self.name,
+                "block_hashes": [h.hex() for h in hashes],
+            },
+            timeout=5.0,
+        )
+        if code != 200 or not isinstance(resp, dict):
+            return
+        decisions = resp.get("decisions") or []
+        sends: Dict[str, List] = {}
+        for (h_bytes, kv), d in zip(batch, decisions):
+            if (
+                isinstance(d, dict)
+                and d.get("action") == "send"
+                and d.get("addr")
+            ):
+                sends.setdefault(str(d["addr"]), []).append((h_bytes, kv))
+        for addr, items in sends.items():
+            frame = kv_frame_to_bytes(
+                {
+                    "fabric_blocks": True,
+                    "block_hashes": [h.hex() for h, _ in items],
+                },
+                np.stack([kv for _, kv in items], axis=2),
+            )
+            try:
+                code, _ = post_bytes(addr, "/kv/import", frame, timeout=30.0)
+            except Exception:
+                continue
+            if code == 200:
+                self._m_fabric_evict_offers.inc(len(items))

@@ -831,6 +831,11 @@ class KVHandoffMixin:
         # pair choice must be rejectable here exactly like its dispatch.
         if self._fence_reject(h, header):
             return
+        if header.get("fabric_blocks"):
+            # Coordinated-eviction re-homing (docs/KV_CACHE.md): a peer is
+            # shipping the last fleet replica of cold-tier victims.
+            self._handle_fabric_import(h, header, body)
+            return
         ss = header.get("kv_stream") or {}
         if ss and ss.get("op") != "commit":
             # Streaming-session control message (open / chunk / abort);

@@ -10,6 +10,7 @@ server.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -22,6 +23,9 @@ from xllm_service_tpu.service.request import ServiceRequest
 from xllm_service_tpu.service.response_handler import accumulate_sequences
 from xllm_service_tpu.tokenizer import parse_messages
 from xllm_service_tpu.tokenizer.tokenizer import IncrementalDetokenizer
+
+logger = logging.getLogger("xllm_service_tpu.api.instance")
+
 
 class ServingMixin:
     def _make_push_callback(
@@ -441,6 +445,18 @@ class ServingMixin:
         # its running decodes preempt under online bursts (engine-level;
         # the master additionally parks offline admissions).
         offline = bool(body.get("offline", False))
+
+        if srid and self._master is not None:
+            # Prefix-fabric peer fetch (docs/KV_CACHE.md): the master's
+            # dispatch hint says a peer holds more of this prompt's
+            # prefix than we do — pull the gap while the engine
+            # chunk-prefills the tail. Best-effort, never a gate.
+            fab = body.get("kv_fabric")
+            if fab and not body.get("mm_positions") and not adapter_idx:
+                try:
+                    self._fabric_prefetch(token_ids, fab)
+                except Exception:
+                    logger.exception("fabric prefetch failed; recomputing")
 
         if srid and self._master is not None and (n > 1 or best_of > 1):
             # Reconcile-manifest entry (docs/FAULT_TOLERANCE.md) — after

@@ -813,6 +813,15 @@ class Master:
                     self.config.enable_decode_response_to_service
                 ),
                 master_epoch=epoch,
+                # Skip the fetch hint when a replay re-routed onto the
+                # holder itself (the instance also self-checks).
+                kv_fabric=(
+                    req.kv_fabric
+                    if req.kv_fabric
+                    and req.kv_fabric.get("holder")
+                    != req.routing.prefill_name
+                    else None
+                ),
             )
             if req.resume_base:
                 # Token-replay resume: the last resume_base token_ids are
@@ -999,8 +1008,30 @@ class Master:
             self._handle_deregister(h, body)
         elif route == "/rpc/generations":
             self._handle_generations(h, body)
+        elif route == "/rpc/fabric/evict_offer":
+            self._handle_evict_offer(h, body)
         else:
             h.send_error_json(404, f"no route {route}")
+
+    def _handle_evict_offer(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
+        """Coordinated multi-tier eviction (docs/KV_CACHE.md): an instance
+        about to drop blocks from its coldest tier asks where they should
+        live. Per-hash verdicts come from the scheduler's PrefixFabric;
+        a non-master replica refuses (its index view may be stale)."""
+        if not self.scheduler.is_master:
+            h.send_error_json(503, "not the master", etype="not_master")
+            return
+        try:
+            hashes = [
+                bytes.fromhex(x) for x in body.get("block_hashes") or []
+            ]
+        except ValueError:
+            h.send_error_json(400, "malformed block hashes")
+            return
+        decisions = self.scheduler.prefix_fabric.evict_decisions(
+            str(body.get("name") or ""), hashes
+        )
+        h.send_json({"ok": True, "decisions": decisions})
 
     def _handle_register(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
         try:
@@ -1090,7 +1121,13 @@ class Master:
             and meta.current_type.name in ("PREFILL", "DECODE")
         ):
             self.scheduler.instance_mgr.requeue_flip(name, 1)
-        h.send_json({"ok": True})
+        resp: Dict[str, Any] = {"ok": True}
+        if self.scheduler.take_cache_resync(name):
+            # Breaker ejection pruned this instance's KV-index locations;
+            # deltas can't rebuild them — ask for the full committed-block
+            # snapshot on the next beat (docs/KV_CACHE.md).
+            resp["resync_cache"] = True
+        h.send_json(resp)
 
     def _handle_generations(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
         try:

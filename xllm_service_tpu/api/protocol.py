@@ -267,6 +267,7 @@ def augment_forwarded_request(
     routing,
     decode_response_to_service: bool = True,
     master_epoch: int = 0,
+    kv_fabric: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Inject the service-side fields so the engine skips re-tokenization
     and knows its PD pair. `decode_response_to_service=False` selects the
@@ -284,6 +285,11 @@ def augment_forwarded_request(
         fwd["routing"]["decode_response_to_service"] = False
     if master_epoch:
         fwd["master_epoch"] = int(master_epoch)
+    if kv_fabric:
+        # Prefix-fabric fetch hint (docs/KV_CACHE.md): the fleet-best
+        # prefix holder for this prompt; the instance pulls the gap over
+        # /kv/fetch while chunk-prefilling the uncovered tail.
+        fwd["kv_fabric"] = dict(kv_fabric)
     return fwd
 
 

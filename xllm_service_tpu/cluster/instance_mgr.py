@@ -159,6 +159,12 @@ class InstanceMgr:
         # Removal listeners (scheduler re-dispatch, cache-index cleanup).
         # Called OUTSIDE the registry lock with the instance name.
         self._removal_listeners: List[Callable[[str], None]] = []
+        # Health-transition listeners: fn(name, new_state), called OUTSIDE
+        # the lock whenever the breaker changes an instance's state in
+        # record_dispatch_failure (the only entry into EJECTED). The
+        # scheduler uses this to prune an ejected instance's KV-index
+        # locations so cache-aware routing stops scoring phantom hits.
+        self._health_listeners: List[Callable[[str, str], None]] = []
 
         self._watch_ids: List[int] = []
         for prefix in INSTANCE_PREFIXES.values():
@@ -280,6 +286,16 @@ class InstanceMgr:
 
     def add_removal_listener(self, fn: Callable[[str], None]) -> None:
         self._removal_listeners.append(fn)
+
+    def add_health_listener(self, fn: Callable[[str, str], None]) -> None:
+        self._health_listeners.append(fn)
+
+    def _notify_health(self, name: str, state: str) -> None:
+        for fn in self._health_listeners:
+            try:
+                fn(name, state)
+            except Exception:
+                logger.exception("health listener failed for %s", name)
 
     def _remove(self, name: str) -> None:
         with self._mu:
@@ -459,15 +475,18 @@ class InstanceMgr:
             elif h.consecutive_failures >= self._suspect_failures:
                 if prev == HealthState.HEALTHY:
                     h.state = HealthState.SUSPECT
-            if h.state != prev:
+            state = h.state
+            if state != prev:
                 logger.warning(
                     "instance %s breaker %s -> %s (%d consecutive failures)",
-                    name, prev, h.state, h.consecutive_failures,
+                    name, prev, state, h.consecutive_failures,
                 )
-                if h.state == HealthState.EJECTED:
+                if state == HealthState.EJECTED:
                     self.total_ejections += 1
                     h.last_probe_mono = 0.0  # probe as soon as possible
-            return h.state
+        if state != prev:
+            self._notify_health(name, state)
+        return state
 
     def _beat_observed(self, name: str) -> None:
         """A live heartbeat clears staleness-driven suspicion (failure-

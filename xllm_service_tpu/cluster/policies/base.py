@@ -13,9 +13,15 @@ from xllm_service_tpu.common.types import Routing
 
 
 class LoadBalancePolicy:
-    def select_instances_pair(self, token_ids: Sequence[int]) -> Routing:
+    def select_instances_pair(
+        self, token_ids: Sequence[int], scores=None
+    ) -> Routing:
         """Choose the (prefill, decode) pair for one request given its
-        pre-tokenized prompt."""
+        pre-tokenized prompt. `scores` is an optional precomputed
+        GlobalKVCacheMgr.match() result — the scheduler computes it once
+        per request and shares it with the fabric's fetch planner, so
+        cache-aware policies must not re-hash the prompt when given it
+        (non-cache policies ignore it)."""
         raise NotImplementedError
 
 
@@ -25,6 +31,7 @@ def make_policy(
     kvcache_mgr=None,
     target_ttft_ms: float = 1000.0,
     target_tpot_ms: float = 50.0,
+    fabric=None,
 ) -> LoadBalancePolicy:
     from xllm_service_tpu.cluster.policies.cache_aware import CacheAwareRouting
     from xllm_service_tpu.cluster.policies.round_robin import RoundRobinPolicy
@@ -36,7 +43,7 @@ def make_policy(
     if key in ("CAR", "CACHE_AWARE"):
         if kvcache_mgr is None:
             raise ValueError("CAR policy requires a GlobalKVCacheMgr")
-        return CacheAwareRouting(instance_mgr, kvcache_mgr)
+        return CacheAwareRouting(instance_mgr, kvcache_mgr, fabric=fabric)
     if key == "SLO_AWARE":
         return SloAwarePolicy(instance_mgr, target_ttft_ms, target_tpot_ms)
     raise ValueError(f"unknown load_balance_policy {name!r}")

@@ -30,10 +30,20 @@ _TIER_WEIGHTS = (("hbm_scores", 1.0), ("dram_scores", 0.5), ("ssd_scores", 0.25)
 
 class CacheAwareRouting(LoadBalancePolicy):
     def __init__(
-        self, instance_mgr: InstanceMgr, kvcache_mgr: GlobalKVCacheMgr
+        self,
+        instance_mgr: InstanceMgr,
+        kvcache_mgr: GlobalKVCacheMgr,
+        fabric=None,
     ) -> None:
         self._instance_mgr = instance_mgr
         self._kvcache_mgr = kvcache_mgr
+        # Prefix KV fabric (cluster/prefix_fabric.py): when present, the
+        # affinity term scores EFFECTIVE matched blocks after a peer
+        # fetch (local overlap + fetchable-from-the-best-holder blocks
+        # discounted by fetch cost) instead of raw local overlap — a
+        # loaded holder can lose to a lightly loaded cheap-fetch peer on
+        # the merits instead of by accident.
+        self._fabric = fabric
 
     def _score(
         self,
@@ -42,9 +52,12 @@ class CacheAwareRouting(LoadBalancePolicy):
         load: Dict[str, LoadMetrics],
         max_waiting: int,
     ) -> float:
-        matched = 0.0
-        for attr, w in _TIER_WEIGHTS:
-            matched += getattr(scores, attr).get(name, 0) * w
+        if self._fabric is not None:
+            matched = self._fabric.effective_matched(name, scores)
+        else:
+            matched = 0.0
+            for attr, w in _TIER_WEIGHTS:
+                matched += getattr(scores, attr).get(name, 0) * w
         affinity = matched / scores.total_blocks if scores.total_blocks else 0.0
         m = load.get(name, LoadMetrics())
         waiting = m.waiting_requests_num / max_waiting if max_waiting else 0.0
@@ -66,8 +79,11 @@ class CacheAwareRouting(LoadBalancePolicy):
                 best, best_score = name, s
         return best
 
-    def select_instances_pair(self, token_ids: Sequence[int]) -> Routing:
-        scores = self._kvcache_mgr.match(token_ids)
+    def select_instances_pair(
+        self, token_ids: Sequence[int], scores=None
+    ) -> Routing:
+        if scores is None:
+            scores = self._kvcache_mgr.match(token_ids)
         load = self._instance_mgr.get_load_metrics()
         max_waiting = max(
             (m.waiting_requests_num for m in load.values()), default=0

@@ -14,5 +14,7 @@ class RoundRobinPolicy(LoadBalancePolicy):
     def __init__(self, instance_mgr: InstanceMgr) -> None:
         self._instance_mgr = instance_mgr
 
-    def select_instances_pair(self, token_ids: Sequence[int]) -> Routing:
+    def select_instances_pair(
+        self, token_ids: Sequence[int], scores=None
+    ) -> Routing:
         return self._instance_mgr.get_next_instance_pair()

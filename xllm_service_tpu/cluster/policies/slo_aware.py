@@ -29,7 +29,9 @@ class SloAwarePolicy(LoadBalancePolicy):
         self.target_ttft_ms = target_ttft_ms
         self.target_tpot_ms = target_tpot_ms
 
-    def select_instances_pair(self, token_ids: Sequence[int]) -> Routing:
+    def select_instances_pair(
+        self, token_ids: Sequence[int], scores=None
+    ) -> Routing:
         return self._instance_mgr.select_instance_pair_on_slo(
             len(token_ids), self.target_ttft_ms, self.target_tpot_ms
         )

@@ -1,0 +1,275 @@
+"""Fleet-wide prefix KV fabric: the cluster plane that MOVES cached blocks.
+
+`GlobalKVCacheMgr` knows where every committed prefix block lives, and
+`CacheAwareRouting` steers requests toward holders — but until this module
+a hit was only usable if routing happened to land the request on the one
+instance holding the blocks. The fabric turns the per-instance caches into
+one content-addressed store (ROADMAP item 3; P/D-Serve, arXiv 2408.08147,
+is the at-scale reference for weighing global reuse against load):
+
+  * **Peer prefix fetch** — at dispatch the master attaches a `kv_fabric`
+    hint ({holder, addr, blocks}) when the fleet-wide best match beats the
+    routed instance's own; the instance pulls the missing blocks from the
+    holder over `POST /kv/fetch` (api/instance_fabric.py) and lands them
+    content-addressed, OVERLAPPED with chunked prefill of the uncovered
+    tail (the engine re-matches at every chunk boundary —
+    InferenceEngine._extend_midchunk_match). Any failure, timeout, or
+    fault-injection hit degrades to plain recompute — never to an error.
+  * **Coordinated multi-tier eviction** — before an instance drops the
+    LAST fleet replica of a block from its coldest tier, it asks the
+    master (`/rpc/fabric/evict_offer` -> `evict_decisions` here) whether
+    to re-home the block on an under-utilized peer's host tier or let it
+    die with an index retraction. Hot shared prefixes survive local
+    pressure; cold ones die fleet-wide.
+  * **Hit-aware admission** — `CacheAwareRouting` scores candidates by
+    `effective_matched` (local matched + fetchable-from-a-peer blocks
+    discounted by fetch cost) instead of raw overlap, so routing can
+    prefer a loaded holder or a cheap-fetch peer on the merits.
+
+Escape hatch: `XLLM_PREFIX_FABRIC=1|0` overrides the config flags either
+way, read per call so it can flip on a live cluster. Wire protocol +
+fallback matrix: docs/KV_CACHE.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from xllm_service_tpu.common.types import OverlapScores
+
+logger = logging.getLogger(__name__)
+
+# Tier weights for matched blocks (shared with CacheAwareRouting): HBM
+# reuse is free, DRAM needs a host->device copy, SSD a disk read first.
+TIER_WEIGHTS = (("hbm_scores", 1.0), ("dram_scores", 0.5), ("ssd_scores", 0.25))
+
+# A block fetched from a peer is worth this fraction of a local HBM hit in
+# the routing score: the fetch pays one control round-trip + a bulk copy,
+# recompute pays a full forward pass — cheaper, but not free.
+FETCH_DISCOUNT = 0.6
+
+# Don't plan a fetch for less than this many blocks (the control round-trip
+# would cost more than the recompute it saves).
+MIN_FETCH_BLOCKS = 1
+
+# Eviction re-homing only targets peers with KV headroom: offering blocks
+# to a peer above this usage would just trigger ITS evictions.
+PEER_USAGE_CEILING = 0.85
+
+
+def fabric_enabled(cfg=None) -> bool:
+    """The escape hatch: XLLM_PREFIX_FABRIC=1|0 overrides the config flag
+    either way. Read per call so the hatch can flip on a live process."""
+    env = os.environ.get("XLLM_PREFIX_FABRIC", "")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    return bool(getattr(cfg, "enable_prefix_fabric", True))
+
+
+def weighted_matched(scores: OverlapScores, name: str) -> float:
+    """Tier-weighted matched-block score for one instance."""
+    total = 0.0
+    for attr, w in TIER_WEIGHTS:
+        total += getattr(scores, attr).get(name, 0) * w
+    return total
+
+
+class PrefixFabric:
+    """Master-side fabric coordinator: fetch planning, fetch-cost-adjusted
+    routing scores, and multi-tier eviction decisions. Owned by the
+    Scheduler; consulted by `schedule()` (hint), `CacheAwareRouting`
+    (scores), and the `/rpc/fabric/evict_offer` RPC (decisions)."""
+
+    def __init__(self, config, instance_mgr, kvcache_mgr, metrics=None):
+        self._config = config
+        self._instance_mgr = instance_mgr
+        self._kvcache_mgr = kvcache_mgr
+        self._mu = threading.Lock()
+        # Fleet-wide prefix hit accounting from the router's vantage: per
+        # scheduled request, the fleet-best matched block count over the
+        # prompt's total hashable blocks. This is the number the fabric
+        # exists to RAISE (routing + fetch turn fleet-visible blocks into
+        # served blocks).
+        self.fleet_matched_blocks = 0
+        self.fleet_total_blocks = 0
+        self.plans = 0
+        self.evict_sends = 0
+        self.evict_drops = 0
+        if metrics is not None:
+            metrics.gauge(
+                "xllm_fleet_prefix_hit_rate",
+                "Fleet-wide prefix hit rate at the router: best matched "
+                "blocks any instance holds over total prompt blocks, "
+                "across scheduled requests",
+            ).set_function(
+                lambda: self.fleet_matched_blocks
+                / max(self.fleet_total_blocks, 1)
+            )
+
+    def enabled(self) -> bool:
+        return fabric_enabled(self._config)
+
+    # ------------------------------------------------------------- routing
+
+    def _holder_usable(self, name: str) -> bool:
+        """A fetch/score holder must still exist and not be ejected (a
+        breaker-ejected peer would time the fetch out on every request).
+        Ejection/deregistration also prunes its index locations — this
+        check covers the heartbeat of staleness in between."""
+        from xllm_service_tpu.cluster.instance_mgr import HealthState
+
+        if self._instance_mgr.get_instance(name) is None:
+            return False
+        return self._instance_mgr.health_state(name) != HealthState.EJECTED
+
+    def effective_matched(self, name: str, scores: OverlapScores) -> float:
+        """Matched blocks AFTER a fabric fetch: the candidate's own
+        tier-weighted overlap plus what the best usable peer could ship,
+        discounted by fetch cost. With the fabric disabled this is exactly
+        the raw local overlap."""
+        local = weighted_matched(scores, name)
+        if not self.enabled():
+            return local
+        best_other = 0.0
+        for other in self._candidate_names(scores):
+            if other == name:
+                continue
+            w = weighted_matched(scores, other)
+            if w > best_other and self._holder_usable(other):
+                best_other = w
+        return local + max(best_other - local, 0.0) * FETCH_DISCOUNT
+
+    @staticmethod
+    def _candidate_names(scores: OverlapScores):
+        names = set()
+        for attr, _ in TIER_WEIGHTS:
+            names.update(getattr(scores, attr))
+        return names
+
+    # ------------------------------------------------------ fetch planning
+
+    def plan_fetch(
+        self,
+        token_ids: Sequence[int],
+        routed: str,
+        scores: Optional[OverlapScores] = None,
+    ) -> Optional[Dict]:
+        """The `kv_fabric` dispatch hint for one routed request: the best
+        usable peer holding more matched blocks than the routed instance,
+        or None when routing already landed on (one of) the best holders.
+        Also feeds the fleet-wide hit-rate gauge — every scheduled request
+        counts, hint or not."""
+        if scores is None:
+            scores = self._kvcache_mgr.match(token_ids)
+
+        def blocks_held(name: str) -> int:
+            # Tiers are DISJOINT per instance (record_updated_kvcaches
+            # moves a hash between sets) — a holder's matched count is
+            # the SUM across tiers, not the max.
+            return sum(
+                getattr(scores, attr).get(name, 0) for attr, _ in TIER_WEIGHTS
+            )
+
+        best_name, best_w = "", 0.0
+        best_blocks = 0
+        for name in self._candidate_names(scores):
+            w = weighted_matched(scores, name)
+            if w > best_w:
+                best_name, best_w = name, w
+                best_blocks = blocks_held(name)
+        with self._mu:
+            self.fleet_total_blocks += scores.total_blocks
+            self.fleet_matched_blocks += best_blocks
+        if not self.enabled():
+            return None
+        routed_w = weighted_matched(scores, routed)
+        routed_blocks = blocks_held(routed)
+        if (
+            not best_name
+            or best_name == routed
+            or best_w <= routed_w
+            or best_blocks - routed_blocks < MIN_FETCH_BLOCKS
+            or not self._holder_usable(best_name)
+        ):
+            return None
+        meta = self._instance_mgr.get_instance(best_name)
+        if meta is None:
+            return None
+        with self._mu:
+            self.plans += 1
+        return {
+            "holder": best_name,
+            "addr": meta.http_address,
+            # Fleet-best matched block count: the requester fetches the
+            # hash range between its own local match and this bound.
+            "blocks": int(best_blocks),
+            "total_blocks": int(scores.total_blocks),
+        }
+
+    # ------------------------------------------- coordinated eviction tier
+
+    def evict_decisions(
+        self, instance: str, hashes: List[bytes]
+    ) -> List[Dict]:
+        """Per-hash verdicts for an instance about to drop blocks from its
+        coldest tier (the `/rpc/fabric/evict_offer` RPC):
+
+          * another instance still holds the block on ANY tier -> "drop"
+            (a replica survives; the offerer's removal is just an index
+            retraction carried by its next heartbeat);
+          * this is the last fleet replica AND an under-utilized peer
+            exists -> "send" + {peer, addr} (the offerer POSTs the block
+            to the peer's /kv/import; the peer's heartbeat re-indexes it);
+          * last replica but no peer has headroom -> "drop" (the block
+            dies fleet-wide — it was cold everywhere).
+        """
+        peer_name, peer_addr = "", ""
+        if self.enabled():
+            peer_name, peer_addr = self._pick_evict_peer(instance)
+        out: List[Dict] = []
+        for h in hashes:
+            loc = self._kvcache_mgr.lookup(h)
+            others = (
+                (loc.hbm_instance_set | loc.dram_instance_set
+                 | loc.ssd_instance_set) - {instance}
+            )
+            if others or not peer_name:
+                out.append({"action": "drop"})
+                with self._mu:
+                    self.evict_drops += 1
+            else:
+                out.append(
+                    {"action": "send", "peer": peer_name, "addr": peer_addr}
+                )
+                with self._mu:
+                    self.evict_sends += 1
+        return out
+
+    def _pick_evict_peer(self, exclude: str):
+        """Least-KV-loaded routable peer with headroom, or ("", "")."""
+        load = self._instance_mgr.get_load_metrics()
+        candidates = [
+            n
+            for n in set(
+                self._instance_mgr.routable_prefill_instances()
+                + self._instance_mgr.routable_decode_instances()
+            )
+            if n != exclude
+        ]
+        best, best_usage = "", PEER_USAGE_CEILING
+        for n in candidates:
+            m = load.get(n)
+            usage = m.gpu_cache_usage_perc if m is not None else 0.0
+            if usage < best_usage:
+                best, best_usage = n, usage
+        if not best:
+            return "", ""
+        meta = self._instance_mgr.get_instance(best)
+        if meta is None:
+            return "", ""
+        return best, meta.http_address

@@ -98,6 +98,12 @@ class ServiceConfig:
     # master's requests can never leak KV (docs/FAULT_TOLERANCE.md).
     reconcile_orphan_ttl_s: float = 10.0
 
+    # Fleet-wide prefix KV fabric (docs/KV_CACHE.md): fetch-aware dispatch
+    # hints, fetch-cost-adjusted CAR scoring, and coordinated multi-tier
+    # eviction. The env var XLLM_PREFIX_FABRIC=1|0 overrides this field
+    # either way (read per call, so the hatch flips on a live cluster).
+    enable_prefix_fabric: bool = True
+
     # Tokenizer / template (reference: --tokenizer_path).
     tokenizer_path: str = ""
 
@@ -254,6 +260,12 @@ class EngineConfig:
     # XLLM_PD_STREAMING=1|0 overrides this field either way (the escape
     # hatch is read per request, so it can flip on a live instance).
     enable_pd_streaming: bool = True
+
+    # Fleet-wide prefix KV fabric, instance side (docs/KV_CACHE.md): serve
+    # peer /kv/fetch requests, act on dispatch fetch hints, and offer
+    # last-replica evictions to the master's coordinator. The env var
+    # XLLM_PREFIX_FABRIC=1|0 overrides either way, per request.
+    enable_prefix_fabric: bool = True
 
     # Cross-PROCESS device-to-device KV data plane
     # (jax.experimental.transfer). When enabled, PD handoffs to a peer in

@@ -185,6 +185,17 @@ class BlockManager:
         """Block id currently committed under this hash, if any."""
         return self._hash_to_block.get(block_hash)
 
+    def committed_hashes(self) -> List[bytes]:
+        """Every committed hash (reconcile manifests / cache resync).
+        Racy off-thread read by design — callers tolerate one-beat drift;
+        the retry only guards resize-during-iteration."""
+        for _ in range(3):
+            try:
+                return list(self._hash_to_block)
+            except RuntimeError:
+                continue
+        return []
+
     def match_prefix(
         self,
         token_ids: Sequence[int],

@@ -20,7 +20,7 @@ import math
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Deque, Dict, List, Optional, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -174,7 +174,7 @@ class _Seq:
         "prefilled", "chunk_len", "prefill_start_time", "head_hash",
         "json_state", "json_upto", "schema_spec",
         "rope_pos3", "rope_delta", "admit_gen", "streamed_blocks",
-        "stream_hashes",
+        "stream_hashes", "admit_hashes",
     )
 
     def __init__(self, req: EngineRequest, slot: int):
@@ -215,6 +215,13 @@ class _Seq:
         # chained block hashes, extended incrementally per chunk.
         self.streamed_blocks = 0
         self.stream_hashes: List[bytes] = []
+        # Admission-time chained hashes of the prompt's full blocks: the
+        # mid-prefill re-match (_extend_midchunk_match) walks them at every
+        # chunk boundary so blocks that land DURING chunked prefill — a
+        # fabric peer fetch, a streamed PD chunk, another sequence's
+        # commit — are adopted instead of recomputed. Empty for
+        # media/LoRA requests (they bypass the cache).
+        self.admit_hashes: List[bytes] = []
         # Bumped by _slot_admit: distinguishes a re-admission of the SAME
         # sequence object from the occupancy an in-flight step sampled for
         # (preempt + same-pass resume into the same slot must not let the
@@ -300,6 +307,17 @@ class InferenceEngine:
         self._pending_kv_chunks: Deque[Tuple[List[bytes], object]] = (
             collections.deque()
         )
+        # Prefix-fabric export requests (peer /kv/fetch): served on the
+        # engine thread — the block manager and host/SSD pools are
+        # engine-thread-only, and an off-thread export could read a block
+        # mid-eviction. Each entry: {"hashes", "event", "result"}.
+        self._pending_exports: Deque[dict] = collections.deque()
+        # Prefix-fabric coordinated eviction hook: called on the engine
+        # thread as on_cold_evict(block_hash, host_kv) when a committed
+        # block is about to leave the LAST local tier (host-pool eviction
+        # with no SSD tier below it). Must never block — the instance
+        # layer enqueues the offer and returns.
+        self.on_cold_evict = None
         self._running: Dict[int, _Seq] = {}  # slot -> seq
         self._free_slots = list(range(self.R - 1, -1, -1))
         self._lock = threading.Lock()
@@ -412,6 +430,10 @@ class InferenceEngine:
         # Prefix-cache effectiveness over fresh admissions (bench/metrics).
         self.prefix_cached_tokens = 0
         self.prefix_prompt_tokens = 0
+        # Blocks adopted by the mid-prefill re-match (chunk-boundary cache
+        # pickup of blocks that landed AFTER admission — fabric fetches,
+        # streamed PD chunks, sibling commits).
+        self.midprefill_adopted_blocks = 0
         # Recompute-preemption accounting (any cause: pool pressure,
         # hybrid-scheduling eviction).
         self.preemptions = 0
@@ -489,6 +511,12 @@ class InferenceEngine:
             "xllm_engine_prefix_prompt_tokens_total",
             "Prompt tokens eligible for prefix-cache matching",
         ).set_function(lambda: self.prefix_prompt_tokens)
+        self.metrics.counter(
+            "xllm_engine_midprefill_rematch_blocks_total",
+            "KV blocks adopted at a chunk boundary after landing "
+            "mid-prefill (fabric fetches, streamed PD chunks, sibling "
+            "commits)",
+        ).set_function(lambda: self.midprefill_adopted_blocks)
         # NO waiting-depth / KV-usage gauges here: the instance front door
         # already exports those via get_load_metrics (they would duplicate
         # xllm_engine_waiting_requests / xllm_engine_kv_cache_usage in the
@@ -541,6 +569,7 @@ class InferenceEngine:
             or self._running
             or self._pending_imports
             or self._pending_kv_chunks
+            or self._pending_exports
             or self._inflight is not None
         )
 
@@ -580,21 +609,31 @@ class InferenceEngine:
         return self.block_mgr.take_cache_event()
 
     def cache_snapshot(self) -> list:
-        """Every committed prefix-cache block hash, for the takeover
-        reconciliation manifest (POST /reconcile). Racy read by design:
-        the engine thread owns the block manager, and a hash that commits
-        or evicts mid-snapshot merely drifts the new master's index by
-        one heartbeat — the retry only guards the rare resize-during-
-        iteration RuntimeError."""
-        table = getattr(self.block_mgr, "_hash_to_block", None)
-        if table is None:
-            return []
-        for _ in range(3):
-            try:
-                return list(table)
-            except RuntimeError:
+        """Every committed prefix-cache block hash — the takeover
+        reconciliation manifest (POST /reconcile) and the fabric's
+        post-ejection heartbeat cache resync. Racy read by design: a hash
+        that commits or evicts mid-snapshot merely drifts the master's
+        index by one heartbeat (both block managers retry the rare
+        resize-during-iteration internally)."""
+        fn = getattr(self.block_mgr, "committed_hashes", None)
+        return fn() if callable(fn) else []
+
+    def cache_snapshot_event(self) -> KvCacheEvent:
+        """Full-tier cache snapshot as a KvCacheEvent — the heartbeat
+        cache RESYNC payload after the master pruned this instance's
+        index locations (breaker ejection): HBM commits as stored, host/
+        SSD holdings as offload entries, so every tier's locations
+        rebuild, not just the hot one. Racy off-thread reads like
+        cache_snapshot: one-beat drift is the contract."""
+        stored = set(self.cache_snapshot())
+        offload: Dict[bytes, str] = {}
+        for pool, tier in ((self.host_pool, "dram"), (self.ssd_pool, "ssd")):
+            if pool is None:
                 continue
-        return []
+            for h in pool.hashes():
+                if h not in stored and h not in offload:
+                    offload[h] = tier
+        return KvCacheEvent(stored_cache=stored, offload_cache=offload)
 
     def profiling_data(self):
         return list(self._profile_ttft), list(self._profile_tpot)
@@ -637,6 +676,7 @@ class InferenceEngine:
         if not self._running and self._inflight is None:
             self._t_host_free = None  # idle time is not a host gap
         self._drain_imports()
+        self._drain_export_requests()
         self._drain_cancelled()
         self._maybe_flush_schema_rows()
         admitted = self._admit()
@@ -725,6 +765,12 @@ class InferenceEngine:
             for x in midchunk:
                 self._waiting.remove(x)
         for seq in midchunk:
+            # Mid-prefill re-match: blocks that landed since the last
+            # chunk (a fabric peer fetch racing this prefill, a streamed
+            # PD chunk, a sibling's commit) are adopted at the chunk
+            # boundary — the remaining tail shrinks instead of
+            # recomputing KV the cache now holds.
+            self._extend_midchunk_match(seq)
             chunk = min(len(seq.tokens) - seq.prefilled, max(budget, 1))
             budget -= chunk
             seq.chunk_len = chunk
@@ -892,6 +938,7 @@ class InferenceEngine:
             seq.prefilled = seq.num_cached
             seq.chunk_len = min(len(seq.tokens) - seq.prefilled, budget)
             seq.head_hash = hashes[0] if hashes else None
+            seq.admit_hashes = hashes  # mid-prefill re-match walks these
             budget -= seq.chunk_len
             pending_hashes.update(hashes)
             batch.append(seq)
@@ -1163,8 +1210,18 @@ class InferenceEngine:
     def _demote_to_ssd(self, block_hash: bytes, kv: np.ndarray) -> None:
         """DRAM eviction lands on disk when the SSD tier is enabled
         (dram->ssd transition, reference proto:47); otherwise the hash is
-        gone from this instance."""
+        gone from this instance — the fabric's coordinated-eviction hook
+        gets one last look at the host array (offer the block to an
+        under-utilized peer) before the local drop is recorded."""
         if self.ssd_pool is None:
+            hook = self.on_cold_evict
+            if hook is not None:
+                try:
+                    hook(block_hash, kv)
+                except Exception:
+                    logging.getLogger(__name__).exception(
+                        "on_cold_evict hook failed; block drops locally"
+                    )
             self.block_mgr.record_host_removed(block_hash)
             return
         for dropped in self.ssd_pool.put(block_hash, kv):
@@ -1208,6 +1265,118 @@ class InferenceEngine:
         for bid, (h, _) in zip(ids, run):
             self.block_mgr.commit_block(bid, h)
         return num_cached + len(run) * self.block_size, cached_blocks + ids
+
+    # ------------------------------------------------- prefix KV fabric
+
+    def _extend_midchunk_match(self, seq: _Seq) -> None:
+        """Chunk-boundary cache pickup: if the NEXT un-prefilled blocks'
+        hashes are now committed locally (they landed after admission —
+        a fabric peer fetch, a streamed PD chunk, a sibling sequence's
+        commit), swap the sequence's fresh blocks for the cached ones and
+        advance `prefilled` past them. This is what makes a peer fetch
+        genuinely OVERLAP chunked prefill of the uncovered tail: each
+        chunk boundary re-checks, so blocks that arrive mid-prefill are
+        adopted instead of recomputed. Only runs on block-aligned
+        boundaries; `last_committed_block` is left alone so the normal
+        commit walk still registers this sequence's own chunks."""
+        hashes = seq.admit_hashes
+        bs = self.block_size
+        if (
+            not hashes
+            or seq.prefilled % bs
+            or seq.req.has_media
+            or seq.req.adapter_idx
+        ):
+            return
+        idx = seq.prefilled // bs
+        adopted = 0
+        while idx < len(hashes) and idx < len(seq.block_ids):
+            bid = self.block_mgr.lookup_hash(hashes[idx])
+            if bid is None or bid == seq.block_ids[idx]:
+                break
+            # Swap: take a cache reference on the committed block, release
+            # this seq's never-written fresh block back to the pool.
+            old = seq.block_ids[idx]
+            self.block_mgr.acquire_cached(bid)
+            self.block_mgr.free([old])
+            seq.block_ids[idx] = bid
+            seq.prefilled += bs
+            adopted += 1
+            idx += 1
+        if adopted:
+            self.prefix_cached_tokens += adopted * bs
+            self.midprefill_adopted_blocks += adopted
+
+    def export_cached_blocks(
+        self, hashes: List[bytes], timeout: float = 10.0
+    ) -> Tuple[List[bytes], Optional[np.ndarray]]:
+        """Serve a peer's prefix fetch: export the KV of every requested
+        hash this instance holds on ANY tier. Thread-safe entry (HTTP
+        serving thread); the export itself runs on the engine thread —
+        the block manager and host/SSD pools are engine-thread-only, and
+        an off-thread device export could read a block mid-eviction.
+        Returns (served_hashes, kv [2, L, n, Hkv, BS, D]) with kv a HOST
+        array, or ([], None) on timeout / nothing held."""
+        job = {
+            "hashes": [bytes(h) for h in hashes],
+            "event": threading.Event(),
+            "result": ([], None),
+        }
+        with self._lock:
+            self._pending_exports.append(job)
+        self._work.set()
+        if not job["event"].wait(timeout):
+            return [], None
+        return job["result"]
+
+    def _drain_export_requests(self) -> None:
+        while True:
+            with self._lock:
+                if not self._pending_exports:
+                    return
+                job = self._pending_exports.popleft()
+            try:
+                job["result"] = self._export_cached(job["hashes"])
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "prefix-fabric block export failed; peer recomputes"
+                )
+                job["result"] = ([], None)
+            finally:
+                job["event"].set()
+
+    def _export_cached(self, hashes: List[bytes]):
+        """Engine-thread export body: HBM blocks gather in ONE device
+        export; host/SSD blocks read from their pools. Requested order is
+        preserved in the stacked result."""
+        served: List[bytes] = []
+        seen: Set[bytes] = set()
+        arrays: Dict[bytes, np.ndarray] = {}
+        hbm: List[Tuple[bytes, int]] = []
+        for h in hashes:
+            if h in seen:
+                continue  # duplicate hash in the request
+            seen.add(h)
+            bid = self.block_mgr.lookup_hash(h)
+            if bid is not None:
+                hbm.append((h, bid))
+                served.append(h)
+                continue
+            kv = self.host_pool.get(h) if self.host_pool is not None else None
+            if kv is None and self.ssd_pool is not None:
+                kv = self.ssd_pool.get(h)
+            if kv is not None:
+                arrays[h] = np.asarray(kv)
+                served.append(h)
+        if hbm:
+            stacked = np.asarray(
+                self.executor.export_blocks([b for _, b in hbm])
+            )
+            for i, (h, _) in enumerate(hbm):
+                arrays[h] = stacked[:, :, i]
+        if not served:
+            return [], None
+        return served, np.stack([arrays[h] for h in served], axis=2)
 
     # ------------------------------------------------- PD disaggregation
 

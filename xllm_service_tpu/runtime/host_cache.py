@@ -46,6 +46,17 @@ class HostKVPool:
     def __contains__(self, block_hash: bytes) -> bool:
         return block_hash in self._data
 
+    def hashes(self) -> List[bytes]:
+        """Every held hash (fabric cache-resync snapshots). Racy
+        off-thread read by design — callers tolerate one-beat drift; the
+        retry only guards resize-during-iteration."""
+        for _ in range(3):
+            try:
+                return list(self._data)
+            except RuntimeError:
+                continue
+        return []
+
     def get(self, block_hash: bytes) -> Optional[np.ndarray]:
         kv = self._data.get(block_hash)
         if kv is not None:
@@ -107,6 +118,16 @@ class SsdKVPool:
 
     def __contains__(self, block_hash: bytes) -> bool:
         return block_hash in self._index
+
+    def hashes(self) -> List[bytes]:
+        """Every held hash (fabric cache-resync snapshots); same racy-read
+        contract as HostKVPool.hashes."""
+        for _ in range(3):
+            try:
+                return list(self._index)
+            except RuntimeError:
+                continue
+        return []
 
     def _path(self, block_hash: bytes) -> str:
         return os.path.join(self.dir, block_hash.hex() + ".kv")

@@ -128,6 +128,13 @@ class NativeBlockManager:
         self.on_evict: Optional[
             Callable[[List[Tuple[int, bytes]]], Sequence[bytes]]
         ] = None
+        # Python-side mirror of the committed-hash set: the C store owns
+        # the authoritative index but exposes no iteration, and the
+        # committed snapshot feeds takeover reconciliation and the
+        # fabric's post-ejection cache resync (engine.cache_snapshot).
+        # Maintained on the engine thread: commit_block adds, the
+        # allocate() eviction report removes.
+        self._committed: set = set()
 
     def __del__(self):
         store, self._store = getattr(self, "_store", None), None
@@ -182,6 +189,7 @@ class NativeBlockManager:
                 self._lib.xbs_record_evicted(
                     self._store, h, 0 if h in saved else -1
                 )
+                self._committed.discard(h)
         return [int(out[i]) for i in range(n)]
 
     def acquire_cached(self, block_id: int) -> None:
@@ -202,6 +210,20 @@ class NativeBlockManager:
 
     def commit_block(self, block_id: int, block_hash: bytes) -> None:
         self._lib.xbs_commit(self._store, block_id, _check_hash(block_hash))
+        # Mirror add is correct even when the C side no-ops a duplicate
+        # commit: the hash IS committed (under the earlier block).
+        self._committed.add(block_hash)
+
+    def committed_hashes(self) -> List[bytes]:
+        """Every committed hash (reconcile manifests / cache resync).
+        Racy off-thread read by design — callers tolerate one-beat drift;
+        the retry only guards resize-during-iteration."""
+        for _ in range(3):
+            try:
+                return list(self._committed)
+            except RuntimeError:
+                continue
+        return []
 
     def lookup_hash(self, block_hash: bytes) -> Optional[int]:
         if not isinstance(block_hash, bytes) or len(block_hash) != 16:

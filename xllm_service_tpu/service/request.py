@@ -62,6 +62,10 @@ class ServiceRequest:
     # Filled by the scheduler:
     num_generated_tokens: int = 0
     estimated_ttft_ms: float = 0.0
+    # Prefix-fabric fetch hint for the routed prefill instance (empty =
+    # no fetch planned): {holder, addr, blocks, total_blocks} — the peer
+    # holding the fleet-best prefix match (docs/KV_CACHE.md).
+    kv_fabric: Dict[str, Any] = field(default_factory=dict)
     # Mid-stream failover (docs/FAULT_TOLERANCE.md). `wire_srid` is the
     # on-the-wire service_request_id for the CURRENT dispatch attempt —
     # the bare id for attempt 0, `<id>#rN` after N replays; outputs

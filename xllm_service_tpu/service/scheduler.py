@@ -31,8 +31,9 @@ from typing import Callable, Deque, Dict, List, Optional
 import numpy as np
 
 from xllm_service_tpu.cluster.global_kvcache_mgr import GlobalKVCacheMgr
-from xllm_service_tpu.cluster.instance_mgr import InstanceMgr
+from xllm_service_tpu.cluster.instance_mgr import HealthState, InstanceMgr
 from xllm_service_tpu.cluster.policies import LoadBalancePolicy, make_policy
+from xllm_service_tpu.cluster.prefix_fabric import PrefixFabric
 from xllm_service_tpu.common.config import ServiceConfig
 from xllm_service_tpu.common.types import (
     FinishReason,
@@ -291,12 +292,20 @@ class Scheduler:
             block_size=config.block_size,
             murmur_hash3_seed=config.murmur_hash3_seed,
         )
+        # Fleet-wide prefix KV fabric (cluster/prefix_fabric.py): fetch
+        # hints at dispatch, fetch-cost-adjusted CAR scoring, and the
+        # coordinated-eviction decisions behind /rpc/fabric/evict_offer.
+        self.prefix_fabric = PrefixFabric(
+            config, self._instance_mgr, self._kvcache_mgr,
+            metrics=self.metrics,
+        )
         self._policy: LoadBalancePolicy = make_policy(
             config.load_balance_policy,
             self._instance_mgr,
             self._kvcache_mgr,
             target_ttft_ms=config.target_ttft_ms,
             target_tpot_ms=config.target_tpot_ms,
+            fabric=self.prefix_fabric,
         )
         self._response_handler = ResponseHandler()
         self._streams = OrderedStreams(config.num_ordered_output_streams)
@@ -307,6 +316,15 @@ class Scheduler:
         self._instance_mgr.add_removal_listener(
             self._kvcache_mgr.remove_instance
         )
+        # Stale-location pruning: an EJECTED instance's KV-index locations
+        # would otherwise linger until lease expiry, letting cache-aware
+        # routing (and the fabric's fetch planner) score phantom hits on
+        # an unroutable peer. Deregistration/prune is covered by the
+        # removal listener above; this covers the breaker path. Pruned
+        # instances are flagged for a full cache resync on their next
+        # heartbeat (deltas cannot rebuild dropped locations).
+        self._cache_resync_needed: set = set()
+        self._instance_mgr.add_health_listener(self._on_instance_health)
         self.max_redispatch = getattr(config, "max_redispatch", 2)
         # Cluster-lifetime fault accounting (aggregated /metrics +
         # bench_serving's fault-injection report).
@@ -733,7 +751,26 @@ class Scheduler:
                 prompt_tokens=len(request.token_ids),
             )
 
-        request.routing = self._policy.select_instances_pair(request.token_ids)
+        # ONE index match per request, shared by the routing policy and
+        # the fabric's fetch planner/gauge below — the chained hashing +
+        # locked index walk must not run twice on the hot path, and must
+        # not run AT ALL when nobody consumes it (RR/SLO routing with the
+        # fabric disabled: those fleets never hashed prompts before, and
+        # the hit-rate gauge is meaningless with both consumers off).
+        # Media prompts bypass the cache (embedding-dependent KV).
+        from xllm_service_tpu.cluster.policies import CacheAwareRouting
+
+        want_scores = not request.media_parts and (
+            isinstance(self._policy, CacheAwareRouting)
+            or self.prefix_fabric.enabled()
+        )
+        scores = (
+            self._kvcache_mgr.match(request.token_ids)
+            if want_scores else None
+        )
+        request.routing = self._policy.select_instances_pair(
+            request.token_ids, scores=scores
+        )
         if not request.routing.prefill_name and not request.routing.decode_name:
             return Status(StatusCode.UNAVAILABLE, "no instances registered")
         if request.media_parts:
@@ -752,6 +789,25 @@ class Scheduler:
                     f"media request needs an ENCODE instance serving "
                     f"{sorted(required)}; none registered covers it",
                 )
+        if scores is not None:
+            # Prefix-fabric fetch hint (docs/KV_CACHE.md): when the fleet
+            # best match beats the routed instance's, name the holder so
+            # the instance can pull the gap instead of recomputing it.
+            # On cache-aware fleets plan_fetch also runs fabric-OFF: it
+            # feeds the fleet-hit-rate gauge either way (no hint when
+            # disabled), so flipping the hatch for an A/B never
+            # flatlines the gauge.
+            try:
+                request.kv_fabric = (
+                    self.prefix_fabric.plan_fetch(
+                        request.token_ids, request.routing.prefill_name,
+                        scores=scores,
+                    )
+                    or {}
+                )
+            except Exception:
+                logger.exception("fabric fetch planning failed")
+                request.kv_fabric = {}
         pred = self._instance_mgr.get_time_predictor(request.routing.prefill_name)
         if pred is not None and pred.has_ttft_model:
             request.estimated_ttft_ms = pred.predict_ttft(len(request.token_ids))
@@ -1432,6 +1488,36 @@ class Scheduler:
     # fault handling: interrupted-request re-dispatch
     # ------------------------------------------------------------------ #
 
+    def _on_instance_health(self, name: str, state: str) -> None:
+        """Breaker transition: ejection retracts the instance's KV-index
+        locations so routing/fetch planning stop scoring phantom hits.
+        Heartbeats carry DELTAS, so the prune also flags the instance for
+        a full cache resync — the next heartbeat response asks it to fold
+        its committed-block snapshot into a stored delta, rebuilding the
+        index once the instance is reachable again."""
+        if state == HealthState.EJECTED:
+            self._kvcache_mgr.remove_instance(name)
+            with self._mu:
+                self._cache_resync_needed.add(name)
+
+    def take_cache_resync(self, name: str) -> bool:
+        """Pop the pending cache-resync flag for one instance (called by
+        the master's heartbeat handler; the flag rides the response).
+        The flag stays armed WHILE the instance remains ejected — a
+        partitioned instance whose beats still arrive must not re-index
+        blocks nobody can fetch (evict_decisions would count them as live
+        replicas and let the real last copy die). Best-effort thereafter:
+        a lost response re-flags only on the next ejection, which is also
+        the only path that loses index state."""
+        with self._mu:
+            if name not in self._cache_resync_needed:
+                return False
+        if self._instance_mgr.health_state(name) == HealthState.EJECTED:
+            return False  # keep armed until the breaker re-admits it
+        with self._mu:
+            self._cache_resync_needed.discard(name)
+        return True
+
     def _on_instance_removed(self, name: str) -> None:
         """An instance left the registry (lease expiry / prune). Requests
         routed to it that have produced NO tokens yet are re-routed and
@@ -1702,7 +1788,18 @@ class Scheduler:
     ) -> None:
         """(reference: scheduler.cpp:123-130)"""
         if cache_event is not None and not cache_event.empty():
-            self._kvcache_mgr.record_updated_kvcaches(name, cache_event)
+            # Breaker gate: an EJECTED instance's beats may still arrive
+            # (asymmetric partition), but its cache deltas must not
+            # re-index blocks nobody can fetch — evict_decisions would
+            # count them as live replicas and let the real last copy die.
+            # Its locations were pruned at ejection; the armed cache
+            # resync rebuilds them (all tiers) once the breaker re-admits
+            # it, so dropping deltas here loses nothing.
+            if (
+                self._instance_mgr.health_state(name)
+                != HealthState.EJECTED
+            ):
+                self._kvcache_mgr.record_updated_kvcaches(name, cache_event)
         if load_metrics is not None:
             self._instance_mgr.record_load_metrics_update(name, load_metrics)
         if latency_metrics is not None:
