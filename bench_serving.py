@@ -690,6 +690,251 @@ def run_prefix_trace_bench(args) -> None:
         sys.exit(3)
 
 
+def run_mm_trace_bench(args) -> None:
+    """Encoder-fabric bench (--mm-trace): a multi-turn re-sent-media
+    chat trace against REAL towers + a real LM engine (docs/EPD.md).
+
+    --mm-sessions concurrent conversations each carry ONE image; every
+    conversation re-sends its image on each of --mm-turns turns (the
+    multi-turn chat shape where the same attachment rides every request).
+    Turn 1 is a cold burst — same-kind items from different requests
+    coalesce in the encoder micro-batcher; later turns are embedding-
+    cache hits that skip the towers entirely.
+
+    Reported: embedding cache hit rate, mean encoder batch occupancy,
+    stage-E-overlap fraction (share of the embedding wait hidden behind
+    an already-admitted text prefill), per-turn wall times, failed
+    requests. Exit 3 when the fabric is inert on a workload built for
+    it: 0 cache hits on the re-sent turns, mean occupancy <= 1 on the
+    burst, any failed request, or no streamed sessions at all.
+    """
+    import sys
+
+    import numpy as np
+
+    from xllm_service_tpu.api import Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+
+    n_sessions = max(args.mm_sessions, 2)
+    n_turns = max(args.mm_turns, 2)
+    n_encoders = max(args.mm_encoders, 1)
+
+    store = MemoryStore(clock=lambda: 0.0)
+    master = Master(
+        ServiceConfig(
+            host="127.0.0.1", http_port=0, rpc_port=0,
+            heartbeat_interval_s=0.25, master_lease_ttl_s=5.0,
+            load_balance_policy="RR", block_size=16,
+            mm_tokens_per_media=4,  # == vit-tiny out_tokens
+        ),
+        store=store,
+    )
+    master.start()
+    lm = InstanceServer(
+        EngineConfig(
+            model="llama3-tiny", dtype="float32", block_size=16,
+            num_blocks=256, max_running_requests=16, max_seq_len=256,
+            prefill_buckets=[64, 128], instance_name="mm-lm",
+            instance_type="MIX",
+            compilation_cache_dir="/tmp/xllm-jit-cache",
+        ),
+        master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.25,
+    )
+    lm.start()
+    encoders = []
+    for i in range(n_encoders):
+        enc = InstanceServer(
+            EngineConfig(
+                model="vit-tiny", instance_name=f"mm-enc{i}",
+                instance_type="ENCODE",
+                # A wider admission window makes burst coalescing
+                # deterministic at bench scale.
+                encoder_batch_window_ms=25.0,
+            ),
+            master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.25,
+        )
+        enc.start()
+        encoders.append(enc)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        c = master.scheduler.instance_mgr.counts()
+        if c[2] == n_encoders and sum(c) == 1 + n_encoders:
+            break
+        time.sleep(0.05)
+
+    rng = np.random.default_rng(args.seed)
+    imgs = [
+        rng.random((32, 32, 3)).astype(np.float32)
+        for _ in range(n_sessions)
+    ]
+
+    import base64 as _b64
+    import http.client
+
+    def one_request(img, out: dict):
+        t0 = time.monotonic()
+        url = (
+            "data:application/x-raw-f32;shape=32x32x3;base64,"
+            + _b64.b64encode(np.ascontiguousarray(img).tobytes()).decode()
+        )
+        try:
+            host, _, port = master.http_address.partition(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=300.0)
+            conn.request(
+                "POST", "/v1/chat/completions",
+                body=json.dumps({
+                    "model": "llama3-tiny",
+                    "messages": [{
+                        "role": "user",
+                        "content": [
+                            {"type": "text", "text": "describe "},
+                            {"type": "image_url", "image_url": {"url": url}},
+                        ],
+                    }],
+                    "max_tokens": 4,
+                    "temperature": 0.0,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                out["err"] = f"HTTP {resp.status}: {body[:120]!r}"
+            else:
+                out["latency_s"] = time.monotonic() - t0
+                out["text"] = json.loads(body)["choices"][0]["message"][
+                    "content"
+                ]
+            conn.close()
+        except Exception as e:  # noqa: BLE001
+            out["err"] = repr(e)
+
+    # Warm the compiles off-measurement (one request pays the LM + tower
+    # jit; the trace then measures serving, not compilation).
+    warm = {}
+    one_request(imgs[0], warm)
+    for e in encoders:
+        e.engine.emb_cache.hits = 0
+        e.engine.emb_cache.misses = 0
+
+    def enc_counter(name):
+        # Batcher/cache series live on the ENGINE registry, session
+        # series on the instance front-door registry — check both.
+        total = 0
+        for e in encoders:
+            m = e.engine.metrics.get(name) or e.metrics.get(name)
+            if m is not None:
+                total += int(m.get())
+        return total
+
+    occ0_items = enc_counter("xllm_encoder_batched_items_total")
+    occ0_batches = enc_counter("xllm_encoder_batches_total")
+
+    turns = []
+    results_all = []
+    texts_by_session = [[] for _ in range(n_sessions)]
+    for turn in range(n_turns):
+        results = [dict() for _ in range(n_sessions)]
+        threads = [
+            threading.Thread(target=one_request, args=(imgs[i], results[i]))
+            for i in range(n_sessions)
+        ]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600.0)
+        wall = time.monotonic() - t0
+        for i, r in enumerate(results):
+            if "text" in r:
+                texts_by_session[i].append(r["text"])
+        lat = [r["latency_s"] for r in results if "latency_s" in r]
+        turns.append({
+            "turn": turn,
+            "wall_s": round(wall, 3),
+            "mean_latency_ms": (
+                round(1000 * sum(lat) / len(lat), 1) if lat else None
+            ),
+        })
+        results_all.extend(results)
+
+    failed = sum(1 for r in results_all if "text" not in r)
+    errors = [r["err"] for r in results_all if "err" in r]
+    # A conversation's re-sent image must never change its answer.
+    divergent = sum(
+        1 for ts in texts_by_session if len(set(ts)) > 1
+    )
+    hits = sum(e.engine.emb_cache.hits for e in encoders)
+    misses = sum(e.engine.emb_cache.misses for e in encoders)
+    batches = enc_counter("xllm_encoder_batches_total") - occ0_batches
+    batched_items = (
+        enc_counter("xllm_encoder_batched_items_total") - occ0_items
+    )
+    occupancy = batched_items / batches if batches else 0.0
+    sessions_streamed = enc_counter("xllm_mm_stream_sessions_total")
+    aborts = enc_counter("xllm_mm_stream_aborts_total")
+    overlap = float(
+        lm.metrics.get("xllm_mm_stream_overlap_frac").get()
+    )
+    fleet_hit_rate = (
+        master.scheduler.encoder_fabric.fleet_hit_items
+        / max(master.scheduler.encoder_fabric.fleet_total_items, 1)
+    )
+
+    for e in encoders:
+        e.stop()
+    lm.stop()
+    master.stop()
+    store.close()
+
+    guard_ok = True
+    reasons = []
+    if failed or divergent:
+        guard_ok = False
+        reasons.append(
+            f"{failed} failed / {divergent} divergent requests on the "
+            "mm trace"
+        )
+    if hits <= 0:
+        guard_ok = False
+        reasons.append("0 embedding-cache hits on a re-sent-media trace")
+    if occupancy <= 1.0:
+        guard_ok = False
+        reasons.append(
+            f"mean encoder batch occupancy {occupancy:.2f} <= 1 "
+            "(cross-request batching inert)"
+        )
+    if sessions_streamed <= 0:
+        guard_ok = False
+        reasons.append("no streamed encoder->prefill sessions opened")
+
+    print(json.dumps({
+        "metric": "encoder_fabric_mm_trace",
+        "sessions": n_sessions,
+        "turns": n_turns,
+        "encoders": n_encoders,
+        "failed_requests": failed,
+        "divergent_conversations": divergent,
+        "embed_cache_hits": int(hits),
+        "embed_cache_misses": int(misses),
+        "embed_cache_hit_rate": round(hits / max(hits + misses, 1), 4),
+        "router_fleet_embed_hit_rate": round(fleet_hit_rate, 4),
+        "encoder_batches": int(batches),
+        "encoder_batched_items": int(batched_items),
+        "mean_batch_occupancy": round(occupancy, 2),
+        "streamed_sessions": int(sessions_streamed),
+        "stream_aborts": int(aborts),
+        "stage_e_overlap_frac": round(overlap, 4),
+        "per_turn": turns,
+        "error_sample": errors[0][:160] if errors else None,
+        "mm_trace_guard": "ok" if guard_ok else "; ".join(reasons),
+    }))
+    if not guard_ok:
+        sys.exit(3)
+
+
 def main() -> None:
     p = argparse.ArgumentParser("xllm-service-tpu burst bench")
     p.add_argument("--requests", type=int, default=64)
@@ -761,6 +1006,24 @@ def main() -> None:
         help="--prefix-trace: generated tokens per request",
     )
     p.add_argument(
+        "--mm-trace", action="store_true",
+        help="encoder-fabric bench: multi-turn re-sent-media chat trace "
+        "reporting encoder batch occupancy, embedding cache hit rate, "
+        "and stage-E-overlap fraction (exit 3 when the fabric is inert)",
+    )
+    p.add_argument(
+        "--mm-sessions", type=int, default=8,
+        help="--mm-trace: concurrent conversations (one image each)",
+    )
+    p.add_argument(
+        "--mm-turns", type=int, default=3,
+        help="--mm-trace: turns per conversation (each re-sends its image)",
+    )
+    p.add_argument(
+        "--mm-encoders", type=int, default=2,
+        help="--mm-trace: ENCODE instances in the stack",
+    )
+    p.add_argument(
         "--pd", action="store_true",
         help="PD handoff microbench: monolithic vs pipelined (streamed) "
         "KV handoff on a real-engine prefill+decode pair; reports "
@@ -798,7 +1061,10 @@ def main() -> None:
 
     import os
 
-    if not args.real_engine and not args.pd and not args.prefix_trace:
+    if (
+        not args.real_engine and not args.pd and not args.prefix_trace
+        and not args.mm_trace
+    ):
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
     plat = os.environ.get("JAX_PLATFORMS")
     if plat:
@@ -811,6 +1077,9 @@ def main() -> None:
         return
     if args.prefix_trace:
         run_prefix_trace_bench(args)
+        return
+    if args.mm_trace:
+        run_mm_trace_bench(args)
         return
 
     import numpy as np

@@ -52,6 +52,13 @@ REQUIRED_POINTS = {
     "kv_fetch.send",
     "kv_fetch.recv",
     "fabric.evict_offer",
+    # encoder fabric (docs/EPD.md): master->encoder dispatch (chaos =
+    # re-route to another encoder) and the streamed encoder->prefill
+    # handoff session (chaos MUST degrade to the monolithic /mm/import
+    # push, never to an error)
+    "encode.dispatch",
+    "mm_handoff.send",
+    "mm_handoff.recv",
 }
 
 

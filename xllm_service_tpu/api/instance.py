@@ -86,6 +86,7 @@ class InstanceServer(
                     model=engine_cfg.model,
                     checkpoint_path=engine_cfg.checkpoint_path,
                     dtype=engine_cfg.dtype,
+                    cfg=engine_cfg,
                 )
             else:
                 from xllm_service_tpu.runtime.engine import InferenceEngine
@@ -286,12 +287,10 @@ class InstanceServer(
         # prefill-instance address to relay generations through instead of
         # pushing to the master directly.
         self._relay_addrs: Dict[str, str] = {}
-        # EPD: media embeddings landed by the encoder stage, keyed by srid;
-        # the forwarded request waits on its event before admission.
-        # Values: (embeds, positions, arrival_ts) — TTL-reaped.
-        self._mm_imports: Dict[str, Tuple[Any, List[int], float]] = {}
-        self._mm_events: Dict[str, threading.Event] = {}
-        self._mm_mu = threading.Lock()
+        # EPD multimodal state + instruments (instance_mm mixin): the
+        # monolithic /mm/import landing table, the streamed-handoff
+        # session handles, and the reap/wait/overlap series.
+        self._init_mm()
         # srid -> set once a generations push carrying it was acked by the
         # master; the handoff sender waits on this so the decode peer's
         # tokens can never reach the master before the first token
@@ -834,6 +833,14 @@ class InstanceServer(
             self._handle_encode(h, body)
         elif route == "/mm/import":
             self._handle_mm_import(h, body)
+        elif route == "/mm/open":
+            self._handle_mm_open(h, body)
+        elif route == "/mm/chunk":
+            self._handle_mm_chunk(h, body)
+        elif route == "/mm/commit":
+            self._handle_mm_commit(h, body)
+        elif route == "/mm/abort":
+            self._handle_mm_abort(h, body)
         elif route == "/rpc/relay_generations":
             # Prefill side of the alternate PD response topology: forward
             # the decode peer's token batch to the master synchronously so

@@ -3,21 +3,266 @@
 Split from api/instance.py (round-3 de-monolith): the ENCODE-stage
 /encode entry, the prefill-side /mm/import landing + wait, and the
 /v1/embeddings handler. Mixed into InstanceServer; `self` is the server.
+
+Encoder fabric (docs/EPD.md): with `XLLM_ENCODER_FABRIC` on, the
+monolithic `/mm/import` push grows a per-item streaming session modeled
+on PR 5's `/kv/import` protocol —
+
+    /mm/open   {srid, items}            session open (epoch-fenced)
+    /mm/chunk  {srid, item, positions,  one media item's embedding rows,
+                count, dim, embeds}     landed as it finishes encoding
+    /mm/commit {srid, count}            all items delivered
+    /mm/abort  {srid, reason}           streaming failed; the MONOLITHIC
+                                        /mm/import push follows (fallback)
+
+— so the prefill peer admits the text request immediately and its engine
+prefills text chunks WHILE embeddings stream in, adopting landed items
+at chunk boundaries (runtime/engine.py mm_stream gating). Chunk sends
+ride the instance's dedicated bounded stream lane (`_stream_q`); a
+saturated lane or any send failure aborts the session and degrades to
+the monolithic push — never to an error.
 """
 
 from __future__ import annotations
 
+import logging
+import queue
 import threading
 import time
-from typing import Any, Dict
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from xllm_service_tpu.api.http_utils import HttpJsonApi, post_json
+from xllm_service_tpu.common import faults
+
+logger = logging.getLogger("xllm_service_tpu.api.instance")
+
+
+def _encoder_fabric_enabled(cfg) -> bool:
+    """Instance-side escape hatch, read per request so it can flip on a
+    live instance (mirrors _pd_streaming_enabled in instance_kv.py).
+    One implementation fleet-wide: master and instance must agree on
+    the hatch semantics or the wire protocol splits."""
+    from xllm_service_tpu.cluster.encoder_fabric import (
+        encoder_fabric_enabled,
+    )
+
+    return encoder_fabric_enabled(cfg)
+
+
+class MMStreamHandle:
+    """Prefill-side assembly of one request's streamed media embeddings.
+
+    Created at forwarded-request admission (the master forwards the text
+    request BEFORE dispatching the encoder when the fabric is on); fed by
+    `/mm/chunk` landings — or by a monolithic `/mm/import` push, which is
+    both the legacy path and the abort fallback — and consumed by the
+    engine at every prefill chunk boundary (`ready_upto`/`assembled`).
+    An abort is ADVISORY: the encoder falls back to the monolithic push,
+    so only the deadline fails a request whose stream died."""
+
+    def __init__(
+        self,
+        srid: str,
+        expected_positions: List[int],
+        deadline_s: float = 180.0,
+        on_update=None,
+        on_complete=None,
+    ):
+        self.srid = srid
+        self._expected = sorted(int(p) for p in expected_positions)
+        self._expected_set = set(self._expected)
+        self._mu = threading.Lock()
+        self._covered: set = set()
+        self._items: List[Tuple[List[int], np.ndarray]] = []
+        self.created_ts = time.monotonic()
+        self.admitted_ts: Optional[float] = None
+        self.complete_ts: Optional[float] = None
+        self._deadline = self.created_ts + max(float(deadline_s), 1.0)
+        self._failed_msg = ""
+        self._complete = False
+        self._on_update = on_update
+        self._on_complete = on_complete
+
+    def land(self, positions: List[int], embeds: np.ndarray) -> None:
+        """One item's rows (positions pair 1:1 with embedding rows).
+        Idempotent: a fully re-landed item (master re-dispatch, abort
+        fallback after partial streaming) is dropped silently."""
+        done = None
+        with self._mu:
+            if self._complete:
+                return
+            pos = [int(p) for p in positions]
+            if set(pos) <= self._covered:
+                return  # idempotent re-land
+            if (
+                len(pos) != int(embeds.shape[0])
+                or not set(pos) <= self._expected_set
+            ):
+                # Encoder and service disagree on media-token layout —
+                # fail rather than pair mismatched arrays (an embeds/
+                # positions desync would crash the engine step).
+                self._failed_msg = (
+                    f"media chunk desync: {len(pos)} positions vs "
+                    f"{int(embeds.shape[0])} rows (or positions outside "
+                    "the request's placeholders)"
+                )
+            else:
+                emb = np.asarray(embeds, np.float32)
+                fresh = [
+                    i for i, p in enumerate(pos) if p not in self._covered
+                ]
+                if len(fresh) != len(pos):
+                    # Partial overlap — a monolithic fallback landing
+                    # after SOME items already streamed. Keep only the
+                    # uncovered rows: appending wholesale would put the
+                    # overlapped positions into assembled() twice, and
+                    # duplicate mm_positions desync the mrope span/grid
+                    # walk (and inflate the executor's media bucket).
+                    pos = [pos[i] for i in fresh]
+                    emb = emb[fresh]
+                self._items.append((pos, emb))
+                self._covered.update(pos)
+                if self._covered == self._expected_set:
+                    self._complete = True
+                    self.complete_ts = time.monotonic()
+                    done = self._on_complete
+        if done is not None:
+            try:
+                done(self)
+            except Exception:
+                pass
+        if self._on_update is not None:
+            self._on_update()
+
+    def fail(self, msg: str) -> None:
+        with self._mu:
+            if not self._complete and not self._failed_msg:
+                self._failed_msg = msg
+        if self._on_update is not None:
+            self._on_update()
+
+    def note_admitted(self) -> None:
+        if self.admitted_ts is None:
+            self.admitted_ts = time.monotonic()
+
+    # ---------------------------------------------------- engine facing
+
+    def ready_upto(self, pos_end: int) -> bool:
+        """All expected placeholder positions strictly below `pos_end`
+        are covered by landed items (the engine asks per prefill chunk)."""
+        with self._mu:
+            if self._complete:
+                return True
+            for p in self._expected:
+                if p >= pos_end:
+                    break
+                if p not in self._covered:
+                    return False
+            return True
+
+    def complete(self) -> bool:
+        with self._mu:
+            return self._complete
+
+    def failed(self) -> str:
+        with self._mu:
+            return self._failed_msg
+
+    def expired(self) -> bool:
+        with self._mu:
+            return not self._complete and time.monotonic() > self._deadline
+
+    def assembled(self) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+        """(embeds [N, D], positions [N]) over landed items, sorted by
+        position — what the current prefill chunk may scatter (the
+        executor drops positions outside the chunk)."""
+        with self._mu:
+            if not self._items:
+                return None, None
+            pos = np.concatenate(
+                [np.asarray(p, np.int64) for p, _ in self._items]
+            )
+            emb = np.concatenate([e for _, e in self._items])
+        order = np.argsort(pos, kind="stable")
+        return emb[order], pos[order]
+
 
 class MultimodalMixin:
     # Landed-but-unclaimed media embeddings are reaped after this TTL.
     _MM_IMPORT_TTL_S = 120.0
+
+    def _init_mm(self) -> None:
+        """Multimodal state + instruments (called from InstanceServer
+        __init__ after self.metrics exists)."""
+        # srid -> (embeds, positions, arrival_ts); legacy monolithic
+        # landing table, waited on by _pop_mm_import.
+        self._mm_imports: Dict[str, Tuple[Any, List[int], float]] = {}
+        self._mm_events: Dict[str, threading.Event] = {}
+        self._mm_mu = threading.Lock()
+        # Streamed-handoff state (encoder fabric): srid -> live handle,
+        # plus chunks that arrived before the forwarded request did
+        # (item_idx, positions, embeds, arrival_ts).
+        self._mm_streams: Dict[str, MMStreamHandle] = {}
+        self._mm_early: Dict[
+            str, List[Tuple[int, List[int], np.ndarray, float]]
+        ] = {}
+        self._m_mm_reaped = self.metrics.counter(
+            "xllm_mm_import_reaped_total",
+            "Landed-but-unclaimed media embeddings reaped after the "
+            "import TTL (their waiter timed out or its master died "
+            "between /encode and the forward)",
+        )
+        self._m_mm_wait = self.metrics.histogram(
+            "xllm_mm_import_wait_ms",
+            "Time a forwarded media request waited for its embeddings "
+            "(legacy blocking wait, or open->complete on a streamed "
+            "session)",
+        )
+        self._m_mm_sessions = self.metrics.counter(
+            "xllm_mm_stream_sessions_total",
+            "Encoder->prefill streaming sessions opened (encoder side)",
+        )
+        self._m_mm_chunks = self.metrics.counter(
+            "xllm_mm_stream_chunks_total",
+            "Per-item embedding chunks sent on streaming sessions "
+            "(encoder side)",
+        )
+        self._m_mm_chunks_landed = self.metrics.counter(
+            "xllm_mm_stream_chunks_landed_total",
+            "Per-item embedding chunks landed by /mm/chunk (prefill side)",
+        )
+        self._m_mm_aborts = self.metrics.counter(
+            "xllm_mm_stream_aborts_total",
+            "Streaming sessions aborted to the monolithic /mm/import "
+            "fallback (encoder side)",
+        )
+        # Stage-E overlap: fraction of the embedding wait that ran AFTER
+        # the text request was already admitted to the engine (prefilling
+        # text) — the pipelining the streamed handoff exists to create.
+        # Own lock: the on_complete hook may fire from a handler that
+        # holds _mm_mu.
+        self._mm_overlap_mu = threading.Lock()
+        self._mm_overlap_num = 0.0
+        self._mm_overlap_den = 0.0
+        self.metrics.gauge(
+            "xllm_mm_stream_overlap_frac",
+            "Fraction of streamed-session embedding wait overlapped with "
+            "an already-admitted text prefill (1 = fully hidden)",
+        ).set_function(
+            lambda: self._mm_overlap_num / max(self._mm_overlap_den, 1e-9)
+        )
+
+    def _mm_note_complete(self, handle: MMStreamHandle) -> None:
+        """on_complete hook: wait + overlap accounting for one session."""
+        now = handle.complete_ts or time.monotonic()
+        wait = max(now - handle.created_ts, 0.0)
+        self._m_mm_wait.observe(wait * 1000.0)
+        if handle.admitted_ts is not None:
+            with self._mm_overlap_mu:
+                self._mm_overlap_num += max(now - handle.admitted_ts, 0.0)
+                self._mm_overlap_den += max(wait, 1e-9)
 
     def _handle_embeddings(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
         """Engine-side /v1/embeddings: token id lists in (the service
@@ -149,10 +394,27 @@ class MultimodalMixin:
                 h.send_error_json(400, f"bad media payload: {e}")
                 return
             decoded.append((kind, arr))
-        # Contiguous same-kind stills/audio batch through one encode
+
+        if (
+            _encoder_fabric_enabled(getattr(self, "cfg", None))
+            and hasattr(self.engine, "encode_media_submit")
+            and positions
+        ):
+            if self._encode_fabric(h, body, decoded, parts):
+                return
+            # Fabric path declined (unpredictable token layout) — the
+            # legacy synchronous path below handles it, errors included.
+
+        # Legacy synchronous path (and the XLLM_ENCODER_FABRIC=0 hatch):
+        # contiguous same-kind stills/audio batch through one encode
         # call; videos encode per part (token count varies with frames).
-        chunks = []
+        # Outputs map back through EXPLICIT source indices — flush-order
+        # bookkeeping must never reorder embeddings when kinds interleave
+        # (audio<->image), or every item after the first boundary binds
+        # to the wrong placeholder span.
+        chunks: list = [None] * len(decoded)
         batch: list = []
+        batch_idx: list = []
         batch_kind = ""
 
         def flush():
@@ -163,19 +425,22 @@ class MultimodalMixin:
                     else self.engine.encode
                 )
                 out = fn(np.stack(batch))  # [B, tokens, D]
-                chunks.extend(out[i] for i in range(out.shape[0]))
+                for j, src in enumerate(batch_idx):
+                    chunks[src] = out[j]
                 batch.clear()
+                batch_idx.clear()
             batch_kind = ""
 
-        for kind, arr in decoded:
+        for i, (kind, arr) in enumerate(decoded):
             if kind == "video":
                 flush()
-                chunks.append(self.engine.encode_video(arr))  # [N, D]
+                chunks[i] = self.engine.encode_video(arr)  # [N, D]
             else:
                 if batch_kind not in ("", kind):
                     flush()
                 batch_kind = kind
                 batch.append(arr)
+                batch_idx.append(i)
         flush()
         flat = np.ascontiguousarray(
             np.concatenate([np.asarray(c).reshape(-1, c.shape[-1])
@@ -215,6 +480,288 @@ class MultimodalMixin:
             return
         h.send_json({"ok": True, "media_tokens": int(flat.shape[0])})
 
+    # ------------------------------------------------------------------ #
+    # encoder fabric: cached + batched encode, streamed handoff session
+    # ------------------------------------------------------------------ #
+
+    def _mm_expected_counts(self, decoded) -> Optional[List[int]]:
+        """Predicted media-token count per decoded item — the same layout
+        the service computed placeholders from (scheduler._expand_media),
+        so per-item position segments are known BEFORE any tower runs.
+        None when a count is unpredictable (unknown tower geometry):
+        the caller then declines to stream and the legacy path serves."""
+        vcfg = getattr(self.engine.executor, "cfg", None)
+        counts: List[int] = []
+        for kind, arr in decoded:
+            if kind == "audio":
+                from xllm_service_tpu.models.audio import audio_out_tokens
+
+                counts.append(audio_out_tokens(int(arr.shape[1])))
+            elif kind == "img":
+                if vcfg is None:
+                    return None
+                counts.append(int(vcfg.out_tokens))
+            else:  # video: out_tokens per temporal slice
+                if vcfg is None:
+                    return None
+                tps = max(getattr(vcfg, "temporal_patch_size", 2), 1)
+                counts.append(int(vcfg.out_tokens) * (int(arr.shape[0]) // tps))
+        return counts
+
+    def _encode_fabric(self, h: HttpJsonApi, body, decoded, parts) -> bool:
+        """Fabric serve of one /encode: per-item cache/batcher resolution
+        (EncoderEngine.encode_media_submit) + a streamed per-item handoff
+        session to the prefill peer. Returns False — caller falls back to
+        the legacy synchronous path — only when the per-item token layout
+        cannot be predicted; once streaming starts, every failure degrades
+        INSIDE this method (abort -> monolithic /mm/import push), and the
+        response is always sent here."""
+        import base64
+
+        from xllm_service_tpu.service.image_processor import (
+            media_content_hash,
+        )
+
+        srid = body.get("service_request_id", "")
+        target = body.get("target", "")
+        positions = [int(p) for p in body.get("positions") or []]
+        counts = self._mm_expected_counts(decoded)
+        if counts is None or sum(counts) != len(positions):
+            return False  # legacy path reports layout errors post-encode
+        segments: List[List[int]] = []
+        off = 0
+        for c in counts:
+            segments.append(positions[off:off + c])
+            off += c
+
+        # Submit EVERY item before waiting on any: a multi-item request
+        # batches against itself, and cache hits resolve instantly.
+        pendings = []
+        for (kind, arr), part in zip(decoded, parts):
+            hx = part.get("hash") if isinstance(part, dict) else None
+            try:
+                key = bytes.fromhex(hx) if hx else None
+            except ValueError:
+                key = None
+            if key is None:
+                key = bytes.fromhex(media_content_hash(
+                    kind, list(arr.shape), part.get("data", "")
+                ))
+            pendings.append(self.engine.encode_media_submit(kind, arr, key))
+
+        # Session open: a refused/unreachable peer means no streaming —
+        # the monolithic fallback below still delivers.
+        epoch = body.get("master_epoch", 0)
+        streaming = True
+        try:
+            code, _ = post_json(
+                target, "/mm/open",
+                {
+                    "service_request_id": srid,
+                    "items": len(decoded),
+                    "master_epoch": epoch,
+                },
+                timeout=10.0,
+            )
+            streaming = code == 200
+        except Exception:
+            streaming = False
+        if streaming:
+            self._m_mm_sessions.inc()
+
+        # Sender-side drain state: chunk posts run on the dedicated
+        # bounded stream lane (_stream_q) — a stuck peer saturates only
+        # that lane and the session degrades to the monolithic push.
+        mu = threading.Lock()
+        cv = threading.Condition(mu)
+        state = {"pending": 0, "failed": ""}
+
+        def _chunk_done(err: str = "") -> None:
+            with cv:
+                state["pending"] -= 1
+                if err and not state["failed"]:
+                    state["failed"] = err
+                cv.notify_all()
+
+        def _send_chunk(idx: int, seg: List[int], rows: np.ndarray) -> None:
+            try:
+                faults.point(
+                    "mm_handoff.send",
+                    instance=self.name, srid=srid, item=idx, peer=target,
+                )
+                code, resp = post_json(
+                    target, "/mm/chunk",
+                    {
+                        "service_request_id": srid,
+                        "item": idx,
+                        "positions": seg,
+                        "count": int(rows.shape[0]),
+                        "dim": int(rows.shape[1]),
+                        "embeds": base64.b64encode(
+                            np.ascontiguousarray(rows).tobytes()
+                        ).decode(),
+                    },
+                    timeout=30.0,
+                )
+                _chunk_done("" if code == 200 else f"peer returned {code}: {resp}")
+            except Exception as e:  # noqa: BLE001
+                _chunk_done(str(e))
+
+        outs: List[Optional[np.ndarray]] = [None] * len(decoded)
+        encode_err: Optional[str] = None
+        for i, p in enumerate(pendings):
+            try:
+                out = p.result(timeout=300.0)
+            except BaseException as e:  # noqa: BLE001
+                encode_err = f"encode failed: {e}"
+                break
+            rows = np.asarray(out, np.float32).reshape(-1, out.shape[-1])
+            outs[i] = rows
+            if rows.shape[0] != counts[i]:
+                # Predicted layout diverged from the tower — stop
+                # streaming; the monolithic fallback's strict count check
+                # reports it exactly like the legacy path.
+                streaming = False
+            if streaming and not state["failed"]:
+                with cv:
+                    state["pending"] += 1
+                try:
+                    self._stream_q.put_nowait(
+                        lambda i=i, seg=segments[i], rows=rows: (
+                            _send_chunk(i, seg, rows)
+                        )
+                    )
+                    self._m_mm_chunks.inc()
+                except queue.Full:
+                    _chunk_done("stream lane saturated")
+
+        if encode_err is not None:
+            if streaming:
+                try:
+                    post_json(
+                        target, "/mm/abort",
+                        {"service_request_id": srid, "reason": encode_err},
+                        timeout=5.0,
+                    )
+                except Exception:
+                    pass
+            h.send_error_json(500, encode_err)
+            return True
+
+        aborted = False
+        if streaming:
+            with cv:
+                deadline = time.monotonic() + 120.0
+                while state["pending"] > 0 and not state["failed"]:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        state["failed"] = "chunk delivery timed out"
+                        break
+                    cv.wait(timeout=left)
+                aborted = bool(state["failed"])
+        else:
+            aborted = True
+
+        total = int(sum(r.shape[0] for r in outs))
+        if not aborted:
+            try:
+                code, _ = post_json(
+                    target, "/mm/commit",
+                    {"service_request_id": srid, "count": total},
+                    timeout=10.0,
+                )
+                aborted = code != 200
+            except Exception:
+                aborted = True
+        if aborted:
+            # Abort -> monolithic fallback: everything is encoded, so the
+            # full push both completes a half-fed stream handle on the
+            # peer (idempotent re-lands) and serves the legacy waiter.
+            self._m_mm_aborts.inc()
+            try:
+                post_json(
+                    target, "/mm/abort",
+                    {"service_request_id": srid,
+                     "reason": state["failed"] or "stream fallback"},
+                    timeout=5.0,
+                )
+            except Exception:
+                pass
+            flat = np.ascontiguousarray(np.concatenate(outs))
+            if positions and len(positions) != flat.shape[0]:
+                h.send_error_json(
+                    400,
+                    f"{len(positions)} placeholder positions but the "
+                    f"encoder produced {flat.shape[0]} media tokens",
+                )
+                return True
+            try:
+                code, resp = post_json(
+                    target, "/mm/import",
+                    {
+                        "service_request_id": srid,
+                        "embeds": base64.b64encode(flat.tobytes()).decode(),
+                        "count": int(flat.shape[0]),
+                        "dim": int(flat.shape[1]),
+                        "positions": list(positions),
+                    },
+                    timeout=30.0,
+                )
+            except Exception as e:
+                h.send_error_json(502, f"prefill peer unreachable: {e}")
+                return True
+            if code != 200:
+                h.send_error_json(
+                    502, f"prefill peer rejected embeddings: {resp}"
+                )
+                return True
+        h.send_json({
+            "ok": True,
+            "media_tokens": total,
+            "streamed": not aborted,
+        })
+        return True
+
+    def _mm_reap_locked(self, now: float) -> int:
+        """Drop landed-but-unclaimed embedding state past the import TTL
+        (caller holds _mm_mu): monolithic imports whose waiter timed out
+        or whose master died between /encode and the forward, early
+        chunks whose forward never came, and stream handles that are
+        complete/expired with nobody left to claim them. Returns the
+        number of REQUESTS reaped (instrumented + logged by callers)."""
+        reaped = 0
+        stale = [
+            s for s, (_, _, ts) in self._mm_imports.items()
+            if now - ts > self._MM_IMPORT_TTL_S
+        ]
+        for s in stale:
+            self._mm_imports.pop(s, None)
+            self._mm_events.pop(s, None)
+            reaped += 1
+        for s, chunks in list(self._mm_early.items()):
+            if chunks and now - chunks[0][3] > self._MM_IMPORT_TTL_S:
+                del self._mm_early[s]
+                reaped += 1
+        for s, handle in list(self._mm_streams.items()):
+            if now - handle.created_ts > self._MM_IMPORT_TTL_S and (
+                handle.complete() or handle.expired()
+            ):
+                # The engine holds its own reference; dropping the table
+                # entry only stops NEW chunk landings from finding it.
+                del self._mm_streams[s]
+                if not handle.complete():
+                    reaped += 1
+        return reaped
+
+    def _mm_note_reaped(self, n: int) -> None:
+        if n:
+            self._m_mm_reaped.inc(n)
+            logger.warning(
+                "instance %s reaped %d unclaimed media-embedding "
+                "import(s) past the %.0fs TTL",
+                self.name, n, self._MM_IMPORT_TTL_S,
+            )
+
     def _handle_mm_import(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
         import base64
 
@@ -230,26 +777,34 @@ class MultimodalMixin:
             h.send_error_json(400, f"bad embeddings payload: {e}")
             return
         now = time.monotonic()
+        ev = handle = None
         with self._mm_mu:
             # Reap orphans (a push landing after its waiter timed out, or a
             # master that died between /encode and the forward): without a
             # TTL every such request pins its embedding array forever.
-            stale = [
-                s for s, (_, _, ts) in self._mm_imports.items()
-                if now - ts > self._MM_IMPORT_TTL_S
-            ]
-            for s in stale:
-                self._mm_imports.pop(s, None)
-                self._mm_events.pop(s, None)
-            self._mm_imports[srid] = (embeds, positions, now)
-            ev = self._mm_events.setdefault(srid, threading.Event())
-        ev.set()
+            reaped = self._mm_reap_locked(now)
+            handle = self._mm_streams.get(srid)
+            if handle is None:
+                self._mm_imports[srid] = (embeds, positions, now)
+                ev = self._mm_events.setdefault(srid, threading.Event())
+        self._mm_note_reaped(reaped)
+        if handle is not None:
+            # A live stream handle claims the monolithic push directly:
+            # this is both the abort fallback (idempotent re-lands of
+            # already-streamed items) and a fabric-off encoder feeding a
+            # fabric-on prefill.
+            handle.land(positions, embeds)
+        else:
+            ev.set()
         h.send_json({"ok": True})
 
     def _pop_mm_import(self, srid: str, timeout: float):
+        t0 = time.monotonic()
         with self._mm_mu:
             ev = self._mm_events.setdefault(srid, threading.Event())
-        if not ev.wait(timeout):
+        ok = ev.wait(timeout)
+        self._m_mm_wait.observe((time.monotonic() - t0) * 1000.0)
+        if not ok:
             with self._mm_mu:
                 self._mm_events.pop(srid, None)
             return None
@@ -257,3 +812,155 @@ class MultimodalMixin:
             self._mm_events.pop(srid, None)
             entry = self._mm_imports.pop(srid, None)
             return entry[:2] if entry is not None else None
+
+    # ------------------------------------------------------------------ #
+    # streamed handoff, prefill side (/mm/open|chunk|commit|abort)
+    # ------------------------------------------------------------------ #
+
+    def _mm_stream_attach(
+        self, srid: str, expected_positions: List[int]
+    ) -> MMStreamHandle:
+        """Create (or return) the stream handle for one forwarded media
+        request, folding in chunks — or a whole monolithic import — that
+        landed before the forward arrived (the master dispatches the
+        encoder CONCURRENTLY with the forward when the fabric is on)."""
+        early: List[Tuple[List[int], np.ndarray, float]] = []
+        mono = None
+        with self._mm_mu:
+            handle = self._mm_streams.get(srid)
+            if handle is None:
+                handle = MMStreamHandle(
+                    srid,
+                    expected_positions,
+                    deadline_s=getattr(
+                        self.cfg, "mm_stream_deadline_s", 180.0
+                    ),
+                    on_update=self._engine_wake,
+                    on_complete=self._mm_note_complete,
+                )
+                self._mm_streams[srid] = handle
+                early = self._mm_early.pop(srid, [])
+                mono = self._mm_imports.pop(srid, None)
+                self._mm_events.pop(srid, None)
+        for _item, pos, emb, _ts in early:
+            handle.land(pos, emb)
+        if mono is not None:
+            handle.land(mono[1], mono[0])
+        return handle
+
+    def _mm_stream_discard(self, srid: str) -> None:
+        with self._mm_mu:
+            self._mm_streams.pop(srid, None)
+
+    def _engine_wake(self) -> None:
+        """Stream landing -> engine work event: a media request parked at
+        a chunk boundary re-checks coverage without the 50ms poll."""
+        wake = getattr(self.engine, "wake", None)
+        if wake is not None:
+            try:
+                wake()
+            except Exception:
+                pass
+
+    def _handle_mm_open(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
+        srid = body.get("service_request_id", "")
+        if not srid:
+            h.send_error_json(400, "service_request_id required")
+            return
+        # The handle itself is created by the forwarded request (only it
+        # knows the placeholder layout); open proves the peer reachable
+        # and un-fenced before the encoder starts streaming.
+        h.send_json({"ok": True})
+
+    def _handle_mm_chunk(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
+        import base64
+
+        srid = body.get("service_request_id", "")
+        try:
+            faults.point(
+                "mm_handoff.recv",
+                instance=self.name, srid=srid, item=body.get("item", -1),
+            )
+        except faults.FaultInjected as fi:
+            h.send_error_json(503, str(fi))
+            return
+        try:
+            count = int(body["count"])
+            dim = int(body["dim"])
+            embeds = np.frombuffer(
+                base64.b64decode(body["embeds"]), np.float32
+            ).reshape(count, dim)
+            positions = [int(p) for p in body.get("positions", [])]
+        except Exception as e:
+            h.send_error_json(400, f"bad chunk payload: {e}")
+            return
+        now = time.monotonic()
+        handle = None
+        stashed = True
+        with self._mm_mu:
+            reaped = self._mm_reap_locked(now)
+            handle = self._mm_streams.get(srid)
+            if handle is None:
+                # Chunk beat the forwarded request here: stash until the
+                # serving thread attaches (bounded per srid; TTL-reaped).
+                stash = self._mm_early.setdefault(srid, [])
+                if len(stash) < 64:
+                    stash.append((
+                        int(body.get("item", len(stash))),
+                        positions, embeds, now,
+                    ))
+                else:
+                    stashed = False
+        self._mm_note_reaped(reaped)
+        if handle is not None:
+            handle.land(positions, embeds)
+        elif not stashed:
+            # Acking a dropped chunk would let the encoder commit a
+            # session that can never complete — fail it so the sender
+            # aborts to the monolithic /mm/import fallback.
+            h.send_error_json(503, "early-chunk stash full")
+            return
+        self._m_mm_chunks_landed.inc()
+        h.send_json({"ok": True})
+
+    def _handle_mm_commit(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
+        srid = body.get("service_request_id", "")
+        ev = None
+        with self._mm_mu:
+            handle = self._mm_streams.get(srid)
+            if handle is None:
+                early = self._mm_early.pop(srid, [])
+                if early:
+                    # No stream handle will ever attach (this prefill
+                    # runs the legacy blocking path — hatch mismatch
+                    # across instances, or the forward died): assemble
+                    # the committed items into a monolithic import so a
+                    # blocked `_pop_mm_import` waiter still gets served.
+                    early.sort(key=lambda t: t[0])
+                    positions = [p for _i, ps, _e, _t in early for p in ps]
+                    embeds = np.concatenate([e for _i, _p, e, _t in early])
+                    self._mm_imports[srid] = (
+                        embeds, positions, time.monotonic()
+                    )
+                    ev = self._mm_events.setdefault(
+                        srid, threading.Event()
+                    )
+        if ev is not None:
+            ev.set()
+            h.send_json({"ok": True, "assembled": True})
+            return
+        if handle is not None and not handle.complete():
+            # Every chunk was acked before the encoder committed, so an
+            # incomplete handle here means landings were lost — fail fast
+            # rather than hold the engine to the deadline. The encoder's
+            # commit failure path then pushes the monolithic fallback
+            # (which un-fails nothing: the engine already rejected).
+            handle.fail("mm commit before full item coverage")
+            h.send_error_json(409, "commit before full item coverage")
+            return
+        h.send_json({"ok": True})
+
+    def _handle_mm_abort(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
+        # Advisory: the encoder degrades to the monolithic /mm/import
+        # push, which completes the handle; only the deadline kills it.
+        h.send_json({"ok": True, "aborted": True})

@@ -51,6 +51,11 @@ class ServingMixin:
                 # handoff — reap the ack event here or it leaks forever.
                 with self._push_acked_mu:
                     self._push_acked.pop(srid, None)
+                # Same for the streamed-media handle: without this, a
+                # finished request's embedding arrays stay pinned in
+                # _mm_streams until the NEXT mm request triggers the TTL
+                # reap — indefinitely on an instance gone text-only.
+                self._mm_stream_discard(srid)
             self._push_q.put(out)
             return True
 
@@ -498,27 +503,43 @@ class ServingMixin:
 
         if srid and self._master is not None:
             # Forwarded mode: ack now, stream back over /rpc/generations.
-            mm_embeds = mm_positions = None
+            mm_embeds = mm_positions = mm_stream = None
             if body.get("mm_positions"):
-                # EPD: the encoder stage pushed this request's media
-                # embeddings to /mm/import (usually already landed — the
-                # master dispatches the encoder first).
-                mm = self._pop_mm_import(srid, timeout=60.0)
-                if mm is None:
-                    h.send_error_json(503, "media embeddings never arrived")
-                    return
-                mm_embeds, mm_positions = mm
-                if len(mm_positions) != len(body["mm_positions"]):
-                    # Encoder and service disagree on media-token count —
-                    # reject rather than pair mismatched arrays (an
-                    # embeds/positions desync would crash the engine step).
-                    h.send_error_json(
-                        502,
-                        f"encoder produced {len(mm_positions)} media tokens "
-                        f"but the request has "
-                        f"{len(body['mm_positions'])} placeholders",
-                    )
-                    return
+                from xllm_service_tpu.api.instance_mm import (
+                    _encoder_fabric_enabled,
+                )
+
+                if _encoder_fabric_enabled(self.cfg):
+                    # Encoder fabric (docs/EPD.md): admit NOW with a
+                    # stream handle — the engine prefills text chunks
+                    # while the encoder's per-item session lands
+                    # embeddings, adopting them at chunk boundaries.
+                    mm_positions = [int(p) for p in body["mm_positions"]]
+                    mm_stream = self._mm_stream_attach(srid, mm_positions)
+                    mm_stream.note_admitted()
+                else:
+                    # Legacy synchronous EPD: the encoder pushed this
+                    # request's media embeddings to /mm/import before the
+                    # master forwarded the text (usually already landed).
+                    mm = self._pop_mm_import(srid, timeout=60.0)
+                    if mm is None:
+                        h.send_error_json(
+                            503, "media embeddings never arrived"
+                        )
+                        return
+                    mm_embeds, mm_positions = mm
+                    if len(mm_positions) != len(body["mm_positions"]):
+                        # Encoder and service disagree on media-token
+                        # count — reject rather than pair mismatched
+                        # arrays (an embeds/positions desync would crash
+                        # the engine step).
+                        h.send_error_json(
+                            502,
+                            f"encoder produced {len(mm_positions)} media "
+                            f"tokens but the request has "
+                            f"{len(body['mm_positions'])} placeholders",
+                        )
+                        return
             with self._srid_mu:
                 self._srid_map.setdefault(srid, []).append(rid)
             # Manifest entry rides the same admission (after the mm/
@@ -530,7 +551,7 @@ class ServingMixin:
             callback = self._make_push_callback(srid, detoks)
             routing = body.get("routing") or {}
             decode_name = routing.get("decode_name", "")
-            if mm_embeds is not None:
+            if mm_embeds is not None or mm_stream is not None:
                 # Media requests serve colocated: the recomputed tail on a
                 # decode peer would need the embeddings too.
                 decode_name = ""
@@ -595,6 +616,7 @@ class ServingMixin:
                         mm_embeds=mm_embeds,
                         mm_positions=mm_positions,
                         mm_grids=body.get("mm_grids"),
+                        mm_stream=mm_stream,
                         resume_from=resume_from,
                     )
                 )

@@ -45,6 +45,7 @@ from xllm_service_tpu.cluster.instance_mgr import (
     HEALTH_STATE_VALUES,
     instance_key,
 )
+from xllm_service_tpu.common import faults
 from xllm_service_tpu.common.config import ServiceConfig
 from xllm_service_tpu.common.types import (
     InstanceMetaInfo,
@@ -758,11 +759,25 @@ class Master:
                 return
             wire = req.wire_srid or req.service_request_id
             epoch = self.scheduler.master_epoch
+            stream_mm = False
             if req.media_parts:
-                # EPD stage E: the encoder computes media embeddings and
-                # pushes them to the prefill peer's /mm/import BEFORE the
-                # text request arrives there. Re-pushing embeddings is
-                # idempotent, so the retry wrapper may redeliver.
+                from xllm_service_tpu.cluster.encoder_fabric import (
+                    encoder_fabric_enabled,
+                )
+
+                # Encoder fabric (docs/EPD.md): dispatch the encoder
+                # CONCURRENTLY with the text forward — the prefill peer
+                # admits the text with an open stream handle and prefills
+                # text chunks while the encoder's per-item session lands
+                # embeddings (re-route retry across the encode tier on
+                # failure).
+                stream_mm = encoder_fabric_enabled(self.config)
+            if req.media_parts and not stream_mm:
+                # Legacy synchronous EPD (and the hatch-off path): the
+                # encoder computes media embeddings and pushes them to
+                # the prefill peer's /mm/import BEFORE the text request
+                # arrives there. Re-pushing embeddings is idempotent, so
+                # the retry wrapper may redeliver.
                 enc = mgr.get_instance(req.routing.encode_name)
                 if enc is None:
                     self.scheduler.fail_request(
@@ -832,6 +847,19 @@ class Master:
                 fwd["mm_positions"] = list(req.mm_positions)
                 if req.mm_grids:
                     fwd["mm_grids"] = [list(g) for g in req.mm_grids]
+            if stream_mm:
+                # Encoder dispatch CONCURRENT with the text forward
+                # (docs/EPD.md): stage E overlaps the forward round-trip,
+                # prefill admission, and the text chunks. Concurrency —
+                # not strict forward-first — also keeps a legacy prefill
+                # (hatch off, blocking /mm/import wait inside its serve
+                # handler) from deadlocking against this thread.
+                threading.Thread(
+                    target=self._encode_fabric_async,
+                    args=(req, wire, meta, epoch),
+                    name=f"encode-dispatch-{wire}",
+                    daemon=True,
+                ).start()
             try:
                 # Dispatch is NOT idempotent: the wrapper only retries
                 # failures proven send-time (request never written); an
@@ -937,6 +965,101 @@ class Master:
             )
 
         h.hold(stream, self._request_timeout_s, fail_deadline)
+
+    def _encode_fabric_async(self, req, wire, prefill_meta, epoch) -> None:
+        """Background encode dispatch for one media request (encoder
+        fabric): runs concurrently with the text forward. When every
+        encode candidate fails, the request error-finishes AND the
+        prefill peer's parked work is cancelled so the stream-deadline
+        reject never has to fire."""
+        try:
+            ok, emsg = self._dispatch_encode_fabric(
+                req, wire, prefill_meta, epoch
+            )
+        except Exception as e:  # noqa: BLE001 — daemon thread must report
+            ok, emsg = False, str(e)
+        if ok:
+            return
+        try:
+            post_json(
+                prefill_meta.http_address, "/cancel",
+                {"service_request_id": wire, "master_epoch": epoch},
+                timeout=5.0,
+            )
+        except Exception:
+            pass
+        self.scheduler.fail_request(
+            req.service_request_id,
+            StatusCode.UNAVAILABLE,
+            f"encoder failed: {emsg}",
+        )
+
+    def _dispatch_encode_fabric(self, req, wire, prefill_meta, epoch):
+        """Encode-tier dispatch with re-route retry (encoder fabric,
+        docs/EPD.md): try the scheduler-routed encoder first, then — on
+        transport/5xx failure, which also feeds the breaker exactly like
+        the LM tiers — re-resolve a DIFFERENT modality-covering encoder
+        and try again, up to 3 candidates. Returns (ok, error_message).
+        A 4xx is the client's bad media: no re-route, fail once."""
+        mgr = self.scheduler.instance_mgr
+        required = {
+            {2: "audio", 4: "video"}.get(len(p["shape"]), "image")
+            for p in req.media_parts
+        }
+        tried = set()
+        enc_name = req.routing.encode_name
+        last_err = "no ENCODE instance available"
+        for _attempt in range(3):
+            if not enc_name or enc_name in tried:
+                enc_name = mgr.next_encode_instance(
+                    required, exclude=tried
+                )
+            if not enc_name:
+                break
+            tried.add(enc_name)
+            enc = mgr.get_instance(enc_name)
+            if enc is None:
+                enc_name = ""
+                continue
+            try:
+                faults.point(
+                    "encode.dispatch", instance=enc_name, srid=wire
+                )
+                code, resp = post_json_retrying(
+                    enc.http_address,
+                    "/encode",
+                    {
+                        "service_request_id": wire,
+                        "parts": req.media_parts,
+                        "positions": req.mm_positions,
+                        "target": prefill_meta.http_address,
+                        "master_epoch": epoch,
+                    },
+                    # Generous: the encoder's FIRST request pays its XLA
+                    # compile inside this call.
+                    timeout=180.0,
+                    attempts=self._retry_attempts,
+                    budget=self._retry_budget,
+                    idempotent=True,
+                )
+            except Exception as e:
+                code, resp = 0, str(e)
+            if code == 200:
+                mgr.record_dispatch_success(enc_name)
+                req.routing.encode_name = enc_name
+                return True, ""
+            last_err = str(resp)
+            if code == 0 or code >= 500:
+                # Instance-side failure: feed the breaker and re-route
+                # to another encoder (third-role failover parity).
+                mgr.record_dispatch_failure(enc_name)
+                enc_name = ""
+                continue
+            # 4xx: the client's bad media — the encoder is healthy and a
+            # re-route would just fail identically.
+            mgr.record_dispatch_success(enc_name)
+            return False, last_err
+        return False, last_err
 
     def _cancel_on_instance(self, req: ServiceRequest) -> None:
         """Propagate a client cancel to the routed instance(s). /cancel is

@@ -1,5 +1,9 @@
 """Cluster state managers (reference: xllm_service/scheduler/managers/)."""
 
+from xllm_service_tpu.cluster.encoder_fabric import (
+    EncoderFabric,
+    encoder_fabric_enabled,
+)
 from xllm_service_tpu.cluster.global_kvcache_mgr import CACHE_PREFIX, GlobalKVCacheMgr
 from xllm_service_tpu.cluster.instance_mgr import (
     INSTANCE_PREFIXES,
@@ -11,6 +15,8 @@ from xllm_service_tpu.cluster.time_predictor import TimePredictor
 
 __all__ = [
     "CACHE_PREFIX",
+    "EncoderFabric",
+    "encoder_fabric_enabled",
     "GlobalKVCacheMgr",
     "INSTANCE_PREFIXES",
     "LOADMETRICS_PREFIX",

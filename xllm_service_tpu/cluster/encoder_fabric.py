@@ -1,0 +1,164 @@
+"""Encoder fabric, master side: the fleet's media-embedding index.
+
+Fourth cluster plane (after serving, PD KV handoff, and the prefix KV
+fabric — ROADMAP item 4): the EPD paper (arXiv 2501.05460) scales
+multimodal serving with independently-sized encoder instances,
+cross-request encoder batching, and cached embeddings; P/D-Serve
+(arXiv 2408.08147) is the reference for weighing cache affinity against
+load. This module is the master's half:
+
+  * **Embedding index** — media content hashes (16-byte blake2b keys,
+    service/image_processor.media_content_hash) -> the set of ENCODE
+    instances holding that item in their local embedding LRU. Fed by the
+    SAME heartbeat KvCacheEvent delta plumbing the prefix index uses
+    (EncoderEngine.take_cache_event); the scheduler routes encoder
+    instances' deltas here instead of into GlobalKVCacheMgr.
+  * **Hit-aware encoder routing** — `match()` scores each encoder by how
+    many of a request's media items it already holds;
+    `InstanceMgr.next_encode_instance` folds that into its live
+    queue-depth score so re-sent media lands where its embeddings live
+    (and skips the tower entirely).
+  * **Hardening parity with the prefix fabric (docs/KV_CACHE.md)** — on
+    breaker ejection the scheduler prunes the instance's embedding-index
+    entries and arms a cache RESYNC: the next heartbeat after re-admission
+    folds the encoder's full LRU snapshot (cache_snapshot_event) into a
+    stored delta, rebuilding the index.
+
+Escape hatch: `XLLM_ENCODER_FABRIC=1|0` overrides the config flags either
+way, read per call so it can flip on a live cluster. Wire protocol +
+fallback matrix: docs/EPD.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, Iterable, List, Set
+
+logger = logging.getLogger(__name__)
+
+# One cached media item is worth this many queue slots in the encoder
+# routing score: a hit skips the tower dispatch entirely, a queued request
+# costs one dispatch — but a hit still pays admission + the handoff.
+HIT_WEIGHT = 2.0
+
+
+def encoder_fabric_enabled(cfg=None) -> bool:
+    """The escape hatch: XLLM_ENCODER_FABRIC=1|0 overrides the config
+    flag (ServiceConfig/EngineConfig.enable_encoder_fabric) either way.
+    Read per call so the hatch can flip on a live process."""
+    env = os.environ.get("XLLM_ENCODER_FABRIC", "")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    return bool(getattr(cfg, "enable_encoder_fabric", True))
+
+
+class EncoderFabric:
+    """Master-side embedding-index coordinator. Owned by the Scheduler;
+    fed by `handle_instance_heartbeat` (ENCODE-role cache deltas),
+    consulted by `schedule()` for hit-aware encoder routing, pruned by
+    the breaker/removal listeners."""
+
+    def __init__(self, config, instance_mgr, metrics=None):
+        self._config = config
+        self._instance_mgr = instance_mgr
+        self._mu = threading.Lock()
+        # media content hash -> encoder instance names holding it.
+        self._index: Dict[bytes, Set[str]] = {}
+        # Fleet-wide embedding hit accounting from the router's vantage:
+        # per scheduled media request, items ANY encoder already holds
+        # over total items. The number the fabric exists to raise.
+        self.fleet_hit_items = 0
+        self.fleet_total_items = 0
+        if metrics is not None:
+            metrics.gauge(
+                "xllm_fleet_embed_hit_rate",
+                "Fleet-wide media-embedding hit rate at the router: items "
+                "some encoder already holds cached over total media items, "
+                "across scheduled media requests",
+            ).set_function(
+                lambda: self.fleet_hit_items
+                / max(self.fleet_total_items, 1)
+            )
+
+    def enabled(self) -> bool:
+        return encoder_fabric_enabled(self._config)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._index)
+
+    # -------------------------------------------------------- index feed
+
+    def record_event(self, instance: str, event) -> None:
+        """Fold one heartbeat KvCacheEvent from an ENCODE instance:
+        stored = items inserted into its embedding LRU, removed = LRU
+        evictions. The offload tiers don't exist for embeddings; a
+        resync snapshot arrives as a plain stored set (idempotent)."""
+        with self._mu:
+            for h in event.stored_cache:
+                self._index.setdefault(h, set()).add(instance)
+            for h in getattr(event, "offload_cache", {}) or {}:
+                self._index.setdefault(h, set()).add(instance)
+            for h in event.removed_cache:
+                holders = self._index.get(h)
+                if holders is not None:
+                    holders.discard(instance)
+                    if not holders:
+                        del self._index[h]
+
+    def remove_instance(self, name: str) -> None:
+        """Retract every location of one encoder (deregistration, lease
+        expiry, or breaker ejection — the scheduler arms a resync so a
+        re-admitted encoder's snapshot rebuilds what this drops)."""
+        with self._mu:
+            dead = []
+            for h, holders in self._index.items():
+                holders.discard(name)
+                if not holders:
+                    dead.append(h)
+            for h in dead:
+                del self._index[h]
+
+    # ----------------------------------------------------------- routing
+
+    def holders(self, media_hash: bytes) -> Set[str]:
+        with self._mu:
+            return set(self._index.get(media_hash, ()))
+
+    def match(self, hashes: Iterable[bytes]) -> Dict[str, int]:
+        """Per-encoder cached-item counts for one request's media list.
+        Always feeds the fleet hit-rate gauge (fabric on or off, so an
+        A/B hatch flip never flatlines it); the ROUTING consumer only
+        uses the scores when the fabric is enabled."""
+        hashes = list(hashes)
+        scores: Dict[str, int] = {}
+        hit_items = 0
+        with self._mu:
+            for h in hashes:
+                holders = self._index.get(h)
+                if not holders:
+                    continue
+                hit_items += 1
+                for name in holders:
+                    scores[name] = scores.get(name, 0) + 1
+            self.fleet_total_items += len(hashes)
+            self.fleet_hit_items += hit_items
+        return scores
+
+    @staticmethod
+    def hashes_of(media_parts: List[dict]) -> List[bytes]:
+        """The 16-byte content keys riding a request's media parts (empty
+        entries — legacy callers without front-door hashing — drop out)."""
+        out = []
+        for p in media_parts or ():
+            hx = p.get("hash") if isinstance(p, dict) else None
+            if hx:
+                try:
+                    out.append(bytes.fromhex(hx))
+                except ValueError:
+                    pass
+        return out

@@ -638,25 +638,61 @@ class InstanceMgr:
                 routing.decode_name = routing.prefill_name
             return routing
 
-    def next_encode_instance(self, required=frozenset()) -> str:
-        """Round-robin over ENCODE instances whose advertised modalities
-        cover `required` (e.g. {"image"} or {"audio"}). Encoders host
-        ONE tower, so modality-blind rotation would 501 half the
-        requests on mixed fleets (review finding, r5). Instances that
-        advertise nothing are legacy wildcards."""
+    def next_encode_instance(
+        self, required=frozenset(), hit_scores=None, exclude=frozenset()
+    ) -> str:
+        """Pick an ENCODE instance whose advertised modalities cover
+        `required` (e.g. {"image"} or {"audio"}). Encoders host ONE
+        tower, so modality-blind rotation would 501 half the requests on
+        mixed fleets (review finding, r5); instances that advertise
+        nothing are legacy wildcards. `exclude` names candidates a
+        caller already failed against (encode dispatch re-route).
+
+        With `hit_scores` (encoder fabric, docs/EPD.md: per-instance
+        cached-media-item counts from the master's embedding index) the
+        pick is SCORED — live encoder queue depth from the last
+        heartbeat, minus a bonus per cached item (a hit skips the tower
+        dispatch entirely) — instead of round-robin; ties rotate so an
+        idle fleet still spreads. Without it (fabric off / text fleets)
+        the legacy round-robin is unchanged."""
+        from xllm_service_tpu.cluster.encoder_fabric import HIT_WEIGHT
+
         required = set(required)
+        exclude = set(exclude)
         with self._mu:
             candidates = [
                 n for n in self._routable(self._encode_index)
-                if not required
-                or not (m := self._instances.get(n)) or not m.modalities
-                or required <= set(m.modalities)
+                if n not in exclude
+                and (
+                    not required
+                    or not (m := self._instances.get(n)) or not m.modalities
+                    or required <= set(m.modalities)
+                )
             ]
             if not candidates:
                 return ""
-            name = candidates[self._rr_encode % len(candidates)]
+            if hit_scores is None:
+                name = candidates[self._rr_encode % len(candidates)]
+                self._rr_encode += 1
+                return name
+
+            def score(n: str) -> float:
+                load = self._load_metrics.get(n, LoadMetrics())
+                return (
+                    load.waiting_requests_num
+                    - HIT_WEIGHT * hit_scores.get(n, 0)
+                )
+
+            rot = self._rr_encode % len(candidates)
+            best = min(
+                range(len(candidates)),
+                key=lambda i: (
+                    score(candidates[i]),
+                    (i - rot) % len(candidates),
+                ),
+            )
             self._rr_encode += 1
-            return name
+            return candidates[best]
 
     def get_load_metrics(self) -> Dict[str, LoadMetrics]:
         """Snapshot for policy scoring (reference: instance_mgr.cpp:217-286)."""

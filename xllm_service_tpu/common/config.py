@@ -140,6 +140,14 @@ class ServiceConfig:
     mm_audio_mel_bins: int = 128
     mm_audio_mel_frames: int = 0
 
+    # Encoder fabric (docs/EPD.md): media-hash-keyed embedding index +
+    # hit/queue-aware encoder routing on the master, streamed
+    # encoder->prefill handoff, and cross-request encoder batching on the
+    # instances. The env var XLLM_ENCODER_FABRIC=1|0 overrides this field
+    # either way (read per call, so the hatch flips on a live cluster);
+    # every fabric failure degrades to the synchronous EPD path.
+    enable_encoder_fabric: bool = True
+
     @classmethod
     def from_args(cls, argv: Optional[List[str]] = None) -> "ServiceConfig":
         parser = argparse.ArgumentParser("xllm-service-tpu master")
@@ -266,6 +274,27 @@ class EngineConfig:
     # last-replica evictions to the master's coordinator. The env var
     # XLLM_PREFIX_FABRIC=1|0 overrides either way, per request.
     enable_prefix_fabric: bool = True
+
+    # Encoder fabric, instance side (docs/EPD.md): ENCODE instances grow a
+    # cross-request micro-batcher + media-hash-keyed embedding LRU, and
+    # the encoder->prefill handoff streams per-item sessions instead of
+    # one monolithic /mm/import. XLLM_ENCODER_FABRIC=1|0 overrides either
+    # way, per request; any failure degrades to the synchronous path.
+    enable_encoder_fabric: bool = True
+    # Micro-batcher admission window: an arriving media item waits at most
+    # this long for same-kind items from OTHER requests before the tower
+    # dispatch fires (deadline-bounded coalescing).
+    encoder_batch_window_ms: float = 5.0
+    # Micro-batcher size bound (power of two — the towers pad batches to
+    # pow2, so a pow2 cut wastes no padding).
+    encoder_batch_max: int = 8
+    # Encoder-local embedding LRU capacity, in media items (0 disables
+    # caching; the master's fleet index follows via heartbeat deltas).
+    encoder_cache_entries: int = 256
+    # Prefill side: how long an admitted media request may wait for its
+    # streamed embeddings before it is rejected (generous — the encoder's
+    # first request pays its XLA compile inside this window).
+    mm_stream_deadline_s: float = 180.0
 
     # Cross-PROCESS device-to-device KV data plane
     # (jax.experimental.transfer). When enabled, PD handoffs to a peer in

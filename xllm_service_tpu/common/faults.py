@@ -14,13 +14,16 @@ Point names are string literals at their call sites; uniqueness and
 test coverage are linted by `scripts/check_fault_points.py` (wired
 next to `check_metric_names.py`). The data plane exposes
 `post_json.send/recv`, `heartbeat.send`, `fake_engine.step`,
-`kv_stream.send/recv`, and the prefix-fabric points
+`kv_stream.send/recv`, the prefix-fabric points
 `kv_fetch.send/recv` (chaos must degrade to recompute, never error —
 docs/KV_CACHE.md) and `fabric.evict_offer` (chaos = the block dies
-locally); the control plane `election.keepalive` (drop = fast demote,
-delay past the lease TTL = the split-brain window), `store.watch`, and
-`reconcile.send/recv` — the docs/FAULT_TOLERANCE.md tables map each to
-its recovery path.
+locally), and the encoder-fabric points `encode.dispatch` (chaos =
+master re-routes to another encoder) and `mm_handoff.send/recv` (chaos
+must degrade to the monolithic /mm/import push, never error —
+docs/EPD.md); the control plane `election.keepalive` (drop = fast
+demote, delay past the lease TTL = the split-brain window),
+`store.watch`, and `reconcile.send/recv` — the docs/FAULT_TOLERANCE.md
+tables map each to its recovery path.
 
 Plan spec (JSON, via `install_spec`, `--chaos-spec`, or the
 `XLLM_CHAOS_SPEC` env var read at first use):

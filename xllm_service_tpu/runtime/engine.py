@@ -81,6 +81,15 @@ class EngineRequest:
     # content-addressed blocks across different images.
     mm_embeds: Optional[object] = None
     mm_positions: Optional[object] = None
+    # Streamed encoder handoff (docs/EPD.md): embeddings are still
+    # arriving per-item over the /mm/chunk session while this request is
+    # admitted. The admission loop gates each prefill chunk on
+    # `mm_stream.ready_upto(chunk_end)` — text chunks before the first
+    # uncovered placeholder prefill WHILE the encoder streams — and
+    # materializes mm_embeds/mm_positions from `assembled()` once every
+    # item landed. Expiry (mm_stream_deadline_s) rejects the request;
+    # abort alone does not (the monolithic fallback push completes it).
+    mm_stream: Optional[object] = None
     # Per-media merged-token grids [(t, gh, gw), ...] in document order
     # (t > 1 = video): _mrope_positions lays the (t, h, w) streams from
     # these instead of inferring a square still-image grid from the span
@@ -109,7 +118,9 @@ class EngineRequest:
 
     @property
     def has_media(self) -> bool:
-        return self.mm_embeds is not None and len(self.mm_positions or ()) > 0
+        return (
+            self.mm_embeds is not None or self.mm_stream is not None
+        ) and len(self.mm_positions or ()) > 0
 
 
 @dataclass
@@ -558,6 +569,12 @@ class InferenceEngine:
             self._waiting.append(req)
         self._work.set()
 
+    def wake(self) -> None:
+        """External work signal (streamed mm chunk landed, etc.): a
+        request parked at an admission gate re-checks without waiting
+        out the loop's idle poll."""
+        self._work.set()
+
     def cancel(self, request_id: str) -> None:
         with self._lock:
             self._cancelled.add(request_id)
@@ -751,6 +768,11 @@ class InferenceEngine:
         # prefilling the shared prefix in the same batched step.
         pending_hashes: set = set()
 
+        # Streamed-media requests deferred this round (embeddings for
+        # their next chunk still in flight): re-fronted after the scan so
+        # they never head-of-line-block text traffic behind them.
+        deferred: List = []
+
         # Mid-chunk seqs continue FIRST, wherever they sit in the queue: a
         # preempted/blocked item appendleft'd in front of one must not
         # starve it — it HOLDS slot + blocks that only further chunks can
@@ -765,6 +787,34 @@ class InferenceEngine:
             for x in midchunk:
                 self._waiting.remove(x)
         for seq in midchunk:
+            if seq.req.mm_stream is not None:
+                # Streamed encoder handoff (docs/EPD.md): the next chunk
+                # may only run once every placeholder it covers has
+                # landed — text-only chunks before the first uncovered
+                # placeholder keep prefilling while the encoder streams.
+                pos_end = seq.prefilled + min(
+                    len(seq.tokens) - seq.prefilled, max(budget, 1)
+                )
+                gate = self._mm_gate(seq.req, pos_end)
+                if gate == "wait":
+                    # Park in `deferred` (re-fronted after the scan), NOT
+                    # back into _waiting: the head-admission loop below
+                    # treats any _Seq it sees as fresh/preempted — it
+                    # would pop a second slot and overwrite the held
+                    # block_ids (leaking both) if this seq reached it.
+                    deferred.append(seq)
+                    continue
+                if gate != "ready":
+                    # Expired/desynced stream: release the held slot +
+                    # blocks (this seq is not in _running — nothing else
+                    # can reclaim them) and error-finish.
+                    self.block_mgr.free(seq.block_ids)
+                    seq.block_ids = []
+                    self._free_slots.append(seq.slot)
+                    rejects.append(
+                        (seq.req, StatusCode.UNAVAILABLE, gate)
+                    )
+                    continue
             # Mid-prefill re-match: blocks that landed since the last
             # chunk (a fabric peer fetch racing this prefill, a streamed
             # PD chunk, a sibling's commit) are adopted at the chunk
@@ -821,6 +871,21 @@ class InferenceEngine:
                          "request needs more KV blocks than the pool holds")
                     )
                     continue
+                if head.mm_stream is not None:
+                    # Streamed encoder handoff: admit only when the first
+                    # chunk's placeholders have landed; otherwise defer
+                    # WITHOUT blocking the queue behind this request.
+                    gate = self._mm_gate(head, min(len(htoks), budget))
+                    if gate == "wait":
+                        self._waiting.popleft()
+                        deferred.append(head_item)
+                        continue
+                    if gate != "ready":
+                        self._waiting.popleft()
+                        rejects.append(
+                            (head, StatusCode.UNAVAILABLE, gate)
+                        )
+                        continue
                 no_slot = not self._free_slots
             if no_slot:
                 # Online head + every slot busy: preempt a running OFFLINE
@@ -943,10 +1008,40 @@ class InferenceEngine:
             pending_hashes.update(hashes)
             batch.append(seq)
 
+        if deferred:
+            # Deferred streamed-media items return to the FRONT in their
+            # original relative order (stream landings set the work event,
+            # so the next step re-checks their coverage).
+            with self._lock:
+                self._waiting.extendleft(reversed(deferred))
         admitted = self._prefill_admitted(batch) if batch else 0
         for req, code, msg in rejects:
             self._reject(req, code, msg)
         return admitted
+
+    def _mm_gate(self, req: EngineRequest, pos_end: int) -> str:
+        """Streamed-media admission gate for one prefill chunk ending at
+        absolute position `pos_end` (docs/EPD.md): "ready" when every
+        placeholder below it has landed (materializing the final arrays
+        once the stream completes), "wait" while chunks are in flight, or
+        an error message when the stream desynced or hit its deadline
+        (the caller error-finishes — exactly the legacy timeout surface,
+        moved off the HTTP thread)."""
+        ms = req.mm_stream
+        if ms is None:
+            return "ready"
+        err = ms.failed()
+        if err:
+            return f"media embedding stream failed: {err}"
+        if ms.complete():
+            emb, pos = ms.assembled()
+            req.mm_embeds = emb
+            req.mm_positions = [int(p) for p in pos]
+            req.mm_stream = None
+            return "ready"
+        if ms.expired():
+            return "media embeddings never arrived (stream deadline)"
+        return "ready" if ms.ready_upto(pos_end) else "wait"
 
     def _prefill_admitted(self, batch: List[_Seq]) -> int:
         from xllm_service_tpu.runtime.executor import PrefillItem
@@ -1005,6 +1100,17 @@ class InferenceEngine:
             s = seq.req.sampling
             start = seq.prefilled
             n = seq.chunk_len or (len(seq.tokens) - start)
+            # Media embeddings for this chunk: final arrays, or — on a
+            # still-streaming handoff — whatever items have landed (the
+            # admission gate guaranteed in-chunk coverage; the executor
+            # drops positions outside the chunk).
+            mm_e = mm_p = None
+            if seq.req.has_media:
+                if seq.req.mm_stream is not None:
+                    mm_e, mm_p = seq.req.mm_stream.assembled()
+                else:
+                    mm_e = np.asarray(seq.req.mm_embeds, np.float32)
+                    mm_p = np.asarray(seq.req.mm_positions, np.int64)
             items.append(
                 PrefillItem(
                     token_ids=np.asarray(
@@ -1018,14 +1124,12 @@ class InferenceEngine:
                     seed=s.seed,
                     step=len(seq.generated),
                     mm_embeds=(
-                        np.asarray(seq.req.mm_embeds, np.float32)
-                        if seq.req.has_media
-                        else None
+                        np.asarray(mm_e, np.float32)
+                        if mm_e is not None else None
                     ),
                     mm_positions=(
-                        np.asarray(seq.req.mm_positions, np.int64)
-                        if seq.req.has_media
-                        else None
+                        np.asarray(mm_p, np.int64)
+                        if mm_p is not None else None
                     ),
                     rope_positions=(
                         self._mrope_positions(seq)[:, start:start + n]

@@ -7,13 +7,33 @@ LM-ready embedding tokens out, pushed to the prefill peer's `/mm/import`.
 
 TPU design: image batches are bucketed to powers of two and encoded in one
 jitted call; weights stay resident.
+
+Encoder fabric (docs/EPD.md): with `enable_encoder_fabric` on, the engine
+grows two serving-tier mechanisms the EPD paper (arXiv 2501.05460) scales
+with —
+
+  * a **cross-request micro-batcher**: `/encode` handlers submit media
+    items into one admission queue; a batcher thread coalesces same-kind
+    same-shape items from DIFFERENT requests into one tower dispatch,
+    bounded by a deadline (encoder_batch_window_ms) and a pow2 size cap
+    (encoder_batch_max — the towers pad batches to pow2, so the cap
+    clamps to a power of two and a full window never pads);
+  * a **media-hash-keyed embedding LRU**: items keyed by their front-door
+    content hash resolve from cache without a tower dispatch; insertions
+    and evictions ride heartbeats as KvCacheEvent deltas into the
+    master's fleet embedding index (cluster/encoder_fabric.py), with the
+    full-snapshot resync contract the prefix fabric hardened.
+
+The legacy per-request `encode`/`encode_video`/`encode_audio` entry
+points are untouched — they ARE the `XLLM_ENCODER_FABRIC=0` path.
 """
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -158,16 +178,114 @@ def _is_audio_model(model: str, checkpoint_path: str) -> bool:
         return False
 
 
+class _EmbeddingLRU:
+    """Media-hash-keyed embedding cache (encoder fabric, docs/EPD.md).
+
+    Keys are the 16-byte front-door content digests
+    (service/image_processor.media_content_hash); values the LM-ready
+    embedding rows ([tokens, D] float32). Insertions/evictions accumulate
+    as a KvCacheEvent delta drained by the heartbeat (the master's fleet
+    embedding index mirrors this LRU the way the KV index mirrors the
+    block pools); `snapshot_event` serves the master-requested resync
+    after a breaker ejection pruned this encoder's locations."""
+
+    def __init__(self, capacity: int):
+        from collections import OrderedDict
+
+        self.capacity = max(int(capacity), 0)
+        self._mu = threading.Lock()
+        self._od: "Dict[bytes, np.ndarray]" = OrderedDict()
+        self._stored: set = set()
+        self._removed: set = set()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key: bytes) -> Optional[np.ndarray]:
+        with self._mu:
+            arr = self._od.get(key)
+            if arr is None:
+                self.misses += 1
+                return None
+            self._od.move_to_end(key)
+            self.hits += 1
+            return arr
+
+    def put(self, key: bytes, arr: np.ndarray) -> None:
+        if not self.capacity:
+            return
+        with self._mu:
+            if key in self._od:
+                self._od.move_to_end(key)
+                return
+            self._od[key] = arr
+            self._stored.add(key)
+            self._removed.discard(key)
+            while len(self._od) > self.capacity:
+                old, _ = self._od.popitem(last=False)
+                self.evictions += 1
+                self._removed.add(old)
+                self._stored.discard(old)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._od)
+
+    def take_event(self) -> KvCacheEvent:
+        with self._mu:
+            ev = KvCacheEvent(
+                stored_cache=set(self._stored),
+                removed_cache=set(self._removed),
+            )
+            self._stored.clear()
+            self._removed.clear()
+            return ev
+
+    def snapshot_event(self) -> KvCacheEvent:
+        with self._mu:
+            return KvCacheEvent(stored_cache=set(self._od.keys()))
+
+
+class _PendingEncode:
+    """One media item queued for the micro-batcher: resolves to the
+    item's embedding rows (or an error) via `result()`."""
+
+    __slots__ = ("kind", "arr", "key", "_event", "out", "err")
+
+    def __init__(self, kind: str, arr: np.ndarray, key: Optional[bytes]):
+        self.kind = kind
+        self.arr = arr
+        self.key = key
+        self._event = threading.Event()
+        self.out: Optional[np.ndarray] = None
+        self.err: Optional[BaseException] = None
+
+    def resolve(self, out: Optional[np.ndarray],
+                err: Optional[BaseException] = None) -> None:
+        self.out = out
+        self.err = err
+        self._event.set()
+
+    def result(self, timeout: float = 300.0) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise TimeoutError("encoder micro-batcher timed out")
+        if self.err is not None:
+            raise self.err
+        return self.out
+
+
 class EncoderEngine:
     """Engine-interface adapter so InstanceServer can host an ENCODE role:
     start/stop, heartbeat metric sources, and the encode entry points.
     Hosts ONE modality executor — vision (image + qwen2vl video) or
-    audio — chosen by the model name / checkpoint config."""
+    audio — chosen by the model name / checkpoint config. (Tests may
+    construct it with BOTH executors to exercise mixed-kind requests.)"""
 
     def __init__(self, executor: Optional[VisionExecutor] = None,
                  model: str = "vit-tiny", checkpoint_path: str = "",
                  dtype: str = "float32",
-                 audio_executor: Optional[AudioExecutor] = None):
+                 audio_executor: Optional[AudioExecutor] = None,
+                 cfg=None):
         if executor is None and audio_executor is None:
             if _is_audio_model(model, checkpoint_path):
                 audio_executor = AudioExecutor(
@@ -183,19 +301,89 @@ class EncoderEngine:
         self._mu = threading.Lock()
         self._latency_window: List[Tuple[float, float]] = []
 
+        # Encoder fabric state (docs/EPD.md). cfg is the instance's
+        # EngineConfig; direct constructions (tests) get the defaults.
+        from xllm_service_tpu.common.config import EngineConfig
+        from xllm_service_tpu.obs import MetricsRegistry
+
+        self.cfg = cfg if cfg is not None else EngineConfig(
+            model=model, instance_type="ENCODE"
+        )
+        self._batch_window_s = max(
+            float(getattr(self.cfg, "encoder_batch_window_ms", 5.0)), 0.0
+        ) / 1000.0
+        bmax = max(int(getattr(self.cfg, "encoder_batch_max", 8)), 1)
+        # Clamp to a power of two: the towers pad batches UP to pow2, so
+        # a full admission window must never pad.
+        self._batch_max = 1 << (bmax.bit_length() - 1)
+        self.emb_cache = _EmbeddingLRU(
+            getattr(self.cfg, "encoder_cache_entries", 256)
+        )
+        self._admit_q: "queue.Queue[Optional[_PendingEncode]]" = queue.Queue()
+        self._batch_thread: Optional[threading.Thread] = None
+        self._batch_started = False
+
+        self.metrics = MetricsRegistry()
+        self.metrics.gauge(
+            "xllm_encoder_queue_depth",
+            "Media items waiting in the encoder micro-batcher admission "
+            "queue",
+        ).set_function(self._admit_q.qsize)
+        self._m_batches = self.metrics.counter(
+            "xllm_encoder_batches_total",
+            "Tower dispatches issued by the encoder micro-batcher",
+        )
+        self._m_batch_items = self.metrics.counter(
+            "xllm_encoder_batched_items_total",
+            "Media items served by micro-batcher tower dispatches",
+        )
+        self._m_occupancy = self.metrics.histogram(
+            "xllm_encoder_batch_occupancy",
+            "Media items coalesced per micro-batcher tower dispatch "
+            "(cross-request batching; 1 = no coalescing)",
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+        )
+        self.metrics.counter(
+            "xllm_encoder_cache_hits_total",
+            "Media items resolved from the encoder-local embedding cache "
+            "(tower dispatch skipped)",
+        ).set_function(lambda: self.emb_cache.hits)
+        self.metrics.counter(
+            "xllm_encoder_cache_misses_total",
+            "Media items that missed the encoder-local embedding cache",
+        ).set_function(lambda: self.emb_cache.misses)
+        self.metrics.counter(
+            "xllm_encoder_cache_evictions_total",
+            "Embedding-cache LRU evictions (heartbeat deltas retract the "
+            "fleet-index locations)",
+        ).set_function(lambda: self.emb_cache.evictions)
+
     # -- lifecycle ------------------------------------------------------
     def start(self) -> None:
-        pass
+        if not self._batch_started:
+            self._batch_started = True
+            # Fresh thread each start: a stopped engine restarted by a
+            # late encode_media must not re-start a dead Thread object.
+            self._batch_thread = threading.Thread(
+                target=self._batch_loop, name="encoder-batcher", daemon=True
+            )
+            self._batch_thread.start()
 
     def stop(self) -> None:
-        pass
+        if self._batch_started:
+            self._batch_started = False
+            self._admit_q.put(None)
+            if self._batch_thread is not None:
+                self._batch_thread.join(timeout=5.0)
 
     # -- heartbeat sources ---------------------------------------------
     def get_load_metrics(self) -> LoadMetrics:
         with self._mu:
-            return LoadMetrics(
-                waiting_requests_num=self._active, gpu_cache_usage_perc=0.0
-            )
+            active = self._active
+        return LoadMetrics(
+            waiting_requests_num=active + self._admit_q.qsize(),
+            gpu_cache_usage_perc=0.0,
+        )
 
     def get_latency_metrics(self, window_s: float = 30.0) -> LatencyMetrics:
         now = time.monotonic()
@@ -208,7 +396,16 @@ class EncoderEngine:
         return LatencyMetrics(recent_max_ttft=int(mx), recent_max_tbt=0)
 
     def take_cache_event(self) -> KvCacheEvent:
-        return KvCacheEvent()
+        """Heartbeat delta: embedding-LRU insertions/evictions since the
+        last beat (media content hashes). The master folds these into its
+        fleet embedding index (cluster/encoder_fabric.py)."""
+        return self.emb_cache.take_event()
+
+    def cache_snapshot_event(self) -> KvCacheEvent:
+        """Full embedding-LRU snapshot for a master-requested resync
+        (breaker ejection pruned this encoder's index locations; deltas
+        alone cannot rebuild them — docs/KV_CACHE.md contract)."""
+        return self.emb_cache.snapshot_event()
 
     def profiling_data(self):
         return [], []
@@ -236,3 +433,103 @@ class EncoderEngine:
 
     def encode_audio(self, mel: np.ndarray) -> np.ndarray:
         return self._timed(self.audio_executor.encode_audio, mel)
+
+    # -- encoder fabric: cache + cross-request micro-batcher -----------
+
+    def encode_media_submit(
+        self, kind: str, arr: np.ndarray, key: Optional[bytes] = None
+    ) -> _PendingEncode:
+        """Fabric entry point for ONE media item (kind: img|video|audio).
+        Checks the embedding LRU first (a hit resolves immediately —
+        re-sent media skips the tower); misses join the admission queue
+        where the batcher coalesces same-kind/-shape items from OTHER
+        requests into one tower dispatch. Non-blocking: callers submit
+        every item of a request before waiting, so a multi-item request
+        batches against itself too."""
+        p = _PendingEncode(kind, arr, key)
+        if key is not None:
+            cached = self.emb_cache.get(key)
+            if cached is not None:
+                p.resolve(cached)
+                return p
+        if not self._batch_started:
+            self.start()  # direct constructions (tests) skip start()
+        self._admit_q.put(p)
+        return p
+
+    def encode_media(
+        self, kind: str, arr: np.ndarray, key: Optional[bytes] = None,
+        timeout: float = 300.0,
+    ) -> np.ndarray:
+        return self.encode_media_submit(kind, arr, key).result(timeout)
+
+    def _batch_loop(self) -> None:
+        while True:
+            item = self._admit_q.get()
+            if item is None:
+                return
+            batch = [item]
+            deadline = time.monotonic() + self._batch_window_s
+            while len(batch) < self._batch_max:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    # Deadline-bounded: whatever coalesced, dispatches.
+                    break
+                try:
+                    nxt = self._admit_q.get(timeout=timeout)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._admit_q.put(None)  # re-post the stop sentinel
+                    break
+                batch.append(nxt)
+            self._dispatch_batch(batch)
+
+    def _dispatch_batch(self, batch: List[_PendingEncode]) -> None:
+        """One gathered admission window: group by (kind, shape) — only
+        identical geometries stack — dedup identical content keys inside
+        a group (two requests racing the same image encode once), then
+        one tower dispatch per stackable group; videos dispatch per item
+        (their token count varies with frame count)."""
+        groups: Dict[tuple, List[_PendingEncode]] = {}
+        for p in batch:
+            groups.setdefault((p.kind, tuple(p.arr.shape)), []).append(p)
+        for (kind, _shape), group in groups.items():
+            try:
+                if kind == "video":
+                    for p in group:
+                        out = self._timed(self.executor.encode_video, p.arr)
+                        self._finish_item(p, out, [p])
+                        self._m_batches.inc()
+                        self._m_batch_items.inc()
+                        self._m_occupancy.observe(1)
+                    continue
+                uniq: Dict[object, List[_PendingEncode]] = {}
+                for p in group:
+                    uniq.setdefault(
+                        p.key if p.key is not None else id(p), []
+                    ).append(p)
+                fn = (
+                    self.encode_audio if kind == "audio" else self.encode
+                )
+                stacked = np.stack([ps[0].arr for ps in uniq.values()])
+                out = fn(stacked)  # [U, tokens, D]
+                for row, ps in zip(out, uniq.values()):
+                    self._finish_item(ps[0], row, ps)
+                self._m_batches.inc()
+                self._m_batch_items.inc(len(group))
+                self._m_occupancy.observe(len(group))
+            except BaseException as e:  # noqa: BLE001 — resolve waiters
+                for p in group:
+                    if not p._event.is_set():
+                        p.resolve(None, e)
+
+    def _finish_item(
+        self, first: _PendingEncode, out: np.ndarray,
+        waiters: List[_PendingEncode],
+    ) -> None:
+        out = np.asarray(out, np.float32)
+        if first.key is not None:
+            self.emb_cache.put(first.key, out)
+        for p in waiters:
+            p.resolve(out)

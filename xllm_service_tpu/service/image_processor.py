@@ -46,6 +46,24 @@ _IMAGE_DATA_RE = re.compile(
 )
 
 
+def media_content_hash(kind: str, shape, data_b64: str) -> str:
+    """Content key for one media item as the encode stage will see it:
+    16-byte blake2b over (kind, shape, payload), hex-encoded. Hashed at
+    the front door AFTER preprocessing, so two byte-different uploads of
+    the same pixels (PNG vs JPEG re-encode) key differently while a
+    re-sent identical payload in a multi-turn chat keys identically —
+    the property the encoder-fabric embedding cache needs (docs/EPD.md).
+    The digest width matches the KV block hashes so the same
+    KvCacheEvent/heartbeat plumbing can carry embedding-index deltas."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    h.update(kind.encode())
+    h.update(("x".join(str(int(s)) for s in shape)).encode())
+    h.update(data_b64.encode())
+    return h.hexdigest()
+
+
 def decode_image_url(url: str) -> Optional[np.ndarray]:
     """`data:image/...;base64` URL -> uint8 RGB [H, W, 3], or None if the
     URL is not an image data URL (the raw-f32 tensor backdoor and error

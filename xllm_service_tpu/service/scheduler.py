@@ -30,6 +30,7 @@ from typing import Callable, Deque, Dict, List, Optional
 
 import numpy as np
 
+from xllm_service_tpu.cluster.encoder_fabric import EncoderFabric
 from xllm_service_tpu.cluster.global_kvcache_mgr import GlobalKVCacheMgr
 from xllm_service_tpu.cluster.instance_mgr import HealthState, InstanceMgr
 from xllm_service_tpu.cluster.policies import LoadBalancePolicy, make_policy
@@ -299,6 +300,13 @@ class Scheduler:
             config, self._instance_mgr, self._kvcache_mgr,
             metrics=self.metrics,
         )
+        # Encoder fabric (cluster/encoder_fabric.py, docs/EPD.md): the
+        # fleet media-embedding index behind hit-aware encoder routing.
+        # Fed by ENCODE-role heartbeat cache deltas; pruned/resynced with
+        # the same breaker hardening as the KV index.
+        self.encoder_fabric = EncoderFabric(
+            config, self._instance_mgr, metrics=self.metrics,
+        )
         self._policy: LoadBalancePolicy = make_policy(
             config.load_balance_policy,
             self._instance_mgr,
@@ -315,6 +323,9 @@ class Scheduler:
         self._instance_mgr.add_removal_listener(self._on_instance_removed)
         self._instance_mgr.add_removal_listener(
             self._kvcache_mgr.remove_instance
+        )
+        self._instance_mgr.add_removal_listener(
+            self.encoder_fabric.remove_instance
         )
         # Stale-location pruning: an EJECTED instance's KV-index locations
         # would otherwise linger until lease expiry, letting cache-aware
@@ -775,13 +786,31 @@ class Scheduler:
             return Status(StatusCode.UNAVAILABLE, "no instances registered")
         if request.media_parts:
             # Three-stage EPD routing: the encoder runs before prefill.
-            # Route by MODALITY — encoders host one tower each.
+            # Route by MODALITY — encoders host one tower each — and,
+            # with the encoder fabric on, by live queue depth + embedding
+            # cache hits instead of blind round-robin (docs/EPD.md). The
+            # index match always runs (the fleet hit-rate gauge must not
+            # flatline during an A/B hatch flip); only the routing
+            # consumer is hatch-gated.
             required = {
                 {2: "audio", 4: "video"}.get(len(p["shape"]), "image")
                 for p in request.media_parts
             }
+            hit_scores = None
+            try:
+                media_hashes = EncoderFabric.hashes_of(request.media_parts)
+                matched = (
+                    self.encoder_fabric.match(media_hashes)
+                    if media_hashes else {}
+                )
+                if self.encoder_fabric.enabled():
+                    hit_scores = matched
+            except Exception:
+                logger.exception("encoder-fabric match failed")
             request.routing.encode_name = (
-                self._instance_mgr.next_encode_instance(required)
+                self._instance_mgr.next_encode_instance(
+                    required, hit_scores=hit_scores
+                )
             )
             if not request.routing.encode_name:
                 return Status(
@@ -1013,11 +1042,23 @@ class Scheduler:
         ]
         if not parts:
             return None
+        from xllm_service_tpu.service.image_processor import (
+            media_content_hash,
+        )
+
         media_parts = []
         for p in parts:
             part, err = self._decode_media_part(p)
             if err is not None:
                 return err
+            # Content key for the encoder-fabric embedding cache + the
+            # master's fleet index (docs/EPD.md): keyed on what the
+            # encode stage will actually see, so a re-sent item in a
+            # multi-turn chat hits regardless of which encoder served it.
+            part["hash"] = media_content_hash(
+                {2: "audio", 4: "video"}.get(len(part["shape"]), "img"),
+                part["shape"], part["data"],
+            )
             media_parts.append(part)
         k = self._config.mm_tokens_per_media
         marker_re = re.compile(
@@ -1497,6 +1538,10 @@ class Scheduler:
         index once the instance is reachable again."""
         if state == HealthState.EJECTED:
             self._kvcache_mgr.remove_instance(name)
+            # Encoder fabric parity: an ejected encoder's embedding-index
+            # locations are phantom hits for hit-aware routing too; the
+            # same armed resync rebuilds them from its LRU snapshot.
+            self.encoder_fabric.remove_instance(name)
             with self._mu:
                 self._cache_resync_needed.add(name)
 
@@ -1799,7 +1844,18 @@ class Scheduler:
                 self._instance_mgr.health_state(name)
                 != HealthState.EJECTED
             ):
-                self._kvcache_mgr.record_updated_kvcaches(name, cache_event)
+                meta = self._instance_mgr.get_instance(name)
+                if meta is not None and meta.current_type.name == "ENCODE":
+                    # ENCODE-role deltas are embedding-LRU transitions
+                    # keyed by media content hashes, not KV block hashes:
+                    # they feed the fleet embedding index, never the KV
+                    # index (a media hash colliding into prefix scoring
+                    # would score phantom KV hits).
+                    self.encoder_fabric.record_event(name, cache_event)
+                else:
+                    self._kvcache_mgr.record_updated_kvcaches(
+                        name, cache_event
+                    )
         if load_metrics is not None:
             self._instance_mgr.record_load_metrics_update(name, load_metrics)
         if latency_metrics is not None:
