@@ -98,6 +98,12 @@ _GUARD_MIN_CPUS = int(os.environ.get("XLLM_BENCH_GUARD_MIN_CPUS", 4))
 # same run — the pipeline paying MORE than it hides is a regression.
 _OVERLAP_MIN_RATIO = float(os.environ.get("XLLM_BENCH_OVERLAP_MIN_RATIO", 0.92))
 
+# Mixed-vs-split attention A/B guard (--attention-mode both): the fused
+# mixed-step engine (one ragged dispatch per iteration, docs/KERNELS.md)
+# must hold at least this fraction of split-step throughput — fusing the
+# hot loop can never be allowed to regress silently (ISSUE 9).
+_RAGGED_MIN_RATIO = float(os.environ.get("XLLM_BENCH_RAGGED_MIN_RATIO", 0.95))
+
 
 def _cpu_regression_guard(line: str) -> "tuple[str, int]":
     """Apply the >5% clean-load CPU decode regression guard — and the
@@ -156,6 +162,38 @@ def _cpu_regression_guard(line: str) -> "tuple[str, int]":
                 f"{100 * _OVERLAP_MIN_RATIO:.0f}% of sync mode {s:.1f}"
             )
             rc = rc or 3
+    # Attention-mode A/B (--attention-mode both): the mixed (ragged) step
+    # builder vs the split-step escape hatch.
+    ab = res.get("attention_bench") or {}
+    if isinstance(ab, dict) and "split" in ab and "ragged" in ab:
+        try:
+            s = float(ab["split"]["tok_s"])
+            g = float(ab["ragged"]["tok_s"])
+        except (KeyError, TypeError, ValueError):
+            s = g = 0.0
+        # The rows must have RUN the builders they are labeled as — an
+        # XLLM_MIXED_STEP env override wins over the per-run config, and
+        # a split-vs-split comparison stamping "ok" would defeat the
+        # guard's whole purpose.
+        builders = (
+            ab["split"].get("step_builder"),
+            ab["ragged"].get("step_builder"),
+        )
+        if builders != ("split", "ragged"):
+            res["engine_ragged_guard"] = (
+                f"abstained: step_builder {builders[0]}/{builders[1]} — "
+                f"an env override pinned the builder (XLLM_MIXED_STEP?)"
+            )
+        elif s <= 0:
+            pass
+        elif g >= _RAGGED_MIN_RATIO * s:
+            res["engine_ragged_guard"] = "ok"
+        else:
+            res["engine_ragged_guard"] = (
+                f"FAIL: mixed (ragged) engine {g:.1f} tok/s is below "
+                f"{100 * _RAGGED_MIN_RATIO:.0f}% of split mode {s:.1f}"
+            )
+            rc = rc or 3
     return json.dumps(res), rc
 
 
@@ -182,6 +220,18 @@ def main() -> None:
                 f"--engine-mode must be sync|overlap|both, got {engine_mode!r}"
             )
 
+    # --attention-mode {split,ragged,both}: mixed (ragged) stepping vs the
+    # split-step escape hatch (docs/KERNELS.md), mirroring --engine-mode.
+    # Default "both" reports the A/B pair and arms the ragged guard.
+    attention_mode = "both"
+    if "--attention-mode" in sys.argv:
+        attention_mode = sys.argv[sys.argv.index("--attention-mode") + 1]
+        if attention_mode not in ("split", "ragged", "both"):
+            raise SystemExit(
+                f"--attention-mode must be split|ragged|both, "
+                f"got {attention_mode!r}"
+            )
+
     backend = _probe_backend()
     on_tpu = backend == "tpu"
     # Fastest config first; fall back if a path that never ran on real
@@ -202,7 +252,8 @@ def main() -> None:
     last_err = None
     for attempt in attempts:
         rc, out, err = _run_attempt_subprocess(
-            dict(attempt, engine_mode=engine_mode, _on_tpu=on_tpu)
+            dict(attempt, engine_mode=engine_mode,
+                 attention_mode=attention_mode, _on_tpu=on_tpu)
         )
         line = ""
         for ln in out.splitlines():
@@ -228,12 +279,15 @@ def main() -> None:
     raise SystemExit(f"all bench configs failed: {last_err}")
 
 
-def _engine_bench(sync: bool) -> dict:
+def _engine_bench(sync: bool, mixed: bool = True) -> dict:
     """Full-InferenceEngine decode throughput (llama3-tiny, R=8) in one
     stepping mode: R seeded requests driven to completion through the real
     admission/decode/emit path. Reports tokens/s plus the pipeline
-    instruments — mean host_gap_ms (host bookkeeping between steps) and the
-    fraction of decode steps dispatched with another step in flight."""
+    instruments — mean host_gap_ms (host bookkeeping between steps), the
+    fraction of decode steps dispatched with another step in flight, the
+    fraction of dispatches that fused prefill rows with the decode batch
+    (`mixed` stepping, docs/KERNELS.md), and the RESOLVED attention
+    kernel the engine's dispatches actually route to."""
     import numpy as np
 
     from xllm_service_tpu.common.config import EngineConfig
@@ -251,6 +305,7 @@ def _engine_bench(sync: bool) -> dict:
         max_seq_len=256,
         prefill_buckets=[32, 64, 128, 256],
         sync_engine=sync,
+        enable_mixed_step=mixed,
     )
     eng = InferenceEngine(cfg, executor=ModelExecutor(cfg))
     rng = np.random.default_rng(0)
@@ -285,7 +340,7 @@ def _engine_bench(sync: bool) -> dict:
     repeats = int(os.environ.get("XLLM_BENCH_ENGINE_REPEATS", 3))
     gap0, gsteps0 = eng.host_gap_ms_sum, eng.host_gap_steps
     ov0, disp0 = eng.overlap_steps, eng.decode_dispatches
-    disc0 = eng.late_stop_discards
+    disc0, mix0 = eng.late_stop_discards, eng.mixed_steps
     dts, toks = [], 0
     for r in range(repeats):
         n, dt = run_once(f"t{r}")
@@ -294,14 +349,31 @@ def _engine_bench(sync: bool) -> dict:
     dt = float(np.median(dts))
     gap_steps = max(eng.host_gap_steps - gsteps0, 1)
     dispatches = max(eng.decode_dispatches - disp0, 1)
+    # The builder the engine actually RAN, not the config knob: sync mode
+    # (and spec decode) forces the split path even with mixed enabled.
+    mixed_ran = eng.mixed_step_enabled and not eng._force_sync
     return {
         "mode": "sync" if sync else "overlap",
+        "step_builder": "ragged" if mixed_ran else "split",
+        # The dispatch decision the engine RESOLVED for the step builder
+        # it actually ran — the fused step's kernel (ragged vs the
+        # mixed[<decode>+<prefill>] reference pair), or the split
+        # builder's separate pair — not the raw env var (ISSUE 9
+        # satellite).
+        "kernel": (
+            eng._kernel_names["mixed"] if mixed_ran
+            else f"split[{eng._kernel_names['decode']}+"
+            f"{eng._kernel_names['prefill']}]"
+        ),
         "tok_s": round(toks / dt, 1),
         "host_gap_ms_mean": round(
             (eng.host_gap_ms_sum - gap0) / gap_steps, 3
         ),
         "overlap_step_frac": round(
             (eng.overlap_steps - ov0) / dispatches, 3
+        ),
+        "mixed_step_frac": round(
+            (eng.mixed_steps - mix0) / dispatches, 3
         ),
         "late_stop_discards": eng.late_stop_discards - disc0,
         "requests": R,
@@ -312,7 +384,8 @@ def _engine_bench(sync: bool) -> dict:
 def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
          use_kernel: bool | None = None,
          weight_dtype: str = "auto",
-         engine_mode: str = "both") -> None:
+         engine_mode: str = "both",
+         attention_mode: str = "both") -> None:
     import jax
 
     from xllm_service_tpu.common.config import EngineConfig
@@ -352,6 +425,12 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
     try:
         ex = ModelExecutor(cfg)
         bs = ex.block_size
+        # The dispatch decisions the serving paths RESOLVE for this
+        # cache/geometry (ops.attention.resolved_kernel_report) — the
+        # record gets which kernel actually runs, not the raw env var.
+        kernel_rep = (
+            ex.kernel_report() if hasattr(ex, "kernel_report") else {}
+        )
         rng = np.random.default_rng(0)
 
         # Fill every slot with a prefilled context of prompt_len tokens via the
@@ -535,6 +614,7 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
         # tunnel each engine.step pays ~100 ms of dispatch latency, which
         # would measure the tunnel, not the pipeline).
         engine_bench = None
+        attention_bench = None
         if not on_tpu and not os.environ.get("XLLM_BENCH_SKIP_ENGINE_AB"):
             engine_bench = {}
             modes = (
@@ -543,6 +623,23 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
             )
             for m in modes:
                 engine_bench[m] = _engine_bench(sync=(m == "sync"))
+            # Mixed-vs-split attention A/B (--attention-mode, ISSUE 9):
+            # same full-engine harness, overlapped stepping, toggling
+            # ONLY the step builder (ragged mixed batch vs alternating
+            # prefill/decode). "ragged" reuses the engine_bench overlap
+            # row when present — identical config, no second run.
+            attention_bench = {}
+            amodes = (
+                ("split", "ragged") if attention_mode == "both"
+                else (attention_mode,)
+            )
+            for m in amodes:
+                if m == "ragged" and "overlap" in engine_bench:
+                    attention_bench[m] = engine_bench["overlap"]
+                else:
+                    attention_bench[m] = _engine_bench(
+                        sync=False, mixed=(m == "ragged")
+                    )
 
         xla_cost = None
         if os.environ.get("XLLM_BENCH_XLA_COST"):
@@ -565,13 +662,15 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
             "prefill_tok_s": round(prefill_tok_s, 1),
             "prefill_mfu": prefill_mfu,
             "attention_kernel": (
-                "forced-off" if use_kernel is False else os.environ.get(
-                    "XLLM_PAGED_ATTENTION_KERNEL", "default")
+                "gather (forced-off)" if use_kernel is False
+                else kernel_rep.get("decode", "unknown")
             ),
             "prefill_kernel": (
-                "forced-off" if use_kernel is False else os.environ.get(
-                    "XLLM_PREFILL_ATTENTION_KERNEL", "default")
+                "blockwise (forced-off)" if use_kernel is False
+                else kernel_rep.get("prefill", "unknown")
             ),
+            "mixed_kernel": kernel_rep.get("mixed"),
+            "mq_kernel": kernel_rep.get("mq"),
             "kv_cache_dtype": cfg.kv_cache_dtype,
             "weight_dtype": cfg.weight_dtype,
             # Analytic roofline expectations ("roofline_ref" names the
@@ -594,6 +693,12 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
             # escape hatch (engine_overlap_guard enforces it).
             "engine_bench": engine_bench,
             "engine_mode": engine_mode,
+            # Mixed-vs-split attention A/B (--attention-mode): one ragged
+            # dispatch per iteration vs the alternating split-step escape
+            # hatch — engine_ragged_guard (exit 3) enforces ragged ≥ 95%
+            # of split (docs/KERNELS.md).
+            "attention_bench": attention_bench,
+            "attention_mode": attention_mode,
             # Methodology markers: median of N repeats, the per-repeat
             # spread, and the host's 1-min load average around the run —
             # a hot host shows up here instead of masquerading as a

@@ -81,7 +81,11 @@ def test_burst_shares_compiled_steps():
 
     exe._prefill_group = counting
 
-    eng = InferenceEngine(_cfg(), executor=exe)
+    # Split stepping: this test counts _prefill_group calls, i.e. the
+    # SPLIT batched-prefill plumbing (the escape hatch since ISSUE 9).
+    # The mixed-step equivalent (a burst riding few fused dispatches) is
+    # covered in tests/test_ragged_attention.py.
+    eng = InferenceEngine(_cfg(enable_mixed_step=False), executor=exe)
     done = []
     rng = np.random.default_rng(7)
     # Enqueue BEFORE starting the engine so one _admit sees the full burst.

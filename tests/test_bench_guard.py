@@ -20,6 +20,7 @@ def _anchor(monkeypatch):
     monkeypatch.setattr(bench, "_GUARD_LOADAVG_CEILING", 1.0)
     monkeypatch.setattr(bench, "_GUARD_MIN_CPUS", 1)
     monkeypatch.setattr(bench, "_OVERLAP_MIN_RATIO", 0.92)
+    monkeypatch.setattr(bench, "_RAGGED_MIN_RATIO", 0.95)
 
 
 def _line(**kw):
@@ -117,3 +118,59 @@ def test_overlap_guard_abstains_on_hot_host():
     )
     assert rc == 0
     assert "engine_overlap_guard" not in json.loads(out)
+
+
+# ---- mixed-vs-split attention A/B guard (--attention-mode both; one
+# ragged dispatch per engine step vs the split-step escape hatch,
+# docs/KERNELS.md) ----
+
+
+def _ab(split_tok, ragged_tok):
+    return {
+        "split": {"step_builder": "split", "tok_s": split_tok},
+        "ragged": {"step_builder": "ragged", "tok_s": ragged_tok},
+    }
+
+
+def test_ragged_at_parity_passes():
+    out, rc = bench._cpu_regression_guard(
+        _line(attention_bench=_ab(100.0, 96.0))
+    )
+    assert rc == 0
+    assert json.loads(out)["engine_ragged_guard"] == "ok"
+
+
+def test_ragged_regression_fails():
+    out, rc = bench._cpu_regression_guard(
+        _line(attention_bench=_ab(100.0, 90.0))
+    )
+    assert rc == 3
+    assert json.loads(out)["engine_ragged_guard"].startswith("FAIL")
+
+
+def test_ragged_guard_needs_both_modes():
+    # --attention-mode split|ragged runs one mode: nothing to A/B.
+    out, rc = bench._cpu_regression_guard(
+        _line(attention_bench={"ragged": {"tok_s": 50.0}})
+    )
+    assert rc == 0
+    assert "engine_ragged_guard" not in json.loads(out)
+
+
+def test_ragged_guard_abstains_on_hot_host():
+    out, rc = bench._cpu_regression_guard(
+        _line(value=100.0, loadavg_1m=3.0, attention_bench=_ab(100.0, 10.0))
+    )
+    assert rc == 0
+    assert "engine_ragged_guard" not in json.loads(out)
+
+
+def test_ragged_guard_abstains_on_builder_mismatch():
+    # XLLM_MIXED_STEP pins the builder over the per-run config: both rows
+    # ran split, so a passing ratio would be vacuous — the guard must
+    # abstain loudly rather than stamp "ok" on split-vs-split.
+    ab = _ab(100.0, 96.0)
+    ab["ragged"]["step_builder"] = "split"
+    out, rc = bench._cpu_regression_guard(_line(attention_bench=ab))
+    assert rc == 0
+    assert json.loads(out)["engine_ragged_guard"].startswith("abstained")

@@ -64,6 +64,16 @@ def test_dram_to_ssd_demotion_and_reimport(tmp_path):
         return orig(batch)
 
     exe.prefill_batch = spy
+    # Prefill rides the FUSED mixed step by default (ISSUE 9,
+    # docs/KERNELS.md) — watch both entry points so the start_pos
+    # assertions hold under either step builder.
+    morig = exe.mixed_start
+
+    def mixed_spy(batch, *args, **kwargs):
+        items.extend(batch)
+        return morig(batch, *args, **kwargs)
+
+    exe.mixed_start = mixed_spy
     engine = InferenceEngine(cfg, executor=exe)
     engine.start()
     try:
@@ -128,6 +138,16 @@ class _EngineHarness:
             return orig(items)
 
         self.exe.prefill_batch = spy
+        # Watch the fused mixed step too (the default builder since
+        # ISSUE 9) — same PrefillItem contract, so start_pos assertions
+        # are step-builder-agnostic.
+        morig = self.exe.mixed_start
+
+        def mixed_spy(items, *args, **kwargs):
+            self.prefill_items.extend(items)
+            return morig(items, *args, **kwargs)
+
+        self.exe.mixed_start = mixed_spy
         self.engine = InferenceEngine(self.cfg, executor=self.exe)
         self.engine.start()
 

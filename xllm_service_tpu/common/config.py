@@ -223,6 +223,20 @@ class EngineConfig:
     # speculative decoding always forces sync (docs/ENGINE_PIPELINE.md).
     sync_engine: bool = False
 
+    # Mixed (ragged) stepping. True (default) = the engine step builder
+    # emits ONE batch per iteration — all active decode slots PLUS the due
+    # chunked-prefill rows — served by a single compiled mixed step
+    # (models.<family>.mixed_step via executor.mixed_start), so prefill
+    # and decode stop competing for alternating engine steps
+    # (docs/KERNELS.md). Whether the attention inside that step runs as
+    # ONE ragged Pallas dispatch or as the split decode+prefill kernels is
+    # a separate hatch (XLLM_RAGGED_ATTENTION_KERNEL — opt-in until
+    # chip-validated). False = the split-step escape hatch (prefill batch
+    # then decode step, the pre-ISSUE-9 hot loop). Env override
+    # XLLM_MIXED_STEP=1|0 wins either way; guided/speculative/sync
+    # iterations and MLA families always run split.
+    enable_mixed_step: bool = True
+
     # Speculative decoding (prompt-lookup / n-gram drafting; 0 disables).
     # Each decode step drafts this many tokens per sequence by matching the
     # newest suffix n-gram against the sequence's own history, verifies all

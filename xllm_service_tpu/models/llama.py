@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from xllm_service_tpu.models.configs import ModelConfig
 from xllm_service_tpu.ops import kv_cache as kv_cache_ops
 from xllm_service_tpu.ops.attention import (
+    mixed_attention,
     paged_attention,
     prefill_attention,
 )
@@ -364,6 +365,142 @@ def decode_step(
     )
     logits = _unembed(params, cfg, x)  # [R, V]
     return logits, k_caches, v_caches
+
+
+def mixed_step(
+    params: Params,
+    cfg: ModelConfig,
+    k_caches: jnp.ndarray,
+    v_caches: jnp.ndarray,
+    dec_tokens: jnp.ndarray,  # [R] int32 — decode-slot input tokens
+    dec_positions: jnp.ndarray,  # [R] int32
+    dec_tables: jnp.ndarray,  # [R, CBd] int32
+    dec_active: jnp.ndarray,  # [R] bool
+    pf_tokens: jnp.ndarray,  # [P, Lpad] int32 — due prefill chunks
+    pf_start: jnp.ndarray,  # [P] int32 (cached tokens before each chunk)
+    pf_len: jnp.ndarray,  # [P] int32 (valid tokens per chunk; 0 = pad row)
+    pf_tables: jnp.ndarray,  # [P, CBp] int32
+    use_ragged: bool | None = None,
+    lora_dec: jnp.ndarray | None = None,  # [R] adapter rows
+    lora_pf: jnp.ndarray | None = None,  # [P] adapter rows
+    rope_delta: jnp.ndarray | None = None,  # [R] M-RoPE lag (decode slots)
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """ONE compiled step for a MIXED batch: R decode slots and P chunked-
+    prefill rows in a single dispatch, fused at the DISPATCH and
+    ATTENTION level but NOT at the dense matmuls: each half runs with
+    exactly the shapes decode_step ([R, E]) and prefill_batch_step
+    (vmapped [P, Lpad]) would use, because matmul row values are only
+    bit-stable under a fixed row count — flattening both halves into one
+    [R + P*Lpad, E] buffer made mixed-step streams drift from split-step
+    streams at bf16 ULP scale (docs/KERNELS.md pins this contract; the
+    engine-level differential in tests/test_ragged_attention.py enforces
+    it). Attention runs through ops.attention.mixed_attention — one
+    ragged Pallas dispatch over both halves when the kernel is enabled,
+    the exact split-path decode+prefill attention ops otherwise.
+
+    Returns (dec_logits [R, V], pf_logits [P, V] — each prefill row's
+    LAST valid position — k', v')."""
+    bs = k_caches.shape[3]
+    scale = cfg.head_dim**-0.5
+    R = dec_tokens.shape[0]
+    P, Lpad = pf_tokens.shape
+    wd = wdtype(params["layers"]["wq"])
+    x_dec = _embed(params, cfg, dec_tokens, wd)  # [R, E]
+    x_pf = _embed(params, cfg, pf_tokens, wd)  # [P, Lpad, E]
+
+    # Decode-half coordinates: verbatim decode_step (M-RoPE rope_delta
+    # shifts the rotation only; inactive slots scatter into garbage
+    # block 0).
+    dec_rope = (
+        dec_positions + rope_delta if rope_delta is not None
+        else dec_positions
+    )
+    dec_blk = jnp.take_along_axis(
+        dec_tables, (dec_positions // bs)[:, None], axis=1
+    )[:, 0]
+    dec_blk = jnp.where(dec_active, dec_blk, 0)
+    dec_off = jnp.where(dec_active, dec_positions % bs, 0)
+    dec_seq_lens = jnp.where(dec_active, dec_positions + 1, 0)
+
+    # Prefill-half coordinates: verbatim prefill_batch_step (invalid
+    # rows land in garbage block 0). Media prompts never ride the mixed
+    # step, so positions are always the plain sequential streams.
+    offsets = jnp.arange(Lpad, dtype=jnp.int32)[None, :]
+    pf_positions = pf_start[:, None] + offsets  # [P, Lpad]
+    pf_valid = offsets < pf_len[:, None]
+    pf_blk = jnp.where(
+        pf_valid,
+        jnp.take_along_axis(pf_tables, pf_positions // bs, axis=1),
+        0,
+    )
+    pf_off = jnp.where(pf_valid, pf_positions % bs, 0)
+    pf_flat_blk = pf_blk.reshape(P * Lpad)
+    pf_flat_off = pf_off.reshape(P * Lpad)
+    li = lora_pf if lora_pf is not None else jnp.zeros((P,), jnp.int32)
+
+    def layer_fn(carry, scanned):
+        x_dec, x_pf = carry
+        lp, k_l, v_l = scanned
+        # Decode half QKV: decode_step's [R, E] shapes.
+        h_dec = rms_norm(x_dec, lp["attn_norm"], cfg.rms_norm_eps)
+        q_dec, k_dec, v_dec = _qkv(lp, cfg, h_dec, dec_rope, lora_dec)
+        # Prefill half QKV: prefill_batch_step's vmapped [Lpad, E] rows.
+        h_pf = rms_norm(x_pf, lp["attn_norm"], cfg.rms_norm_eps)
+        q_pf, k_pf, v_pf = jax.vmap(
+            lambda hx, pos, ai: _qkv(
+                lp, cfg, hx, pos, ai if lora_pf is not None else None
+            )
+        )(h_pf, pf_positions, li)  # q_pf [P, Lpad, Hq, D]
+        k_l, v_l = _scatter_kv(k_l, v_l, dec_blk, dec_off, k_dec, v_dec)
+        k_l, v_l = _scatter_kv(
+            k_l, v_l, pf_flat_blk, pf_flat_off,
+            k_pf.reshape(P * Lpad, *k_pf.shape[2:]),
+            v_pf.reshape(P * Lpad, *v_pf.shape[2:]),
+        )
+        attn_dec, attn_pf = mixed_attention(
+            q_dec, q_pf, k_l, v_l,
+            dec_tables, dec_seq_lens,
+            pf_tables, pf_start, pf_len,
+            scale, use_ragged=use_ragged, interpret=interpret,
+            window=cfg.sliding_window,
+        )
+        # Output projection + MLP, per half, split-step shapes.
+        attn_dec_flat = attn_dec.reshape(attn_dec.shape[0], -1)
+        o = jnp.einsum("rh,he->re", attn_dec_flat,
+                       wt(lp["wo"]).reshape(-1, cfg.hidden_size))
+        d = lora_ops.maybe_apply(lp, "wo", attn_dec_flat, lora_dec, 1.0)
+        x_dec = x_dec + (o + d if d is not None else o)
+        h_dec = rms_norm(x_dec, lp["mlp_norm"], cfg.rms_norm_eps)
+        x_dec = x_dec + _mlp(lp, cfg, h_dec, lora_dec)
+
+        attn_pf_flat = attn_pf.reshape(P, Lpad, -1)
+        o = jnp.einsum("plh,he->ple", attn_pf_flat,
+                       wt(lp["wo"]).reshape(-1, cfg.hidden_size))
+        if lora_pf is not None and lp.get("lora_wo_a") is not None:
+            o = o + jax.vmap(
+                lambda af, ai: lora_ops.apply(
+                    af, lp["lora_wo_a"], lp["lora_wo_b"], ai
+                )
+            )(attn_pf_flat, li)
+        x_pf = x_pf + o
+        h_pf = rms_norm(x_pf, lp["mlp_norm"], cfg.rms_norm_eps)
+        x_pf = x_pf + jax.vmap(
+            lambda t, ai: _mlp(
+                lp, cfg, t, ai if lora_pf is not None else None
+            )
+        )(h_pf, li)
+        return (x_dec, x_pf), (k_l, v_l)
+
+    (x_dec, x_pf), (k_caches, v_caches) = jax.lax.scan(
+        layer_fn, (x_dec, x_pf), (params["layers"], k_caches, v_caches)
+    )
+    dec_logits = _unembed(params, cfg, x_dec)  # [R, V]
+    last = jnp.take_along_axis(
+        x_pf, jnp.maximum(pf_len - 1, 0)[:, None, None], axis=1
+    )[:, 0]  # [P, E]
+    pf_logits = _unembed(params, cfg, last)  # [P, V]
+    return dec_logits, pf_logits, k_caches, v_caches
 
 
 def prefill_batch_step(

@@ -275,6 +275,17 @@ def _gqa_kernel_ok(k_cache, on: bool) -> bool:
     return _kernel_tile_ok(k_cache, kvc.raw(k_cache).shape[-1], on)
 
 
+def gqa_kernel_eligible(k_cache, q_head_dim: int, on: bool) -> bool:
+    """THE tile/lane/packing eligibility gate for every GQA Pallas path
+    (decode, flash prefill, multi-query verify, ragged mixed) — one
+    predicate instead of a per-dispatcher copy of the `_kernel_tile_ok`
+    + `_packed_kernel_allowed` pair (ISSUE 9 satellite). `on` is the
+    platform gate (_on_tpu() or interpret)."""
+    return _gqa_kernel_ok(k_cache, on) and _packed_kernel_allowed(
+        _pack_ratio(k_cache, q_head_dim)
+    )
+
+
 def _mla_kernel_ok(c_cache, on: bool) -> bool:
     return _kernel_tile_ok(c_cache, kvc.raw(c_cache).shape[-1], on)
 
@@ -306,9 +317,9 @@ def prefill_attention(
     # Packed-pair caches (head_dim < 128): queries embed block-diagonally
     # into the 128-lane rows; outputs slice back (pack_queries docstring).
     pack, kv_heads, q_packed = kernel_io_for(k_cache, q)
-    kernel_ok = _gqa_kernel_ok(
-        k_cache, _on_tpu() or interpret
-    ) and _packed_kernel_allowed(pack)
+    kernel_ok = gqa_kernel_eligible(
+        k_cache, q.shape[-1], _on_tpu() or interpret
+    )
 
     # Speculative-verify shapes (a handful of query rows per sequence):
     # the multi-query decode kernel streams each KV row ONCE like a decode
@@ -577,9 +588,7 @@ def paged_attention(
 
     env = os.environ.get("XLLM_PAGED_ATTENTION_KERNEL")
     if use_kernel is None:
-        kernel_ok = _gqa_kernel_ok(
-            k_cache, _on_tpu()
-        ) and _packed_kernel_allowed(_pack_ratio(k_cache, q.shape[-1]))
+        kernel_ok = gqa_kernel_eligible(k_cache, q.shape[-1], _on_tpu())
         use_kernel = (env != "0") if kernel_ok else (env == "1")
     if use_kernel:
         try:
@@ -600,3 +609,275 @@ def paged_attention(
     return paged_attention_gather(
         q, k_cache, v_cache, block_table, seq_lens, scale, window=window
     )
+
+
+# ------------------------------------------------ ragged mixed batches
+# One attention call for a batch mixing chunked-prefill rows (arbitrary
+# query length, prefix-aware start offsets) and decode rows (query length
+# 1) over the same paged KV — the Ragged Paged Attention shape (arxiv
+# 2604.15464; docs/KERNELS.md). The flattened-query contract:
+#
+#   q        [T, Hq, D]   — all rows' query tokens, segment-concatenated
+#   seg_lens tuple (static) — per-row segment CAPACITY; sum == T. A row's
+#                             tokens live at [q_lo[b], q_lo[b]+q_len[b])
+#                             with q_lo = exclusive prefix sum of seg_lens
+#   q_len    [B] int32    — valid tokens per row (<= seg_lens[b]; 0 = dead)
+#   pos0     [B] int32    — ABSOLUTE position of the row's first query
+#                             token (prefix hits / decode context offset)
+#   tables   [B, CB]      — per-row block table
+#
+# Row b's token j sits at absolute position pos0[b]+j and attends cache
+# positions 0..pos0[b]+j (causal; `window` restricts to the trailing
+# window). Decode rows are seg_lens[b] == 1 with pos0 = seq_len - 1.
+
+
+def ragged_attention_blockwise(
+    q: jnp.ndarray,  # [T, Hq, D] flattened ragged queries
+    k_cache,
+    v_cache,
+    block_tables: jnp.ndarray,  # [B, CB]
+    q_len: jnp.ndarray,  # [B] int32
+    pos0: jnp.ndarray,  # [B] int32
+    seg_lens: tuple,  # static per-row segment capacities
+    scale: float,
+    window: int = 0,
+) -> jnp.ndarray:
+    """Blockwise oracle for the ragged mixed contract: each row runs the
+    chunked-prefill blockwise scan (prefill_attention_blockwise handles
+    query length 1 — a decode row — exactly like the decode gather, and
+    arbitrary ragged lengths with prefix offsets). Exact; the CPU/parity
+    reference for ops/pallas/ragged_paged_attention.py. Returns
+    [T, Hq, D] with dead rows (q_len 0) zeroed."""
+    outs = []
+    off = 0
+    for b, seg in enumerate(seg_lens):
+        out_b = prefill_attention_blockwise(
+            q[off:off + seg], k_cache, v_cache, block_tables[b],
+            pos0[b], q_len[b], scale, window=window,
+        )
+        # Blockwise emits acc/l with l=0 rows zeroed already; mask the
+        # padded tail explicitly so dead segments are deterministic.
+        valid = (
+            jnp.arange(seg, dtype=jnp.int32)[:, None, None] < q_len[b]
+        )
+        outs.append(jnp.where(valid, out_b, 0).astype(q.dtype))
+        off += seg
+    return jnp.concatenate(outs, axis=0)
+
+
+def ragged_kernel_enabled(
+    k_cache, q_head_dim: int, use_kernel: bool | None = None,
+    interpret: bool = False,
+) -> bool:
+    """Dispatch decision for the ragged mixed kernel. Follows the repo's
+    opt-in-until-chip-validated convention: the kernel is NEW silicon
+    surface (queued in scripts/validate_kernel_tpu.py as ragged-*), so
+    the default is OFF even on TPU until parity lands —
+    XLLM_RAGGED_ATTENTION_KERNEL=1 opts in, =0 forces the reference
+    path, and `interpret` (the XLLM_RAGGED_INTERPRET CI hook) opts in
+    on its own — the hook exists to DRIVE the kernel branch on CPU, so
+    it must select it, not merely flavor it (=0 still wins).
+    Tile/lane/packing eligibility via the shared gate."""
+    import os
+
+    if use_kernel is not None:
+        return use_kernel and gqa_kernel_eligible(
+            k_cache, q_head_dim, _on_tpu() or interpret
+        )
+    env = os.environ.get("XLLM_RAGGED_ATTENTION_KERNEL")
+    if env == "0":
+        return False
+    return (env == "1" or interpret) and gqa_kernel_eligible(
+        k_cache, q_head_dim, _on_tpu() or interpret
+    )
+
+
+def ragged_paged_attention(
+    q: jnp.ndarray,  # [T, Hq, D]
+    k_cache,
+    v_cache,
+    block_tables: jnp.ndarray,  # [B, CB]
+    q_len: jnp.ndarray,  # [B]
+    pos0: jnp.ndarray,  # [B]
+    seg_lens: tuple,
+    scale: float,
+    use_kernel: bool | None = None,
+    interpret: bool = False,
+    window: int = 0,
+) -> jnp.ndarray:
+    """Ragged mixed-batch paged attention: ONE Pallas dispatch over
+    prefill + decode rows when the kernel is enabled
+    (ragged_kernel_enabled), blockwise oracle otherwise. GQA head packing
+    rides the kernel_io_for/pack_queries contract like every other GQA
+    kernel path; int8 caches stream pool-native grouped scales."""
+    if ragged_kernel_enabled(k_cache, q.shape[-1], use_kernel, interpret):
+        from xllm_service_tpu.ops.pallas.ragged_paged_attention import (
+            ragged_paged_attention_kernel,
+        )
+
+        pack, kv_heads, q_packed = kernel_io_for(k_cache, q)
+        return unpack_outputs(
+            ragged_paged_attention_kernel(
+                q_packed, k_cache, v_cache, block_tables,
+                q_len, pos0, seg_lens, scale,
+                interpret=interpret, window=window,
+            ),
+            pack, kv_heads,
+        )
+    return ragged_attention_blockwise(
+        q, k_cache, v_cache, block_tables, q_len, pos0, seg_lens, scale,
+        window=window,
+    )
+
+
+def mixed_attention(
+    q_dec: jnp.ndarray,  # [R, Hq, D] — decode slots (some inactive)
+    q_pf: jnp.ndarray,  # [P, Lpad, Hq, D] — prefill chunk rows
+    k_cache,
+    v_cache,
+    dec_tables: jnp.ndarray,  # [R, CBd]
+    dec_seq_lens: jnp.ndarray,  # [R] context INCLUDING this token; 0 = off
+    pf_tables: jnp.ndarray,  # [P, CBp]
+    pf_start: jnp.ndarray,  # [P]
+    pf_len: jnp.ndarray,  # [P]
+    scale: float,
+    use_ragged: bool | None = None,
+    interpret: bool = False,
+    window: int = 0,
+):
+    """Attention for one MIXED engine step (models.llama.mixed_step):
+    decode slots and chunked-prefill rows against the same paged KV.
+
+    Ragged kernel on: the whole batch flattens into ONE Pallas dispatch
+    (seg_lens = R decode singletons + P Lpad segments). Otherwise the
+    reference path runs each half through its own serving dispatcher —
+    the Pallas decode kernel + flash prefill on TPU, gather + blockwise
+    on CPU — so mixed-step outputs match the split engine's byte for
+    byte while still fusing the rest of the step into one dispatch.
+    The halves may carry different context-bucket table widths (the
+    executor buckets each exactly like its split program); the ragged
+    flatten pads the narrower table with garbage-block-0 entries, which
+    the kernel's context bound never walks."""
+    R = q_dec.shape[0]
+    P, Lpad = q_pf.shape[0], q_pf.shape[1]
+    if ragged_kernel_enabled(
+        k_cache, q_dec.shape[-1], use_ragged, interpret
+    ):
+        seg_lens = (1,) * R + (Lpad,) * P
+        q_flat = jnp.concatenate(
+            [q_dec, q_pf.reshape(P * Lpad, *q_pf.shape[2:])], axis=0
+        )
+        CB = max(dec_tables.shape[1], pf_tables.shape[1])
+        dt = jnp.pad(dec_tables, ((0, 0), (0, CB - dec_tables.shape[1])))
+        pt = jnp.pad(pf_tables, ((0, 0), (0, CB - pf_tables.shape[1])))
+        tables = jnp.concatenate([dt, pt], axis=0)
+        q_len = jnp.concatenate(
+            [jnp.minimum(dec_seq_lens, 1), pf_len]
+        ).astype(jnp.int32)
+        pos0 = jnp.concatenate(
+            [jnp.maximum(dec_seq_lens - 1, 0), pf_start]
+        ).astype(jnp.int32)
+        out = ragged_paged_attention(
+            q_flat, k_cache, v_cache, tables, q_len, pos0, seg_lens,
+            scale, use_kernel=True, interpret=interpret, window=window,
+        )
+        return out[:R], out[R:].reshape(q_pf.shape)
+    # Reference pair: EXACTLY the split engine's dispatchers. interpret
+    # is deliberately NOT forwarded — it is the ragged-branch CI hook,
+    # and leaking it here would flip the prefill half onto the
+    # interpret-mode flash kernel while split-step engines run
+    # blockwise, breaking the mixed ≡ split byte-parity contract.
+    dec_out = paged_attention(
+        q_dec, k_cache, v_cache, dec_tables, dec_seq_lens, scale,
+        window=window,
+    )
+    pf_out = prefill_attention(
+        q_pf, k_cache, v_cache, pf_tables, pf_start, pf_len, scale,
+        window=window,
+    )
+    return dec_out, pf_out
+
+
+def resolved_kernel_report(
+    k_cache, q_head_dim: int, ragged_interpret: bool = False
+) -> dict:
+    """The dispatch decisions the serving paths would take RIGHT NOW for
+    this cache/geometry — what actually runs, not which env var is set
+    (bench.py reports these; ISSUE 9 satellite: `attention_kernel:
+    default` told the record nothing). Values name the winning
+    implementation; a path whose env hatch forces it off reports the
+    fallback with a ` (forced-off)` marker."""
+    import os
+
+    on = _on_tpu()
+    eligible = gqa_kernel_eligible(k_cache, q_head_dim, on)
+
+    def resolve(env_name: str, kernel: str, fallback: str) -> str:
+        env = os.environ.get(env_name)
+        if env == "0":
+            return f"{fallback} (forced-off)"
+        if env == "1":
+            return kernel
+        return kernel if eligible else fallback
+
+    dec = resolve("XLLM_PAGED_ATTENTION_KERNEL", "paged", "gather")
+    pf = resolve("XLLM_PREFILL_ATTENTION_KERNEL", "flash", "blockwise")
+    ragged = (
+        "ragged"
+        if ragged_kernel_enabled(
+            k_cache, q_head_dim, interpret=ragged_interpret
+        )
+        else (
+            "split (forced-off)"
+            if os.environ.get("XLLM_RAGGED_ATTENTION_KERNEL") == "0"
+            else "split"
+        )
+    )
+    kq = isinstance(k_cache, kvc.PagedKV) and k_cache.quantized
+    mq_env = os.environ.get("XLLM_MQ_ATTENTION_KERNEL")
+    # The prefill dispatcher's function-wide kill switch covers its mq
+    # branch too (prefill_attention requires != "0"), so the report must
+    # mirror it — mq never runs with the prefill kernels forced off.
+    mq_on = (
+        eligible
+        and os.environ.get("XLLM_PREFILL_ATTENTION_KERNEL") != "0"
+        and (mq_env == "1" if kq else mq_env != "0")
+    )
+    return {
+        "decode": dec,
+        "prefill": pf,
+        "mixed": ragged,
+        "mq": "mq" if mq_on else "blockwise",
+    }
+
+
+def resolved_mla_kernel_report(c_cache) -> dict:
+    """MLA counterpart of resolved_kernel_report: mirrors the actual
+    dispatch decisions of mla_paged_attention / mla_prefill_attention —
+    including the _mla_kernel_ok tile/platform gate those dispatchers
+    apply — not just the env vars. MLA families keep split stepping
+    (docs/KERNELS.md)."""
+    import os
+
+    ok = _mla_kernel_ok(c_cache, _on_tpu())
+    quantized = isinstance(c_cache, kvc.PagedKV) and c_cache.quantized
+    dec_env = os.environ.get("XLLM_MLA_ATTENTION_KERNEL")
+    pf_env = os.environ.get("XLLM_MLA_PREFILL_KERNEL")
+    mq_env = os.environ.get("XLLM_MQ_ATTENTION_KERNEL")
+    # mla_paged_attention: opt-in (env == "1") AND tile-eligible.
+    dec = "mla" if (dec_env == "1" and ok) else "gather"
+    # mla_prefill_attention: default-on for eligible bf16 latents
+    # (kernel_ok = ok and not quantized); env == "1" forces, "0" kills.
+    pf_ok = ok and not quantized
+    if (pf_env != "0") if pf_ok else (pf_env == "1"):
+        pf = "mla-flash"
+    elif pf_ok and pf_env == "0":
+        pf = "blockwise (forced-off)"
+    else:
+        pf = "blockwise"
+    return {
+        "decode": dec,
+        "prefill": pf,
+        "mixed": "split",
+        "mq": "mla-mq" if (ok and mq_env == "1") else "blockwise",
+    }

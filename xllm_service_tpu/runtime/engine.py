@@ -185,7 +185,7 @@ class _Seq:
         "prefilled", "chunk_len", "prefill_start_time", "head_hash",
         "json_state", "json_upto", "schema_spec",
         "rope_pos3", "rope_delta", "admit_gen", "streamed_blocks",
-        "stream_hashes", "admit_hashes",
+        "stream_hashes", "admit_hashes", "pf_dispatched",
     )
 
     def __init__(self, req: EngineRequest, slot: int):
@@ -238,6 +238,11 @@ class _Seq:
         # (preempt + same-pass resume into the same slot must not let the
         # stale in-flight token through the drain's identity check).
         self.admit_gen = 0
+        # Mixed (ragged) stepping: prompt tokens DISPATCHED through
+        # prefill chunks, >= `prefilled` while a chunk is in flight — the
+        # step builder cuts the next chunk from here so back-to-back
+        # chunks pipeline instead of waiting out each drain.
+        self.pf_dispatched = 0
 
 
 class _InFlight:
@@ -250,15 +255,23 @@ class _InFlight:
     between dispatch and drain gets its late token discarded: the
     one-step-late stop semantics, docs/ENGINE_PIPELINE.md)."""
 
-    __slots__ = ("tokens", "logprobs", "slots", "t0", "nactive", "total_ctx")
+    __slots__ = (
+        "tokens", "logprobs", "slots", "t0", "nactive", "total_ctx", "pf",
+    )
 
-    def __init__(self, tokens, logprobs, slots, t0, nactive, total_ctx):
+    def __init__(
+        self, tokens, logprobs, slots, t0, nactive, total_ctx, pf=(),
+    ):
         self.tokens = tokens
         self.logprobs = logprobs
         self.slots = slots
         self.t0 = t0
         self.nactive = nactive
         self.total_ctx = total_ctx
+        # Mixed (ragged) step: [(seq, admit_gen, row_idx, chunk_start,
+        # chunk_end)] prefill rows riding this dispatch — their sampled
+        # tokens sit at output index R + row_idx (docs/KERNELS.md).
+        self.pf = pf
 
 
 # The waiting queue holds fresh EngineRequests and preempted _Seqs (which
@@ -352,6 +365,32 @@ class InferenceEngine:
         )
         self._force_sync = self.sync_engine or engine_cfg.speculative_tokens > 0
 
+        # Mixed (ragged) stepping: the step builder emits ONE batch of
+        # decode slots + due prefill chunks per iteration
+        # (executor.mixed_start -> models.<family>.mixed_step ->
+        # ops.attention.mixed_attention; docs/KERNELS.md) instead of
+        # alternating a prefill step and a decode step. Split stepping is
+        # the escape hatch: enable_mixed_step=False or XLLM_MIXED_STEP=0
+        # (=1 force-enables over a False config); guided/sync/speculative
+        # iterations and model families without a mixed_step (MLA) fall
+        # back to split automatically.
+        _menv = _os.environ.get("XLLM_MIXED_STEP", "")
+        self.mixed_step_enabled = (
+            True if _menv == "1"
+            else False if _menv == "0"
+            else engine_cfg.enable_mixed_step
+        ) and getattr(self.executor, "supports_mixed", False)
+        # Test hook: drive the ragged Pallas kernel branch in interpret
+        # mode on CPU (the dispatcher convention every kernel follows).
+        self._ragged_interpret = (
+            _os.environ.get("XLLM_RAGGED_INTERPRET") == "1"
+        )
+        # Sequences mid-chunked-prefill under mixed stepping: they hold
+        # slot + blocks (like split mode's waiting-held mid-chunk seqs)
+        # but live HERE, keyed by request id, so the step builder can cut
+        # chunk c+1 while chunk c is still in flight.
+        self._pf_active: Dict[str, _Seq] = {}
+
         # Persistent decode-batch state: per-slot arrays mutated ONLY on
         # admit/finish/cancel/preempt (plus vectorized per-step position and
         # step-count advances) — the per-step O(R) SamplingBatch rebuild is
@@ -397,6 +436,7 @@ class InferenceEngine:
         self._inflight: Optional[_InFlight] = None
         # Overlap accounting (exported via metrics + bench --engine-mode).
         self.decode_dispatches = 0
+        self.mixed_steps = 0  # mixed dispatches actually carrying pf rows
         self.overlap_steps = 0
         self.late_stop_discards = 0
         self.loop_errors = 0
@@ -504,6 +544,51 @@ class InferenceEngine:
             "xllm_engine_loop_errors_total",
             "Engine-loop iterations that raised (loop stays alive)",
         ).set_function(lambda: self.loop_errors)
+        # Mixed (ragged) step instruments (docs/KERNELS.md +
+        # docs/OBSERVABILITY.md): how often the fused prefill+decode
+        # dispatch runs and how it composes.
+        self.metrics.counter(
+            "xllm_engine_mixed_steps_total",
+            "Engine steps that fused prefill chunk rows with the decode "
+            "batch in one dispatch",
+        ).set_function(lambda: self.mixed_steps)
+        self._m_mixed_pf_rows = self.metrics.histogram(
+            "xllm_engine_mixed_batch_prefill_rows",
+            "Prefill chunk rows per mixed dispatch",
+            buckets=BATCH_BUCKETS,
+        )
+        self._m_mixed_dec_rows = self.metrics.histogram(
+            "xllm_engine_mixed_batch_decode_rows",
+            "Active decode slots per mixed dispatch",
+            buckets=BATCH_BUCKETS,
+        )
+        # Resolved attention-dispatch accounting: which kernel actually
+        # served each engine dispatch (the env var alone told the record
+        # nothing — ISSUE 9). Names resolve once at engine build from the
+        # executor's cache/geometry (kernel choices are process-static:
+        # the jitted steps bake them in at first trace).
+        self._m_kernel_dispatch = self.metrics.counter(
+            "xllm_engine_kernel_dispatch_total",
+            "Engine device dispatches by resolved attention kernel",
+            labelnames=("kernel",),
+        )
+        rep = (
+            self.executor.kernel_report()
+            if hasattr(self.executor, "kernel_report") else {}
+        )
+        self._kernel_names = {
+            "decode": rep.get("decode", "unknown"),
+            "prefill": rep.get("prefill", "unknown"),
+            "mq": rep.get("mq", "unknown"),
+            # The report resolves XLLM_RAGGED_INTERPRET (incl. tile
+            # eligibility), so "ragged" here means the ragged branch
+            # actually dispatches — not merely that a hook is set.
+            "mixed": (
+                "ragged" if rep.get("mixed") == "ragged"
+                else f"mixed[{rep.get('decode', '?')}+"
+                f"{rep.get('prefill', '?')}]"
+            ),
+        }
         self.metrics.counter(
             "xllm_engine_kv_chunk_land_errors_total",
             "Streamed PD chunks that failed to land into the prefix "
@@ -584,6 +669,7 @@ class InferenceEngine:
         return bool(
             self._waiting
             or self._running
+            or self._pf_active
             or self._pending_imports
             or self._pending_kv_chunks
             or self._pending_exports
@@ -696,13 +782,34 @@ class InferenceEngine:
         self._drain_export_requests()
         self._drain_cancelled()
         self._maybe_flush_schema_rows()
+        if (
+            self.mixed_step_enabled
+            and not self._force_sync
+            and not self._guided_slots
+        ):
+            # Mixed (ragged) stepping: ONE dispatch carries the decode
+            # batch AND the due prefill chunks (docs/KERNELS.md).
+            return self._step_mixed()
+        produced0 = 0
+        if self._pf_active:
+            # Mode flip mid-prefill (a guided request went live /
+            # speculative turned on): drain the in-flight mixed step,
+            # then hand the held seqs to the split midchunk flow — they
+            # keep slot + blocks and continue FIRST, like any split-mode
+            # mid-chunk seq.
+            produced0 = self._flush_inflight()
+            with self._lock:
+                self._waiting.extendleft(
+                    reversed(list(self._pf_active.values()))
+                )
+            self._pf_active.clear()
         admitted = self._admit()
         if self._force_sync or self._guided_slots:
             produced = self._flush_inflight()
             produced += self._decode_once()
         else:
             produced = self._step_overlap()
-        return admitted + produced
+        return produced0 + admitted + produced
 
     def _step_overlap(self) -> int:
         """One pipeline iteration: dispatch decode step N+1 (fed from step
@@ -718,6 +825,196 @@ class InferenceEngine:
         produced = self._drain_step(self._inflight, None)
         self._inflight = None
         return produced
+
+    # ------------------------------------------------ mixed (ragged) step
+
+    def _step_mixed(self) -> int:
+        """One mixed-pipeline iteration: cut the due prefill chunks
+        (continuations first — they hold slots and blocks — then fresh
+        admissions), dispatch them FUSED with decode step N+1, then
+        drain/book step N while N+1 runs. Ineligible admissions (media /
+        guided / SP) prefill through the split path in the same
+        iteration; the overlap contract (device-resident decode
+        feedback, one-step-late stops) is unchanged
+        (docs/ENGINE_PIPELINE.md + docs/KERNELS.md)."""
+        items_meta: List[tuple] = []
+        budget = self._continue_pf_chunks(
+            items_meta, self.cfg.max_prefill_tokens
+        )
+        legacy = self._admit(mixed_collect=items_meta, budget=budget)
+        if self._guided_slots:
+            # A guided request went LIVE during this admission (legacy
+            # prefill path): its decode steps need mask rows, which only
+            # the sync path applies. Drain the pipeline, hand any held
+            # mixed seqs to the split midchunk flow, decode masked.
+            produced = self._flush_inflight()
+            if self._pf_active:
+                with self._lock:
+                    self._waiting.extendleft(
+                        reversed(list(self._pf_active.values()))
+                    )
+                self._pf_active.clear()
+            produced += self._decode_once()
+            return legacy + produced
+        nxt = self._dispatch_mixed(items_meta)
+        produced = self._drain_step(self._inflight, nxt)
+        self._inflight = nxt
+        return legacy + produced
+
+    def _continue_pf_chunks(self, items_meta: List[tuple],
+                            budget: int) -> int:
+        """Cut the next chunk for every mid-prefill seq (_pf_active) with
+        tokens left to dispatch. Back-to-back chunks PIPELINE: chunk c+1
+        is cut from `pf_dispatched` (the dispatched extent) while chunk c
+        is still in flight, so chunked prefill advances every iteration
+        like split mode — drain-side bookkeeping (`prefilled`, KV
+        streaming, finish) stays one step behind. The chunk-boundary
+        cache re-match runs at the DISPATCHED frontier even while a
+        chunk is in flight — in-flight chunks only write below the
+        frontier, so frontier-aligned adoption never touches their
+        blocks (see the call-site comment and _extend_midchunk_match).
+
+        One mixed dispatch carries ONE padded-length bucket (the first
+        due chunk's), exactly like _prefill_group's same-bucket grouping:
+        a prefill row's numerics are only byte-stable at a fixed Lpad, so
+        padding a short chunk to a longer peer's bucket would break
+        mixed ≡ split parity (docs/KERNELS.md). Mismatched seqs stop the
+        walk (FIFO head-of-line, like the split queue) and ride the next
+        iteration's dispatch."""
+        group_max = getattr(self.executor, "PREFILL_GROUP_MAX", 8)
+        bucket = None
+        for seq in list(self._pf_active.values()):
+            if budget <= 0 or len(items_meta) >= group_max:
+                break
+            if seq.pf_dispatched >= len(seq.tokens):
+                continue  # final chunk in flight; waiting on its drain
+            # Adopt blocks that landed since the last boundary (fabric
+            # fetch, streamed PD chunk, sibling commit) at the DISPATCHED
+            # frontier — live even while a chunk is in flight (the chunk
+            # writes only below the frontier). The hash chain covers at
+            # most tokens[:n-1], so at least the final token always
+            # remains to dispatch.
+            seq.pf_dispatched += self._extend_midchunk_match(
+                seq, frontier=seq.pf_dispatched
+            )
+            chunk = min(len(seq.tokens) - seq.pf_dispatched, budget)
+            b = self.executor.bucket_len(chunk)
+            if bucket is None:
+                bucket = b
+            elif b != bucket:
+                break
+            items_meta.append((seq, seq.pf_dispatched, chunk))
+            budget -= chunk
+        return budget
+
+    def _dispatch_mixed(self, items_meta: List[tuple]) -> Optional[_InFlight]:
+        """Dispatch decode step N+1 fused with the due prefill chunks as
+        ONE device step (executor.mixed_start). With no due chunks this
+        is exactly _dispatch_decode — the fused shapes only compile when
+        a mixed batch actually exists."""
+        from xllm_service_tpu.runtime.executor import PrefillItem
+
+        if not items_meta:
+            return self._dispatch_decode()
+        R = self.R
+        can = (
+            self._ps_active
+            & (self._ps_gen_count + self._ps_pending < self._ps_max_new)
+            & (
+                self._ps_tok_count + self._ps_pending
+                < self.cfg.max_seq_len
+            )
+        )
+        if can.any():
+            self._ensure_decode_capacity(1, mask=can)
+            can &= self._ps_active  # the capacity pass may have preempted
+        batch = self._sampling_batch_view()
+        prev = self._inflight
+        fresh_mask = self._fresh | ~can
+        assert prev is not None or bool(fresh_mask[can].all())
+        self._observe_host_gap()
+        t0 = time.monotonic()
+        items = []
+        pf_entries = []
+        for j, (seq, start, n) in enumerate(items_meta):
+            s = seq.req.sampling
+            table = np.zeros((self.max_blocks,), np.int32)
+            table[: len(seq.block_ids)] = seq.block_ids
+            final = start + n >= len(seq.tokens)
+            # First chunk: TTFT base. The unset check (0.0 = never set)
+            # covers a deferred first chunk whose start moved past
+            # num_cached via frontier adoption before it dispatched.
+            if start <= seq.num_cached or seq.prefill_start_time == 0.0:
+                seq.prefill_start_time = t0
+            items.append(PrefillItem(
+                token_ids=np.asarray(seq.tokens[start:start + n], np.int32),
+                start_pos=start,
+                block_table=table,
+                temperature=s.temperature,
+                top_k=s.top_k,
+                top_p=s.top_p,
+                seed=s.seed,
+                step=len(seq.generated),
+                presence=getattr(s, "presence_penalty", 0.0),
+                frequency=getattr(s, "frequency_penalty", 0.0),
+                # Final-chunk-only sampling features, exactly like the
+                # split path (_prefill_admitted): intermediate chunks'
+                # sampled tokens are discarded.
+                logit_bias=(
+                    tuple(getattr(s, "logit_bias", ()) or ())
+                    if final else ()
+                ),
+                adapter_idx=seq.req.adapter_idx,
+                min_p=getattr(s, "min_p", 0.0) if final else 0.0,
+                prior_tokens=(
+                    np.asarray([t for t, _ in seq.generated], np.int32)
+                    if seq.generated and final
+                    and (
+                        getattr(s, "presence_penalty", 0.0)
+                        or getattr(s, "frequency_penalty", 0.0)
+                    )
+                    else None
+                ),
+            ))
+            pf_entries.append((seq, seq.admit_gen, j, start, start + n))
+            seq.pf_dispatched = start + n
+        prev_tokens = prev.tokens[:R] if prev is not None else None
+        tokens, logprobs = self.executor.mixed_start(
+            items,
+            self._ps_last_tok,
+            fresh_mask,
+            prev_tokens,
+            self._ps_positions,
+            self._block_tables,
+            can,
+            batch,
+            interpret=self._ragged_interpret,
+        )
+        nactive = int(can.sum())
+        total_ctx = int(self._ps_positions[can].sum()) + nactive
+        snapshot = {}
+        for slot in np.nonzero(can)[0]:
+            seq = self._running[int(slot)]
+            snapshot[int(slot)] = (seq, seq.admit_gen)
+        self._ps_pending[can] += 1
+        self._ps_positions[can] += 1
+        self._ps_steps[can] += 1
+        self._fresh[can] = False
+        self._m_batch.observe(nactive)
+        self._m_steps.inc()
+        self.decode_dispatches += 1
+        self.mixed_steps += 1
+        self._m_mixed_pf_rows.observe(len(items))
+        self._m_mixed_dec_rows.observe(nactive)
+        self._m_kernel_dispatch.labels(
+            kernel=self._kernel_names["mixed"]
+        ).inc()
+        if prev is not None:
+            self.overlap_steps += 1
+        return _InFlight(
+            tokens, logprobs, snapshot, t0, nactive, total_ctx,
+            pf=pf_entries,
+        )
 
     # ------------------------------------------------------------ admission
 
@@ -747,17 +1044,38 @@ class InferenceEngine:
                 item.block_ids = []
                 self._free_slots.append(item.slot)
             self._notify_cancelled(self._item_req(item))
+        # Mixed-step mid-prefill seqs hold slot + blocks in _pf_active:
+        # release both; any chunk still in flight for them drains to a
+        # discard (the pf identity check below misses on the removed
+        # entry) — the freed blocks' device writes are ordered before any
+        # re-user's, exactly the late-stop-discard argument.
+        for rid, seq in list(self._pf_active.items()):
+            if rid in cancelled:
+                del self._pf_active[rid]
+                self.block_mgr.free(seq.block_ids)
+                seq.block_ids = []
+                self._free_slots.append(seq.slot)
+                self._notify_cancelled(seq.req)
         for slot, seq in list(self._running.items()):
             if seq.req.request_id in cancelled:
                 self._finish(seq, FinishReason.NONE, cancelled=True)
 
-    def _admit(self) -> int:
+    def _admit(self, mixed_collect=None, budget=None) -> int:
         """Admit waiting requests up to max_prefill_tokens and prefill them
         in BATCHED compiled steps (executor.prefill_batch groups by length
         bucket) — one slow prefill no longer serializes the whole queue and
         concurrent short prompts share a single device step (round-1 weak
-        item 4)."""
-        budget = self.cfg.max_prefill_tokens
+        item 4).
+
+        Mixed (ragged) stepping passes `mixed_collect`: freshly admitted
+        seqs ELIGIBLE for the fused step (plain text — no media/stream,
+        no guided mask, no SP-ring routing) are appended there (and
+        registered in _pf_active) instead of prefilling here; their
+        chunks ride the SAME dispatch as the decode batch
+        (_dispatch_mixed). Ineligible requests keep the split prefill
+        path below, in the same iteration."""
+        if budget is None:
+            budget = self.cfg.max_prefill_tokens
         pool_capacity = self.block_mgr.num_blocks - 1
         rejects: List[Tuple[EngineRequest, StatusCode, str]] = []
         batch: List[_Seq] = []
@@ -1006,6 +1324,28 @@ class InferenceEngine:
             seq.admit_hashes = hashes  # mid-prefill re-match walks these
             budget -= seq.chunk_len
             pending_hashes.update(hashes)
+            if mixed_collect is not None and self._mixed_eligible(seq):
+                seq.pf_dispatched = seq.prefilled
+                self._pf_active[seq.req.request_id] = seq
+                # One Lpad bucket per mixed dispatch (byte-parity with
+                # _prefill_group's same-bucket grouping): a seq whose
+                # first chunk pads differently still ADMITS now (slot +
+                # blocks held) but its chunk rides the next iteration's
+                # dispatch via _continue_pf_chunks.
+                if (
+                    len(mixed_collect) < getattr(
+                        self.executor, "PREFILL_GROUP_MAX", 8
+                    )
+                    and (
+                        not mixed_collect
+                        or self.executor.bucket_len(seq.chunk_len)
+                        == self.executor.bucket_len(mixed_collect[0][2])
+                    )
+                ):
+                    mixed_collect.append(
+                        (seq, seq.prefilled, seq.chunk_len)
+                    )
+                continue
             batch.append(seq)
 
         if deferred:
@@ -1043,50 +1383,65 @@ class InferenceEngine:
             return "media embeddings never arrived (stream deadline)"
         return "ready" if ms.ready_upto(pos_end) else "wait"
 
+    def _sp_eligible(self, s: _Seq) -> bool:
+        """Whether this seq routes through the sequence-parallel ring
+        prefill (prefill_long). The ring recomputes from position 0 (no
+        prefix reuse), so SP is only a win when the prompt is long AND
+        mostly uncached (uncached suffix >= 8x the cached prefix).
+        Mid-chunk seqs stay batched (the ring would discard landed
+        chunks); LoRA / min_p / logit_bias / guided / penalized-resume
+        requests stay batched because prefill_long samples without those
+        features. Shared by the split prefill router and the mixed-step
+        eligibility check."""
+        sp_thresh = self.cfg.sp_prefill_threshold
+        if sp_thresh <= 0 or not getattr(self.executor, "supports_sp", False):
+            return False
+        sp = s.req.sampling
+        penalized_resume = s.generated and (
+            getattr(sp, "presence_penalty", 0.0)
+            or getattr(sp, "frequency_penalty", 0.0)
+        )
+        return (
+            not s.req.has_media
+            and not s.req.adapter_idx
+            and not getattr(sp, "min_p", 0.0)
+            and not getattr(sp, "logit_bias", ())
+            and not s.req.guided
+            and not penalized_resume
+            and s.prefilled <= s.num_cached
+            and len(s.tokens) - s.num_cached >= sp_thresh
+            and len(s.tokens) - s.num_cached >= 8 * s.num_cached
+        )
+
+    def _mixed_eligible(self, seq: _Seq) -> bool:
+        """Whether a freshly admitted seq can ride the fused mixed step.
+        Media prompts (embedding injection + M-RoPE streams), streamed
+        encoder handoffs, guided requests (their final chunk samples
+        under a mask row, and a live guided slot forces split stepping
+        anyway), and SP-ring prompts keep the split prefill path.
+        prefill_only requests (the PD prefill role, incl. kv_stream
+        sessions) stay split too: they never decode — there is nothing
+        to fuse with — and their per-chunk KV exports are timed to the
+        synchronous prefill loop (docs/PD_DISAGGREGATION.md)."""
+        req = seq.req
+        return (
+            not req.has_media
+            and req.mm_stream is None
+            and not req.guided
+            and not req.prefill_only
+            and not self._sp_eligible(seq)
+        )
+
     def _prefill_admitted(self, batch: List[_Seq]) -> int:
         from xllm_service_tpu.runtime.executor import PrefillItem
         # Long-context path: prompts past the SP threshold prefill over the
         # mesh's sequence-parallel ring (ring attention) one at a time;
         # they skip prefix reuse (ring attends from position 0) and media
         # requests stay on the batched path (embedding injection).
-        sp_thresh = self.cfg.sp_prefill_threshold
-        if sp_thresh > 0 and getattr(self.executor, "supports_sp", False):
-            # The ring recomputes from position 0 (no prefix reuse), so SP
-            # is only a win when the prompt is long AND mostly uncached:
-            # a heavily prefix-cached prompt would trade a short batched
-            # suffix prefill for a full-prompt recompute and give up its
-            # cache hit. Require the uncached suffix to dominate (>= 8x)
-            # the cached prefix. Mid-chunk seqs (prefilled > num_cached)
-            # stay on the batched path — the ring would discard the chunks
-            # already landed.
-            # Penalized RESUMES (generated history + presence/frequency
-            # set) also stay batched: prefill_long samples without the
-            # penalty histogram, so routing one through SP would let the
-            # resumed token escape its penalties.
-            def _penalized_resume(s):
-                sp = s.req.sampling
-                return s.generated and (
-                    getattr(sp, "presence_penalty", 0.0)
-                    or getattr(sp, "frequency_penalty", 0.0)
-                )
-
-            sp_batch = [
-                s
-                for s in batch
-                if not s.req.has_media
-                # LoRA requests stay on the batched path: the SP ring
-                # prefill has no adapter application; likewise requests
-                # whose FIRST sampled token needs min_p / logit_bias /
-                # a guided mask — prefill_long samples without them
-                and not s.req.adapter_idx
-                and not getattr(s.req.sampling, "min_p", 0.0)
-                and not getattr(s.req.sampling, "logit_bias", ())
-                and not s.req.guided
-                and not _penalized_resume(s)
-                and s.prefilled <= s.num_cached
-                and len(s.tokens) - s.num_cached >= sp_thresh
-                and len(s.tokens) - s.num_cached >= 8 * s.num_cached
-            ]
+        if self.cfg.sp_prefill_threshold > 0 and getattr(
+            self.executor, "supports_sp", False
+        ):
+            sp_batch = [s for s in batch if self._sp_eligible(s)]
             if sp_batch:
                 batch = [s for s in batch if s not in sp_batch]
                 done = self._prefill_sp(sp_batch)
@@ -1180,8 +1535,17 @@ class InferenceEngine:
             )
         t0 = time.monotonic()
         for seq in batch:
-            if seq.prefilled <= seq.num_cached:
-                seq.prefill_start_time = t0  # first chunk: TTFT base
+            # First chunk: TTFT base. The unset check (0.0 = never set)
+            # covers a seq whose first chunk never dispatched before
+            # adoption advanced `prefilled` past num_cached (mixed-mode
+            # requeue after a mode flip).
+            if seq.prefilled <= seq.num_cached or (
+                seq.prefill_start_time == 0.0
+            ):
+                seq.prefill_start_time = t0
+        self._m_kernel_dispatch.labels(
+            kernel=self._kernel_names["prefill"]
+        ).inc(self._prefill_group_count(items))
         outs = self.executor.prefill_batch(items)
         now = time.monotonic()
         admitted = 0
@@ -1251,6 +1615,17 @@ class InferenceEngine:
         if alive and seq.req.prefill_only:
             self._handoff(seq)
 
+    def _prefill_group_count(self, items) -> int:
+        """How many compiled dispatches executor.prefill_batch will launch
+        for these items — executor.prefill_groups IS its grouping walk —
+        so the kernel-dispatch counter counts DEVICE dispatches, not
+        engine-level calls. Fake executors without bucketing count as
+        one."""
+        groups = getattr(self.executor, "prefill_groups", None)
+        if groups is None or not items:
+            return 1
+        return len(groups(items))
+
     def _prefill_sp(self, batch: List[_Seq]) -> int:
         """Ring-attention prefill for long prompts (one jitted call per
         sequence; the sp mesh ring IS the batch dimension here). The ring
@@ -1278,6 +1653,7 @@ class InferenceEngine:
             table[: len(seq.block_ids)] = seq.block_ids
             s = seq.req.sampling
             t0 = time.monotonic()
+            self._m_kernel_dispatch.labels(kernel="ring-sp").inc()
             tok, lp = self.executor.prefill_long(
                 np.asarray(seq.tokens, np.int32),
                 table,
@@ -1372,31 +1748,54 @@ class InferenceEngine:
 
     # ------------------------------------------------- prefix KV fabric
 
-    def _extend_midchunk_match(self, seq: _Seq) -> None:
+    def _extend_midchunk_match(self, seq: _Seq,
+                               frontier: Optional[int] = None) -> int:
         """Chunk-boundary cache pickup: if the NEXT un-prefilled blocks'
         hashes are now committed locally (they landed after admission —
         a fabric peer fetch, a streamed PD chunk, a sibling sequence's
         commit), swap the sequence's fresh blocks for the cached ones and
-        advance `prefilled` past them. This is what makes a peer fetch
-        genuinely OVERLAP chunked prefill of the uncovered tail: each
-        chunk boundary re-checks, so blocks that arrive mid-prefill are
+        advance past them. This is what makes a peer fetch genuinely
+        OVERLAP chunked prefill of the uncovered tail: each chunk
+        boundary re-checks, so blocks that arrive mid-prefill are
         adopted instead of recomputed. Only runs on block-aligned
         boundaries; `last_committed_block` is left alone so the normal
-        commit walk still registers this sequence's own chunks."""
+        commit walk still registers this sequence's own chunks.
+
+        `frontier=None` (the split prefill loop) adopts from and
+        advances `seq.prefilled`. The mixed step builder instead passes
+        its DISPATCHED frontier (`pf_dispatched`) so adoption stays live
+        under the chunk pipeline: an in-flight chunk writes only blocks
+        BELOW the frontier, every swapped block lies wholly beyond it,
+        and `prefilled` catches up when the next chunk — cut from the
+        advanced frontier — drains. Returns the tokens adopted (the
+        caller's frontier advance)."""
         hashes = seq.admit_hashes
         bs = self.block_size
+        start = seq.prefilled if frontier is None else frontier
         if (
             not hashes
-            or seq.prefilled % bs
+            or start % bs
             or seq.req.has_media
             or seq.req.adapter_idx
         ):
-            return
-        idx = seq.prefilled // bs
+            return 0
+        idx = start // bs
         adopted = 0
         while idx < len(hashes) and idx < len(seq.block_ids):
             bid = self.block_mgr.lookup_hash(hashes[idx])
-            if bid is None or bid == seq.block_ids[idx]:
+            if bid is None:
+                break
+            if bid == seq.block_ids[idx]:
+                # Already swapped in by a mixed-frontier adoption
+                # (frontier=pf_dispatched) before a mode flip requeued
+                # this seq: `prefilled` never caught up, so count the
+                # block covered NOW — cutting the next split chunk from
+                # `prefilled` would recompute KV into a CACHED block
+                # other live sequences hold references to.
+                if frontier is None and idx * bs >= seq.prefilled:
+                    seq.prefilled = (idx + 1) * bs
+                    idx += 1
+                    continue
                 break
             # Swap: take a cache reference on the committed block, release
             # this seq's never-written fresh block back to the pool.
@@ -1404,12 +1803,14 @@ class InferenceEngine:
             self.block_mgr.acquire_cached(bid)
             self.block_mgr.free([old])
             seq.block_ids[idx] = bid
-            seq.prefilled += bs
+            if frontier is None:
+                seq.prefilled += bs
             adopted += 1
             idx += 1
         if adopted:
             self.prefix_cached_tokens += adopted * bs
             self.midprefill_adopted_blocks += adopted
+        return adopted * bs
 
     def export_cached_blocks(
         self, hashes: List[bytes], timeout: float = 10.0
@@ -1963,6 +2364,9 @@ class InferenceEngine:
             active,
             batch,
         )
+        self._m_kernel_dispatch.labels(
+            kernel=self._kernel_names["decode"]
+        ).inc()
         step_ms = (time.monotonic() - t0) * 1000
         nactive = int(active.sum())
         total_ctx = int(self._ps_positions[active].sum()) + nactive
@@ -2034,12 +2438,17 @@ class InferenceEngine:
         tokens, logprobs = self.executor.decode_start(
             self._ps_last_tok,
             fresh_mask,
-            prev.tokens if prev is not None else None,
+            # A mixed in-flight step's output is [R + P]; the decode
+            # feedback is always the leading R slots.
+            prev.tokens[: self.R] if prev is not None else None,
             self._ps_positions,
             self._block_tables,
             can,
             batch,
         )
+        self._m_kernel_dispatch.labels(
+            kernel=self._kernel_names["decode"]
+        ).inc()
         nactive = int(can.sum())
         total_ctx = int(self._ps_positions[can].sum()) + nactive
         snapshot = {}
@@ -2101,6 +2510,36 @@ class InferenceEngine:
             self._commit_full_blocks(seq)
             produced += 1
             self._emit(seq, finished=self._check_stop(seq))
+        # Prefill rows riding a mixed dispatch: advance `prefilled`, keep
+        # the PD chunk stream fed, and on the FINAL chunk run the shared
+        # post-prefill bookkeeping (_finish_prefill installs the slot —
+        # the seq starts decoding host-fed next dispatch). A seq whose
+        # entry no longer matches _pf_active was cancelled after
+        # dispatch: its chunk's sampled token is discarded like any
+        # late-stop token. admit_gen guards the same _Seq object being
+        # re-admitted between dispatch and drain, like the decode-slot
+        # check above.
+        for seq, gen, j, c_start, c_end in flt.pf:
+            if (
+                self._pf_active.get(seq.req.request_id) is not seq
+                or seq.admit_gen != gen
+            ):
+                self.late_stop_discards += 1
+                continue
+            seq.prefilled = c_end
+            if c_end < len(seq.tokens):
+                self._stream_chunk_kv(seq)
+                produced += 1
+                continue
+            del self._pf_active[seq.req.request_id]
+            tok = int(tokens[self.R + j])
+            lp = float(logprobs[self.R + j])
+            fin = time.monotonic()
+            ms = (fin - seq.prefill_start_time) * 1000
+            self._finish_prefill(
+                seq, tok, lp, fin, ms, len(seq.tokens) - seq.num_cached
+            )
+            produced += 1
         self._t_host_free = time.monotonic()
         return produced
 
@@ -2587,6 +3026,9 @@ class InferenceEngine:
             batch.mask_rows = rows
 
         t0 = time.monotonic()
+        self._m_kernel_dispatch.labels(
+            kernel=self._kernel_names["mq"]
+        ).inc()
         tokens, logprobs, n_emit = self.executor.verify(
             token_ids,
             positions,

@@ -884,22 +884,24 @@ class ModelExecutor:
             b *= 2
         return min(b, cap)
 
-    def prefill_batch(self, items: List["PrefillItem"]) -> List[Tuple[int, float]]:
-        """Prefill several sequences' chunks in as few compiled steps as
-        possible. Items are grouped by padded-length bucket (so a short
-        prompt never pads to a long one's bucket) into chunks of
-        <= PREFILL_GROUP_MAX with bucketed (P, Lpad, CB) shapes; each chunk
-        is ONE jitted call (batched admission — round-1 weak item 4).
-        Returns per-item (first_token, logprob) in input order."""
+    def prefill_groups(
+        self, items: List["PrefillItem"]
+    ) -> List[List[int]]:
+        """Partition item indices into the compiled-dispatch groups
+        prefill_batch launches: sorted by padded-length bucket (so a
+        short prompt never pads to a long one's bucket), at most
+        PREFILL_GROUP_MAX same-bucket items per group. One group = one
+        jitted call — the engine's kernel-dispatch counter shares this
+        walk so it counts DEVICE dispatches."""
         order = sorted(
             range(len(items)),
             key=lambda i: self.bucket_len(len(items[i].token_ids)),
         )
-        results: List[Optional[Tuple[int, float]]] = [None] * len(items)
+        groups: List[List[int]] = []
         i = 0
         while i < len(order):
             bucket = self.bucket_len(len(items[order[i]].token_ids))
-            group_idx = []
+            group_idx: List[int] = []
             while (
                 i < len(order)
                 and len(group_idx) < self.PREFILL_GROUP_MAX
@@ -907,6 +909,18 @@ class ModelExecutor:
             ):
                 group_idx.append(order[i])
                 i += 1
+            groups.append(group_idx)
+        return groups
+
+    def prefill_batch(self, items: List["PrefillItem"]) -> List[Tuple[int, float]]:
+        """Prefill several sequences' chunks in as few compiled steps as
+        possible. Items are grouped by padded-length bucket (so a short
+        prompt never pads to a long one's bucket) into chunks of
+        <= PREFILL_GROUP_MAX with bucketed (P, Lpad, CB) shapes; each chunk
+        is ONE jitted call (batched admission — round-1 weak item 4).
+        Returns per-item (first_token, logprob) in input order."""
+        results: List[Optional[Tuple[int, float]]] = [None] * len(items)
+        for group_idx in self.prefill_groups(items):
             outs = self._prefill_group([items[g] for g in group_idx])
             for g, o in zip(group_idx, outs):
                 results[g] = o
@@ -1397,6 +1411,315 @@ class ModelExecutor:
             jnp.asarray(frequency, jnp.float32),
             use_kernel=use_kernel,
             **bias_kwargs,
+        )
+        return tokens, logprobs
+
+    # ------------------------------------------------------- mixed step
+
+    @property
+    def supports_mixed(self) -> bool:
+        """Whether this model family serves the fused mixed prefill+decode
+        step (runtime/engine.py ragged step builder). MLA families keep
+        the split steps until the ragged kernel grows a latent-row mode
+        (docs/KERNELS.md)."""
+        return hasattr(self.model_mod, "mixed_step")
+
+    def kernel_report(self) -> Dict[str, str]:
+        """Resolved attention-dispatch decisions for THIS executor's cache
+        and geometry — what bench.py reports instead of echoing raw env
+        vars (ISSUE 9 satellite)."""
+        if self.cfg.is_mla:
+            from xllm_service_tpu.ops.attention import (
+                resolved_mla_kernel_report,
+            )
+
+            # The latent cache rides the k slot (num_caches == 1).
+            return resolved_mla_kernel_report(self.k_cache)
+        from xllm_service_tpu.ops.attention import resolved_kernel_report
+
+        return resolved_kernel_report(
+            self.k_cache, self.cfg.head_dim,
+            ragged_interpret=(
+                os.environ.get("XLLM_RAGGED_INTERPRET") == "1"
+            ),
+        )
+
+    def _mixed_impl(
+        self,
+        k_cache,
+        v_cache,
+        counts,  # [R, V] int32 generated-token histogram (donated)
+        params,
+        # --- decode half: identical contract to _decode_impl ---
+        fresh_tokens,  # [R]
+        fresh_mask,  # [R] bool
+        prev_tokens,  # [R] device-resident feedback (overlap pipeline)
+        positions,  # [R]
+        dec_tables,  # [R, CB]
+        active,  # [R] bool
+        temperature,
+        top_k,
+        top_p,
+        step_keys,
+        presence,
+        frequency,
+        # --- prefill half: identical contract to _prefill_impl ---
+        pf_tokens,  # [P, Lpad]
+        pf_start,  # [P]
+        pf_len,  # [P] (0 = padded lane)
+        pf_tables,  # [P, CB]
+        pf_temperature,
+        pf_top_k,
+        pf_top_p,
+        pf_keys,
+        bias_ids=None,
+        bias_vals=None,
+        min_p=None,
+        rope_delta=None,
+        lora_dec=None,  # [R] adapter rows (decode slots)
+        lora_pf=None,  # [P] adapter rows (prefill rows)
+        pf_counts=None,
+        pf_presence=None,
+        pf_frequency=None,
+        pf_bias_ids=None,
+        pf_bias_vals=None,
+        pf_min_p=None,
+        use_ragged=None,
+        interpret=False,
+    ):
+        """One fused engine step: decode slots + due prefill chunks in a
+        single compiled dispatch (models.<family>.mixed_step). Sampling
+        for each half runs the SAME ops with the SAME key schedules as
+        the split _decode_impl/_prefill_impl, and the model halves keep
+        their split-program shapes (mixed_step docstring), so the
+        emitted streams are byte-identical to split stepping
+        (tests/test_ragged_attention.py pins it). Output layout: decode
+        slots first ([:R] feeds the next overlapped dispatch
+        device-side), then the P prefill rows."""
+        token_ids = jnp.where(fresh_mask, fresh_tokens, prev_tokens)
+        dec_logits, pf_logits, k_cache, v_cache = self.model_mod.mixed_step(
+            params,
+            self.cfg,
+            k_cache,
+            v_cache,
+            token_ids,
+            positions,
+            dec_tables,
+            active,
+            pf_tokens,
+            pf_start,
+            pf_len,
+            pf_tables,
+            use_ragged=use_ragged,
+            lora_dec=lora_dec,
+            lora_pf=lora_pf,
+            rope_delta=rope_delta,
+            interpret=interpret,
+        )
+        tokens, logprob, _ = sampling_ops.sample_tokens(
+            dec_logits, temperature, top_k, top_p, step_keys,
+            counts=counts, presence=presence, frequency=frequency,
+            bias_ids=bias_ids, bias_vals=bias_vals, min_p=min_p,
+        )
+        counts = counts.at[
+            jnp.arange(tokens.shape[0]), tokens
+        ].add(active.astype(jnp.int32))
+        pf_tokens_out, pf_logprob, _ = sampling_ops.sample_tokens(
+            pf_logits, pf_temperature, pf_top_k, pf_top_p, pf_keys,
+            counts=pf_counts, presence=pf_presence, frequency=pf_frequency,
+            bias_ids=pf_bias_ids, bias_vals=pf_bias_vals, min_p=pf_min_p,
+        )
+        return (
+            k_cache,
+            v_cache,
+            counts,
+            jnp.concatenate([tokens, pf_tokens_out]),
+            jnp.concatenate([logprob, pf_logprob]),
+        )
+
+    def mixed_start(
+        self,
+        items: List["PrefillItem"],  # due prefill chunks (<= GROUP_MAX)
+        fresh_tokens: np.ndarray,  # [R] host-fed decode input ids
+        fresh_mask: Optional[np.ndarray],  # [R] bool; None = all fresh
+        prev_tokens,  # device [R] int32 from the prior step, or None
+        positions: np.ndarray,  # [R]
+        block_tables: np.ndarray,  # [R, max_blocks_per_seq]
+        active: np.ndarray,  # [R] bool
+        batch: SamplingBatch,
+        use_ragged: Optional[bool] = None,
+        interpret: bool = False,
+    ):
+        """Dispatch ONE mixed prefill+decode step without fetching results:
+        returns (tokens, logprobs) device arrays of width R + Ppad —
+        decode slots at [:R] (the overlap pipeline's device-resident
+        feedback slice), prefill row j at R + j. The engine's ragged step
+        builder is the only caller (docs/KERNELS.md); media/M-RoPE/guided
+        items never reach here (routed to the split prefill path)."""
+        R = self.R
+        n_pf = len(items)
+        P = self._pow2_bucket(max(n_pf, 1), self.PREFILL_GROUP_MAX)
+        Lpad = self.bucket_len(
+            max((len(it.token_ids) for it in items), default=1)
+        )
+        bs = self.block_size
+        # Each half buckets its context width EXACTLY like its split
+        # program (decode_start / _prefill_group) — the bucket cadence is
+        # part of the byte-parity contract (a different table width means
+        # a different compiled program for that half).
+        need_d = 1
+        if active.any():
+            need_d = int(
+                (np.asarray(positions)[np.asarray(active)].max() // bs) + 1
+            )
+        CBd = self._pow2_bucket(need_d, self.max_blocks_per_seq)
+        need_p = max(
+            ((it.start_pos + len(it.token_ids) + bs - 1) // bs
+             for it in items),
+            default=1,
+        )
+        CBp = self._pow2_bucket(max(need_p, 1), self.max_blocks_per_seq)
+
+        keys = sampling_ops.make_step_keys(
+            jnp.asarray(batch.seeds, jnp.uint32),
+            jnp.asarray(batch.steps, jnp.int32),
+        )
+        zeros = np.zeros((R,), np.float32)
+        presence = batch.presence if batch.presence is not None else zeros
+        frequency = batch.frequency if batch.frequency is not None else zeros
+
+        pf_tokens = np.zeros((P, Lpad), np.int32)
+        pf_start = np.zeros((P,), np.int32)
+        pf_len = np.zeros((P,), np.int32)
+        pf_tables = np.zeros((P, CBp), np.int32)
+        pf_temps = np.zeros((P,), np.float32)
+        pf_top_k = np.zeros((P,), np.int32)
+        pf_top_p = np.ones((P,), np.float32)
+        pf_seeds = np.zeros((P,), np.uint32)
+        pf_steps = np.zeros((P,), np.int32)
+        for i, it in enumerate(items):
+            n = len(it.token_ids)
+            pf_tokens[i, :n] = it.token_ids
+            pf_start[i] = it.start_pos
+            pf_len[i] = n
+            m = min(CBp, len(it.block_table))
+            pf_tables[i, :m] = np.asarray(it.block_table[:m], np.int32)
+            pf_temps[i] = it.temperature
+            pf_top_k[i] = it.top_k
+            pf_top_p[i] = it.top_p
+            pf_seeds[i] = it.seed & 0xFFFFFFFF
+            pf_steps[i] = it.step
+        pf_keys = sampling_ops.make_step_keys(
+            jnp.asarray(pf_seeds), jnp.asarray(pf_steps, jnp.int32)
+        )
+
+        opt = {}
+        if batch.bias_ids is not None:
+            opt.update(
+                bias_ids=jnp.asarray(batch.bias_ids, jnp.int32),
+                bias_vals=jnp.asarray(batch.bias_vals, jnp.float32),
+            )
+        if batch.min_p is not None:
+            opt.update(min_p=jnp.asarray(batch.min_p, jnp.float32))
+        if batch.rope_delta is not None:
+            opt.update(rope_delta=jnp.asarray(batch.rope_delta, jnp.int32))
+        # LoRA rides per half, gated exactly like the split programs
+        # (decode_start keys on batch.adapter_idx, _prefill_group on any
+        # item adapter) — an adapter on one half must not flip the other
+        # half onto the lora-apply path.
+        if batch.adapter_idx is not None:
+            opt.update(
+                lora_dec=jnp.asarray(batch.adapter_idx, jnp.int32)
+            )
+        if any(it.adapter_idx for it in items):
+            opt.update(
+                lora_pf=jnp.asarray(
+                    [it.adapter_idx for it in items] + [0] * (P - n_pf),
+                    jnp.int32,
+                )
+            )
+        b_ids, b_vals = sampling_ops.pack_logit_bias(
+            [it.logit_bias for it in items] + [()] * (P - n_pf), P
+        )
+        if b_ids is not None:
+            opt.update(
+                pf_bias_ids=jnp.asarray(b_ids),
+                pf_bias_vals=jnp.asarray(b_vals),
+            )
+        if any(it.min_p for it in items):
+            opt.update(
+                pf_min_p=jnp.asarray(
+                    [it.min_p for it in items] + [0.0] * (P - n_pf),
+                    jnp.float32,
+                )
+            )
+        if any(
+            it.prior_tokens is not None and len(it.prior_tokens)
+            for it in items
+        ):
+            cnts = np.zeros((P, self.cfg.vocab_size), np.int32)
+            pres = np.zeros((P,), np.float32)
+            freq = np.zeros((P,), np.float32)
+            for i, it in enumerate(items):
+                pres[i] = it.presence
+                freq[i] = it.frequency
+                if it.prior_tokens is not None and len(it.prior_tokens):
+                    np.add.at(
+                        cnts[i], np.asarray(it.prior_tokens, np.int64), 1
+                    )
+            opt.update(
+                pf_counts=jnp.asarray(cnts),
+                pf_presence=jnp.asarray(pres),
+                pf_frequency=jnp.asarray(freq),
+            )
+
+        fresh = jnp.asarray(fresh_tokens, jnp.int32)
+        if fresh_mask is None:
+            mask = jnp.ones((R,), bool)
+            prev = fresh
+        else:
+            mask = jnp.asarray(fresh_mask)
+            prev = (
+                jnp.asarray(prev_tokens, jnp.int32)
+                if prev_tokens is not None
+                else fresh
+            )
+        if not hasattr(self, "_mixed_jit"):
+            self._mixed_jit = jax.jit(
+                self._mixed_impl,
+                donate_argnums=(0, 1, 2),
+                static_argnames=("use_ragged", "interpret"),
+            )
+        (
+            self.k_cache, self.v_cache, self.token_counts, tokens, logprobs,
+        ) = self._mixed_jit(
+            self.k_cache,
+            self.v_cache,
+            self.token_counts,
+            self.params,
+            fresh,
+            mask,
+            prev,
+            jnp.asarray(positions, jnp.int32),
+            jnp.asarray(block_tables[:, :CBd], jnp.int32),
+            jnp.asarray(active),
+            jnp.asarray(batch.temperature, jnp.float32),
+            jnp.asarray(batch.top_k, jnp.int32),
+            jnp.asarray(batch.top_p, jnp.float32),
+            keys,
+            jnp.asarray(presence, jnp.float32),
+            jnp.asarray(frequency, jnp.float32),
+            jnp.asarray(pf_tokens),
+            jnp.asarray(pf_start),
+            jnp.asarray(pf_len),
+            jnp.asarray(pf_tables),
+            jnp.asarray(pf_temps),
+            jnp.asarray(pf_top_k),
+            jnp.asarray(pf_top_p),
+            pf_keys,
+            use_ragged=use_ragged,
+            interpret=interpret,
+            **opt,
         )
         return tokens, logprobs
 
