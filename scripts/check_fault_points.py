@@ -1,15 +1,9 @@
 #!/usr/bin/env python
-"""Fault-injection-point lint (run in tests via tests/test_faults.py,
-next to check_metric_names.py).
-
-Scans the package sources (plus bench_serving.py) for every literal
-`faults.point("...")` call site and enforces:
-
-  * names are lowercase dotted identifiers (`^[a-z0-9_]+(\\.[a-z0-9_]+)*$`);
-  * every name is UNIQUE — one injection point, one site (a duplicated
-    name makes a chaos spec fire in places its author never audited);
-  * every name is COVERED — referenced by at least one file under
-    tests/, so each recovery path the point gates is actually exercised.
+"""Fault-injection-point lint — thin shim over graftlint's fault-points
+pass (xllm_service_tpu/analysis/fault_points.py; run in tests via
+tests/test_faults.py). The REQUIRED_POINTS contract table lives in the
+pass module; `python scripts/graftlint.py --pass fault-points` is
+equivalent.
 
 Exit status 0 = clean; 1 = violations (listed on stderr).
 """
@@ -17,107 +11,25 @@ Exit status 0 = clean; 1 = violations (listed on stderr).
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "xllm_service_tpu")
-TESTS = os.path.join(REPO, "tests")
-
-POINT_RE = re.compile(r"faults\.point\(\s*[\r\n ]*[\"']([^\"']+)[\"']")
-NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
-
-# Contractual points: chaos specs and docs reference these by name, so a
-# refactor that silently drops one must fail the lint even though the
-# generic scan would no longer see it.
-REQUIRED_POINTS = {
-    "post_json.send",
-    "post_json.recv",
-    "heartbeat.send",
-    "fake_engine.step",
-    # pipelined PD handoff (docs/PD_DISAGGREGATION.md): sender chunk
-    # emission and receiver chunk landing
-    "kv_stream.send",
-    "kv_stream.recv",
-    # control-plane failover (docs/FAULT_TOLERANCE.md): master lease
-    # keepalive (drop => demote + fence), store watch delivery, and both
-    # sides of the takeover-reconciliation RPC
-    "election.keepalive",
-    "store.watch",
-    "reconcile.send",
-    "reconcile.recv",
-    # prefix KV fabric (docs/KV_CACHE.md): peer fetch send/receive —
-    # chaos here MUST degrade to recompute, never to an error — and the
-    # coordinated-eviction offer (chaos = the block dies locally)
-    "kv_fetch.send",
-    "kv_fetch.recv",
-    "fabric.evict_offer",
-    # encoder fabric (docs/EPD.md): master->encoder dispatch (chaos =
-    # re-route to another encoder) and the streamed encoder->prefill
-    # handoff session (chaos MUST degrade to the monolithic /mm/import
-    # push, never to an error)
-    "encode.dispatch",
-    "mm_handoff.send",
-    "mm_handoff.recv",
-}
-
-
-def _py_files(root):
-    for dirpath, dirs, files in os.walk(root):
-        if "__pycache__" in dirpath:
-            continue
-        for fn in files:
-            if fn.endswith(".py"):
-                yield os.path.join(dirpath, fn)
-
-
-def scan_points():
-    """[(path, name)] for every literal faults.point call site."""
-    found = []
-    sources = list(_py_files(PKG)) + [os.path.join(REPO, "bench_serving.py")]
-    for path in sources:
-        if not os.path.exists(path):
-            continue
-        with open(path, "r", encoding="utf-8") as f:
-            src = f.read()
-        for name in POINT_RE.findall(src):
-            found.append((os.path.relpath(path, REPO), name))
-    return found
+sys.path.insert(0, REPO)
 
 
 def main() -> int:
-    errors = []
-    points = scan_points()
-    if not points:
-        errors.append("no faults.point(...) call sites found at all")
-    by_name = {}
-    for path, name in points:
-        if not NAME_RE.match(name):
-            errors.append(f"{path}: bad point name {name!r}")
-        by_name.setdefault(name, []).append(path)
-    for name, paths in sorted(by_name.items()):
-        if len(paths) > 1:
-            errors.append(
-                f"point {name!r} defined at {len(paths)} sites: "
-                + ", ".join(paths)
-            )
-    for name in sorted(REQUIRED_POINTS - set(by_name)):
-        errors.append(
-            f"required point {name!r} has no faults.point call site"
-        )
-    test_blob = "\n".join(
-        open(p, encoding="utf-8").read() for p in _py_files(TESTS)
+    from xllm_service_tpu.analysis import (
+        FaultPointsPass, Project, run_passes,
     )
-    for name in sorted(by_name):
-        if name not in test_blob:
-            errors.append(
-                f"point {name!r} is not referenced by any test under tests/"
-            )
-    for e in errors:
-        print(f"check_fault_points: {e}", file=sys.stderr)
-    if not errors:
-        print(f"check_fault_points: {len(by_name)} points, all clean")
-    return 1 if errors else 0
+
+    res = run_passes(
+        [FaultPointsPass()], Project.load(REPO), check_stale_waivers=False
+    )
+    for f in res.findings:
+        print(f"check_fault_points: {f.render()}", file=sys.stderr)
+    if not res.findings:
+        print("check_fault_points: OK (graftlint fault-points pass)")
+    return 1 if res.findings else 0
 
 
 if __name__ == "__main__":

@@ -1,20 +1,12 @@
 #!/usr/bin/env python
-"""Kernel-hatch documentation lint (run in tests via
-tests/test_ragged_attention.py, next to check_fault_points.py and
-check_metric_names.py).
-
-ISSUE 9 flipped validated kernel defaults from opt-in env hatches to
-on-by-default-on-TPU; this lint keeps the remaining (and future) hatches
-from drifting undocumented:
-
-  * every `XLLM_*_KERNEL` env hatch referenced under
-    `xllm_service_tpu/ops/` must have a row in docs/ARCHITECTURE.md's
-    "Kernel dispatch hatches" table, and that row must state a default
-    (the Default cell is non-empty) — a flipped default that never
-    reaches the table fails CI, not a reviewer's memory;
-  * every `XLLM_*_KERNEL` name IN the table must still be referenced
-    somewhere in the package — stale rows describing deleted hatches
-    fail too (the drift runs both ways).
+"""Env-hatch documentation lint — thin shim over graftlint's
+hatch-registry pass (xllm_service_tpu/analysis/hatch_registry.py; run in
+tests via tests/test_ragged_attention.py). ISSUE 10 widened the PR-9
+`XLLM_*_KERNEL` check to EVERY `XLLM_*` env hatch read by the package
+or the bench entry points: each must have a row (with a stated default)
+in docs/ARCHITECTURE.md's hatch tables, and every row must still match
+a live hatch. `python scripts/graftlint.py --pass hatch-registry` is
+equivalent.
 
 Exit status 0 = clean; 1 = violations (listed on stderr).
 """
@@ -22,91 +14,25 @@ Exit status 0 = clean; 1 = violations (listed on stderr).
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OPS = os.path.join(REPO, "xllm_service_tpu", "ops")
-PKG = os.path.join(REPO, "xllm_service_tpu")
-ARCH = os.path.join(REPO, "docs", "ARCHITECTURE.md")
-
-HATCH_RE = re.compile(r"XLLM_[A-Z0-9_]*_KERNEL")
-# A documented row: a markdown table line whose first cell is the
-# backticked hatch name. The Default column is the table's LAST cell.
-ROW_RE = re.compile(r"^\|\s*`(XLLM_[A-Z0-9_]*_KERNEL)`\s*\|(.+)\|\s*$")
-
-
-def _py_files(root):
-    for dirpath, dirs, files in os.walk(root):
-        if "__pycache__" in dirpath:
-            continue
-        for fn in files:
-            if fn.endswith(".py"):
-                yield os.path.join(dirpath, fn)
-
-
-def scan_ops_hatches():
-    """{hatch_name: first_referencing_path} under ops/."""
-    found = {}
-    for path in _py_files(OPS):
-        with open(path, encoding="utf-8") as f:
-            for name in HATCH_RE.findall(f.read()):
-                found.setdefault(name, os.path.relpath(path, REPO))
-    return found
-
-
-def scan_pkg_hatches():
-    """All XLLM_*_KERNEL names referenced anywhere in the package."""
-    names = set()
-    for path in _py_files(PKG):
-        with open(path, encoding="utf-8") as f:
-            names.update(HATCH_RE.findall(f.read()))
-    return names
-
-
-def parse_table():
-    """{hatch_name: default_cell} from ARCHITECTURE.md's hatch table."""
-    rows = {}
-    with open(ARCH, encoding="utf-8") as f:
-        for line in f:
-            m = ROW_RE.match(line.strip())
-            if m:
-                cells = [c.strip() for c in m.group(2).split("|")]
-                rows[m.group(1)] = cells[-1] if cells else ""
-    return rows
+sys.path.insert(0, REPO)
 
 
 def main() -> int:
-    ops_hatches = scan_ops_hatches()
-    table = parse_table()
-    problems = []
-    for name, path in sorted(ops_hatches.items()):
-        if name not in table:
-            problems.append(
-                f"{name} (referenced in {path}) has no row in "
-                f"docs/ARCHITECTURE.md's kernel-hatch table"
-            )
-        elif not table[name] or set(table[name]) <= {"-", " "}:
-            problems.append(
-                f"{name}: ARCHITECTURE.md row has an empty Default cell "
-                f"— state the shipping default"
-            )
-    pkg_names = scan_pkg_hatches()
-    for name in sorted(table):
-        if name not in pkg_names:
-            problems.append(
-                f"{name} is documented in ARCHITECTURE.md but no longer "
-                f"referenced anywhere in xllm_service_tpu/ — stale row"
-            )
-    if problems:
-        for p in problems:
-            print(f"kernel-hatch lint: {p}", file=sys.stderr)
-        return 1
-    print(
-        f"kernel-hatch lint: {len(ops_hatches)} hatches in ops/, all "
-        f"documented with defaults ({len(table)} table rows)"
+    from xllm_service_tpu.analysis import (
+        HatchRegistryPass, Project, run_passes,
     )
-    return 0
+
+    res = run_passes(
+        [HatchRegistryPass()], Project.load(REPO), check_stale_waivers=False
+    )
+    for f in res.findings:
+        print(f"kernel-hatch lint: {f.render()}", file=sys.stderr)
+    if not res.findings:
+        print("kernel-hatch lint: OK (graftlint hatch-registry pass)")
+    return 1 if res.findings else 0
 
 
 if __name__ == "__main__":

@@ -1,21 +1,9 @@
 #!/usr/bin/env python
-"""Metric-naming lint (run in tests via tests/test_obs.py).
-
-Two passes:
-
-1. STATIC: scan the package sources for every name registered through a
-   MetricsRegistry factory (`.counter("...")` / `.gauge(` / `.histogram(`)
-   and for hand-written `# TYPE` exposition lines, then enforce the
-   conventions the registry itself asserts at runtime:
-     * every metric name matches ^xllm_[a-z0-9_]+$;
-     * counters end in `_total`;
-     * gauges/histograms do NOT end in `_total` (and histogram base names
-       never end in the render-reserved _bucket/_sum/_count).
-   The scan catches names on code paths tests never execute.
-
-2. RUNTIME: render one Counter/Gauge/Histogram through a registry and
-   assert the exposition honors the format contract — single TYPE line per
-   family and histogram `_bucket`(+Inf cumulative)/`_sum`/`_count` series.
+"""Metric-naming lint — thin shim over graftlint's metric-names pass
+(xllm_service_tpu/analysis/metric_names.py; run in tests via
+tests/test_obs.py). Kept so existing invocations and docs keep working;
+the single maintained implementation is the framework pass —
+`python scripts/graftlint.py --pass metric-names` is equivalent.
 
 Exit status 0 = clean; 1 = violations (listed on stderr).
 """
@@ -23,102 +11,25 @@ Exit status 0 = clean; 1 = violations (listed on stderr).
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "xllm_service_tpu")
-
-NAME_RE = re.compile(r"^xllm_[a-z0-9_]+$")
-# registry.counter("name" | registry.gauge( | registry.histogram( — the
-# receiver may be any expression (self.metrics.counter, reg.histogram...).
-REG_RE = re.compile(
-    r"\.(counter|gauge|histogram)\(\s*[\r\n ]*[\"']([A-Za-z0-9_]+)[\"']"
-)
-TYPE_LINE_RE = re.compile(r"#\s*TYPE\s+([A-Za-z0-9_]+)\s+(\w+)")
-
-
-def scan_sources():
-    """[(path, kind, name)] for every statically visible registration."""
-    found = []
-    for root, _dirs, files in os.walk(PKG):
-        if "__pycache__" in root:
-            continue
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            with open(path, "r", encoding="utf-8") as f:
-                src = f.read()
-            for kind, name in REG_RE.findall(src):
-                found.append((os.path.relpath(path, REPO), kind, name))
-            for name, kind in TYPE_LINE_RE.findall(src):
-                if kind in ("counter", "gauge", "histogram"):
-                    found.append((os.path.relpath(path, REPO), kind, name))
-    return found
-
-
-def static_violations():
-    errs = []
-    for path, kind, name in scan_sources():
-        where = f"{path}: {kind} {name!r}"
-        if not NAME_RE.match(name):
-            errs.append(f"{where}: must match {NAME_RE.pattern}")
-            continue
-        if kind == "counter" and not name.endswith("_total"):
-            errs.append(f"{where}: counters must end in _total")
-        if kind in ("gauge", "histogram") and name.endswith("_total"):
-            errs.append(f"{where}: only counters may end in _total")
-        if kind == "histogram" and any(
-            name.endswith(s) for s in ("_bucket", "_sum", "_count")
-        ):
-            errs.append(
-                f"{where}: histogram base name uses a render-reserved "
-                "suffix"
-            )
-    return errs
-
-
-def runtime_violations():
-    sys.path.insert(0, REPO)
-    from xllm_service_tpu.obs import MetricsRegistry
-
-    errs = []
-    reg = MetricsRegistry()
-    reg.counter("xllm_lint_probe_total", "probe").inc(2)
-    reg.gauge("xllm_lint_probe_depth", "probe").set(3)
-    h = reg.histogram(
-        "xllm_lint_probe_ms", "probe", buckets=(1.0, 10.0)
-    )
-    h.observe(0.5)
-    h.observe(5.0)
-    h.observe(50.0)
-    text = reg.render()
-    for fam in ("xllm_lint_probe_total", "xllm_lint_probe_depth",
-                "xllm_lint_probe_ms"):
-        n = text.count(f"# TYPE {fam} ")
-        if n != 1:
-            errs.append(f"render: {n} TYPE lines for {fam} (want 1)")
-    for needle in (
-        'xllm_lint_probe_ms_bucket{le="1"} 1',
-        'xllm_lint_probe_ms_bucket{le="10"} 2',
-        'xllm_lint_probe_ms_bucket{le="+Inf"} 3',
-        "xllm_lint_probe_ms_sum 55.5",
-        "xllm_lint_probe_ms_count 3",
-    ):
-        if needle not in text:
-            errs.append(f"render: missing sample {needle!r}")
-    return errs
+sys.path.insert(0, REPO)
 
 
 def main() -> int:
-    errs = static_violations() + runtime_violations()
-    for e in errs:
-        print(f"check_metric_names: {e}", file=sys.stderr)
-    if not errs:
-        n = len(scan_sources())
-        print(f"check_metric_names: OK ({n} registrations checked)")
-    return 1 if errs else 0
+    from xllm_service_tpu.analysis import (
+        MetricNamesPass, Project, run_passes,
+    )
+
+    res = run_passes(
+        [MetricNamesPass()], Project.load(REPO), check_stale_waivers=False
+    )
+    for f in res.findings:
+        print(f"check_metric_names: {f.render()}", file=sys.stderr)
+    if not res.findings:
+        print("check_metric_names: OK (graftlint metric-names pass)")
+    return 1 if res.findings else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""graftlint — single entry point for the project's static analyses.
+
+    python scripts/graftlint.py --all            # every pass, repo-wide
+    python scripts/graftlint.py --pass lock-discipline --pass thread-joins
+    python scripts/graftlint.py --list           # pass catalog
+
+Exit status: 0 = zero un-waivered findings (stale waivers count as
+findings — an allow= comment must still be excusing something); 1 =
+violations, listed on stderr. Run repo-wide in tier-1 by
+tests/test_graftlint.py; the legacy check_* scripts are shims over the
+same passes. Pass catalog + annotation/waiver syntax:
+docs/STATIC_ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from xllm_service_tpu.analysis import Project, all_passes, run_passes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--all", action="store_true",
+                    help="run every pass (the default when no --pass)")
+    ap.add_argument("--pass", dest="passes", action="append", default=[],
+                    metavar="ID", help="run one pass (repeatable)")
+    ap.add_argument("--list", action="store_true",
+                    help="list the pass catalog and exit")
+    ap.add_argument("--root", default=REPO, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    passes = all_passes()
+    if args.list:
+        for p in passes:
+            print(f"{p.id:22s} {p.title}")
+        return 0
+    if args.passes:
+        by_id = {p.id: p for p in passes}
+        unknown = [i for i in args.passes if i not in by_id]
+        if unknown:
+            print(f"graftlint: unknown pass(es): {', '.join(unknown)} "
+                  f"(see --list)", file=sys.stderr)
+            return 2
+        passes = [by_id[i] for i in args.passes]
+
+    project = Project.load(args.root)
+    # Stale-waiver accounting needs the full pass set's findings; a
+    # partial run can't tell an unused waiver from one another pass uses.
+    res = run_passes(passes, project,
+                     check_stale_waivers=not args.passes)
+    for f in res.findings + res.stale_waivers:
+        print(f"graftlint: {f.render()}", file=sys.stderr)
+    n_src = len(project.sources) + len(project.aux_sources)
+    status = "FAIL" if res.failed else "OK"
+    print(
+        f"graftlint: {status} — {len(passes)} passes over {n_src} files: "
+        f"{len(res.findings)} findings, {len(res.waived)} waived, "
+        f"{len(res.stale_waivers)} stale waivers"
+    )
+    return 1 if res.failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,46 @@ if _CACHE_DIR != "0":
 
 import pytest  # noqa: E402
 
+# Thread-ownership runtime asserts (common/concurrency.py): on for the
+# whole suite so an off-engine-thread call to a @thread_owned surface
+# fails the test that made it instead of corrupting slot state. Read at
+# decoration time, so it must be set before the package is imported.
+os.environ.setdefault("XLLM_THREAD_CHECKS", "1")
+
+# Runtime lock-order sanitizer (docs/STATIC_ANALYSIS.md): under
+# XLLM_LOCK_TRACE=1, wrap every repo-created lock from here on — before
+# any test module imports the package — and assert after each test that
+# the fleet-wide acquisition graph stayed cycle-free and no lock was
+# held across a fault point. The chaos/differential suites (test_faults,
+# test_master_failover, test_prefix_fabric, test_encoder_fabric) are the
+# ones that drive real multi-instance interleavings through it.
+from xllm_service_tpu.obs import locktrace  # noqa: E402
+
+if locktrace.enabled():
+    locktrace.install()
+
+
+@pytest.fixture(autouse=True)
+def _locktrace_guard():
+    yield
+    if not locktrace.active():
+        return
+    rep = locktrace.report()
+    if rep["cycles"] or rep["point_holds"]:
+        # Reset so one violation fails the test that produced it, not
+        # every test after it.
+        locktrace.reset()
+        lines = [
+            f"lock-order cycle: {' -> '.join(c)}" for c in rep["cycles"]
+        ] + [
+            f"lock {site} held across fault point {point!r} ({n} hits)"
+            for (point, site), n in sorted(rep["point_holds"].items())
+        ]
+        pytest.fail(
+            "locktrace sanitizer violations:\n  " + "\n  ".join(lines),
+            pytrace=False,
+        )
+
 
 @pytest.fixture(scope="session")
 def cpu_devices():

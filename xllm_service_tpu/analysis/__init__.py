@@ -1,0 +1,63 @@
+"""graftlint: project-wide concurrency + registry static analysis.
+
+One framework, pluggable passes, single runner (`scripts/graftlint.py
+--all`), enforced repo-wide as a tier-1 test (tests/test_graftlint.py).
+docs/STATIC_ANALYSIS.md is the pass catalog + annotation/waiver syntax;
+`xllm_service_tpu/obs/locktrace.py` is the runtime half (lock-order
+sanitizer for the chaos suites).
+"""
+
+from xllm_service_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Project,
+    RunResult,
+    Source,
+    run_passes,
+)
+from xllm_service_tpu.analysis.blocking_under_lock import BlockingUnderLockPass
+from xllm_service_tpu.analysis.fault_points import (
+    REQUIRED_POINTS,
+    FaultPointsPass,
+)
+from xllm_service_tpu.analysis.hatch_registry import HatchRegistryPass
+from xllm_service_tpu.analysis.lock_discipline import LockDisciplinePass
+from xllm_service_tpu.analysis.metric_names import MetricNamesPass
+from xllm_service_tpu.analysis.thread_joins import ThreadJoinsPass
+from xllm_service_tpu.analysis.thread_ownership import ThreadOwnershipPass
+
+
+def all_passes(runtime: bool = True):
+    """The canonical pass list, in catalog order (docs/STATIC_ANALYSIS.md).
+
+    `runtime=False` skips probes that import live components (the
+    metric-names exposition render) — used by fixture unit tests.
+    """
+    return [
+        LockDisciplinePass(),
+        BlockingUnderLockPass(),
+        ThreadOwnershipPass(),
+        ThreadJoinsPass(),
+        HatchRegistryPass(),
+        MetricNamesPass(runtime=runtime),
+        FaultPointsPass(),
+    ]
+
+
+__all__ = [
+    "Finding",
+    "LintPass",
+    "Project",
+    "RunResult",
+    "Source",
+    "run_passes",
+    "all_passes",
+    "REQUIRED_POINTS",
+    "BlockingUnderLockPass",
+    "FaultPointsPass",
+    "HatchRegistryPass",
+    "LockDisciplinePass",
+    "MetricNamesPass",
+    "ThreadJoinsPass",
+    "ThreadOwnershipPass",
+]

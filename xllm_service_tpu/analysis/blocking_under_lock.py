@@ -1,0 +1,235 @@
+"""Blocking-call-under-lock pass: no RPC / sleep / queue-wait while a
+lock is held.
+
+Every recent latency cliff and near-deadlock in review traced to the
+same shape: a `with self._mu:` block that grew an RPC or a sleep. A
+blocking call under a lock turns one slow peer into a fleet-wide stall
+(every thread contending that lock queues behind the socket), and a
+lock held across a blocking call is half of every real deadlock cycle.
+
+What counts as blocking (curated — precision over recall, the lint
+must land clean and stay credible):
+
+* our own RPC plane: `post_json`, `post_json_retrying`, `post_bytes`,
+  `post_bytes_raw`, `urlopen`, `create_connection`;
+* jax dispatch/transfer sync points: `block_until_ready`,
+  `device_put`, `device_get`;
+* raw sockets: `.recv`, `.recv_into`, `.sendall`, `.accept`,
+  `.connect`;
+* `time.sleep` (and a bare imported `sleep`);
+* subprocess: `run`, `check_output`, `check_call`, `communicate`;
+* `.wait` / `.wait_for` — EXCEPT the Condition self-wait idiom
+  (`with self._cv: self._cv.wait()` releases the lock it waits on);
+* `.join` on thread-ish receivers (terminal name containing `thread`,
+  `worker`, or a bare `t`/`th` local) — `str.join`/`os.path.join` are
+  not flagged;
+* `.put` / `.get` on queue-ish receivers (terminal name ending in
+  `queue`/`_q`/`q`) without `block=False`/`timeout=0` —
+  `put_nowait`/`get_nowait` never match.
+
+Held-lock detection mirrors the lock-discipline pass: `with self.X:`
+where X is a class lock attr or lock-ish by name, `with <module_lock>:`
+for module-level locks, plus `# graftlint: holds=self._lock` method
+annotations (a caller-holds contract means the body IS under the lock).
+
+Waive a justified site with
+`# graftlint: allow=blocking-under-lock -- why`.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from xllm_service_tpu.analysis.core import (
+    Finding,
+    HOLDS_RE,
+    LOCKISH_NAME_RE,
+    LintPass,
+    Project,
+    Source,
+    class_condition_aliases,
+    class_lock_attrs,
+    is_lock_factory_call,
+    self_attr,
+)
+
+BLOCKING_FUNCS = {
+    "post_json", "post_json_retrying", "post_bytes", "post_bytes_raw",
+    "urlopen", "create_connection",
+    "check_output", "check_call", "communicate",
+    # jax dispatch/transfer: device sync under a service lock turns one
+    # slow step into a fleet-wide stall
+    "block_until_ready", "device_put", "device_get",
+}
+SOCKET_METHODS = {"recv", "recv_into", "sendall", "accept", "connect"}
+THREADISH_RE = re.compile(r"(thread|worker|sender)s?\d*$|^(t|th|thr)\d*$")
+QUEUEISH_RE = re.compile(r"(queue|_q)$|^q\d*$")
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_nonblocking_kwargs(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+        if kw.arg == "timeout" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value == 0:
+            return True
+    return False
+
+
+class BlockingUnderLockPass(LintPass):
+    id = "blocking-under-lock"
+    title = "blocking calls made while holding a lock"
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in project.sources:
+            tree = src.tree
+            if tree is None:
+                continue
+            module_locks = {
+                t.id
+                for node in tree.body
+                if isinstance(node, ast.Assign)
+                and is_lock_factory_call(node.value)
+                for t in node.targets
+                if isinstance(t, ast.Name)
+            }
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    lock_attrs = class_lock_attrs(node)
+                    aliases = class_condition_aliases(node)
+                    for stmt in node.body:
+                        if isinstance(
+                            stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                        ):
+                            self._walk_fn(
+                                src, node.name, stmt, lock_attrs, aliases,
+                                module_locks, findings,
+                            )
+            for stmt in tree.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._walk_fn(
+                        src, None, stmt, set(), {}, module_locks, findings
+                    )
+        return findings
+
+    # -------------------------------------------------------------- walk
+
+    def _walk_fn(
+        self,
+        src: Source,
+        cls_name: Optional[str],
+        fn: ast.AST,
+        lock_attrs: Set[str],
+        aliases: Dict[str, str],
+        module_locks: Set[str],
+        findings: List[Finding],
+    ) -> None:
+        base_held: Dict[str, str] = {}  # lock label -> ast dump of expr
+        hm = HOLDS_RE.search(src.line_comment(fn.lineno))
+        if hm:
+            base_held[f"self.{hm.group(1)}"] = ast.dump(
+                ast.parse(f"self.{hm.group(1)}", mode="eval").body
+            )
+
+        def walk(node: ast.AST, held: Dict[str, str], top: bool) -> None:
+            if not top and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                return  # deferred body: not executed under this lock
+            if isinstance(node, ast.With):
+                add: Dict[str, str] = {}
+                for item in node.items:
+                    expr = item.context_expr
+                    a = self_attr(expr)
+                    if a and (a in lock_attrs or LOCKISH_NAME_RE.search(a)):
+                        add[f"self.{a}"] = ast.dump(expr)
+                        if a in aliases:
+                            # `with self._cv:` acquires the lock the
+                            # Condition wraps.
+                            add[f"self.{aliases[a]}"] = ast.dump(
+                                ast.parse(
+                                    f"self.{aliases[a]}", mode="eval"
+                                ).body
+                            )
+                    elif isinstance(expr, ast.Name) and (
+                        expr.id in module_locks
+                        or LOCKISH_NAME_RE.search(expr.id)
+                    ):
+                        add[expr.id] = ast.dump(expr)
+                if add:
+                    held = {**held, **add}
+            if isinstance(node, ast.Call) and held:
+                msg = self._classify(node, held, aliases)
+                if msg:
+                    where = f"{cls_name}." if cls_name else ""
+                    findings.append(Finding(
+                        self.id, src.rel, node.lineno,
+                        f"{where}{getattr(fn, 'name', '?')}: {msg} while "
+                        f"holding {', '.join(sorted(held))} — move it "
+                        f"outside the lock or waive",
+                    ))
+            for child in ast.iter_child_nodes(node):
+                walk(child, held, False)
+
+        walk(fn, base_held, True)
+
+    # ---------------------------------------------------------- classify
+
+    def _classify(
+        self, call: ast.Call, held: Dict[str, str],
+        aliases: Dict[str, str],
+    ) -> Optional[str]:
+        fn = call.func
+        name = _terminal_name(fn)
+        if name is None:
+            return None
+        # our RPC plane / subprocess / dns
+        if name in BLOCKING_FUNCS:
+            return f"blocking call {name}()"
+        # time.sleep / bare sleep
+        if name == "sleep":
+            if isinstance(fn, ast.Attribute):
+                if not (
+                    isinstance(fn.value, ast.Name) and fn.value.id == "time"
+                ):
+                    return None
+            return "time.sleep()"
+        if not isinstance(fn, ast.Attribute):
+            return None
+        recv = fn.value
+        recv_name = _terminal_name(recv) or ""
+        if name in SOCKET_METHODS:
+            return f"socket .{name}()"
+        if name in ("wait", "wait_for"):
+            # Condition self-wait releases the lock it waits on — both
+            # `with self._cv: self._cv.wait()` and the shared-lock form
+            # `self._cv = Condition(self._mu); with self._mu: _cv.wait()`.
+            if ast.dump(recv) in held.values():
+                return None
+            a = self_attr(recv)
+            if a and a in aliases and f"self.{aliases[a]}" in held:
+                return None
+            return f".{name}() on {recv_name or 'an object'}"
+        if name == "join":
+            if THREADISH_RE.search(recv_name):
+                return f"thread .join() on {recv_name}"
+            return None
+        if name in ("put", "get"):
+            if QUEUEISH_RE.search(recv_name) and not _is_nonblocking_kwargs(
+                call
+            ):
+                return f"queue .{name}() on {recv_name}"
+            return None
+        return None

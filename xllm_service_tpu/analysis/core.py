@@ -1,0 +1,428 @@
+"""graftlint core: one lint framework for the project's bespoke checkers.
+
+The system is deeply concurrent — ~100 threading.Lock/RLock/Condition/
+Event/Thread sites across the evserve loop, engine hot loop, transfer/
+stream lanes, encoder batcher, and heartbeat/election threads — and the
+reference paper's service tier leans on C++ TSan/clang-tidy for exactly
+the bug class our recent review fixes kept catching by hand (join-
+unstarted races, double-unwind, blocking RPC under a lock). This package
+is the Python answer: a single AST-walking framework with pluggable
+passes, run repo-wide by `scripts/graftlint.py --all` and enforced as a
+tier-1 test (tests/test_graftlint.py).
+
+Vocabulary shared by every pass:
+
+* a `Source` is one parsed file (text + lines + lazily parsed AST +
+  waiver map);
+* a `Project` is the set of sources a pass may look at — the package,
+  the bench entry points, the tests (raw text, for coverage checks),
+  and the docs (for registry cross-checks). `Project.from_sources`
+  builds a synthetic in-memory project so each pass is unit-testable
+  against fixture snippets without touching disk;
+* a `Finding` is one violation, anchored to a file:line;
+* a **waiver** is a trailing comment on the finding's anchor line:
+
+      # graftlint: allow=<pass-id>[,<pass-id>] -- <why this is safe>
+
+  The framework drops waived findings and reports how many waivers
+  fired; a waiver naming a pass that never finds anything on that line
+  is itself a finding (stale waivers rot like stale comments).
+
+Passes live in sibling modules; `xllm_service_tpu.analysis` exports the
+canonical `ALL_PASSES` list. The three legacy checkers
+(scripts/check_metric_names.py, check_fault_points.py,
+check_kernel_hatches.py) are thin shims over their absorbed passes —
+one framework, no dual maintenance (docs/STATIC_ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "Finding",
+    "Source",
+    "Project",
+    "LintPass",
+    "run_passes",
+    "WAIVER_RE",
+]
+
+# Trailing-comment waiver: `# graftlint: allow=blocking-under-lock -- why`.
+WAIVER_RE = re.compile(r"#\s*graftlint:\s*allow=([a-z0-9_,-]+)")
+
+# Method-level annotation: `def f(self):  # graftlint: holds=self._lock`
+# asserts the caller contract "only invoked with self._lock held", so the
+# lock-discipline pass treats the whole body as guarded by that lock.
+HOLDS_RE = re.compile(r"#\s*graftlint:\s*holds=self\.([A-Za-z_][A-Za-z0-9_]*)")
+
+# Field annotation: `self._waiting = deque()  # guarded by: self._lock`.
+GUARDED_BY_RE = re.compile(
+    r"#\s*guarded by:\s*self\.([A-Za-z_][A-Za-z0-9_]*)"
+)
+
+# Method annotation: `def _init_mm(self):  # graftlint: init-only` marks a
+# constructor extension (mixin `_init_*` methods called only from
+# __init__) — no concurrent peer can exist yet, so the lock-discipline
+# pass exempts it like __init__ itself.
+INIT_ONLY_RE = re.compile(r"#\s*graftlint:\s*init-only")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation. `line` anchors the waiver lookup."""
+
+    pass_id: str
+    path: str  # repo-relative
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+
+
+class Source:
+    """One file: text, split lines, lazily parsed AST, waiver map."""
+
+    def __init__(self, rel: str, text: str):
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self._tree: Optional[ast.Module] = None
+        self._parse_error: Optional[str] = None
+        self._waivers: Optional[Dict[int, Set[str]]] = None
+
+    @property
+    def tree(self) -> Optional[ast.Module]:
+        if self._tree is None and self._parse_error is None:
+            try:
+                self._tree = ast.parse(self.text)
+            except SyntaxError as e:  # pragma: no cover — repo parses
+                self._parse_error = str(e)
+        return self._tree
+
+    @property
+    def parse_error(self) -> Optional[str]:
+        self.tree
+        return self._parse_error
+
+    def line_comment(self, lineno: int) -> str:
+        """The raw text of line `lineno` (1-based); '' when out of range.
+
+        Good enough for trailing-comment annotations: none of our
+        annotated lines put the marker text inside a string literal.
+        """
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    @property
+    def waivers(self) -> Dict[int, Set[str]]:
+        """{lineno: {pass ids}} for every graftlint allow= comment."""
+        if self._waivers is None:
+            w: Dict[int, Set[str]] = {}
+            for i, line in enumerate(self.lines, start=1):
+                m = WAIVER_RE.search(line)
+                if m:
+                    w[i] = {p.strip() for p in m.group(1).split(",") if p.strip()}
+            self._waivers = w
+        return self._waivers
+
+
+def _walk_py(root: str) -> Iterable[str]:
+    for dirpath, dirs, files in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+class Project:
+    """What the passes see. Three source groups plus the docs:
+
+    * `sources` — the package proper (`xllm_service_tpu/**.py`): the
+      concurrency passes scan exactly these;
+    * `aux_sources` — service entry points outside the package
+      (bench.py, bench_serving.py): hatch/fault-point passes include
+      them;
+    * `test_sources` — tests/**.py, raw text only (fault-point coverage
+      and hatch references, never AST-linted);
+    * `docs` — {relpath: text} for registry cross-checks
+      (docs/ARCHITECTURE.md hatch table).
+    """
+
+    AUX_FILES = ("bench.py", "bench_serving.py")
+
+    def __init__(
+        self,
+        sources: Sequence[Source],
+        aux_sources: Sequence[Source] = (),
+        test_sources: Sequence[Source] = (),
+        docs: Optional[Dict[str, str]] = None,
+    ):
+        self.sources = list(sources)
+        self.aux_sources = list(aux_sources)
+        self.test_sources = list(test_sources)
+        self.docs = dict(docs or {})
+
+    # ------------------------------------------------------------ loading
+
+    @classmethod
+    def load(cls, root: str) -> "Project":
+        pkg = os.path.join(root, "xllm_service_tpu")
+        # The analysis package itself is excluded: its docstrings quote
+        # the very patterns the text-level passes grep for (waiver
+        # syntax, faults.point examples), and it owns no runtime state
+        # worth concurrency-linting — linting the linter's docs is all
+        # false positives.
+        skip = os.path.join(pkg, "analysis") + os.sep
+        sources = [
+            Source(os.path.relpath(p, root), open(p, encoding="utf-8").read())
+            for p in _walk_py(pkg)
+            if not p.startswith(skip)
+        ]
+        aux = []
+        for fn in cls.AUX_FILES:
+            p = os.path.join(root, fn)
+            if os.path.exists(p):
+                aux.append(Source(fn, open(p, encoding="utf-8").read()))
+        tests_dir = os.path.join(root, "tests")
+        tests = []
+        if os.path.isdir(tests_dir):
+            tests = [
+                Source(
+                    os.path.relpath(p, root),
+                    open(p, encoding="utf-8").read(),
+                )
+                for p in _walk_py(tests_dir)
+            ]
+        docs: Dict[str, str] = {}
+        docs_dir = os.path.join(root, "docs")
+        if os.path.isdir(docs_dir):
+            for fn in sorted(os.listdir(docs_dir)):
+                if fn.endswith(".md"):
+                    p = os.path.join(docs_dir, fn)
+                    docs[os.path.join("docs", fn)] = open(
+                        p, encoding="utf-8"
+                    ).read()
+        return cls(sources, aux, tests, docs)
+
+    @classmethod
+    def from_sources(
+        cls,
+        sources: Dict[str, str],
+        tests: Optional[Dict[str, str]] = None,
+        docs: Optional[Dict[str, str]] = None,
+    ) -> "Project":
+        """Synthetic project for fixture-based pass unit tests."""
+        return cls(
+            [Source(rel, text) for rel, text in sources.items()],
+            [],
+            [Source(rel, text) for rel, text in (tests or {}).items()],
+            docs or {},
+        )
+
+    # ----------------------------------------------------------- helpers
+
+    def all_lintable(self) -> List[Source]:
+        return self.sources + self.aux_sources
+
+    def find(self, rel: str) -> Optional[Source]:
+        for s in self.sources + self.aux_sources + self.test_sources:
+            if s.rel == rel:
+                return s
+        return None
+
+
+class LintPass:
+    """One analysis. Subclasses set `id`/`title` and implement run()."""
+
+    id: str = ""
+    title: str = ""
+
+    def run(self, project: Project) -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
+@dataclass
+class RunResult:
+    findings: List[Finding] = field(default_factory=list)
+    waived: List[Finding] = field(default_factory=list)
+    stale_waivers: List[Finding] = field(default_factory=list)
+    checked_passes: List[str] = field(default_factory=list)
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.findings or self.stale_waivers)
+
+
+def run_passes(
+    passes: Sequence[LintPass], project: Project, check_stale_waivers: bool = True
+) -> RunResult:
+    """Run passes, apply waivers, flag waivers that no longer fire.
+
+    A waiver is *used* when a finding of the named pass lands on its
+    line. After all passes run, any `allow=` comment naming a pass that
+    produced nothing on that line is reported as a stale waiver — the
+    escape hatch can't outlive the hazard it excused. Stale-waiver
+    checking only makes sense on a full run, so single-pass invocations
+    (the legacy shims) disable it.
+    """
+    res = RunResult()
+    used: Set[Tuple[str, int, str]] = set()  # (path, line, pass_id)
+    known_ids = {p.id for p in passes}
+    for p in passes:
+        res.checked_passes.append(p.id)
+        for f in p.run(project):
+            src = project.find(f.path)
+            allowed = src.waivers.get(f.line, set()) if src else set()
+            if p.id in allowed or "*" in allowed:
+                res.waived.append(f)
+                used.add((f.path, f.line, p.id))
+            else:
+                res.findings.append(f)
+    if check_stale_waivers:
+        for src in project.all_lintable():
+            for line, ids in src.waivers.items():
+                for pid in ids:
+                    if pid == "*":
+                        continue
+                    if pid not in known_ids:
+                        res.stale_waivers.append(Finding(
+                            "framework", src.rel, line,
+                            f"waiver names unknown pass {pid!r}",
+                        ))
+                    elif (src.rel, line, pid) not in used:
+                        res.stale_waivers.append(Finding(
+                            "framework", src.rel, line,
+                            f"stale waiver: pass {pid!r} reports nothing "
+                            f"on this line — remove the allow= comment",
+                        ))
+    res.findings.sort(key=lambda f: (f.path, f.line, f.pass_id))
+    return res
+
+
+# ---------------------------------------------------------------------------
+# shared AST utilities used by the concurrency passes
+# ---------------------------------------------------------------------------
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+# attr-name heuristic for lock-ish context managers when the defining
+# `threading.Lock()` assignment is out of view (cross-module mixins).
+LOCKISH_NAME_RE = re.compile(
+    r"(^|_)(lock|mu|mutex|cv|cond|sem)($|_)|(_mu|_lock|_cv)$"
+)
+
+
+def is_lock_factory_call(node: ast.AST) -> bool:
+    """True for `threading.Lock()` / `threading.RLock()` /
+    `threading.Condition(...)` (and bare `Lock()` when imported)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return (
+            isinstance(fn.value, ast.Name)
+            and fn.value.id == "threading"
+            and fn.attr in LOCK_FACTORIES
+        )
+    if isinstance(fn, ast.Name):
+        return fn.id in LOCK_FACTORIES
+    return False
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """'x' when node is `self.x`, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def class_lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Self-attrs assigned a threading.Lock/RLock/Condition anywhere in
+    the class body (typically __init__)."""
+    locks: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and is_lock_factory_call(node.value):
+            for t in node.targets:
+                a = self_attr(t)
+                if a:
+                    locks.add(a)
+    return locks
+
+
+def class_condition_aliases(cls: ast.ClassDef) -> Dict[str, str]:
+    """{cond_attr: lock_attr} for `self.X = threading.Condition(self.Y)`:
+    the Condition SHARES Y, so holding X is holding Y (and X.wait()
+    under Y is the canonical idiom, not a blocking call under a foreign
+    lock)."""
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if not (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+        ):
+            continue
+        fn = node.value.func
+        is_cond = (
+            isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "threading"
+            and fn.attr == "Condition"
+        ) or (isinstance(fn, ast.Name) and fn.id == "Condition")
+        if not is_cond or not node.value.args:
+            continue
+        lock = self_attr(node.value.args[0])
+        if lock is None:
+            continue
+        for t in node.targets:
+            a = self_attr(t)
+            if a:
+                aliases[a] = lock
+    return aliases
+
+
+def with_lock_names(
+    node: ast.With,
+    lock_attrs: Set[str],
+    aliases: Optional[Dict[str, str]] = None,
+) -> Set[str]:
+    """Lock attr names this `with` statement acquires: `with self.X:`
+    where X is a known lock attr or matches the lock-ish heuristic.
+    Acquiring a Condition that wraps a known lock counts as acquiring
+    that lock too."""
+    names: Set[str] = set()
+    for item in node.items:
+        a = self_attr(item.context_expr)
+        if a and (a in lock_attrs or LOCKISH_NAME_RE.search(a)):
+            names.add(a)
+            if aliases and a in aliases:
+                names.add(aliases[a])
+    return names
+
+
+def iter_functions(tree: ast.Module):
+    """Yield (classname_or_None, FunctionDef) for every def in a module,
+    attributing methods to their innermost class."""
+    def visit(node, cls_name):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from visit(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield cls_name, child
+                # nested defs belong to the same logical scope
+                yield from visit(child, cls_name)
+            else:
+                yield from visit(child, cls_name)
+
+    yield from visit(tree, None)

@@ -1,0 +1,97 @@
+"""Fault-points pass: injection-point hygiene for the chaos plane.
+
+Absorbs scripts/check_fault_points.py (PR 4). Scans the package plus
+bench_serving.py for every literal `faults.point("...")` site and
+enforces:
+
+* names are lowercase dotted identifiers;
+* every name is UNIQUE — one injection point, one site (a duplicated
+  name makes a chaos spec fire in places its author never audited);
+* every name is COVERED — referenced by at least one file under
+  tests/, so each recovery path the point gates is actually exercised;
+* every REQUIRED point still exists — chaos specs and the
+  FAULT_TOLERANCE.md tables reference these by name, so a refactor
+  that silently drops one fails lint even though the generic scan
+  would no longer see it.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+from xllm_service_tpu.analysis.core import Finding, LintPass, Project
+
+POINT_RE = re.compile(r"faults\.point\(\s*[\r\n ]*[\"']([^\"']+)[\"']")
+NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
+
+# Contractual points — see each plane's doc for the recovery path the
+# point gates (docs/FAULT_TOLERANCE.md, docs/PD_DISAGGREGATION.md,
+# docs/KV_CACHE.md, docs/EPD.md).
+REQUIRED_POINTS = {
+    "post_json.send",
+    "post_json.recv",
+    "heartbeat.send",
+    "fake_engine.step",
+    "kv_stream.send",
+    "kv_stream.recv",
+    "election.keepalive",
+    "store.watch",
+    "reconcile.send",
+    "reconcile.recv",
+    "kv_fetch.send",
+    "kv_fetch.recv",
+    "fabric.evict_offer",
+    "encode.dispatch",
+    "mm_handoff.send",
+    "mm_handoff.recv",
+}
+
+
+class FaultPointsPass(LintPass):
+    id = "fault-points"
+    title = "fault-injection point uniqueness / coverage / contract"
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        sites: List[Tuple[str, int, str]] = []  # (rel, line, name)
+        for src in project.all_lintable():
+            for m in POINT_RE.finditer(src.text):
+                line = src.text.count("\n", 0, m.start()) + 1
+                sites.append((src.rel, line, m.group(1)))
+        if not sites:
+            return [Finding(
+                self.id, "xllm_service_tpu", 1,
+                "no faults.point(...) call sites found at all",
+            )]
+        by_name: Dict[str, List[Tuple[str, int]]] = {}
+        for rel, line, name in sites:
+            if not NAME_RE.match(name):
+                findings.append(Finding(
+                    self.id, rel, line, f"bad point name {name!r}",
+                ))
+            by_name.setdefault(name, []).append((rel, line))
+        for name, where in sorted(by_name.items()):
+            if len(where) > 1:
+                for rel, line in where:
+                    findings.append(Finding(
+                        self.id, rel, line,
+                        f"point {name!r} defined at {len(where)} sites: "
+                        + ", ".join(f"{r}:{l}" for r, l in where),
+                    ))
+        first = next(iter(project.all_lintable()))
+        for name in sorted(REQUIRED_POINTS - set(by_name)):
+            findings.append(Finding(
+                self.id, first.rel, 1,
+                f"required point {name!r} has no faults.point call site",
+            ))
+        test_blob = "\n".join(s.text for s in project.test_sources)
+        for name in sorted(by_name):
+            if name not in test_blob:
+                rel, line = by_name[name][0]
+                findings.append(Finding(
+                    self.id, rel, line,
+                    f"point {name!r} is not referenced by any test "
+                    f"under tests/",
+                ))
+        return findings

@@ -1,0 +1,116 @@
+"""Hatch-registry pass: every `XLLM_*` env hatch is documented, and
+every documented hatch still exists.
+
+Generalises the PR-9 kernel-hatch lint from `XLLM_*_KERNEL` to ALL env
+hatches the serving stack reads: a hatch that never reaches
+docs/ARCHITECTURE.md's table is an undocumented production switch, and
+a table row whose hatch no longer exists misleads the operator reading
+it. Both directions fail lint, not a reviewer's memory.
+
+Scanned for reads: the package plus the bench entry points (bench.py /
+bench_serving.py) — `os.environ.get("XLLM_...")`, `os.environ[...]`,
+and `os.getenv(...)` forms. Scanned for references (the stale-row
+check): any `XLLM_*` token in those sources, so a hatch mentioned in a
+dispatcher table or docstring keeps its row alive. `*_KERNEL` hatches
+keep the original checker's stronger rule: any token reference at all
+(they reach dispatchers through helpers and name tables, not only
+literal environ reads) requires a documented row.
+
+The registry is docs/ARCHITECTURE.md: markdown table rows whose first
+cell is the backticked hatch name; the LAST cell is the shipping
+default and must be non-empty (a default cell of `-` fails — state the
+default, that's the row's whole job).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+from xllm_service_tpu.analysis.core import Finding, LintPass, Project
+
+ENV_READ_RE = re.compile(
+    r"(?:environ\.get|environ\[|getenv)\(?\s*[\"'](XLLM_[A-Z0-9_]+)[\"']"
+)
+TOKEN_RE = re.compile(r"XLLM_[A-Z0-9_]+")
+ROW_RE = re.compile(r"^\|\s*`(XLLM_[A-Z0-9_]+)`\s*\|(.+)\|\s*$")
+
+ARCH_DOC = "docs/ARCHITECTURE.md"
+
+
+def parse_hatch_table(text: str) -> Dict[str, Tuple[int, str]]:
+    """{hatch: (lineno, default_cell)} from ARCHITECTURE.md table rows."""
+    rows: Dict[str, Tuple[int, str]] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        m = ROW_RE.match(line.strip())
+        if m:
+            cells = [c.strip() for c in m.group(2).split("|")]
+            rows[m.group(1)] = (i, cells[-1] if cells else "")
+    return rows
+
+
+class HatchRegistryPass(LintPass):
+    id = "hatch-registry"
+    title = "XLLM_* env hatches vs the ARCHITECTURE.md hatch table"
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        arch = project.docs.get(ARCH_DOC)
+        if arch is None:
+            return [Finding(
+                self.id, ARCH_DOC, 1,
+                "docs/ARCHITECTURE.md not found — the hatch registry "
+                "has nowhere to live",
+            )]
+        table = parse_hatch_table(arch)
+        reads: Dict[str, Tuple[str, int]] = {}  # hatch -> first read site
+        referenced = set()
+        for src in project.all_lintable():
+            for i, line in enumerate(src.lines, start=1):
+                for m in ENV_READ_RE.finditer(line):
+                    reads.setdefault(m.group(1), (src.rel, i))
+                referenced.update(TOKEN_RE.findall(line))
+        for hatch, (rel, lineno) in sorted(reads.items()):
+            if hatch not in table:
+                findings.append(Finding(
+                    self.id, rel, lineno,
+                    f"env hatch {hatch} is read here but has no row in "
+                    f"{ARCH_DOC}'s hatch table — document it with its "
+                    f"shipping default",
+                ))
+        # Legacy check_kernel_hatches contract, kept at full strength:
+        # a *_KERNEL hatch reaches dispatchers through helpers and name
+        # tables, so for kernel hatches ANY token reference (not just a
+        # literal environ read) requires a documented row. Report each
+        # missing hatch once, at its first reference.
+        reported: set = set()
+        for src in project.all_lintable():
+            for i, line in enumerate(src.lines, start=1):
+                for tok in TOKEN_RE.findall(line):
+                    if (
+                        tok.endswith("_KERNEL")
+                        and tok not in table
+                        and tok not in reported
+                    ):
+                        reported.add(tok)
+                        findings.append(Finding(
+                            self.id, src.rel, i,
+                            f"kernel hatch {tok} is referenced here but "
+                            f"has no row in {ARCH_DOC}'s hatch table — "
+                            f"document it with its shipping default",
+                        ))
+        for hatch, (lineno, default) in sorted(table.items()):
+            if not default or set(default) <= {"-", " "}:
+                findings.append(Finding(
+                    self.id, ARCH_DOC, lineno,
+                    f"{hatch}: hatch-table row has an empty Default "
+                    f"cell — state the shipping default",
+                ))
+            if hatch not in referenced:
+                findings.append(Finding(
+                    self.id, ARCH_DOC, lineno,
+                    f"{hatch} is documented but no longer referenced "
+                    f"anywhere in the package or bench entry points — "
+                    f"stale row",
+                ))
+        return findings

@@ -1,0 +1,245 @@
+"""Lock-discipline pass: guarded fields must be mutated under their lock.
+
+Two detection modes, both scoped to one class at a time (cross-object
+aliasing is out of scope — this is clang-tidy's GUARDED_BY for the 90%
+case, not a whole-program alias analysis):
+
+* **declared** — a field's initialising assignment carries
+
+      self._waiting = deque()  # guarded by: self._lock
+
+  and every later mutation of `self._waiting` anywhere in the class
+  must sit inside `with self._lock:` (or in an exempt method — see
+  below). Declaration is the preferred mode: it documents the invariant
+  at the field's birthplace and survives refactors that change usage
+  ratios.
+
+* **inferred (majority-locked)** — for undeclared fields of classes
+  that own at least one lock: if ≥ MIN_LOCKED_SITES mutation sites are
+  under one lock and ≥ MAJORITY_FRACTION of all mutation sites agree,
+  the stragglers are flagged. Catches the PR-6-style bug where one new
+  call site forgets the lock the other five remembered.
+
+A *mutation* is an assignment / augmented assignment / `del` of
+`self.field` or `self.field[...]`, or a call of a mutating container
+method (`append`, `pop`, `update`, ...) with `self.field` as receiver.
+Reads are deliberately not checked: this codebase documents several
+racy-read-by-design surfaces (engine cache snapshots, load gauges).
+
+Exempt: `__init__`/`__del__` (no concurrent peers yet), constructor
+extensions marked `# graftlint: init-only` on their `def` line (the
+mixin `_init_*` convention — called only from __init__), methods whose
+name ends in `_locked` (caller-holds-the-lock convention), and methods
+annotated `# graftlint: holds=self._lock` on their `def` line. Holding
+a Condition constructed over a known lock counts as holding that lock
+(`class_condition_aliases`). Mutations
+inside nested functions/lambdas are skipped — deferred execution makes
+the lexically enclosing `with` meaningless.
+
+Waive a single site with `# graftlint: allow=lock-discipline -- why`.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import Counter
+from typing import Dict, List, Optional, Set, Tuple
+
+from xllm_service_tpu.analysis.core import (
+    Finding,
+    GUARDED_BY_RE,
+    HOLDS_RE,
+    INIT_ONLY_RE,
+    LintPass,
+    Project,
+    Source,
+    class_condition_aliases,
+    class_lock_attrs,
+    self_attr,
+    with_lock_names,
+)
+
+MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert",
+    "add", "remove", "discard", "pop", "popleft", "popitem",
+    "clear", "update", "setdefault",
+}
+
+MIN_LOCKED_SITES = 3
+MAJORITY_FRACTION = 0.75
+
+
+class _Site:
+    __slots__ = ("field", "line", "held", "method", "exempt")
+
+    def __init__(self, field: str, line: int, held: Set[str],
+                 method: str, exempt: bool):
+        self.field = field
+        self.line = line
+        self.held = held
+        self.method = method
+        self.exempt = exempt
+
+
+def _mutated_fields(node: ast.AST) -> List[str]:
+    """Self-attr fields this single statement/expression mutates."""
+    out: List[str] = []
+
+    def target_fields(t: ast.AST) -> None:
+        a = self_attr(t)
+        if a:
+            out.append(a)
+        elif isinstance(t, ast.Subscript):
+            a = self_attr(t.value)
+            if a:
+                out.append(a)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                target_fields(e)
+        elif isinstance(t, ast.Starred):
+            target_fields(t.value)
+
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            target_fields(t)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        if getattr(node, "value", True) is not None:
+            target_fields(node.target)
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            target_fields(t)
+    elif isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in MUTATORS:
+            a = self_attr(fn.value)
+            if a:
+                out.append(a)
+    return out
+
+
+class LockDisciplinePass(LintPass):
+    id = "lock-discipline"
+    title = "guarded fields mutated outside their guarding lock"
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in project.sources:
+            tree = src.tree
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    findings.extend(self._check_class(src, node))
+        return findings
+
+    # ------------------------------------------------------------ class
+
+    def _check_class(self, src: Source, cls: ast.ClassDef) -> List[Finding]:
+        lock_attrs = class_lock_attrs(cls)
+        if not lock_attrs:
+            return []
+        aliases = class_condition_aliases(cls)
+        declared = self._declared_guards(src, cls, lock_attrs)
+        sites = self._collect_sites(src, cls, lock_attrs, aliases)
+        findings: List[Finding] = []
+
+        # declared mode
+        for field, lock in declared.items():
+            for s in sites:
+                if s.field != field or s.exempt:
+                    continue
+                if lock not in s.held and "*" not in s.held:
+                    findings.append(Finding(
+                        self.id, src.rel, s.line,
+                        f"{cls.name}.{s.method}: self.{field} is declared "
+                        f"guarded by self.{lock} but is mutated here "
+                        f"without holding it",
+                    ))
+
+        # inferred mode for undeclared fields
+        by_field: Dict[str, List[_Site]] = {}
+        for s in sites:
+            if s.field in declared or s.field in lock_attrs or s.exempt:
+                continue
+            by_field.setdefault(s.field, []).append(s)
+        for field, fsites in by_field.items():
+            locked = [s for s in fsites if s.held]
+            unlocked = [s for s in fsites if not s.held]
+            if len(locked) < MIN_LOCKED_SITES or not unlocked:
+                continue
+            modal, n_modal = Counter(
+                lock for s in locked for lock in sorted(s.held)[:1]
+            ).most_common(1)[0]
+            if n_modal / len(fsites) < MAJORITY_FRACTION:
+                continue
+            for s in unlocked:
+                findings.append(Finding(
+                    self.id, src.rel, s.line,
+                    f"{cls.name}.{s.method}: self.{field} is mutated "
+                    f"without self.{modal}, which guards {n_modal} of "
+                    f"{len(fsites)} mutation sites (majority-locked "
+                    f"inference — annotate '# guarded by: self.{modal}' "
+                    f"at the field's init, fix the site, or waive)",
+                ))
+        return findings
+
+    def _declared_guards(
+        self, src: Source, cls: ast.ClassDef, lock_attrs: Set[str]
+    ) -> Dict[str, str]:
+        guards: Dict[str, str] = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            m = GUARDED_BY_RE.search(src.line_comment(node.lineno))
+            if not m:
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                a = self_attr(t)
+                if a:
+                    guards[a] = m.group(1)
+        return guards
+
+    def _collect_sites(
+        self, src: Source, cls: ast.ClassDef, lock_attrs: Set[str],
+        aliases: Dict[str, str],
+    ) -> List[_Site]:
+        sites: List[_Site] = []
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            def_line = src.line_comment(stmt.lineno)
+            exempt = (
+                stmt.name in ("__init__", "__del__")
+                or stmt.name.endswith("_locked")
+                or bool(INIT_ONLY_RE.search(def_line))
+            )
+            base_held: Set[str] = set()
+            hm = HOLDS_RE.search(def_line)
+            if hm:
+                base_held.add(hm.group(1))
+            self._walk(stmt, base_held, stmt.name, exempt, lock_attrs,
+                       aliases, sites, top=True)
+        return sites
+
+    def _walk(
+        self, node: ast.AST, held: Set[str], method: str, exempt: bool,
+        lock_attrs: Set[str], aliases: Dict[str, str], sites: List[_Site],
+        top: bool = False,
+    ) -> None:
+        if not top and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            return  # deferred execution: enclosing `with` proves nothing
+        if isinstance(node, ast.With):
+            held = held | with_lock_names(node, lock_attrs, aliases)
+        for field in _mutated_fields(node):
+            sites.append(_Site(
+                field, getattr(node, "lineno", 0), set(held), method, exempt
+            ))
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held, method, exempt, lock_attrs, aliases,
+                       sites)

@@ -1,0 +1,120 @@
+"""Metric-names pass: naming conventions for every registered metric.
+
+Absorbs scripts/check_metric_names.py (PR 2) into the framework. Two
+layers:
+
+* STATIC — scan the package for every name registered through a
+  MetricsRegistry factory (`.counter("...")`/`.gauge(`/`.histogram(`)
+  and for hand-written `# TYPE` exposition lines, then enforce what the
+  registry asserts at runtime: `^xllm_[a-z0-9_]+$`, counters end in
+  `_total`, gauges/histograms don't, histogram base names never use the
+  render-reserved `_bucket`/`_sum`/`_count` suffixes. The scan catches
+  names on code paths tests never execute.
+
+* RUNTIME (optional, default on for repo runs) — render one
+  Counter/Gauge/Histogram through a real registry and assert the
+  exposition contract (single TYPE line per family, cumulative +Inf
+  bucket, `_sum`/`_count` series). Fixture-driven unit tests construct
+  the pass with `runtime=False`.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from xllm_service_tpu.analysis.core import Finding, LintPass, Project
+
+NAME_RE = re.compile(r"^xllm_[a-z0-9_]+$")
+REG_RE = re.compile(
+    r"\.(counter|gauge|histogram)\(\s*[\r\n ]*[\"']([A-Za-z0-9_]+)[\"']"
+)
+TYPE_LINE_RE = re.compile(r"#\s*TYPE\s+([A-Za-z0-9_]+)\s+(\w+)")
+
+
+class MetricNamesPass(LintPass):
+    id = "metric-names"
+    title = "metric naming conventions + exposition contract"
+
+    def __init__(self, runtime: bool = True):
+        self.runtime = runtime
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        n = 0
+        for src in project.sources:
+            regs = [
+                (m.group(1), m.group(2),
+                 src.text.count("\n", 0, m.start()) + 1)
+                for m in REG_RE.finditer(src.text)
+            ]
+            regs += [
+                (kind, name, src.text.count("\n", 0, m.start()) + 1)
+                for m in TYPE_LINE_RE.finditer(src.text)
+                for name, kind in [(m.group(1), m.group(2))]
+                if kind in ("counter", "gauge", "histogram")
+            ]
+            for kind, name, line in regs:
+                n += 1
+                where = f"{kind} {name!r}"
+                if not NAME_RE.match(name):
+                    findings.append(Finding(
+                        self.id, src.rel, line,
+                        f"{where}: must match {NAME_RE.pattern}",
+                    ))
+                    continue
+                if kind == "counter" and not name.endswith("_total"):
+                    findings.append(Finding(
+                        self.id, src.rel, line,
+                        f"{where}: counters must end in _total",
+                    ))
+                if kind in ("gauge", "histogram") and name.endswith("_total"):
+                    findings.append(Finding(
+                        self.id, src.rel, line,
+                        f"{where}: only counters may end in _total",
+                    ))
+                if kind == "histogram" and any(
+                    name.endswith(s) for s in ("_bucket", "_sum", "_count")
+                ):
+                    findings.append(Finding(
+                        self.id, src.rel, line,
+                        f"{where}: histogram base name uses a "
+                        f"render-reserved suffix",
+                    ))
+        if self.runtime:
+            findings.extend(self._runtime_probe())
+        return findings
+
+    def _runtime_probe(self) -> List[Finding]:
+        from xllm_service_tpu.obs import MetricsRegistry
+
+        errs: List[Finding] = []
+        reg = MetricsRegistry()
+        reg.counter("xllm_lint_probe_total", "probe").inc(2)
+        reg.gauge("xllm_lint_probe_depth", "probe").set(3)
+        h = reg.histogram("xllm_lint_probe_ms", "probe", buckets=(1.0, 10.0))
+        h.observe(0.5)
+        h.observe(5.0)
+        h.observe(50.0)
+        text = reg.render()
+        for fam in ("xllm_lint_probe_total", "xllm_lint_probe_depth",
+                    "xllm_lint_probe_ms"):
+            c = text.count(f"# TYPE {fam} ")
+            if c != 1:
+                errs.append(Finding(
+                    self.id, "xllm_service_tpu/obs/metrics.py", 1,
+                    f"render: {c} TYPE lines for {fam} (want 1)",
+                ))
+        for needle in (
+            'xllm_lint_probe_ms_bucket{le="1"} 1',
+            'xllm_lint_probe_ms_bucket{le="10"} 2',
+            'xllm_lint_probe_ms_bucket{le="+Inf"} 3',
+            "xllm_lint_probe_ms_sum 55.5",
+            "xllm_lint_probe_ms_count 3",
+        ):
+            if needle not in text:
+                errs.append(Finding(
+                    self.id, "xllm_service_tpu/obs/metrics.py", 1,
+                    f"render: missing sample {needle!r}",
+                ))
+        return errs

@@ -1,0 +1,86 @@
+"""Thread-join pass: a thread a class stores, the class must also join.
+
+PR 6's `_campaign` join-unstarted race and two reviews' worth of
+"daemon thread still running after stop()" bugs share one shape: a
+`self._thread = threading.Thread(...)` that some stop/close path
+forgets. A daemon thread that outlives stop() keeps mutating state the
+caller believes quiesced — the flakiest bug class in the suite.
+
+Rule (deliberately narrow so it lands clean and stays credible): every
+`self.X = threading.Thread(...)` assignment in a class requires a
+`self.X.join(...)` call somewhere in the same class. Fire-and-forget
+threads bound to locals and worker pools collected in lists are out of
+scope for the AST rule — name them in a waiver so the exception is
+visible at the creation site:
+
+    # graftlint: allow=thread-joins -- drained via self._pool.shutdown()
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from xllm_service_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Project,
+    self_attr,
+)
+
+
+def _is_thread_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return (
+            isinstance(fn.value, ast.Name)
+            and fn.value.id == "threading"
+            and fn.attr == "Thread"
+        )
+    return isinstance(fn, ast.Name) and fn.id == "Thread"
+
+
+class ThreadJoinsPass(LintPass):
+    id = "thread-joins"
+    title = "threads stored on self but never joined"
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in project.sources:
+            tree = src.tree
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                created = []  # (attr, lineno)
+                joined: Set[str] = set()
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) and _is_thread_ctor(
+                        sub.value
+                    ):
+                        for t in sub.targets:
+                            a = self_attr(t)
+                            if a:
+                                created.append((a, sub.lineno))
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "join"
+                    ):
+                        a = self_attr(sub.func.value)
+                        if a:
+                            joined.add(a)
+                for attr, lineno in created:
+                    if attr not in joined:
+                        findings.append(Finding(
+                            self.id, src.rel, lineno,
+                            f"{node.name}: self.{attr} is a Thread this "
+                            f"class never joins — join it in the stop/"
+                            f"close path (daemon threads that outlive "
+                            f"stop() keep mutating 'quiesced' state) or "
+                            f"waive with the drain mechanism named",
+                        ))
+        return findings

@@ -1,0 +1,136 @@
+"""Thread-ownership pass: `@thread_owned` surfaces are only called from
+owning-thread code.
+
+`common/concurrency.py` gives hot single-threaded state a contract:
+methods decorated `@thread_owned("engine")` may only run on the thread
+that called `claim_thread(self, "engine")` (the engine loop claims at
+`_loop` entry, releases on exit). The decorator runtime-asserts under
+`XLLM_THREAD_CHECKS=1` (on for the test suite); this pass is the static
+half — it checks *call sites* so a violation fails lint before a racy
+test has to catch it.
+
+Static rule, scoped per class (receiver must be `self` — cross-object
+calls are covered by the runtime assert):
+
+    a call `self.m(...)` where `m` is @thread_owned in this class must
+    appear inside a method that is itself @thread_owned (same realm) or
+    a *claimer* (a method that calls `claim_thread`).
+
+The closure this forces is the point: decorating `_slot_admit` makes
+every caller prove it is on the engine thread too, so the engine-thread
+call chain is marked end to end and a new off-thread call site fails CI
+instead of corrupting slot state.
+
+Waive a deliberate exception with
+`# graftlint: allow=thread-ownership -- why`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from xllm_service_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Project,
+    Source,
+    self_attr,
+)
+
+
+def _decorator_realm(dec: ast.AST) -> Optional[str]:
+    """Realm string when `dec` is a thread_owned decoration."""
+    if isinstance(dec, ast.Call):
+        name = dec.func
+        tag = name.attr if isinstance(name, ast.Attribute) else (
+            name.id if isinstance(name, ast.Name) else None
+        )
+        if tag == "thread_owned":
+            if dec.args and isinstance(dec.args[0], ast.Constant):
+                return str(dec.args[0].value)
+            return "?"
+    return None
+
+
+def _is_claimer(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            tag = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None
+            )
+            if tag == "claim_thread":
+                return True
+    return False
+
+
+class ThreadOwnershipPass(LintPass):
+    id = "thread-ownership"
+    title = "@thread_owned methods called from unowned code"
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in project.sources:
+            tree = src.tree
+            if tree is None:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.ClassDef):
+                    findings.extend(self._check_class(src, node))
+        return findings
+
+    def _check_class(self, src: Source, cls: ast.ClassDef) -> List[Finding]:
+        owned: Dict[str, str] = {}  # method -> realm
+        methods: List[ast.FunctionDef] = [
+            s for s in cls.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for m in methods:
+            for dec in m.decorator_list:
+                realm = _decorator_realm(dec)
+                if realm:
+                    owned[m.name] = realm
+        if not owned:
+            return []
+        findings: List[Finding] = []
+        for m in methods:
+            caller_realms = {
+                _decorator_realm(d) for d in m.decorator_list
+            } - {None}
+            claimer = _is_claimer(m)
+
+            def visit(node: ast.AST, covered: bool) -> None:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(
+                        child,
+                        (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                    ):
+                        # a nested def runs on whatever thread calls it
+                        # later; its body can't inherit this method's
+                        # ownership — owned calls inside it are flagged.
+                        visit(child, False)
+                        continue
+                    if isinstance(child, ast.Call):
+                        attr = self_attr(child.func)
+                        if attr is not None and attr in owned:
+                            realm = owned[attr]
+                            ok = covered and (
+                                realm in caller_realms or claimer
+                            )
+                            if not ok:
+                                findings.append(Finding(
+                                    self.id, src.rel, child.lineno,
+                                    f"{cls.name}.{m.name} calls "
+                                    f"self.{attr}() which is "
+                                    f"@thread_owned({realm!r}), but "
+                                    f"{m.name} is neither "
+                                    f"@thread_owned({realm!r}) nor a "
+                                    f"claim_thread() claimer — an "
+                                    f"off-{realm}-thread call would "
+                                    f"corrupt {realm}-owned state",
+                                ))
+                    visit(child, covered)
+
+            visit(m, True)
+        return findings

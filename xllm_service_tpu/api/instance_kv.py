@@ -369,14 +369,14 @@ class _KVStreamSession:
 
 
 class KVHandoffMixin:
-    def _init_kv_handoff(self) -> None:
+    def _init_kv_handoff(self) -> None:  # graftlint: init-only
         """Streaming-session state + handoff observability. Called from
         InstanceServer.__init__ once self.metrics exists; the series land
         in the instance exposition next to the engine's."""
         from xllm_service_tpu.obs import LATENCY_BUCKETS_MS
 
         # Receiver session table: sid -> {ts, expected, chunks, blocks}.
-        self._kv_sessions: Dict[str, Dict[str, Any]] = {}
+        self._kv_sessions: Dict[str, Dict[str, Any]] = {}  # guarded by: self._kv_sessions_mu
         self._kv_sessions_mu = threading.Lock()
         # Overlap accounting: numerator = full blocks that migrated through
         # stream chunks (delivered before prefill-done), denominator = ALL

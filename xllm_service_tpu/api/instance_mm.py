@@ -193,13 +193,13 @@ class MultimodalMixin:
     # Landed-but-unclaimed media embeddings are reaped after this TTL.
     _MM_IMPORT_TTL_S = 120.0
 
-    def _init_mm(self) -> None:
+    def _init_mm(self) -> None:  # graftlint: init-only
         """Multimodal state + instruments (called from InstanceServer
         __init__ after self.metrics exists)."""
         # srid -> (embeds, positions, arrival_ts); legacy monolithic
         # landing table, waited on by _pop_mm_import.
-        self._mm_imports: Dict[str, Tuple[Any, List[int], float]] = {}
-        self._mm_events: Dict[str, threading.Event] = {}
+        self._mm_imports: Dict[str, Tuple[Any, List[int], float]] = {}  # guarded by: self._mm_mu
+        self._mm_events: Dict[str, threading.Event] = {}  # guarded by: self._mm_mu
         self._mm_mu = threading.Lock()
         # Streamed-handoff state (encoder fabric): srid -> live handle,
         # plus chunks that arrived before the forwarded request did

@@ -51,7 +51,7 @@ class GlobalKVCacheMgr:
         self._block_size = block_size
         self._seed = murmur_hash3_seed
         self._mu = threading.RLock()
-        self._index: Dict[bytes, CacheLocations] = {}
+        self._index: Dict[bytes, CacheLocations] = {}  # guarded by: self._mu
         self._dirty: Set[bytes] = set()    # changed since last upload
         self._deleted: Set[bytes] = set()  # emptied since last upload
         self._watch_id = self._store.add_watch(CACHE_PREFIX, self._on_watch)
@@ -64,7 +64,7 @@ class GlobalKVCacheMgr:
     def block_size(self) -> int:
         return self._block_size
 
-    def _init_from_store(self) -> None:
+    def _init_from_store(self) -> None:  # graftlint: init-only
         for key, raw in self._store.get_prefix(CACHE_PREFIX).items():
             h = bytes.fromhex(key[len(CACHE_PREFIX):])
             try:

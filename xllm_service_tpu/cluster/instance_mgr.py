@@ -140,7 +140,7 @@ class InstanceMgr:
         self._predictors: Dict[str, TimePredictor] = {}
         self._request_metrics: Dict[str, RequestMetrics] = {}
         self._latency_metrics: Dict[str, LatencyMetrics] = {}
-        self._load_metrics: Dict[str, LoadMetrics] = {}
+        self._load_metrics: Dict[str, LoadMetrics] = {}  # guarded by: self._mu
         self._heartbeat_ts: Dict[str, float] = {}
         # Last master-flush (epoch, counter) seen per instance: replicas
         # only refresh liveness on PUTs whose stamp advances. The epoch is
@@ -189,7 +189,7 @@ class InstanceMgr:
     # registration / discovery
     # ------------------------------------------------------------------ #
 
-    def _init_from_store(self) -> None:
+    def _init_from_store(self) -> None:  # graftlint: init-only
         """Initial prefix scan (reference: InstanceMgr::init,
         instance_mgr.cpp:69-154)."""
         for itype, prefix in INSTANCE_PREFIXES.items():

@@ -1,0 +1,107 @@
+"""Thread-ownership contracts for single-threaded hot state.
+
+The engine's slot arrays, block manager, and host/SSD pools are
+engine-thread-only by design (docs/ENGINE_PIPELINE.md, docs/KV_CACHE.md
+"the export itself runs on the engine thread") — but until now that
+contract lived in docstrings. This module makes it executable:
+
+    class Engine:
+        def _loop(self):
+            claim_thread(self, "engine")
+            try:
+                ...
+            finally:
+                release_thread(self, "engine")
+
+        @thread_owned("engine")
+        def _slot_admit(self, seq): ...
+
+`@thread_owned(realm)` asserts, when `XLLM_THREAD_CHECKS=1` (the test
+suite turns it on in tests/conftest.py), that the caller IS the thread
+that claimed the realm on this object. Before any claim — unit tests
+driving engine internals directly, sync-mode engines stepped inline —
+the check passes: ownership only binds once a loop declares itself.
+After `release_thread` (loop exit) direct calls are again allowed,
+so a stopped engine can be inspected.
+
+With checks off (production default) the decorator returns the function
+untouched — zero overhead. The static half is graftlint's
+thread-ownership pass (docs/STATIC_ANALYSIS.md): call sites of owned
+methods must themselves be owned or claimers, so the whole engine-thread
+call chain is marked and an off-thread call site fails lint before a
+racy test has to catch it.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+
+__all__ = [
+    "checks_enabled",
+    "claim_thread",
+    "release_thread",
+    "thread_owned",
+    "ThreadOwnershipError",
+]
+
+
+class ThreadOwnershipError(AssertionError):
+    """A @thread_owned method ran on a thread that doesn't own its realm."""
+
+
+def checks_enabled() -> bool:
+    return os.environ.get("XLLM_THREAD_CHECKS", "") not in ("", "0")
+
+
+def _attr(realm: str) -> str:
+    return f"_thread_owner_{realm}"
+
+
+def claim_thread(obj, realm: str) -> None:
+    """Declare the current thread the owner of `realm` on `obj` (the
+    engine loop calls this first thing). Idempotent per thread;
+    re-claiming from a DIFFERENT thread is itself an ownership bug."""
+    cur = threading.get_ident()
+    prev = getattr(obj, _attr(realm), None)
+    if prev is not None and prev != cur and checks_enabled():
+        raise ThreadOwnershipError(
+            f"{type(obj).__name__}: realm {realm!r} already claimed by "
+            f"thread {prev}; thread {cur} cannot re-claim it"
+        )
+    setattr(obj, _attr(realm), cur)
+
+
+def release_thread(obj, realm: str) -> None:
+    """Release ownership (loop exit): direct calls are allowed again."""
+    try:
+        delattr(obj, _attr(realm))
+    except AttributeError:
+        pass
+
+
+def thread_owned(realm: str):
+    """Methods mutating `realm`-owned state may only run on the claiming
+    thread. No-op (function returned untouched) unless
+    XLLM_THREAD_CHECKS=1 at decoration time."""
+
+    def deco(fn):
+        if not checks_enabled():
+            return fn
+
+        @functools.wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            owner = getattr(self, _attr(realm), None)
+            if owner is not None and owner != threading.get_ident():
+                raise ThreadOwnershipError(
+                    f"{type(self).__name__}.{fn.__name__} is "
+                    f"@thread_owned({realm!r}) but ran on thread "
+                    f"{threading.current_thread().name!r} while thread "
+                    f"id {owner} owns the realm"
+                )
+            return fn(self, *args, **kwargs)
+
+        return wrapper
+
+    return deco

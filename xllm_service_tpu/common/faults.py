@@ -72,6 +72,7 @@ __all__ = [
     "install_spec",
     "clear",
     "get_plan",
+    "set_point_observer",
 ]
 
 
@@ -246,10 +247,26 @@ def get_plan() -> Optional[FaultPlan]:
     return _plan
 
 
+# Observer hook: obs/locktrace.py registers its sanitizer here when
+# XLLM_LOCK_TRACE is on, so a lock held across an injection point — the
+# place chaos can inject a hang WHILE the lock is held — is recorded
+# without faults.py importing the tracer.
+_point_observer: Optional[Any] = None
+
+
+def set_point_observer(cb) -> None:
+    global _point_observer
+    _point_observer = cb
+
+
 def point(name: str, /, **ctx: Any) -> None:
     """Mark one named injection point. No-op (one global read + None
-    check) unless a plan is installed; may sleep or raise FaultInjected
-    when a rule fires."""
+    check each for the observer and the plan) unless a sanitizer or a
+    plan is installed; may sleep or raise FaultInjected when a rule
+    fires."""
+    obs = _point_observer
+    if obs is not None:
+        obs(name)
     plan = get_plan()
     if plan is None:
         return

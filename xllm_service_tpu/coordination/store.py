@@ -395,6 +395,13 @@ class MemoryStore(CoordinationStore):
         with self._mu:
             self._closed = True
             self._event_cv.notify_all()
+        # Join OUTSIDE _mu (both loops need it to observe _closed), and
+        # never from a watch callback running on the notifier itself.
+        me = threading.current_thread()
+        if self._notifier is not me:
+            self._notifier.join(timeout=2)
+        if self._sweeper is not me:
+            self._sweeper.join(timeout=2)
 
     # Test hook: force-expire a lease without waiting for wall-clock TTL.
     def expire_lease_now(self, lease_id: int) -> None:

@@ -1,0 +1,426 @@
+"""Runtime lock-order sanitizer (`XLLM_LOCK_TRACE=1`).
+
+The static passes (docs/STATIC_ANALYSIS.md) catch what's visible in one
+class; deadlocks live in the composition — instance A's heartbeat
+holding its registry lock while the master's dispatch path holds the
+scheduler lock and each posts to the other. This module is the runtime
+half, modeled on the kernel's lockdep and TSan's deadlock detector, as
+the reference stack's C++ service tier would get from TSan:
+
+* `install()` patches `threading.Lock`/`threading.RLock` so every lock
+  subsequently CREATED BY REPO CODE (creation-site frame inside this
+  repository — stdlib/third-party locks are left untouched and untraced)
+  is wrapped with an acquisition recorder;
+* locks are grouped into CLASSES by creation site (`file:line`, the
+  lockdep trick — every `InstanceMgr._mu` across a fleet of test
+  instances is one class, so an order inversion between two *objects*
+  of the same two classes is still caught);
+* each acquire records a `held-class -> new-class` edge with one
+  example (thread name + both creation sites). A cycle in the class
+  graph is a potential deadlock: some interleaving of those call paths
+  can stall both threads forever, even if this run got lucky;
+* `faults.point(...)` hits are observed via `faults.set_point_observer`:
+  an acquisition HELD ACROSS a fault point means chaos can inject a
+  delay/hang while the lock is held — the lock-convoy half of every
+  chaos-found stall — and is reported with the holding sites;
+* the chaos/differential suites (test_faults, test_master_failover,
+  test_prefix_fabric, test_encoder_fabric) assert a clean report via
+  the autouse fixture in tests/conftest.py when `XLLM_LOCK_TRACE=1`.
+
+Counters (scraped via `registry()`, documented in OBSERVABILITY.md):
+`xllm_locktrace_locks_total`, `xllm_locktrace_acquires_total`,
+`xllm_locktrace_edges_total`, `xllm_locktrace_point_holds_total`, and
+the `xllm_locktrace_lock_classes` gauge.
+
+Caveats, by design: module-level locks created before `install()` are
+untraced (install runs at conftest import, before any component is
+constructed, so in practice that's a handful of stdlib-shaped globals);
+`Condition.wait`'s release/re-acquire is tracked through
+`_release_save`/`_acquire_restore`, so a wait doesn't count as holding
+the lock across the wait.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from xllm_service_tpu.obs.metrics import MetricsRegistry
+
+__all__ = [
+    "enabled",
+    "active",
+    "install",
+    "uninstall",
+    "reset",
+    "report",
+    "note_point",
+    "registry",
+    "isolated",
+]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)
+)))
+_SELF = os.path.abspath(__file__).rstrip("co")  # .pyc → .py
+
+
+def enabled() -> bool:
+    return os.environ.get("XLLM_LOCK_TRACE", "") not in ("", "0")
+
+
+# ---------------------------------------------------------------------------
+# trace state
+# ---------------------------------------------------------------------------
+
+
+class _State:
+    def __init__(self):
+        # The sanitizer's OWN bookkeeping locks (this mu, the metric
+        # registry's per-metric locks) must never be traced: a recorded
+        # acquire increments a counter, and if that counter's lock were
+        # itself traced the inc inside record_acquire would re-enter the
+        # wrapper while the lock is already held — instant self-deadlock.
+        # A fresh _State built while install() is active (isolated())
+        # would hit exactly that, so construction runs on the original
+        # factories.
+        restore = None
+        if _installed:
+            restore = (threading.Lock, threading.RLock)
+            threading.Lock, threading.RLock = _orig_lock, _orig_rlock
+        try:
+            self.mu = threading.Lock()  # guards edges/point_holds
+            self.tls = threading.local()
+            self.classes: Set[str] = set()
+            self.edges: Dict[Tuple[str, str], dict] = {}
+            self.point_holds: Dict[Tuple[str, str], int] = {}
+            self.reg = MetricsRegistry()
+            self.c_locks = self.reg.counter(
+                "xllm_locktrace_locks_total", "Traced locks created")
+            self.c_acquires = self.reg.counter(
+                "xllm_locktrace_acquires_total", "Traced lock acquisitions")
+            self.c_edges = self.reg.counter(
+                "xllm_locktrace_edges_total",
+                "Distinct held->acquired lock-class edges observed")
+            self.c_point_holds = self.reg.counter(
+                "xllm_locktrace_point_holds_total",
+                "Fault-point hits with at least one traced lock held")
+            self.g_classes = self.reg.gauge(
+                "xllm_locktrace_lock_classes",
+                "Distinct lock creation sites traced")
+            self.g_classes.set_function(lambda: len(self.classes))
+        finally:
+            if restore is not None:
+                threading.Lock, threading.RLock = restore
+
+    # ------------------------------------------------------------ stack
+
+    def stack(self) -> list:
+        st = getattr(self.tls, "stack", None)
+        if st is None:
+            st = self.tls.stack = []
+        return st
+
+    # The recorders themselves take locks (the metrics registry's, this
+    # state's `mu`) which may be traced too — a thread already inside a
+    # recorder must pass straight through or every recorded acquire
+    # recurses into recording its own bookkeeping locks.
+    def _busy(self) -> bool:
+        return getattr(self.tls, "busy", False)
+
+    def record_acquire(self, lock: "_TracedLockBase") -> None:
+        if self._busy():
+            return
+        self.tls.busy = True
+        try:
+            st = self.stack()
+            self.c_acquires.inc()
+            if st:
+                new_edges = [
+                    (h.site, lock.site) for h in st
+                    if h is not lock
+                    and (h.site, lock.site) not in self.edges
+                ]
+                if new_edges:
+                    with self.mu:
+                        for a, b in new_edges:
+                            if (a, b) not in self.edges:
+                                self.edges[(a, b)] = {
+                                    "thread":
+                                        threading.current_thread().name,
+                                }
+                                self.c_edges.inc()
+            st.append(lock)
+        finally:
+            self.tls.busy = False
+
+    def record_release(self, lock: "_TracedLockBase") -> None:
+        if self._busy():
+            return
+        st = self.stack()
+        # remove LAST occurrence — manual acquire/release may interleave
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] is lock:
+                del st[i]
+                return
+
+    def note_point(self, name: str) -> None:
+        if self._busy():
+            return
+        st = self.stack()
+        if not st:
+            return
+        self.tls.busy = True
+        try:
+            self.c_point_holds.inc()
+            with self.mu:
+                for h in st:
+                    key = (name, h.site)
+                    self.point_holds[key] = self.point_holds.get(key, 0) + 1
+        finally:
+            self.tls.busy = False
+
+    # ------------------------------------------------------------ report
+
+    def cycles(self) -> List[List[str]]:
+        """Every elementary cycle's node list (deduped by node set) in
+        the lock-class graph — small graphs, plain DFS is fine."""
+        with self.mu:
+            adj: Dict[str, List[str]] = {}
+            for a, b in self.edges:
+                adj.setdefault(a, []).append(b)
+        out: List[List[str]] = []
+        seen_sets: Set[frozenset] = set()
+
+        def dfs(start: str, node: str, path: List[str],
+                on_path: Set[str]) -> None:
+            for nxt in adj.get(node, ()):
+                if nxt == start and len(path) >= 1:
+                    key = frozenset(path)
+                    if key not in seen_sets:
+                        seen_sets.add(key)
+                        out.append(path + [start])
+                elif nxt not in on_path and nxt > start:
+                    # only explore nodes ordered after `start`: each
+                    # cycle is found once, from its smallest node
+                    dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+        # self-edges (two instances of one class nested) fall out of the
+        # same DFS: start's successor list contains start itself.
+        for n in sorted(adj):
+            dfs(n, n, [n], {n})
+        return out
+
+
+_installed = False
+_orig_lock = None
+_orig_rlock = None
+_state = _State()
+
+
+# ---------------------------------------------------------------------------
+# traced lock wrappers
+# ---------------------------------------------------------------------------
+
+
+def _creation_site() -> Optional[str]:
+    """repo-relative file:line of the frame that created the lock, or
+    None when the creator is outside the repo (don't trace)."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        base = os.path.basename(fn)
+        if base != "threading.py" and os.path.abspath(fn) != _SELF:
+            absfn = os.path.abspath(fn)
+            if absfn.startswith(_REPO_ROOT + os.sep):
+                return f"{os.path.relpath(absfn, _REPO_ROOT)}:{f.f_lineno}"
+            return None
+        f = f.f_back
+    return None
+
+
+class _TracedLockBase:
+    site: str
+
+    def __repr__(self):
+        return f"<traced {type(self).__name__} {self.site}>"
+
+
+class _TracedLock(_TracedLockBase):
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self.site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _state.record_acquire(self)
+        return ok
+
+    def release(self):
+        _state.record_release(self)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class _TracedRLock(_TracedLockBase):
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self.site = site
+        self._depth = 0  # mutated only by the owning thread
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        reentrant = self._inner._is_owned()
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            if not reentrant:
+                _state.record_acquire(self)
+            self._depth += 1
+        return ok
+
+    def release(self):
+        self._depth -= 1
+        if self._depth == 0:
+            _state.record_release(self)
+        self._inner.release()
+
+    # Condition integration: wait() fully releases (recursion included)
+    # and re-acquires — the held-stack must mirror that or every
+    # cv.wait() looks like a lock held across whatever woke it.
+    def _release_save(self):
+        st = self._inner._release_save()
+        depth, self._depth = self._depth, 0
+        _state.record_release(self)
+        return (st, depth)
+
+    def _acquire_restore(self, saved):
+        st, depth = saved
+        self._inner._acquire_restore(st)
+        _state.record_acquire(self)
+        self._depth = depth
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+def _make_lock():
+    site = _creation_site()
+    inner = _orig_lock()
+    if site is None:
+        return inner
+    _state.classes.add(site)
+    _state.c_locks.inc()
+    return _TracedLock(inner, site)
+
+
+def _make_rlock():
+    site = _creation_site()
+    inner = _orig_rlock()
+    if site is None:
+        return inner
+    _state.classes.add(site)
+    _state.c_locks.inc()
+    return _TracedRLock(inner, site)
+
+
+# ---------------------------------------------------------------------------
+# public surface
+# ---------------------------------------------------------------------------
+
+
+def active() -> bool:
+    return _installed
+
+
+def install() -> None:
+    """Patch threading.Lock/RLock and observe fault points. Idempotent."""
+    global _installed, _orig_lock, _orig_rlock
+    if _installed:
+        return
+    _orig_lock = threading.Lock
+    _orig_rlock = threading.RLock
+    threading.Lock = _make_lock
+    threading.RLock = _make_rlock
+    from xllm_service_tpu.common import faults
+
+    faults.set_point_observer(note_point)
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _orig_lock
+    threading.RLock = _orig_rlock
+    from xllm_service_tpu.common import faults
+
+    faults.set_point_observer(None)
+    _installed = False
+
+
+def reset() -> None:
+    """Drop recorded graph/holds (lock classes persist — creation sites
+    don't un-happen). Used between fixture scopes."""
+    with _state.mu:
+        _state.edges.clear()
+        _state.point_holds.clear()
+
+
+def note_point(name: str) -> None:
+    _state.note_point(name)
+
+
+def registry() -> MetricsRegistry:
+    return _state.reg
+
+
+def report() -> dict:
+    """{'cycles': [[site,...],...], 'point_holds': {(point, site): n},
+    'edges': n, 'classes': n} — the fixture asserts cycles == [] and
+    point_holds == {}."""
+    cycles = _state.cycles()
+    with _state.mu:
+        return {
+            "cycles": cycles,
+            "point_holds": dict(_state.point_holds),
+            "edges": len(_state.edges),
+            "classes": len(_state.classes),
+        }
+
+
+class isolated:
+    """Context manager swapping in a fresh _State — the synthetic
+    cycle/point-hold unit tests must not pollute (or read) the suite-wide
+    graph."""
+
+    def __enter__(self):
+        global _state
+        self._saved = _state
+        _state = _State()
+        return _state
+
+    def __exit__(self, *exc):
+        global _state
+        _state = self._saved
+        return False

@@ -24,6 +24,11 @@ from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from xllm_service_tpu.common.concurrency import (
+    claim_thread,
+    release_thread,
+    thread_owned,
+)
 from xllm_service_tpu.common.config import EngineConfig
 from xllm_service_tpu.common.hashing import prefix_block_hashes
 from xllm_service_tpu.common.types import (
@@ -320,7 +325,7 @@ class InferenceEngine:
                     directory, engine_cfg.num_ssd_blocks
                 )
 
-        self._waiting: Deque[EngineRequest] = collections.deque()
+        self._waiting: Deque[EngineRequest] = collections.deque()  # guarded by: self._lock
         # KV imports from prefill peers, landed on the engine thread
         # (BlockManager is engine-thread-only).
         self._pending_imports: Deque[Tuple[EngineRequest, KVHandoff]] = (
@@ -335,7 +340,7 @@ class InferenceEngine:
         # engine thread — the block manager and host/SSD pools are
         # engine-thread-only, and an off-thread export could read a block
         # mid-eviction. Each entry: {"hashes", "event", "result"}.
-        self._pending_exports: Deque[dict] = collections.deque()
+        self._pending_exports: Deque[dict] = collections.deque()  # guarded by: self._lock
         # Prefix-fabric coordinated eviction hook: called on the engine
         # thread as on_cold_evict(block_hash, host_kv) when a committed
         # block is about to leave the LAST local tier (host-pool eviction
@@ -348,7 +353,7 @@ class InferenceEngine:
         self._work = threading.Event()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
-        self._cancelled: set = set()
+        self._cancelled: set = set()  # guarded by: self._lock
 
         # Stepping mode: overlapped one-step-lookahead pipeline by default;
         # sync_engine=True (or XLLM_SYNC_ENGINE=1) forces fully synchronous
@@ -744,6 +749,19 @@ class InferenceEngine:
     # ---------------------------------------------------------------- loop
 
     def _loop(self) -> None:
+        # This thread owns the slot arrays, block manager, and host/SSD
+        # pools until the loop exits (docs/STATIC_ANALYSIS.md): the
+        # @thread_owned("engine") surfaces runtime-assert it under
+        # XLLM_THREAD_CHECKS=1, and graftlint's thread-ownership pass
+        # checks their call sites statically.
+        claim_thread(self, "engine")
+        try:
+            self._loop_owned()
+        finally:
+            release_thread(self, "engine")
+
+    @thread_owned("engine")
+    def _loop_owned(self) -> None:
         log = logging.getLogger(__name__)
         while not self._stop:
             if not self.has_work():
@@ -766,6 +784,7 @@ class InferenceEngine:
 
     # ---------------------------------------------------------------- step
 
+    @thread_owned("engine")
     def step(self) -> int:
         """One engine iteration: land migrated KV, admit + prefill new
         requests, then one decode step. Returns number of tokens produced.
@@ -811,6 +830,7 @@ class InferenceEngine:
             produced = self._step_overlap()
         return produced0 + admitted + produced
 
+    @thread_owned("engine")
     def _step_overlap(self) -> int:
         """One pipeline iteration: dispatch decode step N+1 (fed from step
         N's device-resident tokens), THEN drain/book step N while N+1 runs."""
@@ -819,6 +839,7 @@ class InferenceEngine:
         self._inflight = nxt
         return produced
 
+    @thread_owned("engine")
     def _flush_inflight(self) -> int:
         """Drain any in-flight step without dispatching a successor (mode
         transitions and shutdown): surviving slots return to host feeding."""
@@ -828,6 +849,7 @@ class InferenceEngine:
 
     # ------------------------------------------------ mixed (ragged) step
 
+    @thread_owned("engine")
     def _step_mixed(self) -> int:
         """One mixed-pipeline iteration: cut the due prefill chunks
         (continuations first — they hold slots and blocks — then fresh
@@ -861,6 +883,7 @@ class InferenceEngine:
         self._inflight = nxt
         return legacy + produced
 
+    @thread_owned("engine")
     def _continue_pf_chunks(self, items_meta: List[tuple],
                             budget: int) -> int:
         """Cut the next chunk for every mid-prefill seq (_pf_active) with
@@ -907,6 +930,7 @@ class InferenceEngine:
             budget -= chunk
         return budget
 
+    @thread_owned("engine")
     def _dispatch_mixed(self, items_meta: List[tuple]) -> Optional[_InFlight]:
         """Dispatch decode step N+1 fused with the due prefill chunks as
         ONE device step (executor.mixed_start). With no due chunks this
@@ -1022,6 +1046,7 @@ class InferenceEngine:
     def _item_req(item) -> EngineRequest:
         return item.req if isinstance(item, _Seq) else item
 
+    @thread_owned("engine")
     def _drain_cancelled(self) -> None:
         dropped = []
         with self._lock:
@@ -1060,6 +1085,7 @@ class InferenceEngine:
             if seq.req.request_id in cancelled:
                 self._finish(seq, FinishReason.NONE, cancelled=True)
 
+    @thread_owned("engine")
     def _admit(self, mixed_collect=None, budget=None) -> int:
         """Admit waiting requests up to max_prefill_tokens and prefill them
         in BATCHED compiled steps (executor.prefill_batch groups by length
@@ -1432,6 +1458,7 @@ class InferenceEngine:
             and not self._sp_eligible(seq)
         )
 
+    @thread_owned("engine")
     def _prefill_admitted(self, batch: List[_Seq]) -> int:
         from xllm_service_tpu.runtime.executor import PrefillItem
         # Long-context path: prompts past the SP threshold prefill over the
@@ -1576,6 +1603,7 @@ class InferenceEngine:
             admitted += 1
         return admitted
 
+    @thread_owned("engine")
     def _finish_prefill(
         self,
         seq: "_Seq",
@@ -1626,6 +1654,7 @@ class InferenceEngine:
             return 1
         return len(groups(items))
 
+    @thread_owned("engine")
     def _prefill_sp(self, batch: List[_Seq]) -> int:
         """Ring-attention prefill for long prompts (one jitted call per
         sequence; the sp mesh ring IS the batch dimension here). The ring
@@ -1748,6 +1777,7 @@ class InferenceEngine:
 
     # ------------------------------------------------- prefix KV fabric
 
+    @thread_owned("engine")
     def _extend_midchunk_match(self, seq: _Seq,
                                frontier: Optional[int] = None) -> int:
         """Chunk-boundary cache pickup: if the NEXT un-prefilled blocks'
@@ -1834,6 +1864,7 @@ class InferenceEngine:
             return [], None
         return job["result"]
 
+    @thread_owned("engine")
     def _drain_export_requests(self) -> None:
         while True:
             with self._lock:
@@ -1850,6 +1881,7 @@ class InferenceEngine:
             finally:
                 job["event"].set()
 
+    @thread_owned("engine")
     def _export_cached(self, hashes: List[bytes]):
         """Engine-thread export body: HBM blocks gather in ONE device
         export; host/SSD blocks read from their pools. Requested order is
@@ -1944,6 +1976,7 @@ class InferenceEngine:
         )
         return cache[:nblocks]
 
+    @thread_owned("engine")
     def _handoff(self, seq: _Seq) -> None:
         """Prefill side: export this sequence's full committed blocks and
         hand them to the peer transport, then release the local sequence.
@@ -2174,6 +2207,7 @@ class InferenceEngine:
 
     # -------------------------------------------------------------- decode
 
+    @thread_owned("engine")
     def _ensure_decode_capacity(self, width: int, mask=None) -> None:
         """Ensure block capacity for every position the coming decode step
         may write: `width` tokens starting at each slot's next input
@@ -2220,6 +2254,7 @@ class InferenceEngine:
             getattr(self, count_attr) + int(bool(val)) - int(bool(old)),
         )
 
+    @thread_owned("engine")
     def _slot_admit(self, seq: _Seq) -> None:
         """Install a sequence's sampling params + dispatch state into the
         persistent per-slot arrays (fresh admission, preemption resume, PD
@@ -2266,6 +2301,7 @@ class InferenceEngine:
         seq.admit_gen += 1
         self._ps_gen += 1
 
+    @thread_owned("engine")
     def _slot_clear(self, slot: int) -> None:
         """Reset one slot's persistent arrays (finish/cancel/preempt/
         handoff) — inactive rows carry the same neutral values the old
@@ -2338,6 +2374,7 @@ class InferenceEngine:
             self.host_gap_ms_sum += gap
             self.host_gap_steps += 1
 
+    @thread_owned("engine")
     def _decode_once(self) -> int:
         if self.cfg.speculative_tokens > 0:
             return self._decode_spec_once()
@@ -2400,6 +2437,7 @@ class InferenceEngine:
 
     # ------------------------------------------------ overlapped pipeline
 
+    @thread_owned("engine")
     def _dispatch_decode(self) -> Optional[_InFlight]:
         """Dispatch the next overlapped decode step, returning its in-flight
         record (None when nothing is dispatchable). Continuing slots feed
@@ -2466,6 +2504,7 @@ class InferenceEngine:
             self.overlap_steps += 1
         return _InFlight(tokens, logprobs, snapshot, t0, nactive, total_ctx)
 
+    @thread_owned("engine")
     def _drain_step(
         self, flt: Optional[_InFlight], newer: Optional[_InFlight]
     ) -> int:
@@ -2983,6 +3022,7 @@ class InferenceEngine:
                     return out
         return np.full((k,), a[-1], np.int32)
 
+    @thread_owned("engine")
     def _decode_spec_once(self) -> int:
         """Speculative variant of _decode_once: feed [last_token, k drafts]
         per sequence, verify in one pass, emit the accepted prefix + one
@@ -3078,6 +3118,7 @@ class InferenceEngine:
         pool = offline or candidates
         return max(pool, key=lambda s: s.req.arrival_time)
 
+    @thread_owned("engine")
     def _preempt_offline_for(self, head: EngineRequest) -> bool:
         """Hybrid-scheduling preemption: an ONLINE head waiting on slots
         or blocks evicts one RUNNING offline decode (recompute-style; the
@@ -3093,6 +3134,7 @@ class InferenceEngine:
         self._preempt(victim, requeue_front=False)
         return True
 
+    @thread_owned("engine")
     def _preempt(self, seq: _Seq, requeue_front: bool = True) -> None:
         """Recompute-style preemption: release blocks and requeue the _Seq
         itself, preserving token history and generation accounting (KV is
@@ -3151,6 +3193,7 @@ class InferenceEngine:
 
     # ---------------------------------------------------------------- emit
 
+    @thread_owned("engine")
     def _emit(self, seq: _Seq, finished: Optional[FinishReason]) -> bool:
         tok, lp = seq.generated[-1]
         s = seq.req.sampling
@@ -3186,6 +3229,7 @@ class InferenceEngine:
             return False
         return True
 
+    @thread_owned("engine")
     def _finish(
         self, seq: _Seq, reason: FinishReason, cancelled: bool = False
     ) -> None:

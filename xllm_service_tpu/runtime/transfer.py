@@ -94,9 +94,22 @@ class KVTransferServer:
         dead cached transport."""
         with self._mu:
             conn = self._conns.get(addr)
-            if conn is None:
-                conn = self._srv.connect(addr)
-                self._conns[addr] = conn
+        if conn is None:
+            # Connect OUTSIDE the lock: establishing a transport to a
+            # slow/dead peer must not stall every other thread's offer/
+            # retract/pull on this server. A racing pull may connect
+            # too; first insert wins, and a losing connector closes its
+            # redundant transport and pulls over the cached winner.
+            fresh = self._srv.connect(addr)
+            with self._mu:
+                conn = self._conns.setdefault(addr, fresh)
+            if conn is not fresh:
+                try:
+                    close = getattr(fresh, "close", None)
+                    if callable(close):
+                        close()
+                except Exception:
+                    pass
         try:
             return conn.pull(uuid, list(avals))
         except Exception:
