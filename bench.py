@@ -104,6 +104,13 @@ _OVERLAP_MIN_RATIO = float(os.environ.get("XLLM_BENCH_OVERLAP_MIN_RATIO", 0.92))
 # hot loop can never be allowed to regress silently (ISSUE 9).
 _RAGGED_MIN_RATIO = float(os.environ.get("XLLM_BENCH_RAGGED_MIN_RATIO", 0.95))
 
+# Combined-path A/B guard (--spec-mode both, ISSUE 13): with speculative
+# decoding ON, the composed engine (overlap pipeline + mixed verify
+# batch) must hold at least this fraction of the sync+split verify
+# engine's throughput — composing the fast paths must never pay more
+# than it hides (the real win is on TPU; CPU arms the floor).
+_SPEC_MIN_RATIO = float(os.environ.get("XLLM_BENCH_SPEC_MIN_RATIO", 0.95))
+
 
 def _cpu_regression_guard(line: str) -> "tuple[str, int]":
     """Apply the >5% clean-load CPU decode regression guard — and the
@@ -194,6 +201,53 @@ def _cpu_regression_guard(line: str) -> "tuple[str, int]":
                 f"{100 * _RAGGED_MIN_RATIO:.0f}% of split mode {s:.1f}"
             )
             rc = rc or 3
+    # Combined-path A/B (--spec-mode both): speculative decode through
+    # the composed overlap+mixed pipeline vs the sync+split verify
+    # engine (ISSUE 13).
+    sb = res.get("spec_bench") or {}
+    if isinstance(sb, dict) and "composed" in sb and "sync_split" in sb:
+        try:
+            s = float(sb["sync_split"]["tok_s"])
+            c = float(sb["composed"]["tok_s"])
+        except (KeyError, TypeError, ValueError):
+            s = c = 0.0
+        # The rows must have RUN the builders they are labeled as: the
+        # XLLM_SPEC_PIPELINE / XLLM_SYNC_ENGINE / XLLM_MIXED_STEP env
+        # hatches win over the per-run config, and a sync-vs-sync
+        # comparison stamping "ok" would defeat the guard — abstain
+        # loudly on a builder mismatch, like engine_ragged_guard.
+        builders = (
+            sb["composed"].get("step_builder"),
+            sb["sync_split"].get("step_builder"),
+        )
+        if builders != ("spec-overlap+mixed", "spec-sync+split"):
+            # "spec-overlap+split" for the composed row is also a
+            # legitimate label: the model family has no
+            # mixed_verify_step (MLA), so verify rows pipelined without
+            # prefill fusion — name both causes instead of sending the
+            # operator hunting for hatches that were never set.
+            cause = (
+                "the family lacks mixed_verify_step (no spec+mixed "
+                "fusion)"
+                if builders[0] == "spec-overlap+split"
+                and builders[1] == "spec-sync+split"
+                else "an env override pinned the builder "
+                "(XLLM_SPEC_PIPELINE/XLLM_SYNC_ENGINE/XLLM_MIXED_STEP?)"
+            )
+            res["engine_spec_guard"] = (
+                f"abstained: step_builder {builders[0]}/{builders[1]} — "
+                f"{cause}"
+            )
+        elif s <= 0:
+            pass
+        elif c >= _SPEC_MIN_RATIO * s:
+            res["engine_spec_guard"] = "ok"
+        else:
+            res["engine_spec_guard"] = (
+                f"FAIL: composed spec engine {c:.1f} tok/s is below "
+                f"{100 * _SPEC_MIN_RATIO:.0f}% of sync+split {s:.1f}"
+            )
+            rc = rc or 3
     return json.dumps(res), rc
 
 
@@ -232,6 +286,18 @@ def main() -> None:
                 f"got {attention_mode!r}"
             )
 
+    # --spec-mode {composed,sync,both}: the combined-path A/B (ISSUE 13)
+    # — speculative decoding through the composed overlap+mixed pipeline
+    # vs the sync+split verify engine. Default "both" reports the pair
+    # and arms the engine_spec_guard.
+    spec_mode = "both"
+    if "--spec-mode" in sys.argv:
+        spec_mode = sys.argv[sys.argv.index("--spec-mode") + 1]
+        if spec_mode not in ("composed", "sync", "both"):
+            raise SystemExit(
+                f"--spec-mode must be composed|sync|both, got {spec_mode!r}"
+            )
+
     backend = _probe_backend()
     on_tpu = backend == "tpu"
     # Fastest config first; fall back if a path that never ran on real
@@ -253,7 +319,8 @@ def main() -> None:
     for attempt in attempts:
         rc, out, err = _run_attempt_subprocess(
             dict(attempt, engine_mode=engine_mode,
-                 attention_mode=attention_mode, _on_tpu=on_tpu)
+                 attention_mode=attention_mode, spec_mode=spec_mode,
+                 _on_tpu=on_tpu)
         )
         line = ""
         for ln in out.splitlines():
@@ -279,7 +346,7 @@ def main() -> None:
     raise SystemExit(f"all bench configs failed: {last_err}")
 
 
-def _engine_bench(sync: bool, mixed: bool = True) -> dict:
+def _engine_bench(sync: bool, mixed: bool = True, spec: int = 0) -> dict:
     """Full-InferenceEngine decode throughput (llama3-tiny, R=8) in one
     stepping mode: R seeded requests driven to completion through the real
     admission/decode/emit path. Reports tokens/s plus the pipeline
@@ -287,7 +354,9 @@ def _engine_bench(sync: bool, mixed: bool = True) -> dict:
     fraction of decode steps dispatched with another step in flight, the
     fraction of dispatches that fused prefill rows with the decode batch
     (`mixed` stepping, docs/KERNELS.md), and the RESOLVED attention
-    kernel the engine's dispatches actually route to."""
+    kernel the engine's dispatches actually route to. `spec` > 0 runs
+    the same harness under speculative decoding (the ISSUE 13 combined
+    path: sync/mixed then select composed vs sync+split verify)."""
     import numpy as np
 
     from xllm_service_tpu.common.config import EngineConfig
@@ -306,6 +375,10 @@ def _engine_bench(sync: bool, mixed: bool = True) -> dict:
         prefill_buckets=[32, 64, 128, 256],
         sync_engine=sync,
         enable_mixed_step=mixed,
+        speculative_tokens=spec,
+        # Composed path under test iff the engine is NOT pinned sync —
+        # sync=True + spec gives exactly the pre-ISSUE-13 verify loop.
+        enable_spec_pipeline=not sync,
     )
     eng = InferenceEngine(cfg, executor=ModelExecutor(cfg))
     rng = np.random.default_rng(0)
@@ -337,10 +410,18 @@ def _engine_bench(sync: bool, mixed: bool = True) -> dict:
         return emitted[0], time.perf_counter() - t0
 
     run_once("warm")  # compile every shape outside the timing
+    if spec and not sync:
+        # Second warm pass for the pipelined verify: a first post-idle
+        # dispatch sees device-provenance prev/cache arrays that the
+        # cold boot's numpy-fed shapes didn't cover — one more full
+        # cycle compiles those variants outside the timed window too.
+        run_once("warm2")
     repeats = int(os.environ.get("XLLM_BENCH_ENGINE_REPEATS", 3))
     gap0, gsteps0 = eng.host_gap_ms_sum, eng.host_gap_steps
     ov0, disp0 = eng.overlap_steps, eng.decode_dispatches
     disc0, mix0 = eng.late_stop_discards, eng.mixed_steps
+    emit0, sstep0 = eng.spec_tokens_emitted, eng.spec_slot_steps
+    pipe0, spec0 = eng.spec_pipeline_steps, eng.spec_steps
     dts, toks = [], 0
     for r in range(repeats):
         n, dt = run_once(f"t{r}")
@@ -350,11 +431,25 @@ def _engine_bench(sync: bool, mixed: bool = True) -> dict:
     gap_steps = max(eng.host_gap_steps - gsteps0, 1)
     dispatches = max(eng.decode_dispatches - disp0, 1)
     # The builder the engine actually RAN, not the config knob: sync mode
-    # (and spec decode) forces the split path even with mixed enabled.
-    mixed_ran = eng.mixed_step_enabled and not eng._force_sync
-    return {
+    # forces the split path even with mixed enabled, and the env hatches
+    # (XLLM_SYNC_ENGINE/XLLM_SPEC_PIPELINE/XLLM_MIXED_STEP) win over the
+    # per-run config — the guards abstain on a label mismatch.
+    pipelined = not eng._force_sync
+    mixed_ran = eng.mixed_step_enabled and pipelined
+    if spec:
+        spec_fuse = mixed_ran and getattr(
+            eng.executor, "supports_spec_mixed", False
+        )
+        builder = (
+            "spec-overlap+mixed" if pipelined and spec_fuse
+            else "spec-overlap+split" if pipelined
+            else "spec-sync+split"
+        )
+    else:
+        builder = "ragged" if mixed_ran else "split"
+    row = {
         "mode": "sync" if sync else "overlap",
-        "step_builder": "ragged" if mixed_ran else "split",
+        "step_builder": builder,
         # The dispatch decision the engine RESOLVED for the step builder
         # it actually ran — the fused step's kernel (ragged vs the
         # mixed[<decode>+<prefill>] reference pair), or the split
@@ -379,13 +474,28 @@ def _engine_bench(sync: bool, mixed: bool = True) -> dict:
         "requests": R,
         "new_tokens": new_tokens,
     }
+    if spec:
+        # Realized speculative speedup + how the verify steps routed —
+        # deltas over the timed repeats only, like the other counters
+        # (the warm passes must not fold into the A/B rows).
+        row["spec_tokens"] = spec
+        row["accepted_len_mean"] = round(
+            (eng.spec_tokens_emitted - emit0)
+            / max(eng.spec_slot_steps - sstep0, 1), 3
+        )
+        row["spec_pipeline_step_frac"] = round(
+            (eng.spec_pipeline_steps - pipe0)
+            / max(eng.spec_steps - spec0, 1), 3
+        )
+    return row
 
 
 def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
          use_kernel: bool | None = None,
          weight_dtype: str = "auto",
          engine_mode: str = "both",
-         attention_mode: str = "both") -> None:
+         attention_mode: str = "both",
+         spec_mode: str = "both") -> None:
     import jax
 
     from xllm_service_tpu.common.config import EngineConfig
@@ -615,6 +725,7 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
         # would measure the tunnel, not the pipeline).
         engine_bench = None
         attention_bench = None
+        spec_bench = None
         if not on_tpu and not os.environ.get("XLLM_BENCH_SKIP_ENGINE_AB"):
             engine_bench = {}
             modes = (
@@ -640,6 +751,24 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
                     attention_bench[m] = _engine_bench(
                         sync=False, mixed=(m == "ragged")
                     )
+            # Combined-path A/B (--spec-mode, ISSUE 13): speculative
+            # decoding through the composed pipeline (overlap + mixed
+            # verify batch + device-resident accepted-token feedback)
+            # vs the sync+split verify engine — engine_spec_guard
+            # (exit 3) enforces composed >= 95% of sync+split on CPU;
+            # the real win lands in the TPU window.
+            spec_bench = {}
+            smodes = (
+                ("composed", "sync_split") if spec_mode == "both"
+                else ("composed",) if spec_mode == "composed"
+                else ("sync_split",)
+            )
+            for m in smodes:
+                spec_bench[m] = _engine_bench(
+                    sync=(m == "sync_split"),
+                    mixed=(m == "composed"),
+                    spec=3,
+                )
 
         xla_cost = None
         if os.environ.get("XLLM_BENCH_XLA_COST"):
@@ -699,6 +828,12 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
             # of split (docs/KERNELS.md).
             "attention_bench": attention_bench,
             "attention_mode": attention_mode,
+            # Combined-path A/B (--spec-mode): speculative decode on the
+            # composed overlap+mixed pipeline vs sync+split verify —
+            # engine_spec_guard (exit 3) enforces the floor (ISSUE 13,
+            # docs/ENGINE_PIPELINE.md).
+            "spec_bench": spec_bench,
+            "spec_mode": spec_mode,
             # Methodology markers: median of N repeats, the per-repeat
             # spread, and the host's 1-min load average around the run —
             # a hot host shows up here instead of masquerading as a

@@ -174,3 +174,64 @@ def test_ragged_guard_abstains_on_builder_mismatch():
     out, rc = bench._cpu_regression_guard(_line(attention_bench=ab))
     assert rc == 0
     assert json.loads(out)["engine_ragged_guard"].startswith("abstained")
+
+
+# ---- combined-path A/B guard (--spec-mode both; speculative decode on
+# the composed overlap+mixed pipeline vs the sync+split verify engine,
+# ISSUE 13 / docs/ENGINE_PIPELINE.md) ----
+
+
+def _sb(sync_tok, composed_tok):
+    return {
+        "composed": {
+            "step_builder": "spec-overlap+mixed", "tok_s": composed_tok,
+        },
+        "sync_split": {
+            "step_builder": "spec-sync+split", "tok_s": sync_tok,
+        },
+    }
+
+
+def test_spec_at_parity_passes():
+    out, rc = bench._cpu_regression_guard(
+        _line(spec_bench=_sb(100.0, 96.0))
+    )
+    assert rc == 0
+    assert json.loads(out)["engine_spec_guard"] == "ok"
+
+
+def test_spec_regression_fails():
+    out, rc = bench._cpu_regression_guard(
+        _line(spec_bench=_sb(100.0, 90.0))
+    )
+    assert rc == 3
+    assert json.loads(out)["engine_spec_guard"].startswith("FAIL")
+
+
+def test_spec_guard_needs_both_modes():
+    # --spec-mode composed|sync runs one mode: nothing to A/B.
+    out, rc = bench._cpu_regression_guard(
+        _line(spec_bench={"composed": {"tok_s": 50.0}})
+    )
+    assert rc == 0
+    assert "engine_spec_guard" not in json.loads(out)
+
+
+def test_spec_guard_abstains_on_hot_host():
+    out, rc = bench._cpu_regression_guard(
+        _line(value=100.0, loadavg_1m=3.0, spec_bench=_sb(100.0, 10.0))
+    )
+    assert rc == 0
+    assert "engine_spec_guard" not in json.loads(out)
+
+
+def test_spec_guard_abstains_on_builder_mismatch():
+    # XLLM_SPEC_PIPELINE=0 (or XLLM_SYNC_ENGINE/XLLM_MIXED_STEP) pins
+    # the builder over the per-run config: the "composed" row actually
+    # ran the sync verify loop, so a passing ratio would be vacuous —
+    # abstain loudly rather than stamp "ok" on sync-vs-sync.
+    sb = _sb(100.0, 96.0)
+    sb["composed"]["step_builder"] = "spec-sync+split"
+    out, rc = bench._cpu_regression_guard(_line(spec_bench=sb))
+    assert rc == 0
+    assert json.loads(out)["engine_spec_guard"].startswith("abstained")

@@ -408,18 +408,32 @@ def test_env_hatch_overrides_config(monkeypatch):
     assert eng.mixed_step_enabled
 
 
-def test_speculative_forces_split():
+def test_speculative_rides_pipeline(monkeypatch):
+    """Speculative decoding no longer forces sync stepping (ISSUE 13):
+    the composed path is the default, and XLLM_SPEC_PIPELINE=0 (or
+    enable_spec_pipeline=False) degrades it back to sync verify."""
     eng = InferenceEngine(
         _cfg(speculative_tokens=3),
         executor=ModelExecutor(_cfg(), init_seed=11),
     )
-    assert eng._force_sync  # sync iterations never enter _step_mixed
+    assert not eng._force_sync
+    monkeypatch.setenv("XLLM_SPEC_PIPELINE", "0")
+    assert eng._force_sync  # live per-step decision: env flip lands
+    monkeypatch.delenv("XLLM_SPEC_PIPELINE")
+    eng2 = InferenceEngine(
+        _cfg(speculative_tokens=3, enable_spec_pipeline=False),
+        executor=ModelExecutor(_cfg(), init_seed=11),
+    )
+    assert eng2._force_sync
+    monkeypatch.setenv("XLLM_SPEC_PIPELINE", "1")
+    assert not eng2._force_sync  # =1 force-enables over a False config
 
 
-def test_guided_request_takes_split_path():
-    """A guided request admitted under mixed stepping routes through the
-    split prefill path and decodes masked (sync fallback) — and plain
-    requests around it still finish."""
+def test_guided_request_rides_mixed_batch():
+    """A guided request admitted under mixed stepping rides the mixed
+    batch (final chunk under an in-graph mask row) and decodes
+    host-paced inside the pipeline (ISSUE 13) — and plain requests
+    around it still finish."""
     reqs = _requests(n=2)
     cfg = _cfg(enable_mixed_step=True)
     eng = InferenceEngine(cfg, executor=ModelExecutor(_cfg(), init_seed=11))

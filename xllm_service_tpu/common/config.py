@@ -219,8 +219,10 @@ class EngineConfig:
     # single late token a stopped sequence over-produces). True = fully
     # synchronous stepping (every step fetched + booked before the next
     # dispatch) — the differential-testing / debugging escape hatch. The
-    # env var XLLM_SYNC_ENGINE=1|0 overrides this field either way;
-    # speculative decoding always forces sync (docs/ENGINE_PIPELINE.md).
+    # env var XLLM_SYNC_ENGINE=1|0 overrides this field either way, and
+    # the engine re-reads it EVERY step, so a flip takes effect on a
+    # running engine at the next iteration (the in-flight step is
+    # flushed at the transition — docs/ENGINE_PIPELINE.md).
     sync_engine: bool = False
 
     # Mixed (ragged) stepping. True (default) = the engine step builder
@@ -233,8 +235,11 @@ class EngineConfig:
     # a separate hatch (XLLM_RAGGED_ATTENTION_KERNEL — opt-in until
     # chip-validated). False = the split-step escape hatch (prefill batch
     # then decode step, the pre-ISSUE-9 hot loop). Env override
-    # XLLM_MIXED_STEP=1|0 wins either way; guided/speculative/sync
-    # iterations and MLA families always run split.
+    # XLLM_MIXED_STEP=1|0 wins either way; sync iterations and MLA
+    # families always run split. Guided requests ride the mixed batch
+    # (their final chunk samples under an in-graph mask row), and
+    # speculative engines fuse verify rows with the due prefill chunks
+    # (mixed_verify_step) when enable_spec_pipeline holds.
     enable_mixed_step: bool = True
 
     # Speculative decoding (prompt-lookup / n-gram drafting; 0 disables).
@@ -249,11 +254,22 @@ class EngineConfig:
     # are nearly free throughput.
     speculative_tokens: int = 0
     speculative_ngram_max: int = 3  # longest suffix n-gram to match
-    # Drafting scans at most this many trailing history tokens (numpy
-    # sliding-window match, host-side, every decode step) — bounds the
-    # proposer's host cost on long contexts; matches beyond the window are
-    # rare and only cost un-accepted drafts, never correctness.
+    # Legacy scan bound for prompt-lookup drafting. The proposer keeps a
+    # per-sequence rolling suffix index (O(ngram_max) per step), so this
+    # only caps the one-off index build of a long RESUMED history; the
+    # index itself covers the full history.
     speculative_lookback: int = 4096
+    # Speculative decoding inside the overlapped pipeline. True (default)
+    # = draft+verify runs as a pipelined unit: verify step N+1 is
+    # dispatched while step N is in flight, with step N+1's inputs (last
+    # accepted token, position, step count) gathered ON DEVICE from step
+    # N's verify output — the variable accepted count never round-trips
+    # the host. Exactness: point-mass acceptance makes the emitted
+    # stream draft-independent, so host-proposed drafts may lag one step
+    # without changing a byte (docs/ENGINE_PIPELINE.md). False = verify
+    # steps run on the sync path (the pre-ISSUE-13 behavior). Env
+    # override XLLM_SPEC_PIPELINE=1|0 wins either way, re-read per step.
+    enable_spec_pipeline: bool = True
 
     # Persistent XLA compilation cache dir ("" disables). First boot of a
     # shape-bucketed engine compiles tens of programs at 20-40 s each on

@@ -29,6 +29,7 @@ from xllm_service_tpu.models.configs import ModelConfig
 from xllm_service_tpu.ops import kv_cache as kv_cache_ops
 from xllm_service_tpu.ops.attention import (
     mixed_attention,
+    mixed_prefill_attention,
     paged_attention,
     prefill_attention,
 )
@@ -501,6 +502,142 @@ def mixed_step(
     )[:, 0]  # [P, E]
     pf_logits = _unembed(params, cfg, last)  # [P, V]
     return dec_logits, pf_logits, k_caches, v_caches
+
+
+def mixed_verify_step(
+    params: Params,
+    cfg: ModelConfig,
+    k_caches: jnp.ndarray,
+    v_caches: jnp.ndarray,
+    ver_tokens: jnp.ndarray,  # [R, S] int32 — last accepted token + drafts
+    ver_start: jnp.ndarray,  # [R] int32 — position of the first fed token
+    ver_len: jnp.ndarray,  # [R] int32 — fed tokens per row (0 = inactive)
+    ver_tables: jnp.ndarray,  # [R, CBv] int32
+    pf_tokens: jnp.ndarray,  # [P, Lpad] int32 — due prefill chunks
+    pf_start: jnp.ndarray,  # [P] int32
+    pf_len: jnp.ndarray,  # [P] int32 (0 = pad row)
+    pf_tables: jnp.ndarray,  # [P, CBp] int32
+    use_ragged: bool | None = None,
+    lora_ver: jnp.ndarray | None = None,  # [R] adapter rows (verify rows)
+    lora_pf: jnp.ndarray | None = None,  # [P] adapter rows (prefill rows)
+    ver_rope_delta: jnp.ndarray | None = None,  # [R] M-RoPE lag (<= 0)
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """ONE compiled step for a speculative MIXED batch: R verify rows
+    (q_len = k+1 — the multi-query speculative-verify half) and P
+    chunked-prefill rows in a single dispatch. Same fusion contract as
+    mixed_step: fused at the DISPATCH and ATTENTION level, while each
+    half keeps exactly the matmul shapes its split program uses — the
+    verify half IS prefill_batch_step's vmapped [R, S] program (the one
+    executor.verify runs, with all_logits), the prefill half the
+    [P, Lpad] one — because matmul row values are only bit-stable under
+    a fixed row count (docs/KERNELS.md pins this; the composed
+    differential in tests/test_spec_pipeline.py enforces it). Attention
+    runs through ops.attention.mixed_prefill_attention — one ragged
+    Pallas dispatch over the whole heterogeneous batch when the kernel
+    is enabled, the exact split prefill dispatcher per half otherwise.
+
+    Returns (ver_logits [R, S, V] — every position, the speculative
+    verify contract — pf_logits [P, V], k', v')."""
+    bs = k_caches.shape[3]
+    scale = cfg.head_dim**-0.5
+    R, S = ver_tokens.shape
+    P, Lpad = pf_tokens.shape
+    wd = wdtype(params["layers"]["wq"])
+    x_ver = _embed(params, cfg, ver_tokens, wd)  # [R, S, E]
+    x_pf = _embed(params, cfg, pf_tokens, wd)  # [P, Lpad, E]
+
+    def half_coords(start, length, tables, L):
+        offs = jnp.arange(L, dtype=jnp.int32)[None, :]
+        pos = start[:, None] + offs
+        valid = offs < length[:, None]
+        blk = jnp.where(
+            valid, jnp.take_along_axis(tables, pos // bs, axis=1), 0
+        )
+        off = jnp.where(valid, pos % bs, 0)
+        return pos, blk.reshape(-1), off.reshape(-1)
+
+    ver_pos, ver_blk, ver_off = half_coords(
+        ver_start, ver_len, ver_tables, S
+    )
+    pf_pos, pf_blk, pf_off = half_coords(pf_start, pf_len, pf_tables, Lpad)
+    # M-RoPE verify rows (media sequences decoding under spec): the
+    # generation streams are equal, only the lag vs cache positions
+    # matters — exactly executor._verify_impl's broadcast.
+    if ver_rope_delta is not None:
+        base = (ver_start + ver_rope_delta)[:, None] + jnp.arange(
+            S, dtype=jnp.int32
+        )[None]
+        ver_rp = jnp.broadcast_to(base[:, None, :], (R, 3, S))
+    else:
+        ver_rp = ver_pos
+    li_ver = lora_ver if lora_ver is not None else jnp.zeros((R,), jnp.int32)
+    li_pf = lora_pf if lora_pf is not None else jnp.zeros((P,), jnp.int32)
+
+    def layer_fn(carry, scanned):
+        x_ver, x_pf = carry
+        lp, k_l, v_l = scanned
+        h_ver = rms_norm(x_ver, lp["attn_norm"], cfg.rms_norm_eps)
+        q_ver, k_v, v_v = jax.vmap(
+            lambda hx, pos, ai: _qkv(
+                lp, cfg, hx, pos, ai if lora_ver is not None else None
+            )
+        )(h_ver, ver_rp, li_ver)  # q_ver [R, S, Hq, D]
+        h_pf = rms_norm(x_pf, lp["attn_norm"], cfg.rms_norm_eps)
+        q_pf, k_p, v_p = jax.vmap(
+            lambda hx, pos, ai: _qkv(
+                lp, cfg, hx, pos, ai if lora_pf is not None else None
+            )
+        )(h_pf, pf_pos, li_pf)
+        k_l, v_l = _scatter_kv(
+            k_l, v_l, ver_blk, ver_off,
+            k_v.reshape(R * S, *k_v.shape[2:]),
+            v_v.reshape(R * S, *v_v.shape[2:]),
+        )
+        k_l, v_l = _scatter_kv(
+            k_l, v_l, pf_blk, pf_off,
+            k_p.reshape(P * Lpad, *k_p.shape[2:]),
+            v_p.reshape(P * Lpad, *v_p.shape[2:]),
+        )
+        attn_ver, attn_pf = mixed_prefill_attention(
+            q_ver, q_pf, k_l, v_l,
+            ver_tables, ver_start, ver_len,
+            pf_tables, pf_start, pf_len,
+            scale, use_ragged=use_ragged, interpret=interpret,
+            window=cfg.sliding_window,
+        )
+
+        def half_tail(x, attn, L_, n_rows, lora, li):
+            attn_flat = attn.reshape(n_rows, L_, -1)
+            o = jnp.einsum("plh,he->ple", attn_flat,
+                           wt(lp["wo"]).reshape(-1, cfg.hidden_size))
+            if lora is not None and lp.get("lora_wo_a") is not None:
+                o = o + jax.vmap(
+                    lambda af, ai: lora_ops.apply(
+                        af, lp["lora_wo_a"], lp["lora_wo_b"], ai
+                    )
+                )(attn_flat, li)
+            x = x + o
+            h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+            return x + jax.vmap(
+                lambda t, ai: _mlp(
+                    lp, cfg, t, ai if lora is not None else None
+                )
+            )(h, li)
+
+        x_ver = half_tail(x_ver, attn_ver, S, R, lora_ver, li_ver)
+        x_pf = half_tail(x_pf, attn_pf, Lpad, P, lora_pf, li_pf)
+        return (x_ver, x_pf), (k_l, v_l)
+
+    (x_ver, x_pf), (k_caches, v_caches) = jax.lax.scan(
+        layer_fn, (x_ver, x_pf), (params["layers"], k_caches, v_caches)
+    )
+    ver_logits = _unembed(params, cfg, x_ver)  # [R, S, V]
+    last = jnp.take_along_axis(
+        x_pf, jnp.maximum(pf_len - 1, 0)[:, None, None], axis=1
+    )[:, 0]
+    pf_logits = _unembed(params, cfg, last)  # [P, V]
+    return ver_logits, pf_logits, k_caches, v_caches
 
 
 def prefill_batch_step(

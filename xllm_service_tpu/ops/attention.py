@@ -798,6 +798,75 @@ def mixed_attention(
     return dec_out, pf_out
 
 
+def mixed_prefill_attention(
+    q_a: jnp.ndarray,  # [A, La, Hq, D] — speculative verify rows (q_len<=La)
+    q_b: jnp.ndarray,  # [B, Lb, Hq, D] — chunked-prefill rows
+    k_cache,
+    v_cache,
+    a_tables: jnp.ndarray,  # [A, CBa]
+    a_start: jnp.ndarray,  # [A]
+    a_len: jnp.ndarray,  # [A] (0 = inactive row)
+    b_tables: jnp.ndarray,  # [B, CBb]
+    b_start: jnp.ndarray,  # [B]
+    b_len: jnp.ndarray,  # [B]
+    scale: float,
+    use_ragged: bool | None = None,
+    interpret: bool = False,
+    window: int = 0,
+):
+    """Attention for one fused speculative MIXED step
+    (models.llama.mixed_verify_step): TWO prefill-shaped halves — the
+    multi-query verify rows [A, S] and the chunked-prefill rows
+    [B, Lpad] — against the same paged KV.
+
+    Ragged kernel on: the whole heterogeneous batch flattens into ONE
+    Pallas dispatch (seg_lens = A S-segments + B Lpad-segments — a
+    verify row is just a ragged row with q_len = k+1, which the kernel
+    already serves; docs/KERNELS.md). Otherwise each half runs the exact
+    split serving dispatcher (prefill_attention — the program the sync
+    verify and split prefill paths use), so composed-step outputs match
+    sync+split byte for byte. `interpret` is the ragged-branch CI hook
+    only and is deliberately not forwarded to the reference pair, same
+    as mixed_attention."""
+    A, La = q_a.shape[0], q_a.shape[1]
+    B, Lb = q_b.shape[0], q_b.shape[1]
+    if ragged_kernel_enabled(
+        k_cache, q_a.shape[-1], use_ragged, interpret
+    ):
+        seg_lens = (La,) * A + (Lb,) * B
+        q_flat = jnp.concatenate(
+            [
+                q_a.reshape(A * La, *q_a.shape[2:]),
+                q_b.reshape(B * Lb, *q_b.shape[2:]),
+            ],
+            axis=0,
+        )
+        CB = max(a_tables.shape[1], b_tables.shape[1])
+        at = jnp.pad(a_tables, ((0, 0), (0, CB - a_tables.shape[1])))
+        bt = jnp.pad(b_tables, ((0, 0), (0, CB - b_tables.shape[1])))
+        tables = jnp.concatenate([at, bt], axis=0)
+        q_len = jnp.concatenate([a_len, b_len]).astype(jnp.int32)
+        pos0 = jnp.concatenate([a_start, b_start]).astype(jnp.int32)
+        out = ragged_paged_attention(
+            q_flat, k_cache, v_cache, tables, q_len, pos0, seg_lens,
+            scale, use_kernel=True, interpret=interpret, window=window,
+        )
+        return (
+            out[: A * La].reshape(q_a.shape),
+            out[A * La:].reshape(q_b.shape),
+        )
+    return (
+        prefill_attention(
+            q_a, k_cache, v_cache, a_tables, a_start, a_len, scale,
+            window=window,
+        ),
+        prefill_attention(
+            q_b, k_cache, v_cache, b_tables, b_start, b_len, scale,
+            window=window,
+        ),
+    )
+
+
 def resolved_kernel_report(
     k_cache, q_head_dim: int, ragged_interpret: bool = False
 ) -> dict:

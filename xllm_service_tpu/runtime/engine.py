@@ -191,6 +191,7 @@ class _Seq:
         "json_state", "json_upto", "schema_spec",
         "rope_pos3", "rope_delta", "admit_gen", "streamed_blocks",
         "stream_hashes", "admit_hashes", "pf_dispatched",
+        "spec_ngrams", "spec_idx_upto",
     )
 
     def __init__(self, req: EngineRequest, slot: int):
@@ -248,6 +249,15 @@ class _Seq:
         # step builder cuts the next chunk from here so back-to-back
         # chunks pipeline instead of waiting out each drain.
         self.pf_dispatched = 0
+        # Prompt-lookup drafting index (speculative decode): suffix
+        # n-gram -> follow position over this sequence's own history,
+        # extended incrementally per emitted token so proposing k drafts
+        # is O(ngram_max^2) per step instead of a full history rescan
+        # (_propose_drafts). `spec_idx_upto` = history length whose
+        # gram-ends are indexed (always one short of len(tokens): the
+        # newest gram has no follow token yet and must never self-match).
+        self.spec_ngrams: Dict[tuple, int] = {}
+        self.spec_idx_upto = 0
 
 
 class _InFlight:
@@ -262,10 +272,12 @@ class _InFlight:
 
     __slots__ = (
         "tokens", "logprobs", "slots", "t0", "nactive", "total_ctx", "pf",
+        "n_emit", "pf_tok", "pf_lp",
     )
 
     def __init__(
         self, tokens, logprobs, slots, t0, nactive, total_ctx, pf=(),
+        n_emit=None, pf_tok=None, pf_lp=None,
     ):
         self.tokens = tokens
         self.logprobs = logprobs
@@ -275,8 +287,16 @@ class _InFlight:
         self.total_ctx = total_ctx
         # Mixed (ragged) step: [(seq, admit_gen, row_idx, chunk_start,
         # chunk_end)] prefill rows riding this dispatch — their sampled
-        # tokens sit at output index R + row_idx (docs/KERNELS.md).
+        # tokens sit at output index R + row_idx (docs/KERNELS.md), or in
+        # pf_tok/pf_lp when this is a speculative verify step.
         self.pf = pf
+        # Pipelined speculative verify: tokens/logprobs are [R, S] and
+        # each slot consumes its first n_emit[slot] entries at drain
+        # (None = plain decode step). pf_tok/pf_lp carry the fused
+        # prefill rows' samples ([P]) for verify steps.
+        self.n_emit = n_emit
+        self.pf_tok = pf_tok
+        self.pf_lp = pf_lp
 
 
 # The waiting queue holds fresh EngineRequests and preempted _Seqs (which
@@ -357,9 +377,16 @@ class InferenceEngine:
 
         # Stepping mode: overlapped one-step-lookahead pipeline by default;
         # sync_engine=True (or XLLM_SYNC_ENGINE=1) forces fully synchronous
-        # stepping, and speculative decoding always does (the verify step's
-        # variable emission count cannot run one step blind). XLLM_SYNC_ENGINE=0
-        # force-enables overlap over a sync_engine=True config.
+        # stepping; XLLM_SYNC_ENGINE=0 force-enables overlap over a
+        # sync_engine=True config. Speculative decoding rides the pipeline
+        # too (verify inputs gathered on-device from the in-flight step's
+        # variable accepted counts) unless XLLM_SPEC_PIPELINE=0 /
+        # enable_spec_pipeline=False degrades it to sync verify stepping.
+        # Eligibility is a LIVE per-step decision — the `_force_sync`
+        # property re-reads both hatches every step, so a flip lands on a
+        # running engine at the next iteration (ISSUE 13 satellite); the
+        # attribute below only snapshots the construction-time value for
+        # introspection.
         import os as _os
 
         _env = _os.environ.get("XLLM_SYNC_ENGINE", "")
@@ -368,7 +395,6 @@ class InferenceEngine:
             else False if _env == "0"
             else engine_cfg.sync_engine
         )
-        self._force_sync = self.sync_engine or engine_cfg.speculative_tokens > 0
 
         # Mixed (ragged) stepping: the step builder emits ONE batch of
         # decode slots + due prefill chunks per iteration
@@ -483,6 +509,16 @@ class InferenceEngine:
         self.spec_steps = 0
         self.spec_slot_steps = 0
         self.spec_tokens_emitted = 0
+        # Composed-path accounting (ISSUE 13): verify steps dispatched
+        # through the overlapped pipeline vs on the sync path, pipelined
+        # dispatches that applied a guided mask row in-graph, and the
+        # per-slot guided fallback — host-paced skips (a guided slot held
+        # out of one dispatch so its NEXT mask row derives from the exact
+        # host automaton state; the engine itself never flushes).
+        self.spec_pipeline_steps = 0
+        self.spec_sync_steps = 0
+        self.guided_ingraph_steps = 0
+        self.guided_paced_skips = 0
         # Prefix-cache effectiveness over fresh admissions (bench/metrics).
         self.prefix_cached_tokens = 0
         self.prefix_prompt_tokens = 0
@@ -567,6 +603,36 @@ class InferenceEngine:
             "Active decode slots per mixed dispatch",
             buckets=BATCH_BUCKETS,
         )
+        # Composed-path instruments (ISSUE 13, docs/ENGINE_PIPELINE.md):
+        # speculative verify inside the overlapped pipeline + in-graph
+        # guided masking, with the per-slot fallback counters.
+        self._m_spec_accepted = self.metrics.histogram(
+            "xllm_engine_spec_accepted_len",
+            "Tokens emitted per slot per speculative verify step "
+            "(accepted prefix + the corrected/bonus token)",
+            buckets=BATCH_BUCKETS,
+        )
+        self.metrics.counter(
+            "xllm_engine_spec_pipeline_steps_total",
+            "Speculative verify steps dispatched through the overlapped "
+            "pipeline (device-resident accepted-token feedback)",
+        ).set_function(lambda: self.spec_pipeline_steps)
+        self.metrics.counter(
+            "xllm_engine_spec_sync_steps_total",
+            "Speculative verify steps run on the sync path (hatch or "
+            "transition fallback)",
+        ).set_function(lambda: self.spec_sync_steps)
+        self.metrics.counter(
+            "xllm_engine_guided_ingraph_steps_total",
+            "Pipelined dispatches that applied at least one guided mask "
+            "row in-graph (no engine flush)",
+        ).set_function(lambda: self.guided_ingraph_steps)
+        self.metrics.counter(
+            "xllm_engine_guided_paced_skips_total",
+            "Guided slots held out of one pipelined dispatch so their "
+            "next mask row derives from the exact host automaton state "
+            "(the per-slot — not per-engine — fallback)",
+        ).set_function(lambda: self.guided_paced_skips)
         # Resolved attention-dispatch accounting: which kernel actually
         # served each engine dispatch (the env var alone told the record
         # nothing — ISSUE 9). Names resolve once at engine build from the
@@ -784,50 +850,79 @@ class InferenceEngine:
 
     # ---------------------------------------------------------------- step
 
+    @property
+    def _force_sync(self) -> bool:
+        """LIVE pipeline-eligibility decision (ISSUE 13 satellite): the
+        XLLM_SYNC_ENGINE and XLLM_SPEC_PIPELINE hatches are re-read on
+        every step, so flipping either on a running engine takes effect
+        at the next iteration — step() flushes the in-flight step at the
+        transition. Guided sequences no longer appear here: they ride
+        the pipeline host-paced (per-slot, see _apply_guided_pacing)."""
+        import os as _os
+
+        _env = _os.environ.get("XLLM_SYNC_ENGINE", "")
+        sync = (
+            True if _env == "1"
+            else False if _env == "0"
+            else self.cfg.sync_engine
+        )
+        if sync:
+            return True
+        if self.cfg.speculative_tokens > 0:
+            _senv = _os.environ.get("XLLM_SPEC_PIPELINE", "")
+            return not (
+                True if _senv == "1"
+                else False if _senv == "0"
+                else self.cfg.enable_spec_pipeline
+            )
+        return False
+
     @thread_owned("engine")
     def step(self) -> int:
         """One engine iteration: land migrated KV, admit + prefill new
-        requests, then one decode step. Returns number of tokens produced.
+        requests, then one decode (or speculative verify) step. Returns
+        number of tokens produced.
 
-        Overlapped mode (default): the decode dispatch for step N+1 happens
-        BEFORE step N's results are consumed, so host bookkeeping runs while
-        the device computes. Sync mode — the escape hatch, plus automatic
-        fallback while speculative decoding or any guided sequence is live
-        (their next dispatch depends on the previous step's tokens host-side)
-        — fetches and books each step before dispatching the next."""
+        Overlapped mode (default): the dispatch for step N+1 happens
+        BEFORE step N's results are consumed, so host bookkeeping runs
+        while the device computes — for plain decode AND speculative
+        verify (step N+1's verify inputs are gathered on-device from
+        step N's variable accepted counts). Guided sequences ride the
+        pipeline host-paced per slot. Sync mode — the escape hatch, or
+        XLLM_SPEC_PIPELINE=0 degrading speculative engines — fetches and
+        books each step before dispatching the next; the eligibility
+        decision is re-made every step so hatch flips land mid-run."""
         if not self._running and self._inflight is None:
             self._t_host_free = None  # idle time is not a host gap
         self._drain_imports()
         self._drain_export_requests()
         self._drain_cancelled()
         self._maybe_flush_schema_rows()
-        if (
-            self.mixed_step_enabled
-            and not self._force_sync
-            and not self._guided_slots
-        ):
+        if self._force_sync:
+            # Sync path (hatch / spec-pipeline degrade): flush the
+            # pipeline at the transition (_flush_pipeline_state drains
+            # the in-flight step and requeues mixed-held mid-prefill
+            # seqs into the split midchunk flow).
+            produced0 = self._flush_pipeline_state()
+            admitted = self._admit()
+            produced = self._decode_once()
+            return produced0 + admitted + produced
+        if self.cfg.speculative_tokens > 0:
+            # Pipelined speculative stepping: draft+verify as a
+            # pipelined unit, fused with due prefill chunks when the
+            # model family supports it (docs/ENGINE_PIPELINE.md).
+            return self._step_spec()
+        if self.mixed_step_enabled:
             # Mixed (ragged) stepping: ONE dispatch carries the decode
             # batch AND the due prefill chunks (docs/KERNELS.md).
             return self._step_mixed()
         produced0 = 0
         if self._pf_active:
-            # Mode flip mid-prefill (a guided request went live /
-            # speculative turned on): drain the in-flight mixed step,
-            # then hand the held seqs to the split midchunk flow — they
-            # keep slot + blocks and continue FIRST, like any split-mode
-            # mid-chunk seq.
-            produced0 = self._flush_inflight()
-            with self._lock:
-                self._waiting.extendleft(
-                    reversed(list(self._pf_active.values()))
-                )
-            self._pf_active.clear()
+            # Mode flip mid-prefill (mixed stepping turned off): drain
+            # the in-flight mixed step, requeue the held seqs.
+            produced0 = self._flush_pipeline_state()
         admitted = self._admit()
-        if self._force_sync or self._guided_slots:
-            produced = self._flush_inflight()
-            produced += self._decode_once()
-        else:
-            produced = self._step_overlap()
+        produced = self._step_overlap()
         return produced0 + admitted + produced
 
     @thread_owned("engine")
@@ -847,6 +942,22 @@ class InferenceEngine:
         self._inflight = None
         return produced
 
+    @thread_owned("engine")
+    def _flush_pipeline_state(self) -> int:
+        """Mode-transition flush: drain the in-flight step AND hand any
+        mixed-held mid-prefill seqs back to the split midchunk flow —
+        they keep slot + blocks and continue FIRST, like any split-mode
+        mid-chunk seq. One implementation for every transition (sync
+        hatch, mixed-off flip, spec fuse-support flip)."""
+        produced = self._flush_inflight()
+        if self._pf_active:
+            with self._lock:
+                self._waiting.extendleft(
+                    reversed(list(self._pf_active.values()))
+                )
+            self._pf_active.clear()
+        return produced
+
     # ------------------------------------------------ mixed (ragged) step
 
     @thread_owned("engine")
@@ -864,20 +975,6 @@ class InferenceEngine:
             items_meta, self.cfg.max_prefill_tokens
         )
         legacy = self._admit(mixed_collect=items_meta, budget=budget)
-        if self._guided_slots:
-            # A guided request went LIVE during this admission (legacy
-            # prefill path): its decode steps need mask rows, which only
-            # the sync path applies. Drain the pipeline, hand any held
-            # mixed seqs to the split midchunk flow, decode masked.
-            produced = self._flush_inflight()
-            if self._pf_active:
-                with self._lock:
-                    self._waiting.extendleft(
-                        reversed(list(self._pf_active.values()))
-                    )
-                self._pf_active.clear()
-            produced += self._decode_once()
-            return legacy + produced
         nxt = self._dispatch_mixed(items_meta)
         produced = self._drain_step(self._inflight, nxt)
         self._inflight = nxt
@@ -931,33 +1028,14 @@ class InferenceEngine:
         return budget
 
     @thread_owned("engine")
-    def _dispatch_mixed(self, items_meta: List[tuple]) -> Optional[_InFlight]:
-        """Dispatch decode step N+1 fused with the due prefill chunks as
-        ONE device step (executor.mixed_start). With no due chunks this
-        is exactly _dispatch_decode — the fused shapes only compile when
-        a mixed batch actually exists."""
+    def _build_pf_items(self, items_meta: List[tuple], t0: float):
+        """PrefillItems + drain entries for the due chunks riding a
+        fused dispatch (shared by _dispatch_mixed and _dispatch_verify).
+        Guided seqs' FINAL chunks carry their host-derived mask row —
+        exact at dispatch, because a mid-prefill seq has no decode step
+        in flight (its automaton state is host truth)."""
         from xllm_service_tpu.runtime.executor import PrefillItem
 
-        if not items_meta:
-            return self._dispatch_decode()
-        R = self.R
-        can = (
-            self._ps_active
-            & (self._ps_gen_count + self._ps_pending < self._ps_max_new)
-            & (
-                self._ps_tok_count + self._ps_pending
-                < self.cfg.max_seq_len
-            )
-        )
-        if can.any():
-            self._ensure_decode_capacity(1, mask=can)
-            can &= self._ps_active  # the capacity pass may have preempted
-        batch = self._sampling_batch_view()
-        prev = self._inflight
-        fresh_mask = self._fresh | ~can
-        assert prev is not None or bool(fresh_mask[can].all())
-        self._observe_host_gap()
-        t0 = time.monotonic()
         items = []
         pf_entries = []
         for j, (seq, start, n) in enumerate(items_meta):
@@ -988,6 +1066,12 @@ class InferenceEngine:
                     tuple(getattr(s, "logit_bias", ()) or ())
                     if final else ()
                 ),
+                mask_row=(
+                    self._guided_row(seq)
+                    if final and seq.req.guided
+                    and self._guided_tokens is not None
+                    else -1
+                ),
                 adapter_idx=seq.req.adapter_idx,
                 min_p=getattr(s, "min_p", 0.0) if final else 0.0,
                 prior_tokens=(
@@ -1002,6 +1086,70 @@ class InferenceEngine:
             ))
             pf_entries.append((seq, seq.admit_gen, j, start, start + n))
             seq.pf_dispatched = start + n
+        return items, pf_entries
+
+    @thread_owned("engine")
+    def _apply_guided_pacing(self, can: np.ndarray) -> np.ndarray:
+        """Per-slot guided pipeline rule (docs/ENGINE_PIPELINE.md): a
+        guided slot joins a dispatch only when NO step of its own is in
+        flight, so its mask row derives from the EXACT host automaton
+        state (which has consumed every emitted token). The slot runs
+        host-paced — every other pipeline iteration — instead of
+        flushing the whole engine; unguided slots are unaffected."""
+        for slot in self._guided_slots:
+            if can[slot] and self._ps_pending[slot] > 0:
+                can[slot] = False
+                self.guided_paced_skips += 1
+        return can
+
+    def _guided_mask_rows(self, can: np.ndarray) -> Optional[np.ndarray]:
+        """[R] mask-table rows for the guided slots riding this dispatch
+        (None when none do). Dispatched guided slots are always
+        host-paced fresh, so _guided_row sees the exact state."""
+        if self._guided_tokens is None or not self._guided_slots:
+            return None
+        rows = None
+        for slot in self._guided_slots:
+            if can[slot]:
+                if rows is None:
+                    rows = np.full(
+                        (self.R,), self.executor.permissive_row, np.int32
+                    )
+                rows[slot] = self._guided_row(self._running[slot])
+        return rows
+
+    @thread_owned("engine")
+    def _dispatch_mixed(self, items_meta: List[tuple]) -> Optional[_InFlight]:
+        """Dispatch decode step N+1 fused with the due prefill chunks as
+        ONE device step (executor.mixed_start). With no due chunks this
+        is exactly _dispatch_decode — the fused shapes only compile when
+        a mixed batch actually exists."""
+        if not items_meta:
+            return self._dispatch_decode()
+        R = self.R
+        can = (
+            self._ps_active
+            & (self._ps_gen_count + self._ps_pending < self._ps_max_new)
+            & (
+                self._ps_tok_count + self._ps_pending
+                < self.cfg.max_seq_len
+            )
+        )
+        can = self._apply_guided_pacing(can)
+        if can.any():
+            self._ensure_decode_capacity(1, mask=can)
+            can &= self._ps_active  # the capacity pass may have preempted
+        batch = self._sampling_batch_view()
+        rows = self._guided_mask_rows(can)
+        if rows is not None:
+            batch.mask_rows = rows
+            self.guided_ingraph_steps += 1
+        prev = self._inflight
+        fresh_mask = self._fresh | ~can
+        assert prev is not None or bool(fresh_mask[can].all())
+        self._observe_host_gap()
+        t0 = time.monotonic()
+        items, pf_entries = self._build_pf_items(items_meta, t0)
         prev_tokens = prev.tokens[:R] if prev is not None else None
         tokens, logprobs = self.executor.mixed_start(
             items,
@@ -1442,18 +1590,19 @@ class InferenceEngine:
     def _mixed_eligible(self, seq: _Seq) -> bool:
         """Whether a freshly admitted seq can ride the fused mixed step.
         Media prompts (embedding injection + M-RoPE streams), streamed
-        encoder handoffs, guided requests (their final chunk samples
-        under a mask row, and a live guided slot forces split stepping
-        anyway), and SP-ring prompts keep the split prefill path.
+        encoder handoffs, and SP-ring prompts keep the split prefill
+        path. Guided requests DO ride the mixed batch (ISSUE 13): their
+        final chunk samples under a host-derived mask row applied
+        in-graph (_build_pf_items), and their decode steps run
+        host-paced inside the pipeline instead of forcing split.
         prefill_only requests (the PD prefill role, incl. kv_stream
-        sessions) stay split too: they never decode — there is nothing
+        sessions) stay split: they never decode — there is nothing
         to fuse with — and their per-chunk KV exports are timed to the
         synchronous prefill loop (docs/PD_DISAGGREGATION.md)."""
         req = seq.req
         return (
             not req.has_media
             and req.mm_stream is None
-            and not req.guided
             and not req.prefill_only
             and not self._sp_eligible(seq)
         )
@@ -2457,6 +2606,7 @@ class InferenceEngine:
                 < self.cfg.max_seq_len
             )
         )
+        can = self._apply_guided_pacing(can)
         if not can.any():
             return None
         self._ensure_decode_capacity(1, mask=can)
@@ -2464,6 +2614,10 @@ class InferenceEngine:
         if not can.any():
             return None
         batch = self._sampling_batch_view()
+        rows = self._guided_mask_rows(can)
+        if rows is not None:
+            batch.mask_rows = rows
+            self.guided_ingraph_steps += 1
         prev = self._inflight
         # Non-dispatched rows read the (defined) host value; dispatched
         # rows read the device feedback unless freshly (re)admitted.
@@ -2516,6 +2670,8 @@ class InferenceEngine:
         newer dispatch return to host feeding."""
         if flt is None:
             return 0
+        if flt.n_emit is not None:
+            return self._drain_spec(flt, newer)
         tokens = np.asarray(flt.tokens)
         logprobs = np.asarray(flt.logprobs)
         step_ms = (time.monotonic() - flt.t0) * 1000
@@ -2549,15 +2705,27 @@ class InferenceEngine:
             self._commit_full_blocks(seq)
             produced += 1
             self._emit(seq, finished=self._check_stop(seq))
-        # Prefill rows riding a mixed dispatch: advance `prefilled`, keep
-        # the PD chunk stream fed, and on the FINAL chunk run the shared
-        # post-prefill bookkeeping (_finish_prefill installs the slot —
-        # the seq starts decoding host-fed next dispatch). A seq whose
-        # entry no longer matches _pf_active was cancelled after
-        # dispatch: its chunk's sampled token is discarded like any
-        # late-stop token. admit_gen guards the same _Seq object being
-        # re-admitted between dispatch and drain, like the decode-slot
-        # check above.
+        produced += self._drain_pf_rows(flt, tokens, logprobs)
+        self._t_host_free = time.monotonic()
+        return produced
+
+    @thread_owned("engine")
+    def _drain_pf_rows(self, flt: _InFlight, tokens, logprobs) -> int:
+        """Prefill rows riding a fused dispatch: advance `prefilled`,
+        keep the PD chunk stream fed, and on the FINAL chunk run the
+        shared post-prefill bookkeeping (_finish_prefill installs the
+        slot — the seq starts decoding host-fed next dispatch). A seq
+        whose entry no longer matches _pf_active was cancelled after
+        dispatch: its chunk's sampled token is discarded like any
+        late-stop token. admit_gen guards the same _Seq object being
+        re-admitted between dispatch and drain, like the decode-slot
+        check. Plain mixed steps carry the pf samples at output rows
+        [R + j]; speculative verify steps carry them in pf_tok/pf_lp."""
+        pf_tok = (
+            np.asarray(flt.pf_tok) if flt.pf_tok is not None else None
+        )
+        pf_lp = np.asarray(flt.pf_lp) if flt.pf_lp is not None else None
+        produced = 0
         for seq, gen, j, c_start, c_end in flt.pf:
             if (
                 self._pf_active.get(seq.req.request_id) is not seq
@@ -2571,15 +2739,18 @@ class InferenceEngine:
                 produced += 1
                 continue
             del self._pf_active[seq.req.request_id]
-            tok = int(tokens[self.R + j])
-            lp = float(logprobs[self.R + j])
+            if pf_tok is not None:
+                tok = int(pf_tok[j])
+                lp = float(pf_lp[j])
+            else:
+                tok = int(tokens[self.R + j])
+                lp = float(logprobs[self.R + j])
             fin = time.monotonic()
             ms = (fin - seq.prefill_start_time) * 1000
             self._finish_prefill(
                 seq, tok, lp, fin, ms, len(seq.tokens) - seq.num_cached
             )
             produced += 1
-        self._t_host_free = time.monotonic()
         return produced
 
     # ------------------------------------------------------------ M-RoPE
@@ -2998,29 +3169,244 @@ class InferenceEngine:
         """Prompt-lookup drafting: match the newest suffix n-gram (longest
         first, down to 1) against the sequence's own prompt+generation
         history and propose the k tokens that followed the most recent
-        earlier occurrence. No draft model, no extra device work — repetitive
-        text (code, quotes, structured output) accepts several tokens per
-        step; random text degrades to plain decoding (the verify step
-        always emits >= 1 token). History beyond `speculative_lookback`
-        trailing tokens is not scanned (bounds host cost per step)."""
-        a = np.asarray(
-            seq.tokens[-self.cfg.speculative_lookback:], np.int32
-        )
-        n_max = min(self.cfg.speculative_ngram_max, len(a) - 1)
+        earlier occurrence. No draft model, no extra device work —
+        repetitive text (code, quotes, structured output) accepts several
+        tokens per step; random text degrades to plain decoding (the
+        verify step always emits >= 1 token).
+
+        O(ngram_max) per step (ISSUE 13 satellite): a per-seq rolling
+        index maps each n-gram to the position AFTER its latest
+        occurrence, extended incrementally as history grows — the old
+        implementation re-materialized the lookback window and ran a
+        sliding-window scan over every n-gram length on every step
+        (O(lookback x ngram_max)). Gram-ends are indexed only up to
+        len(tokens) - 2 (the newest gram has no follow token yet), so
+        the suffix can never match itself; a long RESUMED history
+        (preemption / PD import) back-fills in one pass bounded by
+        `speculative_lookback`. Stale follow positions from a replaced
+        token list (test stand-ins) fall through to shorter grams.
+        Memory stays bounded by the lookback too: past ~2x the window's
+        worth of entries the index rebuilds from the trailing window
+        (amortized O(ngram_max)/step — the rebuild happens once per
+        lookback's worth of emitted tokens)."""
+        toks = seq.tokens
+        m = len(toks)
+        n_cfg = self.cfg.speculative_ngram_max
+        lookback = self.cfg.speculative_lookback
+        try:
+            idx = seq.spec_ngrams
+            upto = seq.spec_idx_upto
+        except AttributeError:  # stand-in seq objects without the slots
+            idx = seq.spec_ngrams = {}
+            upto = 0
+        if len(idx) > 2 * n_cfg * lookback:
+            idx.clear()
+            upto = 0
+        start = max(upto, m - 1 - lookback)
+        for end in range(start, m - 1):
+            hi = end + 1
+            for n in range(1, min(n_cfg, hi) + 1):
+                idx[tuple(toks[hi - n: hi])] = hi
+        seq.spec_idx_upto = max(m - 1, upto)
+        n_max = min(n_cfg, m - 1)
         for n in range(n_max, 0, -1):
-            g = a[-n:]
-            w = np.lib.stride_tricks.sliding_window_view(a, n)
-            starts = np.nonzero((w == g).all(axis=1))[0]
-            starts = starts[starts < len(a) - n]  # exclude the suffix itself
-            if starts.size:
-                i = int(starts[-1])  # most recent prior occurrence
-                follow = a[i + n: i + n + k]
-                if follow.size:
+            f = idx.get(tuple(toks[m - n: m]))
+            if f is not None:
+                follow = toks[f: f + k]
+                if follow:
                     out = np.empty((k,), np.int32)
-                    out[: follow.size] = follow
-                    out[follow.size:] = follow[-1]
+                    out[: len(follow)] = follow
+                    out[len(follow):] = follow[-1]
                     return out
-        return np.full((k,), a[-1], np.int32)
+        return np.full((k,), toks[-1], np.int32)
+
+    @thread_owned("engine")
+    def _step_spec(self) -> int:
+        """One pipelined speculative iteration (docs/ENGINE_PIPELINE.md):
+        cut the due prefill chunks, dispatch verify step N+1 fused with
+        them (the composed path: verify rows are q_len = k+1 ragged rows
+        next to the chunks — docs/KERNELS.md), then drain/book step N
+        while N+1 runs. Step N+1's verify inputs — last accepted token,
+        position and step base — are gathered ON DEVICE from step N's
+        output, so the VARIABLE accepted count never round-trips the
+        host; the host proposes drafts from its one-step-late history,
+        which is sound because point-mass acceptance makes the emitted
+        stream draft-independent (ops/sampling.py)."""
+        items_meta: List[tuple] = []
+        produced0 = 0
+        fuse = self.mixed_step_enabled and getattr(
+            self.executor, "supports_spec_mixed", False
+        )
+        if fuse:
+            budget = self._continue_pf_chunks(
+                items_meta, self.cfg.max_prefill_tokens
+            )
+            legacy = self._admit(mixed_collect=items_meta, budget=budget)
+        else:
+            if self._pf_active:
+                # Mixed support flipped off mid-run: drain and hand the
+                # held seqs to the split midchunk flow.
+                produced0 = self._flush_pipeline_state()
+            legacy = self._admit()
+        nxt = self._dispatch_verify(items_meta)
+        produced = self._drain_step(self._inflight, nxt)
+        self._inflight = nxt
+        return produced0 + legacy + produced
+
+    @thread_owned("engine")
+    def _dispatch_verify(
+        self, items_meta: List[tuple]
+    ) -> Optional[_InFlight]:
+        """Dispatch speculative verify step N+1 without fetching results
+        (executor.verify_start), optionally fused with due prefill
+        chunks. Guided slots join host-paced (exact automaton state at
+        dispatch — their drafts AND mask rows derive from fully drained
+        history); length-stops surface one step late as discards, and
+        the capacity pass covers TWO steps of worst-case emission
+        because the in-flight step may advance a slot by up to S before
+        this dispatch's writes land."""
+        k = self.cfg.speculative_tokens
+        S = k + 1
+        R = self.R
+        can = self._apply_guided_pacing(self._ps_active.copy())
+        # Host-fed slots re-derive their dispatch state from token truth
+        # BEFORE the capacity pass reads positions: the sync verify path
+        # refreshes lazily at the start of its own next step, so a
+        # sync->pipeline hatch flip would otherwise dispatch from arrays
+        # that lag the last sync step's variable emissions.
+        for slot in np.nonzero(can & self._fresh)[0]:
+            seq = self._running.get(int(slot))
+            if seq is not None:
+                self._refresh_slot_arrays(int(slot), seq)
+        if can.any():
+            self._ensure_decode_capacity(2 * S, mask=can)
+            can &= self._ps_active  # the capacity pass may have preempted
+        if not can.any() and not items_meta:
+            return None
+        batch = self._sampling_batch_view()
+        prev = self._inflight
+        fresh_mask = self._fresh | ~can
+        assert prev is not None or bool(fresh_mask[can].all())
+        drafts = np.zeros((R, k), np.int32)
+        for slot in np.nonzero(can)[0]:
+            drafts[int(slot)] = self._propose_drafts(
+                self._running[int(slot)], k
+            )
+        if self._guided_tokens is not None and any(
+            can[s] for s in self._guided_slots
+        ):
+            rows = np.full(
+                (R, S), self.executor.permissive_row, np.int32
+            )
+            for slot in self._guided_slots:
+                if can[slot]:
+                    rows[slot] = self._guided_rows_spec(
+                        self._running[slot], drafts[slot], S
+                    )
+            batch.mask_rows = rows
+            self.guided_ingraph_steps += 1
+        self._observe_host_gap()
+        t0 = time.monotonic()
+        items, pf_entries = self._build_pf_items(items_meta, t0)
+        tokens, logprobs, n_emit, pf_tok, pf_lp = (
+            self.executor.verify_start(
+                items,
+                drafts,
+                self._ps_last_tok,
+                self._ps_positions,
+                self._ps_steps,
+                fresh_mask,
+                prev.tokens if prev is not None else None,
+                prev.n_emit if prev is not None else None,
+                self._block_tables,
+                can,
+                batch,
+                interpret=self._ragged_interpret,
+            )
+        )
+        nactive = int(can.sum())
+        total_ctx = int(self._ps_positions[can].sum()) + nactive
+        snapshot = {}
+        for slot in np.nonzero(can)[0]:
+            seq = self._running[int(slot)]
+            snapshot[int(slot)] = (seq, seq.admit_gen)
+        self._ps_pending[can] += 1
+        self._fresh[can] = False
+        self._m_batch.observe(nactive)
+        self._m_steps.inc()
+        self.decode_dispatches += 1
+        self.spec_steps += 1
+        self.spec_slot_steps += nactive
+        self.spec_pipeline_steps += 1
+        if items:
+            self.mixed_steps += 1
+            self._m_mixed_pf_rows.observe(len(items))
+            self._m_mixed_dec_rows.observe(nactive)
+            self._m_kernel_dispatch.labels(
+                kernel=self._kernel_names["mixed"]
+            ).inc()
+        else:
+            self._m_kernel_dispatch.labels(
+                kernel=self._kernel_names["mq"]
+            ).inc()
+        if prev is not None:
+            self.overlap_steps += 1
+        return _InFlight(
+            tokens, logprobs, snapshot, t0, nactive, total_ctx,
+            pf=pf_entries, n_emit=n_emit, pf_tok=pf_tok, pf_lp=pf_lp,
+        )
+
+    @thread_owned("engine")
+    def _drain_spec(
+        self, flt: _InFlight, newer: Optional[_InFlight]
+    ) -> int:
+        """Consume one pipelined verify step's results — the speculative
+        twin of _drain_step's decode booking: each surviving slot emits
+        its accepted prefix + the corrected/bonus token (1..S tokens,
+        exactly _decode_spec_once's host loop), one step late. A slot
+        that stopped/cancelled/was preempted after dispatch discards
+        the WHOLE row (the one-step-late stop contract, scaled to
+        variable emission); surviving slots re-derive their host
+        dispatch state from token truth — incremental +1 advances
+        cannot track variable accepted counts."""
+        tokens = np.asarray(flt.tokens)
+        logprobs = np.asarray(flt.logprobs)
+        n_emit = np.asarray(flt.n_emit)
+        step_ms = (time.monotonic() - flt.t0) * 1000
+        self._profile_tpot.append((flt.nactive, flt.total_ctx, step_ms))
+        produced = 0
+        now = time.monotonic()
+        for slot, (seq, gen) in flt.slots.items():
+            if self._running.get(slot) is not seq or seq.admit_gen != gen:
+                self.late_stop_discards += 1
+                continue
+            self._ps_pending[slot] -= 1
+            ne = int(n_emit[slot])
+            self._m_spec_accepted.observe(ne)
+            self.spec_tokens_emitted += ne
+            if ne:
+                tbt_ms = (now - seq.last_token_time) * 1000
+                self._tbt_window.append((now, tbt_ms))
+                self._m_tbt.observe(tbt_ms)
+                seq.last_token_time = now
+            alive = True
+            for i in range(ne):
+                tok, lp = int(tokens[slot, i]), float(logprobs[slot, i])
+                seq.generated.append((tok, lp))
+                seq.tokens.append(tok)
+                self._commit_full_blocks(seq)
+                produced += 1
+                if not self._emit(seq, finished=self._check_stop(seq)):
+                    alive = False  # finished/cancelled: drop the rest
+                    break
+            if alive and self._running.get(slot) is seq:
+                self._refresh_slot_arrays(slot, seq)
+                ent = newer.slots.get(slot) if newer is not None else None
+                if ent is None or ent[0] is not seq or ent[1] != gen:
+                    self._fresh[slot] = True
+        produced += self._drain_pf_rows(flt, tokens, logprobs)
+        self._t_host_free = time.monotonic()
+        return produced
 
     @thread_owned("engine")
     def _decode_spec_once(self) -> int:
@@ -3085,6 +3471,7 @@ class InferenceEngine:
         self._m_steps.inc()
         self.decode_dispatches += 1
         self.spec_steps += 1
+        self.spec_sync_steps += 1
         self.spec_slot_steps += nactive
         self.spec_tokens_emitted += int(n_emit[active].sum())
 

@@ -1484,6 +1484,9 @@ class ModelExecutor:
         pf_bias_ids=None,
         pf_bias_vals=None,
         pf_min_p=None,
+        mask_rows=None,  # [R] rows into guided_table (decode slots)
+        pf_mask_rows=None,  # [P] rows into guided_table (prefill rows)
+        guided_table=None,  # [M+1+D, V] bool
         use_ragged=None,
         interpret=False,
     ):
@@ -1520,6 +1523,9 @@ class ModelExecutor:
             dec_logits, temperature, top_k, top_p, step_keys,
             counts=counts, presence=presence, frequency=frequency,
             bias_ids=bias_ids, bias_vals=bias_vals, min_p=min_p,
+            allowed=(
+                guided_table[mask_rows] if mask_rows is not None else None
+            ),
         )
         counts = counts.at[
             jnp.arange(tokens.shape[0]), tokens
@@ -1528,6 +1534,10 @@ class ModelExecutor:
             pf_logits, pf_temperature, pf_top_k, pf_top_p, pf_keys,
             counts=pf_counts, presence=pf_presence, frequency=pf_frequency,
             bias_ids=pf_bias_ids, bias_vals=pf_bias_vals, min_p=pf_min_p,
+            allowed=(
+                guided_table[pf_mask_rows]
+                if pf_mask_rows is not None else None
+            ),
         )
         return (
             k_cache,
@@ -1554,8 +1564,10 @@ class ModelExecutor:
         returns (tokens, logprobs) device arrays of width R + Ppad —
         decode slots at [:R] (the overlap pipeline's device-resident
         feedback slice), prefill row j at R + j. The engine's ragged step
-        builder is the only caller (docs/KERNELS.md); media/M-RoPE/guided
-        items never reach here (routed to the split prefill path)."""
+        builder is the only caller (docs/KERNELS.md); media/M-RoPE items
+        never reach here (routed to the split prefill path). Guided
+        items DO ride (ISSUE 13): final chunks carry mask_row and the
+        decode half takes batch.mask_rows — both applied in-graph."""
         R = self.R
         n_pf = len(items)
         P = self._pow2_bucket(max(n_pf, 1), self.PREFILL_GROUP_MAX)
@@ -1588,32 +1600,9 @@ class ModelExecutor:
         presence = batch.presence if batch.presence is not None else zeros
         frequency = batch.frequency if batch.frequency is not None else zeros
 
-        pf_tokens = np.zeros((P, Lpad), np.int32)
-        pf_start = np.zeros((P,), np.int32)
-        pf_len = np.zeros((P,), np.int32)
-        pf_tables = np.zeros((P, CBp), np.int32)
-        pf_temps = np.zeros((P,), np.float32)
-        pf_top_k = np.zeros((P,), np.int32)
-        pf_top_p = np.ones((P,), np.float32)
-        pf_seeds = np.zeros((P,), np.uint32)
-        pf_steps = np.zeros((P,), np.int32)
-        for i, it in enumerate(items):
-            n = len(it.token_ids)
-            pf_tokens[i, :n] = it.token_ids
-            pf_start[i] = it.start_pos
-            pf_len[i] = n
-            m = min(CBp, len(it.block_table))
-            pf_tables[i, :m] = np.asarray(it.block_table[:m], np.int32)
-            pf_temps[i] = it.temperature
-            pf_top_k[i] = it.top_k
-            pf_top_p[i] = it.top_p
-            pf_seeds[i] = it.seed & 0xFFFFFFFF
-            pf_steps[i] = it.step
-        pf_keys = sampling_ops.make_step_keys(
-            jnp.asarray(pf_seeds), jnp.asarray(pf_steps, jnp.int32)
-        )
+        pf_args, pf_opt = self._pf_half(items, P, Lpad, CBp)
 
-        opt = {}
+        opt = dict(pf_opt)
         if batch.bias_ids is not None:
             opt.update(
                 bias_ids=jnp.asarray(batch.bias_ids, jnp.int32),
@@ -1623,6 +1612,15 @@ class ModelExecutor:
             opt.update(min_p=jnp.asarray(batch.min_p, jnp.float32))
         if batch.rope_delta is not None:
             opt.update(rope_delta=jnp.asarray(batch.rope_delta, jnp.int32))
+        # Guided decoding rides per half like the split programs: the
+        # decode half takes the engine's per-slot rows (sync _decode_once
+        # contract), the prefill half the per-item final-chunk rows
+        # (_prefill_group contract). One table serves both.
+        if batch.mask_rows is not None:
+            opt.update(
+                mask_rows=jnp.asarray(batch.mask_rows, jnp.int32),
+                guided_table=self._flushed_guided_table(),
+            )
         # LoRA rides per half, gated exactly like the split programs
         # (decode_start keys on batch.adapter_idx, _prefill_group on any
         # item adapter) — an adapter on one half must not flip the other
@@ -1630,47 +1628,6 @@ class ModelExecutor:
         if batch.adapter_idx is not None:
             opt.update(
                 lora_dec=jnp.asarray(batch.adapter_idx, jnp.int32)
-            )
-        if any(it.adapter_idx for it in items):
-            opt.update(
-                lora_pf=jnp.asarray(
-                    [it.adapter_idx for it in items] + [0] * (P - n_pf),
-                    jnp.int32,
-                )
-            )
-        b_ids, b_vals = sampling_ops.pack_logit_bias(
-            [it.logit_bias for it in items] + [()] * (P - n_pf), P
-        )
-        if b_ids is not None:
-            opt.update(
-                pf_bias_ids=jnp.asarray(b_ids),
-                pf_bias_vals=jnp.asarray(b_vals),
-            )
-        if any(it.min_p for it in items):
-            opt.update(
-                pf_min_p=jnp.asarray(
-                    [it.min_p for it in items] + [0.0] * (P - n_pf),
-                    jnp.float32,
-                )
-            )
-        if any(
-            it.prior_tokens is not None and len(it.prior_tokens)
-            for it in items
-        ):
-            cnts = np.zeros((P, self.cfg.vocab_size), np.int32)
-            pres = np.zeros((P,), np.float32)
-            freq = np.zeros((P,), np.float32)
-            for i, it in enumerate(items):
-                pres[i] = it.presence
-                freq[i] = it.frequency
-                if it.prior_tokens is not None and len(it.prior_tokens):
-                    np.add.at(
-                        cnts[i], np.asarray(it.prior_tokens, np.int64), 1
-                    )
-            opt.update(
-                pf_counts=jnp.asarray(cnts),
-                pf_presence=jnp.asarray(pres),
-                pf_frequency=jnp.asarray(freq),
             )
 
         fresh = jnp.asarray(fresh_tokens, jnp.int32)
@@ -1709,6 +1666,100 @@ class ModelExecutor:
             keys,
             jnp.asarray(presence, jnp.float32),
             jnp.asarray(frequency, jnp.float32),
+            *pf_args,
+            use_ragged=use_ragged,
+            interpret=interpret,
+            **opt,
+        )
+        return tokens, logprobs
+
+    def _pf_half(self, items: List["PrefillItem"], P: int, Lpad: int,
+                 CBp: int):
+        """Pack the prefill half of a fused dispatch: the positional
+        arrays (tokens, start, len, tables, temps, top_k, top_p, keys —
+        as jnp arrays, in _mixed_impl/_mixed_verify_impl argument order)
+        plus the optional pf_* sampling features, gated per item exactly
+        like _prefill_group. Shared by mixed_start and verify_start."""
+        n_pf = len(items)
+        pf_tokens = np.zeros((P, Lpad), np.int32)
+        pf_start = np.zeros((P,), np.int32)
+        pf_len = np.zeros((P,), np.int32)
+        pf_tables = np.zeros((P, CBp), np.int32)
+        pf_temps = np.zeros((P,), np.float32)
+        pf_top_k = np.zeros((P,), np.int32)
+        pf_top_p = np.ones((P,), np.float32)
+        pf_seeds = np.zeros((P,), np.uint32)
+        pf_steps = np.zeros((P,), np.int32)
+        for i, it in enumerate(items):
+            n = len(it.token_ids)
+            pf_tokens[i, :n] = it.token_ids
+            pf_start[i] = it.start_pos
+            pf_len[i] = n
+            m = min(CBp, len(it.block_table))
+            pf_tables[i, :m] = np.asarray(it.block_table[:m], np.int32)
+            pf_temps[i] = it.temperature
+            pf_top_k[i] = it.top_k
+            pf_top_p[i] = it.top_p
+            pf_seeds[i] = it.seed & 0xFFFFFFFF
+            pf_steps[i] = it.step
+        pf_keys = sampling_ops.make_step_keys(
+            jnp.asarray(pf_seeds), jnp.asarray(pf_steps, jnp.int32)
+        )
+        opt = {}
+        if any(it.adapter_idx for it in items):
+            opt.update(
+                lora_pf=jnp.asarray(
+                    [it.adapter_idx for it in items] + [0] * (P - n_pf),
+                    jnp.int32,
+                )
+            )
+        b_ids, b_vals = sampling_ops.pack_logit_bias(
+            [it.logit_bias for it in items] + [()] * (P - n_pf), P
+        )
+        if b_ids is not None:
+            opt.update(
+                pf_bias_ids=jnp.asarray(b_ids),
+                pf_bias_vals=jnp.asarray(b_vals),
+            )
+        if any(it.min_p for it in items):
+            opt.update(
+                pf_min_p=jnp.asarray(
+                    [it.min_p for it in items] + [0.0] * (P - n_pf),
+                    jnp.float32,
+                )
+            )
+        if any(it.mask_row >= 0 for it in items):
+            # Guided final chunks: the admission-sampled token applies
+            # the host-derived mask row in-graph (mirrors
+            # _prefill_group's mask_rows path).
+            rows = np.full((P,), self.permissive_row, np.int32)
+            for i, it in enumerate(items):
+                if it.mask_row >= 0:
+                    rows[i] = it.mask_row
+            opt.update(
+                pf_mask_rows=jnp.asarray(rows),
+                guided_table=self._flushed_guided_table(),
+            )
+        if any(
+            it.prior_tokens is not None and len(it.prior_tokens)
+            for it in items
+        ):
+            cnts = np.zeros((P, self.cfg.vocab_size), np.int32)
+            pres = np.zeros((P,), np.float32)
+            freq = np.zeros((P,), np.float32)
+            for i, it in enumerate(items):
+                pres[i] = it.presence
+                freq[i] = it.frequency
+                if it.prior_tokens is not None and len(it.prior_tokens):
+                    np.add.at(
+                        cnts[i], np.asarray(it.prior_tokens, np.int64), 1
+                    )
+            opt.update(
+                pf_counts=jnp.asarray(cnts),
+                pf_presence=jnp.asarray(pres),
+                pf_frequency=jnp.asarray(freq),
+            )
+        return (
             jnp.asarray(pf_tokens),
             jnp.asarray(pf_start),
             jnp.asarray(pf_len),
@@ -1717,11 +1768,365 @@ class ModelExecutor:
             jnp.asarray(pf_top_k),
             jnp.asarray(pf_top_p),
             pf_keys,
-            use_ragged=use_ragged,
-            interpret=interpret,
-            **opt,
+        ), opt
+
+    # ------------------------------------------- pipelined verify (spec)
+
+    @property
+    def supports_spec_mixed(self) -> bool:
+        """Whether this model family can fuse speculative verify rows
+        with prefill chunks in one dispatch (mixed_verify_step). MLA
+        families run the pipelined verify WITHOUT prefill fusion until
+        the ragged kernel grows a latent-row mode (docs/KERNELS.md)."""
+        return hasattr(self.model_mod, "mixed_verify_step")
+
+    def _spec_state_merge(
+        self, drafts, host_last, host_pos, host_steps, fresh_mask,
+        prev_tokens, prev_n_emit, seeds, active,
+    ):
+        """In-graph verify-input gather for the pipelined speculative
+        step: a slot covered by the in-flight verify step feeds from ITS
+        device-resident output — last accepted token
+        prev_tokens[r, n_emit-1], position/step base advanced by the
+        VARIABLE accepted count — while fresh slots (admission, resume,
+        pacing, post-flush) feed from host truth. true_len clamps to the
+        remaining context in-graph: a row whose device position already
+        reached max_seq_len goes inactive (its sequence length-stopped
+        at the drain one step behind; the row's output is a late-stop
+        discard), so no write ever lands past max_seq_len - 1. Keys use
+        the SAME sequential per-step schedule as sync verify — computed
+        in-graph because the step base is device-resident."""
+        R, k = drafts.shape
+        S = k + 1
+        ne = jnp.clip(prev_n_emit - 1, 0, S - 1)
+        carried_last = jnp.take_along_axis(
+            prev_tokens, ne[:, None], axis=1
+        )[:, 0]
+        last = jnp.where(fresh_mask, host_last, carried_last)
+        pos = jnp.where(fresh_mask, host_pos, host_pos + prev_n_emit)
+        steps = jnp.where(fresh_mask, host_steps, host_steps + prev_n_emit)
+        tl = jnp.clip(self.engine_cfg.max_seq_len - pos, 0, S)
+        act = active & (tl > 0)
+        tl = jnp.where(act, tl, 0)
+        token_ids = jnp.concatenate(
+            [last[:, None], drafts.astype(jnp.int32)], axis=1
         )
-        return tokens, logprobs
+        keys = jnp.stack(
+            [
+                sampling_ops.make_step_keys(seeds, steps + j)
+                for j in range(S)
+            ],
+            axis=1,
+        )  # [R, S, 2]
+        return token_ids, pos, tl, keys, act
+
+    def _verify_pipe_impl(
+        self,
+        k_cache,
+        v_cache,
+        counts,  # [R, V] int32 (donated)
+        params,
+        drafts,  # [R, k] int32 — host-proposed (may lag one step:
+        #          point-mass acceptance makes the stream draft-blind)
+        host_last,  # [R] int32 — last token, host truth post-drain
+        host_pos,  # [R] int32 — position base, host truth post-drain
+        host_steps,  # [R] int32 — generated count, host truth post-drain
+        fresh_mask,  # [R] bool — True: feed from host truth
+        prev_tokens,  # [R, S] device — in-flight verify output tokens
+        prev_n_emit,  # [R] device — in-flight accepted counts
+        seeds,  # [R] uint32
+        block_tables,  # [R, CB]
+        active,  # [R] bool
+        temperature,
+        top_k,
+        top_p,
+        presence,
+        frequency,
+        bias_ids=None,
+        bias_vals=None,
+        mask_rows=None,  # [R, S] rows into guided_table
+        guided_table=None,
+        lora_idx=None,
+        min_p=None,
+        rope_delta=None,
+    ):
+        """Pipelined speculative verify WITHOUT prefill fusion: the
+        _verify_impl program fed by the in-graph state merge instead of
+        host-resolved inputs (docs/ENGINE_PIPELINE.md)."""
+        token_ids, pos, tl, keys, act = self._spec_state_merge(
+            drafts, host_last, host_pos, host_steps, fresh_mask,
+            prev_tokens, prev_n_emit, seeds, active,
+        )
+        step_kwargs = (
+            {"lora_idx": lora_idx} if lora_idx is not None else {}
+        )
+        if rope_delta is not None:
+            S_ = token_ids.shape[1]
+            base = (pos + rope_delta)[:, None] + jnp.arange(
+                S_, dtype=jnp.int32
+            )[None]
+            step_kwargs["rope_positions"] = jnp.broadcast_to(
+                base[:, None, :], (base.shape[0], 3, S_)
+            )
+        logits, k_cache, v_cache = self.model_mod.prefill_batch_step(
+            params, self.cfg, k_cache, v_cache, token_ids, pos,
+            tl, block_tables, all_logits=True, **step_kwargs,
+        )
+        tokens, logprobs, n_emit, counts = sampling_ops.speculative_sample(
+            logits, token_ids[:, 1:], temperature, top_k, top_p, keys,
+            limits=tl, active=act,
+            counts=counts, presence=presence, frequency=frequency,
+            bias_ids=bias_ids, bias_vals=bias_vals,
+            allowed=(
+                guided_table[mask_rows] if mask_rows is not None else None
+            ),
+            min_p=min_p,
+        )
+        return k_cache, v_cache, counts, tokens, logprobs, n_emit
+
+    def _mixed_verify_impl(
+        self,
+        k_cache,
+        v_cache,
+        counts,
+        params,
+        # --- verify half: identical contract to _verify_pipe_impl ---
+        drafts,
+        host_last,
+        host_pos,
+        host_steps,
+        fresh_mask,
+        prev_tokens,
+        prev_n_emit,
+        seeds,
+        ver_tables,  # [R, CBv]
+        active,
+        temperature,
+        top_k,
+        top_p,
+        presence,
+        frequency,
+        # --- prefill half: identical contract to _mixed_impl ---
+        pf_tokens,
+        pf_start,
+        pf_len,
+        pf_tables,
+        pf_temperature,
+        pf_top_k,
+        pf_top_p,
+        pf_keys,
+        bias_ids=None,
+        bias_vals=None,
+        mask_rows=None,  # [R, S] (verify rows)
+        guided_table=None,
+        lora_idx=None,
+        min_p=None,
+        rope_delta=None,
+        lora_pf=None,
+        pf_counts=None,
+        pf_presence=None,
+        pf_frequency=None,
+        pf_bias_ids=None,
+        pf_bias_vals=None,
+        pf_min_p=None,
+        pf_mask_rows=None,  # [P] (prefill rows)
+        use_ragged=None,
+        interpret=False,
+    ):
+        """One fused speculative engine step: the pipelined verify rows
+        AND the due prefill chunks in a single compiled dispatch
+        (models.<family>.mixed_verify_step). Sampling per half runs the
+        same ops on the same key schedules as the split programs, so the
+        composed streams stay byte-identical to sync+split
+        (tests/test_spec_pipeline.py pins it). Output layout: verify
+        tokens [R, S] + accepted counts, then the P prefill tokens."""
+        token_ids, pos, tl, keys, act = self._spec_state_merge(
+            drafts, host_last, host_pos, host_steps, fresh_mask,
+            prev_tokens, prev_n_emit, seeds, active,
+        )
+        ver_rope = None
+        if rope_delta is not None:
+            ver_rope = rope_delta
+        ver_logits, pf_logits, k_cache, v_cache = (
+            self.model_mod.mixed_verify_step(
+                params,
+                self.cfg,
+                k_cache,
+                v_cache,
+                token_ids,
+                pos,
+                tl,
+                ver_tables,
+                pf_tokens,
+                pf_start,
+                pf_len,
+                pf_tables,
+                use_ragged=use_ragged,
+                lora_ver=lora_idx,
+                lora_pf=lora_pf,
+                ver_rope_delta=ver_rope,
+                interpret=interpret,
+            )
+        )
+        tokens, logprobs, n_emit, counts = sampling_ops.speculative_sample(
+            ver_logits, token_ids[:, 1:], temperature, top_k, top_p, keys,
+            limits=tl, active=act,
+            counts=counts, presence=presence, frequency=frequency,
+            bias_ids=bias_ids, bias_vals=bias_vals,
+            allowed=(
+                guided_table[mask_rows] if mask_rows is not None else None
+            ),
+            min_p=min_p,
+        )
+        pf_tok, pf_lp, _ = sampling_ops.sample_tokens(
+            pf_logits, pf_temperature, pf_top_k, pf_top_p, pf_keys,
+            counts=pf_counts, presence=pf_presence, frequency=pf_frequency,
+            bias_ids=pf_bias_ids, bias_vals=pf_bias_vals, min_p=pf_min_p,
+            allowed=(
+                guided_table[pf_mask_rows]
+                if pf_mask_rows is not None else None
+            ),
+        )
+        return (
+            k_cache, v_cache, counts, tokens, logprobs, n_emit,
+            pf_tok, pf_lp,
+        )
+
+    def verify_start(
+        self,
+        items: List["PrefillItem"],  # due prefill chunks ([] = none)
+        drafts: np.ndarray,  # [R, k] int32 host-proposed draft tokens
+        host_last: np.ndarray,  # [R] int32
+        host_pos: np.ndarray,  # [R] int32
+        host_steps: np.ndarray,  # [R] int32
+        fresh_mask: np.ndarray,  # [R] bool
+        prev_tokens,  # device [R, S] from the in-flight verify, or None
+        prev_n_emit,  # device [R] accepted counts, or None
+        block_tables: np.ndarray,  # [R, max_blocks_per_seq]
+        active: np.ndarray,  # [R] bool
+        batch: SamplingBatch,
+        interpret: bool = False,
+    ):
+        """Dispatch ONE pipelined speculative verify step — optionally
+        fused with due prefill chunks — without fetching results.
+        Returns (tokens [R, S], logprobs [R, S], n_emit [R], pf_tokens
+        [P] | None, pf_logprobs [P] | None) as DEVICE arrays still in
+        flight; the engine drains one step behind and feeds the next
+        dispatch from these arrays (docs/ENGINE_PIPELINE.md). The
+        context-bucket bound covers host positions + TWO steps of
+        worst-case emission (the in-flight step's and this one's)."""
+        R = self.R
+        S = drafts.shape[1] + 1
+        bs = self.block_size
+        max_len = self.engine_cfg.max_seq_len
+        need = 1
+        if active.any():
+            worst = (
+                int(np.asarray(host_pos)[np.asarray(active)].max())
+                + 2 * S - 1
+            )
+            need = min(worst, max_len - 1) // bs + 1
+        CB = self._pow2_bucket(max(need, 1), self.max_blocks_per_seq)
+        zeros = np.zeros((R,), np.float32)
+        presence = batch.presence if batch.presence is not None else zeros
+        frequency = batch.frequency if batch.frequency is not None else zeros
+        bias_kwargs = {}
+        if batch.bias_ids is not None:
+            bias_kwargs = dict(
+                bias_ids=jnp.asarray(batch.bias_ids, jnp.int32),
+                bias_vals=jnp.asarray(batch.bias_vals, jnp.float32),
+            )
+        if batch.mask_rows is not None:
+            bias_kwargs.update(
+                mask_rows=jnp.asarray(batch.mask_rows, jnp.int32),
+                guided_table=self._flushed_guided_table(),
+            )
+        if batch.adapter_idx is not None:
+            bias_kwargs.update(
+                lora_idx=jnp.asarray(batch.adapter_idx, jnp.int32)
+            )
+        if batch.min_p is not None:
+            bias_kwargs.update(min_p=jnp.asarray(batch.min_p, jnp.float32))
+        if batch.rope_delta is not None:
+            bias_kwargs.update(
+                rope_delta=jnp.asarray(batch.rope_delta, jnp.int32)
+            )
+        if prev_tokens is None:
+            # Committed device zeros with the SAME replicated sharding a
+            # real verify output carries — a host numpy array here keys
+            # a second pjit lowering per context bucket (unspecified- vs
+            # named-sharding args), recompiling the whole verify program
+            # on the first post-idle dispatch.
+            cached = getattr(self, "_null_prev", None)
+            if cached is None or cached[0] != S:
+                # jax.sharding spelled out: `P` is shadowed by the local
+                # prefill-group bucket below.
+                rep = NamedSharding(self.mesh, jax.sharding.PartitionSpec())
+                self._null_prev = (
+                    S,
+                    jax.device_put(np.zeros((R, S), np.int32), rep),
+                    jax.device_put(np.zeros((R,), np.int32), rep),
+                )
+                cached = self._null_prev
+            prev_tokens, prev_n_emit = cached[1], cached[2]
+        common = (
+            self.k_cache,
+            self.v_cache,
+            self.token_counts,
+            self.params,
+            jnp.asarray(drafts, jnp.int32),
+            jnp.asarray(host_last, jnp.int32),
+            jnp.asarray(host_pos, jnp.int32),
+            jnp.asarray(host_steps, jnp.int32),
+            jnp.asarray(fresh_mask),
+            jnp.asarray(prev_tokens, jnp.int32),
+            jnp.asarray(prev_n_emit, jnp.int32),
+            jnp.asarray(batch.seeds, jnp.uint32),
+            jnp.asarray(block_tables[:, :CB], jnp.int32),
+            jnp.asarray(active),
+            jnp.asarray(batch.temperature, jnp.float32),
+            jnp.asarray(batch.top_k, jnp.int32),
+            jnp.asarray(batch.top_p, jnp.float32),
+            jnp.asarray(presence, jnp.float32),
+            jnp.asarray(frequency, jnp.float32),
+        )
+        if not items:
+            if not hasattr(self, "_verify_pipe_jit"):
+                self._verify_pipe_jit = jax.jit(
+                    self._verify_pipe_impl, donate_argnums=(0, 1, 2)
+                )
+            (
+                self.k_cache, self.v_cache, self.token_counts,
+                tokens, logprobs, n_emit,
+            ) = self._verify_pipe_jit(*common, **bias_kwargs)
+            return tokens, logprobs, n_emit, None, None
+        n_pf = len(items)
+        P = self._pow2_bucket(max(n_pf, 1), self.PREFILL_GROUP_MAX)
+        Lpad = self.bucket_len(
+            max((len(it.token_ids) for it in items), default=1)
+        )
+        need_p = max(
+            ((it.start_pos + len(it.token_ids) + bs - 1) // bs
+             for it in items),
+            default=1,
+        )
+        CBp = self._pow2_bucket(max(need_p, 1), self.max_blocks_per_seq)
+        pf_args, pf_opt = self._pf_half(items, P, Lpad, CBp)
+        opt = dict(pf_opt)
+        opt.update(bias_kwargs)
+        if not hasattr(self, "_mixed_verify_jit"):
+            self._mixed_verify_jit = jax.jit(
+                self._mixed_verify_impl,
+                donate_argnums=(0, 1, 2),
+                static_argnames=("use_ragged", "interpret"),
+            )
+        (
+            self.k_cache, self.v_cache, self.token_counts,
+            tokens, logprobs, n_emit, pf_tok, pf_lp,
+        ) = self._mixed_verify_jit(
+            *common, *pf_args, interpret=interpret, **opt,
+        )
+        return tokens, logprobs, n_emit, pf_tok, pf_lp
 
     def seed_slot_counts(self, slot: int, generated: "List[int]") -> None:
         """(Re)build one slot's generated-token histogram — on admission
