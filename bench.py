@@ -251,6 +251,56 @@ def _cpu_regression_guard(line: str) -> "tuple[str, int]":
     return json.dumps(res), rc
 
 
+# Sharded-decode roofline guard (--mesh, ROADMAP item 3): on TPU a
+# tp-sharded decode must land at least this fraction of its analytic
+# per-shard roofline expectation — a GSPMD-replicated kernel or a silent
+# gather fallback is ~tp× off, which this catches loudly (exit 3)
+# instead of letting a degraded multi-chip round into the record.
+_MESH_MIN_ROOFLINE_RATIO = float(
+    os.environ.get("XLLM_BENCH_MESH_MIN_RATIO", 0.5)
+)
+
+
+def _mesh_guard(line: str) -> "tuple[str, int]":
+    """Exit-3 guard for --mesh rows. Abstains LOUDLY off-TPU (the same
+    pattern as engine_spec_guard): a CPU virtual mesh proves parity in
+    tier-1, not performance — the floor arms only where the roofline
+    means something."""
+    try:
+        res = json.loads(line)
+    except ValueError:
+        return line, 0
+    m = res.get("mesh") or {}
+    if not isinstance(m, dict) or m.get("dp", 1) * m.get("tp", 1) * m.get(
+        "ep", 1
+    ) <= 1:
+        return line, 0
+    if res.get("backend") != "tpu":
+        res["engine_mesh_guard"] = (
+            "abstained: virtual CPU mesh — shard parity is tier-1's "
+            "differential suite (tests/test_sharded_engine.py); the "
+            "per-shard roofline floor arms on TPU"
+        )
+        return json.dumps(res), 0
+    try:
+        value = float(res.get("value") or 0.0)
+        expect = float(res["decode_roofline"]["expected_tok_s"])
+    except (KeyError, TypeError, ValueError):
+        return line, 0
+    if expect <= 0:
+        return line, 0
+    if value >= _MESH_MIN_ROOFLINE_RATIO * expect:
+        res["engine_mesh_guard"] = "ok"
+        return json.dumps(res), 0
+    res["engine_mesh_guard"] = (
+        f"FAIL: sharded decode {value:.1f} tok/s is below "
+        f"{100 * _MESH_MIN_ROOFLINE_RATIO:.0f}% of the per-shard "
+        f"roofline expectation {expect:.1f} — GSPMD-replicated kernel "
+        f"or gather fallback? (see kernel_shards / attention_kernel)"
+    )
+    return json.dumps(res), 3
+
+
 def main() -> None:
     if "--attempt-json" in sys.argv:
         # child mode: run exactly one config in THIS process
@@ -259,9 +309,29 @@ def main() -> None:
         if not on_tpu:
             from __graft_entry__ import _force_cpu_platform
 
-            _force_cpu_platform(1)
+            # CPU mesh runs need that many VIRTUAL host devices — the
+            # same --xla_force_host_platform_device_count trick the
+            # tier-1 differential suite runs on (docs/SHARDING.md).
+            dp, tp, ep = cfg.get("mesh", (1, 1, 1))
+            _force_cpu_platform(max(1, dp * tp * ep))
         _run(on_tpu, **cfg)
         return
+
+    # --mesh dp,tp,ep: bench a SHARDED engine (ROADMAP item 3). On TPU
+    # this is the real multi-chip GSPMD tier (tp-sharded 70B-class
+    # decode, per-shard Pallas dispatch); on CPU it runs the same code
+    # on the virtual host mesh so MULTICHIP/BENCH rounds get comparable
+    # shard-aware rows before a chip window opens. Default 1,1,1.
+    mesh = (1, 1, 1)
+    if "--mesh" in sys.argv:
+        raw = sys.argv[sys.argv.index("--mesh") + 1]
+        try:
+            parts = [int(x) for x in raw.split(",")]
+        except ValueError:
+            parts = []
+        if len(parts) != 3 or any(p < 1 for p in parts):
+            raise SystemExit(f"--mesh must be dp,tp,ep integers, got {raw!r}")
+        mesh = tuple(parts)
 
     # --engine-mode {sync,overlap,both}: which InferenceEngine stepping
     # mode(s) the engine-level A/B section measures (docs/ENGINE_PIPELINE.md).
@@ -320,7 +390,7 @@ def main() -> None:
         rc, out, err = _run_attempt_subprocess(
             dict(attempt, engine_mode=engine_mode,
                  attention_mode=attention_mode, spec_mode=spec_mode,
-                 _on_tpu=on_tpu)
+                 mesh=list(mesh), _on_tpu=on_tpu)
         )
         line = ""
         for ln in out.splitlines():
@@ -328,6 +398,8 @@ def main() -> None:
                 line = ln
         if rc == 0 and line:
             line, guard_rc = _cpu_regression_guard(line)
+            line, mesh_rc = _mesh_guard(line)
+            guard_rc = guard_rc or mesh_rc
             print(line)
             if guard_rc:
                 print(
@@ -495,16 +567,29 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
          weight_dtype: str = "auto",
          engine_mode: str = "both",
          attention_mode: str = "both",
-         spec_mode: str = "both") -> None:
+         spec_mode: str = "both",
+         mesh=(1, 1, 1)) -> None:
     import jax
 
     from xllm_service_tpu.common.config import EngineConfig
     from xllm_service_tpu.ops.sampling import SamplingParams
     from xllm_service_tpu.runtime.executor import ModelExecutor, SamplingBatch
 
+    dp, tp, ep = (int(x) for x in mesh)
+    n_dev = dp * tp * ep
     # llama3-3b: largest llama member fitting v5e HBM (6.4 GB bf16 params);
     # head_dim 128 engages the Pallas decode kernel (1b's 64 cannot).
     model = "llama3-3b" if on_tpu else "llama3-tiny"
+    if n_dev > 1:
+        # Sharded rounds (--mesh): the 70B-class serving layout the
+        # BASELINE round-3 dress rehearsal proved fits v5e at tp=8 with
+        # int8 W8+KV8; the CPU virtual mesh runs the tp-shardable tiny
+        # geometry (Hkv=8 divides every tp; llama3-tiny's Hkv=2 caps at
+        # tp=2) so shard-aware rows exist before a chip window opens.
+        model = os.environ.get(
+            "XLLM_BENCH_MESH_MODEL",
+            "llama3-70b" if on_tpu else "llama3-shard-tiny",
+        )
     R = 64 if on_tpu else 8
     prompt_len = 512 if on_tpu else 32
     decode_steps = 128 if on_tpu else 8
@@ -521,6 +606,7 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
         # kernel + e2e parity in tests/test_kv_quant.py).
         kv_cache_dtype=kv_cache_dtype,
         weight_dtype=weight_dtype,
+        dp_size=dp, tp_size=tp, ep_size=ep,
         # Persistent jit cache: re-runs (and later rounds) skip the
         # 20-40s-per-shape TPU compiles.
         compilation_cache_dir="/tmp/xllm-jit-cache" if on_tpu else "",
@@ -534,6 +620,10 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
         os.environ["XLLM_PREFILL_ATTENTION_KERNEL"] = "0"
     try:
         ex = ModelExecutor(cfg)
+        # The scan harness below calls llama.decode_step inside its OWN
+        # jit (not the executor's step functions), so the per-shard
+        # kernel dispatch context must be declared here for the trace.
+        ex._set_shard_ctx()
         bs = ex.block_size
         # The dispatch decisions the serving paths RESOLVE for this
         # cache/geometry (ops.attention.resolved_kernel_report) — the
@@ -700,8 +790,16 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
         kv_row = mcfg.num_layers * mcfg.num_kv_heads * mcfg.head_dim
         # Decode step: whole weight set streams once per step (R
         # amortizes it), each slot reads its live context's K/V rows.
-        dec_flops = R * flops_per_tok
-        dec_bytes = weight_bytes + R * ctx * kv_row * 2 * kv_elem_bytes
+        # Sharded meshes: the roofline is PER DEVICE — params/FLOPs split
+        # over tp*ep (dp replicates the weights), the KV stream over tp
+        # (head-sharded pools) — ignoring collectives, i.e. the ideal
+        # the engine_mesh_guard measures shortfall against.
+        wshard = max(tp * ep, 1)
+        dec_flops = R * flops_per_tok / wshard
+        dec_bytes = (
+            weight_bytes / wshard
+            + R * ctx * kv_row * 2 * kv_elem_bytes / max(tp, 1)
+        )
         decode_rl = _roofline(dec_flops, dec_bytes, peak_ref, bw_ref)
         decode_rl["expected_tok_s"] = round(
             R / decode_rl["expected_step_s"], 1
@@ -709,9 +807,12 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
         # Prefill: same weight stream + K/V writes for R*prompt_len rows;
         # FLOPs from the causal-attention-aware count above.
         pre_bytes = (
-            weight_bytes + R * prompt_len * kv_row * 2 * kv_elem_bytes
+            weight_bytes / wshard
+            + R * prompt_len * kv_row * 2 * kv_elem_bytes / max(tp, 1)
         )
-        prefill_rl = _roofline(prefill_flops, pre_bytes, peak_ref, bw_ref)
+        prefill_rl = _roofline(
+            prefill_flops / wshard, pre_bytes, peak_ref, bw_ref
+        )
         prefill_rl["expected_tok_s"] = round(
             R * prompt_len / prefill_rl["expected_step_s"], 1
         )
@@ -726,7 +827,11 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
         engine_bench = None
         attention_bench = None
         spec_bench = None
-        if not on_tpu and not os.environ.get("XLLM_BENCH_SKIP_ENGINE_AB"):
+        if (
+            not on_tpu
+            and n_dev == 1
+            and not os.environ.get("XLLM_BENCH_SKIP_ENGINE_AB")
+        ):
             engine_bench = {}
             modes = (
                 ("sync", "overlap") if engine_mode == "both"
@@ -800,6 +905,13 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
             ),
             "mixed_kernel": kernel_rep.get("mixed"),
             "mq_kernel": kernel_rep.get("mq"),
+            # Shard-aware row (--mesh, docs/SHARDING.md): the mesh this
+            # engine ran on and how many per-shard kernel launches one
+            # attention dispatch fans into (1 = single-device or the
+            # XLLM_SHARDED_KERNELS=0 GSPMD escape) — MULTICHIP/BENCH
+            # rounds compare across mesh shapes on these columns.
+            "mesh": {"dp": dp, "tp": tp, "ep": ep},
+            "kernel_shards": kernel_rep.get("shards", 1),
             "kv_cache_dtype": cfg.kv_cache_dtype,
             "weight_dtype": cfg.weight_dtype,
             # Analytic roofline expectations ("roofline_ref" names the

@@ -150,6 +150,23 @@ def run_pd_bench(args) -> None:
     import os
     import sys
 
+    try:
+        mesh_sizes = [int(x) for x in args.mesh.split(",")]
+        assert len(mesh_sizes) == 3 and all(s >= 1 for s in mesh_sizes)
+    except (ValueError, AssertionError):
+        raise SystemExit(
+            f"--mesh must be dp,tp,ep integers, got {args.mesh!r}"
+        )
+    if mesh_sizes[0] * mesh_sizes[1] * mesh_sizes[2] > 1:
+        # CPU mesh runs need that many virtual host devices, pinned
+        # BEFORE the jax backend initializes (same trick as the tier-1
+        # conftest / bench.py --mesh).
+        from __graft_entry__ import _force_cpu_platform
+
+        _force_cpu_platform(
+            mesh_sizes[0] * mesh_sizes[1] * mesh_sizes[2]
+        )
+
     import jax
 
     from xllm_service_tpu.api import Master
@@ -168,13 +185,20 @@ def run_pd_bench(args) -> None:
     master = Master(cfg, store=store)
     master.start()
 
+    dp, tp, ep = mesh_sizes
+    # tp>1 pairs stream per-shard block sets (parallel/shard_wire.py);
+    # llama3-tiny's Hkv=2 serves tp<=2 — larger tp needs the shard-tiny
+    # geometry (8 KV heads divide every tp in {2,4,8}).
+    model = "llama3-tiny" if tp <= 2 else "llama3-shard-tiny"
+
     def engine_cfg(name, itype):
         return EngineConfig(
-            model="llama3-tiny", dtype="float32", block_size=16,
+            model=model, dtype="float32", block_size=16,
             num_blocks=256, max_running_requests=4, max_seq_len=1024,
             max_prefill_tokens=args.pd_chunk_tokens,
             prefill_buckets=[64, 128, 256, 512, 1024],
             instance_name=name, instance_type=itype,
+            dp_size=dp, tp_size=tp, ep_size=ep,
             enable_local_kv_transfer=False,  # measure the wire path
         )
 
@@ -338,6 +362,19 @@ def run_pd_bench(args) -> None:
             "overlap fraction missing or <= 0.5 on a multi-chunk prompt"
         )
 
+    kernel_dispatch = {}
+    kv_wire_shards = 1
+    for label, srv in (("prefill", prefill), ("decode", decode)):
+        ex = getattr(srv.engine, "executor", None)
+        if ex is None:
+            continue
+        if hasattr(ex, "kernel_report"):
+            kernel_dispatch[label] = ex.kernel_report()
+        if not ex.cfg.is_mla:
+            kv_wire_shards = max(
+                kv_wire_shards, ex.mesh.shape.get("tp", 1)
+            )
+
     for srv in (prefill, decode):
         try:
             srv.stop()
@@ -353,6 +390,13 @@ def run_pd_bench(args) -> None:
         ),
         "prompt_tokens": n_tok,
         "chunk_tokens": args.pd_chunk_tokens,
+        # Shard-aware columns (docs/SHARDING.md): the per-instance mesh,
+        # the RESOLVED per-shard kernel dispatch of the pair, and how
+        # many per-shard block sets each handoff frame carried — rounds
+        # compare across mesh shapes on these.
+        "mesh": {"dp": dp, "tp": tp, "ep": ep},
+        "kernel_dispatch": kernel_dispatch,
+        "kv_wire_shards": kv_wire_shards,
         "monolithic": mono,
         "streamed": streamed,
         "paired_stall_delta_p50_ms": stall_delta,
@@ -1047,6 +1091,13 @@ def main() -> None:
     p.add_argument(
         "--pd-max-tokens", type=int, default=4,
         help="--pd: generated tokens per request",
+    )
+    p.add_argument(
+        "--mesh", default="1,1,1", metavar="DP,TP,EP",
+        help="--pd: engine mesh per instance (docs/SHARDING.md) — a "
+        "tp>1 pair streams PER-SHARD KV block sets over the handoff "
+        "wire and the rows gain mesh + resolved kernel-dispatch "
+        "columns; the CPU harness runs it on the virtual host mesh",
     )
     p.add_argument(
         "--instance-type", default="MIX",

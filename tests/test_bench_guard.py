@@ -235,3 +235,46 @@ def test_spec_guard_abstains_on_builder_mismatch():
     out, rc = bench._cpu_regression_guard(_line(spec_bench=sb))
     assert rc == 0
     assert json.loads(out)["engine_spec_guard"].startswith("abstained")
+
+
+# ------------------------------------------------- mesh guard (--mesh)
+
+
+def _mesh_line(**kw):
+    d = {
+        "backend": "tpu", "value": 1000.0,
+        "mesh": {"dp": 1, "tp": 8, "ep": 1},
+        "decode_roofline": {"expected_tok_s": 1500.0},
+    }
+    d.update(kw)
+    return json.dumps(d)
+
+
+def test_mesh_guard_skips_unsharded_rows():
+    out, rc = bench._mesh_guard(_line())
+    assert rc == 0
+    assert "engine_mesh_guard" not in json.loads(out)
+
+
+def test_mesh_guard_abstains_off_tpu():
+    # The CPU virtual mesh proves parity in tier-1, not performance —
+    # the guard must say so loudly instead of comparing meaningless
+    # CPU numbers against a v5e roofline.
+    out, rc = bench._mesh_guard(_mesh_line(backend="cpu"))
+    assert rc == 0
+    g = json.loads(out)["engine_mesh_guard"]
+    assert g.startswith("abstained") and "tier-1" in g
+
+
+def test_mesh_guard_above_floor_passes():
+    out, rc = bench._mesh_guard(_mesh_line(value=800.0))  # 53% of 1500
+    assert rc == 0
+    assert json.loads(out)["engine_mesh_guard"] == "ok"
+
+
+def test_mesh_guard_below_floor_fails():
+    # A GSPMD-replicated kernel / silent gather fallback is ~tp× off the
+    # per-shard roofline: exit 3, with the diagnosis in the message.
+    out, rc = bench._mesh_guard(_mesh_line(value=100.0))
+    assert rc == 3
+    assert json.loads(out)["engine_mesh_guard"].startswith("FAIL")

@@ -27,6 +27,7 @@ from xllm_service_tpu.analysis import (  # noqa: E402
     LockDisciplinePass,
     MetricNamesPass,
     Project,
+    ShardingRulesPass,
     ThreadJoinsPass,
     ThreadOwnershipPass,
     all_passes,
@@ -644,6 +645,65 @@ class TestLocktrace:
         t.join(timeout=5)
         rep = traced.report()
         assert rep["edges"] == 0 and rep["cycles"] == [], rep
+
+
+# ---------------------------------------------------------------------------
+# sharding-rules
+# ---------------------------------------------------------------------------
+
+
+class TestShardingRules:
+    RULES = (
+        "def param_shardings(cfg, mesh):\n"
+        "    layers = {'attn_norm': 1, 'wq': 1}\n"
+        "    layers.update({'w_gate': 1})\n"
+        "    layers['wo'] = 1\n"
+        "    return {'embed': 1, 'layers': layers}\n"
+    )
+
+    def _proj(self, model_src, rules_src=None):
+        return Project.from_sources({
+            "xllm_service_tpu/models/llama.py": model_src,
+            "xllm_service_tpu/parallel/sharding.py": (
+                rules_src if rules_src is not None else self.RULES
+            ),
+        })
+
+    def test_unruled_leaf_trips(self):
+        src = (
+            "def init_params(cfg, key, dtype):\n"
+            "    layers = {'attn_norm': 1, 'wq': 1}\n"
+            "    layers['w_new_proj'] = 2\n"
+            "    return {'embed': 1, 'layers': layers}\n"
+        )
+        fs = ShardingRulesPass().run(self._proj(src))
+        assert len(fs) == 1 and "w_new_proj" in fs[0].message
+
+    def test_ruled_tree_clean(self):
+        src = (
+            "def init_params(cfg, key, dtype):\n"
+            "    layers = {'attn_norm': 1, 'wq': 1}\n"
+            "    layers.update({'w_gate': 1, 'wo': 1})\n"
+            "    return {'embed': 1, 'layers': layers}\n"
+        )
+        assert ShardingRulesPass().run(self._proj(src)) == []
+
+    def test_runtime_lora_leaves_exempt(self):
+        src = (
+            "def init_params(cfg, key, dtype):\n"
+            "    layers = {'wq': 1, 'lora_wq_a': 1}\n"
+            "    return {'layers': layers}\n"
+        )
+        assert ShardingRulesPass().run(self._proj(src)) == []
+
+    def test_missing_rules_file_trips(self):
+        src = "def init_params(cfg, key, dtype):\n    return {'wq': 1}\n"
+        fs = ShardingRulesPass().run(
+            Project.from_sources(
+                {"xllm_service_tpu/models/llama.py": src}
+            )
+        )
+        assert len(fs) == 1 and "sharding.py" in fs[0].message
 
 
 # ---------------------------------------------------------------------------

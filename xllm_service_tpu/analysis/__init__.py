@@ -23,6 +23,7 @@ from xllm_service_tpu.analysis.fault_points import (
 from xllm_service_tpu.analysis.hatch_registry import HatchRegistryPass
 from xllm_service_tpu.analysis.lock_discipline import LockDisciplinePass
 from xllm_service_tpu.analysis.metric_names import MetricNamesPass
+from xllm_service_tpu.analysis.sharding_rules import ShardingRulesPass
 from xllm_service_tpu.analysis.thread_joins import ThreadJoinsPass
 from xllm_service_tpu.analysis.thread_ownership import ThreadOwnershipPass
 
@@ -39,6 +40,7 @@ def all_passes(runtime: bool = True):
         ThreadOwnershipPass(),
         ThreadJoinsPass(),
         HatchRegistryPass(),
+        ShardingRulesPass(),
         MetricNamesPass(runtime=runtime),
         FaultPointsPass(),
     ]
@@ -58,6 +60,7 @@ __all__ = [
     "HatchRegistryPass",
     "LockDisciplinePass",
     "MetricNamesPass",
+    "ShardingRulesPass",
     "ThreadJoinsPass",
     "ThreadOwnershipPass",
 ]

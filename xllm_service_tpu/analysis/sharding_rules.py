@@ -1,0 +1,132 @@
+"""Sharding-rule pass: every param leaf the model families create gets a
+partition rule.
+
+The GSPMD tier (docs/SHARDING.md) only works when EVERY leaf of the
+model pytree carries a NamedSharding from
+`parallel/sharding.param_shardings` — a leaf added to a family's
+`init_params` without a matching rule is silently REPLICATED across the
+mesh by the jit default, which "works" on the virtual test mesh and then
+multiplies HBM residency by tp on a real pod (a 70B wq replicated 8x is
+an instant OOM). The runtime half of this guarantee is the
+tests/test_sharding_rules.py structure matrix (jax.eval_shape over every
+registered family × mesh); this pass is the static tripwire that fires
+on the PR that ADDS the leaf, before any test constructs that family on
+a mesh.
+
+Mechanics: collect every string key assigned into the param tree by the
+model modules' `init_params` functions (dict literals, `d["k"] = ...`,
+`d.update({...})` — the only forms the families use), and every key
+`param_shardings` assigns a spec for in parallel/sharding.py, then
+require model-keys ⊆ rule-keys. Keys that are runtime-installed with
+explicit shardings (the multi-LoRA `lora_<proj>_{a,b}` stacks from
+set_lora_adapters) are exempt by prefix.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from xllm_service_tpu.analysis.core import Finding, LintPass, Project
+
+MODEL_FILES = (
+    "xllm_service_tpu/models/llama.py",
+    "xllm_service_tpu/models/deepseek.py",
+)
+RULES_FILE = "xllm_service_tpu/parallel/sharding.py"
+
+# Installed at runtime with an explicit sharding, never by init_params.
+EXEMPT_PREFIXES = ("lora_",)
+
+
+def _str_keys_of_dict(node: ast.AST) -> List[str]:
+    if isinstance(node, ast.Dict):
+        return [
+            k.value
+            for k in node.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)
+        ]
+    return []
+
+
+def _collect_assigned_keys(fn: ast.AST) -> Set[str]:
+    """String keys assigned into any dict within one function body:
+    dict literals, `d["k"] = ...` subscript stores, and
+    `d.update({...})` calls."""
+    keys: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Dict):
+            keys.update(_str_keys_of_dict(node))
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.slice, ast.Constant)
+                    and isinstance(tgt.slice.value, str)
+                ):
+                    keys.add(tgt.slice.value)
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "update"
+        ):
+            for arg in node.args:
+                keys.update(_str_keys_of_dict(arg))
+    return keys
+
+
+def _functions(tree: ast.Module, name: str) -> List[ast.AST]:
+    return [
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n.name == name
+    ]
+
+
+class ShardingRulesPass(LintPass):
+    id = "sharding-rules"
+    title = "model param leaves vs parallel/sharding.py partition rules"
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        rules_src = None
+        model_srcs = []
+        for src in project.sources:
+            if src.rel == RULES_FILE:
+                rules_src = src
+            elif src.rel in MODEL_FILES:
+                model_srcs.append(src)
+        if rules_src is None or rules_src.tree is None:
+            return [Finding(
+                self.id, RULES_FILE, 1,
+                "parallel/sharding.py not found/parsable — the partition "
+                "rules have nowhere to live",
+            )]
+        rule_keys: Set[str] = set()
+        for fn in _functions(rules_src.tree, "param_shardings"):
+            rule_keys |= _collect_assigned_keys(fn)
+        if not rule_keys:
+            return [Finding(
+                self.id, RULES_FILE, 1,
+                "param_shardings assigns no rule keys — the pass cannot "
+                "cross-check the model tree",
+            )]
+        for src in model_srcs:
+            if src.tree is None:
+                continue
+            for fn in _functions(src.tree, "init_params"):
+                for key in sorted(_collect_assigned_keys(fn)):
+                    if key in rule_keys:
+                        continue
+                    if any(key.startswith(p) for p in EXEMPT_PREFIXES):
+                        continue
+                    findings.append(Finding(
+                        self.id, src.rel, fn.lineno,
+                        f"param leaf {key!r} is created by init_params "
+                        f"but has no rule in param_shardings "
+                        f"({RULES_FILE}) — it would silently replicate "
+                        f"across every mesh shard; add a NamedSharding "
+                        f"rule (docs/SHARDING.md)",
+                    ))
+        return findings

@@ -35,10 +35,26 @@ from xllm_service_tpu.api.protocol import (
 )
 from xllm_service_tpu.common import faults
 from xllm_service_tpu.common.shortuuid import generate_uuid
+from xllm_service_tpu.parallel.shard_wire import ShardedKV, to_host
 from xllm_service_tpu.common.types import RequestOutput, Status, StatusCode
 from xllm_service_tpu.tokenizer.tokenizer import IncrementalDetokenizer
 
 logger = logging.getLogger("xllm_service_tpu.api.instance")
+
+def _host_kv(kv):
+    """Device payload → host wire form with NO cross-shard gather: a
+    tp-sharded export becomes per-shard pieces (ShardedKV) that the
+    bytes plane serializes shard-by-shard (docs/SHARDING.md); everything
+    else is the flat np.asarray the old wire carried."""
+    return to_host(kv)
+
+
+def _device_resident(kv) -> bool:
+    """True when `kv` still lives on device (pull-plane eligible); host
+    np payloads AND per-shard host pieces (ShardedKV) ride the bytes
+    plane."""
+    return kv is not None and not isinstance(kv, (np.ndarray, ShardedKV))
+
 
 # Receiver session table bounds: stale sessions (sender died mid-stream
 # without an abort) are reaped past the TTL; the table itself is capped so
@@ -137,12 +153,11 @@ class _KVStreamSession:
         # and the worker converts at serialization anyway (queue pinning
         # stays bounded at the lane's maxsize).
         if (
-            kv is not None
-            and not isinstance(kv, np.ndarray)
+            _device_resident(kv)
             and self.owner._local_peer(self.decode_name) is None
             and self.owner._kv_transfer is None
         ):
-            kv = np.asarray(kv)
+            kv = _host_kv(kv)
         idx = self._next_idx
         self._next_idx += 1
         with self._cv:
@@ -210,12 +225,8 @@ class _KVStreamSession:
             # enqueued for may have deregistered since. With no pull plane
             # the payload must ride host bytes per-chunk — copy NOW, don't
             # strand the session.
-            if (
-                kv is not None
-                and not isinstance(kv, np.ndarray)
-                and self.owner._kv_transfer is None
-            ):
-                kv = np.asarray(kv)
+            if _device_resident(kv) and self.owner._kv_transfer is None:
+                kv = _host_kv(kv)
             addr = self._addr or self.owner._resolve_instance_addr(
                 self.decode_name
             )
@@ -525,8 +536,7 @@ class KVHandoffMixin:
             # the point (the peer pulls from device memory), so the copy
             # is skipped.
             if (
-                handoff.kv is not None
-                and not isinstance(handoff.kv, np.ndarray)
+                _device_resident(handoff.kv)
                 and self._local_peer(decode_name) is None
                 and (
                     self._kv_transfer is None
@@ -534,7 +544,7 @@ class KVHandoffMixin:
                 )
             ):
                 handoff = dataclasses.replace(
-                    handoff, kv=np.asarray(handoff.kv)
+                    handoff, kv=_host_kv(handoff.kv)
                 )
             with self._push_acked_mu:
                 acked = self._push_acked.get(srid)
@@ -677,7 +687,7 @@ class KVHandoffMixin:
                 and self._kv_transfer is None
             ):
                 handoff = dataclasses.replace(
-                    handoff, kv=np.asarray(handoff.kv)
+                    handoff, kv=_host_kv(handoff.kv)
                 )
             self._transfer_q.put(lambda: transfer(handoff, t_pf_done))
 
@@ -707,8 +717,7 @@ class KVHandoffMixin:
         xfer = self._kv_transfer
         use_pull = (
             xfer is not None
-            and kv is not None
-            and not isinstance(kv, np.ndarray)
+            and _device_resident(kv)
             and addr not in self._peer_no_pull
         )
         if use_pull:
@@ -762,7 +771,7 @@ class KVHandoffMixin:
                     "pull-plane /kv/import rejected by %s (%s); retrying "
                     "this message on the bytes plane", addr, resp,
                 )
-            kv = np.asarray(kv)
+            kv = _host_kv(kv)
         try:
             code, resp = post_bytes(
                 addr, "/kv/import", kv_frame_to_bytes(header, kv)
@@ -809,10 +818,22 @@ class KVHandoffMixin:
                 "kv_pull offered but this instance has no transfer server "
                 "(enable_kv_transfer_server)"
             )
+        # Land the pull straight onto the local executor's payload
+        # sharding (migration_sharding — the kv_cache_sharding-derived
+        # layout): a tp-sharded consumer never bounces the payload
+        # through one device and a later reshard; on a 1-device engine
+        # this resolves to the same single-device landing as before.
+        sharding = None
+        ex = getattr(self.engine, "executor", None)
+        if ex is not None and hasattr(ex, "migration_sharding"):
+            try:
+                sharding = ex.migration_sharding()
+            except Exception:
+                sharding = None
         try:
             kv = self._kv_transfer.pull_single(
                 p["addr"], int(p["uuid"]), p["shape"],
-                resolve_kv_dtype(p["dtype"]),
+                resolve_kv_dtype(p["dtype"]), sharding=sharding,
             )
         except Exception as e:
             return None, f"kv pull failed: {e}"

@@ -159,17 +159,37 @@ import json as _json
 
 def kv_frame_to_bytes(header: Dict[str, Any], kv=None) -> bytes:
     """Generic /kv/import frame: one JSON header, a NUL, then raw KV bytes
-    (C-order). The monolithic handoff and the pipelined session's chunk
-    messages share this layout; `kv_dtype`/`kv_shape` are injected when a
-    payload rides the body (the pull plane sends header-only frames)."""
+    (C-order). The monolithic handoff, the pipelined session's chunk
+    messages, and the fabric /kv/fetch responses share this layout;
+    `kv_dtype`/`kv_shape` are injected when a payload rides the body (the
+    pull plane sends header-only frames).
+
+    Sharded payloads (a tp>1 holder — docs/SHARDING.md): a device array
+    sharded on the cache-head axis, or an already-split
+    `shard_wire.ShardedKV`, serializes as N per-shard block sets
+    back-to-back with `kv_shards` (per-shard head counts) and
+    `kv_shard_shape` (the LOGICAL full shape) in the header — each
+    shard's bytes come off its own device, no cross-shard host gather.
+    Deliberately NOT `kv_shape`: the body's byte order is per-shard, so
+    a receiver that doesn't know the shard axis must see "no payload"
+    (and degrade to recompute / reject the frame) rather than
+    frombuffer-reshape scrambled bytes that happen to have the right
+    element count."""
     if kv is not None:
         import numpy as np
 
-        kv = np.asarray(kv)
+        from xllm_service_tpu.parallel.shard_wire import ShardedKV, to_host
+
+        kv = to_host(kv)
         header = dict(header)
         header["kv_dtype"] = str(kv.dtype)
-        header["kv_shape"] = list(kv.shape)
-        body = kv.tobytes()
+        if isinstance(kv, ShardedKV):
+            header["kv_shards"] = kv.head_sizes
+            header["kv_shard_shape"] = list(kv.shape)
+            body = kv.tobytes()
+        else:
+            header["kv_shape"] = list(kv.shape)
+            body = np.asarray(kv).tobytes()
     else:
         body = b""
     return _json.dumps(header).encode("utf-8") + b"\x00" + body
@@ -197,14 +217,44 @@ def resolve_kv_dtype(name: str):
 
 
 def kv_frame_array(header: Dict[str, Any], body: bytes):
-    """Decode a frame's body into the array its header describes (None for
-    header-only frames)."""
+    """Decode a frame's body into the array its header describes (None
+    for header-only frames). A `kv_shards` header yields a
+    `shard_wire.ShardedKV` of the per-shard pieces — the consumer's
+    executor lands each piece straight onto its own kv_cache_sharding
+    (shard_wire.assemble) instead of re-gathering on the host; every
+    shape gate keeps working because ShardedKV.shape is the logical full
+    shape."""
     import numpy as np
 
-    if "kv_shape" not in header:
+    shards = header.get("kv_shards")
+    if "kv_shape" not in header and not shards:
         return None
     dt = resolve_kv_dtype(header["kv_dtype"])
-    return np.frombuffer(body, dtype=dt).reshape(header["kv_shape"])
+    if not shards:
+        return np.frombuffer(body, dtype=dt).reshape(header["kv_shape"])
+    shape = list(header["kv_shard_shape"])
+    from xllm_service_tpu.parallel.shard_wire import HEAD_AXIS, ShardedKV
+
+    pieces, off = [], 0
+    per_head = 1
+    for i, d in enumerate(shape):
+        if i != HEAD_AXIS:
+            per_head *= int(d)
+    for h in shards:
+        n = per_head * int(h)
+        piece_shape = [
+            int(h) if i == HEAD_AXIS else int(d)
+            for i, d in enumerate(shape)
+        ]
+        # offset/count frombuffer: zero-copy views into the body, like
+        # the flat branch above (slicing `body` would copy every shard).
+        pieces.append(
+            np.frombuffer(body, dtype=dt, count=n, offset=off).reshape(
+                piece_shape
+            )
+        )
+        off += n * dt.itemsize
+    return ShardedKV(pieces)
 
 
 def handoff_header(h, extra: Dict[str, Any]) -> Dict[str, Any]:

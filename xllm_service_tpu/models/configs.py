@@ -375,6 +375,27 @@ register(
 )
 
 register(
+    # The sharded-engine differential geometry (docs/SHARDING.md): 8 KV
+    # heads so tp ∈ {2, 4, 8} all divide (llama3-tiny's Hkv=2 caps at
+    # tp=2), head_dim 128 so every Pallas path is kernel-eligible
+    # per-shard down to 1 head/shard (interpret mode on the virtual
+    # mesh), and GQA ratio 2 so per-shard query packing still exercises
+    # grouping. CPU-runnable; the same shape class as the llama3-70b
+    # tp=8 serving layout (BASELINE round 3), just tiny.
+    ModelConfig(
+        name="llama3-shard-tiny",
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=16,
+        num_kv_heads=8,
+        head_dim=128,
+        max_position_embeddings=1024,
+    )
+)
+
+register(
     ModelConfig(
         name="qwen3-moe-tiny",
         vocab_size=512,

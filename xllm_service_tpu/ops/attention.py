@@ -20,6 +20,7 @@ the KV-head axis shards over the `tp` mesh axis.
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +28,111 @@ import jax.numpy as jnp
 from xllm_service_tpu.ops import kv_cache as kvc
 
 NEG_INF = -1e30
+
+
+# ------------------------------------------------- sharded kernel dispatch
+# Pallas kernels are opaque custom calls to XLA's GSPMD partitioner: under
+# a tp>1 mesh it cannot partition them, so a kernel launched from inside
+# the jitted step would silently run replicated over a gathered cache —
+# exactly the degradation the per-shard tier exists to kill. The serving
+# dispatchers below therefore wrap every kernel launch in `shard_map`
+# over the tp axis when a shard context is declared: each shard runs ONE
+# kernel over its own contiguous slice of query heads and KV heads
+# (attention is head-independent, so no collectives are needed), the GQA
+# packing/eligibility trio evaluates against the PER-SHARD cache
+# geometry inside the mapped body, and the fused mixed/spec steps stay
+# one-launch-per-shard. XLLM_SHARDED_KERNELS=0 is the escape hatch back
+# to the pre-shard_map GSPMD behavior (docs/SHARDING.md).
+#
+# The context is per-thread (each engine thread serves one executor) and
+# read at TRACE time — the same lifetime every other kernel hatch here
+# has (the jitted steps bake the decision in at first trace).
+
+_SHARD_TLS = threading.local()
+
+
+def sharded_kernels_enabled() -> bool:
+    import os
+
+    return os.environ.get("XLLM_SHARDED_KERNELS") != "0"
+
+
+def set_shard_context(mesh, axis: str = "tp") -> None:
+    """Declare the mesh the current thread's kernel dispatches run under
+    (runtime/executor.py sets it before every jitted step family so the
+    trace captures the right mesh; None clears). Ignored for meshes
+    without a >1 `axis` extent."""
+    if mesh is not None and mesh.shape.get(axis, 1) > 1:
+        _SHARD_TLS.ctx = (mesh, axis)
+    else:
+        _SHARD_TLS.ctx = None
+
+
+def shard_context():
+    """(mesh, axis) when per-shard kernel dispatch applies, else None."""
+    ctx = getattr(_SHARD_TLS, "ctx", None)
+    if ctx is None or not sharded_kernels_enabled():
+        return None
+    return ctx
+
+
+def _shard_map_fn():
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map
+
+
+def _cache_shard_spec(cache, axis: str):
+    """shard_map spec pytree for a per-layer cache operand: data
+    [N, Hc, BS, D] and int8 scale [N, Hc, G, BS] both carry the head
+    axis at dim 1."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis, None, None)
+    if isinstance(cache, kvc.PagedKV):
+        return kvc.PagedKV(spec, spec if cache.scale is not None else None)
+    return spec
+
+
+def _shardable(q: jnp.ndarray, k_cache, ctx) -> bool:
+    """Whether this (query, cache) pair can shard over ctx's axis: the
+    query heads and the per-shard cache geometry must divide evenly —
+    gqa_kernel_eligible re-checks the cache side per shard."""
+    if ctx is None:
+        return False
+    n = ctx[0].shape[ctx[1]]
+    return q.shape[-2] % n == 0 and kvc.raw(k_cache).shape[-3] % n == 0
+
+
+def _sharded_kernel_call(body, ctx, q_spec_ndim: int, q, k_cache, v_cache,
+                         *rep_args):
+    """Run `body(q, k, v, *rep_args)` once per tp shard via shard_map.
+
+    `body` receives PER-SHARD operands (Hq/tp query heads, Hc/tp cache
+    rows) and must do its own packing (kernel_io_for inside the body sees
+    the per-shard geometry). Tables/lengths/positions replicate; the
+    output's head axis is at `q_spec_ndim - 1` == ndim-2 of q."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh, axis = ctx
+    head_ax = q_spec_ndim - 2
+    q_spec = P(*(
+        axis if i == head_ax else None for i in range(q_spec_ndim)
+    ))
+    fn = _shard_map_fn()(
+        body,
+        mesh=mesh,
+        in_specs=(
+            q_spec,
+            _cache_shard_spec(k_cache, axis),
+            _cache_shard_spec(v_cache, axis),
+        ) + (P(),) * len(rep_args),
+        out_specs=q_spec,
+        check_rep=False,
+    )
+    return fn(q, k_cache, v_cache, *rep_args)
 
 
 def _pack_ratio(cache, q_head_dim: int) -> int:
@@ -275,12 +381,21 @@ def _gqa_kernel_ok(k_cache, on: bool) -> bool:
     return _kernel_tile_ok(k_cache, kvc.raw(k_cache).shape[-1], on)
 
 
-def gqa_kernel_eligible(k_cache, q_head_dim: int, on: bool) -> bool:
+def gqa_kernel_eligible(
+    k_cache, q_head_dim: int, on: bool, shards: int = 1
+) -> bool:
     """THE tile/lane/packing eligibility gate for every GQA Pallas path
     (decode, flash prefill, multi-query verify, ragged mixed) — one
     predicate instead of a per-dispatcher copy of the `_kernel_tile_ok`
     + `_packed_kernel_allowed` pair (ISSUE 9 satellite). `on` is the
-    platform gate (_on_tpu() or interpret)."""
+    platform gate (_on_tpu() or interpret). `shards` > 1 evaluates the
+    PER-SHARD cache geometry of the shard_map'd dispatch: the (possibly
+    packed) cache-head axis must split evenly over tp or the per-shard
+    kernel is declined (the caller then serves the GSPMD path; the
+    config-level resolve_kv_packing fallback normally prevents this, but
+    the gate must hold for hand-built caches too)."""
+    if shards > 1 and kvc.raw(k_cache).shape[-3] % shards:
+        return False
     return _gqa_kernel_ok(k_cache, on) and _packed_kernel_allowed(
         _pack_ratio(k_cache, q_head_dim)
     )
@@ -313,12 +428,16 @@ def prefill_attention(
     import os
 
     # One eligibility predicate for BOTH Pallas paths (flash prefill and
-    # the multi-query verify kernel).
+    # the multi-query verify kernel). Under a shard context (tp>1) each
+    # kernel launches per-shard via shard_map and the packing trio
+    # (kernel_io_for) evaluates the per-shard cache geometry inside the
+    # mapped body.
     # Packed-pair caches (head_dim < 128): queries embed block-diagonally
     # into the 128-lane rows; outputs slice back (pack_queries docstring).
-    pack, kv_heads, q_packed = kernel_io_for(k_cache, q)
+    ctx = shard_context() if _shardable(q, k_cache, shard_context()) else None
+    shards = ctx[0].shape[ctx[1]] if ctx is not None else 1
     kernel_ok = gqa_kernel_eligible(
-        k_cache, q.shape[-1], _on_tpu() or interpret
+        k_cache, q.shape[-1], _on_tpu() or interpret, shards=shards
     )
 
     # Speculative-verify shapes (a handful of query rows per sequence):
@@ -345,14 +464,23 @@ def prefill_attention(
         )
 
         seq_lens = jnp.where(true_len > 0, start_pos + 1, 0)
-        return unpack_outputs(
-            multiquery_paged_attention_kernel(
-                q_packed, k_cache, v_cache,
-                block_tables, seq_lens, scale, interpret=interpret,
-                window=window,
-            ),
-            pack, kv_heads,
-        )
+
+        def mq_body(qq, kk, vv, bt, sl):
+            pack, kv_heads, q_packed = kernel_io_for(kk, qq)
+            return unpack_outputs(
+                multiquery_paged_attention_kernel(
+                    q_packed, kk, vv, bt, sl, scale,
+                    interpret=interpret, window=window,
+                ),
+                pack, kv_heads,
+            )
+
+        if ctx is not None:
+            return _sharded_kernel_call(
+                mq_body, ctx, 4, q, k_cache, v_cache, block_tables,
+                seq_lens,
+            )
+        return mq_body(q, k_cache, v_cache, block_tables, seq_lens)
 
     env = os.environ.get("XLLM_PREFILL_ATTENTION_KERNEL")
     if use_kernel is None:
@@ -362,13 +490,23 @@ def prefill_attention(
             flash_prefill_kernel,
         )
 
-        return unpack_outputs(
-            flash_prefill_kernel(
-                q_packed, k_cache, v_cache,
-                block_tables, start_pos, true_len, scale,
-                interpret=interpret, window=window,
-            ),
-            pack, kv_heads,
+        def flash_body(qq, kk, vv, bt, sp, tl):
+            pack, kv_heads, q_packed = kernel_io_for(kk, qq)
+            return unpack_outputs(
+                flash_prefill_kernel(
+                    q_packed, kk, vv, bt, sp, tl, scale,
+                    interpret=interpret, window=window,
+                ),
+                pack, kv_heads,
+            )
+
+        if ctx is not None:
+            return _sharded_kernel_call(
+                flash_body, ctx, 4, q, k_cache, v_cache, block_tables,
+                start_pos, true_len,
+            )
+        return flash_body(
+            q, k_cache, v_cache, block_tables, start_pos, true_len
         )
     return jax.vmap(
         lambda qi, ti, sp, tl: prefill_attention_blockwise(
@@ -570,6 +708,7 @@ def _on_tpu() -> bool:
 def paged_attention(
     q, k_cache, v_cache, block_table, seq_lens, scale,
     use_kernel: bool | None = None, window: int = 0,
+    interpret: bool = False,
 ):
     """Decode paged attention; Pallas kernel on TPU, gather fallback elsewhere.
 
@@ -577,7 +716,11 @@ def paged_attention(
     chip (scripts/validate_kernel_tpu.py — max |err| vs the gather oracle
     0.002 in bf16, 2.5-8x faster across llama-8B/70B-class decode shapes).
     Set XLLM_PAGED_ATTENTION_KERNEL=0 to force the gather path, =1 to force
-    the kernel even where the default heuristics decline it.
+    the kernel even where the default heuristics decline it. Under a
+    declared shard context (set_shard_context; tp>1 meshes) the kernel
+    launches per-shard through shard_map — one launch per tp shard over
+    its own head slice — instead of degrading to a GSPMD-replicated
+    custom call.
 
     head_dim < 128 models ride the kernel through the packed-pair cache
     layout (kv_cache.kv_pack_factor: a bare [BS, 64] block slice is below
@@ -586,9 +729,13 @@ def paged_attention(
     embed block-diagonally, see pack_queries)."""
     import os
 
+    ctx = shard_context() if _shardable(q, k_cache, shard_context()) else None
+    shards = ctx[0].shape[ctx[1]] if ctx is not None else 1
     env = os.environ.get("XLLM_PAGED_ATTENTION_KERNEL")
     if use_kernel is None:
-        kernel_ok = gqa_kernel_eligible(k_cache, q.shape[-1], _on_tpu())
+        kernel_ok = gqa_kernel_eligible(
+            k_cache, q.shape[-1], _on_tpu() or interpret, shards=shards
+        )
         use_kernel = (env != "0") if kernel_ok else (env == "1")
     if use_kernel:
         try:
@@ -598,14 +745,24 @@ def paged_attention(
         except ImportError:
             use_kernel = False
         else:
-            pack, kv_heads, q_packed = kernel_io_for(k_cache, q)
-            return unpack_outputs(
-                paged_attention_kernel(
-                    q_packed, k_cache, v_cache, block_table, seq_lens, scale,
-                    window=window,
-                ),
-                pack, kv_heads,
-            )
+            def body(qq, kk, vv, bt, sl):
+                # Per-shard packing: kernel_io_for reads the (per-shard,
+                # under shard_map) cache geometry.
+                pack, kv_heads, q_packed = kernel_io_for(kk, qq)
+                return unpack_outputs(
+                    paged_attention_kernel(
+                        q_packed, kk, vv, bt, sl, scale,
+                        window=window, interpret=interpret,
+                    ),
+                    pack, kv_heads,
+                )
+
+            if ctx is not None:
+                return _sharded_kernel_call(
+                    body, ctx, 3, q, k_cache, v_cache, block_table,
+                    seq_lens,
+                )
+            return body(q, k_cache, v_cache, block_table, seq_lens)
     return paged_attention_gather(
         q, k_cache, v_cache, block_table, seq_lens, scale, window=window
     )
@@ -667,7 +824,7 @@ def ragged_attention_blockwise(
 
 def ragged_kernel_enabled(
     k_cache, q_head_dim: int, use_kernel: bool | None = None,
-    interpret: bool = False,
+    interpret: bool = False, shards: int = 1,
 ) -> bool:
     """Dispatch decision for the ragged mixed kernel. Follows the repo's
     opt-in-until-chip-validated convention: the kernel is NEW silicon
@@ -682,13 +839,13 @@ def ragged_kernel_enabled(
 
     if use_kernel is not None:
         return use_kernel and gqa_kernel_eligible(
-            k_cache, q_head_dim, _on_tpu() or interpret
+            k_cache, q_head_dim, _on_tpu() or interpret, shards=shards
         )
     env = os.environ.get("XLLM_RAGGED_ATTENTION_KERNEL")
     if env == "0":
         return False
     return (env == "1" or interpret) and gqa_kernel_eligible(
-        k_cache, q_head_dim, _on_tpu() or interpret
+        k_cache, q_head_dim, _on_tpu() or interpret, shards=shards
     )
 
 
@@ -707,23 +864,37 @@ def ragged_paged_attention(
 ) -> jnp.ndarray:
     """Ragged mixed-batch paged attention: ONE Pallas dispatch over
     prefill + decode rows when the kernel is enabled
-    (ragged_kernel_enabled), blockwise oracle otherwise. GQA head packing
-    rides the kernel_io_for/pack_queries contract like every other GQA
-    kernel path; int8 caches stream pool-native grouped scales."""
-    if ragged_kernel_enabled(k_cache, q.shape[-1], use_kernel, interpret):
+    (ragged_kernel_enabled), blockwise oracle otherwise — ONE dispatch
+    PER TP SHARD under a shard context (the fused mixed/spec engine
+    steps stay one-launch-per-shard on multi-chip meshes). GQA head
+    packing rides the kernel_io_for/pack_queries contract like every
+    other GQA kernel path; int8 caches stream pool-native grouped
+    scales."""
+    ctx = shard_context() if _shardable(q, k_cache, shard_context()) else None
+    shards = ctx[0].shape[ctx[1]] if ctx is not None else 1
+    if ragged_kernel_enabled(
+        k_cache, q.shape[-1], use_kernel, interpret, shards=shards
+    ):
         from xllm_service_tpu.ops.pallas.ragged_paged_attention import (
             ragged_paged_attention_kernel,
         )
 
-        pack, kv_heads, q_packed = kernel_io_for(k_cache, q)
-        return unpack_outputs(
-            ragged_paged_attention_kernel(
-                q_packed, k_cache, v_cache, block_tables,
-                q_len, pos0, seg_lens, scale,
-                interpret=interpret, window=window,
-            ),
-            pack, kv_heads,
-        )
+        def body(qq, kk, vv, bt, ql, p0):
+            pack, kv_heads, q_packed = kernel_io_for(kk, qq)
+            return unpack_outputs(
+                ragged_paged_attention_kernel(
+                    q_packed, kk, vv, bt, ql, p0, seg_lens, scale,
+                    interpret=interpret, window=window,
+                ),
+                pack, kv_heads,
+            )
+
+        if ctx is not None:
+            return _sharded_kernel_call(
+                body, ctx, 3, q, k_cache, v_cache, block_tables,
+                q_len, pos0,
+            )
+        return body(q, k_cache, v_cache, block_tables, q_len, pos0)
     return ragged_attention_blockwise(
         q, k_cache, v_cache, block_tables, q_len, pos0, seg_lens, scale,
         window=window,
@@ -868,18 +1039,25 @@ def mixed_prefill_attention(
 
 
 def resolved_kernel_report(
-    k_cache, q_head_dim: int, ragged_interpret: bool = False
+    k_cache, q_head_dim: int, ragged_interpret: bool = False,
+    shards: int = 1,
 ) -> dict:
     """The dispatch decisions the serving paths would take RIGHT NOW for
     this cache/geometry — what actually runs, not which env var is set
     (bench.py reports these; ISSUE 9 satellite: `attention_kernel:
     default` told the record nothing). Values name the winning
     implementation; a path whose env hatch forces it off reports the
-    fallback with a ` (forced-off)` marker."""
+    fallback with a ` (forced-off)` marker. `shards` > 1 resolves the
+    per-shard (shard_map) dispatch of a tp mesh: the report's `shards`
+    key is how many kernel launches one engine dispatch fans into —
+    asserted (not assumed) by the virtual-mesh differential suite."""
     import os
 
+    # The interpret hook drives only the RAGGED branch on CPU (the
+    # decode/prefill serving dispatchers never see it from the engine),
+    # so the platform gate for those stays _on_tpu().
     on = _on_tpu()
-    eligible = gqa_kernel_eligible(k_cache, q_head_dim, on)
+    eligible = gqa_kernel_eligible(k_cache, q_head_dim, on, shards=shards)
 
     def resolve(env_name: str, kernel: str, fallback: str) -> str:
         env = os.environ.get(env_name)
@@ -894,7 +1072,7 @@ def resolved_kernel_report(
     ragged = (
         "ragged"
         if ragged_kernel_enabled(
-            k_cache, q_head_dim, interpret=ragged_interpret
+            k_cache, q_head_dim, interpret=ragged_interpret, shards=shards
         )
         else (
             "split (forced-off)"
@@ -917,6 +1095,10 @@ def resolved_kernel_report(
         "prefill": pf,
         "mixed": ragged,
         "mq": "mq" if mq_on else "blockwise",
+        # Kernel launches one engine dispatch fans into: tp under the
+        # shard_map tier, 1 on single-device meshes (or with the
+        # XLLM_SHARDED_KERNELS=0 escape hatch back to GSPMD).
+        "shards": shards,
     }
 
 
@@ -949,4 +1131,7 @@ def resolved_mla_kernel_report(c_cache) -> dict:
         "prefill": pf,
         "mixed": "split",
         "mq": "mla-mq" if (ok and mq_env == "1") else "blockwise",
+        # MLA's latent cache has no KV-head axis to shard — the kernels
+        # stay single-launch (docs/SHARDING.md).
+        "shards": 1,
     }

@@ -1,0 +1,200 @@
+"""Per-shard KV wire payloads for the sharded engine tier.
+
+Every KV movement plane — the PD handoff (monolithic and streamed
+chunks), the prefix-fabric `/kv/fetch`, and the coordinated-eviction
+re-homing frames — carries migration payloads shaped
+`[num_caches, L, n_blocks, Hc, BS, D]` with the cache-head axis (3)
+sharded over `tp` on a multi-chip engine (parallel/sharding.py
+kv_cache_sharding). Before this module, those planes shipped and landed
+the payload as ONE flat array: `np.asarray` on the sender was a
+cross-shard host GATHER, and the consumer re-sharded on import — two
+host↔device bounces per handoff that exist only because the wire format
+didn't know the cache was sharded.
+
+`ShardedKV` keeps the payload as per-shard pieces end-to-end:
+
+  * a tp=N holder exports N per-shard block sets (`to_host` reads each
+    shard's host copy straight off its own device — no gather);
+  * the frame protocol (api/protocol.py kv_frame_to_bytes/kv_frame_array)
+    serializes the pieces back-to-back with a `kv_shards` header;
+  * the consumer lands them with `assemble` /
+    `jax.make_array_from_callback` directly onto ITS
+    `kv_cache_sharding`-derived payload sharding (runtime/executor.py
+    migration_sharding) — `jax.device_put` per shard, no host concat
+    when the shard boundaries line up (the common same-tp PD pair), a
+    minimal per-boundary concat when they don't (tp=4 holder → tp=2
+    consumer).
+
+On a 1-device engine every function here degenerates to the old flat
+np.ndarray behavior, so unsharded deployments see byte-identical wires.
+`np.asarray(ShardedKV)` concatenates (compat escape for host tiers and
+tests); `.shape` is the LOGICAL full shape so every existing
+`migration_shape` gate keeps working unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+# The cache-head axis of a migration payload [num_caches, L, n, Hc, BS, D].
+HEAD_AXIS = 3
+
+
+class ShardedKV:
+    """A KV migration payload held as per-shard pieces along HEAD_AXIS.
+
+    `shards[i]` is the i-th tp shard's slice (host np.ndarray on the
+    wire; device pieces are converted by `to_host`). Supports the small
+    surface the KV planes actually use: `.shape`/`.dtype` (logical),
+    `np.asarray` (concat compat), leading-axis `__getitem__` (block
+    sub-selection, applied per shard), and `.nbytes`.
+    """
+
+    axis = HEAD_AXIS
+
+    def __init__(self, shards: Sequence[np.ndarray]):
+        if not shards:
+            raise ValueError("ShardedKV needs at least one shard")
+        self.shards: List[np.ndarray] = list(shards)
+
+    @property
+    def shape(self):
+        s0 = self.shards[0].shape
+        heads = sum(s.shape[self.axis] for s in self.shards)
+        return tuple(
+            heads if i == self.axis else d for i, d in enumerate(s0)
+        )
+
+    @property
+    def dtype(self):
+        return self.shards[0].dtype
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(np.asarray(s).nbytes) for s in self.shards)
+
+    @property
+    def head_sizes(self) -> List[int]:
+        return [int(s.shape[self.axis]) for s in self.shards]
+
+    def __getitem__(self, idx):
+        """Apply a leading-axes index (block sub-selection like
+        `kv[:, :, fresh]`) to every shard. The index must not touch the
+        head axis — the planes never do — and must not DROP an axis
+        (a bare integer would shift the head axis left and silently
+        corrupt `.shape`; use a length-1 slice/array instead)."""
+        entries = idx if isinstance(idx, tuple) else (idx,)
+        if len(entries) > self.axis:
+            raise IndexError(
+                "ShardedKV indexing must stay on the leading "
+                f"{self.axis} axes"
+            )
+        if any(isinstance(e, (int, np.integer)) for e in entries):
+            raise IndexError(
+                "ShardedKV rejects integer indices (they would remove "
+                "an axis and shift the head axis); use a slice or an "
+                "index array"
+            )
+        return ShardedKV([np.asarray(s)[idx] for s in self.shards])
+
+    def __array__(self, dtype=None, copy=None):
+        out = np.concatenate(
+            [np.asarray(s) for s in self.shards], axis=self.axis
+        )
+        return out.astype(dtype) if dtype is not None else out
+
+    def tobytes(self) -> bytes:
+        return b"".join(np.ascontiguousarray(s).tobytes() for s in self.shards)
+
+
+def to_host(kv):
+    """Device payload → host wire form WITHOUT a cross-shard gather.
+
+    A jax.Array sharded along HEAD_AXIS becomes a `ShardedKV` of each
+    shard's own host copy (replicas — a dp axis — are deduplicated; the
+    first addressable replica of each head range wins). Anything else
+    (np.ndarray, single-device/replicated arrays, an already-host
+    ShardedKV) passes through as the flat host array the old wire
+    carried."""
+    if isinstance(kv, ShardedKV):
+        return kv
+    shards = getattr(kv, "addressable_shards", None)
+    if shards is None or getattr(kv, "ndim", 0) <= HEAD_AXIS:
+        return np.asarray(kv)
+    # Two passes so the flat-array cases never pay an extra copy: first
+    # classify the layout from shard INDICES alone, and only when the
+    # head axis is genuinely split (>= 2 distinct ranges) read each
+    # piece's host copy — a single-shard/replicated array (the default
+    # tp=1 deployment) goes straight to the one np.asarray it always
+    # paid.
+    chosen = {}
+    for s in shards:
+        idx = s.index
+        # Only pure head-axis sharding rides the per-shard wire: any
+        # other partitioned axis (a sliced leading dim) means this
+        # payload isn't the KV-plane layout — gather and move on.
+        for ax, sl in enumerate(idx):
+            if ax != HEAD_AXIS and sl != slice(None, None, None):
+                return np.asarray(kv)
+        lo = idx[HEAD_AXIS].start or 0
+        if lo not in chosen:
+            chosen[lo] = s
+    if len(chosen) <= 1:
+        return np.asarray(kv)
+    pieces = {lo: np.asarray(s.data) for lo, s in chosen.items()}
+    covered = sum(p.shape[HEAD_AXIS] for p in pieces.values())
+    if covered != kv.shape[HEAD_AXIS]:
+        # Multi-process mesh: this process holds only some shards, so
+        # the per-shard wire can't be assembled here. np.asarray on a
+        # non-fully-addressable array RAISES — exactly what the
+        # pre-shard wire did on this path (the send machinery fails the
+        # session / errors the handoff); cross-host exports are a
+        # future arc, engines today are per-host.
+        return np.asarray(kv)
+    return ShardedKV([pieces[k] for k in sorted(pieces)])
+
+
+def assemble(kv, sharding):
+    """Land a wire payload directly onto a consumer sharding.
+
+    `kv` may be a ShardedKV (per-shard pieces), a host np.ndarray, or a
+    device array from another mesh. Returns a committed jax.Array with
+    `sharding`. For ShardedKV whose piece boundaries align with the
+    consumer's partition (the same-tp PD pair), each device's buffer is
+    fed from its own piece — no host concat of the full payload ever
+    materializes; mismatched boundaries concat only the pieces that
+    straddle them."""
+    import jax
+
+    if not isinstance(kv, ShardedKV):
+        arr = kv if isinstance(kv, jax.Array) else np.asarray(kv)
+        return jax.device_put(arr, sharding)
+    shards = [np.asarray(s) for s in kv.shards]
+    offs = np.cumsum([0] + [s.shape[HEAD_AXIS] for s in shards])
+    shape = kv.shape
+
+    def cb(index):
+        sl = index[HEAD_AXIS]
+        lo = sl.start or 0
+        hi = sl.stop if sl.stop is not None else shape[HEAD_AXIS]
+        parts = []
+        for i, s in enumerate(shards):
+            s_lo, s_hi = int(offs[i]), int(offs[i + 1])
+            if s_hi <= lo or s_lo >= hi:
+                continue
+            a, b = max(lo, s_lo) - s_lo, min(hi, s_hi) - s_lo
+            parts.append(
+                s[(slice(None),) * HEAD_AXIS + (slice(a, b),)]
+            )
+        arr = parts[0] if len(parts) == 1 else np.concatenate(
+            parts, axis=HEAD_AXIS
+        )
+        rest = tuple(
+            sl_ if ax != HEAD_AXIS else slice(None)
+            for ax, sl_ in enumerate(index)
+        )
+        return arr[rest]
+
+    return jax.make_array_from_callback(shape, sharding, cb)

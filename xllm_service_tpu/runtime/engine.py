@@ -2034,10 +2034,20 @@ class InferenceEngine:
     def _export_cached(self, hashes: List[bytes]):
         """Engine-thread export body: HBM blocks gather in ONE device
         export; host/SSD blocks read from their pools. Requested order is
-        preserved in the stacked result."""
+        preserved in the stacked result. On a tp-sharded executor an
+        all-HBM export stays PER-SHARD end-to-end (shard_wire.ShardedKV:
+        each tp shard's host copy reads off its own device — no
+        cross-shard gather; the /kv/fetch frame then ships N per-shard
+        block sets). Mixing in host/SSD-tier blocks — stored flat —
+        degrades that response to the flat layout."""
+        from xllm_service_tpu.parallel import shard_wire
+
         served: List[bytes] = []
         seen: Set[bytes] = set()
         arrays: Dict[bytes, np.ndarray] = {}
+        # Per-shard per-block pieces [nc, L, Hc/tp, BS, D] (head axis 2
+        # once the block axis is sliced away) for sharded HBM exports.
+        pieces: Dict[bytes, List[np.ndarray]] = {}
         hbm: List[Tuple[bytes, int]] = []
         for h in hashes:
             if h in seen:
@@ -2055,13 +2065,27 @@ class InferenceEngine:
                 arrays[h] = np.asarray(kv)
                 served.append(h)
         if hbm:
-            stacked = np.asarray(
+            stacked = shard_wire.to_host(
                 self.executor.export_blocks([b for _, b in hbm])
             )
-            for i, (h, _) in enumerate(hbm):
-                arrays[h] = stacked[:, :, i]
+            if isinstance(stacked, shard_wire.ShardedKV):
+                for i, (h, _) in enumerate(hbm):
+                    pieces[h] = [
+                        np.asarray(s)[:, :, i] for s in stacked.shards
+                    ]
+            else:
+                for i, (h, _) in enumerate(hbm):
+                    arrays[h] = stacked[:, :, i]
         if not served:
             return [], None
+        if pieces and not arrays:
+            nsh = len(next(iter(pieces.values())))
+            return served, shard_wire.ShardedKV([
+                np.stack([pieces[h][s] for h in served], axis=2)
+                for s in range(nsh)
+            ])
+        for h, pc in pieces.items():
+            arrays[h] = np.concatenate(pc, axis=2)
         return served, np.stack([arrays[h] for h in served], axis=2)
 
     # ------------------------------------------------- PD disaggregation

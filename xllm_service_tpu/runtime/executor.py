@@ -194,12 +194,20 @@ class ModelExecutor:
         )
         tp = self.mesh.shape.get("tp", 1)
         ep = self.mesh.shape.get("ep", 1)
+        # resolve_kv_packing downgraded the cache to the unpacked layout
+        # (tp doesn't divide the packed head count): decode runs the
+        # gather path, and the degradation must be VISIBLE — kernel_report
+        # marks it "gather-fallback" and the engine's
+        # xllm_engine_kernel_dispatch_total counts it under that label
+        # instead of burying one warning in the logs.
+        self.kv_pack_fallback = False
         if tp > 1 or ep > 1:
             check_tp_divisibility(self.cfg, tp, ep)
             # Packed head_dim<128 rows shard only when tp divides the
             # packed count; otherwise serve unpacked via the gather path.
             resolved = resolve_kv_packing(self.cfg, tp)
             if resolved is not self.cfg:
+                self.kv_pack_fallback = True
                 logging.getLogger(__name__).warning(
                     "tp=%d doesn't divide the packed KV-head count of %s "
                     "(Hkv=%d, D=%d): serving the UNPACKED cache layout — "
@@ -794,6 +802,7 @@ class ModelExecutor:
         """Speculative decode step. Returns (tokens [R, S], logprobs [R, S],
         n_emit [R]): each active row emits its first n_emit tokens (>= 1 —
         a verify step subsumes a plain decode step)."""
+        self._set_shard_ctx()
         if not hasattr(self, "_verify_jit"):
             self._verify_jit = jax.jit(
                 self._verify_impl, donate_argnums=(0, 1, 2)
@@ -927,6 +936,7 @@ class ModelExecutor:
         return results  # type: ignore[return-value]
 
     def _prefill_group(self, group: List["PrefillItem"]) -> List[Tuple[int, float]]:
+        self._set_shard_ctx()
         n_real = len(group)
         P = self._pow2_bucket(n_real, self.PREFILL_GROUP_MAX)
         Lpad = self.bucket_len(max(len(it.token_ids) for it in group))
@@ -1339,6 +1349,7 @@ class ModelExecutor:
         fresh_mask is False take their input token from `prev_tokens` —
         the previous step's device-resident sample — so the overlapped
         pipeline's autoregressive feedback never round-trips the host."""
+        self._set_shard_ctx()
         keys = sampling_ops.make_step_keys(
             jnp.asarray(batch.seeds, jnp.uint32),
             jnp.asarray(batch.steps, jnp.int32),
@@ -1424,10 +1435,41 @@ class ModelExecutor:
         (docs/KERNELS.md)."""
         return hasattr(self.model_mod, "mixed_step")
 
+    @property
+    def kernel_shards(self) -> int:
+        """How many per-shard kernel launches one attention dispatch fans
+        into (docs/SHARDING.md): tp under the shard_map tier, 1 on
+        single-device meshes, for MLA (latent cache replicated — nothing
+        to shard), or with the XLLM_SHARDED_KERNELS=0 escape hatch."""
+        from xllm_service_tpu.ops import attention
+
+        tp = self.mesh.shape.get("tp", 1)
+        if (
+            tp <= 1
+            or self.cfg.is_mla
+            or not attention.sharded_kernels_enabled()
+        ):
+            return 1
+        return tp
+
+    def _set_shard_ctx(self) -> None:
+        """Declare this executor's mesh as the calling thread's kernel
+        shard context (ops/attention.py) — called at every jitted-step
+        entry point so the trace (first call compiles) captures the
+        right mesh even with several executors in one process."""
+        from xllm_service_tpu.ops import attention
+
+        attention.set_shard_context(
+            None if self.cfg.is_mla else self.mesh
+        )
+
     def kernel_report(self) -> Dict[str, str]:
         """Resolved attention-dispatch decisions for THIS executor's cache
         and geometry — what bench.py reports instead of echoing raw env
-        vars (ISSUE 9 satellite)."""
+        vars (ISSUE 9 satellite). Includes the per-shard fan-out
+        (`shards`) and marks the resolve_kv_packing downgrade as
+        `gather-fallback` so a tp that strands the packed layout shows up
+        in bench rows and /metrics, not just a log line."""
         if self.cfg.is_mla:
             from xllm_service_tpu.ops.attention import (
                 resolved_mla_kernel_report,
@@ -1437,12 +1479,18 @@ class ModelExecutor:
             return resolved_mla_kernel_report(self.k_cache)
         from xllm_service_tpu.ops.attention import resolved_kernel_report
 
-        return resolved_kernel_report(
+        rep = resolved_kernel_report(
             self.k_cache, self.cfg.head_dim,
             ragged_interpret=(
                 os.environ.get("XLLM_RAGGED_INTERPRET") == "1"
             ),
+            shards=self.kernel_shards,
         )
+        if self.kv_pack_fallback and rep.get("decode", "").startswith(
+            "gather"
+        ):
+            rep["decode"] = "gather-fallback"
+        return rep
 
     def _mixed_impl(
         self,
@@ -1568,6 +1616,7 @@ class ModelExecutor:
         never reach here (routed to the split prefill path). Guided
         items DO ride (ISSUE 13): final chunks carry mask_row and the
         decode half takes batch.mask_rows — both applied in-graph."""
+        self._set_shard_ctx()
         R = self.R
         n_pf = len(items)
         P = self._pow2_bucket(max(n_pf, 1), self.PREFILL_GROUP_MAX)
@@ -2015,6 +2064,7 @@ class ModelExecutor:
         dispatch from these arrays (docs/ENGINE_PIPELINE.md). The
         context-bucket bound covers host positions + TWO steps of
         worst-case emission (the in-flight step's and this one's)."""
+        self._set_shard_ctx()
         R = self.R
         S = drafts.shape[1] + 1
         bs = self.block_size
@@ -2210,12 +2260,29 @@ class ModelExecutor:
             cd,
         )
 
+    def migration_sharding(self) -> NamedSharding:
+        """NamedSharding of a migration payload on THIS mesh: the
+        cache-head axis (3) over tp, exactly like the pool it came from /
+        lands into (kv_cache_sharding) — the landing target for
+        per-shard wire payloads and pull-plane fetches
+        (parallel/shard_wire.py). MLA latents replicate (no head axis);
+        on a 1-device mesh this is effectively a single-device placement
+        (the satellite's no-op case)."""
+        if self.cfg.is_mla or "tp" not in self.mesh.shape:
+            return NamedSharding(self.mesh, P())
+        return NamedSharding(
+            self.mesh, P(None, None, None, "tp", None, None)
+        )
+
     def export_blocks(self, block_ids: np.ndarray) -> jax.Array:
         """Gather KV blocks for migration to a peer instance (PD disagg).
         Returns [2, L, n, Hkv, bs, D] on device in MODEL dtype (int8 caches
         dequantize on export so the migration payload / host-tier format is
         dtype-stable); the transfer layer moves it over ICI/DCN
-        (jax.device_put to the peer mesh) or via host RPC."""
+        (jax.device_put to the peer mesh) or via host RPC. Under tp>1 the
+        export is COMMITTED to migration_sharding (heads per shard), so
+        the wire layer (shard_wire.to_host) can read per-shard host
+        copies without a cross-shard gather."""
         ids = jnp.asarray(block_ids, jnp.int32)
 
         def grab(cache):
@@ -2226,28 +2293,40 @@ class ModelExecutor:
             return cache.data[:, ids]
 
         caches = [self.k_cache, self.v_cache][: self.num_caches]
-        return jnp.stack([grab(c) for c in caches])
+        out = jnp.stack([grab(c) for c in caches])
+        if self.mesh.shape.get("tp", 1) > 1:
+            out = jax.device_put(out, self.migration_sharding())
+        return out
 
-    def import_blocks(self, blocks: jax.Array, block_ids: np.ndarray) -> None:
+    def import_blocks(self, blocks, block_ids: np.ndarray) -> None:
         """Scatter migrated/offloaded blocks into the caches IN PLACE (the
         jitted step donates both caches — without donation each import
         would copy the whole multi-GiB pool). Block count is padded to a
         power of two (duplicate trailing id, same data: benign re-write) so
-        compile count stays logarithmic."""
+        compile count stays logarithmic.
+
+        `blocks` may be a host array, a device array (in-process PD fast
+        path — possibly committed to ANOTHER executor's mesh), or a
+        per-shard `shard_wire.ShardedKV` off the wire; everything lands
+        directly onto this executor's migration_sharding (one
+        jax.device_put per shard — no host-side gather/reshard bounce,
+        and a no-op placement on 1-device meshes)."""
+        from xllm_service_tpu.parallel import shard_wire
+
         n = len(block_ids)
-        P = 1
-        while P < n:
-            P *= 2
-        ids = np.empty((P,), np.int32)
+        P2 = 1
+        while P2 < n:
+            P2 *= 2
+        ids = np.empty((P2,), np.int32)
         ids[:n] = block_ids
         ids[n:] = block_ids[n - 1] if n else 0
         # One device-side pad for both payload kinds: host (HTTP/DCN, tier
         # re-import) payloads transfer UNPADDED and pad on device; the
         # in-process PD fast path is already device-resident (no host
         # round-trip anywhere in the import).
-        arr = jnp.asarray(blocks)
-        if P != n:
-            pad = jnp.repeat(arr[:, :, -1:], P - n, axis=2)
+        arr = shard_wire.assemble(blocks, self.migration_sharding())
+        if P2 != n:
+            pad = jnp.repeat(arr[:, :, -1:], P2 - n, axis=2)
             arr = jnp.concatenate([arr, pad], axis=2)
         self.k_cache, self.v_cache = self._import_jit(
             self.k_cache, self.v_cache, arr, jnp.asarray(ids)

@@ -118,18 +118,34 @@ class KVTransferServer:
                     del self._conns[addr]
             raise
 
-    def pull_single(self, addr: str, uuid: int, shape, dtype) -> Any:
-        """Pull one array onto this process's first LOCAL device (the
-        common single-chip PD-pair case; a sharded consumer reshards
-        under its own jit)."""
+    def pull_single(self, addr: str, uuid: int, shape, dtype,
+                    sharding=None) -> Any:
+        """Pull one array straight onto `sharding` — the consumer
+        executor's migration_sharding for KV payloads, so a tp-sharded
+        consumer lands the pull on its own kv_cache_sharding layout
+        instead of bouncing through one device and resharding later
+        (1-device consumers pass None and keep the old single-device
+        landing). If the transfer transport cannot serve the sharded
+        aval (older jaxlib), the pull falls back to the single-device
+        landing and a `jax.device_put` onto `sharding` — still one
+        device-side hop, never a host bounce."""
         import jax
         from jax.sharding import SingleDeviceSharding
 
-        aval = jax.ShapeDtypeStruct(
-            tuple(shape), dtype,
-            sharding=SingleDeviceSharding(jax.local_devices()[0]),
-        )
-        return self.pull(addr, uuid, [aval])[0]
+        single = SingleDeviceSharding(jax.local_devices()[0])
+        if sharding is not None:
+            try:
+                aval = jax.ShapeDtypeStruct(
+                    tuple(shape), dtype, sharding=sharding
+                )
+                return self.pull(addr, uuid, [aval])[0]
+            except (TypeError, ValueError, NotImplementedError):
+                pass
+        aval = jax.ShapeDtypeStruct(tuple(shape), dtype, sharding=single)
+        out = self.pull(addr, uuid, [aval])[0]
+        if sharding is not None:
+            out = jax.device_put(out, sharding)
+        return out
 
     def retract_later(self, uuid: int, delay_s: float = 120.0) -> None:
         """Drop an offer's keepalive AFTER the peer's possible pull window
