@@ -251,6 +251,75 @@ def _cpu_regression_guard(line: str) -> "tuple[str, int]":
     return json.dumps(res), rc
 
 
+# Grouped-MoE A/B guard (--moe both, ISSUE 15): the grouped ragged
+# expert dispatch must hold at least this fraction of the dense
+# all-experts einsum's decode throughput — the dispatch that exists to
+# make compute track ACTIVE params can never be allowed to regress
+# silently. Armed only when the grouped row actually RESOLVED to the
+# Pallas "grouped" dispatch (docs/MOE.md) — on CPU the row runs the
+# blockwise oracle ("grouped-ref"), whose job is parity, not speed.
+_MOE_MIN_RATIO = float(os.environ.get("XLLM_BENCH_MOE_MIN_RATIO", 0.95))
+
+
+def _moe_guard(line: str) -> "tuple[str, int]":
+    """Exit-3 guard for the --moe A/B rows; abstains LOUDLY on a
+    dispatch mismatch (the engine_spec_guard builder-mismatch
+    pattern)."""
+    try:
+        res = json.loads(line)
+    except ValueError:
+        return line, 0
+    mb = res.get("moe_bench") or {}
+    if not isinstance(mb, dict) or "grouped" not in mb or "dense" not in mb:
+        return line, 0
+    try:
+        d = float(mb["dense"]["tok_s"])
+        g = float(mb["grouped"]["tok_s"])
+    except (KeyError, TypeError, ValueError):
+        d = g = 0.0
+    disp = (
+        mb["grouped"].get("moe_dispatch"),
+        mb["dense"].get("moe_dispatch"),
+    )
+    if disp[0] != "grouped" or str(disp[1] or "").startswith("grouped"):
+        res["engine_moe_guard"] = (
+            f"abstained: moe_dispatch {disp[0]}/{disp[1]} — the grouped "
+            f"row must run the Pallas grouped dispatch and the dense row "
+            f"the all-experts einsum (CPU resolves grouped-ref: parity "
+            f"is tier-1's tests/test_moe_engine.py; the floor arms on "
+            f"TPU)"
+        )
+        return json.dumps(res), 0
+    if mb["grouped"].get("moe_interpret") or mb["dense"].get(
+        "moe_interpret"
+    ):
+        # XLLM_MOE_INTERPRET rows time the Pallas INTERPRETER against
+        # compiled XLA — a guaranteed sub-floor ratio that says nothing
+        # about the chip; a CI host exporting the hook must not fail
+        # the bench.
+        res["engine_moe_guard"] = (
+            "abstained: XLLM_MOE_INTERPRET is set — interpret-mode "
+            "rows measure the interpreter, not the dispatch"
+        )
+        return json.dumps(res), 0
+    if d <= 0 or g <= 0:
+        # Still loud: a harness refactor that loses tok_s must not make
+        # the guard silently vanish.
+        res["engine_moe_guard"] = (
+            f"abstained: unparseable tok_s (grouped={g}, dense={d})"
+        )
+        return json.dumps(res), 0
+    if g >= _MOE_MIN_RATIO * d:
+        res["engine_moe_guard"] = "ok"
+        return json.dumps(res), 0
+    res["engine_moe_guard"] = (
+        f"FAIL: grouped MoE dispatch {g:.1f} tok/s is below "
+        f"{100 * _MOE_MIN_RATIO:.0f}% of the dense all-experts path "
+        f"{d:.1f}"
+    )
+    return json.dumps(res), 3
+
+
 # Sharded-decode roofline guard (--mesh, ROADMAP item 3): on TPU a
 # tp-sharded decode must land at least this fraction of its analytic
 # per-shard roofline expectation — a GSPMD-replicated kernel or a silent
@@ -368,6 +437,22 @@ def main() -> None:
                 f"--spec-mode must be composed|sync|both, got {spec_mode!r}"
             )
 
+    # --moe {grouped,dense,both}: the MoE dispatch A/B (ISSUE 15) — the
+    # grouped ragged expert dispatch vs the dense all-experts einsum on
+    # the MoE tiny model at matched active params. Default "both"
+    # reports the pair and arms the engine_moe_guard.
+    moe_mode = "both"
+    if "--moe" in sys.argv:
+        idx = sys.argv.index("--moe") + 1
+        nxt = sys.argv[idx] if idx < len(sys.argv) else ""
+        if nxt in ("grouped", "dense", "both"):
+            moe_mode = nxt
+        elif nxt and not nxt.startswith("-"):
+            raise SystemExit(
+                f"--moe takes grouped|dense|both, got {nxt!r}"
+            )
+        # bare `--moe` (or followed by another flag) = "both"
+
     backend = _probe_backend()
     on_tpu = backend == "tpu"
     # Fastest config first; fall back if a path that never ran on real
@@ -390,7 +475,7 @@ def main() -> None:
         rc, out, err = _run_attempt_subprocess(
             dict(attempt, engine_mode=engine_mode,
                  attention_mode=attention_mode, spec_mode=spec_mode,
-                 mesh=list(mesh), _on_tpu=on_tpu)
+                 moe_mode=moe_mode, mesh=list(mesh), _on_tpu=on_tpu)
         )
         line = ""
         for ln in out.splitlines():
@@ -399,7 +484,8 @@ def main() -> None:
         if rc == 0 and line:
             line, guard_rc = _cpu_regression_guard(line)
             line, mesh_rc = _mesh_guard(line)
-            guard_rc = guard_rc or mesh_rc
+            line, moe_rc = _moe_guard(line)
+            guard_rc = guard_rc or mesh_rc or moe_rc
             print(line)
             if guard_rc:
                 print(
@@ -418,7 +504,9 @@ def main() -> None:
     raise SystemExit(f"all bench configs failed: {last_err}")
 
 
-def _engine_bench(sync: bool, mixed: bool = True, spec: int = 0) -> dict:
+def _engine_bench(sync: bool, mixed: bool = True, spec: int = 0,
+                  model: str = "llama3-tiny",
+                  moe: "str | None" = None) -> dict:
     """Full-InferenceEngine decode throughput (llama3-tiny, R=8) in one
     stepping mode: R seeded requests driven to completion through the real
     admission/decode/emit path. Reports tokens/s plus the pipeline
@@ -428,7 +516,11 @@ def _engine_bench(sync: bool, mixed: bool = True, spec: int = 0) -> dict:
     (`mixed` stepping, docs/KERNELS.md), and the RESOLVED attention
     kernel the engine's dispatches actually route to. `spec` > 0 runs
     the same harness under speculative decoding (the ISSUE 13 combined
-    path: sync/mixed then select composed vs sync+split verify)."""
+    path: sync/mixed then select composed vs sync+split verify).
+    `moe` pins the MoE dispatch for the --moe A/B (ISSUE 15):
+    "grouped" sets XLLM_MOE_KERNEL=1 around the run, "dense" =0 — the
+    row reports the dispatch the executor actually RESOLVED (the guard
+    abstains when the grouped row ran the oracle, e.g. on CPU)."""
     import numpy as np
 
     from xllm_service_tpu.common.config import EngineConfig
@@ -436,9 +528,25 @@ def _engine_bench(sync: bool, mixed: bool = True, spec: int = 0) -> dict:
     from xllm_service_tpu.runtime.engine import EngineRequest, InferenceEngine
     from xllm_service_tpu.runtime.executor import ModelExecutor
 
+    if moe is not None:
+        # Pin the dispatch around the WHOLE run (env is read at trace
+        # time; later bucket shapes retrace mid-run) and restore — a
+        # later A/B row must not inherit the override.
+        prev_moe_env = os.environ.get("XLLM_MOE_KERNEL")
+        os.environ["XLLM_MOE_KERNEL"] = "1" if moe == "grouped" else "0"
+        try:
+            row = _engine_bench(sync, mixed=mixed, spec=spec, model=model)
+            row["moe_mode"] = moe
+            return row
+        finally:
+            if prev_moe_env is None:
+                os.environ.pop("XLLM_MOE_KERNEL", None)
+            else:
+                os.environ["XLLM_MOE_KERNEL"] = prev_moe_env
+
     R, prompt_len, new_tokens = 8, 32, 48
     cfg = EngineConfig(
-        model="llama3-tiny",
+        model=model,
         dtype="float32",
         block_size=16,
         num_blocks=64,
@@ -546,6 +654,19 @@ def _engine_bench(sync: bool, mixed: bool = True, spec: int = 0) -> dict:
         "requests": R,
         "new_tokens": new_tokens,
     }
+    if getattr(eng.executor.cfg, "is_moe", False):
+        # Resolved MoE dispatch + the expert-load signal (ISSUE 15):
+        # the guard keys on moe_dispatch, not the env var — and on the
+        # interpret hook, whose rows measure the interpreter.
+        rep = eng.executor.kernel_report()
+        row["moe_dispatch"] = rep.get("moe")
+        row["moe_shards"] = rep.get("moe_shards", 1)
+        row["moe_interpret"] = (
+            os.environ.get("XLLM_MOE_INTERPRET") == "1"
+        )
+        stats = eng.executor.moe_stats(drain=True)
+        row["moe_hot_expert_frac"] = round(stats["hot_expert_frac"], 3)
+        row["moe_dropped_assignments"] = stats["dropped"]
     if spec:
         # Realized speculative speedup + how the verify steps routed —
         # deltas over the timed repeats only, like the other counters
@@ -568,6 +689,7 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
          engine_mode: str = "both",
          attention_mode: str = "both",
          spec_mode: str = "both",
+         moe_mode: str = "both",
          mesh=(1, 1, 1)) -> None:
     import jax
 
@@ -586,10 +708,16 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
         # int8 W8+KV8; the CPU virtual mesh runs the tp-shardable tiny
         # geometry (Hkv=8 divides every tp; llama3-tiny's Hkv=2 caps at
         # tp=2) so shard-aware rows exist before a chip window opens.
-        model = os.environ.get(
-            "XLLM_BENCH_MESH_MODEL",
-            "llama3-70b" if on_tpu else "llama3-shard-tiny",
-        )
+        # An ep axis (--mesh d,t,e with e>1) selects the MoE workload —
+        # the `ep` axis is only real when experts shard over it
+        # (ISSUE 15, docs/MOE.md).
+        if ep > 1:
+            default_model = (
+                "qwen3-30b-a3b" if on_tpu else "moe-shard-tiny"
+            )
+        else:
+            default_model = "llama3-70b" if on_tpu else "llama3-shard-tiny"
+        model = os.environ.get("XLLM_BENCH_MESH_MODEL", default_model)
     R = 64 if on_tpu else 8
     prompt_len = 512 if on_tpu else 32
     decode_steps = 128 if on_tpu else 8
@@ -875,6 +1003,33 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
                     spec=3,
                 )
 
+        # MoE dispatch A/B (--moe, ISSUE 15): the grouped ragged expert
+        # dispatch vs the dense all-experts einsum on moe-shard-tiny —
+        # same model, same router, matched active params; only the
+        # dispatch strategy differs. UNLIKE the other engine A/B
+        # sections this also runs on TPU (n_dev == 1): that is the only
+        # backend where the grouped row resolves to the Pallas kernel,
+        # so gating it CPU-only would leave engine_moe_guard permanently
+        # dead on the one backend it exists for. engine_moe_guard
+        # (exit 3) arms on the resolved `grouped` dispatch and abstains
+        # loudly otherwise (CPU runs the grouped-ref oracle — parity is
+        # tier-1's job there — and the interpret hook measures the
+        # interpreter, never the chip).
+        moe_bench = None
+        if (
+            n_dev == 1
+            and not os.environ.get("XLLM_BENCH_SKIP_ENGINE_AB")
+        ):
+            moe_bench = {}
+            mmodes = (
+                ("grouped", "dense") if moe_mode == "both"
+                else (moe_mode,)
+            )
+            for m in mmodes:
+                moe_bench[m] = _engine_bench(
+                    sync=False, model="moe-shard-tiny", moe=m,
+                )
+
         xla_cost = None
         if os.environ.get("XLLM_BENCH_XLA_COST"):
             try:
@@ -946,6 +1101,16 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
             # docs/ENGINE_PIPELINE.md).
             "spec_bench": spec_bench,
             "spec_mode": spec_mode,
+            # MoE dispatch A/B (--moe): grouped ragged expert dispatch
+            # vs dense all-experts at matched active params —
+            # engine_moe_guard (exit 3) enforces the floor when the
+            # Pallas dispatch actually ran (ISSUE 15, docs/MOE.md).
+            "moe_bench": moe_bench,
+            "moe_mode": moe_mode,
+            # The MoE dispatch THIS bench's main model resolved (None
+            # for dense models).
+            "moe_kernel": kernel_rep.get("moe"),
+            "moe_shards": kernel_rep.get("moe_shards"),
             # Methodology markers: median of N repeats, the per-repeat
             # spread, and the host's 1-min load average around the run —
             # a hot host shows up here instead of masquerading as a

@@ -705,6 +705,31 @@ class TestShardingRules:
         )
         assert len(fs) == 1 and "sharding.py" in fs[0].message
 
+    def test_helper_created_leaf_trips(self):
+        # deepseek builds its whole per-layer leaf dict (the MoE
+        # expert/router leaves included) in _layer_stack — the pass must
+        # walk init_params' local-call closure, or a new expert leaf
+        # added out of line would silently replicate (ISSUE 15).
+        src = (
+            "def _layer_stack(cfg, key):\n"
+            "    layers = {'wq': 1}\n"
+            "    layers.update({'w_expert_bias': 1})\n"
+            "    return layers\n"
+            "def init_params(cfg, key, dtype):\n"
+            "    return {'embed': 1, 'layers': _layer_stack(cfg, key)}\n"
+        )
+        fs = ShardingRulesPass().run(self._proj(src))
+        assert len(fs) == 1 and "w_expert_bias" in fs[0].message
+
+    def test_helper_created_ruled_leaf_clean(self):
+        src = (
+            "def _layer_stack(cfg, key):\n"
+            "    return {'wq': 1, 'w_gate': 1, 'wo': 1}\n"
+            "def init_params(cfg, key, dtype):\n"
+            "    return {'embed': 1, 'layers': _layer_stack(cfg, key)}\n"
+        )
+        assert ShardingRulesPass().run(self._proj(src)) == []
+
 
 # ---------------------------------------------------------------------------
 # the real tree: repo-wide zero findings (tier-1 acceptance)

@@ -680,6 +680,14 @@ def test_e2e_evict_offer_rehomes_last_replica(fabric_oracle):
             )
         )
         # Chaos: a dropped offer just lets blocks die (no error, no hang).
+        # Quiesce the offer pipeline BEFORE snapshotting the counter and
+        # installing the plan: phase-1 offers still in flight (engine
+        # evictions draining, worker batches mid-HTTP) would otherwise
+        # land AFTER offers0 and fail the ==-assert — the 5/8 timing
+        # flake PR 12 review flagged; the deadline-bounded barrier
+        # replaces the old sleep/poll race.
+        assert wait_until(lambda: not i0.engine.has_work(), timeout=15.0)
+        assert i0.fabric_evict_quiesce(15.0), "evict offers never drained"
         offers0 = int(
             i0.metrics.get("xllm_fabric_evict_offers_total").get()
         )
@@ -696,7 +704,10 @@ def test_e2e_evict_offer_rehomes_last_replica(fabric_oracle):
                     timeout=300.0,
                 )
                 assert code == 200
-            time.sleep(0.5)
+            assert wait_until(
+                lambda: not i0.engine.has_work(), timeout=15.0
+            )
+            assert i0.fabric_evict_quiesce(15.0)
             assert int(
                 i0.metrics.get("xllm_fabric_evict_offers_total").get()
             ) == offers0

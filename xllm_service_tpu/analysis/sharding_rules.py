@@ -15,10 +15,15 @@ a mesh.
 
 Mechanics: collect every string key assigned into the param tree by the
 model modules' `init_params` functions (dict literals, `d["k"] = ...`,
-`d.update({...})` — the only forms the families use), and every key
-`param_shardings` assigns a spec for in parallel/sharding.py, then
-require model-keys ⊆ rule-keys. Keys that are runtime-installed with
-explicit shardings (the multi-LoRA `lora_<proj>_{a,b}` stacks from
+`d.update({...})` — the only forms the families use) AND by any
+module-local helper init_params calls, transitively — deepseek's
+`_layer_stack` builds the whole per-layer leaf dict (attention + the
+MoE expert/router/shared-expert leaves) out of line, and a pass that
+stopped at the init_params body would wave through exactly the
+expert-axis leaves the EP tier must shard (ISSUE 15). Then require
+model-keys ⊆ the keys `param_shardings` assigns a spec for in
+parallel/sharding.py. Keys that are runtime-installed with explicit
+shardings (the multi-LoRA `lora_<proj>_{a,b}` stacks from
 set_lora_adapters) are exempt by prefix.
 """
 
@@ -84,6 +89,36 @@ def _functions(tree: ast.Module, name: str) -> List[ast.AST]:
     ]
 
 
+def _module_functions(tree: ast.Module):
+    return {
+        n.name: n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _collect_keys_transitive(tree: ast.Module, root: ast.AST) -> Set[str]:
+    """Keys assigned by `root` plus every module-local function it calls
+    (transitively): init_params delegating its leaf dict to a helper
+    (_layer_stack) must not hide leaves from the pass."""
+    fns = _module_functions(tree)
+    keys: Set[str] = set()
+    seen: Set[str] = set()
+    stack = [root]
+    while stack:
+        fn = stack.pop()
+        keys |= _collect_assigned_keys(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Name
+            ):
+                callee = node.func.id
+                if callee in fns and callee not in seen:
+                    seen.add(callee)
+                    stack.append(fns[callee])
+    return keys
+
+
 class ShardingRulesPass(LintPass):
     id = "sharding-rules"
     title = "model param leaves vs parallel/sharding.py partition rules"
@@ -116,7 +151,7 @@ class ShardingRulesPass(LintPass):
             if src.tree is None:
                 continue
             for fn in _functions(src.tree, "init_params"):
-                for key in sorted(_collect_assigned_keys(fn)):
+                for key in sorted(_collect_keys_transitive(src.tree, fn)):
                     if key in rule_keys:
                         continue
                     if any(key.startswith(p) for p in EXEMPT_PREFIXES):

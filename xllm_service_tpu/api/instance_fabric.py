@@ -71,7 +71,7 @@ FETCH_MAX_CONCURRENT = 8
 
 
 class FabricMixin:
-    def _init_fabric(self) -> None:
+    def _init_fabric(self) -> None:  # graftlint: init-only
         """Fabric state + observability. Called from InstanceServer
         .__init__ once self.metrics and self.engine exist."""
         from xllm_service_tpu.obs import LATENCY_BUCKETS_MS
@@ -83,6 +83,13 @@ class FabricMixin:
             maxsize=EVICT_QUEUE_CAP
         )
         self._fabric_evict_thread = None
+        # Offers accepted into the queue but not yet fully processed
+        # (batch HTTP round-trips included). fabric_evict_quiesce waits
+        # on this instead of sleep/polling the metrics counter — the
+        # PR-12-flagged evict-offer e2e race was an offers0 snapshot
+        # taken while phase-1 offers were still in flight.
+        self._fabric_evict_cond = threading.Condition()
+        self._fabric_evict_pending = 0  # guarded by: self._fabric_evict_cond
         self._m_fabric_fetches = self.metrics.counter(
             "xllm_fabric_fetches_total",
             "Peer prefix fetches started (requester side)",
@@ -329,12 +336,14 @@ class FabricMixin:
         without the fabric)."""
         if not self._fabric_enabled() or self._master is None:
             return
-        try:
-            self._fabric_evict_q.put_nowait(
-                (bytes(block_hash), np.ascontiguousarray(kv))
-            )
-        except queue.Full:
-            return
+        with self._fabric_evict_cond:
+            try:
+                self._fabric_evict_q.put_nowait(
+                    (bytes(block_hash), np.ascontiguousarray(kv))
+                )
+            except queue.Full:
+                return
+            self._fabric_evict_pending += 1
         self._fabric_evict_start()
 
     def _fabric_evict_start(self) -> None:
@@ -372,6 +381,21 @@ class FabricMixin:
                 self._fabric_offer_batch(batch)
             except Exception:  # noqa: BLE001 — offers are best-effort
                 logger.debug("fabric evict offer failed", exc_info=True)
+            finally:
+                with self._fabric_evict_cond:
+                    self._fabric_evict_pending -= len(batch)
+                    self._fabric_evict_cond.notify_all()
+
+    def fabric_evict_quiesce(self, timeout: float = 10.0) -> bool:
+        """Deadline-bounded wait until every evict offer accepted so far
+        has been FULLY processed (batch shipped or dropped, metrics
+        settled) — the race-free barrier the e2e suite uses before
+        snapshotting offer counters or installing fault plans, replacing
+        sleep/poll. Returns False on timeout."""
+        with self._fabric_evict_cond:
+            return self._fabric_evict_cond.wait_for(
+                lambda: self._fabric_evict_pending == 0, timeout=timeout
+            )
 
     def _fabric_offer_batch(self, batch) -> None:
         """Ask the master where (whether) this batch of last-tier victims

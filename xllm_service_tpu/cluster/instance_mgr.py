@@ -695,10 +695,15 @@ class InstanceMgr:
             return candidates[best]
 
     def get_load_metrics(self) -> Dict[str, LoadMetrics]:
-        """Snapshot for policy scoring (reference: instance_mgr.cpp:217-286)."""
+        """Snapshot for policy scoring (reference: instance_mgr.cpp:217-286).
+        dataclasses.replace copies EVERY field — a positional rebuild
+        silently zeroed fields added later (the MoE expert-hotness
+        signal, ISSUE 15)."""
+        import dataclasses
+
         with self._mu:
             return {
-                n: LoadMetrics(m.waiting_requests_num, m.gpu_cache_usage_perc)
+                n: dataclasses.replace(m)
                 for n, m in self._load_metrics.items()
             }
 

@@ -94,11 +94,18 @@ class LoadMetrics:
 
     waiting_requests_num: int = 0
     gpu_cache_usage_perc: float = 0.0
+    # Hottest expert's share of routed MoE assignments (0.0 for dense
+    # models / grouped dispatch off) — the expert-hotness signal the
+    # master's routing can weigh next to cache hits (ISSUE 15,
+    # docs/MOE.md). Optional on the wire: old-build instances simply
+    # report 0.0.
+    moe_hot_expert_frac: float = 0.0
 
     def to_json(self) -> Dict[str, Any]:
         return {
             "waiting_requests_num": self.waiting_requests_num,
             "gpu_cache_usage_perc": self.gpu_cache_usage_perc,
+            "moe_hot_expert_frac": self.moe_hot_expert_frac,
         }
 
     @classmethod
@@ -106,6 +113,7 @@ class LoadMetrics:
         return cls(
             waiting_requests_num=int(j["waiting_requests_num"]),
             gpu_cache_usage_perc=float(j["gpu_cache_usage_perc"]),
+            moe_hot_expert_frac=float(j.get("moe_hot_expert_frac", 0.0)),
         )
 
 

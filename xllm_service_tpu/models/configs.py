@@ -396,6 +396,32 @@ register(
 )
 
 register(
+    # The MoE serving differential geometry (docs/MOE.md): llama3-
+    # shard-tiny's kernel-eligible attention dims (Hkv=8, D=128 — every
+    # tp ∈ {1, 2, 4, 8} divides, every Pallas path eligible per-shard)
+    # plus an 8-expert top-2 MoE whose dims keep every tp×ep
+    # combination eligible too: X=8 divides ep ∈ {1, 2, 4, 8},
+    # E=128 and Fm=256 are 128-lane multiples (the grouped-dispatch
+    # kernel gate), and Fm%tp holds through tp=2. CPU-runnable; the
+    # same shape class as the qwen3-30b-a3b / deepseek-v3 EP serving
+    # layouts, just tiny.
+    ModelConfig(
+        name="moe-shard-tiny",
+        vocab_size=512,
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=16,
+        num_kv_heads=8,
+        head_dim=128,
+        num_experts=8,
+        num_experts_per_tok=2,
+        moe_intermediate_size=256,
+        max_position_embeddings=1024,
+    )
+)
+
+register(
     ModelConfig(
         name="qwen3-moe-tiny",
         vocab_size=512,

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from xllm_service_tpu.models.configs import ModelConfig
-from xllm_service_tpu.models.llama import _mlp, _unembed
+from xllm_service_tpu.models.llama import _mlp, _mlp_block, _unembed
 from xllm_service_tpu.ops import kv_cache as kv_cache_ops
 from xllm_service_tpu.ops.attention import (
     mla_paged_attention,
@@ -313,7 +313,7 @@ def decode_step(
             )
             x = x + _attn_out(lp, cfg, ctx)
             h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-            x = x + _mlp(lp, mcfg, h)
+            x = x + _mlp_block(lp, mcfg, h, rows_valid=active)
             return x, (c_l, v_l)
 
         return layer_fn
@@ -387,7 +387,7 @@ def prefill_batch_step(
             )  # [P, Lpad, Hq, kvr] — flash kernel on TPU
             x = x + _attn_out(lp, cfg, ctx)
             h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-            x = x + jax.vmap(lambda t: _mlp(lp, mcfg, t))(h)
+            x = x + _mlp_block(lp, mcfg, h, rows_valid=valid)
             return x, (c_l, v_l)
 
         return layer_fn
@@ -421,8 +421,13 @@ def hidden_dense(
     params: Params,
     cfg: ModelConfig,
     token_ids: jnp.ndarray,  # [B, L]
+    rows_valid: jnp.ndarray | None = None,  # accepted for surface parity
 ) -> jnp.ndarray:
-    """Final-norm hidden states [B, L, E] (the /v1/embeddings path)."""
+    """Final-norm hidden states [B, L, E] (the /v1/embeddings path).
+    `rows_valid` is accepted for function-surface parity with
+    models/llama.py but unused: this naive forward is the MLA
+    correctness oracle and keeps the dense MoE combine (its vmapped
+    per-sequence body cannot host the grouped dispatch's shard_map)."""
     B, L = token_ids.shape
     dn, dr = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
     kvr = cfg.kv_lora_rank

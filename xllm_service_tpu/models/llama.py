@@ -35,6 +35,7 @@ from xllm_service_tpu.ops.attention import (
 )
 from xllm_service_tpu.ops.norms import rms_norm
 from xllm_service_tpu.ops import lora as lora_ops
+from xllm_service_tpu.ops import moe as moe_ops
 from xllm_service_tpu.ops.quant import wdtype, wt
 from xllm_service_tpu.ops import rope as rope_ops
 
@@ -154,10 +155,10 @@ def _project(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
 
 
 def _act(cfg: ModelConfig):
-    """Gated-MLP activation: SwiGLU (default) or Gemma's GELU-tanh."""
-    if cfg.mlp_act == "gelu_tanh":
-        return lambda t: jax.nn.gelu(t, approximate=True)
-    return jax.nn.silu
+    """Gated-MLP activation: SwiGLU (default) or Gemma's GELU-tanh —
+    delegated to the one shared selector (ops/moe.py) so the dense,
+    oracle, and kernel MoE paths can never drift."""
+    return moe_ops._act_fn(cfg.mlp_act)
 
 
 def _embed(params: Params, cfg: ModelConfig, token_ids, wd) -> jnp.ndarray:
@@ -191,7 +192,34 @@ def _mlp(
     # (parallel/sharding.py), the XLA SPMD partitioner keeps each device's
     # expert compute local and inserts one psum for the combine — the EP
     # serving path, with no gather that would force an all-gather of
-    # [T, X, E] activations.
+    # [T, X, E] activations. (The grouped ragged dispatch — compute
+    # tracking ACTIVE params — is the XLLM_MOE_KERNEL path in
+    # _mlp_block; this dense all-experts combine is the default and the
+    # semantic reference, docs/MOE.md.)
+    topi, weights = moe_route(lp, cfg, x)
+    T, X = x.shape[0], cfg.num_experts
+    combine = jnp.zeros((T, X), jnp.float32)
+    combine = combine.at[
+        jnp.arange(T, dtype=jnp.int32)[:, None], topi
+    ].set(weights)  # [T, X]: top-k combine weight or 0
+    gate = jnp.einsum("te,xef->txf", x, wt(lp["w_gate"]))
+    up = jnp.einsum("te,xef->txf", x, wt(lp["w_up"]))
+    expert_out = jnp.einsum(
+        "txf,xfe->txe", _act(cfg)(gate) * up, wt(lp["w_down"])
+    )
+    out = jnp.einsum("txe,tx->te", expert_out, combine.astype(expert_out.dtype))
+    if cfg.n_shared_experts > 0:
+        out = out + _shared_experts(lp, x)
+    return out
+
+
+def moe_route(lp, cfg: ModelConfig, x: jnp.ndarray):
+    """Router top-k selection + combine weights, x [T, E] ->
+    (topi [T, k] int32, weights [T, k] f32). THE routing semantics —
+    shared verbatim by the dense all-experts combine (_mlp) and the
+    grouped ragged dispatch (_moe_grouped), so flipping the dispatch
+    strategy can never change which experts serve a token or at what
+    weight."""
     logits = jnp.einsum(
         "te,ex->tx", x.astype(jnp.float32), lp["router"].astype(jnp.float32)
     )
@@ -236,25 +264,75 @@ def _mlp(
         v3_style or not cfg.norm_topk_prob
     ):
         weights = weights * cfg.routed_scaling_factor
-    combine = jnp.zeros((T, X), jnp.float32)
-    combine = combine.at[
-        jnp.arange(T, dtype=jnp.int32)[:, None], topi
-    ].set(weights)  # [T, X]: top-k combine weight or 0
-    gate = jnp.einsum("te,xef->txf", x, wt(lp["w_gate"]))
-    up = jnp.einsum("te,xef->txf", x, wt(lp["w_up"]))
-    expert_out = jnp.einsum(
-        "txf,xfe->txe", _act(cfg)(gate) * up, wt(lp["w_down"])
+    return topi, weights
+
+
+def _shared_experts(lp, x: jnp.ndarray) -> jnp.ndarray:
+    """DeepSeek-style always-active shared expert(s): a dense SwiGLU of
+    n_shared * moe_intermediate width alongside the routed experts."""
+    sg = jnp.einsum("te,ef->tf", x, wt(lp["w_sh_gate"]))
+    su = jnp.einsum("te,ef->tf", x, wt(lp["w_sh_up"]))
+    return jnp.einsum(
+        "tf,fe->te", jax.nn.silu(sg) * su, wt(lp["w_sh_down"])
     )
-    out = jnp.einsum("txe,tx->te", expert_out, combine.astype(expert_out.dtype))
+
+
+def _moe_grouped(
+    lp, cfg: ModelConfig, x: jnp.ndarray, row_mask=None
+) -> jnp.ndarray:
+    """MoE block via the grouped ragged expert dispatch (ops/moe.py —
+    the XLLM_MOE_KERNEL serving path, ISSUE 15): exact _mlp routing
+    (moe_route), ONE grouped launch per expert slice (shard_map over ep
+    under an executor shard context), dense shared-expert tail."""
+    topi, weights = moe_route(lp, cfg, x)
+    out = moe_ops.grouped_moe(
+        x, topi, weights,
+        wt(lp["w_gate"]), wt(lp["w_up"]), wt(lp["w_down"]),
+        act=cfg.mlp_act, row_mask=row_mask,
+    )
     if cfg.n_shared_experts > 0:
-        # DeepSeek-style always-active shared expert(s): a dense SwiGLU of
-        # n_shared * moe_intermediate width alongside the routed experts.
-        sg = jnp.einsum("te,ef->tf", x, wt(lp["w_sh_gate"]))
-        su = jnp.einsum("te,ef->tf", x, wt(lp["w_sh_up"]))
-        out = out + jnp.einsum(
-            "tf,fe->te", jax.nn.silu(sg) * su, wt(lp["w_sh_down"])
-        )
+        out = out + _shared_experts(lp, x)
     return out
+
+
+def _mlp_block(
+    lp, cfg: ModelConfig, h: jnp.ndarray, lora_idx=None, rows_valid=None
+) -> jnp.ndarray:
+    """MLP over [T, E] or batched [P, L, E] activations — every step
+    function's MLP entry point. Default: EXACTLY the split per-row
+    programs (_mlp direct for 2D, vmapped for 3D — the pre-ISSUE-15
+    jaxprs, byte for byte). With the grouped MoE dispatch enabled
+    (ops.moe.grouped_moe_enabled) the leading axes flatten into one
+    token axis for the routed experts — the flatten is OUTSIDE any
+    vmap, which is what lets the dispatch wrap in shard_map over ep —
+    and the SAME flatten applies in every step family (decode, batched
+    prefill, mixed, verify), so grouped-mode streams stay byte-stable
+    across step builders and mesh sizes (docs/MOE.md).
+
+    `rows_valid` (h's leading shape, bool) marks LIVE rows — every step
+    function already owns this mask (decode `active`, prefill/verify
+    `valid`): padding lanes and inactive slots stay out of the grouped
+    dispatch's routing stats and capacity (ops.moe row_mask docstring).
+    The legacy paths ignore it (dense computes padding rows and
+    discards them downstream, exactly as before)."""
+    if cfg.is_moe and moe_ops.grouped_moe_enabled():
+        lead = h.shape[:-1]
+        mask = rows_valid.reshape(-1) if rows_valid is not None else None
+        y = _moe_grouped(
+            lp, cfg, h.reshape(-1, h.shape[-1]), row_mask=mask
+        )
+        return y.reshape(*lead, y.shape[-1])
+    if h.ndim == 2:
+        return _mlp(lp, cfg, h, lora_idx)
+    li = (
+        lora_idx if lora_idx is not None
+        else jnp.zeros((h.shape[0],), jnp.int32)
+    )
+    return jax.vmap(
+        lambda t, ai: _mlp(
+            lp, cfg, t, ai if lora_idx is not None else None
+        )
+    )(h, li)
 
 
 def _qkv(lp, cfg: ModelConfig, x: jnp.ndarray, positions: jnp.ndarray,
@@ -358,7 +436,7 @@ def decode_step(
         d = lora_ops.maybe_apply(lp, "wo", attn_flat, lora_idx, 1.0)
         x = x + (o + d if d is not None else o)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, cfg, h, lora_idx)
+        x = x + _mlp_block(lp, cfg, h, lora_idx, rows_valid=active)
         return x, (k_l, v_l)
 
     x, (k_caches, v_caches) = jax.lax.scan(
@@ -473,7 +551,9 @@ def mixed_step(
         d = lora_ops.maybe_apply(lp, "wo", attn_dec_flat, lora_dec, 1.0)
         x_dec = x_dec + (o + d if d is not None else o)
         h_dec = rms_norm(x_dec, lp["mlp_norm"], cfg.rms_norm_eps)
-        x_dec = x_dec + _mlp(lp, cfg, h_dec, lora_dec)
+        x_dec = x_dec + _mlp_block(
+            lp, cfg, h_dec, lora_dec, rows_valid=dec_active
+        )
 
         attn_pf_flat = attn_pf.reshape(P, Lpad, -1)
         o = jnp.einsum("plh,he->ple", attn_pf_flat,
@@ -486,11 +566,9 @@ def mixed_step(
             )(attn_pf_flat, li)
         x_pf = x_pf + o
         h_pf = rms_norm(x_pf, lp["mlp_norm"], cfg.rms_norm_eps)
-        x_pf = x_pf + jax.vmap(
-            lambda t, ai: _mlp(
-                lp, cfg, t, ai if lora_pf is not None else None
-            )
-        )(h_pf, li)
+        x_pf = x_pf + _mlp_block(
+            lp, cfg, h_pf, lora_pf, rows_valid=pf_valid
+        )
         return (x_dec, x_pf), (k_l, v_l)
 
     (x_dec, x_pf), (k_caches, v_caches) = jax.lax.scan(
@@ -561,6 +639,14 @@ def mixed_verify_step(
         ver_start, ver_len, ver_tables, S
     )
     pf_pos, pf_blk, pf_off = half_coords(pf_start, pf_len, pf_tables, Lpad)
+    # Live-row masks for the grouped-MoE dispatch (_mlp_block rows_valid
+    # — padding lanes stay out of routing stats/capacity).
+    ver_valid = (
+        jnp.arange(S, dtype=jnp.int32)[None, :] < ver_len[:, None]
+    )
+    pf_valid = (
+        jnp.arange(Lpad, dtype=jnp.int32)[None, :] < pf_len[:, None]
+    )
     # M-RoPE verify rows (media sequences decoding under spec): the
     # generation streams are equal, only the lag vs cache positions
     # matters — exactly executor._verify_impl's broadcast.
@@ -607,7 +693,7 @@ def mixed_verify_step(
             window=cfg.sliding_window,
         )
 
-        def half_tail(x, attn, L_, n_rows, lora, li):
+        def half_tail(x, attn, L_, n_rows, lora, li, valid):
             attn_flat = attn.reshape(n_rows, L_, -1)
             o = jnp.einsum("plh,he->ple", attn_flat,
                            wt(lp["wo"]).reshape(-1, cfg.hidden_size))
@@ -619,14 +705,12 @@ def mixed_verify_step(
                 )(attn_flat, li)
             x = x + o
             h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-            return x + jax.vmap(
-                lambda t, ai: _mlp(
-                    lp, cfg, t, ai if lora is not None else None
-                )
-            )(h, li)
+            return x + _mlp_block(lp, cfg, h, lora, rows_valid=valid)
 
-        x_ver = half_tail(x_ver, attn_ver, S, R, lora_ver, li_ver)
-        x_pf = half_tail(x_pf, attn_pf, Lpad, P, lora_pf, li_pf)
+        x_ver = half_tail(x_ver, attn_ver, S, R, lora_ver, li_ver,
+                          ver_valid)
+        x_pf = half_tail(x_pf, attn_pf, Lpad, P, lora_pf, li_pf,
+                         pf_valid)
         return (x_ver, x_pf), (k_l, v_l)
 
     (x_ver, x_pf), (k_caches, v_caches) = jax.lax.scan(
@@ -725,11 +809,7 @@ def prefill_batch_step(
             )(attn_flat, li)
         x = x + o
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + jax.vmap(
-            lambda t, ai: _mlp(
-                lp, cfg, t, ai if lora_idx is not None else None
-            )
-        )(h, li)
+        x = x + _mlp_block(lp, cfg, h, lora_idx, rows_valid=valid)
         return x, (k_l, v_l)
 
     x, (k_caches, v_caches) = jax.lax.scan(
@@ -807,7 +887,10 @@ def prefill_sp_step(
             wt(lp["wo"]).reshape(-1, cfg.hidden_size),
         )
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, cfg, h[0])[None]
+        x = x + _mlp_block(
+            lp, cfg, h[0],
+            rows_valid=jnp.arange(Lsp, dtype=jnp.int32) < true_len,
+        )[None]
         return x, (k, v)
 
     x, (k_all, v_all) = jax.lax.scan(layer_fn, x, params["layers"])
@@ -830,10 +913,14 @@ def hidden_dense(
     params: Params,
     cfg: ModelConfig,
     token_ids: jnp.ndarray,  # [B, L] int32
+    rows_valid: jnp.ndarray | None = None,  # [B, L] bool live-row mask
 ) -> jnp.ndarray:
     """Final-norm hidden states [B, L, E] of a plain causal forward —
     the /v1/embeddings path (pooling happens executor-side) and the body
-    forward_dense unembeds."""
+    forward_dense unembeds. `rows_valid` marks real tokens when the
+    caller bucket-padded (executor.embed_tokens) — the grouped-MoE
+    dispatch keeps padding rows out of routing stats/capacity exactly
+    like the serving steps (_mlp_block docstring)."""
     B, L = token_ids.shape
     scale = cfg.head_dim**-0.5
     x = _embed(params, cfg, token_ids, wdtype(params["layers"]["wq"]))
@@ -862,8 +949,7 @@ def hidden_dense(
         attn = jax.vmap(one_seq)(h)  # [B, L, Hq*D]
         x = x + jnp.einsum("blh,he->ble", attn, wt(lp["wo"]).reshape(-1, cfg.hidden_size))
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        mlp_out = jax.vmap(lambda t: _mlp(lp, cfg, t))(h)
-        x = x + mlp_out
+        x = x + _mlp_block(lp, cfg, h, rows_valid=rows_valid)
         return x, None
 
     x, _ = jax.lax.scan(layer_fn, x, params["layers"])

@@ -59,7 +59,7 @@ def _apply_local_layers(lp_local, cfg: ModelConfig, x: jnp.ndarray,
                         causal: jnp.ndarray) -> jnp.ndarray:
     """Scan this stage's layer slice over activations [b, Lq, E] — the
     same dense layer body as llama.hidden_dense."""
-    from xllm_service_tpu.models.llama import _mlp, _qkv
+    from xllm_service_tpu.models.llama import _mlp_block, _qkv
     from xllm_service_tpu.ops.norms import rms_norm
     from xllm_service_tpu.ops.quant import wt
 
@@ -93,7 +93,11 @@ def _apply_local_layers(lp_local, cfg: ModelConfig, x: jnp.ndarray,
             wo.astype(attn.dtype) if wo.dtype != attn.dtype else wo,
         )
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + jax.vmap(lambda hx: _mlp(lp, cfg, hx))(h)
+        # _mlp_block keeps this body on the exact dense per-row program
+        # by default and in lockstep with llama.hidden_dense (whose twin
+        # this is) when the grouped-MoE dispatch is enabled — full-length
+        # prompts here, every row live.
+        x = x + _mlp_block(lp, cfg, h)
         return x, None
 
     x, _ = jax.lax.scan(layer_fn, x, lp_local)

@@ -369,6 +369,20 @@ class ModelExecutor:
         if not self.prefill_buckets or self.prefill_buckets[-1] < engine_cfg.max_seq_len:
             self.prefill_buckets.append(engine_cfg.max_seq_len)
 
+        # Grouped-MoE dispatch stats (docs/MOE.md, docs/OBSERVABILITY.md):
+        # each grouped dispatch in a jitted step emits its per-layer
+        # (assignment counts, overflow drops, capacity rows) through an
+        # async jax.debug.callback into _moe_sink — the host accumulators
+        # below feed the engine's obs pull gauges and the master-visible
+        # expert-hotness load signal without ever blocking the device or
+        # the overlap pipeline.
+        self._moe_mu = _threading.Lock()
+        self._moe_counts = np.zeros(
+            (max(self.cfg.num_experts, 1),), np.int64
+        )  # guarded by: self._moe_mu
+        self._moe_dropped = 0  # guarded by: self._moe_mu
+        self._moe_capacity_rows = 0  # guarded by: self._moe_mu
+
     # ------------------------------------------------------- multi-LoRA
 
     def set_lora_adapters(self, adapters) -> Dict[str, int]:
@@ -1456,12 +1470,86 @@ class ModelExecutor:
         """Declare this executor's mesh as the calling thread's kernel
         shard context (ops/attention.py) — called at every jitted-step
         entry point so the trace (first call compiles) captures the
-        right mesh even with several executors in one process."""
-        from xllm_service_tpu.ops import attention
+        right mesh even with several executors in one process. The MoE
+        expert-parallel context (ops/moe.py) is declared alongside: MLA
+        families clear the attention tp context (nothing to shard in a
+        latent cache) but their MoE blocks still dispatch per ep
+        shard."""
+        from xllm_service_tpu.ops import attention, moe
 
         attention.set_shard_context(
             None if self.cfg.is_mla else self.mesh
         )
+        moe.set_ep_context(self.mesh if self.cfg.is_moe else None)
+        moe.set_stats_sink(self._moe_sink if self.cfg.is_moe else None)
+
+    # ----------------------------------------------- grouped-MoE stats
+
+    def _moe_sink(self, counts, dropped: int, cap_rows: int) -> None:
+        """Per-grouped-dispatch stats landing from JAX's async callback
+        thread (ops.moe.set_stats_sink): one call per MoE layer per
+        step, only when the grouped dispatch is enabled. A foreign
+        emission (a direct ops-level grouped_moe on this thread with a
+        different expert count) is dropped rather than corrupting the
+        accumulators."""
+        with self._moe_mu:
+            if counts.shape != self._moe_counts.shape:
+                return
+            self._moe_counts += counts.astype(np.int64)
+            self._moe_dropped += int(dropped)
+            self._moe_capacity_rows += int(cap_rows)
+
+    def moe_stats(self, drain: bool = False) -> Dict[str, float]:
+        """Cumulative grouped-dispatch stats: per-expert assignment
+        counts (summed over layers and steps), total assignments,
+        capacity-overflow drops, group occupancy, and the hot-expert
+        share — the expert-hotness signal the engine exposes as a load
+        gauge next to cache usage (docs/OBSERVABILITY.md). `drain`
+        synchronizes with any in-flight step first (tests/shutdown);
+        the default read is scrape-safe and never blocks the
+        pipeline."""
+        if drain:
+            try:
+                jax.effects_barrier()
+            except Exception:  # pragma: no cover — older jax
+                pass
+        with self._moe_mu:
+            counts = self._moe_counts.copy()
+            dropped = self._moe_dropped
+            cap_rows = self._moe_capacity_rows
+        total = int(counts.sum())
+        return {
+            "experts": int(counts.shape[0]),
+            "expert_counts": counts,
+            "assignments": total,
+            "dropped": dropped,
+            "capacity_rows": cap_rows,
+            "occupancy_frac": (
+                (total - dropped) / cap_rows if cap_rows else 0.0
+            ),
+            "hot_expert_frac": (
+                float(counts.max()) / total if total else 0.0
+            ),
+        }
+
+    @property
+    def moe_shards(self) -> int:
+        """How many per-shard grouped-MoE launches one MLP dispatch fans
+        into: ep under the shard_map tier, 1 on single-device meshes,
+        for non-MoE families, or with the XLLM_SHARDED_KERNELS=0 escape
+        hatch (the grouped oracle then runs under plain GSPMD)."""
+        from xllm_service_tpu.ops import attention, moe
+
+        ep = self.mesh.shape.get("ep", 1)
+        if (
+            ep <= 1
+            or not self.cfg.is_moe
+            or not moe.grouped_moe_enabled()
+            or not attention.sharded_kernels_enabled()
+            or self.cfg.num_experts % ep
+        ):
+            return 1
+        return ep
 
     def kernel_report(self) -> Dict[str, str]:
         """Resolved attention-dispatch decisions for THIS executor's cache
@@ -1476,7 +1564,9 @@ class ModelExecutor:
             )
 
             # The latent cache rides the k slot (num_caches == 1).
-            return resolved_mla_kernel_report(self.k_cache)
+            return self._add_moe_report(
+                resolved_mla_kernel_report(self.k_cache)
+            )
         from xllm_service_tpu.ops.attention import resolved_kernel_report
 
         rep = resolved_kernel_report(
@@ -1490,6 +1580,21 @@ class ModelExecutor:
             "gather"
         ):
             rep["decode"] = "gather-fallback"
+        return self._add_moe_report(rep)
+
+    def _add_moe_report(self, rep: Dict[str, str]) -> Dict[str, str]:
+        """MoE rows of the resolved report (MoE configs only): `moe` is
+        the dispatch the MLP block takes RIGHT NOW (dense | grouped |
+        grouped-ref, docs/MOE.md), `moe_shards` the per-shard launch
+        fan-out over ep — asserted (not assumed) by the EP differential
+        suite, exactly like attention's `shards`."""
+        if self.cfg.is_moe:
+            from xllm_service_tpu.ops.moe import resolved_moe_dispatch
+
+            rep["moe"] = resolved_moe_dispatch(
+                self.cfg.hidden_size, self.cfg.moe_intermediate_size
+            )
+            rep["moe_shards"] = self.moe_shards
         return rep
 
     def _mixed_impl(
@@ -2216,7 +2321,14 @@ class ModelExecutor:
         if init_needed:
             def _impl(params, token_ids, true_len):
                 h = self.model_mod.hidden_dense(
-                    params, self.cfg, token_ids
+                    params, self.cfg, token_ids,
+                    # Bucket-padding rows stay out of the grouped-MoE
+                    # dispatch's routing stats/capacity (llama._mlp_block
+                    # rows_valid) — the pooling mask below already
+                    # excludes them from the embedding itself.
+                    rows_valid=(
+                        jnp.arange(token_ids.shape[1])[None, :] < true_len
+                    ),
                 )  # [1, L, E]
                 mask = (
                     jnp.arange(h.shape[1])[None, :, None] < true_len
