@@ -406,6 +406,524 @@ def run_pd_bench(args) -> None:
         sys.exit(3)
 
 
+def _pd_adapt_guard(line: str) -> "tuple[str, int]":
+    """Exit-3 guard for the --pd-adapt goodput A/B/C row (ISSUE 16).
+
+    Adaptive placement exists to beat BOTH static deployments on a mixed
+    trace — losing to either means the controller routed against its own
+    goodput model. FAILs (rc 3) when adaptive goodput lands below
+    XLLM_BENCH_PD_ADAPT_MIN_RATIO (default 1.0) of the best static
+    baseline, or when the adaptive phase never produced an actionable
+    decision (an inert controller stamping "ok" would be vacuous — the
+    run_pd_bench inert-pipeline precedent). Abstains LOUDLY when no mode
+    met its SLO at all (the host is too noisy for the --adapt-slo-*
+    constants to mean anything) or when the goodput numbers are
+    unparseable; passes through non-JSON lines and rows without all
+    three modes untouched. XLLM_BENCH_NO_REGRESSION_GUARD disarms it.
+    """
+    import os
+
+    if os.environ.get("XLLM_BENCH_NO_REGRESSION_GUARD"):
+        return line, 0
+    try:
+        res = json.loads(line)
+    except ValueError:
+        return line, 0
+    g = res.get("goodput") or {}
+    if not isinstance(g, dict) or not all(
+        k in g for k in ("adaptive", "static_pd", "all_mix")
+    ):
+        return line, 0
+    try:
+        a = float(g["adaptive"]["goodput_tok_s"])
+        s = float(g["static_pd"]["goodput_tok_s"])
+        m = float(g["all_mix"]["goodput_tok_s"])
+    except (KeyError, TypeError, ValueError):
+        # Still loud: a harness refactor that loses goodput_tok_s must
+        # not make the guard silently vanish (the _moe_guard precedent).
+        res["pd_adapt_guard"] = "abstained: unparseable goodput_tok_s"
+        return json.dumps(res), 0
+    if int(g["adaptive"].get("acted") or 0) <= 0:
+        res["pd_adapt_guard"] = (
+            "FAIL: the adaptive phase produced 0 actionable decisions — "
+            "controller off (XLLM_GOODPUT_CONTROLLER=0?) or its inputs "
+            "never warmed; an inert controller must not pass its own A/B"
+        )
+        return json.dumps(res), 3
+    if a <= 0.0 and s <= 0.0 and m <= 0.0:
+        res["pd_adapt_guard"] = (
+            "abstained: no mode met its SLO at all — host too noisy for "
+            "the --adapt-slo-* constants (rerun or raise them)"
+        )
+        return json.dumps(res), 0
+    try:
+        ratio = float(
+            os.environ.get("XLLM_BENCH_PD_ADAPT_MIN_RATIO", "") or 1.0
+        )
+    except ValueError:
+        ratio = 1.0
+    best = max(s, m)
+    if a >= ratio * best:
+        res["pd_adapt_guard"] = "ok"
+        return json.dumps(res), 0
+    res["pd_adapt_guard"] = (
+        f"FAIL: adaptive goodput {a:.1f} tok/s is below "
+        f"{100.0 * ratio:.0f}% of the best static baseline {best:.1f} "
+        f"(static_pd={s:.1f}, all_mix={m:.1f}) — per-request placement "
+        f"lost to a static deployment on the swing trace"
+    )
+    return json.dumps(res), 3
+
+
+def run_pd_adapt_bench(args) -> None:
+    """Goodput-controller A/B/C (--pd-adapt): adaptive per-request
+    colocate-vs-disaggregate placement vs BOTH static deployments, on
+    one swing trace against one fleet in one process (ISSUE 16,
+    docs/PD_DISAGGREGATION.md "Goodput controller").
+
+    The trace interleaves two tenants with OPPOSITE optimal placements:
+
+      * bench-batch — long prompt (256 tok), 2-token decode: the KV
+        handoff stall (--adapt-stall-ms) buys almost no interference-free
+        decode time, so colocation wins;
+      * bench-chat  — short prompt (48 tok), 48-token decode: every
+        colocated decode step overlapping a batch prefill pays the
+        interference factor, so disaggregation wins.
+
+    The fleet is --instances (>= 4) declared-MIX fakes with the
+    colocation physics the stock FakeEngine lacks: per-token decode
+    delay inflates by --adapt-interference per concurrent prefill on
+    the same engine, a prefill occupies the engine for 1 ms/token, and
+    a disaggregated import pays --adapt-stall-ms of simulated KV wire
+    time INSIDE the real handoff path — so the prefill side's stall
+    clock times it and `kv_stall_ms_ewma` heartbeats carry it to the
+    controller. The engine also reports its prefill duty cycle as queue
+    pressure (waiting_requests_num) — the signal a real engine's
+    admission queue shows while prefills own the hot loop, which the
+    fake's thread-per-request generation otherwise hides.
+
+    One warmup pass trains the per-tenant decode-length EWMAs and the
+    stall estimate (cold-EWMA decisions degrade to static = the PD
+    pair), then three measured phases replay the same paced trace:
+    static_pd (XLLM_GOODPUT_FORCE=disaggregate — the classic PD split),
+    all_mix (=colocate — monolithic MIX serving), and adaptive (the
+    controller decides per request). Each measured phase opens with an
+    unmeasured batch-only lead-in that re-arms steady-state prefill
+    duty (and lets heartbeats carry it) before the first measured
+    decision — the A/B/C compares steady-state placement policies, not
+    cold-start transients. Goodput = SLO-met tokens/s: prompt
+    + generated tokens of requests finishing under their tenant's
+    --adapt-slo-*-ms end-to-end budget, over the phase's wall time.
+    Fleet reshaping is pinned off for the whole run
+    (XLLM_GOODPUT_MIN_FLIP_INTERVAL_S=1e9): the A/B/C isolates the
+    per-request half of the controller; the flip plane is tier-1's
+    tests/test_goodput.py. Exits 3 via _pd_adapt_guard when adaptive
+    loses to either static baseline or never acts.
+    """
+    import collections
+    import http.client
+    import os
+    import sys
+
+    from xllm_service_tpu.api import FakeEngine, Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+
+    class InterferingFakeEngine(FakeEngine):
+        """FakeEngine + the three colocation-physics terms the goodput
+        model trades off (see run_pd_adapt_bench docstring)."""
+
+        PREFILL_MS_PER_TOK = 1.0
+        # waiting_requests_num = weight x prefill duty cycle: the queue
+        # pressure a real engine reports while prefills own the hot loop.
+        WAITING_WEIGHT = 30.0
+        DUTY_WINDOW_S = 1.0
+
+        def __init__(self, *, interference, handoff_stall_ms, **kw):
+            # Set before super().__init__: its token_delay_s assignment
+            # goes through the property setter below.
+            self._base_delay = 0.0
+            self._prefilling = 0
+            self._pf_active = {}
+            self._pf_done = collections.deque(maxlen=128)
+            self._imu = threading.Lock()
+            self.interference = interference
+            self.handoff_stall_ms = handoff_stall_ms
+            super().__init__(**kw)
+
+        # Read once per emitted token: interference applies to exactly
+        # the decode steps that overlap a prefill on this engine.
+        @property
+        def token_delay_s(self):
+            return self._base_delay * (
+                1.0 + self.interference * self._prefilling
+            )
+
+        @token_delay_s.setter
+        def token_delay_s(self, v):
+            self._base_delay = v
+
+        def _prefill_sleep(self, n_tokens):
+            key = object()
+            t0 = time.monotonic()
+            with self._imu:
+                self._prefilling += 1
+                self._pf_active[key] = t0
+            try:
+                time.sleep(self.PREFILL_MS_PER_TOK * n_tokens / 1000.0)
+            finally:
+                with self._imu:
+                    self._prefilling -= 1
+                    del self._pf_active[key]
+                    self._pf_done.append((t0, time.monotonic()))
+
+        def _prefill_duty(self):
+            now = time.monotonic()
+            lo = now - self.DUTY_WINDOW_S
+            with self._imu:
+                busy = sum(
+                    min(t1, now) - max(t0, lo)
+                    for t0, t1 in self._pf_done
+                    if t1 > lo
+                )
+                busy += sum(
+                    now - max(t0, lo) for t0 in self._pf_active.values()
+                )
+            return busy / self.DUTY_WINDOW_S
+
+        def _run(self, req, skip_first=False):
+            if not skip_first:
+                # Colocated/monolithic: the prompt's prefill occupies
+                # this engine before its own decode starts. A handed-off
+                # import (skip_first) already paid prefill on the peer.
+                self._prefill_sleep(len(req.prompt_token_ids))
+            super()._run(req, skip_first=skip_first)
+
+        def _run_prefill_only(self, req):
+            self._prefill_sleep(len(req.prompt_token_ids))
+            super()._run_prefill_only(req)
+
+        def import_sequence(self, req, handoff):
+            # Simulated KV wire time, paid BEFORE admission so the
+            # sender's real stall clock (instance_kv commit path) times
+            # it and heartbeats carry it to the controller.
+            time.sleep(self.handoff_stall_ms / 1000.0)
+            super().import_sequence(req, handoff)
+
+        def get_load_metrics(self):
+            lm = super().get_load_metrics()
+            lm.waiting_requests_num = int(
+                round(self.WAITING_WEIGHT * self._prefill_duty())
+            )
+            return lm
+
+        def profiling_data(self):
+            # Publish the UNCONTENDED curves: the controller models load
+            # through the waiting/stall signals; a TPOT point sampled
+            # mid-prefill would double-count interference.
+            ttft = [
+                (n, self.ttft_ms + self.PREFILL_MS_PER_TOK * n)
+                for n in (64, 256, 1024, 4096)
+            ]
+            tpot = [
+                (b, t, self._base_delay * 1000.0 + 0.1 * b)
+                for b in (1, 8, 32)
+                for t in (256, 4096)
+            ]
+            return ttft, tpot
+
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("XLLM_GOODPUT_FORCE", "XLLM_GOODPUT_MIN_FLIP_INTERVAL_S")
+    }
+    # Pin reshaping off: mid-phase census changes would give the three
+    # modes different fleets (the flip plane has its own tier-1 proof).
+    os.environ["XLLM_GOODPUT_MIN_FLIP_INTERVAL_S"] = "1e9"
+
+    store = MemoryStore()
+    # 0.5s heartbeats: the duty/stall signals must reach the controller
+    # well inside a phase (measured phases last ~2-3s).
+    cfg = ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.5, master_lease_ttl_s=5.0,
+        load_balance_policy="RR", block_size=16,
+    )
+    master = Master(cfg, store=store)
+    master.start()
+
+    n_inst = max(args.instances, 4)
+    names = [f"adapt{i}" for i in range(n_inst)]
+    servers = []
+    for name in names:
+        ecfg = EngineConfig(
+            model="fake-echo", instance_name=name,
+            instance_type="MIX", block_size=16,
+        )
+        srv = InstanceServer(
+            ecfg, master_rpc_addr=master.rpc_address,
+            heartbeat_interval_s=0.5,
+            engine=InterferingFakeEngine(
+                interference=args.adapt_interference,
+                handoff_stall_ms=args.adapt_stall_ms,
+                token_delay_s=args.adapt_token_delay_ms / 1000.0,
+                ttft_ms=1.0,
+            ),
+        )
+        srv.start()
+        servers.append(srv)
+
+    mgr = master.scheduler.instance_mgr
+    ctrl = master.scheduler.goodput
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        preds = [mgr.get_time_predictor(n) for n in names]
+        if sum(mgr.counts()) == n_inst and all(
+            p is not None and p.has_ttft_model and p.has_tpot_model
+            for p in preds
+        ):
+            break
+        time.sleep(0.05)
+
+    host, _, port = master.http_address.partition(":")
+    slo_ms = {
+        "bench-batch": args.adapt_slo_batch_ms,
+        "bench-chat": args.adapt_slo_chat_ms,
+    }
+    tenants = {
+        "bench-batch": {"prompt_tokens": 256, "max_tokens": 2},
+        # prompt_tokens >= max_tokens: the fake echoes the reversed
+        # prompt, so the decode length is capped by the prompt length.
+        "bench-chat": {"prompt_tokens": 48, "max_tokens": 48},
+    }
+
+    def build_trace(tag, n):
+        """n paced requests, 3:2 batch:chat, interleaved (the swing is
+        request-to-request, so every phase sees the same mix). Distinct
+        salts: the byte tokenizer makes chars == tokens."""
+        out = []
+        for i in range(n):
+            tenant = "bench-batch" if i % 5 in (0, 2, 4) else "bench-chat"
+            shape = tenants[tenant]
+            salt = f"{tag}{i:04d} "
+            prompt = salt + "x" * max(shape["prompt_tokens"] - len(salt), 1)
+            out.append((tenant, prompt, shape["max_tokens"]))
+        return out
+
+    def run_phase(label, force, n, lead=12):
+        if force:
+            os.environ["XLLM_GOODPUT_FORCE"] = force
+        else:
+            os.environ.pop("XLLM_GOODPUT_FORCE", None)
+        results = []
+        res_mu = threading.Lock()
+
+        def one(tenant, prompt, max_toks, record=True):
+            t0 = time.monotonic()
+            toks, ok = 0, False
+            try:
+                conn = http.client.HTTPConnection(
+                    host, int(port), timeout=60.0
+                )
+                conn.request(
+                    "POST", "/v1/completions",
+                    body=json.dumps({
+                        "model": tenant, "prompt": prompt,
+                        "max_tokens": max_toks, "temperature": 0.0,
+                        "stream": True,
+                    }).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = conn.getresponse()
+                if resp.status == 200:
+                    for raw in resp:
+                        ln = raw.decode().strip()
+                        if not ln.startswith("data: "):
+                            continue
+                        payload = ln[len("data: "):]
+                        if payload == "[DONE]":
+                            ok = True
+                            break
+                        try:
+                            ev = json.loads(payload)
+                        except ValueError:
+                            continue
+                        if ev.get("choices"):
+                            toks += 1
+                conn.close()
+            except Exception:
+                ok = False
+            e2e_ms = (time.monotonic() - t0) * 1000.0
+            if record:
+                with res_mu:
+                    results.append((tenant, len(prompt), toks, e2e_ms, ok))
+
+        threads = []
+        # Unmeasured batch-only lead-in: saturates the duty window and
+        # gives heartbeats (0.5s) time to carry it, so the first
+        # measured decision already sees steady-state prefill pressure.
+        bshape = tenants["bench-batch"]
+        for i in range(lead):
+            salt = f"ld{label[:2]}{i:04d} "
+            prompt = salt + "x" * max(bshape["prompt_tokens"] - len(salt), 1)
+            th = threading.Thread(
+                target=one,
+                args=("bench-batch", prompt, bshape["max_tokens"], False),
+                daemon=True,
+            )
+            th.start()
+            threads.append(th)
+            time.sleep(0.1)
+        d0 = dict(ctrl.decisions)
+        t_start = time.monotonic()
+        for i, (tenant, prompt, max_toks) in enumerate(
+            build_trace(label[:2], n)
+        ):
+            target = t_start + i * args.adapt_gap_ms / 1000.0
+            now = time.monotonic()
+            if target > now:
+                time.sleep(target - now)
+            th = threading.Thread(
+                target=one, args=(tenant, prompt, max_toks), daemon=True
+            )
+            th.start()
+            threads.append(th)
+        # All measured requests are scheduled (decisions happen on HTTP
+        # receipt); snapshot the delta BEFORE the drain pump below adds
+        # its own unmeasured decisions.
+        time.sleep(0.05)
+        dd = {
+            k: ctrl.decisions.get(k, 0) - d0.get(k, 0)
+            for k in ("colocate", "disaggregate", "static")
+        }
+
+        # Drain pump: arrivals stopped but long decodes are still in
+        # flight — keep the steady-state prefill pressure up (same
+        # unmeasured batch load as the lead-in) so a phase's tail isn't
+        # an artificially interference-free free ride.
+        stop = threading.Event()
+        bg_threads = []
+
+        def drain_pump():
+            i = 0
+            while not stop.is_set():
+                salt = f"dp{label[:2]}{i:04d} "
+                prompt = salt + "x" * max(
+                    bshape["prompt_tokens"] - len(salt), 1
+                )
+                th = threading.Thread(
+                    target=one,
+                    args=(
+                        "bench-batch", prompt, bshape["max_tokens"], False
+                    ),
+                    daemon=True,
+                )
+                th.start()
+                bg_threads.append(th)
+                i += 1
+                stop.wait(0.1)
+
+        pump_th = None
+        if lead:
+            pump_th = threading.Thread(target=drain_pump, daemon=True)
+            pump_th.start()
+        for th in threads:
+            th.join(timeout=120)
+        dur = time.monotonic() - t_start
+        stop.set()
+        if pump_th is not None:
+            pump_th.join(timeout=5)
+        for th in bg_threads:
+            th.join(timeout=30)
+        met_tokens = total_tokens = met_n = failed = 0
+        per_tenant = {
+            t: {"requests": 0, "slo_met": 0, "e2e_ms": []} for t in slo_ms
+        }
+        for tenant, ptoks, toks, e2e_ms, ok in results:
+            pt = per_tenant[tenant]
+            pt["requests"] += 1
+            pt["e2e_ms"].append(e2e_ms)
+            total_tokens += ptoks + toks
+            if not ok or toks <= 0:
+                failed += 1
+                continue
+            if e2e_ms <= slo_ms[tenant]:
+                pt["slo_met"] += 1
+                met_n += 1
+                met_tokens += ptoks + toks
+        for pt in per_tenant.values():
+            xs = sorted(pt.pop("e2e_ms"))
+            pt["e2e_p50_ms"] = (
+                round(xs[len(xs) // 2], 1) if xs else None
+            )
+        return {
+            "duration_s": round(dur, 3),
+            "requests": len(results),
+            "failed": failed,
+            "slo_met": met_n,
+            "met_tokens": met_tokens,
+            "total_tokens": total_tokens,
+            "goodput_tok_s": (
+                round(met_tokens / dur, 1) if dur > 0 else 0.0
+            ),
+            "throughput_tok_s": (
+                round(total_tokens / dur, 1) if dur > 0 else 0.0
+            ),
+            "decisions": dd,
+            "acted": dd["colocate"] + dd["disaggregate"],
+            "per_tenant": per_tenant,
+        }
+
+    # Warmup: trains the tenant EWMAs (cold decisions degrade to static
+    # = the PD pair, which also seeds the stall samples + prefill duty)
+    # and the predictors' first heartbeat upload. Unmeasured.
+    run_phase("warmup", None, 12, lead=0)
+    reports = {}
+    for label, force in (
+        ("static_pd", "disaggregate"),
+        ("all_mix", "colocate"),
+        ("adaptive", None),
+    ):
+        time.sleep(0.25)  # settle: heartbeats carry the last phase's tail
+        reports[label] = run_phase(label, force, args.adapt_requests)
+    os.environ.pop("XLLM_GOODPUT_FORCE", None)
+
+    row = {
+        "metric": "pd_adapt",
+        "backend": "fake",
+        "instances": n_inst,
+        "requests_per_phase": args.adapt_requests,
+        "gap_ms": args.adapt_gap_ms,
+        "stall_ms": args.adapt_stall_ms,
+        "interference": args.adapt_interference,
+        "token_delay_ms": args.adapt_token_delay_ms,
+        "slo_ms": slo_ms,
+        "tenants": tenants,
+        "role_census": mgr.role_census(),
+        "wanted_census": ctrl.wanted_census(),
+        "reshape_flips": ctrl.reshape_flips,
+        "goodput": reports,
+    }
+
+    for srv in servers:
+        try:
+            srv.stop()
+        except Exception:
+            pass
+    master.stop()
+    store.close()
+    for k, v in saved_env.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+    line, rc = _pd_adapt_guard(json.dumps(row))
+    print(line)
+    if rc:
+        sys.exit(rc)
+
+
 def run_prefix_trace_bench(args) -> None:
     """Fleet prefix-fabric bench (--prefix-trace): a Zipf-ish shared-
     system-prompt workload replayed at high stream concurrency against
@@ -1080,6 +1598,48 @@ def main() -> None:
         help="--pd: measured requests per phase",
     )
     p.add_argument(
+        "--pd-adapt", action="store_true",
+        help="goodput-controller A/B/C: adaptive per-request colocate-"
+        "vs-disaggregate placement vs static-PD (force=disaggregate) "
+        "and all-MIX (force=colocate) on one two-tenant swing trace "
+        "over a declared-MIX fake fleet with colocation physics; "
+        "reports SLO-met tokens/s per mode; exits 3 when adaptive "
+        "loses to either static baseline (docs/PD_DISAGGREGATION.md)",
+    )
+    p.add_argument(
+        "--adapt-requests", type=int, default=40,
+        help="--pd-adapt: requests per measured phase (3:2 batch:chat)",
+    )
+    p.add_argument(
+        "--adapt-gap-ms", type=float, default=50.0,
+        help="--pd-adapt: open-loop arrival gap between requests",
+    )
+    p.add_argument(
+        "--adapt-stall-ms", type=float, default=400.0,
+        help="--pd-adapt: simulated KV wire time per disaggregated "
+        "handoff (paid inside the real handoff path, so the stall "
+        "telemetry the controller consumes measures it)",
+    )
+    p.add_argument(
+        "--adapt-interference", type=float, default=6.0,
+        help="--pd-adapt: per-concurrent-prefill decode slowdown factor "
+        "on a colocated engine",
+    )
+    p.add_argument(
+        "--adapt-token-delay-ms", type=float, default=10.0,
+        help="--pd-adapt: uncontended per-token decode delay",
+    )
+    p.add_argument(
+        "--adapt-slo-batch-ms", type=float, default=550.0,
+        help="--pd-adapt: e2e SLO for the long-prompt/short-decode "
+        "tenant (misses under static-PD: the stall buys nothing)",
+    )
+    p.add_argument(
+        "--adapt-slo-chat-ms", type=float, default=1300.0,
+        help="--pd-adapt: e2e SLO for the short-prompt/long-decode "
+        "tenant (misses under all-MIX: prefill interference)",
+    )
+    p.add_argument(
         "--pd-prompt-tokens", type=int, default=960,
         help="--pd: prompt length (tokens == chars on the test tokenizer)",
     )
@@ -1123,6 +1683,9 @@ def main() -> None:
 
         jax.config.update("jax_platforms", plat)
 
+    if args.pd_adapt:
+        run_pd_adapt_bench(args)
+        return
     if args.pd:
         run_pd_bench(args)
         return

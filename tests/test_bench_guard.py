@@ -365,3 +365,112 @@ def test_mesh_guard_below_floor_fails():
     out, rc = bench._mesh_guard(_mesh_line(value=100.0))
     assert rc == 3
     assert json.loads(out)["engine_mesh_guard"].startswith("FAIL")
+
+
+# ---------------------------------------------------------------------------
+# bench_serving --pd-adapt goodput guard (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+import bench_serving
+
+
+@pytest.fixture(autouse=True)
+def _adapt_env(monkeypatch):
+    # The guard reads these at call time; pin them off so assertions
+    # don't depend on the invoking shell.
+    monkeypatch.delenv("XLLM_BENCH_NO_REGRESSION_GUARD", raising=False)
+    monkeypatch.delenv("XLLM_BENCH_PD_ADAPT_MIN_RATIO", raising=False)
+
+
+def _adapt_line(a=2500.0, s=500.0, m=1500.0, acted=40, **kw):
+    d = {
+        "metric": "pd_adapt",
+        "goodput": {
+            "adaptive": {"goodput_tok_s": a, "acted": acted},
+            "static_pd": {"goodput_tok_s": s},
+            "all_mix": {"goodput_tok_s": m},
+        },
+    }
+    d.update(kw)
+    return json.dumps(d)
+
+
+def test_pd_adapt_guard_win_passes():
+    out, rc = bench_serving._pd_adapt_guard(_adapt_line())
+    assert rc == 0
+    assert json.loads(out)["pd_adapt_guard"] == "ok"
+
+
+def test_pd_adapt_guard_loss_to_all_mix_fails():
+    # Adaptive under the best static baseline: the controller routed
+    # against its own goodput model — exit 3, both baselines named.
+    out, rc = bench_serving._pd_adapt_guard(_adapt_line(a=1200.0))
+    assert rc == 3
+    g = json.loads(out)["pd_adapt_guard"]
+    assert g.startswith("FAIL") and "1500.0" in g and "static" in g
+
+
+def test_pd_adapt_guard_loss_to_static_pd_fails():
+    out, rc = bench_serving._pd_adapt_guard(
+        _adapt_line(a=400.0, s=500.0, m=300.0)
+    )
+    assert rc == 3
+    assert json.loads(out)["pd_adapt_guard"].startswith("FAIL")
+
+
+def test_pd_adapt_guard_inert_controller_fails():
+    # Tied goodput but zero actionable decisions: an inert controller
+    # (XLLM_GOODPUT_CONTROLLER=0, cold EWMAs) must not pass its own A/B.
+    out, rc = bench_serving._pd_adapt_guard(_adapt_line(acted=0))
+    assert rc == 3
+    assert "0 actionable decisions" in json.loads(out)["pd_adapt_guard"]
+
+
+def test_pd_adapt_guard_min_ratio_env(monkeypatch):
+    # 2500 vs best 1500 is a 1.67x win; demanding 2x must fail it.
+    monkeypatch.setenv("XLLM_BENCH_PD_ADAPT_MIN_RATIO", "2.0")
+    out, rc = bench_serving._pd_adapt_guard(_adapt_line())
+    assert rc == 3
+    assert "200%" in json.loads(out)["pd_adapt_guard"]
+
+
+def test_pd_adapt_guard_all_zero_abstains():
+    # No mode met any SLO: the host is too noisy for the --adapt-slo-*
+    # constants to mean anything — loud abstain, not a fail.
+    out, rc = bench_serving._pd_adapt_guard(
+        _adapt_line(a=0.0, s=0.0, m=0.0)
+    )
+    assert rc == 0
+    assert json.loads(out)["pd_adapt_guard"].startswith("abstained")
+
+
+def test_pd_adapt_guard_unparseable_goodput_abstains():
+    line = json.dumps({
+        "metric": "pd_adapt",
+        "goodput": {
+            "adaptive": {"goodput_tok_s": None, "acted": 40},
+            "static_pd": {"goodput_tok_s": 1.0},
+            "all_mix": {"goodput_tok_s": 1.0},
+        },
+    })
+    out, rc = bench_serving._pd_adapt_guard(line)
+    assert rc == 0
+    assert "unparseable" in json.loads(out)["pd_adapt_guard"]
+
+
+def test_pd_adapt_guard_other_rows_untouched():
+    line = json.dumps({"metric": "pd", "value": 1.0})
+    out, rc = bench_serving._pd_adapt_guard(line)
+    assert rc == 0 and out == line
+
+
+def test_pd_adapt_guard_non_json_untouched():
+    out, rc = bench_serving._pd_adapt_guard("plain text line")
+    assert rc == 0 and out == "plain text line"
+
+
+def test_pd_adapt_guard_kill_switch(monkeypatch):
+    monkeypatch.setenv("XLLM_BENCH_NO_REGRESSION_GUARD", "1")
+    out, rc = bench_serving._pd_adapt_guard(_adapt_line(a=0.0, acted=0))
+    assert rc == 0
+    assert "pd_adapt_guard" not in json.loads(out)

@@ -1,0 +1,656 @@
+"""Goodput controller plane (cluster/goodput.py, ISSUE 16): per-request
+colocate-vs-disaggregate decisions, hysteresis-damped fleet reshaping,
+and the flip-under-chaos guarantees — a role flip mid-stream drops zero
+requests, a stale-epoch /flip is 412-fenced, and forced placements are
+byte-identical to the static oracle (decisions move WHERE work runs,
+never WHAT the stream says).
+"""
+
+import time
+
+import pytest
+
+from xllm_service_tpu.api import FakeEngine, Master
+from xllm_service_tpu.api.http_utils import post_json
+from xllm_service_tpu.api.instance import InstanceServer
+from xllm_service_tpu.cluster.goodput import (
+    GoodputController,
+    goodput_enabled,
+)
+from xllm_service_tpu.cluster.instance_mgr import InstanceMgr, instance_key
+from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+from xllm_service_tpu.common.types import (
+    InstanceMetaInfo,
+    InstanceType,
+    LoadMetrics,
+    RequestAction,
+    Routing,
+)
+from xllm_service_tpu.coordination import MemoryStore
+
+from tests.test_api_e2e import http_post, sse_post, wait_until
+
+
+def _register(store, name, itype=InstanceType.MIX, ttft=5.0, tpot=4.0):
+    """Register with flat profiling curves: predict_ttft == ttft and
+    predict_tpot == tpot at every operating point (three independent
+    sample rows pin the exact least-squares solution)."""
+    meta = InstanceMetaInfo(
+        name=name, http_address=f"h-{name}:1", type=itype,
+        ttft_profiling_data=[(64, ttft), (256, ttft), (1024, ttft)],
+        tpot_profiling_data=[
+            (1, 10, tpot), (4, 40, tpot), (8, 100, tpot),
+        ],
+    )
+    store.set(instance_key(meta), meta.serialize())
+    return meta
+
+
+def _wait_registered(mgr, *names):
+    deadline = time.monotonic() + 5.0
+    while any(mgr.get_instance(n) is None for n in names):
+        if time.monotonic() > deadline:
+            raise RuntimeError(f"registrations not ingested: {names}")
+        time.sleep(0.005)
+
+
+@pytest.fixture()
+def pd_cluster():
+    """One declared-MIX pair: d0 registers first (-> DECODE serving),
+    p0 second (-> PREFILL serving) per the MIX placement rule."""
+    store = MemoryStore()
+    mgr = InstanceMgr(store, is_master=lambda: True)
+    _register(store, "d0")
+    _register(store, "p0")
+    _wait_registered(mgr, "d0", "p0")
+    yield store, mgr
+    mgr.close()
+    store.close()
+
+
+def _controller(mgr, clock=None, config=None):
+    kw = {"clock": clock} if clock is not None else {}
+    return GoodputController(config, mgr, **kw)
+
+
+def _warm(ctl, tenant, tokens, n=4):
+    for _ in range(n):
+        ctl.observe_completion(tenant, tokens)
+
+
+PD = Routing(prefill_name="p0", decode_name="d0")
+
+
+# --------------------------------------------------------------------------
+# hatch + decision gates
+# --------------------------------------------------------------------------
+
+
+def test_goodput_enabled_hatch(monkeypatch):
+    monkeypatch.delenv("XLLM_GOODPUT_CONTROLLER", raising=False)
+    assert goodput_enabled(None)  # default on
+    cfg = ServiceConfig(enable_goodput_controller=False)
+    assert not goodput_enabled(cfg)
+    monkeypatch.setenv("XLLM_GOODPUT_CONTROLLER", "1")
+    assert goodput_enabled(cfg)  # env overrides config either way
+    monkeypatch.setenv("XLLM_GOODPUT_CONTROLLER", "0")
+    assert not goodput_enabled(None)
+
+
+def test_decision_gates_degrade_to_static(pd_cluster, monkeypatch):
+    _, mgr = pd_cluster
+    ctl = _controller(mgr)
+
+    monkeypatch.setenv("XLLM_GOODPUT_CONTROLLER", "0")
+    assert ctl.decide_placement(100, "t", PD).reason == "disabled"
+    monkeypatch.delenv("XLLM_GOODPUT_CONTROLLER", raising=False)
+
+    same = Routing(prefill_name="p0", decode_name="p0")
+    assert ctl.decide_placement(100, "t", same).reason == "already-colocated"
+
+    # A declared-PREFILL target has no mixed hot loop to colocate onto.
+    _register(pd_cluster[0], "pf", itype=InstanceType.PREFILL)
+    _wait_registered(mgr, "pf")
+    fixed = Routing(prefill_name="pf", decode_name="d0")
+    assert ctl.decide_placement(100, "t", fixed).reason == "target-not-mix"
+
+    # Cold EWMA: no completions observed for the tenant yet.
+    d = ctl.decide_placement(100, "t", PD)
+    assert d.mode == "static" and d.reason == "ewma-cold-or-stale"
+    assert ctl.decisions["static"] == 4
+
+
+def test_stale_ewma_degrades_to_static(pd_cluster):
+    _, mgr = pd_cluster
+    now = [100.0]
+    ctl = _controller(mgr, clock=lambda: now[0])
+    _warm(ctl, "t", 8)
+    assert ctl.decide_placement(100, "t", PD).acted
+    now[0] += 31.0  # past XLLM_GOODPUT_STALE_S default 30
+    assert ctl.decide_placement(100, "t", PD).reason == "ewma-cold-or-stale"
+
+
+def test_force_hatch_pins_decisions(pd_cluster, monkeypatch):
+    _, mgr = pd_cluster
+    ctl = _controller(mgr)
+    monkeypatch.setenv("XLLM_GOODPUT_FORCE", "colocate")
+    d = ctl.decide_placement(100, "t", PD)  # no EWMA needed when forced
+    assert d.mode == "colocate" and d.reason == "forced"
+    monkeypatch.setenv("XLLM_GOODPUT_FORCE", "disaggregate")
+    assert ctl.decide_placement(100, "t", PD).mode == "disaggregate"
+
+
+# --------------------------------------------------------------------------
+# the goodput model
+# --------------------------------------------------------------------------
+
+
+def test_model_splits_tenants_by_decode_length(pd_cluster):
+    """The discriminating case the controller exists for: with the
+    prefill side busy and a real handoff stall, SHORT decodes colocate
+    (the stall never amortizes) while LONG decodes disaggregate (decode
+    interference on the busy instance dominates)."""
+    _, mgr = pd_cluster
+    ctl = _controller(mgr)
+    # p0 has 8 waiting requests (colocated decode would queue behind
+    # them); d0 reports a 15ms observed handoff stall.
+    mgr.record_load_metrics_update("p0", LoadMetrics(waiting_requests_num=8))
+    mgr.record_load_metrics_update(
+        "d0", LoadMetrics(kv_stall_ms_ewma=15.0)
+    )
+    _warm(ctl, "batch", 4)   # 4-token completions
+    _warm(ctl, "chat", 32)   # 32-token completions
+    # batch: coloc 4*4*1.64=26.2ms <= disagg 15+16=31ms -> colocate
+    short = ctl.decide_placement(600, "batch", PD)
+    assert short.mode == "colocate", short
+    # chat: coloc 32*6.56=210ms > disagg 15+128=143ms -> disaggregate
+    long = ctl.decide_placement(40, "chat", PD)
+    assert long.mode == "disaggregate", long
+    assert short.stall_ms == long.stall_ms == 15.0
+    assert ctl.decisions["colocate"] == 1
+    assert ctl.decisions["disaggregate"] == 1
+
+
+def test_moe_hot_expert_penalizes_decode_side(pd_cluster):
+    """A hot expert on the decode instance serializes its grouped
+    dispatch: the same request that would disaggregate onto a healthy
+    instance colocates instead."""
+    _, mgr = pd_cluster
+    ctl = _controller(mgr)
+    mgr.record_load_metrics_update("p0", LoadMetrics(waiting_requests_num=8))
+    mgr.record_load_metrics_update(
+        "d0", LoadMetrics(kv_stall_ms_ewma=15.0)
+    )
+    _warm(ctl, "chat", 32)
+    assert ctl.decide_placement(40, "chat", PD).mode == "disaggregate"
+    # Hot expert + queueing on d0: 15 + 32*4*1.32*1.45 = 260ms beats the
+    # colocated 32*6.56 = 210ms — the request flips to colocate.
+    mgr.record_load_metrics_update(
+        "d0",
+        LoadMetrics(
+            waiting_requests_num=4, kv_stall_ms_ewma=15.0,
+            moe_hot_expert_frac=0.9,
+        ),
+    )
+    assert ctl.decide_placement(40, "chat", PD).mode == "colocate"
+
+
+def test_stall_estimate_falls_back_to_fleet_mean(pd_cluster):
+    _, mgr = pd_cluster
+    ctl = _controller(mgr)
+    assert ctl.stall_estimate_ms("d0") == 0.0  # nobody has pulled yet
+    mgr.record_load_metrics_update(
+        "p0", LoadMetrics(kv_stall_ms_ewma=20.0)
+    )
+    assert ctl.stall_estimate_ms("d0") == 20.0  # fleet mean
+    mgr.record_load_metrics_update(
+        "d0", LoadMetrics(kv_stall_ms_ewma=10.0)
+    )
+    assert ctl.stall_estimate_ms("d0") == 10.0  # own beats fleet
+
+
+# --------------------------------------------------------------------------
+# fleet reshaping: hysteresis, drain-aware flips, MIX transitions
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def quad_cluster():
+    """Four declared-MIX instances balanced 2 prefill / 2 decode."""
+    store = MemoryStore()
+    mgr = InstanceMgr(store, is_master=lambda: True)
+    for name in ("i0", "i1", "i2", "i3"):
+        _register(store, name)
+    _wait_registered(mgr, "i0", "i1", "i2", "i3")
+    # MIX placement makes 1 decode + 3 prefill; rebalance to 2/2.
+    assert mgr.flip_prefill_to_decode()
+    assert mgr.counts()[:2] == (2, 2)
+    yield store, mgr
+    mgr.close()
+    store.close()
+
+
+def test_tick_hysteresis_then_one_flip(quad_cluster):
+    _, mgr = quad_cluster
+    now = [100.0]
+    ctl = _controller(mgr, clock=lambda: now[0])
+    # Sustained decode pressure: want_p collapses to 1.
+    for name in mgr.decode_instances():
+        mgr.record_load_metrics_update(
+            name, LoadMetrics(waiting_requests_num=5)
+        )
+    flips_before = mgr.total_flips
+    assert ctl.tick() == ""  # streak 1 of 3
+    assert ctl.tick() == ""  # streak 2
+    now[0] += 1.0
+    flipped = ctl.tick()     # streak 3: acts
+    assert flipped in ("i0", "i1", "i2", "i3")
+    assert mgr.total_flips == flips_before + 1
+    assert mgr.counts()[:2] == (1, 3)
+    assert ctl.wanted_census()["prefill"] == 1
+    assert ctl.reshape_flips == 1
+    # The never-empty guard holds even under unchanged pressure: the
+    # last prefill instance is not flippable away.
+    for _ in range(6):
+        now[0] += 20.0
+        ctl.tick()
+    assert mgr.counts()[0] >= 1
+
+
+def test_tick_flapping_demand_never_flips(quad_cluster):
+    """Demand that flaps on and off each tick keeps resetting the
+    hysteresis streak: the fleet census never moves."""
+    _, mgr = quad_cluster
+    now = [100.0]
+    ctl = _controller(mgr, clock=lambda: now[0])
+    decode = mgr.decode_instances()
+    flips_before = mgr.total_flips
+    for i in range(8):
+        # Odd ticks: decode pressure (want fewer prefill). Even ticks:
+        # idle (want == current, direction 0 resets the streak).
+        for name in decode:
+            mgr.record_load_metrics_update(
+                name, LoadMetrics(waiting_requests_num=5 if i % 2 else 0)
+            )
+        ctl.tick()
+        now[0] += 1.0
+    assert mgr.total_flips == flips_before
+    assert mgr.counts()[:2] == (2, 2)
+
+
+def test_tick_disabled_is_inert(quad_cluster, monkeypatch):
+    _, mgr = quad_cluster
+    monkeypatch.setenv("XLLM_GOODPUT_CONTROLLER", "0")
+    ctl = _controller(mgr, clock=lambda: 1e6)
+    for name in mgr.decode_instances():
+        mgr.record_load_metrics_update(
+            name, LoadMetrics(waiting_requests_num=9)
+        )
+    for _ in range(5):
+        assert ctl.tick() == ""
+    assert ctl.reshape_flips == 0
+
+
+def test_tick_drain_timeout_forces_busy_flip(quad_cluster, monkeypatch):
+    """Idle-only flipping starves when every candidate stays busy; past
+    the drain timeout the controller forces the flip (streams keep
+    running — the role only steers NEW routing)."""
+    _, mgr = quad_cluster
+    now = [100.0]
+    ctl = _controller(mgr, clock=lambda: now[0])
+    # All prefill instances busy: the polite primitive refuses forever.
+    for name in mgr.prefill_instances():
+        mgr.update_request_metrics(
+            Routing(prefill_name=name, decode_name=name),
+            RequestAction.SCHEDULE, 128,
+        )
+    for name in mgr.decode_instances():
+        mgr.record_load_metrics_update(
+            name, LoadMetrics(waiting_requests_num=9)
+        )
+    monkeypatch.setenv("XLLM_GOODPUT_DRAIN_TIMEOUT_S", "5")
+    assert ctl.tick() == ""
+    assert ctl.tick() == ""
+    assert ctl.tick() == ""  # streak satisfied but every candidate busy
+    assert mgr.counts()[:2] == (2, 2)
+    now[0] += 6.0  # past the drain timeout
+    flipped = ctl.tick()
+    assert flipped
+    assert mgr.counts()[:2] == (1, 3)
+
+
+def test_tick_mix_transitions_follow_colocate_fraction(
+    quad_cluster, monkeypatch
+):
+    _, mgr = quad_cluster
+    now = [100.0]
+    ctl = _controller(mgr, clock=lambda: now[0])
+    # A colocate-heavy recent window (forced decisions count as acted).
+    monkeypatch.setenv("XLLM_GOODPUT_FORCE", "colocate")
+    p0 = mgr.prefill_instances()[0]
+    d0 = mgr.decode_instances()[0]
+    pair = Routing(prefill_name=p0, decode_name=d0)
+    for _ in range(10):
+        assert ctl.decide_placement(64, "t", pair).mode == "colocate"
+    monkeypatch.delenv("XLLM_GOODPUT_FORCE")
+    assert ctl.colocate_fraction() == 1.0
+    assert ctl.tick()  # balanced census (direction 0) -> MIX transition
+    census = mgr.role_census()
+    assert census["mix"] == 1
+    # counts() stays a 3-tuple and excludes the MIX-serving instance...
+    assert sum(mgr.counts()) == 3
+    # ...but routing sees it on BOTH sides.
+    mix = mgr.mix_instances()[0]
+    assert mix in mgr.routable_prefill_instances()
+    assert mix in mgr.routable_decode_instances()
+    # Colocate-light window sends it back to a PD side (the deque keeps
+    # the last 64 decisions; 60 disaggregates push the fraction under
+    # the 0.2 release threshold).
+    monkeypatch.setenv("XLLM_GOODPUT_FORCE", "disaggregate")
+    for _ in range(60):
+        ctl.decide_placement(64, "t", pair)
+    monkeypatch.delenv("XLLM_GOODPUT_FORCE")
+    assert ctl.colocate_fraction() < 0.2
+    now[0] += 20.0
+    assert ctl.tick()
+    assert mgr.role_census()["mix"] == 0
+    assert sum(mgr.counts()) == 4
+
+
+def test_flip_role_guards(quad_cluster):
+    _, mgr = quad_cluster
+    # Unknown instance / non-MIX declared type / same role: all refused.
+    assert mgr.flip_role("nope", InstanceType.DECODE) == ""
+    p = mgr.prefill_instances()[0]
+    assert mgr.flip_role(p, InstanceType.PREFILL) == ""
+    assert mgr.flip_role(p, InstanceType.ENCODE) == ""
+    # Busy instance: polite refusal, forced success.
+    mgr.update_request_metrics(
+        Routing(prefill_name=p, decode_name=p),
+        RequestAction.SCHEDULE, 64,
+    )
+    assert mgr.flip_role(p, InstanceType.DECODE) == ""
+    assert mgr.flip_role(p, InstanceType.DECODE, force=True) == p
+    # Never-empty guard: the last prefill-covering instance stays put.
+    p_last = mgr.prefill_instances()[0]
+    assert mgr.flip_role(p_last, InstanceType.DECODE, force=True) == ""
+    # ...unless a MIX-serving instance still covers the prefill side.
+    assert mgr.flip_role(p_last, InstanceType.MIX, force=True) == p_last
+    assert mgr.role_census()["prefill"] == 0
+    assert mgr.routable_prefill_instances()  # mix covers the side
+
+
+# --------------------------------------------------------------------------
+# e2e: flips under live streams + epoch fencing (ISSUE 16 satellite)
+# --------------------------------------------------------------------------
+
+
+def make_master(store, **kw):
+    kw.setdefault("master_lease_ttl_s", 5.0)
+    cfg = ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.2,
+        load_balance_policy="RR", block_size=16, **kw,
+    )
+    m = Master(cfg, store=store)
+    m.start()
+    return m
+
+
+def make_instance(master, name, itype="MIX", **engine_kw):
+    ecfg = EngineConfig(
+        model="fake-echo", instance_name=name, instance_type=itype,
+        block_size=16,
+    )
+    srv = InstanceServer(
+        ecfg, master_rpc_addr=master.rpc_address,
+        heartbeat_interval_s=0.2, engine=FakeEngine(**engine_kw),
+    )
+    srv.start()
+    return srv
+
+
+def test_flip_mid_stream_drops_zero_requests():
+    """Satellite: a role flip while a stream is inflight loses nothing —
+    the flip steers NEW routing only; the running engine request keeps
+    pushing tokens, and the instance serves its new role afterwards."""
+    store = MemoryStore(clock=lambda: 0.0)
+    master = make_master(store)
+    insts = [
+        make_instance(master, f"g{i}", token_delay_s=0.05)
+        for i in range(2)
+    ]
+    try:
+        mgr = master.scheduler.instance_mgr
+        assert wait_until(lambda: mgr.counts()[:2] == (1, 1))
+        n_tokens = 24
+        prompt = "flip me please"
+        # Deterministic oracle for the streamed text, taken BEFORE any
+        # flip (FakeEngine output depends only on the prompt).
+        code, oracle = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "fake-echo", "prompt": prompt,
+             "max_tokens": n_tokens},
+            timeout=30.0,
+        )
+        assert code == 200
+        want_text = oracle["choices"][0]["text"]
+        got = {}
+
+        import threading
+
+        def stream():
+            events = sse_post(
+                master.http_address, "/v1/completions",
+                {"model": "fake-echo", "prompt": prompt,
+                 "max_tokens": n_tokens, "stream": True},
+                timeout=60.0,
+            )
+            got["texts"] = [
+                e["choices"][0]["text"] for e in events
+                if e != "[DONE]" and e.get("choices")
+            ]
+
+        t = threading.Thread(target=stream)
+        t.start()
+        time.sleep(0.3)  # a few tokens in
+        # Swap BOTH roles mid-stream. With a 1/1 census the never-empty
+        # guard blocks a direct swap, so the flip transits the MIX
+        # serving role — exactly the controller's transition path.
+        p = mgr.prefill_instances()[0]
+        d = mgr.decode_instances()[0]
+        assert mgr.flip_role(d, InstanceType.MIX, force=True)
+        assert mgr.flip_role(p, InstanceType.DECODE, force=True)
+        assert mgr.flip_role(d, InstanceType.PREFILL, force=True)
+        t.join(timeout=60.0)
+        assert not t.is_alive()
+        # Zero dropped requests, zero dropped or corrupted tokens.
+        assert "".join(got["texts"]) == want_text
+        # The flipped instances took the notification (engines learn
+        # their new serving role via /flip within a heartbeat or two).
+        assert wait_until(lambda: all(
+            getattr(s.engine, "serving_role", "")
+            == s.meta.current_type.name
+            for s in insts
+        ), timeout=10.0)
+        # And the reshaped fleet still serves new requests.
+        code, body = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "fake-echo", "prompt": "after flip",
+             "max_tokens": 4},
+            timeout=30.0,
+        )
+        assert code == 200 and body["choices"][0]["text"]
+    finally:
+        for s in insts:
+            s.stop()
+        master.stop()
+        store.close()
+
+
+def test_stale_epoch_flip_rpc_is_fenced():
+    """Satellite: a /flip stamped by a deposed master (lower epoch) is
+    412-rejected and does NOT change the serving role; the current
+    epoch's flip passes."""
+    store = MemoryStore(clock=lambda: 0.0)
+    master = make_master(store)
+    srv = make_instance(master, "f0")
+    try:
+        mgr = master.scheduler.instance_mgr
+        assert wait_until(lambda: sum(mgr.counts()) == 1)
+        # Raise the fence to 7.
+        code, _ = post_json(srv.address, "/health", {"master_epoch": 7})
+        assert code == 200
+        role_before = srv.meta.current_type.name
+        code, resp = post_json(
+            srv.address, "/flip",
+            {"role": "PREFILL" if role_before != "PREFILL" else "DECODE",
+             "master_epoch": 6},
+        )
+        assert code == 412 and resp.get("fenced") is True
+        assert srv.meta.current_type.name == role_before
+        # Current-epoch MIX flip is accepted (the /flip allowlist covers
+        # the controller's serving-MIX transitions).
+        code, resp = post_json(
+            srv.address, "/flip", {"role": "MIX", "master_epoch": 7},
+        )
+        assert code == 200 and resp["role"] == "MIX"
+        assert srv.meta.current_type == InstanceType.MIX
+    finally:
+        srv.stop()
+        master.stop()
+        store.close()
+
+
+# --------------------------------------------------------------------------
+# e2e differential: placement changes WHERE, never WHAT
+# --------------------------------------------------------------------------
+
+
+def _run_trace(master, prompts, max_tokens=6):
+    out = []
+    for p in prompts:
+        code, body = http_post(
+            master.http_address, "/v1/completions",
+            {"model": "fake-echo", "prompt": p, "max_tokens": max_tokens,
+             "temperature": 0.0},
+            timeout=60.0,
+        )
+        assert code == 200, body
+        out.append(body["choices"][0]["text"])
+    return out
+
+
+def test_placement_differential_byte_identical(monkeypatch):
+    """Forced-colocate, forced-disaggregate, and adaptive placement over
+    the same seeded trace return byte-identical streams, while the
+    decision counters prove the placements actually differed."""
+    prompts = [f"prompt number {i} with some tail" for i in range(8)]
+    results = {}
+    decisions = {}
+    for mode in ("disaggregate", "colocate", "adaptive"):
+        if mode == "adaptive":
+            monkeypatch.delenv("XLLM_GOODPUT_FORCE", raising=False)
+        else:
+            monkeypatch.setenv("XLLM_GOODPUT_FORCE", mode)
+        store = MemoryStore(clock=lambda: 0.0)
+        master = make_master(store)
+        insts = [make_instance(master, f"m{i}") for i in range(2)]
+        try:
+            mgr = master.scheduler.instance_mgr
+            assert wait_until(lambda: mgr.counts()[:2] == (1, 1))
+            results[mode] = _run_trace(master, prompts)
+            decisions[mode] = dict(master.scheduler.goodput.decisions)
+        finally:
+            for s in insts:
+                s.stop()
+            master.stop()
+            store.close()
+    assert results["colocate"] == results["disaggregate"]
+    assert results["adaptive"] == results["disaggregate"]
+    # The oracle runs really did place differently...
+    assert decisions["colocate"]["colocate"] == len(prompts)
+    assert decisions["disaggregate"]["disaggregate"] == len(prompts)
+    # ...and the adaptive run degraded safely (cold EWMA -> static) while
+    # still consulting the controller for every request.
+    assert sum(decisions["adaptive"].values()) == len(prompts)
+
+
+def test_placement_differential_under_master_flap(monkeypatch):
+    """Master kill + takeover mid-trace with the controller live: every
+    stream completes (0 unrecovered), and output equals the static
+    oracle's byte-for-byte."""
+    monkeypatch.setenv("XLLM_GOODPUT_FORCE", "colocate")
+    prompts = [f"chaos prompt {i}" for i in range(6)]
+
+    # Static oracle (no chaos, forced disaggregate).
+    monkeypatch.setenv("XLLM_GOODPUT_FORCE", "disaggregate")
+    store = MemoryStore(clock=lambda: 0.0)
+    master = make_master(store)
+    insts = [make_instance(master, f"o{i}") for i in range(2)]
+    try:
+        assert wait_until(
+            lambda: master.scheduler.instance_mgr.counts()[:2] == (1, 1)
+        )
+        want = _run_trace(master, prompts)
+    finally:
+        for s in insts:
+            s.stop()
+        master.stop()
+        store.close()
+
+    # Chaos run: colocate-forced decisions + a master flap mid-trace.
+    from tests.test_master_failover import expire_master_lease
+
+    monkeypatch.setenv("XLLM_GOODPUT_FORCE", "colocate")
+    store = MemoryStore()
+    m1 = make_master(store, master_lease_ttl_s=1.0)
+    insts = [make_instance(m1, f"c{i}") for i in range(2)]
+    m2 = None
+    try:
+        assert wait_until(
+            lambda: m1.scheduler.instance_mgr.counts()[:2] == (1, 1)
+        )
+        got = _run_trace(m1, prompts[:3])
+        # Standby joins, the active master's lease lapses, the standby
+        # takes over and reconciles the fleet.
+        m2 = make_master(store, master_lease_ttl_s=1.0)
+        expire_master_lease(store, m1)
+        assert wait_until(
+            lambda: m2.scheduler.is_master
+            and sum(m2.scheduler.instance_mgr.counts()) == 2,
+            timeout=20.0,
+        )
+        got += _run_trace(m2, prompts[3:])
+        assert got == want  # 0 unrecovered, byte-identical
+        assert m2.scheduler.goodput.decisions["colocate"] == 3
+    finally:
+        for s in insts:
+            s.stop()
+        if m2 is not None:
+            m2.stop()
+        m1.stop()
+        store.close()
+
+
+def test_role_metrics_exported():
+    """Satellite: xllm_service_role_flips_total and the per-role census
+    gauge (including MIX) are scrapeable from the master's /metrics."""
+    store = MemoryStore(clock=lambda: 0.0)
+    master = make_master(store)
+    insts = [make_instance(master, f"x{i}") for i in range(3)]
+    try:
+        mgr = master.scheduler.instance_mgr
+        assert wait_until(lambda: sum(mgr.counts()) == 3)
+        assert mgr.flip_role(mgr.prefill_instances()[0], InstanceType.MIX)
+        body = master.scheduler.metrics.render() + \
+            master.cluster_metrics.render()
+        assert "xllm_service_role_flips_total 1" in body
+        assert 'xllm_service_role_census{role="mix"} 1' in body
+        assert 'xllm_service_role_census{role="decode"} 1' in body
+        assert "xllm_goodput_decisions_total" in body
+    finally:
+        for s in insts:
+            s.stop()
+        master.stop()
+        store.close()

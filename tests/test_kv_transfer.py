@@ -33,6 +33,18 @@ from tests.test_api_e2e import http_post, wait_until
 BLOCK = 16
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+try:  # the pull plane needs jax.experimental.transfer (not in every build)
+    from jax.experimental import transfer as _jax_transfer  # noqa: F401
+
+    _HAVE_TRANSFER = True
+except ImportError:
+    _HAVE_TRANSFER = False
+
+requires_transfer = pytest.mark.skipif(
+    not _HAVE_TRANSFER,
+    reason="jax.experimental.transfer not available in this jax build",
+)
+
 
 def engine_cfg(name, itype, **kw):
     kw.setdefault("enable_local_kv_transfer", False)
@@ -45,6 +57,7 @@ def engine_cfg(name, itype, **kw):
     )
 
 
+@requires_transfer
 def test_offer_pull_roundtrip():
     """Offer/pull through the process transfer server's TCP transport
     (self-connection; the transport registry supports ONE server per
@@ -115,6 +128,7 @@ def colocated_oracle():
     store.close()
 
 
+@requires_transfer
 def test_pull_plane_pd_e2e(colocated_oracle):
     """PD pair with the pull plane enabled (local direct path disabled):
     the handoff POST carries no KV bytes; the decode side pulls from the
@@ -148,6 +162,7 @@ def test_pull_plane_pd_e2e(colocated_oracle):
 
 
 @pytest.mark.slow
+@requires_transfer
 def test_pd_e2e_cross_process(colocated_oracle):
     """REAL process boundary: the decode instance lives in a subprocess
     with its own JAX runtime; the prefill side offers device-resident KV

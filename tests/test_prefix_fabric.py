@@ -263,6 +263,14 @@ def cluster():
     kv = GlobalKVCacheMgr(store, is_master=lambda: True, block_size=BS)
     _register(store, "a")
     _register(store, "b")
+    # Store watch callbacks run on a notifier thread; wait until the
+    # registrations are visible to InstanceMgr so plan_fetch can resolve
+    # holder addresses regardless of test execution order.
+    deadline = time.monotonic() + 5.0
+    while mgr.get_instance("a") is None or mgr.get_instance("b") is None:
+        if time.monotonic() > deadline:
+            raise RuntimeError("cluster fixture: registrations not ingested")
+        time.sleep(0.005)
     fab = PrefixFabric(None, mgr, kv)
     yield store, mgr, kv, fab
     mgr.close()

@@ -22,21 +22,23 @@ def test_routing_roundtrip():
 def test_load_metrics_roundtrip():
     m = LoadMetrics(
         waiting_requests_num=7, gpu_cache_usage_perc=0.42,
-        moe_hot_expert_frac=0.31,
+        moe_hot_expert_frac=0.31, kv_stall_ms_ewma=12.5,
     )
     assert LoadMetrics.from_json(m.to_json()) == m
     # Reference wire field names preserved, plus the expert-hotness
-    # extension (ISSUE 15, docs/MOE.md).
+    # (ISSUE 15, docs/MOE.md) and handoff-stall (ISSUE 16,
+    # docs/PD_DISAGGREGATION.md "Goodput controller") extensions.
     assert set(m.to_json()) == {
         "waiting_requests_num", "gpu_cache_usage_perc",
-        "moe_hot_expert_frac",
+        "moe_hot_expert_frac", "kv_stall_ms_ewma",
     }
-    # The extension is OPTIONAL on the wire: a reference-shaped payload
-    # (old-build instance) decodes with the field inert at 0.0.
+    # The extensions are OPTIONAL on the wire: a reference-shaped
+    # payload (old-build instance) decodes with the fields inert at 0.0.
     old = LoadMetrics.from_json(
         {"waiting_requests_num": 7, "gpu_cache_usage_perc": 0.42}
     )
     assert old.moe_hot_expert_frac == 0.0
+    assert old.kv_stall_ms_ewma == 0.0
     assert old.waiting_requests_num == 7
 
 

@@ -239,7 +239,7 @@ class InstanceServer(
                 self._master,
                 self.meta,
                 interval_s=heartbeat_interval_s,
-                collect_load=self.engine.get_load_metrics,
+                collect_load=self._collect_load,
                 collect_latency=self.engine.get_latency_metrics,
                 collect_cache_event=self.engine.take_cache_event,
                 collect_cache_snapshot=getattr(
@@ -352,6 +352,20 @@ class InstanceServer(
             )
 
     # ------------------------------------------------------------------ #
+    def _collect_load(self):
+        """Heartbeat load snapshot: the engine's own metrics stamped with
+        the KV-handoff stall EWMA folded from _kv_stall_samples — the
+        goodput controller's live disaggregation-cost signal (0.0 until
+        this instance has completed a handoff)."""
+        lm = self.engine.get_load_metrics()
+        samples = list(self._kv_stall_samples)
+        if samples:
+            ewma = samples[0][1]
+            for _, stall_ms in samples[1:]:
+                ewma += 0.3 * (stall_ms - ewma)
+            lm.kv_stall_ms_ewma = ewma
+        return lm
+
     def start(self) -> None:
         with _LOCAL_MU:
             _LOCAL_INSTANCES[self.name] = self
@@ -874,7 +888,7 @@ class InstanceServer(
             # recompilation is needed — the role re-points heartbeat
             # metadata and is observable on /metrics.
             role = str(body.get("role", ""))
-            if role not in ("PREFILL", "DECODE"):
+            if role not in ("PREFILL", "DECODE", "MIX"):
                 h.send_error_json(400, f"bad role {role!r}")
                 return
             # current_type is the SERVING role; meta.type stays the

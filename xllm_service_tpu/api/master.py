@@ -226,6 +226,23 @@ class Master:
             "xllm_cluster_pd_flips_total",
             "Dynamic PREFILL<->DECODE role flips applied by the master",
         ).set_function(lambda: mgr.total_flips)
+        # Reshaping observability (ISSUE 16 satellite): the same flip
+        # counter under the service namespace plus a census gauge that —
+        # unlike xllm_cluster_instances — includes the MIX serving role.
+        self.cluster_metrics.counter(
+            "xllm_service_role_flips_total",
+            "Role flips applied by the master (all transitions, "
+            "including MIX)",
+        ).set_function(lambda: mgr.total_flips)
+        census_gauge = self.cluster_metrics.gauge(
+            "xllm_service_role_census",
+            "Instances by current serving role, including MIX",
+            labelnames=("role",),
+        )
+        for role in ("prefill", "decode", "encode", "mix"):
+            census_gauge.labels(role=role).set_function(
+                lambda r=role: float(mgr.role_census()[r])
+            )
         self.cluster_metrics.counter(
             "xllm_cluster_breaker_ejections_total",
             "Instances ejected by the health circuit breaker",
@@ -1239,9 +1256,9 @@ class Master:
             reported
             and meta is not None
             and reported != meta.current_type.name
-            # Only PD roles are flip-notifiable; an ENCODE instance can
-            # never accept /flip, so a mismatch there must not loop.
-            and meta.current_type.name in ("PREFILL", "DECODE")
+            # Only PD/MIX roles are flip-notifiable; an ENCODE instance
+            # can never accept /flip, so a mismatch there must not loop.
+            and meta.current_type.name in ("PREFILL", "DECODE", "MIX")
         ):
             self.scheduler.instance_mgr.requeue_flip(name, 1)
         resp: Dict[str, Any] = {"ok": True}

@@ -1,0 +1,449 @@
+"""Goodput controller plane: per-request colocate-vs-disaggregate placement
+plus continuous PD role reshaping.
+
+Neither static deployment mode wins across a mixed trace (arxiv
+2508.01989): short-decode requests pay the KV-handoff stall for nothing
+under disaggregation, long-decode requests suffer prefill interference
+when colocated, and the right PD ratio tracks the load mix rather than
+being provisioned once (P/D-Serve, arxiv 2408.08147). This module is the
+master-side controller that decides both, from signals the cluster
+already publishes:
+
+- estimated prefill cost: the instance's fitted TTFT curve over the
+  prompt tokens NOT covered by local/fabric prefix cache;
+- predicted decode length: a per-tenant EWMA over observed completions
+  (tenant = model name — the strongest cheap predictor of output length);
+- live handoff-stall: the per-instance `kv_stall_ms_ewma` heartbeat
+  scalar folded from the xllm_kv_handoff_stall_ms stream, with a
+  fleet-mean fallback for instances that have not pulled yet;
+- decode-side TPOT headroom: the fitted TPOT curve inflated by queue
+  depth and `moe_hot_expert_frac` (a hot expert serializes the grouped
+  dispatch for every request in the batch).
+
+The controller only ACTS when its inputs are trustworthy: off, cold
+EWMA, stale EWMA, missing predictor, or a non-MIX target all degrade to
+the static routing the policy already chose — every decision, including
+the fallbacks, is counted in `xllm_goodput_decisions_total{mode}`.
+
+Reshaping is deliberately slow: one flip per qualifying tick, after
+`hysteresis_ticks` consecutive ticks agreeing on the direction and at
+least `min_flip_interval_s` since the last flip. Flips go through the
+drain-aware `InstanceMgr.flip_role` (idle-only), escalating to
+`force=True` only after the same want has persisted past
+`drain_timeout_s` — forced flips never kill inflight streams (the role
+only steers NEW routing; token replay covers redispatch).
+
+Hatches (all read per call so they flip on a live cluster):
+  XLLM_GOODPUT_CONTROLLER=1|0      master on/off override
+  XLLM_GOODPUT_FORCE=colocate|disaggregate
+                                   pin every actionable decision (bench
+                                   baselines and differential oracles)
+  XLLM_GOODPUT_MIN_SAMPLES         EWMA completions before acting
+  XLLM_GOODPUT_STALE_S             EWMA freshness window, seconds
+  XLLM_GOODPUT_COLOCATE_MARGIN     colocate iff coloc <= disagg * margin
+  XLLM_GOODPUT_HYSTERESIS_TICKS    same-direction ticks before a flip
+  XLLM_GOODPUT_MIN_FLIP_INTERVAL_S floor between reshaping flips
+  XLLM_GOODPUT_DRAIN_TIMEOUT_S     want age before force-flipping
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from xllm_service_tpu.common.types import InstanceType
+
+logger = logging.getLogger(__name__)
+
+# Decode-length EWMA smoothing: ~last dozen completions dominate.
+EWMA_ALPHA = 0.3
+# TPOT inflation per waiting request on the serving instance: queueing
+# delays every decode step of the new request.
+WAITING_PENALTY = 0.08
+# TPOT inflation at moe_hot_expert_frac=1.0 (one expert owns every
+# assignment — the grouped dispatch degenerates to serial).
+MOE_PENALTY = 0.5
+# Recent decisions window for the reshaper's colocate-fraction signal.
+DECISION_WINDOW = 64
+
+
+def goodput_enabled(cfg=None) -> bool:
+    """XLLM_GOODPUT_CONTROLLER=1|0 overrides config either way; read per
+    call so the hatch flips on a live cluster."""
+    env = os.environ.get("XLLM_GOODPUT_CONTROLLER")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    return bool(getattr(cfg, "enable_goodput_controller", True))
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+@dataclass
+class PlacementDecision:
+    """One per-request verdict plus the numbers behind it (observability:
+    the bench and tests read these, the mode label feeds the counter)."""
+
+    mode: str           # "colocate" | "disaggregate" | "static"
+    reason: str         # why — "model" for a real comparison, else gate
+    coloc_ms: float = 0.0
+    disagg_ms: float = 0.0
+    decode_est: float = 0.0
+    stall_ms: float = 0.0
+
+    @property
+    def acted(self) -> bool:
+        return self.mode in ("colocate", "disaggregate")
+
+
+@dataclass
+class _TenantStats:
+    ewma: float = 0.0
+    n: int = 0
+    ts: float = 0.0
+
+
+class GoodputController:
+    """Master-side goodput controller (see module docstring). Constructed
+    by the scheduler next to PrefixFabric; all methods are thread-safe
+    (decisions on the dispatch path, ticks on the master loop)."""
+
+    def __init__(self, config, instance_mgr, metrics=None,
+                 clock=time.monotonic):
+        self._config = config
+        self._mgr = instance_mgr
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._tenants: Dict[str, _TenantStats] = {}
+        self._recent_modes = collections.deque(maxlen=DECISION_WINDOW)
+        # Reshaping hysteresis state.
+        self._want_dir = 0          # -1 shrink prefill, +1 grow prefill
+        self._want_streak = 0
+        self._want_since = 0.0
+        self._last_flip_ts = 0.0
+        self.decisions = {"colocate": 0, "disaggregate": 0, "static": 0}
+        self.reshape_flips = 0
+        self._wanted_census = {"prefill": 0, "decode": 0, "mix": 0}
+        self._decisions_total = None
+        self._flips_total = None
+        if metrics is not None:
+            self._decisions_total = metrics.counter(
+                "xllm_goodput_decisions_total",
+                "Per-request placement decisions by mode "
+                "(colocate/disaggregate/static fallback)",
+                labelnames=("mode",),
+            )
+            self._flips_total = metrics.counter(
+                "xllm_goodput_reshape_flips_total",
+                "Reshaping role flips issued by the controller",
+                labelnames=("direction",),
+            )
+            wanted = metrics.gauge(
+                "xllm_goodput_wanted_census",
+                "Role census the reshaper currently wants",
+                labelnames=("role",),
+            )
+            for role in ("prefill", "decode", "mix"):
+                wanted.labels(role=role).set_function(
+                    lambda r=role: float(self._wanted_census[r])
+                )
+
+    # ------------------------------------------------------------------ #
+    # signals
+    # ------------------------------------------------------------------ #
+
+    def enabled(self) -> bool:
+        return goodput_enabled(self._config)
+
+    def observe_completion(self, tenant: str, generated_tokens: int) -> None:
+        """Feed one clean completion into the tenant's decode-length EWMA
+        (scheduler.finish_request; cancelled/errored streams are skipped —
+        a truncated length would bias the predictor low)."""
+        if generated_tokens <= 0:
+            return
+        with self._mu:
+            st = self._tenants.setdefault(tenant, _TenantStats())
+            if st.n == 0:
+                st.ewma = float(generated_tokens)
+            else:
+                st.ewma += EWMA_ALPHA * (generated_tokens - st.ewma)
+            st.n += 1
+            st.ts = self._clock()
+
+    def predicted_decode_len(self, tenant: str) -> Optional[float]:
+        """EWMA estimate, or None while cold/stale (the decision then
+        degrades to static)."""
+        min_n = _env_int("XLLM_GOODPUT_MIN_SAMPLES", 4)
+        stale_s = _env_float("XLLM_GOODPUT_STALE_S", 30.0)
+        with self._mu:
+            st = self._tenants.get(tenant)
+            if st is None or st.n < min_n:
+                return None
+            if self._clock() - st.ts > stale_s:
+                return None
+            return st.ewma
+
+    def stall_estimate_ms(self, decode_name: str) -> float:
+        """Expected KV-handoff stall if this request disaggregates onto
+        `decode_name`: its own heartbeat EWMA, else the fleet mean over
+        instances that HAVE pulled (0.0 when nobody has — first requests
+        assume the wire is free until told otherwise)."""
+        load = self._mgr.get_load_metrics()
+        own = load.get(decode_name)
+        if own is not None and own.kv_stall_ms_ewma > 0.0:
+            return own.kv_stall_ms_ewma
+        seen = [
+            lm.kv_stall_ms_ewma for lm in load.values()
+            if lm.kv_stall_ms_ewma > 0.0
+        ]
+        return sum(seen) / len(seen) if seen else 0.0
+
+    def _effective_tpot_ms(self, name: str, prompt_len: int,
+                           decode_est: float) -> Optional[float]:
+        """Fitted TPOT at the instance's CURRENT batch inflated by queue
+        depth and expert hotness; None without a published model."""
+        pred = self._mgr.get_time_predictor(name)
+        if pred is None or not pred.has_tpot_model:
+            return None
+        rm = self._mgr.get_request_metrics(name)
+        batch = (rm.decode_request_num if rm is not None else 0) + 1
+        tokens = (rm.decode_token_num if rm is not None else 0)
+        tpot = pred.predict_tpot(batch, tokens + prompt_len + int(decode_est))
+        lm = self._mgr.get_load_metrics().get(name)
+        if lm is not None:
+            tpot *= 1.0 + WAITING_PENALTY * lm.waiting_requests_num
+            tpot *= 1.0 + MOE_PENALTY * lm.moe_hot_expert_frac
+        return max(tpot, 0.0)
+
+    # ------------------------------------------------------------------ #
+    # half (a): per-request placement
+    # ------------------------------------------------------------------ #
+
+    def decide_placement(self, prompt_len: int, tenant: str, routing,
+                         covered_tokens: int = 0) -> PlacementDecision:
+        """Choose COLOCATED (decode rides routing.prefill_name's mixed hot
+        loop) vs DISAGGREGATED (keep the policy's PD pair). Every gate
+        that prevents a real comparison returns mode="static" — the
+        caller leaves the routing untouched."""
+        d = self._decide(prompt_len, tenant, routing, covered_tokens)
+        self.decisions[d.mode] = self.decisions.get(d.mode, 0) + 1
+        if self._decisions_total is not None:
+            self._decisions_total.labels(mode=d.mode).inc()
+        if d.acted:
+            with self._mu:
+                self._recent_modes.append(d.mode)
+        return d
+
+    def _decide(self, prompt_len, tenant, routing,
+                covered_tokens) -> PlacementDecision:
+        if not self.enabled():
+            return PlacementDecision("static", "disabled")
+        p_name = getattr(routing, "prefill_name", "")
+        d_name = getattr(routing, "decode_name", "")
+        if not p_name or not d_name or p_name == d_name:
+            return PlacementDecision("static", "already-colocated")
+        meta = self._mgr.get_instance(p_name)
+        if meta is None or meta.type != InstanceType.MIX:
+            # Colocating needs the target's one-dispatch mixed hot loop.
+            return PlacementDecision("static", "target-not-mix")
+        force = os.environ.get("XLLM_GOODPUT_FORCE", "")
+        if force in ("colocate", "disaggregate"):
+            return PlacementDecision(force, "forced")
+        decode_est = self.predicted_decode_len(tenant)
+        if decode_est is None:
+            return PlacementDecision("static", "ewma-cold-or-stale")
+        coloc_tpot = self._effective_tpot_ms(p_name, prompt_len, decode_est)
+        disagg_tpot = self._effective_tpot_ms(d_name, prompt_len, decode_est)
+        if coloc_tpot is None or disagg_tpot is None:
+            return PlacementDecision("static", "no-predictor")
+        # TTFT is paid on p_name under BOTH placements, so it cancels out
+        # of the comparison; keep it in the reported totals when a model
+        # exists (prefix/fabric-covered tokens don't need recompute).
+        pred = self._mgr.get_time_predictor(p_name)
+        eff_prompt = max(1, prompt_len - max(0, covered_tokens))
+        ttft = (
+            pred.predict_ttft(eff_prompt)
+            if pred is not None and pred.has_ttft_model else 0.0
+        )
+        stall = self.stall_estimate_ms(d_name)
+        coloc_ms = ttft + decode_est * coloc_tpot
+        disagg_ms = ttft + stall + decode_est * disagg_tpot
+        margin = _env_float("XLLM_GOODPUT_COLOCATE_MARGIN", 1.0)
+        mode = "colocate" if coloc_ms <= disagg_ms * margin else "disaggregate"
+        return PlacementDecision(
+            mode, "model",
+            coloc_ms=coloc_ms, disagg_ms=disagg_ms,
+            decode_est=decode_est, stall_ms=stall,
+        )
+
+    # ------------------------------------------------------------------ #
+    # half (b): fleet reshaping
+    # ------------------------------------------------------------------ #
+
+    def colocate_fraction(self) -> float:
+        """Share of recent ACTED decisions that chose colocation."""
+        with self._mu:
+            if not self._recent_modes:
+                return 0.0
+            coloc = sum(1 for m in self._recent_modes if m == "colocate")
+            return coloc / len(self._recent_modes)
+
+    def wanted_census(self) -> Dict[str, int]:
+        return dict(self._wanted_census)
+
+    def tick(self) -> str:
+        """One reshaping step (master loop, heartbeat cadence): compute
+        the wanted role census from windowed load, damp with hysteresis,
+        and issue AT MOST one drain-aware flip. Returns the flipped
+        instance's name or ''."""
+        if not self.enabled():
+            self._want_streak = 0
+            self._want_dir = 0
+            return ""
+        census = self._mgr.role_census()
+        cur_p, cur_d = census["prefill"], census["decode"]
+        n = cur_p + cur_d
+        if n < 2:
+            return ""
+        demand_p, demand_d = self._demand()
+        want_p = self._wanted_prefill(n, demand_p, demand_d, cur_p)
+        self._wanted_census = {
+            "prefill": want_p, "decode": n - want_p, "mix": census["mix"],
+        }
+        now = self._clock()
+        direction = (want_p > cur_p) - (want_p < cur_p)
+        if direction == 0:
+            self._want_streak = 0
+            self._want_dir = 0
+            return self._tick_mix(census, now)
+        if direction == self._want_dir:
+            self._want_streak += 1
+        else:
+            self._want_dir = direction
+            self._want_streak = 1
+            self._want_since = now
+        ticks = _env_int("XLLM_GOODPUT_HYSTERESIS_TICKS", 3)
+        min_interval = _env_float("XLLM_GOODPUT_MIN_FLIP_INTERVAL_S", 10.0)
+        if self._want_streak < ticks:
+            return ""
+        if now - self._last_flip_ts < min_interval:
+            return ""
+        if direction > 0:
+            flipped = self._mgr.flip_decode_to_prefill()
+            label = "decode_to_prefill"
+        else:
+            flipped = self._mgr.flip_prefill_to_decode()
+            label = "prefill_to_decode"
+        if not flipped:
+            # Every candidate is busy (drain-aware refusal). After the
+            # same want has persisted past the drain timeout, force the
+            # least-loaded declared-MIX candidate: inflight streams keep
+            # running, only NEW routing changes.
+            drain_s = _env_float("XLLM_GOODPUT_DRAIN_TIMEOUT_S", 30.0)
+            if now - self._want_since >= drain_s:
+                source = (
+                    self._mgr.decode_instances() if direction > 0
+                    else self._mgr.prefill_instances()
+                )
+                target = (
+                    InstanceType.PREFILL if direction > 0
+                    else InstanceType.DECODE
+                )
+                for name in source:
+                    flipped = self._mgr.flip_role(name, target, force=True)
+                    if flipped:
+                        break
+        if flipped:
+            self._last_flip_ts = now
+            self._want_streak = 0
+            self.reshape_flips += 1
+            if self._flips_total is not None:
+                self._flips_total.labels(direction=label).inc()
+            logger.info("goodput reshape: %s (%s)", flipped, label)
+            return flipped
+        return ""
+
+    def _demand(self):
+        """Windowed per-side work: prefill demand from queued prefill
+        time/requests, decode demand from running decodes + waiting."""
+        demand_p = 0.0
+        demand_d = 0.0
+        load = self._mgr.get_load_metrics()
+        for meta in self._mgr.list_instances():
+            rm = self._mgr.get_request_metrics(meta.name)
+            if rm is None:
+                continue
+            demand_p += rm.prefill_request_num
+            demand_d += rm.decode_request_num
+            lm = load.get(meta.name)
+            if lm is not None:
+                demand_d += lm.waiting_requests_num
+        return demand_p, demand_d
+
+    @staticmethod
+    def _wanted_prefill(n, demand_p, demand_d, cur_p):
+        total = demand_p + demand_d
+        if total <= 0:
+            return cur_p  # idle fleet: leave the census alone
+        want = round(n * demand_p / total)
+        return max(1, min(n - 1, int(want)))
+
+    def _tick_mix(self, census, now) -> str:
+        """Serving-MIX transitions, only attempted when the PD census is
+        already where we want it: a sustained colocate-heavy mix earns a
+        dedicated MIX-serving instance (both sides route to it); a
+        colocate-light mix returns it to the thinner side."""
+        frac = self.colocate_fraction()
+        min_interval = _env_float("XLLM_GOODPUT_MIN_FLIP_INTERVAL_S", 10.0)
+        if now - self._last_flip_ts < min_interval:
+            return ""
+        flipped = ""
+        if frac >= 0.6 and census["mix"] == 0 and len(self._recent_modes) >= 8:
+            donor_side = (
+                self._mgr.prefill_instances()
+                if census["prefill"] >= census["decode"]
+                else self._mgr.decode_instances()
+            )
+            for name in donor_side:
+                flipped = self._mgr.flip_role(name, InstanceType.MIX)
+                if flipped:
+                    break
+            label = "to_mix"
+        elif frac <= 0.2 and census["mix"] > 0:
+            target = (
+                InstanceType.PREFILL
+                if census["prefill"] <= census["decode"]
+                else InstanceType.DECODE
+            )
+            for name in self._mgr.mix_instances():
+                flipped = self._mgr.flip_role(name, target)
+                if flipped:
+                    break
+            label = "from_mix"
+        else:
+            return ""
+        if flipped:
+            self._last_flip_ts = now
+            self.reshape_flips += 1
+            if self._flips_total is not None:
+                self._flips_total.labels(direction=label).inc()
+            logger.info("goodput reshape: %s (%s)", flipped, label)
+        return flipped

@@ -135,6 +135,7 @@ class InstanceMgr:
         self._prefill_index: List[str] = []
         self._decode_index: List[str] = []
         self._encode_index: List[str] = []
+        self._mix_index: List[str] = []  # serving BOTH sides at once
         self._index_pos: Dict[str, int] = {}  # name -> position in its index
 
         self._predictors: Dict[str, TimePredictor] = {}
@@ -262,6 +263,7 @@ class InstanceMgr:
             InstanceType.PREFILL: self._prefill_index,
             InstanceType.DECODE: self._decode_index,
             InstanceType.ENCODE: self._encode_index,
+            InstanceType.MIX: self._mix_index,
         }[role]
 
     def _push_index(self, name: str, role: InstanceType) -> None:
@@ -407,6 +409,18 @@ class InstanceMgr:
                 len(self._encode_index),
             )
 
+    def role_census(self) -> Dict[str, int]:
+        """Per-role instance counts by CURRENT serving role, including the
+        MIX serving role (which `counts()` predates and must not grow —
+        callers pattern-match its 3-tuple)."""
+        with self._mu:
+            return {
+                "prefill": len(self._prefill_index),
+                "decode": len(self._decode_index),
+                "encode": len(self._encode_index),
+                "mix": len(self._mix_index),
+            }
+
     def prefill_instances(self) -> List[str]:
         with self._mu:
             return list(self._prefill_index)
@@ -418,6 +432,10 @@ class InstanceMgr:
     def encode_instances(self) -> List[str]:
         with self._mu:
             return list(self._encode_index)
+
+    def mix_instances(self) -> List[str]:
+        with self._mu:
+            return list(self._mix_index)
 
     def get_time_predictor(self, name: str) -> Optional[TimePredictor]:
         with self._mu:
@@ -599,12 +617,13 @@ class InstanceMgr:
         return good or fallback
 
     def routable_prefill_instances(self) -> List[str]:
+        # MIX-serving instances take work on both sides.
         with self._mu:
-            return self._routable(self._prefill_index)
+            return self._routable(self._prefill_index + self._mix_index)
 
     def routable_decode_instances(self) -> List[str]:
         with self._mu:
-            return self._routable(self._decode_index)
+            return self._routable(self._decode_index + self._mix_index)
 
     # ------------------------------------------------------------------ #
     # routing primitives
@@ -618,8 +637,8 @@ class InstanceMgr:
         never picked, suspect ones only when nothing healthier exists."""
         with self._mu:
             routing = Routing()
-            prefill = self._routable(self._prefill_index)
-            decode = self._routable(self._decode_index)
+            prefill = self._routable(self._prefill_index + self._mix_index)
+            decode = self._routable(self._decode_index + self._mix_index)
             if prefill:
                 routing.prefill_name = prefill[
                     self._rr_prefill % len(prefill)
@@ -900,8 +919,12 @@ class InstanceMgr:
         Falls back to round-robin when predictors are absent.
         """
         with self._mu:
-            prefill_candidates = self._routable(self._prefill_index)
-            decode_candidates = self._routable(self._decode_index)
+            prefill_candidates = self._routable(
+                self._prefill_index + self._mix_index
+            )
+            decode_candidates = self._routable(
+                self._decode_index + self._mix_index
+            )
             have_models = any(
                 self._predictors.get(n) is not None
                 and self._predictors[n].has_ttft_model
@@ -1026,6 +1049,72 @@ class InstanceMgr:
                 logger.info("flipped %s decode->prefill", name)
                 return name
             return ""
+
+    @staticmethod
+    def _side_coverage(role: InstanceType) -> Tuple[int, int]:
+        """(prefill, decode) coverage contributed by one serving role."""
+        if role == InstanceType.PREFILL:
+            return (1, 0)
+        if role == InstanceType.DECODE:
+            return (0, 1)
+        if role == InstanceType.MIX:
+            return (1, 1)
+        return (0, 0)
+
+    def flip_role(
+        self,
+        name: str,
+        target: InstanceType,
+        force: bool = False,
+    ) -> str:
+        """Targeted role transition for the goodput controller, covering
+        MIX serving transitions the paired primitives above cannot express.
+        Only declared-MIX instances flip. Drain-aware: refuses while the
+        instance still holds work on the side it is leaving, unless
+        `force=True` (after a drain timeout; inflight streams keep running —
+        the role only steers NEW routing, token replay recovers the rest).
+        Never leaves either the prefill or decode side uncovered. Returns
+        the name on success, '' otherwise."""
+        if isinstance(target, str):
+            target = InstanceType.parse(target)
+        if target not in (
+            InstanceType.PREFILL, InstanceType.DECODE, InstanceType.MIX,
+        ):
+            return ""
+        with self._mu:
+            meta = self._instances.get(name)
+            if meta is None or meta.type != InstanceType.MIX:
+                return ""
+            cur = meta.current_type
+            if cur == target or cur not in (
+                InstanceType.PREFILL, InstanceType.DECODE, InstanceType.MIX,
+            ):
+                return ""
+            if not force:
+                rm = self._request_metrics.get(name)
+                if rm is not None:
+                    lose_p, lose_d = self._side_coverage(cur)
+                    gain_p, gain_d = self._side_coverage(target)
+                    if lose_p > gain_p and rm.prefill_request_num > 0:
+                        return ""
+                    if lose_d > gain_d and rm.decode_request_num > 0:
+                        return ""
+            p_cov = len(self._prefill_index) + len(self._mix_index)
+            d_cov = len(self._decode_index) + len(self._mix_index)
+            cp, cd = self._side_coverage(cur)
+            tp, td = self._side_coverage(target)
+            if p_cov - cp + tp < 1 or d_cov - cd + td < 1:
+                return ""  # never empty a side
+            self._pop_index(name, cur)
+            self._push_index(name, target)
+            meta.current_type = target
+            self._flip_events.append((name, 1))
+            self.total_flips += 1
+            logger.info(
+                "flipped %s %s->%s%s", name, cur.name, target.name,
+                " (forced)" if force else "",
+            )
+            return name
 
     def take_flip_events(self):
         """Drain pending (instance, attempt) flip notifications — the

@@ -104,6 +104,13 @@ class ServiceConfig:
     # either way (read per call, so the hatch flips on a live cluster).
     enable_prefix_fabric: bool = True
 
+    # Goodput controller plane (cluster/goodput.py): per-request
+    # colocate-vs-disaggregate placement plus continuous PD role
+    # reshaping. The env var XLLM_GOODPUT_CONTROLLER=1|0 overrides this
+    # field either way (read per call); when off or when its input
+    # signals are stale the scheduler keeps today's static behavior.
+    enable_goodput_controller: bool = True
+
     # Tokenizer / template (reference: --tokenizer_path).
     tokenizer_path: str = ""
 

@@ -100,12 +100,18 @@ class LoadMetrics:
     # docs/MOE.md). Optional on the wire: old-build instances simply
     # report 0.0.
     moe_hot_expert_frac: float = 0.0
+    # EWMA of observed KV handoff stall per pulled request, milliseconds
+    # (the xllm_kv_handoff_stall_ms stream folded into one scalar) — the
+    # goodput controller's live disaggregation-cost signal. Optional on
+    # the wire: old-build instances report 0.0 (= "no stall observed").
+    kv_stall_ms_ewma: float = 0.0
 
     def to_json(self) -> Dict[str, Any]:
         return {
             "waiting_requests_num": self.waiting_requests_num,
             "gpu_cache_usage_perc": self.gpu_cache_usage_perc,
             "moe_hot_expert_frac": self.moe_hot_expert_frac,
+            "kv_stall_ms_ewma": self.kv_stall_ms_ewma,
         }
 
     @classmethod
@@ -114,6 +120,7 @@ class LoadMetrics:
             waiting_requests_num=int(j["waiting_requests_num"]),
             gpu_cache_usage_perc=float(j["gpu_cache_usage_perc"]),
             moe_hot_expert_frac=float(j.get("moe_hot_expert_frac", 0.0)),
+            kv_stall_ms_ewma=float(j.get("kv_stall_ms_ewma", 0.0)),
         )
 
 

@@ -32,6 +32,7 @@ import numpy as np
 
 from xllm_service_tpu.cluster.encoder_fabric import EncoderFabric
 from xllm_service_tpu.cluster.global_kvcache_mgr import GlobalKVCacheMgr
+from xllm_service_tpu.cluster.goodput import GoodputController
 from xllm_service_tpu.cluster.instance_mgr import HealthState, InstanceMgr
 from xllm_service_tpu.cluster.policies import LoadBalancePolicy, make_policy
 from xllm_service_tpu.cluster.prefix_fabric import PrefixFabric
@@ -305,6 +306,12 @@ class Scheduler:
         # Fed by ENCODE-role heartbeat cache deltas; pruned/resynced with
         # the same breaker hardening as the KV index.
         self.encoder_fabric = EncoderFabric(
+            config, self._instance_mgr, metrics=self.metrics,
+        )
+        # Goodput controller plane (cluster/goodput.py): per-request
+        # colocate-vs-disaggregate placement consulted in schedule(),
+        # plus the periodic role-reshaping tick on the master loop.
+        self.goodput = GoodputController(
             config, self._instance_mgr, metrics=self.metrics,
         )
         self._policy: LoadBalancePolicy = make_policy(
@@ -689,6 +696,10 @@ class Scheduler:
             try:
                 self._kvcache_mgr.upload_kvcache()
                 self._instance_mgr.upload_load_metrics()
+                # Goodput reshaping: at most one hysteresis-damped,
+                # drain-aware role flip per tick (no-op when the
+                # controller is off or the fleet census already fits).
+                self.goodput.tick()
                 # Health breaker upkeep: silent instances turn suspect
                 # before the prune backstop removes them, and ejected ones
                 # get an active /health probe toward probation.
@@ -784,6 +795,28 @@ class Scheduler:
         )
         if not request.routing.prefill_name and not request.routing.decode_name:
             return Status(StatusCode.UNAVAILABLE, "no instances registered")
+        if not request.media_parts:
+            # Goodput placement (cluster/goodput.py): colocate the decode
+            # onto the routed prefill instance's mixed hot loop when the
+            # model says the handoff isn't worth it. Gated decisions
+            # (controller off, cold EWMA, non-MIX target, ...) come back
+            # "static" and leave the policy's pair untouched.
+            try:
+                covered = 0
+                if scores is not None:
+                    covered = int(
+                        self.prefix_fabric.effective_matched(
+                            request.routing.prefill_name, scores
+                        ) * self._config.block_size
+                    )
+                decision = self.goodput.decide_placement(
+                    len(request.token_ids), request.model, request.routing,
+                    covered_tokens=covered,
+                )
+                if decision.mode == "colocate":
+                    request.routing.decode_name = request.routing.prefill_name
+            except Exception:
+                logger.exception("goodput placement decision failed")
         if request.media_parts:
             # Three-stage EPD routing: the encoder runs before prefill.
             # Route by MODALITY — encoders host one tower each — and,
@@ -1495,6 +1528,13 @@ class Scheduler:
             else "ok"
         )
         self._m_finished.labels(outcome=outcome).inc()
+        if outcome == "ok":
+            # Clean completions feed the goodput controller's per-tenant
+            # decode-length EWMA (cancelled/errored lengths would bias
+            # the predictor low).
+            self.goodput.observe_completion(
+                request.model, request.num_generated_tokens
+            )
         if self._tracer.enabled:
             terminal = {"ok": "finish", "error": "error"}.get(
                 outcome, "cancel"
