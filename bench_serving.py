@@ -924,6 +924,301 @@ def run_pd_adapt_bench(args) -> None:
         sys.exit(rc)
 
 
+def _trace_tails_guard(line: str) -> "tuple[str, int]":
+    """Exit-3 guard for the --trace-tails blame-attribution row (ISSUE
+    17). The bench injects a known bottleneck (a KV wire stall on every
+    --tails-stall-every'th handoff) and then asks the tracing plane to
+    find it: the per-stage blame summed across the pulled p99-tail
+    traces must be dominated by the injected stage, and every tail's
+    assembled timeline must span master + prefill + decode (>= 3
+    processes) — a collector that lost a participant would still print
+    plausible numbers. Abstains LOUDLY when the row is unparseable;
+    passes through non-JSON lines untouched.
+    XLLM_BENCH_NO_REGRESSION_GUARD disarms it.
+    """
+    import os
+
+    if os.environ.get("XLLM_BENCH_NO_REGRESSION_GUARD"):
+        return line, 0
+    try:
+        res = json.loads(line)
+    except ValueError:
+        return line, 0
+    if res.get("metric") != "trace_tails":
+        return line, 0
+    tails = res.get("tails")
+    injected = res.get("injected")
+    if not tails or not injected:
+        res["trace_tails_guard"] = (
+            "FAIL: no tail traces were assembled — the collector or the "
+            "participant index lost the p99 requests"
+        )
+        return json.dumps(res), 3
+    reasons = []
+    sums = {}
+    for t in tails:
+        blame = t.get("blame_ms") or {}
+        for k, v in blame.items():
+            if k != "total":
+                sums[k] = sums.get(k, 0.0) + float(v)
+        if len(t.get("processes") or []) < 3:
+            reasons.append(
+                f"tail {t.get('srid')} spans "
+                f"{len(t.get('processes') or [])} processes (< 3): a "
+                f"participant's spans dropped out of the assembly"
+            )
+    if not sums:
+        reasons.append("tail traces carry no blame_ms edges")
+    else:
+        dominant = max(sums, key=lambda k: sums[k])
+        res["dominant"] = dominant
+        if dominant != injected:
+            reasons.append(
+                f"dominant blamed stage is {dominant!r} "
+                f"({round(sums[dominant], 1)} ms summed) but the bench "
+                f"injected the bottleneck into {injected!r} "
+                f"({round(sums.get(injected, 0.0), 1)} ms) — blame "
+                f"attribution points at the wrong stage"
+            )
+    if reasons:
+        res["trace_tails_guard"] = "FAIL: " + "; ".join(reasons)
+        return json.dumps(res), 3
+    res["trace_tails_guard"] = "ok"
+    return json.dumps(res), 0
+
+
+def run_trace_tails_bench(args) -> None:
+    """p99 blame attribution (--trace-tails): stream a burst against a
+    PD pair, auto-pull the master's assembled distributed traces for the
+    p99-tail requests, and print a per-stage blame table — queue vs
+    prefill vs handoff vs decode vs host_gap (ISSUE 17,
+    docs/OBSERVABILITY.md "Distributed tracing").
+
+    The stack is one master + one PREFILL + one DECODE fake instance in
+    one process (three distinct span rings, so an assembled trace spans
+    three processes exactly like a real fleet). The decode side pays
+    --tails-stall-ms of simulated KV wire time on every
+    --tails-stall-every'th admission, INSIDE the real import path —
+    after the prefill side's handoff_send span, before the decode side's
+    decode_admit span — so the stall lands in the blame table's handoff
+    edge and in the sender's commit stall clock, not in a bench-side
+    fudge factor. Every request streams its completion over SSE; the
+    service_request_id is captured from the events' "id" field (the
+    same id a production client would quote in a latency report).
+
+    The tail set is the slowest ~5% by end-to-end latency. For each
+    tail the bench GETs /trace/<srid> from the master — the collector
+    pulls each participant's ring, shifts spans by the heartbeat-derived
+    clock offsets, and returns blame_stages() over the merged timeline.
+    The guard (exit 3 via _trace_tails_guard) checks the tracing plane
+    actually FOUND the planted bottleneck: the dominant blamed stage
+    summed across tails must be "handoff", and every tail's timeline
+    must span >= 3 processes. A median request's blame row is printed
+    alongside for contrast (its handoff edge should be wire-thin).
+    """
+    import http.client
+    import os
+    import sys
+
+    from xllm_service_tpu.api import FakeEngine, Master
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+    from xllm_service_tpu.coordination import MemoryStore
+
+    class StallingDecodeServer(InstanceServer):
+        """Decode InstanceServer that pays the simulated KV wire stall
+        inside the real admission path (see run_trace_tails_bench
+        docstring): the InterferingFakeEngine precedent moved one layer
+        up, because import_sequence runs AFTER the decode_admit span and
+        a sleep there would be blamed to decode, not handoff."""
+
+        def __init__(self, *a, stall_ms=0.0, stall_every=1, **kw):
+            self._tails_stall_ms = float(stall_ms)
+            self._tails_stall_every = max(int(stall_every), 1)
+            self._tails_imports = 0
+            self._tails_mu = threading.Lock()
+            super().__init__(*a, **kw)
+
+        def _admit_import(self, handoff, header):
+            with self._tails_mu:
+                self._tails_imports += 1
+                n = self._tails_imports
+            if n % self._tails_stall_every == 0:
+                time.sleep(self._tails_stall_ms / 1000.0)
+            return super()._admit_import(handoff, header)
+
+    saved_trace = os.environ.get("XLLM_TRACE")
+    os.environ["XLLM_TRACE"] = "1"  # the bench IS the tracing plane
+
+    store = MemoryStore()
+    cfg = ServiceConfig(
+        host="127.0.0.1", http_port=0, rpc_port=0,
+        heartbeat_interval_s=0.5, master_lease_ttl_s=5.0,
+        load_balance_policy="RR", block_size=16,
+    )
+    master = Master(cfg, store=store)
+    master.start()
+
+    token_delay_s = args.tails_token_delay_ms / 1000.0
+    pf = InstanceServer(
+        EngineConfig(
+            model="fake-echo", instance_name="tails-prefill",
+            instance_type="PREFILL", block_size=16,
+        ),
+        master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.5,
+        engine=FakeEngine(token_delay_s=token_delay_s, ttft_ms=1.0),
+    )
+    dec = StallingDecodeServer(
+        EngineConfig(
+            model="fake-echo", instance_name="tails-decode",
+            instance_type="DECODE", block_size=16,
+        ),
+        master_rpc_addr=master.rpc_address, heartbeat_interval_s=0.5,
+        engine=FakeEngine(token_delay_s=token_delay_s, ttft_ms=1.0),
+        stall_ms=args.tails_stall_ms, stall_every=args.tails_stall_every,
+    )
+    pf.start()
+    dec.start()
+
+    mgr = master.scheduler.instance_mgr
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and sum(mgr.counts()) < 2:
+        time.sleep(0.05)
+
+    host, _, port = master.http_address.partition(":")
+    results = []  # (srid, e2e_ms, tokens, ok)
+    for i in range(args.tails_requests):
+        salt = f"tt{i:04d} "
+        prompt = salt + "x" * max(48 - len(salt), 1)
+        srid, toks, ok = "", 0, False
+        t0 = time.monotonic()
+        try:
+            conn = http.client.HTTPConnection(host, int(port), timeout=60.0)
+            conn.request(
+                "POST", "/v1/completions",
+                body=json.dumps({
+                    "model": "fake-echo", "prompt": prompt,
+                    "max_tokens": args.tails_max_tokens,
+                    "temperature": 0.0, "stream": True,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            if resp.status == 200:
+                for raw in resp:
+                    ln = raw.decode().strip()
+                    if not ln.startswith("data: "):
+                        continue
+                    payload = ln[len("data: "):]
+                    if payload == "[DONE]":
+                        ok = True
+                        break
+                    try:
+                        ev = json.loads(payload)
+                    except ValueError:
+                        continue
+                    # The event id IS the service_request_id — the same
+                    # handle /trace/<srid> keys the assembled timeline on.
+                    srid = srid or str(ev.get("id") or "")
+                    if ev.get("choices"):
+                        toks += 1
+            conn.close()
+        except Exception:
+            ok = False
+        results.append((srid, (time.monotonic() - t0) * 1000.0, toks, ok))
+
+    def pull_trace(srid):
+        try:
+            conn = http.client.HTTPConnection(host, int(port), timeout=10.0)
+            conn.request("GET", f"/trace/{srid}")
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            if resp.status != 200:
+                return None
+            return json.loads(body)
+        except Exception:
+            return None
+
+    done = sorted(
+        (r for r in results if r[3] and r[0]),
+        key=lambda r: r[1], reverse=True,
+    )
+    n_tails = max(1, int(round(len(done) * 0.05)))
+    stages = ("queue", "prefill", "handoff", "decode", "host_gap")
+    tails = []
+    for srid, e2e_ms, _toks, _ok in done[:n_tails]:
+        tr = pull_trace(srid)
+        if tr is None:
+            continue
+        blame = tr.get("blame_ms") or {}
+        edge = {k: blame.get(k) for k in stages if blame.get(k) is not None}
+        tails.append({
+            "srid": srid,
+            "e2e_ms": round(e2e_ms, 1),
+            "processes": tr.get("processes") or [],
+            "blame_ms": blame,
+            "top_stage": max(edge, key=lambda k: edge[k]) if edge else None,
+        })
+    median_blame = None
+    if done:
+        med = done[len(done) // 2]
+        med_tr = pull_trace(med[0])
+        if med_tr is not None:
+            median_blame = med_tr.get("blame_ms")
+
+    hdr = f"{'srid':<22}{'e2e_ms':>9}" + "".join(
+        f"{s:>10}" for s in stages + ("total",)
+    )
+    print(hdr)
+    print("-" * len(hdr))
+    for t in tails:
+        b = t["blame_ms"]
+        print(
+            f"{t['srid'][:21]:<22}{t['e2e_ms']:>9.1f}" + "".join(
+                f"{float(b.get(s) or 0.0):>10.1f}"
+                for s in stages + ("total",)
+            )
+        )
+    if median_blame:
+        print(
+            f"{'(median)':<22}{done[len(done) // 2][1]:>9.1f}" + "".join(
+                f"{float(median_blame.get(s) or 0.0):>10.1f}"
+                for s in stages + ("total",)
+            )
+        )
+
+    row = {
+        "metric": "trace_tails",
+        "backend": "fake",
+        "requests": len(results),
+        "failed": sum(1 for r in results if not r[3]),
+        "stall_ms": args.tails_stall_ms,
+        "stall_every": args.tails_stall_every,
+        "token_delay_ms": args.tails_token_delay_ms,
+        "injected": "handoff",
+        "tails": tails,
+        "median_blame_ms": median_blame,
+    }
+
+    for srv in (pf, dec):
+        try:
+            srv.stop()
+        except Exception:
+            pass
+    master.stop()
+    store.close()
+    if saved_trace is None:
+        os.environ.pop("XLLM_TRACE", None)
+    else:
+        os.environ["XLLM_TRACE"] = saved_trace
+
+    line, rc = _trace_tails_guard(json.dumps(row))
+    print(line)
+    if rc:
+        sys.exit(rc)
+
+
 def run_prefix_trace_bench(args) -> None:
     """Fleet prefix-fabric bench (--prefix-trace): a Zipf-ish shared-
     system-prompt workload replayed at high stream concurrency against
@@ -1640,6 +1935,39 @@ def main() -> None:
         "tenant (misses under all-MIX: prefill interference)",
     )
     p.add_argument(
+        "--trace-tails", action="store_true",
+        help="p99 blame attribution: stream a burst against a PD fake "
+        "pair with a KV wire stall injected on every Nth handoff, "
+        "auto-pull the master's assembled distributed traces for the "
+        "p99-tail requests, and print a per-stage blame table (queue / "
+        "prefill / handoff / decode / host_gap); exits 3 when the "
+        "dominant blamed stage is not the injected bottleneck "
+        "(docs/OBSERVABILITY.md)",
+    )
+    p.add_argument(
+        "--tails-requests", type=int, default=40,
+        help="--trace-tails: sequential streamed requests",
+    )
+    p.add_argument(
+        "--tails-stall-ms", type=float, default=250.0,
+        help="--trace-tails: simulated KV wire stall paid inside the "
+        "decode admission path (between handoff_send and decode_admit, "
+        "so the blame table's handoff edge times it)",
+    )
+    p.add_argument(
+        "--tails-stall-every", type=int, default=8,
+        help="--trace-tails: stall every Nth handoff — the stalled "
+        "requests ARE the p99 tail the bench must find",
+    )
+    p.add_argument(
+        "--tails-max-tokens", type=int, default=8,
+        help="--trace-tails: generated tokens per request",
+    )
+    p.add_argument(
+        "--tails-token-delay-ms", type=float, default=2.0,
+        help="--trace-tails: fake-engine per-token decode delay",
+    )
+    p.add_argument(
         "--pd-prompt-tokens", type=int, default=960,
         help="--pd: prompt length (tokens == chars on the test tokenizer)",
     )
@@ -1683,6 +2011,9 @@ def main() -> None:
 
         jax.config.update("jax_platforms", plat)
 
+    if args.trace_tails:
+        run_trace_tails_bench(args)
+        return
     if args.pd_adapt:
         run_pd_adapt_bench(args)
         return

@@ -1,0 +1,51 @@
+"""Subprocess body for the distributed-tracing multi-process test: skew
+this process's monotonic clock by a LARGE constant (seconds — far above
+any real RPC delay), then serve one fake-engine instance against the
+parent process's master until stdin closes.
+
+The skew is the point: span timestamps and heartbeat clock stamps both
+come from the patched clock, so the parent's assembled trace is only
+causally ordered if the master's heartbeat-derived ClockSync offsets
+actually cancel the skew. A real fleet's instances have exactly this
+property — same clock rate, arbitrary per-host base.
+
+Argv: master_rpc_addr name instance_type skew_s.
+"""
+
+import os
+import sys
+import time
+
+
+def main() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("XLLM_TRACE", "1")
+    master_rpc, name, itype = sys.argv[1], sys.argv[2], sys.argv[3]
+    skew_s = float(sys.argv[4])
+
+    # Patch BEFORE any xllm import: modules call time.monotonic() by
+    # attribute, so this rebases the whole process's monotonic domain
+    # (spans, heartbeat send stamps, echo stamps) consistently.
+    real_monotonic = time.monotonic
+    time.monotonic = lambda: real_monotonic() + skew_s
+
+    from xllm_service_tpu.api import FakeEngine
+    from xllm_service_tpu.api.instance import InstanceServer
+    from xllm_service_tpu.common.config import EngineConfig
+
+    srv = InstanceServer(
+        EngineConfig(
+            model="fake-echo", instance_name=name, instance_type=itype,
+            block_size=16,
+        ),
+        master_rpc_addr=master_rpc, heartbeat_interval_s=0.2,
+        engine=FakeEngine(token_delay_s=0.002, ttft_ms=1.0),
+    )
+    srv.start()
+    print(f"TRACE_PROC_UP {name} {srv.address}", flush=True)
+    sys.stdin.read()  # parent closes stdin at teardown
+    srv.stop()
+
+
+if __name__ == "__main__":
+    main()

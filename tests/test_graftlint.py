@@ -28,6 +28,7 @@ from xllm_service_tpu.analysis import (  # noqa: E402
     MetricNamesPass,
     Project,
     ShardingRulesPass,
+    SpanStagesPass,
     ThreadJoinsPass,
     ThreadOwnershipPass,
     all_passes,
@@ -476,6 +477,72 @@ class TestLegacyPasses:
 
 
 # ---------------------------------------------------------------------------
+# span-stages (distributed-tracing vocabulary + trace-plane registry)
+# ---------------------------------------------------------------------------
+
+
+class TestSpanStages:
+    def _pass(self, planes=()):
+        return SpanStagesPass(
+            vocab=("admit", "finish", "handoff_send"), planes=planes,
+        )
+
+    def test_off_vocabulary_stage_trips(self):
+        src = (
+            'self._span(srid, "admit", n=1)\n'
+            'self._span(srid, "not_a_stage")\n'
+            'ring.emit(srid, "handoff_send")\n'
+        )
+        fs = run_one(self._pass(), src)
+        assert len(fs) == 1
+        assert fs[0].line == 2
+        assert "not_a_stage" in fs[0].message
+
+    def test_all_emit_surfaces_are_scanned(self):
+        src = (
+            'tracer.stage(srid, "bogus_a")\n'
+            'ring.emit(srid, "bogus_b")\n'
+            'self.span_hook("", "bogus_c", n=1)\n'
+            'self._span_hook(srid, "bogus_d")\n'
+        )
+        fs = run_one(self._pass(), src)
+        assert {f.line for f in fs} == {1, 2, 3, 4}
+
+    def test_non_literal_stage_is_skipped(self):
+        src = 'self._tracer.stage(srid, terminal, code=1)\n'
+        assert run_one(self._pass(), src) == []
+
+    def test_trace_plane_needle_missing_trips(self):
+        planes = (
+            ("pkg/m.py", 'fwd["trace"] = ctx', "dispatch plane"),
+            ("pkg/gone.py", "x", "vanished plane"),
+        )
+        src = 'fwd = {}\n'
+        fs = run_one(self._pass(planes=planes), src)
+        msgs = "\n".join(f.message for f in fs)
+        assert "no longer forwards trace context" in msgs
+        assert "file is gone" in msgs
+
+    def test_trace_plane_clean_fixture(self):
+        planes = (("pkg/m.py", 'fwd["trace"] = ctx', "dispatch plane"),)
+        src = 'fwd["trace"] = ctx\n'
+        assert run_one(self._pass(planes=planes), src) == []
+
+    def test_repo_vocabulary_is_the_canonical_tuple(self):
+        from xllm_service_tpu.obs.spans import ALL_SPAN_STAGES
+        assert SpanStagesPass().vocab == frozenset(ALL_SPAN_STAGES)
+
+    def test_registry_rows_point_at_live_needles(self):
+        # The shipped TRACE_PLANES rows must hold on the real tree (the
+        # repo-wide run below enforces this too; this pins the registry
+        # itself so a row edit can't silently no-op the check).
+        from xllm_service_tpu.analysis import TRACE_PLANES
+        assert len(TRACE_PLANES) >= 6
+        project = Project.load(REPO)
+        assert SpanStagesPass(vocab=None).run(project) == []
+
+
+# ---------------------------------------------------------------------------
 # framework: waiver bookkeeping
 # ---------------------------------------------------------------------------
 
@@ -500,7 +567,7 @@ class TestFramework:
         assert {
             "lock-discipline", "blocking-under-lock", "thread-ownership",
             "thread-joins", "hatch-registry", "metric-names",
-            "fault-points",
+            "fault-points", "span-stages",
         } <= ids
 
 

@@ -1,0 +1,856 @@
+"""Fleet-wide distributed tracing tests (ISSUE 17):
+
+  * obs.spans.ClockSync — heartbeat-fed monotonic-offset bounds and the
+    midpoint/min-upper/degenerate estimates;
+  * obs.flight.SpanRing — bounded ring, attempt-id collapse, stats;
+  * obs.flight.FlightRecorder — anomaly dumps, rate limiting, rotation;
+  * assemble_trace + blame_stages — cross-process alignment, PD blame
+    edges (handoff must be attributable), colocated fallbacks;
+  * build_timeline on redispatch loops — durations attribute to the
+    retry attempt, not smeared over the first one (ISSUE 17 satellite);
+  * RequestTracer keep-count rotation chain (trace.jsonl.1..N);
+  * absorb_exposition kind conflicts — deterministic skip + returned
+    names + the master's scrape conflict counter;
+  * an in-process PD cluster: GET /trace/<srid> assembles one timeline
+    spanning master + prefill + decode, xllm_cluster_scrape_ms rides the
+    aggregated /metrics, and XLLM_TRACE=0 leaves the token stream
+    byte-identical with zero instance-side span work;
+  * a REAL multi-process PD fleet (tests/_trace_proc.py) with seconds of
+    injected clock skew per instance: the assembled trace must span >= 3
+    processes with zero negative inter-process durations.
+"""
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import OrderedDict
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from prom_parser import parse_metrics  # noqa: E402
+
+from xllm_service_tpu.api import FakeEngine, Master
+from xllm_service_tpu.api.instance import InstanceServer
+from xllm_service_tpu.common.config import EngineConfig, ServiceConfig
+from xllm_service_tpu.coordination import MemoryStore
+from xllm_service_tpu.obs import MetricsRegistry, absorb_exposition
+from xllm_service_tpu.obs.flight import FlightRecorder, SpanRing
+from xllm_service_tpu.obs.spans import (
+    ALL_SPAN_STAGES,
+    ClockSync,
+    assemble_trace,
+    blame_stages,
+    build_timeline,
+    stage_durations_ms,
+    trace_to_chrome,
+)
+from xllm_service_tpu.service.request import RequestTracer
+
+
+def wait_until(pred, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def http_get_json(addr, path, timeout=10.0):
+    host, _, port = addr.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, (json.loads(data) if data else {})
+
+
+def http_post(addr, path, body, timeout=30.0):
+    host, _, port = addr.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    conn.request(
+        "POST", path, body=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, (json.loads(data) if data else {})
+
+
+def stream_completion(addr, body, timeout=30.0):
+    """POST a streamed completion; returns (srid, [event dicts])."""
+    host, _, port = addr.partition(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    conn.request(
+        "POST", "/v1/completions",
+        body=json.dumps(dict(body, stream=True)).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = conn.getresponse()
+    assert resp.status == 200, resp.read()
+    srid, events = "", []
+    for raw in resp:
+        ln = raw.decode().strip()
+        if not ln.startswith("data: "):
+            continue
+        payload = ln[len("data: "):]
+        if payload == "[DONE]":
+            break
+        ev = json.loads(payload)
+        srid = srid or str(ev.get("id") or "")
+        events.append(ev)
+    conn.close()
+    return srid, events
+
+
+def pull_trace_until_finished(addr, srid, timeout=15.0):
+    """GET /trace/<srid> until the terminal `finish` span lands — the
+    master's terminal bookkeeping runs on the lane just AFTER the
+    response body is written, so an immediate pull can race it."""
+    tr = {}
+
+    def finished():
+        nonlocal tr
+        code, body = http_get_json(addr, f"/trace/{srid}")
+        if code != 200:
+            return False
+        tr = body
+        return any(r.get("stage") == "finish" for r in body.get("spans", []))
+
+    assert wait_until(finished, timeout=timeout), (
+        [r.get("stage") for r in tr.get("spans", [])]
+    )
+    return tr
+
+
+def first_stage_times(merged):
+    """stage -> t_mono_ms of its FIRST record in an assembled trace."""
+    first = OrderedDict()
+    for rec in merged:
+        st = rec.get("stage", "")
+        if st and st not in first:
+            first[st] = float(rec.get("t_mono_ms", 0.0))
+    return first
+
+
+# --------------------------------------------------------------------- #
+# clock alignment units
+# --------------------------------------------------------------------- #
+
+
+class TestClockSync:
+    def test_midpoint_of_bounds(self):
+        cs = ClockSync()
+        cs.sample_upper(10.0)  # o + d_forward
+        cs.sample_lower(4.0)   # o - d_backward
+        assert cs.offset_ms() == 7.0
+        assert cs.samples == 2
+        j = cs.to_json()
+        assert j["offset_ms"] == 7.0
+        assert j["upper_ms"] == 10.0 and j["lower_ms"] == 4.0
+
+    def test_tightest_bounds_win(self):
+        cs = ClockSync()
+        for u in (12.0, 9.0, 15.0):
+            cs.sample_upper(u)
+        for lo in (1.0, 5.0, 3.0):
+            cs.sample_lower(lo)
+        # min upper 9, max lower 5 -> midpoint 7
+        assert cs.offset_ms() == 7.0
+
+    def test_upper_only_degrades_to_min_upper(self):
+        cs = ClockSync()
+        cs.sample_upper(12.0)
+        cs.sample_upper(8.0)
+        assert cs.offset_ms() == 8.0
+
+    def test_no_samples_is_zero(self):
+        assert ClockSync().offset_ms() == 0.0
+
+    def test_crossed_bounds_fall_back_to_upper(self):
+        # lower > upper (clock stepped between beats): the intersection
+        # is empty; the estimator must not invent a midpoint outside it.
+        cs = ClockSync()
+        cs.sample_upper(5.0)
+        cs.sample_lower(9.0)
+        assert cs.offset_ms() == 5.0
+
+    def test_window_bounds_memory(self):
+        cs = ClockSync()
+        cs.sample_upper(1.0)  # tight early bound ...
+        for _ in range(ClockSync.WINDOW):
+            cs.sample_upper(50.0)  # ... aged out by a full window
+        assert cs.offset_ms() == 50.0
+
+
+# --------------------------------------------------------------------- #
+# span ring + flight recorder units
+# --------------------------------------------------------------------- #
+
+
+class TestSpanRing:
+    def test_ring_is_bounded(self):
+        ring = SpanRing("p0", capacity=4)
+        for i in range(10):
+            ring.emit(f"r{i}", "admit", idx=i)
+        snap = ring.snapshot()
+        assert len(snap) == 4
+        assert [r["idx"] for r in snap] == [6, 7, 8, 9]
+        st = ring.stats()
+        assert st["size"] == 4 and st["emitted"] == 10
+        assert st["capacity"] == 4 and st["process"] == "p0"
+
+    def test_for_request_collapses_attempt_ids(self):
+        ring = SpanRing("p0")
+        ring.emit("cmpl-1#r1", "admit")
+        ring.emit("cmpl-1#r2", "admit")
+        ring.emit("cmpl-2", "admit")
+        assert len(ring.for_request("cmpl-1")) == 2
+        assert len(ring.for_request("cmpl-1#r2")) == 2
+        assert len(ring.for_request("cmpl-2")) == 1
+
+    def test_none_fields_dropped(self):
+        ring = SpanRing("p0")
+        ring.emit("r", "admit", peer=None, blocks=3)
+        rec = ring.snapshot()[0]
+        assert "peer" not in rec and rec["blocks"] == 3
+
+
+class TestFlightRecorder:
+    def test_trigger_dumps_ring(self, tmp_path):
+        ring = SpanRing("p0")
+        ring.emit("r1", "admit")
+        fr = FlightRecorder(ring, str(tmp_path), min_interval_s=0.0)
+        path = fr.trigger("slo_breach", "r1", ttft_ms=912.0)
+        assert path and os.path.exists(path)
+        body = json.load(open(path))
+        assert body["reason"] == "slo_breach"
+        assert body["service_request_id"] == "r1"
+        assert body["context"]["ttft_ms"] == 912.0
+        # the trigger itself lands in the ring, so the dump records it
+        stages = [r["stage"] for r in body["spans"]]
+        assert stages == ["admit", "flight_dump"]
+        assert "flight_dump" in ALL_SPAN_STAGES
+
+    def test_rate_limit_counts_but_skips_dump(self, tmp_path):
+        reg = MetricsRegistry()
+        ring = SpanRing("p0")
+        fr = FlightRecorder(
+            ring, str(tmp_path), min_interval_s=60.0, registry=reg,
+        )
+        assert fr.trigger("breaker_eject", "r1") is not None
+        assert fr.trigger("breaker_eject", "r2") is None  # rate-limited
+        files = [n for n in os.listdir(tmp_path) if n.startswith("flight-")]
+        assert len(files) == 1
+        # ... but the counter and the ring record BOTH triggers
+        assert sum(
+            1 for r in ring.snapshot() if r["stage"] == "flight_dump"
+        ) == 2
+        fams = parse_metrics(reg.render())
+        assert sum(fams["xllm_flight_dumps_total"].values(
+            reason="breaker_eject"
+        )) == 2
+
+    def test_rotation_keeps_newest(self, tmp_path):
+        ring = SpanRing("p0")
+        fr = FlightRecorder(ring, str(tmp_path), keep=2, min_interval_s=0.0)
+        for i in range(4):
+            assert fr.trigger("fenced_rpc", f"r{i}")
+        files = sorted(
+            n for n in os.listdir(tmp_path) if n.startswith("flight-")
+        )
+        assert files == ["flight-000003.json", "flight-000004.json"]
+
+    def test_never_raises(self, tmp_path):
+        ring = SpanRing("p0")
+        # unwritable directory: trigger must swallow the failure
+        fr = FlightRecorder(
+            ring, os.path.join(str(tmp_path), "f\0bad"), min_interval_s=0.0,
+        )
+        assert fr.trigger("kv_handoff_stall", "r1") is None
+
+
+# --------------------------------------------------------------------- #
+# assembly + blame units
+# --------------------------------------------------------------------- #
+
+
+def _rec(stage, t, srid="cmpl-x", **kw):
+    return {
+        "type": "stage", "service_request_id": srid, "stage": stage,
+        "t_mono_ms": float(t), "timestamp_ms": 0, **kw,
+    }
+
+
+class TestAssembleTrace:
+    def test_offsets_cancel_injected_skew(self):
+        # prefill clock is 5s BEHIND the master, decode 3s AHEAD; the
+        # provided offsets (o = master - instance) must realign them so
+        # every cross-process causal edge is non-negative.
+        master = [_rec("receive", 100.0), _rec("dispatch", 110.0),
+                  _rec("first_token", 130.0), _rec("finish", 160.0)]
+        prefill = [_rec("admit", -4885.0), _rec("handoff_send", -4875.0)]
+        decode = [_rec("decode_admit", 3140.0)]
+        merged = assemble_trace(
+            "master", master,
+            [("pf", prefill, 5000.0), ("dec", decode, -3000.0)],
+        )
+        assert [r["process"] for r in merged] == [
+            "master", "master", "pf", "pf", "master", "dec", "master",
+        ]
+        first = first_stage_times(merged)
+        chain = ("receive", "dispatch", "admit", "handoff_send",
+                 "decode_admit", "finish")
+        for a, b in zip(chain, chain[1:]):
+            assert first[b] - first[a] >= 0.0, (a, b, first)
+
+    def test_tie_keeps_master_before_instance(self):
+        merged = assemble_trace(
+            "master", [_rec("dispatch", 50.0)],
+            [("pf", [_rec("admit", 50.0)], 0.0)],
+        )
+        assert [r["process"] for r in merged] == ["master", "pf"]
+
+    def test_chrome_export_one_track_per_process(self):
+        merged = assemble_trace(
+            "master", [_rec("receive", 0.0), _rec("finish", 10.0)],
+            [("pf", [_rec("admit", 2.0)], 0.0),
+             ("dec", [_rec("decode_admit", 5.0)], 0.0)],
+        )
+        chrome = trace_to_chrome(merged)
+        metas = [e for e in chrome["traceEvents"] if e["ph"] == "M"]
+        assert {e["args"]["name"] for e in metas} == {"master", "pf", "dec"}
+        assert len({e["pid"] for e in metas}) == 3
+
+
+class TestBlameStages:
+    def test_pd_trace_blames_handoff(self):
+        merged = assemble_trace(
+            "master",
+            [_rec("receive", 0.0), _rec("dispatch", 10.0),
+             _rec("first_token", 35.0), _rec("finish", 160.0)],
+            [("pf", [_rec("admit", 12.0), _rec("handoff_send", 40.0)], 0.0),
+             ("dec", [_rec("decode_admit", 140.0)], 0.0)],
+        )
+        blame = blame_stages(merged)
+        assert blame["queue"] == 10.0
+        assert blame["prefill"] == 28.0
+        assert blame["handoff"] == 100.0
+        # decode anchors at decode_admit, NOT first_token: the prefill
+        # side pushes the first token BEFORE the handoff, so that anchor
+        # would double-count the whole handoff window.
+        assert blame["decode"] == 20.0
+        assert blame["total"] == 160.0
+        assert blame["host_gap"] == 160.0 - (10.0 + 28.0 + 100.0 + 20.0)
+        assert max(
+            ("queue", "prefill", "handoff", "decode", "host_gap"),
+            key=lambda k: blame[k],
+        ) == "handoff"
+
+    def test_colocated_fallbacks(self):
+        blame = blame_stages([
+            _rec("receive", 0.0), _rec("dispatch", 5.0),
+            _rec("first_token", 30.0), _rec("finish", 50.0),
+        ])
+        assert blame["queue"] == 5.0
+        assert blame["prefill"] == 25.0   # dispatch -> first_token
+        assert blame["handoff"] == 0.0
+        assert blame["decode"] == 20.0    # first_token -> finish
+        assert blame["host_gap"] == 0.0
+        assert blame["total"] == 50.0
+
+    def test_empty_trace(self):
+        blame = blame_stages([])
+        assert blame["total"] == 0.0 and blame["host_gap"] == 0.0
+
+
+class TestRedispatchTimeline:
+    """ISSUE 17 satellite: a fault-replayed request's spans must charge
+    each inter-stage gap to the attempt that was actually running."""
+
+    def test_durations_attribute_to_retry_attempt(self):
+        recs = [
+            _rec("receive", 0.0),
+            _rec("dispatch", 5.0, attempt=1),
+            _rec("redispatch", 45.0, attempt=2),   # attempt 1 died at 45
+            _rec("dispatch", 47.0, attempt=2),
+            _rec("first_token", 60.0),
+            _rec("finish", 80.0),
+        ]
+        timeline = build_timeline(recs)["cmpl-x"]
+        durs = stage_durations_ms(timeline)
+        assert [s for s, _ in durs] == [
+            "receive", "dispatch", "redispatch", "dispatch",
+            "first_token", "finish",
+        ]
+        by_attempt = {}
+        for (stage, dur), rec in zip(durs, timeline):
+            if stage == "dispatch":
+                by_attempt[rec["attempt"]] = dur
+        # 40ms of dead first attempt stays on attempt 1; the retry is
+        # only charged its own 13ms to first token.
+        assert by_attempt == {1: 40.0, 2: 13.0}
+
+    def test_attempt_wire_ids_collapse_into_one_timeline(self):
+        ring = SpanRing("pf")
+        ring.emit("cmpl-9#r1", "admit")
+        ring.emit("cmpl-9#r2", "admit")
+        merged = assemble_trace(
+            "master",
+            [_rec("dispatch", 0.0, srid="cmpl-9"),
+             _rec("redispatch", 1.0, srid="cmpl-9")],
+            [("pf", ring.for_request("cmpl-9"), 0.0)],
+        )
+        assert len(merged) == 4
+
+    def test_non_monotonic_still_rejected(self):
+        with pytest.raises(ValueError, match="non-monotonic"):
+            build_timeline([_rec("receive", 10.0), _rec("finish", 5.0)])
+
+
+# --------------------------------------------------------------------- #
+# tracer rotation chain (ISSUE 17 satellite)
+# --------------------------------------------------------------------- #
+
+
+class TestTracerRotationChain:
+    def test_keep_count_chain(self, tmp_path):
+        tracer = RequestTracer(
+            str(tmp_path), enabled=True, max_bytes=600, keep=3,
+        )
+        for i in range(120):
+            tracer.stage(f"r{i:04d}", "receive", pad="x" * 40)
+        tracer.close()
+        for n in (1, 2, 3):
+            assert (tmp_path / f"trace.jsonl.{n}").exists(), n
+        assert not (tmp_path / "trace.jsonl.4").exists()
+        assert tracer.dropped == 0
+
+        def first_id(path):
+            with open(path) as f:
+                return json.loads(f.readline())["service_request_id"]
+
+        # the chain is ordered: .1 is the newest rotated window, .N the
+        # oldest still kept
+        ids = [
+            first_id(tmp_path / f"trace.jsonl.{n}") for n in (1, 2, 3)
+        ]
+        assert ids == sorted(ids, reverse=True), ids
+        # the live file (possibly empty right after a rotation) only ever
+        # holds records NEWER than the whole rotated chain
+        with open(tmp_path / "trace.jsonl") as f:
+            line = f.readline()
+        if line:
+            assert json.loads(line)["service_request_id"] > ids[0]
+
+    def test_default_keep_one_drops_older(self, tmp_path):
+        tracer = RequestTracer(str(tmp_path), enabled=True, max_bytes=600)
+        for i in range(120):
+            tracer.stage(f"r{i:04d}", "receive", pad="x" * 40)
+        tracer.close()
+        assert (tmp_path / "trace.jsonl.1").exists()
+        assert not (tmp_path / "trace.jsonl.2").exists()
+
+
+# --------------------------------------------------------------------- #
+# prom merge kind conflicts (ISSUE 17 satellite)
+# --------------------------------------------------------------------- #
+
+
+class TestAbsorbKindConflicts:
+    GAUGE = "# TYPE xllm_t_conf gauge\nxllm_t_conf 1\n"
+    COUNTER = "# TYPE xllm_t_conf_total counter\nxllm_t_conf_total 1\n"
+    BAD = "# TYPE xllm_t_conf counter\nxllm_t_conf 7\n"
+
+    def test_conflicting_family_skipped_and_reported(self):
+        fams = OrderedDict()
+        assert absorb_exposition(fams, self.GAUGE, {"instance": "a"}) == []
+        conflicts = absorb_exposition(fams, self.BAD, {"instance": "b"})
+        assert conflicts == ["xllm_t_conf"]
+        kind, _help, samples = fams["xllm_t_conf"]
+        # first-seen kind wins; the conflicting samples are NOT merged
+        assert kind == "gauge"
+        assert len(samples) == 1 and 'instance="a"' in samples[0][0]
+
+    def test_first_seen_wins_regardless_of_order(self):
+        fams = OrderedDict()
+        assert absorb_exposition(fams, self.BAD, {"instance": "b"}) == []
+        assert absorb_exposition(
+            fams, self.GAUGE, {"instance": "a"}
+        ) == ["xllm_t_conf"]
+        assert fams["xllm_t_conf"][0] == "counter"
+
+    def test_clean_merge_reports_nothing(self):
+        fams = OrderedDict()
+        assert absorb_exposition(fams, self.GAUGE, {"instance": "a"}) == []
+        assert absorb_exposition(fams, self.GAUGE, {"instance": "b"}) == []
+        assert len(fams["xllm_t_conf"][2]) == 2
+
+
+# --------------------------------------------------------------------- #
+# in-process PD cluster: collector, scrape histogram, trace-off diff
+# --------------------------------------------------------------------- #
+
+
+def _make_pd_stack(tmp_path, prefix, trace_env):
+    saved = os.environ.get("XLLM_TRACE")
+    os.environ["XLLM_TRACE"] = trace_env
+    try:
+        store = MemoryStore(clock=lambda: 0.0)
+        cfg = ServiceConfig(
+            host="127.0.0.1", http_port=0, rpc_port=0,
+            heartbeat_interval_s=0.2, master_lease_ttl_s=2.0,
+            num_ordered_output_streams=8, block_size=16,
+            trace_dir=str(tmp_path / f"{prefix}-trace"),
+        )
+        master = Master(cfg, store=store)
+        master.start()
+        servers = []
+        for name, itype in (
+            (f"{prefix}-pf", "PREFILL"), (f"{prefix}-dec", "DECODE"),
+        ):
+            srv = InstanceServer(
+                EngineConfig(
+                    model="fake-echo", instance_name=name,
+                    instance_type=itype, block_size=16,
+                ),
+                master_rpc_addr=master.rpc_address,
+                heartbeat_interval_s=0.2,
+                engine=FakeEngine(token_delay_s=0.002, ttft_ms=1.0),
+            )
+            srv.start()
+            servers.append(srv)
+        assert wait_until(
+            lambda: master.scheduler.instance_mgr.counts() == (1, 1, 0)
+        )
+        return store, master, servers
+    finally:
+        if saved is None:
+            os.environ.pop("XLLM_TRACE", None)
+        else:
+            os.environ["XLLM_TRACE"] = saved
+
+
+def _teardown_stack(store, master, servers):
+    for srv in servers:
+        try:
+            srv.stop()
+        except Exception:
+            pass
+    master.stop()
+    store.close()
+
+
+@pytest.fixture(scope="module")
+def traced_pd_cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("traced-pd")
+    store, master, servers = _make_pd_stack(tmp, "tpd", "1")
+    yield master, servers
+    _teardown_stack(store, master, servers)
+
+
+class TestTraceCollector:
+    def test_assembled_trace_spans_three_processes(self, traced_pd_cluster):
+        master, _servers = traced_pd_cluster
+        srid, events = stream_completion(
+            master.http_address,
+            {"model": "fake-echo", "prompt": "trace me end to end",
+             "max_tokens": 6, "temperature": 0.0},
+        )
+        assert srid and events
+        tr = pull_trace_until_finished(master.http_address, srid)
+        assert set(tr["processes"]) >= {"master", "tpd-pf", "tpd-dec"}
+        stages = {r["stage"] for r in tr["spans"]}
+        assert {"receive", "dispatch", "admit", "handoff_send",
+                "decode_admit", "finish"} <= stages, stages
+        first = first_stage_times(tr["spans"])
+        chain = ("receive", "dispatch", "admit", "handoff_send",
+                 "decode_admit")
+        for a, b in zip(chain, chain[1:]):
+            assert first[b] - first[a] >= 0.0, (a, b, first)
+        blame = tr["blame_ms"]
+        assert blame["total"] > 0.0
+        assert all(
+            blame[k] >= 0.0
+            for k in ("queue", "prefill", "handoff", "decode", "host_gap")
+        )
+        # Perfetto export carries one named track per process
+        metas = [
+            e for e in tr["chrome"]["traceEvents"] if e["ph"] == "M"
+        ]
+        assert {"master", "tpd-pf", "tpd-dec"} <= {
+            e["args"]["name"] for e in metas
+        }
+
+    def test_unknown_srid_404(self, traced_pd_cluster):
+        master, _servers = traced_pd_cluster
+        code, _body = http_get_json(
+            master.http_address, "/trace/cmpl-never-dispatched"
+        )
+        assert code == 404
+
+    def test_instance_trace_route_serves_ring(self, traced_pd_cluster):
+        master, servers = traced_pd_cluster
+        srid, _events = stream_completion(
+            master.http_address,
+            {"model": "fake-echo", "prompt": "ring route", "max_tokens": 4,
+             "temperature": 0.0},
+        )
+        pf = servers[0]
+        code, body = http_get_json(pf.address, f"/trace?srid={srid}")
+        assert code == 200
+        assert body["process"] == "tpd-pf"
+        assert any(r["stage"] == "admit" for r in body["spans"])
+
+    def test_scrape_ms_histogram_in_aggregation(self, traced_pd_cluster):
+        """ISSUE 17 satellite: per-instance scrape latency rides the
+        master's aggregated /metrics as a labelled histogram."""
+        master, _servers = traced_pd_cluster
+        host, _, port = master.http_address.partition(":")
+        conn = http.client.HTTPConnection(host, int(port), timeout=10.0)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        text = resp.read().decode()
+        conn.close()
+        assert resp.status == 200
+        fams = parse_metrics(text)
+        fam = fams["xllm_cluster_scrape_ms"]
+        assert fam.kind == "histogram"
+        for inst in ("tpd-pf", "tpd-dec"):
+            counts = [
+                v for n, labels, v in fam.samples
+                if n.endswith("_count") and labels.get("instance") == inst
+            ]
+            assert counts and counts[0] >= 1, inst
+
+
+class TestTracingOffDifferential:
+    def test_disabled_tracing_is_invisible_on_the_token_path(
+        self, tmp_path,
+    ):
+        """XLLM_TRACE=0 must leave the token stream byte-identical and do
+        ZERO instance-side span work (no hook installed, nothing
+        emitted) — tracing is free when it is off."""
+        req = {
+            "model": "fake-echo", "prompt": "differential stream",
+            "max_tokens": 6, "temperature": 0.0,
+        }
+
+        def run(trace_env, prefix):
+            store, master, servers = _make_pd_stack(
+                tmp_path, prefix, trace_env,
+            )
+            try:
+                _srid, events = stream_completion(master.http_address, req)
+                emitted = sum(
+                    srv.span_ring.stats()["emitted"] for srv in servers
+                )
+                hooks = [
+                    getattr(srv.engine, "span_hook", None)
+                    for srv in servers
+                ]
+                return events, emitted, hooks
+            finally:
+                _teardown_stack(store, master, servers)
+
+        ev_on, emitted_on, hooks_on = run("1", "don")
+        ev_off, emitted_off, hooks_off = run("0", "doff")
+
+        # the streams are byte-identical once the per-run envelope ids
+        # (request id, wall-clock stamp) are masked
+        def normalize(events):
+            out = []
+            for ev in events:
+                ev = dict(ev)
+                ev.pop("id", None)
+                ev.pop("created", None)
+                out.append(json.dumps(ev, sort_keys=True))
+            return out
+
+        assert normalize(ev_on) == normalize(ev_off)
+        assert emitted_on > 0
+        assert emitted_off == 0
+        assert all(h is not None for h in hooks_on)
+        assert all(h is None for h in hooks_off)
+
+
+# --------------------------------------------------------------------- #
+# master scrape-conflict counter (satellite, e2e half)
+# --------------------------------------------------------------------- #
+
+
+class TestScrapeConflictCounter:
+    def test_conflicting_instance_exposition_counted(
+        self, traced_pd_cluster,
+    ):
+        """Point one instance's scrape address at a stub that serves a
+        kind-conflicting family: the aggregated exposition must stay
+        strictly parseable (family skipped) and the conflict counter must
+        tick (tests/test_obs.py scrape-failure precedent)."""
+        import http.server
+
+        master, _servers = traced_pd_cluster
+        # conflicts with the master-local gauge of the same name
+        body = (
+            "# TYPE xllm_service_inflight_requests counter\n"
+            "xllm_service_inflight_requests 3\n"
+        ).encode()
+
+        class Stub(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        httpd = http.server.HTTPServer(("127.0.0.1", 0), Stub)
+        th = threading.Thread(target=httpd.serve_forever, daemon=True)
+        th.start()
+        mgr = master.scheduler.instance_mgr
+        meta = mgr.get_instance("tpd-pf")
+        orig = meta.http_address
+        meta.http_address = "127.0.0.1:%d" % httpd.server_address[1]
+        try:
+            before = master._m_scrape_conflicts.get()
+            host, _, port = master.http_address.partition(":")
+            conn = http.client.HTTPConnection(host, int(port), timeout=10.0)
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            text = resp.read().decode()
+            conn.close()
+            assert resp.status == 200
+            fams = parse_metrics(text)  # still strictly parseable
+            assert fams["xllm_service_inflight_requests"].kind == "gauge"
+            assert master._m_scrape_conflicts.get() > before
+        finally:
+            meta.http_address = orig
+            httpd.shutdown()
+            httpd.server_close()
+            th.join(timeout=5)
+
+
+# --------------------------------------------------------------------- #
+# REAL multi-process fleet with injected clock skew
+# --------------------------------------------------------------------- #
+
+
+class TestMultiProcessTrace:
+    def test_skewed_fleet_assembles_causally(self, tmp_path):
+        """Two instance processes with +4s / -3s monotonic skew: the
+        heartbeat clock alignment must cancel seconds of skew down to
+        RPC-delay precision, so the assembled trace spans 3 processes
+        with ZERO negative inter-process durations (ISSUE 17
+        acceptance)."""
+        helper = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "_trace_proc.py"
+        )
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env["XLLM_TRACE"] = "1"
+        store = MemoryStore(clock=lambda: 0.0)
+        cfg = ServiceConfig(
+            host="127.0.0.1", http_port=0, rpc_port=0,
+            heartbeat_interval_s=0.2, master_lease_ttl_s=5.0,
+            num_ordered_output_streams=8, block_size=16,
+            trace_dir=str(tmp_path / "mp-trace"),
+        )
+        master = Master(cfg, store=store)
+        master.start()
+        skews = {"mp-pf": 4.0, "mp-dec": -3.0}
+        procs = []
+        try:
+            for name, itype in (("mp-pf", "PREFILL"), ("mp-dec", "DECODE")):
+                procs.append(subprocess.Popen(
+                    [sys.executable, helper, master.rpc_address, name,
+                     itype, str(skews[name])],
+                    env=env, stdin=subprocess.PIPE,
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                    text=True,
+                ))
+            for p in procs:
+                deadline = time.monotonic() + 120
+                line = ""
+                while time.monotonic() < deadline:
+                    line = p.stdout.readline()
+                    if not line or line.startswith("TRACE_PROC_UP"):
+                        break
+                assert line.startswith("TRACE_PROC_UP"), (
+                    f"helper died: {line!r}"
+                )
+            assert wait_until(
+                lambda: master.scheduler.instance_mgr.counts() == (1, 1, 0),
+                timeout=30,
+            )
+
+            # Clock convergence: offsets must approach -skew (o = master
+            # - instance) before span alignment means anything. The
+            # lower bound needs the SECOND beat (echoed reply stamp).
+            def aligned():
+                return all(
+                    abs(master.clock_offset_ms(n) + skews[n] * 1000.0)
+                    < 500.0
+                    for n in skews
+                )
+
+            assert wait_until(aligned, timeout=30), {
+                n: master.clock_offset_ms(n) for n in skews
+            }
+
+            srid, events = stream_completion(
+                master.http_address,
+                {"model": "fake-echo", "prompt": "skewed fleet trace",
+                 "max_tokens": 4, "temperature": 0.0},
+                timeout=60.0,
+            )
+            assert srid and events
+            tr = pull_trace_until_finished(
+                master.http_address, srid, timeout=30.0,
+            )
+            assert len(set(tr["processes"])) >= 3
+            assert set(tr["processes"]) >= {"master", "mp-pf", "mp-dec"}
+            for n in skews:
+                assert abs(
+                    tr["offsets_ms"][n] + skews[n] * 1000.0
+                ) < 500.0, tr["offsets_ms"]
+
+            # zero negative inter-process durations along the causal
+            # chain, despite 7s of relative skew between the instances
+            first = first_stage_times(tr["spans"])
+            chain = ("receive", "dispatch", "admit", "handoff_send",
+                     "decode_admit")
+            for a, b in zip(chain, chain[1:]):
+                assert a in first and b in first, (first.keys())
+                assert first[b] - first[a] >= 0.0, (a, b, first)
+            fin = first.get("finish")
+            assert fin is not None and fin - first["decode_admit"] >= 0.0
+            blame = tr["blame_ms"]
+            assert blame["total"] > 0.0
+            assert all(
+                blame[k] >= 0.0 for k in
+                ("queue", "prefill", "handoff", "decode", "host_gap")
+            )
+        finally:
+            for p in procs:
+                try:
+                    p.stdin.close()
+                except Exception:
+                    pass
+            for p in procs:
+                try:
+                    p.wait(timeout=15)
+                except Exception:
+                    p.kill()
+            master.stop()
+            store.close()

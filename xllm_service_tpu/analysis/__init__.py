@@ -24,6 +24,7 @@ from xllm_service_tpu.analysis.hatch_registry import HatchRegistryPass
 from xllm_service_tpu.analysis.lock_discipline import LockDisciplinePass
 from xllm_service_tpu.analysis.metric_names import MetricNamesPass
 from xllm_service_tpu.analysis.sharding_rules import ShardingRulesPass
+from xllm_service_tpu.analysis.span_stages import TRACE_PLANES, SpanStagesPass
 from xllm_service_tpu.analysis.thread_joins import ThreadJoinsPass
 from xllm_service_tpu.analysis.thread_ownership import ThreadOwnershipPass
 
@@ -43,6 +44,7 @@ def all_passes(runtime: bool = True):
         ShardingRulesPass(),
         MetricNamesPass(runtime=runtime),
         FaultPointsPass(),
+        SpanStagesPass(),
     ]
 
 
@@ -55,12 +57,14 @@ __all__ = [
     "run_passes",
     "all_passes",
     "REQUIRED_POINTS",
+    "TRACE_PLANES",
     "BlockingUnderLockPass",
     "FaultPointsPass",
     "HatchRegistryPass",
     "LockDisciplinePass",
     "MetricNamesPass",
     "ShardingRulesPass",
+    "SpanStagesPass",
     "ThreadJoinsPass",
     "ThreadOwnershipPass",
 ]

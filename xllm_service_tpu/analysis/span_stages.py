@@ -1,0 +1,121 @@
+"""Span-stages pass: distributed-tracing vocabulary + plane coverage.
+
+Two layers, mirroring the fault-points registry idiom
+(docs/OBSERVABILITY.md "Distributed tracing"):
+
+* VOCABULARY — scan the package plus the bench entry points for every
+  literal stage emitted through a tracing surface
+  (`RequestTracer.stage`, `SpanRing.emit`, `InstanceServer._span`,
+  `engine.span_hook`, the fabric `_span_hook`s) and require it to be a
+  member of the canonical vocabulary (`obs.spans.ALL_SPAN_STAGES`). A
+  stage outside the vocabulary renders as an orphan track in the merged
+  Perfetto timeline and silently escapes `blame_stages`' edges.
+
+* TRACE PLANES — a registry of RPC-client call sites (one row per
+  cross-process plane: dispatch, PD handoff commit, KV stream OPEN,
+  fabric fetch, encoder forward, mm stream open) each of which must
+  still forward the request's trace context. A refactor that drops the
+  `trace` field from one plane breaks that plane's spans out of the
+  assembled timeline even though nothing crashes — exactly the silent
+  rot a registry row catches.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence, Tuple
+
+from xllm_service_tpu.analysis.core import Finding, LintPass, Project
+
+# A stage emission: the surface call with a LITERAL second argument.
+# Non-literal stages (e.g. the scheduler's `terminal` variable, whose
+# values come from TERMINAL_STAGES) are the vocabulary's job to
+# constrain at the definition site, not here.
+EMIT_RE = re.compile(
+    r"(?:\.stage|\.emit|\bspan_hook|\b_span|\b_span_hook)"
+    r"\(\s*[^,()]*,\s*[\r\n ]*[\"']([a-z_]+)[\"']"
+)
+
+# Contractual trace-context forwarding sites, one row per RPC plane:
+# (repo-relative file, verbatim needle, plane). The needle is the exact
+# source text that puts the trace context on that plane's wire.
+TRACE_PLANES: Tuple[Tuple[str, str, str], ...] = (
+    ("xllm_service_tpu/api/master.py", "trace=trace_ctx",
+     "master dispatch -> prefill/decode (augment_forwarded_request)"),
+    ("xllm_service_tpu/api/master.py", '"trace": trace_ctx',
+     "master dispatch -> legacy /encode body"),
+    ("xllm_service_tpu/api/master.py", '"trace": TraceContext(',
+     "master dispatch -> encoder-fabric /encode body"),
+    ("xllm_service_tpu/api/instance_serving.py",
+     'trace=body.get("trace")',
+     "forwarded admission -> KV stream session + fabric prefetch"),
+    ("xllm_service_tpu/api/instance_kv.py",
+     'header["trace"] = self.trace',
+     "KV stream session OPEN -> decode peer"),
+    ("xllm_service_tpu/api/instance_kv.py",
+     'extra["trace"] = body["trace"]',
+     "PD handoff commit -> decode peer"),
+    ("xllm_service_tpu/api/instance_fabric.py",
+     'fetch_header["trace"] = trace',
+     "prefix-fabric /kv/fetch frame -> holder"),
+    ("xllm_service_tpu/api/instance_mm.py",
+     'mm_open["trace"] = body["trace"]',
+     "encoder /mm/open stream session -> prefill peer"),
+)
+
+
+class SpanStagesPass(LintPass):
+    id = "span-stages"
+    title = "trace-span stage vocabulary + trace-plane forwarding registry"
+
+    def __init__(
+        self,
+        vocab: Optional[Sequence[str]] = None,
+        planes: Optional[Sequence[Tuple[str, str, str]]] = None,
+    ):
+        # Injectable for fixture tests; the repo run uses the canonical
+        # vocabulary and the plane registry above.
+        self._vocab = vocab
+        self.planes = TRACE_PLANES if planes is None else tuple(planes)
+
+    @property
+    def vocab(self) -> frozenset:
+        if self._vocab is None:
+            from xllm_service_tpu.obs.spans import ALL_SPAN_STAGES
+
+            self._vocab = ALL_SPAN_STAGES
+        return frozenset(self._vocab)
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        vocab = self.vocab
+        for src in project.all_lintable():
+            for m in EMIT_RE.finditer(src.text):
+                stage = m.group(1)
+                if stage in vocab:
+                    continue
+                line = src.text.count("\n", 0, m.start()) + 1
+                findings.append(Finding(
+                    self.id, src.rel, line,
+                    f"span stage {stage!r} is not in the canonical "
+                    f"vocabulary (obs.spans.ALL_SPAN_STAGES) — an "
+                    f"off-vocabulary stage is invisible to "
+                    f"build_timeline/blame_stages",
+                ))
+        for rel, needle, plane in self.planes:
+            src = project.find(rel)
+            if src is None:
+                findings.append(Finding(
+                    self.id, rel, 1,
+                    f"trace-plane registry names {rel} ({plane}) but the "
+                    f"file is gone — update the registry row",
+                ))
+                continue
+            if needle not in src.text:
+                findings.append(Finding(
+                    self.id, rel, 1,
+                    f"trace plane {plane!r} no longer forwards trace "
+                    f"context (needle {needle!r} missing) — spans from "
+                    f"that process drop out of the assembled timeline",
+                ))
+        return findings

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 from xllm_service_tpu.api.http_utils import get_json, post_json
@@ -31,6 +32,14 @@ logger = logging.getLogger(__name__)
 class MasterClient:
     def __init__(self, master_rpc_addr: str):
         self._addr = master_rpc_addr
+        # Clock-alignment echo state (docs/OBSERVABILITY.md, Distributed
+        # tracing): the master's reply stamp from the LAST heartbeat
+        # response plus this process's monotonic clock at receipt — echoed
+        # on the next beat so the master derives a LOWER bound on
+        # (master_mono - instance_mono); the request's send stamp gives
+        # the upper bound. Reset on master takeover (old stamps are from
+        # a different process clock).
+        self._clock_echo: Optional[Dict] = None
 
     def hello(self, name: str) -> bool:
         code, resp = post_json(self._addr, "/rpc/hello", {"name": name})
@@ -72,10 +81,25 @@ class MasterClient:
             body["latency_metrics"] = latency_metrics.to_json()
         if cache_event is not None and not cache_event.empty():
             body["cache_event"] = cache_event.to_json()
+        # Monotonic-offset sample for cross-process trace alignment: the
+        # send stamp bounds the master-instance clock offset from above,
+        # the echoed reply stamp (previous response) bounds it from below.
+        clock: Dict = {"send_mono_ms": round(time.monotonic() * 1000.0, 3)}
+        if self._clock_echo is not None:
+            clock["echo_master_mono_ms"] = self._clock_echo["master_mono_ms"]
+            clock["echo_recv_mono_ms"] = self._clock_echo["recv_mono_ms"]
+        body["clock"] = clock
         # Chaos hook: a dropped beat simulates the instance->master side of
         # a partition (staleness suspicion / pruning paths).
         faults.point("heartbeat.send", name=name, addr=self._addr)
         code, resp = post_json(self._addr, "/rpc/heartbeat", body, timeout=10.0)
+        if code == 200 and isinstance(resp, dict):
+            reply = resp.get("clock")
+            if isinstance(reply, dict) and reply.get("master_mono_ms") is not None:
+                self._clock_echo = {
+                    "master_mono_ms": float(reply["master_mono_ms"]),
+                    "recv_mono_ms": round(time.monotonic() * 1000.0, 3),
+                }
         return resp if code == 200 else {"ok": False}
 
     def push_generations(
@@ -218,6 +242,9 @@ class HeartbeatLoop:
                 self._client._addr, new_rpc,
             )
             self._client._addr = new_rpc
+            # The successor runs a different process clock: stale echo
+            # stamps would poison its offset lower bounds.
+            self._client._clock_echo = None
         if resp.get("reregister") and not self._stop.is_set():
             # The stop guard matters: a slow in-flight beat straddling
             # shutdown would otherwise re-insert the instance AFTER the

@@ -35,7 +35,9 @@ from xllm_service_tpu.common.types import (
     RequestOutput,
 )
 from xllm_service_tpu.obs import (
+    FlightRecorder,
     MetricsRegistry,
+    SpanRing,
     absorb_exposition,
     render_families,
 )
@@ -221,6 +223,32 @@ class InstanceServer(
                 lambda: self.engine.spec_tokens_emitted
                 / max(self.engine.spec_slot_steps, 1)
             )
+
+        # Distributed tracing + anomaly flight recorder (obs/flight.py,
+        # docs/OBSERVABILITY.md). The ring is always-on (the recorder
+        # dumps it on fenced RPCs / KV stalls); span EMISSION is gated by
+        # the XLLM_TRACE hatch — with it off the engine's span hook stays
+        # None and the token path does no per-step tracing work at all.
+        self.trace_enabled = os.environ.get(
+            "XLLM_TRACE", "1"
+        ).lower() not in ("0", "false", "off")
+        self.span_ring = SpanRing(
+            self.name,
+            int(os.environ.get("XLLM_TRACE_RING", "") or 2048),
+        )
+        self.flight = FlightRecorder(
+            self.span_ring,
+            os.path.join(
+                os.environ.get("XLLM_TRACE_DIR", "trace"),
+                f"flight-{self.name}",
+            ),
+            registry=self.metrics,
+        )
+        if self.trace_enabled:
+            # Engine-side emission (prefill chunks, step batches): the
+            # engine loop calls hook(srid, stage, **fields) per step /
+            # chunk — never per token — only while a hook is installed.
+            setattr(self.engine, "span_hook", self.span_ring.emit)
 
         # Pipelined PD handoff state + metrics (instance_kv mixin):
         # streaming-session tables and the handoff stall/overlap series.
@@ -617,8 +645,32 @@ class InstanceServer(
                     ],
                 }
             )
+        elif route == "/trace":
+            # Trace-collector pull (docs/OBSERVABILITY.md): this process's
+            # ring spans, filtered to one request when ?srid= is given.
+            # Timestamps are THIS process's monotonic clock — the master
+            # shifts them with the heartbeat-derived offset.
+            srid = h.query().get("srid", "")
+            spans = (
+                self.span_ring.for_request(srid)
+                if srid
+                else self.span_ring.snapshot()
+            )
+            h.send_json(
+                {
+                    "process": self.name,
+                    "spans": spans,
+                    "ring": self.span_ring.stats(),
+                }
+            )
         else:
             h.send_error_json(404, f"no route {route}")
+
+    def _span(self, srid: str, stage: str, **fields: Any) -> None:
+        """One instance-side span into the flight ring (no-op with the
+        XLLM_TRACE hatch off — the serving paths stay allocation-free)."""
+        if self.trace_enabled:
+            self.span_ring.emit(srid, stage, **fields)
 
     # ------------------------------------------------------------------ #
     # epoch fencing + takeover reconciliation (docs/FAULT_TOLERANCE.md)
@@ -651,6 +703,13 @@ class InstanceServer(
         if not cur:
             return False
         self._m_fenced.inc()
+        # Anomaly trigger: a fenced RPC means split-brain dispatch was
+        # just attempted — capture the surrounding span window.
+        self.flight.trigger(
+            "fenced_rpc",
+            str((body or {}).get("service_request_id") or ""),
+            stale_epoch=stamped, fence_epoch=cur,
+        )
         logger.warning(
             "instance %s fenced an RPC from a deposed master "
             "(epoch %s < %d)", self.name, stamped, cur,

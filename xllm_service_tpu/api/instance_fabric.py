@@ -31,7 +31,7 @@ import logging
 import queue
 import threading
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -129,7 +129,8 @@ class FabricMixin:
     # ------------------------------------------------------- requester side
 
     def _fabric_prefetch(
-        self, token_ids: List[int], hint: Dict[str, Any]
+        self, token_ids: List[int], hint: Dict[str, Any],
+        srid: str = "", trace: Optional[Dict[str, Any]] = None,
     ) -> None:
         """Kick off the peer prefix fetch for one admitted request (HTTP
         serving thread; the network work runs on a daemon thread so
@@ -183,16 +184,20 @@ class FabricMixin:
         addr = str(hint.get("addr") or "")
         threading.Thread(
             target=self._fabric_fetch,
-            args=(holder, addr, missing, key),
+            args=(holder, addr, missing, key, srid, trace),
             name=f"kv-fetch-{self.name}",
             daemon=True,
         ).start()
 
     def _fabric_fetch(
-        self, holder: str, addr: str, missing: List[bytes], key: bytes
+        self, holder: str, addr: str, missing: List[bytes], key: bytes,
+        srid: str = "", trace: Optional[Dict[str, Any]] = None,
     ) -> None:
         t0 = time.monotonic()
         self._m_fabric_fetches.inc()
+        self._span(
+            srid, "fabric_fetch", holder=holder, blocks=len(missing)
+        )
         try:
             if not addr:
                 addr = self._resolve_instance_addr(holder)
@@ -203,11 +208,16 @@ class FabricMixin:
                 instance=self.name, peer=holder, addr=addr,
                 blocks=len(missing),
             )
+            fetch_header: Dict[str, Any] = {
+                "block_hashes": [h.hex() for h in missing]
+            }
+            if isinstance(trace, dict):
+                # Trace context rides the fetch frame so the holder's
+                # serve shows up on the requesting request's timeline.
+                fetch_header["trace"] = trace
             code, raw = post_bytes_raw(
                 addr, "/kv/fetch",
-                kv_frame_to_bytes(
-                    {"block_hashes": [h.hex() for h in missing]}
-                ),
+                kv_frame_to_bytes(fetch_header),
                 timeout=FETCH_TIMEOUT_S,
             )
             if code != 200:
@@ -233,6 +243,11 @@ class FabricMixin:
             self.engine.import_kv_blocks(served, kv)
             self._m_fabric_fetch_blocks.inc(len(served))
             self._m_fabric_fetch_ms.observe((time.monotonic() - t0) * 1000)
+            self._span(
+                srid, "fabric_landed",
+                holder=holder, blocks=len(served),
+                fetch_ms=round((time.monotonic() - t0) * 1000, 3),
+            )
         except Exception as e:  # noqa: BLE001 — fetch must fail soft
             self._m_fabric_fetch_aborts.inc()
             logger.warning(

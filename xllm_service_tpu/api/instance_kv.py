@@ -91,7 +91,10 @@ class _KVStreamSession:
     deliver are merely unused cache entries on the peer.
     """
 
-    def __init__(self, owner, srid: str, decode_name: str, epoch: int = 0):
+    def __init__(
+        self, owner, srid: str, decode_name: str, epoch: int = 0,
+        trace: Optional[Dict[str, Any]] = None,
+    ):
         self.owner = owner
         self.srid = srid
         self.decode_name = decode_name
@@ -99,6 +102,10 @@ class _KVStreamSession:
         # session OPEN carries it so the decode peer's fence rejects KV
         # control traffic descending from a deposed master's dispatch.
         self.epoch = int(epoch or 0)
+        # Trace context of the dispatching request: rides the session
+        # OPEN so the decode peer's chunk-landing spans join the same
+        # cross-process timeline.
+        self.trace = trace if isinstance(trace, dict) else None
         self.session_id = generate_uuid(16)
         self.aborted = False
         self._mu = threading.Lock()
@@ -269,6 +276,8 @@ class _KVStreamSession:
             # OPEN is the admission decision (reservation), so it is the
             # message the receiver must be able to reject as stale.
             header["master_epoch"] = self.epoch
+        if meta["idx"] == 0 and self.trace:
+            header["trace"] = self.trace
         if self._offer_session is None and self.owner._kv_transfer is not None:
             self._offer_session = self.owner._kv_transfer.open_offer_session()
         return self.owner._post_kv_frame(
@@ -283,6 +292,13 @@ class _KVStreamSession:
         m = getattr(self.owner, "_m_kv_stream_chunks", None)
         if m is not None:
             m.inc()
+        _span = getattr(self.owner, "_span", None)
+        if _span is not None:
+            _span(
+                self.srid, "kv_chunk_sent",
+                blocks=n_blocks, session=self.session_id,
+                peer=self.decode_name,
+            )
 
     def _fail(self, reason: str) -> None:
         with self._mu:
@@ -433,7 +449,7 @@ class KVHandoffMixin:
         )
 
     def _open_kv_stream(
-        self, srid: str, decode_name: str, epoch=None
+        self, srid: str, decode_name: str, epoch=None, trace=None
     ) -> Optional[_KVStreamSession]:
         """Create the pipelined-handoff session for a PD-split request (or
         None when the escape hatch disables streaming). Costless for
@@ -447,7 +463,9 @@ class KVHandoffMixin:
             epoch = int(epoch or 0)
         except (TypeError, ValueError):
             epoch = 0
-        return _KVStreamSession(self, srid, decode_name, epoch=epoch)
+        return _KVStreamSession(
+            self, srid, decode_name, epoch=epoch, trace=trace
+        )
 
     def _transfer_loop(self, q=None) -> None:
         q = q if q is not None else self._transfer_q
@@ -571,6 +589,11 @@ class KVHandoffMixin:
                     # the decode peer must reject a commit descending
                     # from a deposed master's dispatch.
                     extra["master_epoch"] = body["master_epoch"]
+                if isinstance(body.get("trace"), dict):
+                    # Trace context follows the request across the PD
+                    # boundary: the decode peer's admission span joins
+                    # the dispatching request's timeline.
+                    extra["trace"] = body["trace"]
                 if kv_stream is not None and kv_stream.chunks_sent:
                     # Streamed session: the commit trails its own chunks.
                     # Blocks land order-independently at the peer, but a
@@ -644,6 +667,22 @@ class KVHandoffMixin:
                 self._kv_stall_samples.append(
                     ("streamed" if streamed > 0 else "mono", stall_ms)
                 )
+                self._span(
+                    srid, "handoff_commit",
+                    peer=decode_name, stall_ms=round(stall_ms, 3),
+                    streamed_blocks=streamed,
+                )
+                stall_thresh = float(
+                    os.environ.get("XLLM_TRACE_STALL_MS", "")
+                    or getattr(self.cfg, "trace_stall_ms", 2000.0)
+                    or 2000.0
+                )
+                if stall_ms > stall_thresh:
+                    self.flight.trigger(
+                        "kv_handoff_stall", srid,
+                        stall_ms=round(stall_ms, 3),
+                        threshold_ms=stall_thresh, peer=decode_name,
+                    )
                 with self._kv_stats_mu:  # transfer pool: concurrent commits
                     self._kv_stream_blocks_streamed += streamed
                     self._kv_mig_blocks_total += int(handoff.num_full_blocks)
@@ -666,6 +705,11 @@ class KVHandoffMixin:
 
         def send(handoff) -> None:
             t_pf_done = time.monotonic()  # prefill just finished
+            self._span(
+                srid, "handoff_send",
+                peer=decode_name,
+                blocks=int(getattr(handoff, "num_full_blocks", 0) or 0),
+            )
             # Engine-thread side. The KV export arrives as a DEVICE array;
             # it may only stay device-resident if a colocated peer will
             # take it directly (in-process import) or the pull plane will
@@ -1007,6 +1051,10 @@ class KVHandoffMixin:
                 )
                 return
         self.engine.import_kv_blocks(hashes, kv)
+        land_srid = str(header.get("service_request_id", ""))
+        self._span(
+            land_srid, "kv_chunk_landed", blocks=len(hashes), session=sid
+        )
         with self._kv_sessions_mu:
             ent = self._kv_sessions.get(sid)
             if ent is not None:
@@ -1071,6 +1119,11 @@ class KVHandoffMixin:
         relay_addr = header.get("respond_addr", "")
         if relay_addr:
             self._relay_addrs[srid] = relay_addr
+        self._span(
+            srid, "decode_admit",
+            tokens=len(handoff.token_ids),
+            full_blocks=int(getattr(handoff, "num_full_blocks", 0) or 0),
+        )
         detoks: Dict[int, IncrementalDetokenizer] = {}
         if "detok_ids" in header:
             detoks[0] = IncrementalDetokenizer.from_state(

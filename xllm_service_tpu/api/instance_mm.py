@@ -553,16 +553,17 @@ class MultimodalMixin:
         # the monolithic fallback below still delivers.
         epoch = body.get("master_epoch", 0)
         streaming = True
+        mm_open: Dict[str, Any] = {
+            "service_request_id": srid,
+            "items": len(decoded),
+            "master_epoch": epoch,
+        }
+        if isinstance(body.get("trace"), dict):
+            # Trace context crosses the encoder->prefill stream plane so
+            # the peer's embed landing joins the request's timeline.
+            mm_open["trace"] = body["trace"]
         try:
-            code, _ = post_json(
-                target, "/mm/open",
-                {
-                    "service_request_id": srid,
-                    "items": len(decoded),
-                    "master_epoch": epoch,
-                },
-                timeout=10.0,
-            )
+            code, _ = post_json(target, "/mm/open", mm_open, timeout=10.0)
             streaming = code == 200
         except Exception:
             streaming = False
@@ -635,6 +636,11 @@ class MultimodalMixin:
                 except queue.Full:
                     _chunk_done("stream lane saturated")
 
+        self._span(
+            srid, "encoder_batch",
+            items=len(decoded), target=target,
+            error=encode_err or None,
+        )
         if encode_err is not None:
             if streaming:
                 try:

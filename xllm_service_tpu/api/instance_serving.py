@@ -459,7 +459,9 @@ class ServingMixin:
             fab = body.get("kv_fabric")
             if fab and not body.get("mm_positions") and not adapter_idx:
                 try:
-                    self._fabric_prefetch(token_ids, fab)
+                    self._fabric_prefetch(
+                        token_ids, fab, srid=srid, trace=body.get("trace")
+                    )
                 except Exception:
                     logger.exception("fabric prefetch failed; recomputing")
 
@@ -469,6 +471,9 @@ class ServingMixin:
             # tracking entry that only a takeover scan would collect.
             self._srid_track(
                 srid, len(token_ids), body.get("master_epoch")
+            )
+            self._span(
+                srid, "admit", prompt_tokens=len(token_ids), fanout=True
             )
             # Fan-out mode: PD split is skipped for multi-sequence requests
             # (a per-child handoff would need sub-request ids on the wire);
@@ -547,6 +552,10 @@ class ServingMixin:
             self._srid_track(
                 srid, len(token_ids), body.get("master_epoch")
             )
+            # Instance-side span: one admission record per forwarded
+            # request, clocked on THIS process (the trace collector
+            # aligns it with the master's dispatch span).
+            self._span(srid, "admit", prompt_tokens=len(token_ids))
             detoks: Dict[int, IncrementalDetokenizer] = {}
             callback = self._make_push_callback(srid, detoks)
             routing = body.get("routing") or {}
@@ -578,6 +587,7 @@ class ServingMixin:
                     self._push_acked[srid] = threading.Event()
                 kv_stream = self._open_kv_stream(
                     srid, decode_name, epoch=body.get("master_epoch"),
+                    trace=body.get("trace"),
                 )
                 self.engine.add_request(
                     EngineRequest(

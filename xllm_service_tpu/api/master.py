@@ -31,6 +31,7 @@ from xllm_service_tpu.api.http_utils import (
     HttpJsonApi,
     RetryBudget,
     SseWriter,
+    get_json,
     get_raw,
     make_http_server,
     post_json,
@@ -54,12 +55,17 @@ from xllm_service_tpu.common.types import (
     LoadMetrics,
     RequestAction,
     StatusCode,
+    TraceContext,
 )
 from xllm_service_tpu.coordination.store import CoordinationStore
 from xllm_service_tpu.obs import (
+    ClockSync,
     MetricsRegistry,
     absorb_exposition,
+    assemble_trace,
+    blame_stages,
     render_families,
+    trace_to_chrome,
 )
 from xllm_service_tpu.service import (
     ClientStream,
@@ -309,6 +315,24 @@ class Master:
             "xllm_cluster_scrape_failures_total",
             "Instance /metrics scrapes that failed during aggregation",
         )
+        # Scrape COST, not just failures: one slow engine inflating the
+        # fleet /metrics path shows up here before it times out.
+        self._m_scrape_ms = self.cluster_metrics.histogram(
+            "xllm_cluster_scrape_ms",
+            "Per-instance /metrics scrape latency during aggregation",
+            labelnames=("instance",),
+        )
+        self._m_scrape_conflicts = self.cluster_metrics.counter(
+            "xllm_cluster_scrape_type_conflicts_total",
+            "Instance metric families skipped during aggregation because "
+            "their # TYPE disagreed with the first-seen kind",
+        )
+        # Per-instance monotonic-clock offset estimators, fed by the
+        # heartbeat piggyback samples (docs/OBSERVABILITY.md, Distributed
+        # tracing): GET /trace shifts instance spans into the master
+        # clock domain with these.
+        self._clocks: Dict[str, ClockSync] = {}
+        self._clocks_mu = threading.Lock()
         # Long-lived scrape pool: its threads keep get_raw's thread-local
         # keep-alive connections warm across scrape intervals (a per-call
         # pool would pay thread start-up + a fresh TCP connect to every
@@ -424,8 +448,62 @@ class Master:
             )
         elif route == "/metrics":
             self._handle_metrics(h)
+        elif route.startswith("/trace/"):
+            self._handle_trace(h, route[len("/trace/"):])
         else:
             h.send_error_json(404, f"no route {route}")
+
+    def _handle_trace(self, h: HttpJsonApi, srid: str) -> None:
+        """Distributed-trace collector (docs/OBSERVABILITY.md): pull every
+        participant's ring spans for one service_request_id, shift them
+        into the master clock domain with the heartbeat-derived offsets,
+        and return ONE assembled timeline + per-stage blame + a Perfetto
+        trace_event export with one track per process."""
+        if not srid:
+            h.send_error_json(400, "service_request_id required")
+            return
+        sched = self.scheduler
+        master_spans = sched.span_ring.for_request(srid)
+        names = sched.trace_participants(srid)
+        if not names:
+            # Unknown to the participant index (evicted or pre-dispatch):
+            # fall back to asking the whole (small) fleet.
+            names = [
+                m.name for m in sched.instance_mgr.list_instances()
+            ]
+        participants = []
+        offsets: Dict[str, Any] = {}
+        for name in names:
+            meta = sched.instance_mgr.get_instance(name)
+            if meta is None:
+                continue
+            try:
+                code, resp = get_json(
+                    meta.http_address, f"/trace?srid={srid}", timeout=5.0
+                )
+            except Exception:
+                continue
+            if code != 200 or not isinstance(resp, dict):
+                continue
+            spans = resp.get("spans") or []
+            off = self.clock_offset_ms(name)
+            offsets[name] = round(off, 3)
+            if spans:
+                participants.append((name, spans, off))
+        if not master_spans and not participants:
+            h.send_error_json(404, f"no spans recorded for {srid}")
+            return
+        merged = assemble_trace("master", master_spans, participants)
+        h.send_json(
+            {
+                "service_request_id": srid,
+                "processes": ["master"] + [p[0] for p in participants],
+                "offsets_ms": offsets,
+                "blame_ms": blame_stages(merged),
+                "spans": merged,
+                "chrome": trace_to_chrome(merged),
+            }
+        )
 
     def _handle_metrics(self, h: HttpJsonApi) -> None:
         inst = h.query().get("instance")
@@ -466,8 +544,9 @@ class Master:
         fams: "OrderedDict[str, Any]" = OrderedDict()
         # Local registries go straight in as families — no render->parse
         # round trip for data already in memory in the target shape.
+        # (cluster_metrics is snapshotted AFTER the scrape loop below so
+        # the scrape-latency histogram includes THIS exposure's scrapes.)
         fams.update(self.scheduler.metrics.families())
-        fams.update(self.cluster_metrics.families())
         # Front-end planes: both backends report stats() now (the event
         # loop's full set; the threaded backend's request/accept
         # counters) — emit whichever keys each plane has.
@@ -528,7 +607,18 @@ class Master:
         instances = sorted(mgr.list_instances(), key=lambda m: m.name)
 
         def scrape(meta):
-            status, raw, _ = get_raw(meta.http_address, "/metrics", timeout=2.0)
+            # Timed INSIDE the pool thread so the histogram measures the
+            # instance's own /metrics latency, not queueing behind other
+            # scrapes in the pool.
+            t0 = time.monotonic()
+            try:
+                status, raw, _ = get_raw(
+                    meta.http_address, "/metrics", timeout=2.0
+                )
+            finally:
+                self._m_scrape_ms.labels(instance=meta.name).observe(
+                    (time.monotonic() - t0) * 1000.0
+                )
             if status != 200:
                 raise RuntimeError(f"HTTP {status}")
             return raw.decode("utf-8", "replace")
@@ -536,12 +626,33 @@ class Master:
         futures = [self._scrape_pool.submit(scrape, m) for m in instances]
         for meta, fut in zip(instances, futures):
             try:
-                absorb_exposition(
+                conflicts = absorb_exposition(
                     fams, fut.result(timeout=10.0),
                     extra_labels={"instance": meta.name},
                 )
+                if conflicts:
+                    # Deterministic skip (first-seen kind wins); count the
+                    # dropped families instead of losing them silently.
+                    self._m_scrape_conflicts.inc(len(conflicts))
+                    logger.warning(
+                        "metrics aggregation skipped %d kind-conflicting "
+                        "families from %s: %s",
+                        len(conflicts), meta.name, ", ".join(conflicts),
+                    )
             except Exception:
                 self._m_scrape_failures.inc()
+        # Cluster-level registry last: scrape_ms observations from the
+        # loop above are already in it, so the histogram is never a
+        # TYPE-only family on the first exposure. absorb via the families
+        # dict, not update(): an instance-absorbed family of the same
+        # name must not be clobbered.
+        for name, fam in self.cluster_metrics.families().items():
+            if name in fams:
+                kind, _help, samples = fams[name]
+                if kind == fam[0]:
+                    fams[name] = (kind, fam[1] or _help, fam[2] + samples)
+            else:
+                fams[name] = fam
         return render_families(fams)
 
     def _redirect_if_standby(
@@ -776,6 +887,15 @@ class Master:
                 return
             wire = req.wire_srid or req.service_request_id
             epoch = self.scheduler.master_epoch
+            # Distributed-tracing context: trace_id is the BASE service
+            # id (stable across replay attempts), the parent span names
+            # the attempt-versioned dispatch that spawned the downstream
+            # work, origin_epoch fences stale traces.
+            trace_ctx = TraceContext(
+                trace_id=req.service_request_id,
+                parent_span=f"dispatch:{wire}",
+                origin_epoch=epoch,
+            ).to_json()
             stream_mm = False
             if req.media_parts:
                 from xllm_service_tpu.cluster.encoder_fabric import (
@@ -813,6 +933,7 @@ class Master:
                             "positions": req.mm_positions,
                             "target": meta.http_address,
                             "master_epoch": epoch,
+                            "trace": trace_ctx,
                         },
                         # Generous: the encoder's FIRST request pays its
                         # XLA compile inside this call.
@@ -854,6 +975,7 @@ class Master:
                     != req.routing.prefill_name
                     else None
                 ),
+                trace=trace_ctx,
             )
             if req.resume_base:
                 # Token-replay resume: the last resume_base token_ids are
@@ -1051,6 +1173,11 @@ class Master:
                         "positions": req.mm_positions,
                         "target": prefill_meta.http_address,
                         "master_epoch": epoch,
+                        "trace": TraceContext(
+                            trace_id=req.service_request_id,
+                            parent_span=f"dispatch:{wire}",
+                            origin_epoch=epoch,
+                        ).to_json(),
                     },
                     # Generous: the encoder's FIRST request pays its XLA
                     # compile inside this call.
@@ -1215,6 +1342,35 @@ class Master:
             self._store.revoke_lease(lease)
         h.send_json({"ok": True, "removed": lease is not None})
 
+    def _record_clock_sample(self, name: str, clk: Any) -> None:
+        """One heartbeat's monotonic-offset bounds for `name` (clock
+        alignment, docs/OBSERVABILITY.md): the request's send stamp gives
+        an UPPER bound on (master_mono - instance_mono); the echoed reply
+        stamp from the PREVIOUS response gives a LOWER bound."""
+        if not isinstance(clk, dict):
+            return
+        now_ms = time.monotonic() * 1000.0
+        with self._clocks_mu:
+            sync = self._clocks.setdefault(name, ClockSync())
+        try:
+            if clk.get("send_mono_ms") is not None:
+                sync.sample_upper(now_ms - float(clk["send_mono_ms"]))
+            if (
+                clk.get("echo_master_mono_ms") is not None
+                and clk.get("echo_recv_mono_ms") is not None
+            ):
+                sync.sample_lower(
+                    float(clk["echo_master_mono_ms"])
+                    - float(clk["echo_recv_mono_ms"])
+                )
+        except (TypeError, ValueError):
+            pass
+
+    def clock_offset_ms(self, name: str) -> float:
+        with self._clocks_mu:
+            sync = self._clocks.get(name)
+        return sync.offset_ms() if sync is not None else 0.0
+
     def _handle_heartbeat(self, h: HttpJsonApi, body: Dict[str, Any]) -> None:
         name = body.get("name", "")
         if not self.scheduler.is_master:
@@ -1237,6 +1393,7 @@ class Master:
             # tell the engine to re-register (the etcd-expiry analog).
             h.send_json({"ok": False, "reregister": True})
             return
+        self._record_clock_sample(name, body.get("clock"))
         load = body.get("load_metrics")
         lat = body.get("latency_metrics")
         cache = body.get("cache_event")
@@ -1262,6 +1419,12 @@ class Master:
         ):
             self.scheduler.instance_mgr.requeue_flip(name, 1)
         resp: Dict[str, Any] = {"ok": True}
+        if isinstance(body.get("clock"), dict):
+            # Reply stamp: the instance echoes it (with its own receive
+            # stamp) on the NEXT beat, closing the offset's lower bound.
+            resp["clock"] = {
+                "master_mono_ms": round(time.monotonic() * 1000.0, 3)
+            }
         if self.scheduler.take_cache_resync(name):
             # Breaker ejection pruned this instance's KV-index locations;
             # deltas can't rebuild them — ask for the full committed-block

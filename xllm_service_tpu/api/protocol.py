@@ -318,6 +318,7 @@ def augment_forwarded_request(
     decode_response_to_service: bool = True,
     master_epoch: int = 0,
     kv_fabric: Optional[Dict[str, Any]] = None,
+    trace: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Inject the service-side fields so the engine skips re-tokenization
     and knows its PD pair. `decode_response_to_service=False` selects the
@@ -340,6 +341,12 @@ def augment_forwarded_request(
         # prefix holder for this prompt; the instance pulls the gap over
         # /kv/fetch while chunk-prefilling the uncovered tail.
         fwd["kv_fabric"] = dict(kv_fabric)
+    if trace:
+        # Distributed-tracing context (docs/OBSERVABILITY.md): the
+        # instance threads it through every downstream plane it opens
+        # (KV handoff, fabric fetch, encoder forward) and tags its span
+        # ring emissions with the trace's request.
+        fwd["trace"] = dict(trace)
     return fwd
 
 

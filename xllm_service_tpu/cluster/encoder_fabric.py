@@ -62,10 +62,13 @@ class EncoderFabric:
     consulted by `schedule()` for hit-aware encoder routing, pruned by
     the breaker/removal listeners."""
 
-    def __init__(self, config, instance_mgr, metrics=None):
+    def __init__(self, config, instance_mgr, metrics=None, span_hook=None):
         self._config = config
         self._instance_mgr = instance_mgr
         self._mu = threading.Lock()
+        # Distributed tracing: span_hook(srid, stage, **fields) — the
+        # master's ring-buffer emit for hit-aware encoder-routing spans.
+        self._span_hook = span_hook
         # media content hash -> encoder instance names holding it.
         self._index: Dict[bytes, Set[str]] = {}
         # Fleet-wide embedding hit accounting from the router's vantage:
@@ -129,7 +132,9 @@ class EncoderFabric:
         with self._mu:
             return set(self._index.get(media_hash, ()))
 
-    def match(self, hashes: Iterable[bytes]) -> Dict[str, int]:
+    def match(
+        self, hashes: Iterable[bytes], srid: str = ""
+    ) -> Dict[str, int]:
         """Per-encoder cached-item counts for one request's media list.
         Always feeds the fleet hit-rate gauge (fabric on or off, so an
         A/B hatch flip never flatlines it); the ROUTING consumer only
@@ -147,6 +152,12 @@ class EncoderFabric:
                     scores[name] = scores.get(name, 0) + 1
             self.fleet_total_items += len(hashes)
             self.fleet_hit_items += hit_items
+        if self._span_hook is not None and hashes:
+            self._span_hook(
+                srid, "encoder_route",
+                items=len(hashes), hit_items=hit_items,
+                encoders=len(scores),
+            )
         return scores
 
     @staticmethod

@@ -85,11 +85,18 @@ class PrefixFabric:
     Scheduler; consulted by `schedule()` (hint), `CacheAwareRouting`
     (scores), and the `/rpc/fabric/evict_offer` RPC (decisions)."""
 
-    def __init__(self, config, instance_mgr, kvcache_mgr, metrics=None):
+    def __init__(
+        self, config, instance_mgr, kvcache_mgr, metrics=None,
+        span_hook=None,
+    ):
         self._config = config
         self._instance_mgr = instance_mgr
         self._kvcache_mgr = kvcache_mgr
         self._mu = threading.Lock()
+        # Distributed tracing: span_hook(srid, stage, **fields) — the
+        # master's ring-buffer emit, so fetch-plan decisions land on the
+        # same merged timeline the /trace collector assembles.
+        self._span_hook = span_hook
         # Fleet-wide prefix hit accounting from the router's vantage: per
         # scheduled request, the fleet-best matched block count over the
         # prompt's total hashable blocks. This is the number the fabric
@@ -158,6 +165,7 @@ class PrefixFabric:
         token_ids: Sequence[int],
         routed: str,
         scores: Optional[OverlapScores] = None,
+        srid: str = "",
     ) -> Optional[Dict]:
         """The `kv_fabric` dispatch hint for one routed request: the best
         usable peer holding more matched blocks than the routed instance,
@@ -202,6 +210,12 @@ class PrefixFabric:
             return None
         with self._mu:
             self.plans += 1
+        if self._span_hook is not None:
+            self._span_hook(
+                srid, "fabric_plan",
+                holder=best_name, blocks=int(best_blocks),
+                routed=routed,
+            )
         return {
             "holder": best_name,
             "addr": meta.http_address,

@@ -117,6 +117,17 @@ class ServiceConfig:
     # Tracing (reference: --enable_request_trace).
     enable_request_trace: bool = False
     trace_dir: str = "trace"
+    # Rotated trace.jsonl generations kept on disk (trace.jsonl.1..N).
+    trace_keep: int = 1
+    # Flight recorder (obs/flight.py, docs/OBSERVABILITY.md): always-on
+    # span ring capacity per process, and the anomaly thresholds that
+    # dump it — TTFT SLO in ms (0 disables the SLO trigger) and the KV
+    # handoff stall bound in ms. Env hatches XLLM_TRACE_RING,
+    # XLLM_TRACE_SLO_TTFT_MS and XLLM_TRACE_STALL_MS override these
+    # fields either way (read at trigger time, so they flip live).
+    trace_ring_capacity: int = 2048
+    trace_slo_ttft_ms: float = 0.0
+    trace_stall_ms: float = 2000.0
 
     # Decode→service direct response path (reference:
     # ENABLE_DECODE_RESPONSE_TO_SERVICE env, rpc_service/service.h:61-71).

@@ -469,3 +469,46 @@ class RequestOutput:
 # Callback invoked per generation step; returns False to cancel the stream
 # (reference: common/xllm/output.h:131).
 OutputCallback = Callable[[RequestOutput], bool]
+
+
+@dataclass
+class TraceContext:
+    """Distributed-tracing context carried on every master->instance RPC
+    and peer-to-peer plane (docs/OBSERVABILITY.md, Distributed tracing):
+    dispatch forward, PD handoff/stream, fabric fetch, encoder forward,
+    and the mm stream all ride a `trace` wire field so every participant
+    stamps its spans under ONE trace id. `trace_id` is the base
+    service_request_id (stable across redispatch attempts); `parent_span`
+    names the stage of the emitting hop; `origin_epoch` is the
+    dispatching master's fencing epoch, so a collector can tell spans of
+    a deposed master's attempt apart from the successor's."""
+
+    trace_id: str = ""
+    parent_span: str = ""
+    origin_epoch: int = 0
+
+    def to_json(self) -> Dict:
+        j: Dict = {"trace_id": self.trace_id}
+        if self.parent_span:
+            j["parent_span"] = self.parent_span
+        if self.origin_epoch:
+            j["origin_epoch"] = int(self.origin_epoch)
+        return j
+
+    @staticmethod
+    def from_json(j) -> Optional["TraceContext"]:
+        if not isinstance(j, dict) or not j.get("trace_id"):
+            return None
+        try:
+            epoch = int(j.get("origin_epoch", 0) or 0)
+        except (TypeError, ValueError):
+            epoch = 0
+        return TraceContext(
+            trace_id=str(j["trace_id"]),
+            parent_span=str(j.get("parent_span", "")),
+            origin_epoch=epoch,
+        )
+
+    def child(self, parent_span: str) -> "TraceContext":
+        """Same trace, re-parented for the next hop."""
+        return TraceContext(self.trace_id, parent_span, self.origin_epoch)

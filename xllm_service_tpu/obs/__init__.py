@@ -21,11 +21,18 @@ from xllm_service_tpu.obs.metrics import (
     parse_exposition,
     render_families,
 )
+from xllm_service_tpu.obs.flight import FlightRecorder, SpanRing
 from xllm_service_tpu.obs.spans import (
+    ALL_SPAN_STAGES,
+    INSTANCE_SPAN_STAGES,
     SPAN_STAGES,
+    ClockSync,
+    assemble_trace,
+    blame_stages,
     build_timeline,
     load_spans,
     to_chrome_trace,
+    trace_to_chrome,
 )
 
 __all__ = [
@@ -38,8 +45,16 @@ __all__ = [
     "absorb_exposition",
     "parse_exposition",
     "render_families",
+    "ALL_SPAN_STAGES",
+    "INSTANCE_SPAN_STAGES",
     "SPAN_STAGES",
+    "ClockSync",
+    "FlightRecorder",
+    "SpanRing",
+    "assemble_trace",
+    "blame_stages",
     "build_timeline",
     "load_spans",
     "to_chrome_trace",
+    "trace_to_chrome",
 ]

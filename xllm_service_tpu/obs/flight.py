@@ -1,0 +1,181 @@
+"""Anomaly flight recorder: always-on span rings + triggered dumps.
+
+Every process (master, each instance) keeps a bounded in-memory ring of
+recent span records (SpanRing) — cheap enough to stay on in production,
+and the source both for the master's `GET /trace/<srid>` collector and
+for the FlightRecorder, which dumps the whole ring to disk the moment an
+anomaly trigger fires (SLO breach, breaker ejection, fenced RPC, KV
+handoff stall over threshold) so the "black box" around an incident
+survives the incident. Dumps are rate-limited and rotation-bounded; the
+recorder never throws into the serving path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+__all__ = ["SpanRing", "FlightRecorder"]
+
+
+class SpanRing:
+    """Bounded, thread-safe ring of span records for one process.
+
+    Records mirror the tracer's stage schema ({"type": "stage",
+    "service_request_id", "stage", "t_mono_ms", "timestamp_ms", ...}) so
+    obs.spans timeline/assembly code consumes them unchanged. Emission is
+    per-event (admission, chunk, step batch — never per-token) and lock
+    hold time is O(1) append, so the ring is safe to leave always-on.
+    """
+
+    def __init__(self, process: str, capacity: int = 2048) -> None:
+        self.process = process
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._ring: Deque[Dict[str, Any]] = deque(maxlen=self.capacity)
+        self._emitted = 0
+
+    def emit(self, service_request_id: str, stage: str, **fields: Any) -> None:
+        rec: Dict[str, Any] = {
+            "type": "stage",
+            "service_request_id": service_request_id,
+            "stage": stage,
+            "t_mono_ms": round(time.monotonic() * 1000.0, 3),
+            "timestamp_ms": int(time.time() * 1000),
+        }
+        for k, v in fields.items():
+            if v is not None:
+                rec[k] = v
+        with self._lock:
+            self._ring.append(rec)
+            self._emitted += 1
+
+    def append(self, rec: Dict[str, Any]) -> None:
+        """Mirror an externally-stamped record (e.g. the master tracer's
+        stage hook) into the ring without re-stamping clocks."""
+        with self._lock:
+            self._ring.append(rec)
+            self._emitted += 1
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def for_request(self, service_request_id: str) -> List[Dict[str, Any]]:
+        """Spans whose wire id matches `service_request_id` by BASE id:
+        attempt-versioned ids (`srid#rN`) collapse onto the service id so
+        one collector query sees every attempt."""
+        base = str(service_request_id).split("#", 1)[0]
+        with self._lock:
+            return [
+                r
+                for r in self._ring
+                if str(r.get("service_request_id", "")).split("#", 1)[0] == base
+            ]
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "process": self.process,
+                "capacity": self.capacity,
+                "size": len(self._ring),
+                "emitted": self._emitted,
+            }
+
+
+class FlightRecorder:
+    """Dumps a SpanRing to disk when an anomaly trigger fires.
+
+    Dump files are `flight-<seq>.json` under `directory`, rotation keeps
+    the newest `keep`, and triggers inside `min_interval_s` of the last
+    dump only count (xllm_flight_dumps_total{reason=...} still ticks) so
+    a breaker flapping at line rate can't turn the recorder into its own
+    disk DoS. All failures are swallowed: the recorder must never add a
+    failure mode to the path it is recording.
+    """
+
+    def __init__(
+        self,
+        ring: SpanRing,
+        directory: str,
+        keep: int = 8,
+        min_interval_s: float = 5.0,
+        registry: Optional[Any] = None,
+    ) -> None:
+        self.ring = ring
+        self.directory = directory
+        self.keep = max(int(keep), 1)
+        self.min_interval_s = float(min_interval_s)
+        self._lock = threading.Lock()
+        self._last_dump_mono = float("-inf")
+        self._seq = 0
+        self._m_dumps = (
+            registry.counter(
+                "xllm_flight_dumps_total",
+                "Flight-recorder anomaly triggers by reason",
+                labelnames=("reason",),
+            )
+            if registry is not None
+            else None
+        )
+
+    def trigger(self, reason: str, service_request_id: str = "", **ctx: Any) -> Optional[str]:
+        """Record an anomaly; dump the ring unless rate-limited.
+
+        Returns the dump path when a file was written, else None. Never
+        raises."""
+        try:
+            if self._m_dumps is not None:
+                self._m_dumps.labels(reason=reason).inc()
+            self.ring.emit(
+                service_request_id, "flight_dump", reason=reason, **ctx
+            )
+            now = time.monotonic()
+            with self._lock:
+                if now - self._last_dump_mono < self.min_interval_s:
+                    return None
+                self._last_dump_mono = now
+                self._seq += 1
+                seq = self._seq
+            return self._dump(reason, service_request_id, ctx, seq)
+        except Exception:
+            return None
+
+    def _dump(
+        self, reason: str, srid: str, ctx: Dict[str, Any], seq: int
+    ) -> Optional[str]:
+        os.makedirs(self.directory, exist_ok=True)
+        path = os.path.join(self.directory, "flight-%06d.json" % seq)
+        body = {
+            "reason": reason,
+            "service_request_id": srid,
+            "context": ctx,
+            "timestamp_ms": int(time.time() * 1000),
+            "ring": self.ring.stats(),
+            "spans": self.ring.snapshot(),
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(body, f)
+        os.replace(tmp, path)
+        self._prune()
+        return path
+
+    def _prune(self) -> None:
+        try:
+            dumps = sorted(
+                n
+                for n in os.listdir(self.directory)
+                if n.startswith("flight-") and n.endswith(".json")
+            )
+            for stale in dumps[: -self.keep]:
+                try:
+                    os.remove(os.path.join(self.directory, stale))
+                except OSError:
+                    pass
+        except OSError:
+            pass

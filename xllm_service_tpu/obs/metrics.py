@@ -368,9 +368,20 @@ class MetricsRegistry:
 
 def render_families(fams) -> str:
     """One text exposition from a families dict — exactly one HELP/TYPE
-    pair per family, every sample grouped contiguously under it."""
+    pair per family, every sample grouped contiguously under it.
+
+    HISTOGRAM families with NO samples are omitted entirely: a labelled
+    histogram nobody has observed yet (e.g. the scrape-latency histogram
+    on the very first exposure, whose observations land DURING the
+    scrape the exposition is being built for) would otherwise render a
+    TYPE-only header, which a strict scraper rejects as a histogram
+    without `_bucket` samples. Empty counter/gauge families keep their
+    TYPE-only header — that IS valid exposition, and tests and dashboards
+    discover series names from it."""
     lines: List[str] = []
     for name, (kind, help_text, samples) in fams.items():
+        if not samples and kind == "histogram":
+            continue
         if help_text:
             lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {kind}")
@@ -444,12 +455,16 @@ def absorb_exposition(
     fams,
     text: str,
     extra_labels: Optional[Dict[str, str]] = None,
-) -> None:
+) -> List[str]:
     """Merge one exposition into a render_families()-shaped dict, adding
     `extra_labels` to every sample. Families that already exist keep their
     first-seen kind/help and the new samples append under the SAME single
     TYPE line — the whole point of aggregation (a second TYPE line would
-    fail strict scrapers). Kind conflicts drop the incoming samples."""
+    fail strict scrapers). Kind conflicts (a family whose incoming # TYPE
+    disagrees with the first-seen one) deterministically SKIP the incoming
+    samples — first-seen kind wins regardless of merge order within a
+    family — and the skipped family names are returned so callers can
+    count them instead of losing series silently."""
     # Parsed label values are kept in their ESCAPED wire form; only the
     # extra labels need escaping here — re-escaping parsed values would
     # drift a backslash/quote-bearing value on every aggregation hop.
@@ -465,6 +480,7 @@ def absorb_exposition(
         )
         return "{" + inner + "}"
 
+    conflicts: List[str] = []
     for name, (kind, help_text, samples) in parse_exposition(text).items():
         rendered: List[Tuple[str, str]] = []
         for sample_name, labels, value in samples:
@@ -478,7 +494,9 @@ def absorb_exposition(
         if name in fams:
             prev_kind, prev_help, prev_samples = fams[name]
             if prev_kind != kind:
+                conflicts.append(name)
                 continue
             fams[name] = (prev_kind, prev_help, prev_samples + rendered)
         else:
             fams[name] = (kind, help_text, rendered)
+    return conflicts

@@ -27,12 +27,41 @@ SPAN_STAGES = (
     "route",
     "dispatch",
     "redispatch",
+    "resume",
     "first_token",
     "decode",
     "finish",
     "cancel",
     "error",
 )
+
+# Instance/engine-side stage vocabulary (distributed tracing,
+# docs/OBSERVABILITY.md): spans emitted into per-process ring buffers by
+# the serving/KV/fabric/mm mixins and the engine loop, merged with the
+# master's SPAN_STAGES timeline by assemble_trace().
+INSTANCE_SPAN_STAGES = (
+    "admit",
+    "prefill_chunk",
+    "step_batch",
+    "handoff_send",
+    "handoff_commit",
+    "kv_chunk_sent",
+    "kv_chunk_landed",
+    "decode_admit",
+    "fabric_fetch",
+    "fabric_landed",
+    "encoder_batch",
+    "flight_dump",
+    # Master-side fabric routing decisions (cluster/prefix_fabric.py,
+    # cluster/encoder_fabric.py): dispatch-time plan spans on the same
+    # merged timeline.
+    "fabric_plan",
+    "encoder_route",
+)
+
+# The canonical vocabulary the span-stages lint pass enforces: every
+# stage literal emitted anywhere in the tree must be one of these.
+ALL_SPAN_STAGES = SPAN_STAGES + INSTANCE_SPAN_STAGES
 
 # Terminal stages close a request's timeline.
 TERMINAL_STAGES = frozenset(("finish", "cancel", "error"))
@@ -159,3 +188,189 @@ def to_chrome_trace(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
 def write_chrome_trace(records: Iterable[Dict[str, Any]], path: str) -> None:
     with open(path, "w", encoding="utf-8") as f:
         json.dump(to_chrome_trace(records), f)
+
+
+# --------------------------------------------------------------------- #
+# cross-process clock alignment + trace assembly (distributed tracing)
+# --------------------------------------------------------------------- #
+
+
+class ClockSync:
+    """Monotonic-offset estimator for one instance clock against the
+    master's, fed by samples piggybacked on heartbeats.
+
+    Define o = master_mono - instance_mono (both in ms). Each heartbeat
+    REQUEST carries the instance's send stamp: the master's receive stamp
+    gives  recv - send = o + d  with one-way delay d >= 0, an UPPER bound
+    of o. Each heartbeat RESPONSE carries the master's reply stamp, which
+    the instance echoes on its NEXT beat together with its own receive
+    stamp: reply <= recv_i + o, so  reply - recv_i  is a LOWER bound.
+    The estimate is the midpoint of the intersection [max lower, min
+    upper] over a bounded window; with only upper bounds (first beat) it
+    degrades to min-upper, which overestimates o by the minimum one-way
+    delay — mapped instance events then land slightly late, never before
+    the master RPC that caused them."""
+
+    WINDOW = 64
+
+    def __init__(self) -> None:
+        self._uppers: List[float] = []
+        self._lowers: List[float] = []
+
+    def sample_upper(self, bound_ms: float) -> None:
+        self._uppers.append(float(bound_ms))
+        del self._uppers[: -self.WINDOW]
+
+    def sample_lower(self, bound_ms: float) -> None:
+        self._lowers.append(float(bound_ms))
+        del self._lowers[: -self.WINDOW]
+
+    @property
+    def samples(self) -> int:
+        return len(self._uppers) + len(self._lowers)
+
+    def offset_ms(self) -> float:
+        """Best current estimate of o = master_mono - instance_mono."""
+        upper = min(self._uppers) if self._uppers else None
+        lower = max(self._lowers) if self._lowers else None
+        if upper is not None and lower is not None and lower <= upper:
+            return (upper + lower) / 2.0
+        if upper is not None:
+            return upper
+        if lower is not None:
+            return lower
+        return 0.0
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "offset_ms": round(self.offset_ms(), 3),
+            "samples": self.samples,
+            "upper_ms": round(min(self._uppers), 3) if self._uppers else None,
+            "lower_ms": round(max(self._lowers), 3) if self._lowers else None,
+        }
+
+
+def assemble_trace(
+    master_process: str,
+    master_spans: Iterable[Dict[str, Any]],
+    participants: Iterable[Tuple[str, Iterable[Dict[str, Any]], float]],
+) -> List[Dict[str, Any]]:
+    """ONE merged per-request timeline from every participant's spans.
+
+    `participants` is (process_name, spans, offset_ms) per instance, with
+    offset_ms = master_mono - instance_mono (ClockSync.offset_ms): each
+    instance record's t_mono_ms is shifted into the MASTER clock domain
+    so inter-process durations subtract exactly. Records are returned
+    sorted on the aligned clock with a `process` field stamped on each;
+    ties keep master-before-instance order (the RPC that caused an
+    instance span sorts ahead of it)."""
+    merged: List[Dict[str, Any]] = []
+    for rec in master_spans:
+        r = dict(rec)
+        r.setdefault("process", master_process)
+        merged.append(r)
+    for name, spans, off in participants:
+        for rec in spans:
+            r = dict(rec)
+            r["process"] = name
+            r["t_mono_ms"] = float(r.get("t_mono_ms", 0.0)) + float(off)
+            merged.append(r)
+    merged.sort(
+        key=lambda r: (
+            float(r.get("t_mono_ms", 0.0)),
+            0 if r.get("process") == master_process else 1,
+        )
+    )
+    return merged
+
+
+_TRACE_META_KEYS = _META_KEYS + ("process",)
+
+
+def trace_to_chrome(merged: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Chrome trace_event JSON for one ASSEMBLED multi-process trace
+    (assemble_trace output): one pid track per process so Perfetto stacks
+    master/prefill/decode/encoder timelines in parallel, each span a
+    complete ("X") slice lasting until that process's next span (the
+    process's last span is an instant)."""
+    procs: "OrderedDict[str, List[Dict[str, Any]]]" = OrderedDict()
+    for rec in merged:
+        procs.setdefault(str(rec.get("process", "")), []).append(rec)
+    events: List[Dict[str, Any]] = []
+    for pid, (proc, recs) in enumerate(procs.items(), start=1):
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": proc},
+        })
+        for i, rec in enumerate(recs):
+            ts_us = float(rec.get("t_mono_ms", 0.0)) * 1000.0
+            args = {
+                k: v for k, v in rec.items() if k not in _TRACE_META_KEYS
+            }
+            ev: Dict[str, Any] = {
+                "name": str(rec.get("stage", "")),
+                "cat": "trace",
+                "pid": pid,
+                "tid": 1,
+                "ts": ts_us,
+                "args": args,
+            }
+            if i + 1 < len(recs):
+                nxt = float(recs[i + 1].get("t_mono_ms", 0.0)) * 1000.0
+                ev["ph"] = "X"
+                ev["dur"] = max(nxt - ts_us, 0.0)
+            else:
+                ev["ph"] = "i"
+                ev["s"] = "t"
+            events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# p99 blame attribution: stage -> (start anchor, end anchor). Each anchor
+# names the FIRST record with that stage in the aligned timeline; missing
+# anchors void the stage (blamed 0) rather than guessing.
+_BLAME_EDGES = (
+    ("queue", "receive", "dispatch"),
+    ("prefill", "admit", "handoff_send"),
+    ("handoff", "handoff_send", "decode_admit"),
+    ("decode", "decode_admit", "finish"),
+)
+
+
+def blame_stages(merged: List[Dict[str, Any]]) -> Dict[str, float]:
+    """Per-stage latency blame for one assembled trace: queue vs prefill
+    vs handoff vs decode vs host_gap (ms). host_gap is everything the
+    named edges don't cover — RPC transit, serving-thread scheduling,
+    push batching — so the five always sum to the end-to-end span.
+    Colocated (non-PD) traces have no handoff/decode_admit anchors:
+    prefill falls back to dispatch->first_token, decode to
+    first_token->finish, and handoff blames 0. (A PD trace must NOT use
+    the first_token anchor for decode: the prefill side pushes the first
+    token BEFORE the handoff, so that edge would double-count the whole
+    handoff window and the blame table could never point at it.)"""
+    first: Dict[str, float] = {}
+    for rec in merged:
+        stage = str(rec.get("stage", ""))
+        if stage and stage not in first:
+            first[stage] = float(rec.get("t_mono_ms", 0.0))
+    t_start = min(first.values()) if first else 0.0
+    terminal = [first[s] for s in TERMINAL_STAGES if s in first]
+    t_end = max(terminal) if terminal else (
+        max(first.values()) if first else 0.0
+    )
+    blame: Dict[str, float] = {}
+    covered = 0.0
+    for name, a, b in _BLAME_EDGES:
+        if a in first and b in first and first[b] >= first[a]:
+            dur = first[b] - first[a]
+        elif name == "prefill" and "dispatch" in first and "first_token" in first:
+            dur = max(first["first_token"] - first["dispatch"], 0.0)
+        elif name == "decode" and "first_token" in first and "finish" in first:
+            dur = max(first["finish"] - first["first_token"], 0.0)
+        else:
+            dur = 0.0
+        blame[name] = round(dur, 3)
+        covered += dur
+    blame["host_gap"] = round(max((t_end - t_start) - covered, 0.0), 3)
+    blame["total"] = round(max(t_end - t_start, 0.0), 3)
+    return blame

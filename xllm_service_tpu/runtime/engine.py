@@ -367,6 +367,10 @@ class InferenceEngine:
         # with no SSD tier below it). Must never block — the instance
         # layer enqueues the offer and returns.
         self.on_cold_evict = None
+        # Distributed-tracing hook: span_hook(request_id, stage, **fields)
+        # set by the instance layer ONLY when tracing is enabled — None
+        # keeps the token path free of any per-step tracing work.
+        self.span_hook = None
         self._running: Dict[int, _Seq] = {}  # slot -> seq
         self._free_slots = list(range(self.R - 1, -1, -1))
         self._lock = threading.Lock()
@@ -2800,6 +2804,14 @@ class InferenceEngine:
             produced += 1
             self._emit(seq, finished=self._check_stop(seq))
         produced += self._drain_pf_rows(flt, tokens, logprobs)
+        if self.span_hook is not None and produced:
+            # One span per drained STEP BATCH (never per token): the
+            # engine's decode cadence on the merged timeline.
+            self.span_hook(
+                "", "step_batch",
+                nactive=flt.nactive, produced=produced,
+                step_ms=round(step_ms, 3),
+            )
         self._t_host_free = time.monotonic()
         return produced
 
@@ -2828,6 +2840,15 @@ class InferenceEngine:
                 self.late_stop_discards += 1
                 continue
             seq.prefilled = c_end
+            if self.span_hook is not None:
+                # Per prefill CHUNK (bounded by chunk count, not tokens);
+                # keyed by the engine request id — the instance layer's
+                # srid-keyed admit span brackets the whole prefill.
+                self.span_hook(
+                    seq.req.request_id, "prefill_chunk",
+                    prefilled=c_end, total=len(seq.tokens),
+                    final=c_end >= len(seq.tokens),
+                )
             if c_end < len(seq.tokens):
                 self._stream_chunk_kv(seq)
                 produced += 1

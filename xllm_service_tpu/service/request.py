@@ -99,10 +99,14 @@ class RequestTracer:
         first_token -> decode ticks -> finish/cancel/redispatch), each
         stamped with one process monotonic clock so per-stage durations
         subtract exactly;
-      * size-based rotation (trace.jsonl -> trace.jsonl.1) so a long-lived
-        service never grows the trace without bound;
+      * size-based rotation with a configurable keep-count
+        (trace.jsonl -> trace.jsonl.1 .. .N, oldest dropped) so a long
+        bench run keeps a bounded WINDOW of generations rather than only
+        the newest half;
       * a drop counter instead of unbounded error growth: a failed disk
-        write increments `dropped` and the record is lost, never buffered.
+        write increments `dropped` and the record is lost, never buffered;
+      * an optional SpanRing mirror: every `stage` record is also appended
+        to the process's in-memory flight-recorder ring (obs.flight).
     """
 
     def __init__(
@@ -110,12 +114,16 @@ class RequestTracer:
         trace_dir: str = "trace",
         enabled: bool = False,
         max_bytes: int = 64 * 1024 * 1024,
+        keep: int = 1,
+        ring: Optional[Any] = None,
     ):
         self._enabled = enabled
         self._mu = threading.Lock()
         self._fh = None
         self._path = os.path.join(trace_dir, "trace.jsonl")
         self._max_bytes = max(int(max_bytes), 1)
+        self._keep = max(int(keep), 1)
+        self._ring = ring
         self._size = 0
         self.dropped = 0  # records lost to write failures / closed tracer
         if enabled:
@@ -135,10 +143,16 @@ class RequestTracer:
         return self._path
 
     def _rotate_locked(self) -> None:
-        """One-deep rotation under self._mu: the previous generation is
-        overwritten — bounded disk, newest window always intact."""
+        """Keep-count rotation under self._mu: trace.jsonl.N-1 -> .N for
+        N = keep..2 (the oldest generation falls off the end), then the
+        live file becomes .1 — bounded disk, newest `keep` windows
+        intact."""
         try:
             self._fh.close()
+            for n in range(self._keep, 1, -1):
+                src = "%s.%d" % (self._path, n - 1)
+                if os.path.exists(src):
+                    os.replace(src, "%s.%d" % (self._path, n))
             os.replace(self._path, self._path + ".1")
             self._fh = open(self._path, "a", encoding="utf-8")
             self._size = 0
@@ -184,8 +198,10 @@ class RequestTracer:
         )
 
     def stage(self, service_request_id: str, stage: str, **fields: Any) -> None:
-        """One request-lifecycle span record (obs.spans schema)."""
-        if not self._enabled:
+        """One request-lifecycle span record (obs.spans schema). Mirrored
+        into the flight-recorder ring (always-on) when one is bound; the
+        JSONL write stays gated on --enable_request_trace."""
+        if not self._enabled and self._ring is None:
             return
         entry = {
             "type": "stage",
@@ -195,7 +211,10 @@ class RequestTracer:
             "stage": stage,
         }
         entry.update(fields)
-        self._write_entry(entry)
+        if self._ring is not None:
+            self._ring.append(entry)
+        if self._enabled:
+            self._write_entry(entry)
 
     def bind(self, service_request_id: str) -> Callable[[str, Any], None]:
         return lambda direction, payload: self.record(

@@ -21,10 +21,11 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 import re
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional
 
@@ -57,7 +58,12 @@ from xllm_service_tpu.coordination.election import (
     MasterElection,
 )
 from xllm_service_tpu.coordination.store import CoordinationStore, connect
-from xllm_service_tpu.obs import LATENCY_BUCKETS_MS, MetricsRegistry
+from xllm_service_tpu.obs import (
+    LATENCY_BUCKETS_MS,
+    FlightRecorder,
+    MetricsRegistry,
+    SpanRing,
+)
 from xllm_service_tpu.service.ordered_streams import OrderedStreams
 from xllm_service_tpu.service.request import (
     RequestTracer,
@@ -156,7 +162,25 @@ class Scheduler:
         self._store = store if store is not None else connect(config.etcd_addr)
         self._tokenizer = tokenizer or create_tokenizer(config.tokenizer_path)
         self._chat_template = ChatTemplate(self._tokenizer)
-        self._tracer = RequestTracer(config.trace_dir, config.enable_request_trace)
+        # Always-on flight-recorder ring (obs/flight.py): every lifecycle
+        # span the tracer emits is mirrored here regardless of
+        # --enable_request_trace, so the master always has a recent-span
+        # window to dump on anomalies and to serve GET /trace from.
+        self.span_ring = SpanRing(
+            "master",
+            int(
+                os.environ.get("XLLM_TRACE_RING", "")
+                or getattr(config, "trace_ring_capacity", 2048)
+            ),
+        )
+        self._tracer = RequestTracer(
+            config.trace_dir, config.enable_request_trace,
+            keep=getattr(config, "trace_keep", 1), ring=self.span_ring,
+        )
+        # Which instances participated in each request's trace (prefill /
+        # decode / encode names recorded at every dispatch attempt),
+        # bounded so finished requests stay collectable for a while.
+        self._trace_parts: "OrderedDict[str, List[str]]" = OrderedDict()
         # Installed by the Master: transport for role-flip notifications
         # ((instance_name, new_role) -> POST instance /flip).
         self.on_role_flip = None
@@ -185,6 +209,14 @@ class Scheduler:
         # /metrics renders this alongside the HTTP-plane registries and
         # the scraped per-instance expositions.
         self.metrics = MetricsRegistry()
+        # Anomaly flight recorder: dumps the span ring to
+        # <trace_dir>/flight on SLO breach / breaker ejection triggers
+        # (instances run their own; docs/OBSERVABILITY.md).
+        self.flight = FlightRecorder(
+            self.span_ring,
+            os.path.join(config.trace_dir, "flight"),
+            registry=self.metrics,
+        )
         self._m_requests = self.metrics.counter(
             "xllm_service_requests_total",
             "Requests accepted by schedule()", labelnames=("kind",),
@@ -299,7 +331,7 @@ class Scheduler:
         # coordinated-eviction decisions behind /rpc/fabric/evict_offer.
         self.prefix_fabric = PrefixFabric(
             config, self._instance_mgr, self._kvcache_mgr,
-            metrics=self.metrics,
+            metrics=self.metrics, span_hook=self.span_ring.emit,
         )
         # Encoder fabric (cluster/encoder_fabric.py, docs/EPD.md): the
         # fleet media-embedding index behind hit-aware encoder routing.
@@ -307,6 +339,7 @@ class Scheduler:
         # the same breaker hardening as the KV index.
         self.encoder_fabric = EncoderFabric(
             config, self._instance_mgr, metrics=self.metrics,
+            span_hook=self.span_ring.emit,
         )
         # Goodput controller plane (cluster/goodput.py): per-request
         # colocate-vs-disaggregate placement consulted in schedule(),
@@ -657,6 +690,24 @@ class Scheduler:
     def tracer(self) -> RequestTracer:
         return self._tracer
 
+    def record_trace_participants(self, srid: str, names) -> None:
+        """Remember which instances took part in one request's trace
+        (every dispatch attempt's prefill/decode/encode trio) so the
+        GET /trace collector knows whose rings to pull — bounded LRU, so
+        recently finished requests stay collectable."""
+        with self._mu:
+            cur = self._trace_parts.setdefault(srid, [])
+            for n in names:
+                if n and n not in cur:
+                    cur.append(n)
+            self._trace_parts.move_to_end(srid)
+            while len(self._trace_parts) > 512:
+                self._trace_parts.popitem(last=False)
+
+    def trace_participants(self, srid: str) -> List[str]:
+        with self._mu:
+            return list(self._trace_parts.get(srid, ()))
+
     @property
     def num_inflight(self) -> int:
         with self._mu:
@@ -745,12 +796,11 @@ class Scheduler:
     def schedule(self, request: ServiceRequest) -> Status:
         """Template -> tokenize -> route (reference: scheduler.cpp:73-106).
         Fills request.token_ids, request.routing, request.estimated_ttft_ms."""
-        if self._tracer.enabled:
-            self._tracer.stage(
-                request.service_request_id, "receive",
-                kind="chat" if request.is_chat else "completion",
-                stream=request.stream, offline=request.offline,
-            )
+        self._tracer.stage(
+            request.service_request_id, "receive",
+            kind="chat" if request.is_chat else "completion",
+            stream=request.stream, offline=request.offline,
+        )
         if request.is_chat and not request.prompt:
             try:
                 request.prompt = self._chat_template.apply(
@@ -767,11 +817,10 @@ class Scheduler:
             request.token_ids = self._tokenizer.encode(request.prompt)
         if not request.token_ids:
             return Status(StatusCode.INVALID_ARGUMENT, "prompt tokenized to nothing")
-        if self._tracer.enabled:
-            self._tracer.stage(
-                request.service_request_id, "tokenize",
-                prompt_tokens=len(request.token_ids),
-            )
+        self._tracer.stage(
+            request.service_request_id, "tokenize",
+            prompt_tokens=len(request.token_ids),
+        )
 
         # ONE index match per request, shared by the routing policy and
         # the fabric's fetch planner/gauge below — the chained hashing +
@@ -833,7 +882,9 @@ class Scheduler:
             try:
                 media_hashes = EncoderFabric.hashes_of(request.media_parts)
                 matched = (
-                    self.encoder_fabric.match(media_hashes)
+                    self.encoder_fabric.match(
+                        media_hashes, srid=request.service_request_id
+                    )
                     if media_hashes else {}
                 )
                 if self.encoder_fabric.enabled():
@@ -863,7 +914,7 @@ class Scheduler:
                 request.kv_fabric = (
                     self.prefix_fabric.plan_fetch(
                         request.token_ids, request.routing.prefill_name,
-                        scores=scores,
+                        scores=scores, srid=request.service_request_id,
                     )
                     or {}
                 )
@@ -876,12 +927,11 @@ class Scheduler:
         self._instance_mgr.update_request_metrics(
             request.routing, RequestAction.SCHEDULE, len(request.token_ids)
         )
-        if self._tracer.enabled:
-            self._tracer.stage(
-                request.service_request_id, "route",
-                prefill=request.routing.prefill_name,
-                decode=request.routing.decode_name,
-            )
+        self._tracer.stage(
+            request.service_request_id, "route",
+            prefill=request.routing.prefill_name,
+            decode=request.routing.decode_name,
+        )
         self._m_requests.labels(
             kind="chat" if request.is_chat else "completion"
         ).inc()
@@ -1259,12 +1309,21 @@ class Scheduler:
                         self.takeover_first_dispatch_ms = (
                             (now - self._takeover_elected_mono) * 1000.0
                         )
-                if self._tracer.enabled:
-                    self._tracer.stage(
-                        request.service_request_id, "dispatch",
-                        prefill=request.routing.prefill_name,
-                        attempt=state.redispatch_count + 1,
-                    )
+                self._tracer.stage(
+                    request.service_request_id, "dispatch",
+                    prefill=request.routing.prefill_name,
+                    attempt=state.redispatch_count + 1,
+                )
+                # Trace-collector participant set: every attempt's routed
+                # trio, so GET /trace knows which rings to pull.
+                self.record_trace_participants(
+                    request.service_request_id,
+                    (
+                        request.routing.prefill_name,
+                        request.routing.decode_name,
+                        request.routing.encode_name,
+                    ),
+                )
                 dispatch()
 
             state.dispatch = dispatch_instrumented
@@ -1328,11 +1387,24 @@ class Scheduler:
             now = time.monotonic()
             if state.first_token_mono == 0.0:
                 state.first_token_mono = now
-                self._m_ttft.observe((now - state.sched_mono) * 1000.0)
-                if self._tracer.enabled:
-                    self._tracer.stage(
-                        request.service_request_id, "first_token",
-                        ttft_ms=round((now - state.sched_mono) * 1000.0, 3),
+                ttft_ms = (now - state.sched_mono) * 1000.0
+                self._m_ttft.observe(ttft_ms)
+                self._tracer.stage(
+                    request.service_request_id, "first_token",
+                    ttft_ms=round(ttft_ms, 3),
+                )
+                # Anomaly trigger: TTFT past the configured SLO dumps the
+                # flight ring (hatch XLLM_TRACE_SLO_TTFT_MS; 0 = off).
+                # Once per request, never per token.
+                slo = float(
+                    os.environ.get("XLLM_TRACE_SLO_TTFT_MS", "")
+                    or getattr(self._config, "trace_slo_ttft_ms", 0.0)
+                    or 0.0
+                )
+                if slo and ttft_ms > slo:
+                    self.flight.trigger(
+                        "slo_ttft", request.service_request_id,
+                        ttft_ms=round(ttft_ms, 3), slo_ms=slo,
                     )
             else:
                 # Per-TOKEN time: a delivery may carry several tokens
@@ -1535,15 +1607,12 @@ class Scheduler:
             self.goodput.observe_completion(
                 request.model, request.num_generated_tokens
             )
-        if self._tracer.enabled:
-            terminal = {"ok": "finish", "error": "error"}.get(
-                outcome, "cancel"
-            )
-            self._tracer.stage(
-                service_request_id, terminal,
-                outcome=outcome,
-                generated_tokens=request.num_generated_tokens,
-            )
+        terminal = {"ok": "finish", "error": "error"}.get(outcome, "cancel")
+        self._tracer.stage(
+            service_request_id, terminal,
+            outcome=outcome,
+            generated_tokens=request.num_generated_tokens,
+        )
 
     def fail_request(self, service_request_id: str, code: StatusCode, msg: str) -> None:
         """Error-finish from the API tier (e.g. prefill POST failed —
@@ -1552,10 +1621,9 @@ class Scheduler:
             state = self._requests.get(service_request_id)
         if state is None:
             return
-        if self._tracer.enabled:
-            self._tracer.stage(
-                service_request_id, "error", code=int(code), message=msg
-            )
+        self._tracer.stage(
+            service_request_id, "error", code=int(code), message=msg
+        )
         state.failed = True  # finish_request reports outcome="error"
         self._streams.submit(
             state.lane,
@@ -1577,6 +1645,9 @@ class Scheduler:
         its committed-block snapshot into a stored delta, rebuilding the
         index once the instance is reachable again."""
         if state == HealthState.EJECTED:
+            # Anomaly trigger: a breaker ejection is exactly the moment
+            # the recent-span window explains what went wrong.
+            self.flight.trigger("breaker_ejection", instance=name)
             self._kvcache_mgr.remove_instance(name)
             # Encoder fabric parity: an ejected encoder's embedding-index
             # locations are phantom hits for hit-aware routing too; the
@@ -1745,11 +1816,10 @@ class Scheduler:
         # the removal watch and the prune loop race here.
         with self._mu:
             self.total_redispatches += 1
-        if self._tracer.enabled:
-            self._tracer.stage(
-                service_request_id, "redispatch",
-                excluded=exclude, prefill=routing.prefill_name,
-            )
+        self._tracer.stage(
+            service_request_id, "redispatch",
+            excluded=exclude, prefill=routing.prefill_name,
+        )
         return True
 
     def resume_request(
@@ -1852,12 +1922,11 @@ class Scheduler:
             return False
         with self._mu:
             self.total_resumes += 1
-        if self._tracer.enabled:
-            self._tracer.stage(
-                service_request_id, "resume",
-                excluded=exclude, prefill=routing.prefill_name,
-                replayed_tokens=len(emitted),
-            )
+        self._tracer.stage(
+            service_request_id, "resume",
+            excluded=exclude, prefill=routing.prefill_name,
+            replayed_tokens=len(emitted),
+        )
         return True
 
     # ------------------------------------------------------------------ #
