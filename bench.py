@@ -111,6 +111,25 @@ _RAGGED_MIN_RATIO = float(os.environ.get("XLLM_BENCH_RAGGED_MIN_RATIO", 0.95))
 # than it hides (the real win is on TPU; CPU arms the floor).
 _SPEC_MIN_RATIO = float(os.environ.get("XLLM_BENCH_SPEC_MIN_RATIO", 0.95))
 
+# Latency-hiding collectives A/B guard (--overlap both, ISSUE 18): with
+# the ring collective-matmul schedule ON (XLLM_OVERLAP_COLLECTIVES=1,
+# docs/SHARDING.md "Hiding the mesh"), the sharded engine must hold at
+# least this fraction of the plain-psum row's throughput — decomposing
+# the combines buys overlap headroom and must never pay more than it
+# hides. Distinct env from XLLM_BENCH_OVERLAP_MIN_RATIO, which floors
+# the engine PIPELINE overlap (sync-vs-overlap stepping), not the
+# collective schedule.
+_OVERLAP_COLL_MIN_RATIO = float(
+    os.environ.get("XLLM_BENCH_OVERLAP_COLL_MIN_RATIO", 0.97)
+)
+
+# Warm-start host-gap ceiling (ms) for the default engine row: after the
+# compile-cache prewarm the first post-idle dispatch must NOT pay a
+# fresh XLA compile (PR 11 measured that ambush at 2.7-4 s; steady-state
+# host gap on the record is <1 ms) — a mean above this ceiling on a
+# clean-load host means programs are compiling inside the serving loop.
+_HOST_GAP_MAX_MS = float(os.environ.get("XLLM_BENCH_HOST_GAP_MAX_MS", 25.0))
+
 
 def _cpu_regression_guard(line: str) -> "tuple[str, int]":
     """Apply the >5% clean-load CPU decode regression guard — and the
@@ -320,6 +339,114 @@ def _moe_guard(line: str) -> "tuple[str, int]":
     return json.dumps(res), 3
 
 
+def _overlap_guard(line: str) -> "tuple[str, int]":
+    """Exit-3 guards for the --overlap A/B rows and the warm-start host
+    gap (ISSUE 18). `engine_overlap_collectives_guard` floors the ring
+    collective-matmul row against plain psum and abstains LOUDLY when
+    the labeled rows did not actually route the schedule (the
+    engine_moe_guard dispatch-mismatch pattern); `engine_host_gap_guard`
+    ceilings the default engine row's mean host gap so an
+    in-serving-loop recompile can never ride into the record as a tok/s
+    blip."""
+    if os.environ.get("XLLM_BENCH_NO_REGRESSION_GUARD"):
+        return line, 0
+    try:
+        res = json.loads(line)
+    except ValueError:
+        return line, 0
+    rc = 0
+    load = max(
+        float(res.get("loadavg_1m_start") or 0.0),
+        float(res.get("loadavg_1m") or 0.0),
+    )
+    ob = res.get("overlap_bench") or {}
+    if isinstance(ob, dict) and "on" in ob and "off" in ob:
+        routed = (
+            ob["on"].get("overlap_collectives"),
+            ob["off"].get("overlap_collectives"),
+        )
+        try:
+            on = float(ob["on"]["tok_s"])
+            off = float(ob["off"]["tok_s"])
+        except (KeyError, TypeError, ValueError):
+            on = off = 0.0
+        if routed != (True, False):
+            # The documented abstention: on a single-device mesh
+            # (tp=1, ep=1) the ring schedule is ineligible by design —
+            # both rows ran the original einsum and a floor over them
+            # would stamp "ok" on nothing. Also covers an env override
+            # pinning the hatch under both labels.
+            cause = (
+                "the ring schedule never engaged (single-device mesh — "
+                "run --mesh 1,N,1; parity/eligibility is tier-1's "
+                "tests/test_overlap_collectives.py)"
+                if routed == (False, False)
+                else "an env override pinned the hatch "
+                "(XLLM_OVERLAP_COLLECTIVES?)"
+            )
+            res["engine_overlap_collectives_guard"] = (
+                f"abstained: overlap_collectives {routed[0]}/{routed[1]}"
+                f" — {cause}"
+            )
+        elif res.get("backend") != "tpu":
+            # The mesh-guard precedent: a CPU virtual mesh proves
+            # routing (the rows above carry overlap_collectives
+            # True/False) but not performance — every ppermute hop is a
+            # same-host memcpy with no ICI to hide it behind, so the
+            # ring reads as pure overhead and the floor would flake.
+            res["engine_overlap_collectives_guard"] = (
+                "abstained: virtual CPU mesh — ppermute hops have no "
+                "ICI to hide behind off-TPU; the floor arms on TPU "
+                "(bit-parity is tier-1's tests/test_overlap_collectives"
+                ".py)"
+            )
+        elif load > _GUARD_LOADAVG_CEILING:
+            res["engine_overlap_collectives_guard"] = (
+                f"abstained: loadavg {load:.1f}"
+            )
+        elif on <= 0 or off <= 0:
+            res["engine_overlap_collectives_guard"] = (
+                f"abstained: unparseable tok_s (on={on}, off={off})"
+            )
+        elif on >= _OVERLAP_COLL_MIN_RATIO * off:
+            res["engine_overlap_collectives_guard"] = "ok"
+        else:
+            res["engine_overlap_collectives_guard"] = (
+                f"FAIL: collective-matmul engine {on:.1f} tok/s is "
+                f"below {100 * _OVERLAP_COLL_MIN_RATIO:.0f}% of the "
+                f"psum row {off:.1f}"
+            )
+            rc = 3
+    # Warm-start host-gap ceiling on the default (overlapped) engine
+    # row: the timed repeats run after the warm passes, so a mean above
+    # the ceiling means a program compiled INSIDE the serving loop —
+    # exactly the post-idle ambush the compile-cache prewarm exists to
+    # kill. Timing-based absolute ceiling, so it inherits the CPU
+    # guard's host-class and load abstentions.
+    eb = res.get("engine_bench") or {}
+    row = eb.get("overlap") if isinstance(eb, dict) else None
+    if isinstance(row, dict) and row.get("host_gap_ms_mean") is not None:
+        gap = float(row["host_gap_ms_mean"])
+        ncpu = os.cpu_count() or 1
+        if ncpu < _GUARD_MIN_CPUS:
+            res["engine_host_gap_guard"] = (
+                f"abstained: {ncpu}-CPU host below the ceiling's class"
+            )
+        elif load > _GUARD_LOADAVG_CEILING:
+            res["engine_host_gap_guard"] = f"abstained: loadavg {load:.1f}"
+        elif gap <= _HOST_GAP_MAX_MS:
+            res["engine_host_gap_guard"] = "ok"
+        else:
+            res["engine_host_gap_guard"] = (
+                f"FAIL: warm-start host gap {gap:.3f} ms exceeds the "
+                f"{_HOST_GAP_MAX_MS:.0f} ms ceiling — a program is "
+                f"compiling inside the serving loop (compile-cache "
+                f"prewarm missed a variant? see compile_cache_bench)"
+            )
+            rc = rc or 3
+    return json.dumps(res), rc
+
+
 # Sharded-decode roofline guard (--mesh, ROADMAP item 3): on TPU a
 # tp-sharded decode must land at least this fraction of its analytic
 # per-shard roofline expectation — a GSPMD-replicated kernel or a silent
@@ -453,6 +580,21 @@ def main() -> None:
             )
         # bare `--moe` (or followed by another flag) = "both"
 
+    # --overlap {on,off,both}: the latency-hiding collectives A/B
+    # (ISSUE 18) — the ring collective-matmul schedule
+    # (XLLM_OVERLAP_COLLECTIVES=1, docs/SHARDING.md) vs the plain
+    # psum/einsum combines, on the tp-sharded engine. Default "both"
+    # reports the pair and arms engine_overlap_collectives_guard.
+    overlap_mode = "both"
+    if "--overlap" in sys.argv:
+        idx = sys.argv.index("--overlap") + 1
+        nxt = sys.argv[idx] if idx < len(sys.argv) else ""
+        if nxt in ("on", "off", "both"):
+            overlap_mode = nxt
+        elif nxt and not nxt.startswith("-"):
+            raise SystemExit(f"--overlap takes on|off|both, got {nxt!r}")
+        # bare `--overlap` (or followed by another flag) = "both"
+
     backend = _probe_backend()
     on_tpu = backend == "tpu"
     # Fastest config first; fall back if a path that never ran on real
@@ -475,7 +617,8 @@ def main() -> None:
         rc, out, err = _run_attempt_subprocess(
             dict(attempt, engine_mode=engine_mode,
                  attention_mode=attention_mode, spec_mode=spec_mode,
-                 moe_mode=moe_mode, mesh=list(mesh), _on_tpu=on_tpu)
+                 moe_mode=moe_mode, overlap_mode=overlap_mode,
+                 mesh=list(mesh), _on_tpu=on_tpu)
         )
         line = ""
         for ln in out.splitlines():
@@ -485,7 +628,8 @@ def main() -> None:
             line, guard_rc = _cpu_regression_guard(line)
             line, mesh_rc = _mesh_guard(line)
             line, moe_rc = _moe_guard(line)
-            guard_rc = guard_rc or mesh_rc or moe_rc
+            line, ovl_rc = _overlap_guard(line)
+            guard_rc = guard_rc or mesh_rc or moe_rc or ovl_rc
             print(line)
             if guard_rc:
                 print(
@@ -506,7 +650,9 @@ def main() -> None:
 
 def _engine_bench(sync: bool, mixed: bool = True, spec: int = 0,
                   model: str = "llama3-tiny",
-                  moe: "str | None" = None) -> dict:
+                  moe: "str | None" = None,
+                  overlap: "str | None" = None,
+                  tp: int = 1) -> dict:
     """Full-InferenceEngine decode throughput (llama3-tiny, R=8) in one
     stepping mode: R seeded requests driven to completion through the real
     admission/decode/emit path. Reports tokens/s plus the pipeline
@@ -520,7 +666,12 @@ def _engine_bench(sync: bool, mixed: bool = True, spec: int = 0,
     `moe` pins the MoE dispatch for the --moe A/B (ISSUE 15):
     "grouped" sets XLLM_MOE_KERNEL=1 around the run, "dense" =0 — the
     row reports the dispatch the executor actually RESOLVED (the guard
-    abstains when the grouped row ran the oracle, e.g. on CPU)."""
+    abstains when the grouped row ran the oracle, e.g. on CPU).
+    `overlap` pins the collective-matmul schedule the same way for the
+    --overlap A/B (ISSUE 18): "on" sets XLLM_OVERLAP_COLLECTIVES=1,
+    "off" =0 — the row reports `overlap_collectives`, whether the ring
+    schedule was actually ELIGIBLE (tp>1/ep>1), which the guard keys
+    on. `tp` runs the engine tp-sharded (needs that many devices)."""
     import numpy as np
 
     from xllm_service_tpu.common.config import EngineConfig
@@ -544,6 +695,26 @@ def _engine_bench(sync: bool, mixed: bool = True, spec: int = 0,
             else:
                 os.environ["XLLM_MOE_KERNEL"] = prev_moe_env
 
+    if overlap is not None:
+        # Same pin-around-the-WHOLE-run pattern as `moe`: the hatch is
+        # read at trace time and later bucket shapes retrace mid-run,
+        # so a leaky override would split one row across schedules.
+        prev_ovl_env = os.environ.get("XLLM_OVERLAP_COLLECTIVES")
+        os.environ["XLLM_OVERLAP_COLLECTIVES"] = (
+            "1" if overlap == "on" else "0"
+        )
+        try:
+            row = _engine_bench(
+                sync, mixed=mixed, spec=spec, model=model, tp=tp
+            )
+            row["overlap_mode"] = overlap
+            return row
+        finally:
+            if prev_ovl_env is None:
+                os.environ.pop("XLLM_OVERLAP_COLLECTIVES", None)
+            else:
+                os.environ["XLLM_OVERLAP_COLLECTIVES"] = prev_ovl_env
+
     R, prompt_len, new_tokens = 8, 32, 48
     cfg = EngineConfig(
         model=model,
@@ -551,8 +722,9 @@ def _engine_bench(sync: bool, mixed: bool = True, spec: int = 0,
         block_size=16,
         num_blocks=64,
         max_running_requests=R,
-        max_seq_len=256,
-        prefill_buckets=[32, 64, 128, 256],
+        max_seq_len=128 if tp > 1 else 256,
+        prefill_buckets=[32, 64, 128] if tp > 1 else [32, 64, 128, 256],
+        tp_size=tp,
         sync_engine=sync,
         enable_mixed_step=mixed,
         speculative_tokens=spec,
@@ -602,6 +774,7 @@ def _engine_bench(sync: bool, mixed: bool = True, spec: int = 0,
     disc0, mix0 = eng.late_stop_discards, eng.mixed_steps
     emit0, sstep0 = eng.spec_tokens_emitted, eng.spec_slot_steps
     pipe0, spec0 = eng.spec_pipeline_steps, eng.spec_steps
+    coll0 = eng.collective_overlap_steps
     dts, toks = [], 0
     for r in range(repeats):
         n, dt = run_once(f"t{r}")
@@ -653,6 +826,14 @@ def _engine_bench(sync: bool, mixed: bool = True, spec: int = 0,
         "late_stop_discards": eng.late_stop_discards - disc0,
         "requests": R,
         "new_tokens": new_tokens,
+        # Whether the ring collective-matmul schedule was ELIGIBLE for
+        # this geometry (hatch on AND tp>1/ep>1) plus the steps that
+        # dispatched through it — engine_overlap_collectives_guard keys
+        # on the flag, never the raw env var (ISSUE 18).
+        "overlap_collectives": bool(
+            getattr(eng.executor, "overlap_collectives_active", False)
+        ),
+        "collective_overlap_steps": eng.collective_overlap_steps - coll0,
     }
     if getattr(eng.executor.cfg, "is_moe", False):
         # Resolved MoE dispatch + the expert-load signal (ISSUE 15):
@@ -683,6 +864,57 @@ def _engine_bench(sync: bool, mixed: bool = True, spec: int = 0,
     return row
 
 
+def _compile_cache_bench() -> dict:
+    """Cold-vs-warm persistent compile cache A/B (ISSUE 18 tentpole b):
+    two fresh executors prewarmed against ONE keyed on-disk cache dir —
+    the cold pass pays every XLA compile, the warm pass (new jit
+    wrappers, so jaxpr lowering still runs) reloads the executables
+    from disk, which is exactly what a restarted instance with the same
+    geometry sees. Minimal geometry (one prefill bucket, mixed step
+    off) keeps the section to seconds; the absolute delta scales with
+    the real bucket-program family."""
+    import shutil
+    import tempfile
+
+    from xllm_service_tpu.common.config import EngineConfig
+    from xllm_service_tpu.runtime import compile_cache as cc
+    from xllm_service_tpu.runtime.executor import ModelExecutor
+
+    if not cc.compile_cache_enabled():
+        return {"skipped": "XLLM_COMPILE_CACHE=0"}
+    base = tempfile.mkdtemp(prefix="xllm-bench-compile-cache-")
+    prev_min = os.environ.get("XLLM_COMPILE_CACHE_MIN_COMPILE_S")
+    # Everything in this tiny geometry compiles fast — persist it all,
+    # or the warm pass would measure nothing but re-compiles.
+    os.environ["XLLM_COMPILE_CACHE_MIN_COMPILE_S"] = "0"
+    try:
+        cfg = EngineConfig(
+            model="llama3-tiny", dtype="float32", block_size=16,
+            num_blocks=32, max_running_requests=4, max_seq_len=64,
+            prefill_buckets=[32], enable_mixed_step=False,
+            compilation_cache_dir=base,
+        )
+        cold = ModelExecutor(cfg)
+        cold.prewarm_programs()
+        warm = ModelExecutor(cfg)
+        warm.prewarm_programs()
+        return {
+            "programs": cold.prewarm_report["programs"],
+            "compile_ms_cold": round(cold.prewarm_ms, 1),
+            "compile_ms_warm": round(warm.prewarm_ms, 1),
+            "cache_entries": cc.cache_entries(
+                base, cold.compile_cache_key
+            ),
+            "cache_key": cold.compile_cache_key,
+        }
+    finally:
+        if prev_min is None:
+            os.environ.pop("XLLM_COMPILE_CACHE_MIN_COMPILE_S", None)
+        else:
+            os.environ["XLLM_COMPILE_CACHE_MIN_COMPILE_S"] = prev_min
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
          use_kernel: bool | None = None,
          weight_dtype: str = "auto",
@@ -690,6 +922,7 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
          attention_mode: str = "both",
          spec_mode: str = "both",
          moe_mode: str = "both",
+         overlap_mode: str = "both",
          mesh=(1, 1, 1)) -> None:
     import jax
 
@@ -1030,6 +1263,31 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
                     sync=False, model="moe-shard-tiny", moe=m,
                 )
 
+        # Latency-hiding collectives A/B (--overlap, ISSUE 18): the
+        # ring collective-matmul schedule vs the plain psum/einsum
+        # combines, full-engine harness. On a pure-tp mesh (--mesh
+        # 1,N,1 — CPU virtual devices work) the schedule actually
+        # engages on the tp-sharded tiny model; on a single-device run
+        # the rows still print (original einsum both sides) and
+        # engine_overlap_collectives_guard abstains loudly — the
+        # documented single-device abstention.
+        overlap_bench = None
+        if (
+            not on_tpu
+            and dp == 1 and ep == 1
+            and not os.environ.get("XLLM_BENCH_SKIP_ENGINE_AB")
+        ):
+            overlap_bench = {}
+            omodes = (
+                ("on", "off") if overlap_mode == "both"
+                else (overlap_mode,)
+            )
+            omodel = "llama3-shard-tiny" if tp > 1 else "llama3-tiny"
+            for m in omodes:
+                overlap_bench[m] = _engine_bench(
+                    sync=False, model=omodel, overlap=m, tp=tp,
+                )
+
         xla_cost = None
         if os.environ.get("XLLM_BENCH_XLA_COST"):
             try:
@@ -1040,6 +1298,18 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
                 )
             except Exception:
                 xla_cost = None
+
+        # Cold-vs-warm compile cache row (ISSUE 18): LAST section — it
+        # re-points jax's persistent cache at a throwaway keyed dir
+        # (deleted on exit), so nothing may compile after it in this
+        # process.
+        compile_cache_bench = None
+        if (
+            not on_tpu
+            and n_dev == 1
+            and not os.environ.get("XLLM_BENCH_SKIP_ENGINE_AB")
+        ):
+            compile_cache_bench = _compile_cache_bench()
         print(json.dumps({
             "metric": f"decode_throughput_{model}_bs{R}",
             "value": round(tok_per_s, 1),
@@ -1107,6 +1377,19 @@ def _run(on_tpu: bool, kv_cache_dtype: str = "auto",
             # Pallas dispatch actually ran (ISSUE 15, docs/MOE.md).
             "moe_bench": moe_bench,
             "moe_mode": moe_mode,
+            # Latency-hiding collectives A/B (--overlap): ring
+            # collective-matmul combines vs plain psum on the
+            # tp-sharded engine — engine_overlap_collectives_guard
+            # (exit 3) floors the pair when the schedule actually
+            # engaged and abstains loudly on a single-device mesh
+            # (ISSUE 18, docs/SHARDING.md "Hiding the mesh").
+            "overlap_bench": overlap_bench,
+            "overlap_mode": overlap_mode,
+            # Cold-vs-warm persistent compile cache prewarm (ISSUE 18):
+            # compile_ms_cold pays every XLA compile, compile_ms_warm
+            # reloads the keyed on-disk cache — the restarted-instance
+            # path. engine_host_gap_guard rides the engine rows above.
+            "compile_cache_bench": compile_cache_bench,
             # The MoE dispatch THIS bench's main model resolved (None
             # for dense models).
             "moe_kernel": kernel_rep.get("moe"),

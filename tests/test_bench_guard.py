@@ -474,3 +474,172 @@ def test_pd_adapt_guard_kill_switch(monkeypatch):
     out, rc = bench_serving._pd_adapt_guard(_adapt_line(a=0.0, acted=0))
     assert rc == 0
     assert "pd_adapt_guard" not in json.loads(out)
+
+
+# ---- latency-hiding collectives A/B guard + warm-start host-gap
+# ceiling (--overlap both, ISSUE 18 / docs/SHARDING.md) ----
+
+
+def _ob(off_tok, on_tok, on_routed=True, off_routed=False):
+    return {
+        "on": {"tok_s": on_tok, "overlap_collectives": on_routed},
+        "off": {"tok_s": off_tok, "overlap_collectives": off_routed},
+    }
+
+
+def _ovl_line(**kw):
+    d = {"backend": "cpu", "value": 100.0,
+         "loadavg_1m": 0.2, "loadavg_1m_start": 0.2}
+    d.update(kw)
+    return json.dumps(d)
+
+
+def test_overlap_coll_at_parity_passes(monkeypatch):
+    monkeypatch.setattr(bench, "_OVERLAP_COLL_MIN_RATIO", 0.97)
+    out, rc = bench._overlap_guard(
+        _ovl_line(backend="tpu", overlap_bench=_ob(100.0, 98.0))
+    )
+    assert rc == 0
+    assert json.loads(out)["engine_overlap_collectives_guard"] == "ok"
+
+
+def test_overlap_coll_regression_fails(monkeypatch):
+    monkeypatch.setattr(bench, "_OVERLAP_COLL_MIN_RATIO", 0.97)
+    out, rc = bench._overlap_guard(
+        _ovl_line(backend="tpu", overlap_bench=_ob(100.0, 80.0))
+    )
+    assert rc == 3
+    assert json.loads(out)[
+        "engine_overlap_collectives_guard"
+    ].startswith("FAIL")
+
+
+def test_overlap_coll_abstains_on_cpu_virtual_mesh():
+    # The mesh-guard precedent: a CPU virtual mesh routes the ring (the
+    # rows carry True/False) but every ppermute hop is a same-host
+    # memcpy — the floor would grade pure overhead and flake. Off-TPU
+    # the guard abstains and points at the tier-1 parity suite.
+    out, rc = bench._overlap_guard(
+        _ovl_line(overlap_bench=_ob(100.0, 80.0))
+    )
+    assert rc == 0
+    g = json.loads(out)["engine_overlap_collectives_guard"]
+    assert g.startswith("abstained")
+    assert "TPU" in g and "test_overlap_collectives" in g
+
+
+def test_overlap_coll_guard_needs_both_modes():
+    out, rc = bench._overlap_guard(
+        _ovl_line(overlap_bench={"on": {"tok_s": 50.0}})
+    )
+    assert rc == 0
+    assert "engine_overlap_collectives_guard" not in json.loads(out)
+
+
+def test_overlap_coll_abstains_on_single_device_mesh():
+    # The DOCUMENTED abstention: tp=1/ep=1 means the ring schedule was
+    # ineligible on both rows — an einsum-vs-einsum floor would stamp
+    # "ok" on nothing. The message points at the differential suite.
+    out, rc = bench._overlap_guard(
+        _ovl_line(overlap_bench=_ob(100.0, 80.0, on_routed=False))
+    )
+    assert rc == 0
+    g = json.loads(out)["engine_overlap_collectives_guard"]
+    assert g.startswith("abstained")
+    assert "test_overlap_collectives" in g
+
+
+def test_overlap_coll_abstains_on_env_pinned_hatch():
+    # XLLM_OVERLAP_COLLECTIVES pinned in the env flips BOTH rows onto
+    # the ring schedule — on-vs-on stamping "ok" would be vacuous.
+    out, rc = bench._overlap_guard(
+        _ovl_line(overlap_bench=_ob(100.0, 98.0, off_routed=True))
+    )
+    assert rc == 0
+    g = json.loads(out)["engine_overlap_collectives_guard"]
+    assert g.startswith("abstained")
+    assert "XLLM_OVERLAP_COLLECTIVES" in g
+
+
+def test_overlap_coll_abstains_on_hot_host():
+    out, rc = bench._overlap_guard(
+        _ovl_line(backend="tpu", overlap_bench=_ob(100.0, 80.0),
+                  loadavg_1m=3.0)
+    )
+    assert rc == 0
+    assert "loadavg" in json.loads(out)["engine_overlap_collectives_guard"]
+
+
+def test_overlap_coll_abstains_loudly_on_bad_tok_s():
+    ob = _ob(100.0, 98.0)
+    ob["on"]["tok_s"] = None
+    out, rc = bench._overlap_guard(_ovl_line(backend="tpu", overlap_bench=ob))
+    assert rc == 0
+    assert json.loads(out)[
+        "engine_overlap_collectives_guard"
+    ].startswith("abstained")
+
+
+def test_overlap_guard_kill_switch(monkeypatch):
+    monkeypatch.setenv("XLLM_BENCH_NO_REGRESSION_GUARD", "1")
+    out, rc = bench._overlap_guard(_ovl_line(overlap_bench=_ob(100.0, 10.0)))
+    assert rc == 0
+    assert "engine_overlap_collectives_guard" not in json.loads(out)
+
+
+def test_overlap_guard_non_json_passes_through():
+    assert bench._overlap_guard("not json") == ("not json", 0)
+
+
+def test_host_gap_under_ceiling_passes(monkeypatch):
+    monkeypatch.setattr(bench, "_HOST_GAP_MAX_MS", 25.0)
+    out, rc = bench._overlap_guard(_ovl_line(
+        engine_bench={"overlap": {"tok_s": 300.0, "host_gap_ms_mean": 0.6}}
+    ))
+    assert rc == 0
+    assert json.loads(out)["engine_host_gap_guard"] == "ok"
+
+
+def test_host_gap_recompile_ambush_fails(monkeypatch):
+    # The PR 11 ambush class: a fresh XLA compile inside the serving
+    # loop shows up as a multi-second mean host gap on the warm rows.
+    monkeypatch.setattr(bench, "_HOST_GAP_MAX_MS", 25.0)
+    out, rc = bench._overlap_guard(_ovl_line(
+        engine_bench={"overlap": {"tok_s": 300.0,
+                                  "host_gap_ms_mean": 2700.0}}
+    ))
+    assert rc == 3
+    g = json.loads(out)["engine_host_gap_guard"]
+    assert g.startswith("FAIL") and "compiling inside" in g
+
+
+def test_host_gap_abstains_on_hot_host(monkeypatch):
+    monkeypatch.setattr(bench, "_HOST_GAP_MAX_MS", 25.0)
+    out, rc = bench._overlap_guard(_ovl_line(
+        engine_bench={"overlap": {"tok_s": 300.0,
+                                  "host_gap_ms_mean": 2700.0}},
+        loadavg_1m=3.0,
+    ))
+    assert rc == 0
+    assert "loadavg" in json.loads(out)["engine_host_gap_guard"]
+
+
+def test_host_gap_abstains_on_small_host(monkeypatch):
+    monkeypatch.setattr(bench, "_GUARD_MIN_CPUS", 10_000)
+    monkeypatch.setattr(bench, "_HOST_GAP_MAX_MS", 25.0)
+    out, rc = bench._overlap_guard(_ovl_line(
+        engine_bench={"overlap": {"tok_s": 300.0,
+                                  "host_gap_ms_mean": 2700.0}}
+    ))
+    assert rc == 0
+    assert "host below" in json.loads(out)["engine_host_gap_guard"]
+
+
+def test_host_gap_guard_skips_sync_only_runs(monkeypatch):
+    monkeypatch.setattr(bench, "_HOST_GAP_MAX_MS", 25.0)
+    out, rc = bench._overlap_guard(_ovl_line(
+        engine_bench={"sync": {"tok_s": 300.0,
+                               "host_gap_ms_mean": 2700.0}}
+    ))
+    assert rc == 0
+    assert "engine_host_gap_guard" not in json.loads(out)

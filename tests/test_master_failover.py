@@ -124,7 +124,11 @@ class TestEpochTransaction:
             store.close()
 
     def test_election_epoch_monotonic_across_terms(self):
-        store = MemoryStore()
+        # Frozen lease clock: every expiry is DELIBERATE
+        # (expire_lease_now), never a wall-clock miss under suite-wide
+        # GIL stalls (XLA compiles in sibling tests) — the repo's
+        # established anti-flake pattern for lease-driven tests.
+        store = MemoryStore(clock=lambda: 0.0)
         e1 = MasterElection(store, "svc1", lease_ttl_s=0.2)
         elected2 = threading.Event()
         e2 = MasterElection(
@@ -149,7 +153,12 @@ class TestEpochTransaction:
         """Satellite: a demote -> re-elect cycle must not leak a live
         keepalive thread per term (the old loop is joined before the new
         term starts one)."""
-        store = MemoryStore()
+        # Frozen lease clock (see test_election_epoch_monotonic_across
+        # _terms): under load a 0.2 s wall-clock lease can miss its
+        # refresh window and expire SPONTANEOUSLY, inserting an extra
+        # demote/re-elect cycle that overshoots the strict per-cycle
+        # epoch this test pins.
+        store = MemoryStore(clock=lambda: 0.0)
         # Scope the leak check to THIS election: earlier test files'
         # masters may still be winding their keepalive threads down.
         pre = {

@@ -383,6 +383,18 @@ def test_ejection_prunes_index_locations():
     try:
         _register(store, "gone")
         _register(store, "stays")
+        # Store watch callbacks land on the notifier thread; the breaker
+        # only counts failures for instances it has INGESTED (a miss
+        # returns HEALTHY without counting) — wait like the cluster
+        # fixture does or this thread reliably outruns registration.
+        deadline = time.monotonic() + 5.0
+        while (
+            sched.instance_mgr.get_instance("gone") is None
+            or sched.instance_mgr.get_instance("stays") is None
+        ):
+            if time.monotonic() > deadline:
+                raise RuntimeError("registrations not ingested")
+            time.sleep(0.005)
         toks = prompt_tokens(4 * BS, seed=37)
         hashes = _seed_blocks(sched.kvcache_mgr, "gone", toks, 4)
         _seed_blocks(sched.kvcache_mgr, "stays", toks, 2)

@@ -25,6 +25,17 @@ model-keys ⊆ the keys `param_shardings` assigns a spec for in
 parallel/sharding.py. Keys that are runtime-installed with explicit
 shardings (the multi-LoRA `lora_<proj>_{a,b}` stacks from
 set_lora_adapters) are exempt by prefix.
+
+Second rule (ISSUE 18): every `lax.ppermute` axis name must be one the
+meshes actually carry. A ppermute over a misspelled axis isn't a
+compile error at the call site — it surfaces as an unbound-axis failure
+only when the shard_map finally runs on a mesh, which on the overlap
+paths (ops/collective_matmul.py) happens only with the hatch ON and
+tp>1, i.e. never in a hatch-off CI lane. The pass resolves the axis
+argument statically (string literal, a parameter default, or a simple
+local/closure `name = "lit"` assignment) and flags any resolved name
+outside the mesh vocabulary; an unresolvable dynamic axis is skipped,
+not guessed.
 """
 
 from __future__ import annotations
@@ -42,6 +53,12 @@ RULES_FILE = "xllm_service_tpu/parallel/sharding.py"
 
 # Installed at runtime with an explicit sharding, never by init_params.
 EXEMPT_PREFIXES = ("lora_",)
+
+# The mesh axis vocabulary: parallel/mesh.py build_mesh creates
+# dp/sp/ep/tp; parallel/pipeline.py's GPipe tier runs over a
+# caller-built `pp` axis. A ppermute naming anything else can never
+# bind on a serving mesh.
+MESH_AXES = frozenset({"dp", "tp", "ep", "sp", "pp"})
 
 
 def _str_keys_of_dict(node: ast.AST) -> List[str]:
@@ -119,6 +136,101 @@ def _collect_keys_transitive(tree: ast.Module, root: ast.AST) -> Set[str]:
     return keys
 
 
+_FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _own_nodes(root: ast.AST):
+    """Walk `root` without descending into nested function bodies, so a
+    call binds to its INNERMOST scope's environment, not an outer one."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, _FN_NODES):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _scope_env(fn: ast.AST, inherited: dict) -> dict:
+    """{name: string value} visible inside `fn`: closure bindings, then
+    parameter defaults (`axis: str = "tp"`), then simple local
+    `name = "lit"` assignments. Non-string rebinds shadow to None."""
+    env = dict(inherited)
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = fn.args
+        pos = a.posonlyargs + a.args
+        for arg, dflt in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+            if isinstance(dflt, ast.Constant) and isinstance(dflt.value, str):
+                env[arg.arg] = dflt.value
+        for arg, dflt in zip(a.kwonlyargs, a.kw_defaults):
+            if (
+                dflt is not None
+                and isinstance(dflt, ast.Constant)
+                and isinstance(dflt.value, str)
+            ):
+                env[arg.arg] = dflt.value
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name):
+                if isinstance(node.value, ast.Constant) and isinstance(
+                    node.value.value, str
+                ):
+                    env[tgt.id] = node.value.value
+                else:
+                    env[tgt.id] = None  # dynamic rebind: unresolvable
+    return env
+
+
+def _ppermute_axis_arg(call: ast.Call):
+    """The axis argument node of a `*.ppermute(x, axis_name, perm)`
+    call, or None when the call shape doesn't match."""
+    if not (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr == "ppermute"
+    ):
+        return None
+    if len(call.args) >= 2:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    return None
+
+
+def _ppermute_findings(src, pass_id: str) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def visit(scope: ast.AST, inherited: dict) -> None:
+        env = _scope_env(scope, inherited)
+        for node in _own_nodes(scope):
+            if isinstance(node, ast.Call):
+                axis_node = _ppermute_axis_arg(node)
+                if axis_node is None:
+                    continue
+                axis = None
+                if isinstance(axis_node, ast.Constant) and isinstance(
+                    axis_node.value, str
+                ):
+                    axis = axis_node.value
+                elif isinstance(axis_node, ast.Name):
+                    axis = env.get(axis_node.id)
+                if axis is not None and axis not in MESH_AXES:
+                    findings.append(Finding(
+                        pass_id, src.rel, node.lineno,
+                        f"ppermute over axis {axis!r}, which no mesh "
+                        f"carries (axes: "
+                        f"{', '.join(sorted(MESH_AXES))}) — the ring "
+                        f"would fail to bind the moment the shard_map "
+                        f"runs on a real mesh (parallel/mesh.py)",
+                    ))
+            if isinstance(node, _FN_NODES):
+                visit(node, env)
+
+    if src.tree is not None:
+        visit(src.tree, {})
+    return findings
+
+
 class ShardingRulesPass(LintPass):
     id = "sharding-rules"
     title = "model param leaves vs parallel/sharding.py partition rules"
@@ -132,8 +244,11 @@ class ShardingRulesPass(LintPass):
                 rules_src = src
             elif src.rel in MODEL_FILES:
                 model_srcs.append(src)
+            # Axis-vocabulary rule runs on every package source — the
+            # rings live in ops/, parallel/, and the model families.
+            findings.extend(_ppermute_findings(src, self.id))
         if rules_src is None or rules_src.tree is None:
-            return [Finding(
+            return findings + [Finding(
                 self.id, RULES_FILE, 1,
                 "parallel/sharding.py not found/parsable — the partition "
                 "rules have nowhere to live",
@@ -142,7 +257,7 @@ class ShardingRulesPass(LintPass):
         for fn in _functions(rules_src.tree, "param_shardings"):
             rule_keys |= _collect_assigned_keys(fn)
         if not rule_keys:
-            return [Finding(
+            return findings + [Finding(
                 self.id, RULES_FILE, 1,
                 "param_shardings assigns no rule keys — the pass cannot "
                 "cross-check the model tree",

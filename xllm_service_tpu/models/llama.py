@@ -33,6 +33,7 @@ from xllm_service_tpu.ops.attention import (
     paged_attention,
     prefill_attention,
 )
+from xllm_service_tpu.ops import collective_matmul as cm_ops
 from xllm_service_tpu.ops.norms import rms_norm
 from xllm_service_tpu.ops import lora as lora_ops
 from xllm_service_tpu.ops import moe as moe_ops
@@ -154,6 +155,17 @@ def _project(params: Params, cfg: ModelConfig, h: jnp.ndarray) -> jnp.ndarray:
                       params["lm_head"].astype(jnp.float32))
 
 
+def _row_parallel(eq: str, x: jnp.ndarray, w2d: jnp.ndarray) -> jnp.ndarray:
+    """Row-parallel contraction over a tp-sharded axis (o-proj and the
+    FFN down-proj): the ring collective-matmul pipeline when
+    XLLM_OVERLAP_COLLECTIVES + a tp>1 shard context apply (the
+    reduction rides under the next tile's compute instead of after it
+    — ops/collective_matmul.py), else the caller's exact einsum, whose
+    GSPMD lowering (local matmul + psum) is the serving default."""
+    o = cm_ops.maybe_overlap_matmul(x, w2d)
+    return o if o is not None else jnp.einsum(eq, x, w2d)
+
+
 def _act(cfg: ModelConfig):
     """Gated-MLP activation: SwiGLU (default) or Gemma's GELU-tanh —
     delegated to the one shared selector (ops/moe.py) so the dense,
@@ -183,7 +195,7 @@ def _mlp(
         d = lora_ops.maybe_apply(lp, "w_up", x, lora_idx, 1.0)
         up = up + d if d is not None else up
         h = _act(cfg)(gate) * up
-        out = jnp.einsum("tf,fe->te", h, wt(lp["w_down"]))
+        out = _row_parallel("tf,fe->te", h, wt(lp["w_down"]))
         d = lora_ops.maybe_apply(lp, "w_down", h, lora_idx, 1.0)
         return out + d if d is not None else out
     # MoE: router scores -> top-k weights; every expert's FFN runs on its
@@ -431,8 +443,8 @@ def decode_step(
             use_kernel=use_kernel, window=cfg.sliding_window,
         )
         attn_flat = attn.reshape(attn.shape[0], -1)
-        o = jnp.einsum("rh,he->re", attn_flat,
-                       wt(lp["wo"]).reshape(-1, cfg.hidden_size))
+        o = _row_parallel("rh,he->re", attn_flat,
+                          wt(lp["wo"]).reshape(-1, cfg.hidden_size))
         d = lora_ops.maybe_apply(lp, "wo", attn_flat, lora_idx, 1.0)
         x = x + (o + d if d is not None else o)
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -546,8 +558,8 @@ def mixed_step(
         )
         # Output projection + MLP, per half, split-step shapes.
         attn_dec_flat = attn_dec.reshape(attn_dec.shape[0], -1)
-        o = jnp.einsum("rh,he->re", attn_dec_flat,
-                       wt(lp["wo"]).reshape(-1, cfg.hidden_size))
+        o = _row_parallel("rh,he->re", attn_dec_flat,
+                          wt(lp["wo"]).reshape(-1, cfg.hidden_size))
         d = lora_ops.maybe_apply(lp, "wo", attn_dec_flat, lora_dec, 1.0)
         x_dec = x_dec + (o + d if d is not None else o)
         h_dec = rms_norm(x_dec, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -556,8 +568,8 @@ def mixed_step(
         )
 
         attn_pf_flat = attn_pf.reshape(P, Lpad, -1)
-        o = jnp.einsum("plh,he->ple", attn_pf_flat,
-                       wt(lp["wo"]).reshape(-1, cfg.hidden_size))
+        o = _row_parallel("plh,he->ple", attn_pf_flat,
+                          wt(lp["wo"]).reshape(-1, cfg.hidden_size))
         if lora_pf is not None and lp.get("lora_wo_a") is not None:
             o = o + jax.vmap(
                 lambda af, ai: lora_ops.apply(
@@ -695,8 +707,8 @@ def mixed_verify_step(
 
         def half_tail(x, attn, L_, n_rows, lora, li, valid):
             attn_flat = attn.reshape(n_rows, L_, -1)
-            o = jnp.einsum("plh,he->ple", attn_flat,
-                           wt(lp["wo"]).reshape(-1, cfg.hidden_size))
+            o = _row_parallel("plh,he->ple", attn_flat,
+                              wt(lp["wo"]).reshape(-1, cfg.hidden_size))
             if lora is not None and lp.get("lora_wo_a") is not None:
                 o = o + jax.vmap(
                     lambda af, ai: lora_ops.apply(
@@ -799,8 +811,8 @@ def prefill_batch_step(
             window=cfg.sliding_window,
         )  # [P, Lpad, Hq, D] — flash kernel on TPU, blockwise elsewhere
         attn_flat = attn.reshape(P, Lpad, -1)
-        o = jnp.einsum("plh,he->ple", attn_flat,
-                       wt(lp["wo"]).reshape(-1, cfg.hidden_size))
+        o = _row_parallel("plh,he->ple", attn_flat,
+                          wt(lp["wo"]).reshape(-1, cfg.hidden_size))
         if lora_idx is not None and lp.get("lora_wo_a") is not None:
             o = o + jax.vmap(
                 lambda af, ai: lora_ops.apply(

@@ -76,6 +76,14 @@ def shard_context():
     return ctx
 
 
+def declared_shard_context():
+    """The raw (mesh, axis) the executor declared for this thread,
+    ignoring the XLLM_SHARDED_KERNELS gate — that hatch escapes KERNEL
+    dispatch to GSPMD; consumers with their own hatch (the overlap
+    collectives tier, ops/collective_matmul.py) still need the mesh."""
+    return getattr(_SHARD_TLS, "ctx", None)
+
+
 def _shard_map_fn():
     if hasattr(jax, "shard_map"):
         return jax.shard_map

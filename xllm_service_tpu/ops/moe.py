@@ -367,7 +367,11 @@ def grouped_moe(
     n_shards = ctx[0].shape[ctx[1]] if ctx is not None else 1
     if ctx is not None and n_shards > 1 and X % n_shards == 0:
         from jax.sharding import PartitionSpec as P
+        from xllm_service_tpu.ops import collective_matmul as cm_ops
 
+        # Trace-time hatch read (the jitted steps bake it in, like
+        # every other kernel hatch here).
+        overlap = cm_ops.overlap_collectives_enabled()
         mesh, axis = ctx
         Xl = X // n_shards
 
@@ -381,7 +385,13 @@ def grouped_moe(
             )
             # The combine "shuffle": each slot's value lives on exactly
             # one shard (the rest contribute exact zeros), so the psum
-            # reproduces the single-device per-slot bits.
+            # reproduces the single-device per-slot bits. Under
+            # XLLM_OVERLAP_COLLECTIVES the psum decomposes into the
+            # ring reduce-scatter/all-gather schedule so the combine
+            # pipelines under the dispatch compute — still bit-exact
+            # (adding exact zeros commutes in every order).
+            if overlap:
+                return cm_ops.ring_all_reduce(y, axis, n_shards)
             return jax.lax.psum(y, axis)
 
         shard_map = (

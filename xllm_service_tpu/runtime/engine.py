@@ -51,6 +51,7 @@ from xllm_service_tpu.obs import (
 )
 from xllm_service_tpu.ops.sampling import SamplingParams
 from xllm_service_tpu.runtime.block_manager import BlockManager, OutOfBlocksError
+from xllm_service_tpu.runtime import compile_cache as compile_cache_mod
 from xllm_service_tpu.runtime.executor import ModelExecutor, SamplingBatch
 
 
@@ -473,6 +474,16 @@ class InferenceEngine:
         self.decode_dispatches = 0
         self.mixed_steps = 0  # mixed dispatches actually carrying pf rows
         self.overlap_steps = 0
+        # Collective-overlap accounting (ISSUE 18): dispatches whose
+        # traced programs carry the ring collective-matmul schedule.
+        # Resolved ONCE here — the hatch bakes into the jitted steps at
+        # first trace, so a mid-run env flip doesn't change the programs
+        # and must not change the count.
+        self._overlap_collectives = (
+            1 if getattr(self.executor, "overlap_collectives_active", False)
+            else 0
+        )
+        self.collective_overlap_steps = 0
         self.late_stop_discards = 0
         self.loop_errors = 0
         self.kv_chunk_land_errors = 0
@@ -580,6 +591,35 @@ class InferenceEngine:
             "Decode steps dispatched while the prior step was still in "
             "flight",
         ).set_function(lambda: self.overlap_steps)
+        # Collective-overlap + compile-cache instruments (ISSUE 18,
+        # docs/OBSERVABILITY.md). Hit/miss semantics: a dispatch that
+        # reused an already-lowered program is a hit; every fresh
+        # lowering past the prewarm watermark is a miss (with no
+        # prewarm, ALL lowerings are misses).
+        self.metrics.counter(
+            "xllm_engine_collective_overlap_steps_total",
+            "Engine dispatches whose traced step programs carry the "
+            "ring collective-matmul schedule (XLLM_OVERLAP_COLLECTIVES "
+            "on a tp>1/ep>1 mesh)",
+        ).set_function(lambda: self.collective_overlap_steps)
+        self.metrics.counter(
+            "xllm_engine_compile_cache_misses_total",
+            "Fresh program lowerings past the prewarm watermark (the "
+            "first-post-idle-recompile class prewarm_programs exists "
+            "to kill)",
+        ).set_function(lambda: self.compile_cache_misses())
+        self.metrics.counter(
+            "xllm_engine_compile_cache_hits_total",
+            "Engine dispatches served from already-compiled programs "
+            "(no fresh lowering)",
+        ).set_function(lambda: self.compile_cache_hits())
+        self.metrics.counter(
+            "xllm_engine_compile_cache_prewarm_ms_total",
+            "Wall-clock ms spent compiling the bucket-program family "
+            "at instance start (prewarm_programs)",
+        ).set_function(
+            lambda: getattr(self.executor, "prewarm_ms", 0.0)
+        )
         self.metrics.counter(
             "xllm_engine_late_stop_discards_total",
             "In-flight sampled tokens discarded because their sequence "
@@ -810,9 +850,33 @@ class InferenceEngine:
             or self._inflight is not None
         )
 
+    def compile_cache_misses(self) -> int:
+        """Fresh lowerings past the executor's prewarm watermark (every
+        lowering when nothing was prewarmed)."""
+        ex = self.executor
+        count = getattr(ex, "lowering_count", None)
+        if count is None:
+            return 0
+        return max(0, count() - getattr(ex, "prewarmed_lowerings", 0))
+
+    def compile_cache_hits(self) -> int:
+        """Dispatches that reused an already-compiled program."""
+        return max(0, self.decode_dispatches - self.compile_cache_misses())
+
     def start(self) -> None:
         if self.cfg.warmup_on_start and hasattr(self.executor, "warmup"):
-            self.executor.warmup()
+            # With a keyed persistent cache dir configured, walk the
+            # FULL bucket-program family (runtime/compile_cache.py) so
+            # no first-post-idle dispatch ever lowers fresh — the disk
+            # cache amortizes the enumeration across restarts. Without
+            # a dir the full walk would pay its whole compile bill
+            # every start, so keep the classic split-step warmup.
+            if compile_cache_mod.resolve_cache_dir(self.cfg) and hasattr(
+                self.executor, "prewarm_programs"
+            ):
+                self.executor.prewarm_programs()
+            else:
+                self.executor.warmup()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -1249,6 +1313,7 @@ class InferenceEngine:
         self._m_batch.observe(nactive)
         self._m_steps.inc()
         self.decode_dispatches += 1
+        self.collective_overlap_steps += self._overlap_collectives
         self.mixed_steps += 1
         self._m_mixed_pf_rows.observe(len(items))
         self._m_mixed_dec_rows.observe(nactive)
@@ -2658,6 +2723,7 @@ class InferenceEngine:
         self._m_batch.observe(nactive)
         self._m_steps.inc()
         self.decode_dispatches += 1
+        self.collective_overlap_steps += self._overlap_collectives
         self._ps_steps[active] += 1
         self._ps_positions[active] += 1
 
@@ -2752,6 +2818,7 @@ class InferenceEngine:
         self._m_batch.observe(nactive)
         self._m_steps.inc()
         self.decode_dispatches += 1
+        self.collective_overlap_steps += self._overlap_collectives
         if prev is not None:
             self.overlap_steps += 1
         return _InFlight(tokens, logprobs, snapshot, t0, nactive, total_ctx)
@@ -3450,6 +3517,7 @@ class InferenceEngine:
         self._m_batch.observe(nactive)
         self._m_steps.inc()
         self.decode_dispatches += 1
+        self.collective_overlap_steps += self._overlap_collectives
         self.spec_steps += 1
         self.spec_slot_steps += nactive
         self.spec_pipeline_steps += 1
@@ -3585,6 +3653,7 @@ class InferenceEngine:
         self._m_batch.observe(nactive)
         self._m_steps.inc()
         self.decode_dispatches += 1
+        self.collective_overlap_steps += self._overlap_collectives
         self.spec_steps += 1
         self.spec_sync_steps += 1
         self.spec_slot_steps += nactive

@@ -31,6 +31,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from xllm_service_tpu.common.config import EngineConfig
+from xllm_service_tpu.runtime import compile_cache as compile_cache_mod
 from xllm_service_tpu import models
 from xllm_service_tpu.models.configs import (
     ModelConfig,
@@ -143,7 +144,27 @@ def _setup_compilation_cache(cache_dir: str) -> None:
         return
     _COMPILATION_CACHE_DIR = cache_dir
     jax.config.update("jax_compilation_cache_dir", cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # jax initializes the persistent cache ONCE, at the first compile —
+    # any compile before this point (weight init of an earlier cacheless
+    # engine, a warmed-up sibling model) permanently pins it to the
+    # no-dir state and every later write silently vanishes. Reset so the
+    # next compile re-initializes against the dir just configured.
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _jax_cc,
+        )
+
+        _jax_cc.reset_cache()
+    except Exception:
+        pass  # never let cache plumbing take an engine down
+    # XLLM_COMPILE_CACHE_MIN_COMPILE_S: persistence floor (s) below which
+    # a compile isn't written to disk. 0.5 keeps TPU caches lean; the
+    # CPU bench/tests pin 0 so their sub-second programs persist and the
+    # cold-vs-warm compile_ms delta is measurable.
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.environ.get("XLLM_COMPILE_CACHE_MIN_COMPILE_S", "0.5")),
+    )
 
 
 class ModelExecutor:
@@ -222,8 +243,24 @@ class ModelExecutor:
                 )
             self.cfg = resolved
 
-        if engine_cfg.compilation_cache_dir:
-            _setup_compilation_cache(engine_cfg.compilation_cache_dir)
+        # Persistent compile cache, KEYED by (config hash, jax version,
+        # mesh shape): a restarted instance with the same geometry
+        # reloads every executable from disk; a changed geometry gets a
+        # fresh keyspace (runtime/compile_cache.py, ISSUE 18).
+        self.compile_cache_key = ""
+        _cache_base = compile_cache_mod.resolve_cache_dir(engine_cfg)
+        if _cache_base:
+            self.compile_cache_key = compile_cache_mod.cache_key(
+                engine_cfg, self.cfg, self.mesh
+            )
+            _setup_compilation_cache(
+                compile_cache_mod.keyed_dir(_cache_base, self.compile_cache_key)
+            )
+        # Prewarm bookkeeping (prewarm_programs): lowerings present when
+        # the prewarm finished (0 = never prewarmed — every lowering is
+        # a compile-cache miss for the engine's instruments).
+        self.prewarm_ms = 0.0
+        self.prewarmed_lowerings = 0
         self.dtype = jnp.bfloat16 if engine_cfg.dtype == "bfloat16" else jnp.float32
         # int8 KV cache: halves decode's HBM traffic (the bound resource);
         # params/activations stay in model dtype.
@@ -1110,9 +1147,72 @@ class ModelExecutor:
         to first contact (at most log2(PREFILL_GROUP_MAX) extra compiles
         per bucket over the process lifetime, hit only under concurrent
         admission bursts). Returns the (Lpad, CB) pairs warmed."""
+        warmed: List[Tuple[int, int]] = []
+        for b, CB, n, sp in self._prefill_shape_family():
+            table = np.zeros((self.max_blocks_per_seq,), np.int32)
+            self.prefill_batch(
+                [
+                    PrefillItem(
+                        token_ids=np.zeros((n,), np.int32),
+                        start_pos=sp,
+                        block_table=table,
+                    )
+                ]
+            )
+            warmed.append((b, CB))
+
+        R = self.R
+        active = np.zeros((R,), bool)
+        active[0] = True
+        batch = SamplingBatch(
+            temperature=np.zeros(R, np.float32),
+            top_k=np.zeros(R, np.int32),
+            top_p=np.ones(R, np.float32),
+            seeds=np.zeros(R, np.uint32),
+            steps=np.zeros(R, np.int32),
+        )
+        # Every pow2 context-width bucket decode can hit (decode() slices
+        # the table to the batch's true block bound, one compile per
+        # bucket) — positions drive the bucket; writes land in block 0.
+        for CB in self._decode_cb_walk():
+            positions = np.zeros((R,), np.int32)
+            positions[0] = CB * self.block_size - 1
+            self.decode(
+                np.zeros((R,), np.int32),
+                positions,
+                np.zeros((R, self.max_blocks_per_seq), np.int32),
+                active,
+                batch,
+            )
+
+        # Speculative verify shapes ([R, S] over the same pow2 CB buckets)
+        # when the engine runs speculative decoding.
+        spec = self.engine_cfg.speculative_tokens
+        if spec > 0:
+            S = spec + 1
+            for CB in self._decode_cb_walk():
+                positions = np.zeros((R,), np.int32)
+                positions[0] = max(CB * self.block_size - S, 0)
+                true_len = np.zeros((R,), np.int32)
+                true_len[0] = S
+                self.verify(
+                    np.zeros((R, S), np.int32),
+                    positions,
+                    true_len,
+                    np.zeros((R, self.max_blocks_per_seq), np.int32),
+                    active,
+                    batch,
+                )
+        return warmed
+
+    # ------------------------------------- bucket-program family prewarm
+
+    def _prefill_shape_family(self):
+        """(bucket, CB, n, sp) for every reachable prefill (Lpad, CB)
+        pair at P=1 — THE shape walk warmup() compiles and the mixed /
+        mixed-verify prewarms reuse for their prefill halves."""
         bs = self.block_size
         max_len = self.engine_cfg.max_seq_len
-        warmed: List[Tuple[int, int]] = []
         for bi, b in enumerate(self.prefill_buckets):
             n_full = min(b, max_len - 1)
             # Shortest suffix still padding to THIS bucket (for large-CB
@@ -1140,22 +1240,115 @@ class ModelExecutor:
                         n = n_min
                         sp = (CB - (n + bs - 1) // bs) * bs
                 if sp + n < max_len:
-                    table = np.zeros((self.max_blocks_per_seq,), np.int32)
-                    self.prefill_batch(
-                        [
-                            PrefillItem(
-                                token_ids=np.zeros((n,), np.int32),
-                                start_pos=sp,
-                                block_table=table,
-                            )
-                        ]
-                    )
-                    warmed.append((b, CB))
+                    yield (b, CB, n, sp)
                 if CB >= self.max_blocks_per_seq:
                     break
                 CB = min(CB * 2, self.max_blocks_per_seq)
 
+    def _decode_cb_walk(self):
+        """Every pow2 context-width bucket a decode/verify dispatch can
+        land in (1, 2, 4, ... max_blocks_per_seq)."""
+        CB = 1
+        while True:
+            yield CB
+            if CB >= self.max_blocks_per_seq:
+                break
+            CB = min(CB * 2, self.max_blocks_per_seq)
+
+    # Every jit entry point the serving loop can dispatch through —
+    # lowering_count() sums their dispatch-cache sizes.
+    _JIT_ATTRS = (
+        "_decode_jit", "_prefill_jit", "_import_jit", "_verify_jit",
+        "_sp_jit", "_mixed_jit", "_verify_pipe_jit", "_mixed_verify_jit",
+        "_seed_counts_jit", "_embed_jit",
+    )
+
+    def lowering_count(self) -> int:
+        """Total compiled-program entries across the executor's jit
+        dispatch caches — a monotone count of fresh lowerings. The
+        engine diffs it per dispatch for the compile-cache hit/miss
+        instruments, and the prewarm differential test asserts it stays
+        FLAT across a full workload after prewarm_programs()."""
+        total = 0
+        for name in self._JIT_ATTRS:
+            fn = getattr(self, name, None)
+            size = getattr(fn, "_cache_size", None)
+            if size is not None:
+                try:
+                    total += int(size())
+                except Exception:  # pragma: no cover - jax internals
+                    pass
+        return total
+
+    @property
+    def overlap_collectives_active(self) -> bool:
+        """Whether the jitted steps traced with the ring collective-
+        matmul schedule in the hot loop (XLLM_OVERLAP_COLLECTIVES on a
+        tp>1 or ep>1 mesh — ops/collective_matmul.py)."""
+        from xllm_service_tpu.ops import collective_matmul as cm_ops
+
+        if not cm_ops.overlap_collectives_enabled():
+            return False
+        return (
+            self.mesh.shape.get("tp", 1) > 1
+            or self.mesh.shape.get("ep", 1) > 1
+        )
+
+    def _mixed_step_resolved(self) -> bool:
+        """The engine's mixed-step decision, replicated (XLLM_MIXED_STEP
+        over EngineConfig.enable_mixed_step, gated on family support) —
+        the prewarm must enumerate the builders the ENGINE will run."""
+        env = os.environ.get("XLLM_MIXED_STEP", "")
+        on = (
+            True if env == "1"
+            else False if env == "0"
+            else self.engine_cfg.enable_mixed_step
+        )
+        return bool(on and self.supports_mixed)
+
+    def _spec_pipeline_resolved(self) -> bool:
+        env = os.environ.get("XLLM_SPEC_PIPELINE", "")
+        on = (
+            True if env == "1"
+            else False if env == "0"
+            else self.engine_cfg.enable_spec_pipeline
+        )
+        return bool(on and getattr(self, "supports_spec_mixed", False))
+
+    def prewarm_programs(
+        self, p_groups: bool = True, guided: bool = False
+    ) -> Dict[str, object]:
+        """Compile the FULL bucket-program family this executor can
+        dispatch — context buckets x step builders x spec variants —
+        killing the first-post-idle-recompile class PR 11 measured at
+        2.7-4 s/program (ISSUE 18 tentpole b). Beyond warmup()'s split
+        sync shapes this walks the overlap pipeline's device-resident-
+        feedback decode variant (committed replicated prev tokens key a
+        DIFFERENT lowering than the host-fed sync call), the fused
+        mixed prefill+decode family (CBd x (Lpad, CBp), both feedback
+        variants), and the pipelined verify / mixed-verify programs
+        when speculative decoding is configured. With the keyed
+        persistent cache enabled every compile also lands on disk, so a
+        warm restart replays this walk as disk reads.
+
+        `p_groups` (default on — a concurrent admission wave is the
+        NORMAL case, and its P=2 group recompile is exactly the ambush
+        class) walks the P>1 prefill-group shapes of the mixed family,
+        pow2 up to min(PREFILL_GROUP_MAX, max_running_requests) — the
+        scheduler can never group more chunks than running slots;
+        `guided` adds the guided-mask program variants when a guided
+        table is installed. Returns a report dict
+        ({"families": {name: programs}, "programs", "prewarm_ms"}) and
+        arms the zero-fresh-lowerings accounting (lowering_count)."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        before = self.lowering_count()
         R = self.R
+        rep = NamedSharding(self.mesh, P())
+        dev_prev = jax.device_put(np.zeros((R,), np.int32), rep)
+        no_fresh = np.zeros((R,), bool)
+        tables = np.zeros((R, self.max_blocks_per_seq), np.int32)
         active = np.zeros((R,), bool)
         active[0] = True
         batch = SamplingBatch(
@@ -1165,47 +1358,147 @@ class ModelExecutor:
             seeds=np.zeros(R, np.uint32),
             steps=np.zeros(R, np.int32),
         )
-        # Every pow2 context-width bucket decode can hit (decode() slices
-        # the table to the batch's true block bound, one compile per
-        # bucket) — positions drive the bucket; writes land in block 0.
-        CB = 1
-        while True:
+        families: Dict[str, int] = {}
+        families["split"] = len(self.warmup())
+
+        # Overlap-pipeline decode: the steady state feeds the next step
+        # from the in-flight device sample (replicated committed arrays).
+        n = 0
+        for CB in self._decode_cb_walk():
             positions = np.zeros((R,), np.int32)
             positions[0] = CB * self.block_size - 1
-            self.decode(
-                np.zeros((R,), np.int32),
-                positions,
-                np.zeros((R, self.max_blocks_per_seq), np.int32),
-                active,
-                batch,
+            self.decode_start(
+                np.zeros((R,), np.int32), no_fresh, dev_prev,
+                positions, tables, active, batch,
             )
-            if CB >= self.max_blocks_per_seq:
-                break
-            CB = min(CB * 2, self.max_blocks_per_seq)
+            n += 1
+        families["decode_pipe"] = n
 
-        # Speculative verify shapes ([R, S] over the same pow2 CB buckets)
-        # when the engine runs speculative decoding.
-        spec = self.engine_cfg.speculative_tokens
-        if spec > 0:
-            S = spec + 1
-            CB = 1
-            while True:
-                positions = np.zeros((R,), np.int32)
-                positions[0] = max(CB * self.block_size - S, 0)
-                true_len = np.zeros((R,), np.int32)
-                true_len[0] = S
-                self.verify(
-                    np.zeros((R, S), np.int32),
-                    positions,
-                    true_len,
-                    np.zeros((R, self.max_blocks_per_seq), np.int32),
-                    active,
-                    batch,
+        interp = os.environ.get("XLLM_RAGGED_INTERPRET") == "1"
+        p_walk = [1]
+        if p_groups:
+            pmax = min(self.PREFILL_GROUP_MAX, R)
+            pw = 1
+            while pw < pmax:
+                pw = min(pw * 2, pmax)
+                p_walk.append(pw)
+
+        def pf_items(n_tok: int, sp: int, count: int):
+            return [
+                PrefillItem(
+                    token_ids=np.zeros((n_tok,), np.int32),
+                    start_pos=sp,
+                    block_table=np.zeros(
+                        (self.max_blocks_per_seq,), np.int32
+                    ),
                 )
-                if CB >= self.max_blocks_per_seq:
-                    break
-                CB = min(CB * 2, self.max_blocks_per_seq)
-        return warmed
+                for _ in range(count)
+            ]
+
+        if self._mixed_step_resolved():
+            n = 0
+            for b, CBp, n_tok, sp in self._prefill_shape_family():
+                for Pn in p_walk:
+                    items = pf_items(n_tok, sp, Pn)
+                    for CBd in self._decode_cb_walk():
+                        positions = np.zeros((R,), np.int32)
+                        positions[0] = CBd * self.block_size - 1
+                        # Both feedback variants: host-fed (first
+                        # dispatch after idle/admission) and device-
+                        # resident (steady state).
+                        for prev, fm in (
+                            (None, None), (dev_prev, no_fresh),
+                        ):
+                            self.mixed_start(
+                                items, np.zeros((R,), np.int32), fm,
+                                prev, positions, tables, active, batch,
+                                interpret=interp,
+                            )
+                            n += 1
+            families["mixed"] = n
+
+        # Slot-histogram (re)seed: admission calls it with the pow2-
+        # bucketed generation history (P=1 fresh; resume/PD-import carry
+        # longer ones) — tiny scatter programs, but a fresh lowering on
+        # the admission path is still a post-idle stall.
+        n = 0
+        pw = 1
+        limit = max(int(self.engine_cfg.max_seq_len), 1)
+        while True:
+            self.seed_slot_counts(0, [0] * pw)
+            n += 1
+            if pw >= limit:
+                break
+            pw *= 2
+        families["seed_counts"] = n
+
+        spec = self.engine_cfg.speculative_tokens
+        if spec > 0 and self._spec_pipeline_resolved():
+            S = spec + 1
+            n = 0
+            for CB in self._decode_cb_walk():
+                host_pos = np.zeros((R,), np.int32)
+                host_pos[0] = max(CB * self.block_size - 2 * S, 0)
+                args = (
+                    np.zeros((R, spec), np.int32),  # drafts
+                    np.zeros((R,), np.int32),  # host_last
+                    host_pos,
+                    np.zeros((R,), np.int32),  # host_steps
+                    np.ones((R,), bool),  # fresh_mask
+                    None, None,  # prev tokens/n_emit (device-nulled)
+                    tables, active, batch,
+                )
+                self.verify_start([], *args, interpret=interp)
+                n += 1
+                if self._mixed_step_resolved():
+                    for b, CBp, n_tok, sp in self._prefill_shape_family():
+                        for Pn in p_walk:
+                            self.verify_start(
+                                pf_items(n_tok, sp, Pn), *args,
+                                interpret=interp,
+                            )
+                            n += 1
+            families["verify_pipe"] = n
+
+        if guided and getattr(self, "_guided_table", None) is not None:
+            gbatch = SamplingBatch(
+                temperature=np.zeros(R, np.float32),
+                top_k=np.zeros(R, np.int32),
+                top_p=np.ones(R, np.float32),
+                seeds=np.zeros(R, np.uint32),
+                steps=np.zeros(R, np.int32),
+                mask_rows=np.full((R,), self.permissive_row, np.int32),
+            )
+            n = 0
+            for CB in self._decode_cb_walk():
+                positions = np.zeros((R,), np.int32)
+                positions[0] = CB * self.block_size - 1
+                self.decode(
+                    np.zeros((R,), np.int32), positions, tables, active,
+                    gbatch,
+                )
+                n += 1
+                if self._mixed_step_resolved():
+                    b, CBp, n_tok, sp = next(
+                        iter(self._prefill_shape_family())
+                    )
+                    self.mixed_start(
+                        pf_items(n_tok, sp, 1), np.zeros((R,), np.int32),
+                        no_fresh, dev_prev, positions, tables, active,
+                        gbatch, interpret=interp,
+                    )
+                    n += 1
+            families["guided"] = n
+
+        self.prewarm_ms = (_time.perf_counter() - t0) * 1e3
+        self.prewarmed_lowerings = self.lowering_count()
+        report = {
+            "families": families,
+            "programs": self.prewarmed_lowerings - before,
+            "prewarm_ms": self.prewarm_ms,
+        }
+        self.prewarm_report = report
+        return report
 
     # ------------------------------------------------ SP (ring) prefill
 
