@@ -1,0 +1,260 @@
+#!/usr/bin/env python
+"""Fleet-scale storm bench (ROADMAP item 2; docs/FAULT_TOLERANCE.md).
+
+Drives the REAL master stack — actual Scheduler, routing policies,
+prefix fabric, breaker, election, goodput controller, admission front
+door — through `cluster/fleet_sim`'s discrete-event harness at 50+
+simulated instances and 10k+ concurrent streams, in seconds of wall
+time. Five storm scenarios (see cluster/fleet_sim/traces.py):
+
+    diurnal          sinusoidal day/night swing, peak >10k concurrent
+    burst            10x arrival spike mid-trace
+    zipf_prefix      Zipf-skewed shared prefixes (CAR + prefix index)
+    straggler        ~6% of the fleet serving 6x slow
+    rolling_restart  drain -> rejoin EVERY instance while traffic flows
+
+Each scenario carries an exit-3 guard: zero unrecovered streams,
+bounded p99 sim-TTFT, a goodput floor, and (for the overload
+scenarios) a peak-concurrency floor proving the harness actually
+reached fleet scale. One JSON line per scenario.
+
+Two extra modes:
+
+    --ab        admission on/off A/B on an overload trace past the
+                saturation knee: with XLLM_ADMISSION off the fleet
+                accepts everything and p99 TTFT collapses past the SLO;
+                with the front door on (global-inflight cap + per-tenant
+                buckets) excess arrivals shed with Retry-After and the
+                ADMITTED streams keep their SLO. Guard: admission holds
+                >=1.3x the SLO-goodput of open-door, and sheds > 0.
+
+    --ceiling   master-throughput ceiling: flat-out request storms at
+                instance counts [10, 25, 50, 100] measuring CONTROL-
+                PLANE requests/s (schedule + route + deliver through the
+                real scheduler, wall time). The table is the entry
+                criterion for ROADMAP item 7 (clustered meta-master):
+                shard the master only when this ceiling is the
+                bottleneck. Results land in BASELINE.md.
+
+    python bench_fleet.py                      # 5 scenarios, guards on
+    python bench_fleet.py --quick              # small sizes, CI-able
+    python bench_fleet.py --ab
+    python bench_fleet.py --ceiling
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from xllm_service_tpu.cluster.fleet_sim import FleetSim, SCENARIOS, make_trace
+from xllm_service_tpu.common.config import ServiceConfig
+
+# Scenario -> (num_requests, duration_s, and guard thresholds) at FULL
+# scale (50 instances). p99 bounds are sim-time seconds under the sim's
+# service model (BASE_TTFT 0.2s inflated by load); goodput floors are
+# SLO-met generated tokens per sim second. Guards are deliberately loose
+# ~2x margins against scheduler-policy drift, tight enough to catch a
+# recovery or routing regression (which shows up as unrecovered > 0 or
+# an order-of-magnitude goodput drop, not 10%).
+FULL = {
+    #                requests  duration  p99<=   goodput>=  peak>=
+    "diurnal":        (30000,     45.0,   8.0,     8000.0,   10000),
+    "burst":          (20000,     60.0,   8.0,     5000.0,    4000),
+    "zipf_prefix":    ( 6000,     60.0,   6.0,     1500.0,       0),
+    "straggler":      ( 6000,     60.0,  10.0,     1000.0,       0),
+    "rolling_restart":( 4000,    120.0,   4.0,      800.0,       0),
+}
+# --quick: ~10x smaller, guards scale with it (CI smoke, <5 s total).
+QUICK = {
+    "diurnal":        ( 3000,     30.0,   8.0,      800.0,     400),
+    "burst":          ( 2000,     30.0,   8.0,      500.0,     200),
+    "zipf_prefix":    ( 1000,     30.0,   6.0,      250.0,       0),
+    "straggler":      ( 1000,     30.0,  10.0,      150.0,       0),
+    "rolling_restart":( 1000,     60.0,   4.0,      150.0,       0),
+}
+
+
+def run_scenarios(args) -> int:
+    table = QUICK if args.quick else FULL
+    n_inst = args.instances
+    names = (
+        [s.strip() for s in args.scenarios.split(",") if s.strip()]
+        if args.scenarios else list(SCENARIOS)
+    )
+    rc = 0
+    for name in names:
+        reqs, dur, p99_max, goodput_min, peak_min = table[name]
+        if args.requests:
+            reqs = args.requests
+        trace = make_trace(name, reqs, dur, n_inst, seed=args.seed)
+        sim = FleetSim(
+            num_instances=n_inst, seed=args.seed, policy=trace.policy,
+            slo_ttft_s=args.slo_ttft_s,
+        )
+        try:
+            rep = sim.run(trace)
+        finally:
+            sim.close()
+
+        reasons = []
+        if rep.unrecovered != 0:
+            reasons.append(f"{rep.unrecovered} unrecovered streams")
+        if rep.failed != 0:
+            reasons.append(f"{rep.failed} failed streams")
+        if rep.p99_ttft_s > p99_max:
+            reasons.append(
+                f"p99 TTFT {rep.p99_ttft_s:.2f}s > {p99_max}s"
+            )
+        if rep.goodput_tok_s < goodput_min:
+            reasons.append(
+                f"goodput {rep.goodput_tok_s:.0f} tok/s < {goodput_min:.0f}"
+            )
+        if rep.peak_concurrent < peak_min:
+            reasons.append(
+                f"peak {rep.peak_concurrent} concurrent < {peak_min}"
+            )
+        out = rep.to_json()
+        out["metric"] = "fleet_sim"
+        out["fleet_guard"] = "ok" if not reasons else "; ".join(reasons)
+        print(json.dumps(out))
+        if reasons:
+            rc = 3
+    return rc
+
+
+def run_ab(args) -> int:
+    """Admission on/off A/B past the saturation knee. Same overload
+    trace twice; the SLO is deliberately tight (default 3s) so the
+    open-door run's queueing collapse costs it SLO-goodput while the
+    capped run keeps its admitted streams fast."""
+    n_inst = args.instances
+    reqs = args.requests or (4000 if args.quick else 30000)
+    dur = 20.0 if args.quick else 45.0
+    slo = args.slo_ttft_s if args.slo_ttft_s != 30.0 else 3.0
+
+    results = {}
+    for label, admission in (("off", False), ("on", True)):
+        cfg = ServiceConfig()
+        if admission:
+            # Global cap near the fleet's service knee (instances x
+            # per-instance capacity x small queue allowance); per-tenant
+            # cap at half of it so one tenant cannot own the fleet.
+            cfg.admission_max_global_inflight = n_inst * 40
+            cfg.admission_max_inflight = n_inst * 20
+            cfg.admission_queue_timeout_s = 0.0  # shed, never park
+        trace = make_trace("burst", reqs, dur, n_inst, seed=args.seed)
+        sim = FleetSim(
+            num_instances=n_inst, seed=args.seed, policy=trace.policy,
+            admission=admission, slo_ttft_s=slo, config=cfg,
+        )
+        try:
+            rep = sim.run(trace)
+        finally:
+            sim.close()
+        results[label] = rep
+
+    off, on = results["off"], results["on"]
+    reasons = []
+    if on.unrecovered or off.unrecovered:
+        reasons.append("unrecovered streams in A/B run")
+    if on.shed == 0:
+        reasons.append("admission-on run shed nothing (knee not reached)")
+    if on.goodput_tok_s < off.goodput_tok_s * 1.3:
+        reasons.append(
+            f"admission goodput {on.goodput_tok_s:.0f} not >= 1.3x "
+            f"open-door {off.goodput_tok_s:.0f}"
+        )
+    print(json.dumps({
+        "metric": "fleet_admission_ab",
+        "instances": n_inst,
+        "requests": reqs,
+        "slo_ttft_s": slo,
+        "off": {
+            "goodput_tok_s": round(off.goodput_tok_s, 1),
+            "p99_ttft_s": round(off.p99_ttft_s, 3),
+            "peak_concurrent": off.peak_concurrent,
+            "shed": off.shed,
+        },
+        "on": {
+            "goodput_tok_s": round(on.goodput_tok_s, 1),
+            "p99_ttft_s": round(on.p99_ttft_s, 3),
+            "peak_concurrent": on.peak_concurrent,
+            "shed": on.shed,
+            "sheds_by_reason": on.sheds_by_reason,
+        },
+        "admission_ab_guard": "ok" if not reasons else "; ".join(reasons),
+    }))
+    return 3 if reasons else 0
+
+
+def run_ceiling(args) -> int:
+    """Master control-plane throughput ceiling: a flat-out storm at each
+    instance count, reporting wall-clock requests/s through the REAL
+    scheduler (admission -> route -> record -> dispatch -> 2 deliveries
+    -> finish). No guard — this is a measurement, the BASELINE.md entry
+    criterion for sharding the master (ROADMAP item 7)."""
+    reqs = args.requests or (2000 if args.quick else 10000)
+    counts = [10, 25, 50, 100]
+    rows = []
+    for n_inst in counts:
+        trace = make_trace("burst", reqs, 10.0, n_inst, seed=args.seed)
+        sim = FleetSim(
+            num_instances=n_inst, seed=args.seed, policy=trace.policy,
+        )
+        try:
+            rep = sim.run(trace)
+        finally:
+            sim.close()
+        rows.append({
+            "instances": n_inst,
+            "requests": rep.submitted,
+            "unrecovered": rep.unrecovered,
+            "wall_s": round(rep.wall_s, 2),
+            "control_plane_rps": round(rep.submitted / rep.wall_s, 1),
+            "events_per_s": round(rep.events / rep.wall_s, 1),
+        })
+        print(json.dumps({"metric": "master_ceiling", **rows[-1]}))
+    print(json.dumps({"metric": "master_ceiling_table", "rows": rows}))
+    return 0
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("xllm-service-tpu fleet storm bench")
+    p.add_argument("--instances", type=int, default=50)
+    p.add_argument(
+        "--requests", type=int, default=0,
+        help="override per-scenario request count (0 = scenario default)",
+    )
+    p.add_argument(
+        "--scenarios", default="",
+        help=f"comma list from {sorted(SCENARIOS)} (default: all)",
+    )
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument(
+        "--slo-ttft-s", type=float, default=30.0,
+        help="sim-time TTFT SLO for goodput accounting",
+    )
+    p.add_argument(
+        "--quick", action="store_true",
+        help="~10x smaller sizes with matching guards (CI smoke)",
+    )
+    p.add_argument("--ab", action="store_true",
+                   help="admission on/off A/B instead of the scenarios")
+    p.add_argument("--ceiling", action="store_true",
+                   help="master-throughput ceiling table instead")
+    args = p.parse_args()
+
+    if args.ab:
+        rc = run_ab(args)
+    elif args.ceiling:
+        rc = run_ceiling(args)
+    else:
+        rc = run_scenarios(args)
+    if rc:
+        sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -38,6 +38,13 @@ Fault injection: --chaos-spec takes a seeded schedule (inline JSON or
   * partition — flap + dropped heartbeats (both directions of the link)
                 for duration_s;
   * slow      — stretch the fake engine's per-token delay.
+  * rolling_restart — the ops maneuver, fleet-wide: drain (graceful
+                stop: deregister, live streams redispatch/resume onto
+                survivors) -> grace_s dead -> rejoin a fresh instance
+                under the same name, one instance at a time (step_s
+                apart). Unlike `kill`, nothing is ungraceful, so the
+                report's rolling_restart_guard demands ZERO dropped
+                streams (exit 3 otherwise).
 
 Control-plane chaos (docs/FAULT_TOLERANCE.md): any master_* event makes
 the bench run a TWO-master replica set against one shared store, and the
@@ -2083,8 +2090,9 @@ def main() -> None:
         on_tpu = jax.default_backend() == "tpu"
     model = "llama3-1b" if on_tpu else "llama3-tiny"
 
-    instances = []
-    for i in range(args.instances):
+    def make_instance(i):
+        """Build (NOT start) instance i — also the rolling-restart rebuild
+        path, which re-creates a drained instance under the same name."""
         if args.real_engine:
             ecfg = EngineConfig(
                 model=model, block_size=128 if on_tpu else 16,
@@ -2099,23 +2107,26 @@ def main() -> None:
                 # persistent jit cache: repeat runs skip the compiles
                 compilation_cache_dir="/tmp/xllm-jit-cache",
             )
-            srv = InstanceServer(
+            return InstanceServer(
                 ecfg, master_rpc_addr=master.rpc_address,
                 heartbeat_interval_s=args.heartbeat_s,
             )
-        else:
-            ecfg = EngineConfig(
-                model="fake-echo", instance_name=f"bench{i}",
-                instance_type=args.instance_type, block_size=16,
-            )
-            srv = InstanceServer(
-                ecfg, master_rpc_addr=master.rpc_address,
-                heartbeat_interval_s=args.heartbeat_s,
-                engine=FakeEngine(
-                    token_delay_s=args.token_delay_ms / 1000.0,
-                    ttft_ms=10.0,
-                ),
-            )
+        ecfg = EngineConfig(
+            model="fake-echo", instance_name=f"bench{i}",
+            instance_type=args.instance_type, block_size=16,
+        )
+        return InstanceServer(
+            ecfg, master_rpc_addr=master.rpc_address,
+            heartbeat_interval_s=args.heartbeat_s,
+            engine=FakeEngine(
+                token_delay_s=args.token_delay_ms / 1000.0,
+                ttft_ms=10.0,
+            ),
+        )
+
+    instances = []
+    for i in range(args.instances):
+        srv = make_instance(i)
         srv.start()
         instances.append(srv)
 
@@ -2178,11 +2189,13 @@ def main() -> None:
     from xllm_service_tpu.common import faults
 
     if chaos_events:
-        if any(e.get("action") == "kill" for e in chaos_events) and (
-            len(instances) < 2
-        ):
+        if any(
+            e.get("action") in ("kill", "rolling_restart")
+            for e in chaos_events
+        ) and len(instances) < 2:
             raise SystemExit(
-                "kill events need --instances >= 2 (someone must survive)"
+                "kill/rolling_restart events need --instances >= 2 "
+                "(someone must survive)"
             )
         plan = faults.install_plan(
             faults.FaultPlan(seed=int(chaos.get("seed", args.seed)))
@@ -2209,9 +2222,58 @@ def main() -> None:
         return masters[0]
 
     master_kills = []
+    rolling_log = []
+    rolling_threads = []
+
+    def _rolling_restart(ev, t_start):
+        """Fleet-wide rolling restart: DRAIN (graceful stop: deregister ->
+        the master redispatches pre-token / token-replay-resumes
+        mid-stream work onto survivors), wait a grace period (the process
+        is dead), then REJOIN a fresh InstanceServer under the same name
+        — for every instance in sequence. The ops-maneuver counterpart of
+        `kill`: nothing here is ungraceful, so the guard is ZERO dropped
+        streams, not merely recovered ones."""
+        grace_s = float(ev.get("grace_s", 0.5))
+        step_s = float(ev.get("step_s", grace_s + 1.0))
+        for i in range(len(instances)):
+            old = instances[i]
+            t_drain = time.monotonic() - t_start
+            try:
+                old.stop()
+            except Exception:
+                pass
+            time.sleep(grace_s)
+            srv = make_instance(i)
+            srv.start()
+            instances[i] = srv
+            rolling_log.append({
+                "instance": srv.name,
+                "drained_at_s": round(t_drain, 3),
+                "rejoined_at_s": round(time.monotonic() - t_start, 3),
+            })
+            # Let the rejoin register before the next drain so capacity
+            # never dips by more than one instance.
+            deadline = time.monotonic() + 10.0
+            mgr = _active_master().scheduler.instance_mgr
+            while time.monotonic() < deadline:
+                if any(
+                    m.name == srv.name for m in mgr.list_instances()
+                ):
+                    break
+                time.sleep(0.05)
+            rest = step_s - grace_s
+            if rest > 0:
+                time.sleep(rest)
 
     def fire_chaos(ev, t_start):
         action = ev.get("action")
+        if action == "rolling_restart":
+            th = threading.Thread(
+                target=_rolling_restart, args=(ev, t_start), daemon=True,
+            )
+            th.start()
+            rolling_threads.append(th)
+            return
         if action == "master_kill":
             # Ungraceful: planes drop, keepalive stops, lease LINGERS
             # until TTL — the standby takes over only when the store's
@@ -2430,6 +2492,8 @@ def main() -> None:
         threads.append(t)
     for t in threads:
         t.join(timeout=600.0)
+    for t in rolling_threads:
+        t.join(timeout=600.0)
     wall = time.monotonic() - t_start
     # Read terminal stats from the replica that ended the run as master —
     # under master chaos the original one may be dead.
@@ -2593,10 +2657,21 @@ def main() -> None:
                     prefix_by_instance if args.shared_prefix else None
                 ),
                 "pd_flips": pd_flips,
+                "rolling_restarts": rolling_log or None,
+                "rolling_restart_guard": (
+                    ("ok" if not errors else f"{len(errors)} dropped streams")
+                    if rolling_log else None
+                ),
                 "master_failover": master_report,
             }
         )
     )
+    if rolling_log and errors:
+        # The maneuver is graceful end to end; ANY client-visible stream
+        # error during it is a recovery bug, not acceptable collateral.
+        import sys
+
+        sys.exit(3)
 
 
 if __name__ == "__main__":

@@ -654,3 +654,103 @@ def test_role_metrics_exported():
             s.stop()
         master.stop()
         store.close()
+
+
+# --------------------------------------------------------------------------
+# half (c): autoscaling signals (ISSUE 19)
+# --------------------------------------------------------------------------
+
+
+class TestAutoscale:
+    """autoscale_signals(): wanted-instances-per-role + encoder headroom
+    from the same demand model the reshaper uses, gated by the
+    XLLM_FLEET_AUTOSCALE hatch, degraded (not crashed) by the
+    `autoscale.signal` fault point."""
+
+    def test_hatch_off_returns_empty(self, pd_cluster, monkeypatch):
+        _, mgr = pd_cluster
+        ctl = _controller(mgr)
+        monkeypatch.setenv("XLLM_FLEET_AUTOSCALE", "0")
+        assert ctl.autoscale_signals() == {}
+        # Gauges untouched: still the boot defaults.
+        assert ctl.wanted_instances() == {
+            "prefill": 0, "decode": 0, "mix": 0, "encode": 0,
+        }
+        assert ctl.encoder_headroom() == 1.0
+
+    def test_idle_fleet_wants_current_census(self, pd_cluster):
+        _, mgr = pd_cluster
+        ctl = _controller(mgr)
+        sig = ctl.autoscale_signals()
+        # No queued work anywhere: hold the fleet at its current size.
+        assert sig["wanted_instances"] == {
+            "prefill": 1, "decode": 1, "mix": 0, "encode": 0,
+        }
+        assert sig["encoder_headroom"] == 1.0
+        assert sig["demand_prefill"] == 0.0
+        assert sig["demand_decode"] == 0.0
+        assert ctl.wanted_instances() == sig["wanted_instances"]
+
+    def test_demand_scales_wanted_serving(self, pd_cluster, monkeypatch):
+        _, mgr = pd_cluster
+        ctl = _controller(mgr)
+        monkeypatch.setenv("XLLM_FLEET_AUTOSCALE_TARGET_WAITING", "4.0")
+        # 12 queued prefills + (8 running + 4 waiting) decodes = 24 units
+        # of work / target 4 -> 6 wanted serving replicas, split by the
+        # 50/50 demand ratio.
+        mgr.get_request_metrics("p0").prefill_request_num = 12
+        mgr.get_request_metrics("d0").decode_request_num = 8
+        mgr.record_load_metrics_update("d0", LoadMetrics(
+            waiting_requests_num=4,
+        ))
+        sig = ctl.autoscale_signals()
+        wanted = sig["wanted_instances"]
+        assert wanted["prefill"] + wanted["decode"] == 6
+        assert wanted["prefill"] == 3 and wanted["decode"] == 3
+        assert sig["demand_prefill"] == 12.0
+        assert sig["demand_decode"] == 12.0
+
+    def test_mix_majority_fleet_grows_mix(self, pd_cluster):
+        _, mgr = pd_cluster
+        assert mgr.flip_role("d0", InstanceType.MIX)
+        ctl = _controller(mgr)
+        mgr.get_request_metrics("p0").prefill_request_num = 6
+        mgr.get_request_metrics("d0").decode_request_num = 6
+        sig = ctl.autoscale_signals()
+        wanted = sig["wanted_instances"]
+        # Colocate-heavy fleet: growth lands on the MIX tier, the PD
+        # census is left where the reshaper put it.
+        assert wanted["mix"] >= 1
+        assert wanted["prefill"] == 1
+        assert wanted["mix"] + wanted["prefill"] + wanted["decode"] == 3
+
+    def test_encoder_headroom_tracks_waiting_budget(self, pd_cluster):
+        store, mgr = pd_cluster
+        _register(store, "e0", itype=InstanceType.ENCODE)
+        _wait_registered(mgr, "e0")
+        ctl = _controller(mgr)
+        mgr.record_load_metrics_update("e0", LoadMetrics(
+            waiting_requests_num=2,
+        ))
+        sig = ctl.autoscale_signals()
+        # Budget = target(4) * 1 encoder; 2 waiting -> half the budget
+        # unspent.
+        assert sig["encoder_headroom"] == pytest.approx(0.5)
+        assert sig["wanted_instances"]["encode"] == 1
+        assert ctl.encoder_headroom() == pytest.approx(0.5)
+
+    def test_fault_point_degrades_to_previous_gauges(self, pd_cluster):
+        from xllm_service_tpu.common import faults
+
+        _, mgr = pd_cluster
+        ctl = _controller(mgr)
+        before = ctl.autoscale_signals()["wanted_instances"]
+        faults.install_plan(faults.FaultPlan(rules=[
+            faults.FaultRule(point="autoscale.signal", action="error"),
+        ]))
+        try:
+            assert ctl.autoscale_signals() == {}
+        finally:
+            faults.clear()
+        # A dropped signal tick keeps the previous verdict on the gauges.
+        assert ctl.wanted_instances() == before

@@ -135,6 +135,10 @@ def test_epd_three_stage_e2e():
     tokens. Different images must produce different outputs."""
     import pytest
 
+    from tests._mm_probe import skip_unless_mm_greedy_diverges
+
+    skip_unless_mm_greedy_diverges()
+
     from xllm_service_tpu.api import Master
     from xllm_service_tpu.api.instance import InstanceServer
     from xllm_service_tpu.common.config import ServiceConfig
@@ -526,6 +530,9 @@ def test_qwen2vl_epd_e2e_with_real_tower(tmp_path):
     """North-star config 4 with the REAL VLM family: a Qwen2-VL-arch
     tower (HF visual.* checkpoint) as the ENCODE stage feeding media
     embeddings into the LM through the full three-stage EPD HTTP path."""
+    from tests._mm_probe import skip_unless_mm_greedy_diverges
+
+    skip_unless_mm_greedy_diverges()
     import jax as _jax
 
     _jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,213 @@
+"""Overload-side control-plane guards (ISSUE 19 satellite): the global
+RetryBudget token bucket that stops one flapping instance from
+amplifying into a fleet-wide retry storm, and the per-instance circuit
+breaker's full suspect -> ejected -> probation -> healthy lifecycle
+pinned on a frozen injected clock (no sleeps, no wall-time races).
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from xllm_service_tpu.api.http_utils import (
+    RequestNotSentError,
+    RetryBudget,
+    post_json_retrying,
+)
+from xllm_service_tpu.cluster.instance_mgr import HealthState, InstanceMgr
+from xllm_service_tpu.common import faults
+from xllm_service_tpu.common.types import LoadMetrics
+from xllm_service_tpu.coordination import MemoryStore
+
+from tests.test_api_e2e import wait_until
+from tests.test_goodput import _register, _wait_registered
+
+
+# --------------------------------------------------------------------------
+# RetryBudget
+# --------------------------------------------------------------------------
+
+
+class TestRetryBudget:
+    def test_floor_token_then_exhaustion(self):
+        b = RetryBudget(ratio=0.0, min_tokens=1.0)
+        assert b.withdraw()
+        # ratio 0 means nothing refills: the bucket is dry for good.
+        assert not b.withdraw()
+        assert not b.withdraw()
+        assert b.exhausted_total == 2
+        assert b.tokens == 0.0
+
+    def test_deposits_refill_withdrawals(self):
+        b = RetryBudget(ratio=0.5, min_tokens=0.0)
+        assert not b.withdraw()  # empty until traffic deposits
+        b.deposit()
+        b.deposit()
+        assert b.tokens == pytest.approx(1.0)
+        assert b.withdraw()
+        assert not b.withdraw()
+
+    def test_max_tokens_caps_the_bucket(self):
+        b = RetryBudget(ratio=10.0, min_tokens=0.0, max_tokens=3.0)
+        for _ in range(5):
+            b.deposit()
+        assert b.tokens == 3.0
+
+    def test_post_json_retrying_stops_on_exhausted_budget(self):
+        # A port nothing listens on: every attempt fails at connect time
+        # (proven never-sent, so even the idempotency rule would retry).
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        addr = "127.0.0.1:%d" % s.getsockname()[1]
+        s.close()
+
+        sends = []
+        faults.set_point_observer(
+            lambda name: name == "post_json.send" and sends.append(name)
+        )
+        budget = RetryBudget(ratio=0.0, min_tokens=1.0)
+        try:
+            with pytest.raises(RequestNotSentError):
+                post_json_retrying(
+                    addr, "/echo", {}, timeout=0.5,
+                    attempts=5, budget=budget, idempotent=True,
+                    backoff_base_s=0.001,
+                )
+        finally:
+            faults.set_point_observer(None)
+        # attempts=5 allows 4 retries, but the budget held exactly one
+        # token: first attempt + one retry, then a refused withdrawal
+        # ends the loop — not four timed-out connects.
+        assert len(sends) == 2
+        assert budget.exhausted_total == 1
+
+
+# --------------------------------------------------------------------------
+# circuit breaker on a frozen clock
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def breaker():
+    """One registered instance under an InstanceMgr whose liveness clock
+    is the injected `t[0]` — staleness, probe rate-limiting, and prune
+    all advance only when the test says so. Frozen at 100, not 0:
+    `last_probe_mono = 0` is the breaker's "probe ASAP" reset value, so
+    a clock sitting exactly at 0 would read as probed-just-now."""
+    t = [100.0]
+    store = MemoryStore(clock=lambda: 0.0)
+    mgr = InstanceMgr(
+        store, is_master=lambda: True,
+        detect_disconnected_interval_s=10.0,
+        suspect_failures=2, eject_failures=4,
+        probe_min_interval_s=5.0,
+        clock=lambda: t[0],
+    )
+    _register(store, "i0")
+    _wait_registered(mgr, "i0")
+    yield t, mgr
+    mgr.close()
+    store.close()
+
+
+def _fail_times(mgr, name, n):
+    state = ""
+    for _ in range(n):
+        state = mgr.record_dispatch_failure(name)
+    return state
+
+
+class TestBreakerLifecycle:
+    def test_failure_ladder_healthy_suspect_ejected(self, breaker):
+        _, mgr = breaker
+        assert mgr.record_dispatch_failure("i0") == HealthState.HEALTHY
+        assert mgr.record_dispatch_failure("i0") == HealthState.SUSPECT
+        assert mgr.record_dispatch_failure("i0") == HealthState.SUSPECT
+        assert mgr.record_dispatch_failure("i0") == HealthState.EJECTED
+        assert mgr.total_ejections == 1
+
+    def test_probe_walks_ejected_to_probation_to_healthy(self, breaker):
+        t, mgr = breaker
+        probes = []
+
+        def prober(meta):
+            probes.append(meta.name)
+            return True
+
+        mgr.health_prober = prober
+        assert _fail_times(mgr, "i0", 4) == HealthState.EJECTED
+        # Ejection resets the probe stamp: the first probe fires even on
+        # the frozen clock.
+        assert mgr.probe_unhealthy() == 1
+        assert wait_until(
+            lambda: mgr.health_state("i0") == HealthState.PROBATION
+        )
+        assert mgr.total_probe_recoveries == 1
+        # Probation routes again; its first success closes the breaker.
+        mgr.record_dispatch_success("i0")
+        assert mgr.health_state("i0") == HealthState.HEALTHY
+        assert probes == ["i0"]
+
+    def test_probe_rate_limited_on_frozen_clock(self, breaker):
+        t, mgr = breaker
+        verdict = [False]
+        mgr.health_prober = lambda meta: verdict[0]
+        assert _fail_times(mgr, "i0", 4) == HealthState.EJECTED
+        assert mgr.probe_unhealthy() == 1  # probe fails: still ejected
+        assert wait_until(lambda: mgr.health_state("i0") ==
+                          HealthState.EJECTED)
+        # Same instant: the probe budget for this instance is spent.
+        assert mgr.probe_unhealthy() == 0
+        # Advance past probe_min_interval_s and flip the endpoint up.
+        verdict[0] = True
+        t[0] += 5.0
+        assert mgr.probe_unhealthy() == 1
+        assert wait_until(
+            lambda: mgr.health_state("i0") == HealthState.PROBATION
+        )
+
+    def test_probation_failure_reejects_immediately(self, breaker):
+        t, mgr = breaker
+        mgr.health_prober = lambda meta: True
+        _fail_times(mgr, "i0", 4)
+        mgr.probe_unhealthy()
+        assert wait_until(
+            lambda: mgr.health_state("i0") == HealthState.PROBATION
+        )
+        # The probe lied: one failure during probation re-ejects without
+        # climbing the ladder again.
+        assert mgr.record_dispatch_failure("i0") == HealthState.EJECTED
+        assert mgr.total_ejections == 2
+
+    def test_suspect_probe_ok_heals_without_traffic(self, breaker):
+        _, mgr = breaker
+        mgr.health_prober = lambda meta: True
+        assert _fail_times(mgr, "i0", 2) == HealthState.SUSPECT
+        assert mgr.probe_unhealthy() == 1
+        assert wait_until(
+            lambda: mgr.health_state("i0") == HealthState.HEALTHY
+        )
+
+    def test_stale_heartbeats_suspect_and_fresh_beat_clears(self, breaker):
+        t, mgr = breaker
+        mgr.record_load_metrics_update("i0", LoadMetrics())
+        assert mgr.mark_stale_suspects() == []
+        # Silent for > stale_after * 0.5 on the injected clock.
+        t[0] += 6.0
+        assert mgr.mark_stale_suspects() == ["i0"]
+        assert mgr.health_state("i0") == HealthState.SUSPECT
+        # A live beat clears staleness-driven suspicion...
+        mgr.record_load_metrics_update("i0", LoadMetrics())
+        assert mgr.health_state("i0") == HealthState.HEALTHY
+
+    def test_failure_driven_suspicion_survives_heartbeats(self, breaker):
+        _, mgr = breaker
+        assert _fail_times(mgr, "i0", 2) == HealthState.SUSPECT
+        # ...but failure-driven suspicion does not: only dispatch
+        # success (or a probe) supplies healing evidence.
+        mgr.record_load_metrics_update("i0", LoadMetrics())
+        assert mgr.health_state("i0") == HealthState.SUSPECT
+        mgr.record_dispatch_success("i0")
+        assert mgr.health_state("i0") == HealthState.HEALTHY

@@ -45,6 +45,9 @@ REQUIRED_POINTS = {
     "encode.dispatch",
     "mm_handoff.send",
     "mm_handoff.recv",
+    "admission.shed",
+    "fleet_sim.tick",
+    "autoscale.signal",
 }
 
 

@@ -785,6 +785,9 @@ class Master:
             ),
             temperature=float(body.get("temperature", 1.0)),
             top_p=float(body.get("top_p", 1.0)),
+            # Admission fair-share key: the OpenAI `user` field when the
+            # client sends one, else the model name (service/admission.py).
+            tenant=str(body.get("user") or body.get("model") or ""),
         )
         raw_stop = body.get("stop")
         if raw_stop is not None:
@@ -835,15 +838,21 @@ class Master:
             return
         status = self.scheduler.schedule(req)
         if not status.ok():
+            eh = dict(xh) if xh else {}
+            if status.code == StatusCode.RESOURCE_EXHAUSTED and req.retry_after_s:
+                # Admission shed: tell well-behaved clients exactly when
+                # to come back instead of letting them hammer the door.
+                eh["Retry-After"] = str(int(req.retry_after_s))
             h.send_error_json(
                 _HTTP_STATUS.get(status.code, 500), status.message,
-                extra_headers=xh,
+                extra_headers=eh or None,
             )
             return
 
         if self.scheduler.instance_mgr.get_instance(req.routing.prefill_name) is None:
             # Unwind the SCHEDULE bookkeeping recorded by schedule() — the
-            # request never dispatches.
+            # request never dispatches. The admission slot goes back too.
+            self.scheduler.admission.release(req)
             self.scheduler.instance_mgr.update_request_metrics(
                 req.routing, RequestAction.CANCEL, len(req.token_ids)
             )

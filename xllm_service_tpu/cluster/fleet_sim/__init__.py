@@ -1,0 +1,32 @@
+"""Fleet-scale discrete-event simulation harness (docs/FAULT_TOLERANCE.md,
+BASELINE.md round 19).
+
+`FleetSim` drives the REAL master stack — the actual `Scheduler` object
+with its real routing policies, prefix fabric, breaker, election,
+goodput controller, and admission front door — against simulated
+instances on a simulated clock, so 50+ instances and 10k+ concurrent
+streams run in seconds of wall time. `traces` generates the scenario
+request mixes (diurnal / burst / Zipf-prefix / straggler /
+rolling-restart) P/D-Serve (arxiv 2408.08147) names as the
+fleet-scale failure surfaces; `bench_fleet.py` wraps each in an exit-3
+guard.
+"""
+
+from xllm_service_tpu.cluster.fleet_sim.sim import FleetSim, SimReport
+from xllm_service_tpu.cluster.fleet_sim.traces import (
+    FleetAction,
+    SimRequestSpec,
+    TraceSpec,
+    make_trace,
+    SCENARIOS,
+)
+
+__all__ = [
+    "FleetSim",
+    "SimReport",
+    "FleetAction",
+    "SimRequestSpec",
+    "TraceSpec",
+    "make_trace",
+    "SCENARIOS",
+]

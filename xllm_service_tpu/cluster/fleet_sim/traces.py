@@ -1,0 +1,234 @@
+"""Trace generators for the fleet simulator.
+
+Each generator returns a `TraceSpec`: a deterministic (seeded) arrival
+sequence plus a schedule of fleet actions (drain/kill/rejoin) and
+per-instance straggler factors. The five scenarios are the fleet-scale
+storms P/D-Serve (arxiv 2408.08147) calls out — the ones a 512-stream
+bench against 4 instances can never surface:
+
+  diurnal          sinusoidal arrival rate (the day/night swing); the
+                   peak must clear 10k concurrent streams
+  burst            flat baseline with a 10x arrival spike mid-trace
+  zipf_prefix      Zipf-skewed shared prompt prefixes (hot system
+                   prompts) — exercises the prefix index + CAR routing
+  straggler        uniform load with a few instances serving 6x slow —
+                   the p99 killer
+  rolling_restart  drain -> kill -> rejoin every instance in sequence
+                   while traffic flows; zero streams may drop
+
+Prompt/output lengths are drawn per request; prefix groups share a
+block-aligned token prefix so the REAL chained murmur3 block hashing
+(common/hashing.py) scores them as cache hits once an instance has
+served the group.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class SimRequestSpec:
+    t: float                 # arrival, sim seconds from trace start
+    tenant: str
+    prompt_len: int
+    gen_len: int
+    prefix_group: int = -1   # -1 = unique prompt, else shared-prefix id
+
+
+@dataclass
+class FleetAction:
+    t: float
+    kind: str                # "drain" | "rejoin"
+    instance: int            # instance index
+
+
+@dataclass
+class TraceSpec:
+    name: str
+    duration_s: float
+    requests: List[SimRequestSpec]
+    actions: List[FleetAction] = field(default_factory=list)
+    straggler_factors: Dict[int, float] = field(default_factory=dict)
+    # Routing policy the scenario exercises (zipf wants CAR).
+    policy: str = "RR"
+
+
+# Tenant mix shared by every scenario: a couple of heavy tenants and a
+# tail, so per-tenant admission has real shares to arbitrate.
+_TENANTS = (
+    ("tenant-a", 0.4),
+    ("tenant-b", 0.3),
+    ("tenant-c", 0.2),
+    ("tenant-d", 0.1),
+)
+
+
+def _pick_tenant(rng: random.Random) -> str:
+    x = rng.random()
+    acc = 0.0
+    for name, w in _TENANTS:
+        acc += w
+        if x < acc:
+            return name
+    return _TENANTS[-1][0]
+
+
+def _lens(rng: random.Random) -> "tuple[int, int]":
+    prompt = rng.randint(64, 256)
+    gen = rng.randint(16, 96)
+    return prompt, gen
+
+
+def _requests_from_weights(
+    num_requests: int, duration_s: float, weights: List[float],
+    rng: random.Random, prefix_zipf: float = 0.0, num_groups: int = 0,
+) -> List[SimRequestSpec]:
+    """Place `num_requests` arrivals over `duration_s` proportionally to
+    per-bin `weights` (the rate shape), jittered uniformly inside each
+    bin. With prefix_zipf > 0, each request joins prefix group
+    ~Zipf(s=prefix_zipf) over `num_groups` groups."""
+    total_w = sum(weights) or 1.0
+    bin_s = duration_s / len(weights)
+    # Zipf CDF over groups (precomputed; group 0 hottest).
+    zipf_cdf: List[float] = []
+    if prefix_zipf > 0 and num_groups > 0:
+        masses = [1.0 / (k ** prefix_zipf) for k in range(1, num_groups + 1)]
+        z = sum(masses)
+        acc = 0.0
+        for m in masses:
+            acc += m / z
+            zipf_cdf.append(acc)
+    out: List[SimRequestSpec] = []
+    remaining = num_requests
+    for i, w in enumerate(weights):
+        in_bin = (
+            remaining if i == len(weights) - 1
+            else int(round(num_requests * w / total_w))
+        )
+        in_bin = min(in_bin, remaining)
+        remaining -= in_bin
+        for _ in range(in_bin):
+            t = bin_s * i + rng.random() * bin_s
+            prompt, gen = _lens(rng)
+            group = -1
+            if zipf_cdf:
+                x = rng.random()
+                for g, c in enumerate(zipf_cdf):
+                    if x < c:
+                        group = g
+                        break
+                else:
+                    group = num_groups - 1
+            out.append(SimRequestSpec(
+                t=t, tenant=_pick_tenant(rng),
+                prompt_len=prompt, gen_len=gen, prefix_group=group,
+            ))
+    out.sort(key=lambda r: r.t)
+    return out
+
+
+def diurnal(num_requests: int, duration_s: float, num_instances: int,
+            seed: int) -> TraceSpec:
+    """Sinusoidal arrival rate: trough at the edges, peak mid-trace at
+    ~5x the trough — the compressed day/night swing."""
+    rng = random.Random(seed)
+    bins = 48
+    weights = [
+        1.0 + 4.0 * 0.5 * (1.0 - math.cos(2.0 * math.pi * i / bins))
+        for i in range(bins)
+    ]
+    return TraceSpec(
+        "diurnal", duration_s,
+        _requests_from_weights(num_requests, duration_s, weights, rng),
+    )
+
+
+def burst(num_requests: int, duration_s: float, num_instances: int,
+          seed: int) -> TraceSpec:
+    """Flat baseline with a 10x spike in the middle fifth of the trace
+    (a retry storm / product launch)."""
+    rng = random.Random(seed)
+    bins = 40
+    lo, hi = int(bins * 0.4), int(bins * 0.6)
+    weights = [10.0 if lo <= i < hi else 1.0 for i in range(bins)]
+    return TraceSpec(
+        "burst", duration_s,
+        _requests_from_weights(num_requests, duration_s, weights, rng),
+    )
+
+
+def zipf_prefix(num_requests: int, duration_s: float, num_instances: int,
+                seed: int) -> TraceSpec:
+    """Uniform arrivals, Zipf(1.1)-skewed shared prompt prefixes over 32
+    groups: a handful of hot system prompts dominate, so cache-aware
+    routing + the prefix index earn their keep (policy=CAR)."""
+    rng = random.Random(seed)
+    return TraceSpec(
+        "zipf_prefix", duration_s,
+        _requests_from_weights(
+            num_requests, duration_s, [1.0] * 32, rng,
+            prefix_zipf=1.1, num_groups=32,
+        ),
+        policy="CAR",
+    )
+
+
+def straggler(num_requests: int, duration_s: float, num_instances: int,
+              seed: int) -> TraceSpec:
+    """Uniform arrivals; ~6% of instances serve 6x slow (thermal
+    throttling, a bad host, a noisy neighbor)."""
+    rng = random.Random(seed)
+    n_slow = max(1, num_instances // 16)
+    slow = rng.sample(range(num_instances), n_slow)
+    return TraceSpec(
+        "straggler", duration_s,
+        _requests_from_weights(num_requests, duration_s, [1.0] * 32, rng),
+        straggler_factors={i: 6.0 for i in slow},
+    )
+
+
+def rolling_restart(num_requests: int, duration_s: float,
+                    num_instances: int, seed: int) -> TraceSpec:
+    """Uniform arrivals while EVERY instance is drained (deregistered —
+    its inflight work transparently redispatches/resumes), then rejoined
+    after a grace period, in sequence across the middle 60% of the
+    trace. The guard: zero unrecovered streams fleet-wide."""
+    rng = random.Random(seed)
+    actions: List[FleetAction] = []
+    window_start = duration_s * 0.2
+    window = duration_s * 0.6
+    step = window / num_instances
+    grace = step * 0.5
+    for i in range(num_instances):
+        t = window_start + i * step
+        actions.append(FleetAction(t=t, kind="drain", instance=i))
+        actions.append(FleetAction(t=t + grace, kind="rejoin", instance=i))
+    return TraceSpec(
+        "rolling_restart", duration_s,
+        _requests_from_weights(num_requests, duration_s, [1.0] * 32, rng),
+        actions=actions,
+    )
+
+
+SCENARIOS = {
+    "diurnal": diurnal,
+    "burst": burst,
+    "zipf_prefix": zipf_prefix,
+    "straggler": straggler,
+    "rolling_restart": rolling_restart,
+}
+
+
+def make_trace(name: str, num_requests: int, duration_s: float,
+               num_instances: int, seed: int = 0) -> TraceSpec:
+    try:
+        gen = SCENARIOS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario {name!r}; pick from {sorted(SCENARIOS)}"
+        )
+    return gen(num_requests, duration_s, num_instances, seed)

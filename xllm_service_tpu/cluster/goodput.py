@@ -44,6 +44,17 @@ Hatches (all read per call so they flip on a live cluster):
   XLLM_GOODPUT_HYSTERESIS_TICKS    same-direction ticks before a flip
   XLLM_GOODPUT_MIN_FLIP_INTERVAL_S floor between reshaping flips
   XLLM_GOODPUT_DRAIN_TIMEOUT_S     want age before force-flipping
+
+Autoscaling signals (`autoscale_signals()`, master-loop cadence next to
+`tick()`): reshaping only re-slices the fleet we HAVE; the same demand
+model also says how many instances per role we'd WANT — the gauges an
+external autoscaler (or bench_fleet's scenario guards) consumes.
+`xllm_autoscale_wanted_instances{role}` is demand-derived (queued work
+over the per-instance waiting target), `xllm_autoscale_encoder_headroom`
+is the fraction of encoder capacity still free (negative = encoders are
+the bottleneck). Hatches: XLLM_FLEET_AUTOSCALE=1|0 (default on) and
+XLLM_FLEET_AUTOSCALE_TARGET_WAITING (waiting requests per serving
+instance the fleet should absorb before asking for more, default 4).
 """
 
 from __future__ import annotations
@@ -56,6 +67,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, Optional
 
+from xllm_service_tpu.common import faults
 from xllm_service_tpu.common.types import InstanceType
 
 logger = logging.getLogger(__name__)
@@ -142,6 +154,11 @@ class GoodputController:
         self.decisions = {"colocate": 0, "disaggregate": 0, "static": 0}
         self.reshape_flips = 0
         self._wanted_census = {"prefill": 0, "decode": 0, "mix": 0}
+        # Latest autoscale verdict (autoscale_signals()); gauges read it.
+        self._wanted_instances = {
+            "prefill": 0, "decode": 0, "mix": 0, "encode": 0,
+        }
+        self._encoder_headroom = 1.0
         self._decisions_total = None
         self._flips_total = None
         if metrics is not None:
@@ -165,6 +182,22 @@ class GoodputController:
                 wanted.labels(role=role).set_function(
                     lambda r=role: float(self._wanted_census[r])
                 )
+            wanted_inst = metrics.gauge(
+                "xllm_autoscale_wanted_instances",
+                "Instances per role the demand model would provision "
+                "(autoscaler input; 0 until the first signal tick)",
+                labelnames=("role",),
+            )
+            for role in ("prefill", "decode", "mix", "encode"):
+                wanted_inst.labels(role=role).set_function(
+                    lambda r=role: float(self._wanted_instances[r])
+                )
+            metrics.gauge(
+                "xllm_autoscale_encoder_headroom",
+                "Fraction of encoder capacity still free "
+                "(1 = idle, 0 = at the waiting target, negative = "
+                "encoders are the bottleneck)",
+            ).set_function(lambda: float(self._encoder_headroom))
 
     # ------------------------------------------------------------------ #
     # signals
@@ -397,6 +430,105 @@ class GoodputController:
             if lm is not None:
                 demand_d += lm.waiting_requests_num
         return demand_p, demand_d
+
+    # ------------------------------------------------------------------ #
+    # half (c): autoscaling signals
+    # ------------------------------------------------------------------ #
+
+    def autoscale_signals(self) -> Dict[str, object]:
+        """Emit the wanted-instances-per-role and encoder-headroom
+        signals (master-loop cadence, and directly from bench_fleet).
+
+        Reshaping moves roles WITHIN the fleet; this says how big the
+        fleet should BE: queued+running work per role over the waiting
+        target gives a wanted replica count, never below 1 per role that
+        currently exists (scaling to zero is a provisioning decision,
+        not a load signal). Encoder headroom is how much of the encode
+        tier's waiting budget is unspent — the EPD-specific signal,
+        since encoders saturate on media bursts long before the LM tiers
+        notice. Returns the signal dict it also publishes as gauges."""
+        if os.environ.get("XLLM_FLEET_AUTOSCALE", "1") == "0":
+            return {}
+        target = max(
+            _env_float("XLLM_FLEET_AUTOSCALE_TARGET_WAITING", 4.0), 0.1
+        )
+        census = self._mgr.role_census()
+        demand_p, demand_d = self._demand()
+        serving = census["prefill"] + census["decode"] + census["mix"]
+        total_demand = demand_p + demand_d
+        # Wanted SERVING fleet size: enough instances that each absorbs
+        # at most `target` units of queued+running work.
+        want_serving = max(
+            1, int(-(-total_demand // target))  # ceil
+        ) if total_demand > 0 else max(serving, 1)
+        want_serving = max(want_serving, 1)
+        # Split the serving want by the same demand ratio the reshaper
+        # uses; MIX capacity counts toward whichever side is thinner, so
+        # a colocate-heavy fleet (all MIX) wants MIX replicas.
+        if census["mix"] >= max(census["prefill"], census["decode"]):
+            wanted = {
+                "prefill": census["prefill"],
+                "decode": census["decode"],
+                "mix": max(
+                    1, want_serving - census["prefill"] - census["decode"]
+                ),
+            }
+        else:
+            want_p = (
+                self._wanted_prefill(
+                    want_serving, demand_p, demand_d,
+                    max(census["prefill"], 1),
+                )
+                if want_serving >= 2 else want_serving
+            )
+            wanted = {
+                "prefill": want_p,
+                "decode": max(want_serving - want_p, 0),
+                "mix": census["mix"],
+            }
+        # Encoder headroom: unspent share of the encode tier's waiting
+        # budget. No encoders registered = no EPD tier = full headroom.
+        enc_names = self._mgr.encode_instances()
+        enc_waiting = 0.0
+        load = self._mgr.get_load_metrics()
+        for name in enc_names:
+            lm = load.get(name)
+            if lm is not None:
+                enc_waiting += lm.waiting_requests_num
+        if enc_names:
+            budget = target * len(enc_names)
+            headroom = (budget - enc_waiting) / budget
+            wanted["encode"] = max(
+                len(enc_names), int(-(-enc_waiting // target))
+            )
+        else:
+            headroom = 1.0
+            wanted["encode"] = 0
+        signal = {
+            "wanted_instances": wanted,
+            "encoder_headroom": headroom,
+            "demand_prefill": demand_p,
+            "demand_decode": demand_d,
+        }
+        # Chaos seam: a dropped signal tick must degrade to the previous
+        # gauge values, never crash the master loop.
+        try:
+            faults.point(
+                "autoscale.signal",
+                wanted=str(sum(wanted.values())),
+                headroom=f"{headroom:.3f}",
+            )
+        except faults.FaultInjected:
+            return {}
+        self._wanted_instances = wanted
+        self._encoder_headroom = headroom
+        return signal
+
+    def wanted_instances(self) -> Dict[str, int]:
+        return dict(self._wanted_instances)
+
+    def encoder_headroom(self) -> float:
+        return self._encoder_headroom
 
     @staticmethod
     def _wanted_prefill(n, demand_p, demand_d, cur_p):

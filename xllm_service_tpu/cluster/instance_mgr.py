@@ -107,9 +107,15 @@ class InstanceMgr:
         suspect_failures: int = 2,
         eject_failures: int = 4,
         probe_min_interval_s: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self._store = store
         self._is_master = is_master
+        # Injectable monotonic clock (the MemoryStore(clock=...) pattern):
+        # heartbeat staleness, prune, and probe rate-limiting all advance
+        # on THIS clock, so frozen-clock tests pin every expiry decision
+        # and the fleet simulator runs liveness on simulated time.
+        self._clock = clock
         self._stale_after_s = detect_disconnected_interval_s
         # Circuit breaker (docs/FAULT_TOLERANCE.md): consecutive
         # dispatch/cancel failures drive healthy -> suspect -> ejected;
@@ -225,7 +231,7 @@ class InstanceMgr:
                 self._predictors[meta.name] = TimePredictor(
                     meta.ttft_profiling_data, meta.tpot_profiling_data
                 )
-                self._heartbeat_ts[meta.name] = time.monotonic()
+                self._heartbeat_ts[meta.name] = self._clock()
                 return
             self._instances[meta.name] = meta
             self._predictors[meta.name] = TimePredictor(
@@ -234,7 +240,7 @@ class InstanceMgr:
             self._request_metrics[meta.name] = RequestMetrics()
             self._latency_metrics[meta.name] = LatencyMetrics()
             self._load_metrics.setdefault(meta.name, LoadMetrics())
-            self._heartbeat_ts[meta.name] = time.monotonic()
+            self._heartbeat_ts[meta.name] = self._clock()
             # A fresh registration starts with a clean breaker: the lease
             # write proves the instance is up NOW.
             self._health[meta.name] = _Health()
@@ -381,7 +387,7 @@ class InstanceMgr:
                                 fresh = counter > prev[1]
                             self._load_flush_seq[name] = (epoch, counter)
                         if name in self._instances and fresh:
-                            self._heartbeat_ts[name] = time.monotonic()
+                            self._heartbeat_ts[name] = self._clock()
                     except Exception:
                         pass
                 else:
@@ -521,7 +527,7 @@ class InstanceMgr:
         """Pre-prune staleness signal: an instance silent for half the
         prune interval turns suspect (routing avoids it) well before the
         prune backstop removes it."""
-        now = time.monotonic()
+        now = self._clock()
         marked: List[str] = []
         with self._mu:
             for name, ts in self._heartbeat_ts.items():
@@ -550,7 +556,7 @@ class InstanceMgr:
         prober = self.health_prober
         if prober is None:
             return 0
-        now = time.monotonic()
+        now = self._clock()
         due: List[InstanceMetaInfo] = []
         with self._mu:
             for name, h in self._health.items():
@@ -749,7 +755,7 @@ class InstanceMgr:
             if name not in self._instances:
                 return
             self._load_metrics[name] = metrics
-            self._heartbeat_ts[name] = time.monotonic()
+            self._heartbeat_ts[name] = self._clock()
             self._dirty_load.add(name)
             self._beat_observed(name)
 
@@ -799,7 +805,7 @@ class InstanceMgr:
                 return
             if load is not None:
                 self._load_metrics[name] = load
-            self._heartbeat_ts[name] = time.monotonic()
+            self._heartbeat_ts[name] = self._clock()
             rm = RequestMetrics()
             pred = self._predictors.get(name)
             for ent in manifest:
@@ -824,7 +830,7 @@ class InstanceMgr:
         """Drop instances whose heartbeats stopped, master-side backstop to
         store-lease liveness. The reference declares this interval flag but
         never consumes it (master.cpp:193-194) — here it works."""
-        now = time.monotonic()
+        now = self._clock()
         stale: List[str] = []
         with self._mu:
             for name, ts in list(self._heartbeat_ts.items()):

@@ -110,6 +110,20 @@ class ServiceConfig:
     # field either way (read per call); when off or when its input
     # signals are stale the scheduler keeps today's static behavior.
     enable_goodput_controller: bool = True
+    # Per-tenant admission control at the front door (service/admission.py):
+    # token-bucket rate (req/s per tenant, 0 = unlimited), per-tenant and
+    # global inflight caps, fair-share weighted queuing bounded by the
+    # queue timeout (0 = shed immediately at the global cap), and
+    # "tenant:weight,..." fair shares. XLLM_ADMISSION=1|0 overrides the
+    # enable either way; each knob has a matching XLLM_ADMISSION_* hatch
+    # read per call (docs/ARCHITECTURE.md).
+    enable_admission_control: bool = True
+    admission_rate: float = 0.0
+    admission_burst: float = 0.0
+    admission_max_inflight: int = 2048
+    admission_max_global_inflight: int = 8192
+    admission_queue_timeout_s: float = 2.0
+    admission_weights: str = ""
 
     # Tokenizer / template (reference: --tokenizer_path).
     tokenizer_path: str = ""

@@ -23,6 +23,10 @@ from typing import Any, Dict, Iterable, List, Tuple
 
 SPAN_STAGES = (
     "receive",
+    # Admission verdict (service/admission.py): the request was turned
+    # away at the front door — 429 + Retry-After, before tokenize ever
+    # ran. Terminal: a shed request has no further timeline.
+    "shed",
     "tokenize",
     "route",
     "dispatch",
@@ -64,7 +68,7 @@ INSTANCE_SPAN_STAGES = (
 ALL_SPAN_STAGES = SPAN_STAGES + INSTANCE_SPAN_STAGES
 
 # Terminal stages close a request's timeline.
-TERMINAL_STAGES = frozenset(("finish", "cancel", "error"))
+TERMINAL_STAGES = frozenset(("finish", "cancel", "error", "shed"))
 
 
 def load_spans(path: str) -> List[Dict[str, Any]]:

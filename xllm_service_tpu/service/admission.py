@@ -1,0 +1,455 @@
+"""Per-tenant admission control and fair-share overload protection.
+
+Until this module, the only overload defense was evserve's
+connection-level 503 shed (api/evserve/server.py) — a full accept
+backlog. Everything admitted past the socket joined the scheduler's
+unbounded inflight set, so demand past capacity collapsed goodput for
+EVERY tenant at once: queues grew without bound, each request's TTFT
+blew through the SLO, and the SLO-met-token rate ("Unifying Both for
+Goodput-Optimized LLM Serving", arxiv 2508.01989) went to zero exactly
+when the fleet was busiest. P/D-Serve (arxiv 2408.08147) runs this
+control at the front door of tens of thousands of devices.
+
+The controller sits at the very top of `Scheduler.schedule()` — BEFORE
+the chat template and tokenizer, so a shed costs microseconds, not a
+tokenize of a prompt we will refuse anyway. Three mechanisms, all
+per-tenant (tenant = the request's `user` field, falling back to the
+model name — the same key the goodput controller's decode-length EWMA
+uses):
+
+* **Token-bucket rate limit** — `rate` requests/s refilled
+  continuously, `burst` deep. A dry bucket sheds immediately with
+  `Retry-After = (deficit / rate)` so well-behaved clients back off to
+  exactly the sustainable rate instead of hammering the door.
+* **Inflight caps** — per-tenant and global. The global cap is the
+  scheduler's real protection (bounded queues bound TTFT); the
+  per-tenant cap keeps one tenant from owning the whole window.
+* **Fair-share weighted queuing** — when the global cap is hit,
+  arrivals may briefly WAIT for a slot instead of shedding. Waiters
+  queue per tenant and releases grant by deficit-weighted round-robin
+  (`XLLM_ADMISSION_WEIGHTS`, e.g. "gold:4,free:1"), so a heavy tenant
+  cannot starve a light one no matter how fast it retries. The wait is
+  deadline-aware: when the estimated wait (queue depth over the
+  observed release rate) already exceeds the queue timeout, the
+  request sheds IMMEDIATELY with that estimate as Retry-After —
+  shedding early under hopeless backlog is what keeps the queue from
+  collapsing into a convoy of doomed waiters.
+
+Sheds return `RESOURCE_EXHAUSTED`, which the master's `_HTTP_STATUS`
+map renders as HTTP 429 with a `Retry-After` header from
+`request.retry_after_s`. Admission never touches token bytes: an
+admitted stream is byte-identical to the same stream with the hatch
+off (tests/test_admission.py differential).
+
+Hatches (all read per call, so they flip on a live cluster;
+docs/ARCHITECTURE.md hatch table):
+
+  XLLM_ADMISSION=1|0                   master on/off override
+  XLLM_ADMISSION_RATE                  per-tenant token-bucket rate, req/s
+                                       (0 = unlimited)
+  XLLM_ADMISSION_BURST                 bucket depth (0 = max(rate, 1))
+  XLLM_ADMISSION_MAX_INFLIGHT          per-tenant inflight cap
+  XLLM_ADMISSION_MAX_GLOBAL_INFLIGHT   fleet-wide inflight cap
+  XLLM_ADMISSION_QUEUE_TIMEOUT_S       fair-queue wait bound (0 = shed
+                                       instead of waiting)
+  XLLM_ADMISSION_WEIGHTS               "tenant:weight,..." fair shares
+
+The injectable `clock` follows the PR 18 `MemoryStore(clock=...)`
+pattern: bucket refill and rate estimation advance on the injected
+clock only, so tests pin expiry deterministically and the fleet
+simulator (cluster/fleet_sim) runs admission on SIMULATED time.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import os
+import threading
+import time
+from typing import Callable, Deque, Dict, Optional, Tuple
+
+from xllm_service_tpu.common import faults
+from xllm_service_tpu.common.types import Status, StatusCode
+
+# Release-rate EWMA smoothing for the deadline estimate: recent
+# completions dominate, one burst of finishes doesn't whipsaw it.
+RATE_ALPHA = 0.2
+
+
+def admission_enabled(cfg=None) -> bool:
+    """XLLM_ADMISSION=1|0 overrides config either way; read per call so
+    the hatch flips on a live cluster."""
+    env = os.environ.get("XLLM_ADMISSION")
+    if env == "1":
+        return True
+    if env == "0":
+        return False
+    return bool(getattr(cfg, "enable_admission_control", True))
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def parse_weights(spec: str) -> Dict[str, float]:
+    """"gold:4,free:1" -> {"gold": 4.0, "free": 1.0}; malformed entries
+    are dropped (an operator typo must not take the front door down)."""
+    out: Dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        name, _, w = part.rpartition(":")
+        try:
+            val = float(w)
+        except ValueError:
+            continue
+        if name and val > 0:
+            out[name] = val
+    return out
+
+
+class _TenantState:
+    __slots__ = ("tokens", "last_refill", "inflight", "credit")
+
+    def __init__(self, now: float, burst: float) -> None:
+        self.tokens = burst
+        self.last_refill = now
+        self.inflight = 0
+        self.credit = 0.0  # deficit-round-robin credit while waiting
+
+
+class _Waiter:
+    __slots__ = ("tenant", "event", "granted")
+
+    def __init__(self, tenant: str) -> None:
+        self.tenant = tenant
+        self.event = threading.Event()
+        self.granted = False
+
+
+class AdmissionController:
+    """Front-door admission (see module docstring). Thread-safe: acquire
+    on HTTP handler threads, release on scheduler lane threads."""
+
+    def __init__(self, config=None, metrics=None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self._config = config
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._tenants: Dict[str, _TenantState] = {}
+        self._global_inflight = 0
+        # Per-tenant FIFO of waiters + tenant arrival order for the
+        # deficit-weighted grant scan.
+        self._waiting: Dict[str, Deque[_Waiter]] = {}
+        # Release-rate EWMA (req/s on the injected clock) for the
+        # deadline-aware shed estimate.
+        self._release_rate = 0.0
+        self._last_release = 0.0
+        # Lifetime counters (bench/report surfaces; the labeled counter
+        # below carries the same numbers into /metrics).
+        self.sheds = {"rate": 0, "tenant_inflight": 0, "queue_full": 0,
+                      "queue_timeout": 0, "injected": 0}
+        self.admitted_total = 0
+        self._m_sheds = None
+        self._m_queue_wait = None
+        self._m_tenant_inflight = None
+        if metrics is not None:
+            self._m_sheds = metrics.counter(
+                "xllm_admission_sheds_total",
+                "Requests shed at the front door by reason "
+                "(rate/tenant_inflight/queue_full/queue_timeout/injected)",
+                labelnames=("reason",),
+            )
+            self._m_queue_wait = metrics.histogram(
+                "xllm_admission_queue_wait_ms",
+                "Admission fair-queue wait for ADMITTED requests "
+                "(sheds are counted, not timed)",
+            )
+            self._m_tenant_inflight = metrics.gauge(
+                "xllm_admission_tenant_inflight",
+                "Admitted, unreleased requests per tenant",
+                labelnames=("tenant",),
+            )
+            metrics.gauge(
+                "xllm_admission_queued_waiters",
+                "Requests currently parked in the admission fair queue",
+            ).set_function(lambda: float(self._num_waiting()))
+
+    # ------------------------------------------------------------------ #
+    # knobs (env wins over config, read per call)
+    # ------------------------------------------------------------------ #
+
+    def _rate(self) -> float:
+        return _env_float(
+            "XLLM_ADMISSION_RATE",
+            float(getattr(self._config, "admission_rate", 0.0)),
+        )
+
+    def _burst(self) -> float:
+        burst = _env_float(
+            "XLLM_ADMISSION_BURST",
+            float(getattr(self._config, "admission_burst", 0.0)),
+        )
+        return burst if burst > 0 else max(self._rate(), 1.0)
+
+    def _tenant_cap(self) -> int:
+        return _env_int(
+            "XLLM_ADMISSION_MAX_INFLIGHT",
+            int(getattr(self._config, "admission_max_inflight", 2048)),
+        )
+
+    def _global_cap(self) -> int:
+        return _env_int(
+            "XLLM_ADMISSION_MAX_GLOBAL_INFLIGHT",
+            int(getattr(
+                self._config, "admission_max_global_inflight", 8192
+            )),
+        )
+
+    def _queue_timeout_s(self) -> float:
+        return _env_float(
+            "XLLM_ADMISSION_QUEUE_TIMEOUT_S",
+            float(getattr(self._config, "admission_queue_timeout_s", 2.0)),
+        )
+
+    def _weight(self, tenant: str) -> float:
+        spec = os.environ.get(
+            "XLLM_ADMISSION_WEIGHTS",
+            str(getattr(self._config, "admission_weights", "") or ""),
+        )
+        return parse_weights(spec).get(tenant, 1.0)
+
+    # ------------------------------------------------------------------ #
+    # acquire / release
+    # ------------------------------------------------------------------ #
+
+    def acquire(self, request) -> Optional[Status]:
+        """Admit or shed one request. Returns None when admitted (the
+        request is charged; `release(request)` MUST follow exactly once)
+        and a RESOURCE_EXHAUSTED Status when shed, with
+        `request.retry_after_s` set for the master's Retry-After header.
+        Disabled: always admits, charges nothing (release no-ops)."""
+        if not admission_enabled(self._config):
+            return None
+        tenant = getattr(request, "tenant", "") or request.model or "-"
+        request.tenant = tenant
+        # Chaos seam: a matching XLLM_CHAOS_SPEC rule FORCES a shed here,
+        # so chaos runs exercise every 429 client path without needing a
+        # real overload (docs/FAULT_TOLERANCE.md shed matrix).
+        try:
+            faults.point("admission.shed", tenant=tenant,
+                         request_id=request.service_request_id)
+        except faults.FaultInjected:
+            return self._shed(request, tenant, "injected", 1.0)
+        now = self._clock()
+        rate = self._rate()
+        with self._mu:
+            st = self._tenants.get(tenant)
+            if st is None:
+                st = self._tenants[tenant] = _TenantState(
+                    now, self._burst()
+                )
+            # Token bucket (rate 0 = unlimited).
+            if rate > 0:
+                burst = self._burst()
+                st.tokens = min(
+                    burst, st.tokens + (now - st.last_refill) * rate
+                )
+                st.last_refill = now
+                if st.tokens < 1.0:
+                    retry = (1.0 - st.tokens) / rate
+                    return self._shed_locked(
+                        request, tenant, "rate", retry
+                    )
+                st.tokens -= 1.0
+            # Per-tenant inflight cap: refund the bucket token — the
+            # request never ran, its rate share shouldn't burn.
+            if st.inflight >= self._tenant_cap():
+                if rate > 0:
+                    st.tokens = min(self._burst(), st.tokens + 1.0)
+                return self._shed_locked(
+                    request, tenant, "tenant_inflight",
+                    self._wait_estimate_locked(),
+                )
+            # Global cap: deadline-aware fair queue.
+            if self._global_inflight >= self._global_cap():
+                if rate > 0:
+                    st.tokens = min(self._burst(), st.tokens + 1.0)
+                timeout = self._queue_timeout_s()
+                est = self._wait_estimate_locked()
+                if timeout <= 0 or est > timeout:
+                    return self._shed_locked(
+                        request, tenant, "queue_full", max(est, 1.0)
+                    )
+                waiter = _Waiter(tenant)
+                self._waiting.setdefault(
+                    tenant, collections.deque()
+                ).append(waiter)
+            else:
+                self._admit_locked(tenant, st)
+                request._admitted = True
+                return None
+        # Park OUTSIDE the lock (releases need it to grant).
+        t0 = time.monotonic()
+        waiter.event.wait(timeout)
+        with self._mu:
+            if not waiter.granted:
+                # Timed out: withdraw from the queue and shed. (A grant
+                # racing the timeout sets `granted` under this same
+                # lock, so the re-check here is authoritative.)
+                q = self._waiting.get(tenant)
+                if q is not None:
+                    try:
+                        q.remove(waiter)
+                    except ValueError:
+                        pass
+                    if not q:
+                        self._waiting.pop(tenant, None)
+                return self._shed_locked(
+                    request, tenant, "queue_timeout",
+                    max(self._wait_estimate_locked(), 1.0),
+                )
+        request._admitted = True
+        if self._m_queue_wait is not None:
+            self._m_queue_wait.observe((time.monotonic() - t0) * 1000.0)
+        return None
+
+    def release(self, request) -> None:
+        """Return one admitted request's charges. Idempotent per request
+        (the `_admitted` flag): error paths between schedule() and
+        terminal bookkeeping may release defensively."""
+        if not getattr(request, "_admitted", False):
+            return
+        request._admitted = False
+        tenant = getattr(request, "tenant", "") or request.model or "-"
+        grant: Optional[_Waiter] = None
+        with self._mu:
+            self._global_inflight = max(0, self._global_inflight - 1)
+            st = self._tenants.get(tenant)
+            if st is not None:
+                st.inflight = max(0, st.inflight - 1)
+            now = self._clock()
+            if self._last_release > 0.0 and now > self._last_release:
+                inst = 1.0 / (now - self._last_release)
+                self._release_rate += RATE_ALPHA * (
+                    inst - self._release_rate
+                )
+            self._last_release = now
+            grant = self._grant_next_locked()
+        if self._m_tenant_inflight is not None and st is not None:
+            self._m_tenant_inflight.labels(tenant=tenant).set(
+                float(st.inflight)
+            )
+        if grant is not None:
+            grant.event.set()
+
+    # ------------------------------------------------------------------ #
+    # internals (all _locked helpers run under self._mu)
+    # ------------------------------------------------------------------ #
+
+    def _admit_locked(self, tenant: str, st: _TenantState) -> None:
+        st.inflight += 1
+        self._global_inflight += 1
+        self.admitted_total += 1
+        if self._m_tenant_inflight is not None:
+            self._m_tenant_inflight.labels(tenant=tenant).set(
+                float(st.inflight)
+            )
+
+    def _grant_next_locked(self) -> Optional[_Waiter]:
+        """Deficit-weighted round-robin over waiting tenants: every
+        grant opportunity adds each waiting tenant's weight to its
+        credit, and the largest credit wins (then pays 1.0). A weight-4
+        tenant therefore drains its queue 4x as fast as a weight-1
+        tenant, and an idle tenant accrues nothing (credits exist only
+        while waiting)."""
+        if not self._waiting or self._global_inflight >= self._global_cap():
+            return None
+        best_tenant = None
+        best_credit = -math.inf
+        for tenant in self._waiting:
+            st = self._tenants.get(tenant)
+            if st is None:
+                continue
+            if st.inflight >= self._tenant_cap():
+                continue  # its own cap holds it back, not fairness
+            st.credit += self._weight(tenant)
+            if st.credit > best_credit:
+                best_credit = st.credit
+                best_tenant = tenant
+        if best_tenant is None:
+            return None
+        q = self._waiting[best_tenant]
+        waiter = q.popleft()
+        if not q:
+            self._waiting.pop(best_tenant, None)
+        st = self._tenants[best_tenant]
+        st.credit -= 1.0
+        if best_tenant not in self._waiting:
+            st.credit = 0.0  # queue drained: no banked advantage
+        waiter.granted = True
+        self._admit_locked(best_tenant, st)
+        return waiter
+
+    def _num_waiting(self) -> int:
+        with self._mu:
+            return sum(len(q) for q in self._waiting.values())
+
+    def _wait_estimate_locked(self) -> float:
+        """Expected seconds until a NEW waiter would be granted: queue
+        depth ahead of it over the observed release rate. Zero observed
+        rate (cold start) estimates one queue-timeout — optimistic
+        enough to try waiting once, pessimistic enough that a dead
+        fleet sheds on the second look."""
+        depth = sum(len(q) for q in self._waiting.values()) + 1
+        if self._release_rate <= 0.0:
+            return float(depth) * max(self._queue_timeout_s(), 1.0)
+        return depth / self._release_rate
+
+    def _shed_locked(self, request, tenant: str, reason: str,
+                     retry_after_s: float) -> Status:
+        return self._shed(request, tenant, reason, retry_after_s)
+
+    def _shed(self, request, tenant: str, reason: str,
+              retry_after_s: float) -> Status:
+        self.sheds[reason] = self.sheds.get(reason, 0) + 1
+        if self._m_sheds is not None:
+            self._m_sheds.labels(reason=reason).inc()
+        request.retry_after_s = max(1.0, math.ceil(retry_after_s))
+        return Status(
+            StatusCode.RESOURCE_EXHAUSTED,
+            f"admission: tenant {tenant!r} shed ({reason}); retry after "
+            f"{request.retry_after_s:.0f}s",
+        )
+
+    # ------------------------------------------------------------------ #
+    # introspection (bench_fleet / tests)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def global_inflight(self) -> int:
+        return self._global_inflight
+
+    @property
+    def queued_waiters(self) -> int:
+        return self._num_waiting()
+
+    def tenant_inflight(self, tenant: str) -> int:
+        with self._mu:
+            st = self._tenants.get(tenant)
+            return st.inflight if st is not None else 0
+
+    def sheds_total(self) -> int:
+        return sum(self.sheds.values())

@@ -77,6 +77,14 @@ class ServiceRequest:
     resumable: bool = True
     resume_token_ids: List[int] = field(default_factory=list)
     resume_base: int = 0
+    # Admission (service/admission.py): `tenant` is the fair-share key —
+    # the OpenAI `user` field when the client sends one, else the model
+    # name. `retry_after_s` is set on a shed and rendered as the HTTP
+    # Retry-After header; `_admitted` marks a charged admission slot
+    # (release is idempotent on it).
+    tenant: str = ""
+    retry_after_s: float = 0.0
+    _admitted: bool = False
     # Tracing hook (reference: Request::trace_callback, service.cpp:212-218).
     trace_callback: Optional[Callable[[str, Any], None]] = None
 

@@ -64,6 +64,7 @@ from xllm_service_tpu.obs import (
     MetricsRegistry,
     SpanRing,
 )
+from xllm_service_tpu.service.admission import AdmissionController
 from xllm_service_tpu.service.ordered_streams import OrderedStreams
 from xllm_service_tpu.service.request import (
     RequestTracer,
@@ -157,8 +158,15 @@ class Scheduler:
         store: Optional[CoordinationStore] = None,
         tokenizer: Optional[Tokenizer] = None,
         identity: str = "",
+        clock: Optional[Callable[[], float]] = None,
     ) -> None:
         self._config = config
+        # Injectable monotonic clock for the CONTROL-plane components
+        # whose expiry/EWMA decisions must be testable and simulatable
+        # (instance health, goodput freshness, admission buckets). The
+        # request-path latency histograms stay on time.monotonic — they
+        # time real work. None = wall monotonic.
+        self._ctrl_clock: Callable[[], float] = clock or time.monotonic
         self._store = store if store is not None else connect(config.etcd_addr)
         self._tokenizer = tokenizer or create_tokenizer(config.tokenizer_path)
         self._chat_template = ChatTemplate(self._tokenizer)
@@ -319,6 +327,7 @@ class Scheduler:
             ),
             suspect_failures=getattr(config, "breaker_suspect_failures", 2),
             eject_failures=getattr(config, "breaker_eject_failures", 4),
+            clock=self._ctrl_clock,
         )
         self._kvcache_mgr = GlobalKVCacheMgr(
             self._store,
@@ -346,6 +355,13 @@ class Scheduler:
         # plus the periodic role-reshaping tick on the master loop.
         self.goodput = GoodputController(
             config, self._instance_mgr, metrics=self.metrics,
+            clock=self._ctrl_clock,
+        )
+        # Front-door admission (service/admission.py): per-tenant rate +
+        # inflight caps with fair-share queuing; consulted at the very
+        # top of schedule(), released at terminal request bookkeeping.
+        self.admission = AdmissionController(
+            config, metrics=self.metrics, clock=self._ctrl_clock,
         )
         self._policy: LoadBalancePolicy = make_policy(
             config.load_balance_policy,
@@ -737,30 +753,41 @@ class Scheduler:
         (reference: update_master_service_heartbeat, scheduler.cpp:113-121)."""
         period = self._config.heartbeat_interval_s
         while not self._stop.wait(period):
-            self._pump_offline()
-            self._notify_flips()
-            # Master-only upkeep runs only once RECONCILED: pruning with a
-            # half-rebuilt heartbeat view would mass-evict live instances
-            # on the first post-takeover tick.
-            if self._master_state != MASTER_ACTIVE:
-                continue
-            try:
-                self._kvcache_mgr.upload_kvcache()
-                self._instance_mgr.upload_load_metrics()
-                # Goodput reshaping: at most one hysteresis-damped,
-                # drain-aware role flip per tick (no-op when the
-                # controller is off or the fleet census already fits).
-                self.goodput.tick()
-                # Health breaker upkeep: silent instances turn suspect
-                # before the prune backstop removes them, and ejected ones
-                # get an active /health probe toward probation.
-                self._instance_mgr.mark_stale_suspects()
-                self._instance_mgr.probe_unhealthy()
-                # pruning fires the removal listeners (re-dispatch + cache
-                # index cleanup)
-                self._instance_mgr.prune_disconnected()
-            except Exception:
-                logger.exception("master loop iteration failed")
+            self.run_master_upkeep()
+
+    def run_master_upkeep(self) -> None:
+        """One master-loop iteration, callable out-of-band: the fleet
+        simulator (cluster/fleet_sim) drives this at SIMULATED heartbeat
+        cadence while the real loop idles on a huge interval."""
+        self._pump_offline()
+        self._notify_flips()
+        # Master-only upkeep runs only once RECONCILED: pruning with a
+        # half-rebuilt heartbeat view would mass-evict live instances
+        # on the first post-takeover tick.
+        if self._master_state != MASTER_ACTIVE:
+            return
+        try:
+            self._kvcache_mgr.upload_kvcache()
+            self._instance_mgr.upload_load_metrics()
+            # Goodput reshaping: at most one hysteresis-damped,
+            # drain-aware role flip per tick (no-op when the
+            # controller is off or the fleet census already fits).
+            self.goodput.tick()
+            # Autoscaling signals (wanted role counts + encoder
+            # headroom gauges) ride the same cadence — reshaping
+            # re-slices the fleet we have, the signals say how big
+            # it should be.
+            self.goodput.autoscale_signals()
+            # Health breaker upkeep: silent instances turn suspect
+            # before the prune backstop removes them, and ejected ones
+            # get an active /health probe toward probation.
+            self._instance_mgr.mark_stale_suspects()
+            self._instance_mgr.probe_unhealthy()
+            # pruning fires the removal listeners (re-dispatch + cache
+            # index cleanup)
+            self._instance_mgr.prune_disconnected()
+        except Exception:
+            logger.exception("master loop iteration failed")
 
     def _notify_flips(self) -> None:
         """Tell flipped instances their new role (round-1 weak item 8:
@@ -794,6 +821,24 @@ class Scheduler:
         return routing
 
     def schedule(self, request: ServiceRequest) -> Status:
+        """Admission gate -> template -> tokenize -> route. Admission
+        runs FIRST (a shed must not pay the tokenizer), and any non-OK
+        outcome below returns the admitted slot immediately — only an
+        OK schedule holds it until finish_request."""
+        shed = self.admission.acquire(request)
+        if shed is not None:
+            self._tracer.stage(
+                request.service_request_id, "shed",
+                tenant=request.tenant,
+                retry_after_s=request.retry_after_s,
+            )
+            return shed
+        status = self._schedule_admitted(request)
+        if not status.ok():
+            self.admission.release(request)
+        return status
+
+    def _schedule_admitted(self, request: ServiceRequest) -> Status:
         """Template -> tokenize -> route (reference: scheduler.cpp:73-106).
         Fills request.token_ids, request.routing, request.estimated_ttft_ms."""
         self._tracer.stage(
@@ -1580,6 +1625,11 @@ class Scheduler:
             return
         state.done = True
         request = state.request
+        # Return the admission slot the moment the stream is terminal —
+        # a parked fair-queue waiter gets it before this method even
+        # finishes its metric bookkeeping. Idempotent (release no-ops on
+        # an already-released request).
+        self.admission.release(request)
         action = (
             RequestAction.CANCEL
             if cancelled and not state.prefill_finished
